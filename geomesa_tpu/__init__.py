@@ -1,0 +1,22 @@
+"""geomesa-tpu: a TPU-native spatio-temporal indexing and query framework.
+
+A from-scratch rebuild of the capabilities of GeoMesa (reference: /root/reference)
+designed for JAX/XLA/TPU: columnar feature blocks in HBM, vectorized space-filling
+curve kernels, batched range decomposition, device-side push-down filters and
+aggregations, and multi-chip execution via ``jax.sharding`` meshes.
+
+Layer map (mirrors SURVEY.md):
+  - ``geomesa_tpu.curve``    -- L0 curve math (Z2/Z3/XZ2/XZ3, binned time)
+  - ``geomesa_tpu.geom``     -- geometry model + predicates
+  - ``geomesa_tpu.schema``   -- feature types (SimpleFeatureTypes analog)
+  - ``geomesa_tpu.filter``   -- CQL-style filter AST, extraction, splitting
+  - ``geomesa_tpu.index``    -- key spaces, strategies, query planner
+  - ``geomesa_tpu.store``    -- columnar block store + datastores
+  - ``geomesa_tpu.ops``      -- JAX device kernels (filter/aggregate)
+  - ``geomesa_tpu.parallel`` -- mesh sharding + distributed execution
+  - ``geomesa_tpu.stats``    -- data sketches + cost estimation
+  - ``geomesa_tpu.convert``  -- ingest converters
+  - ``geomesa_tpu.tools``    -- CLI
+"""
+
+__version__ = "0.1.0"

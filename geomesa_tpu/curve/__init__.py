@@ -1,0 +1,69 @@
+"""L0 curve math: space-filling curves and time binning.
+
+TPU-native rebuild of the reference's ``geomesa-z3`` module plus the external
+``sfcurve-zorder`` library it delegates to (bit interleaving and range
+decomposition; see SURVEY.md section 2.1). Everything here is vectorized
+numpy operating on arrays of coordinates -- the hot ingest/planning path --
+with device (JAX) variants living in ``geomesa_tpu.ops``.
+"""
+
+from geomesa_tpu.curve.normalized import (
+    BitNormalizedDimension,
+    NormalizedLat,
+    NormalizedLon,
+    NormalizedTime,
+    SemiNormalizedDimension,
+    SemiNormalizedLat,
+    SemiNormalizedLon,
+    SemiNormalizedTime,
+)
+from geomesa_tpu.curve.binnedtime import (
+    BinnedTime,
+    TimePeriod,
+    EPOCH_MS,
+    max_offset,
+    max_date_ms,
+    time_to_binned,
+    binned_to_time,
+    bounds_to_indexable_ms,
+)
+from geomesa_tpu.curve.zorder import (
+    IndexRange,
+    z2_encode,
+    z2_decode,
+    z3_encode,
+    z3_decode,
+    zranges,
+)
+from geomesa_tpu.curve.sfc import Z2SFC, Z3SFC
+from geomesa_tpu.curve.xz import XZ2SFC, XZ3SFC, XZ_DEFAULT_G
+
+__all__ = [
+    "BitNormalizedDimension",
+    "NormalizedLat",
+    "NormalizedLon",
+    "NormalizedTime",
+    "SemiNormalizedDimension",
+    "SemiNormalizedLat",
+    "SemiNormalizedLon",
+    "SemiNormalizedTime",
+    "BinnedTime",
+    "TimePeriod",
+    "EPOCH_MS",
+    "max_offset",
+    "max_date_ms",
+    "time_to_binned",
+    "binned_to_time",
+    "bounds_to_indexable_ms",
+    "IndexRange",
+    "z2_encode",
+    "z2_decode",
+    "z3_encode",
+    "z3_decode",
+    "zranges",
+    "Z2SFC",
+    "Z3SFC",
+    "XZ2SFC",
+    "XZ3SFC",
+    "XZ_DEFAULT_G",
+]

@@ -1,0 +1,158 @@
+"""Binned time: a timestamp as (short period-bin, long offset-into-bin).
+
+Semantics match the reference (geomesa-z3 .../curve/BinnedTime.scala:44-227):
+
+  ==========  =====================  ==================  =====================
+  period      bin                    offset unit         max date (exclusive)
+  ==========  =====================  ==================  =====================
+  day         days since epoch       milliseconds        epoch + 32768 days
+  week        weeks since epoch      seconds             epoch + 32768 weeks
+  month       calendar months since  seconds             epoch + 32768 months
+  year        calendar years since   minutes             epoch + 32768 years
+  ==========  =====================  ==================  =====================
+
+``max_offset`` (the time dimension's normalization max) is *fixed* per period
+(BinnedTime.scala:113-120): day -> 86400000 ms, week -> 604800 s,
+month -> 31 days of seconds, year -> 52 weeks of minutes.
+
+All conversions are vectorized over int64 epoch-millisecond arrays using
+numpy datetime64 calendar math (numpy months/years since epoch coincide with
+Joda ``monthsBetween``/``yearsBetween`` from the epoch because the epoch falls
+on the first instant of its day/month/year).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class TimePeriod(enum.Enum):
+    """BinnedTime.scala:216-227."""
+
+    DAY = "day"
+    WEEK = "week"
+    MONTH = "month"
+    YEAR = "year"
+
+    @classmethod
+    def parse(cls, s: "TimePeriod | str") -> "TimePeriod":
+        if isinstance(s, TimePeriod):
+            return s
+        return cls(str(s).strip().lower())
+
+
+class BinnedTime(NamedTuple):
+    bin: int
+    offset: int
+
+
+EPOCH_MS = 0
+
+_DAY_MS = 86400000
+_WEEK_MS = 7 * _DAY_MS
+
+# BinnedTime.scala:113-120
+_MAX_OFFSET = {
+    TimePeriod.DAY: _DAY_MS,                      # millis in a day
+    TimePeriod.WEEK: _WEEK_MS // 1000,            # seconds in a week
+    TimePeriod.MONTH: (_DAY_MS // 1000) * 31,     # seconds in 31 days
+    TimePeriod.YEAR: (_WEEK_MS // 60000) * 52,    # minutes in 52 weeks
+}
+
+_MAX_BIN = 32767  # Short.MaxValue
+
+
+def max_offset(period: TimePeriod) -> int:
+    return _MAX_OFFSET[TimePeriod.parse(period)]
+
+
+def _bin_starts_ms(bins: np.ndarray, period: TimePeriod) -> np.ndarray:
+    """Epoch millis of the first instant of each bin."""
+    bins = np.asarray(bins, dtype=np.int64)
+    if period is TimePeriod.DAY:
+        return bins * _DAY_MS
+    if period is TimePeriod.WEEK:
+        return bins * _WEEK_MS
+    if period is TimePeriod.MONTH:
+        return bins.astype("datetime64[M]").astype("datetime64[ms]").astype(np.int64)
+    if period is TimePeriod.YEAR:
+        return bins.astype("datetime64[Y]").astype("datetime64[ms]").astype(np.int64)
+    raise ValueError(period)
+
+
+def max_date_ms(period: TimePeriod) -> int:
+    """Exclusive max indexable date in epoch millis (BinnedTime.scala:57-61)."""
+    period = TimePeriod.parse(period)
+    return int(_bin_starts_ms(np.asarray([_MAX_BIN + 1]), period)[0])
+
+
+def time_to_binned(
+    ms, period: TimePeriod, lenient: bool = False
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized (bin, offset) from epoch-millisecond timestamps.
+
+    Reference: BinnedTime.scala:161-227 (toDayAndMillis etc.). Raises on
+    out-of-bounds dates unless ``lenient``, which clamps (the analog of
+    indexing with lenient=true at Z3SFC.scala:43-48).
+    """
+    period = TimePeriod.parse(period)
+    ms = np.atleast_1d(np.asarray(ms, dtype=np.int64))
+    hi = max_date_ms(period)
+    if lenient:
+        ms = np.clip(ms, 0, hi - 1)
+    else:
+        if ms.size and (ms.min() < 0 or ms.max() >= hi):
+            raise ValueError(
+                f"Date exceeds indexable range [0, {hi}) ms for period {period.value}"
+            )
+    if period is TimePeriod.DAY:
+        bins = ms // _DAY_MS
+        offsets = ms - bins * _DAY_MS
+    elif period is TimePeriod.WEEK:
+        bins = ms // _WEEK_MS
+        offsets = (ms - bins * _WEEK_MS) // 1000
+    elif period is TimePeriod.MONTH:
+        months = ms.astype("datetime64[ms]").astype("datetime64[M]")
+        bins = months.astype(np.int64)
+        offsets = (ms - months.astype("datetime64[ms]").astype(np.int64)) // 1000
+    else:  # YEAR
+        years = ms.astype("datetime64[ms]").astype("datetime64[Y]")
+        bins = years.astype(np.int64)
+        offsets = (ms - years.astype("datetime64[ms]").astype(np.int64)) // 60000
+    return bins.astype(np.int16), offsets.astype(np.int64)
+
+
+def binned_to_time(bins, offsets, period: TimePeriod) -> np.ndarray:
+    """Inverse of :func:`time_to_binned` -> epoch millis.
+
+    Reference: BinnedTime.scala fromDayAndMillis / fromWeekAndSeconds /
+    fromMonthAndSeconds / fromYearAndMinutes.
+    """
+    period = TimePeriod.parse(period)
+    bins = np.atleast_1d(np.asarray(bins, dtype=np.int64))
+    offsets = np.atleast_1d(np.asarray(offsets, dtype=np.int64))
+    starts = _bin_starts_ms(bins, period)
+    if period is TimePeriod.DAY:
+        return starts + offsets
+    if period is TimePeriod.WEEK or period is TimePeriod.MONTH:
+        return starts + offsets * 1000
+    return starts + offsets * 60000
+
+
+def bounds_to_indexable_ms(
+    lo: Optional[int], hi: Optional[int], period: TimePeriod
+) -> Tuple[int, int]:
+    """Clamp filter-extracted date bounds to the indexable domain.
+
+    Reference: BinnedTime.boundsToIndexableDates (BinnedTime.scala:140-163) --
+    missing bounds open to the domain edge; everything clamps into
+    [epoch, maxDate - 1ms].
+    """
+    period = TimePeriod.parse(period)
+    max_ms = max_date_ms(period) - 1
+    lo_ms = EPOCH_MS if lo is None else min(max(int(lo), EPOCH_MS), max_ms)
+    hi_ms = max_ms if hi is None else min(max(int(hi), EPOCH_MS), max_ms)
+    return lo_ms, hi_ms

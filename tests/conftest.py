@@ -1,0 +1,15 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's mock-cluster test pattern (SURVEY.md section 4):
+distributed behavior is exercised in-process, here via
+``xla_force_host_platform_device_count`` instead of Accumulo MockInstance.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,136 @@
+"""XZ2/XZ3 parity tests, mirroring the reference's XZ2SFCTest/XZ3SFCTest."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import TimePeriod, XZ2SFC, XZ3SFC, max_offset
+
+
+class TestXZ2:
+    def setup_method(self):
+        self.sfc = XZ2SFC.for_g(12)
+
+    def test_cached(self):
+        assert XZ2SFC.for_g(12) is XZ2SFC.for_g(12)
+
+    def test_small_box_has_max_length_code(self):
+        # a tiny box bottoms out at resolution g: its code must be >= the code
+        # of the enclosing level-1 quad
+        z = self.sfc.index(1.0, 1.0, 1.0001, 1.0001)[0]
+        assert z > 0
+
+    def test_point_boxes_vectorized_match_scalar(self):
+        rs = np.random.RandomState(0)
+        xs = rs.uniform(-179, 179, 200)
+        ys = rs.uniform(-89, 89, 200)
+        w = rs.uniform(0, 1, 200)
+        zs = self.sfc.index(xs, ys, xs + w, ys + w)
+        for i in range(0, 200, 17):
+            zi = self.sfc.index(
+                float(xs[i]), float(ys[i]), float(xs[i] + w[i]), float(ys[i] + w[i])
+            )[0]
+            assert zi == zs[i]
+
+    def test_larger_box_shorter_code(self):
+        small = self.sfc.index(10.0, 10.0, 10.001, 10.001)[0]
+        large = self.sfc.index(10.0, 10.0, 50.0, 50.0)[0]
+        # larger boxes terminate higher in the tree -> smaller sequence codes
+        assert large < small
+
+    def test_out_of_bounds(self):
+        with pytest.raises(ValueError):
+            self.sfc.index(-190.0, 0.0, 0.0, 1.0)
+        z = self.sfc.index(-190.0, 0.0, 0.0, 1.0, lenient=True)
+        assert z[0] >= 0
+
+    def test_ranges_cover_indexed_geometries(self):
+        """Any geometry intersecting the query window must have its sequence
+        code inside the returned ranges (the index contract)."""
+        query = (-10.0, -10.0, 10.0, 10.0)
+        ranges = self.sfc.ranges([query])
+        lowers = np.array([r.lower for r in ranges])
+        uppers = np.array([r.upper for r in ranges])
+        rs = np.random.RandomState(1)
+        # geometries of assorted sizes that intersect the query box
+        for _ in range(300):
+            cx = rs.uniform(-12, 12)
+            cy = rs.uniform(-12, 12)
+            w = rs.uniform(0.001, 8)
+            xmin, ymin = cx - w / 2, cy - w / 2
+            xmax, ymax = cx + w / 2, cy + w / 2
+            if xmax < query[0] or xmin > query[2] or ymax < query[1] or ymin > query[3]:
+                continue  # doesn't intersect
+            xmin, xmax = np.clip([xmin, xmax], -180, 180)
+            ymin, ymax = np.clip([ymin, ymax], -90, 90)
+            z = self.sfc.index(float(xmin), float(ymin), float(xmax), float(ymax))[0]
+            i = np.searchsorted(lowers, z, side="right") - 1
+            assert i >= 0 and z <= uppers[i], (xmin, ymin, xmax, ymax)
+
+    def test_disjoint_geometry_not_required_covered(self):
+        # sanity: ranges are non-trivial (not the whole curve)
+        query = (-1.0, -1.0, 1.0, 1.0)
+        ranges = self.sfc.ranges([query])
+        total = sum(r.upper - r.lower + 1 for r in ranges)
+        whole = (4 ** (self.sfc.g + 1) - 1) // 3
+        assert total < whole / 10
+
+    def test_max_ranges_budget(self):
+        query = (-170.0, -80.0, 170.0, 80.0)
+        unbounded = self.sfc.ranges([query])
+        bounded = self.sfc.ranges([query], max_ranges=20)
+        assert len(bounded) <= len(unbounded)
+        # bounded must still cover: spot check with contained geometry
+        z = self.sfc.index(0.0, 0.0, 1.0, 1.0)[0]
+        assert any(r.lower <= z <= r.upper for r in bounded)
+
+    def test_whole_world(self):
+        # maxDim=1.0 -> l1=0, the l1+1 predicate holds -> length 1, code 1
+        # (XZ2SFC.scala:62-77: floor(log(1)/log(.5)) = 0, then both-axis fit)
+        z = self.sfc.index(-180.0, -90.0, 180.0, 90.0)[0]
+        assert z == 1
+
+
+class TestXZ3:
+    def setup_method(self):
+        self.sfc = XZ3SFC.for_period(12, TimePeriod.WEEK)
+
+    def test_ranges_cover_indexed_geometries(self):
+        tmax = float(max_offset(TimePeriod.WEEK))
+        query = (-10.0, -10.0, 0.0, 10.0, 10.0, tmax / 4)
+        ranges = self.sfc.ranges([query], max_ranges=2000)
+        lowers = np.array([r.lower for r in ranges])
+        uppers = np.array([r.upper for r in ranges])
+        rs = np.random.RandomState(2)
+        for _ in range(200):
+            cx, cy = rs.uniform(-12, 12), rs.uniform(-12, 12)
+            ct = rs.uniform(0, tmax / 3)
+            w = rs.uniform(0.001, 5)
+            wt = rs.uniform(1, tmax / 20)
+            box = (cx - w / 2, cy - w / 2, ct, cx + w / 2, cy + w / 2, ct + wt)
+            if (
+                box[3] < query[0]
+                or box[0] > query[3]
+                or box[4] < query[1]
+                or box[1] > query[4]
+                or box[5] < query[2]
+                or box[2] > query[5]
+            ):
+                continue
+            xmin, xmax = np.clip([box[0], box[3]], -180, 180)
+            ymin, ymax = np.clip([box[1], box[4]], -90, 90)
+            tmin_, tmax_ = np.clip([box[2], box[5]], 0, tmax)
+            z = self.sfc.index(
+                float(xmin), float(ymin), float(tmin_), float(xmax), float(ymax), float(tmax_)
+            )[0]
+            i = np.searchsorted(lowers, z, side="right") - 1
+            assert i >= 0 and z <= uppers[i]
+
+    def test_whole_space_code(self):
+        # same l1=0 -> length-1 logic as XZ2: whole space gets code 1
+        tmax = float(max_offset(TimePeriod.WEEK))
+        z = self.sfc.index(-180.0, -90.0, 0.0, 180.0, 90.0, tmax)[0]
+        assert z == 1
+
+    def test_instance_cache(self):
+        a = XZ3SFC.for_period(12, TimePeriod.WEEK)
+        assert a is self.sfc
