@@ -1,0 +1,78 @@
+"""CQL-style filter layer: AST, parser, bounds extraction, evaluation.
+
+Rebuild of the reference's ``geomesa-filter`` module (FilterHelper.scala,
+Bounds.scala, FilterValues.scala, package.scala CNF/DNF rewrites) plus the
+subset of (E)CQL text parsing the framework consumes. The AST is a typed
+mini-IR (SURVEY.md section 7): planners extract geometries/intervals from it,
+device kernels compile the common predicates, and a vectorized numpy
+evaluator covers the long tail exactly.
+"""
+
+from geomesa_tpu.filter.ast import (
+    And,
+    BBox,
+    Before,
+    After,
+    Between,
+    Contains,
+    DWithin,
+    During,
+    EXCLUDE,
+    Exclude,
+    Filter,
+    IdFilter,
+    INCLUDE,
+    Include,
+    InList,
+    Intersects,
+    Disjoint,
+    IsNull,
+    Like,
+    Not,
+    Or,
+    Cmp,
+    TEquals,
+    Within,
+)
+from geomesa_tpu.filter.parser import parse_cql
+from geomesa_tpu.filter.bounds import Bound, Bounds, FilterValues
+from geomesa_tpu.filter.extract import extract_geometries, extract_intervals
+from geomesa_tpu.filter.evaluate import evaluate
+from geomesa_tpu.filter.rewrite import to_cnf, to_dnf, simplify
+
+__all__ = [
+    "And",
+    "BBox",
+    "Before",
+    "After",
+    "Between",
+    "Contains",
+    "DWithin",
+    "During",
+    "EXCLUDE",
+    "Exclude",
+    "Filter",
+    "IdFilter",
+    "INCLUDE",
+    "Include",
+    "InList",
+    "Intersects",
+    "Disjoint",
+    "IsNull",
+    "Like",
+    "Not",
+    "Or",
+    "Cmp",
+    "TEquals",
+    "Within",
+    "parse_cql",
+    "Bound",
+    "Bounds",
+    "FilterValues",
+    "extract_geometries",
+    "extract_intervals",
+    "evaluate",
+    "to_cnf",
+    "to_dnf",
+    "simplify",
+]

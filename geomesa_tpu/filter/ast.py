@@ -1,0 +1,287 @@
+"""Filter AST: a typed mini-IR for the CQL subset the framework plans over.
+
+The node set mirrors the OpenGIS filter classes the reference consumes
+(org.opengis.filter.*, dispatched in FilterHelper.scala and the strategy
+extractors): logical And/Or/Not, spatial BBOX/INTERSECTS/CONTAINS/WITHIN/
+DWITHIN/DISJOINT, temporal DURING/BEFORE/AFTER/TEQUALS, comparisons, LIKE,
+NULL checks, and feature-id filters.
+
+Literals are stored raw (str/float/int) and coerced against the schema at
+extraction/evaluation time, like GeoTools' late binding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from geomesa_tpu.geom.base import Envelope, Geometry
+
+
+class Filter:
+    """Base filter node."""
+
+    def children(self) -> Sequence["Filter"]:
+        return ()
+
+    def __repr__(self):
+        from geomesa_tpu.filter.parser import to_cql
+
+        return to_cql(self)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash(repr(self))
+
+
+class Include(Filter):
+    """Matches everything (Filter.INCLUDE)."""
+
+
+class Exclude(Filter):
+    """Matches nothing (Filter.EXCLUDE)."""
+
+
+INCLUDE = Include()
+EXCLUDE = Exclude()
+
+
+class And(Filter):
+    def __init__(self, children: Sequence[Filter]):
+        self._children: List[Filter] = list(children)
+        if len(self._children) < 2:
+            raise ValueError("And requires >= 2 children")
+
+    def children(self) -> Sequence[Filter]:
+        return self._children
+
+
+class Or(Filter):
+    def __init__(self, children: Sequence[Filter]):
+        self._children: List[Filter] = list(children)
+        if len(self._children) < 2:
+            raise ValueError("Or requires >= 2 children")
+
+    def children(self) -> Sequence[Filter]:
+        return self._children
+
+
+class Not(Filter):
+    def __init__(self, child: Filter):
+        self.child = child
+
+    def children(self) -> Sequence[Filter]:
+        return (self.child,)
+
+
+# ---------------------------------------------------------------------------
+# spatial predicates (property vs geometry literal)
+# ---------------------------------------------------------------------------
+
+
+class SpatialFilter(Filter):
+    prop: str
+    geometry: Geometry
+
+
+class BBox(SpatialFilter):
+    def __init__(self, prop: str, xmin: float, ymin: float, xmax: float, ymax: float):
+        self.prop = prop
+        self.envelope = Envelope(xmin, ymin, xmax, ymax)
+        self.geometry = self.envelope.to_polygon()
+
+
+class Intersects(SpatialFilter):
+    def __init__(self, prop: str, geometry: Geometry):
+        self.prop = prop
+        self.geometry = geometry
+
+
+class Contains(SpatialFilter):
+    """CONTAINS(prop, g): the feature geometry contains g."""
+
+    def __init__(self, prop: str, geometry: Geometry):
+        self.prop = prop
+        self.geometry = geometry
+
+
+class Within(SpatialFilter):
+    """WITHIN(prop, g): the feature geometry is within g."""
+
+    def __init__(self, prop: str, geometry: Geometry):
+        self.prop = prop
+        self.geometry = geometry
+
+
+class Disjoint(SpatialFilter):
+    def __init__(self, prop: str, geometry: Geometry):
+        self.prop = prop
+        self.geometry = geometry
+
+
+class DWithin(SpatialFilter):
+    """DWITHIN(prop, g, distance, units): within distance of g.
+
+    Distance is stored in degrees (the reference converts meters to degrees
+    for geodetic CRS at planning time; we accept meters/kilometers/degrees).
+    """
+
+    _UNIT_DEGREES = {
+        "meters": 1.0 / 111320.0,
+        "kilometers": 1.0 / 111.32,
+        "feet": 0.3048 / 111320.0,
+        "statute miles": 1609.34 / 111320.0,
+        "nautical miles": 1852.0 / 111320.0,
+        "degrees": 1.0,
+    }
+
+    def __init__(self, prop: str, geometry: Geometry, distance: float, units: str = "meters"):
+        self.prop = prop
+        self.geometry = geometry
+        self.distance = float(distance)
+        self.units = units.lower()
+        if self.units not in self._UNIT_DEGREES:
+            raise ValueError(f"Unknown distance units: {units}")
+
+    @property
+    def degrees(self) -> float:
+        return self.distance * self._UNIT_DEGREES[self.units]
+
+
+# ---------------------------------------------------------------------------
+# temporal predicates
+# ---------------------------------------------------------------------------
+
+
+class During(Filter):
+    """prop DURING lo/hi -- bounds exclusive (FilterHelper.scala:366)."""
+
+    def __init__(self, prop: str, lo_ms: int, hi_ms: int):
+        self.prop = prop
+        self.lo_ms = int(lo_ms)
+        self.hi_ms = int(hi_ms)
+
+
+class Before(Filter):
+    """prop BEFORE t -- exclusive (FilterHelper.scala:427)."""
+
+    def __init__(self, prop: str, t_ms: int):
+        self.prop = prop
+        self.t_ms = int(t_ms)
+
+
+class After(Filter):
+    """prop AFTER t -- exclusive (FilterHelper.scala:440)."""
+
+    def __init__(self, prop: str, t_ms: int):
+        self.prop = prop
+        self.t_ms = int(t_ms)
+
+
+class TEquals(Filter):
+    def __init__(self, prop: str, t_ms: int):
+        self.prop = prop
+        self.t_ms = int(t_ms)
+
+
+# ---------------------------------------------------------------------------
+# comparisons
+# ---------------------------------------------------------------------------
+
+
+class Cmp(Filter):
+    """prop <op> literal, op in =, <>, <, <=, >, >=."""
+
+    OPS = ("=", "<>", "<", "<=", ">", ">=")
+
+    def __init__(self, prop: str, op: str, literal: Any):
+        if op not in self.OPS:
+            raise ValueError(f"Bad comparison op: {op}")
+        self.prop = prop
+        self.op = op
+        self.literal = literal
+
+
+class Between(Filter):
+    """prop BETWEEN lo AND hi (inclusive both ends)."""
+
+    def __init__(self, prop: str, lo: Any, hi: Any):
+        self.prop = prop
+        self.lo = lo
+        self.hi = hi
+
+
+class Like(Filter):
+    """prop LIKE pattern ('%' multi-char, '_' single-char wildcards)."""
+
+    def __init__(self, prop: str, pattern: str, case_insensitive: bool = False):
+        self.prop = prop
+        self.pattern = pattern
+        self.case_insensitive = case_insensitive
+
+
+class IsNull(Filter):
+    def __init__(self, prop: str, negate: bool = False):
+        self.prop = prop
+        self.negate = negate
+
+
+class InList(Filter):
+    """prop IN (v1, v2, ...)."""
+
+    def __init__(self, prop: str, values: Sequence[Any]):
+        self.prop = prop
+        self.values = list(values)
+
+
+class IdFilter(Filter):
+    """Feature-id filter: IN ('id1', 'id2') with no property."""
+
+    def __init__(self, ids: Sequence[str]):
+        self.ids = list(ids)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def and_option(filters: Sequence[Filter]) -> Filter:
+    """Combine with AND, dropping INCLUDEs (package.scala andOption)."""
+    fs = [f for f in filters if not isinstance(f, Include)]
+    if not fs:
+        return INCLUDE
+    if any(isinstance(f, Exclude) for f in fs):
+        return EXCLUDE
+    if len(fs) == 1:
+        return fs[0]
+    return And(fs)
+
+
+def or_option(filters: Sequence[Filter]) -> Filter:
+    fs = [f for f in filters if not isinstance(f, Exclude)]
+    if not fs:
+        return EXCLUDE
+    if any(isinstance(f, Include) for f in fs):
+        return INCLUDE
+    if len(fs) == 1:
+        return fs[0]
+    return Or(fs)
+
+
+def walk(f: Filter):
+    """Yield every node in the tree (pre-order)."""
+    yield f
+    for c in f.children():
+        yield from walk(c)
+
+
+def properties(f: Filter) -> List[str]:
+    """All property names referenced by the filter."""
+    out = []
+    for node in walk(f):
+        p = getattr(node, "prop", None)
+        if p is not None and p not in out:
+            out.append(p)
+    return out

@@ -1,0 +1,219 @@
+"""Bounds algebra: single-attribute interval sets extracted from filters.
+
+Rebuild of the reference's Bounds.scala:1-179 and FilterValues.scala:1-61:
+a ``Bound`` is an optional endpoint + inclusivity; ``Bounds`` is an interval;
+``FilterValues`` carries a list of extracted values plus ``precise`` (False
+when the extraction over-approximates the filter) and ``disjoint`` (True when
+the filter is provably empty, e.g. contradictory ANDs).
+"""
+
+from __future__ import annotations
+
+from typing import Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Bound(Generic[T]):
+    __slots__ = ("value", "inclusive")
+
+    def __init__(self, value: Optional[T], inclusive: bool):
+        self.value = value
+        self.inclusive = inclusive if value is not None else True
+
+    @classmethod
+    def unbounded(cls) -> "Bound[T]":
+        return cls(None, True)
+
+    @property
+    def exclusive(self) -> bool:
+        return not self.inclusive
+
+    def __repr__(self):
+        return f"Bound({self.value!r}, {'incl' if self.inclusive else 'excl'})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Bound)
+            and self.value == other.value
+            and self.inclusive == other.inclusive
+        )
+
+
+class Bounds(Generic[T]):
+    """An interval [lower, upper] with optional open endpoints."""
+
+    __slots__ = ("lower", "upper")
+
+    def __init__(self, lower: Bound[T], upper: Bound[T]):
+        self.lower = lower
+        self.upper = upper
+
+    @classmethod
+    def everything(cls) -> "Bounds[T]":
+        return cls(Bound.unbounded(), Bound.unbounded())
+
+    @property
+    def is_everything(self) -> bool:
+        return self.lower.value is None and self.upper.value is None
+
+    @property
+    def is_bounded_both(self) -> bool:
+        return self.lower.value is not None and self.upper.value is not None
+
+    def covers_value(self, v: T) -> bool:
+        lo, hi = self.lower, self.upper
+        if lo.value is not None:
+            if v < lo.value or (v == lo.value and not lo.inclusive):
+                return False
+        if hi.value is not None:
+            if v > hi.value or (v == hi.value and not hi.inclusive):
+                return False
+        return True
+
+    def intersection(self, other: "Bounds[T]") -> Optional["Bounds[T]"]:
+        """None when the intervals don't overlap (Bounds.scala intersection)."""
+        lo = _max_bound(self.lower, other.lower)
+        hi = _min_bound(self.upper, other.upper)
+        if lo.value is not None and hi.value is not None:
+            if lo.value > hi.value:
+                return None
+            if lo.value == hi.value and not (lo.inclusive and hi.inclusive):
+                return None
+        return Bounds(lo, hi)
+
+    def overlaps(self, other: "Bounds[T]") -> bool:
+        return self.intersection(other) is not None
+
+    def __repr__(self):
+        lo = "(-inf" if self.lower.value is None else (
+            ("[" if self.lower.inclusive else "(") + repr(self.lower.value)
+        )
+        hi = "inf)" if self.upper.value is None else (
+            repr(self.upper.value) + ("]" if self.upper.inclusive else ")")
+        )
+        return f"{lo},{hi}"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Bounds)
+            and self.lower == other.lower
+            and self.upper == other.upper
+        )
+
+
+def _max_bound(a: Bound, b: Bound) -> Bound:
+    if a.value is None:
+        return b
+    if b.value is None:
+        return a
+    if a.value > b.value:
+        return a
+    if b.value > a.value:
+        return b
+    return a if not a.inclusive else b
+
+
+def _min_bound(a: Bound, b: Bound) -> Bound:
+    if a.value is None:
+        return b
+    if b.value is None:
+        return a
+    if a.value < b.value:
+        return a
+    if b.value < a.value:
+        return b
+    return a if not a.inclusive else b
+
+
+def union_bounds(existing: List[Bounds], b: Bounds) -> List[Bounds]:
+    """Add ``b`` to a disjoint, sorted interval list, merging overlaps
+    (Bounds.scala union semantics)."""
+    out: List[Bounds] = []
+    cur = b
+    for e in existing:
+        if _mergeable(cur, e):
+            cur = Bounds(
+                _lo_min(cur.lower, e.lower),
+                _hi_max(cur.upper, e.upper),
+            )
+        else:
+            out.append(e)
+    out.append(cur)
+    out.sort(key=_sort_key)
+    return out
+
+
+def _mergeable(a: Bounds, b: Bounds) -> bool:
+    inter = a.intersection(b)
+    if inter is not None:
+        return True
+    # adjacent closed/open endpoints like [1,2) + [2,3] merge too
+    for x, y in ((a, b), (b, a)):
+        if (
+            x.upper.value is not None
+            and y.lower.value is not None
+            and x.upper.value == y.lower.value
+            and (x.upper.inclusive or y.lower.inclusive)
+        ):
+            return True
+    return False
+
+
+def _lo_min(a: Bound, b: Bound) -> Bound:
+    if a.value is None or b.value is None:
+        return Bound.unbounded()
+    if a.value < b.value:
+        return a
+    if b.value < a.value:
+        return b
+    return a if a.inclusive else b
+
+
+def _hi_max(a: Bound, b: Bound) -> Bound:
+    if a.value is None or b.value is None:
+        return Bound.unbounded()
+    if a.value > b.value:
+        return a
+    if b.value > a.value:
+        return b
+    return a if a.inclusive else b
+
+
+def _sort_key(b: Bounds):
+    lo = b.lower.value
+    return (lo is not None, lo)
+
+
+class FilterValues(Generic[T]):
+    """Extracted values + precision/disjointness flags (FilterValues.scala)."""
+
+    __slots__ = ("values", "precise", "disjoint")
+
+    def __init__(self, values: List[T], precise: bool = True, disjoint: bool = False):
+        self.values = list(values)
+        self.precise = precise
+        self.disjoint = disjoint
+
+    @classmethod
+    def empty(cls) -> "FilterValues[T]":
+        return cls([], precise=True, disjoint=False)
+
+    @classmethod
+    def disjoint_values(cls) -> "FilterValues[T]":
+        return cls([], precise=True, disjoint=True)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.values
+
+    def __bool__(self):
+        return bool(self.values) and not self.disjoint
+
+    def __repr__(self):
+        flags = []
+        if not self.precise:
+            flags.append("imprecise")
+        if self.disjoint:
+            flags.append("disjoint")
+        return f"FilterValues({self.values!r}{', ' + ' '.join(flags) if flags else ''})"

@@ -1,0 +1,272 @@
+"""Vectorized filter evaluation over columnar feature data.
+
+The host-side exact evaluator: the analog of evaluating a CQL filter
+per-feature in the reference's iterators, but over whole columns at once.
+Device (JAX) compilation of the common predicate shapes lives in
+``geomesa_tpu.ops``; this evaluator is the semantics oracle and the fallback
+for rare predicates (SURVEY.md section 7 "CQL expressiveness creep").
+
+Column conventions (shared with geomesa_tpu.store.blocks):
+  * point geometry attribute ``g``  -> columns ``g__x``, ``g__y`` (float64)
+  * non-point geometry attribute    -> object column of Geometry values
+  * Date attributes                 -> int64 epoch millis
+  * strings                         -> object columns
+  * feature ids                     -> ``__fid__`` object column
+  * nulls                           -> NaN (floats/dates use sentinel mask
+                                       column ``name__null`` when present)
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Dict
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geom.base import Envelope, Geometry
+from geomesa_tpu.geom.predicates import (
+    geometries_intersect,
+    geometry_distance,
+    geometry_within,
+    points_in_envelope,
+    points_in_geometry,
+    points_in_polygon,
+)
+from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
+
+Columns = Dict[str, np.ndarray]
+
+
+def _n(columns: Columns) -> int:
+    for v in columns.values():
+        return len(v)
+    return 0
+
+
+def evaluate(f: ast.Filter, ft: FeatureType, columns: Columns) -> np.ndarray:
+    """Return a boolean mask of matching rows."""
+    n = _n(columns)
+    if isinstance(f, ast.Include):
+        return np.ones(n, dtype=bool)
+    if isinstance(f, ast.Exclude):
+        return np.zeros(n, dtype=bool)
+    if isinstance(f, ast.And):
+        out = np.ones(n, dtype=bool)
+        for c in f.children():
+            out &= evaluate(c, ft, columns)
+        return out
+    if isinstance(f, ast.Or):
+        out = np.zeros(n, dtype=bool)
+        for c in f.children():
+            out |= evaluate(c, ft, columns)
+        return out
+    if isinstance(f, ast.Not):
+        return ~evaluate(f.child, ft, columns)
+    if isinstance(f, ast.SpatialFilter):
+        return _eval_spatial(f, ft, columns)
+    if isinstance(f, (ast.During, ast.Before, ast.After, ast.TEquals)):
+        return _eval_temporal(f, ft, columns)
+    if isinstance(f, ast.Cmp):
+        return _eval_cmp(f, ft, columns)
+    if isinstance(f, ast.Between):
+        lo = _coerce(ft, f.prop, f.lo)
+        hi = _coerce(ft, f.prop, f.hi)
+        col, valid = _column(ft, f.prop, columns)
+        return _masked_cmp(col, valid, lambda v: (v >= lo) & (v <= hi))
+    if isinstance(f, ast.Like):
+        return _eval_like(f, ft, columns)
+    if isinstance(f, ast.IsNull):
+        _, valid = _column(ft, f.prop, columns)
+        return valid if f.negate else ~valid
+    if isinstance(f, ast.InList):
+        col, valid = _column(ft, f.prop, columns)
+        out = np.zeros(_n(columns), dtype=bool)
+        for v in f.values:
+            out |= col == _coerce(ft, f.prop, v)
+        return out & valid
+    if isinstance(f, ast.IdFilter):
+        fids = columns["__fid__"]
+        out = np.zeros(_n(columns), dtype=bool)
+        for fid in f.ids:
+            out |= fids == fid
+        return out
+    raise ValueError(f"Cannot evaluate filter {type(f)}")
+
+
+def _column(ft: FeatureType, prop: str, columns: Columns):
+    """(values, valid_mask) for an attribute column."""
+    attr = ft.attr(prop)
+    col = columns[prop]
+    if attr.type in (AttributeType.FLOAT, AttributeType.DOUBLE):
+        return col, ~np.isnan(col)
+    null_col = columns.get(prop + "__null")
+    valid = ~null_col if null_col is not None else _object_valid(col)
+    return col, valid
+
+
+def _object_valid(col: np.ndarray) -> np.ndarray:
+    if col.dtype == object:
+        return np.array([v is not None for v in col], dtype=bool)
+    return np.ones(len(col), dtype=bool)
+
+
+def _coerce(ft: FeatureType, prop: str, v):
+    attr = ft.attr(prop)
+    if attr.type == AttributeType.DATE and isinstance(v, str):
+        from geomesa_tpu.filter.parser import parse_instant_ms
+
+        return parse_instant_ms(v)
+    if attr.type in (AttributeType.INT, AttributeType.LONG) and isinstance(v, str):
+        return int(v)
+    if attr.type in (AttributeType.FLOAT, AttributeType.DOUBLE) and isinstance(v, str):
+        return float(v)
+    if attr.type == AttributeType.STRING and not isinstance(v, str):
+        return str(v)
+    return v
+
+
+def _eval_spatial(f: ast.SpatialFilter, ft: FeatureType, columns: Columns) -> np.ndarray:
+    attr = ft.attr(f.prop)
+    n = _n(columns)
+    if attr.type == AttributeType.POINT:
+        x = columns[f.prop + "__x"]
+        y = columns[f.prop + "__y"]
+        valid = ~np.isnan(x)
+        if isinstance(f, ast.BBox):
+            mask = points_in_envelope(x, y, f.envelope)
+        elif isinstance(f, ast.Intersects):
+            mask = points_in_geometry(x, y, f.geometry)
+        elif isinstance(f, ast.Within):
+            # JTS within excludes points on the query geometry's boundary
+            from geomesa_tpu.geom.base import Polygon as _Poly
+
+            if isinstance(f.geometry, _Poly):
+                mask = points_in_polygon(x, y, f.geometry, boundary=False)
+            else:
+                mask = points_in_geometry(x, y, f.geometry)
+        elif isinstance(f, ast.Contains):
+            # a point can only contain a point
+            from geomesa_tpu.geom.base import Point
+
+            if isinstance(f.geometry, Point):
+                mask = (x == f.geometry.x) & (y == f.geometry.y)
+            else:
+                mask = np.zeros(n, dtype=bool)
+        elif isinstance(f, ast.Disjoint):
+            mask = ~points_in_geometry(x, y, f.geometry)
+        elif isinstance(f, ast.DWithin):
+            mask = _points_dwithin(x, y, f)
+        else:
+            raise ValueError(type(f))
+        return mask & valid
+    # non-point geometry columns: object arrays, evaluated per row
+    col = columns[f.prop]
+    out = np.zeros(n, dtype=bool)
+    for i, g in enumerate(col):
+        if g is None:
+            continue
+        out[i] = _geom_predicate(f, g)
+    return out
+
+
+def _points_dwithin(x: np.ndarray, y: np.ndarray, f: ast.DWithin) -> np.ndarray:
+    d = f.degrees
+    g = f.geometry
+    from geomesa_tpu.geom.base import Point, LineString
+
+    if isinstance(g, Point):
+        return (x - g.x) ** 2 + (y - g.y) ** 2 <= d * d
+    if isinstance(g, LineString):
+        out = np.zeros(x.shape, dtype=bool)
+        c = g.coords
+        for i in range(len(c) - 1):
+            out |= _dist2_to_segment(x, y, c[i], c[i + 1]) <= d * d
+        return out
+    # fall back to expanded-envelope test
+    env = g.envelope
+    return points_in_envelope(
+        x, y, Envelope(env.xmin - d, env.ymin - d, env.xmax + d, env.ymax + d)
+    )
+
+
+def _dist2_to_segment(x, y, a, b):
+    abx, aby = b[0] - a[0], b[1] - a[1]
+    apx, apy = x - a[0], y - a[1]
+    denom = abx * abx + aby * aby
+    t = np.clip((apx * abx + apy * aby) / (denom if denom else 1.0), 0.0, 1.0)
+    dx = apx - t * abx
+    dy = apy - t * aby
+    return dx * dx + dy * dy
+
+
+def _geom_predicate(f: ast.SpatialFilter, g: Geometry) -> bool:
+    """Row-wise exact predicate for non-point feature geometries."""
+    q = f.geometry
+    if isinstance(f, ast.BBox):
+        return geometries_intersect(g, q)
+    if isinstance(f, ast.Intersects):
+        return geometries_intersect(g, q)
+    if isinstance(f, ast.DWithin):
+        return geometry_distance(g, q) <= f.degrees
+    if isinstance(f, ast.Within):
+        return geometry_within(g, q)
+    if isinstance(f, ast.Contains):
+        return geometry_within(q, g)
+    if isinstance(f, ast.Disjoint):
+        return not geometries_intersect(g, q)
+    raise ValueError(type(f))
+
+
+def _eval_temporal(f, ft: FeatureType, columns: Columns) -> np.ndarray:
+    col, valid = _column(ft, f.prop, columns)
+    if isinstance(f, ast.During):
+        return valid & (col > f.lo_ms) & (col < f.hi_ms)
+    if isinstance(f, ast.Before):
+        return valid & (col < f.t_ms)
+    if isinstance(f, ast.After):
+        return valid & (col > f.t_ms)
+    if isinstance(f, ast.TEquals):
+        return valid & (col == f.t_ms)
+    raise ValueError(type(f))
+
+
+def _masked_cmp(col: np.ndarray, valid: np.ndarray, fn) -> np.ndarray:
+    """Apply a comparison only to valid rows -- object columns holding None
+    would otherwise raise TypeError on ordered comparisons."""
+    out = np.zeros(len(col), dtype=bool)
+    idx = np.where(valid)[0]
+    if len(idx) == 0:
+        return out
+    sub = col[idx]
+    if col.dtype == object:
+        out[idx] = np.array([bool(fn(v)) for v in sub], dtype=bool)
+    else:
+        out[idx] = fn(sub)
+    return out
+
+
+def _eval_cmp(f: ast.Cmp, ft: FeatureType, columns: Columns) -> np.ndarray:
+    col, valid = _column(ft, f.prop, columns)
+    lit = _coerce(ft, f.prop, f.literal)
+    ops = {
+        "=": lambda v: v == lit,
+        "<>": lambda v: v != lit,
+        "<": lambda v: v < lit,
+        "<=": lambda v: v <= lit,
+        ">": lambda v: v > lit,
+        ">=": lambda v: v >= lit,
+    }
+    return _masked_cmp(col, valid, ops[f.op])
+
+
+def _eval_like(f: ast.Like, ft: FeatureType, columns: Columns) -> np.ndarray:
+    col, valid = _column(ft, f.prop, columns)
+    pattern = re.escape(f.pattern).replace("%", ".*").replace("_", ".")
+    flags = re.IGNORECASE if f.case_insensitive else 0
+    rx = re.compile("^" + pattern + "$", flags)
+    out = np.array(
+        [bool(rx.match(v)) if isinstance(v, str) else False for v in col], dtype=bool
+    )
+    return out & valid

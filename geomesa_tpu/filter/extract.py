@@ -1,0 +1,285 @@
+"""Filter decomposition: extract geometries and time intervals per attribute.
+
+Rebuild of the reference's FilterHelper.extractGeometries/extractIntervals
+(geomesa-filter .../FilterHelper.scala:36-617): walk the filter tree,
+intersecting bounds across ANDs and unioning across ORs, clamping spatial
+results to the world envelope, and flagging results imprecise when a node
+can't be represented exactly (e.g. NOT, or mixed-attribute ORs).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.bounds import Bound, Bounds, FilterValues, union_bounds
+from geomesa_tpu.geom.base import Envelope, Geometry, Polygon, WHOLE_WORLD
+
+
+# ---------------------------------------------------------------------------
+# geometry extraction
+# ---------------------------------------------------------------------------
+
+
+def extract_geometries(
+    f: ast.Filter, prop: str, intersect: bool = True
+) -> FilterValues[Geometry]:
+    """Extract the spatial constraint on ``prop`` as a list of geometries
+    (unioned). With ``intersect=False``, AND branches are unioned instead of
+    intersected (the reference uses this for cost estimation). Imprecise when
+    a DWITHIN/odd node is approximated by its envelope.
+    Mirrors FilterHelper.extractGeometries.
+    """
+    return _extract_geoms(f, prop, intersect)
+
+
+def _extract_geoms(f: ast.Filter, prop: str, intersect: bool = True) -> FilterValues[Geometry]:
+    if isinstance(f, ast.And):
+        # intersect envelopes across children that constrain the property
+        current: Optional[FilterValues[Geometry]] = None
+        for c in f.children():
+            child = _extract_geoms(c, prop, intersect)
+            if child.disjoint:
+                return FilterValues.disjoint_values()
+            if child.is_empty:
+                continue
+            if current is None:
+                current = child
+            elif intersect:
+                current = _intersect_geom_values(current, child)
+                if current.disjoint:
+                    return current
+            else:
+                current = FilterValues(
+                    current.values + child.values,
+                    precise=current.precise and child.precise,
+                )
+        return current if current is not None else FilterValues.empty()
+    if isinstance(f, ast.Or):
+        out: List[Geometry] = []
+        precise = True
+        n_disjoint = 0
+        for c in f.children():
+            child = _extract_geoms(c, prop, intersect)
+            if child.disjoint:
+                n_disjoint += 1
+                continue
+            if child.is_empty:
+                # one branch doesn't constrain the prop -> whole filter doesn't
+                return FilterValues.empty()
+            precise &= child.precise
+            out.extend(child.values)
+        if n_disjoint and not out:
+            # every branch is provably empty -> the whole OR is
+            return FilterValues.disjoint_values()
+        return FilterValues(out, precise=precise)
+    if isinstance(f, ast.Not):
+        # negations aren't representable as a positive cover -> no constraint
+        return FilterValues.empty()
+    if isinstance(f, ast.SpatialFilter) and f.prop == prop:
+        if isinstance(f, ast.Disjoint):
+            return FilterValues.empty()
+        if isinstance(f, ast.DWithin):
+            env = f.geometry.envelope
+            d = f.degrees
+            g = _clip_to_world(
+                Envelope(env.xmin - d, env.ymin - d, env.xmax + d, env.ymax + d)
+            )
+            return FilterValues([g], precise=False)
+        geom = f.geometry
+        env = geom.envelope
+        clipped = WHOLE_WORLD.intersection(env)
+        if clipped is None:
+            return FilterValues.disjoint_values()
+        if isinstance(geom, Polygon) and geom.is_rectangle():
+            return FilterValues([_clip_to_world(env)])
+        return FilterValues([geom])
+    return FilterValues.empty()
+
+
+def _clip_to_world(env: Envelope) -> Polygon:
+    inter = WHOLE_WORLD.intersection(env)
+    return (inter if inter is not None else env).to_polygon()
+
+
+def _intersect_geom_values(
+    a: FilterValues[Geometry], b: FilterValues[Geometry]
+) -> FilterValues[Geometry]:
+    """Approximate intersection: pairwise envelope intersection, keeping the
+    non-rectangular geometry when one side is a bbox (the common
+    bbox AND intersects(poly) case). Imprecise when both are non-rectangular."""
+    out: List[Geometry] = []
+    precise = a.precise and b.precise
+    for ga in a.values:
+        for gb in b.values:
+            ea, eb = ga.envelope, gb.envelope
+            inter = ea.intersection(eb)
+            if inter is None:
+                continue
+            a_rect = isinstance(ga, Polygon) and ga.is_rectangle()
+            b_rect = isinstance(gb, Polygon) and gb.is_rectangle()
+            if a_rect and b_rect:
+                out.append(inter.to_polygon())
+            elif a_rect:
+                # keep the narrower geometry; when the bbox doesn't fully
+                # contain it the result over-approximates -> imprecise, so
+                # planners must keep the full post-filter
+                out.append(gb)
+                if not ea.contains_env(eb):
+                    precise = False
+            elif b_rect:
+                out.append(ga)
+                if not eb.contains_env(ea):
+                    precise = False
+            else:
+                # two arbitrary geometries: keep first, flag imprecise
+                out.append(ga)
+                precise = False
+    if not out:
+        return FilterValues.disjoint_values()
+    return FilterValues(out, precise=precise)
+
+
+# ---------------------------------------------------------------------------
+# interval extraction
+# ---------------------------------------------------------------------------
+
+
+def extract_intervals(
+    f: ast.Filter,
+    prop: str,
+    handle_exclusive_bounds: bool = False,
+) -> FilterValues[Bounds[int]]:
+    """Extract temporal bounds (epoch ms) on ``prop``.
+
+    With ``handle_exclusive_bounds`` (used by Z3 key planning,
+    FilterHelper.scala:267-287), exclusive endpoints are rounded inward to
+    whole seconds -- unless the interval is so narrow that rounding would
+    invert it.
+    """
+    fv = _extract_bounds(f, prop)
+    if not handle_exclusive_bounds or fv.disjoint:
+        return fv
+    out: List[Bounds[int]] = []
+    for b in fv.values:
+        out.append(_round_exclusive(b))
+    return FilterValues(out, precise=fv.precise, disjoint=fv.disjoint)
+
+
+def _round_exclusive(b: Bounds[int]) -> Bounds[int]:
+    lo, hi = b.lower, b.upper
+    if lo.value is None or hi.value is None or (lo.inclusive and hi.inclusive):
+        return Bounds(
+            _round_up(lo) if lo.value is not None and not lo.inclusive else lo,
+            _round_down(hi) if hi.value is not None and not hi.inclusive else hi,
+        )
+    margin = 1000 if (lo.inclusive or hi.inclusive) else 2000
+    if hi.value - lo.value > margin:
+        return Bounds(
+            _round_up(lo) if not lo.inclusive else lo,
+            _round_down(hi) if not hi.inclusive else hi,
+        )
+    return b
+
+
+def _round_up(bound: Bound[int]) -> Bound[int]:
+    v = bound.value
+    return Bound((v // 1000) * 1000 + 1000, True)
+
+
+def _round_down(bound: Bound[int]) -> Bound[int]:
+    v = bound.value
+    rounded = (v // 1000) * 1000
+    if rounded == v:
+        rounded -= 1000
+    return Bound(rounded, True)
+
+
+def _extract_bounds(f: ast.Filter, prop: str) -> FilterValues[Bounds[int]]:
+    if isinstance(f, ast.And):
+        current: Optional[List[Bounds[int]]] = None
+        precise = True
+        for c in f.children():
+            child = _extract_bounds(c, prop)
+            if child.disjoint:
+                return FilterValues.disjoint_values()
+            if child.is_empty:
+                continue
+            precise &= child.precise
+            if current is None:
+                current = child.values
+            else:
+                nxt: List[Bounds[int]] = []
+                for a in current:
+                    for b in child.values:
+                        inter = a.intersection(b)
+                        if inter is not None:
+                            nxt.append(inter)
+                if not nxt:
+                    return FilterValues.disjoint_values()
+                current = nxt
+        return FilterValues(current or [], precise=precise)
+    if isinstance(f, ast.Or):
+        merged: List[Bounds[int]] = []
+        precise = True
+        n_disjoint = 0
+        for c in f.children():
+            child = _extract_bounds(c, prop)
+            if child.disjoint:
+                n_disjoint += 1
+                continue
+            if child.is_empty:
+                return FilterValues.empty()
+            precise &= child.precise
+            for b in child.values:
+                merged = union_bounds(merged, b)
+        if n_disjoint and not merged:
+            return FilterValues.disjoint_values()
+        return FilterValues(merged, precise=precise)
+    if isinstance(f, ast.Not):
+        return FilterValues.empty()
+    if isinstance(f, ast.During) and f.prop == prop:
+        # during is exclusive on both ends (FilterHelper.scala:366)
+        return FilterValues([Bounds(Bound(f.lo_ms, False), Bound(f.hi_ms, False))])
+    if isinstance(f, ast.Before) and f.prop == prop:
+        return FilterValues([Bounds(Bound.unbounded(), Bound(f.t_ms, False))])
+    if isinstance(f, ast.After) and f.prop == prop:
+        return FilterValues([Bounds(Bound(f.t_ms, False), Bound.unbounded())])
+    if isinstance(f, ast.TEquals) and f.prop == prop:
+        return FilterValues([Bounds(Bound(f.t_ms, True), Bound(f.t_ms, True))])
+    if isinstance(f, ast.Cmp) and f.prop == prop:
+        v = _as_ms(f.literal)
+        if v is None:
+            return FilterValues.empty()
+        if f.op == "=":
+            return FilterValues([Bounds(Bound(v, True), Bound(v, True))])
+        if f.op == "<":
+            return FilterValues([Bounds(Bound.unbounded(), Bound(v, False))])
+        if f.op == "<=":
+            return FilterValues([Bounds(Bound.unbounded(), Bound(v, True))])
+        if f.op == ">":
+            return FilterValues([Bounds(Bound(v, False), Bound.unbounded())])
+        if f.op == ">=":
+            return FilterValues([Bounds(Bound(v, True), Bound.unbounded())])
+        return FilterValues.empty()
+    if isinstance(f, ast.Between) and f.prop == prop:
+        lo, hi = _as_ms(f.lo), _as_ms(f.hi)
+        if lo is None or hi is None:
+            return FilterValues.empty()
+        return FilterValues([Bounds(Bound(lo, True), Bound(hi, True))])
+    return FilterValues.empty()
+
+
+def _as_ms(v) -> Optional[int]:
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, str):
+        try:
+            from geomesa_tpu.filter.parser import parse_instant_ms
+
+            return parse_instant_ms(v)
+        except ValueError:
+            return None
+    return None

@@ -1,0 +1,442 @@
+"""(E)CQL text parser for the supported filter subset, plus the inverse
+``to_cql`` writer (used by explain traces and the CLI).
+
+Grammar (recursive descent):
+
+  filter     := or
+  or         := and (OR and)*
+  and        := unary (AND unary)*
+  unary      := NOT unary | '(' filter ')' | predicate
+  predicate  := INCLUDE | EXCLUDE
+              | BBOX '(' prop ',' n ',' n ',' n ',' n [',' srs] ')'
+              | INTERSECTS|CONTAINS|WITHIN|DISJOINT '(' prop ',' wkt ')'
+              | DWITHIN '(' prop ',' wkt ',' n ',' unit ')'
+              | prop DURING instant '/' instant
+              | prop (BEFORE|AFTER|TEQUALS) instant
+              | prop BETWEEN literal AND literal
+              | prop [NOT] IN '(' literal (',' literal)* ')'
+              | prop [I]LIKE string
+              | prop IS [NOT] NULL
+              | prop op literal              (op: = <> != < <= > >=)
+              | IN '(' string (',' string)* ')'        -- feature id filter
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from typing import Any, List, Optional
+
+from geomesa_tpu.filter.ast import (
+    After,
+    And,
+    BBox,
+    Before,
+    Between,
+    Cmp,
+    Contains,
+    Disjoint,
+    During,
+    DWithin,
+    EXCLUDE,
+    Exclude,
+    Filter,
+    IdFilter,
+    INCLUDE,
+    Include,
+    InList,
+    Intersects,
+    IsNull,
+    Like,
+    Not,
+    Or,
+    TEquals,
+    Within,
+)
+from geomesa_tpu.geom.wkt import parse_wkt, to_wkt
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<instant>\d{4}-\d{2}-\d{2}T[\d:.]+(?:Z|[+-]\d{2}:?\d{2})?)
+  | (?P<number>[-+]?\d*\.?\d+(?:[eE][-+]?\d+)?)
+  | (?P<op><=|>=|<>|!=|=|<|>)
+  | (?P<punct>[(),/])
+  | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
+    """,
+    re.VERBOSE,
+)
+
+_KEYWORDS = {
+    "AND", "OR", "NOT", "INCLUDE", "EXCLUDE", "BBOX", "INTERSECTS", "CONTAINS",
+    "WITHIN", "DISJOINT", "DWITHIN", "DURING", "BEFORE", "AFTER", "TEQUALS",
+    "BETWEEN", "IN", "LIKE", "ILIKE", "IS", "NULL",
+}
+
+_GEOM_WORDS = {
+    "POINT", "LINESTRING", "POLYGON", "MULTIPOINT", "MULTILINESTRING",
+    "MULTIPOLYGON", "GEOMETRYCOLLECTION",
+}
+
+
+def parse_instant_ms(s: str) -> int:
+    s = s.strip().strip("'")
+    s = s.replace("Z", "+00:00")
+    dt = datetime.datetime.fromisoformat(s)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+class _Tok:
+    def __init__(self, kind: str, value: str, pos: int):
+        self.kind = kind
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value!r}"
+
+
+def _tokenize(text: str) -> List[_Tok]:
+    out: List[_Tok] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m:
+            raise ValueError(f"CQL tokenize error at {pos}: {text[pos:pos+20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        out.append(_Tok(kind, m.group(0), m.start()))
+    return out
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.toks = _tokenize(text)
+        self.i = 0
+
+    def peek(self, offset: int = 0) -> Optional[_Tok]:
+        j = self.i + offset
+        return self.toks[j] if j < len(self.toks) else None
+
+    def next(self) -> _Tok:
+        t = self.peek()
+        if t is None:
+            raise ValueError(f"Unexpected end of CQL: {self.text!r}")
+        self.i += 1
+        return t
+
+    def expect_punct(self, ch: str):
+        t = self.next()
+        if t.kind != "punct" or t.value != ch:
+            raise ValueError(f"Expected {ch!r} at {t.pos} in {self.text!r}")
+
+    def is_word(self, *words: str, offset: int = 0) -> bool:
+        t = self.peek(offset)
+        return t is not None and t.kind == "word" and t.value.upper() in words
+
+    # -- grammar ------------------------------------------------------------
+
+    def parse(self) -> Filter:
+        f = self.or_expr()
+        if self.peek() is not None:
+            t = self.peek()
+            raise ValueError(f"Trailing CQL at {t.pos}: {self.text[t.pos:]!r}")
+        return f
+
+    def or_expr(self) -> Filter:
+        parts = [self.and_expr()]
+        while self.is_word("OR"):
+            self.next()
+            parts.append(self.and_expr())
+        return parts[0] if len(parts) == 1 else Or(parts)
+
+    def and_expr(self) -> Filter:
+        parts = [self.unary()]
+        while self.is_word("AND"):
+            self.next()
+            parts.append(self.unary())
+        return parts[0] if len(parts) == 1 else And(parts)
+
+    def unary(self) -> Filter:
+        if self.is_word("NOT"):
+            self.next()
+            return Not(self.unary())
+        t = self.peek()
+        if t is not None and t.kind == "punct" and t.value == "(":
+            self.next()
+            f = self.or_expr()
+            self.expect_punct(")")
+            return f
+        return self.predicate()
+
+    def _wkt(self) -> Any:
+        """Consume a WKT literal: TYPE ( ... ) with balanced parens."""
+        t = self.next()
+        if t.kind != "word" or t.value.upper() not in _GEOM_WORDS:
+            raise ValueError(f"Expected WKT geometry at {t.pos}")
+        start = t.pos
+        depth = 0
+        end = None
+        while True:
+            tok = self.next()
+            if tok.kind == "punct" and tok.value == "(":
+                depth += 1
+            elif tok.kind == "punct" and tok.value == ")":
+                depth -= 1
+                if depth == 0:
+                    end = tok.pos + 1
+                    break
+        return parse_wkt(self.text[start:end])
+
+    def _number(self) -> float:
+        t = self.next()
+        if t.kind != "number":
+            raise ValueError(f"Expected number at {t.pos}")
+        return float(t.value)
+
+    def _literal(self) -> Any:
+        t = self.next()
+        if t.kind == "number":
+            v = float(t.value)
+            return int(v) if v == int(v) and "." not in t.value and "e" not in t.value.lower() else v
+        if t.kind == "string":
+            return t.value[1:-1].replace("''", "'")
+        if t.kind == "instant":
+            return parse_instant_ms(t.value)
+        if t.kind == "word" and t.value.upper() in ("TRUE", "FALSE"):
+            return t.value.upper() == "TRUE"
+        raise ValueError(f"Expected literal at {t.pos} in {self.text!r}")
+
+    def _instant(self) -> int:
+        t = self.next()
+        if t.kind == "instant":
+            return parse_instant_ms(t.value)
+        if t.kind == "string":
+            return parse_instant_ms(t.value[1:-1])
+        raise ValueError(f"Expected instant at {t.pos}")
+
+    def predicate(self) -> Filter:
+        t = self.peek()
+        if t is None:
+            raise ValueError("Unexpected end of CQL")
+        u = t.value.upper() if t.kind == "word" else None
+
+        if u == "INCLUDE":
+            self.next()
+            return INCLUDE
+        if u == "EXCLUDE":
+            self.next()
+            return EXCLUDE
+
+        if u == "BBOX":
+            self.next()
+            self.expect_punct("(")
+            prop = self.next().value
+            self.expect_punct(",")
+            vals = []
+            for k in range(4):
+                vals.append(self._number())
+                if k < 3:
+                    self.expect_punct(",")
+            # optional srs name
+            if self.peek() and self.peek().kind == "punct" and self.peek().value == ",":
+                self.next()
+                self.next()  # srs token, ignored (4326 assumed)
+            self.expect_punct(")")
+            return BBox(prop, *vals)
+
+        if u in ("INTERSECTS", "CONTAINS", "WITHIN", "DISJOINT"):
+            self.next()
+            self.expect_punct("(")
+            prop = self.next().value
+            self.expect_punct(",")
+            geom = self._wkt()
+            self.expect_punct(")")
+            cls = {
+                "INTERSECTS": Intersects,
+                "CONTAINS": Contains,
+                "WITHIN": Within,
+                "DISJOINT": Disjoint,
+            }[u]
+            return cls(prop, geom)
+
+        if u == "DWITHIN":
+            self.next()
+            self.expect_punct("(")
+            prop = self.next().value
+            self.expect_punct(",")
+            geom = self._wkt()
+            self.expect_punct(",")
+            dist = self._number()
+            self.expect_punct(",")
+            unit_words = [self.next().value]
+            while self.peek() and self.peek().kind == "word":
+                unit_words.append(self.next().value)
+            self.expect_punct(")")
+            return DWithin(prop, geom, dist, " ".join(unit_words))
+
+        # bare feature-id filter: IN ('a', 'b')
+        if u == "IN":
+            self.next()
+            self.expect_punct("(")
+            ids = [str(self._literal())]
+            while self.peek() and self.peek().kind == "punct" and self.peek().value == ",":
+                self.next()
+                ids.append(str(self._literal()))
+            self.expect_punct(")")
+            return IdFilter(ids)
+
+        # property-led predicates
+        prop = self.next().value
+        t = self.peek()
+        if t is None:
+            raise ValueError(f"Dangling property {prop!r}")
+        u = t.value.upper() if t.kind == "word" else None
+
+        if u == "DURING":
+            self.next()
+            lo = self._instant()
+            self.expect_punct("/")
+            hi = self._instant()
+            return During(prop, lo, hi)
+        if u == "BEFORE":
+            self.next()
+            return Before(prop, self._instant())
+        if u == "AFTER":
+            self.next()
+            return After(prop, self._instant())
+        if u == "TEQUALS":
+            self.next()
+            return TEquals(prop, self._instant())
+        if u == "BETWEEN":
+            self.next()
+            lo = self._literal()
+            if not self.is_word("AND"):
+                raise ValueError("BETWEEN requires AND")
+            self.next()
+            hi = self._literal()
+            return Between(prop, lo, hi)
+        if u in ("LIKE", "ILIKE"):
+            self.next()
+            pat = self._literal()
+            return Like(prop, str(pat), case_insensitive=(u == "ILIKE"))
+        if u == "NOT" and self.is_word("IN", offset=1):
+            self.next()
+            self.next()
+            self.expect_punct("(")
+            vals = [self._literal()]
+            while self.peek() and self.peek().kind == "punct" and self.peek().value == ",":
+                self.next()
+                vals.append(self._literal())
+            self.expect_punct(")")
+            return Not(InList(prop, vals))
+        if u == "IN":
+            self.next()
+            self.expect_punct("(")
+            vals = [self._literal()]
+            while self.peek() and self.peek().kind == "punct" and self.peek().value == ",":
+                self.next()
+                vals.append(self._literal())
+            self.expect_punct(")")
+            return InList(prop, vals)
+        if u == "IS":
+            self.next()
+            negate = False
+            if self.is_word("NOT"):
+                self.next()
+                negate = True
+            if not self.is_word("NULL"):
+                raise ValueError("IS requires NULL")
+            self.next()
+            return IsNull(prop, negate)
+
+        if t.kind == "op":
+            op = self.next().value
+            if op == "!=":
+                op = "<>"
+            lit = self._literal()
+            return Cmp(prop, op, lit)
+
+        raise ValueError(f"Cannot parse predicate at {t.pos} in {self.text!r}")
+
+
+def parse_cql(text: str) -> Filter:
+    text = text.strip()
+    if not text:
+        return INCLUDE
+    return _Parser(text).parse()
+
+
+def _fmt_instant(ms: int) -> str:
+    dt = datetime.datetime.fromtimestamp(ms / 1000.0, tz=datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def _fmt_literal(v: Any) -> str:
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    return str(v)
+
+
+def to_cql(f: Filter) -> str:
+    """Inverse of parse_cql (normalized form)."""
+    if isinstance(f, Include):
+        return "INCLUDE"
+    if isinstance(f, Exclude):
+        return "EXCLUDE"
+    if isinstance(f, And):
+        return " AND ".join(
+            f"({to_cql(c)})" if isinstance(c, Or) else to_cql(c) for c in f.children()
+        )
+    if isinstance(f, Or):
+        return " OR ".join(
+            f"({to_cql(c)})" if isinstance(c, (And, Or)) else to_cql(c)
+            for c in f.children()
+        )
+    if isinstance(f, Not):
+        c = f.child
+        inner = to_cql(c)
+        return f"NOT ({inner})" if isinstance(c, (And, Or)) else f"NOT {inner}"
+    if isinstance(f, BBox):
+        e = f.envelope
+        return f"BBOX({f.prop}, {e.xmin}, {e.ymin}, {e.xmax}, {e.ymax})"
+    if isinstance(f, Intersects):
+        return f"INTERSECTS({f.prop}, {to_wkt(f.geometry)})"
+    if isinstance(f, Contains):
+        return f"CONTAINS({f.prop}, {to_wkt(f.geometry)})"
+    if isinstance(f, Within):
+        return f"WITHIN({f.prop}, {to_wkt(f.geometry)})"
+    if isinstance(f, Disjoint):
+        return f"DISJOINT({f.prop}, {to_wkt(f.geometry)})"
+    if isinstance(f, DWithin):
+        return f"DWITHIN({f.prop}, {to_wkt(f.geometry)}, {f.distance}, {f.units})"
+    if isinstance(f, During):
+        return f"{f.prop} DURING {_fmt_instant(f.lo_ms)}/{_fmt_instant(f.hi_ms)}"
+    if isinstance(f, Before):
+        return f"{f.prop} BEFORE {_fmt_instant(f.t_ms)}"
+    if isinstance(f, After):
+        return f"{f.prop} AFTER {_fmt_instant(f.t_ms)}"
+    if isinstance(f, TEquals):
+        return f"{f.prop} TEQUALS {_fmt_instant(f.t_ms)}"
+    if isinstance(f, Cmp):
+        return f"{f.prop} {f.op} {_fmt_literal(f.literal)}"
+    if isinstance(f, Between):
+        return f"{f.prop} BETWEEN {_fmt_literal(f.lo)} AND {_fmt_literal(f.hi)}"
+    if isinstance(f, Like):
+        kw = "ILIKE" if f.case_insensitive else "LIKE"
+        return f"{f.prop} {kw} {_fmt_literal(f.pattern)}"
+    if isinstance(f, IsNull):
+        return f"{f.prop} IS {'NOT ' if f.negate else ''}NULL"
+    if isinstance(f, InList):
+        return f"{f.prop} IN ({', '.join(_fmt_literal(v) for v in f.values)})"
+    if isinstance(f, IdFilter):
+        return f"IN ({', '.join(_fmt_literal(v) for v in f.ids)})"
+    raise ValueError(f"Cannot serialize filter {type(f)}")

@@ -1,0 +1,125 @@
+"""Logical rewrites: simplify, CNF, DNF.
+
+Rebuild of the reference's filter algebra (geomesa-filter package.scala
+rewriteFilterInCNF/rewriteFilterInDNF and the flatten/dedupe helpers used by
+FilterSplitter)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.ast import (
+    And,
+    EXCLUDE,
+    Exclude,
+    Filter,
+    INCLUDE,
+    Include,
+    Not,
+    Or,
+    and_option,
+    or_option,
+)
+
+
+def simplify(f: Filter) -> Filter:
+    """Flatten nested ANDs/ORs, drop INCLUDE/EXCLUDE units, dedupe children,
+    and push NOT through NOT."""
+    if isinstance(f, Not):
+        inner = simplify(f.child)
+        if isinstance(inner, Not):
+            return simplify(inner.child)
+        if isinstance(inner, Include):
+            return EXCLUDE
+        if isinstance(inner, Exclude):
+            return INCLUDE
+        return Not(inner)
+    if isinstance(f, And):
+        flat: List[Filter] = []
+        for c in f.children():
+            c = simplify(c)
+            if isinstance(c, And):
+                flat.extend(c.children())
+            else:
+                flat.append(c)
+        seen, deduped = set(), []
+        for c in flat:
+            key = repr(c)
+            if key not in seen:
+                seen.add(key)
+                deduped.append(c)
+        return and_option(deduped)
+    if isinstance(f, Or):
+        flat = []
+        for c in f.children():
+            c = simplify(c)
+            if isinstance(c, Or):
+                flat.extend(c.children())
+            else:
+                flat.append(c)
+        seen, deduped = set(), []
+        for c in flat:
+            key = repr(c)
+            if key not in seen:
+                seen.add(key)
+                deduped.append(c)
+        return or_option(deduped)
+    return f
+
+
+def _push_not_down(f: Filter) -> Filter:
+    """Negation normal form: NOT only on leaves."""
+    if isinstance(f, Not):
+        c = f.child
+        if isinstance(c, Not):
+            return _push_not_down(c.child)
+        if isinstance(c, And):
+            return Or([_push_not_down(Not(x)) for x in c.children()])
+        if isinstance(c, Or):
+            return And([_push_not_down(Not(x)) for x in c.children()])
+        return f
+    if isinstance(f, And):
+        return And([_push_not_down(c) for c in f.children()])
+    if isinstance(f, Or):
+        return Or([_push_not_down(c) for c in f.children()])
+    return f
+
+
+_MAX_EXPANSION = 1 << 12
+
+
+def to_cnf(f: Filter) -> Filter:
+    """Conjunctive normal form (AND of ORs)."""
+    return simplify(_distribute(_push_not_down(simplify(f)), cnf=True))
+
+
+def to_dnf(f: Filter) -> Filter:
+    """Disjunctive normal form (OR of ANDs)."""
+    return simplify(_distribute(_push_not_down(simplify(f)), cnf=False))
+
+
+def _distribute(f: Filter, cnf: bool) -> Filter:
+    inner_cls, outer_cls = (Or, And) if cnf else (And, Or)
+    if isinstance(f, (And, Or)):
+        children = [_distribute(c, cnf) for c in f.children()]
+        if isinstance(f, outer_cls):
+            return outer_cls(children)
+        # f is the inner connective: distribute over any outer children
+        groups: List[List[Filter]] = [[]]
+        for c in children:
+            if isinstance(c, outer_cls):
+                subs = list(c.children())
+            else:
+                subs = [c]
+            if len(groups) * len(subs) > _MAX_EXPANSION:
+                # bail out of exponential blowup; planner treats as opaque
+                return f
+            groups = [g + [s] for g in groups for s in subs]
+        if len(groups) == 1:
+            return inner_cls(groups[0]) if len(groups[0]) > 1 else groups[0][0]
+        terms = [
+            inner_cls(g) if len(g) > 1 else g[0] for g in groups
+        ]
+        return outer_cls(terms)
+    return f

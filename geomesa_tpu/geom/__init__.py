@@ -1,0 +1,47 @@
+"""Lightweight geometry model with numpy coordinate arrays.
+
+Replaces the reference's dependency on JTS (com.vividsolutions.jts) for the
+subset of geometry the framework needs: WKT round-trips, envelopes, and the
+spatial predicates used by query planning and post-filtering. Coordinates are
+(N, 2) float64 arrays -- friendly to columnar storage and to batched device
+predicates in ``geomesa_tpu.ops``.
+"""
+
+from geomesa_tpu.geom.base import (
+    Envelope,
+    Geometry,
+    GeometryCollection,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+    WHOLE_WORLD,
+)
+from geomesa_tpu.geom.wkt import parse_wkt, to_wkt
+from geomesa_tpu.geom.predicates import (
+    points_in_envelope,
+    points_in_geometry,
+    points_in_polygon,
+    segments_intersect_envelope,
+)
+
+__all__ = [
+    "Envelope",
+    "Geometry",
+    "GeometryCollection",
+    "LineString",
+    "MultiLineString",
+    "MultiPoint",
+    "MultiPolygon",
+    "Point",
+    "Polygon",
+    "WHOLE_WORLD",
+    "parse_wkt",
+    "to_wkt",
+    "points_in_envelope",
+    "points_in_geometry",
+    "points_in_polygon",
+    "segments_intersect_envelope",
+]

@@ -1,0 +1,225 @@
+"""Geometry types: envelope + the seven OGC simple-feature geometries.
+
+The subset of JTS behavior the reference actually leans on (envelope
+computation for index keys via ``geometry.getEnvelopeInternal``, intersection
+testing for planning/post-filter, WKT round-trips for converters/CLI).
+Coordinates are numpy (N, 2) float64 arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Envelope:
+    """Axis-aligned bounding box (analog of JTS Envelope)."""
+
+    __slots__ = ("xmin", "ymin", "xmax", "ymax")
+
+    def __init__(self, xmin: float, ymin: float, xmax: float, ymax: float):
+        self.xmin = float(xmin)
+        self.ymin = float(ymin)
+        self.xmax = float(xmax)
+        self.ymax = float(ymax)
+
+    @classmethod
+    def of_coords(cls, coords: np.ndarray) -> "Envelope":
+        return cls(
+            coords[:, 0].min(), coords[:, 1].min(), coords[:, 0].max(), coords[:, 1].max()
+        )
+
+    def intersects(self, other: "Envelope") -> bool:
+        return (
+            self.xmin <= other.xmax
+            and other.xmin <= self.xmax
+            and self.ymin <= other.ymax
+            and other.ymin <= self.ymax
+        )
+
+    def contains_env(self, other: "Envelope") -> bool:
+        return (
+            self.xmin <= other.xmin
+            and other.xmax <= self.xmax
+            and self.ymin <= other.ymin
+            and other.ymax <= self.ymax
+        )
+
+    def intersection(self, other: "Envelope") -> Optional["Envelope"]:
+        if not self.intersects(other):
+            return None
+        return Envelope(
+            max(self.xmin, other.xmin),
+            max(self.ymin, other.ymin),
+            min(self.xmax, other.xmax),
+            min(self.ymax, other.ymax),
+        )
+
+    def expand_to_include(self, other: "Envelope") -> "Envelope":
+        return Envelope(
+            min(self.xmin, other.xmin),
+            min(self.ymin, other.ymin),
+            max(self.xmax, other.xmax),
+            max(self.ymax, other.ymax),
+        )
+
+    @property
+    def width(self) -> float:
+        return self.xmax - self.xmin
+
+    @property
+    def height(self) -> float:
+        return self.ymax - self.ymin
+
+    @property
+    def area(self) -> float:
+        return max(0.0, self.width) * max(0.0, self.height)
+
+    def to_polygon(self) -> "Polygon":
+        return Polygon(
+            np.array(
+                [
+                    [self.xmin, self.ymin],
+                    [self.xmax, self.ymin],
+                    [self.xmax, self.ymax],
+                    [self.xmin, self.ymax],
+                    [self.xmin, self.ymin],
+                ]
+            )
+        )
+
+    def as_tuple(self) -> Tuple[float, float, float, float]:
+        return (self.xmin, self.ymin, self.xmax, self.ymax)
+
+    def __eq__(self, other):
+        return isinstance(other, Envelope) and self.as_tuple() == other.as_tuple()
+
+    def __hash__(self):
+        return hash(self.as_tuple())
+
+    def __repr__(self):
+        return f"Envelope({self.xmin}, {self.ymin}, {self.xmax}, {self.ymax})"
+
+
+class Geometry:
+    """Base geometry. Subclasses store coordinates as (N, 2) float64."""
+
+    geom_type = "Geometry"
+
+    @property
+    def envelope(self) -> Envelope:
+        raise NotImplementedError
+
+    def is_rectangle(self) -> bool:
+        """True when the geometry is exactly its envelope (the reference's
+        loose-bbox fast path checks geometry==envelope)."""
+        return False
+
+    def __repr__(self):
+        from geomesa_tpu.geom.wkt import to_wkt
+
+        return to_wkt(self)
+
+    def __eq__(self, other):
+        from geomesa_tpu.geom.wkt import to_wkt
+
+        return isinstance(other, Geometry) and to_wkt(self) == to_wkt(other)
+
+    def __hash__(self):
+        from geomesa_tpu.geom.wkt import to_wkt
+
+        return hash(to_wkt(self))
+
+
+class Point(Geometry):
+    geom_type = "Point"
+
+    def __init__(self, x: float, y: float):
+        self.x = float(x)
+        self.y = float(y)
+
+    @property
+    def coords(self) -> np.ndarray:
+        return np.array([[self.x, self.y]], dtype=np.float64)
+
+    @property
+    def envelope(self) -> Envelope:
+        return Envelope(self.x, self.y, self.x, self.y)
+
+
+class LineString(Geometry):
+    geom_type = "LineString"
+
+    def __init__(self, coords):
+        self.coords = np.asarray(coords, dtype=np.float64).reshape(-1, 2)
+
+    @property
+    def envelope(self) -> Envelope:
+        return Envelope.of_coords(self.coords)
+
+
+class Polygon(Geometry):
+    """Exterior shell + optional interior holes; rings are closed (N, 2)."""
+
+    geom_type = "Polygon"
+
+    def __init__(self, shell, holes: Optional[Sequence] = None):
+        self.shell = np.asarray(shell, dtype=np.float64).reshape(-1, 2)
+        self.holes: List[np.ndarray] = [
+            np.asarray(h, dtype=np.float64).reshape(-1, 2) for h in (holes or [])
+        ]
+
+    @property
+    def envelope(self) -> Envelope:
+        return Envelope.of_coords(self.shell)
+
+    def is_rectangle(self) -> bool:
+        if self.holes or len(self.shell) != 5:
+            return False
+        env = self.envelope
+        corners = {
+            (env.xmin, env.ymin),
+            (env.xmax, env.ymin),
+            (env.xmax, env.ymax),
+            (env.xmin, env.ymax),
+        }
+        pts = {(float(x), float(y)) for x, y in self.shell[:4]}
+        return pts == corners
+
+
+class _Multi(Geometry):
+    member_type: type = Geometry
+
+    def __init__(self, geoms: Iterable[Geometry]):
+        self.geoms: List[Geometry] = list(geoms)
+
+    @property
+    def envelope(self) -> Envelope:
+        env = self.geoms[0].envelope
+        for g in self.geoms[1:]:
+            env = env.expand_to_include(g.envelope)
+        return env
+
+
+class MultiPoint(_Multi):
+    geom_type = "MultiPoint"
+    member_type = Point
+
+
+class MultiLineString(_Multi):
+    geom_type = "MultiLineString"
+    member_type = LineString
+
+
+class MultiPolygon(_Multi):
+    geom_type = "MultiPolygon"
+    member_type = Polygon
+
+
+class GeometryCollection(_Multi):
+    geom_type = "GeometryCollection"
+
+
+# The reference's WholeWorldPolygon (geomesa-utils .../geotools/package.scala)
+WHOLE_WORLD = Envelope(-180.0, -90.0, 180.0, 90.0)

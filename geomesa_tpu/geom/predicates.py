@@ -1,0 +1,290 @@
+"""Vectorized spatial predicates over coordinate arrays.
+
+The host-side (numpy) versions of the post-filter kernels. These evaluate a
+*query geometry* against columnar batches of feature points -- the analog of
+the reference's CQL geometry predicates evaluated per-feature in server-side
+iterators (e.g. KryoLazyFilterTransformIterator). The same math is mirrored
+on device in ``geomesa_tpu.ops.geometry``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.geom.base import (
+    Envelope,
+    Geometry,
+    GeometryCollection,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+
+
+def points_in_envelope(x: np.ndarray, y: np.ndarray, env: Envelope) -> np.ndarray:
+    """Inclusive bbox containment for point arrays."""
+    return (x >= env.xmin) & (x <= env.xmax) & (y >= env.ymin) & (y <= env.ymax)
+
+
+def _points_in_ring(x: np.ndarray, y: np.ndarray, ring: np.ndarray) -> np.ndarray:
+    """Even-odd ray cast: True where (x, y) is strictly inside or on an edge
+    crossing. Boundary points are handled separately by the on-segment test."""
+    inside = np.zeros(x.shape, dtype=bool)
+    x0, y0 = ring[:-1, 0], ring[:-1, 1]
+    x1, y1 = ring[1:, 0], ring[1:, 1]
+    for i in range(len(x0)):
+        ax, ay, bx, by = x0[i], y0[i], x1[i], y1[i]
+        crosses = ((ay > y) != (by > y)) & (
+            x < (bx - ax) * (y - ay) / np.where(by != ay, by - ay, 1.0) + ax
+        )
+        inside ^= crosses
+    return inside
+
+
+def _points_on_segments(x: np.ndarray, y: np.ndarray, ring: np.ndarray, eps=1e-12):
+    """True where a point lies on any segment of the ring (inclusive ends)."""
+    on = np.zeros(x.shape, dtype=bool)
+    x0, y0 = ring[:-1, 0], ring[:-1, 1]
+    x1, y1 = ring[1:, 0], ring[1:, 1]
+    for i in range(len(x0)):
+        ax, ay, bx, by = x0[i], y0[i], x1[i], y1[i]
+        cross = (bx - ax) * (y - ay) - (by - ay) * (x - ax)
+        within = (
+            (np.minimum(ax, bx) - eps <= x)
+            & (x <= np.maximum(ax, bx) + eps)
+            & (np.minimum(ay, by) - eps <= y)
+            & (y <= np.maximum(ay, by) + eps)
+        )
+        on |= (np.abs(cross) <= eps * max(1.0, abs(bx - ax) + abs(by - ay))) & within
+    return on
+
+
+def points_in_polygon(
+    x: np.ndarray, y: np.ndarray, poly: Polygon, boundary: bool = True
+) -> np.ndarray:
+    """Point-in-polygon. ``boundary=True`` includes shell *and* hole rings
+    (JTS intersects semantics); ``boundary=False`` is the strict interior
+    (JTS within semantics for points)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    env = poly.envelope
+    candidates = points_in_envelope(x, y, env)
+    result = np.zeros(x.shape, dtype=bool)
+    if not candidates.any():
+        return result
+    xi, yi = x[candidates], y[candidates]
+    inside = _points_in_ring(xi, yi, poly.shell)
+    for hole in poly.holes:
+        inside &= ~_points_in_ring(xi, yi, hole)
+    on_boundary = _points_on_segments(xi, yi, poly.shell)
+    for hole in poly.holes:
+        on_boundary |= _points_on_segments(xi, yi, hole)
+    if boundary:
+        inside |= on_boundary
+    else:
+        inside &= ~on_boundary
+    result[candidates] = inside
+    return result
+
+
+def points_in_geometry(x: np.ndarray, y: np.ndarray, geom: Geometry) -> np.ndarray:
+    """Does each point intersect ``geom``? Dispatch over geometry type."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if isinstance(geom, Polygon):
+        if geom.is_rectangle():
+            return points_in_envelope(x, y, geom.envelope)
+        return points_in_polygon(x, y, geom)
+    if isinstance(geom, Point):
+        return (x == geom.x) & (y == geom.y)
+    if isinstance(geom, LineString):
+        return _points_on_segments(x, y, geom.coords)
+    if isinstance(geom, (MultiPolygon, MultiPoint, MultiLineString, GeometryCollection)):
+        out = np.zeros(x.shape, dtype=bool)
+        for g in geom.geoms:
+            out |= points_in_geometry(x, y, g)
+        return out
+    raise ValueError(f"Unsupported geometry for point test: {type(geom)}")
+
+
+def segments_intersect_envelope(coords: np.ndarray, env: Envelope) -> bool:
+    """Does a polyline intersect an envelope? (Used for non-point features.)
+
+    Cohen-Sutherland style: any endpoint inside, or any segment straddling.
+    """
+    x, y = coords[:, 0], coords[:, 1]
+    if points_in_envelope(x, y, env).any():
+        return True
+    # check each segment against the 4 envelope edges
+    corners = env.to_polygon().shell
+    for i in range(len(coords) - 1):
+        p, q = coords[i], coords[i + 1]
+        for j in range(4):
+            a, b = corners[j], corners[j + 1]
+            if _segs_cross(p, q, a, b):
+                return True
+    return False
+
+
+def _segs_cross(p, q, a, b) -> bool:
+    d1 = _orient(a, b, p)
+    d2 = _orient(a, b, q)
+    d3 = _orient(p, q, a)
+    d4 = _orient(p, q, b)
+    if ((d1 > 0) != (d2 > 0)) and ((d3 > 0) != (d4 > 0)):
+        return True
+    for pt, (u, v) in [(p, (a, b)), (q, (a, b)), (a, (p, q)), (b, (p, q))]:
+        if _orient(u, v, pt) == 0 and _on_segment(u, v, pt):
+            return True
+    return False
+
+
+def _orient(a, b, c) -> float:
+    return (b[0] - a[0]) * (c[1] - a[1]) - (b[1] - a[1]) * (c[0] - a[0])
+
+
+def _on_segment(a, b, c) -> bool:
+    return (
+        min(a[0], b[0]) <= c[0] <= max(a[0], b[0])
+        and min(a[1], b[1]) <= c[1] <= max(a[1], b[1])
+    )
+
+
+# ---------------------------------------------------------------------------
+# exact geometry-geometry intersects / distance (the JTS relate subset)
+# ---------------------------------------------------------------------------
+
+
+def _rings(geom: Geometry):
+    """All coordinate rings/paths of a geometry."""
+    if isinstance(geom, Point):
+        yield geom.coords
+    elif isinstance(geom, LineString):
+        yield geom.coords
+    elif isinstance(geom, Polygon):
+        yield geom.shell
+        yield from geom.holes
+    else:
+        for g in geom.geoms:
+            yield from _rings(g)
+
+
+def _paths_cross(a: np.ndarray, b: np.ndarray) -> bool:
+    for i in range(len(a) - 1):
+        for j in range(len(b) - 1):
+            if _segs_cross(a[i], a[i + 1], b[j], b[j + 1]):
+                return True
+    return False
+
+
+def geometries_intersect(g1: Geometry, g2: Geometry) -> bool:
+    """Exact intersects for the supported types (boundary inclusive).
+
+    Covers the combinations the post-filter needs: point/line/polygon and
+    their multis. Envelope-rejects first, then tests containment of
+    representative vertices plus pairwise edge crossings.
+    """
+    if not g1.envelope.intersects(g2.envelope):
+        return False
+    if isinstance(g1, (MultiPoint, MultiLineString, MultiPolygon, GeometryCollection)):
+        return any(geometries_intersect(g, g2) for g in g1.geoms)
+    if isinstance(g2, (MultiPoint, MultiLineString, MultiPolygon, GeometryCollection)):
+        return any(geometries_intersect(g1, g) for g in g2.geoms)
+    if isinstance(g1, Point):
+        return bool(points_in_geometry(np.array([g1.x]), np.array([g1.y]), g2)[0])
+    if isinstance(g2, Point):
+        return bool(points_in_geometry(np.array([g2.x]), np.array([g2.y]), g1)[0])
+    # line/polygon vs line/polygon: vertex containment either way, or edge cross
+    p1 = next(iter(_rings(g1)))
+    p2 = next(iter(_rings(g2)))
+    if bool(points_in_geometry(p1[:1, 0], p1[:1, 1], g2)[0]):
+        return True
+    if bool(points_in_geometry(p2[:1, 0], p2[:1, 1], g1)[0]):
+        return True
+    for a in _rings(g1):
+        for b in _rings(g2):
+            if _paths_cross(a, b):
+                return True
+    return False
+
+
+def geometry_within(g1: Geometry, g2: Geometry) -> bool:
+    """g1 within g2 (g1 entirely contained; point-on-boundary excluded for
+    point g1, matching JTS where within requires interior intersection)."""
+    if not g2.envelope.contains_env(g1.envelope):
+        return False
+    if isinstance(g1, Point):
+        if isinstance(g2, Polygon):
+            return bool(
+                points_in_polygon(np.array([g1.x]), np.array([g1.y]), g2, boundary=False)[0]
+            )
+        if isinstance(g2, (MultiPolygon, GeometryCollection)):
+            return any(geometry_within(g1, g) for g in g2.geoms)
+        return bool(points_in_geometry(np.array([g1.x]), np.array([g1.y]), g2)[0])
+    if isinstance(g1, (MultiPoint, MultiLineString, MultiPolygon, GeometryCollection)):
+        return all(geometry_within(g, g2) for g in g1.geoms)
+    # every vertex inside (hole-aware), and no edge properly crossing g2's rings
+    for path in _rings(g1):
+        mask = points_in_geometry(path[:, 0], path[:, 1], g2)
+        if not mask.all():
+            return False
+    if isinstance(g2, (Polygon,)):
+        for a in _rings(g1):
+            for hole in g2.holes:
+                if _paths_cross(a, hole):
+                    return False
+    return True
+
+
+def _seg_seg_dist2(p, q, a, b) -> float:
+    """Squared distance between segments pq and ab."""
+    if _segs_cross(p, q, a, b):
+        return 0.0
+    return min(
+        _pt_seg_dist2(p, a, b),
+        _pt_seg_dist2(q, a, b),
+        _pt_seg_dist2(a, p, q),
+        _pt_seg_dist2(b, p, q),
+    )
+
+
+def _pt_seg_dist2(c, a, b) -> float:
+    abx, aby = b[0] - a[0], b[1] - a[1]
+    denom = abx * abx + aby * aby
+    if denom == 0:
+        dx, dy = c[0] - a[0], c[1] - a[1]
+        return dx * dx + dy * dy
+    t = max(0.0, min(1.0, ((c[0] - a[0]) * abx + (c[1] - a[1]) * aby) / denom))
+    dx = c[0] - (a[0] + t * abx)
+    dy = c[1] - (a[1] + t * aby)
+    return dx * dx + dy * dy
+
+
+def geometry_distance(g1: Geometry, g2: Geometry) -> float:
+    """Min euclidean (degree-space) distance; 0 when intersecting."""
+    if geometries_intersect(g1, g2):
+        return 0.0
+    best = np.inf
+    for a in _rings(g1):
+        for b in _rings(g2):
+            if len(a) == 1 and len(b) == 1:
+                d2 = (a[0, 0] - b[0, 0]) ** 2 + (a[0, 1] - b[0, 1]) ** 2
+            elif len(a) == 1:
+                d2 = min(
+                    _pt_seg_dist2(a[0], b[j], b[j + 1]) for j in range(len(b) - 1)
+                )
+            elif len(b) == 1:
+                d2 = min(
+                    _pt_seg_dist2(b[0], a[i], a[i + 1]) for i in range(len(a) - 1)
+                )
+            else:
+                d2 = min(
+                    _seg_seg_dist2(a[i], a[i + 1], b[j], b[j + 1])
+                    for i in range(len(a) - 1)
+                    for j in range(len(b) - 1)
+                )
+            best = min(best, d2)
+    return float(np.sqrt(best))

@@ -1,0 +1,192 @@
+"""WKT parsing and writing for the seven simple-feature geometry types.
+
+Replaces JTS's WKTReader/WKTWriter for the framework's needs (converter
+ingest, CQL literals, CLI export).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+import numpy as np
+
+from geomesa_tpu.geom.base import (
+    Geometry,
+    GeometryCollection,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+
+_TYPE_RE = re.compile(r"\s*([A-Za-z]+)\s*(.*)", re.DOTALL)
+
+
+class _Cursor:
+    def __init__(self, text: str):
+        self.text = text
+        self.pos = 0
+
+    def skip_ws(self):
+        while self.pos < len(self.text) and self.text[self.pos].isspace():
+            self.pos += 1
+
+    def peek(self) -> str:
+        self.skip_ws()
+        return self.text[self.pos] if self.pos < len(self.text) else ""
+
+    def expect(self, ch: str):
+        self.skip_ws()
+        if self.pos >= len(self.text) or self.text[self.pos] != ch:
+            raise ValueError(
+                f"WKT parse error at {self.pos}: expected {ch!r} in {self.text!r}"
+            )
+        self.pos += 1
+
+    def word(self) -> str:
+        self.skip_ws()
+        m = re.match(r"[A-Za-z]+", self.text[self.pos :])
+        if not m:
+            raise ValueError(f"WKT parse error at {self.pos} in {self.text!r}")
+        self.pos += m.end()
+        return m.group(0).upper()
+
+    def number(self) -> float:
+        self.skip_ws()
+        m = re.match(r"[-+]?\d*\.?\d+(?:[eE][-+]?\d+)?", self.text[self.pos :])
+        if not m:
+            raise ValueError(f"WKT number expected at {self.pos} in {self.text!r}")
+        self.pos += m.end()
+        return float(m.group(0))
+
+
+def _parse_coords(c: _Cursor) -> np.ndarray:
+    c.expect("(")
+    pts: List[Tuple[float, float]] = []
+    while True:
+        x = c.number()
+        y = c.number()
+        # ignore any Z/M ordinates
+        while c.peek() not in (",", ")"):
+            c.number()
+        pts.append((x, y))
+        if c.peek() == ",":
+            c.expect(",")
+        else:
+            break
+    c.expect(")")
+    return np.array(pts, dtype=np.float64)
+
+
+def _parse_rings(c: _Cursor) -> List[np.ndarray]:
+    c.expect("(")
+    rings = [_parse_coords(c)]
+    while c.peek() == ",":
+        c.expect(",")
+        rings.append(_parse_coords(c))
+    c.expect(")")
+    return rings
+
+
+def _parse_geom(c: _Cursor) -> Geometry:
+    kind = c.word()
+    if kind == "POINT":
+        pts = _parse_coords(c)
+        return Point(pts[0, 0], pts[0, 1])
+    if kind == "LINESTRING":
+        return LineString(_parse_coords(c))
+    if kind == "POLYGON":
+        rings = _parse_rings(c)
+        return Polygon(rings[0], rings[1:])
+    if kind == "MULTIPOINT":
+        c.expect("(")
+        pts = []
+        while True:
+            if c.peek() == "(":
+                sub = _parse_coords(c)
+                pts.append(Point(sub[0, 0], sub[0, 1]))
+            else:
+                pts.append(Point(c.number(), c.number()))
+            if c.peek() == ",":
+                c.expect(",")
+            else:
+                break
+        c.expect(")")
+        return MultiPoint(pts)
+    if kind == "MULTILINESTRING":
+        c.expect("(")
+        lines = [LineString(_parse_coords(c))]
+        while c.peek() == ",":
+            c.expect(",")
+            lines.append(LineString(_parse_coords(c)))
+        c.expect(")")
+        return MultiLineString(lines)
+    if kind == "MULTIPOLYGON":
+        c.expect("(")
+        polys = []
+        rings = _parse_rings(c)
+        polys.append(Polygon(rings[0], rings[1:]))
+        while c.peek() == ",":
+            c.expect(",")
+            rings = _parse_rings(c)
+            polys.append(Polygon(rings[0], rings[1:]))
+        c.expect(")")
+        return MultiPolygon(polys)
+    if kind == "GEOMETRYCOLLECTION":
+        c.expect("(")
+        geoms = [_parse_geom(c)]
+        while c.peek() == ",":
+            c.expect(",")
+            geoms.append(_parse_geom(c))
+        c.expect(")")
+        return GeometryCollection(geoms)
+    raise ValueError(f"Unsupported WKT type: {kind}")
+
+
+def parse_wkt(text: str) -> Geometry:
+    c = _Cursor(text)
+    g = _parse_geom(c)
+    c.skip_ws()
+    if c.pos != len(c.text):
+        raise ValueError(f"Trailing WKT content: {text[c.pos:]!r}")
+    return g
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _coords_str(coords: np.ndarray) -> str:
+    return "(" + ", ".join(f"{_fmt(x)} {_fmt(y)}" for x, y in coords) + ")"
+
+
+def to_wkt(g: Geometry) -> str:
+    if isinstance(g, Point):
+        return f"POINT ({_fmt(g.x)} {_fmt(g.y)})"
+    if isinstance(g, LineString):
+        return "LINESTRING " + _coords_str(g.coords)
+    if isinstance(g, Polygon):
+        rings = [g.shell] + g.holes
+        return "POLYGON (" + ", ".join(_coords_str(r) for r in rings) + ")"
+    if isinstance(g, MultiPoint):
+        return "MULTIPOINT (" + ", ".join(
+            f"({_fmt(p.x)} {_fmt(p.y)})" for p in g.geoms
+        ) + ")"
+    if isinstance(g, MultiLineString):
+        return "MULTILINESTRING (" + ", ".join(
+            _coords_str(l.coords) for l in g.geoms
+        ) + ")"
+    if isinstance(g, MultiPolygon):
+        parts = []
+        for p in g.geoms:
+            rings = [p.shell] + p.holes
+            parts.append("(" + ", ".join(_coords_str(r) for r in rings) + ")")
+        return "MULTIPOLYGON (" + ", ".join(parts) + ")"
+    if isinstance(g, GeometryCollection):
+        return "GEOMETRYCOLLECTION (" + ", ".join(to_wkt(m) for m in g.geoms) + ")"
+    raise ValueError(f"Cannot serialize {type(g)}")

@@ -1,0 +1,28 @@
+"""Feature type schema: the SimpleFeatureType analog.
+
+Rebuild of the reference's spec-string driven schema layer
+(geomesa-utils .../geotools/SimpleFeatureTypes.scala and
+SimpleFeatureSpecParser.scala): a feature type is declared as
+``"name:String,age:Int,dtg:Date,*geom:Point:srid=4326;geomesa.z3.interval=week"``
+-- comma-separated ``name:Type[:opt=val...]`` attribute specs, ``*`` marking
+the default geometry, and semicolon-separated user-data entries carrying
+schema-level configuration (enabled indices, z3 interval, shard counts...).
+"""
+
+from geomesa_tpu.schema.featuretype import (
+    AttributeDescriptor,
+    AttributeType,
+    FeatureType,
+    parse_spec,
+    encode_spec,
+)
+from geomesa_tpu.schema.feature import Feature
+
+__all__ = [
+    "AttributeDescriptor",
+    "AttributeType",
+    "FeatureType",
+    "Feature",
+    "parse_spec",
+    "encode_spec",
+]

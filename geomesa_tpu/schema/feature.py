@@ -1,0 +1,85 @@
+"""A single feature: id + attribute values (ScalaSimpleFeature analog).
+
+Reference: geomesa-features geomesa-feature-common
+.../ScalaSimpleFeature.scala:1-157. In the TPU design features mostly live in
+columnar blocks (geomesa_tpu.store.blocks); this row-oriented class is the
+ingest/egress unit and test currency.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional, Sequence
+
+from geomesa_tpu.geom.base import Geometry
+from geomesa_tpu.geom.wkt import parse_wkt
+from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
+
+
+def _to_millis(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, datetime.datetime):
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=datetime.timezone.utc)
+        return int(v.timestamp() * 1000)
+    if isinstance(v, str):
+        s = v.strip().replace("Z", "+00:00")
+        dt = datetime.datetime.fromisoformat(s)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return int(dt.timestamp() * 1000)
+    raise TypeError(f"Cannot convert {v!r} to a date")
+
+
+_CONVERTERS = {
+    AttributeType.STRING: lambda v: str(v),
+    AttributeType.INT: lambda v: int(v),
+    AttributeType.LONG: lambda v: int(v),
+    AttributeType.FLOAT: lambda v: float(v),
+    AttributeType.DOUBLE: lambda v: float(v),
+    AttributeType.BOOLEAN: lambda v: v if isinstance(v, bool) else str(v).lower() == "true",
+    AttributeType.DATE: _to_millis,
+    AttributeType.UUID: lambda v: str(v),
+    AttributeType.BYTES: lambda v: bytes(v),
+}
+
+
+def convert_attribute(type_: AttributeType, value: Any) -> Any:
+    """Coerce a raw value to the canonical in-memory representation."""
+    if value is None:
+        return None
+    if type_.is_geometry:
+        if isinstance(value, Geometry):
+            return value
+        if isinstance(value, str):
+            return parse_wkt(value)
+        raise TypeError(f"Cannot convert {value!r} to a geometry")
+    return _CONVERTERS[type_](value)
+
+
+class Feature:
+    __slots__ = ("fid", "values", "user_data")
+
+    def __init__(
+        self,
+        ft: FeatureType,
+        fid: Optional[str],
+        values: Sequence[Any],
+        user_data: Optional[Dict[str, Any]] = None,
+    ):
+        if len(values) != len(ft.attributes):
+            raise ValueError(
+                f"Expected {len(ft.attributes)} values, got {len(values)}"
+            )
+        self.fid = fid
+        self.values: List[Any] = [
+            convert_attribute(a.type, v) for a, v in zip(ft.attributes, values)
+        ]
+        self.user_data = dict(user_data or {})
+
+    def get(self, ft: FeatureType, name: str) -> Any:
+        return self.values[ft.index_of(name)]
+
+    def __repr__(self):
+        return f"Feature({self.fid!r}, {self.values!r})"

@@ -1,0 +1,332 @@
+"""FeatureType: schema definition + spec-string parser/encoder.
+
+Reference: geomesa-utils .../geotools/SimpleFeatureTypes.scala (spec strings),
+SimpleFeatureSpec.scala (attribute options + user-data config keys), and
+geomesa-utils .../index/GeoMesaSchemaValidator.scala (dtg binding checks).
+
+Columnar mapping (TPU-first design): every attribute type declares its
+storage -- a numpy dtype for fixed-width columns (numbers, dates as epoch
+millis, booleans), object/dictionary columns for strings, and coordinate
+pairs for point geometries. Non-point geometries store WKT plus a packed
+envelope column so device kernels can bbox-reject without parsing.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.curve.binnedtime import TimePeriod
+
+
+class AttributeType(enum.Enum):
+    STRING = "String"
+    INT = "Integer"
+    LONG = "Long"
+    FLOAT = "Float"
+    DOUBLE = "Double"
+    BOOLEAN = "Boolean"
+    DATE = "Date"
+    UUID = "UUID"
+    BYTES = "Bytes"
+    POINT = "Point"
+    LINESTRING = "LineString"
+    POLYGON = "Polygon"
+    MULTIPOINT = "MultiPoint"
+    MULTILINESTRING = "MultiLineString"
+    MULTIPOLYGON = "MultiPolygon"
+    GEOMETRYCOLLECTION = "GeometryCollection"
+    GEOMETRY = "Geometry"
+
+    @property
+    def is_geometry(self) -> bool:
+        return self in _GEOM_TYPES
+
+    @property
+    def numpy_dtype(self) -> Optional[np.dtype]:
+        """Fixed-width column dtype, or None for variable-width (object) storage."""
+        return _NUMPY_DTYPES.get(self)
+
+
+_GEOM_TYPES = {
+    AttributeType.POINT,
+    AttributeType.LINESTRING,
+    AttributeType.POLYGON,
+    AttributeType.MULTIPOINT,
+    AttributeType.MULTILINESTRING,
+    AttributeType.MULTIPOLYGON,
+    AttributeType.GEOMETRYCOLLECTION,
+    AttributeType.GEOMETRY,
+}
+
+_NUMPY_DTYPES = {
+    AttributeType.INT: np.dtype(np.int32),
+    AttributeType.LONG: np.dtype(np.int64),
+    AttributeType.FLOAT: np.dtype(np.float32),
+    AttributeType.DOUBLE: np.dtype(np.float64),
+    AttributeType.BOOLEAN: np.dtype(np.bool_),
+    AttributeType.DATE: np.dtype(np.int64),  # epoch millis
+}
+
+_TYPE_ALIASES = {
+    "string": AttributeType.STRING,
+    "int": AttributeType.INT,
+    "integer": AttributeType.INT,
+    "long": AttributeType.LONG,
+    "float": AttributeType.FLOAT,
+    "double": AttributeType.DOUBLE,
+    "boolean": AttributeType.BOOLEAN,
+    "bool": AttributeType.BOOLEAN,
+    "date": AttributeType.DATE,
+    "timestamp": AttributeType.DATE,
+    "uuid": AttributeType.UUID,
+    "bytes": AttributeType.BYTES,
+    "point": AttributeType.POINT,
+    "linestring": AttributeType.LINESTRING,
+    "polygon": AttributeType.POLYGON,
+    "multipoint": AttributeType.MULTIPOINT,
+    "multilinestring": AttributeType.MULTILINESTRING,
+    "multipolygon": AttributeType.MULTIPOLYGON,
+    "geometrycollection": AttributeType.GEOMETRYCOLLECTION,
+    "geometry": AttributeType.GEOMETRY,
+}
+
+# reserved words the reference rejects as attribute names (GeoMesaSchemaValidator)
+_RESERVED = {"id", "fid"}
+
+
+class AttributeDescriptor:
+    def __init__(
+        self,
+        name: str,
+        type_: AttributeType,
+        default_geom: bool = False,
+        options: Optional[Dict[str, str]] = None,
+    ):
+        self.name = name
+        self.type = type_
+        self.default_geom = default_geom
+        self.options = dict(options or {})
+
+    @property
+    def indexed(self) -> bool:
+        """Attribute-index flag (``index=true`` / ``index=join`` option)."""
+        v = self.options.get("index", "false").lower()
+        return v in ("true", "full", "join")
+
+    def spec(self) -> str:
+        parts = [f"{'*' if self.default_geom else ''}{self.name}:{self.type.value}"]
+        for k, v in self.options.items():
+            parts.append(f"{k}={v}")
+        return ":".join(parts)
+
+    def __repr__(self):
+        return f"AttributeDescriptor({self.spec()!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, AttributeDescriptor) and (
+            self.name,
+            self.type,
+            self.default_geom,
+            self.options,
+        ) == (other.name, other.type, other.default_geom, other.options)
+
+
+class FeatureType:
+    """Schema for one feature type (SimpleFeatureType analog).
+
+    ``user_data`` carries schema-level config exactly like the reference's
+    SFT user data: ``geomesa.indices`` (enabled index list),
+    ``geomesa.z3.interval`` / ``geomesa.xz3.interval`` (time period),
+    ``geomesa.z.splits`` (shard count), ``geomesa.table.sharing``, etc.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        attributes: List[AttributeDescriptor],
+        user_data: Optional[Dict[str, str]] = None,
+    ):
+        self.name = name
+        self.attributes = list(attributes)
+        self.user_data: Dict[str, str] = dict(user_data or {})
+        self._by_name = {a.name: i for i, a in enumerate(self.attributes)}
+        if len(self._by_name) != len(self.attributes):
+            raise ValueError("Duplicate attribute names")
+        for a in self.attributes:
+            if a.name.lower() in _RESERVED:
+                raise ValueError(f"Reserved attribute name: {a.name}")
+
+    # -- attribute access ---------------------------------------------------
+
+    def attr(self, name: str) -> AttributeDescriptor:
+        return self.attributes[self.index_of(name)]
+
+    def index_of(self, name: str) -> int:
+        if name not in self._by_name:
+            raise KeyError(f"No attribute {name!r} in type {self.name!r}")
+        return self._by_name[name]
+
+    def has(self, name: str) -> bool:
+        return name in self._by_name
+
+    @property
+    def attribute_names(self) -> List[str]:
+        return [a.name for a in self.attributes]
+
+    # -- well-known roles ---------------------------------------------------
+
+    @property
+    def default_geometry(self) -> Optional[AttributeDescriptor]:
+        for a in self.attributes:
+            if a.default_geom:
+                return a
+        for a in self.attributes:
+            if a.type.is_geometry:
+                return a
+        return None
+
+    @property
+    def default_date(self) -> Optional[AttributeDescriptor]:
+        """The dtg attribute: explicit via user data, else first Date attribute
+        (GeoMesaSchemaValidator's dtg binding)."""
+        explicit = self.user_data.get("geomesa.index.dtg")
+        if explicit:
+            return self.attr(explicit)
+        for a in self.attributes:
+            if a.type == AttributeType.DATE:
+                return a
+        return None
+
+    @property
+    def z3_interval(self) -> TimePeriod:
+        """geomesa.z3.interval user-data key, default week (reference default)."""
+        return TimePeriod.parse(self.user_data.get("geomesa.z3.interval", "week"))
+
+    @property
+    def xz3_interval(self) -> TimePeriod:
+        return TimePeriod.parse(self.user_data.get("geomesa.xz3.interval", "week"))
+
+    @property
+    def z_shards(self) -> int:
+        """geomesa.z.splits: write-shard count (reference default 4)."""
+        return int(self.user_data.get("geomesa.z.splits", "4"))
+
+    @property
+    def attribute_shards(self) -> int:
+        return int(self.user_data.get("geomesa.attr.splits", "4"))
+
+    @property
+    def xz_precision(self) -> int:
+        """geomesa.xz.precision: XZ curve resolution g (default 12)."""
+        return int(self.user_data.get("geomesa.xz.precision", "12"))
+
+    @property
+    def enabled_indices(self) -> Optional[List[str]]:
+        """Explicit geomesa.indices user-data override, or None for defaults."""
+        raw = self.user_data.get("geomesa.indices.enabled") or self.user_data.get(
+            "geomesa.indices"
+        )
+        if not raw:
+            return None
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+    @property
+    def is_points(self) -> bool:
+        geom = self.default_geometry
+        return geom is not None and geom.type == AttributeType.POINT
+
+    # -- spec round trip ----------------------------------------------------
+
+    def spec(self) -> str:
+        return encode_spec(self)
+
+    def __repr__(self):
+        return f"FeatureType({self.name!r}, {self.spec()!r})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, FeatureType)
+            and self.name == other.name
+            and self.attributes == other.attributes
+            and self.user_data == other.user_data
+        )
+
+
+def parse_spec(name: str, spec: str) -> FeatureType:
+    """Parse a spec string into a FeatureType.
+
+    Format (SimpleFeatureTypes.scala / SimpleFeatureSpecParser.scala):
+    ``[*]name:Type[:opt=val]*(,...)[;key=value(,key=value)*]``. User-data
+    values may be single-quoted.
+    """
+    spec = spec.strip()
+    user_data: Dict[str, str] = {}
+    if ";" in spec:
+        attr_part, ud_part = spec.split(";", 1)
+        for entry in _split_top(ud_part, ","):
+            if not entry.strip():
+                continue
+            if "=" not in entry:
+                raise ValueError(f"Bad user-data entry: {entry!r}")
+            k, v = entry.split("=", 1)
+            user_data[k.strip()] = v.strip().strip("'\"")
+    else:
+        attr_part = spec
+
+    attrs: List[AttributeDescriptor] = []
+    for entry in _split_top(attr_part, ","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        default_geom = entry.startswith("*")
+        if default_geom:
+            entry = entry[1:]
+        pieces = entry.split(":")
+        if len(pieces) < 2:
+            raise ValueError(f"Bad attribute spec: {entry!r}")
+        aname = pieces[0].strip()
+        tname = pieces[1].strip().lower()
+        if tname not in _TYPE_ALIASES:
+            raise ValueError(f"Unknown attribute type: {pieces[1]!r}")
+        options: Dict[str, str] = {}
+        for opt in pieces[2:]:
+            if "=" not in opt:
+                raise ValueError(f"Bad attribute option: {opt!r}")
+            k, v = opt.split("=", 1)
+            options[k.strip()] = v.strip().strip("'\"")
+        attrs.append(
+            AttributeDescriptor(aname, _TYPE_ALIASES[tname], default_geom, options)
+        )
+    return FeatureType(name, attrs, user_data)
+
+
+def encode_spec(ft: FeatureType) -> str:
+    attr_part = ",".join(a.spec() for a in ft.attributes)
+    if ft.user_data:
+        ud = ",".join(f"{k}='{v}'" for k, v in sorted(ft.user_data.items()))
+        return f"{attr_part};{ud}"
+    return attr_part
+
+
+def _split_top(s: str, sep: str) -> List[str]:
+    """Split on ``sep`` outside of quotes."""
+    out, buf, quote = [], [], None
+    for ch in s:
+        if quote:
+            buf.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "'\"":
+            quote = ch
+            buf.append(ch)
+        elif ch == sep:
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    out.append("".join(buf))
+    return out

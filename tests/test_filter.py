@@ -1,0 +1,292 @@
+"""Filter layer tests: parser round-trip, extraction, rewrite, evaluation.
+
+Mirrors the reference's FilterHelperTest / FilterSplitter tests in spirit.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter import (
+    And,
+    BBox,
+    Bounds,
+    Cmp,
+    During,
+    EXCLUDE,
+    INCLUDE,
+    IdFilter,
+    InList,
+    Intersects,
+    Like,
+    Not,
+    Or,
+    evaluate,
+    extract_geometries,
+    extract_intervals,
+    parse_cql,
+    simplify,
+    to_cnf,
+    to_dnf,
+)
+from geomesa_tpu.filter.parser import parse_instant_ms, to_cql
+from geomesa_tpu.geom import Polygon, parse_wkt
+from geomesa_tpu.schema import parse_spec
+
+FT = parse_spec(
+    "test", "name:String,age:Int,weight:Double,dtg:Date,*geom:Point:srid=4326"
+)
+
+
+def cols(n=6):
+    return {
+        "name": np.array(["alice", "bob", None, "carol", "dave", "eve"], dtype=object),
+        "age": np.array([30, 25, 40, 35, 21, 67], dtype=np.int32),
+        "weight": np.array([55.5, 81.2, np.nan, 62.0, 70.1, 50.0]),
+        "dtg": np.array(
+            [parse_instant_ms(f"2017-01-0{i+1}T12:00:00Z") for i in range(6)],
+            dtype=np.int64,
+        ),
+        "geom__x": np.array([-120.0, -110.0, -100.0, -90.0, -80.0, -70.0]),
+        "geom__y": np.array([45.0, 40.0, 35.0, 30.0, 25.0, 20.0]),
+        "__fid__": np.array([f"f{i}" for i in range(6)], dtype=object),
+    }
+
+
+class TestParser:
+    @pytest.mark.parametrize(
+        "cql",
+        [
+            "INCLUDE",
+            "EXCLUDE",
+            "BBOX(geom, -180.0, -90.0, 180.0, 90.0)",
+            "INTERSECTS(geom, POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0)))",
+            "name = 'alice'",
+            "age > 21",
+            "age >= 21 AND age <= 65",
+            "weight BETWEEN 50.0 AND 60.0",
+            "name LIKE 'a%'",
+            "name IS NULL",
+            "name IS NOT NULL",
+            "age IN (21, 25, 30)",
+            "IN ('f1', 'f2')",
+            "dtg DURING 2017-01-01T00:00:00.000Z/2017-01-03T00:00:00.000Z",
+            "dtg AFTER 2017-01-02T00:00:00.000Z",
+            "NOT name = 'bob'",
+            "name = 'a' OR name = 'b' OR name = 'c'",
+            "BBOX(geom, -10, -10, 10, 10) AND dtg DURING 2017-01-01T00:00:00.000Z/2017-01-02T00:00:00.000Z",
+            "DWITHIN(geom, POINT (0 0), 1000.0, meters)",
+        ],
+    )
+    def test_round_trip(self, cql):
+        f = parse_cql(cql)
+        f2 = parse_cql(to_cql(f))
+        assert to_cql(f) == to_cql(f2)
+
+    def test_parse_structure(self):
+        f = parse_cql("BBOX(geom, -10, -10, 10, 10) AND age > 21")
+        assert isinstance(f, And)
+        assert isinstance(f.children()[0], BBox)
+        assert isinstance(f.children()[1], Cmp)
+
+    def test_precedence(self):
+        f = parse_cql("age = 1 OR age = 2 AND name = 'x'")
+        assert isinstance(f, Or)  # AND binds tighter
+        assert isinstance(f.children()[1], And)
+
+    def test_quoted_string_escape(self):
+        f = parse_cql("name = 'o''brien'")
+        assert f.literal == "o'brien"
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            parse_cql("BBOX(geom, 1, 2)")
+        with pytest.raises(ValueError):
+            parse_cql("age >")
+        with pytest.raises(ValueError):
+            parse_cql("garbage !!!")
+
+
+class TestExtraction:
+    def test_bbox_extraction(self):
+        f = parse_cql("BBOX(geom, -10, -20, 10, 20)")
+        fv = extract_geometries(f, "geom")
+        assert len(fv.values) == 1
+        assert fv.values[0].envelope.as_tuple() == (-10, -20, 10, 20)
+
+    def test_bbox_clipped_to_world(self):
+        f = parse_cql("BBOX(geom, -200, -95, 200, 95)")
+        fv = extract_geometries(f, "geom")
+        assert fv.values[0].envelope.as_tuple() == (-180, -90, 180, 90)
+
+    def test_and_intersects_bboxes(self):
+        f = parse_cql("BBOX(geom, -10, -10, 10, 10) AND BBOX(geom, 0, 0, 20, 20)")
+        fv = extract_geometries(f, "geom")
+        assert fv.values[0].envelope.as_tuple() == (0, 0, 10, 10)
+
+    def test_disjoint_bboxes(self):
+        f = parse_cql("BBOX(geom, -10, -10, -5, -5) AND BBOX(geom, 5, 5, 10, 10)")
+        fv = extract_geometries(f, "geom")
+        assert fv.disjoint
+
+    def test_or_unions(self):
+        f = parse_cql("BBOX(geom, -10, -10, 0, 0) OR BBOX(geom, 0, 0, 10, 10)")
+        fv = extract_geometries(f, "geom")
+        assert len(fv.values) == 2
+
+    def test_or_with_unconstrained_branch(self):
+        f = parse_cql("BBOX(geom, -10, -10, 0, 0) OR age > 21")
+        fv = extract_geometries(f, "geom")
+        assert fv.is_empty
+
+    def test_during_exclusive(self):
+        f = parse_cql("dtg DURING 2017-01-01T00:00:00.000Z/2017-01-02T00:00:00.000Z")
+        fv = extract_intervals(f, "dtg")
+        b = fv.values[0]
+        assert not b.lower.inclusive and not b.upper.inclusive
+        assert b.lower.value == parse_instant_ms("2017-01-01T00:00:00Z")
+
+    def test_during_exclusive_rounding(self):
+        f = parse_cql("dtg DURING 2017-01-01T00:00:00.500Z/2017-01-02T00:00:00.000Z")
+        fv = extract_intervals(f, "dtg", handle_exclusive_bounds=True)
+        b = fv.values[0]
+        # lower rounds up to the next whole second, now inclusive
+        assert b.lower.value == parse_instant_ms("2017-01-01T00:00:01Z")
+        assert b.lower.inclusive
+        # upper rounds down a second
+        assert b.upper.value == parse_instant_ms("2017-01-01T23:59:59Z")
+
+    def test_interval_intersection(self):
+        f = parse_cql(
+            "dtg AFTER 2017-01-01T00:00:00.000Z AND dtg BEFORE 2017-01-05T00:00:00.000Z"
+        )
+        fv = extract_intervals(f, "dtg")
+        b = fv.values[0]
+        assert b.lower.value == parse_instant_ms("2017-01-01T00:00:00Z")
+        assert b.upper.value == parse_instant_ms("2017-01-05T00:00:00Z")
+
+    def test_interval_or_union_merges(self):
+        f = parse_cql(
+            "(dtg DURING 2017-01-01T00:00:00.000Z/2017-01-03T00:00:00.000Z)"
+            " OR (dtg DURING 2017-01-02T00:00:00.000Z/2017-01-05T00:00:00.000Z)"
+        )
+        fv = extract_intervals(f, "dtg")
+        assert len(fv.values) == 1
+        assert fv.values[0].upper.value == parse_instant_ms("2017-01-05T00:00:00Z")
+
+    def test_contradictory_intervals_disjoint(self):
+        f = parse_cql(
+            "dtg BEFORE 2017-01-01T00:00:00.000Z AND dtg AFTER 2017-06-01T00:00:00.000Z"
+        )
+        fv = extract_intervals(f, "dtg")
+        assert fv.disjoint
+
+    def test_equality_interval(self):
+        f = parse_cql("dtg = '2017-03-01T12:00:00Z'")
+        fv = extract_intervals(f, "dtg")
+        b = fv.values[0]
+        assert b.lower.value == b.upper.value == parse_instant_ms("2017-03-01T12:00:00Z")
+
+
+class TestRewrite:
+    def test_simplify_flattens(self):
+        f = And([And([Cmp("age", ">", 1), Cmp("age", "<", 9)]), Cmp("name", "=", "x")])
+        s = simplify(f)
+        assert len(s.children()) == 3
+
+    def test_simplify_units(self):
+        assert simplify(And([INCLUDE, Cmp("age", ">", 1)])) == Cmp("age", ">", 1)
+        assert simplify(Or([EXCLUDE, Cmp("age", ">", 1)])) == Cmp("age", ">", 1)
+        assert simplify(And([EXCLUDE, Cmp("age", ">", 1)])) == EXCLUDE
+
+    def test_not_not(self):
+        assert simplify(Not(Not(Cmp("age", ">", 1)))) == Cmp("age", ">", 1)
+
+    def test_dnf(self):
+        f = parse_cql("(a = '1' OR b = '2') AND c = '3'")
+        d = to_dnf(f)
+        assert isinstance(d, Or)
+        for term in d.children():
+            assert isinstance(term, And)
+
+    def test_cnf(self):
+        f = parse_cql("(a = '1' AND b = '2') OR c = '3'")
+        c = to_cnf(f)
+        assert isinstance(c, And)
+
+
+class TestEvaluate:
+    def test_bbox(self):
+        f = parse_cql("BBOX(geom, -115, 20, -75, 42)")
+        mask = evaluate(f, FT, cols())
+        np.testing.assert_array_equal(mask, [False, True, True, True, True, False])
+
+    def test_intersects_polygon(self):
+        poly = "POLYGON ((-105 30, -85 30, -85 45, -105 45, -105 30))"
+        f = parse_cql(f"INTERSECTS(geom, {poly})")
+        mask = evaluate(f, FT, cols())
+        np.testing.assert_array_equal(mask, [False, False, True, True, False, False])
+
+    def test_cmp_and_during(self):
+        f = parse_cql(
+            "age >= 25 AND dtg DURING 2017-01-01T00:00:00.000Z/2017-01-04T00:00:00.000Z"
+        )
+        mask = evaluate(f, FT, cols())
+        np.testing.assert_array_equal(mask, [True, True, True, False, False, False])
+
+    def test_null_handling(self):
+        mask = evaluate(parse_cql("name IS NULL"), FT, cols())
+        np.testing.assert_array_equal(mask, [False, False, True, False, False, False])
+        mask = evaluate(parse_cql("weight > 0"), FT, cols())
+        assert not mask[2]  # NaN weight doesn't match
+
+    def test_like(self):
+        mask = evaluate(parse_cql("name LIKE '%e'"), FT, cols())
+        np.testing.assert_array_equal(mask, [True, False, False, False, True, True])
+
+    def test_in_list_and_ids(self):
+        mask = evaluate(parse_cql("age IN (21, 67)"), FT, cols())
+        np.testing.assert_array_equal(mask, [False, False, False, False, True, True])
+        mask = evaluate(parse_cql("IN ('f0', 'f5')"), FT, cols())
+        np.testing.assert_array_equal(mask, [True, False, False, False, False, True])
+
+    def test_not(self):
+        mask = evaluate(parse_cql("NOT age > 30"), FT, cols())
+        np.testing.assert_array_equal(mask, [True, True, False, False, True, False])
+
+    def test_dwithin_point(self):
+        f = parse_cql("DWITHIN(geom, POINT (-110 40), 200000.0, meters)")
+        mask = evaluate(f, FT, cols())
+        assert mask[1]
+        assert not mask[0] and not mask[5]
+
+
+class TestSchema:
+    def test_spec_round_trip(self):
+        ft = parse_spec(
+            "gdelt",
+            "actor1:String:index=true,dtg:Date,*geom:Point:srid=4326;geomesa.z3.interval=week,geomesa.z.splits=4",
+        )
+        assert ft.default_geometry.name == "geom"
+        assert ft.default_date.name == "dtg"
+        assert ft.z3_interval.value == "week"
+        assert ft.z_shards == 4
+        assert ft.attr("actor1").indexed
+        ft2 = parse_spec("gdelt", ft.spec())
+        assert ft == ft2
+
+    def test_is_points(self):
+        assert FT.is_points
+        ft = parse_spec("t", "name:String,*geom:Polygon:srid=4326")
+        assert not ft.is_points
+
+    def test_reserved_names(self):
+        with pytest.raises(ValueError):
+            parse_spec("t", "id:String,*geom:Point")
+
+    def test_geometry_wkt(self):
+        g = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+        assert isinstance(g, Polygon)
+        assert g.is_rectangle()
+        assert g.envelope.as_tuple() == (0, 0, 10, 10)
+        g2 = parse_wkt("POLYGON ((0 0, 10 0, 12 10, 0 10, 0 0))")
+        assert not g2.is_rectangle()
