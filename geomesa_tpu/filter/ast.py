@@ -29,7 +29,10 @@ class Filter:
         return to_cql(self)
 
     def __eq__(self, other):
-        return type(self) is type(other) and self.__dict__ == other.__dict__
+        # eq and hash both key on the normalized CQL form so the contract
+        # holds (raw __dict__ comparison would call int 1 == float 1.0 equal
+        # while their reprs hash differently)
+        return isinstance(other, Filter) and repr(self) == repr(other)
 
     def __hash__(self):
         return hash(repr(self))

@@ -18,7 +18,6 @@ Column conventions (shared with geomesa_tpu.store.blocks):
 
 from __future__ import annotations
 
-import fnmatch
 import re
 from typing import Dict
 
@@ -30,9 +29,10 @@ from geomesa_tpu.geom.predicates import (
     geometries_intersect,
     geometry_distance,
     geometry_within,
+    points_distance_to_geometry,
     points_in_envelope,
     points_in_geometry,
-    points_in_polygon,
+    points_within_geometry,
 )
 from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
 
@@ -140,12 +140,7 @@ def _eval_spatial(f: ast.SpatialFilter, ft: FeatureType, columns: Columns) -> np
             mask = points_in_geometry(x, y, f.geometry)
         elif isinstance(f, ast.Within):
             # JTS within excludes points on the query geometry's boundary
-            from geomesa_tpu.geom.base import Polygon as _Poly
-
-            if isinstance(f.geometry, _Poly):
-                mask = points_in_polygon(x, y, f.geometry, boundary=False)
-            else:
-                mask = points_in_geometry(x, y, f.geometry)
+            mask = points_within_geometry(x, y, f.geometry)
         elif isinstance(f, ast.Contains):
             # a point can only contain a point
             from geomesa_tpu.geom.base import Point
@@ -172,33 +167,7 @@ def _eval_spatial(f: ast.SpatialFilter, ft: FeatureType, columns: Columns) -> np
 
 
 def _points_dwithin(x: np.ndarray, y: np.ndarray, f: ast.DWithin) -> np.ndarray:
-    d = f.degrees
-    g = f.geometry
-    from geomesa_tpu.geom.base import Point, LineString
-
-    if isinstance(g, Point):
-        return (x - g.x) ** 2 + (y - g.y) ** 2 <= d * d
-    if isinstance(g, LineString):
-        out = np.zeros(x.shape, dtype=bool)
-        c = g.coords
-        for i in range(len(c) - 1):
-            out |= _dist2_to_segment(x, y, c[i], c[i + 1]) <= d * d
-        return out
-    # fall back to expanded-envelope test
-    env = g.envelope
-    return points_in_envelope(
-        x, y, Envelope(env.xmin - d, env.ymin - d, env.xmax + d, env.ymax + d)
-    )
-
-
-def _dist2_to_segment(x, y, a, b):
-    abx, aby = b[0] - a[0], b[1] - a[1]
-    apx, apy = x - a[0], y - a[1]
-    denom = abx * abx + aby * aby
-    t = np.clip((apx * abx + apy * aby) / (denom if denom else 1.0), 0.0, 1.0)
-    dx = apx - t * abx
-    dy = apy - t * aby
-    return dx * dx + dy * dy
+    return points_distance_to_geometry(x, y, f.geometry) <= f.degrees
 
 
 def _geom_predicate(f: ast.SpatialFilter, g: Geometry) -> bool:

@@ -8,7 +8,6 @@ from __future__ import annotations
 
 from typing import List
 
-from geomesa_tpu.filter import ast
 from geomesa_tpu.filter.ast import (
     And,
     EXCLUDE,

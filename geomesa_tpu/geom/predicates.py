@@ -180,6 +180,23 @@ def _paths_cross(a: np.ndarray, b: np.ndarray) -> bool:
     return False
 
 
+def _paths_properly_cross(a: np.ndarray, b: np.ndarray) -> bool:
+    """Proper (transversal) crossings only -- touching endpoints or running
+    along a boundary does not count. Used by within-tests where boundary
+    contact is allowed."""
+    for i in range(len(a) - 1):
+        p, q = a[i], a[i + 1]
+        for j in range(len(b) - 1):
+            u, v = b[j], b[j + 1]
+            d1 = _orient(u, v, p)
+            d2 = _orient(u, v, q)
+            d3 = _orient(p, q, u)
+            d4 = _orient(p, q, v)
+            if ((d1 > 0) != (d2 > 0)) and ((d3 > 0) != (d4 > 0)) and 0 not in (d1, d2, d3, d4):
+                return True
+    return False
+
+
 def geometries_intersect(g1: Geometry, g2: Geometry) -> bool:
     """Exact intersects for the supported types (boundary inclusive).
 
@@ -211,6 +228,57 @@ def geometries_intersect(g1: Geometry, g2: Geometry) -> bool:
     return False
 
 
+def points_within_geometry(x: np.ndarray, y: np.ndarray, geom: Geometry) -> np.ndarray:
+    """JTS within for point arrays: interior containment, so points on a
+    polygon boundary are excluded (unlike :func:`points_in_geometry`)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if isinstance(geom, Polygon):
+        return points_in_polygon(x, y, geom, boundary=False)
+    if isinstance(geom, (MultiPolygon, GeometryCollection, MultiPoint, MultiLineString)):
+        out = np.zeros(x.shape, dtype=bool)
+        for g in geom.geoms:
+            out |= points_within_geometry(x, y, g)
+        return out
+    return points_in_geometry(x, y, geom)
+
+
+def points_distance_to_geometry(
+    x: np.ndarray, y: np.ndarray, geom: Geometry
+) -> np.ndarray:
+    """Exact degree-space distance from each point to ``geom`` (0 inside)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if isinstance(geom, Point):
+        return np.sqrt((x - geom.x) ** 2 + (y - geom.y) ** 2)
+    if isinstance(geom, (MultiPolygon, MultiPoint, MultiLineString, GeometryCollection)):
+        out = np.full(x.shape, np.inf)
+        for g in geom.geoms:
+            out = np.minimum(out, points_distance_to_geometry(x, y, g))
+        return out
+    d2 = np.full(x.shape, np.inf)
+    for ring in _rings(geom):
+        if len(ring) == 1:
+            d2 = np.minimum(d2, (x - ring[0, 0]) ** 2 + (y - ring[0, 1]) ** 2)
+        for i in range(len(ring) - 1):
+            a, b = ring[i], ring[i + 1]
+            abx, aby = b[0] - a[0], b[1] - a[1]
+            denom = abx * abx + aby * aby
+            t = np.clip(
+                ((x - a[0]) * abx + (y - a[1]) * aby) / (denom if denom else 1.0),
+                0.0,
+                1.0,
+            )
+            dx = x - (a[0] + t * abx)
+            dy = y - (a[1] + t * aby)
+            d2 = np.minimum(d2, dx * dx + dy * dy)
+    dist = np.sqrt(d2)
+    if isinstance(geom, Polygon):
+        inside = points_in_polygon(x, y, geom)
+        dist = np.where(inside, 0.0, dist)
+    return dist
+
+
 def geometry_within(g1: Geometry, g2: Geometry) -> bool:
     """g1 within g2 (g1 entirely contained; point-on-boundary excluded for
     point g1, matching JTS where within requires interior intersection)."""
@@ -226,16 +294,21 @@ def geometry_within(g1: Geometry, g2: Geometry) -> bool:
         return bool(points_in_geometry(np.array([g1.x]), np.array([g1.y]), g2)[0])
     if isinstance(g1, (MultiPoint, MultiLineString, MultiPolygon, GeometryCollection)):
         return all(geometry_within(g, g2) for g in g1.geoms)
-    # every vertex inside (hole-aware), and no edge properly crossing g2's rings
+    # every vertex and edge midpoint inside (hole-aware), and no edge
+    # properly crossing any ring of g2 (boundary contact allowed)
     for path in _rings(g1):
         mask = points_in_geometry(path[:, 0], path[:, 1], g2)
         if not mask.all():
             return False
-    if isinstance(g2, (Polygon,)):
-        for a in _rings(g1):
-            for hole in g2.holes:
-                if _paths_cross(a, hole):
-                    return False
+        if len(path) > 1:
+            mx = (path[:-1, 0] + path[1:, 0]) / 2.0
+            my = (path[:-1, 1] + path[1:, 1]) / 2.0
+            if not points_in_geometry(mx, my, g2).all():
+                return False
+    for a in _rings(g1):
+        for b in _rings(g2):
+            if _paths_properly_cross(a, b):
+                return False
     return True
 
 

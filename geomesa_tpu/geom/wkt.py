@@ -22,9 +22,6 @@ from geomesa_tpu.geom.base import (
     Polygon,
 )
 
-_TYPE_RE = re.compile(r"\s*([A-Za-z]+)\s*(.*)", re.DOTALL)
-
-
 class _Cursor:
     def __init__(self, text: str):
         self.text = text

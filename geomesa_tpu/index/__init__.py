@@ -1,0 +1,43 @@
+"""Index core: key spaces, filter strategies, query planning.
+
+Rebuild of the reference's ``geomesa-index-api`` (SURVEY.md section 2.2):
+``IndexKeySpace`` implementations encode feature batches into sortable keys
+and decompose filters into key ranges; ``FilterStrategy`` extraction splits a
+filter into the part an index can answer and the residual; the
+``QueryPlanner`` picks the cheapest strategy and assembles a ``QueryPlan``
+executed by the datastore (host numpy or TPU kernels).
+"""
+
+from geomesa_tpu.index.keyspace import (
+    AttributeKeySpace,
+    IdKeySpace,
+    IndexKeySpace,
+    ScanRange,
+    XZ2KeySpace,
+    XZ3KeySpace,
+    Z2KeySpace,
+    Z3KeySpace,
+    ALL_INDICES,
+    default_indices,
+)
+from geomesa_tpu.index.strategy import FilterStrategy, get_filter_strategies
+from geomesa_tpu.index.planner import Explainer, Query, QueryPlan, QueryPlanner
+
+__all__ = [
+    "AttributeKeySpace",
+    "IdKeySpace",
+    "IndexKeySpace",
+    "ScanRange",
+    "XZ2KeySpace",
+    "XZ3KeySpace",
+    "Z2KeySpace",
+    "Z3KeySpace",
+    "ALL_INDICES",
+    "default_indices",
+    "FilterStrategy",
+    "get_filter_strategies",
+    "Explainer",
+    "Query",
+    "QueryPlan",
+    "QueryPlanner",
+]

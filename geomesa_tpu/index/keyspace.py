@@ -1,0 +1,552 @@
+"""Index key spaces: pure key logic per index family.
+
+Rebuild of the reference's IndexKeySpace hierarchy (geomesa-index-api
+.../index/IndexKeySpace.scala:18-62 and the z2/z3/xz2/xz3/attribute/id
+implementations). Each key space knows how to (a) encode a *batch* of
+features into sortable key columns (the vectorized analog of ``toIndexKey``),
+(b) decompose a filter into index values (``getIndexValues``) and
+(c) turn those into scan ranges (``getRanges``).
+
+Key columns convention (consumed by geomesa_tpu.store.blocks):
+  * ``__bin__``  int16 time bin (z3/xz3 only)
+  * ``__key__``  int64 z value / xz sequence code, or object for attr/id
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.curve import (
+    TimePeriod,
+    XZ2SFC,
+    XZ3SFC,
+    Z2SFC,
+    Z3SFC,
+    bounds_to_indexable_ms,
+    max_offset,
+    time_to_binned,
+)
+from geomesa_tpu.curve.zorder import IndexRange
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.bounds import Bounds, FilterValues
+from geomesa_tpu.filter.extract import extract_geometries, extract_intervals
+from geomesa_tpu.geom.base import Envelope, Geometry, WHOLE_WORLD
+from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
+
+# the reference's scan-range budget (QueryProperties.scala:18)
+SCAN_RANGES_TARGET = 2000
+
+
+class ScanRange(NamedTuple):
+    """One key range to scan. ``bin`` partitions binned indices (z3/xz3);
+    non-binned indices use bin 0. ``lower``/``upper`` of None mean unbounded
+    (attribute ranges); inclusivity defaults to closed ranges."""
+
+    bin: int
+    lower: Any
+    upper: Any
+    contained: bool
+    lower_inclusive: bool = True
+    upper_inclusive: bool = True
+
+
+@dataclass
+class IndexValues:
+    """Decomposed filter carried from planning into scans (the reference's
+    Z3IndexValues / Z2IndexValues case classes)."""
+
+    geometries: FilterValues
+    intervals: Optional[FilterValues] = None
+    # bin -> (offset_lo, offset_hi) inclusive windows (z3/xz3)
+    bins: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+    # equality/range values for attribute index
+    attr_bounds: Optional[List[Bounds]] = None
+    ids: Optional[List[str]] = None
+    disjoint: bool = False
+
+    @property
+    def spatial_envelopes(self) -> List[Envelope]:
+        return [g.envelope for g in self.geometries.values]
+
+
+class IndexKeySpace:
+    name: str = "base"
+
+    def supports(self, ft: FeatureType) -> bool:
+        raise NotImplementedError
+
+    def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
+        """Encode a batch of features into key columns (vectorized
+        ``toIndexKey``)."""
+        raise NotImplementedError
+
+    def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
+        raise NotImplementedError
+
+    def get_ranges(
+        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+    ) -> List[ScanRange]:
+        raise NotImplementedError
+
+
+def _geom_prop(ft: FeatureType) -> str:
+    geom = ft.default_geometry
+    if geom is None:
+        raise ValueError(f"Feature type {ft.name} has no geometry")
+    return geom.name
+
+
+def _boxes(values: IndexValues) -> List[Tuple[float, float, float, float]]:
+    """Query envelopes clipped to the world, defaulting to whole world."""
+    if not values.geometries.values:
+        return [WHOLE_WORLD.as_tuple()]
+    out = []
+    for g in values.geometries.values:
+        inter = WHOLE_WORLD.intersection(g.envelope)
+        if inter is not None:
+            out.append(inter.as_tuple())
+    return out or [WHOLE_WORLD.as_tuple()]
+
+
+def times_by_bin(
+    intervals: FilterValues, period: TimePeriod
+) -> Dict[int, Tuple[int, int]]:
+    """Per-bin inclusive offset windows from ms interval bounds.
+
+    The analog of Z3IndexKeySpace.getIndexValues' timesByBin computation
+    (Z3IndexKeySpace.scala:63-119): each interval is clamped to the indexable
+    domain, split at bin boundaries, with whole-period bins short-circuited
+    to the full window.
+    """
+    mo = max_offset(period)
+    out: Dict[int, Tuple[int, int]] = {}
+
+    def add(b: int, lo: int, hi: int):
+        if b in out:
+            clo, chi = out[b]
+            out[b] = (min(clo, lo), max(chi, hi))
+        else:
+            out[b] = (lo, hi)
+
+    for bounds in intervals.values:
+        lo_ms = bounds.lower.value
+        hi_ms = bounds.upper.value
+        # make endpoints inclusive in ms space
+        if lo_ms is not None and not bounds.lower.inclusive:
+            lo_ms += 1
+        if hi_ms is not None and not bounds.upper.inclusive:
+            hi_ms -= 1
+        lo_ms, hi_ms = bounds_to_indexable_ms(lo_ms, hi_ms, period)
+        if lo_ms > hi_ms:
+            continue
+        (blo,), (olo,) = time_to_binned(lo_ms, period)
+        (bhi,), (ohi,) = time_to_binned(hi_ms, period)
+        blo, bhi = int(blo), int(bhi)
+        if blo == bhi:
+            add(blo, int(olo), int(ohi))
+        else:
+            add(blo, int(olo), mo)
+            for b in range(blo + 1, bhi):
+                add(b, 0, mo)
+            add(bhi, 0, int(ohi))
+    return out
+
+
+class Z3KeySpace(IndexKeySpace):
+    """Point + time index: key = (2-byte bin, 63-bit z3)
+    (Z3IndexKeySpace.scala, indexKeyLength=10)."""
+
+    name = "z3"
+
+    def supports(self, ft: FeatureType) -> bool:
+        return ft.is_points and ft.default_date is not None
+
+    def sfc(self, ft: FeatureType) -> Z3SFC:
+        return Z3SFC.for_period(ft.z3_interval)
+
+    def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
+        geom = _geom_prop(ft)
+        dtg = ft.default_date.name
+        x = columns[geom + "__x"]
+        y = columns[geom + "__y"]
+        t = columns[dtg]
+        bins, offsets = time_to_binned(t, ft.z3_interval, lenient=True)
+        z = self.sfc(ft).index(x, y, offsets, lenient=True)
+        return {"__bin__": bins, "__key__": z}
+
+    def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
+        geom = _geom_prop(ft)
+        dtg = ft.default_date.name
+        geoms = extract_geometries(f, geom)
+        intervals = extract_intervals(f, dtg, handle_exclusive_bounds=True)
+        if geoms.disjoint or intervals.disjoint:
+            return IndexValues(geoms, intervals, disjoint=True)
+        bins = times_by_bin(intervals, ft.z3_interval) if intervals.values else {}
+        if not intervals.values:
+            # unbounded time: every bin through the max date (the reference
+            # requires an interval for z3 to be chosen; guard anyway)
+            bins = {}
+        return IndexValues(geoms, intervals, bins=bins)
+
+    def get_ranges(
+        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+    ) -> List[ScanRange]:
+        if values.disjoint:
+            return []
+        sfc = self.sfc(ft)
+        boxes = _boxes(values)
+        mo = max_offset(ft.z3_interval)
+        out: List[ScanRange] = []
+        # whole-period bins share one decomposition (Z3IndexKeySpace.scala:129-135)
+        whole = [b for b, w in values.bins.items() if w == (0, mo)]
+        partial = {b: w for b, w in values.bins.items() if w != (0, mo)}
+        n_groups = (1 if whole else 0) + len(partial)
+        per_group = max(1, max_ranges // max(1, n_groups))
+        if whole:
+            ranges = sfc.ranges(boxes, [(0, mo)], max_ranges=per_group)
+            for b in sorted(whole):
+                out.extend(
+                    ScanRange(b, r.lower, r.upper, r.contained) for r in ranges
+                )
+        for b, (lo, hi) in sorted(partial.items()):
+            ranges = sfc.ranges(boxes, [(lo, hi)], max_ranges=per_group)
+            out.extend(ScanRange(b, r.lower, r.upper, r.contained) for r in ranges)
+        return out
+
+
+class Z2KeySpace(IndexKeySpace):
+    """Point spatial index: key = 62-bit z2 (Z2IndexKeySpace.scala:28-104)."""
+
+    name = "z2"
+
+    def __init__(self):
+        self._sfc = Z2SFC()
+
+    def supports(self, ft: FeatureType) -> bool:
+        return ft.is_points
+
+    def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
+        geom = _geom_prop(ft)
+        z = self._sfc.index(columns[geom + "__x"], columns[geom + "__y"], lenient=True)
+        return {"__key__": z}
+
+    def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
+        geoms = extract_geometries(f, _geom_prop(ft))
+        return IndexValues(geoms, disjoint=geoms.disjoint)
+
+    def get_ranges(
+        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+    ) -> List[ScanRange]:
+        if values.disjoint:
+            return []
+        ranges = self._sfc.ranges(_boxes(values), max_ranges=max_ranges)
+        return [ScanRange(0, r.lower, r.upper, r.contained) for r in ranges]
+
+
+class XZ2KeySpace(IndexKeySpace):
+    """Extent spatial index: key = XZ2 sequence code
+    (XZ2IndexKeySpace.scala:26+). Always requires a geometry post-filter."""
+
+    name = "xz2"
+
+    def supports(self, ft: FeatureType) -> bool:
+        geom = ft.default_geometry
+        return geom is not None and not ft.is_points
+
+    def sfc(self, ft: FeatureType) -> XZ2SFC:
+        return XZ2SFC.for_g(ft.xz_precision)
+
+    def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
+        geom = _geom_prop(ft)
+        col = columns[geom]
+        envs = np.array(
+            [
+                g.envelope.as_tuple() if g is not None else (0.0, 0.0, 0.0, 0.0)
+                for g in col
+            ],
+            dtype=np.float64,
+        ).reshape(-1, 4)
+        keys = self.sfc(ft).index(
+            envs[:, 0], envs[:, 1], envs[:, 2], envs[:, 3], lenient=True
+        )
+        return {"__key__": keys}
+
+    def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
+        geoms = extract_geometries(f, _geom_prop(ft))
+        return IndexValues(geoms, disjoint=geoms.disjoint)
+
+    def get_ranges(
+        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+    ) -> List[ScanRange]:
+        if values.disjoint:
+            return []
+        ranges = self.sfc(ft).ranges(_boxes(values), max_ranges=max_ranges)
+        return [ScanRange(0, r.lower, r.upper, r.contained) for r in ranges]
+
+
+class XZ3KeySpace(IndexKeySpace):
+    """Extent + time index (XZ3IndexKeySpace.scala:29+): key = (bin, xz3)."""
+
+    name = "xz3"
+
+    def supports(self, ft: FeatureType) -> bool:
+        geom = ft.default_geometry
+        return geom is not None and not ft.is_points and ft.default_date is not None
+
+    def sfc(self, ft: FeatureType) -> XZ3SFC:
+        return XZ3SFC.for_period(ft.xz_precision, ft.xz3_interval)
+
+    def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
+        geom = _geom_prop(ft)
+        dtg = ft.default_date.name
+        col = columns[geom]
+        envs = np.array(
+            [
+                g.envelope.as_tuple() if g is not None else (0.0, 0.0, 0.0, 0.0)
+                for g in col
+            ],
+            dtype=np.float64,
+        ).reshape(-1, 4)
+        bins, offsets = time_to_binned(columns[dtg], ft.xz3_interval, lenient=True)
+        off = offsets.astype(np.float64)
+        keys = self.sfc(ft).index(
+            envs[:, 0], envs[:, 1], off, envs[:, 2], envs[:, 3], off, lenient=True
+        )
+        return {"__bin__": bins, "__key__": keys}
+
+    def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
+        geom = _geom_prop(ft)
+        dtg = ft.default_date.name
+        geoms = extract_geometries(f, geom)
+        intervals = extract_intervals(f, dtg, handle_exclusive_bounds=True)
+        if geoms.disjoint or intervals.disjoint:
+            return IndexValues(geoms, intervals, disjoint=True)
+        bins = times_by_bin(intervals, ft.xz3_interval) if intervals.values else {}
+        return IndexValues(geoms, intervals, bins=bins)
+
+    def get_ranges(
+        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+    ) -> List[ScanRange]:
+        if values.disjoint:
+            return []
+        sfc = self.sfc(ft)
+        boxes = _boxes(values)
+        mo = max_offset(ft.xz3_interval)
+        out: List[ScanRange] = []
+        whole = [b for b, w in values.bins.items() if w == (0, mo)]
+        partial = {b: w for b, w in values.bins.items() if w != (0, mo)}
+        n_groups = (1 if whole else 0) + len(partial)
+        per_group = max(1, max_ranges // max(1, n_groups))
+        if whole:
+            queries = [(x0, y0, 0.0, x1, y1, float(mo)) for x0, y0, x1, y1 in boxes]
+            ranges = sfc.ranges(queries, max_ranges=per_group)
+            for b in sorted(whole):
+                out.extend(ScanRange(b, r.lower, r.upper, r.contained) for r in ranges)
+        for b, (lo, hi) in sorted(partial.items()):
+            queries = [
+                (x0, y0, float(lo), x1, y1, float(hi)) for x0, y0, x1, y1 in boxes
+            ]
+            ranges = sfc.ranges(queries, max_ranges=per_group)
+            out.extend(ScanRange(b, r.lower, r.upper, r.contained) for r in ranges)
+        return out
+
+
+class IdKeySpace(IndexKeySpace):
+    """Feature-id index (IdIndex, index/IdIndex.scala:24)."""
+
+    name = "id"
+
+    def supports(self, ft: FeatureType) -> bool:
+        return True
+
+    def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
+        return {"__key__": columns["__fid__"]}
+
+    def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
+        ids: List[str] = []
+        found = _extract_ids(f, ids)
+        return IndexValues(
+            FilterValues.empty(), ids=sorted(set(ids)) if found else None
+        )
+
+    def get_ranges(
+        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+    ) -> List[ScanRange]:
+        if values.ids is None:
+            return []
+        return [ScanRange(0, i, i, True) for i in values.ids]
+
+
+def _extract_ids(f: ast.Filter, out: List[str]) -> bool:
+    """Collect ids when the filter is satisfiable only by listed ids."""
+    if isinstance(f, ast.IdFilter):
+        out.extend(f.ids)
+        return True
+    if isinstance(f, ast.And):
+        return any(_extract_ids(c, out) for c in f.children())
+    if isinstance(f, ast.Or):
+        return all(_extract_ids(c, out) for c in f.children())
+    return False
+
+
+class AttributeKeySpace(IndexKeySpace):
+    """Attribute value index with lexicographic ordering
+    (AttributeIndex.scala:43-46; value lexicoding via Mango LexiTypeEncoders
+    in the reference -- here native value ordering on sorted columns)."""
+
+    name = "attr"
+
+    def __init__(self, attribute: str):
+        self.attribute = attribute
+        self.name = f"attr:{attribute}"
+
+    def supports(self, ft: FeatureType) -> bool:
+        return ft.has(self.attribute) and ft.attr(self.attribute).indexed
+
+    def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
+        col = columns[self.attribute]
+        # null attribute values are not indexed (the reference skips writing
+        # attribute-index rows for null values)
+        if col.dtype == object:
+            valid = np.array([v is not None for v in col], dtype=bool)
+        elif col.dtype.kind == "f":
+            valid = ~np.isnan(col)
+        else:
+            nulls = columns.get(self.attribute + "__null")
+            valid = ~nulls if nulls is not None else np.ones(len(col), dtype=bool)
+        return {"__key__": col, "__valid__": valid}
+
+    def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
+        bounds = _extract_attr_bounds(f, self.attribute, ft)
+        return IndexValues(
+            FilterValues.empty(),
+            attr_bounds=bounds.values if bounds.values else None,
+            disjoint=bounds.disjoint,
+        )
+
+    def get_ranges(
+        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+    ) -> List[ScanRange]:
+        if values.disjoint or not values.attr_bounds:
+            return []
+        out = []
+        for b in values.attr_bounds:
+            out.append(
+                ScanRange(
+                    0,
+                    b.lower.value,
+                    b.upper.value,
+                    True,
+                    b.lower.inclusive,
+                    b.upper.inclusive,
+                )
+            )
+        return out
+
+
+def _extract_attr_bounds(f: ast.Filter, attribute: str, ft: FeatureType) -> FilterValues:
+    """Value bounds for the attribute index: equality, ranges, IN lists,
+    LIKE prefixes (AttributeFilterStrategy semantics)."""
+    from geomesa_tpu.filter.bounds import Bound
+    from geomesa_tpu.filter.evaluate import _coerce
+
+    if isinstance(f, ast.And):
+        current: Optional[List[Bounds]] = None
+        for c in f.children():
+            child = _extract_attr_bounds(c, attribute, ft)
+            if child.disjoint:
+                return FilterValues.disjoint_values()
+            if child.is_empty:
+                continue
+            if current is None:
+                current = child.values
+            else:
+                nxt = []
+                for a in current:
+                    for b in child.values:
+                        inter = a.intersection(b)
+                        if inter is not None:
+                            nxt.append(inter)
+                if not nxt:
+                    return FilterValues.disjoint_values()
+                current = nxt
+        return FilterValues(current or [])
+    if isinstance(f, ast.Or):
+        out: List[Bounds] = []
+        for c in f.children():
+            child = _extract_attr_bounds(c, attribute, ft)
+            if child.is_empty and not child.disjoint:
+                return FilterValues.empty()
+            out.extend(child.values)
+        return FilterValues(out) if out else FilterValues.empty()
+    if isinstance(f, ast.Cmp) and f.prop == attribute:
+        v = _coerce(ft, attribute, f.literal)
+        if f.op == "=":
+            return FilterValues([Bounds(Bound(v, True), Bound(v, True))])
+        if f.op == "<":
+            return FilterValues([Bounds(Bound(None, True), Bound(v, False))])
+        if f.op == "<=":
+            return FilterValues([Bounds(Bound(None, True), Bound(v, True))])
+        if f.op == ">":
+            return FilterValues([Bounds(Bound(v, False), Bound(None, True))])
+        if f.op == ">=":
+            return FilterValues([Bounds(Bound(v, True), Bound(None, True))])
+        return FilterValues.empty()
+    if isinstance(f, ast.Between) and f.prop == attribute:
+        from geomesa_tpu.filter.bounds import Bound
+
+        lo = _coerce(ft, attribute, f.lo)
+        hi = _coerce(ft, attribute, f.hi)
+        return FilterValues([Bounds(Bound(lo, True), Bound(hi, True))])
+    if isinstance(f, ast.InList) and f.prop == attribute:
+        from geomesa_tpu.filter.bounds import Bound
+
+        out = []
+        for v in f.values:
+            cv = _coerce(ft, attribute, v)
+            out.append(Bounds(Bound(cv, True), Bound(cv, True)))
+        return FilterValues(out)
+    if isinstance(f, ast.Like) and f.prop == attribute and not f.case_insensitive:
+        from geomesa_tpu.filter.bounds import Bound
+
+        # prefix scans: 'abc%' -> [abc, abd)
+        pat = f.pattern
+        prefix = pat.split("%")[0].split("_")[0]
+        if prefix and pat.startswith(prefix):
+            hi = prefix[:-1] + chr(ord(prefix[-1]) + 1)
+            return FilterValues(
+                [Bounds(Bound(prefix, True), Bound(hi, False))], precise=False
+            )
+        return FilterValues.empty()
+    return FilterValues.empty()
+
+
+ALL_INDICES = ("z3", "z2", "xz3", "xz2", "id", "attr")
+
+
+def default_indices(ft: FeatureType) -> List[IndexKeySpace]:
+    """The indices enabled for a schema: explicit ``geomesa.indices`` user
+    data, else defaults per geometry/date availability (the reference's
+    GeoMesaIndexManager.setIndices)."""
+    enabled = ft.enabled_indices
+    out: List[IndexKeySpace] = []
+    candidates: List[IndexKeySpace] = [
+        Z3KeySpace(),
+        XZ3KeySpace(),
+        Z2KeySpace(),
+        XZ2KeySpace(),
+        IdKeySpace(),
+    ]
+    for a in ft.attributes:
+        if a.indexed and not a.type.is_geometry:
+            candidates.append(AttributeKeySpace(a.name))
+    for ks in candidates:
+        base = ks.name.split(":")[0]
+        if enabled is not None and base not in enabled:
+            continue
+        if ks.supports(ft):
+            out.append(ks)
+    return out

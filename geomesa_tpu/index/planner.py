@@ -1,0 +1,192 @@
+"""Query planning: hints, strategy choice, plan assembly, explain traces.
+
+Rebuild of the reference's QueryPlanner/QueryRunner/StrategyDecider
+(geomesa-index-api .../planning/QueryPlanner.scala:43-286,
+StrategyDecider.scala:47-144) with the Explainer's indented trace
+(.../utils/Explainer.scala:16).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.parser import parse_cql, to_cql
+from geomesa_tpu.filter.rewrite import simplify
+from geomesa_tpu.index.keyspace import (
+    IndexKeySpace,
+    IndexValues,
+    ScanRange,
+    SCAN_RANGES_TARGET,
+)
+from geomesa_tpu.index.strategy import FilterStrategy, get_filter_strategies
+from geomesa_tpu.schema.featuretype import FeatureType
+
+
+class Explainer:
+    """Indented plan trace (Explainer.scala:16-40)."""
+
+    def __init__(self, sink: Optional[Callable[[str], None]] = None):
+        self._lines: List[str] = []
+        self._depth = 0
+        self._sink = sink
+
+    def __call__(self, msg: str) -> "Explainer":
+        line = "  " * self._depth + msg
+        self._lines.append(line)
+        if self._sink:
+            self._sink(line)
+        return self
+
+    def push(self, msg: Optional[str] = None) -> "Explainer":
+        if msg:
+            self(msg)
+        self._depth += 1
+        return self
+
+    def pop(self) -> "Explainer":
+        self._depth = max(0, self._depth - 1)
+        return self
+
+    @property
+    def output(self) -> str:
+        return "\n".join(self._lines)
+
+
+@dataclass
+class Query:
+    """A query: CQL filter + hints (the reference's GeoTools Query + Hints).
+
+    Supported hints mirror conf/QueryHints.scala: projection/transforms,
+    sort, max_features, sampling, loose_bbox, plus aggregation hints
+    (density/stats/bin/arrow) consumed by the datastore executors.
+    """
+
+    filter: ast.Filter = field(default_factory=lambda: ast.INCLUDE)
+    properties: Optional[List[str]] = None  # projection; None = all
+    sort_by: Optional[List[tuple]] = None  # [(attr, ascending)]
+    max_features: Optional[int] = None
+    hints: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def cql(cls, text: str, **kwargs) -> "Query":
+        return cls(filter=parse_cql(text), **kwargs)
+
+
+@dataclass
+class QueryPlan:
+    """An executable plan (the reference's QueryPlan.scala:27)."""
+
+    ft: FeatureType
+    index: IndexKeySpace
+    ranges: List[ScanRange]
+    values: IndexValues
+    # the filter the scan ranges already guarantee (loose cover)
+    primary: Optional[ast.Filter]
+    # residual filter that must run post-scan
+    secondary: Optional[ast.Filter]
+    # the exact full filter (for result parity the executor may choose to
+    # evaluate this instead of primary/secondary split)
+    full_filter: Optional[ast.Filter]
+    cost: float
+    explain: str = ""
+
+    @property
+    def is_empty(self) -> bool:
+        return isinstance(self.primary, ast.Exclude)
+
+    @property
+    def post_filter(self) -> Optional[ast.Filter]:
+        """What the executor must still evaluate. Contained-only range sets
+        with a precise extraction could skip the primary; we stay exact by
+        keeping the full filter unless ranges are fully covering."""
+        return self.full_filter
+
+
+class QueryPlanner:
+    """Plans queries for one feature type over its enabled indices."""
+
+    def __init__(self, ft: FeatureType, indices: Sequence[IndexKeySpace]):
+        self.ft = ft
+        self.indices = list(indices)
+
+    def plan(
+        self,
+        query: Query,
+        explain: Optional[Explainer] = None,
+        max_ranges: int = SCAN_RANGES_TARGET,
+    ) -> QueryPlan:
+        explain = explain or Explainer()
+        f = simplify(query.filter)
+        explain.push(f"Planning query for type '{self.ft.name}'")
+        explain(f"Filter: {to_cql(f)}")
+        explain(f"Indices available: {[i.name for i in self.indices]}")
+
+        strategies = get_filter_strategies(self.ft, self.indices, f)
+        explain.push(f"Strategy options: {len(strategies)}")
+        for s in strategies:
+            explain(
+                f"{s.index.name}: primary={to_cql(s.primary) if s.primary else 'None'} "
+                f"secondary={to_cql(s.secondary) if s.secondary else 'None'} "
+                f"cost={s.cost:g}"
+            )
+        explain.pop()
+
+        best = min(strategies, key=lambda s: s.cost)
+        explain(f"Chosen strategy: {best.index.name} (cost {best.cost:g})")
+
+        if isinstance(best.primary, ast.Exclude):
+            explain("Filter is provably empty -> empty plan")
+            explain.pop()
+            return QueryPlan(
+                ft=self.ft,
+                index=best.index,
+                ranges=[],
+                values=best.values,
+                primary=ast.EXCLUDE,
+                secondary=None,
+                full_filter=None,
+                cost=0.0,
+                explain=explain.output,
+            )
+
+        if best.primary is None and best.cost >= 1e9:
+            explain("Full table scan (no index applies)")
+            ranges: List[ScanRange] = []
+        else:
+            ranges = best.index.get_ranges(self.ft, best.values, max_ranges)
+        explain(f"Ranges: {len(ranges)}")
+
+        full = None if isinstance(f, ast.Include) else f
+        # attr/id equality ranges are exact in value space, so contained
+        # ranges with no residual answer the query outright. Z/XZ ranges are
+        # exact only in *normalized* space -- curve cells at box edges can
+        # admit raw doubles just outside the query box -- so those always
+        # keep the filter unless the user opts into loose-bbox semantics
+        # (Z2Index.scala:26-40 loose-bbox decision).
+        all_contained = bool(ranges) and all(r.contained for r in ranges)
+        exact_value_space = best.index.name == "id" or best.index.name.startswith(
+            "attr"
+        )
+        precise = (
+            best.values.geometries.precise
+            if best.values.geometries is not None
+            else True
+        ) and (best.values.intervals.precise if best.values.intervals else True)
+        if all_contained and precise and best.secondary is None and exact_value_space:
+            full = None
+            explain("Ranges are fully covering -> no post-filter")
+        explain.pop()
+
+        return QueryPlan(
+            ft=self.ft,
+            index=best.index,
+            ranges=ranges,
+            values=best.values,
+            primary=best.primary,
+            secondary=best.secondary,
+            full_filter=full,
+            cost=best.cost,
+            explain=explain.output,
+        )

@@ -24,11 +24,10 @@ def _to_millis(v) -> int:
             v = v.replace(tzinfo=datetime.timezone.utc)
         return int(v.timestamp() * 1000)
     if isinstance(v, str):
-        s = v.strip().replace("Z", "+00:00")
-        dt = datetime.datetime.fromisoformat(s)
-        if dt.tzinfo is None:
-            dt = dt.replace(tzinfo=datetime.timezone.utc)
-        return int(dt.timestamp() * 1000)
+        # same parser as filter literals so ingest and queries agree
+        from geomesa_tpu.filter.parser import parse_instant_ms
+
+        return parse_instant_ms(v)
     raise TypeError(f"Cannot convert {v!r} to a date")
 
 

@@ -1,0 +1,26 @@
+"""Storage: columnar feature blocks + datastores.
+
+The TPU-first replacement for the reference's KV-row storage backends
+(SURVEY.md section 7 architecture sketch): features live as struct-of-arrays
+columnar blocks sorted by index key, with per-bin slices and key stats for
+block pruning. ``TpuDataStore`` is the GeoMesaDataStore analog;
+``MemoryDataStore`` is the brute-force reference backend used for parity
+testing (the TestGeoMesaDataStore analog, SURVEY.md section 4).
+"""
+
+from geomesa_tpu.store.blocks import ColumnBuffer, FeatureBlock, IndexTable, columns_from_features
+from geomesa_tpu.store.datastore import TpuDataStore, QueryResult
+from geomesa_tpu.store.memory import MemoryDataStore
+from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
+
+__all__ = [
+    "ColumnBuffer",
+    "FeatureBlock",
+    "IndexTable",
+    "columns_from_features",
+    "TpuDataStore",
+    "QueryResult",
+    "MemoryDataStore",
+    "InMemoryMetadata",
+    "Metadata",
+]

@@ -1,0 +1,270 @@
+"""Columnar feature blocks: struct-of-arrays storage sorted by index key.
+
+The TPU-native replacement for the reference's KV rows + Kryo values
+(SURVEY.md section 7): each index keeps sealed immutable blocks whose columns
+are numpy arrays row-aligned with sorted key columns. Binned indices (z3/xz3)
+record per-bin row slices so a scan touches only matching bins; every block
+carries key min/max for whole-block pruning. Blocks are the unit shipped to
+device memory by the TPU executor (geomesa_tpu.ops).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.geom.base import Geometry, Point
+from geomesa_tpu.index.keyspace import IndexKeySpace, ScanRange
+from geomesa_tpu.schema.feature import Feature
+from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
+
+Columns = Dict[str, np.ndarray]
+
+
+def columns_from_features(ft: FeatureType, features: Sequence[Feature]) -> Columns:
+    """Row features -> columnar arrays per the evaluate.py conventions."""
+    n = len(features)
+    out: Columns = {}
+    out["__fid__"] = np.array([f.fid for f in features], dtype=object)
+    for idx, attr in enumerate(ft.attributes):
+        vals = [f.values[idx] for f in features]
+        if attr.type == AttributeType.POINT:
+            x = np.full(n, np.nan)
+            y = np.full(n, np.nan)
+            for i, v in enumerate(vals):
+                if v is not None:
+                    x[i] = v.x
+                    y[i] = v.y
+            out[attr.name + "__x"] = x
+            out[attr.name + "__y"] = y
+        elif attr.type.is_geometry:
+            out[attr.name] = np.array(vals, dtype=object)
+        else:
+            dtype = attr.type.numpy_dtype
+            if dtype is None:
+                out[attr.name] = np.array(vals, dtype=object)
+            else:
+                col = np.zeros(n, dtype=dtype)
+                nulls = np.zeros(n, dtype=bool)
+                for i, v in enumerate(vals):
+                    if v is None:
+                        nulls[i] = True
+                    else:
+                        col[i] = v
+                out[attr.name] = col
+                if nulls.any():
+                    out[attr.name + "__null"] = nulls
+    return out
+
+
+def take_rows(columns: Columns, rows: np.ndarray) -> Columns:
+    return {k: v[rows] for k, v in columns.items()}
+
+
+def concat_columns(parts: Sequence[Columns]) -> Columns:
+    if not parts:
+        return {}
+    keys = set()
+    for p in parts:
+        keys.update(p.keys())
+    out: Columns = {}
+    n_parts = [len(next(iter(p.values()))) if p else 0 for p in parts]
+    for k in keys:
+        arrs = []
+        for p, n in zip(parts, n_parts):
+            if k in p:
+                arrs.append(p[k])
+            else:
+                # missing null-mask columns mean "no nulls in this part"
+                if k.endswith("__null"):
+                    arrs.append(np.zeros(n, dtype=bool))
+                else:
+                    raise KeyError(f"Column {k} missing from a part")
+        out[k] = np.concatenate(arrs)
+    return out
+
+
+class ColumnBuffer:
+    """Mutable ingest buffer; seals into a FeatureBlock."""
+
+    def __init__(self, ft: FeatureType):
+        self.ft = ft
+        self.features: List[Feature] = []
+
+    def append(self, feature: Feature):
+        self.features.append(feature)
+
+    def __len__(self):
+        return len(self.features)
+
+    def to_columns(self) -> Columns:
+        return columns_from_features(self.ft, self.features)
+
+    def clear(self):
+        self.features = []
+
+
+class FeatureBlock:
+    """One sealed, key-sorted block of features for one index."""
+
+    def __init__(
+        self,
+        index: IndexKeySpace,
+        columns: Columns,
+        key: np.ndarray,
+        bins: Optional[np.ndarray],
+    ):
+        self.index = index
+        self.columns = columns
+        self.key = key
+        self.bins = bins
+        self.n = len(key)
+        # per-bin row slices (contiguous after the sort)
+        self.bin_slices: Dict[int, Tuple[int, int]] = {}
+        if bins is not None:
+            uniq, starts = np.unique(bins, return_index=True)
+            bounds = list(starts) + [self.n]
+            for b, s, e in zip(uniq, bounds[:-1], bounds[1:]):
+                self.bin_slices[int(b)] = (int(s), int(e))
+        self.key_min = key[0] if self.n else None
+        self.key_max = key[-1] if self.n else None
+
+    @classmethod
+    def build(cls, index: IndexKeySpace, ft: FeatureType, columns: Columns) -> "FeatureBlock":
+        key_cols = index.key_columns(ft, columns)
+        key = key_cols["__key__"]
+        bins = key_cols.get("__bin__")
+        valid = key_cols.get("__valid__")
+        if valid is not None and not valid.all():
+            rows = np.where(valid)[0]
+            columns = take_rows(columns, rows)
+            key = key[rows]
+            if bins is not None:
+                bins = bins[rows]
+        if bins is not None:
+            order = np.lexsort((key, bins))
+            bins = bins[order]
+        else:
+            order = np.argsort(key, kind="stable")
+        key = key[order]
+        sorted_cols = take_rows(columns, order)
+        return cls(index, sorted_cols, key, bins)
+
+    def scan(self, ranges: Sequence[ScanRange]) -> np.ndarray:
+        """Row indices whose keys fall in any range (sorted, deduped)."""
+        if self.n == 0 or not ranges:
+            return np.empty(0, dtype=np.int64)
+        pieces: List[np.ndarray] = []
+        if self.bins is not None:
+            by_bin: Dict[int, List[ScanRange]] = {}
+            for r in ranges:
+                by_bin.setdefault(r.bin, []).append(r)
+            for b, rs in by_bin.items():
+                if b not in self.bin_slices:
+                    continue
+                s, e = self.bin_slices[b]
+                pieces.extend(self._scan_slice(s, e, rs))
+        else:
+            pieces.extend(self._scan_slice(0, self.n, ranges))
+        if not pieces:
+            return np.empty(0, dtype=np.int64)
+        rows = np.concatenate(pieces)
+        return np.unique(rows)
+
+    def _scan_slice(
+        self, s: int, e: int, ranges: Sequence[ScanRange]
+    ) -> List[np.ndarray]:
+        sub = self.key[s:e]
+        out = []
+        numeric = sub.dtype != object
+        if numeric and all(
+            r.lower is not None
+            and r.upper is not None
+            and r.lower_inclusive
+            and r.upper_inclusive
+            for r in ranges
+        ):
+            los = np.asarray([r.lower for r in ranges], dtype=sub.dtype)
+            his = np.asarray([r.upper for r in ranges], dtype=sub.dtype)
+            starts = np.searchsorted(sub, los, side="left") + s
+            ends = np.searchsorted(sub, his, side="right") + s
+            for st, en in zip(starts, ends):
+                if en > st:
+                    out.append(np.arange(st, en, dtype=np.int64))
+            return out
+        for r in ranges:
+            if r.lower is None:
+                st = s
+            else:
+                side = "left" if r.lower_inclusive else "right"
+                st = int(np.searchsorted(sub, r.lower, side=side)) + s
+            if r.upper is None:
+                en = e
+            else:
+                side = "right" if r.upper_inclusive else "left"
+                en = int(np.searchsorted(sub, r.upper, side=side)) + s
+            if en > st:
+                out.append(np.arange(st, en, dtype=np.int64))
+        return out
+
+
+class IndexTable:
+    """All sealed blocks for one index of one feature type.
+
+    The analog of a reference index table: writes land in sealed sorted
+    blocks (one per flush); scans prune by bin slice + key stats and
+    searchsorted into each block. Deletes are fid tombstones applied at
+    scan time (compaction folds them in).
+    """
+
+    def __init__(self, index: IndexKeySpace, ft: FeatureType):
+        self.index = index
+        self.ft = ft
+        self.blocks: List[FeatureBlock] = []
+        self.tombstones: set = set()
+
+    @property
+    def num_rows(self) -> int:
+        return sum(b.n for b in self.blocks)
+
+    def insert(self, columns: Columns):
+        if not columns or len(next(iter(columns.values()))) == 0:
+            return
+        self.blocks.append(FeatureBlock.build(self.index, self.ft, columns))
+
+    def delete(self, fids: Sequence[str]):
+        self.tombstones.update(fids)
+
+    def scan(self, ranges: Sequence[ScanRange]) -> Iterator[Tuple[FeatureBlock, np.ndarray]]:
+        for b in self.blocks:
+            rows = b.scan(ranges)
+            rows = self._strip_tombstones(b, rows)
+            if len(rows):
+                yield b, rows
+
+    def scan_all(self) -> Iterator[Tuple[FeatureBlock, np.ndarray]]:
+        for b in self.blocks:
+            rows = self._strip_tombstones(b, np.arange(b.n, dtype=np.int64))
+            if len(rows):
+                yield b, rows
+
+    def _strip_tombstones(self, b: FeatureBlock, rows: np.ndarray) -> np.ndarray:
+        if not self.tombstones or not len(rows):
+            return rows
+        fids = b.columns["__fid__"][rows]
+        keep = np.array([f not in self.tombstones for f in fids], dtype=bool)
+        return rows[keep]
+
+    def compact(self):
+        """Merge all blocks into one (dropping tombstoned rows)."""
+        if len(self.blocks) <= 1 and not self.tombstones:
+            return
+        parts = []
+        for b, rows in self.scan_all():
+            parts.append(take_rows(b.columns, rows))
+        merged = concat_columns(parts)
+        self.blocks = []
+        self.tombstones = set()
+        if merged:
+            self.insert(merged)

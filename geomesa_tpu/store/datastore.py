@@ -1,0 +1,320 @@
+"""TpuDataStore: the GeoMesaDataStore analog.
+
+Schema CRUD + writers + query execution over columnar index tables
+(reference: geomesa-index-api .../geotools/MetadataBackedDataStore.scala:39,
+GeoMesaDataStore.scala:39, GeoMesaFeatureWriter.scala:34-259,
+QueryPlanner.runQuery planning/QueryPlanner.scala:74-99).
+
+Execution pipeline per query: plan -> scan ranges over blocks -> candidate
+rows -> post-filter (host numpy by default; the TPU executor in
+geomesa_tpu.parallel offloads point indices to device) -> dedupe -> sort ->
+projection/limits -> aggregation reducers (density/stats/bin) when hinted.
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from geomesa_tpu.filter import ast, evaluate
+from geomesa_tpu.filter.parser import parse_cql
+from geomesa_tpu.index.keyspace import IndexKeySpace, default_indices
+from geomesa_tpu.index.planner import Explainer, Query, QueryPlan, QueryPlanner
+from geomesa_tpu.schema.feature import Feature
+from geomesa_tpu.schema.featuretype import AttributeType, FeatureType, parse_spec
+from geomesa_tpu.store.blocks import (
+    ColumnBuffer,
+    Columns,
+    IndexTable,
+    concat_columns,
+    take_rows,
+)
+from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
+
+DEFAULT_FLUSH_SIZE = 100_000
+
+
+class QueryResult:
+    """Columnar query result with row-feature accessors."""
+
+    def __init__(self, ft: FeatureType, columns: Columns, plan: Optional[QueryPlan] = None):
+        self.ft = ft
+        self.columns = columns
+        self.plan = plan
+
+    def __len__(self):
+        for v in self.columns.values():
+            return len(v)
+        return 0
+
+    @property
+    def fids(self) -> np.ndarray:
+        return self.columns.get("__fid__", np.empty(0, dtype=object))
+
+    def to_features(self) -> List[Feature]:
+        out = []
+        n = len(self)
+        for i in range(n):
+            values = []
+            for a in self.ft.attributes:
+                if a.type == AttributeType.POINT:
+                    x = self.columns[a.name + "__x"][i]
+                    y = self.columns[a.name + "__y"][i]
+                    if np.isnan(x):
+                        values.append(None)
+                    else:
+                        from geomesa_tpu.geom.base import Point
+
+                        values.append(Point(float(x), float(y)))
+                elif a.name in self.columns:
+                    v = self.columns[a.name][i]
+                    nulls = self.columns.get(a.name + "__null")
+                    if nulls is not None and nulls[i]:
+                        values.append(None)
+                    elif v is None:
+                        values.append(None)
+                    else:
+                        values.append(v.item() if isinstance(v, np.generic) else v)
+                else:
+                    values.append(None)
+            out.append(Feature(self.ft, str(self.fids[i]), values))
+        return out
+
+
+class FeatureWriter:
+    """Buffered appender; flush seals one block per index
+    (GeoMesaFeatureWriter analog -- fid generation mirrors Z3FeatureIdGenerator's
+    uuid fallback)."""
+
+    def __init__(self, store: "TpuDataStore", ft: FeatureType, flush_size: int):
+        self.store = store
+        self.ft = ft
+        self.buffer = ColumnBuffer(ft)
+        self.flush_size = flush_size
+
+    def write(self, values: Sequence[Any], fid: Optional[str] = None) -> str:
+        fid = fid if fid is not None else str(uuid.uuid4())
+        self.buffer.append(Feature(self.ft, fid, values))
+        if len(self.buffer) >= self.flush_size:
+            self.flush()
+        return fid
+
+    def write_feature(self, feature: Feature) -> str:
+        if feature.fid is None:
+            feature = Feature(self.ft, str(uuid.uuid4()), feature.values)
+        self.buffer.append(feature)
+        if len(self.buffer) >= self.flush_size:
+            self.flush()
+        return feature.fid
+
+    def write_columns(self, columns: Columns):
+        """Bulk columnar ingest (the fast path: no row objects)."""
+        self.flush()
+        self.store._insert_columns(self.ft, columns)
+
+    def flush(self):
+        if len(self.buffer):
+            self.store._insert_columns(self.ft, self.buffer.to_columns())
+            self.buffer.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.flush()
+        return False
+
+
+class TpuDataStore:
+    """The datastore facade: create_schema / writer / query / delete."""
+
+    def __init__(
+        self,
+        metadata: Optional[Metadata] = None,
+        executor: Optional["ScanExecutor"] = None,
+        flush_size: int = DEFAULT_FLUSH_SIZE,
+    ):
+        self.metadata = metadata or InMemoryMetadata()
+        self.executor = executor or HostScanExecutor()
+        self.flush_size = flush_size
+        self._schemas: Dict[str, FeatureType] = {}
+        self._indices: Dict[str, List[IndexKeySpace]] = {}
+        self._tables: Dict[str, Dict[str, IndexTable]] = {}
+        # recover schemas from persistent metadata
+        for name in self.metadata.scan_types():
+            spec = self.metadata.read(name, "attributes")
+            if spec:
+                self._register(parse_spec(name, spec))
+
+    # -- schema CRUD --------------------------------------------------------
+
+    def create_schema(self, ft: FeatureType) -> None:
+        if ft.name in self._schemas:
+            existing = self._schemas[ft.name]
+            if existing != ft:
+                raise ValueError(f"Schema {ft.name} already exists with different spec")
+            return
+        if ft.default_geometry is None:
+            raise ValueError("Schema requires a geometry attribute")
+        self.metadata.insert(ft.name, "attributes", ft.spec())
+        self._register(ft)
+
+    def _register(self, ft: FeatureType) -> None:
+        self._schemas[ft.name] = ft
+        indices = default_indices(ft)
+        if not indices:
+            raise ValueError(f"No indices support schema {ft.name}")
+        self._indices[ft.name] = indices
+        self._tables[ft.name] = {i.name: IndexTable(i, ft) for i in indices}
+
+    def get_schema(self, name: str) -> FeatureType:
+        if name not in self._schemas:
+            raise KeyError(f"Unknown feature type: {name}")
+        return self._schemas[name]
+
+    @property
+    def type_names(self) -> List[str]:
+        return sorted(self._schemas.keys())
+
+    def delete_schema(self, name: str) -> None:
+        self.get_schema(name)
+        self.metadata.delete(name)
+        del self._schemas[name], self._indices[name], self._tables[name]
+
+    # -- writes -------------------------------------------------------------
+
+    def writer(self, name: str, flush_size: Optional[int] = None) -> FeatureWriter:
+        return FeatureWriter(self, self.get_schema(name), flush_size or self.flush_size)
+
+    def _insert_columns(self, ft: FeatureType, columns: Columns):
+        for table in self._tables[ft.name].values():
+            table.insert(columns)
+
+    def delete_features(self, name: str, fids: Sequence[str]):
+        for table in self._tables[name].values():
+            table.delete(fids)
+
+    def compact(self, name: str):
+        for table in self._tables[name].values():
+            table.compact()
+
+    def count(self, name: str) -> int:
+        tables = self._tables[name]
+        first = next(iter(tables.values()))
+        n = first.num_rows
+        if first.tombstones:
+            n -= sum(1 for _ in first.tombstones)
+        return n
+
+    # -- queries ------------------------------------------------------------
+
+    def planner(self, name: str) -> QueryPlanner:
+        return QueryPlanner(self.get_schema(name), self._indices[name])
+
+    def explain(self, name: str, query: Union[str, Query]) -> str:
+        query = self._as_query(query)
+        plan = self.planner(name).plan(query)
+        return plan.explain
+
+    def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
+        ft = self.get_schema(name)
+        query = self._as_query(query)
+        plan = self.planner(name).plan(query)
+        if plan.is_empty:
+            return QueryResult(ft, _empty_columns(ft), plan)
+
+        tables = self._tables[name]
+        table = tables[plan.index.name]
+        parts: List[Columns] = []
+        if plan.ranges:
+            scan = table.scan(plan.ranges)
+        else:
+            scan = table.scan_all()
+        for block, rows in scan:
+            mask_cols = take_rows(block.columns, rows)
+            if plan.post_filter is not None:
+                mask = self.executor.post_filter(ft, plan, mask_cols)
+                if not mask.all():
+                    mask_cols = take_rows(mask_cols, np.where(mask)[0])
+            if len(next(iter(mask_cols.values()), [])):
+                parts.append(mask_cols)
+        columns = concat_columns(parts) if parts else _empty_columns(ft)
+        columns = _dedupe_by_fid(columns)
+        columns = _apply_query_options(ft, query, columns)
+        return QueryResult(ft, columns, plan)
+
+    def _as_query(self, query: Union[str, Query]) -> Query:
+        if isinstance(query, Query):
+            return query
+        return Query.cql(query)
+
+
+class ScanExecutor:
+    """Pluggable post-filter execution (host numpy vs TPU kernels)."""
+
+    def post_filter(self, ft: FeatureType, plan: QueryPlan, columns: Columns) -> np.ndarray:
+        raise NotImplementedError
+
+
+class HostScanExecutor(ScanExecutor):
+    def post_filter(self, ft: FeatureType, plan: QueryPlan, columns: Columns) -> np.ndarray:
+        return evaluate(plan.post_filter, ft, columns)
+
+
+def _empty_columns(ft: FeatureType) -> Columns:
+    cols: Columns = {"__fid__": np.empty(0, dtype=object)}
+    for a in ft.attributes:
+        if a.type == AttributeType.POINT:
+            cols[a.name + "__x"] = np.empty(0)
+            cols[a.name + "__y"] = np.empty(0)
+        elif a.type.is_geometry:
+            cols[a.name] = np.empty(0, dtype=object)
+        else:
+            dtype = a.type.numpy_dtype
+            cols[a.name] = np.empty(0, dtype=dtype if dtype is not None else object)
+    return cols
+
+
+def _dedupe_by_fid(columns: Columns) -> Columns:
+    fids = columns.get("__fid__")
+    if fids is None or len(fids) == 0:
+        return columns
+    _, first_idx = np.unique(fids.astype(str), return_index=True)
+    if len(first_idx) == len(fids):
+        return columns
+    return take_rows(columns, np.sort(first_idx))
+
+
+def _apply_query_options(ft: FeatureType, query: Query, columns: Columns) -> Columns:
+    n = len(next(iter(columns.values()), []))
+    if query.sort_by and n:
+        keys = []
+        for attr, ascending in reversed(query.sort_by):
+            col = columns[attr] if attr in columns else columns[attr + "__x"]
+            keys.append(col if ascending else _invert_order(col))
+        order = np.lexsort(keys)
+        columns = take_rows(columns, order)
+    if query.max_features is not None and n > query.max_features:
+        columns = {k: v[: query.max_features] for k, v in columns.items()}
+    if query.properties is not None:
+        keep = {"__fid__"}
+        for p in query.properties:
+            keep.add(p)
+            keep.add(p + "__x")
+            keep.add(p + "__y")
+            keep.add(p + "__null")
+        columns = {k: v for k, v in columns.items() if k in keep}
+    return columns
+
+
+def _invert_order(col: np.ndarray) -> np.ndarray:
+    if col.dtype == object:
+        # rank-invert for objects
+        order = np.argsort(col, kind="stable")
+        ranks = np.empty(len(col), dtype=np.int64)
+        ranks[order] = np.arange(len(col))
+        return -ranks
+    return -col

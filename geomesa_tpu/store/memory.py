@@ -1,0 +1,72 @@
+"""MemoryDataStore: brute-force in-memory reference backend.
+
+The parity oracle (TestGeoMesaDataStore analog, SURVEY.md section 4) and the
+CPU baseline for benchmarks (standing in for the reference's CQEngine
+datastore, geomesa-memory .../GeoCQEngine.scala:34-90): no index, every query
+evaluates the filter over all columns with the exact numpy evaluator.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from geomesa_tpu.filter import ast, evaluate
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.feature import Feature
+from geomesa_tpu.schema.featuretype import FeatureType
+from geomesa_tpu.store.blocks import Columns, columns_from_features, concat_columns, take_rows
+from geomesa_tpu.store.datastore import QueryResult, _apply_query_options, _empty_columns
+
+
+class MemoryDataStore:
+    def __init__(self):
+        self._schemas: Dict[str, FeatureType] = {}
+        self._columns: Dict[str, List[Columns]] = {}
+
+    def create_schema(self, ft: FeatureType) -> None:
+        self._schemas[ft.name] = ft
+        self._columns.setdefault(ft.name, [])
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._schemas[name]
+
+    @property
+    def type_names(self) -> List[str]:
+        return sorted(self._schemas.keys())
+
+    def write(self, name: str, values: Sequence[Any], fid: Optional[str] = None) -> str:
+        fid = fid if fid is not None else str(uuid.uuid4())
+        ft = self._schemas[name]
+        self._columns[name].append(
+            columns_from_features(ft, [Feature(ft, fid, values)])
+        )
+        return fid
+
+    def write_features(self, name: str, features: Sequence[Feature]):
+        ft = self._schemas[name]
+        self._columns[name].append(columns_from_features(ft, features))
+
+    def write_columns(self, name: str, columns: Columns):
+        self._columns[name].append(columns)
+
+    def count(self, name: str) -> int:
+        return sum(len(next(iter(c.values()))) for c in self._columns[name] if c)
+
+    def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
+        ft = self._schemas[name]
+        if isinstance(query, str):
+            query = Query.cql(query)
+        parts = self._columns[name]
+        if not parts:
+            return QueryResult(ft, _empty_columns(ft))
+        columns = concat_columns(parts) if len(parts) > 1 else parts[0]
+        # keep a single concatenated copy for repeat queries
+        self._columns[name] = [columns]
+        if not isinstance(query.filter, ast.Include):
+            mask = evaluate(query.filter, ft, columns)
+            columns = take_rows(columns, np.where(mask)[0])
+        columns = _apply_query_options(ft, query, columns)
+        return QueryResult(ft, columns)

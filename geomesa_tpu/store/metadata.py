@@ -1,0 +1,101 @@
+"""Metadata KV: schema registry persistence.
+
+Rebuild of the reference's GeoMesaMetadata
+(geomesa-index-api .../metadata/GeoMesaMetadata.scala:17-100) with in-memory
+and JSON-file backends (the analog of InMemoryMetadata and the
+catalog-table/ZK backends).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+
+class Metadata:
+    """String KV scoped by (type_name, key)."""
+
+    def read(self, type_name: str, key: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def insert(self, type_name: str, key: str, value: str) -> None:
+        raise NotImplementedError
+
+    def remove(self, type_name: str, key: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, type_name: str) -> None:
+        raise NotImplementedError
+
+    def scan_types(self) -> List[str]:
+        raise NotImplementedError
+
+
+class InMemoryMetadata(Metadata):
+    def __init__(self):
+        self._data: Dict[str, Dict[str, str]] = {}
+        self._lock = threading.Lock()
+
+    def read(self, type_name, key):
+        with self._lock:
+            return self._data.get(type_name, {}).get(key)
+
+    def insert(self, type_name, key, value):
+        with self._lock:
+            self._data.setdefault(type_name, {})[key] = value
+
+    def remove(self, type_name, key):
+        with self._lock:
+            self._data.get(type_name, {}).pop(key, None)
+
+    def delete(self, type_name):
+        with self._lock:
+            self._data.pop(type_name, None)
+
+    def scan_types(self):
+        with self._lock:
+            return sorted(self._data.keys())
+
+
+class FileMetadata(Metadata):
+    """JSON-file backed metadata (single-writer; the TPU design keeps schema
+    mutation single-controller, SURVEY.md section 5 race-detection notes)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, str]] = {}
+        if os.path.exists(path):
+            with open(path) as fh:
+                self._data = json.load(fh)
+
+    def _flush(self):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._data, fh, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)
+
+    def read(self, type_name, key):
+        with self._lock:
+            return self._data.get(type_name, {}).get(key)
+
+    def insert(self, type_name, key, value):
+        with self._lock:
+            self._data.setdefault(type_name, {})[key] = value
+            self._flush()
+
+    def remove(self, type_name, key):
+        with self._lock:
+            self._data.get(type_name, {}).pop(key, None)
+            self._flush()
+
+    def delete(self, type_name):
+        with self._lock:
+            self._data.pop(type_name, None)
+            self._flush()
+
+    def scan_types(self):
+        with self._lock:
+            return sorted(self._data.keys())

@@ -1,0 +1,263 @@
+"""Datastore tests: the end-to-end slice with result-set parity.
+
+Mirrors the reference's key pattern (SURVEY.md section 4): an in-memory
+brute-force reference backend (MemoryDataStore) exercises the same queries as
+the indexed TpuDataStore and result sets must match exactly.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.parser import parse_instant_ms
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema import Feature, parse_spec
+from geomesa_tpu.store import MemoryDataStore, TpuDataStore
+
+SPEC = (
+    "actor1:String:index=true,n_articles:Int,dtg:Date,*geom:Point:srid=4326;"
+    "geomesa.z3.interval=week"
+)
+
+
+def make_stores(n=5000, seed=0, flushes=3):
+    """Both stores loaded with identical GDELT-like data."""
+    ft = parse_spec("gdelt", SPEC)
+    tpu = TpuDataStore()
+    mem = MemoryDataStore()
+    tpu.create_schema(ft)
+    mem.create_schema(ft)
+    rs = np.random.RandomState(seed)
+    t0 = parse_instant_ms("2017-01-01T00:00:00Z")
+    t1 = parse_instant_ms("2017-03-01T00:00:00Z")
+    features = []
+    for i in range(n):
+        f = Feature(
+            ft,
+            f"f{i:06d}",
+            [
+                rs.choice(["USA", "CHN", "RUS", "FRA", None]),
+                int(rs.randint(1, 100)),
+                int(rs.randint(t0, t1)),
+                Point(rs.uniform(-180, 180), rs.uniform(-90, 90)),
+            ],
+        )
+        features.append(f)
+    # write in several flushes to get multiple blocks
+    with tpu.writer("gdelt") as w:
+        for i, f in enumerate(features):
+            w.write_feature(f)
+            if (i + 1) % (n // flushes) == 0:
+                w.flush()
+    mem.write_features("gdelt", features)
+    return ft, tpu, mem
+
+
+FT, TPU, MEM = make_stores()
+
+QUERIES = [
+    "BBOX(geom, -20, -20, 20, 20)",
+    "BBOX(geom, -180, -90, 180, 90)",
+    "BBOX(geom, 10.5, 20.25, 11.5, 21.25)",
+    "BBOX(geom, -20, -20, 20, 20) AND dtg DURING 2017-01-10T00:00:00.000Z/2017-01-20T00:00:00.000Z",
+    "dtg DURING 2017-01-01T12:00:00.000Z/2017-01-02T12:00:00.000Z AND BBOX(geom, -170, -80, 170, 80)",
+    "INTERSECTS(geom, POLYGON ((-30 -30, 30 -30, 0 40, -30 -30)))",
+    "actor1 = 'USA'",
+    "actor1 = 'USA' AND BBOX(geom, -60, -60, 60, 60)",
+    "actor1 IN ('CHN', 'RUS') AND n_articles > 50",
+    "n_articles < 5",
+    "IN ('f000001', 'f000077', 'nope')",
+    "BBOX(geom, -20, -20, 20, 20) OR BBOX(geom, 100, 40, 140, 80)",
+    "NOT BBOX(geom, -170, -85, 170, 85)",
+    "actor1 IS NULL AND BBOX(geom, -90, -45, 90, 45)",
+    "dtg AFTER 2017-02-20T00:00:00.000Z",
+    "dtg BEFORE 2017-01-03T00:00:00.000Z",
+    "dtg DURING 2017-01-05T00:00:00.000Z/2017-02-10T00:00:00.000Z",  # multi-bin
+]
+
+
+class TestParity:
+    @pytest.mark.parametrize("cql", QUERIES)
+    def test_result_parity(self, cql):
+        got = set(TPU.query("gdelt", cql).fids.astype(str))
+        want = set(MEM.query("gdelt", cql).fids.astype(str))
+        assert got == want, (
+            f"{cql}: {len(got)} vs {len(want)}; "
+            f"missing={sorted(want - got)[:5]} extra={sorted(got - want)[:5]}"
+        )
+
+    def test_include_returns_all(self):
+        assert len(TPU.query("gdelt", "INCLUDE")) == 5000
+
+    def test_exclude_returns_none(self):
+        assert len(TPU.query("gdelt", "EXCLUDE")) == 0
+
+
+class TestStrategySelection:
+    def expect_index(self, cql, name):
+        plan = TPU.planner("gdelt").plan(Query.cql(cql))
+        assert plan.index.name == name, plan.explain
+
+    def test_z3_for_bbox_and_time(self):
+        self.expect_index(
+            "BBOX(geom, -20, -20, 20, 20) AND "
+            "dtg DURING 2017-01-10T00:00:00.000Z/2017-01-20T00:00:00.000Z",
+            "z3",
+        )
+
+    def test_z2_for_bbox_only(self):
+        self.expect_index("BBOX(geom, -20, -20, 20, 20)", "z2")
+
+    def test_id_for_fid_query(self):
+        self.expect_index("IN ('f000001')", "id")
+
+    def test_attr_for_indexed_equality(self):
+        self.expect_index("actor1 = 'USA'", "attr:actor1")
+
+    def test_attr_plus_bbox_prefers_attr(self):
+        # equality on an indexed attribute is cheaper than a large bbox
+        self.expect_index("actor1 = 'USA' AND BBOX(geom, -170, -80, 170, 80)", "attr:actor1")
+
+    def test_small_bbox_beats_attr_range(self):
+        self.expect_index("actor1 > 'T' AND BBOX(geom, 1, 1, 1.2, 1.2)", "z2")
+
+    def test_empty_plan_for_contradiction(self):
+        plan = TPU.planner("gdelt").plan(
+            Query.cql("BBOX(geom, 0, 0, 1, 1) AND BBOX(geom, 5, 5, 6, 6)")
+        )
+        assert plan.is_empty
+
+    def test_explain_output(self):
+        out = TPU.explain("gdelt", "BBOX(geom, -20, -20, 20, 20)")
+        assert "Chosen strategy: z2" in out
+        assert "Ranges:" in out
+
+
+class TestQueryOptions:
+    def test_max_features(self):
+        r = TPU.query("gdelt", Query.cql("INCLUDE", max_features=7))
+        assert len(r) == 7
+
+    def test_sort(self):
+        r = TPU.query(
+            "gdelt",
+            Query.cql("n_articles >= 95", sort_by=[("n_articles", True)]),
+        )
+        col = r.columns["n_articles"]
+        assert (np.diff(col) >= 0).all()
+
+    def test_projection(self):
+        r = TPU.query("gdelt", Query.cql("INCLUDE", properties=["actor1"], max_features=3))
+        assert "actor1" in r.columns
+        assert "n_articles" not in r.columns
+        assert "__fid__" in r.columns
+
+    def test_to_features_round_trip(self):
+        r = TPU.query("gdelt", "IN ('f000042')")
+        feats = r.to_features()
+        assert len(feats) == 1
+        assert feats[0].fid == "f000042"
+        assert isinstance(feats[0].values[3], Point)
+
+
+class TestWritesAndDeletes:
+    def test_delete_tombstones(self):
+        ft = parse_spec("t", "name:String,dtg:Date,*geom:Point")
+        ds = TpuDataStore()
+        ds.create_schema(ft)
+        with ds.writer("t") as w:
+            for i in range(10):
+                w.write([f"n{i}", 1000 * i, Point(i, i)], fid=f"x{i}")
+        assert len(ds.query("t")) == 10
+        ds.delete_features("t", ["x3", "x7"])
+        r = ds.query("t")
+        assert len(r) == 8
+        assert "x3" not in set(r.fids)
+        ds.compact("t")
+        assert len(ds.query("t")) == 8
+
+    def test_schema_recovery_from_metadata(self):
+        from geomesa_tpu.store.metadata import InMemoryMetadata
+
+        md = InMemoryMetadata()
+        ds = TpuDataStore(metadata=md)
+        ft = parse_spec("t2", "name:String,*geom:Point")
+        ds.create_schema(ft)
+        ds2 = TpuDataStore(metadata=md)
+        assert ds2.get_schema("t2") == ft
+
+    def test_conflicting_schema_rejected(self):
+        ds = TpuDataStore()
+        ds.create_schema(parse_spec("t3", "name:String,*geom:Point"))
+        with pytest.raises(ValueError):
+            ds.create_schema(parse_spec("t3", "other:Int,*geom:Point"))
+
+
+class TestNonPointGeometries:
+    def test_xz2_polygons(self):
+        from geomesa_tpu.geom.wkt import parse_wkt
+
+        ft = parse_spec("polys", "name:String,*geom:Polygon:srid=4326")
+        tpu = TpuDataStore()
+        mem = MemoryDataStore()
+        tpu.create_schema(ft)
+        mem.create_schema(ft)
+        rs = np.random.RandomState(3)
+        features = []
+        for i in range(500):
+            cx, cy = rs.uniform(-170, 170), rs.uniform(-80, 80)
+            w = rs.uniform(0.01, 5)
+            poly = parse_wkt(
+                f"POLYGON (({cx-w} {cy-w}, {cx+w} {cy-w}, {cx+w} {cy+w}, "
+                f"{cx-w} {cy+w}, {cx-w} {cy-w}))"
+            )
+            features.append(Feature(ft, f"p{i}", [f"n{i}", poly]))
+        with tpu.writer("polys") as w_:
+            for f in features:
+                w_.write_feature(f)
+        mem.write_features("polys", features)
+        plan = tpu.planner("polys").plan(Query.cql("BBOX(geom, -10, -10, 10, 10)"))
+        assert plan.index.name == "xz2"
+        for cql in [
+            "BBOX(geom, -10, -10, 10, 10)",
+            "INTERSECTS(geom, POLYGON ((0 0, 20 0, 10 30, 0 0)))",
+            "WITHIN(geom, POLYGON ((-50 -50, 50 -50, 50 50, -50 50, -50 -50)))",
+        ]:
+            got = set(tpu.query("polys", cql).fids.astype(str))
+            want = set(mem.query("polys", cql).fids.astype(str))
+            assert got == want, f"{cql}: {len(got)} vs {len(want)}"
+
+    def test_xz3_polygons_with_time(self):
+        from geomesa_tpu.geom.wkt import parse_wkt
+
+        ft = parse_spec("pt", "dtg:Date,*geom:Polygon:srid=4326")
+        tpu = TpuDataStore()
+        mem = MemoryDataStore()
+        tpu.create_schema(ft)
+        mem.create_schema(ft)
+        t0 = parse_instant_ms("2017-01-01T00:00:00Z")
+        rs = np.random.RandomState(4)
+        features = []
+        for i in range(300):
+            cx, cy = rs.uniform(-170, 170), rs.uniform(-80, 80)
+            w = rs.uniform(0.01, 2)
+            poly = parse_wkt(
+                f"POLYGON (({cx-w} {cy-w}, {cx+w} {cy-w}, {cx+w} {cy+w}, "
+                f"{cx-w} {cy+w}, {cx-w} {cy-w}))"
+            )
+            features.append(
+                Feature(ft, f"p{i}", [t0 + int(rs.randint(0, 40 * 86400000)), poly])
+            )
+        with tpu.writer("pt") as w_:
+            for f in features:
+                w_.write_feature(f)
+        mem.write_features("pt", features)
+        cql = (
+            "BBOX(geom, -30, -30, 30, 30) AND "
+            "dtg DURING 2017-01-05T00:00:00.000Z/2017-01-25T00:00:00.000Z"
+        )
+        plan = tpu.planner("pt").plan(Query.cql(cql))
+        assert plan.index.name == "xz3"
+        got = set(tpu.query("pt", cql).fids.astype(str))
+        want = set(mem.query("pt", cql).fids.astype(str))
+        assert got == want
