@@ -1,0 +1,120 @@
+"""Headline benchmark: GDELT-like Z3 bbox+time filter throughput.
+
+Mirrors BASELINE.json config #1: N synthetic GDELT-style point features, a
+bbox + date-range CQL query, result-set parity enforced between the device
+path and a brute-force host reference (the stand-in for the reference's
+in-memory CQEngine datastore, geomesa-memory GeoCQEngine.scala:34).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Tune with env GEOMESA_BENCH_N (rows, default 2_000_000) and
+GEOMESA_BENCH_REPS (timed repetitions, default 20).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def synthesize(n: int, seed: int = 13):
+    """GDELT-ish: world-wide points clustered around hot spots + 40 days."""
+    rng = np.random.default_rng(seed)
+    k = n // 4
+    # uniform background + three dense clusters (cities)
+    x = np.concatenate(
+        [
+            rng.uniform(-180, 180, n - 3 * k),
+            rng.normal(-77.0, 3.0, k),
+            rng.normal(2.35, 3.0, k),
+            rng.normal(116.4, 3.0, k),
+        ]
+    )
+    y = np.concatenate(
+        [
+            rng.uniform(-90, 90, n - 3 * k),
+            rng.normal(38.9, 2.0, k),
+            rng.normal(48.85, 2.0, k),
+            rng.normal(39.9, 2.0, k),
+        ]
+    )
+    x = np.clip(x, -180.0, 180.0)
+    y = np.clip(y, -90.0, 90.0)
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype(np.int64)
+    t = base + rng.integers(0, 40 * 86400_000, n)
+    order = rng.permutation(n)
+    return x[order], y[order], t[order]
+
+
+QUERY = (
+    "bbox(geom, -80.0, 36.0, -70.0, 41.0) AND "
+    "dtg DURING 2026-01-05T00:00:00Z/2026-01-19T00:00:00Z"
+)
+BOX = (-80.0, 36.0, -70.0, 41.0)
+T_LO = np.datetime64("2026-01-05T00:00:00", "ms").astype(np.int64)
+T_HI = np.datetime64("2026-01-19T00:00:00", "ms").astype(np.int64)
+
+
+def brute_force(x, y, t):
+    """The CPU reference: vectorized full-scan predicate (CQEngine analog)."""
+    return np.flatnonzero(
+        (x >= BOX[0]) & (x <= BOX[2]) & (y >= BOX[1]) & (y <= BOX[3]) & (t > T_LO) & (t < T_HI)
+    )
+
+
+def main():
+    n = int(os.environ.get("GEOMESA_BENCH_N", 5_000_000))
+    reps = int(os.environ.get("GEOMESA_BENCH_REPS", 20))
+    x, y, t = synthesize(n)
+
+    # --- CPU baseline -----------------------------------------------------
+    brute_force(x[:1000], y[:1000], t[:1000])  # warm
+    t0 = time.perf_counter()
+    base_reps = max(3, reps // 4)
+    for _ in range(base_reps):
+        want = brute_force(x, y, t)
+    cpu_s = (time.perf_counter() - t0) / base_reps
+    cpu_fps = n / cpu_s
+
+    # --- TPU store path ---------------------------------------------------
+    from geomesa_tpu.geom.base import Point  # noqa: F401  (schema dep)
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import TpuDataStore
+
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    store._insert_columns(
+        ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t}
+    )
+
+    res = store.query("gdelt", QUERY)  # warm: device pack + compile
+    got = {f for f in res.fids}
+    parity = got == {f"f{i}" for i in want}
+    if not parity:
+        raise SystemExit(
+            json.dumps({"metric": "parity_failure", "value": 0, "unit": "bool", "vs_baseline": 0})
+        )
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = store.query("gdelt", QUERY)
+    tpu_s = (time.perf_counter() - t0) / reps
+    tpu_fps = n / tpu_s
+
+    print(
+        json.dumps(
+            {
+                "metric": "gdelt_z3_bbox_time_filter_throughput",
+                "value": round(tpu_fps, 1),
+                "unit": "features/sec",
+                "vs_baseline": round(tpu_fps / cpu_fps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -225,6 +225,9 @@ class Z2KeySpace(IndexKeySpace):
     def __init__(self):
         self._sfc = Z2SFC()
 
+    def sfc(self, ft: FeatureType) -> Z2SFC:
+        return self._sfc
+
     def supports(self, ft: FeatureType) -> bool:
         return ft.is_points
 

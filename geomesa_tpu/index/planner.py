@@ -107,9 +107,10 @@ class QueryPlan:
 class QueryPlanner:
     """Plans queries for one feature type over its enabled indices."""
 
-    def __init__(self, ft: FeatureType, indices: Sequence[IndexKeySpace]):
+    def __init__(self, ft: FeatureType, indices: Sequence[IndexKeySpace], stats=None):
         self.ft = ft
         self.indices = list(indices)
+        self.stats = stats
 
     def plan(
         self,
@@ -123,7 +124,7 @@ class QueryPlanner:
         explain(f"Filter: {to_cql(f)}")
         explain(f"Indices available: {[i.name for i in self.indices]}")
 
-        strategies = get_filter_strategies(self.ft, self.indices, f)
+        strategies = get_filter_strategies(self.ft, self.indices, f, self.stats)
         explain.push(f"Strategy options: {len(strategies)}")
         for s in strategies:
             explain(

@@ -99,18 +99,34 @@ def _is_attr(attribute: str, node: ast.Filter) -> bool:
 
 
 def get_filter_strategies(
-    ft: FeatureType, indices: List[IndexKeySpace], f: ast.Filter
+    ft: FeatureType, indices: List[IndexKeySpace], f: ast.Filter, stats=None
 ) -> List[FilterStrategy]:
     """All viable (index, primary, secondary) splits for a filter.
 
     Mirrors GeoMesaFeatureIndex.getFilterStrategy for each index family. The
-    decider picks the min-cost one.
+    decider picks the min-cost one: stats-estimated counts when a stats
+    service is provided (CostBasedStrategyDecider, StrategyDecider.scala:
+    47-62), else the index-ordering heuristics above.
     """
     out: List[FilterStrategy] = []
     for index in indices:
         fs = _strategy_for(ft, index, f)
         if fs is not None:
             out.append(fs)
+    if stats is not None:
+        total = stats.get_count(ft)
+        for fs in out:
+            if fs.primary is None or isinstance(fs.primary, ast.Exclude):
+                continue
+            est = stats.get_count(ft, fs.primary)
+            if est is None and total is not None:
+                # no estimate -> pessimistic full-scan rows, so estimated and
+                # unestimated strategies stay on the same (row-count) scale
+                est = total
+            if est is not None:
+                # + tiny index-type tiebreak so equal estimates keep the
+                # heuristic preference order
+                fs.cost = float(est) + fs.cost * 1e-6
     # full-scan fallback on the preferred index (reference scans the record
     # index; we scan the first available one)
     if not out and indices:

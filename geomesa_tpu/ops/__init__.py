@@ -1,0 +1,27 @@
+"""Device (JAX/XLA) kernels: the TPU analog of the reference's server-side code.
+
+The reference pushes compute to the data with Accumulo iterators / HBase
+coprocessors (SURVEY.md section 2.6); here the same role is played by XLA
+kernels over HBM-resident columnar blocks:
+
+  * ``zkernels`` — uint32-limb Morton encode/decode (TPU int64 is emulated,
+    so 62/63-bit keys are carried as (hi, lo) uint32 pairs).
+  * ``filters`` — the Z3Iterator/Z2Iterator analog: vectorized int-domain
+    bbox + time-window candidate masks over normalized coordinate columns.
+  * ``aggregations`` — density grids / stats / BIN packing push-downs.
+"""
+
+from geomesa_tpu.ops.zkernels import (
+    z2_encode_limbs,
+    z2_decode_limbs,
+    z3_encode_limbs,
+    z3_decode_limbs,
+    limbs_in_range,
+)
+from geomesa_tpu.ops.filters import (
+    pad_boxes,
+    pad_windows,
+    z2_query_mask,
+    z3_query_mask,
+    bbox_mask_f32,
+)

@@ -1,0 +1,222 @@
+"""Morton (Z-order) encode/decode as uint32-limb JAX kernels.
+
+The host curve layer (geomesa_tpu.curve.zorder) runs numpy uint64 magic-mask
+passes; TPUs emulate int64, so on device the 62-bit Z2 / 63-bit Z3 keys are
+carried as two uint32 limbs ``(hi, lo)`` compared lexicographically. This is
+the device-side replacement for the reference's sfcurve-zorder bit twiddling
+(called from Z2SFC.scala:52 / Z3SFC.scala:62) and for the row-key decode
+inside the tserver Z3Iterator (accumulo/iterators/Z3Iterator.scala:42-65).
+
+Bit layouts match the host layer exactly:
+  * Z2: x in even positions, y odd; 31 bits/dim -> 62-bit key.
+  * Z3: x at bit 3k, y at 3k+1, t at 3k+2; 21 bits/dim -> 63-bit key.
+
+All helpers are shape-polymorphic over leading dims and jit-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_U32 = jnp.uint32
+
+
+def _u(x: int) -> jnp.ndarray:
+    return jnp.uint32(x)
+
+
+# ---------------------------------------------------------------------------
+# 32-bit spread/compact primitives
+# ---------------------------------------------------------------------------
+
+def part1by1_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Spread the low 16 bits of x to even bit positions (uint32)."""
+    x = x.astype(_U32) & _u(0x0000FFFF)
+    x = (x ^ (x << 8)) & _u(0x00FF00FF)
+    x = (x ^ (x << 4)) & _u(0x0F0F0F0F)
+    x = (x ^ (x << 2)) & _u(0x33333333)
+    x = (x ^ (x << 1)) & _u(0x55555555)
+    return x
+
+
+def compact1by1_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Gather even bit positions of x into the low 16 bits (uint32)."""
+    x = x.astype(_U32) & _u(0x55555555)
+    x = (x ^ (x >> 1)) & _u(0x33333333)
+    x = (x ^ (x >> 2)) & _u(0x0F0F0F0F)
+    x = (x ^ (x >> 4)) & _u(0x00FF00FF)
+    x = (x ^ (x >> 8)) & _u(0x0000FFFF)
+    return x
+
+
+def part1by2_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Spread the low 10 bits of x to every third bit position (uint32)."""
+    x = x.astype(_U32) & _u(0x000003FF)
+    x = (x ^ (x << 16)) & _u(0xFF0000FF)
+    x = (x ^ (x << 8)) & _u(0x0F00F00F)
+    x = (x ^ (x << 4)) & _u(0xC30C30C3)
+    x = (x ^ (x << 2)) & _u(0x49249249)
+    return x
+
+
+def compact1by2_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Gather bits 0,3,...,30 of x into the low 11 bits (uint32)."""
+    x = x.astype(_U32) & _u(0x49249249)
+    x = (x ^ (x >> 2)) & _u(0xC30C30C3)
+    x = (x ^ (x >> 4)) & _u(0x0F00F00F)
+    x = (x ^ (x >> 8)) & _u(0xFF0000FF)
+    x = (x ^ (x >> 16)) & _u(0x000007FF)
+    return x
+
+
+def _shift_left_limbs(hi: jnp.ndarray, lo: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(hi, lo) << k for a static small k (0..31)."""
+    if k == 0:
+        return hi, lo
+    return (hi << k) | (lo >> (32 - k)), lo << k
+
+
+# ---------------------------------------------------------------------------
+# Z2: 31 bits/dim -> 62-bit (hi, lo)
+# ---------------------------------------------------------------------------
+
+def _spread2_limbs(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Spread 31-bit x to even positions of a 62-bit (hi, lo) pair."""
+    x = x.astype(_U32)
+    lo = part1by1_u32(x & _u(0xFFFF))
+    hi = part1by1_u32((x >> 16) & _u(0x7FFF))
+    return hi, lo
+
+
+def z2_encode_limbs(xi: jnp.ndarray, yi: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Interleave two <=31-bit int arrays into 62-bit Morton limbs (hi, lo)."""
+    xh, xl = _spread2_limbs(xi)
+    yh, yl = _spread2_limbs(yi)
+    yh, yl = _shift_left_limbs(yh, yl, 1)
+    return xh | yh, xl | yl
+
+
+def _gather2_dim(hi: jnp.ndarray, lo: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Extract the dim at even-offset k (0=x, 1=y) from 62-bit limbs."""
+    if k:
+        low = (lo >> k) | (hi << (32 - k))
+        high = hi >> k
+    else:
+        low, high = lo, hi
+    return compact1by1_u32(low) | (compact1by1_u32(high) << 16)
+
+
+def z2_decode_limbs(hi: jnp.ndarray, lo: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    hi = hi.astype(_U32)
+    lo = lo.astype(_U32)
+    return _gather2_dim(hi, lo, 0), _gather2_dim(hi, lo, 1)
+
+
+# ---------------------------------------------------------------------------
+# Z3: 21 bits/dim -> 63-bit (hi, lo)
+# ---------------------------------------------------------------------------
+
+def _spread3_limbs(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Spread 21-bit x to every third position of a 63-bit (hi, lo) pair.
+
+    21 = 10 + 10 + 1: s(x) = s(a) | s(b) << 30 | c << 60 with each s() a
+    28-bit part1by2 spread, recombined across the 32-bit limb boundary.
+    """
+    x = x.astype(_U32)
+    a = part1by2_u32(x & _u(0x3FF))
+    b = part1by2_u32((x >> 10) & _u(0x3FF))
+    c = (x >> 20) & _u(1)
+    lo = a | (b << 30)
+    hi = (b >> 2) | (c << 28)
+    return hi, lo
+
+
+def z3_encode_limbs(
+    xi: jnp.ndarray, yi: jnp.ndarray, ti: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Interleave three <=21-bit int arrays into 63-bit Morton limbs (hi, lo)."""
+    out_hi = jnp.zeros(jnp.shape(xi), dtype=_U32)
+    out_lo = jnp.zeros(jnp.shape(xi), dtype=_U32)
+    for k, dim in enumerate((xi, yi, ti)):
+        h, l = _spread3_limbs(dim)
+        h, l = _shift_left_limbs(h, l, k)
+        out_hi = out_hi | h
+        out_lo = out_lo | l
+    return out_hi, out_lo
+
+
+def _gather3_dim(hi: jnp.ndarray, lo: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Extract the dim at stride-3 offset k (0=x, 1=y, 2=t) from 63-bit limbs.
+
+    After v >>= k the dim sits at bits 3i; i in 0..10 come from the low limb,
+    i in 11..20 from the high limb at positions 3(i-11)+1.
+    """
+    if k:
+        low = (lo >> k) | (hi << (32 - k))
+        high = hi >> k
+    else:
+        low, high = lo, hi
+    lo_bits = compact1by2_u32(low)
+    hi_bits = compact1by2_u32(high >> 1) & _u(0x3FF)
+    return lo_bits | (hi_bits << 11)
+
+
+def z3_decode_limbs(
+    hi: jnp.ndarray, lo: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    hi = hi.astype(_U32)
+    lo = lo.astype(_U32)
+    return (
+        _gather3_dim(hi, lo, 0),
+        _gather3_dim(hi, lo, 1),
+        _gather3_dim(hi, lo, 2),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Lexicographic limb comparison / range membership
+# ---------------------------------------------------------------------------
+
+def limbs_leq(
+    a_hi: jnp.ndarray, a_lo: jnp.ndarray, b_hi: jnp.ndarray, b_lo: jnp.ndarray
+) -> jnp.ndarray:
+    """(a_hi, a_lo) <= (b_hi, b_lo) treating limbs as one unsigned value."""
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
+
+
+def limbs_in_range(
+    k_hi: jnp.ndarray,
+    k_lo: jnp.ndarray,
+    lo_hi: jnp.ndarray,
+    lo_lo: jnp.ndarray,
+    up_hi: jnp.ndarray,
+    up_lo: jnp.ndarray,
+) -> jnp.ndarray:
+    """Inclusive range membership over any broadcastable limb shapes.
+
+    The device analog of the tserver seeking a key into [lower, upper]
+    scan ranges; used to mask sorted key columns against planner output.
+    """
+    ge = limbs_leq(lo_hi, lo_lo, k_hi, k_lo)
+    le = limbs_leq(k_hi, k_lo, up_hi, up_lo)
+    return ge & le
+
+
+def split_i64_to_limbs(z) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Host-side helper: int64 keys -> (hi, lo) uint32 arrays (numpy in/out)."""
+    import numpy as np
+
+    z = np.asarray(z, dtype=np.int64).astype(np.uint64)
+    return (z >> np.uint64(32)).astype(np.uint32), (z & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def limbs_to_i64(hi, lo):
+    """Host-side helper: (hi, lo) uint32 -> int64 keys (numpy in/out)."""
+    import numpy as np
+
+    hi = np.asarray(hi, dtype=np.uint64)
+    lo = np.asarray(lo, dtype=np.uint64)
+    return ((hi << np.uint64(32)) | lo).astype(np.int64)

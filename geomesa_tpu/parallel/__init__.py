@@ -1,0 +1,11 @@
+"""SPMD execution over a TPU device mesh.
+
+The reference parallelizes scans with client thread pools + server-side
+iterators across tablet servers (SURVEY.md section 2.6); the TPU analog keeps
+index tables as columnar shards laid out over a ``jax.sharding.Mesh`` and
+broadcasts query descriptors, with partial hit masks merged by XLA collectives
+(psum over the range axis, all_gather of per-shard counts).
+"""
+
+from geomesa_tpu.parallel.mesh import default_mesh, shard_array, pad_to_multiple
+from geomesa_tpu.parallel.executor import TpuScanExecutor, DeviceIndex

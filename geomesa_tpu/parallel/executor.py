@@ -1,0 +1,191 @@
+"""TpuScanExecutor: run the index pre-filter on device over sharded columns.
+
+Replaces the reference's tserver-side scan loop (BatchScanPlan fan-out,
+accumulo/index/AccumuloQueryPlan.scala:113-140, + Z3Iterator reject,
+accumulo/iterators/Z3Iterator.scala:42-65) with one fused XLA pass:
+
+  host planner --> int-domain boxes + per-bin windows (query descriptor)
+  device       --> candidate mask over normalized coordinate columns
+  host         --> exact CQL post-filter on the (small) candidate set
+
+The device mask is conservative and the exact post-filter is unchanged, so
+result sets are identical to the host scan path (parity by construction).
+Columns live on device sharded over the mesh's row axis and are reused across
+queries until the table version changes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from geomesa_tpu.curve import zorder
+from geomesa_tpu.index.planner import QueryPlan
+from geomesa_tpu.ops.filters import (
+    pad_boxes,
+    pad_windows,
+    z2_query_mask,
+    z3_query_mask,
+)
+from geomesa_tpu.parallel.mesh import (
+    DATA_AXIS,
+    default_mesh,
+    pad_to_multiple,
+    replicate,
+    shard_array,
+)
+from geomesa_tpu.store.blocks import IndexTable
+
+# one jit per (N, K, W) shape bucket; padding keeps the bucket count small
+_z3_mask = jax.jit(z3_query_mask)
+_z2_mask = jax.jit(z2_query_mask)
+
+
+class DeviceIndex:
+    """Device-resident mirror of one point-index table (z3 or z2).
+
+    Rows are all blocks concatenated in block order, padded to a multiple of
+    the mesh size with invalid rows; ``block_starts`` maps a global candidate
+    row back to its (block, local row).
+    """
+
+    def __init__(self, mesh, table: IndexTable):
+        self.mesh = mesh
+        self.version = table.version
+        self.kind = table.index.name  # "z3" | "z2"
+        xs: List[np.ndarray] = []
+        ys: List[np.ndarray] = []
+        ts: List[np.ndarray] = []
+        bins: List[np.ndarray] = []
+        self.block_starts: List[int] = []
+        n = 0
+        for b in table.blocks:
+            self.block_starts.append(n)
+            key = b.key.astype(np.int64)
+            if self.kind == "z3":
+                xi, yi, ti = zorder.z3_decode(key)
+                ts.append(ti.astype(np.int32))
+                bins.append(b.bins.astype(np.int32))
+            else:
+                xi, yi = zorder.z2_decode(key)
+            xs.append(xi.astype(np.int32))
+            ys.append(yi.astype(np.int32))
+            n += b.n
+        self.n = n
+        m = max(1, mesh.devices.size)
+        xi = pad_to_multiple(np.concatenate(xs) if xs else np.empty(0, np.int32), m, 0)
+        yi = pad_to_multiple(np.concatenate(ys) if ys else np.empty(0, np.int32), m, 0)
+        valid = pad_to_multiple(np.ones(n, dtype=bool), m, False)
+        self.xi = shard_array(mesh, xi)
+        self.yi = shard_array(mesh, yi)
+        self.valid = shard_array(mesh, valid)
+        if self.kind == "z3":
+            ti = pad_to_multiple(np.concatenate(ts) if ts else np.empty(0, np.int32), m, 0)
+            bi = pad_to_multiple(
+                np.concatenate(bins) if bins else np.empty(0, np.int32), m, -1
+            )
+            self.ti = shard_array(mesh, ti)
+            self.bins = shard_array(mesh, bi)
+
+    def mask(self, boxes: np.ndarray, windows: Optional[np.ndarray]) -> np.ndarray:
+        b = replicate(self.mesh, boxes)
+        if self.kind == "z3":
+            w = replicate(self.mesh, windows)
+            out = _z3_mask(self.xi, self.yi, self.bins, self.ti, self.valid, b, w)
+        else:
+            out = _z2_mask(self.xi, self.yi, self.valid, b)
+        return np.asarray(out)[: self.n]
+
+    def to_block_rows(self, rows: np.ndarray) -> List[Tuple[int, np.ndarray]]:
+        """Global candidate rows -> [(block index, local rows)]."""
+        if not len(rows):
+            return []
+        starts = np.asarray(self.block_starts + [self.n], dtype=np.int64)
+        out = []
+        which = np.searchsorted(starts, rows, side="right") - 1
+        for blk in np.unique(which):
+            local = rows[which == blk] - starts[blk]
+            out.append((int(blk), local))
+        return out
+
+
+class TpuScanExecutor:
+    """Pluggable executor for TpuDataStore: device pre-filter for point
+    indices, host fallback elsewhere. Also evaluates the exact post-filter
+    (numpy) on candidates, like HostScanExecutor."""
+
+    def __init__(self, mesh=None):
+        import weakref
+
+        self.mesh = mesh if mesh is not None else default_mesh()
+        # id() keys can be recycled after GC, so each entry holds a weakref
+        # to its table: identity is re-checked on hit and dead entries are
+        # evicted (frees the device-resident shards)
+        self._cache: Dict[int, Tuple["weakref.ref", DeviceIndex]] = {}
+
+    def device_index(self, table: IndexTable) -> DeviceIndex:
+        import weakref
+
+        entry = self._cache.get(id(table))
+        cached = None
+        if entry is not None and entry[0]() is table:
+            cached = entry[1]
+        if cached is None or cached.version != table.version:
+            cached = DeviceIndex(self.mesh, table)
+            for k in [k for k, (ref, _) in self._cache.items() if ref() is None]:
+                del self._cache[k]
+            self._cache[id(table)] = (weakref.ref(table), cached)
+        return cached
+
+    def supports(self, table: IndexTable, plan: QueryPlan) -> bool:
+        return (
+            table.index.name in ("z3", "z2")
+            and not plan.values.disjoint
+            and bool(plan.values.spatial_envelopes)
+            and not table.tombstones
+        )
+
+    def scan_candidates(self, table: IndexTable, plan: QueryPlan):
+        """Device candidate scan; None -> caller falls back to host ranges."""
+        if not self.supports(table, plan):
+            return None
+        if table.index.name == "z3" and not plan.values.bins:
+            return None
+        return self._device_scan(table, plan)
+
+    def _device_scan(self, table: IndexTable, plan: QueryPlan):
+        dev = self.device_index(table)
+        sfc = table.index.sfc(table.ft)
+        boxes = []
+        for env in plan.values.spatial_envelopes:
+            boxes.append(
+                (
+                    int(sfc.lon.normalize(env.xmin)[()]),
+                    int(sfc.lat.normalize(env.ymin)[()]),
+                    int(sfc.lon.normalize(env.xmax)[()]),
+                    int(sfc.lat.normalize(env.ymax)[()]),
+                )
+            )
+        windows = None
+        if dev.kind == "z3":
+            windows = pad_windows(
+                [
+                    (
+                        b,
+                        int(sfc.time.normalize(lo)[()]),
+                        int(sfc.time.normalize(hi)[()]),
+                    )
+                    for b, (lo, hi) in sorted(plan.values.bins.items())
+                ]
+            )
+        mask = dev.mask(pad_boxes(boxes), windows)
+        rows = np.flatnonzero(mask)
+        for blk, local in dev.to_block_rows(rows):
+            yield table.blocks[blk], local
+
+    def post_filter(self, ft, plan: QueryPlan, columns) -> np.ndarray:
+        from geomesa_tpu.filter.evaluate import evaluate
+
+        return evaluate(plan.post_filter, ft, columns)

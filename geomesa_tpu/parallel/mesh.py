@@ -1,0 +1,43 @@
+"""Device mesh construction and columnar sharding helpers.
+
+Blocks are sharded along their row axis (the analog of tablet splits,
+api/GeoMesaFeatureIndex.scala:116 getSplits); query descriptors are
+replicated. Multi-host meshes ride DCN automatically through jax's global
+device set — the layout code here is identical single-chip and pod-scale.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "shards"
+
+
+def default_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh:
+    """1D mesh over all (or the given) devices; rows shard over ``axis``."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
+    """Pad axis 0 to a multiple so rows divide evenly across shards."""
+    n = arr.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr
+    pad_width = [(0, rem)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width, constant_values=fill)
+
+
+def shard_array(mesh: Mesh, arr: np.ndarray, axis: str = DATA_AXIS):
+    """Place a host array on the mesh, sharded along axis 0."""
+    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+
+
+def replicate(mesh: Mesh, arr: np.ndarray):
+    """Place a host array on the mesh fully replicated (query descriptors)."""
+    return jax.device_put(arr, NamedSharding(mesh, P()))

@@ -1,0 +1,33 @@
+"""Stats sketches + estimation service.
+
+Rebuild of the reference's two stats tiers (SURVEY.md sections 2.2/2.3):
+``geomesa-utils .../stats/`` summary sketches (MinMax, Count, Histogram,
+Frequency/CountMinSketch, TopK, Enumeration, DescriptiveStats, Z3Histogram,
+combinator parser Stat.scala:1-388) and ``geomesa-index-api .../stats/``
+(GeoMesaStats service, MetadataBackedStats persistence, StatsBasedEstimator
+selectivity for the cost-based strategy decider).
+
+Sketches observe columnar numpy batches (vectorized, unlike the reference's
+per-feature observe) and merge with ``+``, so per-shard partials can be
+reduced the same way tablet-level partials are in StatsScan.
+"""
+
+from geomesa_tpu.stats.sketches import (
+    CountStat,
+    DescriptiveStats,
+    EnumerationStat,
+    Frequency,
+    Histogram,
+    MinMax,
+    SeqStat,
+    Stat,
+    TopK,
+    Z3HistogramStat,
+)
+from geomesa_tpu.stats.parser import parse_stat
+from geomesa_tpu.stats.service import (
+    GeoMesaStats,
+    MetadataBackedStats,
+    NoopStats,
+    StatsBasedEstimator,
+)

@@ -1,0 +1,289 @@
+"""Stats service: write-time maintenance + query-time estimation.
+
+The reference maintains per-SFT data stats on the catalog table at write time
+(accumulo/data/stats/StatsCombiner.scala:26, MetadataBackedStats) and feeds
+them to the cost-based strategy decider (stats/StatsBasedEstimator.scala:27,41,
+GeoMesaStats.scala:29-120). Here sketches observe columnar batches as they are
+flushed and persist as JSON in the metadata store.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.filter import ast
+from geomesa_tpu.filter.extract import extract_geometries, extract_intervals
+from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
+from geomesa_tpu.stats import sketches
+from geomesa_tpu.stats.sketches import (
+    CountStat,
+    EnumerationStat,
+    Frequency,
+    Histogram,
+    MinMax,
+    Stat,
+    TopK,
+    Z3HistogramStat,
+)
+
+_HIST_BINS = 1000
+
+
+class GeoMesaStats:
+    """Service interface (stats/GeoMesaStats.scala:29-120)."""
+
+    def get_count(self, ft: FeatureType, f: Optional[ast.Filter] = None) -> Optional[float]:
+        raise NotImplementedError
+
+    def get_bounds(self, ft: FeatureType) -> Optional[Tuple[float, float, float, float]]:
+        raise NotImplementedError
+
+    def get_attribute_bounds(self, ft: FeatureType, attribute: str) -> Optional[Tuple[Any, Any]]:
+        raise NotImplementedError
+
+    def observe_columns(self, ft: FeatureType, columns: Dict[str, np.ndarray]) -> None:
+        """Write-time maintenance hook; no-op unless stats are maintained."""
+
+
+class NoopStats(GeoMesaStats):
+    """Disabled stats (reference NoopStats): planner falls back to
+    index-ordering heuristics."""
+
+    def get_count(self, ft, f=None):
+        return None
+
+    def get_bounds(self, ft):
+        return None
+
+    def get_attribute_bounds(self, ft, attribute):
+        return None
+
+
+class MetadataBackedStats(GeoMesaStats):
+    """Write-time maintained sketches persisted in the metadata store.
+
+    Per type: Count(), MinMax + Histogram for lon/lat/dtg, MinMax per
+    numeric/date attribute, Enumeration/TopK/Frequency per string attribute.
+    """
+
+    def __init__(self, metadata=None, persist_every: int = 50):
+        self.metadata = metadata
+        self._stats: Dict[str, Dict[str, Stat]] = {}
+        self._unpersisted: Dict[str, int] = {}
+        self._persist_every = persist_every
+
+    # -- maintenance --------------------------------------------------------
+
+    def _init_for(self, ft: FeatureType) -> Dict[str, Stat]:
+        stats: Dict[str, Stat] = {"count": CountStat()}
+        geom = ft.default_geometry
+        if geom is not None and geom.type == AttributeType.POINT:
+            stats["lon"] = Histogram(geom.name + "__x", _HIST_BINS, -180.0, 180.0)
+            stats["lat"] = Histogram(geom.name + "__y", _HIST_BINS, -90.0, 90.0)
+            stats["minmax:lon"] = MinMax(geom.name + "__x")
+            stats["minmax:lat"] = MinMax(geom.name + "__y")
+        dtg = ft.default_date
+        if dtg is not None:
+            # ms-epoch histogram over 2000..2040 (clamped ends catch outliers)
+            lo = np.datetime64("2000-01-01", "ms").astype(np.int64)
+            hi = np.datetime64("2040-01-01", "ms").astype(np.int64)
+            stats["dtg"] = Histogram(dtg.name, _HIST_BINS, float(lo), float(hi))
+            stats["minmax:dtg"] = MinMax(dtg.name)
+        if geom is not None and dtg is not None and geom.type == AttributeType.POINT:
+            stats["z3"] = Z3HistogramStat(geom.name, dtg.name, ft.z3_interval.value)
+        for a in ft.attributes:
+            if a is geom or a is dtg:
+                continue
+            if a.type in (AttributeType.INT, AttributeType.LONG, AttributeType.FLOAT,
+                          AttributeType.DOUBLE, AttributeType.DATE):
+                stats[f"minmax:{a.name}"] = MinMax(a.name)
+                if a.indexed:
+                    stats[f"hist:{a.name}"] = None  # lazy: bounds unknown up front
+            elif a.type == AttributeType.STRING:
+                stats[f"topk:{a.name}"] = TopK(a.name)
+                stats[f"freq:{a.name}"] = Frequency(a.name)
+        return {k: v for k, v in stats.items() if v is not None}
+
+    def stats_for(self, ft: FeatureType) -> Dict[str, Stat]:
+        if ft.name not in self._stats:
+            loaded = self._load(ft.name)
+            self._stats[ft.name] = loaded if loaded is not None else self._init_for(ft)
+        return self._stats[ft.name]
+
+    def observe_columns(self, ft: FeatureType, columns: Dict[str, np.ndarray]) -> None:
+        stats = self.stats_for(ft)
+        n = len(next(iter(columns.values()), []))
+        stats["count"].count += n
+        for key, stat in stats.items():
+            if key == "count":
+                continue
+            if isinstance(stat, Z3HistogramStat):
+                x = columns.get(stat.geom + "__x")
+                t = columns.get(stat.dtg)
+                if x is not None and t is not None:
+                    stat.observe_xyt(x, columns[stat.geom + "__y"], t)
+                continue
+            attr = getattr(stat, "attribute", None)
+            if attr is None or attr not in columns:
+                continue
+            nulls = columns.get(attr.split("__")[0] + "__null")
+            stat.observe(columns[attr], nulls)
+        # debounced persistence: serializing every sketch per batch is pure
+        # overhead on the write hot path; sketches are recomputable anyway
+        self._unpersisted[ft.name] = self._unpersisted.get(ft.name, 0) + 1
+        if self._unpersisted[ft.name] >= self._persist_every:
+            self.flush(ft.name)
+
+    # -- persistence --------------------------------------------------------
+
+    def flush(self, name: Optional[str] = None) -> None:
+        """Persist sketches now (age-off of the debounce window)."""
+        names = [name] if name else list(self._stats)
+        for n in names:
+            if n in self._stats:
+                self._persist(n)
+                self._unpersisted[n] = 0
+
+    def _persist(self, name: str) -> None:
+        if self.metadata is None:
+            return
+        payload = json.dumps({k: json.loads(v.to_json()) for k, v in self._stats[name].items()})
+        self.metadata.insert(name, "stats", payload)
+
+    def _load(self, name: str) -> Optional[Dict[str, Stat]]:
+        if self.metadata is None:
+            return None
+        raw = self.metadata.read(name, "stats")
+        if not raw:
+            return None
+        return {k: sketches._from_state(v) for k, v in json.loads(raw).items()}
+
+    # -- queries ------------------------------------------------------------
+
+    def get_count(self, ft: FeatureType, f: Optional[ast.Filter] = None) -> Optional[float]:
+        stats = self.stats_for(ft)
+        total = stats["count"].count
+        if f is None or isinstance(f, ast.Include):
+            return float(total)
+        return StatsBasedEstimator(self).estimate(ft, f)
+
+    def get_bounds(self, ft: FeatureType):
+        stats = self.stats_for(ft)
+        lon, lat = stats.get("minmax:lon"), stats.get("minmax:lat")
+        if lon is None or lon.is_empty:
+            return None
+        return (float(lon.min), float(lat.min), float(lon.max), float(lat.max))
+
+    def get_attribute_bounds(self, ft: FeatureType, attribute: str):
+        stats = self.stats_for(ft)
+        geom = ft.default_geometry
+        if geom is not None and attribute == geom.name:
+            b = self.get_bounds(ft)
+            return None if b is None else ((b[0], b[1]), (b[2], b[3]))
+        dtg = ft.default_date
+        key = "minmax:dtg" if dtg is not None and attribute == dtg.name else f"minmax:{attribute}"
+        mm = stats.get(key)
+        if mm is None or mm.is_empty:
+            return None
+        return (mm.min, mm.max)
+
+
+class StatsBasedEstimator:
+    """Selectivity estimation from sketches (StatsBasedEstimator.scala:27-41).
+
+    bbox -> product of lon/lat histogram selectivities; intervals -> dtg
+    histogram; attribute equality -> frequency/topk; AND multiplies, OR adds
+    (capped), NOT complements.
+    """
+
+    def __init__(self, stats: MetadataBackedStats):
+        self.stats = stats
+
+    def estimate(self, ft: FeatureType, f: ast.Filter) -> Optional[float]:
+        stats = self.stats.stats_for(ft)
+        total = stats["count"].count
+        if total == 0:
+            return 0.0
+        sel = self._selectivity(ft, f, stats, total)
+        if sel is None:
+            return None
+        return max(0.0, min(1.0, sel)) * total
+
+    def _selectivity(self, ft, f, stats, total) -> Optional[float]:
+        if isinstance(f, ast.Include):
+            return 1.0
+        if isinstance(f, ast.Exclude):
+            return 0.0
+        if isinstance(f, ast.And):
+            sel = 1.0
+            for c in f.children():
+                s = self._selectivity(ft, c, stats, total)
+                if s is not None:
+                    sel *= s
+            return sel
+        if isinstance(f, ast.Or):
+            sel = 0.0
+            for c in f.children():
+                s = self._selectivity(ft, c, stats, total)
+                sel += 1.0 if s is None else s
+            return min(1.0, sel)
+        if isinstance(f, ast.Not):
+            s = self._selectivity(ft, f.child, stats, total)
+            return None if s is None else 1.0 - s
+
+        geom = ft.default_geometry
+        if geom is not None and isinstance(f, (ast.BBox, ast.Intersects, ast.Within, ast.Contains)):
+            geoms = extract_geometries(f, geom.name)
+            if not geoms.values:
+                return None
+            lon_h, lat_h = stats.get("lon"), stats.get("lat")
+            if lon_h is None or lon_h.is_empty:
+                return None
+            sel = 0.0
+            for g in geoms.values:
+                env = g.envelope
+                sx = lon_h.count_between(env.xmin, env.xmax) / max(1, total)
+                sy = lat_h.count_between(env.ymin, env.ymax) / max(1, total)
+                sel += sx * sy
+            return min(1.0, sel)
+
+        dtg = ft.default_date
+        if dtg is not None and isinstance(f, (ast.During, ast.Before, ast.After, ast.TEquals, ast.Cmp, ast.Between)):
+            prop = getattr(f, "prop", None)
+            if prop == dtg.name:
+                iv = extract_intervals(f, dtg.name)
+                h = stats.get("dtg")
+                if not iv.values or h is None or h.is_empty:
+                    return None
+                sel = 0.0
+                for b in iv.values:
+                    lo = float(b.lower.value) if b.lower.value is not None else h.lo
+                    hi = float(b.upper.value) if b.upper.value is not None else h.hi
+                    sel += h.count_between(lo, hi) / max(1, total)
+                return min(1.0, sel)
+
+        # attribute equality via frequency sketch
+        if isinstance(f, ast.Cmp) and f.op == "=":
+            freq = stats.get(f"freq:{f.prop}")
+            if freq is not None and not freq.is_empty:
+                return freq.count(f.literal) / max(1, total)
+            mm = stats.get(f"minmax:{f.prop}")
+            if mm is not None and not mm.is_empty and mm.cardinality > 0:
+                return 1.0 / mm.cardinality
+        if isinstance(f, ast.Cmp) and f.op in ("<", "<=", ">", ">="):
+            mm = stats.get(f"minmax:{f.prop}")
+            if mm is not None and not mm.is_empty:
+                try:
+                    lo, hi = float(mm.min), float(mm.max)
+                    v = float(f.literal)
+                    if hi <= lo:
+                        return 1.0
+                    frac = (v - lo) / (hi - lo)
+                    frac = max(0.0, min(1.0, frac))
+                    return frac if f.op in ("<", "<=") else 1.0 - frac
+                except (TypeError, ValueError):
+                    return None
+        return None

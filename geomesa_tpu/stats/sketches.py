@@ -1,0 +1,554 @@
+"""Mergeable summary sketches over columnar batches.
+
+Each sketch mirrors a reference Stat implementation (geomesa-utils
+.../stats/): observe() takes a numpy column (plus optional null mask),
+``+`` merges two sketches of the same shape (the tablet-partial reduce in
+StatsScan / StatsCombiner), and to_json/from_json round-trips for metadata
+persistence (StatSerializer.scala analog, JSON instead of kryo).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.curve import TimePeriod, time_to_binned
+from geomesa_tpu.curve.sfc import Z3SFC
+
+
+class Stat:
+    """Base sketch (stats/Stat.scala)."""
+
+    kind = "stat"
+
+    def observe(self, values: np.ndarray, nulls: Optional[np.ndarray] = None) -> None:
+        raise NotImplementedError
+
+    def __add__(self, other: "Stat") -> "Stat":
+        out = self.copy()
+        out.merge(other)
+        return out
+
+    def merge(self, other: "Stat") -> None:
+        raise NotImplementedError
+
+    def copy(self) -> "Stat":
+        return from_json(self.to_json())
+
+    def to_json(self) -> str:
+        return json.dumps({"kind": self.kind, **self.state()})
+
+    def state(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    @property
+    def is_empty(self) -> bool:
+        raise NotImplementedError
+
+
+def _hash64(values: np.ndarray) -> np.ndarray:
+    """Seed-stable 64-bit hashes: strings via blake2b (Python hash() is
+    per-process randomized, which would corrupt persisted sketches on
+    reload), numerics via splitmix64 of the float bits."""
+    if values.dtype.kind in "OUS":
+        import hashlib
+
+        return np.array(
+            [
+                int.from_bytes(
+                    hashlib.blake2b(str(v).encode(), digest_size=8).digest(), "little"
+                )
+                for v in values
+            ],
+            dtype=np.uint64,
+        )
+    return _mix64(np.asarray(values, dtype=np.float64).view(np.uint64))
+
+
+def _clean(values: np.ndarray, nulls: Optional[np.ndarray]) -> np.ndarray:
+    if nulls is not None:
+        values = values[~nulls]
+    if values.dtype.kind == "f":
+        values = values[~np.isnan(values)]
+    elif values.dtype.kind == "O":
+        values = values[np.array([v is not None for v in values], dtype=bool)]
+    return values
+
+
+class CountStat(Stat):
+    """Total observed count (stats/CountStat.scala)."""
+
+    kind = "count"
+
+    def __init__(self, count: int = 0):
+        self.count = int(count)
+
+    def observe(self, values, nulls=None):
+        self.count += int(len(values))
+
+    def merge(self, other):
+        self.count += other.count
+
+    def state(self):
+        return {"count": self.count}
+
+    @property
+    def is_empty(self):
+        return self.count == 0
+
+
+class MinMax(Stat):
+    """Attribute bounds + HLL-style cardinality estimate (stats/MinMax.scala).
+
+    Cardinality uses a fixed 2^12-register hyperloglog over a 64-bit hash,
+    matching the role (not the bits) of the reference's HyperLogLog field.
+    """
+
+    kind = "minmax"
+    _P = 12  # registers = 4096
+
+    def __init__(self, attribute: str, dtype: str = "f8"):
+        self.attribute = attribute
+        self.dtype = dtype
+        self.min: Optional[Any] = None
+        self.max: Optional[Any] = None
+        self.registers = np.zeros(1 << self._P, dtype=np.int8)
+
+    def observe(self, values, nulls=None):
+        values = _clean(np.asarray(values), nulls)
+        if not len(values):
+            return
+        if values.dtype.kind in "OUS":
+            vmin, vmax = min(values), max(values)
+        else:
+            vmin, vmax = values.min(), values.max()
+        h = _hash64(values)
+        self.min = vmin if self.min is None else min(self.min, vmin)
+        self.max = vmax if self.max is None else max(self.max, vmax)
+        idx = (h >> np.uint64(64 - self._P)).astype(np.int64)
+        rho = (
+            np.clip(_leading_zeros_53(h << np.uint64(self._P)), 0, 64 - self._P) + 1
+        ).astype(np.int8)
+        np.maximum.at(self.registers, idx, rho)
+
+    @property
+    def cardinality(self) -> float:
+        m = float(len(self.registers))
+        if not self.registers.any():
+            return 0.0
+        alpha = 0.7213 / (1 + 1.079 / m)
+        est = alpha * m * m / np.sum(np.exp2(-self.registers.astype(np.float64)))
+        zeros = int(np.sum(self.registers == 0))
+        if est <= 2.5 * m and zeros:
+            est = m * math.log(m / zeros)
+        return float(est)
+
+    def merge(self, other):
+        if other.min is not None:
+            self.min = other.min if self.min is None else min(self.min, other.min)
+            self.max = other.max if self.max is None else max(self.max, other.max)
+        np.maximum(self.registers, other.registers, out=self.registers)
+
+    def state(self):
+        mn, mx = self.min, self.max
+        if isinstance(mn, np.generic):
+            mn = mn.item()
+        if isinstance(mx, np.generic):
+            mx = mx.item()
+        return {
+            "attribute": self.attribute,
+            "dtype": self.dtype,
+            "min": mn,
+            "max": mx,
+            "registers": self.registers.tolist(),
+        }
+
+    @property
+    def is_empty(self):
+        return self.min is None
+
+
+def _mix64(h: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer, vectorized."""
+    h = h.astype(np.uint64)
+    h = (h ^ (h >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    h = (h ^ (h >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return h ^ (h >> np.uint64(31))
+
+
+def _leading_zeros_53(h: np.ndarray) -> np.ndarray:
+    """Approximate 64-bit leading-zero count via float exponent (exact for
+    the top 53 bits, which is all HLL rank estimation needs)."""
+    out = np.full(h.shape, 64, dtype=np.int64)
+    nz = h != 0
+    f = h[nz].astype(np.float64)
+    out[nz] = 63 - np.floor(np.log2(f)).astype(np.int64)
+    return out
+
+
+class EnumerationStat(Stat):
+    """Exact value -> count map (stats/EnumerationStat.scala)."""
+
+    kind = "enumeration"
+
+    def __init__(self, attribute: str):
+        self.attribute = attribute
+        self.counts: Dict[Any, int] = {}
+
+    def observe(self, values, nulls=None):
+        values = _clean(np.asarray(values), nulls)
+        uniq, cnt = np.unique(values, return_counts=True)
+        for v, c in zip(uniq, cnt):
+            v = v.item() if isinstance(v, np.generic) else v
+            self.counts[v] = self.counts.get(v, 0) + int(c)
+
+    def merge(self, other):
+        for v, c in other.counts.items():
+            self.counts[v] = self.counts.get(v, 0) + c
+
+    def state(self):
+        return {"attribute": self.attribute, "counts": list(self.counts.items())}
+
+    @property
+    def is_empty(self):
+        return not self.counts
+
+
+class TopK(Stat):
+    """Space-saving top-k (stats/TopK.scala, StreamSummary analog)."""
+
+    kind = "topk"
+
+    def __init__(self, attribute: str, capacity: int = 1000):
+        self.attribute = attribute
+        self.capacity = capacity
+        self.counts: Dict[Any, int] = {}
+
+    def observe(self, values, nulls=None):
+        values = _clean(np.asarray(values), nulls)
+        uniq, cnt = np.unique(values, return_counts=True)
+        for v, c in zip(uniq, cnt):
+            v = v.item() if isinstance(v, np.generic) else v
+            if v in self.counts:
+                self.counts[v] += int(c)
+            elif len(self.counts) < self.capacity:
+                self.counts[v] = int(c)
+            else:  # evict current min (space-saving substitution)
+                mv = min(self.counts, key=self.counts.get)
+                mc = self.counts.pop(mv)
+                self.counts[v] = mc + int(c)
+
+    def topk(self, k: int = 10) -> List[Tuple[Any, int]]:
+        return sorted(self.counts.items(), key=lambda kv: -kv[1])[:k]
+
+    def merge(self, other):
+        for v, c in other.counts.items():
+            self.counts[v] = self.counts.get(v, 0) + c
+        while len(self.counts) > self.capacity:
+            self.counts.pop(min(self.counts, key=self.counts.get))
+
+    def state(self):
+        return {
+            "attribute": self.attribute,
+            "capacity": self.capacity,
+            "counts": list(self.counts.items()),
+        }
+
+    @property
+    def is_empty(self):
+        return not self.counts
+
+
+class Histogram(Stat):
+    """Fixed-width binned counts over [lo, hi] (stats/Histogram.scala:1-273,
+    BinnedArray semantics: clamp out-of-range values into the end bins)."""
+
+    kind = "histogram"
+
+    def __init__(self, attribute: str, bins: int, lo: float, hi: float):
+        self.attribute = attribute
+        self.bins = int(bins)
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.counts = np.zeros(self.bins, dtype=np.int64)
+
+    def observe(self, values, nulls=None):
+        values = _clean(np.asarray(values, dtype=np.float64), nulls)
+        if not len(values):
+            return
+        idx = np.floor((values - self.lo) * self.bins / (self.hi - self.lo)).astype(np.int64)
+        idx = np.clip(idx, 0, self.bins - 1)
+        np.add.at(self.counts, idx, 1)
+
+    def bin_bounds(self, i: int) -> Tuple[float, float]:
+        w = (self.hi - self.lo) / self.bins
+        return self.lo + i * w, self.lo + (i + 1) * w
+
+    def count_between(self, lo: float, hi: float) -> float:
+        """Estimated count in [lo, hi] with partial-bin interpolation
+        (the StatsBasedEstimator selectivity primitive)."""
+        if hi < self.lo or lo > self.hi:
+            return 0.0
+        w = (self.hi - self.lo) / self.bins
+        total = 0.0
+        for i in range(self.bins):
+            blo, bhi = self.bin_bounds(i)
+            overlap = min(hi, bhi) - max(lo, blo)
+            if overlap > 0:
+                total += self.counts[i] * min(1.0, overlap / w)
+        return total
+
+    def merge(self, other):
+        if (other.lo, other.hi, other.bins) != (self.lo, self.hi, self.bins):
+            raise ValueError("histogram shapes differ")
+        self.counts += other.counts
+
+    def state(self):
+        return {
+            "attribute": self.attribute,
+            "bins": self.bins,
+            "lo": self.lo,
+            "hi": self.hi,
+            "counts": self.counts.tolist(),
+        }
+
+    @property
+    def is_empty(self):
+        return not self.counts.any()
+
+
+class Frequency(Stat):
+    """Count-min sketch (stats/Frequency.scala)."""
+
+    kind = "frequency"
+    _DEPTH = 4
+
+    def __init__(self, attribute: str, width: int = 1024):
+        self.attribute = attribute
+        self.width = int(width)
+        self.table = np.zeros((self._DEPTH, self.width), dtype=np.int64)
+
+    def _hashes(self, values: np.ndarray) -> np.ndarray:
+        base = _hash64(values)
+        rows = []
+        for d in range(self._DEPTH):
+            h = _mix64(base + np.uint64((0x9E3779B97F4A7C15 * (d + 1)) & 0xFFFFFFFFFFFFFFFF))
+            rows.append((h % np.uint64(self.width)).astype(np.int64))
+        return np.stack(rows)
+
+    def observe(self, values, nulls=None):
+        values = _clean(np.asarray(values), nulls)
+        if not len(values):
+            return
+        idx = self._hashes(values)
+        for d in range(self._DEPTH):
+            np.add.at(self.table[d], idx[d], 1)
+
+    def count(self, value) -> int:
+        idx = self._hashes(np.asarray([value]))
+        return int(min(self.table[d, idx[d, 0]] for d in range(self._DEPTH)))
+
+    def merge(self, other):
+        if other.width != self.width:
+            raise ValueError("frequency widths differ")
+        self.table += other.table
+
+    def state(self):
+        return {
+            "attribute": self.attribute,
+            "width": self.width,
+            "table": self.table.tolist(),
+        }
+
+    @property
+    def is_empty(self):
+        return not self.table.any()
+
+
+class DescriptiveStats(Stat):
+    """Running mean/variance (Welford-merged; stats/DescriptiveStats.scala)."""
+
+    kind = "descriptive"
+
+    def __init__(self, attribute: str):
+        self.attribute = attribute
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def observe(self, values, nulls=None):
+        values = _clean(np.asarray(values, dtype=np.float64), nulls)
+        if not len(values):
+            return
+        other = DescriptiveStats(self.attribute)
+        other.n = len(values)
+        other.mean = float(values.mean())
+        other.m2 = float(((values - other.mean) ** 2).sum())
+        self.merge(other)
+
+    @property
+    def variance(self) -> float:
+        return self.m2 / (self.n - 1) if self.n > 1 else 0.0
+
+    def merge(self, other):
+        if other.n == 0:
+            return
+        if self.n == 0:
+            self.n, self.mean, self.m2 = other.n, other.mean, other.m2
+            return
+        n = self.n + other.n
+        delta = other.mean - self.mean
+        self.m2 = self.m2 + other.m2 + delta * delta * self.n * other.n / n
+        self.mean = self.mean + delta * other.n / n
+        self.n = n
+
+    def state(self):
+        return {"attribute": self.attribute, "n": self.n, "mean": self.mean, "m2": self.m2}
+
+    @property
+    def is_empty(self):
+        return self.n == 0
+
+
+class Z3HistogramStat(Stat):
+    """Spatio-temporal density histogram keyed by coarse z3 (stats/Z3Histogram.scala:1-176):
+    counts per (time bin, z3 prefix at ``length`` bits of the full key)."""
+
+    kind = "z3histogram"
+
+    def __init__(self, geom: str, dtg: str, period: str = "week", length: int = 1024):
+        self.geom = geom
+        self.dtg = dtg
+        self.period = TimePeriod.parse(period)
+        self.length = int(length)
+        self.counts: Dict[int, np.ndarray] = {}
+
+    def observe_xyt(self, x: np.ndarray, y: np.ndarray, t_ms: np.ndarray) -> None:
+        ok = ~(np.isnan(x) | np.isnan(y))
+        x, y, t_ms = x[ok], y[ok], np.asarray(t_ms)[ok]
+        if not len(x):
+            return
+        bins, offsets = time_to_binned(t_ms, self.period, lenient=True)
+        sfc = Z3SFC.for_period(self.period)
+        z = sfc.index(x, y, offsets, lenient=True).astype(np.uint64)
+        # top bits of the 63-bit key -> [0, length)
+        shift = np.uint64(63 - int(self.length - 1).bit_length())
+        idx = (z >> shift).astype(np.int64)
+        idx = np.clip(idx, 0, self.length - 1)
+        for b in np.unique(bins):
+            sel = bins == b
+            arr = self.counts.setdefault(int(b), np.zeros(self.length, dtype=np.int64))
+            np.add.at(arr, idx[sel], 1)
+
+    def observe(self, values, nulls=None):  # columnar entry used by service
+        raise TypeError("Z3HistogramStat.observe_xyt(x, y, t) required")
+
+    def merge(self, other):
+        for b, arr in other.counts.items():
+            mine = self.counts.setdefault(b, np.zeros(self.length, dtype=np.int64))
+            mine += arr
+
+    def state(self):
+        return {
+            "geom": self.geom,
+            "dtg": self.dtg,
+            "period": self.period.value,
+            "length": self.length,
+            "counts": {str(b): arr.tolist() for b, arr in self.counts.items()},
+        }
+
+    @property
+    def is_empty(self):
+        return not self.counts
+
+
+class SeqStat(Stat):
+    """Multiple sketches observed together (Stat.scala SeqStat)."""
+
+    kind = "seq"
+
+    def __init__(self, stats: Sequence[Stat]):
+        self.stats = list(stats)
+
+    def observe(self, values, nulls=None):
+        for s in self.stats:
+            s.observe(values, nulls)
+
+    def merge(self, other):
+        for a, b in zip(self.stats, other.stats):
+            a.merge(b)
+
+    def state(self):
+        return {"stats": [json.loads(s.to_json()) for s in self.stats]}
+
+    @property
+    def is_empty(self):
+        return all(s.is_empty for s in self.stats)
+
+
+_KINDS = {}
+
+
+def _register(cls):
+    _KINDS[cls.kind] = cls
+    return cls
+
+
+for _cls in (
+    CountStat,
+    MinMax,
+    EnumerationStat,
+    TopK,
+    Histogram,
+    Frequency,
+    DescriptiveStats,
+    Z3HistogramStat,
+    SeqStat,
+):
+    _register(_cls)
+
+
+def from_json(text: str) -> Stat:
+    d = json.loads(text)
+    return _from_state(d)
+
+
+def _from_state(d: Dict[str, Any]) -> Stat:
+    kind = d.pop("kind")
+    if kind == "count":
+        return CountStat(d["count"])
+    if kind == "minmax":
+        s = MinMax(d["attribute"], d.get("dtype", "f8"))
+        s.min, s.max = d["min"], d["max"]
+        s.registers = np.asarray(d["registers"], dtype=np.int8)
+        return s
+    if kind == "enumeration":
+        s = EnumerationStat(d["attribute"])
+        s.counts = {k: v for k, v in (tuple(p) for p in d["counts"])}
+        return s
+    if kind == "topk":
+        s = TopK(d["attribute"], d["capacity"])
+        s.counts = {k: v for k, v in (tuple(p) for p in d["counts"])}
+        return s
+    if kind == "histogram":
+        s = Histogram(d["attribute"], d["bins"], d["lo"], d["hi"])
+        s.counts = np.asarray(d["counts"], dtype=np.int64)
+        return s
+    if kind == "frequency":
+        s = Frequency(d["attribute"], d["width"])
+        s.table = np.asarray(d["table"], dtype=np.int64)
+        return s
+    if kind == "descriptive":
+        s = DescriptiveStats(d["attribute"])
+        s.n, s.mean, s.m2 = d["n"], d["mean"], d["m2"]
+        return s
+    if kind == "z3histogram":
+        s = Z3HistogramStat(d["geom"], d["dtg"], d["period"], d["length"])
+        s.counts = {int(b): np.asarray(a, dtype=np.int64) for b, a in d["counts"].items()}
+        return s
+    if kind == "seq":
+        return SeqStat([_from_state(x) for x in d["stats"]])
+    raise ValueError(f"unknown stat kind: {kind}")

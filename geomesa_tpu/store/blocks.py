@@ -223,6 +223,8 @@ class IndexTable:
         self.ft = ft
         self.blocks: List[FeatureBlock] = []
         self.tombstones: set = set()
+        # bumped on every mutation; device-resident mirrors key off this
+        self.version = 0
 
     @property
     def num_rows(self) -> int:
@@ -232,9 +234,11 @@ class IndexTable:
         if not columns or len(next(iter(columns.values()))) == 0:
             return
         self.blocks.append(FeatureBlock.build(self.index, self.ft, columns))
+        self.version += 1
 
     def delete(self, fids: Sequence[str]):
         self.tombstones.update(fids)
+        self.version += 1
 
     def scan(self, ranges: Sequence[ScanRange]) -> Iterator[Tuple[FeatureBlock, np.ndarray]]:
         for b in self.blocks:
@@ -266,5 +270,6 @@ class IndexTable:
         merged = concat_columns(parts)
         self.blocks = []
         self.tombstones = set()
+        self.version += 1
         if merged:
             self.insert(merged)

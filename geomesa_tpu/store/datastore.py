@@ -136,13 +136,20 @@ class TpuDataStore:
         metadata: Optional[Metadata] = None,
         executor: Optional["ScanExecutor"] = None,
         flush_size: int = DEFAULT_FLUSH_SIZE,
+        stats: Optional[Any] = None,
     ):
+        from geomesa_tpu.stats.service import MetadataBackedStats
+
         self.metadata = metadata or InMemoryMetadata()
         self.executor = executor or HostScanExecutor()
         self.flush_size = flush_size
+        # write-time maintained sketches feeding the cost-based decider
+        # (accumulo/data/stats/StatsCombiner.scala:26 analog)
+        self.stats = stats if stats is not None else MetadataBackedStats(self.metadata)
         self._schemas: Dict[str, FeatureType] = {}
         self._indices: Dict[str, List[IndexKeySpace]] = {}
         self._tables: Dict[str, Dict[str, IndexTable]] = {}
+        self._plan_cache: Dict[Any, QueryPlan] = {}
         # recover schemas from persistent metadata
         for name in self.metadata.scan_types():
             spec = self.metadata.read(name, "attributes")
@@ -192,6 +199,8 @@ class TpuDataStore:
     def _insert_columns(self, ft: FeatureType, columns: Columns):
         for table in self._tables[ft.name].values():
             table.insert(columns)
+        if self.stats is not None:
+            self.stats.observe_columns(ft, columns)
 
     def delete_features(self, name: str, fids: Sequence[str]):
         for table in self._tables[name].values():
@@ -212,7 +221,7 @@ class TpuDataStore:
     # -- queries ------------------------------------------------------------
 
     def planner(self, name: str) -> QueryPlanner:
-        return QueryPlanner(self.get_schema(name), self._indices[name])
+        return QueryPlanner(self.get_schema(name), self._indices[name], self.stats)
 
     def explain(self, name: str, query: Union[str, Query]) -> str:
         query = self._as_query(query)
@@ -222,17 +231,19 @@ class TpuDataStore:
     def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
         ft = self.get_schema(name)
         query = self._as_query(query)
-        plan = self.planner(name).plan(query)
+        plan = self._plan_cached(name, query)
         if plan.is_empty:
             return QueryResult(ft, _empty_columns(ft), plan)
 
         tables = self._tables[name]
         table = tables[plan.index.name]
         parts: List[Columns] = []
-        if plan.ranges:
-            scan = table.scan(plan.ranges)
-        else:
-            scan = table.scan_all()
+        scan = self.executor.scan_candidates(table, plan)
+        if scan is None:
+            if plan.ranges:
+                scan = table.scan(plan.ranges)
+            else:
+                scan = table.scan_all()
         for block, rows in scan:
             mask_cols = take_rows(block.columns, rows)
             if plan.post_filter is not None:
@@ -251,9 +262,32 @@ class TpuDataStore:
             return query
         return Query.cql(query)
 
+    def _plan_cached(self, name: str, query: Query) -> QueryPlan:
+        """Plan cache keyed on (type, filter text, table state) — the
+        IteratorCache analog (iterators/IteratorCache.scala:1-97)."""
+        from geomesa_tpu.filter.parser import to_cql
+
+        versions = tuple(t.version for t in self._tables[name].values())
+        key = (name, to_cql(query.filter), versions)
+        plan = self._plan_cache.get(key)
+        if plan is None:
+            plan = self.planner(name).plan(query)
+            if len(self._plan_cache) > 256:
+                self._plan_cache.clear()
+            self._plan_cache[key] = plan
+        return plan
+
 
 class ScanExecutor:
-    """Pluggable post-filter execution (host numpy vs TPU kernels)."""
+    """Pluggable scan execution (host numpy vs TPU kernels).
+
+    ``scan_candidates`` may return an iterator of (block, rows) candidate
+    sets computed on device (the tserver-iterator analog) or None to fall
+    back to host range scanning; ``post_filter`` enforces exact semantics.
+    """
+
+    def scan_candidates(self, table, plan: QueryPlan):
+        return None
 
     def post_filter(self, ft: FeatureType, plan: QueryPlan, columns: Columns) -> np.ndarray:
         raise NotImplementedError

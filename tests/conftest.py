@@ -3,13 +3,27 @@
 Mirrors the reference's mock-cluster test pattern (SURVEY.md section 4):
 distributed behavior is exercised in-process, here via
 ``xla_force_host_platform_device_count`` instead of Accumulo MockInstance.
+
+Tests must not ride the axon remote-TPU tunnel (the session claim can take
+minutes and serializes processes): clear the pool override for any
+subprocesses and pin the jax platform to cpu even if a site hook already
+registered the remote plugin at interpreter startup.
 """
 
 import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # jax missing entirely -> host-only tests still run
+    pass

@@ -1,0 +1,135 @@
+"""Device-kernel parity tests: jnp limb kernels vs host numpy curve layer.
+
+Mirrors the reference's SFC invariant tests (geomesa-z3 Z3Test/Z2Test) but in
+the two-tier pattern SURVEY.md section 4 prescribes: the scalar/host encoder
+is the oracle, the device kernel must agree bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import zorder
+from geomesa_tpu.ops import (
+    bbox_mask_f32,
+    limbs_in_range,
+    pad_boxes,
+    pad_windows,
+    z2_decode_limbs,
+    z2_encode_limbs,
+    z2_query_mask,
+    z3_decode_limbs,
+    z3_encode_limbs,
+    z3_query_mask,
+)
+from geomesa_tpu.ops.zkernels import limbs_to_i64, split_i64_to_limbs
+
+RNG = np.random.default_rng(42)
+
+
+def test_z2_encode_limbs_matches_host():
+    xi = RNG.integers(0, 1 << 31, size=5000).astype(np.int64)
+    yi = RNG.integers(0, 1 << 31, size=5000).astype(np.int64)
+    want = zorder.z2_encode(xi, yi)
+    hi, lo = z2_encode_limbs(xi.astype(np.uint32), yi.astype(np.uint32))
+    got = limbs_to_i64(np.asarray(hi), np.asarray(lo))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_z2_decode_limbs_roundtrip():
+    xi = RNG.integers(0, 1 << 31, size=2000).astype(np.int64)
+    yi = RNG.integers(0, 1 << 31, size=2000).astype(np.int64)
+    z = zorder.z2_encode(xi, yi)
+    hi, lo = split_i64_to_limbs(z)
+    dx, dy = z2_decode_limbs(hi, lo)
+    np.testing.assert_array_equal(np.asarray(dx, dtype=np.int64), xi)
+    np.testing.assert_array_equal(np.asarray(dy, dtype=np.int64), yi)
+
+
+def test_z3_encode_limbs_matches_host():
+    xi = RNG.integers(0, 1 << 21, size=5000).astype(np.int64)
+    yi = RNG.integers(0, 1 << 21, size=5000).astype(np.int64)
+    ti = RNG.integers(0, 1 << 21, size=5000).astype(np.int64)
+    want = zorder.z3_encode(xi, yi, ti)
+    hi, lo = z3_encode_limbs(
+        xi.astype(np.uint32), yi.astype(np.uint32), ti.astype(np.uint32)
+    )
+    got = limbs_to_i64(np.asarray(hi), np.asarray(lo))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_z3_encode_limbs_extremes():
+    top = (1 << 21) - 1
+    xi = np.array([0, top, 0, top, 0x155555], dtype=np.uint32)
+    yi = np.array([0, 0, top, top, 0x0AAAAA], dtype=np.uint32)
+    ti = np.array([top, 0, 0, top, 0x1FFFFF], dtype=np.uint32)
+    want = zorder.z3_encode(xi.astype(np.int64), yi.astype(np.int64), ti.astype(np.int64))
+    hi, lo = z3_encode_limbs(xi, yi, ti)
+    np.testing.assert_array_equal(limbs_to_i64(np.asarray(hi), np.asarray(lo)), want)
+
+
+def test_z3_decode_limbs_roundtrip():
+    xi = RNG.integers(0, 1 << 21, size=2000).astype(np.int64)
+    yi = RNG.integers(0, 1 << 21, size=2000).astype(np.int64)
+    ti = RNG.integers(0, 1 << 21, size=2000).astype(np.int64)
+    z = zorder.z3_encode(xi, yi, ti)
+    hi, lo = split_i64_to_limbs(z)
+    dx, dy, dt = z3_decode_limbs(hi, lo)
+    np.testing.assert_array_equal(np.asarray(dx, dtype=np.int64), xi)
+    np.testing.assert_array_equal(np.asarray(dy, dtype=np.int64), yi)
+    np.testing.assert_array_equal(np.asarray(dt, dtype=np.int64), ti)
+
+
+def test_limbs_in_range_matches_int64():
+    keys = RNG.integers(0, 1 << 62, size=3000).astype(np.int64)
+    lo_i = int(RNG.integers(0, 1 << 61))
+    hi_i = lo_i + int(RNG.integers(0, 1 << 60))
+    want = (keys >= lo_i) & (keys <= hi_i)
+    k_hi, k_lo = split_i64_to_limbs(keys)
+    l_hi, l_lo = split_i64_to_limbs(np.array([lo_i]))
+    u_hi, u_lo = split_i64_to_limbs(np.array([hi_i]))
+    got = limbs_in_range(k_hi, k_lo, l_hi[0], l_lo[0], u_hi[0], u_lo[0])
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_z3_query_mask_matches_numpy():
+    n = 4000
+    xi = RNG.integers(0, 1 << 21, size=n).astype(np.int32)
+    yi = RNG.integers(0, 1 << 21, size=n).astype(np.int32)
+    bins = RNG.integers(0, 4, size=n).astype(np.int16)
+    offs = RNG.integers(0, 1 << 21, size=n).astype(np.int32)
+    valid = RNG.random(n) > 0.1
+
+    raw_boxes = [(100, 200, 500000, 800000), (1 << 20, 0, (1 << 21) - 1, 300000)]
+    raw_windows = [(1, 0, 1 << 20), (2, 500, 600000)]
+    boxes = pad_boxes(raw_boxes)
+    windows = pad_windows(raw_windows)
+
+    spatial = np.zeros(n, dtype=bool)
+    for xlo, ylo, xhi, yhi in raw_boxes:
+        spatial |= (xi >= xlo) & (xi <= xhi) & (yi >= ylo) & (yi <= yhi)
+    temporal = np.zeros(n, dtype=bool)
+    for b, lo, hi in raw_windows:
+        temporal |= (bins == b) & (offs >= lo) & (offs <= hi)
+    want = valid & spatial & temporal
+
+    got = z3_query_mask(xi, yi, bins, offs, valid, boxes, windows)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_z2_query_mask_and_padding_never_matches():
+    n = 1000
+    xi = RNG.integers(0, 1 << 31, size=n).astype(np.uint32)
+    yi = RNG.integers(0, 1 << 31, size=n).astype(np.uint32)
+    valid = np.ones(n, dtype=bool)
+    got = z2_query_mask(
+        xi.astype(np.int64), yi.astype(np.int64), valid, pad_boxes([])
+    )
+    assert not np.asarray(got).any()
+
+
+def test_bbox_mask_f32():
+    x = np.array([0.0, 10.0, -5.0, 3.0], dtype=np.float32)
+    y = np.array([0.0, 10.0, -5.0, 3.0], dtype=np.float32)
+    boxes = np.array([[-1.0, -1.0, 5.0, 5.0]], dtype=np.float32)
+    got = np.asarray(bbox_mask_f32(x, y, boxes))
+    np.testing.assert_array_equal(got, [True, False, False, True])

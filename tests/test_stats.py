@@ -1,0 +1,153 @@
+"""Stats sketches + estimator tests (mirrors geomesa-utils stats tests)."""
+
+import numpy as np
+
+from geomesa_tpu.stats import (
+    CountStat,
+    DescriptiveStats,
+    EnumerationStat,
+    Frequency,
+    Histogram,
+    MetadataBackedStats,
+    MinMax,
+    TopK,
+    parse_stat,
+)
+from geomesa_tpu.stats.sketches import SeqStat, from_json
+from geomesa_tpu.schema.featuretype import parse_spec
+
+RNG = np.random.default_rng(5)
+
+
+def test_minmax_and_cardinality():
+    s = MinMax("a")
+    vals = RNG.integers(0, 5000, 20000).astype(np.float64)
+    s.observe(vals)
+    assert s.min == vals.min() and s.max == vals.max()
+    card = s.cardinality
+    true = len(np.unique(vals))
+    assert 0.8 * true < card < 1.2 * true
+
+
+def test_minmax_merge():
+    a, b = MinMax("a"), MinMax("a")
+    a.observe(np.array([1.0, 5.0]))
+    b.observe(np.array([-3.0, 2.0]))
+    c = a + b
+    assert c.min == -3.0 and c.max == 5.0
+
+
+def test_histogram_counts_and_estimate():
+    h = Histogram("a", 100, 0.0, 100.0)
+    vals = RNG.uniform(0, 100, 50000)
+    h.observe(vals)
+    assert h.counts.sum() == 50000
+    est = h.count_between(25.0, 75.0)
+    assert abs(est - 25000) < 1500
+
+
+def test_histogram_clamps_outliers():
+    h = Histogram("a", 10, 0.0, 10.0)
+    h.observe(np.array([-5.0, 15.0]))
+    assert h.counts[0] == 1 and h.counts[-1] == 1
+
+
+def test_frequency_counts():
+    f = Frequency("a", width=2048)
+    vals = np.array(["x"] * 500 + ["y"] * 20 + ["z"] * 3, dtype=object)
+    f.observe(vals)
+    assert f.count("x") >= 500  # CMS overestimates only
+    assert f.count("y") >= 20
+    assert f.count("missing") < 25
+
+
+def test_topk_and_enumeration():
+    t = TopK("a", capacity=10)
+    e = EnumerationStat("a")
+    vals = np.array(["a"] * 100 + ["b"] * 50 + ["c"] * 2, dtype=object)
+    t.observe(vals)
+    e.observe(vals)
+    assert t.topk(2) == [("a", 100), ("b", 50)]
+    assert e.counts == {"a": 100, "b": 50, "c": 2}
+
+
+def test_descriptive_merge_matches_flat():
+    d1, d2, d3 = DescriptiveStats("a"), DescriptiveStats("a"), DescriptiveStats("a")
+    v1, v2 = RNG.normal(3, 2, 1000), RNG.normal(-1, 0.5, 500)
+    d1.observe(v1)
+    d2.observe(v2)
+    d3.observe(np.concatenate([v1, v2]))
+    merged = d1 + d2
+    assert abs(merged.mean - d3.mean) < 1e-9
+    assert abs(merged.variance - d3.variance) < 1e-6
+
+
+def test_json_roundtrip():
+    spec = "Count();MinMax(a);Histogram(a,10,0,1);Frequency(a);TopK(a)"
+    s = parse_stat(spec)
+    assert isinstance(s, SeqStat)
+    s.stats[1].observe(np.array([0.5]))
+    r = from_json(s.to_json())
+    assert r.stats[1].min == 0.5
+
+
+def test_service_estimates_and_bounds():
+    ft = parse_spec("t", "actor:String:index=true,age:Int,dtg:Date,*geom:Point:srid=4326")
+    svc = MetadataBackedStats()
+    n = 20000
+    x = RNG.uniform(-10, 10, n)
+    y = RNG.uniform(-10, 10, n)
+    t = (
+        np.datetime64("2026-01-01", "ms").astype(np.int64)
+        + RNG.integers(0, 10 * 86400_000, n)
+    )
+    actors = np.array(["USA"] * (n // 2) + ["FRA"] * (n // 2), dtype=object)
+    svc.observe_columns(
+        ft,
+        {
+            "geom__x": x,
+            "geom__y": y,
+            "dtg": t,
+            "actor": actors,
+            "age": RNG.integers(0, 100, n).astype(np.int32),
+        },
+    )
+    assert svc.get_count(ft) == n
+    b = svc.get_bounds(ft)
+    assert b is not None and -10.01 < b[0] < -9.9 and 9.9 < b[2] < 10.01
+
+    from geomesa_tpu.filter.parser import parse_cql
+
+    # half the world in x, all in y -> ~ half the data
+    est = svc.get_count(ft, parse_cql("bbox(geom, -10, -10, 0, 10)"))
+    assert est is not None and 0.4 * n < est < 0.6 * n
+    est = svc.get_count(ft, parse_cql("actor = 'USA'"))
+    assert est is not None and 0.45 * n < est < 0.65 * n
+
+
+def test_cost_based_decider_prefers_selective_attribute():
+    """With stats, a highly selective attribute filter should beat z3."""
+    from geomesa_tpu.index.keyspace import default_indices
+    from geomesa_tpu.index.planner import QueryPlanner
+    from geomesa_tpu.index.strategy import get_filter_strategies
+    from geomesa_tpu.filter.parser import parse_cql
+
+    ft = parse_spec("t", "actor:String:index=true,dtg:Date,*geom:Point:srid=4326")
+    svc = MetadataBackedStats()
+    n = 10000
+    x = RNG.uniform(-180, 180, n)
+    y = RNG.uniform(-90, 90, n)
+    t = (
+        np.datetime64("2026-01-01", "ms").astype(np.int64)
+        + RNG.integers(0, 10 * 86400_000, n)
+    )
+    actors = np.array(["common"] * (n - 5) + ["rare"] * 5, dtype=object)
+    svc.observe_columns(ft, {"geom__x": x, "geom__y": y, "dtg": t, "actor": actors})
+
+    f = parse_cql(
+        "actor = 'rare' AND bbox(geom, -170, -80, 170, 80) AND "
+        "dtg DURING 2026-01-01T00:00:00Z/2026-01-09T00:00:00Z"
+    )
+    strategies = get_filter_strategies(ft, default_indices(ft), f, svc)
+    best = min(strategies, key=lambda s: s.cost)
+    assert best.index.name == "attr:actor"
