@@ -6,7 +6,7 @@ path and a brute-force host reference (the stand-in for the reference's
 in-memory CQEngine datastore, geomesa-memory GeoCQEngine.scala:34).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Tune with env GEOMESA_BENCH_N (rows, default 2_000_000) and
+Tune with env GEOMESA_BENCH_N (rows, default 5_000_000) and
 GEOMESA_BENCH_REPS (timed repetitions, default 20).
 """
 

@@ -1,0 +1,151 @@
+"""Aggregation hint execution: density / stats / BIN over filtered columns.
+
+Host-side reducers mirroring the reference's aggregating scans
+(index-api iterators/DensityScan.scala:30-59, StatsScan, BinAggregatingScan
++ BinaryOutputEncoder bin/BinaryOutputEncoder.scala:28-360) and the client
+reduce step (planning/QueryPlanner.scala:87-92). The TpuScanExecutor provides
+a fused device fast path for density (ops/aggregations.py); these reducers
+are the exact host fallback and the final merge.
+
+Hint shapes (conf/QueryHints.scala analogs):
+  hints["density"] = {"envelope": (xmin, ymin, xmax, ymax),
+                      "width": int, "height": int, "weight": attr | None}
+  hints["stats"]   = "MinMax(a);Count()"  (Stat spec string)
+  hints["bin"]     = {"track": attr, "geom": attr | None, "dtg": attr | None,
+                      "label": attr | None}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from geomesa_tpu.schema.featuretype import FeatureType
+from geomesa_tpu.stats.parser import parse_stat
+from geomesa_tpu.stats.sketches import Stat, Z3HistogramStat
+
+
+def has_aggregation(hints: Dict[str, Any]) -> bool:
+    return any(k in hints for k in ("density", "stats", "bin"))
+
+
+def density_grid_numpy(
+    x: np.ndarray,
+    y: np.ndarray,
+    weight: Optional[np.ndarray],
+    env,
+    width: int,
+    height: int,
+) -> np.ndarray:
+    """Host density grid with GridSnap semantics (GridSnap.scala:1-120);
+    the oracle for the device kernel and the exact/weighted fallback."""
+    xmin, ymin, xmax, ymax = env
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    in_env = (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
+    col = np.clip(np.floor((x[in_env] - xmin) / dx).astype(np.int64), 0, width - 1)
+    row = np.clip(np.floor((y[in_env] - ymin) / dy).astype(np.int64), 0, height - 1)
+    w = weight[in_env] if weight is not None else np.ones(int(in_env.sum()))
+    grid = np.zeros((height, width), dtype=np.float64)
+    np.add.at(grid, (row, col), w)
+    return grid
+
+
+def run_density(ft: FeatureType, spec: Dict[str, Any], columns) -> np.ndarray:
+    geom = ft.default_geometry.name
+    x = columns.get(geom + "__x")
+    y = columns.get(geom + "__y")
+    if x is None:
+        raise ValueError("density requires a point geometry")
+    weight = None
+    if spec.get("weight"):
+        weight = np.asarray(columns[spec["weight"]], dtype=np.float64)
+    return density_grid_numpy(
+        x, y, weight, tuple(spec["envelope"]), int(spec["width"]), int(spec["height"])
+    )
+
+
+def run_stats(ft: FeatureType, spec: str, columns) -> Stat:
+    stat = parse_stat(spec)
+    stats = stat.stats if hasattr(stat, "stats") else [stat]
+    n = len(next(iter(columns.values()), []))
+    for s in stats:
+        if isinstance(s, Z3HistogramStat):
+            s.observe_xyt(columns[s.geom + "__x"], columns[s.geom + "__y"], columns[s.dtg])
+            continue
+        attr = getattr(s, "attribute", None)
+        if attr is None:  # CountStat
+            s.count += n
+            continue
+        geom = ft.default_geometry
+        if geom is not None and attr == geom.name:
+            attr = geom.name + "__x"  # bounds callers use minmax of x/y pairs
+        nulls = columns.get(attr + "__null")
+        s.observe(columns[attr], nulls)
+    return stat
+
+
+# 16-byte BIN record: trackId hash (i32) | dtg seconds (i32) | lat f32 | lon f32
+# 24-byte adds label bytes (8). BinaryOutputEncoder.scala:28-360.
+BIN_DTYPE = np.dtype(
+    [("track", "<i4"), ("dtg", "<i4"), ("lat", "<f4"), ("lon", "<f4")]
+)
+BIN_DTYPE_LABEL = np.dtype(
+    [("track", "<i4"), ("dtg", "<i4"), ("lat", "<f4"), ("lon", "<f4"), ("label", "<i8")]
+)
+
+
+def _track_ids(values: np.ndarray) -> np.ndarray:
+    """Stable 32-bit ids for track values (string hashCode analog)."""
+    import hashlib
+
+    if values.dtype.kind in "iuf":
+        return values.astype(np.int32)
+    out = np.empty(len(values), dtype=np.int32)
+    cache: Dict[Any, int] = {}
+    for i, v in enumerate(values):
+        h = cache.get(v)
+        if h is None:
+            h = int.from_bytes(
+                hashlib.blake2b(str(v).encode(), digest_size=4).digest(),
+                "little",
+                signed=True,
+            )
+            cache[v] = h
+        out[i] = h
+    return out
+
+
+def run_bin(ft: FeatureType, spec: Dict[str, Any], columns) -> np.ndarray:
+    geom = spec.get("geom") or ft.default_geometry.name
+    dtg = spec.get("dtg") or (ft.default_date.name if ft.default_date else None)
+    track = spec["track"]
+    n = len(next(iter(columns.values()), []))
+    dtype = BIN_DTYPE_LABEL if spec.get("label") else BIN_DTYPE
+    out = np.zeros(n, dtype=dtype)
+    track_col = columns.get(track)
+    if track_col is None and track == "id":
+        track_col = columns["__fid__"]
+    out["track"] = _track_ids(np.asarray(track_col))
+    if dtg is not None:
+        out["dtg"] = (np.asarray(columns[dtg], dtype=np.int64) // 1000).astype(np.int32)
+    out["lat"] = np.asarray(columns[geom + "__y"], dtype=np.float32)
+    out["lon"] = np.asarray(columns[geom + "__x"], dtype=np.float32)
+    if spec.get("label"):
+        out["label"] = _track_ids(np.asarray(columns[spec["label"]])).astype(np.int64)
+    if spec.get("sort") and dtg is not None:
+        out = out[np.argsort(out["dtg"], kind="stable")]
+    return out
+
+
+def run_aggregation(ft: FeatureType, hints: Dict[str, Any], columns) -> Dict[str, Any]:
+    """Dispatch all requested aggregations over the filtered columns."""
+    out: Dict[str, Any] = {}
+    if "density" in hints:
+        out["density"] = run_density(ft, hints["density"], columns)
+    if "stats" in hints:
+        out["stats"] = run_stats(ft, hints["stats"], columns)
+    if "bin" in hints:
+        out["bin"] = run_bin(ft, hints["bin"], columns)
+    return out

@@ -1,0 +1,109 @@
+"""Device aggregation push-downs: density grids and masked reductions.
+
+The reference runs aggregations inside tablet servers so only small partial
+results travel to the client (AggregatingScan.scala:22-168, DensityScan.scala:
+30-59 with GridSnap, StatsScan, BinAggregatingScan). The TPU analog fuses the
+candidate mask with the aggregation in one XLA pass over sharded columns —
+features never leave HBM; only the [H, W] grid / scalar reductions do.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from geomesa_tpu.ops.filters import spatial_mask, temporal_mask
+from geomesa_tpu.parallel.mesh import DATA_AXIS
+
+
+def grid_snap_indices(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    env: jnp.ndarray,
+    width: int,
+    height: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(col, row, in_env) with GridSnap semantics (utils GridSnap.scala:1-120):
+    i = floor((v - min) * n / extent), right edge snapped into the last cell.
+    ``env`` is a dynamic [4] array (xmin, ymin, xmax, ymax) so new query
+    envelopes don't recompile the kernel; only width/height are static."""
+    xmin, ymin, xmax, ymax = env[0], env[1], env[2], env[3]
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    col = jnp.floor((x - xmin) / dx).astype(jnp.int32)
+    row = jnp.floor((y - ymin) / dy).astype(jnp.int32)
+    in_env = (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
+    col = jnp.clip(col, 0, width - 1)
+    row = jnp.clip(row, 0, height - 1)
+    return col, row, in_env
+
+
+def density_kernel(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    env: jnp.ndarray,
+    width: int,
+    height: int,
+) -> jnp.ndarray:
+    """Masked scatter-add into an [H, W] grid (DensityScan analog)."""
+    col, row, in_env = grid_snap_indices(x, y, env, width, height)
+    w = jnp.where(mask & in_env, jnp.float32(1.0), jnp.float32(0.0))
+    flat = row * width + col
+    grid = jnp.zeros(height * width, dtype=jnp.float32)
+    grid = grid.at[flat].add(w)
+    return grid.reshape(height, width)
+
+
+def make_sharded_density(mesh, width: int, height: int):
+    """Build jitted shard_map density passes: per-shard fused exact-predicate
+    mask + scatter, partial grids psum'd over the row axis (the client-merge
+    analog, QueryPlanner.scala:87-92, but on ICI instead of RPC).
+
+    The spatial test runs on raw f32 coords vs raw boxes, the temporal test
+    on raw (bin, offset) windows — exact query semantics, not the coarse
+    int-domain candidate test, so the grid needs no post-filter.
+    """
+    from geomesa_tpu.ops.filters import bbox_mask_f32
+
+    def step(x, y, bins, offs, valid, boxes, windows, env):
+        m = valid & bbox_mask_f32(x, y, boxes) & temporal_mask(bins, offs, windows)
+        return jax.lax.psum(density_kernel(x, y, m, env, width, height), DATA_AXIS)
+
+    def step_no_time(x, y, valid, boxes, env):
+        m = valid & bbox_mask_f32(x, y, boxes)
+        return jax.lax.psum(density_kernel(x, y, m, env, width, height), DATA_AXIS)
+
+    d = P(DATA_AXIS)
+    r = P()
+    with_time = jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(d, d, d, d, d, r, r, r),
+            out_specs=r,
+        )
+    )
+    no_time = jax.jit(
+        shard_map(
+            step_no_time,
+            mesh=mesh,
+            in_specs=(d, d, d, r, r),
+            out_specs=r,
+        )
+    )
+    return with_time, no_time
+
+
+# the host reference implementation lives in geomesa_tpu.index.aggregators
+# (pure numpy, so the host-only datastore path has no jax dependency)

@@ -29,13 +29,17 @@ def _next_bucket(n: int, minimum: int = 4) -> int:
     return b
 
 
-def pad_boxes(boxes: Sequence[Tuple[int, int, int, int]], minimum: int = 4) -> np.ndarray:
-    """[(xlo, ylo, xhi, yhi)] int boxes -> [K, 4] int32, padded with empties.
+def pad_boxes(
+    boxes: Sequence[Tuple[float, float, float, float]],
+    minimum: int = 4,
+    dtype=np.int32,
+) -> np.ndarray:
+    """[(xlo, ylo, xhi, yhi)] boxes -> [K, 4] padded to a pow2 bucket.
 
     Padding uses inverted boxes (lo > hi) which can never contain a point.
     """
     k = _next_bucket(max(len(boxes), 1), minimum)
-    out = np.empty((k, 4), dtype=np.int32)
+    out = np.empty((k, 4), dtype=dtype)
     out[:, 0] = 1
     out[:, 1] = 1
     out[:, 2] = 0

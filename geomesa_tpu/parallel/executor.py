@@ -22,6 +22,7 @@ import jax
 import numpy as np
 
 from geomesa_tpu.curve import zorder
+from geomesa_tpu.curve.binnedtime import TimePeriod, binned_to_time
 from geomesa_tpu.index.planner import QueryPlan
 from geomesa_tpu.ops.filters import (
     pad_boxes,
@@ -55,10 +56,15 @@ class DeviceIndex:
         self.mesh = mesh
         self.version = table.version
         self.kind = table.index.name  # "z3" | "z2"
+        ft = table.ft
+        geom = ft.default_geometry.name
         xs: List[np.ndarray] = []
         ys: List[np.ndarray] = []
         ts: List[np.ndarray] = []
         bins: List[np.ndarray] = []
+        xfs: List[np.ndarray] = []
+        yfs: List[np.ndarray] = []
+        traw: List[np.ndarray] = []
         self.block_starts: List[int] = []
         n = 0
         for b in table.blocks:
@@ -68,26 +74,38 @@ class DeviceIndex:
                 xi, yi, ti = zorder.z3_decode(key)
                 ts.append(ti.astype(np.int32))
                 bins.append(b.bins.astype(np.int32))
+                # ms-precision in-bin offsets power exact temporal tests for
+                # fused aggregations; only day/week bins are uniform and fit
+                # int32 (month/year fall back to the host path)
+                if ft.z3_interval in (TimePeriod.DAY, TimePeriod.WEEK):
+                    t_ms = b.columns[ft.default_date.name].astype(np.int64)
+                    starts = binned_to_time(
+                        b.bins.astype(np.int64), np.zeros(b.n, np.int64), ft.z3_interval
+                    )
+                    traw.append((t_ms - starts).astype(np.int32))
             else:
                 xi, yi = zorder.z2_decode(key)
             xs.append(xi.astype(np.int32))
             ys.append(yi.astype(np.int32))
+            xfs.append(b.columns[geom + "__x"].astype(np.float32))
+            yfs.append(b.columns[geom + "__y"].astype(np.float32))
             n += b.n
         self.n = n
         m = max(1, mesh.devices.size)
-        xi = pad_to_multiple(np.concatenate(xs) if xs else np.empty(0, np.int32), m, 0)
-        yi = pad_to_multiple(np.concatenate(ys) if ys else np.empty(0, np.int32), m, 0)
-        valid = pad_to_multiple(np.ones(n, dtype=bool), m, False)
-        self.xi = shard_array(mesh, xi)
-        self.yi = shard_array(mesh, yi)
-        self.valid = shard_array(mesh, valid)
+
+        def pack(parts, dtype, fill):
+            arr = np.concatenate(parts) if parts else np.empty(0, dtype)
+            return shard_array(mesh, pad_to_multiple(arr, m, fill))
+
+        self.xi = pack(xs, np.int32, 0)
+        self.yi = pack(ys, np.int32, 0)
+        self.xf = pack(xfs, np.float32, 0.0)
+        self.yf = pack(yfs, np.float32, 0.0)
+        self.valid = shard_array(mesh, pad_to_multiple(np.ones(n, dtype=bool), m, False))
         if self.kind == "z3":
-            ti = pad_to_multiple(np.concatenate(ts) if ts else np.empty(0, np.int32), m, 0)
-            bi = pad_to_multiple(
-                np.concatenate(bins) if bins else np.empty(0, np.int32), m, -1
-            )
-            self.ti = shard_array(mesh, ti)
-            self.bins = shard_array(mesh, bi)
+            self.ti = pack(ts, np.int32, 0)
+            self.bins = pack(bins, np.int32, -1)
+            self.t_ms = pack(traw, np.int32, -1) if traw or not table.blocks else None
 
     def mask(self, boxes: np.ndarray, windows: Optional[np.ndarray]) -> np.ndarray:
         b = replicate(self.mesh, boxes)
@@ -124,6 +142,7 @@ class TpuScanExecutor:
         # to its table: identity is re-checked on hit and dead entries are
         # evicted (frees the device-resident shards)
         self._cache: Dict[int, Tuple["weakref.ref", DeviceIndex]] = {}
+        self._density_fns: Dict[Tuple[int, int], tuple] = {}
 
     def device_index(self, table: IndexTable) -> DeviceIndex:
         import weakref
@@ -189,3 +208,89 @@ class TpuScanExecutor:
         from geomesa_tpu.filter.evaluate import evaluate
 
         return evaluate(plan.post_filter, ft, columns)
+
+    _BIN_MS = {TimePeriod.DAY: 86400000, TimePeriod.WEEK: 604800000}
+
+    def _ms_windows(self, ft, plan: QueryPlan):
+        """Per-bin inclusive ms windows, exact vs the query's ms semantics.
+
+        Requires a single extracted interval (multiple intervals can merge
+        into over-wide per-bin windows) and a uniform day/week bin length;
+        returns None when the device temporal test cannot be exact.
+        """
+        iv = plan.values.intervals
+        if iv is None or not iv.precise or len(iv.values) != 1:
+            return None
+        bin_ms = self._BIN_MS.get(ft.z3_interval)
+        if bin_ms is None:
+            return None
+        b = iv.values[0]
+        lo_ms = None if b.lower.value is None else int(b.lower.value)
+        hi_ms = None if b.upper.value is None else int(b.upper.value)
+        if lo_ms is not None and not b.lower.inclusive:
+            lo_ms += 1
+        if hi_ms is not None and not b.upper.inclusive:
+            hi_ms -= 1
+        out = []
+        for bn in sorted(plan.values.bins):
+            start = int(
+                binned_to_time(np.asarray([bn]), np.asarray([0]), ft.z3_interval)[0]
+            )
+            wlo = 0 if lo_ms is None else max(lo_ms - start, 0)
+            whi = bin_ms - 1 if hi_ms is None else min(hi_ms - start, bin_ms - 1)
+            if whi >= wlo:
+                out.append((bn, wlo, whi))
+        return out
+
+    # -- fused aggregation push-down ----------------------------------------
+
+    def density_scan(self, table: IndexTable, plan: QueryPlan, spec):
+        """Fused filter + density grid on device (the server-side
+        KryoLazyDensityIterator analog); None -> host fallback.
+
+        Supported when the full filter is precise rectangles (+ one time
+        interval over uniform day/week bins, evaluated at ms precision) with
+        no residual CQL. Spatial compares run in float32 — points within one
+        f32 ulp of a box edge may classify differently than the f64 host
+        path, mirroring the reference's loose-bbox point semantics
+        (index/z2/Z2Index.scala:26-40); pass {"exact": True} in the density
+        hint to force the host path.
+        """
+        if not self.supports(table, plan):
+            return None
+        if plan.secondary is not None or spec.get("weight") or spec.get("exact"):
+            return None
+        gv = plan.values.geometries
+        if not gv.values or not gv.precise or not all(g.is_rectangle() for g in gv.values):
+            return None
+        windows = None
+        if table.index.name == "z3":
+            if not plan.values.bins or getattr(self.device_index(table), "t_ms", None) is None:
+                return None
+            windows = self._ms_windows(table.ft, plan)
+            if windows is None:
+                return None
+        width, height = int(spec["width"]), int(spec["height"])
+        dev = self.device_index(table)
+        fns = self._density_fns.get((width, height))
+        if fns is None:
+            from geomesa_tpu.ops.aggregations import make_sharded_density
+
+            fns = make_sharded_density(self.mesh, width, height)
+            self._density_fns[(width, height)] = fns
+        boxes = pad_boxes(
+            [
+                (g.envelope.xmin, g.envelope.ymin, g.envelope.xmax, g.envelope.ymax)
+                for g in gv.values
+            ],
+            dtype=np.float32,
+        )
+        env = np.asarray(spec["envelope"], dtype=np.float32)
+        b = replicate(self.mesh, boxes)
+        e = replicate(self.mesh, env)
+        if dev.kind == "z3":
+            w = replicate(self.mesh, pad_windows(windows))
+            grid = fns[0](dev.xf, dev.yf, dev.bins, dev.t_ms, dev.valid, b, w, e)
+        else:
+            grid = fns[1](dev.xf, dev.yf, dev.valid, b, e)
+        return np.asarray(grid, dtype=np.float64)

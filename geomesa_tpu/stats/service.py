@@ -100,8 +100,6 @@ class MetadataBackedStats(GeoMesaStats):
             if a.type in (AttributeType.INT, AttributeType.LONG, AttributeType.FLOAT,
                           AttributeType.DOUBLE, AttributeType.DATE):
                 stats[f"minmax:{a.name}"] = MinMax(a.name)
-                if a.indexed:
-                    stats[f"hist:{a.name}"] = None  # lazy: bounds unknown up front
             elif a.type == AttributeType.STRING:
                 stats[f"topk:{a.name}"] = TopK(a.name)
                 stats[f"freq:{a.name}"] = Frequency(a.name)

@@ -289,17 +289,18 @@ class Histogram(Stat):
 
     def count_between(self, lo: float, hi: float) -> float:
         """Estimated count in [lo, hi] with partial-bin interpolation
-        (the StatsBasedEstimator selectivity primitive)."""
+        (the StatsBasedEstimator selectivity primitive). Vectorized over the
+        overlapping bin slice — this runs on the per-query planning path."""
         if hi < self.lo or lo > self.hi:
             return 0.0
         w = (self.hi - self.lo) / self.bins
-        total = 0.0
-        for i in range(self.bins):
-            blo, bhi = self.bin_bounds(i)
-            overlap = min(hi, bhi) - max(lo, blo)
-            if overlap > 0:
-                total += self.counts[i] * min(1.0, overlap / w)
-        return total
+        first = max(0, int((lo - self.lo) / w))
+        last = min(self.bins - 1, int((hi - self.lo) / w))
+        idx = np.arange(first, last + 1)
+        blo = self.lo + idx * w
+        overlap = np.minimum(hi, blo + w) - np.maximum(lo, blo)
+        frac = np.clip(overlap / w, 0.0, 1.0)
+        return float(np.dot(self.counts[first : last + 1], frac))
 
     def merge(self, other):
         if (other.lo, other.hi, other.bins) != (self.lo, self.hi, self.bins):

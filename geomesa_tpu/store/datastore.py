@@ -21,6 +21,7 @@ import numpy as np
 
 from geomesa_tpu.filter import ast, evaluate
 from geomesa_tpu.filter.parser import parse_cql
+from geomesa_tpu.index.aggregators import has_aggregation, run_aggregation
 from geomesa_tpu.index.keyspace import IndexKeySpace, default_indices
 from geomesa_tpu.index.planner import Explainer, Query, QueryPlan, QueryPlanner
 from geomesa_tpu.schema.feature import Feature
@@ -40,10 +41,18 @@ DEFAULT_FLUSH_SIZE = 100_000
 class QueryResult:
     """Columnar query result with row-feature accessors."""
 
-    def __init__(self, ft: FeatureType, columns: Columns, plan: Optional[QueryPlan] = None):
+    def __init__(
+        self,
+        ft: FeatureType,
+        columns: Columns,
+        plan: Optional[QueryPlan] = None,
+        aggregate: Optional[Dict[str, Any]] = None,
+    ):
         self.ft = ft
         self.columns = columns
         self.plan = plan
+        # density grid / stats sketch / bin records when hints requested them
+        self.aggregate = aggregate or {}
 
     def __len__(self):
         for v in self.columns.values():
@@ -237,6 +246,14 @@ class TpuDataStore:
 
         tables = self._tables[name]
         table = tables[plan.index.name]
+
+        # fused device density push-down: grid comes back, features don't
+        # (the KryoLazyDensityIterator analog)
+        if set(query.hints) & {"density", "stats", "bin"} == {"density"}:
+            grid = self.executor.density_scan(table, plan, query.hints["density"])
+            if grid is not None:
+                return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})
+
         parts: List[Columns] = []
         scan = self.executor.scan_candidates(table, plan)
         if scan is None:
@@ -254,6 +271,9 @@ class TpuDataStore:
                 parts.append(mask_cols)
         columns = concat_columns(parts) if parts else _empty_columns(ft)
         columns = _dedupe_by_fid(columns)
+        if has_aggregation(query.hints):
+            agg = run_aggregation(ft, query.hints, columns)
+            return QueryResult(ft, _empty_columns(ft), plan, agg)
         columns = _apply_query_options(ft, query, columns)
         return QueryResult(ft, columns, plan)
 
@@ -287,6 +307,10 @@ class ScanExecutor:
     """
 
     def scan_candidates(self, table, plan: QueryPlan):
+        return None
+
+    def density_scan(self, table, plan: QueryPlan, spec) -> Optional[np.ndarray]:
+        """Fused filter+density on device; None -> host reducer fallback."""
         return None
 
     def post_filter(self, ft: FeatureType, plan: QueryPlan, columns: Columns) -> np.ndarray:

@@ -1,0 +1,117 @@
+"""Aggregation push-down tests: density / stats / bin hints, device vs host.
+
+Mirrors the reference's aggregating-iterator tests (DensityIteratorTest,
+StatsIteratorTest, BinAggregatingIteratorTest shapes): same store contents,
+aggregation via hints, host reducer is the oracle for the device fused path.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+
+SPEC = "actor:String,val:Double,dtg:Date,*geom:Point:srid=4326"
+CQL = "bbox(geom, -20, -20, 20, 20) AND dtg DURING 2026-01-02T00:00:00Z/2026-01-12T00:00:00Z"
+
+
+def _fill(store, n=5000, seed=11):
+    rng = np.random.default_rng(seed)
+    ft = parse_spec("agg", SPEC)
+    store.create_schema(ft)
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    cols = {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-50, 50, n),
+        "geom__y": rng.uniform(-50, 50, n),
+        "dtg": base + rng.integers(0, 20 * 86400, n) * 1000,  # whole seconds
+        "actor": np.array([["USA", "FRA", "CHN"][i % 3] for i in range(n)], dtype=object),
+        "val": rng.uniform(0, 10, n),
+    }
+    store._insert_columns(ft, cols)
+    return ft, cols
+
+
+@pytest.fixture(scope="module")
+def host_store():
+    s = TpuDataStore(executor=HostScanExecutor())
+    _fill(s)
+    return s
+
+
+@pytest.fixture(scope="module")
+def tpu_store():
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    _fill(s)
+    return s
+
+
+DENSITY = {"envelope": (-20.0, -20.0, 20.0, 20.0), "width": 32, "height": 16}
+
+
+def test_density_host_matches_brute(host_store):
+    q = Query.cql(CQL, hints={"density": dict(DENSITY)})
+    res = host_store.query("agg", q)
+    grid = res.aggregate["density"]
+    assert grid.shape == (16, 32)
+    plain = host_store.query("agg", CQL)
+    assert grid.sum() == len(plain)
+
+
+def test_density_device_matches_host(host_store, tpu_store):
+    q = Query.cql(CQL, hints={"density": dict(DENSITY)})
+    want = host_store.query("agg", q).aggregate["density"]
+    got = tpu_store.query("agg", q).aggregate["density"]
+    np.testing.assert_allclose(got, want)
+
+
+def test_density_device_fused_path_taken(tpu_store):
+    plan = tpu_store._plan_cached("agg", Query.cql(CQL))
+    table = tpu_store._tables["agg"][plan.index.name]
+    grid = tpu_store.executor.density_scan(table, plan, DENSITY)
+    assert grid is not None
+
+
+def test_density_weighted(host_store):
+    q = Query.cql(CQL, hints={"density": {**DENSITY, "weight": "val"}})
+    res = host_store.query("agg", q)
+    plain = host_store.query("agg", CQL)
+    want = np.asarray(plain.columns["val"]).sum()
+    np.testing.assert_allclose(res.aggregate["density"].sum(), want)
+
+
+def test_stats_hint(host_store):
+    q = Query.cql(CQL, hints={"stats": "Count();MinMax(val)"})
+    res = host_store.query("agg", q)
+    stat = res.aggregate["stats"]
+    plain = host_store.query("agg", CQL)
+    assert stat.stats[0].count == len(plain)
+    vals = np.asarray(plain.columns["val"])
+    assert stat.stats[1].min == vals.min()
+    assert stat.stats[1].max == vals.max()
+
+
+def test_bin_hint(host_store):
+    q = Query.cql(CQL, hints={"bin": {"track": "actor", "sort": True}})
+    res = host_store.query("agg", q)
+    recs = res.aggregate["bin"]
+    plain = host_store.query("agg", CQL)
+    assert len(recs) == len(plain)
+    assert recs.dtype.itemsize == 16
+    assert (np.diff(recs["dtg"]) >= 0).all()
+    # 3 distinct track ids
+    assert len(np.unique(recs["track"])) == 3
+    # lat/lon round-trip within f32
+    assert np.abs(recs["lon"]).max() <= 20.0 + 1e-3
+
+
+def test_aggregation_parity_host_vs_tpu_bin(host_store, tpu_store):
+    q = Query.cql(CQL, hints={"bin": {"track": "actor"}})
+    a = host_store.query("agg", q).aggregate["bin"]
+    b = tpu_store.query("agg", q).aggregate["bin"]
+    a = np.sort(a, order=["track", "dtg", "lon"])
+    b = np.sort(b, order=["track", "dtg", "lon"])
+    np.testing.assert_array_equal(a, b)
