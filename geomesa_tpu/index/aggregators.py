@@ -23,7 +23,7 @@ import numpy as np
 
 from geomesa_tpu.schema.featuretype import FeatureType
 from geomesa_tpu.stats.parser import parse_stat
-from geomesa_tpu.stats.sketches import Stat, Z3HistogramStat
+from geomesa_tpu.stats.sketches import EnvelopeStat, Stat, Z3HistogramStat
 
 
 def has_aggregation(hints: Dict[str, Any]) -> bool:
@@ -69,8 +69,9 @@ def run_density(ft: FeatureType, spec: Dict[str, Any], columns) -> np.ndarray:
 def run_stats(ft: FeatureType, spec: str, columns) -> Stat:
     stat = parse_stat(spec)
     stats = stat.stats if hasattr(stat, "stats") else [stat]
+    geom = ft.default_geometry
     n = len(next(iter(columns.values()), []))
-    for s in stats:
+    for i, s in enumerate(stats):
         if isinstance(s, Z3HistogramStat):
             s.observe_xyt(columns[s.geom + "__x"], columns[s.geom + "__y"], columns[s.dtg])
             continue
@@ -78,9 +79,18 @@ def run_stats(ft: FeatureType, spec: str, columns) -> Stat:
         if attr is None:  # CountStat
             s.count += n
             continue
-        geom = ft.default_geometry
         if geom is not None and attr == geom.name:
-            attr = geom.name + "__x"  # bounds callers use minmax of x/y pairs
+            # MinMax over a geometry means 2D envelope bounds in the
+            # reference; swap in the envelope sketch
+            env = EnvelopeStat(attr)
+            env.observe_xy(
+                np.asarray(columns[attr + "__x"], dtype=np.float64),
+                np.asarray(columns[attr + "__y"], dtype=np.float64),
+            )
+            stats[i] = env
+            if stats is not getattr(stat, "stats", None):
+                stat = env
+            continue
         nulls = columns.get(attr + "__null")
         s.observe(columns[attr], nulls)
     return stat

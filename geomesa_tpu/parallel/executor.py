@@ -57,14 +57,12 @@ class DeviceIndex:
         self.version = table.version
         self.kind = table.index.name  # "z3" | "z2"
         ft = table.ft
-        geom = ft.default_geometry.name
         xs: List[np.ndarray] = []
         ys: List[np.ndarray] = []
         ts: List[np.ndarray] = []
         bins: List[np.ndarray] = []
-        xfs: List[np.ndarray] = []
-        yfs: List[np.ndarray] = []
-        traw: List[np.ndarray] = []
+        fid_count = 0
+        fid_set = set()
         self.block_starts: List[int] = []
         n = 0
         for b in table.blocks:
@@ -74,38 +72,60 @@ class DeviceIndex:
                 xi, yi, ti = zorder.z3_decode(key)
                 ts.append(ti.astype(np.int32))
                 bins.append(b.bins.astype(np.int32))
-                # ms-precision in-bin offsets power exact temporal tests for
-                # fused aggregations; only day/week bins are uniform and fit
-                # int32 (month/year fall back to the host path)
-                if ft.z3_interval in (TimePeriod.DAY, TimePeriod.WEEK):
-                    t_ms = b.columns[ft.default_date.name].astype(np.int64)
-                    starts = binned_to_time(
-                        b.bins.astype(np.int64), np.zeros(b.n, np.int64), ft.z3_interval
-                    )
-                    traw.append((t_ms - starts).astype(np.int32))
             else:
                 xi, yi = zorder.z2_decode(key)
             xs.append(xi.astype(np.int32))
             ys.append(yi.astype(np.int32))
-            xfs.append(b.columns[geom + "__x"].astype(np.float32))
-            yfs.append(b.columns[geom + "__y"].astype(np.float32))
+            fids = b.columns["__fid__"]
+            fid_count += len(fids)
+            fid_set.update(fids)
             n += b.n
         self.n = n
+        # duplicate fids (feature updates) are deduped by the candidate path;
+        # fused aggregations must fall back to host when present
+        self.has_duplicate_fids = len(fid_set) != fid_count
         m = max(1, mesh.devices.size)
-
-        def pack(parts, dtype, fill):
-            arr = np.concatenate(parts) if parts else np.empty(0, dtype)
-            return shard_array(mesh, pad_to_multiple(arr, m, fill))
-
-        self.xi = pack(xs, np.int32, 0)
-        self.yi = pack(ys, np.int32, 0)
-        self.xf = pack(xfs, np.float32, 0.0)
-        self.yf = pack(yfs, np.float32, 0.0)
+        self._m = m
+        self.xi = self._pack(xs, np.int32, 0)
+        self.yi = self._pack(ys, np.int32, 0)
         self.valid = shard_array(mesh, pad_to_multiple(np.ones(n, dtype=bool), m, False))
+        # raw f32 coords + ms offsets are only needed by fused aggregations;
+        # packed lazily on first density_scan (load_raw)
+        self.xf = self.yf = self.t_ms = None
+        self._raw_loaded = False
         if self.kind == "z3":
-            self.ti = pack(ts, np.int32, 0)
-            self.bins = pack(bins, np.int32, -1)
-            self.t_ms = pack(traw, np.int32, -1) if traw or not table.blocks else None
+            self.ti = self._pack(ts, np.int32, 0)
+            self.bins = self._pack(bins, np.int32, -1)
+
+    def _pack(self, parts, dtype, fill):
+        arr = np.concatenate(parts) if parts else np.empty(0, dtype)
+        return shard_array(self.mesh, pad_to_multiple(arr, self._m, fill))
+
+    def load_raw(self, table: IndexTable) -> bool:
+        """Pack raw f32 coords (+ in-bin ms offsets for day/week z3) for the
+        fused aggregation path. Returns False when unsupported (month/year
+        bins are non-uniform / overflow int32 ms offsets)."""
+        if self._raw_loaded:
+            return self.kind == "z2" or self.t_ms is not None
+        self._raw_loaded = True
+        ft = table.ft
+        geom = ft.default_geometry.name
+        xfs = [b.columns[geom + "__x"].astype(np.float32) for b in table.blocks]
+        yfs = [b.columns[geom + "__y"].astype(np.float32) for b in table.blocks]
+        self.xf = self._pack(xfs, np.float32, 0.0)
+        self.yf = self._pack(yfs, np.float32, 0.0)
+        if self.kind == "z3":
+            if ft.z3_interval not in (TimePeriod.DAY, TimePeriod.WEEK):
+                return False
+            traw = []
+            for b in table.blocks:
+                t_ms = b.columns[ft.default_date.name].astype(np.int64)
+                starts = binned_to_time(
+                    b.bins.astype(np.int64), np.zeros(b.n, np.int64), ft.z3_interval
+                )
+                traw.append((t_ms - starts).astype(np.int32))
+            self.t_ms = self._pack(traw, np.int32, -1)
+        return True
 
     def mask(self, boxes: np.ndarray, windows: Optional[np.ndarray]) -> np.ndarray:
         b = replicate(self.mesh, boxes)
@@ -214,11 +234,19 @@ class TpuScanExecutor:
     def _ms_windows(self, ft, plan: QueryPlan):
         """Per-bin inclusive ms windows, exact vs the query's ms semantics.
 
-        Requires a single extracted interval (multiple intervals can merge
-        into over-wide per-bin windows) and a uniform day/week bin length;
-        returns None when the device temporal test cannot be exact.
+        Re-extracts intervals from the full filter WITHOUT exclusive-bound
+        rounding (plan.values.intervals were widened to whole seconds for
+        range planning, extract.py handle_exclusive_bounds) so the ±1ms
+        adjustment here matches the host post-filter exactly. Requires a
+        single interval (multiple intervals can merge into over-wide per-bin
+        windows) and a uniform day/week bin length; returns None when the
+        device temporal test cannot be exact.
         """
-        iv = plan.values.intervals
+        from geomesa_tpu.filter.extract import extract_intervals
+
+        if plan.full_filter is None:
+            return None
+        iv = extract_intervals(plan.full_filter, ft.default_date.name)
         if iv is None or not iv.precise or len(iv.values) != 1:
             return None
         bin_ms = self._BIN_MS.get(ft.z3_interval)
@@ -263,15 +291,21 @@ class TpuScanExecutor:
         gv = plan.values.geometries
         if not gv.values or not gv.precise or not all(g.is_rectangle() for g in gv.values):
             return None
+        dev = self.device_index(table)
+        if dev.has_duplicate_fids:
+            # updates leave multiple live rows per fid; the candidate path
+            # dedupes them, a fused aggregation would double-count
+            return None
         windows = None
         if table.index.name == "z3":
-            if not plan.values.bins or getattr(self.device_index(table), "t_ms", None) is None:
+            if not plan.values.bins:
                 return None
             windows = self._ms_windows(table.ft, plan)
             if windows is None:
                 return None
+        if not dev.load_raw(table):
+            return None
         width, height = int(spec["width"]), int(spec["height"])
-        dev = self.device_index(table)
         fns = self._density_fns.get((width, height))
         if fns is None:
             from geomesa_tpu.ops.aggregations import make_sharded_density

@@ -414,6 +414,66 @@ class DescriptiveStats(Stat):
         return self.n == 0
 
 
+class EnvelopeStat(Stat):
+    """2D bounds over a point geometry attribute — what MinMax(geom) means in
+    the reference (MinMax.scala over Geometry unions envelopes)."""
+
+    kind = "envelope"
+
+    def __init__(self, attribute: str):
+        self.attribute = attribute
+        self.xmin = self.ymin = self.xmax = self.ymax = None
+
+    def observe_xy(self, x: np.ndarray, y: np.ndarray) -> None:
+        ok = ~(np.isnan(x) | np.isnan(y))
+        if not ok.any():
+            return
+        x, y = x[ok], y[ok]
+        lo_x, hi_x, lo_y, hi_y = x.min(), x.max(), y.min(), y.max()
+        if self.xmin is None:
+            self.xmin, self.xmax = float(lo_x), float(hi_x)
+            self.ymin, self.ymax = float(lo_y), float(hi_y)
+        else:
+            self.xmin = min(self.xmin, float(lo_x))
+            self.xmax = max(self.xmax, float(hi_x))
+            self.ymin = min(self.ymin, float(lo_y))
+            self.ymax = max(self.ymax, float(hi_y))
+
+    def observe(self, values, nulls=None):
+        raise TypeError("EnvelopeStat.observe_xy(x, y) required")
+
+    @property
+    def bounds(self):
+        if self.xmin is None:
+            return None
+        return (self.xmin, self.ymin, self.xmax, self.ymax)
+
+    def merge(self, other):
+        if other.xmin is None:
+            return
+        if self.xmin is None:
+            self.xmin, self.ymin = other.xmin, other.ymin
+            self.xmax, self.ymax = other.xmax, other.ymax
+        else:
+            self.xmin = min(self.xmin, other.xmin)
+            self.ymin = min(self.ymin, other.ymin)
+            self.xmax = max(self.xmax, other.xmax)
+            self.ymax = max(self.ymax, other.ymax)
+
+    def state(self):
+        return {
+            "attribute": self.attribute,
+            "xmin": self.xmin,
+            "ymin": self.ymin,
+            "xmax": self.xmax,
+            "ymax": self.ymax,
+        }
+
+    @property
+    def is_empty(self):
+        return self.xmin is None
+
+
 class Z3HistogramStat(Stat):
     """Spatio-temporal density histogram keyed by coarse z3 (stats/Z3Histogram.scala:1-176):
     counts per (time bin, z3 prefix at ``length`` bits of the full key)."""
@@ -506,6 +566,7 @@ for _cls in (
     Histogram,
     Frequency,
     DescriptiveStats,
+    EnvelopeStat,
     Z3HistogramStat,
     SeqStat,
 ):
@@ -545,6 +606,11 @@ def _from_state(d: Dict[str, Any]) -> Stat:
     if kind == "descriptive":
         s = DescriptiveStats(d["attribute"])
         s.n, s.mean, s.m2 = d["n"], d["mean"], d["m2"]
+        return s
+    if kind == "envelope":
+        s = EnvelopeStat(d["attribute"])
+        s.xmin, s.ymin = d["xmin"], d["ymin"]
+        s.xmax, s.ymax = d["xmax"], d["ymax"]
         return s
     if kind == "z3histogram":
         s = Z3HistogramStat(d["geom"], d["dtg"], d["period"], d["length"])

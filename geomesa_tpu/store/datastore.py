@@ -242,7 +242,10 @@ class TpuDataStore:
         query = self._as_query(query)
         plan = self._plan_cached(name, query)
         if plan.is_empty:
-            return QueryResult(ft, _empty_columns(ft), plan)
+            empty = _empty_columns(ft)
+            if has_aggregation(query.hints):
+                return QueryResult(ft, empty, plan, run_aggregation(ft, query.hints, empty))
+            return QueryResult(ft, empty, plan)
 
         tables = self._tables[name]
         table = tables[plan.index.name]

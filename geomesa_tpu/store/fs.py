@@ -1,0 +1,103 @@
+"""FsDataStore: file-system persistence for columnar feature data.
+
+The geomesa-fs analog (SURVEY.md section 2.4, FileSystemDataStore /
+ParquetFileSystemStorage): schemas live in a JSON metadata file, feature
+columns land as one .npz blob per flushed batch, and index tables are rebuilt
+(re-sorted per index) at open. Raw columns are stored once — indexes are
+derived state, mirroring the reference's single-copy partition files rather
+than Accumulo's per-index tables.
+
+Layout:
+    <root>/metadata.json
+    <root>/blocks/<type>/<seq>.npz
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu.schema.featuretype import FeatureType
+from geomesa_tpu.store.blocks import Columns
+from geomesa_tpu.store.datastore import ScanExecutor, TpuDataStore
+from geomesa_tpu.store.metadata import FileMetadata
+
+
+class FsDataStore(TpuDataStore):
+    def __init__(
+        self,
+        root: str,
+        executor: Optional[ScanExecutor] = None,
+        flush_size: Optional[int] = None,
+    ):
+        self._root = root
+        self._loading = True
+        os.makedirs(os.path.join(root, "blocks"), exist_ok=True)
+        kwargs = {} if flush_size is None else {"flush_size": flush_size}
+        super().__init__(
+            metadata=FileMetadata(os.path.join(root, "metadata.json")),
+            executor=executor,
+            **kwargs,
+        )
+        # schemas were recovered by the base ctor; now replay stored blocks
+        for name in self.type_names:
+            ft = self.get_schema(name)
+            for path in self._block_files(name):
+                with np.load(path, allow_pickle=True) as data:
+                    cols = {k: data[k] for k in data.files}
+                super()._insert_columns(ft, cols)
+        self._loading = False
+
+    def _type_dir(self, name: str) -> str:
+        return os.path.join(self._root, "blocks", name)
+
+    def _block_files(self, name: str):
+        d = self._type_dir(name)
+        if not os.path.isdir(d):
+            return []
+        return [os.path.join(d, f) for f in sorted(os.listdir(d)) if f.endswith(".npz")]
+
+    def _insert_columns(self, ft: FeatureType, columns: Columns):
+        super()._insert_columns(ft, columns)
+        if self._loading:
+            return
+        d = self._type_dir(ft.name)
+        os.makedirs(d, exist_ok=True)
+        seq = len(self._block_files(ft.name))
+        tmp = os.path.join(d, f".{seq:08d}.tmp")
+        np.savez(tmp, **columns)  # savez appends .npz
+        os.replace(tmp + ".npz", os.path.join(d, f"{seq:08d}.npz"))
+
+    def delete_features(self, name: str, fids: Sequence[str]):
+        super().delete_features(name, fids)
+        self._rewrite(name)
+
+    def compact(self, name: str):
+        super().compact(name)
+        self._rewrite(name)
+
+    def delete_schema(self, name: str) -> None:
+        super().delete_schema(name)
+        d = self._type_dir(name)
+        if os.path.isdir(d):
+            for f in os.listdir(d):
+                os.remove(os.path.join(d, f))
+            os.rmdir(d)
+
+    def _rewrite(self, name: str) -> None:
+        """Persist current (post-delete/compact) state as a single block."""
+        from geomesa_tpu.store.blocks import concat_columns, take_rows
+
+        table = next(iter(self._tables[name].values()))
+        parts = []
+        for b, rows in table.scan_all():
+            parts.append(take_rows(b.columns, rows))
+        for f in self._block_files(name):
+            os.remove(f)
+        if parts:
+            merged = concat_columns(parts)
+            d = self._type_dir(name)
+            os.makedirs(d, exist_ok=True)
+            np.savez(os.path.join(d, "00000000.npz"), **merged)

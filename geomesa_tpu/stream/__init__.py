@@ -1,0 +1,18 @@
+"""Streaming layer: live feature feeds + lambda-architecture merge.
+
+Rebuild of ``geomesa-kafka`` and ``geomesa-lambda`` (SURVEY.md section 2.4):
+producer writes become ``GeoMessage``s on a partitioned log (feature-affinity
+partitioner, kafka/utils/GeoMessageSerializer.scala), consumers replay the
+log into a live in-memory feature cache queried with full CQL semantics
+(KafkaQueryRunner / InMemoryQueryRunner.scala:37-346), and the lambda store
+unions a transient stream tier with a persistent TpuDataStore tier, aging
+features down (lambda/stream/kafka/DataStorePersistence.scala).
+
+The broker here is in-process (the EmbeddedKafka test analog); the message
+format and consumer-offset protocol are the SPI a real broker plugs into.
+"""
+
+from geomesa_tpu.stream.messages import Clear, CreateOrUpdate, Delete, GeoMessageSerializer
+from geomesa_tpu.stream.broker import InProcessBroker
+from geomesa_tpu.stream.store import StreamDataStore, FeatureCache
+from geomesa_tpu.stream.lambda_store import LambdaDataStore

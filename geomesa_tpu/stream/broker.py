@@ -1,0 +1,54 @@
+"""In-process partitioned message log (the EmbeddedKafka analog).
+
+Reference test pattern: geomesa-kafka EmbeddedKafka.scala spins a real broker;
+here an in-process log provides the same topic/partition/offset contract so
+the stream store and lambda tiers are exercised without a broker. A real
+transport implements the same three methods (send / poll / end_offsets).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+
+class InProcessBroker:
+    """topic -> partition -> append-only list of bytes; thread-safe."""
+
+    def __init__(self, partitions: int = 4):
+        self.partitions = partitions
+        self._logs: Dict[str, List[List[bytes]]] = {}
+        self._lock = threading.Lock()
+
+    def _topic(self, topic: str) -> List[List[bytes]]:
+        with self._lock:
+            if topic not in self._logs:
+                self._logs[topic] = [[] for _ in range(self.partitions)]
+            return self._logs[topic]
+
+    def send(self, topic: str, partition: int, payload: bytes) -> int:
+        log = self._topic(topic)[partition]
+        with self._lock:
+            log.append(payload)
+            return len(log) - 1
+
+    def poll(
+        self, topic: str, offsets: Dict[int, int], max_records: int = 10000
+    ) -> List[Tuple[int, int, bytes]]:
+        """Fetch records after the given per-partition offsets.
+
+        Returns [(partition, offset, payload)]; caller advances its offsets.
+        """
+        out: List[Tuple[int, int, bytes]] = []
+        logs = self._topic(topic)
+        with self._lock:
+            for p, log in enumerate(logs):
+                start = offsets.get(p, 0)
+                for i in range(start, min(len(log), start + max_records)):
+                    out.append((p, i, log[i]))
+        return out
+
+    def end_offsets(self, topic: str) -> Dict[int, int]:
+        logs = self._topic(topic)
+        with self._lock:
+            return {p: len(log) for p, log in enumerate(logs)}

@@ -1,0 +1,93 @@
+"""LambdaDataStore: transient stream tier merged with a persistent tier.
+
+Reference: geomesa-lambda (SURVEY.md section 2.4): writes land on the stream
+(Kafka) tier; ``DataStorePersistence`` ages features older than N down into
+the persistent store (stream/kafka/DataStorePersistence.scala), offsets
+tracked so replay after crash is idempotent (ZookeeperOffsetManager.scala);
+queries union both tiers with the transient copy winning
+(LambdaQueryRunner).
+
+Persistence here is an explicit ``persist_expired`` call (deterministic; a
+scheduler can drive it) rather than a daemon thread.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import FeatureType
+from geomesa_tpu.store.blocks import concat_columns, take_rows
+from geomesa_tpu.store.datastore import QueryResult, TpuDataStore, _empty_columns
+from geomesa_tpu.stream.store import StreamDataStore
+
+
+class LambdaDataStore:
+    def __init__(
+        self,
+        persistent: Optional[TpuDataStore] = None,
+        transient: Optional[StreamDataStore] = None,
+        age_ms: int = 3600_000,
+    ):
+        self.persistent = persistent or TpuDataStore()
+        self.transient = transient or StreamDataStore()
+        self.age_ms = age_ms
+
+    def create_schema(self, ft: FeatureType) -> None:
+        self.persistent.create_schema(ft)
+        self.transient.create_schema(ft)
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self.persistent.get_schema(name)
+
+    def write(self, name, values, fid, ts_ms: Optional[int] = None):
+        self.transient.write(name, values, fid, ts_ms)
+
+    def delete(self, name, fid, ts_ms: Optional[int] = None):
+        self.transient.delete(name, fid, ts_ms)
+        self.persistent.delete_features(name, [fid])
+
+    def persist_expired(self, name: str, now_ms: Optional[int] = None) -> int:
+        """Age features older than age_ms down to the persistent tier."""
+        self.transient.poll(name)
+        cache = self.transient.cache(name)
+        expired = cache.expired_items(self.age_ms, now_ms)
+        if not expired:
+            return 0
+        # replace any previously persisted versions: tombstone + compact
+        # folds the deletes in BEFORE the rewrite (tombstones are per-table,
+        # so a delete after the write would also swallow the new row)
+        self.persistent.delete_features(name, [fid for fid, _, _ in expired])
+        self.persistent.compact(name)
+        with self.persistent.writer(name) as w:
+            for fid, values, _ in expired:
+                w.write(values, fid=fid)
+        for fid, _, _ in expired:
+            cache.remove(fid)
+        return len(expired)
+
+    def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
+        q = query if isinstance(query, Query) else Query.cql(query)
+        ft = self.get_schema(name)
+        # run the raw filter in both tiers; merge, then options/aggregations
+        base = Query(filter=q.filter)
+        trans = self.transient.query(name, base)
+        live_fids = set(self.transient.cache(name)._live)
+        pers = self.persistent.query(name, base)
+        parts = []
+        if len(trans):
+            parts.append(trans.columns)
+        if len(pers):
+            keep = np.array([f not in live_fids for f in pers.fids], dtype=bool)
+            if keep.any():
+                parts.append(take_rows(pers.columns, np.flatnonzero(keep)))
+        columns = concat_columns(parts) if parts else _empty_columns(ft)
+        from geomesa_tpu.index.aggregators import has_aggregation, run_aggregation
+        from geomesa_tpu.store.datastore import _apply_query_options
+
+        if has_aggregation(q.hints):
+            return QueryResult(ft, _empty_columns(ft), None, run_aggregation(ft, q.hints, columns))
+        columns = _apply_query_options(ft, q, columns)
+        return QueryResult(ft, columns, None)

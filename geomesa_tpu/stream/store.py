@@ -1,0 +1,184 @@
+"""StreamDataStore: live feature cache fed from a partitioned log.
+
+Reference: kafka/data/KafkaDataStore.scala:44-90 (consumer side lazily builds
+per-type caches), KafkaCacheLoader -> FeatureCacheGuava, queries served with
+full CQL/aggregation semantics by KafkaQueryRunner over the cache
+(index-api planning/InMemoryQueryRunner.scala:37-346). Consumption here is
+explicit (``poll``) rather than a daemon thread, which keeps tests and lambda
+persistence deterministic; ``query`` polls first so reads always see the log.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from geomesa_tpu.filter.evaluate import evaluate
+from geomesa_tpu.index.aggregators import has_aggregation, run_aggregation
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.feature import Feature
+from geomesa_tpu.schema.featuretype import FeatureType
+from geomesa_tpu.store.blocks import columns_from_features, take_rows
+from geomesa_tpu.store.datastore import QueryResult, _apply_query_options, _empty_columns
+from geomesa_tpu.stream.broker import InProcessBroker
+from geomesa_tpu.stream.messages import (
+    Clear,
+    CreateOrUpdate,
+    Delete,
+    GeoMessageSerializer,
+)
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class FeatureCache:
+    """Live fid -> (values, ts) map with a lazily rebuilt columnar snapshot
+    (the FeatureCacheGuava analog; columns replace the bucketed quadtree —
+    vectorized evaluation serves the spatial-index role)."""
+
+    def __init__(self, ft: FeatureType, expiry_ms: Optional[int] = None):
+        self.ft = ft
+        self.expiry_ms = expiry_ms
+        self._live: Dict[str, tuple] = {}
+        self._columns = None
+
+    def put(self, fid: str, values: List[Any], ts: int):
+        self._live[fid] = (values, ts)
+        self._columns = None
+
+    def remove(self, fid: str):
+        if self._live.pop(fid, None) is not None:
+            self._columns = None
+
+    def clear(self):
+        self._live.clear()
+        self._columns = None
+
+    def expire(self, now_ms: Optional[int] = None):
+        if self.expiry_ms is None:
+            return
+        cutoff = (now_ms if now_ms is not None else _now_ms()) - self.expiry_ms
+        stale = [fid for fid, (_, ts) in self._live.items() if ts < cutoff]
+        for fid in stale:
+            self.remove(fid)
+
+    def expired_items(self, age_ms: int, now_ms: Optional[int] = None):
+        cutoff = (now_ms if now_ms is not None else _now_ms()) - age_ms
+        return [(fid, v, ts) for fid, (v, ts) in self._live.items() if ts < cutoff]
+
+    def __len__(self):
+        return len(self._live)
+
+    def __contains__(self, fid):
+        return fid in self._live
+
+    def columns(self):
+        if self._columns is None:
+            feats = [Feature(self.ft, fid, list(v)) for fid, (v, _) in self._live.items()]
+            self._columns = columns_from_features(self.ft, feats)
+        return self._columns
+
+
+class StreamDataStore:
+    """Producer + consumer + query surface over a partitioned message log."""
+
+    def __init__(
+        self,
+        broker: Optional[InProcessBroker] = None,
+        expiry_ms: Optional[int] = None,
+        clock: Callable[[], int] = _now_ms,
+    ):
+        self.broker = broker or InProcessBroker()
+        self.expiry_ms = expiry_ms
+        self.clock = clock
+        self._schemas: Dict[str, FeatureType] = {}
+        self._serializers: Dict[str, GeoMessageSerializer] = {}
+        self._caches: Dict[str, FeatureCache] = {}
+        self._offsets: Dict[str, Dict[int, int]] = {}
+        self._listeners: Dict[str, List[Callable]] = {}
+
+    # -- schema --------------------------------------------------------------
+
+    def create_schema(self, ft: FeatureType) -> None:
+        if ft.name in self._schemas:
+            return
+        self._schemas[ft.name] = ft
+        self._serializers[ft.name] = GeoMessageSerializer(ft)
+        self._caches[ft.name] = FeatureCache(ft, self.expiry_ms)
+        self._offsets[ft.name] = {}
+        self._listeners[ft.name] = []
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._schemas[name]
+
+    def type_names(self) -> List[str]:
+        return list(self._schemas)
+
+    # -- producer ------------------------------------------------------------
+
+    def write(self, name: str, values: Sequence[Any], fid: str, ts_ms: Optional[int] = None):
+        ser = self._serializers[name]
+        msg = CreateOrUpdate(fid, list(values), ts_ms if ts_ms is not None else _now_ms())
+        p = ser.partition(fid, self.broker.partitions)
+        self.broker.send(name, p, ser.serialize(msg))
+
+    def delete(self, name: str, fid: str, ts_ms: Optional[int] = None):
+        ser = self._serializers[name]
+        msg = Delete(fid, ts_ms if ts_ms is not None else _now_ms())
+        p = ser.partition(fid, self.broker.partitions)
+        self.broker.send(name, p, ser.serialize(msg))
+
+    def clear(self, name: str, ts_ms: Optional[int] = None):
+        ser = self._serializers[name]
+        self.broker.send(name, 0, ser.serialize(Clear(ts_ms if ts_ms is not None else _now_ms())))
+
+    # -- consumer ------------------------------------------------------------
+
+    def add_listener(self, name: str, fn: Callable) -> None:
+        """GeoTools FeatureEvent analog: fn(GeoMessage) per consumed record."""
+        self._listeners[name].append(fn)
+
+    def poll(self, name: str) -> int:
+        """Drain new records into the cache; returns records consumed."""
+        ser = self._serializers[name]
+        cache = self._caches[name]
+        offsets = self._offsets[name]
+        records = self.broker.poll(name, offsets)
+        for p, off, payload in records:
+            msg = ser.deserialize(payload)
+            if isinstance(msg, CreateOrUpdate):
+                cache.put(msg.fid, msg.values, msg.ts_ms)
+            elif isinstance(msg, Delete):
+                cache.remove(msg.fid)
+            else:
+                cache.clear()
+            offsets[p] = off + 1
+            for fn in self._listeners[name]:
+                fn(msg)
+        cache.expire(self.clock())
+        return len(records)
+
+    def cache(self, name: str) -> FeatureCache:
+        return self._caches[name]
+
+    # -- queries (InMemoryQueryRunner analog) --------------------------------
+
+    def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
+        self.poll(name)
+        ft = self._schemas[name]
+        q = query if isinstance(query, Query) else Query.cql(query)
+        columns = self._caches[name].columns()
+        n = len(columns.get("__fid__", []))
+        if n:
+            mask = evaluate(q.filter, ft, columns)
+            columns = take_rows(columns, np.flatnonzero(mask))
+        else:
+            columns = _empty_columns(ft)
+        if has_aggregation(q.hints):
+            return QueryResult(ft, _empty_columns(ft), None, run_aggregation(ft, q.hints, columns))
+        columns = _apply_query_options(ft, q, columns)
+        return QueryResult(ft, columns, None)

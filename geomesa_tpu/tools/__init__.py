@@ -1,0 +1,7 @@
+"""User surface: config-driven converters, export formats, and the CLI.
+
+Rebuild of ``geomesa-convert`` (SimpleFeatureConverter factories + the
+Transformers expression language, SURVEY.md section 2.5) and ``geomesa-tools``
+(JCommander CLI Runner.scala:26,146; commands for schema CRUD, ingest,
+export, explain, stats).
+"""

@@ -1,0 +1,198 @@
+"""geomesa-tpu CLI (the geomesa-tools Runner analog, Runner.scala:26,146).
+
+Subcommands: create-schema, delete-schema, describe, ingest, export, explain,
+stats-count, stats-bounds, stats-topk, version, env. The datastore is the
+file-system store (``--store DIR``), so state persists across invocations the
+way a cluster-backed reference deployment does.
+
+    python -m geomesa_tpu.tools.cli create-schema --store /data/gm \
+        --name gdelt --spec "actor:String,dtg:Date,*geom:Point:srid=4326"
+    python -m geomesa_tpu.tools.cli ingest --store /data/gm --name gdelt \
+        --converter conv.json data.csv
+    python -m geomesa_tpu.tools.cli export --store /data/gm --name gdelt \
+        --cql "bbox(geom,-10,-10,10,10)" --format geojson
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+VERSION = "0.1.0"
+
+
+def _store(args):
+    from geomesa_tpu.store.fs import FsDataStore
+
+    return FsDataStore(args.store)
+
+
+def cmd_create_schema(args) -> int:
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    ds = _store(args)
+    ds.create_schema(parse_spec(args.name, args.spec))
+    print(f"created schema {args.name}")
+    return 0
+
+
+def cmd_delete_schema(args) -> int:
+    ds = _store(args)
+    ds.delete_schema(args.name)
+    print(f"deleted schema {args.name}")
+    return 0
+
+
+def cmd_describe(args) -> int:
+    ds = _store(args)
+    ft = ds.get_schema(args.name)
+    for a in ft.attributes:
+        flags = []
+        if a is ft.default_geometry:
+            flags.append("default-geometry")
+        if a is ft.default_date:
+            flags.append("default-date")
+        if a.indexed:
+            flags.append("indexed")
+        print(f"{a.name:20s} {a.type.value:12s} {' '.join(flags)}")
+    print(f"features: {ds.count(args.name)}")
+    return 0
+
+
+def cmd_ingest(args) -> int:
+    from geomesa_tpu.tools.convert import EvaluationContext, SimpleFeatureConverter
+
+    ds = _store(args)
+    ft = ds.get_schema(args.name)
+    with open(args.converter) as fh:
+        config = json.load(fh)
+    conv = SimpleFeatureConverter(ft, config)
+    ec = EvaluationContext()
+    written = 0
+    with ds.writer(args.name) as w:
+        for path in args.files:
+            for feature in conv.convert_path(path, ec):
+                w.write_feature(feature)
+                written += 1
+    print(f"ingested {written} features ({ec.failure} failed)")
+    for err in ec.errors[:10]:
+        print(f"  {err}", file=sys.stderr)
+    return 0 if written or not ec.failure else 1
+
+
+def cmd_export(args) -> int:
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.tools.export import export
+
+    ds = _store(args)
+    q = Query.cql(args.cql)
+    if args.max_features:
+        q.max_features = args.max_features
+    res = ds.query(args.name, q)
+    out = export(res, args.format, args.output)
+    if out is not None:
+        print(out, end="")
+    return 0
+
+
+def cmd_explain(args) -> int:
+    ds = _store(args)
+    print(ds.explain(args.name, args.cql))
+    return 0
+
+
+def cmd_stats_count(args) -> int:
+    from geomesa_tpu.filter.parser import parse_cql
+
+    ds = _store(args)
+    ft = ds.get_schema(args.name)
+    if args.no_estimate or ds.stats is None:
+        print(len(ds.query(args.name, args.cql)))
+    else:
+        est = ds.stats.get_count(ft, parse_cql(args.cql))
+        print(int(est) if est is not None else len(ds.query(args.name, args.cql)))
+    return 0
+
+
+def cmd_stats_bounds(args) -> int:
+    ds = _store(args)
+    b = ds.stats.get_bounds(ds.get_schema(args.name)) if ds.stats else None
+    print(json.dumps(b))
+    return 0
+
+
+def cmd_stats_topk(args) -> int:
+    ds = _store(args)
+    stats = ds.stats.stats_for(ds.get_schema(args.name))
+    tk = stats.get(f"topk:{args.attribute}")
+    if tk is None:
+        print("no topk sketch for attribute", file=sys.stderr)
+        return 1
+    for v, c in tk.topk(args.k):
+        print(f"{v}\t{c}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(f"geomesa-tpu {VERSION}")
+    return 0
+
+
+def cmd_env(args) -> int:
+    import jax
+
+    print(f"geomesa-tpu {VERSION}")
+    print(f"jax {jax.__version__}, devices: {[str(d) for d in jax.devices()]}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="geomesa-tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, *, store=True, type_name=True):
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+        if store:
+            sp.add_argument("--store", required=True, help="datastore root directory")
+        if type_name:
+            sp.add_argument("--name", required=True, help="feature type name")
+        return sp
+
+    sp = add("create-schema", cmd_create_schema)
+    sp.add_argument("--spec", required=True, help="SimpleFeatureType spec string")
+    add("delete-schema", cmd_delete_schema)
+    add("describe", cmd_describe)
+    sp = add("ingest", cmd_ingest)
+    sp.add_argument("--converter", required=True, help="converter config (json)")
+    sp.add_argument("files", nargs="+")
+    sp = add("export", cmd_export)
+    sp.add_argument("--cql", default="INCLUDE")
+    sp.add_argument("--format", default="csv", choices=["csv", "tsv", "geojson", "wkt", "bin"])
+    sp.add_argument("--output", default=None)
+    sp.add_argument("--max-features", type=int, default=None)
+    sp = add("explain", cmd_explain)
+    sp.add_argument("--cql", required=True)
+    sp = add("stats-count", cmd_stats_count)
+    sp.add_argument("--cql", default="INCLUDE")
+    sp.add_argument("--no-estimate", action="store_true")
+    add("stats-bounds", cmd_stats_bounds)
+    sp = add("stats-topk", cmd_stats_topk)
+    sp.add_argument("--attribute", required=True)
+    sp.add_argument("-k", type=int, default=10)
+    add("version", cmd_version, store=False, type_name=False)
+    add("env", cmd_env, store=False, type_name=False)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,291 @@
+"""Config-driven feature converters (the geomesa-convert analog).
+
+Reference: geomesa-convert-common SimpleFeatureConverterFactory + the
+``Transformers`` expression language (118 functions; we implement the core
+used by the published GDELT/OSM configs). Configs are plain dicts (JSON
+instead of HOCON):
+
+    {
+      "type": "delimited-text",            # or "json"
+      "format": "csv",                     # csv | tsv
+      "options": {"skip-lines": 1},
+      "id-field": "$1",                    # expression
+      "fields": [
+        {"name": "dtg",  "transform": "date('%Y%m%d', $2)"},
+        {"name": "geom", "transform": "point(toDouble($40), toDouble($41))"},
+        {"name": "actor","transform": "trim($7)"}
+      ]
+    }
+
+Expressions: ``$N`` (1-based input column; ``$0`` = whole record), ``$name``
+(previously computed field), string/number literals, and nested function
+calls. Functions: toInt toLong toDouble toString trim lowercase uppercase
+concat date dateToMillis point uuid withDefault regexReplace substr.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import re
+import uuid as uuidlib
+from datetime import datetime, timezone
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.geom.wkt import parse_wkt
+from geomesa_tpu.schema.feature import Feature
+from geomesa_tpu.schema.featuretype import FeatureType
+
+
+# ---------------------------------------------------------------------------
+# expression language
+# ---------------------------------------------------------------------------
+
+class _Expr:
+    def __call__(self, cols: Sequence[Any], fields: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+
+class _Lit(_Expr):
+    def __init__(self, v):
+        self.v = v
+
+    def __call__(self, cols, fields):
+        return self.v
+
+
+class _Col(_Expr):
+    def __init__(self, idx: int):
+        self.idx = idx
+
+    def __call__(self, cols, fields):
+        if self.idx == 0:
+            return cols
+        v = cols[self.idx - 1]
+        return v
+
+
+class _Field(_Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def __call__(self, cols, fields):
+        return fields[self.name]
+
+
+class _Call(_Expr):
+    def __init__(self, fn: Callable, args: List[_Expr]):
+        self.fn = fn
+        self.args = args
+
+    def __call__(self, cols, fields):
+        return self.fn(*[a(cols, fields) for a in self.args])
+
+
+def _fn_date(fmt: str, v: Any) -> int:
+    """Parse to epoch millis. fmt 'ISO' handles ISO-8601; else strptime."""
+    if v is None or v == "":
+        return None
+    s = str(v).strip()
+    if fmt.upper() in ("ISO", "ISO8601", "ISODATETIME"):
+        s2 = s.replace("Z", "+00:00")
+        dt = datetime.fromisoformat(s2)
+    else:
+        dt = datetime.strptime(s, fmt)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+_FUNCTIONS: Dict[str, Callable] = {
+    "toint": lambda v: None if v in (None, "") else int(float(v)),
+    "tolong": lambda v: None if v in (None, "") else int(float(v)),
+    "todouble": lambda v: None if v in (None, "") else float(v),
+    "tostring": lambda v: None if v is None else str(v),
+    "trim": lambda v: None if v is None else str(v).strip(),
+    "lowercase": lambda v: None if v is None else str(v).lower(),
+    "uppercase": lambda v: None if v is None else str(v).upper(),
+    "concat": lambda *a: "".join("" if x is None else str(x) for x in a),
+    "date": _fn_date,
+    "datetomillis": lambda v: None if v is None else int(v),
+    "point": lambda x, y: None if x in (None, "") or y in (None, "") else Point(float(x), float(y)),
+    "geometry": lambda v: None if v in (None, "") else parse_wkt(str(v)),
+    "uuid": lambda: str(uuidlib.uuid4()),
+    "withdefault": lambda v, d: d if v in (None, "") else v,
+    "regexreplace": lambda pattern, repl, v: None if v is None else re.sub(pattern, repl, str(v)),
+    "substr": lambda v, a, b: None if v is None else str(v)[int(a) : int(b)],
+}
+
+
+class _Parser:
+    """Recursive-descent parser for the transform mini-language."""
+
+    _TOKEN = re.compile(
+        r"\s*(?:(?P<num>-?\d+\.\d+|-?\d+)|(?P<str>'(?:[^'\\]|\\.)*')"
+        r"|(?P<dollar>\$[A-Za-z_0-9]+)|(?P<ident>[A-Za-z_][A-Za-z_0-9]*)"
+        r"|(?P<punct>[(),]))"
+    )
+
+    def __init__(self, text: str):
+        self.tokens = []
+        pos = 0
+        while pos < len(text):
+            m = self._TOKEN.match(text, pos)
+            if not m:
+                if text[pos:].strip():
+                    raise ValueError(f"bad transform syntax at: {text[pos:]!r}")
+                break
+            pos = m.end()
+            self.tokens.append(m)
+        self.i = 0
+
+    def _peek(self):
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def _next(self):
+        t = self._peek()
+        self.i += 1
+        return t
+
+    def parse(self) -> _Expr:
+        e = self._expr()
+        if self._peek() is not None:
+            raise ValueError("trailing tokens in transform")
+        return e
+
+    def _expr(self) -> _Expr:
+        t = self._next()
+        if t is None:
+            raise ValueError("empty transform")
+        if t.group("num"):
+            s = t.group("num")
+            return _Lit(float(s) if "." in s else int(s))
+        if t.group("str"):
+            raw = t.group("str")[1:-1]
+            return _Lit(raw.replace("\\'", "'").replace("\\\\", "\\"))
+        if t.group("dollar"):
+            name = t.group("dollar")[1:]
+            if name.isdigit():
+                return _Col(int(name))
+            return _Field(name)
+        if t.group("ident"):
+            fname = t.group("ident").lower()
+            if fname not in _FUNCTIONS:
+                raise ValueError(f"unknown transform function: {fname}")
+            t2 = self._next()
+            if t2 is None or t2.group("punct") != "(":
+                raise ValueError(f"expected ( after {fname}")
+            args: List[_Expr] = []
+            if self._peek() is not None and self._peek().group("punct") == ")":
+                self._next()
+            else:
+                while True:
+                    args.append(self._expr())
+                    t3 = self._next()
+                    if t3 is None:
+                        raise ValueError("unterminated call")
+                    if t3.group("punct") == ")":
+                        break
+                    if t3.group("punct") != ",":
+                        raise ValueError("expected , or )")
+            return _Call(_FUNCTIONS[fname], args)
+        raise ValueError(f"unexpected token {t.group(0)!r}")
+
+
+def parse_transform(text: str) -> _Expr:
+    return _Parser(text).parse()
+
+
+# ---------------------------------------------------------------------------
+# converters
+# ---------------------------------------------------------------------------
+
+class EvaluationContext:
+    """Counters + failure collection (geomesa-convert EvaluationContext)."""
+
+    def __init__(self):
+        self.success = 0
+        self.failure = 0
+        self.errors: List[str] = []
+
+    def fail(self, line: int, err: Exception):
+        self.failure += 1
+        if len(self.errors) < 100:
+            self.errors.append(f"line {line}: {err}")
+
+
+class SimpleFeatureConverter:
+    """Config-driven record -> Feature converter."""
+
+    def __init__(self, ft: FeatureType, config: Dict[str, Any]):
+        self.ft = ft
+        self.config = config
+        self.kind = config.get("type", "delimited-text")
+        self.id_expr = parse_transform(config["id-field"]) if config.get("id-field") else None
+        self.fields = [
+            (f["name"], parse_transform(f["transform"]) if f.get("transform") else None,
+             f.get("path"))
+            for f in config.get("fields", [])
+        ]
+        self._attr_order = [a.name for a in ft.attributes]
+
+    # -- record iteration per format ----------------------------------------
+
+    def _records(self, fh: io.TextIOBase) -> Iterator[Sequence[Any]]:
+        if self.kind == "delimited-text":
+            fmt = self.config.get("format", "csv").lower()
+            delim = "\t" if fmt in ("tsv", "tdv") else ","
+            skip = int(self.config.get("options", {}).get("skip-lines", 0))
+            reader = csv.reader(fh, delimiter=delim)
+            for i, row in enumerate(reader):
+                if i < skip or not row:
+                    continue
+                yield row
+        elif self.kind == "json":
+            for line in fh:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+        else:
+            raise ValueError(f"unknown converter type: {self.kind}")
+
+    @staticmethod
+    def _json_path(obj: Any, path: str) -> Any:
+        """$.a.b[0].c subset of JsonPath."""
+        cur = obj
+        for part in re.findall(r"\.([A-Za-z_][A-Za-z_0-9]*)|\[(\d+)\]", path):
+            key, idx = part
+            if cur is None:
+                return None
+            cur = cur.get(key) if key else (cur[int(idx)] if int(idx) < len(cur) else None)
+        return cur
+
+    # -- conversion ---------------------------------------------------------
+
+    def convert(
+        self, fh: io.TextIOBase, ec: Optional[EvaluationContext] = None
+    ) -> Iterator[Feature]:
+        ec = ec if ec is not None else EvaluationContext()
+        for lineno, rec in enumerate(self._records(fh), 1):
+            try:
+                fields: Dict[str, Any] = {}
+                for name, expr, path in self.fields:
+                    if path is not None:
+                        v = self._json_path(rec, path)
+                        if expr is not None:
+                            v = expr([v], fields)
+                    else:
+                        v = expr(rec, fields) if expr is not None else None
+                    fields[name] = v
+                values = [fields.get(a) for a in self._attr_order]
+                fid = str(self.id_expr(rec, fields)) if self.id_expr else str(uuidlib.uuid4())
+                yield Feature(self.ft, fid, values)
+                ec.success += 1
+            except Exception as e:  # collect, don't abort the ingest
+                ec.fail(lineno, e)
+
+    def convert_path(self, path: str, ec: Optional[EvaluationContext] = None):
+        with open(path, "r", encoding=self.config.get("options", {}).get("encoding", "utf-8")) as fh:
+            yield from self.convert(fh, ec)

