@@ -187,6 +187,18 @@ def zranges(
     ]
     if not boxes:
         return []
+
+    # latency-critical planning path: prefer the C++ BFS (geomesa_tpu.native,
+    # same semantics, ~30x faster); fall back to the Python walk below
+    try:
+        from geomesa_tpu.native import zranges_native
+
+        native = zranges_native(mins, maxs, bits, dims, max_ranges, precision)
+        if native is not None:
+            return [IndexRange(lo, hi, c) for lo, hi, c in native]
+    except Exception:
+        pass
+
     max_level = min(bits, max(1, precision // dims))
 
     ranges: List[IndexRange] = []

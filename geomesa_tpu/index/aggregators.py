@@ -23,7 +23,7 @@ import numpy as np
 
 from geomesa_tpu.schema.featuretype import FeatureType
 from geomesa_tpu.stats.parser import parse_stat
-from geomesa_tpu.stats.sketches import EnvelopeStat, Stat, Z3HistogramStat
+from geomesa_tpu.stats.sketches import EnvelopeStat, MinMax, Stat, Z3HistogramStat
 
 
 def has_aggregation(hints: Dict[str, Any]) -> bool:
@@ -79,7 +79,7 @@ def run_stats(ft: FeatureType, spec: str, columns) -> Stat:
         if attr is None:  # CountStat
             s.count += n
             continue
-        if geom is not None and attr == geom.name:
+        if geom is not None and attr == geom.name and isinstance(s, MinMax):
             # MinMax over a geometry means 2D envelope bounds in the
             # reference; swap in the envelope sketch
             env = EnvelopeStat(attr)

@@ -1,0 +1,115 @@
+"""Native (C++) host kernels, built lazily and bound via ctypes.
+
+The reference keeps its planning hot loops in tight JVM code (sfcurve
+bit-twiddling, SURVEY.md section 2.1); here they are C++ compiled on first
+use with the baked-in g++ toolchain. Everything has a pure-Python fallback —
+set GEOMESA_TPU_NO_NATIVE=1 to force it (and tests compare the two).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "zranges.cpp")
+_SO = os.path.join(_DIR, "_zranges.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except Exception:
+        return False
+
+
+def load():
+    """The ctypes lib, building if needed; None when unavailable/disabled."""
+    global _lib, _tried
+    if os.environ.get("GEOMESA_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            stale = (not os.path.exists(_SO)) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if stale and not _build():
+                return None
+            lib = ctypes.CDLL(_SO)
+            fn = lib.geomesa_zranges
+            fn.restype = ctypes.c_longlong
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_longlong,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_longlong,
+            ]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def zranges_native(
+    mins, maxs, bits: int, dims: int, max_ranges: Optional[int], precision: int
+):
+    """Native decomposition; returns None when the lib is unavailable.
+
+    Output matches curve.zorder.zranges: list of (lower, upper, contained).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    m = np.ascontiguousarray(np.asarray(mins, dtype=np.uint32).reshape(-1))
+    x = np.ascontiguousarray(np.asarray(maxs, dtype=np.uint32).reshape(-1))
+    nboxes = len(m) // dims
+    cap = max(4 * (max_ranges or 0), 1 << 16)
+    budget = -1 if max_ranges is None else int(max_ranges)
+    while True:
+        lo = np.empty(cap, dtype=np.uint64)
+        hi = np.empty(cap, dtype=np.uint64)
+        cont = np.empty(cap, dtype=np.uint8)
+        n = lib.geomesa_zranges(
+            m.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            nboxes,
+            bits,
+            dims,
+            budget,
+            precision,
+            lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            cont.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            cap,
+        )
+        if n >= 0:
+            return [
+                (int(lo[i]), int(hi[i]), bool(cont[i])) for i in range(n)
+            ]
+        cap = int(-n) + 16

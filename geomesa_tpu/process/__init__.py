@@ -1,0 +1,14 @@
+"""Analytic processes over the datastore (the geomesa-process analogs).
+
+Reference: geomesa-process (SURVEY.md section 2.5): KNearestNeighborSearch
+(geohash-spiral expanding search, knn/KNNQuery.scala), ProximitySearch,
+TubeSelect (spatio-temporal corridor, tube/TubeBuilder.scala), Unique,
+Query. Here the expanding search rides the Z2/Z3 index through the normal
+query planner, and the exact distance/corridor math is vectorized numpy
+over the candidate sets the index returns.
+"""
+
+from geomesa_tpu.process.knn import knn_search
+from geomesa_tpu.process.proximity import proximity_search
+from geomesa_tpu.process.tube import tube_select
+from geomesa_tpu.process.unique import unique_values

@@ -1,0 +1,33 @@
+"""Shared geodesic helpers for the process layer.
+
+Haversine distance on the WGS84 mean sphere — the role the reference's
+GeoHashUtils/VincentyModel math plays for KNN and proximity searches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+EARTH_RADIUS_M = 6371008.8
+
+
+def haversine_m(lon1, lat1, lon2, lat2) -> np.ndarray:
+    """Great-circle distance in meters; broadcasts over numpy inputs."""
+    lon1, lat1, lon2, lat2 = (np.radians(np.asarray(v, dtype=np.float64)) for v in (lon1, lat1, lon2, lat2))
+    dlon = lon2 - lon1
+    dlat = lat2 - lat1
+    a = np.sin(dlat / 2) ** 2 + np.cos(lat1) * np.cos(lat2) * np.sin(dlon / 2) ** 2
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.minimum(1.0, np.sqrt(a)))
+
+
+def degrees_box(x: float, y: float, radius_m: float):
+    """Conservative lon/lat bbox containing the radius_m circle around (x, y)."""
+    dlat = float(np.degrees(radius_m / EARTH_RADIUS_M))
+    cos = max(0.01, float(np.cos(np.radians(y))))
+    dlon = dlat / cos
+    return (
+        max(-180.0, float(x) - dlon),
+        max(-90.0, float(y) - dlat),
+        min(180.0, float(x) + dlon),
+        min(90.0, float(y) + dlat),
+    )

@@ -1,0 +1,67 @@
+"""k-nearest-neighbor search via expanding index queries.
+
+Reference: KNearestNeighborSearchProcess (knn/KNNQuery.scala,
+knn/GeoHashSpiral.scala) spirals outward over geohash cells until k features
+are in hand and the k-th distance bounds the search. Here the spiral is an
+expanding bbox over the Z2/Z3 index (doubling radius), with the same
+termination: once >= k candidates are found, one final query at the k-th
+distance guarantees no closer feature was missed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.filter.parser import parse_cql, to_cql
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.process.geodesy import degrees_box, haversine_m
+
+
+def _bbox_cql(ft, box, extra: Optional[str]) -> str:
+    geom = ft.default_geometry.name
+    cql = f"bbox({geom}, {box[0]!r}, {box[1]!r}, {box[2]!r}, {box[3]!r})"
+    if extra:
+        cql = f"({cql}) AND ({extra})"
+    return cql
+
+
+def _distances(ft, result, x: float, y: float) -> np.ndarray:
+    geom = ft.default_geometry.name
+    return haversine_m(result.columns[geom + "__x"], result.columns[geom + "__y"], x, y)
+
+
+def knn_search(
+    store,
+    name: str,
+    x: float,
+    y: float,
+    k: int = 10,
+    initial_radius_m: float = 1000.0,
+    max_radius_m: float = 2_000_000.0,
+    cql: Optional[str] = None,
+) -> List[Tuple[str, float]]:
+    """[(fid, distance_m)] of the k nearest features to (x, y), ascending."""
+    ft = store.get_schema(name)
+    radius = float(initial_radius_m)
+    result = None
+    while True:
+        result = store.query(name, _bbox_cql(ft, degrees_box(x, y, radius), cql))
+        if len(result) >= k or radius >= max_radius_m:
+            break
+        radius *= 2.0
+    if len(result) == 0:
+        return []
+    d = _distances(ft, result, x, y)
+    order = np.argsort(d, kind="stable")[:k]
+    kth = float(d[order[-1]])
+    # the bbox is not a circle: if the k-th distance exceeds the scanned
+    # radius, a closer feature may sit in the circle's corners — requery at
+    # the k-th distance to close the search (KNNQuery's final window)
+    if kth > radius and radius < max_radius_m:
+        result = store.query(name, _bbox_cql(ft, degrees_box(x, y, kth), cql))
+        d = _distances(ft, result, x, y)
+        order = np.argsort(d, kind="stable")[:k]
+    fids = result.fids
+    return [(str(fids[i]), float(d[i])) for i in order]

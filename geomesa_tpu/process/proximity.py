@@ -1,0 +1,46 @@
+"""Proximity search: features within a distance of any input point.
+
+Reference: ProximitySearchProcess (geomesa-process) buffers the input
+features and runs a DWITHIN; here each input point contributes a
+conservative bbox for the index scan and the exact haversine test prunes
+the candidates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.process.geodesy import degrees_box, haversine_m
+
+
+def proximity_search(
+    store,
+    name: str,
+    points: Sequence[Tuple[float, float]],
+    distance_m: float,
+    cql: Optional[str] = None,
+):
+    """QueryResult of features within distance_m of ANY input point."""
+    from geomesa_tpu.store.blocks import take_rows
+    from geomesa_tpu.store.datastore import QueryResult, _empty_columns
+
+    ft = store.get_schema(name)
+    geom = ft.default_geometry.name
+    boxes = [degrees_box(x, y, distance_m) for x, y in points]
+    parts = " OR ".join(
+        f"bbox({geom}, {b[0]!r}, {b[1]!r}, {b[2]!r}, {b[3]!r})" for b in boxes
+    )
+    q = f"({parts})" if parts else "EXCLUDE"
+    if cql:
+        q = f"{q} AND ({cql})"
+    result = store.query(name, q)
+    if len(result) == 0:
+        return result
+    xs = result.columns[geom + "__x"]
+    ys = result.columns[geom + "__y"]
+    keep = np.zeros(len(result), dtype=bool)
+    for x, y in points:
+        keep |= haversine_m(xs, ys, x, y) <= distance_m
+    return QueryResult(ft, take_rows(result.columns, np.flatnonzero(keep)), result.plan)

@@ -1,0 +1,27 @@
+"""Unique attribute values over a query (UniqueProcess analog)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def unique_values(
+    store, name: str, attribute: str, cql: str = "INCLUDE", sort_by_count: bool = True
+) -> List[Tuple[object, int]]:
+    result = store.query(name, cql)
+    if len(result) == 0:
+        return []
+    col = result.columns[attribute]
+    nulls = result.columns.get(attribute + "__null")
+    if nulls is not None:
+        col = col[~nulls]
+    col = col[np.array([v is not None for v in col], dtype=bool)] if col.dtype.kind == "O" else col
+    uniq, counts = np.unique(col, return_counts=True)
+    pairs = [
+        (v.item() if isinstance(v, np.generic) else v, int(c)) for v, c in zip(uniq, counts)
+    ]
+    if sort_by_count:
+        pairs.sort(key=lambda vc: (-vc[1], str(vc[0])))
+    return pairs

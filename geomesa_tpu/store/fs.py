@@ -42,12 +42,19 @@ class FsDataStore(TpuDataStore):
             **kwargs,
         )
         # schemas were recovered by the base ctor; now replay stored blocks
+        # plus any un-compacted tombstones
         for name in self.type_names:
             ft = self.get_schema(name)
             for path in self._block_files(name):
                 with np.load(path, allow_pickle=True) as data:
                     cols = {k: data[k] for k in data.files}
                 super()._insert_columns(ft, cols)
+            ts = self._tombstone_file(name)
+            if os.path.exists(ts):
+                with open(ts) as fh:
+                    fids = [line.rstrip("\n") for line in fh if line.rstrip("\n")]
+                if fids:
+                    super().delete_features(name, fids)
         self._loading = False
 
     def _type_dir(self, name: str) -> str:
@@ -57,7 +64,13 @@ class FsDataStore(TpuDataStore):
         d = self._type_dir(name)
         if not os.path.isdir(d):
             return []
-        return [os.path.join(d, f) for f in sorted(os.listdir(d)) if f.endswith(".npz")]
+        # dot-prefixed names are in-flight temp files (crash leftovers);
+        # only committed 8-digit blocks are replayable
+        return [
+            os.path.join(d, f)
+            for f in sorted(os.listdir(d))
+            if f.endswith(".npz") and not f.startswith(".")
+        ]
 
     def _insert_columns(self, ft: FeatureType, columns: Columns):
         super()._insert_columns(ft, columns)
@@ -70,13 +83,26 @@ class FsDataStore(TpuDataStore):
         np.savez(tmp, **columns)  # savez appends .npz
         os.replace(tmp + ".npz", os.path.join(d, f"{seq:08d}.npz"))
 
+    def _tombstone_file(self, name: str) -> str:
+        return os.path.join(self._type_dir(name), "tombstones.txt")
+
     def delete_features(self, name: str, fids: Sequence[str]):
+        """Deletes append to a durable tombstone sidecar; the O(data) file
+        rewrite is deferred to compact() (one rewrite per cycle, not one
+        per delete batch)."""
         super().delete_features(name, fids)
-        self._rewrite(name)
+        d = self._type_dir(name)
+        os.makedirs(d, exist_ok=True)
+        with open(self._tombstone_file(name), "a") as fh:
+            for fid in fids:
+                fh.write(f"{fid}\n")
 
     def compact(self, name: str):
         super().compact(name)
         self._rewrite(name)
+        ts = self._tombstone_file(name)
+        if os.path.exists(ts):
+            os.remove(ts)
 
     def delete_schema(self, name: str) -> None:
         super().delete_schema(name)

@@ -122,19 +122,19 @@ class StreamDataStore:
 
     def write(self, name: str, values: Sequence[Any], fid: str, ts_ms: Optional[int] = None):
         ser = self._serializers[name]
-        msg = CreateOrUpdate(fid, list(values), ts_ms if ts_ms is not None else _now_ms())
+        msg = CreateOrUpdate(fid, list(values), ts_ms if ts_ms is not None else self.clock())
         p = ser.partition(fid, self.broker.partitions)
         self.broker.send(name, p, ser.serialize(msg))
 
     def delete(self, name: str, fid: str, ts_ms: Optional[int] = None):
         ser = self._serializers[name]
-        msg = Delete(fid, ts_ms if ts_ms is not None else _now_ms())
+        msg = Delete(fid, ts_ms if ts_ms is not None else self.clock())
         p = ser.partition(fid, self.broker.partitions)
         self.broker.send(name, p, ser.serialize(msg))
 
     def clear(self, name: str, ts_ms: Optional[int] = None):
         ser = self._serializers[name]
-        self.broker.send(name, 0, ser.serialize(Clear(ts_ms if ts_ms is not None else _now_ms())))
+        self.broker.send(name, 0, ser.serialize(Clear(ts_ms if ts_ms is not None else self.clock())))
 
     # -- consumer ------------------------------------------------------------
 

@@ -40,7 +40,11 @@ def _rows(result) -> Iterator[list]:
         yield row
 
 
-def _cell(v: Any, date_attrs: bool) -> Any:
+def _fmt_date(ms: int) -> str:
+    return np.datetime64(int(ms), "ms").item().isoformat() + "Z"
+
+
+def _cell(v: Any) -> Any:
     if v is None:
         return ""
     if isinstance(v, Geometry):
@@ -58,8 +62,8 @@ def to_delimited(result, delimiter: str = ",") -> str:
         cells = [fid]
         for a, v in zip(ft.attributes, row):
             if a.name in date_names and v is not None:
-                v = np.datetime64(int(v), "ms").astype("datetime64[ms]").item().isoformat() + "Z"
-            cells.append(_cell(v, False))
+                v = _fmt_date(v)
+            cells.append(_cell(v))
         w.writerow(cells)
     return out.getvalue()
 
@@ -86,9 +90,7 @@ def to_geojson(result) -> str:
             elif isinstance(v, Geometry):
                 props[a.name] = to_wkt(v)
             elif a.name in date_names and v is not None:
-                props[a.name] = (
-                    np.datetime64(int(v), "ms").astype("datetime64[ms]").item().isoformat() + "Z"
-                )
+                props[a.name] = _fmt_date(v)
             else:
                 props[a.name] = v
         features.append(

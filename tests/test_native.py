@@ -1,0 +1,65 @@
+"""Native zranges parity vs the Python oracle, across dims/budgets."""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.native import load, zranges_native
+
+RNG = np.random.default_rng(17)
+
+pytestmark = pytest.mark.skipif(load() is None, reason="no native toolchain")
+
+
+def _python_ranges(mins, maxs, bits, dims, max_ranges, precision=64):
+    """Run the pure-Python BFS by disabling the native hook."""
+    from geomesa_tpu.curve import zorder
+
+    os.environ["GEOMESA_TPU_NO_NATIVE"] = "1"
+    try:
+        return zorder.zranges(mins, maxs, bits, dims, max_ranges, precision)
+    finally:
+        del os.environ["GEOMESA_TPU_NO_NATIVE"]
+
+
+@pytest.mark.parametrize("dims,bits", [(2, 31), (3, 21), (2, 10), (3, 8)])
+@pytest.mark.parametrize("max_ranges", [None, 10, 200, 2000])
+def test_native_matches_python(dims, bits, max_ranges):
+    if max_ranges is None and bits > 10:
+        pytest.skip("unbounded full-depth is slow in the Python oracle")
+    top = (1 << bits) - 1
+    boxes = []
+    for _ in range(3):
+        lo = RNG.integers(0, top, dims)
+        hi = np.minimum(lo + RNG.integers(1, top // 4, dims), top)
+        boxes.append((lo, hi))
+    mins = [b[0] for b in boxes]
+    maxs = [b[1] for b in boxes]
+    want = _python_ranges(mins, maxs, bits, dims, max_ranges)
+    got = zranges_native(mins, maxs, bits, dims, max_ranges, 64)
+    assert got == [(r.lower, r.upper, r.contained) for r in want]
+
+
+def test_native_single_cell():
+    got = zranges_native([[5, 5]], [[5, 5]], 8, 2, None, 64)
+    want = _python_ranges([[5, 5]], [[5, 5]], 8, 2, None)
+    assert got == [(r.lower, r.upper, r.contained) for r in want]
+    assert len(got) == 1 and got[0][2] is True
+
+
+def test_native_wired_into_sfc():
+    """Z2SFC.ranges must give identical results native vs python."""
+    from geomesa_tpu.curve.sfc import Z2SFC
+
+    sfc = Z2SFC()
+    boxes = [(-10.0, -10.0, 10.0, 10.0), (100.0, 40.0, 120.0, 60.0)]
+    a = sfc.ranges(boxes, max_ranges=500)
+    os.environ["GEOMESA_TPU_NO_NATIVE"] = "1"
+    try:
+        b = sfc.ranges(boxes, max_ranges=500)
+    finally:
+        del os.environ["GEOMESA_TPU_NO_NATIVE"]
+    assert [(r.lower, r.upper, r.contained) for r in a] == [
+        (r.lower, r.upper, r.contained) for r in b
+    ]

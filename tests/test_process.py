@@ -1,0 +1,102 @@
+"""Process layer tests: kNN, proximity, tube select, unique values.
+
+Mirrors geomesa-process KNearestNeighborSearchProcessTest /
+TubeSelectProcessTest shapes with brute-force oracles.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.process import knn_search, proximity_search, tube_select, unique_values
+from geomesa_tpu.process.geodesy import haversine_m
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+SPEC = "actor:String,dtg:Date,*geom:Point:srid=4326"
+T0 = int(np.datetime64("2026-04-01T00:00:00", "ms").astype("int64"))
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(23)
+    s = TpuDataStore()
+    ft = parse_spec("pts", SPEC)
+    s.create_schema(ft)
+    n = 4000
+    s._insert_columns(ft, {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(40, 60, n),
+        "dtg": T0 + rng.integers(0, 86400_000, n),
+        "actor": np.array([["a", "b", "c", "d"][i % 4] for i in range(n)], dtype=object),
+    })
+    return s
+
+
+def _brute_knn(store, x, y, k):
+    res = store.query("pts")
+    d = haversine_m(res.columns["geom__x"], res.columns["geom__y"], x, y)
+    order = np.argsort(d, kind="stable")[:k]
+    return [(str(res.fids[i]), float(d[i])) for i in order]
+
+
+def test_knn_matches_brute_force(store):
+    got = knn_search(store, "pts", 0.0, 50.0, k=15, initial_radius_m=100.0)
+    want = _brute_knn(store, 0.0, 50.0, 15)
+    assert [f for f, _ in got] == [f for f, _ in want]
+    np.testing.assert_allclose([d for _, d in got], [d for _, d in want])
+    # ascending distances
+    ds = [d for _, d in got]
+    assert ds == sorted(ds)
+
+
+def test_knn_with_filter(store):
+    got = knn_search(store, "pts", 0.0, 50.0, k=5, cql="actor = 'a'")
+    res = store.query("pts", "actor = 'a'")
+    d = haversine_m(res.columns["geom__x"], res.columns["geom__y"], 0.0, 50.0)
+    want = [str(res.fids[i]) for i in np.argsort(d, kind="stable")[:5]]
+    assert [f for f, _ in got] == want
+
+
+def test_proximity_search(store):
+    pts = [(0.0, 50.0), (5.0, 55.0)]
+    res = proximity_search(store, "pts", pts, distance_m=100_000.0)
+    all_res = store.query("pts")
+    d0 = haversine_m(all_res.columns["geom__x"], all_res.columns["geom__y"], *pts[0])
+    d1 = haversine_m(all_res.columns["geom__x"], all_res.columns["geom__y"], *pts[1])
+    want = set(np.asarray(all_res.fids)[(d0 <= 100_000) | (d1 <= 100_000)])
+    assert set(res.fids) == want and len(want) > 0
+
+
+def test_tube_select(store):
+    # a track crossing the data: brute-force oracle over samples
+    track = [(-5.0, 45.0, T0), (0.0, 50.0, T0 + 3600_000), (5.0, 55.0, T0 + 7200_000)]
+    res = tube_select(store, "pts", track, buffer_m=50_000, time_buffer_ms=86400_000)
+    assert len(res) > 0
+    from geomesa_tpu.process.tube import _resample
+
+    samples = _resample(track, 100_000.0)
+    all_res = store.query("pts")
+    fx, fy = all_res.columns["geom__x"], all_res.columns["geom__y"]
+    ts = np.asarray(all_res.columns["dtg"], dtype=np.float64)
+    keep = np.zeros(len(all_res), dtype=bool)
+    for x, y, t in samples:
+        keep |= (haversine_m(fx, fy, x, y) <= 50_000) & (np.abs(ts - t) <= 86400_000)
+    assert set(res.fids) == set(np.asarray(all_res.fids)[keep])
+
+
+def test_tube_select_time_filtering(store):
+    # tight time buffer excludes most features
+    track = [(0.0, 50.0, T0), (0.0, 50.0, T0 + 1000)]
+    wide = tube_select(store, "pts", track, buffer_m=200_000, time_buffer_ms=86400_000)
+    tight = tube_select(store, "pts", track, buffer_m=200_000, time_buffer_ms=60_000)
+    assert len(tight) < len(wide)
+
+
+def test_unique_values(store):
+    vals = unique_values(store, "pts", "actor")
+    assert {v for v, _ in vals} == {"a", "b", "c", "d"}
+    assert sum(c for _, c in vals) == 4000
+    sub = unique_values(store, "pts", "actor", "bbox(geom, -10, 40, 0, 50)")
+    assert sum(c for _, c in sub) == len(store.query("pts", "bbox(geom, -10, 40, 0, 50)"))
