@@ -39,9 +39,20 @@ from geomesa_tpu.parallel.mesh import (
 )
 from geomesa_tpu.store.blocks import IndexTable
 
-# one jit per (N, K, W) shape bucket; padding keeps the bucket count small
-_z3_mask = jax.jit(z3_query_mask)
-_z2_mask = jax.jit(z2_query_mask)
+# one jit per (N, K, W) shape bucket; padding keeps the bucket count small.
+# masks come back bit-packed (8 rows/byte) so the host transfer is N/8 bytes
+import jax.numpy as jnp
+
+
+def _packed(mask_fn):
+    def run(*args):
+        return jnp.packbits(mask_fn(*args))
+
+    return jax.jit(run)
+
+
+_z3_mask_packed = _packed(z3_query_mask)
+_z2_mask_packed = _packed(z2_query_mask)
 
 
 class DeviceIndex:
@@ -61,8 +72,6 @@ class DeviceIndex:
         ys: List[np.ndarray] = []
         ts: List[np.ndarray] = []
         bins: List[np.ndarray] = []
-        fid_count = 0
-        fid_set = set()
         self.block_starts: List[int] = []
         n = 0
         for b in table.blocks:
@@ -76,15 +85,10 @@ class DeviceIndex:
                 xi, yi = zorder.z2_decode(key)
             xs.append(xi.astype(np.int32))
             ys.append(yi.astype(np.int32))
-            fids = b.columns["__fid__"]
-            fid_count += len(fids)
-            fid_set.update(fids)
             n += b.n
         self.n = n
-        # duplicate fids (feature updates) are deduped by the candidate path;
-        # fused aggregations must fall back to host when present
-        self.has_duplicate_fids = len(fid_set) != fid_count
-        m = max(1, mesh.devices.size)
+        # x8 keeps each shard byte-aligned for the packbits mask transfer
+        m = max(1, mesh.devices.size) * 8
         self._m = m
         self.xi = self._pack(xs, np.int32, 0)
         self.yi = self._pack(ys, np.int32, 0)
@@ -128,13 +132,15 @@ class DeviceIndex:
         return True
 
     def mask(self, boxes: np.ndarray, windows: Optional[np.ndarray]) -> np.ndarray:
+        """Candidate mask; transferred as packed bits (device rows / 8 bytes)
+        to keep the device->host hop small on tunneled transports."""
         b = replicate(self.mesh, boxes)
         if self.kind == "z3":
             w = replicate(self.mesh, windows)
-            out = _z3_mask(self.xi, self.yi, self.bins, self.ti, self.valid, b, w)
+            out = _z3_mask_packed(self.xi, self.yi, self.bins, self.ti, self.valid, b, w)
         else:
-            out = _z2_mask(self.xi, self.yi, self.valid, b)
-        return np.asarray(out)[: self.n]
+            out = _z2_mask_packed(self.xi, self.yi, self.valid, b)
+        return np.unpackbits(np.asarray(out))[: self.n].astype(bool)
 
     def to_block_rows(self, rows: np.ndarray) -> List[Tuple[int, np.ndarray]]:
         """Global candidate rows -> [(block index, local rows)]."""
@@ -185,6 +191,10 @@ class TpuScanExecutor:
             and bool(plan.values.spatial_envelopes)
             and not table.tombstones
         )
+
+    @staticmethod
+    def _has_visibilities(table: IndexTable) -> bool:
+        return any("__vis__" in b.columns for b in table.blocks)
 
     def scan_candidates(self, table: IndexTable, plan: QueryPlan):
         """Device candidate scan; None -> caller falls back to host ranges."""
@@ -288,14 +298,13 @@ class TpuScanExecutor:
             return None
         if plan.secondary is not None or spec.get("weight") or spec.get("exact"):
             return None
+        if self._has_visibilities(table):
+            # per-feature visibility needs the row-wise auth check
+            return None
         gv = plan.values.geometries
         if not gv.values or not gv.precise or not all(g.is_rectangle() for g in gv.values):
             return None
         dev = self.device_index(table)
-        if dev.has_duplicate_fids:
-            # updates leave multiple live rows per fid; the candidate path
-            # dedupes them, a fused aggregation would double-count
-            return None
         windows = None
         if table.index.name == "z3":
             if not plan.values.bins:

@@ -21,13 +21,24 @@ def haversine_m(lon1, lat1, lon2, lat2) -> np.ndarray:
 
 
 def degrees_box(x: float, y: float, radius_m: float):
-    """Conservative lon/lat bbox containing the radius_m circle around (x, y)."""
-    dlat = float(np.degrees(radius_m / EARTH_RADIUS_M))
-    cos = max(0.01, float(np.cos(np.radians(y))))
-    dlon = dlat / cos
+    """Conservative lon/lat bbox containing the radius_m circle around (x, y).
+
+    The max longitudinal half-width of a spherical cap is
+    asin(sin(c) / cos(lat)) with c the angular radius — NOT c / cos(lat),
+    which under-covers at high latitude. If the cap reaches a pole every
+    longitude is included.
+    """
+    c = radius_m / EARTH_RADIUS_M  # angular radius
+    dlat = float(np.degrees(c))
+    lat_lo = max(-90.0, float(y) - dlat)
+    lat_hi = min(90.0, float(y) + dlat)
+    sin_ratio = float(np.sin(min(c, np.pi / 2)) / max(1e-9, np.cos(np.radians(y))))
+    if lat_hi >= 90.0 or lat_lo <= -90.0 or sin_ratio >= 1.0:
+        return (-180.0, lat_lo, 180.0, lat_hi)
+    dlon = float(np.degrees(np.arcsin(sin_ratio)))
     return (
         max(-180.0, float(x) - dlon),
-        max(-90.0, float(y) - dlat),
+        lat_lo,
         min(180.0, float(x) + dlon),
-        min(90.0, float(y) + dlat),
+        lat_hi,
     )

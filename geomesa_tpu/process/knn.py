@@ -14,8 +14,6 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from geomesa_tpu.filter.parser import parse_cql, to_cql
-from geomesa_tpu.index.planner import Query
 from geomesa_tpu.process.geodesy import degrees_box, haversine_m
 
 

@@ -24,7 +24,7 @@ def proximity_search(
 ):
     """QueryResult of features within distance_m of ANY input point."""
     from geomesa_tpu.store.blocks import take_rows
-    from geomesa_tpu.store.datastore import QueryResult, _empty_columns
+    from geomesa_tpu.store.datastore import QueryResult
 
     ft = store.get_schema(name)
     geom = ft.default_geometry.name

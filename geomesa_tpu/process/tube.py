@@ -41,7 +41,7 @@ def tube_select(
     """QueryResult of features inside the corridor around ``track``
     ([(lon, lat, t_ms)] ordered by time)."""
     from geomesa_tpu.store.blocks import take_rows
-    from geomesa_tpu.store.datastore import QueryResult, _empty_columns
+    from geomesa_tpu.store.datastore import QueryResult
 
     if not track:
         raise ValueError("empty track")
@@ -90,6 +90,4 @@ def tube_select(
         if ft_ms is not None:
             ok &= np.abs(ft_ms[s0:s1, None] - st[None, :]) <= time_buffer_ms
         keep[s0:s1] = ok.any(axis=1)
-    from geomesa_tpu.store.blocks import take_rows as _take
-
-    return QueryResult(ft, _take(result.columns, np.flatnonzero(keep)), result.plan)
+    return QueryResult(ft, take_rows(result.columns, np.flatnonzero(keep)), result.plan)

@@ -1,0 +1,16 @@
+"""Security layer: visibility expressions + authorization providers.
+
+Rebuild of ``geomesa-security`` (SURVEY.md section 2.3): Accumulo-style
+boolean visibility expressions per feature (``a&(b|c)``, parsed by
+VisibilityEvaluator.scala:21-50 via parboiled; recursive descent here) and
+the AuthorizationsProvider SPI. Features carry their visibility in the
+``__vis__`` column; queries evaluate it against the store's provider with a
+per-expression cache so columnar enforcement is O(unique expressions).
+"""
+
+from geomesa_tpu.security.visibility import (
+    AuthorizationsProvider,
+    DefaultAuthorizationsProvider,
+    VisibilityEvaluator,
+    visibility_mask,
+)

@@ -27,6 +27,12 @@ def columns_from_features(ft: FeatureType, features: Sequence[Feature]) -> Colum
     n = len(features)
     out: Columns = {}
     out["__fid__"] = np.array([f.fid for f in features], dtype=object)
+    vis = [
+        (f.user_data or {}).get("visibility") if f.user_data is not None else None
+        for f in features
+    ]
+    if any(v for v in vis):
+        out["__vis__"] = np.array(vis, dtype=object)
     for idx, attr in enumerate(ft.attributes):
         vals = [f.values[idx] for f in features]
         if attr.type == AttributeType.POINT:
@@ -76,9 +82,12 @@ def concat_columns(parts: Sequence[Columns]) -> Columns:
             if k in p:
                 arrs.append(p[k])
             else:
-                # missing null-mask columns mean "no nulls in this part"
+                # missing null-mask columns mean "no nulls in this part";
+                # a missing __vis__ means "no visibilities in this batch"
                 if k.endswith("__null"):
                     arrs.append(np.zeros(n, dtype=bool))
+                elif k == "__vis__":
+                    arrs.append(np.full(n, None, dtype=object))
                 else:
                     raise KeyError(f"Column {k} missing from a part")
         out[k] = np.concatenate(arrs)

@@ -104,9 +104,15 @@ class FeatureWriter:
         self.buffer = ColumnBuffer(ft)
         self.flush_size = flush_size
 
-    def write(self, values: Sequence[Any], fid: Optional[str] = None) -> str:
+    def write(
+        self,
+        values: Sequence[Any],
+        fid: Optional[str] = None,
+        visibility: Optional[str] = None,
+    ) -> str:
         fid = fid if fid is not None else str(uuid.uuid4())
-        self.buffer.append(Feature(self.ft, fid, values))
+        user_data = {"visibility": visibility} if visibility else None
+        self.buffer.append(Feature(self.ft, fid, values, user_data))
         if len(self.buffer) >= self.flush_size:
             self.flush()
         return fid
@@ -146,12 +152,24 @@ class TpuDataStore:
         executor: Optional["ScanExecutor"] = None,
         flush_size: int = DEFAULT_FLUSH_SIZE,
         stats: Optional[Any] = None,
+        auths: Optional[Any] = None,
+        audit_writer: Optional[Any] = None,
+        metrics: Optional[Any] = None,
+        query_timeout_s: Optional[float] = None,
+        user: str = "unknown",
     ):
         from geomesa_tpu.stats.service import MetadataBackedStats
 
         self.metadata = metadata or InMemoryMetadata()
         self.executor = executor or HostScanExecutor()
         self.flush_size = flush_size
+        # AuthorizationsProvider, a plain list of auth strings, or None
+        # (None = no auths: only visibility-free features are readable)
+        self.auths = auths
+        self.audit_writer = audit_writer
+        self.metrics = metrics
+        self.query_timeout_s = query_timeout_s
+        self.user = user
         # write-time maintained sketches feeding the cost-based decider
         # (accumulo/data/stats/StatsCombiner.scala:26 analog)
         self.stats = stats if stats is not None else MetadataBackedStats(self.metadata)
@@ -164,6 +182,14 @@ class TpuDataStore:
             spec = self.metadata.read(name, "attributes")
             if spec:
                 self._register(parse_spec(name, spec))
+
+    @property
+    def authorizations(self) -> List[str]:
+        if self.auths is None:
+            return []
+        if hasattr(self.auths, "get_authorizations"):
+            return list(self.auths.get_authorizations())
+        return list(self.auths)
 
     # -- schema CRUD --------------------------------------------------------
 
@@ -219,7 +245,16 @@ class TpuDataStore:
         for table in self._tables[name].values():
             table.compact()
 
-    def count(self, name: str) -> int:
+    def count(self, name: str, query: Union[str, "Query", None] = None, exact: bool = True) -> int:
+        """Feature count; with a filter, ``exact=False`` answers from stats
+        (the EXACT_COUNT hint / GeoMesaStats.getCount split)."""
+        if query is not None:
+            q = self._as_query(query)
+            if not exact and self.stats is not None:
+                est = self.stats.get_count(self.get_schema(name), q.filter)
+                if est is not None:
+                    return int(est)
+            return len(self.query(name, q))
         tables = self._tables[name]
         first = next(iter(tables.values()))
         n = first.num_rows
@@ -238,9 +273,46 @@ class TpuDataStore:
         return plan.explain
 
     def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
+        import time as _time
+
+        t_start = _time.perf_counter()
         ft = self.get_schema(name)
         query = self._as_query(query)
         plan = self._plan_cached(name, query)
+        t_planned = _time.perf_counter()
+        result = self._execute(name, ft, query, plan, t_planned)
+        if self.audit_writer is not None or self.metrics is not None:
+            self._audit(name, query, plan, result, t_start, t_planned)
+        return result
+
+    def _audit(self, name, query, plan, result, t_start, t_planned):
+        import time as _time
+
+        from geomesa_tpu.filter.parser import to_cql
+        from geomesa_tpu.utils.audit import QueryEvent
+
+        now = _time.perf_counter()
+        if self.metrics is not None:
+            self.metrics.inc("queries")
+            self.metrics.update_timer("query.plan", t_planned - t_start)
+            self.metrics.update_timer("query.scan", now - t_planned)
+        if self.audit_writer is not None:
+            self.audit_writer.write_event(
+                QueryEvent(
+                    store=type(self).__name__,
+                    type_name=name,
+                    user=self.user,
+                    filter=to_cql(query.filter),
+                    hints=dict(query.hints),
+                    date_ms=int(_time.time() * 1000),
+                    planning_ms=1000 * (t_planned - t_start),
+                    scanning_ms=1000 * (now - t_planned),
+                    hits=len(result),
+                )
+            )
+
+    def _execute(self, name, ft, query: Query, plan: QueryPlan, t_scan_start) -> QueryResult:
+        import time as _time
         if plan.is_empty:
             empty = _empty_columns(ft)
             if has_aggregation(query.hints):
@@ -264,16 +336,53 @@ class TpuDataStore:
                 scan = table.scan(plan.ranges)
             else:
                 scan = table.scan_all()
+        # loose-bbox: for a residual-free point-index plan the candidate set
+        # IS the loose result (Z2Index.scala:26-40 loose-bbox semantics)
+        loose = (
+            query.hints.get("loose_bbox")
+            and plan.index.name in ("z2", "z3")
+            and plan.secondary is None
+        )
         for block, rows in scan:
-            mask_cols = take_rows(block.columns, rows)
-            if plan.post_filter is not None:
+            if self.query_timeout_s is not None and (
+                _time.perf_counter() - t_scan_start > self.query_timeout_s
+            ):
+                from geomesa_tpu.utils.audit import QueryTimeout
+
+                raise QueryTimeout(
+                    f"query exceeded {self.query_timeout_s}s (geomesa.query.timeout analog)"
+                )
+            # gather value columns first; the (object-dtype) fid column is
+            # gathered once, only for rows surviving the exact post-filter
+            mask_cols = {
+                k: v[rows]
+                for k, v in block.columns.items()
+                if k not in ("__fid__", "__vis__")
+            }
+            if plan.post_filter is not None and not loose:
                 mask = self.executor.post_filter(ft, plan, mask_cols)
                 if not mask.all():
-                    mask_cols = take_rows(mask_cols, np.where(mask)[0])
-            if len(next(iter(mask_cols.values()), [])):
+                    rows = rows[mask]
+                    mask_cols = {k: v[mask] for k, v in mask_cols.items()}
+            vis = block.columns.get("__vis__")
+            if vis is not None:
+                # per-feature visibility vs this store's authorizations
+                # (VisibilityEvaluator.scala:21 / SecurityUtils analog)
+                from geomesa_tpu.security import visibility_mask
+
+                vmask = visibility_mask(vis[rows], self.authorizations)
+                if not vmask.all():
+                    rows = rows[vmask]
+                    mask_cols = {k: v[vmask] for k, v in mask_cols.items()}
+            mask_cols["__fid__"] = block.columns["__fid__"][rows]
+            if len(rows):
                 parts.append(mask_cols)
         columns = concat_columns(parts) if parts else _empty_columns(ft)
-        columns = _dedupe_by_fid(columns)
+        if plan.index.name in ("xz2", "xz3"):
+            # only extent indices can emit multiple rows per feature
+            # (QueryPlanner.scala:83-85 dedupes exactly this case; point
+            # indices are one-row-per-feature in the reference too)
+            columns = _dedupe_by_fid(columns)
         if has_aggregation(query.hints):
             agg = run_aggregation(ft, query.hints, columns)
             return QueryResult(ft, _empty_columns(ft), plan, agg)
@@ -349,7 +458,30 @@ def _dedupe_by_fid(columns: Columns) -> Columns:
     return take_rows(columns, np.sort(first_idx))
 
 
+def _apply_sampling(query: Query, columns: Columns) -> Columns:
+    """hints['sampling'] = fraction in (0, 1]; optional hints['sample_by']
+    threads the 1-in-n selection per attribute value (SamplingIterator /
+    FeatureSampler analog)."""
+    frac = query.hints.get("sampling")
+    n = len(next(iter(columns.values()), []))
+    if not frac or frac >= 1.0 or n == 0:
+        return columns
+    nth = max(1, int(round(1.0 / float(frac))))
+    by = query.hints.get("sample_by")
+    if by and by in columns:
+        keep = np.zeros(n, dtype=bool)
+        col = columns[by]
+        for v in np.unique(col):
+            idx = np.flatnonzero(col == v)
+            keep[idx[::nth]] = True
+    else:
+        keep = np.zeros(n, dtype=bool)
+        keep[::nth] = True
+    return {k: v[keep] for k, v in columns.items()}
+
+
 def _apply_query_options(ft: FeatureType, query: Query, columns: Columns) -> Columns:
+    columns = _apply_sampling(query, columns)
     n = len(next(iter(columns.values()), []))
     if query.sort_by and n:
         keys = []

@@ -1,0 +1,2 @@
+"""Foundation utilities (the geomesa-utils analogs not already absorbed by
+other layers): geohash math, audit events, metrics registry, profiling."""

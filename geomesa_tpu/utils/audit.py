@@ -1,0 +1,118 @@
+"""Query auditing + metrics + profiling.
+
+Reference: audit interfaces (geomesa-utils audit/AuditedEvent.scala:1-102,
+QueryEvent index/audit/QueryEvent.scala, async writers in
+geomesa-accumulo audit/), Dropwizard metrics (geomesa-metrics
+MetricsConfig.scala:26) and MethodProfiling/Timings
+(utils/stats/MethodProfiling.scala:1-222). Kept deliberately lean: an event
+dataclass, pluggable writers, and a counter/timer registry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class QueryEvent:
+    """One audited query (user, filter, timings, hits)."""
+
+    store: str
+    type_name: str
+    user: str
+    filter: str
+    hints: Dict[str, Any]
+    date_ms: int
+    planning_ms: float
+    scanning_ms: float
+    hits: int
+
+
+class AuditWriter:
+    def write_event(self, event: QueryEvent) -> None:
+        raise NotImplementedError
+
+
+class InMemoryAuditWriter(AuditWriter):
+    """Test/embedded sink; bounded ring of recent events."""
+
+    def __init__(self, capacity: int = 10_000):
+        self.capacity = capacity
+        self.events: List[QueryEvent] = []
+        self._lock = threading.Lock()
+
+    def write_event(self, event: QueryEvent) -> None:
+        with self._lock:
+            self.events.append(event)
+            if len(self.events) > self.capacity:
+                del self.events[: len(self.events) - self.capacity]
+
+
+class LoggingAuditWriter(AuditWriter):
+    def __init__(self, logger: Optional[logging.Logger] = None):
+        self.logger = logger or logging.getLogger("geomesa_tpu.audit")
+
+    def write_event(self, event: QueryEvent) -> None:
+        self.logger.info(
+            "query type=%s user=%s filter=%r plan=%.1fms scan=%.1fms hits=%d",
+            event.type_name,
+            event.user,
+            event.filter,
+            event.planning_ms,
+            event.scanning_ms,
+            event.hits,
+        )
+
+
+class MetricsRegistry:
+    """Counters + timers with a snapshot report (Dropwizard registry role)."""
+
+    def __init__(self):
+        self._counters: Dict[str, int] = {}
+        self._timers: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def update_timer(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._timers.setdefault(name, []).append(seconds)
+
+    def timer(self, name: str):
+        registry = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.update_timer(name, time.perf_counter() - self.t0)
+
+        return _Ctx()
+
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counters)
+            for name, vals in self._timers.items():
+                arr = sorted(vals)
+                n = len(arr)
+                out[name] = {
+                    "count": n,
+                    "mean_ms": 1000 * sum(arr) / n,
+                    "p50_ms": 1000 * arr[n // 2],
+                    "max_ms": 1000 * arr[-1],
+                }
+            return out
+
+
+class QueryTimeout(RuntimeError):
+    """Raised when a query exceeds the store's timeout budget
+    (the ThreadManagement reaper analog, index/utils/ThreadManagement.scala:
+    21-60 — checked between scan units instead of a reaper thread)."""

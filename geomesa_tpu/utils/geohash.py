@@ -1,0 +1,96 @@
+"""Geohash encode/decode/neighbors, vectorized.
+
+Reference: geomesa-utils geohash/GeoHash.scala:1-414 + GeohashUtils.scala
+(used by the KNN spiral and legacy indices). Base-32 alphabet, interleaved
+lon/lat bits, msb-first — interoperable with the standard geohash system.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+_BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+_DECODE = {c: i for i, c in enumerate(_BASE32)}
+
+
+def encode(lon, lat, precision: int = 9) -> np.ndarray:
+    """Geohash strings of ``precision`` chars; vectorized over arrays."""
+    lon = np.atleast_1d(np.asarray(lon, dtype=np.float64))
+    lat = np.atleast_1d(np.asarray(lat, dtype=np.float64))
+    nbits = precision * 5
+    lon_bits = (nbits + 1) // 2
+    lat_bits = nbits // 2
+    xi = np.minimum(
+        ((lon + 180.0) / 360.0 * (1 << lon_bits)).astype(np.uint64),
+        (1 << lon_bits) - 1,
+    )
+    yi = np.minimum(
+        ((lat + 90.0) / 180.0 * (1 << lat_bits)).astype(np.uint64),
+        (1 << lat_bits) - 1,
+    )
+    # interleave msb-first: even global bit positions (0,2,..) are lon
+    z = np.zeros(len(xi), dtype=np.uint64)
+    for b in range(nbits):
+        if b % 2 == 0:  # lon bit, msb first
+            src = xi >> np.uint64(lon_bits - 1 - b // 2)
+        else:
+            src = yi >> np.uint64(lat_bits - 1 - b // 2)
+        z = (z << np.uint64(1)) | (src & np.uint64(1))
+    out = np.empty(len(z), dtype=object)
+    for i, v in enumerate(z):
+        v = int(v)
+        chars = []
+        for c in range(precision):
+            shift = 5 * (precision - 1 - c)
+            chars.append(_BASE32[(v >> shift) & 0x1F])
+        out[i] = "".join(chars)
+    return out
+
+
+def decode_bounds(geohash: str) -> Tuple[float, float, float, float]:
+    """(xmin, ymin, xmax, ymax) of the geohash cell."""
+    lon = [-180.0, 180.0]
+    lat = [-90.0, 90.0]
+    even = True
+    for ch in geohash:
+        cd = _DECODE[ch]
+        for b in (16, 8, 4, 2, 1):
+            rng = lon if even else lat
+            mid = (rng[0] + rng[1]) / 2
+            if cd & b:
+                rng[0] = mid
+            else:
+                rng[1] = mid
+            even = not even
+    return (lon[0], lat[0], lon[1], lat[1])
+
+
+def decode(geohash: str) -> Tuple[float, float]:
+    """Cell-center (lon, lat)."""
+    xmin, ymin, xmax, ymax = decode_bounds(geohash)
+    return ((xmin + xmax) / 2, (ymin + ymax) / 2)
+
+
+def neighbors(geohash: str) -> List[str]:
+    """The 8 surrounding cells (grid walk via re-encode of offset centers)."""
+    xmin, ymin, xmax, ymax = decode_bounds(geohash)
+    w = xmax - xmin
+    h = ymax - ymin
+    cx = (xmin + xmax) / 2
+    cy = (ymin + ymax) / 2
+    out = []
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            if dx == 0 and dy == 0:
+                continue
+            x = cx + dx * w
+            y = cy + dy * h
+            if x < -180.0:
+                x += 360.0
+            elif x > 180.0:
+                x -= 360.0
+            if -90.0 <= y <= 90.0:
+                out.append(str(encode(x, y, len(geohash))[0]))
+    return out

@@ -1,0 +1,197 @@
+"""Security (visibility/auth), geohash, hints (sampling/loose/count),
+audit/metrics/timeout tests."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.security import (
+    DefaultAuthorizationsProvider,
+    VisibilityEvaluator,
+    visibility_mask,
+)
+from geomesa_tpu.security.visibility import VisibilityError
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import geohash
+from geomesa_tpu.utils.audit import InMemoryAuditWriter, MetricsRegistry, QueryTimeout
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+T0 = int(np.datetime64("2026-02-01T00:00:00", "ms").astype("int64"))
+
+
+# -- visibility --------------------------------------------------------------
+
+def test_visibility_evaluator():
+    assert VisibilityEvaluator.evaluate("", ["a"])
+    assert VisibilityEvaluator.evaluate("a", ["a", "b"])
+    assert not VisibilityEvaluator.evaluate("a", ["b"])
+    assert VisibilityEvaluator.evaluate("a&b", ["a", "b"])
+    assert not VisibilityEvaluator.evaluate("a&b", ["a"])
+    assert VisibilityEvaluator.evaluate("a|b", ["b"])
+    assert VisibilityEvaluator.evaluate("a&(b|c)", ["a", "c"])
+    assert not VisibilityEvaluator.evaluate("a&(b|c)", ["b", "c"])
+    assert VisibilityEvaluator.evaluate('"weird label"|x', ["weird label"])
+    with pytest.raises(VisibilityError):
+        VisibilityEvaluator.parse("a&b|c")
+    with pytest.raises(VisibilityError):
+        VisibilityEvaluator.parse("(a&b")
+
+
+def test_visibility_mask_vectorized():
+    col = np.array(["a", "a&b", None, "", "b"], dtype=object)
+    np.testing.assert_array_equal(
+        visibility_mask(col, ["a"]), [True, False, True, True, False]
+    )
+
+
+def test_store_enforces_visibility():
+    s = TpuDataStore(auths=DefaultAuthorizationsProvider(["admin"]))
+    s.create_schema(parse_spec("v", SPEC))
+    with s.writer("v") as w:
+        w.write(["open", T0, Point(0, 0)], fid="f1")
+        w.write(["secret", T0, Point(0, 0)], fid="f2", visibility="admin")
+        w.write(["topsecret", T0, Point(0, 0)], fid="f3", visibility="admin&alpha")
+    assert sorted(s.query("v").fids) == ["f1", "f2"]
+
+    s2 = TpuDataStore()  # no auths at all
+    s2.create_schema(parse_spec("v", SPEC))
+    with s2.writer("v") as w:
+        w.write(["open", T0, Point(0, 0)], fid="f1")
+        w.write(["secret", T0, Point(0, 0)], fid="f2", visibility="admin")
+    assert sorted(s2.query("v").fids) == ["f1"]
+
+
+# -- geohash -----------------------------------------------------------------
+
+def test_geohash_known_values():
+    # canonical test vector: ezs42 ~= (-5.6, 42.6)
+    assert str(geohash.encode(-5.6, 42.6, 5)[0]) == "ezs42"
+    lon, lat = geohash.decode("ezs42")
+    assert abs(lon - -5.6) < 0.05 and abs(lat - 42.6) < 0.05
+
+
+def test_geohash_roundtrip_random():
+    rng = np.random.default_rng(3)
+    lon = rng.uniform(-180, 180, 200)
+    lat = rng.uniform(-90, 90, 200)
+    hashes = geohash.encode(lon, lat, 9)
+    for i in range(200):
+        b = geohash.decode_bounds(str(hashes[i]))
+        assert b[0] - 1e-9 <= lon[i] <= b[2] + 1e-9
+        assert b[1] - 1e-9 <= lat[i] <= b[3] + 1e-9
+
+
+def test_geohash_neighbors():
+    n = geohash.neighbors("ezs42")
+    assert len(n) == 8 and "ezs42" not in n
+    # all neighbors share the 3-char prefix region or adjoin it
+    assert all(len(x) == 5 for x in n)
+
+
+# -- hints -------------------------------------------------------------------
+
+@pytest.fixture()
+def filled_store():
+    s = TpuDataStore(metrics=MetricsRegistry(), audit_writer=InMemoryAuditWriter())
+    ft = parse_spec("h", SPEC)
+    s.create_schema(ft)
+    rng = np.random.default_rng(9)
+    n = 2000
+    s._insert_columns(ft, {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-50, 50, n),
+        "geom__y": rng.uniform(-50, 50, n),
+        "dtg": T0 + rng.integers(0, 86400_000, n),
+        "name": np.array([f"n{i % 5}" for i in range(n)], dtype=object),
+    })
+    return s
+
+
+def test_sampling_hint(filled_store):
+    full = filled_store.query("h", "bbox(geom, -50, -50, 50, 50)")
+    q = Query.cql("bbox(geom, -50, -50, 50, 50)", hints={"sampling": 0.1})
+    sampled = filled_store.query("h", q)
+    assert 0.05 * len(full) < len(sampled) < 0.15 * len(full)
+    q2 = Query.cql("bbox(geom, -50, -50, 50, 50)", hints={"sampling": 0.2, "sample_by": "name"})
+    by = filled_store.query("h", q2)
+    # every name group still represented
+    assert set(np.unique(by.columns["name"])) == {f"n{i}" for i in range(5)}
+
+
+def test_loose_bbox_hint(filled_store):
+    exact = filled_store.query("h", "bbox(geom, -10, -10, 10, 10)")
+    q = Query.cql("bbox(geom, -10, -10, 10, 10)", hints={"loose_bbox": True})
+    loose = filled_store.query("h", q)
+    # loose is a superset of exact
+    assert set(exact.fids) <= set(loose.fids)
+
+
+def test_count_estimate(filled_store):
+    exact = filled_store.count("h", "bbox(geom, -25, -50, 25, 50)")
+    est = filled_store.count("h", "bbox(geom, -25, -50, 25, 50)", exact=False)
+    assert exact == len(filled_store.query("h", "bbox(geom, -25, -50, 25, 50)"))
+    assert 0.7 * exact < est < 1.3 * exact
+
+
+def test_audit_and_metrics(filled_store):
+    filled_store.query("h", "bbox(geom, -10, -10, 10, 10)")
+    events = filled_store.audit_writer.events
+    assert events and events[-1].type_name == "h"
+    assert events[-1].hits == len(filled_store.query("h", "bbox(geom, -10, -10, 10, 10)"))
+    rep = filled_store.metrics.report()
+    assert rep["queries"] >= 2 and rep["query.scan"]["count"] >= 2
+
+
+def test_query_timeout():
+    s = TpuDataStore(query_timeout_s=0.0)
+    ft = parse_spec("t", SPEC)
+    s.create_schema(ft)
+    s._insert_columns(ft, {
+        "__fid__": np.array(["a"], dtype=object),
+        "geom__x": np.array([0.0]),
+        "geom__y": np.array([0.0]),
+        "dtg": np.array([T0]),
+        "name": np.array(["x"], dtype=object),
+    })
+    with pytest.raises(QueryTimeout):
+        s.query("t", "bbox(geom, -1, -1, 1, 1)")
+
+
+def test_mixed_visibility_blocks_compact():
+    """Blocks with and without __vis__ must merge cleanly (compact path)."""
+    s = TpuDataStore(auths=["admin"], flush_size=1)
+    s.create_schema(parse_spec("mx", SPEC))
+    with s.writer("mx") as w:
+        w.write(["open", T0, Point(0, 0)], fid="f1")       # block w/o __vis__
+        w.write(["sec", T0, Point(1, 1)], fid="f2", visibility="admin")
+    s.compact("mx")
+    assert sorted(s.query("mx").fids) == ["f1", "f2"]
+    s2 = TpuDataStore(flush_size=1)  # and without auths after compact
+    s2.create_schema(parse_spec("mx", SPEC))
+    with s2.writer("mx") as w:
+        w.write(["open", T0, Point(0, 0)], fid="f1")
+        w.write(["sec", T0, Point(1, 1)], fid="f2", visibility="admin")
+    s2.compact("mx")
+    assert sorted(s2.query("mx").fids) == ["f1"]
+
+
+def test_degrees_box_covers_high_latitude_cap():
+    from geomesa_tpu.process.geodesy import degrees_box, haversine_m
+
+    # at lat 60 with 2000 km radius, the widest lune exceeds r/(R cos lat)
+    box = degrees_box(0.0, 60.0, 2_000_000.0)
+    # sample the circle boundary; every point must be inside the box
+    theta = np.linspace(0, 2 * np.pi, 720)
+    # walk the circle numerically: move 2000 km in heading theta from (0,60)
+    lat1 = np.radians(60.0)
+    c = 2_000_000.0 / 6371008.8
+    lat2 = np.arcsin(np.sin(lat1) * np.cos(c) + np.cos(lat1) * np.sin(c) * np.cos(theta))
+    lon2 = np.degrees(np.arctan2(
+        np.sin(theta) * np.sin(c) * np.cos(lat1),
+        np.cos(c) - np.sin(lat1) * np.sin(lat2),
+    ))
+    lat2 = np.degrees(lat2)
+    assert (lon2 >= box[0] - 1e-6).all() and (lon2 <= box[2] + 1e-6).all()
+    assert (lat2 >= box[1] - 1e-6).all() and (lat2 <= box[3] + 1e-6).all()
