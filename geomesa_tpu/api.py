@@ -1,0 +1,86 @@
+"""Simple value-store facade (the geomesa-native-api analog).
+
+Reference: geomesa-native-api GeoMesaIndex.java — a Java-friendly wrapper
+hiding GeoTools: put(id, value, geometry, date), query(bbox/time) -> values,
+with a pluggable ValueSerializer. Same shape here for callers that don't
+want the full datastore surface.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+_SPEC = "payload:String,dtg:Date,*geom:Point:srid=4326"
+
+
+class ValueSerializer:
+    def to_bytes(self, value: Any) -> str:
+        raise NotImplementedError
+
+    def from_bytes(self, data: str) -> Any:
+        raise NotImplementedError
+
+
+class JsonValueSerializer(ValueSerializer):
+    def to_bytes(self, value: Any) -> str:
+        return json.dumps(value)
+
+    def from_bytes(self, data: str) -> Any:
+        return json.loads(data)
+
+
+class GeoMesaIndex:
+    """put/get/query over (id, value, lon, lat, time)."""
+
+    def __init__(
+        self,
+        name: str = "values",
+        store: Optional[TpuDataStore] = None,
+        serializer: Optional[ValueSerializer] = None,
+    ):
+        self.name = name
+        self.store = store or TpuDataStore()
+        self.serializer = serializer or JsonValueSerializer()
+        self.store.create_schema(parse_spec(name, _SPEC))
+
+    def put(self, fid: str, value: Any, x: float, y: float, t_ms: int) -> str:
+        with self.store.writer(self.name) as w:
+            return w.write(
+                [self.serializer.to_bytes(value), int(t_ms), Point(x, y)], fid=fid
+            )
+
+    def put_batch(self, items) -> None:
+        """items: iterable of (fid, value, x, y, t_ms)."""
+        with self.store.writer(self.name) as w:
+            for fid, value, x, y, t in items:
+                w.write([self.serializer.to_bytes(value), int(t), Point(x, y)], fid=fid)
+
+    def delete(self, fid: str) -> None:
+        self.store.delete_features(self.name, [fid])
+
+    def query(
+        self,
+        bbox: Optional[Tuple[float, float, float, float]] = None,
+        time_range_ms: Optional[Tuple[int, int]] = None,
+    ) -> List[Tuple[str, Any]]:
+        parts = []
+        if bbox:
+            parts.append(f"bbox(geom, {bbox[0]}, {bbox[1]}, {bbox[2]}, {bbox[3]})")
+        if time_range_ms:
+            lo = np.datetime64(int(time_range_ms[0]), "ms").item().isoformat() + "Z"
+            hi = np.datetime64(int(time_range_ms[1]), "ms").item().isoformat() + "Z"
+            parts.append(f"dtg DURING {lo}/{hi}")
+        cql = " AND ".join(parts) or "INCLUDE"
+        res = self.store.query(self.name, cql)
+        payloads = res.columns["payload"]
+        return [
+            (str(fid), self.serializer.from_bytes(payloads[i]))
+            for i, fid in enumerate(res.fids)
+        ]

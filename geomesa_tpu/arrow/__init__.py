@@ -1,0 +1,19 @@
+"""Arrow columnar interchange (the geomesa-arrow analog).
+
+Reference: geomesa-arrow (SURVEY.md section 2.4) — JTS geometry vectors
+(PointVector.java FixedSizeList layout), SimpleFeatureVector SFT<->schema
+mapping (vector/SimpleFeatureVector.scala:1-204), dictionary-encoded
+attributes (ArrowDictionary), IPC file IO (SimpleFeatureArrowFileReader/
+Writer) and the ArrowScan wire format servers stream to clients.
+
+Our feature blocks are already struct-of-arrays, so the mapping is direct:
+point geometry -> FixedSizeList<f64>[2], Date -> timestamp[ms], strings ->
+dictionary-encoded utf8. Requires pyarrow (present in this environment);
+import of this package is the gate.
+"""
+
+from geomesa_tpu.arrow.vector import (
+    SimpleFeatureVector,
+    read_features,
+    write_features,
+)

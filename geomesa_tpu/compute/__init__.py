@@ -1,0 +1,16 @@
+"""Batch analytics layer (the geomesa-spark analog).
+
+Reference: geomesa-spark (SURVEY.md section 2.5) — SpatialRDDProvider feeds
+query results into Spark, Spark SQL exposes ~40 ST_* UDFs with Catalyst
+pushdown (SQLRules.scala:30-62). Here the same roles are:
+
+  * ``st_functions`` — vectorized ST_* library over columnar arrays
+    (numpy on host; the same expressions trace under jax.jit on device).
+  * ``SpatialFrame`` — a columnar frame over query results with select /
+    where / with_column / group_by aggregation; spatial predicates push
+    down to the datastore's CQL planner when constructed via
+    ``SpatialFrame.from_query`` (the Catalyst-rule analog).
+"""
+
+from geomesa_tpu.compute import st_functions as st
+from geomesa_tpu.compute.frame import SpatialFrame

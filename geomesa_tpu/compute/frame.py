@@ -1,0 +1,94 @@
+"""SpatialFrame: columnar analytics over query results.
+
+The Spark-DataFrame role (GeoMesaSparkSQL.scala GeoMesaRelation): construct
+from a datastore query — the CQL predicate pushes down to the index planner
+exactly as Catalyst rules fold ST_* predicates into relation CQL
+(SQLRules.scala:30-62) — then select / where / with_column / group_by
+aggregate columnar, on host numpy (device arrays work transparently for
+numeric columns under jax).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SpatialFrame:
+    def __init__(self, columns: Dict[str, np.ndarray], ft=None):
+        self.columns = dict(columns)
+        self.ft = ft
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_query(cls, store, name: str, cql: str = "INCLUDE") -> "SpatialFrame":
+        """Predicate pushdown: the CQL goes through the index planner."""
+        res = store.query(name, cql)
+        return cls(res.columns, res.ft)
+
+    # -- basic ops ----------------------------------------------------------
+
+    def __len__(self):
+        for v in self.columns.values():
+            return len(v)
+        return 0
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns)
+
+    def select(self, *names: str) -> "SpatialFrame":
+        keep = set(names) | {"__fid__"}
+        cols = {}
+        for k, v in self.columns.items():
+            base = k.split("__")[0] if "__" in k and not k.startswith("__") else k
+            if k in keep or base in keep:
+                cols[k] = v
+        return SpatialFrame(cols, self.ft)
+
+    def where(self, mask: np.ndarray) -> "SpatialFrame":
+        idx = np.flatnonzero(np.asarray(mask))
+        return SpatialFrame({k: v[idx] for k, v in self.columns.items()}, self.ft)
+
+    def with_column(self, name: str, values: np.ndarray) -> "SpatialFrame":
+        cols = dict(self.columns)
+        cols[name] = np.asarray(values)
+        return SpatialFrame(cols, self.ft)
+
+    def sort(self, by: str, ascending: bool = True) -> "SpatialFrame":
+        order = np.argsort(self.columns[by], kind="stable")
+        if not ascending:
+            order = order[::-1]
+        return SpatialFrame({k: v[order] for k, v in self.columns.items()}, self.ft)
+
+    # -- aggregation --------------------------------------------------------
+
+    _AGGS: Dict[str, Callable] = {
+        "count": lambda v: len(v),
+        "sum": lambda v: np.sum(v),
+        "mean": lambda v: np.mean(v),
+        "min": lambda v: np.min(v),
+        "max": lambda v: np.max(v),
+    }
+
+    def group_by(
+        self, key: str, aggs: Dict[str, Tuple[str, str]]
+    ) -> "SpatialFrame":
+        """aggs: out_name -> (agg_fn, column). The ShallowJoin/CountByDay
+        analytics shape (geomesa-accumulo-compute)."""
+        col = self.columns[key]
+        uniq, inverse = np.unique(col, return_inverse=True)
+        out: Dict[str, np.ndarray] = {key: uniq}
+        for out_name, (fn_name, src) in aggs.items():
+            fn = self._AGGS[fn_name]
+            vals = []
+            src_col = self.columns[src]
+            for g in range(len(uniq)):
+                vals.append(fn(src_col[inverse == g]))
+            out[out_name] = np.asarray(vals)
+        return SpatialFrame(out, None)
+
+    def to_dict(self) -> Dict[str, list]:
+        return {k: v.tolist() for k, v in self.columns.items()}

@@ -1,0 +1,129 @@
+"""Schema-less GeoJSON API (the geomesa-geojson analog).
+
+Reference: geomesa-geojson (SURVEY.md section 2.5): GeoJsonIndex stores
+arbitrary GeoJSON with JSON-path access, GeoJsonQuery translates a mongo-ish
+query syntax to CQL. Here GeoJSON features land in a generic point schema
+(properties as a JSON string column + extracted geometry/time) and the query
+translator covers the documented operator set ($bbox, $eq/$lt/$lte/$gt/$gte,
+$and/$or, bare equality).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+_SPEC = "props:String,dtg:Date,*geom:Point:srid=4326"
+
+
+class GeoJsonIndex:
+    def __init__(self, store: Optional[TpuDataStore] = None, date_path: str = "dtg"):
+        self.store = store or TpuDataStore()
+        self.date_path = date_path
+        self._names: set = set()
+
+    def create_index(self, name: str) -> None:
+        if name not in self._names:
+            self.store.create_schema(parse_spec(name, _SPEC))
+            self._names.add(name)
+
+    def add(self, name: str, features: Iterable[Dict[str, Any]]) -> List[str]:
+        """Add GeoJSON Feature dicts; returns fids."""
+        self.create_index(name)
+        fids = []
+        with self.store.writer(name) as w:
+            for f in features:
+                geom = f.get("geometry") or {}
+                if geom.get("type") != "Point":
+                    raise ValueError("GeoJsonIndex v1 indexes Point features")
+                x, y = geom["coordinates"][:2]
+                props = f.get("properties") or {}
+                dtg = props.get(self.date_path)
+                if isinstance(dtg, str):
+                    dtg = int(
+                        np.datetime64(dtg.replace("Z", ""), "ms").astype("int64")
+                    )
+                from geomesa_tpu.geom.base import Point
+
+                fid = w.write(
+                    [json.dumps(props), dtg, Point(float(x), float(y))],
+                    fid=f.get("id"),
+                )
+                fids.append(fid)
+        return fids
+
+    # -- queries ------------------------------------------------------------
+
+    def query(self, name: str, q: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
+        cql = self.translate(q or {})
+        res = self.store.query(name, cql)
+        out = []
+        xs = res.columns["geom__x"]
+        ys = res.columns["geom__y"]
+        props = res.columns["props"]
+        for i, fid in enumerate(res.fids):
+            p = json.loads(props[i]) if props[i] else {}
+            feat = {
+                "type": "Feature",
+                "id": str(fid),
+                "geometry": {"type": "Point", "coordinates": [float(xs[i]), float(ys[i])]},
+                "properties": p,
+            }
+            out.append(feat)
+        # property-level predicates that CQL can't see run client-side
+        residual = self._residual(q or {})
+        if residual:
+            out = [f for f in out if residual(f["properties"])]
+        return out
+
+    # mongo-ish -> CQL translation (GeoJsonQuery analog)
+
+    def translate(self, q: Dict[str, Any]) -> str:
+        parts = []
+        for key, value in q.items():
+            if key == "$bbox":
+                xmin, ymin, xmax, ymax = value
+                parts.append(f"bbox(geom, {xmin}, {ymin}, {xmax}, {ymax})")
+            elif key == "$and":
+                parts.append(" AND ".join(f"({self.translate(v)})" for v in value))
+            elif key == "$or":
+                parts.append(" OR ".join(f"({self.translate(v)})" for v in value))
+        return " AND ".join(p for p in parts if p) or "INCLUDE"
+
+    def _residual(self, q: Dict[str, Any]):
+        preds = []
+        for key, value in q.items():
+            if key.startswith("$"):
+                continue
+            if isinstance(value, dict):
+                for op, rhs in value.items():
+                    fn = {
+                        "$eq": lambda a, b: a == b,
+                        "$lt": lambda a, b: a is not None and a < b,
+                        "$lte": lambda a, b: a is not None and a <= b,
+                        "$gt": lambda a, b: a is not None and a > b,
+                        "$gte": lambda a, b: a is not None and a >= b,
+                    }.get(op)
+                    if fn is None:
+                        raise ValueError(f"unsupported operator {op}")
+                    preds.append((key, fn, rhs))
+            else:
+                preds.append((key, lambda a, b: a == b, value))
+        if not preds:
+            return None
+
+        def check(props: Dict[str, Any]) -> bool:
+            for key, fn, rhs in preds:
+                cur: Any = props
+                for part in key.split("."):
+                    cur = cur.get(part) if isinstance(cur, dict) else None
+                if not fn(cur, rhs):
+                    return False
+            return True
+
+        return check

@@ -26,8 +26,23 @@ from geomesa_tpu.stats.parser import parse_stat
 from geomesa_tpu.stats.sketches import EnvelopeStat, MinMax, Stat, Z3HistogramStat
 
 
+AGGREGATION_HINTS = ("density", "stats", "bin", "arrow")
+
+
 def has_aggregation(hints: Dict[str, Any]) -> bool:
-    return any(k in hints for k in ("density", "stats", "bin"))
+    return any(k in hints for k in AGGREGATION_HINTS)
+
+
+def run_arrow(ft: FeatureType, spec: Dict[str, Any], columns) -> bytes:
+    """Arrow IPC stream of the filtered columns (the ArrowScan wire format,
+    index-api iterators/ArrowScan.scala:91+)."""
+    import io as _io
+
+    from geomesa_tpu.arrow import write_features
+
+    buf = _io.BytesIO()
+    write_features(ft, [columns], buf, dictionary_encode=spec.get("dictionary", ()))
+    return buf.getvalue()
 
 
 def density_grid_numpy(
@@ -158,4 +173,7 @@ def run_aggregation(ft: FeatureType, hints: Dict[str, Any], columns) -> Dict[str
         out["stats"] = run_stats(ft, hints["stats"], columns)
     if "bin" in hints:
         out["bin"] = run_bin(ft, hints["bin"], columns)
+    if "arrow" in hints:
+        spec = hints["arrow"] if isinstance(hints["arrow"], dict) else {}
+        out["arrow"] = run_arrow(ft, spec, columns)
     return out

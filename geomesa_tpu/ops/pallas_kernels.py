@@ -1,0 +1,99 @@
+"""Pallas TPU kernels for the scan hot loop.
+
+The fused candidate mask is the framework's per-row hot op (the tserver
+Z3Iterator seek/next loop, accumulo/iterators/Z3Iterator.scala:42-65). The
+XLA version in ops/filters.py materializes an [N, K] broadcast; this Pallas
+kernel streams row tiles through VMEM and accumulates the per-box/window
+tests in registers, so HBM traffic is one read of each column + one packed
+write — the memory-bound optimum.
+
+Shapes: rows padded to a multiple of the 2D tile (8, 128); boxes [K, 4] and
+windows [W, 3] are small and live in VMEM replicated per tile. On non-TPU
+backends ``interpret=True`` keeps the kernel testable (conftest's CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+TILE = 8 * 128  # one (8, 128) vreg-shaped row tile per grid step
+
+
+def _z3_mask_kernel(xi_ref, yi_ref, bins_ref, offs_ref, valid_ref, boxes_ref,
+                    windows_ref, out_ref, *, k: int, w: int):
+    xi = xi_ref[...]
+    yi = yi_ref[...]
+    bins = bins_ref[...]
+    offs = offs_ref[...]
+    spatial = jnp.zeros(xi.shape, dtype=jnp.bool_)
+    for j in range(k):  # k/w are small static pads; unrolled vector ops
+        spatial = spatial | (
+            (xi >= boxes_ref[j, 0])
+            & (xi <= boxes_ref[j, 2])
+            & (yi >= boxes_ref[j, 1])
+            & (yi <= boxes_ref[j, 3])
+        )
+    temporal = jnp.zeros(xi.shape, dtype=jnp.bool_)
+    for j in range(w):
+        temporal = temporal | (
+            (bins == windows_ref[j, 0])
+            & (offs >= windows_ref[j, 1])
+            & (offs <= windows_ref[j, 2])
+        )
+    out_ref[...] = valid_ref[...] & spatial & temporal
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _run(xi, yi, bins, offs, valid, boxes, windows, interpret):
+    n = xi.shape[0]
+    rows = n // 128
+    shape = (rows, 128)
+    grid = (rows // 8,)
+    row_spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    small = lambda a: pl.BlockSpec(a.shape, lambda i: (0, 0))
+    kern = functools.partial(
+        _z3_mask_kernel, k=boxes.shape[0], w=windows.shape[0]
+    )
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[row_spec, row_spec, row_spec, row_spec, row_spec,
+                  small(boxes), small(windows)],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.bool_),
+        interpret=interpret,
+    )(
+        xi.reshape(shape),
+        yi.reshape(shape),
+        bins.reshape(shape),
+        offs.reshape(shape),
+        valid.reshape(shape),
+        boxes,
+        windows,
+    )
+    return out.reshape(n)
+
+
+def z3_query_mask_pallas(xi, yi, bins, offs, valid, boxes, windows,
+                         interpret: bool | None = None):
+    """Drop-in for ops.filters.z3_query_mask; rows must be TILE-padded."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if xi.shape[0] % TILE:
+        raise ValueError(f"rows must be padded to {TILE}")
+    return _run(
+        jnp.asarray(xi, jnp.int32),
+        jnp.asarray(yi, jnp.int32),
+        jnp.asarray(bins, jnp.int32),
+        jnp.asarray(offs, jnp.int32),
+        jnp.asarray(valid),
+        jnp.asarray(boxes, jnp.int32),
+        jnp.asarray(windows, jnp.int32),
+        interpret,
+    )

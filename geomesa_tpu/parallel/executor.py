@@ -55,6 +55,21 @@ _z3_mask_packed = _packed(z3_query_mask)
 _z2_mask_packed = _packed(z2_query_mask)
 
 
+def _use_pallas(mesh) -> bool:
+    """Single-chip TPU runs take the Pallas streaming kernel; sharded meshes
+    and CPU stay on the XLA mask (pallas under SPMD needs shard_map)."""
+    return jax.default_backend() == "tpu" and mesh.devices.size == 1
+
+
+@jax.jit
+def _z3_mask_packed_pallas(xi, yi, bins, offs, valid, boxes, windows):
+    from geomesa_tpu.ops.pallas_kernels import z3_query_mask_pallas
+
+    return jnp.packbits(
+        z3_query_mask_pallas(xi, yi, bins, offs, valid, boxes, windows, interpret=False)
+    )
+
+
 class DeviceIndex:
     """Device-resident mirror of one point-index table (z3 or z2).
 
@@ -87,8 +102,11 @@ class DeviceIndex:
             ys.append(yi.astype(np.int32))
             n += b.n
         self.n = n
-        # x8 keeps each shard byte-aligned for the packbits mask transfer
-        m = max(1, mesh.devices.size) * 8
+        # x8 keeps each shard byte-aligned for the packbits mask transfer;
+        # lcm with the pallas row tile keeps the kernel path shape-legal
+        from geomesa_tpu.ops.pallas_kernels import TILE
+
+        m = int(np.lcm(max(1, mesh.devices.size) * 8, TILE))
         self._m = m
         self.xi = self._pack(xs, np.int32, 0)
         self.yi = self._pack(ys, np.int32, 0)
@@ -137,7 +155,12 @@ class DeviceIndex:
         b = replicate(self.mesh, boxes)
         if self.kind == "z3":
             w = replicate(self.mesh, windows)
-            out = _z3_mask_packed(self.xi, self.yi, self.bins, self.ti, self.valid, b, w)
+            if _use_pallas(self.mesh):
+                out = _z3_mask_packed_pallas(
+                    self.xi, self.yi, self.bins, self.ti, self.valid, b, w
+                )
+            else:
+                out = _z3_mask_packed(self.xi, self.yi, self.bins, self.ti, self.valid, b, w)
         else:
             out = _z2_mask_packed(self.xi, self.yi, self.valid, b)
         return np.unpackbits(np.asarray(out))[: self.n].astype(bool)

@@ -121,12 +121,21 @@ class _Parser:
             self.pos += 1
             return node
         if c == '"':
-            end = self.text.find('"', self.pos + 1)
-            if end < 0:
-                raise VisibilityError(f"unterminated quote: {self.text!r}")
-            label = self.text[self.pos + 1 : end]
-            self.pos = end + 1
-            return _Label(label)
+            # scan with backslash escapes (\" and \\), as Accumulo accepts
+            chars = []
+            i = self.pos + 1
+            while i < len(self.text):
+                ch = self.text[i]
+                if ch == "\\" and i + 1 < len(self.text):
+                    chars.append(self.text[i + 1])
+                    i += 2
+                    continue
+                if ch == '"':
+                    self.pos = i + 1
+                    return _Label("".join(chars))
+                chars.append(ch)
+                i += 1
+            raise VisibilityError(f"unterminated quote: {self.text!r}")
         m = _LABEL.match(self.text, self.pos)
         if not m:
             raise VisibilityError(f"bad token at {self.pos}: {self.text!r}")

@@ -21,7 +21,11 @@ import numpy as np
 
 from geomesa_tpu.filter import ast, evaluate
 from geomesa_tpu.filter.parser import parse_cql
-from geomesa_tpu.index.aggregators import has_aggregation, run_aggregation
+from geomesa_tpu.index.aggregators import (
+    AGGREGATION_HINTS,
+    has_aggregation,
+    run_aggregation,
+)
 from geomesa_tpu.index.keyspace import IndexKeySpace, default_indices
 from geomesa_tpu.index.planner import Explainer, Query, QueryPlan, QueryPlanner
 from geomesa_tpu.schema.feature import Feature
@@ -119,7 +123,9 @@ class FeatureWriter:
 
     def write_feature(self, feature: Feature) -> str:
         if feature.fid is None:
-            feature = Feature(self.ft, str(uuid.uuid4()), feature.values)
+            feature = Feature(
+                self.ft, str(uuid.uuid4()), feature.values, feature.user_data
+            )
         self.buffer.append(feature)
         if len(self.buffer) >= self.flush_size:
             self.flush()
@@ -248,15 +254,21 @@ class TpuDataStore:
     def count(self, name: str, query: Union[str, "Query", None] = None, exact: bool = True) -> int:
         """Feature count; with a filter, ``exact=False`` answers from stats
         (the EXACT_COUNT hint / GeoMesaStats.getCount split)."""
+        tables = self._tables[name]
+        first = next(iter(tables.values()))
+        # visibility-bearing tables must count through the auth-enforcing
+        # query path — raw row counts (and write-time stats, which observed
+        # every row) would leak the cardinality of unreadable features
+        has_vis = any("__vis__" in b.columns for b in first.blocks)
         if query is not None:
             q = self._as_query(query)
-            if not exact and self.stats is not None:
+            if not exact and self.stats is not None and not has_vis:
                 est = self.stats.get_count(self.get_schema(name), q.filter)
                 if est is not None:
                     return int(est)
             return len(self.query(name, q))
-        tables = self._tables[name]
-        first = next(iter(tables.values()))
+        if has_vis:
+            return len(self.query(name))
         n = first.num_rows
         if first.tombstones:
             n -= sum(1 for _ in first.tombstones)
@@ -324,24 +336,35 @@ class TpuDataStore:
 
         # fused device density push-down: grid comes back, features don't
         # (the KryoLazyDensityIterator analog)
-        if set(query.hints) & {"density", "stats", "bin"} == {"density"}:
+        if (
+            set(query.hints) & set(AGGREGATION_HINTS) == {"density"}
+            and not query.hints.get("sampling")
+        ):
             grid = self.executor.density_scan(table, plan, query.hints["density"])
             if grid is not None:
                 return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})
 
         parts: List[Columns] = []
         scan = self.executor.scan_candidates(table, plan)
+        device_scan = scan is not None
         if scan is None:
             if plan.ranges:
                 scan = table.scan(plan.ranges)
             else:
                 scan = table.scan_all()
-        # loose-bbox: for a residual-free point-index plan the candidate set
-        # IS the loose result (Z2Index.scala:26-40 loose-bbox semantics)
+        # loose-bbox: for a residual-free rectangle-only point-index plan the
+        # device candidate set (int-domain test, same granularity as the
+        # reference's Z3Filter) IS the loose result (Z2Index.scala:26-40).
+        # Non-rectangle predicates keep full ECQL even in the reference.
+        gv = plan.values.geometries
         loose = (
             query.hints.get("loose_bbox")
             and plan.index.name in ("z2", "z3")
             and plan.secondary is None
+            and device_scan  # device int-domain candidates only
+            and gv.values
+            and gv.precise
+            and all(g.is_rectangle() for g in gv.values)
         )
         for block, rows in scan:
             if self.query_timeout_s is not None and (
@@ -384,6 +407,9 @@ class TpuDataStore:
             # indices are one-row-per-feature in the reference too)
             columns = _dedupe_by_fid(columns)
         if has_aggregation(query.hints):
+            # sampling composes with aggregations (SamplingIterator stacks
+            # under density/bin/arrow scans in the reference)
+            columns = _apply_sampling(query, columns)
             agg = run_aggregation(ft, query.hints, columns)
             return QueryResult(ft, _empty_columns(ft), plan, agg)
         columns = _apply_query_options(ft, query, columns)

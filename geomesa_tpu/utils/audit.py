@@ -71,6 +71,8 @@ class LoggingAuditWriter(AuditWriter):
 class MetricsRegistry:
     """Counters + timers with a snapshot report (Dropwizard registry role)."""
 
+    _RESERVOIR = 4096  # bounded per-timer samples (ring, like the audit sink)
+
     def __init__(self):
         self._counters: Dict[str, int] = {}
         self._timers: Dict[str, List[float]] = {}
@@ -82,7 +84,10 @@ class MetricsRegistry:
 
     def update_timer(self, name: str, seconds: float) -> None:
         with self._lock:
-            self._timers.setdefault(name, []).append(seconds)
+            vals = self._timers.setdefault(name, [])
+            vals.append(seconds)
+            if len(vals) > self._RESERVOIR:
+                del vals[: len(vals) - self._RESERVOIR]
 
     def timer(self, name: str):
         registry = self

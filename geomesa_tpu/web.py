@@ -1,0 +1,114 @@
+"""Minimal REST surface (the geomesa-web analog).
+
+Reference: geomesa-web Scalatra servlets (DataEndpoint, GeoMesaStatsEndpoint,
+SURVEY.md section 2.5). Endpoints over a datastore:
+
+    GET /types
+    GET /types/<name>            -- schema description
+    GET /query?name=&cql=&format=geojson|csv&max=
+    GET /stats/count?name=&cql=&exact=
+    GET /stats/bounds?name=
+
+Serves with the stdlib ThreadingHTTPServer — start with ``serve(store,
+port)`` or embed ``GeoMesaHandler`` elsewhere.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def make_handler(store):
+    class GeoMesaHandler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, body: str, ctype: str = "application/json"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            try:
+                parsed = urllib.parse.urlparse(self.path)
+                params = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+                route = parsed.path.rstrip("/")
+                if route == "/types":
+                    self._send(200, json.dumps(store.type_names))
+                elif route.startswith("/types/"):
+                    name = route.split("/")[-1]
+                    ft = store.get_schema(name)
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "name": name,
+                                "spec": ft.spec(),
+                                "count": store.count(name),
+                            }
+                        ),
+                    )
+                elif route == "/query":
+                    from geomesa_tpu.index.planner import Query
+                    from geomesa_tpu.tools.export import to_csv, to_geojson
+
+                    name = params["name"]
+                    q = Query.cql(params.get("cql", "INCLUDE"))
+                    if "max" in params:
+                        q.max_features = int(params["max"])
+                    res = store.query(name, q)
+                    fmt = params.get("format", "geojson")
+                    if fmt == "csv":
+                        self._send(200, to_csv(res), "text/csv")
+                    else:
+                        self._send(200, to_geojson(res), "application/geo+json")
+                elif route == "/stats/count":
+                    name = params["name"]
+                    exact = params.get("exact", "true").lower() != "false"
+                    n = store.count(name, params.get("cql", "INCLUDE"), exact=exact)
+                    self._send(200, json.dumps({"count": int(n)}))
+                elif route == "/stats/bounds":
+                    b = store.stats.get_bounds(store.get_schema(params["name"]))
+                    self._send(200, json.dumps({"bounds": b}))
+                else:
+                    self._send(404, json.dumps({"error": "not found"}))
+            except KeyError as e:
+                self._send(400, json.dumps({"error": f"missing param {e}"}))
+            except Exception as e:  # surface the error to the client
+                self._send(500, json.dumps({"error": str(e)}))
+
+    return GeoMesaHandler
+
+
+class GeoMesaServer:
+    """Embeddable server; ``with GeoMesaServer(store) as url: ...``"""
+
+    def __init__(self, store, host: str = "127.0.0.1", port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), make_handler(store))
+        self.thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> str:
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+        return self.url
+
+    def __exit__(self, *exc):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def serve(store, host: str = "127.0.0.1", port: int = 8765) -> None:
+    httpd = ThreadingHTTPServer((host, port), make_handler(store))
+    httpd.serve_forever()

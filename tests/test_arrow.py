@@ -1,0 +1,82 @@
+"""Arrow interchange tests (SimpleFeatureVector / IPC round trips / the
+ArrowScan-style query hint)."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from geomesa_tpu.arrow import SimpleFeatureVector, read_features, write_features
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+SPEC = "actor:String,n:Int,dtg:Date,*geom:Point:srid=4326"
+T0 = int(np.datetime64("2026-03-01T00:00:00", "ms").astype("int64"))
+
+
+def _columns(n=100, seed=5):
+    rng = np.random.default_rng(seed)
+    return {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-180, 180, n),
+        "geom__y": rng.uniform(-90, 90, n),
+        "dtg": T0 + rng.integers(0, 86400_000, n),
+        "actor": np.array([["USA", "FRA"][i % 2] for i in range(n)], dtype=object),
+        "n": rng.integers(0, 100, n).astype(np.int32),
+    }
+
+
+def test_schema_mapping():
+    ft = parse_spec("t", SPEC)
+    vec = SimpleFeatureVector(ft, dictionary_encode=["actor"])
+    assert vec.schema.field("geom").type == pa.list_(pa.float64(), 2)
+    assert vec.schema.field("dtg").type == pa.timestamp("ms")
+    assert pa.types.is_dictionary(vec.schema.field("actor").type)
+    assert vec.schema.field("n").type == pa.int32()
+
+
+def test_batch_roundtrip():
+    ft = parse_spec("t", SPEC)
+    vec = SimpleFeatureVector(ft, dictionary_encode=["actor"])
+    cols = _columns()
+    batch = vec.to_batch(cols)
+    back = vec.from_batch(batch)
+    np.testing.assert_array_equal(back["__fid__"], cols["__fid__"])
+    np.testing.assert_allclose(back["geom__x"], cols["geom__x"])
+    np.testing.assert_array_equal(back["dtg"], cols["dtg"])
+    np.testing.assert_array_equal(back["actor"], cols["actor"])
+    np.testing.assert_array_equal(back["n"], cols["n"])
+
+
+def test_ipc_stream_roundtrip(tmp_path):
+    ft = parse_spec("t", SPEC)
+    path = str(tmp_path / "features.arrow")
+    cols = _columns(250)
+    # two batches, dictionary-encoded strings
+    parts = [
+        {k: v[:100] for k, v in cols.items()},
+        {k: v[100:] for k, v in cols.items()},
+    ]
+    write_features(ft, parts, path, dictionary_encode=["actor"])
+    ft2, back = read_features(path)
+    assert ft2.spec() == ft.spec()
+    assert len(back["__fid__"]) == 250
+    np.testing.assert_array_equal(back["actor"], cols["actor"])
+
+
+def test_arrow_query_hint():
+    s = TpuDataStore()
+    ft = parse_spec("t", SPEC)
+    s.create_schema(ft)
+    s._insert_columns(ft, _columns(500))
+    q = Query.cql("bbox(geom, -90, -45, 90, 45)", hints={"arrow": {"dictionary": ["actor"]}})
+    res = s.query("t", q)
+    data = res.aggregate["arrow"]
+    assert isinstance(data, bytes) and len(data) > 0
+    with pa.ipc.open_stream(pa.BufferReader(data)) as reader:
+        table = reader.read_all()
+    want = s.query("t", "bbox(geom, -90, -45, 90, 45)")
+    assert table.num_rows == len(want)
+    assert pa.types.is_dictionary(table.schema.field("actor").type)

@@ -1,0 +1,129 @@
+"""User-surface tests: compute frame + ST functions, GeoJSON API, REST
+server, native-api facade."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.api import GeoMesaIndex
+from geomesa_tpu.compute import SpatialFrame, st
+from geomesa_tpu.geojson_api import GeoJsonIndex
+from geomesa_tpu.geom.base import Point, Polygon
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.web import GeoMesaServer
+
+T0 = int(np.datetime64("2026-05-01T00:00:00", "ms").astype("int64"))
+
+
+def _store(n=1000, seed=15):
+    rng = np.random.default_rng(seed)
+    s = TpuDataStore()
+    ft = parse_spec("d", "actor:String,val:Double,dtg:Date,*geom:Point:srid=4326")
+    s.create_schema(ft)
+    s._insert_columns(ft, {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-40, 40, n),
+        "geom__y": rng.uniform(-40, 40, n),
+        "dtg": T0 + rng.integers(0, 86400_000, n),
+        "actor": np.array([["USA", "FRA", "CHN"][i % 3] for i in range(n)], dtype=object),
+        "val": rng.uniform(0, 10, n),
+    })
+    return s
+
+
+# -- compute -----------------------------------------------------------------
+
+def test_spatial_frame_pushdown_and_groupby():
+    s = _store()
+    f = SpatialFrame.from_query(s, "d", "bbox(geom, -20, -20, 20, 20)")
+    assert len(f) == len(s.query("d", "bbox(geom, -20, -20, 20, 20)"))
+    g = f.group_by("actor", {"n": ("count", "val"), "total": ("sum", "val")})
+    assert set(g.columns["actor"]) == {"USA", "FRA", "CHN"}
+    assert g.columns["n"].sum() == len(f)
+    np.testing.assert_allclose(g.columns["total"].sum(), f.columns["val"].sum())
+
+
+def test_st_functions():
+    x = np.array([0.0, 10.0])
+    y = np.array([0.0, 10.0])
+    env = st.st_make_bbox(-1, -1, 5, 5)
+    np.testing.assert_array_equal(st.st_intersects_bbox(x, y, env), [True, False])
+    d = st.st_distance_sphere(0.0, 0.0, 0.0, 1.0)
+    assert abs(float(d) - 111195) < 200  # ~111.2 km per degree
+    poly = Polygon([(0, 0), (4, 0), (4, 4), (0, 4), (0, 0)])
+    assert st.st_area(poly) == pytest.approx(16.0)
+    inside = st.st_contains(poly, np.array([2.0, 9.0]), np.array([2.0, 9.0]))
+    np.testing.assert_array_equal(inside, [True, False])
+    gh = st.st_geohash(np.array([-5.6]), np.array([42.6]), 5)
+    assert str(gh[0]) == "ezs42"
+
+
+def test_frame_where_with_st_predicate():
+    s = _store()
+    f = SpatialFrame.from_query(s, "d")
+    near = f.where(st.st_dwithin_sphere(f.columns["geom__x"], f.columns["geom__y"],
+                                        0.0, 0.0, 1_000_000.0))
+    assert 0 < len(near) < len(f)
+
+
+# -- geojson api -------------------------------------------------------------
+
+def test_geojson_index_roundtrip():
+    idx = GeoJsonIndex()
+    fids = idx.add("places", [
+        {"type": "Feature", "id": "a", "geometry": {"type": "Point", "coordinates": [1.0, 2.0]},
+         "properties": {"name": "x", "pop": 100, "dtg": "2026-05-01T00:00:00"}},
+        {"type": "Feature", "id": "b", "geometry": {"type": "Point", "coordinates": [50.0, 2.0]},
+         "properties": {"name": "y", "pop": 5, "dtg": "2026-05-02T00:00:00"}},
+    ])
+    assert fids == ["a", "b"]
+    res = idx.query("places", {"$bbox": [0, 0, 10, 10]})
+    assert [f["id"] for f in res] == ["a"]
+    res = idx.query("places", {"pop": {"$gt": 50}})
+    assert [f["id"] for f in res] == ["a"]
+    res = idx.query("places", {"name": "y"})
+    assert [f["id"] for f in res] == ["b"]
+    res = idx.query("places", {"$bbox": [0, 0, 60, 10], "pop": {"$lte": 5}})
+    assert [f["id"] for f in res] == ["b"]
+
+
+# -- web ---------------------------------------------------------------------
+
+def test_rest_server_endpoints():
+    s = _store(200)
+    with GeoMesaServer(s) as url:
+        types = json.loads(urllib.request.urlopen(f"{url}/types").read())
+        assert types == ["d"]
+        desc = json.loads(urllib.request.urlopen(f"{url}/types/d").read())
+        assert desc["count"] == 200 and "actor:String" in desc["spec"]
+        q = urllib.request.urlopen(
+            f"{url}/query?name=d&cql=bbox(geom,-20,-20,20,20)&format=geojson"
+        )
+        gj = json.loads(q.read())
+        assert gj["type"] == "FeatureCollection"
+        assert len(gj["features"]) == len(s.query("d", "bbox(geom,-20,-20,20,20)"))
+        cnt = json.loads(
+            urllib.request.urlopen(f"{url}/stats/count?name=d&exact=true").read()
+        )
+        assert cnt["count"] == 200
+        b = json.loads(urllib.request.urlopen(f"{url}/stats/bounds?name=d").read())
+        assert b["bounds"] is not None
+        err = urllib.request.urlopen(f"{url}/types")  # still alive after errors
+        assert err.status == 200
+
+
+# -- native api --------------------------------------------------------------
+
+def test_native_api_facade():
+    idx = GeoMesaIndex("vals")
+    idx.put("k1", {"speed": 12}, -77.0, 38.9, T0)
+    idx.put("k2", {"speed": 99}, 2.35, 48.85, T0 + 1000)
+    got = idx.query(bbox=(-80, 35, -70, 40))
+    assert got == [("k1", {"speed": 12})]
+    got = idx.query(time_range_ms=(T0 + 500, T0 + 2000))
+    assert got == [("k2", {"speed": 99})]
+    idx.delete("k1")
+    assert idx.query(bbox=(-80, 35, -70, 40)) == []
