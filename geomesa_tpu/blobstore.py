@@ -1,0 +1,143 @@
+"""Spatially indexed blob storage (the geomesa-blobstore analog).
+
+Reference: geomesa-blobstore (SURVEY.md section 2.5): AccumuloBlobStore keeps
+a blob table plus a feature index over geo metadata extracted by FileHandler
+SPIs (EXIF/GDAL). Here blobs land on the local filesystem (or in memory) and
+their extracted (x, y, dtg, metadata) rows go through the normal datastore,
+so bbox/time queries locate files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+_SPEC = "filename:String,meta:String,dtg:Date,*geom:Point:srid=4326"
+
+
+class FileHandler:
+    """SPI: extract (x, y, t_ms, metadata) from file bytes (the EXIF/GDAL
+    handler role). ``can_handle`` by filename; return None when unknown."""
+
+    def can_handle(self, filename: str) -> bool:
+        raise NotImplementedError
+
+    def extract(self, filename: str, data: bytes):
+        raise NotImplementedError
+
+
+class GeoJsonFileHandler(FileHandler):
+    """Handles .geojson files: indexes the first point's location."""
+
+    def can_handle(self, filename: str) -> bool:
+        return filename.endswith(".geojson") or filename.endswith(".json")
+
+    def extract(self, filename: str, data: bytes):
+        doc = json.loads(data)
+        feats = doc.get("features") or ([doc] if doc.get("geometry") else [])
+        for f in feats:
+            g = f.get("geometry") or {}
+            if g.get("type") == "Point":
+                x, y = g["coordinates"][:2]
+                props = f.get("properties") or {}
+                t = props.get("dtg")
+                if isinstance(t, str):
+                    t = int(np.datetime64(t.replace("Z", ""), "ms").astype("int64"))
+                return float(x), float(y), t, props
+        return None
+
+
+class BlobStore:
+    def __init__(
+        self,
+        root: Optional[str] = None,
+        store: Optional[TpuDataStore] = None,
+        handlers: Optional[List[FileHandler]] = None,
+    ):
+        self.root = root
+        if root:
+            os.makedirs(root, exist_ok=True)
+        self._mem: Dict[str, bytes] = {}
+        self.store = store or TpuDataStore()
+        self.store.create_schema(parse_spec("blobs", _SPEC))
+        self.handlers = handlers if handlers is not None else [GeoJsonFileHandler()]
+
+    def _blob_id(self, data: bytes) -> str:
+        return hashlib.blake2b(data, digest_size=16).hexdigest()
+
+    def put(
+        self,
+        filename: str,
+        data: bytes,
+        x: Optional[float] = None,
+        y: Optional[float] = None,
+        t_ms: Optional[int] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Store a blob; coordinates come from args or a matching handler."""
+        if x is None or y is None:
+            for h in self.handlers:
+                if h.can_handle(filename):
+                    got = h.extract(filename, data)
+                    if got is not None:
+                        x, y, ht, hmeta = got
+                        t_ms = t_ms if t_ms is not None else ht
+                        metadata = metadata if metadata is not None else hmeta
+                        break
+        if x is None or y is None:
+            raise ValueError(f"no location for blob {filename!r} (no handler matched)")
+        blob_id = self._blob_id(data)
+        if self.root:
+            with open(os.path.join(self.root, blob_id), "wb") as fh:
+                fh.write(data)
+        else:
+            self._mem[blob_id] = data
+        with self.store.writer("blobs") as w:
+            w.write(
+                [filename, json.dumps(metadata or {}), t_ms, Point(x, y)],
+                fid=blob_id,
+            )
+        return blob_id
+
+    def get(self, blob_id: str) -> Optional[bytes]:
+        if self.root:
+            path = os.path.join(self.root, blob_id)
+            if os.path.exists(path):
+                with open(path, "rb") as fh:
+                    return fh.read()
+            return None
+        return self._mem.get(blob_id)
+
+    def delete(self, blob_id: str) -> None:
+        if self.root:
+            path = os.path.join(self.root, blob_id)
+            if os.path.exists(path):
+                os.remove(path)
+        else:
+            self._mem.pop(blob_id, None)
+        self.store.delete_features("blobs", [blob_id])
+
+    def query(self, cql: str = "INCLUDE") -> List[Dict[str, Any]]:
+        """[{id, filename, x, y, dtg, metadata}] matching the CQL."""
+        res = self.store.query("blobs", cql)
+        out = []
+        for i, fid in enumerate(res.fids):
+            out.append(
+                {
+                    "id": str(fid),
+                    "filename": res.columns["filename"][i],
+                    "x": float(res.columns["geom__x"][i]),
+                    "y": float(res.columns["geom__y"][i]),
+                    "dtg": int(res.columns["dtg"][i]),
+                    "metadata": json.loads(res.columns["meta"][i] or "{}"),
+                }
+            )
+        return out

@@ -106,6 +106,31 @@ def z2_query_mask(
     return valid & spatial_mask(xi, yi, boxes)
 
 
+def bbox_overlap_mask(
+    bxmin: jnp.ndarray,
+    bymin: jnp.ndarray,
+    bxmax: jnp.ndarray,
+    bymax: jnp.ndarray,
+    valid: jnp.ndarray,
+    boxes: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-feature bounding boxes vs [K, 4] query boxes -> any-overlap mask.
+
+    The extent-index (XZ2/XZ3) candidate test: a feature qualifies when its
+    bbox intersects any query box (exact geometry intersection is the host
+    post-filter's job, mirroring the reference where XZ indices always keep
+    the geometry ECQL, XZ2IndexKeySpace.scala:26+).
+    """
+    qxlo, qylo, qxhi, qyhi = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    overlap = (
+        (bxmin[:, None] <= qxhi[None, :])
+        & (bxmax[:, None] >= qxlo[None, :])
+        & (bymin[:, None] <= qyhi[None, :])
+        & (bymax[:, None] >= qylo[None, :])
+    )
+    return valid & jnp.any(overlap, axis=1)
+
+
 def bbox_mask_f32(
     x: jnp.ndarray,
     y: jnp.ndarray,

@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from geomesa_tpu.curve import zorder
+from geomesa_tpu.curve import time_to_binned, zorder
 from geomesa_tpu.curve.binnedtime import TimePeriod, binned_to_time
 from geomesa_tpu.index.planner import QueryPlan
 from geomesa_tpu.ops.filters import (
@@ -55,6 +55,25 @@ _z3_mask_packed = _packed(z3_query_mask)
 _z2_mask_packed = _packed(z2_query_mask)
 
 
+def _packed_overlap(with_time: bool):
+    from geomesa_tpu.ops.filters import bbox_overlap_mask, temporal_mask
+
+    if with_time:
+        def run(bxmin, bymin, bxmax, bymax, bins, offs, valid, boxes, windows):
+            m = bbox_overlap_mask(bxmin, bymin, bxmax, bymax, valid, boxes)
+            return jnp.packbits(m & temporal_mask(bins, offs, windows))
+    else:
+        def run(bxmin, bymin, bxmax, bymax, valid, boxes):
+            return jnp.packbits(
+                bbox_overlap_mask(bxmin, bymin, bxmax, bymax, valid, boxes)
+            )
+    return jax.jit(run)
+
+
+_xz2_mask_packed = _packed_overlap(False)
+_xz3_mask_packed = _packed_overlap(True)
+
+
 def _use_pallas(mesh) -> bool:
     """Single-chip TPU runs take the Pallas streaming kernel; sharded meshes
     and CPU stay on the XLA mask (pallas under SPMD needs shard_map)."""
@@ -81,25 +100,47 @@ class DeviceIndex:
     def __init__(self, mesh, table: IndexTable):
         self.mesh = mesh
         self.version = table.version
-        self.kind = table.index.name  # "z3" | "z2"
+        self.kind = table.index.name  # "z3" | "z2" | "xz2" | "xz3"
         ft = table.ft
         xs: List[np.ndarray] = []
         ys: List[np.ndarray] = []
         ts: List[np.ndarray] = []
         bins: List[np.ndarray] = []
+        envs: List[np.ndarray] = []
         self.block_starts: List[int] = []
         n = 0
+        geom = ft.default_geometry.name
         for b in table.blocks:
             self.block_starts.append(n)
-            key = b.key.astype(np.int64)
+            key = b.key.astype(np.int64) if b.key.dtype != object else None
             if self.kind == "z3":
                 xi, yi, ti = zorder.z3_decode(key)
                 ts.append(ti.astype(np.int32))
                 bins.append(b.bins.astype(np.int32))
-            else:
+                xs.append(xi.astype(np.int32))
+                ys.append(yi.astype(np.int32))
+            elif self.kind == "z2":
                 xi, yi = zorder.z2_decode(key)
-            xs.append(xi.astype(np.int32))
-            ys.append(yi.astype(np.int32))
+                xs.append(xi.astype(np.int32))
+                ys.append(yi.astype(np.int32))
+            else:  # xz2 / xz3: per-feature bounding boxes, ulp-widened so the
+                # f32 cast can never shrink a bbox out of a true overlap
+                e = np.zeros((b.n, 4), dtype=np.float64)
+                for i, g in enumerate(b.columns[geom]):
+                    if g is not None:
+                        e[i] = g.envelope.as_tuple()
+                e32 = np.empty((b.n, 4), dtype=np.float32)
+                e32[:, 0] = np.nextafter(e[:, 0].astype(np.float32), np.float32(-np.inf))
+                e32[:, 1] = np.nextafter(e[:, 1].astype(np.float32), np.float32(-np.inf))
+                e32[:, 2] = np.nextafter(e[:, 2].astype(np.float32), np.float32(np.inf))
+                e32[:, 3] = np.nextafter(e[:, 3].astype(np.float32), np.float32(np.inf))
+                envs.append(e32)
+                if self.kind == "xz3":
+                    bins.append(b.bins.astype(np.int32))
+                    _, offs = time_to_binned(
+                        b.columns[ft.default_date.name], ft.xz3_interval
+                    )
+                    ts.append(offs.astype(np.int32))
             n += b.n
         self.n = n
         # x8 keeps each shard byte-aligned for the packbits mask transfer;
@@ -108,14 +149,24 @@ class DeviceIndex:
 
         m = int(np.lcm(max(1, mesh.devices.size) * 8, TILE))
         self._m = m
-        self.xi = self._pack(xs, np.int32, 0)
-        self.yi = self._pack(ys, np.int32, 0)
         self.valid = shard_array(mesh, pad_to_multiple(np.ones(n, dtype=bool), m, False))
         # raw f32 coords + ms offsets are only needed by fused aggregations;
         # packed lazily on first density_scan (load_raw)
         self.xf = self.yf = self.t_ms = None
         self._raw_loaded = False
-        if self.kind == "z3":
+        if self.kind in ("z2", "z3"):
+            self.xi = self._pack(xs, np.int32, 0)
+            self.yi = self._pack(ys, np.int32, 0)
+        else:
+            env = (
+                np.concatenate(envs) if envs else np.empty((0, 4), np.float32)
+            )
+            # inverted pad boxes (min > max) never overlap a query box
+            self.bxmin = self._pack([env[:, 0]], np.float32, 1.0)
+            self.bymin = self._pack([env[:, 1]], np.float32, 1.0)
+            self.bxmax = self._pack([env[:, 2]], np.float32, 0.0)
+            self.bymax = self._pack([env[:, 3]], np.float32, 0.0)
+        if self.kind in ("z3", "xz3"):
             self.ti = self._pack(ts, np.int32, 0)
             self.bins = self._pack(bins, np.int32, -1)
 
@@ -161,8 +212,18 @@ class DeviceIndex:
                 )
             else:
                 out = _z3_mask_packed(self.xi, self.yi, self.bins, self.ti, self.valid, b, w)
-        else:
+        elif self.kind == "z2":
             out = _z2_mask_packed(self.xi, self.yi, self.valid, b)
+        elif self.kind == "xz3":
+            w = replicate(self.mesh, windows)
+            out = _xz3_mask_packed(
+                self.bxmin, self.bymin, self.bxmax, self.bymax,
+                self.bins, self.ti, self.valid, b, w,
+            )
+        else:  # xz2
+            out = _xz2_mask_packed(
+                self.bxmin, self.bymin, self.bxmax, self.bymax, self.valid, b
+            )
         return np.unpackbits(np.asarray(out))[: self.n].astype(bool)
 
     def to_block_rows(self, rows: np.ndarray) -> List[Tuple[int, np.ndarray]]:
@@ -209,7 +270,7 @@ class TpuScanExecutor:
 
     def supports(self, table: IndexTable, plan: QueryPlan) -> bool:
         return (
-            table.index.name in ("z3", "z2")
+            table.index.name in ("z3", "z2", "xz2", "xz3")
             and not plan.values.disjoint
             and bool(plan.values.spatial_envelopes)
             and not table.tombstones
@@ -223,36 +284,62 @@ class TpuScanExecutor:
         """Device candidate scan; None -> caller falls back to host ranges."""
         if not self.supports(table, plan):
             return None
-        if table.index.name == "z3" and not plan.values.bins:
+        if table.index.name in ("z3", "xz3") and not plan.values.bins:
             return None
         return self._device_scan(table, plan)
 
     def _device_scan(self, table: IndexTable, plan: QueryPlan):
         dev = self.device_index(table)
-        sfc = table.index.sfc(table.ft)
-        boxes = []
-        for env in plan.values.spatial_envelopes:
-            boxes.append(
-                (
-                    int(sfc.lon.normalize(env.xmin)[()]),
-                    int(sfc.lat.normalize(env.ymin)[()]),
-                    int(sfc.lon.normalize(env.xmax)[()]),
-                    int(sfc.lat.normalize(env.ymax)[()]),
-                )
-            )
         windows = None
-        if dev.kind == "z3":
-            windows = pad_windows(
+        if dev.kind in ("xz2", "xz3"):
+            # raw-domain overlap test: query boxes widened outward one f32
+            # ulp so the cast can never exclude a true overlap
+            boxes = pad_boxes(
                 [
                     (
-                        b,
-                        int(sfc.time.normalize(lo)[()]),
-                        int(sfc.time.normalize(hi)[()]),
+                        np.nextafter(np.float32(env.xmin), np.float32(-np.inf)),
+                        np.nextafter(np.float32(env.ymin), np.float32(-np.inf)),
+                        np.nextafter(np.float32(env.xmax), np.float32(np.inf)),
+                        np.nextafter(np.float32(env.ymax), np.float32(np.inf)),
                     )
-                    for b, (lo, hi) in sorted(plan.values.bins.items())
+                    for env in plan.values.spatial_envelopes
+                ],
+                dtype=np.float32,
+            )
+            if dev.kind == "xz3":
+                # unit-resolution offsets; widen one unit each side so the
+                # floor never drops a boundary candidate
+                windows = pad_windows(
+                    [
+                        (b, max(0, lo - 1), hi + 1)
+                        for b, (lo, hi) in sorted(plan.values.bins.items())
+                    ]
+                )
+        else:
+            sfc = table.index.sfc(table.ft)
+            boxes = pad_boxes(
+                [
+                    (
+                        int(sfc.lon.normalize(env.xmin)[()]),
+                        int(sfc.lat.normalize(env.ymin)[()]),
+                        int(sfc.lon.normalize(env.xmax)[()]),
+                        int(sfc.lat.normalize(env.ymax)[()]),
+                    )
+                    for env in plan.values.spatial_envelopes
                 ]
             )
-        mask = dev.mask(pad_boxes(boxes), windows)
+            if dev.kind == "z3":
+                windows = pad_windows(
+                    [
+                        (
+                            b,
+                            int(sfc.time.normalize(lo)[()]),
+                            int(sfc.time.normalize(hi)[()]),
+                        )
+                        for b, (lo, hi) in sorted(plan.values.bins.items())
+                    ]
+                )
+        mask = dev.mask(boxes, windows)
         rows = np.flatnonzero(mask)
         for blk, local in dev.to_block_rows(rows):
             yield table.blocks[blk], local
@@ -317,7 +404,7 @@ class TpuScanExecutor:
         (index/z2/Z2Index.scala:26-40); pass {"exact": True} in the density
         hint to force the host path.
         """
-        if not self.supports(table, plan):
+        if table.index.name not in ("z2", "z3") or not self.supports(table, plan):
             return None
         if plan.secondary is not None or spec.get("weight") or spec.get("exact"):
             return None

@@ -1,0 +1,109 @@
+"""Leaflet map export for notebooks (the geomesa-jupyter analog).
+
+Reference: geomesa-jupyter-leaflet Leaflet.scala — a small DSL emitting
+leaflet JS for in-notebook map display. Here: query results / density grids
+-> a self-contained HTML document (CDN leaflet) or an IPython-displayable
+object. Zero new dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+_PAGE = """<!DOCTYPE html>
+<html><head>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>#map{{height:{height}px}}</style>
+</head><body><div id="map"></div><script>
+var map = L.map('map').setView([{lat}, {lon}], {zoom});
+L.tileLayer('https://tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+            {{maxZoom: 19}}).addTo(map);
+{layers}
+</script></body></html>
+"""
+
+
+def _points_layer(result, color: str, limit: int) -> str:
+    xs = result.columns.get(result.ft.default_geometry.name + "__x")
+    ys = result.columns.get(result.ft.default_geometry.name + "__y")
+    pts = [
+        [float(ys[i]), float(xs[i])]
+        for i in range(min(len(result), limit))
+    ]
+    return (
+        f"var pts = {json.dumps(pts)};\n"
+        f"pts.forEach(function(p) {{ L.circleMarker(p, "
+        f"{{radius: 3, color: {color!r}}}).addTo(map); }});"
+    )
+
+
+def _density_layer(grid, envelope, opacity: float = 0.6) -> str:
+    import numpy as np
+
+    g = np.asarray(grid, dtype=float)
+    mx = g.max() or 1.0
+    xmin, ymin, xmax, ymax = envelope
+    h, w = g.shape
+    dx = (xmax - xmin) / w
+    dy = (ymax - ymin) / h
+    rects = []
+    for r in range(h):
+        for c in range(w):
+            if g[r, c] > 0:
+                rects.append(
+                    [
+                        [ymin + r * dy, xmin + c * dx],
+                        [ymin + (r + 1) * dy, xmin + (c + 1) * dx],
+                        round(float(g[r, c] / mx), 4),
+                    ]
+                )
+    return (
+        f"var cells = {json.dumps(rects)};\n"
+        "cells.forEach(function(c) { L.rectangle([c[0], c[1]], "
+        f"{{stroke: false, fillColor: 'red', fillOpacity: c[2] * {opacity}}}"
+        ").addTo(map); });"
+    )
+
+
+def render_map(
+    result=None,
+    density: Optional[tuple] = None,  # (grid, envelope)
+    center: Optional[tuple] = None,
+    zoom: int = 3,
+    height: int = 500,
+    color: str = "#3388ff",
+    max_points: int = 5000,
+) -> str:
+    """Self-contained HTML for a query result and/or density overlay."""
+    layers: List[str] = []
+    lat, lon = (center or (20.0, 0.0))
+    if result is not None and len(result):
+        layers.append(_points_layer(result, color, max_points))
+        geom = result.ft.default_geometry.name
+        lat = float(result.columns[geom + "__y"].mean())
+        lon = float(result.columns[geom + "__x"].mean())
+    if density is not None:
+        layers.append(_density_layer(*density))
+        if result is None or not len(result):
+            env = density[1]
+            lat = (env[1] + env[3]) / 2
+            lon = (env[0] + env[2]) / 2
+    return _PAGE.format(
+        height=height, lat=lat, lon=lon, zoom=zoom, layers="\n".join(layers)
+    )
+
+
+class LeafletMap:
+    """IPython-friendly wrapper: displays inline in a notebook."""
+
+    def __init__(self, html: str):
+        self.html = html
+
+    def _repr_html_(self) -> str:
+        return self.html.replace("#map{height", "#map{min-height")
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            fh.write(self.html)

@@ -87,3 +87,45 @@ def test_device_cache_invalidation(stores):
     after = tpu.query("gdelt", cql)
     assert len(after) == before + 1
     assert "fresh-1" in list(after.fids)
+
+
+def test_xz_device_scan_matches_host():
+    """Extent-index (lines/polygons) device candidate path parity."""
+    from geomesa_tpu.geom.base import LineString, Polygon
+
+    rng = np.random.default_rng(33)
+    spec = "name:String,dtg:Date,*geom:Geometry:srid=4326"
+    cqls = [
+        "bbox(geom, -10, -10, 10, 10)",
+        "bbox(geom, -10, -10, 10, 10) AND dtg DURING 2026-01-02T00:00:00Z/2026-01-20T00:00:00Z",
+        "intersects(geom, POLYGON((-5 -5, 5 -5, 0 8, -5 -5)))",
+    ]
+    stores = {}
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    for key, ex in (("host", HostScanExecutor()), ("tpu", TpuScanExecutor(default_mesh()))):
+        rng = np.random.default_rng(33)
+        s = TpuDataStore(executor=ex)
+        s.create_schema(parse_spec("ways", spec))
+        with s.writer("ways") as w:
+            for i in range(800):
+                x0 = float(rng.uniform(-40, 40)); y0 = float(rng.uniform(-40, 40))
+                dx = float(rng.uniform(0.1, 3)); dy = float(rng.uniform(0.1, 3))
+                if i % 2:
+                    g = LineString([(x0, y0), (x0 + dx, y0 + dy)])
+                else:
+                    g = Polygon([(x0, y0), (x0 + dx, y0), (x0 + dx, y0 + dy), (x0, y0 + dy), (x0, y0)])
+                t = int(base + rng.integers(0, 30 * 86400_000))
+                w.write([f"n{i}", t, g], fid=f"w{i}")
+        stores[key] = s
+    for cql in cqls:
+        a = sorted(stores["host"].query("ways", cql).fids)
+        b = sorted(stores["tpu"].query("ways", cql).fids)
+        assert a == b, (cql, len(a), len(b))
+        assert len(a) > 0
+    # confirm the device path actually engaged for the xz index
+    from geomesa_tpu.index.planner import Query
+
+    plan = stores["tpu"]._plan_cached("ways", Query.cql(cqls[0]))
+    assert plan.index.name in ("xz2", "xz3")
+    table = stores["tpu"]._tables["ways"][plan.index.name]
+    assert stores["tpu"].executor.scan_candidates(table, plan) is not None

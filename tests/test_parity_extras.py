@@ -1,0 +1,114 @@
+"""Parity extras: legacy curves, z3 uuids, track processes, blobstore, viz."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.blobstore import BlobStore
+from geomesa_tpu.curve.legacy import LegacyZ2SFC, LegacyZ3SFC
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.process.tracks import hash_attribute, join, point2point, track_labels
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils.z3uuid import z3_uuid, z3_uuid_batch
+from geomesa_tpu.viz import LeafletMap, render_map
+
+T0 = int(np.datetime64("2026-06-01T00:00:00", "ms").astype("int64"))
+
+
+def test_legacy_curves_roundtrip():
+    sfc = LegacyZ2SFC()
+    z = sfc.index([-77.0, 2.35], [38.9, 48.85])
+    x, y = sfc.invert(z)
+    np.testing.assert_allclose(x, [-77.0, 2.35], atol=1e-6)
+    np.testing.assert_allclose(y, [38.9, 48.85], atol=1e-6)
+    z3 = LegacyZ3SFC.for_period("week")
+    zz = z3.index([10.0], [20.0], [1000])
+    xx, yy, tt = z3.invert(zz)
+    assert abs(float(xx[0]) - 10.0) < 1e-3 and abs(float(tt[0]) - 1000) < 1
+
+
+def test_z3_uuid_locality_and_format():
+    a = z3_uuid(-77.0, 38.9, T0)
+    b = z3_uuid(-77.0001, 38.9001, T0 + 1000)
+    c = z3_uuid(116.4, 39.9, T0)
+    assert len(a) == 36 and a.count("-") == 4
+    # nearby features share the z3 prefix nibbles; far ones don't
+    assert a[:6] == b[:6]
+    assert a[:6] != c[:6]
+    batch = z3_uuid_batch([-77.0, 116.4], [38.9, 39.9], [T0, T0])
+    assert len(set(batch)) == 2
+
+
+@pytest.fixture()
+def track_store():
+    s = TpuDataStore()
+    ft = parse_spec("trk", "ship:String,dtg:Date,*geom:Point:srid=4326")
+    s.create_schema(ft)
+    rows = []
+    with s.writer("trk") as w:
+        for ship in ("a", "b"):
+            for i in range(4):
+                w.write([ship, T0 + i * 60000, Point(i, 0 if ship == "a" else 5)],
+                        fid=f"{ship}{i}")
+    return s
+
+
+def test_point2point_and_labels(track_store):
+    segs = point2point(track_store, "trk", "ship")
+    assert len(segs) == 6  # 3 segments per ship
+    a_segs = [s for s in segs if s["track"] == "a"]
+    assert a_segs[0]["coords"] == [[0.0, 0.0], [1.0, 0.0]]
+    assert all(s["t1"] > s["t0"] for s in segs)
+    labels = track_labels(track_store, "trk", "ship")
+    assert {l["track"]: l["fid"] for l in labels} == {"a": "a3", "b": "b3"}
+
+
+def test_hash_attribute_stability():
+    vals = np.array(["x", "y", "x"], dtype=object)
+    h = hash_attribute(vals, 10)
+    assert h[0] == h[2] and 0 <= h.min() and h.max() < 10
+
+
+def test_join(track_store):
+    s = track_store
+    meta = parse_spec("ships", "ship:String,cls:String,dtg:Date,*geom:Point:srid=4326")
+    s.create_schema(meta)
+    with s.writer("ships") as w:
+        w.write(["a", "tanker", T0, Point(0, 0)], fid="ma")
+        w.write(["b", "cargo", T0, Point(0, 0)], fid="mb")
+    out = join(s, "trk", "ships", "ship", "ship")
+    assert len(out["__fid__"]) == 8
+    got = {(str(f), c) for f, c in zip(out["__fid__"], out["ships.cls"])}
+    assert ("a0", "tanker") in got and ("b3", "cargo") in got
+
+
+def test_blobstore_roundtrip(tmp_path):
+    bs = BlobStore(root=str(tmp_path / "blobs"))
+    data = b"not really an image"
+    bid = bs.put("photo.jpg", data, x=-77.0, y=38.9, t_ms=T0, metadata={"cam": 1})
+    assert bs.get(bid) == data
+    hits = bs.query("bbox(geom, -80, 35, -70, 40)")
+    assert [h["id"] for h in hits] == [bid]
+    assert hits[0]["metadata"] == {"cam": 1}
+    # handler-driven extraction from geojson content
+    gj = json.dumps({"type": "Feature", "geometry": {"type": "Point", "coordinates": [2.35, 48.85]},
+                     "properties": {"dtg": "2026-06-01T00:00:00"}}).encode()
+    bid2 = bs.put("place.geojson", gj)
+    hits = bs.query("bbox(geom, 0, 45, 5, 50)")
+    assert [h["id"] for h in hits] == [bid2]
+    bs.delete(bid)
+    assert bs.get(bid) is None and len(bs.query("bbox(geom, -80, 35, -70, 40)")) == 0
+
+
+def test_viz_render(track_store):
+    res = track_store.query("trk")
+    html = render_map(res, zoom=5)
+    assert "leaflet" in html and "circleMarker" in html
+    grid = np.zeros((4, 4))
+    grid[1, 2] = 3.0
+    html2 = render_map(density=(grid, (-10.0, -10.0, 10.0, 10.0)))
+    assert "rectangle" in html2.lower()
+    m = LeafletMap(html)
+    assert "<html>" in m._repr_html_() or "leaflet" in m._repr_html_()
