@@ -17,6 +17,50 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "shards"
 
 
+def force_cpu_platform(min_devices: int = 0):
+    """Pin jax to the cpu platform and return its devices, never touching
+    the default (possibly remote-TPU) backend.
+
+    The axon site hook registers a remote platform at interpreter startup
+    and bakes ``jax_platforms="axon,cpu"`` into jax's CONFIG, so the env
+    var alone does not stop ``jax.devices()`` from initializing (and
+    potentially hanging on) the tunnel. Both the env var and the config
+    must be forced before any backend initializes. If ``min_devices`` > 1
+    and the cpu backend is not yet initialized, the
+    ``xla_force_host_platform_device_count`` flag is added so a virtual
+    multi-device mesh exists even when the caller's env forgot it.
+    """
+    import os
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # children of a cpu-pinned process must not claim a remote session either
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    if min_devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={min_devices}"
+            ).strip()
+        elif int(m.group(1)) < min_devices:
+            os.environ["XLA_FLAGS"] = flags.replace(
+                m.group(0), f"--xla_force_host_platform_device_count={min_devices}"
+            )
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backends already initialized; explicit "cpu" lookup below
+    devices = jax.devices("cpu")
+    if min_devices and len(devices) < min_devices:
+        raise RuntimeError(
+            f"cpu backend has {len(devices)} device(s), need {min_devices}; "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count before jax "
+            "initializes"
+        )
+    return devices
+
+
 def default_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh:
     """1D mesh over all (or the given) devices; rows shard over ``axis``."""
     devices = list(devices) if devices is not None else jax.devices()

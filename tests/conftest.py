@@ -5,25 +5,19 @@ distributed behavior is exercised in-process, here via
 ``xla_force_host_platform_device_count`` instead of Accumulo MockInstance.
 
 Tests must not ride the axon remote-TPU tunnel (the session claim can take
-minutes and serializes processes): clear the pool override for any
-subprocesses and pin the jax platform to cpu even if a site hook already
-registered the remote plugin at interpreter startup.
+minutes and serializes processes); the single shared pinning recipe lives
+in ``geomesa_tpu.parallel.mesh.force_cpu_platform`` (env + jax config +
+XLA flags + pool-override clear for subprocesses).
 """
 
 import os
+import sys
 
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 try:
-    import jax
+    from geomesa_tpu.parallel.mesh import force_cpu_platform
 
-    jax.config.update("jax_platforms", "cpu")
-except Exception:  # jax missing entirely -> host-only tests still run
+    force_cpu_platform(min_devices=8)
+except ImportError:  # jax missing entirely -> host-only tests still run
     pass

@@ -1,0 +1,65 @@
+"""Regression tests for the driver entry points (__graft_entry__.py).
+
+Round 1 shipped a dryrun that consulted the default (axon/TPU) backend and
+timed out in the driver (MULTICHIP_r01.json rc=124). These tests exercise the
+exact functions the driver calls, on the conftest 8-device CPU mesh, so any
+backend-selection regression fails the suite instead of the driver run.
+"""
+
+import subprocess
+import sys
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    mask, count, checksum = out
+    assert int(count) == int(mask.sum())
+    assert int(count) > 0
+
+
+def test_dryrun_multichip_8():
+    # self-validating: raises AssertionError on mask/count mismatch
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_odd_counts():
+    for n in (1, 2, 4):
+        graft.dryrun_multichip(n)
+
+
+def test_dryrun_subprocess_axon_hook_active():
+    """Driver-faithful: fresh process with the axon site hook ACTIVE.
+
+    Reproduces the round-1 rc=124 condition: sitecustomize registers the
+    remote-TPU platform and JAX_PLATFORMS=axon in the env. The dryrun must
+    pin the cpu platform in jax's CONFIG before any backend initializes, or
+    it hangs on the tunnel claim.
+    """
+    env = {
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": "/root/.axon_site:/root/repo",
+        "PALLAS_AXON_POOL_IPS": "127.0.0.1",
+        "AXON_LOOPBACK_RELAY": "1",
+        "JAX_PLATFORMS": "axon",
+        "HOME": "/root",
+    }
+    code = (
+        "import __graft_entry__ as g; g.dryrun_multichip(8); print('OK-DRYRUN')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd="/root/repo",
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK-DRYRUN" in proc.stdout
