@@ -5,28 +5,42 @@ accumulo/index/AccumuloQueryPlan.scala:113-140, + Z3Iterator reject,
 accumulo/iterators/Z3Iterator.scala:42-65) with one fused XLA pass:
 
   host planner --> int-domain boxes + per-bin windows (query descriptor)
-  device       --> candidate mask over normalized coordinate columns
+  device       --> candidate mask -> on-device COMPACTION to a hit list
   host         --> exact CQL post-filter on the (small) candidate set
 
 The device mask is conservative and the exact post-filter is unchanged, so
 result sets are identical to the host scan path (parity by construction).
-Columns live on device sharded over the mesh's row axis and are reused across
-queries until the table version changes.
+
+Transfer protocol (the tserver "return only matching KVs" analog,
+Z3Iterator.scala:42-65): the device compacts the mask into a fixed-capacity
+sorted index buffer; the host reads (count, indices[:count]) so the hop is
+proportional to HITS, not rows. count > capacity escalates to the next pow2
+capacity bucket; when a hit list would exceed the bitmap size the packed
+N/8-byte bitmap is used instead (dense-result fallback).
+
+Device residency is SEGMENTED and incremental: each write batch becomes a
+new device segment (only new rows cross the host->device link); tombstones
+flip bits in the device-side valid mask instead of invalidating the mirror;
+once fragmentation exceeds MAX_SEGMENTS the mirror is rebuilt as one merged
+segment (a full re-upload — the compaction analog).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from geomesa_tpu.curve import time_to_binned, zorder
 from geomesa_tpu.curve.binnedtime import TimePeriod, binned_to_time
 from geomesa_tpu.index.planner import QueryPlan
 from geomesa_tpu.ops.filters import (
+    bbox_overlap_mask,
     pad_boxes,
     pad_windows,
+    temporal_mask,
     z2_query_mask,
     z3_query_mask,
 )
@@ -37,41 +51,12 @@ from geomesa_tpu.parallel.mesh import (
     replicate,
     shard_array,
 )
-from geomesa_tpu.store.blocks import IndexTable
+from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
 
-# one jit per (N, K, W) shape bucket; padding keeps the bucket count small.
-# masks come back bit-packed (8 rows/byte) so the host transfer is N/8 bytes
-import jax.numpy as jnp
-
-
-def _packed(mask_fn):
-    def run(*args):
-        return jnp.packbits(mask_fn(*args))
-
-    return jax.jit(run)
-
-
-_z3_mask_packed = _packed(z3_query_mask)
-_z2_mask_packed = _packed(z2_query_mask)
-
-
-def _packed_overlap(with_time: bool):
-    from geomesa_tpu.ops.filters import bbox_overlap_mask, temporal_mask
-
-    if with_time:
-        def run(bxmin, bymin, bxmax, bymax, bins, offs, valid, boxes, windows):
-            m = bbox_overlap_mask(bxmin, bymin, bxmax, bymax, valid, boxes)
-            return jnp.packbits(m & temporal_mask(bins, offs, windows))
-    else:
-        def run(bxmin, bymin, bxmax, bymax, valid, boxes):
-            return jnp.packbits(
-                bbox_overlap_mask(bxmin, bymin, bxmax, bymax, valid, boxes)
-            )
-    return jax.jit(run)
-
-
-_xz2_mask_packed = _packed_overlap(False)
-_xz3_mask_packed = _packed_overlap(True)
+# initial hit-list capacity: 8192 idx * 4B = 32 KiB per segment transfer
+HIT_CAPACITY0 = 8192
+# merge device segments once a query must touch more than this many
+MAX_SEGMENTS = 8
 
 
 def _use_pallas(mesh) -> bool:
@@ -80,27 +65,91 @@ def _use_pallas(mesh) -> bool:
     return jax.default_backend() == "tpu" and mesh.devices.size == 1
 
 
-@jax.jit
-def _z3_mask_packed_pallas(xi, yi, bins, offs, valid, boxes, windows):
-    from geomesa_tpu.ops.pallas_kernels import z3_query_mask_pallas
+def _raw_mask_fn(kind: str, pallas: bool):
+    """Unjitted bool-mask callable for one index kind."""
+    if kind == "z3":
+        if pallas:
+            def run(xi, yi, bins, offs, valid, boxes, windows):
+                from geomesa_tpu.ops.pallas_kernels import z3_query_mask_pallas
 
-    return jnp.packbits(
-        z3_query_mask_pallas(xi, yi, bins, offs, valid, boxes, windows, interpret=False)
-    )
+                return z3_query_mask_pallas(
+                    xi, yi, bins, offs, valid, boxes, windows, interpret=False
+                )
+
+            return run
+        return z3_query_mask
+    if kind == "z2":
+        return z2_query_mask
+    if kind == "xz3":
+        def run(bxmin, bymin, bxmax, bymax, bins, offs, valid, boxes, windows):
+            m = bbox_overlap_mask(bxmin, bymin, bxmax, bymax, valid, boxes)
+            return m & temporal_mask(bins, offs, windows)
+
+        return run
+    # xz2
+    return bbox_overlap_mask
 
 
-class DeviceIndex:
-    """Device-resident mirror of one point-index table (z3 or z2).
+# jit caches shared across DeviceIndex instances: one entry per
+# (kind, capacity-bucket, pallas) — shapes bucket again inside jit
+_COMPACT_FNS: Dict[Tuple[str, int, bool], "jax.stages.Wrapped"] = {}
+_PACKED_FNS: Dict[Tuple[str, bool], "jax.stages.Wrapped"] = {}
 
-    Rows are all blocks concatenated in block order, padded to a multiple of
-    the mesh size with invalid rows; ``block_starts`` maps a global candidate
-    row back to its (block, local row).
+
+def _compact_fn(kind: str, capacity: int, pallas: bool):
+    key = (kind, capacity, pallas)
+    fn = _COMPACT_FNS.get(key)
+    if fn is None:
+        mask = _raw_mask_fn(kind, pallas)
+
+        def run(*args):
+            m = mask(*args)
+            cnt = jnp.sum(m.astype(jnp.int32))
+            idx = jnp.nonzero(m, size=capacity, fill_value=m.shape[0])[0]
+            return cnt, idx.astype(jnp.int32)
+
+        fn = jax.jit(run)
+        _COMPACT_FNS[key] = fn
+    return fn
+
+
+def _packed_fn(kind: str, pallas: bool):
+    key = (kind, pallas)
+    fn = _PACKED_FNS.get(key)
+    if fn is None:
+        mask = _raw_mask_fn(kind, pallas)
+
+        def run(*args):
+            return jnp.packbits(mask(*args))
+
+        fn = jax.jit(run)
+        _PACKED_FNS[key] = fn
+    return fn
+
+
+def _pad_rows(n: int, m: int) -> int:
+    """Pad row count to a pow2 multiple of m so segment shapes bucket."""
+    units = max(1, -(-n // m))
+    p = 1
+    while p < units:
+        p *= 2
+    return p * m
+
+
+class DeviceSegment:
+    """Device-resident mirror of a contiguous run of blocks of one index.
+
+    The unit of incremental upload: a write batch seals new block(s), which
+    become one new segment; existing segments' coordinate columns are never
+    re-transferred. Rows are padded to a pow2 multiple of the shard/tile
+    granule so jit shape buckets stay bounded.
     """
 
-    def __init__(self, mesh, table: IndexTable):
+    def __init__(self, mesh, table: IndexTable, blocks: Sequence[FeatureBlock]):
         self.mesh = mesh
-        self.version = table.version
         self.kind = table.index.name  # "z3" | "z2" | "xz2" | "xz3"
+        self.blocks = list(blocks)
+        self.block_ids = [id(b) for b in blocks]
         ft = table.ft
         xs: List[np.ndarray] = []
         ys: List[np.ndarray] = []
@@ -110,7 +159,7 @@ class DeviceIndex:
         self.block_starts: List[int] = []
         n = 0
         geom = ft.default_geometry.name
-        for b in table.blocks:
+        for b in blocks:
             self.block_starts.append(n)
             key = b.key.astype(np.int64) if b.key.dtype != object else None
             if self.kind == "z3":
@@ -143,13 +192,18 @@ class DeviceIndex:
                     ts.append(offs.astype(np.int32))
             n += b.n
         self.n = n
-        # x8 keeps each shard byte-aligned for the packbits mask transfer;
-        # lcm with the pallas row tile keeps the kernel path shape-legal
+        # x8 keeps each shard byte-aligned for the packbits fallback; lcm
+        # with the pallas row tile keeps the kernel path shape-legal
         from geomesa_tpu.ops.pallas_kernels import TILE
 
         m = int(np.lcm(max(1, mesh.devices.size) * 8, TILE))
-        self._m = m
-        self.valid = shard_array(mesh, pad_to_multiple(np.ones(n, dtype=bool), m, False))
+        self.n_padded = _pad_rows(max(n, 1), m)
+        self._m = self.n_padded  # pack() pads straight to the bucketed size
+        self.fids = np.concatenate(
+            [b.columns["__fid__"] for b in blocks]
+        ) if blocks else np.empty(0, dtype=object)
+        self._valid_host = np.ones(n, dtype=bool)
+        self.valid = self._pack([self._valid_host], bool, False)
         # raw f32 coords + ms offsets are only needed by fused aggregations;
         # packed lazily on first density_scan (load_raw)
         self.xf = self.yf = self.t_ms = None
@@ -158,9 +212,7 @@ class DeviceIndex:
             self.xi = self._pack(xs, np.int32, 0)
             self.yi = self._pack(ys, np.int32, 0)
         else:
-            env = (
-                np.concatenate(envs) if envs else np.empty((0, 4), np.float32)
-            )
+            env = np.concatenate(envs) if envs else np.empty((0, 4), np.float32)
             # inverted pad boxes (min > max) never overlap a query box
             self.bxmin = self._pack([env[:, 0]], np.float32, 1.0)
             self.bymin = self._pack([env[:, 1]], np.float32, 1.0)
@@ -174,6 +226,20 @@ class DeviceIndex:
         arr = np.concatenate(parts) if parts else np.empty(0, dtype)
         return shard_array(self.mesh, pad_to_multiple(arr, self._m, fill))
 
+    def apply_tombstones(self, tombstones: set) -> None:
+        """Clear deleted rows in the device valid mask (no re-pack).
+
+        The reference applies deletes as per-row mutations; here a delete
+        flips valid bits so the very next device scan excludes the rows —
+        the executor stays active after delete_features (no host fallback).
+        """
+        if not self.n:
+            return
+        keep = np.array([f not in tombstones for f in self.fids], dtype=bool)
+        if not np.array_equal(keep, self._valid_host):
+            self._valid_host = keep
+            self.valid = self._pack([keep], bool, False)
+
     def load_raw(self, table: IndexTable) -> bool:
         """Pack raw f32 coords (+ in-bin ms offsets for day/week z3) for the
         fused aggregation path. Returns False when unsupported (month/year
@@ -183,15 +249,15 @@ class DeviceIndex:
         self._raw_loaded = True
         ft = table.ft
         geom = ft.default_geometry.name
-        xfs = [b.columns[geom + "__x"].astype(np.float32) for b in table.blocks]
-        yfs = [b.columns[geom + "__y"].astype(np.float32) for b in table.blocks]
+        xfs = [b.columns[geom + "__x"].astype(np.float32) for b in self.blocks]
+        yfs = [b.columns[geom + "__y"].astype(np.float32) for b in self.blocks]
         self.xf = self._pack(xfs, np.float32, 0.0)
         self.yf = self._pack(yfs, np.float32, 0.0)
         if self.kind == "z3":
             if ft.z3_interval not in (TimePeriod.DAY, TimePeriod.WEEK):
                 return False
             traw = []
-            for b in table.blocks:
+            for b in self.blocks:
                 t_ms = b.columns[ft.default_date.name].astype(np.int64)
                 starts = binned_to_time(
                     b.bins.astype(np.int64), np.zeros(b.n, np.int64), ft.z3_interval
@@ -200,34 +266,46 @@ class DeviceIndex:
             self.t_ms = self._pack(traw, np.int32, -1)
         return True
 
-    def mask(self, boxes: np.ndarray, windows: Optional[np.ndarray]) -> np.ndarray:
-        """Candidate mask; transferred as packed bits (device rows / 8 bytes)
-        to keep the device->host hop small on tunneled transports."""
-        b = replicate(self.mesh, boxes)
+    def _mask_args(self, boxes_dev, windows_dev) -> tuple:
         if self.kind == "z3":
-            w = replicate(self.mesh, windows)
-            if _use_pallas(self.mesh):
-                out = _z3_mask_packed_pallas(
-                    self.xi, self.yi, self.bins, self.ti, self.valid, b, w
-                )
-            else:
-                out = _z3_mask_packed(self.xi, self.yi, self.bins, self.ti, self.valid, b, w)
-        elif self.kind == "z2":
-            out = _z2_mask_packed(self.xi, self.yi, self.valid, b)
-        elif self.kind == "xz3":
-            w = replicate(self.mesh, windows)
-            out = _xz3_mask_packed(
+            return (self.xi, self.yi, self.bins, self.ti, self.valid, boxes_dev, windows_dev)
+        if self.kind == "z2":
+            return (self.xi, self.yi, self.valid, boxes_dev)
+        if self.kind == "xz3":
+            return (
                 self.bxmin, self.bymin, self.bxmax, self.bymax,
-                self.bins, self.ti, self.valid, b, w,
+                self.bins, self.ti, self.valid, boxes_dev, windows_dev,
             )
-        else:  # xz2
-            out = _xz2_mask_packed(
-                self.bxmin, self.bymin, self.bxmax, self.bymax, self.valid, b
-            )
-        return np.unpackbits(np.asarray(out))[: self.n].astype(bool)
+        return (self.bxmin, self.bymin, self.bxmax, self.bymax, self.valid, boxes_dev)
 
-    def to_block_rows(self, rows: np.ndarray) -> List[Tuple[int, np.ndarray]]:
-        """Global candidate rows -> [(block index, local rows)]."""
+    def hit_rows(self, boxes_dev, windows_dev) -> np.ndarray:
+        """Sorted candidate row indices, compacted ON DEVICE.
+
+        Transfer = 4 bytes (count) + 4*capacity; escalates capacity on
+        overflow and degrades to the packed bitmap only when the hit list
+        would be larger than the bitmap itself.
+        """
+        pallas = self.kind == "z3" and _use_pallas(self.mesh)
+        args = self._mask_args(boxes_dev, windows_dev)
+        cnt_d, idx_d = _compact_fn(self.kind, HIT_CAPACITY0, pallas)(*args)
+        cnt = int(cnt_d)
+        if cnt == 0:
+            return np.empty(0, dtype=np.int64)
+        if cnt <= HIT_CAPACITY0:
+            return np.asarray(idx_d)[:cnt].astype(np.int64)
+        if cnt * 4 >= self.n_padded // 8:
+            # dense result: the bitmap is the smaller transfer
+            packed = _packed_fn(self.kind, pallas)(*args)
+            mask = np.unpackbits(np.asarray(packed))[: self.n].astype(bool)
+            return np.flatnonzero(mask)
+        cap = HIT_CAPACITY0
+        while cap < cnt:
+            cap *= 2
+        _, idx_d = _compact_fn(self.kind, cap, pallas)(*args)
+        return np.asarray(idx_d)[:cnt].astype(np.int64)
+
+    def to_block_rows(self, rows: np.ndarray) -> List[Tuple[FeatureBlock, np.ndarray]]:
+        """Segment-local candidate rows -> [(block, local rows)]."""
         if not len(rows):
             return []
         starts = np.asarray(self.block_starts + [self.n], dtype=np.int64)
@@ -235,8 +313,62 @@ class DeviceIndex:
         which = np.searchsorted(starts, rows, side="right") - 1
         for blk in np.unique(which):
             local = rows[which == blk] - starts[blk]
-            out.append((int(blk), local))
+            out.append((self.blocks[int(blk)], local))
         return out
+
+
+class DeviceIndex:
+    """Segmented device-resident mirror of one index table.
+
+    ``refresh`` reconciles against the host table incrementally: appended
+    blocks become new segments, new tombstones flip valid bits, and a
+    compaction (block identity mismatch) triggers a full rebuild. Segments
+    merge device-side once fragmentation exceeds MAX_SEGMENTS.
+    """
+
+    def __init__(self, mesh, table: IndexTable):
+        self.mesh = mesh
+        self.kind = table.index.name
+        self.segments: List[DeviceSegment] = []
+        self.version = -1
+        self._n_tombstones = 0
+        self.refresh(table)
+
+    @property
+    def n(self) -> int:
+        return sum(s.n for s in self.segments)
+
+    def refresh(self, table: IndexTable) -> None:
+        if table.version == self.version:
+            return
+        synced: List[int] = []
+        for s in self.segments:
+            synced.extend(s.block_ids)
+        ids = [id(b) for b in table.blocks]
+        if ids[: len(synced)] != synced:
+            # blocks were rewritten (compact) — rebuild from scratch
+            self.segments = []
+            self._n_tombstones = 0
+            synced = []
+        new_blocks = table.blocks[len(synced):]
+        if new_blocks and len(self.segments) >= MAX_SEGMENTS:
+            # fragmentation limit: rebuild one merged segment up front
+            # instead of uploading a per-batch segment just to discard it
+            merged = DeviceSegment(self.mesh, table, table.blocks)
+            if table.tombstones:
+                merged.apply_tombstones(table.tombstones)
+            self.segments = [merged]
+            self._n_tombstones = len(table.tombstones)
+        elif new_blocks:
+            seg = DeviceSegment(self.mesh, table, new_blocks)
+            if table.tombstones:
+                seg.apply_tombstones(table.tombstones)
+            self.segments.append(seg)
+        if len(table.tombstones) != self._n_tombstones:
+            for s in self.segments:
+                s.apply_tombstones(table.tombstones)
+            self._n_tombstones = len(table.tombstones)
+        self.version = table.version
 
 
 class TpuScanExecutor:
@@ -257,15 +389,20 @@ class TpuScanExecutor:
     def device_index(self, table: IndexTable) -> DeviceIndex:
         import weakref
 
+        # sweep dead entries on EVERY lookup — segments pin host block
+        # columns strongly, so a dropped table must not stay resident until
+        # the next cache miss happens to evict it
+        for k in [k for k, (ref, _) in self._cache.items() if ref() is None]:
+            del self._cache[k]
         entry = self._cache.get(id(table))
         cached = None
         if entry is not None and entry[0]() is table:
             cached = entry[1]
-        if cached is None or cached.version != table.version:
+        if cached is None:
             cached = DeviceIndex(self.mesh, table)
-            for k in [k for k, (ref, _) in self._cache.items() if ref() is None]:
-                del self._cache[k]
             self._cache[id(table)] = (weakref.ref(table), cached)
+        elif cached.version != table.version:
+            cached.refresh(table)
         return cached
 
     def supports(self, table: IndexTable, plan: QueryPlan) -> bool:
@@ -273,7 +410,6 @@ class TpuScanExecutor:
             table.index.name in ("z3", "z2", "xz2", "xz3")
             and not plan.values.disjoint
             and bool(plan.values.spatial_envelopes)
-            and not table.tombstones
         )
 
     @staticmethod
@@ -288,10 +424,10 @@ class TpuScanExecutor:
             return None
         return self._device_scan(table, plan)
 
-    def _device_scan(self, table: IndexTable, plan: QueryPlan):
-        dev = self.device_index(table)
+    def _query_descriptor(self, table: IndexTable, plan: QueryPlan):
+        """(boxes, windows) device-replicated arrays for this plan."""
         windows = None
-        if dev.kind in ("xz2", "xz3"):
+        if table.index.name in ("xz2", "xz3"):
             # raw-domain overlap test: query boxes widened outward one f32
             # ulp so the cast can never exclude a true overlap
             boxes = pad_boxes(
@@ -306,7 +442,7 @@ class TpuScanExecutor:
                 ],
                 dtype=np.float32,
             )
-            if dev.kind == "xz3":
+            if table.index.name == "xz3":
                 # unit-resolution offsets; widen one unit each side so the
                 # floor never drops a boundary candidate
                 windows = pad_windows(
@@ -328,7 +464,7 @@ class TpuScanExecutor:
                     for env in plan.values.spatial_envelopes
                 ]
             )
-            if dev.kind == "z3":
+            if table.index.name == "z3":
                 windows = pad_windows(
                     [
                         (
@@ -339,10 +475,17 @@ class TpuScanExecutor:
                         for b, (lo, hi) in sorted(plan.values.bins.items())
                     ]
                 )
-        mask = dev.mask(boxes, windows)
-        rows = np.flatnonzero(mask)
-        for blk, local in dev.to_block_rows(rows):
-            yield table.blocks[blk], local
+        boxes_dev = replicate(self.mesh, boxes)
+        windows_dev = replicate(self.mesh, windows) if windows is not None else None
+        return boxes_dev, windows_dev
+
+    def _device_scan(self, table: IndexTable, plan: QueryPlan):
+        dev = self.device_index(table)
+        boxes_dev, windows_dev = self._query_descriptor(table, plan)
+        for seg in dev.segments:
+            rows = seg.hit_rows(boxes_dev, windows_dev)
+            for block, local in seg.to_block_rows(rows):
+                yield block, local
 
     def post_filter(self, ft, plan: QueryPlan, columns) -> np.ndarray:
         from geomesa_tpu.filter.evaluate import evaluate
@@ -422,8 +565,9 @@ class TpuScanExecutor:
             windows = self._ms_windows(table.ft, plan)
             if windows is None:
                 return None
-        if not dev.load_raw(table):
-            return None
+        for seg in dev.segments:
+            if not seg.load_raw(table):
+                return None
         width, height = int(spec["width"]), int(spec["height"])
         fns = self._density_fns.get((width, height))
         if fns is None:
@@ -441,9 +585,17 @@ class TpuScanExecutor:
         env = np.asarray(spec["envelope"], dtype=np.float32)
         b = replicate(self.mesh, boxes)
         e = replicate(self.mesh, env)
-        if dev.kind == "z3":
-            w = replicate(self.mesh, pad_windows(windows))
-            grid = fns[0](dev.xf, dev.yf, dev.bins, dev.t_ms, dev.valid, b, w, e)
-        else:
-            grid = fns[1](dev.xf, dev.yf, dev.valid, b, e)
-        return np.asarray(grid, dtype=np.float64)
+        w = (
+            replicate(self.mesh, pad_windows(windows))
+            if windows is not None
+            else None
+        )
+        total: Optional[np.ndarray] = None
+        for seg in dev.segments:
+            if seg.kind == "z3":
+                grid = fns[0](seg.xf, seg.yf, seg.bins, seg.t_ms, seg.valid, b, w, e)
+            else:
+                grid = fns[1](seg.xf, seg.yf, seg.valid, b, e)
+            g = np.asarray(grid, dtype=np.float64)
+            total = g if total is None else total + g
+        return total

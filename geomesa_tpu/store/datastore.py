@@ -427,12 +427,13 @@ class TpuDataStore:
 
         versions = tuple(t.version for t in self._tables[name].values())
         key = (name, to_cql(query.filter), versions)
-        plan = self._plan_cache.get(key)
+        # LRU: hits move to the back, the oldest entry is evicted when full
+        plan = self._plan_cache.pop(key, None)
         if plan is None:
             plan = self.planner(name).plan(query)
-            if len(self._plan_cache) > 256:
-                self._plan_cache.clear()
-            self._plan_cache[key] = plan
+            if len(self._plan_cache) >= 256:
+                self._plan_cache.pop(next(iter(self._plan_cache)))
+        self._plan_cache[key] = plan
         return plan
 
 
