@@ -15,11 +15,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from geomesa_tpu.ops.filters import spatial_mask, temporal_mask
@@ -65,7 +60,7 @@ def density_kernel(
     return grid.reshape(height, width)
 
 
-def make_sharded_density(mesh, width: int, height: int):
+def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
     """Build jitted shard_map density passes: per-shard fused exact-predicate
     mask + scatter, partial grids psum'd over the row axis (the client-merge
     analog, QueryPlanner.scala:87-92, but on ICI instead of RPC).
@@ -73,33 +68,57 @@ def make_sharded_density(mesh, width: int, height: int):
     The spatial test runs on raw f32 coords vs raw boxes, the temporal test
     on raw (bin, offset) windows — exact query semantics, not the coarse
     int-domain candidate test, so the grid needs no post-filter.
+
+    mode "pallas"/"pallas_spmd" swaps the per-shard inner pass for the MXU
+    one-hot matmul kernel (pallas_kernels.density_grid_pallas) when the
+    grid fits its VMEM budget; "xla" keeps the scatter-add.
     """
     from geomesa_tpu.ops.filters import bbox_mask_f32
+    from geomesa_tpu.ops.pallas_kernels import DENSITY_MAX_DIM, density_grid_pallas
 
-    def step(x, y, bins, offs, valid, boxes, windows, env):
-        m = valid & bbox_mask_f32(x, y, boxes) & temporal_mask(bins, offs, windows)
-        return jax.lax.psum(density_kernel(x, y, m, env, width, height), DATA_AXIS)
+    use_pallas = mode != "xla" and width <= DENSITY_MAX_DIM and height <= DENSITY_MAX_DIM
 
-    def step_no_time(x, y, valid, boxes, env):
-        m = valid & bbox_mask_f32(x, y, boxes)
-        return jax.lax.psum(density_kernel(x, y, m, env, width, height), DATA_AXIS)
+    if use_pallas:
+        def step(x, y, bins, offs, valid, boxes, windows, env):
+            grid = density_grid_pallas(
+                x, y, bins, offs, valid, boxes, windows, env, width, height, True
+            )
+            return jax.lax.psum(grid, DATA_AXIS)
+
+        def step_no_time(x, y, valid, boxes, env):
+            grid = density_grid_pallas(
+                x, y, None, None, valid, boxes, None, env, width, height, False
+            )
+            return jax.lax.psum(grid, DATA_AXIS)
+    else:
+        def step(x, y, bins, offs, valid, boxes, windows, env):
+            m = valid & bbox_mask_f32(x, y, boxes) & temporal_mask(bins, offs, windows)
+            return jax.lax.psum(density_kernel(x, y, m, env, width, height), DATA_AXIS)
+
+        def step_no_time(x, y, valid, boxes, env):
+            m = valid & bbox_mask_f32(x, y, boxes)
+            return jax.lax.psum(density_kernel(x, y, m, env, width, height), DATA_AXIS)
+
+    from geomesa_tpu.parallel.mesh import shard_map_fn
 
     d = P(DATA_AXIS)
     r = P()
     with_time = jax.jit(
-        shard_map(
+        shard_map_fn(
             step,
-            mesh=mesh,
+            mesh,
             in_specs=(d, d, d, d, d, r, r, r),
             out_specs=r,
+            check=not use_pallas,
         )
     )
     no_time = jax.jit(
-        shard_map(
+        shard_map_fn(
             step_no_time,
-            mesh=mesh,
+            mesh,
             in_specs=(d, d, d, r, r),
             out_specs=r,
+            check=not use_pallas,
         )
     )
     return with_time, no_time

@@ -59,24 +59,32 @@ HIT_CAPACITY0 = 8192
 MAX_SEGMENTS = 8
 
 
-def _use_pallas(mesh) -> bool:
-    """Single-chip TPU runs take the Pallas streaming kernel; sharded meshes
-    and CPU stay on the XLA mask (pallas under SPMD needs shard_map)."""
-    return jax.default_backend() == "tpu" and mesh.devices.size == 1
+def _mask_mode(mesh) -> str:
+    """Which kernel implementation the executor runs.
+
+    "pallas"       streaming Pallas kernel, single chip
+    "pallas_spmd"  Pallas kernel per shard under shard_map (multi-chip:
+                   each chip scans its resident rows — the tablet-server
+                   fan-out of BatchScanPlan, AccumuloQueryPlan.scala:113-140)
+    "xla"          broadcast-compare XLA fallback (CPU, or GEOMESA_PALLAS=0)
+
+    GEOMESA_PALLAS overrides: 0 -> xla, spmd -> pallas_spmd (interpret mode
+    off-TPU; lets the CPU mesh tests exercise the SPMD kernel path).
+    """
+    import os
+
+    env = os.environ.get("GEOMESA_PALLAS", "auto")
+    if env == "0":
+        return "xla"
+    if env == "spmd":
+        return "pallas_spmd"
+    if env == "1" or jax.default_backend() == "tpu":
+        return "pallas" if mesh.devices.size == 1 else "pallas_spmd"
+    return "xla"
 
 
-def _raw_mask_fn(kind: str, pallas: bool):
-    """Unjitted bool-mask callable for one index kind."""
+def _xla_mask_fn(kind: str):
     if kind == "z3":
-        if pallas:
-            def run(xi, yi, bins, offs, valid, boxes, windows):
-                from geomesa_tpu.ops.pallas_kernels import z3_query_mask_pallas
-
-                return z3_query_mask_pallas(
-                    xi, yi, bins, offs, valid, boxes, windows, interpret=False
-                )
-
-            return run
         return z3_query_mask
     if kind == "z2":
         return z2_query_mask
@@ -86,21 +94,64 @@ def _raw_mask_fn(kind: str, pallas: bool):
             return m & temporal_mask(bins, offs, windows)
 
         return run
-    # xz2
-    return bbox_overlap_mask
+    return bbox_overlap_mask  # xz2
+
+
+def _pallas_mask_fn(kind: str):
+    from geomesa_tpu.ops import pallas_kernels as pk
+
+    return {
+        "z3": pk.z3_query_mask_pallas,
+        "z2": pk.z2_query_mask_pallas,
+        "xz2": pk.xz2_overlap_mask_pallas,
+        "xz3": pk.xz3_overlap_mask_pallas,
+    }[kind]
+
+
+# how many leading row-sharded args each kind's mask takes (the rest are
+# replicated query descriptors)
+_KIND_ROW_ARGS = {"z3": 5, "z2": 3, "xz2": 5, "xz3": 7}
+
+
+def _raw_mask_fn(kind: str, mode: str, mesh):
+    """Unjitted bool-mask callable for one index kind."""
+    if mode == "xla":
+        return _xla_mask_fn(kind)
+    fn = _pallas_mask_fn(kind)
+    if mode == "pallas":
+        return fn
+    # pallas_spmd: per-shard Pallas kernel over the row axis; row columns
+    # stay sharded, query descriptors are replicated
+    from jax.sharding import PartitionSpec as P
+
+    from geomesa_tpu.parallel.mesh import shard_map_fn
+
+    nrow = _KIND_ROW_ARGS[kind]
+    nsmall = 2 if kind in ("z3", "xz3") else 1
+    return shard_map_fn(
+        fn,
+        mesh,
+        in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * nsmall),
+        out_specs=P(DATA_AXIS),
+        check=False,
+    )
 
 
 # jit caches shared across DeviceIndex instances: one entry per
-# (kind, capacity-bucket, pallas) — shapes bucket again inside jit
-_COMPACT_FNS: Dict[Tuple[str, int, bool], "jax.stages.Wrapped"] = {}
-_PACKED_FNS: Dict[Tuple[str, bool], "jax.stages.Wrapped"] = {}
+# (kind, capacity-bucket, mode[, mesh]) — shapes bucket again inside jit
+_COMPACT_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
-def _compact_fn(kind: str, capacity: int, pallas: bool):
-    key = (kind, capacity, pallas)
+def _fn_key(kind: str, mode: str, mesh) -> tuple:
+    return (kind, mode, mesh if mode == "pallas_spmd" else None)
+
+
+def _compact_fn(kind: str, capacity: int, mode: str, mesh):
+    key = (capacity,) + _fn_key(kind, mode, mesh)
     fn = _COMPACT_FNS.get(key)
     if fn is None:
-        mask = _raw_mask_fn(kind, pallas)
+        mask = _raw_mask_fn(kind, mode, mesh)
 
         def run(*args):
             m = mask(*args)
@@ -113,11 +164,11 @@ def _compact_fn(kind: str, capacity: int, pallas: bool):
     return fn
 
 
-def _packed_fn(kind: str, pallas: bool):
-    key = (kind, pallas)
+def _packed_fn(kind: str, mode: str, mesh):
+    key = _fn_key(kind, mode, mesh)
     fn = _PACKED_FNS.get(key)
     if fn is None:
-        mask = _raw_mask_fn(kind, pallas)
+        mask = _raw_mask_fn(kind, mode, mesh)
 
         def run(*args):
             return jnp.packbits(mask(*args))
@@ -192,12 +243,20 @@ class DeviceSegment:
                     ts.append(offs.astype(np.int32))
             n += b.n
         self.n = n
-        # x8 keeps each shard byte-aligned for the packbits fallback; lcm
-        # with the pallas row tile keeps the kernel path shape-legal
+        # Pallas modes need a whole number of row tiles PER SHARD; the XLA
+        # mode only needs byte-aligned shards (packbits fallback). Don't pay
+        # the devices*TILE granule when the kernels will never run — if the
+        # mode later flips to pallas on an xla-granule segment, hit_rows
+        # degrades that segment to the XLA mask instead of crashing.
         from geomesa_tpu.ops.pallas_kernels import TILE
 
-        m = int(np.lcm(max(1, mesh.devices.size) * 8, TILE))
+        size = max(1, mesh.devices.size)
+        if _mask_mode(mesh) == "xla":
+            m = int(np.lcm(size * 8, TILE))
+        else:
+            m = size * TILE
         self.n_padded = _pad_rows(max(n, 1), m)
+        self._pallas_ok = (self.n_padded // size) % TILE == 0
         self._m = self.n_padded  # pack() pads straight to the bucketed size
         self.fids = np.concatenate(
             [b.columns["__fid__"] for b in blocks]
@@ -285,9 +344,11 @@ class DeviceSegment:
         overflow and degrades to the packed bitmap only when the hit list
         would be larger than the bitmap itself.
         """
-        pallas = self.kind == "z3" and _use_pallas(self.mesh)
+        mode = _mask_mode(self.mesh)
+        if mode != "xla" and not self._pallas_ok:
+            mode = "xla"  # segment was padded for the XLA granule only
         args = self._mask_args(boxes_dev, windows_dev)
-        cnt_d, idx_d = _compact_fn(self.kind, HIT_CAPACITY0, pallas)(*args)
+        cnt_d, idx_d = _compact_fn(self.kind, HIT_CAPACITY0, mode, self.mesh)(*args)
         cnt = int(cnt_d)
         if cnt == 0:
             return np.empty(0, dtype=np.int64)
@@ -295,13 +356,13 @@ class DeviceSegment:
             return np.asarray(idx_d)[:cnt].astype(np.int64)
         if cnt * 4 >= self.n_padded // 8:
             # dense result: the bitmap is the smaller transfer
-            packed = _packed_fn(self.kind, pallas)(*args)
+            packed = _packed_fn(self.kind, mode, self.mesh)(*args)
             mask = np.unpackbits(np.asarray(packed))[: self.n].astype(bool)
             return np.flatnonzero(mask)
         cap = HIT_CAPACITY0
         while cap < cnt:
             cap *= 2
-        _, idx_d = _compact_fn(self.kind, cap, pallas)(*args)
+        _, idx_d = _compact_fn(self.kind, cap, mode, self.mesh)(*args)
         return np.asarray(idx_d)[:cnt].astype(np.int64)
 
     def to_block_rows(self, rows: np.ndarray) -> List[Tuple[FeatureBlock, np.ndarray]]:
@@ -569,12 +630,15 @@ class TpuScanExecutor:
             if not seg.load_raw(table):
                 return None
         width, height = int(spec["width"]), int(spec["height"])
-        fns = self._density_fns.get((width, height))
+        mode = _mask_mode(self.mesh)
+        if mode != "xla" and not all(s._pallas_ok for s in dev.segments):
+            mode = "xla"  # some segment lacks the per-shard tile granule
+        fns = self._density_fns.get((width, height, mode))
         if fns is None:
             from geomesa_tpu.ops.aggregations import make_sharded_density
 
-            fns = make_sharded_density(self.mesh, width, height)
-            self._density_fns[(width, height)] = fns
+            fns = make_sharded_density(self.mesh, width, height, mode)
+            self._density_fns[(width, height, mode)] = fns
         boxes = pad_boxes(
             [
                 (g.envelope.xmin, g.envelope.ymin, g.envelope.xmax, g.envelope.ymax)

@@ -61,6 +61,23 @@ def force_cpu_platform(min_devices: int = 0):
     return devices
 
 
+def shard_map_fn(f, mesh: Mesh, in_specs, out_specs, check: bool = True):
+    """shard_map across jax versions; check=False disables the replication/
+    vma checker (required when the per-shard body is a pallas_call, whose
+    out_shape carries no vma annotation)."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as sm
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if not check:
+        try:
+            return sm(f, check_vma=False, **kwargs)
+        except TypeError:  # pragma: no cover - pre-vma jax uses check_rep
+            return sm(f, check_rep=False, **kwargs)
+    return sm(f, **kwargs)
+
+
 def default_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh:
     """1D mesh over all (or the given) devices; rows shard over ``axis``."""
     devices = list(devices) if devices is not None else jax.devices()
