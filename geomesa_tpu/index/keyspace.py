@@ -62,8 +62,11 @@ class IndexValues:
     intervals: Optional[FilterValues] = None
     # bin -> (offset_lo, offset_hi) inclusive windows (z3/xz3)
     bins: Dict[int, Tuple[int, int]] = field(default_factory=dict)
-    # equality/range values for attribute index
+    # equality/range values for attribute index; attr_precise=False means
+    # the bounds over-cover (e.g. LIKE 'a%b' scans the 'a' prefix) and the
+    # full filter must post-filter candidates
     attr_bounds: Optional[List[Bounds]] = None
+    attr_precise: bool = True
     ids: Optional[List[str]] = None
     disjoint: bool = False
 
@@ -427,6 +430,7 @@ class AttributeKeySpace(IndexKeySpace):
         return IndexValues(
             FilterValues.empty(),
             attr_bounds=bounds.values if bounds.values else None,
+            attr_precise=bounds.precise,
             disjoint=bounds.disjoint,
         )
 
@@ -458,12 +462,14 @@ def _extract_attr_bounds(f: ast.Filter, attribute: str, ft: FeatureType) -> Filt
 
     if isinstance(f, ast.And):
         current: Optional[List[Bounds]] = None
+        precise = True
         for c in f.children():
             child = _extract_attr_bounds(c, attribute, ft)
             if child.disjoint:
                 return FilterValues.disjoint_values()
             if child.is_empty:
                 continue
+            precise = precise and child.precise
             if current is None:
                 current = child.values
             else:
@@ -476,15 +482,17 @@ def _extract_attr_bounds(f: ast.Filter, attribute: str, ft: FeatureType) -> Filt
                 if not nxt:
                     return FilterValues.disjoint_values()
                 current = nxt
-        return FilterValues(current or [])
+        return FilterValues(current or [], precise=precise)
     if isinstance(f, ast.Or):
         out: List[Bounds] = []
+        precise = True
         for c in f.children():
             child = _extract_attr_bounds(c, attribute, ft)
             if child.is_empty and not child.disjoint:
                 return FilterValues.empty()
+            precise = precise and child.precise
             out.extend(child.values)
-        return FilterValues(out) if out else FilterValues.empty()
+        return FilterValues(out, precise=precise) if out else FilterValues.empty()
     if isinstance(f, ast.Cmp) and f.prop == attribute:
         v = _coerce(ft, attribute, f.literal)
         if f.op == "=":

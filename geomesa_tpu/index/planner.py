@@ -91,6 +91,10 @@ class QueryPlan:
     full_filter: Optional[ast.Filter]
     cost: float
     explain: str = ""
+    # cross-index OR split (FilterSplitter.scala:64-110): when set, the
+    # executor scans each arm plan independently and unions by fid; the
+    # top-level index/ranges fields are informational only
+    union: Optional[List["QueryPlan"]] = None
 
     @property
     def is_empty(self) -> bool:
@@ -120,6 +124,54 @@ class QueryPlanner:
     ) -> QueryPlan:
         explain = explain or Explainer()
         f = simplify(query.filter)
+        single = self._plan_single(f, explain, max_ranges)
+        if not isinstance(f, ast.Or):
+            return single
+        # Cross-index OR split (planning/FilterSplitter.scala:64-110): plan
+        # each top-level OR arm on its own best index; if the summed cost
+        # beats the single-strategy plan, scan the arms independently and
+        # union by fid (the reference instead rewrites arms disjoint,
+        # makeDisjoint :303 — fid dedup is exact and cheaper host-side).
+        arms: List[QueryPlan] = []
+        total = 0.0
+        # fixed per-arm scan overhead: each arm is a full extra scan setup
+        # (+ fid dedup), so a union must win by a real margin — otherwise a
+        # homogeneous OR (e.g. two bboxes) stays on the cheaper multi-box
+        # single-index plan the extractors already produce
+        ARM_OVERHEAD = 100.0
+        for child in f.children():
+            arm = self._plan_single(simplify(child), Explainer(), max_ranges)
+            arms.append(arm)
+            total += arm.cost + ARM_OVERHEAD
+        if total >= single.cost:
+            return single
+        explain.push(f"Union plan: {len(arms)} per-index scans (cost {total:g})")
+        for arm in arms:
+            explain(
+                f"arm[{arm.index.name}]: "
+                f"{to_cql(arm.full_filter) if arm.full_filter else 'INCLUDE'} "
+                f"ranges={len(arm.ranges)} cost={arm.cost:g}"
+            )
+        explain.pop()
+        return QueryPlan(
+            ft=self.ft,
+            index=arms[0].index,
+            ranges=[],
+            values=arms[0].values,
+            primary=None,
+            secondary=None,
+            full_filter=f,
+            cost=total,
+            explain=explain.output,
+            union=arms,
+        )
+
+    def _plan_single(
+        self,
+        f: ast.Filter,
+        explain: Explainer,
+        max_ranges: int = SCAN_RANGES_TARGET,
+    ) -> QueryPlan:
         explain.push(f"Planning query for type '{self.ft.name}'")
         explain(f"Filter: {to_cql(f)}")
         explain(f"Indices available: {[i.name for i in self.indices]}")
@@ -171,10 +223,14 @@ class QueryPlanner:
             "attr"
         )
         precise = (
-            best.values.geometries.precise
-            if best.values.geometries is not None
-            else True
-        ) and (best.values.intervals.precise if best.values.intervals else True)
+            (
+                best.values.geometries.precise
+                if best.values.geometries is not None
+                else True
+            )
+            and (best.values.intervals.precise if best.values.intervals else True)
+            and best.values.attr_precise  # LIKE-prefix ranges over-cover
+        )
         if all_contained and precise and best.secondary is None and exact_value_space:
             full = None
             explain("Ranges are fully covering -> no post-filter")

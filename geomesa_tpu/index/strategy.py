@@ -56,17 +56,31 @@ class FilterStrategy:
         )
 
 
+def _all_leaves(f: ast.Filter, pred) -> bool:
+    """True when every leaf under an and/or tree satisfies ``pred``."""
+    if isinstance(f, (ast.And, ast.Or)):
+        kids = f.children()
+        return bool(kids) and all(_all_leaves(c, pred) for c in kids)
+    return pred(f)
+
+
 def _split_nodes(f: ast.Filter, pred) -> tuple:
-    """Split a top-level AND into (matching, rest) by ``pred`` on leaves."""
+    """Split a top-level AND into (matching, rest) by ``pred``.
+
+    A child counts as matching when ALL its leaves match — so a spatial OR
+    like ``bbox(a) OR bbox(b)`` is index-answerable as a whole and gets a
+    primary (hence a stats estimate), matching the reference where
+    extractGeometries unions OR'd spatial predicates (FilterHelper.scala:36).
+    """
     if isinstance(f, ast.And):
         hits, rest = [], []
         for c in f.children():
-            if pred(c):
+            if _all_leaves(c, pred):
                 hits.append(c)
             else:
                 rest.append(c)
         return hits, rest
-    if pred(f):
+    if _all_leaves(f, pred):
         return [f], []
     return [], [f]
 

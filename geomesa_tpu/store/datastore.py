@@ -324,12 +324,23 @@ class TpuDataStore:
             )
 
     def _execute(self, name, ft, query: Query, plan: QueryPlan, t_scan_start) -> QueryResult:
-        import time as _time
         if plan.is_empty:
             empty = _empty_columns(ft)
             if has_aggregation(query.hints):
                 return QueryResult(ft, empty, plan, run_aggregation(ft, query.hints, empty))
             return QueryResult(ft, empty, plan)
+
+        if plan.union is not None:
+            # cross-index OR: scan each arm on its own index, union by fid
+            # (FilterSplitter.scala:64-110; dedup replaces makeDisjoint :303)
+            parts: List[Columns] = []
+            for arm in plan.union:
+                if arm.is_empty:
+                    continue
+                parts.extend(self._scan_parts(name, ft, query, arm, t_scan_start))
+            columns = concat_columns(parts) if parts else _empty_columns(ft)
+            columns = _dedupe_by_fid(columns)
+            return self._finish(ft, query, plan, columns)
 
         tables = self._tables[name]
         table = tables[plan.index.name]
@@ -344,6 +355,30 @@ class TpuDataStore:
             if grid is not None:
                 return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})
 
+        parts = self._scan_parts(name, ft, query, plan, t_scan_start)
+        columns = concat_columns(parts) if parts else _empty_columns(ft)
+        if plan.index.name in ("xz2", "xz3"):
+            # only extent indices can emit multiple rows per feature
+            # (QueryPlanner.scala:83-85 dedupes exactly this case; point
+            # indices are one-row-per-feature in the reference too)
+            columns = _dedupe_by_fid(columns)
+        return self._finish(ft, query, plan, columns)
+
+    def _finish(self, ft, query: Query, plan: QueryPlan, columns: Columns) -> QueryResult:
+        if has_aggregation(query.hints):
+            # sampling composes with aggregations (SamplingIterator stacks
+            # under density/bin/arrow scans in the reference)
+            columns = _apply_sampling(query, columns)
+            agg = run_aggregation(ft, query.hints, columns)
+            return QueryResult(ft, _empty_columns(ft), plan, agg)
+        columns = _apply_query_options(ft, query, columns)
+        return QueryResult(ft, columns, plan)
+
+    def _scan_parts(self, name, ft, query: Query, plan: QueryPlan, t_scan_start) -> List[Columns]:
+        import time as _time
+
+        tables = self._tables[name]
+        table = tables[plan.index.name]
         parts: List[Columns] = []
         scan = self.executor.scan_candidates(table, plan)
         device_scan = scan is not None
@@ -400,20 +435,7 @@ class TpuDataStore:
             mask_cols["__fid__"] = block.columns["__fid__"][rows]
             if len(rows):
                 parts.append(mask_cols)
-        columns = concat_columns(parts) if parts else _empty_columns(ft)
-        if plan.index.name in ("xz2", "xz3"):
-            # only extent indices can emit multiple rows per feature
-            # (QueryPlanner.scala:83-85 dedupes exactly this case; point
-            # indices are one-row-per-feature in the reference too)
-            columns = _dedupe_by_fid(columns)
-        if has_aggregation(query.hints):
-            # sampling composes with aggregations (SamplingIterator stacks
-            # under density/bin/arrow scans in the reference)
-            columns = _apply_sampling(query, columns)
-            agg = run_aggregation(ft, query.hints, columns)
-            return QueryResult(ft, _empty_columns(ft), plan, agg)
-        columns = _apply_query_options(ft, query, columns)
-        return QueryResult(ft, columns, plan)
+        return parts
 
     def _as_query(self, query: Union[str, Query]) -> Query:
         if isinstance(query, Query):

@@ -1,0 +1,129 @@
+"""Cross-index OR union plans (the FilterSplitter analog,
+geomesa-index-api planning/FilterSplitter.scala:64-110, makeDisjoint :303).
+
+``bbox(...) OR attr = 'x'`` must plan two per-index scans (visible in
+explain) and union results by fid — previously it degenerated to a full
+scan on a single index.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "name:String:index=true,age:Int,dtg:Date,*geom:Point:srid=4326"
+BASE = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+
+
+def _fill(store, n=2500, seed=21):
+    rng = np.random.default_rng(seed)
+    store.create_schema(parse_spec("t", SPEC))
+    rows = [
+        [
+            f"name{i % 40}",
+            int(rng.integers(0, 100)),
+            int(BASE + rng.integers(0, 30 * 86400_000)),
+            Point(float(rng.uniform(-170, 170)), float(rng.uniform(-80, 80))),
+        ]
+        for i in range(n)
+    ]
+    if isinstance(store, MemoryDataStore):
+        for i, r in enumerate(rows):
+            store.write("t", r, fid=f"f{i}")
+    else:
+        with store.writer("t") as w:
+            for i, r in enumerate(rows):
+                w.write(r, fid=f"f{i}")
+
+
+UNION_QUERIES = [
+    "bbox(geom, -20, -20, 20, 20) OR name = 'name7'",
+    "bbox(geom, -20, -20, 20, 20) OR name = 'name7' OR name = 'name8'",
+    (
+        "(bbox(geom, -20, -20, 20, 20) AND dtg DURING "
+        "2026-01-02T00:00:00Z/2026-01-20T00:00:00Z) OR name = 'name3'"
+    ),
+    "IN ('f1', 'f2', 'f3') OR bbox(geom, 100, 40, 140, 70)",
+]
+
+
+@pytest.fixture(scope="module")
+def stores():
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    mem = MemoryDataStore()
+    for s in (host, tpu, mem):
+        _fill(s)
+    return host, tpu, mem
+
+
+@pytest.mark.parametrize("cql", UNION_QUERIES)
+def test_union_parity_vs_memory_oracle(stores, cql):
+    host, tpu, mem = stores
+    want = sorted(mem.query("t", cql).fids)
+    assert len(want) > 0
+    assert sorted(host.query("t", cql).fids) == want
+    assert sorted(tpu.query("t", cql).fids) == want
+
+
+def test_union_plan_chosen_and_explained(stores):
+    host, _, _ = stores
+    cql = UNION_QUERIES[0]
+    plan = host._plan_cached("t", host._as_query(cql))
+    assert plan.union is not None and len(plan.union) == 2
+    names = sorted(arm.index.name for arm in plan.union)
+    assert names[1].startswith("z") or names[1].startswith("xz")  # spatial arm
+    assert any(n.startswith("attr") for n in names)  # attribute arm
+    text = host.explain("t", cql)
+    assert "Union plan" in text
+    assert "arm[" in text
+
+
+def test_union_dedups_overlapping_arms(stores):
+    """A feature matching both arms must appear once."""
+    host, _, mem = stores
+    # name7 features inside the bbox match both arms
+    cql = "bbox(geom, -180, -90, 180, 90) OR name = 'name7'"
+    got = list(host.query("t", cql).fids)
+    assert len(got) == len(set(got))
+    assert sorted(got) == sorted(mem.query("t", cql).fids)
+
+
+def test_spatial_only_or_stays_single_plan(stores):
+    """Homogeneous spatial ORs keep the (cheaper) multi-box single scan."""
+    host, _, mem = stores
+    cql = "bbox(geom, -20, -20, 0, 0) OR bbox(geom, 0, 0, 20, 20)"
+    plan = host._plan_cached("t", host._as_query(cql))
+    assert plan.union is None
+    assert sorted(host.query("t", cql).fids) == sorted(mem.query("t", cql).fids)
+
+
+def test_union_with_max_features(stores):
+    from geomesa_tpu.index.planner import Query
+
+    host, _, _ = stores
+    q = Query.cql(UNION_QUERIES[0], max_features=5)
+    assert len(host.query("t", q)) == 5
+
+
+def test_like_inner_wildcard_postfilters():
+    """Regression: LIKE with an inner wildcard produces an over-covering
+    prefix range (attr_precise=False); the covering shortcut must NOT drop
+    the post-filter — bare or OR-wrapped."""
+    for cql in ("name LIKE 'na%e7'", "name LIKE 'na%e7' OR name = 'q'"):
+        host = TpuDataStore(executor=HostScanExecutor())
+        mem = MemoryDataStore()
+        spec = "name:String:index=true,*geom:Point:srid=4326"
+        for s in (host, mem):
+            s.create_schema(parse_spec("lk", spec))
+        rows = [["name7", Point(1.0, 1.0)], ["name70", Point(2.0, 2.0)], ["q", Point(3.0, 3.0)]]
+        for i, r in enumerate(rows):
+            mem.write("lk", r, fid=f"f{i}")
+        with host.writer("lk") as w:
+            for i, r in enumerate(rows):
+                w.write(r, fid=f"f{i}")
+        assert sorted(host.query("lk", cql).fids) == sorted(mem.query("lk", cql).fids), cql
