@@ -43,7 +43,12 @@ SCAN_RANGES_TARGET = 2000
 class ScanRange(NamedTuple):
     """One key range to scan. ``bin`` partitions binned indices (z3/xz3);
     non-binned indices use bin 0. ``lower``/``upper`` of None mean unbounded
-    (attribute ranges); inclusivity defaults to closed ranges."""
+    (attribute ranges); inclusivity defaults to closed ranges.
+
+    ``tiebreak_ranges`` carries secondary z2 ranges for attribute-equality
+    scans (the z-curve tiebreak of the reference's attribute keys,
+    AttributeIndex.scala:43-46): rows within one attribute value are sorted
+    by z2, so a spatial predicate prunes to matching z sub-spans."""
 
     bin: int
     lower: Any
@@ -51,6 +56,7 @@ class ScanRange(NamedTuple):
     contained: bool
     lower_inclusive: bool = True
     upper_inclusive: bool = True
+    tiebreak_ranges: Optional[List[Tuple[int, int]]] = None
 
 
 @dataclass
@@ -412,6 +418,10 @@ class AttributeKeySpace(IndexKeySpace):
     def supports(self, ft: FeatureType) -> bool:
         return ft.has(self.attribute) and ft.attr(self.attribute).indexed
 
+    # z2 tiebreak decomposition budget: each range costs one searchsorted
+    # pair per equality span at scan time
+    TIEBREAK_MAX_RANGES = 32
+
     def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
         col = columns[self.attribute]
         # null attribute values are not indexed (the reference skips writing
@@ -423,15 +433,30 @@ class AttributeKeySpace(IndexKeySpace):
         else:
             nulls = columns.get(self.attribute + "__null")
             valid = ~nulls if nulls is not None else np.ones(len(col), dtype=bool)
-        return {"__key__": col, "__valid__": valid}
+        out = {"__key__": col, "__valid__": valid}
+        geom = ft.default_geometry
+        if geom is not None and ft.is_points:
+            # secondary sort by z2 within each attribute value
+            # (AttributeIndex.scala:43-46 z-curve tiebreak)
+            x = columns[geom.name + "__x"]
+            y = columns[geom.name + "__y"]
+            ok = ~(np.isnan(x) | np.isnan(y))
+            tb = np.full(len(col), -1, dtype=np.int64)
+            if ok.any():
+                tb[ok] = Z2SFC().index(x[ok], y[ok], lenient=True)
+            out["__tiebreak__"] = tb
+        return out
 
     def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
         bounds = _extract_attr_bounds(f, self.attribute, ft)
+        geoms = FilterValues.empty()
+        if ft.default_geometry is not None and ft.is_points:
+            geoms = extract_geometries(f, ft.default_geometry.name)
         return IndexValues(
-            FilterValues.empty(),
+            geoms,
             attr_bounds=bounds.values if bounds.values else None,
             attr_precise=bounds.precise,
-            disjoint=bounds.disjoint,
+            disjoint=bounds.disjoint or geoms.disjoint,
         )
 
     def get_ranges(
@@ -439,8 +464,23 @@ class AttributeKeySpace(IndexKeySpace):
     ) -> List[ScanRange]:
         if values.disjoint or not values.attr_bounds:
             return []
+        # one z2 decomposition shared by every equality span: prune within
+        # a value's rows to z sub-spans when the query is ALSO spatial.
+        # Only equality spans are z-sorted, so skip the decomposition when
+        # no bound can use it.
+        tiebreaks: Optional[List[Tuple[int, int]]] = None
+        any_equality = any(
+            b.lower.value is not None and b.lower.value == b.upper.value
+            for b in values.attr_bounds
+        )
+        if values.geometries.values and any_equality:
+            zr = Z2SFC().ranges(
+                _boxes(values), max_ranges=self.TIEBREAK_MAX_RANGES
+            )
+            tiebreaks = [(int(r.lower), int(r.upper)) for r in zr]
         out = []
         for b in values.attr_bounds:
+            equality = b.lower.value is not None and b.lower.value == b.upper.value
             out.append(
                 ScanRange(
                     0,
@@ -449,6 +489,7 @@ class AttributeKeySpace(IndexKeySpace):
                     True,
                     b.lower.inclusive,
                     b.upper.inclusive,
+                    tiebreaks if equality else None,
                 )
             )
         return out

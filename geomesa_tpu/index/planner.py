@@ -132,24 +132,29 @@ class QueryPlanner:
         # beats the single-strategy plan, scan the arms independently and
         # union by fid (the reference instead rewrites arms disjoint,
         # makeDisjoint :303 — fid dedup is exact and cheaper host-side).
-        arms: List[QueryPlan] = []
-        total = 0.0
         # fixed per-arm scan overhead: each arm is a full extra scan setup
         # (+ fid dedup), so a union must win by a real margin — otherwise a
         # homogeneous OR (e.g. two bboxes) stays on the cheaper multi-box
         # single-index plan the extractors already produce
         ARM_OVERHEAD = 100.0
-        for child in f.children():
-            arm = self._plan_single(simplify(child), Explainer(), max_ranges)
-            arms.append(arm)
-            total += arm.cost + ARM_OVERHEAD
+        children = [simplify(c) for c in f.children()]
+        # cost the arms from strategies alone first; range decomposition
+        # only runs for arms of a union that actually wins
+        total = 0.0
+        for child in children:
+            opts = get_filter_strategies(self.ft, self.indices, child, self.stats)
+            total += min(s.cost for s in opts) + ARM_OVERHEAD if opts else 2e9
         if total >= single.cost:
             return single
+        arms: List[QueryPlan] = [
+            self._plan_single(child, Explainer(), max_ranges) for child in children
+        ]
+        total = sum(a.cost + ARM_OVERHEAD for a in arms)
         explain.push(f"Union plan: {len(arms)} per-index scans (cost {total:g})")
-        for arm in arms:
+        for child, arm in zip(children, arms):
+            covered = " (ranges fully cover)" if arm.full_filter is None else ""
             explain(
-                f"arm[{arm.index.name}]: "
-                f"{to_cql(arm.full_filter) if arm.full_filter else 'INCLUDE'} "
+                f"arm[{arm.index.name}]: {to_cql(child)}{covered} "
                 f"ranges={len(arm.ranges)} cost={arm.cost:g}"
             )
         explain.pop()

@@ -123,11 +123,14 @@ class FeatureBlock:
         columns: Columns,
         key: np.ndarray,
         bins: Optional[np.ndarray],
+        tiebreak: Optional[np.ndarray] = None,
     ):
         self.index = index
         self.columns = columns
         self.key = key
         self.bins = bins
+        # secondary z2 sort within equal keys (attribute index only)
+        self.tiebreak = tiebreak
         self.n = len(key)
         # per-bin row slices (contiguous after the sort)
         self.bin_slices: Dict[int, Tuple[int, int]] = {}
@@ -145,20 +148,26 @@ class FeatureBlock:
         key = key_cols["__key__"]
         bins = key_cols.get("__bin__")
         valid = key_cols.get("__valid__")
+        tiebreak = key_cols.get("__tiebreak__")
         if valid is not None and not valid.all():
             rows = np.where(valid)[0]
             columns = take_rows(columns, rows)
             key = key[rows]
             if bins is not None:
                 bins = bins[rows]
+            if tiebreak is not None:
+                tiebreak = tiebreak[rows]
         if bins is not None:
             order = np.lexsort((key, bins))
             bins = bins[order]
+        elif tiebreak is not None:
+            order = np.lexsort((tiebreak, key))
+            tiebreak = tiebreak[order]
         else:
             order = np.argsort(key, kind="stable")
         key = key[order]
         sorted_cols = take_rows(columns, order)
-        return cls(index, sorted_cols, key, bins)
+        return cls(index, sorted_cols, key, bins, tiebreak)
 
     def scan(self, ranges: Sequence[ScanRange]) -> np.ndarray:
         """Row indices whose keys fall in any range (sorted, deduped)."""
@@ -187,6 +196,27 @@ class FeatureBlock:
         sub = self.key[s:e]
         out = []
         numeric = sub.dtype != object
+        if self.tiebreak is not None and any(r.tiebreak_ranges for r in ranges):
+            # attribute scans with a z2 tiebreak: within each equality span
+            # rows are z-sorted, so spatial predicates reduce to z sub-spans
+            # (the tiered-range scan of the reference's AttributeIndex)
+            for r in ranges:
+                side = "left" if r.lower is None or r.lower_inclusive else "right"
+                st = s if r.lower is None else int(np.searchsorted(sub, r.lower, side=side)) + s
+                side = "right" if r.upper is None or r.upper_inclusive else "left"
+                en = e if r.upper is None else int(np.searchsorted(sub, r.upper, side=side)) + s
+                if en <= st:
+                    continue
+                if not r.tiebreak_ranges:
+                    out.append(np.arange(st, en, dtype=np.int64))
+                    continue
+                tb = self.tiebreak[st:en]
+                for zlo, zhi in r.tiebreak_ranges:
+                    s2 = int(np.searchsorted(tb, zlo, side="left"))
+                    e2 = int(np.searchsorted(tb, zhi, side="right"))
+                    if e2 > s2:
+                        out.append(np.arange(st + s2, st + e2, dtype=np.int64))
+            return out
         if numeric and all(
             r.lower is not None
             and r.upper is not None
