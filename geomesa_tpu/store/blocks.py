@@ -160,6 +160,8 @@ class FeatureBlock:
         if bins is not None:
             order = np.lexsort((key, bins))
             bins = bins[order]
+            if tiebreak is not None:  # keep row-aligned even though no
+                tiebreak = tiebreak[order]  # binned index emits one today
         elif tiebreak is not None:
             order = np.lexsort((tiebreak, key))
             tiebreak = tiebreak[order]

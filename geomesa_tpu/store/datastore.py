@@ -367,11 +367,18 @@ class TpuDataStore:
     def _finish(self, ft, query: Query, plan: QueryPlan, columns: Columns) -> QueryResult:
         if has_aggregation(query.hints):
             # sampling composes with aggregations (SamplingIterator stacks
-            # under density/bin/arrow scans in the reference)
+            # under density/bin/arrow scans in the reference); transforms
+            # apply BEFORE aggregation so arrow/bin streams carry the
+            # derived schema (ArrowScan transform handling)
+            from geomesa_tpu.index.transforms import QueryTransforms
+
             columns = _apply_sampling(query, columns)
+            tf = QueryTransforms.parse(ft, query.properties)
+            if tf is not None:
+                ft, columns = tf.apply(columns)
             agg = run_aggregation(ft, query.hints, columns)
             return QueryResult(ft, _empty_columns(ft), plan, agg)
-        columns = _apply_query_options(ft, query, columns)
+        ft, columns = apply_projection(ft, query, columns)
         return QueryResult(ft, columns, plan)
 
     def _scan_parts(self, name, ft, query: Query, plan: QueryPlan, t_scan_start) -> List[Columns]:
@@ -527,6 +534,23 @@ def _apply_sampling(query: Query, columns: Columns) -> Columns:
         keep = np.zeros(n, dtype=bool)
         keep[::nth] = True
     return {k: v[keep] for k, v in columns.items()}
+
+
+def apply_projection(ft: FeatureType, query: Query, columns: Columns):
+    """Sampling/sort/limit + projection, including derived-attribute
+    transforms ("out=EXPR" properties — QueryPlanner.scala:192-284). Returns
+    (possibly-derived feature type, projected columns)."""
+    from dataclasses import replace
+
+    from geomesa_tpu.index.transforms import QueryTransforms
+
+    tf = QueryTransforms.parse(ft, query.properties)
+    if tf is None:
+        return ft, _apply_query_options(ft, query, columns)
+    # sort/limit/sampling run on the ORIGINAL attributes; the property
+    # filter must not run (expressions still need their source columns)
+    columns = _apply_query_options(ft, replace(query, properties=None), columns)
+    return tf.apply(columns)
 
 
 def _apply_query_options(ft: FeatureType, query: Query, columns: Columns) -> Columns:

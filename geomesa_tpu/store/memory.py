@@ -18,7 +18,7 @@ from geomesa_tpu.index.planner import Query
 from geomesa_tpu.schema.feature import Feature
 from geomesa_tpu.schema.featuretype import FeatureType
 from geomesa_tpu.store.blocks import Columns, columns_from_features, concat_columns, take_rows
-from geomesa_tpu.store.datastore import QueryResult, _apply_query_options, _empty_columns
+from geomesa_tpu.store.datastore import QueryResult, _empty_columns, apply_projection
 
 
 class MemoryDataStore:
@@ -68,5 +68,5 @@ class MemoryDataStore:
         if not isinstance(query.filter, ast.Include):
             mask = evaluate(query.filter, ft, columns)
             columns = take_rows(columns, np.where(mask)[0])
-        columns = _apply_query_options(ft, query, columns)
+        ft, columns = apply_projection(ft, query, columns)
         return QueryResult(ft, columns)

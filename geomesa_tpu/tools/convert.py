@@ -76,9 +76,10 @@ class _Field(_Expr):
 
 
 class _Call(_Expr):
-    def __init__(self, fn: Callable, args: List[_Expr]):
+    def __init__(self, fn: Callable, args: List[_Expr], name: str = ""):
         self.fn = fn
         self.args = args
+        self.name = name  # lowercase function name (for type inference)
 
     def __call__(self, cols, fields):
         return self.fn(*[a(cols, fields) for a in self.args])
@@ -190,7 +191,7 @@ class _Parser:
                         break
                     if t3.group("punct") != ",":
                         raise ValueError("expected , or )")
-            return _Call(_FUNCTIONS[fname], args)
+            return _Call(_FUNCTIONS[fname], args, fname)
         raise ValueError(f"unexpected token {t.group(0)!r}")
 
 

@@ -1,0 +1,94 @@
+"""Query transforms: derived-attribute projections
+(planning/QueryPlanner.scala:192-284, TransformSimpleFeature.scala).
+
+Properties mixing plain names with "out=EXPR" definitions must produce a
+derived schema + projected values, flowing into exports.
+"""
+
+import numpy as np
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import AttributeType, parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+
+SPEC = "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+BASE = np.datetime64("2026-01-05T00:00:00", "ms").astype("int64")
+
+
+def _store(n=50):
+    s = TpuDataStore(executor=HostScanExecutor())
+    s.create_schema(parse_spec("t", SPEC))
+    with s.writer("t") as w:
+        for i in range(n):
+            w.write(
+                [f"name{i}", i, int(BASE + i * 1000), Point(float(i % 90), float(i % 45))],
+                fid=f"f{i}",
+            )
+    return s
+
+
+def test_transform_schema_and_values():
+    s = _store()
+    q = Query.cql(
+        "age < 10",
+        properties=["geom", "who=uppercase($name)", "age2=toint(concat($age, '0'))"],
+    )
+    res = s.query("t", q)
+    assert [a.name for a in res.ft.attributes] == ["geom", "who", "age2"]
+    assert res.ft.attr("who").type == AttributeType.STRING
+    assert res.ft.attr("age2").type == AttributeType.INT
+    assert res.ft.default_geometry is not None
+    cols = res.columns
+    order = np.argsort(cols["__fid__"].astype(str))
+    whos = cols["who"][order]
+    ages = cols["age2"][order]
+    fids = cols["__fid__"][order]
+    for fid, who, a2 in zip(fids, whos, ages):
+        i = int(fid[1:])
+        assert who == f"NAME{i}".upper()
+        assert int(a2) == i * 10
+    # geometry passthrough survives as x/y columns
+    assert "geom__x" in cols and "geom__y" in cols
+
+
+def test_transform_geometry_expression():
+    s = _store()
+    q = Query.cql(
+        "age = 3", properties=["pt=point($age, $age)", "name"]
+    )
+    res = s.query("t", q)
+    assert res.ft.attr("pt").type == AttributeType.POINT
+    assert float(res.columns["pt__x"][0]) == 3.0
+    assert float(res.columns["pt__y"][0]) == 3.0
+    assert res.columns["name"][0] == "name3"
+
+
+def test_transform_composes_with_sort_and_limit():
+    s = _store()
+    q = Query.cql(
+        "INCLUDE",
+        properties=["who=uppercase($name)"],
+        sort_by=[("age", False)],
+        max_features=3,
+    )
+    res = s.query("t", q)
+    assert len(res) == 3
+    assert list(res.columns["who"]) == ["NAME49", "NAME48", "NAME47"]
+
+
+def test_transform_flows_into_export():
+    from geomesa_tpu.tools.export import to_geojson
+
+    s = _store()
+    q = Query.cql("age = 1", properties=["geom", "who=uppercase($name)"])
+    res = s.query("t", q)
+    out = to_geojson(res)
+    assert '"who": "NAME1"' in out or '"who":"NAME1"' in out
+
+
+def test_plain_projection_unchanged():
+    s = _store()
+    q = Query.cql("age = 2", properties=["name"])
+    res = s.query("t", q)
+    assert "name" in res.columns and "age" not in res.columns
