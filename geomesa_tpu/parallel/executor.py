@@ -12,11 +12,18 @@ The device mask is conservative and the exact post-filter is unchanged, so
 result sets are identical to the host scan path (parity by construction).
 
 Transfer protocol (the tserver "return only matching KVs" analog,
-Z3Iterator.scala:42-65): the device compacts the mask into a fixed-capacity
-sorted index buffer; the host reads (count, indices[:count]) so the hop is
-proportional to HITS, not rows. count > capacity escalates to the next pow2
-capacity bucket; when a hit list would exceed the bitmap size the packed
-N/8-byte bitmap is used instead (dense-result fallback).
+Z3Iterator.scala:42-65): the device compacts the mask into run-length
+encoded hit runs — rows are z-sorted, so a box query's hits are contiguous
+runs and RLE is ~8x smaller than an index list — and fuses (count, n_runs,
+starts, lengths) into ONE int32 buffer so a query costs a single
+device->host round trip. n_runs > capacity escalates to the next pow2
+bucket (the segment remembers it); when the run list would exceed the
+packed bitmap's size the N/8-byte bitmap is transferred instead.
+
+Dispatch and resolve are SPLIT (dispatch_hits / _PendingHits.rows) so many
+scans pipeline over a high-latency device link: all buffers start computing
+and copying host-ward before the first blocking read — the client-side
+BatchScanner thread-pool analog (AccumuloQueryPlan.scala:113-140).
 
 Device residency is SEGMENTED and incremental: each write batch becomes a
 new device segment (only new rows cross the host->device link); tombstones
@@ -53,10 +60,13 @@ from geomesa_tpu.parallel.mesh import (
 )
 from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
 
-# initial hit-list capacity: 8192 idx * 4B = 32 KiB per segment transfer
-HIT_CAPACITY0 = 8192
+# initial hit-run capacity: 4096 runs * 8B = 32 KiB per segment transfer
+HIT_CAPACITY0 = 4096
 # merge device segments once a query must touch more than this many
 MAX_SEGMENTS = 8
+# runs buffers bigger than n/DENSE_BITMAP_FACTOR rows' worth degrade to the
+# packed N/8-byte bitmap (8B/run vs 1bit/row break-even at n/64 runs)
+DENSE_BITMAP_FACTOR = 64
 
 
 def _mask_mode(mesh) -> str:
@@ -139,7 +149,7 @@ def _raw_mask_fn(kind: str, mode: str, mesh):
 
 # jit caches shared across DeviceIndex instances: one entry per
 # (kind, capacity-bucket, mode[, mesh]) — shapes bucket again inside jit
-_COMPACT_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_RUNS_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 _PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
@@ -147,20 +157,29 @@ def _fn_key(kind: str, mode: str, mesh) -> tuple:
     return (kind, mode, mesh if mode == "pallas_spmd" else None)
 
 
-def _compact_fn(kind: str, capacity: int, mode: str, mesh):
-    key = (capacity,) + _fn_key(kind, mode, mesh)
-    fn = _COMPACT_FNS.get(key)
+def _runs_fn(kind: str, rcap: int, mode: str, mesh):
+    """Mask -> fused RLE buffer [count, n_runs, starts*rcap, lens*rcap]."""
+    key = (rcap,) + _fn_key(kind, mode, mesh)
+    fn = _RUNS_FNS.get(key)
     if fn is None:
         mask = _raw_mask_fn(kind, mode, mesh)
 
         def run(*args):
             m = mask(*args)
             cnt = jnp.sum(m.astype(jnp.int32))
-            idx = jnp.nonzero(m, size=capacity, fill_value=m.shape[0])[0]
-            return cnt, idx.astype(jnp.int32)
+            prev = jnp.concatenate([jnp.zeros((1,), m.dtype), m[:-1]])
+            nxt = jnp.concatenate([m[1:], jnp.zeros((1,), m.dtype)])
+            starts_m = m & ~prev
+            nruns = jnp.sum(starts_m.astype(jnp.int32))
+            starts = jnp.nonzero(starts_m, size=rcap, fill_value=m.shape[0])[0]
+            ends = jnp.nonzero(m & ~nxt, size=rcap, fill_value=m.shape[0])[0]
+            head = jnp.stack([cnt, nruns])
+            return jnp.concatenate(
+                [head, starts, ends - starts + 1]
+            ).astype(jnp.int32)
 
         fn = jax.jit(run)
-        _COMPACT_FNS[key] = fn
+        _RUNS_FNS[key] = fn
     return fn
 
 
@@ -263,6 +282,8 @@ class DeviceSegment:
         ) if blocks else np.empty(0, dtype=object)
         self._valid_host = np.ones(n, dtype=bool)
         self.valid = self._pack([self._valid_host], bool, False)
+        # adaptive run capacity: grows on overflow, remembered per segment
+        self._rcap = HIT_CAPACITY0
         # raw f32 coords + ms offsets are only needed by fused aggregations;
         # packed lazily on first density_scan (load_raw)
         self.xf = self.yf = self.t_ms = None
@@ -337,33 +358,46 @@ class DeviceSegment:
             )
         return (self.bxmin, self.bymin, self.bxmax, self.bymax, self.valid, boxes_dev)
 
-    def hit_rows(self, boxes_dev, windows_dev) -> np.ndarray:
-        """Sorted candidate row indices, compacted ON DEVICE.
-
-        Transfer = 4 bytes (count) + 4*capacity; escalates capacity on
-        overflow and degrades to the packed bitmap only when the hit list
-        would be larger than the bitmap itself.
-        """
+    def _mode(self) -> str:
         mode = _mask_mode(self.mesh)
         if mode != "xla" and not self._pallas_ok:
             mode = "xla"  # segment was padded for the XLA granule only
+        return mode
+
+    def remember_rcap(self, nruns: int) -> None:
+        """Adapt the dispatch capacity to observed run counts: grow to 2x
+        the need (pow2), decay gently when queries shrink, and never exceed
+        the packed-bitmap break-even — one fragmented query must not lock
+        later queries into bitmap-sized transfers forever."""
+        cap_hi = HIT_CAPACITY0
+        limit = max(HIT_CAPACITY0, self.n_padded // (2 * DENSE_BITMAP_FACTOR))
+        while cap_hi < limit:
+            cap_hi *= 2
+        want = HIT_CAPACITY0
+        while want < 2 * nruns and want < cap_hi:
+            want *= 2
+        if want > self._rcap:
+            self._rcap = want
+        elif want < self._rcap:
+            self._rcap = max(want, self._rcap // 2)
+
+    def dispatch_hits(self, boxes_dev, windows_dev) -> "_PendingHits":
+        """Start the device scan WITHOUT blocking: the fused RLE buffer
+        begins computing and copying host-ward immediately. Call .rows()
+        on the returned handle to block and decode."""
+        mode = self._mode()
         args = self._mask_args(boxes_dev, windows_dev)
-        cnt_d, idx_d = _compact_fn(self.kind, HIT_CAPACITY0, mode, self.mesh)(*args)
-        cnt = int(cnt_d)
-        if cnt == 0:
-            return np.empty(0, dtype=np.int64)
-        if cnt <= HIT_CAPACITY0:
-            return np.asarray(idx_d)[:cnt].astype(np.int64)
-        if cnt * 4 >= self.n_padded // 8:
-            # dense result: the bitmap is the smaller transfer
-            packed = _packed_fn(self.kind, mode, self.mesh)(*args)
-            mask = np.unpackbits(np.asarray(packed))[: self.n].astype(bool)
-            return np.flatnonzero(mask)
-        cap = HIT_CAPACITY0
-        while cap < cnt:
-            cap *= 2
-        _, idx_d = _compact_fn(self.kind, cap, mode, self.mesh)(*args)
-        return np.asarray(idx_d)[:cnt].astype(np.int64)
+        rcap = self._rcap
+        buf = _runs_fn(self.kind, rcap, mode, self.mesh)(*args)
+        try:
+            buf.copy_to_host_async()
+        except Exception:  # pragma: no cover - transfer started lazily
+            pass
+        return _PendingHits(self, args, rcap, buf)
+
+    def hit_rows(self, boxes_dev, windows_dev) -> np.ndarray:
+        """Sorted candidate row indices, compacted ON DEVICE (sync)."""
+        return self.dispatch_hits(boxes_dev, windows_dev).rows()
 
     def to_block_rows(self, rows: np.ndarray) -> List[Tuple[FeatureBlock, np.ndarray]]:
         """Segment-local candidate rows -> [(block, local rows)]."""
@@ -376,6 +410,69 @@ class DeviceSegment:
             local = rows[which == blk] - starts[blk]
             out.append((self.blocks[int(blk)], local))
         return out
+
+
+class _PendingHits:
+    """A dispatched segment scan: one fused RLE buffer en route to host.
+
+    rows() blocks on the transfer and decodes; run-capacity overflow
+    recomputes at the escalated pow2 capacity (remembered on the segment),
+    and pathologically fragmented dense results degrade to the packed
+    bitmap — the only case where a second round trip is paid.
+    """
+
+    __slots__ = ("seg", "args", "rcap", "buf", "_rows")
+
+    def __init__(self, seg: DeviceSegment, args, rcap: int, buf):
+        self.seg = seg
+        self.args = args
+        self.rcap = rcap
+        self.buf = buf
+        self._rows: Optional[np.ndarray] = None
+
+    def rows(self) -> np.ndarray:
+        if self._rows is None:  # cached: shared pendings resolve once
+            self._rows = self._resolve()
+        return self._rows
+
+    def _resolve(self) -> np.ndarray:
+        seg = self.seg
+        buf = np.asarray(self.buf)
+        cnt, nruns = int(buf[0]), int(buf[1])
+        seg.remember_rcap(nruns)
+        if cnt == 0:
+            return np.empty(0, dtype=np.int64)
+        rcap = self.rcap
+        if nruns > rcap:
+            if nruns > max(1, seg.n_padded // DENSE_BITMAP_FACTOR):
+                # fragmented + dense: the bitmap is the smaller transfer
+                packed = _packed_fn(seg.kind, seg._mode(), seg.mesh)(*self.args)
+                mask = np.unpackbits(np.asarray(packed))[: seg.n].astype(bool)
+                return np.flatnonzero(mask)
+            while rcap < nruns:
+                rcap *= 2
+            buf = np.asarray(_runs_fn(seg.kind, rcap, seg._mode(), seg.mesh)(*self.args))
+        starts = buf[2 : 2 + nruns].astype(np.int64)
+        lens = buf[2 + rcap : 2 + rcap + nruns].astype(np.int64)
+        # expand runs -> sorted row indices
+        out = np.repeat(starts, lens)
+        base = np.concatenate(([0], np.cumsum(lens[:-1])))
+        return out + (np.arange(len(out), dtype=np.int64) - np.repeat(base, lens))
+
+
+class _PendingScan:
+    """All of one table's dispatched segment scans; iterating resolves them
+    in order and maps segment-local rows back to (block, local rows)."""
+
+    __slots__ = ("pending",)
+
+    def __init__(self, pending):
+        self.pending = pending
+
+    def __iter__(self):
+        for seg, ph in self.pending:
+            for block, local in seg.to_block_rows(ph.rows()):
+                yield block, local
 
 
 class DeviceIndex:
@@ -477,13 +574,27 @@ class TpuScanExecutor:
     def _has_visibilities(table: IndexTable) -> bool:
         return any("__vis__" in b.columns for b in table.blocks)
 
-    def scan_candidates(self, table: IndexTable, plan: QueryPlan):
-        """Device candidate scan; None -> caller falls back to host ranges."""
+    def dispatch_candidates(self, table: IndexTable, plan: QueryPlan):
+        """Start the device pre-filter WITHOUT blocking; None -> caller
+        falls back to host ranges. Every segment's fused RLE buffer begins
+        computing/transferring before the first blocking decode, so many
+        dispatches pipeline over the device link and the round-trip latency
+        is paid once per batch, not once per scan (the BatchScanner
+        thread-pool analog, AccumuloQueryPlan.scala:113-140)."""
         if not self.supports(table, plan):
             return None
         if table.index.name in ("z3", "xz3") and not plan.values.bins:
             return None
-        return self._device_scan(table, plan)
+        dev = self.device_index(table)
+        boxes_dev, windows_dev = self._query_descriptor(table, plan)
+        return _PendingScan(
+            [(seg, seg.dispatch_hits(boxes_dev, windows_dev)) for seg in dev.segments]
+        )
+
+    def scan_candidates(self, table: IndexTable, plan: QueryPlan):
+        """Device candidate scan; None -> caller falls back to host ranges."""
+        pending = self.dispatch_candidates(table, plan)
+        return None if pending is None else iter(pending)
 
     def _query_descriptor(self, table: IndexTable, plan: QueryPlan):
         """(boxes, windows) device-replicated arrays for this plan."""
@@ -539,14 +650,6 @@ class TpuScanExecutor:
         boxes_dev = replicate(self.mesh, boxes)
         windows_dev = replicate(self.mesh, windows) if windows is not None else None
         return boxes_dev, windows_dev
-
-    def _device_scan(self, table: IndexTable, plan: QueryPlan):
-        dev = self.device_index(table)
-        boxes_dev, windows_dev = self._query_descriptor(table, plan)
-        for seg in dev.segments:
-            rows = seg.hit_rows(boxes_dev, windows_dev)
-            for block, local in seg.to_block_rows(rows):
-                yield block, local
 
     def post_filter(self, ft, plan: QueryPlan, columns) -> np.ndarray:
         from geomesa_tpu.filter.evaluate import evaluate

@@ -297,6 +297,53 @@ class TpuDataStore:
             self._audit(name, query, plan, result, t_start, t_planned)
         return result
 
+    def query_many(
+        self, name: str, queries: Sequence[Union[str, Query]]
+    ) -> List[QueryResult]:
+        """Execute many queries with PIPELINED device dispatch.
+
+        Phase 1 plans every query and starts its device pre-filters
+        back-to-back with no host synchronization between them; phase 2
+        resolves and post-filters in order. Over a high-latency device link
+        the round-trip cost is paid once per batch instead of once per
+        query — the client-side BatchScanner thread-pool analog
+        (AccumuloQueryPlan.scala:113-140 fans scans across tservers the
+        same way). Results are positionally identical to [query(name, q)
+        for q in queries].
+        """
+        import time as _time
+
+        ft = self.get_schema(name)
+        qs = [self._as_query(q) for q in queries]
+        plan_s: List[float] = []
+        plans = []
+        for q in qs:
+            t0 = _time.perf_counter()
+            plans.append(self._plan_cached(name, q))
+            plan_s.append(_time.perf_counter() - t0)
+        dispatch = getattr(self.executor, "dispatch_candidates", None)
+        pending: Dict[int, object] = {}
+        if dispatch is not None:
+            for q, plan in zip(qs, plans):
+                if "density" in q.hints:
+                    continue  # fused density path dispatches its own compute
+                arms = plan.union if plan.union is not None else [plan]
+                for arm in arms:
+                    if arm.is_empty or id(arm) in pending:
+                        continue
+                    table = self._tables[name][arm.index.name]
+                    pending[id(arm)] = dispatch(table, arm)
+        results = []
+        for q, plan, dt in zip(qs, plans, plan_s):
+            # per-query clock: the timeout budget and audited scan time
+            # cover THIS query's resolve, not the whole batch's
+            t_resolve = _time.perf_counter()
+            result = self._execute(name, ft, q, plan, t_resolve, pending)
+            if self.audit_writer is not None or self.metrics is not None:
+                self._audit(name, q, plan, result, t_resolve - dt, t_resolve)
+            results.append(result)
+        return results
+
     def _audit(self, name, query, plan, result, t_start, t_planned):
         import time as _time
 
@@ -323,7 +370,9 @@ class TpuDataStore:
                 )
             )
 
-    def _execute(self, name, ft, query: Query, plan: QueryPlan, t_scan_start) -> QueryResult:
+    def _execute(
+        self, name, ft, query: Query, plan: QueryPlan, t_scan_start, pending=None
+    ) -> QueryResult:
         if plan.is_empty:
             empty = _empty_columns(ft)
             if has_aggregation(query.hints):
@@ -337,7 +386,7 @@ class TpuDataStore:
             for arm in plan.union:
                 if arm.is_empty:
                     continue
-                parts.extend(self._scan_parts(name, ft, query, arm, t_scan_start))
+                parts.extend(self._scan_parts(name, ft, query, arm, t_scan_start, pending))
             columns = concat_columns(parts) if parts else _empty_columns(ft)
             columns = _dedupe_by_fid(columns)
             return self._finish(ft, query, plan, columns)
@@ -355,7 +404,7 @@ class TpuDataStore:
             if grid is not None:
                 return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})
 
-        parts = self._scan_parts(name, ft, query, plan, t_scan_start)
+        parts = self._scan_parts(name, ft, query, plan, t_scan_start, pending)
         columns = concat_columns(parts) if parts else _empty_columns(ft)
         if plan.index.name in ("xz2", "xz3"):
             # only extent indices can emit multiple rows per feature
@@ -381,13 +430,18 @@ class TpuDataStore:
         ft, columns = apply_projection(ft, query, columns)
         return QueryResult(ft, columns, plan)
 
-    def _scan_parts(self, name, ft, query: Query, plan: QueryPlan, t_scan_start) -> List[Columns]:
+    def _scan_parts(
+        self, name, ft, query: Query, plan: QueryPlan, t_scan_start, pending=None
+    ) -> List[Columns]:
         import time as _time
 
         tables = self._tables[name]
         table = tables[plan.index.name]
         parts: List[Columns] = []
-        scan = self.executor.scan_candidates(table, plan)
+        if pending is not None and id(plan) in pending:
+            scan = pending[id(plan)]  # pre-dispatched (query_many pipeline)
+        else:
+            scan = self.executor.scan_candidates(table, plan)
         device_scan = scan is not None
         if scan is None:
             if plan.ranges:
