@@ -159,6 +159,11 @@ class MetadataBackedStats(GeoMesaStats):
             return None
         return {k: sketches._from_state(v) for k, v in json.loads(raw).items()}
 
+    def has_persisted(self, name: str) -> bool:
+        """True when durable sketches exist — a store replaying persisted
+        blocks must then NOT re-observe them (double-counting)."""
+        return self.metadata is not None and bool(self.metadata.read(name, "stats"))
+
     # -- queries ------------------------------------------------------------
 
     def get_count(self, ft: FeatureType, f: Optional[ast.Filter] = None) -> Optional[float]:

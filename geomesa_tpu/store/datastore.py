@@ -237,10 +237,10 @@ class TpuDataStore:
     def writer(self, name: str, flush_size: Optional[int] = None) -> FeatureWriter:
         return FeatureWriter(self, self.get_schema(name), flush_size or self.flush_size)
 
-    def _insert_columns(self, ft: FeatureType, columns: Columns):
+    def _insert_columns(self, ft: FeatureType, columns: Columns, observe_stats: bool = True):
         for table in self._tables[ft.name].values():
             table.insert(columns)
-        if self.stats is not None:
+        if observe_stats and self.stats is not None:
             self.stats.observe_columns(ft, columns)
 
     def delete_features(self, name: str, fids: Sequence[str]):

@@ -2,20 +2,34 @@
 
 The geomesa-fs analog (SURVEY.md section 2.4, FileSystemDataStore /
 ParquetFileSystemStorage): schemas live in a JSON metadata file, feature
-columns land as one .npz blob per flushed batch, and index tables are rebuilt
-(re-sorted per index) at open. Raw columns are stored once — indexes are
-derived state, mirroring the reference's single-copy partition files rather
-than Accumulo's per-index tables.
+columns land as one columnar blob per flushed batch, and in-memory index
+tables are rebuilt (re-sorted per index) from the blobs. Raw columns are
+stored once — indexes are derived state, mirroring the reference's
+single-copy partition files rather than Accumulo's per-index tables.
+
+Partitioning (PartitionScheme.scala analogs, store/partitions.py): when a
+type has a partition scheme, each write batch is split by partition path
+and lands under ``blocks/<type>/<partition...>/``. With ``lazy=True`` the
+store defers block reads until a query arrives, then loads ONLY the
+partitions whose paths fall under the filter's covering prefixes — the
+partition-pruning read path of the reference's FileSystemDataStore.
+
+Block formats: ``npz`` (default, pickle-friendly) or ``parquet``. Parquet
+blocks carry column statistics, and lazy loading prunes whole files whose
+x/y/time ranges are disjoint from the query — the row-group-statistics
+predicate pushdown of FilterConverter.scala at file granularity.
 
 Layout:
     <root>/metadata.json
-    <root>/blocks/<type>/<seq>.npz
+    <root>/blocks/<type>/[_scheme.json]
+    <root>/blocks/<type>/<partition...>/<seq>.(npz|parquet)
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set, Union
 
 import numpy as np
 
@@ -23,6 +37,9 @@ from geomesa_tpu.schema.featuretype import FeatureType
 from geomesa_tpu.store.blocks import Columns
 from geomesa_tpu.store.datastore import ScanExecutor, TpuDataStore
 from geomesa_tpu.store.metadata import FileMetadata
+from geomesa_tpu.store.partitions import PartitionScheme, from_config, parse_scheme
+
+_EXTS = (".npz", ".parquet")
 
 
 class FsDataStore(TpuDataStore):
@@ -31,8 +48,21 @@ class FsDataStore(TpuDataStore):
         root: str,
         executor: Optional[ScanExecutor] = None,
         flush_size: Optional[int] = None,
+        partition_scheme: Union[str, PartitionScheme, None] = None,
+        lazy: bool = False,
+        block_format: str = "npz",
     ):
+        if block_format not in ("npz", "parquet"):
+            raise ValueError(f"unknown block format: {block_format!r}")
         self._root = root
+        self._lazy = lazy
+        self._format = block_format
+        if isinstance(partition_scheme, str):
+            partition_scheme = parse_scheme(partition_scheme)
+        self._default_scheme = partition_scheme
+        self._schemes: Dict[str, Optional[PartitionScheme]] = {}
+        self._files: Dict[str, List[str]] = {}  # type -> sorted relpaths
+        self._loaded: Dict[str, Set[str]] = {}
         self._loading = True
         os.makedirs(os.path.join(root, "blocks"), exist_ok=True)
         kwargs = {} if flush_size is None else {"flush_size": flush_size}
@@ -41,89 +71,348 @@ class FsDataStore(TpuDataStore):
             executor=executor,
             **kwargs,
         )
-        # schemas were recovered by the base ctor; now replay stored blocks
-        # plus any un-compacted tombstones
+        # schemas were recovered by the base ctor; discover stored blocks
+        # (and load them eagerly unless lazy)
         for name in self.type_names:
-            ft = self.get_schema(name)
-            for path in self._block_files(name):
-                with np.load(path, allow_pickle=True) as data:
-                    cols = {k: data[k] for k in data.files}
-                super()._insert_columns(ft, cols)
-            ts = self._tombstone_file(name)
-            if os.path.exists(ts):
-                with open(ts) as fh:
-                    fids = [line.rstrip("\n") for line in fh if line.rstrip("\n")]
-                if fids:
-                    super().delete_features(name, fids)
+            self._schemes[name] = self._read_scheme(name)
+            self._files[name] = self._discover(name)
+            self._loaded[name] = set()
+            if not lazy:
+                self._ensure_loaded(name, None)
         self._loading = False
+
+    # -- layout --------------------------------------------------------------
 
     def _type_dir(self, name: str) -> str:
         return os.path.join(self._root, "blocks", name)
 
-    def _block_files(self, name: str):
-        d = self._type_dir(name)
-        if not os.path.isdir(d):
-            return []
-        # dot-prefixed names are in-flight temp files (crash leftovers);
-        # only committed 8-digit blocks are replayable
-        return [
-            os.path.join(d, f)
-            for f in sorted(os.listdir(d))
-            if f.endswith(".npz") and not f.startswith(".")
-        ]
+    def _scheme_file(self, name: str) -> str:
+        return os.path.join(self._type_dir(name), "_scheme.json")
 
-    def _insert_columns(self, ft: FeatureType, columns: Columns):
-        super()._insert_columns(ft, columns)
+    def _read_scheme(self, name: str) -> Optional[PartitionScheme]:
+        path = self._scheme_file(name)
+        if os.path.exists(path):
+            with open(path) as fh:
+                return from_config(json.load(fh))
+        return None
+
+    def _discover(self, name: str) -> List[str]:
+        """All committed block files for a type, as sorted relative paths."""
+        root = self._type_dir(name)
+        if not os.path.isdir(root):
+            return []
+        out = []
+        for dirpath, _dirs, files in os.walk(root):
+            rel = os.path.relpath(dirpath, root)
+            for f in files:
+                # dot-prefixed names are in-flight temp files (crash
+                # leftovers); only committed blocks are replayable
+                if f.endswith(_EXTS) and not f.startswith((".", "_")):
+                    out.append(f if rel == "." else os.path.join(rel, f))
+        return sorted(out)
+
+    # -- lazy loading + pruning ---------------------------------------------
+
+    def _covering_files(self, name: str, filt) -> List[str]:
+        files = self._files.get(name, [])
+        scheme = self._schemes.get(name)
+        prefixes = None if scheme is None else scheme.covering(self.get_schema(name), filt)
+        if prefixes is None:
+            return files
+        out = []
+        for rel in files:
+            d = os.path.dirname(rel)
+            if any(d == p or d.startswith(p + "/") for p in prefixes):
+                out.append(rel)
+        return out
+
+    def _ensure_loaded(self, name: str, filt) -> None:
+        if name not in self._files:
+            return
+        loaded = self._loaded.setdefault(name, set())
+        todo = [f for f in self._covering_files(name, filt) if f not in loaded]
+        if not todo:
+            return
+        ft = self.get_schema(name)
+        # persisted sketches are authoritative; re-observing replayed rows
+        # would double-count them (they were observed when first written)
+        observe = self.stats is None or not self.stats.has_persisted(name)
+        was_loading = self._loading
+        self._loading = True  # suppress re-persisting replayed blocks
+        try:
+            for rel in todo:
+                loaded.add(rel)
+                path = os.path.join(self._type_dir(name), rel)
+                if rel.endswith(".parquet") and _parquet_disjoint(path, ft, filt):
+                    # statistics pushdown: the file can't contain matches;
+                    # leave it unloaded so a later, broader query reads it
+                    loaded.discard(rel)
+                    continue
+                cols = _read_block(path, ft)
+                super()._insert_columns(ft, cols, observe_stats=observe)
+            # tombstones may cover rows in just-loaded blocks
+            fids = self._stored_tombstones(name)
+            if fids:
+                super().delete_features(name, fids)
+        finally:
+            self._loading = was_loading
+
+    def _stored_tombstones(self, name: str) -> List[str]:
+        out: List[str] = []
+        # "tombstones.txt" is the pre-partitioning sidecar name; stores
+        # written by older code must not resurrect their deletes
+        for ts in (self._tombstone_file(name),
+                   os.path.join(self._type_dir(name), "tombstones.txt")):
+            if os.path.exists(ts):
+                with open(ts) as fh:
+                    out.extend(line.rstrip("\n") for line in fh if line.rstrip("\n"))
+        return out
+
+    # -- query surface (prune before planning) -------------------------------
+
+    def query(self, name: str, query="INCLUDE"):
+        q = self._as_query(query)
+        self._ensure_loaded(name, q.filter)
+        return super().query(name, q)
+
+    def query_many(self, name: str, queries):
+        qs = [self._as_query(q) for q in queries]
+        for q in qs:
+            self._ensure_loaded(name, q.filter)
+        return super().query_many(name, qs)
+
+    def explain(self, name: str, query) -> str:
+        q = self._as_query(query)
+        self._ensure_loaded(name, q.filter)
+        return super().explain(name, q)
+
+    def count(self, name: str, query=None, exact: bool = True) -> int:
+        if query is not None and exact:
+            # counting through the filter touches only covering partitions;
+            # bare totals and stats estimates need everything loaded
+            self._ensure_loaded(name, self._as_query(query).filter)
+        else:
+            self._ensure_loaded(name, None)
+        return super().count(name, query, exact)
+
+    # -- writes ---------------------------------------------------------------
+
+    def create_schema(self, ft: FeatureType) -> None:
+        if ft.name not in self._schemes and self._default_scheme is not None:
+            # fail fast BEFORE the schema/scheme are durably written
+            self._default_scheme.validate(ft)
+        super().create_schema(ft)
+        if ft.name not in self._files:
+            self._files[ft.name] = []
+            self._loaded[ft.name] = set()
+        if ft.name not in self._schemes:
+            scheme = self._default_scheme
+            self._schemes[ft.name] = scheme
+            if scheme is not None and not self._loading:
+                os.makedirs(self._type_dir(ft.name), exist_ok=True)
+                with open(self._scheme_file(ft.name), "w") as fh:
+                    json.dump(scheme.to_config(), fh)
+
+    def _insert_columns(self, ft: FeatureType, columns: Columns, observe_stats: bool = True):
+        super()._insert_columns(ft, columns, observe_stats)
         if self._loading:
             return
-        d = self._type_dir(ft.name)
+        self._write_partitioned(ft, columns)
+
+    def _write_partitioned(self, ft: FeatureType, columns: Columns) -> None:
+        """Split one column batch by partition and persist each group."""
+        scheme = self._schemes.get(ft.name)
+        if scheme is None:
+            self._write_partition(ft, "", columns)
+            return
+        names = scheme.partition_names(ft, columns)
+        for part in np.unique(names):
+            rows = np.flatnonzero(names == part)
+            sub = {k: v[rows] for k, v in columns.items()}
+            self._write_partition(ft, str(part), sub)
+
+    def _write_partition(self, ft: FeatureType, partition: str, columns: Columns):
+        d = os.path.join(self._type_dir(ft.name), partition) if partition else self._type_dir(ft.name)
         os.makedirs(d, exist_ok=True)
-        seq = len(self._block_files(ft.name))
-        tmp = os.path.join(d, f".{seq:08d}.tmp")
-        np.savez(tmp, **columns)  # savez appends .npz
-        os.replace(tmp + ".npz", os.path.join(d, f"{seq:08d}.npz"))
+        existing = [f for f in os.listdir(d) if f.endswith(_EXTS) and not f.startswith(".")]
+        seq = len(existing)
+        ext = ".parquet" if self._format == "parquet" else ".npz"
+        final = os.path.join(d, f"{seq:08d}{ext}")
+        _write_block(final, ft, columns, self._format)
+        rel = os.path.relpath(final, self._type_dir(ft.name))
+        self._files[ft.name].append(rel)
+        self._loaded[ft.name].add(rel)  # freshly written data is in memory
 
     def _tombstone_file(self, name: str) -> str:
-        return os.path.join(self._type_dir(name), "tombstones.txt")
+        return os.path.join(self._type_dir(name), "_tombstones.txt")
 
     def delete_features(self, name: str, fids: Sequence[str]):
         """Deletes append to a durable tombstone sidecar; the O(data) file
         rewrite is deferred to compact() (one rewrite per cycle, not one
         per delete batch)."""
         super().delete_features(name, fids)
-        d = self._type_dir(name)
-        os.makedirs(d, exist_ok=True)
+        os.makedirs(self._type_dir(name), exist_ok=True)
         with open(self._tombstone_file(name), "a") as fh:
             for fid in fids:
                 fh.write(f"{fid}\n")
 
     def compact(self, name: str):
+        self._ensure_loaded(name, None)
         super().compact(name)
         self._rewrite(name)
-        ts = self._tombstone_file(name)
-        if os.path.exists(ts):
-            os.remove(ts)
+        for ts in (self._tombstone_file(name),
+                   os.path.join(self._type_dir(name), "tombstones.txt")):
+            if os.path.exists(ts):
+                os.remove(ts)
 
     def delete_schema(self, name: str) -> None:
         super().delete_schema(name)
         d = self._type_dir(name)
         if os.path.isdir(d):
-            for f in os.listdir(d):
-                os.remove(os.path.join(d, f))
-            os.rmdir(d)
+            for dirpath, _dirs, files in os.walk(d, topdown=False):
+                for f in files:
+                    os.remove(os.path.join(dirpath, f))
+                os.rmdir(dirpath)
+        self._files.pop(name, None)
+        self._loaded.pop(name, None)
+        self._schemes.pop(name, None)
 
     def _rewrite(self, name: str) -> None:
-        """Persist current (post-delete/compact) state as a single block."""
+        """Persist current (post-delete/compact) state, re-partitioned."""
         from geomesa_tpu.store.blocks import concat_columns, take_rows
 
+        ft = self.get_schema(name)
         table = next(iter(self._tables[name].values()))
         parts = []
         for b, rows in table.scan_all():
             parts.append(take_rows(b.columns, rows))
-        for f in self._block_files(name):
-            os.remove(f)
+        root = self._type_dir(name)
+        for rel in self._files.get(name, []):
+            path = os.path.join(root, rel)
+            if os.path.exists(path):
+                os.remove(path)
+        self._files[name] = []
+        self._loaded[name] = set()
         if parts:
-            merged = concat_columns(parts)
-            d = self._type_dir(name)
-            os.makedirs(d, exist_ok=True)
-            np.savez(os.path.join(d, "00000000.npz"), **merged)
+            self._write_partitioned(ft, concat_columns(parts))
+
+
+# -- block ser/de -------------------------------------------------------------
+
+
+def _geom_attrs(ft: FeatureType) -> Set[str]:
+    return {a.name for a in ft.attributes if a.type.is_geometry}
+
+
+def _write_block(path: str, ft: FeatureType, columns: Columns, fmt: str) -> None:
+    tmp = os.path.join(os.path.dirname(path), "." + os.path.basename(path) + ".tmp")
+    if fmt == "npz":
+        np.savez(tmp, **columns)  # savez appends .npz
+        os.replace(tmp + ".npz", path)
+        return
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from geomesa_tpu.geom.wkt import to_wkt
+
+    geoms = _geom_attrs(ft)
+    arrays, names, objcols = [], [], []
+    for k, v in columns.items():
+        names.append(k)
+        if v.dtype == object:
+            objcols.append(k)
+            if k in geoms:
+                vals = [None if g is None else to_wkt(g) for g in v]
+            else:
+                vals = [None if x is None else x for x in v]
+            arrays.append(pa.array(vals))
+        else:
+            arrays.append(pa.array(v))
+    table = pa.Table.from_arrays(arrays, names=names)
+    table = table.replace_schema_metadata({"geomesa.objcols": json.dumps(objcols)})
+    pq.write_table(table, tmp)
+    os.replace(tmp, path)
+
+
+def _read_block(path: str, ft: FeatureType) -> Columns:
+    if path.endswith(".npz"):
+        with np.load(path, allow_pickle=True) as data:
+            return {k: data[k] for k in data.files}
+    import pyarrow.parquet as pq
+
+    from geomesa_tpu.geom.wkt import parse_wkt
+
+    table = pq.read_table(path)
+    meta = table.schema.metadata or {}
+    objcols = set(json.loads(meta.get(b"geomesa.objcols", b"[]")))
+    geoms = _geom_attrs(ft)
+    out: Columns = {}
+    for k in table.column_names:
+        col = table.column(k)
+        if k in objcols:
+            vals = col.to_pylist()
+            if k in geoms:
+                vals = [None if w is None else parse_wkt(w) for w in vals]
+            out[k] = np.array(vals, dtype=object)
+        else:
+            out[k] = col.to_numpy(zero_copy_only=False)
+    return out
+
+
+def _parquet_disjoint(path: str, ft: FeatureType, filt) -> bool:
+    """File-level statistics pushdown (FilterConverter.scala analog): True
+    when the query's bbox/interval provably excludes every row group."""
+    if filt is None:
+        return False
+    from geomesa_tpu.filter.extract import extract_geometries, extract_intervals
+
+    import pyarrow.parquet as pq
+
+    try:
+        md = pq.ParquetFile(path).metadata
+    except Exception:
+        return False
+    col_range = {}
+    for rg in range(md.num_row_groups):
+        g = md.row_group(rg)
+        for ci in range(g.num_columns):
+            c = g.column(ci)
+            st = c.statistics
+            if st is None or not st.has_min_max:
+                continue
+            name = c.path_in_schema
+            lo, hi = col_range.get(name, (None, None))
+            mn, mx = st.min, st.max
+            col_range[name] = (
+                mn if lo is None or mn < lo else lo,
+                mx if hi is None or mx > hi else hi,
+            )
+
+    geom = ft.default_geometry.name if ft.default_geometry is not None else None
+    if geom is not None and geom + "__x" in col_range and geom + "__y" in col_range:
+        gv = extract_geometries(filt, geom)
+        if gv.values and not gv.disjoint:
+            (xlo, xhi), (ylo, yhi) = col_range[geom + "__x"], col_range[geom + "__y"]
+            hit = False
+            for g in gv.values:
+                env = g.envelope
+                if env.xmax >= xlo and env.xmin <= xhi and env.ymax >= ylo and env.ymin <= yhi:
+                    hit = True
+                    break
+            if not hit:
+                return True
+    dtg = ft.default_date.name if ft.default_date is not None else None
+    if dtg is not None and dtg in col_range:
+        iv = extract_intervals(filt, dtg)
+        if iv is not None and iv.values and not iv.disjoint:
+            lo, hi = col_range[dtg]
+            hit = False
+            for b in iv.values:
+                blo = -np.inf if b.lower.value is None else float(b.lower.value)
+                bhi = np.inf if b.upper.value is None else float(b.upper.value)
+                if bhi >= float(lo) and blo <= float(hi):
+                    hit = True
+                    break
+            if not hit:
+                return True
+    return False

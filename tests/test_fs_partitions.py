@@ -1,0 +1,243 @@
+"""FS partition schemes: vectorized assignment, covering-prefix pruning,
+lazy partition loading, parquet blocks + statistics pushdown.
+
+Mirrors the reference's PartitionSchemeTest.scala (datetime/z2/composite
+name + covering behavior) and the FilterConverter parquet-statistics
+pushdown, at the granularity this store supports (whole files).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.parser import parse_cql
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.fs import FsDataStore
+from geomesa_tpu.store.partitions import (
+    CompositeScheme,
+    DateTimeScheme,
+    Z2Scheme,
+    from_config,
+    parse_scheme,
+)
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+FT = parse_spec("t", SPEC)
+MS = np.datetime64("2026-03-05T13:45:00", "ms").astype(np.int64)
+
+
+def _cols(xs, ys, ts):
+    return {
+        "geom__x": np.asarray(xs, dtype=np.float64),
+        "geom__y": np.asarray(ys, dtype=np.float64),
+        "dtg": np.asarray(ts, dtype=np.int64),
+    }
+
+
+def test_datetime_scheme_names_and_covering():
+    s = DateTimeScheme("daily")
+    names = s.partition_names(FT, _cols([0], [0], [MS]))
+    assert list(names) == ["2026/03/05"]
+    cov = s.covering(FT, parse_cql(
+        "dtg DURING 2026-03-04T00:00:00Z/2026-03-06T23:00:00Z"))
+    assert cov == ["2026/03/04", "2026/03/05", "2026/03/06"]
+    # no time constraint -> no pruning
+    assert s.covering(FT, parse_cql("bbox(geom,0,0,1,1)")) is None
+
+
+def test_datetime_monthly_and_julian():
+    m = DateTimeScheme("monthly")
+    assert list(m.partition_names(FT, _cols([0], [0], [MS]))) == ["2026/03"]
+    cov = m.covering(FT, parse_cql(
+        "dtg DURING 2025-11-15T00:00:00Z/2026-02-01T00:00:00Z"))
+    assert cov == ["2025/11", "2025/12", "2026/01", "2026/02"]
+    j = DateTimeScheme("julian-day")
+    assert list(j.partition_names(FT, _cols([0], [0], [MS]))) == ["2026/064"]
+
+
+def test_z2_scheme_names_and_covering():
+    s = Z2Scheme(bits=4)
+    # quadrant centers: z2 at 2 bits/dim
+    names = s.partition_names(FT, _cols([-90, 90, -90, 90], [-45, 45, 45, -45],
+                                        [MS] * 4))
+    assert len(set(names)) == 4
+    assert all(len(n) == s.digits for n in names)
+    cov = s.covering(FT, parse_cql("bbox(geom, -170, -80, -100, -10)"))
+    assert cov is not None and len(cov) >= 1
+    # the partition holding (-90,-45) must be covered by a box around it
+    target = s.partition_names(FT, _cols([-90], [-45], [MS]))[0]
+    cov2 = s.covering(FT, parse_cql("bbox(geom, -91, -46, -89, -44)"))
+    assert target in cov2
+
+
+def test_composite_scheme_prefix_covering():
+    s = CompositeScheme([DateTimeScheme("daily"), Z2Scheme(bits=2)])
+    names = s.partition_names(FT, _cols([10], [10], [MS]))
+    assert names[0].startswith("2026/03/05/")
+    # time-only filter: z2 child can't prune -> date buckets act as prefixes
+    cov = s.covering(FT, parse_cql(
+        "dtg DURING 2026-03-05T00:00:00Z/2026-03-05T23:00:00Z"))
+    assert cov == ["2026/03/05"]
+    # bbox+time prunes on both levels
+    cov2 = s.covering(FT, parse_cql(
+        "bbox(geom, 5, 5, 15, 15) AND dtg DURING 2026-03-05T00:00:00Z/2026-03-05T23:00:00Z"))
+    assert all(c.startswith("2026/03/05/") for c in cov2)
+
+
+def test_scheme_config_roundtrip_and_parse():
+    for s in (
+        DateTimeScheme("hourly"),
+        Z2Scheme(bits=6),
+        CompositeScheme([DateTimeScheme("daily"), Z2Scheme(bits=4)]),
+        parse_scheme("daily,z2-4bits"),
+    ):
+        s2 = from_config(s.to_config())
+        assert s2.to_config() == s.to_config()
+    assert isinstance(parse_scheme("z2-6bits"), Z2Scheme)
+    assert isinstance(parse_scheme("monthly"), DateTimeScheme)
+
+
+def _write_days(store, n_days=6, per_day=40):
+    rng = np.random.default_rng(9)
+    base = np.datetime64("2026-03-01T00:00:00", "ms").astype(np.int64)
+    with store.writer("t") as w:
+        for d in range(n_days):
+            for i in range(per_day):
+                w.write(
+                    [
+                        f"d{d}",
+                        int(base + d * 86400_000 + int(rng.integers(0, 86400_000))),
+                        Point(float(rng.uniform(-170, 170)), float(rng.uniform(-80, 80))),
+                    ],
+                    fid=f"f{d}-{i}",
+                )
+
+
+@pytest.mark.parametrize("fmt", ["npz", "parquet"])
+def test_partitioned_store_roundtrip(tmp_path, fmt):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root, partition_scheme="daily", block_format=fmt)
+    ds.create_schema(parse_spec("t", SPEC))
+    _write_days(ds)
+    # partition dirs exist on disk
+    days = sorted(os.listdir(os.path.join(root, "blocks", "t", "2026", "03")))
+    assert days == ["01", "02", "03", "04", "05", "06"]
+    q = "dtg DURING 2026-03-02T00:00:00Z/2026-03-03T23:59:59Z"
+    want = sorted(ds.query("t", q).fids)
+    # reopen (eager) and compare
+    ds2 = FsDataStore(root, block_format=fmt)
+    assert sorted(ds2.query("t", q).fids) == want
+    assert ds2.count("t") == 240
+
+
+@pytest.mark.parametrize("fmt", ["npz", "parquet"])
+def test_lazy_loading_reads_only_covering_partitions(tmp_path, fmt):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root, partition_scheme="daily", block_format=fmt)
+    ds.create_schema(parse_spec("t", SPEC))
+    _write_days(ds)
+    want = sorted(
+        ds.query("t", "dtg DURING 2026-03-02T00:00:00Z/2026-03-02T23:00:00Z").fids
+    )
+    lazy = FsDataStore(root, lazy=True, block_format=fmt)
+    assert lazy._loaded["t"] == set()
+    got = sorted(
+        lazy.query("t", "dtg DURING 2026-03-02T00:00:00Z/2026-03-02T23:00:00Z").fids
+    )
+    assert got == want
+    loaded = lazy._loaded["t"]
+    assert loaded and all(rel.startswith("2026/03/02") for rel in loaded)
+    # a broader query loads the rest and still matches the eager store
+    assert sorted(lazy.query("t").fids) == sorted(ds.query("t").fids)
+
+
+def test_lazy_delete_applies_to_late_loaded_partitions(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root, partition_scheme="daily")
+    ds.create_schema(parse_spec("t", SPEC))
+    _write_days(ds)
+    victims = [f"f3-{i}" for i in range(10)]
+    ds.delete_features("t", victims)
+    lazy = FsDataStore(root, lazy=True)
+    # touch only day 1 first, then a query that loads day 3
+    lazy.query("t", "dtg DURING 2026-03-01T00:00:00Z/2026-03-01T23:00:00Z")
+    got = lazy.query("t", "dtg DURING 2026-03-04T00:00:00Z/2026-03-04T23:59:59Z").fids
+    assert not (set(got) & set(victims))
+    assert sorted(lazy.query("t").fids) == sorted(ds.query("t").fids)
+
+
+def test_parquet_stats_pushdown_skips_disjoint_files(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root, block_format="parquet", flush_size=50)
+    ds.create_schema(parse_spec("t", SPEC))
+    # two spatially separated batches -> two files with disjoint x stats
+    base = np.datetime64("2026-03-01T00:00:00", "ms").astype(np.int64)
+    with ds.writer("t") as w:
+        for i in range(50):
+            w.write(["west", int(base + i), Point(-150.0 + i * 0.1, 10.0)], fid=f"w{i}")
+    with ds.writer("t") as w:
+        for i in range(50):
+            w.write(["east", int(base + i), Point(100.0 + i * 0.1, 10.0)], fid=f"e{i}")
+    lazy = FsDataStore(root, lazy=True, block_format="parquet")
+    got = sorted(lazy.query("t", "bbox(geom, 90, 0, 120, 20)").fids)
+    assert got == sorted(f"e{i}" for i in range(50))
+    # west file was stat-pruned: never loaded
+    assert len(lazy._loaded["t"]) == 1
+    # ...but remains reachable for a broader query
+    assert len(lazy.query("t").fids) == 100
+
+
+def test_scheme_validation_fails_fast(tmp_path):
+    # dateless type + datetime scheme
+    ds = FsDataStore(str(tmp_path / "a"), partition_scheme="daily")
+    with pytest.raises(ValueError, match="Date attribute"):
+        ds.create_schema(parse_spec("nodate", "name:String,*geom:Point:srid=4326"))
+    # polygon type + z2 scheme (centroid bucketing would break lazy pruning)
+    ds2 = FsDataStore(str(tmp_path / "b"), partition_scheme="z2-4bits")
+    with pytest.raises(ValueError, match="Point"):
+        ds2.create_schema(parse_spec("poly", "dtg:Date,*geom:Polygon:srid=4326"))
+    # nothing was durably written for the rejected types
+    assert not os.path.exists(str(tmp_path / "a" / "blocks" / "nodate"))
+
+
+def test_reopen_does_not_double_count_stats(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root)
+    ds.create_schema(parse_spec("t", SPEC))
+    _write_days(ds, n_days=2)
+    ds.stats.flush()  # persist sketches
+    before = ds.stats.get_count(ds.get_schema("t"))
+    ds2 = FsDataStore(root)  # replay must not re-observe persisted rows
+    assert ds2.stats.get_count(ds2.get_schema("t")) == before == 80
+
+
+def test_legacy_tombstone_sidecar_still_applies(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root)
+    ds.create_schema(parse_spec("t", SPEC))
+    _write_days(ds, n_days=1)
+    # simulate a store written by the pre-partitioning code
+    with open(os.path.join(root, "blocks", "t", "tombstones.txt"), "w") as fh:
+        fh.write("f0-0\nf0-1\n")
+    ds2 = FsDataStore(root)
+    fids = set(ds2.query("t").fids)
+    assert "f0-0" not in fids and "f0-1" not in fids
+
+
+def test_compact_preserves_partitions(tmp_path):
+    root = str(tmp_path / "store")
+    ds = FsDataStore(root, partition_scheme="daily")
+    ds.create_schema(parse_spec("t", SPEC))
+    _write_days(ds, n_days=3)
+    ds.delete_features("t", [f"f1-{i}" for i in range(20)])
+    ds.compact("t")
+    # tombstone sidecar gone, data rewritten under partition dirs
+    assert not os.path.exists(os.path.join(root, "blocks", "t", "_tombstones.txt"))
+    ds2 = FsDataStore(root)
+    assert ds2.count("t") == 3 * 40 - 20
+    d2 = sorted(ds2.query(
+        "t", "dtg DURING 2026-03-02T00:00:00Z/2026-03-02T23:59:59Z").fids)
+    assert all(f.startswith("f1-") for f in d2)
+    assert len(d2) == 20
