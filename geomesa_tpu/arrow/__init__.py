@@ -12,6 +12,7 @@ dictionary-encoded utf8. Requires pyarrow (present in this environment);
 import of this package is the gate.
 """
 
+from geomesa_tpu.arrow.delta import DeltaWriter, reduce_deltas
 from geomesa_tpu.arrow.vector import (
     SimpleFeatureVector,
     read_features,

@@ -1,0 +1,250 @@
+"""Arrow delta-batch writer + reduce: distributed dictionary building.
+
+The geomesa-arrow DeltaWriter analog (geomesa-arrow-gt io/DeltaWriter.scala
+:1-752): each scan worker emits messages carrying ONLY the dictionary
+values it has not sent before (the "delta") plus a record batch whose
+dictionary fields are already index-encoded against the worker's cumulative
+dictionary. A reduce phase merges all workers' deltas into one global
+sorted dictionary, remaps every batch's indices, sorted-merges the rows,
+and emits a single standard Arrow IPC stream.
+
+TPU-first redesign: the remap and merge are vectorized numpy passes over
+columnar batches (np.searchsorted for the index remap, one stable argsort
+for the global merge) instead of the reference's per-row vector copies and
+k-way priority-queue merge — same wire-level semantics (delta messages,
+threading keys, one sorted dictionary-encoded result stream).
+
+Message framing:  [u32 header_len][header JSON][Arrow IPC stream payload]
+  header: {"key": <writer id>, "deltas": {field: [new values...]},
+           "count": <rows>}
+  payload: the feature schema with each dictionary field as int32 indices.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from geomesa_tpu.arrow.vector import SimpleFeatureVector, _FID
+from geomesa_tpu.schema.featuretype import FeatureType
+
+
+def _sort_batch(columns, field: str, reverse: bool):
+    key = columns[field]
+    order = np.argsort(key, kind="stable")
+    if reverse:
+        order = order[::-1]
+    return {k: v[order] for k, v in columns.items()}
+
+
+class DeltaWriter:
+    """One scan worker's incremental encoder (DeltaWriter.scala:48-200).
+
+    write_batch() returns a self-contained message: dictionary deltas (new
+    values only) + the index-encoded record batch, sorted by ``sort`` within
+    the batch so the reducer can merge streams cheaply.
+    """
+
+    _next_key = 0
+
+    def __init__(
+        self,
+        ft: FeatureType,
+        dictionary_fields: Sequence[str] = (),
+        sort: Optional[Tuple[str, bool]] = None,
+    ):
+        self.ft = ft
+        self.dictionary_fields = list(dictionary_fields)
+        self.sort = sort
+        self.key = DeltaWriter._next_key
+        DeltaWriter._next_key += 1
+        # cumulative per-field dictionary: value -> local index
+        self._dicts: Dict[str, Dict[str, int]] = {f: {} for f in self.dictionary_fields}
+        base = SimpleFeatureVector(ft)
+        fields = []
+        for f in base.schema:
+            if f.name in self._dicts:
+                fields.append(pa.field(f.name, pa.int32(), nullable=True))
+            else:
+                fields.append(f)
+        self.schema = pa.schema(fields)
+        self._vec = base
+
+    def write_batch(self, columns: Dict[str, np.ndarray]) -> bytes:
+        if self.sort is not None:
+            columns = _sort_batch(columns, *self.sort)
+        deltas: Dict[str, List[str]] = {}
+        encoded = dict(columns)
+        for f in self.dictionary_fields:
+            d = self._dicts[f]
+            vals = columns[f]
+            new = sorted({v for v in vals if v is not None and v not in d})
+            for v in new:
+                d[v] = len(d)
+            deltas[f] = new
+            idx = np.array(
+                [-1 if v is None else d[v] for v in vals], dtype=np.int32
+            )
+            encoded[f] = idx
+        batch = self._to_batch(encoded)
+        payload = io.BytesIO()
+        with pa.ipc.new_stream(payload, self.schema) as w:
+            w.write_batch(batch)
+        header = json.dumps(
+            {"key": self.key, "deltas": deltas, "count": len(columns[_FID])}
+        ).encode()
+        return struct.pack("<I", len(header)) + header + payload.getvalue()
+
+    def _to_batch(self, encoded) -> pa.RecordBatch:
+        # non-dictionary columns go through the standard vector; dictionary
+        # fields travel as raw int32 indices (-1 = null)
+        n = len(encoded[_FID])
+        placeholder = {
+            k: (np.full(n, None, dtype=object) if k in self._dicts else v)
+            for k, v in encoded.items()
+        }
+        full = self._vec.to_batch(placeholder)
+        arrays = []
+        for i, f in enumerate(self.schema):
+            if f.name in self._dicts:
+                idx = encoded[f.name]
+                arrays.append(pa.array(idx, type=pa.int32(), mask=idx < 0))
+            else:
+                arrays.append(full.column(i))
+        return pa.RecordBatch.from_arrays(arrays, schema=self.schema)
+
+
+def _decode_message(msg: bytes):
+    (hlen,) = struct.unpack_from("<I", msg, 0)
+    header = json.loads(msg[4 : 4 + hlen].decode())
+    with pa.ipc.open_stream(pa.BufferReader(msg[4 + hlen :])) as r:
+        batches = list(r)
+    return header, batches
+
+
+def reduce_deltas(
+    ft: FeatureType,
+    messages: Iterable[bytes],
+    dictionary_fields: Sequence[str] = (),
+    sort: Optional[Tuple[str, bool]] = None,
+    batch_size: int = 100_000,
+) -> bytes:
+    """Merge delta messages into ONE sorted, dictionary-encoded IPC stream
+    (the reduce phase, DeltaWriter.scala reduce :300-540): global sorted
+    dictionaries, vectorized index remap, stable global sort."""
+    per_writer_dicts: Dict[int, Dict[str, List[str]]] = {}
+    decoded: List[Tuple[int, Dict[str, np.ndarray]]] = []
+    vec = SimpleFeatureVector(ft)
+    for msg in messages:
+        header, batches = _decode_message(msg)
+        key = header["key"]
+        dicts = per_writer_dicts.setdefault(key, {f: [] for f in dictionary_fields})
+        for f in dictionary_fields:
+            dicts[f].extend(header["deltas"].get(f, []))
+        for b in batches:
+            cols: Dict[str, np.ndarray] = {}
+            names = [g.name for g in b.schema]
+            # decode non-dictionary fields through the standard vector,
+            # keep dictionary indices raw for the remap
+            plain = pa.RecordBatch.from_arrays(
+                [
+                    b.column(i)
+                    if names[i] not in dictionary_fields
+                    else pa.nulls(b.num_rows, type=vec.schema.field(names[i]).type)
+                    for i in range(len(names))
+                ],
+                schema=vec.schema,
+            )
+            cols.update(vec.from_batch(plain))
+            for f in dictionary_fields:
+                i = names.index(f)
+                idx = b.column(i).to_numpy(zero_copy_only=False)
+                idx = np.where(np.asarray(b.column(i).is_null()), -1, idx)
+                cols[f] = idx.astype(np.int64)
+            decoded.append((key, cols))
+    if not decoded:
+        # still a VALID (schema-only) IPC stream: clients parse empties
+        out_fields = [
+            pa.field(f.name, pa.dictionary(pa.int32(), pa.utf8()), nullable=True)
+            if f.name in dictionary_fields
+            else f
+            for f in vec.schema
+        ]
+        sink = io.BytesIO()
+        with pa.ipc.new_stream(
+            sink, pa.schema(out_fields, metadata=vec.schema.metadata)
+        ):
+            pass
+        return sink.getvalue()
+
+    # global dictionaries: sorted union of every writer's values
+    global_dicts: Dict[str, np.ndarray] = {}
+    remaps: Dict[Tuple[int, str], np.ndarray] = {}
+    for f in dictionary_fields:
+        values = sorted({v for d in per_writer_dicts.values() for v in d[f]})
+        global_dicts[f] = np.array(values, dtype=object)
+        for key, d in per_writer_dicts.items():
+            local = np.array(d[f], dtype=object)
+            remaps[(key, f)] = (
+                np.searchsorted(global_dicts[f], local).astype(np.int64)
+                if len(local)
+                else np.empty(0, np.int64)
+            )
+
+    # remap per-batch indices to the global dictionary, then concatenate
+    parts: List[Dict[str, np.ndarray]] = []
+    for key, cols in decoded:
+        for f in dictionary_fields:
+            idx = cols[f]
+            remap = remaps[(key, f)]
+            out = np.full(len(idx), -1, dtype=np.int64)
+            valid = idx >= 0
+            out[valid] = remap[idx[valid]]
+            cols[f] = out
+        parts.append(cols)
+    merged: Dict[str, np.ndarray] = {}
+    for k in parts[0]:
+        merged[k] = np.concatenate([p[k] for p in parts])
+    if sort is not None:
+        merged = _sort_batch(merged, *sort)
+
+    # emit a standard dictionary-encoded IPC stream
+    out_fields = []
+    for f in vec.schema:
+        if f.name in dictionary_fields:
+            out_fields.append(
+                pa.field(f.name, pa.dictionary(pa.int32(), pa.utf8()), nullable=True)
+            )
+        else:
+            out_fields.append(f)
+    out_schema = pa.schema(out_fields, metadata=vec.schema.metadata)
+    sink = io.BytesIO()
+    n = len(merged[_FID])
+    with pa.ipc.new_stream(sink, out_schema) as w:
+        for lo in range(0, n, batch_size):
+            sl = {k: v[lo : lo + batch_size] for k, v in merged.items()}
+            arrays = []
+            base = vec.to_batch(
+                {
+                    k: (v if k not in dictionary_fields else np.full(len(sl[_FID]), None, object))
+                    for k, v in sl.items()
+                }
+            )
+            for i, f in enumerate(out_schema):
+                if f.name in dictionary_fields:
+                    idx = sl[f.name]
+                    indices = pa.array(idx.astype(np.int32), mask=idx < 0)
+                    arrays.append(
+                        pa.DictionaryArray.from_arrays(
+                            indices, pa.array(list(global_dicts[f.name]), type=pa.utf8())
+                        )
+                    )
+                else:
+                    arrays.append(base.column(i))
+            w.write_batch(pa.RecordBatch.from_arrays(arrays, schema=out_schema))
+    return sink.getvalue()

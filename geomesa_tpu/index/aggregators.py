@@ -35,11 +35,28 @@ def has_aggregation(hints: Dict[str, Any]) -> bool:
 
 def run_arrow(ft: FeatureType, spec: Dict[str, Any], columns) -> bytes:
     """Arrow IPC stream of the filtered columns (the ArrowScan wire format,
-    index-api iterators/ArrowScan.scala:91+)."""
+    index-api iterators/ArrowScan.scala:91+). Spec options: ``dictionary``
+    (fields to dictionary-encode), ``sort`` ((field, reverse)), ``delta``
+    (emit through the DeltaWriter/reduce pipeline — one sorted,
+    delta-dictionary-merged stream, io/DeltaWriter.scala analog)."""
     import io as _io
 
+    sort = spec.get("sort")
+    if sort is not None:
+        sort = (sort, False) if isinstance(sort, str) else (sort[0], bool(sort[1]))
+    if spec.get("delta"):
+        from geomesa_tpu.arrow.delta import DeltaWriter, reduce_deltas
+
+        fields = list(spec.get("dictionary", ()))
+        writer = DeltaWriter(ft, fields, sort)
+        msgs = [writer.write_batch(columns)] if len(columns.get("__fid__", ())) else []
+        return reduce_deltas(ft, msgs, fields, sort)
     from geomesa_tpu.arrow import write_features
 
+    if sort is not None:
+        from geomesa_tpu.arrow.delta import _sort_batch
+
+        columns = _sort_batch(columns, *sort)
     buf = _io.BytesIO()
     write_features(ft, [columns], buf, dictionary_encode=spec.get("dictionary", ()))
     return buf.getvalue()

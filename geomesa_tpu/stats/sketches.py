@@ -228,18 +228,34 @@ class TopK(Stat):
         self.counts: Dict[Any, int] = {}
 
     def observe(self, values, nulls=None):
+        """Batched space-saving: newcomers enter at (evicted-min + count)
+        like the per-value StreamSummary substitution, but the min scan and
+        truncation run ONCE per batch — O(batch + capacity) instead of the
+        per-value min() that made unique-id columns quadratic. The
+        overestimate-only guarantee (a true heavy hitter can't be displaced
+        by a stream of one-off values) is preserved."""
         values = _clean(np.asarray(values), nulls)
         uniq, cnt = np.unique(values, return_counts=True)
+        newcomers = {}
         for v, c in zip(uniq, cnt):
             v = v.item() if isinstance(v, np.generic) else v
             if v in self.counts:
                 self.counts[v] += int(c)
-            elif len(self.counts) < self.capacity:
-                self.counts[v] = int(c)
-            else:  # evict current min (space-saving substitution)
-                mv = min(self.counts, key=self.counts.get)
-                mc = self.counts.pop(mv)
-                self.counts[v] = mc + int(c)
+            else:
+                newcomers[v] = int(c)
+        if not newcomers:
+            return
+        if len(self.counts) + len(newcomers) <= self.capacity:
+            self.counts.update(newcomers)
+            return
+        import heapq
+
+        baseline = min(self.counts.values()) if self.counts else 0
+        for v, c in newcomers.items():
+            self.counts[v] = c + baseline
+        self.counts = dict(
+            heapq.nlargest(self.capacity, self.counts.items(), key=lambda kv: kv[1])
+        )
 
     def topk(self, k: int = 10) -> List[Tuple[Any, int]]:
         return sorted(self.counts.items(), key=lambda kv: -kv[1])[:k]
@@ -344,9 +360,12 @@ class Frequency(Stat):
         values = _clean(np.asarray(values), nulls)
         if not len(values):
             return
-        idx = self._hashes(values)
+        # hash the uniques only: string hashing is per-value Python, so a
+        # low-cardinality column costs its cardinality, not its length
+        uniq, cnt = np.unique(values, return_counts=True)
+        idx = self._hashes(uniq)
         for d in range(self._DEPTH):
-            np.add.at(self.table[d], idx[d], 1)
+            np.add.at(self.table[d], idx[d], cnt)
 
     def count(self, value) -> int:
         idx = self._hashes(np.asarray([value]))

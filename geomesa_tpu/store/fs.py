@@ -143,7 +143,9 @@ class FsDataStore(TpuDataStore):
             for rel in todo:
                 loaded.add(rel)
                 path = os.path.join(self._type_dir(name), rel)
-                if rel.endswith(".parquet") and _parquet_disjoint(path, ft, filt):
+                if rel.endswith(".parquet") and _parquet_disjoint(
+                    path, ft, filt, *_stat_attrs(ft, self._schemes.get(name))
+                ):
                     # statistics pushdown: the file can't contain matches;
                     # leave it unloaded so a later, broader query reads it
                     loaded.discard(rel)
@@ -188,11 +190,26 @@ class FsDataStore(TpuDataStore):
 
     def count(self, name: str, query=None, exact: bool = True) -> int:
         if query is not None and exact:
-            # counting through the filter touches only covering partitions;
-            # bare totals and stats estimates need everything loaded
+            # counting through the filter touches only covering partitions
             self._ensure_loaded(name, self._as_query(query).filter)
-        else:
-            self._ensure_loaded(name, None)
+            return super().count(name, query, exact)
+        if (
+            query is not None
+            and not exact
+            and self.stats is not None
+            and self.stats.has_persisted(name)
+            and self.metadata.read(name, "geomesa.vis") is None
+        ):
+            # stats estimates answer from persisted sketches — loading
+            # every block to then not read it would defeat lazy=True.
+            # Visibility-bearing types (tracked at write time) still take
+            # the auth-enforcing path below, like the base store.
+            est = self.stats.get_count(
+                self.get_schema(name), self._as_query(query).filter
+            )
+            if est is not None:
+                return int(est)
+        self._ensure_loaded(name, None)
         return super().count(name, query, exact)
 
     # -- writes ---------------------------------------------------------------
@@ -217,6 +234,10 @@ class FsDataStore(TpuDataStore):
         super()._insert_columns(ft, columns, observe_stats)
         if self._loading:
             return
+        if "__vis__" in columns and self.metadata.read(ft.name, "geomesa.vis") is None:
+            # durable marker: count-estimate shortcuts must keep enforcing
+            # visibility even before any block of this type is loaded
+            self.metadata.insert(ft.name, "geomesa.vis", "true")
         self._write_partitioned(ft, columns)
 
     def _write_partitioned(self, ft: FeatureType, columns: Columns) -> None:
@@ -359,14 +380,38 @@ def _read_block(path: str, ft: FeatureType) -> Columns:
     return out
 
 
-def _parquet_disjoint(path: str, ft: FeatureType, filt) -> bool:
+def _stat_attrs(ft: FeatureType, scheme) -> tuple:
+    """(geometry attrs, date attrs) to test statistics against: the type's
+    defaults plus any attribute a partition scheme was configured with —
+    pruning must align with the columns the query actually constrains."""
+    from geomesa_tpu.store.partitions import CompositeScheme, DateTimeScheme, Z2Scheme
+
+    geoms = {ft.default_geometry.name} if ft.default_geometry is not None else set()
+    dtgs = {ft.default_date.name} if ft.default_date is not None else set()
+
+    def walk(s):
+        if isinstance(s, CompositeScheme):
+            for c in s.children:
+                walk(c)
+        elif isinstance(s, DateTimeScheme) and s.dtg is not None:
+            dtgs.add(s.dtg)
+        elif isinstance(s, Z2Scheme) and s.geom is not None:
+            geoms.add(s.geom)
+
+    if scheme is not None:
+        walk(scheme)
+    return sorted(geoms), sorted(dtgs)
+
+
+def _parquet_disjoint(path: str, ft: FeatureType, filt, geoms=(), dtgs=()) -> bool:
     """File-level statistics pushdown (FilterConverter.scala analog): True
-    when the query's bbox/interval provably excludes every row group."""
+    when, for SOME constrained attribute, the query's bbox/interval
+    provably excludes every row group."""
     if filt is None:
         return False
-    from geomesa_tpu.filter.extract import extract_geometries, extract_intervals
-
     import pyarrow.parquet as pq
+
+    from geomesa_tpu.filter.extract import extract_geometries, extract_intervals
 
     try:
         md = pq.ParquetFile(path).metadata
@@ -388,8 +433,9 @@ def _parquet_disjoint(path: str, ft: FeatureType, filt) -> bool:
                 mx if hi is None or mx > hi else hi,
             )
 
-    geom = ft.default_geometry.name if ft.default_geometry is not None else None
-    if geom is not None and geom + "__x" in col_range and geom + "__y" in col_range:
+    for geom in geoms:
+        if geom + "__x" not in col_range or geom + "__y" not in col_range:
+            continue
         gv = extract_geometries(filt, geom)
         if gv.values and not gv.disjoint:
             (xlo, xhi), (ylo, yhi) = col_range[geom + "__x"], col_range[geom + "__y"]
@@ -401,8 +447,9 @@ def _parquet_disjoint(path: str, ft: FeatureType, filt) -> bool:
                     break
             if not hit:
                 return True
-    dtg = ft.default_date.name if ft.default_date is not None else None
-    if dtg is not None and dtg in col_range:
+    for dtg in dtgs:
+        if dtg not in col_range:
+            continue
         iv = extract_intervals(filt, dtg)
         if iv is not None and iv.values and not iv.disjoint:
             lo, hi = col_range[dtg]

@@ -64,24 +64,24 @@ def cmd_describe(args) -> int:
 
 
 def cmd_ingest(args) -> int:
-    from geomesa_tpu.tools.convert import EvaluationContext, SimpleFeatureConverter
+    from geomesa_tpu.tools.ingest import bulk_ingest
+    from geomesa_tpu.tools.premade import PREMADE
 
     ds = _store(args)
-    ft = ds.get_schema(args.name)
-    with open(args.converter) as fh:
-        config = json.load(fh)
-    conv = SimpleFeatureConverter(ft, config)
-    ec = EvaluationContext()
-    written = 0
-    with ds.writer(args.name) as w:
-        for path in args.files:
-            for feature in conv.convert_path(path, ec):
-                w.write_feature(feature)
-                written += 1
-    print(f"ingested {written} features ({ec.failure} failed)")
+    if args.converter in PREMADE:
+        spec, config = PREMADE[args.converter]
+        if args.name not in ds.type_names:
+            from geomesa_tpu.schema.featuretype import parse_spec
+
+            ds.create_schema(parse_spec(args.name, spec))
+    else:
+        with open(args.converter) as fh:
+            config = json.load(fh)
+    ec = bulk_ingest(ds, args.name, args.files, config, workers=args.workers)
+    print(f"ingested {ec.success} features ({ec.failure} failed)")
     for err in ec.errors[:10]:
         print(f"  {err}", file=sys.stderr)
-    return 0 if written or not ec.failure else 1
+    return 0 if ec.success or not ec.failure else 1
 
 
 def cmd_export(args) -> int:
@@ -168,7 +168,11 @@ def build_parser() -> argparse.ArgumentParser:
     add("delete-schema", cmd_delete_schema)
     add("describe", cmd_describe)
     sp = add("ingest", cmd_ingest)
-    sp.add_argument("--converter", required=True, help="converter config (json)")
+    sp.add_argument(
+        "--converter", required=True,
+        help="converter config (json file) or a premade name (e.g. gdelt)",
+    )
+    sp.add_argument("--workers", type=int, default=None, help="parallel converter processes")
     sp.add_argument("files", nargs="+")
     sp = add("export", cmd_export)
     sp.add_argument("--cql", default="INCLUDE")

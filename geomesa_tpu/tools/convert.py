@@ -62,7 +62,9 @@ class _Col(_Expr):
 
     def __call__(self, cols, fields):
         if self.idx == 0:
-            return cols
+            # $0 = the whole record: for delimited rows the delimiter-joined
+            # fields (stable across the row and vectorized ingest paths)
+            return getattr(cols, "raw", cols)
         v = cols[self.idx - 1]
         return v
 
@@ -85,8 +87,39 @@ class _Call(_Expr):
         return self.fn(*[a(cols, fields) for a in self.args])
 
 
+def java_date_format(fmt: str) -> str:
+    """Translate a Java DateTimeFormatter pattern (what reference converter
+    configs use, e.g. 'yyyyMMdd') to a strptime pattern. Patterns already
+    containing '%' pass through untouched."""
+    if "%" in fmt:
+        return fmt
+    out = []
+    i = 0
+    subs = [
+        ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+        ("HH", "%H"), ("mm", "%M"), ("ss", "%S"), ("SSS", "%f"),
+        ("DDD", "%j"),
+    ]
+    while i < len(fmt):
+        if fmt[i] == "'":  # quoted literal, e.g. 'T'
+            j = fmt.index("'", i + 1)
+            out.append(fmt[i + 1 : j])
+            i = j + 1
+            continue
+        for pat, rep in subs:
+            if fmt.startswith(pat, i):
+                out.append(rep)
+                i += len(pat)
+                break
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
 def _fn_date(fmt: str, v: Any) -> int:
-    """Parse to epoch millis. fmt 'ISO' handles ISO-8601; else strptime."""
+    """Parse to epoch millis. fmt 'ISO' handles ISO-8601; else strptime
+    (Java DateTimeFormatter patterns are translated automatically)."""
     if v is None or v == "":
         return None
     s = str(v).strip()
@@ -94,10 +127,19 @@ def _fn_date(fmt: str, v: Any) -> int:
         s2 = s.replace("Z", "+00:00")
         dt = datetime.fromisoformat(s2)
     else:
-        dt = datetime.strptime(s, fmt)
+        dt = datetime.strptime(s, java_date_format(fmt))
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=timezone.utc)
     return int(dt.timestamp() * 1000)
+
+
+def _fn_md5(v) -> Optional[str]:
+    import hashlib
+
+    if v is None:
+        return None
+    raw = v if isinstance(v, (bytes, bytearray)) else str(v).encode()
+    return hashlib.md5(raw).hexdigest()
 
 
 _FUNCTIONS: Dict[str, Callable] = {
@@ -105,18 +147,22 @@ _FUNCTIONS: Dict[str, Callable] = {
     "tolong": lambda v: None if v in (None, "") else int(float(v)),
     "todouble": lambda v: None if v in (None, "") else float(v),
     "tostring": lambda v: None if v is None else str(v),
+    "toboolean": lambda v: None if v in (None, "") else str(v).strip().lower() in ("true", "1", "t", "yes"),
     "trim": lambda v: None if v is None else str(v).strip(),
+    "strlen": lambda v: 0 if v is None else len(str(v)),
     "lowercase": lambda v: None if v is None else str(v).lower(),
     "uppercase": lambda v: None if v is None else str(v).upper(),
     "concat": lambda *a: "".join("" if x is None else str(x) for x in a),
     "date": _fn_date,
     "datetomillis": lambda v: None if v is None else int(v),
     "point": lambda x, y: None if x in (None, "") or y in (None, "") else Point(float(x), float(y)),
-    "geometry": lambda v: None if v in (None, "") else parse_wkt(str(v)),
+    "geometry": lambda v: None if v in (None, "") else (v if not isinstance(v, str) else parse_wkt(v)),
     "uuid": lambda: str(uuidlib.uuid4()),
     "withdefault": lambda v, d: d if v in (None, "") else v,
     "regexreplace": lambda pattern, repl, v: None if v is None else re.sub(pattern, repl, str(v)),
     "substr": lambda v, a, b: None if v is None else str(v)[int(a) : int(b)],
+    "mapvalue": lambda m, k: None if m is None else m.get(k),
+    "md5": _fn_md5,
 }
 
 
@@ -129,7 +175,8 @@ class _Parser:
         r"|(?P<punct>[(),]))"
     )
 
-    def __init__(self, text: str):
+    def __init__(self, text: str, extra: Optional[Dict[str, Callable]] = None):
+        self.extra = extra or {}
         self.tokens = []
         pos = 0
         while pos < len(text):
@@ -173,7 +220,7 @@ class _Parser:
             return _Field(name)
         if t.group("ident"):
             fname = t.group("ident").lower()
-            if fname not in _FUNCTIONS:
+            if fname not in _FUNCTIONS and fname not in self.extra:
                 raise ValueError(f"unknown transform function: {fname}")
             t2 = self._next()
             if t2 is None or t2.group("punct") != "(":
@@ -191,17 +238,24 @@ class _Parser:
                         break
                     if t3.group("punct") != ",":
                         raise ValueError("expected , or )")
-            return _Call(_FUNCTIONS[fname], args, fname)
+            fn = self.extra.get(fname) or _FUNCTIONS[fname]
+            return _Call(fn, args, fname)
         raise ValueError(f"unexpected token {t.group(0)!r}")
 
 
-def parse_transform(text: str) -> _Expr:
-    return _Parser(text).parse()
+def parse_transform(text: str, extra: Optional[Dict[str, Callable]] = None) -> _Expr:
+    return _Parser(text, extra).parse()
 
 
 # ---------------------------------------------------------------------------
 # converters
 # ---------------------------------------------------------------------------
+
+class _Row(list):
+    """A parsed delimited row + its joined raw form (for $0)."""
+
+    __slots__ = ("raw",)
+
 
 class EvaluationContext:
     """Counters + failure collection (geomesa-convert EvaluationContext)."""
@@ -217,6 +271,60 @@ class EvaluationContext:
             self.errors.append(f"line {line}: {err}")
 
 
+def _load_cache(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """Load one enrichment cache (the geomesa-convert EnrichmentCache /
+    redis-cache analog, file-backed): csv-kv maps a key column to a value
+    column; json-kv maps top-level object keys to values."""
+    kind = cfg.get("type", "csv-kv")
+    path = cfg["path"]
+    if kind == "csv-kv":
+        key_col = int(cfg.get("key-col", 1)) - 1
+        val_col = int(cfg.get("value-col", 2)) - 1
+        out: Dict[str, Any] = {}
+        with open(path, newline="") as fh:
+            for row in csv.reader(fh, delimiter=cfg.get("delimiter", ",")):
+                if len(row) > max(key_col, val_col):
+                    out[row[key_col]] = row[val_col]
+        return out
+    if kind == "json-kv":
+        with open(path) as fh:
+            return json.load(fh)
+    raise ValueError(f"unknown cache type: {kind}")
+
+
+def _make_validators(ft: FeatureType, names: Sequence[str]):
+    """SimpleFeatureValidator.scala:27-165 analogs: has-geo, has-dtg,
+    z-index (geometry inside the whole-world bounds + a sane date)."""
+    geom = ft.default_geometry.name if ft.default_geometry is not None else None
+    dtg = ft.default_date.name if ft.default_date is not None else None
+    max_ms = 253402300799999  # 9999-12-31
+
+    def has_geo(fields):
+        if geom is None or fields.get(geom) is None:
+            raise ValueError("validator has-geo: null geometry")
+
+    def has_dtg(fields):
+        if dtg is None or fields.get(dtg) is None:
+            raise ValueError("validator has-dtg: null date")
+
+    def z_index(fields):
+        has_geo(fields)
+        has_dtg(fields)
+        env = fields[geom].envelope
+        if not (-180 <= env.xmin and env.xmax <= 180 and -90 <= env.ymin and env.ymax <= 90):
+            raise ValueError("validator z-index: geometry outside world bounds")
+        if not (0 <= int(fields[dtg]) <= max_ms):
+            raise ValueError("validator z-index: date outside indexable range")
+
+    table = {"has-geo": has_geo, "has-dtg": has_dtg, "z-index": z_index, "index": z_index}
+    out = []
+    for n in names:
+        if n not in table:
+            raise ValueError(f"unknown validator: {n}")
+        out.append(table[n])
+    return out
+
+
 class SimpleFeatureConverter:
     """Config-driven record -> Feature converter."""
 
@@ -224,33 +332,136 @@ class SimpleFeatureConverter:
         self.ft = ft
         self.config = config
         self.kind = config.get("type", "delimited-text")
-        self.id_expr = parse_transform(config["id-field"]) if config.get("id-field") else None
+        self.caches = {
+            name: _load_cache(c) for name, c in config.get("caches", {}).items()
+        }
+        extra = {
+            "cachelookup": lambda cache, key: self.caches.get(cache, {}).get(key)
+        }
+        self.id_expr = (
+            parse_transform(config["id-field"], extra) if config.get("id-field") else None
+        )
         self.fields = [
-            (f["name"], parse_transform(f["transform"]) if f.get("transform") else None,
-             f.get("path"))
+            (f["name"],
+             parse_transform(f["transform"], extra) if f.get("transform") else None,
+             f.get("path"), f)
             for f in config.get("fields", [])
         ]
         self._attr_order = [a.name for a in ft.attributes]
+        self.validators = _make_validators(
+            ft, config.get("options", {}).get("validators", [])
+        )
 
     # -- record iteration per format ----------------------------------------
 
-    def _records(self, fh: io.TextIOBase) -> Iterator[Sequence[Any]]:
+    def _records(self, fh) -> Iterator[Sequence[Any]]:
         if self.kind == "delimited-text":
             fmt = self.config.get("format", "csv").lower()
-            delim = "\t" if fmt in ("tsv", "tdv") else ","
+            delim = "\t" if fmt in ("tsv", "tdv", "tdf") else ","
             skip = int(self.config.get("options", {}).get("skip-lines", 0))
             reader = csv.reader(fh, delimiter=delim)
             for i, row in enumerate(reader):
                 if i < skip or not row:
                     continue
-                yield row
+                rec = _Row(row)
+                rec.raw = delim.join(row)
+                yield rec
         elif self.kind == "json":
             for line in fh:
                 line = line.strip()
                 if line:
                     yield json.loads(line)
+        elif self.kind == "fixed-width":
+            # geomesa-convert-fixedwidth: each field slices [start, start+width)
+            skip = int(self.config.get("options", {}).get("skip-lines", 0))
+            for i, line in enumerate(fh):
+                line = line.rstrip("\n")
+                if i < skip or not line:
+                    continue
+                yield line
+        elif self.kind == "xml":
+            # geomesa-convert-xml XmlConverter: feature-path selects the
+            # repeated element; field paths are relative ElementTree XPaths
+            import xml.etree.ElementTree as ET
+
+            tree = ET.parse(fh)
+            root = tree.getroot()
+            fpath = self.config.get("feature-path")
+            elems = root.iter() if fpath is None else root.findall(fpath)
+            for el in elems:
+                yield el
+        elif self.kind == "avro":
+            # geomesa-convert-avro AvroConverter: records come out as dicts,
+            # field paths address them like json
+            from geomesa_tpu.utils.avro import read_container
+
+            _, records = read_container(fh)
+            yield from records
+        elif self.kind == "osm":
+            yield from self._osm_records(fh)
         else:
             raise ValueError(f"unknown converter type: {self.kind}")
+
+    def _osm_records(self, fh) -> Iterator[Dict[str, Any]]:
+        """geomesa-convert-osm analog: nodes become Points, ways become
+        LineStrings through their node refs (two-pass; the reference shells
+        out to osmosis for the same resolution). Records are dicts:
+        {id, geom, tags{...}, user, timestamp}."""
+        import xml.etree.ElementTree as ET
+
+        from geomesa_tpu.geom.base import LineString
+
+        want = self.config.get("options", {}).get("element", "node")
+        data = fh.read()
+        if isinstance(data, bytes):
+            data = data.decode("utf-8")
+        root = ET.fromstring(data)
+        nodes: Dict[str, tuple] = {}
+        for el in root.iter("node"):
+            nodes[el.get("id")] = (float(el.get("lon")), float(el.get("lat")))
+
+        def tags(el):
+            return {t.get("k"): t.get("v") for t in el.findall("tag")}
+
+        if want == "node":
+            for el in root.iter("node"):
+                x, y = nodes[el.get("id")]
+                yield {
+                    "id": el.get("id"),
+                    "geom": Point(x, y),
+                    "tags": tags(el),
+                    "user": el.get("user"),
+                    "timestamp": el.get("timestamp"),
+                }
+        elif want == "way":
+            for el in root.iter("way"):
+                refs = [nd.get("ref") for nd in el.findall("nd")]
+                coords = [nodes[r] for r in refs if r in nodes]
+                if len(coords) < 2:
+                    continue
+                import numpy as np
+
+                yield {
+                    "id": el.get("id"),
+                    "geom": LineString(np.asarray(coords, dtype=np.float64)),
+                    "tags": tags(el),
+                    "user": el.get("user"),
+                    "timestamp": el.get("timestamp"),
+                }
+        else:
+            raise ValueError(f"osm element must be node or way, got {want!r}")
+
+    @staticmethod
+    def _xml_value(elem, path: str) -> Any:
+        """Relative path into an element: 'a/b' (text), '@attr', 'a/@attr'."""
+        if path.startswith("@"):
+            return elem.get(path[1:])
+        if "/@" in path:
+            sub, attr = path.rsplit("/@", 1)
+            target = elem.find(sub)
+            return None if target is None else target.get(attr)
+        target = elem.find(path)
+        return None if target is None else (target.text or "").strip()
 
     @staticmethod
     def _json_path(obj: Any, path: str) -> Any:
@@ -265,21 +476,28 @@ class SimpleFeatureConverter:
 
     # -- conversion ---------------------------------------------------------
 
-    def convert(
-        self, fh: io.TextIOBase, ec: Optional[EvaluationContext] = None
-    ) -> Iterator[Feature]:
+    def _extract(self, rec, fields, expr, path, cfg):
+        if self.kind == "fixed-width" and "start" in cfg:
+            start = int(cfg["start"])
+            v = rec[start : start + int(cfg["width"])]
+            return expr([v], fields) if expr is not None else v
+        if path is not None:
+            if self.kind == "xml" or (self.kind == "osm" and path.startswith("@")):
+                v = self._xml_value(rec, path) if self.kind == "xml" else rec.get(path[1:])
+            else:
+                v = self._json_path(rec, path)
+            return expr([v], fields) if expr is not None else v
+        return expr(rec, fields) if expr is not None else None
+
+    def convert(self, fh, ec: Optional[EvaluationContext] = None) -> Iterator[Feature]:
         ec = ec if ec is not None else EvaluationContext()
         for lineno, rec in enumerate(self._records(fh), 1):
             try:
                 fields: Dict[str, Any] = {}
-                for name, expr, path in self.fields:
-                    if path is not None:
-                        v = self._json_path(rec, path)
-                        if expr is not None:
-                            v = expr([v], fields)
-                    else:
-                        v = expr(rec, fields) if expr is not None else None
-                    fields[name] = v
+                for name, expr, path, cfg in self.fields:
+                    fields[name] = self._extract(rec, fields, expr, path, cfg)
+                for check in self.validators:
+                    check(fields)
                 values = [fields.get(a) for a in self._attr_order]
                 fid = str(self.id_expr(rec, fields)) if self.id_expr else str(uuidlib.uuid4())
                 yield Feature(self.ft, fid, values)
@@ -288,5 +506,11 @@ class SimpleFeatureConverter:
                 ec.fail(lineno, e)
 
     def convert_path(self, path: str, ec: Optional[EvaluationContext] = None):
-        with open(path, "r", encoding=self.config.get("options", {}).get("encoding", "utf-8")) as fh:
+        mode = "rb" if self.kind == "avro" else "r"
+        kwargs = (
+            {}
+            if mode == "rb"
+            else {"encoding": self.config.get("options", {}).get("encoding", "utf-8")}
+        )
+        with open(path, mode, **kwargs) as fh:
             yield from self.convert(fh, ec)

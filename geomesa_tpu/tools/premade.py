@@ -1,0 +1,61 @@
+"""Premade SFT specs + converter configs (geomesa-tools conf/sfts analog).
+
+GDELT v1 (57-column tab-delimited event records): field/column mapping
+mirrors the reference's shipped config
+(geomesa-tools/conf/sfts/gdelt/reference.conf) translated to this repo's
+JSON converter dialect. The delimited transforms stay inside the
+bulk-ingest fast-path subset, so GDELT files parse through the vectorized
+pyarrow reader (tools/ingest.py) rather than per-row Python.
+"""
+
+from __future__ import annotations
+
+GDELT_SFT = (
+    "globalEventId:String,eventCode:String:index=true,eventBaseCode:String,"
+    "eventRootCode:String,isRootEvent:Integer,"
+    "actor1Name:String:index=true,actor1Code:String,actor1CountryCode:String,"
+    "actor1GroupCode:String,actor1EthnicCode:String,actor1Religion1Code:String,"
+    "actor1Religion2Code:String,actor2Name:String:index=true,actor2Code:String,"
+    "actor2CountryCode:String,actor2GroupCode:String,actor2EthnicCode:String,"
+    "actor2Religion1Code:String,actor2Religion2Code:String,"
+    "quadClass:Integer,goldsteinScale:Double,"
+    "numMentions:Integer,numSources:Integer,numArticles:Integer,avgTone:Double,"
+    "dtg:Date,*geom:Point:srid=4326"
+)
+
+GDELT_CONVERTER = {
+    "type": "delimited-text",
+    "format": "tdf",
+    "id-field": "md5(toString($0))",
+    "fields": [
+        {"name": "globalEventId", "transform": "$1"},
+        {"name": "eventCode", "transform": "$27"},
+        {"name": "eventBaseCode", "transform": "$28"},
+        {"name": "eventRootCode", "transform": "$29"},
+        {"name": "isRootEvent", "transform": "toInt($26)"},
+        {"name": "actor1Name", "transform": "$7"},
+        {"name": "actor1Code", "transform": "$6"},
+        {"name": "actor1CountryCode", "transform": "$8"},
+        {"name": "actor1GroupCode", "transform": "$9"},
+        {"name": "actor1EthnicCode", "transform": "$10"},
+        {"name": "actor1Religion1Code", "transform": "$11"},
+        {"name": "actor1Religion2Code", "transform": "$12"},
+        {"name": "actor2Name", "transform": "$17"},
+        {"name": "actor2Code", "transform": "$16"},
+        {"name": "actor2CountryCode", "transform": "$18"},
+        {"name": "actor2GroupCode", "transform": "$19"},
+        {"name": "actor2EthnicCode", "transform": "$20"},
+        {"name": "actor2Religion1Code", "transform": "$21"},
+        {"name": "actor2Religion2Code", "transform": "$22"},
+        {"name": "quadClass", "transform": "toInt($30)"},
+        {"name": "goldsteinScale", "transform": "toDouble($31)"},
+        {"name": "numMentions", "transform": "toInt($32)"},
+        {"name": "numSources", "transform": "toInt($33)"},
+        {"name": "numArticles", "transform": "toInt($34)"},
+        {"name": "avgTone", "transform": "toDouble($35)"},
+        {"name": "dtg", "transform": "date('yyyyMMdd', $2)"},
+        {"name": "geom", "transform": "point(toDouble($41), toDouble($40))"},
+    ],
+}
+
+PREMADE = {"gdelt": (GDELT_SFT, GDELT_CONVERTER)}

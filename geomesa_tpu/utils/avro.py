@@ -1,0 +1,277 @@
+"""Minimal Avro Object Container File codec (pure Python).
+
+Supports the subset of the Avro 1.x spec the converter and export layers
+need — primitive types, records, arrays, maps, unions, enums, fixed, and
+the null/deflate block codecs — replacing the reference's dependency on the
+Java Avro library (geomesa-convert-avro AvroConverter, geomesa-features
+AvroFeatureSerializer). Schemas are plain JSON per the spec.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, BinaryIO, Dict, Iterator, List, Optional, Tuple, Union
+
+MAGIC = b"Obj\x01"
+
+
+# -- zigzag varint ------------------------------------------------------------
+
+
+def _read_long(fh: BinaryIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = fh.read(1)
+        if not b:
+            raise EOFError("truncated avro varint")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)
+
+
+def _write_long(out: BinaryIO, value: int) -> None:
+    n = (value << 1) ^ (value >> 63)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            break
+
+
+# -- datum reader/writer ------------------------------------------------------
+
+
+def _read_datum(fh: BinaryIO, schema: Any) -> Any:
+    if isinstance(schema, str):
+        kind = schema
+    elif isinstance(schema, list):  # union: long index then datum
+        idx = _read_long(fh)
+        return _read_datum(fh, schema[idx])
+    else:
+        kind = schema["type"]
+    if kind == "null":
+        return None
+    if kind == "boolean":
+        return fh.read(1) == b"\x01"
+    if kind in ("int", "long"):
+        return _read_long(fh)
+    if kind == "float":
+        return struct.unpack("<f", fh.read(4))[0]
+    if kind == "double":
+        return struct.unpack("<d", fh.read(8))[0]
+    if kind == "bytes":
+        return fh.read(_read_long(fh))
+    if kind == "string":
+        return fh.read(_read_long(fh)).decode("utf-8")
+    if kind == "record":
+        return {f["name"]: _read_datum(fh, f["type"]) for f in schema["fields"]}
+    if kind == "enum":
+        return schema["symbols"][_read_long(fh)]
+    if kind == "fixed":
+        return fh.read(schema["size"])
+    if kind == "array":
+        out: List[Any] = []
+        while True:
+            n = _read_long(fh)
+            if n == 0:
+                break
+            if n < 0:  # block with byte size
+                _read_long(fh)
+                n = -n
+            for _ in range(n):
+                out.append(_read_datum(fh, schema["items"]))
+        return out
+    if kind == "map":
+        m: Dict[str, Any] = {}
+        while True:
+            n = _read_long(fh)
+            if n == 0:
+                break
+            if n < 0:
+                _read_long(fh)
+                n = -n
+            for _ in range(n):
+                k = fh.read(_read_long(fh)).decode("utf-8")
+                m[k] = _read_datum(fh, schema["values"])
+        return m
+    raise ValueError(f"unsupported avro type: {kind!r}")
+
+
+def _write_datum(out: BinaryIO, schema: Any, value: Any) -> None:
+    if isinstance(schema, list):  # union: pick the first matching branch
+        for i, branch in enumerate(schema):
+            if _matches(branch, value):
+                _write_long(out, i)
+                _write_datum(out, branch, value)
+                return
+        raise ValueError(f"value {value!r} matches no union branch {schema}")
+    kind = schema if isinstance(schema, str) else schema["type"]
+    if kind == "null":
+        return
+    if kind == "boolean":
+        out.write(b"\x01" if value else b"\x00")
+    elif kind in ("int", "long"):
+        _write_long(out, int(value))
+    elif kind == "float":
+        out.write(struct.pack("<f", float(value)))
+    elif kind == "double":
+        out.write(struct.pack("<d", float(value)))
+    elif kind == "bytes":
+        _write_long(out, len(value))
+        out.write(value)
+    elif kind == "string":
+        raw = str(value).encode("utf-8")
+        _write_long(out, len(raw))
+        out.write(raw)
+    elif kind == "record":
+        for f in schema["fields"]:
+            _write_datum(out, f["type"], value.get(f["name"]))
+    elif kind == "enum":
+        _write_long(out, schema["symbols"].index(value))
+    elif kind == "fixed":
+        out.write(value)
+    elif kind == "array":
+        if value:
+            _write_long(out, len(value))
+            for v in value:
+                _write_datum(out, schema["items"], v)
+        _write_long(out, 0)
+    elif kind == "map":
+        if value:
+            _write_long(out, len(value))
+            for k, v in value.items():
+                raw = str(k).encode("utf-8")
+                _write_long(out, len(raw))
+                out.write(raw)
+                _write_datum(out, schema["values"], v)
+        _write_long(out, 0)
+    else:
+        raise ValueError(f"unsupported avro type: {kind!r}")
+
+
+def _matches(schema: Any, value: Any) -> bool:
+    kind = schema if isinstance(schema, str) else schema["type"]
+    if kind == "null":
+        return value is None
+    if value is None:
+        return False
+    if kind == "boolean":
+        return isinstance(value, bool)
+    if kind in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if kind in ("float", "double"):
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if kind == "string":
+        return isinstance(value, str)
+    if kind in ("bytes", "fixed"):
+        return isinstance(value, (bytes, bytearray))
+    if kind == "record":
+        return isinstance(value, dict)
+    if kind == "array":
+        return isinstance(value, (list, tuple))
+    if kind == "map":
+        return isinstance(value, dict)
+    if kind == "enum":
+        return isinstance(value, str)
+    return False
+
+
+# -- object container files ---------------------------------------------------
+
+
+def read_container(source: Union[str, BinaryIO]) -> Tuple[Any, Iterator[Any]]:
+    """(schema, record iterator) from an Avro OCF (null/deflate codecs)."""
+    fh = open(source, "rb") if isinstance(source, str) else source
+
+    if fh.read(4) != MAGIC:
+        raise ValueError("not an avro object container file")
+    meta = _read_datum(fh, {"type": "map", "values": "bytes"})
+    schema = json.loads(meta[b"avro.schema"] if b"avro.schema" in meta else meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null")
+    codec = codec.decode() if isinstance(codec, (bytes, bytearray)) else codec
+    sync = fh.read(16)
+
+    def records() -> Iterator[Any]:
+        try:
+            while True:
+                try:
+                    count = _read_long(fh)
+                except EOFError:
+                    return
+                size = _read_long(fh)
+                block = fh.read(size)
+                if codec == "deflate":
+                    block = zlib.decompress(block, -15)
+                elif codec != "null":
+                    raise ValueError(f"unsupported avro codec: {codec}")
+                bio = io.BytesIO(block)
+                for _ in range(count):
+                    yield _read_datum(bio, schema)
+                if fh.read(16) != sync:
+                    raise ValueError("avro sync marker mismatch")
+        finally:
+            if isinstance(source, str):
+                fh.close()
+
+    return schema, records()
+
+
+def write_container(
+    sink: Union[str, BinaryIO],
+    schema: Any,
+    records: Iterator[Any],
+    codec: str = "null",
+    block_size: int = 1000,
+) -> int:
+    """Write records as an Avro OCF; returns the record count."""
+    fh = open(sink, "wb") if isinstance(sink, str) else sink
+    try:
+        fh.write(MAGIC)
+        meta = {
+            "avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode(),
+        }
+        _write_datum(fh, {"type": "map", "values": "bytes"}, meta)
+        sync = os.urandom(16)
+        fh.write(sync)
+        total = 0
+        buf: List[Any] = []
+
+        def flush():
+            nonlocal total
+            if not buf:
+                return
+            bio = io.BytesIO()
+            for r in buf:
+                _write_datum(bio, schema, r)
+            payload = bio.getvalue()
+            if codec == "deflate":
+                co = zlib.compressobj(wbits=-15)
+                payload = co.compress(payload) + co.flush()
+            _write_long(fh, len(buf))
+            _write_long(fh, len(payload))
+            fh.write(payload)
+            fh.write(sync)
+            total += len(buf)
+            buf.clear()
+
+        for r in records:
+            buf.append(r)
+            if len(buf) >= block_size:
+                flush()
+        flush()
+        return total
+    finally:
+        if isinstance(sink, str):
+            fh.close()

@@ -1,0 +1,159 @@
+"""Bulk ingest: vectorized delimited fast path vs row converter parity,
+multiprocess fan-out, premade GDELT config end-to-end."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.tools.ingest import _FastPlan, _Unsupported, bulk_ingest
+from geomesa_tpu.tools.premade import GDELT_CONVERTER, GDELT_SFT
+
+
+def _gdelt_row(i: int) -> str:
+    cols = [""] * 57
+    cols[0] = str(100000 + i)
+    cols[1] = f"2026{1 + i % 3:02d}{1 + i % 27:02d}"
+    cols[5] = f"A1C{i % 4}"
+    cols[6] = f"ACTOR{i % 5}"
+    cols[25] = str(i % 2)
+    cols[26] = "043"
+    cols[27] = "043"
+    cols[28] = "04"
+    cols[29] = str(i % 4)
+    cols[30] = f"{(i % 20) - 10}.5"
+    cols[31] = str(i % 9)
+    cols[32] = "1"
+    cols[33] = str(i % 7)
+    cols[34] = f"{(i % 11) - 5}.25"
+    cols[39] = f"{(i % 140) - 70}.5"  # lat
+    cols[40] = f"{(i % 340) - 170}.25"  # lon
+    return "\t".join(cols)
+
+
+@pytest.fixture()
+def gdelt_files(tmp_path):
+    paths = []
+    for part in range(3):
+        p = tmp_path / f"gdelt_{part}.tsv"
+        p.write_text("\n".join(_gdelt_row(part * 40 + i) for i in range(40)) + "\n")
+        paths.append(str(p))
+    return paths
+
+
+def test_gdelt_fast_path_compiles():
+    ft = parse_spec("gdelt", GDELT_SFT)
+    plan = _FastPlan(ft, GDELT_CONVERTER)  # must not raise _Unsupported
+    assert plan.max_col == 41
+    assert plan.id_op == ("md5row",)
+
+
+def test_fast_path_matches_row_converter(gdelt_files):
+    ft_spec = GDELT_SFT
+    fast = TpuDataStore()
+    fast.create_schema(parse_spec("gdelt", ft_spec))
+    bulk_ingest(fast, "gdelt", gdelt_files, GDELT_CONVERTER, workers=1)
+
+    # force the row-at-a-time converter by adding an unsupported transform
+    slow_cfg = dict(GDELT_CONVERTER)
+    slow_cfg["fields"] = [dict(f) for f in GDELT_CONVERTER["fields"]]
+    slow_cfg["fields"][0]["transform"] = "trim(concat($1, ''))"
+    with pytest.raises(_Unsupported):
+        _FastPlan(parse_spec("g2", ft_spec), slow_cfg)
+    slow = TpuDataStore()
+    slow.create_schema(parse_spec("gdelt", ft_spec))
+    bulk_ingest(slow, "gdelt", gdelt_files, slow_cfg, workers=1)
+
+    q = "bbox(geom, -90, -50, 90, 50) AND dtg DURING 2026-01-01T00:00:00Z/2026-02-28T00:00:00Z"
+    got = fast.query("gdelt", q)
+    want = slow.query("gdelt", q)
+    assert len(got.fids) == len(want.fids) > 0
+    # same rows by event id (fids are md5s of the whole record in both paths)
+    assert sorted(got.fids) == sorted(want.fids)
+    g = {f: v for f, v in zip(got.fids, got.columns["actor1Name"])}
+    s = {f: v for f, v in zip(want.fids, want.columns["actor1Name"])}
+    assert g == s
+
+
+def test_multiprocess_ingest_matches_serial(gdelt_files):
+    a = TpuDataStore()
+    a.create_schema(parse_spec("gdelt", GDELT_SFT))
+    bulk_ingest(a, "gdelt", gdelt_files, GDELT_CONVERTER, workers=1)
+    b = TpuDataStore()
+    b.create_schema(parse_spec("gdelt", GDELT_SFT))
+    ec = bulk_ingest(b, "gdelt", gdelt_files, GDELT_CONVERTER, workers=2)
+    assert ec.failure == 0 and ec.success == 120
+    assert sorted(a.query("gdelt").fids) == sorted(b.query("gdelt").fids)
+
+
+def test_fast_and_slow_paths_produce_identical_fids(tmp_path):
+    """md5($0) fids must not depend on which parse path ran — arrow type
+    inference re-rendering untyped columns would break re-ingest identity."""
+    p = tmp_path / "vals.tsv"
+    row = [""] * 57
+    row[0] = "1"
+    row[1] = "20260101"
+    row[39] = "10.50"  # trailing zero: inference would render 10.5
+    row[40] = "20.25"
+    row[43] = "1.50"
+    row[44] = "20200101"  # date-looking untyped column
+    p.write_text("\t".join(row) + "\n")
+    fast = TpuDataStore()
+    fast.create_schema(parse_spec("gdelt", GDELT_SFT))
+    bulk_ingest(fast, "gdelt", [str(p)], GDELT_CONVERTER, workers=1)
+    import io
+
+    from geomesa_tpu.tools.convert import SimpleFeatureConverter
+
+    conv = SimpleFeatureConverter(parse_spec("gdelt", GDELT_SFT), GDELT_CONVERTER)
+    feats = list(conv.convert(io.StringIO("\t".join(row) + "\n")))
+    assert list(fast.query("gdelt").fids) == [feats[0].fid]
+
+
+def test_ragged_rows_fall_back_to_row_converter(tmp_path, gdelt_files):
+    """A malformed row must not abort the whole ingest."""
+    dirty = tmp_path / "dirty.tsv"
+    good = _gdelt_row(1)
+    dirty.write_text(good + "\nshort\trow\n" + _gdelt_row(2) + "\n")
+    ds = TpuDataStore()
+    ds.create_schema(parse_spec("gdelt", GDELT_SFT))
+    ec = bulk_ingest(ds, "gdelt", [str(dirty)], GDELT_CONVERTER, workers=1)
+    assert ec.success == 2 and ec.failure == 1
+    assert len(ds.query("gdelt").fids) == 2
+
+
+def test_null_dates_masked_in_fast_path(tmp_path):
+    cfg = {
+        "type": "delimited-text",
+        "format": "csv",
+        "id-field": "$1",
+        "fields": [
+            {"name": "name", "transform": "$1"},
+            # non-yyyyMMdd format exercises the strptime fallback
+            {"name": "dtg", "transform": "date('yyyy-MM-dd HH:mm:ss', $2)"},
+            {"name": "geom", "transform": "point(toDouble($3), toDouble($4))"},
+        ],
+    }
+    p = tmp_path / "d.csv"
+    p.write_text("a,2026-01-02 03:04:05,1.0,2.0\nb,,3.0,4.0\n")
+    ft = parse_spec("t", "name:String,dtg:Date,*geom:Point:srid=4326")
+    ds = TpuDataStore()
+    ds.create_schema(ft)
+    ec = bulk_ingest(ds, "t", [str(p)], cfg, workers=1)
+    assert ec.success == 2
+    res = ds.query("t", "dtg DURING 2026-01-01T00:00:00Z/2026-01-03T00:00:00Z")
+    assert list(res.fids) == ["a"]  # the null-date row must NOT appear at 1970
+
+
+def test_cli_premade_gdelt(tmp_path, gdelt_files, capsys):
+    from geomesa_tpu.tools.cli import main
+
+    root = str(tmp_path / "store")
+    rc = main(
+        ["ingest", "--store", root, "--name", "gdelt", "--converter", "gdelt"]
+        + gdelt_files
+    )
+    assert rc == 0
+    assert "ingested 120 features" in capsys.readouterr().out
+    rc = main(["describe", "--store", root, "--name", "gdelt"])
+    assert rc == 0

@@ -1,0 +1,208 @@
+"""Converter formats beyond delimited/json: fixed-width, XML, Avro, OSM,
+plus validators and enrichment caches (geomesa-convert-{fixedwidth,xml,
+avro,osm} + SimpleFeatureValidator + EnrichmentCache analogs)."""
+
+import io
+import textwrap
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.tools.convert import EvaluationContext, SimpleFeatureConverter
+from geomesa_tpu.utils.avro import read_container, write_container
+
+FT = parse_spec("t", "name:String,age:Int,dtg:Date,*geom:Point:srid=4326")
+
+
+def test_fixed_width_converter():
+    conv = SimpleFeatureConverter(
+        FT,
+        {
+            "type": "fixed-width",
+            "id-field": "trim($name)",
+            "fields": [
+                {"name": "name", "start": 0, "width": 6, "transform": "trim($1)"},
+                {"name": "age", "start": 6, "width": 3, "transform": "toInt(trim($1))"},
+                {"name": "lon", "start": 9, "width": 7, "transform": "toDouble(trim($1))"},
+                {"name": "lat", "start": 16, "width": 6, "transform": "toDouble(trim($1))"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        },
+    )
+    # columns: name[0:6] age[6:9] lon[9:16] lat[16:22]
+    data = "alice  42-77.000 38.90\nbob    17 116.40 39.90\n"
+    feats = list(conv.convert(io.StringIO(data)))
+    assert [f.fid for f in feats] == ["alice", "bob"]
+    assert feats[0].values[1] == 42
+    assert feats[1].values[3].x == pytest.approx(116.4)
+
+
+def test_xml_converter():
+    xml = textwrap.dedent(
+        """\
+        <people>
+          <person id="p1"><name>ann</name><age>30</age>
+            <loc><lon>1.5</lon><lat>2.5</lat></loc></person>
+          <person id="p2"><name>bo</name><age>40</age>
+            <loc><lon>3.5</lon><lat>4.5</lat></loc></person>
+        </people>
+        """
+    )
+    conv = SimpleFeatureConverter(
+        FT,
+        {
+            "type": "xml",
+            "feature-path": "person",
+            "id-field": "$name",
+            "fields": [
+                {"name": "pid", "path": "@id"},
+                {"name": "name", "path": "name"},
+                {"name": "age", "path": "age", "transform": "toInt($1)"},
+                {"name": "lon", "path": "loc/lon", "transform": "toDouble($1)"},
+                {"name": "lat", "path": "loc/lat", "transform": "toDouble($1)"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        },
+    )
+    feats = list(conv.convert(io.StringIO(xml)))
+    assert [f.fid for f in feats] == ["ann", "bo"]
+    assert feats[1].values[3].y == pytest.approx(4.5)
+
+
+def test_avro_roundtrip_and_converter(tmp_path):
+    schema = {
+        "type": "record",
+        "name": "Obs",
+        "fields": [
+            {"name": "who", "type": "string"},
+            {"name": "age", "type": ["null", "int"]},
+            {"name": "lon", "type": "double"},
+            {"name": "lat", "type": "double"},
+            {"name": "tags", "type": {"type": "map", "values": "string"}},
+        ],
+    }
+    rows = [
+        {"who": "ann", "age": 30, "lon": 1.0, "lat": 2.0, "tags": {"a": "x"}},
+        {"who": "bo", "age": None, "lon": 3.0, "lat": 4.0, "tags": {}},
+    ]
+    path = str(tmp_path / "obs.avro")
+    assert write_container(path, schema, iter(rows), codec="deflate") == 2
+    schema2, records = read_container(path)
+    assert list(records) == rows
+
+    conv = SimpleFeatureConverter(
+        FT,
+        {
+            "type": "avro",
+            "id-field": "$name",
+            "fields": [
+                {"name": "name", "path": "$.who"},
+                {"name": "age", "path": "$.age"},
+                {"name": "lon", "path": "$.lon"},
+                {"name": "lat", "path": "$.lat"},
+                {"name": "geom", "transform": "point($lon, $lat)"},
+            ],
+        },
+    )
+    feats = list(conv.convert_path(path))
+    assert [f.fid for f in feats] == ["ann", "bo"]
+    assert feats[1].values[1] is None
+
+
+OSM = textwrap.dedent(
+    """\
+    <osm version="0.6">
+      <node id="1" lat="10.0" lon="20.0" user="u1">
+        <tag k="amenity" v="cafe"/><tag k="name" v="Kafe"/></node>
+      <node id="2" lat="11.0" lon="21.0" user="u1"/>
+      <node id="3" lat="12.0" lon="22.0" user="u2"/>
+      <way id="9" user="u2">
+        <nd ref="1"/><nd ref="2"/><nd ref="3"/>
+        <tag k="highway" v="residential"/></way>
+    </osm>
+    """
+)
+
+
+def test_osm_nodes_and_ways():
+    node_conv = SimpleFeatureConverter(
+        FT,
+        {
+            "type": "osm",
+            "options": {"element": "node"},
+            "id-field": "$pid",
+            "fields": [
+                {"name": "pid", "path": "$.id"},
+                {"name": "name", "path": "$.tags.name"},
+                {"name": "geom", "path": "$.geom", "transform": "geometry($1)"},
+            ],
+        },
+    )
+    feats = list(node_conv.convert(io.StringIO(OSM)))
+    assert len(feats) == 3
+    assert feats[0].values[0] == "Kafe"
+    assert feats[0].values[3].x == pytest.approx(20.0)
+
+    way_ft = parse_spec("w", "kind:String,*geom:LineString:srid=4326")
+    way_conv = SimpleFeatureConverter(
+        way_ft,
+        {
+            "type": "osm",
+            "options": {"element": "way"},
+            "id-field": "$pid",
+            "fields": [
+                {"name": "pid", "path": "$.id"},
+                {"name": "kind", "path": "$.tags.highway"},
+                {"name": "geom", "path": "$.geom", "transform": "geometry($1)"},
+            ],
+        },
+    )
+    ways = list(way_conv.convert(io.StringIO(OSM)))
+    assert len(ways) == 1
+    assert ways[0].values[0] == "residential"
+    assert ways[0].values[1].coords.shape == (3, 2)
+
+
+def test_validators_reject_bad_rows():
+    conv = SimpleFeatureConverter(
+        FT,
+        {
+            "type": "delimited-text",
+            "options": {"validators": ["z-index"]},
+            "id-field": "$1",
+            "fields": [
+                {"name": "name", "transform": "$1"},
+                {"name": "dtg", "transform": "date('ISO', $2)"},
+                {"name": "geom", "transform": "point(toDouble($3), toDouble($4))"},
+            ],
+        },
+    )
+    rows = (
+        "ok,2026-01-01T00:00:00Z,10.0,20.0\n"
+        "badgeo,2026-01-01T00:00:00Z,400.0,20.0\n"  # out of bounds
+        "nodate,,10.0,20.0\n"
+    )
+    ec = EvaluationContext()
+    feats = list(conv.convert(io.StringIO(rows), ec))
+    assert [f.fid for f in feats] == ["ok"]
+    assert ec.success == 1 and ec.failure == 2
+
+
+def test_enrichment_cache_lookup(tmp_path):
+    lookup = tmp_path / "codes.csv"
+    lookup.write_text("US,United States\nFR,France\n")
+    conv = SimpleFeatureConverter(
+        FT,
+        {
+            "type": "delimited-text",
+            "caches": {"codes": {"type": "csv-kv", "path": str(lookup)}},
+            "id-field": "$1",
+            "fields": [
+                {"name": "name", "transform": "cacheLookup('codes', $1)"},
+                {"name": "geom", "transform": "point(toDouble($2), toDouble($3))"},
+            ],
+        },
+    )
+    feats = list(conv.convert(io.StringIO("FR,1.0,2.0\nUS,3.0,4.0\nXX,5.0,6.0\n")))
+    assert [f.values[0] for f in feats] == ["France", "United States", None]

@@ -1,0 +1,49 @@
+"""Sketch guarantees after the batched-observe optimizations."""
+
+import numpy as np
+import pyarrow as pa
+
+from geomesa_tpu.stats.sketches import Frequency, TopK
+
+
+def test_topk_heavy_hitter_survives_one_off_stream():
+    """Space-saving guarantee: a value with true count > N/capacity must be
+    in the summary, with an overestimated (never undercounted) count —
+    even when every batch floods the summary with one-off values."""
+    t = TopK("a", capacity=4)
+    true_hot = 0
+    n = 0
+    for batch in range(50):
+        vals = ["hot"] * 10 + [f"u{batch}_{i}" for i in range(6)]
+        true_hot += 10
+        n += len(vals)
+        t.observe(np.array(vals, dtype=object))
+    assert true_hot > n / 4  # hot IS a heavy hitter for this stream
+    top = dict(t.topk(4))
+    assert "hot" in top
+    assert top["hot"] >= true_hot  # overestimate-only, never an undercount
+
+
+def test_frequency_unique_batching_counts_match():
+    f1 = Frequency("a", width=256)
+    f2 = Frequency("a", width=256)
+    vals = np.array(["x"] * 500 + ["y"] * 30 + ["z"] * 3, dtype=object)
+    f1.observe(vals)
+    for v in vals:  # one-at-a-time == batched
+        f2.observe(np.array([v], dtype=object))
+    for v in ("x", "y", "z"):
+        assert f1.count(v) == f2.count(v)
+    assert f1.count("x") >= 500
+
+
+def test_empty_delta_reduce_is_valid_ipc():
+    from geomesa_tpu.arrow import read_features, reduce_deltas
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    ft = parse_spec("t", "name:String,dtg:Date,*geom:Point:srid=4326")
+    stream = reduce_deltas(ft, [], ["name"])
+    with pa.ipc.open_stream(pa.BufferReader(stream)) as r:
+        assert pa.types.is_dictionary(r.schema.field("name").type)
+        assert list(r) == []
+    ft2, cols = read_features(pa.BufferReader(stream))
+    assert cols == {} or len(cols.get("__fid__", [])) == 0
