@@ -50,19 +50,20 @@ class DeltaWriter:
     the batch so the reducer can merge streams cheaply.
     """
 
-    _next_key = 0
-
     def __init__(
         self,
         ft: FeatureType,
         dictionary_fields: Sequence[str] = (),
         sort: Optional[Tuple[str, bool]] = None,
     ):
+        import os
+
         self.ft = ft
         self.dictionary_fields = list(dictionary_fields)
         self.sort = sort
-        self.key = DeltaWriter._next_key
-        DeltaWriter._next_key += 1
+        # random threading key (DeltaWriter.scala:60 ThreadLocalRandom):
+        # writers live in different processes/hosts, so a counter collides
+        self.key = int.from_bytes(os.urandom(8), "little")
         # cumulative per-field dictionary: value -> local index
         self._dicts: Dict[str, Dict[str, int]] = {f: {} for f in self.dictionary_fields}
         base = SimpleFeatureVector(ft)

@@ -37,6 +37,15 @@ from geomesa_tpu.geom.base import Envelope, Geometry, WHOLE_WORLD
 from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
 
 # the reference's scan-range budget (QueryProperties.scala:18)
+def _ranges_target() -> int:
+    """Tiered knob (QueryProperties.scala:18 'geomesa.scan.ranges.target'):
+    override via utils.config.set_property or GEOMESA_SCAN_RANGES_TARGET."""
+    from geomesa_tpu.utils.config import SCAN_RANGES_TARGET as prop
+
+    v = prop.to_int()
+    return 2000 if v is None else v
+
+
 SCAN_RANGES_TARGET = 2000
 
 

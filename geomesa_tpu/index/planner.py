@@ -120,8 +120,13 @@ class QueryPlanner:
         self,
         query: Query,
         explain: Optional[Explainer] = None,
-        max_ranges: int = SCAN_RANGES_TARGET,
+        max_ranges: Optional[int] = None,
     ) -> QueryPlan:
+        if max_ranges is None:
+            # tiered knob: geomesa.scan.ranges.target (QueryProperties.scala:18)
+            from geomesa_tpu.index.keyspace import _ranges_target
+
+            max_ranges = _ranges_target()
         explain = explain or Explainer()
         f = simplify(query.filter)
         single = self._plan_single(f, explain, max_ranges)

@@ -174,6 +174,13 @@ class TpuDataStore:
         self.auths = auths
         self.audit_writer = audit_writer
         self.metrics = metrics
+        if query_timeout_s is None:
+            # tiered knob (QueryProperties 'geomesa.query.timeout'):
+            # GEOMESA_QUERY_TIMEOUT or utils.config.set_property
+            from geomesa_tpu.utils.config import QUERY_TIMEOUT
+
+            ms = QUERY_TIMEOUT.to_duration_ms()
+            query_timeout_s = None if ms is None else ms / 1000.0
         self.query_timeout_s = query_timeout_s
         self.user = user
         # write-time maintained sketches feeding the cost-based decider
@@ -267,7 +274,8 @@ class TpuDataStore:
                 if est is not None:
                     return int(est)
             return len(self.query(name, q))
-        if has_vis:
+        if has_vis or self._age_off_cutoff(self.get_schema(name)) is not None:
+            # expired features must not be counted (age-off masks at scan)
             return len(self.query(name))
         n = first.num_rows
         if first.tombstones:
@@ -448,6 +456,10 @@ class TpuDataStore:
                 scan = table.scan(plan.ranges)
             else:
                 scan = table.scan_all()
+        # dtg age-off (DtgAgeOffIterator.scala:29-60 analog): a per-type
+        # retention window ('geomesa.feature.expiry' in the SFT user data or
+        # the system property, e.g. '7 days') masks expired rows at scan
+        age_cutoff = self._age_off_cutoff(ft)
         # loose-bbox: for a residual-free rectangle-only point-index plan the
         # device candidate set (int-domain test, same granularity as the
         # reference's Z3Filter) IS the loose result (Z2Index.scala:26-40).
@@ -478,6 +490,15 @@ class TpuDataStore:
                 for k, v in block.columns.items()
                 if k not in ("__fid__", "__vis__")
             }
+            if age_cutoff is not None:
+                dtg = ft.default_date.name
+                alive = mask_cols[dtg] >= age_cutoff
+                nulls = mask_cols.get(dtg + "__null")
+                if nulls is not None:
+                    alive |= nulls  # null dates never age off
+                if not alive.all():
+                    rows = rows[alive]
+                    mask_cols = {k: v[alive] for k, v in mask_cols.items()}
             if plan.post_filter is not None and not loose:
                 mask = self.executor.post_filter(ft, plan, mask_cols)
                 if not mask.all():
@@ -497,6 +518,50 @@ class TpuDataStore:
             if len(rows):
                 parts.append(mask_cols)
         return parts
+
+    def _age_off_cutoff(self, ft: FeatureType) -> Optional[int]:
+        """Epoch-ms cutoff below which features are expired, or None.
+
+        Retention comes from the SFT user data key 'geomesa.feature.expiry'
+        (per-type, like the reference's table iterator config) or the
+        system property of the same name (store-wide default)."""
+        if ft.default_date is None:
+            return None
+        from geomesa_tpu.utils.config import FEATURE_EXPIRY, SystemProperty
+
+        spec = (ft.user_data or {}).get("geomesa.feature.expiry")
+        ms = None
+        if spec is not None:
+            ms = SystemProperty("", str(spec)).to_duration_ms()
+        if ms is None:
+            ms = FEATURE_EXPIRY.to_duration_ms()
+        if ms is None:
+            return None
+        import time as _time
+
+        return int(_time.time() * 1000) - ms
+
+    def age_off(self, name: str) -> int:
+        """Tombstone expired features (maintenance sweep; the age-off
+        iterator drops them physically at compaction in the reference).
+        Returns the number removed."""
+        ft = self.get_schema(name)
+        cutoff = self._age_off_cutoff(ft)
+        if cutoff is None:
+            return 0
+        dtg = ft.default_date.name
+        victims: List[str] = []
+        table = next(iter(self._tables[name].values()))
+        for b, rows in table.scan_all():
+            t = b.columns[dtg][rows]
+            nulls = b.columns.get(dtg + "__null")
+            dead = t < cutoff
+            if nulls is not None:
+                dead &= ~nulls[rows]
+            victims.extend(b.columns["__fid__"][rows[dead]])
+        if victims:
+            self.delete_features(name, victims)
+        return len(victims)
 
     def _as_query(self, query: Union[str, Query]) -> Query:
         if isinstance(query, Query):

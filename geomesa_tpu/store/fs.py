@@ -151,6 +151,9 @@ class FsDataStore(TpuDataStore):
                     loaded.discard(rel)
                     continue
                 cols = _read_block(path, ft)
+                if "__vis__" in cols and self.metadata.read(name, "geomesa.vis") != "true":
+                    # legacy store: learn visibility presence during replay
+                    self.metadata.insert(name, "geomesa.vis", "true")
                 super()._insert_columns(ft, cols, observe_stats=observe)
             # tombstones may cover rows in just-loaded blocks
             fids = self._stored_tombstones(name)
@@ -198,7 +201,7 @@ class FsDataStore(TpuDataStore):
             and not exact
             and self.stats is not None
             and self.stats.has_persisted(name)
-            and self.metadata.read(name, "geomesa.vis") is None
+            and self.metadata.read(name, "geomesa.vis") == "false"
         ):
             # stats estimates answer from persisted sketches — loading
             # every block to then not read it would defeat lazy=True.
@@ -234,10 +237,14 @@ class FsDataStore(TpuDataStore):
         super()._insert_columns(ft, columns, observe_stats)
         if self._loading:
             return
-        if "__vis__" in columns and self.metadata.read(ft.name, "geomesa.vis") is None:
-            # durable marker: count-estimate shortcuts must keep enforcing
-            # visibility even before any block of this type is loaded
-            self.metadata.insert(ft.name, "geomesa.vis", "true")
+        # durable marker: count-estimate shortcuts must keep enforcing
+        # visibility even before any block of this type is loaded. Absence
+        # of the marker (legacy store) is treated as "maybe" — no shortcut.
+        if "__vis__" in columns:
+            if self.metadata.read(ft.name, "geomesa.vis") != "true":
+                self.metadata.insert(ft.name, "geomesa.vis", "true")
+        elif self.metadata.read(ft.name, "geomesa.vis") is None:
+            self.metadata.insert(ft.name, "geomesa.vis", "false")
         self._write_partitioned(ft, columns)
 
     def _write_partitioned(self, ft: FeatureType, columns: Columns) -> None:

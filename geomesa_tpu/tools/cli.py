@@ -176,7 +176,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("files", nargs="+")
     sp = add("export", cmd_export)
     sp.add_argument("--cql", default="INCLUDE")
-    sp.add_argument("--format", default="csv", choices=["csv", "tsv", "geojson", "wkt", "bin"])
+    sp.add_argument(
+        "--format", default="csv",
+        choices=["csv", "tsv", "geojson", "wkt", "gml", "bin", "avro", "shp"],
+    )
     sp.add_argument("--output", default=None)
     sp.add_argument("--max-features", type=int, default=None)
     sp = add("explain", cmd_explain)

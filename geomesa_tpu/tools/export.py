@@ -117,11 +117,150 @@ def to_bin(result, track: str = "id") -> bytes:
     return recs.tobytes()
 
 
+def to_gml(result) -> str:
+    """GML 3 feature collection (the reference's GML export,
+    geomesa-tools export GmlExporter via GeoTools GML encoder)."""
+    from xml.sax.saxutils import escape
+
+    ft = result.ft
+    date_names = {a.name for a in ft.attributes if a.type == AttributeType.DATE}
+    out = [
+        '<?xml version="1.0" encoding="UTF-8"?>',
+        '<gml:FeatureCollection xmlns:gml="http://www.opengis.net/gml" '
+        'xmlns:geomesa="http://geomesa.org/tpu">',
+    ]
+    for fid, row in zip(result.fids, _rows(result)):
+        fid_attr = escape(str(fid), {'"': "&quot;"})
+        out.append(f'  <gml:featureMember><geomesa:{ft.name} gml:id="{fid_attr}">')
+        for a, v in zip(ft.attributes, row):
+            if v is None:
+                continue
+            if isinstance(v, Geometry):
+                out.append(f"    <geomesa:{a.name}>{_gml_geom(v)}</geomesa:{a.name}>")
+            elif a.name in date_names:
+                out.append(f"    <geomesa:{a.name}>{_fmt_date(v)}</geomesa:{a.name}>")
+            else:
+                out.append(f"    <geomesa:{a.name}>{escape(str(v))}</geomesa:{a.name}>")
+        out.append(f"  </geomesa:{ft.name}></gml:featureMember>")
+    out.append("</gml:FeatureCollection>")
+    return "\n".join(out) + "\n"
+
+
+def _gml_geom(g: Geometry) -> str:
+    srs = ' srsName="urn:ogc:def:crs:EPSG::4326"'
+    if isinstance(g, Point):
+        return f"<gml:Point{srs}><gml:pos>{g.x} {g.y}</gml:pos></gml:Point>"
+    from geomesa_tpu.geom.base import LineString, Polygon
+
+    def poslist(coords) -> str:
+        return " ".join(f"{x} {y}" for x, y in np.asarray(coords))
+
+    if isinstance(g, LineString):
+        return (
+            f"<gml:LineString{srs}><gml:posList>{poslist(g.coords)}"
+            "</gml:posList></gml:LineString>"
+        )
+    if isinstance(g, Polygon):
+        rings = [
+            "<gml:exterior><gml:LinearRing><gml:posList>"
+            + poslist(g.shell)
+            + "</gml:posList></gml:LinearRing></gml:exterior>"
+        ]
+        for h in g.holes:
+            rings.append(
+                "<gml:interior><gml:LinearRing><gml:posList>"
+                + poslist(h)
+                + "</gml:posList></gml:LinearRing></gml:interior>"
+            )
+        return f"<gml:Polygon{srs}>{''.join(rings)}</gml:Polygon>"
+    return f"<!-- unsupported {g.geom_type} -->"
+
+
+def _avro_schema(ft) -> dict:
+    """FeatureType -> Avro record schema: dates as ms longs, geometries as
+    WKT strings (the reference's avro export serializes JTS the same
+    logical way via AvroSimpleFeature)."""
+    fields = [{"name": "__fid__", "type": "string"}]
+    simple = {
+        AttributeType.STRING: "string",
+        AttributeType.INT: "int",
+        AttributeType.LONG: "long",
+        AttributeType.FLOAT: "float",
+        AttributeType.DOUBLE: "double",
+        AttributeType.BOOLEAN: "boolean",
+        AttributeType.DATE: "long",
+    }
+    for a in ft.attributes:
+        t = "string" if a.type.is_geometry else simple.get(a.type, "string")
+        fields.append({"name": a.name, "type": ["null", t]})
+    return {"type": "record", "name": ft.name, "fields": fields}
+
+
+def to_avro(result, sink) -> int:
+    """Avro object-container export through utils/avro.py."""
+    from geomesa_tpu.utils.avro import write_container
+
+    ft = result.ft
+    schema = _avro_schema(ft)
+
+    def records():
+        for fid, row in zip(result.fids, _rows(result)):
+            rec = {"__fid__": str(fid)}
+            for a, v in zip(ft.attributes, row):
+                if isinstance(v, Geometry):
+                    v = to_wkt(v)
+                rec[a.name] = v
+            yield rec
+
+    return write_container(sink, schema, records())
+
+
+def to_shp(result, basename: str) -> None:
+    """ESRI shapefile triple (<basename>.shp/.shx/.dbf)."""
+    from geomesa_tpu.tools.shapefile import write_shp
+
+    ft = result.ft
+    geom_attr = ft.default_geometry
+    if geom_attr is None:
+        raise ValueError("shapefile export needs a geometry attribute")
+    gi = ft.attributes.index(geom_attr)
+    date_names = {a.name for a in ft.attributes if a.type == AttributeType.DATE}
+    fields = [("id", "C", 64, 0)]
+    specs = []
+    for a in ft.attributes:
+        if a is geom_attr:
+            continue
+        if a.type in (AttributeType.INT, AttributeType.LONG):
+            fields.append((a.name, "N", 18, 0))
+        elif a.type in (AttributeType.FLOAT, AttributeType.DOUBLE):
+            fields.append((a.name, "F", 20, 8))
+        else:
+            fields.append((a.name, "C", 64, 0))
+        specs.append(a)
+    geoms, rows = [], []
+    for fid, row in zip(result.fids, _rows(result)):
+        geoms.append(row[gi])
+        vals = [str(fid)]
+        for a in specs:
+            v = row[ft.attributes.index(a)]
+            if v is not None and a.name in date_names:
+                v = _fmt_date(v)
+            elif isinstance(v, Geometry):
+                v = to_wkt(v)
+            vals.append(v)
+        rows.append(vals)
+    geom_type = {"Point": "Point", "LineString": "LineString", "Polygon": "Polygon"}.get(
+        geom_attr.type.value, "Point"
+    )
+    write_shp(basename, geoms, fields, rows, geom_type)
+
+
 FORMATS = {
     "csv": to_csv,
     "tsv": to_tsv,
     "geojson": to_geojson,
     "wkt": to_wkt_lines,
+    "gml": to_gml,
 }
 
 
@@ -133,8 +272,23 @@ def export(result, fmt: str, output: Optional[str] = None) -> Optional[str]:
                 fh.write(data)
             return None
         return data.hex()
+    if fmt == "avro":
+        if output:
+            to_avro(result, output)
+            return None
+        buf = io.BytesIO()
+        to_avro(result, buf)
+        return buf.getvalue().hex()
+    if fmt == "shp":
+        if not output:
+            raise ValueError("shp export requires --output <basename>")
+        base = output[:-4] if output.endswith(".shp") else output
+        to_shp(result, base)
+        return None
     if fmt not in FORMATS:
-        raise ValueError(f"unknown export format: {fmt} (have {sorted(FORMATS)} + bin)")
+        raise ValueError(
+            f"unknown export format: {fmt} (have {sorted(FORMATS)} + bin/avro/shp)"
+        )
     text = FORMATS[fmt](result)
     if output:
         with open(output, "w") as fh:

@@ -53,6 +53,10 @@ class _FastPlan:
     def __init__(self, ft: FeatureType, config: Dict[str, Any]):
         self.ft = ft
         self.config = config
+        if config.get("options", {}).get("validators"):
+            # row-level validation isn't vectorized (yet): the row converter
+            # must run so rejects are counted identically
+            raise _Unsupported("validators")
         self.delim = "\t" if config.get("format", "csv").lower() in ("tsv", "tdv", "tdf") else ","
         self.skip = int(config.get("options", {}).get("skip-lines", 0))
         self.steps: List[Tuple[str, Tuple]] = []  # (attr, op)
@@ -77,7 +81,15 @@ class _FastPlan:
         if isinstance(e, _Call) and e.name == "uuid" and not e.args:
             return ("uuid",)
         if isinstance(e, _Call) and e.name == "md5":
-            return ("md5row",)
+            arg = e.args[0]
+            # md5 of the WHOLE record ($0, possibly through toString) hashes
+            # the joined row; md5 of anything else hashes that value —
+            # matching the row converter exactly
+            if isinstance(arg, _Call) and arg.name in ("tostring", "trim") and len(arg.args) == 1:
+                arg = arg.args[0]
+            if isinstance(arg, _Col) and arg.idx == 0:
+                return ("md5row",)
+            return ("md5", self._compile(e.args[0]))
         op = self._compile(e)
         return ("expr", op)
 
@@ -96,8 +108,10 @@ class _FastPlan:
         if isinstance(e, _Call):
             if e.name in ("toint", "tolong", "todouble", "tostring", "trim"):
                 inner = self._compile(e.args[0])
-                if e.name in ("tostring", "trim"):
+                if e.name == "trim":
                     return ("str", inner)
+                if e.name == "tostring":
+                    return ("tostr", inner)  # NO strip — row path is str(v)
                 return ("num", "int64" if e.name in ("toint", "tolong") else "float64", inner)
             if e.name == "date" and isinstance(e.args[0], _Lit):
                 return ("date", e.args[0].v, self._compile(e.args[1]))
@@ -162,6 +176,24 @@ class _FastPlan:
                     out[name + "__null"] = nulls
             else:
                 out[name] = val if val.dtype == object else val.astype(object)
+        # schema attributes the config never sets still need columns (the
+        # row path's columns_from_features emits every attribute)
+        covered = {s[0] for s in self.steps}
+        for a in self.ft.attributes:
+            if a.name in covered:
+                continue
+            if a.type == AttributeType.POINT:
+                out[a.name + "__x"] = np.full(n, np.nan)
+                out[a.name + "__y"] = np.full(n, np.nan)
+            elif a.type.is_geometry:
+                out[a.name] = np.full(n, None, dtype=object)
+            else:
+                dtype = a.type.numpy_dtype
+                if dtype is None:
+                    out[a.name] = np.full(n, None, dtype=object)
+                else:
+                    out[a.name] = np.zeros(n, dtype=dtype)
+                    out[a.name + "__null"] = np.ones(n, dtype=bool)
         out[_FID] = self._eval_id(cols, n)
         return out
 
@@ -176,6 +208,9 @@ class _FastPlan:
         if kind == "str":
             v = self._eval(op[1], cols, n)
             return np.array([None if x is None else str(x).strip() for x in v], dtype=object)
+        if kind == "tostr":
+            v = self._eval(op[1], cols, n)
+            return np.array([None if x is None else str(x) for x in v], dtype=object)
         if kind == "num":
             return self._eval(op[2], cols, n)  # cast happens at column build
         if kind == "date":
@@ -208,6 +243,19 @@ class _FastPlan:
             ).to_numpy(zero_copy_only=False)
             return np.array(
                 [hashlib.md5(s.encode()).hexdigest() for s in joined], dtype=object
+            )
+        if kind == "md5":
+            import hashlib
+
+            v = self._eval(self.id_op[1], cols, n)
+            return np.array(
+                [
+                    None if x is None else hashlib.md5(
+                        (x if isinstance(x, (bytes, bytearray)) else str(x).encode())
+                    ).hexdigest()
+                    for x in v
+                ],
+                dtype=object,
             )
         v = self._eval(self.id_op[1], cols, n)
         return np.array([None if x is None else str(x) for x in v], dtype=object)

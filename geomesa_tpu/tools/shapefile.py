@@ -1,0 +1,205 @@
+"""Minimal ESRI Shapefile writer/reader (pure Python, spec-direct).
+
+The geomesa-tools shapefile export analog (FileExportCommand SHP path,
+which delegates to GeoTools' ShapefileDataStore): writes the .shp/.shx/.dbf
+triple for Point / PolyLine / Polygon layers, with attributes as DBF
+C(string) / N(numeric) fields. The reader exists for round-trip tests.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from datetime import date
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.geom.base import Geometry, LineString, Point, Polygon
+
+_SHP_NULL = 0
+_SHP_POINT = 1
+_SHP_POLYLINE = 3
+_SHP_POLYGON = 5
+
+_TYPE_FOR = {"Point": _SHP_POINT, "LineString": _SHP_POLYLINE, "Polygon": _SHP_POLYGON}
+
+
+def _geom_points(g: Geometry) -> List[np.ndarray]:
+    """Geometry -> list of (n,2) part arrays (polygon rings closed)."""
+    if isinstance(g, Point):
+        return [np.array([[g.x, g.y]])]
+    if isinstance(g, LineString):
+        return [g.coords]
+    if isinstance(g, Polygon):
+        rings = []
+        for r in [g.shell] + list(g.holes):
+            r = np.asarray(r)
+            if len(r) and not np.array_equal(r[0], r[-1]):
+                r = np.vstack([r, r[:1]])
+            rings.append(r)
+        return rings
+    raise ValueError(f"unsupported shapefile geometry: {g.geom_type}")
+
+
+def _record_content(g: Optional[Geometry], shp_type: int) -> bytes:
+    if g is None:
+        return struct.pack("<i", _SHP_NULL)
+    if shp_type == _SHP_POINT:
+        return struct.pack("<idd", _SHP_POINT, g.x, g.y)
+    parts = _geom_points(g)
+    pts = np.vstack(parts)
+    buf = io.BytesIO()
+    env = (pts[:, 0].min(), pts[:, 1].min(), pts[:, 0].max(), pts[:, 1].max())
+    buf.write(struct.pack("<i4d", shp_type, *env))
+    buf.write(struct.pack("<ii", len(parts), len(pts)))
+    start = 0
+    for p in parts:
+        buf.write(struct.pack("<i", start))
+        start += len(p)
+    buf.write(pts.astype("<f8").tobytes())
+    return buf.getvalue()
+
+
+def write_shp(
+    basename: str,
+    geoms: Sequence[Optional[Geometry]],
+    fields: Sequence[Tuple[str, str, int, int]],
+    rows: Sequence[Sequence[Any]],
+    geom_type: str = "Point",
+) -> None:
+    """Write <basename>.shp/.shx/.dbf.
+
+    fields: (name, dbf type 'C'|'N'|'F', length, decimals) per column.
+    """
+    shp_type = _TYPE_FOR[geom_type]
+    contents = [_record_content(g, shp_type) for g in geoms]
+    # bounding box over non-null geometries
+    envs = [g.envelope.as_tuple() for g in geoms if g is not None]
+    if envs:
+        e = np.asarray(envs)
+        bbox = (e[:, 0].min(), e[:, 1].min(), e[:, 2].max(), e[:, 3].max())
+    else:
+        bbox = (0.0, 0.0, 0.0, 0.0)
+
+    def file_header(length_bytes: int) -> bytes:
+        h = struct.pack(">i", 9994) + b"\x00" * 20 + struct.pack(">i", length_bytes // 2)
+        h += struct.pack("<ii", 1000, shp_type)
+        h += struct.pack("<4d", *bbox)
+        h += struct.pack("<4d", 0.0, 0.0, 0.0, 0.0)
+        return h
+
+    shp_len = 100 + sum(8 + len(c) for c in contents)
+    with open(basename + ".shp", "wb") as fh:
+        fh.write(file_header(shp_len))
+        for i, c in enumerate(contents, 1):
+            fh.write(struct.pack(">ii", i, len(c) // 2))
+            fh.write(c)
+
+    shx_len = 100 + 8 * len(contents)
+    with open(basename + ".shx", "wb") as fh:
+        fh.write(file_header(shx_len))
+        offset = 50
+        for c in contents:
+            fh.write(struct.pack(">ii", offset, len(c) // 2))
+            offset += 4 + len(c) // 2
+
+    _write_dbf(basename + ".dbf", fields, rows)
+
+
+def _write_dbf(path: str, fields, rows) -> None:
+    record_size = 1 + sum(f[2] for f in fields)
+    today = date.today()
+    with open(path, "wb") as fh:
+        fh.write(
+            struct.pack(
+                "<BBBBIHH20x",
+                0x03, today.year - 1900, today.month, today.day,
+                len(rows), 32 + 32 * len(fields) + 1, record_size,
+            )
+        )
+        for name, ftype, length, dec in fields:
+            fh.write(
+                struct.pack(
+                    "<11sc4xBB14x", name.encode("ascii", "replace")[:10], ftype.encode(),
+                    length, dec,
+                )
+            )
+        fh.write(b"\x0d")
+        for row in rows:
+            fh.write(b" ")
+            for (name, ftype, length, dec), v in zip(fields, row):
+                if v is None:
+                    cell = b" " * length
+                elif ftype == "C":
+                    cell = str(v).encode("utf-8", "replace")[:length].ljust(length)
+                else:  # N / F: right-justified ASCII number
+                    txt = f"{float(v):.{dec}f}" if dec else str(int(v))
+                    cell = txt.encode("ascii")[:length].rjust(length)
+                fh.write(cell)
+        fh.write(b"\x1a")
+
+
+# -- reader (round-trip tests) -------------------------------------------------
+
+
+def read_shp(basename: str) -> Tuple[List[Optional[Geometry]], List[str], List[list]]:
+    """(geometries, field names, attribute rows) from a .shp/.dbf pair."""
+    geoms: List[Optional[Geometry]] = []
+    with open(basename + ".shp", "rb") as fh:
+        data = fh.read()
+    pos = 100
+    while pos < len(data):
+        (_num, words) = struct.unpack_from(">ii", data, pos)
+        pos += 8
+        content = data[pos : pos + words * 2]
+        pos += words * 2
+        (stype,) = struct.unpack_from("<i", content, 0)
+        if stype == _SHP_NULL:
+            geoms.append(None)
+        elif stype == _SHP_POINT:
+            x, y = struct.unpack_from("<dd", content, 4)
+            geoms.append(Point(x, y))
+        else:
+            nparts, npts = struct.unpack_from("<ii", content, 36)
+            parts = list(struct.unpack_from(f"<{nparts}i", content, 44))
+            pts = np.frombuffer(
+                content, dtype="<f8", count=npts * 2, offset=44 + 4 * nparts
+            ).reshape(-1, 2)
+            bounds = parts[1:] + [npts]
+            rings = [pts[a:b] for a, b in zip(parts, bounds)]
+            if stype == _SHP_POLYLINE:
+                geoms.append(LineString(rings[0]))
+            else:
+                geoms.append(Polygon(rings[0], rings[1:]))
+
+    with open(basename + ".dbf", "rb") as fh:
+        dbf = fh.read()
+    nrec, hsize, rsize = struct.unpack_from("<IHH", dbf, 4)
+    fields = []
+    off = 32
+    while dbf[off] != 0x0D:
+        name = dbf[off : off + 11].split(b"\x00")[0].decode()
+        ftype = chr(dbf[off + 11])
+        length = dbf[off + 16]
+        fields.append((name, ftype, length))
+        off += 32
+    rows = []
+    pos = hsize
+    for _ in range(nrec):
+        rec = dbf[pos : pos + rsize]
+        pos += rsize
+        cur = 1
+        row = []
+        for name, ftype, length in fields:
+            raw = rec[cur : cur + length].decode("utf-8", "replace")
+            cur += length
+            raw = raw.strip()
+            if not raw:
+                row.append(None)
+            elif ftype in ("N", "F"):
+                row.append(float(raw) if "." in raw else int(raw))
+            else:
+                row.append(raw)
+        rows.append(row)
+    return geoms, [f[0] for f in fields], rows

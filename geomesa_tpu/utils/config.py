@@ -1,0 +1,122 @@
+"""Tiered runtime configuration (GeoMesaSystemProperties analog).
+
+Reference (geomesa-utils conf/GeoMesaSystemProperties.scala:17-80): each
+knob is a named SystemProperty resolved through tiers — config file value
+(optionally final), then JVM system properties, then the default. Here the
+tiers are: programmatic overrides (set_property / properties context
+manager), then environment variables (dots become underscores, upper-cased,
+e.g. ``geomesa.scan.ranges.target`` -> ``GEOMESA_SCAN_RANGES_TARGET``),
+then the default. Duration/bytes parsing mirrors toDuration/toBytes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+_overrides: Dict[str, str] = {}
+_lock = threading.Lock()
+
+
+def set_property(name: str, value: Optional[str]) -> None:
+    """Set (or clear, with None) a programmatic override — the top tier."""
+    with _lock:
+        if value is None:
+            _overrides.pop(name, None)
+        else:
+            _overrides[name] = str(value)
+
+
+@contextmanager
+def properties(**kwargs):
+    """Scoped overrides: properties(geomesa_query_timeout=\"10 seconds\")
+    — underscores in keyword names map to dots."""
+    names = {k.replace("_", "."): v for k, v in kwargs.items()}
+    before = {n: _overrides.get(n) for n in names}
+    for n, v in names.items():
+        set_property(n, v)
+    try:
+        yield
+    finally:
+        for n, v in before.items():
+            set_property(n, v)
+
+
+_DURATION_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]+)\s*$")
+_DURATION_MS = {
+    "ms": 1, "millis": 1, "millisecond": 1, "milliseconds": 1,
+    "s": 1000, "second": 1000, "seconds": 1000,
+    "m": 60_000, "min": 60_000, "minute": 60_000, "minutes": 60_000,
+    "h": 3_600_000, "hour": 3_600_000, "hours": 3_600_000,
+    "d": 86_400_000, "day": 86_400_000, "days": 86_400_000,
+}
+_BYTES = {"b": 1, "k": 1024, "kb": 1024, "m": 1024**2, "mb": 1024**2,
+          "g": 1024**3, "gb": 1024**3, "t": 1024**4, "tb": 1024**4}
+
+
+class SystemProperty:
+    """One named knob; resolution happens on every get (values can change
+    under tests / long-running processes, like the reference's sys-props)."""
+
+    def __init__(self, name: str, default: Optional[str] = None):
+        self.name = name
+        self.default = default
+
+    def get(self) -> Optional[str]:
+        with _lock:
+            if self.name in _overrides:
+                return _overrides[self.name]
+        env = os.environ.get(self.name.replace(".", "_").upper())
+        if env is not None:
+            return env
+        return self.default
+
+    def to_int(self) -> Optional[int]:
+        v = self.get()
+        try:
+            return None if v is None else int(v)
+        except ValueError:
+            return None if self.default is None else int(self.default)
+
+    def to_float(self) -> Optional[float]:
+        v = self.get()
+        try:
+            return None if v is None else float(v)
+        except ValueError:
+            return None if self.default is None else float(self.default)
+
+    def to_bool(self) -> Optional[bool]:
+        v = self.get()
+        return None if v is None else v.strip().lower() in ("true", "1", "yes")
+
+    def to_duration_ms(self) -> Optional[int]:
+        """'10 seconds' / '5m' / '100 ms' -> milliseconds."""
+        for v in (self.get(), self.default):
+            if v is None:
+                continue
+            m = _DURATION_RE.match(str(v))
+            if m and m.group(2).lower() in _DURATION_MS:
+                return int(float(m.group(1)) * _DURATION_MS[m.group(2).lower()])
+            try:
+                return int(v)  # bare number = ms
+            except ValueError:
+                continue
+        return None
+
+    def to_bytes(self) -> Optional[int]:
+        for v in (self.get(), self.default):
+            if v is None:
+                continue
+            m = re.match(r"^\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]*)\s*$", str(v))
+            if m and (m.group(2) or "b").lower() in _BYTES:
+                return int(float(m.group(1)) * _BYTES[(m.group(2) or "b").lower()])
+        return None
+
+
+# the reference's commonly-tuned knobs (QueryProperties.scala analogs)
+SCAN_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.target", "2000")
+QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", None)
+FEATURE_EXPIRY = SystemProperty("geomesa.feature.expiry", None)

@@ -73,6 +73,61 @@ def decode(geohash: str) -> Tuple[float, float]:
     return ((xmin + xmax) / 2, (ymin + ymax) / 2)
 
 
+def decompose(geom, max_hashes: int = 32, max_precision: int = 6) -> List[str]:
+    """Cover a geometry with geohash cells at mixed precisions.
+
+    The GeohashUtils.decomposeGeometry analog (geomesa-utils
+    GeohashUtils.scala): BFS refinement — a cell fully inside the geometry
+    is emitted as-is, a boundary cell splits into its 32 children until the
+    budget or precision cap is reached (remaining boundary cells are then
+    emitted coarse, keeping the cover a SUPERSET of the geometry).
+    """
+    from geomesa_tpu.geom.base import Envelope, Polygon
+    from geomesa_tpu.geom.predicates import geometries_intersect, geometry_within
+
+    def cell_poly(gh: str) -> Polygon:
+        xmin, ymin, xmax, ymax = decode_bounds(gh)
+        return Polygon(
+            [[xmin, ymin], [xmax, ymin], [xmax, ymax], [xmin, ymax], [xmin, ymin]]
+        )
+
+    env = geom.envelope
+    # seed precision: grow until a single cell no longer contains the bbox
+    seeds = [""]
+    for p in range(1, max_precision + 1):
+        gh = encode(
+            np.asarray([(env.xmin + env.xmax) / 2]),
+            np.asarray([(env.ymin + env.ymax) / 2]),
+            p,
+        )[0]
+        xmin, ymin, xmax, ymax = decode_bounds(gh)
+        if xmin <= env.xmin and xmax >= env.xmax and ymin <= env.ymin and ymax >= env.ymax:
+            seeds = [gh]
+        else:
+            break
+
+    out: List[str] = []
+    frontier: List[str] = []
+    for s in seeds:
+        if s == "":
+            # whole world: 32 top-level cells
+            frontier.extend(_BASE32)
+        else:
+            frontier.append(s)
+    while frontier:
+        gh = frontier.pop(0)
+        cp = cell_poly(gh)
+        if not geometries_intersect(cp, geom):
+            continue
+        if geometry_within(cp, geom):
+            out.append(gh)
+        elif len(gh) >= max_precision or len(out) + len(frontier) >= max_hashes:
+            out.append(gh)  # boundary cell at budget: keep coarse (superset)
+        else:
+            frontier.extend(gh + c for c in _BASE32)
+    return sorted(out)
+
+
 def neighbors(geohash: str) -> List[str]:
     """The 8 surrounding cells (grid walk via re-encode of offset centers)."""
     xmin, ymin, xmax, ymax = decode_bounds(geohash)

@@ -1,0 +1,95 @@
+"""Tiered config properties + dtg age-off (GeoMesaSystemProperties /
+DtgAgeOffIterator analogs)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils.config import (
+    SCAN_RANGES_TARGET,
+    SystemProperty,
+    properties,
+    set_property,
+)
+
+
+def test_property_tiers(monkeypatch):
+    p = SystemProperty("geomesa.test.knob", "5")
+    assert p.to_int() == 5
+    monkeypatch.setenv("GEOMESA_TEST_KNOB", "7")
+    assert p.to_int() == 7  # env beats default
+    set_property("geomesa.test.knob", "9")
+    try:
+        assert p.to_int() == 9  # programmatic beats env
+    finally:
+        set_property("geomesa.test.knob", None)
+    assert p.to_int() == 7
+
+
+def test_duration_and_bytes_parsing():
+    assert SystemProperty("x", "10 seconds").to_duration_ms() == 10_000
+    assert SystemProperty("x", "5m").to_duration_ms() == 300_000
+    assert SystemProperty("x", "2 days").to_duration_ms() == 172_800_000
+    assert SystemProperty("x", "1500").to_duration_ms() == 1500
+    assert SystemProperty("x", "4k").to_bytes() == 4096
+    assert SystemProperty("x", "2mb").to_bytes() == 2 * 1024 * 1024
+
+
+def test_scan_ranges_target_knob_affects_planning():
+    ds = TpuDataStore()
+    ds.create_schema(parse_spec("t", "dtg:Date,*geom:Point:srid=4326"))
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    rng = np.random.default_rng(2)
+    with ds.writer("t") as w:
+        for i in range(500):
+            w.write([int(base + int(rng.integers(0, 10 * 86400_000))),
+                     Point(float(rng.uniform(-60, 60)), float(rng.uniform(-60, 60)))],
+                    fid=f"f{i}")
+    cql = "bbox(geom, -50, -50, 50, 50) AND dtg DURING 2026-01-02T00:00:00Z/2026-01-08T00:00:00Z"
+    many = ds.planner("t").plan(ds._as_query(cql))
+    with properties(geomesa_scan_ranges_target="8"):
+        few = ds.planner("t").plan(ds._as_query(cql))
+    assert len(few.ranges) < len(many.ranges)
+    # results are identical either way (ranges are a cover, not the answer)
+    with properties(geomesa_scan_ranges_target="8"):
+        got = sorted(ds.query("t", cql).fids)
+    assert got == sorted(ds.query("t", cql).fids)
+
+
+def test_query_timeout_property(monkeypatch):
+    with properties(geomesa_query_timeout="10 seconds"):
+        ds = TpuDataStore()
+        assert ds.query_timeout_s == 10.0
+
+
+def test_dtg_age_off_masks_and_sweeps():
+    ft = parse_spec("t", "name:String,dtg:Date,*geom:Point:srid=4326")
+    ft.user_data["geomesa.feature.expiry"] = "1 days"
+    ds = TpuDataStore()
+    ds.create_schema(ft)
+    now = int(time.time() * 1000)
+    with ds.writer("t") as w:
+        w.write(["old", now - 3 * 86400_000, Point(1.0, 1.0)], fid="old")
+        w.write(["new", now - 3600_000, Point(2.0, 2.0)], fid="new")
+    # scan-time masking: expired feature invisible to every query path
+    assert sorted(ds.query("t").fids) == ["new"]
+    assert sorted(ds.query("t", "bbox(geom, 0, 0, 3, 3)").fids) == ["new"]
+    assert ds.count("t", "INCLUDE") == 1
+    assert ds.count("t") == 1  # bare counts respect age-off too
+    # maintenance sweep physically tombstones it
+    assert ds.age_off("t") == 1
+    assert sorted(ds.query("t").fids) == ["new"]
+
+
+def test_age_off_without_expiry_is_noop():
+    ds = TpuDataStore()
+    ds.create_schema(parse_spec("t", "dtg:Date,*geom:Point:srid=4326"))
+    now = int(time.time() * 1000)
+    with ds.writer("t") as w:
+        w.write([now - 10 * 86400_000, Point(1.0, 1.0)], fid="a")
+    assert sorted(ds.query("t").fids) == ["a"]
+    assert ds.age_off("t") == 0

@@ -1,0 +1,57 @@
+"""Raster chip store: resolution selection, bbox chip queries, mosaicking."""
+
+import numpy as np
+
+from geomesa_tpu.geom.base import Envelope
+from geomesa_tpu.raster import Raster, RasterQuery, RasterStore
+
+
+def _chip(x0, y0, size_deg, px, value):
+    data = np.full((px, px), float(value))
+    return Raster(data, Envelope(x0, y0, x0 + size_deg, y0 + size_deg))
+
+
+def test_put_and_query_by_bbox():
+    rs = RasterStore()
+    # 2x2 grid of 10-degree chips at 64px (res ~0.15625 deg/px)
+    for i, (x, y) in enumerate([(0, 0), (10, 0), (0, 10), (10, 10)]):
+        rs.put_raster(_chip(x, y, 10.0, 64, i + 1))
+    q = RasterQuery(Envelope(2, 2, 8, 8), 0.15625)
+    got = rs.get_rasters(q)
+    assert len(got) == 1 and got[0].data[0, 0] == 1.0
+    q2 = RasterQuery(Envelope(5, 5, 15, 15), 0.15625)
+    assert len(rs.get_rasters(q2)) == 4
+
+
+def test_resolution_selection_closest_log():
+    rs = RasterStore()
+    rs.put_raster(_chip(0, 0, 10.0, 64, 1))    # res 0.15625
+    rs.put_raster(_chip(0, 0, 10.0, 512, 2))   # res 0.01953
+    assert rs._choose_resolution(0.2) == rs.available_resolutions[1]
+    assert rs._choose_resolution(0.02) == rs.available_resolutions[0]
+    assert len(rs.available_resolutions) == 2
+
+
+def test_mosaic_composites_chips():
+    rs = RasterStore()
+    rs.put_raster(_chip(0, 0, 10.0, 100, 1))   # west, res 0.1
+    rs.put_raster(_chip(10, 0, 10.0, 100, 2))  # east
+    grid, env = rs.mosaic(RasterQuery(Envelope(5, 2, 15, 8), 0.1), fill=-1)
+    assert grid.shape == (60, 100)
+    assert grid[30, 10] == 1.0  # west half
+    assert grid[30, 90] == 2.0  # east half
+    assert not (grid == -1).any()  # fully covered
+    # partially-covered query keeps the fill value outside chips
+    grid2, _ = rs.mosaic(RasterQuery(Envelope(15, 2, 25, 8), 0.1), fill=-1)
+    assert (grid2[:, :50] == 2.0).all()
+    assert (grid2[:, 50:] == -1).all()
+
+
+def test_mosaic_resamples_to_requested_resolution():
+    rs = RasterStore()
+    chip = _chip(0, 0, 10.0, 100, 0)
+    chip.data[:] = np.arange(100)[None, :]  # gradient across x
+    rs.put_raster(chip)
+    grid, _ = rs.mosaic(RasterQuery(Envelope(0, 0, 10, 10), 0.5))
+    assert grid.shape == (20, 20)
+    assert grid[0, 0] < grid[0, -1]  # gradient preserved

@@ -1,0 +1,66 @@
+"""RouteSearchProcess analog + GeohashUtils polygon decomposition."""
+
+import numpy as np
+
+from geomesa_tpu.geom.base import LineString, Point, Polygon
+from geomesa_tpu.process.route import match_route, route_search
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils.geohash import decode_bounds, decompose
+
+
+def test_match_route_buffer_and_heading():
+    route = LineString([[0.0, 0.0], [1.0, 0.0]])  # due east along the equator
+    px = np.array([0.5, 0.5, 0.5, 5.0])
+    py = np.array([0.0001, 0.0001, 0.0001, 5.0])
+    headings = np.array([90.0, 270.0, 0.0, 90.0])
+    # heading 90 = along route; 270 = reverse; 0 = crossing; far point = out
+    m = match_route(px, py, headings, route, buffer_m=50.0, heading_threshold=30.0)
+    assert list(m) == [True, False, False, False]
+    m2 = match_route(
+        px, py, headings, route, buffer_m=50.0, heading_threshold=30.0,
+        bidirectional=True,
+    )
+    assert list(m2) == [True, True, False, False]
+
+
+def test_route_search_store_level():
+    ds = TpuDataStore()
+    ds.create_schema(parse_spec("t", "heading:Double,dtg:Date,*geom:Point:srid=4326"))
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    with ds.writer("t") as w:
+        # along-route points heading east
+        for i in range(5):
+            w.write([90.0, int(base + i), Point(0.1 + 0.2 * i, 0.00005)], fid=f"on{i}")
+        # crossing traffic
+        for i in range(3):
+            w.write([0.0, int(base + i), Point(0.3 + 0.2 * i, 0.00005)], fid=f"x{i}")
+        # far away
+        w.write([90.0, int(base), Point(10.0, 10.0)], fid="far")
+    route = LineString([[0.0, 0.0], [1.0, 0.0]])
+    fids = route_search(ds, "t", [route], buffer_m=100.0, heading_threshold=20.0,
+                        heading_attr="heading")
+    assert sorted(fids) == [f"on{i}" for i in range(5)]
+
+
+def test_geohash_decompose_covers_polygon():
+    poly = Polygon([[-10, -10], [10, -10], [10, 10], [-10, 10], [-10, -10]])
+    cells = decompose(poly, max_hashes=64, max_precision=3)
+    assert cells
+    # superset: random points inside the polygon fall in some cell
+    rng = np.random.default_rng(3)
+    xs = rng.uniform(-9.9, 9.9, 200)
+    ys = rng.uniform(-9.9, 9.9, 200)
+    bounds = [decode_bounds(c) for c in cells]
+    for x, y in zip(xs, ys):
+        assert any(b[0] <= x <= b[2] and b[1] <= y <= b[3] for b in bounds), (x, y)
+
+
+def test_geohash_decompose_interior_cells_refined():
+    # a large polygon should produce a mix of precisions (interior coarse,
+    # boundary finer) and respect the budget
+    poly = Polygon([[-45, -45], [45, -45], [45, 45], [-45, 45], [-45, -45]])
+    cells = decompose(poly, max_hashes=40, max_precision=4)
+    assert 0 < len(cells) <= 80
+    lens = {len(c) for c in cells}
+    assert len(lens) >= 2  # mixed precisions
