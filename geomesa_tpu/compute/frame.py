@@ -92,3 +92,79 @@ class SpatialFrame:
 
     def to_dict(self) -> Dict[str, list]:
         return {k: v.tolist() for k, v in self.columns.items()}
+
+    # -- spatial join ---------------------------------------------------------
+
+    def spatial_join(
+        self,
+        other: "SpatialFrame",
+        predicate: str = "intersects",
+        distance_m: Optional[float] = None,
+        suffix: str = "_r",
+    ) -> "SpatialFrame":
+        """Join this frame's POINT rows against the other frame's geometries
+        (the Catalyst spatial-join relation analog, SQLRules.scala spatial
+        join folding): 'intersects'/'contains' do point-in-geometry,
+        'dwithin' uses a haversine radius against the other frame's points.
+        Output = matched left rows + right columns (suffixed)."""
+        gx = self.ft.default_geometry.name if self.ft is not None else "geom"
+        lx = self.columns[gx + "__x"]
+        ly = self.columns[gx + "__y"]
+        li: List[int] = []
+        ri: List[int] = []
+        if predicate in ("intersects", "contains", "within"):
+            from geomesa_tpu.geom.predicates import points_in_geometry
+
+            geoms = other.columns[
+                other.ft.default_geometry.name if other.ft is not None else "geom"
+            ]
+            for j, g in enumerate(geoms):
+                if g is None:
+                    continue
+                m = points_in_geometry(lx, ly, g)
+                hits = np.flatnonzero(m)
+                li.extend(hits)
+                ri.extend([j] * len(hits))
+        elif predicate == "dwithin":
+            if distance_m is None:
+                raise ValueError("dwithin join needs distance_m")
+            from geomesa_tpu.process.geodesy import haversine_m
+
+            ogx = other.ft.default_geometry.name if other.ft is not None else "geom"
+            rx = other.columns[ogx + "__x"]
+            ry = other.columns[ogx + "__y"]
+            for j in range(len(rx)):
+                d = haversine_m(lx, ly, rx[j], ry[j])
+                hits = np.flatnonzero(d <= distance_m)
+                li.extend(hits)
+                ri.extend([j] * len(hits))
+        else:
+            raise ValueError(f"unknown join predicate: {predicate}")
+        lidx = np.asarray(li, dtype=np.int64)
+        ridx = np.asarray(ri, dtype=np.int64)
+        cols = {k: v[lidx] for k, v in self.columns.items()}
+        for k, v in other.columns.items():
+            cols[(k + suffix) if k in self.columns else k] = v[ridx]
+        return SpatialFrame(cols, self.ft)
+
+    def partition_by_z2(self, bits: int = 8) -> Dict[int, "SpatialFrame"]:
+        """Partition rows by low-resolution z2 cell of their point geometry
+        (the IndexPartitioner analog): co-locates spatially-near rows so
+        downstream per-partition work maps onto mesh shards."""
+        from geomesa_tpu.curve import zorder
+        from geomesa_tpu.curve.normalized import NormalizedLat, NormalizedLon
+
+        gx = self.ft.default_geometry.name if self.ft is not None else "geom"
+        x = self.columns[gx + "__x"]
+        y = self.columns[gx + "__y"]
+        z = zorder.z2_encode(
+            np.asarray(NormalizedLon(bits // 2).normalize(x), dtype=np.int64),
+            np.asarray(NormalizedLat(bits // 2).normalize(y), dtype=np.int64),
+        )
+        out: Dict[int, SpatialFrame] = {}
+        for cell in np.unique(z):
+            idx = np.flatnonzero(z == cell)
+            out[int(cell)] = SpatialFrame(
+                {k: v[idx] for k, v in self.columns.items()}, self.ft
+            )
+        return out

@@ -130,6 +130,39 @@ def st_geohash(x, y, precision: int = 9) -> np.ndarray:
     return encode(x, y, precision)
 
 
+def st_convex_hull(xs, ys) -> "Geometry":
+    """Convex hull of a point set (Andrew's monotone chain) — the
+    ConvexHull UDAF analog (geomesa-spark-sql SQLSpatialAccumulatorFunction).
+    Returns a Polygon (or Point/LineString for degenerate inputs)."""
+    from geomesa_tpu.geom.base import LineString, Point, Polygon
+
+    pts = np.unique(
+        np.stack([np.asarray(xs, float), np.asarray(ys, float)], axis=1), axis=0
+    )
+    if len(pts) == 1:
+        return Point(pts[0, 0], pts[0, 1])
+    if len(pts) == 2:
+        return LineString(pts)
+
+    def cross2(o, a, b) -> float:
+        return (a[0] - o[0]) * (b[1] - o[1]) - (a[1] - o[1]) * (b[0] - o[0])
+
+    def half(points):
+        out: list = []
+        for p in points:
+            while len(out) >= 2 and cross2(out[-2], out[-1], p) <= 0:
+                out.pop()
+            out.append(p)
+        return out
+
+    lower = half(pts)
+    upper = half(pts[::-1])
+    hull = np.asarray(lower[:-1] + upper[:-1])
+    if len(hull) < 3:
+        return LineString(pts)
+    return Polygon(np.vstack([hull, hull[:1]]))
+
+
 def st_bin_time(t_ms, period="week"):
     """(bin, offset) pair columns (the z3 binned-time transform)."""
     from geomesa_tpu.curve import time_to_binned

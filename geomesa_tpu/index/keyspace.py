@@ -37,16 +37,15 @@ from geomesa_tpu.geom.base import Envelope, Geometry, WHOLE_WORLD
 from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
 
 # the reference's scan-range budget (QueryProperties.scala:18)
-def _ranges_target() -> int:
-    """Tiered knob (QueryProperties.scala:18 'geomesa.scan.ranges.target'):
-    override via utils.config.set_property or GEOMESA_SCAN_RANGES_TARGET."""
+def _ranges_target(requested=None) -> int:
+    """Resolve a max-ranges argument: an explicit value wins, else the
+    tiered knob (QueryProperties.scala:18 'geomesa.scan.ranges.target' —
+    utils.config.set_property or GEOMESA_SCAN_RANGES_TARGET), default 2000."""
+    if requested is not None:
+        return requested
     from geomesa_tpu.utils.config import SCAN_RANGES_TARGET as prop
 
-    v = prop.to_int()
-    return 2000 if v is None else v
-
-
-SCAN_RANGES_TARGET = 2000
+    return prop.to_int()
 
 
 class ScanRange(NamedTuple):
@@ -105,7 +104,7 @@ class IndexKeySpace:
         raise NotImplementedError
 
     def get_ranges(
-        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+        self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
     ) -> List[ScanRange]:
         raise NotImplementedError
 
@@ -210,7 +209,7 @@ class Z3KeySpace(IndexKeySpace):
         return IndexValues(geoms, intervals, bins=bins)
 
     def get_ranges(
-        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+        self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
     ) -> List[ScanRange]:
         if values.disjoint:
             return []
@@ -222,7 +221,7 @@ class Z3KeySpace(IndexKeySpace):
         whole = [b for b, w in values.bins.items() if w == (0, mo)]
         partial = {b: w for b, w in values.bins.items() if w != (0, mo)}
         n_groups = (1 if whole else 0) + len(partial)
-        per_group = max(1, max_ranges // max(1, n_groups))
+        per_group = max(1, _ranges_target(max_ranges) // max(1, n_groups))
         if whole:
             ranges = sfc.ranges(boxes, [(0, mo)], max_ranges=per_group)
             for b in sorted(whole):
@@ -259,11 +258,11 @@ class Z2KeySpace(IndexKeySpace):
         return IndexValues(geoms, disjoint=geoms.disjoint)
 
     def get_ranges(
-        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+        self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
     ) -> List[ScanRange]:
         if values.disjoint:
             return []
-        ranges = self._sfc.ranges(_boxes(values), max_ranges=max_ranges)
+        ranges = self._sfc.ranges(_boxes(values), max_ranges=_ranges_target(max_ranges))
         return [ScanRange(0, r.lower, r.upper, r.contained) for r in ranges]
 
 
@@ -300,11 +299,11 @@ class XZ2KeySpace(IndexKeySpace):
         return IndexValues(geoms, disjoint=geoms.disjoint)
 
     def get_ranges(
-        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+        self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
     ) -> List[ScanRange]:
         if values.disjoint:
             return []
-        ranges = self.sfc(ft).ranges(_boxes(values), max_ranges=max_ranges)
+        ranges = self.sfc(ft).ranges(_boxes(values), max_ranges=_ranges_target(max_ranges))
         return [ScanRange(0, r.lower, r.upper, r.contained) for r in ranges]
 
 
@@ -349,7 +348,7 @@ class XZ3KeySpace(IndexKeySpace):
         return IndexValues(geoms, intervals, bins=bins)
 
     def get_ranges(
-        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+        self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
     ) -> List[ScanRange]:
         if values.disjoint:
             return []
@@ -360,7 +359,7 @@ class XZ3KeySpace(IndexKeySpace):
         whole = [b for b, w in values.bins.items() if w == (0, mo)]
         partial = {b: w for b, w in values.bins.items() if w != (0, mo)}
         n_groups = (1 if whole else 0) + len(partial)
-        per_group = max(1, max_ranges // max(1, n_groups))
+        per_group = max(1, _ranges_target(max_ranges) // max(1, n_groups))
         if whole:
             queries = [(x0, y0, 0.0, x1, y1, float(mo)) for x0, y0, x1, y1 in boxes]
             ranges = sfc.ranges(queries, max_ranges=per_group)
@@ -394,7 +393,7 @@ class IdKeySpace(IndexKeySpace):
         )
 
     def get_ranges(
-        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+        self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
     ) -> List[ScanRange]:
         if values.ids is None:
             return []
@@ -469,7 +468,7 @@ class AttributeKeySpace(IndexKeySpace):
         )
 
     def get_ranges(
-        self, ft: FeatureType, values: IndexValues, max_ranges: int = SCAN_RANGES_TARGET
+        self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
     ) -> List[ScanRange]:
         if values.disjoint or not values.attr_bounds:
             return []

@@ -18,7 +18,6 @@ from geomesa_tpu.index.keyspace import (
     IndexKeySpace,
     IndexValues,
     ScanRange,
-    SCAN_RANGES_TARGET,
 )
 from geomesa_tpu.index.strategy import FilterStrategy, get_filter_strategies
 from geomesa_tpu.schema.featuretype import FeatureType
@@ -122,11 +121,10 @@ class QueryPlanner:
         explain: Optional[Explainer] = None,
         max_ranges: Optional[int] = None,
     ) -> QueryPlan:
-        if max_ranges is None:
-            # tiered knob: geomesa.scan.ranges.target (QueryProperties.scala:18)
-            from geomesa_tpu.index.keyspace import _ranges_target
+        from geomesa_tpu.index.keyspace import _ranges_target
 
-            max_ranges = _ranges_target()
+        # tiered knob: geomesa.scan.ranges.target (QueryProperties.scala:18)
+        max_ranges = _ranges_target(max_ranges)
         explain = explain or Explainer()
         f = simplify(query.filter)
         single = self._plan_single(f, explain, max_ranges)
@@ -180,7 +178,7 @@ class QueryPlanner:
         self,
         f: ast.Filter,
         explain: Explainer,
-        max_ranges: int = SCAN_RANGES_TARGET,
+        max_ranges: Optional[int] = None,
     ) -> QueryPlan:
         explain.push(f"Planning query for type '{self.ft.name}'")
         explain(f"Filter: {to_cql(f)}")

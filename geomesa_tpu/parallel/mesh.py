@@ -84,6 +84,38 @@ def default_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> M
     return Mesh(np.asarray(devices), (axis,))
 
 
+def multihost_mesh(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    axis: str = DATA_AXIS,
+) -> Mesh:
+    """Mesh spanning every chip of every host (the multi-host DCN path).
+
+    The reference scales batch compute by adding Spark executors over the
+    database's RPC fabric (AccumuloSpatialRDDProvider); here the fabric is
+    jax's distributed runtime: each host calls this with the same
+    coordinator address, ``jax.distributed.initialize`` wires DCN, and
+    ``jax.devices()`` becomes the GLOBAL device set. Collectives inserted
+    by shard_map/pjit ride ICI within a host and DCN across hosts — the
+    executor's scan/merge code is unchanged at any scale.
+
+    With no arguments this is a no-op wrapper around the local device set
+    (single-controller dev mode and tests).
+    """
+    if coordinator is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    devices = jax.devices()
+    # hosts first: keeps each host's chips contiguous along the data axis so
+    # block shards stay host-local and cross-host traffic is merge-only
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    return Mesh(np.asarray(devices), (axis,))
+
+
 def pad_to_multiple(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
     """Pad axis 0 to a multiple so rows divide evenly across shards."""
     n = arr.shape[0]

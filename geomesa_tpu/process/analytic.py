@@ -1,0 +1,92 @@
+"""Analytic WPS-process analogs that delegate to the query engine.
+
+The remaining geomesa-process-vector entries (GeoMesaProcessFactory SPI):
+each reference process wraps a capability this framework exposes through
+query hints or the stats layer — these functions give them the same
+process-level names so a WPS-shaped caller finds one-call equivalents.
+
+  MinMaxProcess        -> min_max           (stats MinMax sketch / exact)
+  StatsProcess         -> stats_process     (stats hint)
+  SamplingProcess      -> sampling_process  (sampling hint)
+  QueryProcess         -> query_process     (plain CQL query)
+  DensityProcess       -> density_process   (density hint / device kernel)
+  ArrowConversionProcess -> arrow_conversion (arrow hint)
+  BinConversionProcess -> bin_conversion    (bin hint)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.index.planner import Query
+
+
+def query_process(store, name: str, cql: str = "INCLUDE"):
+    """QueryProcess.scala: run a CQL query, return the result."""
+    return store.query(name, cql)
+
+
+def min_max(store, name: str, attribute: str, cql: str = "INCLUDE", exact: bool = False):
+    """MinMaxProcess.scala: (min, max) of an attribute, from the write-time
+    MinMax sketch when available (exact=False) else by scanning."""
+    if not exact and cql == "INCLUDE" and store.stats is not None:
+        ft = store.get_schema(name)
+        sk = store.stats.stats_for(ft).get(f"minmax:{attribute}")
+        if sk is not None and not sk.is_empty:
+            return sk.min, sk.max
+    res = store.query(name, cql)
+    col = res.columns[attribute]
+    nulls = res.columns.get(attribute + "__null")
+    if nulls is not None:
+        col = col[~nulls]
+    if not len(col):
+        return None, None
+    return col.min(), col.max()
+
+
+def stats_process(store, name: str, stat_spec: str, cql: str = "INCLUDE") -> Any:
+    """StatsProcess.scala: evaluate a stat-spec string over query results."""
+    q = Query.cql(cql)
+    q.hints["stats"] = stat_spec
+    res = store.query(name, q)
+    return res.aggregate["stats"]
+
+
+def sampling_process(store, name: str, n: int, cql: str = "INCLUDE"):
+    """SamplingProcess.scala: thin features to at most ~n via the sampling
+    hint (rate-based, like SamplingIterator)."""
+    total = max(1, store.count(name, cql))
+    q = Query.cql(cql)
+    q.hints["sampling"] = min(1.0, n / total)
+    return store.query(name, q)
+
+
+def density_process(
+    store, name: str, envelope, width: int, height: int, cql: str = "INCLUDE"
+) -> np.ndarray:
+    """DensityProcess.scala: heat-map grid via the density push-down."""
+    q = Query.cql(cql)
+    q.hints["density"] = {
+        "envelope": envelope, "width": int(width), "height": int(height)
+    }
+    res = store.query(name, q)
+    return res.aggregate["density"]
+
+
+def arrow_conversion(store, name: str, cql: str = "INCLUDE", **spec) -> bytes:
+    """ArrowConversionProcess.scala: results as an Arrow IPC stream."""
+    q = Query.cql(cql)
+    q.hints["arrow"] = dict(spec) if spec else {}
+    res = store.query(name, q)
+    return res.aggregate["arrow"]
+
+
+def bin_conversion(store, name: str, cql: str = "INCLUDE", track: str = "id") -> bytes:
+    """BinConversionProcess.scala: results as packed BIN records."""
+    q = Query.cql(cql)
+    q.hints["bin"] = {"track": track}
+    res = store.query(name, q)
+    recs = res.aggregate["bin"]
+    return recs.tobytes() if hasattr(recs, "tobytes") else recs

@@ -100,6 +100,11 @@ def route_search(
     headings = None
     if heading_attr is not None:
         headings = np.asarray(res.columns[heading_attr], dtype=np.float64)
+        nulls = res.columns.get(heading_attr + "__null")
+        if nulls is not None:
+            # a feature without a heading cannot be route-following
+            # (NaN fails every threshold compare)
+            headings = np.where(nulls, np.nan, headings)
     mask = np.zeros(len(px), dtype=bool)
     for route in routes:
         mask |= match_route(

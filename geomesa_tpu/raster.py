@@ -53,20 +53,27 @@ class RasterStore:
     def __init__(self, name: str = "rasters"):
         self.name = name
         self._chips: Dict[float, List[Raster]] = {}
-        self._envs: Dict[float, np.ndarray] = {}  # (N,4) per resolution
+        # (N,4) materialized lazily per resolution (writes only append to
+        # the chip list — rebuilding the array per insert would be O(N^2))
+        self._envs: Dict[float, np.ndarray] = {}
 
     # -- writes --------------------------------------------------------------
 
     def put_raster(self, raster: Raster) -> None:
         res = _quantize(raster.resolution)
         self._chips.setdefault(res, []).append(raster)
-        env = np.asarray([raster.envelope.as_tuple()])
-        cur = self._envs.get(res)
-        self._envs[res] = env if cur is None else np.vstack([cur, env])
+        self._envs.pop(res, None)  # invalidate; rebuilt on next query
 
     def put_rasters(self, rasters: Sequence[Raster]) -> None:
         for r in rasters:
             self.put_raster(r)
+
+    def _env_index(self, res: float) -> np.ndarray:
+        envs = self._envs.get(res)
+        if envs is None or len(envs) != len(self._chips[res]):
+            envs = np.asarray([c.envelope.as_tuple() for c in self._chips[res]])
+            self._envs[res] = envs
+        return envs
 
     # -- queries -------------------------------------------------------------
 
@@ -86,7 +93,7 @@ class RasterStore:
         res = self._choose_resolution(query.resolution)
         if res is None:
             return []
-        e = self._envs[res]
+        e = self._env_index(res)
         q = query.envelope
         hit = (e[:, 2] >= q.xmin) & (e[:, 0] <= q.xmax) & (e[:, 3] >= q.ymin) & (e[:, 1] <= q.ymax)
         chips = self._chips[res]

@@ -269,7 +269,14 @@ class TpuDataStore:
         has_vis = any("__vis__" in b.columns for b in first.blocks)
         if query is not None:
             q = self._as_query(query)
-            if not exact and self.stats is not None and not has_vis:
+            if (
+                not exact
+                and self.stats is not None
+                and not has_vis
+                # expired rows were observed at write time: sketches would
+                # count them, so age-off types must scan
+                and self._age_off_cutoff(self.get_schema(name)) is None
+            ):
                 est = self.stats.get_count(self.get_schema(name), q.filter)
                 if est is not None:
                     return int(est)

@@ -68,7 +68,15 @@ def cmd_ingest(args) -> int:
     from geomesa_tpu.tools.premade import PREMADE
 
     ds = _store(args)
-    if args.converter in PREMADE:
+    if args.converter == "auto":
+        # AutoIngest analog: infer schema + converter from the first file
+        from geomesa_tpu.schema.featuretype import parse_spec
+        from geomesa_tpu.tools.convert import infer_converter
+
+        spec, config = infer_converter(args.files[0], args.name)
+        if args.name not in ds.type_names:
+            ds.create_schema(parse_spec(args.name, spec))
+    elif args.converter in PREMADE:
         spec, config = PREMADE[args.converter]
         if args.name not in ds.type_names:
             from geomesa_tpu.schema.featuretype import parse_spec

@@ -490,8 +490,13 @@ class SimpleFeatureConverter:
         return expr(rec, fields) if expr is not None else None
 
     def convert(self, fh, ec: Optional[EvaluationContext] = None) -> Iterator[Feature]:
+        yield from self.convert_records(self._records(fh), ec)
+
+    def convert_records(self, records, ec: Optional[EvaluationContext] = None):
+        """Convert pre-parsed records (dicts/rows) directly — also the
+        simple-feature (SFT-to-SFT) converter entry point."""
         ec = ec if ec is not None else EvaluationContext()
-        for lineno, rec in enumerate(self._records(fh), 1):
+        for lineno, rec in enumerate(records, 1):
             try:
                 fields: Dict[str, Any] = {}
                 for name, expr, path, cfg in self.fields:
@@ -514,3 +519,103 @@ class SimpleFeatureConverter:
         )
         with open(path, mode, **kwargs) as fh:
             yield from self.convert(fh, ec)
+
+
+def sft_to_sft(
+    store,
+    src_name: str,
+    dst_ft: FeatureType,
+    config: Dict[str, Any],
+    cql: str = "INCLUDE",
+    ec: Optional[EvaluationContext] = None,
+) -> Iterator[Feature]:
+    """SFT-to-SFT conversion (geomesa-convert-simplefeature analog): query
+    features of one type and re-shape them into another. Records are dicts
+    of the source attributes (+ __fid__), addressed with json-style paths
+    or $field expressions."""
+    conv = SimpleFeatureConverter(dst_ft, dict(config, type="simple-feature"))
+    res = store.query(src_name, cql)
+    records = ({"__fid__": f.fid, **dict(zip([a.name for a in res.ft.attributes], f.values))}
+               for f in res.to_features())
+    yield from conv.convert_records(records, ec)
+
+
+def infer_converter(path: str, name: str = "inferred") -> tuple:
+    """(sft spec string, converter config) inferred from a delimited file
+    with a header row — the AutoIngest / TypeInference analog: samples rows
+    to type each column (Integer/Double/Date-ISO/WKT geometry/String) and
+    pairs lon/lat-ish column names into a Point geometry."""
+    import itertools
+
+    with open(path, newline="") as fh:
+        sample = fh.read(64 * 1024)
+        fh.seek(0)
+        try:
+            dialect = csv.Sniffer().sniff(sample, delimiters=",\t|;")
+            delim = dialect.delimiter
+        except csv.Error:
+            delim = ","
+        reader = csv.reader(fh, delimiter=delim)
+        header = next(reader)
+        rows = list(itertools.islice(reader, 100))
+    if not rows:
+        raise ValueError(f"no data rows to infer from in {path}")
+
+    def col_type(i: int) -> str:
+        vals = [r[i] for r in rows if len(r) > i and r[i] != ""]
+        if not vals:
+            return "String"
+        for caster, t in ((int, "Integer"), (float, "Double")):
+            try:
+                for v in vals:
+                    caster(v)
+                return t
+            except ValueError:
+                pass
+        try:
+            for v in vals:
+                _fn_date("ISO", v)
+            return "Date"
+        except Exception:
+            pass
+        try:
+            for v in vals:
+                parse_wkt(v)
+            return "Geometry"
+        except Exception:
+            pass
+        return "String"
+
+    types = [col_type(i) for i in range(len(header))]
+    lon = lat = None
+    for i, h in enumerate(header):
+        hl = h.strip().lower()
+        if types[i] in ("Double", "Integer"):
+            if hl in ("lon", "longitude", "x") and lon is None:
+                lon = i
+            elif hl in ("lat", "latitude", "y") and lat is None:
+                lat = i
+    spec_parts = []
+    fields = []
+    fmt = {"\t": "tsv"}.get(delim, "csv")
+    for i, (h, t) in enumerate(zip(header, types)):
+        attr = re.sub(r"[^A-Za-z0-9_]", "_", h.strip()) or f"col{i}"
+        if t == "Geometry":
+            spec_parts.append(f"*{attr}:Geometry:srid=4326")
+            fields.append({"name": attr, "transform": f"geometry(${i + 1})"})
+        else:
+            tf = {"Integer": f"toInt(${i + 1})", "Double": f"toDouble(${i + 1})",
+                  "Date": f"date('ISO', ${i + 1})"}.get(t, f"${i + 1}")
+            spec_parts.append(f"{attr}:{t}")
+            fields.append({"name": attr, "transform": tf})
+    if lon is not None and lat is not None and not any(p.startswith("*") for p in spec_parts):
+        spec_parts.append("*geom:Point:srid=4326")
+        fields.append({"name": "geom", "transform": f"point(${lon + 1}, ${lat + 1})"})
+    config = {
+        "type": "delimited-text",
+        "format": fmt,
+        "options": {"skip-lines": 1},
+        "id-field": "md5(toString($0))",
+        "fields": fields,
+    }
+    return ",".join(spec_parts), config

@@ -249,9 +249,18 @@ def to_shp(result, basename: str) -> None:
                 v = to_wkt(v)
             vals.append(v)
         rows.append(vals)
-    geom_type = {"Point": "Point", "LineString": "LineString", "Polygon": "Polygon"}.get(
-        geom_attr.type.value, "Point"
-    )
+    # shapefiles are single-geometry-type: dispatch on the actual data when
+    # the attribute type is generic, and fail clearly on unsupported shapes
+    kinds = {g.geom_type for g in geoms if g is not None}
+    if geom_attr.type.value in ("Point", "LineString", "Polygon"):
+        geom_type = geom_attr.type.value
+    elif len(kinds) == 1 and next(iter(kinds)) in ("Point", "LineString", "Polygon"):
+        geom_type = next(iter(kinds))
+    else:
+        raise ValueError(
+            f"shapefile export supports a single Point/LineString/Polygon "
+            f"layer; got geometry types {sorted(kinds) or ['<empty>']}"
+        )
     write_shp(basename, geoms, fields, rows, geom_type)
 
 

@@ -117,6 +117,100 @@ class MetricsRegistry:
             return out
 
 
+class Reporter:
+    """Scheduled metrics publication (Dropwizard ScheduledReporter role,
+    metrics/config/MetricsConfig.scala:26-60): start() emits a registry
+    snapshot every ``interval_s`` on a daemon thread; report_now() for
+    synchronous flushes (tests, shutdown)."""
+
+    def __init__(self, registry: MetricsRegistry, interval_s: float = 60.0):
+        self.registry = registry
+        self.interval_s = interval_s
+        self._timer: Any = None
+        self._stopped = False
+
+    def emit(self, snapshot: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def report_now(self) -> None:
+        self.emit(self.registry.report())
+
+    def start(self) -> "Reporter":
+        self._stopped = False
+
+        def tick():
+            if self._stopped:  # stop() raced an in-flight fire
+                return
+            self.report_now()
+            schedule()
+
+        def schedule():
+            if self._stopped:
+                return
+            t = threading.Timer(self.interval_s, tick)
+            t.daemon = True
+            t.start()
+            self._timer = t
+
+        schedule()
+        return self
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+class ConsoleReporter(Reporter):
+    """ConsoleReporter analog: human-readable snapshot to a stream."""
+
+    def __init__(self, registry, interval_s: float = 60.0, stream=None):
+        super().__init__(registry, interval_s)
+        import sys
+
+        self.stream = stream or sys.stderr
+
+    def emit(self, snapshot):
+        import json as _json
+
+        self.stream.write(f"-- metrics {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} --\n")
+        self.stream.write(_json.dumps(snapshot, indent=1, default=str) + "\n")
+        self.stream.flush()
+
+
+class LoggingReporter(Reporter):
+    """Slf4jReporter analog: snapshot through the logging module."""
+
+    def __init__(self, registry, interval_s: float = 60.0, logger_name: str = "geomesa.metrics"):
+        super().__init__(registry, interval_s)
+        import logging
+
+        self.logger = logging.getLogger(logger_name)
+
+    def emit(self, snapshot):
+        self.logger.info("metrics %s", snapshot)
+
+
+class DelimitedFileReporter(Reporter):
+    """DelimitedFileReporter analog: appends timestamped rows, one metric
+    per line (tab-separated), for offline aggregation."""
+
+    def __init__(self, registry, path: str, interval_s: float = 60.0):
+        super().__init__(registry, interval_s)
+        self.path = path
+
+    def emit(self, snapshot):
+        now = int(time.time() * 1000)
+        with open(self.path, "a") as fh:
+            for name, val in sorted(snapshot.items()):
+                if isinstance(val, dict):
+                    for k, v in val.items():
+                        fh.write(f"{now}\t{name}.{k}\t{v}\n")
+                else:
+                    fh.write(f"{now}\t{name}\t{val}\n")
+
+
 class QueryTimeout(RuntimeError):
     """Raised when a query exceeds the store's timeout budget
     (the ThreadManagement reaper analog, index/utils/ThreadManagement.scala:

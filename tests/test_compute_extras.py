@@ -1,0 +1,178 @@
+"""Spatial join / convex hull / partitioner, analytic processes, metrics
+reporters, SFT-to-SFT conversion, auto-converter inference, multihost mesh."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.compute.frame import SpatialFrame
+from geomesa_tpu.compute.st_functions import st_convex_hull
+from geomesa_tpu.geom.base import Point, Polygon
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+
+@pytest.fixture()
+def store():
+    ds = TpuDataStore()
+    ds.create_schema(parse_spec("t", "name:String,age:Int,dtg:Date,*geom:Point:srid=4326"))
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    rng = np.random.default_rng(5)
+    with ds.writer("t") as w:
+        for i in range(60):
+            w.write(
+                [f"n{i % 4}", i, int(base + i * 60_000),
+                 Point(float(rng.uniform(-90, 90)), float(rng.uniform(-45, 45)))],
+                fid=f"f{i}",
+            )
+    return ds
+
+
+def test_spatial_join_points_in_polygons(store):
+    left = SpatialFrame.from_query(store, "t")
+    regions = SpatialFrame(
+        {
+            "__fid__": np.array(["west", "east"], dtype=object),
+            "geom": np.array(
+                [
+                    Polygon([[-90, -45], [0, -45], [0, 45], [-90, 45], [-90, -45]]),
+                    Polygon([[0, -45], [90, -45], [90, 45], [0, 45], [0, -45]]),
+                ],
+                dtype=object,
+            ),
+            "region": np.array(["W", "E"], dtype=object),
+        },
+        parse_spec("r", "region:String,*geom:Polygon:srid=4326"),
+    )
+    joined = left.spatial_join(regions, "intersects")
+    assert len(joined) == len(left)  # every point falls in exactly one half
+    x = joined.columns["geom__x"]
+    reg = joined.columns["region"]
+    assert all((r == "W") == (xx < 0) for r, xx in zip(reg, x))
+
+
+def test_spatial_join_dwithin(store):
+    left = SpatialFrame.from_query(store, "t")
+    sites = SpatialFrame(
+        {
+            "__fid__": np.array(["s"], dtype=object),
+            "geom__x": np.array([0.0]),
+            "geom__y": np.array([0.0]),
+            "site": np.array(["origin"], dtype=object),
+        },
+        parse_spec("s", "site:String,*geom:Point:srid=4326"),
+    )
+    joined = left.spatial_join(sites, "dwithin", distance_m=3_000_000.0)
+    from geomesa_tpu.process.geodesy import haversine_m
+
+    want = int(
+        (haversine_m(left.columns["geom__x"], left.columns["geom__y"], 0.0, 0.0)
+         <= 3_000_000).sum()
+    )
+    assert len(joined) == want > 0
+
+
+def test_convex_hull_and_partitioner(store):
+    f = SpatialFrame.from_query(store, "t")
+    hull = st_convex_hull(f.columns["geom__x"], f.columns["geom__y"])
+    assert isinstance(hull, Polygon)
+    from geomesa_tpu.geom.predicates import points_in_geometry
+
+    assert points_in_geometry(f.columns["geom__x"], f.columns["geom__y"], hull).all()
+    parts = f.partition_by_z2(bits=4)
+    assert sum(len(p) for p in parts.values()) == len(f)
+    assert len(parts) > 1
+
+
+def test_analytic_processes(store):
+    from geomesa_tpu.process.analytic import (
+        arrow_conversion,
+        bin_conversion,
+        min_max,
+        query_process,
+        sampling_process,
+        stats_process,
+    )
+
+    assert len(query_process(store, "t", "age < 10").fids) == 10
+    lo, hi = min_max(store, "t", "age")
+    assert (lo, hi) == (0, 59)
+    lo2, hi2 = min_max(store, "t", "age", cql="age > 9", exact=True)
+    assert (lo2, hi2) == (10, 59)
+    s = stats_process(store, "t", "MinMax(age)")
+    assert s.min == 0 and s.max == 59
+    sampled = sampling_process(store, "t", 10)
+    assert 0 < len(sampled.fids) <= 25
+    assert len(arrow_conversion(store, "t", dictionary=["name"])) > 0
+    assert len(bin_conversion(store, "t", track="name")) > 0
+
+
+def test_metrics_reporters(tmp_path):
+    from geomesa_tpu.utils.audit import (
+        ConsoleReporter,
+        DelimitedFileReporter,
+        MetricsRegistry,
+    )
+    import io
+
+    reg = MetricsRegistry()
+    reg.inc("queries", 3)
+    with reg.timer("scan"):
+        pass
+    buf = io.StringIO()
+    ConsoleReporter(reg, stream=buf).report_now()
+    assert "queries" in buf.getvalue()
+    path = str(tmp_path / "metrics.tsv")
+    DelimitedFileReporter(reg, path).report_now()
+    lines = open(path).read().splitlines()
+    assert any("queries\t3" in ln for ln in lines)
+    assert any(ln.split("\t")[1].startswith("scan.") for ln in lines)
+
+
+def test_sft_to_sft_conversion(store):
+    from geomesa_tpu.tools.convert import sft_to_sft
+
+    dst = parse_spec("slim", "label:String,*geom:Point:srid=4326")
+    feats = list(
+        sft_to_sft(
+            store, "t", dst,
+            {
+                "id-field": "$pid",
+                "fields": [
+                    {"name": "pid", "path": "$.__fid__"},
+                    {"name": "name", "path": "$.name"},
+                    {"name": "label", "transform": "uppercase($name)"},
+                    {"name": "geom", "path": "$.geom", "transform": "geometry($1)"},
+                ],
+            },
+            cql="age < 5",
+        )
+    )
+    assert len(feats) == 5
+    assert feats[0].values[0].startswith("N")
+
+
+def test_infer_converter_auto_ingest(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text(
+        "name,when,lon,lat,score\n"
+        "a,2026-01-01T00:00:00Z,10.5,20.5,7\n"
+        "b,2026-01-02T00:00:00Z,11.5,21.5,9\n"
+    )
+    from geomesa_tpu.tools.convert import infer_converter
+    from geomesa_tpu.tools.ingest import bulk_ingest
+
+    spec, config = infer_converter(str(p))
+    assert "when:Date" in spec and "*geom:Point" in spec and "score:Integer" in spec
+    ds = TpuDataStore()
+    ds.create_schema(parse_spec("auto", spec))
+    ec = bulk_ingest(ds, "auto", [str(p)], config, workers=1)
+    assert ec.success == 2 and ec.failure == 0
+    res = ds.query("auto", "bbox(geom, 10, 20, 12, 22)")
+    assert len(res.fids) == 2
+
+
+def test_multihost_mesh_local_noop():
+    from geomesa_tpu.parallel.mesh import multihost_mesh
+
+    mesh = multihost_mesh()
+    assert mesh.devices.size >= 1
