@@ -57,6 +57,7 @@ from geomesa_tpu.parallel.mesh import (
     pad_to_multiple,
     replicate,
     shard_array,
+    shard_map_fn,
 )
 from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
 
@@ -180,6 +181,60 @@ def _runs_fn(kind: str, rcap: int, mode: str, mesh):
 
         fn = jax.jit(run)
         _RUNS_FNS[key] = fn
+    return fn
+
+
+_KNN_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _knn_fn(k: int, mode: str, mesh):
+    """(xf, yf, valid, qx, qy) -> top-k row indices by f32 haversine.
+
+    pallas_spmd meshes rank per shard (k indices per chip, stacked) — the
+    per-tablet partial-result + client-merge shape of the reference's
+    distributed kNN, with lax.top_k as the per-chip ranker."""
+    key = (k, mode, mesh if mode == "pallas_spmd" else None)
+    fn = _KNN_FNS.get(key)
+    if fn is None:
+
+        def dists(xf, yf, valid, qx, qy):
+            rx = jnp.radians(xf)
+            ry = jnp.radians(yf)
+            qxr = jnp.radians(qx)
+            qyr = jnp.radians(qy)
+            sdy = jnp.sin((ry - qyr) * 0.5)
+            sdx = jnp.sin((rx - qxr) * 0.5)
+            a = sdy * sdy + jnp.cos(ry) * jnp.cos(qyr) * sdx * sdx
+            d = jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+            return jnp.where(valid, d, jnp.inf)
+
+        def local_topk(xf, yf, valid, qx, qy):
+            d = dists(xf, yf, valid, qx, qy)
+            kk = min(k, d.shape[0])
+            _, idx = jax.lax.top_k(-d, kk)
+            return idx
+
+        if mode == "pallas_spmd":
+            from jax.sharding import PartitionSpec as P
+
+            def per_shard(xf, yf, valid, qx, qy):
+                d = dists(xf, yf, valid, qx, qy)
+                kk = min(k, d.shape[0])
+                _, idx = jax.lax.top_k(-d, kk)
+                # shard-local -> segment-global row index
+                return idx + jax.lax.axis_index(DATA_AXIS) * d.shape[0]
+
+            body = shard_map_fn(
+                per_shard,
+                mesh,
+                in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+                out_specs=P(DATA_AXIS),
+                check=False,
+            )
+            fn = jax.jit(body)
+        else:
+            fn = jax.jit(local_topk)
+        _KNN_FNS[key] = fn
     return fn
 
 
@@ -695,6 +750,51 @@ class TpuScanExecutor:
             whi = bin_ms - 1 if hi_ms is None else min(hi_ms - start, bin_ms - 1)
             if whi >= wlo:
                 out.append((bn, wlo, whi))
+        return out
+
+    # -- device kNN ----------------------------------------------------------
+
+    def knn_candidates(self, table: IndexTable, x: float, y: float, k: int):
+        """Device top-k nearest candidates to (x, y); None -> host fallback.
+
+        The KNNQuery/GeoHashSpiral analog gone TPU-native: instead of
+        spiraling geohash cells outward, every chip ranks ITS resident rows
+        by f32 haversine distance in one fused pass (lax.top_k per shard
+        under shard_map) and ships back only k candidates per shard — a
+        fixed, tiny transfer independent of N. Candidates are a superset
+        ranked in f32; callers re-rank exactly in f64 (process/knn.py), so
+        results match the host path. Returns [(block, local_rows)] of the
+        per-segment candidates.
+        """
+        if table.index.name not in ("z2", "z3"):
+            return None
+        if self._has_visibilities(table):
+            # per-feature auth checks need the row-wise host path
+            return None
+        dev = self.device_index(table)
+        out = []
+        pend = []
+        for seg in dev.segments:
+            if not seg.n:
+                continue
+            if not seg.load_raw(table) and seg.xf is None:
+                return None
+            kk = min(k, seg.n)
+            mode = seg._mode()
+            fn = _knn_fn(kk, mode, self.mesh)
+            idx_d = fn(seg.xf, seg.yf, seg.valid,
+                       jnp.float32(x), jnp.float32(y))
+            try:
+                idx_d.copy_to_host_async()
+            except Exception:  # pragma: no cover
+                pass
+            pend.append((seg, idx_d))
+        for seg, idx_d in pend:
+            rows = np.unique(np.asarray(idx_d).ravel())
+            rows = rows[(rows >= 0) & (rows < seg.n)].astype(np.int64)
+            # drop padded/invalid slots that leaked through top_k
+            rows = rows[seg._valid_host[rows]]
+            out.extend(seg.to_block_rows(np.sort(rows)))
         return out
 
     # -- fused aggregation push-down ----------------------------------------

@@ -30,9 +30,15 @@ def query_process(store, name: str, cql: str = "INCLUDE"):
 
 def min_max(store, name: str, attribute: str, cql: str = "INCLUDE", exact: bool = False):
     """MinMaxProcess.scala: (min, max) of an attribute, from the write-time
-    MinMax sketch when available (exact=False) else by scanning."""
-    if not exact and cql == "INCLUDE" and store.stats is not None:
-        ft = store.get_schema(name)
+    MinMax sketch when available (exact=False) else by scanning. Sketches
+    observed EVERY row, so visibility-bearing and age-off types always scan
+    (same guards as datastore.count — unreadable/expired rows must not leak
+    into the bounds)."""
+    ft = store.get_schema(name)
+    table = next(iter(store._tables[name].values()), None)
+    has_vis = table is not None and any("__vis__" in b.columns for b in table.blocks)
+    expiring = getattr(store, "_age_off_cutoff", lambda _ft: None)(ft) is not None
+    if not exact and cql == "INCLUDE" and store.stats is not None and not has_vis and not expiring:
         sk = store.stats.stats_for(ft).get(f"minmax:{attribute}")
         if sk is not None and not sk.is_empty:
             return sk.min, sk.max
@@ -57,7 +63,9 @@ def stats_process(store, name: str, stat_spec: str, cql: str = "INCLUDE") -> Any
 def sampling_process(store, name: str, n: int, cql: str = "INCLUDE"):
     """SamplingProcess.scala: thin features to at most ~n via the sampling
     hint (rate-based, like SamplingIterator)."""
-    total = max(1, store.count(name, cql))
+    # an estimate suffices for an inherently-approximate rate (and avoids a
+    # full scan just to size the second scan)
+    total = max(1, store.count(name, cql, exact=False))
     q = Query.cql(cql)
     q.hints["sampling"] = min(1.0, n / total)
     return store.query(name, q)

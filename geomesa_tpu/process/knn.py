@@ -42,6 +42,12 @@ def knn_search(
 ) -> List[Tuple[str, float]]:
     """[(fid, distance_m)] of the k nearest features to (x, y), ascending."""
     ft = store.get_schema(name)
+    if cql is None:
+        direct = _device_knn(store, name, ft, x, y, k)
+        if direct is not None:
+            # honor the caller's search bound like the expanding-bbox path,
+            # which never looks past max_radius_m
+            return [(f, d) for f, d in direct if d <= max_radius_m]
     radius = float(initial_radius_m)
     result = None
     while True:
@@ -62,4 +68,39 @@ def knn_search(
         d = _distances(ft, result, x, y)
         order = np.argsort(d, kind="stable")[:k]
     fids = result.fids
+    return [(str(fids[i]), float(d[i])) for i in order]
+
+
+def _device_knn(store, name: str, ft, x: float, y: float, k: int):
+    """One-pass device top-k (executor.knn_candidates): every chip ranks
+    its resident rows and returns k candidates; exact f64 re-rank here.
+    None when the store has no device executor / no point index."""
+    knn = getattr(store.executor, "knn_candidates", None)
+    if knn is None:
+        return None
+    if getattr(store, "_age_off_cutoff", lambda _ft: None)(ft) is not None:
+        return None  # expired rows are masked by the query path only
+    tables = store._tables.get(name, {})
+    table = tables.get("z3") or tables.get("z2")
+    if table is None or table.num_rows == 0:
+        return None
+    parts = knn(table, x, y, k)
+    if parts is None:
+        return None
+    geom = ft.default_geometry.name
+    fids: List[str] = []
+    dists: List[np.ndarray] = []
+    seen = set()
+    for block, rows in parts:
+        px = block.columns[geom + "__x"][rows]
+        py = block.columns[geom + "__y"][rows]
+        bf = block.columns["__fid__"][rows]
+        keep = [i for i, f in enumerate(bf) if f not in seen]
+        seen.update(bf[keep])
+        fids.extend(bf[keep])
+        dists.append(haversine_m(px[keep], py[keep], x, y))
+    if not fids:
+        return []
+    d = np.concatenate(dists)
+    order = np.argsort(d, kind="stable")[:k]
     return [(str(fids[i]), float(d[i])) for i in order]

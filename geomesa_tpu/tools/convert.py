@@ -338,6 +338,14 @@ class SimpleFeatureConverter:
         extra = {
             "cachelookup": lambda cache, key: self.caches.get(cache, {}).get(key)
         }
+        # geomesa-convert-scripting analog: user-defined transform functions
+        # as Python lambda sources (the reference evaluates Nashorn JS the
+        # same way — converter configs are trusted local tooling input)
+        for fname, src in config.get("script-functions", {}).items():
+            fn = eval(compile(src, f"<script-function {fname}>", "eval"))  # noqa: S307
+            if not callable(fn):
+                raise ValueError(f"script-function {fname!r} is not callable")
+            extra[fname.lower()] = fn
         self.id_expr = (
             parse_transform(config["id-field"], extra) if config.get("id-field") else None
         )

@@ -189,6 +189,27 @@ def test_validators_reject_bad_rows():
     assert ec.success == 1 and ec.failure == 2
 
 
+def test_script_functions():
+    """geomesa-convert-scripting analog: lambdas in the config become
+    transform functions."""
+    conv = SimpleFeatureConverter(
+        FT,
+        {
+            "type": "delimited-text",
+            "script-functions": {
+                "shout": "lambda v: None if v is None else str(v).upper() + '!'"
+            },
+            "id-field": "$1",
+            "fields": [
+                {"name": "name", "transform": "shout($1)"},
+                {"name": "geom", "transform": "point(toDouble($2), toDouble($3))"},
+            ],
+        },
+    )
+    feats = list(conv.convert(io.StringIO("bob,1.0,2.0\n")))
+    assert feats[0].values[0] == "BOB!"
+
+
 def test_enrichment_cache_lookup(tmp_path):
     lookup = tmp_path / "codes.csv"
     lookup.write_text("US,United States\nFR,France\n")
