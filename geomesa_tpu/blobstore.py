@@ -12,6 +12,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import struct
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -55,6 +56,110 @@ class GeoJsonFileHandler(FileHandler):
         return None
 
 
+class ExifFileHandler(FileHandler):
+    """Handles geotagged JPEGs: pulls GPS lat/lon (+ timestamp) out of the
+    EXIF APP1 segment — the reference's ExifFileHandler role
+    (geomesa-blobstore FileHandler SPI) without the metadata-extractor jar.
+    Pure-Python TIFF/IFD walk; returns None when no GPS tags exist."""
+
+    def can_handle(self, filename: str) -> bool:
+        return filename.lower().endswith((".jpg", ".jpeg", ".tif", ".tiff"))
+
+    def extract(self, filename: str, data: bytes):
+        tiff = data if data[:2] in (b"II", b"MM") else _find_exif_tiff(data)
+        if tiff is None:
+            return None
+        try:
+            return _gps_from_tiff(tiff)
+        except Exception:
+            return None
+
+
+def _find_exif_tiff(data: bytes):
+    """Locate the TIFF blob inside a JPEG's APP1 Exif segment."""
+    if data[:2] != b"\xff\xd8":
+        return None
+    pos = 2
+    while pos + 4 <= len(data) and data[pos] == 0xFF:
+        marker = data[pos + 1]
+        if marker in (0xD8, 0x01) or 0xD0 <= marker <= 0xD7:
+            pos += 2
+            continue
+        (seglen,) = struct.unpack_from(">H", data, pos + 2)
+        if marker == 0xE1 and data[pos + 4 : pos + 10] == b"Exif\x00\x00":
+            return data[pos + 10 : pos + 2 + seglen]
+        pos += 2 + seglen
+    return None
+
+
+def _gps_from_tiff(tiff: bytes):
+    bo = "<" if tiff[:2] == b"II" else ">"
+
+    def u16(o):
+        return struct.unpack_from(bo + "H", tiff, o)[0]
+
+    def u32(o):
+        return struct.unpack_from(bo + "I", tiff, o)[0]
+
+    def ifd_entries(off):
+        n = u16(off)
+        for i in range(n):
+            e = off + 2 + 12 * i
+            yield u16(e), u16(e + 2), u32(e + 4), e + 8
+
+    def rationals(e_off, count):
+        off = u32(e_off)
+        return [
+            u32(off + 8 * i) / max(1, u32(off + 8 * i + 4)) for i in range(count)
+        ]
+
+    gps_off = None
+    for tag, _t, _c, val_off in ifd_entries(u32(4)):
+        if tag == 0x8825:  # GPS IFD pointer
+            gps_off = u32(val_off)
+    if gps_off is None:
+        return None
+    lat = lon = None
+    lat_ref, lon_ref = "N", "E"
+    date_str = None
+    time_hms = None
+    for tag, typ, cnt, val_off in ifd_entries(gps_off):
+        if tag == 1 and cnt <= 4:  # GPSLatitudeRef ("N\0" inline)
+            lat_ref = chr(tiff[val_off])
+        elif tag == 3 and cnt <= 4:  # GPSLongitudeRef
+            lon_ref = chr(tiff[val_off])
+        elif tag == 2 and typ == 5 and cnt == 3:  # GPSLatitude d/m/s
+            d, m, s = rationals(val_off, 3)
+            lat = d + m / 60.0 + s / 3600.0
+        elif tag == 4 and typ == 5 and cnt == 3:  # GPSLongitude
+            d, m, s = rationals(val_off, 3)
+            lon = d + m / 60.0 + s / 3600.0
+        elif tag == 7 and typ == 5 and cnt == 3:  # GPSTimeStamp h/m/s (UTC)
+            time_hms = rationals(val_off, 3)
+        elif tag == 0x1D and typ == 2:  # GPSDateStamp "YYYY:MM:DD"
+            off = u32(val_off) if cnt > 4 else val_off
+            date_str = tiff[off : off + cnt].split(b"\x00")[0].decode("ascii", "replace")
+    if lat is None or lon is None:
+        return None
+    if lat_ref.upper() == "S":
+        lat = -lat
+    if lon_ref.upper() == "W":
+        lon = -lon
+    t_ms = None
+    if date_str is not None:
+        try:
+            from datetime import datetime, timezone
+
+            dt = datetime.strptime(date_str, "%Y:%m:%d").replace(tzinfo=timezone.utc)
+            t_ms = int(dt.timestamp() * 1000)
+            if time_hms is not None:
+                h, m, s = time_hms
+                t_ms += int(((h * 60 + m) * 60 + s) * 1000)
+        except ValueError:
+            t_ms = None
+    return float(lon), float(lat), t_ms, {"source": "exif"}
+
+
 class BlobStore:
     def __init__(
         self,
@@ -68,7 +173,7 @@ class BlobStore:
         self._mem: Dict[str, bytes] = {}
         self.store = store or TpuDataStore()
         self.store.create_schema(parse_spec("blobs", _SPEC))
-        self.handlers = handlers if handlers is not None else [GeoJsonFileHandler()]
+        self.handlers = handlers if handlers is not None else [GeoJsonFileHandler(), ExifFileHandler()]
 
     def _blob_id(self, data: bytes) -> str:
         return hashlib.blake2b(data, digest_size=16).hexdigest()

@@ -35,8 +35,16 @@ def min_max(store, name: str, attribute: str, cql: str = "INCLUDE", exact: bool 
     (same guards as datastore.count — unreadable/expired rows must not leak
     into the bounds)."""
     ft = store.get_schema(name)
-    table = next(iter(store._tables[name].values()), None)
-    has_vis = table is not None and any("__vis__" in b.columns for b in table.blocks)
+    if hasattr(store, "_files"):
+        # lazy-capable fs store: blocks may not be resident — trust the
+        # durable visibility marker ('false' written on vis-free inserts;
+        # absent on legacy stores -> conservative scan), like fs count()
+        has_vis = store.metadata.read(name, "geomesa.vis") != "false"
+    else:
+        table = next(iter(store._tables[name].values()), None)
+        has_vis = table is not None and any(
+            "__vis__" in b.columns for b in table.blocks
+        )
     expiring = getattr(store, "_age_off_cutoff", lambda _ft: None)(ft) is not None
     if not exact and cql == "INCLUDE" and store.stats is not None and not has_vis and not expiring:
         sk = store.stats.stats_for(ft).get(f"minmax:{attribute}")

@@ -40,13 +40,13 @@ def knn_search(
     max_radius_m: float = 2_000_000.0,
     cql: Optional[str] = None,
 ) -> List[Tuple[str, float]]:
-    """[(fid, distance_m)] of the k nearest features to (x, y), ascending."""
+    """[(fid, distance_m)] of the k nearest features to (x, y), ascending.
+    Features beyond ``max_radius_m`` are never returned — identical
+    semantics on the device top-k and host expanding-bbox paths."""
     ft = store.get_schema(name)
     if cql is None:
         direct = _device_knn(store, name, ft, x, y, k)
         if direct is not None:
-            # honor the caller's search bound like the expanding-bbox path,
-            # which never looks past max_radius_m
             return [(f, d) for f, d in direct if d <= max_radius_m]
     radius = float(initial_radius_m)
     result = None
@@ -68,18 +68,28 @@ def knn_search(
         d = _distances(ft, result, x, y)
         order = np.argsort(d, kind="stable")[:k]
     fids = result.fids
-    return [(str(fids[i]), float(d[i])) for i in order]
+    return [
+        (str(fids[i]), float(d[i])) for i in order if d[i] <= max_radius_m
+    ]
 
 
 def _device_knn(store, name: str, ft, x: float, y: float, k: int):
     """One-pass device top-k (executor.knn_candidates): every chip ranks
     its resident rows and returns k candidates; exact f64 re-rank here.
     None when the store has no device executor / no point index."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     knn = getattr(store.executor, "knn_candidates", None)
     if knn is None:
         return None
     if getattr(store, "_age_off_cutoff", lambda _ft: None)(ft) is not None:
         return None  # expired rows are masked by the query path only
+    # lazy stores (FsDataStore) may have partitions on disk only; kNN has
+    # no pruning filter, so everything must be resident before ranking
+    ensure = getattr(store, "_ensure_loaded", None)
+    if ensure is not None:
+        ensure(name, None)
     tables = store._tables.get(name, {})
     table = tables.get("z3") or tables.get("z2")
     if table is None or table.num_rows == 0:
@@ -99,8 +109,32 @@ def _device_knn(store, name: str, ft, x: float, y: float, k: int):
         seen.update(bf[keep])
         fids.extend(bf[keep])
         dists.append(haversine_m(px[keep], py[keep], x, y))
+    out: List[Tuple[str, float]]
     if not fids:
-        return []
-    d = np.concatenate(dists)
-    order = np.argsort(d, kind="stable")[:k]
-    return [(str(fids[i]), float(d[i])) for i in order]
+        out = []
+    else:
+        d = np.concatenate(dists)
+        order = np.argsort(d, kind="stable")[:k]
+        out = [(str(fids[i]), float(d[i])) for i in order]
+    # the fast path bypasses store.query, so it must audit itself — the
+    # host fallback is audited per bbox query it issues
+    if store.metrics is not None:
+        store.metrics.inc("queries")
+        store.metrics.update_timer("query.scan", _time.perf_counter() - t0)
+    if store.audit_writer is not None:
+        from geomesa_tpu.utils.audit import QueryEvent
+
+        store.audit_writer.write_event(
+            QueryEvent(
+                store=type(store).__name__,
+                type_name=name,
+                user=store.user,
+                filter=f"KNN({x}, {y}, k={k})",
+                hints={"knn": k},
+                date_ms=int(_time.time() * 1000),
+                planning_ms=0.0,
+                scanning_ms=1000 * (_time.perf_counter() - t0),
+                hits=len(out),
+            )
+        )
+    return out

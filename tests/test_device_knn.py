@@ -82,7 +82,8 @@ def test_knn_with_filter_falls_back():
     tpu = _mk(TpuScanExecutor(default_mesh()))
     got = knn_search(tpu, "t", 0.0, 0.0, k=8, cql="name = 'n3'")
     assert len(got) == 8
-    assert all(True for _ in got)
+    n3 = set(tpu.query("t", "name = 'n3'").fids)
+    assert all(f in n3 for f, _ in got)  # filter actually honored
     res = tpu.query("t", "name = 'n3'")
     d = haversine_m(res.columns["geom__x"], res.columns["geom__y"], 0.0, 0.0)
     order = np.argsort(d, kind="stable")[:8]

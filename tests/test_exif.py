@@ -1,0 +1,94 @@
+"""EXIF GPS file handler: synthetic JPEG/TIFF with a GPS IFD."""
+
+import struct
+
+from geomesa_tpu.blobstore import BlobStore, ExifFileHandler
+
+
+def _rat(num, den=1):
+    return struct.pack("<II", num, den)
+
+
+def _make_tiff_gps(lat_dms, lon_dms, lat_ref=b"N", lon_ref=b"E",
+                   date=None, time_hms=None) -> bytes:
+    """Little-endian TIFF: IFD0 with a GPS pointer; GPS IFD with refs +
+    d/m/s rationals (+ optional GPSDateStamp / GPSTimeStamp)."""
+    n_entries = 4 + (1 if date else 0) + (1 if time_hms else 0)
+    ifd0_off = 8
+    gps_off = ifd0_off + 2 + 12 + 4
+    vals = gps_off + 2 + n_entries * 12 + 4
+    lat_vals = vals
+    lon_vals = lat_vals + 24
+    time_vals = lon_vals + 24
+    date_vals = time_vals + (24 if time_hms else 0)
+    out = bytearray()
+    out += b"II*\x00" + struct.pack("<I", ifd0_off)
+    # IFD0: 1 entry: GPSInfo pointer (0x8825, LONG)
+    out += struct.pack("<H", 1)
+    out += struct.pack("<HHI I", 0x8825, 4, 1, gps_off)
+    out += struct.pack("<I", 0)  # next IFD
+    out += struct.pack("<H", n_entries)
+    out += struct.pack("<HHI4s", 1, 2, 2, lat_ref + b"\x00\x00\x00")  # LatRef
+    out += struct.pack("<HHII", 2, 5, 3, lat_vals)  # Latitude rationals
+    out += struct.pack("<HHI4s", 3, 2, 2, lon_ref + b"\x00\x00\x00")  # LonRef
+    out += struct.pack("<HHII", 4, 5, 3, lon_vals)  # Longitude rationals
+    if time_hms:
+        out += struct.pack("<HHII", 7, 5, 3, time_vals)  # GPSTimeStamp
+    if date:
+        out += struct.pack("<HHII", 0x1D, 2, 11, date_vals)  # GPSDateStamp
+    out += struct.pack("<I", 0)
+    for d, m, s in (lat_dms,):
+        out += _rat(d) + _rat(m) + _rat(int(s * 100), 100)
+    for d, m, s in (lon_dms,):
+        out += _rat(d) + _rat(m) + _rat(int(s * 100), 100)
+    if time_hms:
+        h, m, s = time_hms
+        out += _rat(h) + _rat(m) + _rat(s)
+    if date:
+        out += date.encode("ascii") + b"\x00"
+    return bytes(out)
+
+
+def _wrap_jpeg(tiff: bytes) -> bytes:
+    app1 = b"Exif\x00\x00" + tiff
+    return b"\xff\xd8" + b"\xff\xe1" + struct.pack(">H", len(app1) + 2) + app1 + b"\xff\xd9"
+
+
+def test_exif_gps_extraction():
+    tiff = _make_tiff_gps((48, 51, 29.6), (2, 21, 5.0))
+    h = ExifFileHandler()
+    got = h.extract("eiffel.jpg", _wrap_jpeg(tiff))
+    assert got is not None
+    x, y, t, meta = got
+    assert abs(y - (48 + 51 / 60 + 29.6 / 3600)) < 1e-6
+    assert abs(x - (2 + 21 / 60 + 5.0 / 3600)) < 1e-6
+
+
+def test_exif_south_west_refs_and_blobstore():
+    tiff = _make_tiff_gps((33, 52, 0.0), (151, 12, 0.0), lat_ref=b"S", lon_ref=b"E")
+    blob = _wrap_jpeg(tiff)
+    bs = BlobStore()
+    bid = bs.put("sydney.jpg", blob)
+    res = bs.query("bbox(geom, 150, -35, 152, -33)")
+    assert len(res) == 1
+    assert bs.get(bid) == blob
+    # bare TIFF input works too
+    got = ExifFileHandler().extract("x.tiff", tiff)
+    assert got is not None and got[1] < 0  # southern hemisphere
+
+
+def test_exif_gps_timestamp():
+    tiff = _make_tiff_gps((10, 0, 0.0), (20, 0, 0.0),
+                          date="2026:03:05", time_hms=(13, 45, 30))
+    got = ExifFileHandler().extract("t.jpg", _wrap_jpeg(tiff))
+    assert got is not None
+    import numpy as np
+
+    want = np.datetime64("2026-03-05T13:45:30", "ms").astype("int64")
+    assert got[2] == int(want)
+
+
+def test_exif_no_gps_returns_none():
+    # TIFF with an empty IFD0
+    out = b"II*\x00" + struct.pack("<I", 8) + struct.pack("<H", 0) + struct.pack("<I", 0)
+    assert ExifFileHandler().extract("plain.jpg", _wrap_jpeg(out)) is None
