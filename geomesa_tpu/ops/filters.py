@@ -145,3 +145,33 @@ def bbox_mask_f32(
         & (y[:, None] <= yhi[None, :])
     )
     return jnp.any(inside, axis=1)
+
+
+def exact_st_mask(
+    x_hi: jnp.ndarray,
+    x_lo: jnp.ndarray,
+    y_hi: jnp.ndarray,
+    y_lo: jnp.ndarray,
+    valid: jnp.ndarray,
+    box: jnp.ndarray,
+    t_hi: jnp.ndarray = None,
+    t_lo: jnp.ndarray = None,
+    window: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """EXACT spatio-temporal predicate over f64/i64 sort-key limbs.
+
+    The candidate masks above are conservative (int-normalized domain);
+    this one IS the query predicate: coordinates travel as uint32 limb
+    pairs of their IEEE754 total-order keys (zkernels.f64_sort_keys), so
+    inclusive f64 bbox compares run exactly on devices with x64 disabled.
+    ``box`` = u32[8] (xmin/xmax/ymin/ymax key limbs), ``window`` = u32[4]
+    (t_lo/t_hi key limbs, inclusive ms). Rows passing this mask need NO
+    host post-filter for the primary predicate.
+    """
+    from geomesa_tpu.ops.zkernels import limbs_in_range
+
+    m = limbs_in_range(x_hi, x_lo, box[0], box[1], box[2], box[3])
+    m &= limbs_in_range(y_hi, y_lo, box[4], box[5], box[6], box[7])
+    if window is not None:
+        m &= limbs_in_range(t_hi, t_lo, window[0], window[1], window[2], window[3])
+    return m & valid

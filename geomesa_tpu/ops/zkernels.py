@@ -213,6 +213,42 @@ def split_i64_to_limbs(z) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return (z >> np.uint64(32)).astype(np.uint32), (z & np.uint64(0xFFFFFFFF)).astype(np.uint32)
 
 
+def f64_sort_keys(x) -> "np.ndarray":
+    """Host-side: float64 -> uint64 keys whose UNSIGNED order equals the
+    float total order (IEEE754 trick: flip all bits of negatives, flip the
+    sign bit of non-negatives). -0.0 is collapsed onto +0.0 first so the
+    key order matches `==`/`<=` semantics exactly; NaNs map above +inf
+    (positive NaN) or below -inf (negative NaN), so they fail any finite
+    range test — the behavior the exact device predicate needs for missing
+    coordinates. Enables EXACT f64 comparisons on a device whose jax
+    config has x64 disabled: compare the keys as two u32 limbs."""
+    import numpy as np
+
+    x = np.asarray(x, dtype=np.float64)
+    x = np.where(x == 0.0, 0.0, x)
+    bits = x.view(np.int64)
+    u = bits.view(np.uint64)
+    mask = np.where(
+        bits < 0, np.uint64(0xFFFFFFFFFFFFFFFF), np.uint64(0x8000000000000000)
+    )
+    return u ^ mask
+
+
+def i64_sort_keys(t) -> "np.ndarray":
+    """Host-side: int64 -> uint64 keys with matching unsigned order."""
+    import numpy as np
+
+    return np.asarray(t, dtype=np.int64).view(np.uint64) ^ np.uint64(1 << 63)
+
+
+def split_u64_to_limbs(u) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Host-side: uint64 keys -> (hi, lo) uint32 arrays."""
+    import numpy as np
+
+    u = np.asarray(u, dtype=np.uint64)
+    return (u >> np.uint64(32)).astype(np.uint32), (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
 def limbs_to_i64(hi, lo):
     """Host-side helper: (hi, lo) uint32 -> int64 keys (numpy in/out)."""
     import numpy as np

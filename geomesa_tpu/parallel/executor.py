@@ -158,29 +158,91 @@ def _fn_key(kind: str, mode: str, mesh) -> tuple:
     return (kind, mode, mesh if mode == "pallas_spmd" else None)
 
 
+def _runs_from_mask(m, rcap: int):
+    """Bool mask -> fused RLE buffer [count, n_runs, starts*rcap, lens*rcap]."""
+    cnt = jnp.sum(m.astype(jnp.int32))
+    prev = jnp.concatenate([jnp.zeros((1,), m.dtype), m[:-1]])
+    nxt = jnp.concatenate([m[1:], jnp.zeros((1,), m.dtype)])
+    starts_m = m & ~prev
+    nruns = jnp.sum(starts_m.astype(jnp.int32))
+    starts = jnp.nonzero(starts_m, size=rcap, fill_value=m.shape[0])[0]
+    ends = jnp.nonzero(m & ~nxt, size=rcap, fill_value=m.shape[0])[0]
+    head = jnp.stack([cnt, nruns])
+    return jnp.concatenate([head, starts, ends - starts + 1]).astype(jnp.int32)
+
+
 def _runs_fn(kind: str, rcap: int, mode: str, mesh):
-    """Mask -> fused RLE buffer [count, n_runs, starts*rcap, lens*rcap]."""
+    """Mask -> fused RLE buffer (see _runs_from_mask)."""
     key = (rcap,) + _fn_key(kind, mode, mesh)
     fn = _RUNS_FNS.get(key)
     if fn is None:
         mask = _raw_mask_fn(kind, mode, mesh)
 
         def run(*args):
-            m = mask(*args)
-            cnt = jnp.sum(m.astype(jnp.int32))
-            prev = jnp.concatenate([jnp.zeros((1,), m.dtype), m[:-1]])
-            nxt = jnp.concatenate([m[1:], jnp.zeros((1,), m.dtype)])
-            starts_m = m & ~prev
-            nruns = jnp.sum(starts_m.astype(jnp.int32))
-            starts = jnp.nonzero(starts_m, size=rcap, fill_value=m.shape[0])[0]
-            ends = jnp.nonzero(m & ~nxt, size=rcap, fill_value=m.shape[0])[0]
-            head = jnp.stack([cnt, nruns])
-            return jnp.concatenate(
-                [head, starts, ends - starts + 1]
-            ).astype(jnp.int32)
+            return _runs_from_mask(mask(*args), rcap)
 
         fn = jax.jit(run)
         _RUNS_FNS[key] = fn
+    return fn
+
+
+def _exact_mask_body(has_time: bool, mode: str, mesh):
+    """Unjitted exact-predicate mask callable (ops.filters.exact_st_mask),
+    shard_map-wrapped for multi-chip meshes."""
+    from geomesa_tpu.ops.filters import exact_st_mask
+
+    if has_time:
+        def body(xh, xl, yh, yl, th, tl, valid, box, win):
+            return exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
+        nrow = 7
+        nrep = 2
+    else:
+        def body(xh, xl, yh, yl, valid, box):
+            return exact_st_mask(xh, xl, yh, yl, valid, box)
+        nrow = 5
+        nrep = 1
+    if mode != "spmd":
+        return body
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map_fn(
+        body,
+        mesh,
+        in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * nrep),
+        out_specs=P(DATA_AXIS),
+        check=False,
+    )
+
+
+_EXACT_RUNS_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_EXACT_PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
+    key = (has_time, rcap, mode, mesh if mode == "spmd" else None)
+    fn = _EXACT_RUNS_FNS.get(key)
+    if fn is None:
+        mask = _exact_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            return _runs_from_mask(mask(*args), rcap)
+
+        fn = jax.jit(run)
+        _EXACT_RUNS_FNS[key] = fn
+    return fn
+
+
+def _exact_packed_fn(has_time: bool, mode: str, mesh):
+    key = (has_time, mode, mesh if mode == "spmd" else None)
+    fn = _EXACT_PACKED_FNS.get(key)
+    if fn is None:
+        mask = _exact_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            return jnp.packbits(mask(*args))
+
+        fn = jax.jit(run)
+        _EXACT_PACKED_FNS[key] = fn
     return fn
 
 
@@ -374,6 +436,13 @@ class DeviceSegment:
         if not np.array_equal(keep, self._valid_host):
             self._valid_host = keep
             self.valid = self._pack([keep], bool, False)
+            if getattr(self, "_exact_loaded", False) and self.tvalid is not None:
+                nulls = getattr(self, "_t_nulls_host", None)
+                self.tvalid = (
+                    self.valid
+                    if nulls is None
+                    else self._pack([keep & ~nulls], bool, False)
+                )
 
     def load_raw(self, table: IndexTable) -> bool:
         """Pack raw f32 coords (+ in-bin ms offsets for day/week z3) for the
@@ -448,7 +517,94 @@ class DeviceSegment:
             buf.copy_to_host_async()
         except Exception:  # pragma: no cover - transfer started lazily
             pass
-        return _PendingHits(self, args, rcap, buf)
+        return _PendingHits(
+            self,
+            rcap,
+            buf,
+            refetch=lambda rc: _runs_fn(self.kind, rc, mode, self.mesh)(*args),
+            packed=lambda: _packed_fn(self.kind, mode, self.mesh)(*args),
+        )
+
+    def load_exact(self, table: IndexTable) -> bool:
+        """Pack f64/i64 SORT-KEY limb columns for the EXACT device
+        predicate path (zkernels.f64_sort_keys — u32 limb compares give
+        exact f64 semantics without jax x64); False when unsupported."""
+        if self.kind not in ("z2", "z3"):
+            return False
+        if getattr(self, "_exact_loaded", False):
+            return True
+        from geomesa_tpu.ops.zkernels import (
+            f64_sort_keys,
+            i64_sort_keys,
+            split_u64_to_limbs,
+        )
+
+        ft = table.ft
+        geom = ft.default_geometry.name
+
+        def pack_keys(keys: np.ndarray):
+            hi, lo = split_u64_to_limbs(keys)
+            # pad with max-key: never inside a finite range (valid also
+            # masks pads, this is belt+braces)
+            return (
+                self._pack([hi], np.uint32, np.uint32(0xFFFFFFFF)),
+                self._pack([lo], np.uint32, np.uint32(0xFFFFFFFF)),
+            )
+
+        xs = np.concatenate([b.columns[geom + "__x"] for b in self.blocks])
+        ys = np.concatenate([b.columns[geom + "__y"] for b in self.blocks])
+        self.xk_hi, self.xk_lo = pack_keys(f64_sort_keys(xs))
+        self.yk_hi, self.yk_lo = pack_keys(f64_sort_keys(ys))
+        if self.kind == "z3":
+            dtg = ft.default_date.name
+            ts = np.concatenate(
+                [b.columns[dtg].astype(np.int64) for b in self.blocks]
+            )
+            self.tk_hi, self.tk_lo = pack_keys(i64_sort_keys(ts))
+            # null dates are stored as 0 + a __null mask: the host evaluator
+            # rejects them for any temporal predicate, so the exact TEMPORAL
+            # mask needs its own valid column (bbox-only queries keep them)
+            nulls = np.concatenate(
+                [
+                    b.columns.get(dtg + "__null", np.zeros(b.n, dtype=bool))
+                    for b in self.blocks
+                ]
+            )
+            self._t_nulls_host = nulls if nulls.any() else None
+            if self._t_nulls_host is not None:
+                self.tvalid = self._pack([self._valid_host & ~nulls], bool, False)
+            else:
+                self.tvalid = self.valid
+        else:
+            self.tk_hi = self.tk_lo = None
+            self.tvalid = None
+        self._exact_loaded = True
+        return True
+
+    def dispatch_exact(self, box_dev, win_dev) -> "_PendingHits":
+        """Exact predicate scan (see TpuScanExecutor._exact_descriptor)."""
+        has_time = self.tk_hi is not None and win_dev is not None
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        if has_time:
+            args = (
+                self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo,
+                self.tk_hi, self.tk_lo, self.tvalid, box_dev, win_dev,
+            )
+        else:
+            args = (self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo, self.valid, box_dev)
+        rcap = self._rcap
+        buf = _exact_runs_fn(has_time, rcap, mode, self.mesh)(*args)
+        try:
+            buf.copy_to_host_async()
+        except Exception:  # pragma: no cover
+            pass
+        return _PendingHits(
+            self,
+            rcap,
+            buf,
+            refetch=lambda rc: _exact_runs_fn(has_time, rc, mode, self.mesh)(*args),
+            packed=lambda: _exact_packed_fn(has_time, mode, self.mesh)(*args),
+        )
 
     def hit_rows(self, boxes_dev, windows_dev) -> np.ndarray:
         """Sorted candidate row indices, compacted ON DEVICE (sync)."""
@@ -476,13 +632,14 @@ class _PendingHits:
     bitmap — the only case where a second round trip is paid.
     """
 
-    __slots__ = ("seg", "args", "rcap", "buf", "_rows")
+    __slots__ = ("seg", "rcap", "buf", "_refetch", "_packed", "_rows")
 
-    def __init__(self, seg: DeviceSegment, args, rcap: int, buf):
+    def __init__(self, seg: DeviceSegment, rcap: int, buf, refetch, packed):
         self.seg = seg
-        self.args = args
         self.rcap = rcap
         self.buf = buf
+        self._refetch = refetch  # rcap -> new runs buffer (device)
+        self._packed = packed  # () -> packed bitmap (device), or None
         self._rows: Optional[np.ndarray] = None
 
     def rows(self) -> np.ndarray:
@@ -499,14 +656,15 @@ class _PendingHits:
             return np.empty(0, dtype=np.int64)
         rcap = self.rcap
         if nruns > rcap:
-            if nruns > max(1, seg.n_padded // DENSE_BITMAP_FACTOR):
+            if self._packed is not None and nruns > max(
+                1, seg.n_padded // DENSE_BITMAP_FACTOR
+            ):
                 # fragmented + dense: the bitmap is the smaller transfer
-                packed = _packed_fn(seg.kind, seg._mode(), seg.mesh)(*self.args)
-                mask = np.unpackbits(np.asarray(packed))[: seg.n].astype(bool)
+                mask = np.unpackbits(np.asarray(self._packed()))[: seg.n].astype(bool)
                 return np.flatnonzero(mask)
             while rcap < nruns:
                 rcap *= 2
-            buf = np.asarray(_runs_fn(seg.kind, rcap, seg._mode(), seg.mesh)(*self.args))
+            buf = np.asarray(self._refetch(rcap))
         starts = buf[2 : 2 + nruns].astype(np.int64)
         lens = buf[2 + rcap : 2 + rcap + nruns].astype(np.int64)
         # expand runs -> sorted row indices
@@ -517,12 +675,18 @@ class _PendingHits:
 
 class _PendingScan:
     """All of one table's dispatched segment scans; iterating resolves them
-    in order and maps segment-local rows back to (block, local rows)."""
+    in order and maps segment-local rows back to (block, local rows).
 
-    __slots__ = ("pending",)
+    ``exact=True`` marks hit lists computed by the EXACT f64 predicate on
+    device (no conservative over-coverage): the caller may skip its host
+    post-filter entirely for the primary spatio-temporal predicate.
+    """
 
-    def __init__(self, pending):
+    __slots__ = ("pending", "exact")
+
+    def __init__(self, pending, exact: bool = False):
         self.pending = pending
+        self.exact = exact
 
     def __iter__(self):
         for seg, ph in self.pending:
@@ -635,11 +799,28 @@ class TpuScanExecutor:
         computing/transferring before the first blocking decode, so many
         dispatches pipeline over the device link and the round-trip latency
         is paid once per batch, not once per scan (the BatchScanner
-        thread-pool analog, AccumuloQueryPlan.scala:113-140)."""
+        thread-pool analog, AccumuloQueryPlan.scala:113-140).
+
+        Pure bbox(+interval) filters take the EXACT predicate path: the
+        device evaluates the query's own f64/ms semantics (sort-key limb
+        compares), so hits need no host post-filter at all — the full
+        tserver-iterator role (Z3Iterator + KryoLazyFilterTransformIterator
+        combined) on device."""
         if not self.supports(table, plan):
             return None
         if table.index.name in ("z3", "xz3") and not plan.values.bins:
             return None
+        desc = self._exact_descriptor(table, plan)
+        if desc is not None:
+            dev = self.device_index(table)
+            if all(seg.load_exact(table) for seg in dev.segments):
+                box_np, win_np = desc
+                box_dev = replicate(self.mesh, box_np)
+                win_dev = None if win_np is None else replicate(self.mesh, win_np)
+                return _PendingScan(
+                    [(seg, seg.dispatch_exact(box_dev, win_dev)) for seg in dev.segments],
+                    exact=True,
+                )
         dev = self.device_index(table)
         boxes_dev, windows_dev = self._query_descriptor(table, plan)
         return _PendingScan(
@@ -647,9 +828,101 @@ class TpuScanExecutor:
         )
 
     def scan_candidates(self, table: IndexTable, plan: QueryPlan):
-        """Device candidate scan; None -> caller falls back to host ranges."""
-        pending = self.dispatch_candidates(table, plan)
-        return None if pending is None else iter(pending)
+        """Device candidate scan; None -> caller falls back to host ranges.
+        Returns the iterable _PendingScan (carrying .exact) directly."""
+        return self.dispatch_candidates(table, plan)
+
+    def _exact_descriptor(self, table: IndexTable, plan: QueryPlan):
+        """(box key limbs u32[8], window key limbs u32[4] | None) when the
+        FULL filter is exactly one AND-combination of inclusive-envelope
+        spatial tests on the default point geometry plus interval tests on
+        the default date — i.e. the device can evaluate the query's own
+        semantics. None otherwise (conservative mask + host post-filter).
+        """
+        import os
+
+        env = os.environ.get("GEOMESA_EXACT_DEVICE", "auto")
+        if env == "0":
+            return None
+        if env != "1" and jax.default_backend() == "cpu":
+            # auto: on the CPU backend "device" compute IS host compute —
+            # the wider limb columns cost more than the post-filter saves.
+            # On real accelerators the exact mask is memory-bound free and
+            # eliminates the host post-filter entirely.
+            return None
+        if table.index.name not in ("z2", "z3") or plan.secondary is not None:
+            return None
+        ft = table.ft
+        f = plan.full_filter
+        if f is None:
+            return None
+        from geomesa_tpu.filter import ast as A
+
+        geom = ft.default_geometry.name
+        dtg = ft.default_date.name if ft.default_date is not None else None
+        boxes: List = []
+        t_lo, t_hi = None, None  # inclusive ms, None = open
+
+        def clamp_lo(v):
+            nonlocal t_lo
+            t_lo = v if t_lo is None else max(t_lo, v)
+
+        def clamp_hi(v):
+            nonlocal t_hi
+            t_hi = v if t_hi is None else min(t_hi, v)
+
+        def walk(node) -> bool:
+            if isinstance(node, A.And):
+                return all(walk(c) for c in node.children())
+            if isinstance(node, A.BBox) and node.prop == geom:
+                boxes.append(node.envelope)
+                return True
+            if isinstance(node, A.Intersects) and node.prop == geom:
+                g = node.geometry
+                if hasattr(g, "is_rectangle") and g.is_rectangle():
+                    boxes.append(g.envelope)
+                    return True
+                return False
+            if dtg is not None and isinstance(node, A.During) and node.prop == dtg:
+                clamp_lo(node.lo_ms + 1)  # DURING bounds are exclusive
+                clamp_hi(node.hi_ms - 1)
+                return True
+            if dtg is not None and isinstance(node, A.After) and node.prop == dtg:
+                clamp_lo(node.t_ms + 1)
+                return True
+            if dtg is not None and isinstance(node, A.Before) and node.prop == dtg:
+                clamp_hi(node.t_ms - 1)
+                return True
+            if dtg is not None and isinstance(node, A.TEquals) and node.prop == dtg:
+                clamp_lo(node.t_ms)
+                clamp_hi(node.t_ms)
+                return True
+            return False
+
+        if not walk(f) or not boxes:
+            return None
+        if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
+            return None  # temporal test needs the time column (z3 segments)
+        from geomesa_tpu.ops.zkernels import f64_sort_keys, i64_sort_keys, split_u64_to_limbs
+
+        env = boxes[0]
+        xmin, ymin, xmax, ymax = env.xmin, env.ymin, env.xmax, env.ymax
+        for e in boxes[1:]:  # AND of boxes = envelope intersection
+            xmin, ymin = max(xmin, e.xmin), max(ymin, e.ymin)
+            xmax, ymax = min(xmax, e.xmax), min(ymax, e.ymax)
+        bk = f64_sort_keys(np.asarray([xmin, xmax, ymin, ymax]))
+        hi, lo = split_u64_to_limbs(bk)
+        box_np = np.asarray(
+            [hi[0], lo[0], hi[1], lo[1], hi[2], lo[2], hi[3], lo[3]], dtype=np.uint32
+        )
+        win_np = None
+        if t_lo is not None or t_hi is not None:
+            lo_ms = np.iinfo(np.int64).min + 1 if t_lo is None else t_lo
+            hi_ms = np.iinfo(np.int64).max if t_hi is None else t_hi
+            tk = i64_sort_keys(np.asarray([lo_ms, hi_ms]))
+            thi, tlo = split_u64_to_limbs(tk)
+            win_np = np.asarray([thi[0], tlo[0], thi[1], tlo[1]], dtype=np.uint32)
+        return box_np, win_np
 
     def _query_descriptor(self, table: IndexTable, plan: QueryPlan):
         """(boxes, windows) device-replicated arrays for this plan."""
@@ -692,6 +965,24 @@ class TpuScanExecutor:
                 ]
             )
             if table.index.name == "z3":
+                # plan.values.bins came from SECOND-rounded intervals (the
+                # reference's handleExclusiveBounds narrows inward,
+                # FilterHelper.scala:267-287) — fine for ranges, which the
+                # BFS loosens back into supersets, but a DIRECT window mask
+                # would drop true matches inside the rounded-off second.
+                # Rebuild per-bin windows from the UNROUNDED intervals
+                # (times_by_bin applies the exact ±1ms exclusive shift);
+                # floor-normalization keeps both ends conservative.
+                bins = plan.values.bins
+                if plan.full_filter is not None and table.ft.default_date is not None:
+                    from geomesa_tpu.filter.extract import extract_intervals
+                    from geomesa_tpu.index.keyspace import times_by_bin
+
+                    iv = extract_intervals(
+                        plan.full_filter, table.ft.default_date.name
+                    )
+                    if iv is not None and iv.values and not iv.disjoint:
+                        bins = times_by_bin(iv, table.ft.z3_interval)
                 windows = pad_windows(
                     [
                         (
@@ -699,7 +990,7 @@ class TpuScanExecutor:
                             int(sfc.time.normalize(lo)[()]),
                             int(sfc.time.normalize(hi)[()]),
                         )
-                        for b, (lo, hi) in sorted(plan.values.bins.items())
+                        for b, (lo, hi) in sorted(bins.items())
                     ]
                 )
         boxes_dev = replicate(self.mesh, boxes)

@@ -45,9 +45,9 @@ def knn_search(
     semantics on the device top-k and host expanding-bbox paths."""
     ft = store.get_schema(name)
     if cql is None:
-        direct = _device_knn(store, name, ft, x, y, k)
+        direct = _device_knn(store, name, ft, x, y, k, max_radius_m)
         if direct is not None:
-            return [(f, d) for f, d in direct if d <= max_radius_m]
+            return direct
     radius = float(initial_radius_m)
     result = None
     while True:
@@ -73,7 +73,8 @@ def knn_search(
     ]
 
 
-def _device_knn(store, name: str, ft, x: float, y: float, k: int):
+def _device_knn(store, name: str, ft, x: float, y: float, k: int,
+                max_radius_m: float = np.inf):
     """One-pass device top-k (executor.knn_candidates): every chip ranks
     its resident rows and returns k candidates; exact f64 re-rank here.
     None when the store has no device executor / no point index."""
@@ -115,7 +116,10 @@ def _device_knn(store, name: str, ft, x: float, y: float, k: int):
     else:
         d = np.concatenate(dists)
         order = np.argsort(d, kind="stable")[:k]
-        out = [(str(fids[i]), float(d[i])) for i in order]
+        # radius bound applied BEFORE auditing so hits == returned results
+        out = [
+            (str(fids[i]), float(d[i])) for i in order if d[i] <= max_radius_m
+        ]
     # the fast path bypasses store.query, so it must audit itself — the
     # host fallback is audited per bbox query it issues
     if store.metrics is not None:

@@ -481,6 +481,10 @@ class TpuDataStore:
             and gv.precise
             and all(g.is_rectangle() for g in gv.values)
         )
+        if getattr(scan, "exact", False):
+            # the device evaluated the query's own f64/ms predicate
+            # (executor._exact_descriptor): candidates ARE the result set
+            loose = True
         for block, rows in scan:
             if self.query_timeout_s is not None and (
                 _time.perf_counter() - t_scan_start > self.query_timeout_s

@@ -1,0 +1,165 @@
+"""Exact device predicate path: the f64/ms query semantics evaluated on
+device via sort-key limb compares — results must match the host path
+bit-for-bit, INCLUDING boundary values, and the host post-filter must not
+run at all for pure bbox+interval filters."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.parallel import executor as ex
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+
+
+@pytest.fixture(autouse=True)
+def _force_exact(monkeypatch):
+    # 'auto' disables the exact path on the CPU backend; tests force it
+    monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "1")
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+BASE = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+CQL = "bbox(geom, -20, -20, 20, 20) AND dtg DURING 2026-01-02T00:00:00Z/2026-01-20T00:00:00Z"
+
+
+def _pair(n=2500, seed=7, boundary=True):
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    for s in (host, tpu):
+        s.create_schema(parse_spec("t", SPEC))
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        rows.append(
+            (f"f{i}", f"n{i % 5}",
+             int(BASE + int(rng.integers(0, 25 * 86400_000))),
+             float(rng.uniform(-60, 60)), float(rng.uniform(-60, 60)))
+        )
+    if boundary:
+        # adversarial: points EXACTLY on the box edges and interval endpoints
+        t_lo = int(np.datetime64("2026-01-02T00:00:00", "ms").astype("int64"))
+        t_hi = int(np.datetime64("2026-01-20T00:00:00", "ms").astype("int64"))
+        rows += [
+            ("edge-xmin", "e", t_lo + 1, -20.0, 0.0),
+            ("edge-xmax", "e", t_hi - 1, 20.0, 0.0),
+            ("edge-ymin", "e", t_lo + 1, 0.0, -20.0),
+            ("edge-ymax", "e", t_hi - 1, 0.0, 20.0),
+            ("edge-t-lo", "e", t_lo, 0.0, 0.0),       # DURING excludes lo
+            ("edge-t-lo1", "e", t_lo + 1, 0.0, 0.0),  # first included ms
+            ("edge-t-hi", "e", t_hi, 0.0, 0.0),       # DURING excludes hi
+            ("edge-t-hi1", "e", t_hi - 1, 0.0, 0.0),  # last included ms
+            ("corner", "e", t_lo + 1, -20.0, -20.0),
+            ("outside-x", "e", t_lo + 1, np.nextafter(20.0, 100.0), 0.0),
+            ("neg-zero", "e", t_lo + 1, -0.0, 0.0),
+        ]
+    for s in (host, tpu):
+        with s.writer("t") as w:
+            for fid, name, t, x, y in rows:
+                w.write([name, t, Point(x, y)], fid=fid)
+    return host, tpu
+
+
+def test_exact_path_is_selected_and_parity_holds():
+    host, tpu = _pair()
+    plan = tpu._plan_cached("t", tpu._as_query(CQL))
+    table = tpu._tables["t"][plan.index.name]
+    desc = tpu.executor._exact_descriptor(table, plan)
+    assert desc is not None  # pure bbox+DURING -> exact path
+    scan = tpu.executor.scan_candidates(table, plan)
+    assert getattr(scan, "exact", False)
+    got = sorted(tpu.query("t", CQL).fids)
+    want = sorted(host.query("t", CQL).fids)
+    assert got == want
+    # boundary semantics: edges included, DURING endpoints excluded
+    assert "edge-xmin" in got and "edge-xmax" in got
+    assert "edge-t-lo1" in got and "edge-t-hi1" in got
+    assert "edge-t-lo" not in got and "edge-t-hi" not in got
+    assert "outside-x" not in got
+    assert "neg-zero" in got
+
+
+def test_exact_path_skips_host_post_filter(monkeypatch):
+    _, tpu = _pair(n=800)
+
+    def boom(*a, **k):
+        raise AssertionError("post_filter must not run on the exact path")
+
+    monkeypatch.setattr(type(tpu.executor), "post_filter", boom)
+    res = tpu.query("t", CQL)
+    assert len(res.fids) > 0
+
+
+def test_residual_filters_still_post_filter():
+    host, tpu = _pair(n=1200)
+    cql = CQL + " AND name = 'n3'"
+    got = sorted(tpu.query("t", cql).fids)
+    want = sorted(host.query("t", cql).fids)
+    assert got == want
+    plan = tpu._plan_cached("t", tpu._as_query(cql))
+    table = tpu._tables["t"][plan.index.name]
+    assert tpu.executor._exact_descriptor(table, plan) is None  # residual -> conservative
+
+
+def test_exact_path_bbox_only_z2():
+    host, tpu = _pair(n=1500)
+    cql = "bbox(geom, -15.5, -10.25, 18.75, 12.125)"
+    got = sorted(tpu.query("t", cql).fids)
+    assert got == sorted(host.query("t", cql).fids)
+    plan = tpu._plan_cached("t", tpu._as_query(cql))
+    table = tpu._tables["t"][plan.index.name]
+    desc = tpu.executor._exact_descriptor(table, plan)
+    assert desc is not None and desc[1] is None  # no temporal window
+
+
+def test_exact_path_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "0")
+    host, tpu = _pair(n=900)
+    got = sorted(tpu.query("t", CQL).fids)
+    assert got == sorted(host.query("t", CQL).fids)
+    plan = tpu._plan_cached("t", tpu._as_query(CQL))
+    table = tpu._tables["t"][plan.index.name]
+    assert tpu.executor._exact_descriptor(table, plan) is None
+
+
+def test_exact_path_with_deletes_and_escalation(monkeypatch):
+    monkeypatch.setattr(ex, "HIT_CAPACITY0", 16)  # force escalation path
+    host, tpu = _pair(n=2000)
+    victims = [f"f{i}" for i in range(0, 2000, 4)]
+    host.delete_features("t", victims)
+    tpu.delete_features("t", victims)
+    got = sorted(tpu.query("t", CQL).fids)
+    assert got == sorted(host.query("t", CQL).fids)
+    assert not (set(got) & set(victims))
+
+
+def test_exact_path_excludes_null_dates():
+    """Null dtg rows are stored as epoch 0 + a __null mask: temporal exact
+    scans must reject them (the host evaluator does), while bbox-only
+    queries keep them."""
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    for s in (host, tpu):
+        s.create_schema(parse_spec("t", SPEC))
+        with s.writer("t") as w:
+            w.write(["a", int(BASE + 86400_000), Point(1.0, 1.0)], fid="has-date")
+            w.write(["b", None, Point(1.5, 1.5)], fid="null-date")
+    # open-low window covering epoch 0: the null row must still be excluded
+    cql = "bbox(geom, 0, 0, 2, 2) AND dtg BEFORE 2026-02-01T00:00:00Z"
+    got = sorted(tpu.query("t", cql).fids)
+    assert got == sorted(host.query("t", cql).fids) == ["has-date"]
+    # bbox-only: null-date feature IS a result
+    got2 = sorted(tpu.query("t", "bbox(geom, 0, 0, 2, 2)").fids)
+    assert got2 == sorted(host.query("t", "bbox(geom, 0, 0, 2, 2)").fids)
+    assert "null-date" in got2
+    # delete the null row: temporal + bbox-only paths both drop it
+    tpu.delete_features("t", ["has-date"])
+    host.delete_features("t", ["has-date"])
+    assert sorted(tpu.query("t", cql).fids) == sorted(host.query("t", cql).fids) == []
+
+
+def test_exact_path_spmd_mode(monkeypatch):
+    monkeypatch.setenv("GEOMESA_PALLAS", "spmd")
+    host, tpu = _pair(n=1600)
+    got = sorted(tpu.query("t", CQL).fids)
+    assert got == sorted(host.query("t", CQL).fids)
