@@ -401,7 +401,15 @@ class TpuDataStore:
             for arm in plan.union:
                 if arm.is_empty:
                     continue
-                parts.extend(self._scan_parts(name, ft, query, arm, t_scan_start, pending))
+                # arms gather the FULL column set: per-arm pruning would
+                # give concat_columns inconsistent parts (each arm's
+                # post-filter needs different columns); the projection is
+                # applied after the union instead
+                parts.extend(
+                    self._scan_parts(
+                        name, ft, query, arm, t_scan_start, pending, allow_prune=False
+                    )
+                )
             columns = concat_columns(parts) if parts else _empty_columns(ft)
             columns = _dedupe_by_fid(columns)
             return self._finish(ft, query, plan, columns)
@@ -446,7 +454,8 @@ class TpuDataStore:
         return QueryResult(ft, columns, plan)
 
     def _scan_parts(
-        self, name, ft, query: Query, plan: QueryPlan, t_scan_start, pending=None
+        self, name, ft, query: Query, plan: QueryPlan, t_scan_start, pending=None,
+        allow_prune: bool = True,
     ) -> List[Columns]:
         import time as _time
 
@@ -485,6 +494,14 @@ class TpuDataStore:
             # the device evaluated the query's own f64/ms predicate
             # (executor._exact_descriptor): candidates ARE the result set
             loose = True
+        # projection pushdown into the gather (the transform-schema
+        # pruning of QueryPlanner.scala:192-284 applied at scan time):
+        # only columns the query can observe leave the blocks
+        needed = (
+            self._needed_columns(ft, query, plan, loose, age_cutoff)
+            if allow_prune
+            else None
+        )
         for block, rows in scan:
             if self.query_timeout_s is not None and (
                 _time.perf_counter() - t_scan_start > self.query_timeout_s
@@ -500,6 +517,7 @@ class TpuDataStore:
                 k: v[rows]
                 for k, v in block.columns.items()
                 if k not in ("__fid__", "__vis__")
+                and (needed is None or _column_base(k) in needed)
             }
             if age_cutoff is not None:
                 dtg = ft.default_date.name
@@ -529,6 +547,28 @@ class TpuDataStore:
             if len(rows):
                 parts.append(mask_cols)
         return parts
+
+    def _needed_columns(
+        self, ft: FeatureType, query: Query, plan: QueryPlan, loose: bool, age_cutoff
+    ) -> Optional[set]:
+        """Attribute base-names the scan must gather; None = everything.
+        Only prunes when an explicit projection makes the need explicit."""
+        props = query.properties
+        if props is None or has_aggregation(query.hints):
+            return None
+        if any("=" in p for p in props):
+            return None  # derived transforms read arbitrary source columns
+        needed = set(props)
+        if plan.post_filter is not None and not loose:
+            needed.update(ast.properties(plan.post_filter))
+        if query.sort_by:
+            needed.update(a for a, _ in query.sort_by)
+        sample_by = query.hints.get("sample_by")
+        if sample_by:
+            needed.add(sample_by)
+        if age_cutoff is not None and ft.default_date is not None:
+            needed.add(ft.default_date.name)
+        return needed
 
     def _age_off_cutoff(self, ft: FeatureType) -> Optional[int]:
         """Epoch-ms cutoff below which features are expired, or None.
@@ -620,6 +660,14 @@ class HostScanExecutor(ScanExecutor):
         return evaluate(plan.post_filter, ft, columns)
 
 
+def _column_base(k: str) -> str:
+    """geom__x / dtg__null -> attribute base name (dunder-internal keys
+    like __fid__ pass through unchanged)."""
+    if k.startswith("__"):
+        return k
+    return k.split("__", 1)[0]
+
+
 def _empty_columns(ft: FeatureType) -> Columns:
     cols: Columns = {"__fid__": np.empty(0, dtype=object)}
     for a in ft.attributes:
@@ -676,7 +724,27 @@ def apply_projection(ft: FeatureType, query: Query, columns: Columns):
 
     tf = QueryTransforms.parse(ft, query.properties)
     if tf is None:
-        return ft, _apply_query_options(ft, query, columns)
+        columns = _apply_query_options(ft, query, columns)
+        if query.properties is not None:
+            # the result TYPE narrows with the projection, like the
+            # reference's transform schema — consumers (exports, arrow)
+            # iterate result.ft and must only see present attributes
+            keep = set(query.properties)
+            user_data = dict(ft.user_data)
+            if user_data.get("geomesa.index.dtg") not in keep:
+                # role bindings must not point at projected-away attributes
+                user_data.pop("geomesa.index.dtg", None)
+            ft = FeatureType(
+                ft.name,
+                [a for a in ft.attributes if a.name in keep],
+                user_data,
+            )
+            columns = {
+                k: v
+                for k, v in columns.items()
+                if k.startswith("__") or _column_base(k) in keep
+            }
+        return ft, columns
     # sort/limit/sampling run on the ORIGINAL attributes; the property
     # filter must not run (expressions still need their source columns)
     columns = _apply_query_options(ft, replace(query, properties=None), columns)
