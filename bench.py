@@ -321,7 +321,7 @@ def run(n: int, reps: int, backend: str) -> dict:
 
 def main():
     smoke = os.environ.get("GEOMESA_BENCH_SMOKE", "") not in ("", "0")
-    n = int(os.environ.get("GEOMESA_BENCH_N", 200_000 if smoke else 5_000_000))
+    n = int(os.environ.get("GEOMESA_BENCH_N", 0))
     reps = int(os.environ.get("GEOMESA_BENCH_REPS", 3 if smoke else 20))
     claim_timeout = int(os.environ.get("GEOMESA_BENCH_CLAIM_TIMEOUT", 180))
     retries = int(os.environ.get("GEOMESA_BENCH_CLAIM_RETRIES", 2))
@@ -330,6 +330,11 @@ def main():
     t_start = time.monotonic()
     watchdog = start_watchdog(deadline)
     backend = init_backend(claim_timeout, retries)
+    if n == 0:
+        # fixed per-query latency (device link round trip) amortizes with
+        # N, so the accelerator run sizes up; the cpu fallback would only
+        # burn its deadline at 20M
+        n = 200_000 if smoke else (20_000_000 if backend == "default" else 5_000_000)
     try:
         payload = run(n, reps, backend)
     except Exception as e:  # noqa: BLE001

@@ -100,6 +100,11 @@ class MetadataBackedStats(GeoMesaStats):
             if a.type in (AttributeType.INT, AttributeType.LONG, AttributeType.FLOAT,
                           AttributeType.DOUBLE, AttributeType.DATE):
                 stats[f"minmax:{a.name}"] = MinMax(a.name)
+                if a.indexed:
+                    # indexed numerics carry an auto-ranging histogram so
+                    # range-scan selectivity beats the MinMax linear guess
+                    # (StatsBasedEstimator.scala attribute histograms)
+                    stats[f"hist:{a.name}"] = Histogram(a.name, _HIST_BINS)
             elif a.type == AttributeType.STRING:
                 stats[f"topk:{a.name}"] = TopK(a.name)
                 stats[f"freq:{a.name}"] = Frequency(a.name)
@@ -108,6 +113,12 @@ class MetadataBackedStats(GeoMesaStats):
     def stats_for(self, ft: FeatureType) -> Dict[str, Stat]:
         if ft.name not in self._stats:
             loaded = self._load(ft.name)
+            if loaded is not None:
+                # persisted payloads predating newly-introduced sketch
+                # kinds still gain them (they start empty and observe
+                # future writes) instead of being frozen forever
+                for k, v in self._init_for(ft).items():
+                    loaded.setdefault(k, v)
             self._stats[ft.name] = loaded if loaded is not None else self._init_for(ft)
         return self._stats[ft.name]
 
@@ -277,6 +288,15 @@ class StatsBasedEstimator:
             if mm is not None and not mm.is_empty and mm.cardinality > 0:
                 return 1.0 / mm.cardinality
         if isinstance(f, ast.Cmp) and f.op in ("<", "<=", ">", ">="):
+            h = stats.get(f"hist:{f.prop}")
+            if h is not None and not h.is_empty:
+                try:
+                    v = float(f.literal)
+                except (TypeError, ValueError):
+                    return None
+                if f.op in ("<", "<="):
+                    return h.count_between(h.lo, v) / max(1, total)
+                return h.count_between(v, h.hi) / max(1, total)
             mm = stats.get(f"minmax:{f.prop}")
             if mm is not None and not mm.is_empty:
                 try:
@@ -287,6 +307,13 @@ class StatsBasedEstimator:
                     frac = (v - lo) / (hi - lo)
                     frac = max(0.0, min(1.0, frac))
                     return frac if f.op in ("<", "<=") else 1.0 - frac
+                except (TypeError, ValueError):
+                    return None
+        if isinstance(f, ast.Between):
+            h = stats.get(f"hist:{f.prop}")
+            if h is not None and not h.is_empty:
+                try:
+                    return h.count_between(float(f.lo), float(f.hi)) / max(1, total)
                 except (TypeError, ValueError):
                     return None
         return None

@@ -284,17 +284,41 @@ class Histogram(Stat):
 
     kind = "histogram"
 
-    def __init__(self, attribute: str, bins: int, lo: float, hi: float):
+    def __init__(self, attribute: str, bins: int, lo=None, hi=None):
         self.attribute = attribute
         self.bins = int(bins)
-        self.lo = float(lo)
-        self.hi = float(hi)
+        # lo/hi None = auto-ranging: bounds initialize from the first batch
+        # and EXPAND by re-binning when later data falls outside — the
+        # reference's BinnedArray.expand behavior (Histogram.scala:1-273)
+        self.lo = None if lo is None else float(lo)
+        self.hi = None if hi is None else float(hi)
+        self._fixed = lo is not None
         self.counts = np.zeros(self.bins, dtype=np.int64)
+
+    def _expand(self, lo: float, hi: float) -> None:
+        """Grow [lo, hi] and re-bin existing counts by old-bin centers
+        (approximate, like the reference's value re-binning)."""
+        old_lo, old_hi, old_counts = self.lo, self.hi, self.counts
+        self.lo, self.hi = lo, hi
+        self.counts = np.zeros(self.bins, dtype=np.int64)
+        if old_counts.any():
+            w = (old_hi - old_lo) / self.bins
+            centers = old_lo + (np.arange(self.bins) + 0.5) * w
+            idx = np.floor((centers - lo) * self.bins / (hi - lo)).astype(np.int64)
+            np.add.at(self.counts, np.clip(idx, 0, self.bins - 1), old_counts)
 
     def observe(self, values, nulls=None):
         values = _clean(np.asarray(values, dtype=np.float64), nulls)
+        values = values[np.isfinite(values)]
         if not len(values):
             return
+        vlo, vhi = float(values.min()), float(values.max())
+        if self.lo is None:
+            pad = (vhi - vlo) * 0.1 or max(1.0, abs(vlo) * 0.01)
+            self.lo, self.hi = vlo - pad, vhi + pad
+        elif not self._fixed and (vlo < self.lo or vhi > self.hi):
+            span = max(vhi, self.hi) - min(vlo, self.lo)
+            self._expand(min(vlo, self.lo) - span * 0.1, max(vhi, self.hi) + span * 0.1)
         idx = np.floor((values - self.lo) * self.bins / (self.hi - self.lo)).astype(np.int64)
         idx = np.clip(idx, 0, self.bins - 1)
         np.add.at(self.counts, idx, 1)
@@ -307,8 +331,13 @@ class Histogram(Stat):
         """Estimated count in [lo, hi] with partial-bin interpolation
         (the StatsBasedEstimator selectivity primitive). Vectorized over the
         overlapping bin slice — this runs on the per-query planning path."""
-        if hi < self.lo or lo > self.hi:
+        if self.lo is None or hi < self.lo or lo > self.hi:
             return 0.0
+        if hi == lo:
+            # zero-width (inclusive equality): point mass = containing bin,
+            # indexed with observe()'s exact formula
+            i = int(np.floor((lo - self.lo) * self.bins / (self.hi - self.lo)))
+            return float(self.counts[int(np.clip(i, 0, self.bins - 1))])
         w = (self.hi - self.lo) / self.bins
         first = max(0, int((lo - self.lo) / w))
         last = min(self.bins - 1, int((hi - self.lo) / w))
@@ -319,8 +348,24 @@ class Histogram(Stat):
         return float(np.dot(self.counts[first : last + 1], frac))
 
     def merge(self, other):
-        if (other.lo, other.hi, other.bins) != (self.lo, self.hi, self.bins):
-            raise ValueError("histogram shapes differ")
+        if other.bins != self.bins:
+            raise ValueError("histogram bin counts differ")
+        if other.lo is None or not other.counts.any():
+            return
+        if self.lo is None:
+            self.lo, self.hi = other.lo, other.hi
+            self.counts = other.counts.copy()
+            return
+        if (other.lo, other.hi) != (self.lo, self.hi):
+            # shard partials rarely share bounds: expand to the union and
+            # re-bin by centers (Histogram.scala merge-with-expansion)
+            lo, hi = min(self.lo, other.lo), max(self.hi, other.hi)
+            self._expand(lo, hi)
+            w = (other.hi - other.lo) / self.bins
+            centers = other.lo + (np.arange(self.bins) + 0.5) * w
+            idx = np.floor((centers - lo) * self.bins / (hi - lo)).astype(np.int64)
+            np.add.at(self.counts, np.clip(idx, 0, self.bins - 1), other.counts)
+            return
         self.counts += other.counts
 
     def state(self):
@@ -329,6 +374,7 @@ class Histogram(Stat):
             "bins": self.bins,
             "lo": self.lo,
             "hi": self.hi,
+            "fixed": self._fixed,
             "counts": self.counts.tolist(),
         }
 
@@ -616,6 +662,7 @@ def _from_state(d: Dict[str, Any]) -> Stat:
         return s
     if kind == "histogram":
         s = Histogram(d["attribute"], d["bins"], d["lo"], d["hi"])
+        s._fixed = d.get("fixed", True)  # legacy payloads were fixed-range
         s.counts = np.asarray(d["counts"], dtype=np.int64)
         return s
     if kind == "frequency":

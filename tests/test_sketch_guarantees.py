@@ -36,6 +36,75 @@ def test_frequency_unique_batching_counts_match():
     assert f1.count("x") >= 500
 
 
+def test_auto_histogram_expands_and_estimates():
+    from geomesa_tpu.stats.sketches import Histogram, _from_state
+    import json
+
+    h = Histogram("a", 100)
+    h.observe(np.arange(0, 1000, dtype=np.float64))
+    assert h.lo is not None and h.lo <= 0 and h.hi >= 999
+    mid = h.count_between(250.0, 750.0)
+    assert 400 <= mid <= 600  # ~half
+    # data outside current bounds triggers expansion, counts preserved
+    h.observe(np.arange(5000, 6000, dtype=np.float64))
+    assert h.hi >= 5999
+    assert int(h.counts.sum()) == 2000
+    assert h.count_between(5000, 6000) > 500
+    # round trip keeps auto-ranging
+    h2 = _from_state(json.loads(h.to_json()))
+    assert h2._fixed is False
+    assert int(h2.counts.sum()) == 2000
+
+
+def test_auto_histogram_merge_expands_bounds():
+    from geomesa_tpu.stats.sketches import Histogram
+
+    a = Histogram("a", 100)
+    b = Histogram("a", 100)
+    a.observe(np.arange(0, 100, dtype=np.float64))
+    b.observe(np.arange(50, 200, dtype=np.float64))
+    a.merge(b)  # must NOT raise despite different bounds
+    assert int(a.counts.sum()) == 250
+    assert a.lo <= 0 and a.hi >= 199
+    assert a.count_between(0, 200) > 200
+    # zero-width equality returns the containing bin's mass, not 0
+    c = Histogram("c", 10)
+    c.observe(np.full(500, 5.0))
+    assert c.count_between(5.0, 5.0) >= 500
+
+
+def test_indexed_attr_range_selectivity_beats_constant():
+    """Histogram-backed range estimates flow into strategy costs: a narrow
+    numeric range on an indexed attribute should WIN over the spatial index
+    when it's far more selective."""
+    from geomesa_tpu.geom.base import Point
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import TpuDataStore
+
+    ds = TpuDataStore()
+    ds.create_schema(parse_spec(
+        "t", "score:Double:index=true,dtg:Date,*geom:Point:srid=4326"))
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    rng = np.random.default_rng(8)
+    with ds.writer("t") as w:
+        for i in range(3000):
+            w.write([float(rng.uniform(0, 100)), int(base + i),
+                     Point(float(rng.uniform(-1, 1)), float(rng.uniform(-1, 1)))],
+                    fid=f"f{i}")
+    # huge bbox + razor-thin score range: the attr index must be chosen
+    plan = ds.planner("t").plan(
+        ds._as_query("bbox(geom, -180, -90, 180, 90) AND score > 99.9")
+    )
+    assert plan.index.name == "attr:score"
+    got = sorted(ds.query("t", "bbox(geom, -180, -90, 180, 90) AND score > 99.9").fids)
+    want = sorted(
+        f for f, s in zip(
+            ds.query("t").fids, ds.query("t").columns["score"]
+        ) if s > 99.9
+    )
+    assert got == want
+
+
 def test_empty_delta_reduce_is_valid_ipc():
     from geomesa_tpu.arrow import read_features, reduce_deltas
     from geomesa_tpu.schema.featuretype import parse_spec
