@@ -1,0 +1,206 @@
+"""Benchmark suite: BASELINE.md configs #2-#5 (the headline #1 lives in
+bench.py, which the driver runs). Each config prints one JSON line with
+parity-checked throughput vs the in-process numpy full-scan baseline.
+
+  #2 z2: bbox-only point query (OSM-GPS-trace shape)
+  #3 xz2: ST_Intersects over polygons/lines (OSM-ways shape)
+  #4 z3 + attribute secondary filter (GDELT actor1='USA' AND bbox)
+  #5 kNN process over the z3 index
+
+Usage: python bench_suite.py            (auto backend, like bench.py)
+       GEOMESA_BENCH_N=... GEOMESA_BENCH_REPS=... to resize
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    sys.stderr.write(f"[suite] {msg}\n")
+    sys.stderr.flush()
+
+
+def emit(payload):
+    sys.stdout.write(json.dumps(payload) + "\n")
+    sys.stdout.flush()
+
+
+def _store():
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+    from geomesa_tpu.store.datastore import TpuDataStore
+
+    return TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+
+
+def _timeit(fn, reps):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    return (time.perf_counter() - t0) / reps, out
+
+
+def bench_z2(n, reps):
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    rng = np.random.default_rng(5)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-85, 85, n)
+    ds = _store()
+    ft = parse_spec("gps", "*geom:Point:srid=4326")
+    ds.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    ds._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y})
+    box = (-10.0, -5.0, 15.0, 12.0)
+    want = np.flatnonzero((x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3]))
+    cql = f"bbox(geom, {box[0]}, {box[1]}, {box[2]}, {box[3]})"
+
+    base_s, _ = _timeit(
+        lambda: np.flatnonzero(
+            (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        ),
+        max(3, reps // 4),
+    )
+    dev_s, res = _timeit(lambda: ds.query("gps", cql), reps)
+    parity = set(res.fids) == {f"f{i}" for i in want}
+    return {
+        "metric": "z2_bbox_throughput", "value": round(n / dev_s, 1),
+        "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
+        "n": n, "hits": int(len(want)), "parity": bool(parity),
+        "query_ms": round(dev_s * 1000, 3),
+    }
+
+
+def bench_xz2(n, reps):
+    from geomesa_tpu.geom.base import Polygon
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    n = min(n, 200_000)  # polygon synthesis is host-side
+    rng = np.random.default_rng(6)
+    cx = rng.uniform(-170, 170, n)
+    cy = rng.uniform(-80, 80, n)
+    w = rng.uniform(0.01, 0.5, n)
+    ds = _store()
+    ft = parse_spec("ways", "*geom:Polygon:srid=4326")
+    ds.create_schema(ft)
+    with ds.writer("ways") as wtr:
+        for i in range(n):
+            x0, y0, ww = cx[i], cy[i], w[i]
+            wtr.write(
+                [Polygon([[x0, y0], [x0 + ww, y0], [x0 + ww, y0 + ww], [x0, y0 + ww], [x0, y0]])],
+                fid=f"w{i}",
+            )
+    box = (0.0, 0.0, 20.0, 15.0)
+    hit = (cx + w >= box[0]) & (cx <= box[2]) & (cy + w >= box[1]) & (cy <= box[3])
+    cql = f"bbox(geom, {box[0]}, {box[1]}, {box[2]}, {box[3]})"
+
+    base_s, _ = _timeit(
+        lambda: np.flatnonzero(
+            (cx + w >= box[0]) & (cx <= box[2]) & (cy + w >= box[1]) & (cy <= box[3])
+        ),
+        max(3, reps // 4),
+    )
+    dev_s, res = _timeit(lambda: ds.query("ways", cql), reps)
+    parity = set(res.fids) == {f"w{i}" for i in np.flatnonzero(hit)}
+    return {
+        "metric": "xz2_intersects_throughput", "value": round(n / dev_s, 1),
+        "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
+        "n": n, "hits": int(hit.sum()), "parity": bool(parity),
+        "query_ms": round(dev_s * 1000, 3),
+    }
+
+
+def bench_attr_bbox(n, reps):
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    rng = np.random.default_rng(7)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-85, 85, n)
+    base_ms = np.datetime64("2026-01-01T00:00:00", "ms").astype(np.int64)
+    t = base_ms + rng.integers(0, 30 * 86400_000, n)
+    actors = np.array(["USA", "CHN", "RUS", "FRA", "BRA"], dtype=object)[
+        rng.integers(0, 5, n)
+    ]
+    ds = _store()
+    ft = parse_spec("gdelt", "actor1:String:index=true,dtg:Date,*geom:Point:srid=4326")
+    ds.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    ds._insert_columns(
+        ft, {"__fid__": fids, "actor1": actors, "geom__x": x, "geom__y": y, "dtg": t}
+    )
+    box = (-30.0, 0.0, 10.0, 30.0)
+    want_mask = (
+        (actors == "USA") & (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+    )
+    cql = f"actor1 = 'USA' AND bbox(geom, {box[0]}, {box[1]}, {box[2]}, {box[3]})"
+
+    base_s, _ = _timeit(
+        lambda: np.flatnonzero(
+            (actors == "USA") & (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        ),
+        max(3, reps // 4),
+    )
+    dev_s, res = _timeit(lambda: ds.query("gdelt", cql), reps)
+    parity = set(res.fids) == set(fids[want_mask])
+    return {
+        "metric": "attr_plus_bbox_throughput", "value": round(n / dev_s, 1),
+        "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
+        "n": n, "hits": int(want_mask.sum()), "parity": bool(parity),
+        "query_ms": round(dev_s * 1000, 3),
+    }
+
+
+def bench_knn(n, reps):
+    from geomesa_tpu.process.geodesy import haversine_m
+    from geomesa_tpu.process.knn import knn_search
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    rng = np.random.default_rng(8)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-85, 85, n)
+    base_ms = np.datetime64("2026-01-01T00:00:00", "ms").astype(np.int64)
+    t = base_ms + rng.integers(0, 30 * 86400_000, n)
+    ds = _store()
+    ft = parse_spec("pts", "dtg:Date,*geom:Point:srid=4326")
+    ds.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    ds._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t})
+    qx, qy, k = 2.35, 48.85, 10
+
+    def brute():
+        d = haversine_m(x, y, qx, qy)
+        return [f"f{i}" for i in np.argsort(d, kind="stable")[:k]]
+
+    base_s, want = _timeit(brute, max(3, reps // 4))
+    dev_s, got = _timeit(lambda: knn_search(ds, "pts", qx, qy, k=k), reps)
+    parity = [f for f, _ in got] == want
+    return {
+        "metric": "knn_throughput", "value": round(n / dev_s, 1),
+        "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
+        "n": n, "k": k, "parity": bool(parity),
+        "query_ms": round(dev_s * 1000, 3),
+    }
+
+
+def main():
+    n = int(os.environ.get("GEOMESA_BENCH_N", 2_000_000))
+    reps = int(os.environ.get("GEOMESA_BENCH_REPS", 10))
+    for name, fn in [
+        ("z2", bench_z2),
+        ("xz2", bench_xz2),
+        ("attr_bbox", bench_attr_bbox),
+        ("knn", bench_knn),
+    ]:
+        log(f"running {name} (n={n})")
+        try:
+            emit(fn(n, reps))
+        except Exception as e:  # keep the suite going per config
+            emit({"metric": name, "error": f"{type(e).__name__}: {e}"})
+
+
+if __name__ == "__main__":
+    main()

@@ -294,9 +294,14 @@ class StatsBasedEstimator:
                     v = float(f.literal)
                 except (TypeError, ValueError):
                     return None
+                # normalize by the histogram's OWN mass: an upgrade-added
+                # sketch may lag the global count (it observed only
+                # post-upgrade writes); its distribution is still the best
+                # available sample
+                mass = max(1.0, float(h.counts.sum()))
                 if f.op in ("<", "<="):
-                    return h.count_between(h.lo, v) / max(1, total)
-                return h.count_between(v, h.hi) / max(1, total)
+                    return h.count_between(h.lo, v) / mass
+                return h.count_between(v, h.hi) / mass
             mm = stats.get(f"minmax:{f.prop}")
             if mm is not None and not mm.is_empty:
                 try:
@@ -313,7 +318,8 @@ class StatsBasedEstimator:
             h = stats.get(f"hist:{f.prop}")
             if h is not None and not h.is_empty:
                 try:
-                    return h.count_between(float(f.lo), float(f.hi)) / max(1, total)
+                    mass = max(1.0, float(h.counts.sum()))
+                    return h.count_between(float(f.lo), float(f.hi)) / mass
                 except (TypeError, ValueError):
                     return None
         return None

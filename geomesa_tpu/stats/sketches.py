@@ -357,8 +357,13 @@ class Histogram(Stat):
             self.counts = other.counts.copy()
             return
         if (other.lo, other.hi) != (self.lo, self.hi):
-            # shard partials rarely share bounds: expand to the union and
-            # re-bin by centers (Histogram.scala merge-with-expansion)
+            if self._fixed or other._fixed:
+                # fixed-range histograms (lon/lat/dtg) only merge with their
+                # own kind: a bounds mismatch means mismatched sketches, and
+                # silently re-binning would corrupt them
+                raise ValueError("histogram bounds differ")
+            # auto-ranged shard partials rarely share bounds: expand to the
+            # union and re-bin by centers (Histogram.scala merge-with-expansion)
             lo, hi = min(self.lo, other.lo), max(self.hi, other.hi)
             self._expand(lo, hi)
             w = (other.hi - other.lo) / self.bins

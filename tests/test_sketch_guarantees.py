@@ -71,6 +71,15 @@ def test_auto_histogram_merge_expands_bounds():
     c = Histogram("c", 10)
     c.observe(np.full(500, 5.0))
     assert c.count_between(5.0, 5.0) >= 500
+    # fixed-range histograms still refuse mismatched merges
+    import pytest as _pytest
+
+    f1 = Histogram("lon", 10, -180.0, 180.0)
+    f2 = Histogram("lat", 10, -90.0, 90.0)
+    f1.observe(np.array([1.0]))
+    f2.observe(np.array([1.0]))
+    with _pytest.raises(ValueError):
+        f1.merge(f2)
 
 
 def test_indexed_attr_range_selectivity_beats_constant():
