@@ -27,8 +27,8 @@ def make_handler(store):
         def log_message(self, *args):  # quiet
             pass
 
-        def _send(self, code: int, body: str, ctype: str = "application/json"):
-            data = body.encode()
+        def _send(self, code: int, body, ctype: str = "application/json"):
+            data = body if isinstance(body, bytes) else body.encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
@@ -69,6 +69,48 @@ def make_handler(store):
                         self._send(200, to_csv(res), "text/csv")
                     else:
                         self._send(200, to_geojson(res), "application/geo+json")
+                elif route == "/density":
+                    # the DensityProcess/WMS-heatmap endpoint: JSON grid
+                    from geomesa_tpu.index.planner import Query
+
+                    name = params["name"]
+                    env = [float(v) for v in params["bbox"].split(",")]
+                    # the tile envelope pushes down as a spatial predicate
+                    # so the planner prunes instead of full-scanning
+                    geom = store.get_schema(name).default_geometry.name
+                    bbox_cql = (
+                        f"bbox({geom}, {env[0]!r}, {env[1]!r}, {env[2]!r}, {env[3]!r})"
+                    )
+                    user_cql = params.get("cql", "INCLUDE")
+                    q = Query.cql(
+                        bbox_cql if user_cql == "INCLUDE"
+                        else f"({bbox_cql}) AND ({user_cql})"
+                    )
+                    q.hints["density"] = {
+                        "envelope": tuple(env),
+                        "width": int(params.get("width", 256)),
+                        "height": int(params.get("height", 256)),
+                    }
+                    res = store.query(name, q)
+                    grid = res.aggregate["density"]
+                    self._send(
+                        200,
+                        json.dumps({"shape": list(grid.shape),
+                                    "grid": grid.tolist()}),
+                    )
+                elif route == "/bin":
+                    from geomesa_tpu.index.planner import Query
+
+                    name = params["name"]
+                    q = Query.cql(params.get("cql", "INCLUDE"))
+                    q.hints["bin"] = {
+                        "track": params.get("track", "id"),
+                        "sort": params.get("sort", "").lower() == "true",
+                    }
+                    res = store.query(name, q)
+                    recs = res.aggregate["bin"]
+                    body = recs.tobytes() if hasattr(recs, "tobytes") else recs
+                    self._send(200, body, "application/octet-stream")
                 elif route == "/stats/count":
                     name = params["name"]
                     exact = params.get("exact", "true").lower() != "false"

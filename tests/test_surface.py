@@ -111,6 +111,17 @@ def test_rest_server_endpoints():
         assert cnt["count"] == 200
         b = json.loads(urllib.request.urlopen(f"{url}/stats/bounds?name=d").read())
         assert b["bounds"] is not None
+        # density grid endpoint (DensityProcess/WMS heat-map analog)
+        d = json.loads(
+            urllib.request.urlopen(
+                f"{url}/density?name=d&bbox=-30,-30,30,30&width=32&height=16"
+            ).read()
+        )
+        assert d["shape"] == [16, 32]
+        assert sum(map(sum, d["grid"])) > 0
+        # packed BIN endpoint (16 bytes per record)
+        raw = urllib.request.urlopen(f"{url}/bin?name=d&track=actor&sort=true").read()
+        assert len(raw) == 200 * 16
         err = urllib.request.urlopen(f"{url}/types")  # still alive after errors
         assert err.status == 200
 
