@@ -5,18 +5,24 @@ designed for JAX/XLA/TPU: columnar feature blocks in HBM, vectorized space-filli
 curve kernels, batched range decomposition, device-side push-down filters and
 aggregations, and multi-chip execution via ``jax.sharding`` meshes.
 
-Layer map (mirrors SURVEY.md):
+Layer map (mirrors SURVEY.md; COMPONENTS.md maps every reference component):
   - ``geomesa_tpu.curve``    -- L0 curve math (Z2/Z3/XZ2/XZ3, binned time)
   - ``geomesa_tpu.geom``     -- geometry model + predicates
   - ``geomesa_tpu.schema``   -- feature types (SimpleFeatureTypes analog)
   - ``geomesa_tpu.filter``   -- CQL-style filter AST, extraction, splitting
-  - ``geomesa_tpu.index``    -- key spaces, strategies, query planner
-  - ``geomesa_tpu.store``    -- columnar block store + datastores
-  - ``geomesa_tpu.ops``      -- JAX device kernels (filter/aggregate)
-  - ``geomesa_tpu.parallel`` -- mesh sharding + distributed execution
+  - ``geomesa_tpu.index``    -- key spaces, strategies, query planner, transforms
+  - ``geomesa_tpu.store``    -- columnar block store + memory/fs datastores
+  - ``geomesa_tpu.ops``      -- JAX/Pallas device kernels (filter/aggregate)
+  - ``geomesa_tpu.parallel`` -- mesh sharding + the device scan executor
   - ``geomesa_tpu.stats``    -- data sketches + cost estimation
-  - ``geomesa_tpu.convert``  -- ingest converters
-  - ``geomesa_tpu.tools``    -- CLI
+  - ``geomesa_tpu.stream``   -- live/lambda tiers (Kafka analog)
+  - ``geomesa_tpu.security`` -- visibility expressions + auth providers
+  - ``geomesa_tpu.process``  -- kNN/proximity/tube/route/track processes
+  - ``geomesa_tpu.compute``  -- SpatialFrame + ST_* (Spark SQL analog)
+  - ``geomesa_tpu.arrow``    -- Arrow interchange + delta dictionaries
+  - ``geomesa_tpu.raster``   -- raster chip store + mosaicking
+  - ``geomesa_tpu.tools``    -- converters, bulk ingest, exports, CLI
+  - ``geomesa_tpu.utils``    -- geohash, avro, config tiers, audit/metrics
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
