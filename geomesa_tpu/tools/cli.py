@@ -22,7 +22,7 @@ from typing import List, Optional
 
 import numpy as np
 
-VERSION = "0.1.0"
+from geomesa_tpu import __version__ as VERSION
 
 
 def _store(args):
