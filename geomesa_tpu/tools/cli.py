@@ -1,7 +1,7 @@
 """geomesa-tpu CLI (the geomesa-tools Runner analog, Runner.scala:26,146).
 
 Subcommands: create-schema, delete-schema, describe, ingest, export, explain,
-stats-count, stats-bounds, stats-topk, version, env. The datastore is the
+stats-count, stats-bounds, stats-topk, stats-histogram, version, env. The datastore is the
 file-system store (``--store DIR``), so state persists across invocations the
 way a cluster-backed reference deployment does.
 
@@ -100,6 +100,18 @@ def cmd_export(args) -> int:
     q = Query.cql(args.cql)
     if args.max_features:
         q.max_features = args.max_features
+    if args.attributes:
+        # ExportCommand --attributes: projection (supports derived
+        # "out=EXPR" transform properties too); split is paren-depth aware
+        # so multi-arg transforms like concat($a,$b) survive
+        props = _split_attributes(args.attributes)
+        ft = ds.get_schema(args.name)
+        known = {a.name for a in ft.attributes}
+        missing = [p for p in props if "=" not in p and p not in known]
+        if missing:
+            print(f"unknown attribute(s): {', '.join(missing)}", file=sys.stderr)
+            return 1
+        q.properties = props
     res = ds.query(args.name, q)
     out = export(res, args.format, args.output)
     if out is not None:
@@ -130,6 +142,66 @@ def cmd_stats_bounds(args) -> int:
     ds = _store(args)
     b = ds.stats.get_bounds(ds.get_schema(args.name)) if ds.stats else None
     print(json.dumps(b))
+    return 0
+
+
+def _split_attributes(spec: str) -> List[str]:
+    """Comma split at paren depth 0 only (transform args contain commas)."""
+    out: List[str] = []
+    depth = 0
+    cur = []
+    for ch in spec:
+        if ch == "," and depth == 0:
+            if "".join(cur).strip():
+                out.append("".join(cur).strip())
+            cur = []
+            continue
+        depth += ch == "("
+        depth -= ch == ")"
+        cur.append(ch)
+    if "".join(cur).strip():
+        out.append("".join(cur).strip())
+    return out
+
+
+def cmd_stats_histogram(args) -> int:
+    """StatsHistogramCommand analog: binned counts for an attribute."""
+    from geomesa_tpu.stats.sketches import Histogram
+
+    if args.bins < 1:
+        print("--bins must be >= 1", file=sys.stderr)
+        return 1
+    ds = _store(args)
+    ft = ds.get_schema(args.name)
+    stats = ds.stats.stats_for(ft)
+    # role histograms live under literal keys: the default date under
+    # "dtg", the geometry axes under "lon"/"lat"
+    keys = [f"hist:{args.attribute}"]
+    if ft.default_date is not None and args.attribute == ft.default_date.name:
+        keys.append("dtg")
+    geom = ft.default_geometry
+    if geom is not None and args.attribute in (geom.name + "__x", "lon"):
+        keys.append("lon")
+    if geom is not None and args.attribute in (geom.name + "__y", "lat"):
+        keys.append("lat")
+    h = next(
+        (s for k in keys
+         for s in [stats.get(k)]
+         if isinstance(s, Histogram)),
+        None,
+    )
+    if h is None or h.is_empty:
+        print("no histogram sketch for attribute", file=sys.stderr)
+        return 1
+    total = int(h.counts.sum())
+    width = (h.hi - h.lo) / h.bins
+    step = max(1, h.bins // args.bins)
+    for i in range(0, h.bins, step):
+        c = int(h.counts[i : i + step].sum())
+        if c:
+            lo = h.lo + i * width
+            hi = h.lo + min(i + step, h.bins) * width
+            print(f"[{lo:.6g}, {hi:.6g})\t{c}\t{100.0 * c / total:.2f}%")
     return 0
 
 
@@ -190,6 +262,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--output", default=None)
     sp.add_argument("--max-features", type=int, default=None)
+    sp.add_argument(
+        "--attributes", default=None,
+        help="comma-separated projection, e.g. name,geom or upper=uppercase($name)",
+    )
     sp = add("explain", cmd_explain)
     sp.add_argument("--cql", required=True)
     sp = add("stats-count", cmd_stats_count)
@@ -199,6 +275,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("stats-topk", cmd_stats_topk)
     sp.add_argument("--attribute", required=True)
     sp.add_argument("-k", type=int, default=10)
+    sp = add("stats-histogram", cmd_stats_histogram)
+    sp.add_argument("--attribute", required=True)
+    sp.add_argument("--bins", type=int, default=20)
     add("version", cmd_version, store=False, type_name=False)
     add("env", cmd_env, store=False, type_name=False)
     return p

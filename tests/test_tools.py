@@ -170,4 +170,42 @@ def test_cli_end_to_end(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "default-geometry" in out and "features: 3" in out
 
+    # projection via --attributes (ExportCommand --attributes analog)
+    assert main(["export", "--store", store, "--name", "gdelt",
+                 "--format", "csv", "--attributes", "actor"]) == 0
+    out = capsys.readouterr().out
+    assert out.splitlines()[0] == "id,actor"
+
+    # derived transform projection
+    assert main(["export", "--store", store, "--name", "gdelt",
+                 "--format", "csv",
+                 "--attributes", "shout=uppercase($actor)"]) == 0
+    out = capsys.readouterr().out
+    assert out.splitlines()[0] == "id,shout"
+    assert "USA" in out
+
+    assert main(["stats-histogram", "--store", store, "--name", "gdelt",
+                 "--attribute", "dtg", "--bins", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "%" in out and "[" in out
+    # non-histogram name-collisions ('count' matches CountStat) error cleanly
+    assert main(["stats-histogram", "--store", store, "--name", "gdelt",
+                 "--attribute", "count"]) == 1
+    capsys.readouterr()
+    assert main(["stats-histogram", "--store", store, "--name", "gdelt",
+                 "--attribute", "dtg", "--bins", "0"]) == 1
+    capsys.readouterr()
+
+    # multi-arg transform survives the comma split
+    assert main(["export", "--store", store, "--name", "gdelt",
+                 "--format", "csv",
+                 "--attributes", "who=concat($actor, '-x'),actor"]) == 0
+    out = capsys.readouterr().out
+    assert out.splitlines()[0] == "id,who,actor"
+    assert "USA-x" in out
+    # typo'd projection errors instead of silently exporting nothing
+    assert main(["export", "--store", store, "--name", "gdelt",
+                 "--format", "csv", "--attributes", "actr"]) == 1
+    capsys.readouterr()
+
     assert main(["version"]) == 0
