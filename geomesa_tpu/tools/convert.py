@@ -163,6 +163,23 @@ _FUNCTIONS: Dict[str, Callable] = {
     "substr": lambda v, a, b: None if v is None else str(v)[int(a) : int(b)],
     "mapvalue": lambda m, k: None if m is None else m.get(k),
     "md5": _fn_md5,
+    # arithmetic + string helpers (Transformers.scala math/string fns)
+    "add": lambda *a: sum(float(x) for x in a if x not in (None, "")),
+    "subtract": lambda a, b: None if None in (a, b) else float(a) - float(b),
+    "multiply": lambda *a: __import__("math").prod(float(x) for x in a if x not in (None, "")),
+    "divide": lambda a, b: None if None in (a, b) or float(b) == 0 else float(a) / float(b),
+    "length": lambda v: 0 if v is None else len(str(v)),
+    "emptytonull": lambda v: None if v in (None, "") else v,
+    "capitalize": lambda v: None if v is None else str(v).capitalize(),
+    "printf": lambda fmt, *a: str(fmt) % tuple(a),
+    "stringtoint": lambda v, d=None: d if v in (None, "") else int(float(v)),
+    "stringtolong": lambda v, d=None: d if v in (None, "") else int(float(v)),
+    "stringtodouble": lambda v, d=None: d if v in (None, "") else float(v),
+    "stringtofloat": lambda v, d=None: d if v in (None, "") else float(v),
+    "stringtoboolean": lambda v, d=None: d if v in (None, "") else str(v).strip().lower() in ("true", "1", "t", "yes"),
+    "now": lambda: int(__import__("time").time() * 1000),
+    "secstomillis": lambda v: None if v in (None, "") else int(float(v) * 1000),
+    "millistosecs": lambda v: None if v in (None, "") else int(float(v) // 1000),
 }
 
 

@@ -189,6 +189,30 @@ def test_validators_reject_bad_rows():
     assert ec.success == 1 and ec.failure == 2
 
 
+def test_transform_function_batch():
+    """The Transformers.scala math/string function batch."""
+    from geomesa_tpu.tools.convert import parse_transform
+
+    def ev(expr, cols=()):
+        return parse_transform(expr)(list(cols), {})
+
+    assert ev("add(1, 2, $1)", ["3"]) == 6.0
+    assert ev("subtract(10, 4)") == 6.0
+    assert ev("multiply(2, 3, 4)") == 24.0
+    assert ev("divide(10, 4)") == 2.5
+    assert ev("divide(10, 0)") is None
+    assert ev("length($1)", ["abcd"]) == 4
+    assert ev("emptyToNull($1)", [""]) is None
+    assert ev("capitalize($1)", ["miXED"]) == "Mixed"
+    assert ev("printf('%s-%03d', $1, 7)", ["a"]) == "a-007"
+    assert ev("stringToInt($1, 9)", [""]) == 9
+    assert ev("stringToDouble($1)", ["2.5"]) == 2.5
+    assert ev("stringToBoolean($1)", ["True"]) is True
+    assert ev("secsToMillis($1)", ["12"]) == 12000
+    assert ev("millisToSecs($1)", ["12500"]) == 12
+    assert ev("now()") > 1_700_000_000_000
+
+
 def test_script_functions():
     """geomesa-convert-scripting analog: lambdas in the config become
     transform functions."""
