@@ -69,22 +69,40 @@ class Z2SFC:
         xy: Sequence[Tuple[float, float, float, float]],
         precision: int = 64,
         max_ranges: Optional[int] = None,
+        exact_skip: bool = False,
     ) -> List[IndexRange]:
-        """Decompose (xmin, ymin, xmax, ymax) boxes into z ranges (Z2SFC.scala:50-54)."""
+        """Decompose (xmin, ymin, xmax, ymax) boxes into z ranges (Z2SFC.scala:50-54).
+
+        With ``exact_skip`` the ``contained`` flag of the returned ranges is
+        computed against the strict INTERIOR of each box (normalized bounds
+        shrunk one unit per side): because ``normalize`` is monotone, a row
+        whose cell lies inside the interior provably satisfies the raw f64
+        bbox predicate, so scans may skip the post-filter for those ranges.
+        """
         mins, maxs = [], []
+        skip_mins: List[List[int]] = []
+        skip_maxs: List[List[int]] = []
         for xmin, ymin, xmax, ymax in xy:
             self._check_bounds(
                 np.asarray([xmin, xmax], dtype=np.float64),
                 np.asarray([ymin, ymax], dtype=np.float64),
             )
-            mins.append(
-                [int(self.lon.normalize(xmin)[()]), int(self.lat.normalize(ymin)[()])]
-            )
-            maxs.append(
-                [int(self.lon.normalize(xmax)[()]), int(self.lat.normalize(ymax)[()])]
-            )
+            nx0, ny0 = int(self.lon.normalize(xmin)[()]), int(self.lat.normalize(ymin)[()])
+            nx1, ny1 = int(self.lon.normalize(xmax)[()]), int(self.lat.normalize(ymax)[()])
+            mins.append([nx0, ny0])
+            maxs.append([nx1, ny1])
+            if exact_skip and nx0 + 1 <= nx1 - 1 and ny0 + 1 <= ny1 - 1:
+                skip_mins.append([nx0 + 1, ny0 + 1])
+                skip_maxs.append([nx1 - 1, ny1 - 1])
         return zranges(
-            mins, maxs, self.precision, 2, max_ranges, precision
+            mins,
+            maxs,
+            self.precision,
+            2,
+            max_ranges,
+            precision,
+            skip_mins=skip_mins if exact_skip else None,
+            skip_maxs=skip_maxs if exact_skip else None,
         )
 
 
@@ -162,10 +180,23 @@ class Z3SFC:
         t: Sequence[Tuple[int, int]],
         precision: int = 64,
         max_ranges: Optional[int] = None,
+        exact_skip: bool = False,
     ) -> List[IndexRange]:
         """Decompose spatial boxes x time-offset windows into z ranges
-        (Z3SFC.scala:56-65: the cross product of boxes and windows)."""
+        (Z3SFC.scala:56-65: the cross product of boxes and windows).
+
+        ``exact_skip``: compute the ``contained`` flag against the strict
+        interior of each (box, window) so flagged ranges provably satisfy
+        the raw predicate (see Z2SFC.ranges). The time dimension shrinks by
+        an extra ``ceil(bins/extent)`` units per side to absorb the
+        offset-unit floor rounding between raw ms and stored offsets."""
+        # one normalized unit per side guards the normalize() floor; the
+        # extra margin guards the ms -> offset-unit floor when normalized
+        # units are finer than offset units (e.g. week: 2^21 bins / 604800s)
+        t_margin = 1 + int(np.ceil(self.time.bins / (self.time.max - self.time.min)))
         mins, maxs = [], []
+        skip_mins: List[List[int]] = []
+        skip_maxs: List[List[int]] = []
         for xmin, ymin, xmax, ymax in xy:
             for tmin, tmax in t:
                 self._check_bounds(
@@ -173,20 +204,29 @@ class Z3SFC:
                     np.asarray([ymin, ymax], dtype=np.float64),
                     np.asarray([tmin, tmax], dtype=np.int64),
                 )
-                mins.append(
-                    [
-                        int(self.lon.normalize(xmin)[()]),
-                        int(self.lat.normalize(ymin)[()]),
-                        int(self.time.normalize(tmin)[()]),
-                    ]
-                )
-                maxs.append(
-                    [
-                        int(self.lon.normalize(xmax)[()]),
-                        int(self.lat.normalize(ymax)[()]),
-                        int(self.time.normalize(tmax)[()]),
-                    ]
-                )
+                nx0 = int(self.lon.normalize(xmin)[()])
+                ny0 = int(self.lat.normalize(ymin)[()])
+                nt0 = int(self.time.normalize(tmin)[()])
+                nx1 = int(self.lon.normalize(xmax)[()])
+                ny1 = int(self.lat.normalize(ymax)[()])
+                nt1 = int(self.time.normalize(tmax)[()])
+                mins.append([nx0, ny0, nt0])
+                maxs.append([nx1, ny1, nt1])
+                if (
+                    exact_skip
+                    and nx0 + 1 <= nx1 - 1
+                    and ny0 + 1 <= ny1 - 1
+                    and nt0 + t_margin <= nt1 - t_margin
+                ):
+                    skip_mins.append([nx0 + 1, ny0 + 1, nt0 + t_margin])
+                    skip_maxs.append([nx1 - 1, ny1 - 1, nt1 - t_margin])
         return zranges(
-            mins, maxs, self.precision, 3, max_ranges, precision
+            mins,
+            maxs,
+            self.precision,
+            3,
+            max_ranges,
+            precision,
+            skip_mins=skip_mins if exact_skip else None,
+            skip_maxs=skip_maxs if exact_skip else None,
         )

@@ -135,15 +135,18 @@ def _interleave_scalar(coords: Sequence[int], dims: int) -> int:
 
 
 def merge_ranges(ranges: List[IndexRange]) -> List[IndexRange]:
-    """Sort and merge adjacent/overlapping ranges; a merge of a contained and
-    a not-contained range is not-contained."""
+    """Sort and merge ranges. Truly overlapping ranges always coalesce
+    (flag = AND); merely adjacent ones only when flags match — a contained
+    (skip-eligible) run must not lose its flag to a boundary neighbor."""
     if not ranges:
         return []
     ranges = sorted(ranges, key=lambda r: (r.lower, r.upper))
     merged: List[IndexRange] = []
     cur = ranges[0]
     for r in ranges[1:]:
-        if r.lower <= cur.upper + 1:
+        if r.lower <= cur.upper or (
+            r.lower == cur.upper + 1 and r.contained == cur.contained
+        ):
             cur = IndexRange(
                 cur.lower, max(cur.upper, r.upper), cur.contained and r.contained
             )
@@ -161,6 +164,8 @@ def zranges(
     dims: int,
     max_ranges: Optional[int] = None,
     precision: int = 64,
+    skip_mins: Optional[Sequence[Sequence[int]]] = None,
+    skip_maxs: Optional[Sequence[Sequence[int]]] = None,
 ) -> List[IndexRange]:
     """Decompose axis-aligned boxes (in normalized int space) into z-ranges.
 
@@ -180,6 +185,12 @@ def zranges(
         sfcurve's getOrElse(Int.MaxValue); the planner passes its
         SCAN_RANGES_TARGET of 2000, QueryProperties.scala:18)
       precision: total z bits of resolution to recurse to (64 = full depth)
+      skip_mins/skip_maxs: optional INTERIOR boxes. When given, the output
+        ``contained`` flag means "cell inside some skip box": every raw
+        value in the cell provably satisfies the query's own (f64/ms)
+        predicate, so scans skip the post-filter for that range. Recursion
+        still classifies against the regular boxes. Without skip boxes the
+        flag keeps the legacy cell-in-box meaning.
     """
     boxes = [
         (tuple(int(v) for v in lo), tuple(int(v) for v in hi))
@@ -187,13 +198,23 @@ def zranges(
     ]
     if not boxes:
         return []
+    skips = (
+        None
+        if skip_mins is None
+        else [
+            (tuple(int(v) for v in lo), tuple(int(v) for v in hi))
+            for lo, hi in zip(skip_mins, skip_maxs)
+        ]
+    )
 
     # latency-critical planning path: prefer the C++ BFS (geomesa_tpu.native,
     # same semantics, ~30x faster); fall back to the Python walk below
     try:
         from geomesa_tpu.native import zranges_native
 
-        native = zranges_native(mins, maxs, bits, dims, max_ranges, precision)
+        native = zranges_native(
+            mins, maxs, bits, dims, max_ranges, precision, skip_mins, skip_maxs
+        )
         if native is not None:
             return [IndexRange(lo, hi, c) for lo, hi, c in native]
     except Exception:
@@ -211,6 +232,15 @@ def zranges(
         return [(c, c + size - 1) for c in cmin]
 
     def emit(cmin: Tuple[int, ...], level: int, contained: bool):
+        if contained and skips is not None:
+            size = 1 << (bits - level)
+            contained = any(
+                all(
+                    lo[d] <= cmin[d] and cmin[d] + size - 1 <= hi[d]
+                    for d in range(dims)
+                )
+                for lo, hi in skips
+            )
         zmin = _interleave_scalar(cmin, dims)
         span = 1 << (dims * (bits - level))
         ranges.append(IndexRange(zmin, zmin + span - 1, contained))

@@ -89,6 +89,25 @@ class IndexValues:
         return [g.envelope for g in self.geometries.values]
 
 
+def _exact_skip_ok(values: IndexValues) -> bool:
+    """Whether z-range ``contained`` flags may be computed with exact-skip
+    semantics (strict-interior boxes): requires precisely-extracted
+    rectangle geometries and precise intervals, so that "cell inside the
+    interior" implies "row satisfies the query's own f64/ms primary
+    predicate". Non-rectangles (polygon intersects) or lossy extraction
+    disable the skip — flags are then forced False and every candidate is
+    post-filtered, the previous behavior."""
+    gv = values.geometries
+    if not gv.values or not gv.precise:
+        return False
+    if not all(g.is_rectangle() for g in gv.values):
+        return False
+    iv = values.intervals
+    if iv is not None and iv.values and not iv.precise:
+        return False
+    return True
+
+
 class IndexKeySpace:
     name: str = "base"
 
@@ -222,15 +241,19 @@ class Z3KeySpace(IndexKeySpace):
         partial = {b: w for b, w in values.bins.items() if w != (0, mo)}
         n_groups = (1 if whole else 0) + len(partial)
         per_group = max(1, _ranges_target(max_ranges) // max(1, n_groups))
+        skip = _exact_skip_ok(values)
         if whole:
-            ranges = sfc.ranges(boxes, [(0, mo)], max_ranges=per_group)
+            ranges = sfc.ranges(boxes, [(0, mo)], max_ranges=per_group, exact_skip=skip)
             for b in sorted(whole):
                 out.extend(
-                    ScanRange(b, r.lower, r.upper, r.contained) for r in ranges
+                    ScanRange(b, r.lower, r.upper, r.contained and skip)
+                    for r in ranges
                 )
         for b, (lo, hi) in sorted(partial.items()):
-            ranges = sfc.ranges(boxes, [(lo, hi)], max_ranges=per_group)
-            out.extend(ScanRange(b, r.lower, r.upper, r.contained) for r in ranges)
+            ranges = sfc.ranges(boxes, [(lo, hi)], max_ranges=per_group, exact_skip=skip)
+            out.extend(
+                ScanRange(b, r.lower, r.upper, r.contained and skip) for r in ranges
+            )
         return out
 
 
@@ -262,8 +285,11 @@ class Z2KeySpace(IndexKeySpace):
     ) -> List[ScanRange]:
         if values.disjoint:
             return []
-        ranges = self._sfc.ranges(_boxes(values), max_ranges=_ranges_target(max_ranges))
-        return [ScanRange(0, r.lower, r.upper, r.contained) for r in ranges]
+        skip = _exact_skip_ok(values)
+        ranges = self._sfc.ranges(
+            _boxes(values), max_ranges=_ranges_target(max_ranges), exact_skip=skip
+        )
+        return [ScanRange(0, r.lower, r.upper, r.contained and skip) for r in ranges]
 
 
 class XZ2KeySpace(IndexKeySpace):
@@ -304,7 +330,9 @@ class XZ2KeySpace(IndexKeySpace):
         if values.disjoint:
             return []
         ranges = self.sfc(ft).ranges(_boxes(values), max_ranges=_ranges_target(max_ranges))
-        return [ScanRange(0, r.lower, r.upper, r.contained) for r in ranges]
+        # contained forced False: XZ rows are extent features, whose geometry
+        # predicate can never be skipped from key containment alone
+        return [ScanRange(0, r.lower, r.upper, False) for r in ranges]
 
 
 class XZ3KeySpace(IndexKeySpace):
@@ -360,17 +388,19 @@ class XZ3KeySpace(IndexKeySpace):
         partial = {b: w for b, w in values.bins.items() if w != (0, mo)}
         n_groups = (1 if whole else 0) + len(partial)
         per_group = max(1, _ranges_target(max_ranges) // max(1, n_groups))
+        # contained is forced False: XZ rows are extent features, whose
+        # geometry predicate can never be skipped from key containment alone
         if whole:
             queries = [(x0, y0, 0.0, x1, y1, float(mo)) for x0, y0, x1, y1 in boxes]
             ranges = sfc.ranges(queries, max_ranges=per_group)
             for b in sorted(whole):
-                out.extend(ScanRange(b, r.lower, r.upper, r.contained) for r in ranges)
+                out.extend(ScanRange(b, r.lower, r.upper, False) for r in ranges)
         for b, (lo, hi) in sorted(partial.items()):
             queries = [
                 (x0, y0, float(lo), x1, y1, float(hi)) for x0, y0, x1, y1 in boxes
             ]
             ranges = sfc.ranges(queries, max_ranges=per_group)
-            out.extend(ScanRange(b, r.lower, r.upper, r.contained) for r in ranges)
+            out.extend(ScanRange(b, r.lower, r.upper, False) for r in ranges)
         return out
 
 
@@ -494,7 +524,9 @@ class AttributeKeySpace(IndexKeySpace):
                     0,
                     b.lower.value,
                     b.upper.value,
-                    True,
+                    # exact in value space only when the bounds are precise
+                    # (LIKE-prefix ranges over-cover and must post-filter)
+                    values.attr_precise,
                     b.lower.inclusive,
                     b.upper.inclusive,
                     tiebreaks if equality else None,

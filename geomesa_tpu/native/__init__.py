@@ -65,6 +65,9 @@ def load():
                 ctypes.c_int,
                 ctypes.c_longlong,
                 ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint32),  # skip_mins (nullable)
+                ctypes.POINTER(ctypes.c_uint32),  # skip_maxs (nullable)
+                ctypes.c_int,  # nskip
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint8),
@@ -77,7 +80,14 @@ def load():
 
 
 def zranges_native(
-    mins, maxs, bits: int, dims: int, max_ranges: Optional[int], precision: int
+    mins,
+    maxs,
+    bits: int,
+    dims: int,
+    max_ranges: Optional[int],
+    precision: int,
+    skip_mins=None,
+    skip_maxs=None,
 ):
     """Native decomposition; returns None when the lib is unavailable.
 
@@ -89,6 +99,16 @@ def zranges_native(
     m = np.ascontiguousarray(np.asarray(mins, dtype=np.uint32).reshape(-1))
     x = np.ascontiguousarray(np.asarray(maxs, dtype=np.uint32).reshape(-1))
     nboxes = len(m) // dims
+    null_u32 = ctypes.POINTER(ctypes.c_uint32)()
+    if skip_mins is not None:
+        sm = np.ascontiguousarray(np.asarray(skip_mins, dtype=np.uint32).reshape(-1))
+        sx = np.ascontiguousarray(np.asarray(skip_maxs, dtype=np.uint32).reshape(-1))
+        nskip = len(sm) // dims
+        sm_p = sm.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+        sx_p = sx.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+    else:
+        nskip = -1  # legacy contained semantics
+        sm_p = sx_p = null_u32
     cap = max(4 * (max_ranges or 0), 1 << 16)
     budget = -1 if max_ranges is None else int(max_ranges)
     while True:
@@ -103,6 +123,9 @@ def zranges_native(
             dims,
             budget,
             precision,
+            sm_p,
+            sx_p,
+            nskip,
             lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             cont.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

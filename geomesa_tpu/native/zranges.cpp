@@ -50,9 +50,18 @@ extern "C" {
 // -needed if the output capacity was insufficient (caller retries).
 //   mins/maxs: [nboxes * dims] per-dim inclusive bounds
 //   max_ranges: <0 means unbounded
+//   skip_mins/skip_maxs: [nskip * dims] optional INTERIOR boxes — when
+//     nskip >= 0 (with non-null pointers) the output `contained` flag
+//     means "cell inside some skip box" (every raw-domain value in the
+//     cell provably satisfies the query's own predicate, so scans skip
+//     the post-filter for these ranges); recursion still classifies
+//     against the regular boxes. nskip == 0 therefore forces every flag
+//     false (no interior). Pass nskip < 0 (null pointers) for the legacy
+//     meaning (cell inside a regular box).
 long long geomesa_zranges(
     const uint32_t* mins, const uint32_t* maxs, int nboxes,
     int bits, int dims, long long max_ranges, int precision,
+    const uint32_t* skip_mins, const uint32_t* skip_maxs, int nskip,
     uint64_t* out_lo, uint64_t* out_hi, uint8_t* out_contained,
     long long cap) {
     if (nboxes <= 0 || dims < 1 || dims > 3) return 0;
@@ -85,9 +94,26 @@ long long geomesa_zranges(
         }
         if (!overlaps) continue;
         if (contained) {
+            uint8_t flag = 1;
+            if (nskip >= 0 && skip_mins != nullptr) {
+                flag = 0;
+                for (int b = 0; b < nskip && !flag; ++b) {
+                    bool cont = true;
+                    for (int d = 0; d < dims; ++d) {
+                        uint64_t c0 = cell.cmin[d];
+                        uint64_t c1 = c0 + size - 1;
+                        if (!(skip_mins[b * dims + d] <= c0 &&
+                              c1 <= skip_maxs[b * dims + d])) {
+                            cont = false;
+                            break;
+                        }
+                    }
+                    if (cont) flag = 1;
+                }
+            }
             uint64_t zmin = interleave(cell.cmin, dims);
             uint64_t span = 1ULL << (dims * (bits - cell.level));
-            ranges.push_back({zmin, zmin + span - 1, 1});
+            ranges.push_back({zmin, zmin + span - 1, flag});
         } else if (cell.level >= max_level ||
                    (max_ranges >= 0 &&
                     (long long)(ranges.size() + queue.size()) >= max_ranges)) {
@@ -115,7 +141,10 @@ long long geomesa_zranges(
     for (size_t i = 1; i < ranges.size(); ++i) {
         Range& cur = merged.back();
         const Range& r = ranges[i];
-        if (r.lo <= cur.hi + 1) {
+        // truly overlapping ranges always coalesce (flag = AND); merely
+        // adjacent ones only when flags match — a skip-eligible interior
+        // run must not lose its flag to a neighboring boundary cell
+        if (r.lo <= cur.hi || (r.lo == cur.hi + 1 && r.contained == cur.contained)) {
             cur.hi = std::max(cur.hi, r.hi);
             cur.contained = cur.contained && r.contained;
         } else {

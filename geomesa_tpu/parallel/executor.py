@@ -694,6 +694,35 @@ class _PendingScan:
                 yield block, local
 
 
+class _HostSeekScan:
+    """A host searchsorted block seek wrapped in the _PendingScan shape:
+    the executor chose seeking over device dispatch for a selective plan.
+    ``exact`` is False (candidates are range-granular — the caller post-
+    filters) and ``seek`` is True (range-granular rows are never eligible
+    for the loose-bbox shortcut, which promises int-domain granularity).
+    Yields (block, rows, covered) triples: ``covered`` rows came from
+    ``contained`` ranges and provably satisfy the exact primary predicate,
+    so the caller applies only the residual (secondary) filter to them.
+
+    Carries the per-block (starts, ends, flags) intervals the chooser's
+    cost probe already computed — row expansion happens lazily at
+    iteration, so the seek runs exactly once per query."""
+
+    __slots__ = ("table", "per_block", "exact", "seek")
+
+    def __init__(self, table: IndexTable, per_block):
+        self.exact = False
+        self.seek = True
+        self.table = table
+        self.per_block = per_block
+
+    def __iter__(self):
+        for block, starts, ends, flags in self.per_block:
+            rows, covered = self.table.expand_covered(block, starts, ends, flags)
+            if len(rows):
+                yield block, rows, covered
+
+
 class DeviceIndex:
     """Segmented device-resident mirror of one index table.
 
@@ -793,6 +822,40 @@ class TpuScanExecutor:
     def _has_visibilities(table: IndexTable) -> bool:
         return any("__vis__" in b.columns for b in table.blocks)
 
+    def _seek_scan(self, table: IndexTable, plan) -> Optional[_HostSeekScan]:
+        """Cost-based execution choice (the StrategyDecider's cost model
+        applied at the execution layer): when the plan's decomposed ranges
+        cover a small fraction of the sorted blocks, a host searchsorted
+        seek touches only candidate rows and beats dispatching a device
+        full-scan — especially over a high-latency device link. This is
+        the reference's own architecture: BatchScanPlan scans only the
+        decomposed ranges (AccumuloQueryPlan.scala:113-140), it never
+        full-scans the table. GEOMESA_SEEK: auto (default) | 0 (never) |
+        1 (whenever ranges exist); GEOMESA_SEEK_FRAC tunes the cutoff."""
+        import os
+
+        mode = os.environ.get("GEOMESA_SEEK", "auto")
+        if mode == "0" or not plan.ranges:
+            return None
+        nrows = table.num_rows
+        if nrows == 0:
+            return None
+        # one searchsorted pass serves both the cost probe and (if the seek
+        # wins) the scan itself — _HostSeekScan expands rows lazily from
+        # these intervals
+        per_block = []
+        total = 0
+        for b in table.blocks:
+            starts, ends, flags = b.scan_intervals(plan.ranges)
+            if len(starts):
+                total += int(np.maximum(ends - starts, 0).sum())
+                per_block.append((b, starts, ends, flags))
+        if mode != "1":
+            frac = float(os.environ.get("GEOMESA_SEEK_FRAC", "0.4"))
+            if total > frac * nrows:
+                return None
+        return _HostSeekScan(table, per_block)
+
     def dispatch_candidates(self, table: IndexTable, plan: QueryPlan):
         """Start the device pre-filter WITHOUT blocking; None -> caller
         falls back to host ranges. Every segment's fused RLE buffer begins
@@ -806,6 +869,9 @@ class TpuScanExecutor:
         compares), so hits need no host post-filter at all — the full
         tserver-iterator role (Z3Iterator + KryoLazyFilterTransformIterator
         combined) on device."""
+        seek = self._seek_scan(table, plan)
+        if seek is not None:
+            return seek
         if not self.supports(table, plan):
             return None
         if table.index.name in ("z3", "xz3") and not plan.values.bins:

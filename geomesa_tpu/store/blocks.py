@@ -68,9 +68,50 @@ def take_rows(columns: Columns, rows: np.ndarray) -> Columns:
     return {k: v[rows] for k, v in columns.items()}
 
 
+def expand_intervals(
+    starts: np.ndarray, ends: np.ndarray, flags: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """[start, end) row intervals -> sorted deduped row indices.
+
+    Disjoint sorted intervals (the common case: merged z-ranges seeked into
+    a sorted key column) expand with vectorized run arithmetic; anything
+    overlapping falls back to a unique pass.
+
+    With per-interval ``flags`` (range ``contained`` markers) returns
+    (rows, covered) where ``covered`` is the per-row expansion of the
+    flags; the overlap fallback drops flags to all-False (safe: covered
+    rows merely skip a post-filter they would pass)."""
+    if not len(starts):
+        rows = np.empty(0, dtype=np.int64)
+        return rows if flags is None else (rows, np.empty(0, dtype=bool))
+    lens = ends - starts
+    keep = lens > 0
+    if not keep.all():
+        starts, ends, lens = starts[keep], ends[keep], lens[keep]
+        if flags is not None:
+            flags = flags[keep]
+        if not len(starts):
+            rows = np.empty(0, dtype=np.int64)
+            return rows if flags is None else (rows, np.empty(0, dtype=bool))
+    order = np.argsort(starts, kind="stable")
+    starts, ends, lens = starts[order], ends[order], lens[order]
+    out_starts = np.repeat(starts, lens)
+    base = np.concatenate(([0], np.cumsum(lens[:-1])))
+    rows = out_starts + (np.arange(len(out_starts), dtype=np.int64) - np.repeat(base, lens))
+    if len(starts) > 1 and (ends[:-1] > starts[1:]).any():
+        rows = np.unique(rows)  # overlapping intervals: dedup
+        return rows if flags is None else (rows, np.zeros(len(rows), dtype=bool))
+    if flags is None:
+        return rows
+    covered = np.repeat(flags[order].astype(bool), lens)
+    return rows, covered
+
+
 def concat_columns(parts: Sequence[Columns]) -> Columns:
     if not parts:
         return {}
+    if len(parts) == 1:
+        return dict(parts[0])  # single block: no copy
     keys = set()
     for p in parts:
         keys.update(p.keys())
@@ -144,6 +185,20 @@ class FeatureBlock:
 
     @classmethod
     def build(cls, index: IndexKeySpace, ft: FeatureType, columns: Columns) -> "FeatureBlock":
+        fid = columns.get("__fid__")
+        # the all-str scan is a short-circuiting Python pass (~3% of ingest);
+        # astype would silently coerce non-strings, so it cannot replace it
+        if (
+            fid is not None
+            and fid.dtype == object
+            and len(fid)
+            and all(type(v) is str for v in fid)
+        ):
+            # fixed-width unicode storage: fancy-indexing a U-array is a
+            # memcpy, ~6x faster than object-pointer gather + refcounting
+            # (the fid gather is the hottest host op on the query path)
+            columns = dict(columns)
+            columns["__fid__"] = fid.astype(np.str_)
         key_cols = index.key_columns(ft, columns)
         key = key_cols["__key__"]
         bins = key_cols.get("__bin__")
@@ -173,35 +228,59 @@ class FeatureBlock:
 
     def scan(self, ranges: Sequence[ScanRange]) -> np.ndarray:
         """Row indices whose keys fall in any range (sorted, deduped)."""
+        starts, ends, _ = self.scan_intervals(ranges)
+        return expand_intervals(starts, ends)
+
+    def scan_covered(
+        self, ranges: Sequence[ScanRange]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows, covered): like ``scan`` plus a per-row bool marking rows
+        from ``contained`` ranges — rows that provably satisfy the plan's
+        exact primary predicate and may skip the post-filter."""
+        starts, ends, flags = self.scan_intervals(ranges)
+        return expand_intervals(starts, ends, flags)
+
+    def scan_intervals(
+        self, ranges: Sequence[ScanRange]
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Row-interval form of ``scan``: (starts, ends[, ), flags) arrays.
+        The cheap seek product — callers that only need counts (the
+        executor's host-seek cost probe) avoid materializing rows."""
         if self.n == 0 or not ranges:
-            return np.empty(0, dtype=np.int64)
-        pieces: List[np.ndarray] = []
+            z = np.empty(0, dtype=np.int64)
+            return z, z, np.empty(0, dtype=bool)
+        pieces: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         if self.bins is not None:
             by_bin: Dict[int, List[ScanRange]] = {}
             for r in ranges:
                 by_bin.setdefault(r.bin, []).append(r)
-            for b, rs in by_bin.items():
+            for b in sorted(by_bin):
                 if b not in self.bin_slices:
                     continue
                 s, e = self.bin_slices[b]
-                pieces.extend(self._scan_slice(s, e, rs))
+                pieces.append(self._slice_intervals(s, e, by_bin[b]))
         else:
-            pieces.extend(self._scan_slice(0, self.n, ranges))
+            pieces.append(self._slice_intervals(0, self.n, ranges))
         if not pieces:
-            return np.empty(0, dtype=np.int64)
-        rows = np.concatenate(pieces)
-        return np.unique(rows)
+            z = np.empty(0, dtype=np.int64)
+            return z, z, np.empty(0, dtype=bool)
+        starts = np.concatenate([p[0] for p in pieces])
+        ends = np.concatenate([p[1] for p in pieces])
+        flags = np.concatenate([p[2] for p in pieces])
+        return starts, ends, flags
 
-    def _scan_slice(
+    def _slice_intervals(
         self, s: int, e: int, ranges: Sequence[ScanRange]
-    ) -> List[np.ndarray]:
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         sub = self.key[s:e]
-        out = []
         numeric = sub.dtype != object
         if self.tiebreak is not None and any(r.tiebreak_ranges for r in ranges):
             # attribute scans with a z2 tiebreak: within each equality span
             # rows are z-sorted, so spatial predicates reduce to z sub-spans
-            # (the tiered-range scan of the reference's AttributeIndex)
+            # (the tiered-range scan of the reference's AttributeIndex).
+            # Tiebreak sub-spans are spatial over-approximations, so their
+            # covered flag is always False.
+            outs, oute, outf = [], [], []
             for r in ranges:
                 side = "left" if r.lower is None or r.lower_inclusive else "right"
                 st = s if r.lower is None else int(np.searchsorted(sub, r.lower, side=side)) + s
@@ -210,15 +289,23 @@ class FeatureBlock:
                 if en <= st:
                     continue
                 if not r.tiebreak_ranges:
-                    out.append(np.arange(st, en, dtype=np.int64))
+                    outs.append(st)
+                    oute.append(en)
+                    outf.append(r.contained)
                     continue
                 tb = self.tiebreak[st:en]
                 for zlo, zhi in r.tiebreak_ranges:
                     s2 = int(np.searchsorted(tb, zlo, side="left"))
                     e2 = int(np.searchsorted(tb, zhi, side="right"))
                     if e2 > s2:
-                        out.append(np.arange(st + s2, st + e2, dtype=np.int64))
-            return out
+                        outs.append(st + s2)
+                        oute.append(st + e2)
+                        outf.append(False)
+            return (
+                np.asarray(outs, dtype=np.int64),
+                np.asarray(oute, dtype=np.int64),
+                np.asarray(outf, dtype=bool),
+            )
         if numeric and all(
             r.lower is not None
             and r.upper is not None
@@ -228,12 +315,11 @@ class FeatureBlock:
         ):
             los = np.asarray([r.lower for r in ranges], dtype=sub.dtype)
             his = np.asarray([r.upper for r in ranges], dtype=sub.dtype)
-            starts = np.searchsorted(sub, los, side="left") + s
-            ends = np.searchsorted(sub, his, side="right") + s
-            for st, en in zip(starts, ends):
-                if en > st:
-                    out.append(np.arange(st, en, dtype=np.int64))
-            return out
+            starts = np.searchsorted(sub, los, side="left").astype(np.int64) + s
+            ends = np.searchsorted(sub, his, side="right").astype(np.int64) + s
+            flags = np.asarray([r.contained for r in ranges], dtype=bool)
+            return starts, ends, flags
+        outs, oute, outf = [], [], []
         for r in ranges:
             if r.lower is None:
                 st = s
@@ -246,8 +332,14 @@ class FeatureBlock:
                 side = "right" if r.upper_inclusive else "left"
                 en = int(np.searchsorted(sub, r.upper, side=side)) + s
             if en > st:
-                out.append(np.arange(st, en, dtype=np.int64))
-        return out
+                outs.append(st)
+                oute.append(en)
+                outf.append(r.contained)
+        return (
+            np.asarray(outs, dtype=np.int64),
+            np.asarray(oute, dtype=np.int64),
+            np.asarray(outf, dtype=bool),
+        )
 
 
 class IndexTable:
@@ -287,6 +379,33 @@ class IndexTable:
             rows = self._strip_tombstones(b, rows)
             if len(rows):
                 yield b, rows
+
+    def scan_covered(
+        self, ranges: Sequence[ScanRange]
+    ) -> Iterator[Tuple[FeatureBlock, np.ndarray, np.ndarray]]:
+        """Like ``scan`` but yields (block, rows, covered): ``covered`` rows
+        came from ``contained`` ranges and provably satisfy the plan's exact
+        primary predicate (no post-filter needed for them)."""
+        for b in self.blocks:
+            starts, ends, flags = b.scan_intervals(ranges)
+            rows, covered = self.expand_covered(b, starts, ends, flags)
+            if len(rows):
+                yield b, rows, covered
+
+    def expand_covered(
+        self, block: FeatureBlock, starts, ends, flags
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows, covered) from seek intervals, with tombstones stripped —
+        the shared expansion step for scan_covered and the executor's
+        host-seek scan (which reuses its cost-probe intervals)."""
+        rows, covered = expand_intervals(starts, ends, flags)
+        if self.tombstones and len(rows):
+            fids = block.columns["__fid__"][rows]
+            keep = ~np.isin(fids, list(self.tombstones))
+            if not keep.all():
+                rows = rows[keep]
+                covered = covered[keep]
+        return rows, covered
 
     def scan_all(self) -> Iterator[Tuple[FeatureBlock, np.ndarray]]:
         for b in self.blocks:

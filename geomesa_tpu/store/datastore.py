@@ -486,6 +486,7 @@ class TpuDataStore:
             and plan.index.name in ("z2", "z3")
             and plan.secondary is None
             and device_scan  # device int-domain candidates only
+            and not getattr(scan, "seek", False)  # range-granular rows
             and gv.values
             and gv.precise
             and all(g.is_rectangle() for g in gv.values)
@@ -502,7 +503,18 @@ class TpuDataStore:
             if allow_prune
             else None
         )
-        for block, rows in scan:
+        # columns only the post-filter/age-off reads are dropped before the
+        # survivor gather: with a narrow projection (e.g. fid-only streams)
+        # the filter inputs never leave the block
+        out_needed = self._output_columns(ft, query) if allow_prune else None
+        for item in scan:
+            if len(item) == 3:
+                block, rows, covered = item
+                if covered is not None and not covered.any():
+                    covered = None  # nothing to split: take the generic path
+            else:
+                block, rows = item
+                covered = None
             if self.query_timeout_s is not None and (
                 _time.perf_counter() - t_scan_start > self.query_timeout_s
             ):
@@ -511,6 +523,13 @@ class TpuDataStore:
                 raise QueryTimeout(
                     f"query exceeded {self.query_timeout_s}s (geomesa.query.timeout analog)"
                 )
+            if covered is not None and plan.post_filter is not None and not loose:
+                part = self._scan_block_covered(
+                    ft, plan, block, rows, covered, age_cutoff, needed, out_needed
+                )
+                if part is not None:
+                    parts.append(part)
+                continue
             # gather value columns first; the (object-dtype) fid column is
             # gathered once, only for rows surviving the exact post-filter
             mask_cols = {
@@ -530,9 +549,19 @@ class TpuDataStore:
                     mask_cols = {k: v[alive] for k, v in mask_cols.items()}
             if plan.post_filter is not None and not loose:
                 mask = self.executor.post_filter(ft, plan, mask_cols)
+                if out_needed is not None:
+                    mask_cols = {
+                        k: v
+                        for k, v in mask_cols.items()
+                        if _column_base(k) in out_needed
+                    }
                 if not mask.all():
                     rows = rows[mask]
                     mask_cols = {k: v[mask] for k, v in mask_cols.items()}
+            elif out_needed is not None:
+                mask_cols = {
+                    k: v for k, v in mask_cols.items() if _column_base(k) in out_needed
+                }
             vis = block.columns.get("__vis__")
             if vis is not None:
                 # per-feature visibility vs this store's authorizations
@@ -547,6 +576,75 @@ class TpuDataStore:
             if len(rows):
                 parts.append(mask_cols)
         return parts
+
+    def _scan_block_covered(
+        self, ft, plan: QueryPlan, block, rows, covered, age_cutoff, needed, out_needed
+    ):
+        """Covered-split scan of one block.
+
+        Rows marked ``covered`` came from ``contained`` ranges and provably
+        satisfy the plan's exact primary predicate (strict-interior z skip
+        boxes / precise attr-value ranges), so the full post-filter runs
+        only on the uncovered remainder; covered rows check just the
+        residual secondary predicate. The reference makes the analogous
+        move by dropping the primary filter when ranges are covering and
+        residual-free; here it is per-range, not per-plan."""
+        from geomesa_tpu.filter import ast as _ast
+        from geomesa_tpu.filter.evaluate import evaluate
+
+        if age_cutoff is not None:
+            dtg = ft.default_date.name
+            alive = block.columns[dtg][rows] >= age_cutoff
+            nulls_col = block.columns.get(dtg + "__null")
+            if nulls_col is not None:
+                alive |= nulls_col[rows]  # null dates never age off
+            if not alive.all():
+                rows = rows[alive]
+                covered = covered[alive]
+        keep = covered.copy()
+        uncov_idx = np.flatnonzero(~covered)
+        if len(uncov_idx):
+            rows_u = rows[uncov_idx]
+            fcols = {
+                k: v[rows_u]
+                for k, v in block.columns.items()
+                if k not in ("__fid__", "__vis__")
+                and (needed is None or _column_base(k) in needed)
+            }
+            keep[uncov_idx] = self.executor.post_filter(ft, plan, fcols)
+        if plan.secondary is not None:
+            cov_idx = np.flatnonzero(covered)
+            if len(cov_idx):
+                rows_c = rows[cov_idx]
+                sec_props = set(_ast.properties(plan.secondary))
+                scols = {
+                    k: v[rows_c]
+                    for k, v in block.columns.items()
+                    if k not in ("__fid__", "__vis__")
+                    and _column_base(k) in sec_props
+                }
+                keep[cov_idx] = evaluate(plan.secondary, ft, scols)
+        if not keep.all():
+            rows = rows[keep]
+        if not len(rows):
+            return None
+        vis = block.columns.get("__vis__")
+        if vis is not None:
+            from geomesa_tpu.security import visibility_mask
+
+            vmask = visibility_mask(vis[rows], self.authorizations)
+            if not vmask.all():
+                rows = rows[vmask]
+                if not len(rows):
+                    return None
+        out = {
+            k: v[rows]
+            for k, v in block.columns.items()
+            if k not in ("__fid__", "__vis__")
+            and (out_needed is None or _column_base(k) in out_needed)
+        }
+        out["__fid__"] = block.columns["__fid__"][rows]
+        return out
 
     def _needed_columns(
         self, ft: FeatureType, query: Query, plan: QueryPlan, loose: bool, age_cutoff
@@ -569,6 +667,24 @@ class TpuDataStore:
         if age_cutoff is not None and ft.default_date is not None:
             needed.add(ft.default_date.name)
         return needed
+
+    def _output_columns(self, ft: FeatureType, query: Query) -> Optional[set]:
+        """Base-names the query RESULT must carry; None = everything.
+        A superset of the projection: sort and sampling read from the
+        gathered columns after filtering. Distinct from _needed_columns,
+        which adds post-filter/age-off inputs that never reach the result."""
+        props = query.properties
+        if props is None or has_aggregation(query.hints):
+            return None
+        if any("=" in p for p in props):
+            return None  # derived transforms read arbitrary source columns
+        out = set(props)
+        if query.sort_by:
+            out.update(a for a, _ in query.sort_by)
+        sample_by = query.hints.get("sample_by")
+        if sample_by:
+            out.add(sample_by)
+        return out
 
     def _age_off_cutoff(self, ft: FeatureType) -> Optional[int]:
         """Epoch-ms cutoff below which features are expired, or None.

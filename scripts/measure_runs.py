@@ -1,0 +1,110 @@
+"""Measure backend-independent bench-stream quantities on the CPU backend:
+run counts (=> transfer bytes), rcap trajectory, and host phase costs.
+
+Usage: JAX_PLATFORMS=cpu GEOMESA_BENCH_N=5000000 python scripts/measure_runs.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# this script measures the DEVICE RLE-buffer protocol; the host-seek chooser
+# would answer these plans without dispatching
+os.environ.setdefault("GEOMESA_SEEK", "0")
+
+from geomesa_tpu.parallel.mesh import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()
+
+import bench  # noqa: E402
+
+
+def main():
+    n = int(os.environ.get("GEOMESA_BENCH_N", 5_000_000))
+    reps = int(os.environ.get("GEOMESA_BENCH_REPS", 8))
+    x, y, t = bench.synthesize(n)
+    boxes, cqls = bench.make_queries(reps)
+
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import TpuDataStore
+
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    t0 = time.perf_counter()
+    store._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t})
+    print(f"ingest: {time.perf_counter() - t0:.1f}s", flush=True)
+
+    t0 = time.perf_counter()
+    res = store.query("gdelt", bench.QUERY)
+    print(f"warm: {time.perf_counter() - t0:.1f}s hits={len(res.fids)}", flush=True)
+
+    name = "gdelt"
+    queries = [Query.cql(c, properties=[]) for c in cqls]
+    qs = [store._as_query(q) for q in queries]
+    plans = []
+    t0 = time.perf_counter()
+    for q in qs:
+        plans.append(store._plan_cached(name, q))
+    plan_s = time.perf_counter() - t0
+    print(f"plan: {plan_s / reps * 1000:.1f} ms/query", flush=True)
+
+    table = store._tables[name][plans[0].index.name]
+    # per-query dispatch + immediate resolve, recording run counts
+    tot_runs, tot_hits, tot_bytes = [], [], []
+    exact_flags = []
+    for plan in plans:
+        scan = store.executor.dispatch_candidates(table, plan)
+        exact_flags.append(getattr(scan, "exact", False))
+        for seg, ph in scan.pending:
+            buf = np.asarray(ph.buf)
+            cnt, nruns = int(buf[0]), int(buf[1])
+            tot_runs.append(nruns)
+            tot_hits.append(cnt)
+            tot_bytes.append(buf.nbytes)
+            ph.rows()
+    print(f"exact-path queries: {sum(exact_flags)}/{len(exact_flags)}", flush=True)
+    print(
+        f"avg hits {np.mean(tot_hits):,.0f}  avg runs {np.mean(tot_runs):,.0f}  "
+        f"avg buffer {np.mean(tot_bytes) / 1e6:.2f} MB  "
+        f"(min runs ratio {np.mean(tot_runs) / max(np.mean(tot_hits), 1):.3f})",
+        flush=True,
+    )
+    # rcap trajectory
+    dev = store.executor.device_index(table)
+    print("rcap per segment:", [s._rcap for s in dev.segments], flush=True)
+
+    # host decode cost: run expansion at bench scale
+    nh = int(np.mean(tot_hits))
+    nr = max(int(np.mean(tot_runs)), 1)
+    starts = np.sort(np.random.default_rng(0).choice(n, nr, replace=False)).astype(np.int64)
+    lens = np.full(nr, max(nh // nr, 1), dtype=np.int64)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = np.repeat(starts, lens)
+        base = np.concatenate(([0], np.cumsum(lens[:-1])))
+        out = out + (np.arange(len(out), dtype=np.int64) - np.repeat(base, lens))
+    print(f"decode (synthetic {nr} runs -> {len(out):,} rows): {(time.perf_counter() - t0) / 5 * 1000:.1f} ms", flush=True)
+
+    # fid gather cost
+    rows = np.sort(np.random.default_rng(1).choice(n, nh, replace=False))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        _ = fids[rows]
+    print(f"fid gather ({nh:,} object strs): {(time.perf_counter() - t0) / 5 * 1000:.1f} ms", flush=True)
+
+    # full query_many on cpu for reference
+    t0 = time.perf_counter()
+    store.query_many(name, queries)
+    print(f"query_many (cpu backend): {(time.perf_counter() - t0) / reps * 1000:.1f} ms/query", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Phase-level profiler for the headline bench query path on live hardware.
+
+Breaks one bench-style query stream into:
+  plan       CQL parse + strategy + zranges (host)
+  dispatch   descriptor upload + jit dispatch (host->device, async)
+  device     kernel execution (block_until_ready on the RLE buffer)
+  transfer   device->host fetch of the fused count+runs buffer
+  decode     RLE run expansion -> sorted row indices
+  gather     block column gather + fid materialization (QueryResult build)
+
+Usage: GEOMESA_BENCH_N=... python scripts/profile_query.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# this profiler dissects the DEVICE dispatch protocol (_PendingHits et al);
+# the host-seek chooser would answer these plans without dispatching
+os.environ.setdefault("GEOMESA_SEEK", "0")
+
+import bench  # noqa: E402
+
+
+def main():
+    n = int(os.environ.get("GEOMESA_BENCH_N", 5_000_000))
+    reps = int(os.environ.get("GEOMESA_BENCH_REPS", 8))
+    x, y, t = bench.synthesize(n)
+    boxes, cqls = bench.make_queries(reps)
+
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import TpuDataStore
+
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    t0 = time.perf_counter()
+    store._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t})
+    print(f"ingest: {time.perf_counter() - t0:.1f}s ({n / (time.perf_counter() - t0):,.0f} rec/s)")
+
+    # warm (pack + compile)
+    t0 = time.perf_counter()
+    res = store.query("gdelt", bench.QUERY)
+    print(f"warm: {time.perf_counter() - t0:.1f}s hits={len(res.fids)}")
+
+    queries = [Query.cql(c, properties=[]) for c in cqls]
+
+    # ---- phase timing over the stream --------------------------------
+    phases = {k: 0.0 for k in ("plan", "dispatch", "device", "transfer", "decode", "gather")}
+    name = "gdelt"
+    plans = []
+    t0 = time.perf_counter()
+    for q in queries:
+        plans.append(store._plan_cached(name, q))
+    phases["plan"] = time.perf_counter() - t0
+
+    table = store._tables[name][plans[0].index.name]
+    scans = []
+    t0 = time.perf_counter()
+    for plan in plans:
+        scans.append(store.executor.dispatch_candidates(table, plan))
+    phases["dispatch"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for scan in scans:
+        for seg, ph in scan.pending:
+            ph.buf.block_until_ready()
+    phases["device"] = time.perf_counter() - t0
+
+    bufs = []
+    t0 = time.perf_counter()
+    for scan in scans:
+        for seg, ph in scan.pending:
+            bufs.append(np.asarray(ph.buf))
+    phases["transfer"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    allrows = []
+    for scan in scans:
+        rows_per = []
+        for seg, ph in scan.pending:
+            rows_per.append((seg, ph.rows()))
+        allrows.append((scan, rows_per))
+    phases["decode"] = time.perf_counter() - t0
+
+    qftq = [store._as_query(q) for q in queries]
+    t0 = time.perf_counter()
+    results = []
+    for (scan, _), q, plan in zip(allrows, qftq, plans):
+        parts = store._scan_parts(name, ft, q, plan, time.perf_counter(), {id(plan): scan})
+        results.append(parts)
+    phases["gather"] = time.perf_counter() - t0
+
+    total = sum(phases.values())
+    print(f"\nN={n:,} reps={reps} total={total:.3f}s  per-query={total / reps * 1000:.1f}ms")
+    for k, v in phases.items():
+        print(f"  {k:9s} {v / reps * 1000:8.2f} ms/query  ({100 * v / total:5.1f}%)")
+
+    # sanity: end-to-end query_many for comparison
+    t0 = time.perf_counter()
+    store.query_many(name, queries)
+    e2e = time.perf_counter() - t0
+    print(f"query_many end-to-end: {e2e / reps * 1000:.1f} ms/query")
+
+    nhits = sum(len(r) for _, rp in allrows for __, r in rp) // reps
+    print(f"avg hits/query: {nhits:,}")
+
+
+if __name__ == "__main__":
+    main()

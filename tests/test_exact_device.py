@@ -15,8 +15,11 @@ from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
 
 @pytest.fixture(autouse=True)
 def _force_exact(monkeypatch):
-    # 'auto' disables the exact path on the CPU backend; tests force it
+    # 'auto' disables the exact path on the CPU backend; tests force it.
+    # The host-seek chooser would otherwise win these selective plans —
+    # disable it so the device-exact path under test actually dispatches.
     monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "1")
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
 
 SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
 BASE = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")

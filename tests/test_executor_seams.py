@@ -22,6 +22,13 @@ CQL = "bbox(geom, -20, -20, 20, 20) AND dtg DURING 2026-01-02T00:00:00Z/2026-01-
 BASE = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
 
 
+@pytest.fixture(autouse=True)
+def _force_device(monkeypatch):
+    # the host-seek chooser would answer these selective plans without ever
+    # dispatching; these tests are about the DEVICE seams, so disable it
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+
+
 def _mk_store(executor):
     s = TpuDataStore(executor=executor)
     s.create_schema(parse_spec("t", SPEC))

@@ -1,0 +1,189 @@
+"""Host-seek chooser + covered-range post-filter skip.
+
+The executor now makes a cost-based execution choice (the reference's
+StrategyDecider cost model applied at the execution layer): selective plans
+seek the sorted blocks on host instead of dispatching a device full-scan,
+and ranges whose cells lie strictly inside the query's interior skip the
+post-filter entirely (per-range version of the reference's covering-range
+filter drop). These tests pin the chooser, the exact-skip semantics at box
+boundaries, and parity against the brute-force memory store.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve.zorder import IndexRange, merge_ranges, zranges
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.parallel.executor import _HostSeekScan
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+BASE = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+CQL = "bbox(geom, -20, -20, 20, 20) AND dtg DURING 2026-01-02T00:00:00Z/2026-01-30T00:00:00Z"
+
+
+def _mk(executor=None, n=4000, seed=3):
+    s = TpuDataStore(executor=executor)
+    s.create_schema(parse_spec("t", SPEC))
+    rng = np.random.default_rng(seed)
+    with s.writer("t") as w:
+        for i in range(n):
+            w.write(
+                [
+                    f"n{i % 5}",
+                    int(BASE + rng.integers(0, 35 * 86400_000)),
+                    Point(float(rng.uniform(-60, 60)), float(rng.uniform(-60, 60))),
+                ],
+                fid=f"f{i}",
+            )
+    return s
+
+
+def test_seek_chooser_picks_host_seek_for_selective_plan():
+    s = _mk(TpuScanExecutor(default_mesh()))
+    plan = s._plan_cached("t", s._as_query(CQL))
+    table = s._tables["t"][plan.index.name]
+    scan = s.executor.scan_candidates(table, plan)
+    assert isinstance(scan, _HostSeekScan)
+    assert scan.seek and not scan.exact
+
+
+def test_seek_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    s = _mk(TpuScanExecutor(default_mesh()))
+    plan = s._plan_cached("t", s._as_query(CQL))
+    table = s._tables["t"][plan.index.name]
+    scan = s.executor.scan_candidates(table, plan)
+    assert not isinstance(scan, _HostSeekScan)
+
+
+def test_seek_parity_with_device_path():
+    a = _mk(TpuScanExecutor(default_mesh()))
+    b = _mk(HostScanExecutor())
+    got = sorted(a.query("t", CQL).fids)
+    want = sorted(b.query("t", CQL).fids)
+    assert got == want and len(got) > 0
+
+
+def test_covered_ranges_exist_and_skip_post_filter(monkeypatch):
+    """A large interior query must produce contained ranges, and covered
+    rows must never reach the post-filter (only uncovered boundary rows)."""
+    s = _mk(TpuScanExecutor(default_mesh()), n=6000)
+    plan = s._plan_cached("t", s._as_query(CQL))
+    assert any(r.contained for r in plan.ranges), "interior ranges expected"
+    table = s._tables["t"][plan.index.name]
+    scan = s.executor.scan_candidates(table, plan)
+    ncov = nuncov = 0
+    for _, rows, covered in scan:
+        ncov += int(covered.sum())
+        nuncov += int((~covered).sum())
+    assert ncov > 0
+    # post_filter sees only the uncovered rows
+    seen = []
+    orig = type(s.executor).post_filter
+
+    def spy(self, ft, p, cols):
+        seen.append(len(next(iter(cols.values()))))
+        return orig(self, ft, p, cols)
+
+    monkeypatch.setattr(type(s.executor), "post_filter", spy)
+    res = s.query("t", CQL)
+    assert sum(seen) == nuncov
+    # parity against brute force
+    want = sorted(_mk(HostScanExecutor(), n=6000).query("t", CQL).fids)
+    assert sorted(res.fids) == want
+
+
+def test_covered_rows_provably_satisfy_predicate():
+    """Every row in a contained range must individually pass the raw
+    f64/ms predicate — the exact-skip guarantee, checked by brute force."""
+    s = _mk(TpuScanExecutor(default_mesh()), n=8000, seed=11)
+    plan = s._plan_cached("t", s._as_query(CQL))
+    table = s._tables["t"][plan.index.name]
+    from geomesa_tpu.filter.evaluate import evaluate
+
+    ft = s.get_schema("t")
+    for block, rows, covered in table.scan_covered(plan.ranges):
+        if not covered.any():
+            continue
+        rc = rows[covered]
+        cols = {k: v[rc] for k, v in block.columns.items() if k != "__fid__"}
+        mask = evaluate(plan.full_filter, ft, cols)
+        assert mask.all(), "covered row failed the exact predicate"
+
+
+def test_secondary_applied_to_covered_rows():
+    """attr residual must still filter covered rows (bbox+dtg+name)."""
+    cql = CQL + " AND name = 'n1'"
+    a = _mk(TpuScanExecutor(default_mesh()), n=5000)
+    b = _mk(HostScanExecutor(), n=5000)
+    got = sorted(a.query("t", cql).fids)
+    want = sorted(b.query("t", cql).fids)
+    assert got == want and len(got) > 0
+
+
+def test_merge_ranges_preserves_contained_flags():
+    rs = [
+        IndexRange(0, 9, True),
+        IndexRange(10, 19, False),  # adjacent, different flag: no merge
+        IndexRange(20, 29, False),  # adjacent, same flag: merge
+        IndexRange(25, 40, True),  # true overlap: merge, AND -> False
+        IndexRange(50, 60, True),
+        IndexRange(61, 70, True),  # adjacent same flag: merge
+    ]
+    out = merge_ranges(rs)
+    assert out == [
+        IndexRange(0, 9, True),
+        IndexRange(10, 40, False),
+        IndexRange(50, 70, True),
+    ]
+
+
+def test_zranges_skip_boxes_python_native_parity():
+    """Skip-box contained flags agree between the C++ and Python BFS."""
+    import os
+
+    box_min, box_max = [3, 5], [900, 700]
+    skip_min, skip_max = [4, 6], [899, 699]
+    kw = dict(
+        bits=10,
+        dims=2,
+        max_ranges=200,
+        skip_mins=[skip_min],
+        skip_maxs=[skip_max],
+    )
+    native = zranges([box_min], [box_max], **kw)
+    os.environ["GEOMESA_TPU_NO_NATIVE"] = "1"
+    try:
+        pure = zranges([box_min], [box_max], **kw)
+    finally:
+        del os.environ["GEOMESA_TPU_NO_NATIVE"]
+    assert native == pure
+    assert any(r.contained for r in native)
+    assert any(not r.contained for r in native)
+
+
+def test_zranges_skip_flags_are_strict_interior():
+    """A contained range's cells decode to coords inside the SKIP box."""
+    from geomesa_tpu.curve.zorder import z2_decode
+
+    box_min, box_max = [10, 10], [500, 400]
+    skip_min, skip_max = [11, 11], [499, 399]
+    rs = zranges(
+        [box_min],
+        [box_max],
+        bits=10,
+        dims=2,
+        max_ranges=500,
+        skip_mins=[skip_min],
+        skip_maxs=[skip_max],
+    )
+    for r in rs:
+        if not r.contained:
+            continue
+        zs = np.arange(r.lower, r.upper + 1, dtype=np.uint64)
+        xi, yi = z2_decode(zs)
+        assert (xi >= skip_min[0]).all() and (xi <= skip_max[0]).all()
+        assert (yi >= skip_min[1]).all() and (yi <= skip_max[1]).all()
