@@ -19,24 +19,32 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "zranges.cpp")
 _SO = os.path.join(_DIR, "_zranges.so")
+_SEEK_SRC = os.path.join(_DIR, "seekscan.cpp")
+_SEEK_SO = os.path.join(_DIR, "_seekscan.so")
 
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_seek_lib = None
+_seek_tried = False
 
 
-def _build() -> bool:
+def _build_so(src: str, so: str) -> bool:
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", so + ".tmp", src],
             check=True,
             capture_output=True,
             timeout=120,
         )
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(so + ".tmp", so)
         return True
     except Exception:
         return False
+
+
+def _build() -> bool:
+    return _build_so(_SRC, _SO)
 
 
 def load():
@@ -77,6 +85,102 @@ def load():
         except Exception:
             _lib = None
         return _lib
+
+
+def load_seek():
+    """The seek-scan ctypes lib, building if needed; None when unavailable."""
+    global _seek_lib, _seek_tried
+    if os.environ.get("GEOMESA_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _seek_tried:
+            return _seek_lib
+        _seek_tried = True
+        try:
+            stale = (not os.path.exists(_SEEK_SO)) or (
+                os.path.getmtime(_SEEK_SO) < os.path.getmtime(_SEEK_SRC)
+            )
+            if stale and not _build_so(_SEEK_SRC, _SEEK_SO):
+                return None
+            lib = ctypes.CDLL(_SEEK_SO)
+            fn = lib.geomesa_seek_scan
+            fn.restype = ctypes.c_longlong
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_double),  # x
+                ctypes.POINTER(ctypes.c_double),  # y
+                ctypes.POINTER(ctypes.c_int64),  # t (nullable)
+                ctypes.POINTER(ctypes.c_int64),  # starts
+                ctypes.POINTER(ctypes.c_int64),  # ends
+                ctypes.POINTER(ctypes.c_uint8),  # covered
+                ctypes.c_longlong,  # nruns
+                ctypes.c_double,  # xmin
+                ctypes.c_double,  # xmax
+                ctypes.c_double,  # ymin
+                ctypes.c_double,  # ymax
+                ctypes.c_int64,  # tlo
+                ctypes.c_int64,  # thi
+                ctypes.POINTER(ctypes.c_int64),  # out_rows
+                ctypes.c_longlong,  # cap
+            ]
+            _seek_lib = lib
+        except Exception:
+            _seek_lib = None
+        return _seek_lib
+
+
+def seek_scan_native(
+    x: np.ndarray,
+    y: np.ndarray,
+    t,
+    starts: np.ndarray,
+    ends: np.ndarray,
+    covered: np.ndarray,
+    box,
+    tlo,
+    thi,
+):
+    """One-pass candidate-interval filter (see seekscan.cpp); returns the
+    final row-index array, or None when the lib is unavailable.
+
+    ``box`` = (xmin, ymin, xmax, ymax) inclusive; ``tlo``/``thi`` inclusive
+    epoch ms (ignored when ``t`` is None)."""
+    lib = load_seek()
+    if lib is None:
+        return None
+    xs = np.ascontiguousarray(x, dtype=np.float64)
+    ys = np.ascontiguousarray(y, dtype=np.float64)
+    st = np.ascontiguousarray(starts, dtype=np.int64)
+    en = np.ascontiguousarray(ends, dtype=np.int64)
+    cv = np.ascontiguousarray(covered, dtype=np.uint8)
+    if t is not None:
+        ts = np.ascontiguousarray(t, dtype=np.int64)
+        t_p = ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        lo, hi = int(tlo), int(thi)
+    else:
+        t_p = ctypes.POINTER(ctypes.c_int64)()
+        lo = hi = 0
+    cap = int(np.maximum(en - st, 0).sum())
+    out = np.empty(max(cap, 1), dtype=np.int64)
+    n = lib.geomesa_seek_scan(
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ys.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        t_p,
+        st.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        en.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        cv.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(st),
+        float(box[0]),
+        float(box[2]),
+        float(box[1]),
+        float(box[3]),
+        lo,
+        hi,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        cap,
+    )
+    if n < 0:
+        return None  # cannot happen with an exact cap; fall back anyway
+    return out[:n]
 
 
 def zranges_native(

@@ -706,21 +706,73 @@ class _HostSeekScan:
 
     Carries the per-block (starts, ends, flags) intervals the chooser's
     cost probe already computed — row expansion happens lazily at
-    iteration, so the seek runs exactly once per query."""
+    iteration, so the seek runs exactly once per query.
 
-    __slots__ = ("table", "per_block", "exact", "seek")
+    With ``pred`` set — the query reduced to one exact bbox(+interval)
+    predicate (_exact_predicate_shape) and the native lib is available —
+    iteration runs the one-pass C++ seek-scan (native/seekscan.cpp, the
+    tserver Z3Iterator hot-loop analog): final filtered rows come straight
+    out, ``exact`` flips True, and the caller skips its post-filter."""
 
-    def __init__(self, table: IndexTable, per_block):
-        self.exact = False
+    __slots__ = ("table", "per_block", "pred", "exact", "seek")
+
+    def __init__(self, table: IndexTable, per_block, pred=None):
+        self.exact = pred is not None
         self.seek = True
         self.table = table
         self.per_block = per_block
+        self.pred = pred
 
     def __iter__(self):
+        if self.pred is not None:
+            yield from self._iter_native()
+            return
         for block, starts, ends, flags in self.per_block:
             rows, covered = self.table.expand_covered(block, starts, ends, flags)
             if len(rows):
                 yield block, rows, covered
+
+    def _iter_native(self):
+        from geomesa_tpu.native import seek_scan_native
+
+        geom, dtg, box, t_lo, t_hi = self.pred
+        for block, starts, ends, flags in self.per_block:
+            t = None
+            lo = hi = 0
+            if t_lo is not None or t_hi is not None:
+                t = block.columns[dtg]
+                lo = np.iinfo(np.int64).min + 1 if t_lo is None else t_lo
+                hi = np.iinfo(np.int64).max if t_hi is None else t_hi
+            rows = seek_scan_native(
+                block.columns[geom + "__x"],
+                block.columns[geom + "__y"],
+                t,
+                starts,
+                ends,
+                flags,
+                box,
+                lo,
+                hi,
+            )
+            if rows is None:
+                # lib raced away: numpy equivalent of the same exact test
+                # (exact=True promises FILTERED rows — never raw candidates)
+                cand, _cov = self.table.expand_covered(block, starts, ends, flags)
+                if not len(cand):
+                    continue
+                xs = block.columns[geom + "__x"][cand]
+                ys = block.columns[geom + "__y"][cand]
+                m = (xs >= box[0]) & (xs <= box[2]) & (ys >= box[1]) & (ys <= box[3])
+                if t is not None:
+                    tv = t[cand]
+                    m &= (tv >= lo) & (tv <= hi)
+                rows = cand[m]  # expand_covered already stripped tombstones
+            else:
+                keep = self.table.tombstone_keep(block, rows)
+                if keep is not None:
+                    rows = rows[keep]
+            if len(rows):
+                yield block, rows
 
 
 class DeviceIndex:
@@ -854,7 +906,35 @@ class TpuScanExecutor:
             frac = float(os.environ.get("GEOMESA_SEEK_FRAC", "0.4"))
             if total > frac * nrows:
                 return None
-        return _HostSeekScan(table, per_block)
+        return _HostSeekScan(table, per_block, self._native_seek_pred(table, plan))
+
+    def _native_seek_pred(self, table: IndexTable, plan):
+        """(geom, dtg, box, t_lo, t_hi) for the one-pass native seek-scan
+        when the query reduces to one exact bbox(+interval) predicate and
+        the C++ lib is available; None -> covered-split numpy path."""
+        shape = self._exact_predicate_shape(table, plan)
+        if shape is None:
+            return None
+        from geomesa_tpu.native import load_seek
+
+        if load_seek() is None:
+            return None
+        xmin, ymin, xmax, ymax, t_lo, t_hi = shape
+        ft = table.ft
+        dtg = ft.default_date.name if ft.default_date is not None else None
+        if t_lo is not None or t_hi is not None:
+            # stored null dates are 0 + a __null mask; the exact test would
+            # wrongly admit them if the window covers the epoch — fall back
+            # (has_nulls memoizes per immutable block: no per-query scans)
+            if any(b.has_nulls(dtg) for b in table.blocks):
+                return None
+        return (
+            ft.default_geometry.name,
+            dtg,
+            (xmin, ymin, xmax, ymax),
+            t_lo,
+            t_hi,
+        )
 
     def dispatch_candidates(self, table: IndexTable, plan: QueryPlan):
         """Start the device pre-filter WITHOUT blocking; None -> caller
@@ -898,24 +978,14 @@ class TpuScanExecutor:
         Returns the iterable _PendingScan (carrying .exact) directly."""
         return self.dispatch_candidates(table, plan)
 
-    def _exact_descriptor(self, table: IndexTable, plan: QueryPlan):
-        """(box key limbs u32[8], window key limbs u32[4] | None) when the
-        FULL filter is exactly one AND-combination of inclusive-envelope
-        spatial tests on the default point geometry plus interval tests on
-        the default date — i.e. the device can evaluate the query's own
-        semantics. None otherwise (conservative mask + host post-filter).
-        """
-        import os
-
-        env = os.environ.get("GEOMESA_EXACT_DEVICE", "auto")
-        if env == "0":
-            return None
-        if env != "1" and jax.default_backend() == "cpu":
-            # auto: on the CPU backend "device" compute IS host compute —
-            # the wider limb columns cost more than the post-filter saves.
-            # On real accelerators the exact mask is memory-bound free and
-            # eliminates the host post-filter entirely.
-            return None
+    @staticmethod
+    def _exact_predicate_shape(table: IndexTable, plan: QueryPlan):
+        """(xmin, ymin, xmax, ymax, t_lo, t_hi) raw f64 / inclusive-ms
+        bounds when the FULL filter is exactly one AND-combination of
+        inclusive-envelope spatial tests on the default point geometry plus
+        interval tests on the default date — i.e. the query's own semantics
+        reduce to one box(+window) test. None otherwise. t_lo/t_hi are None
+        when the filter has no temporal part."""
         if table.index.name not in ("z2", "z3") or plan.secondary is not None:
             return None
         ft = table.ft
@@ -968,14 +1038,36 @@ class TpuScanExecutor:
         if not walk(f) or not boxes:
             return None
         if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
-            return None  # temporal test needs the time column (z3 segments)
-        from geomesa_tpu.ops.zkernels import f64_sort_keys, i64_sort_keys, split_u64_to_limbs
-
+            return None  # temporal test needs the time column (z3 tables)
         env = boxes[0]
         xmin, ymin, xmax, ymax = env.xmin, env.ymin, env.xmax, env.ymax
         for e in boxes[1:]:  # AND of boxes = envelope intersection
             xmin, ymin = max(xmin, e.xmin), max(ymin, e.ymin)
             xmax, ymax = min(xmax, e.xmax), min(ymax, e.ymax)
+        return xmin, ymin, xmax, ymax, t_lo, t_hi
+
+    def _exact_descriptor(self, table: IndexTable, plan: QueryPlan):
+        """(box key limbs u32[8], window key limbs u32[4] | None) when the
+        device can evaluate the query's own semantics (see
+        _exact_predicate_shape). None otherwise (conservative mask + host
+        post-filter)."""
+        import os
+
+        env = os.environ.get("GEOMESA_EXACT_DEVICE", "auto")
+        if env == "0":
+            return None
+        if env != "1" and jax.default_backend() == "cpu":
+            # auto: on the CPU backend "device" compute IS host compute —
+            # the wider limb columns cost more than the post-filter saves.
+            # On real accelerators the exact mask is memory-bound free and
+            # eliminates the host post-filter entirely.
+            return None
+        shape = self._exact_predicate_shape(table, plan)
+        if shape is None:
+            return None
+        xmin, ymin, xmax, ymax, t_lo, t_hi = shape
+        from geomesa_tpu.ops.zkernels import f64_sort_keys, i64_sort_keys, split_u64_to_limbs
+
         bk = f64_sort_keys(np.asarray([xmin, xmax, ymin, ymax]))
         hi, lo = split_u64_to_limbs(bk)
         box_np = np.asarray(

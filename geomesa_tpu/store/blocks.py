@@ -68,6 +68,27 @@ def take_rows(columns: Columns, rows: np.ndarray) -> Columns:
     return {k: v[rows] for k, v in columns.items()}
 
 
+def intern_fids(columns: Columns) -> Columns:
+    """Convert an object-dtype ``__fid__`` column to fixed-width unicode
+    when every entry is a str: fancy-indexing a U-array is a memcpy, ~6x
+    faster than object-pointer gather + refcounting (the fid gather is the
+    hottest host op on the query path). Idempotent — call once per write
+    batch so per-index table builds don't re-scan the column.
+
+    The all-str scan is a short-circuiting Python pass; astype would
+    silently coerce non-strings, so it cannot replace the check."""
+    fid = columns.get("__fid__")
+    if (
+        fid is not None
+        and fid.dtype == object
+        and len(fid)
+        and all(type(v) is str for v in fid)
+    ):
+        columns = dict(columns)
+        columns["__fid__"] = fid.astype(np.str_)
+    return columns
+
+
 def expand_intervals(
     starts: np.ndarray, ends: np.ndarray, flags: Optional[np.ndarray] = None
 ) -> np.ndarray:
@@ -182,23 +203,22 @@ class FeatureBlock:
                 self.bin_slices[int(b)] = (int(s), int(e))
         self.key_min = key[0] if self.n else None
         self.key_max = key[-1] if self.n else None
+        self._nulls_memo: Dict[str, bool] = {}
+
+    def has_nulls(self, name: str) -> bool:
+        """Whether the attribute's __null mask has any set bit; memoized —
+        blocks are immutable once sealed, so hot query paths (the native
+        seek-scan eligibility check) pay the O(n) scan once per block."""
+        got = self._nulls_memo.get(name)
+        if got is None:
+            col = self.columns.get(name + "__null")
+            got = bool(col.any()) if col is not None else False
+            self._nulls_memo[name] = got
+        return got
 
     @classmethod
     def build(cls, index: IndexKeySpace, ft: FeatureType, columns: Columns) -> "FeatureBlock":
-        fid = columns.get("__fid__")
-        # the all-str scan is a short-circuiting Python pass (~3% of ingest);
-        # astype would silently coerce non-strings, so it cannot replace it
-        if (
-            fid is not None
-            and fid.dtype == object
-            and len(fid)
-            and all(type(v) is str for v in fid)
-        ):
-            # fixed-width unicode storage: fancy-indexing a U-array is a
-            # memcpy, ~6x faster than object-pointer gather + refcounting
-            # (the fid gather is the hottest host op on the query path)
-            columns = dict(columns)
-            columns["__fid__"] = fid.astype(np.str_)
+        columns = intern_fids(columns)
         key_cols = index.key_columns(ft, columns)
         key = key_cols["__key__"]
         bins = key_cols.get("__bin__")
@@ -399,12 +419,10 @@ class IndexTable:
         the shared expansion step for scan_covered and the executor's
         host-seek scan (which reuses its cost-probe intervals)."""
         rows, covered = expand_intervals(starts, ends, flags)
-        if self.tombstones and len(rows):
-            fids = block.columns["__fid__"][rows]
-            keep = ~np.isin(fids, list(self.tombstones))
-            if not keep.all():
-                rows = rows[keep]
-                covered = covered[keep]
+        keep = self.tombstone_keep(block, rows)
+        if keep is not None:
+            rows = rows[keep]
+            covered = covered[keep]
         return rows, covered
 
     def scan_all(self) -> Iterator[Tuple[FeatureBlock, np.ndarray]]:
@@ -413,12 +431,19 @@ class IndexTable:
             if len(rows):
                 yield b, rows
 
-    def _strip_tombstones(self, b: FeatureBlock, rows: np.ndarray) -> np.ndarray:
+    def tombstone_keep(self, b: FeatureBlock, rows: np.ndarray):
+        """Bool keep-mask over ``rows`` vs this table's tombstones, or None
+        when nothing is stripped — the ONE tombstone filter every scan path
+        (plain, covered, native seek) goes through."""
         if not self.tombstones or not len(rows):
-            return rows
+            return None
         fids = b.columns["__fid__"][rows]
-        keep = np.array([f not in self.tombstones for f in fids], dtype=bool)
-        return rows[keep]
+        keep = ~np.isin(fids, list(self.tombstones))
+        return None if keep.all() else keep
+
+    def _strip_tombstones(self, b: FeatureBlock, rows: np.ndarray) -> np.ndarray:
+        keep = self.tombstone_keep(b, rows)
+        return rows if keep is None else rows[keep]
 
     def compact(self):
         """Merge all blocks into one (dropping tombstoned rows)."""

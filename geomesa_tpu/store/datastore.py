@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import itertools
 import uuid
+from collections.abc import Mapping
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
@@ -42,6 +43,59 @@ from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
 DEFAULT_FLUSH_SIZE = 100_000
 
 
+class LazyColumns(Mapping):
+    """Deferred column materialization over scanned (block, rows) pairs.
+
+    The KryoBufferSimpleFeature analog (geomesa-feature-kryo
+    .../KryoBufferSimpleFeature.scala:1-288 — zero-copy lazy attribute
+    reads): a query result holds row indices into the immutable sealed
+    blocks and gathers a column only when something actually reads it.
+    A fid-only parity stream or a count never pays for attribute gathers;
+    the CPU-reference comparison (index arrays) stays apples-to-apples.
+
+    Read-only Mapping; ``materialize()`` returns a plain dict for code
+    paths that mutate or re-order columns (sort/limit/sampling/dedupe)."""
+
+    __slots__ = ("_parts", "_keys", "_cache", "num_rows")
+
+    def __init__(self, parts, keys):
+        self._parts = parts  # [(FeatureBlock, row-index array)]
+        self._keys = frozenset(keys)
+        self._cache: Dict[str, np.ndarray] = {}
+        self.num_rows = int(sum(len(r) for _, r in parts))
+
+    def __getitem__(self, k: str) -> np.ndarray:
+        if k not in self._keys:
+            raise KeyError(k)
+        got = self._cache.get(k)
+        if got is None:
+            pieces = []
+            for block, rows in self._parts:
+                col = block.columns.get(k)
+                if col is not None:
+                    pieces.append(col[rows])
+                elif k.endswith("__null"):
+                    # missing null-mask column means "no nulls in this block"
+                    pieces.append(np.zeros(len(rows), dtype=bool))
+                else:
+                    raise KeyError(f"Column {k} missing from a block")
+            got = np.concatenate(pieces) if pieces else np.empty(0, dtype=object)
+            self._cache[k] = got
+        return got
+
+    def __iter__(self):
+        return iter(self._keys)
+
+    def __len__(self):
+        return len(self._keys)
+
+    def __contains__(self, k):
+        return k in self._keys
+
+    def materialize(self) -> Columns:
+        return {k: self[k] for k in self._keys}
+
+
 class QueryResult:
     """Columnar query result with row-feature accessors."""
 
@@ -59,6 +113,9 @@ class QueryResult:
         self.aggregate = aggregate or {}
 
     def __len__(self):
+        n = getattr(self.columns, "num_rows", None)
+        if n is not None:
+            return n
         for v in self.columns.values():
             return len(v)
         return 0
@@ -245,6 +302,9 @@ class TpuDataStore:
         return FeatureWriter(self, self.get_schema(name), flush_size or self.flush_size)
 
     def _insert_columns(self, ft: FeatureType, columns: Columns, observe_stats: bool = True):
+        from geomesa_tpu.store.blocks import intern_fids
+
+        columns = intern_fids(columns)  # once per batch, not per index table
         for table in self._tables[ft.name].values():
             table.insert(columns)
         if observe_stats and self.stats is not None:
@@ -397,21 +457,15 @@ class TpuDataStore:
         if plan.union is not None:
             # cross-index OR: scan each arm on its own index, union by fid
             # (FilterSplitter.scala:64-110; dedup replaces makeDisjoint :303)
-            parts: List[Columns] = []
+            parts: List[tuple] = []
             for arm in plan.union:
                 if arm.is_empty:
                     continue
-                # arms gather the FULL column set: per-arm pruning would
-                # give concat_columns inconsistent parts (each arm's
-                # post-filter needs different columns); the projection is
-                # applied after the union instead
                 parts.extend(
-                    self._scan_parts(
-                        name, ft, query, arm, t_scan_start, pending, allow_prune=False
-                    )
+                    self._scan_parts(name, ft, query, arm, t_scan_start, pending)
                 )
-            columns = concat_columns(parts) if parts else _empty_columns(ft)
-            columns = _dedupe_by_fid(columns)
+            columns = self._columns_from_parts(ft, query, parts)
+            columns = _dedupe_by_fid(_materialize(columns))
             return self._finish(ft, query, plan, columns)
 
         tables = self._tables[name]
@@ -428,40 +482,78 @@ class TpuDataStore:
                 return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})
 
         parts = self._scan_parts(name, ft, query, plan, t_scan_start, pending)
-        columns = concat_columns(parts) if parts else _empty_columns(ft)
+        columns = self._columns_from_parts(ft, query, parts)
         if plan.index.name in ("xz2", "xz3"):
             # only extent indices can emit multiple rows per feature
             # (QueryPlanner.scala:83-85 dedupes exactly this case; point
             # indices are one-row-per-feature in the reference too)
-            columns = _dedupe_by_fid(columns)
+            columns = _dedupe_by_fid(_materialize(columns))
         return self._finish(ft, query, plan, columns)
 
+    def _columns_from_parts(self, ft, query: Query, parts: List[tuple]):
+        """Light (block, rows) parts -> LazyColumns exposing the query's
+        observable key set (projection pushdown of the transform-schema
+        pruning, QueryPlanner.scala:192-284, now fully deferred)."""
+        if not parts:
+            return _empty_columns(ft)
+        out_needed = self._output_columns(ft, query)
+        keys = {"__fid__"}
+        for block, _rows in parts:
+            keys.update(
+                k
+                for k in block.columns
+                if k != "__vis__"
+                and (
+                    k == "__fid__"
+                    or out_needed is None
+                    or _column_base(k) in out_needed
+                )
+            )
+        return LazyColumns(parts, keys)
+
     def _finish(self, ft, query: Query, plan: QueryPlan, columns: Columns) -> QueryResult:
+        from geomesa_tpu.index.transforms import QueryTransforms
+
         if has_aggregation(query.hints):
             # sampling composes with aggregations (SamplingIterator stacks
             # under density/bin/arrow scans in the reference); transforms
             # apply BEFORE aggregation so arrow/bin streams carry the
             # derived schema (ArrowScan transform handling)
-            from geomesa_tpu.index.transforms import QueryTransforms
-
-            columns = _apply_sampling(query, columns)
+            columns = _apply_sampling(query, _materialize(columns))
             tf = QueryTransforms.parse(ft, query.properties)
             if tf is not None:
                 ft, columns = tf.apply(columns)
             agg = run_aggregation(ft, query.hints, columns)
             return QueryResult(ft, _empty_columns(ft), plan, agg)
-        ft, columns = apply_projection(ft, query, columns)
+        if (
+            isinstance(columns, LazyColumns)
+            and not query.sort_by
+            and query.max_features is None
+            and not query.hints.get("sampling")
+            and QueryTransforms.parse(ft, query.properties) is None
+        ):
+            # plain stream: nothing re-orders or derives columns, so the
+            # lazy mapping (already key-restricted) passes straight through
+            if query.properties is not None:
+                ft = _narrow_ft(ft, query.properties)
+            return QueryResult(ft, columns, plan)
+        ft, columns = apply_projection(ft, query, _materialize(columns))
         return QueryResult(ft, columns, plan)
 
     def _scan_parts(
         self, name, ft, query: Query, plan: QueryPlan, t_scan_start, pending=None,
-        allow_prune: bool = True,
-    ) -> List[Columns]:
+    ) -> List[tuple]:
+        """Scan one plan into light (block, final_rows) parts.
+
+        No output column ever leaves the blocks here: filtering gathers
+        only the columns the post-filter/age-off read, and the result's
+        attribute gathers are deferred to LazyColumns (the
+        KryoBufferSimpleFeature lazy-read analog)."""
         import time as _time
 
         tables = self._tables[name]
         table = tables[plan.index.name]
-        parts: List[Columns] = []
+        parts: List[tuple] = []
         if pending is not None and id(plan) in pending:
             scan = pending[id(plan)]  # pre-dispatched (query_many pipeline)
         else:
@@ -492,21 +584,14 @@ class TpuDataStore:
             and all(g.is_rectangle() for g in gv.values)
         )
         if getattr(scan, "exact", False):
-            # the device evaluated the query's own f64/ms predicate
-            # (executor._exact_descriptor): candidates ARE the result set
+            # the device/native path evaluated the query's own f64/ms
+            # predicate: candidates ARE the result set
             loose = True
-        # projection pushdown into the gather (the transform-schema
-        # pruning of QueryPlanner.scala:192-284 applied at scan time):
-        # only columns the query can observe leave the blocks
-        needed = (
-            self._needed_columns(ft, query, plan, loose, age_cutoff)
-            if allow_prune
+        pf_props = (
+            set(ast.properties(plan.post_filter))
+            if plan.post_filter is not None and not loose
             else None
         )
-        # columns only the post-filter/age-off reads are dropped before the
-        # survivor gather: with a narrow projection (e.g. fid-only streams)
-        # the filter inputs never leave the block
-        out_needed = self._output_columns(ft, query) if allow_prune else None
         for item in scan:
             if len(item) == 3:
                 block, rows, covered = item
@@ -523,64 +608,69 @@ class TpuDataStore:
                 raise QueryTimeout(
                     f"query exceeded {self.query_timeout_s}s (geomesa.query.timeout analog)"
                 )
-            if covered is not None and plan.post_filter is not None and not loose:
-                part = self._scan_block_covered(
-                    ft, plan, block, rows, covered, age_cutoff, needed, out_needed
+            if covered is not None and pf_props is not None:
+                rows = self._filter_block_covered(
+                    ft, plan, block, rows, covered, age_cutoff, pf_props
                 )
-                if part is not None:
-                    parts.append(part)
+                if len(rows):
+                    parts.append((block, rows))
                 continue
-            # gather value columns first; the (object-dtype) fid column is
-            # gathered once, only for rows surviving the exact post-filter
-            mask_cols = {
-                k: v[rows]
-                for k, v in block.columns.items()
-                if k not in ("__fid__", "__vis__")
-                and (needed is None or _column_base(k) in needed)
-            }
-            if age_cutoff is not None:
-                dtg = ft.default_date.name
-                alive = mask_cols[dtg] >= age_cutoff
-                nulls = mask_cols.get(dtg + "__null")
-                if nulls is not None:
-                    alive |= nulls  # null dates never age off
-                if not alive.all():
-                    rows = rows[alive]
-                    mask_cols = {k: v[alive] for k, v in mask_cols.items()}
-            if plan.post_filter is not None and not loose:
-                mask = self.executor.post_filter(ft, plan, mask_cols)
-                if out_needed is not None:
-                    mask_cols = {
-                        k: v
-                        for k, v in mask_cols.items()
-                        if _column_base(k) in out_needed
-                    }
+            alive = self._age_off_keep(ft, block, rows, age_cutoff)
+            if alive is not None:
+                rows = rows[alive]
+            if pf_props is not None and len(rows):
+                fcols = self._gather_filter_cols(block, rows, pf_props)
+                mask = self.executor.post_filter(ft, plan, fcols)
                 if not mask.all():
                     rows = rows[mask]
-                    mask_cols = {k: v[mask] for k, v in mask_cols.items()}
-            elif out_needed is not None:
-                mask_cols = {
-                    k: v for k, v in mask_cols.items() if _column_base(k) in out_needed
-                }
-            vis = block.columns.get("__vis__")
-            if vis is not None:
-                # per-feature visibility vs this store's authorizations
-                # (VisibilityEvaluator.scala:21 / SecurityUtils analog)
-                from geomesa_tpu.security import visibility_mask
-
-                vmask = visibility_mask(vis[rows], self.authorizations)
-                if not vmask.all():
-                    rows = rows[vmask]
-                    mask_cols = {k: v[vmask] for k, v in mask_cols.items()}
-            mask_cols["__fid__"] = block.columns["__fid__"][rows]
+            vmask = self._visibility_keep(block, rows)
+            if vmask is not None:
+                rows = rows[vmask]
             if len(rows):
-                parts.append(mask_cols)
+                parts.append((block, rows))
         return parts
 
-    def _scan_block_covered(
-        self, ft, plan: QueryPlan, block, rows, covered, age_cutoff, needed, out_needed
-    ):
-        """Covered-split scan of one block.
+    def _age_off_keep(self, ft, block, rows, age_cutoff):
+        """Bool keep-mask for the dtg age-off window, or None if all live
+        (DtgAgeOffIterator analog; null dates never age off)."""
+        if age_cutoff is None or not len(rows):
+            return None
+        dtg = ft.default_date.name
+        alive = block.columns[dtg][rows] >= age_cutoff
+        nulls = block.columns.get(dtg + "__null")
+        if nulls is not None:
+            alive |= nulls[rows]
+        return None if alive.all() else alive
+
+    @staticmethod
+    def _gather_filter_cols(block, rows, props) -> Columns:
+        """Gather exactly the columns a filter reads; property-free filters
+        (e.g. EXCLUDE) get a length-carrier column so evaluate() can infer
+        the row count."""
+        fcols = {
+            k: v[rows]
+            for k, v in block.columns.items()
+            if k not in ("__fid__", "__vis__") and _column_base(k) in props
+        }
+        if not fcols:
+            fcols["__rows__"] = rows
+        return fcols
+
+    def _visibility_keep(self, block, rows):
+        """Bool keep-mask vs this store's authorizations, or None when all
+        visible (VisibilityEvaluator.scala:21 / SecurityUtils analog)."""
+        vis = block.columns.get("__vis__")
+        if vis is None or not len(rows):
+            return None
+        from geomesa_tpu.security import visibility_mask
+
+        vmask = visibility_mask(vis[rows], self.authorizations)
+        return None if vmask.all() else vmask
+
+    def _filter_block_covered(
+        self, ft, plan: QueryPlan, block, rows, covered, age_cutoff, pf_props
+    ) -> np.ndarray:
+        """Covered-split filtering of one block -> surviving rows.
 
         Rows marked ``covered`` came from ``contained`` ranges and provably
         satisfy the plan's exact primary predicate (strict-interior z skip
@@ -592,59 +682,29 @@ class TpuDataStore:
         from geomesa_tpu.filter import ast as _ast
         from geomesa_tpu.filter.evaluate import evaluate
 
-        if age_cutoff is not None:
-            dtg = ft.default_date.name
-            alive = block.columns[dtg][rows] >= age_cutoff
-            nulls_col = block.columns.get(dtg + "__null")
-            if nulls_col is not None:
-                alive |= nulls_col[rows]  # null dates never age off
-            if not alive.all():
-                rows = rows[alive]
-                covered = covered[alive]
+        alive = self._age_off_keep(ft, block, rows, age_cutoff)
+        if alive is not None:
+            rows = rows[alive]
+            covered = covered[alive]
         keep = covered.copy()
         uncov_idx = np.flatnonzero(~covered)
         if len(uncov_idx):
             rows_u = rows[uncov_idx]
-            fcols = {
-                k: v[rows_u]
-                for k, v in block.columns.items()
-                if k not in ("__fid__", "__vis__")
-                and (needed is None or _column_base(k) in needed)
-            }
+            fcols = self._gather_filter_cols(block, rows_u, pf_props)
             keep[uncov_idx] = self.executor.post_filter(ft, plan, fcols)
         if plan.secondary is not None:
             cov_idx = np.flatnonzero(covered)
             if len(cov_idx):
                 rows_c = rows[cov_idx]
                 sec_props = set(_ast.properties(plan.secondary))
-                scols = {
-                    k: v[rows_c]
-                    for k, v in block.columns.items()
-                    if k not in ("__fid__", "__vis__")
-                    and _column_base(k) in sec_props
-                }
+                scols = self._gather_filter_cols(block, rows_c, sec_props)
                 keep[cov_idx] = evaluate(plan.secondary, ft, scols)
         if not keep.all():
             rows = rows[keep]
-        if not len(rows):
-            return None
-        vis = block.columns.get("__vis__")
-        if vis is not None:
-            from geomesa_tpu.security import visibility_mask
-
-            vmask = visibility_mask(vis[rows], self.authorizations)
-            if not vmask.all():
-                rows = rows[vmask]
-                if not len(rows):
-                    return None
-        out = {
-            k: v[rows]
-            for k, v in block.columns.items()
-            if k not in ("__fid__", "__vis__")
-            and (out_needed is None or _column_base(k) in out_needed)
-        }
-        out["__fid__"] = block.columns["__fid__"][rows]
-        return out
+        vmask = self._visibility_keep(block, rows)
+        if vmask is not None:
+            rows = rows[vmask]
+        return rows
 
     def _needed_columns(
         self, ft: FeatureType, query: Query, plan: QueryPlan, loose: bool, age_cutoff
@@ -798,6 +858,30 @@ def _empty_columns(ft: FeatureType) -> Columns:
     return cols
 
 
+def _materialize(columns) -> Columns:
+    """LazyColumns -> plain dict (for code that mutates/re-orders); plain
+    dicts pass through."""
+    if isinstance(columns, LazyColumns):
+        return columns.materialize()
+    return columns
+
+
+def _narrow_ft(ft: FeatureType, props: Sequence[str]) -> FeatureType:
+    """The result TYPE narrows with a projection, like the reference's
+    transform schema — consumers (exports, arrow) iterate result.ft and
+    must only see present attributes."""
+    keep = set(props)
+    user_data = dict(ft.user_data)
+    if user_data.get("geomesa.index.dtg") not in keep:
+        # role bindings must not point at projected-away attributes
+        user_data.pop("geomesa.index.dtg", None)
+    return FeatureType(
+        ft.name,
+        [a for a in ft.attributes if a.name in keep],
+        user_data,
+    )
+
+
 def _dedupe_by_fid(columns: Columns) -> Columns:
     fids = columns.get("__fid__")
     if fids is None or len(fids) == 0:
@@ -813,8 +897,10 @@ def _apply_sampling(query: Query, columns: Columns) -> Columns:
     threads the 1-in-n selection per attribute value (SamplingIterator /
     FeatureSampler analog)."""
     frac = query.hints.get("sampling")
+    if not frac or frac >= 1.0:
+        return columns
     n = len(next(iter(columns.values()), []))
-    if not frac or frac >= 1.0 or n == 0:
+    if n == 0:
         return columns
     nth = max(1, int(round(1.0 / float(frac))))
     by = query.hints.get("sample_by")
@@ -842,19 +928,8 @@ def apply_projection(ft: FeatureType, query: Query, columns: Columns):
     if tf is None:
         columns = _apply_query_options(ft, query, columns)
         if query.properties is not None:
-            # the result TYPE narrows with the projection, like the
-            # reference's transform schema — consumers (exports, arrow)
-            # iterate result.ft and must only see present attributes
             keep = set(query.properties)
-            user_data = dict(ft.user_data)
-            if user_data.get("geomesa.index.dtg") not in keep:
-                # role bindings must not point at projected-away attributes
-                user_data.pop("geomesa.index.dtg", None)
-            ft = FeatureType(
-                ft.name,
-                [a for a in ft.attributes if a.name in keep],
-                user_data,
-            )
+            ft = _narrow_ft(ft, query.properties)
             columns = {
                 k: v
                 for k, v in columns.items()

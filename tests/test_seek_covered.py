@@ -47,7 +47,9 @@ def test_seek_chooser_picks_host_seek_for_selective_plan():
     table = s._tables["t"][plan.index.name]
     scan = s.executor.scan_candidates(table, plan)
     assert isinstance(scan, _HostSeekScan)
-    assert scan.seek and not scan.exact
+    assert scan.seek
+    # pure bbox+interval plan + native lib -> one-pass exact seek-scan
+    assert scan.exact == (scan.pred is not None)
 
 
 def test_seek_env_kill_switch(monkeypatch):
@@ -69,7 +71,10 @@ def test_seek_parity_with_device_path():
 
 def test_covered_ranges_exist_and_skip_post_filter(monkeypatch):
     """A large interior query must produce contained ranges, and covered
-    rows must never reach the post-filter (only uncovered boundary rows)."""
+    rows must never reach the post-filter (only uncovered boundary rows).
+    Pins GEOMESA_TPU_NO_NATIVE: with the C++ seek-scan active the whole
+    block bypasses the post-filter (exact path, tested separately)."""
+    monkeypatch.setenv("GEOMESA_TPU_NO_NATIVE", "1")
     s = _mk(TpuScanExecutor(default_mesh()), n=6000)
     plan = s._plan_cached("t", s._as_query(CQL))
     assert any(r.contained for r in plan.ranges), "interior ranges expected"
@@ -122,6 +127,55 @@ def test_secondary_applied_to_covered_rows():
     got = sorted(a.query("t", cql).fids)
     want = sorted(b.query("t", cql).fids)
     assert got == want and len(got) > 0
+
+
+def test_native_seek_scan_parity_with_python_fallback(monkeypatch):
+    """The C++ one-pass seek-scan and the covered-split numpy path must
+    produce identical result sets (incl. DURING exclusivity and bbox edge
+    inclusivity, which the fuzz corpus also covers)."""
+    s = _mk(TpuScanExecutor(default_mesh()), n=7000, seed=23)
+    native = sorted(s.query("t", CQL).fids)
+    monkeypatch.setenv("GEOMESA_TPU_NO_NATIVE", "1")
+    fallback = sorted(s.query("t", CQL).fids)
+    assert native == fallback and len(native) > 0
+
+
+def test_native_seek_scan_exact_skips_post_filter(monkeypatch):
+    s = _mk(TpuScanExecutor(default_mesh()), n=5000)
+    plan = s._plan_cached("t", s._as_query(CQL))
+    table = s._tables["t"][plan.index.name]
+    scan = s.executor.scan_candidates(table, plan)
+    if scan.pred is None:
+        pytest.skip("native lib unavailable")
+
+    def boom(*a, **k):
+        raise AssertionError("post_filter must not run on the native exact path")
+
+    monkeypatch.setattr(type(s.executor), "post_filter", boom)
+    assert len(s.query("t", CQL).fids) > 0
+
+
+def test_native_seek_scan_respects_tombstones():
+    s = _mk(TpuScanExecutor(default_mesh()), n=5000)
+    got = sorted(s.query("t", CQL).fids)
+    assert len(got) > 20
+    s.delete_features("t", got[:20])
+    got2 = sorted(s.query("t", CQL).fids)
+    assert got2 == sorted(set(got) - set(got[:20]))
+
+
+def test_native_seek_not_used_with_secondary_or_polygon():
+    s = _mk(TpuScanExecutor(default_mesh()), n=3000)
+    for cql in (
+        CQL + " AND name = 'n1'",  # secondary residual
+        "intersects(geom, POLYGON((-20 -20, 20 -20, 0 20, -20 -20))) AND "
+        "dtg DURING 2026-01-02T00:00:00Z/2026-01-30T00:00:00Z",  # non-rect
+    ):
+        plan = s._plan_cached("t", s._as_query(cql))
+        table = s._tables["t"][plan.index.name]
+        scan = s.executor.scan_candidates(table, plan)
+        if scan is not None and hasattr(scan, "pred"):
+            assert scan.pred is None, cql
 
 
 def test_merge_ranges_preserves_contained_flags():
