@@ -225,11 +225,6 @@ def init_backend(claim_timeout: int, retries: int) -> str:
 
 
 def run(n: int, reps: int, backend: str) -> dict:
-    # tuned for the seek-scan execution path: with the one-pass native
-    # filter, extra candidate rows are ~ns each while every extra range
-    # costs planning + searchsorted; 512 is the measured sweet spot
-    # (framework default stays at the reference's 2000 for parity)
-    os.environ.setdefault("GEOMESA_SCAN_RANGES_TARGET", "512")
     x, y, t = synthesize(n)
     boxes, cqls = make_queries(reps)
 

@@ -187,8 +187,27 @@ def bench_knn(n, reps):
 
 
 def main():
-    n = int(os.environ.get("GEOMESA_BENCH_N", 2_000_000))
-    reps = int(os.environ.get("GEOMESA_BENCH_REPS", 10))
+    # bench.py's hardened backend claim: subprocess probe with hard timeout,
+    # cpu pin on failure — a dead device tunnel must never hang the suite
+    import bench
+
+    smoke = os.environ.get("GEOMESA_BENCH_SMOKE", "") not in ("", "0")
+    n = int(os.environ.get("GEOMESA_BENCH_N", 0)) or (200_000 if smoke else 2_000_000)
+    reps = int(os.environ.get("GEOMESA_BENCH_REPS", 3 if smoke else 10))
+    claim_timeout = int(os.environ.get("GEOMESA_BENCH_CLAIM_TIMEOUT", 120))
+    retries = int(os.environ.get("GEOMESA_BENCH_CLAIM_RETRIES", 1))
+    backend = bench.init_backend(claim_timeout, retries)
+    deadline = float(os.environ.get("GEOMESA_BENCH_DEADLINE", 2400))
+    import threading
+
+    def fire():
+        log(f"suite watchdog fired after {deadline}s")
+        emit({"metric": "bench_suite", "error": f"watchdog_deadline_{int(deadline)}s"})
+        os._exit(3)
+
+    watchdog = threading.Timer(deadline, fire)
+    watchdog.daemon = True
+    watchdog.start()
     for name, fn in [
         ("z2", bench_z2),
         ("xz2", bench_xz2),
@@ -197,9 +216,12 @@ def main():
     ]:
         log(f"running {name} (n={n})")
         try:
-            emit(fn(n, reps))
+            payload = fn(n, reps)
+            payload["backend"] = backend
+            emit(payload)
         except Exception as e:  # keep the suite going per config
             emit({"metric": name, "error": f"{type(e).__name__}: {e}"})
+    watchdog.cancel()
 
 
 if __name__ == "__main__":

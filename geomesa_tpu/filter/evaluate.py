@@ -156,8 +156,61 @@ def _eval_spatial(f: ast.SpatialFilter, ft: FeatureType, columns: Columns) -> np
         else:
             raise ValueError(type(f))
         return mask & valid
-    # non-point geometry columns: object arrays, evaluated per row
+    # non-point geometry columns: vectorized envelope prescreen over the
+    # stored per-row envelope companions (geom__bxmin...), then the exact
+    # per-row predicate only on the undecided straddling ring. The
+    # envelope math decides most rows: envelope-disjoint => predicate
+    # false for intersects/bbox; feature envelope inside a RECTANGLE
+    # query => intersects true.
     col = columns[f.prop]
+    bxmin = columns.get(f.prop + "__bxmin")
+    if bxmin is not None and isinstance(f, (ast.BBox, ast.Intersects, ast.Disjoint)):
+        if isinstance(f, ast.BBox):
+            qenv = f.envelope
+            rect = True
+        else:
+            qenv = f.geometry.envelope
+            rect = hasattr(f.geometry, "is_rectangle") and f.geometry.is_rectangle()
+        bymin = columns[f.prop + "__bymin"]
+        bxmax = columns[f.prop + "__bxmax"]
+        bymax = columns[f.prop + "__bymax"]
+        overlap = (
+            (bxmax >= qenv.xmin)
+            & (bxmin <= qenv.xmax)
+            & (bymax >= qenv.ymin)
+            & (bymin <= qenv.ymax)
+        )
+        inter = np.zeros(n, dtype=bool)
+        if rect:
+            # feature envelope inside the rectangle => geometry inside it.
+            # (0,0,0,0) is also the NULL-geometry placeholder envelope, so
+            # those rows are demoted to the exact ring (which skips None) —
+            # a real degenerate at-origin geometry stays correct that way.
+            placeholder = (bxmin == 0) & (bymin == 0) & (bxmax == 0) & (bymax == 0)
+            inside = (
+                overlap
+                & ~placeholder
+                & (bxmin >= qenv.xmin)
+                & (bxmax <= qenv.xmax)
+                & (bymin >= qenv.ymin)
+                & (bymax <= qenv.ymax)
+            )
+            inter[inside] = True
+            undecided = np.flatnonzero(overlap & ~inside)
+        else:
+            undecided = np.flatnonzero(overlap)
+        for i in undecided:
+            g = col[i]
+            if g is not None:
+                inter[i] = _geom_predicate(
+                    f if not isinstance(f, ast.Disjoint) else ast.Intersects(f.prop, f.geometry),
+                    g,
+                )
+        if isinstance(f, ast.Disjoint):
+            # disjoint = NOT intersects, but null geometries stay false
+            notnull = np.array([g is not None for g in col], dtype=bool)
+            return ~inter & notnull
+        return inter
     out = np.zeros(n, dtype=bool)
     for i, g in enumerate(col):
         if g is None:

@@ -109,6 +109,15 @@ class Geometry:
 
     @property
     def envelope(self) -> Envelope:
+        # memoized: geometries are immutable and the envelope is read on
+        # every predicate evaluation (hot in XZ post-filter rings)
+        env = getattr(self, "_env_cache", None)
+        if env is None:
+            env = self._compute_envelope()
+            self._env_cache = env
+        return env
+
+    def _compute_envelope(self) -> Envelope:
         raise NotImplementedError
 
     def is_rectangle(self) -> bool:
@@ -143,8 +152,7 @@ class Point(Geometry):
     def coords(self) -> np.ndarray:
         return np.array([[self.x, self.y]], dtype=np.float64)
 
-    @property
-    def envelope(self) -> Envelope:
+    def _compute_envelope(self) -> Envelope:
         return Envelope(self.x, self.y, self.x, self.y)
 
 
@@ -154,8 +162,7 @@ class LineString(Geometry):
     def __init__(self, coords):
         self.coords = np.asarray(coords, dtype=np.float64).reshape(-1, 2)
 
-    @property
-    def envelope(self) -> Envelope:
+    def _compute_envelope(self) -> Envelope:
         return Envelope.of_coords(self.coords)
 
 
@@ -170,8 +177,7 @@ class Polygon(Geometry):
             np.asarray(h, dtype=np.float64).reshape(-1, 2) for h in (holes or [])
         ]
 
-    @property
-    def envelope(self) -> Envelope:
+    def _compute_envelope(self) -> Envelope:
         return Envelope.of_coords(self.shell)
 
     def is_rectangle(self) -> bool:
@@ -194,8 +200,7 @@ class _Multi(Geometry):
     def __init__(self, geoms: Iterable[Geometry]):
         self.geoms: List[Geometry] = list(geoms)
 
-    @property
-    def envelope(self) -> Envelope:
+    def _compute_envelope(self) -> Envelope:
         env = self.geoms[0].envelope
         for g in self.geoms[1:]:
             env = env.expand_to_include(g.envelope)

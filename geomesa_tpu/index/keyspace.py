@@ -147,6 +147,37 @@ def _boxes(values: IndexValues) -> List[Tuple[float, float, float, float]]:
     return out or [WHOLE_WORLD.as_tuple()]
 
 
+def _envelope_columns(geom: str, columns) -> Dict[str, np.ndarray]:
+    """Per-row geometry envelope companion columns (``geom__bxmin`` ...).
+
+    Computed once at ingest for XZ keys and STORED in the blocks: the
+    vectorized bbox prescreen in filter evaluation (evaluate._eval_spatial)
+    and the device executor both read them instead of re-walking the
+    object geometry column. Null geometries get an empty (0,0,0,0) box."""
+    existing = columns.get(geom + "__bxmin")
+    if existing is not None:
+        return {
+            geom + "__bxmin": existing,
+            geom + "__bymin": columns[geom + "__bymin"],
+            geom + "__bxmax": columns[geom + "__bxmax"],
+            geom + "__bymax": columns[geom + "__bymax"],
+        }
+    col = columns[geom]
+    envs = np.array(
+        [
+            g.envelope.as_tuple() if g is not None else (0.0, 0.0, 0.0, 0.0)
+            for g in col
+        ],
+        dtype=np.float64,
+    ).reshape(-1, 4)
+    return {
+        geom + "__bxmin": envs[:, 0],
+        geom + "__bymin": envs[:, 1],
+        geom + "__bxmax": envs[:, 2],
+        geom + "__bymax": envs[:, 3],
+    }
+
+
 def times_by_bin(
     intervals: FilterValues, period: TimePeriod
 ) -> Dict[int, Tuple[int, int]]:
@@ -307,18 +338,15 @@ class XZ2KeySpace(IndexKeySpace):
 
     def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
         geom = _geom_prop(ft)
-        col = columns[geom]
-        envs = np.array(
-            [
-                g.envelope.as_tuple() if g is not None else (0.0, 0.0, 0.0, 0.0)
-                for g in col
-            ],
-            dtype=np.float64,
-        ).reshape(-1, 4)
+        envs = _envelope_columns(geom, columns)
         keys = self.sfc(ft).index(
-            envs[:, 0], envs[:, 1], envs[:, 2], envs[:, 3], lenient=True
+            envs[geom + "__bxmin"],
+            envs[geom + "__bymin"],
+            envs[geom + "__bxmax"],
+            envs[geom + "__bymax"],
+            lenient=True,
         )
-        return {"__key__": keys}
+        return {"__key__": keys, **envs}
 
     def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
         geoms = extract_geometries(f, _geom_prop(ft))
@@ -350,20 +378,19 @@ class XZ3KeySpace(IndexKeySpace):
     def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
         geom = _geom_prop(ft)
         dtg = ft.default_date.name
-        col = columns[geom]
-        envs = np.array(
-            [
-                g.envelope.as_tuple() if g is not None else (0.0, 0.0, 0.0, 0.0)
-                for g in col
-            ],
-            dtype=np.float64,
-        ).reshape(-1, 4)
+        envs = _envelope_columns(geom, columns)
         bins, offsets = time_to_binned(columns[dtg], ft.xz3_interval, lenient=True)
         off = offsets.astype(np.float64)
         keys = self.sfc(ft).index(
-            envs[:, 0], envs[:, 1], off, envs[:, 2], envs[:, 3], off, lenient=True
+            envs[geom + "__bxmin"],
+            envs[geom + "__bymin"],
+            off,
+            envs[geom + "__bxmax"],
+            envs[geom + "__bymax"],
+            off,
+            lenient=True,
         )
-        return {"__bin__": bins, "__key__": keys}
+        return {"__bin__": bins, "__key__": keys, **envs}
 
     def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
         geom = _geom_prop(ft)

@@ -361,10 +361,23 @@ class DeviceSegment:
                 ys.append(yi.astype(np.int32))
             else:  # xz2 / xz3: per-feature bounding boxes, ulp-widened so the
                 # f32 cast can never shrink a bbox out of a true overlap
-                e = np.zeros((b.n, 4), dtype=np.float64)
-                for i, g in enumerate(b.columns[geom]):
-                    if g is not None:
-                        e[i] = g.envelope.as_tuple()
+                bx = b.columns.get(geom + "__bxmin")
+                if bx is not None:
+                    # envelope companion columns stored at ingest
+                    e = np.stack(
+                        [
+                            bx,
+                            b.columns[geom + "__bymin"],
+                            b.columns[geom + "__bxmax"],
+                            b.columns[geom + "__bymax"],
+                        ],
+                        axis=1,
+                    ).astype(np.float64)
+                else:  # legacy blocks: walk the object column
+                    e = np.zeros((b.n, 4), dtype=np.float64)
+                    for i, g in enumerate(b.columns[geom]):
+                        if g is not None:
+                            e[i] = g.envelope.as_tuple()
                 e32 = np.empty((b.n, 4), dtype=np.float32)
                 e32[:, 0] = np.nextafter(e[:, 0].astype(np.float32), np.float32(-np.inf))
                 e32[:, 1] = np.nextafter(e[:, 1].astype(np.float32), np.float32(-np.inf))

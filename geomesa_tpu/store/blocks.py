@@ -224,6 +224,15 @@ class FeatureBlock:
         bins = key_cols.get("__bin__")
         valid = key_cols.get("__valid__")
         tiebreak = key_cols.get("__tiebreak__")
+        extra = {
+            k: v
+            for k, v in key_cols.items()
+            if k not in ("__key__", "__bin__", "__valid__", "__tiebreak__")
+        }
+        if extra:
+            # derived companion columns (e.g. XZ geometry envelopes) ride
+            # along row-aligned and get sorted with everything else
+            columns = {**columns, **extra}
         if valid is not None and not valid.all():
             rows = np.where(valid)[0]
             columns = take_rows(columns, rows)

@@ -35,7 +35,6 @@ from geomesa_tpu.store.blocks import (
     ColumnBuffer,
     Columns,
     IndexTable,
-    concat_columns,
     take_rows,
 )
 from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
@@ -497,18 +496,21 @@ class TpuDataStore:
         if not parts:
             return _empty_columns(ft)
         out_needed = self._output_columns(ft, query)
+        # a key must exist in EVERY part's block (union arms can mix index
+        # families whose blocks carry different derived companions, e.g.
+        # xz envelope columns vs attr blocks) — except __null companions,
+        # whose absence means "no nulls in this block" and materializes as
+        # zeros
+        keysets = [set(b.columns) for b, _ in parts]
+        common = set.intersection(*keysets)
         keys = {"__fid__"}
-        for block, _rows in parts:
-            keys.update(
-                k
-                for k in block.columns
-                if k != "__vis__"
-                and (
-                    k == "__fid__"
-                    or out_needed is None
-                    or _column_base(k) in out_needed
-                )
-            )
+        keys.update(
+            k
+            for k in set.union(*keysets)
+            if k != "__vis__"
+            and (k in common or k.endswith("__null"))
+            and (out_needed is None or _column_base(k) in out_needed)
+        )
         return LazyColumns(parts, keys)
 
     def _finish(self, ft, query: Query, plan: QueryPlan, columns: Columns) -> QueryResult:
@@ -706,33 +708,12 @@ class TpuDataStore:
             rows = rows[vmask]
         return rows
 
-    def _needed_columns(
-        self, ft: FeatureType, query: Query, plan: QueryPlan, loose: bool, age_cutoff
-    ) -> Optional[set]:
-        """Attribute base-names the scan must gather; None = everything.
-        Only prunes when an explicit projection makes the need explicit."""
-        props = query.properties
-        if props is None or has_aggregation(query.hints):
-            return None
-        if any("=" in p for p in props):
-            return None  # derived transforms read arbitrary source columns
-        needed = set(props)
-        if plan.post_filter is not None and not loose:
-            needed.update(ast.properties(plan.post_filter))
-        if query.sort_by:
-            needed.update(a for a, _ in query.sort_by)
-        sample_by = query.hints.get("sample_by")
-        if sample_by:
-            needed.add(sample_by)
-        if age_cutoff is not None and ft.default_date is not None:
-            needed.add(ft.default_date.name)
-        return needed
-
     def _output_columns(self, ft: FeatureType, query: Query) -> Optional[set]:
         """Base-names the query RESULT must carry; None = everything.
         A superset of the projection: sort and sampling read from the
-        gathered columns after filtering. Distinct from _needed_columns,
-        which adds post-filter/age-off inputs that never reach the result."""
+        gathered columns after filtering (post-filter/age-off inputs are
+        gathered separately by _gather_filter_cols and never reach the
+        result)."""
         props = query.properties
         if props is None or has_aggregation(query.hints):
             return None

@@ -241,3 +241,48 @@ def test_zranges_skip_flags_are_strict_interior():
         xi, yi = z2_decode(zs)
         assert (xi >= skip_min[0]).all() and (xi <= skip_max[0]).all()
         assert (yi >= skip_min[1]).all() and (yi <= skip_max[1]).all()
+
+
+def test_union_mixed_index_families_with_envelope_columns():
+    """xz blocks carry envelope companion columns, attr blocks don't; a
+    cross-index OR union must still materialize (round-2 regression)."""
+    from geomesa_tpu.geom.base import Polygon
+
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    s.create_schema(parse_spec("w", "name:String:index=true,*geom:Polygon:srid=4326"))
+    rng = np.random.default_rng(4)
+    with s.writer("w") as w:
+        for i in range(500):
+            x0 = float(rng.uniform(-170, 170)); y0 = float(rng.uniform(-80, 80))
+            w.write(
+                [f"n{i % 7}", Polygon([[x0, y0], [x0 + 1, y0], [x0 + 1, y0 + 1], [x0, y0 + 1], [x0, y0]])],
+                fid=f"w{i}",
+            )
+    cql = "intersects(geom, POLYGON((-20 -20, 20 -20, 0 20, -20 -20))) OR name = 'n1'"
+    got = sorted(s.query("w", cql).fids)
+    # oracle: evaluate both predicates directly
+    from geomesa_tpu.filter.parser import parse_cql
+    from geomesa_tpu.filter.evaluate import evaluate
+
+    res = s.query("w", "INCLUDE")
+    cols = dict(res.columns.items())
+    mask = evaluate(parse_cql(cql), s.get_schema("w"), cols)
+    want = sorted(np.asarray(cols["__fid__"])[mask])
+    assert got == want and len(got) > 0
+
+
+def test_null_geometry_not_matched_by_origin_box():
+    """A None geometry's placeholder (0,0,0,0) envelope must not satisfy a
+    query box covering the origin (round-2 regression)."""
+    from geomesa_tpu.geom.base import Polygon
+
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    s.create_schema(parse_spec("w", "*geom:Polygon:srid=4326"))
+    with s.writer("w") as w:
+        w.write([Polygon([[1, 1], [2, 1], [2, 2], [1, 2], [1, 1]])], fid="inbox")
+        w.write([None], fid="nullgeom")
+        w.write([Polygon([[50, 50], [51, 50], [51, 51], [50, 51], [50, 50]])], fid="far")
+        # degenerate at-origin geometry: must still match
+        w.write([Polygon([[0, 0], [0, 0], [0, 0], [0, 0], [0, 0]])], fid="origin")
+    got = sorted(s.query("w", "bbox(geom, -10, -10, 10, 10)").fids)
+    assert got == ["inbox", "origin"], got
