@@ -181,8 +181,18 @@ class Polygon(Geometry):
         return Envelope.of_coords(self.shell)
 
     def is_rectangle(self) -> bool:
+        got = getattr(self, "_rect_cache", None)
+        if got is None:
+            got = self._compute_is_rectangle()
+            self._rect_cache = got
+        return got
+
+    def _compute_is_rectangle(self) -> bool:
         if self.holes or len(self.shell) != 5:
             return False
+        s = [(float(x), float(y)) for x, y in self.shell]
+        if s[4] != s[0]:
+            return False  # unclosed ring
         env = self.envelope
         corners = {
             (env.xmin, env.ymin),
@@ -190,8 +200,16 @@ class Polygon(Geometry):
             (env.xmax, env.ymax),
             (env.xmin, env.ymax),
         }
-        pts = {(float(x), float(y)) for x, y in self.shell[:4]}
-        return pts == corners
+        if len(corners) != 4 or set(s[:4]) != corners:
+            return False
+        # perimeter order: consecutive corners must share exactly one
+        # coordinate (axis-aligned edges) — rejects self-intersecting
+        # "bowtie" orderings whose vertex SET still equals the corners
+        # (JTS isRectangle validates ordering the same way)
+        return all(
+            (x0 == x1) != (y0 == y1)
+            for (x0, y0), (x1, y1) in zip(s[:4], s[1:5])
+        )
 
 
 class _Multi(Geometry):

@@ -206,6 +206,10 @@ def geometries_intersect(g1: Geometry, g2: Geometry) -> bool:
     """
     if not g1.envelope.intersects(g2.envelope):
         return False
+    # axis-aligned rectangles ARE their envelopes: overlap decides exactly
+    # (the dominant case in bbox post-filter rings)
+    if g1.is_rectangle() and g2.is_rectangle():
+        return True
     if isinstance(g1, (MultiPoint, MultiLineString, MultiPolygon, GeometryCollection)):
         return any(geometries_intersect(g, g2) for g in g1.geoms)
     if isinstance(g2, (MultiPoint, MultiLineString, MultiPolygon, GeometryCollection)):
