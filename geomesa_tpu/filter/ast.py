@@ -281,10 +281,14 @@ def walk(f: Filter):
 
 
 def properties(f: Filter) -> List[str]:
-    """All property names referenced by the filter."""
+    """All property names referenced by the filter. IdFilter reads the
+    feature id, reported as the internal "__fid__" column so scans gather
+    it for evaluation."""
     out = []
     for node in walk(f):
         p = getattr(node, "prop", None)
         if p is not None and p not in out:
             out.append(p)
+        if isinstance(node, IdFilter) and "__fid__" not in out:
+            out.append("__fid__")
     return out

@@ -748,8 +748,10 @@ class _HostSeekScan:
     def _iter_native(self):
         from geomesa_tpu.native import seek_scan_native
 
-        geom, dtg, box, t_lo, t_hi = self.pred
+        geom, dtg, box, t_lo, t_hi, use_covered = self.pred
         for block, starts, ends, flags in self.per_block:
+            if not use_covered:
+                flags = np.zeros(len(starts), dtype=bool)
             t = None
             lo = hi = 0
             if t_lo is not None or t_hi is not None:
@@ -922,10 +924,29 @@ class TpuScanExecutor:
         return _HostSeekScan(table, per_block, self._native_seek_pred(table, plan))
 
     def _native_seek_pred(self, table: IndexTable, plan):
-        """(geom, dtg, box, t_lo, t_hi) for the one-pass native seek-scan
-        when the query reduces to one exact bbox(+interval) predicate and
-        the C++ lib is available; None -> covered-split numpy path."""
+        """(geom, dtg, box, t_lo, t_hi, use_covered) for the one-pass
+        native seek-scan when the remaining per-row work reduces to one
+        exact bbox(+interval) test and the C++ lib is available; None ->
+        covered-split numpy path. ``use_covered`` marks full-filter mode,
+        where range ``contained`` flags let the kernel skip whole runs.
+
+        Two plan shapes qualify:
+          * point z-index, FULL filter = bbox(+interval), no residual —
+            the kernel evaluates the whole query;
+          * value-exact attr/id plan (every range ``contained``: equality
+            bounds in value space) whose residual secondary = bbox(+interval)
+            — candidates already satisfy the primary, the kernel evaluates
+            the residual (the z2-tiebreak attribute scan of the reference,
+            AttributeIndex.scala:43-46, with the spatial recheck in C++).
+        """
         shape = self._exact_predicate_shape(table, plan)
+        # full-filter mode: range ``contained`` flags mean "satisfies the
+        # whole predicate" and the kernel may skip those runs. In residual
+        # mode they only mean "satisfies the primary" — every candidate
+        # still takes the box test.
+        use_covered = shape is not None
+        if shape is None:
+            shape = self._residual_shape(table, plan)
         if shape is None:
             return None
         from geomesa_tpu.native import load_seek
@@ -947,7 +968,24 @@ class TpuScanExecutor:
             (xmin, ymin, xmax, ymax),
             t_lo,
             t_hi,
+            use_covered,
         )
+
+    def _residual_shape(self, table: IndexTable, plan):
+        """Box(+window) shape of a value-exact plan's residual secondary.
+
+        Requires every scan range to be ``contained`` (attr equality / id
+        ranges, exact in value space: primary provably satisfied by every
+        candidate) and full_filter = primary AND secondary, so testing only
+        the secondary box(+window) yields the query's own result set."""
+        name = table.index.name
+        if not (name == "id" or name.startswith("attr")):
+            return None
+        if plan.primary is None or plan.secondary is None:
+            return None
+        if not plan.ranges or not all(r.contained for r in plan.ranges):
+            return None
+        return self._box_window_shape(table.ft, plan.secondary)
 
     def dispatch_candidates(self, table: IndexTable, plan: QueryPlan):
         """Start the device pre-filter WITHOUT blocking; None -> caller
@@ -992,17 +1030,33 @@ class TpuScanExecutor:
         return self.dispatch_candidates(table, plan)
 
     @staticmethod
-    def _exact_predicate_shape(table: IndexTable, plan: QueryPlan):
+    def _box_window_shape(ft, f):
         """(xmin, ymin, xmax, ymax, t_lo, t_hi) raw f64 / inclusive-ms
-        bounds when the FULL filter is exactly one AND-combination of
+        bounds when filter ``f`` is exactly one AND-combination of
         inclusive-envelope spatial tests on the default point geometry plus
-        interval tests on the default date — i.e. the query's own semantics
-        reduce to one box(+window) test. None otherwise. t_lo/t_hi are None
-        when the filter has no temporal part."""
+        interval tests on the default date — i.e. its semantics reduce to
+        one box(+window) test. None otherwise. t_lo/t_hi are None when the
+        filter has no temporal part."""
+        if f is None or ft.default_geometry is None or not ft.is_points:
+            return None
+        return TpuScanExecutor._walk_box_window(ft, f)
+
+    @staticmethod
+    def _exact_predicate_shape(table: IndexTable, plan: QueryPlan):
+        """Box(+window) shape of the FULL filter for point z-index plans
+        with no residual (see _box_window_shape)."""
         if table.index.name not in ("z2", "z3") or plan.secondary is not None:
             return None
-        ft = table.ft
-        f = plan.full_filter
+        shape = TpuScanExecutor._box_window_shape(table.ft, plan.full_filter)
+        if shape is None:
+            return None
+        t_lo, t_hi = shape[4], shape[5]
+        if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
+            return None  # temporal test needs the time column (z3 tables)
+        return shape
+
+    @staticmethod
+    def _walk_box_window(ft, f):
         if f is None:
             return None
         from geomesa_tpu.filter import ast as A
@@ -1050,8 +1104,6 @@ class TpuScanExecutor:
 
         if not walk(f) or not boxes:
             return None
-        if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
-            return None  # temporal test needs the time column (z3 tables)
         env = boxes[0]
         xmin, ymin, xmax, ymax = env.xmin, env.ymin, env.xmax, env.ymax
         for e in boxes[1:]:  # AND of boxes = envelope intersection

@@ -646,13 +646,16 @@ class TpuDataStore:
 
     @staticmethod
     def _gather_filter_cols(block, rows, props) -> Columns:
-        """Gather exactly the columns a filter reads; property-free filters
-        (e.g. EXCLUDE) get a length-carrier column so evaluate() can infer
-        the row count."""
+        """Gather exactly the columns a filter reads (incl. "__fid__" when
+        an IdFilter is present — ast.properties reports it); property-free
+        filters (e.g. EXCLUDE) get a length-carrier column so evaluate()
+        can infer the row count."""
         fcols = {
             k: v[rows]
             for k, v in block.columns.items()
-            if k not in ("__fid__", "__vis__") and _column_base(k) in props
+            if k != "__vis__"
+            and (k != "__fid__" or "__fid__" in props)
+            and _column_base(k) in props
         }
         if not fcols:
             fcols["__rows__"] = rows

@@ -286,3 +286,66 @@ def test_null_geometry_not_matched_by_origin_box():
         w.write([Polygon([[0, 0], [0, 0], [0, 0], [0, 0], [0, 0]])], fid="origin")
     got = sorted(s.query("w", "bbox(geom, -10, -10, 10, 10)").fids)
     assert got == ["inbox", "origin"], got
+
+
+def test_native_residual_path_on_selective_attr_plan():
+    """When the attribute index wins (selective equality), candidates are
+    value-exact and the native kernel evaluates the bbox residual: the scan
+    must be exact (no post-filter) with brute-force parity."""
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    s.create_schema(parse_spec("t", "tag:String:index=true,dtg:Date,*geom:Point:srid=4326"))
+    rng = np.random.default_rng(31)
+    rows = []
+    with s.writer("t") as w:
+        for i in range(8000):
+            tag = "rare" if i % 400 == 0 else f"common{i % 3}"
+            t = int(BASE + rng.integers(0, 35 * 86400_000))
+            x = float(rng.uniform(-60, 60)); y = float(rng.uniform(-60, 60))
+            rows.append((f"f{i}", tag, t, x, y))
+            w.write([tag, t, Point(x, y)], fid=f"f{i}")
+    cql = "tag = 'rare' AND bbox(geom, -30, -30, 30, 30)"
+    plan = s._plan_cached("t", s._as_query(cql))
+    assert plan.index.name.startswith("attr"), plan.index.name
+    table = s._tables["t"][plan.index.name]
+    scan = s.executor.scan_candidates(table, plan)
+    if scan is None or getattr(scan, "pred", None) is None:
+        pytest.skip("native residual path not selected (lib unavailable?)")
+    assert scan.exact
+    got = sorted(s.query("t", cql).fids)
+    want = sorted(
+        f for f, tag, t, x, y in rows
+        if tag == "rare" and -30 <= x <= 30 and -30 <= y <= 30
+    )
+    assert got == want and len(got) > 0
+
+
+def test_id_filter_in_post_filter_does_not_crash():
+    """IN(...) AND bbox via the covered-split path must gather __fid__ for
+    the IdFilter evaluation (review regression: KeyError '__fid__')."""
+    s = _mk(TpuScanExecutor(default_mesh()), n=3000)
+    all_hits = sorted(s.query("t", CQL).fids)
+    pick = all_hits[:3] + ["nonexistent"]
+    ids = ",".join(f"'{f}'" for f in pick)
+    cql = f"IN ({ids}) AND " + CQL
+    got = sorted(s.query("t", cql).fids)
+    assert got == sorted(all_hits[:3])
+    # and on the pure-host fallback too
+    b = _mk(HostScanExecutor(), n=3000)
+    assert sorted(b.query("t", cql).fids) == got
+
+
+def test_mixed_type_object_column_ordered_compare():
+    """An ordered comparison over a mixed-type object column must treat
+    incomparable rows as non-matching, not crash."""
+    from geomesa_tpu.filter.evaluate import evaluate
+    from geomesa_tpu.filter.parser import parse_cql
+
+    ft = parse_spec("t", "v:String,*geom:Point:srid=4326")
+    cols = {
+        "v": np.array(["a", 3, "c", None], dtype=object),
+        "geom__x": np.zeros(4),
+        "geom__y": np.zeros(4),
+        "__fid__": np.array(["a", "b", "c", "d"], dtype=object),
+    }
+    mask = evaluate(parse_cql("v < 'b'"), ft, cols)
+    assert mask.tolist() == [True, False, False, False]
