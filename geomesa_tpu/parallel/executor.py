@@ -707,6 +707,25 @@ class _PendingScan:
                 yield block, local
 
 
+def _merge_overlapping_intervals(starts, ends, flags):
+    """Coalesce overlapping [start, end) row intervals (flags AND-merge —
+    False is safe in both kernel modes: the row merely takes the test it
+    would pass anyway). Disjoint inputs return unchanged."""
+    if len(starts) <= 1:
+        return starts, ends, flags
+    order = np.argsort(starts, kind="stable")
+    s, e, f = starts[order], ends[order], flags[order]
+    run_end = np.maximum.accumulate(e)
+    if (s[1:] >= run_end[:-1]).all():
+        return s, e, f  # already disjoint (sorted)
+    new_grp = np.concatenate(([True], s[1:] >= run_end[:-1]))
+    heads = np.flatnonzero(new_grp)
+    gs = s[heads]
+    ge = np.maximum.reduceat(e, heads)
+    gf = np.minimum.reduceat(f.astype(np.int8), heads).astype(bool)
+    return gs, ge, gf
+
+
 class _HostSeekScan:
     """A host searchsorted block seek wrapped in the _PendingScan shape:
     the executor chose seeking over device dispatch for a selective plan.
@@ -752,6 +771,11 @@ class _HostSeekScan:
         for block, starts, ends, flags in self.per_block:
             if not use_covered:
                 flags = np.zeros(len(starts), dtype=bool)
+            # the kernel iterates intervals verbatim: overlapping candidate
+            # intervals (OR'd attr ranges, duplicate IN values) would emit
+            # shared rows once per interval — merge them first (z ranges
+            # arrive merged-disjoint; attr ranges carry no such guarantee)
+            starts, ends, flags = _merge_overlapping_intervals(starts, ends, flags)
             t = None
             lo = hi = 0
             if t_lo is not None or t_hi is not None:

@@ -14,12 +14,15 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from geomesa_tpu.process.geodesy import degrees_box, haversine_m
+from geomesa_tpu.process.geodesy import degrees_boxes, haversine_m
 
 
-def _bbox_cql(ft, box, extra: Optional[str]) -> str:
+def _bbox_cql(ft, boxes, extra: Optional[str]) -> str:
     geom = ft.default_geometry.name
-    cql = f"bbox({geom}, {box[0]!r}, {box[1]!r}, {box[2]!r}, {box[3]!r})"
+    parts = [
+        f"bbox({geom}, {b[0]!r}, {b[1]!r}, {b[2]!r}, {b[3]!r})" for b in boxes
+    ]
+    cql = parts[0] if len(parts) == 1 else "(" + " OR ".join(parts) + ")"
     if extra:
         cql = f"({cql}) AND ({extra})"
     return cql
@@ -44,14 +47,14 @@ def knn_search(
     Features beyond ``max_radius_m`` are never returned — identical
     semantics on the device top-k and host expanding-bbox paths."""
     ft = store.get_schema(name)
-    if cql is None:
+    if cql is None and _device_knn_wanted():
         direct = _device_knn(store, name, ft, x, y, k, max_radius_m)
         if direct is not None:
             return direct
     radius = float(initial_radius_m)
     result = None
     while True:
-        result = store.query(name, _bbox_cql(ft, degrees_box(x, y, radius), cql))
+        result = store.query(name, _bbox_cql(ft, degrees_boxes(x, y, radius), cql))
         if len(result) >= k or radius >= max_radius_m:
             break
         radius *= 2.0
@@ -64,13 +67,30 @@ def knn_search(
     # radius, a closer feature may sit in the circle's corners — requery at
     # the k-th distance to close the search (KNNQuery's final window)
     if kth > radius and radius < max_radius_m:
-        result = store.query(name, _bbox_cql(ft, degrees_box(x, y, kth), cql))
+        result = store.query(name, _bbox_cql(ft, degrees_boxes(x, y, kth), cql))
         d = _distances(ft, result, x, y)
         order = np.argsort(d, kind="stable")[:k]
     fids = result.fids
     return [
         (str(fids[i]), float(d[i])) for i in order if d[i] <= max_radius_m
     ]
+
+
+def _device_knn_wanted() -> bool:
+    """Cost choice: the one-pass device top-k ranks EVERY resident row —
+    a bargain on a real accelerator, a full scan on the CPU backend where
+    the expanding-bbox seek path touches only candidate cells.
+    GEOMESA_KNN_DEVICE: auto (accelerators only, default) | 1 | 0."""
+    import os
+
+    env = os.environ.get("GEOMESA_KNN_DEVICE", "auto")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    import jax
+
+    return jax.default_backend() != "cpu"
 
 
 def _device_knn(store, name: str, ft, x: float, y: float, k: int,

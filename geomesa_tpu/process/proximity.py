@@ -12,7 +12,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from geomesa_tpu.process.geodesy import degrees_box, haversine_m
+from geomesa_tpu.process.geodesy import degrees_boxes, haversine_m
 
 
 def proximity_search(
@@ -28,7 +28,7 @@ def proximity_search(
 
     ft = store.get_schema(name)
     geom = ft.default_geometry.name
-    boxes = [degrees_box(x, y, distance_m) for x, y in points]
+    boxes = [b for x, y in points for b in degrees_boxes(x, y, distance_m)]
     parts = " OR ".join(
         f"bbox({geom}, {b[0]!r}, {b[1]!r}, {b[2]!r}, {b[3]!r})" for b in boxes
     )

@@ -14,7 +14,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from geomesa_tpu.process.geodesy import degrees_box, haversine_m
+from geomesa_tpu.process.geodesy import degrees_boxes, haversine_m
 
 
 def _resample(track, max_gap_m: float):
@@ -54,7 +54,7 @@ def tube_select(
     # decomposes it; per-sample precision comes from the exact pass below)
     xs = [s[0] for s in samples]
     ys = [s[1] for s in samples]
-    boxes = [degrees_box(x, y, buffer_m) for x, y in zip(xs, ys)]
+    boxes = [b for x, y in zip(xs, ys) for b in degrees_boxes(x, y, buffer_m)]
     xmin = min(b[0] for b in boxes)
     ymin = min(b[1] for b in boxes)
     xmax = max(b[2] for b in boxes)

@@ -14,6 +14,13 @@ from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
 SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
 
 
+@pytest.fixture(autouse=True)
+def _force_device_knn(monkeypatch):
+    # 'auto' routes kNN to the expanding-bbox seek on the CPU backend;
+    # these tests are about the DEVICE top-k path, so force it on
+    monkeypatch.setenv("GEOMESA_KNN_DEVICE", "1")
+
+
 def _mk(executor, n=3000, seed=11):
     ds = TpuDataStore(executor=executor)
     ds.create_schema(parse_spec("t", SPEC))

@@ -349,3 +349,32 @@ def test_mixed_type_object_column_ordered_compare():
     }
     mask = evaluate(parse_cql("v < 'b'"), ft, cols)
     assert mask.tolist() == [True, False, False, False]
+
+
+def test_native_residual_no_duplicates_with_overlapping_attr_ranges(monkeypatch):
+    """Overlapping contained attr ranges (OR'd value ranges sharing a
+    boundary) must not emit shared rows once per range through the native
+    kernel (review regression)."""
+    monkeypatch.setenv("GEOMESA_SEEK", "1")
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    s.create_schema(parse_spec("t", "tag:String:index=true,*geom:Point:srid=4326"))
+    rng = np.random.default_rng(41)
+    rows = []
+    with s.writer("t") as w:
+        for i in range(2000):
+            tag = f"t{i % 5}"
+            x = float(rng.uniform(-50, 50)); y = float(rng.uniform(-50, 50))
+            rows.append((f"f{i}", tag, x, y))
+            w.write([tag, Point(x, y)], fid=f"f{i}")
+    cql = (
+        "((tag >= 't1' AND tag <= 't3') OR (tag >= 't3' AND tag <= 't5')) "
+        "AND bbox(geom, -30, -30, 30, 30)"
+    )
+    res = s.query("t", cql)
+    fids = list(res.fids)
+    assert len(fids) == len(set(fids)), "duplicate fids in result"
+    want = sorted(
+        f for f, tag, x, y in rows
+        if "t1" <= tag <= "t5" and -30 <= x <= 30 and -30 <= y <= 30
+    )
+    assert sorted(fids) == want and len(want) > 0
