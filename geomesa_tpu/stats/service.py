@@ -122,7 +122,12 @@ class MetadataBackedStats(GeoMesaStats):
             self._stats[ft.name] = loaded if loaded is not None else self._init_for(ft)
         return self._stats[ft.name]
 
-    def observe_columns(self, ft: FeatureType, columns: Dict[str, np.ndarray]) -> None:
+    def observe_columns(
+        self, ft: FeatureType, columns: Dict[str, np.ndarray], z3_keys=None
+    ) -> None:
+        """``z3_keys``: optional (keys, bins) arrays from a freshly sealed
+        z3 block of the SAME rows — the Z3 histogram then derives its cells
+        from the already-encoded keys instead of re-encoding the batch."""
         stats = self.stats_for(ft)
         n = len(next(iter(columns.values()), []))
         stats["count"].count += n
@@ -130,6 +135,9 @@ class MetadataBackedStats(GeoMesaStats):
             if key == "count":
                 continue
             if isinstance(stat, Z3HistogramStat):
+                if z3_keys is not None:
+                    stat.observe_keys(*z3_keys)
+                    continue
                 x = columns.get(stat.geom + "__x")
                 t = columns.get(stat.dtg)
                 if x is not None and t is not None:

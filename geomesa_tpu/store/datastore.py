@@ -307,7 +307,27 @@ class TpuDataStore:
         for table in self._tables[ft.name].values():
             table.insert(columns)
         if observe_stats and self.stats is not None:
-            self.stats.observe_columns(ft, columns)
+            # the z3 block just sealed already encoded every row's key: the
+            # Z3 histogram reuses it (row order is irrelevant to counts).
+            # Gate on NaN-free coords — observe_xyt drops NaN rows, while
+            # the block's lenient encode would give them clipped keys.
+            z3_keys = None
+            zt = self._tables[ft.name].get("z3")
+            geom = ft.default_geometry
+            if zt is not None and zt.blocks and geom is not None:
+                blk = zt.blocks[-1]
+                x = columns.get(geom.name + "__x")
+                y = columns.get(geom.name + "__y")
+                if (
+                    x is not None
+                    and y is not None
+                    and blk.n == len(x)
+                    and blk.bins is not None
+                    and not np.isnan(x).any()
+                    and not np.isnan(y).any()
+                ):
+                    z3_keys = (blk.key, blk.bins)
+            self.stats.observe_columns(ft, columns, z3_keys=z3_keys)
 
     def delete_features(self, name: str, fids: Sequence[str]):
         for table in self._tables[name].values():

@@ -151,3 +151,33 @@ def test_cost_based_decider_prefers_selective_attribute():
     strategies = get_filter_strategies(ft, default_indices(ft), f, svc)
     best = min(strategies, key=lambda s: s.cost)
     assert best.index.name == "attr:actor"
+
+
+def test_z3_histogram_observe_keys_matches_observe_xyt():
+    """The key-reuse ingest path must produce bit-identical Z3 histogram
+    counts to the re-encoding path, including clipped coordinates."""
+    import numpy as np
+
+    from geomesa_tpu.curve import TimePeriod, time_to_binned
+    from geomesa_tpu.curve.sfc import Z3SFC
+    from geomesa_tpu.stats.sketches import Z3HistogramStat
+
+    rng = np.random.default_rng(3)
+    n = 20000
+    x = np.concatenate([rng.uniform(-185, 185, n // 2), rng.normal(-77, 3, n - n // 2)])
+    y = np.concatenate([rng.uniform(-95, 95, n // 2), rng.normal(38.9, 2, n - n // 2)])
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype(np.int64)
+    t = base + rng.integers(0, 40 * 86400_000, n)
+
+    a = Z3HistogramStat("geom", "dtg", "week")
+    a.observe_xyt(x, y, t)
+
+    period = TimePeriod.WEEK
+    bins, offsets = time_to_binned(t, period, lenient=True)
+    keys = Z3SFC.for_period(period).index(x, y, offsets, lenient=True)
+    b = Z3HistogramStat("geom", "dtg", "week")
+    b.observe_keys(keys, bins)
+
+    assert set(a.counts) == set(b.counts)
+    for k in a.counts:
+        assert (a.counts[k] == b.counts[k]).all()
