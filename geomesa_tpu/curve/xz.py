@@ -157,6 +157,23 @@ class _XZSFC:
                 )
             )
 
+        # latency-critical planning path: prefer the C++ BFS (same
+        # semantics, tested against this Python walk); fall back below
+        try:
+            from geomesa_tpu.native import xzranges_native
+
+            native = xzranges_native(
+                [q[0] for q in queries],
+                [q[1] for q in queries],
+                self.dims,
+                self.g,
+                max_ranges,
+            )
+            if native is not None:
+                return [IndexRange(lo, hi, c) for lo, hi, c in native]
+        except Exception:
+            pass
+
         dims, base, g = self.dims, self.base, self.g
         ranges: List[IndexRange] = []
 

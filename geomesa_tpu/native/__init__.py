@@ -4,6 +4,11 @@ The reference keeps its planning hot loops in tight JVM code (sfcurve
 bit-twiddling, SURVEY.md section 2.1); here they are C++ compiled on first
 use with the baked-in g++ toolchain. Everything has a pure-Python fallback —
 set GEOMESA_TPU_NO_NATIVE=1 to force it (and tests compare the two).
+
+Kernels:
+  zranges.cpp   z2/z3 quad/oct-tree range decomposition (+ skip boxes)
+  xzranges.cpp  XZ sequence-interval BFS (extent indices)
+  seekscan.cpp  one-pass candidate-interval filter (the tserver hot loop)
 """
 
 from __future__ import annotations
@@ -12,21 +17,12 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "zranges.cpp")
-_SO = os.path.join(_DIR, "_zranges.so")
-_SEEK_SRC = os.path.join(_DIR, "seekscan.cpp")
-_SEEK_SO = os.path.join(_DIR, "_seekscan.so")
-
 _lock = threading.Lock()
-_lib = None
-_tried = False
-_seek_lib = None
-_seek_tried = False
 
 
 def _build_so(src: str, so: str) -> bool:
@@ -43,89 +39,199 @@ def _build_so(src: str, so: str) -> bool:
         return False
 
 
-def _build() -> bool:
-    return _build_so(_SRC, _SO)
+class _NativeLib:
+    """One lazily-built, cached ctypes kernel: source path, symbol and
+    signature in one place (the loader boilerplate used to be copied per
+    kernel and drifted)."""
+
+    def __init__(self, src: str, so: str, symbol: str, restype, argtypes):
+        self.src = os.path.join(_DIR, src)
+        self.so = os.path.join(_DIR, so)
+        self.symbol = symbol
+        self.restype = restype
+        self.argtypes = argtypes
+        self._lib = None
+        self._tried = False
+
+    def load(self):
+        if os.environ.get("GEOMESA_TPU_NO_NATIVE"):
+            return None
+        with _lock:
+            if self._tried:
+                return self._lib
+            self._tried = True
+            try:
+                stale = (not os.path.exists(self.so)) or (
+                    os.path.getmtime(self.so) < os.path.getmtime(self.src)
+                )
+                if stale and not _build_so(self.src, self.so):
+                    return None
+                lib = ctypes.CDLL(self.so)
+                fn = getattr(lib, self.symbol)
+                fn.restype = self.restype
+                fn.argtypes = self.argtypes
+                self._lib = lib
+            except Exception:
+                self._lib = None
+            return self._lib
+
+
+_c_u32p = ctypes.POINTER(ctypes.c_uint32)
+_c_u64p = ctypes.POINTER(ctypes.c_uint64)
+_c_u8p = ctypes.POINTER(ctypes.c_uint8)
+_c_i64p = ctypes.POINTER(ctypes.c_int64)
+_c_f64p = ctypes.POINTER(ctypes.c_double)
+
+_ZRANGES = _NativeLib(
+    "zranges.cpp",
+    "_zranges.so",
+    "geomesa_zranges",
+    ctypes.c_longlong,
+    [
+        _c_u32p, _c_u32p,  # mins, maxs
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # nboxes, bits, dims
+        ctypes.c_longlong, ctypes.c_int,  # max_ranges, precision
+        _c_u32p, _c_u32p, ctypes.c_int,  # skip_mins, skip_maxs, nskip
+        _c_u64p, _c_u64p, _c_u8p, ctypes.c_longlong,  # out lo/hi/cont, cap
+    ],
+)
+
+_XZRANGES = _NativeLib(
+    "xzranges.cpp",
+    "_xzranges.so",
+    "geomesa_xzranges",
+    ctypes.c_longlong,
+    [
+        _c_f64p, _c_f64p,  # qmins, qmaxs (normalized)
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # nqueries, dims, g
+        ctypes.c_longlong,  # max_ranges
+        _c_i64p, _c_i64p, _c_u8p, ctypes.c_longlong,  # out lo/hi/cont, cap
+    ],
+)
+
+_SEEKSCAN = _NativeLib(
+    "seekscan.cpp",
+    "_seekscan.so",
+    "geomesa_seek_scan",
+    ctypes.c_longlong,
+    [
+        _c_f64p, _c_f64p, _c_i64p,  # x, y, t (t nullable)
+        _c_i64p, _c_i64p, _c_u8p, ctypes.c_longlong,  # starts, ends, covered, nruns
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,  # box
+        ctypes.c_int64, ctypes.c_int64,  # tlo, thi
+        _c_i64p, ctypes.c_longlong,  # out_rows, cap
+    ],
+)
 
 
 def load():
-    """The ctypes lib, building if needed; None when unavailable/disabled."""
-    global _lib, _tried
-    if os.environ.get("GEOMESA_TPU_NO_NATIVE"):
-        return None
-    with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        try:
-            stale = (not os.path.exists(_SO)) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-            )
-            if stale and not _build():
-                return None
-            lib = ctypes.CDLL(_SO)
-            fn = lib.geomesa_zranges
-            fn.restype = ctypes.c_longlong
-            fn.argtypes = [
-                ctypes.POINTER(ctypes.c_uint32),
-                ctypes.POINTER(ctypes.c_uint32),
-                ctypes.c_int,
-                ctypes.c_int,
-                ctypes.c_int,
-                ctypes.c_longlong,
-                ctypes.c_int,
-                ctypes.POINTER(ctypes.c_uint32),  # skip_mins (nullable)
-                ctypes.POINTER(ctypes.c_uint32),  # skip_maxs (nullable)
-                ctypes.c_int,  # nskip
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_longlong,
-            ]
-            _lib = lib
-        except Exception:
-            _lib = None
-        return _lib
+    """The zranges ctypes lib; None when unavailable/disabled."""
+    return _ZRANGES.load()
+
+
+def load_xz():
+    """The XZ-ranges ctypes lib; None when unavailable/disabled."""
+    return _XZRANGES.load()
 
 
 def load_seek():
-    """The seek-scan ctypes lib, building if needed; None when unavailable."""
-    global _seek_lib, _seek_tried
-    if os.environ.get("GEOMESA_TPU_NO_NATIVE"):
+    """The seek-scan ctypes lib; None when unavailable/disabled."""
+    return _SEEKSCAN.load()
+
+
+def zranges_native(
+    mins,
+    maxs,
+    bits: int,
+    dims: int,
+    max_ranges: Optional[int],
+    precision: int,
+    skip_mins=None,
+    skip_maxs=None,
+):
+    """Native decomposition; returns None when the lib is unavailable.
+
+    Output matches curve.zorder.zranges: list of (lower, upper, contained).
+    """
+    if dims < 1 or dims > 3:
+        return None  # fall back rather than silently answering empty
+    lib = load()
+    if lib is None:
         return None
-    with _lock:
-        if _seek_tried:
-            return _seek_lib
-        _seek_tried = True
-        try:
-            stale = (not os.path.exists(_SEEK_SO)) or (
-                os.path.getmtime(_SEEK_SO) < os.path.getmtime(_SEEK_SRC)
-            )
-            if stale and not _build_so(_SEEK_SRC, _SEEK_SO):
-                return None
-            lib = ctypes.CDLL(_SEEK_SO)
-            fn = lib.geomesa_seek_scan
-            fn.restype = ctypes.c_longlong
-            fn.argtypes = [
-                ctypes.POINTER(ctypes.c_double),  # x
-                ctypes.POINTER(ctypes.c_double),  # y
-                ctypes.POINTER(ctypes.c_int64),  # t (nullable)
-                ctypes.POINTER(ctypes.c_int64),  # starts
-                ctypes.POINTER(ctypes.c_int64),  # ends
-                ctypes.POINTER(ctypes.c_uint8),  # covered
-                ctypes.c_longlong,  # nruns
-                ctypes.c_double,  # xmin
-                ctypes.c_double,  # xmax
-                ctypes.c_double,  # ymin
-                ctypes.c_double,  # ymax
-                ctypes.c_int64,  # tlo
-                ctypes.c_int64,  # thi
-                ctypes.POINTER(ctypes.c_int64),  # out_rows
-                ctypes.c_longlong,  # cap
-            ]
-            _seek_lib = lib
-        except Exception:
-            _seek_lib = None
-        return _seek_lib
+    m = np.ascontiguousarray(np.asarray(mins, dtype=np.uint32).reshape(-1))
+    x = np.ascontiguousarray(np.asarray(maxs, dtype=np.uint32).reshape(-1))
+    nboxes = len(m) // dims
+    null_u32 = _c_u32p()
+    if skip_mins is not None:
+        sm = np.ascontiguousarray(np.asarray(skip_mins, dtype=np.uint32).reshape(-1))
+        sx = np.ascontiguousarray(np.asarray(skip_maxs, dtype=np.uint32).reshape(-1))
+        nskip = len(sm) // dims
+        sm_p = sm.ctypes.data_as(_c_u32p)
+        sx_p = sx.ctypes.data_as(_c_u32p)
+    else:
+        nskip = -1  # legacy contained semantics
+        sm_p = sx_p = null_u32
+    cap = max(4 * (max_ranges or 0), 1 << 16)
+    # a NEGATIVE budget must not collide with the C++ 'unbounded' sentinel:
+    # the Python walk treats it as an exhausted budget (clamp to 0)
+    budget = -1 if max_ranges is None else max(0, int(max_ranges))
+    while True:
+        lo = np.empty(cap, dtype=np.uint64)
+        hi = np.empty(cap, dtype=np.uint64)
+        cont = np.empty(cap, dtype=np.uint8)
+        n = lib.geomesa_zranges(
+            m.ctypes.data_as(_c_u32p),
+            x.ctypes.data_as(_c_u32p),
+            nboxes,
+            bits,
+            dims,
+            budget,
+            precision,
+            sm_p,
+            sx_p,
+            nskip,
+            lo.ctypes.data_as(_c_u64p),
+            hi.ctypes.data_as(_c_u64p),
+            cont.ctypes.data_as(_c_u8p),
+            cap,
+        )
+        if n >= 0:
+            return [(int(lo[i]), int(hi[i]), bool(cont[i])) for i in range(n)]
+        cap = int(-n) + 16
+
+
+def xzranges_native(qmins, qmaxs, dims: int, g: int, max_ranges: Optional[int]):
+    """Native XZ BFS over normalized [0,1] windows; None when unavailable.
+    Output matches _XZSFC.ranges_boxes: [(lower, upper, contained)]."""
+    if dims < 2 or dims > 3 or g < 1 or g > 20:
+        return None  # out of the kernel's domain: use the Python fallback
+    lib = load_xz()
+    if lib is None:
+        return None
+    m = np.ascontiguousarray(np.asarray(qmins, dtype=np.float64).reshape(-1))
+    x = np.ascontiguousarray(np.asarray(qmaxs, dtype=np.float64).reshape(-1))
+    nq = len(m) // dims
+    budget = -1 if max_ranges is None else max(0, int(max_ranges))
+    cap = max(4 * (max_ranges or 0) + (1 << dims) * (g + 1), 1 << 16)
+    while True:
+        lo = np.empty(cap, dtype=np.int64)
+        hi = np.empty(cap, dtype=np.int64)
+        cont = np.empty(cap, dtype=np.uint8)
+        n = lib.geomesa_xzranges(
+            m.ctypes.data_as(_c_f64p),
+            x.ctypes.data_as(_c_f64p),
+            nq,
+            dims,
+            g,
+            budget,
+            lo.ctypes.data_as(_c_i64p),
+            hi.ctypes.data_as(_c_i64p),
+            cont.ctypes.data_as(_c_u8p),
+            cap,
+        )
+        if n >= 0:
+            return [(int(lo[i]), int(hi[i]), bool(cont[i])) for i in range(n)]
+        cap = int(-n) + 16
 
 
 def seek_scan_native(
@@ -154,20 +260,20 @@ def seek_scan_native(
     cv = np.ascontiguousarray(covered, dtype=np.uint8)
     if t is not None:
         ts = np.ascontiguousarray(t, dtype=np.int64)
-        t_p = ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        t_p = ts.ctypes.data_as(_c_i64p)
         lo, hi = int(tlo), int(thi)
     else:
-        t_p = ctypes.POINTER(ctypes.c_int64)()
+        t_p = _c_i64p()
         lo = hi = 0
     cap = int(np.maximum(en - st, 0).sum())
     out = np.empty(max(cap, 1), dtype=np.int64)
     n = lib.geomesa_seek_scan(
-        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-        ys.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        xs.ctypes.data_as(_c_f64p),
+        ys.ctypes.data_as(_c_f64p),
         t_p,
-        st.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        en.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        cv.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        st.ctypes.data_as(_c_i64p),
+        en.ctypes.data_as(_c_i64p),
+        cv.ctypes.data_as(_c_u8p),
         len(st),
         float(box[0]),
         float(box[2]),
@@ -175,68 +281,9 @@ def seek_scan_native(
         float(box[3]),
         lo,
         hi,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(_c_i64p),
         cap,
     )
     if n < 0:
         return None  # cannot happen with an exact cap; fall back anyway
     return out[:n]
-
-
-def zranges_native(
-    mins,
-    maxs,
-    bits: int,
-    dims: int,
-    max_ranges: Optional[int],
-    precision: int,
-    skip_mins=None,
-    skip_maxs=None,
-):
-    """Native decomposition; returns None when the lib is unavailable.
-
-    Output matches curve.zorder.zranges: list of (lower, upper, contained).
-    """
-    lib = load()
-    if lib is None:
-        return None
-    m = np.ascontiguousarray(np.asarray(mins, dtype=np.uint32).reshape(-1))
-    x = np.ascontiguousarray(np.asarray(maxs, dtype=np.uint32).reshape(-1))
-    nboxes = len(m) // dims
-    null_u32 = ctypes.POINTER(ctypes.c_uint32)()
-    if skip_mins is not None:
-        sm = np.ascontiguousarray(np.asarray(skip_mins, dtype=np.uint32).reshape(-1))
-        sx = np.ascontiguousarray(np.asarray(skip_maxs, dtype=np.uint32).reshape(-1))
-        nskip = len(sm) // dims
-        sm_p = sm.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
-        sx_p = sx.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
-    else:
-        nskip = -1  # legacy contained semantics
-        sm_p = sx_p = null_u32
-    cap = max(4 * (max_ranges or 0), 1 << 16)
-    budget = -1 if max_ranges is None else int(max_ranges)
-    while True:
-        lo = np.empty(cap, dtype=np.uint64)
-        hi = np.empty(cap, dtype=np.uint64)
-        cont = np.empty(cap, dtype=np.uint8)
-        n = lib.geomesa_zranges(
-            m.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-            x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-            nboxes,
-            bits,
-            dims,
-            budget,
-            precision,
-            sm_p,
-            sx_p,
-            nskip,
-            lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            cont.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            cap,
-        )
-        if n >= 0:
-            return [
-                (int(lo[i]), int(hi[i]), bool(cont[i])) for i in range(n)
-            ]
-        cap = int(-n) + 16

@@ -63,3 +63,77 @@ def test_native_wired_into_sfc():
     assert [(r.lower, r.upper, r.contained) for r in a] == [
         (r.lower, r.upper, r.contained) for r in b
     ]
+
+
+def test_xzranges_native_matches_python():
+    """The C++ XZ BFS must reproduce the Python walk exactly: same ranges,
+    same flags, same budget behavior, across dims/g/windows."""
+    import os
+
+    import numpy as np
+
+    from geomesa_tpu.curve.xz import XZ2SFC, XZ3SFC
+
+    rng = np.random.default_rng(5)
+    cases = []
+    for _ in range(12):
+        x0 = rng.uniform(-170, 150); y0 = rng.uniform(-80, 60)
+        w = rng.uniform(0.01, 40); h = rng.uniform(0.01, 30)
+        cases.append((x0, y0, x0 + w, y0 + h))
+    for budget in (None, 50, 500):
+        for x0, y0, x1, y1 in cases:
+            sfc = XZ2SFC.for_g(12)
+            native = sfc.ranges([(x0, y0, x1, y1)], max_ranges=budget)
+            os.environ["GEOMESA_TPU_NO_NATIVE"] = "1"
+            try:
+                pure = sfc.ranges([(x0, y0, x1, y1)], max_ranges=budget)
+            finally:
+                del os.environ["GEOMESA_TPU_NO_NATIVE"]
+            assert native == pure, (budget, x0, y0, x1, y1)
+    # xz3 (octs + time dim)
+    sfc3 = XZ3SFC.for_period(12, "week")
+    q = [(-20.0, -10.0, 100000.0, 30.0, 25.0, 400000.0)]
+    native = sfc3.ranges(q, max_ranges=200)
+    os.environ["GEOMESA_TPU_NO_NATIVE"] = "1"
+    try:
+        pure = sfc3.ranges(q, max_ranges=200)
+    finally:
+        del os.environ["GEOMESA_TPU_NO_NATIVE"]
+    assert native == pure
+
+
+def test_xzranges_out_of_domain_falls_back_to_python():
+    """g > 20 is outside the native kernel's domain: the wrapper must
+    decline (None) so the Python walk answers — not return an empty plan."""
+    from geomesa_tpu.curve.xz import XZ2SFC
+    from geomesa_tpu.native import xzranges_native
+
+    assert xzranges_native([[0.1, 0.1]], [[0.2, 0.2]], 2, 21, 50) is None
+    sfc = XZ2SFC.for_g(21)
+    assert len(sfc.ranges([(-10.0, -10.0, 10.0, 10.0)], max_ranges=50)) > 0
+
+
+def test_ranges_nonpositive_budget_parity():
+    """A zero/negative budget means 'exhausted' on the Python paths; the
+    native wrappers must not map it to the C++ unbounded sentinel."""
+    import os
+
+    from geomesa_tpu.curve.xz import XZ2SFC
+    from geomesa_tpu.curve.zorder import zranges
+
+    for budget in (0, -1):
+        native = zranges([(3, 2)], [(200, 180)], bits=8, dims=2, max_ranges=budget)
+        os.environ["GEOMESA_TPU_NO_NATIVE"] = "1"
+        try:
+            pure = zranges([(3, 2)], [(200, 180)], bits=8, dims=2, max_ranges=budget)
+        finally:
+            del os.environ["GEOMESA_TPU_NO_NATIVE"]
+        assert native == pure, budget
+        sfc = XZ2SFC.for_g(12)
+        nx = sfc.ranges([(0.0, 0.0, 20.0, 15.0)], max_ranges=budget)
+        os.environ["GEOMESA_TPU_NO_NATIVE"] = "1"
+        try:
+            px = sfc.ranges([(0.0, 0.0, 20.0, 15.0)], max_ranges=budget)
+        finally:
+            del os.environ["GEOMESA_TPU_NO_NATIVE"]
+        assert nx == px, budget
