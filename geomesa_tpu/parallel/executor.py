@@ -1348,7 +1348,21 @@ class TpuScanExecutor:
         path, mirroring the reference's loose-bbox point semantics
         (index/z2/Z2Index.scala:26-40); pass {"exact": True} in the density
         hint to force the host path.
+
+        GEOMESA_DENSITY_DEVICE: auto (accelerators only, default) | 1 | 0 —
+        on the CPU backend the fused full-scan has no advantage over the
+        host seek + bincount path, so auto declines there.
         """
+        import os
+
+        mode = os.environ.get("GEOMESA_DENSITY_DEVICE", "auto")
+        if mode == "0":
+            return None
+        if mode != "1" and jax.default_backend() == "cpu":
+            # cost choice (like GEOMESA_KNN_DEVICE): the fused kernel full-
+            # scans every resident row — free on an accelerator, while the
+            # CPU backend's host path seeks candidates and bincounts them
+            return None
         if table.index.name not in ("z2", "z3") or not self.supports(table, plan):
             return None
         if plan.secondary is not None or spec.get("weight") or spec.get("exact"):

@@ -18,6 +18,13 @@ SPEC = "actor:String,val:Double,dtg:Date,*geom:Point:srid=4326"
 CQL = "bbox(geom, -20, -20, 20, 20) AND dtg DURING 2026-01-02T00:00:00Z/2026-01-12T00:00:00Z"
 
 
+@pytest.fixture(autouse=True)
+def _force_device_density(monkeypatch):
+    # 'auto' routes density to the host seek path on the CPU backend;
+    # these tests exercise the DEVICE fused kernel, so force it on
+    monkeypatch.setenv("GEOMESA_DENSITY_DEVICE", "1")
+
+
 def _fill(store, n=5000, seed=11):
     rng = np.random.default_rng(seed)
     ft = parse_spec("agg", SPEC)

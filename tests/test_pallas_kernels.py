@@ -150,6 +150,9 @@ def test_spmd_pallas_store_parity(monkeypatch):
 
 def test_spmd_pallas_density_parity(monkeypatch):
     monkeypatch.setenv("GEOMESA_PALLAS", "spmd")
+    # the auto gate routes density to the host path on CPU backends —
+    # force the device fused kernel this test exists to cover
+    monkeypatch.setenv("GEOMESA_DENSITY_DEVICE", "1")
     from geomesa_tpu.geom.base import Point
     from geomesa_tpu.index.planner import Query
     from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
