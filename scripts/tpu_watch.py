@@ -1,0 +1,93 @@
+"""Watch for the axon TPU tunnel to come up; run the hardware batch once.
+
+Probes in a killable subprocess every PERIOD seconds (the in-process claim
+can hang indefinitely). On the first healthy probe it runs, sequentially:
+
+  1. bench.py                      (headline, N=20M, seek path)
+  2. GEOMESA_SEEK=0 bench.py smoke (device exact path + compiled Pallas)
+  3. bench_suite.py                (configs #2-#5; kNN takes device top-k)
+
+Everything appends to the log-path positional argument (default
+/tmp/tpu_watch.log); each bench's JSON line is echoed verbatim. Exits
+after one batch (rerun to re-arm).
+Never run a second TPU-claiming process while this is active — concurrent
+axon claims deadlock each other.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERIOD = int(os.environ.get("TPU_WATCH_PERIOD", 600))
+DEADLINE = time.monotonic() + float(os.environ.get("TPU_WATCH_MAX_S", 8 * 3600))
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_watch.log"
+
+
+def log(msg):
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(OUT, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s=45) -> bool:
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print('OK', d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return p.returncode == 0 and "OK tpu" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run(cmd, env_extra=None, timeout_s=1800):
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    log(f"run: {' '.join(cmd)} env={env_extra or {}}")
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, env=env, cwd=REPO)
+        for line in p.stdout.strip().splitlines():
+            log(f"  out: {line}")
+        for line in p.stderr.strip().splitlines()[-6:]:
+            log(f"  err: {line}")
+        log(f"  rc={p.returncode}")
+    except subprocess.TimeoutExpired as e:
+        # keep whatever output made it out before the hang — the bench
+        # emits its JSON line before teardown, which is what matters
+        for src_ in (e.stdout, e.stderr):
+            if src_:
+                text = src_.decode() if isinstance(src_, bytes) else src_
+                for line in text.strip().splitlines()[-10:]:
+                    log(f"  partial: {line}")
+        log("  TIMEOUT")
+
+
+def main():
+    log(f"watching for TPU (period {PERIOD}s)")
+    while time.monotonic() < DEADLINE:
+        if probe():
+            log("TPU UP — running hardware batch")
+            run([sys.executable, "bench.py"],
+                {"GEOMESA_BENCH_CLAIM_TIMEOUT": "60", "GEOMESA_BENCH_CLAIM_RETRIES": "1"},
+                timeout_s=3000)
+            run([sys.executable, "bench.py"],
+                {"GEOMESA_SEEK": "0", "GEOMESA_BENCH_SMOKE": "1",
+                 "GEOMESA_BENCH_CLAIM_TIMEOUT": "60", "GEOMESA_BENCH_CLAIM_RETRIES": "1"},
+                timeout_s=1200)
+            run([sys.executable, "bench_suite.py"],
+                {"GEOMESA_BENCH_CLAIM_TIMEOUT": "60", "GEOMESA_BENCH_CLAIM_RETRIES": "1"},
+                timeout_s=3000)
+            log("hardware batch complete")
+            return
+        time.sleep(PERIOD)
+    log("gave up waiting for the TPU")
+
+
+if __name__ == "__main__":
+    main()

@@ -89,3 +89,81 @@ def test_fuzz_parity_host_vs_device(monkeypatch, exact_mode):
     tpu.delete_features("t", victims)
     for q in queries[:10]:
         assert sorted(tpu.query("t", q).fids) == sorted(host.query("t", q).fids), q
+
+
+@pytest.mark.parametrize(
+    "seek,no_native", [("auto", ""), ("auto", "1"), ("1", ""), ("0", "")]
+)
+def test_fuzz_parity_seek_modes(monkeypatch, seek, no_native):
+    """The seek chooser, covered-split, native kernel and device paths must
+    all agree with the host oracle across the random corpus."""
+    monkeypatch.setenv("GEOMESA_SEEK", seek)
+    if no_native:
+        monkeypatch.setenv("GEOMESA_TPU_NO_NATIVE", no_native)
+    else:
+        # an ambient debugging toggle would silently downgrade the native
+        # parametrizations to the Python fallback
+        monkeypatch.delenv("GEOMESA_TPU_NO_NATIVE", raising=False)
+    rng = np.random.default_rng(77)
+    rows = _data(rng, 1500)
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    for s in (host, tpu):
+        s.create_schema(parse_spec("t", SPEC))
+        with s.writer("t") as w:
+            for fid, name, age, t, x, y in rows:
+                w.write([name, age, t, Point(x, y)], fid=fid)
+    extra = [
+        "IN ('f3','f77','f500','nope') AND bbox(geom, -60, -40, 30, 20)",
+        "name LIKE 'n1%' AND bbox(geom, -60, -40, 30, 20)",
+        "dtg AFTER 2026-01-05T12:00:00Z AND bbox(geom, -30, -20, 30, 20)",
+        "dtg BEFORE 2026-01-10T00:00:00Z AND bbox(geom, -30, -20, 30, 20)",
+        "intersects(geom, POLYGON((-40 -30, 20 -30, -10 15, -40 -30)))",
+    ]
+    for q in [_rand_query(rng) for _ in range(20)] + extra:
+        got = sorted(tpu.query("t", q).fids)
+        want = sorted(host.query("t", q).fids)
+        assert got == want, (seek, no_native, q)
+
+
+def test_fuzz_parity_extent_store(monkeypatch):
+    """Polygon (xz2) store: native XZ planning + envelope prescreen +
+    per-row geometry tests vs the host oracle, incl. rect and triangle
+    query geometries and grid-snapped feature boxes."""
+    monkeypatch.delenv("GEOMESA_TPU_NO_NATIVE", raising=False)
+    from geomesa_tpu.geom.base import Polygon
+
+    rng = np.random.default_rng(99)
+    feats = []
+    for i in range(900):
+        if i % 3 == 0:
+            x0 = float(rng.integers(-6, 6) * 10.0)
+            y0 = float(rng.integers(-4, 4) * 10.0)
+        else:
+            x0 = float(rng.uniform(-60, 55))
+            y0 = float(rng.uniform(-40, 35))
+        w = float(rng.uniform(0.01, 8.0))
+        feats.append((f"w{i}", Polygon(
+            [[x0, y0], [x0 + w, y0], [x0 + w, y0 + w], [x0, y0 + w], [x0, y0]]
+        )))
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    for s in (host, tpu):
+        s.create_schema(parse_spec("w", "*geom:Polygon:srid=4326"))
+        with s.writer("w") as wtr:
+            for fid, p in feats:
+                wtr.write([p], fid=fid)
+    queries = []
+    for _ in range(12):
+        x0 = float(rng.integers(-6, 4) * 10.0) if rng.random() < 0.5 else float(rng.uniform(-55, 25))
+        y0 = float(rng.integers(-4, 2) * 10.0) if rng.random() < 0.5 else float(rng.uniform(-35, 15))
+        w = float(rng.uniform(3, 35))
+        queries.append(f"bbox(geom, {x0!r}, {y0!r}, {x0 + w!r}, {y0 + w!r})")
+        queries.append(
+            f"intersects(geom, POLYGON(({x0!r} {y0!r}, {x0 + w!r} {y0!r}, "
+            f"{x0!r} {y0 + w!r}, {x0!r} {y0!r})))"
+        )
+    for q in queries:
+        got = sorted(tpu.query("w", q).fids)
+        want = sorted(host.query("w", q).fids)
+        assert got == want, q
