@@ -25,18 +25,44 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _lock = threading.Lock()
 
 
-def _build_so(src: str, so: str) -> bool:
+def _host_tag() -> str:
+    """Short fingerprint of this host's CPU (machine + ISA flags): cached
+    .so files carry it in their name so a kernel built with -march=native
+    on one host is never CDLL-loaded on a different CPU (SIGILL)."""
+    import hashlib
+    import platform
+
+    sig = platform.machine()
     try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", so + ".tmp", src],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        os.replace(so + ".tmp", so)
-        return True
-    except Exception:
-        return False
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    sig += line
+                    break
+    except OSError:
+        pass
+    return hashlib.md5(sig.encode()).hexdigest()[:10]
+
+
+def _build_so(src: str, so: str) -> bool:
+    # lazy JIT compile for THIS host (the host tag in `so` keys the cache):
+    # -march=native lets the seek-scan loop vectorize; retry plain -O2 only
+    # for compile errors — a missing g++ or a timeout fails the same way
+    for flags in (["-O3", "-march=native"], ["-O2"]):
+        try:
+            subprocess.run(
+                ["g++", *flags, "-shared", "-fPIC", "-o", so + ".tmp", src],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(so + ".tmp", so)
+            return True
+        except subprocess.CalledProcessError:
+            continue
+        except Exception:
+            return False
+    return False
 
 
 class _NativeLib:
@@ -46,7 +72,8 @@ class _NativeLib:
 
     def __init__(self, src: str, so: str, symbol: str, restype, argtypes):
         self.src = os.path.join(_DIR, src)
-        self.so = os.path.join(_DIR, so)
+        base, ext = os.path.splitext(so)
+        self.so = os.path.join(_DIR, f"{base}.{_host_tag()}{ext}")
         self.symbol = symbol
         self.restype = restype
         self.argtypes = argtypes
