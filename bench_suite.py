@@ -53,7 +53,7 @@ def bench_z2(n, reps):
     ds = _store()
     ft = parse_spec("gps", "*geom:Point:srid=4326")
     ds.create_schema(ft)
-    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    fids = np.char.add("f", np.arange(n).astype(f"<U{len(str(n - 1))}"))
     ds._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y})
     box = (-10.0, -5.0, 15.0, 12.0)
     want = np.flatnonzero((x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3]))
@@ -128,7 +128,7 @@ def bench_attr_bbox(n, reps):
     ds = _store()
     ft = parse_spec("gdelt", "actor1:String:index=true,dtg:Date,*geom:Point:srid=4326")
     ds.create_schema(ft)
-    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    fids = np.char.add("f", np.arange(n).astype(f"<U{len(str(n - 1))}"))
     ds._insert_columns(
         ft, {"__fid__": fids, "actor1": actors, "geom__x": x, "geom__y": y, "dtg": t}
     )
@@ -167,7 +167,7 @@ def bench_knn(n, reps):
     ds = _store()
     ft = parse_spec("pts", "dtg:Date,*geom:Point:srid=4326")
     ds.create_schema(ft)
-    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    fids = np.char.add("f", np.arange(n).astype(f"<U{len(str(n - 1))}"))
     ds._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t})
     qx, qy, k = 2.35, 48.85, 10
 

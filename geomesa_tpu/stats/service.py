@@ -83,15 +83,15 @@ class MetadataBackedStats(GeoMesaStats):
         if geom is not None and geom.type == AttributeType.POINT:
             stats["lon"] = Histogram(geom.name + "__x", _HIST_BINS, -180.0, 180.0)
             stats["lat"] = Histogram(geom.name + "__y", _HIST_BINS, -90.0, 90.0)
-            stats["minmax:lon"] = MinMax(geom.name + "__x")
-            stats["minmax:lat"] = MinMax(geom.name + "__y")
+            stats["minmax:lon"] = MinMax(geom.name + "__x", track_cardinality=False)
+            stats["minmax:lat"] = MinMax(geom.name + "__y", track_cardinality=False)
         dtg = ft.default_date
         if dtg is not None:
             # ms-epoch histogram over 2000..2040 (clamped ends catch outliers)
             lo = np.datetime64("2000-01-01", "ms").astype(np.int64)
             hi = np.datetime64("2040-01-01", "ms").astype(np.int64)
             stats["dtg"] = Histogram(dtg.name, _HIST_BINS, float(lo), float(hi))
-            stats["minmax:dtg"] = MinMax(dtg.name)
+            stats["minmax:dtg"] = MinMax(dtg.name, track_cardinality=False)
         if geom is not None and dtg is not None and geom.type == AttributeType.POINT:
             stats["z3"] = Z3HistogramStat(geom.name, dtg.name, ft.z3_interval.value)
         for a in ft.attributes:

@@ -110,11 +110,16 @@ class MinMax(Stat):
     kind = "minmax"
     _P = 12  # registers = 4096
 
-    def __init__(self, attribute: str, dtype: str = "f8"):
+    def __init__(self, attribute: str, dtype: str = "f8", track_cardinality: bool = True):
         self.attribute = attribute
         self.dtype = dtype
         self.min: Optional[Any] = None
         self.max: Optional[Any] = None
+        # bounds-only mode skips the per-row hash+HLL update — used for the
+        # lon/lat/dtg role stats, whose cardinality nothing consumes
+        # (spatial/temporal selectivity comes from histograms); ingest-time
+        # hashing of every coordinate was ~10% of a 20M-row batch
+        self.track_cardinality = track_cardinality
         self.registers = np.zeros(1 << self._P, dtype=np.int8)
 
     def observe(self, values, nulls=None):
@@ -125,9 +130,11 @@ class MinMax(Stat):
             vmin, vmax = min(values), max(values)
         else:
             vmin, vmax = values.min(), values.max()
-        h = _hash64(values)
         self.min = vmin if self.min is None else min(self.min, vmin)
         self.max = vmax if self.max is None else max(self.max, vmax)
+        if not self.track_cardinality:
+            return
+        h = _hash64(values)
         idx = (h >> np.uint64(64 - self._P)).astype(np.int64)
         rho = (
             np.clip(_leading_zeros_53(h << np.uint64(self._P)), 0, 64 - self._P) + 1
@@ -163,6 +170,7 @@ class MinMax(Stat):
             "dtype": self.dtype,
             "min": mn,
             "max": mx,
+            "track_cardinality": self.track_cardinality,
             "registers": self.registers.tolist(),
         }
 
@@ -664,7 +672,11 @@ def _from_state(d: Dict[str, Any]) -> Stat:
     if kind == "count":
         return CountStat(d["count"])
     if kind == "minmax":
-        s = MinMax(d["attribute"], d.get("dtype", "f8"))
+        s = MinMax(
+            d["attribute"],
+            d.get("dtype", "f8"),
+            track_cardinality=d.get("track_cardinality", True),
+        )
         s.min, s.max = d["min"], d["max"]
         s.registers = np.asarray(d["registers"], dtype=np.int8)
         return s

@@ -26,7 +26,12 @@ def columns_from_features(ft: FeatureType, features: Sequence[Feature]) -> Colum
     """Row features -> columnar arrays per the evaluate.py conventions."""
     n = len(features)
     out: Columns = {}
-    out["__fid__"] = np.array([f.fid for f in features], dtype=object)
+    # dtype inferred: all-str fids become fixed-width unicode directly
+    # (U-array gathers are memcpy; see intern_fids), mixed/None stay object
+    fid_list = [f.fid for f in features]
+    out["__fid__"] = np.array(fid_list) if n and all(
+        type(v) is str for v in fid_list
+    ) else np.array(fid_list, dtype=object)
     vis = [
         (f.user_data or {}).get("visibility") if f.user_data is not None else None
         for f in features
