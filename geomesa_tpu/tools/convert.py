@@ -133,6 +133,11 @@ def _fn_date(fmt: str, v: Any) -> int:
     return int(dt.timestamp() * 1000)
 
 
+_FN_ISO_DATETIME = lambda v: _fn_date("ISO", v)  # noqa: E731
+_FN_MILLIS = lambda v: None if v in (None, "") else int(float(v))  # noqa: E731
+_FN_SECS_TO_MILLIS = lambda v: None if v in (None, "") else int(float(v) * 1000)  # noqa: E731
+
+
 def _fn_md5(v) -> Optional[str]:
     import hashlib
 
@@ -154,6 +159,14 @@ _FUNCTIONS: Dict[str, Callable] = {
     "uppercase": lambda v: None if v is None else str(v).upper(),
     "concat": lambda *a: "".join("" if x is None else str(x) for x in a),
     "date": _fn_date,
+    # reference Transformers.scala date aliases: datetime/isodatetime parse
+    # ISO-8601, isodate the compact yyyyMMdd form, millisToDate/secsToDate
+    # epoch numbers (each behavior defined once; aliases share the lambda)
+    "datetime": _FN_ISO_DATETIME,
+    "isodatetime": _FN_ISO_DATETIME,
+    "isodate": lambda v: _fn_date("yyyyMMdd", v) if v not in (None, "") and "-" not in str(v) else _fn_date("ISO", v),
+    "millistodate": _FN_MILLIS,
+    "secstodate": _FN_SECS_TO_MILLIS,
     "datetomillis": lambda v: None if v is None else int(v),
     "point": lambda x, y: None if x in (None, "") or y in (None, "") else Point(float(x), float(y)),
     "geometry": lambda v: None if v in (None, "") else (v if not isinstance(v, str) else parse_wkt(v)),

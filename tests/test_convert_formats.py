@@ -251,3 +251,20 @@ def test_enrichment_cache_lookup(tmp_path):
     )
     feats = list(conv.convert(io.StringIO("FR,1.0,2.0\nUS,3.0,4.0\nXX,5.0,6.0\n")))
     assert [f.values[0] for f in feats] == ["France", "United States", None]
+
+
+def test_reference_date_function_aliases():
+    """Transformers.scala date-function names must work: datetime/isodatetime
+    (ISO-8601), isodate (compact), millisToDate/secsToDate (epoch numbers)."""
+    from geomesa_tpu.tools.convert import _FUNCTIONS
+
+    iso = "2026-01-03T10:00:00Z"
+    want = 1767434400000
+    assert _FUNCTIONS["datetime"](iso) == want
+    assert _FUNCTIONS["isodatetime"](iso) == want
+    assert _FUNCTIONS["isodate"]("20260103") == 1767398400000
+    assert _FUNCTIONS["isodate"]("2026-01-03") == 1767398400000
+    assert _FUNCTIONS["millistodate"]("1767434400000") == want
+    assert _FUNCTIONS["secstodate"]("1767434400") == want
+    for f in ("datetime", "isodatetime", "isodate", "millistodate", "secstodate"):
+        assert _FUNCTIONS[f]("") is None and _FUNCTIONS[f](None) is None
