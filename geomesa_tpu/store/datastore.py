@@ -301,9 +301,10 @@ class TpuDataStore:
         return FeatureWriter(self, self.get_schema(name), flush_size or self.flush_size)
 
     def _insert_columns(self, ft: FeatureType, columns: Columns, observe_stats: bool = True):
-        from geomesa_tpu.store.blocks import intern_fids
+        from geomesa_tpu.store.blocks import intern_fids, intern_string_columns
 
-        columns = intern_fids(columns)  # once per batch, not per index table
+        # once per batch, not per index table
+        columns = intern_string_columns(ft, intern_fids(columns))
         for table in self._tables[ft.name].values():
             table.insert(columns)
         if observe_stats and self.stats is not None:
@@ -970,8 +971,9 @@ def _apply_query_options(ft: FeatureType, query: Query, columns: Columns) -> Col
 
 
 def _invert_order(col: np.ndarray) -> np.ndarray:
-    if col.dtype == object:
-        # rank-invert for objects
+    if col.dtype == object or col.dtype.kind in "US":
+        # rank-invert for objects and (interned) strings — numpy has no
+        # 'negative' loop for either
         order = np.argsort(col, kind="stable")
         ranks = np.empty(len(col), dtype=np.int64)
         ranks[order] = np.arange(len(col))

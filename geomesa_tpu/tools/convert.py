@@ -133,6 +133,8 @@ def _fn_date(fmt: str, v: Any) -> int:
     return int(dt.timestamp() * 1000)
 
 
+# single definitions shared by every alias key in _FUNCTIONS (datetime/
+# isodatetime; millisToDate/toInt/toLong; secsToDate/secsToMillis)
 _FN_ISO_DATETIME = lambda v: _fn_date("ISO", v)  # noqa: E731
 _FN_MILLIS = lambda v: None if v in (None, "") else int(float(v))  # noqa: E731
 _FN_SECS_TO_MILLIS = lambda v: None if v in (None, "") else int(float(v) * 1000)  # noqa: E731
@@ -148,8 +150,8 @@ def _fn_md5(v) -> Optional[str]:
 
 
 _FUNCTIONS: Dict[str, Callable] = {
-    "toint": lambda v: None if v in (None, "") else int(float(v)),
-    "tolong": lambda v: None if v in (None, "") else int(float(v)),
+    "toint": _FN_MILLIS,
+    "tolong": _FN_MILLIS,
     "todouble": lambda v: None if v in (None, "") else float(v),
     "tostring": lambda v: None if v is None else str(v),
     "toboolean": lambda v: None if v in (None, "") else str(v).strip().lower() in ("true", "1", "t", "yes"),
@@ -191,7 +193,7 @@ _FUNCTIONS: Dict[str, Callable] = {
     "stringtofloat": lambda v, d=None: d if v in (None, "") else float(v),
     "stringtoboolean": lambda v, d=None: d if v in (None, "") else str(v).strip().lower() in ("true", "1", "t", "yes"),
     "now": lambda: int(__import__("time").time() * 1000),
-    "secstomillis": lambda v: None if v in (None, "") else int(float(v) * 1000),
+    "secstomillis": _FN_SECS_TO_MILLIS,
     "millistosecs": lambda v: None if v in (None, "") else int(float(v) // 1000),
 }
 

@@ -261,3 +261,37 @@ class TestNonPointGeometries:
         got = set(tpu.query("pt", cql).fids.astype(str))
         want = set(mem.query("pt", cql).fids.astype(str))
         assert got == want
+
+
+def test_interned_string_columns_null_vs_empty():
+    """STRING columns intern to fixed-width unicode + __null mask; a null
+    value and a genuine empty string must stay distinguishable through
+    queries and feature materialization."""
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    s.create_schema(parse_spec("t", "name:String,*geom:Point:srid=4326"))
+    with s.writer("t") as w:
+        w.write(["alpha", Point(1, 1)], fid="a")
+        w.write([None, Point(2, 2)], fid="b")
+        w.write(["", Point(3, 3)], fid="c")
+    table = next(iter(s._tables["t"].values()))
+    col = table.blocks[0].columns["name"]
+    assert col.dtype.kind == "U", col.dtype  # interned
+    assert sorted(s.query("t", "name = ''").fids) == ["c"]  # null excluded
+    assert sorted(s.query("t", "name IS NULL").fids) == ["b"]
+    assert sorted(s.query("t", "name = 'alpha'").fids) == ["a"]
+    feats = {f.fid: f.values[0] for f in s.query("t", "INCLUDE").to_features()}
+    assert feats["a"] == "alpha" and feats["b"] is None and feats["c"] == ""
+
+
+def test_descending_sort_on_string_attribute():
+    from geomesa_tpu.index.planner import Query
+
+    s = TpuDataStore()
+    s.create_schema(parse_spec("t", "name:String,*geom:Point:srid=4326"))
+    with s.writer("t") as w:
+        for i, nm in enumerate(["b", "c", "a"]):
+            w.write([nm, Point(i, i)], fid=f"f{i}")
+    r = s.query("t", Query.cql("INCLUDE", sort_by=[("name", False)]))
+    assert list(r.columns["name"]) == ["c", "b", "a"]
