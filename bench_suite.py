@@ -79,7 +79,6 @@ def bench_xz2(n, reps):
     from geomesa_tpu.geom.base import Polygon
     from geomesa_tpu.schema.featuretype import parse_spec
 
-    n = min(n, 200_000)  # polygon synthesis is host-side
     rng = np.random.default_rng(6)
     cx = rng.uniform(-170, 170, n)
     cy = rng.uniform(-80, 80, n)
@@ -87,13 +86,14 @@ def bench_xz2(n, reps):
     ds = _store()
     ft = parse_spec("ways", "*geom:Polygon:srid=4326")
     ds.create_schema(ft)
-    with ds.writer("ways") as wtr:
-        for i in range(n):
-            x0, y0, ww = cx[i], cy[i], w[i]
-            wtr.write(
-                [Polygon([[x0, y0], [x0 + ww, y0], [x0 + ww, y0 + ww], [x0, y0 + ww], [x0, y0]])],
-                fid=f"w{i}",
-            )
+    geoms = np.empty(n, dtype=object)
+    for i in range(n):  # geometry OBJECTS are per-row; ingest is columnar
+        x0, y0, ww = cx[i], cy[i], w[i]
+        geoms[i] = Polygon(
+            [[x0, y0], [x0 + ww, y0], [x0 + ww, y0 + ww], [x0, y0 + ww], [x0, y0]]
+        )
+    fids = np.char.add("w", np.arange(n).astype(f"<U{len(str(n - 1))}"))
+    ds._insert_columns(ft, {"__fid__": fids, "geom": geoms})
     box = (0.0, 0.0, 20.0, 15.0)
     hit = (cx + w >= box[0]) & (cx <= box[2]) & (cy + w >= box[1]) & (cy <= box[3])
     cql = f"bbox(geom, {box[0]}, {box[1]}, {box[2]}, {box[3]})"

@@ -109,7 +109,20 @@ def intern_string_columns(ft: FeatureType, columns: Columns) -> Columns:
         col = columns.get(a.name)
         if col is None or col.dtype != object or not len(col):
             continue
-        if not all(v is None or type(v) is str for v in col):
+        ok = True
+        maxlen = 0
+        for v in col:
+            if v is None:
+                continue
+            if type(v) is not str:
+                ok = False
+                break
+            if len(v) > maxlen:
+                maxlen = len(v)
+        # width cap: fixed-width storage is 4B/char for EVERY row, so one
+        # long outlier would multiply the whole column's memory (a 1000-char
+        # value makes a 1M-row column ~4GB) — leave such columns object
+        if not ok or maxlen > 128:
             continue
         nulls = np.array([v is None for v in col], dtype=bool)
         interned = np.where(nulls, "", col).astype(np.str_)
@@ -374,8 +387,16 @@ class FeatureBlock:
             and r.upper_inclusive
             for r in ranges
         ):
-            los = np.asarray([r.lower for r in ranges], dtype=sub.dtype)
-            his = np.asarray([r.upper for r in ranges], dtype=sub.dtype)
+            if sub.dtype.kind in "US":
+                # natural promotion: forcing dtype=sub.dtype would TRUNCATE
+                # literals longer than the block's fixed string width and
+                # match the truncated prefix (wrong rows, and contained
+                # equality ranges skip the post-filter)
+                los = np.asarray([r.lower for r in ranges])
+                his = np.asarray([r.upper for r in ranges])
+            else:
+                los = np.asarray([r.lower for r in ranges], dtype=sub.dtype)
+                his = np.asarray([r.upper for r in ranges], dtype=sub.dtype)
             starts = np.searchsorted(sub, los, side="left").astype(np.int64) + s
             ends = np.searchsorted(sub, his, side="right").astype(np.int64) + s
             flags = np.asarray([r.contained for r in ranges], dtype=bool)

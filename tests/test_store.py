@@ -295,3 +295,30 @@ def test_descending_sort_on_string_attribute():
             w.write([nm, Point(i, i)], fid=f"f{i}")
     r = s.query("t", Query.cql("INCLUDE", sort_by=[("name", False)]))
     assert list(r.columns["name"]) == ["c", "b", "a"]
+
+
+def test_attr_equality_literal_longer_than_interned_width():
+    """A query literal longer than the block's fixed string width must not
+    be truncated by the seek (wrong rows with the post-filter skipped)."""
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    s.create_schema(parse_spec("t", "name:String:index=true,*geom:Point:srid=4326"))
+    with s.writer("t") as w:
+        w.write(["ab", Point(1, 1)], fid="a")
+        w.write(["cd", Point(2, 2)], fid="b")
+    assert list(s.query("t", "name = 'abcde'").fids) == []
+    assert list(s.query("t", "name = 'ab'").fids) == ["a"]
+    assert sorted(s.query("t", "name >= 'ab' AND name <= 'cdz'").fids) == ["a", "b"]
+    assert sorted(s.query("t", "name >= 'abx'").fids) == ["b"]
+
+
+def test_long_string_outlier_stays_object_dtype():
+    s = TpuDataStore()
+    s.create_schema(parse_spec("t", "d:String,*geom:Point:srid=4326"))
+    with s.writer("t") as w:
+        w.write(["x" * 5000, Point(0, 0)], fid="big")
+        w.write(["small", Point(1, 1)], fid="s")
+    table = next(iter(s._tables["t"].values()))
+    assert table.blocks[0].columns["d"].dtype == object
+    assert sorted(s.query("t", "d = 'small'").fids) == ["s"]
