@@ -150,6 +150,20 @@ _SEEKSCAN = _NativeLib(
     ],
 )
 
+_ENVSCAN = _NativeLib(
+    "seekscan.cpp",
+    "_seekscan.so",
+    "geomesa_env_seek_scan",
+    ctypes.c_longlong,
+    [
+        _c_f64p, _c_f64p, _c_f64p, _c_f64p,  # bxmin, bymin, bxmax, bymax
+        _c_i64p, _c_i64p, ctypes.c_longlong,  # starts, ends, nruns
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,  # query box
+        ctypes.c_int,  # rect_query
+        _c_i64p, _c_u8p, ctypes.c_longlong,  # out_rows, out_decided, cap
+    ],
+)
+
 
 def load():
     """The zranges ctypes lib; None when unavailable/disabled."""
@@ -164,6 +178,51 @@ def load_xz():
 def load_seek():
     """The seek-scan ctypes lib; None when unavailable/disabled."""
     return _SEEKSCAN.load()
+
+
+def load_env_seek():
+    """The extent (envelope) seek-scan lib; None when unavailable."""
+    return _ENVSCAN.load()
+
+
+def env_seek_scan_native(
+    bxmin, bymin, bxmax, bymax, starts, ends, qenv, rect_query: bool
+):
+    """Extent candidate filter (see seekscan.cpp geomesa_env_seek_scan);
+    returns (rows, decided_bool) or None when the lib is unavailable.
+    ``qenv`` = (xmin, ymin, xmax, ymax) of the query geometry's envelope."""
+    lib = load_env_seek()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(bxmin, dtype=np.float64)
+    b = np.ascontiguousarray(bymin, dtype=np.float64)
+    c = np.ascontiguousarray(bxmax, dtype=np.float64)
+    d = np.ascontiguousarray(bymax, dtype=np.float64)
+    st = np.ascontiguousarray(starts, dtype=np.int64)
+    en = np.ascontiguousarray(ends, dtype=np.int64)
+    cap = int(np.maximum(en - st, 0).sum())
+    rows = np.empty(max(cap, 1), dtype=np.int64)
+    dec = np.empty(max(cap, 1), dtype=np.uint8)
+    n = lib.geomesa_env_seek_scan(
+        a.ctypes.data_as(_c_f64p),
+        b.ctypes.data_as(_c_f64p),
+        c.ctypes.data_as(_c_f64p),
+        d.ctypes.data_as(_c_f64p),
+        st.ctypes.data_as(_c_i64p),
+        en.ctypes.data_as(_c_i64p),
+        len(st),
+        float(qenv[0]),
+        float(qenv[1]),
+        float(qenv[2]),
+        float(qenv[3]),
+        1 if rect_query else 0,
+        rows.ctypes.data_as(_c_i64p),
+        dec.ctypes.data_as(_c_u8p),
+        cap,
+    )
+    if n < 0:
+        return None  # cannot happen with an exact cap; fall back anyway
+    return rows[:n], dec[:n].astype(bool)
 
 
 def zranges_native(

@@ -61,4 +61,44 @@ long long geomesa_seek_scan(
     return n;
 }
 
+// Extent-feature (XZ) variant: candidate intervals + per-row ENVELOPE
+// columns -> rows whose envelope overlaps the query box, with a parallel
+// flag marking rows DECIDED by envelope math alone. For a rectangle query
+// geometry, a feature envelope strictly inside the box implies intersects
+// (decided=1); the all-zero placeholder envelope (null geometry) and
+// boundary-straddling envelopes stay decided=0 — the caller runs the exact
+// per-row geometry test only on those. Mirrors the vectorized prescreen in
+// filter/evaluate.py::_eval_spatial, one pass, no intermediate gathers.
+//
+// Returns rows written, or -1 if cap insufficient (caller sizes exactly).
+long long geomesa_env_seek_scan(
+    const double* bxmin, const double* bymin,
+    const double* bxmax, const double* bymax,
+    const int64_t* starts, const int64_t* ends, long long nruns,
+    double qxmin, double qymin, double qxmax, double qymax,
+    int rect_query,
+    int64_t* out_rows, uint8_t* out_decided, long long cap) {
+    long long n = 0;
+    for (long long r = 0; r < nruns; ++r) {
+        int64_t s = starts[r];
+        int64_t e = ends[r];
+        if (e <= s) continue;
+        if (n + (e - s) > cap) return -1;
+        for (int64_t i = s; i < e; ++i) {
+            bool overlap = bxmax[i] >= qxmin && bxmin[i] <= qxmax &&
+                           bymax[i] >= qymin && bymin[i] <= qymax;
+            if (!overlap) continue;
+            bool placeholder = bxmin[i] == 0.0 && bymin[i] == 0.0 &&
+                               bxmax[i] == 0.0 && bymax[i] == 0.0;
+            bool inside = rect_query && !placeholder &&
+                          bxmin[i] >= qxmin && bxmax[i] <= qxmax &&
+                          bymin[i] >= qymin && bymax[i] <= qymax;
+            out_rows[n] = i;
+            out_decided[n] = inside ? 1 : 0;
+            ++n;
+        }
+    }
+    return n;
+}
+
 }  // extern "C"

@@ -757,17 +757,74 @@ class _HostSeekScan:
 
     def __iter__(self):
         if self.pred is not None:
-            yield from self._iter_native()
+            if self.pred[0] == "xz":
+                yield from self._iter_native_xz()
+            else:
+                yield from self._iter_native()
             return
         for block, starts, ends, flags in self.per_block:
             rows, covered = self.table.expand_covered(block, starts, ends, flags)
             if len(rows):
                 yield block, rows, covered
 
+    def _iter_native_xz(self):
+        """Extent plans: the C++ envelope kernel decides overlap/inside per
+        candidate row; only the boundary-straddling ring takes the exact
+        per-row geometry test. exact=True — rows ARE the result set."""
+        from geomesa_tpu.filter.evaluate import _geom_predicate
+        from geomesa_tpu.native import env_seek_scan_native
+
+        _, geom, node, qenv, rect = self.pred
+        qbox = (qenv.xmin, qenv.ymin, qenv.xmax, qenv.ymax)
+        for block, starts, ends, flags in self.per_block:
+            bx = block.columns[geom + "__bxmin"]
+            by = block.columns[geom + "__bymin"]
+            cx = block.columns[geom + "__bxmax"]
+            cy = block.columns[geom + "__bymax"]
+            got = env_seek_scan_native(bx, by, cx, cy, starts, ends, qbox, rect)
+            if got is None:
+                # lib raced away: same semantics via the shared vectorized
+                # prescreen in _eval_spatial (no third copy of the rules)
+                from geomesa_tpu.filter.evaluate import _eval_spatial
+
+                cand, _cov = self.table.expand_covered(block, starts, ends, flags)
+                if not len(cand):
+                    continue
+                sub = {
+                    geom: block.columns[geom][cand],
+                    geom + "__bxmin": bx[cand],
+                    geom + "__bymin": by[cand],
+                    geom + "__bxmax": cx[cand],
+                    geom + "__bymax": cy[cand],
+                }
+                final = cand[_eval_spatial(node, self.table.ft, sub)]
+                if len(final):  # expand_covered already stripped tombstones
+                    yield block, final
+                continue
+            rows, decided = got
+            if not len(rows):
+                continue
+            ring = rows[~decided]
+            if len(ring):
+                col = block.columns[geom]
+                keep = np.fromiter(
+                    (g is not None and _geom_predicate(node, g) for g in col[ring]),
+                    bool,
+                    len(ring),
+                )
+                final = np.sort(np.concatenate([rows[decided], ring[keep]]))
+            else:
+                final = rows[decided]
+            keepmask = self.table.tombstone_keep(block, final)
+            if keepmask is not None:
+                final = final[keepmask]
+            if len(final):
+                yield block, final
+
     def _iter_native(self):
         from geomesa_tpu.native import seek_scan_native
 
-        geom, dtg, box, t_lo, t_hi, use_covered = self.pred
+        _z, geom, dtg, box, t_lo, t_hi, use_covered = self.pred
         for block, starts, ends, flags in self.per_block:
             if not use_covered:
                 flags = np.zeros(len(starts), dtype=bool)
@@ -945,7 +1002,10 @@ class TpuScanExecutor:
             frac = float(os.environ.get("GEOMESA_SEEK_FRAC", "0.4"))
             if total > frac * nrows:
                 return None
-        return _HostSeekScan(table, per_block, self._native_seek_pred(table, plan))
+        pred = self._native_seek_pred(table, plan)
+        if pred is None:
+            pred = self._xz_native_pred(table, plan)
+        return _HostSeekScan(table, per_block, pred)
 
     def _native_seek_pred(self, table: IndexTable, plan):
         """(geom, dtg, box, t_lo, t_hi, use_covered) for the one-pass
@@ -987,6 +1047,7 @@ class TpuScanExecutor:
             if any(b.has_nulls(dtg) for b in table.blocks):
                 return None
         return (
+            "z",
             ft.default_geometry.name,
             dtg,
             (xmin, ymin, xmax, ymax),
@@ -994,6 +1055,44 @@ class TpuScanExecutor:
             t_hi,
             use_covered,
         )
+
+    def _xz_native_pred(self, table: IndexTable, plan):
+        """("xz", geom, node, qenv, rect) for the extent envelope kernel
+        when the FULL filter is exactly one spatial predicate on the
+        default geometry of an xz2 plan and the blocks carry envelope
+        companion columns; None otherwise.
+
+        Only a SINGLE spatial node qualifies: an AND of two bboxes is NOT
+        equivalent to one test against their envelope intersection for
+        extent features (a geometry can straddle both boxes yet miss the
+        intersection)."""
+        if table.index.name != "xz2" or plan.secondary is not None:
+            return None
+        f = plan.full_filter
+        if f is None:
+            return None
+        from geomesa_tpu.filter import ast as A
+
+        ft = table.ft
+        geom = ft.default_geometry.name
+        if isinstance(f, A.BBox) and f.prop == geom:
+            node, qenv, rect = f, f.envelope, True
+        elif isinstance(f, A.Intersects) and f.prop == geom:
+            g = f.geometry
+            node, qenv = f, g.envelope
+            rect = hasattr(g, "is_rectangle") and g.is_rectangle()
+        else:
+            return None
+        blocks = table.blocks
+        if not blocks or any(
+            geom + "__bxmin" not in b.columns for b in blocks
+        ):
+            return None  # legacy blocks without envelope companions
+        from geomesa_tpu.native import load_env_seek
+
+        if load_env_seek() is None:
+            return None
+        return ("xz", geom, node, qenv, rect)
 
     def _residual_shape(self, table: IndexTable, plan):
         """Box(+window) shape of a value-exact plan's residual secondary.

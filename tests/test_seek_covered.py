@@ -378,3 +378,40 @@ def test_native_residual_no_duplicates_with_overlapping_attr_ranges(monkeypatch)
         if "t1" <= tag <= "t5" and -30 <= x <= 30 and -30 <= y <= 30
     )
     assert sorted(fids) == want and len(want) > 0
+
+
+def test_xz_native_envelope_kernel_selected_and_parity(monkeypatch):
+    """Single-bbox extent plans route through the C++ envelope kernel
+    (exact=True); AND-of-two-bboxes must NOT (not reducible to one box
+    for extent features). Parity vs the no-native path either way."""
+    from geomesa_tpu.geom.base import Polygon
+
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    s.create_schema(parse_spec("w", "*geom:Polygon:srid=4326"))
+    rng = np.random.default_rng(12)
+    with s.writer("w") as w:
+        for i in range(3000):
+            x0 = float(rng.uniform(-60, 55)); y0 = float(rng.uniform(-40, 35))
+            ww = float(rng.uniform(0.01, 5))
+            w.write(
+                [Polygon([[x0, y0], [x0 + ww, y0], [x0 + ww, y0 + ww], [x0, y0 + ww], [x0, y0]])],
+                fid=f"w{i}",
+            )
+    single = "bbox(geom, -20, -15, 15, 10)"
+    double = "bbox(geom, -20, -15, 15, 10) AND bbox(geom, -10, -10, 30, 20)"
+    plan1 = s._plan_cached("w", s._as_query(single))
+    table = s._tables["w"][plan1.index.name]
+    scan1 = s.executor.scan_candidates(table, plan1)
+    if scan1 is None or getattr(scan1, "pred", None) is None:
+        pytest.skip("native env kernel unavailable")
+    assert scan1.pred[0] == "xz" and scan1.exact
+    plan2 = s._plan_cached("w", s._as_query(double))
+    scan2 = s.executor.scan_candidates(table, plan2)
+    if scan2 is not None and hasattr(scan2, "pred"):
+        assert scan2.pred is None, "AND of boxes must not take the env kernel"
+    for cql in (single, double):
+        native = sorted(s.query("w", cql).fids)
+        monkeypatch.setenv("GEOMESA_TPU_NO_NATIVE", "1")
+        fallback = sorted(s.query("w", cql).fids)
+        monkeypatch.delenv("GEOMESA_TPU_NO_NATIVE")
+        assert native == fallback and len(native) > 0, cql
