@@ -18,6 +18,7 @@ from geomesa_tpu.curve.binnedtime import TimePeriod
 from geomesa_tpu.curve.normalized import NormalizedLat, NormalizedLon, NormalizedTime
 from geomesa_tpu.curve.zorder import (
     IndexRange,
+    zranges_arrays,
     z2_decode,
     z2_encode,
     z3_decode,
@@ -79,6 +80,24 @@ class Z2SFC:
         whose cell lies inside the interior provably satisfies the raw f64
         bbox predicate, so scans may skip the post-filter for those ranges.
         """
+        args = self._range_inputs(xy, exact_skip)
+        return zranges(*args[:2], self.precision, 2, max_ranges, precision,
+                       skip_mins=args[2], skip_maxs=args[3])
+
+    def ranges_arrays(
+        self,
+        xy: Sequence[Tuple[float, float, float, float]],
+        precision: int = 64,
+        max_ranges: Optional[int] = None,
+        exact_skip: bool = False,
+    ):
+        """(lower[], upper[], contained[]) arrays via the C++ BFS, or None
+        when the native lib is unavailable (callers use :meth:`ranges`)."""
+        args = self._range_inputs(xy, exact_skip)
+        return zranges_arrays(*args[:2], self.precision, 2, max_ranges, precision,
+                              skip_mins=args[2], skip_maxs=args[3])
+
+    def _range_inputs(self, xy, exact_skip: bool):
         mins, maxs = [], []
         skip_mins: List[List[int]] = []
         skip_maxs: List[List[int]] = []
@@ -94,15 +113,11 @@ class Z2SFC:
             if exact_skip and nx0 + 1 <= nx1 - 1 and ny0 + 1 <= ny1 - 1:
                 skip_mins.append([nx0 + 1, ny0 + 1])
                 skip_maxs.append([nx1 - 1, ny1 - 1])
-        return zranges(
+        return (
             mins,
             maxs,
-            self.precision,
-            2,
-            max_ranges,
-            precision,
-            skip_mins=skip_mins if exact_skip else None,
-            skip_maxs=skip_maxs if exact_skip else None,
+            skip_mins if exact_skip else None,
+            skip_maxs if exact_skip else None,
         )
 
 
@@ -193,6 +208,28 @@ class Z3SFC:
         # one normalized unit per side guards the normalize() floor; the
         # extra margin guards the ms -> offset-unit floor when normalized
         # units are finer than offset units (e.g. week: 2^21 bins / 604800s)
+        args = self._range_inputs(xy, t, exact_skip)
+        return zranges(*args[:2], self.precision, 3, max_ranges, precision,
+                       skip_mins=args[2], skip_maxs=args[3])
+
+    def ranges_arrays(
+        self,
+        xy: Sequence[Tuple[float, float, float, float]],
+        t: Sequence[Tuple[int, int]],
+        precision: int = 64,
+        max_ranges: Optional[int] = None,
+        exact_skip: bool = False,
+    ):
+        """(lower[], upper[], contained[]) arrays via the C++ BFS, or None
+        when the native lib is unavailable (callers use :meth:`ranges`)."""
+        args = self._range_inputs(xy, t, exact_skip)
+        return zranges_arrays(*args[:2], self.precision, 3, max_ranges, precision,
+                              skip_mins=args[2], skip_maxs=args[3])
+
+    def _range_inputs(self, xy, t, exact_skip: bool):
+        # one normalized unit per side guards the normalize() floor; the
+        # extra margin guards the ms -> offset-unit floor when normalized
+        # units are finer than offset units (e.g. week: 2^21 bins / 604800s)
         t_margin = 1 + int(np.ceil(self.time.bins / (self.time.max - self.time.min)))
         mins, maxs = [], []
         skip_mins: List[List[int]] = []
@@ -220,13 +257,9 @@ class Z3SFC:
                 ):
                     skip_mins.append([nx0 + 1, ny0 + 1, nt0 + t_margin])
                     skip_maxs.append([nx1 - 1, ny1 - 1, nt1 - t_margin])
-        return zranges(
+        return (
             mins,
             maxs,
-            self.precision,
-            3,
-            max_ranges,
-            precision,
-            skip_mins=skip_mins if exact_skip else None,
-            skip_maxs=skip_maxs if exact_skip else None,
+            skip_mins if exact_skip else None,
+            skip_maxs if exact_skip else None,
         )

@@ -157,6 +157,29 @@ def merge_ranges(ranges: List[IndexRange]) -> List[IndexRange]:
     return merged
 
 
+def zranges_arrays(
+    mins,
+    maxs,
+    bits: int,
+    dims: int,
+    max_ranges: Optional[int] = None,
+    precision: int = 64,
+    skip_mins=None,
+    skip_maxs=None,
+):
+    """Array-form decomposition (lower[], upper[], contained[]) via the C++
+    BFS; None when the native lib is unavailable (callers fall back to the
+    tuple-based Python walk in :func:`zranges`)."""
+    try:
+        from geomesa_tpu.native import zranges_native
+
+        return zranges_native(
+            mins, maxs, bits, dims, max_ranges, precision, skip_mins, skip_maxs
+        )
+    except Exception:
+        return None
+
+
 def zranges(
     mins: Sequence[Sequence[int]],
     maxs: Sequence[Sequence[int]],
@@ -192,6 +215,16 @@ def zranges(
         still classifies against the regular boxes. Without skip boxes the
         flag keeps the legacy cell-in-box meaning.
     """
+    arrays = zranges_arrays(
+        mins, maxs, bits, dims, max_ranges, precision, skip_mins, skip_maxs
+    )
+    if arrays is not None:
+        lo, hi, cont = arrays
+        return [
+            IndexRange(l, h, c)
+            for l, h, c in zip(lo.tolist(), hi.tolist(), cont.tolist())
+        ]
+
     boxes = [
         (tuple(int(v) for v in lo), tuple(int(v) for v in hi))
         for lo, hi in zip(mins, maxs)
@@ -206,19 +239,6 @@ def zranges(
             for lo, hi in zip(skip_mins, skip_maxs)
         ]
     )
-
-    # latency-critical planning path: prefer the C++ BFS (geomesa_tpu.native,
-    # same semantics, ~30x faster); fall back to the Python walk below
-    try:
-        from geomesa_tpu.native import zranges_native
-
-        native = zranges_native(
-            mins, maxs, bits, dims, max_ranges, precision, skip_mins, skip_maxs
-        )
-        if native is not None:
-            return [IndexRange(lo, hi, c) for lo, hi, c in native]
-    except Exception:
-        pass
 
     max_level = min(bits, max(1, precision // dims))
 

@@ -14,8 +14,9 @@ Key columns convention (consumed by geomesa_tpu.store.blocks):
 
 from __future__ import annotations
 
+from collections.abc import Sequence
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
 
 import numpy as np
 
@@ -65,6 +66,35 @@ class ScanRange(NamedTuple):
     lower_inclusive: bool = True
     upper_inclusive: bool = True
     tiebreak_ranges: Optional[List[Tuple[int, int]]] = None
+
+
+class RangeSet(Sequence):
+    """Array-backed scan ranges for z2/z3 plans (closed-inclusive numeric
+    keys, no tiebreaks): the planning/seek hot path carries four arrays
+    instead of thousands of ScanRange tuples. ``__getitem__`` materializes
+    a ScanRange for code that inspects ranges individually (explain,
+    planner coverage checks, tests)."""
+
+    __slots__ = ("bins", "lower", "upper", "contained")
+
+    def __init__(self, bins, lower, upper, contained):
+        self.bins = np.asarray(bins, dtype=np.int64)
+        self.lower = np.asarray(lower)
+        self.upper = np.asarray(upper)
+        self.contained = np.asarray(contained, dtype=bool)
+
+    def __len__(self):
+        return len(self.lower)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        return ScanRange(
+            int(self.bins[i]),
+            int(self.lower[i]),
+            int(self.upper[i]),
+            bool(self.contained[i]),
+        )
 
 
 @dataclass
@@ -222,6 +252,21 @@ def times_by_bin(
     return out
 
 
+def _group_arrays(sfc, boxes, window, per_group, skip):
+    """(lower[], upper[], contained[]) for one decomposition group: the C++
+    BFS arrays when available, else the Python tuple walk converted — ONE
+    code path feeds the RangeSet either way. ``window`` None = 2D (Z2)."""
+    targs = () if window is None else ([window],)
+    arrs = sfc.ranges_arrays(boxes, *targs, max_ranges=per_group, exact_skip=skip)
+    if arrs is not None:
+        return arrs
+    rs = sfc.ranges(boxes, *targs, max_ranges=per_group, exact_skip=skip)
+    lo = np.array([r.lower for r in rs], dtype=np.uint64)
+    hi = np.array([r.upper for r in rs], dtype=np.uint64)
+    cont = np.array([r.contained for r in rs], dtype=bool)
+    return lo, hi, cont
+
+
 class Z3KeySpace(IndexKeySpace):
     """Point + time index: key = (2-byte bin, 63-bit z3)
     (Z3IndexKeySpace.scala, indexKeyLength=10)."""
@@ -260,32 +305,37 @@ class Z3KeySpace(IndexKeySpace):
 
     def get_ranges(
         self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
-    ) -> List[ScanRange]:
+    ) -> "Union[RangeSet, List[ScanRange]]":
         if values.disjoint:
             return []
         sfc = self.sfc(ft)
         boxes = _boxes(values)
         mo = max_offset(ft.z3_interval)
-        out: List[ScanRange] = []
         # whole-period bins share one decomposition (Z3IndexKeySpace.scala:129-135)
         whole = [b for b, w in values.bins.items() if w == (0, mo)]
         partial = {b: w for b, w in values.bins.items() if w != (0, mo)}
         n_groups = (1 if whole else 0) + len(partial)
         per_group = max(1, _ranges_target(max_ranges) // max(1, n_groups))
         skip = _exact_skip_ok(values)
+        # one decomposition per group, array-form (native BFS when present,
+        # tuple walk converted otherwise) -> a single RangeSet either way
+        parts = []
         if whole:
-            ranges = sfc.ranges(boxes, [(0, mo)], max_ranges=per_group, exact_skip=skip)
+            lo_a, hi_a, cont_a = _group_arrays(sfc, boxes, (0, mo), per_group, skip)
             for b in sorted(whole):
-                out.extend(
-                    ScanRange(b, r.lower, r.upper, r.contained and skip)
-                    for r in ranges
-                )
+                parts.append((np.full(len(lo_a), b, dtype=np.int64), lo_a, hi_a, cont_a))
         for b, (lo, hi) in sorted(partial.items()):
-            ranges = sfc.ranges(boxes, [(lo, hi)], max_ranges=per_group, exact_skip=skip)
-            out.extend(
-                ScanRange(b, r.lower, r.upper, r.contained and skip) for r in ranges
-            )
-        return out
+            lo_a, hi_a, cont_a = _group_arrays(sfc, boxes, (lo, hi), per_group, skip)
+            parts.append((np.full(len(lo_a), b, dtype=np.int64), lo_a, hi_a, cont_a))
+        if not parts:
+            return []
+        bins_c = np.concatenate([p[0] for p in parts])
+        lo_c = np.concatenate([p[1] for p in parts])
+        hi_c = np.concatenate([p[2] for p in parts])
+        cont_c = np.concatenate([p[3] for p in parts])
+        return RangeSet(
+            bins_c, lo_c, hi_c, cont_c if skip else np.zeros(len(lo_c), bool)
+        )
 
 
 class Z2KeySpace(IndexKeySpace):
@@ -313,14 +363,19 @@ class Z2KeySpace(IndexKeySpace):
 
     def get_ranges(
         self, ft: FeatureType, values: IndexValues, max_ranges: Optional[int] = None
-    ) -> List[ScanRange]:
+    ) -> "Union[RangeSet, List[ScanRange]]":
         if values.disjoint:
             return []
         skip = _exact_skip_ok(values)
-        ranges = self._sfc.ranges(
-            _boxes(values), max_ranges=_ranges_target(max_ranges), exact_skip=skip
+        lo_a, hi_a, cont_a = _group_arrays(
+            self._sfc, _boxes(values), None, _ranges_target(max_ranges), skip
         )
-        return [ScanRange(0, r.lower, r.upper, r.contained and skip) for r in ranges]
+        return RangeSet(
+            np.zeros(len(lo_a), dtype=np.int64),
+            lo_a,
+            hi_a,
+            cont_a if skip else np.zeros(len(lo_a), bool),
+        )
 
 
 class XZ2KeySpace(IndexKeySpace):

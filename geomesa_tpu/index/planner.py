@@ -226,7 +226,11 @@ class QueryPlanner:
         # admit raw doubles just outside the query box -- so those always
         # keep the filter unless the user opts into loose-bbox semantics
         # (Z2Index.scala:26-40 loose-bbox decision).
-        all_contained = bool(ranges) and all(r.contained for r in ranges)
+        cont_arr = getattr(ranges, "contained", None)  # RangeSet fast path
+        all_contained = bool(len(ranges)) and (
+            bool(cont_arr.all()) if cont_arr is not None
+            else all(r.contained for r in ranges)
+        )
         exact_value_space = best.index.name == "id" or best.index.name.startswith(
             "attr"
         )

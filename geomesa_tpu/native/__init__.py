@@ -235,10 +235,9 @@ def zranges_native(
     skip_mins=None,
     skip_maxs=None,
 ):
-    """Native decomposition; returns None when the lib is unavailable.
-
-    Output matches curve.zorder.zranges: list of (lower, upper, contained).
-    """
+    """Native decomposition as (lower[], upper[], contained[]) uint64/uint64/
+    bool arrays; None when the lib is unavailable. The array form skips
+    per-range Python tuple construction on the planning hot path."""
     if dims < 1 or dims > 3:
         return None  # fall back rather than silently answering empty
     lib = load()
@@ -282,7 +281,9 @@ def zranges_native(
             cap,
         )
         if n >= 0:
-            return [(int(lo[i]), int(hi[i]), bool(cont[i])) for i in range(n)]
+            # copies: the views' base is the >=64K-entry scratch buffer, and
+            # cached plans would otherwise retain ~1MB per query
+            return lo[:n].copy(), hi[:n].copy(), cont[:n].astype(bool)
         cap = int(-n) + 16
 
 

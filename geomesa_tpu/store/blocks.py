@@ -320,9 +320,17 @@ class FeatureBlock:
         """Row-interval form of ``scan``: (starts, ends[, ), flags) arrays.
         The cheap seek product — callers that only need counts (the
         executor's host-seek cost probe) avoid materializing rows."""
-        if self.n == 0 or not ranges:
+        if self.n == 0 or not len(ranges):
             z = np.empty(0, dtype=np.int64)
             return z, z, np.empty(0, dtype=bool)
+        from geomesa_tpu.index.keyspace import RangeSet
+
+        if (
+            isinstance(ranges, RangeSet)
+            and self.key.dtype != object
+            and self.tiebreak is None
+        ):
+            return self._scan_intervals_arrays(ranges)
         pieces: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         if self.bins is not None:
             by_bin: Dict[int, List[ScanRange]] = {}
@@ -342,6 +350,34 @@ class FeatureBlock:
         ends = np.concatenate([p[1] for p in pieces])
         flags = np.concatenate([p[2] for p in pieces])
         return starts, ends, flags
+
+    def _scan_intervals_arrays(
+        self, rs
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """RangeSet fast path: searchsorted straight off the backing arrays
+        (closed-inclusive numeric ranges; no per-range tuples touched)."""
+        key = self.key
+        lo = rs.lower.astype(key.dtype, copy=False)
+        hi = rs.upper.astype(key.dtype, copy=False)
+        if self.bins is None:
+            starts = np.searchsorted(key, lo, side="left").astype(np.int64)
+            ends = np.searchsorted(key, hi, side="right").astype(np.int64)
+            return starts, ends, rs.contained
+        outs, oute, outf = [], [], []
+        for b in np.unique(rs.bins):
+            sl = self.bin_slices.get(int(b))
+            if sl is None:
+                continue
+            s, e = sl
+            sub = key[s:e]
+            m = rs.bins == b
+            outs.append(np.searchsorted(sub, lo[m], side="left").astype(np.int64) + s)
+            oute.append(np.searchsorted(sub, hi[m], side="right").astype(np.int64) + s)
+            outf.append(rs.contained[m])
+        if not outs:
+            z = np.empty(0, dtype=np.int64)
+            return z, z, np.empty(0, dtype=bool)
+        return np.concatenate(outs), np.concatenate(oute), np.concatenate(outf)
 
     def _slice_intervals(
         self, s: int, e: int, ranges: Sequence[ScanRange]

@@ -37,12 +37,18 @@ def test_native_matches_python(dims, bits, max_ranges):
     mins = [b[0] for b in boxes]
     maxs = [b[1] for b in boxes]
     want = _python_ranges(mins, maxs, bits, dims, max_ranges)
-    got = zranges_native(mins, maxs, bits, dims, max_ranges, 64)
+    got = _as_tuples(zranges_native(mins, maxs, bits, dims, max_ranges, 64))
     assert got == [(r.lower, r.upper, r.contained) for r in want]
 
 
+def _as_tuples(arrays):
+    """zranges_native returns (lower[], upper[], contained[]) arrays."""
+    lo, hi, cont = arrays
+    return list(zip(lo.tolist(), hi.tolist(), cont.tolist()))
+
+
 def test_native_single_cell():
-    got = zranges_native([[5, 5]], [[5, 5]], 8, 2, None, 64)
+    got = _as_tuples(zranges_native([[5, 5]], [[5, 5]], 8, 2, None, 64))
     want = _python_ranges([[5, 5]], [[5, 5]], 8, 2, None)
     assert got == [(r.lower, r.upper, r.contained) for r in want]
     assert len(got) == 1 and got[0][2] is True
