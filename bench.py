@@ -14,7 +14,7 @@ CPU jax backend (labeled "backend": "cpu-fallback") so every round records
 a real features/sec number.
 
 Env knobs:
-  GEOMESA_BENCH_N        rows (default 5_000_000)
+  GEOMESA_BENCH_N        rows (default 20_000_000 on either backend)
   GEOMESA_BENCH_REPS     timed repetitions (default 20)
   GEOMESA_BENCH_SMOKE=1  small fast mode (N=200_000, reps=3)
   GEOMESA_BENCH_CLAIM_TIMEOUT  seconds per TPU-claim probe (default 180)
