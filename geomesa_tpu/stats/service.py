@@ -44,8 +44,12 @@ class GeoMesaStats:
     def get_attribute_bounds(self, ft: FeatureType, attribute: str) -> Optional[Tuple[Any, Any]]:
         raise NotImplementedError
 
-    def observe_columns(self, ft: FeatureType, columns: Dict[str, np.ndarray]) -> None:
-        """Write-time maintenance hook; no-op unless stats are maintained."""
+    def observe_columns(
+        self, ft: FeatureType, columns: Dict[str, np.ndarray], z3_keys=None
+    ) -> None:
+        """Write-time maintenance hook; no-op unless stats are maintained.
+        ``z3_keys``: optional (keys, bins) from a freshly sealed z3 block
+        (see MetadataBackedStats.observe_columns)."""
 
 
 class NoopStats(GeoMesaStats):

@@ -262,8 +262,15 @@ class FeatureBlock:
         return got
 
     @classmethod
-    def build(cls, index: IndexKeySpace, ft: FeatureType, columns: Columns) -> "FeatureBlock":
-        columns = intern_string_columns(ft, intern_fids(columns))
+    def build(
+        cls,
+        index: IndexKeySpace,
+        ft: FeatureType,
+        columns: Columns,
+        interned: bool = False,
+    ) -> "FeatureBlock":
+        if not interned:  # batch-level ingest interns once for all tables
+            columns = intern_string_columns(ft, intern_fids(columns))
         key_cols = index.key_columns(ft, columns)
         key = key_cols["__key__"]
         bins = key_cols.get("__bin__")
@@ -481,10 +488,12 @@ class IndexTable:
     def num_rows(self) -> int:
         return sum(b.n for b in self.blocks)
 
-    def insert(self, columns: Columns):
+    def insert(self, columns: Columns, interned: bool = False):
         if not columns or len(next(iter(columns.values()))) == 0:
             return
-        self.blocks.append(FeatureBlock.build(self.index, self.ft, columns))
+        self.blocks.append(
+            FeatureBlock.build(self.index, self.ft, columns, interned=interned)
+        )
         self.version += 1
 
     def delete(self, fids: Sequence[str]):

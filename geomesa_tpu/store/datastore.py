@@ -306,7 +306,7 @@ class TpuDataStore:
         # once per batch, not per index table
         columns = intern_string_columns(ft, intern_fids(columns))
         for table in self._tables[ft.name].values():
-            table.insert(columns)
+            table.insert(columns, interned=True)
         if observe_stats and self.stats is not None:
             # the z3 block just sealed already encoded every row's key: the
             # Z3 histogram reuses it (row order is irrelevant to counts).

@@ -1,0 +1,83 @@
+"""GDELT-layout end-to-end: the premade converter config ingests the real
+57-column tab-delimited event layout through the bulk path, and BASELINE
+configs #1 (bbox+time) and #4 (attr + bbox) answer with brute-force parity.
+
+The VERDICT #8 shape: real-format rows through the shipped converter into
+columnar blocks, then the headline query semantics against them.
+"""
+
+import numpy as np
+
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.tools.ingest import bulk_ingest
+from geomesa_tpu.tools.premade import GDELT_CONVERTER, GDELT_SFT
+
+
+def _synth_gdelt_tsv(path, n, rng):
+    day = np.datetime64("2026-01-01") + rng.integers(0, 40, n).astype("timedelta64[D]")
+    ymd = np.char.replace(day.astype(str), "-", "")
+    lat = np.round(rng.uniform(-80, 80, n), 4)
+    lon = np.round(rng.uniform(-170, 170, n), 4)
+    actor1 = np.array(["UNITED STATES", "CHINA", "RUSSIA"], dtype=object)[
+        rng.integers(0, 3, n)
+    ]
+    arr = np.empty((n, 57), dtype=object)
+    arr[:] = ""
+    arr[:, 0] = np.arange(n).astype(str)
+    arr[:, 1] = ymd
+    arr[:, 5] = "USA"
+    arr[:, 6] = actor1
+    arr[:, 25] = "1"
+    arr[:, 26] = "010"
+    arr[:, 27] = "01"
+    arr[:, 28] = "01"
+    arr[:, 29] = "1"
+    arr[:, 30] = "1.5"
+    arr[:, 31] = "3"
+    arr[:, 32] = "1"
+    arr[:, 33] = "2"
+    arr[:, 34] = "-1.2"
+    arr[:, 39] = lat.astype(str)
+    arr[:, 40] = lon.astype(str)
+    with open(path, "w") as f:
+        f.write("\n".join("\t".join(r) for r in arr) + "\n")
+    tms = day.astype("datetime64[ms]").astype(np.int64)
+    return lon, lat, tms, actor1
+
+
+def test_gdelt_layout_bulk_ingest_and_baseline_queries(tmp_path):
+    rng = np.random.default_rng(4)
+    n = 20000
+    path = tmp_path / "gdelt.tsv"
+    lon, lat, tms, actor1 = _synth_gdelt_tsv(str(path), n, rng)
+
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    ft = parse_spec("gdelt", GDELT_SFT)
+    store.create_schema(ft)
+    ec = bulk_ingest(store, "gdelt", [str(path)], GDELT_CONVERTER, workers=1)
+    assert ec.success == n and ec.failure == 0
+
+    # config #1: bbox + time window
+    cql = (
+        "bbox(geom, -80, -30, 10, 41) AND "
+        "dtg DURING 2026-01-05T00:00:00Z/2026-01-19T00:00:00Z"
+    )
+    t_lo = np.datetime64("2026-01-05T00:00:00", "ms").astype(np.int64)
+    t_hi = np.datetime64("2026-01-19T00:00:00", "ms").astype(np.int64)
+    want = (
+        (lon >= -80) & (lon <= 10) & (lat >= -30) & (lat <= 41)
+        & (tms > t_lo) & (tms < t_hi)
+    )
+    res = store.query("gdelt", cql)
+    assert len(res) == int(want.sum()) and len(res) > 0
+
+    # config #4: attribute + bbox (interned string equality)
+    cql4 = "actor1Name = 'CHINA' AND bbox(geom, -80, -30, 10, 41)"
+    want4 = (
+        (actor1 == "CHINA")
+        & (lon >= -80) & (lon <= 10) & (lat >= -30) & (lat <= 41)
+    )
+    res4 = store.query("gdelt", cql4)
+    assert len(res4) == int(want4.sum()) and len(res4) > 0

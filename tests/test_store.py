@@ -322,3 +322,17 @@ def test_long_string_outlier_stays_object_dtype():
     table = next(iter(s._tables["t"].values()))
     assert table.blocks[0].columns["d"].dtype == object
     assert sorted(s.query("t", "d = 'small'").fids) == ["s"]
+
+
+def test_noop_stats_store_accepts_writes():
+    """Stores with NoopStats (or any GeoMesaStats subclass using the base
+    observe_columns hook) must accept writes (round-2 regression: the
+    z3_keys kwarg was only added to MetadataBackedStats)."""
+    from geomesa_tpu.stats.service import NoopStats
+
+    s = TpuDataStore(stats=NoopStats())
+    s.create_schema(parse_spec("t", "dtg:Date,*geom:Point:srid=4326"))
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    with s.writer("t") as w:
+        w.write([int(base), Point(1, 1)], fid="a")
+    assert list(s.query("t", "INCLUDE").fids) == ["a"]
