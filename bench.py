@@ -153,14 +153,40 @@ def start_watchdog(deadline_s: float):
     return t
 
 
+# the held tunnel lock must outlive probe_tpu (a local would be GC'd on
+# return, silently releasing the flock mid-claim) — it lives here until
+# process exit, where the OS drops it
+_HELD_LOCK = None
+
+
+def _axon_lock():
+    """The cross-process tunnel mutex (None when this process inherited a
+    held lock from tpu_watch, which serializes the whole batch itself)."""
+    if os.environ.get("GEOMESA_AXON_LOCK_HELD", "") not in ("", "0"):
+        return None
+    try:
+        from geomesa_tpu.utils.axon_lock import AxonLock
+
+        return AxonLock()
+    except Exception:  # noqa: BLE001 - lock is belt+braces, never fatal
+        return None
+
+
 def probe_tpu(timeout_s: int, retries: int) -> bool:
     """Probe the TPU/axon backend in a SUBPROCESS with a hard timeout.
 
     Round 1's bench died because backend init either crashed (rc=1,
     BENCH_r01.json) or hung >9 min on the tunnel claim. A subprocess probe
-    can always be killed, no matter where the child blocks.
+    can always be killed, no matter where the child blocks. Probes hold
+    the axon flock: concurrent claims (e.g. scripts/tpu_watch.py mid-
+    batch) deadlock the tunnel, so a busy lock reads as "TPU busy".
     """
     code = "import jax; d = jax.devices(); print('PROBE-OK', len(d), d[0].platform)"
+    lock = _axon_lock()
+    if lock is not None and not lock.try_acquire(timeout_s=5.0):
+        log("axon lock busy (another claimer active); treating TPU as unavailable")
+        return False
+    ok = False
     for attempt in range(1, retries + 1):
         log(f"TPU probe attempt {attempt}/{retries} (timeout {timeout_s}s)")
         try:
@@ -176,11 +202,21 @@ def probe_tpu(timeout_s: int, retries: int) -> bool:
         if proc is not None:
             if proc.returncode == 0 and "PROBE-OK" in proc.stdout:
                 log(f"probe ok: {proc.stdout.strip().splitlines()[-1]}")
-                return True
+                ok = True
+                break
             log(f"probe failed rc={proc.returncode}: {proc.stderr.strip()[-400:]}")
         if attempt < retries:  # no pointless sleep after the final attempt
             time.sleep(min(10 * attempt, 30))
-    return False
+    # on success KEEP the lock held through the in-process claim + run (the
+    # OS drops flocks at process exit — no leak); on failure release so
+    # other claimers (tpu_watch) can probe
+    global _HELD_LOCK
+    if lock is not None:
+        if ok:
+            _HELD_LOCK = lock
+        else:
+            lock.release()
+    return ok
 
 
 def _pin_cpu() -> None:
@@ -320,6 +356,69 @@ def run(n: int, reps: int, backend: str) -> dict:
     }
 
 
+def attach_hw_capture(payload: dict) -> dict:
+    """When falling back to CPU, attach any committed hardware capture
+    (BENCH_hw.json, written by scripts/tpu_watch.py during a tunnel
+    window) so the round's record still carries the real-TPU numbers."""
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_hw.json")
+        with open(path) as f:
+            hw = json.load(f)
+        payload["hw_capture"] = hw
+    except Exception:  # noqa: BLE001 - absent file is the common case
+        pass
+    return payload
+
+
+def poll_for_tpu_retry(payload, t_start, deadline):
+    """CPU fallback happened: keep polling for a tunnel window for the
+    rest of the deadline budget; if the TPU comes up, rerun the bench on
+    it in a subprocess and return THAT payload instead. The round-2
+    lesson: the tunnel opens in short windows, and a 2x180s probe at the
+    start of the run is a much smaller net than the whole budget."""
+    if os.environ.get("GEOMESA_BENCH_POLL", "1") in ("0",):
+        return payload
+    margin = 120.0  # emit well before the watchdog fires
+    device_budget = 1500.0  # min time a 20M device run needs
+    while True:
+        remaining = deadline - (time.monotonic() - t_start) - margin
+        if remaining < device_budget:
+            return payload
+        if probe_tpu(45, 1):
+            budget = deadline - (time.monotonic() - t_start) - margin
+            log(f"tunnel opened mid-run; device retry ({budget:.0f}s budget)")
+            env = dict(
+                os.environ,
+                GEOMESA_BENCH_POLL="0",
+                GEOMESA_AXON_LOCK_HELD="1",  # we hold the flock
+                GEOMESA_BENCH_CLAIM_TIMEOUT="60",
+                GEOMESA_BENCH_CLAIM_RETRIES="1",
+                GEOMESA_BENCH_DEADLINE=str(int(budget - 30)),
+            )
+            try:
+                proc = subprocess.run(
+                    [sys.executable, __file__],
+                    capture_output=True,
+                    text=True,
+                    timeout=budget,
+                    env=env,
+                )
+                sys.stderr.write(proc.stderr[-4000:])
+                line = next(
+                    (ln for ln in reversed(proc.stdout.strip().splitlines())
+                     if ln.startswith("{")),
+                    "",
+                )
+                got = json.loads(line)
+                if got.get("backend") == "default" and not got.get("error"):
+                    return got
+                log(f"device retry unusable ({got.get('backend')}, {got.get('error')})")
+            except Exception as e:  # noqa: BLE001
+                log(f"device retry failed: {type(e).__name__}: {e}")
+            return payload
+        time.sleep(45)
+
+
 def main():
     smoke = os.environ.get("GEOMESA_BENCH_SMOKE", "") not in ("", "0")
     n = int(os.environ.get("GEOMESA_BENCH_N", 0))
@@ -386,6 +485,10 @@ def main():
                 "error": f"{type(e).__name__}: {e}",
                 "backend": backend,
             }
+    if payload.get("backend") == "cpu-fallback" and not payload.get("error"):
+        payload = poll_for_tpu_retry(payload, t_start, deadline)
+        if payload.get("backend") == "cpu-fallback":
+            payload = attach_hw_capture(payload)
     watchdog.cancel()
     emit_once(payload)
 

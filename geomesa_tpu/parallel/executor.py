@@ -375,7 +375,7 @@ class DeviceSegment:
                     ).astype(np.float64)
                 else:  # legacy blocks: walk the object column
                     e = np.zeros((b.n, 4), dtype=np.float64)
-                    for i, g in enumerate(b.columns[geom]):
+                    for i, g in enumerate(b.full_col(geom)):
                         if g is not None:
                             e[i] = g.envelope.as_tuple()
                 e32 = np.empty((b.n, 4), dtype=np.float32)
@@ -387,7 +387,7 @@ class DeviceSegment:
                 if self.kind == "xz3":
                     bins.append(b.bins.astype(np.int32))
                     _, offs = time_to_binned(
-                        b.columns[ft.default_date.name], ft.xz3_interval
+                        b.full_col(ft.default_date.name), ft.xz3_interval
                     )
                     ts.append(offs.astype(np.int32))
             n += b.n
@@ -408,7 +408,7 @@ class DeviceSegment:
         self._pallas_ok = (self.n_padded // size) % TILE == 0
         self._m = self.n_padded  # pack() pads straight to the bucketed size
         self.fids = np.concatenate(
-            [b.columns["__fid__"] for b in blocks]
+            [b.full_col("__fid__") for b in blocks]
         ) if blocks else np.empty(0, dtype=object)
         self._valid_host = np.ones(n, dtype=bool)
         self.valid = self._pack([self._valid_host], bool, False)
@@ -466,8 +466,8 @@ class DeviceSegment:
         self._raw_loaded = True
         ft = table.ft
         geom = ft.default_geometry.name
-        xfs = [b.columns[geom + "__x"].astype(np.float32) for b in self.blocks]
-        yfs = [b.columns[geom + "__y"].astype(np.float32) for b in self.blocks]
+        xfs = [b.full_col(geom + "__x").astype(np.float32) for b in self.blocks]
+        yfs = [b.full_col(geom + "__y").astype(np.float32) for b in self.blocks]
         self.xf = self._pack(xfs, np.float32, 0.0)
         self.yf = self._pack(yfs, np.float32, 0.0)
         if self.kind == "z3":
@@ -475,7 +475,7 @@ class DeviceSegment:
                 return False
             traw = []
             for b in self.blocks:
-                t_ms = b.columns[ft.default_date.name].astype(np.int64)
+                t_ms = b.full_col(ft.default_date.name).astype(np.int64)
                 starts = binned_to_time(
                     b.bins.astype(np.int64), np.zeros(b.n, np.int64), ft.z3_interval
                 )
@@ -564,24 +564,21 @@ class DeviceSegment:
                 self._pack([lo], np.uint32, np.uint32(0xFFFFFFFF)),
             )
 
-        xs = np.concatenate([b.columns[geom + "__x"] for b in self.blocks])
-        ys = np.concatenate([b.columns[geom + "__y"] for b in self.blocks])
+        xs = np.concatenate([b.full_col(geom + "__x") for b in self.blocks])
+        ys = np.concatenate([b.full_col(geom + "__y") for b in self.blocks])
         self.xk_hi, self.xk_lo = pack_keys(f64_sort_keys(xs))
         self.yk_hi, self.yk_lo = pack_keys(f64_sort_keys(ys))
         if self.kind == "z3":
             dtg = ft.default_date.name
             ts = np.concatenate(
-                [b.columns[dtg].astype(np.int64) for b in self.blocks]
+                [b.full_col(dtg).astype(np.int64) for b in self.blocks]
             )
             self.tk_hi, self.tk_lo = pack_keys(i64_sort_keys(ts))
             # null dates are stored as 0 + a __null mask: the host evaluator
             # rejects them for any temporal predicate, so the exact TEMPORAL
             # mask needs its own valid column (bbox-only queries keep them)
             nulls = np.concatenate(
-                [
-                    b.columns.get(dtg + "__null", np.zeros(b.n, dtype=bool))
-                    for b in self.blocks
-                ]
+                [b.full_col(dtg + "__null") for b in self.blocks]
             )
             self._t_nulls_host = nulls if nulls.any() else None
             if self._t_nulls_host is not None:
@@ -791,7 +788,7 @@ class _HostSeekScan:
                 if not len(cand):
                     continue
                 sub = {
-                    geom: block.columns[geom][cand],
+                    geom: block.gather(geom, cand),
                     geom + "__bxmin": bx[cand],
                     geom + "__bymin": by[cand],
                     geom + "__bxmax": cx[cand],
@@ -806,9 +803,9 @@ class _HostSeekScan:
                 continue
             ring = rows[~decided]
             if len(ring):
-                col = block.columns[geom]
+                geoms = block.gather(geom, ring)
                 keep = np.fromiter(
-                    (g is not None and _geom_predicate(node, g) for g in col[ring]),
+                    (g is not None and _geom_predicate(node, g) for g in geoms),
                     bool,
                     len(ring),
                 )
@@ -825,6 +822,9 @@ class _HostSeekScan:
         from geomesa_tpu.native import seek_scan_native
 
         _z, geom, dtg, box, t_lo, t_hi, use_covered = self.pred
+        want_t = t_lo is not None or t_hi is not None
+        lo = np.iinfo(np.int64).min + 1 if t_lo is None else t_lo
+        hi = np.iinfo(np.int64).max if t_hi is None else t_hi
         for block, starts, ends, flags in self.per_block:
             if not use_covered:
                 flags = np.zeros(len(starts), dtype=bool)
@@ -833,36 +833,48 @@ class _HostSeekScan:
             # shared rows once per interval — merge them first (z ranges
             # arrive merged-disjoint; attr ranges carry no such guarantee)
             starts, ends, flags = _merge_overlapping_intervals(starts, ends, flags)
-            t = None
-            lo = hi = 0
-            if t_lo is not None or t_hi is not None:
-                t = block.columns[dtg]
-                lo = np.iinfo(np.int64).min + 1 if t_lo is None else t_lo
-                hi = np.iinfo(np.int64).max if t_hi is None else t_hi
+            cand = None
+            if geom + "__x" in block.columns:
+                # z-index blocks own contiguous x/y(/t): the kernel streams
+                # candidate intervals straight off the sorted columns
+                xs = block.columns[geom + "__x"]
+                ys = block.columns[geom + "__y"]
+                t = block.columns.get(dtg) if want_t else None
+                kstarts, kends, kflags = starts, ends, flags
+            else:
+                # reduced index blocks (attr/id residual plans): gather the
+                # candidate rows' coords from the record table — O(cands),
+                # and candidates are value-exact so the set is small
+                cand, _cov = self.table.expand_covered(block, starts, ends, flags)
+                if not len(cand):
+                    continue
+                xs = block.gather(geom + "__x", cand)
+                ys = block.gather(geom + "__y", cand)
+                t = block.gather(dtg, cand) if want_t else None
+                kstarts = np.zeros(1, dtype=np.int64)
+                kends = np.full(1, len(cand), dtype=np.int64)
+                kflags = np.zeros(1, dtype=bool)
+            if want_t and t is None:
+                t = block.full_col(dtg)
             rows = seek_scan_native(
-                block.columns[geom + "__x"],
-                block.columns[geom + "__y"],
-                t,
-                starts,
-                ends,
-                flags,
-                box,
-                lo,
-                hi,
+                xs, ys, t, kstarts, kends, kflags, box, lo, hi
             )
             if rows is None:
                 # lib raced away: numpy equivalent of the same exact test
                 # (exact=True promises FILTERED rows — never raw candidates)
-                cand, _cov = self.table.expand_covered(block, starts, ends, flags)
-                if not len(cand):
-                    continue
-                xs = block.columns[geom + "__x"][cand]
-                ys = block.columns[geom + "__y"][cand]
+                if cand is None:
+                    cand, _cov = self.table.expand_covered(block, starts, ends, flags)
+                    if not len(cand):
+                        continue
+                    xs = xs[cand]
+                    ys = ys[cand]
+                    t = t[cand] if t is not None else None
                 m = (xs >= box[0]) & (xs <= box[2]) & (ys >= box[1]) & (ys <= box[3])
-                if t is not None:
-                    tv = t[cand]
-                    m &= (tv >= lo) & (tv <= hi)
+                if want_t:
+                    m &= (t >= lo) & (t <= hi)
                 rows = cand[m]  # expand_covered already stripped tombstones
+            elif cand is not None:
+                rows = cand[rows]  # kernel positions -> block rows
             else:
                 keep = self.table.tombstone_keep(block, rows)
                 if keep is not None:
@@ -968,7 +980,7 @@ class TpuScanExecutor:
 
     @staticmethod
     def _has_visibilities(table: IndexTable) -> bool:
-        return any("__vis__" in b.columns for b in table.blocks)
+        return any(b.has_col("__vis__") for b in table.blocks)
 
     def _seek_scan(self, table: IndexTable, plan) -> Optional[_HostSeekScan]:
         """Cost-based execution choice (the StrategyDecider's cost model

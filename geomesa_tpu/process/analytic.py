@@ -43,7 +43,7 @@ def min_max(store, name: str, attribute: str, cql: str = "INCLUDE", exact: bool 
     else:
         table = next(iter(store._tables[name].values()), None)
         has_vis = table is not None and any(
-            "__vis__" in b.columns for b in table.blocks
+            b.has_col("__vis__") for b in table.blocks
         )
     expiring = getattr(store, "_age_off_cutoff", lambda _ft: None)(ft) is not None
     if not exact and cql == "INCLUDE" and store.stats is not None and not has_vis and not expiring:

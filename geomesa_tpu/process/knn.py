@@ -123,9 +123,9 @@ def _device_knn(store, name: str, ft, x: float, y: float, k: int,
     dists: List[np.ndarray] = []
     seen = set()
     for block, rows in parts:
-        px = block.columns[geom + "__x"][rows]
-        py = block.columns[geom + "__y"][rows]
-        bf = block.columns["__fid__"][rows]
+        px = block.gather(geom + "__x", rows)
+        py = block.gather(geom + "__y", rows)
+        bf = block.gather("__fid__", rows)
         keep = [i for i, f in enumerate(bf) if f not in seen]
         seen.update(bf[keep])
         fids.extend(bf[keep])

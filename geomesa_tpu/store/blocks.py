@@ -10,7 +10,7 @@ device memory by the TPU executor (geomesa_tpu.ops).
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -201,6 +201,58 @@ def concat_columns(parts: Sequence[Columns]) -> Columns:
     return out
 
 
+class RecordBlock:
+    """Full feature columns for ONE write batch, in ingest order.
+
+    The record-table analog (reference stores the full serialized feature
+    once in the record/id table and joins from reduced index tables,
+    geomesa-accumulo .../index/AttributeIndex.scala:42,392 JoinPlan;
+    index/BaseFeatureIndex.scala:49-56): every index's FeatureBlock holds
+    only its key + scan-hot columns plus a ``rowid`` array into this block,
+    so attributes and fids are stored once per batch instead of once per
+    index table."""
+
+    __slots__ = ("columns", "n", "_nulls_memo")
+
+    def __init__(self, columns: Columns):
+        self.columns = columns
+        self.n = len(next(iter(columns.values()))) if columns else 0
+        self._nulls_memo: Dict[str, bool] = {}
+
+    def has_nulls(self, name: str) -> bool:
+        got = self._nulls_memo.get(name)
+        if got is None:
+            col = self.columns.get(name + "__null")
+            got = bool(col.any()) if col is not None else False
+            self._nulls_memo[name] = got
+        return got
+
+
+# scan-hot columns each index family keeps physically sorted in its own
+# blocks (everything else rides in the shared RecordBlock): the native
+# seek-scan kernels (native/seekscan.cpp) and the device mirrors read these
+# sequentially over candidate intervals, so they must stay contiguous in
+# key order. ``{geom}``/``{dtg}`` are substituted per feature type.
+_HOT_COLUMNS = {
+    "z2": ("{geom}__x", "{geom}__y"),
+    "z3": ("{geom}__x", "{geom}__y", "{dtg}", "{dtg}__null"),
+    "xz2": (),  # envelope companions come from key_columns extras
+    "xz3": ("{dtg}", "{dtg}__null"),
+    "id": (),
+    "attr": (),
+}
+
+
+def _hot_names(index: IndexKeySpace, ft: FeatureType) -> Tuple[str, ...]:
+    fam = "attr" if index.name.startswith("attr") else index.name
+    pats = _HOT_COLUMNS.get(fam, ())
+    geom = ft.default_geometry.name if ft.default_geometry is not None else ""
+    dtg = ft.default_date.name if ft.default_date is not None else ""
+    names = (p.format(geom=geom, dtg=dtg) for p in pats)
+    # an unbound role substitutes to "" / "__null": drop those
+    return tuple(n for n in names if n and not n.startswith("__"))
+
+
 class ColumnBuffer:
     """Mutable ingest buffer; seals into a FeatureBlock."""
 
@@ -222,7 +274,15 @@ class ColumnBuffer:
 
 
 class FeatureBlock:
-    """One sealed, key-sorted block of features for one index."""
+    """One sealed, key-sorted block of features for one index.
+
+    ``columns`` holds only this index's OWN (scan-hot) columns, physically
+    sorted in key order; everything else lives once in the shared
+    ``record`` block, addressed through the key-sorted ``rowid`` array
+    (the reference's record-table/join-index layout,
+    index/BaseFeatureIndex.scala:49-56, AttributeIndex.scala:42,392).
+    ``gather`` is the one accessor scan paths use — it hits own columns
+    zero-copy and falls through to a rowid gather otherwise."""
 
     def __init__(
         self,
@@ -231,6 +291,8 @@ class FeatureBlock:
         key: np.ndarray,
         bins: Optional[np.ndarray],
         tiebreak: Optional[np.ndarray] = None,
+        record: Optional[RecordBlock] = None,
+        rowid: Optional[np.ndarray] = None,
     ):
         self.index = index
         self.columns = columns
@@ -238,6 +300,8 @@ class FeatureBlock:
         self.bins = bins
         # secondary z2 sort within equal keys (attribute index only)
         self.tiebreak = tiebreak
+        self.record = record
+        self.rowid = rowid
         self.n = len(key)
         # per-bin row slices (contiguous after the sort)
         self.bin_slices: Dict[int, Tuple[int, int]] = {}
@@ -257,38 +321,111 @@ class FeatureBlock:
         got = self._nulls_memo.get(name)
         if got is None:
             col = self.columns.get(name + "__null")
-            got = bool(col.any()) if col is not None else False
+            if col is not None:
+                got = bool(col.any())
+            elif self.record is not None:
+                # rows here are a subset of the record's (valid filter), so
+                # the record's memoized answer is a safe over-approximation
+                got = self.record.has_nulls(name)
+            else:
+                got = False
             self._nulls_memo[name] = got
         return got
+
+    def has_col(self, k: str) -> bool:
+        return k in self.columns or (
+            self.record is not None and k in self.record.columns
+        )
+
+    def all_keys(self) -> set:
+        keys = set(self.columns)
+        if self.record is not None:
+            keys.update(self.record.columns)
+        return keys
+
+    def gather(
+        self,
+        k: str,
+        rows: np.ndarray,
+        record_rows: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Column values at block-local ``rows``: own columns directly,
+        record columns through the rowid mapping; a missing ``__null``
+        companion means "no nulls" and materializes as zeros.
+
+        Callers reading SEVERAL record-backed columns for the same rows
+        should pass ``record_rows=self.rowid[rows]`` (computed once) —
+        this is the single column-resolution rule; don't reimplement the
+        own -> record -> __null fallthrough elsewhere."""
+        col = self.columns.get(k)
+        if col is not None:
+            return col[rows]
+        if self.record is not None:
+            col = self.record.columns.get(k)
+            if col is not None:
+                if record_rows is None:
+                    record_rows = self.rowid[rows]
+                return col[record_rows]
+        if k.endswith("__null"):
+            return np.zeros(len(rows), dtype=bool)
+        raise KeyError(k)
+
+    def full_col(self, k: str) -> np.ndarray:
+        """Whole column in this block's key order (own zero-copy, record
+        via one full gather — used by device mirror packing)."""
+        col = self.columns.get(k)
+        if col is not None:
+            return col
+        if self.record is not None:
+            col = self.record.columns.get(k)
+            if col is not None:
+                return col[self.rowid]
+        if k.endswith("__null"):
+            return np.zeros(self.n, dtype=bool)
+        raise KeyError(k)
+
+    def record_part(self, rows: np.ndarray) -> Tuple[object, np.ndarray]:
+        """(record block, record rows) for result assembly: downstream
+        consumers (LazyColumns) read full feature columns from the record
+        table, never from the reduced index block."""
+        if self.record is None:
+            return self, rows
+        return self.record, self.rowid[rows]
 
     @classmethod
     def build(
         cls,
         index: IndexKeySpace,
         ft: FeatureType,
-        columns: Columns,
+        columns: Union[Columns, RecordBlock],
         interned: bool = False,
     ) -> "FeatureBlock":
-        if not interned:  # batch-level ingest interns once for all tables
-            columns = intern_string_columns(ft, intern_fids(columns))
-        key_cols = index.key_columns(ft, columns)
+        if isinstance(columns, RecordBlock):
+            record = columns
+        else:
+            if not interned:  # batch-level ingest interns once for all tables
+                columns = intern_string_columns(ft, intern_fids(columns))
+            record = RecordBlock(columns)
+        key_cols = index.key_columns(ft, record.columns)
         key = key_cols["__key__"]
         bins = key_cols.get("__bin__")
         valid = key_cols.get("__valid__")
         tiebreak = key_cols.get("__tiebreak__")
-        extra = {
+        own: Columns = {
             k: v
             for k, v in key_cols.items()
             if k not in ("__key__", "__bin__", "__valid__", "__tiebreak__")
-        }
-        if extra:
-            # derived companion columns (e.g. XZ geometry envelopes) ride
-            # along row-aligned and get sorted with everything else
-            columns = {**columns, **extra}
+        }  # derived companions (e.g. XZ envelopes) stay with the index
+        for name in _hot_names(index, ft):
+            col = record.columns.get(name)
+            if col is not None and name not in own:
+                own[name] = col
+        rowid = np.arange(record.n, dtype=np.int64)
         if valid is not None and not valid.all():
             rows = np.where(valid)[0]
-            columns = take_rows(columns, rows)
+            own = take_rows(own, rows)
             key = key[rows]
+            rowid = rowid[rows]
             if bins is not None:
                 bins = bins[rows]
             if tiebreak is not None:
@@ -304,8 +441,8 @@ class FeatureBlock:
         else:
             order = np.argsort(key, kind="stable")
         key = key[order]
-        sorted_cols = take_rows(columns, order)
-        return cls(index, sorted_cols, key, bins, tiebreak)
+        sorted_cols = take_rows(own, order)
+        return cls(index, sorted_cols, key, bins, tiebreak, record, rowid[order])
 
     def scan(self, ranges: Sequence[ScanRange]) -> np.ndarray:
         """Row indices whose keys fall in any range (sorted, deduped)."""
@@ -491,9 +628,17 @@ class IndexTable:
     def insert(self, columns: Columns, interned: bool = False):
         if not columns or len(next(iter(columns.values()))) == 0:
             return
-        self.blocks.append(
-            FeatureBlock.build(self.index, self.ft, columns, interned=interned)
-        )
+        if not interned:
+            columns = intern_string_columns(self.ft, intern_fids(columns))
+        self.insert_record(RecordBlock(columns))
+
+    def insert_record(self, record: RecordBlock):
+        """Seal one key-sorted block referencing a (possibly shared)
+        record block — the datastore passes ONE RecordBlock per write
+        batch to every index table."""
+        if record.n == 0:
+            return
+        self.blocks.append(FeatureBlock.build(self.index, self.ft, record))
         self.version += 1
 
     def delete(self, fids: Sequence[str]):
@@ -544,7 +689,7 @@ class IndexTable:
         (plain, covered, native seek) goes through."""
         if not self.tombstones or not len(rows):
             return None
-        fids = b.columns["__fid__"][rows]
+        fids = b.gather("__fid__", rows)
         keep = ~np.isin(fids, list(self.tombstones))
         return None if keep.all() else keep
 
@@ -552,16 +697,40 @@ class IndexTable:
         keep = self.tombstone_keep(b, rows)
         return rows if keep is None else rows[keep]
 
-    def compact(self):
-        """Merge all blocks into one (dropping tombstoned rows)."""
-        if len(self.blocks) <= 1 and not self.tombstones:
-            return
-        parts = []
-        for b, rows in self.scan_all():
-            parts.append(take_rows(b.columns, rows))
-        merged = concat_columns(parts)
+    def compact(self, record: Optional[RecordBlock] = None):
+        """Merge all blocks into one (dropping tombstoned rows).
+
+        With ``record`` given, rebuild against that pre-merged shared
+        record block (the datastore compacts all of a type's tables
+        against ONE merged record); otherwise merge this table's own
+        record parts."""
+        if record is None:
+            if len(self.blocks) <= 1 and not self.tombstones:
+                return
+            parts = []
+            for b, rows in self.scan_all():
+                rb, rr = b.record_part(rows)
+                parts.append(take_rows(rb.columns, rr))
+            record = RecordBlock(concat_columns(parts))
         self.blocks = []
         self.tombstones = set()
         self.version += 1
-        if merged:
-            self.insert(merged)
+        self.insert_record(record)
+
+    def merged_record(self) -> RecordBlock:
+        """Live rows of every record block, tombstones dropped, in record
+        order — the input to a store-level shared compaction."""
+        parts = []
+        seen = set()
+        for b in self.blocks:
+            rb, rows = b.record_part(np.arange(b.n, dtype=np.int64))
+            if id(rb) in seen:
+                continue
+            seen.add(id(rb))
+            rows = np.arange(getattr(rb, "n", len(rows)), dtype=np.int64)
+            if self.tombstones:
+                fids = rb.columns["__fid__"]
+                rows = rows[~np.isin(fids, list(self.tombstones))]
+            if len(rows):
+                parts.append(take_rows(rb.columns, rows))
+        return RecordBlock(concat_columns(parts))

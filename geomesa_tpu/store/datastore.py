@@ -52,32 +52,50 @@ class LazyColumns(Mapping):
     A fid-only parity stream or a count never pays for attribute gathers;
     the CPU-reference comparison (index arrays) stays apples-to-apples.
 
+    Parts hold INDEX-block rows; columns resolve own (key-sorted, near-
+    sequential) block columns first and fall through to the shared record
+    block via the block's rowid mapping, computed lazily ONCE per part
+    (the join against the record table, AttributeIndex JoinPlan analog) —
+    a count or a fid-free stream never pays it.
+
     Read-only Mapping; ``materialize()`` returns a plain dict for code
     paths that mutate or re-order columns (sort/limit/sampling/dedupe)."""
 
-    __slots__ = ("_parts", "_keys", "_cache", "num_rows")
+    __slots__ = ("_parts", "_keys", "_cache", "_rmap", "num_rows")
 
     def __init__(self, parts, keys):
-        self._parts = parts  # [(FeatureBlock, row-index array)]
+        self._parts = parts  # [(FeatureBlock | RecordBlock, row-index array)]
         self._keys = frozenset(keys)
         self._cache: Dict[str, np.ndarray] = {}
+        self._rmap: Dict[int, np.ndarray] = {}  # part idx -> record rows
         self.num_rows = int(sum(len(r) for _, r in parts))
+
+    def _part_col(self, i: int, block, rows, k: str) -> np.ndarray:
+        gather = getattr(block, "gather", None)
+        if gather is None:  # RecordBlock part: plain column lookup
+            col = block.columns.get(k)
+            if col is not None:
+                return col[rows]
+            if k.endswith("__null"):
+                return np.zeros(len(rows), dtype=bool)
+            raise KeyError(f"Column {k} missing from a block")
+        if k not in block.columns and getattr(block, "record", None) is not None:
+            # record-backed read: compute the join mapping once per part
+            rr = self._rmap.get(i)
+            if rr is None:
+                rr = self._rmap[i] = block.rowid[rows]
+            return gather(k, rows, record_rows=rr)
+        return gather(k, rows)
 
     def __getitem__(self, k: str) -> np.ndarray:
         if k not in self._keys:
             raise KeyError(k)
         got = self._cache.get(k)
         if got is None:
-            pieces = []
-            for block, rows in self._parts:
-                col = block.columns.get(k)
-                if col is not None:
-                    pieces.append(col[rows])
-                elif k.endswith("__null"):
-                    # missing null-mask column means "no nulls in this block"
-                    pieces.append(np.zeros(len(rows), dtype=bool))
-                else:
-                    raise KeyError(f"Column {k} missing from a block")
+            pieces = [
+                self._part_col(i, block, rows, k)
+                for i, (block, rows) in enumerate(self._parts)
+            ]
             got = np.concatenate(pieces) if pieces else np.empty(0, dtype=object)
             self._cache[k] = got
         return got
@@ -301,12 +319,20 @@ class TpuDataStore:
         return FeatureWriter(self, self.get_schema(name), flush_size or self.flush_size)
 
     def _insert_columns(self, ft: FeatureType, columns: Columns, observe_stats: bool = True):
-        from geomesa_tpu.store.blocks import intern_fids, intern_string_columns
+        from geomesa_tpu.store.blocks import (
+            RecordBlock,
+            intern_fids,
+            intern_string_columns,
+        )
 
         # once per batch, not per index table
         columns = intern_string_columns(ft, intern_fids(columns))
+        # ONE shared record block per batch: index tables sort only their
+        # key + scan-hot columns and reference the rest by rowid (the
+        # record-table / join-index layout, AttributeIndex.scala:42,392)
+        record = RecordBlock(columns)
         for table in self._tables[ft.name].values():
-            table.insert(columns, interned=True)
+            table.insert_record(record)
         if observe_stats and self.stats is not None:
             # the z3 block just sealed already encoded every row's key: the
             # Z3 histogram reuses it (row order is irrelevant to counts).
@@ -335,8 +361,21 @@ class TpuDataStore:
             table.delete(fids)
 
     def compact(self, name: str):
-        for table in self._tables[name].values():
-            table.compact()
+        tables = self._tables[name]
+        first = next(iter(tables.values()))
+        if len(first.blocks) <= 1 and not first.tombstones:
+            return
+        # merge record parts ONCE; every index table rebuilds against the
+        # same shared record block (deletes are store-wide, so any table's
+        # tombstone set covers them all — use the fullest view: a table
+        # without a __valid__ row filter)
+        full = next(
+            (t for t in tables.values() if t.index.name in ("id", "z2", "z3", "xz2", "xz3")),
+            first,
+        )
+        record = full.merged_record()
+        for table in tables.values():
+            table.compact(record)
 
     def count(self, name: str, query: Union[str, "Query", None] = None, exact: bool = True) -> int:
         """Feature count; with a filter, ``exact=False`` answers from stats
@@ -346,7 +385,7 @@ class TpuDataStore:
         # visibility-bearing tables must count through the auth-enforcing
         # query path — raw row counts (and write-time stats, which observed
         # every row) would leak the cardinality of unreadable features
-        has_vis = any("__vis__" in b.columns for b in first.blocks)
+        has_vis = any(b.has_col("__vis__") for b in first.blocks)
         if query is not None:
             q = self._as_query(query)
             if (
@@ -517,12 +556,17 @@ class TpuDataStore:
         if not parts:
             return _empty_columns(ft)
         out_needed = self._output_columns(ft, query)
-        # a key must exist in EVERY part's block (union arms can mix index
-        # families whose blocks carry different derived companions, e.g.
-        # xz envelope columns vs attr blocks) — except __null companions,
-        # whose absence means "no nulls in this block" and materializes as
-        # zeros
-        keysets = [set(b.columns) for b, _ in parts]
+        # observable keys come from the RECORD columns (full features);
+        # index-own derived companions (e.g. xz envelopes) are scan
+        # internals and never leak into results. A key must exist in EVERY
+        # part's record (union arms share record layout per batch) —
+        # except __null companions, whose absence means "no nulls in this
+        # block" and materializes as zeros
+        keysets = [
+            set(b.record.columns) if getattr(b, "record", None) is not None
+            else set(b.columns)
+            for b, _ in parts
+        ]
         common = set.intersection(*keysets)
         keys = {"__fid__"}
         keys.update(
@@ -659,10 +703,8 @@ class TpuDataStore:
         if age_cutoff is None or not len(rows):
             return None
         dtg = ft.default_date.name
-        alive = block.columns[dtg][rows] >= age_cutoff
-        nulls = block.columns.get(dtg + "__null")
-        if nulls is not None:
-            alive |= nulls[rows]
+        alive = block.gather(dtg, rows) >= age_cutoff
+        alive |= block.gather(dtg + "__null", rows)
         return None if alive.all() else alive
 
     @staticmethod
@@ -670,14 +712,21 @@ class TpuDataStore:
         """Gather exactly the columns a filter reads (incl. "__fid__" when
         an IdFilter is present — ast.properties reports it); property-free
         filters (e.g. EXCLUDE) get a length-carrier column so evaluate()
-        can infer the row count."""
-        fcols = {
-            k: v[rows]
-            for k, v in block.columns.items()
+        can infer the row count. The record-row join mapping is computed
+        at most once even when several record-backed columns are read."""
+        wanted = [
+            k
+            for k in block.all_keys()
             if k != "__vis__"
             and (k != "__fid__" or "__fid__" in props)
             and _column_base(k) in props
-        }
+        ]
+        rr = None
+        if any(
+            k not in block.columns for k in wanted
+        ) and getattr(block, "record", None) is not None:
+            rr = block.rowid[rows]
+        fcols = {k: block.gather(k, rows, record_rows=rr) for k in wanted}
         if not fcols:
             fcols["__rows__"] = rows
         return fcols
@@ -685,12 +734,11 @@ class TpuDataStore:
     def _visibility_keep(self, block, rows):
         """Bool keep-mask vs this store's authorizations, or None when all
         visible (VisibilityEvaluator.scala:21 / SecurityUtils analog)."""
-        vis = block.columns.get("__vis__")
-        if vis is None or not len(rows):
+        if not len(rows) or not block.has_col("__vis__"):
             return None
         from geomesa_tpu.security import visibility_mask
 
-        vmask = visibility_mask(vis[rows], self.authorizations)
+        vmask = visibility_mask(block.gather("__vis__", rows), self.authorizations)
         return None if vmask.all() else vmask
 
     def _filter_block_covered(
@@ -785,12 +833,9 @@ class TpuDataStore:
         victims: List[str] = []
         table = next(iter(self._tables[name].values()))
         for b, rows in table.scan_all():
-            t = b.columns[dtg][rows]
-            nulls = b.columns.get(dtg + "__null")
-            dead = t < cutoff
-            if nulls is not None:
-                dead &= ~nulls[rows]
-            victims.extend(b.columns["__fid__"][rows[dead]])
+            t = b.gather(dtg, rows)
+            dead = (t < cutoff) & ~b.gather(dtg + "__null", rows)
+            victims.extend(b.gather("__fid__", rows[dead]))
         if victims:
             self.delete_features(name, victims)
         return len(victims)

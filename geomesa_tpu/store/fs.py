@@ -313,7 +313,8 @@ class FsDataStore(TpuDataStore):
         table = next(iter(self._tables[name].values()))
         parts = []
         for b, rows in table.scan_all():
-            parts.append(take_rows(b.columns, rows))
+            rb, rr = b.record_part(rows)
+            parts.append(take_rows(rb.columns, rr))
         root = self._type_dir(name)
         for rel in self._files.get(name, []):
             path = os.path.join(root, rel)

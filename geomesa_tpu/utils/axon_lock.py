@@ -1,0 +1,61 @@
+"""Cross-process mutex for the axon TPU tunnel.
+
+Concurrent axon claims deadlock each other (observed round 2), so every
+process that may initialize the TPU backend — bench.py, bench_suite.py,
+scripts/tpu_watch.py — serializes through one advisory flock. Probes use
+``try_acquire`` (non-blocking): if another process holds the tunnel, treat
+the TPU as busy rather than queueing up behind a long hardware batch.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+LOCK_PATH = os.environ.get("GEOMESA_AXON_LOCK", "/tmp/geomesa_axon.lock")
+
+
+class AxonLock:
+    def __init__(self, path: str = LOCK_PATH):
+        self.path = path
+        self._fh = None
+
+    def try_acquire(self, timeout_s: float = 0.0, poll_s: float = 2.0) -> bool:
+        """Acquire without blocking (optionally retrying until timeout_s).
+        Returns False if another process holds the tunnel."""
+        if self._fh is not None:
+            return True
+        deadline = time.monotonic() + timeout_s
+        fh = open(self.path, "a+")
+        while True:
+            try:
+                fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._fh = fh
+                return True
+            except OSError:
+                if time.monotonic() >= deadline:
+                    fh.close()
+                    return False
+                time.sleep(poll_s)
+
+    def release(self) -> None:
+        if self._fh is not None:
+            try:
+                fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+            finally:
+                self._fh.close()
+                self._fh = None
+
+
+@contextmanager
+def axon_claim(timeout_s: float = 0.0) -> Iterator[Optional[AxonLock]]:
+    """Context manager yielding the held lock, or None when busy."""
+    lock = AxonLock()
+    got = lock.try_acquire(timeout_s)
+    try:
+        yield lock if got else None
+    finally:
+        lock.release()

@@ -276,7 +276,7 @@ def test_interned_string_columns_null_vs_empty():
         w.write([None, Point(2, 2)], fid="b")
         w.write(["", Point(3, 3)], fid="c")
     table = next(iter(s._tables["t"].values()))
-    col = table.blocks[0].columns["name"]
+    col = table.blocks[0].full_col("name")
     assert col.dtype.kind == "U", col.dtype  # interned
     assert sorted(s.query("t", "name = ''").fids) == ["c"]  # null excluded
     assert sorted(s.query("t", "name IS NULL").fids) == ["b"]
@@ -320,7 +320,7 @@ def test_long_string_outlier_stays_object_dtype():
         w.write(["x" * 5000, Point(0, 0)], fid="big")
         w.write(["small", Point(1, 1)], fid="s")
     table = next(iter(s._tables["t"].values()))
-    assert table.blocks[0].columns["d"].dtype == object
+    assert table.blocks[0].full_col("d").dtype == object
     assert sorted(s.query("t", "d = 'small'").fids) == ["s"]
 
 
