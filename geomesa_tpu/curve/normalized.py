@@ -37,6 +37,16 @@ class BitNormalizedDimension:
         x = np.asarray(x, dtype=np.float64)
         scaled = np.floor((x - self.min) * self._normalizer)
         out = np.where(x >= self.max, float(self.max_index), scaled)
+        # Non-finite / out-of-range inputs must cast DETERMINISTICALLY:
+        # float->int64 casting of NaN/inf/overflow is implementation-
+        # defined (INT64_MIN on x86, 0 on ARM), and covered-range
+        # exact-skip soundness requires garbage rows to never land inside
+        # a strict-interior skip box. NaN (null geometries under lenient
+        # encoding) and -inf map to cell 0 (domain edge, always excluded
+        # from skip boxes); +inf is already clamped by x >= max; huge
+        # finite out-of-domain values saturate like the JVM's d.toLong.
+        out = np.where(np.isnan(out), 0.0, out)
+        out = np.clip(out, float(-(2**63)), float(2**63 - 2**10))
         return out.astype(np.int64)
 
     def denormalize(self, i) -> np.ndarray:

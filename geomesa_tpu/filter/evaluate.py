@@ -40,9 +40,9 @@ Columns = Dict[str, np.ndarray]
 
 
 def _n(columns: Columns) -> int:
-    for v in columns.values():
-        return len(v)
-    return 0
+    from geomesa_tpu.store.blocks import num_rows  # vocab-aware row count
+
+    return num_rows(columns)
 
 
 def evaluate(f: ast.Filter, ft: FeatureType, columns: Columns) -> np.ndarray:
@@ -74,6 +74,11 @@ def evaluate(f: ast.Filter, ft: FeatureType, columns: Columns) -> np.ndarray:
         lo = _coerce(ft, f.prop, f.lo)
         hi = _coerce(ft, f.prop, f.hi)
         col, valid = _column(ft, f.prop, columns)
+        vocab = _vocab(columns, f.prop)
+        if vocab is not None:
+            lo_c = np.searchsorted(vocab, lo, side="left")
+            hi_c = np.searchsorted(vocab, hi, side="right")
+            return _masked_cmp(col, valid, lambda v: (v >= lo_c) & (v < hi_c))
         return _masked_cmp(col, valid, lambda v: (v >= lo) & (v <= hi))
     if isinstance(f, ast.Like):
         return _eval_like(f, ft, columns)
@@ -82,6 +87,10 @@ def evaluate(f: ast.Filter, ft: FeatureType, columns: Columns) -> np.ndarray:
         return valid if f.negate else ~valid
     if isinstance(f, ast.InList):
         col, valid = _column(ft, f.prop, columns)
+        vocab = _vocab(columns, f.prop)
+        if vocab is not None:
+            codes = _exact_codes(vocab, [_coerce(ft, f.prop, v) for v in f.values])
+            return np.isin(col, codes) & valid
         out = np.zeros(_n(columns), dtype=bool)
         for v in f.values:
             out |= col == _coerce(ft, f.prop, v)
@@ -96,14 +105,22 @@ def evaluate(f: ast.Filter, ft: FeatureType, columns: Columns) -> np.ndarray:
 
 
 def _column(ft: FeatureType, prop: str, columns: Columns):
-    """(values, valid_mask) for an attribute column."""
+    """(values, valid_mask) for an attribute column. Dictionary-encoded
+    string columns return their int32 CODES — predicate evaluators map
+    literals into code space via the sorted vocab (``prop__vocab``)."""
     attr = ft.attr(prop)
     col = columns[prop]
     if attr.type in (AttributeType.FLOAT, AttributeType.DOUBLE):
         return col, ~np.isnan(col)
+    if prop + "__vocab" in columns:
+        return col, col >= 0  # -1 is the dictionary null sentinel
     null_col = columns.get(prop + "__null")
     valid = ~null_col if null_col is not None else _object_valid(col)
     return col, valid
+
+
+def _vocab(columns: Columns, prop: str):
+    return columns.get(prop + "__vocab")
 
 
 def _object_valid(col: np.ndarray) -> np.ndarray:
@@ -198,6 +215,11 @@ def _eval_spatial(f: ast.SpatialFilter, ft: FeatureType, columns: Columns) -> np
                 & (bymin >= qenv.ymin)
                 & (bymax <= qenv.ymax)
             )
+            isrect = columns.get(f.prop + "__isrect")
+            if isrect is not None:
+                # rectangle features vs a rectangle query: envelope overlap
+                # IS the exact predicate — no per-geometry test needed
+                inside = inside | (overlap & ~placeholder & (isrect > 0))
             inter[inside] = True
             undecided = np.flatnonzero(overlap & ~inside)
         else:
@@ -266,13 +288,20 @@ def _masked_cmp(col: np.ndarray, valid: np.ndarray, fn) -> np.ndarray:
         return out
     sub = col[idx]
     if col.dtype == object:
+        got = None
         try:
             # numpy applies the comparison per element in C — an order of
             # magnitude faster than a Python loop
-            out[idx] = np.asarray(fn(sub), dtype=bool)
+            got = np.asarray(fn(sub), dtype=bool)
         except TypeError:
-            # mixed-type column with an ordered comparison: re-run per row,
-            # treating incomparable values as non-matching
+            pass
+        if got is not None and got.shape == sub.shape:
+            out[idx] = got
+        else:
+            # mixed-type column with an ordered comparison (TypeError), or
+            # a value type whose ndarray comparison collapses to a scalar
+            # (wrong shape — would broadcast one bool over every row):
+            # re-run per row, treating incomparable values as non-matching
             def safe(v):
                 try:
                     return bool(fn(v))
@@ -285,17 +314,42 @@ def _masked_cmp(col: np.ndarray, valid: np.ndarray, fn) -> np.ndarray:
     return out
 
 
+def _exact_codes(vocab: np.ndarray, values) -> np.ndarray:
+    """Codes of the values PRESENT in the sorted vocab (absent -> dropped)."""
+    out = []
+    for v in values:
+        i = int(np.searchsorted(vocab, v))
+        if i < len(vocab) and vocab[i] == v:
+            out.append(i)
+    return np.asarray(out, dtype=np.int32)
+
+
 def _eval_cmp(f: ast.Cmp, ft: FeatureType, columns: Columns) -> np.ndarray:
     col, valid = _column(ft, f.prop, columns)
     lit = _coerce(ft, f.prop, f.literal)
-    ops = {
-        "=": lambda v: v == lit,
-        "<>": lambda v: v != lit,
-        "<": lambda v: v < lit,
-        "<=": lambda v: v <= lit,
-        ">": lambda v: v > lit,
-        ">=": lambda v: v >= lit,
-    }
+    vocab = _vocab(columns, f.prop)
+    if vocab is not None:
+        # dictionary codes: map the literal into code space (the vocab is
+        # sorted, so order compares translate to code compares exactly)
+        lo = np.searchsorted(vocab, lit, side="left")
+        hi = np.searchsorted(vocab, lit, side="right")  # lo==hi iff absent
+        ops = {
+            "=": lambda v: (v >= lo) & (v < hi),
+            "<>": lambda v: (v < lo) | (v >= hi),
+            "<": lambda v: v < lo,
+            "<=": lambda v: v < hi,
+            ">": lambda v: v >= hi,
+            ">=": lambda v: v >= lo,
+        }
+    else:
+        ops = {
+            "=": lambda v: v == lit,
+            "<>": lambda v: v != lit,
+            "<": lambda v: v < lit,
+            "<=": lambda v: v <= lit,
+            ">": lambda v: v > lit,
+            ">=": lambda v: v >= lit,
+        }
     return _masked_cmp(col, valid, ops[f.op])
 
 
@@ -304,6 +358,14 @@ def _eval_like(f: ast.Like, ft: FeatureType, columns: Columns) -> np.ndarray:
     pattern = re.escape(f.pattern).replace("%", ".*").replace("_", ".")
     flags = re.IGNORECASE if f.case_insensitive else 0
     rx = re.compile("^" + pattern + "$", flags)
+    vocab = _vocab(columns, f.prop)
+    if vocab is not None:
+        # run the regex over the (small) vocab once, then one int isin over
+        # the codes — LIKE over millions of rows costs len(vocab) matches
+        match_codes = np.flatnonzero(
+            np.fromiter((bool(rx.match(v)) for v in vocab), bool, len(vocab))
+        ).astype(np.int32)
+        return np.isin(col, match_codes) & valid
     out = np.array(
         [bool(rx.match(v)) if isinstance(v, str) else False for v in col], dtype=bool
     )

@@ -178,33 +178,45 @@ def _boxes(values: IndexValues) -> List[Tuple[float, float, float, float]]:
 
 
 def _envelope_columns(geom: str, columns) -> Dict[str, np.ndarray]:
-    """Per-row geometry envelope companion columns (``geom__bxmin`` ...).
+    """Per-row geometry envelope companion columns (``geom__bxmin`` ... +
+    ``geom__isrect``).
 
     Computed once at ingest for XZ keys and STORED in the blocks: the
     vectorized bbox prescreen in filter evaluation (evaluate._eval_spatial)
     and the device executor both read them instead of re-walking the
-    object geometry column. Null geometries get an empty (0,0,0,0) box."""
+    object geometry column. Null geometries get an empty (0,0,0,0) box.
+    ``isrect`` marks features whose geometry IS its envelope rectangle —
+    for rectangle queries the envelope test is then exact and the per-row
+    geometry predicate is skipped (the extent-query hot path)."""
     existing = columns.get(geom + "__bxmin")
     if existing is not None:
-        return {
+        out = {
             geom + "__bxmin": existing,
             geom + "__bymin": columns[geom + "__bymin"],
             geom + "__bxmax": columns[geom + "__bxmax"],
             geom + "__bymax": columns[geom + "__bymax"],
         }
+        isrect = columns.get(geom + "__isrect")
+        if isrect is not None:
+            out[geom + "__isrect"] = isrect.astype(np.uint8, copy=False)
+        return out
     col = columns[geom]
-    envs = np.array(
-        [
-            g.envelope.as_tuple() if g is not None else (0.0, 0.0, 0.0, 0.0)
-            for g in col
-        ],
-        dtype=np.float64,
-    ).reshape(-1, 4)
+    n = len(col)
+    envs = np.zeros((n, 4), dtype=np.float64)
+    isrect = np.zeros(n, dtype=np.uint8)
+    for i, g in enumerate(col):
+        if g is None:
+            continue
+        envs[i] = g.envelope.as_tuple()
+        rect = getattr(g, "is_rectangle", None)
+        if rect is not None and rect():
+            isrect[i] = 1
     return {
         geom + "__bxmin": envs[:, 0],
         geom + "__bymin": envs[:, 1],
         geom + "__bxmax": envs[:, 2],
         geom + "__bymax": envs[:, 3],
+        geom + "__isrect": isrect,
     }
 
 
@@ -546,7 +558,10 @@ class AttributeKeySpace(IndexKeySpace):
         col = columns[self.attribute]
         # null attribute values are not indexed (the reference skips writing
         # attribute-index rows for null values)
-        if col.dtype == object:
+        vocab = columns.get(self.attribute + "__vocab")
+        if vocab is not None:
+            valid = col >= 0  # dictionary codes: -1 is the null sentinel
+        elif col.dtype == object:
             valid = np.array([v is not None for v in col], dtype=bool)
         elif col.dtype.kind == "f":
             valid = ~np.isnan(col)
@@ -554,6 +569,11 @@ class AttributeKeySpace(IndexKeySpace):
             nulls = columns.get(self.attribute + "__null")
             valid = ~nulls if nulls is not None else np.ones(len(col), dtype=bool)
         out = {"__key__": col, "__valid__": valid}
+        if vocab is not None:
+            # sorted per-batch vocab rides with the block (NOT row-aligned):
+            # scan ranges arrive with VALUE bounds and map to code space
+            # per block (FeatureBlock._to_code_ranges)
+            out["__key_vocab__"] = vocab
         geom = ft.default_geometry
         if geom is not None and ft.is_points:
             # secondary sort by z2 within each attribute value

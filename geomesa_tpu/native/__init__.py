@@ -157,6 +157,7 @@ _ENVSCAN = _NativeLib(
     ctypes.c_longlong,
     [
         _c_f64p, _c_f64p, _c_f64p, _c_f64p,  # bxmin, bymin, bxmax, bymax
+        _c_u8p,  # isrect flags (nullable)
         _c_i64p, _c_i64p, ctypes.c_longlong,  # starts, ends, nruns
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,  # query box
         ctypes.c_int,  # rect_query
@@ -186,11 +187,14 @@ def load_env_seek():
 
 
 def env_seek_scan_native(
-    bxmin, bymin, bxmax, bymax, starts, ends, qenv, rect_query: bool
+    bxmin, bymin, bxmax, bymax, starts, ends, qenv, rect_query: bool,
+    isrect=None,
 ):
     """Extent candidate filter (see seekscan.cpp geomesa_env_seek_scan);
     returns (rows, decided_bool) or None when the lib is unavailable.
-    ``qenv`` = (xmin, ymin, xmax, ymax) of the query geometry's envelope."""
+    ``qenv`` = (xmin, ymin, xmax, ymax) of the query geometry's envelope.
+    ``isrect``: optional uint8/bool flags — rows whose geometry IS its
+    envelope rectangle are decided by the envelope test alone."""
     lib = load_env_seek()
     if lib is None:
         return None
@@ -200,6 +204,11 @@ def env_seek_scan_native(
     d = np.ascontiguousarray(bymax, dtype=np.float64)
     st = np.ascontiguousarray(starts, dtype=np.int64)
     en = np.ascontiguousarray(ends, dtype=np.int64)
+    if isrect is not None:
+        ir = np.ascontiguousarray(isrect, dtype=np.uint8)
+        ir_p = ir.ctypes.data_as(_c_u8p)
+    else:
+        ir_p = _c_u8p()
     cap = int(np.maximum(en - st, 0).sum())
     rows = np.empty(max(cap, 1), dtype=np.int64)
     dec = np.empty(max(cap, 1), dtype=np.uint8)
@@ -208,6 +217,7 @@ def env_seek_scan_native(
         b.ctypes.data_as(_c_f64p),
         c.ctypes.data_as(_c_f64p),
         d.ctypes.data_as(_c_f64p),
+        ir_p,
         st.ctypes.data_as(_c_i64p),
         en.ctypes.data_as(_c_i64p),
         len(st),

@@ -71,9 +71,14 @@ long long geomesa_seek_scan(
 // filter/evaluate.py::_eval_spatial, one pass, no intermediate gathers.
 //
 // Returns rows written, or -1 if cap insufficient (caller sizes exactly).
+// isrect: optional (nullable) per-row flag marking features whose geometry
+// IS its axis-aligned envelope rectangle — for a RECTANGLE query their
+// envelope-overlap test is exact, so straddling rows skip the host's
+// per-geometry ring test entirely.
 long long geomesa_env_seek_scan(
     const double* bxmin, const double* bymin,
     const double* bxmax, const double* bymax,
+    const uint8_t* isrect,
     const int64_t* starts, const int64_t* ends, long long nruns,
     double qxmin, double qymin, double qxmax, double qymax,
     int rect_query,
@@ -90,11 +95,12 @@ long long geomesa_env_seek_scan(
             if (!overlap) continue;
             bool placeholder = bxmin[i] == 0.0 && bymin[i] == 0.0 &&
                                bxmax[i] == 0.0 && bymax[i] == 0.0;
-            bool inside = rect_query && !placeholder &&
-                          bxmin[i] >= qxmin && bxmax[i] <= qxmax &&
-                          bymin[i] >= qymin && bymax[i] <= qymax;
+            bool decided = rect_query && !placeholder &&
+                           ((bxmin[i] >= qxmin && bxmax[i] <= qxmax &&
+                             bymin[i] >= qymin && bymax[i] <= qymax) ||
+                            (isrect && isrect[i]));
             out_rows[n] = i;
-            out_decided[n] = inside ? 1 : 0;
+            out_decided[n] = decided ? 1 : 0;
             ++n;
         }
     }

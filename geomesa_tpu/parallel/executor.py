@@ -778,7 +778,10 @@ class _HostSeekScan:
             by = block.columns[geom + "__bymin"]
             cx = block.columns[geom + "__bxmax"]
             cy = block.columns[geom + "__bymax"]
-            got = env_seek_scan_native(bx, by, cx, cy, starts, ends, qbox, rect)
+            got = env_seek_scan_native(
+                bx, by, cx, cy, starts, ends, qbox, rect,
+                isrect=block.columns.get(geom + "__isrect"),
+            )
             if got is None:
                 # lib raced away: same semantics via the shared vectorized
                 # prescreen in _eval_spatial (no third copy of the rules)

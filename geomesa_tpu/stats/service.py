@@ -132,9 +132,12 @@ class MetadataBackedStats(GeoMesaStats):
         """``z3_keys``: optional (keys, bins) arrays from a freshly sealed
         z3 block of the SAME rows — the Z3 histogram then derives its cells
         from the already-encoded keys instead of re-encoding the batch."""
+        from geomesa_tpu.store.blocks import num_rows
+
         stats = self.stats_for(ft)
-        n = len(next(iter(columns.values()), []))
+        n = num_rows(columns)
         stats["count"].count += n
+        _decoded: Dict[str, np.ndarray] = {}
         for key, stat in stats.items():
             if key == "count":
                 continue
@@ -151,7 +154,17 @@ class MetadataBackedStats(GeoMesaStats):
             if attr is None or attr not in columns:
                 continue
             nulls = columns.get(attr.split("__")[0] + "__null")
-            stat.observe(columns[attr], nulls)
+            vals = columns[attr]
+            vocab = columns.get(attr + "__vocab")
+            if vocab is not None:
+                # dictionary column: sketches observe VALUES (decoded once
+                # per batch; several sketches on one attr share the cache)
+                from geomesa_tpu.store.blocks import dict_decode
+
+                vals = _decoded.get(attr)
+                if vals is None:
+                    vals = _decoded[attr] = dict_decode(columns[attr], vocab)
+            stat.observe(vals, nulls)
         # debounced persistence: serializing every sketch per batch is pure
         # overhead on the write hot path; sketches are recomputable anyway
         self._unpersisted[ft.name] = self._unpersisted.get(ft.name, 0) + 1

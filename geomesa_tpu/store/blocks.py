@@ -22,6 +22,15 @@ from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
 Columns = Dict[str, np.ndarray]
 
 
+def num_rows(columns: Columns) -> int:
+    """Row count of a column dict, skipping dictionary vocab arrays
+    (``*__vocab`` — per-batch sorted vocabs, NOT row-aligned)."""
+    for k, v in columns.items():
+        if not k.endswith("__vocab"):
+            return len(v)
+    return 0
+
+
 def columns_from_features(ft: FeatureType, features: Sequence[Feature]) -> Columns:
     """Row features -> columnar arrays per the evaluate.py conventions."""
     n = len(features)
@@ -95,43 +104,110 @@ def intern_fids(columns: Columns) -> Columns:
 
 
 def intern_string_columns(ft: FeatureType, columns: Columns) -> Columns:
-    """Convert object-dtype STRING attribute columns to fixed-width unicode
-    plus a ``__null`` companion (None -> "" + mask), the same shape numeric
-    nulls already use. Equality / LIKE / validity over U arrays run in
-    numpy's C loops instead of per-object Python dispatch — the difference
-    between ~100ms and ~10ms attribute post-filters on 1M-candidate scans.
-    Columns containing any non-str non-None value stay object. Idempotent;
-    call once per write batch alongside intern_fids."""
+    """Encode STRING attribute columns for columnar storage. Idempotent;
+    call once per write batch alongside intern_fids.
+
+    Low-cardinality columns DICTIONARY-ENCODE: ``name`` becomes int32
+    codes into a per-batch SORTED vocab stored as ``name__vocab`` (code
+    order == value order, so range scans and sorts work in code space);
+    null -> code -1 plus the usual ``__null`` mask. Equality/range/LIKE
+    predicates then compare 4-byte ints instead of 4B-per-CHAR fixed-width
+    text — the reference makes the same move on the wire with
+    ArrowDictionary (geomesa-arrow-gt .../vector/SimpleFeatureVector.scala
+    dictionary handling); here it is the at-rest layout.
+
+    High-cardinality columns fall back to fixed-width unicode + ``__null``
+    (C-speed compares, no vocab win); columns with a >128-char outlier or
+    non-str values stay object."""
     out = None
     for a in ft.attributes:
         if a.type != AttributeType.STRING:
             continue
         col = columns.get(a.name)
-        if col is None or col.dtype != object or not len(col):
+        if col is None or not len(col):
             continue
-        ok = True
-        maxlen = 0
-        for v in col:
-            if v is None:
+        if a.name + "__vocab" in columns:
+            continue  # already encoded (idempotence)
+        n = len(col)
+        if col.dtype.kind == "U":
+            # pre-interned input (bulk ingest fast path / fs replay)
+            nulls = columns.get(a.name + "__null")
+            nulls = (
+                nulls.copy() if nulls is not None else np.zeros(n, dtype=bool)
+            )
+            clean = col
+        elif col.dtype == object:
+            ok = True
+            maxlen = 0
+            for v in col:
+                if v is None:
+                    continue
+                if type(v) is not str:
+                    ok = False
+                    break
+                if len(v) > maxlen:
+                    maxlen = len(v)
+            # width cap: one long outlier would multiply a fixed-width
+            # column's memory (and a dict vocab still pays it per distinct
+            # value) — leave such columns object
+            if not ok or maxlen > 128:
                 continue
-            if type(v) is not str:
-                ok = False
-                break
-            if len(v) > maxlen:
-                maxlen = len(v)
-        # width cap: fixed-width storage is 4B/char for EVERY row, so one
-        # long outlier would multiply the whole column's memory (a 1000-char
-        # value makes a 1M-row column ~4GB) — leave such columns object
-        if not ok or maxlen > 128:
+            nulls = np.array([v is None for v in col], dtype=bool)
+            clean = np.where(nulls, "", col).astype(np.str_)
+        else:
             continue
-        nulls = np.array([v is None for v in col], dtype=bool)
-        interned = np.where(nulls, "", col).astype(np.str_)
         if out is None:
             out = dict(columns)
-        out[a.name] = interned
+        # cardinality probe on a strided sample first: np.unique is a full
+        # lexicographic sort, wasted on per-row-unique columns (UUIDs,
+        # notes) that will take the plain-U fallback anyway
+        high_card = False
+        if n > 8192:
+            probe = clean[:: max(1, n // 2048)][:2048]
+            pu = len(np.unique(probe))
+            high_card = pu > 256 and 2 * pu > len(probe)
+        if high_card:
+            out[a.name] = clean
+        else:
+            vocab, codes = np.unique(clean, return_inverse=True)
+            if len(vocab) <= 256 or 2 * len(vocab) <= n:
+                codes = codes.astype(np.int32)
+                codes[nulls] = -1
+                out[a.name] = codes
+                out[a.name + "__vocab"] = vocab
+            else:
+                out[a.name] = clean
         if nulls.any():
             out[a.name + "__null"] = nulls
     return out if out is not None else columns
+
+
+def dict_decode(codes: np.ndarray, vocab: np.ndarray) -> np.ndarray:
+    """Row-subset decode helper (codes may include -1 nulls -> "")."""
+    vals = vocab[np.maximum(codes, 0)]
+    neg = codes < 0
+    if neg.any():
+        vals = vals.copy()
+        vals[neg] = ""
+    return vals
+
+
+def record_rows_decoded(columns: Columns, rows: np.ndarray) -> Columns:
+    """take_rows with dictionary columns DECODED to values (null -> "" +
+    the ``__null`` mask) and vocabs dropped: vocab arrays are not
+    row-aligned, and codes from different batches are not comparable — so
+    the persistence rewrite and compaction re-encode paths merge through
+    values and re-intern afterwards."""
+    out = {}
+    for k, v in columns.items():
+        if k.endswith("__vocab"):
+            continue
+        vocab = columns.get(k + "__vocab")
+        if vocab is not None:
+            out[k] = dict_decode(v[rows], vocab)
+        else:
+            out[k] = v[rows]
+    return out
 
 
 def expand_intervals(
@@ -216,7 +292,7 @@ class RecordBlock:
 
     def __init__(self, columns: Columns):
         self.columns = columns
-        self.n = len(next(iter(columns.values()))) if columns else 0
+        self.n = num_rows(columns)
         self._nulls_memo: Dict[str, bool] = {}
 
     def has_nulls(self, name: str) -> bool:
@@ -293,6 +369,7 @@ class FeatureBlock:
         tiebreak: Optional[np.ndarray] = None,
         record: Optional[RecordBlock] = None,
         rowid: Optional[np.ndarray] = None,
+        key_vocab: Optional[np.ndarray] = None,
     ):
         self.index = index
         self.columns = columns
@@ -302,6 +379,9 @@ class FeatureBlock:
         self.tiebreak = tiebreak
         self.record = record
         self.rowid = rowid
+        # dictionary-encoded attr key: sorted value vocab for this block's
+        # int32 code keys (scan ranges map value bounds -> code bounds)
+        self.key_vocab = key_vocab
         self.n = len(key)
         # per-bin row slices (contiguous after the sort)
         self.bin_slices: Dict[int, Tuple[int, int]] = {}
@@ -411,10 +491,13 @@ class FeatureBlock:
         bins = key_cols.get("__bin__")
         valid = key_cols.get("__valid__")
         tiebreak = key_cols.get("__tiebreak__")
+        key_vocab = key_cols.get("__key_vocab__")
         own: Columns = {
             k: v
             for k, v in key_cols.items()
-            if k not in ("__key__", "__bin__", "__valid__", "__tiebreak__")
+            if k not in (
+                "__key__", "__bin__", "__valid__", "__tiebreak__", "__key_vocab__"
+            )
         }  # derived companions (e.g. XZ envelopes) stay with the index
         for name in _hot_names(index, ft):
             col = record.columns.get(name)
@@ -442,7 +525,9 @@ class FeatureBlock:
             order = np.argsort(key, kind="stable")
         key = key[order]
         sorted_cols = take_rows(own, order)
-        return cls(index, sorted_cols, key, bins, tiebreak, record, rowid[order])
+        return cls(
+            index, sorted_cols, key, bins, tiebreak, record, rowid[order], key_vocab
+        )
 
     def scan(self, ranges: Sequence[ScanRange]) -> np.ndarray:
         """Row indices whose keys fall in any range (sorted, deduped)."""
@@ -523,10 +608,41 @@ class FeatureBlock:
             return z, z, np.empty(0, dtype=bool)
         return np.concatenate(outs), np.concatenate(oute), np.concatenate(outf)
 
+    def _to_code_ranges(self, ranges: Sequence[ScanRange]) -> List[ScanRange]:
+        """VALUE-space scan ranges -> this block's CODE space (inclusive
+        int bounds). The vocab is sorted, so order-preserving: a value
+        bound maps by binary search; exclusive bounds shift by choosing
+        the searchsorted side. ``contained`` flags carry over — codes
+        represent exact values."""
+        vocab = self.key_vocab
+        out = []
+        for r in ranges:
+            if r.lower is None:
+                lo = 0
+            else:
+                side = "left" if r.lower_inclusive else "right"
+                lo = int(np.searchsorted(vocab, r.lower, side=side))
+            if r.upper is None:
+                hi = len(vocab) - 1
+            else:
+                side = "right" if r.upper_inclusive else "left"
+                hi = int(np.searchsorted(vocab, r.upper, side=side)) - 1
+            if hi < lo:
+                continue
+            out.append(
+                ScanRange(r.bin, lo, hi, r.contained, True, True, r.tiebreak_ranges)
+            )
+        return out
+
     def _slice_intervals(
         self, s: int, e: int, ranges: Sequence[ScanRange]
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         sub = self.key[s:e]
+        if self.key_vocab is not None:
+            ranges = self._to_code_ranges(ranges)
+            if not ranges:
+                z = np.empty(0, dtype=np.int64)
+                return z, z, np.empty(0, dtype=bool)
         numeric = sub.dtype != object
         if self.tiebreak is not None and any(r.tiebreak_ranges for r in ranges):
             # attribute scans with a z2 tiebreak: within each equality span
@@ -626,7 +742,7 @@ class IndexTable:
         return sum(b.n for b in self.blocks)
 
     def insert(self, columns: Columns, interned: bool = False):
-        if not columns or len(next(iter(columns.values()))) == 0:
+        if not columns or num_rows(columns) == 0:
             return
         if not interned:
             columns = intern_string_columns(self.ft, intern_fids(columns))
@@ -707,11 +823,7 @@ class IndexTable:
         if record is None:
             if len(self.blocks) <= 1 and not self.tombstones:
                 return
-            parts = []
-            for b, rows in self.scan_all():
-                rb, rr = b.record_part(rows)
-                parts.append(take_rows(rb.columns, rr))
-            record = RecordBlock(concat_columns(parts))
+            record = self.merged_record()
         self.blocks = []
         self.tombstones = set()
         self.version += 1
@@ -719,7 +831,9 @@ class IndexTable:
 
     def merged_record(self) -> RecordBlock:
         """Live rows of every record block, tombstones dropped, in record
-        order — the input to a store-level shared compaction."""
+        order — the input to a store-level shared compaction. Dictionary
+        columns are decoded per part (vocabs are batch-relative) and the
+        merged batch re-encoded with one unified vocab."""
         parts = []
         seen = set()
         for b in self.blocks:
@@ -732,5 +846,6 @@ class IndexTable:
                 fids = rb.columns["__fid__"]
                 rows = rows[~np.isin(fids, list(self.tombstones))]
             if len(rows):
-                parts.append(take_rows(rb.columns, rows))
-        return RecordBlock(concat_columns(parts))
+                parts.append(record_rows_decoded(rb.columns, rows))
+        merged = intern_string_columns(self.ft, concat_columns(parts))
+        return RecordBlock(merged)

@@ -73,19 +73,35 @@ class LazyColumns(Mapping):
     def _part_col(self, i: int, block, rows, k: str) -> np.ndarray:
         gather = getattr(block, "gather", None)
         if gather is None:  # RecordBlock part: plain column lookup
-            col = block.columns.get(k)
-            if col is not None:
-                return col[rows]
-            if k.endswith("__null"):
+            got = block.columns.get(k)
+            if got is not None:
+                got = got[rows]
+            elif k.endswith("__null"):
                 return np.zeros(len(rows), dtype=bool)
-            raise KeyError(f"Column {k} missing from a block")
-        if k not in block.columns and getattr(block, "record", None) is not None:
+            else:
+                raise KeyError(f"Column {k} missing from a block")
+        elif k not in block.columns and getattr(block, "record", None) is not None:
             # record-backed read: compute the join mapping once per part
             rr = self._rmap.get(i)
             if rr is None:
                 rr = self._rmap[i] = block.rowid[rows]
-            return gather(k, rows, record_rows=rr)
-        return gather(k, rows)
+            got = gather(k, rows, record_rows=rr)
+        else:
+            got = gather(k, rows)
+        vocab = self._vocab_for(block, k)
+        if vocab is not None:
+            from geomesa_tpu.store.blocks import dict_decode
+
+            got = dict_decode(got, vocab)  # results expose VALUES, not codes
+        return got
+
+    @staticmethod
+    def _vocab_for(block, k: str):
+        if k.startswith("__") or k.endswith("__null"):
+            return None
+        rec = getattr(block, "record", None)
+        cols = rec.columns if rec is not None else block.columns
+        return cols.get(k + "__vocab")
 
     def __getitem__(self, k: str) -> np.ndarray:
         if k not in self._keys:
@@ -542,11 +558,11 @@ class TpuDataStore:
 
         parts = self._scan_parts(name, ft, query, plan, t_scan_start, pending)
         columns = self._columns_from_parts(ft, query, parts)
-        if plan.index.name in ("xz2", "xz3"):
-            # only extent indices can emit multiple rows per feature
-            # (QueryPlanner.scala:83-85 dedupes exactly this case; point
-            # indices are one-row-per-feature in the reference too)
-            columns = _dedupe_by_fid(_materialize(columns))
+        # NO xz dedupe: unlike the reference's sharded XZ tables
+        # (QueryPlanner.scala:83-85 dedupes multi-row extent features),
+        # this layout writes exactly ONE row per feature per index, and
+        # expand_intervals dedupes overlapping range hits within a block —
+        # so extent results stay lazy like point results
         return self._finish(ft, query, plan, columns)
 
     def _columns_from_parts(self, ft, query: Query, parts: List[tuple]):
@@ -573,6 +589,7 @@ class TpuDataStore:
             k
             for k in set.union(*keysets)
             if k != "__vis__"
+            and not k.endswith(_INTERNAL_SUFFIXES)  # scan internals never leak
             and (k in common or k.endswith("__null"))
             and (out_needed is None or _column_base(k) in out_needed)
         )
@@ -726,7 +743,16 @@ class TpuDataStore:
             k not in block.columns for k in wanted
         ) and getattr(block, "record", None) is not None:
             rr = block.rowid[rows]
-        fcols = {k: block.gather(k, rows, record_rows=rr) for k in wanted}
+        fcols = {}
+        for k in wanted:
+            if k.endswith("__vocab"):
+                # dictionary vocab: whole sorted array, NOT row-aligned —
+                # the evaluator maps literals through it in code space
+                fcols[k] = block.full_col(k) if k in block.columns else (
+                    block.record.columns[k]
+                )
+            else:
+                fcols[k] = block.gather(k, rows, record_rows=rr)
         if not fcols:
             fcols["__rows__"] = rows
         return fcols
@@ -884,6 +910,14 @@ class ScanExecutor:
 class HostScanExecutor(ScanExecutor):
     def post_filter(self, ft: FeatureType, plan: QueryPlan, columns: Columns) -> np.ndarray:
         return evaluate(plan.post_filter, ft, columns)
+
+
+# derived scan-internal companion suffixes (dictionary vocabs, envelope
+# prescreen columns, rect flags): never exposed in query results, whether
+# they were computed at ingest or supplied precomputed by a columnar writer
+_INTERNAL_SUFFIXES = (
+    "__vocab", "__bxmin", "__bymin", "__bxmax", "__bymax", "__isrect"
+)
 
 
 def _column_base(k: str) -> str:

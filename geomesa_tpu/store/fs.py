@@ -306,15 +306,16 @@ class FsDataStore(TpuDataStore):
         self._schemes.pop(name, None)
 
     def _rewrite(self, name: str) -> None:
-        """Persist current (post-delete/compact) state, re-partitioned."""
-        from geomesa_tpu.store.blocks import concat_columns, take_rows
+        """Persist current (post-delete/compact) state, re-partitioned.
+        Dictionary columns are decoded — values are the on-disk form."""
+        from geomesa_tpu.store.blocks import concat_columns, record_rows_decoded
 
         ft = self.get_schema(name)
         table = next(iter(self._tables[name].values()))
         parts = []
         for b, rows in table.scan_all():
             rb, rr = b.record_part(rows)
-            parts.append(take_rows(rb.columns, rr))
+            parts.append(record_rows_decoded(rb.columns, rr))
         root = self._type_dir(name)
         for rel in self._files.get(name, []):
             path = os.path.join(root, rel)

@@ -1,0 +1,71 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md).
+
+1. z2/z3 key encoding of NaN coordinates must be deterministic (cell 0),
+   not dependent on C float->int cast behavior.
+2. evaluate._masked_cmp must not broadcast a scalar comparison result
+   across all rows for exotic value types.
+3. Extent-type query results must expose the same column set whether they
+   take the lazy passthrough or the eager (sort/limit) path — derived
+   envelope companions (geom__b*) are scan internals and never leak.
+"""
+
+import numpy as np
+
+from geomesa_tpu.curve.normalized import NormalizedLat, NormalizedLon
+from geomesa_tpu.geom.base import LineString, Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+
+def test_nan_normalizes_to_cell_zero():
+    for dim in (NormalizedLon(31), NormalizedLat(31), NormalizedLon(21)):
+        got = dim.normalize(np.array([np.nan, 0.0, np.nan]))
+        assert got[0] == 0 and got[2] == 0
+        assert got.dtype == np.int64
+
+
+def test_masked_cmp_rejects_scalar_broadcast():
+    from geomesa_tpu.filter.evaluate import _masked_cmp
+
+    class Collapses:
+        """Comparison against an ndarray returns a SCALAR (not elementwise,
+        not raising) — the broadcast hazard from the advisory."""
+
+        def __init__(self, v):
+            self.v = v
+
+        def __eq__(self, other):
+            if isinstance(other, np.ndarray):
+                return True  # scalar! would broadcast over all rows
+            return isinstance(other, Collapses) and self.v == other.v
+
+        __hash__ = None
+
+    col = np.array([Collapses(1), Collapses(2), Collapses(3)], dtype=object)
+    valid = np.ones(3, dtype=bool)
+    lit = Collapses(2)
+    got = _masked_cmp(col, valid, lambda v: v == lit)
+    assert got.tolist() == [False, True, False]
+
+
+def _extent_store():
+    s = TpuDataStore()
+    s.create_schema(parse_spec("ways", "name:String,*geom:LineString:srid=4326"))
+    with s.writer("ways") as w:
+        for i in range(20):
+            w.write(
+                [f"w{i}", LineString([(i, 0.0), (i + 1.0, 1.0)])], fid=f"f{i}"
+            )
+    return s
+
+def test_companion_columns_never_leak_lazy_vs_eager():
+    s = _extent_store()
+    cql = "bbox(geom, 2.5, -1, 8.5, 2)"
+    lazy = s.query("ways", cql)  # plain stream: lazy passthrough
+    eager = s.query("ways", Query.cql(cql, sort_by=[("name", True)]))
+    lazy_keys = set(lazy.columns)
+    eager_keys = set(eager.columns)
+    assert not {k for k in lazy_keys if "__b" in k}, lazy_keys
+    assert lazy_keys == eager_keys, lazy_keys ^ eager_keys
+    assert set(map(str, lazy.fids)) == set(map(str, eager.fids))

@@ -1,0 +1,153 @@
+"""Dictionary-encoded string columns: layout, code-space predicates, attr
+index scans over per-block vocabs, cross-batch merges, and parity vs the
+in-memory oracle (the at-rest analog of the reference's ArrowDictionary
+wire encoding, geomesa-arrow-gt .../vector/SimpleFeatureVector.scala)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "actor:String:index=true,note:String,dtg:Date,*geom:Point:srid=4326"
+ACTORS = ["USA", "FRA", "CHN", "BRA", "DEU", "FRA2", ""]
+
+
+def _pair(n=5000, batches=3, seed=7):
+    rng = np.random.default_rng(seed)
+    tpu = TpuDataStore(flush_size=n // batches + 1)
+    mem = MemoryDataStore()
+    tpu.create_schema(parse_spec("t", SPEC))
+    mem.create_schema(parse_spec("t", SPEC))
+    base = np.datetime64("2026-01-01", "ms").astype(np.int64)
+    rows = []
+    for i in range(n):
+        actor = ACTORS[rng.integers(0, len(ACTORS))] if rng.random() > 0.1 else None
+        note = f"note-{rng.integers(0, 50)}"
+        rows.append(
+            (
+                [actor, note, int(base + rng.integers(0, 10 * 86400_000)),
+                 Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90)))],
+                f"f{i}",
+            )
+        )
+    with tpu.writer("t") as w:
+        for vals, fid in rows:
+            w.write(vals, fid=fid)
+    for vals, fid in rows:
+        mem.write("t", vals, fid=fid)
+    return tpu, mem
+
+
+QUERIES = [
+    "actor = 'FRA'",
+    "actor = 'NOPE'",
+    "actor <> 'USA'",
+    "actor < 'D'",
+    "actor >= 'FRA' AND actor <= 'FRA2'",
+    "actor BETWEEN 'B' AND 'E'",
+    "actor LIKE 'FR%'",
+    "actor LIKE '%A'",
+    "actor IN ('USA', 'CHN', 'MISSING')",
+    "actor IS NULL",
+    "actor = ''",
+    "note = 'note-7'",
+    "actor = 'USA' AND bbox(geom, -120, 0, 0, 60)",
+    "actor = 'USA' AND dtg DURING 2026-01-02T00:00:00Z/2026-01-05T00:00:00Z",
+]
+
+
+def _check(tpu, mem, queries=QUERIES):
+    for q in queries:
+        got = set(map(str, tpu.query("t", q).fids))
+        want = set(map(str, mem.query("t", q).fids))
+        assert got == want, (q, len(got), len(want), list(got ^ want)[:5])
+
+
+def test_dictionary_layout():
+    tpu, _ = _pair(n=300, batches=1)
+    table = next(iter(tpu._tables["t"].values()))
+    rec = table.blocks[0].record
+    assert rec.columns["actor"].dtype == np.int32
+    vocab = rec.columns["actor__vocab"]
+    assert list(vocab) == sorted(set(vocab))
+    # attr index keys are the codes, block carries the vocab
+    attr_table = tpu._tables["t"]["attr:actor"]
+    blk = attr_table.blocks[0]
+    assert blk.key.dtype == np.int32 and blk.key_vocab is not None
+    # nulls are excluded from the attr index, -1 never appears as a key
+    assert (blk.key >= 0).all()
+
+
+def test_codespace_parity_single_batch():
+    _check(*_pair(batches=1))
+
+
+def test_codespace_parity_multi_batch():
+    # several batches => several vocabs; ranges map per block
+    _check(*_pair(batches=4))
+
+
+def test_results_expose_values_not_codes():
+    tpu, _ = _pair(n=500, batches=1)
+    r = tpu.query("t", "actor = 'USA'")
+    col = r.columns["actor"]
+    assert col.dtype.kind == "U" and set(col) == {"USA"}
+    assert "actor__vocab" not in set(r.columns)
+    feats = r.to_features()
+    assert feats[0].values[0] == "USA"
+    # sort + projection paths decode too
+    r2 = tpu.query("t", Query.cql("INCLUDE", sort_by=[("actor", False)],
+                                  properties=["actor", "geom"]))
+    vals = [v for v in r2.columns["actor"]]
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_compact_unifies_vocabs():
+    tpu, mem = _pair(batches=4)
+    dead = [f"f{i}" for i in range(0, 5000, 11)]
+    tpu.delete_features("t", dead)
+    tpu.compact("t")
+    table = next(iter(tpu._tables["t"].values()))
+    assert len(table.blocks) == 1
+    rec = table.blocks[0].record
+    assert rec.columns["actor"].dtype == np.int32  # re-encoded, one vocab
+    deadset = set(dead)
+    for q in QUERIES:
+        got = set(map(str, tpu.query("t", q).fids))
+        want = set(map(str, mem.query("t", q).fids)) - deadset
+        assert got == want, q
+
+
+def test_high_cardinality_falls_back_to_unicode():
+    s = TpuDataStore()
+    s.create_schema(parse_spec("u", "tag:String,*geom:Point:srid=4326"))
+    with s.writer("u") as w:
+        for i in range(2000):
+            w.write([f"unique-{i}", Point(i % 360 - 180, 0)], fid=f"f{i}")
+    table = next(iter(s._tables["u"].values()))
+    rec = table.blocks[0].record
+    assert "tag__vocab" not in rec.columns
+    assert rec.columns["tag"].dtype.kind == "U"
+    assert sorted(s.query("u", "tag = 'unique-77'").fids) == ["f77"]
+
+
+def test_fs_store_roundtrip_with_dictionary(tmp_path):
+    from geomesa_tpu.store.fs import FsDataStore
+
+    root = str(tmp_path / "store")
+    s = FsDataStore(root)
+    s.create_schema(parse_spec("t", SPEC))
+    with s.writer("t") as w:
+        for i in range(400):
+            w.write([ACTORS[i % len(ACTORS)] or None, f"note-{i % 9}",
+                     1760000000000 + i, Point(i % 360 - 180, (i % 170) - 85)],
+                    fid=f"f{i}")
+    want = set(map(str, s.query("t", "actor = 'CHN'").fids))
+    assert want
+    s2 = FsDataStore(root)
+    got = set(map(str, s2.query("t", "actor = 'CHN'").fids))
+    assert got == want

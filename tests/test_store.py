@@ -276,8 +276,13 @@ def test_interned_string_columns_null_vs_empty():
         w.write([None, Point(2, 2)], fid="b")
         w.write(["", Point(3, 3)], fid="c")
     table = next(iter(s._tables["t"].values()))
-    col = table.blocks[0].full_col("name")
-    assert col.dtype.kind == "U", col.dtype  # interned
+    blk = table.blocks[0]
+    col = blk.full_col("name")
+    # low-cardinality strings dictionary-encode: int32 codes + sorted vocab
+    assert col.dtype == np.int32, col.dtype
+    vocab = blk.record.columns["name__vocab"]
+    assert vocab.dtype.kind == "U" and list(vocab) == sorted(vocab)
+    assert (col == -1).sum() == 1  # the null row
     assert sorted(s.query("t", "name = ''").fids) == ["c"]  # null excluded
     assert sorted(s.query("t", "name IS NULL").fids) == ["b"]
     assert sorted(s.query("t", "name = 'alpha'").fids) == ["a"]
