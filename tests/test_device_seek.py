@@ -1,0 +1,117 @@
+"""Device-assisted seek protocol (executor._devseek_fn/_DeviceSeekScan):
+host plans candidate intervals, the device gathers + exact-tests only the
+candidates and returns a packed bitmap. Forced on via GEOMESA_DEVSEEK=1
+(the CPU backend auto-declines) and checked for exact parity against the
+host paths — the role of accumulo/iterators/Z3Iterator.scala:42-65 with
+per-row work proportional to candidates, not N."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.parallel.executor import _DeviceSeekScan
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+
+def _store(n=30_000, batches=3, with_null_dates=False, seed=11):
+    rng = np.random.default_rng(seed)
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    ft = parse_spec("t", "dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    base = np.datetime64("2026-03-01", "ms").astype(np.int64)
+    per = n // batches
+    for b in range(batches):
+        x = rng.uniform(-180, 180, per)
+        y = rng.uniform(-90, 90, per)
+        t = base + rng.integers(0, 12 * 86400_000, per)
+        cols = {
+            "__fid__": np.array([f"f{b}_{i}" for i in range(per)]),
+            "geom__x": x,
+            "geom__y": y,
+            "dtg": t,
+        }
+        if with_null_dates and b == 0:
+            nulls = np.zeros(per, dtype=bool)
+            nulls[:: 50] = True
+            cols["dtg"] = np.where(nulls, 0, t)
+            cols["dtg__null"] = nulls
+        store._insert_columns(ft, cols)
+    return store
+
+
+QUERIES = [
+    "bbox(geom, -30, -20, 40, 35) AND dtg DURING 2026-03-02T00:00:00Z/2026-03-07T12:00:00Z",
+    "bbox(geom, 10, 10, 11, 11)",
+    "bbox(geom, -180, -90, 180, 90) AND dtg AFTER 2026-03-10T00:00:00Z",
+    "bbox(geom, 0, 0, 90, 45) AND dtg BEFORE 2026-03-04T06:30:00Z",
+]
+
+
+def _devseek_chosen(store, cql) -> bool:
+    plan = store.planner("t").plan(Query.cql(cql))
+    scan = store.executor._seek_scan(store._tables["t"][plan.index.name], plan)
+    return isinstance(scan, _DeviceSeekScan)
+
+
+def test_devseek_parity_vs_host(monkeypatch):
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "1")
+    dev = _store()
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "0")
+    host = _store()
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "1")
+    assert any(_devseek_chosen(dev, q) for q in QUERIES)
+    for q in QUERIES:
+        got = set(map(str, dev.query("t", q).fids))
+        want = set(map(str, host.query("t", q).fids))
+        assert got == want, (q, len(got), len(want))
+
+
+def test_devseek_tombstones(monkeypatch):
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "1")
+    store = _store(batches=2)
+    before = set(map(str, store.query("t", QUERIES[0]).fids))
+    victims = sorted(before)[: len(before) // 2]
+    store.delete_features("t", victims)
+    after = set(map(str, store.query("t", QUERIES[0]).fids))
+    assert after == before - set(victims)
+
+
+def test_devseek_null_dates_excluded_from_temporal(monkeypatch):
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "1")
+    dev = _store(with_null_dates=True)
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "0")
+    host = _store(with_null_dates=True)
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "1")
+    q = QUERIES[0]
+    got = set(map(str, dev.query("t", q).fids))
+    want = set(map(str, host.query("t", q).fids))
+    assert got == want
+    # bbox-only keeps null-date rows (valid, not tvalid)
+    q2 = "bbox(geom, -180, -90, 180, 90)"
+    assert len(dev.query("t", q2)) == len(host.query("t", q2))
+
+
+def test_devseek_declines_on_residual(monkeypatch):
+    """Plans with a residual secondary must NOT take the exact device
+    shortcut — the fallback host paths answer them."""
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "1")
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    ft = parse_spec("t", "name:String,dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    with store.writer("t") as w:
+        rng = np.random.default_rng(2)
+        base = np.datetime64("2026-03-01", "ms").astype(np.int64)
+        for i in range(5000):
+            w.write([f"n{i % 7}", int(base + rng.integers(0, 5 * 86400_000)),
+                     Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90)))],
+                    fid=f"f{i}")
+    q = "bbox(geom, -90, -45, 90, 45) AND name = 'n3'"
+    got = set(map(str, store.query("t", q).fids))
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "0")
+    store2_want = set(map(str, store.query("t", q).fids))
+    assert got == store2_want and got
+    for f in got:
+        assert int(f[1:]) % 7 == 3

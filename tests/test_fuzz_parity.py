@@ -92,12 +92,21 @@ def test_fuzz_parity_host_vs_device(monkeypatch, exact_mode):
 
 
 @pytest.mark.parametrize(
-    "seek,no_native", [("auto", ""), ("auto", "1"), ("1", ""), ("0", "")]
+    "seek,no_native,devseek",
+    [
+        ("auto", "", ""),
+        ("auto", "1", ""),
+        ("1", "", ""),
+        ("0", "", ""),
+        ("auto", "", "1"),  # device-assisted seek (forced on CPU backend)
+    ],
 )
-def test_fuzz_parity_seek_modes(monkeypatch, seek, no_native):
+def test_fuzz_parity_seek_modes(monkeypatch, seek, no_native, devseek):
     """The seek chooser, covered-split, native kernel and device paths must
     all agree with the host oracle across the random corpus."""
     monkeypatch.setenv("GEOMESA_SEEK", seek)
+    if devseek:
+        monkeypatch.setenv("GEOMESA_DEVSEEK", devseek)
     if no_native:
         monkeypatch.setenv("GEOMESA_TPU_NO_NATIVE", no_native)
     else:
