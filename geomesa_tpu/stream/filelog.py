@@ -1,0 +1,178 @@
+"""Durable cross-process streaming transport: file-backed partitioned log.
+
+The role of the reference's Kafka broker + ZooKeeper offset store
+(geomesa-kafka .../data/KafkaDataStore.scala:44-90 — durable partitioned
+topics surviving producer/consumer crashes;
+geomesa-lambda .../stream/ZookeeperOffsetManager.scala — consumer offsets
+persisted out-of-process so a restarted consumer resumes where it died),
+rebuilt on the filesystem:
+
+  <root>/<topic>/p<k>.log      append-only [u32 len][payload] records
+  <root>/offsets/<group>.json  per-(topic, partition) committed offsets
+
+Any number of OS processes can share one root: appends serialize through
+an exclusive flock per partition file and are flushed before the lock
+drops, so a record is either fully visible to every reader or not at all
+(readers stop at a torn tail). Offsets are committed atomically
+(write + rename). ``InProcessBroker`` and ``FileLogBroker`` expose the
+same three-method contract (send / poll / end_offsets), so the stream
+and lambda tiers run unchanged on either transport.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+_LEN = struct.Struct("<I")
+
+
+class FileLogBroker:
+    """Partitioned append-only log under a directory; safe across
+    processes (flock-serialized appends) and crashes (torn tails are
+    ignored until completed)."""
+
+    def __init__(self, root: str, partitions: int = 4, fsync: bool = False):
+        self.root = root
+        self.partitions = partitions
+        self.fsync = fsync
+        # reader position cache: (topic, partition, ordinal) -> byte pos
+        self._pos: Dict[Tuple[str, int], Tuple[int, int]] = {}
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, topic: str, partition: int) -> str:
+        d = os.path.join(self.root, topic)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"p{partition}.log")
+
+    # -- producer ------------------------------------------------------------
+
+    def send(self, topic: str, partition: int, payload: bytes) -> int:
+        path = self._path(topic, partition)
+        with open(path, "ab") as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            try:
+                f.write(_LEN.pack(len(payload)))
+                f.write(payload)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+        # ordinal is informational for file logs (scan-derived on read)
+        return -1
+
+    # -- consumer ------------------------------------------------------------
+
+    def _scan_from(self, f, start_ord: int, start_pos: int, max_records: int):
+        """Read complete records from (ordinal, byte pos) forward; returns
+        ([(ordinal, payload)], next_ord, next_pos). Stops cleanly at a
+        torn tail (partial length prefix or truncated payload)."""
+        f.seek(start_pos)
+        out = []
+        ordn, pos = start_ord, start_pos
+        while len(out) < max_records:
+            head = f.read(4)
+            if len(head) < 4:
+                break
+            (n,) = _LEN.unpack(head)
+            payload = f.read(n)
+            if len(payload) < n:
+                break  # torn tail: a concurrent append not yet complete
+            out.append((ordn, payload))
+            ordn += 1
+            pos += 4 + n
+        return out, ordn, pos
+
+    def poll(
+        self, topic: str, offsets: Dict[int, int], max_records: int = 10000
+    ) -> List[Tuple[int, int, bytes]]:
+        """Fetch records after the given per-partition offsets (ordinals).
+        Returns [(partition, ordinal, payload)]; caller advances offsets."""
+        out: List[Tuple[int, int, bytes]] = []
+        for p in range(self.partitions):
+            want = offsets.get(p, 0)
+            path = self._path(topic, p)
+            if not os.path.exists(path):
+                continue
+            size = os.path.getsize(path)
+            cached = self._pos.get((topic, p))
+            ordn, pos = (0, 0)
+            if cached is not None and cached[0] <= want:
+                ordn, pos = cached
+            with open(path, "rb") as f:
+                # skip forward to the wanted ordinal by header hops (the
+                # cached position makes this a no-op on steady-state polls)
+                while ordn < want and pos + 4 <= size:
+                    f.seek(pos)
+                    (n,) = _LEN.unpack(f.read(4))
+                    if pos + 4 + n > size:
+                        break  # torn tail
+                    pos += 4 + n
+                    ordn += 1
+                if ordn < want:
+                    continue  # log shorter than the committed offset
+                recs, next_ord, next_pos = self._scan_from(
+                    f, ordn, pos, max_records
+                )
+            self._pos[(topic, p)] = (next_ord, next_pos)
+            out.extend((p, o, payload) for o, payload in recs)
+        return out
+
+    def end_offsets(self, topic: str) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for p in range(self.partitions):
+            path = self._path(topic, p)
+            n = 0
+            if os.path.exists(path):
+                size = os.path.getsize(path)
+                # header hops only — counting must not materialize payloads
+                with open(path, "rb") as f:
+                    pos = 0
+                    while pos + 4 <= size:
+                        f.seek(pos)
+                        (ln,) = _LEN.unpack(f.read(4))
+                        if pos + 4 + ln > size:
+                            break  # torn tail
+                        pos += 4 + ln
+                        n += 1
+            out[p] = n
+        return out
+
+
+class FileOffsetManager:
+    """Committed consumer-group offsets, persisted atomically per commit
+    (the ZookeeperOffsetManager analog: a restarted consumer resumes from
+    its last commit and replays everything after it).
+
+    One file per (group, topic): a commit atomically replaces ONLY its own
+    topic's file (pid-unique tmp + rename) — no read-modify-write of
+    shared state, so concurrent commits for different topics in one group
+    can never lose or corrupt each other. Two live consumers committing
+    the SAME (group, topic) are last-writer-wins, as in the reference's
+    model where a consumer group assigns each partition to one consumer."""
+
+    def __init__(self, root: str, group: str = "default"):
+        self.dir = os.path.join(root, "offsets")
+        os.makedirs(self.dir, exist_ok=True)
+        self.group = group
+
+    def _path(self, topic: str) -> str:
+        return os.path.join(self.dir, f"{self.group}__{topic}.json")
+
+    def commit(self, topic: str, offsets: Dict[int, int]) -> None:
+        tmp = f"{self._path(topic)}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(p): int(o) for p, o in offsets.items()}, f)
+        os.replace(tmp, self._path(topic))
+
+    def offsets(self, topic: str) -> Dict[int, int]:
+        try:
+            with open(self._path(topic)) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        return {int(p): int(o) for p, o in raw.items()}

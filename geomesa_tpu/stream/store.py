@@ -91,10 +91,17 @@ class StreamDataStore:
         broker: Optional[InProcessBroker] = None,
         expiry_ms: Optional[int] = None,
         clock: Callable[[], int] = _now_ms,
+        offset_manager=None,
     ):
+        """``offset_manager`` (stream.filelog.FileOffsetManager or
+        compatible): when given, consumed offsets are committed after
+        every poll and the consumer RESUMES from its last commit on
+        restart — the ZookeeperOffsetManager durability contract. Without
+        one, offsets live in-process (the transient-cache contract)."""
         self.broker = broker or InProcessBroker()
         self.expiry_ms = expiry_ms
         self.clock = clock
+        self.offset_manager = offset_manager
         self._schemas: Dict[str, FeatureType] = {}
         self._serializers: Dict[str, GeoMessageSerializer] = {}
         self._caches: Dict[str, FeatureCache] = {}
@@ -109,7 +116,11 @@ class StreamDataStore:
         self._schemas[ft.name] = ft
         self._serializers[ft.name] = GeoMessageSerializer(ft)
         self._caches[ft.name] = FeatureCache(ft, self.expiry_ms)
-        self._offsets[ft.name] = {}
+        self._offsets[ft.name] = (
+            dict(self.offset_manager.offsets(ft.name))
+            if self.offset_manager is not None
+            else {}
+        )
         self._listeners[ft.name] = []
 
     def get_schema(self, name: str) -> FeatureType:
@@ -159,6 +170,8 @@ class StreamDataStore:
             offsets[p] = off + 1
             for fn in self._listeners[name]:
                 fn(msg)
+        if records and self.offset_manager is not None:
+            self.offset_manager.commit(name, offsets)
         cache.expire(self.clock())
         return len(records)
 
