@@ -10,7 +10,11 @@ pushdown (SQLRules.scala:30-62). Here the same roles are:
     where / with_column / group_by aggregation; spatial predicates push
     down to the datastore's CQL planner when constructed via
     ``SpatialFrame.from_query`` (the Catalyst-rule analog).
+  * ``SQLContext`` — the SQL string surface: SELECT / WHERE / GROUP BY
+    whose ST_* predicates compile into the filter AST and go through the
+    cost-based index planner (``SqlResult.explain`` proves the pushdown).
 """
 
 from geomesa_tpu.compute import st_functions as st
 from geomesa_tpu.compute.frame import SpatialFrame
+from geomesa_tpu.compute.sql import SQLContext, SqlResult

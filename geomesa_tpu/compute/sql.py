@@ -1,0 +1,511 @@
+"""SQL string surface over the datastore: SELECT with ST_* pushdown.
+
+The Catalyst-rule analog (geomesa-spark-sql .../SQLRules.scala:30-62 folds
+``ScalaUDF(ST_*)`` predicates in the WHERE clause into the relation's CQL
+so the z-index answers them; SQLTypes registers the ~40 ST_* UDFs): a
+small SELECT / FROM / WHERE / GROUP BY / ORDER BY / LIMIT dialect whose
+spatial and attribute predicates compile DIRECTLY to the filter AST and
+go through the cost-based planner — ``SqlResult.explain`` shows the index
+the pushdown chose. Aggregations (count/sum/avg/min/max, grouped or
+global) and scalar ST_* projections run client-side over the columnar
+result, like the reference's Spark stage after the pushed scan.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.compute import st_functions as st
+from geomesa_tpu.compute.frame import SpatialFrame
+from geomesa_tpu.filter import ast
+from geomesa_tpu.geom.base import Envelope, Geometry, Point, Polygon
+from geomesa_tpu.geom.wkt import parse_wkt
+from geomesa_tpu.index.planner import Query
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<str>'(?:[^']|'')*')
+      | (?P<num>-?\d+\.?\d*(?:[eE][+-]?\d+)?)
+      | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+      | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*)
+    )""",
+    re.VERBOSE,
+)
+
+_AGG_FNS = {"count", "sum", "avg", "mean", "min", "max"}
+
+
+class SqlError(ValueError):
+    pass
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            if text[pos:].strip():
+                raise SqlError(f"Cannot tokenize at: {text[pos:pos+25]!r}")
+            break
+        pos = m.end()
+        for kind in ("str", "num", "ident", "op"):
+            v = m.group(kind)
+            if v is not None:
+                out.append((kind, v))
+                break
+    out.append(("end", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, text: str, ft):
+        self.toks = _tokenize(text)
+        self.i = 0
+        self.ft = ft
+
+    # -- token helpers -------------------------------------------------------
+
+    def peek(self, kw: Optional[str] = None):
+        kind, v = self.toks[self.i]
+        if kw is not None:
+            return kind == "ident" and v.upper() == kw
+        return kind, v
+
+    def take(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect_kw(self, kw: str):
+        kind, v = self.take()
+        if kind != "ident" or v.upper() != kw:
+            raise SqlError(f"Expected {kw}, got {v!r}")
+
+    def expect_op(self, op: str):
+        kind, v = self.take()
+        if kind != "op" or v != op:
+            raise SqlError(f"Expected {op!r}, got {v!r}")
+
+    def accept_kw(self, kw: str) -> bool:
+        if self.peek(kw):
+            self.i += 1
+            return True
+        return False
+
+    # -- grammar -------------------------------------------------------------
+
+    def parse(self) -> dict:
+        self.expect_kw("SELECT")
+        items = [self.select_item()]
+        while self.accept_op(","):
+            items.append(self.select_item())
+        self.expect_kw("FROM")
+        kind, table = self.take()
+        if kind != "ident":
+            raise SqlError("Expected table name after FROM")
+        where = None
+        if self.accept_kw("WHERE"):
+            where = self.or_expr()
+        group = []
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            group.append(self.ident())
+            while self.accept_op(","):
+                group.append(self.ident())
+        order = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                col = self.ident()
+                asc = True
+                if self.accept_kw("DESC"):
+                    asc = False
+                else:
+                    self.accept_kw("ASC")
+                order.append((col, asc))
+                if not self.accept_op(","):
+                    break
+        limit = None
+        if self.accept_kw("LIMIT"):
+            kind, v = self.take()
+            if kind != "num":
+                raise SqlError("Expected number after LIMIT")
+            limit = int(float(v))
+        kind, v = self.take()
+        if kind != "end":
+            raise SqlError(f"Trailing input at {v!r}")
+        return {
+            "items": items,
+            "table": table,
+            "where": where,
+            "group": group,
+            "order": order,
+            "limit": limit,
+        }
+
+    def accept_op(self, op: str) -> bool:
+        kind, v = self.toks[self.i]
+        if kind == "op" and v == op:
+            self.i += 1
+            return True
+        return False
+
+    def ident(self) -> str:
+        kind, v = self.take()
+        if kind != "ident":
+            raise SqlError(f"Expected identifier, got {v!r}")
+        return v
+
+    def select_item(self) -> dict:
+        kind, v = self.toks[self.i]
+        if kind == "op" and v == "*":
+            self.i += 1
+            return {"kind": "star"}
+        if kind == "ident":
+            name = v
+            nk, nv = self.toks[self.i + 1]
+            if nk == "op" and nv == "(":
+                self.i += 2
+                low = name.lower()
+                if low in _AGG_FNS:
+                    if self.accept_op("*"):
+                        arg = "*"
+                    else:
+                        arg = self.ident()
+                    self.expect_op(")")
+                    item = {"kind": "agg", "fn": low, "arg": arg,
+                            "alias": f"{low}_{arg if arg != '*' else 'all'}"}
+                elif low.startswith("st_"):
+                    args = self.call_args()
+                    item = {"kind": "stfn", "fn": low, "args": args,
+                            "alias": low}
+                else:
+                    raise SqlError(f"Unknown function {name}")
+                if self.accept_kw("AS"):
+                    item["alias"] = self.ident()
+                return item
+            self.i += 1
+            item = {"kind": "col", "name": name, "alias": name}
+            if self.accept_kw("AS"):
+                item["alias"] = self.ident()
+            return item
+        raise SqlError(f"Bad select item at {v!r}")
+
+    def call_args(self) -> list:
+        """Arguments of an already-opened call; consumes the ')'."""
+        args = []
+        if not self.accept_op(")"):
+            args.append(self.value_expr())
+            while self.accept_op(","):
+                args.append(self.value_expr())
+            self.expect_op(")")
+        return args
+
+    def value_expr(self):
+        """Literal, column reference, or ST_* constructor call."""
+        kind, v = self.take()
+        if kind == "str":
+            return ("lit", v[1:-1].replace("''", "'"))
+        if kind == "num":
+            return ("lit", float(v) if "." in v or "e" in v.lower() else int(v))
+        if kind == "ident":
+            nk, nv = self.toks[self.i]
+            if nk == "op" and nv == "(":
+                self.i += 1
+                fn = v.lower()
+                args = self.call_args()
+                return ("call", fn, args)
+            return ("col", v)
+        raise SqlError(f"Bad value at {v!r}")
+
+    # WHERE expression with OR < AND < NOT precedence
+    def or_expr(self) -> ast.Filter:
+        left = self.and_expr()
+        while self.accept_kw("OR"):
+            left = ast.Or([left, self.and_expr()])
+        return left
+
+    def and_expr(self) -> ast.Filter:
+        left = self.not_expr()
+        while self.accept_kw("AND"):
+            left = ast.And([left, self.not_expr()])
+        return left
+
+    def not_expr(self) -> ast.Filter:
+        if self.accept_kw("NOT"):
+            return ast.Not(self.not_expr())
+        if self.accept_op("("):
+            f = self.or_expr()
+            self.expect_op(")")
+            return f
+        return self.predicate()
+
+    def predicate(self) -> ast.Filter:
+        kind, v = self.toks[self.i]
+        if kind != "ident":
+            raise SqlError(f"Expected predicate at {v!r}")
+        low = v.lower()
+        if low.startswith("st_") or low == "bbox":
+            self.i += 1
+            self.expect_op("(")
+            args = self.call_args()
+            return self.spatial_predicate(low, args)
+        prop = self.ident()
+        if self.accept_kw("BETWEEN"):
+            lo = self.value_expr()
+            self.expect_kw("AND")
+            hi = self.value_expr()
+            return ast.Between(prop, _lit(lo), _lit(hi))
+        if self.accept_kw("LIKE"):
+            kind, pat = self.take()
+            if kind != "str":
+                raise SqlError("LIKE needs a string pattern")
+            return ast.Like(prop, pat[1:-1].replace("''", "'"))
+        if self.accept_kw("IN"):
+            self.expect_op("(")
+            vals = [_lit(self.value_expr())]
+            while self.accept_op(","):
+                vals.append(_lit(self.value_expr()))
+            self.expect_op(")")
+            return ast.InList(prop, vals)
+        if self.accept_kw("IS"):
+            negate = self.accept_kw("NOT")
+            self.expect_kw("NULL")
+            return ast.IsNull(prop, negate=negate)
+        kind, op = self.take()
+        if kind != "op" or op not in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            raise SqlError(f"Bad comparison operator {op!r}")
+        rhs = _lit(self.value_expr())
+        if op == "!=":
+            op = "<>"
+        return ast.Cmp(prop, op, rhs)
+
+    # -- ST_* predicate folding (SQLRules.scala:33-62 analog) -----------------
+
+    def spatial_predicate(self, fn: str, args: list) -> ast.Filter:
+        if fn == "bbox":
+            col = _column_name(args[0])
+            vals = [float(_lit(a)) for a in args[1:5]]
+            return ast.BBox(col, *vals)
+        if fn == "st_dwithin":
+            col, geom, swapped = _col_and_geom(args[0], args[1])
+            dist = float(_lit(args[2]))
+            unit = "meters"
+            if len(args) > 3:
+                unit = str(_lit(args[3]))
+            return ast.DWithin(col, geom, dist, unit)
+        if fn not in (
+            "st_contains", "st_within", "st_intersects", "st_disjoint",
+            "st_equals",
+        ):
+            raise SqlError(f"Unsupported spatial predicate {fn}")
+        col, geom, swapped = _col_and_geom(args[0], args[1])
+        if fn == "st_intersects":
+            return ast.Intersects(col, geom)
+        if fn == "st_disjoint":
+            return ast.Disjoint(col, geom)
+        if fn == "st_equals":
+            return ast.And([ast.Within(col, geom), ast.Contains(col, geom)])
+        # contains/within: direction depends on which argument is the column
+        if fn == "st_contains":
+            # contains(a, b): b inside a
+            return ast.Within(col, geom) if swapped else ast.Contains(col, geom)
+        # within(a, b): a inside b
+        return ast.Contains(col, geom) if swapped else ast.Within(col, geom)
+
+
+def _lit(v):
+    if v[0] != "lit":
+        raise SqlError(f"Expected literal, got {v!r}")
+    return v[1]
+
+
+def _column_name(v) -> str:
+    if v[0] != "col":
+        raise SqlError(f"Expected column reference, got {v!r}")
+    return v[1]
+
+
+def _eval_geometry(v) -> Geometry:
+    """Constant geometry expression -> Geometry."""
+    if v[0] == "lit" and isinstance(v[1], str):
+        return parse_wkt(v[1])
+    if v[0] != "call":
+        raise SqlError(f"Expected geometry expression, got {v!r}")
+    _, fn, args = v
+    if fn in ("st_geomfromwkt", "st_geomfromtext", "st_pointfromtext",
+              "st_linefromtext", "st_polygonfromtext"):
+        return parse_wkt(str(_lit(args[0])))
+    if fn in ("st_makebbox", "st_makebox2d"):
+        vals = [float(_lit(a)) for a in args]
+        e = Envelope(*vals)
+        return Polygon(
+            [[e.xmin, e.ymin], [e.xmax, e.ymin], [e.xmax, e.ymax],
+             [e.xmin, e.ymax], [e.xmin, e.ymin]]
+        )
+    if fn in ("st_point", "st_makepoint"):
+        return Point(float(_lit(args[0])), float(_lit(args[1])))
+    if fn == "st_geomfromgeohash":
+        return st.st_geom_from_geohash(str(_lit(args[0])))
+    raise SqlError(f"Unsupported geometry constructor {fn}")
+
+
+def _col_and_geom(a, b) -> Tuple[str, Geometry, bool]:
+    """(column, constant geometry, swapped): swapped=True when the column
+    was the SECOND argument."""
+    if a[0] == "col":
+        return a[1], _eval_geometry(b), False
+    if b[0] == "col":
+        return b[1], _eval_geometry(a), True
+    raise SqlError("Spatial predicate needs one column argument")
+
+
+class SqlResult(SpatialFrame):
+    """SpatialFrame + the pushed-down query plan (explain proves which
+    index answered the WHERE clause)."""
+
+    def __init__(self, columns, ft=None, plan=None):
+        super().__init__(columns, ft)
+        self.plan = plan
+
+    @property
+    def explain(self) -> str:
+        return self.plan.explain if self.plan is not None else "(no plan)"
+
+
+class SQLContext:
+    """``SQLContext(store).sql("SELECT ... WHERE st_contains(...)")`` —
+    the GeoMesaSparkSQL relation role over a TpuDataStore."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def sql(self, text: str) -> SqlResult:
+        # the FROM table determines the schema used during parsing
+        m = re.search(r"\bfrom\s+([A-Za-z_][A-Za-z_0-9]*)", text, re.IGNORECASE)
+        if m is None:
+            raise SqlError("Missing FROM clause")
+        ft = self.store.get_schema(m.group(1))
+        q = _Parser(text, ft).parse()
+        return self._execute(ft, q)
+
+    # -- execution -----------------------------------------------------------
+
+    def _execute(self, ft, q: dict) -> SqlResult:
+        items = q["items"]
+        aggs = [it for it in items if it["kind"] == "agg"]
+        plain = [it for it in items if it["kind"] == "col"]
+        stfns = [it for it in items if it["kind"] == "stfn"]
+        star = any(it["kind"] == "star" for it in items)
+
+        # projection pushdown: only the columns the SELECT needs leave the
+        # scan (group keys, agg sources, plain columns, st-fn inputs)
+        props: Optional[List[str]] = None
+        if not star:
+            needed = set(q["group"])
+            needed.update(it["name"] for it in plain)
+            needed.update(it["arg"] for it in aggs if it["arg"] != "*")
+            for it in stfns:
+                needed.update(a[1] for a in it["args"] if a[0] == "col")
+            if aggs and not needed:
+                geom = ft.default_geometry
+                needed.add(geom.name if geom is not None else ft.attributes[0].name)
+            props = sorted(needed)
+        query = Query(
+            filter=q["where"] if q["where"] is not None else ast.Include(),
+            properties=props,
+            sort_by=q["order"] or None,
+            max_features=q["limit"] if not aggs and not q["group"] else None,
+        )
+        res = self.store.query(ft.name, query)
+        frame = SpatialFrame(
+            res.columns if isinstance(res.columns, dict) else res.columns.materialize(),
+            res.ft,
+        )
+        # scalar ST_* projections (computed client-side, like Spark's
+        # post-scan stage)
+        for it in stfns:
+            frame = frame.with_column(
+                it["alias"], _apply_stfn(frame, ft, it["fn"], it["args"])
+            )
+        if aggs or q["group"]:
+            out = self._aggregate(frame, q["group"], aggs, plain)
+            if q["order"]:
+                for col, asc in reversed(q["order"]):
+                    if col in out.columns:
+                        out = out.sort(col, asc)
+            if q["limit"] is not None:
+                out = SqlResult(
+                    {k: v[: q["limit"]] for k, v in out.columns.items()},
+                    out.ft, res.plan,
+                )
+                return out
+            return SqlResult(out.columns, out.ft, res.plan)
+        if not star:
+            keep = [it["alias"] for it in plain] + [it["alias"] for it in stfns]
+            cols: Dict[str, np.ndarray] = {}
+            for it in plain:
+                src = it["name"]
+                for k, v in frame.columns.items():
+                    if k == src or (
+                        k.startswith(src + "__") and not k.endswith("__vocab")
+                    ):
+                        cols[k if it["alias"] == src else it["alias"]] = v
+            for it in stfns:
+                cols[it["alias"]] = frame.columns[it["alias"]]
+            frame = SpatialFrame(cols, frame.ft)
+            del keep
+        return SqlResult(frame.columns, frame.ft, res.plan)
+
+    @staticmethod
+    def _aggregate(frame: SpatialFrame, group: List[str], aggs, plain) -> SpatialFrame:
+        fn_map = {"count": "count", "sum": "sum", "avg": "mean",
+                  "mean": "mean", "min": "min", "max": "max"}
+        if group:
+            spec = {}
+            for it in aggs:
+                src = it["arg"]
+                if src == "*":
+                    src = group[0]
+                spec[it["alias"]] = (fn_map[it["fn"]], src)
+            key = group[0]
+            out = frame.group_by(key, spec)
+            if len(group) > 1:
+                raise SqlError("GROUP BY supports one key column")
+            return out
+        # global aggregate: one row
+        cols: Dict[str, np.ndarray] = {}
+        n = len(frame)
+        for it in aggs:
+            if it["fn"] == "count":
+                cols[it["alias"]] = np.asarray([n])
+            else:
+                src = frame.columns[it["arg"]]
+                cols[it["alias"]] = np.asarray(
+                    [SpatialFrame._AGGS[fn_map[it["fn"]]](src) if n else 0]
+                )
+        return SpatialFrame(cols, None)
+
+
+def _apply_stfn(frame: SpatialFrame, ft, fn: str, args: list) -> np.ndarray:
+    """Scalar ST_* select expressions over result columns."""
+    geom = ft.default_geometry.name if ft.default_geometry is not None else None
+
+    def coord(axis: str, col: str) -> np.ndarray:
+        got = frame.columns.get(f"{col}__{axis}")
+        if got is None:
+            raise SqlError(f"{fn} needs point column {col}")
+        return got
+
+    if fn in ("st_x", "st_y"):
+        col = args[0][1] if args and args[0][0] == "col" else geom
+        return coord("x" if fn == "st_x" else "y", col)
+    if fn == "st_geohash":
+        col = args[0][1] if args and args[0][0] == "col" else geom
+        prec = int(_lit(args[1])) if len(args) > 1 else 9
+        return st.st_geohash(coord("x", col), coord("y", col), prec)
+    raise SqlError(f"Unsupported select function {fn}")

@@ -168,3 +168,177 @@ def st_bin_time(t_ms, period="week"):
     from geomesa_tpu.curve import time_to_binned
 
     return time_to_binned(np.asarray(t_ms, dtype=np.int64), period)
+
+
+# -- constructors (text / geohash / parts) -----------------------------------
+
+def st_make_point(x: float, y: float) -> Point:
+    return Point(float(x), float(y))
+
+
+def st_make_line(points) -> "Geometry":
+    """points: [(x, y), ...] or Nx2 array -> LineString."""
+    from geomesa_tpu.geom.base import LineString
+
+    return LineString(np.asarray(points, dtype=np.float64))
+
+
+def st_make_polygon(shell) -> Polygon:
+    """shell: closed ring [(x, y), ...] -> Polygon."""
+    return Polygon(np.asarray(shell, dtype=np.float64))
+
+
+def st_geom_from_text(wkt: str) -> Geometry:
+    return st_geom_from_wkt(wkt)
+
+
+def st_point_from_text(wkt: str) -> Point:
+    g = st_geom_from_wkt(wkt)
+    if not isinstance(g, Point):
+        raise ValueError("ST_PointFromText needs POINT wkt")
+    return g
+
+
+def st_line_from_text(wkt: str) -> "Geometry":
+    from geomesa_tpu.geom.base import LineString
+
+    g = st_geom_from_wkt(wkt)
+    if not isinstance(g, LineString):
+        raise ValueError("ST_LineFromText needs LINESTRING wkt")
+    return g
+
+
+def st_polygon_from_text(wkt: str) -> Polygon:
+    g = st_geom_from_wkt(wkt)
+    if not isinstance(g, Polygon):
+        raise ValueError("ST_PolygonFromText needs POLYGON wkt")
+    return g
+
+
+def st_geom_from_geohash(gh: str) -> Polygon:
+    """Geohash cell -> its bounding polygon (ST_GeomFromGeoHash)."""
+    from geomesa_tpu.utils.geohash import decode_bounds
+
+    xmin, ymin, xmax, ymax = decode_bounds(gh)
+    return Envelope(xmin, ymin, xmax, ymax).to_polygon()
+
+
+def st_box2d_from_geohash(gh: str) -> Envelope:
+    from geomesa_tpu.utils.geohash import decode_bounds
+
+    return Envelope(*decode_bounds(gh))
+
+
+# -- accessors / converters ---------------------------------------------------
+
+def st_as_text(g: Geometry) -> str:
+    from geomesa_tpu.geom.wkt import to_wkt
+
+    return to_wkt(g)
+
+
+def st_as_geojson(g: Geometry) -> str:
+    import json
+
+    from geomesa_tpu.geom.base import LineString
+
+    if isinstance(g, Point):
+        return json.dumps({"type": "Point", "coordinates": [g.x, g.y]})
+    if isinstance(g, LineString):
+        return json.dumps(
+            {"type": "LineString", "coordinates": np.asarray(g.coords).tolist()}
+        )
+    if isinstance(g, Polygon):
+        rings = [np.asarray(r).tolist() for r in [g.shell, *g.holes]]
+        return json.dumps({"type": "Polygon", "coordinates": rings})
+    raise ValueError(f"Cannot serialize {type(g).__name__}")
+
+
+def st_num_points(g: Geometry) -> int:
+    from geomesa_tpu.geom.base import LineString, _Multi
+
+    if isinstance(g, Point):
+        return 1
+    if isinstance(g, LineString):
+        return len(np.asarray(g.coords))
+    if isinstance(g, Polygon):
+        return sum(len(np.asarray(r)) for r in [g.shell, *g.holes])
+    if isinstance(g, _Multi):
+        return sum(st_num_points(m) for m in g.geoms)
+    raise ValueError(f"ST_NumPoints: unsupported {type(g).__name__}")
+
+
+def st_is_empty(g) -> bool:
+    return g is None or st_num_points(g) == 0
+
+
+def st_is_valid(g) -> bool:
+    """Light validity: non-empty, finite coordinates, closed polygon rings."""
+    if g is None:
+        return False
+    if isinstance(g, Point):
+        return bool(np.isfinite([g.x, g.y]).all())
+    from geomesa_tpu.geom.base import LineString
+
+    if isinstance(g, LineString):
+        c = np.asarray(g.coords)
+        return len(c) >= 2 and bool(np.isfinite(c).all())
+    if isinstance(g, Polygon):
+        for r in [g.shell, *g.holes]:
+            c = np.asarray(r)
+            if len(c) < 4 or not np.isfinite(c).all() or not np.allclose(c[0], c[-1]):
+                return False
+        return True
+    from geomesa_tpu.geom.base import _Multi
+
+    if isinstance(g, _Multi):
+        return len(g.geoms) > 0 and all(st_is_valid(m) for m in g.geoms)
+    return False
+
+
+def st_exterior_ring(g: Polygon) -> "Geometry":
+    from geomesa_tpu.geom.base import LineString
+
+    return LineString(np.asarray(g.shell))
+
+
+def st_coord_dim(g: Geometry) -> int:
+    return 2
+
+
+# GeoMesa-parity alias for the existing accessor (SQLSpatialAccessors)
+st_bounding_box = st_envelope
+
+
+def st_expand_bbox(env: Envelope, dx: float, dy: float = None) -> Envelope:
+    dy = dx if dy is None else dy
+    return Envelope(env.xmin - dx, env.ymin - dy, env.xmax + dx, env.ymax + dy)
+
+
+# -- row-wise predicates over geometry object columns -------------------------
+
+def st_intersects_geoms(geoms, query: Geometry) -> np.ndarray:
+    """Vectorized-over-rows exact intersects for object geometry columns."""
+    from geomesa_tpu.geom.predicates import geometries_intersect
+
+    return np.fromiter(
+        (g is not None and geometries_intersect(g, query) for g in geoms),
+        bool,
+        len(geoms),
+    )
+
+
+def st_within_geoms(geoms, query: Geometry) -> np.ndarray:
+    from geomesa_tpu.geom.predicates import geometry_within
+
+    return np.fromiter(
+        (g is not None and geometry_within(g, query) for g in geoms),
+        bool,
+        len(geoms),
+    )
+
+
+def st_disjoint_geoms(geoms, query: Geometry) -> np.ndarray:
+    out = st_intersects_geoms(geoms, query)
+    notnull = np.fromiter((g is not None for g in geoms), bool, len(geoms))
+    return ~out & notnull

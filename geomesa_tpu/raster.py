@@ -27,18 +27,67 @@ class Raster:
     """One chip: 2D (H, W) or 3D (H, W, bands) array + geographic bounds.
 
     resolution = degrees per pixel (x and y assumed square, like the
-    reference's single lexicoded resolution)."""
+    reference's single lexicoded resolution). ``geohash`` is the chip's
+    index key (RasterIndexSchema keys chips by geohash + lexicoded
+    resolution); computed from the chip center when not supplied."""
 
     def __init__(self, data: np.ndarray, envelope: Envelope, raster_id: Optional[str] = None,
-                 time_ms: int = 0):
+                 time_ms: int = 0, geohash: Optional[str] = None):
         self.data = np.asarray(data)
         self.envelope = envelope
         self.id = raster_id or f"r{id(self)}"
         self.time_ms = int(time_ms)
+        if geohash is None:
+            geohash = _containing_geohash(
+                envelope, _gh_precision(self.resolution_of(data, envelope))
+            )
+        self.geohash = geohash
+
+    @staticmethod
+    def resolution_of(data: np.ndarray, envelope: Envelope) -> float:
+        return (envelope.xmax - envelope.xmin) / data.shape[1]
 
     @property
     def resolution(self) -> float:
         return (self.envelope.xmax - self.envelope.xmin) / self.data.shape[1]
+
+
+def _containing_geohash(envelope: Envelope, max_precision: int) -> str:
+    """Longest geohash whose cell CONTAINS the envelope ("" = world).
+
+    Containment keying is what makes the prefix scan route complete: two
+    geohash cells intersect iff one's string prefixes the other, so a chip
+    intersecting the query implies its (containing) cell intersects some
+    decomposed query prefix — a center-keyed chip straddling a cell
+    boundary would be silently dropped."""
+    from geomesa_tpu.utils.geohash import decode_bounds, encode
+
+    cx = (envelope.xmin + envelope.xmax) / 2.0
+    cy = (envelope.ymin + envelope.ymax) / 2.0
+    gh = str(encode(np.asarray([cx]), np.asarray([cy]), max_precision)[0])
+    while gh:
+        xmin, ymin, xmax, ymax = decode_bounds(gh)
+        if (
+            xmin <= envelope.xmin and xmax >= envelope.xmax
+            and ymin <= envelope.ymin and ymax >= envelope.ymax
+        ):
+            return gh
+        gh = gh[:-1]
+    return ""
+
+
+def _gh_precision(resolution: float) -> int:
+    """Geohash precision whose cell size ~ matches a 256px chip at this
+    resolution (coarser chips get shorter keys, like the reference's
+    per-level geohash lengths)."""
+    span = max(resolution * 256.0, 1e-9)
+    p = 1
+    # each geohash char ~ divides the cell by ~5.66 (sqrt(32)) on average
+    cell = 45.0
+    while cell > span and p < 9:
+        cell /= 5.657
+        p += 1
+    return p
 
 
 class RasterQuery:
@@ -118,6 +167,148 @@ class RasterStore:
         n = len(self._chips.pop(res, []))
         self._envs.pop(res, None)
         return n
+
+    # -- pyramid ingest (AccumuloRasterStore ingest + overview build) --------
+
+    def ingest_raster(
+        self,
+        data: np.ndarray,
+        envelope: Envelope,
+        chip_size: int = 256,
+        levels: Optional[int] = None,
+        name: str = "r",
+    ) -> Dict[float, int]:
+        """Tile a full raster into geohash-keyed chips and build an
+        overview PYRAMID by 2x box-filter downsampling per level until the
+        whole raster fits one chip (the reference ingests pre-built
+        pyramid levels from GeoServer; here the chain is built in-store).
+        Returns {resolution: chips stored} per level."""
+        data = np.asarray(data)
+        out: Dict[float, int] = {}
+        level = 0
+        while True:
+            out[_quantize(Raster.resolution_of(data, envelope))] = self._ingest_level(
+                data, envelope, chip_size, f"{name}_L{level}"
+            )
+            h, w = data.shape[:2]
+            done = (h <= chip_size and w <= chip_size) or (
+                levels is not None and level + 1 >= levels
+            )
+            if done:
+                break
+            # odd edges are clipped by the box filter: shrink the envelope
+            # to the clipped extent FIRST or every coarser level's pixels
+            # would be stretched (mis-registered) by up to one source pixel
+            h2, w2 = h // 2 * 2, w // 2 * 2
+            if (h2, w2) != (h, w):
+                res_x = (envelope.xmax - envelope.xmin) / w
+                res_y = (envelope.ymax - envelope.ymin) / h
+                envelope = Envelope(
+                    envelope.xmin,
+                    envelope.ymax - h2 * res_y,
+                    envelope.xmin + w2 * res_x,
+                    envelope.ymax,
+                )
+            data = _downsample2(data)
+            level += 1
+        return out
+
+    def _ingest_level(
+        self, data: np.ndarray, envelope: Envelope, chip_size: int, name: str
+    ) -> int:
+        h, w = data.shape[:2]
+        res_x = (envelope.xmax - envelope.xmin) / w
+        res_y = (envelope.ymax - envelope.ymin) / h
+        n = 0
+        for r0 in range(0, h, chip_size):
+            for c0 in range(0, w, chip_size):
+                r1 = min(r0 + chip_size, h)
+                c1 = min(c0 + chip_size, w)
+                # row 0 = north
+                env = Envelope(
+                    envelope.xmin + c0 * res_x,
+                    envelope.ymax - r1 * res_y,
+                    envelope.xmin + c1 * res_x,
+                    envelope.ymax - r0 * res_y,
+                )
+                self.put_raster(
+                    Raster(data[r0:r1, c0:c1], env, raster_id=f"{name}_{r0}_{c0}")
+                )
+                n += 1
+        return n
+
+    # -- geohash-keyed scan route (RasterIndexSchema parity) -----------------
+
+    def geohash_index(self, resolution: float) -> Dict[str, List[Raster]]:
+        """geohash -> chips at one stored resolution."""
+        res = _quantize(resolution)
+        out: Dict[str, List[Raster]] = {}
+        for c in self._chips.get(res, []):
+            out.setdefault(c.geohash, []).append(c)
+        return out
+
+    def get_rasters_by_geohash(self, query: RasterQuery) -> List[Raster]:
+        """The reference's scan shape: decompose the query bbox into
+        covering geohash prefixes and fetch chips under them, THEN exact-
+        filter by envelope (prefix scans over-cover). Results match
+        ``get_rasters`` (the vectorized fast path)."""
+        res = self._choose_resolution(query.resolution)
+        if res is None:
+            return []
+        idx = self.geohash_index(res)
+        if not idx:
+            return []
+        plen = max(1, max(len(k) for k in idx))
+        from geomesa_tpu.utils.geohash import decompose
+
+        q = query.envelope
+        prefixes = decompose(q.to_polygon(), max_hashes=64, max_precision=plen)
+        out: List[Raster] = []
+        for gh, chips in idx.items():
+            # cells intersect iff one geohash prefixes the other; "" (world
+            # cell, a chip too big for any cell) matches every prefix
+            if any(gh.startswith(p) or p.startswith(gh) for p in prefixes):
+                for c in chips:
+                    e = c.envelope
+                    if (
+                        e.xmax >= q.xmin and e.xmin <= q.xmax
+                        and e.ymax >= q.ymin and e.ymin <= q.ymax
+                    ):
+                        out.append(c)
+        return out
+
+    # -- WCS-style windowed read (GeoMesaCoverageReader analog) --------------
+
+    def read_window(
+        self,
+        envelope: Envelope,
+        width: int,
+        height: int,
+        fill: float = 0.0,
+    ) -> np.ndarray:
+        """Read an arbitrary bbox at an arbitrary output size: resolution
+        selection from the implied pixel size (suggestResolution), then a
+        nearest-neighbor mosaic resampled to EXACTLY (height, width) — the
+        WCS GetCoverage contract of GeoMesaCoverageReader."""
+        res = (envelope.xmax - envelope.xmin) / max(width, 1)
+        grid, _ = self.mosaic(RasterQuery(envelope, res), fill=fill)
+        if grid.shape[:2] == (height, width):
+            return grid
+        # resample the mosaic grid to the requested window size
+        src_h, src_w = grid.shape[:2]
+        ry = np.clip(((np.arange(height) + 0.5) * src_h / height).astype(int), 0, src_h - 1)
+        rx = np.clip(((np.arange(width) + 0.5) * src_w / width).astype(int), 0, src_w - 1)
+        return grid[np.ix_(ry, rx)]
+
+
+def _downsample2(data: np.ndarray) -> np.ndarray:
+    """2x box-filter downsample (overview chain step); odd edges clipped."""
+    h, w = data.shape[:2]
+    h2, w2 = h // 2 * 2, w // 2 * 2
+    d = data[:h2, :w2]
+    if d.ndim == 2:
+        return d.reshape(h2 // 2, 2, w2 // 2, 2).mean(axis=(1, 3))
+    return d.reshape(h2 // 2, 2, w2 // 2, 2, d.shape[2]).mean(axis=(1, 3))
 
 
 def _quantize(res: float) -> float:

@@ -41,6 +41,8 @@ class FileLogBroker:
         self.fsync = fsync
         # reader position cache: (topic, partition, ordinal) -> byte pos
         self._pos: Dict[Tuple[str, int], Tuple[int, int]] = {}
+        # producer-side verified complete-prefix byte size per partition
+        self._good: Dict[Tuple[str, int], int] = {}
         os.makedirs(root, exist_ok=True)
 
     def _path(self, topic: str, partition: int) -> str:
@@ -52,18 +54,44 @@ class FileLogBroker:
 
     def send(self, topic: str, partition: int, payload: bytes) -> int:
         path = self._path(topic, partition)
-        with open(path, "ab") as f:
+        with open(path, "r+b" if os.path.exists(path) else "w+b") as f:
             fcntl.flock(f.fileno(), fcntl.LOCK_EX)
             try:
+                # repair a torn tail BEFORE appending: a producer killed
+                # mid-append leaves an incomplete record at EOF, and
+                # appending after it would misframe the partition for every
+                # reader. Walk complete records from the last known-good
+                # position and truncate anything dangling.
+                end = self._good_size(topic, partition, f)
+                f.truncate(end)
+                f.seek(end)
                 f.write(_LEN.pack(len(payload)))
                 f.write(payload)
                 f.flush()
                 if self.fsync:
                     os.fsync(f.fileno())
+                self._good[(topic, partition)] = end + 4 + len(payload)
             finally:
                 fcntl.flock(f.fileno(), fcntl.LOCK_UN)
         # ordinal is informational for file logs (scan-derived on read)
         return -1
+
+    def _good_size(self, topic: str, partition: int, f) -> int:
+        """Byte size of the complete-record prefix of an open log file.
+        Resumes from this broker's last verified position; a fresh broker
+        instance re-walks from 0 once."""
+        f.seek(0, 2)
+        size = f.tell()
+        pos = self._good.get((topic, partition), 0)
+        if pos > size:
+            pos = 0  # file shrank (external truncation): re-verify
+        while pos + 4 <= size:
+            f.seek(pos)
+            (n,) = _LEN.unpack(f.read(4))
+            if pos + 4 + n > size:
+                break  # torn tail
+            pos += 4 + n
+        return pos
 
     # -- consumer ------------------------------------------------------------
 

@@ -175,3 +175,21 @@ def test_lambda_store_survives_kill9_of_consumer(tmp_path):
     res = lam2.query("t", "INCLUDE")
     assert len(res) == 120
     assert sorted(map(str, res.fids)) == sorted(f"f{i}" for i in range(120))
+
+
+def test_producer_crash_torn_tail_repaired_on_next_send(tmp_path):
+    """A producer SIGKILLed mid-append leaves a torn record; the NEXT send
+    (any process) must truncate it so the partition never misframes."""
+    root = str(tmp_path / "log")
+    b = FileLogBroker(root, partitions=1)
+    b.send("t", 0, b"alpha")
+    b.send("t", 0, b"beta")
+    path = os.path.join(root, "t", "p0.log")
+    with open(path, "ab") as f:
+        f.write(b"\x64\x00\x00\x00only-10b")  # len=100, 8 bytes present
+    # a FRESH broker (crash wiped in-memory state) appends next
+    b2 = FileLogBroker(root, partitions=1)
+    b2.send("t", 0, b"gamma")
+    got = [p for _, _, p in FileLogBroker(root, partitions=1).poll("t", {})]
+    assert got == [b"alpha", b"beta", b"gamma"]
+    assert FileLogBroker(root, partitions=1).end_offsets("t") == {0: 3}

@@ -1,0 +1,129 @@
+"""SQL string surface (compute/sql.py): ST_* predicates in WHERE must fold
+into the planner's filter AST and ride the z-index (the Catalyst pushdown
+analog, geomesa-spark-sql SQLRules.scala:30-62), with aggregation /
+projection / order / limit semantics over the columnar result."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.compute.sql import SQLContext, SqlError
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(21)
+    s = TpuDataStore()
+    s.create_schema(parse_spec(
+        "gdelt", "actor1:String:index=true,n_articles:Int,dtg:Date,*geom:Point:srid=4326"
+    ))
+    base = np.datetime64("2026-01-01", "ms").astype(np.int64)
+    actors = ["USA", "FRA", "CHN", "RUS"]
+    with s.writer("gdelt") as w:
+        for i in range(4000):
+            w.write(
+                [actors[i % 4], int(rng.integers(0, 100)),
+                 int(base + rng.integers(0, 20 * 86400_000)),
+                 Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90)))],
+                fid=f"f{i}",
+            )
+    return s
+
+
+def test_select_where_spatial_pushdown(store):
+    ctx = SQLContext(store)
+    r = ctx.sql(
+        "SELECT actor1, n_articles FROM gdelt "
+        "WHERE st_contains(st_makeBBOX(-50.0, -30.0, 40.0, 35.0), geom)"
+    )
+    # the spatial predicate went through the PLANNER, not a full scan
+    assert "z2" in r.explain or "xz2" in r.explain, r.explain
+    assert "full scan" not in r.explain.lower()
+    assert len(r) > 0
+    x = store.query("gdelt", "WITHIN(geom, POLYGON((-50 -30, 40 -30, 40 35, -50 35, -50 -30)))")
+    assert len(r) == len(x)
+    assert set(r.columns) >= {"actor1", "n_articles"}
+
+
+def test_group_by_count(store):
+    ctx = SQLContext(store)
+    r = ctx.sql(
+        "SELECT actor1, count(*) AS n FROM gdelt "
+        "WHERE st_intersects(geom, st_makeBBOX(-180.0, -90.0, 180.0, 90.0)) "
+        "GROUP BY actor1"
+    )
+    assert sorted(r.columns["actor1"]) == ["CHN", "FRA", "RUS", "USA"]
+    assert int(r.columns["n"].sum()) == 4000
+
+
+def test_aggregates_and_filters(store):
+    ctx = SQLContext(store)
+    r = ctx.sql(
+        "SELECT count(*) AS n, min(n_articles) AS lo, max(n_articles) AS hi, "
+        "avg(n_articles) AS m FROM gdelt WHERE actor1 = 'USA' AND n_articles >= 50"
+    )
+    want = store.query("gdelt", "actor1 = 'USA' AND n_articles >= 50")
+    assert int(r.columns["n"][0]) == len(want)
+    assert int(r.columns["lo"][0]) >= 50
+    assert r.columns["m"][0] <= r.columns["hi"][0]
+
+
+def test_order_limit_and_like(store):
+    ctx = SQLContext(store)
+    r = ctx.sql(
+        "SELECT actor1, n_articles FROM gdelt WHERE actor1 LIKE 'U%' "
+        "ORDER BY n_articles DESC LIMIT 5"
+    )
+    vals = list(r.columns["n_articles"])
+    assert len(vals) == 5 and vals == sorted(vals, reverse=True)
+    assert set(r.columns["actor1"]) == {"USA"}
+
+
+def test_st_select_functions(store):
+    ctx = SQLContext(store)
+    r = ctx.sql(
+        "SELECT st_x(geom) AS lon, st_y(geom) AS lat, st_geohash(geom, 5) AS gh "
+        "FROM gdelt WHERE bbox(geom, 0.0, 0.0, 10.0, 10.0)"
+    )
+    assert (r.columns["lon"] >= 0).all() and (r.columns["lon"] <= 10).all()
+    assert all(len(g) == 5 for g in r.columns["gh"])
+
+
+def test_dwithin_and_wkt_literals(store):
+    ctx = SQLContext(store)
+    r = ctx.sql(
+        "SELECT actor1 FROM gdelt "
+        "WHERE st_dwithin(geom, st_point(0.0, 0.0), 500000.0)"
+    )
+    want = store.query("gdelt", "DWITHIN(geom, POINT(0 0), 500000.0, meters)")
+    assert len(r) == len(want)
+    r2 = ctx.sql(
+        "SELECT actor1 FROM gdelt "
+        "WHERE st_within(geom, st_geomFromWKT('POLYGON((-20 -10, 30 -10, 30 25, -20 25, -20 -10))'))"
+    )
+    want2 = store.query(
+        "gdelt", "WITHIN(geom, POLYGON((-20 -10, 30 -10, 30 25, -20 25, -20 -10)))"
+    )
+    assert len(r2) == len(want2) > 0
+
+
+def test_in_between_null_and_errors(store):
+    ctx = SQLContext(store)
+    r = ctx.sql("SELECT actor1 FROM gdelt WHERE actor1 IN ('USA', 'FRA') AND n_articles BETWEEN 10 AND 20")
+    got = set(r.columns["actor1"])
+    assert got <= {"USA", "FRA"}
+    r2 = ctx.sql("SELECT actor1 FROM gdelt WHERE actor1 IS NOT NULL LIMIT 3")
+    assert len(r2) == 3
+    with pytest.raises(SqlError):
+        ctx.sql("SELECT FROM gdelt")
+    with pytest.raises(SqlError):
+        ctx.sql("SELECT actor1 FROM gdelt WHERE st_buffer(geom, 1)")
+
+
+def test_st_function_count():
+    from geomesa_tpu.compute import st_functions as st
+
+    fns = [n for n in dir(st) if n.startswith("st_")]
+    assert len(fns) >= 35, len(fns)
