@@ -62,10 +62,9 @@ def _tokenize(text: str) -> List[Tuple[str, str]]:
 
 
 class _Parser:
-    def __init__(self, text: str, ft):
+    def __init__(self, text: str):
         self.toks = _tokenize(text)
         self.i = 0
-        self.ft = ft
 
     # -- token helpers -------------------------------------------------------
 
@@ -385,12 +384,8 @@ class SQLContext:
         self.store = store
 
     def sql(self, text: str) -> SqlResult:
-        # the FROM table determines the schema used during parsing
-        m = re.search(r"\bfrom\s+([A-Za-z_][A-Za-z_0-9]*)", text, re.IGNORECASE)
-        if m is None:
-            raise SqlError("Missing FROM clause")
-        ft = self.store.get_schema(m.group(1))
-        q = _Parser(text, ft).parse()
+        q = _Parser(text).parse()
+        ft = self.store.get_schema(q["table"])
         return self._execute(ft, q)
 
     # -- execution -----------------------------------------------------------
@@ -415,11 +410,24 @@ class SQLContext:
                 geom = ft.default_geometry
                 needed.add(geom.name if geom is not None else ft.attributes[0].name)
             props = sorted(needed)
+        # sort pushes into the scan ONLY when it orders real schema
+        # attributes of a plain (non-aggregated) select — ORDER BY over an
+        # agg/select alias sorts the client-side result instead
+        push_sort = (
+            q["order"]
+            and not aggs
+            and not q["group"]
+            and all(ft.has(col) for col, _ in q["order"])
+        )
         query = Query(
             filter=q["where"] if q["where"] is not None else ast.Include(),
             properties=props,
-            sort_by=q["order"] or None,
-            max_features=q["limit"] if not aggs and not q["group"] else None,
+            sort_by=q["order"] if push_sort else None,
+            max_features=(
+                q["limit"] if push_sort or (
+                    not q["order"] and not aggs and not q["group"]
+                ) else None
+            ),
         )
         res = self.store.query(ft.name, query)
         frame = SpatialFrame(
@@ -446,25 +454,44 @@ class SQLContext:
                 return out
             return SqlResult(out.columns, out.ft, res.plan)
         if not star:
-            keep = [it["alias"] for it in plain] + [it["alias"] for it in stfns]
             cols: Dict[str, np.ndarray] = {}
             for it in plain:
                 src = it["name"]
+                alias = it["alias"]
                 for k, v in frame.columns.items():
-                    if k == src or (
-                        k.startswith(src + "__") and not k.endswith("__vocab")
-                    ):
-                        cols[k if it["alias"] == src else it["alias"]] = v
+                    if k == src:
+                        cols[alias] = v
+                    elif k.startswith(src + "__") and not k.endswith("__vocab"):
+                        # subcolumns (__x/__y/__null) keep their suffix
+                        # under the alias — collapsing them onto the alias
+                        # key would clobber the value column
+                        cols[alias + k[len(src):]] = v
             for it in stfns:
                 cols[it["alias"]] = frame.columns[it["alias"]]
             frame = SpatialFrame(cols, frame.ft)
-            del keep
+        if q["order"] and not push_sort:
+            # ORDER BY over aliases/derived columns: client-side sort
+            for col, asc in reversed(q["order"]):
+                if col in frame.columns:
+                    frame = frame.sort(col, asc)
+                else:
+                    raise SqlError(f"ORDER BY references unknown column {col}")
+            if q["limit"] is not None:
+                frame = SpatialFrame(
+                    {k: v[: q["limit"]] for k, v in frame.columns.items()},
+                    frame.ft,
+                )
         return SqlResult(frame.columns, frame.ft, res.plan)
 
     @staticmethod
     def _aggregate(frame: SpatialFrame, group: List[str], aggs, plain) -> SpatialFrame:
         fn_map = {"count": "count", "sum": "sum", "avg": "mean",
                   "mean": "mean", "min": "min", "max": "max"}
+        stray = [it["name"] for it in plain if it["name"] not in group]
+        if stray:
+            raise SqlError(
+                f"Non-aggregated column(s) {stray} must appear in GROUP BY"
+            )
         if group:
             spec = {}
             for it in aggs:

@@ -290,7 +290,12 @@ class RasterStore:
         selection from the implied pixel size (suggestResolution), then a
         nearest-neighbor mosaic resampled to EXACTLY (height, width) — the
         WCS GetCoverage contract of GeoMesaCoverageReader."""
-        res = (envelope.xmax - envelope.xmin) / max(width, 1)
+        # finest implied pixel size on either axis drives level selection
+        # (a tall narrow window must not pick a level too coarse for y)
+        res = min(
+            (envelope.xmax - envelope.xmin) / max(width, 1),
+            (envelope.ymax - envelope.ymin) / max(height, 1),
+        )
         grid, _ = self.mosaic(RasterQuery(envelope, res), fill=fill)
         if grid.shape[:2] == (height, width):
             return grid

@@ -109,7 +109,12 @@ class MetadataBackedStats(GeoMesaStats):
                     # range-scan selectivity beats the MinMax linear guess
                     # (StatsBasedEstimator.scala attribute histograms)
                     stats[f"hist:{a.name}"] = Histogram(a.name, _HIST_BINS)
-            elif a.type == AttributeType.STRING:
+            elif a.type == AttributeType.STRING and a.indexed:
+                # like the reference's StatsCombiner, value sketches are
+                # maintained for INDEXED attributes (the ones the cost
+                # decider consults); unindexed high-cardinality strings
+                # (ids, free text) would pay per-unique hashing for stats
+                # nothing reads
                 stats[f"topk:{a.name}"] = TopK(a.name)
                 stats[f"freq:{a.name}"] = Frequency(a.name)
         return {k: v for k, v in stats.items() if v is not None}
@@ -154,17 +159,24 @@ class MetadataBackedStats(GeoMesaStats):
             if attr is None or attr not in columns:
                 continue
             nulls = columns.get(attr.split("__")[0] + "__null")
-            vals = columns[attr]
             vocab = columns.get(attr + "__vocab")
             if vocab is not None:
-                # dictionary column: sketches observe VALUES (decoded once
-                # per batch; several sketches on one attr share the cache)
-                from geomesa_tpu.store.blocks import dict_decode
-
-                vals = _decoded.get(attr)
-                if vals is None:
-                    vals = _decoded[attr] = dict_decode(columns[attr], vocab)
-            stat.observe(vals, nulls)
+                # dictionary column: sketches observe via (vocab values,
+                # bincount of codes) — cardinality-sized work instead of a
+                # per-row decode + re-unique in every sketch. Null codes
+                # (-1) drop out of the bincount naturally.
+                vc = _decoded.get(attr)
+                if vc is None:
+                    codes = columns[attr]
+                    cnt = np.bincount(codes[codes >= 0], minlength=len(vocab))
+                    present = cnt > 0
+                    vc = _decoded[attr] = (vocab[present], cnt[present])
+                if hasattr(stat, "observe_counts"):
+                    stat.observe_counts(*vc)
+                else:
+                    stat.observe(np.repeat(*vc), None)
+                continue
+            stat.observe(columns[attr], nulls)
         # debounced persistence: serializing every sketch per batch is pure
         # overhead on the write hot path; sketches are recomputable anyway
         self._unpersisted[ft.name] = self._unpersisted.get(ft.name, 0) + 1

@@ -209,7 +209,13 @@ class EnumerationStat(Stat):
     def observe(self, values, nulls=None):
         values = _clean(np.asarray(values), nulls)
         uniq, cnt = np.unique(values, return_counts=True)
-        for v, c in zip(uniq, cnt):
+        self.observe_counts(uniq, cnt)
+
+    def observe_counts(self, values, counts):
+        """Pre-aggregated (unique value, count) observation — dictionary
+        columns feed sketches via vocab + bincount instead of decoding
+        every row."""
+        for v, c in zip(values, counts):
             v = v.item() if isinstance(v, np.generic) else v
             self.counts[v] = self.counts.get(v, 0) + int(c)
 
@@ -244,6 +250,10 @@ class TopK(Stat):
         by a stream of one-off values) is preserved."""
         values = _clean(np.asarray(values), nulls)
         uniq, cnt = np.unique(values, return_counts=True)
+        self.observe_counts(uniq, cnt)
+
+    def observe_counts(self, uniq, cnt):
+        """Pre-aggregated observation (see EnumerationStat.observe_counts)."""
         newcomers = {}
         for v, c in zip(uniq, cnt):
             v = v.item() if isinstance(v, np.generic) else v
@@ -424,7 +434,13 @@ class Frequency(Stat):
         # hash the uniques only: string hashing is per-value Python, so a
         # low-cardinality column costs its cardinality, not its length
         uniq, cnt = np.unique(values, return_counts=True)
-        idx = self._hashes(uniq)
+        self.observe_counts(uniq, cnt)
+
+    def observe_counts(self, uniq, cnt):
+        """Pre-aggregated observation (see EnumerationStat.observe_counts)."""
+        if not len(uniq):
+            return
+        idx = self._hashes(np.asarray(uniq))
         for d in range(self._DEPTH):
             np.add.at(self.table[d], idx[d], cnt)
 

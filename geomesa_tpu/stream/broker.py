@@ -33,16 +33,23 @@ class InProcessBroker:
             return len(log) - 1
 
     def poll(
-        self, topic: str, offsets: Dict[int, int], max_records: int = 10000
+        self,
+        topic: str,
+        offsets: Dict[int, int],
+        max_records: int = 10000,
+        partitions=None,
     ) -> List[Tuple[int, int, bytes]]:
         """Fetch records after the given per-partition offsets.
 
-        Returns [(partition, offset, payload)]; caller advances its offsets.
+        Returns [(partition, offset, payload)]; caller advances its
+        offsets. ``partitions`` restricts to an assignment subset.
         """
         out: List[Tuple[int, int, bytes]] = []
         logs = self._topic(topic)
         with self._lock:
             for p, log in enumerate(logs):
+                if partitions is not None and p not in partitions:
+                    continue
                 start = offsets.get(p, 0)
                 for i in range(start, min(len(log), start + max_records)):
                     out.append((p, i, log[i]))

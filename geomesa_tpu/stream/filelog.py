@@ -54,7 +54,11 @@ class FileLogBroker:
 
     def send(self, topic: str, partition: int, payload: bytes) -> int:
         path = self._path(topic, partition)
-        with open(path, "r+b" if os.path.exists(path) else "w+b") as f:
+        # O_CREAT without O_TRUNC: creation must be atomic — an
+        # exists()-then-"w+b" race would truncate a concurrent producer's
+        # committed records at open() time, before any flock is held
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        with os.fdopen(fd, "r+b") as f:
             fcntl.flock(f.fileno(), fcntl.LOCK_EX)
             try:
                 # repair a torn tail BEFORE appending: a producer killed
@@ -116,12 +120,19 @@ class FileLogBroker:
         return out, ordn, pos
 
     def poll(
-        self, topic: str, offsets: Dict[int, int], max_records: int = 10000
+        self,
+        topic: str,
+        offsets: Dict[int, int],
+        max_records: int = 10000,
+        partitions=None,
     ) -> List[Tuple[int, int, bytes]]:
         """Fetch records after the given per-partition offsets (ordinals).
-        Returns [(partition, ordinal, payload)]; caller advances offsets."""
+        Returns [(partition, ordinal, payload)]; caller advances offsets.
+        ``partitions`` restricts the fetch to an assignment subset (the
+        consumer-group partition-assignment contract: cooperating
+        consumers split a topic's partitions disjointly)."""
         out: List[Tuple[int, int, bytes]] = []
-        for p in range(self.partitions):
+        for p in partitions if partitions is not None else range(self.partitions):
             want = offsets.get(p, 0)
             path = self._path(topic, p)
             if not os.path.exists(path):

@@ -92,16 +92,26 @@ class StreamDataStore:
         expiry_ms: Optional[int] = None,
         clock: Callable[[], int] = _now_ms,
         offset_manager=None,
+        assigned_partitions=None,
     ):
         """``offset_manager`` (stream.filelog.FileOffsetManager or
         compatible): when given, consumed offsets are committed after
         every poll and the consumer RESUMES from its last commit on
         restart — the ZookeeperOffsetManager durability contract. Without
-        one, offsets live in-process (the transient-cache contract)."""
+        one, offsets live in-process (the transient-cache contract).
+
+        ``assigned_partitions``: this consumer's partition assignment
+        (stream parallelism — cooperating consumers in one group split a
+        topic's partitions disjointly, like Kafka's consumer-group
+        assignment; the feature-affinity partitioner keeps per-feature
+        ordering within one consumer)."""
         self.broker = broker or InProcessBroker()
         self.expiry_ms = expiry_ms
         self.clock = clock
         self.offset_manager = offset_manager
+        self.assigned_partitions = (
+            list(assigned_partitions) if assigned_partitions is not None else None
+        )
         self._schemas: Dict[str, FeatureType] = {}
         self._serializers: Dict[str, GeoMessageSerializer] = {}
         self._caches: Dict[str, FeatureCache] = {}
@@ -158,7 +168,9 @@ class StreamDataStore:
         ser = self._serializers[name]
         cache = self._caches[name]
         offsets = self._offsets[name]
-        records = self.broker.poll(name, offsets)
+        records = self.broker.poll(
+            name, offsets, partitions=self.assigned_partitions
+        )
         for p, off, payload in records:
             msg = ser.deserialize(payload)
             if isinstance(msg, CreateOrUpdate):

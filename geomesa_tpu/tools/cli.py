@@ -207,11 +207,32 @@ def cmd_stats_histogram(args) -> int:
 
 def cmd_stats_topk(args) -> int:
     ds = _store(args)
-    stats = ds.stats.stats_for(ds.get_schema(args.name))
+    ft = ds.get_schema(args.name)
+    stats = ds.stats.stats_for(ft)
     tk = stats.get(f"topk:{args.attribute}")
-    if tk is None:
-        print("no topk sketch for attribute", file=sys.stderr)
-        return 1
+    if tk is None or tk.is_empty:
+        # maintained sketches only exist for indexed attributes — fall
+        # back to an exact scan (the UnoptimizedRunnableStats role:
+        # stats queries still answer when nothing is cached)
+        if not ft.has(args.attribute):
+            print("no such attribute", file=sys.stderr)
+            return 1
+        from geomesa_tpu.index.planner import Query
+
+        res = ds.query(args.name, Query.cql("INCLUDE", properties=[args.attribute]))
+        col = res.columns.get(args.attribute)
+        if col is None:
+            print("no values", file=sys.stderr)
+            return 1
+        nulls = res.columns.get(args.attribute + "__null")
+        if nulls is not None:
+            col = col[~np.asarray(nulls)]
+        uniq, cnt = np.unique(col, return_counts=True)
+        order = np.argsort(-cnt)[: args.k]
+        for i in order:
+            v = uniq[i]
+            print(f"{v.item() if hasattr(v, 'item') else v}\t{int(cnt[i])}")
+        return 0
     for v, c in tk.topk(args.k):
         print(f"{v}\t{c}")
     return 0

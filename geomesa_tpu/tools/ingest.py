@@ -143,14 +143,33 @@ class _FastPlan:
         table = pacsv.read_csv(path, read_options=opts, parse_options=parse,
                                convert_options=conv)
         self._table = table  # for the vectorized id join
-        cols = [
-            table.column(i).to_numpy(zero_copy_only=False)
-            for i in range(table.num_columns)
-        ]
+        cols = _LazyArrowCols(table)  # only touched columns materialize
         n = table.num_rows
         out: Columns = {}
         for name, op in self.steps:
             a = next(x for x in self.ft.attributes if x.name == name)
+            if a.type == AttributeType.STRING and self._arrow_col_idx(op) is not None:
+                # string columns encode IN ARROW (C++): dictionary codes +
+                # sorted vocab for low cardinality (the store's at-rest
+                # layout — intern_string_columns then skips them), plain
+                # fixed-width unicode otherwise. An order of magnitude
+                # faster than the per-object Python scan on wide layouts.
+                ci, trim = self._arrow_col_idx(op)
+                for k, v in _arrow_string_column(table.column(ci), name, trim).items():
+                    out[k] = v
+                continue
+            if (
+                a.type == AttributeType.DATE
+                and op[0] == "date"
+                and op[2][0] == "col"
+            ):
+                got = _arrow_date_column(table.column(op[2][1]), op[1])
+                if got is not None:
+                    arr, nulls = got
+                    out[name] = arr
+                    if nulls is not None:
+                        out[name + "__null"] = nulls
+                    continue
             val = self._eval(op, cols, n)
             if a.type.is_geometry:
                 # columns_from_features convention: points are __x/__y only
@@ -164,13 +183,18 @@ class _FastPlan:
                     arr = np.where(nulls, 0, arr)
                     out[name + "__null"] = nulls
                 out[name] = arr
-            elif a.type in (AttributeType.INT, AttributeType.LONG):
-                arr, nulls = _to_num(val, np.int64)
-                out[name] = arr
-                if nulls is not None:
-                    out[name + "__null"] = nulls
-            elif a.type in (AttributeType.FLOAT, AttributeType.DOUBLE):
-                arr, nulls = _to_num(val, np.float64)
+            elif a.type in (AttributeType.INT, AttributeType.LONG,
+                            AttributeType.FLOAT, AttributeType.DOUBLE):
+                is_int = a.type in (AttributeType.INT, AttributeType.LONG)
+                ci = self._num_col_idx(op)
+                if ci is not None:
+                    # numeric parse in arrow C++ ('' -> null), not Python
+                    arr, nulls = _arrow_num_column(table.column(ci), is_int)
+                else:
+                    arr, nulls = _to_num(
+                        self._eval(op, cols, n),
+                        np.int64 if is_int else np.float64,
+                    )
                 out[name] = arr
                 if nulls is not None:
                     out[name + "__null"] = nulls
@@ -222,6 +246,25 @@ class _FastPlan:
             return x, y
         raise AssertionError(kind)
 
+    def _num_col_idx(self, op):
+        """Source column index when a numeric attribute op reads one raw
+        input column (with or without an explicit to-number cast)."""
+        if op[0] == "col":
+            return op[1]
+        if op[0] == "num" and op[2][0] == "col":
+            return op[2][1]
+        return None
+
+    def _arrow_col_idx(self, op):
+        """(source column index, trim?) when a STRING attribute op reads
+        one raw input column (optionally trimmed) — the shapes the arrow
+        C++ encoder handles; None sends the op down the generic path."""
+        if op[0] == "col":
+            return op[1], False
+        if op[0] in ("str", "tostr") and op[1][0] == "col":
+            return op[1][1], op[0] == "str"
+        return None
+
     def _eval_id(self, cols, n):
         kind = self.id_op[0]
         if kind == "uuid":
@@ -263,6 +306,120 @@ class _FastPlan:
 
 class _Unsupported(Exception):
     pass
+
+
+class _LazyArrowCols:
+    """Index-access view over an arrow table that materializes a column to
+    numpy only when an op actually reads it — the arrow fast paths handle
+    most columns without ever touching this."""
+
+    def __init__(self, table):
+        self._table = table
+        self._cache = {}
+
+    def __getitem__(self, i: int):
+        got = self._cache.get(i)
+        if got is None:
+            got = self._cache[i] = self._table.column(i).to_numpy(
+                zero_copy_only=False
+            )
+        return got
+
+    def __len__(self):
+        return self._table.num_columns
+
+
+def _arrow_date_column(arr, fmt: str):
+    """(epoch-ms array, null mask | None) parsed by arrow's C++ strptime
+    when the java format maps to one it supports; None -> generic path."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    from geomesa_tpu.tools.convert import java_date_format
+
+    try:
+        py_fmt = java_date_format(fmt)
+    except Exception:  # noqa: BLE001
+        return None
+    if "%" not in py_fmt or "%f" in py_fmt:
+        return None  # strptime in arrow lacks fractional seconds
+    arr = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+    empty = pc.equal(pc.fill_null(arr, ""), "")
+    cleaned = pc.if_else(empty, pa.scalar(None, pa.string()), arr)
+    try:
+        ts = pc.strptime(cleaned, format=py_fmt, unit="ms", error_is_null=False)
+    except pa.ArrowInvalid:
+        return None  # unparseable rows: the generic path raises per row
+    vals = ts.to_numpy(zero_copy_only=False).astype("datetime64[ms]")
+    ms = vals.astype(np.int64)
+    nat = np.datetime64("NaT").astype(np.int64)
+    nulls = ms == nat
+    if nulls.any():
+        ms = np.where(nulls, 0, ms)
+        return ms, nulls
+    return ms, None
+
+
+def _arrow_num_column(arr, is_int: bool):
+    """Arrow string column -> (numeric array, null mask | None): empty
+    strings and nulls become the 0-plus-mask convention, parsed in C++."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    arr = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+    empty = pc.equal(pc.fill_null(arr, ""), "")
+    cleaned = pc.if_else(empty, pa.scalar(None, pa.string()), arr)
+    vals = pc.cast(cleaned, pa.float64()).to_numpy(zero_copy_only=False)
+    nulls = np.isnan(vals)
+    if is_int:
+        out = np.where(nulls, 0, vals).astype(np.int64)
+    else:
+        out = vals
+    return out, (nulls if nulls.any() else None)
+
+
+def _arrow_string_column(arr, name: str, trim: bool):
+    """One arrow string column -> the store's columnar string layout:
+    int32 dictionary codes + SORTED vocab (+ __null mask) when cardinality
+    is low, fixed-width unicode otherwise — same policy as
+    store.blocks.intern_string_columns, computed by arrow's C++ kernels."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    arr = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+    if trim:
+        arr = pc.utf8_trim_whitespace(arr)
+    nulls_pa = pc.is_null(arr)
+    arr = pc.fill_null(arr, "")
+    n = len(arr)
+    d = pc.dictionary_encode(arr)
+    if isinstance(d, pa.ChunkedArray):
+        d = d.combine_chunks()
+    vocab_obj = d.dictionary.to_numpy(zero_copy_only=False)
+    nulls = nulls_pa.to_numpy(zero_copy_only=False)
+    out = {}
+    if len(vocab_obj) <= 256 or 2 * len(vocab_obj) <= n:
+        codes = np.asarray(d.indices, dtype=np.int32)
+        vocab = vocab_obj.astype(np.str_)
+        order = np.argsort(vocab)  # code order must equal value order
+        remap = np.empty(len(order), dtype=np.int32)
+        remap[order] = np.arange(len(order), dtype=np.int32)
+        codes = remap[codes]
+        codes[nulls] = -1
+        out[name] = codes
+        out[name + "__vocab"] = vocab[order]
+    else:
+        maxlen = pc.max(pc.utf8_length(arr)).as_py() or 1
+        if maxlen > 128:
+            # outlier-wide columns stay object (the intern policy)
+            vals = arr.to_numpy(zero_copy_only=False)
+            vals = np.where(nulls, None, vals)
+            out[name] = vals.astype(object)
+            return out
+        out[name] = arr.to_numpy(zero_copy_only=False).astype(f"U{maxlen}")
+    if nulls.any():
+        out[name + "__null"] = nulls
+    return out
 
 
 def _to_num(v, dtype):

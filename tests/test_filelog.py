@@ -193,3 +193,29 @@ def test_producer_crash_torn_tail_repaired_on_next_send(tmp_path):
     got = [p for _, _, p in FileLogBroker(root, partitions=1).poll("t", {})]
     assert got == [b"alpha", b"beta", b"gamma"]
     assert FileLogBroker(root, partitions=1).end_offsets("t") == {0: 3}
+
+
+def test_partition_assignment_splits_topic_across_consumers(tmp_path):
+    """Stream parallelism: two consumers in one group with DISJOINT
+    partition assignments collectively consume every record exactly once
+    (the Kafka consumer-group assignment shape over the durable log)."""
+    root = str(tmp_path / "log")
+    producer = StreamDataStore(broker=FileLogBroker(root))
+    producer.create_schema(parse_spec("t", SPEC))
+    _write_n(producer, 200)
+
+    got = []
+    consumers = [
+        StreamDataStore(
+            broker=FileLogBroker(root),
+            offset_manager=FileOffsetManager(root, f"g-p{i}"),
+            assigned_partitions=parts,
+        )
+        for i, parts in enumerate(([0, 1], [2, 3]))
+    ]
+    for c in consumers:
+        c.create_schema(parse_spec("t", SPEC))
+        c.add_listener("t", lambda m: got.append(m.fid))
+        c.poll("t")
+    assert sorted(got) == sorted(f"f{i}" for i in range(200))
+    assert len(got) == len(set(got))  # exactly once across the group

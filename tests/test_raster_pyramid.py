@@ -85,3 +85,17 @@ def test_multiband_pyramid():
     store.ingest_raster(rgb, WORLD, chip_size=128)
     win = store.read_window(Envelope(-10, -10, 10, 10), 64, 64)
     assert win.shape == (64, 64, 3)
+
+
+def test_tall_window_picks_fine_level():
+    """Resolution selection uses the FINEST implied pixel axis: a tall
+    narrow window must not read a level too coarse for its y axis."""
+    data = _source(2048, 4096)
+    store = RasterStore()
+    store.ingest_raster(data, WORLD, chip_size=256)
+    q = Envelope(-5.0, -20.0, 5.0, 20.0)
+    win = store.read_window(q, 20, 800)  # y pixels much finer than x
+    lat = q.ymax - (np.arange(800) + 0.5) * (q.ymax - q.ymin) / 800
+    lon = q.xmin + (np.arange(20) + 0.5) * (q.xmax - q.xmin) / 20
+    want = np.sin(np.radians(lon))[None, :] * 100 + np.cos(np.radians(lat))[:, None] * 50
+    assert np.abs(win - want).mean() < 0.5

@@ -127,3 +127,24 @@ def test_st_function_count():
 
     fns = [n for n in dir(st) if n.startswith("st_")]
     assert len(fns) >= 35, len(fns)
+
+
+def test_alias_keeps_subcolumns_and_orderby_alias(store):
+    ctx = SQLContext(store)
+    r = ctx.sql("SELECT geom AS g, actor1 AS a FROM gdelt WHERE bbox(geom, 0.0, 0.0, 20.0, 20.0)")
+    assert "g__x" in r.columns and "g__y" in r.columns
+    assert r.columns["a"].dtype.kind in ("U", "O")
+    # ORDER BY an aggregation alias sorts the client-side result
+    r2 = ctx.sql("SELECT actor1, count(*) AS n FROM gdelt GROUP BY actor1 ORDER BY n DESC")
+    vals = list(r2.columns["n"])
+    assert vals == sorted(vals, reverse=True) and len(vals) == 4
+    # ORDER BY a select alias on a plain query
+    r3 = ctx.sql("SELECT n_articles AS k FROM gdelt WHERE actor1 = 'USA' ORDER BY k DESC LIMIT 4")
+    vals3 = list(r3.columns["k"])
+    assert len(vals3) == 4 and vals3 == sorted(vals3, reverse=True)
+
+
+def test_ungrouped_plain_column_raises(store):
+    ctx = SQLContext(store)
+    with pytest.raises(SqlError):
+        ctx.sql("SELECT actor1, n_articles, count(*) AS n FROM gdelt GROUP BY actor1")
