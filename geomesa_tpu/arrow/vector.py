@@ -75,6 +75,38 @@ class SimpleFeatureVector:
                     pa.array(ms, type=pa.timestamp("ms"),
                              mask=nulls if nulls is not None else None)
                 )
+            elif (
+                a.type == AttributeType.STRING
+                and a.name + "__vocab" in columns
+                and a.name in self.dictionary_encode
+            ):
+                # store-layout dictionary columns map STRAIGHT to Arrow
+                # dictionaries — codes + sorted vocab, no re-encode (the
+                # ArrowDictionary wire role fed from the at-rest codes)
+                codes = np.asarray(columns[a.name], dtype=np.int32)
+                mask = codes < 0  # -1 = null sentinel
+                idx = pa.array(np.where(mask, 0, codes), mask=mask)
+                arrays.append(
+                    pa.DictionaryArray.from_arrays(
+                        idx, pa.array(columns[a.name + "__vocab"], type=pa.utf8())
+                    )
+                )
+            elif a.type == AttributeType.STRING and a.name in columns:
+                col = columns[a.name]
+                vocab = columns.get(a.name + "__vocab")
+                if vocab is not None:
+                    from geomesa_tpu.store.blocks import dict_decode
+
+                    col = dict_decode(np.asarray(col), np.asarray(vocab))
+                if col.dtype == object:
+                    vals = pa.array(list(col), type=pa.utf8())
+                else:
+                    nulls = columns.get(a.name + "__null")
+                    vals = pa.array(col, type=pa.utf8(),
+                                    mask=np.asarray(nulls) if nulls is not None else None)
+                if a.name in self.dictionary_encode:
+                    vals = vals.dictionary_encode()
+                arrays.append(vals)
             elif a.name in columns and columns[a.name].dtype == object:
                 vals = pa.array(list(columns[a.name]), type=pa.utf8())
                 if a.name in self.dictionary_encode:

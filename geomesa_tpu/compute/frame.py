@@ -74,20 +74,42 @@ class SpatialFrame:
     }
 
     def group_by(
-        self, key: str, aggs: Dict[str, Tuple[str, str]]
+        self, key, aggs: Dict[str, Tuple[str, str]]
     ) -> "SpatialFrame":
-        """aggs: out_name -> (agg_fn, column). The ShallowJoin/CountByDay
-        analytics shape (geomesa-accumulo-compute)."""
-        col = self.columns[key]
-        uniq, inverse = np.unique(col, return_inverse=True)
-        out: Dict[str, np.ndarray] = {key: uniq}
+        """aggs: out_name -> (agg_fn, column); ``key`` is one column name
+        or a sequence of them (composite grouping). The
+        ShallowJoin/CountByDay analytics shape (geomesa-accumulo-compute)."""
+        keys = [key] if isinstance(key, str) else list(key)
+        # factorize each key column, then combine the per-key codes into
+        # one group id (mixed dtypes can't stack into a single unique call)
+        uniques = []
+        codes = None
+        for k in keys:
+            u, inv = np.unique(self.columns[k], return_inverse=True)
+            uniques.append(u)
+            codes = inv if codes is None else codes * len(u) + inv
+        if len(keys) == 1:  # already factorized: skip the second unique
+            gids = np.arange(len(uniques[0]), dtype=np.int64)
+            inverse = codes
+        else:
+            gids, inverse = np.unique(codes, return_inverse=True)
+        out: Dict[str, np.ndarray] = {}
+        # decompose each group id back into its per-key unique values
+        rem = gids.copy()
+        for k, u in zip(reversed(keys), reversed(uniques)):
+            out[k] = u[rem % len(u)]
+            rem //= len(u)
+        out = {k: out[k] for k in keys}  # restore key order
+        # sort rows into contiguous group runs ONCE: each aggregate then
+        # reads a slice (O(N log N) total, not O(groups x rows) masks)
+        order = np.argsort(inverse, kind="stable")
+        bounds = np.searchsorted(inverse[order], np.arange(len(gids) + 1))
         for out_name, (fn_name, src) in aggs.items():
             fn = self._AGGS[fn_name]
-            vals = []
-            src_col = self.columns[src]
-            for g in range(len(uniq)):
-                vals.append(fn(src_col[inverse == g]))
-            out[out_name] = np.asarray(vals)
+            src_sorted = self.columns[src][order]
+            out[out_name] = np.asarray(
+                [fn(src_sorted[bounds[g]: bounds[g + 1]]) for g in range(len(gids))]
+            )
         return SpatialFrame(out, None)
 
     def to_dict(self) -> Dict[str, list]:

@@ -499,11 +499,7 @@ class SQLContext:
                 if src == "*":
                     src = group[0]
                 spec[it["alias"]] = (fn_map[it["fn"]], src)
-            key = group[0]
-            out = frame.group_by(key, spec)
-            if len(group) > 1:
-                raise SqlError("GROUP BY supports one key column")
-            return out
+            return frame.group_by(group, spec)
         # global aggregate: one row
         cols: Dict[str, np.ndarray] = {}
         n = len(frame)

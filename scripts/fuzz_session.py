@@ -1,0 +1,148 @@
+"""Extended fuzz-parity session: many random store pairs x many random
+queries across every executor mode, with deletes, sorts, limits,
+projections and compaction — the long-running version of
+tests/test_fuzz_parity.py, covering the round-3 paths (record-table
+joins, dictionary-encoded strings, device-assisted seek).
+
+Usage: python scripts/fuzz_session.py [minutes] (default 30). Prints a
+running tally; any parity failure prints the repro (seed, mode, query)
+and exits non-zero.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import numpy as np  # noqa: E402
+
+from geomesa_tpu.parallel.mesh import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()
+
+from geomesa_tpu.geom.base import Point  # noqa: E402
+from geomesa_tpu.index.planner import Query  # noqa: E402
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh  # noqa: E402
+from geomesa_tpu.schema.featuretype import parse_spec  # noqa: E402
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+from test_fuzz_parity import _data, _rand_query  # noqa: E402
+
+SPEC = "name:String:index=true,tag:String,age:Int,dtg:Date,*geom:Point:srid=4326"
+
+MODES = [
+    {"GEOMESA_SEEK": "auto"},
+    {"GEOMESA_SEEK": "0"},
+    {"GEOMESA_SEEK": "1"},
+    {"GEOMESA_SEEK": "auto", "GEOMESA_TPU_NO_NATIVE": "1"},
+    {"GEOMESA_SEEK": "auto", "GEOMESA_DEVSEEK": "1"},
+    {"GEOMESA_SEEK": "auto", "GEOMESA_EXACT_DEVICE": "1"},
+]
+
+
+def build_pair(rng, n):
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    rows = _data(rng, n)
+    for s in (host, tpu):
+        s.create_schema(parse_spec("t", SPEC))
+        with s.writer("t") as w:
+            for fid, name, age, t, x, y in rows:
+                tag = None if int(fid[1:]) % 13 == 0 else f"tag-{int(fid[1:]) % 7}"
+                w.write([name, tag, age, t, Point(x, y)], fid=fid)
+    return host, tpu
+
+
+def one_round(seed: int) -> int:
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(400, 2500))
+    mode = MODES[seed % len(MODES)]
+    old = {k: os.environ.get(k) for k in
+           ("GEOMESA_SEEK", "GEOMESA_TPU_NO_NATIVE", "GEOMESA_DEVSEEK",
+            "GEOMESA_EXACT_DEVICE")}
+    for k in old:
+        os.environ.pop(k, None)
+    os.environ.update(mode)
+    try:
+        host, tpu = build_pair(rng, n)
+        checked = 0
+        queries = [_rand_query(rng) for _ in range(12)] + [
+            "tag IS NULL",
+            "tag = 'tag-3' AND bbox(geom, -50, -40, 40, 40)",
+            "name LIKE 'n%' AND age BETWEEN 10 AND 50",
+        ]
+        for q in queries:
+            got = sorted(map(str, tpu.query("t", q).fids))
+            want = sorted(map(str, host.query("t", q).fids))
+            assert got == want, ("plain", seed, mode, q)
+            checked += 1
+        # options: sort / limit / projection
+        q = queries[0]
+        for opts in (
+            dict(sort_by=[("age", False)]),
+            dict(max_features=7),
+            dict(properties=["name", "geom"]),
+            dict(sort_by=[("name", True)], max_features=11),
+        ):
+            a = tpu.query("t", Query.cql(q, **opts))
+            b = host.query("t", Query.cql(q, **opts))
+            assert len(a) == len(b), ("opts-len", seed, mode, q, opts)
+            if "sort_by" in opts and "max_features" not in opts:
+                key = opts["sort_by"][0][0]
+                av = a.columns.get(key)
+                bv = b.columns.get(key)
+                if av is not None and bv is not None:
+                    assert list(map(str, av)) == list(map(str, bv)), (
+                        "opts-order", seed, mode, q, opts)
+            checked += 1
+        # deletes then requery, then compact then requery
+        dead = [f"f{i}" for i in range(0, n, int(rng.integers(5, 11)))]
+        for s in (host, tpu):
+            s.delete_features("t", dead)
+        for q in queries[:5]:
+            got = sorted(map(str, tpu.query("t", q).fids))
+            want = sorted(map(str, host.query("t", q).fids))
+            assert got == want, ("post-delete", seed, mode, q)
+            checked += 1
+        tpu.compact("t")
+        for q in queries[:5]:
+            got = sorted(map(str, tpu.query("t", q).fids))
+            want = sorted(map(str, host.query("t", q).fids))
+            assert got == want, ("post-compact", seed, mode, q)
+            checked += 1
+        return checked
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main():
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
+    deadline = time.monotonic() + minutes * 60
+    seed = int(os.environ.get("FUZZ_SEED0", 10_000))
+    stores = 0
+    queries = 0
+    t0 = time.monotonic()
+    while time.monotonic() < deadline:
+        queries += one_round(seed)
+        stores += 1
+        seed += 1
+        if stores % 25 == 0:
+            dt = time.monotonic() - t0
+            print(
+                f"[fuzz] {stores} store pairs, {queries} checks, "
+                f"{dt:.0f}s elapsed, 0 failures",
+                flush=True,
+            )
+    print(f"[fuzz] DONE: {stores} store pairs, {queries} checks, 0 failures")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,3 +151,38 @@ def test_fs_store_roundtrip_with_dictionary(tmp_path):
     s2 = FsDataStore(root)
     got = set(map(str, s2.query("t", "actor = 'CHN'").fids))
     assert got == want
+
+
+def test_arrow_export_uses_stored_codes_directly():
+    """Record-layout dictionary columns export to REAL Arrow dictionaries
+    without re-encoding: codes+vocab in -> DictionaryArray out, nulls
+    preserved, values identical after decode."""
+    import io as _io
+
+    import pyarrow as pa
+
+    from geomesa_tpu.arrow.vector import SimpleFeatureVector, read_features, write_features
+
+    ft = parse_spec("t", "actor:String,*geom:Point:srid=4326")
+    codes = np.array([0, 2, -1, 1, 2, 0], dtype=np.int32)
+    vocab = np.array(["AAA", "BBB", "CCC"])
+    cols = {
+        "__fid__": np.array([f"f{i}" for i in range(6)], dtype=object),
+        "actor": codes,
+        "actor__vocab": vocab,
+        "actor__null": codes < 0,
+        "geom__x": np.zeros(6),
+        "geom__y": np.zeros(6),
+    }
+    vec = SimpleFeatureVector(ft, dictionary_encode=["actor"])
+    batch = vec.to_batch(cols)
+    col = batch.column(1)
+    assert pa.types.is_dictionary(col.type)
+    assert col.dictionary.to_pylist() == ["AAA", "BBB", "CCC"]  # verbatim vocab
+    assert col.to_pylist() == ["AAA", "CCC", None, "BBB", "CCC", "AAA"]
+    # full IPC round trip
+    buf = _io.BytesIO()
+    write_features(ft, [cols], buf, dictionary_encode=["actor"])
+    buf.seek(0)
+    _, got = read_features(buf)
+    assert list(got["actor"][:2]) == ["AAA", "CCC"]

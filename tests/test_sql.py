@@ -148,3 +148,19 @@ def test_ungrouped_plain_column_raises(store):
     ctx = SQLContext(store)
     with pytest.raises(SqlError):
         ctx.sql("SELECT actor1, n_articles, count(*) AS n FROM gdelt GROUP BY actor1")
+
+
+def test_multi_key_group_by(store):
+    ctx = SQLContext(store)
+    r = ctx.sql(
+        "SELECT actor1, n_articles, count(*) AS n FROM gdelt "
+        "WHERE n_articles < 3 GROUP BY actor1, n_articles ORDER BY n DESC"
+    )
+    assert set(r.columns) == {"actor1", "n_articles", "n"}
+    # every (actor, n_articles) pair appears once, counts sum to the filter
+    pairs = list(zip(r.columns["actor1"], r.columns["n_articles"]))
+    assert len(pairs) == len(set(pairs))
+    want = store.query("gdelt", "n_articles < 3")
+    assert int(r.columns["n"].sum()) == len(want)
+    vals = list(r.columns["n"])
+    assert vals == sorted(vals, reverse=True)
