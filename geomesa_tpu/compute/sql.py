@@ -29,7 +29,7 @@ _TOKEN = re.compile(
     r"""\s*(?:
         (?P<str>'(?:[^']|'')*')
       | (?P<num>-?\d+\.?\d*(?:[eE][+-]?\d+)?)
-      | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+      | (?P<ident>[A-Za-z_][A-Za-z_0-9]*(?:\.[A-Za-z_][A-Za-z_0-9]*)?)
       | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*)
     )""",
     re.VERBOSE,
@@ -106,6 +106,31 @@ class _Parser:
         kind, table = self.take()
         if kind != "ident":
             raise SqlError("Expected table name after FROM")
+        alias = None
+        if self.peek()[0] == "ident" and not self.peek("WHERE") and not any(
+            self.peek(k) for k in ("JOIN", "GROUP", "ORDER", "LIMIT")
+        ):
+            alias = self.ident()
+        join = None
+        if self.accept_kw("JOIN"):
+            kind, rtable = self.take()
+            if kind != "ident":
+                raise SqlError("Expected table name after JOIN")
+            ralias = None
+            if self.peek()[0] == "ident" and not self.peek("ON"):
+                ralias = self.ident()
+            self.expect_kw("ON")
+            kind, fn = self.take()
+            if kind != "ident" or not fn.lower().startswith("st_"):
+                raise SqlError("JOIN ON needs an ST_* predicate")
+            self.expect_op("(")
+            on_args = self.call_args()
+            join = {
+                "table": rtable,
+                "alias": ralias or rtable,
+                "fn": fn.lower(),
+                "args": on_args,
+            }
         where = None
         if self.accept_kw("WHERE"):
             where = self.or_expr()
@@ -140,6 +165,8 @@ class _Parser:
         return {
             "items": items,
             "table": table,
+            "alias": alias or table,
+            "join": join,
             "where": where,
             "group": group,
             "order": order,
@@ -323,6 +350,45 @@ def _lit(v):
     return v[1]
 
 
+def _project_plain(columns: Dict[str, np.ndarray], plain_items) -> Dict[str, np.ndarray]:
+    """Project plain select items out of a column dict: the value column
+    maps to the item's alias and subcolumns (__x/__y/__null) keep their
+    suffix under the alias; dictionary vocabs never leak."""
+    cols: Dict[str, np.ndarray] = {}
+    for it in plain_items:
+        src = it["name"]
+        alias = it["alias"]
+        found = False
+        for k, v in columns.items():
+            if k == src:
+                cols[alias] = v
+                found = True
+            elif k.startswith(src + "__") and not k.endswith("__vocab"):
+                cols[alias + k[len(src):]] = v
+                found = True
+        if not found:
+            raise SqlError(f"Unknown column {src}")
+    return cols
+
+
+def _flatten_and(f: Optional[ast.Filter]) -> List[ast.Filter]:
+    if f is None or isinstance(f, ast.Include):
+        return []
+    if isinstance(f, ast.And):
+        return [p for c in f.children() for p in _flatten_and(c)]
+    return [f]
+
+
+def _strip_alias(f: ast.Filter) -> ast.Filter:
+    """Rewrite 'alias.prop' references to bare 'prop' (in place — the
+    nodes are fresh from this parse)."""
+    for node in ast.walk(f):
+        prop = getattr(node, "prop", None)
+        if prop and "." in prop:
+            node.prop = prop.split(".", 1)[1]
+    return f
+
+
 def _column_name(v) -> str:
     if v[0] != "col":
         raise SqlError(f"Expected column reference, got {v!r}")
@@ -385,8 +451,168 @@ class SQLContext:
 
     def sql(self, text: str) -> SqlResult:
         q = _Parser(text).parse()
+        if q["join"] is not None:
+            return self._execute_join(q)
         ft = self.store.get_schema(q["table"])
         return self._execute(ft, q)
+
+    # -- JOIN (the Catalyst spatial-join relation, SQLRules.scala) -----------
+
+    def _execute_join(self, q: dict) -> SqlResult:
+        """Two-relation spatial join: single-alias WHERE conjuncts push
+        down into EACH relation's index scan (per-relation CQL pushdown),
+        the ON ST_* predicate folds into SpatialFrame.spatial_join, and
+        the SELECT/GROUP/ORDER pipeline runs over the joined frame."""
+        join = q["join"]
+        la, ra = q["alias"], join["alias"]
+        if la == ra:
+            raise SqlError("JOIN aliases must differ")
+        rels = {la: q["table"], ra: join["table"]}
+        # split the WHERE into per-alias conjuncts
+        conjuncts: Dict[str, List[ast.Filter]] = {la: [], ra: []}
+        for part in _flatten_and(q["where"]):
+            aliases = {p.split(".", 1)[0] for p in ast.properties(part) if "." in p}
+            if len(aliases) != 1 or not aliases <= set(conjuncts):
+                raise SqlError(
+                    "JOIN WHERE predicates must reference exactly one alias"
+                )
+            conjuncts[aliases.pop()].append(_strip_alias(part))
+        # ON predicate -> (left alias(points), right alias, predicate, dist)
+        fn = join["fn"]
+        args = join["args"]
+        arg_alias = [
+            a[1].split(".", 1)[0] if a[0] == "col" and "." in a[1] else None
+            for a in args
+        ]
+        dist = None
+        if fn == "st_dwithin":
+            if len(args) < 3:
+                raise SqlError("st_dwithin join needs a distance")
+            dist = float(_lit(args[2]))
+            pred = "dwithin"
+            left, right = arg_alias[0], arg_alias[1]
+        elif fn in ("st_intersects", "st_within", "st_contains"):
+            pred = "intersects"
+            if fn == "st_within":
+                left, right = arg_alias[0], arg_alias[1]
+            elif fn == "st_contains":  # contains(a, b): b inside a
+                left, right = arg_alias[1], arg_alias[0]
+            else:
+                left, right = arg_alias[0], arg_alias[1]
+        else:
+            raise SqlError(f"Unsupported join predicate {fn}")
+        if left is None or right is None or {left, right} != {la, ra}:
+            raise SqlError("JOIN ON must reference both aliases' geometries")
+        # intersects is symmetric: the POINT-typed relation drives the join
+        if fn == "st_intersects":
+            lft_pts = self.store.get_schema(rels[left]).is_points
+            if not lft_pts and self.store.get_schema(rels[right]).is_points:
+                left, right = right, left
+        frames = {}
+        plans = {}
+        for alias in (la, ra):
+            f = (
+                ast.and_option(conjuncts[alias])
+                if conjuncts[alias]
+                else ast.Include()
+            )
+            res = self.store.query(rels[alias], Query(filter=f))
+            plans[alias] = res.plan
+            frames[alias] = SpatialFrame(
+                res.columns if isinstance(res.columns, dict)
+                else res.columns.materialize(),
+                res.ft,
+            )
+        raw = frames[left].spatial_join(
+            frames[right], predicate=pred, distance_m=dist, suffix="_r"
+        )
+        # canonicalize right-originated output columns DETERMINISTICALLY:
+        # every right attribute becomes base_r (companions keep their
+        # suffix: name__null -> name_r__null), whether or not it happened
+        # to collide with a left column — qualified resolution must never
+        # depend on the collision set
+        leftkeys = set(frames[left].columns)
+        rightkeys = set(frames[right].columns)
+        cols = {}
+        for k, v in raw.columns.items():
+            if k in leftkeys:
+                cols[k] = v
+                continue
+            orig = (
+                k[:-2] if k.endswith("_r") and k[:-2] in rightkeys else k
+            )
+            if orig.startswith("__"):
+                cols[k] = v  # __fid__ internals stay as produced
+                continue
+            base = orig.split("__", 1)[0]
+            cols[base + "_r" + orig[len(base):]] = v
+        joined = SpatialFrame(cols, raw.ft)
+
+        def resolve(name: str) -> str:
+            if "." not in name:
+                raise SqlError(f"JOIN columns must be qualified: {name}")
+            alias, col = name.split(".", 1)
+            if alias == left:
+                return col
+            if alias == right:
+                return col + "_r"
+            raise SqlError(f"Unknown alias {alias}")
+
+        items = []
+        for it in q["items"]:
+            it = dict(it)
+            if it["kind"] == "stfn":
+                raise SqlError(
+                    "ST_* select expressions are not supported in JOIN queries"
+                )
+            if it["kind"] == "col":
+                src = resolve(it["name"])
+                if it["alias"] == it["name"]:
+                    # default output name: the bare column (AS overrides)
+                    it["alias"] = it["name"].split(".", 1)[1]
+                it["name"] = src
+            elif it["kind"] == "agg" and it["arg"] != "*":
+                it["arg"] = resolve(it["arg"])
+            items.append(it)
+        group = [resolve(g) if "." in g else g for g in q["group"]]
+        aggs = [it for it in items if it["kind"] == "agg"]
+        plain = [it for it in items if it["kind"] == "col"]
+        star = any(it["kind"] == "star" for it in items)
+        if aggs or group:
+            out = self._aggregate(joined, group, aggs, plain)
+            # group keys surface under their BARE names (same default as
+            # plain select aliases): zname_r -> zname. Ambiguous bare
+            # names (a.name + b.name) keep their resolved forms.
+            bares = [g.split(".", 1)[1] for g in q["group"] if "." in g]
+            renames = (
+                {resolve(g): g.split(".", 1)[1] for g in q["group"] if "." in g}
+                if len(set(bares)) == len(bares)
+                else {}
+            )
+            out = SpatialFrame(
+                {renames.get(k, k): v for k, v in out.columns.items()}, out.ft
+            )
+            for col, asc in reversed(q["order"]):
+                key = col.split(".", 1)[1] if "." in col else col
+                if key not in out.columns:
+                    raise SqlError(f"ORDER BY references unknown column {col}")
+                out = out.sort(key, asc)
+        else:
+            # sort on the FULL joined frame (aliases have not narrowed the
+            # columns yet), then project
+            for col, asc in reversed(q["order"]):
+                key = resolve(col) if "." in col else col
+                if key not in joined.columns:
+                    raise SqlError(f"ORDER BY references unknown column {col}")
+                joined = joined.sort(key, asc)
+            out = joined if star else SpatialFrame(
+                _project_plain(joined.columns, plain), joined.ft
+            )
+        if q["limit"] is not None:
+            out = SpatialFrame(
+                {k: v[: q["limit"]] for k, v in out.columns.items()}, out.ft
+            )
+        return SqlResult(out.columns, out.ft, plans[left])
 
     # -- execution -----------------------------------------------------------
 
@@ -454,18 +680,7 @@ class SQLContext:
                 return out
             return SqlResult(out.columns, out.ft, res.plan)
         if not star:
-            cols: Dict[str, np.ndarray] = {}
-            for it in plain:
-                src = it["name"]
-                alias = it["alias"]
-                for k, v in frame.columns.items():
-                    if k == src:
-                        cols[alias] = v
-                    elif k.startswith(src + "__") and not k.endswith("__vocab"):
-                        # subcolumns (__x/__y/__null) keep their suffix
-                        # under the alias — collapsing them onto the alias
-                        # key would clobber the value column
-                        cols[alias + k[len(src):]] = v
+            cols = _project_plain(frame.columns, plain)
             for it in stfns:
                 cols[it["alias"]] = frame.columns[it["alias"]]
             frame = SpatialFrame(cols, frame.ft)

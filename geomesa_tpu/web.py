@@ -111,6 +111,34 @@ def make_handler(store):
                     recs = res.aggregate["bin"]
                     body = recs.tobytes() if hasattr(recs, "tobytes") else recs
                     self._send(200, body, "application/octet-stream")
+                elif route == "/raster":
+                    # WCS GetCoverage role (GeoMesaCoverageReader analog):
+                    # bbox window at an arbitrary output size from the
+                    # raster pyramid attached to the server
+                    from geomesa_tpu.geom.base import Envelope
+
+                    rstore = getattr(store, "raster_store", None)
+                    if rstore is None:
+                        self._send(404, json.dumps({"error": "no raster store"}))
+                        return
+                    env = [float(v) for v in params["bbox"].split(",")]
+                    w = int(params.get("width", 256))
+                    h = int(params.get("height", 256))
+                    grid = rstore.read_window(Envelope(*env), w, h)
+                    if params.get("format") == "npy":
+                        import io as _io
+
+                        import numpy as _np
+
+                        buf = _io.BytesIO()
+                        _np.save(buf, grid)
+                        self._send(200, buf.getvalue(), "application/octet-stream")
+                    else:
+                        self._send(
+                            200,
+                            json.dumps({"shape": list(grid.shape),
+                                        "grid": grid.tolist()}),
+                        )
                 elif route == "/stats/count":
                     name = params["name"]
                     exact = params.get("exact", "true").lower() != "false"

@@ -99,3 +99,33 @@ def test_tall_window_picks_fine_level():
     lon = q.xmin + (np.arange(20) + 0.5) * (q.xmax - q.xmin) / 20
     want = np.sin(np.radians(lon))[None, :] * 100 + np.cos(np.radians(lat))[:, None] * 50
     assert np.abs(win - want).mean() < 0.5
+
+
+def test_web_raster_endpoint():
+    """WCS-style /raster endpoint serves pyramid windows over HTTP."""
+    import json
+    import urllib.request
+
+    from geomesa_tpu.store.datastore import TpuDataStore
+    from geomesa_tpu.web import GeoMesaServer
+
+    data = _source(512, 1024)
+    rstore = RasterStore()
+    rstore.ingest_raster(data, WORLD, chip_size=256)
+    store = TpuDataStore.__new__(TpuDataStore)  # minimal facade holder
+    store.__init__()
+    store.raster_store = rstore
+    with GeoMesaServer(store) as url:
+        got = json.loads(
+            urllib.request.urlopen(
+                f"{url}/raster?bbox=-10,-5,30,15&width=64&height=32"
+            ).read()
+        )
+        assert got["shape"][:2] == [32, 64]
+        import numpy as _np
+
+        grid = _np.asarray(got["grid"])
+        lat = 15 - (_np.arange(32) + 0.5) * 20 / 32
+        lon = -10 + (_np.arange(64) + 0.5) * 40 / 64
+        want = _np.sin(_np.radians(lon))[None, :] * 100 + _np.cos(_np.radians(lat))[:, None] * 50
+        assert _np.abs(grid - want).mean() < 2.0

@@ -164,3 +164,77 @@ def test_multi_key_group_by(store):
     assert int(r.columns["n"].sum()) == len(want)
     vals = list(r.columns["n"])
     assert vals == sorted(vals, reverse=True)
+
+
+def test_spatial_join_sql():
+    """JOIN ... ON st_contains(b.geom, a.geom): per-relation WHERE
+    pushdown + the spatial-join relation (SQLRules.scala spatial join)."""
+    from geomesa_tpu.geom.base import Polygon
+
+    s = TpuDataStore()
+    s.create_schema(parse_spec("pts", "kind:String,*geom:Point:srid=4326"))
+    s.create_schema(parse_spec("zones", "zname:String,*geom:Polygon:srid=4326"))
+    with s.writer("pts") as w:
+        for i in range(200):
+            # points on a grid: 10x10 inside [0,10)^2, rest far away
+            if i < 100:
+                w.write([f"k{i % 3}", Point(i % 10 + 0.5, i // 10 % 10 + 0.5)], fid=f"p{i}")
+            else:
+                w.write([f"k{i % 3}", Point(100.0 + i % 50, -60.0)], fid=f"p{i}")
+    with s.writer("zones") as w:
+        w.write(["west", Polygon([[0, 0], [5, 0], [5, 10], [0, 10], [0, 0]])], fid="z1")
+        w.write(["east", Polygon([[5, 0], [10, 0], [10, 10], [5, 10], [5, 0]])], fid="z2")
+    ctx = SQLContext(s)
+    r = ctx.sql(
+        "SELECT b.zname, count(*) AS n FROM pts a JOIN zones b "
+        "ON st_contains(b.geom, a.geom) WHERE a.kind <> 'k2' "
+        "GROUP BY b.zname ORDER BY n DESC"
+    )
+    # 100 grid points, minus kind k2 (1/3), split between two 5x10 zones
+    assert set(r.columns["zname"]) == {"west", "east"}
+    assert int(r.columns["n"].sum()) == sum(
+        1 for i in range(100) if i % 3 != 2
+    )
+    r2 = ctx.sql(
+        "SELECT a.kind, b.zname FROM pts a JOIN zones b "
+        "ON st_intersects(a.geom, b.geom) WHERE b.zname = 'west' LIMIT 500"
+    )
+    assert set(r2.columns["zname"]) == {"west"}
+    assert len(r2.columns["kind"]) == 50
+    with pytest.raises(SqlError):
+        ctx.sql("SELECT a.kind FROM pts a JOIN zones b ON st_contains(b.geom, a.geom) "
+                "WHERE kind = 'k0'")  # unqualified in a join
+
+
+def test_join_right_columns_resolve_correctly():
+    """Right-relation columns resolve deterministically: b.geom returns
+    the RIGHT geometry subcolumns, colliding right columns keep their
+    null masks, and ORDER BY b.col works (review regression suite)."""
+    from geomesa_tpu.geom.base import Polygon
+
+    s = TpuDataStore()
+    s.create_schema(parse_spec("pts", "name:String,*geom:Point:srid=4326"))
+    s.create_schema(parse_spec("zones", "name:String,*geom:Polygon:srid=4326"))
+    with s.writer("pts") as w:
+        for i in range(8):
+            w.write([f"p{i}", Point(i + 0.5, 0.5)], fid=f"p{i}")
+    with s.writer("zones") as w:
+        w.write(["zB", Polygon([[0, 0], [4, 0], [4, 1], [0, 1], [0, 0]])], fid="z1")
+        w.write([None, Polygon([[4, 0], [8, 0], [8, 1], [4, 1], [4, 0]])], fid="z2")
+    ctx = SQLContext(s)
+    r = ctx.sql("SELECT b.geom FROM pts a JOIN zones b ON st_contains(b.geom, a.geom)")
+    # b.geom is the POLYGON relation's geometry column, not the points
+    assert "geom" in r.columns or "geom__bxmin" not in r.columns
+    gcol = r.columns.get("geom")
+    assert gcol is not None and all(g.geom_type == "Polygon" for g in gcol)
+    # colliding right column keeps its null mask
+    r2 = ctx.sql("SELECT b.name FROM pts a JOIN zones b ON st_contains(b.geom, a.geom)")
+    assert "name__null" in r2.columns
+    assert int(np.asarray(r2.columns["name__null"]).sum()) == 4  # z2 matches
+    # ORDER BY a right column
+    r3 = ctx.sql("SELECT a.name, b.name AS zn FROM pts a JOIN zones b "
+                 "ON st_contains(b.geom, a.geom) ORDER BY b.name DESC")
+    assert len(r3.columns["zn"]) == 8
+    # ST_* select expressions are explicitly rejected in joins
+    with pytest.raises(SqlError):
+        ctx.sql("SELECT st_x(a.geom) FROM pts a JOIN zones b ON st_contains(b.geom, a.geom)")
