@@ -46,16 +46,31 @@ def log(msg):
         f.write(line + "\n")
 
 
+_probe_fails = 0
+
+
 def probe(timeout_s=45) -> bool:
+    global _probe_fails
     try:
         p = subprocess.run(
             [sys.executable, "-c",
              "import jax; d = jax.devices(); print('OK', d[0].platform)"],
             capture_output=True, text=True, timeout=timeout_s,
         )
-        return p.returncode == 0 and "OK tpu" in p.stdout
+        ok = p.returncode == 0 and "OK tpu" in p.stdout
+        why = "" if ok else f"rc={p.returncode} {p.stderr.strip()[-120:]}"
     except subprocess.TimeoutExpired:
-        return False
+        ok = False
+        why = "timeout"
+    if ok:
+        _probe_fails = 0
+        return True
+    _probe_fails += 1
+    # one diagnostic line every ~10 failures (quiet steady-state, but the
+    # log shows the watcher IS probing and WHY probes fail)
+    if _probe_fails % 10 == 1:
+        log(f"probe failed x{_probe_fails}: {why}")
+    return False
 
 
 def run(cmd, env_extra=None, timeout_s=1800):
