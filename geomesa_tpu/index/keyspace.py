@@ -499,7 +499,16 @@ class XZ3KeySpace(IndexKeySpace):
 
 
 class IdKeySpace(IndexKeySpace):
-    """Feature-id index (IdIndex, index/IdIndex.scala:24)."""
+    """Feature-id index (IdIndex, index/IdIndex.scala:24).
+
+    Keys are the fids as ASCII BYTES (numpy 'S' via the C-speed U->S
+    astype, which is ASCII-only): byte value equals code point, so
+    lexicographic scans are unchanged, while sorting moves 4x less data
+    than UCS-4 unicode and compares with memcmp — the id table is pure
+    (key, rowid) so this is its whole cost. Batches with any non-ASCII
+    fid keep unicode keys (the scan handles both; a block's key dtype
+    says which). Scan-range bounds encode the same way at seek time
+    (FeatureBlock._slice_intervals)."""
 
     name = "id"
 
@@ -507,7 +516,13 @@ class IdKeySpace(IndexKeySpace):
         return True
 
     def key_columns(self, ft: FeatureType, columns) -> Dict[str, np.ndarray]:
-        return {"__key__": columns["__fid__"]}
+        fid = columns["__fid__"]
+        if fid.dtype.kind == "U":
+            try:
+                return {"__key__": fid.astype("S")}
+            except UnicodeEncodeError:
+                pass  # non-latin-1 fids: unicode keys
+        return {"__key__": fid}
 
     def get_index_values(self, ft: FeatureType, f: ast.Filter) -> IndexValues:
         ids: List[str] = []

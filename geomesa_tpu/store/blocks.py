@@ -643,6 +643,36 @@ class FeatureBlock:
             if not ranges:
                 z = np.empty(0, dtype=np.int64)
                 return z, z, np.empty(0, dtype=bool)
+        elif sub.dtype.kind == "S":
+            # bytes keys (the id index, ASCII): encode str bounds the
+            # same way — byte value == code point, so order is unchanged.
+            # A non-ASCII bound cannot exist in an ASCII block: drop it.
+            try:
+                # one C pass over all bounds (the common all-str case)
+                lo_b = np.asarray([r.lower for r in ranges]).astype("S")
+                hi_b = np.asarray([r.upper for r in ranges]).astype("S")
+                ranges = [
+                    r._replace(lower=lo, upper=hi)
+                    for r, lo, hi in zip(ranges, lo_b, hi_b)
+                ]
+            except (UnicodeEncodeError, TypeError):
+                mapped = []
+                for r in ranges:
+                    try:
+                        lo = (
+                            r.lower.encode("ascii")
+                            if isinstance(r.lower, str)
+                            else r.lower
+                        )
+                        hi = (
+                            r.upper.encode("ascii")
+                            if isinstance(r.upper, str)
+                            else r.upper
+                        )
+                    except UnicodeEncodeError:
+                        continue
+                    mapped.append(r._replace(lower=lo, upper=hi))
+                ranges = mapped
         numeric = sub.dtype != object
         if self.tiebreak is not None and any(r.tiebreak_ranges for r in ranges):
             # attribute scans with a z2 tiebreak: within each equality span
