@@ -30,10 +30,22 @@ class LambdaDataStore:
         persistent: Optional[TpuDataStore] = None,
         transient: Optional[StreamDataStore] = None,
         age_ms: int = 3600_000,
+        offset_manager=None,
     ):
+        """``offset_manager`` (stream.filelog.FileOffsetManager or
+        compatible): when given, the per-partition LOG OFFSETS persisted
+        so far are committed after every ``persist_expired`` under the
+        pseudo-topic ``<name>#persisted`` — the ZookeeperOffsetManager
+        role. A restarted consumer re-reads the durable log into its
+        cache but skips RE-PERSISTING entries whose message offset is
+        below the commit (persisting is idempotent either way; the
+        watermark only saves the duplicate downstream writes). Offsets —
+        not event timestamps — are the watermark, so late-arriving event
+        times can never classify a fresh message as already done."""
         self.persistent = persistent or TpuDataStore()
         self.transient = transient or StreamDataStore()
         self.age_ms = age_ms
+        self.offset_manager = offset_manager
 
     def create_schema(self, ft: FeatureType) -> None:
         self.persistent.create_schema(ft)
@@ -50,22 +62,53 @@ class LambdaDataStore:
         self.persistent.delete_features(name, [fid])
 
     def persist_expired(self, name: str, now_ms: Optional[int] = None) -> int:
-        """Age features older than age_ms down to the persistent tier."""
+        """Age features older than age_ms down to the persistent tier.
+        With an offset manager, entries whose source message offset is
+        below the committed per-partition watermark were already
+        persisted by a previous (possibly crashed) consumer and are only
+        removed from the cache, not re-written."""
         self.transient.poll(name)
         cache = self.transient.cache(name)
         expired = cache.expired_items(self.age_ms, now_ms)
         if not expired:
             return 0
+        if self.offset_manager is not None:
+            committed = self.offset_manager.offsets(f"{name}#persisted")
+            if committed:
+                def is_done(origin) -> bool:
+                    return (
+                        origin is not None
+                        and origin[1] < committed.get(origin[0], 0)
+                    )
+
+                done = [e for e in expired if is_done(e[3])]
+                expired = [e for e in expired if not is_done(e[3])]
+                for fid, _, _, _ in done:
+                    cache.remove(fid)
+                if not expired:
+                    return 0
         # replace any previously persisted versions: tombstone + compact
         # folds the deletes in BEFORE the rewrite (tombstones are per-table,
         # so a delete after the write would also swallow the new row)
-        self.persistent.delete_features(name, [fid for fid, _, _ in expired])
+        self.persistent.delete_features(name, [fid for fid, _, _, _ in expired])
         self.persistent.compact(name)
         with self.persistent.writer(name) as w:
-            for fid, values, _ in expired:
+            for fid, values, _, _ in expired:
                 w.write(values, fid=fid)
-        for fid, _, _ in expired:
+        for fid, _, _, _ in expired:
             cache.remove(fid)
+        if self.offset_manager is not None:
+            # commit AFTER the durable write: a crash in between merely
+            # re-persists the same features (idempotent delete+rewrite).
+            # Per-partition max persisted offset, merged with the prior
+            # commit (another consumer may own other partitions).
+            committed = dict(self.offset_manager.offsets(f"{name}#persisted"))
+            for _, _, _, origin in expired:
+                if origin is not None:
+                    p, off = origin
+                    committed[p] = max(committed.get(p, 0), off + 1)
+            if committed:
+                self.offset_manager.commit(f"{name}#persisted", committed)
         return len(expired)
 
     def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:

@@ -46,8 +46,12 @@ class FeatureCache:
         self._live: Dict[str, tuple] = {}
         self._columns = None
 
-    def put(self, fid: str, values: List[Any], ts: int):
-        self._live[fid] = (values, ts)
+    def put(self, fid: str, values: List[Any], ts: int, origin=None):
+        """``origin``: (partition, offset) provenance of the message this
+        entry came from — the lambda tier's persistence watermark is
+        offset-based, so late EVENT times can never classify a fresh
+        message as already-persisted."""
+        self._live[fid] = (values, ts, origin)
         self._columns = None
 
     def remove(self, fid: str):
@@ -62,13 +66,16 @@ class FeatureCache:
         if self.expiry_ms is None:
             return
         cutoff = (now_ms if now_ms is not None else _now_ms()) - self.expiry_ms
-        stale = [fid for fid, (_, ts) in self._live.items() if ts < cutoff]
+        stale = [fid for fid, (_, ts, _o) in self._live.items() if ts < cutoff]
         for fid in stale:
             self.remove(fid)
 
     def expired_items(self, age_ms: int, now_ms: Optional[int] = None):
+        """[(fid, values, ts, origin)] of entries older than age_ms."""
         cutoff = (now_ms if now_ms is not None else _now_ms()) - age_ms
-        return [(fid, v, ts) for fid, (v, ts) in self._live.items() if ts < cutoff]
+        return [
+            (fid, v, ts, o) for fid, (v, ts, o) in self._live.items() if ts < cutoff
+        ]
 
     def __len__(self):
         return len(self._live)
@@ -78,7 +85,10 @@ class FeatureCache:
 
     def columns(self):
         if self._columns is None:
-            feats = [Feature(self.ft, fid, list(v)) for fid, (v, _) in self._live.items()]
+            feats = [
+                Feature(self.ft, fid, list(v))
+                for fid, (v, _ts, _o) in self._live.items()
+            ]
             self._columns = columns_from_features(self.ft, feats)
         return self._columns
 
@@ -174,7 +184,7 @@ class StreamDataStore:
         for p, off, payload in records:
             msg = ser.deserialize(payload)
             if isinstance(msg, CreateOrUpdate):
-                cache.put(msg.fid, msg.values, msg.ts_ms)
+                cache.put(msg.fid, msg.values, msg.ts_ms, origin=(p, off))
             elif isinstance(msg, Delete):
                 cache.remove(msg.fid)
             else:

@@ -219,3 +219,53 @@ def test_partition_assignment_splits_topic_across_consumers(tmp_path):
         c.poll("t")
     assert sorted(got) == sorted(f"f{i}" for i in range(200))
     assert len(got) == len(set(got))  # exactly once across the group
+
+
+def test_lambda_persist_watermark_skips_repersist(tmp_path):
+    """With an offset manager, a restarted lambda consumer does NOT
+    re-write already-persisted features to the persistent tier — the
+    committed watermark (ZookeeperOffsetManager role) marks them done."""
+    from geomesa_tpu.store.fs import FsDataStore
+    from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+    root = str(tmp_path / "log")
+    pdir = str(tmp_path / "persist")
+    producer = StreamDataStore(broker=FileLogBroker(root))
+    producer.create_schema(parse_spec("t", SPEC))
+    _write_n(producer, 100)
+
+    def make():
+        return LambdaDataStore(
+            persistent=FsDataStore(pdir),
+            transient=StreamDataStore(broker=FileLogBroker(root)),
+            age_ms=10,
+            offset_manager=FileOffsetManager(root, "lam"),
+        )
+
+    lam1 = make()
+    lam1.create_schema(parse_spec("t", SPEC))
+    n1 = lam1.persist_expired("t", now_ms=1760000000000 + 100 + 10)
+    assert n1 == 100
+    del lam1  # crash analog
+
+    lam2 = make()
+    lam2.create_schema(parse_spec("t", SPEC))
+    # replayed cache entries are below the watermark: nothing re-persisted
+    n2 = lam2.persist_expired("t", now_ms=1760000000000 + 100 + 10)
+    assert n2 == 0
+    assert len(lam2.query("t", "INCLUDE")) == 100
+    # new writes after the watermark persist normally
+    _write_n(producer, 20, start=100)
+    n3 = lam2.persist_expired("t", now_ms=1760000000000 + 200 + 10)
+    assert n3 == 20
+    assert len(lam2.query("t", "INCLUDE")) == 120
+    # LATE EVENT TIME: a fresh message whose ts is far below the committed
+    # watermark must STILL persist (the watermark is log offsets, not
+    # event time — an event-time watermark would silently drop this row)
+    producer.write("t", ["late", 1760000000000 - 999, Point(0.0, 0.0)],
+                   fid="late1", ts_ms=1760000000000 - 999)
+    n4 = lam2.persist_expired("t", now_ms=1760000000000 + 200 + 10)
+    assert n4 == 1
+    res = lam2.query("t", "IN ('late1')")
+    assert len(res) == 1
+    assert len(lam2.query("t", "INCLUDE")) == 121

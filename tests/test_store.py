@@ -341,3 +341,25 @@ def test_noop_stats_store_accepts_writes():
     with s.writer("t") as w:
         w.write([int(base), Point(1, 1)], fid="a")
     assert list(s.query("t", "INCLUDE").fids) == ["a"]
+
+
+def test_non_ascii_fids_mixed_with_ascii_blocks():
+    """Id-index encoding boundary: ASCII batches get bytes keys, batches
+    containing ANY non-ASCII fid keep unicode keys; lookups across mixed
+    blocks agree, and non-ASCII bounds never match an ASCII block."""
+    s = TpuDataStore(flush_size=3)
+    s.create_schema(parse_spec("t", "*geom:Point:srid=4326"))
+    with s.writer("t") as w:
+        for i in range(3):  # batch 1: pure ASCII -> 'S' keys
+            w.write([Point(i, i)], fid=f"a{i}")
+        w.write([Point(5, 5)], fid="café")  # batch 2: non-ASCII -> 'U' keys
+        w.write([Point(6, 6)], fid="日本-x")
+        w.write([Point(7, 7)], fid="plain")
+    table = s._tables["t"]["id"]
+    kinds = {b.key.dtype.kind for b in table.blocks}
+    assert kinds == {"S", "U"}, kinds
+    got = sorted(map(str, s.query("t", "IN ('a1', 'café', '日本-x', 'nope')").fids))
+    assert got == sorted(["a1", "café", "日本-x"])
+    # a non-ASCII-only query still scans the U block and skips the S block
+    assert sorted(map(str, s.query("t", "IN ('日本-x')").fids)) == ["日本-x"]
+    assert len(s.query("t", "IN ('a0','a2','plain')")) == 3
