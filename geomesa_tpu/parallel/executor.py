@@ -591,6 +591,41 @@ class DeviceSegment:
         self._exact_loaded = True
         return True
 
+    def load_exact_xz(self, table: IndexTable) -> bool:
+        """Pack f64 sort-key limbs of the envelope companions (+ isrect
+        flags) for the extent device-assisted seek; False when this is
+        not an xz2 segment or blocks lack companions."""
+        if self.kind != "xz2":
+            return False
+        if getattr(self, "_exact_xz_loaded", False):
+            return True
+        from geomesa_tpu.ops.zkernels import f64_sort_keys, split_u64_to_limbs
+
+        geom = table.ft.default_geometry.name
+        cols = []
+        for suffix in ("__bxmin", "__bymin", "__bxmax", "__bymax"):
+            parts = []
+            for b in self.blocks:
+                col = b.columns.get(geom + suffix)
+                if col is None:
+                    return False  # legacy blocks without companions
+                parts.append(np.asarray(col, dtype=np.float64))
+            hi, lo = split_u64_to_limbs(f64_sort_keys(np.concatenate(parts)))
+            cols.append(self._pack([hi], np.uint32, np.uint32(0)))
+            cols.append(self._pack([lo], np.uint32, np.uint32(0)))
+        self.xz_limbs = tuple(cols)
+        irs = np.concatenate(
+            [
+                np.asarray(
+                    b.columns.get(geom + "__isrect", np.zeros(b.n, np.uint8))
+                ).astype(bool)
+                for b in self.blocks
+            ]
+        ) if self.blocks else np.empty(0, dtype=bool)
+        self.xz_isrect = self._pack([irs], bool, False)
+        self._exact_xz_loaded = True
+        return True
+
     def dispatch_exact(self, box_dev, win_dev) -> "_PendingHits":
         """Exact predicate scan (see TpuScanExecutor._exact_descriptor)."""
         has_time = self.tk_hi is not None and win_dev is not None
@@ -944,6 +979,123 @@ def _pow2_at_least(n: int, floor: int = 256) -> int:
     return p
 
 
+_DEVSEEK_XZ_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _devseek_xz_fn(n_iv: int, cand_cap: int):
+    """Extent (xz2) device-assisted seek: exact f64 envelope tests on the
+    candidates via sort-key limb compares (the device edition of
+    native/seekscan.cpp geomesa_env_seek_scan). Returns TWO packed
+    bitmaps over the candidate space: ``hit`` (envelope overlaps the
+    query box — exact) and ``decided`` (provably satisfies the exact
+    predicate: envelope inside a rectangle query, or an isrect feature
+    overlapping one). Only hit & ~decided rows — the boundary-straddling
+    ring — need the host's per-geometry test."""
+    key = (n_iv, cand_cap)
+    fn = _DEVSEEK_XZ_FNS.get(key)
+    if fn is not None:
+        return fn
+    from geomesa_tpu.ops.zkernels import limbs_leq
+
+    def run(limbs, isrect, valid, starts, lens, qbox, rect):
+        # limbs: tuple of 8 arrays (bxmin, bymin, bxmax, bymax) x (hi, lo)
+        seg_end = jnp.cumsum(lens)
+        total = seg_end[-1]
+        j = jnp.arange(cand_cap, dtype=jnp.int32)
+        seg = jnp.searchsorted(seg_end, j, side="right")
+        segc = jnp.clip(seg, 0, n_iv - 1)
+        prev = seg_end[segc] - lens[segc]
+        rows = starts[segc] + (j - prev)
+        ok = j < total
+        rows = jnp.where(ok, rows, 0)
+        g = [jnp.take(a, rows) for a in limbs]
+        bxmin_h, bxmin_l, bymin_h, bymin_l, bxmax_h, bxmax_l, bymax_h, bymax_l = g
+        ir = jnp.take(isrect, rows)
+        va = jnp.take(valid, rows) & ok
+        # qbox: u32[16] = (qxmin, qymin, qxmax, qymax) x (hi, lo) twice-
+        # packed: [xmin_h, xmin_l, ymin_h, ymin_l, xmax_h, xmax_l,
+        # ymax_h, ymax_l, zero_h, zero_l, ...pad]
+        qxmin_h, qxmin_l = qbox[0], qbox[1]
+        qymin_h, qymin_l = qbox[2], qbox[3]
+        qxmax_h, qxmax_l = qbox[4], qbox[5]
+        qymax_h, qymax_l = qbox[6], qbox[7]
+        zero_h, zero_l = qbox[8], qbox[9]
+        overlap = (
+            limbs_leq(qxmin_h, qxmin_l, bxmax_h, bxmax_l)
+            & limbs_leq(bxmin_h, bxmin_l, qxmax_h, qxmax_l)
+            & limbs_leq(qymin_h, qymin_l, bymax_h, bymax_l)
+            & limbs_leq(bymin_h, bymin_l, qymax_h, qymax_l)
+        )
+        placeholder = (
+            (bxmin_h == zero_h) & (bxmin_l == zero_l)
+            & (bymin_h == zero_h) & (bymin_l == zero_l)
+            & (bxmax_h == zero_h) & (bxmax_l == zero_l)
+            & (bymax_h == zero_h) & (bymax_l == zero_l)
+        )
+        inside = (
+            limbs_leq(qxmin_h, qxmin_l, bxmin_h, bxmin_l)
+            & limbs_leq(bxmax_h, bxmax_l, qxmax_h, qxmax_l)
+            & limbs_leq(qymin_h, qymin_l, bymin_h, bymin_l)
+            & limbs_leq(bymax_h, bymax_l, qymax_h, qymax_l)
+        )
+        hit = overlap & va
+        decided = hit & rect & ~placeholder & (inside | ir)
+        return jnp.concatenate([jnp.packbits(hit), jnp.packbits(decided)])
+
+    fn = jax.jit(run)
+    _DEVSEEK_XZ_FNS[key] = fn
+    return fn
+
+
+class _DeviceSeekXZScan:
+    """Dispatched xz2 device seeks: decided rows are final; the ring
+    (hit & ~decided) takes the host's exact per-geometry test. ``exact``
+    is True — yielded rows ARE the result set."""
+
+    __slots__ = ("pending", "node", "geom", "exact", "seek")
+
+    def __init__(self, pending, node, geom):
+        self.pending = pending  # [(segment, starts, lens, total, buf)]
+        self.node = node  # the spatial ast node for ring tests
+        self.geom = geom
+        self.exact = True
+        self.seek = True
+
+    def __iter__(self):
+        from geomesa_tpu.filter.evaluate import _geom_predicate
+
+        for seg, starts, lens, total, buf in self.pending:
+            raw = np.asarray(buf)
+            half = len(raw) // 2
+            hit = np.unpackbits(raw[:half])[:total].astype(bool)
+            decided = np.unpackbits(raw[half:])[:total].astype(bool)
+            j = np.flatnonzero(hit)
+            if not len(j):
+                continue
+            seg_end = np.cumsum(lens)
+            which = np.searchsorted(seg_end, j, side="right")
+            prev = seg_end[which] - lens[which]
+            rows = starts[which] + (j - prev)
+            dec = decided[j]
+            ring = rows[~dec]
+            keep_rows = rows[dec]
+            if len(ring):
+                for block, local in seg.to_block_rows(np.sort(ring)):
+                    geoms = block.gather(self.geom, local)
+                    m = np.fromiter(
+                        (
+                            g is not None and _geom_predicate(self.node, g)
+                            for g in geoms
+                        ),
+                        bool,
+                        len(local),
+                    )
+                    if m.any():
+                        yield block, local[m]
+            if len(keep_rows):
+                yield from seg.to_block_rows(np.sort(keep_rows))
+
+
 class _DeviceSeekScan:
     """Device-assisted seek: dispatched per segment, resolved lazily.
 
@@ -1104,12 +1256,103 @@ class TpuScanExecutor:
             if total > frac * nrows:
                 return None
         dev = self._device_seek(table, plan, per_block, total)
+        if dev is None:
+            dev = self._device_seek_xz(table, plan, per_block, total)
         if dev is not None:
             return dev
         pred = self._native_seek_pred(table, plan)
         if pred is None:
             pred = self._xz_native_pred(table, plan)
         return _HostSeekScan(table, per_block, pred)
+
+    @staticmethod
+    def _devseek_enabled() -> bool:
+        import os
+
+        env = os.environ.get("GEOMESA_DEVSEEK", "auto")
+        if env == "0":
+            return False
+        return env == "1" or jax.default_backend() != "cpu"
+
+    def _device_seek_xz(self, table: IndexTable, plan, per_block, total: int):
+        """Extent edition of the device-assisted seek: exact f64 envelope
+        tests (sort-key limb compares) + isrect decisions on device; only
+        the boundary-straddling ring takes the host's per-geometry test.
+        Qualifies exactly like the native envelope kernel (one spatial
+        predicate on the default geometry of an xz2 plan)."""
+        if not self._devseek_enabled():
+            return None
+        if total == 0 or total > (1 << 22):
+            return None
+        shape = self._xz_pred_shape(table, plan)
+        if shape is None:
+            return None
+        geom, node, qenv, rect = shape
+        dev = self.device_index(table)
+        if not dev.segments or not all(
+            seg.load_exact_xz(table) for seg in dev.segments
+        ):
+            return None
+        synced = set()
+        for seg in dev.segments:
+            synced.update(seg.block_ids)
+        if any(id(b) not in synced for b, _s, _e, _f in per_block):
+            return None
+        from geomesa_tpu.ops.zkernels import f64_sort_keys, split_u64_to_limbs
+
+        keys = f64_sort_keys(
+            np.asarray([qenv.xmin, qenv.ymin, qenv.xmax, qenv.ymax, 0.0])
+        )
+        hi, lo = split_u64_to_limbs(keys)
+        qbox = np.empty(10, dtype=np.uint32)
+        qbox[0::2] = hi
+        qbox[1::2] = lo
+        qbox_dev = replicate(self.mesh, qbox)
+        rect_dev = replicate(self.mesh, np.asarray(rect))
+        pending = []
+        for seg in dev.segments:
+            offsets = {
+                bid: off for bid, off in zip(seg.block_ids, seg.block_starts)
+            }
+            sts, lns = [], []
+            for block, starts, ends, flags in per_block:
+                off = offsets.get(id(block))
+                if off is None:
+                    continue
+                starts, ends, _f = _merge_overlapping_intervals(
+                    starts, ends, flags
+                )
+                keep = ends > starts
+                if keep.any():
+                    sts.append(starts[keep] + off)
+                    lns.append((ends - starts)[keep])
+            if not sts:
+                continue
+            starts = np.concatenate(sts).astype(np.int32)
+            lens = np.concatenate(lns).astype(np.int32)
+            tot = int(lens.sum())
+            if tot == 0:
+                continue
+            n_iv = _pow2_at_least(len(starts), 64)
+            cand = _pow2_at_least(tot, 1024)
+            starts_p = np.zeros(n_iv, np.int32)
+            starts_p[: len(starts)] = starts
+            lens_p = np.zeros(n_iv, np.int32)
+            lens_p[: len(lens)] = lens
+            fn = _devseek_xz_fn(n_iv, cand)
+            buf = fn(
+                seg.xz_limbs, seg.xz_isrect, seg.valid,
+                replicate(self.mesh, starts_p), replicate(self.mesh, lens_p),
+                qbox_dev, rect_dev,
+            )
+            try:
+                buf.copy_to_host_async()
+            except Exception:  # pragma: no cover
+                pass
+            pending.append((seg, starts, lens, tot, buf))
+        if not pending:
+            return None
+        return _DeviceSeekXZScan(pending, node, geom)
 
     def _device_seek(self, table: IndexTable, plan, per_block, total: int):
         """Device-assisted seek (see _devseek_fn): host-planned candidate
@@ -1122,12 +1365,7 @@ class TpuScanExecutor:
         dispatch overhead, so auto declines (the native seek-scan wins).
         Declines when the plan is not one exact bbox(+interval) predicate
         or candidates exceed the bitmap budget — host paths take over."""
-        import os
-
-        env = os.environ.get("GEOMESA_DEVSEEK", "auto")
-        if env == "0":
-            return None
-        if env != "1" and jax.default_backend() == "cpu":
+        if not self._devseek_enabled():
             return None
         if total == 0 or total > (1 << 22):
             return None
@@ -1279,11 +1517,11 @@ class TpuScanExecutor:
             use_covered,
         )
 
-    def _xz_native_pred(self, table: IndexTable, plan):
-        """("xz", geom, node, qenv, rect) for the extent envelope kernel
-        when the FULL filter is exactly one spatial predicate on the
-        default geometry of an xz2 plan and the blocks carry envelope
-        companion columns; None otherwise.
+    @staticmethod
+    def _xz_pred_shape(table: IndexTable, plan):
+        """(geom, node, qenv, rect) when the FULL filter is exactly one
+        spatial predicate on the default geometry of an xz2 plan and the
+        blocks carry envelope companion columns; None otherwise.
 
         Only a SINGLE spatial node qualifies: an AND of two bboxes is NOT
         equivalent to one test against their envelope intersection for
@@ -1311,11 +1549,19 @@ class TpuScanExecutor:
             geom + "__bxmin" not in b.columns for b in blocks
         ):
             return None  # legacy blocks without envelope companions
+        return (geom, node, qenv, rect)
+
+    def _xz_native_pred(self, table: IndexTable, plan):
+        """("xz", geom, node, qenv, rect) for the C++ extent envelope
+        kernel (see _xz_pred_shape); None when unavailable."""
+        shape = self._xz_pred_shape(table, plan)
+        if shape is None:
+            return None
         from geomesa_tpu.native import load_env_seek
 
         if load_env_seek() is None:
             return None
-        return ("xz", geom, node, qenv, rect)
+        return ("xz",) + shape
 
     def _residual_shape(self, table: IndexTable, plan):
         """Box(+window) shape of a value-exact plan's residual secondary.

@@ -100,13 +100,24 @@ class LambdaDataStore:
         if self.offset_manager is not None:
             # commit AFTER the durable write: a crash in between merely
             # re-persists the same features (idempotent delete+rewrite).
-            # Per-partition max persisted offset, merged with the prior
-            # commit (another consumer may own other partitions).
-            committed = dict(self.offset_manager.offsets(f"{name}#persisted"))
-            for _, _, _, origin in expired:
+            # The watermark per partition is the MIN offset still LIVE in
+            # the cache (capped at the consumed end) — NOT the max
+            # persisted offset: entries expire in EVENT-TIME order, so a
+            # lower-offset message with a later event time may still be
+            # live, and advancing past it would silently drop it on its
+            # own later expiry. Every offset below min-live was handled
+            # (persisted, deleted, or superseded by a later update whose
+            # entry is governed separately).
+            live_min: Dict[int, int] = {}
+            for _fid, (_v, _ts, origin) in cache._live.items():
                 if origin is not None:
                     p, off = origin
-                    committed[p] = max(committed.get(p, 0), off + 1)
+                    live_min[p] = min(live_min.get(p, off), off)
+            consumed = self.transient._offsets.get(name, {})
+            committed = dict(self.offset_manager.offsets(f"{name}#persisted"))
+            for p, end in consumed.items():
+                wm = min(live_min.get(p, end), end)
+                committed[p] = max(committed.get(p, 0), wm)
             if committed:
                 self.offset_manager.commit(f"{name}#persisted", committed)
         return len(expired)

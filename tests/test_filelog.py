@@ -269,3 +269,46 @@ def test_lambda_persist_watermark_skips_repersist(tmp_path):
     res = lam2.query("t", "IN ('late1')")
     assert len(res) == 1
     assert len(lam2.query("t", "INCLUDE")) == 121
+
+
+def test_lambda_watermark_out_of_order_event_times(tmp_path):
+    """The reproduced data-loss shape: a LOWER-offset message with a
+    LATER event time must survive a watermark committed after
+    higher-offset, earlier-ts entries were persisted. The min-live-offset
+    watermark holds it back until the entry itself is handled."""
+    from geomesa_tpu.store.fs import FsDataStore
+    from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+    root = str(tmp_path / "log")
+    pdir = str(tmp_path / "persist")
+    base = 1760000000000
+    producer = StreamDataStore(broker=FileLogBroker(root, partitions=1))
+    producer.create_schema(parse_spec("t", SPEC))
+    # offset 0: LATE-expiring (fresh event time); offsets 1-2: expire first
+    producer.write("t", ["fresh", base + 1000, Point(0.0, 0.0)],
+                   fid="f0", ts_ms=base + 1000)
+    producer.write("t", ["old", base, Point(1.0, 1.0)], fid="f4", ts_ms=base)
+    producer.write("t", ["old", base, Point(2.0, 2.0)], fid="f5", ts_ms=base)
+
+    def make():
+        return LambdaDataStore(
+            persistent=FsDataStore(pdir),
+            transient=StreamDataStore(broker=FileLogBroker(root, partitions=1)),
+            age_ms=10,
+            offset_manager=FileOffsetManager(root, "lam2"),
+        )
+
+    lam = make()
+    lam.create_schema(parse_spec("t", SPEC))
+    assert lam.persist_expired("t", now_ms=base + 11) == 2  # f4, f5 only
+    del lam  # crash analog
+    lam2 = make()
+    lam2.create_schema(parse_spec("t", SPEC))
+    # f0 expires now; a max-offset watermark would classify it done & DROP
+    # it. The min-live watermark cannot advance past live offset 0, so f0
+    # persists and f4/f5 are re-persisted idempotently (the Kafka
+    # contiguous-commit tradeoff: conservative, never lossy).
+    assert lam2.persist_expired("t", now_ms=base + 1011) == 3
+    res = lam2.query("t", "IN ('f0')")
+    assert len(res) == 1, "late-expiring lower-offset feature was lost"
+    assert len(lam2.query("t", "INCLUDE")) == 3
