@@ -456,6 +456,16 @@ class DeviceSegment:
                     if nulls is None
                     else self._pack([keep & ~nulls], bool, False)
                 )
+            if (
+                getattr(self, "_exact_xz_loaded", False)
+                and getattr(self, "_xz_t_nulls_host", None) is not None
+            ):
+                # xz3 temporal-valid mask bakes in the tombstones too —
+                # devseek hits ARE the result set, nothing downstream
+                # strips deleted rows
+                self.xz_tvalid = self._pack(
+                    [keep & ~self._xz_t_nulls_host], bool, False
+                )
 
     def load_raw(self, table: IndexTable) -> bool:
         """Pack raw f32 coords (+ in-bin ms offsets for day/week z3) for the
@@ -593,15 +603,21 @@ class DeviceSegment:
 
     def load_exact_xz(self, table: IndexTable) -> bool:
         """Pack f64 sort-key limbs of the envelope companions (+ isrect
-        flags) for the extent device-assisted seek; False when this is
-        not an xz2 segment or blocks lack companions."""
-        if self.kind != "xz2":
+        flags; + dtg i64 limbs and a temporal-valid mask for xz3) for the
+        extent device-assisted seek; False when this is not an extent
+        segment or blocks lack companions."""
+        if self.kind not in ("xz2", "xz3"):
             return False
         if getattr(self, "_exact_xz_loaded", False):
             return True
-        from geomesa_tpu.ops.zkernels import f64_sort_keys, split_u64_to_limbs
+        from geomesa_tpu.ops.zkernels import (
+            f64_sort_keys,
+            i64_sort_keys,
+            split_u64_to_limbs,
+        )
 
-        geom = table.ft.default_geometry.name
+        ft = table.ft
+        geom = ft.default_geometry.name
         cols = []
         for suffix in ("__bxmin", "__bymin", "__bxmax", "__bymax"):
             parts = []
@@ -623,6 +639,30 @@ class DeviceSegment:
             ]
         ) if self.blocks else np.empty(0, dtype=bool)
         self.xz_isrect = self._pack([irs], bool, False)
+        if self.kind == "xz3" and ft.default_date is not None:
+            dtg = ft.default_date.name
+            ts = np.concatenate(
+                [np.asarray(b.columns[dtg], dtype=np.int64) for b in self.blocks]
+            )
+            thi, tlo = split_u64_to_limbs(i64_sort_keys(ts))
+            self.xz_tk = (
+                self._pack([thi], np.uint32, np.uint32(0)),
+                self._pack([tlo], np.uint32, np.uint32(0)),
+            )
+            nulls = np.concatenate(
+                [b.full_col(dtg + "__null") for b in self.blocks]
+            )
+            # keep the host mask so apply_tombstones can rebuild xz_tvalid
+            self._xz_t_nulls_host = nulls if nulls.any() else None
+            self.xz_tvalid = (
+                self._pack([self._valid_host & ~nulls], bool, False)
+                if self._xz_t_nulls_host is not None
+                else None  # falls back to the segment valid mask
+            )
+        else:
+            self.xz_tk = None
+            self.xz_tvalid = None
+            self._xz_t_nulls_host = None
         self._exact_xz_loaded = True
         return True
 
@@ -982,22 +1022,23 @@ def _pow2_at_least(n: int, floor: int = 256) -> int:
 _DEVSEEK_XZ_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
-def _devseek_xz_fn(n_iv: int, cand_cap: int):
-    """Extent (xz2) device-assisted seek: exact f64 envelope tests on the
-    candidates via sort-key limb compares (the device edition of
-    native/seekscan.cpp geomesa_env_seek_scan). Returns TWO packed
-    bitmaps over the candidate space: ``hit`` (envelope overlaps the
-    query box — exact) and ``decided`` (provably satisfies the exact
-    predicate: envelope inside a rectangle query, or an isrect feature
-    overlapping one). Only hit & ~decided rows — the boundary-straddling
-    ring — need the host's per-geometry test."""
-    key = (n_iv, cand_cap)
+def _devseek_xz_fn(n_iv: int, cand_cap: int, has_time: bool = False):
+    """Extent (xz2/xz3) device-assisted seek: exact f64 envelope tests on
+    the candidates via sort-key limb compares (the device edition of
+    native/seekscan.cpp geomesa_env_seek_scan), plus — for xz3 — the
+    exact i64 ms time-window test. Returns TWO packed bitmaps over the
+    candidate space: ``hit`` (envelope overlaps the query box and the
+    time window matches — exact) and ``decided`` (provably satisfies the
+    exact predicate: envelope inside a rectangle query, or an isrect
+    feature overlapping one). Only hit & ~decided rows — the boundary-
+    straddling ring — need the host's per-geometry test."""
+    key = (n_iv, cand_cap, has_time)
     fn = _DEVSEEK_XZ_FNS.get(key)
     if fn is not None:
         return fn
-    from geomesa_tpu.ops.zkernels import limbs_leq
+    from geomesa_tpu.ops.zkernels import limbs_in_range, limbs_leq
 
-    def run(limbs, isrect, valid, starts, lens, qbox, rect):
+    def run(limbs, isrect, valid, starts, lens, qbox, rect, th, tl, win):
         # limbs: tuple of 8 arrays (bxmin, bymin, bxmax, bymax) x (hi, lo)
         seg_end = jnp.cumsum(lens)
         total = seg_end[-1]
@@ -1039,6 +1080,10 @@ def _devseek_xz_fn(n_iv: int, cand_cap: int):
             & limbs_leq(bymax_h, bymax_l, qymax_h, qymax_l)
         )
         hit = overlap & va
+        if has_time:
+            gth = jnp.take(th, rows)
+            gtl = jnp.take(tl, rows)
+            hit = hit & limbs_in_range(gth, gtl, win[0], win[1], win[2], win[3])
         decided = hit & rect & ~placeholder & (inside | ir)
         return jnp.concatenate([jnp.packbits(hit), jnp.packbits(decided)])
 
@@ -1287,18 +1332,25 @@ class TpuScanExecutor:
         shape = self._xz_pred_shape(table, plan)
         if shape is None:
             return None
-        geom, node, qenv, rect = shape
+        geom, node, qenv, rect, t_lo, t_hi = shape
+        has_time = t_lo is not None or t_hi is not None
         dev = self.device_index(table)
         if not dev.segments or not all(
             seg.load_exact_xz(table) for seg in dev.segments
         ):
+            return None
+        if has_time and any(seg.xz_tk is None for seg in dev.segments):
             return None
         synced = set()
         for seg in dev.segments:
             synced.update(seg.block_ids)
         if any(id(b) not in synced for b, _s, _e, _f in per_block):
             return None
-        from geomesa_tpu.ops.zkernels import f64_sort_keys, split_u64_to_limbs
+        from geomesa_tpu.ops.zkernels import (
+            f64_sort_keys,
+            i64_sort_keys,
+            split_u64_to_limbs,
+        )
 
         keys = f64_sort_keys(
             np.asarray([qenv.xmin, qenv.ymin, qenv.xmax, qenv.ymax, 0.0])
@@ -1309,41 +1361,31 @@ class TpuScanExecutor:
         qbox[1::2] = lo
         qbox_dev = replicate(self.mesh, qbox)
         rect_dev = replicate(self.mesh, np.asarray(rect))
+        win_dev = None
+        if has_time:
+            lo_ms = np.iinfo(np.int64).min + 1 if t_lo is None else t_lo
+            hi_ms = np.iinfo(np.int64).max if t_hi is None else t_hi
+            thi, tlo = split_u64_to_limbs(i64_sort_keys(np.asarray([lo_ms, hi_ms])))
+            win_dev = replicate(
+                self.mesh,
+                np.asarray([thi[0], tlo[0], thi[1], tlo[1]], dtype=np.uint32),
+            )
         pending = []
-        for seg in dev.segments:
-            offsets = {
-                bid: off for bid, off in zip(seg.block_ids, seg.block_starts)
-            }
-            sts, lns = [], []
-            for block, starts, ends, flags in per_block:
-                off = offsets.get(id(block))
-                if off is None:
-                    continue
-                starts, ends, _f = _merge_overlapping_intervals(
-                    starts, ends, flags
-                )
-                keep = ends > starts
-                if keep.any():
-                    sts.append(starts[keep] + off)
-                    lns.append((ends - starts)[keep])
-            if not sts:
-                continue
-            starts = np.concatenate(sts).astype(np.int32)
-            lens = np.concatenate(lns).astype(np.int32)
-            tot = int(lens.sum())
-            if tot == 0:
-                continue
-            n_iv = _pow2_at_least(len(starts), 64)
-            cand = _pow2_at_least(tot, 1024)
-            starts_p = np.zeros(n_iv, np.int32)
-            starts_p[: len(starts)] = starts
-            lens_p = np.zeros(n_iv, np.int32)
-            lens_p[: len(lens)] = lens
-            fn = _devseek_xz_fn(n_iv, cand)
+        for seg, starts, lens, tot, n_iv, cand, starts_p, lens_p in (
+            self._candidate_batches(dev, per_block)
+        ):
+            fn = _devseek_xz_fn(n_iv, cand, has_time)
+            valid = seg.valid
+            th = tl = win = qbox_dev  # unused placeholders when no time
+            if has_time:
+                th, tl = seg.xz_tk
+                win = win_dev
+                if seg.xz_tvalid is not None:
+                    valid = seg.xz_tvalid
             buf = fn(
-                seg.xz_limbs, seg.xz_isrect, seg.valid,
+                seg.xz_limbs, seg.xz_isrect, valid,
                 replicate(self.mesh, starts_p), replicate(self.mesh, lens_p),
-                qbox_dev, rect_dev,
+                qbox_dev, rect_dev, th, tl, win,
             )
             try:
                 buf.copy_to_host_async()
@@ -1387,6 +1429,37 @@ class TpuScanExecutor:
         box_d = replicate(self.mesh, box_np)
         win_d = replicate(self.mesh, win_np) if has_time else None
         pending = []
+        for seg, starts, lens, tot, n_iv, cand, starts_p, lens_p in (
+            self._candidate_batches(dev, per_block)
+        ):
+            fn = _devseek_fn(has_time, n_iv, cand)
+            valid = seg.tvalid if has_time else seg.valid
+            th = seg.tk_hi if has_time else seg.xk_hi  # unused when no time
+            tl = seg.tk_lo if has_time else seg.xk_lo
+            buf = fn(
+                seg.xk_hi, seg.xk_lo, seg.yk_hi, seg.yk_lo, th, tl, valid,
+                replicate(self.mesh, starts_p), replicate(self.mesh, lens_p),
+                box_d, win_d if has_time else box_d,
+            )
+            try:
+                buf.copy_to_host_async()
+            except Exception:  # pragma: no cover
+                pass
+            pending.append((seg, starts, lens, tot, buf))
+        if not pending:
+            # every candidate fell on rows the mirror hasn't synced — the
+            # host path answers from the blocks directly
+            return None
+        return _DeviceSeekScan(pending)
+
+    @staticmethod
+    def _candidate_batches(dev, per_block):
+        """Per-segment candidate-interval assembly shared by both devseek
+        dispatchers: maps per-block seek intervals into segment row space
+        (overlap-MERGED first — overlapping intervals would emit shared
+        rows once per interval in the flat candidate space, where the
+        host paths dedupe in expand_intervals), pads to pow2 buckets, and
+        yields (seg, starts, lens, tot, n_iv, cand, starts_p, lens_p)."""
         for seg in dev.segments:
             offsets = {
                 bid: off for bid, off in zip(seg.block_ids, seg.block_starts)
@@ -1396,9 +1469,6 @@ class TpuScanExecutor:
                 off = offsets.get(id(block))
                 if off is None:
                     continue
-                # overlapping candidate intervals would emit shared rows
-                # once per interval (the host paths dedupe in
-                # expand_intervals; the flat candidate space cannot)
                 starts, ends, _f = _merge_overlapping_intervals(
                     starts, ends, flags
                 )
@@ -1419,25 +1489,7 @@ class TpuScanExecutor:
             starts_p[: len(starts)] = starts
             lens_p = np.zeros(n_iv, np.int32)
             lens_p[: len(lens)] = lens
-            fn = _devseek_fn(has_time, n_iv, cand)
-            valid = seg.tvalid if has_time else seg.valid
-            th = seg.tk_hi if has_time else seg.xk_hi  # unused when no time
-            tl = seg.tk_lo if has_time else seg.xk_lo
-            buf = fn(
-                seg.xk_hi, seg.xk_lo, seg.yk_hi, seg.yk_lo, th, tl, valid,
-                replicate(self.mesh, starts_p), replicate(self.mesh, lens_p),
-                box_d, win_d if has_time else box_d,
-            )
-            try:
-                buf.copy_to_host_async()
-            except Exception:  # pragma: no cover
-                pass
-            pending.append((seg, starts, lens, tot, buf))
-        if not pending:
-            # every candidate fell on rows the mirror hasn't synced — the
-            # host path answers from the blocks directly
-            return None
-        return _DeviceSeekScan(pending)
+            yield seg, starts, lens, tot, n_iv, cand, starts_p, lens_p
 
     @staticmethod
     def _shape_limbs(shape):
@@ -1519,15 +1571,17 @@ class TpuScanExecutor:
 
     @staticmethod
     def _xz_pred_shape(table: IndexTable, plan):
-        """(geom, node, qenv, rect) when the FULL filter is exactly one
-        spatial predicate on the default geometry of an xz2 plan and the
-        blocks carry envelope companion columns; None otherwise.
+        """(geom, node, qenv, rect, t_lo, t_hi) when the FULL filter is
+        exactly one spatial predicate on the default geometry of an
+        xz2/xz3 plan — plus, for xz3, AND-combined temporal bounds on the
+        default date — and the blocks carry envelope companion columns;
+        None otherwise. t_lo/t_hi are inclusive ms or None.
 
         Only a SINGLE spatial node qualifies: an AND of two bboxes is NOT
         equivalent to one test against their envelope intersection for
         extent features (a geometry can straddle both boxes yet miss the
         intersection)."""
-        if table.index.name != "xz2" or plan.secondary is not None:
+        if table.index.name not in ("xz2", "xz3") or plan.secondary is not None:
             return None
         f = plan.full_filter
         if f is None:
@@ -1536,32 +1590,70 @@ class TpuScanExecutor:
 
         ft = table.ft
         geom = ft.default_geometry.name
-        if isinstance(f, A.BBox) and f.prop == geom:
-            node, qenv, rect = f, f.envelope, True
-        elif isinstance(f, A.Intersects) and f.prop == geom:
-            g = f.geometry
-            node, qenv = f, g.envelope
-            rect = hasattr(g, "is_rectangle") and g.is_rectangle()
-        else:
+        dtg = ft.default_date.name if ft.default_date is not None else None
+        spatial: List = []
+        t_lo = t_hi = None
+
+        def clamp_lo(v):
+            nonlocal t_lo
+            t_lo = v if t_lo is None else max(t_lo, v)
+
+        def clamp_hi(v):
+            nonlocal t_hi
+            t_hi = v if t_hi is None else min(t_hi, v)
+
+        def walk(node) -> bool:
+            if isinstance(node, A.And):
+                return all(walk(c) for c in node.children())
+            if isinstance(node, (A.BBox, A.Intersects)) and node.prop == geom:
+                spatial.append(node)
+                return True
+            if dtg is not None and isinstance(node, A.During) and node.prop == dtg:
+                clamp_lo(node.lo_ms + 1)
+                clamp_hi(node.hi_ms - 1)
+                return True
+            if dtg is not None and isinstance(node, A.After) and node.prop == dtg:
+                clamp_lo(node.t_ms + 1)
+                return True
+            if dtg is not None and isinstance(node, A.Before) and node.prop == dtg:
+                clamp_hi(node.t_ms - 1)
+                return True
+            if dtg is not None and isinstance(node, A.TEquals) and node.prop == dtg:
+                clamp_lo(node.t_ms)
+                clamp_hi(node.t_ms)
+                return True
+            return False
+
+        if not walk(f) or len(spatial) != 1:
             return None
+        if table.index.name == "xz2" and (t_lo is not None or t_hi is not None):
+            return None  # xz2 blocks carry no time column
+        node = spatial[0]
+        if isinstance(node, A.BBox):
+            qenv, rect = node.envelope, True
+        else:
+            g = node.geometry
+            qenv = g.envelope
+            rect = hasattr(g, "is_rectangle") and g.is_rectangle()
         blocks = table.blocks
         if not blocks or any(
             geom + "__bxmin" not in b.columns for b in blocks
         ):
             return None  # legacy blocks without envelope companions
-        return (geom, node, qenv, rect)
+        return (geom, node, qenv, rect, t_lo, t_hi)
 
     def _xz_native_pred(self, table: IndexTable, plan):
         """("xz", geom, node, qenv, rect) for the C++ extent envelope
-        kernel (see _xz_pred_shape); None when unavailable."""
+        kernel (xz2 only — see _xz_pred_shape); None when unavailable."""
         shape = self._xz_pred_shape(table, plan)
-        if shape is None:
+        if shape is None or table.index.name != "xz2":
             return None
+        geom, node, qenv, rect, _t_lo, _t_hi = shape
         from geomesa_tpu.native import load_env_seek
 
         if load_env_seek() is None:
             return None
-        return ("xz",) + shape
+        return ("xz", geom, node, qenv, rect)
 
     def _residual_shape(self, table: IndexTable, plan):
         """Box(+window) shape of a value-exact plan's residual secondary.

@@ -114,8 +114,14 @@ class LambdaDataStore:
                     p, off = origin
                     live_min[p] = min(live_min.get(p, off), off)
             consumed = self.transient._offsets.get(name, {})
+            # only commit partitions THIS consumer owns: another consumer's
+            # live entries are invisible here, and advancing its partition
+            # to the consumed end would classify them as persisted
+            owned = self.transient.assigned_partitions
             committed = dict(self.offset_manager.offsets(f"{name}#persisted"))
             for p, end in consumed.items():
+                if owned is not None and p not in owned:
+                    continue
                 wm = min(live_min.get(p, end), end)
                 committed[p] = max(committed.get(p, 0), wm)
             if committed:

@@ -181,3 +181,72 @@ def test_devseek_xz_tombstones(monkeypatch):
     store.delete_features("ways", victims)
     after = set(map(str, store.query("ways", q).fids))
     assert after == before - set(victims)
+
+
+def _extent_time_store(n=5000, batches=2, seed=9, null_dates=False):
+    from geomesa_tpu.geom.base import LineString, Polygon
+
+    rng = np.random.default_rng(seed)
+    store = TpuDataStore(
+        executor=TpuScanExecutor(default_mesh()), flush_size=n // batches + 1
+    )
+    ft = parse_spec("wt", "dtg:Date,*geom:Geometry:srid=4326")
+    store.create_schema(ft)
+    base = np.datetime64("2026-06-01", "ms").astype(np.int64)
+    with store.writer("wt") as w:
+        for i in range(n):
+            x0 = float(rng.uniform(-170, 160))
+            y0 = float(rng.uniform(-80, 70))
+            if i % 3 == 0:
+                g = Polygon([[x0, y0], [x0 + 1, y0], [x0 + 1, y0 + 1],
+                             [x0, y0 + 1], [x0, y0]])
+            elif i % 3 == 1:
+                g = Polygon([[x0, y0], [x0 + 2, y0], [x0 + 1, y0 + 2], [x0, y0]])
+            else:
+                g = LineString([(x0, y0), (x0 + 1.5, y0 + 0.7)])
+            t = None if (null_dates and i % 37 == 0) else int(
+                base + rng.integers(0, 12 * 86400_000)
+            )
+            w.write([t, g], fid=f"w{i}")
+    return store
+
+
+XZ3_QUERIES = [
+    "bbox(geom, -30, -20, 40, 30) AND dtg DURING 2026-06-02T00:00:00Z/2026-06-08T00:00:00Z",
+    "INTERSECTS(geom, POLYGON((-40 -30, 10 -30, 10 10, -40 10, -40 -30))) "
+    "AND dtg AFTER 2026-06-05T00:00:00Z",
+    "bbox(geom, -170, -80, 160, 70) AND dtg BEFORE 2026-06-03T12:00:00Z",
+]
+
+
+@pytest.mark.parametrize("null_dates", [False, True])
+def test_devseek_xz3_parity(monkeypatch, null_dates):
+    from geomesa_tpu.parallel.executor import _DeviceSeekXZScan
+
+    store = _extent_time_store(null_dates=null_dates)
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "1")
+    plan = store.planner("wt").plan(Query.cql(XZ3_QUERIES[0]))
+    assert plan.index.name == "xz3"
+    scan = store.executor._seek_scan(store._tables["wt"]["xz3"], plan)
+    assert isinstance(scan, _DeviceSeekXZScan), type(scan)
+    got = {q: sorted(map(str, store.query("wt", q).fids)) for q in XZ3_QUERIES}
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "0")
+    for q in XZ3_QUERIES:
+        want = sorted(map(str, store.query("wt", q).fids))
+        assert got[q] == want, (q, len(got[q]), len(want))
+    assert any(got.values())
+
+
+def test_devseek_xz3_tombstones_with_null_dates(monkeypatch):
+    """The xz3 temporal-valid device mask must refresh on deletes: devseek
+    hits ARE the result set, so a stale mask would resurrect tombstoned
+    features (review regression)."""
+    monkeypatch.setenv("GEOMESA_DEVSEEK", "1")
+    store = _extent_time_store(null_dates=True)
+    q = XZ3_QUERIES[0]
+    before = set(map(str, store.query("wt", q).fids))
+    assert before
+    victims = sorted(before)[::2]
+    store.delete_features("wt", victims)
+    after = set(map(str, store.query("wt", q).fids))
+    assert after == before - set(victims)

@@ -312,3 +312,31 @@ def test_lambda_watermark_out_of_order_event_times(tmp_path):
     res = lam2.query("t", "IN ('f0')")
     assert len(res) == 1, "late-expiring lower-offset feature was lost"
     assert len(lam2.query("t", "INCLUDE")) == 3
+
+
+def test_lambda_watermark_only_commits_owned_partitions(tmp_path):
+    """A consumer assigned a partition subset must not advance OTHER
+    partitions' watermarks — another consumer's live entries there are
+    invisible to it (review regression)."""
+    from geomesa_tpu.store.fs import FsDataStore
+    from geomesa_tpu.stream.lambda_store import LambdaDataStore
+
+    root = str(tmp_path / "log")
+    base = 1760000000000
+    producer = StreamDataStore(broker=FileLogBroker(root, partitions=4))
+    producer.create_schema(parse_spec("t", SPEC))
+    _write_n(producer, 80)
+    om = FileOffsetManager(root, "lamshared")
+    lam_b = LambdaDataStore(
+        persistent=FsDataStore(str(tmp_path / "pb")),
+        transient=StreamDataStore(
+            broker=FileLogBroker(root, partitions=4),
+            assigned_partitions=[2, 3],
+        ),
+        age_ms=10,
+        offset_manager=om,
+    )
+    lam_b.create_schema(parse_spec("t", SPEC))
+    lam_b.persist_expired("t", now_ms=base + 80 + 10)
+    committed = om.offsets("t#persisted")
+    assert set(committed) <= {2, 3}, committed  # partitions 0/1 untouched
