@@ -123,6 +123,85 @@ def one_round(seed: int) -> int:
                 os.environ[k] = v
 
 
+def one_extent_round(seed: int) -> int:
+    """Extent store (mixed rects/triangles/lines/null geoms, with dates):
+    exercises xz2/xz3 incl. the device-assisted extent seek modes."""
+    from geomesa_tpu.geom.base import LineString, Polygon
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(300, 1500))
+    mode = MODES[seed % len(MODES)]
+    old = {k: os.environ.get(k) for k in
+           ("GEOMESA_SEEK", "GEOMESA_TPU_NO_NATIVE", "GEOMESA_DEVSEEK",
+            "GEOMESA_EXACT_DEVICE")}
+    for k in old:
+        os.environ.pop(k, None)
+    os.environ.update(mode)
+    try:
+        host = TpuDataStore(executor=HostScanExecutor())
+        tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+        base = 1767225600000  # 2026-01-01
+        rows = []
+        for i in range(n):
+            x0 = float(rng.uniform(-170, 160))
+            y0 = float(rng.uniform(-80, 70))
+            k = i % 5
+            if k == 0:
+                g = Polygon([[x0, y0], [x0 + 1, y0], [x0 + 1, y0 + 1],
+                             [x0, y0 + 1], [x0, y0]])
+            elif k == 1:
+                g = Polygon([[x0, y0], [x0 + 2, y0], [x0 + 1, y0 + 2], [x0, y0]])
+            elif k == 2:
+                g = LineString([(x0, y0), (x0 + 1.5, y0 + 0.7)])
+            elif k == 3:
+                g = LineString([(x0, y0), (x0 + 0.3, y0), (x0 + 0.3, y0 + 2.5)])
+            else:
+                g = None
+            t = None if i % 41 == 0 else int(base + rng.integers(0, 15 * 86400_000))
+            rows.append((f"e{i}", t, g))
+        for s in (host, tpu):
+            s.create_schema(parse_spec("e", "dtg:Date,*geom:Geometry:srid=4326"))
+            with s.writer("e") as w:
+                for fid, t, g in rows:
+                    w.write([t, g], fid=fid)
+        checked = 0
+        for _ in range(10):
+            x0 = float(rng.uniform(-60, 30))
+            y0 = float(rng.uniform(-40, 20))
+            w_ = float(rng.uniform(5, 50))
+            parts = [f"bbox(geom, {x0!r}, {y0!r}, {x0 + w_!r}, {y0 + w_!r})"]
+            if rng.random() < 0.6:
+                d0 = int(rng.integers(1, 10))
+                d1 = d0 + int(rng.integers(1, 5))
+                parts.append(
+                    f"dtg DURING 2026-01-{d0:02d}T00:00:00Z/2026-01-{d1:02d}T00:00:00Z"
+                )
+            if rng.random() < 0.3:
+                parts = [
+                    f"INTERSECTS(geom, POLYGON(({x0} {y0}, {x0+w_} {y0}, "
+                    f"{x0+w_/2} {y0+w_}, {x0} {y0})))"
+                ] + parts[1:]
+            q = " AND ".join(parts)
+            got = sorted(map(str, tpu.query("e", q).fids))
+            want = sorted(map(str, host.query("e", q).fids))
+            assert got == want, ("extent", seed, mode, q)
+            checked += 1
+        dead = [f"e{i}" for i in range(0, n, 7)]
+        for s in (host, tpu):
+            s.delete_features("e", dead)
+        q = "bbox(geom, -60, -40, 40, 30)"
+        got = sorted(map(str, tpu.query("e", q).fids))
+        want = sorted(map(str, host.query("e", q).fids))
+        assert got == want, ("extent-post-delete", seed, mode)
+        return checked + 1
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main():
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 30.0
     deadline = time.monotonic() + minutes * 60
@@ -132,9 +211,10 @@ def main():
     t0 = time.monotonic()
     while time.monotonic() < deadline:
         queries += one_round(seed)
-        stores += 1
+        queries += one_extent_round(seed + 500_000)
+        stores += 2
         seed += 1
-        if stores % 25 == 0:
+        if stores % 25 == 0 or stores % 25 == 1:
             dt = time.monotonic() - t0
             print(
                 f"[fuzz] {stores} store pairs, {queries} checks, "
