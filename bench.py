@@ -501,7 +501,10 @@ def poll_for_tpu_retry(payload, t_start, deadline):
     if os.environ.get("GEOMESA_BENCH_POLL", "1") in ("0",):
         return payload
     margin = 120.0  # emit well before the watchdog fires
-    device_budget = 1500.0  # min time a 20M device run needs
+    # min time a 20M device rerun needs: synthesis ~90s + baseline ~60s +
+    # ingest ~35s + warm compile + 20 queries ≈ 10 min — keep this tight
+    # so the polling window covers as much of the deadline as possible
+    device_budget = 900.0
     while True:
         remaining = deadline - (time.monotonic() - t_start) - margin
         if remaining < device_budget:
