@@ -461,7 +461,67 @@ def run(n: int, reps: int, backend: str) -> dict:
                 "n": n,
             }
 
+    # --- device-forced stream (accelerator only) -------------------------
+    # The SAME query stream answered end-to-end by the accelerator: the
+    # batched exact path (_exact_runs_batch_fn) fuses all queries into one
+    # device execution per segment, so per-execution link cost amortizes.
+    # Recorded alongside the cost-chosen headline: on a low-latency local
+    # device the chooser picks this path by itself; over a tunneled link
+    # the host seek may win the headline while this field proves the
+    # silicon path on its own.
+    device_fields = {}
+    import jax as _jax
+
+    if _jax.default_backend() != "cpu" and os.environ.get("GEOMESA_SEEK") != "0":
+        saved_seek = os.environ.get("GEOMESA_SEEK")
+        os.environ["GEOMESA_SEEK"] = "0"
+        try:  # auxiliary: must never discard the measured headline above
+            # warm until the adaptive run capacities stop changing: rcap
+            # learning happens at resolve time, and a changed rcap keys a
+            # fresh jit compile — which must land here, not in the timing
+            t0 = time.perf_counter()
+            prev_rcaps = None
+            for _ in range(3):
+                store.query_many("gdelt", queries)
+                rcaps = {
+                    id(s): s._rcap
+                    for d in getattr(store.executor, "_cache", {}).values()
+                    for s in d[1].segments
+                }
+                if rcaps == prev_rcaps:
+                    break
+                prev_rcaps = rcaps
+            dwarm_s = time.perf_counter() - t0
+            log(f"device stream warm (pack+compile): {dwarm_s:.1f}s")
+            t0 = time.perf_counter()
+            dres = store.query_many("gdelt", queries)
+            dpipe_s = (time.perf_counter() - t0) / reps
+            dok = all(
+                set(r.fids) == {f"f{j}" for j in w}
+                for r, w in zip(dres, wants)
+            )
+            device_fields = {
+                "device_path_fps": round(n / dpipe_s, 1),
+                "device_path_vs_baseline": round(n / dpipe_s / cpu_fps, 3),
+                "device_query_ms_pipelined": round(dpipe_s * 1000, 3),
+                "device_parity": bool(dok),
+                "device_warm_s": round(dwarm_s, 1),
+            }
+            log(
+                f"device stream: {n / dpipe_s:,.0f} features/sec "
+                f"({dpipe_s * 1000:.1f} ms/query, parity={dok})"
+            )
+        except Exception as e:  # noqa: BLE001
+            device_fields = {"device_error": f"{type(e).__name__}: {e}"[:200]}
+            log(f"device stream failed: {e}")
+        finally:
+            if saved_seek is None:
+                os.environ.pop("GEOMESA_SEEK", None)
+            else:
+                os.environ["GEOMESA_SEEK"] = saved_seek
+
     return {
+        **device_fields,
         "metric": "gdelt_z3_bbox_time_filter_throughput",
         "value": round(dev_fps, 1),
         "unit": "features/sec",

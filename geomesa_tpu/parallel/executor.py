@@ -216,6 +216,7 @@ def _exact_mask_body(has_time: bool, mode: str, mesh):
 
 _EXACT_RUNS_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 _EXACT_PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_EXACT_RUNS_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
 def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
@@ -230,6 +231,80 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
         fn = jax.jit(run)
         _EXACT_RUNS_FNS[key] = fn
     return fn
+
+
+def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
+    """Q exact-predicate scans fused into ONE device execution.
+
+    lax.scan over [q] stacked query descriptors; each step streams the
+    whole segment through the exact limb mask and RLE-compresses its hit
+    runs — output [q, 2 + 2*rcap]. One dispatch and one D2H transfer
+    answer the entire query stream, so a high-latency device link pays
+    its per-execution cost once per BATCH (measured on the axon tunnel:
+    ~70 ms per execution regardless of size, which made per-query
+    dispatch the round-2/3 bottleneck). The streaming masks also avoid
+    candidate gathers entirely — on TPU a 2M-row gather from a 20M-row
+    mirror measured ~500 ms while the full 20M-row streaming compare is
+    ~1 ms (HBM-bandwidth bound), so O(N) streaming beats "O(candidates)"
+    random access by orders of magnitude. This is the BatchScanner
+    analog (AccumuloQueryPlan.scala:113-140) collapsed into one RPC.
+    """
+    key = (has_time, rcap, q, mode, mesh if mode == "spmd" else None)
+    fn = _EXACT_RUNS_BATCH_FNS.get(key)
+    if fn is None:
+        mask = _exact_mask_body(has_time, mode, mesh)
+        if has_time:
+            def run(xh, xl, yh, yl, th, tl, valid, boxes, wins):
+                def step(carry, bw):
+                    b, w = bw
+                    m = mask(xh, xl, yh, yl, th, tl, valid, b, w)
+                    return carry, _runs_from_mask(m, rcap)
+
+                _, out = jax.lax.scan(step, 0, (boxes, wins))
+                return out
+        else:
+            def run(xh, xl, yh, yl, valid, boxes):
+                def step(carry, b):
+                    return carry, _runs_from_mask(mask(xh, xl, yh, yl, valid, b), rcap)
+
+                _, out = jax.lax.scan(step, 0, boxes)
+                return out
+
+        fn = jax.jit(run)
+        _EXACT_RUNS_BATCH_FNS[key] = fn
+    return fn
+
+
+class _BatchRows:
+    """One [q, 2+2*rcap] batch buffer, fetched to host exactly once."""
+
+    __slots__ = ("buf", "_np")
+
+    def __init__(self, buf):
+        self.buf = buf
+        self._np = None
+
+    def row(self, i: int) -> np.ndarray:
+        if self._np is None:
+            self._np = np.asarray(self.buf)
+            self.buf = None  # release the device allocation immediately
+        return self._np[i]
+
+
+class _BatchRow:
+    """np.asarray-able view of one query's slice of a _BatchRows buffer
+    (slicing the device array directly would dispatch a device slice op
+    per query — another round trip on a tunneled link)."""
+
+    __slots__ = ("batch", "i")
+
+    def __init__(self, batch: _BatchRows, i: int):
+        self.batch = batch
+        self.i = i
+
+    def __array__(self, dtype=None, copy=None):
+        r = self.batch.row(self.i)
+        return r if dtype is None else r.astype(dtype)
 
 
 def _exact_packed_fn(has_time: bool, mode: str, mesh):
@@ -666,17 +741,21 @@ class DeviceSegment:
         self._exact_xz_loaded = True
         return True
 
+    def _exact_args(self, box_dev, win_dev, has_time: bool) -> tuple:
+        """The one place that knows the exact-scan argument layout (shared
+        by single dispatch, batch dispatch, and escalation refetches)."""
+        if has_time:
+            return (
+                self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo,
+                self.tk_hi, self.tk_lo, self.tvalid, box_dev, win_dev,
+            )
+        return (self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo, self.valid, box_dev)
+
     def dispatch_exact(self, box_dev, win_dev) -> "_PendingHits":
         """Exact predicate scan (see TpuScanExecutor._exact_descriptor)."""
         has_time = self.tk_hi is not None and win_dev is not None
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
-        if has_time:
-            args = (
-                self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo,
-                self.tk_hi, self.tk_lo, self.tvalid, box_dev, win_dev,
-            )
-        else:
-            args = (self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo, self.valid, box_dev)
+        args = self._exact_args(box_dev, win_dev, has_time)
         rcap = self._rcap
         buf = _exact_runs_fn(has_time, rcap, mode, self.mesh)(*args)
         try:
@@ -690,6 +769,66 @@ class DeviceSegment:
             refetch=lambda rc: _exact_runs_fn(has_time, rc, mode, self.mesh)(*args),
             packed=lambda: _exact_packed_fn(has_time, mode, self.mesh)(*args),
         )
+
+    def dispatch_exact_batch(
+        self, descs: Sequence[tuple], has_time: bool
+    ) -> List["_PendingHits"]:
+        """Q exact scans in ONE device execution (see _exact_runs_batch_fn).
+
+        ``descs`` = [(box_np u32[8], win_np u32[4]|None)]; all entries of a
+        batch share ``has_time``. Returns one _PendingHits per desc, all
+        resolving from a single shared [q, 2+2*rcap] buffer fetch. The
+        query list is padded to a pow2 bucket (repeating the last
+        descriptor) so jit shape buckets stay bounded. Overflow refetches
+        escalate per query through the single-query path.
+        """
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        q = len(descs)
+        qpad = _pow2_at_least(q, 4)
+        boxes_np = np.stack(
+            [d[0] for d in descs] + [descs[-1][0]] * (qpad - q)
+        )
+        boxes_dev = replicate(self.mesh, boxes_np)
+        if has_time:
+            wins_np = np.stack(
+                [d[1] for d in descs] + [descs[-1][1]] * (qpad - q)
+            )
+            wins_dev = replicate(self.mesh, wins_np)
+        else:
+            wins_dev = None
+        args = self._exact_args(boxes_dev, wins_dev, has_time)
+        rcap = self._rcap
+        buf = _exact_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
+        try:
+            buf.copy_to_host_async()
+        except Exception:  # pragma: no cover
+            pass
+        batch = _BatchRows(buf)
+        out = []
+        for i, (box_np, win_np) in enumerate(descs):
+            # escalation/bitmap fallbacks re-dispatch the SINGLE-query fns
+            # with this query's own descriptor (rare: capacities adapt)
+            def single_args(box_np=box_np, win_np=win_np):
+                return self._exact_args(
+                    replicate(self.mesh, box_np),
+                    None if win_np is None else replicate(self.mesh, win_np),
+                    has_time,
+                )
+
+            out.append(
+                _PendingHits(
+                    self,
+                    rcap,
+                    _BatchRow(batch, i),
+                    refetch=lambda rc, sa=single_args: _exact_runs_fn(
+                        has_time, rc, mode, self.mesh
+                    )(*sa()),
+                    packed=lambda sa=single_args: _exact_packed_fn(
+                        has_time, mode, self.mesh
+                    )(*sa()),
+                )
+            )
+        return out
 
     def hit_rows(self, boxes_dev, windows_dev) -> np.ndarray:
         """Sorted candidate row indices, compacted ON DEVICE (sync)."""
@@ -1312,12 +1451,18 @@ class TpuScanExecutor:
 
     @staticmethod
     def _devseek_enabled() -> bool:
+        """GEOMESA_DEVSEEK: 1 (force) | auto/0 (off).
+
+        Auto is OFF since round 3's silicon session: the candidate-gather
+        protocol measured ~500 ms/query on TPU v5e (random 2M-row gathers
+        from a 20M-row mirror) while the streaming full-scan exact mask is
+        ~1 ms — TPU gathers are not HBM-bandwidth-bound, streaming compares
+        are. The batched exact path (_exact_runs_batch_fn) supersedes this
+        protocol; it stays forceable for parity tests and for hardware
+        where gathers win."""
         import os
 
-        env = os.environ.get("GEOMESA_DEVSEEK", "auto")
-        if env == "0":
-            return False
-        return env == "1" or jax.default_backend() != "cpu"
+        return os.environ.get("GEOMESA_DEVSEEK", "auto") == "1"
 
     def _device_seek_xz(self, table: IndexTable, plan, per_block, total: int):
         """Extent edition of the device-assisted seek: exact f64 envelope
@@ -1687,11 +1832,30 @@ class TpuScanExecutor:
         seek = self._seek_scan(table, plan)
         if seek is not None:
             return seek
+        return self._dispatch_nonseek(table, plan)
+
+    def _scan_eligible(self, table: IndexTable, plan: QueryPlan) -> bool:
+        """Shared gate for any full-scan device dispatch (single or
+        batched): index family supported and bin-keyed tables have bins."""
         if not self.supports(table, plan):
+            return False
+        return not (
+            table.index.name in ("z3", "xz3") and not plan.values.bins
+        )
+
+    _DESC_UNSET = object()  # sentinel: caller did not precompute desc
+
+    def _dispatch_nonseek(
+        self, table: IndexTable, plan: QueryPlan, desc=_DESC_UNSET
+    ):
+        """Device dispatch AFTER the seek-path choice declined (the
+        full-scan tail of dispatch_candidates). ``desc`` lets dispatch_many
+        pass an already-computed exact descriptor (avoids re-walking the
+        filter AST on its fallback paths)."""
+        if not self._scan_eligible(table, plan):
             return None
-        if table.index.name in ("z3", "xz3") and not plan.values.bins:
-            return None
-        desc = self._exact_descriptor(table, plan)
+        if desc is TpuScanExecutor._DESC_UNSET:
+            desc = self._exact_descriptor(table, plan)
         if desc is not None:
             dev = self.device_index(table)
             if all(seg.load_exact(table) for seg in dev.segments):
@@ -1712,6 +1876,85 @@ class TpuScanExecutor:
         """Device candidate scan; None -> caller falls back to host ranges.
         Returns the iterable _PendingScan (carrying .exact) directly."""
         return self.dispatch_candidates(table, plan)
+
+    # one batched execution answers at most this many queries; longer
+    # streams chunk (bounds the [q, 2+2*rcap] transfer and compile shapes)
+    BATCH_MAX = 64
+
+    @staticmethod
+    def _batch_enabled() -> bool:
+        """GEOMESA_DEVBATCH: auto (accelerator backends) | 1 | 0."""
+        import os
+
+        env = os.environ.get("GEOMESA_DEVBATCH", "auto")
+        if env == "0":
+            return False
+        return env == "1" or jax.default_backend() != "cpu"
+
+    def dispatch_many(self, items: Sequence[Tuple[IndexTable, QueryPlan]]):
+        """Dispatch a query stream; returns {id(plan): scan | None}.
+
+        Plans whose full filter reduces to one exact box(+window) test on
+        the same z-index table — after the cost-based seek choice declines
+        them — fuse into ONE batched device execution per segment
+        (_exact_runs_batch_fn), so the per-execution link cost of a
+        tunneled/remote accelerator amortizes across the whole stream.
+        Everything else takes the same path dispatch_candidates would.
+        """
+        out: Dict[int, object] = {}
+        seen: set = set()
+        batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
+        for table, plan in items:
+            if id(plan) in seen:
+                continue
+            seen.add(id(plan))
+            seek = self._seek_scan(table, plan)
+            if seek is not None:
+                out[id(plan)] = seek
+                continue
+            if not (self._batch_enabled() and self._scan_eligible(table, plan)):
+                out[id(plan)] = self._dispatch_nonseek(table, plan)
+                continue
+            desc = self._exact_descriptor(table, plan)
+            if desc is None:
+                out[id(plan)] = self._dispatch_nonseek(table, plan, desc=None)
+                continue
+            has_time = desc[1] is not None
+            key = (id(table), has_time)
+            if key not in batchable:
+                batchable[key] = (table, has_time, [])
+            batchable[key][2].append((id(plan), plan, desc))
+        for table, has_time, lst in batchable.values():
+            dev = self.device_index(table)
+            if not dev.segments or not all(
+                seg.load_exact(table) for seg in dev.segments
+            ):
+                for pid, plan, d in lst:
+                    out[pid] = self._dispatch_nonseek(table, plan, desc=d)
+                continue
+            for i in range(0, len(lst), self.BATCH_MAX):
+                chunk = lst[i : i + self.BATCH_MAX]
+                if len(chunk) == 1:
+                    # a lone query pads to the pow2 floor in the batch fn
+                    # (x4 scan work) — the cached single-query dispatch is
+                    # strictly better
+                    pid, plan, d = chunk[0]
+                    out[pid] = self._dispatch_nonseek(table, plan, desc=d)
+                    continue
+                descs = [d for _pid, _p, d in chunk]
+                per_seg = [
+                    seg.dispatch_exact_batch(descs, has_time)
+                    for seg in dev.segments
+                ]
+                for qi, (pid, _plan, _d) in enumerate(chunk):
+                    out[pid] = _PendingScan(
+                        [
+                            (seg, phs[qi])
+                            for seg, phs in zip(dev.segments, per_seg)
+                        ],
+                        exact=True,
+                    )
+        return out
 
     @staticmethod
     def _box_window_shape(ft, f):

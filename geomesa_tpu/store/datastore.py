@@ -472,8 +472,10 @@ class TpuDataStore:
             plans.append(self._plan_cached(name, q))
             plan_s.append(_time.perf_counter() - t0)
         dispatch = getattr(self.executor, "dispatch_candidates", None)
+        dispatch_many = getattr(self.executor, "dispatch_many", None)
         pending: Dict[int, object] = {}
         if dispatch is not None:
+            items = []
             for q, plan in zip(qs, plans):
                 if "density" in q.hints:
                     continue  # fused density path dispatches its own compute
@@ -482,7 +484,15 @@ class TpuDataStore:
                     if arm.is_empty or id(arm) in pending:
                         continue
                     table = self._tables[name][arm.index.name]
-                    pending[id(arm)] = dispatch(table, arm)
+                    if dispatch_many is not None:
+                        pending[id(arm)] = None  # placeholder, filled below
+                        items.append((table, arm))
+                    else:
+                        pending[id(arm)] = dispatch(table, arm)
+            if dispatch_many is not None and items:
+                # exact-shape plans on the same table fuse into one batched
+                # device execution; the rest dispatch as before
+                pending.update(dispatch_many(items))
         results = []
         for q, plan, dt in zip(qs, plans, plan_s):
             # per-query clock: the timeout budget and audited scan time
