@@ -2246,11 +2246,18 @@ class TpuScanExecutor:
         mode = os.environ.get("GEOMESA_DENSITY_DEVICE", "auto")
         if mode == "0":
             return None
-        if mode != "1" and jax.default_backend() == "cpu":
+        if mode != "1":
             # cost choice (like GEOMESA_KNN_DEVICE): the fused kernel full-
             # scans every resident row — free on an accelerator, while the
-            # CPU backend's host path seeks candidates and bincounts them
-            return None
+            # CPU backend's host path seeks candidates and bincounts them.
+            # Over a high-latency link the dispatch round trip alone beats
+            # the host path, so auto declines there too (link_latency_ms).
+            if jax.default_backend() == "cpu":
+                return None
+            from geomesa_tpu.parallel.mesh import link_latency_ms
+
+            if link_latency_ms() > 10.0:
+                return None
         if table.index.name not in ("z2", "z3") or not self.supports(table, plan):
             return None
         if plan.secondary is not None or spec.get("weight") or spec.get("exact"):

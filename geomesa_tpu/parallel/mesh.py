@@ -134,3 +134,46 @@ def shard_array(mesh: Mesh, arr: np.ndarray, axis: str = DATA_AXIS):
 def replicate(mesh: Mesh, arr: np.ndarray):
     """Place a host array on the mesh fully replicated (query descriptors)."""
     return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+_LINK_LATENCY_MS: Optional[float] = None
+
+
+def link_latency_ms() -> float:
+    """Measured host<->device round-trip latency (ms), cached per process.
+
+    The per-query cost floor of any device dispatch. A PCIe-attached chip
+    measures well under 1 ms; the axon remote-TPU tunnel measured ~70-95 ms
+    per execution (round-3 silicon session). Cost-based executor choices
+    (device kNN/density autos) consult this so a high-latency link prefers
+    host kernels while a local accelerator keeps the device paths — the
+    same per-deployment cost asymmetry the reference handles by moving
+    compute to the data (SURVEY.md section 2.6). CPU backend: 0 (device
+    compute IS host compute). GEOMESA_LINK_LATENCY_MS overrides (tests,
+    known deployments)."""
+    global _LINK_LATENCY_MS
+    import os
+
+    env = os.environ.get("GEOMESA_LINK_LATENCY_MS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if _LINK_LATENCY_MS is None:
+        if jax.default_backend() == "cpu":
+            _LINK_LATENCY_MS = 0.0
+        else:
+            import time
+            import numpy as _np
+
+            f = jax.jit(lambda x: x + 1)
+            x = jax.device_put(_np.zeros(8, _np.float32))
+            _np.asarray(f(x))  # compile + first transfer
+            samples = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _np.asarray(f(x))
+                samples.append((time.perf_counter() - t0) * 1000.0)
+            _LINK_LATENCY_MS = float(sorted(samples)[1])
+    return _LINK_LATENCY_MS

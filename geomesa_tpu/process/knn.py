@@ -78,9 +78,13 @@ def knn_search(
 
 def _device_knn_wanted() -> bool:
     """Cost choice: the one-pass device top-k ranks EVERY resident row —
-    a bargain on a real accelerator, a full scan on the CPU backend where
-    the expanding-bbox seek path touches only candidate cells.
-    GEOMESA_KNN_DEVICE: auto (accelerators only, default) | 1 | 0."""
+    a bargain on a LOCAL accelerator, a full scan on the CPU backend where
+    the expanding-bbox seek path touches only candidate cells. Over a
+    high-latency device link (tunneled/remote chip) the per-dispatch
+    round trip alone dwarfs the host seek's sub-ms answer, so auto
+    declines there too (measured link_latency_ms, round-3 silicon
+    session: ~80 ms/query device vs ~0.2 ms host on the axon tunnel).
+    GEOMESA_KNN_DEVICE: auto | 1 | 0."""
     import os
 
     env = os.environ.get("GEOMESA_KNN_DEVICE", "auto")
@@ -90,7 +94,15 @@ def _device_knn_wanted() -> bool:
         return True
     import jax
 
-    return jax.default_backend() != "cpu"
+    if jax.default_backend() == "cpu":
+        return False
+    from geomesa_tpu.parallel.mesh import link_latency_ms
+
+    return link_latency_ms() <= _LINK_BUDGET_MS
+
+
+# auto device paths decline when one round trip costs more than this
+_LINK_BUDGET_MS = 10.0
 
 
 def _device_knn(store, name: str, ft, x: float, y: float, k: int,
