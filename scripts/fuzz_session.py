@@ -41,7 +41,13 @@ MODES = [
     {"GEOMESA_SEEK": "auto", "GEOMESA_TPU_NO_NATIVE": "1"},
     {"GEOMESA_SEEK": "auto", "GEOMESA_DEVSEEK": "1"},
     {"GEOMESA_SEEK": "auto", "GEOMESA_EXACT_DEVICE": "1"},
+    # batched exact device scans (query_many fuses exact-shape plans)
+    {"GEOMESA_SEEK": "0", "GEOMESA_EXACT_DEVICE": "1", "GEOMESA_DEVBATCH": "1"},
 ]
+_MODE_KEYS = (
+    "GEOMESA_SEEK", "GEOMESA_TPU_NO_NATIVE", "GEOMESA_DEVSEEK",
+    "GEOMESA_EXACT_DEVICE", "GEOMESA_DEVBATCH",
+)
 
 
 def build_pair(rng, n):
@@ -61,9 +67,7 @@ def one_round(seed: int) -> int:
     rng = np.random.default_rng(seed)
     n = int(rng.integers(400, 2500))
     mode = MODES[seed % len(MODES)]
-    old = {k: os.environ.get(k) for k in
-           ("GEOMESA_SEEK", "GEOMESA_TPU_NO_NATIVE", "GEOMESA_DEVSEEK",
-            "GEOMESA_EXACT_DEVICE")}
+    old = {k: os.environ.get(k) for k in _MODE_KEYS}
     for k in old:
         os.environ.pop(k, None)
     os.environ.update(mode)
@@ -75,10 +79,17 @@ def one_round(seed: int) -> int:
             "tag = 'tag-3' AND bbox(geom, -50, -40, 40, 40)",
             "name LIKE 'n%' AND age BETWEEN 10 AND 50",
         ]
+        wants = {}
         for q in queries:
             got = sorted(map(str, tpu.query("t", q).fids))
-            want = sorted(map(str, host.query("t", q).fids))
-            assert got == want, ("plain", seed, mode, q)
+            wants[q] = sorted(map(str, host.query("t", q).fids))
+            assert got == wants[q], ("plain", seed, mode, q)
+            checked += 1
+        # query_many: the pipelined/batched dispatch (exact-shape plans
+        # fuse into one device execution under GEOMESA_DEVBATCH) must be
+        # positionally identical to per-query execution
+        for q, r in zip(queries, tpu.query_many("t", queries)):
+            assert sorted(map(str, r.fids)) == wants[q], ("many", seed, mode, q)
             checked += 1
         # options: sort / limit / projection
         q = queries[0]
@@ -131,9 +142,7 @@ def one_extent_round(seed: int) -> int:
     rng = np.random.default_rng(seed)
     n = int(rng.integers(300, 1500))
     mode = MODES[seed % len(MODES)]
-    old = {k: os.environ.get(k) for k in
-           ("GEOMESA_SEEK", "GEOMESA_TPU_NO_NATIVE", "GEOMESA_DEVSEEK",
-            "GEOMESA_EXACT_DEVICE")}
+    old = {k: os.environ.get(k) for k in _MODE_KEYS}
     for k in old:
         os.environ.pop(k, None)
     os.environ.update(mode)
