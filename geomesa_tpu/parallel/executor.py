@@ -307,6 +307,135 @@ class _BatchRow:
         return r if dtype is None else r.astype(dtype)
 
 
+def _xz_exact_mask_body(has_time: bool, mode: str, mesh):
+    """Unjitted full-scan extent mask: (hit, decided) over ALL rows.
+
+    hit = stored envelope overlaps the query envelope (exact f64 via
+    sort-key limb compares) AND the time window matches (xz3); decided =
+    provably final (rectangle query AND (envelope inside the box, or an
+    isrect feature), never a placeholder/null geometry). hit & ~decided is
+    the boundary-straddling ring that still needs the host's per-geometry
+    test — the same decision logic as the candidate-gather devseek
+    (_devseek_xz_fn) but streaming, which is how this hardware wants it.
+
+    Query descriptor qbox: u32[12] = (xmin, ymin, xmax, ymax, zero) x
+    (hi, lo) limbs + [rect_flag, 0]."""
+    from geomesa_tpu.ops.zkernels import limbs_in_range, limbs_leq
+
+    def core(
+        bxmin_h, bxmin_l, bymin_h, bymin_l, bxmax_h, bxmax_l,
+        bymax_h, bymax_l, isrect, valid, th, tl, qbox, win,
+    ):
+        qxmin_h, qxmin_l = qbox[0], qbox[1]
+        qymin_h, qymin_l = qbox[2], qbox[3]
+        qxmax_h, qxmax_l = qbox[4], qbox[5]
+        qymax_h, qymax_l = qbox[6], qbox[7]
+        zero_h, zero_l = qbox[8], qbox[9]
+        rect = qbox[10] != 0
+        overlap = (
+            limbs_leq(qxmin_h, qxmin_l, bxmax_h, bxmax_l)
+            & limbs_leq(bxmin_h, bxmin_l, qxmax_h, qxmax_l)
+            & limbs_leq(qymin_h, qymin_l, bymax_h, bymax_l)
+            & limbs_leq(bymin_h, bymin_l, qymax_h, qymax_l)
+        )
+        placeholder = (
+            (bxmin_h == zero_h) & (bxmin_l == zero_l)
+            & (bymin_h == zero_h) & (bymin_l == zero_l)
+            & (bxmax_h == zero_h) & (bxmax_l == zero_l)
+            & (bymax_h == zero_h) & (bymax_l == zero_l)
+        )
+        inside = (
+            limbs_leq(qxmin_h, qxmin_l, bxmin_h, bxmin_l)
+            & limbs_leq(bxmax_h, bxmax_l, qxmax_h, qxmax_l)
+            & limbs_leq(qymin_h, qymin_l, bymin_h, bymin_l)
+            & limbs_leq(bymax_h, bymax_l, qymax_h, qymax_l)
+        )
+        hit = overlap & valid
+        if has_time:
+            hit = hit & limbs_in_range(th, tl, win[0], win[1], win[2], win[3])
+        decided = hit & rect & ~placeholder & (inside | isrect)
+        return hit, decided
+
+    if mode != "spmd":
+        return core
+    from jax.sharding import PartitionSpec as P
+
+    # 8 limb cols + isrect + valid + th + tl sharded; qbox/win replicated
+    return shard_map_fn(
+        core,
+        mesh,
+        in_specs=tuple([P(DATA_AXIS)] * 12 + [P()] * 2),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check=False,
+    )
+
+
+_XZ_RUNS_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_XZ_RUNS_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_XZ_PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _xz_dual_runs(hit, decided, rcap: int):
+    """(hit, decided) masks -> one fused buffer [2 x (2 + 2*rcap)]."""
+    return jnp.concatenate(
+        [_runs_from_mask(hit, rcap), _runs_from_mask(decided, rcap)]
+    )
+
+
+def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
+    key = (has_time, rcap, mode, mesh if mode == "spmd" else None)
+    fn = _XZ_RUNS_FNS.get(key)
+    if fn is None:
+        mask = _xz_exact_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            hit, decided = mask(*args)
+            return _xz_dual_runs(hit, decided, rcap)
+
+        fn = jax.jit(run)
+        _XZ_RUNS_FNS[key] = fn
+    return fn
+
+
+def _xz_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
+    """Batched extent edition of _exact_runs_batch_fn: lax.scan over [q]
+    stacked (qbox, window) descriptors -> [q, 2 x (2 + 2*rcap)]."""
+    key = (has_time, rcap, q, mode, mesh if mode == "spmd" else None)
+    fn = _XZ_RUNS_BATCH_FNS.get(key)
+    if fn is None:
+        mask = _xz_exact_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            cols, qboxes, wins = args[:-2], args[-2], args[-1]
+
+            def step(carry, bw):
+                qbox, win = bw
+                hit, decided = mask(*cols, qbox, win)
+                return carry, _xz_dual_runs(hit, decided, rcap)
+
+            _, out = jax.lax.scan(step, 0, (qboxes, wins))
+            return out
+
+        fn = jax.jit(run)
+        _XZ_RUNS_BATCH_FNS[key] = fn
+    return fn
+
+
+def _xz_packed_fn(has_time: bool, mode: str, mesh):
+    key = (has_time, mode, mesh if mode == "spmd" else None)
+    fn = _XZ_PACKED_FNS.get(key)
+    if fn is None:
+        mask = _xz_exact_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            hit, decided = mask(*args)
+            return jnp.concatenate([jnp.packbits(hit), jnp.packbits(decided)])
+
+        fn = jax.jit(run)
+        _XZ_PACKED_FNS[key] = fn
+    return fn
+
+
 def _exact_packed_fn(has_time: bool, mode: str, mesh):
     key = (has_time, mode, mesh if mode == "spmd" else None)
     fn = _EXACT_PACKED_FNS.get(key)
@@ -830,6 +959,63 @@ class DeviceSegment:
             )
         return out
 
+    def _xz_args(self, qbox_dev, win_dev, has_time: bool) -> tuple:
+        """Extent exact-scan argument layout (single + batch + refetch).
+        Dummies stand in for the time columns when has_time is False (the
+        mask body ignores them; shard_map still needs row-sharded args)."""
+        valid = self.valid
+        th = tl = self.xz_limbs[0]  # placeholder columns
+        if has_time:
+            th, tl = self.xz_tk
+            if self.xz_tvalid is not None:
+                valid = self.xz_tvalid
+        return (*self.xz_limbs, self.xz_isrect, valid, th, tl, qbox_dev, win_dev)
+
+    def dispatch_exact_xz_batch(
+        self, descs: Sequence[tuple], has_time: bool
+    ) -> List["_PendingXZHits"]:
+        """Q extent exact scans in ONE device execution (dual RLE buffers:
+        hit runs + decided runs per query; see _xz_exact_mask_body).
+        ``descs`` = [(qbox_np u32[12], win_np u32[4])]."""
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        q = len(descs)
+        qpad = _pow2_at_least(q, 4)
+        boxes_np = np.stack([d[0] for d in descs] + [descs[-1][0]] * (qpad - q))
+        wins_np = np.stack([d[1] for d in descs] + [descs[-1][1]] * (qpad - q))
+        args = self._xz_args(
+            replicate(self.mesh, boxes_np), replicate(self.mesh, wins_np), has_time
+        )
+        rcap = self._rcap
+        buf = _xz_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
+        try:
+            buf.copy_to_host_async()
+        except Exception:  # pragma: no cover
+            pass
+        batch = _BatchRows(buf)
+        out = []
+        for i, (qbox_np, win_np) in enumerate(descs):
+            def single_args(qbox_np=qbox_np, win_np=win_np):
+                return self._xz_args(
+                    replicate(self.mesh, qbox_np),
+                    replicate(self.mesh, win_np),
+                    has_time,
+                )
+
+            out.append(
+                _PendingXZHits(
+                    self,
+                    rcap,
+                    _BatchRow(batch, i),
+                    refetch=lambda rc, sa=single_args: _xz_runs_fn(
+                        has_time, rc, mode, self.mesh
+                    )(*sa()),
+                    packed=lambda sa=single_args: _xz_packed_fn(
+                        has_time, mode, self.mesh
+                    )(*sa()),
+                )
+            )
+        return out
+
     def hit_rows(self, boxes_dev, windows_dev) -> np.ndarray:
         """Sorted candidate row indices, compacted ON DEVICE (sync)."""
         return self.dispatch_hits(boxes_dev, windows_dev).rows()
@@ -891,10 +1077,151 @@ class _PendingHits:
             buf = np.asarray(self._refetch(rcap))
         starts = buf[2 : 2 + nruns].astype(np.int64)
         lens = buf[2 + rcap : 2 + rcap + nruns].astype(np.int64)
-        # expand runs -> sorted row indices
-        out = np.repeat(starts, lens)
-        base = np.concatenate(([0], np.cumsum(lens[:-1])))
-        return out + (np.arange(len(out), dtype=np.int64) - np.repeat(base, lens))
+        return _expand_runs(starts, lens)
+
+
+def _expand_runs(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """RLE runs -> sorted row indices."""
+    if not len(starts):
+        return np.empty(0, dtype=np.int64)
+    out = np.repeat(starts, lens)
+    base = np.concatenate(([0], np.cumsum(lens[:-1])))
+    return out + (np.arange(len(out), dtype=np.int64) - np.repeat(base, lens))
+
+
+def _xz_query_limbs(qenv, rect: bool, t_lo, t_hi):
+    """(qbox u32[12], win u32[4], has_time): the ONE place that encodes an
+    extent query's envelope + placeholder-zero sort-key limbs, rect flag,
+    and time-window limbs. Must stay bit-identical with the unpacking in
+    _xz_exact_mask_body / _devseek_xz_fn."""
+    from geomesa_tpu.ops.zkernels import (
+        f64_sort_keys,
+        i64_sort_keys,
+        split_u64_to_limbs,
+    )
+
+    keys = f64_sort_keys(
+        np.asarray([qenv.xmin, qenv.ymin, qenv.xmax, qenv.ymax, 0.0])
+    )
+    hi, lo = split_u64_to_limbs(keys)
+    qbox = np.zeros(12, dtype=np.uint32)
+    qbox[0:10:2] = hi
+    qbox[1:10:2] = lo
+    qbox[10] = 1 if rect else 0
+    win = np.zeros(4, dtype=np.uint32)
+    has_time = t_lo is not None or t_hi is not None
+    if has_time:
+        lo_ms = np.iinfo(np.int64).min + 1 if t_lo is None else t_lo
+        hi_ms = np.iinfo(np.int64).max if t_hi is None else t_hi
+        thi, tlo = split_u64_to_limbs(i64_sort_keys(np.asarray([lo_ms, hi_ms])))
+        win[:] = (thi[0], tlo[0], thi[1], tlo[1])
+    return qbox, win, has_time
+
+
+def _yield_xz_rows(seg, dec_rows: np.ndarray, ring: np.ndarray, node, geom):
+    """Shared tail of every extent device scan: ring rows (hit but not
+    device-decided) take the host's exact per-geometry test, decided rows
+    are final. Yields (block, local_rows)."""
+    from geomesa_tpu.filter.evaluate import _geom_predicate
+
+    if len(ring):
+        for block, local in seg.to_block_rows(np.sort(ring)):
+            geoms = block.gather(geom, local)
+            m = np.fromiter(
+                (g is not None and _geom_predicate(node, g) for g in geoms),
+                bool,
+                len(local),
+            )
+            if m.any():
+                yield block, local[m]
+    if len(dec_rows):
+        yield from seg.to_block_rows(np.sort(dec_rows))
+
+
+class _PendingXZHits:
+    """A dispatched extent segment scan: dual fused RLE buffers (hit +
+    decided runs) en route to host. rows() -> (hit_rows, decided_rows),
+    both sorted; decided_rows is a subset of hit_rows. Overflow of either
+    run set escalates; fragmented dense results degrade to dual packed
+    bitmaps."""
+
+    __slots__ = ("seg", "rcap", "buf", "_refetch", "_packed", "_rows")
+
+    def __init__(self, seg: DeviceSegment, rcap: int, buf, refetch, packed):
+        self.seg = seg
+        self.rcap = rcap
+        self.buf = buf
+        self._refetch = refetch
+        self._packed = packed
+        self._rows = None
+
+    def rows(self):
+        if self._rows is None:
+            self._rows = self._resolve()
+        return self._rows
+
+    def _one(self, buf, rcap):
+        nruns = int(buf[1])
+        starts = buf[2 : 2 + nruns].astype(np.int64)
+        lens = buf[2 + rcap : 2 + rcap + nruns].astype(np.int64)
+        return _expand_runs(starts, lens)
+
+    def _resolve(self):
+        seg = self.seg
+        buf = np.asarray(self.buf)
+        rcap = self.rcap
+        half = 2 + 2 * rcap
+        hit_b, dec_b = buf[:half], buf[half:]
+        nruns = max(int(hit_b[1]), int(dec_b[1]))
+        seg.remember_rcap(nruns)
+        if int(hit_b[0]) == 0:
+            empty = np.empty(0, dtype=np.int64)
+            return empty, empty
+        if nruns > rcap:
+            if self._packed is not None and nruns > max(
+                1, seg.n_padded // DENSE_BITMAP_FACTOR
+            ):
+                both = np.asarray(self._packed())
+                h = len(both) // 2
+                hm = np.unpackbits(both[:h])[: seg.n].astype(bool)
+                dm = np.unpackbits(both[h:])[: seg.n].astype(bool)
+                return np.flatnonzero(hm), np.flatnonzero(dm)
+            while rcap < nruns:
+                rcap *= 2
+            buf = np.asarray(self._refetch(rcap))
+            half = 2 + 2 * rcap
+            hit_b, dec_b = buf[:half], buf[half:]
+        return self._one(hit_b, rcap), self._one(dec_b, rcap)
+
+
+class _XZBatchScan:
+    """Batched extent scans resolved against the plan's own spatial node:
+    decided rows are final; the ring (hit minus decided) takes the host's
+    exact per-geometry test. ``exact`` is True — yielded rows ARE the
+    result set (the valid masks bake tombstones and time-nulls)."""
+
+    __slots__ = ("pending", "node", "geom", "exact", "seek")
+
+    def __init__(self, pending, node, geom):
+        self.pending = pending  # [(seg, _PendingXZHits)]
+        self.node = node
+        self.geom = geom
+        self.exact = True
+        self.seek = True
+
+    def __iter__(self):
+        for seg, ph in self.pending:
+            hit_rows, dec_rows = ph.rows()
+            if not len(hit_rows):
+                continue
+            # ring = hits not decided (both sorted): membership via merge
+            in_dec = np.zeros(len(hit_rows), dtype=bool)
+            if len(dec_rows):
+                pos = np.searchsorted(dec_rows, hit_rows)
+                pos = np.minimum(pos, len(dec_rows) - 1)
+                in_dec = dec_rows[pos] == hit_rows
+            ring = hit_rows[~in_dec]
+            yield from _yield_xz_rows(seg, dec_rows, ring, self.node, self.geom)
 
 
 class _PendingScan:
@@ -1246,8 +1573,6 @@ class _DeviceSeekXZScan:
         self.seek = True
 
     def __iter__(self):
-        from geomesa_tpu.filter.evaluate import _geom_predicate
-
         for seg, starts, lens, total, buf in self.pending:
             raw = np.asarray(buf)
             half = len(raw) // 2
@@ -1261,23 +1586,9 @@ class _DeviceSeekXZScan:
             prev = seg_end[which] - lens[which]
             rows = starts[which] + (j - prev)
             dec = decided[j]
-            ring = rows[~dec]
-            keep_rows = rows[dec]
-            if len(ring):
-                for block, local in seg.to_block_rows(np.sort(ring)):
-                    geoms = block.gather(self.geom, local)
-                    m = np.fromiter(
-                        (
-                            g is not None and _geom_predicate(self.node, g)
-                            for g in geoms
-                        ),
-                        bool,
-                        len(local),
-                    )
-                    if m.any():
-                        yield block, local[m]
-            if len(keep_rows):
-                yield from seg.to_block_rows(np.sort(keep_rows))
+            yield from _yield_xz_rows(
+                seg, rows[dec], rows[~dec], self.node, self.geom
+            )
 
 
 class _DeviceSeekScan:
@@ -1491,30 +1802,10 @@ class TpuScanExecutor:
             synced.update(seg.block_ids)
         if any(id(b) not in synced for b, _s, _e, _f in per_block):
             return None
-        from geomesa_tpu.ops.zkernels import (
-            f64_sort_keys,
-            i64_sort_keys,
-            split_u64_to_limbs,
-        )
-
-        keys = f64_sort_keys(
-            np.asarray([qenv.xmin, qenv.ymin, qenv.xmax, qenv.ymax, 0.0])
-        )
-        hi, lo = split_u64_to_limbs(keys)
-        qbox = np.empty(10, dtype=np.uint32)
-        qbox[0::2] = hi
-        qbox[1::2] = lo
-        qbox_dev = replicate(self.mesh, qbox)
-        rect_dev = replicate(self.mesh, np.asarray(rect))
-        win_dev = None
-        if has_time:
-            lo_ms = np.iinfo(np.int64).min + 1 if t_lo is None else t_lo
-            hi_ms = np.iinfo(np.int64).max if t_hi is None else t_hi
-            thi, tlo = split_u64_to_limbs(i64_sort_keys(np.asarray([lo_ms, hi_ms])))
-            win_dev = replicate(
-                self.mesh,
-                np.asarray([thi[0], tlo[0], thi[1], tlo[1]], dtype=np.uint32),
-            )
+        qbox12, win, _ht = _xz_query_limbs(qenv, rect, t_lo, t_hi)
+        qbox_dev = replicate(self.mesh, qbox12[:10])
+        rect_dev = replicate(self.mesh, np.asarray(bool(qbox12[10])))
+        win_dev = replicate(self.mesh, win) if has_time else None
         pending = []
         for seg, starts, lens, tot, n_iv, cand, starts_p, lens_p in (
             self._candidate_batches(dev, per_block)
@@ -1904,6 +2195,7 @@ class TpuScanExecutor:
         out: Dict[int, object] = {}
         seen: set = set()
         batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
+        xz_batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
         for table, plan in items:
             if id(plan) in seen:
                 continue
@@ -1912,18 +2204,32 @@ class TpuScanExecutor:
             if seek is not None:
                 out[id(plan)] = seek
                 continue
-            if not (self._batch_enabled() and self._scan_eligible(table, plan)):
+            if not self._batch_enabled():
                 out[id(plan)] = self._dispatch_nonseek(table, plan)
                 continue
-            desc = self._exact_descriptor(table, plan)
-            if desc is None:
-                out[id(plan)] = self._dispatch_nonseek(table, plan, desc=None)
+            desc = (
+                self._exact_descriptor(table, plan)
+                if self._scan_eligible(table, plan)
+                else None
+            )
+            if desc is not None:
+                has_time = desc[1] is not None
+                key = (id(table), has_time)
+                if key not in batchable:
+                    batchable[key] = (table, has_time, [])
+                batchable[key][2].append((id(plan), plan, desc))
                 continue
-            has_time = desc[1] is not None
-            key = (id(table), has_time)
-            if key not in batchable:
-                batchable[key] = (table, has_time, [])
-            batchable[key][2].append((id(plan), plan, desc))
+            xz = self._xz_batch_desc(table, plan)
+            if xz is not None:
+                qbox, win, has_time, geom, node = xz
+                key = (id(table), has_time)
+                if key not in xz_batchable:
+                    xz_batchable[key] = (table, has_time, [])
+                xz_batchable[key][2].append(
+                    (id(plan), plan, qbox, win, geom, node)
+                )
+                continue
+            out[id(plan)] = self._dispatch_nonseek(table, plan, desc=None)
         for table, has_time, lst in batchable.values():
             dev = self.device_index(table)
             if not dev.segments or not all(
@@ -1954,7 +2260,57 @@ class TpuScanExecutor:
                         ],
                         exact=True,
                     )
+        for table, has_time, lst in xz_batchable.values():
+            dev = self.device_index(table)
+            ok = (
+                bool(dev.segments)
+                and all(seg.load_exact_xz(table) for seg in dev.segments)
+                and not (
+                    has_time and any(seg.xz_tk is None for seg in dev.segments)
+                )
+            )
+            if not ok or len(lst) == 1:
+                for pid, plan, *_rest in lst:
+                    # desc=None: these plans provably have no exact POINT
+                    # descriptor (that's why they took the xz branch)
+                    out[pid] = self._dispatch_nonseek(table, plan, desc=None)
+                continue
+            for i in range(0, len(lst), self.BATCH_MAX):
+                chunk = lst[i : i + self.BATCH_MAX]
+                if len(chunk) == 1:
+                    pid, plan, *_rest = chunk[0]
+                    out[pid] = self._dispatch_nonseek(table, plan, desc=None)
+                    continue
+                descs = [(qb, wn) for _pid, _p, qb, wn, _g, _n in chunk]
+                per_seg = [
+                    seg.dispatch_exact_xz_batch(descs, has_time)
+                    for seg in dev.segments
+                ]
+                for qi, (pid, _plan, _qb, _wn, geom, node) in enumerate(chunk):
+                    out[pid] = _XZBatchScan(
+                        [
+                            (seg, phs[qi])
+                            for seg, phs in zip(dev.segments, per_seg)
+                        ],
+                        node,
+                        geom,
+                    )
         return out
+
+    def _xz_batch_desc(self, table: IndexTable, plan: QueryPlan):
+        """(qbox u32[12], win u32[4], has_time, geom, node) when this
+        extent plan's full filter reduces to one spatial predicate
+        (+ xz3 time bounds) — the batched extent scan's descriptor; None
+        otherwise. qbox = envelope + placeholder-zero sort-key limbs +
+        a rect flag (see _xz_exact_mask_body)."""
+        if table.index.name not in ("xz2", "xz3"):
+            return None
+        shape = self._xz_pred_shape(table, plan)
+        if shape is None:
+            return None
+        geom, node, qenv, rect, t_lo, t_hi = shape
+        qbox, win, has_time = _xz_query_limbs(qenv, rect, t_lo, t_hi)
+        return qbox, win, has_time, geom, node
 
     @staticmethod
     def _box_window_shape(ft, f):
