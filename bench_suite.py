@@ -44,6 +44,55 @@ def _timeit(fn, reps):
     return (time.perf_counter() - t0) / reps, out
 
 
+def _device_stream_fields(ds, name, cqls, wants, n, base_s):
+    """Device-forced jittered query stream (accelerator backends only):
+    GEOMESA_SEEK=0 routes the stream through the batched exact device
+    scans (one execution per chunk); parity-checked per query. Reported
+    as device_path_* next to the cost-chosen headline metric."""
+    import os
+
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return {}
+    from geomesa_tpu.index.planner import Query as _Q
+
+    saved = os.environ.get("GEOMESA_SEEK")
+    os.environ["GEOMESA_SEEK"] = "0"
+    try:
+        queries = [_Q.cql(c, properties=[]) for c in cqls]
+        prev = None
+        for _ in range(3):  # warm until adaptive run capacities settle
+            ds.query_many(name, queries)
+            caps = {
+                id(s): s._rcap
+                for d in getattr(ds.executor, "_cache", {}).values()
+                for s in d[1].segments
+            }
+            if caps == prev:
+                break
+            prev = caps
+        t0 = time.perf_counter()
+        res = ds.query_many(name, queries)
+        dt = (time.perf_counter() - t0) / len(queries)
+        ok = all(
+            set(map(str, r.fids)) == w for r, w in zip(res, wants)
+        )
+        return {
+            "device_path_fps": round(n / dt, 1),
+            "device_path_vs_baseline": round(base_s / dt, 3),
+            "device_query_ms_pipelined": round(dt * 1000, 3),
+            "device_parity": bool(ok),
+        }
+    except Exception as e:  # noqa: BLE001 - auxiliary, never kills the metric
+        return {"device_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        if saved is None:
+            os.environ.pop("GEOMESA_SEEK", None)
+        else:
+            os.environ["GEOMESA_SEEK"] = saved
+
+
 def bench_z2(n, reps):
     from geomesa_tpu.schema.featuretype import parse_spec
 
@@ -67,11 +116,24 @@ def bench_z2(n, reps):
     )
     dev_s, res = _timeit(lambda: ds.query("gps", cql), reps)
     parity = set(res.fids) == {f"f{i}" for i in want}
+    # jittered stream for the device-forced measurement
+    jit_rng = np.random.default_rng(55)
+    cqls, wants = [], []
+    for _ in range(max(8, reps)):
+        dx, dy = jit_rng.uniform(-8, 8, 2)
+        b = (box[0] + dx, box[1] + dy, box[2] + dx, box[3] + dy)
+        cqls.append(f"bbox(geom, {b[0]}, {b[1]}, {b[2]}, {b[3]})")
+        wants.append({
+            f"f{i}" for i in np.flatnonzero(
+                (x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3])
+            )
+        })
     return {
         "metric": "z2_bbox_throughput", "value": round(n / dev_s, 1),
         "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
         "n": n, "hits": int(len(want)), "parity": bool(parity),
         "query_ms": round(dev_s * 1000, 3),
+        **_device_stream_fields(ds, "gps", cqls, wants, n, base_s),
     }
 
 
@@ -113,11 +175,23 @@ def bench_xz2(n, reps):
     )
     dev_s, res = _timeit(lambda: ds.query("ways", cql), reps)
     parity = set(res.fids) == {f"w{i}" for i in np.flatnonzero(hit)}
+    jit_rng = np.random.default_rng(66)
+    cqls, wants = [], []
+    for _ in range(max(8, reps)):
+        dx, dy = jit_rng.uniform(-10, 10, 2)
+        b = (box[0] + dx, box[1] + dy, box[2] + dx, box[3] + dy)
+        cqls.append(f"bbox(geom, {b[0]}, {b[1]}, {b[2]}, {b[3]})")
+        wants.append({
+            f"w{i}" for i in np.flatnonzero(
+                (cx + w >= b[0]) & (cx <= b[2]) & (cy + w >= b[1]) & (cy <= b[3])
+            )
+        })
     return {
         "metric": "xz2_intersects_throughput", "value": round(n / dev_s, 1),
         "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
         "n": n, "hits": int(hit.sum()), "parity": bool(parity),
         "query_ms": round(dev_s * 1000, 3),
+        **_device_stream_fields(ds, "ways", cqls, wants, n, base_s),
     }
 
 
