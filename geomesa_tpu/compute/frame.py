@@ -124,19 +124,54 @@ class SpatialFrame:
         distance_m: Optional[float] = None,
         suffix: str = "_r",
     ) -> "SpatialFrame":
-        """Join this frame's POINT rows against the other frame's geometries
+        """Join this frame's rows against the other frame's geometries
         (the Catalyst spatial-join relation analog, SQLRules.scala spatial
-        join folding): 'intersects'/'contains' do point-in-geometry,
-        'dwithin' uses a haversine radius against the other frame's points.
-        Output = matched left rows + right columns (suffixed)."""
+        join folding): point left frames do point-in-geometry; EXTENT left
+        frames (no point columns) take an envelope prescreen + exact
+        geometry-geometry test per surviving pair ('intersects' =
+        geometries_intersect, 'within' = left within right, 'contains' =
+        left contains right); 'dwithin' uses a haversine radius against
+        the other frame's points (point frames only). Output = matched
+        left rows + right columns (suffixed)."""
         gx = self.ft.default_geometry.name if self.ft is not None else "geom"
-        lx = self.columns[gx + "__x"]
-        ly = self.columns[gx + "__y"]
+        left_pts = (gx + "__x") in self.columns
         li: List[int] = []
         ri: List[int] = []
-        if predicate in ("intersects", "contains", "within"):
+        if predicate in ("intersects", "contains", "within") and not left_pts:
+            from geomesa_tpu.geom.predicates import (
+                geometries_intersect,
+                geometry_within,
+            )
+
+            ogx = other.ft.default_geometry.name if other.ft is not None else "geom"
+            lg = self.columns[gx]
+            env = self._envelopes(gx)
+            for j, g in enumerate(other.columns[ogx]):
+                if g is None:
+                    continue
+                qe = g.envelope
+                cand = np.flatnonzero(
+                    (env[:, 0] <= qe.xmax) & (env[:, 2] >= qe.xmin)
+                    & (env[:, 1] <= qe.ymax) & (env[:, 3] >= qe.ymin)
+                )
+                for i in cand:
+                    a = lg[i]
+                    if a is None:
+                        continue
+                    if predicate == "intersects":
+                        ok = geometries_intersect(a, g)
+                    elif predicate == "within":
+                        ok = geometry_within(a, g)
+                    else:  # contains: left contains right
+                        ok = geometry_within(g, a)
+                    if ok:
+                        li.append(int(i))
+                        ri.append(j)
+        elif predicate in ("intersects", "contains", "within"):
             from geomesa_tpu.geom.predicates import points_in_geometry
 
+            lx = self.columns[gx + "__x"]
+            ly = self.columns[gx + "__y"]
             geoms = other.columns[
                 other.ft.default_geometry.name if other.ft is not None else "geom"
             ]
@@ -150,8 +185,12 @@ class SpatialFrame:
         elif predicate == "dwithin":
             if distance_m is None:
                 raise ValueError("dwithin join needs distance_m")
+            if not left_pts:
+                raise ValueError("dwithin joins need point geometries")
             from geomesa_tpu.process.geodesy import haversine_m
 
+            lx = self.columns[gx + "__x"]
+            ly = self.columns[gx + "__y"]
             ogx = other.ft.default_geometry.name if other.ft is not None else "geom"
             rx = other.columns[ogx + "__x"]
             ry = other.columns[ogx + "__y"]
@@ -168,6 +207,32 @@ class SpatialFrame:
         for k, v in other.columns.items():
             cols[(k + suffix) if k in self.columns else k] = v[ridx]
         return SpatialFrame(cols, self.ft)
+
+    def _envelopes(self, gx: str) -> np.ndarray:
+        """[n, 4] (xmin, ymin, xmax, ymax) per row — from the companion
+        columns when present (what ingest stores for extent schemas), else
+        walked from the geometry objects. Null geometries get an inverted
+        envelope that never overlaps anything."""
+        bx = self.columns.get(gx + "__bxmin")
+        if bx is not None:
+            return np.stack(
+                [
+                    np.asarray(bx, dtype=np.float64),
+                    np.asarray(self.columns[gx + "__bymin"], dtype=np.float64),
+                    np.asarray(self.columns[gx + "__bxmax"], dtype=np.float64),
+                    np.asarray(self.columns[gx + "__bymax"], dtype=np.float64),
+                ],
+                axis=1,
+            )
+        geoms = self.columns[gx]
+        env = np.empty((len(geoms), 4), dtype=np.float64)
+        env[:, :2] = np.inf
+        env[:, 2:] = -np.inf
+        for i, g in enumerate(geoms):
+            if g is not None:
+                e = g.envelope
+                env[i] = (e.xmin, e.ymin, e.xmax, e.ymax)
+        return env
 
     def partition_by_z2(self, bits: int = 8) -> Dict[int, "SpatialFrame"]:
         """Partition rows by low-resolution z2 cell of their point geometry

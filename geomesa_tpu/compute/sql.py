@@ -108,7 +108,7 @@ class _Parser:
             raise SqlError("Expected table name after FROM")
         alias = None
         if self.peek()[0] == "ident" and not self.peek("WHERE") and not any(
-            self.peek(k) for k in ("JOIN", "GROUP", "ORDER", "LIMIT")
+            self.peek(k) for k in ("JOIN", "GROUP", "ORDER", "LIMIT", "HAVING")
         ):
             alias = self.ident()
         join = None
@@ -140,6 +140,9 @@ class _Parser:
             group.append(self.ident())
             while self.accept_op(","):
                 group.append(self.ident())
+        having = None
+        if self.accept_kw("HAVING"):
+            having = self.having_expr()
         order = []
         if self.accept_kw("ORDER"):
             self.expect_kw("BY")
@@ -169,9 +172,60 @@ class _Parser:
             "join": join,
             "where": where,
             "group": group,
+            "having": having,
             "order": order,
             "limit": limit,
         }
+
+    # HAVING: boolean combinations of comparisons whose left side is an
+    # aggregate call or an aggregate's (output) alias, right side a literal
+    def having_expr(self):
+        node = self._having_and()
+        while self.accept_kw("OR"):
+            node = ("or", node, self._having_and())
+        return node
+
+    def _having_and(self):
+        node = self._having_not()
+        while self.accept_kw("AND"):
+            node = ("and", node, self._having_not())
+        return node
+
+    def _having_not(self):
+        if self.accept_kw("NOT"):
+            return ("not", self._having_not())
+        if self.accept_op("("):
+            node = self.having_expr()
+            self.expect_op(")")
+            return node
+        return self._having_cmp()
+
+    def _having_cmp(self):
+        kind, v = self.take()
+        if kind != "ident":
+            raise SqlError(f"Bad HAVING expression at {v!r}")
+        nk, nv = self.toks[self.i]
+        if nk == "op" and nv == "(":
+            low = v.lower()
+            if low not in _AGG_FNS:
+                raise SqlError(f"HAVING supports aggregate calls, got {v}")
+            self.i += 1
+            arg = "*" if self.accept_op("*") else self.ident()
+            self.expect_op(")")
+            lhs = ("agg", low, arg)
+        else:
+            lhs = ("name", v)
+        kind, op = self.take()
+        if kind != "op" or op not in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            raise SqlError(f"Expected comparison in HAVING, got {op!r}")
+        kind, rv = self.take()
+        if kind == "num":
+            val = float(rv)
+        elif kind == "str":
+            val = rv[1:-1].replace("''", "'")
+        else:
+            raise SqlError(f"Expected literal in HAVING, got {rv!r}")
+        return ("cmp", lhs, op, val)
 
     def accept_op(self, op: str) -> bool:
         kind, v = self.toks[self.i]
@@ -204,7 +258,7 @@ class _Parser:
                         arg = self.ident()
                     self.expect_op(")")
                     item = {"kind": "agg", "fn": low, "arg": arg,
-                            "alias": f"{low}_{arg if arg != '*' else 'all'}"}
+                            "alias": _agg_alias(low, arg)}
                 elif low.startswith("st_"):
                     args = self.call_args()
                     item = {"kind": "stfn", "fn": low, "args": args,
@@ -350,6 +404,125 @@ def _lit(v):
     return v[1]
 
 
+def _agg_alias(fn: str, arg: str) -> str:
+    return f"{fn}_{arg if arg != '*' else 'all'}"
+
+
+def _having_agg_terms(node, out: list) -> None:
+    """Collect every ('agg', fn, arg) left side in a HAVING tree."""
+    k = node[0]
+    if k in ("or", "and"):
+        _having_agg_terms(node[1], out)
+        _having_agg_terms(node[2], out)
+    elif k == "not":
+        _having_agg_terms(node[1], out)
+    elif k == "cmp" and node[1][0] == "agg":
+        out.append(node[1])
+
+
+def _having_mask(
+    node, columns: Dict[str, np.ndarray], aggmap: Optional[dict] = None
+) -> np.ndarray:
+    k = node[0]
+    if k == "or":
+        return _having_mask(node[1], columns, aggmap) | _having_mask(
+            node[2], columns, aggmap
+        )
+    if k == "and":
+        return _having_mask(node[1], columns, aggmap) & _having_mask(
+            node[2], columns, aggmap
+        )
+    if k == "not":
+        return ~_having_mask(node[1], columns, aggmap)
+    _, lhs, op, val = node
+    if lhs[0] == "agg":
+        name = (aggmap or {}).get((lhs[1], lhs[2]), _agg_alias(lhs[1], lhs[2]))
+    else:
+        name = lhs[1]
+    col = columns.get(name)
+    if col is None:
+        raise SqlError(f"HAVING references unknown column {name}")
+    if op in ("!=", "<>"):
+        return col != val
+    return {
+        "=": lambda c: c == val,
+        "<": lambda c: c < val,
+        "<=": lambda c: c <= val,
+        ">": lambda c: c > val,
+        ">=": lambda c: c >= val,
+    }[op](col)
+
+
+def _resolve_having(node, resolve, renames=None):
+    """Qualify a JOIN query's HAVING tree. Aggregate args resolve through
+    the alias map (unqualified real columns are rejected, same as SELECT
+    aggs); qualified NAME references resolve to the post-rename output
+    column so ambiguous bare group keys (a.name + b.name) bind to the
+    right relation's column, never silently to the left's."""
+    renames = renames or {}
+    k = node[0]
+    if k in ("or", "and"):
+        return (
+            k,
+            _resolve_having(node[1], resolve, renames),
+            _resolve_having(node[2], resolve, renames),
+        )
+    if k == "not":
+        return (k, _resolve_having(node[1], resolve, renames))
+    _, lhs, op, val = node
+    if lhs[0] == "agg":
+        if "." in lhs[2]:
+            lhs = ("agg", lhs[1], resolve(lhs[2]))
+        elif lhs[2] != "*":
+            raise SqlError(
+                f"JOIN columns must be qualified: {lhs[2]} (in HAVING)"
+            )
+    elif lhs[0] == "name" and "." in lhs[1]:
+        src = resolve(lhs[1])
+        lhs = ("name", renames.get(src, src))
+    return ("cmp", lhs, op, val)
+
+
+def _with_having_aggs(having, aggs):
+    """(aggs + hidden HAVING-only aggregates, hidden aliases, aggmap).
+
+    Dedupes by (fn, arg) so a HAVING aggregate that matches a SELECTed one
+    (even under a user alias) reuses its column instead of computing the
+    same aggregate twice; aggmap maps (fn, arg) -> output column name for
+    the mask evaluation."""
+    if having is None:
+        return aggs, [], {}
+    terms: list = []
+    _having_agg_terms(having, terms)
+    aggmap = {(it["fn"], it["arg"]): it["alias"] for it in aggs}
+    taken = {it["alias"] for it in aggs}
+    hidden = []
+    out = list(aggs)
+    for _tag, fn, arg in terms:
+        if (fn, arg) in aggmap:
+            continue
+        alias = _agg_alias(fn, arg)
+        if alias in taken:  # user AS-alias collides; find a free name
+            i = 2
+            while f"{alias}_{i}" in taken:
+                i += 1
+            alias = f"{alias}_{i}"
+        out.append({"kind": "agg", "fn": fn, "arg": arg, "alias": alias})
+        hidden.append(alias)
+        taken.add(alias)
+        aggmap[(fn, arg)] = alias
+    return out, hidden, aggmap
+
+
+def _apply_having(out, having, hidden, aggmap):
+    """Filter aggregated rows by the HAVING mask; drop hidden columns."""
+    m = _having_mask(having, out.columns, aggmap)
+    return SpatialFrame(
+        {k: v[m] for k, v in out.columns.items() if k not in hidden},
+        out.ft,
+    )
+
+
 def _project_plain(columns: Dict[str, np.ndarray], plain_items) -> Dict[str, np.ndarray]:
     """Project plain select items out of a column dict: the value column
     maps to the item's alias and subcolumns (__x/__y/__null) keep their
@@ -492,12 +665,18 @@ class SQLContext:
             pred = "dwithin"
             left, right = arg_alias[0], arg_alias[1]
         elif fn in ("st_intersects", "st_within", "st_contains"):
-            pred = "intersects"
+            # within(a, b): a inside b -> left=a drives; contains(a, b):
+            # b inside a -> left=b. Point-left frames evaluate all three as
+            # point-in-geometry; extent-left frames take the exact
+            # geometry-geometry path in SpatialFrame.spatial_join.
             if fn == "st_within":
+                pred = "within"
                 left, right = arg_alias[0], arg_alias[1]
-            elif fn == "st_contains":  # contains(a, b): b inside a
+            elif fn == "st_contains":
+                pred = "within"
                 left, right = arg_alias[1], arg_alias[0]
             else:
+                pred = "intersects"
                 left, right = arg_alias[0], arg_alias[1]
         else:
             raise SqlError(f"Unsupported join predicate {fn}")
@@ -562,10 +741,13 @@ class SQLContext:
         for it in q["items"]:
             it = dict(it)
             if it["kind"] == "stfn":
-                raise SqlError(
-                    "ST_* select expressions are not supported in JOIN queries"
-                )
-            if it["kind"] == "col":
+                # resolve qualified column args, compute over the joined
+                # frame (the post-scan projection stage, like _execute)
+                it["args"] = [
+                    ("col", resolve(a[1])) if a[0] == "col" and "." in a[1] else a
+                    for a in it["args"]
+                ]
+            elif it["kind"] == "col":
                 src = resolve(it["name"])
                 if it["alias"] == it["name"]:
                     # default output name: the bare column (AS overrides)
@@ -574,24 +756,45 @@ class SQLContext:
             elif it["kind"] == "agg" and it["arg"] != "*":
                 it["arg"] = resolve(it["arg"])
             items.append(it)
+        stfns = [it for it in items if it["kind"] == "stfn"]
+        for it in stfns:
+            joined = joined.with_column(
+                it["alias"], _apply_stfn(joined, None, it["fn"], it["args"])
+            )
         group = [resolve(g) if "." in g else g for g in q["group"]]
         aggs = [it for it in items if it["kind"] == "agg"]
         plain = [it for it in items if it["kind"] == "col"]
         star = any(it["kind"] == "star" for it in items)
-        if aggs or group:
+        # group keys surface under their BARE names (same default as
+        # plain select aliases): zname_r -> zname. Ambiguous bare
+        # names (a.name + b.name) keep their resolved forms.
+        bares = [g.split(".", 1)[1] for g in q["group"] if "." in g]
+        renames = (
+            {resolve(g): g.split(".", 1)[1] for g in q["group"] if "." in g}
+            if len(set(bares)) == len(bares)
+            else {}
+        )
+        having = (
+            _resolve_having(q["having"], resolve, renames)
+            if q["having"] is not None
+            else None
+        )
+        if aggs or group or having is not None:
+            stray_stfn = [
+                it["alias"] for it in stfns if it["alias"] not in group
+            ]
+            if stray_stfn:
+                raise SqlError(
+                    f"Non-aggregated select expression(s) {stray_stfn} "
+                    "must appear in GROUP BY"
+                )
+            aggs, hidden, aggmap = _with_having_aggs(having, aggs)
             out = self._aggregate(joined, group, aggs, plain)
-            # group keys surface under their BARE names (same default as
-            # plain select aliases): zname_r -> zname. Ambiguous bare
-            # names (a.name + b.name) keep their resolved forms.
-            bares = [g.split(".", 1)[1] for g in q["group"] if "." in g]
-            renames = (
-                {resolve(g): g.split(".", 1)[1] for g in q["group"] if "." in g}
-                if len(set(bares)) == len(bares)
-                else {}
-            )
             out = SpatialFrame(
                 {renames.get(k, k): v for k, v in out.columns.items()}, out.ft
             )
+            if having is not None:
+                out = _apply_having(out, having, hidden, aggmap)
             for col, asc in reversed(q["order"]):
                 key = col.split(".", 1)[1] if "." in col else col
                 if key not in out.columns:
@@ -599,15 +802,21 @@ class SQLContext:
                 out = out.sort(key, asc)
         else:
             # sort on the FULL joined frame (aliases have not narrowed the
-            # columns yet), then project
+            # columns yet), then project; bare ORDER BY names may reference
+            # the SELECT's output aliases (standard SQL)
+            alias_src = {it["alias"]: it["name"] for it in plain}
             for col, asc in reversed(q["order"]):
-                key = resolve(col) if "." in col else col
+                key = resolve(col) if "." in col else alias_src.get(col, col)
                 if key not in joined.columns:
                     raise SqlError(f"ORDER BY references unknown column {col}")
                 joined = joined.sort(key, asc)
-            out = joined if star else SpatialFrame(
-                _project_plain(joined.columns, plain), joined.ft
-            )
+            if star:
+                out = joined
+            else:
+                cols = _project_plain(joined.columns, plain)
+                for it in stfns:
+                    cols[it["alias"]] = joined.columns[it["alias"]]
+                out = SpatialFrame(cols, joined.ft)
         if q["limit"] is not None:
             out = SpatialFrame(
                 {k: v[: q["limit"]] for k, v in out.columns.items()}, out.ft
@@ -630,6 +839,10 @@ class SQLContext:
             needed = set(q["group"])
             needed.update(it["name"] for it in plain)
             needed.update(it["arg"] for it in aggs if it["arg"] != "*")
+            if q["having"] is not None:
+                hterms: list = []
+                _having_agg_terms(q["having"], hterms)
+                needed.update(arg for _t, _fn, arg in hterms if arg != "*")
             for it in stfns:
                 needed.update(a[1] for a in it["args"] if a[0] == "col")
             if aggs and not needed:
@@ -666,8 +879,19 @@ class SQLContext:
             frame = frame.with_column(
                 it["alias"], _apply_stfn(frame, ft, it["fn"], it["args"])
             )
-        if aggs or q["group"]:
+        if aggs or q["group"] or q["having"] is not None:
+            stray_stfn = [
+                it["alias"] for it in stfns if it["alias"] not in q["group"]
+            ]
+            if stray_stfn:
+                raise SqlError(
+                    f"Non-aggregated select expression(s) {stray_stfn} "
+                    "must appear in GROUP BY"
+                )
+            aggs, hidden, aggmap = _with_having_aggs(q["having"], aggs)
             out = self._aggregate(frame, q["group"], aggs, plain)
+            if q["having"] is not None:
+                out = _apply_having(out, q["having"], hidden, aggmap)
             if q["order"]:
                 for col, asc in reversed(q["order"]):
                     if col in out.columns:
@@ -730,8 +954,13 @@ class SQLContext:
 
 
 def _apply_stfn(frame: SpatialFrame, ft, fn: str, args: list) -> np.ndarray:
-    """Scalar ST_* select expressions over result columns."""
-    geom = ft.default_geometry.name if ft.default_geometry is not None else None
+    """Scalar ST_* select expressions over result columns. ft may be None
+    (JOIN queries) — every column argument must then be explicit."""
+    geom = (
+        ft.default_geometry.name
+        if ft is not None and ft.default_geometry is not None
+        else None
+    )
 
     def coord(axis: str, col: str) -> np.ndarray:
         got = frame.columns.get(f"{col}__{axis}")

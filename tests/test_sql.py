@@ -235,6 +235,155 @@ def test_join_right_columns_resolve_correctly():
     r3 = ctx.sql("SELECT a.name, b.name AS zn FROM pts a JOIN zones b "
                  "ON st_contains(b.geom, a.geom) ORDER BY b.name DESC")
     assert len(r3.columns["zn"]) == 8
-    # ST_* select expressions are explicitly rejected in joins
+    # ST_* select expressions resolve through the alias map in joins
+    r4 = ctx.sql(
+        "SELECT st_x(a.geom) AS px FROM pts a "
+        "JOIN zones b ON st_contains(b.geom, a.geom) ORDER BY px"
+    )
+    assert list(r4.columns["px"]) == [i + 0.5 for i in range(8)]
+
+
+def test_having(store):
+    ctx = SQLContext(store)
+    # per-group filter on a SELECTed aggregate alias
+    full = ctx.sql("SELECT actor1, count(*) AS n FROM gdelt GROUP BY actor1")
+    counts = dict(zip(full.columns["actor1"], full.columns["n"]))
+    cutoff = int(np.median(list(counts.values())))
+    r = ctx.sql(
+        "SELECT actor1, count(*) AS n FROM gdelt GROUP BY actor1 "
+        f"HAVING count(*) > {cutoff} ORDER BY n DESC"
+    )
+    want = {a for a, c in counts.items() if c > cutoff}
+    assert set(r.columns["actor1"]) == want
+    # HAVING over an aggregate NOT in the select list (hidden column)
+    r2 = ctx.sql(
+        "SELECT actor1 FROM gdelt GROUP BY actor1 "
+        "HAVING avg(n_articles) >= 45 AND count(*) > 0"
+    )
+    agg = ctx.sql(
+        "SELECT actor1, avg(n_articles) AS m FROM gdelt GROUP BY actor1"
+    )
+    want2 = {
+        a for a, m in zip(agg.columns["actor1"], agg.columns["m"]) if m >= 45
+    }
+    assert set(r2.columns["actor1"]) == want2
+    assert "avg_n_articles" not in r2.columns  # hidden agg dropped
+    # boolean combinations + alias reference
+    r3 = ctx.sql(
+        "SELECT actor1, count(*) AS n FROM gdelt GROUP BY actor1 "
+        f"HAVING NOT (n <= {cutoff})"
+    )
+    assert set(r3.columns["actor1"]) == want
+
+
+def test_having_in_join():
+    from geomesa_tpu.geom.base import Polygon
+
+    s = TpuDataStore()
+    s.create_schema(parse_spec("pts", "kind:String,*geom:Point:srid=4326"))
+    s.create_schema(parse_spec("zones", "zname:String,*geom:Polygon:srid=4326"))
+    with s.writer("pts") as w:
+        for i in range(100):
+            w.write([f"k{i % 3}", Point(i % 10 + 0.5, i // 10 + 0.5)], fid=f"p{i}")
+    with s.writer("zones") as w:
+        w.write(["west", Polygon([[0, 0], [3, 0], [3, 10], [0, 10], [0, 0]])], fid="z1")
+        w.write(["east", Polygon([[3, 0], [10, 0], [10, 10], [3, 10], [3, 0]])], fid="z2")
+    ctx = SQLContext(s)
+    r = ctx.sql(
+        "SELECT b.zname, count(*) AS n FROM pts a JOIN zones b "
+        "ON st_contains(b.geom, a.geom) GROUP BY b.zname HAVING count(*) > 40"
+    )
+    assert list(r.columns["zname"]) == ["east"]  # 70 vs 30 points
+
+
+def test_having_join_review_regressions():
+    """Review findings: ambiguous bare group keys must bind HAVING to the
+    RIGHT relation's column; unqualified HAVING agg args must raise; a
+    selected ST_* expression outside GROUP BY must raise, and one used AS
+    a group key must work."""
+    from geomesa_tpu.geom.base import Polygon
+
+    s = TpuDataStore()
+    s.create_schema(parse_spec("pts", "name:String,w:Int,*geom:Point:srid=4326"))
+    s.create_schema(parse_spec("zones", "name:String,*geom:Polygon:srid=4326"))
+    with s.writer("pts") as w:
+        for i in range(60):
+            w.write([f"p{i % 2}", i % 7, Point(i % 6 + 0.5, 0.5)], fid=f"p{i}")
+    with s.writer("zones") as w:
+        w.write(["west", Polygon([[0, 0], [3, 0], [3, 1], [0, 1], [0, 0]])], fid="z1")
+        w.write(["east", Polygon([[3, 0], [6, 0], [6, 1], [3, 1], [3, 0]])], fid="z2")
+    ctx = SQLContext(s)
+    # ambiguous bare 'name' (both relations have it): HAVING b.name must
+    # filter on the RIGHT column even though renames were skipped
+    r = ctx.sql(
+        "SELECT a.name, b.name, count(*) AS n FROM pts a JOIN zones b "
+        "ON st_contains(b.geom, a.geom) GROUP BY a.name, b.name "
+        "HAVING b.name = 'west'"
+    )
+    assert len(r.columns["n"]) == 2  # p0/p1 x west
+    assert set(r.columns["name_r"]) == {"west"}
+    # unqualified real column in a join HAVING aggregate -> SqlError
     with pytest.raises(SqlError):
-        ctx.sql("SELECT st_x(a.geom) FROM pts a JOIN zones b ON st_contains(b.geom, a.geom)")
+        ctx.sql(
+            "SELECT b.name, count(*) AS n FROM pts a JOIN zones b "
+            "ON st_contains(b.geom, a.geom) GROUP BY b.name HAVING avg(w) > 1"
+        )
+    # selected ST_* expression not in GROUP BY alongside aggregation -> error
+    with pytest.raises(SqlError):
+        ctx.sql(
+            "SELECT st_x(a.geom) AS px, count(*) AS n FROM pts a JOIN zones b "
+            "ON st_contains(b.geom, a.geom) GROUP BY b.name"
+        )
+    # ...but AS a group key it works (joins and plain queries both)
+    r2 = ctx.sql(
+        "SELECT st_x(a.geom) AS px, count(*) AS n FROM pts a JOIN zones b "
+        "ON st_contains(b.geom, a.geom) GROUP BY px ORDER BY px"
+    )
+    assert list(r2.columns["px"]) == [i + 0.5 for i in range(6)]
+    with pytest.raises(SqlError):
+        SQLContext(s).sql("SELECT st_x(geom) AS px, count(*) FROM pts GROUP BY name")
+    r3 = ctx.sql(
+        "SELECT st_x(geom) AS px, count(*) AS n FROM pts GROUP BY px ORDER BY px"
+    )
+    assert list(r3.columns["px"]) == [i + 0.5 for i in range(6)]
+    # HAVING agg matching a SELECTed agg reuses its column (no hidden col)
+    r4 = ctx.sql(
+        "SELECT name, count(*) AS n FROM pts GROUP BY name HAVING count(*) > 0"
+    )
+    assert "count_all" not in r4.columns and set(r4.columns) == {"name", "n"}
+
+
+def test_extent_extent_join():
+    """Non-point LEFT relation: exact geometry-geometry join (envelope
+    prescreen + geometries_intersect / geometry_within per pair)."""
+    from geomesa_tpu.geom.base import LineString, Polygon
+
+    s = TpuDataStore()
+    s.create_schema(parse_spec("roads", "rname:String,*geom:LineString:srid=4326"))
+    s.create_schema(parse_spec("zones", "zname:String,*geom:Polygon:srid=4326"))
+    with s.writer("roads") as w:
+        # r0 crosses both zones, r1 entirely in west, r2 outside everything
+        w.write(["r0", LineString([(1, 5), (9, 5)])], fid="r0")
+        w.write(["r1", LineString([(0.5, 1), (2.5, 1)])], fid="r1")
+        w.write(["r2", LineString([(50, 50), (60, 60)])], fid="r2")
+        w.write(["r3", None], fid="r3")
+    with s.writer("zones") as w:
+        w.write(["west", Polygon([[0, 0], [3, 0], [3, 10], [0, 10], [0, 0]])], fid="z1")
+        w.write(["east", Polygon([[3, 0], [10, 0], [10, 10], [3, 10], [3, 0]])], fid="z2")
+    ctx = SQLContext(s)
+    r = ctx.sql(
+        "SELECT a.rname, b.zname FROM roads a JOIN zones b "
+        "ON st_intersects(a.geom, b.geom) ORDER BY rname, zname"
+    )
+    pairs = list(zip(r.columns["rname"], r.columns["zname"]))
+    assert pairs == [("r0", "east"), ("r0", "west"), ("r1", "west")]
+    # within: only the fully-contained road qualifies
+    r2 = ctx.sql(
+        "SELECT a.rname FROM roads a JOIN zones b ON st_within(a.geom, b.geom)"
+    )
+    assert list(r2.columns["rname"]) == ["r1"]
+    # contains(b, a): same containment stated from the zone side
+    r3 = ctx.sql(
+        "SELECT a.rname FROM roads a JOIN zones b ON st_contains(b.geom, a.geom)"
+    )
+    assert list(r3.columns["rname"]) == ["r1"]
