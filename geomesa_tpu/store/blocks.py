@@ -288,12 +288,13 @@ class RecordBlock:
     so attributes and fids are stored once per batch instead of once per
     index table."""
 
-    __slots__ = ("columns", "n", "_nulls_memo")
+    __slots__ = ("columns", "n", "_nulls_memo", "_spilled", "__weakref__")
 
     def __init__(self, columns: Columns):
         self.columns = columns
         self.n = num_rows(columns)
         self._nulls_memo: Dict[str, bool] = {}
+        self._spilled = False
 
     def has_nulls(self, name: str) -> bool:
         got = self._nulls_memo.get(name)
@@ -302,6 +303,115 @@ class RecordBlock:
             got = bool(col.any()) if col is not None else False
             self._nulls_memo[name] = got
         return got
+
+    def spill(self) -> None:
+        """Cold-column spill (geomesa.spill.dir): non-object columns past
+        the size threshold are rewritten as .npy files (fsync'd, then
+        page-cache-dropped) and re-opened memory-mapped. Reads (gather /
+        full_col through the rowid join) work unchanged — np.memmap is an
+        ndarray — while resident memory for a wide schema becomes
+        page-cache-reclaimable instead of heap. The reference's analog:
+        full features live in the backing KV store (record table), not in
+        client memory. Files are deleted when the block is garbage-
+        collected; stale files from crashed processes are swept on first
+        use of a directory.
+
+        Called AFTER the batch's index tables are built (never in
+        __init__): the builders read key/hot columns from this block, and
+        spilling first would force a write-evict-refault round trip per
+        batch. Idempotent."""
+        from geomesa_tpu.utils.config import SPILL_DIR, SPILL_MIN_BYTES
+
+        d = SPILL_DIR.get()
+        if not d or not self.n or self._spilled:
+            return
+        self._spilled = True
+        import os
+        import re
+        import uuid
+        import weakref
+
+        os.makedirs(d, exist_ok=True)
+        _sweep_stale_spill_files(d)
+        min_bytes = SPILL_MIN_BYTES.to_bytes() or 0
+        paths = []
+        cols = dict(self.columns)
+        tag = uuid.uuid4().hex[:12]
+        for i, (k, v) in enumerate(cols.items()):
+            if (
+                not isinstance(v, np.ndarray)
+                or v.dtype == object
+                or v.nbytes < min_bytes
+                or isinstance(v, np.memmap)
+            ):
+                continue
+            # the index keeps sanitized names collision-proof ('a b' and
+            # 'a_b' both sanitize to 'a_b')
+            safe = re.sub(r"[^A-Za-z0-9_.-]", "_", k)
+            path = os.path.join(d, f"rb-{os.getpid()}-{tag}-{i}-{safe}.npy")
+            np.save(path, v)
+            # fsync BEFORE dropping: DONTNEED skips dirty pages, so without
+            # writeback the eviction would be a no-op exactly when a big
+            # batch needs it
+            try:
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                    os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+                finally:
+                    os.close(fd)
+            except (AttributeError, OSError):  # pragma: no cover
+                pass
+            cols[k] = np.load(path, mmap_mode="r")
+            paths.append(path)
+        if paths:
+            self.columns = cols
+            weakref.finalize(self, _remove_spill_files, paths)
+
+
+def _remove_spill_files(paths: Sequence[str]) -> None:
+    import os
+
+    for p in paths:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+_SWEPT_SPILL_DIRS: set = set()
+
+
+def _sweep_stale_spill_files(d: str) -> None:
+    """Unlink rb-<pid>-* files left by dead processes (crash/SIGKILL never
+    runs GC finalizers). Once per directory per process."""
+    import os
+    import re
+
+    if d in _SWEPT_SPILL_DIRS:
+        return
+    _SWEPT_SPILL_DIRS.add(d)
+    pat = re.compile(r"^rb-(\d+)-")
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        m = pat.match(name)
+        if m is None:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+        except OSError:
+            pass  # alive but not ours (EPERM): leave it
 
 
 # scan-hot columns each index family keeps physically sorted in its own
@@ -480,7 +590,8 @@ class FeatureBlock:
         columns: Union[Columns, RecordBlock],
         interned: bool = False,
     ) -> "FeatureBlock":
-        if isinstance(columns, RecordBlock):
+        local_record = not isinstance(columns, RecordBlock)
+        if not local_record:
             record = columns
         else:
             if not interned:  # batch-level ingest interns once for all tables
@@ -525,6 +636,11 @@ class FeatureBlock:
             order = np.argsort(key, kind="stable")
         key = key[order]
         sorted_cols = take_rows(own, order)
+        if local_record:
+            # single-table path owns its record: spill now that the build
+            # has read everything (shared records spill at the call site
+            # once EVERY table's build is done)
+            record.spill()
         return cls(
             index, sorted_cols, key, bins, tiebreak, record, rowid[order], key_vocab
         )
@@ -776,7 +892,9 @@ class IndexTable:
             return
         if not interned:
             columns = intern_string_columns(self.ft, intern_fids(columns))
-        self.insert_record(RecordBlock(columns))
+        record = RecordBlock(columns)
+        self.insert_record(record)
+        record.spill()  # after the build read its key/hot columns
 
     def insert_record(self, record: RecordBlock):
         """Seal one key-sorted block referencing a (possibly shared)
@@ -850,7 +968,8 @@ class IndexTable:
         record block (the datastore compacts all of a type's tables
         against ONE merged record); otherwise merge this table's own
         record parts."""
-        if record is None:
+        own_merge = record is None
+        if own_merge:
             if len(self.blocks) <= 1 and not self.tombstones:
                 return
             record = self.merged_record()
@@ -858,6 +977,8 @@ class IndexTable:
         self.tombstones = set()
         self.version += 1
         self.insert_record(record)
+        if own_merge:
+            record.spill()  # shared records spill at the datastore level
 
     def merged_record(self) -> RecordBlock:
         """Live rows of every record block, tombstones dropped, in record

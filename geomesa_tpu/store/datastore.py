@@ -371,6 +371,9 @@ class TpuDataStore:
                 ):
                     z3_keys = (blk.key, blk.bins)
             self.stats.observe_columns(ft, columns, z3_keys=z3_keys)
+        # cold-column spill LAST: every index table and the stats observer
+        # has read its columns; nothing refaults what fadvise just dropped
+        record.spill()
 
     def delete_features(self, name: str, fids: Sequence[str]):
         for table in self._tables[name].values():
@@ -392,6 +395,7 @@ class TpuDataStore:
         record = full.merged_record()
         for table in tables.values():
             table.compact(record)
+        record.spill()  # after every table's rebuild read its columns
 
     def count(self, name: str, query: Union[str, "Query", None] = None, exact: bool = True) -> int:
         """Feature count; with a filter, ``exact=False`` answers from stats

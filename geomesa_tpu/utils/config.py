@@ -125,3 +125,10 @@ class SystemProperty:
 SCAN_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.target", "512")
 QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", None)
 FEATURE_EXPIRY = SystemProperty("geomesa.feature.expiry", None)
+# Cold-column spill: when set, record-table columns larger than the
+# threshold are written to .npy files under this directory and re-opened
+# memory-mapped, so wide schemas at large N stay bounded by the page
+# cache instead of the heap (the reference's analog: full features live
+# in the backing KV store, not in client memory). Off by default.
+SPILL_DIR = SystemProperty("geomesa.spill.dir", None)
+SPILL_MIN_BYTES = SystemProperty("geomesa.spill.min.bytes", "4MB")

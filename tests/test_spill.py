@@ -1,0 +1,140 @@
+"""Cold-column spill (geomesa.spill.dir): record-table columns past the
+threshold move to mmap-backed .npy files; every read path (lazy results,
+filters, sorts, compaction, exports) must behave identically, and files
+must be reclaimed when blocks are garbage-collected."""
+
+import gc
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils.config import properties
+
+SPEC = "name:String,tag:String,age:Int,score:Double,dtg:Date,*geom:Point:srid=4326"
+BASE = int(np.datetime64("2026-02-01T00:00:00", "ms").astype("int64"))
+
+
+def _rows(n, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        [
+            f"actor-{int(rng.integers(0, 40))}",
+            None if i % 17 == 0 else f"t{int(rng.integers(0, 5))}",
+            int(rng.integers(0, 99)),
+            float(rng.normal()),
+            int(BASE + int(rng.integers(0, 20 * 86400_000))),
+            Point(float(rng.uniform(-60, 60)), float(rng.uniform(-60, 60))),
+        ]
+        for i in range(n)
+    ]
+
+
+def _fill(store, rows):
+    store.create_schema(parse_spec("t", SPEC))
+    with store.writer("t") as w:
+        for i, r in enumerate(rows):
+            w.write(list(r), fid=f"f{i}")
+
+
+QUERIES = [
+    "bbox(geom, -20, -15, 25, 30)",
+    "bbox(geom, -20, -15, 25, 30) AND dtg DURING 2026-02-03T00:00:00Z/2026-02-12T00:00:00Z",
+    "name = 'actor-7'",
+    "age > 80 AND bbox(geom, -50, -50, 50, 50)",
+    "tag IS NULL",
+]
+
+
+def test_spill_parity_and_cleanup(tmp_path):
+    rows = _rows(4000)
+    plain = TpuDataStore()
+    _fill(plain, rows)
+    sd = str(tmp_path / "spill")
+    with properties(**{"geomesa.spill.dir": sd, "geomesa.spill.min.bytes": "1KB"}):
+        spilled = TpuDataStore()
+        _fill(spilled, rows)
+        files = glob.glob(os.path.join(sd, "*.npy"))
+        assert files, "spill produced no files"
+        for q in QUERIES:
+            a = sorted(map(str, spilled.query("t", q).fids))
+            b = sorted(map(str, plain.query("t", q).fids))
+            assert a == b, q
+        # attribute materialization through the rowid join reads mmaps
+        r = spilled.query("t", "bbox(geom, -20, -15, 25, 30)")
+        names = r.columns["name"]
+        assert len(names) == len(r.fids)
+        # deletes + compaction rebuild (merged record re-spills)
+        doomed = [f"f{i}" for i in range(0, 4000, 11)]
+        spilled.delete_features("t", doomed)
+        spilled.compact("t")
+    # plain compacts OUTSIDE the spill scope (the property is global: any
+    # store compacting inside it would spill its merged record too, and
+    # those files rightly live as long as that store does)
+    plain.delete_features("t", doomed)
+    plain.compact("t")
+    with properties(**{"geomesa.spill.dir": sd, "geomesa.spill.min.bytes": "1KB"}):
+        for q in QUERIES:
+            a = sorted(map(str, spilled.query("t", q).fids))
+            b = sorted(map(str, plain.query("t", q).fids))
+            assert a == b, ("post-compact", q)
+        # dropping the store reclaims every spill file
+        del spilled, r, names
+        gc.collect()
+        assert glob.glob(os.path.join(sd, "*.npy")) == []
+
+
+def test_spill_sort_and_export(tmp_path):
+    from geomesa_tpu.index.planner import Query
+
+    rows = _rows(1500, seed=9)
+    sd = str(tmp_path / "s2")
+    with properties(**{"geomesa.spill.dir": sd, "geomesa.spill.min.bytes": "1KB"}):
+        s = TpuDataStore()
+        _fill(s, rows)
+        assert glob.glob(os.path.join(sd, "*.npy"))
+        r = s.query("t", Query.cql(
+            "bbox(geom, -60, -60, 60, 60)", sort_by=[("age", False)], max_features=25
+        ))
+        ages = np.asarray(r.columns["age"])
+        assert len(ages) == 25 and all(ages[:-1] >= ages[1:])
+
+
+def test_spill_off_by_default(monkeypatch):
+    from geomesa_tpu.store.blocks import RecordBlock
+    from geomesa_tpu.utils.config import SPILL_DIR
+
+    monkeypatch.delenv("GEOMESA_SPILL_DIR", raising=False)
+    assert SPILL_DIR.get() is None  # no default directory
+    s = TpuDataStore()
+    _fill(s, _rows(500, seed=1))
+    # no record column anywhere became a memmap
+    for table in s._tables["t"].values():
+        for b in table.blocks:
+            rec = getattr(b, "record", None)
+            if rec is not None:
+                assert not any(
+                    isinstance(v, np.memmap) for v in rec.columns.values()
+                )
+
+
+def test_stale_spill_files_swept(tmp_path):
+    from geomesa_tpu.store.blocks import _SWEPT_SPILL_DIRS
+
+    sd = tmp_path / "sweep"
+    sd.mkdir()
+    # a file from a provably dead pid, and a non-spill bystander
+    dead = sd / "rb-999999999-deadbeef-0-name.npy"
+    dead.write_bytes(b"x")
+    keep = sd / "unrelated.npy"
+    keep.write_bytes(b"y")
+    _SWEPT_SPILL_DIRS.discard(str(sd))
+    with properties(**{"geomesa.spill.dir": str(sd), "geomesa.spill.min.bytes": "1KB"}):
+        s = TpuDataStore()
+        _fill(s, _rows(1200, seed=2))
+    assert not dead.exists(), "stale dead-pid spill file not swept"
+    assert keep.exists()
