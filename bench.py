@@ -481,10 +481,10 @@ def run(n: int, reps: int, backend: str) -> dict:
             # fresh jit compile — which must land here, not in the timing
             t0 = time.perf_counter()
             prev_rcaps = None
-            for _ in range(3):
+            for _ in range(4):
                 store.query_many("gdelt", queries)
                 rcaps = {
-                    id(s): s._rcap
+                    id(s): (s._rcap, s._sum_cap)
                     for d in getattr(store.executor, "_cache", {}).values()
                     for s in d[1].segments
                 }

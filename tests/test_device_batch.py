@@ -79,17 +79,23 @@ def test_batched_query_many_parity_time():
     rng = np.random.default_rng(3)
     cqls = _cqls(rng, 12, with_time=True)
     calls = {"batch": 0}
-    orig = ex._exact_runs_batch_fn
+    orig_runs, orig_packed = ex._exact_runs_batch_fn, ex._exact_packed_batch_fn
 
-    def counting(*a, **k):
+    def counting_runs(*a, **k):
         calls["batch"] += 1
-        return orig(*a, **k)
+        return orig_runs(*a, **k)
 
-    ex._exact_runs_batch_fn, saved = counting, orig
+    def counting_packed(*a, **k):
+        calls["batch"] += 1
+        return orig_packed(*a, **k)
+
+    ex._exact_runs_batch_fn = counting_runs
+    ex._exact_packed_batch_fn = counting_packed
     try:
         got = tpu.query_many("t", cqls)
     finally:
-        ex._exact_runs_batch_fn = saved
+        ex._exact_runs_batch_fn = orig_runs
+        ex._exact_packed_batch_fn = orig_packed
     assert calls["batch"] >= 1  # the fused path ran
     for cql, res in zip(cqls, got):
         assert _fids(res) == _fids(host.query("t", cql)), cql

@@ -1,0 +1,220 @@
+"""Delta-packed sum-layout batch transfer (_exact_packed_batch_fn).
+
+The packed path halves per-run bytes and sizes the shared buffer by the
+stream's actual total runs; these tests pin down the encoding edge cases:
+16-bit gap/length overflows spilling into the exception table, shared-
+capacity overflow falling back to single-query refetches, capacity
+learning, and bit-identical results vs the unpacked batch layout.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.parallel import executor as ex
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+
+SPEC = "dtg:Date,*geom:Point:srid=4326"
+BASE = int(np.datetime64("2026-01-01T00:00:00", "ms").astype("int64"))
+
+
+@pytest.fixture(autouse=True)
+def _force_batch(monkeypatch):
+    monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "1")
+    monkeypatch.setenv("GEOMESA_DEVBATCH", "1")
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+
+
+def _stores(x, y, t):
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    for s in (host, tpu):
+        s.create_schema(parse_spec("t", SPEC))
+        with s.writer("t") as w:
+            for i in range(len(x)):
+                w.write([int(t[i]), Point(float(x[i]), float(y[i]))], fid=f"f{i}")
+    return host, tpu
+
+
+def _fids(res):
+    return sorted(res.fids)
+
+
+def _parity(host, tpu, cqls):
+    got = tpu.query_many("t", cqls)
+    for cql, res in zip(cqls, got):
+        assert _fids(res) == _fids(host.query("t", cql)), cql
+
+
+def _decode_roundtrip(starts, lens, n):
+    """Host-side reference for the wire format: encode (gap,len) words the
+    way _packed_step does, decode with _decode_packed_query."""
+    starts = np.asarray(starts, np.int64)
+    lens = np.asarray(lens, np.int64)
+    prev_end = np.concatenate([[0], (starts + lens)[:-1]])
+    gaps = starts - prev_end
+    words = (((gaps & 0xFFFF) << 16) | (lens & 0xFFFF)).astype(np.uint32)
+    over = np.flatnonzero((gaps > 0xFFFF) | (lens > 0xFFFF))
+    header = np.zeros(3 + 3 * ex.PACK_XCAP, np.int64)
+    header[0] = lens.sum()
+    header[1] = len(starts)
+    header[2] = len(over)
+    header[3 : 3 + len(over)] = over
+    header[3 + ex.PACK_XCAP : 3 + ex.PACK_XCAP + len(over)] = gaps[over] >> 16
+    header[3 + 2 * ex.PACK_XCAP : 3 + 2 * ex.PACK_XCAP + len(over)] = lens[over] >> 16
+    s2, l2 = ex._decode_packed_query(words.view(np.int32), header, len(over))
+    np.testing.assert_array_equal(s2, starts)
+    np.testing.assert_array_equal(l2, lens)
+
+
+def test_wire_format_roundtrip():
+    rng = np.random.default_rng(0)
+    # mixed small/large gaps and lens, including >16-bit values; runs are
+    # disjoint by construction (gap >= 0 between consecutive runs)
+    gaps = rng.integers(0, 200_000, 50)
+    lens = rng.integers(1, 90_000, 50)
+    starts = np.cumsum(gaps) + np.concatenate([[0], np.cumsum(lens)[:-1]])
+    _decode_roundtrip(starts, lens, int(starts[-1] + lens[-1]))
+
+
+def test_exception_table_gap_overflow():
+    """Two hit clusters separated by far more than 65535 rows: the gap
+    between them (and the leading gap) must spill into exceptions."""
+    n = 300_000
+    rng = np.random.default_rng(1)
+    # cluster A near (10,10), cluster B near (50,50), background elsewhere
+    x = rng.uniform(-170, -60, n)
+    y = rng.uniform(-80, -10, n)
+    x[1000:2000] = rng.uniform(10, 11, 1000)
+    y[1000:2000] = rng.uniform(10, 11, 1000)
+    x[250_000:251_000] = rng.uniform(50, 51, 1000)
+    y[250_000:251_000] = rng.uniform(50, 51, 1000)
+    t = BASE + rng.integers(0, 86400_000, n)
+    host, tpu = _stores(x, y, t)
+    # one box covering BOTH clusters -> z-sorted hits form two groups with
+    # a multi-hundred-thousand-row empty stretch between them
+    cqls = [
+        "bbox(geom, 5, 5, 55, 55)",
+        "bbox(geom, 9, 9, 12, 12)",
+        "bbox(geom, 49, 49, 52, 52)",
+        "bbox(geom, -100, -50, -80, -30)",
+    ]
+    _parity(host, tpu, cqls)
+
+
+def test_length_overflow_long_run():
+    """>65535 consecutive hit rows in z-order: one run whose length needs
+    the exception table's high bits."""
+    n = 120_000
+    rng = np.random.default_rng(2)
+    # 80k rows jammed into a tiny cell -> contiguous in z-order
+    x = np.concatenate([rng.uniform(20.0, 20.001, 80_000), rng.uniform(-170, -60, n - 80_000)])
+    y = np.concatenate([rng.uniform(30.0, 30.001, 80_000), rng.uniform(-80, -10, n - 80_000)])
+    t = BASE + rng.integers(0, 86400_000, n)
+    host, tpu = _stores(x, y, t)
+    _parity(host, tpu, ["bbox(geom, 19, 29, 21, 31)", "bbox(geom, -100, -50, -80, -30)",
+                        "bbox(geom, 0, 0, 40, 40)", "bbox(geom, -180, -90, 180, 90)"])
+
+
+def test_sum_capacity_overflow_falls_back():
+    rng = np.random.default_rng(3)
+    n = 4000
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-60, 60, n)
+    t = BASE + rng.integers(0, 86400_000, n)
+    host, tpu = _stores(x, y, t)
+    cqls = [f"bbox(geom, {x0}, {y0}, {x0+30}, {y0+30})"
+            for x0, y0 in [(-50, -50), (-20, -20), (0, 0), (10, 10), (-40, 0)]]
+    tpu.query_many("t", cqls)  # build mirror
+    table = tpu._tables["t"]["z2"]
+    dev = tpu.executor.device_index(table)
+    for seg in dev.segments:
+        seg._sum_cap = 8  # every query's region overflows the shared buffer
+    _parity(host, tpu, cqls)
+    # learning must have grown the capacity back out of the crushed value
+    assert all(s._sum_cap > 8 for s in dev.segments)
+
+
+def test_xcap_overflow_falls_back(monkeypatch):
+    """More >16-bit entries than the exception table holds: per-query
+    fallback (forced by crushing PACK_XCAP)."""
+    monkeypatch.setattr(ex, "PACK_XCAP", 1)
+    ex._EXACT_PACKED_BATCH_FNS.clear()  # cached fns baked the old constant
+    try:
+        rng = np.random.default_rng(4)
+        n = 200_000
+        x = rng.uniform(-170, 170, n)
+        y = rng.uniform(-80, 80, n)
+        t = BASE + rng.integers(0, 86400_000, n)
+        host, tpu = _stores(x, y, t)
+        cqls = [f"bbox(geom, {x0}, -60, {x0+40}, 60)" for x0 in (-170, -100, -30, 40, 110)]
+        _parity(host, tpu, cqls)
+    finally:
+        ex._EXACT_PACKED_BATCH_FNS.clear()
+
+
+def test_packed_matches_unpacked_exactly(monkeypatch):
+    rng = np.random.default_rng(5)
+    n = 30_000
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-60, 60, n)
+    t = BASE + rng.integers(0, 20 * 86400_000, n)
+    _, tpu_a = _stores(x, y, t)
+    cqls = []
+    for _ in range(9):
+        x0 = float(rng.uniform(-55, 20))
+        y0 = float(rng.uniform(-55, 20))
+        d0 = int(rng.integers(1, 12))
+        cqls.append(
+            f"bbox(geom, {x0}, {y0}, {x0 + 25}, {y0 + 25}) AND "
+            f"dtg DURING 2026-01-{d0:02d}T00:00:00Z/2026-01-{d0 + 7:02d}T00:00:00Z"
+        )
+    got_packed = [_fids(r) for r in tpu_a.query_many("t", cqls)]
+    monkeypatch.setenv("GEOMESA_BATCH_PACK", "0")
+    _, tpu_b = _stores(x, y, t)
+    got_unpacked = [_fids(r) for r in tpu_b.query_many("t", cqls)]
+    assert got_packed == got_unpacked
+
+
+def test_decay_steps_once_per_stream():
+    """The gentle-decay hysteresis must apply once per batch, not once per
+    query: a small stream after a big one halves _sum_cap at most once."""
+    rng = np.random.default_rng(7)
+    n = 8000
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-60, 60, n)
+    t = BASE + rng.integers(0, 86400_000, n)
+    _, tpu = _stores(x, y, t)
+    cqls = [f"bbox(geom, {x0}, {y0}, {x0+15}, {y0+15})"
+            for x0, y0 in [(-50, -50), (-20, -20), (0, 0), (10, 10), (-40, 0), (20, -30)]]
+    tpu.query_many("t", cqls)  # build mirror + learn real caps
+    table = tpu._tables["t"]["z2"]
+    dev = tpu.executor.device_index(table)
+    big = ex.SUM_CAP0 * 64
+    for seg in dev.segments:
+        seg._sum_cap = big
+    tpu.query_many("t", cqls)
+    for seg in dev.segments:
+        assert seg._sum_cap == big // 2, seg._sum_cap
+
+
+def test_entry_total_learning():
+    rng = np.random.default_rng(6)
+    n = 20_000
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-60, 60, n)
+    t = BASE + rng.integers(0, 86400_000, n)
+    _, tpu = _stores(x, y, t)
+    cqls = [f"bbox(geom, {x0}, {y0}, {x0+20}, {y0+20})"
+            for x0, y0 in [(-50, -50), (-20, -20), (0, 0), (20, 20)]]
+    tpu.query_many("t", cqls)
+    table = tpu._tables["t"]["z2"]
+    dev = tpu.executor.device_index(table)
+    # capacities stay pow2-bucketed and within sane bounds: this stream's
+    # total entries is tiny (<< SUM_CAP0), so learning must keep the
+    # floor-bucket capacity, not grow it
+    for seg in dev.segments:
+        assert seg._sum_cap & (seg._sum_cap - 1) == 0
+        assert seg._sum_cap == ex.SUM_CAP0
