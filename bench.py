@@ -484,7 +484,7 @@ def run(n: int, reps: int, backend: str) -> dict:
             for _ in range(4):
                 store.query_many("gdelt", queries)
                 rcaps = {
-                    id(s): (s._rcap, s._sum_cap)
+                    id(s): (s._rcap, s._sum_cap, s._span_cap)
                     for d in getattr(store.executor, "_cache", {}).values()
                     for s in d[1].segments
                 }

@@ -1,0 +1,133 @@
+"""One-shot primitive timings on the real chip (run under the axon flock).
+
+Measures the building blocks the batched-scan protocols choose between,
+at headline scale (20M rows), so protocol decisions ride measurements
+instead of guesses:
+
+  mask         streaming exact limb mask (the lower bound)
+  nonzero      size-bounded jnp.nonzero at rcap=131072 (runs extraction)
+  sort         lax.sort of 20M i32 (sort-based compaction alternative)
+  argmax       first-hit reduction (bitmap span framing)
+  packbits     bitmap pack (bitmap protocol device side)
+  cumsum       prefix sum (scatter-compaction alternative)
+  d2h_4m/h2d_4m  link bandwidth on a 4 MB buffer
+  exec_floor   empty-ish execution round trip
+  batch_*      end-to-end _exact_{runs,packed,bitmap}_batch_fn, q=20
+
+Writes HW_PRIMS.json at the repo root and prints one JSON line.
+Timings are medians of 3 after a warmup run; each fn is jitted first.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N = int(os.environ.get("HW_PROBE_N", 20_000_000))
+Q = 20
+RCAP = 131072
+
+
+def median3(f):
+    f()  # warm (compile + first run)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[1]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    dev = jax.devices()[0].platform
+    out = {"backend": backend, "platform": dev, "n": N}
+
+    rng = np.random.default_rng(0)
+    m_host = rng.random(N) < 0.05
+    m = jax.device_put(m_host)
+    x = jax.device_put(rng.integers(0, 2**31, N).astype(np.int32))
+
+    cmp_fn = jax.jit(lambda a: (a < 12345).sum())
+    out["mask_ms"] = median3(lambda: cmp_fn(x).block_until_ready()) * 1e3
+
+    nz = jax.jit(lambda a: jnp.nonzero(a, size=RCAP, fill_value=N)[0])
+    out["nonzero_ms"] = median3(lambda: nz(m).block_until_ready()) * 1e3
+
+    srt = jax.jit(lambda a: jax.lax.sort(a))
+    out["sort_ms"] = median3(lambda: srt(x).block_until_ready()) * 1e3
+
+    am = jax.jit(lambda a: jnp.argmax(a))
+    out["argmax_ms"] = median3(lambda: am(m).block_until_ready()) * 1e3
+
+    pb = jax.jit(lambda a: jnp.packbits(a))
+    out["packbits_ms"] = median3(lambda: pb(m).block_until_ready()) * 1e3
+
+    cs = jax.jit(lambda a: jnp.cumsum(a.astype(jnp.int32)))
+    out["cumsum_ms"] = median3(lambda: cs(m).block_until_ready()) * 1e3
+
+    big = jax.device_put(np.zeros(1 << 20, np.int32))  # 4 MB
+    idn = jax.jit(lambda a: a + 1)
+    idn(big).block_until_ready()
+    # fresh output per call: jax.Array caches its host value after the
+    # first np.asarray, which would turn repeats into cache hits
+    out["d2h_4m_ms"] = median3(lambda: np.asarray(idn(big))) * 1e3
+    host4 = np.zeros(1 << 20, np.int32)
+    out["h2d_4m_ms"] = median3(
+        lambda: jax.device_put(host4).block_until_ready()
+    ) * 1e3
+    tiny = jax.device_put(np.zeros(8, np.int32))
+    out["exec_floor_ms"] = median3(
+        lambda: np.asarray(idn(tiny))
+    ) * 1e3
+
+    # end-to-end batch kernels on a realistic z3 segment
+    from geomesa_tpu.parallel import executor as ex
+    from geomesa_tpu.parallel.mesh import default_mesh, replicate
+
+    mesh = default_mesh()
+    mode = "spmd" if ex._mask_mode(mesh) == "pallas_spmd" else "local"
+
+    def limb(hi):
+        return jax.device_put(
+            rng.integers(0, 2**31, N).astype(np.uint32)
+        )
+
+    xh, xl, yh, yl = limb(1), limb(0), limb(1), limb(0)
+    valid = jax.device_put(np.ones(N, bool))
+    boxes = replicate(mesh, rng.integers(0, 2**31, (Q, 8)).astype(np.uint32))
+
+    runs_fn = ex._exact_runs_batch_fn(False, RCAP, Q, mode, mesh)
+    out["batch_runs_ms"] = median3(
+        lambda: np.asarray(runs_fn(xh, xl, yh, yl, valid, boxes))
+    ) * 1e3
+
+    packed_fn = ex._exact_packed_batch_fn(False, RCAP, 1 << 20, Q, mode, mesh)
+    out["batch_packed_ms"] = median3(
+        lambda: np.asarray(packed_fn(xh, xl, yh, yl, valid, boxes))
+    ) * 1e3
+
+    span = 1 << 23  # 8M-row window (1 MB bitmap/query)
+    bm_fn = ex._exact_bitmap_batch_fn(False, min(span, N - N % 8), Q, mode, mesh)
+    def run_bm():
+        h, b = bm_fn(xh, xl, yh, yl, valid, boxes)
+        np.asarray(h)
+        np.asarray(b)
+    out["batch_bitmap_ms"] = median3(run_bm) * 1e3
+
+    path = os.path.join(REPO, "HW_PRIMS.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -129,13 +129,15 @@ def record_hw(results) -> None:
     with open(path, "w") as f:
         json.dump(payload, f, indent=1)
     log(f"wrote {path}")
+    extra = [p for p in ("HW_PRIMS.json",)
+             if os.path.exists(os.path.join(REPO, p))]
     for attempt in range(6):
         try:
-            subprocess.run(["git", "add", "BENCH_hw.json"], cwd=REPO,
+            subprocess.run(["git", "add", "BENCH_hw.json", *extra], cwd=REPO,
                            capture_output=True, timeout=60)
             p = subprocess.run(
                 ["git", "commit", "-m", "Record hardware bench results (tpu_watch)",
-                 "--", "BENCH_hw.json"],
+                 "--", "BENCH_hw.json", *extra],
                 cwd=REPO, capture_output=True, text=True, timeout=60,
             )
             if p.returncode == 0 or "nothing to commit" in p.stdout + p.stderr:
@@ -156,6 +158,10 @@ def batch() -> None:
                  "GEOMESA_AXON_LOCK_HELD": "1",
                  "GEOMESA_BENCH_POLL": "0"}
     results = []
+    # primitive timings first (fast, ~3-5 min): protocol choices ride these
+    r = run([sys.executable, "scripts/hw_probe.py"], claim_env, timeout_s=900)
+    if r is not None:
+        results.append({"name": "primitives", **r})
     r = run([sys.executable, "bench.py"], claim_env, timeout_s=3000)
     if r is not None:
         results.append({"name": "headline", **r})

@@ -218,3 +218,66 @@ def test_entry_total_learning():
     for seg in dev.segments:
         assert seg._sum_cap & (seg._sum_cap - 1) == 0
         assert seg._sum_cap == ex.SUM_CAP0
+
+
+# ---------------------------------------------------------------------------
+# bitmap protocol (_exact_bitmap_batch_fn): the accelerator-side transfer
+# that avoids device compaction entirely (span-framed bitmaps, host RLE)
+# ---------------------------------------------------------------------------
+
+
+def test_bitmap_protocol_parity(monkeypatch):
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", "bitmap")
+    rng = np.random.default_rng(8)
+    n = 60_000
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-60, 60, n)
+    t = BASE + rng.integers(0, 20 * 86400_000, n)
+    host, tpu = _stores(x, y, t)
+    cqls = []
+    for _ in range(10):
+        x0 = float(rng.uniform(-55, 20))
+        y0 = float(rng.uniform(-55, 20))
+        c = f"bbox(geom, {x0}, {y0}, {x0 + 25}, {y0 + 25})"
+        if rng.integers(0, 2):
+            d0 = int(rng.integers(1, 12))
+            c += (f" AND dtg DURING 2026-01-{d0:02d}T00:00:00Z"
+                  f"/2026-01-{d0 + 7:02d}T00:00:00Z")
+        cqls.append(c)
+    _parity(host, tpu, cqls)
+    _parity(host, tpu, cqls)  # second stream rides the learned span window
+
+
+def test_bitmap_span_overflow_falls_back(monkeypatch):
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", "bitmap")
+    rng = np.random.default_rng(9)
+    n = 500_000
+    x = rng.uniform(-170, 170, n)
+    y = rng.uniform(-80, 80, n)
+    t = BASE + rng.integers(0, 86400_000, n)
+    host, tpu = _stores(x, y, t)
+    cqls = [f"bbox(geom, {x0}, -70, {x0+60}, 70)" for x0 in (-160, -80, 0, 80)]
+    tpu.query_many("t", cqls)  # build mirror
+    table = tpu._tables["t"]["z2"]
+    dev = tpu.executor.device_index(table)
+    for seg in dev.segments:
+        seg._span_cap = 1 << 16  # far narrower than these wide queries
+    _parity(host, tpu, cqls)
+    # learning must widen the window back out after seeing the true spans
+    assert all(s.span_cap() > (1 << 16) for s in dev.segments)
+
+
+def test_bitmap_matches_runs_protocols(monkeypatch):
+    rng = np.random.default_rng(10)
+    n = 40_000
+    x = rng.uniform(-60, 60, n)
+    y = rng.uniform(-60, 60, n)
+    t = BASE + rng.integers(0, 10 * 86400_000, n)
+    cqls = [f"bbox(geom, {x0}, {y0}, {x0+20}, {y0+20})"
+            for x0, y0 in [(-50, -50), (-15, -15), (5, 5), (15, -30), (-40, 10)]]
+    got = {}
+    for proto in ("bitmap", "runs_packed", "runs"):
+        monkeypatch.setenv("GEOMESA_BATCH_PROTO", proto)
+        _, tpu = _stores(x, y, t)
+        got[proto] = [_fids(r) for r in tpu.query_many("t", cqls)]
+    assert got["bitmap"] == got["runs_packed"] == got["runs"]

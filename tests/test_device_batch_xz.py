@@ -144,3 +144,36 @@ def test_xz_batch_respects_deletes():
     for cql, res in zip(cqls, got):
         assert _fids(res) == _fids(host.query("e", cql)), cql
         assert not set(map(str, res.fids)) & set(doomed)
+
+
+def test_xz_bitmap_protocol_parity(monkeypatch):
+    """The span-framed dual-bitmap wire format (GEOMESA_BATCH_PROTO=bitmap)
+    must produce identical results, including the ring rows that take the
+    host's per-geometry test, across two streams (second rides the learned
+    span window)."""
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", "bitmap")
+    host, tpu = _pair(seed=41)
+    rng = np.random.default_rng(6)
+    cqls = _queries(rng, 5, time_frac=0.0, poly_frac=0.5) + _queries(rng, 4, time_frac=1.0)
+    for _ in range(2):
+        got = tpu.query_many("e", cqls)
+        for cql, res in zip(cqls, got):
+            assert _fids(res) == _fids(host.query("e", cql)), cql
+
+
+def test_xz_bitmap_span_overflow_falls_back(monkeypatch):
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", "bitmap")
+    host, tpu = _pair(seed=43)
+    rng = np.random.default_rng(7)
+    cqls = _queries(rng, 5, time_frac=0.3)
+    tpu.query_many("e", cqls)  # build mirror
+    for fam in ("xz2", "xz3"):
+        table = tpu._tables["e"].get(fam)
+        if table is None:
+            continue
+        dev = tpu.executor.device_index(table)
+        for seg in dev.segments:
+            seg._span_cap = 8  # comically narrow: every query overflows
+    got = tpu.query_many("e", cqls)
+    for cql, res in zip(cqls, got):
+        assert _fids(res) == _fids(host.query("e", cql)), cql
