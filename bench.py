@@ -605,6 +605,12 @@ def poll_for_tpu_retry(payload, t_start, deadline):
 
 
 def main():
+    try:
+        from geomesa_tpu.utils.malloc import retain_arenas
+
+        retain_arenas()  # page re-faulting throttles large-N ingest otherwise
+    except Exception:  # noqa: BLE001
+        pass
     smoke = os.environ.get("GEOMESA_BENCH_SMOKE", "") not in ("", "0")
     n = int(os.environ.get("GEOMESA_BENCH_N", 0))
     reps = int(os.environ.get("GEOMESA_BENCH_REPS", 3 if smoke else 20))

@@ -500,6 +500,9 @@ def bulk_ingest(
 ) -> EvaluationContext:
     """Convert ``paths`` in parallel worker processes and append the
     resulting columnar batches through the (single-writer) store."""
+    from geomesa_tpu.utils.malloc import retain_arenas
+
+    retain_arenas()  # batch churn re-faults pages otherwise (utils/malloc.py)
     ec = ec if ec is not None else EvaluationContext()
     ft = store.get_schema(name)
     spec = ft.spec()
@@ -521,6 +524,14 @@ def bulk_ingest(
     else:
         from concurrent.futures import ProcessPoolExecutor
 
-        with ProcessPoolExecutor(max_workers=workers) as pool:
+        with ProcessPoolExecutor(
+            max_workers=workers, initializer=_worker_init
+        ) as pool:
             drain(pool.map(_convert_one, jobs))
     return ec
+
+
+def _worker_init():
+    from geomesa_tpu.utils.malloc import retain_arenas
+
+    retain_arenas()

@@ -43,10 +43,15 @@ MODES = [
     {"GEOMESA_SEEK": "auto", "GEOMESA_EXACT_DEVICE": "1"},
     # batched exact device scans (query_many fuses exact-shape plans)
     {"GEOMESA_SEEK": "0", "GEOMESA_EXACT_DEVICE": "1", "GEOMESA_DEVBATCH": "1"},
+    # the accelerator wire formats, forced on the CPU parity mesh
+    {"GEOMESA_SEEK": "0", "GEOMESA_EXACT_DEVICE": "1", "GEOMESA_DEVBATCH": "1",
+     "GEOMESA_BATCH_PROTO": "bitmap"},
+    {"GEOMESA_SEEK": "0", "GEOMESA_EXACT_DEVICE": "1", "GEOMESA_DEVBATCH": "1",
+     "GEOMESA_BATCH_PROTO": "runs"},
 ]
 _MODE_KEYS = (
     "GEOMESA_SEEK", "GEOMESA_TPU_NO_NATIVE", "GEOMESA_DEVSEEK",
-    "GEOMESA_EXACT_DEVICE", "GEOMESA_DEVBATCH",
+    "GEOMESA_EXACT_DEVICE", "GEOMESA_DEVBATCH", "GEOMESA_BATCH_PROTO",
 )
 
 
