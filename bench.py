@@ -606,9 +606,9 @@ def poll_for_tpu_retry(payload, t_start, deadline):
 
 def main():
     try:
-        from geomesa_tpu.utils.malloc import retain_arenas
+        from geomesa_tpu.utils.malloc import retain_freed_memory
 
-        retain_arenas()  # page re-faulting throttles large-N ingest otherwise
+        retain_freed_memory()  # page re-faulting throttles large-N ingest otherwise
     except Exception:  # noqa: BLE001
         pass
     smoke = os.environ.get("GEOMESA_BENCH_SMOKE", "") not in ("", "0")

@@ -500,9 +500,9 @@ def bulk_ingest(
 ) -> EvaluationContext:
     """Convert ``paths`` in parallel worker processes and append the
     resulting columnar batches through the (single-writer) store."""
-    from geomesa_tpu.utils.malloc import retain_arenas
+    from geomesa_tpu.utils.malloc import retain_freed_memory
 
-    retain_arenas()  # batch churn re-faults pages otherwise (utils/malloc.py)
+    retain_freed_memory()  # batch churn re-faults pages otherwise (utils/malloc.py)
     ec = ec if ec is not None else EvaluationContext()
     ft = store.get_schema(name)
     spec = ft.spec()
@@ -532,6 +532,6 @@ def bulk_ingest(
 
 
 def _worker_init():
-    from geomesa_tpu.utils.malloc import retain_arenas
+    from geomesa_tpu.utils.malloc import retain_freed_memory
 
-    retain_arenas()
+    retain_freed_memory()

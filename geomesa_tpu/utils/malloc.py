@@ -28,14 +28,17 @@ _M_TRIM_THRESHOLD = -1
 _M_MMAP_THRESHOLD = -3
 
 
-def retain_arenas() -> bool:
-    """Keep freed glibc arenas in-process (idempotent). True on success."""
+def retain_freed_memory() -> bool:
+    """Keep freed memory in-process via glibc heap-trim/mmap thresholds
+    (NOT arena management — M_ARENA_MAX is untouched). Idempotent: the
+    mallopt pair is applied at most once per process and cannot be undone,
+    so GEOMESA_MALLOC_RETAIN=0 only has effect if set before the first
+    call. Returns True when the thresholds were (or already are) set."""
     global _done
+    if os.environ.get("GEOMESA_MALLOC_RETAIN", "1") == "0" and _done is None:
+        return False
     if _done is not None:
         return _done
-    if os.environ.get("GEOMESA_MALLOC_RETAIN", "1") == "0":
-        _done = False
-        return False
     try:
         libc = ctypes.CDLL("libc.so.6", use_errno=True)
         ok = bool(libc.mallopt(_M_TRIM_THRESHOLD, 2**31 - 1))
@@ -44,3 +47,4 @@ def retain_arenas() -> bool:
     except Exception:  # noqa: BLE001 - non-glibc platforms: no-op
         _done = False
     return _done
+
