@@ -821,6 +821,183 @@ class _PendingXZBitmapHits:
         return start + np.flatnonzero(hit), start + np.flatnonzero(dec)
 
 
+# banded polygon ray cast: rows within EPS of a ring vertex's latitude, or
+# within XINT_K*EPS of a computed edge crossing, are BAND rows (device
+# cannot certify them in f32) and take the host's exact test; everything
+# else is decided on device. EPS covers f32 coordinate rounding (ulp at
+# |lon|<=180 is ~1.5e-5) plus crossing arithmetic error with wide margin.
+POLY_EPS = 1e-4
+POLY_XINT_K = 16.0
+
+
+def _poly_mask_body(has_time: bool, mode: str, mesh):
+    """Unjitted banded point-in-polygon mask: (hit, decided) over ALL rows.
+
+    The device analog of the host's exact geometry post-filter for
+    point-schema INTERSECTS(polygon) queries (role of the tserver-side
+    filter push-down, accumulo/iterators/FilterTransformIterator.scala):
+    exact envelope bound via sort-key limb compares, then an f32 ray cast
+    over the polygon's edges (lax.scan; streaming, no gathers). Crossing
+    parity decides in/out; rows inside the error band stay hit-but-
+    undecided and the host certifies them — identical results to the host
+    path by construction, device work O(N * edges) streaming."""
+    from geomesa_tpu.ops.filters import exact_st_mask
+
+    def core(xh, xl, yh, yl, th, tl, valid, xf, yf, edges, box, win):
+        if has_time:
+            env = exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
+        else:
+            env = exact_st_mask(xh, xl, yh, yl, valid, box)
+        eps = jnp.float32(POLY_EPS)
+        keps = jnp.float32(POLY_EPS * POLY_XINT_K)
+
+        def step(carry, e):
+            crossings, band = carry
+            x1, y1, x2, y2 = e[0], e[1], e[2], e[3]
+            degen = (x1 == x2) & (y1 == y2)
+            straddle = (y1 > yf) != (y2 > yf)
+            dy = jnp.where(y2 == y1, jnp.float32(1.0), y2 - y1)
+            xint = x1 + (yf - y1) / dy * (x2 - x1)
+            cross = straddle & (xf < xint) & ~degen
+            near = (jnp.abs(yf - y1) < eps) | (jnp.abs(yf - y2) < eps)
+            # xint's f32 error scales with the edge slope |dx|/|dy| (the
+            # y-side representation error is amplified through the
+            # interpolation), so the crossing band must widen with it;
+            # |dy| < eps edges are fully covered by the vertex strips
+            slope_tol = keps * (
+                jnp.float32(1.0)
+                + jnp.abs(x2 - x1) / jnp.maximum(jnp.abs(dy), eps)
+            )
+            nearx = straddle & (jnp.abs(xf - xint) < slope_tol)
+            band = band | ((near | nearx) & ~degen)
+            return (crossings + cross.astype(jnp.int32), band), None
+
+        (crossings, band), _ = jax.lax.scan(
+            step,
+            (jnp.zeros(xf.shape, jnp.int32), jnp.zeros(xf.shape, bool)),
+            edges,
+        )
+        odd = (crossings & 1) == 1
+        hit = env & (odd | band)
+        decided = hit & ~band
+        return hit, decided
+
+    if has_time:
+        def body(xh, xl, yh, yl, th, tl, valid, xf, yf, edges, box, win):
+            return core(xh, xl, yh, yl, th, tl, valid, xf, yf, edges, box, win)
+        nrow = 9
+    else:
+        # the dummy window rides along unused so every caller (single,
+        # batch, escalation refetch) shares ONE argument layout
+        def body(xh, xl, yh, yl, valid, xf, yf, edges, box, win):
+            return core(xh, xl, yh, yl, None, None, valid, xf, yf, edges, box, None)
+        nrow = 7
+    if mode != "spmd":
+        return body
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map_fn(
+        body,
+        mesh,
+        in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * 3),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check=False,
+    )
+
+
+_POLY_RUNS_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_POLY_RUNS_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_POLY_BITMAP_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_POLY_PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _poly_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
+    """Single polygon query -> dual fused RLE buffer (xz layout)."""
+    key = (has_time, rcap, mode, mesh if mode == "spmd" else None)
+    fn = _POLY_RUNS_FNS.get(key)
+    if fn is None:
+        mask = _poly_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            hit, decided = mask(*args)
+            return _xz_dual_runs(hit, decided, rcap)
+
+        fn = jax.jit(run)
+        _POLY_RUNS_FNS[key] = fn
+    return fn
+
+
+def _poly_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
+    """Q polygon queries in ONE execution -> [q, 2 x (2 + 2*rcap)]."""
+    key = (has_time, rcap, q, mode, mesh if mode == "spmd" else None)
+    fn = _POLY_RUNS_BATCH_FNS.get(key)
+    if fn is None:
+        mask = _poly_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            *cols, edges, boxes, wins = args
+
+            def step(carry, d):
+                hit, dec = mask(*cols, d[0], d[1], d[2])
+                return carry, _xz_dual_runs(hit, dec, rcap)
+
+            _, out = jax.lax.scan(step, 0, (edges, boxes, wins))
+            return out
+
+        fn = jax.jit(run)
+        _POLY_RUNS_BATCH_FNS[key] = fn
+    return fn
+
+
+def _poly_packed_fn(has_time: bool, mode: str, mesh):
+    """Dual full packed bitmaps (hit | decided) for one polygon query —
+    the dense-result degrade mirror of _xz_packed_fn."""
+    key = (has_time, mode, mesh if mode == "spmd" else None)
+    fn = _POLY_PACKED_FNS.get(key)
+    if fn is None:
+        mask = _poly_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            hit, dec = mask(*args)
+            return jnp.concatenate([jnp.packbits(hit), jnp.packbits(dec)])
+
+        fn = jax.jit(run)
+        _POLY_PACKED_FNS[key] = fn
+    return fn
+
+
+def _poly_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
+                          mesh):
+    """Polygon edition of _xz_bitmap_batch_fn: headers i32[q,4] +
+    bitmaps u8[q, 2*span_cap//8] (hit | decided planes)."""
+    key = (has_time, span_cap, q, mode, mesh if mode == "spmd" else None)
+    fn = _POLY_BITMAP_BATCH_FNS.get(key)
+    if fn is None:
+        mask = _poly_mask_body(has_time, mode, mesh)
+
+        def run(*args):
+            *cols, edges, boxes, wins = args
+
+            def step(carry, d):
+                hit, dec = mask(*cols, d[0], d[1], d[2])
+                n = hit.shape[0]
+                cnt = jnp.sum(hit.astype(jnp.int32))
+                lo = jnp.argmax(hit).astype(jnp.int32)
+                hi = (n - 1 - jnp.argmax(hit[::-1])).astype(jnp.int32)
+                start = jnp.clip((lo // 8) * 8, 0, n - span_cap)
+                hw = jax.lax.dynamic_slice(hit, (start,), (span_cap,))
+                dw = jax.lax.dynamic_slice(dec, (start,), (span_cap,))
+                bits = jnp.concatenate([jnp.packbits(hw), jnp.packbits(dw)])
+                return carry, (jnp.stack([cnt, lo, hi, start]), bits)
+
+            _, (headers, bitmaps) = jax.lax.scan(step, 0, (edges, boxes, wins))
+            return headers, bitmaps
+
+        fn = jax.jit(run)
+        _POLY_BITMAP_BATCH_FNS[key] = fn
+    return fn
+
+
 def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
     key = (has_time, rcap, mode, mesh if mode == "spmd" else None)
     fn = _XZ_RUNS_FNS.get(key)
@@ -1488,6 +1665,110 @@ class DeviceSegment:
                 )
         return out
 
+    def load_poly(self, table: IndexTable) -> bool:
+        """Exact limbs + f32 coords for the banded polygon path (point
+        z-indices only)."""
+        if self.kind not in ("z2", "z3"):
+            return False
+        if not self.load_exact(table):
+            return False
+        if self.xf is None:
+            # load_raw's bool gates the t_ms aggregation column; the poly
+            # path only needs the coords it packs unconditionally
+            self.load_raw(table)
+        return self.xf is not None
+
+    def _poly_args(self, edges_dev, box_dev, win_dev, has_time: bool) -> tuple:
+        """Polygon-scan argument layout (single + batch + refetch). A
+        dummy window rides along when has_time is False (ignored)."""
+        if has_time:
+            return (
+                self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo,
+                self.tk_hi, self.tk_lo, self.tvalid, self.xf, self.yf,
+                edges_dev, box_dev, win_dev,
+            )
+        return (
+            self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo, self.valid,
+            self.xf, self.yf, edges_dev, box_dev, win_dev,
+        )
+
+    def dispatch_poly_batch(
+        self, descs: Sequence[tuple], has_time: bool
+    ) -> list:
+        """Q banded polygon scans in ONE device execution (dual
+        hit/decided planes, xz resolve contract). ``descs`` =
+        [(edges f32[E,4], box u32[8], win u32[4]|None)]; edge counts pad
+        to the batch's shared pow2 bucket with degenerate zero edges."""
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        q = len(descs)
+        proto = _batch_proto()
+        bitmap = proto == "bitmap"
+        qpad = (q + 3) // 4 * 4 if bitmap else _pow2_at_least(q, 4)
+        ecap = _pow2_at_least(max(len(d[0]) for d in descs), 8)
+        padded = descs + [descs[-1]] * (qpad - q)
+
+        def pad_edges(e):
+            out = np.zeros((ecap, 4), np.float32)
+            out[: len(e)] = e
+            return out
+
+        edges_np = np.stack([pad_edges(d[0]) for d in padded])
+        boxes_np = np.stack([d[1] for d in padded])
+        wins_np = np.stack(
+            [d[2] if d[2] is not None else np.zeros(4, np.uint32) for d in padded]
+        )
+        args = self._poly_args(
+            replicate(self.mesh, edges_np),
+            replicate(self.mesh, boxes_np),
+            replicate(self.mesh, wins_np),
+            has_time,
+        )
+        rcap = self._rcap
+        if bitmap:
+            span_cap = self.span_cap()
+            hdr, bits = _poly_bitmap_batch_fn(
+                has_time, span_cap, qpad, mode, self.mesh
+            )(*args)
+            for b in (hdr, bits):
+                try:
+                    b.copy_to_host_async()
+                except Exception:  # pragma: no cover
+                    pass
+            batch = _BitmapBatch(hdr, bits, span_cap, seg=self)
+        else:
+            buf = _poly_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
+            try:
+                buf.copy_to_host_async()
+            except Exception:  # pragma: no cover
+                pass
+            batch = _BatchRows(buf)
+        out = []
+        for i, (edges, box_np, win_np) in enumerate(descs):
+            def single_args(edges=edges, box_np=box_np, win_np=win_np):
+                return self._poly_args(
+                    replicate(self.mesh, pad_edges(edges)),
+                    replicate(self.mesh, box_np),
+                    replicate(
+                        self.mesh,
+                        win_np if win_np is not None else np.zeros(4, np.uint32),
+                    ),
+                    has_time,
+                )
+
+            refetch = lambda rc, sa=single_args: _poly_runs_fn(  # noqa: E731
+                has_time, rc, mode, self.mesh
+            )(*sa())
+            packed = lambda sa=single_args: _poly_packed_fn(  # noqa: E731
+                has_time, mode, self.mesh
+            )(*sa())
+            if bitmap:
+                out.append(_PendingXZBitmapHits(self, batch, i, refetch, packed))
+            else:
+                out.append(
+                    _PendingXZHits(self, rcap, _BatchRow(batch, i), refetch, packed)
+                )
+        return out
+
     def _xz_args(self, qbox_dev, win_dev, has_time: bool) -> tuple:
         """Extent exact-scan argument layout (single + batch + refetch).
         Dummies stand in for the time columns when has_time is False (the
@@ -1669,7 +1950,21 @@ def _yield_xz_rows(seg, dec_rows: np.ndarray, ring: np.ndarray, node, geom):
 
     if len(ring):
         for block, local in seg.to_block_rows(np.sort(ring)):
-            geoms = block.gather(geom, local)
+            try:
+                geoms = block.gather(geom, local)
+            except KeyError:
+                # point schemas store coords columnar (geom__x/__y), not
+                # geometry objects — materialize Points for the (small)
+                # band only
+                from geomesa_tpu.geom.base import Point
+
+                xs = block.gather(geom + "__x", local)
+                ys = block.gather(geom + "__y", local)
+                nulls = block.gather(geom + "__null", local)
+                geoms = [
+                    None if nl else Point(float(x), float(y))
+                    for x, y, nl in zip(xs, ys, nulls)
+                ]
             m = np.fromiter(
                 (g is not None and _geom_predicate(node, g) for g in geoms),
                 bool,
@@ -2596,41 +2891,16 @@ class TpuScanExecutor:
 
         ft = table.ft
         geom = ft.default_geometry.name
-        dtg = ft.default_date.name if ft.default_date is not None else None
         spatial: List = []
-        t_lo = t_hi = None
 
-        def clamp_lo(v):
-            nonlocal t_lo
-            t_lo = v if t_lo is None else max(t_lo, v)
-
-        def clamp_hi(v):
-            nonlocal t_hi
-            t_hi = v if t_hi is None else min(t_hi, v)
-
-        def walk(node) -> bool:
-            if isinstance(node, A.And):
-                return all(walk(c) for c in node.children())
+        def match(node) -> bool:
             if isinstance(node, (A.BBox, A.Intersects)) and node.prop == geom:
                 spatial.append(node)
                 return True
-            if dtg is not None and isinstance(node, A.During) and node.prop == dtg:
-                clamp_lo(node.lo_ms + 1)
-                clamp_hi(node.hi_ms - 1)
-                return True
-            if dtg is not None and isinstance(node, A.After) and node.prop == dtg:
-                clamp_lo(node.t_ms + 1)
-                return True
-            if dtg is not None and isinstance(node, A.Before) and node.prop == dtg:
-                clamp_hi(node.t_ms - 1)
-                return True
-            if dtg is not None and isinstance(node, A.TEquals) and node.prop == dtg:
-                clamp_lo(node.t_ms)
-                clamp_hi(node.t_ms)
-                return True
             return False
 
-        if not walk(f) or len(spatial) != 1:
+        ok, t_lo, t_hi = TpuScanExecutor._and_walk_temporal(ft, f, match)
+        if not ok or len(spatial) != 1:
             return None
         if table.index.name == "xz2" and (t_lo is not None or t_hi is not None):
             return None  # xz2 blocks carry no time column
@@ -2766,6 +3036,7 @@ class TpuScanExecutor:
         seen: set = set()
         batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
         xz_batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
+        poly_batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
         for table, plan in items:
             if id(plan) in seen:
                 continue
@@ -2788,6 +3059,16 @@ class TpuScanExecutor:
                 if key not in batchable:
                     batchable[key] = (table, has_time, [])
                 batchable[key][2].append((id(plan), plan, desc))
+                continue
+            poly = self._poly_batch_desc(table, plan)
+            if poly is not None:
+                edges, box_np, win_np, has_time, geom, node = poly
+                key = (id(table), has_time)
+                if key not in poly_batchable:
+                    poly_batchable[key] = (table, has_time, [])
+                poly_batchable[key][2].append(
+                    (id(plan), plan, edges, box_np, win_np, geom, node)
+                )
                 continue
             xz = self._xz_batch_desc(table, plan)
             if xz is not None:
@@ -2865,7 +3146,114 @@ class TpuScanExecutor:
                         node,
                         geom,
                     )
+        for table, has_time, lst in poly_batchable.values():
+            dev = self.device_index(table)
+            ok = bool(dev.segments) and all(
+                seg.load_poly(table) for seg in dev.segments
+            )
+            if not ok or len(lst) == 1:
+                for pid, plan, *_rest in lst:
+                    # desc=None: no exact box descriptor exists (that's why
+                    # these plans took the polygon branch)
+                    out[pid] = self._dispatch_nonseek(table, plan, desc=None)
+                continue
+            for i in range(0, len(lst), self.BATCH_MAX):
+                chunk = lst[i : i + self.BATCH_MAX]
+                if len(chunk) == 1:
+                    pid, plan, *_rest = chunk[0]
+                    out[pid] = self._dispatch_nonseek(table, plan, desc=None)
+                    continue
+                descs = [(e, b, w) for _pid, _p, e, b, w, _g, _n in chunk]
+                per_seg = [
+                    seg.dispatch_poly_batch(descs, has_time)
+                    for seg in dev.segments
+                ]
+                for qi, (pid, _plan, _e, _b, _w, geom, node) in enumerate(chunk):
+                    out[pid] = _XZBatchScan(
+                        [
+                            (seg, phs[qi])
+                            for seg, phs in zip(dev.segments, per_seg)
+                        ],
+                        node,
+                        geom,
+                    )
         return out
+
+    def _poly_batch_desc(self, table: IndexTable, plan: QueryPlan):
+        """(edges f32[E,4], box u32[8], win u32[4]|None, has_time, geom,
+        node) when this point z-index plan's FULL filter is one non-rect
+        INTERSECTS(polygon) on the default geometry (+ z3 temporal
+        bounds) — the banded-raycast batch descriptor; None otherwise.
+        Same GEOMESA_EXACT_DEVICE gate as the box path (the kernel rides
+        the exact limb columns)."""
+        import os
+
+        env = os.environ.get("GEOMESA_EXACT_DEVICE", "auto")
+        if env == "0" or (env != "1" and jax.default_backend() == "cpu"):
+            return None
+        if table.index.name not in ("z2", "z3") or plan.secondary is not None:
+            return None
+        ft = table.ft
+        if ft.default_geometry is None or not ft.is_points:
+            return None
+        f = plan.full_filter
+        if f is None:
+            return None
+        from geomesa_tpu.filter import ast as A
+        from geomesa_tpu.geom.base import MultiPolygon, Polygon
+
+        geom = ft.default_geometry.name
+        spatial: List = []
+
+        def match(node) -> bool:
+            if isinstance(node, A.Intersects) and node.prop == geom:
+                spatial.append(node)
+                return True
+            return False
+
+        ok, t_lo, t_hi = self._and_walk_temporal(ft, f, match)
+        if not ok or len(spatial) != 1:
+            return None
+        has_time = t_lo is not None or t_hi is not None
+        if has_time and table.index.name != "z3":
+            return None
+        node = spatial[0]
+        g = node.geometry
+        if hasattr(g, "is_rectangle") and g.is_rectangle():
+            return None  # the box path handles rects exactly
+        if isinstance(g, Polygon):
+            polys = [g]
+        elif isinstance(g, MultiPolygon):
+            polys = list(g.geoms)
+            # crossing parity is only valid for disjoint members; envelope
+            # overlap (conservative) sends such queries down the old path
+            envs = [p.envelope for p in polys]
+            for i in range(len(envs)):
+                for j in range(i + 1, len(envs)):
+                    if envs[i].intersects(envs[j]):
+                        return None
+        else:
+            return None
+        rings = []
+        for p in polys:
+            rings.append(p.shell)
+            rings.extend(p.holes)
+        segs = []
+        for r in rings:
+            r = np.asarray(r, np.float64)
+            if len(r) < 3:
+                return None
+            if not np.array_equal(r[0], r[-1]):
+                r = np.vstack([r, r[:1]])
+            segs.append(
+                np.stack([r[:-1, 0], r[:-1, 1], r[1:, 0], r[1:, 1]], axis=1)
+            )
+        edges = np.concatenate(segs).astype(np.float32)
+        e = g.envelope
+        box_np, win_np = self._shape_limbs(
+            (e.xmin, e.ymin, e.xmax, e.ymax, t_lo, t_hi)
+        )
+        return edges, box_np, win_np, has_time, geom, node
 
     def _xz_batch_desc(self, table: IndexTable, plan: QueryPlan):
         """(qbox u32[12], win u32[4], has_time, geom, node) when this
@@ -2909,14 +3297,16 @@ class TpuScanExecutor:
         return shape
 
     @staticmethod
-    def _walk_box_window(ft, f):
-        if f is None:
-            return None
+    def _and_walk_temporal(ft, f, match_spatial):
+        """Shared AND-only filter walker: temporal predicates on the
+        default date clamp the (inclusive-ms) window with the exclusive-
+        bound rules (DURING/AFTER/BEFORE are exclusive, TEQUALS is a
+        point); every other node must be accepted by ``match_spatial``.
+        Returns (ok, t_lo, t_hi) — THE single home of the bound rules for
+        the box, xz, and polygon device descriptors."""
         from geomesa_tpu.filter import ast as A
 
-        geom = ft.default_geometry.name
         dtg = ft.default_date.name if ft.default_date is not None else None
-        boxes: List = []
         t_lo, t_hi = None, None  # inclusive ms, None = open
 
         def clamp_lo(v):
@@ -2930,17 +3320,8 @@ class TpuScanExecutor:
         def walk(node) -> bool:
             if isinstance(node, A.And):
                 return all(walk(c) for c in node.children())
-            if isinstance(node, A.BBox) and node.prop == geom:
-                boxes.append(node.envelope)
-                return True
-            if isinstance(node, A.Intersects) and node.prop == geom:
-                g = node.geometry
-                if hasattr(g, "is_rectangle") and g.is_rectangle():
-                    boxes.append(g.envelope)
-                    return True
-                return False
             if dtg is not None and isinstance(node, A.During) and node.prop == dtg:
-                clamp_lo(node.lo_ms + 1)  # DURING bounds are exclusive
+                clamp_lo(node.lo_ms + 1)
                 clamp_hi(node.hi_ms - 1)
                 return True
             if dtg is not None and isinstance(node, A.After) and node.prop == dtg:
@@ -2953,9 +3334,32 @@ class TpuScanExecutor:
                 clamp_lo(node.t_ms)
                 clamp_hi(node.t_ms)
                 return True
+            return match_spatial(node)
+
+        return walk(f), t_lo, t_hi
+
+    @staticmethod
+    def _walk_box_window(ft, f):
+        if f is None:
+            return None
+        from geomesa_tpu.filter import ast as A
+
+        geom = ft.default_geometry.name
+        boxes: List = []
+
+        def match(node) -> bool:
+            if isinstance(node, A.BBox) and node.prop == geom:
+                boxes.append(node.envelope)
+                return True
+            if isinstance(node, A.Intersects) and node.prop == geom:
+                g = node.geometry
+                if hasattr(g, "is_rectangle") and g.is_rectangle():
+                    boxes.append(g.envelope)
+                    return True
             return False
 
-        if not walk(f) or not boxes:
+        ok, t_lo, t_hi = TpuScanExecutor._and_walk_temporal(ft, f, match)
+        if not ok or not boxes:
             return None
         env = boxes[0]
         xmin, ymin, xmax, ymax = env.xmin, env.ymin, env.xmax, env.ymax
