@@ -65,7 +65,7 @@ def _device_stream_fields(ds, name, cqls, wants, n, base_s):
         for _ in range(3):  # warm until adaptive run capacities settle
             ds.query_many(name, queries)
             caps = {
-                id(s): s._rcap
+                id(s): (s._rcap, s._sum_cap, s._span_cap)
                 for d in getattr(ds.executor, "_cache", {}).values()
                 for s in d[1].segments
             }
@@ -235,6 +235,61 @@ def bench_attr_bbox(n, reps):
     }
 
 
+def bench_poly(n, reps):
+    """Non-rect INTERSECTS(polygon) over a point store vs a vectorized f64
+    numpy ray-cast full scan. The headline times the cost-chosen path
+    (like every suite config); the device_path_* fields time the banded
+    device ray-cast (executor._poly_mask_body) on the jittered stream."""
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    rng = np.random.default_rng(9)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-85, 85, n)
+    ds = _store()
+    ft = parse_spec("pts", "*geom:Point:srid=4326")
+    ds.create_schema(ft)
+    fids = np.char.add("f", np.arange(n).astype(f"<U{len(str(n - 1))}"))
+    ds._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y})
+
+    def star(cx, cy, r):
+        ang = np.linspace(0, 2 * np.pi, 13)[:-1]
+        rad = np.where(np.arange(12) % 2 == 0, r, 0.45 * r)
+        pts = np.stack([cx + rad * np.cos(ang), cy + rad * np.sin(ang)], axis=1)
+        return np.vstack([pts, pts[:1]])
+
+    def pip(poly, px, py):
+        inside = np.zeros(len(px), bool)
+        for (x1, y1), (x2, y2) in zip(poly[:-1], poly[1:]):
+            cond = (y1 > py) != (y2 > py)
+            if y1 != y2:
+                xint = x1 + (py - y1) * (x2 - x1) / (y2 - y1)
+                inside ^= cond & (px < xint)
+        return inside
+
+    def wkt(poly):
+        return "POLYGON ((" + ", ".join(f"{a:.6f} {b:.6f}" for a, b in poly) + "))"
+
+    poly = star(2.0, 10.0, 14.0)
+    cql = f"intersects(geom, {wkt(poly)})"
+    base_s, want_mask = _timeit(lambda: pip(poly, x, y), max(3, reps // 4))
+    dev_s, res = _timeit(lambda: ds.query("pts", cql), reps)
+    parity = set(res.fids) == set(fids[want_mask])
+    jit_rng = np.random.default_rng(99)
+    cqls, wants = [], []
+    for _ in range(max(8, reps)):
+        dx, dy = jit_rng.uniform(-6, 6, 2)
+        p = star(2.0 + dx, 10.0 + dy, 14.0)
+        cqls.append(f"intersects(geom, {wkt(p)})")
+        wants.append(set(fids[pip(p, x, y)]))
+    return {
+        "metric": "polygon_intersects_throughput", "value": round(n / dev_s, 1),
+        "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
+        "n": n, "hits": int(want_mask.sum()), "parity": bool(parity),
+        "query_ms": round(dev_s * 1000, 3),
+        **_device_stream_fields(ds, "pts", cqls, wants, n, base_s),
+    }
+
+
 def bench_knn(n, reps):
     from geomesa_tpu.process.geodesy import haversine_m
     from geomesa_tpu.process.knn import knn_search
@@ -293,6 +348,7 @@ def main():
         ("z2", bench_z2),
         ("xz2", bench_xz2),
         ("attr_bbox", bench_attr_bbox),
+        ("poly", bench_poly),
         ("knn", bench_knn),
     ]:
         log(f"running {name} (n={n})")

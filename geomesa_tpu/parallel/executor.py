@@ -750,10 +750,24 @@ def _xz_dual_runs(hit, decided, rcap: int):
 _XZ_BITMAP_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
+def _dual_bitmap_row(hit, decided, span_cap: int):
+    """(hit, decided) masks -> (header i32[4], bits u8[2*span_cap//8]):
+    THE span-framed dual-plane wire step (header = cnt/lo/hi/start keyed
+    on the hit span; decided is a subset so one window frames both) —
+    shared by the xz and polygon bitmap batch kernels."""
+    n = hit.shape[0]
+    cnt = jnp.sum(hit.astype(jnp.int32))
+    lo = jnp.argmax(hit).astype(jnp.int32)
+    hi = (n - 1 - jnp.argmax(hit[::-1])).astype(jnp.int32)
+    start = jnp.clip((lo // 8) * 8, 0, n - span_cap)
+    hw = jax.lax.dynamic_slice(hit, (start,), (span_cap,))
+    dw = jax.lax.dynamic_slice(decided, (start,), (span_cap,))
+    bits = jnp.concatenate([jnp.packbits(hw), jnp.packbits(dw)])
+    return jnp.stack([cnt, lo, hi, start]), bits
+
+
 def _xz_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str, mesh):
-    """Extent edition of _exact_bitmap_batch_fn: headers i32[q,4] keyed on
-    the HIT mask's span (decided is a subset of hit, so one window frames
-    both) + bitmaps u8[q, 2*span_cap//8] (hit plane | decided plane)."""
+    """Extent edition of _exact_bitmap_batch_fn (see _dual_bitmap_row)."""
     key = (has_time, span_cap, q, mode, mesh if mode == "spmd" else None)
     fn = _XZ_BITMAP_BATCH_FNS.get(key)
     if fn is None:
@@ -764,15 +778,7 @@ def _xz_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str, mesh):
 
             def step(carry, d):
                 hit, decided = mask(*cols, d[0], d[1])
-                n = hit.shape[0]
-                cnt = jnp.sum(hit.astype(jnp.int32))
-                lo = jnp.argmax(hit).astype(jnp.int32)
-                hi = (n - 1 - jnp.argmax(hit[::-1])).astype(jnp.int32)
-                start = jnp.clip((lo // 8) * 8, 0, n - span_cap)
-                hw = jax.lax.dynamic_slice(hit, (start,), (span_cap,))
-                dw = jax.lax.dynamic_slice(decided, (start,), (span_cap,))
-                bits = jnp.concatenate([jnp.packbits(hw), jnp.packbits(dw)])
-                return carry, (jnp.stack([cnt, lo, hi, start]), bits)
+                return carry, _dual_bitmap_row(hit, decided, span_cap)
 
             _, (headers, bitmaps) = jax.lax.scan(step, 0, (qboxes, wins))
             return headers, bitmaps
@@ -980,15 +986,7 @@ def _poly_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
 
             def step(carry, d):
                 hit, dec = mask(*cols, d[0], d[1], d[2])
-                n = hit.shape[0]
-                cnt = jnp.sum(hit.astype(jnp.int32))
-                lo = jnp.argmax(hit).astype(jnp.int32)
-                hi = (n - 1 - jnp.argmax(hit[::-1])).astype(jnp.int32)
-                start = jnp.clip((lo // 8) * 8, 0, n - span_cap)
-                hw = jax.lax.dynamic_slice(hit, (start,), (span_cap,))
-                dw = jax.lax.dynamic_slice(dec, (start,), (span_cap,))
-                bits = jnp.concatenate([jnp.packbits(hw), jnp.packbits(dw)])
-                return carry, (jnp.stack([cnt, lo, hi, start]), bits)
+                return carry, _dual_bitmap_row(hit, dec, span_cap)
 
             _, (headers, bitmaps) = jax.lax.scan(step, 0, (edges, boxes, wins))
             return headers, bitmaps
@@ -3148,7 +3146,9 @@ class TpuScanExecutor:
                     )
         for table, has_time, lst in poly_batchable.values():
             dev = self.device_index(table)
-            ok = bool(dev.segments) and all(
+            # a lone query never batches: decide BEFORE paying the limb +
+            # coord column upload that load_poly triggers
+            ok = len(lst) > 1 and bool(dev.segments) and all(
                 seg.load_poly(table) for seg in dev.segments
             )
             if not ok or len(lst) == 1:

@@ -37,15 +37,34 @@ def _data(rng, n):
 def _rand_query(rng) -> str:
     parts = []
     if rng.random() < 0.9:
+        if rng.random() < 0.2:
+            # non-rect polygon: exercises the banded device ray cast;
+            # grid-aligned vertices half the time so polygon edges pass
+            # EXACTLY through data coordinates (band -> host cases)
+            if rng.random() < 0.5:
+                cx = float(rng.integers(-5, 3) * 10.0)
+                cy = float(rng.integers(-3, 2) * 10.0)
+            else:
+                cx = float(rng.uniform(-50, 20))
+                cy = float(rng.uniform(-30, 10))
+            r = float(rng.uniform(8, 30))
+            k = int(rng.integers(3, 9))
+            ang = np.sort(rng.uniform(0, 2 * np.pi, k))
+            pts = [(float(cx + r * np.cos(a)), float(cy + r * np.sin(a))) for a in ang]
+            pts.append(pts[0])
+            wkt = ", ".join(f"{px!r} {py!r}" for px, py in pts)
+            parts.append(f"intersects(geom, POLYGON (({wkt})))")
         # grid-aligned half the time so box edges EQUAL data coordinates
-        if rng.random() < 0.5:
+        elif rng.random() < 0.5:
             x0 = float(rng.integers(-6, 4) * 10.0)
             y0 = float(rng.integers(-4, 2) * 10.0)
+            w = float(rng.uniform(5, 40))
+            parts.append(f"bbox(geom, {x0!r}, {y0!r}, {x0 + w!r}, {y0 + w!r})")
         else:
             x0 = float(rng.uniform(-60, 30))
             y0 = float(rng.uniform(-40, 20))
-        w = float(rng.uniform(5, 40))
-        parts.append(f"bbox(geom, {x0!r}, {y0!r}, {x0 + w!r}, {y0 + w!r})")
+            w = float(rng.uniform(5, 40))
+            parts.append(f"bbox(geom, {x0!r}, {y0!r}, {x0 + w!r}, {y0 + w!r})")
     if rng.random() < 0.7:
         d0 = int(rng.integers(0, 15))
         d1 = d0 + int(rng.integers(1, 6))
