@@ -181,7 +181,11 @@ def probe_tpu(timeout_s: int, retries: int) -> bool:
     the axon flock: concurrent claims (e.g. scripts/tpu_watch.py mid-
     batch) deadlock the tunnel, so a busy lock reads as "TPU busy".
     """
-    code = "import jax; d = jax.devices(); print('PROBE-OK', len(d), d[0].platform)"
+    code = (
+        "import jax; d = jax.devices(); "
+        "assert d[0].platform != 'cpu', 'cpu backend is not a TPU claim'; "
+        "print('PROBE-OK', len(d), d[0].platform)"
+    )
     lock = _axon_lock()
     if lock is not None and not lock.try_acquire(timeout_s=5.0):
         log("axon lock busy (another claimer active); treating TPU as unavailable")
