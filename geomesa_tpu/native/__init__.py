@@ -166,6 +166,41 @@ _ENVSCAN = _NativeLib(
 )
 
 
+_BITDECODE = _NativeLib(
+    "bitdecode.cpp",
+    "_bitdecode.so",
+    "bitmap_rows",
+    ctypes.c_longlong,
+    [_c_u8p, ctypes.c_longlong, ctypes.c_longlong, _c_i64p],
+)
+
+
+def load_bitdecode():
+    """The bitmap-decode ctypes lib; None when unavailable/disabled."""
+    return _BITDECODE.load()
+
+
+def bitmap_rows_native(bits, base: int, max_out: int):
+    """Packed bitmap (np.packbits big bit order) -> int64 row indices
+    (bit index + ``base``); None when the lib is unavailable. ``max_out``
+    bounds the output (callers know the set-bit count from the wire
+    header)."""
+    import numpy as np
+
+    lib = load_bitdecode()
+    if lib is None:
+        return None
+    bits = np.ascontiguousarray(bits, dtype=np.uint8)
+    out = np.empty(max_out, dtype=np.int64)
+    k = lib.bitmap_rows(
+        bits.ctypes.data_as(_c_u8p),
+        ctypes.c_longlong(len(bits)),
+        ctypes.c_longlong(base),
+        out.ctypes.data_as(_c_i64p),
+    )
+    return out[:k]
+
+
 def load():
     """The zranges ctypes lib; None when unavailable/disabled."""
     return _ZRANGES.load()

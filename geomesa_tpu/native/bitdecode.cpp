@@ -1,0 +1,62 @@
+// Bitmap -> row indices at memory speed: the host-side decode of the
+// span-framed bitmap wire format (parallel/executor.py bitmap batch
+// protocol). np.packbits bit order ("big"): bit (7-j) of byte i is row
+// i*8 + j. Zero words (the common case outside hit clusters) skip 8
+// bytes at a time. Role: the client-side decode of the tserver's
+// returned key/value batch (reference BatchScanner consumption path);
+// numpy's unpackbits+flatnonzero equivalent measured ~35 ms per 1 MB
+// window vs ~1 ms here.
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// per-byte decode table: bit positions (big bit order) + popcount —
+// turns the inner loop branchless (one bounded copy per nonzero byte)
+struct Tables {
+    uint8_t pos[256][8];
+    uint8_t cnt[256];
+    Tables() {
+        for (int b = 0; b < 256; ++b) {
+            int k = 0;
+            for (int j = 0; j < 8; ++j)
+                if (b & (0x80 >> j)) pos[b][k++] = (uint8_t)j;
+            cnt[b] = (uint8_t)k;
+        }
+    }
+};
+const Tables T;
+
+inline long long decode_byte(uint8_t byte, long long row0, int64_t* out,
+                             long long k) {
+    int c = T.cnt[byte];
+    const uint8_t* p = T.pos[byte];
+    for (int t = 0; t < c; ++t) out[k + t] = row0 + p[t];
+    return k + c;
+}
+
+}  // namespace
+
+extern "C" {
+
+// bits: nbytes packed bytes; out: caller-sized (>= popcount) row buffer.
+// Returns the number of set bits written; rows are base + bit index.
+long long bitmap_rows(const uint8_t* bits, long long nbytes, long long base,
+                      int64_t* out) {
+    long long k = 0;
+    long long i = 0;
+    // word-skip over the zero runs
+    for (; i + 8 <= nbytes; i += 8) {
+        uint64_t w;
+        std::memcpy(&w, bits + i, 8);
+        if (w == 0) continue;
+        long long row0 = base + i * 8;
+        for (int b = 0; b < 8; ++b)
+            k = decode_byte(bits[i + b], row0 + b * 8, out, k);
+    }
+    for (; i < nbytes; ++i)
+        k = decode_byte(bits[i], base + i * 8, out, k);
+    return k;
+}
+
+}  // extern "C"

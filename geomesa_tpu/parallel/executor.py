@@ -435,6 +435,19 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
     return fn
 
 
+def _decode_bitmap_rows(bits: np.ndarray, start: int, max_out: int) -> np.ndarray:
+    """Span-window bitmap -> global row indices: C++ ctz-style decode
+    (native/bitdecode.cpp, ~1 ms per 1 MB window) with the numpy
+    unpackbits fallback (~35 ms). ``max_out`` is the wire header's hit
+    count (every set bit lies inside the span window by construction)."""
+    from geomesa_tpu.native import bitmap_rows_native
+
+    rows = bitmap_rows_native(bits, start, max_out)
+    if rows is not None:
+        return rows
+    return start + np.flatnonzero(np.unpackbits(bits)).astype(np.int64)
+
+
 class _BitmapBatch:
     """One bitmap batch (headers + span-framed bitmaps), fetched once.
     Remembers the stream's widest span on the segment (once per batch)."""
@@ -497,8 +510,7 @@ class _PendingBitmapHits:
                 self.seg, self.seg._rcap,
                 self._refetch(self.seg._rcap), self._refetch, self._packed,
             ).rows()
-        bits = np.unpackbits(self.batch.query_bits(self.i))
-        return start + np.flatnonzero(bits)
+        return _decode_bitmap_rows(self.batch.query_bits(self.i), start, cnt)
 
 
 def _decode_packed_query(words: np.ndarray, header: np.ndarray, nexc: int):
@@ -822,9 +834,10 @@ class _PendingXZBitmapHits:
             ).rows()
         both = self.batch.query_bits(self.i)
         h = len(both) // 2
-        hit = np.unpackbits(both[:h])
-        dec = np.unpackbits(both[h:])
-        return start + np.flatnonzero(hit), start + np.flatnonzero(dec)
+        return (
+            _decode_bitmap_rows(both[:h], start, cnt),
+            _decode_bitmap_rows(both[h:], start, cnt),  # decided <= hit
+        )
 
 
 # banded polygon ray cast: rows within EPS of a ring vertex's latitude, or

@@ -143,3 +143,19 @@ def test_ranges_nonpositive_budget_parity():
         finally:
             del os.environ["GEOMESA_TPU_NO_NATIVE"]
         assert nx == px, budget
+
+
+def test_bitmap_rows_native_matches_numpy():
+    import numpy as np
+
+    from geomesa_tpu.native import bitmap_rows_native
+
+    rng = np.random.default_rng(3)
+    for n_bytes, p in ((1, 0.5), (7, 0.9), (8, 0.0), (1024, 0.02), (100_003, 0.3)):
+        bits = (rng.random(n_bytes * 8) < p).astype(np.uint8)
+        packed = np.packbits(bits)
+        want = 1000 + np.flatnonzero(bits)
+        got = bitmap_rows_native(packed, 1000, int(bits.sum()))
+        if got is None:  # native lib unavailable: fallback covered elsewhere
+            return
+        np.testing.assert_array_equal(got, want)
