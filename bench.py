@@ -182,8 +182,9 @@ def probe_tpu(timeout_s: int, retries: int) -> bool:
     batch) deadlock the tunnel, so a busy lock reads as "TPU busy".
     """
     code = (
-        "import jax; d = jax.devices(); "
-        "assert d[0].platform != 'cpu', 'cpu backend is not a TPU claim'; "
+        "import jax; d = jax.devices()\n"
+        "if d[0].platform == 'cpu':\n"
+        "    raise SystemExit('cpu backend is not a TPU claim')\n"
         "print('PROBE-OK', len(d), d[0].platform)"
     )
     lock = _axon_lock()

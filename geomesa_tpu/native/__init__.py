@@ -171,7 +171,7 @@ _BITDECODE = _NativeLib(
     "_bitdecode.so",
     "bitmap_rows",
     ctypes.c_longlong,
-    [_c_u8p, ctypes.c_longlong, ctypes.c_longlong, _c_i64p],
+    [_c_u8p, ctypes.c_longlong, ctypes.c_longlong, _c_i64p, ctypes.c_longlong],
 )
 
 
@@ -197,7 +197,10 @@ def bitmap_rows_native(bits, base: int, max_out: int):
         ctypes.c_longlong(len(bits)),
         ctypes.c_longlong(base),
         out.ctypes.data_as(_c_i64p),
+        ctypes.c_longlong(max_out),
     )
+    if k < 0:  # popcount exceeded max_out: header/bitmap mismatch
+        return None
     return out[:k]
 
 

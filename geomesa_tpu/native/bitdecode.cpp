@@ -39,14 +39,17 @@ inline long long decode_byte(uint8_t byte, long long row0, int64_t* out,
 
 extern "C" {
 
-// bits: nbytes packed bytes; out: caller-sized (>= popcount) row buffer.
-// Returns the number of set bits written; rows are base + bit index.
+// bits: nbytes packed bytes; out: row buffer of capacity ``cap``.
+// Returns the number of set bits written (rows are base + bit index), or
+// -1 if the popcount exceeds cap (header/bitmap mismatch — the caller
+// must treat the buffer as corrupt, like every sibling kernel's cap).
 long long bitmap_rows(const uint8_t* bits, long long nbytes, long long base,
-                      int64_t* out) {
+                      int64_t* out, long long cap) {
     long long k = 0;
     long long i = 0;
-    // word-skip over the zero runs
-    for (; i + 8 <= nbytes; i += 8) {
+    // word-skip over the zero runs; the 8-byte body writes at most 64
+    // rows, so guard capacity per word and fall to the checked tail
+    for (; i + 8 <= nbytes && k + 64 <= cap; i += 8) {
         uint64_t w;
         std::memcpy(&w, bits + i, 8);
         if (w == 0) continue;
@@ -54,8 +57,12 @@ long long bitmap_rows(const uint8_t* bits, long long nbytes, long long base,
         for (int b = 0; b < 8; ++b)
             k = decode_byte(bits[i + b], row0 + b * 8, out, k);
     }
-    for (; i < nbytes; ++i)
-        k = decode_byte(bits[i], base + i * 8, out, k);
+    for (; i < nbytes; ++i) {
+        uint8_t byte = bits[i];
+        if (!byte) continue;
+        if (k + T.cnt[byte] > cap) return -1;
+        k = decode_byte(byte, base + i * 8, out, k);
+    }
     return k;
 }
 
