@@ -247,6 +247,28 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
     return fn
 
 
+def _point_desc_split(mask, has_time: bool, args):
+    """Shared arg split for the point batch builders: returns
+    (mask_of(desc), stacked desc arrays for lax.scan)."""
+    if has_time:
+        xh, xl, yh, yl, th, tl, valid, boxes, wins = args
+        return (
+            lambda d: mask(xh, xl, yh, yl, th, tl, valid, d[0], d[1]),
+            (boxes, wins),
+        )
+    xh, xl, yh, yl, valid, boxes = args
+    return lambda d: mask(xh, xl, yh, yl, valid, d[0]), (boxes,)
+
+
+def _start_d2h(*bufs) -> None:
+    """Kick device->host copies without blocking (best effort)."""
+    for b in bufs:
+        try:
+            b.copy_to_host_async()
+        except Exception:  # pragma: no cover - transfer started lazily
+            pass
+
+
 def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
     """Q exact-predicate scans fused into ONE device execution.
 
@@ -267,22 +289,15 @@ def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
     fn = _EXACT_RUNS_BATCH_FNS.get(key)
     if fn is None:
         mask = _exact_mask_body(has_time, mode, mesh)
-        if has_time:
-            def run(xh, xl, yh, yl, th, tl, valid, boxes, wins):
-                def step(carry, bw):
-                    b, w = bw
-                    m = mask(xh, xl, yh, yl, th, tl, valid, b, w)
-                    return carry, _runs_from_mask(m, rcap)
 
-                _, out = jax.lax.scan(step, 0, (boxes, wins))
-                return out
-        else:
-            def run(xh, xl, yh, yl, valid, boxes):
-                def step(carry, b):
-                    return carry, _runs_from_mask(mask(xh, xl, yh, yl, valid, b), rcap)
+        def run(*args):
+            mask_of, descs = _point_desc_split(mask, has_time, args)
 
-                _, out = jax.lax.scan(step, 0, boxes)
-                return out
+            def step(carry, d):
+                return carry, _runs_from_mask(mask_of(d), rcap)
+
+            _, out = jax.lax.scan(step, 0, descs)
+            return out
 
         fn = jax.jit(run)
         _EXACT_RUNS_BATCH_FNS[key] = fn
@@ -340,19 +355,7 @@ def _exact_packed_batch_fn(has_time: bool, rcap: int, sum_cap: int, q: int,
         mask = _exact_mask_body(has_time, mode, mesh)
 
         def run(*args):
-            if has_time:
-                xh, xl, yh, yl, th, tl, valid, boxes, wins = args
-                descs = (boxes, wins)
-
-                def mask_of(d):
-                    return mask(xh, xl, yh, yl, th, tl, valid, d[0], d[1])
-            else:
-                xh, xl, yh, yl, valid, boxes = args
-                descs = (boxes,)
-
-                def mask_of(d):
-                    return mask(xh, xl, yh, yl, valid, d[0])
-
+            mask_of, descs = _point_desc_split(mask, has_time, args)
             shared0 = jnp.zeros((sum_cap,), jnp.int32)
 
             def step(carry, d):
@@ -401,18 +404,7 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
         mask = _exact_mask_body(has_time, mode, mesh)
 
         def run(*args):
-            if has_time:
-                xh, xl, yh, yl, th, tl, valid, boxes, wins = args
-                descs = (boxes, wins)
-
-                def mask_of(d):
-                    return mask(xh, xl, yh, yl, th, tl, valid, d[0], d[1])
-            else:
-                xh, xl, yh, yl, valid, boxes = args
-                descs = (boxes,)
-
-                def mask_of(d):
-                    return mask(xh, xl, yh, yl, valid, d[0])
+            mask_of, descs = _point_desc_split(mask, has_time, args)
 
             def step(carry, d):
                 m = mask_of(d)
@@ -1402,10 +1394,7 @@ class DeviceSegment:
         args = self._mask_args(boxes_dev, windows_dev)
         rcap = self._rcap
         buf = _runs_fn(self.kind, rcap, mode, self.mesh)(*args)
-        try:
-            buf.copy_to_host_async()
-        except Exception:  # pragma: no cover - transfer started lazily
-            pass
+        _start_d2h(buf)
         return _PendingHits(
             self,
             rcap,
@@ -1549,10 +1538,7 @@ class DeviceSegment:
         args = self._exact_args(box_dev, win_dev, has_time)
         rcap = self._rcap
         buf = _exact_runs_fn(has_time, rcap, mode, self.mesh)(*args)
-        try:
-            buf.copy_to_host_async()
-        except Exception:  # pragma: no cover
-            pass
+        _start_d2h(buf)
         return _PendingHits(
             self,
             rcap,
@@ -1603,11 +1589,7 @@ class DeviceSegment:
             hdr, bits = _exact_bitmap_batch_fn(
                 has_time, span_cap, qpad, mode, self.mesh
             )(*args)
-            for b in (hdr, bits):
-                try:
-                    b.copy_to_host_async()
-                except Exception:  # pragma: no cover
-                    pass
+            _start_d2h(hdr, bits)
             batch = _BitmapBatch(hdr, bits, span_cap, seg=self)
             out = []
             for i, (box_np, win_np) in enumerate(descs):
@@ -1638,10 +1620,7 @@ class DeviceSegment:
             )(*args)
         else:
             buf = _exact_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
-        try:
-            buf.copy_to_host_async()
-        except Exception:  # pragma: no cover
-            pass
+        _start_d2h(buf)
         if pack:
             batch = _PackedBatch(
                 buf, qpad, rcap, sum_cap, seg=self,
@@ -1740,18 +1719,11 @@ class DeviceSegment:
             hdr, bits = _poly_bitmap_batch_fn(
                 has_time, span_cap, qpad, mode, self.mesh
             )(*args)
-            for b in (hdr, bits):
-                try:
-                    b.copy_to_host_async()
-                except Exception:  # pragma: no cover
-                    pass
+            _start_d2h(hdr, bits)
             batch = _BitmapBatch(hdr, bits, span_cap, seg=self)
         else:
             buf = _poly_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
-            try:
-                buf.copy_to_host_async()
-            except Exception:  # pragma: no cover
-                pass
+            _start_d2h(buf)
             batch = _BatchRows(buf)
         out = []
         for i, (edges, box_np, win_np) in enumerate(descs):
@@ -1815,18 +1787,11 @@ class DeviceSegment:
             hdr, bits = _xz_bitmap_batch_fn(
                 has_time, span_cap, qpad, mode, self.mesh
             )(*args)
-            for b in (hdr, bits):
-                try:
-                    b.copy_to_host_async()
-                except Exception:  # pragma: no cover
-                    pass
+            _start_d2h(hdr, bits)
             batch = _BitmapBatch(hdr, bits, span_cap, seg=self)
         else:
             buf = _xz_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
-            try:
-                buf.copy_to_host_async()
-            except Exception:  # pragma: no cover
-                pass
+            _start_d2h(buf)
             batch = _BatchRows(buf)
         out = []
         for i, (qbox_np, win_np) in enumerate(descs):
@@ -2699,10 +2664,7 @@ class TpuScanExecutor:
                 replicate(self.mesh, starts_p), replicate(self.mesh, lens_p),
                 qbox_dev, rect_dev, th, tl, win,
             )
-            try:
-                buf.copy_to_host_async()
-            except Exception:  # pragma: no cover
-                pass
+            _start_d2h(buf)
             pending.append((seg, starts, lens, tot, buf))
         if not pending:
             return None
@@ -2753,10 +2715,7 @@ class TpuScanExecutor:
                 replicate(self.mesh, starts_p), replicate(self.mesh, lens_p),
                 box_d, win_d if has_time else box_d,
             )
-            try:
-                buf.copy_to_host_async()
-            except Exception:  # pragma: no cover
-                pass
+            _start_d2h(buf)
             pending.append((seg, starts, lens, tot, buf))
         if not pending:
             # every candidate fell on rows the mirror hasn't synced — the
@@ -3122,19 +3081,41 @@ class TpuScanExecutor:
                         ],
                         exact=True,
                     )
-        for table, has_time, lst in xz_batchable.values():
+        def xz_loaded(dev, table, has_time):
+            return all(seg.load_exact_xz(table) for seg in dev.segments) and not (
+                has_time and any(seg.xz_tk is None for seg in dev.segments)
+            )
+
+        self._drain_dual_batches(
+            out, xz_batchable, xz_loaded,
+            lambda seg, descs, ht: seg.dispatch_exact_xz_batch(descs, ht),
+        )
+        self._drain_dual_batches(
+            out, poly_batchable,
+            lambda dev, table, _ht: all(
+                seg.load_poly(table) for seg in dev.segments
+            ),
+            lambda seg, descs, ht: seg.dispatch_poly_batch(descs, ht),
+        )
+        return out
+
+    def _drain_dual_batches(self, out, groups, loaded, dispatch) -> None:
+        """Shared drain for the dual-plane (hit/decided) batch groups
+        (extent envelopes, banded polygons): chunked batched dispatch per
+        segment resolving through _XZBatchScan. Group items are
+        ``(plan_id, plan, *desc_parts, geom, node)``. Lone queries route
+        to the single-query path BEFORE any device column upload; these
+        plans provably have no exact point descriptor (that's why they
+        took a dual-plane branch), so nonseek gets desc=None."""
+        for table, has_time, lst in groups.values():
             dev = self.device_index(table)
             ok = (
-                bool(dev.segments)
-                and all(seg.load_exact_xz(table) for seg in dev.segments)
-                and not (
-                    has_time and any(seg.xz_tk is None for seg in dev.segments)
-                )
+                len(lst) > 1
+                and bool(dev.segments)
+                and loaded(dev, table, has_time)
             )
-            if not ok or len(lst) == 1:
+            if not ok:
                 for pid, plan, *_rest in lst:
-                    # desc=None: these plans provably have no exact POINT
-                    # descriptor (that's why they took the xz branch)
                     out[pid] = self._dispatch_nonseek(table, plan, desc=None)
                 continue
             for i in range(0, len(lst), self.BATCH_MAX):
@@ -3143,12 +3124,12 @@ class TpuScanExecutor:
                     pid, plan, *_rest = chunk[0]
                     out[pid] = self._dispatch_nonseek(table, plan, desc=None)
                     continue
-                descs = [(qb, wn) for _pid, _p, qb, wn, _g, _n in chunk]
+                descs = [tuple(item[2:-2]) for item in chunk]
                 per_seg = [
-                    seg.dispatch_exact_xz_batch(descs, has_time)
-                    for seg in dev.segments
+                    dispatch(seg, descs, has_time) for seg in dev.segments
                 ]
-                for qi, (pid, _plan, _qb, _wn, geom, node) in enumerate(chunk):
+                for qi, item in enumerate(chunk):
+                    pid, geom, node = item[0], item[-2], item[-1]
                     out[pid] = _XZBatchScan(
                         [
                             (seg, phs[qi])
@@ -3157,40 +3138,6 @@ class TpuScanExecutor:
                         node,
                         geom,
                     )
-        for table, has_time, lst in poly_batchable.values():
-            dev = self.device_index(table)
-            # a lone query never batches: decide BEFORE paying the limb +
-            # coord column upload that load_poly triggers
-            ok = len(lst) > 1 and bool(dev.segments) and all(
-                seg.load_poly(table) for seg in dev.segments
-            )
-            if not ok or len(lst) == 1:
-                for pid, plan, *_rest in lst:
-                    # desc=None: no exact box descriptor exists (that's why
-                    # these plans took the polygon branch)
-                    out[pid] = self._dispatch_nonseek(table, plan, desc=None)
-                continue
-            for i in range(0, len(lst), self.BATCH_MAX):
-                chunk = lst[i : i + self.BATCH_MAX]
-                if len(chunk) == 1:
-                    pid, plan, *_rest = chunk[0]
-                    out[pid] = self._dispatch_nonseek(table, plan, desc=None)
-                    continue
-                descs = [(e, b, w) for _pid, _p, e, b, w, _g, _n in chunk]
-                per_seg = [
-                    seg.dispatch_poly_batch(descs, has_time)
-                    for seg in dev.segments
-                ]
-                for qi, (pid, _plan, _e, _b, _w, geom, node) in enumerate(chunk):
-                    out[pid] = _XZBatchScan(
-                        [
-                            (seg, phs[qi])
-                            for seg, phs in zip(dev.segments, per_seg)
-                        ],
-                        node,
-                        geom,
-                    )
-        return out
 
     def _poly_batch_desc(self, table: IndexTable, plan: QueryPlan):
         """(edges f32[E,4], box u32[8], win u32[4]|None, has_time, geom,
