@@ -43,6 +43,14 @@ def median3(f):
     return sorted(ts)[1]
 
 
+def flush(out: dict) -> None:
+    """Persist after EVERY measurement: a tunnel window closing mid-probe
+    (or the watcher's timeout) must still leave the numbers gathered so
+    far on disk."""
+    with open(os.path.join(REPO, "HW_PRIMS.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -58,21 +66,27 @@ def main():
 
     cmp_fn = jax.jit(lambda a: (a < 12345).sum())
     out["mask_ms"] = median3(lambda: cmp_fn(x).block_until_ready()) * 1e3
+    flush(out)
 
     nz = jax.jit(lambda a: jnp.nonzero(a, size=RCAP, fill_value=N)[0])
     out["nonzero_ms"] = median3(lambda: nz(m).block_until_ready()) * 1e3
+    flush(out)
 
     srt = jax.jit(lambda a: jax.lax.sort(a))
     out["sort_ms"] = median3(lambda: srt(x).block_until_ready()) * 1e3
+    flush(out)
 
     am = jax.jit(lambda a: jnp.argmax(a))
     out["argmax_ms"] = median3(lambda: am(m).block_until_ready()) * 1e3
+    flush(out)
 
     pb = jax.jit(lambda a: jnp.packbits(a))
     out["packbits_ms"] = median3(lambda: pb(m).block_until_ready()) * 1e3
+    flush(out)
 
     cs = jax.jit(lambda a: jnp.cumsum(a.astype(jnp.int32)))
     out["cumsum_ms"] = median3(lambda: cs(m).block_until_ready()) * 1e3
+    flush(out)
 
     big = jax.device_put(np.zeros(1 << 20, np.int32))  # 4 MB
     idn = jax.jit(lambda a: a + 1)
@@ -80,14 +94,17 @@ def main():
     # fresh output per call: jax.Array caches its host value after the
     # first np.asarray, which would turn repeats into cache hits
     out["d2h_4m_ms"] = median3(lambda: np.asarray(idn(big))) * 1e3
+    flush(out)
     host4 = np.zeros(1 << 20, np.int32)
     out["h2d_4m_ms"] = median3(
         lambda: jax.device_put(host4).block_until_ready()
     ) * 1e3
+    flush(out)
     tiny = jax.device_put(np.zeros(8, np.int32))
     out["exec_floor_ms"] = median3(
         lambda: np.asarray(idn(tiny))
     ) * 1e3
+    flush(out)
 
     # end-to-end batch kernels on a realistic z3 segment
     from geomesa_tpu.parallel import executor as ex
@@ -109,11 +126,13 @@ def main():
     out["batch_runs_ms"] = median3(
         lambda: np.asarray(runs_fn(xh, xl, yh, yl, valid, boxes))
     ) * 1e3
+    flush(out)
 
     packed_fn = ex._exact_packed_batch_fn(False, RCAP, 1 << 20, Q, mode, mesh)
     out["batch_packed_ms"] = median3(
         lambda: np.asarray(packed_fn(xh, xl, yh, yl, valid, boxes))
     ) * 1e3
+    flush(out)
 
     span = 1 << 23  # 8M-row window (1 MB bitmap/query)
     bm_fn = ex._exact_bitmap_batch_fn(False, min(span, N - N % 8), Q, mode, mesh)
@@ -122,10 +141,7 @@ def main():
         np.asarray(h)
         np.asarray(b)
     out["batch_bitmap_ms"] = median3(run_bm) * 1e3
-
-    path = os.path.join(REPO, "HW_PRIMS.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+    flush(out)
     print(json.dumps(out))
 
 

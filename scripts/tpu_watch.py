@@ -169,7 +169,7 @@ def batch() -> None:
         results.append({"name": "suite", **r})
         record_hw(results)
     # primitive timings (compile-heavy at 20M): next protocol choices
-    r = run([sys.executable, "scripts/hw_probe.py"], claim_env, timeout_s=900)
+    r = run([sys.executable, "scripts/hw_probe.py"], claim_env, timeout_s=1500)
     if r is not None:
         results.append({"name": "primitives", **r})
     r = run([sys.executable, "bench.py"],
