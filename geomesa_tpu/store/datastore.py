@@ -508,6 +508,15 @@ class TpuDataStore:
             results.append(result)
         return results
 
+    @staticmethod
+    def _collect_scan_path(plan) -> str:
+        """This plan's audited execution path; union plans join their
+        arms' labels (set by _scan_parts as each arm executes)."""
+        if plan.union is not None:
+            arms = [getattr(a, "scan_path", "") for a in plan.union]
+            return "+".join(sorted({a for a in arms if a}))
+        return getattr(plan, "scan_path", "")
+
     def _audit(self, name, query, plan, result, t_start, t_planned):
         import time as _time
 
@@ -531,6 +540,7 @@ class TpuDataStore:
                     planning_ms=1000 * (t_planned - t_start),
                     scanning_ms=1000 * (now - t_planned),
                     hits=len(result),
+                    scan_path=self._collect_scan_path(plan),
                 )
             )
 
@@ -568,6 +578,7 @@ class TpuDataStore:
         ):
             grid = self.executor.density_scan(table, plan, query.hints["density"])
             if grid is not None:
+                plan.scan_path = "device-density"
                 return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})
 
         parts = self._scan_parts(name, ft, query, plan, t_scan_start, pending)
@@ -657,6 +668,10 @@ class TpuDataStore:
         else:
             scan = self.executor.scan_candidates(table, plan)
         device_scan = scan is not None
+        # audited execution-path label (the reference audits plan/scan
+        # timings; WHICH path answered is the extra operators need when
+        # cost gates flip between host and device)
+        plan.scan_path = _scan_label(scan)
         if scan is None:
             if plan.ranges:
                 scan = table.scan(plan.ranges)
@@ -932,6 +947,25 @@ class HostScanExecutor(ScanExecutor):
 _INTERNAL_SUFFIXES = (
     "__vocab", "__bxmin", "__bymin", "__bxmax", "__bymax", "__isrect"
 )
+
+
+def _scan_label(scan) -> str:
+    """Human-readable execution-path label for audit events (None = the
+    executor declined and the host table scan ran)."""
+    if scan is None:
+        return "host-table"
+    name = type(scan).__name__
+    labels = {
+        "_HostSeekScan": "host-seek",
+        "_DeviceSeekScan": "device-seek",
+        "_DeviceSeekXZScan": "device-seek-xz",
+        "_XZBatchScan": "device-batch-dual",
+    }
+    if name in labels:
+        return labels[name]
+    if name == "_PendingScan":
+        return "device-exact" if getattr(scan, "exact", False) else "device-mask"
+    return name.strip("_").lower()
 
 
 def _column_base(k: str) -> str:

@@ -30,6 +30,10 @@ class QueryEvent:
     planning_ms: float
     scanning_ms: float
     hits: int
+    # which execution path answered (host-seek / device-exact /
+    # device-batch-dual / ... ; "+"-joined for union plans) — the extra
+    # the reference's QueryEvent lacks but cost-gated execution needs
+    scan_path: str = ""
 
 
 class AuditWriter:

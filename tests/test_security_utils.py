@@ -195,3 +195,31 @@ def test_degrees_box_covers_high_latitude_cap():
     lat2 = np.degrees(lat2)
     assert (lon2 >= box[0] - 1e-6).all() and (lon2 <= box[2] + 1e-6).all()
     assert (lat2 >= box[1] - 1e-6).all() and (lat2 <= box[3] + 1e-6).all()
+
+
+def test_audit_scan_path_label(monkeypatch):
+    """Audit events record WHICH execution path answered (host seek vs
+    device paths), including '+'-joined arms for union plans."""
+    import numpy as np
+
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+
+    monkeypatch.setenv("GEOMESA_SEEK", "1")  # force the host seek chooser
+    aw = InMemoryAuditWriter()
+    s = TpuDataStore(executor=TpuScanExecutor(default_mesh()), audit_writer=aw)
+    s.create_schema(parse_spec("t", "dtg:Date,*geom:Point:srid=4326"))
+    rng = np.random.default_rng(0)
+    base = int(np.datetime64("2026-01-01T00:00:00", "ms").astype("int64"))
+    with s.writer("t") as w:
+        for i in range(1500):
+            w.write([int(base + int(rng.integers(0, 10 * 86400_000))),
+                     Point(float(rng.uniform(-50, 50)), float(rng.uniform(-50, 50)))],
+                    fid=f"f{i}")
+    s.query("t", "bbox(geom, -10, -10, 20, 20)")
+    ev = aw.events[-1]
+    assert ev.scan_path in ("host-seek", "host-table"), ev.scan_path
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "1")
+    s.query("t", "bbox(geom, -10, -10, 20, 20) AND dtg DURING "
+                 "2026-01-02T00:00:00Z/2026-01-06T00:00:00Z")
+    assert aw.events[-1].scan_path.startswith("device"), aw.events[-1].scan_path
