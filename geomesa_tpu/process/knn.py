@@ -171,6 +171,7 @@ def _device_knn(store, name: str, ft, x: float, y: float, k: int,
                 planning_ms=0.0,
                 scanning_ms=1000 * (_time.perf_counter() - t0),
                 hits=len(out),
+                scan_path="device-topk",
             )
         )
     return out

@@ -57,6 +57,10 @@ def main():
 
     backend = jax.default_backend()
     dev = jax.devices()[0].platform
+    if os.environ.get("HW_PROBE_REQUIRE_TPU") and dev == "cpu":
+        # the tunnel closed between the watcher's probe and now: CPU
+        # timings must not overwrite a real-silicon HW_PRIMS.json
+        raise SystemExit("cpu backend; refusing to record primitives")
     out = {"backend": backend, "platform": dev, "n": N}
 
     rng = np.random.default_rng(0)
