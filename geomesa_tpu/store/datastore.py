@@ -951,7 +951,8 @@ _INTERNAL_SUFFIXES = (
 
 def _scan_label(scan) -> str:
     """Human-readable execution-path label for audit events (None = the
-    executor declined and the host table scan ran)."""
+    executor declined and the host table scan ran). Batched device scans
+    carry a ``/bitmap`` or ``/runs`` suffix for the wire format."""
     if scan is None:
         return "host-table"
     name = type(scan).__name__
@@ -959,12 +960,21 @@ def _scan_label(scan) -> str:
         "_HostSeekScan": "host-seek",
         "_DeviceSeekScan": "device-seek",
         "_DeviceSeekXZScan": "device-seek-xz",
-        "_XZBatchScan": "device-batch-dual",
     }
     if name in labels:
         return labels[name]
-    if name == "_PendingScan":
-        return "device-exact" if getattr(scan, "exact", False) else "device-mask"
+    if name in ("_PendingScan", "_XZBatchScan"):
+        base = (
+            "device-batch-dual" if name == "_XZBatchScan"
+            else "device-exact" if getattr(scan, "exact", False)
+            else "device-mask"
+        )
+        pending = getattr(scan, "pending", None)
+        if pending:
+            # every non-bitmap pending resolves RLE runs (packed or not)
+            fmt = "bitmap" if "Bitmap" in type(pending[0][1]).__name__ else "runs"
+            return f"{base}/{fmt}"
+        return base
     return name.strip("_").lower()
 
 
