@@ -236,3 +236,23 @@ def test_near_horizontal_long_edge_band():
     sliver = ("POLYGON ((-60 50, 60 50.0003, 60 65, -60 65, -60 50))")
     cqls = [f"intersects(geom, {sliver})"] * 2
     _parity(host, tpu, cqls)
+
+
+def test_polygon_chunking_past_batch_max():
+    host, tpu = (None, None)
+    rng = np.random.default_rng(11)
+    n = 9000
+    x = rng.uniform(-70, 70, n)
+    y = rng.uniform(-70, 70, n)
+    t = BASE + rng.integers(0, 86400_000, n)
+    host, tpu = _stores(x, y, t)
+    saved = ex.TpuScanExecutor.BATCH_MAX
+    ex.TpuScanExecutor.BATCH_MAX = 3  # force multiple chunks + a lone tail
+    try:
+        polys = [TRIANGLE, CONCAVE, HOLED, MULTI, TRIANGLE, CONCAVE, HOLED]
+        cqls = [f"intersects(geom, {g})" for g in polys]
+        got = tpu.query_many("t", cqls)
+    finally:
+        ex.TpuScanExecutor.BATCH_MAX = saved
+    for cql, res in zip(cqls, got):
+        assert _fids(res) == _fids(host.query("t", cql)), cql
