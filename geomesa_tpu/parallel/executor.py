@@ -440,6 +440,24 @@ def _decode_bitmap_rows(bits: np.ndarray, start: int, max_out: int) -> np.ndarra
     return start + np.flatnonzero(np.unpackbits(bits)).astype(np.int64)
 
 
+_POPCOUNT8 = np.unpackbits(
+    np.arange(256, dtype=np.uint8)[:, None], axis=1
+).sum(axis=1).astype(np.int64)
+
+
+def _decode_full_bitmap_rows(packed: np.ndarray, n: int) -> np.ndarray:
+    """Full-table packed bitmap -> row indices < n (the dense-degrade
+    transfers): popcount-table count + the native decode, numpy
+    fallback. Pad bits beyond n are always clear (the valid mask), so
+    the bound check is belt and braces."""
+    packed = np.asarray(packed)
+    cnt = int(_POPCOUNT8[packed].sum())
+    rows = _decode_bitmap_rows(packed, 0, cnt)
+    if len(rows) and rows[-1] >= n:
+        rows = rows[rows < n]
+    return rows
+
+
 class _BitmapBatch:
     """One bitmap batch (headers + span-framed bitmaps), fetched once.
     Remembers the stream's widest span on the segment (once per batch)."""
