@@ -7,11 +7,16 @@ NumPy full-scan predicate — a stand-in for (and strictly stronger than) the
 reference's in-memory CQEngine datastore (geomesa-memory GeoCQEngine.scala:34),
 which walks a quadtree + per-attribute indices on the JVM.
 
-Prints exactly ONE JSON line on stdout:
+Prints one or more JSON lines on stdout — the LAST line is the result:
   {"metric", "value", "unit", "vs_baseline", ...diagnostic extras}
-and never crashes without emitting it — TPU-claim failures degrade to the
-CPU jax backend (labeled "backend": "cpu-fallback") so every round records
-a real features/sec number.
+and never exits without emitting at least one — TPU-claim failures degrade
+to the CPU jax backend (labeled "backend": "cpu-fallback") so every round
+records a real features/sec number. The CPU-fallback line is emitted
+IMMEDIATELY after it is measured, BEFORE any tunnel polling, so an
+external kill during the poll can never destroy an already-computed
+result (round 3's driver artifact was rc=124/null for exactly that
+reason); if a tunnel window then opens, an upgraded device line is
+emitted afterwards and wins.
 
 Env knobs:
   GEOMESA_BENCH_N        rows (default 20_000_000 on either backend)
@@ -157,6 +162,52 @@ def start_watchdog(deadline_s: float):
 # return, silently releasing the flock mid-claim) — it lives here until
 # process exit, where the OS drops it
 _HELD_LOCK = None
+
+# marker telling scripts/tpu_watch.py a driver-invoked bench wants the
+# tunnel: the watcher defers (skips new batches / stops between batch
+# steps) while this file is fresh and its writer is alive
+PENDING_PATH = os.environ.get(
+    "GEOMESA_BENCH_PENDING", "/tmp/geomesa_bench_pending"
+)
+
+
+def mark_claim_pending() -> None:
+    """Advertise this bench run to tpu_watch so it yields the flock.
+
+    Only the driver's own invocation writes the marker: children spawned
+    by tpu_watch (GEOMESA_AXON_LOCK_HELD=1) and cpu-pinned retries must
+    not, or they would clobber/remove the parent's marker."""
+    if os.environ.get("GEOMESA_AXON_LOCK_HELD", "") not in ("", "0"):
+        return
+    if os.environ.get("JAX_PLATFORMS", None) == "cpu":
+        return
+    try:
+        with open(PENDING_PATH, "w") as f:
+            f.write(str(os.getpid()))
+        import atexit
+
+        atexit.register(clear_claim_pending)
+    except OSError:
+        pass
+
+
+def touch_claim_pending() -> None:
+    """Refresh the marker mtime so a multi-hour poll never goes stale."""
+    try:
+        with open(PENDING_PATH) as f:
+            if f.read().strip() == str(os.getpid()):
+                os.utime(PENDING_PATH)
+    except OSError:
+        pass
+
+
+def clear_claim_pending() -> None:
+    try:
+        with open(PENDING_PATH) as f:
+            if f.read().strip() == str(os.getpid()):
+                os.remove(PENDING_PATH)
+    except OSError:
+        pass
 
 
 def _axon_lock():
@@ -606,6 +657,7 @@ def poll_for_tpu_retry(payload, t_start, deadline):
             except Exception as e:  # noqa: BLE001
                 log(f"device retry failed: {type(e).__name__}: {e}")
             return payload
+        touch_claim_pending()  # keep the tpu_watch yield-marker fresh
         time.sleep(45)
 
 
@@ -624,6 +676,7 @@ def main():
     deadline = float(os.environ.get("GEOMESA_BENCH_DEADLINE", 3000))
 
     t_start = time.monotonic()
+    mark_claim_pending()
     watchdog = start_watchdog(deadline)
     backend = init_backend(claim_timeout, retries)
     if n == 0:
@@ -683,9 +736,22 @@ def main():
                 "backend": backend,
             }
     if payload.get("backend") == "cpu-fallback" and not payload.get("error"):
-        payload = poll_for_tpu_retry(payload, t_start, deadline)
-        if payload.get("backend") == "cpu-fallback":
-            payload = attach_hw_capture(payload)
+        # emit the measured fallback NOW — before tunnel polling — so an
+        # external kill mid-poll can never destroy it (BENCH_r03.json was
+        # rc=124/parsed:null because the only emit happened post-poll)
+        emit_once(attach_hw_capture(payload))
+        first_hw = payload.get("hw_capture")
+        upgraded = poll_for_tpu_retry(payload, t_start, deadline)
+        if upgraded is not payload:
+            emit(upgraded)  # device capture: last line wins
+        else:
+            # no device upgrade, but tpu_watch may have committed fresh
+            # silicon numbers to BENCH_hw.json during the poll (a batch
+            # step already in flight finishes and records); re-emit so
+            # the round's record carries them
+            refreshed = attach_hw_capture(dict(payload))
+            if refreshed.get("hw_capture") != first_hw:
+                emit(refreshed)
     watchdog.cancel()
     emit_once(payload)
 
