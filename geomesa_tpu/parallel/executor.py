@@ -160,7 +160,38 @@ _PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
 def _fn_key(kind: str, mode: str, mesh) -> tuple:
-    return (kind, mode, mesh if mode == "pallas_spmd" else None)
+    return (kind, mode, mesh)
+
+
+def _gathered(mask, mesh):
+    """Wrap a mask body so the extraction ops see a REPLICATED operand.
+
+    The run/bitmap extraction ops downstream of every mask (bounded
+    jnp.nonzero, scatter-at, argmax span framing, packbits) lower
+    pathologically under GSPMD when their operand stays row-sharded:
+    measured 7.1 s vs 7 ms for the same bounded-nonzero extraction at
+    262k rows on the 8-device CPU mesh — a ~1000x cliff that dominated
+    the CPU-mesh test/fuzz wall time. The mask computation itself
+    partitions perfectly, so all-gather the bool mask (one BYTE per row
+    in XLA — packbits runs after the gather) once and let extraction
+    compile to its single-device form. At segment sizes that is n bytes
+    over ICI per scan step (~20 MB per query at 20M rows — still small
+    next to the reference's tablet servers shipping whole KV ranges
+    back per scan, iterators/Z3Iterator.scala:42-65)."""
+    if mesh is None or getattr(mesh, "devices", np.empty(0)).size <= 1:
+        return mask
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+
+    def wrapped(*args):
+        out = mask(*args)
+        if isinstance(out, tuple):
+            return tuple(jax.lax.with_sharding_constraint(o, rep) for o in out)
+        return jax.lax.with_sharding_constraint(out, rep)
+
+    return wrapped
 
 
 def _mask_runs(m, rcap: int):
@@ -190,6 +221,7 @@ def _runs_fn(kind: str, rcap: int, mode: str, mesh):
     fn = _RUNS_FNS.get(key)
     if fn is None:
         mask = _raw_mask_fn(kind, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             return _runs_from_mask(mask(*args), rcap)
@@ -234,10 +266,11 @@ _EXACT_PACKED_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
 def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
-    key = (has_time, rcap, mode, mesh if mode == "spmd" else None)
+    key = (has_time, rcap, mode, mesh)
     fn = _EXACT_RUNS_FNS.get(key)
     if fn is None:
         mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             return _runs_from_mask(mask(*args), rcap)
@@ -285,10 +318,11 @@ def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
     random access by orders of magnitude. This is the BatchScanner
     analog (AccumuloQueryPlan.scala:113-140) collapsed into one RPC.
     """
-    key = (has_time, rcap, q, mode, mesh if mode == "spmd" else None)
+    key = (has_time, rcap, q, mode, mesh)
     fn = _EXACT_RUNS_BATCH_FNS.get(key)
     if fn is None:
         mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             mask_of, descs = _point_desc_split(mask, has_time, args)
@@ -349,10 +383,11 @@ def _exact_packed_batch_fn(has_time: bool, rcap: int, sum_cap: int, q: int,
     ``[q*(3+3*PACK_XCAP) headers | sum_cap shared words]`` (see
     _packed_step). Same one-execution-per-stream shape as
     _exact_runs_batch_fn with a ~5x smaller D2H transfer."""
-    key = (has_time, rcap, sum_cap, q, mode, mesh if mode == "spmd" else None)
+    key = (has_time, rcap, sum_cap, q, mode, mesh)
     fn = _EXACT_PACKED_BATCH_FNS.get(key)
     if fn is None:
         mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             mask_of, descs = _point_desc_split(mask, has_time, args)
@@ -393,15 +428,17 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
     span_cap is detected host-side (hi - start + 1 > span_cap) and that
     query refetches singly while the segment learns a bigger span bucket.
 
-    On a sharded mesh the dynamic-slice start is a traced scalar, so GSPMD
-    reshards the window (fine for the CPU parity mesh; a real multi-chip
-    deployment would extract per shard instead — single-chip is the
-    tunnel-bench shape that matters here).
+    On a multi-device mesh the mask is all-gathered to a replicated
+    layout first (_gathered), so the argmax framing / dynamic-slice /
+    packbits all compile to their single-device form; a future pod
+    deployment could extract per shard and stitch offsets instead —
+    single-chip is the tunnel-bench shape that matters here.
     """
-    key = (has_time, span_cap, q, mode, mesh if mode == "spmd" else None)
+    key = (has_time, span_cap, q, mode, mesh)
     fn = _EXACT_BITMAP_BATCH_FNS.get(key)
     if fn is None:
         mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             mask_of, descs = _point_desc_split(mask, has_time, args)
@@ -790,10 +827,11 @@ def _dual_bitmap_row(hit, decided, span_cap: int):
 
 def _xz_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str, mesh):
     """Extent edition of _exact_bitmap_batch_fn (see _dual_bitmap_row)."""
-    key = (has_time, span_cap, q, mode, mesh if mode == "spmd" else None)
+    key = (has_time, span_cap, q, mode, mesh)
     fn = _XZ_BITMAP_BATCH_FNS.get(key)
     if fn is None:
         mask = _xz_exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             *cols, qboxes, wins = args
@@ -942,10 +980,11 @@ _POLY_PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 def _poly_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
     """Single polygon query -> dual fused RLE buffer (xz layout)."""
-    key = (has_time, rcap, mode, mesh if mode == "spmd" else None)
+    key = (has_time, rcap, mode, mesh)
     fn = _POLY_RUNS_FNS.get(key)
     if fn is None:
         mask = _poly_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             hit, decided = mask(*args)
@@ -958,10 +997,11 @@ def _poly_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
 
 def _poly_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
     """Q polygon queries in ONE execution -> [q, 2 x (2 + 2*rcap)]."""
-    key = (has_time, rcap, q, mode, mesh if mode == "spmd" else None)
+    key = (has_time, rcap, q, mode, mesh)
     fn = _POLY_RUNS_BATCH_FNS.get(key)
     if fn is None:
         mask = _poly_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             *cols, edges, boxes, wins = args
@@ -981,10 +1021,11 @@ def _poly_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
 def _poly_packed_fn(has_time: bool, mode: str, mesh):
     """Dual full packed bitmaps (hit | decided) for one polygon query —
     the dense-result degrade mirror of _xz_packed_fn."""
-    key = (has_time, mode, mesh if mode == "spmd" else None)
+    key = (has_time, mode, mesh)
     fn = _POLY_PACKED_FNS.get(key)
     if fn is None:
         mask = _poly_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             hit, dec = mask(*args)
@@ -999,10 +1040,11 @@ def _poly_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
                           mesh):
     """Polygon edition of _xz_bitmap_batch_fn: headers i32[q,4] +
     bitmaps u8[q, 2*span_cap//8] (hit | decided planes)."""
-    key = (has_time, span_cap, q, mode, mesh if mode == "spmd" else None)
+    key = (has_time, span_cap, q, mode, mesh)
     fn = _POLY_BITMAP_BATCH_FNS.get(key)
     if fn is None:
         mask = _poly_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             *cols, edges, boxes, wins = args
@@ -1020,10 +1062,11 @@ def _poly_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
 
 
 def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
-    key = (has_time, rcap, mode, mesh if mode == "spmd" else None)
+    key = (has_time, rcap, mode, mesh)
     fn = _XZ_RUNS_FNS.get(key)
     if fn is None:
         mask = _xz_exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             hit, decided = mask(*args)
@@ -1037,10 +1080,11 @@ def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
 def _xz_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
     """Batched extent edition of _exact_runs_batch_fn: lax.scan over [q]
     stacked (qbox, window) descriptors -> [q, 2 x (2 + 2*rcap)]."""
-    key = (has_time, rcap, q, mode, mesh if mode == "spmd" else None)
+    key = (has_time, rcap, q, mode, mesh)
     fn = _XZ_RUNS_BATCH_FNS.get(key)
     if fn is None:
         mask = _xz_exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             cols, qboxes, wins = args[:-2], args[-2], args[-1]
@@ -1059,10 +1103,11 @@ def _xz_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
 
 
 def _xz_packed_fn(has_time: bool, mode: str, mesh):
-    key = (has_time, mode, mesh if mode == "spmd" else None)
+    key = (has_time, mode, mesh)
     fn = _XZ_PACKED_FNS.get(key)
     if fn is None:
         mask = _xz_exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             hit, decided = mask(*args)
@@ -1074,10 +1119,11 @@ def _xz_packed_fn(has_time: bool, mode: str, mesh):
 
 
 def _exact_packed_fn(has_time: bool, mode: str, mesh):
-    key = (has_time, mode, mesh if mode == "spmd" else None)
+    key = (has_time, mode, mesh)
     fn = _EXACT_PACKED_FNS.get(key)
     if fn is None:
         mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             return jnp.packbits(mask(*args))
@@ -1146,6 +1192,7 @@ def _packed_fn(kind: str, mode: str, mesh):
     fn = _PACKED_FNS.get(key)
     if fn is None:
         mask = _raw_mask_fn(kind, mode, mesh)
+        mask = _gathered(mask, mesh)
 
         def run(*args):
             return jnp.packbits(mask(*args))

@@ -80,21 +80,22 @@ def test_wire_format_roundtrip():
 
 
 def test_exception_table_gap_overflow():
-    """Two hit clusters separated by far more than 65535 rows: the gap
-    between them (and the leading gap) must spill into exceptions."""
-    n = 300_000
+    """Hit clusters preceded by far more than 65535 non-hit rows: the
+    leading gap must spill into the exception table (verified offline:
+    every cluster query here carries exactly one >16-bit gap exception;
+    the SW background z-sorts wholly below the NE clusters)."""
+    n = 100_000
     rng = np.random.default_rng(1)
     # cluster A near (10,10), cluster B near (50,50), background elsewhere
     x = rng.uniform(-170, -60, n)
     y = rng.uniform(-80, -10, n)
     x[1000:2000] = rng.uniform(10, 11, 1000)
     y[1000:2000] = rng.uniform(10, 11, 1000)
-    x[250_000:251_000] = rng.uniform(50, 51, 1000)
-    y[250_000:251_000] = rng.uniform(50, 51, 1000)
+    x[83_000:84_000] = rng.uniform(50, 51, 1000)
+    y[83_000:84_000] = rng.uniform(50, 51, 1000)
     t = BASE + rng.integers(0, 86400_000, n)
     host, tpu = _stores(x, y, t)
-    # one box covering BOTH clusters -> z-sorted hits form two groups with
-    # a multi-hundred-thousand-row empty stretch between them
+    # one box covering BOTH clusters plus per-cluster and background boxes
     cqls = [
         "bbox(geom, 5, 5, 55, 55)",
         "bbox(geom, 9, 9, 12, 12)",
@@ -139,17 +140,29 @@ def test_sum_capacity_overflow_falls_back():
 
 def test_xcap_overflow_falls_back(monkeypatch):
     """More >16-bit entries than the exception table holds: per-query
-    fallback (forced by crushing PACK_XCAP)."""
+    fallback (forced by crushing PACK_XCAP). The construction produces
+    exactly TWO exceptions deterministically — a >65535-row leading gap
+    (SW background z-sorts below the NE cell) plus a >65535-row
+    contiguous run (70k rows jammed into one tiny cell) — where the old
+    200k uniform dataset yielded 2 exceptions on one lucky query."""
     monkeypatch.setattr(ex, "PACK_XCAP", 1)
     ex._EXACT_PACKED_BATCH_FNS.clear()  # cached fns baked the old constant
     try:
         rng = np.random.default_rng(4)
-        n = 200_000
-        x = rng.uniform(-170, 170, n)
-        y = rng.uniform(-80, 80, n)
+        n = 140_000
+        x = np.concatenate(
+            [rng.uniform(-170, -60, 70_000), rng.uniform(20.0, 20.001, 70_000)]
+        )
+        y = np.concatenate(
+            [rng.uniform(-80, -10, 70_000), rng.uniform(30.0, 30.001, 70_000)]
+        )
         t = BASE + rng.integers(0, 86400_000, n)
         host, tpu = _stores(x, y, t)
-        cqls = [f"bbox(geom, {x0}, -60, {x0+40}, 60)" for x0 in (-170, -100, -30, 40, 110)]
+        cqls = [
+            "bbox(geom, 19, 29, 21, 31)",      # gap + long-run: 2 exceptions
+            "bbox(geom, -100, -50, -80, -30)",  # plain background box
+            "bbox(geom, -180, -90, 180, 90)",   # whole world
+        ]
         _parity(host, tpu, cqls)
     finally:
         ex._EXACT_PACKED_BATCH_FNS.clear()
@@ -249,9 +262,12 @@ def test_bitmap_protocol_parity(monkeypatch):
 
 
 def test_bitmap_span_overflow_falls_back(monkeypatch):
+    """A crushed span window far narrower than the queries' true spans
+    (~100k rows at this n, verified offline) forces the single-query runs
+    fallback; learning must then widen the window back out."""
     monkeypatch.setenv("GEOMESA_BATCH_PROTO", "bitmap")
     rng = np.random.default_rng(9)
-    n = 500_000
+    n = 150_000
     x = rng.uniform(-170, 170, n)
     y = rng.uniform(-80, 80, n)
     t = BASE + rng.integers(0, 86400_000, n)
