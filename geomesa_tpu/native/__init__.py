@@ -199,8 +199,15 @@ def bitmap_rows_native(bits, base: int, max_out: int):
         out.ctypes.data_as(_c_i64p),
         ctypes.c_longlong(max_out),
     )
-    if k < 0:  # popcount exceeded max_out: header/bitmap mismatch
-        return None
+    if k < 0:
+        # popcount exceeded max_out: the wire header and bitmap disagree.
+        # Raise rather than return None — None means "lib unavailable"
+        # and callers would silently fall through to the numpy decode,
+        # masking a wire-format bug instead of surfacing it.
+        raise ValueError(
+            f"corrupt bitmap wire data: popcount exceeds header count "
+            f"{max_out}"
+        )
     return out[:k]
 
 

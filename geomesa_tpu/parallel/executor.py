@@ -646,13 +646,14 @@ class _PackedBatch:
     re-dispatches ONCE at that size (``refetch_batch``) instead of paying
     a single-query round trip per clipped query."""
 
-    __slots__ = ("buf", "q", "rcap", "sum_cap", "seg", "_np", "_offs",
-                 "_refetch_batch", "_remembered", "trace")
+    __slots__ = ("buf", "q", "q_real", "rcap", "sum_cap", "seg", "_np",
+                 "_offs", "_refetch_batch", "_remembered", "trace")
 
     def __init__(self, buf, q: int, rcap: int, sum_cap: int, seg=None,
-                 refetch_batch=None, trace=None):
+                 refetch_batch=None, trace=None, q_real=None):
         self.buf = buf
-        self.q = q
+        self.q = q  # padded query count (device layout)
+        self.q_real = q if q_real is None else q_real
         self.rcap = rcap
         self.sum_cap = sum_cap
         self.seg = seg
@@ -676,9 +677,14 @@ class _PackedBatch:
             if self.seg is not None and not self._remembered:
                 # ONCE per batch: the per-query resolves all see the same
                 # stream total, and the gentle-decay hysteresis must step
-                # once per stream, not q times
+                # once per stream, not q times. Learn from the REAL
+                # queries only — the padded duplicate tail repeats the
+                # last descriptor and would overestimate the capacity for
+                # small streams whose last query is run-heavy (the
+                # overflow check below still uses the padded total, which
+                # is what the device actually scattered).
                 self._remembered = True
-                self.seg.remember_entry_total(int(self._offs[self.q]))
+                self.seg.remember_entry_total(int(self._offs[self.q_real]))
         return self._np
 
     def header(self, i: int) -> np.ndarray:
@@ -1780,6 +1786,7 @@ class DeviceSegment:
                     has_time, rcap, sc, qpad, mode, self.mesh
                 )(*args),
                 trace=trace,
+                q_real=q,
             )
         else:
             batch = _BatchRows(buf, trace=trace)

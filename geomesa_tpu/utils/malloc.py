@@ -44,6 +44,17 @@ def retain_freed_memory() -> bool:
         ok = bool(libc.mallopt(_M_TRIM_THRESHOLD, 2**31 - 1))
         ok = bool(libc.mallopt(_M_MMAP_THRESHOLD, 2**31 - 1)) and ok
         _done = ok
+        if ok:
+            # one line so operators can attribute pinned RSS to this knob
+            # (irreversible for the process; GEOMESA_MALLOC_RETAIN=0
+            # before the first call opts out)
+            import sys
+
+            print(
+                "[geomesa] malloc retain enabled: freed memory stays "
+                "in-process (GEOMESA_MALLOC_RETAIN=0 to disable)",
+                file=sys.stderr,
+            )
     except Exception:  # noqa: BLE001 - non-glibc platforms: no-op
         _done = False
     return _done

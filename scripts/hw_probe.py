@@ -118,8 +118,11 @@ def main():
     mode = "spmd" if ex._mask_mode(mesh) == "pallas_spmd" else "local"
 
     def limb(hi):
+        # hi limbs carry the sign-clear top bit pattern real sort keys
+        # have; lo limbs span the full u32 range
+        bound = 2**31 if hi else 2**32
         return jax.device_put(
-            rng.integers(0, 2**31, N).astype(np.uint32)
+            rng.integers(0, bound, N, dtype=np.uint64).astype(np.uint32)
         )
 
     xh, xl, yh, yl = limb(1), limb(0), limb(1), limb(0)

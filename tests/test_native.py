@@ -165,6 +165,11 @@ def test_bitmap_rows_native_matches_numpy():
 
             pytest.skip("bitdecode lib unavailable")
         np.testing.assert_array_equal(got, want)
-    # capacity mismatch must be detected, not written past the buffer
+    # capacity mismatch must be detected LOUDLY (a silent None would let
+    # callers fall through to the numpy decode and mask the corruption),
+    # and never written past the buffer
     bits = np.ones(64, np.uint8)
-    assert bitmap_rows_native(np.packbits(bits), 0, 63) is None
+    import pytest
+
+    with pytest.raises(ValueError, match="corrupt bitmap"):
+        bitmap_rows_native(np.packbits(bits), 0, 63)
