@@ -125,7 +125,16 @@ def make_handler(store):
                     w = int(params.get("width", 256))
                     h = int(params.get("height", 256))
                     grid = rstore.read_window(Envelope(*env), w, h)
-                    if params.get("format") == "npy":
+                    if params.get("format") in ("tiff", "geotiff"):
+                        # WCS GetCoverage format=image/geotiff
+                        import io as _io
+
+                        from geomesa_tpu.raster_io import write_geotiff
+
+                        buf = _io.BytesIO()
+                        write_geotiff(buf, grid, Envelope(*env))
+                        self._send(200, buf.getvalue(), "image/tiff")
+                    elif params.get("format") == "npy":
                         import io as _io
 
                         import numpy as _np

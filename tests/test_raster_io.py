@@ -1,0 +1,212 @@
+"""GeoTIFF codec round trips + pyramid integration (raster_io.py).
+
+Mirrors the reference's real-coverage path
+(geomesa-accumulo-raster: AccumuloRasterStore ingest + WCS
+GeoMesaCoverageReader serving) at the file-format edge: arrays written
+as GeoTIFF must read back bit-identical with the same envelope, an
+externally-flavored tiled/deflate/predictor TIFF must parse, and a
+GeoTIFF must drive the pyramid store end-to-end.
+"""
+
+import io
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Envelope
+from geomesa_tpu.raster import RasterQuery, RasterStore
+from geomesa_tpu.raster_io import read_geotiff, write_geotiff
+
+ENV = Envelope(-10.0, 40.0, 2.8, 48.0)
+
+
+def _roundtrip(data, compress):
+    buf = io.BytesIO()
+    write_geotiff(buf, data, ENV, compress=compress)
+    buf.seek(0)
+    got, env = read_geotiff(buf)
+    np.testing.assert_array_equal(got, data)
+    assert env is not None
+    for a in ("xmin", "ymin", "xmax", "ymax"):
+        assert getattr(env, a) == pytest.approx(getattr(ENV, a), abs=1e-9)
+
+
+@pytest.mark.parametrize("compress", [False, True])
+@pytest.mark.parametrize(
+    "dtype", [np.uint8, np.uint16, np.int16, np.int32, np.float32, np.float64]
+)
+def test_roundtrip_dtypes(dtype, compress):
+    rng = np.random.default_rng(1)
+    if np.issubdtype(dtype, np.floating):
+        data = rng.normal(0, 100, (37, 53)).astype(dtype)
+    else:
+        info = np.iinfo(dtype)
+        data = rng.integers(info.min, info.max, (37, 53), dtype=dtype)
+    _roundtrip(data, compress)
+
+
+def test_roundtrip_multiband():
+    rng = np.random.default_rng(2)
+    _roundtrip(rng.integers(0, 255, (40, 31, 3), dtype=np.uint8), True)
+
+
+def test_roundtrip_multi_strip():
+    # rows_per_strip splits at 64 KiB: 600 rows x 500 cols x f32 = many strips
+    rng = np.random.default_rng(3)
+    _roundtrip(rng.normal(0, 1, (600, 500)).astype(np.float32), True)
+    _roundtrip(rng.normal(0, 1, (600, 500)).astype(np.float32), False)
+
+
+def _write_tiled_tiff(data, tile=64, predictor=False, big_endian=False,
+                      geo=True):
+    """Hand-rolled TILED writer (the store writer emits strips): builds
+    the external flavor the reader must accept — tile layout, deflate,
+    optional horizontal predictor, either byte order."""
+    bo = ">" if big_endian else "<"
+    h, w = data.shape
+    dt = data.dtype.newbyteorder(bo)
+    data = data.astype(dt)
+    tiles = []
+    for r0 in range(0, h, tile):
+        for c0 in range(0, w, tile):
+            t = np.zeros((tile, tile), dt)
+            rr = min(tile, h - r0)
+            cc = min(tile, w - c0)
+            t[:rr, :cc] = data[r0 : r0 + rr, c0 : c0 + cc]
+            if predictor:
+                # concatenate normalizes to NATIVE byte order — re-cast
+                # to the declared order or the fixture lies to the header
+                t = np.concatenate(
+                    [t[:, :1], (t[:, 1:].astype(np.int64)
+                                - t[:, :-1].astype(np.int64)).astype(dt)],
+                    axis=1,
+                ).astype(dt)
+            tiles.append(zlib.compress(t.tobytes()))
+    entries = [
+        (256, 4, 1, (w,)),
+        (257, 4, 1, (h,)),
+        (258, 3, 1, (data.dtype.itemsize * 8,)),
+        (259, 3, 1, (8,)),
+        (262, 3, 1, (1,)),
+        (277, 3, 1, (1,)),
+        (317, 3, 1, (2 if predictor else 1,)),
+        (322, 3, 1, (tile,)),
+        (323, 3, 1, (tile,)),
+        (324, 4, len(tiles), None),
+        (325, 4, len(tiles), tuple(len(t) for t in tiles)),
+        (339, 3, 1, (1 if data.dtype.kind == "u" else 2,)),
+    ]
+    if geo:
+        entries += [
+            (33550, 12, 3, (0.25, 0.5, 0.0)),
+            (33922, 12, 6, (0.0, 0.0, 0.0, 10.0, 60.0, 0.0)),
+        ]
+    entries.sort()
+    sizes = {1: 1, 3: 2, 4: 4, 12: 8}
+    codes = {1: "B", 3: "H", 4: "I", 12: "d"}
+    ifd_off = 8
+    over_off = ifd_off + 2 + 12 * len(entries) + 4
+    over = bytearray()
+    place = {}
+    for tag, ft, n, vals in entries:
+        if sizes[ft] * n > 4:
+            place[tag] = len(over)
+            over.extend(b"\0" * sizes[ft] * n)
+    data_off = over_off + len(over)
+    offs = []
+    pos = data_off
+    for t in tiles:
+        offs.append(pos)
+        pos += len(t)
+    out = bytearray()
+    out += struct.pack(bo + "2sHI", b"MM" if big_endian else b"II", 42, ifd_off)
+    out += struct.pack(bo + "H", len(entries))
+    for tag, ft, n, vals in entries:
+        if tag == 324:
+            vals = tuple(offs)
+        vb = struct.pack(bo + codes[ft] * n, *vals)
+        if len(vb) <= 4:
+            out += struct.pack(bo + "HHI", tag, ft, n) + vb.ljust(4, b"\0")
+        else:
+            out += struct.pack(bo + "HHII", tag, ft, n, over_off + place[tag])
+            over[place[tag] : place[tag] + len(vb)] = vb
+    out += struct.pack(bo + "I", 0)
+    out += over
+    for t in tiles:
+        out += t
+    return bytes(out)
+
+
+@pytest.mark.parametrize("predictor", [False, True])
+@pytest.mark.parametrize("big_endian", [False, True])
+def test_reads_external_tiled_flavor(predictor, big_endian):
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 60_000, (150, 170), dtype=np.uint16)
+    raw = _write_tiled_tiff(data, predictor=predictor, big_endian=big_endian)
+    got, env = read_geotiff(io.BytesIO(raw))
+    np.testing.assert_array_equal(got, data)
+    # tiepoint (0,0)->(10,60), scale (0.25, 0.5): w=170, h=150
+    assert env.xmin == pytest.approx(10.0)
+    assert env.ymax == pytest.approx(60.0)
+    assert env.xmax == pytest.approx(10.0 + 170 * 0.25)
+    assert env.ymin == pytest.approx(60.0 - 150 * 0.5)
+
+
+def test_geotiff_drives_pyramid_store(tmp_path):
+    """End-to-end VERDICT r3 #6: GeoTIFF on disk -> pyramid ingest ->
+    read_window parity vs the in-memory array -> window exported back to
+    a GeoTIFF that re-reads identically."""
+    rng = np.random.default_rng(5)
+    h, w = 512, 768
+    yy, xx = np.mgrid[0:h, 0:w]
+    data = (np.sin(xx / 37.0) * np.cos(yy / 23.0) * 1000).astype(np.float32)
+    env = Envelope(-20.0, 30.0, 28.0, 62.0)
+    src = tmp_path / "src.tif"
+    write_geotiff(src, data, env)
+
+    store = RasterStore()
+    levels = store.ingest_geotiff(src, chip_size=256)
+    assert len(levels) >= 2  # base + at least one overview
+
+    # full-extent window at native size: must reproduce the source
+    got = store.read_window(env, w, h)
+    np.testing.assert_array_equal(got, data)
+
+    # sub-window export -> GeoTIFF -> re-read parity
+    sub = Envelope(-5.0, 40.0, 10.0, 50.0)
+    dst = tmp_path / "window.tif"
+    window = store.export_window_geotiff(dst, sub, 120, 80)
+    back, benv = read_geotiff(dst)
+    np.testing.assert_array_equal(back, window)
+    assert benv.xmin == pytest.approx(sub.xmin)
+    assert benv.ymax == pytest.approx(sub.ymax)
+
+
+def test_reader_rejects_non_tiff(tmp_path):
+    p = tmp_path / "x.bin"
+    p.write_bytes(b"NOPE not a tiff")
+    with pytest.raises(ValueError, match="byte-order"):
+        read_geotiff(p)
+
+
+def test_reader_rejects_bigtiff():
+    buf = io.BytesIO(struct.pack("<2sHI", b"II", 43, 16))
+    with pytest.raises(ValueError, match="BigTIFF"):
+        read_geotiff(buf)
+
+
+def test_missing_georef_reads_but_wont_ingest(tmp_path):
+    # a TIFF without ModelPixelScale/Tiepoint reads (env=None) but the
+    # store refuses to ingest it
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 255, (64, 64), dtype=np.uint8)
+    raw = _write_tiled_tiff(data, geo=False)
+    got, env = read_geotiff(io.BytesIO(raw))
+    np.testing.assert_array_equal(got, data)
+    assert env is None
+    p = tmp_path / "nogeo.tif"
+    p.write_bytes(raw)
+    with pytest.raises(ValueError, match="georeferencing"):
+        RasterStore().ingest_geotiff(p)

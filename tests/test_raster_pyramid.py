@@ -129,3 +129,15 @@ def test_web_raster_endpoint():
         lon = -10 + (_np.arange(64) + 0.5) * 40 / 64
         want = _np.sin(_np.radians(lon))[None, :] * 100 + _np.cos(_np.radians(lat))[:, None] * 50
         assert _np.abs(grid - want).mean() < 2.0
+        # format=geotiff serves the same window as image/tiff
+        import io as _io
+
+        from geomesa_tpu.raster_io import read_geotiff
+
+        resp = urllib.request.urlopen(
+            f"{url}/raster?bbox=-10,-5,30,15&width=64&height=32&format=geotiff"
+        )
+        assert resp.headers["Content-Type"] == "image/tiff"
+        tif, tenv = read_geotiff(_io.BytesIO(resp.read()))
+        _np.testing.assert_allclose(tif, grid)
+        assert (tenv.xmin, tenv.ymax) == (-10.0, 15.0)
