@@ -283,12 +283,19 @@ def walk(f: Filter):
 def properties(f: Filter) -> List[str]:
     """All property names referenced by the filter. IdFilter reads the
     feature id, reported as the internal "__fid__" column so scans gather
-    it for evaluation."""
+    it for evaluation. ``$.attr.path`` json-path properties report the
+    UNDERLYING attribute (the stored column evaluation reads); the full
+    path stays on the filter node for the extraction step."""
     out = []
     for node in walk(f):
         p = getattr(node, "prop", None)
-        if p is not None and p not in out:
-            out.append(p)
+        if p is not None:
+            if p.startswith("$."):
+                from geomesa_tpu.filter.jsonpath import parse_path
+
+                p = parse_path(p)[0]  # one parser for the syntax
+            if p not in out:
+                out.append(p)
         if isinstance(node, IdFilter) and "__fid__" not in out:
             out.append("__fid__")
     return out

@@ -107,7 +107,13 @@ def evaluate(f: ast.Filter, ft: FeatureType, columns: Columns) -> np.ndarray:
 def _column(ft: FeatureType, prop: str, columns: Columns):
     """(values, valid_mask) for an attribute column. Dictionary-encoded
     string columns return their int32 CODES — predicate evaluators map
-    literals into code space via the sorted vocab (``prop__vocab``)."""
+    literals into code space via the sorted vocab (``prop__vocab``).
+    ``$.attr.path`` properties extract from json-typed String columns
+    (JsonPathPropertyAccessor analog)."""
+    if prop.startswith("$."):
+        from geomesa_tpu.filter.jsonpath import json_path_column
+
+        return json_path_column(ft, prop, columns)
     attr = ft.attr(prop)
     col = columns[prop]
     if attr.type in (AttributeType.FLOAT, AttributeType.DOUBLE):
@@ -120,6 +126,8 @@ def _column(ft: FeatureType, prop: str, columns: Columns):
 
 
 def _vocab(columns: Columns, prop: str):
+    if prop.startswith("$."):
+        return None  # extracted json values have no code space
     return columns.get(prop + "__vocab")
 
 
@@ -133,6 +141,8 @@ def _object_valid(col: np.ndarray) -> np.ndarray:
 
 
 def _coerce(ft: FeatureType, prop: str, v):
+    if prop.startswith("$."):
+        return v  # json leaves keep their parsed type (str/num/bool)
     attr = ft.attr(prop)
     if attr.type == AttributeType.DATE and isinstance(v, str):
         from geomesa_tpu.filter.parser import parse_instant_ms

@@ -1,0 +1,122 @@
+"""JSON path access into json-typed String attributes.
+
+Reference: geomesa-feature-kryo's JSON support — property syntax
+``$.attr.path.to.field`` where the first path element selects a String
+attribute flagged ``json=true`` and the rest selects within the stored
+document (JsonPathPropertyAccessor.scala: ``canHandle``/``get``;
+KryoJsonSerialization.scala:1-525 evaluates paths against serialized
+bytes). Filter predicates do not support jayway filter expressions,
+matching JsonPathParser.scala's "does not support filter predicates".
+
+TPU-first twist: JSON attributes live in dictionary-encoded string
+columns, so extraction parses each DISTINCT vocab entry ONCE and
+broadcasts the result through the int32 codes — a query over millions
+of rows pays len(vocab) json.loads calls, not n.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import re
+from typing import Any, List, Optional, Tuple, Union
+
+import numpy as np
+
+# $.attr , $.attr.key , $.attr[2] , $.attr.key[0].sub , trailing .* wildcard
+_STEP_RE = re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]|\.(\*)")
+
+Step = Union[str, int]
+
+
+def is_json_path(prop: str) -> bool:
+    return isinstance(prop, str) and prop.startswith("$.")
+
+
+@functools.lru_cache(maxsize=512)
+def parse_path(prop: str) -> Tuple[str, Tuple[Step, ...]]:
+    """``$.attr.a[0].b`` -> ("attr", ("a", 0, "b")). Raises on syntax the
+    subset doesn't cover (filter predicates, deep scans, non-trailing
+    wildcards). Cached: converter transforms re-evaluate the same
+    constant path once per row."""
+    if not is_json_path(prop):
+        raise ValueError(f"not a json path: {prop!r}")
+    pos = 1  # skip "$"
+    steps: List[Step] = []
+    while pos < len(prop):
+        m = _STEP_RE.match(prop, pos)
+        if not m:
+            raise ValueError(f"bad json path at {pos}: {prop!r}")
+        if m.group(1) is not None:
+            steps.append(m.group(1))
+        elif m.group(2) is not None:
+            steps.append(int(m.group(2)))
+        else:
+            steps.append("*")
+        pos = m.end()
+    if not steps or not isinstance(steps[0], str) or steps[0] == "*":
+        raise ValueError(f"json path must start with an attribute: {prop!r}")
+    if "*" in steps[:-1]:
+        # extract() flattens one level at the tail only; a mid-path
+        # wildcard would need fan-out mapping — reject loudly rather
+        # than silently matching nothing
+        raise ValueError(f"wildcard is only supported as the last step: {prop!r}")
+    return steps[0], tuple(steps[1:])
+
+
+def extract(doc: Any, steps: List[Step]) -> Any:
+    """Walk parsed JSON; missing/mismatched steps yield None. A ``*``
+    wildcard flattens one level (list of children)."""
+    cur = doc
+    for s in steps:
+        if cur is None:
+            return None
+        if s == "*":
+            if isinstance(cur, dict):
+                cur = list(cur.values())
+            elif not isinstance(cur, list):
+                return None
+        elif isinstance(s, int):
+            cur = cur[s] if isinstance(cur, (list, tuple)) and s < len(cur) else None
+        else:
+            cur = cur.get(s) if isinstance(cur, dict) else None
+    return cur
+
+
+def _extract_str(s: Optional[str], steps: List[Step]) -> Any:
+    if not isinstance(s, str):
+        return None
+    try:
+        return extract(json.loads(s), steps)
+    except ValueError:
+        return None
+
+
+def json_path_column(ft, prop: str, columns) -> Tuple[np.ndarray, np.ndarray]:
+    """(values object array, valid mask) for a ``$.attr.path`` property.
+
+    The attribute must be a json-typed String (AttributeDescriptor.json);
+    dictionary-coded columns evaluate the path once per vocab entry.
+    """
+    attr_name, steps = parse_path(prop)
+    attr = ft.attr(attr_name)
+    if not getattr(attr, "json", False):
+        raise ValueError(
+            f"attribute {attr_name!r} is not json-typed "
+            f"(declare it as {attr_name}:String:json=true)"
+        )
+    vocab = columns.get(attr_name + "__vocab")
+    col = columns[attr_name]
+    if vocab is not None:
+        per_vocab = np.empty(len(vocab) + 1, dtype=object)
+        for i, s in enumerate(vocab):
+            per_vocab[i] = _extract_str(s, steps)
+        per_vocab[len(vocab)] = None  # code -1 (null) indexes here
+        codes = np.asarray(col, dtype=np.int64)
+        values = per_vocab[np.where(codes >= 0, codes, len(vocab))]
+    else:
+        values = np.empty(len(col), dtype=object)
+        for i, s in enumerate(col):
+            values[i] = _extract_str(s, steps)
+    valid = np.not_equal(values, None)
+    return values, valid

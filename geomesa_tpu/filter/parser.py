@@ -63,6 +63,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>[-+]?\d*\.?\d+(?:[eE][-+]?\d+)?)
   | (?P<op><=|>=|<>|!=|=|<|>)
   | (?P<punct>[(),/])
+  | (?P<jsonpath>\$\.[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z0-9_*]+|\[\d+\])*)
   | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
     """,
     re.VERBOSE,

@@ -72,6 +72,11 @@ _NUMPY_DTYPES = {
 }
 
 _TYPE_ALIASES = {
+    # "json" is storage-wise a String with the json flag set — the
+    # reference models it the same way (a String attribute with
+    # user-data json=true; KryoJsonSerialization.scala:1-525 stores the
+    # parsed document, here the string column is the document of record)
+    "json": AttributeType.STRING,
     "string": AttributeType.STRING,
     "int": AttributeType.INT,
     "integer": AttributeType.INT,
@@ -116,6 +121,16 @@ class AttributeDescriptor:
         """Attribute-index flag (``index=true`` / ``index=join`` option)."""
         v = self.options.get("index", "false").lower()
         return v in ("true", "full", "join")
+
+    @property
+    def json(self) -> bool:
+        """JSON-typed String attribute (``:json=true`` or the ``json``
+        type alias): path expressions ``$.name.path`` select into the
+        stored document (JsonPathPropertyAccessor analog)."""
+        return (
+            self.type == AttributeType.STRING
+            and self.options.get("json", "false").lower() == "true"
+        )
 
     def spec(self) -> str:
         parts = [f"{'*' if self.default_geom else ''}{self.name}:{self.type.value}"]
@@ -298,6 +313,14 @@ def parse_spec(name: str, spec: str) -> FeatureType:
                 raise ValueError(f"Bad attribute option: {opt!r}")
             k, v = opt.split("=", 1)
             options[k.strip()] = v.strip().strip("'\"")
+        if tname == "json":
+            options.setdefault("json", "true")
+        if options.get("json", "false").lower() == "true" and (
+            _TYPE_ALIASES[tname] != AttributeType.STRING
+        ):
+            raise ValueError(
+                f"json=true requires a String attribute: {entry!r}"
+            )
         attrs.append(
             AttributeDescriptor(aname, _TYPE_ALIASES[tname], default_geom, options)
         )

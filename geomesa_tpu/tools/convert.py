@@ -195,7 +195,34 @@ _FUNCTIONS: Dict[str, Callable] = {
     "now": lambda: int(__import__("time").time() * 1000),
     "secstomillis": _FN_SECS_TO_MILLIS,
     "millistosecs": lambda v: None if v in (None, "") else int(float(v) // 1000),
+    # jsonPath('$.a.b[0]', $jsonfield): select within a JSON document
+    # string (JsonPathFilterFunction analog; path is document-relative)
+    "jsonpath": lambda path, v: _fn_jsonpath(path, v),
+    "jsontostring": lambda v: None if v is None else (
+        v if isinstance(v, str) else __import__("json").dumps(v)
+    ),
 }
+
+
+def _fn_jsonpath(path, v):
+    import json as _json
+
+    from geomesa_tpu.filter.jsonpath import extract, parse_path
+
+    if v in (None, ""):
+        return None
+    path = str(path)
+    if not path.startswith("$"):
+        raise ValueError(f"jsonPath expects a '$.'-rooted path: {path!r}")
+    # document-relative: "$.a.b" selects within v, so prepend a synthetic
+    # root segment for the attribute-first parser (parse_path is cached —
+    # one parse per distinct path, not per row)
+    _, steps = parse_path("$.doc" + path[1:])
+    try:
+        doc = v if not isinstance(v, str) else _json.loads(v)
+    except ValueError:
+        return None
+    return extract(doc, steps)
 
 
 class _Parser:

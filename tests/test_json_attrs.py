@@ -1,0 +1,216 @@
+"""JSON-typed attributes + path access (VERDICT r3 #7).
+
+Reference: geomesa-feature-kryo JSON support — a String attribute
+flagged json=true stores a document; property syntax ``$.attr.path``
+selects into it (JsonPathPropertyAccessor.scala), and the jsonPath
+function evaluates document-relative paths
+(JsonPathFilterFunction.scala; KryoJsonSerialization.scala:1-525).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter.jsonpath import extract, is_json_path, parse_path
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import AttributeType, parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+from geomesa_tpu.store.memory import MemoryDataStore
+
+SPEC = "dtg:Date,props:json,name:String,*geom:Point:srid=4326"
+
+
+def test_json_type_alias_and_flag():
+    ft = parse_spec("t", SPEC)
+    a = ft.attr("props")
+    assert a.type == AttributeType.STRING
+    assert a.json
+    assert not ft.attr("name").json
+    # spec round-trips with the flag
+    from geomesa_tpu.schema.featuretype import encode_spec
+
+    assert parse_spec("t", encode_spec(ft)).attr("props").json
+
+
+def test_json_flag_requires_string():
+    with pytest.raises(ValueError, match="String"):
+        parse_spec("t", "n:Integer:json=true")
+
+
+def test_path_parser():
+    assert parse_path("$.a.b") == ("a", ("b",))
+    assert parse_path("$.a.b[2].c") == ("a", ("b", 2, "c"))
+    assert parse_path("$.a") == ("a", ())
+    assert parse_path("$.a.*") == ("a", ("*",))
+    assert is_json_path("$.a") and not is_json_path("a")
+    with pytest.raises(ValueError):
+        parse_path("$[0]")
+    with pytest.raises(ValueError):
+        parse_path("plain")
+    # mid-path wildcards are rejected loudly (extract only flattens at
+    # the tail; silent None-matching would look like an empty result)
+    with pytest.raises(ValueError, match="wildcard"):
+        parse_path("$.a.*.b")
+
+
+def test_jsonpath_fn_rejects_unrooted_path():
+    from geomesa_tpu.tools.convert import _fn_jsonpath
+
+    with pytest.raises(ValueError, match="rooted"):
+        _fn_jsonpath("foo.bar", json.dumps({"foo": {"bar": 1}, "bar": 99}))
+    assert _fn_jsonpath("$.foo.bar", json.dumps({"foo": {"bar": 1}})) == 1
+
+
+def test_extract_walk():
+    doc = {"a": {"b": [10, {"c": "x"}]}, "n": None}
+    assert extract(doc, ["a", "b", 0]) == 10
+    assert extract(doc, ["a", "b", 1, "c"]) == "x"
+    assert extract(doc, ["a", "missing"]) is None
+    assert extract(doc, ["a", "b", 9]) is None
+    assert extract(doc, ["n", "deeper"]) is None
+    assert extract(doc, ["a", "*"]) == [[10, {"c": "x"}]]
+
+
+def _seed(n=1500, seed=5):
+    rng = np.random.default_rng(seed)
+    base = int(np.datetime64("2026-06-01", "ms").astype("int64"))
+    rows = []
+    for i in range(n):
+        doc = (
+            json.dumps(
+                {
+                    "type": ["road", "rail", "river"][i % 3],
+                    "score": i % 100,
+                    "nested": {"flag": bool(i % 2)},
+                    "tags": [f"t{i % 5}", "x"],
+                }
+            )
+            if i % 7
+            else None  # null documents interleave
+        )
+        rows.append(
+            [
+                base + i * 1000,
+                doc,
+                f"n{i % 10}",
+                Point(float(rng.uniform(-60, 60)), float(rng.uniform(-50, 50))),
+            ]
+        )
+    return rows
+
+
+QUERIES = [
+    "$.props.type = 'road'",
+    "$.props.type <> 'rail'",
+    "$.props.score > 90",
+    "$.props.score BETWEEN 10 AND 20",
+    "$.props.nested.flag = true",
+    "$.props.tags[0] = 't2'",
+    "$.props.type = 'road' AND bbox(geom, -30, -30, 30, 30)",
+    "$.props.missing IS NULL",
+    "$.props.type IS NOT NULL",
+    "$.props.type IN ('road', 'river')",
+    "$.props.type LIKE 'r%'",
+]
+
+
+def test_three_store_parity():
+    """The device store, host executor store, and the memory oracle must
+    agree on every json-path query shape (null docs included)."""
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+
+    rows = _seed()
+    mem = MemoryDataStore()
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    mem.create_schema(parse_spec("t", SPEC))
+    for i, r in enumerate(rows):
+        mem.write("t", r, fid=f"f{i}")
+    for s in (host, tpu):
+        s.create_schema(parse_spec("t", SPEC))
+        with s.writer("t") as w:
+            for i, r in enumerate(rows):
+                w.write(r, fid=f"f{i}")
+    for cql in QUERIES:
+        want = sorted(mem.query("t", cql).fids)
+        assert sorted(host.query("t", cql).fids) == want, cql
+        assert sorted(tpu.query("t", cql).fids) == want, cql
+        assert len(want) > 0 or cql == "", cql
+
+
+def test_non_json_attribute_rejected():
+    host = TpuDataStore(executor=HostScanExecutor())
+    host.create_schema(parse_spec("t", SPEC))
+    with host.writer("t") as w:
+        w.write(
+            [0, json.dumps({"a": 1}), "n", Point(0.0, 0.0)], fid="f0"
+        )
+    with pytest.raises(ValueError, match="json-typed"):
+        host.query("t", "$.name.sub = 'x'")
+
+
+def test_jsonpath_transform_projection():
+    """jsonPath('$.path', $attr) in query transforms extracts values
+    (the transform/filter-function edge of the reference's json support)."""
+    host = TpuDataStore(executor=HostScanExecutor())
+    host.create_schema(parse_spec("t", SPEC))
+    rows = _seed(200)
+    with host.writer("t") as w:
+        for i, r in enumerate(rows):
+            w.write(r, fid=f"f{i}")
+    from geomesa_tpu.index.planner import Query
+
+    res = host.query(
+        "t",
+        Query.cql(
+            "$.props.score > 95",
+            properties=["kind=jsonPath('$.type', $props)", "geom"],
+        ),
+    )
+    kinds = set(res.columns["kind"])
+    assert kinds <= {"road", "rail", "river"}
+    assert len(res.fids) > 0
+
+
+def test_converter_ingest_json_column():
+    """Delimited ingest with a json field + path query, parity vs the
+    memory oracle (the 'ingest GDELT with a json column' done-check)."""
+    import io
+
+    from geomesa_tpu.tools.convert import SimpleFeatureConverter
+
+    spec = "props:json,val:Integer,*geom:Point:srid=4326"
+    conv = SimpleFeatureConverter(
+        parse_spec("t", spec),
+        {
+            "type": "delimited-text",
+            "format": "TSV",
+            "id-field": "$1",
+            "fields": [
+                {"name": "props", "transform": "$2"},
+                {"name": "val", "transform": "toInt($3)"},
+                {"name": "geom", "transform": "point($4, $5)"},
+            ],
+        },
+    )
+    lines = []
+    for i in range(300):
+        doc = json.dumps({"kind": ["a", "b"][i % 2], "rank": i})
+        lines.append(f"r{i}\t{doc}\t{i}\t{i % 90 - 45}\t{i % 80 - 40}")
+    text = "\n".join(lines)
+
+    host = TpuDataStore(executor=HostScanExecutor())
+    host.create_schema(parse_spec("t", spec))
+    mem = MemoryDataStore()
+    mem.create_schema(parse_spec("t", spec))
+    feats = list(conv.convert(io.StringIO(text)))
+    with host.writer("t") as w:
+        for f in feats:
+            w.write(f.values, fid=f.fid)
+    for f in feats:
+        mem.write("t", f.values, fid=f.fid)
+    for cql in ("$.props.kind = 'a'", "$.props.rank > 250"):
+        want = sorted(mem.query("t", cql).fids)
+        assert sorted(host.query("t", cql).fids) == want, cql
+        assert want, cql
