@@ -23,7 +23,14 @@ import numpy as np
 
 from geomesa_tpu.schema.featuretype import FeatureType
 from geomesa_tpu.stats.parser import parse_stat
-from geomesa_tpu.stats.sketches import EnvelopeStat, MinMax, Stat, Z3HistogramStat
+from geomesa_tpu.stats.sketches import (
+    EnvelopeStat,
+    GroupByStat,
+    MinMax,
+    Stat,
+    Z3FrequencyStat,
+    Z3HistogramStat,
+)
 
 
 AGGREGATION_HINTS = ("density", "stats", "bin", "arrow")
@@ -104,8 +111,11 @@ def run_stats(ft: FeatureType, spec: str, columns) -> Stat:
     geom = ft.default_geometry
     n = len(next(iter(columns.values()), []))
     for i, s in enumerate(stats):
-        if isinstance(s, Z3HistogramStat):
+        if isinstance(s, (Z3HistogramStat, Z3FrequencyStat)):
             s.observe_xyt(columns[s.geom + "__x"], columns[s.geom + "__y"], columns[s.dtg])
+            continue
+        if isinstance(s, GroupByStat):
+            _observe_groupby(s, columns)
             continue
         attr = getattr(s, "attribute", None)
         if attr is None:  # CountStat
@@ -126,6 +136,44 @@ def run_stats(ft: FeatureType, spec: str, columns) -> Stat:
         nulls = columns.get(attr + "__null")
         s.observe(columns[attr], nulls)
     return stat
+
+
+def _observe_groupby(s: GroupByStat, columns) -> None:
+    """GroupBy over candidate columns: keys from the grouping attribute,
+    values from the sub-stat's own attribute (Count subs only need group
+    sizes). Decodes dictionary columns so group keys are real values."""
+    import json as _json
+
+    def col_values(name):
+        col = np.asarray(columns[name])
+        vocab = columns.get(name + "__vocab")
+        if vocab is not None:
+            v = np.asarray(vocab, dtype=object)
+            out = np.empty(len(col), dtype=object)
+            ok = col >= 0
+            out[ok] = v[col[ok].astype(np.int64)]
+            return out
+        nulls = columns.get(name + "__null")
+        if nulls is not None:
+            # decoded columns carry nulls as fill values ("" / 0) — mask
+            # them back to None so null keys never form a group
+            out = np.asarray(col, dtype=object).copy()
+            out[np.asarray(nulls, dtype=bool)] = None
+            return out
+        return col
+
+    keys = col_values(s.attribute)
+    sub_attr = _json.loads(s.example).get("attribute")
+    if sub_attr is None:
+        values = keys  # Count(): only group sizes matter
+    elif sub_attr in columns:
+        values = col_values(sub_attr)
+    else:
+        # a silent keys-fallback would return confidently wrong
+        # sub-stats (MinMax over the group labels)
+        raise KeyError(f"GroupBy sub-stat attribute {sub_attr!r} not gathered")
+    nulls = columns.get((sub_attr or s.attribute) + "__null")
+    s.observe_grouped(keys, values, nulls)
 
 
 # 16-byte BIN record: trackId hash (i32) | dtg seconds (i32) | lat f32 | lon f32

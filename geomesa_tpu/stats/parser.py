@@ -5,6 +5,8 @@ Grammar subset:
     single   := Count() | MinMax(a) | Enumeration(a) | TopK(a[,cap])
               | Histogram(a,bins,lo,hi) | Frequency(a[,width])
               | DescriptiveStats(a) | Z3Histogram(geom,dtg,period,length)
+              | Z3Frequency(geom,dtg[,period[,precision[,width]]])
+              | GroupBy(a, single)          -- nested sub-stat per key
 """
 
 from __future__ import annotations
@@ -17,11 +19,13 @@ from geomesa_tpu.stats.sketches import (
     DescriptiveStats,
     EnumerationStat,
     Frequency,
+    GroupByStat,
     Histogram,
     MinMax,
     SeqStat,
     Stat,
     TopK,
+    Z3FrequencyStat,
     Z3HistogramStat,
 )
 
@@ -36,6 +40,15 @@ def parse_stat(spec: str) -> Stat:
     parts = [p for p in spec.split(";") if p.strip()]
     stats: List[Stat] = []
     for part in parts:
+        # GroupBy nests a full sub-stat spec -> balanced-paren special case
+        g = re.match(
+            r"\s*GroupBy\s*\(\s*['\"]?([A-Za-z0-9_]+)['\"]?\s*,\s*(.+)\)\s*$",
+            part,
+            re.IGNORECASE,
+        )
+        if g:
+            stats.append(GroupByStat(g.group(1), parse_stat(g.group(2))))
+            continue
         m = _CALL.match(part)
         if not m:
             raise ValueError(f"bad stat spec: {part!r}")
@@ -54,6 +67,16 @@ def parse_stat(spec: str) -> Stat:
             stats.append(Frequency(args[0], int(args[1]) if len(args) > 1 else 1024))
         elif name == "descriptivestats":
             stats.append(DescriptiveStats(args[0]))
+        elif name == "z3frequency":
+            stats.append(
+                Z3FrequencyStat(
+                    args[0],
+                    args[1],
+                    args[2] if len(args) > 2 else "week",
+                    int(args[3]) if len(args) > 3 else 25,
+                    int(args[4]) if len(args) > 4 else 1024,
+                )
+            )
         elif name == "z3histogram":
             stats.append(
                 Z3HistogramStat(

@@ -420,12 +420,7 @@ class Frequency(Stat):
         self.table = np.zeros((self._DEPTH, self.width), dtype=np.int64)
 
     def _hashes(self, values: np.ndarray) -> np.ndarray:
-        base = _hash64(values)
-        rows = []
-        for d in range(self._DEPTH):
-            h = _mix64(base + np.uint64((0x9E3779B97F4A7C15 * (d + 1)) & 0xFFFFFFFFFFFFFFFF))
-            rows.append((h % np.uint64(self.width)).astype(np.int64))
-        return np.stack(rows)
+        return _cms_rows(_hash64(values), self.width, self._DEPTH)
 
     def observe(self, values, nulls=None):
         values = _clean(np.asarray(values), nulls)
@@ -631,6 +626,220 @@ class Z3HistogramStat(Stat):
         return not self.counts
 
 
+def _cms_rows(base: np.ndarray, width: int, depth: int) -> np.ndarray:
+    """Count-min row indices from 64-bit base hashes (shared by the
+    attribute Frequency and the Z3Frequency editions)."""
+    rows = []
+    for d in range(depth):
+        h = _mix64(base + np.uint64((0x9E3779B97F4A7C15 * (d + 1)) & 0xFFFFFFFFFFFFFFFF))
+        rows.append((h % np.uint64(width)).astype(np.int64))
+    return np.stack(rows)
+
+
+class Z3FrequencyStat(Stat):
+    """Spatio-temporal frequency: one count-min sketch PER TIME BIN over
+    z3 values masked to ``precision`` bits (stats/Z3Frequency.scala —
+    geometry+date tracked as a single z value; estimates within eps*N).
+    Bins that never observed anything answer 0 exactly."""
+
+    kind = "z3frequency"
+    _DEPTH = 4
+
+    def __init__(self, geom: str, dtg: str, period: str = "week",
+                 precision: int = 25, width: int = 1024):
+        self.geom = geom
+        self.dtg = dtg
+        self.period = TimePeriod.parse(period)
+        self.precision = int(precision)
+        self.width = int(width)
+        self.sketches: Dict[int, np.ndarray] = {}  # bin -> (DEPTH, width)
+
+    def _masked(self, z: np.ndarray) -> np.ndarray:
+        # keep the TOP precision bits of the 63-bit key: nearby points
+        # (same coarse z cell) collide into one counted value
+        mask = np.uint64((~((1 << (63 - self.precision)) - 1)) & (2**64 - 1))
+        return np.asarray(z).astype(np.uint64) & mask
+
+    def observe_xyt(self, x: np.ndarray, y: np.ndarray, t_ms: np.ndarray) -> None:
+        ok = ~(np.isnan(x) | np.isnan(y))
+        x, y, t_ms = x[ok], y[ok], np.asarray(t_ms)[ok]
+        if not len(x):
+            return
+        bins, offsets = time_to_binned(t_ms, self.period, lenient=True)
+        sfc = Z3SFC.for_period(self.period)
+        self.observe_keys(sfc.index(x, y, offsets, lenient=True), bins)
+
+    def observe_keys(self, keys: np.ndarray, bins: np.ndarray) -> None:
+        """Precomputed-key edition (a sealed z3 block's key columns)."""
+        z = self._masked(keys)
+        bins = np.asarray(bins)
+        for b in np.unique(bins):
+            sel = bins == b
+            uniq, cnt = np.unique(z[sel], return_counts=True)
+            idx = _cms_rows(_mix64(uniq), self.width, self._DEPTH)
+            table = self.sketches.setdefault(
+                int(b), np.zeros((self._DEPTH, self.width), dtype=np.int64)
+            )
+            for d in range(self._DEPTH):
+                np.add.at(table[d], idx[d], cnt)
+
+    def count(self, x: float, y: float, t_ms: int) -> int:
+        bins, offsets = time_to_binned(
+            np.asarray([t_ms]), self.period, lenient=True
+        )
+        sfc = Z3SFC.for_period(self.period)
+        z = sfc.index(np.asarray([x]), np.asarray([y]), offsets, lenient=True)
+        return self.count_direct(int(bins[0]), int(z[0]))
+
+    def count_direct(self, time_bin: int, z: int) -> int:
+        table = self.sketches.get(int(time_bin))
+        if table is None:
+            return 0
+        zu = self._masked(np.asarray([z], dtype=np.uint64))
+        idx = _cms_rows(_mix64(zu), self.width, self._DEPTH)
+        return int(min(table[d, idx[d, 0]] for d in range(self._DEPTH)))
+
+    def observe(self, values, nulls=None):
+        raise TypeError("Z3FrequencyStat.observe_xyt(x, y, t) required")
+
+    def merge(self, other):
+        if (
+            other.width != self.width
+            or other.precision != self.precision
+            or other.period != self.period
+        ):
+            # periods key the integer time bins: summing week-binned and
+            # day-binned tables would silently corrupt counts
+            raise ValueError("z3frequency shapes differ")
+        for b, table in other.sketches.items():
+            mine = self.sketches.setdefault(
+                b, np.zeros((self._DEPTH, self.width), dtype=np.int64)
+            )
+            mine += table
+
+    def state(self):
+        return {
+            "geom": self.geom,
+            "dtg": self.dtg,
+            "period": self.period.value,
+            "precision": self.precision,
+            "width": self.width,
+            "sketches": {str(b): t.tolist() for b, t in self.sketches.items()},
+        }
+
+    @property
+    def is_empty(self):
+        return not self.sketches
+
+
+def _json_key(k):
+    """Group keys serialize as [typecode, value] so ints/floats/strings/
+    bools round-trip distinguishably through JSON object-less arrays."""
+    if isinstance(k, np.generic):
+        k = k.item()
+    if isinstance(k, bool):
+        return ["b", k]
+    if isinstance(k, int):
+        return ["i", k]
+    if isinstance(k, float):
+        return ["f", k]
+    return ["s", str(k)]
+
+
+def _unjson_key(tk):
+    t, v = tk
+    return {"b": bool, "i": int, "f": float, "s": str}[t](v)
+
+
+class GroupByStat(Stat):
+    """Per-group sub-sketches keyed by an attribute's value
+    (stats/GroupBy.scala: groupedStats map + an example stat re-parsed
+    per new key). ``example`` is the EMPTY sub-stat's JSON — each new
+    group clones it, merges combine per key."""
+
+    kind = "groupby"
+
+    def __init__(self, attribute: str, example):
+        self.attribute = attribute
+        self.example = example.to_json() if isinstance(example, Stat) else str(example)
+        self.groups: Dict[Any, Stat] = {}
+
+    def _new(self) -> Stat:
+        return from_json(self.example)
+
+    def size(self) -> int:
+        return len(self.groups)
+
+    def get(self, key) -> Optional[Stat]:
+        return self.groups.get(key)
+
+    def observe_grouped(
+        self, keys: np.ndarray, values: np.ndarray,
+        nulls: Optional[np.ndarray] = None,
+    ) -> None:
+        """Group rows by key and feed each group's slice of ``values`` to
+        that group's sub-stat (null keys are skipped, like the reference
+        skipping features whose grouping attribute is missing)."""
+        keys = np.asarray(keys)
+        values = np.asarray(values)
+        kvalid = _object_ok(keys)
+        for k in _unique_obj(keys[kvalid]):
+            sel = kvalid & (keys == k)
+            sub = self.groups.get(k)
+            if sub is None:
+                sub = self.groups[k] = self._new()
+            sub.observe(values[sel], None if nulls is None else nulls[sel])
+
+    def observe(self, values, nulls=None):
+        # grouping attribute observed by its own sub-stat (GroupBy(a, Count()))
+        self.observe_grouped(values, values, nulls)
+
+    def merge(self, other):
+        for k, stat in other.groups.items():
+            mine = self.groups.get(k)
+            if mine is None:
+                self.groups[k] = from_json(stat.to_json())
+            else:
+                mine.merge(stat)
+
+    def state(self):
+        try:
+            items = sorted(self.groups.items(), key=lambda kv: kv[0])
+        except TypeError:
+            items = sorted(self.groups.items(), key=lambda kv: str(kv[0]))
+        return {
+            "attribute": self.attribute,
+            "example": json.loads(self.example),
+            "groups": [
+                [_json_key(k), json.loads(v.to_json())] for k, v in items
+            ],
+        }
+
+    @property
+    def is_empty(self):
+        return all(s.is_empty for s in self.groups.values())
+
+
+def _object_ok(keys: np.ndarray) -> np.ndarray:
+    if keys.dtype.kind == "O":
+        return np.not_equal(keys, None)
+    if keys.dtype.kind == "f":
+        return ~np.isnan(keys)
+    return np.ones(len(keys), dtype=bool)
+
+
+def _unique_obj(keys: np.ndarray):
+    if keys.dtype.kind == "O":
+        seen = []
+        s = set()
+        for k in keys:
+            if k not in s:
+                s.add(k)
+                seen.append(k)
+        return seen
+    return [k.item() for k in np.unique(keys)]
+
+
 class SeqStat(Stat):
     """Multiple sketches observed together (Stat.scala SeqStat)."""
 
@@ -673,6 +882,8 @@ for _cls in (
     DescriptiveStats,
     EnvelopeStat,
     Z3HistogramStat,
+    Z3FrequencyStat,
+    GroupByStat,
     SeqStat,
 ):
     _register(_cls)
@@ -725,6 +936,21 @@ def _from_state(d: Dict[str, Any]) -> Stat:
     if kind == "z3histogram":
         s = Z3HistogramStat(d["geom"], d["dtg"], d["period"], d["length"])
         s.counts = {int(b): np.asarray(a, dtype=np.int64) for b, a in d["counts"].items()}
+        return s
+    if kind == "z3frequency":
+        s = Z3FrequencyStat(
+            d["geom"], d["dtg"], d["period"], d["precision"], d["width"]
+        )
+        s.sketches = {
+            int(b): np.asarray(t, dtype=np.int64)
+            for b, t in d["sketches"].items()
+        }
+        return s
+    if kind == "groupby":
+        s = GroupByStat(d["attribute"], json.dumps(d["example"]))
+        s.groups = {
+            _unjson_key(tk): _from_state(dict(v)) for tk, v in d["groups"]
+        }
         return s
     if kind == "seq":
         return SeqStat([_from_state(x) for x in d["stats"]])

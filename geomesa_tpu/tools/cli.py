@@ -205,6 +205,30 @@ def cmd_stats_histogram(args) -> int:
     return 0
 
 
+def cmd_stats_groupby(args) -> int:
+    """Per-group sub-stats via a stats-hint query (GroupBy.scala analog):
+    geomesa stats-groupby <name> --attribute a [--stat 'Count()'] [--cql]."""
+    import json as _json
+
+    from geomesa_tpu.index.planner import Query
+
+    ds = _store(args)
+    ft = ds.get_schema(args.name)
+    if not ft.has(args.attribute) or ft.attr(args.attribute).type.is_geometry:
+        print("no such groupable attribute", file=sys.stderr)
+        return 1
+    q = Query.cql(args.cql)
+    q.hints["stats"] = f"GroupBy({args.attribute}, {args.stat})"
+    res = ds.query(args.name, q)
+    stat = res.aggregate.get("stats")
+    if stat is None or stat.is_empty:
+        print("no groups", file=sys.stderr)
+        return 1
+    for tk, sub in stat.state()["groups"]:
+        print(f"{tk[1]}\t{_json.dumps(sub)}")
+    return 0
+
+
 def cmd_stats_topk(args) -> int:
     ds = _store(args)
     ft = ds.get_schema(args.name)
@@ -299,6 +323,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("stats-histogram", cmd_stats_histogram)
     sp.add_argument("--attribute", required=True)
     sp.add_argument("--bins", type=int, default=20)
+    sp = add("stats-groupby", cmd_stats_groupby)
+    sp.add_argument("--attribute", required=True)
+    sp.add_argument("--stat", default="Count()")
+    sp.add_argument("--cql", default="INCLUDE")
     add("version", cmd_version, store=False, type_name=False)
     add("env", cmd_env, store=False, type_name=False)
     return p

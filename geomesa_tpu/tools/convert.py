@@ -212,7 +212,8 @@ def _fn_jsonpath(path, v):
     if v in (None, ""):
         return None
     path = str(path)
-    if not path.startswith("$"):
+    if path != "$" and not path.startswith("$."):
+        # '$foo.bar' would silently glue 'foo' onto the synthetic root
         raise ValueError(f"jsonPath expects a '$.'-rooted path: {path!r}")
     # document-relative: "$.a.b" selects within v, so prepend a synthetic
     # root segment for the attribute-first parser (parse_path is cached —

@@ -1,0 +1,262 @@
+"""GroupBy + Z3Frequency sketches (VERDICT r3 #8).
+
+Reference: geomesa-utils stats/GroupBy.scala (per-key sub-stats cloned
+from an example spec, merged per key) and stats/Z3Frequency.scala (one
+count-min sketch per time bin over precision-masked z3 values).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.stats.parser import parse_stat
+from geomesa_tpu.stats.sketches import (
+    CountStat,
+    GroupByStat,
+    MinMax,
+    TopK,
+    Z3FrequencyStat,
+    from_json,
+)
+
+
+def test_groupby_observe_and_counts():
+    g = GroupByStat("kind", CountStat())
+    keys = np.array(["a", "b", "a", None, "c", "a"], dtype=object)
+    g.observe(keys)
+    assert g.size() == 3
+    assert g.get("a").count == 3
+    assert g.get("b").count == 1
+    assert g.get("c").count == 1
+    assert not g.is_empty
+
+
+def test_groupby_sub_minmax_over_other_attribute():
+    g = GroupByStat("kind", MinMax("val"))
+    keys = np.array(["x", "y", "x", "y"], dtype=object)
+    vals = np.array([5.0, 100.0, -2.0, 40.0])
+    g.observe_grouped(keys, vals)
+    assert g.get("x").min == -2.0 and g.get("x").max == 5.0
+    assert g.get("y").min == 40.0 and g.get("y").max == 100.0
+
+
+def test_groupby_merge_matches_single_pass():
+    keys = np.array([f"k{i % 4}" for i in range(200)], dtype=object)
+    vals = np.arange(200).astype(np.float64)
+    whole = GroupByStat("kind", MinMax("val"))
+    whole.observe_grouped(keys, vals)
+    a = GroupByStat("kind", MinMax("val"))
+    b = GroupByStat("kind", MinMax("val"))
+    a.observe_grouped(keys[:90], vals[:90])
+    b.observe_grouped(keys[90:], vals[90:])
+    merged = a + b
+    assert merged.size() == whole.size()
+    for k in ("k0", "k1", "k2", "k3"):
+        assert merged.get(k).min == whole.get(k).min
+        assert merged.get(k).max == whole.get(k).max
+
+
+def test_groupby_json_roundtrip_key_types():
+    g = GroupByStat("k", CountStat())
+    g.observe(np.array([1, 2, 1], dtype=np.int64))
+    g2 = from_json(g.to_json())
+    assert isinstance(g2, GroupByStat)
+    assert g2.get(1).count == 2 and g2.get(2).count == 1
+    # float + string keys survive distinguishably
+    gs = GroupByStat("k", CountStat())
+    gs.observe(np.array(["1", "2"], dtype=object))
+    gs2 = from_json(gs.to_json())
+    assert gs2.get("1").count == 1
+    assert gs2.get(1) is None  # int 1 is NOT string "1"
+
+
+def test_groupby_spec_parsing_nested():
+    g = parse_stat("GroupBy(actor, TopK(site, 5))")
+    assert isinstance(g, GroupByStat)
+    assert g.attribute == "actor"
+    assert isinstance(g._new(), TopK)
+    # nested in a seq
+    seq = parse_stat("Count();GroupBy(a, Count())")
+    assert any(isinstance(s, GroupByStat) for s in seq.stats)
+
+
+def _xyt(n=3000, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-170, 170, n)
+    y = rng.uniform(-80, 80, n)
+    base = int(np.datetime64("2026-04-01", "ms").astype("int64"))
+    t = base + rng.integers(0, 30 * 86400_000, n)
+    return x, y, t
+
+
+def test_z3frequency_counts_hot_cell():
+    x, y, t = _xyt()
+    # jam a hot cluster into one tiny cell on one day
+    x[:500] = 20.0001
+    y[:500] = 30.0001
+    t[:500] = int(np.datetime64("2026-04-03T12:00", "ms").astype("int64"))
+    zf = Z3FrequencyStat("geom", "dtg", "week", precision=25)
+    zf.observe_xyt(x, y, t)
+    hot = zf.count(20.0001, 30.0001, int(t[0]))
+    cold = zf.count(-150.0, -70.0, int(t[0]))
+    assert hot >= 500  # CMS overestimates, never under
+    assert cold < hot / 5
+    # a bin never observed answers 0 exactly
+    t_other = int(np.datetime64("2027-01-01", "ms").astype("int64"))
+    assert zf.count(20.0, 30.0, t_other) == 0
+
+
+def test_z3frequency_merge_equals_single_pass():
+    x, y, t = _xyt(4000)
+    whole = Z3FrequencyStat("geom", "dtg", "week")
+    whole.observe_xyt(x, y, t)
+    a = Z3FrequencyStat("geom", "dtg", "week")
+    b = Z3FrequencyStat("geom", "dtg", "week")
+    a.observe_xyt(x[:1500], y[:1500], t[:1500])
+    b.observe_xyt(x[1500:], y[1500:], t[1500:])
+    merged = a + b
+    assert set(merged.sketches) == set(whole.sketches)
+    for bin_ in whole.sketches:
+        np.testing.assert_array_equal(merged.sketches[bin_], whole.sketches[bin_])
+
+
+def test_z3frequency_json_roundtrip():
+    x, y, t = _xyt(1000)
+    zf = Z3FrequencyStat("geom", "dtg", "day", precision=20, width=512)
+    zf.observe_xyt(x, y, t)
+    zf2 = from_json(zf.to_json())
+    assert isinstance(zf2, Z3FrequencyStat)
+    assert zf2.period == zf.period and zf2.precision == 20 and zf2.width == 512
+    for bin_ in zf.sketches:
+        np.testing.assert_array_equal(zf2.sketches[bin_], zf.sketches[bin_])
+    assert zf2.count(float(x[0]), float(y[0]), int(t[0])) == zf.count(
+        float(x[0]), float(y[0]), int(t[0])
+    )
+
+
+def test_z3frequency_spec_parsing():
+    zf = parse_stat("Z3Frequency(geom, dtg, week, 22, 2048)")
+    assert isinstance(zf, Z3FrequencyStat)
+    assert zf.precision == 22 and zf.width == 2048
+
+
+def test_stats_hint_query_groupby_and_z3freq():
+    """Both new sketches ride the stats-hint query path (StatsScan
+    analog) end to end through a store."""
+    from geomesa_tpu.geom.base import Point
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+
+    ds = TpuDataStore(executor=HostScanExecutor())
+    ds.create_schema(
+        parse_spec("t", "dtg:Date,kind:String,val:Integer,*geom:Point:srid=4326")
+    )
+    x, y, t = _xyt(800, seed=9)
+    with ds.writer("t") as w:
+        for i in range(800):
+            w.write(
+                [int(t[i]), ["a", "b", "c"][i % 3], i,
+                 Point(float(x[i]), float(y[i]))],
+                fid=f"f{i}",
+            )
+    q = Query.cql("INCLUDE")
+    q.hints["stats"] = "GroupBy(kind, MinMax(val))"
+    res = ds.query("t", q)
+    g = res.aggregate["stats"]
+    assert isinstance(g, GroupByStat) and g.size() == 3
+    assert g.get("a").min == 0 and g.get("a").max == 798
+
+    q2 = Query.cql("INCLUDE")
+    q2.hints["stats"] = "Z3Frequency(geom, dtg, week)"
+    res2 = ds.query("t", q2)
+    zf = res2.aggregate["stats"]
+    assert isinstance(zf, Z3FrequencyStat) and not zf.is_empty
+
+
+def test_groupby_null_keys_skipped_in_store_path():
+    """Null grouping attributes must not form a group — in either store
+    layout (dictionary codes or decoded columns with a __null mask)."""
+    from geomesa_tpu.geom.base import Point
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+
+    ds = TpuDataStore(executor=HostScanExecutor())
+    ds.create_schema(parse_spec("t", "kind:String,*geom:Point:srid=4326"))
+    with ds.writer("t") as w:
+        for i in range(10):
+            kind = None if i % 3 == 0 else "ab"[i % 2]
+            w.write([kind, Point(float(i), float(i))], fid=f"f{i}")
+    q = Query.cql("INCLUDE")
+    q.hints["stats"] = "GroupBy(kind, Count())"
+    g = ds.query("t", q).aggregate["stats"]
+    assert set(g.groups) == {"a", "b"}
+    assert g.get("a").count + g.get("b").count == 6
+
+
+def test_groupby_missing_sub_attribute_raises():
+    from geomesa_tpu.index.aggregators import run_stats
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    ft = parse_spec("t", "kind:String,val:Integer")
+    cols = {"kind": np.array(["a", "b"], dtype=object)}
+    with pytest.raises(KeyError, match="speed"):
+        run_stats(ft, "GroupBy(kind, MinMax(speed))", cols)
+
+
+def test_z3frequency_merge_rejects_period_mismatch():
+    a = Z3FrequencyStat("geom", "dtg", "week")
+    b = Z3FrequencyStat("geom", "dtg", "day")
+    x, y, t = _xyt(100)
+    a.observe_xyt(x, y, t)
+    b.observe_xyt(x, y, t)
+    with pytest.raises(ValueError, match="differ"):
+        a.merge(b)
+
+
+def test_jsonpath_fn_rejects_dollar_glue():
+    from geomesa_tpu.tools.convert import _fn_jsonpath
+
+    with pytest.raises(ValueError, match="rooted"):
+        _fn_jsonpath("$foo.bar", json.dumps({"foo": {"bar": 1}, "bar": 99}))
+
+
+def test_cli_stats_groupby(tmp_path, capsys):
+    from geomesa_tpu.tools import cli
+
+    root = tmp_path / "store"
+    rc = cli.main(
+        ["create-schema", "--store", str(root), "--name", "t",
+         "--spec", "kind:String,val:Integer,*geom:Point:srid=4326"]
+    )
+    assert rc == 0
+    data = tmp_path / "in.csv"
+    lines = ["id,kind,val,lon,lat"]
+    for i in range(50):
+        lines.append(f"r{i},{'ab'[i % 2]},{i},{i % 60 - 30},{i % 40 - 20}")
+    data.write_text("\n".join(lines) + "\n")
+    conv = tmp_path / "conv.json"
+    conv.write_text(json.dumps({
+        "type": "delimited-text", "format": "CSV", "options": {"skip-lines": 1},
+        "id-field": "$1",
+        "fields": [
+            {"name": "kind", "transform": "$2"},
+            {"name": "val", "transform": "toInt($3)"},
+            {"name": "geom", "transform": "point($4, $5)"},
+        ]}))
+    rc = cli.main(
+        ["ingest", "--store", str(root), "--name", "t",
+         "--converter", str(conv), str(data)]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    rc = cli.main(
+        ["stats-groupby", "--store", str(root), "--name", "t",
+         "--attribute", "kind"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    got = {ln.split("\t")[0]: json.loads(ln.split("\t", 1)[1]) for ln in out}
+    assert got["a"]["count"] == 25 and got["b"]["count"] == 25
