@@ -779,12 +779,36 @@ class GroupByStat(Stat):
     ) -> None:
         """Group rows by key and feed each group's slice of ``values`` to
         that group's sub-stat (null keys are skipped, like the reference
-        skipping features whose grouping attribute is missing)."""
+        skipping features whose grouping attribute is missing). Grouping
+        is O(n log n) — factorize + one stable sort — not a full-column
+        scan per distinct key, so high-cardinality attributes stay
+        linear-ish."""
         keys = np.asarray(keys)
         values = np.asarray(values)
         kvalid = _object_ok(keys)
-        for k in _unique_obj(keys[kvalid]):
-            sel = kvalid & (keys == k)
+        idx = np.flatnonzero(kvalid)
+        if not len(idx):
+            return
+        if keys.dtype.kind == "O":
+            # object keys may be mixed-type (unsortable): dict factorize
+            codes_of: Dict[Any, int] = {}
+            uniq: List[Any] = []
+            inv = np.empty(len(idx), dtype=np.int64)
+            for j, i in enumerate(idx):
+                k = keys[i]
+                c = codes_of.get(k)
+                if c is None:
+                    c = codes_of[k] = len(uniq)
+                    uniq.append(k)
+                inv[j] = c
+        else:
+            u, inv = np.unique(keys[idx], return_inverse=True)
+            uniq = [k.item() for k in u]
+        order = np.argsort(inv, kind="stable")
+        bounds = np.searchsorted(inv[order], np.arange(len(uniq) + 1))
+        rows = idx[order]
+        for c, k in enumerate(uniq):
+            sel = rows[bounds[c] : bounds[c + 1]]
             sub = self.groups.get(k)
             if sub is None:
                 sub = self.groups[k] = self._new()
@@ -826,18 +850,6 @@ def _object_ok(keys: np.ndarray) -> np.ndarray:
     if keys.dtype.kind == "f":
         return ~np.isnan(keys)
     return np.ones(len(keys), dtype=bool)
-
-
-def _unique_obj(keys: np.ndarray):
-    if keys.dtype.kind == "O":
-        seen = []
-        s = set()
-        for k in keys:
-            if k not in s:
-                s.add(k)
-                seen.append(k)
-        return seen
-    return [k.item() for k in np.unique(keys)]
 
 
 class SeqStat(Stat):

@@ -8,11 +8,17 @@ log into a live in-memory feature cache queried with full CQL semantics
 unions a transient stream tier with a persistent TpuDataStore tier, aging
 features down (lambda/stream/kafka/DataStorePersistence.scala).
 
-The broker here is in-process (the EmbeddedKafka test analog); the message
-format and consumer-offset protocol are the SPI a real broker plugs into.
+Three broker transports share one contract (send / poll / end_offsets):
+``InProcessBroker`` (the EmbeddedKafka test analog), ``FileLogBroker``
+(durable, multi-process over a shared filesystem), and
+``RemoteLogBroker`` against a ``LogServer`` daemon (durable AND
+network-transparent — the Kafka-broker deployment shape: producers and
+consumers reach the log over TCP with offsets committed broker-side).
 """
 
 from geomesa_tpu.stream.messages import Clear, CreateOrUpdate, Delete, GeoMessageSerializer
 from geomesa_tpu.stream.broker import InProcessBroker
+from geomesa_tpu.stream.filelog import FileLogBroker, FileOffsetManager
+from geomesa_tpu.stream.netlog import LogServer, RemoteLogBroker, RemoteOffsetManager
 from geomesa_tpu.stream.store import StreamDataStore, FeatureCache
 from geomesa_tpu.stream.lambda_store import LambdaDataStore

@@ -203,7 +203,14 @@ class FileOffsetManager:
         return os.path.join(self.dir, f"{self.group}__{topic}.json")
 
     def commit(self, topic: str, offsets: Dict[int, int]) -> None:
-        tmp = f"{self._path(topic)}.{os.getpid()}.tmp"
+        import threading
+
+        # pid+thread unique: the LogServer commits for many connections
+        # from one process, and two threads sharing a tmp name would
+        # interleave writes / replace a half-written file
+        tmp = (
+            f"{self._path(topic)}.{os.getpid()}.{threading.get_ident()}.tmp"
+        )
         with open(tmp, "w") as f:
             json.dump({str(p): int(o) for p, o in offsets.items()}, f)
         os.replace(tmp, self._path(topic))

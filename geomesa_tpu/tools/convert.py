@@ -212,8 +212,9 @@ def _fn_jsonpath(path, v):
     if v in (None, ""):
         return None
     path = str(path)
-    if path != "$" and not path.startswith("$."):
-        # '$foo.bar' would silently glue 'foo' onto the synthetic root
+    if path != "$" and not path.startswith(("$.", "$[")):
+        # '$foo.bar' would silently glue 'foo' onto the synthetic root;
+        # '$[0]...' (root array) stays valid
         raise ValueError(f"jsonPath expects a '$.'-rooted path: {path!r}")
     # document-relative: "$.a.b" selects within v, so prepend a synthetic
     # root segment for the attribute-first parser (parse_path is cached —
