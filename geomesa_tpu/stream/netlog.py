@@ -277,6 +277,13 @@ class RemoteLogBroker:
     # -- broker contract -----------------------------------------------------
 
     def send(self, topic: str, partition: int, payload: bytes) -> int:
+        if len(payload) > _MAX_MSG - 1024:
+            # fail fast: the server would reject the frame and drop the
+            # connection, and the reconnect retry would re-ship it all
+            raise ValueError(
+                f"payload {len(payload)} bytes exceeds the {_MAX_MSG}-byte "
+                "frame limit"
+            )
         resp, _ = self._rpc(
             {"op": "send", "topic": topic, "partition": int(partition)},
             payload,

@@ -332,27 +332,6 @@ class EvaluationContext:
             self.errors.append(f"line {line}: {err}")
 
 
-def _load_cache(cfg: Dict[str, Any]) -> Dict[str, Any]:
-    """Load one enrichment cache (the geomesa-convert EnrichmentCache /
-    redis-cache analog, file-backed): csv-kv maps a key column to a value
-    column; json-kv maps top-level object keys to values."""
-    kind = cfg.get("type", "csv-kv")
-    path = cfg["path"]
-    if kind == "csv-kv":
-        key_col = int(cfg.get("key-col", 1)) - 1
-        val_col = int(cfg.get("value-col", 2)) - 1
-        out: Dict[str, Any] = {}
-        with open(path, newline="") as fh:
-            for row in csv.reader(fh, delimiter=cfg.get("delimiter", ",")):
-                if len(row) > max(key_col, val_col):
-                    out[row[key_col]] = row[val_col]
-        return out
-    if kind == "json-kv":
-        with open(path) as fh:
-            return json.load(fh)
-    raise ValueError(f"unknown cache type: {kind}")
-
-
 def _make_validators(ft: FeatureType, names: Sequence[str]):
     """SimpleFeatureValidator.scala:27-165 analogs: has-geo, has-dtg,
     z-index (geometry inside the whole-world bounds + a sane date)."""
@@ -393,12 +372,17 @@ class SimpleFeatureConverter:
         self.ft = ft
         self.config = config
         self.kind = config.get("type", "delimited-text")
+        from geomesa_tpu.tools.enrichment import build_cache
+
         self.caches = {
-            name: _load_cache(c) for name, c in config.get("caches", {}).items()
+            name: build_cache(c) for name, c in config.get("caches", {}).items()
         }
-        extra = {
-            "cachelookup": lambda cache, key: self.caches.get(cache, {}).get(key)
-        }
+
+        def cachelookup(cache, key, field=None):
+            c = self.caches.get(cache)
+            return None if c is None else c.get(key, field)
+
+        extra = {"cachelookup": cachelookup}
         # geomesa-convert-scripting analog: user-defined transform functions
         # as Python lambda sources (the reference evaluates Nashorn JS the
         # same way — converter configs are trusted local tooling input)

@@ -1,0 +1,237 @@
+"""Pluggable converter enrichment caches.
+
+Reference: geomesa-convert-common EnrichmentCache.scala — a get/put/clear
+trait with ServiceLoader factories (SimpleEnrichmentCache inline data,
+ResourceLoadingCache CSV files, and an external Redis-backed cache in
+geomesa-convert-redis-cache). Here the same seam is a registry of
+factory callables keyed by the config ``type``:
+
+  simple    inline nested data            {"type":"simple","data":{...}}
+  csv-kv    file-backed key->value CSV    {"type":"csv-kv","path":...}
+  json-kv   file-backed JSON object       {"type":"json-kv","path":...}
+  resp      EXTERNAL network KV speaking the Redis wire protocol
+            {"type":"resp","host":...,"port":6379[,"prefix":...]} —
+            the redis-cache analog: no client library needed, the RESP
+            framing is a dozen lines; values are JSON documents whose
+            top-level keys serve the (key, field) lookups.
+
+``register_cache_factory`` adds new backends (the ServiceLoader role).
+Converter lookups go through ``cachelookup(name, key[, field])``.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import socket
+import threading
+from typing import Any, Callable, Dict, Optional
+
+# ---------------------------------------------------------------------------
+
+
+class EnrichmentCache:
+    """get/put/clear contract (EnrichmentCache.scala trait)."""
+
+    def get(self, key: str, field: Optional[str] = None) -> Any:
+        raise NotImplementedError
+
+    def put(self, key: str, value: Any, field: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class SimpleEnrichmentCache(EnrichmentCache):
+    """Inline nested data (SimpleEnrichmentCache.scala)."""
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self.data: Dict[str, Any] = dict(data or {})
+
+    def get(self, key, field=None):
+        v = self.data.get(key)
+        if field is not None and isinstance(v, dict):
+            return v.get(field)
+        return v
+
+    def put(self, key, value, field=None):
+        if field is None:
+            self.data[key] = value
+        else:
+            self.data.setdefault(key, {})[field] = value
+
+    def clear(self):
+        self.data.clear()
+
+
+class FileKvCache(SimpleEnrichmentCache):
+    """File-backed lookup tables (ResourceLoadingCache role): csv-kv maps
+    a key column to a value column, json-kv loads a JSON object."""
+
+    def __init__(self, cfg: Dict[str, Any]):
+        kind = cfg.get("type", "csv-kv")
+        path = cfg["path"]
+        if kind == "csv-kv":
+            key_col = int(cfg.get("key-col", 1)) - 1
+            val_col = int(cfg.get("value-col", 2)) - 1
+            data: Dict[str, Any] = {}
+            with open(path, newline="") as fh:
+                for row in csv.reader(fh, delimiter=cfg.get("delimiter", ",")):
+                    if len(row) > max(key_col, val_col):
+                        data[row[key_col]] = row[val_col]
+        else:  # json-kv
+            with open(path) as fh:
+                data = json.load(fh)
+        super().__init__(data)
+
+
+class RespCache(EnrichmentCache):
+    """External KV over the Redis wire protocol (RESP) — the
+    geomesa-convert-redis-cache analog without a client library.
+
+    Values are stored/read as JSON (SET key json / GET key); a ``field``
+    lookup selects a top-level key of the JSON document, matching how
+    the reference's redis cache stores one document per entity. A
+    ``prefix`` namespaces keys. Lookups memoize per cache instance (one
+    network round trip per distinct key per ingest, not per row)."""
+
+    def __init__(self, host: str, port: int = 6379, prefix: str = "",
+                 timeout_s: float = 10.0):
+        self.host = host
+        self.port = int(port)
+        self.prefix = prefix
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._memo: Dict[str, Any] = {}
+
+    # -- RESP framing --------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout_s
+            )
+            self._rfile = self._sock.makefile("rb")
+        return self._sock
+
+    def _command(self, *parts: str):
+        with self._lock:
+            try:
+                return self._command_locked(*parts)
+            except (OSError, ConnectionError):
+                self.close()
+                return self._command_locked(*parts)  # one reconnect retry
+
+    def _command_locked(self, *parts: str):
+        sock = self._connect()
+        msg = [f"*{len(parts)}\r\n".encode()]
+        for p in parts:
+            b = p.encode()
+            msg.append(b"$" + str(len(b)).encode() + b"\r\n" + b + b"\r\n")
+        sock.sendall(b"".join(msg))
+        return self._read_reply()
+
+    def _read_reply(self):
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("RESP peer closed")
+        kind, rest = line[:1], line[1:].strip()
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RuntimeError(f"RESP error: {rest.decode()}")
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = self._rfile.read(n + 2)
+            if len(data) < n + 2:
+                # EOF mid-reply: raising routes through the reconnect
+                # retry instead of memoizing a truncated value
+                raise ConnectionError("RESP peer closed mid-reply")
+            return data[:n].decode()
+        if kind == b"*":
+            return [self._read_reply() for _ in range(int(rest))]
+        raise RuntimeError(f"bad RESP reply: {line!r}")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    # -- cache contract ------------------------------------------------------
+
+    def get(self, key, field=None):
+        if key in self._memo:
+            doc = self._memo[key]
+        else:
+            raw = self._command("GET", self.prefix + str(key))
+            if raw is None:
+                doc = None
+            else:
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    doc = raw
+            self._memo[key] = doc
+        if field is not None and isinstance(doc, dict):
+            return doc.get(field)
+        return doc
+
+    def put(self, key, value, field=None):
+        if field is not None:
+            doc = self.get(key)
+            doc = dict(doc) if isinstance(doc, dict) else {}
+            doc[field] = value
+            value = doc
+        payload = value if isinstance(value, str) else json.dumps(value)
+        self._command("SET", self.prefix + str(key), payload)
+        self._memo.pop(key, None)
+
+    def clear(self):
+        self._memo.clear()
+        if not self.prefix:
+            # FLUSHDB on a shared database would wipe keys this cache
+            # never owned — clearing requires a namespace
+            raise RuntimeError(
+                "RespCache.clear() requires a key prefix (refusing to "
+                "flush a whole shared database)"
+            )
+        keys = self._command("KEYS", self.prefix + "*") or []
+        if keys:
+            self._command("DEL", *[str(k) for k in keys])
+
+
+# -- factory registry (the ServiceLoader seam) -------------------------------
+
+_FACTORIES: Dict[str, Callable[[Dict[str, Any]], EnrichmentCache]] = {
+    "simple": lambda cfg: SimpleEnrichmentCache(cfg.get("data", {})),
+    "csv-kv": FileKvCache,
+    "json-kv": FileKvCache,
+    "resp": lambda cfg: RespCache(
+        cfg["host"], cfg.get("port", 6379), cfg.get("prefix", "")
+    ),
+}
+
+
+def register_cache_factory(
+    kind: str, factory: Callable[[Dict[str, Any]], EnrichmentCache]
+) -> None:
+    """Plug a new backend in (EnrichmentCacheFactory ServiceLoader role)."""
+    _FACTORIES[kind] = factory
+
+
+def build_cache(cfg: Dict[str, Any]) -> EnrichmentCache:
+    kind = cfg.get("type", "csv-kv")
+    factory = _FACTORIES.get(kind)
+    if factory is None:
+        raise ValueError(
+            f"unknown cache type: {kind} (known: {sorted(_FACTORIES)})"
+        )
+    return factory(cfg)

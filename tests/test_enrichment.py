@@ -1,0 +1,180 @@
+"""Pluggable enrichment caches (tools/enrichment.py).
+
+Reference: geomesa-convert-common EnrichmentCache.scala (get/put/clear
+trait + ServiceLoader factories: simple inline data, resource CSV
+files) and the external redis-backed cache
+(geomesa-convert-redis-cache). The RESP backend is proven against a
+minimal in-test server speaking the actual Redis wire protocol.
+"""
+
+import io
+import json
+import socketserver
+import threading
+
+import pytest
+
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.tools.convert import SimpleFeatureConverter
+from geomesa_tpu.tools.enrichment import (
+    RespCache,
+    SimpleEnrichmentCache,
+    build_cache,
+    register_cache_factory,
+)
+
+
+def test_simple_cache_inline_data():
+    c = build_cache({"type": "simple", "data": {"k1": {"f": "v"}, "k2": 7}})
+    assert c.get("k1", "f") == "v"
+    assert c.get("k2") == 7
+    assert c.get("missing") is None
+    c.put("k3", {"a": 1})
+    assert c.get("k3", "a") == 1
+    c.clear()
+    assert c.get("k1") is None
+
+
+def test_file_caches(tmp_path):
+    p = tmp_path / "lut.csv"
+    p.write_text("USA,United States\nFRA,France\n")
+    c = build_cache({"type": "csv-kv", "path": str(p)})
+    assert c.get("USA") == "United States"
+    j = tmp_path / "lut.json"
+    j.write_text(json.dumps({"a": {"name": "Alpha"}}))
+    cj = build_cache({"type": "json-kv", "path": str(j)})
+    assert cj.get("a", "name") == "Alpha"
+
+
+def test_factory_registry_pluggable():
+    class Doubler(SimpleEnrichmentCache):
+        def get(self, key, field=None):
+            return key * 2
+
+    register_cache_factory("doubler", lambda cfg: Doubler())
+    assert build_cache({"type": "doubler"}).get("ab") == "abab"
+    with pytest.raises(ValueError, match="unknown cache type"):
+        build_cache({"type": "nope"})
+
+
+class _MiniRedis(socketserver.ThreadingTCPServer):
+    """Just enough RESP to prove the client: GET/SET/DEL/KEYS/FLUSHDB."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        self.data = {}
+        super().__init__(("127.0.0.1", 0), _MiniRedisHandler)
+
+
+class _MiniRedisHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        db = self.server.data
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            assert line[:1] == b"*", line
+            nargs = int(line[1:].strip())
+            args = []
+            for _ in range(nargs):
+                ln = self.rfile.readline()
+                assert ln[:1] == b"$"
+                n = int(ln[1:].strip())
+                args.append(self.rfile.read(n + 2)[:n].decode())
+            cmd = args[0].upper()
+            if cmd == "GET":
+                v = db.get(args[1])
+                if v is None:
+                    self.wfile.write(b"$-1\r\n")
+                else:
+                    b = v.encode()
+                    self.wfile.write(
+                        b"$" + str(len(b)).encode() + b"\r\n" + b + b"\r\n"
+                    )
+            elif cmd == "SET":
+                db[args[1]] = args[2]
+                self.wfile.write(b"+OK\r\n")
+            elif cmd == "DEL":
+                n = sum(1 for k in args[1:] if db.pop(k, None) is not None)
+                self.wfile.write(b":" + str(n).encode() + b"\r\n")
+            elif cmd == "KEYS":
+                pre = args[1].rstrip("*")
+                ks = [k for k in db if k.startswith(pre)]
+                self.wfile.write(b"*" + str(len(ks)).encode() + b"\r\n")
+                for k in ks:
+                    b = k.encode()
+                    self.wfile.write(
+                        b"$" + str(len(b)).encode() + b"\r\n" + b + b"\r\n"
+                    )
+            elif cmd == "FLUSHDB":
+                db.clear()
+                self.wfile.write(b"+OK\r\n")
+            else:
+                self.wfile.write(b"-ERR unknown\r\n")
+            self.wfile.flush()
+
+
+@pytest.fixture()
+def mini_redis():
+    server = _MiniRedis()
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_resp_cache_against_wire_server(mini_redis):
+    host, port = mini_redis.server_address[:2]
+    c = RespCache(host, port, prefix="gm:")
+    assert c.get("missing") is None
+    c.put("site1", {"name": "Alpha", "pop": 1200})
+    assert mini_redis.data["gm:site1"]  # stored under the prefix
+    c2 = RespCache(host, port, prefix="gm:")  # fresh connection
+    assert c2.get("site1", "name") == "Alpha"
+    assert c2.get("site1", "pop") == 1200
+    # memoization: a second get must not need the server
+    mini_redis.data.clear()
+    assert c2.get("site1", "name") == "Alpha"
+    c2.clear()
+    assert c2.get("site1") is None
+
+
+def test_resp_clear_requires_prefix(mini_redis):
+    host, port = mini_redis.server_address[:2]
+    mini_redis.data["other-apps-key"] = "precious"
+    c = RespCache(host, port)  # no prefix
+    with pytest.raises(RuntimeError, match="prefix"):
+        c.clear()
+    assert mini_redis.data["other-apps-key"] == "precious"
+
+
+def test_converter_cachelookup_with_field(tmp_path, mini_redis):
+    host, port = mini_redis.server_address[:2]
+    mini_redis.data["c:USA"] = json.dumps({"name": "United States"})
+    ft = parse_spec("t", "code:String,country:String,*geom:Point:srid=4326")
+    conv = SimpleFeatureConverter(
+        ft,
+        {
+            "type": "delimited-text",
+            "format": "CSV",
+            "id-field": "$1",
+            "caches": {
+                "countries": {"type": "resp", "host": host, "port": port,
+                              "prefix": "c:"},
+            },
+            "fields": [
+                {"name": "code", "transform": "$2"},
+                {"name": "country",
+                 "transform": "cacheLookup('countries', $code, 'name')"},
+                {"name": "geom", "transform": "point($3, $4)"},
+            ],
+        },
+    )
+    feats = list(conv.convert(io.StringIO("r1,USA,-77.0,38.9\nr2,FRA,2.3,48.8\n")))
+    assert feats[0].values[1] == "United States"
+    assert feats[1].values[1] is None  # FRA absent -> null enrichment
