@@ -289,15 +289,34 @@ def _runs_fn(kind: str, rcap: int, mode: str, mesh):
     return fn
 
 
-def _exact_mask_body(has_time: bool, mode: str, mesh):
+def _exact_mask_body(has_time: bool, mode: str, mesh, attr: bool = False):
     """Unjitted exact-predicate mask callable (ops.filters.exact_st_mask),
-    shard_map-wrapped for multi-chip meshes."""
+    shard_map-wrapped for multi-chip meshes.
+
+    ``attr`` adds the dictionary-code equality plane (the device half of
+    the reference's join attribute strategy, AttributeIndex.scala:42,392
+    — evaluate the secondary attribute predicate AT the data): one extra
+    row-sharded i32 ``codes`` column compared against a replicated
+    per-query ``qcode`` (shape (1,); -2 = literal absent from the
+    segment vocab, matching nothing; nulls are -1)."""
     from geomesa_tpu.ops.filters import exact_st_mask
 
-    if has_time:
+    if has_time and attr:
+        def body(xh, xl, yh, yl, th, tl, valid, codes, box, win, qcode):
+            m = exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
+            return m & (codes == qcode[0])
+        nrow = 8
+        nrep = 3
+    elif has_time:
         def body(xh, xl, yh, yl, th, tl, valid, box, win):
             return exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
         nrow = 7
+        nrep = 2
+    elif attr:
+        def body(xh, xl, yh, yl, valid, codes, box, qcode):
+            m = exact_st_mask(xh, xl, yh, yl, valid, box)
+            return m & (codes == qcode[0])
+        nrow = 6
         nrep = 2
     else:
         def body(xh, xl, yh, yl, valid, box):
@@ -323,11 +342,12 @@ _EXACT_RUNS_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 _EXACT_PACKED_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
-def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
-    key = (has_time, rcap, mode, mesh)
+def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh,
+                   attr: bool = False):
+    key = (has_time, rcap, mode, mesh, attr)
     fn = _EXACT_RUNS_FNS.get(key)
     if fn is None:
-        mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
@@ -338,14 +358,28 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
     return fn
 
 
-def _point_desc_split(mask, has_time: bool, args):
+def _point_desc_split(mask, has_time: bool, args, attr: bool = False):
     """Shared arg split for the point batch builders: returns
-    (mask_of(desc), stacked desc arrays for lax.scan)."""
+    (mask_of(desc), stacked desc arrays for lax.scan). ``attr`` adds the
+    codes column (row-sharded) and per-query qcodes [q,1] to the scan."""
+    if has_time and attr:
+        xh, xl, yh, yl, th, tl, valid, codes, boxes, wins, qcodes = args
+        return (
+            lambda d: mask(xh, xl, yh, yl, th, tl, valid, codes,
+                           d[0], d[1], d[2]),
+            (boxes, wins, qcodes),
+        )
     if has_time:
         xh, xl, yh, yl, th, tl, valid, boxes, wins = args
         return (
             lambda d: mask(xh, xl, yh, yl, th, tl, valid, d[0], d[1]),
             (boxes, wins),
+        )
+    if attr:
+        xh, xl, yh, yl, valid, codes, boxes, qcodes = args
+        return (
+            lambda d: mask(xh, xl, yh, yl, valid, codes, d[0], d[1]),
+            (boxes, qcodes),
         )
     xh, xl, yh, yl, valid, boxes = args
     return lambda d: mask(xh, xl, yh, yl, valid, d[0]), (boxes,)
@@ -360,7 +394,8 @@ def _start_d2h(*bufs) -> None:
             pass
 
 
-def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
+def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
+                         attr: bool = False):
     """Q exact-predicate scans fused into ONE device execution.
 
     lax.scan over [q] stacked query descriptors; each step streams the
@@ -376,14 +411,14 @@ def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
     random access by orders of magnitude. This is the BatchScanner
     analog (AccumuloQueryPlan.scala:113-140) collapsed into one RPC.
     """
-    key = (has_time, rcap, q, mode, mesh)
+    key = (has_time, rcap, q, mode, mesh, attr)
     fn = _EXACT_RUNS_BATCH_FNS.get(key)
     if fn is None:
-        mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
-            mask_of, descs = _point_desc_split(mask, has_time, args)
+            mask_of, descs = _point_desc_split(mask, has_time, args, attr)
 
             def step(carry, d):
                 return carry, _runs_from_mask(mask_of(d), rcap)
@@ -436,19 +471,19 @@ def _packed_step(m, rcap: int, sum_cap: int, off, shared):
 
 
 def _exact_packed_batch_fn(has_time: bool, rcap: int, sum_cap: int, q: int,
-                           mode: str, mesh):
+                           mode: str, mesh, attr: bool = False):
     """Q exact scans -> ONE fused i32 buffer
     ``[q*(3+3*PACK_XCAP) headers | sum_cap shared words]`` (see
     _packed_step). Same one-execution-per-stream shape as
     _exact_runs_batch_fn with a ~5x smaller D2H transfer."""
-    key = (has_time, rcap, sum_cap, q, mode, mesh)
+    key = (has_time, rcap, sum_cap, q, mode, mesh, attr)
     fn = _EXACT_PACKED_BATCH_FNS.get(key)
     if fn is None:
-        mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
-            mask_of, descs = _point_desc_split(mask, has_time, args)
+            mask_of, descs = _point_desc_split(mask, has_time, args, attr)
             shared0 = jnp.zeros((sum_cap,), jnp.int32)
 
             def step(carry, d):
@@ -472,7 +507,7 @@ _EXACT_BITMAP_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
 def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
-                           mesh):
+                           mesh, attr: bool = False):
     """Q exact scans -> (headers i32[q,4], bitmaps u8[q, span_cap//8]).
 
     The TPU-native extraction: NO compaction on device. Size-bounded
@@ -492,14 +527,14 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
     deployment could extract per shard and stitch offsets instead —
     single-chip is the tunnel-bench shape that matters here.
     """
-    key = (has_time, span_cap, q, mode, mesh)
+    key = (has_time, span_cap, q, mode, mesh, attr)
     fn = _EXACT_BITMAP_BATCH_FNS.get(key)
     if fn is None:
-        mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
-            mask_of, descs = _point_desc_split(mask, has_time, args)
+            mask_of, descs = _point_desc_split(mask, has_time, args, attr)
 
             def step(carry, d):
                 m = mask_of(d)
@@ -1192,11 +1227,11 @@ def _xz_packed_fn(has_time: bool, mode: str, mesh):
     return fn
 
 
-def _exact_packed_fn(has_time: bool, mode: str, mesh):
-    key = (has_time, mode, mesh)
+def _exact_packed_fn(has_time: bool, mode: str, mesh, attr: bool = False):
+    key = (has_time, mode, mesh, attr)
     fn = _EXACT_PACKED_FNS.get(key)
     if fn is None:
-        mask = _exact_mask_body(has_time, mode, mesh)
+        mask = _exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
@@ -1673,15 +1708,112 @@ class DeviceSegment:
         self._exact_xz_loaded = True
         return True
 
-    def _exact_args(self, box_dev, win_dev, has_time: bool) -> tuple:
+    def _exact_args(
+        self, box_dev, win_dev, has_time: bool,
+        codes_dev=None, qcode_dev=None,
+    ) -> tuple:
         """The one place that knows the exact-scan argument layout (shared
-        by single dispatch, batch dispatch, and escalation refetches)."""
+        by single dispatch, batch dispatch, and escalation refetches).
+        ``codes_dev``/``qcode_dev`` add the attribute-equality plane."""
         if has_time:
-            return (
+            base = (
                 self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo,
-                self.tk_hi, self.tk_lo, self.tvalid, box_dev, win_dev,
+                self.tk_hi, self.tk_lo, self.tvalid,
             )
-        return (self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo, self.valid, box_dev)
+        else:
+            base = (self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo, self.valid)
+        if codes_dev is not None:
+            base = base + (codes_dev,)
+        base = base + (box_dev,)
+        if has_time:
+            base = base + (win_dev,)
+        if qcode_dev is not None:
+            base = base + (qcode_dev,)
+        return base
+
+    def load_attr_codes(self, attr: str) -> bool:
+        """Unified dictionary-code column for one string attribute: each
+        block's sorted vocab re-encodes into ONE segment-wide sorted
+        vocab (a searchsorted remap per block), so the device decides
+        ``attr = literal`` with a single i32 compare per row — the
+        device half of the reference's join attribute strategy
+        (AttributeIndex.scala:42,392: evaluate the attribute predicate
+        at the data instead of post-filtering on the client). Pad rows
+        carry -1 (the null sentinel), which no qcode >= 0 matches."""
+        cache = getattr(self, "_attr_codes", None)
+        if cache is None:
+            cache = self._attr_codes = {}
+        if attr in cache:
+            return cache[attr] is not None
+        def raw_vocab(b):
+            # vocabs are NOT row-aligned: bypass full_col's record gather
+            v = b.columns.get(attr + "__vocab")
+            if v is None and b.record is not None:
+                v = b.record.columns.get(attr + "__vocab")
+            return v
+
+        per = []
+        try:
+            for b in self.blocks:
+                codes = b.full_col(attr)
+                vocab = raw_vocab(b)
+                if vocab is None or codes.dtype.kind not in "iu":
+                    raise KeyError(attr)
+                per.append((codes, vocab))
+        except KeyError:
+            cache[attr] = None  # not dictionary-coded in every block
+            return False
+        unified = (
+            np.unique(np.concatenate([v for _c, v in per]))
+            if per else np.empty(0, dtype=object)
+        )
+        parts = []
+        for codes, vocab in per:
+            remap = np.searchsorted(unified, vocab).astype(np.int32)
+            parts.append(
+                np.where(
+                    codes >= 0, remap[np.maximum(codes, 0)], np.int32(-1)
+                ).astype(np.int32)
+            )
+        dev = self._pack(parts, np.int32, -1)
+        cache[attr] = (dev, unified)
+        return True
+
+    def attr_qcode(self, attr: str, value) -> int:
+        """Segment-local code of ``value`` (-2 when absent: matches no
+        row, including nulls at -1)."""
+        _dev, unified = self._attr_codes[attr]
+        i = int(np.searchsorted(unified, value))
+        if i < len(unified) and unified[i] == value:
+            return i
+        return -2
+
+    def dispatch_exact_attr(
+        self, box_dev, win_dev, attr: str, value
+    ) -> "_PendingHits":
+        """Single-query edition of the attr-equality plane (a lone query
+        must not lose device exactness to the conservative fallback)."""
+        has_time = self.tk_hi is not None and win_dev is not None
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        codes_dev = self._attr_codes[attr][0]
+        qc = replicate(
+            self.mesh, np.array([self.attr_qcode(attr, value)], np.int32)
+        )
+        args = self._exact_args(box_dev, win_dev, has_time, codes_dev, qc)
+        rcap = self._rcap
+        buf = _exact_runs_fn(has_time, rcap, mode, self.mesh, True)(*args)
+        _start_d2h(buf)
+        return _PendingHits(
+            self,
+            rcap,
+            buf,
+            refetch=lambda rc: _exact_runs_fn(
+                has_time, rc, mode, self.mesh, True
+            )(*args),
+            packed=lambda: _exact_packed_fn(
+                has_time, mode, self.mesh, True
+            )(*args),
+        )
 
     def dispatch_exact(self, box_dev, win_dev) -> "_PendingHits":
         """Exact predicate scan (see TpuScanExecutor._exact_descriptor)."""
@@ -1700,21 +1832,26 @@ class DeviceSegment:
         )
 
     def dispatch_exact_batch(
-        self, descs: Sequence[tuple], has_time: bool
+        self, descs: Sequence[tuple], has_time: bool,
+        attr: Optional[str] = None,
     ) -> List["_PendingHits"]:
         """Q exact scans in ONE device execution (see _exact_runs_batch_fn
         and _exact_packed_batch_fn).
 
-        ``descs`` = [(box_np u32[8], win_np u32[4]|None)]; all entries of a
-        batch share ``has_time``. Returns one pending handle per desc, all
-        resolving from a single shared buffer fetch. The query list is
-        padded (repeating the last descriptor) so jit shape buckets stay
-        bounded. Overflow refetches escalate per query through the
-        single-query path. GEOMESA_BATCH_PROTO (auto|bitmap|runs|
-        runs_packed, see _batch_proto) selects the wire format: span-
-        framed bitmaps on accelerators, delta-packed RLE runs on the CPU
-        backend; GEOMESA_BATCH_PACK=0 degrades runs_packed to the
-        unpacked layout for A/B runs.
+        ``descs`` = [(box_np u32[8], win_np u32[4]|None)] — or, with
+        ``attr`` set, [(box, win, literal_value)]: the device then also
+        decides ``attr = literal`` per row via unified dictionary codes
+        (load_attr_codes), the join attribute strategy evaluated at the
+        data. All entries of a batch share ``has_time``. Returns one
+        pending handle per desc, all resolving from a single shared
+        buffer fetch. The query list is padded (repeating the last
+        descriptor) so jit shape buckets stay bounded. Overflow
+        refetches escalate per query through the single-query path.
+        GEOMESA_BATCH_PROTO (auto|bitmap|runs|runs_packed, see
+        _batch_proto) selects the wire format: span-framed bitmaps on
+        accelerators, delta-packed RLE runs on the CPU backend;
+        GEOMESA_BATCH_PACK=0 degrades runs_packed to the unpacked layout
+        for A/B runs.
         """
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
         q = len(descs)
@@ -1734,35 +1871,66 @@ class DeviceSegment:
             wins_dev = replicate(self.mesh, wins_np)
         else:
             wins_dev = None
-        args = self._exact_args(boxes_dev, wins_dev, has_time)
+        # attr-equality plane: descs carry the literal VALUE (codes are
+        # segment-local); map each to this segment's unified qcode here
+        is_attr = attr is not None
+        codes_dev = self._attr_codes[attr][0] if is_attr else None
+        if is_attr:
+            qcodes_np = np.array(
+                [[self.attr_qcode(attr, d[2])] for d in descs]
+                + [[self.attr_qcode(attr, descs[-1][2])]] * (qpad - q),
+                dtype=np.int32,
+            )
+            qcodes_dev = replicate(self.mesh, qcodes_np)
+        else:
+            qcodes_dev = None
+        args = self._exact_args(
+            boxes_dev, wins_dev, has_time, codes_dev, qcodes_dev
+        )
         rcap = self._rcap
+
+        def single_args_for(box_np, win_np, value):
+            def build():
+                qc = (
+                    replicate(
+                        self.mesh,
+                        np.array([self.attr_qcode(attr, value)], np.int32),
+                    )
+                    if is_attr
+                    else None
+                )
+                return self._exact_args(
+                    replicate(self.mesh, box_np),
+                    None if win_np is None else replicate(self.mesh, win_np),
+                    has_time,
+                    codes_dev,
+                    qc,
+                )
+            return build
+
         if proto == "bitmap":
             span_cap = self.span_cap()
             trace = _batch_trace(self, args, qpad, "bitmap", 0)
             hdr, bits = _exact_bitmap_batch_fn(
-                has_time, span_cap, qpad, mode, self.mesh
+                has_time, span_cap, qpad, mode, self.mesh, is_attr
             )(*args)
             if trace is not None:
                 trace["out_bytes"] = int(hdr.nbytes) + int(bits.nbytes)
             _start_d2h(hdr, bits)
             batch = _BitmapBatch(hdr, bits, span_cap, seg=self, trace=trace)
             out = []
-            for i, (box_np, win_np) in enumerate(descs):
-                def single_args(box_np=box_np, win_np=win_np):
-                    return self._exact_args(
-                        replicate(self.mesh, box_np),
-                        None if win_np is None else replicate(self.mesh, win_np),
-                        has_time,
-                    )
-
+            for i, d in enumerate(descs):
+                single_args = single_args_for(
+                    d[0], d[1], d[2] if is_attr else None
+                )
                 out.append(
                     _PendingBitmapHits(
                         self, batch, i,
                         refetch=lambda rc, sa=single_args: _exact_runs_fn(
-                            has_time, rc, mode, self.mesh
+                            has_time, rc, mode, self.mesh, is_attr
                         )(*sa()),
                         packed=lambda sa=single_args: _exact_packed_fn(
-                            has_time, mode, self.mesh
+                            has_time, mode, self.mesh, is_attr
                         )(*sa()),
                     )
                 )
@@ -1772,10 +1940,12 @@ class DeviceSegment:
         if pack:
             sum_cap = self._sum_cap
             buf = _exact_packed_batch_fn(
-                has_time, rcap, sum_cap, qpad, mode, self.mesh
+                has_time, rcap, sum_cap, qpad, mode, self.mesh, is_attr
             )(*args)
         else:
-            buf = _exact_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
+            buf = _exact_runs_batch_fn(
+                has_time, rcap, qpad, mode, self.mesh, is_attr
+            )(*args)
         if trace is not None:
             trace["out_bytes"] = int(buf.nbytes)
         _start_d2h(buf)
@@ -1783,7 +1953,7 @@ class DeviceSegment:
             batch = _PackedBatch(
                 buf, qpad, rcap, sum_cap, seg=self,
                 refetch_batch=lambda sc: _exact_packed_batch_fn(
-                    has_time, rcap, sc, qpad, mode, self.mesh
+                    has_time, rcap, sc, qpad, mode, self.mesh, is_attr
                 )(*args),
                 trace=trace,
                 q_real=q,
@@ -1791,21 +1961,18 @@ class DeviceSegment:
         else:
             batch = _BatchRows(buf, trace=trace)
         out = []
-        for i, (box_np, win_np) in enumerate(descs):
+        for i, d in enumerate(descs):
             # escalation/bitmap fallbacks re-dispatch the SINGLE-query fns
             # with this query's own descriptor (rare: capacities adapt)
-            def single_args(box_np=box_np, win_np=win_np):
-                return self._exact_args(
-                    replicate(self.mesh, box_np),
-                    None if win_np is None else replicate(self.mesh, win_np),
-                    has_time,
-                )
+            single_args = single_args_for(
+                d[0], d[1], d[2] if is_attr else None
+            )
 
             refetch = lambda rc, sa=single_args: _exact_runs_fn(  # noqa: E731
-                has_time, rc, mode, self.mesh
+                has_time, rc, mode, self.mesh, is_attr
             )(*sa())
             packed = lambda sa=single_args: _exact_packed_fn(  # noqa: E731
-                has_time, mode, self.mesh
+                has_time, mode, self.mesh, is_attr
             )(*sa())
             if pack:
                 out.append(_PendingPackedHits(self, batch, i, refetch, packed))
@@ -3165,6 +3332,7 @@ class TpuScanExecutor:
         out: Dict[int, object] = {}
         seen: set = set()
         batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
+        attr_batchable: Dict[tuple, Tuple[IndexTable, bool, str, list]] = {}
         xz_batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
         poly_batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
         for table, plan in items:
@@ -3189,6 +3357,19 @@ class TpuScanExecutor:
                 if key not in batchable:
                     batchable[key] = (table, has_time, [])
                 batchable[key][2].append((id(plan), plan, desc))
+                continue
+            adesc = (
+                self._attr_batch_desc(table, plan)
+                if self._scan_eligible(table, plan)
+                else None
+            )
+            if adesc is not None:
+                attr, d = adesc
+                has_time = d[1] is not None
+                key = (id(table), has_time, attr)
+                if key not in attr_batchable:
+                    attr_batchable[key] = (table, has_time, attr, [])
+                attr_batchable[key][3].append((id(plan), plan, d))
                 continue
             poly = self._poly_batch_desc(table, plan)
             if poly is not None:
@@ -3245,6 +3426,59 @@ class TpuScanExecutor:
                         ],
                         exact=True,
                     )
+        for table, has_time, attr, lst in attr_batchable.values():
+            dev = self.device_index(table)
+            ok = (
+                bool(dev.segments)
+                and all(seg.load_exact(table) for seg in dev.segments)
+                and all(seg.load_attr_codes(attr) for seg in dev.segments)
+            )
+            if not ok:
+                # no dictionary codes in some segment: the conservative
+                # mask + host post-filter answers (the attribute
+                # predicate runs host-side, same results)
+                for pid, plan, _d in lst:
+                    out[pid] = self._dispatch_nonseek(table, plan, desc=None)
+                continue
+
+            def single_attr(pid, d):
+                box_np, win_np, value = d
+                box_dev = replicate(self.mesh, box_np)
+                win_dev = (
+                    None if win_np is None else replicate(self.mesh, win_np)
+                )
+                out[pid] = _PendingScan(
+                    [
+                        (seg, seg.dispatch_exact_attr(
+                            box_dev, win_dev, attr, value))
+                        for seg in dev.segments
+                    ],
+                    exact=True,
+                )
+
+            self._seed_spans(dev, [p for _pid, p, _d in lst])
+            for i in range(0, len(lst), self.BATCH_MAX):
+                chunk = lst[i : i + self.BATCH_MAX]
+                if len(chunk) == 1:
+                    # lone query keeps device exactness via the cached
+                    # single-query attr dispatch (the batch fn would pad
+                    # to the pow2 floor: x4 scan work)
+                    single_attr(chunk[0][0], chunk[0][2])
+                    continue
+                descs = [d for _pid, _p, d in chunk]
+                per_seg = [
+                    seg.dispatch_exact_batch(descs, has_time, attr=attr)
+                    for seg in dev.segments
+                ]
+                for qi, (pid, _plan, _d) in enumerate(chunk):
+                    out[pid] = _PendingScan(
+                        [
+                            (seg, phs[qi])
+                            for seg, phs in zip(dev.segments, per_seg)
+                        ],
+                        exact=True,
+                    )
+
         def xz_loaded(dev, table, has_time):
             return all(seg.load_exact_xz(table) for seg in dev.segments) and not (
                 has_time and any(seg.xz_tk is None for seg in dev.segments)
@@ -3349,10 +3583,7 @@ class TpuScanExecutor:
         bounds) — the banded-raycast batch descriptor; None otherwise.
         Same GEOMESA_EXACT_DEVICE gate as the box path (the kernel rides
         the exact limb columns)."""
-        import os
-
-        env = os.environ.get("GEOMESA_EXACT_DEVICE", "auto")
-        if env == "0" or (env != "1" and jax.default_backend() == "cpu"):
+        if not self._exact_device_enabled():
             return None
         if table.index.name not in ("z2", "z3") or plan.secondary is not None:
             return None
@@ -3531,26 +3762,88 @@ class TpuScanExecutor:
             xmax, ymax = min(xmax, e.xmax), min(ymax, e.ymax)
         return xmin, ymin, xmax, ymax, t_lo, t_hi
 
+    @staticmethod
+    def _exact_device_enabled() -> bool:
+        """GEOMESA_EXACT_DEVICE gate, shared by every exact-descriptor
+        builder: auto means accelerator backends only — on the CPU
+        backend "device" compute IS host compute and the wider limb
+        columns cost more than the post-filter saves; on real
+        accelerators the exact mask is memory-bound free and eliminates
+        the host post-filter entirely."""
+        import os
+
+        env = os.environ.get("GEOMESA_EXACT_DEVICE", "auto")
+        if env == "0":
+            return False
+        return env == "1" or jax.default_backend() != "cpu"
+
     def _exact_descriptor(self, table: IndexTable, plan: QueryPlan):
         """(box key limbs u32[8], window key limbs u32[4] | None) when the
         device can evaluate the query's own semantics (see
         _exact_predicate_shape). None otherwise (conservative mask + host
         post-filter)."""
-        import os
-
-        env = os.environ.get("GEOMESA_EXACT_DEVICE", "auto")
-        if env == "0":
-            return None
-        if env != "1" and jax.default_backend() == "cpu":
-            # auto: on the CPU backend "device" compute IS host compute —
-            # the wider limb columns cost more than the post-filter saves.
-            # On real accelerators the exact mask is memory-bound free and
-            # eliminates the host post-filter entirely.
+        if not self._exact_device_enabled():
             return None
         shape = self._exact_predicate_shape(table, plan)
         if shape is None:
             return None
         return self._shape_limbs(shape)
+
+    def _attr_batch_desc(self, table: IndexTable, plan: QueryPlan):
+        """(attr_name, (box_limbs, win_limbs|None, literal)) when the
+        plan's FULL filter is one box(+window) AND exactly one string-
+        attribute equality — the device then decides everything,
+        including the secondary attribute predicate (the join attribute
+        strategy evaluated at the data, AttributeIndex.scala:42,392).
+        None otherwise."""
+        if not self._exact_device_enabled():
+            return None
+        if table.index.name not in ("z2", "z3"):
+            return None
+        ft = table.ft
+        if ft.default_geometry is None or not ft.is_points:
+            return None
+        from geomesa_tpu.filter import ast as A
+        from geomesa_tpu.schema.featuretype import AttributeType
+
+        geom = ft.default_geometry.name
+        boxes: List = []
+        attr_eq: List = []
+
+        def match(node) -> bool:
+            if isinstance(node, A.BBox) and node.prop == geom:
+                boxes.append(node.envelope)
+                return True
+            if isinstance(node, A.Intersects) and node.prop == geom:
+                g = node.geometry
+                if hasattr(g, "is_rectangle") and g.is_rectangle():
+                    boxes.append(g.envelope)
+                    return True
+            if (
+                isinstance(node, A.Cmp)
+                and node.op == "="
+                and not node.prop.startswith("$.")
+                and ft.has(node.prop)
+                and ft.attr(node.prop).type == AttributeType.STRING
+                and not ft.attr(node.prop).json
+            ):
+                attr_eq.append((node.prop, node.literal))
+                return True
+            return False
+
+        ok, t_lo, t_hi = self._and_walk_temporal(ft, plan.full_filter, match)
+        if not ok or not boxes or len(attr_eq) != 1:
+            return None
+        if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
+            return None
+        env0 = boxes[0]
+        xmin, ymin, xmax, ymax = env0.xmin, env0.ymin, env0.xmax, env0.ymax
+        for e in boxes[1:]:
+            xmin, ymin = max(xmin, e.xmin), max(ymin, e.ymin)
+            xmax, ymax = min(xmax, e.xmax), min(ymax, e.ymax)
+        limbs = self._shape_limbs((xmin, ymin, xmax, ymax, t_lo, t_hi))
+        attr, literal = attr_eq[0]
+        return attr, (limbs[0], limbs[1], str(literal))
 
     def _query_descriptor(self, table: IndexTable, plan: QueryPlan):
         """(boxes, windows) device-replicated arrays for this plan."""

@@ -277,9 +277,10 @@ class RemoteLogBroker:
     # -- broker contract -----------------------------------------------------
 
     def send(self, topic: str, partition: int, payload: bytes) -> int:
-        if len(payload) > _MAX_MSG - 1024:
+        if len(payload) > _MAX_MSG:
             # fail fast: the server would reject the frame and drop the
             # connection, and the reconnect retry would re-ship it all
+            # (the payload travels as its own frame; the limit is exact)
             raise ValueError(
                 f"payload {len(payload)} bytes exceeds the {_MAX_MSG}-byte "
                 "frame limit"

@@ -194,18 +194,38 @@ class RespCache(EnrichmentCache):
         self._command("SET", self.prefix + str(key), payload)
         self._memo.pop(key, None)
 
+    @staticmethod
+    def _glob_escape(s: str) -> str:
+        """Escape Redis glob metacharacters so a literal prefix like
+        'tenant[1]:' matches itself, not the character class [1]."""
+        out = []
+        for ch in s:
+            if ch in "*?[]\\":
+                out.append("\\")
+            out.append(ch)
+        return "".join(out)
+
     def clear(self):
-        self._memo.clear()
         if not self.prefix:
             # FLUSHDB on a shared database would wipe keys this cache
-            # never owned — clearing requires a namespace
+            # never owned — clearing requires a namespace. (Refusal is
+            # side-effect free: the memo survives.)
             raise RuntimeError(
                 "RespCache.clear() requires a key prefix (refusing to "
                 "flush a whole shared database)"
             )
-        keys = self._command("KEYS", self.prefix + "*") or []
-        if keys:
-            self._command("DEL", *[str(k) for k in keys])
+        self._memo.clear()
+        # SCAN (cursor pages) instead of KEYS: no blocking full-keyspace
+        # sweep on a shared server
+        pattern = self._glob_escape(self.prefix) + "*"
+        cursor = "0"
+        while True:
+            reply = self._command("SCAN", cursor, "MATCH", pattern)
+            cursor, keys = str(reply[0]), reply[1]
+            if keys:
+                self._command("DEL", *[str(k) for k in keys])
+            if cursor == "0":
+                break
 
 
 # -- factory registry (the ServiceLoader seam) -------------------------------

@@ -99,9 +99,11 @@ class _MiniRedisHandler(socketserver.StreamRequestHandler):
             elif cmd == "DEL":
                 n = sum(1 for k in args[1:] if db.pop(k, None) is not None)
                 self.wfile.write(b":" + str(n).encode() + b"\r\n")
-            elif cmd == "KEYS":
-                pre = args[1].rstrip("*")
+            elif cmd == "SCAN":
+                # args: cursor, MATCH, pattern — single-page reply
+                pre = args[3].rstrip("*").replace("\\", "")
                 ks = [k for k in db if k.startswith(pre)]
+                self.wfile.write(b"*2\r\n$1\r\n0\r\n")
                 self.wfile.write(b"*" + str(len(ks)).encode() + b"\r\n")
                 for k in ks:
                     b = k.encode()
