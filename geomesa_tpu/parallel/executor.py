@@ -3733,7 +3733,12 @@ class TpuScanExecutor:
         return walk(f), t_lo, t_hi
 
     @staticmethod
-    def _walk_box_window(ft, f):
+    def _walk_boxes(ft, f, extra_match=None):
+        """AND-only walk collecting bbox / rect-INTERSECTS tests on the
+        default geometry plus temporal clamps — THE single home of the
+        box-shape rules for the plain exact AND attr device planes.
+        ``extra_match`` may claim additional node shapes. Returns
+        ((xmin, ymin, xmax, ymax), t_lo, t_hi) or None."""
         if f is None:
             return None
         from geomesa_tpu.filter import ast as A
@@ -3750,7 +3755,7 @@ class TpuScanExecutor:
                 if hasattr(g, "is_rectangle") and g.is_rectangle():
                     boxes.append(g.envelope)
                     return True
-            return False
+            return extra_match(node) if extra_match is not None else False
 
         ok, t_lo, t_hi = TpuScanExecutor._and_walk_temporal(ft, f, match)
         if not ok or not boxes:
@@ -3760,6 +3765,14 @@ class TpuScanExecutor:
         for e in boxes[1:]:  # AND of boxes = envelope intersection
             xmin, ymin = max(xmin, e.xmin), max(ymin, e.ymin)
             xmax, ymax = min(xmax, e.xmax), min(ymax, e.ymax)
+        return (xmin, ymin, xmax, ymax), t_lo, t_hi
+
+    @staticmethod
+    def _walk_box_window(ft, f):
+        got = TpuScanExecutor._walk_boxes(ft, f)
+        if got is None:
+            return None
+        (xmin, ymin, xmax, ymax), t_lo, t_hi = got
         return xmin, ymin, xmax, ymax, t_lo, t_hi
 
     @staticmethod
@@ -3806,19 +3819,9 @@ class TpuScanExecutor:
         from geomesa_tpu.filter import ast as A
         from geomesa_tpu.schema.featuretype import AttributeType
 
-        geom = ft.default_geometry.name
-        boxes: List = []
         attr_eq: List = []
 
-        def match(node) -> bool:
-            if isinstance(node, A.BBox) and node.prop == geom:
-                boxes.append(node.envelope)
-                return True
-            if isinstance(node, A.Intersects) and node.prop == geom:
-                g = node.geometry
-                if hasattr(g, "is_rectangle") and g.is_rectangle():
-                    boxes.append(g.envelope)
-                    return True
+        def match_attr(node) -> bool:
             if (
                 isinstance(node, A.Cmp)
                 and node.op == "="
@@ -3831,16 +3834,12 @@ class TpuScanExecutor:
                 return True
             return False
 
-        ok, t_lo, t_hi = self._and_walk_temporal(ft, plan.full_filter, match)
-        if not ok or not boxes or len(attr_eq) != 1:
+        got = self._walk_boxes(ft, plan.full_filter, extra_match=match_attr)
+        if got is None or len(attr_eq) != 1:
             return None
+        (xmin, ymin, xmax, ymax), t_lo, t_hi = got
         if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
             return None
-        env0 = boxes[0]
-        xmin, ymin, xmax, ymax = env0.xmin, env0.ymin, env0.xmax, env0.ymax
-        for e in boxes[1:]:
-            xmin, ymin = max(xmin, e.xmin), max(ymin, e.ymin)
-            xmax, ymax = min(xmax, e.xmax), min(ymax, e.ymax)
         limbs = self._shape_limbs((xmin, ymin, xmax, ymax, t_lo, t_hi))
         attr, literal = attr_eq[0]
         return attr, (limbs[0], limbs[1], str(literal))

@@ -220,7 +220,10 @@ class RespCache(EnrichmentCache):
         pattern = self._glob_escape(self.prefix) + "*"
         cursor = "0"
         while True:
-            reply = self._command("SCAN", cursor, "MATCH", pattern)
+            # COUNT bounds the round trips (Redis default pages at 10)
+            reply = self._command(
+                "SCAN", cursor, "MATCH", pattern, "COUNT", "1000"
+            )
             cursor, keys = str(reply[0]), reply[1]
             if keys:
                 self._command("DEL", *[str(k) for k in keys])

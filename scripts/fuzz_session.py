@@ -83,6 +83,13 @@ def one_round(seed: int) -> int:
             "tag IS NULL",
             "tag = 'tag-3' AND bbox(geom, -50, -40, 40, 40)",
             "name LIKE 'n%' AND age BETWEEN 10 AND 50",
+            # attr-equality device plane shapes (batch modes route these
+            # through the dictionary-code compare): z3 window edition,
+            # absent literal, and two batchable partners on one attr
+            "tag = 'tag-1' AND bbox(geom, -60, -50, 50, 50) AND "
+            "dtg DURING 2026-01-02T00:00:00Z/2026-01-20T00:00:00Z",
+            "tag = 'no-such-tag' AND bbox(geom, -50, -40, 40, 40)",
+            "tag = 'tag-5' AND bbox(geom, -20, -30, 60, 45)",
         ]
         wants = {}
         for q in queries:
