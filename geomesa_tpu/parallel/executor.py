@@ -305,24 +305,17 @@ def _exact_mask_body(has_time: bool, mode: str, mesh, attr: bool = False):
         def body(xh, xl, yh, yl, th, tl, valid, codes, box, win, qcode):
             m = exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
             return m & (codes == qcode[0])
-        nrow = 8
-        nrep = 3
     elif has_time:
         def body(xh, xl, yh, yl, th, tl, valid, box, win):
             return exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
-        nrow = 7
-        nrep = 2
     elif attr:
         def body(xh, xl, yh, yl, valid, codes, box, qcode):
             m = exact_st_mask(xh, xl, yh, yl, valid, box)
             return m & (codes == qcode[0])
-        nrow = 6
-        nrep = 2
     else:
         def body(xh, xl, yh, yl, valid, box):
             return exact_st_mask(xh, xl, yh, yl, valid, box)
-        nrow = 5
-        nrep = 1
+    nrow, nrep = _exact_arg_counts(has_time, attr)
     if mode != "spmd":
         return body
     from jax.sharding import PartitionSpec as P
@@ -334,6 +327,33 @@ def _exact_mask_body(has_time: bool, mode: str, mesh, attr: bool = False):
         out_specs=P(DATA_AXIS),
         check=False,
     )
+
+
+def _exact_arg_counts(has_time: bool, attr: bool) -> Tuple[int, int]:
+    """(row-sharded, replicated) arg counts of the exact mask layouts —
+    THE single table both _exact_mask_body's shard specs and the
+    shard-extract wrapper consult (must track _exact_args)."""
+    if has_time and attr:
+        return 8, 3
+    if has_time:
+        return 7, 2
+    if attr:
+        return 6, 2
+    return 5, 1
+
+
+def _bitmap_frame_step(m, span_cap: int):
+    """One query's span framing: (header [cnt, lo, hi, start], packed
+    window bits) — shared by the replicated and per-shard bitmap batch
+    kernels (their wire parity depends on this staying single-sourced)."""
+    n = m.shape[0]
+    cnt = jnp.sum(m.astype(jnp.int32))
+    lo = jnp.argmax(m).astype(jnp.int32)
+    hi = (n - 1 - jnp.argmax(m[::-1])).astype(jnp.int32)
+    # caller guarantees span_cap <= n and both multiples of 8
+    start = jnp.clip((lo // 8) * 8, 0, n - span_cap)
+    window = jax.lax.dynamic_slice(m, (start,), (span_cap,))
+    return jnp.stack([cnt, lo, hi, start]), jnp.packbits(window)
 
 
 _EXACT_RUNS_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
@@ -537,17 +557,7 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
             mask_of, descs = _point_desc_split(mask, has_time, args, attr)
 
             def step(carry, d):
-                m = mask_of(d)
-                n = m.shape[0]
-                cnt = jnp.sum(m.astype(jnp.int32))
-                lo = jnp.argmax(m).astype(jnp.int32)
-                hi = (n - 1 - jnp.argmax(m[::-1])).astype(jnp.int32)
-                # caller guarantees span_cap <= n and both multiples of 8
-                start = jnp.clip((lo // 8) * 8, 0, n - span_cap)
-                window = jax.lax.dynamic_slice(m, (start,), (span_cap,))
-                bits = jnp.packbits(window)
-                header = jnp.stack([cnt, lo, hi, start])
-                return carry, (header, bits)
+                return carry, _bitmap_frame_step(mask_of(d), span_cap)
 
             _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
@@ -555,6 +565,148 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
         fn = jax.jit(run)
         _EXACT_BITMAP_BATCH_FNS[key] = fn
     return fn
+
+
+_EXACT_SHARD_BITMAP_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _shard_extract_on(mode: str, mesh) -> bool:
+    """GEOMESA_SHARD_EXTRACT: auto|1|0 — per-shard window extraction for
+    the bitmap protocol on multi-device meshes. auto: on for the
+    explicit SPMD kernel mode (the multi-chip deployment shape, and
+    what dryrun_multichip proves); off in local mode where the
+    replicated extraction is the measured single-link default. 1 forces
+    it anywhere (parity tests on the CPU mesh)."""
+    import os
+
+    env = os.environ.get("GEOMESA_SHARD_EXTRACT", "auto")
+    if env == "0" or mesh.devices.size <= 1:
+        return False
+    return env == "1" or mode == "spmd"
+
+
+def _exact_shard_bitmap_batch_fn(has_time: bool, span_cap: int, q: int,
+                                 mesh, attr: bool = False):
+    """PER-SHARD extraction edition of _exact_bitmap_batch_fn: the mask
+    AND the span framing both run INSIDE shard_map, so each chip frames
+    only its LOCAL hit window — no cross-chip collective at all, not
+    even the mask all-gather. The host stitches shard windows with row
+    offsets (shard d's rows start at d * shard_n). This is the true pod
+    shape: per-tablet partial results merged client-side
+    (AccumuloQueryPlan.scala:113-140), with D2H = D small windows
+    instead of one gathered mask. ``span_cap`` is the PER-SHARD window
+    (multiple of 8, <= shard_n); a shard whose true span exceeds it
+    triggers the single-query fallback host-side."""
+    key = (has_time, span_cap, q, mesh, attr)
+    fn = _EXACT_SHARD_BITMAP_FNS.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec as P
+
+        # the UNWRAPPED local mask body: shard_map provides the locality
+        local_mask = _exact_mask_body(has_time, "local", mesh, attr)
+        nrow, nrep = _exact_arg_counts(has_time, attr)
+
+        def shard_body(*args):
+            mask_of, descs = _point_desc_split(
+                local_mask, has_time, args, attr
+            )
+
+            def step(carry, d):
+                # LOCAL rows only: shard_map scopes the mask to the shard
+                return carry, _bitmap_frame_step(mask_of(d), span_cap)
+
+            _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
+            return headers, bitmaps  # per shard: [q, 4], [q, span_cap//8]
+
+        wrapped = shard_map_fn(
+            shard_body,
+            mesh,
+            in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * nrep),
+            # leading axis concatenates across shards -> [D*q, ...]
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            check=False,
+        )
+        fn = jax.jit(wrapped)
+        _EXACT_SHARD_BITMAP_FNS[key] = fn
+    return fn
+
+
+class _ShardBitmapBatch:
+    """One per-shard bitmap batch: [D*q, 4] headers + [D*q, cap//8]
+    windows, fetched once; shard d / query i slices at d*q + i."""
+
+    __slots__ = ("hdr", "bits", "span_cap", "n_shards", "q", "shard_n",
+                 "seg", "_np", "trace")
+
+    def __init__(self, hdr, bits, span_cap, n_shards, q, shard_n,
+                 seg=None, trace=None):
+        self.hdr = hdr
+        self.bits = bits
+        self.span_cap = span_cap
+        self.n_shards = n_shards
+        self.q = q
+        self.shard_n = shard_n
+        self.seg = seg
+        self._np = None
+        self.trace = trace
+
+    def _fetch(self):
+        if self._np is None:
+            t1 = _trace_fetch_begin(self.trace, self.hdr, self.bits)
+            h = np.asarray(self.hdr).reshape(self.n_shards, self.q, 4)
+            b = np.asarray(self.bits).reshape(self.n_shards, self.q, -1)
+            _trace_fetch_end(self.trace, t1)
+            self._np = (h, b)
+            self.hdr = self.bits = None
+            if self.seg is not None:
+                nonempty = h[:, :, 0] > 0
+                spans = np.where(nonempty, h[:, :, 2] - h[:, :, 3] + 1, 0)
+                self.seg.remember_shard_span(int(spans.max(initial=0)))
+        return self._np
+
+
+class _PendingShardBitmapHits:
+    """One query's slice across every shard window: decode each shard's
+    bitmap, offset by the shard's row base, concatenate (rows stay
+    sorted — shard bases ascend). Any shard span wider than the window
+    falls back to the single-query refetch."""
+
+    __slots__ = ("seg", "batch", "i", "_refetch", "_packed", "_rows")
+
+    def __init__(self, seg, batch: _ShardBitmapBatch, i: int, refetch, packed):
+        self.seg = seg
+        self.batch = batch
+        self.i = i
+        self._refetch = refetch
+        self._packed = packed
+        self._rows: Optional[np.ndarray] = None
+
+    def rows(self) -> np.ndarray:
+        if self._rows is None:
+            self._rows = self._resolve()
+        return self._rows
+
+    def _resolve(self) -> np.ndarray:
+        h, b = self.batch._fetch()
+        parts = []
+        for d in range(self.batch.n_shards):
+            cnt, _lo, hi, start = (int(v) for v in h[d, self.i])
+            if cnt == 0:
+                continue
+            if hi - start + 1 > self.batch.span_cap:
+                # one overflowing shard: re-answer the whole query singly
+                return _PendingHits(
+                    self.seg, self.seg._rcap,
+                    self._refetch(self.seg._rcap), self._refetch,
+                    self._packed,
+                ).rows()
+            base = d * self.batch.shard_n
+            parts.append(
+                base + _decode_bitmap_rows(b[d, self.i], start, cnt)
+            )
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate(parts)
 
 
 def _decode_bitmap_rows(bits: np.ndarray, start: int, max_out: int) -> np.ndarray:
@@ -1417,6 +1569,9 @@ class DeviceSegment:
         # bitmap-batch span window (rows): starts at the full segment and
         # narrows to the widest observed query span
         self._span_cap = 0  # 0 = unlearned -> full segment
+        # per-SHARD span window for the shard-extract bitmap edition
+        # (each chip frames its local hits; window <= shard_n)
+        self._shard_span_cap = 0
         # raw f32 coords + ms offsets are only needed by fused aggregations;
         # packed lazily on first density_scan (load_raw)
         self.xf = self.yf = self.t_ms = None
@@ -1549,17 +1704,47 @@ class DeviceSegment:
         elif want < cur:
             self._span_cap = max(want, cur // 2)
 
+    def shard_n(self) -> int:
+        return self.n_padded // max(1, self.mesh.devices.size)
+
+    def shard_span_cap(self) -> int:
+        """Per-shard bitmap window (pow2 bucket, multiple of 8 because
+        n_padded divides by 8*n_devices by construction)."""
+        if self._shard_span_cap == 0:
+            return self.shard_n()
+        return min(self._shard_span_cap, self.shard_n())
+
+    def remember_shard_span(self, span: int) -> None:
+        """Adapt the per-shard window to the widest LOCAL span observed
+        across a stream's (shard, query) windows."""
+        want = min(
+            _pow2_at_least(max(int(span * 1.25), 1), 1 << 13), self.shard_n()
+        )
+        cur = self._shard_span_cap or self.shard_n()
+        if want > cur:
+            self._shard_span_cap = want
+        elif want < cur:
+            self._shard_span_cap = max(want, cur // 2)
+        else:
+            self._shard_span_cap = want  # observed == window: pin it
+
     def seed_span(self, span: int) -> None:
-        """Seed the bitmap span window from the PLAN before the first
+        """Seed the bitmap span windows from the PLAN before the first
         device stream (only when unlearned): the host's decomposed
         z-ranges conservatively cover every hit row, so the widest
         planned candidate span bounds the true hit span — killing the
         full-window first stream (n_padded/8 bytes per query per plane)
-        that an unlearned segment otherwise pays. Learned values are
-        never overridden; observation stays the source of truth."""
+        that an unlearned segment otherwise pays. The same global bound
+        also caps every shard's LOCAL span, so the shard-extract window
+        seeds too. Learned values are never overridden; observation
+        stays the source of truth."""
         if self._span_cap == 0:
             self._span_cap = min(
                 _pow2_at_least(max(int(span), 1), 1 << 16), self.n_padded
+            )
+        if self._shard_span_cap == 0:
+            self._shard_span_cap = min(
+                _pow2_at_least(max(int(span), 1), 1 << 13), self.shard_n()
             )
 
     def remember_entry_total(self, total: int) -> None:
@@ -1908,6 +2093,39 @@ class DeviceSegment:
                 )
             return build
 
+        if proto == "bitmap" and _shard_extract_on(mode, self.mesh):
+            # per-shard extraction: each chip frames its LOCAL window,
+            # the host stitches with shard row offsets — no collectives
+            n_sh = self.mesh.devices.size
+            span_cap = self.shard_span_cap()
+            trace = _batch_trace(self, args, qpad, "bitmap_shard", 0)
+            hdr, bits = _exact_shard_bitmap_batch_fn(
+                has_time, span_cap, qpad, self.mesh, is_attr
+            )(*args)
+            if trace is not None:
+                trace["out_bytes"] = int(hdr.nbytes) + int(bits.nbytes)
+            _start_d2h(hdr, bits)
+            batch = _ShardBitmapBatch(
+                hdr, bits, span_cap, n_sh, qpad, self.shard_n(),
+                seg=self, trace=trace,
+            )
+            out = []
+            for i, d in enumerate(descs):
+                single_args = single_args_for(
+                    d[0], d[1], d[2] if is_attr else None
+                )
+                out.append(
+                    _PendingShardBitmapHits(
+                        self, batch, i,
+                        refetch=lambda rc, sa=single_args: _exact_runs_fn(
+                            has_time, rc, mode, self.mesh, is_attr
+                        )(*sa()),
+                        packed=lambda sa=single_args: _exact_packed_fn(
+                            has_time, mode, self.mesh, is_attr
+                        )(*sa()),
+                    )
+                )
+            return out
         if proto == "bitmap":
             span_cap = self.span_cap()
             trace = _batch_trace(self, args, qpad, "bitmap", 0)
