@@ -2093,6 +2093,17 @@ class DeviceSegment:
                 )
             return build
 
+        def single_fallbacks(single_args):
+            """(refetch, packed) single-query escalation pair — one
+            definition for all three wire-format branches."""
+            refetch = lambda rc, sa=single_args: _exact_runs_fn(  # noqa: E731
+                has_time, rc, mode, self.mesh, is_attr
+            )(*sa())
+            packed = lambda sa=single_args: _exact_packed_fn(  # noqa: E731
+                has_time, mode, self.mesh, is_attr
+            )(*sa())
+            return refetch, packed
+
         if proto == "bitmap" and _shard_extract_on(mode, self.mesh):
             # per-shard extraction: each chip frames its LOCAL window,
             # the host stitches with shard row offsets — no collectives
@@ -2111,19 +2122,11 @@ class DeviceSegment:
             )
             out = []
             for i, d in enumerate(descs):
-                single_args = single_args_for(
+                refetch, packed = single_fallbacks(single_args_for(
                     d[0], d[1], d[2] if is_attr else None
-                )
+                ))
                 out.append(
-                    _PendingShardBitmapHits(
-                        self, batch, i,
-                        refetch=lambda rc, sa=single_args: _exact_runs_fn(
-                            has_time, rc, mode, self.mesh, is_attr
-                        )(*sa()),
-                        packed=lambda sa=single_args: _exact_packed_fn(
-                            has_time, mode, self.mesh, is_attr
-                        )(*sa()),
-                    )
+                    _PendingShardBitmapHits(self, batch, i, refetch, packed)
                 )
             return out
         if proto == "bitmap":
@@ -2138,19 +2141,11 @@ class DeviceSegment:
             batch = _BitmapBatch(hdr, bits, span_cap, seg=self, trace=trace)
             out = []
             for i, d in enumerate(descs):
-                single_args = single_args_for(
+                refetch, packed = single_fallbacks(single_args_for(
                     d[0], d[1], d[2] if is_attr else None
-                )
+                ))
                 out.append(
-                    _PendingBitmapHits(
-                        self, batch, i,
-                        refetch=lambda rc, sa=single_args: _exact_runs_fn(
-                            has_time, rc, mode, self.mesh, is_attr
-                        )(*sa()),
-                        packed=lambda sa=single_args: _exact_packed_fn(
-                            has_time, mode, self.mesh, is_attr
-                        )(*sa()),
-                    )
+                    _PendingBitmapHits(self, batch, i, refetch, packed)
                 )
             return out
         pack = proto == "runs_packed"
@@ -2182,16 +2177,9 @@ class DeviceSegment:
         for i, d in enumerate(descs):
             # escalation/bitmap fallbacks re-dispatch the SINGLE-query fns
             # with this query's own descriptor (rare: capacities adapt)
-            single_args = single_args_for(
+            refetch, packed = single_fallbacks(single_args_for(
                 d[0], d[1], d[2] if is_attr else None
-            )
-
-            refetch = lambda rc, sa=single_args: _exact_runs_fn(  # noqa: E731
-                has_time, rc, mode, self.mesh, is_attr
-            )(*sa())
-            packed = lambda sa=single_args: _exact_packed_fn(  # noqa: E731
-                has_time, mode, self.mesh, is_attr
-            )(*sa())
+            ))
             if pack:
                 out.append(_PendingPackedHits(self, batch, i, refetch, packed))
             else:

@@ -196,20 +196,7 @@ class RasterStore:
             )
             if done:
                 break
-            # odd edges are clipped by the box filter: shrink the envelope
-            # to the clipped extent FIRST or every coarser level's pixels
-            # would be stretched (mis-registered) by up to one source pixel
-            h2, w2 = h // 2 * 2, w // 2 * 2
-            if (h2, w2) != (h, w):
-                res_x = (envelope.xmax - envelope.xmin) / w
-                res_y = (envelope.ymax - envelope.ymin) / h
-                envelope = Envelope(
-                    envelope.xmin,
-                    envelope.ymax - h2 * res_y,
-                    envelope.xmin + w2 * res_x,
-                    envelope.ymax,
-                )
-            data = _downsample2(data)
+            data, envelope = clip_and_downsample(data, envelope)
             level += 1
         return out
 
@@ -285,15 +272,38 @@ class RasterStore:
         chip_size: int = 256,
         levels: Optional[int] = None,
         name: str = "r",
+        use_overviews: bool = False,
     ) -> Dict[float, int]:
         """Real-format ingest (VERDICT r3 #6): parse a GeoTIFF
         (raster_io.read_geotiff — strip/tile, none/deflate) and feed the
-        pyramid chain. The reference's coverage ingest is
-        geomesa-accumulo-raster's AccumuloRasterStore fed by GeoServer
-        pyramid levels; here the format edge and the overview chain both
-        live in-store."""
-        from geomesa_tpu.raster_io import read_geotiff
+        pyramid chain. ``use_overviews`` ingests the file's OWN chained
+        reduced-resolution IFD pages as pyramid levels instead of
+        rebuilding the overview chain — exactly how the reference's
+        coverage ingest consumes GeoServer-built pyramid levels
+        (geomesa-accumulo-raster AccumuloRasterStore)."""
+        from geomesa_tpu.raster_io import read_geotiff, read_geotiff_pages
 
+        if use_overviews:
+            # only the base page + genuine reduced-resolution pages
+            # (NewSubfileType bit 0) become pyramid levels; mask or
+            # unrelated pages are skipped. ``levels`` caps the count.
+            pages = read_geotiff_pages(path, overviews_only=True)
+            if levels is not None:
+                pages = pages[: max(1, levels)]
+            if any(env is None for _d, env in pages):
+                raise ValueError(
+                    "GeoTIFF page without georeferencing (ModelPixelScale "
+                    "+ ModelTiepoint required on every ingested page)"
+                )
+            out: Dict[float, int] = {}
+            for k, (data, env) in enumerate(pages):
+                out.update(
+                    self.ingest_raster(
+                        data, env, chip_size=chip_size, levels=1,
+                        name=f"{name}_p{k}",
+                    )
+                )
+            return out
         data, env = read_geotiff(path)
         if env is None:
             raise ValueError(
@@ -346,6 +356,31 @@ class RasterStore:
         ry = np.clip(((np.arange(height) + 0.5) * src_h / height).astype(int), 0, src_h - 1)
         rx = np.clip(((np.arange(width) + 0.5) * src_w / width).astype(int), 0, src_w - 1)
         return grid[np.ix_(ry, rx)]
+
+
+def clip_and_downsample(
+    data: np.ndarray, envelope: Envelope
+) -> Tuple[np.ndarray, Envelope]:
+    """One overview step: clip odd edges (shrinking the envelope FIRST so
+    the coarser level's pixels stay registered), 2x box-filter, and cast
+    back to the source dtype — THE single home of the overview
+    registration math (ingest_raster and the GeoTIFF writer both use
+    it)."""
+    h, w = data.shape[:2]
+    h2, w2 = h // 2 * 2, w // 2 * 2
+    if (h2, w2) != (h, w):
+        res_x = (envelope.xmax - envelope.xmin) / w
+        res_y = (envelope.ymax - envelope.ymin) / h
+        envelope = Envelope(
+            envelope.xmin,
+            envelope.ymax - h2 * res_y,
+            envelope.xmin + w2 * res_x,
+            envelope.ymax,
+        )
+        data = data[:h2, :w2]
+    # the box filter means in float; integer sources cast back so
+    # overview pages keep the base page's storage type
+    return _downsample2(data).astype(data.dtype, copy=False), envelope
 
 
 def _downsample2(data: np.ndarray) -> np.ndarray:

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import struct
 import zlib
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -45,6 +45,7 @@ _SAMPLE_FORMAT = 339  # 1 = uint, 2 = int, 3 = ieee float
 _MODEL_PIXEL_SCALE = 33550  # 3 doubles: sx, sy, sz
 _MODEL_TIEPOINT = 33922  # 6 doubles: i, j, k, x, y, z
 _GEO_KEY_DIRECTORY = 34735
+_NEW_SUBFILE_TYPE = 254  # 1 = reduced-resolution (overview) page
 
 # field type -> (struct code, byte size)
 _TYPES = {
@@ -61,8 +62,8 @@ _TYPES = {
 }
 
 
-def _read_ifd(buf: bytes, bo: str, off: int) -> Dict[int, tuple]:
-    """One IFD -> {tag: tuple_of_values} (value arrays resolved)."""
+def _read_ifd(buf: bytes, bo: str, off: int) -> Tuple[Dict[int, tuple], int]:
+    """One IFD -> ({tag: tuple_of_values}, next_ifd_offset)."""
     (count,) = struct.unpack_from(bo + "H", buf, off)
     tags: Dict[int, tuple] = {}
     for i in range(count):
@@ -82,7 +83,8 @@ def _read_ifd(buf: bytes, bo: str, off: int) -> Dict[int, tuple]:
             )
         else:
             tags[tag] = struct.unpack_from(bo + code * n, buf, voff)
-    return tags
+    (nxt,) = struct.unpack_from(bo + "I", buf, off + 2 + 12 * count)
+    return tags, nxt
 
 
 def _dtype_of(tags: Dict[int, tuple], bo: str) -> np.dtype:
@@ -125,13 +127,8 @@ def _decode_chunk(
     return arr
 
 
-def read_geotiff(path) -> Tuple[np.ndarray, Optional[Envelope]]:
-    """Classic TIFF -> (array [H,W] or [H,W,bands], envelope or None).
-
-    Strip and tile layouts; compression none/deflate; predictor
-    none/horizontal; chunky planar config; first IFD only (overview IFDs
-    are ignored — the pyramid store builds its own overview chain).
-    """
+def _read_buf(path) -> Tuple[bytes, str, int]:
+    """(file bytes, byte order, first IFD offset) with format checks."""
     if hasattr(path, "read"):
         buf = path.read()
     else:
@@ -148,8 +145,47 @@ def read_geotiff(path) -> Tuple[np.ndarray, Optional[Envelope]]:
         raise ValueError("BigTIFF is not supported (classic TIFF only)")
     if magic != 42:
         raise ValueError(f"not a TIFF file (magic {magic})")
-    tags = _read_ifd(buf, bo, ifd_off)
+    return buf, bo, ifd_off
 
+
+def read_geotiff(path) -> Tuple[np.ndarray, Optional[Envelope]]:
+    """Classic TIFF -> (array [H,W] or [H,W,bands], envelope or None).
+
+    Strip and tile layouts; compression none/deflate; predictor
+    none/horizontal; chunky planar config; FIRST IFD (use
+    ``read_geotiff_pages`` for overview pages)."""
+    buf, bo, ifd_off = _read_buf(path)
+    tags, _nxt = _read_ifd(buf, bo, ifd_off)
+    return _decode_page(buf, bo, tags)
+
+
+def read_geotiff_pages(
+    path, overviews_only: bool = False
+) -> List[Tuple[np.ndarray, Optional[Envelope]]]:
+    """Every IFD page (main image + chained pages) in file order —
+    pre-built pyramid levels the store can ingest directly (the
+    reference ingests GeoServer-built levels the same way).
+    ``overviews_only`` keeps the first page plus only pages whose
+    NewSubfileType marks them reduced-resolution (bit 0) — mask pages,
+    transparency pages, or unrelated multi-page images are skipped."""
+    buf, bo, ifd_off = _read_buf(path)
+    pages = []
+    seen = set()
+    first = True
+    while ifd_off and ifd_off not in seen:
+        seen.add(ifd_off)  # cycle guard on a corrupt chain
+        tags, ifd_off = _read_ifd(buf, bo, ifd_off)
+        if not first and overviews_only:
+            if not tags.get(_NEW_SUBFILE_TYPE, (0,))[0] & 1:
+                continue
+        pages.append(_decode_page(buf, bo, tags))
+        first = False
+    return pages
+
+
+def _decode_page(
+    buf: bytes, bo: str, tags: Dict[int, tuple]
+) -> Tuple[np.ndarray, Optional[Envelope]]:
     w = tags[_IMAGE_WIDTH][0]
     h = tags[_IMAGE_LENGTH][0]
     spp = tags.get(_SAMPLES_PER_PIXEL, (1,))[0]
@@ -205,11 +241,33 @@ def write_geotiff(
     data: np.ndarray,
     envelope: Envelope,
     compress: bool = True,
+    tile: Optional[int] = None,
+    overviews: int = 0,
 ) -> None:
     """Array [H,W] or [H,W,bands] + envelope -> classic GeoTIFF
-    (little-endian, strip layout, deflate when ``compress``, EPSG:4326
-    geographic keys)."""
-    data = np.ascontiguousarray(np.asarray(data))
+    (little-endian, deflate when ``compress``, EPSG:4326 geographic
+    keys). ``tile`` switches to a tiled layout (edge a multiple of 16);
+    ``overviews`` chains that many 2x box-filter reduced-resolution
+    pages as extra IFDs (NewSubfileType=1) — the pre-built pyramid
+    shape the reference's coverage pipeline produces."""
+    if tile is not None and tile % 16 != 0:
+        raise ValueError("tile edge must be a multiple of 16")
+    from geomesa_tpu.raster import clip_and_downsample
+
+    d = np.ascontiguousarray(np.asarray(data))
+    env = envelope
+    pages = [(d, env, False)]
+    for _ in range(max(0, overviews)):
+        if d.shape[0] < 2 or d.shape[1] < 2:
+            break
+        d, env = clip_and_downsample(d, env)
+        d = np.ascontiguousarray(d)
+        pages.append((d, env, True))
+    _write_pages(path, pages, compress, tile)
+
+
+def _page_chunks(data, envelope, compress, tile, reduced):
+    """(entries, chunks) for one IFD page; offsets patched at layout."""
     if data.ndim == 2:
         data = data[:, :, None]
     if data.ndim != 3:
@@ -222,84 +280,123 @@ def write_geotiff(
         raise ValueError(f"unsupported dtype {data.dtype}")
     bits = dt.itemsize * 8
 
-    row_bytes = w * spp * dt.itemsize
-    rps = max(1, min(h, (1 << 16) // max(row_bytes, 1) or 1))
-    strips = []
-    for r0 in range(0, h, rps):
-        raw = data[r0 : r0 + rps].tobytes()
-        strips.append(zlib.compress(raw, 6) if compress else raw)
+    chunks = []
+    entries = []  # (tag, type, count, values | None for chunk offsets)
+    if tile is not None:
+        for r0 in range(0, h, tile):
+            for c0 in range(0, w, tile):
+                t = np.zeros((tile, tile, spp), dt)
+                rr = min(tile, h - r0)
+                cc = min(tile, w - c0)
+                t[:rr, :cc] = data[r0 : r0 + rr, c0 : c0 + cc]
+                raw = t.tobytes()
+                chunks.append(zlib.compress(raw, 6) if compress else raw)
+        entries.append((_TILE_WIDTH, 3, 1, (tile,)))
+        entries.append((_TILE_LENGTH, 3, 1, (tile,)))
+        entries.append((_TILE_OFFSETS, 4, len(chunks), None))
+        entries.append(
+            (_TILE_BYTE_COUNTS, 4, len(chunks),
+             tuple(len(c) for c in chunks))
+        )
+    else:
+        row_bytes = w * spp * dt.itemsize
+        rps = max(1, min(h, (1 << 16) // max(row_bytes, 1) or 1))
+        for r0 in range(0, h, rps):
+            raw = data[r0 : r0 + rps].tobytes()
+            chunks.append(zlib.compress(raw, 6) if compress else raw)
+        entries.append((_STRIP_OFFSETS, 4, len(chunks), None))
+        entries.append((_ROWS_PER_STRIP, 4, 1, (rps,)))
+        entries.append(
+            (_STRIP_BYTE_COUNTS, 4, len(chunks),
+             tuple(len(c) for c in chunks))
+        )
 
     sx = (envelope.xmax - envelope.xmin) / w
     sy = (envelope.ymax - envelope.ymin) / h
     # GTModelType=2 (geographic), GTRasterType=1 (pixel-is-area),
     # GeographicType=4326
     geo_keys = (1, 1, 0, 3, 1024, 0, 1, 2, 1025, 0, 1, 1, 2048, 0, 1, 4326)
-
-    entries = []  # (tag, type, count, values)
-    entries.append((_IMAGE_WIDTH, 4, 1, (w,)))
-    entries.append((_IMAGE_LENGTH, 4, 1, (h,)))
-    entries.append((_BITS_PER_SAMPLE, 3, spp, (bits,) * spp))
-    entries.append((_COMPRESSION, 3, 1, (8 if compress else 1,)))
-    entries.append((_PHOTOMETRIC, 3, 1, (1,)))  # BlackIsZero
-    entries.append((_STRIP_OFFSETS, 4, len(strips), None))  # patched below
-    entries.append((_SAMPLES_PER_PIXEL, 3, 1, (spp,)))
-    entries.append((_ROWS_PER_STRIP, 4, 1, (rps,)))
-    entries.append(
-        (_STRIP_BYTE_COUNTS, 4, len(strips), tuple(len(s) for s in strips))
-    )
-    entries.append((_PLANAR_CONFIG, 3, 1, (1,)))
-    entries.append((_SAMPLE_FORMAT, 3, spp, (fmt,) * spp))
-    entries.append((_MODEL_PIXEL_SCALE, 12, 3, (sx, sy, 0.0)))
-    entries.append(
+    entries += [
+        (_IMAGE_WIDTH, 4, 1, (w,)),
+        (_IMAGE_LENGTH, 4, 1, (h,)),
+        (_BITS_PER_SAMPLE, 3, spp, (bits,) * spp),
+        (_COMPRESSION, 3, 1, (8 if compress else 1,)),
+        (_PHOTOMETRIC, 3, 1, (1,)),  # BlackIsZero
+        (_SAMPLES_PER_PIXEL, 3, 1, (spp,)),
+        (_PLANAR_CONFIG, 3, 1, (1,)),
+        (_SAMPLE_FORMAT, 3, spp, (fmt,) * spp),
+        (_MODEL_PIXEL_SCALE, 12, 3, (sx, sy, 0.0)),
         (_MODEL_TIEPOINT, 12, 6,
-         (0.0, 0.0, 0.0, envelope.xmin, envelope.ymax, 0.0))
-    )
-    entries.append((_GEO_KEY_DIRECTORY, 3, len(geo_keys), geo_keys))
+         (0.0, 0.0, 0.0, envelope.xmin, envelope.ymax, 0.0)),
+        (_GEO_KEY_DIRECTORY, 3, len(geo_keys), geo_keys),
+    ]
+    if reduced:
+        entries.append((_NEW_SUBFILE_TYPE, 4, 1, (1,)))
     entries.sort(key=lambda e: e[0])
+    return entries, chunks
 
-    # layout: header(8) | IFD | overflow values | strip data
-    ifd_off = 8
-    ifd_size = 2 + 12 * len(entries) + 4
-    over_off = ifd_off + ifd_size
-    over = bytearray()
+
+def _write_pages(path, pages, compress, tile) -> None:
+    """Serialize a chain of (data, envelope, reduced) IFD pages:
+    header | [IFD + overflow values] per page | all chunk data."""
 
     def value_bytes(ftype, vals):
         code = _TYPES[ftype][0]
         return struct.pack("<" + code * len(vals), *vals)
 
-    # first pass: compute overflow area size to place strip data
-    placeholders = {}
-    for tag, ftype, n, vals in entries:
-        size = _TYPES[ftype][1] * n
-        if size > 4:
-            placeholders[tag] = len(over)
-            over.extend(b"\0" * size)
-    data_off = over_off + len(over)
-    strip_offsets = []
-    pos = data_off
-    for s in strips:
-        strip_offsets.append(pos)
-        pos += len(s)
+    built = [_page_chunks(d, e, compress, tile, r) for d, e, r in pages]
+    # layout pass: place every IFD + its overflow, then the data region
+    pos = 8
+    layouts = []  # (ifd_off, over_off, placeholders)
+    for entries, _chunks in built:
+        ifd_off = pos
+        over_off = ifd_off + 2 + 12 * len(entries) + 4
+        placeholders = {}
+        osize = 0
+        for tag, ftype, n, _vals in entries:
+            size = _TYPES[ftype][1] * n
+            if size > 4:
+                placeholders[tag] = osize
+                osize += size
+        layouts.append((ifd_off, over_off, placeholders))
+        pos = over_off + osize
+    chunk_offsets = []
+    for _entries, chunks in built:
+        offs = []
+        for c in chunks:
+            offs.append(pos)
+            pos += len(c)
+        chunk_offsets.append(offs)
 
-    # second pass: serialize
     out = bytearray()
-    out += struct.pack("<2sHI", b"II", 42, ifd_off)
-    out += struct.pack("<H", len(entries))
-    over = bytearray(len(over))
-    for tag, ftype, n, vals in entries:
-        if tag == _STRIP_OFFSETS:
-            vals = tuple(strip_offsets)
-        vb = value_bytes(ftype, vals)
-        if len(vb) <= 4:
-            out += struct.pack("<HHI", tag, ftype, n) + vb.ljust(4, b"\0")
-        else:
-            voff = over_off + placeholders[tag]
-            out += struct.pack("<HHII", tag, ftype, n, voff)
-            over[placeholders[tag] : placeholders[tag] + len(vb)] = vb
-    out += struct.pack("<I", 0)  # no next IFD
-    out += over
-    for s in strips:
-        out += s
+    out += struct.pack("<2sHI", b"II", 42, layouts[0][0])
+    for pi, ((entries, chunks), (ifd_off, over_off, placeholders)) in enumerate(
+        zip(built, layouts)
+    ):
+        assert len(out) == ifd_off
+        out += struct.pack("<H", len(entries))
+        osize = sum(
+            _TYPES[ft][1] * n
+            for _t, ft, n, _v in entries
+            if _TYPES[ft][1] * n > 4
+        )
+        over = bytearray(osize)
+        for tag, ftype, n, vals in entries:
+            if tag in (_STRIP_OFFSETS, _TILE_OFFSETS) and vals is None:
+                vals = tuple(chunk_offsets[pi])
+            vb = value_bytes(ftype, vals)
+            if len(vb) <= 4:
+                out += struct.pack("<HHI", tag, ftype, n) + vb.ljust(4, b"\0")
+            else:
+                voff = over_off + placeholders[tag]
+                out += struct.pack("<HHII", tag, ftype, n, voff)
+                over[placeholders[tag] : placeholders[tag] + len(vb)] = vb
+        nxt = layouts[pi + 1][0] if pi + 1 < len(layouts) else 0
+        out += struct.pack("<I", nxt)
+        out += over
+    for _entries, chunks in built:
+        for c in chunks:
+            out += c
 
     if hasattr(path, "write"):
         path.write(bytes(out))

@@ -184,6 +184,102 @@ def test_geotiff_drives_pyramid_store(tmp_path):
     assert benv.ymax == pytest.approx(sub.ymax)
 
 
+def test_tiled_write_roundtrip():
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 60_000, (200, 310), dtype=np.uint16)
+    buf = io.BytesIO()
+    write_geotiff(buf, data, ENV, compress=True, tile=64)
+    buf.seek(0)
+    got, env = read_geotiff(buf)
+    np.testing.assert_array_equal(got, data)
+    assert env.xmin == pytest.approx(ENV.xmin)
+    with pytest.raises(ValueError, match="multiple of 16"):
+        write_geotiff(io.BytesIO(), data, ENV, tile=50)
+
+
+def test_overview_pages_roundtrip():
+    """Multi-IFD overview chain: pages read back in order with 2x-coarser
+    resolutions and consistent envelopes."""
+    from geomesa_tpu.raster_io import read_geotiff_pages
+
+    rng = np.random.default_rng(8)
+    data = rng.normal(0, 10, (301, 403)).astype(np.float32)  # odd edges
+    buf = io.BytesIO()
+    write_geotiff(buf, data, ENV, overviews=3)
+    buf.seek(0)
+    pages = read_geotiff_pages(buf)
+    assert len(pages) == 4
+    np.testing.assert_array_equal(pages[0][0], data)
+    prev_res = (ENV.xmax - ENV.xmin) / 403
+    for arr, env in pages[1:]:
+        res = (env.xmax - env.xmin) / arr.shape[1]
+        assert res == pytest.approx(prev_res * 2, rel=1e-6)
+        prev_res = res
+        # every page's envelope nests inside the base envelope
+        assert env.xmin >= ENV.xmin - 1e-9 and env.ymax <= ENV.ymax + 1e-9
+
+
+def test_integer_overviews_keep_dtype():
+    from geomesa_tpu.raster_io import read_geotiff_pages
+
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 60_000, (128, 128), dtype=np.uint16)
+    buf = io.BytesIO()
+    write_geotiff(buf, data, ENV, overviews=2)
+    buf.seek(0)
+    pages = read_geotiff_pages(buf)
+    assert [p[0].dtype for p in pages] == [np.uint16] * 3
+
+
+def test_overviews_only_skips_mask_pages():
+    """A non-overview extra page (NewSubfileType without bit 0) must not
+    become a pyramid level."""
+    import geomesa_tpu.raster_io as rio
+
+    rng = np.random.default_rng(10)
+    data = rng.integers(0, 255, (64, 64), dtype=np.uint8)
+    buf = io.BytesIO()
+    write_geotiff(buf, data, ENV, overviews=1)
+    raw = bytearray(buf.getvalue())
+    # flip the overview page's NewSubfileType from 1 (reduced) to 4
+    # (transparency mask) in place
+    pos = raw.find(rio._NEW_SUBFILE_TYPE.to_bytes(2, "little") + (4).to_bytes(2, "little"))
+    assert pos > 0
+    assert raw[pos + 8] == 1
+    raw[pos + 8] = 4
+    pages_all = rio.read_geotiff_pages(io.BytesIO(bytes(raw)))
+    pages_ov = rio.read_geotiff_pages(
+        io.BytesIO(bytes(raw)), overviews_only=True
+    )
+    assert len(pages_all) == 2 and len(pages_ov) == 1
+
+
+def test_ingest_prebuilt_overviews(tmp_path):
+    """use_overviews=True consumes the file's own pyramid levels (the
+    GeoServer-built-levels ingest path of the reference)."""
+    from geomesa_tpu.raster_io import read_geotiff_pages
+
+    yy, xx = np.mgrid[0:256, 0:512]
+    data = (np.sin(xx / 31.0) * 500 + yy).astype(np.float32)
+    env = Envelope(-10.0, 20.0, 22.0, 36.0)
+    src = tmp_path / "ov.tif"
+    write_geotiff(src, data, env, overviews=2, tile=128)
+
+    store = RasterStore()
+    levels = store.ingest_geotiff(src, chip_size=128, use_overviews=True)
+    assert len(levels) == 3  # base + 2 pre-built overviews, no rebuild
+    # full-res window reproduces the base page exactly
+    got = store.read_window(env, 512, 256)
+    np.testing.assert_array_equal(got, data)
+    # a coarse window picks a pre-built overview level
+    coarse = store.read_window(env, 128, 64)
+    want = read_geotiff_pages(str(src))[2][0]
+    assert coarse.shape == (64, 128)
+    np.testing.assert_allclose(
+        coarse.mean(), want.mean(), rtol=0.05
+    )
+
+
 def test_reader_rejects_non_tiff(tmp_path):
     p = tmp_path / "x.bin"
     p.write_bytes(b"NOPE not a tiff")
