@@ -293,25 +293,27 @@ def _exact_mask_body(has_time: bool, mode: str, mesh, attr: bool = False):
     """Unjitted exact-predicate mask callable (ops.filters.exact_st_mask),
     shard_map-wrapped for multi-chip meshes.
 
-    ``attr`` adds the dictionary-code equality plane (the device half of
-    the reference's join attribute strategy, AttributeIndex.scala:42,392
-    — evaluate the secondary attribute predicate AT the data): one extra
-    row-sharded i32 ``codes`` column compared against a replicated
-    per-query ``qcode`` (shape (1,); -2 = literal absent from the
-    segment vocab, matching nothing; nulls are -1)."""
+    ``attr`` adds the dictionary-code membership plane (the device half
+    of the reference's join attribute strategy,
+    AttributeIndex.scala:42,392 — evaluate the secondary attribute
+    predicate AT the data): one extra row-sharded i32 ``codes`` column
+    tested against a replicated per-query ``qcode`` vector (shape (K,):
+    equality is K=1, IN-lists pad to the batch's K bucket; -2 = literal
+    absent from the segment vocab, matching nothing; nulls are -1).
+    jit re-specializes per K automatically (shape-keyed)."""
     from geomesa_tpu.ops.filters import exact_st_mask
 
     if has_time and attr:
         def body(xh, xl, yh, yl, th, tl, valid, codes, box, win, qcode):
             m = exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
-            return m & (codes == qcode[0])
+            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
     elif has_time:
         def body(xh, xl, yh, yl, th, tl, valid, box, win):
             return exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
     elif attr:
         def body(xh, xl, yh, yl, valid, codes, box, qcode):
             m = exact_st_mask(xh, xl, yh, yl, valid, box)
-            return m & (codes == qcode[0])
+            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
     else:
         def body(xh, xl, yh, yl, valid, box):
             return exact_st_mask(xh, xl, yh, yl, valid, box)
@@ -1973,16 +1975,26 @@ class DeviceSegment:
             return i
         return -2
 
+    def attr_qcodes(self, attr: str, values, k: int) -> np.ndarray:
+        """i32[k] code vector for an IN-list (equality = length 1),
+        padded with the match-nothing sentinel."""
+        out = np.full(k, -2, dtype=np.int32)
+        for j, v in enumerate(values[:k]):
+            out[j] = self.attr_qcode(attr, v)
+        return out
+
     def dispatch_exact_attr(
-        self, box_dev, win_dev, attr: str, value
+        self, box_dev, win_dev, attr: str, values
     ) -> "_PendingHits":
-        """Single-query edition of the attr-equality plane (a lone query
-        must not lose device exactness to the conservative fallback)."""
+        """Single-query edition of the attr-membership plane (a lone
+        query must not lose device exactness to the conservative
+        fallback). ``values`` is the literal tuple (equality = len 1)."""
         has_time = self.tk_hi is not None and win_dev is not None
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
         codes_dev = self._attr_codes[attr][0]
         qc = replicate(
-            self.mesh, np.array([self.attr_qcode(attr, value)], np.int32)
+            self.mesh,
+            self.attr_qcodes(attr, values, _pow2_at_least(len(values), 1)),
         )
         args = self._exact_args(box_dev, win_dev, has_time, codes_dev, qc)
         rcap = self._rcap
@@ -2056,15 +2068,16 @@ class DeviceSegment:
             wins_dev = replicate(self.mesh, wins_np)
         else:
             wins_dev = None
-        # attr-equality plane: descs carry the literal VALUE (codes are
-        # segment-local); map each to this segment's unified qcode here
+        # attr-membership plane: descs carry the literal VALUE TUPLE
+        # (codes are segment-local); map each to this segment's unified
+        # qcodes here, padded to the batch's K bucket (equality = K 1)
         is_attr = attr is not None
         codes_dev = self._attr_codes[attr][0] if is_attr else None
         if is_attr:
-            qcodes_np = np.array(
-                [[self.attr_qcode(attr, d[2])] for d in descs]
-                + [[self.attr_qcode(attr, descs[-1][2])]] * (qpad - q),
-                dtype=np.int32,
+            kk = _pow2_at_least(max(len(d[2]) for d in descs), 1)
+            qcodes_np = np.stack(
+                [self.attr_qcodes(attr, d[2], kk) for d in descs]
+                + [self.attr_qcodes(attr, descs[-1][2], kk)] * (qpad - q)
             )
             qcodes_dev = replicate(self.mesh, qcodes_np)
         else:
@@ -2074,12 +2087,14 @@ class DeviceSegment:
         )
         rcap = self._rcap
 
-        def single_args_for(box_np, win_np, value):
+        def single_args_for(box_np, win_np, values):
             def build():
                 qc = (
                     replicate(
                         self.mesh,
-                        np.array([self.attr_qcode(attr, value)], np.int32),
+                        self.attr_qcodes(
+                            attr, values, _pow2_at_least(len(values), 1)
+                        ),
                     )
                     if is_attr
                     else None
@@ -4009,12 +4024,13 @@ class TpuScanExecutor:
         return self._shape_limbs(shape)
 
     def _attr_batch_desc(self, table: IndexTable, plan: QueryPlan):
-        """(attr_name, (box_limbs, win_limbs|None, literal)) when the
-        plan's FULL filter is one box(+window) AND exactly one string-
-        attribute equality — the device then decides everything,
-        including the secondary attribute predicate (the join attribute
-        strategy evaluated at the data, AttributeIndex.scala:42,392).
-        None otherwise."""
+        """(attr_name, (box_limbs, win_limbs|None, values_tuple)) when
+        the plan's FULL filter is one box(+window) AND exactly one
+        string-attribute membership test — ``attr = 'x'`` or
+        ``attr IN (...)`` with at most 8 distinct values — so the device
+        decides everything, including the secondary attribute predicate
+        (the join attribute strategy evaluated at the data,
+        AttributeIndex.scala:42,392). None otherwise."""
         if not self._exact_device_enabled():
             return None
         if table.index.name not in ("z2", "z3"):
@@ -4027,17 +4043,29 @@ class TpuScanExecutor:
 
         attr_eq: List = []
 
+        def eligible(prop) -> bool:
+            return (
+                not prop.startswith("$.")
+                and ft.has(prop)
+                and ft.attr(prop).type == AttributeType.STRING
+                and not ft.attr(prop).json
+            )
+
         def match_attr(node) -> bool:
             if (
                 isinstance(node, A.Cmp)
                 and node.op == "="
-                and not node.prop.startswith("$.")
-                and ft.has(node.prop)
-                and ft.attr(node.prop).type == AttributeType.STRING
-                and not ft.attr(node.prop).json
+                and eligible(node.prop)
             ):
-                attr_eq.append((node.prop, node.literal))
+                attr_eq.append((node.prop, (str(node.literal),)))
                 return True
+            if isinstance(node, A.InList) and eligible(node.prop):
+                # dedup BEFORE the bucket cap (duplicate literals must
+                # not push a small distinct set off the device plane)
+                vals = tuple(dict.fromkeys(str(v) for v in node.values))
+                if 0 < len(vals) <= 8:  # K bucket cap
+                    attr_eq.append((node.prop, vals))
+                    return True
             return False
 
         got = self._walk_boxes(ft, plan.full_filter, extra_match=match_attr)
@@ -4047,8 +4075,8 @@ class TpuScanExecutor:
         if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
             return None
         limbs = self._shape_limbs((xmin, ymin, xmax, ymax, t_lo, t_hi))
-        attr, literal = attr_eq[0]
-        return attr, (limbs[0], limbs[1], str(literal))
+        attr, values = attr_eq[0]
+        return attr, (limbs[0], limbs[1], values)
 
     def _query_descriptor(self, table: IndexTable, plan: QueryPlan):
         """(boxes, windows) device-replicated arrays for this plan."""

@@ -90,6 +90,10 @@ def one_round(seed: int) -> int:
             "dtg DURING 2026-01-02T00:00:00Z/2026-01-20T00:00:00Z",
             "tag = 'no-such-tag' AND bbox(geom, -50, -40, 40, 40)",
             "tag = 'tag-5' AND bbox(geom, -20, -30, 60, 45)",
+            "tag IN ('tag-0', 'tag-4', 'missing') AND "
+            "bbox(geom, -55, -45, 45, 45)",
+            "tag IN ('tag-2', 'tag-6') AND bbox(geom, -40, -35, 50, 40) AND "
+            "dtg DURING 2026-01-03T00:00:00Z/2026-01-18T00:00:00Z",
         ]
         wants = {}
         for q in queries:

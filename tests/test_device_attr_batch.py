@@ -114,6 +114,49 @@ def test_attr_batch_after_delete():
     _parity(host, tpu, CQLS_Z2[:2])
 
 
+@pytest.mark.parametrize("proto", ["bitmap", "runs_packed"])
+def test_attr_in_list_parity(monkeypatch, proto):
+    """attr IN (...) rides the membership plane: K-padded qcode vectors
+    (equality is the K=1 case), mixed list sizes in one stream, absent
+    members, duplicates deduped."""
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", proto)
+    host, tpu = _stores()
+    cqls = [
+        "kind IN ('k0', 'k2') AND bbox(geom, -60, -40, 40, 30)",
+        "kind IN ('k1', 'k3', 'k4', 'nope') AND bbox(geom, -100, -60, 80, 60)",
+        "kind IN ('k2') AND bbox(geom, 0, 0, 90, 70)",
+        "kind IN ('k1', 'k1', 'k1') AND bbox(geom, -60, -40, 40, 30)",
+        "kind = 'k0' AND bbox(geom, -40, -30, 30, 20)",  # mixed with eq
+    ]
+    _parity(host, tpu, cqls)
+    table = tpu._tables["t"]["z2"]
+    dev = tpu.executor.device_index(table)
+    assert all(
+        getattr(s, "_attr_codes", {}).get("kind") is not None
+        for s in dev.segments
+    )
+
+
+def test_attr_in_list_with_time_and_lone():
+    host, tpu = _stores()
+    _parity(host, tpu, [
+        "kind IN ('k0', 'k3') AND bbox(geom, -60, -40, 40, 30) AND "
+        "dtg DURING 2026-01-03T00:00:00Z/2026-01-12T00:00:00Z",
+        "kind IN ('k1', 'k2') AND bbox(geom, -90, -50, 70, 55) AND "
+        "dtg DURING 2026-01-04T00:00:00Z/2026-01-14T00:00:00Z",
+    ])
+    # lone IN-list query: single-dispatch edition
+    _parity(host, tpu, ["kind IN ('k2', 'k4') AND bbox(geom, -50, -35, 35, 28)"])
+
+
+def test_attr_in_list_too_long_falls_back():
+    """Lists past the K bucket cap keep the conservative host path and
+    still answer exactly."""
+    host, tpu = _stores(n=6000)
+    vals = ", ".join(f"'v{i}'" for i in range(12))
+    _parity(host, tpu, [f"kind IN ({vals}, 'k1') AND bbox(geom, -60, -40, 40, 30)"])
+
+
 def test_lone_attr_query_stays_on_device():
     """A single eligible query (no batch partner) must still run the
     device attr plane via the single-query dispatch, exactly."""
