@@ -383,7 +383,8 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh,
 def _point_desc_split(mask, has_time: bool, args, attr: bool = False):
     """Shared arg split for the point batch builders: returns
     (mask_of(desc), stacked desc arrays for lax.scan). ``attr`` adds the
-    codes column (row-sharded) and per-query qcodes [q,1] to the scan."""
+    codes column (row-sharded) and per-query qcode vectors [q, K] to
+    the scan (K = pow2 membership bucket, equality is K=1)."""
     if has_time and attr:
         xh, xl, yh, yl, th, tl, valid, codes, boxes, wins, qcodes = args
         return (
@@ -1109,6 +1110,109 @@ def _xz_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str, mesh):
         fn = jax.jit(run)
         _XZ_BITMAP_BATCH_FNS[key] = fn
     return fn
+
+
+_DUAL_SHARD_BITMAP_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _dual_shard_bitmap_batch_fn(kind: str, has_time: bool, span_cap: int,
+                                q: int, mesh):
+    """PER-SHARD extraction edition of the dual-plane bitmap batches
+    (``kind`` = 'xz' extent envelopes | 'poly' banded ray cast): the
+    local mask AND the dual span framing run INSIDE shard_map, each chip
+    framing its LOCAL hit/decided windows; the host stitches shard rows
+    with offsets (see _exact_shard_bitmap_batch_fn — same shape, two
+    planes per window)."""
+    key = (kind, has_time, span_cap, q, mesh)
+    fn = _DUAL_SHARD_BITMAP_FNS.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec as P
+
+        if kind == "xz":
+            local = _xz_exact_mask_body(has_time, "local", mesh)
+            nrow, nrep = 12, 2
+
+            def split(args):
+                cols, qboxes, wins = args[:-2], args[-2], args[-1]
+                return (lambda d: local(*cols, d[0], d[1])), (qboxes, wins)
+        else:
+            local = _poly_mask_body(has_time, "local", mesh)
+            nrow, nrep = (9 if has_time else 7), 3
+
+            def split(args):
+                *cols, edges, boxes, wins = args
+                return (
+                    lambda d: local(*cols, d[0], d[1], d[2]),
+                    (edges, boxes, wins),
+                )
+
+        def shard_body(*args):
+            mask_of, descs = split(args)
+
+            def step(carry, d):
+                hit, dec = mask_of(d)
+                return carry, _dual_bitmap_row(hit, dec, span_cap)
+
+            _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
+            return headers, bitmaps  # per shard: [q,4], [q, 2*cap//8]
+
+        wrapped = shard_map_fn(
+            shard_body,
+            mesh,
+            in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * nrep),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            check=False,
+        )
+        fn = jax.jit(wrapped)
+        _DUAL_SHARD_BITMAP_FNS[key] = fn
+    return fn
+
+
+class _PendingDualShardBitmapHits:
+    """One extent/polygon query across every shard's dual windows:
+    rows() -> (hit_rows, decided_rows) stitched with shard offsets; any
+    shard span wider than the window falls back to the single-query
+    dual-runs refetch."""
+
+    __slots__ = ("seg", "batch", "i", "_refetch", "_packed", "_rows")
+
+    def __init__(self, seg, batch: "_ShardBitmapBatch", i: int,
+                 refetch, packed):
+        self.seg = seg
+        self.batch = batch
+        self.i = i
+        self._refetch = refetch
+        self._packed = packed
+        self._rows = None
+
+    def rows(self):
+        if self._rows is None:
+            self._rows = self._resolve()
+        return self._rows
+
+    def _resolve(self):
+        h, b = self.batch._fetch()
+        hits, decs = [], []
+        for d in range(self.batch.n_shards):
+            cnt, _lo, hi, start = (int(v) for v in h[d, self.i])
+            if cnt == 0:
+                continue
+            if hi - start + 1 > self.batch.span_cap:
+                return _PendingXZHits(
+                    self.seg, self.seg._rcap,
+                    self._refetch(self.seg._rcap), self._refetch,
+                    self._packed,
+                ).rows()
+            both = b[d, self.i]
+            half = len(both) // 2
+            base = d * self.batch.shard_n
+            hits.append(base + _decode_bitmap_rows(both[:half], start, cnt))
+            decs.append(base + _decode_bitmap_rows(both[half:], start, cnt))
+        empty = np.empty(0, dtype=np.int64)
+        return (
+            np.concatenate(hits) if hits else empty,
+            np.concatenate(decs) if decs else empty,
+        )
 
 
 class _PendingXZBitmapHits:
@@ -2230,6 +2334,23 @@ class DeviceSegment:
             self.xf, self.yf, edges_dev, box_dev, win_dev,
         )
 
+    def _dual_shard_batch(self, kind: str, has_time: bool, qpad: int,
+                          args) -> "_ShardBitmapBatch":
+        """Shared shard-extract dispatch for the dual-plane batches
+        ('xz' | 'poly'): per-shard windows + trace hook in one place."""
+        span_cap = self.shard_span_cap()
+        trace = _batch_trace(self, args, qpad, f"bitmap_shard_{kind}", 0)
+        hdr, bits = _dual_shard_bitmap_batch_fn(
+            kind, has_time, span_cap, qpad, self.mesh
+        )(*args)
+        if trace is not None:
+            trace["out_bytes"] = int(hdr.nbytes) + int(bits.nbytes)
+        _start_d2h(hdr, bits)
+        return _ShardBitmapBatch(
+            hdr, bits, span_cap, self.mesh.devices.size, qpad,
+            self.shard_n(), seg=self, trace=trace,
+        )
+
     def dispatch_poly_batch(
         self, descs: Sequence[tuple], has_time: bool
     ) -> list:
@@ -2262,7 +2383,10 @@ class DeviceSegment:
             has_time,
         )
         rcap = self._rcap
-        if bitmap:
+        shard_x = bitmap and _shard_extract_on(mode, self.mesh)
+        if shard_x:
+            batch = self._dual_shard_batch("poly", has_time, qpad, args)
+        elif bitmap:
             span_cap = self.span_cap()
             hdr, bits = _poly_bitmap_batch_fn(
                 has_time, span_cap, qpad, mode, self.mesh
@@ -2292,7 +2416,11 @@ class DeviceSegment:
             packed = lambda sa=single_args: _poly_packed_fn(  # noqa: E731
                 has_time, mode, self.mesh
             )(*sa())
-            if bitmap:
+            if shard_x:
+                out.append(
+                    _PendingDualShardBitmapHits(self, batch, i, refetch, packed)
+                )
+            elif bitmap:
                 out.append(_PendingXZBitmapHits(self, batch, i, refetch, packed))
             else:
                 out.append(
@@ -2330,7 +2458,10 @@ class DeviceSegment:
             replicate(self.mesh, boxes_np), replicate(self.mesh, wins_np), has_time
         )
         rcap = self._rcap
-        if bitmap:
+        shard_x = bitmap and _shard_extract_on(mode, self.mesh)
+        if shard_x:
+            batch = self._dual_shard_batch("xz", has_time, qpad, args)
+        elif bitmap:
             span_cap = self.span_cap()
             hdr, bits = _xz_bitmap_batch_fn(
                 has_time, span_cap, qpad, mode, self.mesh
@@ -2356,7 +2487,11 @@ class DeviceSegment:
             packed = lambda sa=single_args: _xz_packed_fn(  # noqa: E731
                 has_time, mode, self.mesh
             )(*sa())
-            if bitmap:
+            if shard_x:
+                out.append(
+                    _PendingDualShardBitmapHits(self, batch, i, refetch, packed)
+                )
+            elif bitmap:
                 out.append(_PendingXZBitmapHits(self, batch, i, refetch, packed))
             else:
                 out.append(

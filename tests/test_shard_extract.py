@@ -105,6 +105,61 @@ def test_shard_window_overflow_falls_back():
     assert all(s.shard_span_cap() > (1 << 13) for s in dev.segments)
 
 
+def test_shard_extract_polygon_dual_plane():
+    """Non-rect INTERSECTS on a point schema rides the per-shard DUAL
+    (hit/decided) windows; band rows still take the host test."""
+    host, tpu = _stores(n=30_000)
+    _parity(host, tpu, [
+        "intersects(geom, POLYGON ((-40 -40, 30 -35, 10 30, -35 20, -40 -40)))",
+        "intersects(geom, POLYGON ((-15 -50, 50 -40, 25 15, -15 -50)))",
+    ])
+    assert any(k[0] == "poly" for k in ex._DUAL_SHARD_BITMAP_FNS)
+
+
+def test_shard_extract_extent_dual_plane():
+    """Extent schemas (mixed rects/triangles/lines/points/nulls) ride the
+    per-shard dual windows on the xz indices."""
+    from geomesa_tpu.geom.base import LineString, Polygon
+
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    for s in (host, tpu):
+        s.create_schema(parse_spec("e", "dtg:Date,*geom:Geometry:srid=4326"))
+    rng = np.random.default_rng(33)
+    rows = []
+    for i in range(3000):
+        x0 = float(rng.uniform(-170, 160))
+        y0 = float(rng.uniform(-80, 70))
+        k = i % 4
+        if k == 0:
+            g = Polygon([[x0, y0], [x0 + 1, y0], [x0 + 1, y0 + 1],
+                         [x0, y0 + 1], [x0, y0]])
+        elif k == 1:
+            g = Polygon([[x0, y0], [x0 + 2, y0], [x0 + 1, y0 + 2], [x0, y0]])
+        elif k == 2:
+            g = LineString([(x0, y0), (x0 + 1.5, y0 + 0.7)])
+        else:
+            g = None
+        t = int(BASE + int(rng.integers(0, 10 * 86400_000)))
+        rows.append((t, g))
+    for s in (host, tpu):
+        with s.writer("e") as w:
+            for i, (t, g) in enumerate(rows):
+                w.write([t, g], fid=f"e{i}")
+    cqls = [
+        "bbox(geom, -60, -40, 10, 20)",
+        "bbox(geom, -100, -60, 80, 50)",
+        "bbox(geom, -30, -30, 40, 35) AND "
+        "dtg DURING 2026-01-02T00:00:00Z/2026-01-08T00:00:00Z",
+        "bbox(geom, 20, -20, 100, 45) AND "
+        "dtg DURING 2026-01-03T00:00:00Z/2026-01-09T00:00:00Z",
+    ]
+    got = tpu.query_many("e", cqls)
+    for cql, res in zip(cqls, got):
+        assert sorted(res.fids) == sorted(host.query("e", cql).fids), cql
+    assert any(k[0] == "xz" for k in ex._DUAL_SHARD_BITMAP_FNS)
+
+
 def test_shard_extract_empty_and_deletes():
     host, tpu = _stores(n=20_000)
     for s in (host, tpu):
