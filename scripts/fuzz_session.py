@@ -48,10 +48,14 @@ MODES = [
      "GEOMESA_BATCH_PROTO": "bitmap"},
     {"GEOMESA_SEEK": "0", "GEOMESA_EXACT_DEVICE": "1", "GEOMESA_DEVBATCH": "1",
      "GEOMESA_BATCH_PROTO": "runs"},
+    # per-shard window extraction (point + dual-plane editions)
+    {"GEOMESA_SEEK": "0", "GEOMESA_EXACT_DEVICE": "1", "GEOMESA_DEVBATCH": "1",
+     "GEOMESA_BATCH_PROTO": "bitmap", "GEOMESA_SHARD_EXTRACT": "1"},
 ]
 _MODE_KEYS = (
     "GEOMESA_SEEK", "GEOMESA_TPU_NO_NATIVE", "GEOMESA_DEVSEEK",
     "GEOMESA_EXACT_DEVICE", "GEOMESA_DEVBATCH", "GEOMESA_BATCH_PROTO",
+    "GEOMESA_SHARD_EXTRACT",
 )
 
 
