@@ -124,6 +124,53 @@ class RasterStore:
             self._envs[res] = envs
         return envs
 
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Persist every chip to ONE .npz (the durable-state edge the
+        reference gets from its Accumulo raster tables): chip arrays
+        under positional keys + a JSON manifest of (resolution,
+        envelope, id) rows. Atomic via tmp + rename."""
+        import json as _json
+        import os as _os
+
+        arrays: Dict[str, np.ndarray] = {}
+        manifest = []
+        i = 0
+        for res in self.available_resolutions:
+            for c in self._chips[res]:
+                arrays[f"c{i}"] = c.data
+                manifest.append([res, list(c.envelope.as_tuple()), c.id])
+                i += 1
+        arrays["manifest"] = np.frombuffer(
+            _json.dumps({"name": self.name, "chips": manifest}).encode(),
+            dtype=np.uint8,
+        )
+        tmp = f"{path}.{_os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(f, **arrays)
+            _os.replace(tmp, path)
+        except BaseException:
+            try:
+                _os.remove(tmp)  # no orphaned multi-MB tmp on failure
+            except OSError:
+                pass
+            raise
+
+    @classmethod
+    def load(cls, path: str) -> "RasterStore":
+        import json as _json
+
+        with np.load(path) as z:
+            meta = _json.loads(bytes(z["manifest"].tobytes()).decode())
+            store = cls(meta.get("name", "rasters"))
+            for i, (res, env, rid) in enumerate(meta["chips"]):
+                store.put_raster(
+                    Raster(z[f"c{i}"], Envelope(*env), raster_id=rid)
+                )
+        return store
+
     # -- queries -------------------------------------------------------------
 
     @property

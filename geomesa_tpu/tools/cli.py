@@ -267,6 +267,58 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_raster_ingest(args) -> int:
+    """Ingest a GeoTIFF into a persisted raster pyramid (.npz store) —
+    the raster half of the reference's ingest surface
+    (geomesa-accumulo-raster ingest + AccumuloRasterStore tables)."""
+    import fcntl
+    import os as _os
+
+    from geomesa_tpu.raster import RasterStore
+
+    # serialize the load-modify-save cycle: concurrent ingests into one
+    # store must append, not last-writer-wins each other's chips away
+    with open(args.raster_store + ".lock", "a") as lockf:
+        fcntl.flock(lockf.fileno(), fcntl.LOCK_EX)
+        store = (
+            RasterStore.load(args.raster_store)
+            if _os.path.exists(args.raster_store) and not args.replace
+            else RasterStore()
+        )
+        levels = store.ingest_geotiff(
+            args.file,
+            chip_size=args.chip_size,
+            use_overviews=args.use_overviews,
+            name=_os.path.splitext(_os.path.basename(args.file))[0],
+        )
+        store.save(args.raster_store)
+    for res in sorted(levels):
+        print(f"resolution {res:.6g}\t{levels[res]} chips")
+    return 0
+
+
+def cmd_raster_export(args) -> int:
+    """Window a persisted raster pyramid back out as GeoTIFF (the WCS
+    GetCoverage role from the command line)."""
+    from geomesa_tpu.geom.base import Envelope
+    from geomesa_tpu.raster import RasterStore
+
+    try:
+        parts = [float(v) for v in args.bbox.split(",")]
+        if len(parts) != 4:
+            raise ValueError(f"{len(parts)} values")
+    except ValueError as e:
+        print(f"--bbox must be xmin,ymin,xmax,ymax ({e})", file=sys.stderr)
+        return 1
+    store = RasterStore.load(args.raster_store)
+    env = Envelope(*parts)
+    store.export_window_geotiff(
+        args.out, env, args.width, args.height
+    )
+    print(f"wrote {args.out} ({args.height}x{args.width})")
+    return 0
+
+
 def cmd_env(args) -> int:
     import jax
 
@@ -327,6 +379,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--attribute", required=True)
     sp.add_argument("--stat", default="Count()")
     sp.add_argument("--cql", default="INCLUDE")
+    sp = add("raster-ingest", cmd_raster_ingest, store=False, type_name=False)
+    sp.add_argument("--raster-store", required=True, help=".npz pyramid store")
+    sp.add_argument("--file", required=True, help="GeoTIFF to ingest")
+    sp.add_argument("--chip-size", type=int, default=256)
+    sp.add_argument("--use-overviews", action="store_true",
+                    help="ingest the file's own overview pages as levels")
+    sp.add_argument("--replace", action="store_true",
+                    help="start a fresh store instead of appending")
+    sp = add("raster-export", cmd_raster_export, store=False, type_name=False)
+    sp.add_argument("--raster-store", required=True)
+    sp.add_argument("--bbox", required=True, help="xmin,ymin,xmax,ymax")
+    sp.add_argument("--width", type=int, default=256)
+    sp.add_argument("--height", type=int, default=256)
+    sp.add_argument("--out", required=True, help="output GeoTIFF path")
     add("version", cmd_version, store=False, type_name=False)
     add("env", cmd_env, store=False, type_name=False)
     return p

@@ -280,6 +280,49 @@ def test_ingest_prebuilt_overviews(tmp_path):
     )
 
 
+def test_store_save_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(11)
+    data = rng.normal(0, 20, (300, 400)).astype(np.float32)
+    env = Envelope(-15.0, 30.0, 15.0, 50.0)
+    store = RasterStore("x")
+    store.ingest_raster(data, env, chip_size=128)
+    p = str(tmp_path / "pyr.npz")
+    store.save(p)
+    back = RasterStore.load(p)
+    assert back.available_resolutions == store.available_resolutions
+    np.testing.assert_array_equal(
+        back.read_window(env, 400, 300), store.read_window(env, 400, 300)
+    )
+
+
+def test_cli_raster_roundtrip(tmp_path, capsys):
+    """raster-ingest -> raster-export end to end through the real CLI."""
+    from geomesa_tpu.tools import cli
+
+    yy, xx = np.mgrid[0:128, 0:256]
+    data = (xx * 3 + yy).astype(np.float32)
+    env = Envelope(0.0, 10.0, 16.0, 18.0)
+    src = tmp_path / "in.tif"
+    write_geotiff(src, data, env, overviews=1)
+    npz = tmp_path / "pyr.npz"
+    rc = cli.main([
+        "raster-ingest", "--raster-store", str(npz), "--file", str(src),
+        "--use-overviews", "--chip-size", "64",
+    ])
+    assert rc == 0 and npz.exists()
+    out = tmp_path / "win.tif"
+    rc = cli.main([
+        "raster-export", "--raster-store", str(npz),
+        "--bbox", "2,12,10,16", "--width", "128", "--height", "64",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    got, genv = read_geotiff(str(out))
+    assert got.shape == (64, 128)
+    assert genv.xmin == pytest.approx(2.0) and genv.ymax == pytest.approx(16.0)
+    capsys.readouterr()
+
+
 def test_reader_rejects_non_tiff(tmp_path):
     p = tmp_path / "x.bin"
     p.write_bytes(b"NOPE not a tiff")
