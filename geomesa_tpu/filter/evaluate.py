@@ -117,7 +117,14 @@ def _column(ft: FeatureType, prop: str, columns: Columns):
     attr = ft.attr(prop)
     col = columns[prop]
     if attr.type in (AttributeType.FLOAT, AttributeType.DOUBLE):
-        return col, ~np.isnan(col)
+        # a None float is STORED as 0.0 + the __null mask — without the
+        # mask here, ``v = 0`` would match null rows (comparisons against
+        # null must be false, FilterHelper semantics)
+        valid = ~np.isnan(col)
+        null_col = columns.get(prop + "__null")
+        if null_col is not None:
+            valid &= ~null_col
+        return col, valid
     if prop + "__vocab" in columns:
         return col, col >= 0  # -1 is the dictionary null sentinel
     null_col = columns.get(prop + "__null")

@@ -289,31 +289,47 @@ def _runs_fn(kind: str, rcap: int, mode: str, mesh):
     return fn
 
 
-def _exact_mask_body(has_time: bool, mode: str, mesh, attr: bool = False):
+def _exact_mask_body(has_time: bool, mode: str, mesh, attr=False):
     """Unjitted exact-predicate mask callable (ops.filters.exact_st_mask),
     shard_map-wrapped for multi-chip meshes.
 
-    ``attr`` adds the dictionary-code membership plane (the device half
-    of the reference's join attribute strategy,
-    AttributeIndex.scala:42,392 — evaluate the secondary attribute
-    predicate AT the data): one extra row-sharded i32 ``codes`` column
-    tested against a replicated per-query ``qcode`` vector (shape (K,):
-    equality is K=1, IN-lists pad to the batch's K bucket; -2 = literal
-    absent from the segment vocab, matching nothing; nulls are -1).
-    jit re-specializes per K automatically (shape-keyed)."""
+    ``attr`` adds the unified-code attribute plane (the device half of
+    the reference's join attribute strategy, AttributeIndex.scala:42,392
+    — evaluate the secondary attribute predicate AT the data): one extra
+    row-sharded i32 ``codes`` column (ranks into the segment's sorted
+    unified value space — dictionary vocab for strings, np.unique of raw
+    values for numeric/date columns) tested against a replicated
+    per-query vector. Two editions share the plumbing:
+
+    - ``attr=True`` (membership): qcode shape (K,) — equality is K=1,
+      IN-lists pad to the batch's K bucket; -2 = literal absent from the
+      segment's value space, matching nothing; nulls are -1.
+    - ``attr="range"``: qcode shape (2,) = [lo, hi] inclusive code
+      interval (code order == value order because the unified space is
+      sorted); empty intervals encode as lo > hi, and lo >= 0 keeps
+      nulls (-1) out.
+
+    jit re-specializes per K automatically (shape-keyed); the two
+    editions are distinct cache-key values of ``attr``."""
     from geomesa_tpu.ops.filters import exact_st_mask
 
+    if attr == "range":
+        def combine(m, codes, qcode):
+            return m & (codes >= qcode[0]) & (codes <= qcode[1])
+    elif attr:
+        def combine(m, codes, qcode):
+            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
     if has_time and attr:
         def body(xh, xl, yh, yl, th, tl, valid, codes, box, win, qcode):
             m = exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
-            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
+            return combine(m, codes, qcode)
     elif has_time:
         def body(xh, xl, yh, yl, th, tl, valid, box, win):
             return exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
     elif attr:
         def body(xh, xl, yh, yl, valid, codes, box, qcode):
             m = exact_st_mask(xh, xl, yh, yl, valid, box)
-            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
+            return combine(m, codes, qcode)
     else:
         def body(xh, xl, yh, yl, valid, box):
             return exact_st_mask(xh, xl, yh, yl, valid, box)
@@ -331,7 +347,7 @@ def _exact_mask_body(has_time: bool, mode: str, mesh, attr: bool = False):
     )
 
 
-def _exact_arg_counts(has_time: bool, attr: bool) -> Tuple[int, int]:
+def _exact_arg_counts(has_time: bool, attr) -> Tuple[int, int]:
     """(row-sharded, replicated) arg counts of the exact mask layouts —
     THE single table both _exact_mask_body's shard specs and the
     shard-extract wrapper consult (must track _exact_args)."""
@@ -365,7 +381,7 @@ _EXACT_PACKED_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
 def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh,
-                   attr: bool = False):
+                   attr=False):
     key = (has_time, rcap, mode, mesh, attr)
     fn = _EXACT_RUNS_FNS.get(key)
     if fn is None:
@@ -380,7 +396,7 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh,
     return fn
 
 
-def _point_desc_split(mask, has_time: bool, args, attr: bool = False):
+def _point_desc_split(mask, has_time: bool, args, attr=False):
     """Shared arg split for the point batch builders: returns
     (mask_of(desc), stacked desc arrays for lax.scan). ``attr`` adds the
     codes column (row-sharded) and per-query qcode vectors [q, K] to
@@ -418,7 +434,7 @@ def _start_d2h(*bufs) -> None:
 
 
 def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
-                         attr: bool = False):
+                         attr=False):
     """Q exact-predicate scans fused into ONE device execution.
 
     lax.scan over [q] stacked query descriptors; each step streams the
@@ -494,7 +510,7 @@ def _packed_step(m, rcap: int, sum_cap: int, off, shared):
 
 
 def _exact_packed_batch_fn(has_time: bool, rcap: int, sum_cap: int, q: int,
-                           mode: str, mesh, attr: bool = False):
+                           mode: str, mesh, attr=False):
     """Q exact scans -> ONE fused i32 buffer
     ``[q*(3+3*PACK_XCAP) headers | sum_cap shared words]`` (see
     _packed_step). Same one-execution-per-stream shape as
@@ -530,7 +546,7 @@ _EXACT_BITMAP_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
 def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
-                           mesh, attr: bool = False):
+                           mesh, attr=False):
     """Q exact scans -> (headers i32[q,4], bitmaps u8[q, span_cap//8]).
 
     The TPU-native extraction: NO compaction on device. Size-bounded
@@ -589,7 +605,7 @@ def _shard_extract_on(mode: str, mesh) -> bool:
 
 
 def _exact_shard_bitmap_batch_fn(has_time: bool, span_cap: int, q: int,
-                                 mesh, attr: bool = False):
+                                 mesh, attr=False):
     """PER-SHARD extraction edition of _exact_bitmap_batch_fn: the mask
     AND the span framing both run INSIDE shard_map, so each chip frames
     only its LOCAL hit window — no cross-chip collective at all, not
@@ -1485,7 +1501,7 @@ def _xz_packed_fn(has_time: bool, mode: str, mesh):
     return fn
 
 
-def _exact_packed_fn(has_time: bool, mode: str, mesh, attr: bool = False):
+def _exact_packed_fn(has_time: bool, mode: str, mesh, attr=False):
     key = (has_time, mode, mesh, attr)
     fn = _EXACT_PACKED_FNS.get(key)
     if fn is None:
@@ -2023,14 +2039,22 @@ class DeviceSegment:
         return base
 
     def load_attr_codes(self, attr: str) -> bool:
-        """Unified dictionary-code column for one string attribute: each
-        block's sorted vocab re-encodes into ONE segment-wide sorted
-        vocab (a searchsorted remap per block), so the device decides
-        ``attr = literal`` with a single i32 compare per row — the
-        device half of the reference's join attribute strategy
-        (AttributeIndex.scala:42,392: evaluate the attribute predicate
-        at the data instead of post-filtering on the client). Pad rows
-        carry -1 (the null sentinel), which no qcode >= 0 matches."""
+        """Unified rank-code column for one attribute: every block's
+        values re-encode into ONE segment-wide SORTED value space, so
+        the device decides ``attr = literal`` (one i32 compare per row)
+        and ``attr`` range predicates (one interval test — code order ==
+        value order) — the device half of the reference's join attribute
+        strategy (AttributeIndex.scala:42,392: evaluate the attribute
+        predicate at the data instead of post-filtering on the client).
+
+        Two per-block sources feed the same unified space:
+        - dictionary-coded string blocks: sorted vocab, remapped with
+          one searchsorted pass per block;
+        - raw typed columns (int/long/float/double/date-ms, plus the
+          high-cardinality fixed-width-unicode string fallback):
+          np.unique over the block values — the ranks ARE the codes.
+        Null rows (and float NaN, which the oracle's valid mask also
+        excludes) carry -1; pad rows carry -1."""
         cache = getattr(self, "_attr_codes", None)
         if cache is None:
             cache = self._attr_codes = {}
@@ -2043,41 +2067,106 @@ class DeviceSegment:
                 v = b.record.columns.get(attr + "__vocab")
             return v
 
-        per = []
+        per = []  # (codes, vocab) | (values, nulls_or_None)
+        vocab_pool = []  # value arrays feeding the unified space
         try:
             for b in self.blocks:
-                codes = b.full_col(attr)
+                col = b.full_col(attr)
                 vocab = raw_vocab(b)
-                if vocab is None or codes.dtype.kind not in "iu":
-                    raise KeyError(attr)
-                per.append((codes, vocab))
+                if vocab is not None and col.dtype.kind in "iu":
+                    per.append(("dict", col, vocab))
+                    vocab_pool.append(vocab)
+                elif col.dtype.kind in "iufU":
+                    # (datetime64 'M' deliberately excluded: DATE columns
+                    # are int64 epoch-ms — an 'M' column could not compare
+                    # against the planner's ms literals and would decide
+                    # "no rows" instead of falling back to the host)
+                    nulls = b.full_col(attr + "__null")
+                    if col.dtype.kind == "f":
+                        nulls = nulls | np.isnan(col)
+                    live = col[~nulls] if nulls.any() else col
+                    per.append(("raw", col, nulls))
+                    vocab_pool.append(np.unique(live))
+                else:
+                    raise KeyError(attr)  # object column: host-only
         except KeyError:
-            cache[attr] = None  # not dictionary-coded in every block
+            cache[attr] = None  # no device-codable layout in some block
             return False
         unified = (
-            np.unique(np.concatenate([v for _c, v in per]))
-            if per else np.empty(0, dtype=object)
+            np.unique(np.concatenate(vocab_pool))
+            if vocab_pool else np.empty(0, dtype=object)
         )
         parts = []
-        for codes, vocab in per:
-            remap = np.searchsorted(unified, vocab).astype(np.int32)
-            parts.append(
-                np.where(
-                    codes >= 0, remap[np.maximum(codes, 0)], np.int32(-1)
-                ).astype(np.int32)
-            )
+        for kind, col, aux in per:
+            if kind == "dict":
+                remap = np.searchsorted(unified, aux).astype(np.int32)
+                parts.append(
+                    np.where(
+                        col >= 0, remap[np.maximum(col, 0)], np.int32(-1)
+                    ).astype(np.int32)
+                )
+            else:
+                # null/NaN rows get arbitrary ranks here (NaN sorts past
+                # the end) and are overwritten with -1 below
+                codes = np.searchsorted(unified, col).astype(np.int32)
+                codes[aux] = -1
+                parts.append(codes)
         dev = self._pack(parts, np.int32, -1)
         cache[attr] = (dev, unified)
         return True
 
     def attr_qcode(self, attr: str, value) -> int:
-        """Segment-local code of ``value`` (-2 when absent: matches no
-        row, including nulls at -1)."""
+        """Segment-local code of ``value`` (-2 when absent OR not
+        comparable with the column's value space: matches no row,
+        including nulls at -1)."""
         _dev, unified = self._attr_codes[attr]
-        i = int(np.searchsorted(unified, value))
+        try:
+            i = int(np.searchsorted(unified, value))
+        except (TypeError, ValueError):
+            return -2
         if i < len(unified) and unified[i] == value:
             return i
         return -2
+
+    def attr_qrange(self, attr: str, preds) -> np.ndarray:
+        """i32[2] inclusive code interval = the INTERSECTION of ``preds``
+        mapped into this segment's sorted unified value space. Each pred
+        is (op, literal): op in =, <, <=, >, >=, between (inclusive
+        pair), and the exclusive temporal forms during/before/after
+        (FilterHelper.scala:366,427,440 bound rules). searchsorted
+        left/right gives EXACTLY the oracle's code-space semantics
+        (filter/evaluate.py:_eval_cmp); incomparable literals produce an
+        empty interval, matching the oracle's per-row TypeError -> False.
+        lo >= 0 always, so nulls (-1) never match; empty = lo > hi."""
+        _dev, unified = self._attr_codes[attr]
+        u = len(unified)
+        lo, hi = 0, u - 1
+        for op, lit in preds:
+            try:
+                if op in ("between", "during"):
+                    a_side, b_side = (
+                        ("left", "right") if op == "between"
+                        else ("right", "left")  # during: exclusive ends
+                    )
+                    a = np.searchsorted(unified, lit[0], side=a_side)
+                    b = np.searchsorted(unified, lit[1], side=b_side) - 1
+                elif op == "=":
+                    a = np.searchsorted(unified, lit, side="left")
+                    b = np.searchsorted(unified, lit, side="right") - 1
+                elif op == ">=":
+                    a, b = np.searchsorted(unified, lit, side="left"), u - 1
+                elif op in (">", "after"):
+                    a, b = np.searchsorted(unified, lit, side="right"), u - 1
+                elif op in ("<", "before"):
+                    a, b = 0, np.searchsorted(unified, lit, side="left") - 1
+                elif op == "<=":
+                    a, b = 0, np.searchsorted(unified, lit, side="right") - 1
+                else:  # unknown op: claim nothing (planner should gate)
+                    a, b = 0, -1
+            except (TypeError, ValueError):
+                a, b = 0, -1
+            lo, hi = max(lo, int(a)), min(hi, int(b))
+        return np.array([lo, hi], dtype=np.int32)
 
     def attr_qcodes(self, attr: str, values, k: int) -> np.ndarray:
         """i32[k] code vector for an IN-list (equality = length 1),
@@ -2088,31 +2177,37 @@ class DeviceSegment:
         return out
 
     def dispatch_exact_attr(
-        self, box_dev, win_dev, attr: str, values
+        self, box_dev, win_dev, attr: str, payload, kind: str = "member"
     ) -> "_PendingHits":
-        """Single-query edition of the attr-membership plane (a lone
-        query must not lose device exactness to the conservative
-        fallback). ``values`` is the literal tuple (equality = len 1)."""
+        """Single-query edition of the attr plane (a lone query must not
+        lose device exactness to the conservative fallback). ``payload``
+        is the literal tuple for ``kind="member"`` (equality = len 1) or
+        the (op, literal) predicate tuple for ``kind="range"``."""
         has_time = self.tk_hi is not None and win_dev is not None
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
         codes_dev = self._attr_codes[attr][0]
-        qc = replicate(
-            self.mesh,
-            self.attr_qcodes(attr, values, _pow2_at_least(len(values), 1)),
+        aflag = "range" if kind == "range" else True
+        qc_np = (
+            self.attr_qrange(attr, payload)
+            if kind == "range"
+            else self.attr_qcodes(
+                attr, payload, _pow2_at_least(len(payload), 1)
+            )
         )
+        qc = replicate(self.mesh, qc_np)
         args = self._exact_args(box_dev, win_dev, has_time, codes_dev, qc)
         rcap = self._rcap
-        buf = _exact_runs_fn(has_time, rcap, mode, self.mesh, True)(*args)
+        buf = _exact_runs_fn(has_time, rcap, mode, self.mesh, aflag)(*args)
         _start_d2h(buf)
         return _PendingHits(
             self,
             rcap,
             buf,
             refetch=lambda rc: _exact_runs_fn(
-                has_time, rc, mode, self.mesh, True
+                has_time, rc, mode, self.mesh, aflag
             )(*args),
             packed=lambda: _exact_packed_fn(
-                has_time, mode, self.mesh, True
+                has_time, mode, self.mesh, aflag
             )(*args),
         )
 
@@ -2134,16 +2229,20 @@ class DeviceSegment:
 
     def dispatch_exact_batch(
         self, descs: Sequence[tuple], has_time: bool,
-        attr: Optional[str] = None,
+        attr: Optional[str] = None, attr_kind: str = "member",
     ) -> List["_PendingHits"]:
         """Q exact scans in ONE device execution (see _exact_runs_batch_fn
         and _exact_packed_batch_fn).
 
         ``descs`` = [(box_np u32[8], win_np u32[4]|None)] — or, with
-        ``attr`` set, [(box, win, literal_value)]: the device then also
-        decides ``attr = literal`` per row via unified dictionary codes
+        ``attr`` set, [(box, win, payload)]: the device then also
+        decides the attribute predicate per row via unified rank codes
         (load_attr_codes), the join attribute strategy evaluated at the
-        data. All entries of a batch share ``has_time``. Returns one
+        data. ``attr_kind`` picks the plane edition: "member" payloads
+        are literal tuples (equality/IN), "range" payloads are (op,
+        literal) predicate tuples intersected into one [lo, hi] code
+        interval per segment. All entries of a batch share ``has_time``
+        (and ``attr_kind`` — the two editions jit separately). Returns one
         pending handle per desc, all resolving from a single shared
         buffer fetch. The query list is padded (repeating the last
         descriptor) so jit shape buckets stay bounded. Overflow
@@ -2172,16 +2271,26 @@ class DeviceSegment:
             wins_dev = replicate(self.mesh, wins_np)
         else:
             wins_dev = None
-        # attr-membership plane: descs carry the literal VALUE TUPLE
-        # (codes are segment-local); map each to this segment's unified
-        # qcodes here, padded to the batch's K bucket (equality = K 1)
-        is_attr = attr is not None
+        # attr plane: descs carry LITERALS (codes are segment-local); map
+        # each to this segment's unified code space here — member: K-padded
+        # qcode vectors (equality = K 1); range: [lo, hi] code intervals
+        is_attr = (
+            False if attr is None
+            else ("range" if attr_kind == "range" else True)
+        )
         codes_dev = self._attr_codes[attr][0] if is_attr else None
-        if is_attr:
+        if is_attr == "range":
+            def qvec(payload):
+                return self.attr_qrange(attr, payload)
+        elif is_attr:
             kk = _pow2_at_least(max(len(d[2]) for d in descs), 1)
+
+            def qvec(payload):
+                return self.attr_qcodes(attr, payload, kk)
+        if is_attr:
             qcodes_np = np.stack(
-                [self.attr_qcodes(attr, d[2], kk) for d in descs]
-                + [self.attr_qcodes(attr, descs[-1][2], kk)] * (qpad - q)
+                [qvec(d[2]) for d in descs]
+                + [qvec(descs[-1][2])] * (qpad - q)
             )
             qcodes_dev = replicate(self.mesh, qcodes_np)
         else:
@@ -2193,16 +2302,13 @@ class DeviceSegment:
 
         def single_args_for(box_np, win_np, values):
             def build():
-                qc = (
-                    replicate(
-                        self.mesh,
-                        self.attr_qcodes(
-                            attr, values, _pow2_at_least(len(values), 1)
-                        ),
+                if is_attr == "range":
+                    qc_np = self.attr_qrange(attr, values)
+                elif is_attr:
+                    qc_np = self.attr_qcodes(
+                        attr, values, _pow2_at_least(len(values), 1)
                     )
-                    if is_attr
-                    else None
-                )
+                qc = replicate(self.mesh, qc_np) if is_attr else None
                 return self._exact_args(
                     replicate(self.mesh, box_np),
                     None if win_np is None else replicate(self.mesh, win_np),
@@ -3720,12 +3826,12 @@ class TpuScanExecutor:
                 else None
             )
             if adesc is not None:
-                attr, d = adesc
+                attr, akind, d = adesc
                 has_time = d[1] is not None
-                key = (id(table), has_time, attr)
+                key = (id(table), has_time, attr, akind)
                 if key not in attr_batchable:
-                    attr_batchable[key] = (table, has_time, attr, [])
-                attr_batchable[key][3].append((id(plan), plan, d))
+                    attr_batchable[key] = (table, has_time, attr, akind, [])
+                attr_batchable[key][4].append((id(plan), plan, d))
                 continue
             poly = self._poly_batch_desc(table, plan)
             if poly is not None:
@@ -3782,7 +3888,7 @@ class TpuScanExecutor:
                         ],
                         exact=True,
                     )
-        for table, has_time, attr, lst in attr_batchable.values():
+        for table, has_time, attr, akind, lst in attr_batchable.values():
             dev = self.device_index(table)
             ok = (
                 bool(dev.segments)
@@ -3806,7 +3912,7 @@ class TpuScanExecutor:
                 out[pid] = _PendingScan(
                     [
                         (seg, seg.dispatch_exact_attr(
-                            box_dev, win_dev, attr, value))
+                            box_dev, win_dev, attr, value, kind=akind))
                         for seg in dev.segments
                     ],
                     exact=True,
@@ -3823,7 +3929,9 @@ class TpuScanExecutor:
                     continue
                 descs = [d for _pid, _p, d in chunk]
                 per_seg = [
-                    seg.dispatch_exact_batch(descs, has_time, attr=attr)
+                    seg.dispatch_exact_batch(
+                        descs, has_time, attr=attr, attr_kind=akind
+                    )
                     for seg in dev.segments
                 ]
                 for qi, (pid, _plan, _d) in enumerate(chunk):
@@ -4159,13 +4267,22 @@ class TpuScanExecutor:
         return self._shape_limbs(shape)
 
     def _attr_batch_desc(self, table: IndexTable, plan: QueryPlan):
-        """(attr_name, (box_limbs, win_limbs|None, values_tuple)) when
-        the plan's FULL filter is one box(+window) AND exactly one
-        string-attribute membership test — ``attr = 'x'`` or
-        ``attr IN (...)`` with at most 8 distinct values — so the device
-        decides everything, including the secondary attribute predicate
-        (the join attribute strategy evaluated at the data,
-        AttributeIndex.scala:42,392). None otherwise."""
+        """(attr_name, kind, (box_limbs, win_limbs|None, payload)) when
+        the plan's FULL filter is one box(+window) AND attribute
+        predicates on exactly ONE eligible attribute that the unified
+        code space can decide — so the device answers everything,
+        including the secondary attribute predicate (the join attribute
+        strategy evaluated at the data, AttributeIndex.scala:42,392).
+        None otherwise.
+
+        kind "member": ``attr = 'x'`` or ``attr IN (...)`` with at most
+        8 distinct values — payload is the literal tuple. kind "range":
+        any AND of order predicates (<, <=, >, >=, =, BETWEEN; DURING/
+        BEFORE/AFTER on secondary date attributes) — payload is the
+        (op, coerced_literal) tuple, intersected per segment in code
+        space (code order == value order). Eligible attribute types:
+        String (non-json), Integer, Long, Float, Double, Date (the
+        default dtg stays with the window plane)."""
         if not self._exact_device_enabled():
             return None
         if table.index.name not in ("z2", "z3"):
@@ -4174,44 +4291,105 @@ class TpuScanExecutor:
         if ft.default_geometry is None or not ft.is_points:
             return None
         from geomesa_tpu.filter import ast as A
+        from geomesa_tpu.filter.evaluate import _coerce
         from geomesa_tpu.schema.featuretype import AttributeType
 
-        attr_eq: List = []
+        dtg = ft.default_date.name if ft.default_date is not None else None
+        OK_TYPES = (
+            AttributeType.STRING, AttributeType.INT, AttributeType.LONG,
+            AttributeType.FLOAT, AttributeType.DOUBLE, AttributeType.DATE,
+        )
+        inlists: List = []  # (prop, values_tuple)
+        ranges: List = []  # (prop, op, coerced_literal); includes '='
 
         def eligible(prop) -> bool:
             return (
                 not prop.startswith("$.")
+                and prop != dtg
                 and ft.has(prop)
-                and ft.attr(prop).type == AttributeType.STRING
+                and ft.attr(prop).type in OK_TYPES
                 and not ft.attr(prop).json
             )
 
+        def usable(lit) -> bool:
+            # NaN literals break the code-space mapping (NaN sorts past
+            # the end but compares false everywhere); None never matches
+            return lit is not None and not (
+                isinstance(lit, float) and lit != lit
+            )
+
+        def coerced(prop, lit):
+            v = _coerce(ft, prop, lit)
+            return v if usable(v) else None
+
         def match_attr(node) -> bool:
-            if (
-                isinstance(node, A.Cmp)
-                and node.op == "="
-                and eligible(node.prop)
-            ):
-                attr_eq.append((node.prop, (str(node.literal),)))
+            if isinstance(node, A.Cmp) and node.op in (
+                "=", "<", "<=", ">", ">="
+            ) and eligible(node.prop):
+                lit = coerced(node.prop, node.literal)
+                if lit is None:
+                    return False
+                ranges.append((node.prop, node.op, lit))
+                return True
+            if isinstance(node, A.Between) and eligible(node.prop):
+                lo = coerced(node.prop, node.lo)
+                hi = coerced(node.prop, node.hi)
+                if lo is None or hi is None:
+                    return False
+                ranges.append((node.prop, "between", (lo, hi)))
                 return True
             if isinstance(node, A.InList) and eligible(node.prop):
                 # dedup BEFORE the bucket cap (duplicate literals must
                 # not push a small distinct set off the device plane)
-                vals = tuple(dict.fromkeys(str(v) for v in node.values))
+                raw = [coerced(node.prop, v) for v in node.values]
+                if any(v is None for v in raw):
+                    return False
+                vals = tuple(dict.fromkeys(raw))
                 if 0 < len(vals) <= 8:  # K bucket cap
-                    attr_eq.append((node.prop, vals))
+                    inlists.append((node.prop, vals))
                     return True
+                return False
+            if (
+                isinstance(node, (A.During, A.Before, A.After))
+                and eligible(node.prop)
+                and ft.attr(node.prop).type == AttributeType.DATE
+            ):
+                # secondary date attribute (the default dtg was already
+                # claimed by _and_walk_temporal's window clamps)
+                if isinstance(node, A.During):
+                    ranges.append(
+                        (node.prop, "during", (node.lo_ms, node.hi_ms))
+                    )
+                elif isinstance(node, A.Before):
+                    ranges.append((node.prop, "before", node.t_ms))
+                else:
+                    ranges.append((node.prop, "after", node.t_ms))
+                return True
             return False
 
         got = self._walk_boxes(ft, plan.full_filter, extra_match=match_attr)
-        if got is None or len(attr_eq) != 1:
+        if got is None or not (inlists or ranges):
             return None
+        props = {p for p, *_ in inlists} | {p for p, *_ in ranges}
+        if len(props) != 1:
+            return None  # one device codes column per batch
+        if inlists and (ranges or len(inlists) > 1):
+            return None  # IN combined with other preds: host post-filter
         (xmin, ymin, xmax, ymax), t_lo, t_hi = got
         if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
             return None
         limbs = self._shape_limbs((xmin, ymin, xmax, ymax, t_lo, t_hi))
-        attr, values = attr_eq[0]
-        return attr, (limbs[0], limbs[1], values)
+        attr = props.pop()
+        if inlists:
+            return attr, "member", (limbs[0], limbs[1], inlists[0][1])
+        if len(ranges) == 1 and ranges[0][1] == "=":
+            # a lone equality rides the membership edition (shares the
+            # K=1 kernel with equality batches already in flight)
+            return attr, "member", (limbs[0], limbs[1], (ranges[0][2],))
+        # AND of order predicates (any mix, incl. repeated '='):
+        # intersected per segment in code space
+        payload = tuple((op, lit) for _p, op, lit in ranges)
+        return attr, "range", (limbs[0], limbs[1], payload)
 
     def _query_descriptor(self, table: IndexTable, plan: QueryPlan):
         """(boxes, windows) device-replicated arrays for this plan."""
