@@ -98,6 +98,17 @@ def one_round(seed: int) -> int:
             "bbox(geom, -55, -45, 45, 45)",
             "tag IN ('tag-2', 'tag-6') AND bbox(geom, -40, -35, 50, 40) AND "
             "dtg DURING 2026-01-03T00:00:00Z/2026-01-18T00:00:00Z",
+            # range-kind attr plane shapes (round 4): numeric + string
+            # code-interval tests, incl. the z3 window edition, numeric
+            # equality (membership edition on raw ranks), and an empty
+            # interval
+            "age > 20 AND age <= 60 AND bbox(geom, -55, -45, 45, 45)",
+            "age BETWEEN 15 AND 40 AND bbox(geom, -60, -50, 50, 50) AND "
+            "dtg DURING 2026-01-02T00:00:00Z/2026-01-20T00:00:00Z",
+            "tag >= 'tag-2' AND tag < 'tag-5' AND bbox(geom, -50, -40, 40, 40)",
+            "age = 33 AND bbox(geom, -45, -40, 45, 40)",
+            "age IN (12, 34, 56) AND bbox(geom, -55, -40, 50, 42)",
+            "age > 64 AND age < 12 AND bbox(geom, -50, -40, 40, 40)",
         ]
         wants = {}
         for q in queries:
