@@ -306,8 +306,11 @@ def _exact_mask_body(has_time: bool, mode: str, mesh, attr=False):
       segment's value space, matching nothing; nulls are -1.
     - ``attr="range"``: qcode shape (2,) = [lo, hi] inclusive code
       interval (code order == value order because the unified space is
-      sorted); empty intervals encode as lo > hi, and lo >= 0 keeps
-      nulls (-1) out.
+      sorted); empty intervals encode as lo > hi. Value predicates
+      clamp lo >= 0 host-side so nulls (-1) stay out, but IS NULL is
+      the deliberate interval [-1, -1] — do NOT add a codes >= 0 guard
+      here (pad rows also rank -1 and are excluded by the valid mask
+      inside the base st mask, not by this combine).
 
     jit re-specializes per K automatically (shape-keyed); the two
     editions are distinct cache-key values of ``attr``."""
@@ -2132,15 +2135,19 @@ class DeviceSegment:
         """i32[2] inclusive code interval = the INTERSECTION of ``preds``
         mapped into this segment's sorted unified value space. Each pred
         is (op, literal): op in =, <, <=, >, >=, between (inclusive
-        pair), and the exclusive temporal forms during/before/after
-        (FilterHelper.scala:366,427,440 bound rules). searchsorted
-        left/right gives EXACTLY the oracle's code-space semantics
-        (filter/evaluate.py:_eval_cmp); incomparable literals produce an
-        empty interval, matching the oracle's per-row TypeError -> False.
-        lo >= 0 always, so nulls (-1) never match; empty = lo > hi."""
+        pair), the exclusive temporal forms during/before/after
+        (FilterHelper.scala:366,427,440 bound rules), prefix (LIKE with
+        one trailing %), and isnull/notnull (IS [NOT] NULL — isnull is
+        the interval [-1, -1]: nulls AND float NaN both rank -1, exactly
+        the oracle's ~valid). searchsorted left/right gives EXACTLY the
+        oracle's code-space semantics (filter/evaluate.py:_eval_cmp);
+        incomparable literals produce an empty interval, matching the
+        oracle's per-row TypeError -> False. Every value op clamps its
+        own lower bound to >= 0, so nulls never match ordinary ranges;
+        empty = lo > hi."""
         _dev, unified = self._attr_codes[attr]
         u = len(unified)
-        lo, hi = 0, u - 1
+        lo, hi = -1, u - 1  # -1 reachable ONLY via isnull
         for op, lit in preds:
             try:
                 if op in ("between", "during"):
@@ -2161,6 +2168,17 @@ class DeviceSegment:
                     a, b = 0, np.searchsorted(unified, lit, side="left") - 1
                 elif op == "<=":
                     a, b = 0, np.searchsorted(unified, lit, side="right") - 1
+                elif op == "prefix":
+                    a = np.searchsorted(unified, lit, side="left")
+                    succ = _str_successor(lit)
+                    b = (
+                        np.searchsorted(unified, succ, side="left") - 1
+                        if succ is not None else u - 1
+                    )
+                elif op == "isnull":
+                    a, b = -1, -1
+                elif op == "notnull":
+                    a, b = 0, u - 1
                 else:  # unknown op: claim nothing (planner should gate)
                     a, b = 0, -1
             except (TypeError, ValueError):
@@ -3113,6 +3131,18 @@ def _pow2_at_least(n: int, floor: int = 256) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def _str_successor(s: str):
+    """Smallest string greater than EVERY string with prefix ``s`` (the
+    LIKE-prefix upper bound): increment the last incrementable code
+    point, dropping any trailing U+10FFFF. None = unbounded (every
+    vocab entry past the searchsorted lower bound matches)."""
+    while s and ord(s[-1]) >= 0x10FFFF:
+        s = s[:-1]
+    if not s:
+        return None
+    return s[:-1] + chr(ord(s[-1]) + 1)
 
 
 _DEVSEEK_XZ_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
@@ -4278,11 +4308,13 @@ class TpuScanExecutor:
         kind "member": ``attr = 'x'`` or ``attr IN (...)`` with at most
         8 distinct values — payload is the literal tuple. kind "range":
         any AND of order predicates (<, <=, >, >=, =, BETWEEN; DURING/
-        BEFORE/AFTER on secondary date attributes) — payload is the
-        (op, coerced_literal) tuple, intersected per segment in code
-        space (code order == value order). Eligible attribute types:
-        String (non-json), Integer, Long, Float, Double, Date (the
-        default dtg stays with the window plane)."""
+        BEFORE/AFTER on secondary date attributes; single-trailing-%
+        LIKE prefixes; IS [NOT] NULL) — payload is the (op,
+        coerced_literal) tuple, intersected per segment in code space
+        (code order == value order; null/NaN rank -1, which IS NULL's
+        [-1, -1] interval selects). Eligible attribute types: String
+        (non-json), Integer, Long, Float, Double, Date (the default dtg
+        stays with the window plane)."""
         if not self._exact_device_enabled():
             return None
         if table.index.name not in ("z2", "z3"):
@@ -4349,6 +4381,32 @@ class TpuScanExecutor:
                     inlists.append((node.prop, vals))
                     return True
                 return False
+            if isinstance(node, A.IsNull) and eligible(node.prop):
+                ranges.append(
+                    (node.prop, "notnull" if node.negate else "isnull", None)
+                )
+                return True
+            if (
+                isinstance(node, A.Like)
+                and eligible(node.prop)
+                and ft.attr(node.prop).type == AttributeType.STRING
+                and not node.case_insensitive
+                and "_" not in node.pattern
+                and (
+                    "%" not in node.pattern
+                    or (
+                        node.pattern.count("%") == 1
+                        and node.pattern.endswith("%")
+                    )
+                )
+            ):
+                # prefix LIKE is a code range on the sorted value space;
+                # a wildcard-free pattern is equality (oracle: ^pat$)
+                if node.pattern.endswith("%"):
+                    ranges.append((node.prop, "prefix", node.pattern[:-1]))
+                else:
+                    ranges.append((node.prop, "=", node.pattern))
+                return True
             if (
                 isinstance(node, (A.During, A.Before, A.After))
                 and eligible(node.prop)

@@ -214,6 +214,56 @@ def test_mixed_member_and_range_stream(monkeypatch):
     _plane_loaded(tpu, "z2", "cnt")
 
 
+def test_like_prefix_rides_code_range(monkeypatch):
+    """Single-trailing-% LIKE = a prefix interval on the sorted value
+    space; wildcard-free LIKE = equality; both device-decided. Dict and
+    high-cardinality string layouts."""
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", "bitmap")
+    host, tpu = _stores()
+    got = _parity(host, tpu, [
+        f"kind LIKE 'k1%' AND {BOX}",
+        f"kind LIKE 'k%' AND {BOX2}",
+        f"kind LIKE 'k3' AND {BOX}",  # wildcard-free: equality
+        f"kind LIKE 'zz%' AND {BOX}",  # empty prefix interval
+        f"tag LIKE 'tag-000%' AND {BOX}",  # high-card layout
+        f"tag LIKE 'tag-001234%' AND {BOX2}",
+    ])
+    assert len(got[0].fids) > 0
+    _plane_loaded(tpu, "z2", "kind")
+    _plane_loaded(tpu, "z2", "tag")
+
+
+def test_like_non_prefix_falls_back():
+    """Leading/multiple %, _, case-insensitive: host path, still exact."""
+    host, tpu = _stores(n=6000)
+    _parity(host, tpu, [
+        f"kind LIKE '%1' AND {BOX2}",
+        f"kind LIKE 'k%1' AND {BOX2}",
+        f"kind LIKE 'k_' AND {BOX2}",
+        f"kind ILIKE 'K1%' AND {BOX2}",
+    ])
+
+
+def test_is_null_and_not_null_on_device(monkeypatch):
+    """IS NULL = the [-1, -1] code interval (nulls AND float NaN rank
+    -1, matching the oracle's ~valid); IS NOT NULL = [0, U-1]."""
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", "bitmap")
+    host, tpu = _stores(null_every=5, nan_every=7)
+    got = _parity(host, tpu, [
+        f"kind IS NULL AND {BOX}",
+        f"score IS NULL AND {BOX}",  # includes the NaN rows
+        f"cnt IS NULL AND {BOX2}",
+        f"kind IS NOT NULL AND {BOX2}",
+        f"score IS NOT NULL AND {BOX}",
+        f"score IS NOT NULL AND score < 0.4 AND {BOX2}",
+        f"cnt IS NULL AND cnt > 3 AND {BOX}",  # contradiction: empty
+    ])
+    assert all(len(r.fids) > 0 for r in got[:5])
+    _plane_loaded(tpu, "z2", "kind")
+    _plane_loaded(tpu, "z2", "score")
+    _plane_loaded(tpu, "z2", "cnt")
+
+
 def test_ineligible_shapes_fall_back_exactly():
     """IN + range on one attr, predicates on TWO attrs, <>: the
     conservative host path still answers exactly."""
