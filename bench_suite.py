@@ -6,6 +6,7 @@ parity-checked throughput vs the in-process numpy full-scan baseline.
   #3 xz2: ST_Intersects over polygons/lines (OSM-ways shape)
   #4 z3 + attribute secondary filter (GDELT actor1='USA' AND bbox)
   #5 kNN process over the z3 index
+  #6 density-grid aggregation push-down (device grid vs host reducer)
 
 Usage: python bench_suite.py            (auto backend, like bench.py)
        GEOMESA_BENCH_N=... GEOMESA_BENCH_REPS=... to resize
@@ -15,6 +16,7 @@ import json
 import os
 import sys
 import time
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -34,6 +36,38 @@ def _store():
     from geomesa_tpu.store.datastore import TpuDataStore
 
     return TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+
+
+@contextmanager
+def _env_override(name, value):
+    """Set one env var for the block, restoring the prior state (unset
+    vars are re-unset) — the one home of the save/set/restore dance the
+    forced-path measurements need."""
+    saved = os.environ.get(name)
+    os.environ[name] = value
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = saved
+
+
+def _grid_parity(grid, host_grid, hits):
+    """(ok, l1): density parity tolerant of f32 cell-boundary flips.
+
+    The device kernel snaps in float32 (executor.py density_scan doc:
+    mirrors the reference's loose-bbox semantics); the host reducer is
+    f64, so points within one f32 ulp of a cell or box edge may land one
+    cell over (L1 contribution 2) or flip box membership (contribution
+    1). Bound the allowed L1 by the statistically expected flip count;
+    an actual kernel bug (wrong row set, shifted grid) blows far past
+    it."""
+    if grid.shape != host_grid.shape:
+        return False, -1
+    l1 = int(np.abs(np.asarray(grid, np.int64) - np.asarray(host_grid, np.int64)).sum())
+    return l1 <= max(8, int(hits) // 10_000 * 2), l1
 
 
 def _timeit(fn, reps):
@@ -57,24 +91,23 @@ def _device_stream_fields(ds, name, cqls, wants, n, base_s):
         return {}
     from geomesa_tpu.index.planner import Query as _Q
 
-    saved = os.environ.get("GEOMESA_SEEK")
-    os.environ["GEOMESA_SEEK"] = "0"
     try:
-        queries = [_Q.cql(c, properties=[]) for c in cqls]
-        prev = None
-        for _ in range(3):  # warm until adaptive run capacities settle
-            ds.query_many(name, queries)
-            caps = {
-                id(s): (s._rcap, s._sum_cap, s._span_cap)
-                for d in getattr(ds.executor, "_cache", {}).values()
-                for s in d[1].segments
-            }
-            if caps == prev:
-                break
-            prev = caps
-        t0 = time.perf_counter()
-        res = ds.query_many(name, queries)
-        dt = (time.perf_counter() - t0) / len(queries)
+        with _env_override("GEOMESA_SEEK", "0"):
+            queries = [_Q.cql(c, properties=[]) for c in cqls]
+            prev = None
+            for _ in range(3):  # warm until adaptive run capacities settle
+                ds.query_many(name, queries)
+                caps = {
+                    id(s): (s._rcap, s._sum_cap, s._span_cap)
+                    for d in getattr(ds.executor, "_cache", {}).values()
+                    for s in d[1].segments
+                }
+                if caps == prev:
+                    break
+                prev = caps
+            t0 = time.perf_counter()
+            res = ds.query_many(name, queries)
+            dt = (time.perf_counter() - t0) / len(queries)
         ok = all(
             set(map(str, r.fids)) == w for r, w in zip(res, wants)
         )
@@ -86,11 +119,6 @@ def _device_stream_fields(ds, name, cqls, wants, n, base_s):
         }
     except Exception as e:  # noqa: BLE001 - auxiliary, never kills the metric
         return {"device_error": f"{type(e).__name__}: {e}"[:200]}
-    finally:
-        if saved is None:
-            os.environ.pop("GEOMESA_SEEK", None)
-        else:
-            os.environ["GEOMESA_SEEK"] = saved
 
 
 def bench_z2(n, reps):
@@ -306,6 +334,85 @@ def bench_poly(n, reps):
     }
 
 
+def bench_density(n, reps):
+    """Density aggregation push-down (#6): the fused device kernel
+    returns a [H, W] grid (KBs over the link) instead of hit rows — the
+    server-side-compute-at-the-data win (DensityScan.scala:30-59 role,
+    here an MXU one-hot-matmul / XLA bincount kernel over resident
+    columns). Baseline: numpy mask + bincount over the raw arrays (the
+    strongest host equivalent of the reducer's core loop). Parity: the
+    cost-chosen grid must equal the host reducer's grid exactly."""
+    from geomesa_tpu.index.planner import Query as _Q
+    from geomesa_tpu.schema.featuretype import parse_spec
+
+    rng = np.random.default_rng(12)
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-85, 85, n)
+    ds = _store()
+    ft = parse_spec("dens", "*geom:Point:srid=4326")
+    ds.create_schema(ft)
+    fids = np.char.add("f", np.arange(n).astype(f"<U{len(str(n - 1))}"))
+    ds._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y})
+    box = (-60.0, -30.0, 60.0, 40.0)
+    wdt, hgt = 256, 128
+    cql = f"bbox(geom, {box[0]}, {box[1]}, {box[2]}, {box[3]})"
+    spec = {"envelope": box, "width": wdt, "height": hgt}
+
+    def brute():
+        m = (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        gx = np.clip(
+            ((x[m] - box[0]) / (box[2] - box[0]) * wdt).astype(np.int64),
+            0, wdt - 1,
+        )
+        gy = np.clip(
+            ((y[m] - box[1]) / (box[3] - box[1]) * hgt).astype(np.int64),
+            0, hgt - 1,
+        )
+        return np.bincount(gy * wdt + gx, minlength=wdt * hgt)
+
+    base_s, base_grid = _timeit(brute, max(3, reps // 4))
+    q = _Q.cql(cql, hints={"density": dict(spec)})
+    dev_s, res = _timeit(lambda: ds.query("dens", q), reps)
+    grid = np.asarray(res.aggregate["density"])
+    # parity oracle: the HOST reducer on the same store (GridSnap
+    # semantics, f64) — tolerance for f32 cell-boundary flips, see
+    # _grid_parity; the brute bincount cross-checks the total count
+    with _env_override("GEOMESA_DENSITY_DEVICE", "0"):
+        host_s, host_res = _timeit(lambda: ds.query("dens", q), max(3, reps // 4))
+        host_grid = np.asarray(host_res.aggregate["density"])
+    parity, l1 = _grid_parity(grid, host_grid, base_grid.sum())
+    count_ok = abs(int(grid.sum()) - int(base_grid.sum())) <= max(
+        4, int(base_grid.sum()) // 20_000
+    )
+    out = {
+        "metric": "density_grid_throughput", "value": round(n / dev_s, 1),
+        "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
+        "n": n, "grid": [hgt, wdt], "hits": int(base_grid.sum()),
+        "parity": bool(parity and count_ok), "grid_l1_diff": l1,
+        "query_ms": round(dev_s * 1000, 3),
+        "host_reducer_ms": round(host_s * 1000, 3),
+    }
+    import jax
+
+    if jax.default_backend() != "cpu":
+        # forced device kernel (the cost gate may already choose it —
+        # this field isolates the fused-kernel time either way)
+        try:
+            with _env_override("GEOMESA_DENSITY_DEVICE", "1"):
+                dvc_s, dvc_res = _timeit(lambda: ds.query("dens", q), reps)
+            dgrid = np.asarray(dvc_res.aggregate["density"])
+            dparity, dl1 = _grid_parity(dgrid, host_grid, base_grid.sum())
+            out.update({
+                "device_path_fps": round(n / dvc_s, 1),
+                "device_path_vs_baseline": round(base_s / dvc_s, 3),
+                "device_query_ms_pipelined": round(dvc_s * 1000, 3),
+                "device_parity": bool(dparity), "device_grid_l1_diff": dl1,
+            })
+        except Exception as e:  # noqa: BLE001 - auxiliary field only
+            out["device_error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
+
+
 def bench_knn(n, reps):
     from geomesa_tpu.process.geodesy import haversine_m
     from geomesa_tpu.process.knn import knn_search
@@ -365,6 +472,7 @@ def main():
         ("xz2", bench_xz2),
         ("attr_bbox", bench_attr_bbox),
         ("poly", bench_poly),
+        ("density", bench_density),
         ("knn", bench_knn),
     ]:
         log(f"running {name} (n={n})")
