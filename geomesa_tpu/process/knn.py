@@ -47,8 +47,28 @@ def knn_search(
     Features beyond ``max_radius_m`` are never returned — identical
     semantics on the device top-k and host expanding-bbox paths."""
     ft = store.get_schema(name)
-    if cql is None and _device_knn_wanted():
-        direct = _device_knn(store, name, ft, x, y, k, max_radius_m)
+    if (
+        cql is None
+        and _device_knn_wanted()
+        and not _device_tripped(store.executor)
+    ):
+        try:
+            direct = _device_knn(store, name, ft, x, y, k, max_radius_m)
+        except Exception as e:  # noqa: BLE001 - device/tunnel failure
+            # a dead tunnel or backend compile error must not kill the
+            # search: the host expanding-bbox path answers identically
+            # (round-4 silicon: the suite's kNN config died on a TPU
+            # setup/compile Unavailable mid-batch with no fallback).
+            # Trip the executor's device flag so auto-mode queries stop
+            # paying the failure latency for the rest of the session.
+            import sys
+
+            store.executor._device_tripped = True
+            sys.stderr.write(
+                f"[knn] device top-k failed ({type(e).__name__}); "
+                "host path answers\n"
+            )
+            direct = None
         if direct is not None:
             return direct
     radius = float(initial_radius_m)
@@ -103,6 +123,18 @@ def _device_knn_wanted() -> bool:
 
 # auto device paths decline when one round trip costs more than this
 _LINK_BUDGET_MS = 10.0
+
+
+def _device_tripped(executor) -> bool:
+    """True when a device path already failed this session AND the
+    operator has not forced the device on: auto mode sticks to the host
+    after one tunnel/backend failure (no per-query failure latency);
+    an explicit GEOMESA_KNN_DEVICE=1 keeps retrying."""
+    import os
+
+    if os.environ.get("GEOMESA_KNN_DEVICE", "auto") == "1":
+        return False
+    return bool(getattr(executor, "_device_tripped", False))
 
 
 def _device_knn(store, name: str, ft, x: float, y: float, k: int,

@@ -14,6 +14,7 @@ projection/limits -> aggregation reducers (density/stats/bin) when hinted.
 from __future__ import annotations
 
 import itertools
+import os
 import uuid
 from collections.abc import Mapping
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
@@ -575,8 +576,30 @@ class TpuDataStore:
         if (
             set(query.hints) & set(AGGREGATION_HINTS) == {"density"}
             and not query.hints.get("sampling")
+            and not (
+                getattr(self.executor, "_device_tripped", False)
+                and os.environ.get("GEOMESA_DENSITY_DEVICE", "auto") != "1"
+            )
         ):
-            grid = self.executor.density_scan(table, plan, query.hints["density"])
+            try:
+                grid = self.executor.density_scan(
+                    table, plan, query.hints["density"]
+                )
+            except Exception as e:  # noqa: BLE001 - device/tunnel failure
+                # the host reducer (run_density over scanned columns)
+                # answers identically — a dead tunnel mid-execution must
+                # not kill an aggregation query. Trip the shared device
+                # flag: auto-mode queries stop paying the failure
+                # latency for the rest of the session (forced =1 keeps
+                # retrying).
+                import sys
+
+                self.executor._device_tripped = True
+                sys.stderr.write(
+                    f"[density] device grid failed ({type(e).__name__}); "
+                    "host reducer answers\n"
+                )
+                grid = None
             if grid is not None:
                 plan.scan_path = "device-density"
                 return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})

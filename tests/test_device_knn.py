@@ -95,3 +95,47 @@ def test_knn_with_filter_falls_back():
     d = haversine_m(res.columns["geom__x"], res.columns["geom__y"], 0.0, 0.0)
     order = np.argsort(d, kind="stable")[:8]
     assert [f for f, _ in got] == [str(res.fids[i]) for i in order]
+
+
+def test_device_failure_falls_back_to_host(monkeypatch):
+    """A dead tunnel / backend compile error inside the device top-k must
+    degrade to the host expanding-bbox path, not kill the search (the
+    round-4 silicon suite lost its kNN number to exactly this)."""
+    import geomesa_tpu.process.knn as K
+
+    monkeypatch.setenv("GEOMESA_KNN_DEVICE", "1")
+
+    def boom(*a, **kw):
+        raise RuntimeError("UNAVAILABLE: TPU backend setup/compile error")
+
+    monkeypatch.setattr(K, "_device_knn", boom)
+    tpu = _mk(TpuScanExecutor(default_mesh()))
+    got = knn_search(tpu, "t", 10.0, 10.0, k=5)
+    brute = _brute(tpu, 10.0, 10.0, 5)
+    assert [f for f, _ in got] == [f for f, _ in brute]
+
+
+def test_device_failure_trips_auto_mode_once(monkeypatch):
+    """After one device failure, auto-mode searches skip the device
+    attempt for the session (no per-query failure latency); forced =1
+    keeps retrying."""
+    import geomesa_tpu.process.knn as K
+
+    calls = {"n": 0}
+
+    def boom(*a, **kw):
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE")
+
+    monkeypatch.setattr(K, "_device_knn", boom)
+    monkeypatch.setattr(K, "_device_knn_wanted", lambda: True)
+    monkeypatch.delenv("GEOMESA_KNN_DEVICE", raising=False)
+    tpu = _mk(TpuScanExecutor(default_mesh()))
+    brute = _brute(tpu, 10.0, 10.0, 5)
+    for _ in range(3):
+        got = knn_search(tpu, "t", 10.0, 10.0, k=5)
+        assert [f for f, _ in got] == [f for f, _ in brute]
+    assert calls["n"] == 1  # tripped after the first failure
+    monkeypatch.setenv("GEOMESA_KNN_DEVICE", "1")
+    knn_search(tpu, "t", 10.0, 10.0, k=5)
+    assert calls["n"] == 2  # forced mode retries despite the trip
