@@ -177,3 +177,33 @@ def link_latency_ms() -> float:
                 samples.append((time.perf_counter() - t0) * 1000.0)
             _LINK_LATENCY_MS = float(sorted(samples)[1])
     return _LINK_LATENCY_MS
+
+
+def device_tripped(executor, env_var: str) -> bool:
+    """True when a device path already failed this session AND the
+    operator has not forced THIS path on (env_var != "1"): auto-mode
+    queries stick to the host after one tunnel/backend failure instead
+    of paying the failure latency per query; an explicit =1 keeps
+    retrying. One home for the gate check the device kNN and density
+    autos share."""
+    import os
+
+    if os.environ.get(env_var, "auto") == "1":
+        return False
+    return bool(getattr(executor, "_device_tripped", False))
+
+
+def trip_device(executor, env_var: str, tag: str, exc: BaseException) -> None:
+    """Record a device-path failure: one stderr line, and set the
+    executor's session trip flag — UNLESS the operator forced this path
+    on (a deterministic kernel-specific failure under a forced =1 must
+    not poison the OTHER auto-mode device paths on a healthy tunnel)."""
+    import os
+    import sys
+
+    if os.environ.get(env_var, "auto") != "1":
+        executor._device_tripped = True
+    sys.stderr.write(
+        f"[{tag}] device path failed ({type(exc).__name__}); "
+        "host path answers\n"
+    )

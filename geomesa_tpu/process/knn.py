@@ -46,11 +46,13 @@ def knn_search(
     """[(fid, distance_m)] of the k nearest features to (x, y), ascending.
     Features beyond ``max_radius_m`` are never returned — identical
     semantics on the device top-k and host expanding-bbox paths."""
+    from geomesa_tpu.parallel.mesh import device_tripped, trip_device
+
     ft = store.get_schema(name)
     if (
         cql is None
         and _device_knn_wanted()
-        and not _device_tripped(store.executor)
+        and not device_tripped(store.executor, "GEOMESA_KNN_DEVICE")
     ):
         try:
             direct = _device_knn(store, name, ft, x, y, k, max_radius_m)
@@ -58,16 +60,8 @@ def knn_search(
             # a dead tunnel or backend compile error must not kill the
             # search: the host expanding-bbox path answers identically
             # (round-4 silicon: the suite's kNN config died on a TPU
-            # setup/compile Unavailable mid-batch with no fallback).
-            # Trip the executor's device flag so auto-mode queries stop
-            # paying the failure latency for the rest of the session.
-            import sys
-
-            store.executor._device_tripped = True
-            sys.stderr.write(
-                f"[knn] device top-k failed ({type(e).__name__}); "
-                "host path answers\n"
-            )
+            # setup/compile Unavailable mid-batch with no fallback)
+            trip_device(store.executor, "GEOMESA_KNN_DEVICE", "knn", e)
             direct = None
         if direct is not None:
             return direct
@@ -123,18 +117,6 @@ def _device_knn_wanted() -> bool:
 
 # auto device paths decline when one round trip costs more than this
 _LINK_BUDGET_MS = 10.0
-
-
-def _device_tripped(executor) -> bool:
-    """True when a device path already failed this session AND the
-    operator has not forced the device on: auto mode sticks to the host
-    after one tunnel/backend failure (no per-query failure latency);
-    an explicit GEOMESA_KNN_DEVICE=1 keeps retrying."""
-    import os
-
-    if os.environ.get("GEOMESA_KNN_DEVICE", "auto") == "1":
-        return False
-    return bool(getattr(executor, "_device_tripped", False))
 
 
 def _device_knn(store, name: str, ft, x: float, y: float, k: int,

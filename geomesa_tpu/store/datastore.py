@@ -22,6 +22,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 import numpy as np
 
 from geomesa_tpu.filter import ast, evaluate
+from geomesa_tpu.parallel import mesh as mesh_mod
 from geomesa_tpu.filter.parser import parse_cql
 from geomesa_tpu.index.aggregators import (
     AGGREGATION_HINTS,
@@ -576,9 +577,8 @@ class TpuDataStore:
         if (
             set(query.hints) & set(AGGREGATION_HINTS) == {"density"}
             and not query.hints.get("sampling")
-            and not (
-                getattr(self.executor, "_device_tripped", False)
-                and os.environ.get("GEOMESA_DENSITY_DEVICE", "auto") != "1"
+            and not mesh_mod.device_tripped(
+                self.executor, "GEOMESA_DENSITY_DEVICE"
             )
         ):
             try:
@@ -588,16 +588,10 @@ class TpuDataStore:
             except Exception as e:  # noqa: BLE001 - device/tunnel failure
                 # the host reducer (run_density over scanned columns)
                 # answers identically — a dead tunnel mid-execution must
-                # not kill an aggregation query. Trip the shared device
-                # flag: auto-mode queries stop paying the failure
-                # latency for the rest of the session (forced =1 keeps
-                # retrying).
-                import sys
-
-                self.executor._device_tripped = True
-                sys.stderr.write(
-                    f"[density] device grid failed ({type(e).__name__}); "
-                    "host reducer answers\n"
+                # not kill an aggregation query; see mesh.trip_device
+                # for the session trip semantics
+                mesh_mod.trip_device(
+                    self.executor, "GEOMESA_DENSITY_DEVICE", "density", e
                 )
                 grid = None
             if grid is not None:

@@ -108,39 +108,50 @@ def probe(timeout_s=45) -> bool:
 
 
 def run(cmd, env_extra=None, timeout_s=1800):
-    """Run one bench; returns its last stdout JSON line (or None)."""
+    """Run one bench; returns ALL parsed stdout JSON lines, in order.
+
+    Multi-config benches (bench_suite) emit one line per config — every
+    line must reach BENCH_hw.json (round 4 lost four good suite configs
+    because only the LAST line, a kNN error, was kept)."""
     env = dict(os.environ)
     if env_extra:
         env.update(env_extra)
     log(f"run: {' '.join(cmd)} env={env_extra or {}}")
-    json_line = None
+    json_lines = []
     try:
         p = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout_s, env=env, cwd=REPO)
         for line in p.stdout.strip().splitlines():
             log(f"  out: {line}")
             if line.startswith("{"):
-                json_line = line
+                json_lines.append(line)
         for line in p.stderr.strip().splitlines()[-6:]:
             log(f"  err: {line}")
         log(f"  rc={p.returncode}")
     except subprocess.TimeoutExpired as e:
-        # keep whatever output made it out before the hang — the bench
-        # emits its JSON line before teardown, which is what matters
-        for src_ in (e.stdout, e.stderr):
-            if src_:
-                text = src_.decode() if isinstance(src_, bytes) else src_
-                for line in text.strip().splitlines()[-10:]:
-                    log(f"  partial: {line}")
-                    if line.startswith("{"):
-                        json_line = line
+        # keep whatever output made it out before the hang — completed
+        # configs emit their JSON lines before the hang, and ALL stdout
+        # lines count (a timed-out suite must not lose its early
+        # configs). stderr is logged for diagnosis but NEVER collected:
+        # a JSON-shaped runtime diagnostic is not a bench result.
+        if e.stdout:
+            text = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+            for line in text.strip().splitlines():
+                log(f"  partial: {line}")
+                if line.startswith("{"):
+                    json_lines.append(line)
+        if e.stderr:
+            text = e.stderr.decode() if isinstance(e.stderr, bytes) else e.stderr
+            for line in text.strip().splitlines()[-10:]:
+                log(f"  partial-err: {line}")
         log("  TIMEOUT")
-    if json_line is not None:
+    out = []
+    for line in json_lines:
         try:
-            return json.loads(json_line)
+            out.append(json.loads(line))
         except ValueError:
             pass
-    return None
+    return out
 
 
 def git_head() -> str:
@@ -209,9 +220,9 @@ def batch() -> None:
         if driver_bench_pending():
             log("driver bench pending; aborting batch to yield the flock")
             break
-        r = run(cmd, env_extra, timeout_s=timeout_s)
-        if r is not None:
-            results.append({"name": name, **r})
+        got = run(cmd, env_extra, timeout_s=timeout_s)
+        if got:
+            results.extend({"name": name, **r} for r in got)
             record_hw(results)  # durable even if the window closes mid-batch
 
 
