@@ -399,6 +399,26 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh,
     return fn
 
 
+_EXACT_COUNT_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _exact_count_fn(has_time: bool, mode: str, mesh, attr=False):
+    """Mask -> scalar hit count (NO extraction, no gather-to-replicated:
+    jnp.sum reduces the row-sharded mask directly — XLA inserts the
+    cross-shard reduction). One i32 back over the link per execution."""
+    key = (has_time, mode, mesh, attr)
+    fn = _EXACT_COUNT_FNS.get(key)
+    if fn is None:
+        mask = _exact_mask_body(has_time, mode, mesh, attr)
+
+        def run(*args):
+            return jnp.sum(mask(*args), dtype=jnp.int32)
+
+        fn = jax.jit(run)
+        _EXACT_COUNT_FNS[key] = fn
+    return fn
+
+
 def _point_desc_split(mask, has_time: bool, args, attr=False):
     """Shared arg split for the point batch builders: returns
     (mask_of(desc), stacked desc arrays for lax.scan). ``attr`` adds the
@@ -2203,16 +2223,7 @@ class DeviceSegment:
         the (op, literal) predicate tuple for ``kind="range"``."""
         has_time = self.tk_hi is not None and win_dev is not None
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
-        codes_dev = self._attr_codes[attr][0]
-        aflag = "range" if kind == "range" else True
-        qc_np = (
-            self.attr_qrange(attr, payload)
-            if kind == "range"
-            else self.attr_qcodes(
-                attr, payload, _pow2_at_least(len(payload), 1)
-            )
-        )
-        qc = replicate(self.mesh, qc_np)
+        aflag, codes_dev, qc = self._attr_plane_args(attr, payload, kind)
         args = self._exact_args(box_dev, win_dev, has_time, codes_dev, qc)
         rcap = self._rcap
         buf = _exact_runs_fn(has_time, rcap, mode, self.mesh, aflag)(*args)
@@ -2228,6 +2239,43 @@ class DeviceSegment:
                 has_time, mode, self.mesh, aflag
             )(*args),
         )
+
+    def _attr_plane_args(self, attr, payload, kind):
+        """(aflag, codes_dev, qc_dev) for one attr-plane query — THE
+        shared member/range split (K-bucket vs [lo, hi] interval) used
+        by extraction dispatches AND the count path, so the two can
+        never diverge. attr None -> the plain exact plane."""
+        if attr is None:
+            return False, None, None
+        codes_dev = self._attr_codes[attr][0]
+        if kind == "range":
+            return "range", codes_dev, replicate(
+                self.mesh, self.attr_qrange(attr, payload)
+            )
+        return True, codes_dev, replicate(
+            self.mesh,
+            self.attr_qcodes(attr, payload, _pow2_at_least(len(payload), 1)),
+        )
+
+    def count_exact_start(
+        self, box_dev, win_dev, attr=None, payload=None, kind="member"
+    ):
+        """DISPATCH a filtered count (no row extraction): the
+        exact(+attr) mask sums on device; returns the in-flight scalar —
+        int() it to collect. One i32 crosses the link per segment,
+        independent of hit count; callers replicate box/window ONCE and
+        dispatch every segment before collecting, so S segments pay one
+        upload + one link round-trip of latency, not S (the device
+        edition of an EXACT_COUNT scan; count_scan wires it to
+        store.count). Per-segment attr vectors stay per segment — codes
+        are segment-local."""
+        has_time = self.tk_hi is not None and win_dev is not None
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        aflag, codes_dev, qc = self._attr_plane_args(attr, payload, kind)
+        args = self._exact_args(box_dev, win_dev, has_time, codes_dev, qc)
+        out = _exact_count_fn(has_time, mode, self.mesh, aflag)(*args)
+        _start_d2h(out)
+        return out
 
     def dispatch_exact(self, box_dev, win_dev) -> "_PendingHits":
         """Exact predicate scan (see TpuScanExecutor._exact_descriptor)."""
@@ -4614,6 +4662,67 @@ class TpuScanExecutor:
         return out
 
     # -- fused aggregation push-down ----------------------------------------
+
+    def count_scan(self, table: IndexTable, plan: QueryPlan):
+        """Exact filtered count with no row extraction (the EXACT_COUNT
+        edition of the exact device scans): when the plan's FULL filter
+        is device-decidable — precise box(+window), optionally with one
+        attr predicate set (member or range) — each segment sums its
+        mask on device and ships ONE scalar, transfer independent of
+        hit count. None -> host path (len(query) over the normal scan).
+
+        GEOMESA_COUNT_DEVICE: auto (accelerators with a sub-10ms link;
+        over a high-latency tunnel the per-execution floor loses to the
+        host seek's sub-ms answer) | 1 | 0. Reference role: the
+        EXACT_COUNT hint / GeoMesaStats.getCount split
+        (index-api .../stats/GeoMesaStats.scala, QueryProperties)."""
+        import os
+
+        env = os.environ.get("GEOMESA_COUNT_DEVICE", "auto")
+        if env == "0":
+            return None
+        if env != "1":
+            if jax.default_backend() == "cpu":
+                return None
+            from geomesa_tpu.parallel.mesh import link_latency_ms
+
+            if link_latency_ms() > 10.0:
+                return None
+        if table.index.name not in ("z2", "z3"):
+            return None
+        if not self._scan_eligible(table, plan):
+            return None
+        if self._has_visibilities(table):
+            return None  # per-feature auth checks need the host path
+        attr = akind = payload = None
+        desc = self._exact_descriptor(table, plan)
+        if desc is not None:
+            box_np, win_np = desc
+        else:
+            got = self._attr_batch_desc(table, plan)
+            if got is None:
+                return None
+            attr, akind, (box_np, win_np, payload) = got
+        dev = self.device_index(table)
+        if not dev.segments:
+            return None
+        if not all(seg.load_exact(table) for seg in dev.segments):
+            return None
+        if attr is not None and not all(
+            seg.load_attr_codes(attr) for seg in dev.segments
+        ):
+            return None
+        # replicate once, dispatch ALL segments, then collect: S segments
+        # pay one upload + one link round-trip of latency, not S
+        box_dev = replicate(self.mesh, box_np)
+        win_dev = None if win_np is None else replicate(self.mesh, win_np)
+        pending = [
+            seg.count_exact_start(
+                box_dev, win_dev, attr, payload, akind or "member"
+            )
+            for seg in dev.segments
+        ]
+        return sum(int(p) for p in pending)
 
     def density_scan(self, table: IndexTable, plan: QueryPlan, spec):
         """Fused filter + density grid on device (the server-side

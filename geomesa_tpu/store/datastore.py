@@ -14,7 +14,6 @@ projection/limits -> aggregation reducers (density/stats/bin) when hinted.
 from __future__ import annotations
 
 import itertools
-import os
 import uuid
 from collections.abc import Mapping
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
@@ -421,6 +420,14 @@ class TpuDataStore:
                 est = self.stats.get_count(self.get_schema(name), q.filter)
                 if est is not None:
                     return int(est)
+            if (
+                exact
+                and not has_vis
+                and self._age_off_cutoff(self.get_schema(name)) is None
+            ):
+                got = self._count_device(name, q)
+                if got is not None:
+                    return got
             return len(self.query(name, q))
         if has_vis or self._age_off_cutoff(self.get_schema(name)) is not None:
             # expired features must not be counted (age-off masks at scan)
@@ -429,6 +436,32 @@ class TpuDataStore:
         if first.tombstones:
             n -= sum(1 for _ in first.tombstones)
         return n
+
+    def _count_device(self, name: str, q: "Query") -> Optional[int]:
+        """Device mask-sum count when the executor supports it and the
+        query's semantics reduce to plain len() (no limit/hints). The
+        failure fallback mirrors density: a dead tunnel answers through
+        the ordinary scan path and trips the session device flag."""
+        count_scan = getattr(self.executor, "count_scan", None)
+        if count_scan is None:
+            return None
+        if q.max_features is not None or q.hints:
+            return None  # limits / sampling / aggregations change len()
+        if mesh_mod.device_tripped(self.executor, "GEOMESA_COUNT_DEVICE"):
+            return None
+        plan = self._plan_cached(name, q)
+        if plan.union:
+            return None  # OR arms may overlap; the host path dedupes
+        table = self._tables[name].get(plan.index.name)
+        if table is None:
+            return None
+        try:
+            return count_scan(table, plan)
+        except Exception as e:  # noqa: BLE001 - device/tunnel failure
+            mesh_mod.trip_device(
+                self.executor, "GEOMESA_COUNT_DEVICE", "count", e
+            )
+            return None
 
     # -- queries ------------------------------------------------------------
 

@@ -148,9 +148,16 @@ def run(cmd, env_extra=None, timeout_s=1800):
     out = []
     for line in json_lines:
         try:
-            out.append(json.loads(line))
+            parsed = json.loads(line)
         except ValueError:
-            pass
+            continue
+        if parsed.get("source") == "tpu_watch_capture":
+            # bench.py's provisional echo of a PREVIOUS capture — never a
+            # result of THIS run (belt to the GEOMESA_AXON_LOCK_HELD
+            # suppression braces: recording it would freeze a stale
+            # headline into BENCH_hw.json forever)
+            continue
+        out.append(parsed)
     return out
 
 
