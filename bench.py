@@ -814,8 +814,22 @@ def main():
                     env=env,
                 )
                 sys.stderr.write(proc.stderr)
-                line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
-                payload = json.loads(line)
+                # the child ALSO emits a provisional capture echo first
+                # (and re-emits it after an error): take the last LIVE
+                # line — adopting a capture echo would mislabel stale
+                # numbers as the retry's measurement and hide the error
+                parsed = []
+                for line in proc.stdout.strip().splitlines():
+                    if line.startswith("{"):
+                        try:
+                            parsed.append(json.loads(line))
+                        except ValueError:
+                            pass
+                live = [
+                    p for p in parsed
+                    if p.get("source") != "tpu_watch_capture"
+                ]
+                payload = live[-1]  # IndexError -> the error payload below
                 payload["note"] = f"device run failed ({type(e).__name__}), cpu retry"
             except Exception as e2:  # noqa: BLE001
                 payload = {

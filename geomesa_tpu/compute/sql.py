@@ -832,6 +832,47 @@ class SQLContext:
         stfns = [it for it in items if it["kind"] == "stfn"]
         star = any(it["kind"] == "star" for it in items)
 
+        # COUNT(*)-only fast path: no rows leave the store at all —
+        # store.count rides the device mask-sum (executor.count_scan)
+        # when the WHERE is device-decidable, the ordinary scan + len
+        # otherwise (Spark's count pushdown role)
+        if (
+            len(aggs) == 1 and not plain and not stfns and not star
+            and not q["group"] and q["having"] is None and not q["order"]
+            and aggs[0]["fn"] == "count" and aggs[0]["arg"] == "*"
+        ):
+            cq = Query(
+                filter=q["where"] if q["where"] is not None else ast.Include()
+            )
+            cnt = None
+            count = getattr(self.store, "count", None)
+            if callable(count):
+                import inspect
+
+                try:
+                    takes_filter = len(
+                        inspect.signature(count).parameters
+                    ) >= 2
+                except (TypeError, ValueError):
+                    takes_filter = True
+                if takes_filter:
+                    cnt = count(ft.name, cq)
+            if cnt is not None:
+                # .explain must still prove which index would answer the
+                # WHERE (SqlResult.plan's stated purpose); .ft is None
+                # exactly as _aggregate's global-aggregate frames are
+                plan = None
+                plan_cached = getattr(self.store, "_plan_cached", None)
+                if callable(plan_cached):
+                    try:
+                        plan = plan_cached(ft.name, cq)
+                    except Exception:  # noqa: BLE001 - explain is advisory
+                        plan = None
+                cols = {aggs[0]["alias"]: np.asarray([cnt])}
+                if q["limit"] is not None:
+                    cols = {k: v[: q["limit"]] for k, v in cols.items()}
+                return SqlResult(cols, None, plan)
+
         # projection pushdown: only the columns the SELECT needs leave the
         # scan (group keys, agg sources, plain columns, st-fn inputs)
         props: Optional[List[str]] = None

@@ -2368,13 +2368,11 @@ class DeviceSegment:
 
         def single_args_for(box_np, win_np, values):
             def build():
-                if is_attr == "range":
-                    qc_np = self.attr_qrange(attr, values)
-                elif is_attr:
-                    qc_np = self.attr_qcodes(
-                        attr, values, _pow2_at_least(len(values), 1)
-                    )
-                qc = replicate(self.mesh, qc_np) if is_attr else None
+                _aflag, _codes, qc = self._attr_plane_args(
+                    attr if is_attr else None,
+                    values,
+                    "range" if is_attr == "range" else "member",
+                )
                 return self._exact_args(
                     replicate(self.mesh, box_np),
                     None if win_np is None else replicate(self.mesh, win_np),

@@ -387,3 +387,20 @@ def test_extent_extent_join():
         "SELECT a.rname FROM roads a JOIN zones b ON st_contains(b.geom, a.geom)"
     )
     assert list(r3.columns["rname"]) == ["r1"]
+
+
+def test_count_star_fast_path(store, monkeypatch):
+    """SELECT COUNT(*) alone never materializes rows: it answers through
+    store.count (which rides the device mask-sum when the WHERE is
+    device-decidable). Parity vs the row-materializing multi-agg path."""
+    monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "1")
+    monkeypatch.setenv("GEOMESA_COUNT_DEVICE", "1")
+    sq = SQLContext(store)
+    for where in [
+        "",
+        " WHERE st_intersects(geom, st_makeBBOX(-20.0, -15.0, 25.0, 18.0))",
+        " WHERE n_articles BETWEEN 10 AND 40",
+    ]:
+        fast = sq.sql(f"SELECT COUNT(*) AS n FROM gdelt{where}")
+        slow = sq.sql(f"SELECT COUNT(*) AS n, MIN(n_articles) AS a FROM gdelt{where}")
+        assert int(fast.columns["n"][0]) == int(slow.columns["n"][0]), where
