@@ -51,11 +51,14 @@ MODES = [
     # per-shard window extraction (point + dual-plane editions)
     {"GEOMESA_SEEK": "0", "GEOMESA_EXACT_DEVICE": "1", "GEOMESA_DEVBATCH": "1",
      "GEOMESA_BATCH_PROTO": "bitmap", "GEOMESA_SHARD_EXTRACT": "1"},
+    # device mask-sum counts alongside the batched scans
+    {"GEOMESA_SEEK": "0", "GEOMESA_EXACT_DEVICE": "1", "GEOMESA_DEVBATCH": "1",
+     "GEOMESA_COUNT_DEVICE": "1"},
 ]
 _MODE_KEYS = (
     "GEOMESA_SEEK", "GEOMESA_TPU_NO_NATIVE", "GEOMESA_DEVSEEK",
     "GEOMESA_EXACT_DEVICE", "GEOMESA_DEVBATCH", "GEOMESA_BATCH_PROTO",
-    "GEOMESA_SHARD_EXTRACT",
+    "GEOMESA_SHARD_EXTRACT", "GEOMESA_COUNT_DEVICE",
 )
 
 
@@ -115,6 +118,11 @@ def one_round(seed: int) -> int:
             got = sorted(map(str, tpu.query("t", q).fids))
             wants[q] = sorted(map(str, host.query("t", q).fids))
             assert got == wants[q], ("plain", seed, mode, q)
+            checked += 1
+        # filtered counts (device mask-sum when the mode enables it,
+        # host len() otherwise) must match the materialized result size
+        for q in queries[:6]:
+            assert tpu.count("t", q) == len(wants[q]), ("count", seed, mode, q)
             checked += 1
         # query_many: the pipelined/batched dispatch (exact-shape plans
         # fuse into one device execution under GEOMESA_DEVBATCH) must be
