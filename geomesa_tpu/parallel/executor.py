@@ -1034,7 +1034,17 @@ class _BatchRow:
         return r if dtype is None else r.astype(dtype)
 
 
-def _xz_exact_mask_body(has_time: bool, mode: str, mesh):
+def _xz_arg_counts(attr) -> Tuple[int, int]:
+    """(row-sharded, replicated) arg counts of the extent mask layouts —
+    THE single table for _xz_exact_mask_body's shard specs, the dual
+    shard-extract kernels, and DeviceSegment._xz_args (must stay in
+    lock-step)."""
+    if attr:
+        return 13, 3  # + codes column / + qcode vector
+    return 12, 2
+
+
+def _xz_exact_mask_body(has_time: bool, mode: str, mesh, attr=False):
     """Unjitted full-scan extent mask: (hit, decided) over ALL rows.
 
     hit = stored envelope overlaps the query envelope (exact f64 via
@@ -1045,14 +1055,31 @@ def _xz_exact_mask_body(has_time: bool, mode: str, mesh):
     test — the same decision logic as the candidate-gather devseek
     (_devseek_xz_fn) but streaming, which is how this hardware wants it.
 
+    ``attr`` adds the unified-rank-code attribute plane exactly like
+    _exact_mask_body's editions (True = membership over a (K,) qcode
+    vector, "range" = one inclusive [lo, hi] interval): the attr test
+    ANDs into ``hit`` BEFORE ``decided`` derives from it, so decided
+    rows are final for the full spatial-AND-attr predicate and the ring
+    only ever carries attr-passing rows (the host's per-geometry test
+    needs no attr re-check).
+
     Query descriptor qbox: u32[12] = (xmin, ymin, xmax, ymax, zero) x
     (hi, lo) limbs + [rect_flag, 0]."""
     from geomesa_tpu.ops.zkernels import limbs_in_range, limbs_leq
 
-    def core(
+    if attr == "range":
+        def acomb(m, codes, qcode):
+            return m & (codes >= qcode[0]) & (codes <= qcode[1])
+    elif attr:
+        def acomb(m, codes, qcode):
+            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
+
+    def parts(
         bxmin_h, bxmin_l, bymin_h, bymin_l, bxmax_h, bxmax_l,
         bymax_h, bymax_l, isrect, valid, th, tl, qbox, win,
     ):
+        """(hit, finalizable): decided = hit & finalizable (callers AND
+        the attr plane into hit FIRST when present)."""
         qxmin_h, qxmin_l = qbox[0], qbox[1]
         qymin_h, qymin_l = qbox[2], qbox[3]
         qxmax_h, qxmax_l = qbox[4], qbox[5]
@@ -1080,21 +1107,54 @@ def _xz_exact_mask_body(has_time: bool, mode: str, mesh):
         hit = overlap & valid
         if has_time:
             hit = hit & limbs_in_range(th, tl, win[0], win[1], win[2], win[3])
-        decided = hit & rect & ~placeholder & (inside | isrect)
-        return hit, decided
+        return hit, rect & ~placeholder & (inside | isrect)
+
+    if attr:
+        def core(
+            bxmin_h, bxmin_l, bymin_h, bymin_l, bxmax_h, bxmax_l,
+            bymax_h, bymax_l, isrect, valid, th, tl, codes,
+            qbox, win, qcode,
+        ):
+            hit, fin = parts(
+                bxmin_h, bxmin_l, bymin_h, bymin_l, bxmax_h, bxmax_l,
+                bymax_h, bymax_l, isrect, valid, th, tl, qbox, win,
+            )
+            hit = acomb(hit, codes, qcode)
+            return hit, hit & fin
+    else:
+        def core(
+            bxmin_h, bxmin_l, bymin_h, bymin_l, bxmax_h, bxmax_l,
+            bymax_h, bymax_l, isrect, valid, th, tl, qbox, win,
+        ):
+            hit, fin = parts(
+                bxmin_h, bxmin_l, bymin_h, bymin_l, bxmax_h, bxmax_l,
+                bymax_h, bymax_l, isrect, valid, th, tl, qbox, win,
+            )
+            return hit, hit & fin
 
     if mode != "spmd":
         return core
     from jax.sharding import PartitionSpec as P
 
-    # 8 limb cols + isrect + valid + th + tl sharded; qbox/win replicated
+    nrow, nrep = _xz_arg_counts(attr)
     return shard_map_fn(
         core,
         mesh,
-        in_specs=tuple([P(DATA_AXIS)] * 12 + [P()] * 2),
+        in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * nrep),
         out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
         check=False,
     )
+
+
+def _xz_desc_split(mask, attr, args):
+    """Shared arg split for the extent batch builders (the dual-plane
+    edition of _point_desc_split): (mask_of(desc), stacked desc arrays
+    for lax.scan)."""
+    if attr:
+        *cols, qboxes, wins, qcodes = args
+        return (lambda d: mask(*cols, d[0], d[1], d[2])), (qboxes, wins, qcodes)
+    *cols, qboxes, wins = args
+    return (lambda d: mask(*cols, d[0], d[1])), (qboxes, wins)
 
 
 _XZ_RUNS_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
@@ -1128,22 +1188,23 @@ def _dual_bitmap_row(hit, decided, span_cap: int):
     return jnp.stack([cnt, lo, hi, start]), bits
 
 
-def _xz_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str, mesh):
+def _xz_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
+                        mesh, attr=False):
     """Extent edition of _exact_bitmap_batch_fn (see _dual_bitmap_row)."""
-    key = (has_time, span_cap, q, mode, mesh)
+    key = (has_time, span_cap, q, mode, mesh, attr)
     fn = _XZ_BITMAP_BATCH_FNS.get(key)
     if fn is None:
-        mask = _xz_exact_mask_body(has_time, mode, mesh)
+        mask = _xz_exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
-            *cols, qboxes, wins = args
+            mask_of, descs = _xz_desc_split(mask, attr, args)
 
             def step(carry, d):
-                hit, decided = mask(*cols, d[0], d[1])
+                hit, decided = mask_of(d)
                 return carry, _dual_bitmap_row(hit, decided, span_cap)
 
-            _, (headers, bitmaps) = jax.lax.scan(step, 0, (qboxes, wins))
+            _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
 
         fn = jax.jit(run)
@@ -1155,26 +1216,27 @@ _DUAL_SHARD_BITMAP_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
 def _dual_shard_bitmap_batch_fn(kind: str, has_time: bool, span_cap: int,
-                                q: int, mesh):
+                                q: int, mesh, attr=False):
     """PER-SHARD extraction edition of the dual-plane bitmap batches
     (``kind`` = 'xz' extent envelopes | 'poly' banded ray cast): the
     local mask AND the dual span framing run INSIDE shard_map, each chip
     framing its LOCAL hit/decided windows; the host stitches shard rows
     with offsets (see _exact_shard_bitmap_batch_fn — same shape, two
-    planes per window)."""
-    key = (kind, has_time, span_cap, q, mesh)
+    planes per window). ``attr`` (xz only) threads the rank-code
+    attribute plane through the local mask."""
+    key = (kind, has_time, span_cap, q, mesh, attr)
     fn = _DUAL_SHARD_BITMAP_FNS.get(key)
     if fn is None:
         from jax.sharding import PartitionSpec as P
 
         if kind == "xz":
-            local = _xz_exact_mask_body(has_time, "local", mesh)
-            nrow, nrep = 12, 2
+            local = _xz_exact_mask_body(has_time, "local", mesh, attr)
+            nrow, nrep = _xz_arg_counts(attr)
 
             def split(args):
-                cols, qboxes, wins = args[:-2], args[-2], args[-1]
-                return (lambda d: local(*cols, d[0], d[1])), (qboxes, wins)
+                return _xz_desc_split(local, attr, args)
         else:
+            assert not attr, "attr plane is xz-only in the dual kernels"
             local = _poly_mask_body(has_time, "local", mesh)
             nrow, nrep = (9 if has_time else 7), 3
 
@@ -1467,11 +1529,11 @@ def _poly_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
     return fn
 
 
-def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
-    key = (has_time, rcap, mode, mesh)
+def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh, attr=False):
+    key = (has_time, rcap, mode, mesh, attr)
     fn = _XZ_RUNS_FNS.get(key)
     if fn is None:
-        mask = _xz_exact_mask_body(has_time, mode, mesh)
+        mask = _xz_exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
@@ -1483,24 +1545,24 @@ def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
     return fn
 
 
-def _xz_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
+def _xz_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
+                      attr=False):
     """Batched extent edition of _exact_runs_batch_fn: lax.scan over [q]
-    stacked (qbox, window) descriptors -> [q, 2 x (2 + 2*rcap)]."""
-    key = (has_time, rcap, q, mode, mesh)
+    stacked (qbox, window[, qcode]) descriptors -> [q, 2 x (2 + 2*rcap)]."""
+    key = (has_time, rcap, q, mode, mesh, attr)
     fn = _XZ_RUNS_BATCH_FNS.get(key)
     if fn is None:
-        mask = _xz_exact_mask_body(has_time, mode, mesh)
+        mask = _xz_exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
-            cols, qboxes, wins = args[:-2], args[-2], args[-1]
+            mask_of, descs = _xz_desc_split(mask, attr, args)
 
-            def step(carry, bw):
-                qbox, win = bw
-                hit, decided = mask(*cols, qbox, win)
+            def step(carry, d):
+                hit, decided = mask_of(d)
                 return carry, _xz_dual_runs(hit, decided, rcap)
 
-            _, out = jax.lax.scan(step, 0, (qboxes, wins))
+            _, out = jax.lax.scan(step, 0, descs)
             return out
 
         fn = jax.jit(run)
@@ -1508,11 +1570,11 @@ def _xz_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
     return fn
 
 
-def _xz_packed_fn(has_time: bool, mode: str, mesh):
-    key = (has_time, mode, mesh)
+def _xz_packed_fn(has_time: bool, mode: str, mesh, attr=False):
+    key = (has_time, mode, mesh, attr)
     fn = _XZ_PACKED_FNS.get(key)
     if fn is None:
-        mask = _xz_exact_mask_body(has_time, mode, mesh)
+        mask = _xz_exact_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
@@ -2240,6 +2302,33 @@ class DeviceSegment:
             )(*args),
         )
 
+    def _attr_batch_vectors(self, attr, attr_kind, descs, qpad):
+        """(is_attr, codes_dev, qcodes_dev) for a batch whose descs carry
+        payloads at index 2 — the BATCH edition of _attr_plane_args (one
+        home for the K-bucket vs [lo, hi] split across the point and
+        extent dispatchers, so the two can never diverge). Pad entries
+        repeat the last desc's vector."""
+        is_attr = (
+            False if attr is None
+            else ("range" if attr_kind == "range" else True)
+        )
+        if not is_attr:
+            return False, None, None
+        codes_dev = self._attr_codes[attr][0]
+        if is_attr == "range":
+            def qvec(payload):
+                return self.attr_qrange(attr, payload)
+        else:
+            kk = _pow2_at_least(max(len(d[2]) for d in descs), 1)
+
+            def qvec(payload):
+                return self.attr_qcodes(attr, payload, kk)
+        q = len(descs)
+        qcodes_np = np.stack(
+            [qvec(d[2]) for d in descs] + [qvec(descs[-1][2])] * (qpad - q)
+        )
+        return is_attr, codes_dev, replicate(self.mesh, qcodes_np)
+
     def _attr_plane_args(self, attr, payload, kind):
         """(aflag, codes_dev, qc_dev) for one attr-plane query — THE
         shared member/range split (K-bucket vs [lo, hi] interval) used
@@ -2340,27 +2429,9 @@ class DeviceSegment:
         # attr plane: descs carry LITERALS (codes are segment-local); map
         # each to this segment's unified code space here — member: K-padded
         # qcode vectors (equality = K 1); range: [lo, hi] code intervals
-        is_attr = (
-            False if attr is None
-            else ("range" if attr_kind == "range" else True)
+        is_attr, codes_dev, qcodes_dev = self._attr_batch_vectors(
+            attr, attr_kind, descs, qpad
         )
-        codes_dev = self._attr_codes[attr][0] if is_attr else None
-        if is_attr == "range":
-            def qvec(payload):
-                return self.attr_qrange(attr, payload)
-        elif is_attr:
-            kk = _pow2_at_least(max(len(d[2]) for d in descs), 1)
-
-            def qvec(payload):
-                return self.attr_qcodes(attr, payload, kk)
-        if is_attr:
-            qcodes_np = np.stack(
-                [qvec(d[2]) for d in descs]
-                + [qvec(descs[-1][2])] * (qpad - q)
-            )
-            qcodes_dev = replicate(self.mesh, qcodes_np)
-        else:
-            qcodes_dev = None
         args = self._exact_args(
             boxes_dev, wins_dev, has_time, codes_dev, qcodes_dev
         )
@@ -2505,13 +2576,13 @@ class DeviceSegment:
         )
 
     def _dual_shard_batch(self, kind: str, has_time: bool, qpad: int,
-                          args) -> "_ShardBitmapBatch":
+                          args, attr=False) -> "_ShardBitmapBatch":
         """Shared shard-extract dispatch for the dual-plane batches
         ('xz' | 'poly'): per-shard windows + trace hook in one place."""
         span_cap = self.shard_span_cap()
         trace = _batch_trace(self, args, qpad, f"bitmap_shard_{kind}", 0)
         hdr, bits = _dual_shard_bitmap_batch_fn(
-            kind, has_time, span_cap, qpad, self.mesh
+            kind, has_time, span_cap, qpad, self.mesh, attr
         )(*args)
         if trace is not None:
             trace["out_bytes"] = int(hdr.nbytes) + int(bits.nbytes)
@@ -2598,25 +2669,40 @@ class DeviceSegment:
                 )
         return out
 
-    def _xz_args(self, qbox_dev, win_dev, has_time: bool) -> tuple:
-        """Extent exact-scan argument layout (single + batch + refetch).
-        Dummies stand in for the time columns when has_time is False (the
-        mask body ignores them; shard_map still needs row-sharded args)."""
+    def _xz_args(
+        self, qbox_dev, win_dev, has_time: bool,
+        codes_dev=None, qcode_dev=None,
+    ) -> tuple:
+        """Extent exact-scan argument layout (single + batch + refetch) —
+        must track _xz_arg_counts. Dummies stand in for the time columns
+        when has_time is False (the mask body ignores them; shard_map
+        still needs row-sharded args). ``codes_dev``/``qcode_dev`` add
+        the rank-code attribute plane."""
         valid = self.valid
         th = tl = self.xz_limbs[0]  # placeholder columns
         if has_time:
             th, tl = self.xz_tk
             if self.xz_tvalid is not None:
                 valid = self.xz_tvalid
-        return (*self.xz_limbs, self.xz_isrect, valid, th, tl, qbox_dev, win_dev)
+        base = (*self.xz_limbs, self.xz_isrect, valid, th, tl)
+        if codes_dev is not None:
+            base = base + (codes_dev,)
+        base = base + (qbox_dev, win_dev)
+        if qcode_dev is not None:
+            base = base + (qcode_dev,)
+        return base
 
     def dispatch_exact_xz_batch(
-        self, descs: Sequence[tuple], has_time: bool
+        self, descs: Sequence[tuple], has_time: bool,
+        attr: Optional[str] = None, attr_kind: str = "member",
     ) -> List["_PendingXZHits"]:
         """Q extent exact scans in ONE device execution (dual hit/decided
         planes per query; see _xz_exact_mask_body). ``descs`` =
-        [(qbox_np u32[12], win_np u32[4])]. GEOMESA_BATCH_PROTO selects
-        the wire format exactly like the point edition."""
+        [(qbox_np u32[12], win_np u32[4])] — or, with ``attr`` set,
+        [(qbox, win, payload)]: the attr test ANDs into the hit plane
+        (member literal tuples or range (op, literal) predicate tuples,
+        exactly the point edition's contract). GEOMESA_BATCH_PROTO
+        selects the wire format exactly like the point edition."""
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
         q = len(descs)
         proto = _batch_proto()
@@ -2624,38 +2710,54 @@ class DeviceSegment:
         qpad = (q + 3) // 4 * 4 if bitmap else _pow2_at_least(q, 4)
         boxes_np = np.stack([d[0] for d in descs] + [descs[-1][0]] * (qpad - q))
         wins_np = np.stack([d[1] for d in descs] + [descs[-1][1]] * (qpad - q))
+        is_attr, codes_dev, qcodes_dev = self._attr_batch_vectors(
+            attr, attr_kind, descs, qpad
+        )
         args = self._xz_args(
-            replicate(self.mesh, boxes_np), replicate(self.mesh, wins_np), has_time
+            replicate(self.mesh, boxes_np), replicate(self.mesh, wins_np),
+            has_time, codes_dev, qcodes_dev,
         )
         rcap = self._rcap
         shard_x = bitmap and _shard_extract_on(mode, self.mesh)
         if shard_x:
-            batch = self._dual_shard_batch("xz", has_time, qpad, args)
+            batch = self._dual_shard_batch(
+                "xz", has_time, qpad, args, attr=is_attr
+            )
         elif bitmap:
             span_cap = self.span_cap()
             hdr, bits = _xz_bitmap_batch_fn(
-                has_time, span_cap, qpad, mode, self.mesh
+                has_time, span_cap, qpad, mode, self.mesh, is_attr
             )(*args)
             _start_d2h(hdr, bits)
             batch = _BitmapBatch(hdr, bits, span_cap, seg=self)
         else:
-            buf = _xz_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
+            buf = _xz_runs_batch_fn(
+                has_time, rcap, qpad, mode, self.mesh, is_attr
+            )(*args)
             _start_d2h(buf)
             batch = _BatchRows(buf)
         out = []
-        for i, (qbox_np, win_np) in enumerate(descs):
-            def single_args(qbox_np=qbox_np, win_np=win_np):
+        for i, d in enumerate(descs):
+            qbox_np, win_np = d[0], d[1]
+            payload = d[2] if is_attr else None
+
+            def single_args(qbox_np=qbox_np, win_np=win_np, payload=payload):
+                _aflag, codes, qc = self._attr_plane_args(
+                    attr if is_attr else None,
+                    payload,
+                    "range" if is_attr == "range" else "member",
+                )
                 return self._xz_args(
                     replicate(self.mesh, qbox_np),
                     replicate(self.mesh, win_np),
-                    has_time,
+                    has_time, codes, qc,
                 )
 
             refetch = lambda rc, sa=single_args: _xz_runs_fn(  # noqa: E731
-                has_time, rc, mode, self.mesh
+                has_time, rc, mode, self.mesh, is_attr
             )(*sa())
             packed = lambda sa=single_args: _xz_packed_fn(  # noqa: E731
-                has_time, mode, self.mesh
+                has_time, mode, self.mesh, is_attr
             )(*sa())
             if shard_x:
                 out.append(
@@ -3706,7 +3808,7 @@ class TpuScanExecutor:
         )
 
     @staticmethod
-    def _xz_pred_shape(table: IndexTable, plan):
+    def _xz_pred_shape(table: IndexTable, plan, extra_match=None):
         """(geom, node, qenv, rect, t_lo, t_hi) when the FULL filter is
         exactly one spatial predicate on the default geometry of an
         xz2/xz3 plan — plus, for xz3, AND-combined temporal bounds on the
@@ -3716,8 +3818,12 @@ class TpuScanExecutor:
         Only a SINGLE spatial node qualifies: an AND of two bboxes is NOT
         equivalent to one test against their envelope intersection for
         extent features (a geometry can straddle both boxes yet miss the
-        intersection)."""
-        if table.index.name not in ("xz2", "xz3") or plan.secondary is not None:
+        intersection). ``extra_match`` may claim additional node shapes
+        (the attr plane's predicates) — the plan may then carry a
+        secondary (the attr residual the device decides instead)."""
+        if table.index.name not in ("xz2", "xz3"):
+            return None
+        if extra_match is None and plan.secondary is not None:
             return None
         f = plan.full_filter
         if f is None:
@@ -3732,7 +3838,7 @@ class TpuScanExecutor:
             if isinstance(node, (A.BBox, A.Intersects)) and node.prop == geom:
                 spatial.append(node)
                 return True
-            return False
+            return extra_match(node) if extra_match is not None else False
 
         ok, t_lo, t_hi = TpuScanExecutor._and_walk_temporal(ft, f, match)
         if not ok or len(spatial) != 1:
@@ -3914,20 +4020,33 @@ class TpuScanExecutor:
                 edges, box_np, win_np, has_time, geom, node = poly
                 key = (id(table), has_time)
                 if key not in poly_batchable:
-                    poly_batchable[key] = (table, has_time, [])
-                poly_batchable[key][2].append(
+                    poly_batchable[key] = (table, has_time, None, [])
+                poly_batchable[key][3].append(
                     (id(plan), plan, edges, box_np, win_np, geom, node)
                 )
                 continue
             xz = self._xz_batch_desc(table, plan)
             if xz is not None:
-                qbox, win, has_time, geom, node = xz
-                key = (id(table), has_time)
-                if key not in xz_batchable:
-                    xz_batchable[key] = (table, has_time, [])
-                xz_batchable[key][2].append(
-                    (id(plan), plan, qbox, win, geom, node)
-                )
+                qbox, win, has_time, geom, node, ainfo = xz
+                if ainfo is None:
+                    key = (id(table), has_time)
+                    if key not in xz_batchable:
+                        xz_batchable[key] = (table, has_time, None, [])
+                    xz_batchable[key][3].append(
+                        (id(plan), plan, qbox, win, geom, node)
+                    )
+                else:
+                    # attr edition: its own batch group (different
+                    # kernel); the payload rides in the desc slice
+                    attr, akind, payload = ainfo
+                    key = (id(table), has_time, attr, akind)
+                    if key not in xz_batchable:
+                        xz_batchable[key] = (
+                            table, has_time, (attr, akind), []
+                        )
+                    xz_batchable[key][3].append(
+                        (id(plan), plan, qbox, win, payload, geom, node)
+                    )
                 continue
             out[id(plan)] = self._dispatch_nonseek(table, plan, desc=None)
         for table, has_time, lst in batchable.values():
@@ -4019,21 +4138,32 @@ class TpuScanExecutor:
                         exact=True,
                     )
 
-        def xz_loaded(dev, table, has_time):
-            return all(seg.load_exact_xz(table) for seg in dev.segments) and not (
+        def xz_loaded(dev, table, has_time, extra):
+            ok = all(
+                seg.load_exact_xz(table) for seg in dev.segments
+            ) and not (
                 has_time and any(seg.xz_tk is None for seg in dev.segments)
             )
+            if ok and extra is not None:  # attr edition: codes too
+                ok = all(
+                    seg.load_attr_codes(extra[0]) for seg in dev.segments
+                )
+            return ok
 
         self._drain_dual_batches(
             out, xz_batchable, xz_loaded,
-            lambda seg, descs, ht: seg.dispatch_exact_xz_batch(descs, ht),
+            lambda seg, descs, ht, extra: seg.dispatch_exact_xz_batch(
+                descs, ht,
+                attr=None if extra is None else extra[0],
+                attr_kind="member" if extra is None else extra[1],
+            ),
         )
         self._drain_dual_batches(
             out, poly_batchable,
-            lambda dev, table, _ht: all(
+            lambda dev, table, _ht, _extra: all(
                 seg.load_poly(table) for seg in dev.segments
             ),
-            lambda seg, descs, ht: seg.dispatch_poly_batch(descs, ht),
+            lambda seg, descs, ht, _extra: seg.dispatch_poly_batch(descs, ht),
         )
         return out
 
@@ -4078,18 +4208,22 @@ class TpuScanExecutor:
 
     def _drain_dual_batches(self, out, groups, loaded, dispatch) -> None:
         """Shared drain for the dual-plane (hit/decided) batch groups
-        (extent envelopes, banded polygons): chunked batched dispatch per
-        segment resolving through _XZBatchScan. Group items are
-        ``(plan_id, plan, *desc_parts, geom, node)``. Lone queries route
-        to the single-query path BEFORE any device column upload; these
-        plans provably have no exact point descriptor (that's why they
-        took a dual-plane branch), so nonseek gets desc=None."""
-        for table, has_time, lst in groups.values():
+        (extent envelopes — plain and attr editions — and banded
+        polygons): chunked batched dispatch per segment resolving
+        through _XZBatchScan. Group values are ``(table, has_time,
+        extra, items)`` where ``extra`` threads group-level context
+        ((attr, kind) for the attr edition, None otherwise) into
+        ``loaded`` and ``dispatch``; items are ``(plan_id, plan,
+        *desc_parts, geom, node)``. Lone queries route to the
+        single-query path BEFORE any device column upload; these plans
+        provably have no exact point descriptor (that's why they took a
+        dual-plane branch), so nonseek gets desc=None."""
+        for table, has_time, extra, lst in groups.values():
             dev = self.device_index(table)
             ok = (
                 len(lst) > 1
                 and bool(dev.segments)
-                and loaded(dev, table, has_time)
+                and loaded(dev, table, has_time, extra)
             )
             if not ok:
                 for pid, plan, *_rest in lst:
@@ -4103,7 +4237,8 @@ class TpuScanExecutor:
                     continue
                 descs = [tuple(item[2:-2]) for item in chunk]
                 per_seg = [
-                    dispatch(seg, descs, has_time) for seg in dev.segments
+                    dispatch(seg, descs, has_time, extra)
+                    for seg in dev.segments
                 ]
                 for qi, item in enumerate(chunk):
                     pid, geom, node = item[0], item[-2], item[-1]
@@ -4190,19 +4325,29 @@ class TpuScanExecutor:
         return edges, box_np, win_np, has_time, geom, node
 
     def _xz_batch_desc(self, table: IndexTable, plan: QueryPlan):
-        """(qbox u32[12], win u32[4], has_time, geom, node) when this
-        extent plan's full filter reduces to one spatial predicate
-        (+ xz3 time bounds) — the batched extent scan's descriptor; None
-        otherwise. qbox = envelope + placeholder-zero sort-key limbs +
-        a rect flag (see _xz_exact_mask_body)."""
+        """(qbox u32[12], win u32[4], has_time, geom, node, attr_info)
+        when this extent plan's full filter reduces to one spatial
+        predicate (+ xz3 time bounds), optionally AND attr predicates on
+        ONE eligible attribute — the batched extent scan's descriptor;
+        None otherwise. attr_info is None (plain) or (attr, kind,
+        payload) per the _attr_pred_collector contract: the rank-code
+        test ANDs into the device hit plane, so decided rows are final
+        for spatial-AND-attr and the ring needs only the host geometry
+        test. qbox = envelope + placeholder-zero sort-key limbs + a rect
+        flag (see _xz_exact_mask_body)."""
         if table.index.name not in ("xz2", "xz3"):
             return None
         shape = self._xz_pred_shape(table, plan)
+        attr_info = None
         if shape is None:
-            return None
+            match_attr, finalize = self._attr_pred_collector(table.ft)
+            shape = self._xz_pred_shape(table, plan, extra_match=match_attr)
+            attr_info = finalize()
+            if shape is None or attr_info is None:
+                return None
         geom, node, qenv, rect, t_lo, t_hi = shape
         qbox, win, has_time = _xz_query_limbs(qenv, rect, t_lo, t_hi)
-        return qbox, win, has_time, geom, node
+        return qbox, win, has_time, geom, node, attr_info
 
     @staticmethod
     def _box_window_shape(ft, f):
@@ -4368,6 +4513,25 @@ class TpuScanExecutor:
         ft = table.ft
         if ft.default_geometry is None or not ft.is_points:
             return None
+        match_attr, finalize = self._attr_pred_collector(ft)
+        got = self._walk_boxes(ft, plan.full_filter, extra_match=match_attr)
+        found = finalize()
+        if got is None or found is None:
+            return None
+        attr, kind, payload = found
+        (xmin, ymin, xmax, ymax), t_lo, t_hi = got
+        if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
+            return None
+        limbs = self._shape_limbs((xmin, ymin, xmax, ymax, t_lo, t_hi))
+        return attr, kind, (limbs[0], limbs[1], payload)
+
+    @staticmethod
+    def _attr_pred_collector(ft):
+        """(match, finalize) pair — THE shared attr-predicate recognizer
+        for the device attr planes (point boxes AND extent envelopes).
+        ``match(node)`` claims eligible predicates during an AND-walk;
+        ``finalize()`` returns None or (attr, kind, payload) per the
+        _attr_batch_desc contract (kind "member" | "range")."""
         from geomesa_tpu.filter import ast as A
         from geomesa_tpu.filter.evaluate import _coerce
         from geomesa_tpu.schema.featuretype import AttributeType
@@ -4471,29 +4635,26 @@ class TpuScanExecutor:
                 return True
             return False
 
-        got = self._walk_boxes(ft, plan.full_filter, extra_match=match_attr)
-        if got is None or not (inlists or ranges):
-            return None
-        props = {p for p, *_ in inlists} | {p for p, *_ in ranges}
-        if len(props) != 1:
-            return None  # one device codes column per batch
-        if inlists and (ranges or len(inlists) > 1):
-            return None  # IN combined with other preds: host post-filter
-        (xmin, ymin, xmax, ymax), t_lo, t_hi = got
-        if (t_lo is not None or t_hi is not None) and table.index.name != "z3":
-            return None
-        limbs = self._shape_limbs((xmin, ymin, xmax, ymax, t_lo, t_hi))
-        attr = props.pop()
-        if inlists:
-            return attr, "member", (limbs[0], limbs[1], inlists[0][1])
-        if len(ranges) == 1 and ranges[0][1] == "=":
-            # a lone equality rides the membership edition (shares the
-            # K=1 kernel with equality batches already in flight)
-            return attr, "member", (limbs[0], limbs[1], (ranges[0][2],))
-        # AND of order predicates (any mix, incl. repeated '='):
-        # intersected per segment in code space
-        payload = tuple((op, lit) for _p, op, lit in ranges)
-        return attr, "range", (limbs[0], limbs[1], payload)
+        def finalize():
+            if not (inlists or ranges):
+                return None
+            props = {p for p, *_ in inlists} | {p for p, *_ in ranges}
+            if len(props) != 1:
+                return None  # one device codes column per batch
+            if inlists and (ranges or len(inlists) > 1):
+                return None  # IN combined with other preds: host path
+            attr = props.pop()
+            if inlists:
+                return attr, "member", inlists[0][1]
+            if len(ranges) == 1 and ranges[0][1] == "=":
+                # a lone equality rides the membership edition (shares
+                # the K=1 kernel with equality batches already in flight)
+                return attr, "member", (ranges[0][2],)
+            # AND of order predicates (any mix, incl. repeated '='):
+            # intersected per segment in code space
+            return attr, "range", tuple((op, lit) for _p, op, lit in ranges)
+
+        return match_attr, finalize
 
     def _query_descriptor(self, table: IndexTable, plan: QueryPlan):
         """(boxes, windows) device-replicated arrays for this plan."""

@@ -206,13 +206,17 @@ def one_extent_round(seed: int) -> int:
             else:
                 g = None
             t = None if i % 41 == 0 else int(base + rng.integers(0, 15 * 86400_000))
-            rows.append((f"e{i}", t, g))
+            cat = None if i % 23 == 0 else f"cat-{int(rng.integers(0, 5))}"
+            rows.append((f"e{i}", t, cat, g))
         for s in (host, tpu):
-            s.create_schema(parse_spec("e", "dtg:Date,*geom:Geometry:srid=4326"))
+            s.create_schema(
+                parse_spec("e", "dtg:Date,cat:String,*geom:Geometry:srid=4326")
+            )
             with s.writer("e") as w:
-                for fid, t, g in rows:
-                    w.write([t, g], fid=fid)
+                for fid, t, cat, g in rows:
+                    w.write([t, cat, g], fid=fid)
         checked = 0
+        queries = []
         for _ in range(10):
             x0 = float(rng.uniform(-60, 30))
             y0 = float(rng.uniform(-40, 20))
@@ -229,10 +233,28 @@ def one_extent_round(seed: int) -> int:
                     f"INTERSECTS(geom, POLYGON(({x0} {y0}, {x0+w_} {y0}, "
                     f"{x0+w_/2} {y0+w_}, {x0} {y0})))"
                 ] + parts[1:]
+            if rng.random() < 0.4:
+                # xz attr plane shapes: member / range fused into the
+                # dual hit/decided planes (batched via query_many below)
+                parts.append(
+                    rng.choice([
+                        f"cat = 'cat-{int(rng.integers(0, 5))}'",
+                        "cat >= 'cat-1' AND cat < 'cat-4'",
+                        "cat IN ('cat-0', 'cat-2')",
+                        "cat IS NOT NULL",
+                    ])
+                )
             q = " AND ".join(parts)
+            queries.append(q)
             got = sorted(map(str, tpu.query("e", q).fids))
             want = sorted(map(str, host.query("e", q).fids))
             assert got == want, ("extent", seed, mode, q)
+            checked += 1
+        # query_many: the batched dual-plane dispatch (incl. the attr
+        # editions when >= 2 shapes share a group) must match singles
+        for q, r in zip(queries, tpu.query_many("e", queries)):
+            want = sorted(map(str, host.query("e", q).fids))
+            assert sorted(map(str, r.fids)) == want, ("extent-many", seed, mode, q)
             checked += 1
         dead = [f"e{i}" for i in range(0, n, 7)]
         for s in (host, tpu):
