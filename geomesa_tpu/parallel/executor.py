@@ -316,12 +316,8 @@ def _exact_mask_body(has_time: bool, mode: str, mesh, attr=False):
     editions are distinct cache-key values of ``attr``."""
     from geomesa_tpu.ops.filters import exact_st_mask
 
-    if attr == "range":
-        def combine(m, codes, qcode):
-            return m & (codes >= qcode[0]) & (codes <= qcode[1])
-    elif attr:
-        def combine(m, codes, qcode):
-            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
+    if attr:
+        combine = _attr_combine(attr)
     if has_time and attr:
         def body(xh, xl, yh, yl, th, tl, valid, codes, box, win, qcode):
             m = exact_st_mask(xh, xl, yh, yl, valid, box, th, tl, win)
@@ -348,6 +344,24 @@ def _exact_mask_body(has_time: bool, mode: str, mesh, attr=False):
         out_specs=P(DATA_AXIS),
         check=False,
     )
+
+
+def _attr_combine(attr):
+    """The attr-plane combinator shared by ALL mask bodies (point box,
+    extent envelope, polygon ray cast — one home so the planes can never
+    diverge). attr True = membership against a (K,) qcode vector;
+    "range" = one inclusive [lo, hi] interval. Value predicates clamp
+    lo >= 0 host-side so nulls (-1) stay out, but IS NULL is the
+    deliberate interval [-1, -1] — do NOT add a codes >= 0 guard here
+    (pad rows also rank -1 and are excluded by the valid mask inside
+    the base masks, not by this combine)."""
+    if attr == "range":
+        def combine(m, codes, qcode):
+            return m & (codes >= qcode[0]) & (codes <= qcode[1])
+    else:
+        def combine(m, codes, qcode):
+            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
+    return combine
 
 
 def _exact_arg_counts(has_time: bool, attr) -> Tuple[int, int]:
@@ -1067,12 +1081,8 @@ def _xz_exact_mask_body(has_time: bool, mode: str, mesh, attr=False):
     (hi, lo) limbs + [rect_flag, 0]."""
     from geomesa_tpu.ops.zkernels import limbs_in_range, limbs_leq
 
-    if attr == "range":
-        def acomb(m, codes, qcode):
-            return m & (codes >= qcode[0]) & (codes <= qcode[1])
-    elif attr:
-        def acomb(m, codes, qcode):
-            return m & (codes[:, None] == qcode[None, :]).any(axis=-1)
+    if attr:
+        acomb = _attr_combine(attr)
 
     def parts(
         bxmin_h, bxmin_l, bymin_h, bymin_l, bxmax_h, bxmax_l,
@@ -1222,8 +1232,8 @@ def _dual_shard_bitmap_batch_fn(kind: str, has_time: bool, span_cap: int,
     local mask AND the dual span framing run INSIDE shard_map, each chip
     framing its LOCAL hit/decided windows; the host stitches shard rows
     with offsets (see _exact_shard_bitmap_batch_fn — same shape, two
-    planes per window). ``attr`` (xz only) threads the rank-code
-    attribute plane through the local mask."""
+    planes per window). ``attr`` threads the rank-code attribute plane
+    through the local mask (both kinds)."""
     key = (kind, has_time, span_cap, q, mesh, attr)
     fn = _DUAL_SHARD_BITMAP_FNS.get(key)
     if fn is None:
@@ -1236,16 +1246,11 @@ def _dual_shard_bitmap_batch_fn(kind: str, has_time: bool, span_cap: int,
             def split(args):
                 return _xz_desc_split(local, attr, args)
         else:
-            assert not attr, "attr plane is xz-only in the dual kernels"
-            local = _poly_mask_body(has_time, "local", mesh)
-            nrow, nrep = (9 if has_time else 7), 3
+            local = _poly_mask_body(has_time, "local", mesh, attr)
+            nrow, nrep = _poly_arg_counts(has_time, attr)
 
             def split(args):
-                *cols, edges, boxes, wins = args
-                return (
-                    lambda d: local(*cols, d[0], d[1], d[2]),
-                    (edges, boxes, wins),
-                )
+                return _poly_desc_split(local, attr, args)
 
         def shard_body(*args):
             mask_of, descs = split(args)
@@ -1365,7 +1370,17 @@ POLY_EPS = 1e-4
 POLY_XINT_K = 16.0
 
 
-def _poly_mask_body(has_time: bool, mode: str, mesh):
+def _poly_arg_counts(has_time: bool, attr) -> Tuple[int, int]:
+    """(row-sharded, replicated) arg counts of the polygon mask layouts —
+    THE single table for _poly_mask_body's shard specs, the dual
+    shard-extract kernels, and DeviceSegment._poly_args."""
+    nrow = 9 if has_time else 7
+    if attr:
+        return nrow + 1, 4  # + codes column / + qcode vector
+    return nrow, 3
+
+
+def _poly_mask_body(has_time: bool, mode: str, mesh, attr=False):
     """Unjitted banded point-in-polygon mask: (hit, decided) over ALL rows.
 
     The device analog of the host's exact geometry post-filter for
@@ -1375,8 +1390,16 @@ def _poly_mask_body(has_time: bool, mode: str, mesh):
     over the polygon's edges (lax.scan; streaming, no gathers). Crossing
     parity decides in/out; rows inside the error band stay hit-but-
     undecided and the host certifies them — identical results to the host
-    path by construction, device work O(N * edges) streaming."""
+    path by construction, device work O(N * edges) streaming.
+
+    ``attr`` threads the rank-code attribute plane (True = membership,
+    "range" = [lo, hi] interval): the attr test ANDs into ``hit`` before
+    ``decided`` derives, so the band ring only carries attr-passing rows
+    (the host certification needs no attr re-check)."""
     from geomesa_tpu.ops.filters import exact_st_mask
+
+    if attr:
+        acomb = _attr_combine(attr)
 
     def core(xh, xl, yh, yl, th, tl, valid, xf, yf, edges, box, win):
         if has_time:
@@ -1414,30 +1437,62 @@ def _poly_mask_body(has_time: bool, mode: str, mesh):
         )
         odd = (crossings & 1) == 1
         hit = env & (odd | band)
-        decided = hit & ~band
-        return hit, decided
+        return hit, band
 
-    if has_time:
+    def finish(hit, band, codes=None, qcode=None):
+        if attr:
+            hit = acomb(hit, codes, qcode)
+        return hit, hit & ~band
+
+    if has_time and attr:
+        def body(xh, xl, yh, yl, th, tl, valid, xf, yf, codes,
+                 edges, box, win, qcode):
+            hit, band = core(xh, xl, yh, yl, th, tl, valid, xf, yf,
+                             edges, box, win)
+            return finish(hit, band, codes, qcode)
+    elif has_time:
         def body(xh, xl, yh, yl, th, tl, valid, xf, yf, edges, box, win):
-            return core(xh, xl, yh, yl, th, tl, valid, xf, yf, edges, box, win)
-        nrow = 9
-    else:
+            hit, band = core(xh, xl, yh, yl, th, tl, valid, xf, yf,
+                             edges, box, win)
+            return finish(hit, band)
+    elif attr:
         # the dummy window rides along unused so every caller (single,
         # batch, escalation refetch) shares ONE argument layout
+        def body(xh, xl, yh, yl, valid, xf, yf, codes, edges, box, win,
+                 qcode):
+            hit, band = core(xh, xl, yh, yl, None, None, valid, xf, yf,
+                             edges, box, None)
+            return finish(hit, band, codes, qcode)
+    else:
         def body(xh, xl, yh, yl, valid, xf, yf, edges, box, win):
-            return core(xh, xl, yh, yl, None, None, valid, xf, yf, edges, box, None)
-        nrow = 7
+            hit, band = core(xh, xl, yh, yl, None, None, valid, xf, yf,
+                             edges, box, None)
+            return finish(hit, band)
     if mode != "spmd":
         return body
     from jax.sharding import PartitionSpec as P
 
+    nrow, nrep = _poly_arg_counts(has_time, attr)
     return shard_map_fn(
         body,
         mesh,
-        in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * 3),
+        in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * nrep),
         out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
         check=False,
     )
+
+
+def _poly_desc_split(mask, attr, args):
+    """Shared arg split for the polygon batch builders: (mask_of(desc),
+    stacked desc arrays for lax.scan)."""
+    if attr:
+        *cols, edges, boxes, wins, qcodes = args
+        return (
+            lambda d: mask(*cols, d[0], d[1], d[2], d[3]),
+            (edges, boxes, wins, qcodes),
+        )
+    *cols, edges, boxes, wins = args
+    return (lambda d: mask(*cols, d[0], d[1], d[2])), (edges, boxes, wins)
 
 
 _POLY_RUNS_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
@@ -1446,12 +1501,12 @@ _POLY_BITMAP_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 _POLY_PACKED_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
-def _poly_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
+def _poly_runs_fn(has_time: bool, rcap: int, mode: str, mesh, attr=False):
     """Single polygon query -> dual fused RLE buffer (xz layout)."""
-    key = (has_time, rcap, mode, mesh)
+    key = (has_time, rcap, mode, mesh, attr)
     fn = _POLY_RUNS_FNS.get(key)
     if fn is None:
-        mask = _poly_mask_body(has_time, mode, mesh)
+        mask = _poly_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
@@ -1463,22 +1518,23 @@ def _poly_runs_fn(has_time: bool, rcap: int, mode: str, mesh):
     return fn
 
 
-def _poly_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
+def _poly_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
+                        attr=False):
     """Q polygon queries in ONE execution -> [q, 2 x (2 + 2*rcap)]."""
-    key = (has_time, rcap, q, mode, mesh)
+    key = (has_time, rcap, q, mode, mesh, attr)
     fn = _POLY_RUNS_BATCH_FNS.get(key)
     if fn is None:
-        mask = _poly_mask_body(has_time, mode, mesh)
+        mask = _poly_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
-            *cols, edges, boxes, wins = args
+            mask_of, descs = _poly_desc_split(mask, attr, args)
 
             def step(carry, d):
-                hit, dec = mask(*cols, d[0], d[1], d[2])
+                hit, dec = mask_of(d)
                 return carry, _xz_dual_runs(hit, dec, rcap)
 
-            _, out = jax.lax.scan(step, 0, (edges, boxes, wins))
+            _, out = jax.lax.scan(step, 0, descs)
             return out
 
         fn = jax.jit(run)
@@ -1486,13 +1542,13 @@ def _poly_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh):
     return fn
 
 
-def _poly_packed_fn(has_time: bool, mode: str, mesh):
+def _poly_packed_fn(has_time: bool, mode: str, mesh, attr=False):
     """Dual full packed bitmaps (hit | decided) for one polygon query —
     the dense-result degrade mirror of _xz_packed_fn."""
-    key = (has_time, mode, mesh)
+    key = (has_time, mode, mesh, attr)
     fn = _POLY_PACKED_FNS.get(key)
     if fn is None:
-        mask = _poly_mask_body(has_time, mode, mesh)
+        mask = _poly_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
@@ -1505,23 +1561,23 @@ def _poly_packed_fn(has_time: bool, mode: str, mesh):
 
 
 def _poly_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
-                          mesh):
+                          mesh, attr=False):
     """Polygon edition of _xz_bitmap_batch_fn: headers i32[q,4] +
     bitmaps u8[q, 2*span_cap//8] (hit | decided planes)."""
-    key = (has_time, span_cap, q, mode, mesh)
+    key = (has_time, span_cap, q, mode, mesh, attr)
     fn = _POLY_BITMAP_BATCH_FNS.get(key)
     if fn is None:
-        mask = _poly_mask_body(has_time, mode, mesh)
+        mask = _poly_mask_body(has_time, mode, mesh, attr)
         mask = _gathered(mask, mesh)
 
         def run(*args):
-            *cols, edges, boxes, wins = args
+            mask_of, descs = _poly_desc_split(mask, attr, args)
 
             def step(carry, d):
-                hit, dec = mask(*cols, d[0], d[1], d[2])
+                hit, dec = mask_of(d)
                 return carry, _dual_bitmap_row(hit, dec, span_cap)
 
-            _, (headers, bitmaps) = jax.lax.scan(step, 0, (edges, boxes, wins))
+            _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
 
         fn = jax.jit(run)
@@ -2302,12 +2358,12 @@ class DeviceSegment:
             )(*args),
         )
 
-    def _attr_batch_vectors(self, attr, attr_kind, descs, qpad):
-        """(is_attr, codes_dev, qcodes_dev) for a batch whose descs carry
-        payloads at index 2 — the BATCH edition of _attr_plane_args (one
-        home for the K-bucket vs [lo, hi] split across the point and
-        extent dispatchers, so the two can never diverge). Pad entries
-        repeat the last desc's vector."""
+    def _attr_batch_vectors(self, attr, attr_kind, payloads, qpad):
+        """(is_attr, codes_dev, qcodes_dev) for one batch's attr-plane
+        payload list — the BATCH edition of _attr_plane_args (one home
+        for the K-bucket vs [lo, hi] split across the point, extent, and
+        polygon dispatchers, so they can never diverge). Pad entries
+        repeat the last payload's vector."""
         is_attr = (
             False if attr is None
             else ("range" if attr_kind == "range" else True)
@@ -2319,13 +2375,13 @@ class DeviceSegment:
             def qvec(payload):
                 return self.attr_qrange(attr, payload)
         else:
-            kk = _pow2_at_least(max(len(d[2]) for d in descs), 1)
+            kk = _pow2_at_least(max(len(p) for p in payloads), 1)
 
             def qvec(payload):
                 return self.attr_qcodes(attr, payload, kk)
-        q = len(descs)
+        q = len(payloads)
         qcodes_np = np.stack(
-            [qvec(d[2]) for d in descs] + [qvec(descs[-1][2])] * (qpad - q)
+            [qvec(p) for p in payloads] + [qvec(payloads[-1])] * (qpad - q)
         )
         return is_attr, codes_dev, replicate(self.mesh, qcodes_np)
 
@@ -2430,7 +2486,8 @@ class DeviceSegment:
         # each to this segment's unified code space here — member: K-padded
         # qcode vectors (equality = K 1); range: [lo, hi] code intervals
         is_attr, codes_dev, qcodes_dev = self._attr_batch_vectors(
-            attr, attr_kind, descs, qpad
+            attr, attr_kind,
+            [d[2] for d in descs] if attr is not None else None, qpad,
         )
         args = self._exact_args(
             boxes_dev, wins_dev, has_time, codes_dev, qcodes_dev
@@ -2561,19 +2618,30 @@ class DeviceSegment:
             self.load_raw(table)
         return self.xf is not None
 
-    def _poly_args(self, edges_dev, box_dev, win_dev, has_time: bool) -> tuple:
-        """Polygon-scan argument layout (single + batch + refetch). A
-        dummy window rides along when has_time is False (ignored)."""
+    def _poly_args(
+        self, edges_dev, box_dev, win_dev, has_time: bool,
+        codes_dev=None, qcode_dev=None,
+    ) -> tuple:
+        """Polygon-scan argument layout (single + batch + refetch) —
+        must track _poly_arg_counts. A dummy window rides along when
+        has_time is False (ignored). ``codes_dev``/``qcode_dev`` add the
+        rank-code attribute plane."""
         if has_time:
-            return (
+            base = (
                 self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo,
                 self.tk_hi, self.tk_lo, self.tvalid, self.xf, self.yf,
-                edges_dev, box_dev, win_dev,
             )
-        return (
-            self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo, self.valid,
-            self.xf, self.yf, edges_dev, box_dev, win_dev,
-        )
+        else:
+            base = (
+                self.xk_hi, self.xk_lo, self.yk_hi, self.yk_lo,
+                self.valid, self.xf, self.yf,
+            )
+        if codes_dev is not None:
+            base = base + (codes_dev,)
+        base = base + (edges_dev, box_dev, win_dev)
+        if qcode_dev is not None:
+            base = base + (qcode_dev,)
+        return base
 
     def _dual_shard_batch(self, kind: str, has_time: bool, qpad: int,
                           args, attr=False) -> "_ShardBitmapBatch":
@@ -2593,12 +2661,16 @@ class DeviceSegment:
         )
 
     def dispatch_poly_batch(
-        self, descs: Sequence[tuple], has_time: bool
+        self, descs: Sequence[tuple], has_time: bool,
+        attr: Optional[str] = None, attr_kind: str = "member",
     ) -> list:
         """Q banded polygon scans in ONE device execution (dual
         hit/decided planes, xz resolve contract). ``descs`` =
-        [(edges f32[E,4], box u32[8], win u32[4]|None)]; edge counts pad
-        to the batch's shared pow2 bucket with degenerate zero edges."""
+        [(edges f32[E,4], box u32[8], win u32[4]|None)] — or, with
+        ``attr`` set, [(edges, box, win, payload)]: the rank-code attr
+        test ANDs into the hit plane (point-edition contract). Edge
+        counts pad to the batch's shared pow2 bucket with degenerate
+        zero edges."""
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
         q = len(descs)
         proto = _batch_proto()
@@ -2617,30 +2689,47 @@ class DeviceSegment:
         wins_np = np.stack(
             [d[2] if d[2] is not None else np.zeros(4, np.uint32) for d in padded]
         )
+        is_attr, codes_dev, qcodes_dev = self._attr_batch_vectors(
+            attr, attr_kind,
+            [d[3] for d in descs] if attr is not None else None, qpad,
+        )
         args = self._poly_args(
             replicate(self.mesh, edges_np),
             replicate(self.mesh, boxes_np),
             replicate(self.mesh, wins_np),
-            has_time,
+            has_time, codes_dev, qcodes_dev,
         )
         rcap = self._rcap
         shard_x = bitmap and _shard_extract_on(mode, self.mesh)
         if shard_x:
-            batch = self._dual_shard_batch("poly", has_time, qpad, args)
+            batch = self._dual_shard_batch(
+                "poly", has_time, qpad, args, attr=is_attr
+            )
         elif bitmap:
             span_cap = self.span_cap()
             hdr, bits = _poly_bitmap_batch_fn(
-                has_time, span_cap, qpad, mode, self.mesh
+                has_time, span_cap, qpad, mode, self.mesh, is_attr
             )(*args)
             _start_d2h(hdr, bits)
             batch = _BitmapBatch(hdr, bits, span_cap, seg=self)
         else:
-            buf = _poly_runs_batch_fn(has_time, rcap, qpad, mode, self.mesh)(*args)
+            buf = _poly_runs_batch_fn(
+                has_time, rcap, qpad, mode, self.mesh, is_attr
+            )(*args)
             _start_d2h(buf)
             batch = _BatchRows(buf)
         out = []
-        for i, (edges, box_np, win_np) in enumerate(descs):
-            def single_args(edges=edges, box_np=box_np, win_np=win_np):
+        for i, d in enumerate(descs):
+            edges, box_np, win_np = d[0], d[1], d[2]
+            payload = d[3] if is_attr else None
+
+            def single_args(edges=edges, box_np=box_np, win_np=win_np,
+                            payload=payload):
+                _aflag, codes, qc = self._attr_plane_args(
+                    attr if is_attr else None,
+                    payload,
+                    "range" if is_attr == "range" else "member",
+                )
                 return self._poly_args(
                     replicate(self.mesh, pad_edges(edges)),
                     replicate(self.mesh, box_np),
@@ -2648,14 +2737,14 @@ class DeviceSegment:
                         self.mesh,
                         win_np if win_np is not None else np.zeros(4, np.uint32),
                     ),
-                    has_time,
+                    has_time, codes, qc,
                 )
 
             refetch = lambda rc, sa=single_args: _poly_runs_fn(  # noqa: E731
-                has_time, rc, mode, self.mesh
+                has_time, rc, mode, self.mesh, is_attr
             )(*sa())
             packed = lambda sa=single_args: _poly_packed_fn(  # noqa: E731
-                has_time, mode, self.mesh
+                has_time, mode, self.mesh, is_attr
             )(*sa())
             if shard_x:
                 out.append(
@@ -2711,7 +2800,8 @@ class DeviceSegment:
         boxes_np = np.stack([d[0] for d in descs] + [descs[-1][0]] * (qpad - q))
         wins_np = np.stack([d[1] for d in descs] + [descs[-1][1]] * (qpad - q))
         is_attr, codes_dev, qcodes_dev = self._attr_batch_vectors(
-            attr, attr_kind, descs, qpad
+            attr, attr_kind,
+            [d[2] for d in descs] if attr is not None else None, qpad,
         )
         args = self._xz_args(
             replicate(self.mesh, boxes_np), replicate(self.mesh, wins_np),
@@ -4017,13 +4107,25 @@ class TpuScanExecutor:
                 continue
             poly = self._poly_batch_desc(table, plan)
             if poly is not None:
-                edges, box_np, win_np, has_time, geom, node = poly
-                key = (id(table), has_time)
-                if key not in poly_batchable:
-                    poly_batchable[key] = (table, has_time, None, [])
-                poly_batchable[key][3].append(
-                    (id(plan), plan, edges, box_np, win_np, geom, node)
-                )
+                edges, box_np, win_np, has_time, geom, node, ainfo = poly
+                if ainfo is None:
+                    key = (id(table), has_time)
+                    if key not in poly_batchable:
+                        poly_batchable[key] = (table, has_time, None, [])
+                    poly_batchable[key][3].append(
+                        (id(plan), plan, edges, box_np, win_np, geom, node)
+                    )
+                else:
+                    attr, akind, payload = ainfo
+                    key = (id(table), has_time, attr, akind)
+                    if key not in poly_batchable:
+                        poly_batchable[key] = (
+                            table, has_time, (attr, akind), []
+                        )
+                    poly_batchable[key][3].append(
+                        (id(plan), plan, edges, box_np, win_np, payload,
+                         geom, node)
+                    )
                 continue
             xz = self._xz_batch_desc(table, plan)
             if xz is not None:
@@ -4158,12 +4260,21 @@ class TpuScanExecutor:
                 attr_kind="member" if extra is None else extra[1],
             ),
         )
+        def poly_loaded(dev, table, _ht, extra):
+            ok = all(seg.load_poly(table) for seg in dev.segments)
+            if ok and extra is not None:  # attr edition: codes too
+                ok = all(
+                    seg.load_attr_codes(extra[0]) for seg in dev.segments
+                )
+            return ok
+
         self._drain_dual_batches(
-            out, poly_batchable,
-            lambda dev, table, _ht, _extra: all(
-                seg.load_poly(table) for seg in dev.segments
+            out, poly_batchable, poly_loaded,
+            lambda seg, descs, ht, extra: seg.dispatch_poly_batch(
+                descs, ht,
+                attr=None if extra is None else extra[0],
+                attr_kind="member" if extra is None else extra[1],
             ),
-            lambda seg, descs, ht, _extra: seg.dispatch_poly_batch(descs, ht),
         )
         return out
 
@@ -4253,14 +4364,17 @@ class TpuScanExecutor:
 
     def _poly_batch_desc(self, table: IndexTable, plan: QueryPlan):
         """(edges f32[E,4], box u32[8], win u32[4]|None, has_time, geom,
-        node) when this point z-index plan's FULL filter is one non-rect
-        INTERSECTS(polygon) on the default geometry (+ z3 temporal
-        bounds) — the banded-raycast batch descriptor; None otherwise.
-        Same GEOMESA_EXACT_DEVICE gate as the box path (the kernel rides
-        the exact limb columns)."""
+        node, attr_info) when this point z-index plan's FULL filter is
+        one non-rect INTERSECTS(polygon) on the default geometry (+ z3
+        temporal bounds), optionally AND attr predicates on ONE eligible
+        attribute (attr_info per the _attr_pred_collector contract; the
+        rank-code test ANDs into the hit plane so the band ring only
+        carries attr-passing rows) — the banded-raycast batch
+        descriptor; None otherwise. Same GEOMESA_EXACT_DEVICE gate as
+        the box path (the kernel rides the exact limb columns)."""
         if not self._exact_device_enabled():
             return None
-        if table.index.name not in ("z2", "z3") or plan.secondary is not None:
+        if table.index.name not in ("z2", "z3"):
             return None
         ft = table.ft
         if ft.default_geometry is None or not ft.is_points:
@@ -4280,9 +4394,15 @@ class TpuScanExecutor:
                 return True
             return False
 
-        ok, t_lo, t_hi = self._and_walk_temporal(ft, f, match)
+        match_attr, finalize = self._attr_pred_collector(ft)
+        ok, t_lo, t_hi = self._and_walk_temporal(
+            ft, f, lambda n: match(n) or match_attr(n)
+        )
+        attr_info = finalize()
         if not ok or len(spatial) != 1:
             return None
+        if attr_info is None and plan.secondary is not None:
+            return None  # residual present but not a claimable attr set
         has_time = t_lo is not None or t_hi is not None
         if has_time and table.index.name != "z3":
             return None
@@ -4322,7 +4442,7 @@ class TpuScanExecutor:
         box_np, win_np = self._shape_limbs(
             (e.xmin, e.ymin, e.xmax, e.ymax, t_lo, t_hi)
         )
-        return edges, box_np, win_np, has_time, geom, node
+        return edges, box_np, win_np, has_time, geom, node, attr_info
 
     def _xz_batch_desc(self, table: IndexTable, plan: QueryPlan):
         """(qbox u32[12], win u32[4], has_time, geom, node, attr_info)

@@ -189,3 +189,96 @@ def test_xz_attr_after_delete_and_fallbacks():
         f"kind = 'c1' AND size > 2.0 AND {BOX2}",  # two attrs
         f"kind LIKE '%2' AND {BOX2}",  # non-prefix LIKE
     ])
+
+
+# -- polygon ray-cast edition (point schemas) --------------------------------
+
+from geomesa_tpu.geom.base import Point  # noqa: E402
+
+PT_SPEC = "dtg:Date,kind:String,score:Int,*geom:Point:srid=4326"
+
+
+def _point_stores(n=20_000, seed=61):
+    rng = np.random.default_rng(seed)
+    # rows precomputed ONCE — generating inside the store loop would give
+    # host and tpu different data (the rng state advances)
+    rows = [
+        [
+            int(BASE + rng.integers(0, 15 * 86400_000)),
+            None if i % 17 == 0 else f"k{rng.integers(0, 5)}",
+            None if i % 19 == 0 else int(rng.integers(0, 40)),
+            Point(float(rng.uniform(-170, 170)),
+                  float(rng.uniform(-80, 80))),
+        ]
+        for i in range(n)
+    ]
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    for s in (host, tpu):
+        s.create_schema(parse_spec("p", PT_SPEC))
+        with s.writer("p") as w:
+            for i, r in enumerate(rows):
+                w.write(r, fid=f"p{i}")
+    return host, tpu
+
+
+def _pparity(host, tpu, cqls):
+    got = tpu.query_many("p", cqls)
+    for cql, res in zip(cqls, got):
+        want = sorted(map(str, host.query("p", cql).fids))
+        assert sorted(map(str, res.fids)) == want, cql
+    return got
+
+
+TRI = "POLYGON ((-40 -40, 30 -35, 10 30, -35 20, -40 -40))"
+TRI2 = "POLYGON ((-15 -50, 50 -40, 25 15, -15 -50))"
+
+
+@pytest.mark.parametrize("proto", ["bitmap", "runs_packed"])
+def test_poly_attr_member_and_range(monkeypatch, proto):
+    """Attr plane fused into the banded ray-cast batches: the band ring
+    only carries attr-passing rows; decided rows are final for the full
+    polygon-AND-attr predicate."""
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", proto)
+    host, tpu = _point_stores()
+    got = _pparity(host, tpu, [
+        f"kind = 'k1' AND intersects(geom, {TRI})",
+        f"kind = 'k3' AND intersects(geom, {TRI2})",
+        f"score > 10 AND score <= 30 AND intersects(geom, {TRI})",
+        f"score BETWEEN 5 AND 20 AND intersects(geom, {TRI2})",
+    ])
+    assert any(len(r.fids) > 0 for r in got)
+    table = tpu._tables["p"]["z2"]
+    dev = tpu.executor.device_index(table)
+    assert all(
+        getattr(s, "_attr_codes", {}).get("kind") is not None
+        for s in dev.segments
+    )
+
+
+def test_poly_attr_with_window_and_shard_extract(monkeypatch):
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", "bitmap")
+    monkeypatch.setenv("GEOMESA_SHARD_EXTRACT", "1")
+    host, tpu = _point_stores()
+    _pparity(host, tpu, [
+        f"kind = 'k0' AND intersects(geom, {TRI}) AND "
+        "dtg DURING 2026-01-02T00:00:00Z/2026-01-09T00:00:00Z",
+        f"kind = 'k2' AND intersects(geom, {TRI2}) AND "
+        "dtg DURING 2026-01-03T00:00:00Z/2026-01-11T00:00:00Z",
+        f"score < 25 AND intersects(geom, {TRI}) AND "
+        "dtg DURING 2026-01-02T00:00:00Z/2026-01-09T00:00:00Z",
+        f"score >= 8 AND intersects(geom, {TRI2}) AND "
+        "dtg DURING 2026-01-03T00:00:00Z/2026-01-11T00:00:00Z",
+    ])
+
+
+def test_poly_attr_after_delete():
+    host, tpu = _point_stores(n=8000)
+    for s in (host, tpu):
+        s.delete_features("p", [f"p{i}" for i in range(0, 8000, 11)])
+    _pparity(host, tpu, [
+        f"kind = 'k2' AND intersects(geom, {TRI})",
+        f"kind = 'k4' AND intersects(geom, {TRI2})",
+        f"score IS NULL AND intersects(geom, {TRI})",
+        f"kind IS NOT NULL AND intersects(geom, {TRI2})",
+    ])
