@@ -232,12 +232,17 @@ def bench_attr_bbox(n, reps):
     actors = np.array(["USA", "CHN", "RUS", "FRA", "BRA"], dtype=object)[
         rng.integers(0, 5, n)
     ]
+    gold = np.round(rng.uniform(-10, 10, n), 1)  # goldsteinscale shape
     ds = _store()
-    ft = parse_spec("gdelt", "actor1:String:index=true,dtg:Date,*geom:Point:srid=4326")
+    ft = parse_spec(
+        "gdelt",
+        "actor1:String:index=true,goldstein:Double,dtg:Date,*geom:Point:srid=4326",
+    )
     ds.create_schema(ft)
     fids = np.char.add("f", np.arange(n).astype(f"<U{len(str(n - 1))}"))
     ds._insert_columns(
-        ft, {"__fid__": fids, "actor1": actors, "geom__x": x, "geom__y": y, "dtg": t}
+        ft, {"__fid__": fids, "actor1": actors, "goldstein": gold,
+             "geom__x": x, "geom__y": y, "dtg": t}
     )
     box = (-30.0, 0.0, 10.0, 30.0)
     want_mask = (
@@ -254,20 +259,25 @@ def bench_attr_bbox(n, reps):
     dev_s, res = _timeit(lambda: ds.query("gdelt", cql), reps)
     parity = set(res.fids) == set(fids[want_mask])
     # jittered attr+bbox stream: with GEOMESA_SEEK=0 these route through
-    # the attr-equality device batch (dictionary-code compare fused into
-    # the exact scan) — the silicon number VERDICT r3 #9 asks for
+    # the attr device batches — equality via the membership edition
+    # (VERDICT r3 #9's silicon number) AND numeric ranges via the
+    # [lo, hi] code-interval edition (round 4's plane), interleaved so
+    # one pipelined stream measures both kernel families
     cqls, wants = [], []
-    for k in range(reps):
+    for k in range(max(8, reps)):  # both families need >= 2 batch members
         dx = round(float(rng.uniform(-5, 5)), 3)
-        actor = ["USA", "CHN", "RUS"][k % 3]
         b = (box[0] + dx, box[1], box[2] + dx, box[3])
-        cqls.append(
-            f"actor1 = '{actor}' AND bbox(geom, {b[0]!r}, {b[1]!r}, {b[2]!r}, {b[3]!r})"
-        )
-        wants.append(
-            set(fids[(actors == actor) & (x >= b[0]) & (x <= b[2])
-                     & (y >= b[1]) & (y <= b[3])])
-        )
+        bq = f"bbox(geom, {b[0]!r}, {b[1]!r}, {b[2]!r}, {b[3]!r})"
+        in_box = (x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3])
+        if k % 2 == 0:
+            actor = ["USA", "CHN", "RUS"][(k // 2) % 3]
+            cqls.append(f"actor1 = '{actor}' AND {bq}")
+            wants.append(set(fids[(actors == actor) & in_box]))
+        else:
+            lo = round(float(rng.uniform(-8, 0)), 1)
+            hi = round(lo + float(rng.uniform(2, 10)), 1)
+            cqls.append(f"goldstein > {lo} AND goldstein <= {hi} AND {bq}")
+            wants.append(set(fids[(gold > lo) & (gold <= hi) & in_box]))
     return {
         "metric": "attr_plus_bbox_throughput", "value": round(n / dev_s, 1),
         "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
