@@ -231,6 +231,15 @@ def batch() -> None:
         if got:
             results.extend({"name": name, **r} for r in got)
             record_hw(results)  # durable even if the window closes mid-batch
+        else:
+            # a step that produced NOTHING usually means the tunnel died
+            # mid-batch (claims then HANG, they don't fail): re-probe and
+            # abort the remaining steps rather than paying each one's
+            # full timeout against a dead tunnel (the 19:35Z wedge cost
+            # ~45 min of hung hw_probe + smoke)
+            if not probe():
+                log(f"step {name} empty and tunnel dead; aborting batch")
+                break
 
 
 def main():
