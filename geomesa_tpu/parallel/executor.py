@@ -225,7 +225,7 @@ def _gathered(mask, mesh):
     """Wrap a mask body so the extraction ops see a REPLICATED operand.
 
     The run/bitmap extraction ops downstream of every mask (bounded
-    jnp.nonzero, scatter-at, argmax span framing, packbits) lower
+    jnp.nonzero, scatter-at, the _span_bounds framing, packbits) lower
     pathologically under GSPMD when their operand stays row-sharded:
     measured 7.1 s vs 7 ms for the same bounded-nonzero extraction at
     262k rows on the 8-device CPU mesh — a ~1000x cliff that dominated
@@ -377,14 +377,29 @@ def _exact_arg_counts(has_time: bool, attr) -> Tuple[int, int]:
     return 5, 1
 
 
+def _span_bounds(m):
+    """(cnt, lo, hi) of a bool mask in ONE fused pass: iota-select
+    min/max reductions instead of argmax over m and argmax over m[::-1]
+    — the reversal materializes a full copy of the mask per query on
+    TPU, which dominated the batched framing at 20M rows. Semantics
+    match the argmax pair exactly, including the empty-mask case
+    (lo=0, hi=n-1)."""
+    n = m.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    cnt = jnp.sum(m.astype(jnp.int32))
+    lo_f = jnp.min(jnp.where(m, idx, jnp.int32(n)))
+    hi_f = jnp.max(jnp.where(m, idx, jnp.int32(-1)))
+    lo = jnp.where(cnt > 0, lo_f, jnp.int32(0))
+    hi = jnp.where(cnt > 0, hi_f, jnp.int32(n - 1))
+    return cnt, lo, hi
+
+
 def _bitmap_frame_step(m, span_cap: int):
     """One query's span framing: (header [cnt, lo, hi, start], packed
     window bits) — shared by the replicated and per-shard bitmap batch
     kernels (their wire parity depends on this staying single-sourced)."""
     n = m.shape[0]
-    cnt = jnp.sum(m.astype(jnp.int32))
-    lo = jnp.argmax(m).astype(jnp.int32)
-    hi = (n - 1 - jnp.argmax(m[::-1])).astype(jnp.int32)
+    cnt, lo, hi = _span_bounds(m)
     # caller guarantees span_cap <= n and both multiples of 8
     start = jnp.clip((lo // 8) * 8, 0, n - span_cap)
     window = jax.lax.dynamic_slice(m, (start,), (span_cap,))
@@ -590,15 +605,16 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
     ``jnp.nonzero`` lowers to a binary search per output slot — measured
     ~850 ms per 20M-row query on v5e (the gather poison), which dwarfed
     both the streaming mask (~1 ms) and the link. Here the device only
-    does streaming-friendly work: the mask, two argmax reductions for the
-    first/last hit, a dynamic-slice of the span window, and a bit-pack.
+    does streaming-friendly work: the mask, fused iota-select min/max
+    reductions for the first/last hit (_span_bounds — no mask reversal),
+    a dynamic-slice of the span window, and a bit-pack.
     The host unpacks and RLE-extracts at C speed from the (span-framed)
     bitmap. Header = (count, lo, hi, slice_start); a span wider than
     span_cap is detected host-side (hi - start + 1 > span_cap) and that
     query refetches singly while the segment learns a bigger span bucket.
 
     On a multi-device mesh the mask is all-gathered to a replicated
-    layout first (_gathered), so the argmax framing / dynamic-slice /
+    layout first (_gathered), so the span framing / dynamic-slice /
     packbits all compile to their single-device form; a future pod
     deployment could extract per shard and stitch offsets instead —
     single-chip is the tunnel-bench shape that matters here.
@@ -1188,9 +1204,7 @@ def _dual_bitmap_row(hit, decided, span_cap: int):
     on the hit span; decided is a subset so one window frames both) —
     shared by the xz and polygon bitmap batch kernels."""
     n = hit.shape[0]
-    cnt = jnp.sum(hit.astype(jnp.int32))
-    lo = jnp.argmax(hit).astype(jnp.int32)
-    hi = (n - 1 - jnp.argmax(hit[::-1])).astype(jnp.int32)
+    cnt, lo, hi = _span_bounds(hit)
     start = jnp.clip((lo // 8) * 8, 0, n - span_cap)
     hw = jax.lax.dynamic_slice(hit, (start,), (span_cap,))
     dw = jax.lax.dynamic_slice(decided, (start,), (span_cap,))
