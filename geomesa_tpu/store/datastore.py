@@ -13,7 +13,6 @@ projection/limits -> aggregation reducers (density/stats/bin) when hinted.
 
 from __future__ import annotations
 
-import itertools
 import uuid
 from collections.abc import Mapping
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
@@ -22,14 +21,13 @@ import numpy as np
 
 from geomesa_tpu.filter import ast, evaluate
 from geomesa_tpu.parallel import mesh as mesh_mod
-from geomesa_tpu.filter.parser import parse_cql
 from geomesa_tpu.index.aggregators import (
     AGGREGATION_HINTS,
     has_aggregation,
     run_aggregation,
 )
 from geomesa_tpu.index.keyspace import IndexKeySpace, default_indices
-from geomesa_tpu.index.planner import Explainer, Query, QueryPlan, QueryPlanner
+from geomesa_tpu.index.planner import Query, QueryPlan, QueryPlanner
 from geomesa_tpu.schema.feature import Feature
 from geomesa_tpu.schema.featuretype import AttributeType, FeatureType, parse_spec
 from geomesa_tpu.store.blocks import (
