@@ -454,15 +454,60 @@ def bench_knn(n, reps):
         d = haversine_m(x, y, qx, qy)
         return [f"f{i}" for i in np.argsort(d, kind="stable")[:k]]
 
+    from geomesa_tpu.process.knn import last_knn_path
+
     base_s, want = _timeit(brute, max(3, reps // 4))
-    dev_s, got = _timeit(lambda: knn_search(ds, "pts", qx, qy, k=k), reps)
+    paths = []
+
+    def timed_knn():
+        r = knn_search(ds, "pts", qx, qy, k=k)
+        paths.append(last_knn_path())  # per CALL: a mid-loop fallback
+        return r  # must not be mislabeled by the final rep's path
+
+    dev_s, got = _timeit(timed_knn, reps)
     parity = [f for f, _ in got] == want
-    return {
+    out = {
         "metric": "knn_throughput", "value": round(n / dev_s, 1),
         "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
         "n": n, "k": k, "parity": bool(parity),
         "query_ms": round(dev_s * 1000, 3),
+        "cost_chosen_path": (
+            paths[-1] if len(set(paths)) == 1 else f"mixed:{sorted(set(paths))}"
+        ),
     }
+    import jax
+
+    if jax.default_backend() != "cpu":
+        if set(paths) == {"device-topk"}:
+            # the cost gate already chose the device for every rep — the
+            # headline numbers ARE the device numbers; no second loop
+            out.update({
+                "device_path_fps": out["value"],
+                "device_path_vs_baseline": out["vs_baseline"],
+                "device_query_ms_pipelined": out["query_ms"],
+                "device_parity": bool(parity),
+            })
+            return out
+        # forced device top-k: EVERY rep must have answered on device or
+        # the averaged time includes fallback latencies (mislabeling)
+        try:
+            paths.clear()
+            with _env_override("GEOMESA_KNN_DEVICE", "1"):
+                dvc_s, got_d = _timeit(timed_knn, reps)
+            if set(paths) != {"device-topk"}:
+                out["device_error"] = (
+                    f"device top-k declined or fell back ({sorted(set(paths))})"
+                )
+            else:
+                out.update({
+                    "device_path_fps": round(n / dvc_s, 1),
+                    "device_path_vs_baseline": round(base_s / dvc_s, 3),
+                    "device_query_ms_pipelined": round(dvc_s * 1000, 3),
+                    "device_parity": [f for f, _ in got_d] == want,
+                })
+        except Exception as e:  # noqa: BLE001 - auxiliary field only
+            out["device_error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
 
 
 def main():

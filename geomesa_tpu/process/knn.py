@@ -10,6 +10,7 @@ distance guarantees no closer feature was missed.
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -45,9 +46,16 @@ def knn_search(
 ) -> List[Tuple[str, float]]:
     """[(fid, distance_m)] of the k nearest features to (x, y), ascending.
     Features beyond ``max_radius_m`` are never returned — identical
-    semantics on the device top-k and host expanding-bbox paths."""
+    semantics on the device top-k and host expanding-bbox paths.
+
+    ``last_knn_path()`` reports which path answered this THREAD's most
+    recent call ("device-topk" | "host-bbox") — benches and tests
+    consult it per call so a silent fallback can never report host time
+    as a device number (thread-local: concurrent callers, e.g. the REST
+    server's threads, cannot clobber each other's marker)."""
     from geomesa_tpu.parallel.mesh import device_tripped, trip_device
 
+    _PATH_LOCAL.path = "host-bbox"
     ft = store.get_schema(name)
     if (
         cql is None
@@ -64,6 +72,7 @@ def knn_search(
             trip_device(store.executor, "GEOMESA_KNN_DEVICE", "knn", e)
             direct = None
         if direct is not None:
+            _PATH_LOCAL.path = "device-topk"
             return direct
     radius = float(initial_radius_m)
     result = None
@@ -117,6 +126,14 @@ def _device_knn_wanted() -> bool:
 
 # auto device paths decline when one round trip costs more than this
 _LINK_BUDGET_MS = 10.0
+
+_PATH_LOCAL = threading.local()
+
+
+def last_knn_path() -> str:
+    """Which path answered this thread's most recent knn_search call
+    ("device-topk" | "host-bbox"; "?" before any call)."""
+    return getattr(_PATH_LOCAL, "path", "?")
 
 
 def _device_knn(store, name: str, ft, x: float, y: float, k: int,

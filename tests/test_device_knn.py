@@ -139,3 +139,19 @@ def test_device_failure_trips_auto_mode_once(monkeypatch):
     monkeypatch.setenv("GEOMESA_KNN_DEVICE", "1")
     knn_search(tpu, "t", 10.0, 10.0, k=5)
     assert calls["n"] == 2  # forced mode retries despite the trip
+
+
+def test_last_path_marker(monkeypatch):
+    """last_knn_path() truthfully records which path answered this
+    thread's most recent call — benches consult it per call so a
+    fallback can never report host time as a device number."""
+    from geomesa_tpu.process.knn import last_knn_path
+
+    tpu = _mk(TpuScanExecutor(default_mesh()))
+    monkeypatch.setenv("GEOMESA_KNN_DEVICE", "1")
+    got = knn_search(tpu, "t", 10.0, 10.0, k=5)
+    assert last_knn_path() == "device-topk"
+    assert [f for f, _ in got] == [f for f, _ in _brute(tpu, 10.0, 10.0, 5)]
+    monkeypatch.setenv("GEOMESA_KNN_DEVICE", "0")
+    knn_search(tpu, "t", 10.0, 10.0, k=5)
+    assert last_knn_path() == "host-bbox"
