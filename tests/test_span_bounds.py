@@ -1,0 +1,43 @@
+"""_span_bounds: the bitmap protocols' span-framing primitive. Its
+header semantics (including the EMPTY-mask lo=0/hi=n-1 convention the
+host decoders rely on) replaced an argmax pair — pin them."""
+
+import numpy as np
+import jax
+
+from geomesa_tpu.parallel.executor import _span_bounds
+
+
+def _ref(m):
+    """The original argmax-pair semantics."""
+    n = len(m)
+    cnt = int(m.sum())
+    lo = int(np.argmax(m))
+    hi = int(n - 1 - np.argmax(m[::-1]))
+    return cnt, lo, hi
+
+
+def check(m):
+    got = jax.jit(_span_bounds)(m)
+    got = tuple(int(v) for v in got)
+    assert got == _ref(np.asarray(m)), (got, _ref(np.asarray(m)), m)
+
+
+def test_span_bounds_edge_masks():
+    n = 64
+    check(np.zeros(n, bool))          # empty: (0, 0, n-1)
+    check(np.ones(n, bool))           # full: (n, 0, n-1)
+    for i in (0, 1, n // 2, n - 2, n - 1):
+        m = np.zeros(n, bool)
+        m[i] = True                    # lone hit anywhere
+        check(m)
+    m = np.zeros(n, bool)
+    m[0] = m[-1] = True                # both extremes
+    check(m)
+
+
+def test_span_bounds_random_masks():
+    rng = np.random.default_rng(3)
+    for density in (0.01, 0.3, 0.9):
+        for _ in range(5):
+            check(rng.random(257) < density)
