@@ -1,0 +1,66 @@
+"""bench.py's driver contract: a parseable JSON line must reach stdout
+within seconds of process start — BEFORE any tunnel claim or
+measurement — so an external kill at any point leaves the round's
+record carrying the committed hardware capture instead of parsed:null
+(the r03 failure mode)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(timeout_s, extra_env):
+    env = {
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "HOME": "/root",
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "GEOMESA_BENCH_POLL": "0",
+        **extra_env,
+    }
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=REPO,
+        )
+        out = p.stdout
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"")
+        out = out.decode() if isinstance(out, bytes) else out
+    return [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+
+
+def test_provisional_line_survives_early_kill():
+    """Killed 25s in (before any measurement at 20M could finish): the
+    capture line with provenance must already be on stdout."""
+    if not os.path.exists(os.path.join(REPO, "BENCH_hw.json")):
+        import pytest
+
+        pytest.skip("no committed hardware capture")
+    lines = _run_bench(25, {"GEOMESA_BENCH_CLAIM_TIMEOUT": "300"})
+    assert lines, "no JSON within 25s of start"
+    assert lines[0].get("source") == "tpu_watch_capture"
+    assert lines[0].get("vs_baseline", 0) > 0
+    assert lines[0].get("captured_head")
+
+
+def test_watcher_batches_suppress_the_echo():
+    """Inside a tpu_watch batch the provisional would echo a PREVIOUS
+    capture into the next BENCH_hw.json — it must not be emitted."""
+    lines = _run_bench(
+        180,
+        {
+            "GEOMESA_AXON_LOCK_HELD": "1",
+            "GEOMESA_BENCH_SMOKE": "1",
+            "GEOMESA_BENCH_CLAIM_TIMEOUT": "3",
+            "GEOMESA_BENCH_CLAIM_RETRIES": "1",
+        },
+    )
+    assert lines, "smoke run emitted nothing"
+    assert all(l.get("source") != "tpu_watch_capture" for l in lines)
+    assert lines[-1].get("value", 0) > 0  # the measured line
