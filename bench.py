@@ -18,15 +18,32 @@ result (round 3's driver artifact was rc=124/null for exactly that
 reason); if a tunnel window then opens, an upgraded device line is
 emitted afterwards and wins.
 
+The driver that consumes this output keeps only the stdout TAIL (rounds
+1-4 proved it: rc=124 with a wall of probe-log lines scrolled both JSON
+lines out of the captured window). Three guarantees keep the payload
+inside the last few hundred bytes under EVERY termination:
+  1. a tail-guard thread re-emits the current-best JSON line every 60s
+     for the whole run (suppressed inside tpu_watch batches, where every
+     stdout JSON line is recorded and duplicates would corrupt the
+     capture file);
+  2. the poll loop re-emits the current-best line after EVERY probe and
+     logs a heartbeat only once per ~3 minutes;
+  3. the watchdog re-emits the best line (not just "stands on" it)
+     before force-exiting, and its default deadline (540s) fires BEFORE
+     the driver's observed ~600s kill.
+
 Env knobs:
   GEOMESA_BENCH_N        rows (default 20_000_000 on either backend)
   GEOMESA_BENCH_REPS     timed repetitions (default 20)
   GEOMESA_BENCH_SMOKE=1  small fast mode (N=200_000, reps=3)
-  GEOMESA_BENCH_CLAIM_TIMEOUT  seconds per TPU-claim probe (default 180)
-  GEOMESA_BENCH_CLAIM_RETRIES  probe attempts (default 2)
-  GEOMESA_BENCH_DEADLINE       whole-run watchdog seconds (default 3000);
-                               on expiry a fallback JSON line is emitted
-                               and the process force-exits
+  GEOMESA_BENCH_CLAIM_TIMEOUT  seconds per TPU-claim probe (default 90)
+  GEOMESA_BENCH_CLAIM_RETRIES  probe attempts (default 1)
+  GEOMESA_BENCH_DEADLINE       whole-run watchdog seconds (default 540 —
+                               UNDER the driver's external kill; the
+                               tpu_watch batch passes its own, larger
+                               budget explicitly); on expiry the best
+                               JSON line is re-emitted and the process
+                               force-exits
 """
 
 import json
@@ -104,9 +121,64 @@ def brute_force(x, y, t, box=BOX):
     )
 
 
+# the current-best emitted line, re-printed by the tail guard / watchdog /
+# poll loop so the driver's tail capture always ends near a JSON line.
+# Error lines only become "best" while no good line exists — a zero-value
+# error record must never displace real numbers in the tail.
+_BEST_LINE = None
+_BEST_IS_ERROR = False
+
+
 def emit(payload: dict) -> None:
-    sys.stdout.write(json.dumps(payload) + "\n")
+    global _BEST_LINE, _BEST_IS_ERROR
+    line = json.dumps(payload)
+    sys.stdout.write(line + "\n")
     sys.stdout.flush()
+    is_error = bool(payload.get("error")) or payload.get("value") == 0.0
+    if _BEST_LINE is None or not is_error or _BEST_IS_ERROR:
+        _BEST_LINE = line
+        _BEST_IS_ERROR = is_error
+
+
+def reemit_best() -> None:
+    """Re-print the current-best JSON line so it sits at the stdout tail."""
+    if _BEST_LINE is not None:
+        sys.stdout.write(_BEST_LINE + "\n")
+        sys.stdout.flush()
+
+
+def _recorded_run() -> bool:
+    """True when every stdout JSON line is being RECORDED (a tpu_watch
+    batch step): duplicate/partial emissions would corrupt BENCH_hw.json
+    there. GEOMESA_BENCH_RECORDED overrides — the mid-poll device-retry
+    child holds the flock (GEOMESA_AXON_LOCK_HELD=1) but its stdout goes
+    to a last-line parser, not a recorder, so it sets =0 to keep the
+    tail-guard/early-emit protections active."""
+    v = os.environ.get("GEOMESA_BENCH_RECORDED")
+    if v is not None:
+        return v not in ("", "0")
+    return os.environ.get("GEOMESA_AXON_LOCK_HELD", "") not in ("", "0")
+
+
+def start_tail_guard(period_s: float = 60.0):
+    """Daemon thread keeping the best JSON line within the driver's tail
+    window at all times. The driver keeps only trailing stdout — any
+    kill, at any phase, must land within ~period_s of a re-emit.
+    Suppressed inside tpu_watch batches: the watcher records EVERY stdout
+    JSON line into BENCH_hw.json and re-emits would duplicate entries."""
+    if _recorded_run():
+        return None
+    import threading
+
+    stop = threading.Event()
+
+    def tick():
+        while not stop.wait(period_s):
+            reemit_best()
+
+    t = threading.Thread(target=tick, daemon=True, name="bench-tail-guard")
+    t.start()
+    return stop
 
 
 def log(msg: str) -> None:
@@ -141,11 +213,12 @@ def start_watchdog(deadline_s: float):
     import threading
 
     def fire():
-        if _PROVISIONAL_OUT and not _EMITTED:
-            # the capture line is already out and is strictly better
-            # than a zero-value error line (last line wins — do not
-            # clobber real silicon numbers with an error record)
-            log(f"watchdog fired after {deadline_s}s; capture line stands")
+        if _BEST_LINE is not None:
+            # re-emit rather than stand on it: the driver keeps only the
+            # stdout TAIL, and a line emitted minutes ago may have
+            # scrolled out of the captured window by now
+            log(f"watchdog fired after {deadline_s}s; re-emitting best line")
+            reemit_best()
             os._exit(3)
         log(f"watchdog fired after {deadline_s}s; emitting fallback JSON")
         emit_once(
@@ -230,7 +303,7 @@ def _axon_lock():
         return None
 
 
-def probe_tpu(timeout_s: int, retries: int) -> bool:
+def probe_tpu(timeout_s: int, retries: int, quiet: bool = False) -> bool:
     """Probe the TPU/axon backend in a SUBPROCESS with a hard timeout.
 
     Round 1's bench died because backend init either crashed (rc=1,
@@ -247,11 +320,13 @@ def probe_tpu(timeout_s: int, retries: int) -> bool:
     )
     lock = _axon_lock()
     if lock is not None and not lock.try_acquire(timeout_s=5.0):
-        log("axon lock busy (another claimer active); treating TPU as unavailable")
+        if not quiet:
+            log("axon lock busy (another claimer active); treating TPU as unavailable")
         return False
     ok = False
     for attempt in range(1, retries + 1):
-        log(f"TPU probe attempt {attempt}/{retries} (timeout {timeout_s}s)")
+        if not quiet:
+            log(f"TPU probe attempt {attempt}/{retries} (timeout {timeout_s}s)")
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code],
@@ -260,14 +335,16 @@ def probe_tpu(timeout_s: int, retries: int) -> bool:
                 timeout=timeout_s,
             )
         except subprocess.TimeoutExpired:
-            log("probe timed out")
+            if not quiet:
+                log("probe timed out")
             proc = None
         if proc is not None:
             if proc.returncode == 0 and "PROBE-OK" in proc.stdout:
                 log(f"probe ok: {proc.stdout.strip().splitlines()[-1]}")
                 ok = True
                 break
-            log(f"probe failed rc={proc.returncode}: {proc.stderr.strip()[-400:]}")
+            if not quiet:
+                log(f"probe failed rc={proc.returncode}: {proc.stderr.strip()[-400:]}")
         if attempt < retries:  # no pointless sleep after the final attempt
             time.sleep(min(10 * attempt, 30))
     # on success KEEP the lock held through the in-process claim + run (the
@@ -524,6 +601,29 @@ def run(n: int, reps: int, backend: str) -> dict:
                 "n": n,
             }
 
+    core = {
+        "metric": "gdelt_z3_bbox_time_filter_throughput",
+        "value": round(dev_fps, 1),
+        "unit": "features/sec",
+        "vs_baseline": round(dev_fps / cpu_fps, 3),
+        "backend": backend,
+        "baseline": "numpy-fullscan (CQEngine stand-in, stronger than GeoCQEngine)",
+        "n": n,
+        "reps": reps,
+        "hits": int(len(wants[0])),
+        "cpu_baseline_fps": round(cpu_fps, 1),
+        "ingest_rec_per_sec": round(n / ingest_s, 1),
+        "query_ms": round(lat_s * 1000, 3),
+        "query_ms_pipelined": round(pipe_s * 1000, 3),
+    }
+    # the headline is measured: put it on the wire NOW, before the
+    # (auxiliary) device-forced stream below — a watchdog or external kill
+    # during that section must cost the device_* extras, not the round's
+    # live number. Suppressed in watcher batches (every stdout JSON line
+    # is recorded there; a partial + final pair would double-count).
+    if not _recorded_run():
+        emit(core)
+
     # --- device-forced stream (accelerator only) -------------------------
     # The SAME query stream answered end-to-end by the accelerator: the
     # batched exact path (_exact_runs_batch_fn) fuses all queries into one
@@ -624,22 +724,7 @@ def run(n: int, reps: int, backend: str) -> dict:
             else:
                 os.environ["GEOMESA_BATCH_TRACE"] = saved_trace
 
-    return {
-        **device_fields,
-        "metric": "gdelt_z3_bbox_time_filter_throughput",
-        "value": round(dev_fps, 1),
-        "unit": "features/sec",
-        "vs_baseline": round(dev_fps / cpu_fps, 3),
-        "backend": backend,
-        "baseline": "numpy-fullscan (CQEngine stand-in, stronger than GeoCQEngine)",
-        "n": n,
-        "reps": reps,
-        "hits": int(len(wants[0])),
-        "cpu_baseline_fps": round(cpu_fps, 1),
-        "ingest_rec_per_sec": round(n / ingest_s, 1),
-        "query_ms": round(lat_s * 1000, 3),
-        "query_ms_pipelined": round(pipe_s * 1000, 3),
-    }
+    return {**device_fields, **core}
 
 
 def emit_provisional_from_capture() -> None:
@@ -654,7 +739,7 @@ def emit_provisional_from_capture() -> None:
     watcher records EVERY stdout JSON line into BENCH_hw.json, and an
     echo of the previous capture would become a self-perpetuating stale
     headline entry."""
-    if os.environ.get("GEOMESA_AXON_LOCK_HELD"):
+    if os.environ.get("GEOMESA_AXON_LOCK_HELD", "") not in ("", "0"):
         return
     try:
         path = os.path.join(
@@ -682,14 +767,36 @@ def emit_provisional_from_capture() -> None:
 
 
 def attach_hw_capture(payload: dict) -> dict:
-    """When falling back to CPU, attach any committed hardware capture
-    (BENCH_hw.json, written by scripts/tpu_watch.py during a tunnel
-    window) so the round's record still carries the real-TPU numbers."""
+    """When falling back to CPU, attach a COMPACT summary of any committed
+    hardware capture (BENCH_hw.json, written by scripts/tpu_watch.py
+    during a tunnel window) so the round's record still carries the
+    real-TPU numbers.
+
+    Compact is load-bearing: the driver keeps only the stdout TAIL, and
+    attaching the raw capture once produced a >3KB single line whose
+    START fell outside a 2KB tail window — no parseable line at all. Every
+    emitted line must stay well under ~1.5KB."""
     try:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_hw.json")
         with open(path) as f:
             hw = json.load(f)
-        payload["hw_capture"] = hw
+        slim = {"captured_at": hw.get("captured_at"), "head": hw.get("head")}
+        rows = []
+        for r in hw.get("results", []):
+            if "metric" not in r or "value" not in r or "error" in r:
+                continue  # error rows carry no number worth tail space
+            row = {k: r[k] for k in
+                   ("name", "metric", "value", "vs_baseline",
+                    "device_path_vs_baseline", "parity", "device_parity")
+                   if k in r}
+            rows.append(row)
+        slim["results"] = rows
+        blob = json.dumps(slim)
+        while len(blob) > 600 and slim["results"]:
+            slim["results"] = slim["results"][:-1]
+            slim["results_truncated"] = True
+            blob = json.dumps(slim)
+        payload["hw_capture"] = slim
     except Exception:  # noqa: BLE001 - absent file is the common case
         pass
     return payload
@@ -703,26 +810,44 @@ def poll_for_tpu_retry(payload, t_start, deadline):
     start of the run is a much smaller net than the whole budget."""
     if os.environ.get("GEOMESA_BENCH_POLL", "1") in ("0",):
         return payload
-    margin = 120.0  # emit well before the watchdog fires
-    # min time a 20M device rerun needs: synthesis ~90s + baseline ~60s +
-    # ingest ~35s + warm compile + 20 queries ≈ 10 min — keep this tight
-    # so the polling window covers as much of the deadline as possible
-    device_budget = 900.0
+    margin = 60.0  # emit well before the watchdog fires
+    # a full 20M device rerun needs ~10 min; below that, a reduced-N rerun
+    # (2M: ~3 min end to end) still yields a real silicon number — far
+    # better than polling uselessly against a budget that can't fit 20M
+    full_budget = 900.0
+    small_budget = 240.0
+    probes = 0
     while True:
         remaining = deadline - (time.monotonic() - t_start) - margin
-        if remaining < device_budget:
+        if remaining < small_budget:
             return payload
-        if probe_tpu(45, 1):
+        probes += 1
+        # heartbeat once per ~3 min (4 probes x 45s), not per probe: the
+        # driver keeps only the stdout tail and per-probe logging scrolled
+        # the r04 JSON lines out of the captured window
+        quiet = probes % 4 != 1
+        if not quiet:
+            log(f"polling for tunnel window ({remaining:.0f}s budget left)")
+        if probe_tpu(30, 1, quiet=quiet):
             budget = deadline - (time.monotonic() - t_start) - margin
-            log(f"tunnel opened mid-run; device retry ({budget:.0f}s budget)")
+            retry_n = 0 if budget >= full_budget else 2_000_000
+            log(f"tunnel opened mid-run; device retry ({budget:.0f}s budget, "
+                f"n={'full' if retry_n == 0 else retry_n})")
             env = dict(
                 os.environ,
                 GEOMESA_BENCH_POLL="0",
                 GEOMESA_AXON_LOCK_HELD="1",  # we hold the flock
+                # ...but our parser (below) is NOT a recorder: the child
+                # keeps its tail guard + early headline emit so a
+                # deadline hit in its auxiliary device section can't
+                # lose an already-measured number
+                GEOMESA_BENCH_RECORDED="0",
                 GEOMESA_BENCH_CLAIM_TIMEOUT="60",
                 GEOMESA_BENCH_CLAIM_RETRIES="1",
                 GEOMESA_BENCH_DEADLINE=str(int(budget - 30)),
             )
+            if retry_n:
+                env["GEOMESA_BENCH_N"] = str(retry_n)
             try:
                 proc = subprocess.run(
                     [sys.executable, __file__],
@@ -734,7 +859,8 @@ def poll_for_tpu_retry(payload, t_start, deadline):
                 sys.stderr.write(proc.stderr[-4000:])
                 line = next(
                     (ln for ln in reversed(proc.stdout.strip().splitlines())
-                     if ln.startswith("{")),
+                     if ln.startswith("{")
+                     and '"source": "tpu_watch_capture"' not in ln),
                     "",
                 )
                 got = json.loads(line)
@@ -745,6 +871,7 @@ def poll_for_tpu_retry(payload, t_start, deadline):
                 log(f"device retry failed: {type(e).__name__}: {e}")
             return payload
         touch_claim_pending()  # keep the tpu_watch yield-marker fresh
+        reemit_best()  # keep the payload at the stdout tail through the poll
         time.sleep(45)
 
 
@@ -764,7 +891,12 @@ def main():
     # (2x180s once cost a driver run 360s before its CPU fallback began)
     claim_timeout = int(os.environ.get("GEOMESA_BENCH_CLAIM_TIMEOUT", 90))
     retries = int(os.environ.get("GEOMESA_BENCH_CLAIM_RETRIES", 1))
-    deadline = float(os.environ.get("GEOMESA_BENCH_DEADLINE", 3000))
+    # the driver kills at ~600s: default the internal deadline UNDER that
+    # so the watchdog (which re-emits the best JSON line) always fires
+    # first. 3000s was a fiction — it meant neither the watchdog nor the
+    # poll-exit margin ever ran inside the real budget (rounds 3-4).
+    # tpu_watch passes its own larger budget explicitly per batch step.
+    deadline = float(os.environ.get("GEOMESA_BENCH_DEADLINE", 540))
 
     t_start = time.monotonic()
     # provisional line FIRST — before any claim/probe/measure work. If a
@@ -776,6 +908,7 @@ def main():
     # structurally impossible once this line is out.
     emit_provisional_from_capture()
     mark_claim_pending()
+    start_tail_guard()
     watchdog = start_watchdog(deadline)
     backend = init_backend(claim_timeout, retries)
     if n == 0:

@@ -406,9 +406,14 @@ def bench_density(n, reps):
 
     if jax.default_backend() != "cpu":
         # forced device kernel (the cost gate may already choose it —
-        # this field isolates the fused-kernel time either way)
+        # this field isolates the fused-kernel time either way). The seek
+        # scan must ALSO be disabled: with it on, the plan routes
+        # host-seek before the density push-down is consulted, and the
+        # forced run times the host reducer under a device label (the
+        # r5 capture's "kernel declined (scan_path='host-seek')")
         try:
-            with _env_override("GEOMESA_DENSITY_DEVICE", "1"):
+            with _env_override("GEOMESA_DENSITY_DEVICE", "1"), \
+                    _env_override("GEOMESA_SEEK", "0"):
                 dvc_s, dvc_res = _timeit(lambda: ds.query("dens", q), reps)
             if getattr(dvc_res.plan, "scan_path", "") != "device-density":
                 # the fused kernel declined (unsupported shape / failure
@@ -516,7 +521,11 @@ def main():
     import bench
 
     smoke = os.environ.get("GEOMESA_BENCH_SMOKE", "") not in ("", "0")
-    n = int(os.environ.get("GEOMESA_BENCH_N", 0)) or (200_000 if smoke else 2_000_000)
+    # 8M (was 2M): at 2M the per-execution device floor drowned the z2/xz2
+    # device paths (0.30-0.34x vs 1.5x at the headline's 20M) — the suite
+    # should measure kernels above the floor, like the reference's bulk
+    # scans do (tablet-server scans amortize per-RPC cost the same way)
+    n = int(os.environ.get("GEOMESA_BENCH_N", 0)) or (200_000 if smoke else 8_000_000)
     reps = int(os.environ.get("GEOMESA_BENCH_REPS", 3 if smoke else 10))
     claim_timeout = int(os.environ.get("GEOMESA_BENCH_CLAIM_TIMEOUT", 120))
     retries = int(os.environ.get("GEOMESA_BENCH_CLAIM_RETRIES", 1))

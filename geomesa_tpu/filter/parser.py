@@ -375,8 +375,9 @@ def parse_cql(text: str) -> Filter:
 
 
 def _fmt_instant(ms: int) -> str:
-    dt = datetime.datetime.fromtimestamp(ms / 1000.0, tz=datetime.timezone.utc)
-    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+    from geomesa_tpu.utils import fmt_instant_ms
+
+    return fmt_instant_ms(ms)
 
 
 def _fmt_literal(v: Any) -> str:

@@ -5075,12 +5075,9 @@ class TpuScanExecutor:
         mode = _mask_mode(self.mesh)
         if mode != "xla" and not all(s._pallas_ok for s in dev.segments):
             mode = "xla"  # some segment lacks the per-shard tile granule
-        fns = self._density_fns.get((width, height, mode))
-        if fns is None:
-            from geomesa_tpu.ops.aggregations import make_sharded_density
-
-            fns = make_sharded_density(self.mesh, width, height, mode)
-            self._density_fns[(width, height, mode)] = fns
+        if getattr(self, "_density_pallas_broken", False):
+            mode = "xla"  # runtime-downgraded this session (see below)
+        fns = self._density_grid_fns(width, height, mode)
         boxes = pad_boxes(
             [
                 (g.envelope.xmin, g.envelope.ymin, g.envelope.xmax, g.envelope.ymax)
@@ -5096,12 +5093,44 @@ class TpuScanExecutor:
             if windows is not None
             else None
         )
-        total: Optional[np.ndarray] = None
-        for seg in dev.segments:
-            if seg.kind == "z3":
-                grid = fns[0](seg.xf, seg.yf, seg.bins, seg.t_ms, seg.valid, b, w, e)
-            else:
-                grid = fns[1](seg.xf, seg.yf, seg.valid, b, e)
-            g = np.asarray(grid, dtype=np.float64)
-            total = g if total is None else total + g
-        return total
+        def run(fns):
+            total: Optional[np.ndarray] = None
+            for seg in dev.segments:
+                if seg.kind == "z3":
+                    grid = fns[0](seg.xf, seg.yf, seg.bins, seg.t_ms, seg.valid, b, w, e)
+                else:
+                    grid = fns[1](seg.xf, seg.yf, seg.valid, b, e)
+                g = np.asarray(grid, dtype=np.float64)
+                total = g if total is None else total + g
+            return total
+
+        try:
+            return run(fns)
+        except Exception as e:
+            if mode == "xla":
+                raise
+            # the pallas grid kernel compiled but failed at RUNTIME on the
+            # real chip (r5 silicon capture: JaxRuntimeError per query) —
+            # the XLA scatter-add edition computes the identical grid, so
+            # downgrade for the session instead of abandoning the fused
+            # push-down for the host reducer
+            import warnings
+
+            warnings.warn(
+                f"pallas density kernel failed ({type(e).__name__}: "
+                f"{str(e)[:200]}); downgrading to the XLA edition for "
+                "this session",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            self._density_pallas_broken = True
+            return run(self._density_grid_fns(width, height, "xla"))
+
+    def _density_grid_fns(self, width: int, height: int, mode: str):
+        fns = self._density_fns.get((width, height, mode))
+        if fns is None:
+            from geomesa_tpu.ops.aggregations import make_sharded_density
+
+            fns = make_sharded_density(self.mesh, width, height, mode)
+            self._density_fns[(width, height, mode)] = fns
+        return fns

@@ -1,7 +1,8 @@
 """geomesa-tpu CLI (the geomesa-tools Runner analog, Runner.scala:26,146).
 
 Subcommands: create-schema, delete-schema, describe, ingest, export, explain,
-stats-count, stats-bounds, stats-topk, stats-histogram, version, env. The datastore is the
+stats-count, stats-bounds, stats-topk, stats-histogram, stats-groupby,
+raster-ingest, raster-export, listen, version, env. The datastore is the
 file-system store (``--store DIR``), so state persists across invocations the
 way a cluster-backed reference deployment does.
 
@@ -262,6 +263,99 @@ def cmd_stats_topk(args) -> int:
     return 0
 
 
+def cmd_listen(args) -> int:
+    """Live-tail a stream topic (KafkaListenCommand.scala:22-44 analog):
+    decode GeoMessages from a broker and print one line per event —
+    ``<iso time> [add/update] fid=... v1|v2|...`` — until interrupted
+    (or ``--max-messages``/``--duration`` for scripted use).
+
+    Start position: a ``--group``'s committed offsets win (restart-resume,
+    the ConsumerDataStoreParams readBack contract), then explicit
+    ``--offsets``, then ``--from-beginning``, else the live end (tail
+    only new events, the reference's default)."""
+    import time as _time
+
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.utils import fmt_instant_ms
+    from geomesa_tpu.stream.messages import (
+        CreateOrUpdate,
+        Delete,
+        GeoMessageSerializer,
+    )
+
+    if bool(args.broker) == bool(args.log_root):
+        print("exactly one of --broker / --log-root required", file=sys.stderr)
+        return 1
+    if args.broker:
+        from geomesa_tpu.stream.netlog import RemoteLogBroker, RemoteOffsetManager
+
+        host, _, port = args.broker.rpartition(":")
+        if not port.isdigit():
+            print("--broker must be host:port", file=sys.stderr)
+            return 1
+        broker = RemoteLogBroker(host or "127.0.0.1", int(port))
+        om = RemoteOffsetManager(broker, args.group) if args.group else None
+    else:
+        from geomesa_tpu.stream.filelog import FileLogBroker, FileOffsetManager
+
+        broker = FileLogBroker(args.log_root)
+        om = FileOffsetManager(args.log_root, args.group) if args.group else None
+
+    ser = GeoMessageSerializer(parse_spec(args.name, args.spec))
+    committed = dict(om.offsets(args.name)) if om is not None else {}
+    if committed:
+        offsets = committed
+    elif args.offsets:
+        try:
+            offsets = {
+                int(p): int(o)
+                for p, o in (kv.split(":") for kv in args.offsets.split(","))
+            }
+        except ValueError:
+            print("--offsets must be p:o[,p:o...]", file=sys.stderr)
+            return 1
+    elif args.from_beginning:
+        offsets = {}
+    else:
+        offsets = dict(broker.end_offsets(args.name))
+
+    print(f"Listening to '{args.name}' {args.spec} ...", file=sys.stderr)
+    seen = 0
+    deadline = (
+        _time.monotonic() + args.duration if args.duration is not None else None
+    )
+    try:
+        while True:
+            records = broker.poll(args.name, offsets)
+            for p, off, payload in records:
+                msg = ser.deserialize(payload)
+                if isinstance(msg, CreateOrUpdate):
+                    vals = "|".join("" if v is None else str(v) for v in msg.values)
+                    line = f"{fmt_instant_ms(msg.ts_ms)} [add/update] fid={msg.fid} {vals}"
+                elif isinstance(msg, Delete):
+                    line = f"{fmt_instant_ms(msg.ts_ms)} [delete]     fid={msg.fid}"
+                else:
+                    line = f"{fmt_instant_ms(msg.ts_ms)} [clear]"
+                print(line, flush=True)
+                offsets[p] = off + 1
+                seen += 1
+                if args.max_messages is not None and seen >= args.max_messages:
+                    if om is not None:
+                        # commit through the LAST printed event: a bounded
+                        # run is a unit of consumption, and the next
+                        # --group run must resume after it, not replay it
+                        om.commit(args.name, offsets)
+                    return 0
+            if records and om is not None:
+                om.commit(args.name, offsets)
+            if deadline is not None and _time.monotonic() >= deadline:
+                return 0
+            if not records:
+                _time.sleep(args.poll_interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_version(args) -> int:
     print(f"geomesa-tpu {VERSION}")
     return 0
@@ -393,6 +487,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--width", type=int, default=256)
     sp.add_argument("--height", type=int, default=256)
     sp.add_argument("--out", required=True, help="output GeoTIFF path")
+    sp = add("listen", cmd_listen, store=False)
+    sp.add_argument("--broker", default=None, help="remote LogServer host:port")
+    sp.add_argument("--log-root", default=None, help="local file-log directory")
+    sp.add_argument("--spec", required=True, help="SimpleFeatureType spec string")
+    sp.add_argument("--from-beginning", action="store_true",
+                    help="replay the topic from offset 0 (default: live tail)")
+    sp.add_argument("--offsets", default=None,
+                    help="explicit start offsets, p:o[,p:o...]")
+    sp.add_argument("--group", default=None,
+                    help="consumer group: resume from (and commit) offsets")
+    sp.add_argument("--max-messages", type=int, default=None,
+                    help="exit after printing this many events")
+    sp.add_argument("--duration", type=float, default=None,
+                    help="exit after this many seconds")
+    sp.add_argument("--poll-interval", type=float, default=0.2,
+                    help="idle sleep between polls (seconds)")
     add("version", cmd_version, store=False, type_name=False)
     add("env", cmd_env, store=False, type_name=False)
     return p

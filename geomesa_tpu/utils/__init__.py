@@ -1,2 +1,11 @@
 """Foundation utilities (the geomesa-utils analogs not already absorbed by
 other layers): geohash math, audit events, metrics registry, profiling."""
+
+import datetime as _dt
+
+
+def fmt_instant_ms(ms: int) -> str:
+    """Epoch-ms -> ISO-8601 UTC with millisecond precision (the one
+    formatter CQL serialization and the CLI listen tail share)."""
+    dt = _dt.datetime.fromtimestamp(ms / 1000.0, tz=_dt.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"

@@ -165,3 +165,73 @@ def test_server_reports_errors_not_disconnects(tmp_path):
         # the connection is still usable afterwards
         b.send("t", 0, b"ok")
         assert len(b.poll("t", {})) == 1
+
+
+def test_cli_listen_from_beginning_and_tail(tmp_path, capsys):
+    """CLI ``listen`` (KafkaListenCommand.scala:22-44 analog) over the TCP
+    transport: --from-beginning replays, the default tails only NEW
+    events, --group commits offsets so a restart resumes past what it
+    already printed."""
+    from geomesa_tpu.tools import cli
+
+    with LogServer(str(tmp_path / "log"), partitions=2) as (host, port):
+        s = StreamDataStore(broker=RemoteLogBroker(host, port))
+        s.create_schema(parse_spec("t", SPEC))
+        for i in range(5):
+            s.write("t", [f"n{i}", 1760000000000 + i, Point(1.0, 2.0)],
+                    fid=f"f{i}", ts_ms=1760000000000 + i)
+        s.delete("t", "f3", ts_ms=1760000001000)
+
+        base = ["listen", "--name", "t", "--spec", SPEC,
+                "--broker", f"{host}:{port}"]
+        # replay: all 5 adds + the delete, formatted like the reference's
+        # OutFeatureListener lines
+        rc = cli.main(base + ["--from-beginning", "--max-messages", "6"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 6
+        adds = [l for l in out if "[add/update]" in l]
+        assert len(adds) == 5
+        assert any("fid=f0" in l and "n0|" in l for l in adds)
+        assert sum("[delete]" in l and "fid=f3" in l for l in out) == 1
+        assert out[0].startswith("2025-")  # ISO-formatted event time
+
+        # default start = live end: a bounded --duration run sees nothing
+        rc = cli.main(base + ["--duration", "0.3", "--poll-interval", "0.05"])
+        assert rc == 0
+        assert capsys.readouterr().out == ""
+
+        # group resume: first run prints 3 and commits; the restart
+        # resumes AFTER them (committed offsets win over --from-beginning)
+        g = ["--group", "g1", "--from-beginning"]
+        rc = cli.main(base + g + ["--max-messages", "3"])
+        assert rc == 0
+        first = capsys.readouterr().out.strip().splitlines()
+        assert len(first) == 3
+        rc = cli.main(base + g + ["--max-messages", "3"])
+        assert rc == 0
+        second = capsys.readouterr().out.strip().splitlines()
+        assert len(second) == 3
+
+        def key(line):
+            kind = "delete" if "[delete]" in line else "add"
+            fid = next(t for t in line.split() if t.startswith("fid="))
+            return (kind, fid)
+
+        # together the two bounded runs cover all 6 events exactly once
+        assert sorted(key(l) for l in first + second) == sorted(
+            [("add", f"fid=f{i}") for i in range(5)] + [("delete", "fid=f3")]
+        )
+
+
+def test_cli_listen_rejects_bad_transport_args(tmp_path, capsys):
+    from geomesa_tpu.tools import cli
+
+    rc = cli.main(["listen", "--name", "t", "--spec", SPEC])
+    assert rc == 1
+    rc = cli.main(["listen", "--name", "t", "--spec", SPEC,
+                   "--broker", "h:1", "--log-root", str(tmp_path)])
+    assert rc == 1
+    rc = cli.main(["listen", "--name", "t", "--spec", SPEC,
+                   "--broker", "nope"])
+    assert rc == 1
