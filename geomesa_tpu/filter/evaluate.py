@@ -370,11 +370,20 @@ def _eval_cmp(f: ast.Cmp, ft: FeatureType, columns: Columns) -> np.ndarray:
     return _masked_cmp(col, valid, ops[f.op])
 
 
+def like_regex(pattern: str, case_insensitive: bool):
+    """THE compiled matcher for CQL LIKE/ILIKE — shared by this host
+    evaluator and the device vocab-mask plane (executor.attr_qmask), so
+    device/host parity cannot drift: any semantics change lands in both
+    by construction."""
+    body = re.escape(pattern).replace("%", ".*").replace("_", ".")
+    return re.compile(
+        "^" + body + "$", re.IGNORECASE if case_insensitive else 0
+    )
+
+
 def _eval_like(f: ast.Like, ft: FeatureType, columns: Columns) -> np.ndarray:
     col, valid = _column(ft, f.prop, columns)
-    pattern = re.escape(f.pattern).replace("%", ".*").replace("_", ".")
-    flags = re.IGNORECASE if f.case_insensitive else 0
-    rx = re.compile("^" + pattern + "$", flags)
+    rx = like_regex(f.pattern, f.case_insensitive)
     vocab = _vocab(columns, f.prop)
     if vocab is not None:
         # run the regex over the (small) vocab once, then one int isin over

@@ -60,6 +60,63 @@ def density_kernel(
     return grid.reshape(height, width)
 
 
+_MATMUL_TILE = 8192
+
+
+def density_kernel_matmul(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    env: jnp.ndarray,
+    width: int,
+    height: int,
+) -> jnp.ndarray:
+    """The MXU edition of ``density_kernel`` in PLAIN XLA: the grid as a
+    one-hot outer-product matmul, lax.scan'd over static row tiles —
+
+        grid = (W ⊙ onehot_rows)^T @ onehot_cols   per tile, accumulated
+
+    — the same contraction the pallas kernel does
+    (pallas_kernels._density_kernel), but lowered by stock XLA, so it
+    needs no pallas compile path (the axon remote-compile helper crashed
+    on the pallas edition at 8M rows, r5 capture). Scatter-free: on TPU
+    the scatter-add edition serializes through ~n dynamic-update-slices,
+    while this stays dense matmul work. Identical grid by construction —
+    both editions snap through grid_snap_indices."""
+    col, row, in_env = grid_snap_indices(x, y, env, width, height)
+    wgt = jnp.where(mask & in_env, jnp.float32(1.0), jnp.float32(0.0))
+    n = x.shape[0]
+    pad = (-n) % _MATMUL_TILE
+    if pad:
+        col = jnp.pad(col, (0, pad))
+        row = jnp.pad(row, (0, pad))
+        wgt = jnp.pad(wgt, (0, pad))  # zero weight: padding adds nothing
+    nt = (n + pad) // _MATMUL_TILE
+    col = col.reshape(nt, _MATMUL_TILE)
+    row = row.reshape(nt, _MATMUL_TILE)
+    wgt = wgt.reshape(nt, _MATMUL_TILE)
+    rows_iota = jax.lax.broadcasted_iota(jnp.int32, (_MATMUL_TILE, height), 1)
+    cols_iota = jax.lax.broadcasted_iota(jnp.int32, (_MATMUL_TILE, width), 1)
+
+    def step(acc, rcw):
+        r, c, w = rcw
+        r1h = jnp.where(r[:, None] == rows_iota, w[:, None], jnp.float32(0.0))
+        c1h = (c[:, None] == cols_iota).astype(jnp.float32)
+        acc = acc + jax.lax.dot_general(
+            r1h, c1h,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, None
+
+    # the carry must inherit the inputs' varying-manual-axes type: under
+    # shard_map a plain jnp.zeros is unvarying and lax.scan rejects the
+    # carry-in/carry-out mismatch — seed it from a (varying) input value
+    grid0 = jnp.zeros((height, width), jnp.float32) + wgt[0, 0] * 0.0
+    grid, _ = jax.lax.scan(step, grid0, (row, col, wgt))
+    return grid
+
+
 def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
     """Build jitted shard_map density passes: per-shard fused exact-predicate
     mask + scatter, partial grids psum'd over the row axis (the client-merge
@@ -71,12 +128,16 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
 
     mode "pallas"/"pallas_spmd" swaps the per-shard inner pass for the MXU
     one-hot matmul kernel (pallas_kernels.density_grid_pallas) when the
-    grid fits its VMEM budget; "xla" keeps the scatter-add.
+    grid fits its VMEM budget; "xla_matmul" is the same contraction in
+    plain XLA (density_kernel_matmul — the pallas-free accelerator
+    edition); "xla" keeps the scatter-add (the CPU shape).
     """
     from geomesa_tpu.ops.filters import bbox_mask_f32
     from geomesa_tpu.ops.pallas_kernels import DENSITY_MAX_DIM, density_grid_pallas
 
-    use_pallas = mode != "xla" and width <= DENSITY_MAX_DIM and height <= DENSITY_MAX_DIM
+    use_pallas = mode not in ("xla", "xla_matmul") and (
+        width <= DENSITY_MAX_DIM and height <= DENSITY_MAX_DIM
+    )
 
     if use_pallas:
         def step(x, y, bins, offs, valid, boxes, windows, env):
@@ -91,13 +152,15 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
             )
             return jax.lax.psum(grid, DATA_AXIS)
     else:
+        kern = density_kernel_matmul if mode == "xla_matmul" else density_kernel
+
         def step(x, y, bins, offs, valid, boxes, windows, env):
             m = valid & bbox_mask_f32(x, y, boxes) & temporal_mask(bins, offs, windows)
-            return jax.lax.psum(density_kernel(x, y, m, env, width, height), DATA_AXIS)
+            return jax.lax.psum(kern(x, y, m, env, width, height), DATA_AXIS)
 
         def step_no_time(x, y, valid, boxes, env):
             m = valid & bbox_mask_f32(x, y, boxes)
-            return jax.lax.psum(density_kernel(x, y, m, env, width, height), DATA_AXIS)
+            return jax.lax.psum(kern(x, y, m, env, width, height), DATA_AXIS)
 
     from geomesa_tpu.parallel.mesh import shard_map_fn
 

@@ -4,11 +4,13 @@ The reference stores and serves REAL coverage data end-to-end
 (geomesa-accumulo/geomesa-accumulo-raster/: AccumuloRasterStore ingest,
 WCS GeoMesaCoverageReader serving) — this module closes the file-format
 edge of that path for the TPU build: ``read_geotiff`` parses classic
-(non-Big) TIFF with strip or tile layout, uncompressed or
-deflate-compressed, with horizontal-predictor support and GeoTIFF
-georeferencing (ModelPixelScale + ModelTiepoint); ``write_geotiff``
-emits a deflate-compressed strip layout with the same georeferencing so
-``RasterStore.read_window`` output round-trips back to disk.
+AND BigTIFF (magic 43, 64-bit offset) headers with strip or tile
+layout, uncompressed or deflate-compressed, with horizontal-predictor
+support and GeoTIFF georeferencing (ModelPixelScale + ModelTiepoint);
+``write_geotiff`` emits a deflate-compressed strip or tiled layout with
+the same georeferencing so ``RasterStore.read_window`` output
+round-trips back to disk, auto-switching to BigTIFF when the laid-out
+file would overflow classic TIFF's u32 offsets (~4 GB).
 
 Pure numpy + zlib — no GDAL in the image; the subset matches what the
 pyramid ingest needs (single- or multi-band rasters on a regular
@@ -59,21 +61,40 @@ _TYPES = {
     9: ("i", 4),   # SLONG
     11: ("f", 4),  # FLOAT
     12: ("d", 8),  # DOUBLE
+    16: ("Q", 8),  # LONG8 (BigTIFF)
+    17: ("q", 8),  # SLONG8 (BigTIFF)
+    18: ("Q", 8),  # IFD8 (BigTIFF)
 }
 
 
-def _read_ifd(buf: bytes, bo: str, off: int) -> Tuple[Dict[int, tuple], int]:
-    """One IFD -> ({tag: tuple_of_values}, next_ifd_offset)."""
-    (count,) = struct.unpack_from(bo + "H", buf, off)
+def _read_ifd(
+    buf: bytes, bo: str, off: int, big: bool = False
+) -> Tuple[Dict[int, tuple], int]:
+    """One IFD -> ({tag: tuple_of_values}, next_ifd_offset).
+
+    ``big`` reads the BigTIFF layout (TIFF magic 43): u64 entry count,
+    20-byte entries with an 8-byte inline value field, u64 next-IFD."""
+    if big:
+        (count,) = struct.unpack_from(bo + "Q", buf, off)
+        head, esize, inline_cap, off_code = 8, 20, 8, "Q"
+    else:
+        (count,) = struct.unpack_from(bo + "H", buf, off)
+        head, esize, inline_cap, off_code = 2, 12, 4, "I"
     tags: Dict[int, tuple] = {}
     for i in range(count):
-        base = off + 2 + 12 * i
-        tag, ftype, n = struct.unpack_from(bo + "HHI", buf, base)
+        base = off + head + esize * i
+        tag, ftype = struct.unpack_from(bo + "HH", buf, base)
+        (n,) = struct.unpack_from(bo + off_code, buf, base + 4)
         if ftype not in _TYPES:
             continue
         code, size = _TYPES[ftype]
         total = size * n * (2 if ftype == 5 else 1)
-        voff = base + 8 if total <= 4 else struct.unpack_from(bo + "I", buf, base + 8)[0]
+        vbase = base + 4 + (8 if big else 4)
+        voff = (
+            vbase
+            if total <= inline_cap
+            else struct.unpack_from(bo + off_code, buf, vbase)[0]
+        )
         if ftype == 2:
             tags[tag] = (buf[voff : voff + n].split(b"\0")[0].decode("latin-1"),)
         elif ftype == 5:
@@ -83,7 +104,7 @@ def _read_ifd(buf: bytes, bo: str, off: int) -> Tuple[Dict[int, tuple], int]:
             )
         else:
             tags[tag] = struct.unpack_from(bo + code * n, buf, voff)
-    (nxt,) = struct.unpack_from(bo + "I", buf, off + 2 + 12 * count)
+    (nxt,) = struct.unpack_from(bo + off_code, buf, off + head + esize * count)
     return tags, nxt
 
 
@@ -127,8 +148,8 @@ def _decode_chunk(
     return arr
 
 
-def _read_buf(path) -> Tuple[bytes, str, int]:
-    """(file bytes, byte order, first IFD offset) with format checks."""
+def _read_buf(path) -> Tuple[bytes, str, int, bool]:
+    """(file bytes, byte order, first IFD offset, is_bigtiff)."""
     if hasattr(path, "read"):
         buf = path.read()
     else:
@@ -140,22 +161,28 @@ def _read_buf(path) -> Tuple[bytes, str, int]:
         bo = ">"
     else:
         raise ValueError("not a TIFF file (bad byte-order mark)")
-    magic, ifd_off = struct.unpack_from(bo + "HI", buf, 2)
+    (magic,) = struct.unpack_from(bo + "H", buf, 2)
     if magic == 43:
-        raise ValueError("BigTIFF is not supported (classic TIFF only)")
+        # BigTIFF: u16 offset size (always 8), u16 reserved 0, u64 IFD0
+        osize, zero = struct.unpack_from(bo + "HH", buf, 4)
+        if osize != 8 or zero != 0:
+            raise ValueError(f"malformed BigTIFF header ({osize}, {zero})")
+        (ifd_off,) = struct.unpack_from(bo + "Q", buf, 8)
+        return buf, bo, ifd_off, True
     if magic != 42:
         raise ValueError(f"not a TIFF file (magic {magic})")
-    return buf, bo, ifd_off
+    (ifd_off,) = struct.unpack_from(bo + "I", buf, 4)
+    return buf, bo, ifd_off, False
 
 
 def read_geotiff(path) -> Tuple[np.ndarray, Optional[Envelope]]:
     """Classic TIFF -> (array [H,W] or [H,W,bands], envelope or None).
 
     Strip and tile layouts; compression none/deflate; predictor
-    none/horizontal; chunky planar config; FIRST IFD (use
-    ``read_geotiff_pages`` for overview pages)."""
-    buf, bo, ifd_off = _read_buf(path)
-    tags, _nxt = _read_ifd(buf, bo, ifd_off)
+    none/horizontal; chunky planar config; classic AND BigTIFF headers;
+    FIRST IFD (use ``read_geotiff_pages`` for overview pages)."""
+    buf, bo, ifd_off, big = _read_buf(path)
+    tags, _nxt = _read_ifd(buf, bo, ifd_off, big)
     return _decode_page(buf, bo, tags)
 
 
@@ -168,13 +195,13 @@ def read_geotiff_pages(
     ``overviews_only`` keeps the first page plus only pages whose
     NewSubfileType marks them reduced-resolution (bit 0) — mask pages,
     transparency pages, or unrelated multi-page images are skipped."""
-    buf, bo, ifd_off = _read_buf(path)
+    buf, bo, ifd_off, big = _read_buf(path)
     pages = []
     seen = set()
     first = True
     while ifd_off and ifd_off not in seen:
         seen.add(ifd_off)  # cycle guard on a corrupt chain
-        tags, ifd_off = _read_ifd(buf, bo, ifd_off)
+        tags, ifd_off = _read_ifd(buf, bo, ifd_off, big)
         if not first and overviews_only:
             if not tags.get(_NEW_SUBFILE_TYPE, (0,))[0] & 1:
                 continue
@@ -243,13 +270,22 @@ def write_geotiff(
     compress: bool = True,
     tile: Optional[int] = None,
     overviews: int = 0,
+    bigtiff="auto",
 ) -> None:
-    """Array [H,W] or [H,W,bands] + envelope -> classic GeoTIFF
-    (little-endian, deflate when ``compress``, EPSG:4326 geographic
-    keys). ``tile`` switches to a tiled layout (edge a multiple of 16);
-    ``overviews`` chains that many 2x box-filter reduced-resolution
-    pages as extra IFDs (NewSubfileType=1) — the pre-built pyramid
-    shape the reference's coverage pipeline produces."""
+    """Array [H,W] or [H,W,bands] + envelope -> GeoTIFF (little-endian,
+    deflate when ``compress``, EPSG:4326 geographic keys). ``tile``
+    switches to a tiled layout (edge a multiple of 16); ``overviews``
+    chains that many 2x box-filter reduced-resolution pages as extra
+    IFDs (NewSubfileType=1) — the pre-built pyramid shape the
+    reference's coverage pipeline produces.
+
+    ``bigtiff``: "auto" (default) emits a classic header unless the laid
+    out file would overflow classic TIFF's u32 offsets (~4 GB), in which
+    case the BigTIFF (magic 43, 64-bit offset) layout is used — the
+    scale edge of the reference's coverage store
+    (geomesa-accumulo-raster serves arbitrarily large mosaics from
+    chunked tables; one file here must not cap below that). True/False
+    force either format; False raises if the data cannot fit."""
     if tile is not None and tile % 16 != 0:
         raise ValueError("tile edge must be a multiple of 16")
     from geomesa_tpu.raster import clip_and_downsample
@@ -263,11 +299,14 @@ def write_geotiff(
         d, env = clip_and_downsample(d, env)
         d = np.ascontiguousarray(d)
         pages.append((d, env, True))
-    _write_pages(path, pages, compress, tile)
+    _write_pages(path, pages, compress, tile, bigtiff)
 
 
-def _page_chunks(data, envelope, compress, tile, reduced):
-    """(entries, chunks) for one IFD page; offsets patched at layout."""
+def _page_chunks(data, envelope, compress, tile, reduced, big=False):
+    """(entries, chunks) for one IFD page; offsets patched at layout.
+    ``big`` types the chunk offset/count arrays LONG8 so they can hold
+    >4GB positions."""
+    otype = 16 if big else 4
     if data.ndim == 2:
         data = data[:, :, None]
     if data.ndim != 3:
@@ -293,9 +332,9 @@ def _page_chunks(data, envelope, compress, tile, reduced):
                 chunks.append(zlib.compress(raw, 6) if compress else raw)
         entries.append((_TILE_WIDTH, 3, 1, (tile,)))
         entries.append((_TILE_LENGTH, 3, 1, (tile,)))
-        entries.append((_TILE_OFFSETS, 4, len(chunks), None))
+        entries.append((_TILE_OFFSETS, otype, len(chunks), None))
         entries.append(
-            (_TILE_BYTE_COUNTS, 4, len(chunks),
+            (_TILE_BYTE_COUNTS, otype, len(chunks),
              tuple(len(c) for c in chunks))
         )
     else:
@@ -304,10 +343,10 @@ def _page_chunks(data, envelope, compress, tile, reduced):
         for r0 in range(0, h, rps):
             raw = data[r0 : r0 + rps].tobytes()
             chunks.append(zlib.compress(raw, 6) if compress else raw)
-        entries.append((_STRIP_OFFSETS, 4, len(chunks), None))
+        entries.append((_STRIP_OFFSETS, otype, len(chunks), None))
         entries.append((_ROWS_PER_STRIP, 4, 1, (rps,)))
         entries.append(
-            (_STRIP_BYTE_COUNTS, 4, len(chunks),
+            (_STRIP_BYTE_COUNTS, otype, len(chunks),
              tuple(len(c) for c in chunks))
         )
 
@@ -336,70 +375,118 @@ def _page_chunks(data, envelope, compress, tile, reduced):
     return entries, chunks
 
 
-def _write_pages(path, pages, compress, tile) -> None:
+def _write_pages(path, pages, compress, tile, bigtiff="auto") -> None:
     """Serialize a chain of (data, envelope, reduced) IFD pages:
-    header | [IFD + overflow values] per page | all chunk data."""
+    header | [IFD + overflow values] per page | all chunk data
+    (chunk data streamed, not buffered — a BigTIFF-scale payload must
+    not be duplicated into one giant bytearray)."""
 
     def value_bytes(ftype, vals):
         code = _TYPES[ftype][0]
         return struct.pack("<" + code * len(vals), *vals)
 
-    built = [_page_chunks(d, e, compress, tile, r) for d, e, r in pages]
-    # layout pass: place every IFD + its overflow, then the data region
-    pos = 8
-    layouts = []  # (ifd_off, over_off, placeholders)
-    for entries, _chunks in built:
-        ifd_off = pos
-        over_off = ifd_off + 2 + 12 * len(entries) + 4
-        placeholders = {}
-        osize = 0
-        for tag, ftype, n, _vals in entries:
-            size = _TYPES[ftype][1] * n
-            if size > 4:
-                placeholders[tag] = osize
-                osize += size
-        layouts.append((ifd_off, over_off, placeholders))
-        pos = over_off + osize
-    chunk_offsets = []
-    for _entries, chunks in built:
-        offs = []
-        for c in chunks:
-            offs.append(pos)
-            pos += len(c)
-        chunk_offsets.append(offs)
+    def layout(big: bool):
+        """(layouts, chunk_offsets, total) for one header flavor."""
+        head = 16 if big else 8
+        ecount = 8 if big else 2
+        esize = 20 if big else 12
+        nxt_sz = 8 if big else 4
+        inline = 8 if big else 4
+        pos = head
+        louts = []  # (ifd_off, over_off, placeholders)
+        for entries, _chunks in built:
+            ifd_off = pos
+            over_off = ifd_off + ecount + esize * len(entries) + nxt_sz
+            placeholders = {}
+            osize = 0
+            for tag, ftype, n, _vals in entries:
+                size = _TYPES[ftype][1] * n
+                if size > inline:
+                    placeholders[tag] = osize
+                    osize += size
+            louts.append((ifd_off, over_off, placeholders))
+            pos = over_off + osize
+        offsets = []
+        for _entries, chunks in built:
+            offs = []
+            for c in chunks:
+                offs.append(pos)
+                pos += len(c)
+            offsets.append(offs)
+        return louts, offsets, pos
 
+    if bigtiff not in (True, False, "auto"):
+        # normalize truthy non-bool (np.True_, 1) rather than silently
+        # treating it as classic and later erroring "pass bigtiff=True"
+        bigtiff = bool(bigtiff)
+    big = bigtiff is True
+    built = [_page_chunks(d, e, compress, tile, r, big) for d, e, r in pages]
+    if bigtiff == "auto":
+        _l, _o, total = layout(False)
+        if total > 0xFFFF0000:  # classic u32 offsets would overflow
+            big = True
+            # chunk BYTES are identical across the flag — only the
+            # offset/count entry TYPES change. Retype in place instead of
+            # re-running deflate over a >4GB payload.
+            retype = (_STRIP_OFFSETS, _TILE_OFFSETS,
+                      _STRIP_BYTE_COUNTS, _TILE_BYTE_COUNTS)
+            built = [
+                (
+                    [
+                        (tag, 16 if tag in retype else ftype, n, vals)
+                        for tag, ftype, n, vals in entries
+                    ],
+                    chunks,
+                )
+                for entries, chunks in built
+            ]
+    layouts, chunk_offsets, total = layout(big)
+    if not big and total > 0xFFFFFFFF:
+        raise ValueError(
+            f"classic TIFF cannot address {total} bytes; pass bigtiff=True"
+        )
+
+    inline = 8 if big else 4
+    off_code = "Q" if big else "I"
     out = bytearray()
-    out += struct.pack("<2sHI", b"II", 42, layouts[0][0])
+    if big:
+        out += struct.pack("<2sHHHQ", b"II", 43, 8, 0, layouts[0][0])
+    else:
+        out += struct.pack("<2sHI", b"II", 42, layouts[0][0])
     for pi, ((entries, chunks), (ifd_off, over_off, placeholders)) in enumerate(
         zip(built, layouts)
     ):
         assert len(out) == ifd_off
-        out += struct.pack("<H", len(entries))
+        out += struct.pack("<" + ("Q" if big else "H"), len(entries))
         osize = sum(
             _TYPES[ft][1] * n
             for _t, ft, n, _v in entries
-            if _TYPES[ft][1] * n > 4
+            if _TYPES[ft][1] * n > inline
         )
         over = bytearray(osize)
         for tag, ftype, n, vals in entries:
             if tag in (_STRIP_OFFSETS, _TILE_OFFSETS) and vals is None:
                 vals = tuple(chunk_offsets[pi])
             vb = value_bytes(ftype, vals)
-            if len(vb) <= 4:
-                out += struct.pack("<HHI", tag, ftype, n) + vb.ljust(4, b"\0")
+            out += struct.pack("<HH" + off_code, tag, ftype, n)
+            if len(vb) <= inline:
+                out += vb.ljust(inline, b"\0")
             else:
                 voff = over_off + placeholders[tag]
-                out += struct.pack("<HHII", tag, ftype, n, voff)
+                out += struct.pack("<" + off_code, voff)
                 over[placeholders[tag] : placeholders[tag] + len(vb)] = vb
         nxt = layouts[pi + 1][0] if pi + 1 < len(layouts) else 0
-        out += struct.pack("<I", nxt)
+        out += struct.pack("<" + off_code, nxt)
         out += over
-    for _entries, chunks in built:
-        for c in chunks:
-            out += c
+
+    def stream(f) -> None:
+        f.write(bytes(out))
+        for _entries, chunks in built:
+            for c in chunks:
+                f.write(c)
 
     if hasattr(path, "write"):
-        path.write(bytes(out))
+        stream(path)
     else:
         with open(path, "wb") as f:
-            f.write(bytes(out))
+            stream(f)

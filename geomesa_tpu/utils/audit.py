@@ -215,6 +215,112 @@ class DelimitedFileReporter(Reporter):
                     fh.write(f"{now}\t{name}\t{val}\n")
 
 
+class GraphiteReporter(Reporter):
+    """Network reporter speaking the Graphite/Carbon plaintext protocol
+    (metrics/config/MetricsConfig.scala:26,99-117's GraphiteReporter
+    role): one ``<prefix>.<name> <value> <epoch-s>`` line per metric over
+    a persistent TCP connection. Timer dicts flatten to dotted leaves
+    (``name.count``, ``name.mean_ms``, ...). A broken connection is
+    re-dialed once per emission; a still-unreachable carbon endpoint
+    drops that snapshot (metrics are telemetry — they must never take
+    the query path down with them)."""
+
+    def __init__(self, registry, host: str, port: int = 2003,
+                 prefix: str = "geomesa", interval_s: float = 60.0):
+        super().__init__(registry, interval_s)
+        self.host = host
+        self.port = port
+        self.prefix = prefix.rstrip(".")
+        self._sock: Any = None
+
+    def _lines(self, snapshot: Dict[str, Any], now_s: int):
+        for name, val in sorted(snapshot.items()):
+            base = f"{self.prefix}.{name}" if self.prefix else name
+            if isinstance(val, dict):
+                for k, v in sorted(val.items()):
+                    yield f"{base}.{k} {float(v):g} {now_s}\n"
+            else:
+                yield f"{base} {float(val):g} {now_s}\n"
+
+    def _connect(self):
+        import socket
+
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=10
+            )
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def stop(self) -> None:
+        super().stop()
+        self.close()
+
+    def emit(self, snapshot):
+        payload = "".join(self._lines(snapshot, int(time.time()))).encode()
+        if not payload:
+            return
+        for attempt in (0, 1):  # one reconnect per emission
+            try:
+                self._connect().sendall(payload)
+                return
+            except OSError:
+                self.close()
+        # carbon unreachable: drop this snapshot (next interval retries)
+
+
+def reporters_from_config(
+    config: Dict[str, Any], registry: MetricsRegistry, start: bool = True
+):
+    """Config-driven reporter construction (MetricsConfig.reporters,
+    metrics/config/MetricsConfig.scala:29-50): ``config`` maps arbitrary
+    reporter names to ``{"type": ..., ...}`` blocks; invalid blocks warn
+    and are skipped rather than failing the rest.
+
+    Types: console | slf4j | delimited-text | graphite.
+    Common key: ``interval`` (seconds, default 60)."""
+    import warnings
+
+    out = []
+    for key, block in config.items():
+        try:
+            typ = str(block["type"]).lower()
+            interval = float(block.get("interval", 60.0))
+            if typ == "console":
+                r = ConsoleReporter(registry, interval_s=interval)
+            elif typ == "slf4j":
+                r = LoggingReporter(
+                    registry, interval_s=interval,
+                    logger_name=block.get("logger", "geomesa.metrics"),
+                )
+            elif typ == "delimited-text":
+                r = DelimitedFileReporter(
+                    registry, block["output"], interval_s=interval
+                )
+            elif typ == "graphite":
+                host, _, port = str(block["url"]).rpartition(":")
+                r = GraphiteReporter(
+                    registry, host, int(port),
+                    prefix=block.get("prefix", "geomesa"),
+                    interval_s=interval,
+                )
+            else:
+                raise ValueError(f"unknown reporter type {typ!r}")
+        except Exception as e:  # noqa: BLE001 - mirror the reference's skip
+            warnings.warn(f"invalid reporter config {key!r}: {e}", stacklevel=2)
+            continue
+        if start:
+            r.start()
+        out.append(r)
+    return out
+
+
 class QueryTimeout(RuntimeError):
     """Raised when a query exceeds the store's timeout budget
     (the ThreadManagement reaper analog, index/utils/ThreadManagement.scala:

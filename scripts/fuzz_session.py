@@ -112,6 +112,21 @@ def one_round(seed: int) -> int:
             "age = 33 AND bbox(geom, -45, -40, 45, 40)",
             "age IN (12, 34, 56) AND bbox(geom, -55, -40, 50, 42)",
             "age > 64 AND age < 12 AND bbox(geom, -50, -40, 40, 40)",
+            # round-5 plane editions: complement membership ('<>'
+            # chains), wide IN (K in (8, 32]), and the vocab-mask plane
+            # (ILIKE / '_' / interior '%' via the oracle-regex mask)
+            "tag <> 'tag-3' AND bbox(geom, -50, -40, 40, 40)",
+            "tag <> 'tag-0' AND tag <> 'tag-5' AND "
+            "bbox(geom, -55, -45, 45, 45) AND "
+            "dtg DURING 2026-01-02T00:00:00Z/2026-01-20T00:00:00Z",
+            "age <> 33 AND bbox(geom, -45, -40, 45, 40)",
+            "age IN (" + ", ".join(str(v) for v in range(10, 34)) + ") "
+            "AND bbox(geom, -55, -40, 50, 42)",
+            "tag ILIKE 'TAG-2' AND bbox(geom, -50, -40, 40, 40)",
+            "tag ILIKE 'TaG-%' AND bbox(geom, -40, -35, 50, 40)",
+            "tag LIKE 'tag-_' AND bbox(geom, -55, -45, 45, 45)",
+            "tag LIKE '%g-4%' AND bbox(geom, -50, -40, 40, 40) AND "
+            "dtg DURING 2026-01-03T00:00:00Z/2026-01-18T00:00:00Z",
         ]
         wants = {}
         for q in queries:

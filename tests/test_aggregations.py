@@ -169,3 +169,66 @@ def test_device_density_exact_exclusive_bounds(host_store, tpu_store):
     want = host_store.query("agg", q).aggregate["density"]
     got = tpu_store.query("agg", q).aggregate["density"]
     np.testing.assert_allclose(got, want)
+
+
+def test_density_matmul_edition_matches_scatter():
+    """density_kernel_matmul (the pallas-free MXU contraction) must
+    produce the identical grid as the scatter-add edition — both snap
+    through grid_snap_indices, so equality is exact, including the
+    sub-tile padding path."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.ops.aggregations import (
+        density_kernel,
+        density_kernel_matmul,
+    )
+
+    rng = np.random.default_rng(21)
+    for n in (100, 8192, 20000):
+        x = jnp.asarray(rng.uniform(-30, 30, n), jnp.float32)
+        y = jnp.asarray(rng.uniform(-30, 30, n), jnp.float32)
+        mask = jnp.asarray(rng.random(n) < 0.7)
+        env = jnp.asarray([-20.0, -20.0, 20.0, 20.0], jnp.float32)
+        a = np.asarray(density_kernel(x, y, mask, env, 32, 16))
+        b = np.asarray(density_kernel_matmul(x, y, mask, env, 32, 16))
+        np.testing.assert_array_equal(a, b)
+
+
+def test_density_pallas_failure_downgrades_to_matmul(monkeypatch):
+    """A pallas density kernel that fails at RUNTIME (the r5 silicon
+    shape: axon remote-compile 500) must downgrade to the XLA matmul
+    edition for the session — same grid, no host fallback, ONE warning,
+    and no pallas retry on subsequent queries."""
+    from geomesa_tpu.ops import aggregations as agg
+    from geomesa_tpu.parallel import executor as ex
+
+    calls = {"pallas": 0}
+
+    def exploding(*a, **k):
+        calls["pallas"] += 1
+        raise RuntimeError("synthetic remote-compile failure")
+
+    monkeypatch.setattr(agg, "density_grid_pallas", exploding, raising=False)
+    import geomesa_tpu.ops.pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "density_grid_pallas", exploding)
+    # force the pallas mode on the CPU backend (interpret mode)
+    monkeypatch.setenv("GEOMESA_PALLAS", "1")
+    monkeypatch.setenv("GEOMESA_DENSITY_DEVICE", "1")
+
+    host = TpuDataStore(executor=HostScanExecutor())
+    _fill(host)
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    _fill(tpu)
+    q = Query.cql(CQL, hints={"density": dict(DENSITY)})
+    want = host.query("agg", q).aggregate["density"]
+    with pytest.warns(RuntimeWarning, match="downgrading to the XLA matmul"):
+        res = tpu.query("agg", q)
+    assert res.plan.scan_path == "device-density"
+    np.testing.assert_allclose(res.aggregate["density"], want)
+    assert calls["pallas"] >= 1
+    before = calls["pallas"]
+    res2 = tpu.query("agg", q)  # downgrade is sticky: no pallas retry
+    assert res2.plan.scan_path == "device-density"
+    assert calls["pallas"] == before
+    np.testing.assert_allclose(res2.aggregate["density"], want)

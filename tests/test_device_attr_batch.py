@@ -150,11 +150,68 @@ def test_attr_in_list_with_time_and_lone():
 
 
 def test_attr_in_list_too_long_falls_back():
-    """Lists past the K bucket cap keep the conservative host path and
-    still answer exactly."""
+    """Lists past the K bucket cap (32) keep the conservative host path
+    and still answer exactly."""
     host, tpu = _stores(n=6000)
-    vals = ", ".join(f"'v{i}'" for i in range(12))
+    vals = ", ".join(f"'v{i}'" for i in range(40))
     _parity(host, tpu, [f"kind IN ({vals}, 'k1') AND bbox(geom, -60, -40, 40, 30)"])
+
+
+def test_attr_in_list_wide_k_rides_device():
+    """K in (8, 32] — the round-5 cap raise: a 13-distinct-value IN-list
+    pads into the K=16 bucket and decides on device."""
+    from geomesa_tpu.parallel import executor as ex
+
+    host, tpu = _stores(n=6000)
+    vals = ", ".join(f"'v{i}'" for i in range(11))
+    cql = f"kind IN ({vals}, 'k1', 'k2') AND bbox(geom, -60, -40, 40, 30)"
+    from geomesa_tpu.index.planner import Query
+
+    plan = tpu.planner("t").plan(Query.cql(cql))
+    table = tpu._tables["t"][plan.index.name]
+    desc = tpu.executor._attr_batch_desc(table, plan)
+    assert desc is not None and desc[1] == "member"
+    assert len(desc[2][2]) == 13
+    _parity(host, tpu, [cql, cql.replace("40, 30", "50, 40")])
+
+
+def test_attr_not_equal_rides_notmember_plane():
+    """`<>` chains decide on device via the complement-membership
+    edition: null rows never match, absent excluded literals exclude
+    nothing, chains AND together."""
+    host, tpu = _stores()
+    cqls = [
+        "kind <> 'k1' AND bbox(geom, -60, -40, 40, 30)",
+        "kind <> 'k0' AND kind <> 'k3' AND bbox(geom, -100, -60, 80, 60)",
+        "kind <> 'absent' AND bbox(geom, -60, -40, 40, 30)",
+        "kind <> 'k2' AND bbox(geom, 0, 0, 90, 70) AND "
+        "dtg DURING 2026-01-03T00:00:00Z/2026-01-12T00:00:00Z",
+    ]
+    from geomesa_tpu.index.planner import Query
+
+    plan = tpu.planner("t").plan(Query.cql(cqls[1]))
+    table = tpu._tables["t"][plan.index.name]
+    desc = tpu.executor._attr_batch_desc(table, plan)
+    assert desc is not None and desc[1] == "notmember"
+    assert desc[2][2] == ("k0", "k3")
+    got = _parity(host, tpu, cqls)
+    # the complement must actually exclude nulls (data has them)
+    assert all("kind" not in r.columns or None not in r.columns["kind"]
+               for r in got)
+
+
+def test_attr_not_equal_mixed_with_range_stays_host():
+    """`<>` combined with order predicates on the same attr declines the
+    device plane (host path answers exactly)."""
+    host, tpu = _stores(n=6000)
+    cql = ("kind <> 'k1' AND kind > 'k0' AND "
+           "bbox(geom, -60, -40, 40, 30)")
+    from geomesa_tpu.index.planner import Query
+
+    plan = tpu.planner("t").plan(Query.cql(cql))
+    table = tpu._tables["t"][plan.index.name]
+    assert tpu.executor._attr_batch_desc(table, plan) is None
+    _parity(host, tpu, [cql])
 
 
 def test_lone_attr_query_stays_on_device():
@@ -181,3 +238,48 @@ def test_attr_shape_rejects_non_eligible():
         "kind = 'k1' AND kind = 'k2' AND bbox(geom, -60, -40, 40, 30)",
     ]
     _parity(host, tpu, cqls)
+
+
+def test_ilike_and_wildcards_ride_vocabmask_plane():
+    """ILIKE and general LIKE wildcards ('_', interior '%') decide on
+    device via the vocab-mask edition — the oracle's own regex evaluated
+    over each segment's unified vocab, so parity is by construction."""
+    from geomesa_tpu.index.planner import Query
+
+    host, tpu = _stores()
+    cqls = [
+        "kind ILIKE 'K1' AND bbox(geom, -60, -40, 40, 30)",
+        "kind ILIKE 'k%' AND bbox(geom, -100, -60, 80, 60)",
+        "kind LIKE 'k_' AND bbox(geom, -60, -40, 40, 30)",
+        "kind LIKE '%1%' AND bbox(geom, 0, 0, 90, 70)",
+        "kind ILIKE 'K_' AND bbox(geom, -60, -40, 40, 30) AND "
+        "dtg DURING 2026-01-03T00:00:00Z/2026-01-12T00:00:00Z",
+    ]
+    plan = tpu.planner("t").plan(Query.cql(cqls[0]))
+    table = tpu._tables["t"][plan.index.name]
+    desc = tpu.executor._attr_batch_desc(table, plan)
+    assert desc is not None and desc[1] == "vocabmask"
+    assert desc[2][2] == ("K1", True)
+    _parity(host, tpu, cqls)
+
+
+def test_vocabmask_lone_and_count():
+    host, tpu = _stores(n=8000)
+    cql = "kind ILIKE 'K2' AND bbox(geom, -60, -40, 40, 30)"
+    _parity(host, tpu, [cql])  # lone query: single-dispatch edition
+    assert tpu.count("t", cql) == len(host.query("t", cql))
+
+
+def test_vocabmask_declines_oversized_vocab(monkeypatch):
+    """A unified vocab past the cap keeps the host path (still exact)."""
+    host, tpu = _stores(n=6000)
+    # crush the cap on every live segment instead of synthesizing a
+    # 65k-value vocab
+    table = tpu._tables["t"]["z2"]
+    dev = tpu.executor.device_index(table)
+    tpu.query_many("t", CQLS_Z2[:2])  # build mirror + codes
+    for seg in dev.segments:
+        monkeypatch.setattr(type(seg), "ATTR_VOCAB_MASK_CAP", 2,
+                            raising=False)
+    _parity(host, tpu, ["kind ILIKE 'K1' AND bbox(geom, -60, -40, 40, 30)",
+                        "kind ILIKE 'K3' AND bbox(geom, -90, -50, 70, 55)"])

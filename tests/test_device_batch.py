@@ -79,23 +79,26 @@ def test_batched_query_many_parity_time():
     rng = np.random.default_rng(3)
     cqls = _cqls(rng, 12, with_time=True)
     calls = {"batch": 0}
-    orig_runs, orig_packed = ex._exact_runs_batch_fn, ex._exact_packed_batch_fn
+    # spy on every batch-kernel builder: which one runs depends on the
+    # default wire format (runs_packed single-device CPU; per-shard
+    # bitmap on multi-device meshes)
+    spied = ("_exact_runs_batch_fn", "_exact_packed_batch_fn",
+             "_exact_bitmap_batch_fn", "_exact_shard_bitmap_batch_fn")
+    origs = {name: getattr(ex, name) for name in spied}
 
-    def counting_runs(*a, **k):
-        calls["batch"] += 1
-        return orig_runs(*a, **k)
+    def counting(orig):
+        def wrapped(*a, **k):
+            calls["batch"] += 1
+            return orig(*a, **k)
+        return wrapped
 
-    def counting_packed(*a, **k):
-        calls["batch"] += 1
-        return orig_packed(*a, **k)
-
-    ex._exact_runs_batch_fn = counting_runs
-    ex._exact_packed_batch_fn = counting_packed
+    for name in spied:
+        setattr(ex, name, counting(origs[name]))
     try:
         got = tpu.query_many("t", cqls)
     finally:
-        ex._exact_runs_batch_fn = orig_runs
-        ex._exact_packed_batch_fn = orig_packed
+        for name in spied:
+            setattr(ex, name, origs[name])
     assert calls["batch"] >= 1  # the fused path ran
     for cql, res in zip(cqls, got):
         assert _fids(res) == _fids(host.query("t", cql)), cql

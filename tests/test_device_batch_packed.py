@@ -25,6 +25,11 @@ def _force_batch(monkeypatch):
     monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "1")
     monkeypatch.setenv("GEOMESA_DEVBATCH", "1")
     monkeypatch.setenv("GEOMESA_SEEK", "0")
+    # this file pins down the packed/replicated wire formats; the
+    # multi-device default is now bitmap + per-shard extraction, so the
+    # paths under test must be selected explicitly
+    monkeypatch.setenv("GEOMESA_BATCH_PROTO", "runs_packed")
+    monkeypatch.setenv("GEOMESA_SHARD_EXTRACT", "0")
 
 
 def _stores(x, y, t):

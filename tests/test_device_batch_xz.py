@@ -84,17 +84,25 @@ def test_xz2_batched_parity():
     rng = np.random.default_rng(1)
     cqls = _queries(rng, 10, time_frac=0.0)
     calls = {"n": 0}
-    orig = ex._xz_runs_batch_fn
+    # spy every xz batch-kernel builder: the wire format (runs vs
+    # bitmap/shard) depends on the mesh-aware default proto
+    spied = ("_xz_runs_batch_fn", "_xz_bitmap_batch_fn",
+             "_dual_shard_bitmap_batch_fn")
+    origs = {name: getattr(ex, name) for name in spied}
 
-    def counting(*a, **k):
-        calls["n"] += 1
-        return orig(*a, **k)
+    def counting(orig):
+        def wrapped(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+        return wrapped
 
-    ex._xz_runs_batch_fn = counting
+    for name in spied:
+        setattr(ex, name, counting(origs[name]))
     try:
         got = tpu.query_many("e", cqls)
     finally:
-        ex._xz_runs_batch_fn = orig
+        for name in spied:
+            setattr(ex, name, origs[name])
     assert calls["n"] >= 1
     for cql, res in zip(cqls, got):
         assert _fids(res) == _fids(host.query("e", cql)), cql

@@ -349,3 +349,75 @@ def test_missing_georef_reads_but_wont_ingest(tmp_path):
     p.write_bytes(raw)
     with pytest.raises(ValueError, match="georeferencing"):
         RasterStore().ingest_geotiff(p)
+
+
+def test_bigtiff_roundtrip_forced():
+    """BigTIFF (magic 43, 64-bit offsets): forced writes round-trip in
+    every layout the classic path supports — the format edge of the
+    reference's arbitrarily-large coverage mosaics
+    (geomesa-accumulo-raster)."""
+    import io
+
+    from geomesa_tpu.geom.base import Envelope
+    from geomesa_tpu.raster_io import read_geotiff, read_geotiff_pages, write_geotiff
+
+    rng = np.random.default_rng(17)
+    env = Envelope(-10.0, 20.0, 22.0, 36.0)
+    for data, kwargs in [
+        (rng.integers(0, 4000, (37, 53)).astype(np.uint16), {}),
+        (rng.normal(size=(40, 48)).astype(np.float32), {"tile": 16}),
+        (rng.integers(0, 255, (64, 80, 3)).astype(np.uint8),
+         {"tile": 32, "overviews": 2}),
+        (rng.integers(-500, 500, (33, 47)).astype(np.int32),
+         {"compress": False}),
+    ]:
+        buf = io.BytesIO()
+        write_geotiff(buf, data, env, bigtiff=True, **kwargs)
+        raw = buf.getvalue()
+        assert raw[:4] == b"II+\x00" and raw[4:6] == b"\x08\x00"  # magic 43
+        got, genv = read_geotiff(io.BytesIO(raw))
+        np.testing.assert_array_equal(got, data)
+        assert genv is not None and abs(genv.xmin - env.xmin) < 1e-9
+        if kwargs.get("overviews"):
+            pages = read_geotiff_pages(io.BytesIO(raw), overviews_only=True)
+            assert len(pages) == 1 + kwargs["overviews"]
+            assert pages[1][0].shape[0] == data.shape[0] // 2
+
+
+def test_bigtiff_auto_stays_classic_for_small():
+    import io
+
+    from geomesa_tpu.geom.base import Envelope
+    from geomesa_tpu.raster_io import write_geotiff
+
+    buf = io.BytesIO()
+    write_geotiff(
+        buf, np.zeros((8, 8), np.uint8), Envelope(0, 0, 1, 1)
+    )
+    assert buf.getvalue()[2:4] == b"\x2a\x00"  # classic magic 42
+
+
+def test_classic_overflow_refused_when_bigtiff_false(monkeypatch):
+    """bigtiff=False on an over-4GB layout must raise, not truncate
+    offsets. (Patches the overflow guard's threshold comparison by
+    wrapping _page_chunks to report giant chunks without allocating.)"""
+    import io
+
+    from geomesa_tpu import raster_io
+    from geomesa_tpu.geom.base import Envelope
+
+    class FakeChunk(bytes):
+        def __len__(self):
+            return 1 << 31  # 2 GB each, 3 strips -> >4GB layout
+
+    orig = raster_io._page_chunks
+
+    def fake(data, envelope, compress, tile, reduced, big=False):
+        entries, chunks = orig(data, envelope, compress, tile, reduced, big)
+        return entries, [FakeChunk(c) for c in chunks] * 3
+    monkeypatch.setattr(raster_io, "_page_chunks", fake)
+    with pytest.raises(ValueError, match="cannot address"):
+        raster_io.write_geotiff(
+            io.BytesIO(), np.zeros((4, 4), np.uint8),
+            Envelope(0, 0, 1, 1), bigtiff=False,
+        )

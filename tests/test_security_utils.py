@@ -226,3 +226,105 @@ def test_audit_scan_path_label(monkeypatch):
     assert path.startswith("device"), path
     # batched/forced device scans also audit their wire format
     assert path == "device-seek" or "/" in path, path
+
+
+def test_graphite_reporter_plaintext_protocol():
+    """GraphiteReporter (MetricsConfig.scala:26 graphite role): carbon
+    plaintext lines over TCP, timer dicts flattened to dotted leaves,
+    reconnect on a broken connection, unreachable endpoint tolerated."""
+    import socket
+    import threading
+
+    from geomesa_tpu.utils.audit import GraphiteReporter, MetricsRegistry
+
+    received = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    port = srv.getsockname()[1]
+
+    def accept_one():
+        conn, _ = srv.accept()
+        data = b""
+        conn.settimeout(5)
+        try:
+            while not data.endswith(b"\n") or data.count(b"\n") < 3:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        except socket.timeout:
+            pass
+        received.append(data.decode())
+        conn.close()
+
+    reg = MetricsRegistry()
+    reg.inc("planner.plans", 3)
+    with reg.timer("scan.exec"):
+        pass
+    t = threading.Thread(target=accept_one, daemon=True)
+    t.start()
+    rep = GraphiteReporter(reg, "127.0.0.1", port, prefix="gm.test")
+    rep.report_now()
+    rep.close()
+    t.join(timeout=10)
+    assert received, "carbon server saw no payload"
+    lines = received[0].strip().splitlines()
+    assert any(l.startswith("gm.test.planner.plans 3 ") for l in lines)
+    assert any(l.startswith("gm.test.scan.exec.count 1 ") for l in lines)
+    for l in lines:  # every line is <path> <float> <epoch-s>
+        path, val, ts = l.split()
+        float(val), int(ts)
+
+    # reconnect: the server socket accepts a NEW connection per emission
+    t2 = threading.Thread(target=accept_one, daemon=True)
+    t2.start()
+    rep.report_now()
+    rep.close()
+    t2.join(timeout=10)
+    assert len(received) == 2
+    srv.close()
+
+    # unreachable carbon must not raise (telemetry never fails the caller)
+    dead = GraphiteReporter(reg, "127.0.0.1", port)
+    dead.report_now()
+
+
+def test_reporters_from_config_factory(tmp_path):
+    """MetricsConfig.reporters analog: typed blocks build reporters,
+    invalid blocks warn and are skipped."""
+    import warnings
+
+    from geomesa_tpu.utils.audit import (
+        ConsoleReporter,
+        DelimitedFileReporter,
+        GraphiteReporter,
+        LoggingReporter,
+        MetricsRegistry,
+        reporters_from_config,
+    )
+
+    reg = MetricsRegistry()
+    reg.inc("c", 1)
+    cfg = {
+        "con": {"type": "console", "interval": 5},
+        "log": {"type": "slf4j", "logger": "gm.x"},
+        "file": {"type": "delimited-text",
+                 "output": str(tmp_path / "m.tsv"), "interval": 1},
+        "net": {"type": "graphite", "url": "127.0.0.1:12003",
+                "prefix": "gm"},
+        "bad": {"type": "nope"},
+        "worse": {},
+    }
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        reps = reporters_from_config(cfg, reg, start=False)
+    assert [type(r) for r in reps] == [
+        ConsoleReporter, LoggingReporter, DelimitedFileReporter,
+        GraphiteReporter,
+    ]
+    assert reps[0].interval_s == 5.0
+    assert reps[3].port == 12003 and reps[3].prefix == "gm"
+    assert sum("invalid reporter config" in str(x.message) for x in w) == 2
+    reps[2].report_now()
+    assert "\tc\t1" in (tmp_path / "m.tsv").read_text()
