@@ -60,7 +60,7 @@ def density_kernel(
     return grid.reshape(height, width)
 
 
-_MATMUL_TILE = 8192
+_MATMUL_TILE = 16384
 
 
 def density_kernel_matmul(
@@ -100,8 +100,12 @@ def density_kernel_matmul(
 
     def step(acc, rcw):
         r, c, w = rcw
-        r1h = jnp.where(r[:, None] == rows_iota, w[:, None], jnp.float32(0.0))
-        c1h = (c[:, None] == cols_iota).astype(jnp.float32)
+        # bf16 one-hots (0/1 weights are exact in bf16) with f32
+        # accumulation: the MXU's native input width, ~2x the f32 path
+        r1h = jnp.where(
+            r[:, None] == rows_iota, w[:, None], jnp.float32(0.0)
+        ).astype(jnp.bfloat16)
+        c1h = (c[:, None] == cols_iota).astype(jnp.bfloat16)
         acc = acc + jax.lax.dot_general(
             r1h, c1h,
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -117,6 +121,27 @@ def density_kernel_matmul(
     return grid
 
 
+def density_kernel_sort(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    env: jnp.ndarray,
+    width: int,
+    height: int,
+) -> jnp.ndarray:
+    """Sort-based edition: flat cell ids sorted once, counts read off as
+    differences of searchsorted boundaries — integer-exact, no scatter,
+    no per-cell FLOPs (the matmul edition pays 2*H*W FLOPs PER ROW; this
+    pays one 32-bit sort + H*W binary searches total). Masked rows sort
+    into a discard bucket past the grid."""
+    col, row, in_env = grid_snap_indices(x, y, env, width, height)
+    hw = height * width
+    flat = jnp.where(mask & in_env, row * width + col, jnp.int32(hw))
+    s = jnp.sort(flat)
+    bounds = jnp.searchsorted(s, jnp.arange(hw + 1, dtype=jnp.int32))
+    return jnp.diff(bounds).astype(jnp.float32).reshape(height, width)
+
+
 def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
     """Build jitted shard_map density passes: per-shard fused exact-predicate
     mask + scatter, partial grids psum'd over the row axis (the client-merge
@@ -130,12 +155,13 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
     one-hot matmul kernel (pallas_kernels.density_grid_pallas) when the
     grid fits its VMEM budget; "xla_matmul" is the same contraction in
     plain XLA (density_kernel_matmul — the pallas-free accelerator
-    edition); "xla" keeps the scatter-add (the CPU shape).
+    edition); "xla_sort" counts via one sort + boundary searches
+    (density_kernel_sort); "xla" keeps the scatter-add (the CPU shape).
     """
     from geomesa_tpu.ops.filters import bbox_mask_f32
     from geomesa_tpu.ops.pallas_kernels import DENSITY_MAX_DIM, density_grid_pallas
 
-    use_pallas = mode not in ("xla", "xla_matmul") and (
+    use_pallas = mode not in ("xla", "xla_matmul", "xla_sort") and (
         width <= DENSITY_MAX_DIM and height <= DENSITY_MAX_DIM
     )
 
@@ -152,7 +178,10 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
             )
             return jax.lax.psum(grid, DATA_AXIS)
     else:
-        kern = density_kernel_matmul if mode == "xla_matmul" else density_kernel
+        kern = {
+            "xla_matmul": density_kernel_matmul,
+            "xla_sort": density_kernel_sort,
+        }.get(mode, density_kernel)
 
         def step(x, y, bins, offs, valid, boxes, windows, env):
             m = valid & bbox_mask_f32(x, y, boxes) & temporal_mask(bins, offs, windows)

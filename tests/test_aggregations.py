@@ -232,3 +232,24 @@ def test_density_pallas_failure_downgrades_to_matmul(monkeypatch):
     assert res2.plan.scan_path == "device-density"
     assert calls["pallas"] == before
     np.testing.assert_allclose(res2.aggregate["density"], want)
+
+
+def test_density_sort_edition_matches_scatter():
+    """density_kernel_sort (sort + boundary searches) must equal the
+    scatter edition exactly — integer counting, no float paths."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.ops.aggregations import (
+        density_kernel,
+        density_kernel_sort,
+    )
+
+    rng = np.random.default_rng(31)
+    for n in (100, 5000, 40000):
+        x = jnp.asarray(rng.uniform(-30, 30, n), jnp.float32)
+        y = jnp.asarray(rng.uniform(-30, 30, n), jnp.float32)
+        mask = jnp.asarray(rng.random(n) < 0.6)
+        env = jnp.asarray([-20.0, -20.0, 20.0, 20.0], jnp.float32)
+        a = np.asarray(density_kernel(x, y, mask, env, 32, 16))
+        b = np.asarray(density_kernel_sort(x, y, mask, env, 32, 16))
+        np.testing.assert_array_equal(a, b)

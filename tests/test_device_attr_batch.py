@@ -37,18 +37,21 @@ def _stores(n=40_000, seed=21, batches=3, null_every=11):
         pool = [f"k{(b + j) % 5}" for j in range(3)]
         kinds[sl] = rng.choice(pool, (sl.stop or n) - sl.start)
     kinds[::null_every] = None
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
     host = TpuDataStore(executor=HostScanExecutor())
     tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
     for s in (host, tpu):
         s.create_schema(parse_spec("t", SPEC))
+        # one columnar write per batch keeps the multiple-blocks /
+        # distinct-vocabs shape without the per-row writer wall
         for b in range(batches):
             sl = slice(b * n // batches, (b + 1) * n // batches)
             with s.writer("t") as w:
-                for i in range(sl.start, sl.stop):
-                    w.write(
-                        [int(t[i]), kinds[i], Point(float(x[i]), float(y[i]))],
-                        fid=f"f{i}",
-                    )
+                w.write_columns({
+                    "__fid__": fids[sl], "dtg": t[sl].astype(np.int64),
+                    "kind": kinds[sl],
+                    "geom__x": x[sl], "geom__y": y[sl],
+                })
     return host, tpu
 
 

@@ -33,13 +33,20 @@ def _force_batch(monkeypatch):
 
 
 def _stores(x, y, t):
+    """Columnar bulk insert (this file tests WIRE FORMATS, not the
+    writer — the per-row write loop was most of the suite wall here)."""
+    n = len(x)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
     host = TpuDataStore(executor=HostScanExecutor())
     tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
     for s in (host, tpu):
         s.create_schema(parse_spec("t", SPEC))
         with s.writer("t") as w:
-            for i in range(len(x)):
-                w.write([int(t[i]), Point(float(x[i]), float(y[i]))], fid=f"f{i}")
+            w.write_columns(
+                {"__fid__": fids, "dtg": np.asarray(t, np.int64),
+                 "geom__x": np.asarray(x, float),
+                 "geom__y": np.asarray(y, float)}
+            )
     return host, tpu
 
 

@@ -33,16 +33,16 @@ def _stores(n=60_000, seed=31):
     y = rng.uniform(-80, 80, n)
     t = BASE + rng.integers(0, 20 * 86400_000, n)
     kinds = np.array([f"k{i % 4}" for i in range(n)], dtype=object)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
     host = TpuDataStore(executor=HostScanExecutor())
     tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
     for s in (host, tpu):
         s.create_schema(parse_spec("t", SPEC))
         with s.writer("t") as w:
-            for i in range(n):
-                w.write(
-                    [int(t[i]), kinds[i], Point(float(x[i]), float(y[i]))],
-                    fid=f"f{i}",
-                )
+            w.write_columns({
+                "__fid__": fids, "dtg": t.astype(np.int64), "kind": kinds,
+                "geom__x": x, "geom__y": y,
+            })
     return host, tpu
 
 
