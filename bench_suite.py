@@ -145,7 +145,7 @@ def bench_z2(n, reps):
     # jittered stream for the device-forced measurement
     jit_rng = np.random.default_rng(55)
     cqls, wants = [], []
-    for _ in range(max(8, reps)):
+    for _ in range(max(24, reps)):
         dx, dy = jit_rng.uniform(-8, 8, 2)
         b = (box[0] + dx, box[1] + dy, box[2] + dx, box[3] + dy)
         cqls.append(f"bbox(geom, {b[0]}, {b[1]}, {b[2]}, {b[3]})")
@@ -203,7 +203,7 @@ def bench_xz2(n, reps):
     parity = set(res.fids) == {f"w{i}" for i in np.flatnonzero(hit)}
     jit_rng = np.random.default_rng(66)
     cqls, wants = [], []
-    for _ in range(max(8, reps)):
+    for _ in range(max(24, reps)):
         dx, dy = jit_rng.uniform(-10, 10, 2)
         b = (box[0] + dx, box[1] + dy, box[2] + dx, box[3] + dy)
         cqls.append(f"bbox(geom, {b[0]}, {b[1]}, {b[2]}, {b[3]})")
@@ -264,7 +264,7 @@ def bench_attr_bbox(n, reps):
     # [lo, hi] code-interval edition (round 4's plane), interleaved so
     # one pipelined stream measures both kernel families
     cqls, wants = [], []
-    for k in range(max(8, reps)):  # both families need >= 2 batch members
+    for k in range(max(24, reps)):  # both families need >= 2 batch members
         dx = round(float(rng.uniform(-5, 5)), 3)
         b = (box[0] + dx, box[1], box[2] + dx, box[3])
         bq = f"bbox(geom, {b[0]!r}, {b[1]!r}, {b[2]!r}, {b[3]!r})"
@@ -328,7 +328,7 @@ def bench_poly(n, reps):
     parity = set(res.fids) == set(fids[want_mask])
     jit_rng = np.random.default_rng(99)
     cqls, wants = [], []
-    for _ in range(max(8, reps)):
+    for _ in range(max(24, reps)):
         dx, dy = jit_rng.uniform(-6, 6, 2)
         p = star(2.0 + dx, 10.0 + dy, 14.0)
         cqls.append(f"intersects(geom, {wkt(p)})")
@@ -368,17 +368,22 @@ def bench_density(n, reps):
     cql = f"bbox(geom, {box[0]}, {box[1]}, {box[2]}, {box[3]})"
     spec = {"envelope": box, "width": wdt, "height": hgt}
 
-    def brute():
-        m = (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+    def _bin(xs, ys):
+        """One grid-snap + bincount — shared by BOTH baselines so their
+        ratio can never drift on a snapping change."""
         gx = np.clip(
-            ((x[m] - box[0]) / (box[2] - box[0]) * wdt).astype(np.int64),
+            ((xs - box[0]) / (box[2] - box[0]) * wdt).astype(np.int64),
             0, wdt - 1,
         )
         gy = np.clip(
-            ((y[m] - box[1]) / (box[3] - box[1]) * hgt).astype(np.int64),
+            ((ys - box[1]) / (box[3] - box[1]) * hgt).astype(np.int64),
             0, hgt - 1,
         )
         return np.bincount(gy * wdt + gx, minlength=wdt * hgt)
+
+    def brute():
+        m = (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        return _bin(x[m], y[m])
 
     base_s, base_grid = _timeit(brute, max(3, reps // 4))
     q = _Q.cql(cql, hints={"density": dict(spec)})
@@ -394,9 +399,24 @@ def bench_density(n, reps):
     count_ok = abs(int(grid.sum()) - int(base_grid.sum())) <= max(
         4, int(base_grid.sum()) // 20_000
     )
+    # the push-down's REFERENCE-FAITHFUL comparison: DensityScan exists
+    # so rows never leave the server (KryoLazyDensityIterator vs a plain
+    # scan + client-side binning). Time the extract-then-bin alternative
+    # — materialize the hit rows through the store, then bincount — and
+    # report the ratio next to the raw numpy full-scan baseline (which
+    # no deployed client can actually run: it presumes the raw arrays).
+    def extract_then_bin():
+        r = ds.query("dens", cql)
+        return _bin(
+            np.asarray(r.columns["geom__x"]), np.asarray(r.columns["geom__y"])
+        )
+
+    extract_s, _ = _timeit(extract_then_bin, max(3, reps // 4))
     out = {
         "metric": "density_grid_throughput", "value": round(n / dev_s, 1),
         "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
+        "vs_extract_baseline": round(extract_s / dev_s, 3),
+        "extract_then_bin_ms": round(extract_s * 1000, 3),
         "n": n, "grid": [hgt, wdt], "hits": int(base_grid.sum()),
         "parity": bool(parity and count_ok), "grid_l1_diff": l1,
         "query_ms": round(dev_s * 1000, 3),
