@@ -224,6 +224,10 @@ def batch() -> None:
         # primitive timings (compile-heavy at 20M): next protocol choices
         ("primitives", [sys.executable, "scripts/hw_probe.py"],
          {"HW_PROBE_REQUIRE_TPU": "1", **claim_env}, 1500),
+        # density kernel editions (scatter/matmul/sort/pallas) at suite
+        # shape: which edition the auto should prefer on THIS link
+        ("density_editions", [sys.executable, "scripts/density_probe.py"],
+         claim_env, 900),
         ("device_smoke", [sys.executable, "bench.py"],
          {"GEOMESA_SEEK": "0", "GEOMESA_BENCH_SMOKE": "1",
           "GEOMESA_BENCH_DEADLINE": "1100", **claim_env},
