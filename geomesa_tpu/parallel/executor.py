@@ -5243,11 +5243,36 @@ class TpuScanExecutor:
             if not seg.load_raw(table):
                 return None
         width, height = int(spec["width"]), int(spec["height"])
-        mode = _mask_mode(self.mesh)
-        if mode != "xla" and not all(s._pallas_ok for s in dev.segments):
-            mode = "xla"  # some segment lacks the per-shard tile granule
-        if getattr(self, "_density_pallas_broken", False):
-            mode = "xla_matmul"  # runtime-downgraded this session (below)
+        # GEOMESA_DENSITY_KERNEL pins the edition outright (operators
+        # with a measured scripts/density_probe.py winner for their
+        # link); otherwise the kernel mode tracks the mask mode, with a
+        # sticky matmul downgrade after a pallas runtime failure
+        pin = os.environ.get("GEOMESA_DENSITY_KERNEL")
+        pinned = False
+        if pin:
+            if pin in ("pallas", "xla", "xla_matmul", "xla_sort"):
+                mode, pinned = pin, True
+                if pin == "pallas" and not all(
+                    s._pallas_ok for s in dev.segments
+                ):
+                    # same granule guard as auto: pallas cannot run on
+                    # xla-granule segments — honor the nearest
+                    # accelerator edition instead of tracing-and-failing
+                    # on every query
+                    mode = "xla_matmul"
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"unknown GEOMESA_DENSITY_KERNEL={pin!r}; using auto",
+                    stacklevel=2,
+                )
+        if not pinned:
+            mode = _mask_mode(self.mesh)
+            if mode != "xla" and not all(s._pallas_ok for s in dev.segments):
+                mode = "xla"  # some segment lacks the per-shard tile granule
+            if getattr(self, "_density_pallas_broken", False):
+                mode = "xla_matmul"  # runtime-downgraded this session
         fns = self._density_grid_fns(width, height, mode)
         boxes = pad_boxes(
             [
@@ -5278,23 +5303,31 @@ class TpuScanExecutor:
         try:
             return run(fns)
         except Exception as exc:  # NOT `as e` — `e` is run()'s env operand
-            if mode in ("xla", "xla_matmul"):
+            if mode in ("xla", "xla_matmul", "xla_sort"):
                 raise
             # the pallas grid kernel failed on the real chip (r5 silicon:
             # the axon remote-compile helper 500s on it at 8M rows) — the
             # plain-XLA matmul edition computes the identical grid with
-            # stock lowering, so downgrade for the session instead of
-            # abandoning the fused push-down for the host reducer
+            # stock lowering, so answer THIS query on it. Auto mode
+            # downgrades for the whole session; a pinned pallas keeps
+            # retrying (the forced-knob contract: a pin must neither
+            # stick nor poison the auto path after it is unset) and
+            # warns only once.
             import warnings
 
-            warnings.warn(
-                f"pallas density kernel failed ({type(exc).__name__}: "
-                f"{str(exc)[:200]}); downgrading to the XLA matmul edition "
-                "for this session",
-                RuntimeWarning,
-                stacklevel=2,
-            )
-            self._density_pallas_broken = True
+            if not (pinned and getattr(self, "_density_pin_warned", False)):
+                warnings.warn(
+                    f"pallas density kernel failed ({type(exc).__name__}: "
+                    f"{str(exc)[:200]}); using the XLA matmul edition "
+                    + ("for this query (pinned pallas keeps retrying)"
+                       if pinned else "for this session"),
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            if pinned:
+                self._density_pin_warned = True
+            else:
+                self._density_pallas_broken = True
             return run(self._density_grid_fns(width, height, "xla_matmul"))
 
     def _density_grid_fns(self, width: int, height: int, mode: str):

@@ -222,7 +222,7 @@ def test_density_pallas_failure_downgrades_to_matmul(monkeypatch):
     _fill(tpu)
     q = Query.cql(CQL, hints={"density": dict(DENSITY)})
     want = host.query("agg", q).aggregate["density"]
-    with pytest.warns(RuntimeWarning, match="downgrading to the XLA matmul"):
+    with pytest.warns(RuntimeWarning, match="using the XLA matmul edition for this session"):
         res = tpu.query("agg", q)
     assert res.plan.scan_path == "device-density"
     np.testing.assert_allclose(res.aggregate["density"], want)
