@@ -121,6 +121,18 @@ class MetricsRegistry:
             return out
 
 
+def _flatten(snapshot):
+    """[(dotted_name, value)] — THE snapshot traversal every reporter
+    shares (timer dicts become 'name.leaf' rows, sorted)."""
+    out = []
+    for name, val in sorted(snapshot.items()):
+        if isinstance(val, dict):
+            out.extend((f"{name}.{k}", v) for k, v in sorted(val.items()))
+        else:
+            out.append((name, val))
+    return out
+
+
 class Reporter:
     """Scheduled metrics publication (Dropwizard ScheduledReporter role,
     metrics/config/MetricsConfig.scala:26-60): start() emits a registry
@@ -207,12 +219,8 @@ class DelimitedFileReporter(Reporter):
     def emit(self, snapshot):
         now = int(time.time() * 1000)
         with open(self.path, "a") as fh:
-            for name, val in sorted(snapshot.items()):
-                if isinstance(val, dict):
-                    for k, v in val.items():
-                        fh.write(f"{now}\t{name}.{k}\t{v}\n")
-                else:
-                    fh.write(f"{now}\t{name}\t{val}\n")
+            for name, v in _flatten(snapshot):
+                fh.write(f"{now}\t{name}\t{v}\n")
 
 
 class GraphiteReporter(Reporter):
@@ -234,13 +242,9 @@ class GraphiteReporter(Reporter):
         self._sock: Any = None
 
     def _lines(self, snapshot: Dict[str, Any], now_s: int):
-        for name, val in sorted(snapshot.items()):
+        for name, v in _flatten(snapshot):
             base = f"{self.prefix}.{name}" if self.prefix else name
-            if isinstance(val, dict):
-                for k, v in sorted(val.items()):
-                    yield f"{base}.{k} {float(v):g} {now_s}\n"
-            else:
-                yield f"{base} {float(val):g} {now_s}\n"
+            yield f"{base} {float(v):g} {now_s}\n"
 
     def _connect(self):
         import socket
@@ -275,6 +279,70 @@ class GraphiteReporter(Reporter):
         # carbon unreachable: drop this snapshot (next interval retries)
 
 
+class GangliaReporter(Reporter):
+    """Ganglia gmetric reporter (metrics/config/MetricsConfig.scala:26's
+    GangliaReporter role): one XDR metadata + value packet pair per
+    metric over UDP, speaking the gmond 3.1 wire format. Timer dicts
+    flatten to dotted leaves like the graphite edition. UDP is
+    fire-and-forget — an absent gmond costs nothing and loses nothing
+    but telemetry."""
+
+    def __init__(self, registry, host: str, port: int = 8649,
+                 group: str = "geomesa", interval_s: float = 60.0):
+        super().__init__(registry, interval_s)
+        self.host = host
+        self.port = port
+        self.group = group
+
+    @staticmethod
+    def _xdr_str(s: str) -> bytes:
+        import struct
+
+        b = s.encode()
+        return struct.pack("!I", len(b)) + b + b"\0" * (-len(b) % 4)
+
+    def _packets(self, name: str, value: float):
+        """(metadata, value) XDR packet pair for one double metric."""
+        import struct
+
+        xs = self._xdr_str
+        hostname = "geomesa-tpu"
+        # metadata packet: id 128 — host, name, spoof=0, type, name,
+        # units, slope BOTH(3), tmax 60, dmax 0, extra {GROUP: group}
+        meta = (
+            struct.pack("!I", 128)
+            + xs(hostname) + xs(name) + struct.pack("!I", 0)
+            + xs("double") + xs(name) + xs("")
+            + struct.pack("!III", 3, max(60, int(self.interval_s)), 0)
+            + struct.pack("!I", 1) + xs("GROUP") + xs(self.group)
+        )
+        # value packet: id 133 (string-formatted value) — host, name,
+        # spoof=0, printf format, value
+        val = (
+            struct.pack("!I", 133)
+            + xs(hostname) + xs(name) + struct.pack("!I", 0)
+            + xs("%s") + xs(f"{float(value):g}")
+        )
+        return meta, val
+
+    def emit(self, snapshot):
+        import socket
+
+        flat = _flatten(snapshot)
+        if not flat:
+            return
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            for name, value in flat:
+                for pkt in self._packets(name, value):
+                    try:
+                        sock.sendto(pkt, (self.host, self.port))
+                    except OSError:
+                        return  # unreachable gmond: drop the snapshot
+        finally:
+            sock.close()
+
+
 def reporters_from_config(
     config: Dict[str, Any], registry: MetricsRegistry, start: bool = True
 ):
@@ -283,7 +351,7 @@ def reporters_from_config(
     reporter names to ``{"type": ..., ...}`` blocks; invalid blocks warn
     and are skipped rather than failing the rest.
 
-    Types: console | slf4j | delimited-text | graphite.
+    Types: console | slf4j | delimited-text | graphite | ganglia.
     Common key: ``interval`` (seconds, default 60)."""
     import warnings
 
@@ -308,6 +376,17 @@ def reporters_from_config(
                 r = GraphiteReporter(
                     registry, host, int(port),
                     prefix=block.get("prefix", "geomesa"),
+                    interval_s=interval,
+                )
+            elif typ == "ganglia":
+                url = str(block["url"])
+                if ":" in url:
+                    host, _, port = url.rpartition(":")
+                else:
+                    host, port = url, 8649  # the well-known gmond default
+                r = GangliaReporter(
+                    registry, host, int(port),
+                    group=block.get("group", "geomesa"),
                     interval_s=interval,
                 )
             else:

@@ -328,3 +328,67 @@ def test_reporters_from_config_factory(tmp_path):
     assert sum("invalid reporter config" in str(x.message) for x in w) == 2
     reps[2].report_now()
     assert "\tc\t1" in (tmp_path / "m.tsv").read_text()
+
+
+def test_ganglia_reporter_xdr_packets():
+    """GangliaReporter: gmond 3.1 XDR metadata+value pairs over UDP,
+    parseable back to (name, type, value); unreachable gmond tolerated."""
+    import socket
+    import struct
+
+    from geomesa_tpu.utils.audit import GangliaReporter, MetricsRegistry
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.settimeout(5)
+    port = srv.getsockname()[1]
+
+    reg = MetricsRegistry()
+    reg.inc("scan.hits", 42)
+    with reg.timer("plan"):
+        pass
+    rep = GangliaReporter(reg, "127.0.0.1", port, group="gm")
+    rep.report_now()
+
+    def xdr_str(buf, off):
+        (n,) = struct.unpack_from("!I", buf, off)
+        s = buf[off + 4 : off + 4 + n].decode()
+        return s, off + 4 + n + (-n % 4)
+
+    metrics = {}
+    # 5 metrics (scan.hits + 4 timer leaves) x 2 packets each
+    for _ in range(10):
+        buf, _addr = srv.recvfrom(65536)
+        (pid,) = struct.unpack_from("!I", buf, 0)
+        host, off = xdr_str(buf, 4)
+        name, off = xdr_str(buf, off)
+        off += 4  # spoof
+        if pid == 128:
+            typ, off = xdr_str(buf, off)
+            metrics.setdefault(name, {})["type"] = typ
+        elif pid == 133:
+            _fmt, off = xdr_str(buf, off)
+            val, off = xdr_str(buf, off)
+            metrics.setdefault(name, {})["value"] = float(val)
+    srv.close()
+    assert metrics["scan.hits"] == {"type": "double", "value": 42.0}
+    assert metrics["plan.count"]["value"] == 1.0
+    assert {"plan.mean_ms", "plan.p50_ms", "plan.max_ms"} <= set(metrics)
+
+    # fire-and-forget: closed port must not raise
+    GangliaReporter(reg, "127.0.0.1", port).report_now()
+
+
+def test_reporters_from_config_ganglia(tmp_path):
+    from geomesa_tpu.utils.audit import (
+        GangliaReporter,
+        MetricsRegistry,
+        reporters_from_config,
+    )
+
+    reps = reporters_from_config(
+        {"g": {"type": "ganglia", "url": "127.0.0.1:18649", "group": "x"}},
+        MetricsRegistry(), start=False,
+    )
+    assert [type(r) for r in reps] == [GangliaReporter]
+    assert reps[0].port == 18649 and reps[0].group == "x"
