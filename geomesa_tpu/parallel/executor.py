@@ -2898,6 +2898,29 @@ class DeviceSegment:
             base = base + (qcode_dev,)
         return base
 
+    def count_xz_start(self, qbox_dev, win_dev, has_time: bool,
+                       attr=None, payload=None, kind="member"):
+        """Dispatch ONE extent scan's dual (hit, decided) planes for a
+        COUNT: the decided total needs no row extraction at all (the
+        wire carries bounded RLE runs either way), and only the boundary
+        ring takes the host's per-geometry test. Returns the pending
+        dual handle; the executor sums len(decided) + certified ring."""
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        aflag, codes, qc = self._attr_plane_args(attr, payload, kind)
+        args = self._xz_args(qbox_dev, win_dev, has_time, codes, qc)
+        rcap = self._rcap
+        buf = _xz_runs_fn(has_time, rcap, mode, self.mesh, aflag)(*args)
+        _start_d2h(buf)
+        return _PendingXZHits(
+            self, rcap, buf,
+            refetch=lambda rc: _xz_runs_fn(
+                has_time, rc, mode, self.mesh, aflag
+            )(*args),
+            packed=lambda: _xz_packed_fn(
+                has_time, mode, self.mesh, aflag
+            )(*args),
+        )
+
     def dispatch_exact_xz_batch(
         self, descs: Sequence[tuple], has_time: bool,
         attr: Optional[str] = None, attr_kind: str = "member",
@@ -3080,6 +3103,20 @@ def _xz_query_limbs(qenv, rect: bool, t_lo, t_hi):
     return qbox, win, has_time
 
 
+def _ring_split(hit_rows: np.ndarray, dec_rows: np.ndarray) -> np.ndarray:
+    """Ring = hits not device-decided (both inputs sorted): membership
+    via one searchsorted merge — THE shared split every extent resolve
+    uses (extraction and count must never diverge on it)."""
+    if not len(hit_rows):
+        return hit_rows
+    in_dec = np.zeros(len(hit_rows), dtype=bool)
+    if len(dec_rows):
+        pos = np.searchsorted(dec_rows, hit_rows)
+        pos = np.minimum(pos, len(dec_rows) - 1)
+        in_dec = dec_rows[pos] == hit_rows
+    return hit_rows[~in_dec]
+
+
 def _yield_xz_rows(seg, dec_rows: np.ndarray, ring: np.ndarray, node, geom):
     """Shared tail of every extent device scan: ring rows (hit but not
     device-decided) take the host's exact per-geometry test, decided rows
@@ -3190,13 +3227,7 @@ class _XZBatchScan:
             hit_rows, dec_rows = ph.rows()
             if not len(hit_rows):
                 continue
-            # ring = hits not decided (both sorted): membership via merge
-            in_dec = np.zeros(len(hit_rows), dtype=bool)
-            if len(dec_rows):
-                pos = np.searchsorted(dec_rows, hit_rows)
-                pos = np.minimum(pos, len(dec_rows) - 1)
-                in_dec = dec_rows[pos] == hit_rows
-            ring = hit_rows[~in_dec]
+            ring = _ring_split(hit_rows, dec_rows)
             yield from _yield_xz_rows(seg, dec_rows, ring, self.node, self.geom)
 
 
@@ -5148,6 +5179,8 @@ class TpuScanExecutor:
 
             if link_latency_ms() > 10.0:
                 return None
+        if table.index.name in ("xz2", "xz3"):
+            return self._count_xz_scan(table, plan)
         if table.index.name not in ("z2", "z3"):
             return None
         if not self._scan_eligible(table, plan):
@@ -5187,6 +5220,60 @@ class TpuScanExecutor:
             for seg in dev.segments
         ]
         return sum(int(p) for p in pending)
+
+    def _count_xz_scan(self, table: IndexTable, plan: QueryPlan):
+        """Extent edition of count_scan (round-4 idea #5): the dual
+        (hit, decided) planes answer COUNT as |decided| + the host-
+        certified boundary ring — decided rows (the bulk, for rect-heavy
+        data) never extract; only ring rows gather geometry objects.
+        Matches the point edition's gates; None -> host path."""
+        if not self._scan_eligible(table, plan):
+            return None
+        if self._has_visibilities(table):
+            return None
+        got = self._xz_batch_desc(table, plan)
+        if got is None:
+            return None
+        qbox, win, has_time, geom, node, attr_info = got
+        attr = akind = payload = None
+        if attr_info is not None:
+            attr, akind, payload = attr_info
+        dev = self.device_index(table)
+        if not dev.segments:
+            return None
+        if not all(seg.load_exact_xz(table) for seg in dev.segments):
+            return None
+        if has_time and any(seg.xz_tk is None for seg in dev.segments):
+            return None
+        if attr is not None and not all(
+            seg.load_attr_codes(attr) for seg in dev.segments
+        ):
+            return None
+        if akind == "vocabmask" and not all(
+            seg.attr_vocab_ok(attr) for seg in dev.segments
+        ):
+            return None
+        qbox_dev = replicate(self.mesh, qbox)
+        win_dev = replicate(self.mesh, win)
+        # dispatch EVERY segment before resolving any (one link round
+        # trip of latency for S segments, like the point edition)
+        pendings = [
+            (seg, seg.count_xz_start(
+                qbox_dev, win_dev, has_time, attr, payload,
+                akind or "member",
+            ))
+            for seg in dev.segments
+        ]
+        total = 0
+        none_dec = np.empty(0, dtype=np.int64)
+        for seg, ph in pendings:
+            hit_rows, dec_rows = ph.rows()
+            total += len(dec_rows)
+            ring = _ring_split(hit_rows, dec_rows)
+            for _block, local in _yield_xz_rows(seg, none_dec, ring,
+                                                node, geom):
+                total += len(local)
+        return total
 
     def density_scan(self, table: IndexTable, plan: QueryPlan, spec):
         """Fused filter + density grid on device (the server-side

@@ -343,6 +343,21 @@ class GangliaReporter(Reporter):
             sock.close()
 
 
+def _host_port(url: str, default_port: int):
+    """(host, port) from a reporter url — one parse for every network
+    reporter: bracketed IPv6 ([::1]:2003), host:port, or bare host
+    (default port)."""
+    url = url.strip()
+    if url.startswith("["):  # [v6]:port or [v6]
+        host, _, rest = url[1:].partition("]")
+        rest = rest.lstrip(":")
+        return host, int(rest) if rest else default_port
+    if url.count(":") == 1:
+        host, _, port = url.partition(":")
+        return host, int(port)
+    return url, default_port  # bare host OR unbracketed v6 literal
+
+
 def reporters_from_config(
     config: Dict[str, Any], registry: MetricsRegistry, start: bool = True
 ):
@@ -372,20 +387,16 @@ def reporters_from_config(
                     registry, block["output"], interval_s=interval
                 )
             elif typ == "graphite":
-                host, _, port = str(block["url"]).rpartition(":")
+                host, port = _host_port(str(block["url"]), 2003)
                 r = GraphiteReporter(
-                    registry, host, int(port),
+                    registry, host, port,
                     prefix=block.get("prefix", "geomesa"),
                     interval_s=interval,
                 )
             elif typ == "ganglia":
-                url = str(block["url"])
-                if ":" in url:
-                    host, _, port = url.rpartition(":")
-                else:
-                    host, port = url, 8649  # the well-known gmond default
+                host, port = _host_port(str(block["url"]), 8649)
                 r = GangliaReporter(
-                    registry, host, int(port),
+                    registry, host, port,
                     group=block.get("group", "geomesa"),
                     interval_s=interval,
                 )

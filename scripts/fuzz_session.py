@@ -273,6 +273,11 @@ def one_extent_round(seed: int) -> int:
             assert sorted(map(str, r.fids)) == wants[q], (
                 "extent-many", seed, mode, q)
             checked += 1
+        # extent counts: |device-decided| + certified ring (round-5)
+        for q in queries[:4]:
+            assert tpu.count("e", q) == len(wants[q]), (
+                "extent-count", seed, mode, q)
+            checked += 1
         dead = [f"e{i}" for i in range(0, n, 7)]
         for s in (host, tpu):
             s.delete_features("e", dead)

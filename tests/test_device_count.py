@@ -104,3 +104,81 @@ def test_count_respects_limit_and_failure_trip(monkeypatch):
     for _ in range(3):
         assert ds.count("t", CQLS[0]) == want
     assert calls["n"] == 1  # tripped after the first failure
+
+
+def _extent_store(n=6000, seed=47):
+    """Mixed rects/triangles/lines/nulls on an xz2 (+ xz3) schema."""
+    from geomesa_tpu.geom.base import LineString, Polygon
+
+    rng = np.random.default_rng(seed)
+    ds = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    ds.create_schema(parse_spec(
+        "e", "dtg:Date,kind:String,*geom:Geometry:srid=4326"
+    ))
+    with ds.writer("e") as w:
+        for i in range(n):
+            x0 = float(rng.uniform(-170, 160))
+            y0 = float(rng.uniform(-80, 70))
+            k = i % 5
+            if k == 0:
+                g = Polygon([[x0, y0], [x0 + 1, y0], [x0 + 1, y0 + 1],
+                             [x0, y0 + 1], [x0, y0]])
+            elif k == 1:
+                g = Polygon([[x0, y0], [x0 + 2, y0], [x0 + 1, y0 + 2],
+                             [x0, y0]])
+            elif k == 2:
+                g = LineString([(x0, y0), (x0 + 1.5, y0 + 0.7)])
+            elif k == 3:
+                g = None
+            else:
+                g = Polygon([[x0, y0], [x0 + 0.5, y0], [x0 + 0.5, y0 + 0.5],
+                             [x0, y0 + 0.5], [x0, y0]])
+            w.write(
+                [int(BASE + rng.integers(0, 15 * 86400_000)),
+                 f"k{i % 4}", g],
+                fid=f"e{i}",
+            )
+    return ds
+
+
+def test_extent_count_device_parity():
+    """Round-4 idea #5: COUNT over extent tables = |device-decided| +
+    host-certified ring — parity vs len(query), device path engaged."""
+    from geomesa_tpu.parallel import executor as exm
+
+    ds = _extent_store()
+    calls = {"n": 0}
+    orig = exm.TpuScanExecutor._count_xz_scan
+
+    def spy(self, table, plan):
+        out = orig(self, table, plan)
+        if out is not None:
+            calls["n"] += 1
+        return out
+
+    exm.TpuScanExecutor._count_xz_scan = spy
+    try:
+        cqls = [
+            "bbox(geom, -60, -40, 10, 20)",
+            "bbox(geom, -100, -60, 80, 50)",
+            "intersects(geom, POLYGON ((-40 -40, 30 -35, 10 30, "
+            "-35 20, -40 -40)))",
+            "bbox(geom, -30, -30, 40, 35) AND "
+            "dtg DURING 2026-01-02T00:00:00Z/2026-01-08T00:00:00Z",
+            "kind = 'k1' AND bbox(geom, -60, -40, 40, 30)",
+            "kind <> 'k2' AND bbox(geom, -60, -40, 40, 30)",
+            "bbox(geom, 179.0, 89.0, 179.9, 89.9)",  # ~empty
+        ]
+        for cql in cqls:
+            assert ds.count("e", cql) == len(ds.query("e", cql)), cql
+    finally:
+        exm.TpuScanExecutor._count_xz_scan = orig
+    assert calls["n"] >= len(cqls) - 1  # the device path actually answered
+
+
+def test_extent_count_after_delete():
+    ds = _extent_store(n=3000)
+    ds.delete_features("e", "IN ('e7', 'e100', 'e2500')")
+    for cql in ("bbox(geom, -100, -60, 80, 50)",
+                "bbox(geom, -60, -40, 10, 20)"):
+        assert ds.count("e", cql) == len(ds.query("e", cql)), cql
