@@ -212,11 +212,20 @@ def bench_xz2(n, reps):
                 (cx + w >= b[0]) & (cx <= b[2]) & (cy + w >= b[1]) & (cy <= b[3])
             )
         })
+    # COUNT(*) pushdown over the extent table (round-5): |device-decided|
+    # + host-certified ring, no row extraction for the decided bulk.
+    # FORCED device edition (like the other device_* fields — the
+    # cost-chosen count over a slow link may pick the host path, which
+    # would make an unforced timing indistinguishable from the pushdown)
+    with _env_override("GEOMESA_COUNT_DEVICE", "1"):
+        cnt_s, cnt = _timeit(lambda: ds.count("ways", cql), max(3, reps // 4))
     return {
         "metric": "xz2_intersects_throughput", "value": round(n / dev_s, 1),
         "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
         "n": n, "hits": int(hit.sum()), "parity": bool(parity),
         "query_ms": round(dev_s * 1000, 3),
+        "count_device_ms": round(cnt_s * 1000, 3),
+        "count_parity": bool(cnt == int(hit.sum())),
         **_device_stream_fields(ds, "ways", cqls, wants, n, base_s),
     }
 
