@@ -2898,6 +2898,34 @@ class DeviceSegment:
             base = base + (qcode_dev,)
         return base
 
+    def count_poly_start(self, edges_np, box_dev, win_dev, has_time: bool,
+                         attr=None, payload=None, kind="member"):
+        """Banded-polygon edition of count_xz_start: the ray cast's dual
+        (hit, decided) planes answer COUNT as |decided hits| + the host-
+        certified error band — same resolve contract, point-table
+        geometry (the band materializes Points from the columnar
+        coords)."""
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        aflag, codes, qc = self._attr_plane_args(attr, payload, kind)
+        ecap = _pow2_at_least(len(edges_np), 8)
+        padded = np.zeros((ecap, 4), np.float32)
+        padded[: len(edges_np)] = edges_np
+        edges_dev = replicate(self.mesh, padded)
+        args = self._poly_args(edges_dev, box_dev, win_dev, has_time,
+                               codes, qc)
+        rcap = self._rcap
+        buf = _poly_runs_fn(has_time, rcap, mode, self.mesh, aflag)(*args)
+        _start_d2h(buf)
+        return _PendingXZHits(
+            self, rcap, buf,
+            refetch=lambda rc: _poly_runs_fn(
+                has_time, rc, mode, self.mesh, aflag
+            )(*args),
+            packed=lambda: _poly_packed_fn(
+                has_time, mode, self.mesh, aflag
+            )(*args),
+        )
+
     def count_xz_start(self, qbox_dev, win_dev, has_time: bool,
                        attr=None, payload=None, kind="member"):
         """Dispatch ONE extent scan's dual (hit, decided) planes for a
@@ -3101,6 +3129,21 @@ def _xz_query_limbs(qenv, rect: bool, t_lo, t_hi):
         thi, tlo = split_u64_to_limbs(i64_sort_keys(np.asarray([lo_ms, hi_ms])))
         win[:] = (thi[0], tlo[0], thi[1], tlo[1])
     return qbox, win, has_time
+
+
+def _count_dual_resolve(pendings, node, geom) -> int:
+    """Shared COUNT resolve for every dual-plane dispatch (extent
+    envelopes AND banded polygons): len(decided) needs no extraction;
+    only the ring/band takes the host's exact per-geometry test."""
+    total = 0
+    none_dec = np.empty(0, dtype=np.int64)
+    for seg, ph in pendings:
+        hit_rows, dec_rows = ph.rows()
+        total += len(dec_rows)
+        ring = _ring_split(hit_rows, dec_rows)
+        for _block, local in _yield_xz_rows(seg, none_dec, ring, node, geom):
+            total += len(local)
+    return total
 
 
 def _ring_split(hit_rows: np.ndarray, dec_rows: np.ndarray) -> np.ndarray:
@@ -5194,7 +5237,9 @@ class TpuScanExecutor:
         else:
             got = self._attr_batch_desc(table, plan)
             if got is None:
-                return None
+                # non-rect INTERSECTS on a point table: the banded
+                # ray-cast dual planes count like the extent tables do
+                return self._count_poly_scan(table, plan)
             attr, akind, (box_np, win_np, payload) = got
         dev = self.device_index(table)
         if not dev.segments:
@@ -5264,16 +5309,45 @@ class TpuScanExecutor:
             ))
             for seg in dev.segments
         ]
-        total = 0
-        none_dec = np.empty(0, dtype=np.int64)
-        for seg, ph in pendings:
-            hit_rows, dec_rows = ph.rows()
-            total += len(dec_rows)
-            ring = _ring_split(hit_rows, dec_rows)
-            for _block, local in _yield_xz_rows(seg, none_dec, ring,
-                                                node, geom):
-                total += len(local)
-        return total
+        return _count_dual_resolve(pendings, node, geom)
+
+    def _count_poly_scan(self, table: IndexTable, plan: QueryPlan):
+        """Banded-polygon edition of _count_xz_scan (point z-tables, one
+        non-rect INTERSECTS + optional window/attr predicates): |decided
+        ray-cast hits| + the host-certified error band."""
+        got = self._poly_batch_desc(table, plan)
+        if got is None:
+            return None
+        edges, box_np, win_np, has_time, geom, node, attr_info = got
+        attr = akind = payload = None
+        if attr_info is not None:
+            attr, akind, payload = attr_info
+        dev = self.device_index(table)
+        if not dev.segments:
+            return None
+        if not all(seg.load_poly(table) for seg in dev.segments):
+            return None
+        if attr is not None and not all(
+            seg.load_attr_codes(attr) for seg in dev.segments
+        ):
+            return None
+        if akind == "vocabmask" and not all(
+            seg.attr_vocab_ok(attr) for seg in dev.segments
+        ):
+            return None
+        box_dev = replicate(self.mesh, box_np)
+        win_dev = replicate(
+            self.mesh,
+            win_np if win_np is not None else np.zeros(4, np.uint32),
+        )
+        pendings = [
+            (seg, seg.count_poly_start(
+                edges, box_dev, win_dev, has_time, attr, payload,
+                akind or "member",
+            ))
+            for seg in dev.segments
+        ]
+        return _count_dual_resolve(pendings, node, geom)
 
     def density_scan(self, table: IndexTable, plan: QueryPlan, spec):
         """Fused filter + density grid on device (the server-side

@@ -139,6 +139,13 @@ def one_round(seed: int) -> int:
         for q in queries[:6]:
             assert tpu.count("t", q) == len(wants[q]), ("count", seed, mode, q)
             checked += 1
+        # banded-polygon count on the point table (round-5): |decided
+        # ray-cast hits| + host-certified band
+        pq = ("intersects(geom, POLYGON ((-40 -38, 32 -30, 12 28, "
+              "-34 18, -40 -38)))")
+        assert tpu.count("t", pq) == len(host.query("t", pq)), (
+            "poly-count", seed, mode)
+        checked += 1
         # query_many: the pipelined/batched dispatch (exact-shape plans
         # fuse into one device execution under GEOMESA_DEVBATCH) must be
         # positionally identical to per-query execution

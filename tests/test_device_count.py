@@ -182,3 +182,37 @@ def test_extent_count_after_delete():
     for cql in ("bbox(geom, -100, -60, 80, 50)",
                 "bbox(geom, -60, -40, 10, 20)"):
         assert ds.count("e", cql) == len(ds.query("e", cql)), cql
+
+
+def test_poly_count_device_parity():
+    """Non-rect INTERSECTS COUNT on point tables: |decided ray-cast
+    hits| + host-certified band, parity vs len(query), path engaged."""
+    from geomesa_tpu.parallel import executor as exm
+
+    ds = _store(n=15_000, seed=43)
+    calls = {"n": 0}
+    orig = exm.TpuScanExecutor._count_poly_scan
+
+    def spy(self, table, plan):
+        out = orig(self, table, plan)
+        if out is not None:
+            calls["n"] += 1
+        return out
+
+    exm.TpuScanExecutor._count_poly_scan = spy
+    try:
+        cqls = [
+            "intersects(geom, POLYGON ((-40 -40, 30 -35, 10 30, "
+            "-35 20, -40 -40)))",
+            "intersects(geom, POLYGON ((-15 -50, 50 -40, 25 15, -15 -50)))",
+            "intersects(geom, POLYGON ((-20 -20, 40 -10, 5 45, -20 -20))) "
+            "AND dtg DURING 2026-01-02T00:00:00Z/2026-01-12T00:00:00Z",
+            "kind = 'k1' AND "
+            "intersects(geom, POLYGON ((-38 -38, 28 -33, 8 28, -33 18, "
+            "-38 -38)))",
+        ]
+        for cql in cqls:
+            assert ds.count("t", cql) == len(ds.query("t", cql)), cql
+    finally:
+        exm.TpuScanExecutor._count_poly_scan = orig
+    assert calls["n"] >= len(cqls) - 1
