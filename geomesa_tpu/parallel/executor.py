@@ -2898,19 +2898,16 @@ class DeviceSegment:
             base = base + (qcode_dev,)
         return base
 
-    def count_poly_start(self, edges_np, box_dev, win_dev, has_time: bool,
+    def count_poly_start(self, edges_dev, box_dev, win_dev, has_time: bool,
                          attr=None, payload=None, kind="member"):
         """Banded-polygon edition of count_xz_start: the ray cast's dual
         (hit, decided) planes answer COUNT as |decided hits| + the host-
         certified error band — same resolve contract, point-table
         geometry (the band materializes Points from the columnar
-        coords)."""
+        coords). ``edges_dev`` is replicated ONCE by the caller (S
+        segments pay one upload, like the box/window args)."""
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
         aflag, codes, qc = self._attr_plane_args(attr, payload, kind)
-        ecap = _pow2_at_least(len(edges_np), 8)
-        padded = np.zeros((ecap, 4), np.float32)
-        padded[: len(edges_np)] = edges_np
-        edges_dev = replicate(self.mesh, padded)
         args = self._poly_args(edges_dev, box_dev, win_dev, has_time,
                                codes, qc)
         rcap = self._rcap
@@ -5340,9 +5337,13 @@ class TpuScanExecutor:
             self.mesh,
             win_np if win_np is not None else np.zeros(4, np.uint32),
         )
+        ecap = _pow2_at_least(len(edges), 8)
+        padded = np.zeros((ecap, 4), np.float32)
+        padded[: len(edges)] = edges
+        edges_dev = replicate(self.mesh, padded)
         pendings = [
             (seg, seg.count_poly_start(
-                edges, box_dev, win_dev, has_time, attr, payload,
+                edges_dev, box_dev, win_dev, has_time, attr, payload,
                 akind or "member",
             ))
             for seg in dev.segments

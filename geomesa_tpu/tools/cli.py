@@ -132,10 +132,12 @@ def cmd_stats_count(args) -> int:
     ds = _store(args)
     ft = ds.get_schema(args.name)
     if args.no_estimate or ds.stats is None:
-        print(len(ds.query(args.name, args.cql)))
+        # store.count: the device mask-sum / dual-plane count pushdowns
+        # answer without extraction when the filter is device-decidable
+        print(ds.count(args.name, args.cql))
     else:
         est = ds.stats.get_count(ft, parse_cql(args.cql))
-        print(int(est) if est is not None else len(ds.query(args.name, args.cql)))
+        print(int(est) if est is not None else ds.count(args.name, args.cql))
     return 0
 
 
