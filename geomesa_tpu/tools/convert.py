@@ -138,6 +138,8 @@ def _fn_date(fmt: str, v: Any) -> int:
 _FN_ISO_DATETIME = lambda v: _fn_date("ISO", v)  # noqa: E731
 _FN_MILLIS = lambda v: None if v in (None, "") else int(float(v))  # noqa: E731
 _FN_SECS_TO_MILLIS = lambda v: None if v in (None, "") else int(float(v) * 1000)  # noqa: E731
+_FN_CONCAT = lambda *a: "".join("" if x is None else str(x) for x in a)  # noqa: E731
+_FN_STRLEN = lambda v: 0 if v is None else len(str(v))  # noqa: E731
 
 
 def _fn_md5(v) -> Optional[str]:
@@ -149,6 +151,257 @@ def _fn_md5(v) -> Optional[str]:
     return hashlib.md5(raw).hexdigest()
 
 
+def _murmur3_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86 32-bit (public-domain algorithm, Austin Appleby).
+    Id-function analog of Transformers.scala IdFunctionFactory murmur3_32
+    (Guava Hashing.murmur3_32 over the UTF-8 string)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data)
+    rounded = n - (n % 4)
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def _murmur3_128_h1(data: bytes, seed: int = 0) -> int:
+    """First 64-bit half of MurmurHash3 x64 128-bit — what Guava's
+    murmur3_128(...).asLong() returns (Transformers.scala murmur3_64).
+    Returned as a SIGNED 64-bit int to match the JVM long."""
+    m = 0xFFFFFFFFFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & m
+
+    def fmix(k):
+        k ^= k >> 33
+        k = (k * 0xFF51AFD7ED558CCD) & m
+        k ^= k >> 33
+        k = (k * 0xC4CEB9FE1A85EC53) & m
+        k ^= k >> 33
+        return k
+
+    c1, c2 = 0x87C37B91114253D5, 0x4CF5AD432745937F
+    h1 = h2 = seed & m
+    n = len(data)
+    nblocks = n // 16
+    for i in range(nblocks):
+        k1 = int.from_bytes(data[i * 16 : i * 16 + 8], "little")
+        k2 = int.from_bytes(data[i * 16 + 8 : i * 16 + 16], "little")
+        k1 = (k1 * c1) & m
+        k1 = rotl(k1, 31)
+        k1 = (k1 * c2) & m
+        h1 ^= k1
+        h1 = rotl(h1, 27)
+        h1 = (h1 + h2) & m
+        h1 = (h1 * 5 + 0x52DCE729) & m
+        k2 = (k2 * c2) & m
+        k2 = rotl(k2, 33)
+        k2 = (k2 * c1) & m
+        h2 ^= k2
+        h2 = rotl(h2, 31)
+        h2 = (h2 + h1) & m
+        h2 = (h2 * 5 + 0x38495AB5) & m
+    tail = data[nblocks * 16 :]
+    k1 = k2 = 0
+    for i in range(min(len(tail), 16) - 1, 7, -1):
+        k2 ^= tail[i] << ((i - 8) * 8)
+    if len(tail) > 8:
+        k2 = (k2 * c2) & m
+        k2 = rotl(k2, 33)
+        k2 = (k2 * c1) & m
+        h2 ^= k2
+    for i in range(min(len(tail), 8) - 1, -1, -1):
+        k1 ^= tail[i] << (i * 8)
+    if len(tail) > 0:
+        k1 = (k1 * c1) & m
+        k1 = rotl(k1, 31)
+        k1 = (k1 * c2) & m
+        h1 ^= k1
+    h1 ^= n
+    h2 ^= n
+    h1 = (h1 + h2) & m
+    h2 = (h2 + h1) & m
+    h1 = fmix(h1)
+    h2 = fmix(h2)
+    h1 = (h1 + h2) & m
+    return h1 - (1 << 64) if h1 >= 1 << 63 else h1
+
+
+def _fn_typed_geom(v, want: str):
+    """linestring()/polygon()/multipoint()/... parsers: WKT string or
+    geometry pass-through, type-checked (Transformers.scala
+    GeometryFunctionFactory: each parser casts to its target JTS type)."""
+    if v in (None, ""):
+        return None
+    g = parse_wkt(v) if isinstance(v, str) else v
+    if want != "Geometry" and g.geom_type != want:
+        raise ValueError(f"{want.lower()}(): got {g.geom_type} from {v!r}")
+    return g
+
+
+def _parse_int_exact(s: str) -> int:
+    """Exact integer parse (Long.parseLong fidelity — int(float(s)) would
+    corrupt values above 2^53), falling back to float for '2.0'/'1e2'."""
+    try:
+        return int(str(s).strip())
+    except ValueError:
+        return int(float(s))
+
+
+_PARSE_INT = _parse_int_exact
+_PARSE_BOOL = lambda s: s.strip().lower() in ("true", "1", "t", "yes")  # noqa: E731
+
+_PARSE_TYPES: Dict[str, Callable[[str], Any]] = {
+    # parseList/parseMap element types (Transformers.scala MapListParsing
+    # determineClazz: string/int/long/double/float/boolean/bytes/uuid/date)
+    "string": str, "str": str,
+    "int": _PARSE_INT, "integer": _PARSE_INT, "long": _PARSE_INT,
+    "double": float, "float": float,
+    "bool": _PARSE_BOOL, "boolean": _PARSE_BOOL,
+    "bytes": lambda s: s.encode(),
+    "uuid": lambda s: str(uuidlib.UUID(s)),
+    "date": lambda s: _fn_date("ISO", s),
+}
+
+
+def _parse_typed(value: str, typ: str) -> Any:
+    fn = _PARSE_TYPES.get(str(typ).strip().lower())
+    if fn is None:
+        raise ValueError(f"unknown element type: {typ}")
+    return fn(value)
+
+
+def _fn_parse_list(typ, s, delim=",") -> List[Any]:
+    if s in (None, ""):
+        return []
+    return [_parse_typed(x.strip(), typ) for x in str(s).split(str(delim))]
+
+
+def _fn_parse_map(kvtypes, s, kv_delim="->", pair_delim=",") -> Dict[Any, Any]:
+    kt, _, vt = str(kvtypes).partition("->")
+    if not vt:
+        raise ValueError(f"parseMap type spec must be 'ktype->vtype': {kvtypes!r}")
+    out: Dict[Any, Any] = {}
+    if s in (None, ""):
+        return out
+    for pair in str(s).split(str(pair_delim)):
+        k, sep, v = pair.partition(str(kv_delim))
+        if not sep:
+            raise ValueError(f"parseMap pair missing {kv_delim!r}: {pair!r}")
+        out[_parse_typed(k.strip(), kt)] = _parse_typed(v.strip(), vt)
+    return out
+
+
+def _fn_date_to_string(fmt, millis) -> Optional[str]:
+    """dateToString(javaPattern, millis) — Transformers.scala DateToString.
+    Java SSS is 3-digit millis; strftime %f would print 6-digit micros,
+    so the millis field is substituted directly."""
+    if millis in (None, ""):
+        return None
+    dt = datetime.fromtimestamp(int(millis) / 1000, tz=timezone.utc)
+    pat = java_date_format(str(fmt)).replace("%f", "\x00")
+    return dt.strftime(pat).replace("\x00", f"{dt.microsecond // 1000:03d}")
+
+
+def _fn_compact_datetime(v, with_millis: bool):
+    """basicDateTime / basicDateTimeNoMillis: compact yyyyMMdd'T'HHmmss
+    forms (ISODateTimeFormat.basicDateTime*); lenient fallback to ISO."""
+    if v in (None, ""):
+        return None
+    s = str(v).strip()
+    for pat in (("%Y%m%dT%H%M%S.%f%z", "%Y%m%dT%H%M%S.%f") if with_millis
+                else ("%Y%m%dT%H%M%S%z", "%Y%m%dT%H%M%S")):
+        try:
+            dt = datetime.strptime(s, pat)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=timezone.utc)
+            return int(dt.timestamp() * 1000)
+        except ValueError:
+            continue
+    return _fn_date("ISO", s)
+
+
+# current input line number, readable by lineNo()/lineNumber() mid-transform
+# (Transformers.scala LineNumberFn reads ctx.counter.getLineCount; the
+# converter loop publishes it before evaluating each record's fields —
+# thread-local so concurrent converters don't see each other's counter)
+_CURRENT_LINENO = __import__("threading").local()
+
+
+def _fn_lineno() -> int:
+    return getattr(_CURRENT_LINENO, "value", 0)
+
+
+def _bytes_arg(v) -> Optional[bytes]:
+    if v is None:
+        return None
+    return bytes(v) if isinstance(v, (bytes, bytearray)) else str(v).encode()
+
+
+def _fn_point(*args):
+    """point(x, y) or point(wkt|geometry) — both reference arities.
+    The two-arg form keeps the pre-existing null contract (either
+    coordinate null -> null geometry), so the arity check must come
+    before any WKT routing."""
+    if len(args) == 2:
+        x, y = args
+        if x in (None, "") or y in (None, ""):
+            return None
+        return Point(float(x), float(y))
+    if len(args) != 1:
+        raise ValueError(f"point() takes 1 or 2 arguments, got {len(args)}")
+    return _fn_typed_geom(args[0], "Point")
+
+
+def _fn_string2bytes(v) -> Optional[bytes]:
+    return None if v is None else str(v).encode("utf-8")
+
+
+def _try_cast(convert: Callable[[str], Any]) -> Callable:
+    """CastFunctionFactory.tryConvert: null/empty OR unparseable input
+    returns the supplied default (None when absent) instead of raising."""
+
+    def fn(v, d=None):
+        if v in (None, ""):
+            return d
+        try:
+            return convert(str(v))
+        except (ValueError, TypeError):
+            return d
+
+    return fn
+
+
+_FN_CAST_INT = _try_cast(_PARSE_INT)
+_FN_CAST_DOUBLE = _try_cast(float)
+_FN_CAST_BOOL = _try_cast(_PARSE_BOOL)
+
+
 _FUNCTIONS: Dict[str, Callable] = {
     "toint": _FN_MILLIS,
     "tolong": _FN_MILLIS,
@@ -156,10 +409,11 @@ _FUNCTIONS: Dict[str, Callable] = {
     "tostring": lambda v: None if v is None else str(v),
     "toboolean": lambda v: None if v in (None, "") else str(v).strip().lower() in ("true", "1", "t", "yes"),
     "trim": lambda v: None if v is None else str(v).strip(),
-    "strlen": lambda v: 0 if v is None else len(str(v)),
+    "strlen": _FN_STRLEN,
     "lowercase": lambda v: None if v is None else str(v).lower(),
     "uppercase": lambda v: None if v is None else str(v).upper(),
-    "concat": lambda *a: "".join("" if x is None else str(x) for x in a),
+    "concat": _FN_CONCAT,
+    "concatenate": _FN_CONCAT,
     "date": _fn_date,
     # reference Transformers.scala date aliases: datetime/isodatetime parse
     # ISO-8601, isodate the compact yyyyMMdd form, millisToDate/secsToDate
@@ -170,8 +424,14 @@ _FUNCTIONS: Dict[str, Callable] = {
     "millistodate": _FN_MILLIS,
     "secstodate": _FN_SECS_TO_MILLIS,
     "datetomillis": lambda v: None if v is None else int(v),
-    "point": lambda x, y: None if x in (None, "") or y in (None, "") else Point(float(x), float(y)),
+    "point": _fn_point,
     "geometry": lambda v: None if v in (None, "") else (v if not isinstance(v, str) else parse_wkt(v)),
+    "linestring": lambda v: _fn_typed_geom(v, "LineString"),
+    "polygon": lambda v: _fn_typed_geom(v, "Polygon"),
+    "multipoint": lambda v: _fn_typed_geom(v, "MultiPoint"),
+    "multilinestring": lambda v: _fn_typed_geom(v, "MultiLineString"),
+    "multipolygon": lambda v: _fn_typed_geom(v, "MultiPolygon"),
+    "geometrycollection": lambda v: _fn_typed_geom(v, "GeometryCollection"),
     "uuid": lambda: str(uuidlib.uuid4()),
     "withdefault": lambda v, d: d if v in (None, "") else v,
     "regexreplace": lambda pattern, repl, v: None if v is None else re.sub(pattern, repl, str(v)),
@@ -183,15 +443,15 @@ _FUNCTIONS: Dict[str, Callable] = {
     "subtract": lambda a, b: None if None in (a, b) else float(a) - float(b),
     "multiply": lambda *a: __import__("math").prod(float(x) for x in a if x not in (None, "")),
     "divide": lambda a, b: None if None in (a, b) or float(b) == 0 else float(a) / float(b),
-    "length": lambda v: 0 if v is None else len(str(v)),
+    "length": _FN_STRLEN,
     "emptytonull": lambda v: None if v in (None, "") else v,
     "capitalize": lambda v: None if v is None else str(v).capitalize(),
     "printf": lambda fmt, *a: str(fmt) % tuple(a),
-    "stringtoint": lambda v, d=None: d if v in (None, "") else int(float(v)),
-    "stringtolong": lambda v, d=None: d if v in (None, "") else int(float(v)),
-    "stringtodouble": lambda v, d=None: d if v in (None, "") else float(v),
-    "stringtofloat": lambda v, d=None: d if v in (None, "") else float(v),
-    "stringtoboolean": lambda v, d=None: d if v in (None, "") else str(v).strip().lower() in ("true", "1", "t", "yes"),
+    "stringtoint": _FN_CAST_INT,
+    "stringtolong": _FN_CAST_INT,
+    "stringtodouble": _FN_CAST_DOUBLE,
+    "stringtofloat": _FN_CAST_DOUBLE,
+    "stringtoboolean": _FN_CAST_BOOL,
     "now": lambda: int(__import__("time").time() * 1000),
     "secstomillis": _FN_SECS_TO_MILLIS,
     "millistosecs": lambda v: None if v in (None, "") else int(float(v) // 1000),
@@ -201,6 +461,40 @@ _FUNCTIONS: Dict[str, Callable] = {
     "jsontostring": lambda v: None if v is None else (
         v if isinstance(v, str) else __import__("json").dumps(v)
     ),
+    # string extras (Transformers.scala StringFunctionFactory)
+    "stripquotes": lambda v: None if v is None else str(v).replace('"', ""),
+    "mkstring": lambda sep, *a: str(sep).join(str(x) for x in a),
+    "stringlength": _FN_STRLEN,
+    # math extras (MathFunctionFactory mean/min/max over parseDouble'd args)
+    "mean": lambda *a: sum(float(x) for x in a) / len(a),
+    "min": lambda *a: min(float(x) for x in a),
+    "max": lambda *a: max(float(x) for x in a),
+    # id functions (IdFunctionFactory)
+    "string2bytes": _fn_string2bytes,
+    "stringtobytes": _fn_string2bytes,
+    # URL-safe unpadded, matching Base64.encodeBase64URLSafeString
+    "base64": lambda v: None if v is None else __import__("base64")
+    .urlsafe_b64encode(_bytes_arg(v)).rstrip(b"=").decode(),
+    # hex like Guava HashCode.toString (little-endian byte order)
+    "murmur3_32": lambda v: None if v is None
+    else _murmur3_32(_bytes_arg(v)).to_bytes(4, "little").hex(),
+    "murmur3_64": lambda v: None if v is None else _murmur3_128_h1(_bytes_arg(v)),
+    # collections (CollectionFunctionFactory + StringMapListFunctionFactory)
+    "list": lambda *a: list(a),
+    "parselist": _fn_parse_list,
+    "parsemap": _fn_parse_map,
+    # date extras (DateFunctionFactory)
+    "datetostring": _fn_date_to_string,
+    "basicdate": lambda v: _fn_date("yyyyMMdd", v) if v not in (None, "") and "-" not in str(v) else _fn_date("ISO", v),
+    "basicdatetime": lambda v: _fn_compact_datetime(v, with_millis=True),
+    "basicdatetimenomillis": lambda v: _fn_compact_datetime(v, with_millis=False),
+    "datehourminutesecondmillis": lambda v: _fn_date("ISO", v),
+    # cast aliases (CastFunctionFactory names)
+    "stringtointeger": _FN_CAST_INT,
+    "stringtobool": _FN_CAST_BOOL,
+    # current input line (LineNumberFunctionFactory lineNo/lineNumber)
+    "lineno": _fn_lineno,
+    "linenumber": _fn_lineno,
 }
 
 
@@ -408,6 +702,11 @@ class SimpleFeatureConverter:
     # -- record iteration per format ----------------------------------------
 
     def _records(self, fh) -> Iterator[Sequence[Any]]:
+        # line-oriented formats publish the PHYSICAL input line (header and
+        # blank lines count, like ctx.counter.getLineCount) so lineNo()
+        # matches a reference ingest of the same file; record-oriented
+        # formats (xml/avro/osm) fall back to the record index published
+        # by convert_records
         if self.kind == "delimited-text":
             fmt = self.config.get("format", "csv").lower()
             delim = "\t" if fmt in ("tsv", "tdv", "tdf") else ","
@@ -418,11 +717,13 @@ class SimpleFeatureConverter:
                     continue
                 rec = _Row(row)
                 rec.raw = delim.join(row)
+                _CURRENT_LINENO.value = reader.line_num
                 yield rec
         elif self.kind == "json":
-            for line in fh:
+            for pl, line in enumerate(fh, 1):
                 line = line.strip()
                 if line:
+                    _CURRENT_LINENO.value = pl
                     yield json.loads(line)
         elif self.kind == "fixed-width":
             # geomesa-convert-fixedwidth: each field slices [start, start+width)
@@ -431,6 +732,7 @@ class SimpleFeatureConverter:
                 line = line.rstrip("\n")
                 if i < skip or not line:
                     continue
+                _CURRENT_LINENO.value = i + 1
                 yield line
         elif self.kind == "xml":
             # geomesa-convert-xml XmlConverter: feature-path selects the
@@ -543,13 +845,22 @@ class SimpleFeatureConverter:
         return expr(rec, fields) if expr is not None else None
 
     def convert(self, fh, ec: Optional[EvaluationContext] = None) -> Iterator[Feature]:
-        yield from self.convert_records(self._records(fh), ec)
+        physical = self.kind in ("delimited-text", "json", "fixed-width")
+        yield from self.convert_records(self._records(fh), ec,
+                                        _self_numbering=physical)
 
-    def convert_records(self, records, ec: Optional[EvaluationContext] = None):
+    def convert_records(self, records, ec: Optional[EvaluationContext] = None,
+                        _self_numbering: bool = False):
         """Convert pre-parsed records (dicts/rows) directly — also the
-        simple-feature (SFT-to-SFT) converter entry point."""
+        simple-feature (SFT-to-SFT) converter entry point. When the record
+        iterator publishes physical line numbers itself (_self_numbering),
+        the record index must not overwrite them."""
         ec = ec if ec is not None else EvaluationContext()
         for lineno, rec in enumerate(records, 1):
+            if not _self_numbering:
+                _CURRENT_LINENO.value = lineno
+            else:
+                lineno = _fn_lineno()
             try:
                 fields: Dict[str, Any] = {}
                 for name, expr, path, cfg in self.fields:

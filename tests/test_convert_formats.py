@@ -268,3 +268,94 @@ def test_reference_date_function_aliases():
     assert _FUNCTIONS["secstodate"]("1767434400") == want
     for f in ("datetime", "isodatetime", "isodate", "millistodate", "secstodate"):
         assert _FUNCTIONS[f]("") is None and _FUNCTIONS[f](None) is None
+
+
+def test_transform_function_batch_round5():
+    """Round-5 widening of the Transformers.scala function set: string
+    extras, math mean/min/max, id hashes (murmur3/base64/string2bytes),
+    typed WKT geometry parsers, collections, date extras, lineNo."""
+    from geomesa_tpu.tools.convert import parse_transform
+
+    def ev(expr, cols=()):
+        return parse_transform(expr)(list(cols), {})
+
+    # strings
+    assert ev("stripQuotes($1)", ['he said "hi"']) == "he said hi"
+    assert ev("mkstring('-', $1, $2, 3)", ["a", "b"]) == "a-b-3"
+    assert ev("concatenate($1, 'x', 2)", ["a"]) == "ax2"
+    assert ev("stringLength($1)", ["abcd"]) == 4
+    # math
+    assert ev("mean(1, 2, $1)", ["3"]) == 2.0
+    assert ev("min(3, '1', 2)") == 1.0
+    assert ev("max(3, '9', 2)") == 9.0
+    # ids — murmur3 against the canonical Appleby vectors; base64 URL-safe
+    # unpadded like Base64.encodeBase64URLSafeString
+    assert ev("murmur3_32($1)", ["hello"]) == (0x248BFA47).to_bytes(4, "little").hex()
+    assert ev("murmur3_64($1)", ["hello"]) == 0xCBD8A7B341BD9B02 - (1 << 64)
+    assert ev("base64(string2bytes($1))", ["hi>?"]) == "aGk-Pw"
+    assert ev("stringToBytes($1)", ["abc"]) == b"abc"
+    # typed geometry parsers (WKT in, type-checked geometry out)
+    assert ev("linestring($1)", ["LINESTRING(0 0, 1 1)"]).geom_type == "LineString"
+    assert ev("polygon($1)", ["POLYGON((0 0,1 0,1 1,0 0))"]).geom_type == "Polygon"
+    assert ev("multipoint($1)", ["MULTIPOINT((0 0),(1 1))"]).geom_type == "MultiPoint"
+    assert ev("multilinestring($1)",
+              ["MULTILINESTRING((0 0,1 1),(2 2,3 3))"]).geom_type == "MultiLineString"
+    assert ev("multipolygon($1)",
+              ["MULTIPOLYGON(((0 0,1 0,1 1,0 0)))"]).geom_type == "MultiPolygon"
+    assert ev("geometrycollection($1)",
+              ["GEOMETRYCOLLECTION(POINT(1 2))"]).geom_type == "GeometryCollection"
+    p = ev("point($1)", ["POINT(3 4)"])
+    assert (p.x, p.y) == (3.0, 4.0)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        ev("linestring($1)", ["POINT(1 2)"])
+    # collections
+    assert ev("list($1, 2, 'c')", ["a"]) == ["a", 2, "c"]
+    assert ev("parseList('int', $1)", ["1, 2,3"]) == [1, 2, 3]
+    assert ev("parseList('double', $1, ';')", ["1.5;2"]) == [1.5, 2.0]
+    assert ev("parseList('string', $1)", [""]) == []
+    assert ev("parseMap('string->int', $1)", ["a->1, b->2"]) == {"a": 1, "b": 2}
+    # dates
+    assert ev("dateToString('yyyy-MM-dd', $1)", [86400000]) == "1970-01-02"
+    assert ev("basicDateTime($1)", ["20240102T030405.123Z"]) == 1704164645123
+    assert ev("basicDateTimeNoMillis($1)", ["20240102T030405Z"]) == 1704164645000
+    assert ev("dateHourMinuteSecondMillis($1)",
+              ["2024-01-02T03:04:05.123"]) == 1704164645123
+    assert ev("basicDate($1)", ["20240102"]) == 1704153600000
+    # two-arg point keeps the null contract (null coord -> null geometry,
+    # NOT a detour into the one-arg WKT path); murmur fns pass None through
+    assert ev("point(toDouble($1), toDouble($2))", ["1.0", ""]) is None
+    assert ev("point(toDouble($1), toDouble($2))", ["", "2.0"]) is None
+    assert ev("murmur3_32($1)", [None]) is None
+    assert ev("murmur3_64($1)", [None]) is None
+    # casts return the default on UNPARSEABLE input too (tryConvert)
+    assert ev("stringToInt($1, 9)", ["N/A"]) == 9
+    assert ev("stringToInteger($1, 7)", ["xx"]) == 7
+    assert ev("stringToDouble($1)", ["junk"]) is None
+    assert ev("stringToLong($1, 3)", ["1e2"]) == 100
+    assert ev("stringToBool($1, 1)", [""]) == 1
+    assert ev("stringToBool($1)", ["true"]) is True
+    assert ev("stringToBool($1)", ["garbage"]) is False
+
+
+def test_lineno_function_tracks_converter_rows():
+    conv = SimpleFeatureConverter(
+        FT,
+        {
+            "type": "delimited-text",
+            "id-field": "lineNo()",
+            "fields": [
+                {"name": "name", "transform": "concat($1, '@', lineNumber())"},
+                {"name": "geom", "transform": "point(toDouble($2), toDouble($3))"},
+            ],
+        },
+    )
+    feats = list(conv.convert(io.StringIO("a,1.0,2.0\nb,3.0,4.0\n")))
+    assert [f.fid for f in feats] == ["1", "2"]
+    assert [f.values[0] for f in feats] == ["a@1", "b@2"]
+    # PHYSICAL line numbers: a skipped header and a blank line still count
+    # (reference ctx.counter.getLineCount semantics)
+    conv.config["options"] = {"skip-lines": 1}
+    conv2 = SimpleFeatureConverter(conv.ft, {**conv.config})
+    feats = list(conv2.convert(io.StringIO("h1,h2,h3\na,1.0,2.0\n\nb,3.0,4.0\n")))
+    assert [f.fid for f in feats] == ["2", "4"]
