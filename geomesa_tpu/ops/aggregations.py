@@ -216,5 +216,158 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
     return with_time, no_time
 
 
+# --- exact device density: certain grid + host-certified band ---------------
+#
+# The plain editions bin in f32, so points within f32 error of a grid-cell
+# boundary or a query-box edge may land differently than the host's f64
+# path (the documented loose-point semantics). The DUAL edition makes the
+# device grid EXACTLY host-parity, reusing the banded-polygon idiom
+# (parallel/executor._poly_mask_body: device decides the bulk, host
+# certifies the ring): rows the device cannot certify in f32 are excluded
+# from the device grid and their indices returned for the host to evaluate
+# and bin from its f64 block columns.
+
+DENSITY_BAND_CAP = 8192  # per-shard band-candidate budget (32KB i32 d2h)
+_BAND_ULPS = 16.0  # margin over the rigorous f32 quantization+rounding bound
+
+
+def density_band(x, y, env, width, height, boxes):
+    """(band, near): ``band`` = rows whose cell assignment or box
+    membership could differ between the device's f32 columns/arithmetic
+    and the host's f64 originals — f32 quantization of the coordinate
+    (<= 0.5 ulp of |x|), f32 rounding of env/box bounds, and the f32
+    (x - xmin)/dx evaluation; ``near`` = band rows that additionally pass
+    every test with band-widened edges (the candidate set the host must
+    certify — band rows far outside every box need no certification).
+
+    Padded boxes are inverted (min > max) and satisfy neither the wide
+    nor the strict test; NaN coordinates (null geometries) fail every
+    comparison and are never banded."""
+    xmin, ymin, xmax, ymax = env[0], env[1], env[2], env[3]
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    eps = jnp.float32(_BAND_ULPS * 2.0 ** -23)
+    ex = eps * jnp.maximum(jnp.maximum(jnp.abs(xmin), jnp.abs(xmax)), jnp.abs(x))
+    ey = eps * jnp.maximum(jnp.maximum(jnp.abs(ymin), jnp.abs(ymax)), jnp.abs(y))
+    tx = (x - xmin) / dx
+    ty = (y - ymin) / dy
+    ttx = ex / jnp.abs(dx) + eps * jnp.abs(tx)
+    tty = ey / jnp.abs(dy) + eps * jnp.abs(ty)
+    cell_band = (jnp.abs(tx - jnp.round(tx)) <= ttx) | (
+        jnp.abs(ty - jnp.round(ty)) <= tty
+    )
+    bx0, by0, bx1, by1 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    exk = jnp.maximum(
+        ex[:, None], eps * jnp.maximum(jnp.abs(bx0), jnp.abs(bx1))[None, :]
+    )
+    eyk = jnp.maximum(
+        ey[:, None], eps * jnp.maximum(jnp.abs(by0), jnp.abs(by1))[None, :]
+    )
+    xx, yy = x[:, None], y[:, None]
+    in_wide = (
+        (xx >= bx0[None, :] - exk) & (xx <= bx1[None, :] + exk)
+        & (yy >= by0[None, :] - eyk) & (yy <= by1[None, :] + eyk)
+    )
+    in_strict = (
+        (xx >= bx0[None, :] + exk) & (xx <= bx1[None, :] - exk)
+        & (yy >= by0[None, :] + eyk) & (yy <= by1[None, :] - eyk)
+    )
+    any_strict = jnp.any(in_strict, axis=1)
+    box_band = jnp.any(in_wide & ~in_strict, axis=1) & ~any_strict
+    band = cell_band | box_band
+    near = (
+        band
+        & jnp.any(in_wide, axis=1)
+        & (tx >= -ttx) & (tx <= width + ttx)
+        & (ty >= -tty) & (ty <= height + tty)
+    )
+    return band, near
+
+
+def make_sharded_density_dual(
+    mesh, width: int, height: int, mode: str = "xla",
+    band_cap: int = DENSITY_BAND_CAP,
+):
+    """Dual variants of ``make_sharded_density``: each call returns
+    (grid, band_idx, band_count) where the [H, W] grid counts only rows
+    the device can certify (mask & ~band), ``band_idx`` is the
+    [n_shards * band_cap] packed-array indices of band candidates
+    (-1 padding), and ``band_count`` the per-shard true candidate counts
+    (count > band_cap means the buffer truncated — the caller must fall
+    back to the host path). The executor certifies the band rows against
+    the plan's post filter on the f64 host columns and adds their f64
+    GridSnap bins, making the final grid exactly host-parity."""
+    from geomesa_tpu.ops.filters import bbox_mask_f32
+    from geomesa_tpu.ops.pallas_kernels import DENSITY_MAX_DIM, density_grid_pallas
+
+    use_pallas = mode not in ("xla", "xla_matmul", "xla_sort") and (
+        width <= DENSITY_MAX_DIM and height <= DENSITY_MAX_DIM
+    )
+    kern = {
+        "xla_matmul": density_kernel_matmul,
+        "xla_sort": density_kernel_sort,
+    }.get(mode, density_kernel)
+
+    def _band_outputs(cand, local_n):
+        cnt = jnp.sum(cand.astype(jnp.int32)).reshape(1)
+        idx = jnp.nonzero(cand, size=band_cap, fill_value=local_n)[0].astype(jnp.int32)
+        shard = jax.lax.axis_index(DATA_AXIS).astype(jnp.int32)
+        gidx = jnp.where(idx < local_n, idx + shard * local_n, jnp.int32(-1))
+        return gidx, cnt
+
+    def step(x, y, bins, offs, valid, boxes, windows, env):
+        band, near = density_band(x, y, env, width, height, boxes)
+        tm = temporal_mask(bins, offs, windows)
+        if use_pallas:
+            grid = density_grid_pallas(
+                x, y, bins, offs, valid & ~band, boxes, windows, env,
+                width, height, True,
+            )
+        else:
+            m = valid & bbox_mask_f32(x, y, boxes) & tm
+            grid = kern(x, y, m & ~band, env, width, height)
+        grid = jax.lax.psum(grid, DATA_AXIS)
+        gidx, cnt = _band_outputs(near & valid & tm, x.shape[0])
+        return grid, gidx, cnt
+
+    def step_no_time(x, y, valid, boxes, env):
+        band, near = density_band(x, y, env, width, height, boxes)
+        if use_pallas:
+            grid = density_grid_pallas(
+                x, y, None, None, valid & ~band, boxes, None, env,
+                width, height, False,
+            )
+        else:
+            m = valid & bbox_mask_f32(x, y, boxes)
+            grid = kern(x, y, m & ~band, env, width, height)
+        grid = jax.lax.psum(grid, DATA_AXIS)
+        gidx, cnt = _band_outputs(near & valid, x.shape[0])
+        return grid, gidx, cnt
+
+    from geomesa_tpu.parallel.mesh import shard_map_fn
+
+    d = P(DATA_AXIS)
+    r = P()
+    with_time = jax.jit(
+        shard_map_fn(
+            step,
+            mesh,
+            in_specs=(d, d, d, d, d, r, r, r),
+            out_specs=(r, d, d),
+            check=not use_pallas,
+        )
+    )
+    no_time = jax.jit(
+        shard_map_fn(
+            step_no_time,
+            mesh,
+            in_specs=(d, d, d, r, r),
+            out_specs=(r, d, d),
+            check=not use_pallas,
+        )
+    )
+    return with_time, no_time
+
+
 # the host reference implementation lives in geomesa_tpu.index.aggregators
 # (pure numpy, so the host-only datastore path has no jax dependency)

@@ -5356,11 +5356,14 @@ class TpuScanExecutor:
 
         Supported when the full filter is precise rectangles (+ one time
         interval over uniform day/week bins, evaluated at ms precision) with
-        no residual CQL. Spatial compares run in float32 — points within one
-        f32 ulp of a box edge may classify differently than the f64 host
-        path, mirroring the reference's loose-bbox point semantics
-        (index/z2/Z2Index.scala:26-40); pass {"exact": True} in the density
-        hint to force the host path.
+        no residual CQL. The grid is EXACTLY host-parity: the device counts
+        rows it can certify in f32 and returns the indices of rows within
+        f32 error of a cell boundary or box edge (the band), which the host
+        decides from its f64 columns with the plan's full filter + the f64
+        GridSnap — the density analog of the banded-polygon ring. A band
+        overflowing its per-shard buffer (very fine grids over tiny
+        envelopes) falls back to the host path. {"exact": True} still
+        forces the host path outright.
 
         GEOMESA_DENSITY_DEVICE: auto (accelerators only, default) | 1 | 0 —
         on the CPU backend the fused full-scan has no advantage over the
@@ -5408,7 +5411,8 @@ class TpuScanExecutor:
         # GEOMESA_DENSITY_KERNEL pins the edition outright (operators
         # with a measured scripts/density_probe.py winner for their
         # link); otherwise the kernel mode tracks the mask mode, with a
-        # sticky matmul downgrade after a pallas runtime failure
+        # sticky xla_sort downgrade after a pallas runtime failure (the
+        # measured silicon winner: 31.7ms vs matmul 46.9ms at 8M)
         pin = os.environ.get("GEOMESA_DENSITY_KERNEL")
         pinned = False
         if pin:
@@ -5418,10 +5422,10 @@ class TpuScanExecutor:
                     s._pallas_ok for s in dev.segments
                 ):
                     # same granule guard as auto: pallas cannot run on
-                    # xla-granule segments — honor the nearest
+                    # xla-granule segments — honor the fastest measured
                     # accelerator edition instead of tracing-and-failing
                     # on every query
-                    mode = "xla_matmul"
+                    mode = "xla_sort"
             else:
                 import warnings
 
@@ -5434,8 +5438,15 @@ class TpuScanExecutor:
             if mode != "xla" and not all(s._pallas_ok for s in dev.segments):
                 mode = "xla"  # some segment lacks the per-shard tile granule
             if getattr(self, "_density_pallas_broken", False):
-                mode = "xla_matmul"  # runtime-downgraded this session
-        fns = self._density_grid_fns(width, height, mode)
+                mode = "xla_sort"  # runtime-downgraded this session
+        from geomesa_tpu.ops.aggregations import DENSITY_BAND_CAP
+
+        # ONE read of the cap: both the compiled nonzero buffer size and
+        # the overflow check below must see the same value (a runtime
+        # change to the constant re-keys the fns cache instead of
+        # silently truncating against a stale compiled buffer)
+        band_cap = DENSITY_BAND_CAP
+        fns = self._density_grid_fns(width, height, mode, band_cap)
         boxes = pad_boxes(
             [
                 (g.envelope.xmin, g.envelope.ymin, g.envelope.xmax, g.envelope.ymax)
@@ -5452,14 +5463,41 @@ class TpuScanExecutor:
             else None
         )
         def run(fns):
+            # dual grids: the device counts rows it can certify in f32;
+            # band candidates come back as packed-array indices for the
+            # host to decide from its f64 columns (exact host parity —
+            # the density analog of the banded-polygon ring)
             total: Optional[np.ndarray] = None
+            band: List[Tuple[object, np.ndarray]] = []
             for seg in dev.segments:
                 if seg.kind == "z3":
-                    grid = fns[0](seg.xf, seg.yf, seg.bins, seg.t_ms, seg.valid, b, w, e)
+                    grid, gidx, cnt = fns[0](
+                        seg.xf, seg.yf, seg.bins, seg.t_ms, seg.valid, b, w, e
+                    )
                 else:
-                    grid = fns[1](seg.xf, seg.yf, seg.valid, b, e)
+                    grid, gidx, cnt = fns[1](seg.xf, seg.yf, seg.valid, b, e)
+                if int(np.max(np.asarray(cnt))) > band_cap:
+                    # a shard's band overflowed its index buffer (fine
+                    # grid over a tiny envelope): the host path answers
+                    # exactly rather than shipping a truncated band
+                    return None
                 g = np.asarray(grid, dtype=np.float64)
+                if float(g.max()) >= 2.0 ** 24:
+                    # the device grid accumulates in f32, which is exact
+                    # for integer counts only below 2^24 per cell; counts
+                    # only grow during accumulation, so any loss leaves
+                    # the final cell >= 2^24 and this check catches it —
+                    # the host path answers exactly instead
+                    return None
                 total = g if total is None else total + g
+                idx = np.asarray(gidx)
+                idx = idx[idx >= 0]
+                if idx.size:
+                    band.append((seg, idx))
+            if band:
+                total += self._certify_density_band(
+                    table, plan, spec, band, width, height
+                )
             return total
 
         try:
@@ -5469,7 +5507,9 @@ class TpuScanExecutor:
                 raise
             # the pallas grid kernel failed on the real chip (r5 silicon:
             # the axon remote-compile helper 500s on it at 8M rows) — the
-            # plain-XLA matmul edition computes the identical grid with
+            # plain-XLA sort edition (the measured silicon winner:
+            # 31.7ms vs matmul 46.9ms vs scatter 84.3ms at 8M,
+            # density_probe 19:40Z) computes the identical grid with
             # stock lowering, so answer THIS query on it. Auto mode
             # downgrades for the whole session; a pinned pallas keeps
             # retrying (the forced-knob contract: a pin must neither
@@ -5480,7 +5520,7 @@ class TpuScanExecutor:
             if not (pinned and getattr(self, "_density_pin_warned", False)):
                 warnings.warn(
                     f"pallas density kernel failed ({type(exc).__name__}: "
-                    f"{str(exc)[:200]}); using the XLA matmul edition "
+                    f"{str(exc)[:200]}); using the XLA sort edition "
                     + ("for this query (pinned pallas keeps retrying)"
                        if pinned else "for this session"),
                     RuntimeWarning,
@@ -5490,13 +5530,71 @@ class TpuScanExecutor:
                 self._density_pin_warned = True
             else:
                 self._density_pallas_broken = True
-            return run(self._density_grid_fns(width, height, "xla_matmul"))
+            return run(self._density_grid_fns(width, height, "xla_sort", band_cap))
 
-    def _density_grid_fns(self, width: int, height: int, mode: str):
-        fns = self._density_fns.get((width, height, mode))
+    def _density_grid_fns(self, width: int, height: int, mode: str,
+                          band_cap: int):
+        key = (width, height, mode, band_cap)
+        fns = self._density_fns.get(key)
         if fns is None:
-            from geomesa_tpu.ops.aggregations import make_sharded_density
+            from geomesa_tpu.ops.aggregations import make_sharded_density_dual
 
-            fns = make_sharded_density(self.mesh, width, height, mode)
-            self._density_fns[(width, height, mode)] = fns
+            fns = make_sharded_density_dual(
+                self.mesh, width, height, mode, band_cap=band_cap
+            )
+            self._density_fns[key] = fns
         return fns
+
+    def _certify_density_band(
+        self, table: IndexTable, plan: QueryPlan, spec,
+        band: List[Tuple[object, np.ndarray]], width: int, height: int,
+    ) -> np.ndarray:
+        """Host-exact decisions for the density band: evaluate the plan's
+        post filter on the f64 block columns of the band candidates and
+        bin the passing rows with the f64 GridSnap (density_grid_numpy) —
+        the same arithmetic the host reducer path uses, so the combined
+        grid matches it exactly."""
+        from geomesa_tpu.filter.evaluate import evaluate
+        from geomesa_tpu.index.aggregators import density_grid_numpy
+        from geomesa_tpu.store.datastore import _INTERNAL_SUFFIXES, LazyColumns
+
+        ft = table.ft
+        geom = ft.default_geometry.name
+        add = np.zeros((height, width), dtype=np.float64)
+        for seg, idx in band:
+            idx = np.unique(idx[idx < seg.n])  # drop tail padding rows
+            if idx.size == 0:
+                continue
+            parts = seg.to_block_rows(idx)
+            # same observable-key rule as datastore._columns_from_parts:
+            # a key must exist in every part's record layout (__null
+            # absence means "no nulls" and materializes as zeros)
+            keysets = [
+                set(b.record.columns) if getattr(b, "record", None) is not None
+                else set(b.columns)
+                for b, _ in parts
+            ]
+            common = set.intersection(*keysets)
+            keys = {"__fid__"} | {
+                k for k in set.union(*keysets)
+                if k != "__vis__"
+                and not k.endswith(_INTERNAL_SUFFIXES)
+                and (k in common or k.endswith("__null"))
+            }
+            cols = LazyColumns(parts, keys)
+            pf = plan.post_filter
+            m = (
+                evaluate(pf, ft, cols) if pf is not None
+                else np.ones(cols.num_rows, dtype=bool)
+            )
+            if not m.any():
+                continue
+            add += density_grid_numpy(
+                np.asarray(cols[geom + "__x"], dtype=np.float64)[m],
+                np.asarray(cols[geom + "__y"], dtype=np.float64)[m],
+                None,
+                tuple(spec["envelope"]),
+                width,
+                height,
+            )
+        return add

@@ -194,11 +194,12 @@ def test_density_matmul_edition_matches_scatter():
         np.testing.assert_array_equal(a, b)
 
 
-def test_density_pallas_failure_downgrades_to_matmul(monkeypatch):
+def test_density_pallas_failure_downgrades_to_sort(monkeypatch):
     """A pallas density kernel that fails at RUNTIME (the r5 silicon
-    shape: axon remote-compile 500) must downgrade to the XLA matmul
-    edition for the session — same grid, no host fallback, ONE warning,
-    and no pallas retry on subsequent queries."""
+    shape: axon remote-compile 500) must downgrade to the XLA sort
+    edition (the measured silicon winner) for the session — same grid,
+    no host fallback, ONE warning, and no pallas retry on subsequent
+    queries."""
     from geomesa_tpu.ops import aggregations as agg
     from geomesa_tpu.parallel import executor as ex
 
@@ -222,7 +223,7 @@ def test_density_pallas_failure_downgrades_to_matmul(monkeypatch):
     _fill(tpu)
     q = Query.cql(CQL, hints={"density": dict(DENSITY)})
     want = host.query("agg", q).aggregate["density"]
-    with pytest.warns(RuntimeWarning, match="using the XLA matmul edition for this session"):
+    with pytest.warns(RuntimeWarning, match="using the XLA sort edition for this session"):
         res = tpu.query("agg", q)
     assert res.plan.scan_path == "device-density"
     np.testing.assert_allclose(res.aggregate["density"], want)
@@ -253,3 +254,120 @@ def test_density_sort_edition_matches_scatter():
         a = np.asarray(density_kernel(x, y, mask, env, 32, 16))
         b = np.asarray(density_kernel_sort(x, y, mask, env, 32, 16))
         np.testing.assert_array_equal(a, b)
+
+
+def _fill_boundary(store, seed=23):
+    """Adversarial density data: points engineered within f32 error of
+    density-cell boundaries and query-box edges — the rows the dual
+    edition must defer to host f64 certification."""
+    rng = np.random.default_rng(seed)
+    ft = parse_spec("aggb", SPEC)
+    store.create_schema(ft)
+    env = BOUNDARY_DENSITY["envelope"]
+    w, h = BOUNDARY_DENSITY["width"], BOUNDARY_DENSITY["height"]
+    dx = (env[2] - env[0]) / w
+    dy = (env[3] - env[1]) / h
+    n_uniform, n_edge = 2000, 2000
+    xs = [rng.uniform(env[0], env[2], n_uniform)]
+    ys = [rng.uniform(env[1], env[3], n_uniform)]
+    # straddle cell boundaries at f32 scale (offsets far below f32 ulp
+    # of |x| ~ 1e-6, so f32 rounding can move points across)
+    bx = env[0] + rng.integers(0, w + 1, n_edge) * dx
+    by = env[1] + rng.integers(0, h + 1, n_edge) * dy
+    off = rng.uniform(-1e-9, 1e-9, n_edge)
+    xs.append(bx + off)
+    ys.append(by + rng.uniform(-1e-9, 1e-9, n_edge))
+    # straddle the query box's edges too
+    for edge_x in (BOUNDARY_BOX[0], BOUNDARY_BOX[2]):
+        xs.append(np.full(200, edge_x) + rng.uniform(-1e-9, 1e-9, 200))
+        ys.append(rng.uniform(env[1], env[3], 200))
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    n = len(x)
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    cols = {
+        "__fid__": np.array([f"b{i}" for i in range(n)], dtype=object),
+        "geom__x": x,
+        "geom__y": y,
+        "dtg": base + rng.integers(0, 20 * 86400, n) * 1000,
+        "actor": np.array(["USA"] * n, dtype=object),
+        "val": rng.uniform(0, 10, n),
+    }
+    store._insert_columns(ft, cols)
+    return ft, cols
+
+
+# awkward bounds: dx = 2.1/21 = 0.1 is not f32-representable, so cell
+# boundaries land between f32 values and the band is exercised for real
+BOUNDARY_DENSITY = {"envelope": (-1.05, -0.55, 1.05, 0.55), "width": 21, "height": 11}
+BOUNDARY_BOX = (-0.7, -0.35, 0.7, 0.35)
+BOUNDARY_CQL = (
+    f"bbox(geom, {BOUNDARY_BOX[0]}, {BOUNDARY_BOX[1]}, "
+    f"{BOUNDARY_BOX[2]}, {BOUNDARY_BOX[3]}) AND "
+    "dtg DURING 2026-01-02T00:00:00Z/2026-01-12T00:00:00Z"
+)
+
+
+def test_density_device_grid_exact_at_boundaries():
+    """The dual edition's device grid must equal the host oracle EXACTLY
+    (zero L1) on data engineered to straddle cell boundaries and box
+    edges at f32 scale — the band rows are host-certified from the f64
+    columns, so f32 rounding cannot show through."""
+    host = TpuDataStore(executor=HostScanExecutor())
+    _fill_boundary(host)
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    _fill_boundary(tpu)
+    q = Query.cql(BOUNDARY_CQL, hints={"density": dict(BOUNDARY_DENSITY)})
+    want = host.query("aggb", q).aggregate["density"]
+    res = tpu.query("aggb", q)
+    assert res.plan.scan_path == "device-density"
+    np.testing.assert_array_equal(res.aggregate["density"], want)
+    assert want.sum() > 0
+    # the z2 (no-time) dual leg: bbox-only query through the same band
+    bbox_only = BOUNDARY_CQL.split(" AND ")[0]
+    q2 = Query.cql(bbox_only, hints={"density": dict(BOUNDARY_DENSITY)})
+    want2 = host.query("aggb", q2).aggregate["density"]
+    res2 = tpu.query("aggb", q2)
+    assert res2.plan.scan_path == "device-density"
+    np.testing.assert_array_equal(res2.aggregate["density"], want2)
+
+
+def test_density_band_actually_engaged():
+    """Witness that the adversarial data produces a non-empty band (the
+    exactness test above must not pass vacuously)."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.ops.aggregations import density_band
+
+    rng = np.random.default_rng(23)
+    env = np.asarray(BOUNDARY_DENSITY["envelope"], dtype=np.float32)
+    w, h = BOUNDARY_DENSITY["width"], BOUNDARY_DENSITY["height"]
+    dx = (env[2] - env[0]) / w
+    bx = env[0] + rng.integers(0, w + 1, 500).astype(np.float32) * np.float32(dx)
+    x = jnp.asarray(bx)
+    y = jnp.zeros(500, jnp.float32)
+    boxes = jnp.asarray([BOUNDARY_BOX], dtype=jnp.float32)
+    band, near = density_band(x, y, jnp.asarray(env), w, h, boxes)
+    assert int(band.sum()) > 0
+    assert int(near.sum()) > 0
+
+
+def test_density_band_overflow_falls_back_to_host(monkeypatch):
+    """A band larger than the per-shard index budget must decline the
+    device path (host answers exactly) instead of truncating."""
+    from geomesa_tpu.ops import aggregations as agg
+
+    # the cap is read inside density_scan from the aggregations module
+    # (one read keys the compiled buffer size AND the overflow check)
+    monkeypatch.setattr(agg, "DENSITY_BAND_CAP", 4)
+
+    host = TpuDataStore(executor=HostScanExecutor())
+    _fill_boundary(host)
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    _fill_boundary(tpu)
+    q = Query.cql(BOUNDARY_CQL, hints={"density": dict(BOUNDARY_DENSITY)})
+    want = host.query("aggb", q).aggregate["density"]
+    res = tpu.query("aggb", q)
+    # grid still exact — just via the host reducer fallback
+    np.testing.assert_array_equal(res.aggregate["density"], want)
+    assert res.plan.scan_path != "device-density"
