@@ -195,3 +195,65 @@ def test_fuzz_parity_extent_store(monkeypatch):
         got = sorted(tpu.query("w", q).fids)
         want = sorted(host.query("w", q).fids)
         assert got == want, q
+
+
+def test_fuzz_parity_density_grids(monkeypatch):
+    """Random rect(+time) queries with density hints: the dual device
+    grid must equal the host reducer EXACTLY (zero L1) across the random
+    corpus — the fuzz-scale version of the engineered boundary tests.
+    Envelopes are grid-aligned half the time so cell boundaries land ON
+    data coordinates, and use non-f32-representable bounds otherwise."""
+    monkeypatch.setenv("GEOMESA_DENSITY_DEVICE", "1")
+    from geomesa_tpu.index.planner import Query
+
+    rng = np.random.default_rng(123)
+    rows = _data(rng, 1500)
+    host = TpuDataStore(executor=HostScanExecutor())
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    for s in (host, tpu):
+        s.create_schema(parse_spec("t", SPEC))
+        with s.writer("t") as w:
+            for fid, name, age, t, x, y in rows:
+                w.write([name, age, t, Point(x, y)], fid=fid)
+    device_runs = 0
+    for _ in range(12):
+        if rng.random() < 0.5:  # box edges EQUAL grid-snapped data coords
+            x0 = float(rng.integers(-6, 4) * 10.0)
+            y0 = float(rng.integers(-4, 2) * 10.0)
+        else:
+            x0 = float(rng.uniform(-60, 30))
+            y0 = float(rng.uniform(-40, 20))
+        bw = float(rng.uniform(10, 50))
+        parts = [f"bbox(geom, {x0!r}, {y0!r}, {x0 + bw!r}, {y0 + bw!r})"]
+        if rng.random() < 0.6:
+            d0 = int(rng.integers(0, 15))
+            parts.append(
+                f"dtg DURING 2026-01-{d0 + 1:02d}T00:00:00Z/"
+                f"2026-01-{d0 + int(rng.integers(1, 6)) + 1:02d}T00:00:00Z"
+            )
+        cql = " AND ".join(parts)
+        if rng.random() < 0.5:  # cell boundaries on data coordinates
+            env = (-60.0, -40.0, 60.0, 40.0)
+        else:  # 0.1-granular bounds: dx not f32-representable
+            env = (
+                round(float(rng.uniform(-66, -50)), 1),
+                round(float(rng.uniform(-44, -35)), 1),
+                round(float(rng.uniform(50, 66)), 1),
+                round(float(rng.uniform(35, 44)), 1),
+            )
+        # small shape set: each (w, h) is its own jit variant, so keep
+        # the compile count bounded while still varying the cell grid
+        w_px = int(rng.choice([16, 32]))
+        h_px = int(rng.choice([8, 16]))
+        q = Query.cql(
+            cql,
+            hints={"density": {"envelope": env, "width": w_px, "height": h_px}},
+        )
+        want = host.query("t", q).aggregate["density"]
+        res = tpu.query("t", q)
+        np.testing.assert_array_equal(
+            res.aggregate["density"], want, err_msg=cql
+        )
+        device_runs += res.plan.scan_path == "device-density"
+    # the exactness claim must not pass vacuously through host fallbacks
+    assert device_runs >= 8, device_runs
