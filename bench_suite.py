@@ -287,11 +287,38 @@ def bench_attr_bbox(n, reps):
             hi = round(lo + float(rng.uniform(2, 10)), 1)
             cqls.append(f"goldstein > {lo} AND goldstein <= {hi} AND {bq}")
             wants.append(set(fids[(gold > lo) & (gold <= hi) & in_box]))
+    # device stats push-down (per-code histograms -> exact sketches, no
+    # row extraction): parity checked against direct numpy aggregation
+    stats_fields = {}
+    try:
+        from geomesa_tpu.index.planner import Query as _Q
+
+        bq0 = f"bbox(geom, {box[0]}, {box[1]}, {box[2]}, {box[3]})"
+        sq = _Q.cql(bq0, hints={"stats": "Count();MinMax(goldstein);TopK(actor1)"})
+        ds.query("gdelt", sq)  # warm (jit per u_pad bucket)
+        st_s, st_res = _timeit(lambda: ds.query("gdelt", sq), max(3, reps // 4))
+        in_box = (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        seq = st_res.aggregate["stats"].stats
+        uniq, cnt = np.unique(actors[in_box], return_counts=True)
+        stats_parity = (
+            seq[0].count == int(in_box.sum())
+            and float(seq[1].min) == float(gold[in_box].min())
+            and float(seq[1].max) == float(gold[in_box].max())
+            and dict(seq[2].topk(5)) == dict(zip(uniq.tolist(), cnt.astype(int).tolist()))
+        )
+        stats_fields = {
+            "device_stats_ms": round(st_s * 1000, 3),
+            "device_stats_path": st_res.plan.scan_path,
+            "device_stats_parity": bool(stats_parity),
+        }
+    except Exception as e:  # noqa: BLE001 - diagnostic field, not a config
+        stats_fields = {"device_stats_error": f"{type(e).__name__}: {e}"[:160]}
     return {
         "metric": "attr_plus_bbox_throughput", "value": round(n / dev_s, 1),
         "unit": "features/sec", "vs_baseline": round(base_s / dev_s, 3),
         "n": n, "hits": int(want_mask.sum()), "parity": bool(parity),
         "query_ms": round(dev_s * 1000, 3),
+        **stats_fields,
         **_device_stream_fields(ds, "gdelt", cqls, wants, n, base_s),
     }
 

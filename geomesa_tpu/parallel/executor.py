@@ -472,6 +472,42 @@ def _exact_count_fn(has_time: bool, mode: str, mesh, attr=False):
     return fn
 
 
+_EXACT_STAT_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _exact_stat_hist_fn(has_time: bool, mode: str, mesh, u_pad: int):
+    """Mask x target rank-codes -> i32[1 + u_pad]: [total hit count,
+    per-code hit counts]. The device half of the stats push-down: the
+    host reconstructs EXACT value-distribution sketches (MinMax incl.
+    HLL, Enumeration, TopK, Histogram, Frequency) from per-code counts
+    via the segment's sorted vocab — U counts cross the link instead of
+    N rows (the StatsScan compute-at-data analog, AggregatingScan.scala:
+    22-168 / KryoLazyStatsIterator). Counting is the sort + boundary-
+    searchsorted shape (the measured density-edition winner on silicon),
+    not a scatter-add; null/pad rows (code -1) sort into the discard
+    bucket past u_pad."""
+    key = (has_time, mode, mesh, u_pad)
+    fn = _EXACT_STAT_FNS.get(key)
+    if fn is None:
+        mask = _exact_mask_body(has_time, mode, mesh, False)
+
+        def run(tcodes, *args):
+            m = mask(*args)
+            cnt = jnp.sum(m, dtype=jnp.int32)
+            live = m & (tcodes >= 0)
+            flat = jnp.where(live, tcodes, jnp.int32(u_pad))
+            s = jnp.sort(flat)
+            bounds = jnp.searchsorted(
+                s, jnp.arange(u_pad + 1, dtype=jnp.int32)
+            ).astype(jnp.int32)
+            hist = jnp.diff(bounds)
+            return jnp.concatenate([cnt[None], hist])
+
+        fn = jax.jit(run)
+        _EXACT_STAT_FNS[key] = fn
+    return fn
+
+
 def _point_desc_split(mask, has_time: bool, args, attr=False):
     """Shared arg split for the point batch builders: returns
     (mask_of(desc), stacked desc arrays for lax.scan). ``attr`` adds the
@@ -2538,6 +2574,22 @@ class DeviceSegment:
         out = _exact_count_fn(has_time, mode, self.mesh, aflag)(*args)
         _start_d2h(out)
         return out
+
+    def stat_hist_start(self, box_dev, win_dev, attr: str):
+        """DISPATCH a filtered per-code count histogram for ``attr``
+        (load_attr_codes must have succeeded): returns (in-flight
+        i32[1 + u_pad] buffer, sorted unified value space). Collect with
+        np.asarray; [0] is the total hit count (nulls included), [1:] the
+        per-code hit counts aligned to the vocab. Callers replicate
+        box/window once and dispatch every segment before collecting."""
+        has_time = self.tk_hi is not None and win_dev is not None
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        tcodes, unified = self._attr_codes[attr]
+        u_pad = _pow2_at_least(len(unified), 8)
+        args = self._exact_args(box_dev, win_dev, has_time)
+        out = _exact_stat_hist_fn(has_time, mode, self.mesh, u_pad)(tcodes, *args)
+        _start_d2h(out)
+        return out, unified
 
     def dispatch_exact(self, box_dev, win_dev) -> "_PendingHits":
         """Exact predicate scan (see TpuScanExecutor._exact_descriptor)."""
@@ -5207,18 +5259,10 @@ class TpuScanExecutor:
         host seek's sub-ms answer) | 1 | 0. Reference role: the
         EXACT_COUNT hint / GeoMesaStats.getCount split
         (index-api .../stats/GeoMesaStats.scala, QueryProperties)."""
-        import os
+        from geomesa_tpu.parallel.mesh import device_auto_declines
 
-        env = os.environ.get("GEOMESA_COUNT_DEVICE", "auto")
-        if env == "0":
+        if device_auto_declines("GEOMESA_COUNT_DEVICE"):
             return None
-        if env != "1":
-            if jax.default_backend() == "cpu":
-                return None
-            from geomesa_tpu.parallel.mesh import link_latency_ms
-
-            if link_latency_ms() > 10.0:
-                return None
         if table.index.name in ("xz2", "xz3"):
             return self._count_xz_scan(table, plan)
         if table.index.name not in ("z2", "z3"):
@@ -5262,6 +5306,121 @@ class TpuScanExecutor:
             for seg in dev.segments
         ]
         return sum(int(p) for p in pending)
+
+    # value-distribution sketches reconstructable exactly from per-code
+    # counts (observe_counts contract); GroupBy/Z3*/Descriptive and
+    # geometry-attribute stats stay on the host extraction path
+    _STAT_HIST_KINDS = ("minmax", "enumeration", "topk", "histogram", "frequency")
+
+    def stats_scan(self, table: IndexTable, plan: QueryPlan, spec: str):
+        """Device stats push-down (the KryoLazyStatsIterator / StatsScan
+        compute-at-data analog, index-api iterators/AggregatingScan.scala:
+        22-168): when the plan's FULL filter is a precise box(+window) on
+        a point table and every combinator in ``spec`` is a value-
+        distribution sketch over a rank-codable attribute, each segment
+        ships ONE per-code count histogram (u_pad i32 — transfer sized by
+        the attribute's cardinality, not the hit count) and the host
+        reconstructs the EXACT sketches through the observe_counts
+        contract: identical state to extracting the rows and observing
+        them, including MinMax's HLL registers (multiplicity-insensitive,
+        so distinct-value observation reproduces them bit-for-bit).
+        None -> host path (extract + run_stats).
+
+        GEOMESA_STATS_DEVICE: auto (accelerators with a sub-10ms link) |
+        1 | 0 — same cost shape as GEOMESA_COUNT_DEVICE."""
+        from geomesa_tpu.parallel.mesh import device_auto_declines
+        from geomesa_tpu.stats.parser import parse_stat
+        from geomesa_tpu.stats.sketches import CountStat, SeqStat
+
+        if device_auto_declines("GEOMESA_STATS_DEVICE"):
+            return None
+        if table.index.name not in ("z2", "z3"):
+            return None
+        if not self._scan_eligible(table, plan):
+            return None
+        if self._has_visibilities(table):
+            return None
+        desc = self._exact_descriptor(table, plan)
+        if desc is None:
+            return None  # attr predicates / non-rect filters: host path
+        try:
+            stat = parse_stat(spec)
+        except Exception:
+            return None
+        stats = stat.stats if isinstance(stat, SeqStat) else [stat]
+        geom = table.ft.default_geometry.name if table.ft.default_geometry else None
+        attrs = []
+        for s in stats:
+            if isinstance(s, CountStat):
+                continue
+            target = getattr(s, "attribute", None)
+            if (
+                s.kind not in self._STAT_HIST_KINDS
+                or target is None
+                or target == geom
+            ):
+                return None
+            attrs.append(target)
+        dev = self.device_index(table)
+        if not dev.segments:
+            return None
+        if not all(seg.load_exact(table) for seg in dev.segments):
+            return None
+        for a in set(attrs):
+            for seg in dev.segments:
+                # the histogram buffer rides the vocab-mask size gate:
+                # past it the per-query u_pad transfer stops being small
+                if not seg.load_attr_codes(a) or not seg.attr_vocab_ok(a):
+                    return None
+        box_np, win_np = desc
+        box_dev = replicate(self.mesh, box_np)
+        win_dev = None if win_np is None else replicate(self.mesh, win_np)
+        if attrs:
+            pending = {
+                a: [seg.stat_hist_start(box_dev, win_dev, a) for seg in dev.segments]
+                for a in set(attrs)
+            }
+            merged: Dict[str, tuple] = {}
+            total = None
+            for a, per_seg in pending.items():
+                vals: List[np.ndarray] = []
+                cnts: List[np.ndarray] = []
+                t = 0
+                for buf, unified in per_seg:
+                    out = np.asarray(buf)
+                    t += int(out[0])
+                    h = out[1 : 1 + len(unified)]
+                    present = h > 0
+                    if present.any():
+                        vals.append(np.asarray(unified)[present])
+                        cnts.append(h[present].astype(np.int64))
+                if vals:
+                    allv = np.concatenate(vals)
+                    allc = np.concatenate(cnts)
+                    uniq, inv = np.unique(allv, return_inverse=True)
+                    summed = np.zeros(len(uniq), dtype=np.int64)
+                    np.add.at(summed, inv, allc)
+                    merged[a] = (uniq, summed)
+                else:
+                    merged[a] = (np.empty(0), np.empty(0, dtype=np.int64))
+                total = t if total is None else total
+        else:
+            # Count()-only spec: the scalar count edition answers directly
+            # (count_scan's own env gate must not double-gate a stats
+            # request that already passed GEOMESA_STATS_DEVICE)
+            pend = [
+                seg.count_exact_start(box_dev, win_dev)
+                for seg in dev.segments
+            ]
+            total = sum(int(p) for p in pend)
+        for s in stats:
+            if isinstance(s, CountStat):
+                s.count = int(total)
+            else:
+                vals_cnts = merged[getattr(s, "attribute")]
+                if len(vals_cnts[0]):
+                    s.observe_counts(*vals_cnts)
+        return stat
 
     def _count_xz_scan(self, table: IndexTable, plan: QueryPlan):
         """Extent edition of count_scan (round-4 idea #5): the dual
@@ -5371,21 +5530,14 @@ class TpuScanExecutor:
         """
         import os
 
-        mode = os.environ.get("GEOMESA_DENSITY_DEVICE", "auto")
-        if mode == "0":
-            return None
-        if mode != "1":
-            # cost choice (like GEOMESA_KNN_DEVICE): the fused kernel full-
-            # scans every resident row — free on an accelerator, while the
-            # CPU backend's host path seeks candidates and bincounts them.
-            # Over a high-latency link the dispatch round trip alone beats
-            # the host path, so auto declines there too (link_latency_ms).
-            if jax.default_backend() == "cpu":
-                return None
-            from geomesa_tpu.parallel.mesh import link_latency_ms
+        from geomesa_tpu.parallel.mesh import device_auto_declines
 
-            if link_latency_ms() > 10.0:
-                return None
+        # cost choice (like GEOMESA_KNN_DEVICE): the fused kernel full-
+        # scans every resident row — free on an accelerator, while the
+        # CPU backend's host path seeks candidates and bincounts them;
+        # over a high-latency link the dispatch round trip alone loses
+        if device_auto_declines("GEOMESA_DENSITY_DEVICE"):
+            return None
         if table.index.name not in ("z2", "z3") or not self.supports(table, plan):
             return None
         if plan.secondary is not None or spec.get("weight") or spec.get("exact"):

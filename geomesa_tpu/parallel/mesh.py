@@ -183,6 +183,27 @@ def link_latency_ms() -> float:
     return _LINK_LATENCY_MS
 
 
+def device_auto_declines(env_var: str, link_cap_ms: float = 10.0) -> bool:
+    """The shared auto-mode cost gate for scalar/aggregate device
+    push-downs (count/stats/density): True when the path should decline
+    to the host — forced off ("0"), or in auto mode on the CPU backend
+    (where "device" compute IS host compute) or over a high-latency
+    link (the per-execution floor loses to the host seek's sub-ms
+    answer). An explicit "1" always passes."""
+    import os
+
+    import jax
+
+    env = os.environ.get(env_var, "auto")
+    if env == "0":
+        return True
+    if env == "1":
+        return False
+    if jax.default_backend() == "cpu":
+        return True
+    return link_latency_ms() > link_cap_ms
+
+
 def device_tripped(executor, env_var: str) -> bool:
     """True when a device path already failed this session AND the
     operator has not forced THIS path on (env_var != "1"): auto-mode

@@ -65,7 +65,12 @@ def _hash64(values: np.ndarray) -> np.ndarray:
             ],
             dtype=np.uint64,
         )
-    return _mix64(np.asarray(values, dtype=np.float64).view(np.uint64))
+    # + 0.0 collapses -0.0 onto +0.0 BEFORE taking bits: hashing must
+    # follow VALUE equality (-0.0 == 0.0 ranks as one code in the device
+    # planes and matches the same CQL literals), not bit identity —
+    # otherwise HLL/CMS state depends on which representation a row
+    # happened to carry
+    return _mix64((np.asarray(values, dtype=np.float64) + 0.0).view(np.uint64))
 
 
 def _clean(values: np.ndarray, nulls: Optional[np.ndarray]) -> np.ndarray:
@@ -132,6 +137,18 @@ class MinMax(Stat):
             vmin, vmax = values.min(), values.max()
         self.min = vmin if self.min is None else min(self.min, vmin)
         self.max = vmax if self.max is None else max(self.max, vmax)
+        self._observe_hll(values)
+
+    def observe_counts(self, values, counts):
+        """Pre-aggregated observation (see EnumerationStat.observe_counts).
+        MinMax state is multiplicity-INSENSITIVE — bounds depend on the
+        value set and the HLL registers are per-value maxima — so one
+        observation of each distinct value reproduces the exact state a
+        per-row observe over the expanded column would."""
+        del counts
+        self.observe(values)
+
+    def _observe_hll(self, values):
         if not self.track_cardinality:
             return
         h = _hash64(values)
@@ -342,6 +359,27 @@ class Histogram(Stat):
         # bincount is ~10x add.at for large batches (write-time stats are
         # on the ingest hot path, StatsCombiner analog)
         self.counts += np.bincount(idx, minlength=self.bins)
+
+    def observe_counts(self, values, counts):
+        """Pre-aggregated observation (see EnumerationStat.observe_counts):
+        identical state to a per-row observe of the expanded column —
+        auto-ranging keys off min/max of the distinct values (same
+        bounds), then each value's bin gains its full count."""
+        values = np.asarray(values, dtype=np.float64)
+        counts = np.asarray(counts, dtype=np.int64)
+        finite = np.isfinite(values)
+        values, counts = values[finite], counts[finite]
+        if not len(values):
+            return
+        vlo, vhi = float(values.min()), float(values.max())
+        if self.lo is None:
+            pad = (vhi - vlo) * 0.1 or max(1.0, abs(vlo) * 0.01)
+            self.lo, self.hi = vlo - pad, vhi + pad
+        elif not self._fixed and (vlo < self.lo or vhi > self.hi):
+            span = max(vhi, self.hi) - min(vlo, self.lo)
+            self._expand(min(vlo, self.lo) - span * 0.1, max(vhi, self.hi) + span * 0.1)
+        idx = np.floor((values - self.lo) * self.bins / (self.hi - self.lo)).astype(np.int64)
+        np.add.at(self.counts, np.clip(idx, 0, self.bins - 1), counts)
 
     def bin_bounds(self, i: int) -> Tuple[float, float]:
         w = (self.hi - self.lo) / self.bins

@@ -629,6 +629,29 @@ class TpuDataStore:
                 plan.scan_path = "device-density"
                 return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})
 
+        # device stats push-down: per-code count histograms come back,
+        # features don't (the KryoLazyStatsIterator analog) — the host
+        # reconstructs exact sketches via the observe_counts contract
+        if (
+            set(query.hints) & set(AGGREGATION_HINTS) == {"stats"}
+            and not query.hints.get("sampling")
+            and not mesh_mod.device_tripped(
+                self.executor, "GEOMESA_STATS_DEVICE"
+            )
+        ):
+            try:
+                stat = self.executor.stats_scan(
+                    table, plan, query.hints["stats"]
+                )
+            except Exception as e:  # noqa: BLE001 - device/tunnel failure
+                mesh_mod.trip_device(
+                    self.executor, "GEOMESA_STATS_DEVICE", "stats", e
+                )
+                stat = None
+            if stat is not None:
+                plan.scan_path = "device-stats"
+                return QueryResult(ft, _empty_columns(ft), plan, {"stats": stat})
+
         parts = self._scan_parts(name, ft, query, plan, t_scan_start, pending)
         columns = self._columns_from_parts(ft, query, parts)
         # NO xz dedupe: unlike the reference's sharded XZ tables
@@ -978,6 +1001,11 @@ class ScanExecutor:
 
     def density_scan(self, table, plan: QueryPlan, spec) -> Optional[np.ndarray]:
         """Fused filter+density on device; None -> host reducer fallback."""
+        return None
+
+    def stats_scan(self, table, plan: QueryPlan, spec: str):
+        """Device stats sketches from per-code counts; None -> host
+        extraction + run_stats fallback."""
         return None
 
     def post_filter(self, ft: FeatureType, plan: QueryPlan, columns: Columns) -> np.ndarray:

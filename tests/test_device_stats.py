@@ -1,0 +1,171 @@
+"""Device stats push-down: per-code count histograms -> exact sketches.
+
+The StatsScan / KryoLazyStatsIterator compute-at-data analog: for
+device-decidable box(+window) plans, each segment ships one per-code
+count histogram and the host reconstructs the sketches through the
+observe_counts contract. Parity bar: the device-built sketch's full
+JSON state equals the host extraction path's — including MinMax's HLL
+registers (multiplicity-insensitive, so distinct-value observation
+reproduces them bit-for-bit).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+
+SPEC = "actor:String,val:Double,age:Int,dtg:Date,*geom:Point:srid=4326"
+CQL = (
+    "bbox(geom, -20, -20, 20, 20) AND "
+    "dtg DURING 2026-01-02T00:00:00Z/2026-01-12T00:00:00Z"
+)
+
+
+@pytest.fixture(autouse=True)
+def _force_device_stats(monkeypatch):
+    # auto declines on the CPU backend; these tests exercise the device
+    # reconstruction path (exact-device gate feeds the descriptor)
+    monkeypatch.setenv("GEOMESA_STATS_DEVICE", "1")
+    monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "1")
+
+
+def _fill(store, n=4000, seed=31):
+    rng = np.random.default_rng(seed)
+    ft = parse_spec("st", SPEC)
+    store.create_schema(ft)
+    base = np.datetime64("2026-01-01T00:00:00", "ms").astype("int64")
+    nulls = rng.random(n) < 0.05
+    vals = rng.uniform(0, 10, n)
+    cols = {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-50, 50, n),
+        "geom__y": rng.uniform(-50, 50, n),
+        "dtg": base + rng.integers(0, 20 * 86400, n) * 1000,
+        "actor": np.array(
+            [["USA", "FRA", "CHN", "BRA", "IND"][i % 5] for i in range(n)],
+            dtype=object,
+        ),
+        "val": np.where(nulls, np.nan, vals),
+        "val__null": nulls,
+        "age": rng.integers(0, 90, n).astype(np.int32),
+    }
+    store._insert_columns(ft, cols)
+    return ft
+
+
+@pytest.fixture(scope="module")
+def stores():
+    host = TpuDataStore(executor=HostScanExecutor())
+    _fill(host)
+    tpu = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    _fill(tpu)
+    return host, tpu
+
+
+SPECS = [
+    "Count()",
+    "MinMax(actor)",
+    "MinMax(val)",
+    "MinMax(dtg)",
+    "Enumeration(actor)",
+    "Enumeration(age)",
+    "TopK(actor)",
+    "Histogram(val,20,0,10)",
+    "Frequency(actor)",
+    "Count();MinMax(dtg);TopK(actor)",
+    "MinMax(age);Enumeration(actor);Count()",
+]
+
+
+@pytest.mark.parametrize("spec", SPECS)
+def test_device_stats_state_equals_host(stores, spec):
+    host, tpu = stores
+    q = Query.cql(CQL, hints={"stats": spec})
+    want = host.query("st", q)
+    got = tpu.query("st", q)
+    assert got.plan.scan_path == "device-stats", got.plan.scan_path
+    assert want.plan.scan_path != "device-stats"
+    assert got.aggregate["stats"].to_json() == want.aggregate["stats"].to_json()
+
+
+def test_device_stats_bbox_only_leg(stores):
+    host, tpu = stores
+    q = Query.cql("bbox(geom, -20, -20, 20, 20)", hints={"stats": "MinMax(actor);Count()"})
+    got = tpu.query("st", q)
+    assert got.plan.scan_path == "device-stats"
+    assert got.aggregate["stats"].to_json() == host.query("st", q).aggregate["stats"].to_json()
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "GroupBy(actor,Count())",   # unsupported combinator
+        "MinMax(geom)",             # geometry bounds: host path
+        "DescriptiveStats(val)",    # moment stats: host path
+    ],
+)
+def test_device_stats_declines_to_host(stores, spec):
+    host, tpu = stores
+    q = Query.cql(CQL, hints={"stats": spec})
+    got = tpu.query("st", q)
+    assert got.plan.scan_path != "device-stats"
+    assert got.aggregate["stats"].to_json() == host.query("st", q).aggregate["stats"].to_json()
+
+
+def test_device_stats_declines_on_attr_filter(stores):
+    # an attribute predicate in the filter leaves the exact-descriptor
+    # path; stats must fall back to host extraction and still agree
+    host, tpu = stores
+    cql = CQL + " AND actor = 'USA'"
+    q = Query.cql(cql, hints={"stats": "Count();MinMax(val)"})
+    got = tpu.query("st", q)
+    assert got.plan.scan_path != "device-stats"
+    assert got.aggregate["stats"].to_json() == host.query("st", q).aggregate["stats"].to_json()
+
+
+def test_minmax_hll_registers_identical(stores):
+    """The strongest form of the multiplicity-insensitivity claim: the
+    device MinMax's HLL registers equal the host's byte-for-byte."""
+    host, tpu = stores
+    q = Query.cql(CQL, hints={"stats": "MinMax(actor)"})
+    h = host.query("st", q).aggregate["stats"]
+    d = tpu.query("st", q).aggregate["stats"]
+    np.testing.assert_array_equal(d.registers, h.registers)
+    assert (d.min, d.max) == (h.min, h.max)
+
+
+def test_negative_zero_hashes_as_value_equality():
+    """-0.0 and 0.0 are value-equal (one rank code on device), so the
+    hash feeding HLL/CMS must collapse them — otherwise MinMax/Frequency
+    state depends on which bit pattern a row happened to carry and the
+    device reconstruction (which can only see the value set) diverges."""
+    from geomesa_tpu.stats.sketches import Frequency, MinMax, _hash64
+
+    assert _hash64(np.array([-0.0])) == _hash64(np.array([0.0]))
+    a, b = MinMax("v"), MinMax("v")
+    a.observe(np.array([-0.0, 1.5]))
+    b.observe(np.array([0.0, -0.0, 1.5]))
+    np.testing.assert_array_equal(a.registers, b.registers)
+    fa, fb = Frequency("v"), Frequency("v")
+    fa.observe(np.array([-0.0, 0.0]))
+    fb.observe(np.array([0.0, 0.0]))
+    np.testing.assert_array_equal(fa.table, fb.table)
+
+
+def test_device_stats_declines_over_vocab_cap(stores, monkeypatch):
+    """An attribute whose distinct-value count exceeds the vocab gate
+    must decline cleanly to the host path with an identical result."""
+    from geomesa_tpu.parallel import executor as ex
+
+    monkeypatch.setattr(ex.DeviceSegment, "ATTR_VOCAB_MASK_CAP", 4)
+    host, tpu = stores
+    # fresh executor state so the cap applies to a new code-plane load
+    tpu2 = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    _fill(tpu2)
+    q = Query.cql(CQL, hints={"stats": "MinMax(val)"})
+    got = tpu2.query("st", q)
+    assert got.plan.scan_path != "device-stats"
+    assert got.aggregate["stats"].to_json() == host.query("st", q).aggregate["stats"].to_json()
