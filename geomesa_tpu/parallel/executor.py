@@ -5354,11 +5354,17 @@ class TpuScanExecutor:
             if isinstance(s, CountStat):
                 continue
             target = getattr(s, "attribute", None)
-            if (
-                s.kind not in self._STAT_HIST_KINDS
-                or target is None
-                or target == geom
-            ):
+            if target is None or target == geom:
+                return None
+            if s.kind == "groupby":
+                # GroupBy(a, Count()) IS the per-code histogram — one
+                # CountStat group per present value; any other sub-stat
+                # needs joint distributions and stays on the host
+                import json as _json
+
+                if _json.loads(s.example).get("kind") != "count":
+                    return None
+            elif s.kind not in self._STAT_HIST_KINDS:
                 return None
             attrs.append(target)
         dev = self.device_index(table)
@@ -5416,10 +5422,15 @@ class TpuScanExecutor:
         for s in stats:
             if isinstance(s, CountStat):
                 s.count = int(total)
-            else:
-                vals_cnts = merged[getattr(s, "attribute")]
-                if len(vals_cnts[0]):
-                    s.observe_counts(*vals_cnts)
+                continue
+            vals, cnts = merged[getattr(s, "attribute")]
+            if s.kind == "groupby":
+                for v, c in zip(vals, cnts):
+                    sub = s._new()
+                    sub.count = int(c)
+                    s.groups[v.item() if isinstance(v, np.generic) else v] = sub
+            elif len(vals):
+                s.observe_counts(vals, cnts)
         return stat
 
     def _count_xz_scan(self, table: IndexTable, plan: QueryPlan):

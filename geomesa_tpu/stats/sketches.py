@@ -342,11 +342,11 @@ class Histogram(Stat):
             idx = np.floor((centers - lo) * self.bins / (hi - lo)).astype(np.int64)
             np.add.at(self.counts, np.clip(idx, 0, self.bins - 1), old_counts)
 
-    def observe(self, values, nulls=None):
-        values = _clean(np.asarray(values, dtype=np.float64), nulls)
-        values = values[np.isfinite(values)]
-        if not len(values):
-            return
+    def _auto_range(self, values: np.ndarray) -> np.ndarray:
+        """Shared ranging + binning for observe/observe_counts: initialize
+        or expand [lo, hi] from the batch's min/max (the observe_counts
+        parity contract requires BOTH paths to use this one formula),
+        then return each value's clipped bin index."""
         vlo, vhi = float(values.min()), float(values.max())
         if self.lo is None:
             pad = (vhi - vlo) * 0.1 or max(1.0, abs(vlo) * 0.01)
@@ -355,7 +355,14 @@ class Histogram(Stat):
             span = max(vhi, self.hi) - min(vlo, self.lo)
             self._expand(min(vlo, self.lo) - span * 0.1, max(vhi, self.hi) + span * 0.1)
         idx = np.floor((values - self.lo) * self.bins / (self.hi - self.lo)).astype(np.int64)
-        idx = np.clip(idx, 0, self.bins - 1)
+        return np.clip(idx, 0, self.bins - 1)
+
+    def observe(self, values, nulls=None):
+        values = _clean(np.asarray(values, dtype=np.float64), nulls)
+        values = values[np.isfinite(values)]
+        if not len(values):
+            return
+        idx = self._auto_range(values)
         # bincount is ~10x add.at for large batches (write-time stats are
         # on the ingest hot path, StatsCombiner analog)
         self.counts += np.bincount(idx, minlength=self.bins)
@@ -371,15 +378,7 @@ class Histogram(Stat):
         values, counts = values[finite], counts[finite]
         if not len(values):
             return
-        vlo, vhi = float(values.min()), float(values.max())
-        if self.lo is None:
-            pad = (vhi - vlo) * 0.1 or max(1.0, abs(vlo) * 0.01)
-            self.lo, self.hi = vlo - pad, vhi + pad
-        elif not self._fixed and (vlo < self.lo or vhi > self.hi):
-            span = max(vhi, self.hi) - min(vlo, self.lo)
-            self._expand(min(vlo, self.lo) - span * 0.1, max(vhi, self.hi) + span * 0.1)
-        idx = np.floor((values - self.lo) * self.bins / (self.hi - self.lo)).astype(np.int64)
-        np.add.at(self.counts, np.clip(idx, 0, self.bins - 1), counts)
+        np.add.at(self.counts, self._auto_range(values), counts)
 
     def bin_bounds(self, i: int) -> Tuple[float, float]:
         w = (self.hi - self.lo) / self.bins

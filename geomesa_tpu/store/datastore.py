@@ -603,11 +603,19 @@ class TpuDataStore:
         tables = self._tables[name]
         table = tables[plan.index.name]
 
+        # device aggregation push-downs evaluate STORED columns — a query
+        # transform (computed property) changes what the host path would
+        # aggregate, so any transform keeps aggregation on the host
+        from geomesa_tpu.index.transforms import QueryTransforms as _QT
+
+        untransformed = _QT.parse(ft, query.properties) is None
+
         # fused device density push-down: grid comes back, features don't
         # (the KryoLazyDensityIterator analog)
         if (
             set(query.hints) & set(AGGREGATION_HINTS) == {"density"}
             and not query.hints.get("sampling")
+            and untransformed
             and not mesh_mod.device_tripped(
                 self.executor, "GEOMESA_DENSITY_DEVICE"
             )
@@ -635,6 +643,7 @@ class TpuDataStore:
         if (
             set(query.hints) & set(AGGREGATION_HINTS) == {"stats"}
             and not query.hints.get("sampling")
+            and untransformed
             and not mesh_mod.device_tripped(
                 self.executor, "GEOMESA_STATS_DEVICE"
             )

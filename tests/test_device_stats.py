@@ -77,6 +77,8 @@ SPECS = [
     "Frequency(actor)",
     "Count();MinMax(dtg);TopK(actor)",
     "MinMax(age);Enumeration(actor);Count()",
+    "GroupBy(actor,Count())",
+    "GroupBy(age,Count());Count()",
 ]
 
 
@@ -102,7 +104,7 @@ def test_device_stats_bbox_only_leg(stores):
 @pytest.mark.parametrize(
     "spec",
     [
-        "GroupBy(actor,Count())",   # unsupported combinator
+        "GroupBy(actor,MinMax(val))",  # joint distribution: host path
         "MinMax(geom)",             # geometry bounds: host path
         "DescriptiveStats(val)",    # moment stats: host path
     ],
@@ -169,3 +171,24 @@ def test_device_stats_declines_over_vocab_cap(stores, monkeypatch):
     got = tpu2.query("st", q)
     assert got.plan.scan_path != "device-stats"
     assert got.aggregate["stats"].to_json() == host.query("st", q).aggregate["stats"].to_json()
+
+
+def test_device_stats_declines_on_transform(stores):
+    """A computed query property changes what the host would aggregate —
+    the device path (which reads stored columns) must decline and the
+    transformed host result must win."""
+    host, tpu = stores
+    q = Query.cql(
+        CQL,
+        properties=["doubled=multiply($val, 2)"],
+        hints={"stats": "MinMax(doubled)"},
+    )
+    got = tpu.query("st", q)
+    assert got.plan.scan_path != "device-stats"
+    want = host.query("st", q)
+    assert got.aggregate["stats"].to_json() == want.aggregate["stats"].to_json()
+    # and the bounds really are the transformed ones
+    plain = host.query("st", Query.cql(CQL, hints={"stats": "MinMax(val)"}))
+    assert got.aggregate["stats"].max == pytest.approx(
+        2 * plain.aggregate["stats"].max
+    )
