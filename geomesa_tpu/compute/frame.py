@@ -15,6 +15,17 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def _non_null(v) -> np.ndarray:
+    """Drop NaN (float) / None (object) entries for null-ignoring
+    aggregates."""
+    v = np.asarray(v)
+    if v.dtype.kind == "f":
+        return v[~np.isnan(v)]
+    if v.dtype.kind == "O":
+        return v[np.array([x is not None for x in v], dtype=bool)]
+    return v
+
+
 class SpatialFrame:
     def __init__(self, columns: Dict[str, np.ndarray], ft=None):
         self.columns = dict(columns)
@@ -69,8 +80,11 @@ class SpatialFrame:
         "count": lambda v: len(v),
         "sum": lambda v: np.sum(v),
         "mean": lambda v: np.mean(v),
-        "min": lambda v: np.min(v),
-        "max": lambda v: np.max(v),
+        # SQL MIN/MAX ignore NULLs (NaN floats / None objects) — np.min
+        # would propagate NaN and TypeError on None; an all-null group
+        # yields 0, matching the global-aggregate empty-result shape
+        "min": lambda v: (lambda m: np.min(m) if len(m) else 0)(_non_null(v)),
+        "max": lambda v: (lambda m: np.max(m) if len(m) else 0)(_non_null(v)),
     }
 
     def group_by(
@@ -80,12 +94,32 @@ class SpatialFrame:
         or a sequence of them (composite grouping). The
         ShallowJoin/CountByDay analytics shape (geomesa-accumulo-compute)."""
         keys = [key] if isinstance(key, str) else list(key)
+        # null group keys (None objects / NaN floats) are SKIPPED, the
+        # framework-wide grouping convention (GroupByStat.observe_grouped
+        # skips them like the reference skips features whose grouping
+        # attribute is missing) — np.unique would otherwise raise
+        # comparing None against values
+        live = np.ones(len(self), dtype=bool)
+        for k in keys:
+            col = np.asarray(self.columns[k])
+            nulls = self.columns.get(k + "__null")
+            if nulls is not None:
+                # decoded columns carry nulls as fill values ("" / 0) —
+                # the companion mask is the real null signal
+                live &= ~np.asarray(nulls, dtype=bool)
+            if col.dtype.kind == "O":
+                live &= np.array([x is not None for x in col], dtype=bool)
+            elif col.dtype.kind == "f":
+                live &= ~np.isnan(col)
+        frame = self if live.all() else SpatialFrame(
+            {k: v[live] for k, v in self.columns.items()}, self.ft
+        )
         # factorize each key column, then combine the per-key codes into
         # one group id (mixed dtypes can't stack into a single unique call)
         uniques = []
         codes = None
         for k in keys:
-            u, inv = np.unique(self.columns[k], return_inverse=True)
+            u, inv = np.unique(frame.columns[k], return_inverse=True)
             uniques.append(u)
             codes = inv if codes is None else codes * len(u) + inv
         if len(keys) == 1:  # already factorized: skip the second unique
@@ -106,7 +140,7 @@ class SpatialFrame:
         bounds = np.searchsorted(inverse[order], np.arange(len(gids) + 1))
         for out_name, (fn_name, src) in aggs.items():
             fn = self._AGGS[fn_name]
-            src_sorted = self.columns[src][order]
+            src_sorted = frame.columns[src][order]
             out[out_name] = np.asarray(
                 [fn(src_sorted[bounds[g]: bounds[g + 1]]) for g in range(len(gids))]
             )

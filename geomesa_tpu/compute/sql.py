@@ -873,6 +873,14 @@ class SQLContext:
                     cols = {k: v[: q["limit"]] for k, v in cols.items()}
                 return SqlResult(cols, None, plan)
 
+        # sketch push-down: global COUNT(*)/MIN/MAX and single-key
+        # GROUP BY + COUNT(*) ride the stats hint — the store answers from
+        # per-code count histograms (device stats scan on accelerators)
+        # and rows never leave the scan (Spark's aggregate-pushdown role)
+        sk = self._stats_pushdown(ft, q, aggs, plain, stfns, star)
+        if sk is not None:
+            return sk
+
         # projection pushdown: only the columns the SELECT needs leave the
         # scan (group keys, agg sources, plain columns, st-fn inputs)
         props: Optional[List[str]] = None
@@ -962,6 +970,80 @@ class SQLContext:
                     frame.ft,
                 )
         return SqlResult(frame.columns, frame.ft, res.plan)
+
+    def _stats_pushdown(self, ft, q: dict, aggs, plain, stfns, star):
+        """SqlResult for aggregate shapes the stats sketches answer
+        exactly, or None to take the ordinary extract-then-aggregate
+        path. Supported: global COUNT(*)/MIN(a)/MAX(a) combinations, and
+        ``SELECT key, COUNT(*) ... GROUP BY key``. MIN/MAX ignore nulls
+        (SQL semantics, matching the null-excluding rank-code planes);
+        an empty result yields 0 like _aggregate's empty shape."""
+        if star or stfns or q["having"] is not None or q["order"]:
+            return None
+        group = q["group"]
+        if group:
+            if (
+                len(group) != 1 or len(aggs) != 1
+                or aggs[0]["fn"] != "count" or aggs[0]["arg"] != "*"
+                or [it["name"] for it in plain] != group
+                or not ft.has(group[0])
+            ):
+                return None
+            spec = f"GroupBy({group[0]},Count())"
+        else:
+            if not aggs or plain:
+                return None
+            parts = []
+            for a in aggs:
+                if a["fn"] == "count" and a["arg"] == "*":
+                    parts.append("Count()")
+                elif (
+                    a["fn"] in ("min", "max")
+                    and a["arg"] != "*" and ft.has(a["arg"])
+                ):
+                    parts.append(f"MinMax({a['arg']})")
+                else:
+                    return None
+            spec = ";".join(dict.fromkeys(parts))
+        cq = Query(
+            filter=q["where"] if q["where"] is not None else ast.Include(),
+            hints={"stats": spec},
+        )
+        try:
+            res = self.store.query(ft.name, cq)
+        except Exception:  # noqa: BLE001 - store without stats hints
+            return None
+        stat = getattr(res, "aggregate", {}).get("stats")
+        if stat is None:
+            return None
+        stats = stat.stats if hasattr(stat, "stats") else [stat]
+        if group:
+            gb = stats[0]
+            keys = sorted(gb.groups)  # group_by emits np.unique order
+            cols = {
+                group[0]: np.asarray(keys),
+                aggs[0]["alias"]: np.asarray(
+                    [gb.groups[k].count for k in keys], dtype=np.int64
+                ),
+            }
+        else:
+            by_attr = {
+                getattr(s, "attribute", None): s
+                for s in stats if s.kind == "minmax"
+            }
+            total = next((s.count for s in stats if s.kind == "count"), None)
+            cols = {}
+            for a in aggs:
+                if a["fn"] == "count":
+                    cols[a["alias"]] = np.asarray([int(total)])
+                else:
+                    mm = by_attr[a["arg"]]
+                    v = mm.min if a["fn"] == "min" else mm.max
+                    cols[a["alias"]] = np.asarray([v if v is not None else 0])
+        if q["limit"] is not None:
+            cols = {k: v[: q["limit"]] for k, v in cols.items()}
+        # aggregate results carry no feature type, like _aggregate's frames
+        return SqlResult(cols, None, res.plan)
 
     @staticmethod
     def _aggregate(frame: SpatialFrame, group: List[str], aggs, plain) -> SpatialFrame:

@@ -606,9 +606,11 @@ class TpuDataStore:
         # device aggregation push-downs evaluate STORED columns — a query
         # transform (computed property) changes what the host path would
         # aggregate, so any transform keeps aggregation on the host
-        from geomesa_tpu.index.transforms import QueryTransforms as _QT
-
-        untransformed = _QT.parse(ft, query.properties) is None
+        # (same containment test QueryTransforms.parse uses, without
+        # building and discarding the transform ASTs per query)
+        untransformed = not query.properties or not any(
+            "=" in p for p in query.properties
+        )
 
         # fused device density push-down: grid comes back, features don't
         # (the KryoLazyDensityIterator analog)

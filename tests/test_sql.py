@@ -404,3 +404,96 @@ def test_count_star_fast_path(store, monkeypatch):
         fast = sq.sql(f"SELECT COUNT(*) AS n FROM gdelt{where}")
         slow = sq.sql(f"SELECT COUNT(*) AS n, MIN(n_articles) AS a FROM gdelt{where}")
         assert int(fast.columns["n"][0]) == int(slow.columns["n"][0]), where
+
+
+def test_sql_aggregates_ride_stats_pushdown(monkeypatch):
+    """Global COUNT/MIN/MAX and GROUP BY + COUNT(*) answer from the
+    stats sketches — on a device-decidable WHERE the store's scan_path
+    proves no rows were extracted, and values equal the ordinary
+    extract-then-aggregate path exactly."""
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+
+    monkeypatch.setenv("GEOMESA_STATS_DEVICE", "1")
+    monkeypatch.setenv("GEOMESA_EXACT_DEVICE", "1")
+    rng = np.random.default_rng(21)
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    store.create_schema(parse_spec(
+        "gdelt", "actor1:String:index=true,n_articles:Int,dtg:Date,*geom:Point:srid=4326"
+    ))
+    base = np.datetime64("2026-01-01", "ms").astype(np.int64)
+    actors = ["USA", "FRA", "CHN", "RUS"]
+    with store.writer("gdelt") as w:
+        for i in range(4000):
+            w.write(
+                [actors[i % 4], int(rng.integers(0, 100)),
+                 int(base + rng.integers(0, 20 * 86400_000)),
+                 Point(float(rng.uniform(-180, 180)), float(rng.uniform(-90, 90)))],
+                fid=f"f{i}",
+            )
+    ctx = SQLContext(store)
+    where = "WHERE st_intersects(geom, st_makeBBOX(-50.0, -30.0, 40.0, 35.0))"
+    r = ctx.sql(
+        "SELECT count(*) AS n, min(n_articles) AS lo, max(n_articles) AS hi "
+        f"FROM gdelt {where}"
+    )
+    assert r.plan is not None and r.plan.scan_path == "device-stats"
+    # oracle: the ordinary path with the pushdown declined
+    monkeypatch.setenv("GEOMESA_STATS_DEVICE", "0")
+    w = ctx.sql(
+        "SELECT count(*) AS n, min(n_articles) AS lo, max(n_articles) AS hi "
+        f"FROM gdelt {where}"
+    )
+    for k in ("n", "lo", "hi"):
+        assert r.columns[k][0] == w.columns[k][0], k
+    monkeypatch.setenv("GEOMESA_STATS_DEVICE", "1")
+    g = ctx.sql(f"SELECT actor1, count(*) AS n FROM gdelt {where} GROUP BY actor1")
+    assert g.plan is not None and g.plan.scan_path == "device-stats"
+    monkeypatch.setenv("GEOMESA_STATS_DEVICE", "0")
+    gw = ctx.sql(f"SELECT actor1, count(*) AS n FROM gdelt {where} GROUP BY actor1")
+    np.testing.assert_array_equal(g.columns["actor1"], gw.columns["actor1"])
+    np.testing.assert_array_equal(g.columns["n"], gw.columns["n"])
+    # unsupported shapes (SUM) still answer through the ordinary path
+    monkeypatch.setenv("GEOMESA_STATS_DEVICE", "1")
+    s = ctx.sql(f"SELECT sum(n_articles) AS s FROM gdelt {where}")
+    assert s.plan is None or s.plan.scan_path != "device-stats"
+    assert s.columns["s"][0] > 0
+
+
+def test_sql_min_max_ignore_nulls():
+    """SQL MIN/MAX skip NULLs (NaN floats / None strings) instead of
+    propagating them — matching the null-excluding sketch planes."""
+    s = TpuDataStore()
+    s.create_schema(parse_spec("nn", "tag:String,v:Double,*geom:Point:srid=4326"))
+    with s.writer("nn") as w:
+        w.write(["a", 3.0, Point(0, 0)], fid="a")
+        w.write([None, None, Point(1, 1)], fid="b")
+        w.write(["c", 1.5, Point(2, 2)], fid="c")
+    ctx = SQLContext(s)
+    r = ctx.sql("SELECT min(v) AS lo, max(v) AS hi, min(tag) AS t FROM nn")
+    assert r.columns["lo"][0] == 1.5
+    assert r.columns["hi"][0] == 3.0
+    assert r.columns["t"][0] == "a"
+
+
+def test_group_by_skips_null_keys(monkeypatch):
+    """Null group keys are skipped on BOTH paths (the framework grouping
+    convention, matching GroupByStat.observe_grouped and the reference
+    skipping features whose grouping attribute is missing)."""
+    s = TpuDataStore()
+    s.create_schema(parse_spec("gk", "tag:String,v:Double,*geom:Point:srid=4326"))
+    with s.writer("gk") as w:
+        w.write(["a", 1.0, Point(0, 0)], fid="1")
+        w.write([None, np.nan, Point(1, 1)], fid="2")
+        w.write(["c", 7.0, Point(2, 2)], fid="3")
+        w.write(["a", 2.0, Point(3, 3)], fid="4")
+    ctx = SQLContext(s)
+    for env in ("0", "1"):
+        monkeypatch.setenv("GEOMESA_STATS_DEVICE", env)
+        r = ctx.sql("SELECT tag, count(*) AS n FROM gk GROUP BY tag")
+        assert list(r.columns["tag"]) == ["a", "c"]
+        assert list(r.columns["n"]) == [2, 1]
+        # the projected-column shape: decoded strings carry nulls as ""
+        # with a __null companion, which group_by must honor
+        r2 = ctx.sql("SELECT tag, count(*) AS n, max(v) AS m FROM gk GROUP BY tag")
+        assert list(r2.columns["tag"]) == ["a", "c"]
+        assert list(r2.columns["m"]) == [2.0, 7.0]
