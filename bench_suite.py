@@ -288,15 +288,20 @@ def bench_attr_bbox(n, reps):
             cqls.append(f"goldstein > {lo} AND goldstein <= {hi} AND {bq}")
             wants.append(set(fids[(gold > lo) & (gold <= hi) & in_box]))
     # device stats push-down (per-code histograms -> exact sketches, no
-    # row extraction): parity checked against direct numpy aggregation
+    # row extraction): parity checked against direct numpy aggregation.
+    # FORCED like the other device_path_* fields — auto rightly declines
+    # over a high-latency tunnel (the cost gate), which auto_stats_path
+    # records; the forced run measures the device edition itself
     stats_fields = {}
     try:
         from geomesa_tpu.index.planner import Query as _Q
 
         bq0 = f"bbox(geom, {box[0]}, {box[1]}, {box[2]}, {box[3]})"
         sq = _Q.cql(bq0, hints={"stats": "Count();MinMax(goldstein);TopK(actor1)"})
-        ds.query("gdelt", sq)  # warm (jit per u_pad bucket)
-        st_s, st_res = _timeit(lambda: ds.query("gdelt", sq), max(3, reps // 4))
+        auto_path = ds.query("gdelt", sq).plan.scan_path
+        with _env_override("GEOMESA_STATS_DEVICE", "1"):
+            ds.query("gdelt", sq)  # warm (jit per u_pad bucket)
+            st_s, st_res = _timeit(lambda: ds.query("gdelt", sq), max(3, reps // 4))
         in_box = (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
         seq = st_res.aggregate["stats"].stats
         uniq, cnt = np.unique(actors[in_box], return_counts=True)
@@ -310,6 +315,7 @@ def bench_attr_bbox(n, reps):
             "device_stats_ms": round(st_s * 1000, 3),
             "device_stats_path": st_res.plan.scan_path,
             "device_stats_parity": bool(stats_parity),
+            "auto_stats_path": auto_path,
         }
     except Exception as e:  # noqa: BLE001 - diagnostic field, not a config
         stats_fields = {"device_stats_error": f"{type(e).__name__}: {e}"[:160]}
