@@ -20,8 +20,16 @@ import numpy as np
 from geomesa_tpu.geom.base import Point
 from geomesa_tpu.schema.featuretype import parse_spec
 from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.store.integrity import fsync_enabled
+from geomesa_tpu.utils import faults
+from geomesa_tpu.utils.retry import RetryPolicy
 
 _SPEC = "filename:String,meta:String,dtg:Date,*geom:Point:srid=4326"
+
+# blob bytes ride the same fault points and retry treatment as store
+# blocks: transient I/O failures retry, then surface
+_BLOB_RETRY = RetryPolicy(name="blobstore", max_attempts=4, base_s=0.005,
+                          cap_s=0.1)
 
 
 class FileHandler:
@@ -201,8 +209,7 @@ class BlobStore:
             raise ValueError(f"no location for blob {filename!r} (no handler matched)")
         blob_id = self._blob_id(data)
         if self.root:
-            with open(os.path.join(self.root, blob_id), "wb") as fh:
-                fh.write(data)
+            _BLOB_RETRY.call(self._write_blob, os.path.join(self.root, blob_id), data)
         else:
             self._mem[blob_id] = data
         with self.store.writer("blobs") as w:
@@ -212,12 +219,26 @@ class BlobStore:
             )
         return blob_id
 
+    @staticmethod
+    def _write_blob(path: str, data: bytes) -> None:
+        faults.fault_point("fs.block_write")
+        with open(path, "wb") as fh:
+            fh.write(data)
+            if fsync_enabled():
+                fh.flush()
+                os.fsync(fh.fileno())
+
+    @staticmethod
+    def _read_blob(path: str) -> bytes:
+        faults.fault_point("fs.block_read")
+        with open(path, "rb") as fh:
+            return fh.read()
+
     def get(self, blob_id: str) -> Optional[bytes]:
         if self.root:
             path = os.path.join(self.root, blob_id)
             if os.path.exists(path):
-                with open(path, "rb") as fh:
-                    return fh.read()
+                return _BLOB_RETRY.call(self._read_blob, path)
             return None
         return self._mem.get(blob_id)
 

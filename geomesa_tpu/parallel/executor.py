@@ -60,6 +60,7 @@ from geomesa_tpu.parallel.mesh import (
     shard_map_fn,
 )
 from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
+from geomesa_tpu.utils import faults
 
 # initial hit-run capacity: 4096 runs * 8B = 32 KiB per segment transfer
 HIT_CAPACITY0 = 4096
@@ -811,6 +812,11 @@ class _ShardBitmapBatch:
 def _np_local(arr) -> np.ndarray:
     """Host view of a device array that may span MULTIPLE PROCESSES.
 
+    Also the ``device.fetch`` fault point: every scan-resolution D2H
+    transfer funnels through here, so an injected fetch fault surfaces
+    exactly where a dead tunnel mid-query would — and the datastore's
+    degradation path re-answers from the host scan.
+
     On a jax.distributed (DCN) mesh the per-shard outputs are global
     arrays whose remote shards this process cannot read — np.asarray
     raises. Read the ADDRESSABLE shards into a zero-filled global-shaped
@@ -819,6 +825,7 @@ def _np_local(arr) -> np.ndarray:
     partial results the reference's Spark partitions return
     (GeoMesaSpark.scala:38-50), with the client (caller) unioning
     processes. Single-process arrays take the plain asarray path."""
+    faults.fault_point("device.fetch")
     if getattr(arr, "is_fully_addressable", True):
         return np.asarray(arr)
     out = np.zeros(arr.shape, dtype=arr.dtype)
@@ -930,7 +937,7 @@ class _BitmapBatch:
     def _fetch(self):
         if self._np is None:
             t1 = _trace_fetch_begin(self.trace, self.hdr, self.bits)
-            self._np = (np.asarray(self.hdr), np.asarray(self.bits))
+            self._np = (_np_local(self.hdr), _np_local(self.bits))
             _trace_fetch_end(self.trace, t1)
             self.hdr = self.bits = None
             if self.seg is not None:
@@ -1026,7 +1033,7 @@ class _PackedBatch:
     def _fetch(self):
         if self._np is None:
             t1 = _trace_fetch_begin(self.trace, self.buf)
-            flat = np.asarray(self.buf)
+            flat = _np_local(self.buf)
             _trace_fetch_end(self.trace, t1)
             self.trace = None  # escalation refetch must not re-append
             self.buf = None
@@ -1116,7 +1123,7 @@ class _PendingPackedHits:
             if self._packed is not None and nruns > max(
                 1, seg.n_padded // DENSE_BITMAP_FACTOR
             ):
-                mask = np.unpackbits(np.asarray(self._packed()))[: seg.n].astype(bool)
+                mask = np.unpackbits(_np_local(self._packed()))[: seg.n].astype(bool)
                 return np.flatnonzero(mask)
             while rcap < nruns:
                 rcap *= 2
@@ -1143,7 +1150,7 @@ class _BatchRows:
     def row(self, i: int) -> np.ndarray:
         if self._np is None:
             t1 = _trace_fetch_begin(self.trace, self.buf)
-            self._np = np.asarray(self.buf)
+            self._np = _np_local(self.buf)
             _trace_fetch_end(self.trace, t1)
             self.buf = None  # release the device allocation immediately
         return self._np[i]
@@ -3121,7 +3128,7 @@ class _PendingHits:
 
     def _resolve(self) -> np.ndarray:
         seg = self.seg
-        buf = np.asarray(self.buf)
+        buf = _np_local(self.buf)
         cnt, nruns = int(buf[0]), int(buf[1])
         seg.remember_rcap(nruns)
         if cnt == 0:
@@ -3132,11 +3139,11 @@ class _PendingHits:
                 1, seg.n_padded // DENSE_BITMAP_FACTOR
             ):
                 # fragmented + dense: the bitmap is the smaller transfer
-                mask = np.unpackbits(np.asarray(self._packed()))[: seg.n].astype(bool)
+                mask = np.unpackbits(_np_local(self._packed()))[: seg.n].astype(bool)
                 return np.flatnonzero(mask)
             while rcap < nruns:
                 rcap *= 2
-            buf = np.asarray(self._refetch(rcap))
+            buf = _np_local(self._refetch(rcap))
         starts = buf[2 : 2 + nruns].astype(np.int64)
         lens = buf[2 + rcap : 2 + rcap + nruns].astype(np.int64)
         return _expand_runs(starts, lens)
@@ -3273,7 +3280,7 @@ class _PendingXZHits:
 
     def _resolve(self):
         seg = self.seg
-        buf = np.asarray(self.buf)
+        buf = _np_local(self.buf)
         rcap = self.rcap
         half = 2 + 2 * rcap
         hit_b, dec_b = buf[:half], buf[half:]
@@ -3286,14 +3293,14 @@ class _PendingXZHits:
             if self._packed is not None and nruns > max(
                 1, seg.n_padded // DENSE_BITMAP_FACTOR
             ):
-                both = np.asarray(self._packed())
+                both = _np_local(self._packed())
                 h = len(both) // 2
                 hm = np.unpackbits(both[:h])[: seg.n].astype(bool)
                 dm = np.unpackbits(both[h:])[: seg.n].astype(bool)
                 return np.flatnonzero(hm), np.flatnonzero(dm)
             while rcap < nruns:
                 rcap *= 2
-            buf = np.asarray(self._refetch(rcap))
+            buf = _np_local(self._refetch(rcap))
             half = 2 + 2 * rcap
             hit_b, dec_b = buf[:half], buf[half:]
         return self._one(hit_b, rcap), self._one(dec_b, rcap)
@@ -3720,7 +3727,7 @@ class _DeviceSeekXZScan:
 
     def __iter__(self):
         for seg, starts, lens, total, buf in self.pending:
-            raw = np.asarray(buf)
+            raw = _np_local(buf)
             half = len(raw) // 2
             hit = np.unpackbits(raw[:half])[:total].astype(bool)
             decided = np.unpackbits(raw[half:])[:total].astype(bool)
@@ -3753,7 +3760,7 @@ class _DeviceSeekScan:
 
     def __iter__(self):
         for seg, starts, lens, total, buf in self.pending:
-            bits = np.unpackbits(np.asarray(buf))[:total].astype(bool)
+            bits = np.unpackbits(_np_local(buf))[:total].astype(bool)
             j = np.flatnonzero(bits)
             if not len(j):
                 continue
@@ -4284,8 +4291,46 @@ class TpuScanExecutor:
 
     def scan_candidates(self, table: IndexTable, plan: QueryPlan):
         """Device candidate scan; None -> caller falls back to host ranges.
-        Returns the iterable _PendingScan (carrying .exact) directly."""
-        return self.dispatch_candidates(table, plan)
+        Returns the iterable _PendingScan (carrying .exact) directly.
+
+        Graceful degradation: ANY dispatch-side failure (mirror upload,
+        descriptor placement, kernel launch — a dead tunnel, OOM, or an
+        injected fault) degrades this query to the host scan path by
+        returning None, with identical results (the host path evaluates
+        the full filter). The table's mirror is marked unhealthy and
+        evicted so the next query triggers a rebuild; fetch-side failures
+        during resolution are handled the same way by the datastore's
+        scan loop (store/datastore.py _scan_parts)."""
+        try:
+            return self.dispatch_candidates(table, plan)
+        except Exception as e:  # noqa: BLE001 - device/tunnel failure
+            self.degrade(table, e)
+            return None
+
+    def degrade(self, table: Optional[IndexTable], exc: BaseException) -> None:
+        """Record a device->host degradation: evict the failed table's
+        device mirror (None evicts every mirror — a batched dispatch
+        failed mid-stream) so the next query that wants it rebuilds from
+        the host table, and count the event in
+        ``utils.audit.robustness_metrics`` (``degrade.*``)."""
+        import sys
+
+        from geomesa_tpu.utils.audit import robustness_metrics
+
+        evicted = 0
+        if table is None:
+            evicted = len(self._cache)
+            self._cache.clear()
+        elif self._cache.pop(id(table), None) is not None:
+            evicted = 1
+        m = robustness_metrics()
+        m.inc("degrade.device_to_host")
+        if evicted:
+            m.inc("degrade.mirror_rebuilds", evicted)
+        sys.stderr.write(
+            f"[executor] device scan failed ({type(exc).__name__}: {exc}); "
+            "host path answers; mirror marked for rebuild\n"
+        )
 
     # one batched execution answers at most this many queries; longer
     # streams chunk (bounds the [q, 2+2*rcap] transfer and compile shapes)

@@ -14,6 +14,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from geomesa_tpu.utils import faults
+
 DATA_AXIS = "shards"
 
 
@@ -127,12 +129,19 @@ def pad_to_multiple(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
 
 
 def shard_array(mesh: Mesh, arr: np.ndarray, axis: str = DATA_AXIS):
-    """Place a host array on the mesh, sharded along axis 0."""
+    """Place a host array on the mesh, sharded along axis 0.
+
+    ``device.dispatch`` fault point: every H2D placement (mirror uploads
+    and query descriptors) passes here or through ``replicate``, so an
+    injected dispatch fault exercises the executor's device->host
+    degradation exactly where a dead tunnel would surface."""
+    faults.fault_point("device.dispatch")
     return jax.device_put(arr, NamedSharding(mesh, P(axis)))
 
 
 def replicate(mesh: Mesh, arr: np.ndarray):
     """Place a host array on the mesh fully replicated (query descriptors)."""
+    faults.fault_point("device.dispatch")
     return jax.device_put(arr, NamedSharding(mesh, P()))
 
 

@@ -512,24 +512,33 @@ class TpuDataStore:
         dispatch_many = getattr(self.executor, "dispatch_many", None)
         pending: Dict[int, object] = {}
         if dispatch is not None:
-            items = []
-            for q, plan in zip(qs, plans):
-                if "density" in q.hints:
-                    continue  # fused density path dispatches its own compute
-                arms = plan.union if plan.union is not None else [plan]
-                for arm in arms:
-                    if arm.is_empty or id(arm) in pending:
-                        continue
-                    table = self._tables[name][arm.index.name]
-                    if dispatch_many is not None:
-                        pending[id(arm)] = None  # placeholder, filled below
-                        items.append((table, arm))
-                    else:
-                        pending[id(arm)] = dispatch(table, arm)
-            if dispatch_many is not None and items:
-                # exact-shape plans on the same table fuse into one batched
-                # device execution; the rest dispatch as before
-                pending.update(dispatch_many(items))
+            try:
+                items = []
+                for q, plan in zip(qs, plans):
+                    if "density" in q.hints:
+                        continue  # fused density path dispatches its own compute
+                    arms = plan.union if plan.union is not None else [plan]
+                    for arm in arms:
+                        if arm.is_empty or id(arm) in pending:
+                            continue
+                        table = self._tables[name][arm.index.name]
+                        if dispatch_many is not None:
+                            pending[id(arm)] = None  # placeholder, filled below
+                            items.append((table, arm))
+                        else:
+                            pending[id(arm)] = dispatch(table, arm)
+                if dispatch_many is not None and items:
+                    # exact-shape plans on the same table fuse into one batched
+                    # device execution; the rest dispatch as before
+                    pending.update(dispatch_many(items))
+            except Exception as e:  # noqa: BLE001 - device/tunnel failure
+                # batched dispatch died mid-stream: un-dispatched plans
+                # keep their None placeholders, which _scan_parts already
+                # resolves to the host scan — the whole batch degrades
+                # rather than the batch query dying
+                degrade = getattr(self.executor, "degrade", None)
+                if degrade is not None:
+                    degrade(None, e)
         results = []
         for q, plan, dt in zip(qs, plans, plan_s):
             # per-query clock: the timeout budget and audited scan time
@@ -740,11 +749,8 @@ class TpuDataStore:
         only the columns the post-filter/age-off read, and the result's
         attribute gathers are deferred to LazyColumns (the
         KryoBufferSimpleFeature lazy-read analog)."""
-        import time as _time
-
         tables = self._tables[name]
         table = tables[plan.index.name]
-        parts: List[tuple] = []
         if pending is not None and id(plan) in pending:
             scan = pending[id(plan)]  # pre-dispatched (query_many pipeline)
         else:
@@ -754,6 +760,40 @@ class TpuDataStore:
         # timings; WHICH path answered is the extra operators need when
         # cost gates flip between host and device)
         plan.scan_path = _scan_label(scan)
+        try:
+            return self._consume_scan(
+                ft, query, plan, table, scan, device_scan, t_scan_start
+            )
+        except Exception as e:
+            from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
+
+            if not device_scan or isinstance(e, QueryTimeout):
+                raise
+            # an executor scan died mid-resolution (device fetch / native
+            # seek failure): degrade THIS query to the host table scan —
+            # identical results, since the host path evaluates the full
+            # filter — and let the executor rebuild its mirror. The
+            # timeout clock keeps running across the rerun.
+            degrade = getattr(self.executor, "degrade", None)
+            if degrade is not None:
+                degrade(table, e)
+            else:
+                robustness_metrics().inc("degrade.device_to_host")
+            plan.scan_path = "host-table-degraded"
+            return self._consume_scan(
+                ft, query, plan, table, None, False, t_scan_start
+            )
+
+    def _consume_scan(
+        self, ft, query: Query, plan: QueryPlan, table, scan, device_scan,
+        t_scan_start,
+    ) -> List[tuple]:
+        """Resolve one (possibly device-pending) scan into parts; the
+        filtering tail of _scan_parts, split out so a device failure can
+        re-enter with the host scan."""
+        import time as _time
+
+        parts: List[tuple] = []
         if scan is None:
             if plan.ranges:
                 scan = table.scan(plan.ranges)

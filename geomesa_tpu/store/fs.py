@@ -36,10 +36,31 @@ import numpy as np
 from geomesa_tpu.schema.featuretype import FeatureType
 from geomesa_tpu.store.blocks import Columns
 from geomesa_tpu.store.datastore import ScanExecutor, TpuDataStore
+from geomesa_tpu.store.integrity import (
+    CorruptFileError,
+    append_crc_footer,
+    fsync_replace,
+    quarantine,
+    verify_file_crc,
+)
 from geomesa_tpu.store.metadata import FileMetadata
 from geomesa_tpu.store.partitions import PartitionScheme, from_config, parse_scheme
+from geomesa_tpu.utils import faults
+from geomesa_tpu.utils.retry import RetryPolicy
 
 _EXTS = (".npz", ".parquet")
+
+# transient I/O failures (real EIO or injected OSError) get bounded
+# retries; CorruptFileError (not an OSError) and FileNotFoundError (a
+# vanished block is deterministic) are never retried — corruption is
+# quarantined instead
+_BLOCK_READ_RETRY = RetryPolicy(
+    name="fs.block_read", max_attempts=4, base_s=0.005, cap_s=0.1,
+    retryable=lambda e: isinstance(e, OSError)
+    and not isinstance(e, FileNotFoundError),
+)
+_BLOCK_WRITE_RETRY = RetryPolicy(name="fs.block_write", max_attempts=4,
+                                 base_s=0.005, cap_s=0.1)
 
 
 class FsDataStore(TpuDataStore):
@@ -150,7 +171,18 @@ class FsDataStore(TpuDataStore):
                     # leave it unloaded so a later, broader query reads it
                     loaded.discard(rel)
                     continue
-                cols = _read_block(path, ft)
+                try:
+                    cols = _read_block(path, ft)
+                except CorruptFileError:
+                    # torn/corrupt block: move it aside and keep serving
+                    # the rest of the store (the quarantine counter in
+                    # robustness_metrics records the loss)
+                    quarantine(path)
+                    loaded.discard(rel)
+                    self._files[name] = [
+                        f for f in self._files[name] if f != rel
+                    ]
+                    continue
                 if "__vis__" in cols and self.metadata.read(name, "geomesa.vis") != "true":
                     # legacy store: learn visibility presence during replay
                     self.metadata.insert(name, "geomesa.vis", "true")
@@ -335,10 +367,21 @@ def _geom_attrs(ft: FeatureType) -> Set[str]:
 
 
 def _write_block(path: str, ft: FeatureType, columns: Columns, fmt: str) -> None:
+    """Persist one block durably: tmp write + CRC footer (npz; parquet's
+    own footer already detects truncation) + fsync + rename, with
+    transient write failures retried (the whole attempt re-runs)."""
+    _BLOCK_WRITE_RETRY.call(_write_block_once, path, ft, columns, fmt)
+
+
+def _write_block_once(path: str, ft: FeatureType, columns: Columns, fmt: str) -> None:
+    faults.fault_point("fs.block_write")
     tmp = os.path.join(os.path.dirname(path), "." + os.path.basename(path) + ".tmp")
     if fmt == "npz":
         np.savez(tmp, **columns)  # savez appends .npz
-        os.replace(tmp + ".npz", path)
+        tmp += ".npz"
+        append_crc_footer(tmp)
+        faults.maybe_tear("fs.block_write", tmp)
+        fsync_replace(tmp, path)
         return
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -361,18 +404,41 @@ def _write_block(path: str, ft: FeatureType, columns: Columns, fmt: str) -> None
     table = pa.Table.from_arrays(arrays, names=names)
     table = table.replace_schema_metadata({"geomesa.objcols": json.dumps(objcols)})
     pq.write_table(table, tmp)
-    os.replace(tmp, path)
+    faults.maybe_tear("fs.block_write", tmp)
+    fsync_replace(tmp, path)
 
 
 def _read_block(path: str, ft: FeatureType) -> Columns:
+    """Deserialize one block. Transient read failures (OSError) retry;
+    corruption — CRC mismatch, or content the codec cannot decode —
+    raises CorruptFileError for the caller to quarantine."""
+    return _BLOCK_READ_RETRY.call(_read_block_once, path, ft)
+
+
+def _read_block_once(path: str, ft: FeatureType) -> Columns:
+    faults.fault_point("fs.block_read")
     if path.endswith(".npz"):
-        with np.load(path, allow_pickle=True) as data:
-            return {k: data[k] for k in data.files}
+        # streaming CRC pass, then np.load straight off the file (zipfile
+        # tolerates the trailing footer) — the block is never duplicated
+        # whole in memory
+        verify_file_crc(path)  # CorruptFileError on mismatch
+        try:
+            with np.load(path, allow_pickle=True) as data:
+                return {k: data[k] for k in data.files}
+        except FileNotFoundError:
+            raise
+        except Exception as e:  # noqa: BLE001 - zip/pickle decode failures
+            raise CorruptFileError(f"undecodable npz block {path}: {e}") from e
     import pyarrow.parquet as pq
 
     from geomesa_tpu.geom.wkt import parse_wkt
 
-    table = pq.read_table(path)
+    try:
+        table = pq.read_table(path)
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # noqa: BLE001 - arrow raises its own hierarchy
+        raise CorruptFileError(f"undecodable parquet block {path}: {e}") from e
     meta = table.schema.metadata or {}
     objcols = set(json.loads(meta.get(b"geomesa.objcols", b"[]")))
     geoms = _geom_attrs(ft)

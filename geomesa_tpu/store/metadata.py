@@ -13,6 +13,16 @@ import os
 import threading
 from typing import Dict, List, Optional
 
+from geomesa_tpu.store.integrity import (
+    CorruptFileError,
+    append_crc_footer,
+    fsync_replace,
+    quarantine,
+    read_verified,
+)
+from geomesa_tpu.utils import faults
+from geomesa_tpu.utils.retry import RetryPolicy
+
 
 class Metadata:
     """String KV scoped by (type_name, key)."""
@@ -61,21 +71,43 @@ class InMemoryMetadata(Metadata):
 
 class FileMetadata(Metadata):
     """JSON-file backed metadata (single-writer; the TPU design keeps schema
-    mutation single-controller, SURVEY.md section 5 race-detection notes)."""
+    mutation single-controller, SURVEY.md section 5 race-detection notes).
+
+    Durability: each flush lands via write + CRC32 footer + fsync +
+    rename (store/integrity.py), so a crash mid-save can never publish a
+    torn registry. A registry that IS torn or corrupt on open (legacy
+    stores, disk faults) is quarantined aside — the store opens empty
+    instead of refusing to start; re-creating the schemas makes the
+    orphaned blocks replayable again on the next open."""
+
+    # a corrupt registry must not be hammered; transient I/O errors and
+    # injected faults (OSError) get a few fast attempts
+    _SAVE_RETRY = RetryPolicy(
+        name="metadata.save", max_attempts=4, base_s=0.005, cap_s=0.1
+    )
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
         self._data: Dict[str, Dict[str, str]] = {}
         if os.path.exists(path):
-            with open(path) as fh:
-                self._data = json.load(fh)
+            try:
+                self._data = json.loads(read_verified(path).decode())
+            except (CorruptFileError, ValueError, UnicodeDecodeError):
+                quarantine(path)
+                self._data = {}
 
     def _flush(self):
-        tmp = self.path + ".tmp"
+        self._SAVE_RETRY.call(self._flush_once)
+
+    def _flush_once(self):
+        faults.fault_point("metadata.save")
+        tmp = f"{self.path}.{os.getpid()}.tmp"
         with open(tmp, "w") as fh:
             json.dump(self._data, fh, indent=1, sort_keys=True)
-        os.replace(tmp, self.path)
+        append_crc_footer(tmp)
+        faults.maybe_tear("metadata.save", tmp)
+        fsync_replace(tmp, self.path)
 
     def read(self, type_name, key):
         with self._lock:

@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Tuple
 
+from geomesa_tpu.utils import faults
+
 
 class InProcessBroker:
     """topic -> partition -> append-only list of bytes; thread-safe."""
@@ -44,6 +46,7 @@ class InProcessBroker:
         Returns [(partition, offset, payload)]; caller advances its
         offsets. ``partitions`` restricts to an assignment subset.
         """
+        faults.fault_point("broker.poll")
         out: List[Tuple[int, int, bytes]] = []
         logs = self._topic(topic)
         with self._lock:

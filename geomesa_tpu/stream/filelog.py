@@ -27,6 +27,8 @@ import os
 import struct
 from typing import Dict, List, Optional, Tuple
 
+from geomesa_tpu.utils import faults
+
 _LEN = struct.Struct("<I")
 
 
@@ -131,6 +133,7 @@ class FileLogBroker:
         ``partitions`` restricts the fetch to an assignment subset (the
         consumer-group partition-assignment contract: cooperating
         consumers split a topic's partitions disjointly)."""
+        faults.fault_point("broker.poll")
         out: List[Tuple[int, int, bytes]] = []
         for p in partitions if partitions is not None else range(self.partitions):
             want = offsets.get(p, 0)

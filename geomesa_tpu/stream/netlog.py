@@ -36,9 +36,16 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from geomesa_tpu.stream.filelog import FileLogBroker, FileOffsetManager
+from geomesa_tpu.utils import faults
+from geomesa_tpu.utils.retry import RetryPolicy
 
 _LEN = struct.Struct("<I")
 _MAX_MSG = 64 * 1024 * 1024  # sanity bound on a single frame
+
+# ops whose server-side effect is the same applied once or twice: reads
+# (poll/meta/end_offsets/offsets) and commit (a full replace of the
+# group's offsets). ``send`` appends — retrying it can duplicate.
+_IDEMPOTENT_OPS = frozenset({"poll", "meta", "end_offsets", "offsets", "commit"})
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -219,12 +226,32 @@ class LogServer:
 class RemoteLogBroker:
     """FileLogBroker contract over a LogServer socket (send / poll /
     end_offsets), so the stream and lambda tiers run unchanged against a
-    remote broker. Reconnects on a broken connection; the send ack makes
-    retried appends at-least-once (the reference's producer default)."""
+    remote broker.
 
-    def __init__(self, host: str, port: int, partitions: Optional[int] = None):
+    Failure semantics: idempotent ops (poll / meta / end_offsets /
+    offsets / commit) retry through a RetryPolicy, reconnecting on a
+    broken connection. ``send`` does NOT retry by default — a connection
+    that dies after the request ships may have appended the record before
+    the ack was lost, so a blind re-send duplicates it. Producers whose
+    consumers are duplicate-tolerant (GeoMessage streams apply by fid, so
+    re-delivery is an idempotent upsert) opt in with
+    ``at_least_once=True`` — the reference's producer default — and sends
+    then retry like everything else."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        partitions: Optional[int] = None,
+        at_least_once: bool = False,
+        retry: Optional[RetryPolicy] = None,
+    ):
         self.host = host
         self.port = port
+        self.at_least_once = bool(at_least_once)
+        self._retry = retry if retry is not None else RetryPolicy(
+            name="netlog", max_attempts=4, base_s=0.02, cap_s=0.5,
+        )
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self.partitions = (
@@ -240,28 +267,40 @@ class RemoteLogBroker:
             self._sock = s
         return self._sock
 
-    def _rpc(self, head: dict, payload: Optional[bytes] = None,
-             retried: bool = False):
+    def _attempt(self, head: dict, payload: Optional[bytes]):
+        """One full request/response exchange; any transport failure
+        drops the cached socket so the next attempt redials."""
+        try:
+            sock = self._connect()
+            faults.fault_point("netlog.rpc")
+            _send_msg(sock, json.dumps(head).encode())
+            if payload is not None:
+                _send_msg(sock, payload)
+            resp = json.loads(_recv_msg(sock).decode())
+            if resp.get("ok") != 1:
+                raise RuntimeError(
+                    f"broker error: {resp.get('error', 'unknown')}"
+                )
+            if head["op"] == "poll":
+                blob = _recv_msg(sock)
+                return resp, blob
+            return resp, b""
+        except OSError:
+            self.close()
+            raise
+
+    def _rpc(self, head: dict, payload: Optional[bytes] = None):
         with self._lock:
-            try:
-                sock = self._connect()
-                _send_msg(sock, json.dumps(head).encode())
-                if payload is not None:
-                    _send_msg(sock, payload)
-                resp = json.loads(_recv_msg(sock).decode())
-                if resp.get("ok") != 1:
-                    raise RuntimeError(
-                        f"broker error: {resp.get('error', 'unknown')}"
-                    )
-                if head["op"] == "poll":
-                    blob = _recv_msg(sock)
-                    return resp, blob
-                return resp, b""
-            except (OSError, ConnectionError):
-                self.close()
-                if retried:
-                    raise
-        return self._rpc(head, payload, retried=True)
+            if head.get("op") in _IDEMPOTENT_OPS or self.at_least_once:
+                return self._retry.call(self._attempt, head, payload)
+            # at-most-once: an attempt that fails AFTER the request ships
+            # may already be applied server-side, so it surfaces to the
+            # caller (or opt in with at_least_once=True). Establishing the
+            # connection is unambiguously before any apply, though — dial
+            # failures always retry, so a producer survives a server
+            # restart between sends.
+            self._retry.call(self._connect)
+            return self._attempt(head, payload)
 
     def close(self) -> None:
         if self._sock is not None:

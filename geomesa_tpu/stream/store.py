@@ -29,6 +29,7 @@ from geomesa_tpu.stream.messages import (
     Delete,
     GeoMessageSerializer,
 )
+from geomesa_tpu.utils.retry import RetryPolicy
 
 
 def _now_ms() -> int:
@@ -127,6 +128,11 @@ class StreamDataStore:
         self._caches: Dict[str, FeatureCache] = {}
         self._offsets: Dict[str, Dict[int, int]] = {}
         self._listeners: Dict[str, List[Callable]] = {}
+        # a consumer outlives transient broker hiccups (poll is
+        # idempotent: offsets only advance after records are applied)
+        self._poll_retry = RetryPolicy(
+            name="broker.poll", max_attempts=4, base_s=0.01, cap_s=0.2
+        )
 
     # -- schema --------------------------------------------------------------
 
@@ -178,9 +184,18 @@ class StreamDataStore:
         ser = self._serializers[name]
         cache = self._caches[name]
         offsets = self._offsets[name]
-        records = self.broker.poll(
-            name, offsets, partitions=self.assigned_partitions
-        )
+        if isinstance(getattr(self.broker, "_retry", None), RetryPolicy):
+            # RemoteLogBroker already retries its RPCs internally —
+            # stacking a second policy would multiply attempts and
+            # double-count retries in the robustness metrics
+            records = self.broker.poll(
+                name, offsets, partitions=self.assigned_partitions
+            )
+        else:
+            records = self._poll_retry.call(
+                self.broker.poll, name, offsets,
+                partitions=self.assigned_partitions,
+            )
         for p, off, payload in records:
             msg = ser.deserialize(payload)
             if isinstance(msg, CreateOrUpdate):

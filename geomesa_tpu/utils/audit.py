@@ -121,6 +121,32 @@ class MetricsRegistry:
             return out
 
 
+_ROBUSTNESS: Optional[MetricsRegistry] = None
+_ROBUSTNESS_LOCK = threading.Lock()
+
+
+def robustness_metrics() -> MetricsRegistry:
+    """Process-wide counters for the fault/retry/degradation layer:
+
+        fault.<point>.<kind>       injected faults fired (utils.faults)
+        retry.<name>.retries       re-attempts a RetryPolicy absorbed
+        retry.<name>.giveup        retries exhausted (error surfaced)
+        quarantine.files           corrupt files renamed aside
+        degrade.device_to_host     queries degraded to the host scan path
+        degrade.mirror_rebuilds    device mirrors evicted for rebuild
+
+    One shared registry rather than per-store: the layers that fault
+    (block readers, the RPC client, the device executor) are constructed
+    below the store facade and shared across stores. A store's own
+    ``metrics`` registry still carries its query timings; chaos soaks and
+    operators read this one for failure-path behavior."""
+    global _ROBUSTNESS
+    with _ROBUSTNESS_LOCK:
+        if _ROBUSTNESS is None:
+            _ROBUSTNESS = MetricsRegistry()
+        return _ROBUSTNESS
+
+
 def _flatten(snapshot):
     """[(dotted_name, value)] — THE snapshot traversal every reporter
     shares (timer dicts become 'name.leaf' rows, sorted)."""
@@ -235,11 +261,18 @@ class GraphiteReporter(Reporter):
 
     def __init__(self, registry, host: str, port: int = 2003,
                  prefix: str = "geomesa", interval_s: float = 60.0):
+        from geomesa_tpu.utils.retry import RetryPolicy
+
         super().__init__(registry, interval_s)
         self.host = host
         self.port = port
         self.prefix = prefix.rstrip(".")
         self._sock: Any = None
+        # one reconnect per emission, through the shared policy
+        self._retry = RetryPolicy(
+            name="graphite", max_attempts=2, base_s=0.05, cap_s=0.1,
+            retryable=(OSError,),
+        )
 
     def _lines(self, snapshot: Dict[str, Any], now_s: int):
         for name, v in _flatten(snapshot):
@@ -270,13 +303,18 @@ class GraphiteReporter(Reporter):
         payload = "".join(self._lines(snapshot, int(time.time()))).encode()
         if not payload:
             return
-        for attempt in (0, 1):  # one reconnect per emission
+
+        def _send():
             try:
                 self._connect().sendall(payload)
-                return
             except OSError:
-                self.close()
-        # carbon unreachable: drop this snapshot (next interval retries)
+                self.close()  # next attempt/emission redials
+                raise
+
+        try:
+            self._retry.call(_send)
+        except OSError:
+            pass  # carbon unreachable: drop this snapshot (next interval retries)
 
 
 class GangliaReporter(Reporter):

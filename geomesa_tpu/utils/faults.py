@@ -1,0 +1,235 @@
+"""Deterministic fault injection at I/O and device boundaries.
+
+The production posture (ROADMAP.md) needs the failure paths exercised as
+routinely as the happy paths: the reference inherits retry/recovery from
+Accumulo and Kafka, and this rebuild replaced those substrates, so every
+recovery behavior here must be proved by injection rather than assumed.
+Named fault points instrument each place the system crosses a process,
+disk, or device boundary:
+
+    fs.block_read      columnar block deserialization (store/fs.py)
+    fs.block_write     columnar block persistence (store/fs.py, blobstore)
+    metadata.save      schema-registry flush (store/metadata.py)
+    netlog.rpc         RemoteLogBroker request/response (stream/netlog.py)
+    broker.poll        log-broker record fetch (stream/filelog.py, broker.py)
+    device.dispatch    host->device placement (parallel/mesh.py)
+    device.fetch       device->host result resolution (parallel/executor.py)
+
+Kinds:
+
+    error      raise InjectedFault (an OSError: retry policies treat it
+               as transient, exactly like a real EIO)
+    drop       raise InjectedDrop (a ConnectionError: a peer hanging up
+               mid-exchange)
+    latency    sleep a few milliseconds before proceeding
+    torn       truncate a just-written file before it is published
+               (``maybe_tear``) — the crash-between-write-and-rename
+               window the fsync fixes close for real crashes
+
+Activation is either environment-driven::
+
+    GEOMESA_FAULTS="fs.block_read:error=0.1,netlog.rpc:drop=0.05"
+    GEOMESA_FAULTS_SEED=42
+
+or programmatic and scoped::
+
+    with faults.inject("device.fetch:error=0.5", seed=7):
+        store.query("t", "bbox(geom, 0, 0, 10, 10)")
+
+Draws come from a ``random.Random`` seeded per activation, so a chaos
+soak replays the same fault schedule from the same seed (single-threaded
+call order assumed; concurrent callers serialize on the set's lock but
+interleave nondeterministically). Every fired fault is counted in
+``utils.audit.robustness_metrics()`` under ``fault.<point>.<kind>``.
+
+With no active rules (the common case) ``fault_point`` is one env read
+and a list check — cheap enough to sit on every block read.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from geomesa_tpu.utils.audit import robustness_metrics
+
+FAULT_POINTS = (
+    "fs.block_read",
+    "fs.block_write",
+    "metadata.save",
+    "netlog.rpc",
+    "broker.poll",
+    "device.dispatch",
+    "device.fetch",
+)
+
+KINDS = ("error", "drop", "latency", "torn")
+
+
+class InjectedFault(OSError):
+    """An ``error`` rule fired. OSError, so I/O retry policies classify
+    it as transient — the same treatment a real EIO would get."""
+
+
+class InjectedDrop(ConnectionError):
+    """A ``drop`` rule fired: the peer hung up mid-exchange."""
+
+
+@dataclass
+class FaultRule:
+    """One injection rule. ``point`` is an exact fault-point name or a
+    prefix ending in ``*`` (``fs.*`` matches both fs points).
+    ``max_fires`` bounds how many times the rule may fire (a schedule of
+    "the first two reads fail" is ``prob=1, max_fires=2``)."""
+
+    point: str
+    kind: str
+    prob: float = 1.0
+    latency_s: float = 0.002
+    max_fires: Optional[int] = None
+    fired: int = 0
+
+    def matches(self, point: str) -> bool:
+        if self.point.endswith("*"):
+            return point.startswith(self.point[:-1])
+        return self.point == point
+
+
+class FaultSet:
+    """One activation of fault rules with its own seeded RNG. Use as a
+    context manager for scoped injection; the env-derived set stays
+    active for the whole process."""
+
+    def __init__(self, rules, seed: Optional[int] = None):
+        for r in rules:
+            if r.kind not in KINDS:
+                raise ValueError(f"unknown fault kind {r.kind!r} (kinds: {KINDS})")
+        self.rules: List[FaultRule] = list(rules)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def draw(self, point: str, kinds) -> Optional[FaultRule]:
+        """First matching rule that fires for ``point``, or None. The RNG
+        draw and fire bookkeeping serialize (broker handler threads hit
+        points concurrently with client threads)."""
+        with self._lock:
+            for rule in self.rules:
+                if rule.kind not in kinds or not rule.matches(point):
+                    continue
+                if rule.max_fires is not None and rule.fired >= rule.max_fires:
+                    continue
+                if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+                return rule
+        return None
+
+    def __enter__(self) -> "FaultSet":
+        with _STACK_LOCK:
+            _STACK.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _STACK_LOCK:
+            try:
+                _STACK.remove(self)
+            except ValueError:
+                pass
+
+
+def parse(spec: str, seed: Optional[int] = None) -> FaultSet:
+    """``"<point>:<kind>=<prob>,..."`` -> FaultSet. ``=<prob>`` is
+    optional (default 1.0)."""
+    rules = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pk, _, prob = part.partition("=")
+        point, sep, kind = pk.partition(":")
+        if not sep:
+            raise ValueError(f"bad fault spec {part!r} (want point:kind[=prob])")
+        rules.append(
+            FaultRule(point.strip(), kind.strip(), float(prob) if prob else 1.0)
+        )
+    return FaultSet(rules, seed=seed)
+
+
+def inject(spec: Optional[str] = None, *, rules=None, seed: Optional[int] = None) -> FaultSet:
+    """Programmatic scoped activation::
+
+        with faults.inject("fs.block_read:error=0.2", seed=3): ...
+        with faults.inject(rules=[FaultRule("netlog.rpc", "drop", max_fires=1)]): ...
+    """
+    if (spec is None) == (rules is None):
+        raise ValueError("pass exactly one of spec= or rules=")
+    return parse(spec, seed=seed) if spec is not None else FaultSet(rules, seed=seed)
+
+
+_STACK: List[FaultSet] = []
+_STACK_LOCK = threading.Lock()
+# (env spec string, parsed set): re-parsed only when GEOMESA_FAULTS changes
+_ENV_CACHE: Tuple[Optional[str], Optional[FaultSet]] = (None, None)
+
+
+def _env_set() -> Optional[FaultSet]:
+    global _ENV_CACHE
+    spec = os.environ.get("GEOMESA_FAULTS")
+    cached_spec, cached = _ENV_CACHE
+    if spec != cached_spec:
+        seed_s = os.environ.get("GEOMESA_FAULTS_SEED")
+        cached = (
+            parse(spec, seed=None if seed_s is None else int(seed_s))
+            if spec
+            else None
+        )
+        _ENV_CACHE = (spec, cached)
+    return cached
+
+
+def _active_sets() -> List[FaultSet]:
+    env = _env_set()
+    if not _STACK:
+        return [env] if env is not None else []
+    with _STACK_LOCK:
+        stack = list(_STACK)
+    return ([env] if env is not None else []) + stack
+
+
+def fault_point(point: str) -> None:
+    """The harness hook: call at a named boundary. ``error``/``drop``
+    rules raise, ``latency`` sleeps; ``torn`` rules are write-site only
+    (see ``maybe_tear``) and never fire here."""
+    for fs in _active_sets():
+        rule = fs.draw(point, ("error", "drop", "latency"))
+        if rule is None:
+            continue
+        robustness_metrics().inc(f"fault.{point}.{rule.kind}")
+        if rule.kind == "latency":
+            time.sleep(rule.latency_s)
+        elif rule.kind == "drop":
+            raise InjectedDrop(f"injected connection drop at {point}")
+        else:
+            raise InjectedFault(f"injected error at {point}")
+
+
+def maybe_tear(point: str, path: str) -> bool:
+    """Apply a fired ``torn`` rule to a just-written (not yet published)
+    file: truncate it to half, returning True. The caller publishes the
+    torn file anyway — simulating a crash inside the write-then-rename
+    window so the corruption-detection/quarantine path stays provable
+    even though the fsync fixes close that window for real crashes."""
+    for fs in _active_sets():
+        rule = fs.draw(point, ("torn",))
+        if rule is None:
+            continue
+        robustness_metrics().inc(f"fault.{point}.torn")
+        size = os.path.getsize(path)
+        with open(path, "rb+") as fh:
+            fh.truncate(max(0, size // 2))
+        return True
+    return False

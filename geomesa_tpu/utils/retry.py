@@ -1,0 +1,104 @@
+"""Unified retry policy: bounded attempts, decorrelated-jitter backoff.
+
+One implementation of backoff, jitter, deadlines, and retryable-exception
+classification for every transient-failure site in the tree — FsDataStore
+block I/O, the metadata registry flush, the RemoteLogBroker RPC path, the
+stream consumer's poll loop, the blobstore, and the metrics reporters all
+route through RetryPolicy (``scripts/lint_robustness.sh`` fails ad-hoc
+retry loops). Retries and give-ups are counted in
+``utils.audit.robustness_metrics()`` under ``retry.<name>.*`` so chaos
+soaks can assert the layer actually absorbed the injected faults.
+
+Backoff is exponential with decorrelated jitter (the AWS architecture
+blog's variant): ``sleep_i = min(cap, uniform(base, 3 * sleep_{i-1}))``.
+Decorrelation keeps a thundering herd of retriers from re-colliding on
+the same schedule; the cap bounds tail latency.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, Union
+
+from geomesa_tpu.utils.audit import robustness_metrics
+
+Retryable = Union[Tuple[Type[BaseException], ...], Callable[[BaseException], bool]]
+
+
+class RetryPolicy:
+    """Retry a callable on transient failures.
+
+    ``retryable`` is an exception-type tuple (default ``(OSError,)`` —
+    I/O and connection failures, including injected ones) or a predicate
+    ``exc -> bool``. Anything else raises through on the first attempt:
+    application errors and deterministic corruption must never be
+    hammered. ``deadline_s`` bounds total elapsed time across attempts;
+    when it would be exceeded the last error is raised even if attempts
+    remain. ``rng``/``sleep`` are injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        name: str = "io",
+        max_attempts: int = 4,
+        base_s: float = 0.02,
+        cap_s: float = 1.0,
+        deadline_s: Optional[float] = None,
+        retryable: Retryable = (OSError,),
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.name = name
+        self.max_attempts = int(max_attempts)
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.deadline_s = deadline_s
+        self.retryable = retryable
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        if isinstance(self.retryable, tuple):
+            return isinstance(exc, self.retryable)
+        return bool(self.retryable(exc))
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """``fn(*args, **kwargs)``, retried on retryable failures. The
+        final failure re-raises the ORIGINAL exception — callers keep
+        their exception contract."""
+        t0 = time.monotonic()
+        prev = self.base_s
+        attempt = 1
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:
+                if not self.is_retryable(e):
+                    raise
+                left = (
+                    None
+                    if self.deadline_s is None
+                    else self.deadline_s - (time.monotonic() - t0)
+                )
+                if attempt >= self.max_attempts or (left is not None and left <= 0):
+                    robustness_metrics().inc(f"retry.{self.name}.giveup")
+                    raise
+                prev = min(self.cap_s, self._rng.uniform(self.base_s, prev * 3))
+                if left is not None:
+                    prev = min(prev, max(0.0, left))
+                robustness_metrics().inc(f"retry.{self.name}.retries")
+                self._sleep(prev)
+                attempt += 1
+
+    def wrap(self, fn: Callable) -> Callable:
+        """Decorator form of ``call``."""
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+
+        return inner

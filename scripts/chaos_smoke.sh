@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Bounded chaos smoke: the fault-injection soaks (tests/test_chaos.py) on
+# CPU under a hard 60 s cap. Run in CI next to the tier-1 suite; a failure
+# prints the seed, and GEOMESA_FAULTS_SEED replays the schedule exactly.
+#
+# Usage: scripts/chaos_smoke.sh [extra pytest args]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+exec timeout -k 10 60 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_chaos.py -q -m chaos -p no:cacheprovider "$@"

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Static robustness lint for geomesa_tpu/:
+#
+#   1. bare `except:` — swallows KeyboardInterrupt/SystemExit and hides
+#      the exception type a retry policy would need to classify
+#   2. ad-hoc retry loops — `for attempt in ...`, a `retried=` flag, or
+#      time.sleep inside an except handler — outside utils/retry.py;
+#      every retry must route through RetryPolicy so backoff, deadlines,
+#      and the retry.* counters stay uniform
+#
+# Exits non-zero with the offending lines on any hit.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+fail=0
+
+bare=$(grep -rnE '(^|[^a-zA-Z_.])except[[:space:]]*:' --include='*.py' geomesa_tpu/ || true)
+if [ -n "$bare" ]; then
+    echo "FAIL: bare 'except:' (use typed exceptions):"
+    echo "$bare"
+    fail=1
+fi
+
+adhoc=$(grep -rnE 'for[[:space:]]+_?(attempt|retry|tries)[a-z_]*[[:space:]]+in[[:space:]]|retried[[:space:]]*=|while.*retr(y|ies)' \
+        --include='*.py' geomesa_tpu/ | grep -v 'geomesa_tpu/utils/retry.py' || true)
+if [ -n "$adhoc" ]; then
+    echo "FAIL: ad-hoc retry loop (route through geomesa_tpu.utils.retry.RetryPolicy):"
+    echo "$adhoc"
+    fail=1
+fi
+
+if [ "$fail" -eq 0 ]; then
+    echo "robustness lint clean"
+fi
+exit $fail

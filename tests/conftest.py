@@ -21,3 +21,14 @@ try:
     force_cpu_platform(min_devices=8)
 except ImportError:  # jax missing entirely -> host-only tests still run
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: randomized fault-injection soak (bounded; scripts/chaos_smoke.sh "
+        "runs just these)",
+    )
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')"
+    )

@@ -1,0 +1,199 @@
+"""Chaos soaks: ingest + query + stream pipelines under randomized fault
+schedules, asserting result-set parity with the fault-free run.
+
+The invariant ("parity under faults", ROADMAP.md): a fault schedule over
+the fs / netlog / device fault points may cost latency (retries,
+device->host degradation) but NEVER correctness — every query answers
+identically to the fault-free run. Schedules are seeded
+(utils/faults.py), so a failing seed replays exactly.
+
+Bounded by design (scripts/chaos_smoke.sh runs just these under a 60 s
+cap): small stores, five seeds per pipeline.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.parallel.executor import TpuScanExecutor
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.store.fs import FsDataStore
+from geomesa_tpu.stream.filelog import FileLogBroker
+from geomesa_tpu.stream.netlog import LogServer, RemoteLogBroker
+from geomesa_tpu.stream.store import StreamDataStore
+from geomesa_tpu.utils import faults
+from geomesa_tpu.utils.audit import robustness_metrics
+
+pytestmark = pytest.mark.chaos
+
+SPEC = "name:String,n:Int,dtg:Date,*geom:Point:srid=4326"
+T0 = 1483228800000  # 2017-01-01T00:00:00Z
+DAY = 86400000
+
+QUERIES = [
+    "INCLUDE",
+    "BBOX(geom, -20, -20, 20, 20)",
+    "BBOX(geom, 0, 0, 60, 60) AND dtg DURING "
+    "2017-01-05T00:00:00Z/2017-01-20T00:00:00Z",
+    "name = 'n3'",
+    "BBOX(geom, -60, -60, 0, 0) OR name = 'n5'",
+]
+
+# retried-or-degraded kinds only: torn writes lose data by design (their
+# recovery contract — quarantine + keep serving — is pinned separately in
+# test_robustness.py) and would break parity
+FS_SCHEDULE = (
+    "fs.block_read:error=0.1,fs.block_read:latency=0.2,"
+    "fs.block_write:error=0.1,metadata.save:error=0.1,"
+    "device.dispatch:error=0.3,device.fetch:error=0.3"
+)
+
+
+def rows(n=150, seed=0):
+    rs = np.random.RandomState(seed)
+    return [
+        (
+            f"f{i:05d}",
+            [
+                f"n{i % 7}",
+                int(rs.randint(0, 100)),
+                T0 + int(rs.randint(0, 30 * DAY)),
+                Point(float(rs.uniform(-70, 70)), float(rs.uniform(-70, 70))),
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def ingest(store, data, name="t"):
+    store.create_schema(parse_spec(name, SPEC))
+    with store.writer(name) as w:
+        for fid, values in data:
+            w.write(values, fid=fid)
+
+
+def fids(store, name="t"):
+    return {q: sorted(store.query(name, q).fids) for q in QUERIES}
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fs_pipeline_parity_under_faults(tmp_path, seed, monkeypatch):
+    """Ingest + query + reopen an FsDataStore (with a live device
+    executor) under a randomized fs/device fault schedule: every result
+    set matches the fault-free run."""
+    monkeypatch.setenv("GEOMESA_SEEK", "0")  # keep the device scan path live
+    data = rows(seed=seed)
+    clean = FsDataStore(str(tmp_path / "clean"), flush_size=37)
+    ingest(clean, data)
+    baseline = fids(clean)
+
+    root = str(tmp_path / "chaos")
+    with faults.inject(FS_SCHEDULE, seed=seed):
+        store = FsDataStore(root, flush_size=37, executor=TpuScanExecutor())
+        ingest(store, data)
+        assert fids(store) == baseline
+        # reopen UNDER faults: block replay exercises the read-side
+        # retries (freshly written blocks never re-read in-process)
+        reopened = FsDataStore(root, executor=TpuScanExecutor())
+        assert fids(reopened) == baseline
+    # everything the faulted ingest published must replay clean
+    assert fids(FsDataStore(root)) == baseline
+    assert not [
+        f for f in os.listdir(os.path.join(root, "blocks", "t"))
+        if f.endswith(".quarantine")
+    ]
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_stream_pipeline_parity_under_faults(tmp_path, seed):
+    """Produce + consume over the durable file log while the consumer's
+    polls fault: the retry layer absorbs them with zero record loss."""
+    data = rows(n=80, seed=seed)
+    clean = StreamDataStore(broker=FileLogBroker(str(tmp_path / "clean")))
+    ingest_stream(clean, data)
+    baseline = fids(clean)
+
+    broker = FileLogBroker(str(tmp_path / "chaos"))
+    prod = StreamDataStore(broker=broker)
+    cons = StreamDataStore(broker=FileLogBroker(str(tmp_path / "chaos")))
+    with faults.inject("broker.poll:error=0.25,broker.poll:latency=0.2",
+                       seed=seed):
+        ingest_stream(prod, data)
+        cons.create_schema(parse_spec("t", SPEC))
+        assert fids(cons) == baseline
+
+
+def ingest_stream(store, data, name="t"):
+    store.create_schema(parse_spec(name, SPEC))
+    for i, (fid, values) in enumerate(data):
+        store.write(name, values, fid=fid, ts_ms=T0 + i)
+    store.delete(name, data[0][0], ts_ms=T0 + len(data))
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_remote_stream_parity_under_connection_drops(tmp_path, seed):
+    """The TCP tier under injected connection drops: an at-least-once
+    producer and an idempotent-retrying consumer agree with the
+    fault-free run (duplicate deliveries collapse by fid)."""
+    data = rows(n=60, seed=seed)
+    clean = StreamDataStore(broker=FileLogBroker(str(tmp_path / "clean")))
+    ingest_stream(clean, data)
+    baseline = fids(clean)
+
+    with LogServer(str(tmp_path / "chaos")) as (host, port):
+        with faults.inject("netlog.rpc:drop=0.1,netlog.rpc:latency=0.1",
+                           seed=seed):
+            prod = StreamDataStore(
+                broker=RemoteLogBroker(host, port, at_least_once=True)
+            )
+            ingest_stream(prod, data)
+            cons = StreamDataStore(broker=RemoteLogBroker(host, port))
+            cons.create_schema(parse_spec("t", SPEC))
+            assert fids(cons) == baseline
+
+
+@pytest.mark.parametrize("point", ["device.dispatch", "device.fetch"])
+def test_device_fault_degrades_to_host_with_parity(point, monkeypatch):
+    """The acceptance check: an injected device fault on a live
+    TpuScanExecutor query returns results identical to the host scan
+    path, the audit counters record the degradation, and the next clean
+    query rebuilds the mirror and runs the device path again."""
+    monkeypatch.setenv("GEOMESA_SEEK", "0")  # force the device scan path
+    data = rows(n=400, seed=11)
+    host = TpuDataStore()
+    ingest(host, data)
+    dev = TpuDataStore(executor=TpuScanExecutor())
+    ingest(dev, data)
+    q = "BBOX(geom, -30, -30, 30, 30)"
+    baseline = sorted(host.query("t", q).fids)
+    assert sorted(dev.query("t", q).fids) == baseline  # warm mirror, device path
+
+    m = robustness_metrics()
+    before = m.report().get("degrade.device_to_host", 0)
+    with faults.inject(f"{point}:error=1.0"):
+        assert sorted(dev.query("t", q).fids) == baseline
+    report = m.report()
+    assert report.get("degrade.device_to_host", 0) > before
+    assert report.get("degrade.mirror_rebuilds", 0) >= 1
+    # faults cleared: the mirror rebuilds and the device path serves again
+    assert sorted(dev.query("t", q).fids) == baseline
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_query_many_parity_under_device_faults(seed, monkeypatch):
+    """The pipelined batch-dispatch path degrades per batch: positional
+    results stay identical to the fault-free per-query answers."""
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    data = rows(n=300, seed=seed)
+    host = TpuDataStore()
+    ingest(host, data)
+    dev = TpuDataStore(executor=TpuScanExecutor())
+    ingest(dev, data)
+    baseline = [sorted(host.query("t", q).fids) for q in QUERIES]
+    with faults.inject("device.dispatch:error=0.4,device.fetch:error=0.4",
+                       seed=seed):
+        got = [sorted(r.fids) for r in dev.query_many("t", QUERIES)]
+    assert got == baseline

@@ -1,0 +1,394 @@
+"""Robustness layer: fault-injection harness, RetryPolicy, CRC/quarantine
+recovery, and the RemoteLogBroker idempotency contract.
+
+The chaos soaks (test_chaos.py) prove end-to-end parity under randomized
+schedules; these tests pin the individual mechanisms — deterministic
+injection, retry classification/backoff, torn-write recovery, and the
+send duplicate-append hazard fix.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.fs import FsDataStore
+from geomesa_tpu.store.integrity import (
+    CorruptFileError,
+    append_crc_footer,
+    read_verified,
+)
+from geomesa_tpu.store.metadata import FileMetadata
+from geomesa_tpu.stream.filelog import FileLogBroker
+from geomesa_tpu.stream.netlog import LogServer, RemoteLogBroker
+from geomesa_tpu.stream.store import StreamDataStore
+from geomesa_tpu.utils import faults
+from geomesa_tpu.utils.audit import robustness_metrics
+from geomesa_tpu.utils.retry import RetryPolicy
+
+SPEC = "name:String,n:Int,dtg:Date,*geom:Point:srid=4326"
+T0 = 1483228800000  # 2017-01-01T00:00:00Z
+
+
+def counter(name):
+    return robustness_metrics().report().get(name, 0)
+
+
+def fill(store, name="t", rows=120, seed=0):
+    ft = parse_spec(name, SPEC)
+    store.create_schema(ft)
+    rs = np.random.RandomState(seed)
+    with store.writer(name) as w:
+        for i in range(rows):
+            w.write(
+                [
+                    f"n{i % 7}",
+                    int(rs.randint(0, 100)),
+                    T0 + int(rs.randint(0, 30 * 86400000)),
+                    Point(float(rs.uniform(-60, 60)), float(rs.uniform(-60, 60))),
+                ],
+                fid=f"f{i:05d}",
+            )
+    return ft
+
+
+# -- harness ------------------------------------------------------------------
+
+
+def test_fault_point_kinds_and_counters():
+    before = counter("fault.fs.block_read.error")
+    with faults.inject("fs.block_read:error"):
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("fs.block_read")
+        faults.fault_point("fs.block_write")  # other points untouched
+    faults.fault_point("fs.block_read")  # scope exited: inert
+    assert counter("fault.fs.block_read.error") == before + 1
+    with faults.inject("netlog.rpc:drop"):
+        with pytest.raises(ConnectionError):
+            faults.fault_point("netlog.rpc")
+    with faults.inject("broker.poll:latency"):
+        faults.fault_point("broker.poll")  # sleeps, returns
+
+
+def test_fault_schedule_is_seed_deterministic():
+    def draws(seed):
+        fired = []
+        with faults.inject("fs.block_read:error=0.5", seed=seed):
+            for _ in range(40):
+                try:
+                    faults.fault_point("fs.block_read")
+                    fired.append(0)
+                except faults.InjectedFault:
+                    fired.append(1)
+        return fired
+
+    assert draws(7) == draws(7)
+    assert draws(7) != draws(8)
+    assert sum(draws(7)) > 0
+
+
+def test_fault_rule_wildcard_and_max_fires():
+    rule = faults.FaultRule("fs.*", "error", max_fires=2)
+    with faults.inject(rules=[rule]):
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("fs.block_read")
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("fs.block_write")
+        faults.fault_point("fs.block_read")  # exhausted
+    assert rule.fired == 2
+
+
+def test_env_activation(monkeypatch):
+    monkeypatch.setenv("GEOMESA_FAULTS", "metadata.save:error")
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("metadata.save")
+    monkeypatch.setenv("GEOMESA_FAULTS", "")
+    faults.fault_point("metadata.save")  # cleared
+
+
+# -- RetryPolicy --------------------------------------------------------------
+
+
+def test_retry_absorbs_transient_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    sleeps = []
+    p = RetryPolicy(name="test", max_attempts=4, base_s=0.01, cap_s=0.05,
+                    sleep=sleeps.append)
+    before = counter("retry.test.retries")
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert len(sleeps) == 2
+    assert all(0.0 <= s <= 0.05 for s in sleeps)
+    assert counter("retry.test.retries") == before + 2
+
+
+def test_retry_gives_up_with_original_error():
+    p = RetryPolicy(name="test-giveup", max_attempts=3, sleep=lambda s: None)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    before = counter("retry.test-giveup.giveup")
+    with pytest.raises(ConnectionError, match="down"):
+        p.call(always)
+    assert len(calls) == 3
+    assert counter("retry.test-giveup.giveup") == before + 1
+
+
+def test_retry_never_hammers_non_retryable():
+    p = RetryPolicy(name="test-app", sleep=lambda s: None)
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("application bug")
+
+    with pytest.raises(ValueError):
+        p.call(boom)
+    assert len(calls) == 1
+    # CorruptFileError is deliberately not an OSError: never retried
+    def corrupt():
+        calls.append(1)
+        raise CorruptFileError("bad crc")
+
+    with pytest.raises(CorruptFileError):
+        p.call(corrupt)
+    assert len(calls) == 2
+
+
+def test_retry_deadline_bounds_total_time():
+    p = RetryPolicy(name="test-deadline", max_attempts=100, base_s=0.001,
+                    deadline_s=0.05)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("slow outage")
+
+    with pytest.raises(OSError):
+        p.call(always)
+    assert 1 < len(calls) < 100
+
+
+# -- integrity: CRC + quarantine ----------------------------------------------
+
+
+def test_crc_footer_roundtrip_and_detection(tmp_path):
+    p = str(tmp_path / "f.bin")
+    with open(p, "wb") as fh:
+        fh.write(b"payload" * 100)
+    append_crc_footer(p)
+    assert read_verified(p) == b"payload" * 100
+    # bit rot anywhere in the content is caught
+    with open(p, "rb+") as fh:
+        fh.seek(50)
+        fh.write(b"\x00")
+    with pytest.raises(CorruptFileError):
+        read_verified(p)
+
+
+def test_torn_block_quarantined_store_keeps_serving(tmp_path):
+    root = str(tmp_path / "store")
+    fill(FsDataStore(root, flush_size=40), rows=120)
+    d = os.path.join(root, "blocks", "t")
+    blocks = sorted(f for f in os.listdir(d) if f.endswith(".npz"))
+    assert len(blocks) == 3
+    victim = os.path.join(d, blocks[1])
+    with open(victim, "rb+") as fh:
+        fh.truncate(os.path.getsize(victim) // 2)
+
+    before = counter("quarantine.files")
+    store = FsDataStore(root)
+    assert len(store.query("t")) == 80  # the other two blocks still serve
+    assert os.path.exists(victim + ".quarantine") and not os.path.exists(victim)
+    assert counter("quarantine.files") == before + 1
+    # a fresh open no longer even discovers the quarantined file
+    assert len(FsDataStore(root).query("t")) == 80
+
+
+def test_torn_parquet_block_quarantined(tmp_path):
+    root = str(tmp_path / "store")
+    fill(FsDataStore(root, flush_size=40, block_format="parquet"), rows=120)
+    d = os.path.join(root, "blocks", "t")
+    victim = os.path.join(d, sorted(os.listdir(d))[0])
+    with open(victim, "rb+") as fh:
+        fh.truncate(os.path.getsize(victim) // 2)
+    store = FsDataStore(root, block_format="parquet")
+    assert len(store.query("t")) == 80
+    assert os.path.exists(victim + ".quarantine")
+
+
+def test_torn_metadata_quarantined_then_recoverable(tmp_path):
+    root = str(tmp_path / "store")
+    ft = fill(FsDataStore(root, flush_size=40), rows=120)
+    meta = os.path.join(root, "metadata.json")
+    with open(meta, "rb+") as fh:
+        fh.truncate(os.path.getsize(meta) // 2)
+
+    before = counter("quarantine.files")
+    store = FsDataStore(root)  # opens EMPTY instead of refusing to start
+    assert store.type_names == []
+    assert os.path.exists(meta + ".quarantine")
+    assert counter("quarantine.files") == before + 1
+    # recovery contract: re-create the schema, reopen, blocks replay
+    store.create_schema(ft)
+    assert len(FsDataStore(root).query("t")) == 120
+
+
+def test_injected_torn_write_is_caught_on_read(tmp_path):
+    """A torn fault fired during block write publishes a truncated file
+    (the pre-fsync crash window); the CRC/quarantine path absorbs it."""
+    root = str(tmp_path / "store")
+    with faults.inject(rules=[faults.FaultRule("fs.block_write", "torn",
+                                               max_fires=1)]):
+        fill(FsDataStore(root, flush_size=40), rows=120)
+    store = FsDataStore(root)
+    assert len(store.query("t")) == 80
+
+
+def test_metadata_save_retries_injected_errors(tmp_path):
+    m = FileMetadata(str(tmp_path / "metadata.json"))
+    with faults.inject(rules=[faults.FaultRule("metadata.save", "error",
+                                               max_fires=2)]):
+        m.insert("t", "k", "v")  # two failures absorbed by the retry
+    assert FileMetadata(str(tmp_path / "metadata.json")).read("t", "k") == "v"
+
+
+# -- netlog: duplicate-append hazard ------------------------------------------
+
+
+class _AckLossBroker(RemoteLogBroker):
+    """Simulates the hazard window: the request is applied server-side
+    but the connection dies before the ack arrives."""
+
+    def __init__(self, *args, **kwargs):
+        self.lose_next_ack = False
+        super().__init__(*args, **kwargs)
+
+    def _attempt(self, head, payload):
+        resp = super()._attempt(head, payload)
+        if self.lose_next_ack:
+            self.lose_next_ack = False
+            self.close()
+            raise ConnectionError("ack lost after apply")
+        return resp
+
+
+def test_send_is_at_most_once_by_default(tmp_path):
+    with LogServer(str(tmp_path / "log"), partitions=1) as (host, port):
+        b = _AckLossBroker(host, port)
+        b.lose_next_ack = True
+        with pytest.raises(ConnectionError):
+            b.send("t", 0, b"rec")  # NOT blindly re-sent
+        # the append WAS applied server-side — a blind retry would have
+        # duplicated it; the error surfaced instead
+        assert b.end_offsets("t") == {0: 1}
+
+
+def test_send_retries_with_at_least_once_opt_in(tmp_path):
+    with LogServer(str(tmp_path / "log"), partitions=1) as (host, port):
+        b = _AckLossBroker(host, port, at_least_once=True)
+        b.lose_next_ack = True
+        b.send("t", 0, b"rec")  # retried; the duplicate is the contract
+        assert b.end_offsets("t") == {0: 2}
+        # GeoMessage consumers apply by fid, so re-delivery is idempotent
+        s = StreamDataStore(broker=RemoteLogBroker(host, port))
+        s.create_schema(parse_spec("t2", SPEC))
+        prod = StreamDataStore(
+            broker=_AckLossBroker(host, port, at_least_once=True)
+        )
+        prod.create_schema(parse_spec("t2", SPEC))
+        prod.broker.lose_next_ack = True
+        prod.write("t2", ["a", 1, T0, Point(0.0, 0.0)], fid="x")
+        s.create_schema(parse_spec("t2", SPEC))
+        assert sorted(s.query("t2").fids) == ["x"]  # duplicate collapsed
+
+
+def test_send_dial_failures_retry_even_at_most_once(tmp_path):
+    """Establishing the connection happens before any server-side apply,
+    so dial failures retry even for at-most-once sends."""
+    with LogServer(str(tmp_path / "log"), partitions=1) as (host, port):
+        b = RemoteLogBroker(host, port)
+    b.close()  # server gone AND no cached socket: send must dial
+    before = counter("retry.netlog.retries")
+    with pytest.raises(OSError):
+        b.send("t", 0, b"x")
+    assert counter("retry.netlog.retries") >= before + 3
+
+
+def test_idempotent_ops_retry_through_drops(tmp_path):
+    with LogServer(str(tmp_path / "log"), partitions=1) as (host, port):
+        b = RemoteLogBroker(host, port)
+        b.send("t", 0, b"rec")
+        with faults.inject(rules=[faults.FaultRule("netlog.rpc", "drop",
+                                                   max_fires=1)]):
+            assert len(b.poll("t", {})) == 1  # reconnect + retry, no caller care
+        with faults.inject(rules=[faults.FaultRule("netlog.rpc", "drop",
+                                                   max_fires=1)]):
+            with pytest.raises(ConnectionError):
+                b.send("t", 0, b"rec2")  # send does NOT ride the retry
+        assert b.end_offsets("t") == {0: 1}
+
+
+def test_stream_consumer_poll_retries_broker_faults(tmp_path):
+    broker = FileLogBroker(str(tmp_path / "log"), partitions=2)
+    s = StreamDataStore(broker=broker)
+    s.create_schema(parse_spec("t", SPEC))
+    for i in range(10):
+        s.write("t", [f"n{i}", i, T0 + i, Point(1.0, 2.0)], fid=f"f{i}")
+    with faults.inject(rules=[faults.FaultRule("broker.poll", "error",
+                                               max_fires=2)]):
+        assert len(s.query("t")) == 10  # consumer absorbed the poll faults
+
+
+# -- blobstore ----------------------------------------------------------------
+
+
+def test_blobstore_retries_injected_io_faults(tmp_path):
+    from geomesa_tpu.blobstore import BlobStore
+
+    bs = BlobStore(root=str(tmp_path / "blobs"))
+    doc = b'{"geometry": {"type": "Point", "coordinates": [1.0, 2.0]}}'
+    with faults.inject(rules=[faults.FaultRule("fs.block_write", "error",
+                                               max_fires=2)]):
+        bid = bs.put("a.geojson", doc)
+    with faults.inject(rules=[faults.FaultRule("fs.block_read", "error",
+                                               max_fires=2)]):
+        assert bs.get(bid) == doc
+
+
+def test_concurrent_fault_points_are_safe():
+    """Handler threads hit points concurrently with clients: the set's
+    lock must keep draws consistent (no lost fires, no crashes)."""
+    errs = []
+    hits = []
+
+    def worker():
+        for _ in range(200):
+            try:
+                faults.fault_point("broker.poll")
+            except faults.InjectedFault:
+                hits.append(1)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+    with faults.inject("broker.poll:error=0.3", seed=1):
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errs
+    assert hits
