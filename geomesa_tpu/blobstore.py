@@ -21,7 +21,7 @@ from geomesa_tpu.geom.base import Point
 from geomesa_tpu.schema.featuretype import parse_spec
 from geomesa_tpu.store.datastore import TpuDataStore
 from geomesa_tpu.store.integrity import fsync_enabled
-from geomesa_tpu.utils import faults
+from geomesa_tpu.utils import faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 _SPEC = "filename:String,meta:String,dtg:Date,*geom:Point:srid=4326"
@@ -221,18 +221,20 @@ class BlobStore:
 
     @staticmethod
     def _write_blob(path: str, data: bytes) -> None:
-        faults.fault_point("fs.block_write")
-        with open(path, "wb") as fh:
-            fh.write(data)
-            if fsync_enabled():
-                fh.flush()
-                os.fsync(fh.fileno())
+        with trace.span("fs.block_write", path=path, bytes=len(data)):
+            faults.fault_point("fs.block_write")
+            with open(path, "wb") as fh:
+                fh.write(data)
+                if fsync_enabled():
+                    fh.flush()
+                    os.fsync(fh.fileno())
 
     @staticmethod
     def _read_blob(path: str) -> bytes:
-        faults.fault_point("fs.block_read")
-        with open(path, "rb") as fh:
-            return fh.read()
+        with trace.span("fs.block_read", path=path):
+            faults.fault_point("fs.block_read")
+            with open(path, "rb") as fh:
+                return fh.read()
 
     def get(self, blob_id: str) -> Optional[bytes]:
         if self.root:

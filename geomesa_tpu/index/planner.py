@@ -21,6 +21,7 @@ from geomesa_tpu.index.keyspace import (
 )
 from geomesa_tpu.index.strategy import FilterStrategy, get_filter_strategies
 from geomesa_tpu.schema.featuretype import FeatureType
+from geomesa_tpu.utils import trace
 
 
 class Explainer:
@@ -127,6 +128,27 @@ class QueryPlanner:
         max_ranges = _ranges_target(max_ranges)
         explain = explain or Explainer()
         f = simplify(query.filter)
+        with trace.span("plan", type=self.ft.name) as sp:
+            plan = self._plan_or(f, explain, max_ranges)
+            if sp.recording:
+                # the Explainer trace IS the plan's provenance — attach it
+                # whole so a slow-query dump or /debug/traces explains the
+                # strategy choice without a second explain() run
+                sp.set_attr("filter", to_cql(f))
+                sp.set_attr("index", plan.index.name)
+                sp.set_attr("cost", plan.cost)
+                sp.set_attr("n_ranges", len(plan.ranges))
+                if plan.union is not None:
+                    sp.set_attr("union_arms", len(plan.union))
+                sp.set_attr("explain", plan.explain)
+        return plan
+
+    def _plan_or(
+        self,
+        f: ast.Filter,
+        explain: Explainer,
+        max_ranges: Optional[int] = None,
+    ) -> QueryPlan:
         single = self._plan_single(f, explain, max_ranges)
         if not isinstance(f, ast.Or):
             return single
@@ -216,7 +238,11 @@ class QueryPlanner:
             explain("Full table scan (no index applies)")
             ranges: List[ScanRange] = []
         else:
-            ranges = best.index.get_ranges(self.ft, best.values, max_ranges)
+            with trace.span(
+                "plan.range_decomposition", index=best.index.name
+            ) as rsp:
+                ranges = best.index.get_ranges(self.ft, best.values, max_ranges)
+                rsp.set_attr("n_ranges", len(ranges))
         explain(f"Ranges: {len(ranges)}")
 
         full = None if isinstance(f, ast.Include) else f

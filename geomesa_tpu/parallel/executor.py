@@ -60,7 +60,7 @@ from geomesa_tpu.parallel.mesh import (
     shard_map_fn,
 )
 from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
-from geomesa_tpu.utils import faults
+from geomesa_tpu.utils import faults, trace
 
 # initial hit-run capacity: 4096 runs * 8B = 32 KiB per segment transfer
 HIT_CAPACITY0 = 4096
@@ -824,14 +824,19 @@ def _np_local(arr) -> np.ndarray:
     each process resolves exactly its own shards' hits — the per-executor
     partial results the reference's Spark partitions return
     (GeoMesaSpark.scala:38-50), with the client (caller) unioning
-    processes. Single-process arrays take the plain asarray path."""
-    faults.fault_point("device.fetch")
-    if getattr(arr, "is_fully_addressable", True):
-        return np.asarray(arr)
-    out = np.zeros(arr.shape, dtype=arr.dtype)
-    for s in arr.addressable_shards:
-        out[s.index] = np.asarray(s.data)
-    return out
+    processes. Single-process arrays take the plain asarray path.
+
+    The ``device.fetch`` span mirrors the fault point: every D2H
+    boundary crossing lands on the owning query's trace with the bytes
+    that moved (the kernel-vs-link split of arxiv 2203.14362 §5)."""
+    with trace.span("device.fetch", bytes=int(getattr(arr, "nbytes", 0))):
+        faults.fault_point("device.fetch")
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        out = np.zeros(arr.shape, dtype=arr.dtype)
+        for s in arr.addressable_shards:
+            out[s.index] = np.asarray(s.data)
+        return out
 
 
 class _PendingShardBitmapHits:
@@ -4327,6 +4332,13 @@ class TpuScanExecutor:
         m.inc("degrade.device_to_host")
         if evicted:
             m.inc("degrade.mirror_rebuilds", evicted)
+        # the degrade reason lands on the degraded query's OWN span tree,
+        # joining the process-wide degrade.* counters to per-query blame
+        trace.event(
+            "degrade.device_to_host",
+            reason=f"{type(exc).__name__}: {exc}",
+            mirrors_evicted=evicted,
+        )
         sys.stderr.write(
             f"[executor] device scan failed ({type(exc).__name__}: {exc}); "
             "host path answers; mirror marked for rebuild\n"

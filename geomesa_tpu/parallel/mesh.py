@@ -14,7 +14,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from geomesa_tpu.utils import faults
+from geomesa_tpu.utils import faults, trace
 
 DATA_AXIS = "shards"
 
@@ -134,15 +134,19 @@ def shard_array(mesh: Mesh, arr: np.ndarray, axis: str = DATA_AXIS):
     ``device.dispatch`` fault point: every H2D placement (mirror uploads
     and query descriptors) passes here or through ``replicate``, so an
     injected dispatch fault exercises the executor's device->host
-    degradation exactly where a dead tunnel would surface."""
-    faults.fault_point("device.dispatch")
-    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+    degradation exactly where a dead tunnel would surface. The span of
+    the same name is the tracing half of that contract: every H2D
+    boundary crossing lands on the owning query's span tree."""
+    with trace.span("device.dispatch", bytes=int(getattr(arr, "nbytes", 0))):
+        faults.fault_point("device.dispatch")
+        return jax.device_put(arr, NamedSharding(mesh, P(axis)))
 
 
 def replicate(mesh: Mesh, arr: np.ndarray):
     """Place a host array on the mesh fully replicated (query descriptors)."""
-    faults.fault_point("device.dispatch")
-    return jax.device_put(arr, NamedSharding(mesh, P()))
+    with trace.span("device.dispatch", bytes=int(getattr(arr, "nbytes", 0))):
+        faults.fault_point("device.dispatch")
+        return jax.device_put(arr, NamedSharding(mesh, P()))
 
 
 _LINK_LATENCY_MS: Optional[float] = None

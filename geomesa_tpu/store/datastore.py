@@ -37,6 +37,7 @@ from geomesa_tpu.store.blocks import (
     take_rows,
 )
 from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
+from geomesa_tpu.utils import trace
 
 DEFAULT_FLUSH_SIZE = 100_000
 
@@ -251,6 +252,7 @@ class TpuDataStore:
         audit_writer: Optional[Any] = None,
         metrics: Optional[Any] = None,
         query_timeout_s: Optional[float] = None,
+        slow_query_s: Optional[float] = None,
         user: str = "unknown",
     ):
         from geomesa_tpu.stats.service import MetadataBackedStats
@@ -271,6 +273,14 @@ class TpuDataStore:
             ms = QUERY_TIMEOUT.to_duration_ms()
             query_timeout_s = None if ms is None else ms / 1000.0
         self.query_timeout_s = query_timeout_s
+        if slow_query_s is None:
+            # tiered knob: geomesa.query.slow.threshold — any query over
+            # the budget logs its full span tree + explain
+            from geomesa_tpu.utils.config import SLOW_QUERY_THRESHOLD
+
+            ms = SLOW_QUERY_THRESHOLD.to_duration_ms()
+            slow_query_s = None if ms is None else ms / 1000.0
+        self.slow_query_s = slow_query_s
         self.user = user
         # write-time maintained sketches feeding the cost-based decider
         # (accumulo/data/stats/StatsCombiner.scala:26 analog)
@@ -279,6 +289,24 @@ class TpuDataStore:
         self._indices: Dict[str, List[IndexKeySpace]] = {}
         self._tables: Dict[str, Dict[str, IndexTable]] = {}
         self._plan_cache: Dict[Any, QueryPlan] = {}
+        if self.metrics is not None and hasattr(self.metrics, "gauge_fn"):
+            # sampled at snapshot time: cache pressure without
+            # bookkeeping. One gauge per REGISTRY summing over a WeakSet
+            # of live stores — several stores sharing the scrape registry
+            # don't overwrite each other, and a registry outliving a
+            # store never pins its tables and mirrors (dead stores just
+            # drop out of the set).
+            import weakref
+
+            stores = getattr(self.metrics, "_plan_cache_stores", None)
+            if stores is None:
+                stores = weakref.WeakSet()
+                self.metrics._plan_cache_stores = stores
+                self.metrics.gauge_fn(
+                    "plan_cache.size",
+                    lambda: sum(len(s._plan_cache) for s in stores),
+                )
+            stores.add(self)
         # recover schemas from persistent metadata
         for name in self.metadata.scan_types():
             spec = self.metadata.read(name, "attributes")
@@ -474,15 +502,40 @@ class TpuDataStore:
     def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
         import time as _time
 
-        t_start = _time.perf_counter()
         ft = self.get_schema(name)
         query = self._as_query(query)
-        plan = self._plan_cached(name, query)
-        t_planned = _time.perf_counter()
-        result = self._execute(name, ft, query, plan, t_planned)
-        if self.audit_writer is not None or self.metrics is not None:
-            self._audit(name, query, plan, result, t_start, t_planned)
-        return result
+        # one span tree per query: plan -> range decomposition -> per-block
+        # scans -> device dispatch/fetch (or degradation) -> post-filter.
+        # Forced (exporter or not) when a slow-query budget is set, so the
+        # slow log always has a tree to dump — including for queries that
+        # RAISE (a timeout is exactly the query the slow log exists for).
+        root = trace.NOOP
+        plan = None
+        try:
+            with trace.span(
+                "query", force=self.slow_query_s is not None, type=name
+            ) as root:
+                self._prepare_query(name, query)
+                # the audited clock starts AFTER preparation: a lazy
+                # store's partition replay is traced (fs.load) but must
+                # not inflate the audited planning time
+                t_start = _time.perf_counter()
+                plan = self._plan_cached(name, query)
+                t_planned = _time.perf_counter()
+                result = self._execute(name, ft, query, plan, t_planned)
+                if root.recording:
+                    root.set_attr("hits", len(result))
+                    root.set_attr("scan_path", self._collect_scan_path(plan))
+                if self.audit_writer is not None or self.metrics is not None:
+                    self._audit(name, query, plan, result, t_start, t_planned)
+                return result
+        finally:
+            self._log_slow_query(name, plan, root)
+
+    def _prepare_query(self, name: str, query: Query) -> None:
+        """Pre-execution hook inside the query's root span — subclasses
+        that must materialize state first (FsDataStore's lazy partition
+        replay) override this so that work lands ON the query's trace."""
 
     def query_many(
         self, name: str, queries: Sequence[Union[str, Query]]
@@ -498,10 +551,51 @@ class TpuDataStore:
         same way). Results are positionally identical to [query(name, q)
         for q in queries].
         """
-        import time as _time
-
         ft = self.get_schema(name)
         qs = [self._as_query(q) for q in queries]
+        # one batch root: shared preparation (a lazy store's partition
+        # replay) and the per-query spans all land on ONE tree — without
+        # it the fs.load span would export as an orphan root and the
+        # batch queries' trees would omit the replay cost entirely.
+        # Forced under a slow-query budget like query()'s root, so batch
+        # overhead (replay, planning) stays slow-log-visible too.
+        batch = trace.NOOP
+        try:
+            with trace.span(
+                "query.batch", force=self.slow_query_s is not None,
+                type=name, n=len(qs),
+            ) as batch:
+                for q in qs:
+                    self._prepare_query(name, q)
+                return self._query_many_planned(name, ft, qs)
+        finally:
+            self._log_slow_batch(name, batch)
+
+    def _log_slow_batch(self, name: str, batch) -> None:
+        """query_many edition of the slow-query log: the batch's OWN
+        overhead — shared preparation (a lazy store's partition replay)
+        plus pipelined planning/dispatch, i.e. everything outside the
+        per-query spans — over budget dumps the batch tree. Per-query
+        trees log themselves via _log_slow_query."""
+        import logging as _logging
+
+        if self.slow_query_s is None or not batch.recording:
+            return
+        own_ms = batch.duration_ms - sum(
+            c.duration_ms for c in batch.children if c.name == "query"
+        )
+        if own_ms < self.slow_query_s * 1000.0:
+            return
+        _logging.getLogger("geomesa_tpu.slowquery").warning(
+            "slow query batch type=%s trace=%s overhead %.1fms of %.1fms "
+            "total (budget %.0fms)\n%s",
+            name, batch.trace_id, own_ms, batch.duration_ms,
+            self.slow_query_s * 1000.0, batch.render(),
+        )
+
+    def _query_many_planned(self, name, ft, qs: List[Query]) -> List[QueryResult]:
+        import time as _time
+
         plan_s: List[float] = []
         plans = []
         for q in qs:
@@ -544,9 +638,20 @@ class TpuDataStore:
             # per-query clock: the timeout budget and audited scan time
             # cover THIS query's resolve, not the whole batch's
             t_resolve = _time.perf_counter()
-            result = self._execute(name, ft, q, plan, t_resolve, pending)
-            if self.audit_writer is not None or self.metrics is not None:
-                self._audit(name, q, plan, result, t_resolve - dt, t_resolve)
+            root = trace.NOOP
+            try:
+                with trace.span(
+                    "query", force=self.slow_query_s is not None,
+                    type=name, batched=True,
+                ) as root:
+                    result = self._execute(name, ft, q, plan, t_resolve, pending)
+                    if root.recording:
+                        root.set_attr("hits", len(result))
+                        root.set_attr("scan_path", self._collect_scan_path(plan))
+                    if self.audit_writer is not None or self.metrics is not None:
+                        self._audit(name, q, plan, result, t_resolve - dt, t_resolve)
+            finally:
+                self._log_slow_query(name, plan, root)
             results.append(result)
         return results
 
@@ -583,8 +688,30 @@ class TpuDataStore:
                     scanning_ms=1000 * (now - t_planned),
                     hits=len(result),
                     scan_path=self._collect_scan_path(plan),
+                    # called inside the query's root span: the audit row
+                    # and the exported trace tree join on this id
+                    trace_id=trace.current_trace_id() or "",
                 )
             )
+
+    def _log_slow_query(self, name: str, plan, root) -> None:
+        """Threshold slow-query log: any query over ``slow_query_s``
+        dumps its full span tree + the plan explain (the per-query
+        "why was this one slow" answer the aggregate timers can't give).
+        ``root`` is real whenever a budget is set (query() forces it)."""
+        import logging as _logging
+
+        if self.slow_query_s is None or not root.recording:
+            return
+        if root.duration_ms < self.slow_query_s * 1000.0:
+            return
+        _logging.getLogger("geomesa_tpu.slowquery").warning(
+            "slow query type=%s trace=%s took %.1fms (budget %.0fms)\n%s\n"
+            "explain:\n%s",
+            name, root.trace_id, root.duration_ms,
+            self.slow_query_s * 1000.0, root.render(),
+            plan.explain if plan is not None else "<planning failed>",
+        )
 
     def _execute(
         self, name, ft, query: Query, plan: QueryPlan, t_scan_start, pending=None
@@ -605,9 +732,10 @@ class TpuDataStore:
                 parts.extend(
                     self._scan_parts(name, ft, query, arm, t_scan_start, pending)
                 )
-            columns = self._columns_from_parts(ft, query, parts)
-            columns = _dedupe_by_fid(_materialize(columns))
-            return self._finish(ft, query, plan, columns)
+            with trace.span("query.assemble"):
+                columns = self._columns_from_parts(ft, query, parts)
+                columns = _dedupe_by_fid(_materialize(columns))
+                return self._finish(ft, query, plan, columns)
 
         tables = self._tables[name]
         table = tables[plan.index.name]
@@ -673,13 +801,18 @@ class TpuDataStore:
                 return QueryResult(ft, _empty_columns(ft), plan, {"stats": stat})
 
         parts = self._scan_parts(name, ft, query, plan, t_scan_start, pending)
-        columns = self._columns_from_parts(ft, query, parts)
-        # NO xz dedupe: unlike the reference's sharded XZ tables
-        # (QueryPlanner.scala:83-85 dedupes multi-row extent features),
-        # this layout writes exactly ONE row per feature per index, and
-        # expand_intervals dedupes overlapping range hits within a block —
-        # so extent results stay lazy like point results
-        return self._finish(ft, query, plan, columns)
+        # result assembly (column projection, dedupe, sort/limit,
+        # transforms) spans as its own stage so per-query self-times sum
+        # to the audited wall — scan time vs materialization time is
+        # exactly the split perf work needs
+        with trace.span("query.assemble"):
+            columns = self._columns_from_parts(ft, query, parts)
+            # NO xz dedupe: unlike the reference's sharded XZ tables
+            # (QueryPlanner.scala:83-85 dedupes multi-row extent features),
+            # this layout writes exactly ONE row per feature per index, and
+            # expand_intervals dedupes overlapping range hits within a block —
+            # so extent results stay lazy like point results
+            return self._finish(ft, query, plan, columns)
 
     def _columns_from_parts(self, ft, query: Query, parts: List[tuple]):
         """Light (block, rows) parts -> LazyColumns exposing the query's
@@ -751,38 +884,45 @@ class TpuDataStore:
         KryoBufferSimpleFeature lazy-read analog)."""
         tables = self._tables[name]
         table = tables[plan.index.name]
-        if pending is not None and id(plan) in pending:
-            scan = pending[id(plan)]  # pre-dispatched (query_many pipeline)
-        else:
-            scan = self.executor.scan_candidates(table, plan)
-        device_scan = scan is not None
-        # audited execution-path label (the reference audits plan/scan
-        # timings; WHICH path answered is the extra operators need when
-        # cost gates flip between host and device)
-        plan.scan_path = _scan_label(scan)
-        try:
-            return self._consume_scan(
-                ft, query, plan, table, scan, device_scan, t_scan_start
-            )
-        except Exception as e:
-            from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
-
-            if not device_scan or isinstance(e, QueryTimeout):
-                raise
-            # an executor scan died mid-resolution (device fetch / native
-            # seek failure): degrade THIS query to the host table scan —
-            # identical results, since the host path evaluates the full
-            # filter — and let the executor rebuild its mirror. The
-            # timeout clock keeps running across the rerun.
-            degrade = getattr(self.executor, "degrade", None)
-            if degrade is not None:
-                degrade(table, e)
+        with trace.span("scan", index=plan.index.name) as sp:
+            if pending is not None and id(plan) in pending:
+                scan = pending[id(plan)]  # pre-dispatched (query_many pipeline)
             else:
-                robustness_metrics().inc("degrade.device_to_host")
-            plan.scan_path = "host-table-degraded"
-            return self._consume_scan(
-                ft, query, plan, table, None, False, t_scan_start
-            )
+                scan = self.executor.scan_candidates(table, plan)
+            device_scan = scan is not None
+            # audited execution-path label (the reference audits plan/scan
+            # timings; WHICH path answered is the extra operators need when
+            # cost gates flip between host and device)
+            plan.scan_path = _scan_label(scan)
+            sp.set_attr("scan_path", plan.scan_path)
+            try:
+                return self._consume_scan(
+                    ft, query, plan, table, scan, device_scan, t_scan_start
+                )
+            except Exception as e:
+                from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
+
+                if not device_scan or isinstance(e, QueryTimeout):
+                    raise
+                # an executor scan died mid-resolution (device fetch / native
+                # seek failure): degrade THIS query to the host table scan —
+                # identical results, since the host path evaluates the full
+                # filter — and let the executor rebuild its mirror. The
+                # timeout clock keeps running across the rerun.
+                degrade = getattr(self.executor, "degrade", None)
+                if degrade is not None:
+                    degrade(table, e)  # emits the degrade span event + counters
+                else:
+                    robustness_metrics().inc("degrade.device_to_host")
+                    trace.event(
+                        "degrade.device_to_host",
+                        reason=f"{type(e).__name__}: {e}",
+                    )
+                plan.scan_path = "host-table-degraded"
+                sp.set_attr("scan_path", plan.scan_path)
+                return self._consume_scan(
+                    ft, query, plan, table, None, False, t_scan_start
+                )
 
     def _consume_scan(
         self, ft, query: Query, plan: QueryPlan, table, scan, device_scan,
@@ -843,26 +983,27 @@ class TpuDataStore:
                 raise QueryTimeout(
                     f"query exceeded {self.query_timeout_s}s (geomesa.query.timeout analog)"
                 )
-            if covered is not None and pf_props is not None:
-                rows = self._filter_block_covered(
-                    ft, plan, block, rows, covered, age_cutoff, pf_props
-                )
+            with trace.span("scan.block", rows_in=len(rows)) as bsp:
+                if covered is not None and pf_props is not None:
+                    rows = self._filter_block_covered(
+                        ft, plan, block, rows, covered, age_cutoff, pf_props
+                    )
+                else:
+                    alive = self._age_off_keep(ft, block, rows, age_cutoff)
+                    if alive is not None:
+                        rows = rows[alive]
+                    if pf_props is not None and len(rows):
+                        fcols = self._gather_filter_cols(block, rows, pf_props)
+                        with trace.span("scan.post_filter", rows=len(rows)):
+                            mask = self.executor.post_filter(ft, plan, fcols)
+                        if not mask.all():
+                            rows = rows[mask]
+                    vmask = self._visibility_keep(block, rows)
+                    if vmask is not None:
+                        rows = rows[vmask]
+                bsp.set_attr("rows_out", len(rows))
                 if len(rows):
                     parts.append((block, rows))
-                continue
-            alive = self._age_off_keep(ft, block, rows, age_cutoff)
-            if alive is not None:
-                rows = rows[alive]
-            if pf_props is not None and len(rows):
-                fcols = self._gather_filter_cols(block, rows, pf_props)
-                mask = self.executor.post_filter(ft, plan, fcols)
-                if not mask.all():
-                    rows = rows[mask]
-            vmask = self._visibility_keep(block, rows)
-            if vmask is not None:
-                rows = rows[vmask]
-            if len(rows):
-                parts.append((block, rows))
         return parts
 
     def _age_off_keep(self, ft, block, rows, age_cutoff):
@@ -942,7 +1083,8 @@ class TpuDataStore:
         if len(uncov_idx):
             rows_u = rows[uncov_idx]
             fcols = self._gather_filter_cols(block, rows_u, pf_props)
-            keep[uncov_idx] = self.executor.post_filter(ft, plan, fcols)
+            with trace.span("scan.post_filter", rows=len(rows_u)):
+                keep[uncov_idx] = self.executor.post_filter(ft, plan, fcols)
         if plan.secondary is not None:
             cov_idx = np.flatnonzero(covered)
             if len(cov_idx):
@@ -1027,15 +1169,23 @@ class TpuDataStore:
         IteratorCache analog (iterators/IteratorCache.scala:1-97)."""
         from geomesa_tpu.filter.parser import to_cql
 
-        versions = tuple(t.version for t in self._tables[name].values())
-        key = (name, to_cql(query.filter), versions)
-        # LRU: hits move to the back, the oldest entry is evicted when full
-        plan = self._plan_cache.pop(key, None)
-        if plan is None:
-            plan = self.planner(name).plan(query)
-            if len(self._plan_cache) >= 256:
-                self._plan_cache.pop(next(iter(self._plan_cache)))
-        self._plan_cache[key] = plan
+        with trace.span("query.plan") as sp:
+            versions = tuple(t.version for t in self._tables[name].values())
+            key = (name, to_cql(query.filter), versions)
+            # LRU: hits move to the back, the oldest entry is evicted when full
+            plan = self._plan_cache.pop(key, None)
+            if plan is None:
+                sp.set_attr("cache", "miss")
+                plan = self.planner(name).plan(query)
+                if len(self._plan_cache) >= 256:
+                    self._plan_cache.pop(next(iter(self._plan_cache)))
+            elif sp.recording:
+                # cache hit: no planner child span, so the hit carries the
+                # cached plan's provenance itself
+                sp.set_attr("cache", "hit")
+                sp.set_attr("index", plan.index.name)
+                sp.set_attr("explain", plan.explain)
+            self._plan_cache[key] = plan
         return plan
 
 

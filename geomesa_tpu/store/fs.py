@@ -45,7 +45,7 @@ from geomesa_tpu.store.integrity import (
 )
 from geomesa_tpu.store.metadata import FileMetadata
 from geomesa_tpu.store.partitions import PartitionScheme, from_config, parse_scheme
-from geomesa_tpu.utils import faults
+from geomesa_tpu.utils import faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 _EXTS = (".npz", ".parquet")
@@ -160,37 +160,42 @@ class FsDataStore(TpuDataStore):
         observe = self.stats is None or not self.stats.has_persisted(name)
         was_loading = self._loading
         self._loading = True  # suppress re-persisting replayed blocks
+        # the replay loop spans as one unit (per-block fs.block_read spans
+        # nest inside): a lazy store's first query shows exactly what the
+        # partition load cost it
+        span = trace.span("fs.load", type=name, n_files=len(todo))
         try:
-            for rel in todo:
-                loaded.add(rel)
-                path = os.path.join(self._type_dir(name), rel)
-                if rel.endswith(".parquet") and _parquet_disjoint(
-                    path, ft, filt, *_stat_attrs(ft, self._schemes.get(name))
-                ):
-                    # statistics pushdown: the file can't contain matches;
-                    # leave it unloaded so a later, broader query reads it
-                    loaded.discard(rel)
-                    continue
-                try:
-                    cols = _read_block(path, ft)
-                except CorruptFileError:
-                    # torn/corrupt block: move it aside and keep serving
-                    # the rest of the store (the quarantine counter in
-                    # robustness_metrics records the loss)
-                    quarantine(path)
-                    loaded.discard(rel)
-                    self._files[name] = [
-                        f for f in self._files[name] if f != rel
-                    ]
-                    continue
-                if "__vis__" in cols and self.metadata.read(name, "geomesa.vis") != "true":
-                    # legacy store: learn visibility presence during replay
-                    self.metadata.insert(name, "geomesa.vis", "true")
-                super()._insert_columns(ft, cols, observe_stats=observe)
-            # tombstones may cover rows in just-loaded blocks
-            fids = self._stored_tombstones(name)
-            if fids:
-                super().delete_features(name, fids)
+            with span:
+                for rel in todo:
+                    loaded.add(rel)
+                    path = os.path.join(self._type_dir(name), rel)
+                    if rel.endswith(".parquet") and _parquet_disjoint(
+                        path, ft, filt, *_stat_attrs(ft, self._schemes.get(name))
+                    ):
+                        # statistics pushdown: the file can't contain matches;
+                        # leave it unloaded so a later, broader query reads it
+                        loaded.discard(rel)
+                        continue
+                    try:
+                        cols = _read_block(path, ft)
+                    except CorruptFileError:
+                        # torn/corrupt block: move it aside and keep serving
+                        # the rest of the store (the quarantine counter in
+                        # robustness_metrics records the loss)
+                        quarantine(path)
+                        loaded.discard(rel)
+                        self._files[name] = [
+                            f for f in self._files[name] if f != rel
+                        ]
+                        continue
+                    if "__vis__" in cols and self.metadata.read(name, "geomesa.vis") != "true":
+                        # legacy store: learn visibility presence during replay
+                        self.metadata.insert(name, "geomesa.vis", "true")
+                    super()._insert_columns(ft, cols, observe_stats=observe)
+                # tombstones may cover rows in just-loaded blocks
+                fids = self._stored_tombstones(name)
+                if fids:
+                    super().delete_features(name, fids)
         finally:
             self._loading = was_loading
 
@@ -207,16 +212,12 @@ class FsDataStore(TpuDataStore):
 
     # -- query surface (prune before planning) -------------------------------
 
-    def query(self, name: str, query="INCLUDE"):
-        q = self._as_query(query)
-        self._ensure_loaded(name, q.filter)
-        return super().query(name, q)
-
-    def query_many(self, name: str, queries):
-        qs = [self._as_query(q) for q in queries]
-        for q in qs:
-            self._ensure_loaded(name, q.filter)
-        return super().query_many(name, qs)
+    def _prepare_query(self, name: str, query) -> None:
+        # the base store calls this inside the query's root span (or the
+        # batch's query.batch root), so a lazy store's partition replay
+        # attributes to the query/batch that forced it (the fs.load span
+        # + per-block fs.block_read children)
+        self._ensure_loaded(name, query.filter)
 
     def explain(self, name: str, query) -> str:
         q = self._as_query(query)
@@ -369,8 +370,11 @@ def _geom_attrs(ft: FeatureType) -> Set[str]:
 def _write_block(path: str, ft: FeatureType, columns: Columns, fmt: str) -> None:
     """Persist one block durably: tmp write + CRC footer (npz; parquet's
     own footer already detects truncation) + fsync + rename, with
-    transient write failures retried (the whole attempt re-runs)."""
-    _BLOCK_WRITE_RETRY.call(_write_block_once, path, ft, columns, fmt)
+    transient write failures retried (the whole attempt re-runs). The
+    span wraps the whole retried write, so a trace shows the block's
+    true end-to-end persistence cost including absorbed retries."""
+    with trace.span("fs.block_write", path=path):
+        _BLOCK_WRITE_RETRY.call(_write_block_once, path, ft, columns, fmt)
 
 
 def _write_block_once(path: str, ft: FeatureType, columns: Columns, fmt: str) -> None:
@@ -411,8 +415,11 @@ def _write_block_once(path: str, ft: FeatureType, columns: Columns, fmt: str) ->
 def _read_block(path: str, ft: FeatureType) -> Columns:
     """Deserialize one block. Transient read failures (OSError) retry;
     corruption — CRC mismatch, or content the codec cannot decode —
-    raises CorruptFileError for the caller to quarantine."""
-    return _BLOCK_READ_RETRY.call(_read_block_once, path, ft)
+    raises CorruptFileError for the caller to quarantine. Span-wrapped
+    like the write side: per-block load time (lazy-store replay included)
+    attributes to the query that forced the load."""
+    with trace.span("fs.block_read", path=path):
+        return _BLOCK_READ_RETRY.call(_read_block_once, path, ft)
 
 
 def _read_block_once(path: str, ft: FeatureType) -> Columns:

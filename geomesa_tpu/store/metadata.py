@@ -20,7 +20,7 @@ from geomesa_tpu.store.integrity import (
     quarantine,
     read_verified,
 )
-from geomesa_tpu.utils import faults
+from geomesa_tpu.utils import faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 
@@ -98,7 +98,8 @@ class FileMetadata(Metadata):
                 self._data = {}
 
     def _flush(self):
-        self._SAVE_RETRY.call(self._flush_once)
+        with trace.span("metadata.save", path=self.path):
+            self._SAVE_RETRY.call(self._flush_once)
 
     def _flush_once(self):
         faults.fault_point("metadata.save")

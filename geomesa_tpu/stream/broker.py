@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Tuple
 
-from geomesa_tpu.utils import faults
+from geomesa_tpu.utils import faults, trace
 
 
 class InProcessBroker:
@@ -46,17 +46,19 @@ class InProcessBroker:
         Returns [(partition, offset, payload)]; caller advances its
         offsets. ``partitions`` restricts to an assignment subset.
         """
-        faults.fault_point("broker.poll")
-        out: List[Tuple[int, int, bytes]] = []
-        logs = self._topic(topic)
-        with self._lock:
-            for p, log in enumerate(logs):
-                if partitions is not None and p not in partitions:
-                    continue
-                start = offsets.get(p, 0)
-                for i in range(start, min(len(log), start + max_records)):
-                    out.append((p, i, log[i]))
-        return out
+        with trace.span("broker.poll", topic=topic) as sp:
+            faults.fault_point("broker.poll")
+            out: List[Tuple[int, int, bytes]] = []
+            logs = self._topic(topic)
+            with self._lock:
+                for p, log in enumerate(logs):
+                    if partitions is not None and p not in partitions:
+                        continue
+                    start = offsets.get(p, 0)
+                    for i in range(start, min(len(log), start + max_records)):
+                        out.append((p, i, log[i]))
+            sp.set_attr("records", len(out))
+            return out
 
     def end_offsets(self, topic: str) -> Dict[int, int]:
         logs = self._topic(topic)

@@ -27,7 +27,7 @@ import os
 import struct
 from typing import Dict, List, Optional, Tuple
 
-from geomesa_tpu.utils import faults
+from geomesa_tpu.utils import faults, trace
 
 _LEN = struct.Struct("<I")
 
@@ -133,6 +133,12 @@ class FileLogBroker:
         ``partitions`` restricts the fetch to an assignment subset (the
         consumer-group partition-assignment contract: cooperating
         consumers split a topic's partitions disjointly)."""
+        with trace.span("broker.poll", topic=topic) as sp:
+            out = self._poll_once(topic, offsets, max_records, partitions)
+            sp.set_attr("records", len(out))
+            return out
+
+    def _poll_once(self, topic, offsets, max_records, partitions):
         faults.fault_point("broker.poll")
         out: List[Tuple[int, int, bytes]] = []
         for p in partitions if partitions is not None else range(self.partitions):

@@ -36,7 +36,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from geomesa_tpu.stream.filelog import FileLogBroker, FileOffsetManager
-from geomesa_tpu.utils import faults
+from geomesa_tpu.utils import faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 _LEN = struct.Struct("<I")
@@ -100,6 +100,16 @@ class _Handler(socketserver.BaseRequestHandler):
             sock.close()
 
     def _dispatch(self, server, broker, sock, head) -> None:
+        # broker-side work correlates with the caller: the trace id
+        # carried in the message envelope keys this (server-root) span,
+        # so client and broker trees join on one id
+        with trace.span(
+            f"netlog.server.{head.get('op', 'unknown')}",
+            trace_id=head.get("trace"),
+        ):
+            self._dispatch_op(server, broker, sock, head)
+
+    def _dispatch_op(self, server, broker, sock, head) -> None:
         op = head.get("op")
         if op == "send":
             payload = _recv_msg(sock)
@@ -269,27 +279,36 @@ class RemoteLogBroker:
 
     def _attempt(self, head: dict, payload: Optional[bytes]):
         """One full request/response exchange; any transport failure
-        drops the cached socket so the next attempt redials."""
-        try:
-            sock = self._connect()
-            faults.fault_point("netlog.rpc")
-            _send_msg(sock, json.dumps(head).encode())
-            if payload is not None:
-                _send_msg(sock, payload)
-            resp = json.loads(_recv_msg(sock).decode())
-            if resp.get("ok") != 1:
-                raise RuntimeError(
-                    f"broker error: {resp.get('error', 'unknown')}"
-                )
-            if head["op"] == "poll":
-                blob = _recv_msg(sock)
-                return resp, blob
-            return resp, b""
-        except OSError:
-            self.close()
-            raise
+        drops the cached socket so the next attempt redials. Each
+        attempt is its own ``netlog.rpc`` span, so a trace shows retries
+        as sibling spans (the failed ones carry error events)."""
+        with trace.span("netlog.rpc", op=str(head.get("op", ""))):
+            try:
+                sock = self._connect()
+                faults.fault_point("netlog.rpc")
+                _send_msg(sock, json.dumps(head).encode())
+                if payload is not None:
+                    _send_msg(sock, payload)
+                resp = json.loads(_recv_msg(sock).decode())
+                if resp.get("ok") != 1:
+                    raise RuntimeError(
+                        f"broker error: {resp.get('error', 'unknown')}"
+                    )
+                if head["op"] == "poll":
+                    blob = _recv_msg(sock)
+                    return resp, blob
+                return resp, b""
+            except OSError:
+                self.close()
+                raise
 
     def _rpc(self, head: dict, payload: Optional[bytes] = None):
+        # trace correlation across the wire: the client's trace id rides
+        # in the message envelope so broker-side spans join this query's
+        # tree (heads are built fresh per call — safe to annotate)
+        tid = trace.current_trace_id()
+        if tid:
+            head.setdefault("trace", tid)
         with self._lock:
             if head.get("op") in _IDEMPOTENT_OPS or self.at_least_once:
                 return self._retry.call(self._attempt, head, payload)

@@ -29,6 +29,7 @@ from geomesa_tpu.stream.messages import (
     Delete,
     GeoMessageSerializer,
 )
+from geomesa_tpu.utils import trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 
@@ -180,36 +181,40 @@ class StreamDataStore:
         self._listeners[name].append(fn)
 
     def poll(self, name: str) -> int:
-        """Drain new records into the cache; returns records consumed."""
+        """Drain new records into the cache; returns records consumed.
+        One ``stream.poll`` span per drain (fetch + apply + commit); the
+        broker's own fetch nests inside as ``broker.poll``."""
         ser = self._serializers[name]
         cache = self._caches[name]
         offsets = self._offsets[name]
-        if isinstance(getattr(self.broker, "_retry", None), RetryPolicy):
-            # RemoteLogBroker already retries its RPCs internally —
-            # stacking a second policy would multiply attempts and
-            # double-count retries in the robustness metrics
-            records = self.broker.poll(
-                name, offsets, partitions=self.assigned_partitions
-            )
-        else:
-            records = self._poll_retry.call(
-                self.broker.poll, name, offsets,
-                partitions=self.assigned_partitions,
-            )
-        for p, off, payload in records:
-            msg = ser.deserialize(payload)
-            if isinstance(msg, CreateOrUpdate):
-                cache.put(msg.fid, msg.values, msg.ts_ms, origin=(p, off))
-            elif isinstance(msg, Delete):
-                cache.remove(msg.fid)
+        with trace.span("stream.poll", type=name) as sp:
+            if isinstance(getattr(self.broker, "_retry", None), RetryPolicy):
+                # RemoteLogBroker already retries its RPCs internally —
+                # stacking a second policy would multiply attempts and
+                # double-count retries in the robustness metrics
+                records = self.broker.poll(
+                    name, offsets, partitions=self.assigned_partitions
+                )
             else:
-                cache.clear()
-            offsets[p] = off + 1
-            for fn in self._listeners[name]:
-                fn(msg)
-        if records and self.offset_manager is not None:
-            self.offset_manager.commit(name, offsets)
-        cache.expire(self.clock())
+                records = self._poll_retry.call(
+                    self.broker.poll, name, offsets,
+                    partitions=self.assigned_partitions,
+                )
+            for p, off, payload in records:
+                msg = ser.deserialize(payload)
+                if isinstance(msg, CreateOrUpdate):
+                    cache.put(msg.fid, msg.values, msg.ts_ms, origin=(p, off))
+                elif isinstance(msg, Delete):
+                    cache.remove(msg.fid)
+                else:
+                    cache.clear()
+                offsets[p] = off + 1
+                for fn in self._listeners[name]:
+                    fn(msg)
+            if records and self.offset_manager is not None:
+                self.offset_manager.commit(name, offsets)
+            cache.expire(self.clock())
+            sp.set_attr("records", len(records))
         return len(records)
 
     def cache(self, name: str) -> FeatureCache:

@@ -34,6 +34,10 @@ class QueryEvent:
     # device-batch-dual / ... ; "+"-joined for union plans) — the extra
     # the reference's QueryEvent lacks but cost-gated execution needs
     scan_path: str = ""
+    # trace correlation: the id of the span tree this query produced
+    # (utils/trace.py), "" when the query ran untraced — audit rows and
+    # /debug/traces join on it
+    trace_id: str = ""
 
 
 class AuditWriter:
@@ -72,19 +76,66 @@ class LoggingAuditWriter(AuditWriter):
         )
 
 
+def histogram_summary(vals: List[float], total_count: Optional[int] = None) -> Dict[str, Any]:
+    """Percentile summary of raw timer samples (seconds) -> ms leaves.
+
+    Nearest-rank percentiles over the sorted reservoir: p50 keeps the
+    historical ``arr[n // 2]`` (int(0.5 * n) == n // 2), and the tail
+    quantiles (p90/p95/p99) are what latency budgets are written
+    against — a mean/max pair hides exactly the stalls a per-stage
+    tracer is meant to attribute. ``total_count`` is the CUMULATIVE
+    update count (the reservoir is a sliding window; monotone consumers
+    like Prometheus rate() must see the true total)."""
+    arr = sorted(vals)
+    n = len(arr)
+
+    def q(p: float) -> float:
+        return arr[min(n - 1, int(p * n))]
+
+    return {
+        "count": n if total_count is None else total_count,
+        "mean_ms": 1000 * sum(arr) / n,
+        "p50_ms": 1000 * q(0.50),
+        "p90_ms": 1000 * q(0.90),
+        "p95_ms": 1000 * q(0.95),
+        "p99_ms": 1000 * q(0.99),
+        "max_ms": 1000 * arr[-1],
+    }
+
+
 class MetricsRegistry:
-    """Counters + timers with a snapshot report (Dropwizard registry role)."""
+    """Counters + gauges + timers with a snapshot report (Dropwizard
+    registry role). Timers report percentile summaries
+    (histogram_summary); gauges are either set values or zero-arg
+    callables sampled at snapshot time."""
 
     _RESERVOIR = 4096  # bounded per-timer samples (ring, like the audit sink)
 
     def __init__(self):
         self._counters: Dict[str, int] = {}
         self._timers: Dict[str, List[float]] = {}
+        # cumulative (count, sum_s) per timer: the reservoir above is a
+        # sliding window, but monotone consumers (Prometheus _count/_sum,
+        # rate() dashboards) need totals that never move backwards
+        self._timer_totals: Dict[str, List[float]] = {}
+        self._gauges: Dict[str, float] = {}
+        self._gauge_fns: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge_fn(self, name: str, fn) -> None:
+        """Register a zero-arg callable sampled on every snapshot (cache
+        sizes, queue depths — state that is cheaper to read than to
+        maintain incrementally)."""
+        with self._lock:
+            self._gauge_fns[name] = fn
 
     def update_timer(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -92,6 +143,9 @@ class MetricsRegistry:
             vals.append(seconds)
             if len(vals) > self._RESERVOIR:
                 del vals[: len(vals) - self._RESERVOIR]
+            tot = self._timer_totals.setdefault(name, [0, 0.0])
+            tot[0] += 1
+            tot[1] += seconds
 
     def timer(self, name: str):
         registry = self
@@ -106,19 +160,44 @@ class MetricsRegistry:
 
         return _Ctx()
 
-    def report(self) -> Dict[str, Any]:
+    def snapshot(self):
+        """(counters, gauges, {timer: raw samples}, {timer: (count, sum_s)})
+        — every collection COPIED under the lock, so concurrent
+        inc/update_timer during a report can never mutate what a reporter
+        is iterating. Timer samples are the sliding reservoir (percentile
+        material); the totals are cumulative. Gauge callables are sampled
+        OUTSIDE the lock (a gauge that reads another registry must not
+        deadlock); a failing gauge is skipped rather than failing the
+        snapshot."""
         with self._lock:
-            out: Dict[str, Any] = dict(self._counters)
-            for name, vals in self._timers.items():
-                arr = sorted(vals)
-                n = len(arr)
-                out[name] = {
-                    "count": n,
-                    "mean_ms": 1000 * sum(arr) / n,
-                    "p50_ms": 1000 * arr[n // 2],
-                    "max_ms": 1000 * arr[-1],
-                }
-            return out
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            gauge_fns = list(self._gauge_fns.items())
+            timers = {name: list(vals) for name, vals in self._timers.items()}
+            totals = {
+                name: (int(c), float(s))
+                for name, (c, s) in self._timer_totals.items()
+            }
+        for name, fn in gauge_fns:
+            try:
+                gauges[name] = float(fn())
+            except Exception:  # noqa: BLE001 - telemetry must not raise
+                logging.getLogger("geomesa_tpu.audit").exception(
+                    "gauge %r failed", name
+                )
+        return counters, gauges, timers, totals
+
+    def report(self) -> Dict[str, Any]:
+        counters, gauges, timers, totals = self.snapshot()
+        out: Dict[str, Any] = counters
+        out.update(gauges)
+        for name, vals in timers.items():
+            if not vals:  # a registered-but-never-updated timer: no math on it
+                continue
+            out[name] = histogram_summary(
+                vals, total_count=totals.get(name, (None,))[0]
+            )
+        return out
 
 
 _ROBUSTNESS: Optional[MetricsRegistry] = None
@@ -183,7 +262,16 @@ class Reporter:
         def tick():
             if self._stopped:  # stop() raced an in-flight fire
                 return
-            self.report_now()
+            try:
+                self.report_now()
+            except Exception:  # noqa: BLE001 - one bad emit must not kill the loop
+                # an emit() that raises (sink down, disk full) used to
+                # skip schedule() and silently end the periodic loop
+                # forever; log and keep the cadence — the next interval
+                # retries against a possibly-recovered sink
+                logging.getLogger("geomesa_tpu.audit").exception(
+                    "%s emit failed; reporting continues", type(self).__name__
+                )
             schedule()
 
         def schedule():
@@ -381,6 +469,103 @@ class GangliaReporter(Reporter):
             sock.close()
 
 
+def _prom_name(name: str, prefix: str = "geomesa") -> str:
+    """Metric name -> Prometheus-legal name: dotted segments join with
+    underscores, anything outside [a-zA-Z0-9_:] flattens to ``_``."""
+    import re as _re
+
+    base = _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return f"{prefix}_{base}" if prefix else base
+
+
+def prometheus_text(registries, prefix: str = "geomesa") -> str:
+    """Text exposition (version 0.0.4) of one or more registries.
+
+    Counters render as ``counter``, gauges as ``gauge``, and timers as
+    ``summary`` families: quantile labels in SECONDS (the exposition
+    convention) from the sliding reservoir, ``_sum``/``_count`` from the
+    CUMULATIVE totals (summary semantics — rate()/increase() stay
+    monotone after the reservoir starts sliding), and a ``<name>_max``
+    gauge. Later registries win a name collision — callers merge the
+    store registry with ``robustness_metrics()`` so one scrape carries
+    both query latencies and the failure-path counters."""
+    if isinstance(registries, MetricsRegistry):
+        registries = [registries]
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    timers: Dict[str, List[float]] = {}
+    totals: Dict[str, tuple] = {}
+    for reg in registries:
+        c, g, t, tt = reg.snapshot()
+        counters.update(c)
+        gauges.update(g)
+        timers.update({k: v for k, v in t.items() if v})
+        totals.update(tt)
+    lines: List[str] = []
+    for name, v in sorted(counters.items()):
+        p = _prom_name(name, prefix)
+        lines.append(f"# TYPE {p} counter")
+        lines.append(f"{p} {float(v):g}")
+    for name, v in sorted(gauges.items()):
+        p = _prom_name(name, prefix)
+        lines.append(f"# TYPE {p} gauge")
+        lines.append(f"{p} {float(v):g}")
+    for name, vals in sorted(timers.items()):
+        p = _prom_name(name, prefix)
+        h = histogram_summary(vals)
+        cum_count, cum_sum = totals.get(name, (h["count"], sum(vals)))
+        lines.append(f"# TYPE {p} summary")
+        for label, key in (("0.5", "p50_ms"), ("0.9", "p90_ms"),
+                           ("0.95", "p95_ms"), ("0.99", "p99_ms")):
+            lines.append(f'{p}{{quantile="{label}"}} {h[key] / 1000:g}')
+        lines.append(f"{p}_sum {cum_sum:g}")
+        lines.append(f"{p}_count {cum_count}")
+        lines.append(f"# TYPE {p}_max gauge")
+        lines.append(f"{p}_max {h['max_ms'] / 1000:g}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusReporter(Reporter):
+    """Prometheus edition of the scheduled reporters: writes the text
+    exposition atomically to ``path`` on every interval (the
+    node-exporter textfile-collector pattern — a scraper or sidecar
+    reads the file). ``render()`` returns the same exposition on demand;
+    the live pull surface is ``GET /metrics`` on web.py, which calls
+    ``prometheus_text`` directly. ``extra_registries`` merge into every
+    exposition (robustness_metrics() by default, so failure-path
+    counters always ship alongside the store's timings)."""
+
+    def __init__(self, registry, path: str, interval_s: float = 60.0,
+                 prefix: str = "geomesa", extra_registries=None):
+        super().__init__(registry, interval_s)
+        self.path = path
+        self.prefix = prefix
+        self.extra_registries = (
+            list(extra_registries) if extra_registries is not None
+            else [robustness_metrics()]
+        )
+
+    def render(self) -> str:
+        return prometheus_text(
+            [self.registry] + self.extra_registries, prefix=self.prefix
+        )
+
+    def report_now(self) -> None:
+        # render() snapshots the registries itself (it must merge the
+        # extras); the base report() snapshot would only be thrown away —
+        # and would sample every gauge callable twice per tick
+        self.emit(None)
+
+    def emit(self, snapshot):
+        import os
+
+        text = self.render()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, self.path)
+
+
 def _host_port(url: str, default_port: int):
     """(host, port) from a reporter url — one parse for every network
     reporter: bracketed IPv6 ([::1]:2003), host:port, or bare host
@@ -404,8 +589,8 @@ def reporters_from_config(
     reporter names to ``{"type": ..., ...}`` blocks; invalid blocks warn
     and are skipped rather than failing the rest.
 
-    Types: console | slf4j | delimited-text | graphite | ganglia.
-    Common key: ``interval`` (seconds, default 60)."""
+    Types: console | slf4j | delimited-text | graphite | ganglia |
+    prometheus. Common key: ``interval`` (seconds, default 60)."""
     import warnings
 
     out = []
@@ -437,6 +622,11 @@ def reporters_from_config(
                     registry, host, port,
                     group=block.get("group", "geomesa"),
                     interval_s=interval,
+                )
+            elif typ == "prometheus":
+                r = PrometheusReporter(
+                    registry, block["output"], interval_s=interval,
+                    prefix=block.get("prefix", "geomesa"),
                 )
             else:
                 raise ValueError(f"unknown reporter type {typ!r}")

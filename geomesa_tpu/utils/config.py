@@ -124,6 +124,10 @@ class SystemProperty:
 # this execution model. Set the property/env to 2000 for reference parity.
 SCAN_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.target", "512")
 QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", None)
+# Slow-query budget: any query slower than this logs its FULL span tree
+# plus the plan explain (the audit-log "why was this one slow" answer;
+# duration string, e.g. '500 ms'). Unset = no slow-query log.
+SLOW_QUERY_THRESHOLD = SystemProperty("geomesa.query.slow.threshold", None)
 FEATURE_EXPIRY = SystemProperty("geomesa.feature.expiry", None)
 # Cold-column spill: when set, record-table columns larger than the
 # threshold are written to .npy files under this directory and re-opened

@@ -55,6 +55,7 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
+from geomesa_tpu.utils import trace
 from geomesa_tpu.utils.audit import robustness_metrics
 
 FAULT_POINTS = (
@@ -209,6 +210,10 @@ def fault_point(point: str) -> None:
         if rule is None:
             continue
         robustness_metrics().inc(f"fault.{point}.{rule.kind}")
+        # per-query attribution: the fired fault lands as an event on the
+        # affected query's span tree, joining the process-wide fault.*
+        # counters to the trace that suffered the injection
+        trace.event(f"fault.{point}.{rule.kind}")
         if rule.kind == "latency":
             time.sleep(rule.latency_s)
         elif rule.kind == "drop":
@@ -228,6 +233,7 @@ def maybe_tear(point: str, path: str) -> bool:
         if rule is None:
             continue
         robustness_metrics().inc(f"fault.{point}.torn")
+        trace.event(f"fault.{point}.torn", path=path)
         size = os.path.getsize(path)
         with open(path, "rb+") as fh:
             fh.truncate(max(0, size // 2))

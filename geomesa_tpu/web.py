@@ -8,9 +8,16 @@ SURVEY.md section 2.5). Endpoints over a datastore:
     GET /query?name=&cql=&format=geojson|csv&max=
     GET /stats/count?name=&cql=&exact=
     GET /stats/bounds?name=
+    GET /metrics                 -- Prometheus text exposition (store
+                                    registry + robustness counters)
+    GET /healthz                 -- liveness/readiness JSON
+    GET /debug/traces?n=         -- last n query span trees (JSON)
 
 Serves with the stdlib ThreadingHTTPServer — start with ``serve(store,
-port)`` or embed ``GeoMesaHandler`` elsewhere.
+port)`` or embed ``GeoMesaHandler`` elsewhere. Constructing the server
+installs the process trace debug ring (utils/trace.ensure_ring), so
+/debug/traces works out of the box; point real exporters at the tracer
+for anything longer-lived.
 """
 
 from __future__ import annotations
@@ -148,6 +155,56 @@ def make_handler(store):
                             json.dumps({"shape": list(grid.shape),
                                         "grid": grid.tolist()}),
                         )
+                elif route == "/metrics":
+                    # Prometheus scrape surface: the store's own registry
+                    # (query.plan/query.scan percentiles) merged with the
+                    # process-wide failure-path counters — one scrape
+                    # carries both (GeoMesaStatsEndpoint role, scrape-able)
+                    from geomesa_tpu.utils.audit import (
+                        MetricsRegistry,
+                        prometheus_text,
+                        robustness_metrics,
+                    )
+
+                    regs = []
+                    # duck-typed stores (e.g. a stream store) may carry
+                    # no registry; the robustness counters still serve
+                    if isinstance(getattr(store, "metrics", None), MetricsRegistry):
+                        regs.append(store.metrics)
+                    regs.append(robustness_metrics())
+                    self._send(
+                        200, prometheus_text(regs),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif route == "/healthz":
+                    # liveness + a cheap readiness probe: schema metadata
+                    # is readable and the registries respond (type_names
+                    # is a property on TpuDataStore, a method on the
+                    # stream store — accept both duck types)
+                    types = store.type_names
+                    if callable(types):
+                        types = types()
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "status": "ok",
+                                "store": type(store).__name__,
+                                "types": list(types),
+                            }
+                        ),
+                    )
+                elif route == "/debug/traces":
+                    from geomesa_tpu.utils import trace as _trace
+
+                    n = int(params.get("n", 20))
+                    self._send(
+                        200,
+                        json.dumps(
+                            [t.to_dict() for t in _trace.recent_traces(n)],
+                            default=str,
+                        ),
+                    )
                 elif route == "/stats/count":
                     name = params["name"]
                     exact = params.get("exact", "true").lower() != "false"
@@ -170,8 +227,12 @@ class GeoMesaServer:
     """Embeddable server; ``with GeoMesaServer(store) as url: ...``"""
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0):
+        from geomesa_tpu.utils import trace as _trace
+
+        _trace.ensure_ring()  # /debug/traces has a sink from the start
         self.httpd = ThreadingHTTPServer((host, port), make_handler(store))
         self.thread: Optional[threading.Thread] = None
+        self._ring_held = True
 
     @property
     def url(self) -> str:
@@ -184,10 +245,20 @@ class GeoMesaServer:
         return self.url
 
     def __exit__(self, *exc):
+        from geomesa_tpu.utils import trace as _trace
+
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self._ring_held:
+            # a short-lived embedded server must not leave the tracer
+            # active for the rest of the process (free-when-off contract)
+            self._ring_held = False
+            _trace.release_ring()
 
 
 def serve(store, host: str = "127.0.0.1", port: int = 8765) -> None:
+    from geomesa_tpu.utils import trace as _trace
+
+    _trace.ensure_ring()
     httpd = ThreadingHTTPServer((host, port), make_handler(store))
     httpd.serve_forever()

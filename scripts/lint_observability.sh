@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Static observability lint for geomesa_tpu/ (the tracing sibling of
+# lint_robustness.sh):
+#
+#   1. Span coverage — every named I/O / device boundary keeps its
+#      trace span next to its fault point. Device dispatch + fetch,
+#      block I/O, the netlog RPC, and the consumer poll loop must stay
+#      span-wrapped, so per-query traces never lose a boundary
+#      (ROADMAP invariant: every new I/O or device boundary gets a span).
+#   2. Fault/span pairing — any file that adds a fault_point() call must
+#      also open at least one trace span, so new boundaries cannot be
+#      chaos-tested without also being attributable per query.
+#
+# Exits non-zero with the offending boundary on any miss.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+fail=0
+
+# boundary -> file that must carry its span (point name == span name)
+declare -A SPANS=(
+    ["device.dispatch"]="geomesa_tpu/parallel/mesh.py"
+    ["device.fetch"]="geomesa_tpu/parallel/executor.py"
+    ["fs.block_read"]="geomesa_tpu/store/fs.py"
+    ["fs.block_write"]="geomesa_tpu/store/fs.py"
+    ["netlog.rpc"]="geomesa_tpu/stream/netlog.py"
+    ["broker.poll"]="geomesa_tpu/stream/filelog.py"
+    ["stream.poll"]="geomesa_tpu/stream/store.py"
+)
+for point in "${!SPANS[@]}"; do
+    file="${SPANS[$point]}"
+    if ! grep -qE "span\(\s*[\"']${point}[\"']" "$file"; then
+        echo "FAIL: boundary '${point}' in ${file} is not span-wrapped"
+        echo "      (expected trace.span(\"${point}\", ...) — see utils/trace.py)"
+        fail=1
+    fi
+done
+
+# every file instrumenting a fault point must also trace at least one span
+# (faults.py itself hosts the harness, not a boundary)
+while IFS= read -r f; do
+    [ "$f" = "geomesa_tpu/utils/faults.py" ] && continue
+    if ! grep -q 'trace\.span(' "$f"; then
+        echo "FAIL: ${f} calls faults.fault_point() but opens no trace span"
+        echo "      (new boundaries need both: inject-able AND attributable)"
+        fail=1
+    fi
+done < <(grep -rlE 'faults\.fault_point\(' --include='*.py' geomesa_tpu/ || true)
+
+if [ "$fail" -eq 0 ]; then
+    echo "observability lint clean"
+fi
+exit $fail

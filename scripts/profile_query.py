@@ -1,12 +1,14 @@
 """Phase-level profiler for the headline bench query path on live hardware.
 
-Breaks one bench-style query stream into:
-  plan       CQL parse + strategy + zranges (host)
-  dispatch   descriptor upload + jit dispatch (host->device, async)
-  device     kernel execution (block_until_ready on the RLE buffer)
-  transfer   device->host fetch of the fused count+runs buffer
-  decode     RLE run expansion -> sorted row indices
-  gather     block column gather + fid materialization (QueryResult build)
+Runs a bench-style query stream with the span-tree tracer installed
+(geomesa_tpu/utils/trace.py) and reports where the time went from the
+traces themselves — the same instrumentation production runs under, so
+the profile and the deployment can never disagree about phase
+boundaries:
+
+  * a per-span-name table (count, total/mean self-time, share of wall)
+    aggregated across the stream
+  * the full span tree of the slowest query
 
 Usage: GEOMESA_BENCH_N=... python scripts/profile_query.py
 """
@@ -14,13 +16,14 @@ Usage: GEOMESA_BENCH_N=... python scripts/profile_query.py
 import os
 import sys
 import time
+from collections import defaultdict
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# this profiler dissects the DEVICE dispatch protocol (_PendingHits et al);
-# the host-seek chooser would answer these plans without dispatching
+# this profiler dissects the DEVICE dispatch protocol; the host-seek
+# chooser would answer these plans without dispatching
 os.environ.setdefault("GEOMESA_SEEK", "0")
 
 import bench  # noqa: E402
@@ -30,12 +33,13 @@ def main():
     n = int(os.environ.get("GEOMESA_BENCH_N", 5_000_000))
     reps = int(os.environ.get("GEOMESA_BENCH_REPS", 8))
     x, y, t = bench.synthesize(n)
-    boxes, cqls = bench.make_queries(reps)
+    _boxes, cqls = bench.make_queries(reps)
 
     from geomesa_tpu.index.planner import Query
     from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
     from geomesa_tpu.schema.featuretype import parse_spec
     from geomesa_tpu.store.datastore import TpuDataStore
+    from geomesa_tpu.utils import trace
 
     store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
     ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
@@ -45,72 +49,45 @@ def main():
     store._insert_columns(ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t})
     print(f"ingest: {time.perf_counter() - t0:.1f}s ({n / (time.perf_counter() - t0):,.0f} rec/s)")
 
-    # warm (pack + compile)
+    # warm (pack + compile) BEFORE tracing so compile time doesn't pollute
     t0 = time.perf_counter()
     res = store.query("gdelt", bench.QUERY)
     print(f"warm: {time.perf_counter() - t0:.1f}s hits={len(res.fids)}")
 
     queries = [Query.cql(c, properties=[]) for c in cqls]
 
-    # ---- phase timing over the stream --------------------------------
-    phases = {k: 0.0 for k in ("plan", "dispatch", "device", "transfer", "decode", "gather")}
-    name = "gdelt"
-    plans = []
-    t0 = time.perf_counter()
-    for q in queries:
-        plans.append(store._plan_cached(name, q))
-    phases["plan"] = time.perf_counter() - t0
+    # ---- traced stream ------------------------------------------------
+    ring = trace.InMemoryTraceExporter(capacity=reps + 4)
+    with trace.exporting(ring):
+        t0 = time.perf_counter()
+        results = [store.query("gdelt", q) for q in queries]
+        total = time.perf_counter() - t0
+    roots = [r for r in ring.traces if r.name == "query"]
 
-    table = store._tables[name][plans[0].index.name]
-    scans = []
-    t0 = time.perf_counter()
-    for plan in plans:
-        scans.append(store.executor.dispatch_candidates(table, plan))
-    phases["dispatch"] = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    for scan in scans:
-        for seg, ph in scan.pending:
-            ph.buf.block_until_ready()
-    phases["device"] = time.perf_counter() - t0
-
-    bufs = []
-    t0 = time.perf_counter()
-    for scan in scans:
-        for seg, ph in scan.pending:
-            bufs.append(np.asarray(ph.buf))
-    phases["transfer"] = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    allrows = []
-    for scan in scans:
-        rows_per = []
-        for seg, ph in scan.pending:
-            rows_per.append((seg, ph.rows()))
-        allrows.append((scan, rows_per))
-    phases["decode"] = time.perf_counter() - t0
-
-    qftq = [store._as_query(q) for q in queries]
-    t0 = time.perf_counter()
-    results = []
-    for (scan, _), q, plan in zip(allrows, qftq, plans):
-        parts = store._scan_parts(name, ft, q, plan, time.perf_counter(), {id(plan): scan})
-        results.append(parts)
-    phases["gather"] = time.perf_counter() - t0
-
-    total = sum(phases.values())
+    per_name = defaultdict(lambda: [0, 0.0])  # name -> [count, self ms]
+    for root in roots:
+        for sp in root.walk():
+            per_name[sp.name][0] += 1
+            per_name[sp.name][1] += sp.self_time_ms
+    wall_ms = sum(r.duration_ms for r in roots)
     print(f"\nN={n:,} reps={reps} total={total:.3f}s  per-query={total / reps * 1000:.1f}ms")
-    for k, v in phases.items():
-        print(f"  {k:9s} {v / reps * 1000:8.2f} ms/query  ({100 * v / total:5.1f}%)")
+    print(f"  {'span':24s} {'count':>6s} {'self ms':>10s} {'ms/query':>9s} {'%':>6s}")
+    for name, (cnt, self_ms) in sorted(per_name.items(), key=lambda kv: -kv[1][1]):
+        print(
+            f"  {name:24s} {cnt:6d} {self_ms:10.2f} "
+            f"{self_ms / reps:9.2f} {100 * self_ms / max(wall_ms, 1e-9):5.1f}%"
+        )
 
-    # sanity: end-to-end query_many for comparison
+    slowest = max(roots, key=lambda r: r.duration_ms)
+    print(f"\nslowest query ({slowest.duration_ms:.1f}ms), span tree:")
+    print(slowest.render(indent=1))
+
+    # sanity: pipelined batch dispatch for comparison
     t0 = time.perf_counter()
-    store.query_many(name, queries)
+    store.query_many("gdelt", queries)
     e2e = time.perf_counter() - t0
-    print(f"query_many end-to-end: {e2e / reps * 1000:.1f} ms/query")
-
-    nhits = sum(len(r) for _, rp in allrows for __, r in rp) // reps
-    print(f"avg hits/query: {nhits:,}")
+    print(f"\nquery_many end-to-end: {e2e / reps * 1000:.1f} ms/query")
+    print(f"avg hits/query: {sum(len(r) for r in results) // reps:,}")
 
 
 if __name__ == "__main__":

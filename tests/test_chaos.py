@@ -182,6 +182,50 @@ def test_device_fault_degrades_to_host_with_parity(point, monkeypatch):
     assert sorted(dev.query("t", q).fids) == baseline
 
 
+@pytest.mark.parametrize("point", ["device.dispatch", "device.fetch"])
+def test_injected_fault_surfaces_as_span_event(point, monkeypatch):
+    """PR 1 tied injected faults to process-wide counters; the tracer
+    ties them to the query that suffered them: a fired fault appears as
+    a ``fault.<point>.<kind>`` event on the affected query's own trace,
+    next to the degradation event that answered it."""
+    from geomesa_tpu.utils import trace
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")  # force the device scan path
+    data = rows(n=200, seed=5)
+    dev = TpuDataStore(executor=TpuScanExecutor())
+    ingest(dev, data)
+    q = "BBOX(geom, -30, -30, 30, 30)"
+    baseline = sorted(dev.query("t", q).fids)  # warm mirror
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        with faults.inject(f"{point}:error=1.0"):
+            assert sorted(dev.query("t", q).fids) == baseline
+    root = ring.traces[-1]
+    events = [ev["name"] for sp in root.walk() for ev in sp.events]
+    assert f"fault.{point}.error" in events, root.render()
+    assert "degrade.device_to_host" in events, root.render()
+
+
+def test_fs_fault_lands_on_replaying_query_trace(tmp_path):
+    """Lazy-store replay edition: a block-read fault fired while a query
+    forces partition loads shows up on THAT query's trace (the fs.load /
+    fs.block_read spans carry it)."""
+    from geomesa_tpu.utils import trace
+
+    data = rows(n=120, seed=9)
+    root_dir = str(tmp_path / "fs")
+    ingest(FsDataStore(root_dir, flush_size=40), data)
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        with faults.inject("fs.block_read:latency=1.0"):
+            store = FsDataStore(root_dir, lazy=True)
+            store.query("t", "BBOX(geom, -20, -20, 20, 20)")
+    roots = [t for t in ring.traces if t.name == "query"]
+    assert roots, "query produced no trace"
+    events = [ev["name"] for sp in roots[-1].walk() for ev in sp.events]
+    assert "fault.fs.block_read.latency" in events, roots[-1].render()
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_query_many_parity_under_device_faults(seed, monkeypatch):
     """The pipelined batch-dispatch path degrades per batch: positional

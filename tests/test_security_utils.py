@@ -356,8 +356,8 @@ def test_ganglia_reporter_xdr_packets():
         return s, off + 4 + n + (-n % 4)
 
     metrics = {}
-    # 5 metrics (scan.hits + 4 timer leaves) x 2 packets each
-    for _ in range(10):
+    # 8 metrics (scan.hits + 7 timer histogram leaves) x 2 packets each
+    for _ in range(16):
         buf, _addr = srv.recvfrom(65536)
         (pid,) = struct.unpack_from("!I", buf, 0)
         host, off = xdr_str(buf, 4)
@@ -373,7 +373,7 @@ def test_ganglia_reporter_xdr_packets():
     srv.close()
     assert metrics["scan.hits"] == {"type": "double", "value": 42.0}
     assert metrics["plan.count"]["value"] == 1.0
-    assert {"plan.mean_ms", "plan.p50_ms", "plan.max_ms"} <= set(metrics)
+    assert {"plan.mean_ms", "plan.p50_ms", "plan.p99_ms", "plan.max_ms"} <= set(metrics)
 
     # fire-and-forget: closed port must not raise
     GangliaReporter(reg, "127.0.0.1", port).report_now()
