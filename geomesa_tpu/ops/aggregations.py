@@ -19,6 +19,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from geomesa_tpu.ops.filters import spatial_mask, temporal_mask
 from geomesa_tpu.parallel.mesh import DATA_AXIS
+from geomesa_tpu.utils.devstats import instrumented_jit
 
 
 def grid_snap_indices(
@@ -195,7 +196,7 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
 
     d = P(DATA_AXIS)
     r = P()
-    with_time = jax.jit(
+    with_time = instrumented_jit("density.time", 
         shard_map_fn(
             step,
             mesh,
@@ -204,7 +205,7 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
             check=not use_pallas,
         )
     )
-    no_time = jax.jit(
+    no_time = instrumented_jit("density.notime", 
         shard_map_fn(
             step_no_time,
             mesh,
@@ -348,7 +349,7 @@ def make_sharded_density_dual(
 
     d = P(DATA_AXIS)
     r = P()
-    with_time = jax.jit(
+    with_time = instrumented_jit("density_dual.time", 
         shard_map_fn(
             step,
             mesh,
@@ -357,7 +358,7 @@ def make_sharded_density_dual(
             check=not use_pallas,
         )
     )
-    no_time = jax.jit(
+    no_time = instrumented_jit("density_dual.notime", 
         shard_map_fn(
             step_no_time,
             mesh,

@@ -61,6 +61,7 @@ from geomesa_tpu.parallel.mesh import (
 )
 from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
 from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils.devstats import count_d2h, instrumented_jit, record_pad
 
 # initial hit-run capacity: 4096 runs * 8B = 32 KiB per segment transfer
 HIT_CAPACITY0 = 4096
@@ -290,7 +291,7 @@ def _runs_fn(kind: str, rcap: int, mode: str, mesh):
         def run(*args):
             return _runs_from_mask(mask(*args), rcap)
 
-        fn = jax.jit(run)
+        fn = instrumented_jit(f"runs.{kind}", run)
         _RUNS_FNS[key] = fn
     return fn
 
@@ -448,7 +449,7 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh,
         def run(*args):
             return _runs_from_mask(mask(*args), rcap)
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("exact_runs", run)
         _EXACT_RUNS_FNS[key] = fn
     return fn
 
@@ -468,7 +469,7 @@ def _exact_count_fn(has_time: bool, mode: str, mesh, attr=False):
         def run(*args):
             return jnp.sum(mask(*args), dtype=jnp.int32)
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("exact_count", run)
         _EXACT_COUNT_FNS[key] = fn
     return fn
 
@@ -504,7 +505,7 @@ def _exact_stat_hist_fn(has_time: bool, mode: str, mesh, u_pad: int):
             hist = jnp.diff(bounds)
             return jnp.concatenate([cnt[None], hist])
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("exact_stat_hist", run)
         _EXACT_STAT_FNS[key] = fn
     return fn
 
@@ -578,7 +579,7 @@ def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
             _, out = jax.lax.scan(step, 0, descs)
             return out
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("exact_runs_batch", run)
         _EXACT_RUNS_BATCH_FNS[key] = fn
     return fn
 
@@ -650,7 +651,7 @@ def _exact_packed_batch_fn(has_time: bool, rcap: int, sum_cap: int, q: int,
             )
             return jnp.concatenate([headers.reshape(-1), shared])
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("exact_packed_batch", run)
         _EXACT_PACKED_BATCH_FNS[key] = fn
     return fn
 
@@ -695,7 +696,7 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
             _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("exact_bitmap_batch", run)
         _EXACT_BITMAP_BATCH_FNS[key] = fn
     return fn
 
@@ -760,7 +761,7 @@ def _exact_shard_bitmap_batch_fn(has_time: bool, span_cap: int, q: int,
             out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
             check=False,
         )
-        fn = jax.jit(wrapped)
+        fn = instrumented_jit("exact_shard_bitmap_batch", wrapped)
         _EXACT_SHARD_BITMAP_FNS[key] = fn
     return fn
 
@@ -832,10 +833,18 @@ def _np_local(arr) -> np.ndarray:
     with trace.span("device.fetch", bytes=int(getattr(arr, "nbytes", 0))):
         faults.fault_point("device.fetch")
         if getattr(arr, "is_fully_addressable", True):
-            return np.asarray(arr)
-        out = np.zeros(arr.shape, dtype=arr.dtype)
-        for s in arr.addressable_shards:
-            out[s.index] = np.asarray(s.data)
+            out = np.asarray(arr)
+            fetched = int(getattr(arr, "nbytes", 0))
+        else:
+            out = np.zeros(arr.shape, dtype=arr.dtype)
+            fetched = 0
+            for s in arr.addressable_shards:
+                local = np.asarray(s.data)
+                out[s.index] = local
+                fetched += int(local.nbytes)  # only LOCAL shards crossed
+        # counted AFTER the read: a faulted fetch that degraded to the
+        # host scan moved nothing over the link
+        count_d2h(fetched)
         return out
 
 
@@ -1344,7 +1353,7 @@ def _xz_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
             _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("xz_bitmap_batch", run)
         _XZ_BITMAP_BATCH_FNS[key] = fn
     return fn
 
@@ -1396,7 +1405,7 @@ def _dual_shard_bitmap_batch_fn(kind: str, has_time: bool, span_cap: int,
             out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
             check=False,
         )
-        fn = jax.jit(wrapped)
+        fn = instrumented_jit(f"{kind}_shard_bitmap_batch", wrapped)
         _DUAL_SHARD_BITMAP_FNS[key] = fn
     return fn
 
@@ -1640,7 +1649,7 @@ def _poly_runs_fn(has_time: bool, rcap: int, mode: str, mesh, attr=False):
             hit, decided = mask(*args)
             return _xz_dual_runs(hit, decided, rcap)
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("poly_runs", run)
         _POLY_RUNS_FNS[key] = fn
     return fn
 
@@ -1664,7 +1673,7 @@ def _poly_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
             _, out = jax.lax.scan(step, 0, descs)
             return out
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("poly_runs_batch", run)
         _POLY_RUNS_BATCH_FNS[key] = fn
     return fn
 
@@ -1682,7 +1691,7 @@ def _poly_packed_fn(has_time: bool, mode: str, mesh, attr=False):
             hit, dec = mask(*args)
             return jnp.concatenate([jnp.packbits(hit), jnp.packbits(dec)])
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("poly_packed", run)
         _POLY_PACKED_FNS[key] = fn
     return fn
 
@@ -1707,7 +1716,7 @@ def _poly_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
             _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("poly_bitmap_batch", run)
         _POLY_BITMAP_BATCH_FNS[key] = fn
     return fn
 
@@ -1723,7 +1732,7 @@ def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh, attr=False):
             hit, decided = mask(*args)
             return _xz_dual_runs(hit, decided, rcap)
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("xz_runs", run)
         _XZ_RUNS_FNS[key] = fn
     return fn
 
@@ -1748,7 +1757,7 @@ def _xz_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
             _, out = jax.lax.scan(step, 0, descs)
             return out
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("xz_runs_batch", run)
         _XZ_RUNS_BATCH_FNS[key] = fn
     return fn
 
@@ -1764,7 +1773,7 @@ def _xz_packed_fn(has_time: bool, mode: str, mesh, attr=False):
             hit, decided = mask(*args)
             return jnp.concatenate([jnp.packbits(hit), jnp.packbits(decided)])
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("xz_packed", run)
         _XZ_PACKED_FNS[key] = fn
     return fn
 
@@ -1779,7 +1788,7 @@ def _exact_packed_fn(has_time: bool, mode: str, mesh, attr=False):
         def run(*args):
             return jnp.packbits(mask(*args))
 
-        fn = jax.jit(run)
+        fn = instrumented_jit("exact_packed", run)
         _EXACT_PACKED_FNS[key] = fn
     return fn
 
@@ -1831,9 +1840,9 @@ def _knn_fn(k: int, mode: str, mesh):
                 out_specs=P(DATA_AXIS),
                 check=False,
             )
-            fn = jax.jit(body)
+            fn = instrumented_jit("knn", body)
         else:
-            fn = jax.jit(local_topk)
+            fn = instrumented_jit("knn", local_topk)
         _KNN_FNS[key] = fn
     return fn
 
@@ -1848,7 +1857,7 @@ def _packed_fn(kind: str, mode: str, mesh):
         def run(*args):
             return jnp.packbits(mask(*args))
 
-        fn = jax.jit(run)
+        fn = instrumented_jit(f"packed.{kind}", run)
         _PACKED_FNS[key] = fn
     return fn
 
@@ -1944,6 +1953,7 @@ class DeviceSegment:
         else:
             m = size * TILE
         self.n_padded = _pad_rows(max(n, 1), m)
+        record_pad(n, self.n_padded, kind=self.kind)
         self._pallas_ok = (self.n_padded // size) % TILE == 0
         self._m = self.n_padded  # pack() pads straight to the bucketed size
         self.fids = np.concatenate(
@@ -3584,7 +3594,7 @@ def _devseek_fn(has_time: bool, n_iv: int, cand_cap: int):
             m = exact_st_mask(gxh, gxl, gyh, gyl, gvalid, box)
         return jnp.packbits(m)
 
-    fn = jax.jit(run)
+    fn = instrumented_jit("devseek", run)
     _DEVSEEK_FNS[key] = fn
     return fn
 
@@ -3711,7 +3721,7 @@ def _devseek_xz_fn(n_iv: int, cand_cap: int, has_time: bool = False):
         decided = hit & rect & ~placeholder & (inside | ir)
         return jnp.concatenate([jnp.packbits(hit), jnp.packbits(decided)])
 
-    fn = jax.jit(run)
+    fn = instrumented_jit("devseek_xz", run)
     _DEVSEEK_XZ_FNS[key] = fn
     return fn
 

@@ -15,6 +15,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils.devstats import count_h2d, instrumented_jit
 
 DATA_AXIS = "shards"
 
@@ -139,14 +140,20 @@ def shard_array(mesh: Mesh, arr: np.ndarray, axis: str = DATA_AXIS):
     boundary crossing lands on the owning query's span tree."""
     with trace.span("device.dispatch", bytes=int(getattr(arr, "nbytes", 0))):
         faults.fault_point("device.dispatch")
-        return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+        out = jax.device_put(arr, NamedSharding(mesh, P(axis)))
+        # counted AFTER the put: a faulted/failed dispatch moved nothing,
+        # and the degradation path must not inflate the link counters
+        count_h2d(int(getattr(arr, "nbytes", 0)))
+        return out
 
 
 def replicate(mesh: Mesh, arr: np.ndarray):
     """Place a host array on the mesh fully replicated (query descriptors)."""
     with trace.span("device.dispatch", bytes=int(getattr(arr, "nbytes", 0))):
         faults.fault_point("device.dispatch")
-        return jax.device_put(arr, NamedSharding(mesh, P()))
+        out = jax.device_put(arr, NamedSharding(mesh, P()))
+        count_h2d(int(getattr(arr, "nbytes", 0)))
+        return out
 
 
 _LINK_LATENCY_MS: Optional[float] = None
@@ -180,7 +187,7 @@ def link_latency_ms() -> float:
             import time
             import numpy as _np
 
-            f = jax.jit(lambda x: x + 1)
+            f = instrumented_jit("link_probe", lambda x: x + 1)
             x = jax.device_put(_np.zeros(8, _np.float32))
             _np.asarray(f(x))  # compile + first transfer
             samples = []

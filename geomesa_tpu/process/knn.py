@@ -143,7 +143,10 @@ def _device_knn(store, name: str, ft, x: float, y: float, k: int,
     None when the store has no device executor / no point index."""
     import time as _time
 
+    from geomesa_tpu.utils import devstats
+
     t0 = _time.perf_counter()
+    dev0 = devstats.receipt_snapshot()
     knn = getattr(store.executor, "knn_candidates", None)
     if knn is None:
         return None
@@ -191,6 +194,9 @@ def _device_knn(store, name: str, ft, x: float, y: float, k: int,
     if store.audit_writer is not None:
         from geomesa_tpu.utils.audit import QueryEvent
 
+        # the device-heaviest path must carry its cost receipt like any
+        # store.query row (compiles + both transfer directions)
+        receipt = devstats.receipt_since(dev0)
         store.audit_writer.write_event(
             QueryEvent(
                 store=type(store).__name__,
@@ -203,6 +209,10 @@ def _device_knn(store, name: str, ft, x: float, y: float, k: int,
                 scanning_ms=1000 * (_time.perf_counter() - t0),
                 hits=len(out),
                 scan_path="device-topk",
+                recompiles=int(receipt["recompiles"]),
+                h2d_bytes=int(receipt["h2d_bytes"]),
+                d2h_bytes=int(receipt["d2h_bytes"]),
+                pad_ratio=float(receipt["pad_ratio"]),
             )
         )
     return out

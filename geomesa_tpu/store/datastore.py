@@ -37,7 +37,7 @@ from geomesa_tpu.store.blocks import (
     take_rows,
 )
 from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
-from geomesa_tpu.utils import trace
+from geomesa_tpu.utils import devstats, trace
 
 DEFAULT_FLUSH_SIZE = 100_000
 
@@ -515,6 +515,10 @@ class TpuDataStore:
             with trace.span(
                 "query", force=self.slow_query_s is not None, type=name
             ) as root:
+                # device cost receipt baseline: taken BEFORE preparation
+                # so a lazy store's replay uploads attribute to the query
+                # that paid for them (three dict reads — hot-path safe)
+                dev0 = devstats.receipt_snapshot()
                 self._prepare_query(name, query)
                 # the audited clock starts AFTER preparation: a lazy
                 # store's partition replay is traced (fs.load) but must
@@ -523,11 +527,17 @@ class TpuDataStore:
                 plan = self._plan_cached(name, query)
                 t_planned = _time.perf_counter()
                 result = self._execute(name, ft, query, plan, t_planned)
+                receipt = devstats.receipt_since(dev0)
                 if root.recording:
                     root.set_attr("hits", len(result))
                     root.set_attr("scan_path", self._collect_scan_path(plan))
+                    # the receipt rides the root span too: the slow-query
+                    # log renders it next to the tree it explains
+                    root.set_attr("device", receipt)
                 if self.audit_writer is not None or self.metrics is not None:
-                    self._audit(name, query, plan, result, t_start, t_planned)
+                    self._audit(
+                        name, query, plan, result, t_start, t_planned, receipt
+                    )
                 return result
         finally:
             self._log_slow_query(name, plan, root)
@@ -565,9 +575,18 @@ class TpuDataStore:
                 "query.batch", force=self.slow_query_s is not None,
                 type=name, n=len(qs),
             ) as batch:
+                # batch-level cost receipt: the pipelined phase-1 work
+                # (mirror uploads, compiles triggered by dispatch_many)
+                # happens OUTSIDE the per-query resolve windows, so the
+                # batch root carries the whole stream's delta — the
+                # per-query receipts cover only each resolve phase
+                dev0 = devstats.receipt_snapshot()
                 for q in qs:
                     self._prepare_query(name, q)
-                return self._query_many_planned(name, ft, qs)
+                results = self._query_many_planned(name, ft, qs)
+                if batch.recording:
+                    batch.set_attr("device", devstats.receipt_since(dev0))
+                return results
         finally:
             self._log_slow_batch(name, batch)
 
@@ -644,12 +663,16 @@ class TpuDataStore:
                     "query", force=self.slow_query_s is not None,
                     type=name, batched=True,
                 ) as root:
+                    dev0 = devstats.receipt_snapshot()
                     result = self._execute(name, ft, q, plan, t_resolve, pending)
+                    receipt = devstats.receipt_since(dev0)
                     if root.recording:
                         root.set_attr("hits", len(result))
                         root.set_attr("scan_path", self._collect_scan_path(plan))
+                        root.set_attr("device", receipt)
                     if self.audit_writer is not None or self.metrics is not None:
-                        self._audit(name, q, plan, result, t_resolve - dt, t_resolve)
+                        self._audit(name, q, plan, result, t_resolve - dt,
+                                    t_resolve, receipt)
             finally:
                 self._log_slow_query(name, plan, root)
             results.append(result)
@@ -664,13 +687,15 @@ class TpuDataStore:
             return "+".join(sorted({a for a in arms if a}))
         return getattr(plan, "scan_path", "")
 
-    def _audit(self, name, query, plan, result, t_start, t_planned):
+    def _audit(self, name, query, plan, result, t_start, t_planned,
+               receipt=None):
         import time as _time
 
         from geomesa_tpu.filter.parser import to_cql
         from geomesa_tpu.utils.audit import QueryEvent
 
         now = _time.perf_counter()
+        receipt = receipt or {}
         if self.metrics is not None:
             self.metrics.inc("queries")
             self.metrics.update_timer("query.plan", t_planned - t_start)
@@ -691,6 +716,10 @@ class TpuDataStore:
                     # called inside the query's root span: the audit row
                     # and the exported trace tree join on this id
                     trace_id=trace.current_trace_id() or "",
+                    recompiles=int(receipt.get("recompiles", 0)),
+                    h2d_bytes=int(receipt.get("h2d_bytes", 0)),
+                    d2h_bytes=int(receipt.get("d2h_bytes", 0)),
+                    pad_ratio=float(receipt.get("pad_ratio", 0.0)),
                 )
             )
 

@@ -38,6 +38,16 @@ class QueryEvent:
     # (utils/trace.py), "" when the query ran untraced — audit rows and
     # /debug/traces join on it
     trace_id: str = ""
+    # device cost receipt (utils/devstats.receipt_since): what THIS
+    # query cost below the host — XLA compiles it triggered, bytes it
+    # moved across the device link each way, and the padding efficiency
+    # of any segment THIS query uploaded (0.0 when it uploaded none).
+    # Upper bounds under concurrent streams (the counters are
+    # process-wide), exact single-stream.
+    recompiles: int = 0
+    h2d_bytes: int = 0
+    d2h_bytes: int = 0
+    pad_ratio: float = 0.0
 
 
 class AuditWriter:
@@ -136,6 +146,19 @@ class MetricsRegistry:
         maintain incrementally)."""
         with self._lock:
             self._gauge_fns[name] = fn
+
+    def counter(self, name: str, default: int = 0) -> int:
+        """One counter's current value — a single dict read under the
+        lock, cheap enough for per-query receipt snapshots
+        (utils/devstats.receipt_snapshot) on the hot path."""
+        with self._lock:
+            return int(self._counters.get(name, default))
+
+    def gauge(self, name: str, default: float = 0.0) -> float:
+        """One SET gauge's current value (gauge_fn callables are only
+        sampled by snapshot() — this is the cheap point read)."""
+        with self._lock:
+            return float(self._gauges.get(name, default))
 
     def update_timer(self, name: str, seconds: float) -> None:
         with self._lock:

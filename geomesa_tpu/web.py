@@ -9,9 +9,12 @@ SURVEY.md section 2.5). Endpoints over a datastore:
     GET /stats/count?name=&cql=&exact=
     GET /stats/bounds?name=
     GET /metrics                 -- Prometheus text exposition (store
-                                    registry + robustness counters)
+                                    registry + robustness counters +
+                                    device/compiler telemetry)
     GET /healthz                 -- liveness/readiness JSON
     GET /debug/traces?n=         -- last n query span trees (JSON)
+    GET /debug/device            -- device/compiler telemetry (compile
+                                    counts, transfer bytes, pad, HBM)
 
 Serves with the stdlib ThreadingHTTPServer — start with ``serve(store,
 port)`` or embed ``GeoMesaHandler`` elsewhere. Constructing the server
@@ -27,6 +30,10 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+# /debug/traces?n= clamp: the debug ring holds 256 trees, so anything
+# past this only bloats the response a client asked for by accident
+MAX_DEBUG_TRACES = 1000
 
 
 def make_handler(store):
@@ -158,13 +165,15 @@ def make_handler(store):
                 elif route == "/metrics":
                     # Prometheus scrape surface: the store's own registry
                     # (query.plan/query.scan percentiles) merged with the
-                    # process-wide failure-path counters — one scrape
-                    # carries both (GeoMesaStatsEndpoint role, scrape-able)
+                    # process-wide failure-path counters AND the device/
+                    # compiler telemetry — one scrape carries all three
+                    # (GeoMesaStatsEndpoint role, scrape-able)
                     from geomesa_tpu.utils.audit import (
                         MetricsRegistry,
                         prometheus_text,
                         robustness_metrics,
                     )
+                    from geomesa_tpu.utils.devstats import devstats_metrics
 
                     regs = []
                     # duck-typed stores (e.g. a stream store) may carry
@@ -172,6 +181,7 @@ def make_handler(store):
                     if isinstance(getattr(store, "metrics", None), MetricsRegistry):
                         regs.append(store.metrics)
                     regs.append(robustness_metrics())
+                    regs.append(devstats_metrics())
                     self._send(
                         200, prometheus_text(regs),
                         "text/plain; version=0.0.4; charset=utf-8",
@@ -197,7 +207,23 @@ def make_handler(store):
                 elif route == "/debug/traces":
                     from geomesa_tpu.utils import trace as _trace
 
-                    n = int(params.get("n", 20))
+                    # validate ?n= rather than bubbling a 500: non-numeric
+                    # and negative are the CALLER's error (400); absurdly
+                    # large just clamps — the ring is bounded anyway and a
+                    # huge JSON dump would only hurt the server
+                    try:
+                        n = int(params.get("n", 20))
+                    except ValueError:
+                        self._send(
+                            400, json.dumps({"error": "n must be an integer"})
+                        )
+                        return
+                    if n < 0:
+                        self._send(
+                            400, json.dumps({"error": "n must be >= 0"})
+                        )
+                        return
+                    n = min(n, MAX_DEBUG_TRACES)
                     self._send(
                         200,
                         json.dumps(
@@ -205,6 +231,13 @@ def make_handler(store):
                             default=str,
                         ),
                     )
+                elif route == "/debug/device":
+                    # device/compiler telemetry page: per-kernel compile +
+                    # cache accounting, transfer byte totals, padding
+                    # efficiency, best-effort HBM (utils/devstats.py)
+                    from geomesa_tpu.utils.devstats import device_debug
+
+                    self._send(200, json.dumps(device_debug(), default=str))
                 elif route == "/stats/count":
                     name = params["name"]
                     exact = params.get("exact", "true").lower() != "false"

@@ -1,0 +1,329 @@
+"""Perf-regression gate: bench stream -> comparable JSON -> pass/fail.
+
+The continuous-profiling loop the bench trajectory was missing: run the
+headline bench query stream (bench.synthesize / bench.make_queries)
+under the span tracer AND the device/compiler telemetry
+(utils/devstats.py), emit ONE structured JSON artifact —
+
+  * per-span self-times aggregated across the stream (the same numbers
+    scripts/profile_query.py prints for humans),
+  * devstats deltas over the stream (recompiles triggered, H2D/D2H
+    bytes moved, padding ratio, compile wall time),
+  * throughput (per-query ms, features/s),
+
+— and compare it against a committed baseline (BENCH_BASELINE.json)
+with a tolerance band. Exit 0 when inside the band, nonzero with one
+line per regression when outside. Perf PRs cite these deltas, not
+ad-hoc timers (ROADMAP invariant).
+
+Usage:
+    python scripts/bench_gate.py --record          # (re)write the baseline
+    python scripts/bench_gate.py --check           # gate against it
+    python scripts/bench_gate.py --out run.json    # just emit the artifact
+
+Env: GEOMESA_BENCH_N / GEOMESA_BENCH_REPS size the stream (defaults are
+CI-small); GEOMESA_GATE_DEVICE=1 skips the CPU pin (live-hardware runs
+record their own baselines). --inject-slowdown F scales the measured
+timings by F AFTER measurement — the gate's own failure path is
+testable without a slow machine (tests/test_bench_gate.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "BENCH_BASELINE.json")
+
+# the gate's tolerance band — recorded INTO the baseline so the check
+# and the recording can never disagree about what "regressed" means;
+# --tolerance overrides the time factor for one-off runs
+DEFAULT_TOLERANCE = {
+    # per-query wall may grow to baseline * factor before failing (CI
+    # boxes are noisy; a real regression the gate exists for — an O(N)
+    # slip, a lost cache, a new sync point — blows straight past 1.75x)
+    "per_query_ms_factor": 1.75,
+    # silent-recompile budget: the traced stream may trigger at most
+    # baseline + slack compiles (shape buckets make warm streams ~0)
+    "recompiles_slack": 4,
+    # transfer budget: bytes moved per stream may grow to factor * base
+    # + slack (a padding blow-up or a lost wire-format optimization
+    # shows up here even when a fast box hides the time cost)
+    "bytes_factor": 1.5,
+    "bytes_slack": 1 << 20,
+}
+
+
+def run_stream(n: int, reps: int) -> dict:
+    """Ingest n synthetic rows, warm (pack + compile), then run the
+    jittered bench query stream traced; return the gate artifact."""
+    import numpy as np
+
+    import bench
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import TpuDataStore
+    from geomesa_tpu.utils import devstats, trace
+
+    import jax
+
+    x, y, t = bench.synthesize(n)
+    _boxes, cqls = bench.make_queries(reps)
+
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    store._insert_columns(
+        ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t}
+    )
+    store.query("gdelt", bench.QUERY)  # warm: device pack + compile
+
+    queries = [Query.cql(c, properties=[]) for c in cqls]
+    ring = trace.InMemoryTraceExporter(capacity=reps + 4)
+    dev0 = devstats.receipt_snapshot()
+    compile_s0 = devstats.devstats_metrics().snapshot()[3].get(
+        "xla.compile", (0, 0.0)
+    )[1]
+    with trace.exporting(ring):
+        t0 = time.perf_counter()
+        results = [store.query("gdelt", q) for q in queries]
+        total_s = time.perf_counter() - t0
+    receipt = devstats.receipt_since(dev0)
+    compile_s1 = devstats.devstats_metrics().snapshot()[3].get(
+        "xla.compile", (0, 0.0)
+    )[1]
+
+    roots = [r for r in ring.traces if r.name == "query"]
+    per_name = defaultdict(lambda: [0, 0.0])
+    for root in roots:
+        for sp in root.walk():
+            per_name[sp.name][0] += 1
+            per_name[sp.name][1] += sp.self_time_ms
+    spans = {
+        name: {
+            "count": cnt,
+            "self_ms": round(self_ms, 3),
+            "ms_per_query": round(self_ms / max(reps, 1), 3),
+        }
+        for name, (cnt, self_ms) in sorted(per_name.items())
+    }
+    hits = sum(len(r) for r in results)
+    return {
+        "schema": 1,
+        "config": {
+            "n": n,
+            "reps": reps,
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+        },
+        "per_query_ms": round(total_s / max(reps, 1) * 1000.0, 3),
+        "features_per_s": round(n * reps / max(total_s, 1e-9), 1),
+        "hits_total": hits,
+        "spans": spans,
+        "devstats": {
+            "recompiles": receipt["recompiles"],
+            "h2d_bytes": receipt["h2d_bytes"],
+            "d2h_bytes": receipt["d2h_bytes"],
+            "pad_ratio": receipt["pad_ratio"],
+            "compile_wall_s": round(compile_s1 - compile_s0, 4),
+        },
+        "tolerance": dict(DEFAULT_TOLERANCE),
+    }
+
+
+def inject_slowdown(artifact: dict, factor: float) -> dict:
+    """Scale the measured timings by ``factor`` (testing the gate's own
+    failure path — the artifact records the injection honestly)."""
+    if factor == 1.0:
+        return artifact
+    out = json.loads(json.dumps(artifact))
+    out["per_query_ms"] = round(out["per_query_ms"] * factor, 3)
+    out["features_per_s"] = round(out["features_per_s"] / factor, 1)
+    for row in out["spans"].values():
+        row["self_ms"] = round(row["self_ms"] * factor, 3)
+        row["ms_per_query"] = round(row["ms_per_query"] * factor, 3)
+    out["injected_slowdown"] = factor
+    return out
+
+
+def compare(baseline: dict, current: dict, tolerance: dict = None) -> list:
+    """[] when current is inside the baseline's band, else one
+    human-readable line per regression. Hit-count drift is a CORRECTNESS
+    failure (same synthetic stream must answer identically), reported
+    through the same channel."""
+    tol = dict(DEFAULT_TOLERANCE)
+    tol.update(baseline.get("tolerance") or {})
+    tol.update(tolerance or {})
+    out = []
+
+    bcfg, ccfg = baseline.get("config", {}), current.get("config", {})
+    keys = ("n", "reps", "backend", "devices")
+    if tuple(bcfg.get(k) for k in keys) != tuple(ccfg.get(k) for k in keys):
+        diff = ", ".join(
+            f"{k}: {bcfg.get(k)} vs {ccfg.get(k)}"
+            for k in keys if bcfg.get(k) != ccfg.get(k)
+        )
+        out.append(
+            f"config mismatch ({diff}) — a baseline from a different "
+            "stream size or backend/mesh cannot gate this run; re-record "
+            "on this configuration"
+        )
+        return out
+
+    b_ms, c_ms = baseline["per_query_ms"], current["per_query_ms"]
+    limit = b_ms * tol["per_query_ms_factor"]
+    if c_ms > limit:
+        out.append(
+            f"per_query_ms regressed: {c_ms:.1f} > {limit:.1f} "
+            f"(baseline {b_ms:.1f} x {tol['per_query_ms_factor']})"
+        )
+
+    b_dev = baseline.get("devstats", {})
+    c_dev = current.get("devstats", {})
+    rc_limit = b_dev.get("recompiles", 0) + tol["recompiles_slack"]
+    if c_dev.get("recompiles", 0) > rc_limit:
+        out.append(
+            f"recompiles regressed: {c_dev.get('recompiles', 0)} > {rc_limit} "
+            f"(baseline {b_dev.get('recompiles', 0)} + "
+            f"{tol['recompiles_slack']} slack) — a jit cache stopped hitting"
+        )
+    for key in ("h2d_bytes", "d2h_bytes"):
+        b_v, c_v = b_dev.get(key, 0), c_dev.get(key, 0)
+        b_limit = b_v * tol["bytes_factor"] + tol["bytes_slack"]
+        if c_v > b_limit:
+            out.append(
+                f"{key} regressed: {c_v:,} > {b_limit:,.0f} "
+                f"(baseline {b_v:,} x {tol['bytes_factor']} + slack) — "
+                "transfer/padding blow-up"
+            )
+
+    if baseline.get("hits_total") != current.get("hits_total"):
+        out.append(
+            f"hits_total drifted: {current.get('hits_total')} != "
+            f"{baseline.get('hits_total')} (CORRECTNESS, not perf)"
+        )
+    return out
+
+
+def span_deltas(baseline: dict, current: dict, top: int = 8) -> list:
+    """Informational per-span ms/query deltas (largest growth first) —
+    the "where did it go" context printed next to a failing gate."""
+    rows = []
+    b_spans = baseline.get("spans", {})
+    for name, cur in current.get("spans", {}).items():
+        base_ms = b_spans.get(name, {}).get("ms_per_query", 0.0)
+        rows.append((cur["ms_per_query"] - base_ms, name, base_ms,
+                     cur["ms_per_query"]))
+    rows.sort(reverse=True)
+    return [
+        f"  {name:28s} {base:8.2f} -> {cur:8.2f} ms/query ({delta:+.2f})"
+        for delta, name, base, cur in rows[:top]
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--record", action="store_true",
+                    help="write the artifact as the new baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="compare against the baseline; exit 1 on regression")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--out", default=None, help="also write the artifact here")
+    ap.add_argument("--n", type=int,
+                    default=int(os.environ.get("GEOMESA_BENCH_N", 200_000)))
+    ap.add_argument("--reps", type=int,
+                    default=int(os.environ.get("GEOMESA_BENCH_REPS", 6)))
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="override the per_query_ms factor")
+    ap.add_argument("--runs", type=int, default=None,
+                    help="stream repetitions; the median-per_query_ms "
+                         "artifact wins (default 3 for --record AND "
+                         "--check — medians on both sides keep one "
+                         "noisy scheduler window from becoming either "
+                         "a too-tight floor or a false regression; "
+                         "plain artifact emission defaults to 1)")
+    ap.add_argument("--inject-slowdown", type=float, default=1.0,
+                    help="scale measured timings by F (gate self-test)")
+    args = ap.parse_args(argv)
+
+    if args.record and args.inject_slowdown != 1.0:
+        # a doctored baseline would silently widen every future check's
+        # band; the injection flag exists ONLY to test the failure path
+        print("refusing --record with --inject-slowdown: the baseline "
+              "must be a real measurement", file=sys.stderr)
+        return 2
+
+    baseline = None
+    if args.check:
+        # read the baseline BEFORE paying for the measurement: a wrong
+        # path must fail in milliseconds, not after the full stream
+        try:
+            with open(args.baseline) as fh:
+                baseline = json.load(fh)
+        except FileNotFoundError:
+            print(f"no baseline at {args.baseline}; run --record first",
+                  file=sys.stderr)
+            return 2
+
+    runs = (
+        args.runs if args.runs is not None
+        else (3 if args.record or args.check else 1)
+    )
+    attempts = sorted(
+        (run_stream(args.n, args.reps) for _ in range(max(1, runs))),
+        key=lambda a: a["per_query_ms"],
+    )
+    artifact = attempts[len(attempts) // 2]  # median per_query_ms
+    artifact = inject_slowdown(artifact, args.inject_slowdown)
+    text = json.dumps(artifact, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    if args.record:
+        with open(args.baseline, "w") as fh:
+            fh.write(text + "\n")
+        print(f"baseline recorded: {args.baseline}")
+        return 0
+    if not args.check:
+        print(text)
+        return 0
+    tol = (
+        None if args.tolerance is None
+        else {"per_query_ms_factor": args.tolerance}
+    )
+    regressions = compare(baseline, artifact, tol)
+    print(
+        f"bench_gate: per_query_ms={artifact['per_query_ms']:.1f} "
+        f"(baseline {baseline['per_query_ms']:.1f}), "
+        f"recompiles={artifact['devstats']['recompiles']}, "
+        f"d2h={artifact['devstats']['d2h_bytes']:,}B"
+    )
+    if regressions:
+        print("REGRESSION:", file=sys.stderr)
+        for line in regressions:
+            print(f"  {line}", file=sys.stderr)
+        print("largest span growth:", file=sys.stderr)
+        for line in span_deltas(baseline, artifact):
+            print(line, file=sys.stderr)
+        return 1
+    print("bench_gate: within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    # device dispatch is what the gate profiles; the host-seek chooser
+    # would answer these plans without dispatching (profile_query.py's
+    # posture), and CPU pinning keeps CI baselines reproducible
+    os.environ.setdefault("GEOMESA_SEEK", "0")
+    if os.environ.get("GEOMESA_GATE_DEVICE", "") != "1":
+        from geomesa_tpu.parallel.mesh import force_cpu_platform
+
+        force_cpu_platform()
+    sys.exit(main())

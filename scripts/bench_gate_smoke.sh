@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Bounded bench-gate smoke (the perf sibling of chaos_smoke.sh): the
+# slow-marked tests/test_bench_gate.py end-to-end checks — record a tiny
+# baseline, gate a clean rerun (pass), gate an injected 2x slowdown
+# (fail) — on CPU under a hard 300 s cap. Run in CI next to the tier-1
+# suite and the chaos smoke.
+#
+# Usage: scripts/bench_gate_smoke.sh [extra pytest args]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+exec timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_bench_gate.py -q -m slow -p no:cacheprovider "$@"

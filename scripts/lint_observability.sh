@@ -46,6 +46,19 @@ while IFS= read -r f; do
     fi
 done < <(grep -rlE 'faults\.fault_point\(' --include='*.py' geomesa_tpu/ || true)
 
+# 3. Compiler accounting — every jax.jit in geomesa_tpu/ goes through
+#    utils/devstats.instrumented_jit (ROADMAP invariant): a bare jit is
+#    an unaccounted kernel whose recompiles/cache growth are invisible
+#    to /debug/device, the cost receipt, and the bench gate.
+while IFS= read -r hit; do
+    f="${hit%%:*}"
+    [ "$f" = "geomesa_tpu/utils/devstats.py" ] && continue
+    echo "FAIL: bare jax.jit outside instrumented_jit: ${hit}"
+    echo "      (use utils/devstats.instrumented_jit(name, fn) so compiles"
+    echo "       are counted per kernel and attributed to queries)"
+    fail=1
+done < <(grep -rnE 'jax\.jit\(' --include='*.py' geomesa_tpu/ || true)
+
 if [ "$fail" -eq 0 ]; then
     echo "observability lint clean"
 fi

@@ -11,6 +11,12 @@ boundaries:
   * the full span tree of the slowest query
 
 Usage: GEOMESA_BENCH_N=... python scripts/profile_query.py
+
+GEOMESA_PROFILE_JSON=<path> additionally writes the per-span table and
+the slowest query's full span tree as one JSON document — the
+machine-diffable twin of the human table, so CI can compare two
+profiles without re-parsing stdout (scripts/bench_gate.py is the gated
+edition of the same artifact).
 """
 
 import os
@@ -81,6 +87,32 @@ def main():
     slowest = max(roots, key=lambda r: r.duration_ms)
     print(f"\nslowest query ({slowest.duration_ms:.1f}ms), span tree:")
     print(slowest.render(indent=1))
+
+    json_path = os.environ.get("GEOMESA_PROFILE_JSON")
+    if json_path:
+        import json
+
+        doc = {
+            "config": {"n": n, "reps": reps},
+            "total_s": round(total, 4),
+            "per_query_ms": round(total / reps * 1000.0, 3),
+            "spans": {
+                name: {
+                    "count": cnt,
+                    "self_ms": round(self_ms, 3),
+                    "ms_per_query": round(self_ms / reps, 3),
+                    "pct_of_wall": round(
+                        100 * self_ms / max(wall_ms, 1e-9), 2
+                    ),
+                }
+                for name, (cnt, self_ms) in sorted(per_name.items())
+            },
+            "slowest": slowest.to_dict(),
+        }
+        with open(json_path, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True, default=str)
+            fh.write("\n")
+        print(f"\nJSON profile written: {json_path}")
 
     # sanity: pipelined batch dispatch for comparison
     t0 = time.perf_counter()

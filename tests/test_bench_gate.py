@@ -1,0 +1,160 @@
+"""Perf-regression gate tests (scripts/bench_gate.py): the compare()
+band logic unit-tested with injected regressions (fast), and a tiny-N
+end-to-end record -> check -> injected-2x-slowdown smoke (slow-marked;
+scripts/bench_gate_smoke.sh runs it next to the chaos smoke)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_gate",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "bench_gate.py"),
+)
+bench_gate = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_gate)
+
+
+def _artifact(per_query_ms=100.0, recompiles=1, h2d=1 << 20, d2h=1 << 18,
+              hits=5000):
+    return {
+        "schema": 1,
+        "config": {"n": 200_000, "reps": 6, "backend": "cpu", "devices": 8},
+        "per_query_ms": per_query_ms,
+        "features_per_s": 1e6,
+        "hits_total": hits,
+        "spans": {
+            "device.fetch": {"count": 6, "self_ms": per_query_ms * 4,
+                             "ms_per_query": per_query_ms * 0.66},
+            "plan": {"count": 6, "self_ms": 30.0, "ms_per_query": 5.0},
+        },
+        "devstats": {
+            "recompiles": recompiles,
+            "h2d_bytes": h2d,
+            "d2h_bytes": d2h,
+            "pad_ratio": 0.8,
+            "compile_wall_s": 0.0,
+        },
+        "tolerance": dict(bench_gate.DEFAULT_TOLERANCE),
+    }
+
+
+# -- compare(): the band logic ------------------------------------------------
+
+
+def test_clean_run_passes():
+    assert bench_gate.compare(_artifact(), _artifact()) == []
+
+
+def test_small_jitter_inside_band_passes():
+    assert bench_gate.compare(_artifact(100.0), _artifact(140.0)) == []
+
+
+def test_injected_2x_slowdown_fails():
+    """The acceptance criterion: a synthetic 2x slowdown must trip the
+    gate (2.0 > the 1.75 band)."""
+    base = _artifact(100.0)
+    slow = bench_gate.inject_slowdown(_artifact(100.0), 2.0)
+    regs = bench_gate.compare(base, slow)
+    assert regs and "per_query_ms regressed" in regs[0]
+    assert slow["injected_slowdown"] == 2.0
+    # the span table scaled with it (CI diffing stays consistent)
+    assert slow["spans"]["plan"]["ms_per_query"] == pytest.approx(10.0)
+
+
+def test_recompile_blowup_fails_even_when_fast():
+    """A silent recompile storm on a fast box is still a regression —
+    the gate exists exactly for what wall time hides."""
+    regs = bench_gate.compare(
+        _artifact(recompiles=0), _artifact(recompiles=20)
+    )
+    assert regs and "recompiles regressed" in regs[0]
+
+
+def test_transfer_blowup_fails():
+    regs = bench_gate.compare(
+        _artifact(d2h=1 << 18), _artifact(d2h=(1 << 18) * 3 + (1 << 21))
+    )
+    assert regs and "d2h_bytes regressed" in regs[0]
+
+
+def test_hit_drift_is_reported_as_correctness():
+    regs = bench_gate.compare(_artifact(hits=5000), _artifact(hits=4999))
+    assert regs and "CORRECTNESS" in regs[0]
+
+
+def test_config_mismatch_refuses_to_compare():
+    cur = _artifact()
+    cur["config"]["n"] = 100
+    regs = bench_gate.compare(_artifact(), cur)
+    assert len(regs) == 1 and "config mismatch" in regs[0]
+
+
+def test_backend_mismatch_refuses_to_compare():
+    """A live-hardware baseline must not gate a CPU CI run (or vice
+    versa): order-of-magnitude config differences read as 'regression'
+    otherwise."""
+    cur = _artifact()
+    cur["config"]["backend"] = "tpu"
+    cur["config"]["devices"] = 1
+    regs = bench_gate.compare(_artifact(), cur)
+    assert len(regs) == 1 and "config mismatch" in regs[0]
+    assert "backend" in regs[0] and "devices" in regs[0]
+
+
+def test_tolerance_override_tightens_band():
+    regs = bench_gate.compare(
+        _artifact(100.0), _artifact(120.0),
+        tolerance={"per_query_ms_factor": 1.1},
+    )
+    assert regs and "per_query_ms regressed" in regs[0]
+
+
+def test_record_refuses_injected_slowdown(tmp_path):
+    """--record with --inject-slowdown would commit a doctored baseline
+    that widens every future band — refused before anything runs."""
+    baseline = str(tmp_path / "b.json")
+    rc = bench_gate.main(
+        ["--record", "--inject-slowdown", "2.0", "--baseline", baseline,
+         "--n", "1000", "--reps", "1"]
+    )
+    assert rc == 2 and not os.path.exists(baseline)
+
+
+def test_span_deltas_rank_growth():
+    base, cur = _artifact(100.0), _artifact(100.0)
+    cur["spans"]["plan"]["ms_per_query"] = 50.0
+    lines = bench_gate.span_deltas(base, cur)
+    assert lines and "plan" in lines[0]
+
+
+# -- end-to-end smoke (tiny N) ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gate_end_to_end_record_check_and_injected_fail(tmp_path, monkeypatch):
+    """Record a tiny baseline, gate a clean rerun (exit 0), then gate an
+    injected 2x slowdown (exit 1) — the whole loop CI runs."""
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    baseline = str(tmp_path / "baseline.json")
+    args = ["--n", "20000", "--reps", "3", "--baseline", baseline]
+    assert bench_gate.main(args + ["--record"]) == 0
+    doc = json.load(open(baseline))
+    assert doc["per_query_ms"] > 0 and doc["spans"]
+    assert "devstats" in doc and doc["devstats"]["d2h_bytes"] >= 0
+    assert bench_gate.main(args + ["--check"]) == 0
+    # 3x, not 2x: warm reruns of a tiny stream can be ~25% faster than
+    # the cold-recorded baseline, and 2x of a faster run can land back
+    # inside the 1.75 band — the exact 2x-vs-band arithmetic is covered
+    # deterministically by test_injected_2x_slowdown_fails above
+    assert bench_gate.main(
+        args + ["--check", "--inject-slowdown", "3.0"]
+    ) == 1
+    # missing baseline is an operator error, not a crash
+    assert bench_gate.main(
+        ["--n", "20000", "--reps", "3", "--check",
+         "--baseline", str(tmp_path / "nope.json")]
+    ) == 2

@@ -1,0 +1,303 @@
+"""Device/compiler telemetry tests (utils/devstats.py): instrumented_jit
+compile accounting, transfer byte counters, padding gauges, the
+per-query cost receipt on QueryEvent and the root span, and the
+/debug/device + /metrics surfaces."""
+
+import json
+import urllib.error
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import devstats, trace
+from geomesa_tpu.utils.audit import (
+    InMemoryAuditWriter,
+    MetricsRegistry,
+    PrometheusReporter,
+    prometheus_text,
+)
+
+T0 = 1483228800000
+DAY = 86400000
+SPEC = "dtg:Date,*geom:Point:srid=4326"
+CQL = (
+    "bbox(geom, -30, -30, 30, 30) AND dtg DURING "
+    "2017-01-05T00:00:00Z/2017-01-20T00:00:00Z"
+)
+
+
+def _fill(store, name="gdelt", n=3000, seed=3):
+    ft = parse_spec(name, SPEC)
+    store.create_schema(ft)
+    rng = np.random.default_rng(seed)
+    store._insert_columns(ft, {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-80, 80, n),
+        "geom__y": rng.uniform(-80, 80, n),
+        "dtg": T0 + rng.integers(0, 30 * DAY, n),
+    })
+    return store
+
+
+def _uname(prefix: str) -> str:
+    """Unique kernel name: devstats state is process-wide by design, so
+    each test accounts against its own kernel."""
+    return f"{prefix}_{uuid.uuid4().hex[:8]}"
+
+
+# -- instrumented_jit ---------------------------------------------------------
+
+
+def test_instrumented_jit_counts_compiles_per_signature():
+    import jax.numpy as jnp
+
+    name = _uname("k")
+    reg = devstats.devstats_metrics()
+    fn = devstats.instrumented_jit(name, lambda x: x + 1)
+    a8 = jnp.zeros(8, jnp.float32)
+    assert int(fn(a8)[0]) == 1
+    fn(a8)
+    fn(jnp.ones(8, jnp.float32))  # same signature: warm
+    assert reg.counter(f"xla.compile.{name}") == 1
+    # a new shape bucket is a new compile
+    fn(jnp.zeros(16, jnp.float32))
+    assert reg.counter(f"xla.compile.{name}") == 2
+    # a new dtype too
+    fn(jnp.zeros(8, jnp.int32))
+    assert reg.counter(f"xla.compile.{name}") == 3
+    # the cache-entry gauge tracks the signature set
+    _c, gauges, _t, _tt = reg.snapshot()
+    assert gauges[f"xla.cache.{name}.entries"] == 3.0
+    # wall time landed in the shared compile timer
+    assert reg.snapshot()[3]["xla.compile"][0] >= 3
+
+
+def test_sibling_wrappers_each_account_their_own_compiles():
+    """jit's compilation cache is per wrapper, and the executor builds
+    one wrapper per (capacity bucket, mode, mesh) cache key: a sibling
+    wrapper's first call with already-seen shapes is a REAL compile and
+    must count — while counters and the cache gauge aggregate under the
+    one kernel name an operator reasons about."""
+    import jax.numpy as jnp
+
+    name = _uname("shared")
+    reg = devstats.devstats_metrics()
+    f1 = devstats.instrumented_jit(name, lambda x: x + 1)
+    f2 = devstats.instrumented_jit(name, lambda x: x + 1)
+    f1(jnp.zeros(4, jnp.float32))
+    assert reg.counter(f"xla.compile.{name}") == 1
+    f2(jnp.zeros(4, jnp.float32))  # same shapes, cold sibling cache
+    assert reg.counter(f"xla.compile.{name}") == 2
+    f2(jnp.zeros(4, jnp.float32))  # warm within the wrapper
+    assert reg.counter(f"xla.compile.{name}") == 2
+    _c, gauges, _t, _tt = reg.snapshot()
+    assert gauges[f"xla.cache.{name}.entries"] == 2.0
+
+
+def test_instrumented_jit_compile_attributes_to_query_span():
+    """A compile triggered inside a traced query lands as an xla.compile
+    span ON that query's tree (the compile-stall attribution the host
+    spans could not see)."""
+    import jax.numpy as jnp
+
+    name = _uname("traced")
+    fn = devstats.instrumented_jit(name, lambda x: x * 2)
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        with trace.span("query"):
+            fn(jnp.zeros(32, jnp.float32))  # cold: compiles
+            fn(jnp.zeros(32, jnp.float32))  # warm: no span
+    root = ring.traces[-1]
+    compiles = root.find("xla.compile")
+    assert len(compiles) == 1
+    assert compiles[0].attributes["kernel"] == name
+    assert reg_total_compiles_at_least(1)
+
+
+def reg_total_compiles_at_least(n: int) -> bool:
+    return devstats.devstats_metrics().counter("xla.compile.total") >= n
+
+
+# -- transfer + padding counters ----------------------------------------------
+
+
+def test_h2d_d2h_counters_and_pad_gauges_move_on_device_query(monkeypatch):
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")  # keep the device scan path live
+    reg = devstats.devstats_metrics()
+    before = devstats.receipt_snapshot()
+    store = _fill(TpuDataStore(executor=TpuScanExecutor()), n=4000)
+    store.query("gdelt", CQL)
+    after = devstats.receipt_snapshot()
+    # the mirror upload crossed H2D, the hit buffer crossed D2H
+    assert after["h2d_bytes"] > before["h2d_bytes"]
+    assert after["d2h_bytes"] > before["d2h_bytes"]
+    # padding gauges describe the latest segment upload
+    used = reg.gauge("device.pad.rows_used")
+    cap = reg.gauge("device.pad.rows_capacity")
+    assert 0 < used <= cap
+    assert reg.gauge("device.pad.ratio") == pytest.approx(used / cap)
+    assert reg.counter("device.pad.rows_used_total") >= used
+
+
+def test_receipt_on_query_event_and_root_span(monkeypatch):
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    store = _fill(TpuDataStore(
+        executor=TpuScanExecutor(), audit_writer=InMemoryAuditWriter()
+    ), n=4000)
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        store.query("gdelt", CQL)
+    ev = store.audit_writer.events[-1]
+    # the first query pays the mirror upload: bytes moved both ways
+    assert ev.h2d_bytes > 0 and ev.d2h_bytes > 0
+    assert 0 < ev.pad_ratio <= 1.0
+    assert ev.recompiles >= 0
+    root = ring.traces[-1]
+    receipt = root.attributes["device"]
+    assert receipt["h2d_bytes"] == ev.h2d_bytes
+    assert receipt["d2h_bytes"] == ev.d2h_bytes
+    # a warm repeat's receipt shows the cache working: no new upload,
+    # and pad_ratio reports 0 rather than inheriting the cold query's
+    # segment efficiency (the ratio describes what THIS query uploaded)
+    with trace.exporting(ring):
+        store.query("gdelt", CQL)
+    ev2 = store.audit_writer.events[-1]
+    assert ev2.recompiles == 0
+    assert ev2.h2d_bytes < ev.h2d_bytes
+    assert ev2.pad_ratio == 0.0
+
+
+def test_query_many_batch_receipt_covers_pipelined_dispatch(monkeypatch):
+    """query_many's phase-1 work (mirror uploads, compiles) runs before
+    any per-query resolve window — the query.batch root's receipt must
+    carry it so the batch path never looks free."""
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    store = _fill(TpuDataStore(executor=TpuScanExecutor()), n=4000)
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        store.query_many("gdelt", [CQL, "bbox(geom, -10, -10, 10, 10)"])
+    batch = [t for t in ring.traces if t.name == "query.batch"][-1]
+    receipt = batch.attributes["device"]
+    # the cold mirror upload happened inside the batch window
+    assert receipt["h2d_bytes"] > 0
+    assert receipt["d2h_bytes"] > 0
+
+
+def test_faulted_fetch_counts_no_d2h_bytes(monkeypatch):
+    """A device.fetch fault degrades the query to the host scan: no
+    bytes crossed the link, so the monotone counter must not move for
+    the failed transfer (counting happens after the read succeeds)."""
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+    from geomesa_tpu.utils import faults
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    store = _fill(TpuDataStore(executor=TpuScanExecutor()), n=4000)
+    hits_clean = len(store.query("gdelt", CQL))  # warm: mirror uploaded
+    before = devstats.receipt_snapshot()
+    with faults.inject("device.fetch:error"):
+        res = store.query("gdelt", "bbox(geom, -29, -29, 29, 29) AND dtg "
+                          "DURING 2017-01-05T00:00:00Z/2017-01-20T00:00:00Z")
+    after = devstats.receipt_snapshot()
+    assert len(res) > 0 and hits_clean > 0  # degradation answered
+    assert after["d2h_bytes"] == before["d2h_bytes"]
+
+
+def test_receipt_in_slow_query_log(monkeypatch, caplog):
+    """The cost receipt rides the root span's attrs, so the slow-query
+    dump carries it next to the tree it explains."""
+    import logging
+
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    store = _fill(
+        TpuDataStore(executor=TpuScanExecutor(), slow_query_s=0.0), n=2000
+    )
+    with caplog.at_level(logging.WARNING, logger="geomesa_tpu.slowquery"):
+        store.query("gdelt", CQL)
+    msg = caplog.records[-1].getMessage()
+    assert "h2d_bytes" in msg and "recompiles" in msg
+
+
+# -- registry surfaces --------------------------------------------------------
+
+
+def test_device_debug_payload_shape():
+    doc = devstats.device_debug()
+    assert doc["backend"] == "cpu" and doc["device_count"] >= 1
+    assert {"kernels", "compile", "transfer", "pad", "hbm"} <= set(doc)
+    assert doc["transfer"]["h2d_bytes"] >= 0
+    # runs in a suite that already compiled executor kernels
+    for name, row in doc["kernels"].items():
+        assert row["cache_entries"] >= 0 and row["compiles"] >= 0
+    # the payload is JSON-serializable as the endpoint requires
+    json.dumps(doc, default=str)
+
+
+def test_devstats_prometheus_exposition(tmp_path):
+    """The devstats registry renders through the standard exposition:
+    byte counters as counters, pad/HBM/cache as gauges — and the
+    PrometheusReporter carries them via extra_registries."""
+    import jax.numpy as jnp
+
+    name = _uname("prom")
+    devstats.instrumented_jit(name, lambda x: x + 1)(jnp.zeros(4))
+    devstats.count_h2d(10)
+    devstats.count_d2h(10)
+    devstats.record_pad(100, 128)
+    text = prometheus_text(devstats.devstats_metrics())
+    assert "# TYPE geomesa_device_h2d_bytes counter" in text
+    assert "# TYPE geomesa_device_d2h_bytes counter" in text
+    assert "# TYPE geomesa_device_pad_ratio gauge" in text
+    assert "# TYPE geomesa_device_hbm_live_bytes gauge" in text
+    assert "# TYPE geomesa_xla_cache_entries gauge" in text
+    assert f"geomesa_xla_compile_{name} 1" in text
+    store_reg = MetricsRegistry()
+    store_reg.inc("queries", 2)
+    path = str(tmp_path / "dev.prom")
+    rep = PrometheusReporter(
+        store_reg, path,
+        extra_registries=[devstats.devstats_metrics()],
+    )
+    rep.report_now()
+    body = open(path).read()
+    assert "geomesa_queries 2" in body
+    assert "geomesa_device_h2d_bytes" in body
+    assert "geomesa_device_pad_ratio" in body
+
+
+def test_web_debug_device_and_metrics_carry_devstats(monkeypatch):
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+    from geomesa_tpu.web import GeoMesaServer
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    store = _fill(TpuDataStore(
+        executor=TpuScanExecutor(), metrics=MetricsRegistry()
+    ), n=2000)
+    with GeoMesaServer(store) as url:
+        urllib.request.urlopen(
+            url + "/query?name=gdelt&cql=bbox(geom,-10,-10,10,10)"
+        ).read()
+        dev = json.loads(
+            urllib.request.urlopen(url + "/debug/device").read()
+        )
+        metrics = urllib.request.urlopen(url + "/metrics").read().decode()
+    assert dev["backend"] == "cpu"
+    assert dev["transfer"]["h2d_bytes"] > 0
+    assert any(k.startswith(("runs.", "exact_", "packed."))
+               for k in dev["kernels"]), dev["kernels"]
+    # the same scrape carries store timings AND device telemetry
+    assert 'geomesa_query_scan{quantile="0.99"}' in metrics
+    assert "geomesa_device_h2d_bytes" in metrics
+    assert "geomesa_xla_compile_total" in metrics
+    assert "geomesa_device_pad_ratio" in metrics

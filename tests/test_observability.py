@@ -7,6 +7,7 @@ import json
 import logging
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -341,6 +342,36 @@ def test_gauges_and_gauge_fns():
     assert "broken" not in rep
 
 
+def test_snapshot_skips_failing_gauge_fn(caplog):
+    """The snapshot() skip path itself: a raising gauge callable is
+    logged and omitted while every healthy gauge (set or callable) still
+    samples — a dead probe must never blank a reporter tick."""
+    reg = MetricsRegistry()
+    reg.set_gauge("static", 1)
+    reg.gauge_fn("healthy", lambda: 5)
+    reg.gauge_fn("dying", lambda: (_ for _ in ()).throw(OSError("probe gone")))
+    with caplog.at_level(logging.ERROR, logger="geomesa_tpu.audit"):
+        counters, gauges, timers, totals = reg.snapshot()
+    assert gauges == {"static": 1, "healthy": 5.0}
+    assert "dying" not in gauges
+    assert any("dying" in r.getMessage() for r in caplog.records)
+    # and the failure never leaks into the other snapshot collections
+    assert counters == {} and timers == {} and totals == {}
+
+
+def test_counter_and_gauge_point_reads():
+    """The cheap point accessors the devstats receipt path uses: one
+    dict read, absent names default, gauge_fn callables are NOT sampled
+    (that is snapshot()'s job)."""
+    reg = MetricsRegistry()
+    reg.inc("c", 3)
+    reg.set_gauge("g", 2.5)
+    reg.gauge_fn("fn", lambda: 99)
+    assert reg.counter("c") == 3 and reg.counter("absent") == 0
+    assert reg.gauge("g") == 2.5 and reg.gauge("absent") == 0.0
+    assert reg.gauge("fn") == 0.0  # callable: point read stays cheap
+
+
 def test_snapshot_copies_under_lock():
     """Snapshot collections are copies: concurrent updates during/after a
     report never mutate what a reporter is iterating."""
@@ -488,6 +519,32 @@ def test_web_metrics_healthz_debug_traces():
     q = [t for t in traces if t.get("name") == "query"]
     assert q and q[-1]["attributes"]["type"] == "gdelt"
     assert any(c["name"] == "query.plan" for c in q[-1]["children"])
+
+
+def test_debug_traces_n_validation():
+    """?n= is caller input: non-numeric and negative return 400 (not a
+    bubbled 500), absurdly large clamps to the bounded ring instead of
+    building an arbitrarily large response."""
+    from geomesa_tpu.web import MAX_DEBUG_TRACES, GeoMesaServer
+
+    store = _fill(TpuDataStore(), n=50, name="nval")
+    with GeoMesaServer(store) as url:
+        store.query("nval", "INCLUDE")  # one trace in the ring
+        for bad in ("abc", "1.5", "-1", "-100"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + f"/debug/traces?n={bad}")
+            assert ei.value.code == 400, bad
+            assert "error" in json.loads(ei.value.read())
+        # absurdly large: clamped, served, bounded by the ring
+        huge = json.loads(urllib.request.urlopen(
+            url + "/debug/traces?n=999999999999"
+        ).read())
+        assert isinstance(huge, list) and len(huge) <= MAX_DEBUG_TRACES
+        # n=0 and a normal n still behave
+        assert json.loads(urllib.request.urlopen(
+            url + "/debug/traces?n=0").read()) == []
+        assert len(json.loads(urllib.request.urlopen(
+            url + "/debug/traces?n=5").read())) >= 1
 
 
 def test_server_exit_releases_debug_ring():
