@@ -21,7 +21,7 @@ from geomesa_tpu.geom.base import Point
 from geomesa_tpu.schema.featuretype import parse_spec
 from geomesa_tpu.store.datastore import TpuDataStore
 from geomesa_tpu.store.integrity import fsync_enabled
-from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils import deadline, faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 _SPEC = "filename:String,meta:String,dtg:Date,*geom:Point:srid=4326"
@@ -222,6 +222,7 @@ class BlobStore:
     @staticmethod
     def _write_blob(path: str, data: bytes) -> None:
         with trace.span("fs.block_write", path=path, bytes=len(data)):
+            deadline.check("fs.block_write")
             faults.fault_point("fs.block_write")
             with open(path, "wb") as fh:
                 fh.write(data)
@@ -232,6 +233,7 @@ class BlobStore:
     @staticmethod
     def _read_blob(path: str) -> bytes:
         with trace.span("fs.block_read", path=path):
+            deadline.check("fs.block_read")
             faults.fault_point("fs.block_read")
             with open(path, "rb") as fh:
                 return fh.read()

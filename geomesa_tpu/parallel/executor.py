@@ -60,7 +60,7 @@ from geomesa_tpu.parallel.mesh import (
     shard_map_fn,
 )
 from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
-from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils import deadline, faults, trace
 from geomesa_tpu.utils.devstats import count_d2h, instrumented_jit, record_pad
 
 # initial hit-run capacity: 4096 runs * 8B = 32 KiB per segment transfer
@@ -831,6 +831,7 @@ def _np_local(arr) -> np.ndarray:
     boundary crossing lands on the owning query's trace with the bytes
     that moved (the kernel-vs-link split of arxiv 2203.14362 §5)."""
     with trace.span("device.fetch", bytes=int(getattr(arr, "nbytes", 0))):
+        deadline.check("device.fetch")
         faults.fault_point("device.fetch")
         if getattr(arr, "is_fully_addressable", True):
             out = np.asarray(arr)
@@ -3846,8 +3847,10 @@ class TpuScanExecutor:
     indices, host fallback elsewhere. Also evaluates the exact post-filter
     (numpy) on candidates, like HostScanExecutor."""
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, breaker=None):
         import weakref
+
+        from geomesa_tpu.utils.breaker import CircuitBreaker
 
         self.mesh = mesh if mesh is not None else default_mesh()
         # id() keys can be recycled after GC, so each entry holds a weakref
@@ -3855,6 +3858,11 @@ class TpuScanExecutor:
         # evicted (frees the device-resident shards)
         self._cache: Dict[int, Tuple["weakref.ref", DeviceIndex]] = {}
         self._density_fns: Dict[Tuple[int, int], tuple] = {}
+        # circuit breaker over device.dispatch/fetch: a PERSISTENTLY
+        # failing link short-circuits queries straight to the host scan
+        # (zero per-query dispatch/retry cost) until a half-open probe
+        # succeeds — the probe query itself rebuilds the evicted mirror
+        self.breaker = breaker if breaker is not None else CircuitBreaker("device")
 
     def device_index(self, table: IndexTable) -> DeviceIndex:
         import weakref
@@ -4294,15 +4302,21 @@ class TpuScanExecutor:
                 box_np, win_np = desc
                 box_dev = replicate(self.mesh, box_np)
                 win_dev = None if win_np is None else replicate(self.mesh, win_np)
-                return _PendingScan(
-                    [(seg, seg.dispatch_exact(box_dev, win_dev)) for seg in dev.segments],
-                    exact=True,
-                )
+                pending = []
+                for seg in dev.segments:
+                    # per-segment cooperative check: a many-segment
+                    # dispatch over a stalling link stops mid-stream
+                    # instead of paying every segment's latency first
+                    deadline.check("device.dispatch")
+                    pending.append((seg, seg.dispatch_exact(box_dev, win_dev)))
+                return _PendingScan(pending, exact=True)
         dev = self.device_index(table)
         boxes_dev, windows_dev = self._query_descriptor(table, plan)
-        return _PendingScan(
-            [(seg, seg.dispatch_hits(boxes_dev, windows_dev)) for seg in dev.segments]
-        )
+        pending = []
+        for seg in dev.segments:
+            deadline.check("device.dispatch")
+            pending.append((seg, seg.dispatch_hits(boxes_dev, windows_dev)))
+        return _PendingScan(pending)
 
     def scan_candidates(self, table: IndexTable, plan: QueryPlan):
         """Device candidate scan; None -> caller falls back to host ranges.
@@ -4315,12 +4329,34 @@ class TpuScanExecutor:
         the full filter). The table's mirror is marked unhealthy and
         evicted so the next query triggers a rebuild; fetch-side failures
         during resolution are handled the same way by the datastore's
-        scan loop (store/datastore.py _scan_parts)."""
+        scan loop (store/datastore.py _scan_parts).
+
+        While the device circuit breaker is OPEN, the dispatch is not
+        even attempted: the query takes the host path immediately, with
+        none of the dispatch/retry latency a dead link would charge."""
+        if not self.breaker.allow():
+            trace.event("breaker.short_circuit", breaker=self.breaker.name)
+            return None
         try:
-            return self.dispatch_candidates(table, plan)
+            scan = self.dispatch_candidates(table, plan)
         except Exception as e:  # noqa: BLE001 - device/tunnel failure
+            from geomesa_tpu.utils.audit import QueryTimeout
+
+            if isinstance(e, QueryTimeout):
+                # an expired budget is the QUERY's failure, not the
+                # link's: no degrade, no breaker strike, no mirror
+                # eviction — the timeout propagates crisply. A half-open
+                # probe slot taken by allow() must not stay latched on a
+                # verdict-free exit.
+                self.breaker.cancel_probe()
+                raise
             self.degrade(table, e)
             return None
+        if scan is None or isinstance(scan, _HostSeekScan):
+            # no device boundary was exercised — a half-open probe slot
+            # taken by allow() must not stay latched on a host-only path
+            self.breaker.cancel_probe()
+        return scan
 
     def degrade(self, table: Optional[IndexTable], exc: BaseException) -> None:
         """Record a device->host degradation: evict the failed table's
@@ -4338,6 +4374,10 @@ class TpuScanExecutor:
             self._cache.clear()
         elif self._cache.pop(id(table), None) is not None:
             evicted = 1
+        # every degradation is a breaker failure: enough of them inside
+        # the rolling window opens the circuit and later queries skip
+        # the (doomed) dispatch entirely
+        self.breaker.record_failure()
         m = robustness_metrics()
         m.inc("degrade.device_to_host")
         if evicted:
@@ -4353,6 +4393,13 @@ class TpuScanExecutor:
             f"[executor] device scan failed ({type(exc).__name__}: {exc}); "
             "host path answers; mirror marked for rebuild\n"
         )
+
+    def record_device_success(self) -> None:
+        """A device scan resolved cleanly end-to-end (the datastore calls
+        this after consuming a device scan without degradation). Closes a
+        half-open circuit: the successful probe query just proved the
+        link healthy AND rebuilt the mirror its dispatch needed."""
+        self.breaker.record_success()
 
     # one batched execution answers at most this many queries; longer
     # streams chunk (bounds the [q, 2+2*rcap] transfer and compile shapes)
@@ -4379,6 +4426,31 @@ class TpuScanExecutor:
         Everything else takes the same path dispatch_candidates would.
         """
         out: Dict[int, object] = {}
+        if not self.breaker.allow():
+            # open circuit: the WHOLE batch takes the host path (None
+            # placeholders resolve to host scans in the datastore) with
+            # zero dispatch cost — exactly what per-query short-circuit
+            # does, amortized
+            trace.event("breaker.short_circuit", breaker=self.breaker.name)
+            return out
+        try:
+            return self._dispatch_many_batches(items, out)
+        except Exception as e:
+            from geomesa_tpu.utils.audit import QueryTimeout
+
+            if isinstance(e, QueryTimeout):
+                # budget death mid-batch is no verdict on the link: a
+                # half-open probe slot must not stay latched (non-timeout
+                # failures reach degrade() in the caller, which resolves
+                # the probe via record_failure)
+                self.breaker.cancel_probe()
+            raise
+
+    def _dispatch_many_batches(
+        self, items: Sequence[Tuple[IndexTable, QueryPlan]], out: Dict[int, object]
+    ):
+        """dispatch_many's body, split out so the breaker wrapper above
+        can resolve the half-open probe slot on every exit path."""
         seen: set = set()
         batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
         attr_batchable: Dict[tuple, Tuple[IndexTable, bool, str, list]] = {}
@@ -4388,6 +4460,7 @@ class TpuScanExecutor:
             if id(plan) in seen:
                 continue
             seen.add(id(plan))
+            deadline.check("device.dispatch")
             seek = self._seek_scan(table, plan)
             if seek is not None:
                 out[id(plan)] = seek
@@ -4606,6 +4679,13 @@ class TpuScanExecutor:
                 attr_kind="member" if extra is None else extra[1],
             ),
         )
+        if not any(
+            v is not None and not isinstance(v, _HostSeekScan)
+            for v in out.values()
+        ):
+            # every plan resolved host-side: a half-open probe slot taken
+            # by the batch's allow() must not stay latched
+            self.breaker.cancel_probe()
         return out
 
     @staticmethod

@@ -14,7 +14,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils import deadline, faults, trace
 from geomesa_tpu.utils.devstats import count_h2d, instrumented_jit
 
 DATA_AXIS = "shards"
@@ -107,6 +107,16 @@ def multihost_mesh(
     (single-controller dev mode and tests).
     """
     if coordinator is not None:
+        try:
+            # the CPU backend only runs multi-process collectives over
+            # gloo, and the default is "none" — without this, the first
+            # cross-process psum dies with "Multiprocess computations
+            # aren't implemented on the CPU backend". Harmless on real
+            # TPU/GPU pods (the flag only configures the cpu backend)
+            # and must be set BEFORE any backend initializes.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - older jax without the flag
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
@@ -139,6 +149,7 @@ def shard_array(mesh: Mesh, arr: np.ndarray, axis: str = DATA_AXIS):
     the same name is the tracing half of that contract: every H2D
     boundary crossing lands on the owning query's span tree."""
     with trace.span("device.dispatch", bytes=int(getattr(arr, "nbytes", 0))):
+        deadline.check("device.dispatch")
         faults.fault_point("device.dispatch")
         out = jax.device_put(arr, NamedSharding(mesh, P(axis)))
         # counted AFTER the put: a faulted/failed dispatch moved nothing,
@@ -150,6 +161,7 @@ def shard_array(mesh: Mesh, arr: np.ndarray, axis: str = DATA_AXIS):
 def replicate(mesh: Mesh, arr: np.ndarray):
     """Place a host array on the mesh fully replicated (query descriptors)."""
     with trace.span("device.dispatch", bytes=int(getattr(arr, "nbytes", 0))):
+        deadline.check("device.dispatch")
         faults.fault_point("device.dispatch")
         out = jax.device_put(arr, NamedSharding(mesh, P()))
         count_h2d(int(getattr(arr, "nbytes", 0)))

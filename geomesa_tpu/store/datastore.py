@@ -37,6 +37,7 @@ from geomesa_tpu.store.blocks import (
     take_rows,
 )
 from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
+from geomesa_tpu.utils import deadline as deadline_mod
 from geomesa_tpu.utils import devstats, trace
 
 DEFAULT_FLUSH_SIZE = 100_000
@@ -254,6 +255,8 @@ class TpuDataStore:
         query_timeout_s: Optional[float] = None,
         slow_query_s: Optional[float] = None,
         user: str = "unknown",
+        max_inflight: Optional[int] = None,
+        max_queue: Optional[int] = None,
     ):
         from geomesa_tpu.stats.service import MetadataBackedStats
 
@@ -270,18 +273,32 @@ class TpuDataStore:
             # GEOMESA_QUERY_TIMEOUT or utils.config.set_property
             from geomesa_tpu.utils.config import QUERY_TIMEOUT
 
-            ms = QUERY_TIMEOUT.to_duration_ms()
-            query_timeout_s = None if ms is None else ms / 1000.0
+            query_timeout_s = QUERY_TIMEOUT.to_duration_s()
         self.query_timeout_s = query_timeout_s
         if slow_query_s is None:
             # tiered knob: geomesa.query.slow.threshold — any query over
             # the budget logs its full span tree + explain
             from geomesa_tpu.utils.config import SLOW_QUERY_THRESHOLD
 
-            ms = SLOW_QUERY_THRESHOLD.to_duration_ms()
-            slow_query_s = None if ms is None else ms / 1000.0
+            slow_query_s = SLOW_QUERY_THRESHOLD.to_duration_s()
         self.slow_query_s = slow_query_s
         self.user = user
+        # admission control (utils/admission.py): bounded in-flight
+        # queries + a bounded wait queue; overflow sheds with ShedLoad
+        # instead of queueing into collapse. Knobs:
+        # geomesa.query.max.inflight / geomesa.query.queue.depth.
+        from geomesa_tpu.utils.admission import AdmissionController
+        from geomesa_tpu.utils.config import (
+            QUERY_MAX_INFLIGHT,
+            QUERY_QUEUE_DEPTH,
+        )
+
+        if max_inflight is None:
+            max_inflight = QUERY_MAX_INFLIGHT.to_int() or 64
+        if max_queue is None:
+            mq = QUERY_QUEUE_DEPTH.to_int()
+            max_queue = 256 if mq is None else mq
+        self.admission = AdmissionController(max_inflight, max_queue)
         # write-time maintained sketches feeding the cost-based decider
         # (accumulo/data/stats/StatsCombiner.scala:26 analog)
         self.stats = stats if stats is not None else MetadataBackedStats(self.metadata)
@@ -484,6 +501,10 @@ class TpuDataStore:
         try:
             return count_scan(table, plan)
         except Exception as e:  # noqa: BLE001 - device/tunnel failure
+            from geomesa_tpu.utils.audit import QueryTimeout
+
+            if isinstance(e, QueryTimeout):
+                raise  # the query's budget died, not the device
             mesh_mod.trip_device(
                 self.executor, "GEOMESA_COUNT_DEVICE", "count", e
             )
@@ -502,6 +523,8 @@ class TpuDataStore:
     def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
         import time as _time
 
+        from geomesa_tpu.utils.audit import QueryTimeout, ShedLoad
+
         ft = self.get_schema(name)
         query = self._as_query(query)
         # one span tree per query: plan -> range decomposition -> per-block
@@ -515,30 +538,62 @@ class TpuDataStore:
             with trace.span(
                 "query", force=self.slow_query_s is not None, type=name
             ) as root:
-                # device cost receipt baseline: taken BEFORE preparation
-                # so a lazy store's replay uploads attribute to the query
-                # that paid for them (three dict reads — hot-path safe)
-                dev0 = devstats.receipt_snapshot()
-                self._prepare_query(name, query)
-                # the audited clock starts AFTER preparation: a lazy
-                # store's partition replay is traced (fs.load) but must
-                # not inflate the audited planning time
-                t_start = _time.perf_counter()
-                plan = self._plan_cached(name, query)
-                t_planned = _time.perf_counter()
-                result = self._execute(name, ft, query, plan, t_planned)
-                receipt = devstats.receipt_since(dev0)
-                if root.recording:
-                    root.set_attr("hits", len(result))
-                    root.set_attr("scan_path", self._collect_scan_path(plan))
-                    # the receipt rides the root span too: the slow-query
-                    # log renders it next to the tree it explains
-                    root.set_attr("device", receipt)
-                if self.audit_writer is not None or self.metrics is not None:
-                    self._audit(
-                        name, query, plan, result, t_start, t_planned, receipt
+                t_admit = _time.perf_counter()
+                try:
+                    # the deadline starts at ADMISSION: queue wait, lazy
+                    # replay, planning, and every retry/backoff below all
+                    # spend the same budget — a query can never cost more
+                    # than its deadline (± one fault-point granularity)
+                    with deadline_mod.budget(self.query_timeout_s):
+                        with self.admission.admit():
+                            # device cost receipt baseline: taken BEFORE
+                            # preparation so a lazy store's replay uploads
+                            # attribute to the query that paid for them
+                            # (three dict reads — hot-path safe)
+                            dev0 = devstats.receipt_snapshot()
+                            self._prepare_query(name, query)
+                            # the audited clock starts AFTER preparation:
+                            # a lazy store's partition replay is traced
+                            # (fs.load) but must not inflate the audited
+                            # planning time
+                            t_start = _time.perf_counter()
+                            plan = self._plan_cached(name, query)
+                            t_planned = _time.perf_counter()
+                            result = self._execute(
+                                name, ft, query, plan, t_planned
+                            )
+                            receipt = devstats.receipt_since(dev0)
+                            if root.recording:
+                                root.set_attr("hits", len(result))
+                                root.set_attr(
+                                    "scan_path", self._collect_scan_path(plan)
+                                )
+                                # the receipt rides the root span too: the
+                                # slow-query log renders it next to the
+                                # tree it explains
+                                root.set_attr("device", receipt)
+                            if (
+                                self.audit_writer is not None
+                                or self.metrics is not None
+                            ):
+                                self._audit(
+                                    name, query, plan, result, t_start,
+                                    t_planned, receipt,
+                                )
+                            return result
+                except (QueryTimeout, ShedLoad) as e:
+                    # crisp failure: a timed-out or shed query NEVER
+                    # returns a truncated result set — but it still
+                    # audits, so overload is visible in the same trail
+                    # as the queries it protected
+                    outcome = (
+                        "timeout" if isinstance(e, QueryTimeout) else "shed"
                     )
-                return result
+                    if root.recording:
+                        root.set_attr("outcome", outcome)
+                    if self.audit_writer is not None or self.metrics is not None:
+                        self._audit_failure(name, query, plan, t_admit, outcome)
+                    raise
         finally:
             self._log_slow_query(name, plan, root)
 
@@ -575,18 +630,30 @@ class TpuDataStore:
                 "query.batch", force=self.slow_query_s is not None,
                 type=name, n=len(qs),
             ) as batch:
-                # batch-level cost receipt: the pipelined phase-1 work
-                # (mirror uploads, compiles triggered by dispatch_many)
-                # happens OUTSIDE the per-query resolve windows, so the
-                # batch root carries the whole stream's delta — the
-                # per-query receipts cover only each resolve phase
-                dev0 = devstats.receipt_snapshot()
-                for q in qs:
-                    self._prepare_query(name, q)
-                results = self._query_many_planned(name, ft, qs)
-                if batch.recording:
-                    batch.set_attr("device", devstats.receipt_since(dev0))
-                return results
+                # a batch admits as ONE unit: its queries share a
+                # pipeline and must never deadlock against their own
+                # batchmates waiting for slots. The queue wait itself is
+                # bounded by one query budget (the per-phase budgets
+                # below don't exist yet while we wait).
+                with self.admission.admit(self.query_timeout_s):
+                    # batch-level cost receipt: the pipelined phase-1 work
+                    # (mirror uploads, compiles triggered by dispatch_many)
+                    # happens OUTSIDE the per-query resolve windows, so the
+                    # batch root carries the whole stream's delta — the
+                    # per-query receipts cover only each resolve phase
+                    dev0 = devstats.receipt_snapshot()
+                    # the shared pipeline phase (replay, planning, batched
+                    # dispatch) is one query's worth of shared work: it
+                    # gets one budget; each per-query resolve then runs
+                    # under its own (so a batch of N costs at most N+1
+                    # budgets, and any SINGLE query at most 2)
+                    with deadline_mod.budget(self.query_timeout_s):
+                        for q in qs:
+                            self._prepare_query(name, q)
+                    results = self._query_many_planned(name, ft, qs)
+                    if batch.recording:
+                        batch.set_attr("device", devstats.receipt_since(dev0))
+                    return results
         finally:
             self._log_slow_batch(name, batch)
 
@@ -615,47 +682,59 @@ class TpuDataStore:
     def _query_many_planned(self, name, ft, qs: List[Query]) -> List[QueryResult]:
         import time as _time
 
+        from geomesa_tpu.utils.audit import QueryTimeout
+
         plan_s: List[float] = []
         plans = []
-        for q in qs:
-            t0 = _time.perf_counter()
-            plans.append(self._plan_cached(name, q))
-            plan_s.append(_time.perf_counter() - t0)
         dispatch = getattr(self.executor, "dispatch_candidates", None)
         dispatch_many = getattr(self.executor, "dispatch_many", None)
         pending: Dict[int, object] = {}
-        if dispatch is not None:
-            try:
-                items = []
-                for q, plan in zip(qs, plans):
-                    if "density" in q.hints:
-                        continue  # fused density path dispatches its own compute
-                    arms = plan.union if plan.union is not None else [plan]
-                    for arm in arms:
-                        if arm.is_empty or id(arm) in pending:
-                            continue
-                        table = self._tables[name][arm.index.name]
-                        if dispatch_many is not None:
-                            pending[id(arm)] = None  # placeholder, filled below
-                            items.append((table, arm))
-                        else:
-                            pending[id(arm)] = dispatch(table, arm)
-                if dispatch_many is not None and items:
-                    # exact-shape plans on the same table fuse into one batched
-                    # device execution; the rest dispatch as before
-                    pending.update(dispatch_many(items))
-            except Exception as e:  # noqa: BLE001 - device/tunnel failure
-                # batched dispatch died mid-stream: un-dispatched plans
-                # keep their None placeholders, which _scan_parts already
-                # resolves to the host scan — the whole batch degrades
-                # rather than the batch query dying
-                degrade = getattr(self.executor, "degrade", None)
-                if degrade is not None:
-                    degrade(None, e)
+        # planning + pipelined dispatch: the batch's SHARED phase runs
+        # under one budget (see query_many) — a stalled link fails the
+        # phase crisply and every query degrades to the host scan
+        with deadline_mod.budget(self.query_timeout_s):
+            for q in qs:
+                t0 = _time.perf_counter()
+                plans.append(self._plan_cached(name, q))
+                plan_s.append(_time.perf_counter() - t0)
+            if dispatch is not None:
+                try:
+                    items = []
+                    for q, plan in zip(qs, plans):
+                        if "density" in q.hints:
+                            continue  # fused density path dispatches its own compute
+                        arms = plan.union if plan.union is not None else [plan]
+                        for arm in arms:
+                            if arm.is_empty or id(arm) in pending:
+                                continue
+                            table = self._tables[name][arm.index.name]
+                            if dispatch_many is not None:
+                                pending[id(arm)] = None  # placeholder, filled below
+                                items.append((table, arm))
+                            else:
+                                pending[id(arm)] = dispatch(table, arm)
+                    if dispatch_many is not None and items:
+                        # exact-shape plans on the same table fuse into one batched
+                        # device execution; the rest dispatch as before
+                        pending.update(dispatch_many(items))
+                except QueryTimeout:
+                    # the shared phase's budget died mid-dispatch: the
+                    # un-dispatched plans keep their None placeholders
+                    # and every query resolves from the host scan under
+                    # its OWN budget below — the batch itself survives
+                    pending = {k: None for k in pending}
+                except Exception as e:  # noqa: BLE001 - device/tunnel failure
+                    # batched dispatch died mid-stream: un-dispatched plans
+                    # keep their None placeholders, which _scan_parts already
+                    # resolves to the host scan — the whole batch degrades
+                    # rather than the batch query dying
+                    degrade = getattr(self.executor, "degrade", None)
+                    if degrade is not None:
+                        degrade(None, e)
         results = []
         for q, plan, dt in zip(qs, plans, plan_s):
-            # per-query clock: the timeout budget and audited scan time
-            # cover THIS query's resolve, not the whole batch's
+            # per-query clock AND budget: the timeout and audited scan
+            # time cover THIS query's resolve, not the whole batch's
             t_resolve = _time.perf_counter()
             root = trace.NOOP
             try:
@@ -663,16 +742,17 @@ class TpuDataStore:
                     "query", force=self.slow_query_s is not None,
                     type=name, batched=True,
                 ) as root:
-                    dev0 = devstats.receipt_snapshot()
-                    result = self._execute(name, ft, q, plan, t_resolve, pending)
-                    receipt = devstats.receipt_since(dev0)
-                    if root.recording:
-                        root.set_attr("hits", len(result))
-                        root.set_attr("scan_path", self._collect_scan_path(plan))
-                        root.set_attr("device", receipt)
-                    if self.audit_writer is not None or self.metrics is not None:
-                        self._audit(name, q, plan, result, t_resolve - dt,
-                                    t_resolve, receipt)
+                    with deadline_mod.budget(self.query_timeout_s):
+                        dev0 = devstats.receipt_snapshot()
+                        result = self._execute(name, ft, q, plan, t_resolve, pending)
+                        receipt = devstats.receipt_since(dev0)
+                        if root.recording:
+                            root.set_attr("hits", len(result))
+                            root.set_attr("scan_path", self._collect_scan_path(plan))
+                            root.set_attr("device", receipt)
+                        if self.audit_writer is not None or self.metrics is not None:
+                            self._audit(name, q, plan, result, t_resolve - dt,
+                                        t_resolve, receipt)
             finally:
                 self._log_slow_query(name, plan, root)
             results.append(result)
@@ -720,6 +800,38 @@ class TpuDataStore:
                     h2d_bytes=int(receipt.get("h2d_bytes", 0)),
                     d2h_bytes=int(receipt.get("d2h_bytes", 0)),
                     pad_ratio=float(receipt.get("pad_ratio", 0.0)),
+                )
+            )
+
+    def _audit_failure(self, name, query, plan, t_admit, outcome: str):
+        """Audit trail for a query that FAILED crisply (timeout / shed):
+        hits stay 0 — a failed query never has partial hits — and the
+        elapsed wall (admission wait included) lands in scanning_ms so
+        latency dashboards see the cost overload actually charged."""
+        import time as _time
+
+        from geomesa_tpu.filter.parser import to_cql
+        from geomesa_tpu.utils.audit import QueryEvent
+
+        elapsed_ms = 1000 * (_time.perf_counter() - t_admit)
+        if self.metrics is not None:
+            self.metrics.inc("queries")
+            self.metrics.inc(f"queries.{outcome}")
+        if self.audit_writer is not None:
+            self.audit_writer.write_event(
+                QueryEvent(
+                    store=type(self).__name__,
+                    type_name=name,
+                    user=self.user,
+                    filter=to_cql(query.filter),
+                    hints=dict(query.hints),
+                    date_ms=int(_time.time() * 1000),
+                    planning_ms=0.0,
+                    scanning_ms=elapsed_ms,
+                    hits=0,
+                    scan_path=self._collect_scan_path(plan) if plan is not None else "",
+                    trace_id=trace.current_trace_id() or "",
+                    outcome=outcome,
                 )
             )
 
@@ -793,6 +905,10 @@ class TpuDataStore:
                     table, plan, query.hints["density"]
                 )
             except Exception as e:  # noqa: BLE001 - device/tunnel failure
+                from geomesa_tpu.utils.audit import QueryTimeout
+
+                if isinstance(e, QueryTimeout):
+                    raise  # the query's budget died, not the device
                 # the host reducer (run_density over scanned columns)
                 # answers identically — a dead tunnel mid-execution must
                 # not kill an aggregation query; see mesh.trip_device
@@ -821,6 +937,10 @@ class TpuDataStore:
                     table, plan, query.hints["stats"]
                 )
             except Exception as e:  # noqa: BLE001 - device/tunnel failure
+                from geomesa_tpu.utils.audit import QueryTimeout
+
+                if isinstance(e, QueryTimeout):
+                    raise  # the query's budget died, not the device
                 mesh_mod.trip_device(
                     self.executor, "GEOMESA_STATS_DEVICE", "stats", e
                 )
@@ -925,9 +1045,17 @@ class TpuDataStore:
             plan.scan_path = _scan_label(scan)
             sp.set_attr("scan_path", plan.scan_path)
             try:
-                return self._consume_scan(
+                parts = self._consume_scan(
                     ft, query, plan, table, scan, device_scan, t_scan_start
                 )
+                if device_scan and plan.scan_path.startswith("device"):
+                    # a device scan resolved end-to-end: tell the
+                    # executor's circuit breaker (a successful half-open
+                    # probe closes the circuit here)
+                    ok = getattr(self.executor, "record_device_success", None)
+                    if ok is not None:
+                        ok()
+                return parts
             except Exception as e:
                 from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
 
@@ -962,6 +1090,7 @@ class TpuDataStore:
         re-enter with the host scan."""
         import time as _time
 
+        dl = deadline_mod.ambient()
         parts: List[tuple] = []
         if scan is None:
             if plan.ranges:
@@ -1004,7 +1133,13 @@ class TpuDataStore:
             else:
                 block, rows = item
                 covered = None
-            if self.query_timeout_s is not None and (
+            # cooperative per-block check against the query's ambient
+            # deadline (installed by query()/query_many from
+            # query_timeout_s); direct _execute callers without a budget
+            # fall back to the legacy between-blocks clock
+            if dl is not None:
+                dl.check("scan.block")
+            elif self.query_timeout_s is not None and (
                 _time.perf_counter() - t_scan_start > self.query_timeout_s
             ):
                 from geomesa_tpu.utils.audit import QueryTimeout

@@ -45,7 +45,7 @@ from geomesa_tpu.store.integrity import (
 )
 from geomesa_tpu.store.metadata import FileMetadata
 from geomesa_tpu.store.partitions import PartitionScheme, from_config, parse_scheme
-from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils import deadline, faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 _EXTS = (".npz", ".parquet")
@@ -72,6 +72,7 @@ class FsDataStore(TpuDataStore):
         partition_scheme: Union[str, PartitionScheme, None] = None,
         lazy: bool = False,
         block_format: str = "npz",
+        **kwargs,
     ):
         if block_format not in ("npz", "parquet"):
             raise ValueError(f"unknown block format: {block_format!r}")
@@ -86,7 +87,11 @@ class FsDataStore(TpuDataStore):
         self._loaded: Dict[str, Set[str]] = {}
         self._loading = True
         os.makedirs(os.path.join(root, "blocks"), exist_ok=True)
-        kwargs = {} if flush_size is None else {"flush_size": flush_size}
+        if flush_size is not None:
+            kwargs["flush_size"] = flush_size
+        # remaining kwargs (query_timeout_s, audit_writer, max_inflight,
+        # ...) pass straight through: the fs store takes the same
+        # deadline/admission knobs as the base facade
         super().__init__(
             metadata=FileMetadata(os.path.join(root, "metadata.json")),
             executor=executor,
@@ -378,6 +383,7 @@ def _write_block(path: str, ft: FeatureType, columns: Columns, fmt: str) -> None
 
 
 def _write_block_once(path: str, ft: FeatureType, columns: Columns, fmt: str) -> None:
+    deadline.check("fs.block_write")
     faults.fault_point("fs.block_write")
     tmp = os.path.join(os.path.dirname(path), "." + os.path.basename(path) + ".tmp")
     if fmt == "npz":
@@ -423,6 +429,7 @@ def _read_block(path: str, ft: FeatureType) -> Columns:
 
 
 def _read_block_once(path: str, ft: FeatureType) -> Columns:
+    deadline.check("fs.block_read")
     faults.fault_point("fs.block_read")
     if path.endswith(".npz"):
         # streaming CRC pass, then np.load straight off the file (zipfile

@@ -20,7 +20,7 @@ from geomesa_tpu.store.integrity import (
     quarantine,
     read_verified,
 )
-from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils import deadline, faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 
@@ -102,6 +102,7 @@ class FileMetadata(Metadata):
             self._SAVE_RETRY.call(self._flush_once)
 
     def _flush_once(self):
+        deadline.check("metadata.save")
         faults.fault_point("metadata.save")
         tmp = f"{self.path}.{os.getpid()}.tmp"
         with open(tmp, "w") as fh:

@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Tuple
 
-from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils import deadline, faults, trace
 
 
 class InProcessBroker:
@@ -47,6 +47,7 @@ class InProcessBroker:
         offsets. ``partitions`` restricts to an assignment subset.
         """
         with trace.span("broker.poll", topic=topic) as sp:
+            deadline.check("broker.poll")
             faults.fault_point("broker.poll")
             out: List[Tuple[int, int, bytes]] = []
             logs = self._topic(topic)

@@ -27,7 +27,7 @@ import os
 import struct
 from typing import Dict, List, Optional, Tuple
 
-from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils import deadline, faults, trace
 
 _LEN = struct.Struct("<I")
 
@@ -139,6 +139,7 @@ class FileLogBroker:
             return out
 
     def _poll_once(self, topic, offsets, max_records, partitions):
+        deadline.check("broker.poll")
         faults.fault_point("broker.poll")
         out: List[Tuple[int, int, bytes]] = []
         for p in partitions if partitions is not None else range(self.partitions):

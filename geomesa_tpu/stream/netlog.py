@@ -36,7 +36,8 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from geomesa_tpu.stream.filelog import FileLogBroker, FileOffsetManager
-from geomesa_tpu.utils import faults, trace
+from geomesa_tpu.utils import deadline, faults, trace
+from geomesa_tpu.utils.breaker import CircuitBreaker
 from geomesa_tpu.utils.retry import RetryPolicy
 
 _LEN = struct.Struct("<I")
@@ -255,12 +256,28 @@ class RemoteLogBroker:
         partitions: Optional[int] = None,
         at_least_once: bool = False,
         retry: Optional[RetryPolicy] = None,
+        timeout_s: Optional[float] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
+        from geomesa_tpu.utils.config import NETLOG_TIMEOUT
+
         self.host = host
         self.port = port
         self.at_least_once = bool(at_least_once)
         self._retry = retry if retry is not None else RetryPolicy(
             name="netlog", max_attempts=4, base_s=0.02, cap_s=0.5,
+        )
+        # per-attempt socket budget: geomesa.netlog.timeout, further
+        # clamped to the calling query's remaining deadline per attempt —
+        # no blocking recv can outlive the query that issued it
+        if timeout_s is None:
+            timeout_s = NETLOG_TIMEOUT.to_duration_s(30.0)
+        self._timeout_s = float(timeout_s)
+        # circuit breaker over the RPC: a persistently unreachable broker
+        # fails FAST (CircuitOpen, a ConnectionError) instead of charging
+        # every call the full retry ladder; a half-open probe re-dials
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            "netlog.rpc"
         )
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
@@ -272,7 +289,10 @@ class RemoteLogBroker:
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection((self.host, self.port), timeout=30)
+            s = socket.create_connection(
+                (self.host, self.port),
+                timeout=deadline.io_timeout(self._timeout_s, "netlog.dial"),
+            )
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
         return self._sock
@@ -281,11 +301,19 @@ class RemoteLogBroker:
         """One full request/response exchange; any transport failure
         drops the cached socket so the next attempt redials. Each
         attempt is its own ``netlog.rpc`` span, so a trace shows retries
-        as sibling spans (the failed ones carry error events)."""
+        as sibling spans (the failed ones carry error events). The
+        socket timeout is re-derived PER ATTEMPT from the remaining
+        query budget (min with geomesa.netlog.timeout) — a stalled
+        broker costs at most the deadline, never the 30 s constant this
+        used to hardcode."""
         with trace.span("netlog.rpc", op=str(head.get("op", ""))):
             try:
                 sock = self._connect()
+                deadline.check("netlog.rpc")
                 faults.fault_point("netlog.rpc")
+                sock.settimeout(
+                    deadline.io_timeout(self._timeout_s, "netlog.rpc")
+                )
                 _send_msg(sock, json.dumps(head).encode())
                 if payload is not None:
                     _send_msg(sock, payload)
@@ -310,16 +338,37 @@ class RemoteLogBroker:
         if tid:
             head.setdefault("trace", tid)
         with self._lock:
-            if head.get("op") in _IDEMPOTENT_OPS or self.at_least_once:
-                return self._retry.call(self._attempt, head, payload)
-            # at-most-once: an attempt that fails AFTER the request ships
-            # may already be applied server-side, so it surfaces to the
-            # caller (or opt in with at_least_once=True). Establishing the
-            # connection is unambiguously before any apply, though — dial
-            # failures always retry, so a producer survives a server
-            # restart between sends.
-            self._retry.call(self._connect)
-            return self._attempt(head, payload)
+            # open circuit: fail fast with CircuitOpen (a
+            # ConnectionError) — no dial, no retry ladder. The cooldown's
+            # half-open probe is the only call that pays the attempt.
+            self._breaker.check()
+            try:
+                if head.get("op") in _IDEMPOTENT_OPS or self.at_least_once:
+                    out = self._retry.call(self._attempt, head, payload)
+                else:
+                    # at-most-once: an attempt that fails AFTER the request
+                    # ships may already be applied server-side, so it
+                    # surfaces to the caller (or opt in with
+                    # at_least_once=True). Establishing the connection is
+                    # unambiguously before any apply, though — dial
+                    # failures always retry, so a producer survives a
+                    # server restart between sends.
+                    self._retry.call(self._connect)
+                    out = self._attempt(head, payload)
+            except OSError:
+                # retries exhausted (or at-most-once surfaced a transport
+                # failure): one breaker strike per FAILED CALL, not per
+                # attempt — absorbed retries never open the circuit
+                self._breaker.record_failure()
+                raise
+            except BaseException:
+                # non-transport exit (QueryTimeout, a broker-side app
+                # error): no verdict on the link — release a half-open
+                # probe slot rather than latching it forever
+                self._breaker.cancel_probe()
+                raise
+            self._breaker.record_success()
+            return out
 
     def close(self) -> None:
         if self._sock is not None:

@@ -97,11 +97,17 @@ class RespCache(EnrichmentCache):
     network round trip per distinct key per ingest, not per row)."""
 
     def __init__(self, host: str, port: int = 6379, prefix: str = "",
-                 timeout_s: float = 10.0):
+                 timeout_s: Optional[float] = None):
+        if timeout_s is None:
+            # shared knob (geomesa.socket.timeout) rather than a
+            # hardcoded constant: no I/O boundary is unbounded-by-default
+            from geomesa_tpu.utils.config import SOCKET_TIMEOUT
+
+            timeout_s = SOCKET_TIMEOUT.to_duration_s(10.0)
         self.host = host
         self.port = int(port)
         self.prefix = prefix
-        self.timeout_s = timeout_s
+        self.timeout_s = float(timeout_s)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._memo: Dict[str, Any] = {}
@@ -110,8 +116,14 @@ class RespCache(EnrichmentCache):
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
+            # clamped to the ambient query deadline, when one is active
+            # (an enrichment lookup inside a bounded ingest/query must
+            # not outlive it)
+            from geomesa_tpu.utils import deadline
+
             self._sock = socket.create_connection(
-                (self.host, self.port), timeout=self.timeout_s
+                (self.host, self.port),
+                timeout=deadline.io_timeout(self.timeout_s, "resp.connect"),
             )
             self._rfile = self._sock.makefile("rb")
         return self._sock

@@ -1,0 +1,179 @@
+"""Admission control: bounded in-flight queries + a bounded wait queue.
+
+The overload half of the deadline/breaker layer. Under a traffic spike an
+unbounded query path queues work it can never finish — every query gets
+slower until all of them time out (congestion collapse). Admission
+control makes shedding DETERMINISTIC instead:
+
+* at most ``max_inflight`` queries execute concurrently;
+* at most ``max_queue`` more wait for a slot, their wait charged against
+  their own deadline (``utils.deadline`` — a query that spends its whole
+  budget queued raises ``QueryTimeout`` without ever executing);
+* anything beyond that raises ``ShedLoad`` IMMEDIATELY — a fast, honest
+  refusal that web.py maps to 503 + Retry-After, costing the server
+  almost nothing while it digs out.
+
+Wired into ``TpuDataStore.query``/``query_many`` (a batch admits as one
+unit: its queries share a pipeline and must not deadlock against their
+own batchmates). Defaults come from ``geomesa.query.max.inflight`` /
+``geomesa.query.queue.depth`` (utils/config.py); the uncontended path is
+one lock acquire/release, so the gate adds no measurable per-query cost.
+
+Observability rides the existing rails: queue waits appear as
+``admit.wait`` spans on the waiting query's trace, sheds count under
+``shed.overflow`` / ``shed.queue_timeout`` in
+``utils.audit.robustness_metrics()``, and the live snapshot serves on
+``/debug/overload`` (+ ``/healthz`` reports degraded while shedding).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from geomesa_tpu.utils import deadline as deadline_mod
+from geomesa_tpu.utils import trace
+from geomesa_tpu.utils.audit import QueryTimeout, ShedLoad, robustness_metrics
+
+# /healthz reports "degraded" while a shed happened within this window
+_RECENT_SHED_S = 30.0
+
+
+class AdmissionController:
+    """Counting semaphore + bounded FIFO-ish wait queue over one lock.
+
+    ``with controller.admit(): ...`` around each query. Waiters are
+    charged against their ambient deadline; overflow sheds instantly."""
+
+    def __init__(self, max_inflight: int, max_queue: int, name: str = "query"):
+        if max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        if max_queue < 0:
+            raise ValueError("max_queue must be >= 0")
+        self.max_inflight = int(max_inflight)
+        self.max_queue = int(max_queue)
+        self.name = name
+        self._cond = threading.Condition()
+        self.inflight = 0
+        self.queued = 0
+        self.sheds = 0
+        self._last_shed: Optional[float] = None
+
+    def admit(self, budget_s: Optional[float] = None) -> "_Admit":
+        """Context manager around one query (or one batch). ``budget_s``
+        bounds the QUEUE WAIT for callers that haven't installed an
+        ambient deadline yet (query_many admits before its per-query
+        budgets exist); with an ambient deadline active it is ignored —
+        the query's own budget already charges the wait."""
+        return _Admit(self, budget_s)
+
+    # -- internals -----------------------------------------------------------
+
+    def _shed_locked(self) -> None:
+        self.sheds += 1
+        self._last_shed = time.monotonic()
+        robustness_metrics().inc("shed.overflow")
+        trace.event(
+            "shed.overflow",
+            inflight=self.inflight,
+            queued=self.queued,
+            max_queue=self.max_queue,
+        )
+        raise ShedLoad(
+            f"admission refused: {self.inflight} queries in flight "
+            f"(max {self.max_inflight}) and the wait queue is full "
+            f"({self.queued}/{self.max_queue}) — retry after backoff"
+        )
+
+    def _acquire(self) -> None:
+        with self._cond:
+            # fast path: a free slot and nobody ahead of us in the queue
+            if self.queued == 0 and self.inflight < self.max_inflight:
+                self.inflight += 1
+                return
+            if self.queued >= self.max_queue:
+                self._shed_locked()
+        # contended: wait with the queue, the wait charged against THIS
+        # query's deadline (queue time is query time)
+        dl = deadline_mod.ambient()
+        t0 = time.perf_counter()
+        with trace.span("admit.wait") as sp:
+            with self._cond:
+                if self.queued >= self.max_queue:
+                    self._shed_locked()
+                self.queued += 1
+                try:
+                    while self.inflight >= self.max_inflight:
+                        left = None if dl is None else dl.remaining()
+                        if left is not None and left <= 0.0:
+                            self._last_shed = time.monotonic()
+                            robustness_metrics().inc("shed.queue_timeout")
+                            trace.event(
+                                "deadline.exceeded", point="admit.wait",
+                            )
+                            raise QueryTimeout(
+                                "query budget exhausted after "
+                                f"{time.perf_counter() - t0:.3f}s in the "
+                                "admission queue (never executed)"
+                            )
+                        self._cond.wait(timeout=left)
+                    self.inflight += 1
+                finally:
+                    self.queued -= 1
+            if sp.recording:
+                sp.set_attr(
+                    "waited_ms", (time.perf_counter() - t0) * 1000.0
+                )
+
+    def _release(self) -> None:
+        with self._cond:
+            self.inflight -= 1
+            self._cond.notify()
+
+    # -- observability -------------------------------------------------------
+
+    def recently_shedding(self, window_s: float = _RECENT_SHED_S) -> bool:
+        last = self._last_shed
+        return last is not None and time.monotonic() - last < window_s
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "inflight": self.inflight,
+                "queued": self.queued,
+                "max_inflight": self.max_inflight,
+                "max_queue": self.max_queue,
+                "sheds": self.sheds,
+                "recently_shedding": self.recently_shedding(),
+            }
+
+
+class _Admit:
+    """The admit() context manager (split out so admit() itself stays
+    cheap to call and re-enterable per query)."""
+
+    __slots__ = ("_ctl", "_held", "_budget_s")
+
+    def __init__(self, ctl: AdmissionController, budget_s: Optional[float] = None):
+        self._ctl = ctl
+        self._held = False
+        self._budget_s = budget_s
+
+    def __enter__(self) -> "_Admit":
+        if self._budget_s is not None and deadline_mod.ambient() is None:
+            # bound the wait itself; the budget deliberately does NOT
+            # extend over the admitted work (query_many installs its own
+            # per-phase budgets after admission)
+            with deadline_mod.budget(self._budget_s):
+                self._ctl._acquire()
+        else:
+            self._ctl._acquire()
+        self._held = True
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._held:
+            self._held = False
+            self._ctl._release()
+        return False

@@ -48,6 +48,11 @@ class QueryEvent:
     h2d_bytes: int = 0
     d2h_bytes: int = 0
     pad_ratio: float = 0.0
+    # how the query ended: "ok", "timeout" (QueryTimeout — budget
+    # exhausted), or "shed" (ShedLoad — admission control refused it).
+    # Timed-out and shed queries still audit: overload behavior must be
+    # visible in the same trail as the successes it protected.
+    outcome: str = "ok"
 
 
 class AuditWriter:
@@ -236,6 +241,15 @@ def robustness_metrics() -> MetricsRegistry:
         quarantine.files           corrupt files renamed aside
         degrade.device_to_host     queries degraded to the host scan path
         degrade.mirror_rebuilds    device mirrors evicted for rebuild
+        deadline.exceeded          query budgets exhausted (utils.deadline)
+        shed.overflow              queries refused outright (queue full)
+        shed.queue_timeout         queries whose budget died in the queue
+        breaker.<name>.opens       circuits tripped open (utils.breaker)
+        breaker.<name>.reopens     half-open probes that failed
+        breaker.<name>.closes      successful probes (circuit healed)
+        breaker.<name>.probes      half-open probe attempts
+        breaker.<name>.short_circuit  calls refused while open
+        breaker.<name>.state       gauge: 0 closed / 0.5 half-open / 1 open
 
     One shared registry rather than per-store: the layers that fault
     (block readers, the RPC client, the device executor) are constructed
@@ -393,9 +407,14 @@ class GraphiteReporter(Reporter):
     def _connect(self):
         import socket
 
+        from geomesa_tpu.utils.config import SOCKET_TIMEOUT
+
         if self._sock is None:
+            # shared knob, not a hardcoded constant: no I/O boundary is
+            # unbounded-by-default, and operators tune ONE property
             self._sock = socket.create_connection(
-                (self.host, self.port), timeout=10
+                (self.host, self.port),
+                timeout=SOCKET_TIMEOUT.to_duration_s(10.0),
             )
         return self._sock
 
@@ -665,4 +684,14 @@ def reporters_from_config(
 class QueryTimeout(RuntimeError):
     """Raised when a query exceeds the store's timeout budget
     (the ThreadManagement reaper analog, index/utils/ThreadManagement.scala:
-    21-60 — checked between scan units instead of a reaper thread)."""
+    21-60 — checked cooperatively at fault points / scan blocks / socket
+    reads via ``utils.deadline`` instead of a reaper thread). A timed-out
+    query fails crisply: it NEVER returns a truncated result set."""
+
+
+class ShedLoad(RuntimeError):
+    """Raised when admission control refuses a query outright: every
+    in-flight slot is taken AND the bounded wait queue is full
+    (``utils.admission``). Deliberately fast and cheap — shedding exists
+    so overload degrades to quick, honest 503s instead of queueing into
+    collapse. web.py maps it to 503 + Retry-After."""

@@ -1,0 +1,234 @@
+"""Circuit breakers for repeatedly failing device / network boundaries.
+
+PR 1's degradation layer made a single device failure cheap (the query
+re-answers from the host scan); this module makes a PERSISTENT failure
+cheap. Without it, a dead device tunnel or unreachable broker pays the
+full dispatch-and-retry cost on every query — each one rediscovering the
+same outage. A ``CircuitBreaker`` remembers:
+
+    closed     normal operation; failures accumulate in a rolling window
+    open       the window filled (``failures`` within ``window_s``):
+               calls short-circuit instantly for ``cooldown_s`` —
+               breaker-guarded queries take their degrade path with ZERO
+               per-query failure cost
+    half-open  cooldown elapsed: exactly ONE probe call is let through;
+               success closes the circuit (and, for the device breaker,
+               the probe query rebuilds the evicted mirror), failure
+               re-opens it for another cooldown
+
+Guarded boundaries: ``device.dispatch``/``device.fetch`` (the
+TpuScanExecutor's scan dispatch — open means queries go straight to the
+host scan) and ``netlog.rpc`` (RemoteLogBroker — open fails fast with
+``CircuitOpen`` instead of paying a full retry ladder per call).
+
+State is observable everywhere the rest of the robustness layer already
+lives: ``breaker.<name>.*`` counters and a ``breaker.<name>.state``
+gauge in ``utils.audit.robustness_metrics()``, transitions as trace
+events on the query that caused them, and the process-wide
+``breaker_states()`` snapshot behind ``/healthz`` (degraded while any
+circuit is open) and ``/debug/overload``.
+
+Defaults come from the tiered knobs ``geomesa.breaker.failures`` /
+``geomesa.breaker.window`` / ``geomesa.breaker.cooldown``
+(utils/config.py); ``clock`` is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Callable, Dict, Optional
+
+from geomesa_tpu.utils import trace
+from geomesa_tpu.utils.audit import robustness_metrics
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+# severity order for merging several same-named breakers into one report
+_SEVERITY = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+_STATE_GAUGE = {CLOSED: 0.0, HALF_OPEN: 0.5, OPEN: 1.0}
+
+# every live breaker, for /healthz + /debug/overload (weak: a breaker
+# dies with its executor/client and must not be pinned by telemetry)
+_REGISTRY: "weakref.WeakSet[CircuitBreaker]" = weakref.WeakSet()
+_REGISTRY_LOCK = threading.Lock()
+
+
+class CircuitOpen(ConnectionError):
+    """Fast-fail raised at a breaker-guarded boundary while the circuit
+    is open. A ConnectionError (and so an OSError): callers that already
+    classify transport failures as transient treat a refused call
+    exactly like the outage it stands in for — minus the latency."""
+
+
+class CircuitBreaker:
+    """One guarded boundary's closed/open/half-open state machine.
+
+    ``record_failure()`` after each boundary failure, ``record_success()``
+    after each success, ``allow()`` (or ``check()``, which raises
+    ``CircuitOpen``) before each call. Thread-safe; all transitions and
+    refusals are counted under ``breaker.<name>.*``."""
+
+    def __init__(
+        self,
+        name: str,
+        failures: Optional[int] = None,
+        window_s: Optional[float] = None,
+        cooldown_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        from geomesa_tpu.utils.config import (
+            BREAKER_COOLDOWN,
+            BREAKER_FAILURES,
+            BREAKER_WINDOW,
+        )
+
+        self.name = name
+        if failures is None:
+            failures = BREAKER_FAILURES.to_int() or 5
+        if window_s is None:
+            window_s = BREAKER_WINDOW.to_duration_s(30.0)
+        if cooldown_s is None:
+            cooldown_s = BREAKER_COOLDOWN.to_duration_s(5.0)
+        if failures < 1:
+            raise ValueError("failures must be >= 1")
+        self.failures = int(failures)
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._window: list = []  # monotonic stamps of recent failures
+        self._opened_at = 0.0
+        self._probing = False  # a half-open probe is in flight
+        with _REGISTRY_LOCK:
+            _REGISTRY.add(self)
+        # live state gauge (same-named breakers overwrite each other;
+        # breaker_states() merges them by worst state instead)
+        ref = weakref.ref(self)
+        robustness_metrics().gauge_fn(
+            f"breaker.{name}.state",
+            lambda: _STATE_GAUGE[ref().state] if ref() is not None else 0.0,
+        )
+
+    # -- state ---------------------------------------------------------------
+
+    def _tick_locked(self) -> None:
+        """Open -> half-open once the cooldown has elapsed."""
+        if (
+            self._state == OPEN
+            and self._clock() - self._opened_at >= self.cooldown_s
+        ):
+            self._state = HALF_OPEN
+            self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._tick_locked()
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed? Closed: always. Open: never (counted under
+        ``breaker.<name>.short_circuit``). Half-open: exactly one probe
+        at a time — concurrent callers short-circuit until the probe
+        reports back."""
+        with self._lock:
+            self._tick_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN or self._probing:
+                robustness_metrics().inc(f"breaker.{self.name}.short_circuit")
+                return False
+            self._probing = True
+            robustness_metrics().inc(f"breaker.{self.name}.probes")
+            return True
+
+    def check(self) -> None:
+        """``allow()`` that raises ``CircuitOpen`` on refusal — for
+        boundaries whose contract is exception-based (the netlog RPC)."""
+        if not self.allow():
+            raise CircuitOpen(
+                f"{self.name} circuit open "
+                f"({self.failures} failures in {self.window_s:g}s; "
+                f"retrying after {self.cooldown_s:g}s cooldown)"
+            )
+
+    def cancel_probe(self) -> None:
+        """The call ``allow()`` admitted never actually exercised the
+        guarded boundary (e.g. the device dispatcher chose a host-only
+        path): release the half-open probe slot without judging the
+        circuit either way. No-op in closed/open."""
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probing = False
+
+    def record_success(self) -> None:
+        """A guarded call succeeded. In half-open this is the probe
+        reporting back: the circuit closes and the failure window
+        clears. While OPEN, a straggler success (a call that dispatched
+        before the trip and only finished now) is IGNORED — the cooldown
+        stands; only a post-cooldown probe may close the circuit."""
+        with self._lock:
+            self._tick_locked()
+            if self._state != HALF_OPEN:
+                return
+            self._state = CLOSED
+            self._probing = False
+            self._window.clear()
+            robustness_metrics().inc(f"breaker.{self.name}.closes")
+            trace.event("breaker.close", breaker=self.name)
+
+    def record_failure(self) -> None:
+        """A guarded call failed. Half-open: the probe failed — re-open
+        for another cooldown. Closed: roll the window; trip open when it
+        fills."""
+        with self._lock:
+            self._tick_locked()
+            now = self._clock()
+            if self._state == HALF_OPEN:
+                self._state = OPEN
+                self._opened_at = now
+                self._probing = False
+                robustness_metrics().inc(f"breaker.{self.name}.reopens")
+                trace.event("breaker.reopen", breaker=self.name)
+                return
+            if self._state == OPEN:
+                return  # already open; nothing new to learn
+            self._window.append(now)
+            cutoff = now - self.window_s
+            while self._window and self._window[0] < cutoff:
+                self._window.pop(0)
+            if len(self._window) >= self.failures:
+                self._state = OPEN
+                self._opened_at = now
+                self._window.clear()
+                robustness_metrics().inc(f"breaker.{self.name}.opens")
+                trace.event(
+                    "breaker.open", breaker=self.name,
+                    cooldown_s=self.cooldown_s,
+                )
+
+
+def breaker_states() -> Dict[str, str]:
+    """Every live breaker's state, worst-per-name (several executors may
+    each carry a "device" breaker) — the /healthz + /debug/overload
+    snapshot. A process is degraded while any circuit is open."""
+    out: Dict[str, str] = {}
+    with _REGISTRY_LOCK:
+        live = list(_REGISTRY)
+    for b in live:
+        s = b.state
+        if _SEVERITY[s] >= _SEVERITY.get(out.get(b.name, CLOSED), 0):
+            out[b.name] = s
+    return out
+
+
+def open_breakers() -> Dict[str, str]:
+    """Just the OPEN circuits. Half-open is routine recovery probing —
+    reporting it as unhealthy would keep /healthz degraded through every
+    probe cycle and prolong the drain after a transient outage."""
+    return {n: s for n, s in breaker_states().items() if s == OPEN}

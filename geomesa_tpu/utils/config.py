@@ -106,6 +106,13 @@ class SystemProperty:
                 continue
         return None
 
+    def to_duration_s(self, default_s: Optional[float] = None) -> Optional[float]:
+        """``to_duration_ms`` in SECONDS, with a caller default — the one
+        home for the ms->s conversion every timeout knob consumer needs
+        (breakers, socket timeouts, query budgets)."""
+        ms = self.to_duration_ms()
+        return default_s if ms is None else ms / 1000.0
+
     def to_bytes(self) -> Optional[int]:
         for v in (self.get(), self.default):
             if v is None:
@@ -124,6 +131,25 @@ class SystemProperty:
 # this execution model. Set the property/env to 2000 for reference parity.
 SCAN_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.target", "512")
 QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", None)
+# Overload protection (utils/admission.py): at most max.inflight queries
+# execute concurrently per store; queue.depth more may wait (the wait
+# charged against each query's own deadline); beyond that, ShedLoad —
+# a fast 503 instead of queueing into collapse.
+QUERY_MAX_INFLIGHT = SystemProperty("geomesa.query.max.inflight", "64")
+QUERY_QUEUE_DEPTH = SystemProperty("geomesa.query.queue.depth", "256")
+# Circuit breakers (utils/breaker.py): trip open after `failures`
+# boundary failures inside `window`, short-circuit for `cooldown`, then
+# let one probe through.
+BREAKER_FAILURES = SystemProperty("geomesa.breaker.failures", "5")
+BREAKER_WINDOW = SystemProperty("geomesa.breaker.window", "30 seconds")
+BREAKER_COOLDOWN = SystemProperty("geomesa.breaker.cooldown", "5 seconds")
+# Socket-timeout knobs: NO I/O boundary is unbounded-by-default. The
+# netlog RPC client derives its per-attempt timeout from
+# min(geomesa.netlog.timeout, the query's remaining deadline); auxiliary
+# sockets (graphite reporter, RESP enrichment cache) use
+# geomesa.socket.timeout.
+NETLOG_TIMEOUT = SystemProperty("geomesa.netlog.timeout", "30 seconds")
+SOCKET_TIMEOUT = SystemProperty("geomesa.socket.timeout", "10 seconds")
 # Slow-query budget: any query slower than this logs its FULL span tree
 # plus the plan explain (the audit-log "why was this one slow" answer;
 # duration string, e.g. '500 ms'). Unset = no slow-query log.

@@ -1,0 +1,139 @@
+"""Per-query deadlines: contextvar-propagated cooperative cancellation.
+
+The reference bounds every query with ``geomesa.query.timeout`` enforced
+by a reaper thread over live scan sessions (index/utils/ThreadManagement
+.scala:21-60, plus Accumulo's own scan-session eviction). This rebuild
+has no reaper; instead the budget travels WITH the query as an ambient
+``Deadline`` (a contextvars value, the same propagation the tracer uses)
+and every boundary that can stall — each named fault point, each scanned
+block, each socket — checks it cooperatively:
+
+* ``deadline.check(point)`` raises ``QueryTimeout`` the moment the
+  budget is gone, so a latency-fault schedule costs at most the deadline
+  plus one fault-point granularity (the "bounded latency" half of the
+  parity-under-faults invariant, ROADMAP.md).
+* ``deadline.io_timeout(default)`` derives a socket timeout from the
+  remaining budget, so no blocking recv can outlive its query
+  (stream/netlog.py, tools/enrichment.py).
+* ``utils.retry.RetryPolicy`` caps its per-call deadline and every
+  backoff sleep at the ambient remaining budget, so a retry loop can
+  never outlive the query that started it.
+
+With no deadline installed (the common case) every helper is one
+ContextVar read — cheap enough to sit on per-block scan paths, the same
+free-when-off posture as ``trace.span`` and ``faults.fault_point``.
+Timed-out work fails CRISPLY: callers get ``QueryTimeout``, never a
+truncated result set. Exceeded budgets are counted in
+``utils.audit.robustness_metrics()`` under ``deadline.exceeded`` and
+land on the suffering query's trace as a ``deadline.exceeded`` event.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from geomesa_tpu.utils import trace
+from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
+
+_CURRENT: contextvars.ContextVar[Optional["Deadline"]] = contextvars.ContextVar(
+    "geomesa_tpu_deadline", default=None
+)
+
+
+class Deadline:
+    """One query's time budget: an absolute monotonic expiry plus the
+    original budget (for error messages / telemetry)."""
+
+    __slots__ = ("budget_s", "t_end")
+
+    def __init__(self, budget_s: float, t_end: Optional[float] = None):
+        self.budget_s = float(budget_s)
+        self.t_end = (
+            time.monotonic() + self.budget_s if t_end is None else float(t_end)
+        )
+
+    def remaining(self) -> float:
+        """Seconds of budget left (negative once expired)."""
+        return self.t_end - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, point: str = "") -> None:
+        """Raise ``QueryTimeout`` if the budget is exhausted. ``point``
+        names the boundary that noticed (fault-point names, "scan.block",
+        "admit.wait", ...) — it lands in the exception, the counter's
+        trace event, and therefore the slow-query log."""
+        if self.t_end - time.monotonic() > 0.0:
+            return
+        robustness_metrics().inc("deadline.exceeded")
+        # the timeout attributes to the suffering query's own span tree,
+        # next to whatever fault/latency event ate the budget
+        trace.event("deadline.exceeded", point=point, budget_s=self.budget_s)
+        where = f" at {point}" if point else ""
+        raise QueryTimeout(
+            f"query exceeded its {self.budget_s:g}s budget{where} "
+            "(geomesa.query.timeout analog)"
+        )
+
+
+@contextmanager
+def budget(budget_s: Optional[float]):
+    """Activate a deadline for the calling scope::
+
+        with deadline.budget(store.query_timeout_s):
+            ...  # every check()/io_timeout() below sees it
+
+    ``None`` is a no-op passthrough (yields the ambient deadline, if
+    any). A nested budget can only TIGHTEN: when an outer deadline
+    expires sooner, the inner scope inherits the outer expiry — a
+    sub-operation's own allowance never extends its query's budget."""
+    if budget_s is None:
+        yield _CURRENT.get()
+        return
+    d = Deadline(budget_s)
+    outer = _CURRENT.get()
+    if outer is not None and outer.t_end < d.t_end:
+        d = Deadline(budget_s, t_end=outer.t_end)
+    token = _CURRENT.set(d)
+    try:
+        yield d
+    finally:
+        _CURRENT.reset(token)
+
+
+def ambient() -> Optional[Deadline]:
+    """The calling context's deadline, or None when unbounded."""
+    return _CURRENT.get()
+
+
+def check(point: str = "") -> None:
+    """Cooperative cancellation hook: ``QueryTimeout`` when the ambient
+    budget is exhausted, free no-op otherwise. Sits next to every named
+    ``faults.fault_point`` (enforced by scripts/lint_robustness.sh)."""
+    d = _CURRENT.get()
+    if d is not None:
+        d.check(point)
+
+
+def remaining() -> Optional[float]:
+    """Ambient remaining budget in seconds, or None when unbounded."""
+    d = _CURRENT.get()
+    return None if d is None else d.remaining()
+
+
+def io_timeout(default_s: Optional[float], point: str = "io") -> Optional[float]:
+    """A socket/IO timeout derived from the remaining budget:
+    ``min(default_s, remaining)``, or ``default_s`` when unbounded.
+    Raises ``QueryTimeout`` (rather than returning a zero timeout) when
+    the budget is already gone — the I/O must not start at all."""
+    d = _CURRENT.get()
+    if d is None:
+        return default_s
+    d.check(point)
+    left = d.remaining()
+    return left if default_s is None else min(float(default_s), left)

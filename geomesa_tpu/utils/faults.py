@@ -215,7 +215,17 @@ def fault_point(point: str) -> None:
         # counters to the trace that suffered the injection
         trace.event(f"fault.{point}.{rule.kind}")
         if rule.kind == "latency":
-            time.sleep(rule.latency_s)
+            # a latency fault never sleeps past the ambient query budget:
+            # the next deadline.check at this boundary fires, so a
+            # latency schedule costs at most deadline + one granularity
+            from geomesa_tpu.utils import deadline as _deadline
+
+            left = _deadline.remaining()
+            time.sleep(
+                rule.latency_s
+                if left is None
+                else max(0.0, min(rule.latency_s, left))
+            )
         elif rule.kind == "drop":
             raise InjectedDrop(f"injected connection drop at {point}")
         else:

@@ -68,8 +68,19 @@ class RetryPolicy:
     def call(self, fn: Callable, *args, **kwargs):
         """``fn(*args, **kwargs)``, retried on retryable failures. The
         final failure re-raises the ORIGINAL exception — callers keep
-        their exception contract."""
+        their exception contract.
+
+        Two budgets bound the loop: the policy's own ``deadline_s`` and
+        the AMBIENT query deadline (``utils.deadline``) — backoff sleeps
+        are clamped to whichever remainder is smaller, so a retry ladder
+        can never outlive the query that started it. A sleep that would
+        consume the entire remaining budget is skipped: the retry after
+        it could only start AT the deadline, so the loop gives up
+        immediately instead of burning the budget asleep."""
+        from geomesa_tpu.utils import deadline as _deadline
+
         t0 = time.monotonic()
+        ambient = _deadline.ambient()
         prev = self.base_s
         attempt = 1
         while True:
@@ -83,12 +94,19 @@ class RetryPolicy:
                     if self.deadline_s is None
                     else self.deadline_s - (time.monotonic() - t0)
                 )
+                if ambient is not None:
+                    amb_left = ambient.remaining()
+                    left = amb_left if left is None else min(left, amb_left)
                 if attempt >= self.max_attempts or (left is not None and left <= 0):
                     robustness_metrics().inc(f"retry.{self.name}.giveup")
                     raise
                 prev = min(self.cap_s, self._rng.uniform(self.base_s, prev * 3))
-                if left is not None:
-                    prev = min(prev, max(0.0, left))
+                if left is not None and prev >= left:
+                    # the backoff would sleep through the rest of the
+                    # budget — the final sleep is pointless; give up NOW
+                    # with the budget intact for the caller's cleanup
+                    robustness_metrics().inc(f"retry.{self.name}.giveup")
+                    raise
                 robustness_metrics().inc(f"retry.{self.name}.retries")
                 self._sleep(prev)
                 attempt += 1

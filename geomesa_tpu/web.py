@@ -11,10 +11,18 @@ SURVEY.md section 2.5). Endpoints over a datastore:
     GET /metrics                 -- Prometheus text exposition (store
                                     registry + robustness counters +
                                     device/compiler telemetry)
-    GET /healthz                 -- liveness/readiness JSON
+    GET /healthz                 -- liveness/readiness JSON ("degraded"
+                                    while a breaker is open or load was
+                                    shed recently)
     GET /debug/traces?n=         -- last n query span trees (JSON)
     GET /debug/device            -- device/compiler telemetry (compile
                                     counts, transfer bytes, pad, HBM)
+    GET /debug/overload          -- breaker states, admission snapshot,
+                                    shed/deadline/breaker counters
+
+Overload mapping: a ShedLoad from admission control answers 503 +
+Retry-After, a QueryTimeout answers 504 — queries fail crisply, never
+with truncated bodies.
 
 Serves with the stdlib ThreadingHTTPServer — start with ``serve(store,
 port)`` or embed ``GeoMesaHandler`` elsewhere. Constructing the server
@@ -41,11 +49,14 @@ def make_handler(store):
         def log_message(self, *args):  # quiet
             pass
 
-        def _send(self, code: int, body, ctype: str = "application/json"):
+        def _send(self, code: int, body, ctype: str = "application/json",
+                  headers=None):
             data = body if isinstance(body, bytes) else body.encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -190,17 +201,31 @@ def make_handler(store):
                     # liveness + a cheap readiness probe: schema metadata
                     # is readable and the registries respond (type_names
                     # is a property on TpuDataStore, a method on the
-                    # stream store — accept both duck types)
+                    # stream store — accept both duck types). Status is
+                    # "degraded" while overload protection is active —
+                    # any circuit open, or the store shed load recently —
+                    # so balancers can steer before queries start failing
+                    from geomesa_tpu.utils.breaker import open_breakers
+
                     types = store.type_names
                     if callable(types):
                         types = types()
+                    unhealthy = open_breakers()
+                    adm = getattr(store, "admission", None)
+                    shedding = adm is not None and adm.recently_shedding()
                     self._send(
                         200,
                         json.dumps(
                             {
-                                "status": "ok",
+                                "status": (
+                                    "degraded"
+                                    if unhealthy or shedding
+                                    else "ok"
+                                ),
                                 "store": type(store).__name__,
                                 "types": list(types),
+                                "breakers": unhealthy,
+                                "shedding": shedding,
                             }
                         ),
                     )
@@ -231,6 +256,35 @@ def make_handler(store):
                             default=str,
                         ),
                     )
+                elif route == "/debug/overload":
+                    # overload-protection debug page: every breaker's
+                    # live state, the store's admission snapshot, and the
+                    # shed/deadline/breaker counters — the operator's
+                    # one-stop "why are we 503ing" answer
+                    from geomesa_tpu.utils.audit import robustness_metrics
+                    from geomesa_tpu.utils.breaker import breaker_states
+
+                    counters, _g, _t, _tt = robustness_metrics().snapshot()
+                    adm = getattr(store, "admission", None)
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "breakers": breaker_states(),
+                                "admission": (
+                                    None if adm is None else adm.snapshot()
+                                ),
+                                "counters": {
+                                    k: v
+                                    for k, v in sorted(counters.items())
+                                    if k.startswith(
+                                        ("shed.", "breaker.", "deadline.")
+                                    )
+                                },
+                            },
+                            default=str,
+                        ),
+                    )
                 elif route == "/debug/device":
                     # device/compiler telemetry page: per-kernel compile +
                     # cache accounting, transfer byte totals, padding
@@ -251,7 +305,20 @@ def make_handler(store):
             except KeyError as e:
                 self._send(400, json.dumps({"error": f"missing param {e}"}))
             except Exception as e:  # surface the error to the client
-                self._send(500, json.dumps({"error": str(e)}))
+                from geomesa_tpu.utils.audit import QueryTimeout, ShedLoad
+
+                if isinstance(e, ShedLoad):
+                    # overload sheds map to the HTTP backpressure idiom:
+                    # 503 + Retry-After, cheap for the server, actionable
+                    # for a well-behaved client
+                    self._send(
+                        503, json.dumps({"error": str(e)}),
+                        headers={"Retry-After": "1"},
+                    )
+                elif isinstance(e, QueryTimeout):
+                    self._send(504, json.dumps({"error": str(e)}))
+                else:
+                    self._send(500, json.dumps({"error": str(e)}))
 
     return GeoMesaHandler
 

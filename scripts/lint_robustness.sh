@@ -7,6 +7,11 @@
 #      time.sleep inside an except handler — outside utils/retry.py;
 #      every retry must route through RetryPolicy so backoff, deadlines,
 #      and the retry.* counters stay uniform
+#   3. deadline pairing (the budget mirror of the PR 2 span-pairing
+#      lint): every file that instruments a named fault point must also
+#      consult the ambient query deadline — a cooperative
+#      deadline.check(...) or a budget-derived io_timeout — so a new
+#      I/O/device boundary can never stall a query past its budget
 #
 # Exits non-zero with the offending lines on any hit.
 set -uo pipefail
@@ -27,6 +32,18 @@ if [ -n "$adhoc" ]; then
     echo "$adhoc"
     fail=1
 fi
+
+# every file instrumenting a fault point must also consult the ambient
+# deadline next to it (faults.py hosts the harness, not a boundary)
+while IFS= read -r f; do
+    [ "$f" = "geomesa_tpu/utils/faults.py" ] && continue
+    if ! grep -qE 'deadline\.(check|io_timeout|remaining|ambient)\(' "$f"; then
+        echo "FAIL: ${f} calls faults.fault_point() but never consults the query deadline"
+        echo "      (add deadline.check(\"<point>\") beside the fault point, or derive"
+        echo "       the boundary's timeout via deadline.io_timeout — utils/deadline.py)"
+        fail=1
+    fi
+done < <(grep -rlE 'faults\.fault_point\(' --include='*.py' geomesa_tpu/ || true)
 
 if [ "$fail" -eq 0 ]; then
     echo "robustness lint clean"

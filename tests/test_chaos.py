@@ -241,3 +241,181 @@ def test_query_many_parity_under_device_faults(seed, monkeypatch):
                        seed=seed):
         got = [sorted(r.fids) for r in dev.query_many("t", QUERIES)]
     assert got == baseline
+
+
+# -- deadlines, breakers, overload (PR 4) -------------------------------------
+# The invariant extended: a latency-fault schedule may stall I/O but costs
+# at most the deadline ± one fault-point granularity, and a timed-out or
+# shed query fails CRISPLY — it never returns a truncated result set.
+
+
+def test_latency_schedule_costs_bounded_latency(tmp_path):
+    """Many 80 ms block-read stalls against a 250 ms budget: QueryTimeout
+    fires within deadline + one fault-point granularity, and the store
+    answers the full result set once the schedule clears."""
+    import time
+
+    from geomesa_tpu.utils.audit import QueryTimeout
+
+    data = rows(n=150, seed=3)
+    root = str(tmp_path / "fs")
+    ingest(FsDataStore(root, flush_size=20), data)  # many blocks to replay
+    baseline = fids(FsDataStore(root))
+
+    lat = 0.08
+    budget = 0.25
+    store = FsDataStore(root, lazy=True, query_timeout_s=budget)
+    with faults.inject(rules=[
+        faults.FaultRule("fs.block_read", "latency", latency_s=lat),
+    ]):
+        t0 = time.perf_counter()
+        with pytest.raises(QueryTimeout):
+            store.query("t", "INCLUDE")
+        elapsed = time.perf_counter() - t0
+    # bounded: the deadline, plus at most one fault granularity, plus CI
+    # scheduling slack — NOT the ~full replay latency the schedule wanted
+    assert elapsed <= budget + lat + 0.5, elapsed
+    # crisp failure: nothing partial was cached — a fresh store still
+    # answers every query identically to the fault-free run
+    assert fids(FsDataStore(root)) == baseline
+
+
+def test_timeout_attributes_to_query_trace(tmp_path):
+    """The QueryTimeout lands on the suffering query's OWN span tree as a
+    deadline.exceeded event, next to the latency faults that ate the
+    budget (the trace edition of the deadline counter)."""
+    from geomesa_tpu.utils import trace
+    from geomesa_tpu.utils.audit import QueryTimeout
+
+    data = rows(n=120, seed=7)
+    root = str(tmp_path / "fs")
+    ingest(FsDataStore(root, flush_size=30), data)
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        with faults.inject(rules=[
+            faults.FaultRule("fs.block_read", "latency", latency_s=0.1),
+        ]):
+            store = FsDataStore(root, lazy=True, query_timeout_s=0.15)
+            with pytest.raises(QueryTimeout):
+                store.query("t", "BBOX(geom, -20, -20, 20, 20)")
+    roots = [t for t in ring.traces if t.name == "query"]
+    assert roots, "timed-out query produced no trace"
+    events = [ev["name"] for sp in roots[-1].walk() for ev in sp.events]
+    assert "deadline.exceeded" in events, roots[-1].render()
+    assert "fault.fs.block_read.latency" in events, roots[-1].render()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_latency_parity_or_crisp_timeout(seed, monkeypatch):
+    """Latency rules on device.dispatch + device.fetch under a deadline:
+    every query either answers IDENTICALLY to the fault-free run or
+    raises QueryTimeout — never a truncated subset."""
+    from geomesa_tpu.utils.audit import QueryTimeout
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    data = rows(n=300, seed=seed)
+    host = TpuDataStore()
+    ingest(host, data)
+    baseline = {q: sorted(host.query("t", q).fids) for q in QUERIES}
+    dev = TpuDataStore(executor=TpuScanExecutor(), query_timeout_s=2.0)
+    ingest(dev, data)
+    with faults.inject(rules=[
+        faults.FaultRule("device.dispatch", "latency", prob=0.5,
+                         latency_s=0.01),
+        faults.FaultRule("device.fetch", "latency", prob=0.5,
+                         latency_s=0.01),
+    ], seed=seed):
+        for q in QUERIES:
+            try:
+                got = sorted(dev.query("t", q).fids)
+            except QueryTimeout:
+                continue  # crisp failure is allowed; truncation is not
+            assert got == baseline[q], q
+
+
+def test_breaker_open_takes_host_path_without_retry_cost(monkeypatch):
+    """A persistently failing device link: after the breaker's window
+    fills, queries short-circuit to the host scan — the device fault
+    point is NOT even reached (no per-query dispatch/retry cost) and
+    answers stay correct throughout."""
+    from geomesa_tpu.utils.breaker import CircuitBreaker
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    data = rows(n=300, seed=2)
+    host = TpuDataStore()
+    ingest(host, data)
+    q = "BBOX(geom, -30, -30, 30, 30)"
+    baseline = sorted(host.query("t", q).fids)
+    ex = TpuScanExecutor(
+        breaker=CircuitBreaker("device", failures=3, window_s=30.0,
+                               cooldown_s=300.0)
+    )
+    dev = TpuDataStore(executor=ex)
+    ingest(dev, data)
+
+    m = robustness_metrics()
+    with faults.inject("device.dispatch:error=1.0"):
+        for _ in range(4):  # 3 strikes open the circuit
+            assert sorted(dev.query("t", q).fids) == baseline
+        assert ex.breaker.state == "open"
+        faults_before = m.counter("fault.device.dispatch.error")
+        degrades_before = m.counter("degrade.device_to_host")
+        sc_before = m.counter("breaker.device.short_circuit")
+        for _ in range(3):
+            assert sorted(dev.query("t", q).fids) == baseline
+        # open circuit: the dispatch (and its fault point) never ran, no
+        # new degradations were paid — the host path answered directly
+        assert m.counter("fault.device.dispatch.error") == faults_before
+        assert m.counter("degrade.device_to_host") == degrades_before
+        assert m.counter("breaker.device.short_circuit") >= sc_before + 3
+
+
+def test_overload_sheds_deterministically_zero_wrong_answers(monkeypatch):
+    """Concurrent queries + device latency faults against a 1-slot store:
+    every query either answers identically to the baseline or fails
+    crisply with ShedLoad/QueryTimeout; sheds are counted; no thread
+    ever sees a wrong or truncated answer."""
+    import threading
+
+    from geomesa_tpu.utils.audit import QueryTimeout, ShedLoad
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    data = rows(n=300, seed=1)
+    host = TpuDataStore()
+    ingest(host, data)
+    q = "BBOX(geom, -30, -30, 30, 30)"
+    baseline = sorted(host.query("t", q).fids)
+    dev = TpuDataStore(executor=TpuScanExecutor(), query_timeout_s=5.0,
+                       max_inflight=1, max_queue=1)
+    ingest(dev, data)
+    assert sorted(dev.query("t", q).fids) == baseline  # warm mirror
+
+    m = robustness_metrics()
+    sheds_before = m.counter("shed.overflow")
+    answers, crisp, wrong = [], [], []
+
+    def worker():
+        try:
+            answers.append(sorted(dev.query("t", q).fids))
+        except (ShedLoad, QueryTimeout) as e:
+            crisp.append(type(e).__name__)
+        except Exception as e:  # noqa: BLE001 - anything else is a failure
+            wrong.append(repr(e))
+
+    with faults.inject(rules=[
+        faults.FaultRule("device.dispatch", "latency", latency_s=0.02),
+        faults.FaultRule("device.fetch", "latency", latency_s=0.02),
+    ]):
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+
+    assert not wrong, wrong
+    assert answers, "no query got through at all"
+    assert all(a == baseline for a in answers)  # zero wrong answers
+    assert crisp, "1 slot + 1 queue under 8 threads shed nothing"
+    assert m.counter("shed.overflow") > sheds_before
+    snap = dev.admission.snapshot()
+    assert snap["inflight"] == 0 and snap["queued"] == 0

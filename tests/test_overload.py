@@ -1,0 +1,421 @@
+"""Deadlines, circuit breakers, and admission control (the overload-proof
+query path): unit coverage for utils/deadline.py, utils/breaker.py,
+utils/admission.py plus the store/web integration — timeout/shed outcomes
+on QueryEvent, 503 + Retry-After mapping, /healthz degradation, and the
+device breaker's host-path short-circuit. The chaos-schedule editions
+(latency soaks, concurrent overload) live in tests/test_chaos.py.
+"""
+
+import gc
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import deadline
+from geomesa_tpu.utils.admission import AdmissionController
+from geomesa_tpu.utils.audit import (
+    InMemoryAuditWriter,
+    QueryTimeout,
+    ShedLoad,
+    robustness_metrics,
+)
+from geomesa_tpu.utils.breaker import (
+    CircuitBreaker,
+    CircuitOpen,
+    breaker_states,
+    open_breakers,
+)
+from geomesa_tpu.utils.retry import RetryPolicy
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+T0 = 1483228800000
+
+
+def counter(name):
+    return robustness_metrics().report().get(name, 0)
+
+
+def _small_store(**kw):
+    s = TpuDataStore(**kw)
+    ft = parse_spec("t", SPEC)
+    s.create_schema(ft)
+    with s.writer("t") as w:
+        for i in range(20):
+            w.write([f"n{i % 3}", T0 + i, Point(float(i % 10), float(i % 7))],
+                    fid=f"f{i}")
+    return s
+
+
+# -- deadline -----------------------------------------------------------------
+
+
+def test_deadline_budget_scope_and_check():
+    assert deadline.ambient() is None
+    with deadline.budget(30.0) as d:
+        assert deadline.ambient() is d
+        assert 0.0 < d.remaining() <= 30.0
+        deadline.check("unit")  # plenty left: no-op
+    assert deadline.ambient() is None
+    deadline.check("unit")  # unbounded: no-op
+
+
+def test_deadline_expiry_raises_and_counts():
+    before = counter("deadline.exceeded")
+    with deadline.budget(0.0):
+        with pytest.raises(QueryTimeout, match="budget at unit"):
+            deadline.check("unit")
+    assert counter("deadline.exceeded") == before + 1
+
+
+def test_nested_budget_only_tightens():
+    with deadline.budget(0.05) as outer:
+        with deadline.budget(60.0) as inner:
+            # a sub-operation's allowance cannot extend its query's budget
+            assert inner.t_end <= outer.t_end
+        with deadline.budget(0.001) as inner2:
+            assert inner2.t_end < outer.t_end  # tighter stays tighter
+
+
+def test_io_timeout_derives_from_budget():
+    assert deadline.io_timeout(30.0) == 30.0  # unbounded: the default
+    with deadline.budget(0.05):
+        assert deadline.io_timeout(30.0) <= 0.05
+        assert deadline.io_timeout(None) <= 0.05  # None = budget alone
+    with deadline.budget(0.0):
+        # exhausted: the I/O must not start at all
+        with pytest.raises(QueryTimeout):
+            deadline.io_timeout(30.0)
+
+
+# -- retry x deadline ---------------------------------------------------------
+
+
+def test_retry_skips_final_pointless_sleep():
+    """The backoff would sleep through the whole remaining budget: the
+    policy gives up NOW instead of burning the deadline asleep (satellite
+    bugfix — the budget used to be checked only per attempt)."""
+    sleeps = []
+    p = RetryPolicy(name="t-clamp", max_attempts=100, base_s=0.5, cap_s=1.0,
+                    deadline_s=0.2, sleep=sleeps.append)
+
+    def always():
+        raise OSError("down")
+
+    before = counter("retry.t-clamp.giveup")
+    with pytest.raises(OSError):
+        p.call(always)
+    assert sleeps == []  # every draw (>= base 0.5s) exceeded the 0.2s left
+    assert counter("retry.t-clamp.giveup") == before + 1
+
+
+def test_retry_capped_by_ambient_query_budget():
+    """A policy with NO deadline of its own still stops when the ambient
+    query budget runs out — a retry ladder can never outlive its query."""
+    calls = []
+
+    def slow_fail():
+        calls.append(1)
+        time.sleep(0.02)
+        raise OSError("outage")
+
+    p = RetryPolicy(name="t-ambient", max_attempts=1000, base_s=0.001,
+                    cap_s=0.002)
+    with deadline.budget(0.06):
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            p.call(slow_fail)
+        elapsed = time.monotonic() - t0
+    assert len(calls) < 1000  # the budget, not max_attempts, ended it
+    assert elapsed < 1.0
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+def test_breaker_lifecycle_closed_open_halfopen():
+    now = [0.0]
+    b = CircuitBreaker("t-dev", failures=3, window_s=10.0, cooldown_s=5.0,
+                       clock=lambda: now[0])
+    assert b.state == "closed" and b.allow()
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()  # short-circuit, instantly
+    now[0] = 5.1  # cooldown over
+    assert b.state == "half-open"
+    assert b.allow()  # the single probe
+    assert not b.allow()  # concurrent callers still short-circuit
+    b.record_failure()  # probe failed
+    assert b.state == "open"
+    now[0] = 10.3
+    assert b.allow()
+    b.record_success()  # probe succeeded
+    assert b.state == "closed" and b.allow()
+
+
+def test_breaker_window_rolls_old_failures_off():
+    now = [0.0]
+    b = CircuitBreaker("t-roll", failures=3, window_s=1.0, cooldown_s=1.0,
+                       clock=lambda: now[0])
+    b.record_failure()
+    now[0] = 2.0  # the first strike ages out of the window
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open"
+
+
+def test_breaker_cancel_probe_releases_the_slot():
+    now = [0.0]
+    b = CircuitBreaker("t-cancel", failures=1, window_s=10.0, cooldown_s=1.0,
+                       clock=lambda: now[0])
+    b.record_failure()
+    now[0] = 1.5
+    assert b.allow()  # probe taken
+    b.cancel_probe()  # ...but the guarded boundary was never exercised
+    assert b.allow()  # slot free again — no permanent latch
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_registry_reports_worst_state():
+    b = CircuitBreaker("t-reg", failures=1, cooldown_s=60.0)
+    assert breaker_states().get("t-reg") == "closed"
+    b.record_failure()
+    assert open_breakers().get("t-reg") == "open"
+    del b
+    gc.collect()
+    assert "t-reg" not in breaker_states()  # dead breakers drop out
+
+
+# -- admission control --------------------------------------------------------
+
+
+def test_admission_fast_path_and_overflow_shed():
+    ctl = AdmissionController(1, 0)
+    before = counter("shed.overflow")
+    with ctl.admit():
+        assert ctl.inflight == 1
+        with pytest.raises(ShedLoad):
+            with ctl.admit():
+                pass
+    assert ctl.inflight == 0
+    with ctl.admit():  # the slot really was released
+        pass
+    assert ctl.sheds == 1 and ctl.recently_shedding()
+    assert counter("shed.overflow") == before + 1
+
+
+def test_admission_queue_wait_charged_against_deadline():
+    """A queued query's wait spends ITS budget: expiry in the queue is a
+    crisp QueryTimeout — it never executed, it never partial-answered."""
+    ctl = AdmissionController(1, 4)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def holder():
+        with ctl.admit():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert entered.wait(5.0)
+    before = counter("shed.queue_timeout")
+    try:
+        with deadline.budget(0.05):
+            t0 = time.monotonic()
+            with pytest.raises(QueryTimeout, match="admission queue"):
+                with ctl.admit():
+                    pass
+            assert time.monotonic() - t0 < 2.0  # woke at the deadline
+    finally:
+        release.set()
+        t.join(5.0)
+    assert counter("shed.queue_timeout") == before + 1
+    assert ctl.queued == 0
+
+
+def test_admission_waiter_proceeds_when_slot_frees():
+    ctl = AdmissionController(1, 4)
+    entered = threading.Event()
+
+    def holder():
+        with ctl.admit():
+            entered.set()
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert entered.wait(5.0)
+    with ctl.admit():  # waits ~50ms, then takes the freed slot
+        assert ctl.inflight == 1
+    t.join(5.0)
+    snap = ctl.snapshot()
+    assert snap["inflight"] == 0 and snap["queued"] == 0
+
+
+# -- store integration --------------------------------------------------------
+
+
+def test_query_timeout_audits_outcome():
+    store = _small_store(query_timeout_s=0.0,
+                         audit_writer=InMemoryAuditWriter())
+    with pytest.raises(QueryTimeout):
+        store.query("t", "INCLUDE")
+    ev = store.audit_writer.events[-1]
+    assert ev.outcome == "timeout"
+    assert ev.hits == 0  # a failed query NEVER has partial hits
+
+
+def test_shed_load_audits_outcome():
+    store = _small_store(max_inflight=1, max_queue=0,
+                         audit_writer=InMemoryAuditWriter())
+    with store.admission.admit():  # someone else holds the only slot
+        with pytest.raises(ShedLoad):
+            store.query("t", "INCLUDE")
+    ev = store.audit_writer.events[-1]
+    assert ev.outcome == "shed" and ev.hits == 0
+    # slot free again: the same query answers fine and audits "ok"
+    assert len(store.query("t", "INCLUDE")) == 20
+    assert store.audit_writer.events[-1].outcome == "ok"
+
+
+def test_query_many_admits_as_one_unit():
+    """A batch takes ONE admission slot: its queries never deadlock
+    against their own batchmates even at max_inflight=1."""
+    store = _small_store(max_inflight=1, max_queue=0)
+    results = store.query_many("t", ["INCLUDE", "name = 'n1'"])
+    assert len(results) == 2 and len(results[0]) == 20
+
+
+def test_timeout_lands_on_query_trace():
+    from geomesa_tpu.utils import trace
+
+    store = _small_store(query_timeout_s=0.0)
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        with pytest.raises(QueryTimeout):
+            store.query("t", "INCLUDE")
+    roots = [t for t in ring.traces if t.name == "query"]
+    assert roots, "timed-out query produced no trace"
+    events = [ev["name"] for sp in roots[-1].walk() for ev in sp.events]
+    assert "deadline.exceeded" in events, roots[-1].render()
+
+
+def test_dispatch_timeout_is_not_a_device_failure(monkeypatch):
+    """A budget that dies mid-dispatch is the QUERY's failure, not the
+    link's: the timeout propagates crisply with NO degrade, NO breaker
+    strike, and the device mirror left intact for the next query."""
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+    ex = TpuScanExecutor()
+    store = _small_store(executor=ex)
+    q = "BBOX(geom, -5, -5, 5, 5)"
+    warm = sorted(store.query("t", q).fids)  # mirror built, no budget
+    degrades = counter("degrade.device_to_host")
+    store.query_timeout_s = 0.0  # the next query expires at first check
+    with pytest.raises(QueryTimeout):
+        store.query("t", q)
+    assert counter("degrade.device_to_host") == degrades
+    assert ex.breaker.state == "closed"
+    assert len(ex._cache) == 1  # the mirror survived
+    store.query_timeout_s = None
+    assert sorted(store.query("t", q).fids) == warm
+
+
+# -- netlog breaker -----------------------------------------------------------
+
+
+def test_netlog_breaker_fails_fast_after_outage(tmp_path):
+    from geomesa_tpu.stream.netlog import LogServer, RemoteLogBroker
+
+    with LogServer(str(tmp_path / "log")) as (host, port):
+        b = RemoteLogBroker(
+            host, port,
+            retry=RetryPolicy(name="netlog", max_attempts=2, base_s=0.001,
+                              cap_s=0.002),
+            breaker=CircuitBreaker("netlog.rpc", failures=2, window_s=30.0,
+                                   cooldown_s=60.0),
+        )
+        b.send("t", 0, b"x")
+    b.close()  # drop the cached socket: the next calls must re-dial
+    # server gone: the first calls pay the (short) retry ladder...
+    for _ in range(2):
+        with pytest.raises(OSError):
+            b.poll("t", {})
+    # ...then the circuit opens and calls fail fast with ZERO retries
+    retries_before = counter("retry.netlog.retries")
+    with pytest.raises(CircuitOpen):
+        b.poll("t", {})
+    assert counter("retry.netlog.retries") == retries_before
+
+
+# -- web surface --------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def test_web_maps_shed_timeout_and_debug_overload():
+    from geomesa_tpu.web import GeoMesaServer
+
+    store = _small_store()
+    orig = store.query
+    with GeoMesaServer(store) as url:
+        # normal query works
+        assert _get(url + "/query?name=t&cql=INCLUDE")["features"]
+
+        store.query = lambda *a, **k: (_ for _ in ()).throw(
+            ShedLoad("overloaded"))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/query?name=t")
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"] == "1"
+
+        store.query = lambda *a, **k: (_ for _ in ()).throw(
+            QueryTimeout("budget gone"))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/query?name=t")
+        assert ei.value.code == 504
+
+        store.query = orig
+        dbg = _get(url + "/debug/overload")
+        assert dbg["admission"]["max_inflight"] == store.admission.max_inflight
+        assert isinstance(dbg["breakers"], dict)
+        assert isinstance(dbg["counters"], dict)
+
+
+def test_healthz_degrades_while_breaker_open_or_shedding():
+    from geomesa_tpu.web import GeoMesaServer
+
+    store = _small_store(max_inflight=1, max_queue=0)
+    b = CircuitBreaker("t-health", failures=1, cooldown_s=300.0)
+    with GeoMesaServer(store) as url:
+        health = _get(url + "/healthz")
+        assert "t-health" not in health["breakers"]
+
+        b.record_failure()  # circuit open -> the process is degraded
+        health = _get(url + "/healthz")
+        assert health["status"] == "degraded"
+        assert health["breakers"]["t-health"] == "open"
+
+        del b
+        gc.collect()
+        with store.admission.admit():
+            with pytest.raises(ShedLoad):
+                store.query("t", "INCLUDE")
+        health = _get(url + "/healthz")  # recent shed also degrades
+        assert health["status"] == "degraded" and health["shedding"]
