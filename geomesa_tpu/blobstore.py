@@ -20,7 +20,7 @@ import numpy as np
 from geomesa_tpu.geom.base import Point
 from geomesa_tpu.schema.featuretype import parse_spec
 from geomesa_tpu.store.datastore import TpuDataStore
-from geomesa_tpu.store.integrity import fsync_enabled
+from geomesa_tpu.store.integrity import cleanup_tmp, durable_write
 from geomesa_tpu.utils import deadline, faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
@@ -178,6 +178,12 @@ class BlobStore:
         self.root = root
         if root:
             os.makedirs(root, exist_ok=True)
+            # open-time scrub (the blob root lives outside any datastore
+            # root, so the store-open scrub never walks it): sweep tmp
+            # stragglers a crashed _write_blob left behind
+            for f in os.listdir(root):
+                if f.endswith(".tmp"):
+                    cleanup_tmp(os.path.join(root, f))
         self._mem: Dict[str, bytes] = {}
         self.store = store or TpuDataStore()
         self.store.create_schema(parse_spec("blobs", _SPEC))
@@ -221,14 +227,14 @@ class BlobStore:
 
     @staticmethod
     def _write_blob(path: str, data: bytes) -> None:
+        # tmp + fsync-before-rename (integrity.durable_write): a crash
+        # mid-write can never publish a torn blob under its final
+        # (content-addressed) id; a failed attempt unlinks its tmp, a
+        # crashed one is swept at the next BlobStore open
         with trace.span("fs.block_write", path=path, bytes=len(data)):
             deadline.check("fs.block_write")
             faults.fault_point("fs.block_write")
-            with open(path, "wb") as fh:
-                fh.write(data)
-                if fsync_enabled():
-                    fh.flush()
-                    os.fsync(fh.fileno())
+            durable_write(path, data)
 
     @staticmethod
     def _read_blob(path: str) -> bytes:

@@ -236,7 +236,13 @@ class FeatureWriter:
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        self.flush()
+        # flush buffered rows on normal exit and on plain failures (the
+        # historical contract), but NOT while a crash-like BaseException
+        # (faults.SimulatedCrash, KeyboardInterrupt) unwinds — a dying
+        # process flushes nothing, and the crash harness depends on the
+        # unwind leaving disk exactly as a SIGKILL would
+        if exc is None or isinstance(exc, Exception):
+            self.flush()
         return False
 
 

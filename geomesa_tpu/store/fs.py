@@ -39,16 +39,30 @@ from geomesa_tpu.store.datastore import ScanExecutor, TpuDataStore
 from geomesa_tpu.store.integrity import (
     CorruptFileError,
     append_crc_footer,
+    cleanup_tmp,
+    fsync_dir,
+    fsync_enabled,
     fsync_replace,
     quarantine,
     verify_file_crc,
 )
+from geomesa_tpu.store.journal import IntentJournal, recover_store
 from geomesa_tpu.store.metadata import FileMetadata
-from geomesa_tpu.store.partitions import PartitionScheme, from_config, parse_scheme
+from geomesa_tpu.store.partitions import (
+    PartitionScheme,
+    load_scheme,
+    parse_scheme,
+    save_scheme,
+)
 from geomesa_tpu.utils import deadline, faults, trace
 from geomesa_tpu.utils.retry import RetryPolicy
 
 _EXTS = (".npz", ".parquet")
+
+# tombstone-sidecar framing: a line starting with the RS control char is
+# one delete batch as a JSON array (fids can contain anything); any other
+# line is a single legacy-format fid
+_TOMBSTONE_BATCH = "\x1e"
 
 # transient I/O failures (real EIO or injected OSError) get bounded
 # retries; CorruptFileError (not an OSError) and FileNotFoundError (a
@@ -89,14 +103,21 @@ class FsDataStore(TpuDataStore):
         os.makedirs(os.path.join(root, "blocks"), exist_ok=True)
         if flush_size is not None:
             kwargs["flush_size"] = flush_size
+        # crash consistency (store/journal.py): every multi-file mutation
+        # below routes through the write-ahead intent journal, and store
+        # open FIRST repairs whatever a previous process left behind —
+        # pending intents roll forward or back, orphan *.tmp files are
+        # swept, old quarantines age out — BEFORE any state is read. The
+        # summary lands on `last_recovery` (GET /debug/recovery).
+        self.journal = IntentJournal(root)
+        meta = FileMetadata(
+            os.path.join(root, "metadata.json"), journal=self.journal
+        )
+        self.last_recovery = recover_store(root, self.journal, metadata=meta)
         # remaining kwargs (query_timeout_s, audit_writer, max_inflight,
         # ...) pass straight through: the fs store takes the same
         # deadline/admission knobs as the base facade
-        super().__init__(
-            metadata=FileMetadata(os.path.join(root, "metadata.json")),
-            executor=executor,
-            **kwargs,
-        )
+        super().__init__(metadata=meta, executor=executor, **kwargs)
         # schemas were recovered by the base ctor; discover stored blocks
         # (and load them eagerly unless lazy)
         for name in self.type_names:
@@ -116,11 +137,9 @@ class FsDataStore(TpuDataStore):
         return os.path.join(self._type_dir(name), "_scheme.json")
 
     def _read_scheme(self, name: str) -> Optional[PartitionScheme]:
-        path = self._scheme_file(name)
-        if os.path.exists(path):
-            with open(path) as fh:
-                return from_config(json.load(fh))
-        return None
+        # torn/corrupt sidecars quarantine and degrade to unpartitioned
+        # (store/partitions.py) — a bad config file never blocks opening
+        return load_scheme(self._scheme_file(name))
 
     def _discover(self, name: str) -> List[str]:
         """All committed block files for a type, as sorted relative paths."""
@@ -212,7 +231,24 @@ class FsDataStore(TpuDataStore):
                    os.path.join(self._type_dir(name), "tombstones.txt")):
             if os.path.exists(ts):
                 with open(ts) as fh:
-                    out.extend(line.rstrip("\n") for line in fh if line.rstrip("\n"))
+                    data = fh.read()
+                # only a NEWLINE-TERMINATED line is committed: a producer
+                # that crashed mid-append leaves an unterminated tail,
+                # and honoring a partial batch would be exactly the
+                # half-applied mutation the journal forbids. A line is
+                # either one delete BATCH (RS sentinel + JSON array — no
+                # fid content can be misparsed) or a single legacy fid.
+                committed = data[: data.rfind("\n") + 1]
+                for line in committed.split("\n"):
+                    if not line:
+                        continue
+                    if line.startswith(_TOMBSTONE_BATCH):
+                        try:
+                            out.extend(json.loads(line[1:]))
+                        except ValueError:
+                            continue  # rot inside a committed line
+                    else:
+                        out.append(line)  # legacy: the whole line is a fid
         return out
 
     # -- query surface (prune before planning) -------------------------------
@@ -268,8 +304,9 @@ class FsDataStore(TpuDataStore):
             self._schemes[ft.name] = scheme
             if scheme is not None and not self._loading:
                 os.makedirs(self._type_dir(ft.name), exist_ok=True)
-                with open(self._scheme_file(ft.name), "w") as fh:
-                    json.dump(scheme.to_config(), fh)
+                save_scheme(
+                    self._scheme_file(ft.name), scheme, journal=self.journal
+                )
 
     def _insert_columns(self, ft: FeatureType, columns: Columns, observe_stats: bool = True):
         super()._insert_columns(ft, columns, observe_stats)
@@ -285,67 +322,119 @@ class FsDataStore(TpuDataStore):
             self.metadata.insert(ft.name, "geomesa.vis", "false")
         self._write_partitioned(ft, columns)
 
-    def _write_partitioned(self, ft: FeatureType, columns: Columns) -> None:
-        """Split one column batch by partition and persist each group."""
+    def _partition_groups(self, ft: FeatureType, columns: Columns):
+        """Split one column batch by partition: [(partition_path, sub)]."""
         scheme = self._schemes.get(ft.name)
         if scheme is None:
-            self._write_partition(ft, "", columns)
-            return
+            return [("", columns)]
         names = scheme.partition_names(ft, columns)
+        groups = []
         for part in np.unique(names):
             rows = np.flatnonzero(names == part)
-            sub = {k: v[rows] for k, v in columns.items()}
-            self._write_partition(ft, str(part), sub)
+            groups.append((str(part), {k: v[rows] for k, v in columns.items()}))
+        return groups
 
-    def _write_partition(self, ft: FeatureType, partition: str, columns: Columns):
-        d = os.path.join(self._type_dir(ft.name), partition) if partition else self._type_dir(ft.name)
+    def _reserve_block(self, name: str, partition: str, taken: Set[str]) -> str:
+        """Pick a fresh block relpath in a partition dir — never reusing
+        a name that exists on disk or was reserved earlier in the same
+        mutation, so a journaled publish can always be rolled back by
+        unlink (an overwrite would be undoable)."""
+        td = self._type_dir(name)
+        d = os.path.join(td, partition) if partition else td
         os.makedirs(d, exist_ok=True)
-        existing = [f for f in os.listdir(d) if f.endswith(_EXTS) and not f.startswith(".")]
-        seq = len(existing)
+        seq = len(
+            [f for f in os.listdir(d)
+             if f.endswith(_EXTS) and not f.startswith(".")]
+        )
         ext = ".parquet" if self._format == "parquet" else ".npz"
-        final = os.path.join(d, f"{seq:08d}{ext}")
-        _write_block(final, ft, columns, self._format)
-        rel = os.path.relpath(final, self._type_dir(ft.name))
-        self._files[ft.name].append(rel)
-        self._loaded[ft.name].add(rel)  # freshly written data is in memory
+        while True:
+            final = os.path.join(d, f"{seq:08d}{ext}")
+            rel = os.path.relpath(final, td)
+            if rel not in taken and not os.path.exists(final):
+                taken.add(rel)
+                return rel
+            seq += 1
+
+    def _write_partitioned(self, ft: FeatureType, columns: Columns) -> None:
+        """Persist one column batch, split by partition, as ONE journaled
+        mutation: intent first, then every block via fsync_replace, then
+        commit — a crash mid-batch can never leave a subset of the
+        batch's partitions visible (startup recovery unlinks partials)."""
+        groups = self._partition_groups(ft, columns)
+        td = self._type_dir(ft.name)
+        taken: Set[str] = set()
+        rels = [self._reserve_block(ft.name, part, taken) for part, _ in groups]
+        with self.journal.intent(
+            "fs.write", publishes=[os.path.join(td, r) for r in rels]
+        ):
+            for rel, (_part, sub) in zip(rels, groups):
+                _write_block(os.path.join(td, rel), ft, sub, self._format)
+        # in-memory bookkeeping only after the intent committed: a rolled
+        # back batch must not leave the store believing its files exist
+        for rel in rels:
+            self._files[ft.name].append(rel)
+            self._loaded[ft.name].add(rel)  # freshly written data is in memory
 
     def _tombstone_file(self, name: str) -> str:
         return os.path.join(self._type_dir(name), "_tombstones.txt")
 
     def delete_features(self, name: str, fids: Sequence[str]):
-        """Deletes append to a durable tombstone sidecar; the O(data) file
-        rewrite is deferred to compact() (one rewrite per cycle, not one
-        per delete batch)."""
+        """Deletes append ONE newline-terminated line (RS sentinel + the
+        fid batch as a JSON array, so no fid content can break framing)
+        to the durable tombstone sidecar — O(batch), and batch-atomic
+        because readers only honor terminated lines (a crash mid-append
+        leaves an unterminated tail that simply never happened); the
+        O(data) block rewrite is deferred to compact() (one rewrite per
+        cycle, not one per delete batch)."""
         super().delete_features(name, fids)
         os.makedirs(self._type_dir(name), exist_ok=True)
-        with open(self._tombstone_file(name), "a") as fh:
-            for fid in fids:
-                fh.write(f"{fid}\n")
+        ts = self._tombstone_file(name)
+        line = _TOMBSTONE_BATCH + json.dumps(
+            [str(f) for f in fids], separators=(",", ":")
+        ) + "\n"
+        with self.journal.intent("fs.tombstones", replaces=[ts]):
+            fresh = not os.path.exists(ts)
+            with open(ts, "a") as fh:
+                fh.write(line)
+                fh.flush()
+                if fsync_enabled():
+                    os.fsync(fh.fileno())
+            if fresh and fsync_enabled():
+                fsync_dir(os.path.dirname(ts))
 
     def compact(self, name: str):
         self._ensure_loaded(name, None)
         super().compact(name)
-        self._rewrite(name)
-        for ts in (self._tombstone_file(name),
-                   os.path.join(self._type_dir(name), "tombstones.txt")):
-            if os.path.exists(ts):
-                os.remove(ts)
+        self._rewrite(name, drop_tombstones=True)
 
     def delete_schema(self, name: str) -> None:
-        super().delete_schema(name)
+        self.get_schema(name)  # unknown type raises BEFORE any intent
         d = self._type_dir(name)
+        targets: List[str] = []
         if os.path.isdir(d):
-            for dirpath, _dirs, files in os.walk(d, topdown=False):
-                for f in files:
-                    os.remove(os.path.join(dirpath, f))
-                os.rmdir(dirpath)
+            for dirpath, _dirs, files in os.walk(d):
+                targets.extend(os.path.join(dirpath, f) for f in files)
+        # ONE intent covers the registry drop AND every data file: a
+        # crash anywhere after the record rolls the whole deletion
+        # forward at the next open (drop_type finishes the metadata
+        # side), so a type can never reopen half-present
+        with self.journal.intent(
+            "fs.delete_schema", deletes=targets, drop_type=name, rmdirs=[d]
+        ):
+            super().delete_schema(name)
+            # file deletes + dir sweep apply on scope exit, then commit
         self._files.pop(name, None)
         self._loaded.pop(name, None)
         self._schemes.pop(name, None)
 
-    def _rewrite(self, name: str) -> None:
-        """Persist current (post-delete/compact) state, re-partitioned.
-        Dictionary columns are decoded — values are the on-disk form."""
+    def _rewrite(self, name: str, drop_tombstones: bool = False) -> None:
+        """Persist current (post-delete/compact) state, re-partitioned,
+        as ONE journaled mutation: new blocks (fresh names — never
+        overwriting the old generation) publish first, then the old
+        blocks (+ consumed tombstone sidecars) delete, then commit. A
+        crash mid-rewrite recovers to exactly the old or the new
+        generation. Dictionary columns are decoded — values are the
+        on-disk form."""
         from geomesa_tpu.store.blocks import concat_columns, record_rows_decoded
 
         ft = self.get_schema(name)
@@ -354,15 +443,28 @@ class FsDataStore(TpuDataStore):
         for b, rows in table.scan_all():
             rb, rr = b.record_part(rows)
             parts.append(record_rows_decoded(rb.columns, rr))
-        root = self._type_dir(name)
-        for rel in self._files.get(name, []):
-            path = os.path.join(root, rel)
-            if os.path.exists(path):
-                os.remove(path)
-        self._files[name] = []
-        self._loaded[name] = set()
-        if parts:
-            self._write_partitioned(ft, concat_columns(parts))
+        td = self._type_dir(name)
+        old_abs = [os.path.join(td, rel) for rel in self._files.get(name, [])]
+        if drop_tombstones:
+            old_abs.extend(
+                ts for ts in (self._tombstone_file(name),
+                              os.path.join(td, "tombstones.txt"))
+                if os.path.exists(ts)
+            )
+        groups = (
+            self._partition_groups(ft, concat_columns(parts)) if parts else []
+        )
+        taken: Set[str] = set()
+        rels = [self._reserve_block(name, part, taken) for part, _ in groups]
+        with self.journal.intent(
+            "fs.rewrite",
+            publishes=[os.path.join(td, r) for r in rels],
+            deletes=old_abs,
+        ):
+            for rel, (_part, sub) in zip(rels, groups):
+                _write_block(os.path.join(td, rel), ft, sub, self._format)
+        self._files[name] = sorted(rels)
+        self._loaded[name] = set(rels)
 
 
 # -- block ser/de -------------------------------------------------------------
@@ -386,11 +488,20 @@ def _write_block_once(path: str, ft: FeatureType, columns: Columns, fmt: str) ->
     deadline.check("fs.block_write")
     faults.fault_point("fs.block_write")
     tmp = os.path.join(os.path.dirname(path), "." + os.path.basename(path) + ".tmp")
+    # tmp cleanup is except-Exception, NOT finally: a failed attempt (the
+    # happy-error path, e.g. ENOSPC mid-serialize) never leaks its tmp,
+    # while a crash-like BaseException skips the handler and leaves the
+    # straggler for the startup scrub — exactly like a real crash
     if fmt == "npz":
-        np.savez(tmp, **columns)  # savez appends .npz
-        tmp += ".npz"
-        append_crc_footer(tmp)
-        faults.maybe_tear("fs.block_write", tmp)
+        try:
+            np.savez(tmp, **columns)  # savez appends .npz
+            tmp += ".npz"
+            append_crc_footer(tmp)
+            faults.maybe_tear("fs.block_write", tmp)
+        except Exception:
+            cleanup_tmp(tmp)
+            cleanup_tmp(tmp + ".npz")  # savez failed before the += above
+            raise
         fsync_replace(tmp, path)
         return
     import pyarrow as pa
@@ -398,23 +509,27 @@ def _write_block_once(path: str, ft: FeatureType, columns: Columns, fmt: str) ->
 
     from geomesa_tpu.geom.wkt import to_wkt
 
-    geoms = _geom_attrs(ft)
-    arrays, names, objcols = [], [], []
-    for k, v in columns.items():
-        names.append(k)
-        if v.dtype == object:
-            objcols.append(k)
-            if k in geoms:
-                vals = [None if g is None else to_wkt(g) for g in v]
+    try:
+        geoms = _geom_attrs(ft)
+        arrays, names, objcols = [], [], []
+        for k, v in columns.items():
+            names.append(k)
+            if v.dtype == object:
+                objcols.append(k)
+                if k in geoms:
+                    vals = [None if g is None else to_wkt(g) for g in v]
+                else:
+                    vals = [None if x is None else x for x in v]
+                arrays.append(pa.array(vals))
             else:
-                vals = [None if x is None else x for x in v]
-            arrays.append(pa.array(vals))
-        else:
-            arrays.append(pa.array(v))
-    table = pa.Table.from_arrays(arrays, names=names)
-    table = table.replace_schema_metadata({"geomesa.objcols": json.dumps(objcols)})
-    pq.write_table(table, tmp)
-    faults.maybe_tear("fs.block_write", tmp)
+                arrays.append(pa.array(v))
+        table = pa.Table.from_arrays(arrays, names=names)
+        table = table.replace_schema_metadata({"geomesa.objcols": json.dumps(objcols)})
+        pq.write_table(table, tmp)
+        faults.maybe_tear("fs.block_write", tmp)
+    except Exception:
+        cleanup_tmp(tmp)
+        raise
     fsync_replace(tmp, path)
 
 

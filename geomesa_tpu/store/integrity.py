@@ -27,6 +27,7 @@ from __future__ import annotations
 import os
 import struct
 import sys
+import threading
 import zlib
 
 from geomesa_tpu.utils.audit import robustness_metrics
@@ -116,6 +117,34 @@ def fsync_enabled() -> bool:
     return FS_FSYNC.get() not in ("0", "false", "no")
 
 
+def fsync_dir(path: str) -> None:
+    """Fsync a DIRECTORY entry (the step that makes a just-created or
+    just-renamed name itself durable), unconditionally — callers gate on
+    whichever durability knob governs THEIR boundary (``fsync_enabled``
+    for the store tier, the broker's own ``fsync`` flag for the file
+    log). Tolerant of filesystems that refuse directory fsync — the
+    rename/append stands either way."""
+    dfd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+def cleanup_tmp(tmp: str) -> None:
+    """Unlink a temp file, tolerating its absence — the happy-error-path
+    companion to ``fsync_replace`` (call from an ``except Exception``
+    handler so a failed write never leaks its tmp; a BaseException —
+    a real or simulated crash — skips it, leaving the straggler for the
+    startup scrub in store/journal.py)."""
+    try:
+        os.remove(tmp)
+    except OSError:
+        pass
+
+
 def fsync_replace(tmp: str, path: str) -> None:
     """Atomically publish ``tmp`` at ``path``, durably: the content is
     fsynced BEFORE the rename (so the rename can never expose an empty or
@@ -128,13 +157,26 @@ def fsync_replace(tmp: str, path: str) -> None:
             os.close(fd)
     os.replace(tmp, path)
     if fsync_enabled():
-        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        except OSError:
-            pass  # some filesystems refuse directory fsync; rename stands
-        finally:
-            os.close(dfd)
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def durable_write(path: str, data: bytes, crc: bool = False) -> None:
+    """The one home for the durable-publish pattern: pid+thread-unique
+    tmp write (+ optional CRC footer), then ``fsync_replace``. Cleanup is
+    ``except Exception``, deliberately NOT ``finally``: a failed attempt
+    (the happy-error path) never leaks its tmp, while a crash-like
+    BaseException skips the handler and leaves the straggler for the
+    startup scrub — exactly like a real crash."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        if crc:
+            append_crc_footer(tmp)
+    except Exception:
+        cleanup_tmp(tmp)
+        raise
+    fsync_replace(tmp, path)
 
 
 def quarantine(path: str) -> str:

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 from geomesa_tpu.store.integrity import (
     CorruptFileError,
     append_crc_footer,
+    cleanup_tmp,
     fsync_replace,
     quarantine,
     read_verified,
@@ -86,8 +87,13 @@ class FileMetadata(Metadata):
         name="metadata.save", max_attempts=4, base_s=0.005, cap_s=0.1
     )
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, journal=None):
         self.path = path
+        # optional write-ahead intent journal (store/journal.py): the
+        # registry flush is a single atomic replace, but routing it
+        # through the journal keeps EVERY store mutation uniformly
+        # visible to recovery, /debug/recovery, and lint rule 4
+        self._journal = journal
         self._lock = threading.Lock()
         self._data: Dict[str, Dict[str, str]] = {}
         if os.path.exists(path):
@@ -99,16 +105,27 @@ class FileMetadata(Metadata):
 
     def _flush(self):
         with trace.span("metadata.save", path=self.path):
-            self._SAVE_RETRY.call(self._flush_once)
+            if self._journal is not None:
+                with self._journal.intent("metadata.save",
+                                          replaces=[self.path]):
+                    self._SAVE_RETRY.call(self._flush_once)
+            else:
+                self._SAVE_RETRY.call(self._flush_once)
 
     def _flush_once(self):
         deadline.check("metadata.save")
         faults.fault_point("metadata.save")
         tmp = f"{self.path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(self._data, fh, indent=1, sort_keys=True)
-        append_crc_footer(tmp)
-        faults.maybe_tear("metadata.save", tmp)
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(self._data, fh, indent=1, sort_keys=True)
+            append_crc_footer(tmp)
+            faults.maybe_tear("metadata.save", tmp)
+        except Exception:
+            # failed flush must not leak its tmp (a BaseException — a
+            # crash — leaves it for the startup scrub, like a real crash)
+            cleanup_tmp(tmp)
+            raise
         fsync_replace(tmp, self.path)
 
     def read(self, type_name, key):

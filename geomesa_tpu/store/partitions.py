@@ -15,7 +15,9 @@ computation reuses the planner's filter-bounds extraction.
 
 from __future__ import annotations
 
+import json
 import math
+import os
 from datetime import datetime, timezone
 from typing import Dict, List, Optional, Sequence
 
@@ -25,6 +27,12 @@ from geomesa_tpu.curve import zorder
 from geomesa_tpu.curve.normalized import NormalizedLat, NormalizedLon
 from geomesa_tpu.filter.extract import extract_geometries, extract_intervals
 from geomesa_tpu.schema.featuretype import FeatureType
+from geomesa_tpu.store.integrity import (
+    CorruptFileError,
+    durable_write,
+    quarantine,
+    read_verified,
+)
 
 # give up on pruning rather than enumerate absurd bucket counts
 MAX_COVERING = 4096
@@ -330,3 +338,54 @@ def parse_scheme(spec: str) -> PartitionScheme:
     if not children:
         raise ValueError(f"empty partition scheme spec: {spec!r}")
     return children[0] if len(children) == 1 else CompositeScheme(children)
+
+
+# -- durable scheme persistence ------------------------------------------------
+#
+# The scheme sidecar (``blocks/<type>/_scheme.json``) is config the store
+# CANNOT afford to tear: a half-written scheme file would make every
+# partition path unparseable at the next open. It gets the full store
+# durability discipline — CRC footer + fsync + rename on write (under a
+# write-ahead intent, store/journal.py, so a crash mid-create rolls the
+# sidecar forward or back with the rest of the mutation), quarantine on a
+# corrupt read (the store falls back to unpartitioned layout and keeps
+# serving).
+
+
+def save_scheme(path: str, scheme: PartitionScheme, journal=None) -> None:
+    """Durably publish a partition-scheme sidecar at ``path``; when a
+    journal is given the write is recorded as a write-ahead intent — a
+    FRESH sidecar as a publish (rolled back by unlink on a crash), an
+    overwrite of an existing one as a replace (the rename is atomic, and
+    journaling it as a publish would let rollback unlink the PREVIOUS
+    valid version after a failed attempt)."""
+
+    def _publish() -> None:
+        durable_write(
+            path, json.dumps(scheme.to_config(), sort_keys=True).encode(),
+            crc=True,
+        )
+
+    if journal is not None:
+        fresh = not os.path.exists(path)
+        with journal.intent(
+            "fs.scheme",
+            publishes=[path] if fresh else (),
+            replaces=() if fresh else [path],
+        ):
+            _publish()
+    else:
+        _publish()
+
+
+def load_scheme(path: str) -> Optional[PartitionScheme]:
+    """Read a scheme sidecar; a torn/corrupt file is quarantined (the
+    type degrades to unpartitioned — still correct, just unpruned) and
+    legacy footer-less files read unverified."""
+    if not os.path.exists(path):
+        return None
+    try:
+        return from_config(json.loads(read_verified(path).decode()))
+    except (CorruptFileError, ValueError, UnicodeDecodeError, KeyError):
+        quarantine(path)
+        return None

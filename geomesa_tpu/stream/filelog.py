@@ -25,8 +25,9 @@ import fcntl
 import json
 import os
 import struct
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
+from geomesa_tpu.store.integrity import durable_write, fsync_dir
 from geomesa_tpu.utils import deadline, faults, trace
 
 _LEN = struct.Struct("<I")
@@ -45,6 +46,11 @@ class FileLogBroker:
         self._pos: Dict[Tuple[str, int], Tuple[int, int]] = {}
         # producer-side verified complete-prefix byte size per partition
         self._good: Dict[Tuple[str, int], int] = {}
+        # partitions whose DIRECTORY entry this broker has fsynced: a
+        # freshly created segment file isn't durable until its name is —
+        # fsyncing the file alone leaves the record reachable only
+        # through a directory entry a crash can lose
+        self._dir_synced: Set[Tuple[str, int]] = set()
         os.makedirs(root, exist_ok=True)
 
     def _path(self, topic: str, partition: int) -> str:
@@ -76,6 +82,12 @@ class FileLogBroker:
                 f.flush()
                 if self.fsync:
                     os.fsync(f.fileno())
+                    if (topic, partition) not in self._dir_synced:
+                        # first durable append through this broker: make
+                        # the segment's directory entry durable too
+                        # (fsync_replace discipline, store/integrity.py)
+                        fsync_dir(os.path.dirname(path))
+                        self._dir_synced.add((topic, partition))
                 self._good[(topic, partition)] = end + 4 + len(payload)
             finally:
                 fcntl.flock(f.fileno(), fcntl.LOCK_UN)
@@ -213,17 +225,18 @@ class FileOffsetManager:
         return os.path.join(self.dir, f"{self.group}__{topic}.json")
 
     def commit(self, topic: str, offsets: Dict[int, int]) -> None:
-        import threading
-
-        # pid+thread unique: the LogServer commits for many connections
-        # from one process, and two threads sharing a tmp name would
-        # interleave writes / replace a half-written file
-        tmp = (
-            f"{self._path(topic)}.{os.getpid()}.{threading.get_ident()}.tmp"
+        # fsync-before-rename + directory fsync + pid/thread-unique tmp
+        # (integrity.durable_write, honoring the geomesa.fs.fsync knob):
+        # a bare rename leaves the committed offset file's CONTENT
+        # un-durable — a crash could resurrect an older offset and
+        # over-replay the log — and the LogServer commits from many
+        # threads, so tmp names must never collide
+        durable_write(
+            self._path(topic),
+            json.dumps(
+                {str(p): int(o) for p, o in offsets.items()}
+            ).encode(),
         )
-        with open(tmp, "w") as f:
-            json.dump({str(p): int(o) for p, o in offsets.items()}, f)
-        os.replace(tmp, self._path(topic))
 
     def offsets(self, topic: str) -> Dict[int, int]:
         try:

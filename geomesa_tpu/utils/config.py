@@ -154,6 +154,11 @@ SOCKET_TIMEOUT = SystemProperty("geomesa.socket.timeout", "10 seconds")
 # plus the plan explain (the audit-log "why was this one slow" answer;
 # duration string, e.g. '500 ms'). Unset = no slow-query log.
 SLOW_QUERY_THRESHOLD = SystemProperty("geomesa.query.slow.threshold", None)
+# Crash recovery (store/journal.py): corrupt files quarantined by the
+# integrity layer are kept for operator inspection, then aged out by the
+# store-open scrub once older than this TTL (bounds disk leakage from
+# repeated corruption). Raise it (e.g. "3650 days") to keep them longer.
+QUARANTINE_TTL = SystemProperty("geomesa.fs.quarantine.ttl", "7 days")
 FEATURE_EXPIRY = SystemProperty("geomesa.feature.expiry", None)
 # Cold-column spill: when set, record-table columns larger than the
 # threshold are written to .npy files under this directory and re-opened

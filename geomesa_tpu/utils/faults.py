@@ -9,7 +9,10 @@ disk, or device boundary:
 
     fs.block_read      columnar block deserialization (store/fs.py)
     fs.block_write     columnar block persistence (store/fs.py, blobstore)
+    fs.block_delete    journaled file deletion (store/journal.py)
     metadata.save      schema-registry flush (store/metadata.py)
+    journal.intent     intent-record publish (store/journal.py)
+    journal.commit     intent-record commit/unlink (store/journal.py)
     netlog.rpc         RemoteLogBroker request/response (stream/netlog.py)
     broker.poll        log-broker record fetch (stream/filelog.py, broker.py)
     device.dispatch    host->device placement (parallel/mesh.py)
@@ -25,6 +28,14 @@ Kinds:
     torn       truncate a just-written file before it is published
                (``maybe_tear``) — the crash-between-write-and-rename
                window the fsync fixes close for real crashes
+    crash      raise SimulatedCrash (a BaseException): the process dies
+               HERE — no retry classifies it, no except-Exception
+               recovery path absorbs it, cleanup handlers written as
+               ``except Exception`` (not ``finally``) are skipped, so
+               disk is left exactly as a SIGKILL would leave it. The
+               crash harness (tests/test_crash.py) catches it at top
+               level and reopens the store from disk, proving startup
+               recovery (store/journal.py) restores pre- or post-state.
 
 Activation is either environment-driven::
 
@@ -61,14 +72,17 @@ from geomesa_tpu.utils.audit import robustness_metrics
 FAULT_POINTS = (
     "fs.block_read",
     "fs.block_write",
+    "fs.block_delete",
     "metadata.save",
+    "journal.intent",
+    "journal.commit",
     "netlog.rpc",
     "broker.poll",
     "device.dispatch",
     "device.fetch",
 )
 
-KINDS = ("error", "drop", "latency", "torn")
+KINDS = ("error", "drop", "latency", "torn", "crash")
 
 
 class InjectedFault(OSError):
@@ -80,19 +94,32 @@ class InjectedDrop(ConnectionError):
     """A ``drop`` rule fired: the peer hung up mid-exchange."""
 
 
+class SimulatedCrash(BaseException):
+    """A ``crash`` rule fired: the process "dies" here. Deliberately a
+    BaseException — retry policies and except-Exception degradation
+    paths must NOT absorb it, and ``except Exception`` tmp-cleanup
+    handlers must not run, so the unwind leaves disk exactly as a real
+    crash would. Only the crash harness catches it."""
+
+
 @dataclass
 class FaultRule:
     """One injection rule. ``point`` is an exact fault-point name or a
-    prefix ending in ``*`` (``fs.*`` matches both fs points).
+    prefix ending in ``*`` (``fs.*`` matches the fs points).
     ``max_fires`` bounds how many times the rule may fire (a schedule of
-    "the first two reads fail" is ``prob=1, max_fires=2``)."""
+    "the first two reads fail" is ``prob=1, max_fires=2``); ``skip``
+    suppresses the first k times the rule would otherwise fire ("crash
+    at the k-th block write" is ``kind="crash", max_fires=1, skip=k`` —
+    the crash harness sweeps k to walk a crash point through an op)."""
 
     point: str
     kind: str
     prob: float = 1.0
     latency_s: float = 0.002
     max_fires: Optional[int] = None
+    skip: int = 0
     fired: int = 0
+    seen: int = 0
 
     def matches(self, point: str) -> bool:
         if self.point.endswith("*"):
@@ -124,6 +151,9 @@ class FaultSet:
                 if rule.max_fires is not None and rule.fired >= rule.max_fires:
                     continue
                 if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                    continue
+                rule.seen += 1
+                if rule.seen <= rule.skip:
                     continue
                 rule.fired += 1
                 return rule
@@ -202,11 +232,11 @@ def _active_sets() -> List[FaultSet]:
 
 
 def fault_point(point: str) -> None:
-    """The harness hook: call at a named boundary. ``error``/``drop``
-    rules raise, ``latency`` sleeps; ``torn`` rules are write-site only
-    (see ``maybe_tear``) and never fire here."""
+    """The harness hook: call at a named boundary. ``error``/``drop``/
+    ``crash`` rules raise, ``latency`` sleeps; ``torn`` rules are
+    write-site only (see ``maybe_tear``) and never fire here."""
     for fs in _active_sets():
-        rule = fs.draw(point, ("error", "drop", "latency"))
+        rule = fs.draw(point, ("error", "drop", "latency", "crash"))
         if rule is None:
             continue
         robustness_metrics().inc(f"fault.{point}.{rule.kind}")
@@ -228,6 +258,8 @@ def fault_point(point: str) -> None:
             )
         elif rule.kind == "drop":
             raise InjectedDrop(f"injected connection drop at {point}")
+        elif rule.kind == "crash":
+            raise SimulatedCrash(f"simulated crash at {point}")
         else:
             raise InjectedFault(f"injected error at {point}")
 

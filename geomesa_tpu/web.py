@@ -19,6 +19,12 @@ SURVEY.md section 2.5). Endpoints over a datastore:
                                     counts, transfer bytes, pad, HBM)
     GET /debug/overload          -- breaker states, admission snapshot,
                                     shed/deadline/breaker counters
+    GET /debug/recovery          -- crash-recovery surface: the store's
+                                    last startup-recovery summary (intent
+                                    journal roll-forward/-back, tmp sweep,
+                                    quarantine aging), live pending-intent
+                                    count, recovery./journal./quarantine.
+                                    counters
 
 Overload mapping: a ShedLoad from admission control answers 503 +
 Retry-After, a QueryTimeout answers 504 — queries fail crisply, never
@@ -279,6 +285,39 @@ def make_handler(store):
                                     for k, v in sorted(counters.items())
                                     if k.startswith(
                                         ("shed.", "breaker.", "deadline.")
+                                    )
+                                },
+                            },
+                            default=str,
+                        ),
+                    )
+                elif route == "/debug/recovery":
+                    # crash-consistency debug page: what startup recovery
+                    # did at open (store/journal.py), whether intents are
+                    # pending RIGHT NOW (non-zero outside a mutation =
+                    # deferred deletes awaiting the next open), and the
+                    # process-wide recovery/journal/quarantine counters —
+                    # the operator's "did that crash lose anything" answer
+                    from geomesa_tpu.utils.audit import robustness_metrics
+
+                    counters, _g, _t, _tt = robustness_metrics().snapshot()
+                    jr = getattr(store, "journal", None)
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "last_recovery": getattr(
+                                    store, "last_recovery", None
+                                ),
+                                "journal_pending": (
+                                    None if jr is None else len(jr.pending())
+                                ),
+                                "counters": {
+                                    k: v
+                                    for k, v in sorted(counters.items())
+                                    if k.startswith(
+                                        ("recovery.", "journal.",
+                                         "quarantine.")
                                     )
                                 },
                             },

@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
-# Bounded chaos smoke: the fault-injection soaks (tests/test_chaos.py) on
-# CPU under a hard 90 s cap. Run in CI next to the tier-1 suite; a failure
-# prints the seed, and GEOMESA_FAULTS_SEED replays the schedule exactly.
+# Bounded chaos smoke: the fault-injection soaks (tests/test_chaos.py) and
+# the crash-schedule soaks (tests/test_crash.py) on CPU under a hard 240 s
+# cap. Run in CI next to the tier-1 suite; a failure prints the seed /
+# crash point, and GEOMESA_FAULTS_SEED replays a fault schedule exactly.
 #
-# Covers both halves of the robustness invariant:
+# Covers all three robustness invariants:
 #   - parity under faults: every query answers identically to the
 #     fault-free run (retries / device->host degradation absorb faults)
 #   - bounded latency + deterministic shedding: latency schedules cost at
@@ -11,9 +12,14 @@
 #     overload scenario (concurrent queries + device latency faults +
 #     tiny admission limits) sheds deterministically — shed.* / breaker.*
 #     counters move, zero wrong answers
+#   - crash consistency: for every (fault point x journaled mutation x
+#     crash position) schedule, a store reopened from disk answers
+#     exactly the pre-op or post-op result set — never a partial one —
+#     with zero orphan *.tmp files and an empty intent journal
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
 cd "$(dirname "$0")/.."
-exec timeout -k 10 90 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_chaos.py -q -m chaos -p no:cacheprovider "$@"
+exec timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_chaos.py tests/test_crash.py -q -m chaos \
+    -p no:cacheprovider "$@"

@@ -22,6 +22,9 @@ declare -A SPANS=(
     ["device.fetch"]="geomesa_tpu/parallel/executor.py"
     ["fs.block_read"]="geomesa_tpu/store/fs.py"
     ["fs.block_write"]="geomesa_tpu/store/fs.py"
+    ["fs.block_delete"]="geomesa_tpu/store/journal.py"
+    ["journal.intent"]="geomesa_tpu/store/journal.py"
+    ["journal.commit"]="geomesa_tpu/store/journal.py"
     ["netlog.rpc"]="geomesa_tpu/stream/netlog.py"
     ["broker.poll"]="geomesa_tpu/stream/filelog.py"
     ["stream.poll"]="geomesa_tpu/stream/store.py"

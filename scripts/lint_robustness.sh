@@ -12,6 +12,13 @@
 #      consult the ambient query deadline — a cooperative
 #      deadline.check(...) or a budget-derived io_timeout — so a new
 #      I/O/device boundary can never stall a query past its budget
+#   4. journal pairing (the crash mirror of rules 1-3): any store-tier
+#      file that publishes or deletes files (fsync_replace / os.remove)
+#      is a multi-file mutation site and must route through the
+#      write-ahead intent journal — journal.intent(...) — so a crash at
+#      any point recovers to pre- or post-state (store/journal.py);
+#      integrity.py (the publish primitive) and journal.py (the journal
+#      itself) are the only exemptions
 #
 # Exits non-zero with the offending lines on any hit.
 set -uo pipefail
@@ -44,6 +51,21 @@ while IFS= read -r f; do
         fail=1
     fi
 done < <(grep -rlE 'faults\.fault_point\(' --include='*.py' geomesa_tpu/ || true)
+
+# multi-file mutation sites in the store tier must declare a
+# write-ahead intent before touching files (crash-consistency contract)
+while IFS= read -r f; do
+    case "$f" in
+        geomesa_tpu/store/integrity.py|geomesa_tpu/store/journal.py) continue ;;
+    esac
+    if ! grep -qE 'journal\.intent\(' "$f"; then
+        echo "FAIL: ${f} publishes/deletes store files but never declares a"
+        echo "      write-ahead intent (wrap the mutation in"
+        echo "      journal.intent(op, publishes=..., deletes=...) —"
+        echo "      store/journal.py — so a crash recovers to pre/post state)"
+        fail=1
+    fi
+done < <(grep -rlE 'fsync_replace\(|os\.remove\(' --include='*.py' geomesa_tpu/store/ || true)
 
 if [ "$fail" -eq 0 ]; then
     echo "robustness lint clean"

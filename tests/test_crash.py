@@ -1,0 +1,411 @@
+"""Crash-consistency soaks: kill a mutation at every named fault point,
+reopen the store from disk, and assert it answers EXACTLY the pre-op or
+post-op result set — never a partial one.
+
+The invariant (ROADMAP.md, PR 5): every multi-file mutation is journaled
+(store/journal.py write-ahead intents), so ANY crash schedule recovers to
+pre- or post-state at the next open. The atomicity unit is one journaled
+mutation — a write batch, a tombstone replace, a compaction rewrite, a
+schema delete — mirroring the reference's per-mutation visibility
+contract (GeoMesa's key-value stores never expose a half-applied
+mutation).
+
+The ``crash`` fault kind (utils/faults.py SimulatedCrash, a BaseException)
+unwinds without running except-Exception cleanup, leaving disk exactly as
+a SIGKILL would; ``skip=k`` walks the crash through the op — the k-th hit
+of each fault point — so every publish/delete/commit window of the
+protocol gets its own schedule. Bounded by design (scripts/chaos_smoke.sh
+runs these under the chaos cap): one small store per op, five crash
+positions per (op x point).
+"""
+
+import json
+import os
+import shutil
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.fs import FsDataStore
+from geomesa_tpu.store.journal import INTENT_SUFFIX, JOURNAL_DIR, IntentJournal
+from geomesa_tpu.utils import faults
+from geomesa_tpu.utils.audit import robustness_metrics
+from geomesa_tpu.utils.faults import FaultRule, SimulatedCrash
+
+pytestmark = pytest.mark.chaos
+
+SPEC = "name:String,n:Int,dtg:Date,*geom:Point:srid=4326"
+T0 = 1483228800000  # 2017-01-01T00:00:00Z
+DAY = 86400000
+
+QUERIES = [
+    "INCLUDE",
+    "BBOX(geom, -20, -20, 20, 20)",
+    "name = 'n3'",
+    "BBOX(geom, 0, 0, 60, 60) AND dtg DURING "
+    "2017-01-02T00:00:00Z/2017-01-05T00:00:00Z",
+]
+
+# every fault point a journaled mutation crosses: the protocol's own
+# record/commit windows, per-file publish/delete, and the registry flush
+POINTS = [
+    "journal.intent",
+    "journal.commit",
+    "fs.block_write",
+    "fs.block_delete",
+    "metadata.save",
+]
+
+FLUSH = 9
+
+
+def rows(n=30, seed=0, start=0):
+    rs = np.random.RandomState(seed)
+    return [
+        (
+            f"f{start + i:05d}",
+            [
+                f"n{(start + i) % 7}",
+                int(rs.randint(0, 100)),
+                T0 + int(rs.randint(0, 5 * DAY)),
+                Point(float(rs.uniform(-70, 70)), float(rs.uniform(-70, 70))),
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def open_store(root):
+    return FsDataStore(root, flush_size=FLUSH, partition_scheme="daily")
+
+
+def seed_store(root):
+    """Base state every op starts from: partitioned data on disk PLUS a
+    few durable tombstones (so compact() has real work)."""
+    store = open_store(root)
+    store.create_schema(parse_spec("t", SPEC))
+    with store.writer("t") as w:
+        for fid, values in rows():
+            w.write(values, fid=fid)
+    store.delete_features("t", [f"f{i:05d}" for i in (1, 8, 15)])
+    return store
+
+
+# one journaled mutation each — the atomicity unit the contract covers
+OPS = {
+    # one write batch (< FLUSH rows -> a single flush, fanned out across
+    # daily partitions under ONE intent)
+    "write": lambda s: _write_batch(s),
+    "delete_features": lambda s: s.delete_features(
+        "t", [f"f{i:05d}" for i in (0, 7, 14, 21)]
+    ),
+    "compact": lambda s: s.compact("t"),
+    "delete_schema": lambda s: s.delete_schema("t"),
+    "create_schema": lambda s: s.create_schema(parse_spec("u", SPEC)),
+}
+
+
+def _write_batch(store):
+    with store.writer("t") as w:
+        for fid, values in rows(n=8, seed=99, start=1000):
+            w.write(values, fid=fid)
+
+
+def disk_state(root):
+    """What a FRESH process sees: reopen from disk (startup recovery
+    runs), answer every query for every type."""
+    store = FsDataStore(root)
+    return {
+        name: {q: tuple(sorted(store.query(name, q).fids)) for q in QUERIES}
+        for name in store.type_names
+    }
+
+
+def assert_no_leftovers(root):
+    """Zero orphan tmp files and an empty intent journal after a
+    recovered open — the crash left nothing behind."""
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            assert not f.endswith((".tmp", ".tmp.npz")), (
+                f"orphan tmp survived recovery: {os.path.join(dirpath, f)}"
+            )
+    jd = os.path.join(root, JOURNAL_DIR)
+    if os.path.isdir(jd):
+        pend = [f for f in os.listdir(jd) if f.endswith(INTENT_SUFFIX)]
+        assert pend == [], f"journal not empty after recovery: {pend}"
+
+
+@pytest.fixture(scope="module")
+def baselines(tmp_path_factory):
+    """Per-op (base_dir, pre_state, post_state), built once: the crash
+    runs copy `base` and must land on exactly `pre` or `post`."""
+    root = tmp_path_factory.mktemp("crash_base")
+    base = str(root / "base")
+    seed_store(base)
+    pre = disk_state(base)
+    out = {}
+    for opname, op in OPS.items():
+        clean = str(root / f"post_{opname}")
+        shutil.copytree(base, clean)
+        op(open_store(clean))
+        out[opname] = (base, pre, disk_state(clean))
+    return out
+
+
+@pytest.mark.parametrize("point", POINTS)
+@pytest.mark.parametrize("opname", list(OPS))
+def test_crash_schedule_recovers_pre_or_post(tmp_path, baselines, opname, point):
+    """The tentpole soak: for every (fault point x journaled op), crash
+    at the k-th hit of the point (k = 0..4, five schedules), reopen, and
+    assert pre-or-post parity + zero leftovers."""
+    base, pre, post = baselines[opname]
+    for k in range(5):
+        root = str(tmp_path / f"crash_{k}")
+        shutil.copytree(base, root)
+        store = open_store(root)
+        crashed = False
+        with faults.inject(
+            rules=[FaultRule(point, "crash", max_fires=1, skip=k)]
+        ):
+            try:
+                OPS[opname](store)
+            except SimulatedCrash:
+                crashed = True
+        del store  # the "process" is gone; only disk survives
+        got = disk_state(root)
+        assert got == pre or got == post, (
+            f"{opname} x {point} @k={k} (crashed={crashed}): partial state\n"
+            f"got:  {got}\npre:  {pre}\npost: {post}"
+        )
+        assert_no_leftovers(root)
+
+
+def test_crash_during_recovery_is_idempotent(tmp_path, baselines):
+    """Recovery itself may die and re-run: crash a compaction at commit
+    (all publishes landed, intent pending), then crash the FIRST recovery
+    mid-delete — the SECOND open must still converge to pre-or-post."""
+    base, pre, post = baselines["compact"]
+    root = str(tmp_path / "store")
+    shutil.copytree(base, root)
+    store = open_store(root)
+    with faults.inject(rules=[FaultRule("journal.commit", "crash")]):
+        try:
+            store.compact("t")
+        except SimulatedCrash:
+            pass
+    del store
+    assert IntentJournal(root).pending(), "expected a pending intent"
+    with faults.inject(rules=[FaultRule("fs.block_delete", "crash", skip=1)]):
+        try:
+            FsDataStore(root)
+        except SimulatedCrash:
+            pass  # recovery died mid-roll-forward
+    got = disk_state(root)  # second recovery finishes the job
+    assert got == pre or got == post
+    assert_no_leftovers(root)
+
+
+def test_recovery_rolls_back_partial_publish(tmp_path):
+    """A hand-built torn mutation — intent on disk, only some publishes
+    landed — rolls BACK: partial files unlinked, journal drained."""
+    root = str(tmp_path / "store")
+    store = seed_store(root)
+    n_before = {q: len(store.query("t", q)) for q in QUERIES}
+    td = os.path.join(root, "blocks", "t")
+    journal = IntentJournal(root)
+    landed = os.path.join(td, "partial0.npz")
+    missing = os.path.join(td, "partial1.npz")
+    # fabricate the landed half as a VALID block so a bad rollback would
+    # change results (copy an existing committed block)
+    src = next(
+        os.path.join(dp, f)
+        for dp, _d, fs in os.walk(td)
+        for f in fs
+        if f.endswith(".npz")
+    )
+    shutil.copy(src, landed)
+    intent = journal.intent(
+        "fs.write", publishes=[landed, missing]
+    )
+    journal._write_record(intent._record())
+    del store
+    before = robustness_metrics().counter("recovery.intent.back")
+    reopened = FsDataStore(root)
+    assert robustness_metrics().counter("recovery.intent.back") == before + 1
+    assert not os.path.exists(landed)
+    assert reopened.last_recovery["intents"]["back"] == 1
+    assert {q: len(reopened.query("t", q)) for q in QUERIES} == n_before
+    assert_no_leftovers(root)
+
+
+def test_recovery_rolls_forward_complete_publish(tmp_path):
+    """All publishes present + pending deletes -> roll FORWARD: the
+    deletes finish, the intent commits."""
+    root = str(tmp_path / "store")
+    seed_store(root)
+    td = os.path.join(root, "blocks", "t")
+    victim = next(
+        os.path.join(dp, f)
+        for dp, _d, fs in os.walk(td)
+        for f in fs
+        if f.endswith(".npz")
+    )
+    journal = IntentJournal(root)
+    intent = journal.intent("fs.rewrite", deletes=[victim])
+    journal._write_record(intent._record())
+    before = robustness_metrics().counter("recovery.intent.forward")
+    FsDataStore(root)
+    assert robustness_metrics().counter("recovery.intent.forward") == before + 1
+    assert not os.path.exists(victim)
+    assert_no_leftovers(root)
+
+
+def test_corrupt_intent_quarantined_pre_state_kept(tmp_path):
+    """A torn intent record (crash inside RECORD) means nothing was
+    applied: the record quarantines, the store keeps the pre-state."""
+    root = str(tmp_path / "store")
+    store = seed_store(root)
+    pre = disk_state(root)
+    del store
+    jd = os.path.join(root, JOURNAL_DIR)
+    os.makedirs(jd, exist_ok=True)
+    torn = os.path.join(jd, f"{0:016d}{INTENT_SUFFIX}")
+    with open(torn, "w") as fh:
+        fh.write('{"op": "fs.write", "publi')  # torn mid-record, no CRC
+    before = robustness_metrics().counter("recovery.intent.corrupt")
+    assert disk_state(root) == pre
+    assert robustness_metrics().counter("recovery.intent.corrupt") == before + 1
+    assert not os.path.exists(torn)
+    assert os.path.exists(torn + ".quarantine")
+
+
+def test_scrub_sweeps_orphan_tmp_files(tmp_path):
+    """Crash leftovers (*.tmp / *.tmp.npz) are swept at open and never
+    discovered as blocks."""
+    root = str(tmp_path / "store")
+    store = seed_store(root)
+    pre = disk_state(root)
+    del store
+    td = os.path.join(root, "blocks", "t")
+    strays = [
+        os.path.join(td, ".00000099.npz.tmp"),
+        os.path.join(td, ".00000099.npz.tmp.npz"),
+        os.path.join(root, "metadata.json.12345.tmp"),
+    ]
+    for s in strays:
+        with open(s, "wb") as fh:
+            fh.write(b"half-written garbage")
+    before = robustness_metrics().counter("recovery.tmp.swept")
+    reopened = FsDataStore(root)
+    assert robustness_metrics().counter("recovery.tmp.swept") == before + 3
+    assert reopened.last_recovery["scrub"]["tmp_swept"] == 3
+    for s in strays:
+        assert not os.path.exists(s)
+    assert disk_state(root) == pre
+
+
+def test_debug_recovery_endpoint(tmp_path):
+    """GET /debug/recovery surfaces the last startup-recovery summary,
+    the live pending-intent count, and the recovery counters."""
+    from geomesa_tpu.web import GeoMesaServer
+
+    root = str(tmp_path / "store")
+    seed_store(root)
+    store = FsDataStore(root)
+    with GeoMesaServer(store) as url:
+        body = json.loads(
+            urllib.request.urlopen(f"{url}/debug/recovery").read()
+        )
+    assert body["journal_pending"] == 0
+    assert body["last_recovery"]["intents"] == {
+        "forward": 0, "back": 0, "corrupt": 0, "kept": 0
+    }
+    assert body["last_recovery"]["scrub"]["tmp_swept"] == 0
+    assert "duration_ms" in body["last_recovery"]
+    assert isinstance(body["counters"], dict)
+
+
+def test_crash_fault_kind_is_uncatchable_by_retry():
+    """SimulatedCrash must unwind through RetryPolicy and
+    except-Exception recovery paths — a crash is not a transient."""
+    from geomesa_tpu.utils.retry import RetryPolicy
+
+    calls = []
+
+    def op():
+        calls.append(1)
+        faults.fault_point("fs.block_write")
+
+    with faults.inject(rules=[FaultRule("fs.block_write", "crash")]):
+        with pytest.raises(SimulatedCrash):
+            RetryPolicy(name="t", max_attempts=5, base_s=0.001).call(op)
+    assert len(calls) == 1  # no retry consumed the crash
+
+
+def test_fault_rule_skip_positions_the_crash():
+    """skip=k defers the k first would-be fires: the harness's knob for
+    walking a crash point through an op."""
+    hits = []
+    with faults.inject(
+        rules=[FaultRule("fs.block_write", "crash", max_fires=1, skip=2)]
+    ):
+        for i in range(5):
+            try:
+                faults.fault_point("fs.block_write")
+                hits.append(i)
+            except SimulatedCrash:
+                hits.append(f"crash@{i}")
+    assert hits == [0, 1, "crash@2", 3, 4]
+
+
+def test_commit_failure_is_absorbed_after_full_apply(tmp_path):
+    """A transient failure at journal.commit must NOT fail the mutation
+    — everything already applied; the intent merely stays pending and
+    the next open drains it."""
+    base_root = str(tmp_path / "store")
+    store = seed_store(base_root)
+    with faults.inject(rules=[FaultRule("journal.commit", "error")]):
+        store.compact("t")  # no exception: commit deferred, op succeeded
+    assert store.journal.pending(), "intent should be pending"
+    # the live store's bookkeeping matches the applied state
+    n_live = len(store.query("t", "INCLUDE"))
+    del store
+    got = disk_state(root=base_root)  # reopen drains the journal
+    assert len(got["t"]["INCLUDE"]) == n_live
+    assert_no_leftovers(base_root)
+
+
+def test_torn_tombstone_tail_is_ignored(tmp_path):
+    """Only newline-terminated tombstone lines are committed: a crash
+    mid-append (unterminated tail) must not half-apply the delete batch
+    — or worse, delete a fid whose name is a prefix of the torn one."""
+    root = str(tmp_path / "store")
+    store = seed_store(root)
+    n = len(store.query("t", "INCLUDE"))
+    del store
+    ts = os.path.join(root, "blocks", "t", "_tombstones.txt")
+    with open(ts, "a") as fh:
+        fh.write("f00002\tf0000")  # torn mid-batch, no terminator
+    reopened = FsDataStore(root)
+    assert len(reopened.query("t", "INCLUDE")) == n  # batch never happened
+
+
+def test_tombstone_batch_framing_is_fid_safe(tmp_path):
+    """Fid content (tabs, newlines escaped by JSON, RS chars) can never
+    break tombstone framing: a deleted weird fid STAYS deleted across
+    reopen, and no innocent prefix-fid gets deleted with it."""
+    root = str(tmp_path / "store")
+    store = open_store(root)
+    store.create_schema(parse_spec("t", SPEC))
+    weird = "weird\tfid"
+    with store.writer("t") as w:
+        for fid in (weird, "weird", "normal"):
+            w.write(["n1", 1, T0, Point(1.0, 1.0)], fid=fid)
+    store.delete_features("t", [weird])
+    assert sorted(store.query("t", "INCLUDE").fids) == ["normal", "weird"]
+    del store
+    reopened = FsDataStore(root)
+    assert sorted(reopened.query("t", "INCLUDE").fids) == ["normal", "weird"]

@@ -392,3 +392,131 @@ def test_concurrent_fault_points_are_safe():
             t.join()
     assert not errs
     assert hits
+
+
+# -- quarantine lifecycle (PR 5): skip on reload, count, age out --------------
+
+
+def test_quarantine_lifecycle_skip_count_age(tmp_path):
+    """The full .quarantine lifecycle: a corrupt block moves aside and is
+    SKIPPED on reload (never rediscovered as a block), COUNTED in
+    robustness_metrics, kept through scrubs inside its TTL, then AGED
+    OUT by the store-open scrub once older than
+    geomesa.fs.quarantine.ttl."""
+    import time
+
+    from geomesa_tpu.utils.config import properties
+
+    root = str(tmp_path / "store")
+    fill(FsDataStore(root, flush_size=40), rows=120)
+    d = os.path.join(root, "blocks", "t")
+    victim = os.path.join(d, sorted(
+        f for f in os.listdir(d) if f.endswith(".npz")
+    )[0])
+    with open(victim, "rb+") as fh:
+        fh.truncate(os.path.getsize(victim) // 2)
+
+    before = counter("quarantine.files")
+    store = FsDataStore(root)
+    assert len(store.query("t")) == 80
+    q = victim + ".quarantine"
+    assert os.path.exists(q) and not os.path.exists(victim)
+    assert counter("quarantine.files") == before + 1
+
+    # inside the TTL (default 7 days): scrub counts it but keeps it
+    reopened = FsDataStore(root)
+    assert reopened.last_recovery["scrub"]["quarantine_present"] == 1
+    assert reopened.last_recovery["scrub"]["quarantine_aged"] == 0
+    assert os.path.exists(q)
+    assert len(reopened.query("t")) == 80  # still skipped, still serving
+
+    # beyond the TTL: the operator's inspection window is over — swept
+    old = time.time() - 120.0
+    os.utime(q, (old, old))
+    aged_before = counter("recovery.quarantine.aged")
+    with properties(geomesa_fs_quarantine_ttl="1 minute"):
+        aged = FsDataStore(root)
+    assert not os.path.exists(q)
+    assert counter("recovery.quarantine.aged") == aged_before + 1
+    assert aged.last_recovery["scrub"]["quarantine_aged"] == 1
+    assert len(aged.query("t")) == 80
+
+
+# -- file-log durability (PR 5): dir-entry fsync + durable offset commit ------
+
+
+def test_filelog_send_fsyncs_directory_entry(tmp_path, monkeypatch):
+    """A durable send must fsync the segment's DIRECTORY entry too, not
+    just the file content — a freshly created segment whose name is lost
+    loses every record in it."""
+    synced = []
+    real_fsync = os.fsync
+
+    def spy(fd):
+        synced.append(fd)
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", spy)
+    broker = FileLogBroker(str(tmp_path / "log"), partitions=1, fsync=True)
+    broker.send("topic", 0, b"rec")
+    # content fsync + directory-entry fsync on the creating append
+    assert len(synced) >= 2
+    n_first = len(synced)
+    broker.send("topic", 0, b"rec2")
+    # steady state: only the content fsync (the entry is already durable)
+    assert len(synced) == n_first + 1
+
+
+def test_filelog_send_no_fsync_when_disabled(tmp_path, monkeypatch):
+    calls = []
+    monkeypatch.setattr(os, "fsync", lambda fd: calls.append(fd))
+    broker = FileLogBroker(str(tmp_path / "log"), partitions=1, fsync=False)
+    broker.send("topic", 0, b"rec")
+    assert calls == []
+
+
+def test_offset_commit_is_durable_and_leak_free(tmp_path, monkeypatch):
+    """OffsetStore.commit routes through fsync_replace semantics: content
+    fsynced before the rename (honoring geomesa.fs.fsync), and a failed
+    commit never leaks its tmp file."""
+    import json as _json
+
+    from geomesa_tpu.stream.filelog import FileOffsetManager
+
+    mgr = FileOffsetManager(str(tmp_path / "log"), group="g")
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (synced.append(fd), real_fsync(fd))[1]
+    )
+    mgr.commit("topic", {0: 5})
+    assert synced, "commit rename skipped fsync"
+    assert mgr.offsets("topic") == {0: 5}
+
+    # failed serialization: no tmp straggler left beside the offsets file
+    def boom(*a, **k):
+        raise ValueError("no json for you")
+
+    monkeypatch.setattr(_json, "dumps", boom)
+    with pytest.raises(ValueError):
+        mgr.commit("topic", {0: 7})
+    strays = [f for f in os.listdir(mgr.dir) if f.endswith(".tmp")]
+    assert strays == []
+    monkeypatch.undo()
+    assert mgr.offsets("topic") == {0: 5}  # old commit intact
+
+
+def test_filelog_dir_fsync_follows_broker_flag_not_store_knob(tmp_path, monkeypatch):
+    """The broker's fsync=True contract stands even when the STORE
+    durability knob is off: the two boundaries have separate owners."""
+    from geomesa_tpu.utils.config import properties
+
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (synced.append(fd), real_fsync(fd))[1]
+    )
+    with properties(geomesa_fs_fsync="0"):
+        broker = FileLogBroker(str(tmp_path / "log"), partitions=1, fsync=True)
+        broker.send("topic", 0, b"rec")
+    assert len(synced) >= 2  # content fsync AND directory-entry fsync
