@@ -9,3 +9,21 @@ broadcasts query descriptors, with partial hit masks merged by XLA collectives
 
 from geomesa_tpu.parallel.mesh import default_mesh, shard_array, pad_to_multiple
 from geomesa_tpu.parallel.executor import TpuScanExecutor, DeviceIndex
+
+# the shard fabric (parallel/shards.py) imports store.datastore, which
+# imports this package — resolve lazily so either import order works
+_SHARD_EXPORTS = (
+    "ShardedDataStore",
+    "ShardWorker",
+    "PlacementMap",
+    "ShardDied",
+    "mesh_executor_factory",
+)
+
+
+def __getattr__(name):
+    if name in _SHARD_EXPORTS:
+        from geomesa_tpu.parallel import shards
+
+        return getattr(shards, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

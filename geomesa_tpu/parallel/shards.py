@@ -1,0 +1,891 @@
+"""Fault-tolerant sharded query fan-out: scatter/gather over shard workers.
+
+The reference GeoMesa answers a query by decomposing Z-ranges into
+per-tablet scans across an Accumulo cluster (PAPER.md L5); this module is
+that distribution layer for geomesa-tpu. A ``ShardedDataStore`` is the
+coordinator over N ``ShardWorker`` shards:
+
+    PLAN    the coordinator's own planner (inherited from TpuDataStore —
+            stats are observed coordinator-side at ingest)
+    ROUTE   a partition-aware ``PlacementMap`` buckets rows into
+            low-resolution z2 cells of the point geometry (the same
+            z-range decomposition the planner's scan ranges use, at
+            partition granularity — store/partitions.Z2Scheme); a query's
+            filter is covered to the partitions that can match, grouped
+            by their primary shard
+    SCAN    per-shard scans scatter onto a worker pool, each under a
+            per-shard DEADLINE SLICE carved from the query's remaining
+            budget (utils/deadline.py), crossing the named ``shard.rpc``
+            fault boundary
+    MERGE   results gather and merge (``shard.merge`` boundary), then the
+            ordinary finish stage (dedupe/sort/limit/sampling/transforms/
+            aggregation) runs coordinator-side
+
+Robustness is the contract:
+
+* **Hedged requests** — a shard lagging past a quantile of its completed
+  siblings (``geomesa.shard.hedge.quantile``, floored at
+  ``geomesa.shard.hedge.min.ms``) is re-issued to its replica placement;
+  the first answer wins and the loser is cancelled cooperatively (its
+  slice Deadline is poisoned — ``Deadline.cancel()``) WITHOUT striking a
+  breaker, emitting a degrade counter, or folding its bytes into the
+  winner's cost receipt (per-scan receipts are exact context-local
+  collectors, utils/devstats.collecting).
+* **Per-shard circuit breakers** (``utils/breaker.py``, named
+  ``shard.<n>``) — a repeatedly failing shard short-circuits straight to
+  its replica (or to a crisp ``ShardUnavailable``) with zero dispatch
+  cost; states surface on ``/healthz`` and ``/debug/overload``.
+* **Per-shard admission** — each worker carries its own
+  ``AdmissionController`` (``geomesa.shard.max.inflight`` /
+  ``geomesa.shard.queue.depth``): PR 4's per-process budget becomes a
+  per-shard budget, and an overloaded shard's ``ShedLoad`` routes the
+  scan to a replica instead of striking the breaker.
+* **Partial-result policy** — a query either completes over ALL its
+  shards (possibly via hedges and replica failovers) or fails crisply
+  with ``QueryTimeout``/``ShardUnavailable``; NEVER a silently truncated
+  result set. Every query's root span carries a per-shard outcome table
+  (``shards`` attr) attributing which shard degraded and why.
+
+Replication is wholesale by shard succession: partition ``p`` with
+primary ``h(p)`` is also written to shards ``h(p)+1 .. h(p)+R (mod N)``,
+so every partition grouped under one primary shares the same replica
+chain and failover/hedging re-targets the whole per-shard scan.
+
+Transports: the worker pool is in-process first (threads; one GIL, so
+this buys fault isolation + overlap, not host parallelism). The second
+transport is the ``parallel/mesh.py`` device mesh: pass
+``executor_factory=mesh_executor_factory(mesh)`` and each shard's
+partition stores execute on their own slice of the mesh's devices —
+shard compute rides the mesh (ICI/DCN) while the scatter/gather control
+plane stays here. A cross-process RPC transport slots in at the same
+``_shard_call`` seam.
+
+A ``crash`` fault at ``shard.rpc`` simulates the SHARD process dying:
+the coordinator observes the ``SimulatedCrash`` crossing the boundary as
+a dead peer (``ShardDied``, a ConnectionError) and fails over — the
+coordinator process itself never absorbs a coordinator-side crash
+(``shard.merge`` crash kinds still unwind as BaseException).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _cf
+import functools
+import threading
+import time
+import zlib
+from dataclasses import replace
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from geomesa_tpu.index.aggregators import AGGREGATION_HINTS, has_aggregation, run_aggregation
+from geomesa_tpu.index.planner import Query, QueryPlan
+from geomesa_tpu.schema.featuretype import AttributeType, FeatureType
+from geomesa_tpu.store.datastore import (
+    QueryResult,
+    TpuDataStore,
+    _dedupe_by_fid,
+    _empty_columns,
+    _materialize,
+)
+from geomesa_tpu.store.partitions import Z2Scheme
+from geomesa_tpu.utils import deadline
+from geomesa_tpu.utils import devstats, faults, trace
+from geomesa_tpu.utils.admission import AdmissionController
+from geomesa_tpu.utils.audit import (
+    QueryTimeout,
+    ShardUnavailable,
+    ShedLoad,
+    robustness_metrics,
+)
+from geomesa_tpu.utils.breaker import CircuitBreaker
+from geomesa_tpu.utils.retry import RetryPolicy
+
+# cancel handles for unbounded queries still need a Deadline object
+_UNBOUNDED_S = 1e9
+# gather-loop tick: how often hedging re-evaluates lagging shards
+_GATHER_TICK_S = 0.01
+# a slice QueryTimeout with less than this much QUERY budget left blames
+# the dying caller, not the shard — no breaker strike
+_DYING_QUERY_S = 0.05
+# the null-geometry partition: rows whose point coords are NaN can never
+# match a spatial predicate, so spatially-prunable queries skip it
+_NULL_PARTITION = "null"
+
+
+class ShardDied(ConnectionError):
+    """A shard worker's process died mid-scan: the ``SimulatedCrash``
+    (or a real dead host, in a cross-process transport) crossing the
+    ``shard.rpc`` boundary surfaces to the coordinator as a dead peer —
+    a connection failure, struck against the shard's breaker and failed
+    over like any other transport fault."""
+
+
+def _quantile(vals: Sequence[float], q: float) -> float:
+    arr = sorted(vals)
+    return arr[min(len(arr) - 1, int(q * len(arr)))]
+
+
+class PlacementMap:
+    """Partition -> shard placement: which shards hold (and answer for)
+    each partition.
+
+    Partitions are low-resolution z2 cells of the point geometry
+    (``geomesa.shard.partition.bits``) so a spatial filter prunes whole
+    shards; schemas without a point geometry fall back to stable
+    fid-hash buckets (no pruning, uniform spread). Placement is a stable
+    hash of the partition name; replicas are the ``replicas`` successor
+    shards, so all partitions sharing a primary share one replica
+    chain."""
+
+    def __init__(self, num_shards: int, replicas: int, bits: int = 4):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.num_shards = int(num_shards)
+        self.replicas = max(0, min(int(replicas), self.num_shards - 1))
+        self._z2 = Z2Scheme(bits=bits)
+        self._hash_parts = max(16, self.num_shards * 4)
+
+    # -- partitioning --------------------------------------------------------
+
+    def _spatial(self, ft: FeatureType) -> bool:
+        g = ft.default_geometry
+        return g is not None and g.type == AttributeType.POINT
+
+    def partition_rows(self, ft: FeatureType, columns) -> np.ndarray:
+        """Per-row partition name for an ingest batch."""
+        fids = np.asarray(columns["__fid__"], dtype=object)
+        n = len(fids)
+        if not self._spatial(ft):
+            return np.array(
+                [f"h{zlib.crc32(str(f).encode()) % self._hash_parts:03d}" for f in fids],
+                dtype=object,
+            )
+        g = ft.default_geometry.name
+        x = np.asarray(columns[g + "__x"], dtype=np.float64)
+        y = np.asarray(columns[g + "__y"], dtype=np.float64)
+        out = np.full(n, _NULL_PARTITION, dtype=object)
+        valid = np.isfinite(x) & np.isfinite(y)
+        if valid.any():
+            sub = {g + "__x": x[valid], g + "__y": y[valid]}
+            out[valid] = self._z2.partition_names(ft, sub)
+        return out
+
+    def covering(self, ft: FeatureType, filt, known: Set[str]) -> List[str]:
+        """The known partitions a query's filter can match (sorted).
+        Spatial filters prune via the z2 cell covering — the partition
+        analog of the planner's Z-range decomposition; anything the
+        scheme cannot prune scans every known partition."""
+        if not known:
+            return []
+        if not self._spatial(ft):
+            return sorted(known)
+        prefixes = self._z2.covering(ft, filt)
+        if prefixes is None:
+            return sorted(known)
+        if not prefixes:
+            return []  # provably disjoint from every partition
+        pset = set(prefixes)
+        # a spatially-prunable filter can never match a null geometry
+        return sorted(p for p in known if p in pset)
+
+    # -- placement -----------------------------------------------------------
+
+    def primary(self, partition: str) -> int:
+        return zlib.crc32(partition.encode()) % self.num_shards
+
+    def chain(self, primary: int) -> List[int]:
+        """Placement chain for a per-shard scan: the primary shard then
+        its replica successors, in failover/hedge order."""
+        return [(primary + k) % self.num_shards for k in range(self.replicas + 1)]
+
+    def targets(self, partition: str) -> List[int]:
+        return self.chain(self.primary(partition))
+
+
+def mesh_executor_factory(mesh=None):
+    """The mesh transport's executor factory: each shard's partition
+    stores run a ``TpuScanExecutor`` over that shard's slice of the mesh
+    devices — shard compute lands on its own accelerator(s), collectives
+    ride ICI/DCN inside the shard, and the coordinator's scatter/gather
+    stays the control plane. With fewer devices than shards, shards share
+    round-robin."""
+    import jax
+
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+    from geomesa_tpu.parallel.mesh import default_mesh
+
+    devices = list(mesh.devices.flat) if mesh is not None else list(jax.devices())
+
+    def make(shard_id: int):
+        dev = devices[shard_id % len(devices)]
+        return TpuScanExecutor(default_mesh([dev]))
+
+    return make
+
+
+class ShardWorker:
+    """One shard: partition-scoped sub-stores behind a per-shard
+    admission budget. The in-process analog of one tablet server — a
+    cross-process transport would put exactly this object behind an RPC
+    endpoint."""
+
+    def __init__(
+        self,
+        shard_id: int,
+        executor_factory=None,
+        auths=None,
+        max_inflight: Optional[int] = None,
+        max_queue: Optional[int] = None,
+    ):
+        from geomesa_tpu.utils.config import SHARD_MAX_INFLIGHT, SHARD_QUEUE_DEPTH
+
+        self.shard_id = int(shard_id)
+        if max_inflight is None:
+            max_inflight = SHARD_MAX_INFLIGHT.to_int() or 32
+        if max_queue is None:
+            mq = SHARD_QUEUE_DEPTH.to_int()
+            max_queue = 128 if mq is None else mq
+        # PR 4's admission becomes a PER-SHARD budget: each shard bounds
+        # its own concurrent scans + wait queue; overflow sheds and the
+        # coordinator routes the scan to a replica instead
+        self.admission = AdmissionController(
+            max_inflight, max_queue, name=f"shard{shard_id}"
+        )
+        self._make_executor = executor_factory
+        self._auths = auths
+        self._stores: Dict[str, TpuDataStore] = {}
+        self._schemas: Dict[str, FeatureType] = {}
+        self._lock = threading.Lock()
+
+    def create_schema(self, ft: FeatureType) -> None:
+        with self._lock:
+            self._schemas[ft.name] = ft
+            stores = list(self._stores.values())
+        for st in stores:
+            if ft.name not in st.type_names:
+                st.create_schema(ft)
+
+    def _store(self, partition: str) -> TpuDataStore:
+        with self._lock:
+            st = self._stores.get(partition)
+            if st is None:
+                ex = (
+                    self._make_executor(self.shard_id)
+                    if self._make_executor is not None
+                    else None
+                )
+                st = TpuDataStore(executor=ex, auths=self._auths)
+                for ft in self._schemas.values():
+                    st.create_schema(ft)
+                self._stores[partition] = st
+            return st
+
+    def insert(self, partition: str, ft: FeatureType, columns) -> None:
+        # stats are observed coordinator-side (the planner lives there);
+        # observing per replica would double-count anyway
+        self._store(partition)._insert_columns(ft, columns, observe_stats=False)
+
+    def scan(self, name: str, query: Query, partitions: Sequence[str]) -> Dict[str, Any]:
+        """One per-shard scan: the given partitions' sub-stores answer
+        the (sort/limit/aggregation-stripped) worker query under this
+        shard's admission budget; the caller's ambient deadline slice
+        bounds every block. The receipt is an EXACT context-local
+        collector — a hedge race cannot bleed bytes between scans."""
+        with self.admission.admit():
+            receipt: Dict[str, int] = {}
+            out_cols: List[dict] = []
+            rows = 0
+            with devstats.collecting(receipt):
+                for p in partitions:
+                    with self._lock:
+                        st = self._stores.get(p)
+                    if st is None:
+                        continue  # partition never received rows on this shard
+                    res = st.query(name, query)
+                    if len(res):
+                        out_cols.append(dict(_materialize(res.columns)))
+                        rows += len(res)
+            return {"columns": out_cols, "rows": rows, "receipt": receipt}
+
+    def count(self, name: str, partition: str) -> int:
+        with self._lock:
+            st = self._stores.get(partition)
+        return 0 if st is None else st.count(name)
+
+    def has_visibility(self, name: str) -> bool:
+        with self._lock:
+            stores = list(self._stores.values())
+        for st in stores:
+            tables = st._tables.get(name)
+            if not tables:
+                continue
+            first = next(iter(tables.values()))
+            if any(b.has_col("__vis__") for b in first.blocks):
+                return True
+        return False
+
+    def delete(self, name: str, fids) -> None:
+        with self._lock:
+            stores = list(self._stores.values())
+        for st in stores:
+            if name in st.type_names:
+                st.delete_features(name, fids)
+
+    def compact(self, name: str) -> None:
+        with self._lock:
+            stores = list(self._stores.values())
+        for st in stores:
+            if name in st.type_names:
+                st.compact(name)
+
+    def age_off(self, name: str, partitions: Sequence[str]) -> int:
+        """Physical age-off, counted over the given (primary) partitions
+        only; replicas of OTHER partitions expire when their own primary
+        sweep runs on their owning worker."""
+        removed = 0
+        for p in partitions:
+            with self._lock:
+                st = self._stores.get(p)
+            if st is not None and name in st.type_names:
+                removed += st.age_off(name)
+        return removed
+
+
+class _Attempt:
+    """One in-flight per-shard scan: its future, its slice Deadline (the
+    cooperative-cancellation handle), its target shard, and whether it
+    was a hedge."""
+
+    __slots__ = ("future", "deadline", "target", "t0", "hedge")
+
+    def __init__(self, target: int, dl: deadline.Deadline, hedge: bool):
+        self.future = None
+        self.deadline = dl
+        self.target = target
+        self.t0 = time.perf_counter()
+        self.hedge = hedge
+
+
+class ShardedDataStore(TpuDataStore):
+    """Scatter/gather coordinator: the TpuDataStore facade over a shard
+    fabric. Inherits the whole PR 1-5 query envelope — admission,
+    end-to-end deadline, tracing, audit, slow-query log — and replaces
+    EXECUTE with route -> scatter (hedged, breaker-guarded, slice-
+    bounded) -> gather -> merge. See the module docstring for the
+    robustness contract."""
+
+    def __init__(
+        self,
+        num_shards: Optional[int] = None,
+        replicas: Optional[int] = None,
+        partition_bits: Optional[int] = None,
+        executor_factory=None,
+        **kwargs,
+    ):
+        from geomesa_tpu.utils.config import (
+            SHARD_COUNT,
+            SHARD_DEADLINE_FRACTION,
+            SHARD_HEDGE_MIN_MS,
+            SHARD_HEDGE_QUANTILE,
+            SHARD_PARTITION_BITS,
+            SHARD_REPLICAS,
+        )
+
+        super().__init__(**kwargs)
+        if num_shards is None:
+            num_shards = SHARD_COUNT.to_int() or 4
+        if replicas is None:
+            r = SHARD_REPLICAS.to_int()
+            replicas = 1 if r is None else r
+        if partition_bits is None:
+            partition_bits = SHARD_PARTITION_BITS.to_int() or 4
+        self.placement = PlacementMap(num_shards, replicas, bits=partition_bits)
+        self.workers = [
+            ShardWorker(i, executor_factory, auths=self.auths)
+            for i in range(num_shards)
+        ]
+        self._breakers = [CircuitBreaker(f"shard.{i}") for i in range(num_shards)]
+        # explicit 0 is meaningful for all three (hedge on pure quantile
+        # / hedge immediately / no slice reserve) — never `or`-default
+        hq = SHARD_HEDGE_QUANTILE.to_float()
+        self._hedge_q = 0.9 if hq is None else hq
+        hm = SHARD_HEDGE_MIN_MS.to_float()
+        self._hedge_min_s = (25.0 if hm is None else hm) / 1000.0
+        sf = SHARD_DEADLINE_FRACTION.to_float()
+        self._slice_fraction = 0.5 if sf is None else sf
+        self._partitions: Dict[str, Set[str]] = {}
+        self._pool = _cf.ThreadPoolExecutor(
+            max_workers=max(4, num_shards * 2), thread_name_prefix="geomesa-shard"
+        )
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    # -- schema + writes -----------------------------------------------------
+
+    def create_schema(self, ft: FeatureType) -> None:
+        super().create_schema(ft)  # local (empty) tables feed the planner
+        for w in self.workers:
+            w.create_schema(ft)
+
+    def _insert_columns(self, ft, columns, observe_stats: bool = True):
+        """Route an ingest batch: rows bucket into partitions, each
+        partition lands on its primary + replica shards. The coordinator
+        keeps NO row data — only the live partition set (for routing)
+        and the write-time stats sketches (for planning)."""
+        fids = columns.get("__fid__")
+        if fids is None or len(fids) == 0:
+            return
+        parts = self.placement.partition_rows(ft, columns)
+        known = self._partitions.setdefault(ft.name, set())
+        uniq, inv = np.unique(parts, return_inverse=True)
+        for i, p in enumerate(uniq):
+            mask = inv == i
+            sub = {k: np.asarray(v)[mask] for k, v in columns.items()}
+            known.add(str(p))
+            for sid in self.placement.targets(str(p)):
+                self.workers[sid].insert(str(p), ft, sub)
+        if observe_stats and self.stats is not None:
+            self.stats.observe_columns(ft, columns)
+
+    def delete_features(self, name: str, fids) -> None:
+        for w in self.workers:
+            w.delete(name, fids)
+
+    def compact(self, name: str) -> None:
+        for w in self.workers:
+            w.compact(name)
+
+    def age_off(self, name: str) -> int:
+        by_primary: Dict[int, List[str]] = {}
+        for p in sorted(self._partitions.get(name, ())):
+            by_primary.setdefault(self.placement.primary(p), []).append(p)
+        removed = 0
+        for sid, ps in sorted(by_primary.items()):
+            for t in self.placement.chain(sid):
+                n = self.workers[t].age_off(name, ps)
+                if t == sid:
+                    removed += n  # count primaries only; replicas mirror
+        return removed
+
+    def count(self, name: str, query=None, exact: bool = True) -> int:
+        ft = self.get_schema(name)
+        if query is None:
+            return sum(
+                self.workers[self.placement.primary(p)].count(name, p)
+                for p in sorted(self._partitions.get(name, ()))
+            )
+        q = self._as_query(query)
+        if (
+            not exact
+            and self.stats is not None
+            and self._age_off_cutoff(ft) is None
+            and not any(w.has_visibility(name) for w in self.workers)
+        ):
+            est = self.stats.get_count(ft, q.filter)
+            if est is not None:
+                return int(est)
+        return len(self.query(name, q))
+
+    # -- execute: route -> scatter/gather -> merge ---------------------------
+
+    def _execute(
+        self, name, ft, query: Query, plan: QueryPlan, t_scan_start, pending=None
+    ) -> QueryResult:
+        if plan.is_empty:
+            return super()._execute(name, ft, query, plan, t_scan_start, pending)
+        groups = self._route_shards(name, ft, query)
+        plan.scan_path = f"sharded[{len(groups)}]"
+        if not groups:
+            empty = _empty_columns(ft)
+            if has_aggregation(query.hints):
+                return QueryResult(
+                    ft, empty, plan, run_aggregation(ft, query.hints, empty)
+                )
+            return QueryResult(ft, empty, plan)
+        wq = self._worker_query(query)
+        outcomes: Dict[str, Dict[str, Any]] = {}
+        try:
+            scanouts = self._scatter_gather(name, wq, groups, outcomes)
+        finally:
+            # the per-shard outcome table rides the query's ROOT span:
+            # even a failing query's trace attributes which shard
+            # degraded and why (hedges, failovers, refusals)
+            trace.set_attr("shards", outcomes)
+        return self._merge_shards(ft, query, plan, scanouts)
+
+    def _route_shards(
+        self, name: str, ft, query: Query
+    ) -> Dict[int, List[str]]:
+        """ROUTE: the filter's partition covering grouped by primary
+        shard — each group becomes one per-shard scan with one
+        failover/hedge chain."""
+        with trace.span("shard.route") as sp:
+            parts = self.placement.covering(
+                ft, query.filter, self._partitions.get(name, set())
+            )
+            groups: Dict[int, List[str]] = {}
+            for p in parts:
+                groups.setdefault(self.placement.primary(p), []).append(p)
+            groups = {gid: sorted(ps) for gid, ps in sorted(groups.items())}
+            sp.set_attr("partitions", len(parts))
+            sp.set_attr("shards", sorted(groups))
+            return groups
+
+    @staticmethod
+    def _worker_query(query: Query) -> Query:
+        """The per-shard scan query: the same filter, with every
+        merge-stage option stripped — sort/limit/sampling/aggregation
+        must see ALL shards' rows, so they run coordinator-side after
+        the gather (projection too: transforms and sort may read
+        arbitrary source columns)."""
+        hints = {
+            k: v
+            for k, v in query.hints.items()
+            if k not in AGGREGATION_HINTS and k not in ("sampling", "sample_by")
+        }
+        return replace(
+            query, properties=None, sort_by=None, max_features=None, hints=hints
+        )
+
+    def _shard_call(
+        self, target: int, name: str, wq: Query, partitions, handle, qdl, last
+    ):
+        """The shard-server half of the scatter RPC — runs on a pool
+        thread under the coordinator's copied trace context, with the
+        per-shard deadline slice attached (the handle doubles as the
+        cooperative-cancellation lever).
+
+        The slice is ARMED here, at execution start, not at submit:
+        coordinator pool queue wait must not burn the scan's slice — a
+        congested pool would otherwise expire slices and strike breakers
+        on perfectly healthy shards (a metastable failure mode). ``last``
+        marks the chain's final possible dispatch, which gets the full
+        remaining budget (nothing left to reserve for)."""
+        if qdl is not None:
+            rem = qdl.remaining()
+            slice_s = rem if last else max(rem * self._slice_fraction, 0.005)
+            handle.budget_s = max(slice_s, 0.0)
+            handle.t_end = time.monotonic() + slice_s
+        with deadline.attach(handle):
+            with trace.span("shard.rpc", shard=target,
+                            partitions=len(partitions)) as sp:
+                deadline.check("shard.rpc")
+                faults.fault_point("shard.rpc")
+                out = self.workers[target].scan(name, wq, partitions)
+                sp.set_attr("rows", out["rows"])
+                return out
+
+    def _scatter_gather(
+        self,
+        name: str,
+        wq: Query,
+        groups: Dict[int, List[str]],
+        outcomes: Dict[str, Dict[str, Any]],
+    ) -> List[Dict[str, Any]]:
+        """SCATTER + GATHER with hedging, breaker-guarded failover, and
+        the crisp partial-result policy. Returns one scan result per
+        group (sorted by group id) or raises — never a subset."""
+        dl = deadline.ambient()
+        live: Dict[Any, tuple] = {}  # future -> (gid, _Attempt)
+        inflight: Dict[int, List[_Attempt]] = {gid: [] for gid in groups}
+        tried: Dict[int, List[int]] = {gid: [] for gid in groups}
+        results: Dict[int, Dict[str, Any]] = {}
+        lat_done: List[float] = []
+        hedge_decided: Set[int] = set()  # groups whose one hedge chance is spent
+        metrics = robustness_metrics()
+
+        def outcome(gid: int) -> Dict[str, Any]:
+            return outcomes.setdefault(str(gid), {"partitions": len(groups[gid])})
+
+        def next_target(gid: int) -> Optional[int]:
+            # untried placements first (a failing shard goes to its
+            # replica, not back to itself); then ONE re-dispatch per
+            # placement so a transient fault on every placement is still
+            # absorbed (the boundary's bounded-retry budget — the
+            # deadline caps the ladder like everywhere else)
+            chain = self.placement.chain(gid)
+            for dispatched in (0, 1):
+                for t in chain:
+                    if tried[gid].count(t) != dispatched:
+                        continue
+                    if self._breakers[t].allow():
+                        return t
+                    if dispatched == 0:
+                        # breaker open/probing: zero dispatch cost
+                        refused = outcome(gid).setdefault("refused", [])
+                        if t not in refused:
+                            refused.append(t)
+            return None
+
+        def dispatch(gid: int, hedge: bool) -> bool:
+            if dl is not None:
+                # BEFORE next_target(): allow() may consume a half-open
+                # probe slot, and raising after that would leak the slot
+                # forever (the breaker would refuse every future caller
+                # while never transitioning)
+                dl.check("shard.dispatch")
+            t = next_target(gid)
+            if t is None:
+                return False
+            # the handle starts unbounded; _shard_call carves the slice
+            # (fraction of the budget REMAINING at execution start, so
+            # pool queue wait charges the query, never the shard) — the
+            # coordinator keeps the handle purely to cancel()
+            last = len(tried[gid]) + 1 >= 2 * len(self.placement.chain(gid))
+            a = _Attempt(t, deadline.Deadline(_UNBOUNDED_S), hedge)
+            tried[gid].append(t)
+            inflight[gid].append(a)
+            fn = trace.wrap(
+                functools.partial(
+                    self._shard_call, t, name, wq, groups[gid], a.deadline,
+                    dl, last,
+                )
+            )
+            a.future = self._pool.submit(fn)
+            live[a.future] = (gid, a)
+            return True
+
+        def abort_all() -> None:
+            """Crisp-failure cleanup: poison every outstanding slice so
+            pool threads unwind at their next check, and release any
+            half-open probe slots the attempts may hold."""
+            for _f, (gid, a) in list(live.items()):
+                a.future.cancel()  # drop queued work for free; running
+                a.deadline.cancel()  # ...work aborts at its next check
+                self._breakers[a.target].cancel_probe()
+                o = outcome(gid)
+                if "outcome" not in o:
+                    o["outcome"] = "aborted"
+
+        def resolve(fut) -> Optional[BaseException]:
+            """Fold one completed future into the gather state. Returns
+            a fatal exception to raise (after abort), or None."""
+            gid, a = live.pop(fut)
+            if a in inflight[gid]:
+                inflight[gid].remove(a)
+            if fut.cancelled():
+                # a queued attempt we revoked before it ever started —
+                # no verdict of any kind
+                self._breakers[a.target].cancel_probe()
+                return None
+            exc = fut.exception()
+            elapsed = time.perf_counter() - a.t0
+            if gid in results:
+                # the losing side of a satisfied group finished anyway:
+                # discard — its verdict must not touch the breaker
+                self._breakers[a.target].cancel_probe()
+                return None
+            if exc is None:
+                res = fut.result()
+                results[gid] = res
+                lat_done.append(elapsed)
+                self._breakers[a.target].record_success()
+                o = outcome(gid)
+                o.update(
+                    outcome="hedged" if a.hedge else o.get("outcome", "ok"),
+                    served_by=a.target,
+                    ms=round(elapsed * 1000.0, 2),
+                    rows=res["rows"],
+                    receipt=res["receipt"],
+                )
+                if a.hedge:
+                    metrics.inc("shard.hedge.won")
+                for sib in inflight[gid]:
+                    # hedge race lost: cancel cooperatively; no breaker
+                    # verdict, no receipt, no degrade counter
+                    sib.future.cancel()
+                    sib.deadline.cancel()
+                    self._breakers[sib.target].cancel_probe()
+                    metrics.inc("shard.hedge.cancelled")
+                    trace.event(
+                        "shard.hedge.cancel", shard=sib.target, group=gid
+                    )
+                return None
+            if a.deadline.cancelled:
+                # our own cancellation unwinding — already accounted
+                self._breakers[a.target].cancel_probe()
+                return None
+            if isinstance(exc, faults.SimulatedCrash):
+                exc = ShardDied(f"shard {a.target} died mid-scan: {exc}")
+            o = outcome(gid)
+            o.setdefault("failures", []).append(
+                {"shard": a.target, "error": type(exc).__name__}
+            )
+            if isinstance(exc, ShedLoad):
+                # the shard's own admission control shed the scan: route
+                # around it, but an overloaded shard is not a BROKEN one
+                self._breakers[a.target].cancel_probe()
+            elif (
+                isinstance(exc, QueryTimeout)
+                and dl is not None
+                and dl.remaining() <= _DYING_QUERY_S
+            ):
+                # the QUERY's own budget is (nearly) dead: this slice
+                # timeout measures the dying caller, not shard health —
+                # no strike (the shard-boundary form of PR 4's "a
+                # QueryTimeout is never a device failure" rule; without
+                # this, a burst of over-budget queries would open
+                # breakers fleet-wide and 503 the healthy traffic)
+                self._breakers[a.target].cancel_probe()
+            elif isinstance(exc, (QueryTimeout, OSError)):
+                # slice expiry (a lagging shard) and transport faults
+                # strike the shard's breaker
+                self._breakers[a.target].record_failure()
+                trace.event(
+                    "shard.failure", shard=a.target, group=gid,
+                    error=type(exc).__name__,
+                )
+            else:
+                # application error: deterministic, never hammered
+                # across replicas — propagate as-is
+                return exc
+            if inflight[gid]:
+                # a sibling (hedge) attempt is still racing: its answer
+                # can still satisfy the group — no replacement dispatch,
+                # and certainly no unavailability verdict yet
+                return None
+            metrics.inc("shard.failover")
+            if dispatch(gid, hedge=False):
+                o["outcome"] = "failover"
+                return None
+            o["outcome"] = "unavailable"
+            metrics.inc("shard.unavailable")
+            if dl is not None and dl.expired:
+                return None  # the loop-top deadline check raises crisply
+            return ShardUnavailable(
+                f"shard group {gid} exhausted every placement "
+                f"{self.placement.chain(gid)} (last: {type(exc).__name__}: {exc})"
+            )
+
+        try:
+            for gid in groups:
+                outcome(gid)
+                if not dispatch(gid, hedge=False):
+                    metrics.inc("shard.unavailable")
+                    outcome(gid)["outcome"] = "unavailable"
+                    raise ShardUnavailable(
+                        f"shard group {gid}: every placement "
+                        f"{self.placement.chain(gid)} refused (breakers open)"
+                    )
+            while len(results) < len(groups):
+                if dl is not None:
+                    dl.check("shard.gather")
+                if not live:
+                    raise ShardUnavailable(
+                        "scatter lost every in-flight scan without a "
+                        "completion (all placements exhausted)"
+                    )
+                done, _ = _cf.wait(
+                    set(live), timeout=_GATHER_TICK_S,
+                    return_when=_cf.FIRST_COMPLETED,
+                )
+                for fut in done:
+                    fatal = resolve(fut)
+                    if fatal is not None:
+                        raise fatal
+                # hedge evaluation: a shard lagging past the quantile of
+                # its completed siblings re-issues to its replica chain.
+                # ONE hedge decision per group — a refused hedge (no
+                # placement available) is final, not re-tried every tick
+                if lat_done and len(results) < len(groups):
+                    thr = max(
+                        _quantile(lat_done, self._hedge_q), self._hedge_min_s
+                    )
+                    now = time.perf_counter()
+                    for gid, alist in inflight.items():
+                        if (
+                            gid in results
+                            or len(alist) != 1
+                            or gid in hedge_decided
+                        ):
+                            continue
+                        a = alist[0]
+                        if now - a.t0 <= thr:
+                            continue
+                        hedge_decided.add(gid)
+                        if dispatch(gid, hedge=True):
+                            metrics.inc("shard.hedge.issued")
+                            outcome(gid)["hedged"] = True
+                            trace.event(
+                                "shard.hedge", group=gid,
+                                after_ms=round((now - a.t0) * 1000.0, 2),
+                                threshold_ms=round(thr * 1000.0, 2),
+                            )
+        except BaseException:
+            abort_all()
+            raise
+        # stragglers (cancelled hedge losers) may still be running; they
+        # were cancelled at win time and their results are discarded
+        return [results[gid] for gid in sorted(results)]
+
+    # -- merge ---------------------------------------------------------------
+
+    def _merge_shards(
+        self, ft, query: Query, plan: QueryPlan, scanouts: List[Dict[str, Any]]
+    ) -> QueryResult:
+        """MERGE: concatenate every shard's columns (the ``shard.merge``
+        boundary — transient faults retry, the merge is pure), dedupe by
+        fid (replica/hedge belt-and-suspenders), then the ordinary
+        finish stage applies aggregation/sampling/transforms/sort/limit/
+        projection over the complete row set."""
+        with trace.span("shard.merge", shards=len(scanouts)):
+
+            def merge_once():
+                deadline.check("shard.merge")
+                faults.fault_point("shard.merge")
+                col_sets = [c for so in scanouts for c in so["columns"] if c]
+                return _concat_columns(ft, col_sets)
+
+            columns = RetryPolicy("shard.merge", max_attempts=3).call(merge_once)
+            columns = _dedupe_by_fid(columns)
+            return self._finish(ft, query, plan, columns)
+
+    # -- observability -------------------------------------------------------
+
+    def shards_snapshot(self) -> Dict[str, Any]:
+        """The ``shards`` block for /debug/overload + /healthz: per-shard
+        breaker state and admission snapshot, plus the live partition
+        spread — the operator's "which shard is hurting" answer."""
+        return {
+            "count": len(self.workers),
+            "replicas": self.placement.replicas,
+            "partitions": {
+                n: len(ps) for n, ps in sorted(self._partitions.items())
+            },
+            "shards": {
+                str(i): {
+                    "breaker": self._breakers[i].state,
+                    "admission": w.admission.snapshot(),
+                }
+                for i, w in enumerate(self.workers)
+            },
+        }
+
+
+def _concat_columns(ft, col_sets: List[dict]) -> dict:
+    """Concatenate per-shard column dicts into one result column set.
+    Keys must be present in every shard's columns to survive — except
+    ``__null`` companions, whose absence means "no nulls in that shard"
+    and fills with zeros (the LazyColumns contract, store/datastore.py)."""
+    if not col_sets:
+        return _empty_columns(ft)
+    if len(col_sets) == 1:
+        return dict(col_sets[0])
+    lens = [len(c["__fid__"]) for c in col_sets]
+    all_keys = set().union(*col_sets)
+    out: dict = {}
+    for k in sorted(all_keys):
+        missing = [i for i, c in enumerate(col_sets) if k not in c]
+        if missing and not k.endswith("__null"):
+            continue  # not common to every shard: cannot be observable
+        pieces = []
+        for i, c in enumerate(col_sets):
+            got = c.get(k)
+            if got is None:
+                got = np.zeros(lens[i], dtype=bool)
+            pieces.append(got)
+        out[k] = np.concatenate(pieces)
+    return out

@@ -5,10 +5,13 @@ Schema CRUD + writers + query execution over columnar index tables
 GeoMesaDataStore.scala:39, GeoMesaFeatureWriter.scala:34-259,
 QueryPlanner.runQuery planning/QueryPlanner.scala:74-99).
 
-Execution pipeline per query: plan -> scan ranges over blocks -> candidate
-rows -> post-filter (host numpy by default; the TPU executor in
-geomesa_tpu.parallel offloads point indices to device) -> dedupe -> sort ->
-projection/limits -> aggregation reducers (density/stats/bin) when hinted.
+Execution pipeline per query: PLAN (_plan_cached) -> ROUTE (_route:
+decompose into independently scannable units — union arms here, per-shard
+partition scans in parallel/shards.py) -> SCAN (_scan_parts: ranges over
+blocks -> candidate rows -> post-filter; host numpy by default, the TPU
+executor in geomesa_tpu.parallel offloads point indices to device) ->
+MERGE (_merge: dedupe -> sort -> projection/limits -> aggregation
+reducers (density/stats/bin) when hinted).
 """
 
 from __future__ import annotations
@@ -863,6 +866,15 @@ class TpuDataStore:
     def _execute(
         self, name, ft, query: Query, plan: QueryPlan, t_scan_start, pending=None
     ) -> QueryResult:
+        """EXECUTE = route -> scan -> merge (PLAN ran in _plan_cached).
+
+        The single-process pipeline: ``_route`` decomposes the plan into
+        independently scannable units (cross-index union arms here; the
+        sharded coordinator in parallel/shards.py overrides execution
+        into per-shard partition scans instead), ``_scan_parts`` scans
+        each unit, ``_merge`` assembles/dedupes/finishes. The device
+        aggregation push-downs below are single-unit short-circuits that
+        skip the scan entirely."""
         if plan.is_empty:
             empty = _empty_columns(ft)
             if has_aggregation(query.hints):
@@ -873,16 +885,11 @@ class TpuDataStore:
             # cross-index OR: scan each arm on its own index, union by fid
             # (FilterSplitter.scala:64-110; dedup replaces makeDisjoint :303)
             parts: List[tuple] = []
-            for arm in plan.union:
-                if arm.is_empty:
-                    continue
+            for arm in self._route(query, plan):
                 parts.extend(
                     self._scan_parts(name, ft, query, arm, t_scan_start, pending)
                 )
-            with trace.span("query.assemble"):
-                columns = self._columns_from_parts(ft, query, parts)
-                columns = _dedupe_by_fid(_materialize(columns))
-                return self._finish(ft, query, plan, columns)
+            return self._merge(ft, query, plan, parts, unique=False)
 
         tables = self._tables[name]
         table = tables[plan.index.name]
@@ -956,17 +963,39 @@ class TpuDataStore:
                 return QueryResult(ft, _empty_columns(ft), plan, {"stats": stat})
 
         parts = self._scan_parts(name, ft, query, plan, t_scan_start, pending)
-        # result assembly (column projection, dedupe, sort/limit,
-        # transforms) spans as its own stage so per-query self-times sum
-        # to the audited wall — scan time vs materialization time is
-        # exactly the split perf work needs
+        # NO xz dedupe: unlike the reference's sharded XZ tables
+        # (QueryPlanner.scala:83-85 dedupes multi-row extent features),
+        # this layout writes exactly ONE row per feature per index, and
+        # expand_intervals dedupes overlapping range hits within a block —
+        # so extent results stay lazy like point results
+        return self._merge(ft, query, plan, parts, unique=True)
+
+    def _route(self, query: Query, plan: QueryPlan) -> List[QueryPlan]:
+        """ROUTE stage: decompose a plan into independently scannable
+        units. Single-process, that is the cross-index union arms — a
+        non-union plan routes trivially to itself, so the hot path skips
+        the call; the sharded coordinator's analog maps the query's
+        partition covering onto shard placements (parallel/shards.py)."""
+        if plan.is_empty:
+            return []
+        if plan.union is not None:
+            return [arm for arm in plan.union if not arm.is_empty]
+        return [plan]
+
+    def _merge(
+        self, ft, query: Query, plan: QueryPlan, parts: List[tuple],
+        unique: bool,
+    ) -> QueryResult:
+        """MERGE stage: scanned parts -> result columns -> finish.
+        Result assembly (column projection, dedupe, sort/limit,
+        transforms) spans as its own stage so per-query self-times sum
+        to the audited wall — scan time vs materialization time is
+        exactly the split perf work needs. ``unique=False`` (union arms
+        may overlap) dedupes by fid."""
         with trace.span("query.assemble"):
             columns = self._columns_from_parts(ft, query, parts)
-            # NO xz dedupe: unlike the reference's sharded XZ tables
-            # (QueryPlanner.scala:83-85 dedupes multi-row extent features),
-            # this layout writes exactly ONE row per feature per index, and
-            # expand_intervals dedupes overlapping range hits within a block —
-            # so extent results stay lazy like point results
+            if not unique:
+                columns = _dedupe_by_fid(_materialize(columns))
             return self._finish(ft, query, plan, columns)
 
     def _columns_from_parts(self, ft, query: Query, parts: List[tuple]):

@@ -30,14 +30,26 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 from geomesa_tpu.utils import deadline as deadline_mod
 from geomesa_tpu.utils import trace
-from geomesa_tpu.utils.audit import QueryTimeout, ShedLoad, robustness_metrics
+from geomesa_tpu.utils.audit import (
+    QueryTimeout,
+    ShedLoad,
+    histogram_summary,
+    robustness_metrics,
+)
 
 # /healthz reports "degraded" while a shed happened within this window
 _RECENT_SHED_S = 30.0
+
+# sliding reservoir of recent admission waits (seconds; 0.0 = fast
+# path). Sized so the /debug/overload p50/p99 reflect the last few
+# thousand admissions — enough to explain a shed burst post-hoc without
+# unbounded memory
+_WAIT_RESERVOIR = 2048
 
 
 class AdmissionController:
@@ -58,6 +70,8 @@ class AdmissionController:
         self.inflight = 0
         self.queued = 0
         self.sheds = 0
+        self.admitted = 0  # cumulative successful admissions
+        self._waits: deque = deque(maxlen=_WAIT_RESERVOIR)  # seconds
         self._last_shed: Optional[float] = None
 
     def admit(self, budget_s: Optional[float] = None) -> "_Admit":
@@ -91,6 +105,8 @@ class AdmissionController:
             # fast path: a free slot and nobody ahead of us in the queue
             if self.queued == 0 and self.inflight < self.max_inflight:
                 self.inflight += 1
+                self.admitted += 1
+                self._waits.append(0.0)
                 return
             if self.queued >= self.max_queue:
                 self._shed_locked()
@@ -105,6 +121,11 @@ class AdmissionController:
                 self.queued += 1
                 try:
                     while self.inflight >= self.max_inflight:
+                        if dl is not None and dl.is_cancelled:
+                            # a cancelled scan (hedge loser) must stop
+                            # holding a queue slot promptly, even though
+                            # its slice may have eons left
+                            dl.check("admit.wait")
                         left = None if dl is None else dl.remaining()
                         if left is not None and left <= 0.0:
                             self._last_shed = time.monotonic()
@@ -117,8 +138,14 @@ class AdmissionController:
                                 f"{time.perf_counter() - t0:.3f}s in the "
                                 "admission queue (never executed)"
                             )
-                        self._cond.wait(timeout=left)
+                        # deadline-bearing waiters poll (bounded tick) so
+                        # cancellation is observed without a notify
+                        self._cond.wait(
+                            timeout=left if dl is None else min(left, 0.1)
+                        )
                     self.inflight += 1
+                    self.admitted += 1
+                    self._waits.append(time.perf_counter() - t0)
                 finally:
                     self.queued -= 1
             if sp.recording:
@@ -139,12 +166,23 @@ class AdmissionController:
 
     def snapshot(self) -> Dict[str, Any]:
         with self._cond:
+            # wait-time summary over the recent reservoir (fast-path
+            # admissions count as 0.0 waits): p50/p99 beside the shed
+            # counters make a shed burst explainable post-hoc — were
+            # queries queuing long before we refused, or did traffic
+            # spike straight past the queue?
+            waits = (
+                histogram_summary(list(self._waits), total_count=self.admitted)
+                if self._waits else None
+            )
             return {
                 "inflight": self.inflight,
                 "queued": self.queued,
                 "max_inflight": self.max_inflight,
                 "max_queue": self.max_queue,
                 "sheds": self.sheds,
+                "admitted": self.admitted,
+                "wait_ms": waits,
                 "recently_shedding": self.recently_shedding(),
             }
 

@@ -695,3 +695,14 @@ class ShedLoad(RuntimeError):
     (``utils.admission``). Deliberately fast and cheap — shedding exists
     so overload degrades to quick, honest 503s instead of queueing into
     collapse. web.py maps it to 503 + Retry-After."""
+
+
+class ShardUnavailable(RuntimeError):
+    """Raised by the sharded scatter/gather coordinator
+    (``parallel/shards.py``) when some shard's every placement — primary
+    and all replicas — is refused (breaker open) or has failed. The
+    partial-result policy makes this CRISP: a query either completes over
+    ALL its shards (possibly via hedges and replica failovers) or raises,
+    never a silently truncated result set. web.py maps it to 503 +
+    Retry-After, the same backpressure idiom as ShedLoad — the shard may
+    recover within a breaker cooldown."""

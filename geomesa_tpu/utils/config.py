@@ -143,6 +143,28 @@ QUERY_QUEUE_DEPTH = SystemProperty("geomesa.query.queue.depth", "256")
 BREAKER_FAILURES = SystemProperty("geomesa.breaker.failures", "5")
 BREAKER_WINDOW = SystemProperty("geomesa.breaker.window", "30 seconds")
 BREAKER_COOLDOWN = SystemProperty("geomesa.breaker.cooldown", "5 seconds")
+# Sharded scatter/gather (parallel/shards.py): the coordinator fans a
+# query out over `count` shard workers, each partition written to its
+# primary + `replicas` successor shards. Each per-shard scan gets
+# `deadline.fraction` of the query's REMAINING budget (the slice leaves
+# room for a hedge/failover inside the same overall deadline); a shard
+# lagging past the `hedge.quantile` of its completed siblings (and past
+# `hedge.min.ms` — the floor keeps microsecond jitter from hedging
+# everything) is re-issued to a replica, first answer wins. Per-shard
+# admission rides `max.inflight`/`queue.depth` (the per-process PR 4
+# knobs become a per-shard budget).
+SHARD_COUNT = SystemProperty("geomesa.shard.count", "4")
+SHARD_REPLICAS = SystemProperty("geomesa.shard.replicas", "1")
+SHARD_HEDGE_QUANTILE = SystemProperty("geomesa.shard.hedge.quantile", "0.9")
+SHARD_HEDGE_MIN_MS = SystemProperty("geomesa.shard.hedge.min.ms", "25")
+SHARD_DEADLINE_FRACTION = SystemProperty("geomesa.shard.deadline.fraction", "0.5")
+SHARD_MAX_INFLIGHT = SystemProperty("geomesa.shard.max.inflight", "32")
+SHARD_QUEUE_DEPTH = SystemProperty("geomesa.shard.queue.depth", "128")
+# Spatial placement granularity: partitions are low-resolution z2 cells
+# of the point geometry (store/partitions.Z2Scheme, `bits` even), so a
+# bbox query routes to the shards owning intersecting cells only;
+# schemas without a point geometry fall back to fid-hash partitions.
+SHARD_PARTITION_BITS = SystemProperty("geomesa.shard.partition.bits", "4")
 # Socket-timeout knobs: NO I/O boundary is unbounded-by-default. The
 # netlog RPC client derives its per-attempt timeout from
 # min(geomesa.netlog.timeout, the query's remaining deadline); auxiliary

@@ -45,15 +45,33 @@ _CURRENT: contextvars.ContextVar[Optional["Deadline"]] = contextvars.ContextVar(
 
 class Deadline:
     """One query's time budget: an absolute monotonic expiry plus the
-    original budget (for error messages / telemetry)."""
+    original budget (for error messages / telemetry).
 
-    __slots__ = ("budget_s", "t_end")
+    A Deadline is also the COOPERATIVE CANCELLATION handle for work
+    running in another thread: the sharded scatter/gather coordinator
+    (parallel/shards.py) keeps the slice Deadline it hands each shard
+    scan and calls ``cancel()`` on the hedge loser — the loser's next
+    ``check()`` raises, aborting the scan at the following block/fault
+    boundary without waiting out the slice."""
 
-    def __init__(self, budget_s: float, t_end: Optional[float] = None):
+    __slots__ = ("budget_s", "t_end", "cancelled", "_outer")
+
+    def __init__(
+        self,
+        budget_s: float,
+        t_end: Optional[float] = None,
+        outer: Optional["Deadline"] = None,
+    ):
         self.budget_s = float(budget_s)
         self.t_end = (
             time.monotonic() + self.budget_s if t_end is None else float(t_end)
         )
+        self.cancelled = False
+        # the enclosing scope's deadline, when nested via budget():
+        # cancellation must PIERCE nesting — a worker store installing
+        # its own (knob-derived) budget inside an attached slice must
+        # still abort when the coordinator cancels the slice handle
+        self._outer = outer
 
     def remaining(self) -> float:
         """Seconds of budget left (negative once expired)."""
@@ -63,11 +81,45 @@ class Deadline:
     def expired(self) -> bool:
         return self.remaining() <= 0.0
 
+    def cancel(self) -> None:
+        """Mark this deadline's work as no longer wanted (the hedge
+        winner already answered): every subsequent ``check()`` raises
+        ``QueryTimeout`` immediately — including checks against
+        deadlines NESTED inside this one (the cancel chain walks
+        outward). Idempotent, safe cross-thread (one bool store)."""
+        self.cancelled = True
+
+    @property
+    def is_cancelled(self) -> bool:
+        """Cancelled directly or via any enclosing scope's deadline —
+        the test blocked waits (admission queue) poll so a cancelled
+        scan stops consuming a queue slot promptly."""
+        return self._cancel_chain()
+
+    def _cancel_chain(self) -> bool:
+        d = self
+        while d is not None:
+            if d.cancelled:
+                return True
+            d = d._outer
+        return False
+
     def check(self, point: str = "") -> None:
         """Raise ``QueryTimeout`` if the budget is exhausted. ``point``
         names the boundary that noticed (fault-point names, "scan.block",
         "admit.wait", ...) — it lands in the exception, the counter's
         trace event, and therefore the slow-query log."""
+        if self._cancel_chain():
+            # cancellation is not a timeout: it gets its own counter so
+            # hedge losers never inflate deadline.exceeded, but raises
+            # the same QueryTimeout so the scan unwinds through exactly
+            # the crisp-propagation paths the timeout already proved out
+            robustness_metrics().inc("deadline.cancelled")
+            trace.event("deadline.cancelled", point=point)
+            where = f" at {point}" if point else ""
+            raise QueryTimeout(
+                f"scan cancelled{where} (a sibling answer already won)"
+            )
         if self.t_end - time.monotonic() > 0.0:
             return
         robustness_metrics().inc("deadline.exceeded")
@@ -95,10 +147,29 @@ def budget(budget_s: Optional[float]):
     if budget_s is None:
         yield _CURRENT.get()
         return
-    d = Deadline(budget_s)
     outer = _CURRENT.get()
+    d = Deadline(budget_s, outer=outer)
     if outer is not None and outer.t_end < d.t_end:
-        d = Deadline(budget_s, t_end=outer.t_end)
+        d = Deadline(budget_s, t_end=outer.t_end, outer=outer)
+    token = _CURRENT.set(d)
+    try:
+        yield d
+    finally:
+        _CURRENT.reset(token)
+
+
+@contextmanager
+def attach(d: Optional[Deadline]):
+    """Install an EXISTING Deadline for the calling scope — the
+    cross-thread handoff ``budget()`` cannot do: a coordinator carves a
+    per-shard slice, KEEPS the handle (for ``cancel()``), and the worker
+    thread attaches it. ``None`` is a no-op passthrough. No
+    tighten-to-outer logic: worker threads have no ambient deadline of
+    their own, and the slice was already carved from the query's
+    remaining budget by the coordinator."""
+    if d is None:
+        yield _CURRENT.get()
+        return
     token = _CURRENT.set(d)
     try:
         yield d

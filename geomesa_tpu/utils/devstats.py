@@ -43,10 +43,12 @@ on the single-stream bench/CI paths the perf gate
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 import weakref
-from typing import Any, Dict, Optional
+from contextlib import contextmanager
+from typing import Any, Dict, Optional, Tuple
 
 from geomesa_tpu.utils import trace
 from geomesa_tpu.utils.audit import MetricsRegistry
@@ -236,6 +238,7 @@ def instrumented_jit(name: str, fn, **jit_kw):
             return jitted(*args, **kwargs)
         reg.inc(f"xla.compile.{name}")
         reg.inc("xla.compile.total")
+        _collect("recompiles", 1)
         t0 = time.perf_counter()
         with trace.span("xla.compile", kernel=name):
             out = jitted(*args, **kwargs)
@@ -248,11 +251,51 @@ def instrumented_jit(name: str, fn, **jit_kw):
     return call
 
 
+# context-local receipt collectors: the stack of dicts that transfers
+# and compiles counted from THIS thread/context also accumulate into.
+# Unlike the process-wide receipt window (receipt_since), a collector is
+# EXACT under concurrency — the shard coordinator (parallel/shards.py)
+# wraps each per-shard scan in one so a hedged loser's bytes can never
+# land in the winner's receipt.
+_COLLECTORS: contextvars.ContextVar[Tuple[Dict[str, int], ...]] = (
+    contextvars.ContextVar("geomesa_tpu_receipt_collectors", default=())
+)
+# trace.wrap copies the caller's context into worker threads, so an
+# OUTER collector can legitimately be fed from several threads at once
+# (e.g. collecting() around a sharded query) — the fold must not lose
+# increments to interleaved read-modify-writes
+_COLLECT_LOCK = threading.Lock()
+
+
+@contextmanager
+def collecting(out: Optional[Dict[str, int]] = None):
+    """Collect this context's device costs into ``out`` (keys
+    ``h2d_bytes`` / ``d2h_bytes`` / ``recompiles``), in ADDITION to the
+    process-wide counters. Nests; each active collector sees every
+    event. Yields the dict."""
+    out = {} if out is None else out
+    token = _COLLECTORS.set(_COLLECTORS.get() + (out,))
+    try:
+        yield out
+    finally:
+        _COLLECTORS.reset(token)
+
+
+def _collect(key: str, n: int) -> None:
+    outs = _COLLECTORS.get()
+    if not outs:
+        return
+    with _COLLECT_LOCK:
+        for out in outs:
+            out[key] = out.get(key, 0) + n
+
+
 def count_h2d(nbytes: int) -> None:
     """Fold one host->device transfer into the monotone byte counter
     (called from the device.dispatch boundary, parallel/mesh.py)."""
     if nbytes:
         devstats_metrics().inc("device.h2d.bytes", int(nbytes))
+        _collect("h2d_bytes", int(nbytes))
 
 
 def count_d2h(nbytes: int) -> None:
@@ -260,6 +303,7 @@ def count_d2h(nbytes: int) -> None:
     (called from the device.fetch boundary, parallel/executor.py)."""
     if nbytes:
         devstats_metrics().inc("device.d2h.bytes", int(nbytes))
+        _collect("d2h_bytes", int(nbytes))
 
 
 def record_pad(rows_used: int, rows_capacity: int, kind: str = "") -> None:

@@ -17,6 +17,11 @@ disk, or device boundary:
     broker.poll        log-broker record fetch (stream/filelog.py, broker.py)
     device.dispatch    host->device placement (parallel/mesh.py)
     device.fetch       device->host result resolution (parallel/executor.py)
+    shard.rpc          coordinator->shard scan scatter (parallel/shards.py);
+                       a ``crash`` here simulates the SHARD process dying —
+                       the coordinator observes it as a dead peer and fails
+                       over to a replica placement
+    shard.merge        shard-result gather/merge (parallel/shards.py)
 
 Kinds:
 
@@ -41,6 +46,14 @@ Activation is either environment-driven::
 
     GEOMESA_FAULTS="fs.block_read:error=0.1,netlog.rpc:drop=0.05"
     GEOMESA_FAULTS_SEED=42
+
+Spec rules may position themselves deterministically with an ``@`` suffix
+on the kind: ``point:kind@S=prob`` skips the first S times the rule would
+fire, and ``point:kind@SxM`` additionally caps it at M fires — so
+``shard.rpc:latency@2x1`` slows exactly the third shard scan and nothing
+else (the deterministic-hedge-test schedule), the spec-string form of
+``FaultRule(skip=2, max_fires=1)``. Positioning works for EVERY kind,
+not just crash (the crash harness's original use).
 
 or programmatic and scoped::
 
@@ -80,6 +93,8 @@ FAULT_POINTS = (
     "broker.poll",
     "device.dispatch",
     "device.fetch",
+    "shard.rpc",
+    "shard.merge",
 )
 
 KINDS = ("error", "drop", "latency", "torn", "crash")
@@ -108,9 +123,12 @@ class FaultRule:
     prefix ending in ``*`` (``fs.*`` matches the fs points).
     ``max_fires`` bounds how many times the rule may fire (a schedule of
     "the first two reads fail" is ``prob=1, max_fires=2``); ``skip``
-    suppresses the first k times the rule would otherwise fire ("crash
-    at the k-th block write" is ``kind="crash", max_fires=1, skip=k`` —
-    the crash harness sweeps k to walk a crash point through an op)."""
+    suppresses the first k times the rule would otherwise fire — generic
+    Nth-hit positioning for ANY kind: "crash at the k-th block write" is
+    ``kind="crash", max_fires=1, skip=k`` (the crash harness sweeps k to
+    walk a crash point through an op), and "slow exactly the third shard
+    scan" is ``kind="latency", max_fires=1, skip=2`` (the deterministic
+    hedge-test schedule). Spec-string form: ``point:kind@skip[xfires]``."""
 
     point: str
     kind: str
@@ -173,8 +191,10 @@ class FaultSet:
 
 
 def parse(spec: str, seed: Optional[int] = None) -> FaultSet:
-    """``"<point>:<kind>=<prob>,..."`` -> FaultSet. ``=<prob>`` is
-    optional (default 1.0)."""
+    """``"<point>:<kind>[@skip[xfires]][=<prob>],..."`` -> FaultSet.
+    ``=<prob>`` is optional (default 1.0); ``@skip`` positions the rule
+    at the (skip+1)-th hit, ``xfires`` caps total fires — e.g.
+    ``shard.rpc:latency@2x1`` fires once, on exactly the third hit."""
     rules = []
     for part in spec.split(","):
         part = part.strip()
@@ -183,9 +203,26 @@ def parse(spec: str, seed: Optional[int] = None) -> FaultSet:
         pk, _, prob = part.partition("=")
         point, sep, kind = pk.partition(":")
         if not sep:
-            raise ValueError(f"bad fault spec {part!r} (want point:kind[=prob])")
+            raise ValueError(
+                f"bad fault spec {part!r} (want point:kind[@skip[xfires]][=prob])"
+            )
+        kind, _, pos = kind.partition("@")
+        skip, max_fires = 0, None
+        if pos:
+            skip_s, _, fires_s = pos.partition("x")
+            try:
+                skip = int(skip_s)
+                if fires_s:
+                    max_fires = int(fires_s)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault position {pos!r} in {part!r} (want @skip[xfires])"
+                ) from None
         rules.append(
-            FaultRule(point.strip(), kind.strip(), float(prob) if prob else 1.0)
+            FaultRule(
+                point.strip(), kind.strip(), float(prob) if prob else 1.0,
+                max_fires=max_fires, skip=skip,
+            )
         )
     return FaultSet(rules, seed=seed)
 

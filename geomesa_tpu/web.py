@@ -26,9 +26,10 @@ SURVEY.md section 2.5). Endpoints over a datastore:
                                     count, recovery./journal./quarantine.
                                     counters
 
-Overload mapping: a ShedLoad from admission control answers 503 +
-Retry-After, a QueryTimeout answers 504 — queries fail crisply, never
-with truncated bodies.
+Overload mapping: a ShedLoad from admission control and a
+ShardUnavailable from the sharded scatter/gather (parallel/shards.py)
+answer 503 + Retry-After, a QueryTimeout answers 504 — queries fail
+crisply, never with truncated bodies.
 
 Serves with the stdlib ThreadingHTTPServer — start with ``serve(store,
 port)`` or embed ``GeoMesaHandler`` elsewhere. Constructing the server
@@ -219,22 +220,33 @@ def make_handler(store):
                     unhealthy = open_breakers()
                     adm = getattr(store, "admission", None)
                     shedding = adm is not None and adm.recently_shedding()
-                    self._send(
-                        200,
-                        json.dumps(
-                            {
-                                "status": (
-                                    "degraded"
-                                    if unhealthy or shedding
-                                    else "ok"
-                                ),
-                                "store": type(store).__name__,
-                                "types": list(types),
-                                "breakers": unhealthy,
-                                "shedding": shedding,
-                            }
+                    body = {
+                        "status": (
+                            "degraded" if unhealthy or shedding else "ok"
                         ),
-                    )
+                        "store": type(store).__name__,
+                        "types": list(types),
+                        "breakers": unhealthy,
+                        "shedding": shedding,
+                    }
+                    # sharded stores report shard availability: which
+                    # shards are currently routed-around (breaker open —
+                    # their names land in `breakers` above too, so
+                    # status is already "degraded" while any shard is
+                    # down); balancers can steer on the summary
+                    snap_fn = getattr(store, "shards_snapshot", None)
+                    if snap_fn is not None:
+                        snap = snap_fn()
+                        down = sorted(
+                            (int(i) for i, s in snap["shards"].items()
+                             if s["breaker"] == "open")
+                        )
+                        body["shards"] = {
+                            "count": snap["count"],
+                            "replicas": snap["replicas"],
+                            "unavailable": down,
+                        }
+                    self._send(200, json.dumps(body))
                 elif route == "/debug/traces":
                     from geomesa_tpu.utils import trace as _trace
 
@@ -272,19 +284,31 @@ def make_handler(store):
 
                     counters, _g, _t, _tt = robustness_metrics().snapshot()
                     adm = getattr(store, "admission", None)
+                    snap_fn = getattr(store, "shards_snapshot", None)
                     self._send(
                         200,
                         json.dumps(
                             {
                                 "breakers": breaker_states(),
+                                # admission snapshot includes the wait-
+                                # time histogram summary (p50/p99): were
+                                # queries queuing long before sheds, or
+                                # did traffic spike straight past the
+                                # queue?
                                 "admission": (
                                     None if adm is None else adm.snapshot()
+                                ),
+                                # per-shard breaker + admission states
+                                # for sharded stores (parallel/shards.py)
+                                "shards": (
+                                    None if snap_fn is None else snap_fn()
                                 ),
                                 "counters": {
                                     k: v
                                     for k, v in sorted(counters.items())
                                     if k.startswith(
-                                        ("shed.", "breaker.", "deadline.")
+                                        ("shed.", "breaker.", "deadline.",
+                                         "shard.")
                                     )
                                 },
                             },
@@ -344,12 +368,18 @@ def make_handler(store):
             except KeyError as e:
                 self._send(400, json.dumps({"error": f"missing param {e}"}))
             except Exception as e:  # surface the error to the client
-                from geomesa_tpu.utils.audit import QueryTimeout, ShedLoad
+                from geomesa_tpu.utils.audit import (
+                    QueryTimeout,
+                    ShardUnavailable,
+                    ShedLoad,
+                )
 
-                if isinstance(e, ShedLoad):
-                    # overload sheds map to the HTTP backpressure idiom:
-                    # 503 + Retry-After, cheap for the server, actionable
-                    # for a well-behaved client
+                if isinstance(e, (ShedLoad, ShardUnavailable)):
+                    # overload sheds AND exhausted-shard failures map to
+                    # the HTTP backpressure idiom: 503 + Retry-After —
+                    # cheap for the server, actionable for a well-behaved
+                    # client (a shard may recover within a breaker
+                    # cooldown)
                     self._send(
                         503, json.dumps({"error": str(e)}),
                         headers={"Retry-After": "1"},

@@ -4,7 +4,7 @@
 # cap. Run in CI next to the tier-1 suite; a failure prints the seed /
 # crash point, and GEOMESA_FAULTS_SEED replays a fault schedule exactly.
 #
-# Covers all three robustness invariants:
+# Covers the robustness invariants:
 #   - parity under faults: every query answers identically to the
 #     fault-free run (retries / device->host degradation absorb faults)
 #   - bounded latency + deterministic shedding: latency schedules cost at
@@ -16,10 +16,17 @@
 #     crash position) schedule, a store reopened from disk answers
 #     exactly the pre-op or post-op result set — never a partial one —
 #     with zero orphan *.tmp files and an empty intent journal
+#   - sharded partial-result policy (tests/test_shards.py): under any
+#     shard.rpc schedule — error / drop / latency / crash of any single
+#     shard, including the kill-one-shard schedule (one worker dead for
+#     the whole soak) — every query answers identically to the
+#     fault-free single-process run or fails crisply with
+#     QueryTimeout/ShardUnavailable, never a truncated result, with the
+#     per-shard outcome table attributing which shard degraded and why
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
 cd "$(dirname "$0")/.."
 exec timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_chaos.py tests/test_crash.py -q -m chaos \
+    tests/test_chaos.py tests/test_crash.py tests/test_shards.py -q -m chaos \
     -p no:cacheprovider "$@"

@@ -28,6 +28,8 @@ declare -A SPANS=(
     ["netlog.rpc"]="geomesa_tpu/stream/netlog.py"
     ["broker.poll"]="geomesa_tpu/stream/filelog.py"
     ["stream.poll"]="geomesa_tpu/stream/store.py"
+    ["shard.rpc"]="geomesa_tpu/parallel/shards.py"
+    ["shard.merge"]="geomesa_tpu/parallel/shards.py"
 )
 for point in "${!SPANS[@]}"; do
     file="${SPANS[$point]}"

@@ -52,6 +52,22 @@ while IFS= read -r f; do
     fi
 done < <(grep -rlE 'faults\.fault_point\(' --include='*.py' geomesa_tpu/ || true)
 
+# the shard fan-out boundaries are pinned by name: the coordinator
+# (parallel/shards.py) must keep both shard.* fault points AND consult
+# the ambient deadline beside them (rule 3 covers the pairing once the
+# points exist; this pin keeps the points themselves from vanishing in
+# a refactor — a shard RPC that cannot be chaos-tested is an untested
+# outage path)
+for point in shard.rpc shard.merge; do
+    if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/parallel/shards.py; then
+        echo "FAIL: geomesa_tpu/parallel/shards.py lost the '${point}' fault point"
+        echo "      (the shard fan-out contract: every scatter/merge boundary is"
+        echo "       injectable — faults.fault_point(\"${point}\") beside a"
+        echo "       deadline check; see utils/faults.py)"
+        fail=1
+    fi
+done
+
 # multi-file mutation sites in the store tier must declare a
 # write-ahead intent before touching files (crash-consistency contract)
 while IFS= read -r f; do

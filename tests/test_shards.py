@@ -1,0 +1,661 @@
+"""Sharded scatter/gather fan-out (parallel/shards.py): parity with the
+single-process store, per-shard deadline slices, hedged requests and
+their cancellation contract, per-shard breakers, the crisp partial-
+result policy, and the chaos soaks (incl. the kill-one-shard schedule).
+
+The headline invariant: a ``ShardedDataStore`` query either answers
+IDENTICALLY to the fault-free single-process run — absorbing shard
+faults via replica failover and hedging — or fails crisply with
+``QueryTimeout``/``ShardUnavailable``; never a silently truncated
+result set.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.parallel.shards import (
+    PlacementMap,
+    ShardedDataStore,
+    ShardWorker,
+)
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import devstats, faults, trace
+from geomesa_tpu.utils.audit import (
+    QueryTimeout,
+    ShardUnavailable,
+    robustness_metrics,
+)
+from geomesa_tpu.utils.config import properties
+
+SPEC = "name:String,n:Int,dtg:Date,*geom:Point:srid=4326"
+T0 = 1483228800000  # 2017-01-01T00:00:00Z
+DAY = 86400000
+
+QUERIES = [
+    "INCLUDE",
+    "BBOX(geom, -20, -20, 20, 20)",
+    "BBOX(geom, 0, 0, 60, 60) AND dtg DURING "
+    "2017-01-05T00:00:00Z/2017-01-20T00:00:00Z",
+    "name = 'n3'",
+    "BBOX(geom, -60, -60, 0, 0) OR name = 'n5'",
+]
+
+
+def rows(n=200, seed=0):
+    rs = np.random.RandomState(seed)
+    return [
+        (
+            f"f{i:05d}",
+            [
+                f"n{i % 7}",
+                int(i),  # unique: sort comparisons are deterministic
+                T0 + int(rs.randint(0, 30 * DAY)),
+                Point(float(rs.uniform(-70, 70)), float(rs.uniform(-70, 70))),
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def ingest(store, data=None, name="t"):
+    store.create_schema(parse_spec(name, SPEC))
+    with store.writer(name) as w:
+        for fid, values in data or rows():
+            w.write(values, fid=fid)
+    return store
+
+
+def sharded(**kw):
+    kw.setdefault("num_shards", 4)
+    kw.setdefault("replicas", 1)
+    return ingest(ShardedDataStore(**kw))
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """Fault-free single-process answers for every soak query."""
+    store = ingest(TpuDataStore())
+    return {q: sorted(store.query("t", q).fids) for q in QUERIES}
+
+
+# -- parity with the single-process pipeline ---------------------------------
+
+
+def test_query_parity_with_single_store(baseline):
+    sh = sharded()
+    for q in QUERIES:
+        assert sorted(sh.query("t", q).fids) == baseline[q], q
+
+
+def test_sort_limit_and_projection_run_at_the_coordinator(baseline):
+    base = ingest(TpuDataStore())
+    sh = sharded()
+    q = Query.cql(
+        "BBOX(geom, -70, -70, 70, 70)", sort_by=[("n", True)], max_features=10
+    )
+    a, b = base.query("t", q), sh.query("t", q)
+    # sort/limit must see ALL shards' rows: same global top-10, in order
+    assert list(a.columns["n"]) == list(b.columns["n"])
+    assert list(a.fids) == list(b.fids)
+    qp = Query.cql("name = 'n1'", properties=["name"])
+    ra, rb = base.query("t", qp), sh.query("t", qp)
+    assert sorted(ra.fids) == sorted(rb.fids)
+    assert "n" not in rb.columns and "name" in rb.columns
+
+
+def test_aggregations_merge_over_all_shards(baseline):
+    base = ingest(TpuDataStore())
+    sh = sharded()
+    q = Query.cql("BBOX(geom, -70, -70, 70, 70)")
+    q.hints["density"] = {
+        "envelope": (-70, -70, 70, 70), "width": 16, "height": 16
+    }
+    ga = base.query("t", q).aggregate["density"]
+    gb = sh.query("t", q).aggregate["density"]
+    assert np.allclose(ga, gb)
+
+
+def test_count_and_query_many(baseline):
+    base = ingest(TpuDataStore())
+    sh = sharded()
+    assert sh.count("t") == base.count("t") == 200
+    assert sh.count("t", "name = 'n3'") == base.count("t", "name = 'n3'")
+    got = sh.query_many("t", QUERIES)
+    for q, res in zip(QUERIES, got):
+        assert sorted(res.fids) == baseline[q], q
+
+
+def test_spatial_routing_prunes_shards():
+    sh = sharded()
+    ring = trace.InMemoryTraceExporter(capacity=8)
+    with trace.exporting(ring):
+        sh.query("t", "BBOX(geom, 1, 1, 5, 5)")
+        sh.query("t", "INCLUDE")
+    small, full = [r for r in ring.traces if r.name == "query"]
+    # a small bbox covers fewer z2 partitions -> fewer per-shard scans
+    assert len(small.attributes["shards"]) < len(full.attributes["shards"])
+
+
+def test_delete_and_compact_propagate(baseline):
+    sh = sharded()
+    victims = [f"f{i:05d}" for i in range(0, 200, 2)]
+    sh.delete_features("t", victims)
+    sh.compact("t")
+    got = sorted(sh.query("t", "INCLUDE").fids)
+    assert got == sorted(f"f{i:05d}" for i in range(1, 200, 2))
+
+
+# -- placement ----------------------------------------------------------------
+
+
+def test_placement_chain_is_primary_plus_successors():
+    pm = PlacementMap(num_shards=5, replicas=2)
+    t = pm.targets("0012")
+    assert len(t) == 3 and t[0] == pm.primary("0012")
+    assert t[1] == (t[0] + 1) % 5 and t[2] == (t[0] + 2) % 5
+    # stable across instances (placement must survive restarts)
+    assert PlacementMap(5, 2).targets("0012") == t
+
+
+def test_null_geometry_rows_route_and_answer():
+    data = rows(50) + [("fnull", ["n0", 999, T0, None])]
+    base = ingest(TpuDataStore(), data)
+    sh = ingest(ShardedDataStore(num_shards=3, replicas=1), data)
+    for q in ("INCLUDE", "name = 'n0'", "BBOX(geom, -20, -20, 20, 20)"):
+        assert sorted(sh.query("t", q).fids) == sorted(base.query("t", q).fids)
+
+
+# -- per-shard deadline slices ------------------------------------------------
+
+
+def test_per_shard_deadline_slice_carved_from_budget():
+    sh = sharded(query_timeout_s=10.0)
+    seen = []
+    orig = ShardWorker.scan
+
+    def spy(self, name, q, parts):
+        from geomesa_tpu.utils import deadline as dl
+        seen.append(dl.remaining())
+        return orig(self, name, q, parts)
+
+    for w in sh.workers:
+        w.scan = spy.__get__(w, ShardWorker)
+    sh.query("t", "INCLUDE")
+    assert seen
+    # each scan sees a SLICE (fraction of the remaining budget), never
+    # the whole 10 s — the reserve funds a hedge/failover in-budget
+    assert all(s is not None and s <= 10.0 * 0.5 + 0.1 for s in seen), seen
+
+
+def test_budget_exhausted_in_gather_is_crisp_timeout():
+    sh = sharded(query_timeout_s=0.2, replicas=0)
+
+    def stall(*a, **k):
+        time.sleep(5.0)
+        raise AssertionError("unreachable: slice must expire first")
+
+    for w in sh.workers:
+        w.scan = stall
+    t0 = time.perf_counter()
+    with pytest.raises((QueryTimeout, ShardUnavailable)):
+        sh.query("t", "INCLUDE")
+    assert time.perf_counter() - t0 < 2.0  # bounded by budget, not sleep
+
+
+# -- hedged requests ----------------------------------------------------------
+
+
+def _slow_one_shard(sh, delay_s=0.3, d2h_bytes=0):
+    """Monkeypatch ONE data-bearing shard's scan to lag (and optionally
+    count loser bytes); returns (victim shard id, call counter)."""
+    ring = trace.InMemoryTraceExporter(capacity=4)
+    with trace.exporting(ring):
+        sh.query("t", "INCLUDE")
+    root = [r for r in ring.traces if r.name == "query"][-1]
+    victim = int(next(iter(root.attributes["shards"])))
+    orig = sh.workers[victim].scan
+    calls = {"n": 0}
+
+    def slow(name, q, parts):
+        time.sleep(delay_s)
+        if d2h_bytes:
+            devstats.count_d2h(d2h_bytes)
+        calls["n"] += 1
+        return orig(name, q, parts)
+
+    sh.workers[victim].scan = slow
+    return victim, calls
+
+
+def test_hedge_fires_on_lagging_shard_and_replica_answers(baseline):
+    with properties(geomesa_shard_hedge_min_ms="20"):
+        sh = sharded()
+    victim, _ = _slow_one_shard(sh)
+    m = robustness_metrics()
+    h0, w0 = m.counter("shard.hedge.issued"), m.counter("shard.hedge.won")
+    ring = trace.InMemoryTraceExporter(capacity=4)
+    with trace.exporting(ring):
+        got = sorted(sh.query("t", "INCLUDE").fids)
+    assert got == baseline["INCLUDE"]
+    assert m.counter("shard.hedge.issued") > h0
+    assert m.counter("shard.hedge.won") > w0
+    root = [r for r in ring.traces if r.name == "query"][-1]
+    entry = root.attributes["shards"][str(victim)]
+    assert entry["hedged"] and entry["outcome"] == "hedged"
+    assert entry["served_by"] != victim  # the replica answered
+
+
+def test_hedge_loser_cancelled_without_breaker_strike_or_receipt(baseline):
+    """The satellite contract: the losing hedge must not strike a
+    breaker, emit a degrade counter, or double-count bytes into the
+    winner's cost receipt."""
+    with properties(geomesa_shard_hedge_min_ms="20"):
+        sh = sharded()
+    victim, calls = _slow_one_shard(sh, d2h_bytes=1 << 20)
+    m = robustness_metrics()
+    before, _g, _t, _tt = m.snapshot()
+    c0 = m.counter("shard.hedge.cancelled")
+    ring = trace.InMemoryTraceExporter(capacity=4)
+    with trace.exporting(ring):
+        got = sorted(sh.query("t", "INCLUDE").fids)
+    assert got == baseline["INCLUDE"]
+    after, _g, _t, _tt = m.snapshot()
+    # no breaker strike: the victim's breaker never opened and stays
+    # closed; no degrade counter moved anywhere
+    assert sh._breakers[victim].state == "closed"
+    assert after.get(f"breaker.shard.{victim}.opens", 0) == before.get(
+        f"breaker.shard.{victim}.opens", 0
+    )
+    for k in after:
+        if k.startswith("degrade."):
+            assert after[k] == before.get(k, 0), k
+    assert m.counter("shard.hedge.cancelled") > c0
+    # the loser's 1 MiB never lands in any winner's per-scan receipt
+    root = [r for r in ring.traces if r.name == "query"][-1]
+    for entry in root.attributes["shards"].values():
+        assert entry.get("receipt", {}).get("d2h_bytes", 0) < (1 << 20), entry
+    # give the cancelled loser time to unwind; it must stay discarded
+    deadline_ts = time.time() + 2.0
+    while calls["n"] == 0 and time.time() < deadline_ts:
+        time.sleep(0.01)
+
+
+def test_cancel_pierces_nested_budgets():
+    """The cancel chain must survive nesting: a worker store that
+    installs its own (knob-derived) budget INSIDE the attached slice
+    still aborts when the coordinator cancels the slice handle."""
+    from geomesa_tpu.utils import deadline as dl
+
+    handle = dl.Deadline(10.0)
+    with dl.attach(handle):
+        with dl.budget(5.0):  # the worker's own nested budget
+            handle.cancel()
+            with pytest.raises(QueryTimeout):
+                dl.check("scan.block")
+
+
+def test_hedge_cancellation_with_global_query_timeout(baseline):
+    """The production configuration: geomesa.query.timeout set globally
+    means every worker sub-store nests its own budget — hedging and
+    loser cancellation must still work end to end."""
+    with properties(
+        geomesa_query_timeout="30 seconds", geomesa_shard_hedge_min_ms="20"
+    ):
+        sh = sharded()
+        victim, _ = _slow_one_shard(sh)
+        m = robustness_metrics()
+        h0 = m.counter("shard.hedge.won")
+        got = sorted(sh.query("t", "INCLUDE").fids)
+        assert got == baseline["INCLUDE"]
+        assert m.counter("shard.hedge.won") > h0
+        assert sh._breakers[victim].state == "closed"
+
+
+def test_deterministic_hedge_via_positioned_latency_fault(baseline):
+    """FaultRule.skip generalized to latency: slow exactly ONE shard.rpc
+    hit; the hedge absorbs it with full parity."""
+    with properties(geomesa_shard_hedge_min_ms="20"):
+        sh = sharded(num_shards=3)
+    rule = faults.FaultRule(
+        "shard.rpc", "latency", latency_s=0.4, max_fires=1, skip=1
+    )
+    m = robustness_metrics()
+    h0 = m.counter("shard.hedge.issued")
+    with faults.inject(rules=[rule]):
+        got = sorted(sh.query("t", "INCLUDE").fids)
+    assert got == baseline["INCLUDE"]
+    assert rule.fired == 1 and rule.seen >= 2
+    assert m.counter("shard.hedge.issued") > h0
+
+
+def test_fault_spec_skip_syntax_parses_for_all_kinds():
+    fs = faults.parse("shard.rpc:latency@2x1,fs.block_read:error@3=0.5")
+    lat, err = fs.rules
+    assert (lat.kind, lat.skip, lat.max_fires) == ("latency", 2, 1)
+    assert (err.kind, err.skip, err.max_fires, err.prob) == ("error", 3, None, 0.5)
+    with pytest.raises(ValueError):
+        faults.parse("shard.rpc:latency@bogus")
+
+
+# -- per-shard breakers + crisp failure ---------------------------------------
+
+
+def _primaries(sh, name="t"):
+    """Shard ids that are primary for at least one live partition."""
+    return sorted(
+        {sh.placement.primary(p) for p in sh._partitions.get(name, ())}
+    )
+
+
+def test_breaker_open_goes_straight_to_replica_with_zero_dispatch(baseline):
+    with properties(
+        geomesa_breaker_failures="2",
+        geomesa_breaker_window="60 seconds",
+        geomesa_breaker_cooldown="60 seconds",
+    ):
+        sh = sharded()
+        victim = _primaries(sh)[0]
+        calls = {"n": 0}
+
+        def dead(*a, **k):
+            calls["n"] += 1
+            raise ConnectionError("host down")
+
+        sh.workers[victim].scan = dead
+        for _ in range(3):
+            assert sorted(sh.query("t", "INCLUDE").fids) == baseline["INCLUDE"]
+        assert sh._breakers[victim].state == "open"
+        n = calls["n"]
+        ring = trace.InMemoryTraceExporter(capacity=4)
+        with trace.exporting(ring):
+            assert sorted(sh.query("t", "INCLUDE").fids) == baseline["INCLUDE"]
+        # zero dispatch cost: the dead worker was never called again
+        assert calls["n"] == n
+        root = [r for r in ring.traces if r.name == "query"][-1]
+        refused = [
+            e for e in root.attributes["shards"].values()
+            if victim in e.get("refused", [])
+        ]
+        assert refused, root.attributes["shards"]
+
+
+def test_all_placements_down_is_crisp_shard_unavailable():
+    sh = sharded(replicas=0)
+    victim = _primaries(sh)[0]
+
+    def dead(*a, **k):
+        raise ConnectionError("host down")
+
+    sh.workers[victim].scan = dead
+    with pytest.raises(ShardUnavailable):
+        sh.query("t", "INCLUDE")
+
+
+def test_shed_shard_routes_to_replica_without_breaker_strike(baseline):
+    sh = sharded()
+    victim = _primaries(sh)[0]
+    from geomesa_tpu.utils.audit import ShedLoad
+
+    def shedding(*a, **k):
+        raise ShedLoad("shard overloaded")
+
+    sh.workers[victim].scan = shedding
+    assert sorted(sh.query("t", "INCLUDE").fids) == baseline["INCLUDE"]
+    assert sh._breakers[victim].state == "closed"
+
+
+def test_application_error_propagates_without_failover():
+    sh = sharded()
+
+    def buggy(*a, **k):
+        raise KeyError("application bug")
+
+    for w in sh.workers:
+        w.scan = buggy
+    with pytest.raises(KeyError):
+        sh.query("t", "INCLUDE")
+
+
+def test_expired_budget_dispatch_does_not_leak_halfopen_probe():
+    """A dispatch aborted by the query deadline AFTER the breaker's
+    allow() would strand the half-open probe slot forever — the check
+    must run before the probe is consumed."""
+    from geomesa_tpu.index.planner import Query as Q
+    from geomesa_tpu.utils import deadline as dl_mod
+    from geomesa_tpu.utils.breaker import CircuitBreaker
+
+    sh = sharded()
+    victim = _primaries(sh)[0]
+    clk = {"t": 0.0}
+    b = CircuitBreaker(
+        f"shard.{victim}", failures=1, window_s=30.0, cooldown_s=5.0,
+        clock=lambda: clk["t"],
+    )
+    sh._breakers[victim] = b
+    b.record_failure()  # open
+    clk["t"] = 10.0  # past cooldown -> half-open
+    assert b.state == "half-open"
+    d = dl_mod.Deadline(1e-4)
+    time.sleep(0.01)  # the budget is already dead at dispatch
+    groups = {victim: sorted(sh._partitions["t"])}
+    with dl_mod.attach(d):
+        with pytest.raises(QueryTimeout):
+            sh._scatter_gather("t", sh._worker_query(Q.cql("INCLUDE")), groups, {})
+    # the probe slot survived: the next caller can still probe
+    assert b.allow() is True
+    b.cancel_probe()
+
+
+def test_dying_query_slice_timeout_does_not_strike_breaker():
+    """A slice timeout whose QUERY budget is also (nearly) dead blames
+    the dying caller, not the shard — tight-budget query bursts must not
+    open breakers on healthy shards."""
+    sh = sharded(num_shards=3, replicas=0, query_timeout_s=0.08)
+
+    def stall(*a, **k):
+        from geomesa_tpu.utils import deadline as dl_mod
+        while True:
+            time.sleep(0.005)
+            dl_mod.check("stall")  # raises when the armed slice expires
+
+    for w in sh.workers:
+        w.scan = stall
+    before = {i: b.state for i, b in enumerate(sh._breakers)}
+    with pytest.raises((QueryTimeout, ShardUnavailable)):
+        sh.query("t", "INCLUDE")
+    assert {i: b.state for i, b in enumerate(sh._breakers)} == before
+    assert all(s == "closed" for s in before.values())
+
+
+# -- observability surfaces ---------------------------------------------------
+
+
+def test_shards_snapshot_and_web_surfaces():
+    import json
+    import urllib.request
+
+    from geomesa_tpu.web import GeoMesaServer
+
+    sh = sharded()
+    sh.query("t", "INCLUDE")  # the wait histogram needs an admission
+    snap = sh.shards_snapshot()
+    assert snap["count"] == 4 and snap["replicas"] == 1
+    assert set(snap["shards"]) == {"0", "1", "2", "3"}
+    with GeoMesaServer(sh) as url:
+        over = json.loads(urllib.request.urlopen(url + "/debug/overload").read())
+        assert over["shards"]["count"] == 4
+        assert "breaker" in over["shards"]["shards"]["0"]
+        # satellite: admission wait-time histogram beside the counters
+        adm = over["admission"]
+        assert adm["wait_ms"] is not None
+        assert "p50_ms" in adm["wait_ms"] and "p99_ms" in adm["wait_ms"]
+        health = json.loads(urllib.request.urlopen(url + "/healthz").read())
+        assert health["shards"] == {
+            "count": 4, "replicas": 1, "unavailable": []
+        }
+        assert health["status"] == "ok"
+
+
+def test_healthz_degrades_while_a_shard_breaker_is_open():
+    import json
+    import urllib.request
+
+    from geomesa_tpu.web import GeoMesaServer
+
+    with properties(
+        geomesa_breaker_failures="1",
+        geomesa_breaker_cooldown="60 seconds",
+    ):
+        sh = sharded()
+        victim = _primaries(sh)[0]
+
+        def dead(*a, **k):
+            raise ConnectionError("down")
+
+        sh.workers[victim].scan = dead
+        sh.query("t", "INCLUDE")  # replica answers; victim strikes open
+        assert sh._breakers[victim].state == "open"
+        with GeoMesaServer(sh) as url:
+            health = json.loads(urllib.request.urlopen(url + "/healthz").read())
+            assert health["status"] == "degraded"
+            assert health["shards"]["unavailable"] == [victim]
+            assert f"shard.{victim}" in health["breakers"]
+
+
+def test_admission_wait_histogram_tracks_contention():
+    from geomesa_tpu.utils.admission import AdmissionController
+
+    ctl = AdmissionController(max_inflight=1, max_queue=4)
+    release = threading.Event()
+
+    def holder():
+        with ctl.admit():
+            release.wait(2.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    while ctl.inflight == 0:
+        time.sleep(0.005)
+    waited = {}
+
+    def waiter():
+        with ctl.admit():
+            waited["ok"] = True
+
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    time.sleep(0.1)
+    release.set()
+    t.join()
+    t2.join()
+    snap = ctl.snapshot()
+    assert snap["admitted"] == 2
+    assert snap["wait_ms"]["count"] == 2
+    assert snap["wait_ms"]["p99_ms"] >= 50.0  # the waiter queued ~100 ms
+    assert snap["wait_ms"]["p50_ms"] >= 0.0
+
+
+# -- chaos soaks (scripts/chaos_smoke.sh) -------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", ["error", "drop", "crash"])
+@pytest.mark.parametrize("seed", range(5))
+def test_shard_chaos_parity_under_transport_faults(baseline, kind, seed):
+    """Any shard.rpc error/drop/crash schedule: replica failover +
+    bounded re-dispatch absorb the faults with full parity, or the query
+    fails crisply — never a truncated result."""
+    sh = sharded(num_shards=3)
+    with faults.inject(f"shard.rpc:{kind}=0.3", seed=seed):
+        for q in QUERIES:
+            try:
+                got = sorted(sh.query("t", q).fids)
+            except (QueryTimeout, ShardUnavailable):
+                continue  # crisp, never truncated
+            assert got == baseline[q], (kind, seed, q)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(5))
+def test_shard_chaos_latency_parity_or_crisp_timeout(baseline, seed):
+    with properties(geomesa_shard_hedge_min_ms="20"):
+        sh = sharded(num_shards=3, query_timeout_s=1.0)
+    with faults.inject("shard.rpc:latency=0.4", seed=seed):
+        for q in QUERIES:
+            try:
+                got = sorted(sh.query("t", q).fids)
+            except QueryTimeout:
+                continue  # the budget died crisply
+            assert got == baseline[q], (seed, q)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("victim", range(3))
+def test_kill_one_shard_schedule(baseline, victim):
+    """The kill-one-shard schedule: one worker is DEAD for the whole
+    soak. Every query answers identically via replicas, the outcome
+    table attributes the degraded shard, and /healthz eventually lists
+    it unavailable once its breaker opens."""
+    with properties(
+        geomesa_breaker_failures="2",
+        geomesa_breaker_cooldown="60 seconds",
+    ):
+        sh = sharded(num_shards=3)
+
+        def dead(*a, **k):
+            raise ConnectionError("killed")
+
+        sh.workers[victim].scan = dead
+        ring = trace.InMemoryTraceExporter(capacity=32)
+        with trace.exporting(ring):
+            for q in QUERIES:
+                assert sorted(sh.query("t", q).fids) == baseline[q], q
+        # the outcome tables attribute the kill: whenever the dead shard
+        # was routed as a primary, its entry records the failure or the
+        # refusal (a victim that is only ever a replica is never routed)
+        blamed = False
+        for root in ring.traces:
+            if root.name != "query":
+                continue
+            for entry in root.attributes.get("shards", {}).values():
+                fails = [f["shard"] for f in entry.get("failures", [])]
+                if victim in fails or victim in entry.get("refused", []):
+                    blamed = True
+        assert blamed or victim not in _primaries(sh)
+
+
+@pytest.mark.chaos
+def test_kill_one_shard_without_replicas_is_crisp(baseline):
+    sh = sharded(num_shards=3, replicas=0)
+
+    def dead(*a, **k):
+        raise ConnectionError("killed")
+
+    sh.workers[1].scan = dead
+    for q in QUERIES:
+        try:
+            got = sorted(sh.query("t", q).fids)
+        except ShardUnavailable:
+            continue  # crisp: the dead shard owned needed partitions
+        # complete answers only happen when shard 1 owned nothing needed
+        assert got == baseline[q], q
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", ["error", "drop"])
+def test_shard_merge_faults_are_absorbed(baseline, kind):
+    """Transient merge faults retry in-place (the merge is pure): two
+    consecutive injected failures still answer within the 3-attempt
+    budget, deterministically."""
+    sh = sharded(num_shards=3)
+    rule = faults.FaultRule("shard.merge", kind, max_fires=2)
+    with faults.inject(rules=[rule]):
+        for q in QUERIES:
+            assert sorted(sh.query("t", q).fids) == baseline[q], (kind, q)
+    assert rule.fired == 2
