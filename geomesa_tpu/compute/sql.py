@@ -687,31 +687,73 @@ class SQLContext:
             lft_pts = self.store.get_schema(rels[left]).is_points
             if not lft_pts and self.store.get_schema(rels[right]).is_points:
                 left, right = right, left
-        frames = {}
-        plans = {}
-        for alias in (la, ra):
-            f = (
+        filters = {
+            alias: (
                 ast.and_option(conjuncts[alias])
                 if conjuncts[alias]
                 else ast.Include()
             )
-            res = self.store.query(rels[alias], Query(filter=f))
-            plans[alias] = res.plan
-            frames[alias] = SpatialFrame(
-                res.columns if isinstance(res.columns, dict)
-                else res.columns.materialize(),
-                res.ft,
+            for alias in (la, ra)
+        }
+        # device-side join pushdown (ops/join.py): the point-in-polygon
+        # and point-distance shapes ride the bucketed device kernels via
+        # store.query_join — build side HBM-resident per schema
+        # generation, probe side streamed, host degradation identical —
+        # instead of materializing both frames and running the O(L*R)
+        # host loop. Semantics match spatial_join exactly (boundary-
+        # inclusive point-in-geometry / haversine radius, same
+        # right-major pair order), so the SELECT pipeline downstream is
+        # unchanged. Ineligible shapes (extent-left frames, point-point
+        # containment, stores without query_join) keep the frame path.
+        raw = None
+        plans = {la: None, ra: None}
+        lft = self.store.get_schema(rels[left])
+        rft = self.store.get_schema(rels[right])
+        device_shape = (
+            getattr(self.store, "query_join", None) is not None
+            and lft.is_points
+            and (
+                (pred in ("within", "intersects") and not rft.is_points)
+                or (pred == "dwithin" and rft.is_points)
             )
-        raw = frames[left].spatial_join(
-            frames[right], predicate=pred, distance_m=dist, suffix="_r"
         )
+        if device_shape:
+            from geomesa_tpu.ops.join import JoinError
+
+            try:
+                jr = self.store.query_join(
+                    (rels[right], Query(filter=filters[right])),
+                    (rels[left], Query(filter=filters[left])),
+                    predicate="dwithin" if pred == "dwithin" else "contains",
+                    radius_m=dist,
+                )
+            except JoinError:
+                jr = None  # e.g. mixed build geometry: host frames below
+            if jr is not None:
+                plans[left] = jr.plan
+                leftkeys = set(jr.probe.columns)
+                rightkeys = set(jr.build.columns)
+                raw = SpatialFrame(jr.raw_columns(suffix="_r"), jr.probe.ft)
+        if raw is None:
+            frames = {}
+            for alias in (la, ra):
+                res = self.store.query(rels[alias], Query(filter=filters[alias]))
+                plans[alias] = res.plan
+                frames[alias] = SpatialFrame(
+                    res.columns if isinstance(res.columns, dict)
+                    else res.columns.materialize(),
+                    res.ft,
+                )
+            leftkeys = set(frames[left].columns)
+            rightkeys = set(frames[right].columns)
+            raw = frames[left].spatial_join(
+                frames[right], predicate=pred, distance_m=dist, suffix="_r"
+            )
         # canonicalize right-originated output columns DETERMINISTICALLY:
         # every right attribute becomes base_r (companions keep their
         # suffix: name__null -> name_r__null), whether or not it happened
         # to collide with a left column — qualified resolution must never
         # depend on the collision set
-        leftkeys = set(frames[left].columns)
-        rightkeys = set(frames[right].columns)
         cols = {}
         for k, v in raw.columns.items():
             if k in leftkeys:

@@ -17,6 +17,37 @@ import numpy as np
 
 from geomesa_tpu.geom.base import Geometry, Polygon
 
+# z2 normalization resolution (curve/zorder: 2 dims x 31 bits). The curve
+# layer snaps every coordinate to this grid before keys are built, so any
+# device predicate evaluated against index-derived coordinates is off by
+# at most one cell from the f64 truth.
+GRID_BITS = 31
+# the planner's meters->degrees conversion constant (filter/ast.DWithin):
+# radii must mean the same thing in planner pruning and kernel evaluation
+METERS_PER_DEGREE = 111320.0
+
+
+def snap_epsilon_deg(bits: int = GRID_BITS) -> float:
+    """The curve layer's GridSnap quantum in degrees: one normalization
+    cell of the wider (longitude) dimension. The largest displacement
+    snapping to the z2/z3 grid can introduce per axis — any
+    distance-derived pruning or device mask must widen by at least this
+    much or boundary rows disagree between the planner's int-domain
+    pruning and the kernel's coordinate-domain evaluation."""
+    return 360.0 / (1 << bits)
+
+
+def snap_epsilon_m(radius_m: float = 0.0, bits: int = GRID_BITS) -> float:
+    """``snap_epsilon_deg`` in meters (planner conversion scale), plus the
+    f32 evaluation slack for a radius of ``radius_m``: float32 carries
+    ~7 significant digits, so a great-circle distance near ``radius_m``
+    (or near the earth-scale intermediate terms) can round by a few
+    meters. The sum is the widening that makes an f32 device dwithin
+    mask a guaranteed SUPERSET of the f64 host predicate — the contract
+    every device pre-filter in this repo honors."""
+    f32_slack = max(16.0, abs(radius_m) * 4e-6)
+    return snap_epsilon_deg(bits) * METERS_PER_DEGREE + f32_slack
+
 
 def polygon_edges(polygon: Polygon) -> np.ndarray:
     """[(x0, y0, x1, y1)] for all rings (shell + holes), f32.
@@ -53,16 +84,38 @@ def points_in_polygon_f32(
     return (crossings % 2) == 1
 
 
-def dwithin_mask_f32(
-    x: jnp.ndarray, y: jnp.ndarray, cx: float, cy: float, radius_m: float
+def haversine_m_f32(
+    x: jnp.ndarray, y: jnp.ndarray, cx, cy
 ) -> jnp.ndarray:
-    """Haversine distance mask (meters) on device, f32."""
+    """Great-circle distance (meters) on device, f32. Broadcasts."""
     r = jnp.float32(6371008.8)
     lon1, lat1 = jnp.radians(x), jnp.radians(y)
-    lon2, lat2 = jnp.radians(jnp.float32(cx)), jnp.radians(jnp.float32(cy))
+    lon2, lat2 = jnp.radians(cx), jnp.radians(cy)
     a = (
         jnp.sin((lat2 - lat1) / 2) ** 2
         + jnp.cos(lat1) * jnp.cos(lat2) * jnp.sin((lon2 - lon1) / 2) ** 2
     )
-    d = 2 * r * jnp.arcsin(jnp.minimum(1.0, jnp.sqrt(a)))
-    return d <= radius_m
+    return 2 * r * jnp.arcsin(jnp.minimum(1.0, jnp.sqrt(a)))
+
+
+def dwithin_mask_f32(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    cx: float,
+    cy: float,
+    radius_m: float,
+    snap_m: float = None,
+) -> jnp.ndarray:
+    """Haversine distance mask (meters) on device, f32.
+
+    The mask is a candidate PRE-filter, so it must never be stricter
+    than the host predicate it screens for: the radius widens by the
+    curve layer's GridSnap/normalization epsilon plus the f32 rounding
+    slack (``snap_epsilon_m``) so a point exactly on the radius — or
+    displaced by one grid cell of index snapping — always survives to
+    the exact f64 post-filter. ``snap_m=0.0`` restores the raw
+    (parity-unsafe) mask for callers that do their own widening."""
+    if snap_m is None:
+        snap_m = snap_epsilon_m(radius_m)
+    d = haversine_m_f32(x, y, jnp.float32(cx), jnp.float32(cy))
+    return d <= jnp.float32(radius_m) + jnp.float32(snap_m)

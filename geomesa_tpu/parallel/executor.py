@@ -849,6 +849,30 @@ def _np_local(arr) -> np.ndarray:
         return out
 
 
+def join_upload(mesh, xs: np.ndarray, ys: np.ndarray, floor: int = 64):
+    """Upload one spatial-join probe group through the segment-upload
+    path: f32 coordinate pair, NaN-padded to the pow2 bucket above the
+    group (NaN probe rows fall out of every join kernel comparison), pad
+    efficiency recorded like any mirror upload, H2D crossing the
+    ``device.dispatch`` boundary (fault point + span + byte counters) via
+    ``mesh.replicate``. Returns (x_dev, y_dev)."""
+    n = len(xs)
+    cap = _pow2_at_least(max(n, 1), floor)
+    px = np.full(cap, np.nan, dtype=np.float32)
+    py = np.full(cap, np.nan, dtype=np.float32)
+    px[:n] = xs
+    py[:n] = ys
+    record_pad(n, cap, kind="join")
+    return replicate(mesh, px), replicate(mesh, py)
+
+
+def join_fetch(arr) -> np.ndarray:
+    """Resolve a join kernel's mask output to host: the ``device.fetch``
+    boundary (fault point + span + D2H byte counters), shared with every
+    other scan-resolution transfer."""
+    return _np_local(arr)
+
+
 class _PendingShardBitmapHits:
     """One query's slice across every shard window: decode each shard's
     bitmap, offset by the shard's row base, concatenate (rows stay

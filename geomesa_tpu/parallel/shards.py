@@ -267,6 +267,14 @@ class ShardWorker:
             if ft.name not in st.type_names:
                 st.create_schema(ft)
 
+    def delete_schema(self, name: str) -> None:
+        with self._lock:
+            self._schemas.pop(name, None)
+            stores = list(self._stores.values())
+        for st in stores:
+            if name in st.type_names:
+                st.delete_schema(name)
+
     def _store(self, partition: str) -> TpuDataStore:
         with self._lock:
             st = self._stores.get(partition)
@@ -430,6 +438,15 @@ class ShardedDataStore(TpuDataStore):
         for w in self.workers:
             w.create_schema(ft)
 
+    def delete_schema(self, name: str) -> None:
+        # super validates (unknown type raises BEFORE any worker drop)
+        # and bumps the write generation so build-cache keys can never
+        # reproduce the deleted incarnation
+        super().delete_schema(name)
+        for w in self.workers:
+            w.delete_schema(name)
+        self._partitions.pop(name, None)
+
     def _insert_columns(self, ft, columns, observe_stats: bool = True):
         """Route an ingest batch: rows bucket into partitions, each
         partition lands on its primary + replica shards. The coordinator
@@ -449,14 +466,20 @@ class ShardedDataStore(TpuDataStore):
                 self.workers[sid].insert(str(p), ft, sub)
         if observe_stats and self.stats is not None:
             self.stats.observe_columns(ft, columns)
+        # coordinator tables never move on writes (rows live on shard
+        # workers): the write-generation counter is the ONLY signal the
+        # schema-generation cache keys (ops/join.py) have here
+        self._note_write(ft.name)
 
     def delete_features(self, name: str, fids) -> None:
         for w in self.workers:
             w.delete(name, fids)
+        self._note_write(name)
 
     def compact(self, name: str) -> None:
         for w in self.workers:
             w.compact(name)
+        self._note_write(name)
 
     def age_off(self, name: str) -> int:
         by_primary: Dict[int, List[str]] = {}
@@ -468,6 +491,11 @@ class ShardedDataStore(TpuDataStore):
                 n = self.workers[t].age_off(name, ps)
                 if t == sid:
                     removed += n  # count primaries only; replicas mirror
+        if removed:
+            # age-off mutates worker rows like any delete: the write
+            # generation must move or schema-generation cache keys
+            # (ops/join.py) keep serving the expired features
+            self._note_write(name)
         return removed
 
     def count(self, name: str, query=None, exact: bool = True) -> int:

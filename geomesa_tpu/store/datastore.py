@@ -315,6 +315,12 @@ class TpuDataStore:
         self._indices: Dict[str, List[IndexKeySpace]] = {}
         self._tables: Dict[str, Dict[str, IndexTable]] = {}
         self._plan_cache: Dict[Any, QueryPlan] = {}
+        # per-type write generation: bumped on EVERY mutation path —
+        # including subclass overrides whose writes never touch the
+        # local tables (ShardedDataStore routes rows to shard workers)
+        # — so schema-generation cache keys (ops/join.py) can never
+        # serve state from before a write
+        self._write_gen: Dict[str, int] = {}
         if self.metrics is not None and hasattr(self.metrics, "gauge_fn"):
             # sampled at snapshot time: cache pressure without
             # bookkeeping. One gauge per REGISTRY summing over a WeakSet
@@ -381,6 +387,12 @@ class TpuDataStore:
         self.get_schema(name)
         self.metadata.delete(name)
         del self._schemas[name], self._indices[name], self._tables[name]
+        # the generation counter deliberately SURVIVES the schema (not
+        # popped): a delete + recreate cycle must never reproduce an
+        # old schema_generation, or the join build cache would serve
+        # pairs from the deleted incarnation on stores whose local
+        # table versions never move (ShardedDataStore coordinators)
+        self._note_write(name)
 
     # -- writes -------------------------------------------------------------
 
@@ -427,10 +439,27 @@ class TpuDataStore:
         # cold-column spill LAST: every index table and the stats observer
         # has read its columns; nothing refaults what fadvise just dropped
         record.spill()
+        self._note_write(ft.name)
+
+    def _note_write(self, name: str) -> None:
+        """Advance the type's write generation (see _write_gen). Every
+        mutation path — base or override — must call this."""
+        self._write_gen[name] = self._write_gen.get(name, 0) + 1
+
+    def schema_generation(self, name: str) -> tuple:
+        """An opaque value that changes whenever the type's stored rows
+        may have changed: local index-table versions (a lazy store's
+        replay moves them) plus the write counter (covers subclasses
+        that keep no local rows). Cache keys derive from this."""
+        return (
+            tuple(t.version for t in self._tables[name].values()),
+            self._write_gen.get(name, 0),
+        )
 
     def delete_features(self, name: str, fids: Sequence[str]):
         for table in self._tables[name].values():
             table.delete(fids)
+        self._note_write(name)
 
     def compact(self, name: str):
         tables = self._tables[name]
@@ -449,6 +478,7 @@ class TpuDataStore:
         for table in tables.values():
             table.compact(record)
         record.spill()  # after every table's rebuild read its columns
+        self._note_write(name)
 
     def count(self, name: str, query: Union[str, "Query", None] = None, exact: bool = True) -> int:
         """Feature count; with a filter, ``exact=False`` answers from stats
@@ -610,6 +640,113 @@ class TpuDataStore:
         """Pre-execution hook inside the query's root span — subclasses
         that must materialize state first (FsDataStore's lazy partition
         replay) override this so that work lands ON the query's trace."""
+
+    def query_join(
+        self,
+        build,
+        probe,
+        predicate: Union[str, Any] = "contains",
+        *,
+        radius_m: Optional[float] = None,
+    ):
+        """Spatial join: which probe features match which build features.
+
+        ``build``/``probe`` are type names or ``(name, query)`` pairs
+        (per-side filters push down through the ordinary scan pipeline);
+        ``predicate`` is ``"contains"`` (probe point in build polygon,
+        boundary inclusive) or ``"dwithin(<meters>)"`` /
+        ``("dwithin", radius_m=...)``. The build side is bucketed once
+        per schema generation into an HBM-resident Z-grid (ops/join.py)
+        with adaptive skew splits; the probe side streams through the
+        device kernels with exact f64 verification of boundary pairs,
+        and ANY device failure degrades to the host reference join with
+        identical pairs. Returns ``ops.join.JoinResult``.
+
+        The whole join runs under one query budget (inner build/probe
+        queries link their own budgets to it, PR 4/6 semantics) and
+        holds ONE admission slot end to end — the device probe loop is
+        the expensive phase, so it must count against
+        ``geomesa.query.max.inflight`` like any scan. The inner queries
+        ride the outer slot (reentrant admit per controller), so a join
+        costs exactly one slot and can never deadlock against itself."""
+        import time as _time
+
+        from geomesa_tpu.ops.join import JoinPlanner, JoinSpec
+        from geomesa_tpu.utils.audit import QueryTimeout, ShedLoad
+
+        spec = JoinSpec.parse(predicate, radius_m)
+        build_name, build_q = self._join_side(build)
+        probe_name, probe_q = self._join_side(probe)
+        root = trace.NOOP
+        t0 = _time.perf_counter()
+        try:
+            with trace.span(
+                "query.join", force=self.slow_query_s is not None,
+                build=build_name, probe=probe_name, predicate=spec.kind,
+            ) as root:
+                try:
+                    with deadline_mod.budget(self.query_timeout_s):
+                        # ONE admission slot for the whole join: the
+                        # kernel probe loop is the expensive phase and
+                        # must count against max_inflight like any scan;
+                        # the inner build/probe queries ride this slot
+                        # (reentrant admit), so a join can never
+                        # deadlock against itself
+                        with self.admission.admit():
+                            dev0 = devstats.receipt_snapshot()
+                            result = JoinPlanner(self).join(
+                                build_name, build_q, probe_name, probe_q,
+                                spec,
+                            )
+                        if root.recording:
+                            root.set_attr("join", result.stats)
+                            root.set_attr("hits", len(result))
+                            root.set_attr(
+                                "device", devstats.receipt_since(dev0)
+                            )
+                        if self.metrics is not None:
+                            self.metrics.inc("queries.join")
+                            self.metrics.update_timer(
+                                "query.join", _time.perf_counter() - t0
+                            )
+                        return result
+                except (QueryTimeout, ShedLoad) as e:
+                    # crisp failure: a timed-out join never returns a
+                    # truncated pair set — and it audits like any other
+                    # query (a join shed at admission never ran its
+                    # inner build/probe queries, so without this event
+                    # the outcome would be invisible to the PR 4
+                    # QueryEvent.outcome accounting)
+                    outcome = (
+                        "timeout" if isinstance(e, QueryTimeout) else "shed"
+                    )
+                    if root.recording:
+                        root.set_attr("outcome", outcome)
+                    if self.metrics is not None:
+                        # join-scoped counters only: a timeout inside an
+                        # inner build/probe query already audited itself
+                        # into queries/queries.<outcome> — counting the
+                        # join there too would show 2 failures for 1 join
+                        self.metrics.inc("queries.join")
+                        self.metrics.inc(f"queries.join.{outcome}")
+                    if self.audit_writer is not None:
+                        self._audit_failure(
+                            build_name + "+" + probe_name, probe_q, None,
+                            t0, outcome, count_metrics=False,
+                        )
+                    raise
+        finally:
+            self._log_slow_query(build_name + "+" + probe_name, None, root)
+
+    def _join_side(self, side) -> tuple:
+        """``"name"`` or ``(name, cql-or-Query)`` -> (name, Query)."""
+        if isinstance(side, str):
+            name, q = side, Query()
+        else:
+            name, q = side
+            q = self._as_query(q)
+        self.get_schema(name)  # fail fast on unknown types
+        return name, q
 
     def query_many(
         self, name: str, queries: Sequence[Union[str, Query]]
@@ -812,18 +949,22 @@ class TpuDataStore:
                 )
             )
 
-    def _audit_failure(self, name, query, plan, t_admit, outcome: str):
+    def _audit_failure(self, name, query, plan, t_admit, outcome: str,
+                       count_metrics: bool = True):
         """Audit trail for a query that FAILED crisply (timeout / shed):
         hits stay 0 — a failed query never has partial hits — and the
         elapsed wall (admission wait included) lands in scanning_ms so
-        latency dashboards see the cost overload actually charged."""
+        latency dashboards see the cost overload actually charged.
+        ``count_metrics=False`` writes the event only — query_join keeps
+        its failures in join-scoped counters so an inner query that
+        already audited its own timeout is not double-counted."""
         import time as _time
 
         from geomesa_tpu.filter.parser import to_cql
         from geomesa_tpu.utils.audit import QueryEvent
 
         elapsed_ms = 1000 * (_time.perf_counter() - t_admit)
-        if self.metrics is not None:
+        if count_metrics and self.metrics is not None:
             self.metrics.inc("queries")
             self.metrics.inc(f"queries.{outcome}")
         if self.audit_writer is not None:

@@ -28,6 +28,7 @@ Observability rides the existing rails: queue waits appear as
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from collections import deque
@@ -73,6 +74,15 @@ class AdmissionController:
         self.admitted = 0  # cumulative successful admissions
         self._waits: deque = deque(maxlen=_WAIT_RESERVOIR)  # seconds
         self._last_shed: Optional[float] = None
+        # context-local reentrancy: a caller that already holds a slot
+        # from THIS controller (query_join admits once around the whole
+        # join) must not queue for a second one — at max_inflight=1 that
+        # would deadlock the join against itself. Inner admits ride the
+        # outer slot; distinct controllers (per-shard workers) still
+        # admit independently.
+        self._ctx_held: contextvars.ContextVar[bool] = contextvars.ContextVar(
+            "admission_held_" + name, default=False
+        )
 
     def admit(self, budget_s: Optional[float] = None) -> "_Admit":
         """Context manager around one query (or one batch). ``budget_s``
@@ -191,14 +201,19 @@ class _Admit:
     """The admit() context manager (split out so admit() itself stays
     cheap to call and re-enterable per query)."""
 
-    __slots__ = ("_ctl", "_held", "_budget_s")
+    __slots__ = ("_ctl", "_held", "_budget_s", "_token")
 
     def __init__(self, ctl: AdmissionController, budget_s: Optional[float] = None):
         self._ctl = ctl
         self._held = False
         self._budget_s = budget_s
+        self._token: Optional[contextvars.Token] = None
 
     def __enter__(self) -> "_Admit":
+        if self._ctl._ctx_held.get():
+            # this context already holds a slot on this controller:
+            # ride it (no second slot, no self-deadlock)
+            return self
         if self._budget_s is not None and deadline_mod.ambient() is None:
             # bound the wait itself; the budget deliberately does NOT
             # extend over the admitted work (query_many installs its own
@@ -208,9 +223,13 @@ class _Admit:
         else:
             self._ctl._acquire()
         self._held = True
+        self._token = self._ctl._ctx_held.set(True)
         return self
 
     def __exit__(self, *exc) -> bool:
+        if self._token is not None:
+            self._ctl._ctx_held.reset(self._token)
+            self._token = None
         if self._held:
             self._held = False
             self._ctl._release()

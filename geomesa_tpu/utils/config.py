@@ -165,6 +165,19 @@ SHARD_QUEUE_DEPTH = SystemProperty("geomesa.shard.queue.depth", "128")
 # bbox query routes to the shards owning intersecting cells only;
 # schemas without a point geometry fall back to fid-hash partitions.
 SHARD_PARTITION_BITS = SystemProperty("geomesa.shard.partition.bits", "4")
+# Device-side spatial joins (ops/join.py): the build side buckets into a
+# low-resolution z2 grid (2^bits x 2^bits base cells); any bucket holding
+# more than `skew.threshold` geometries quad-splits into finer cells
+# (up to `split.depth` extra levels) so one hot geofence cluster cannot
+# blow the pow2 pad budget of every kernel dispatch. Built build sides
+# stay HBM-resident keyed by schema generation for `cache.ttl`; probe
+# points stream through the segment-upload path `probe.chunk` rows at a
+# time (padded to the pow2 bucket above the chunk).
+JOIN_BUCKET_BITS = SystemProperty("geomesa.join.bucket.bits", "3")
+JOIN_SKEW_THRESHOLD = SystemProperty("geomesa.join.skew.threshold", "128")
+JOIN_SPLIT_DEPTH = SystemProperty("geomesa.join.split.depth", "6")
+JOIN_CACHE_TTL = SystemProperty("geomesa.join.cache.ttl", "10 minutes")
+JOIN_PROBE_CHUNK = SystemProperty("geomesa.join.probe.chunk", "2048")
 # Socket-timeout knobs: NO I/O boundary is unbounded-by-default. The
 # netlog RPC client derives its per-attempt timeout from
 # min(geomesa.netlog.timeout, the query's remaining deadline); auxiliary

@@ -382,6 +382,13 @@ def device_debug() -> Dict[str, Any]:
     }
     compile_count, compile_sum_s = totals.get("xla.compile", (0, 0.0))
     try:
+        # lazy: the join subsystem may never have loaded in this process
+        from geomesa_tpu.ops.join import join_debug
+
+        join_block = join_debug()
+    except Exception:  # noqa: BLE001 - debug page must render regardless
+        join_block = {}
+    try:
         backend = jax.default_backend()
         n_devices = len(jax.devices())
     except Exception as e:  # noqa: BLE001 - backend init failure is still a page
@@ -416,4 +423,7 @@ def device_debug() -> Dict[str, Any]:
                 "device.hbm.peak_bytes_in_use", 0
             ),
         },
+        # spatial-join telemetry (ops/join.py): build-cache occupancy +
+        # hit/miss counters, bucket skew histogram, split/pair counters
+        "join": join_block,
     }

@@ -22,6 +22,10 @@ disk, or device boundary:
                        the coordinator observes it as a dead peer and fails
                        over to a replica placement
     shard.merge        shard-result gather/merge (parallel/shards.py)
+    join.build         build-side bucketing + device upload (ops/join.py)
+    join.probe         per-chunk probe dispatch of a spatial join
+                       (ops/join.py); device failures here degrade to
+                       the host reference join with identical pairs
 
 Kinds:
 
@@ -95,6 +99,8 @@ FAULT_POINTS = (
     "device.fetch",
     "shard.rpc",
     "shard.merge",
+    "join.build",
+    "join.probe",
 )
 
 KINDS = ("error", "drop", "latency", "torn", "crash")

@@ -6,6 +6,12 @@ SURVEY.md section 2.5). Endpoints over a datastore:
     GET /types
     GET /types/<name>            -- schema description
     GET /query?name=&cql=&format=geojson|csv&max=
+    POST /join                   -- device-side spatial join (ops/join.py):
+                                    JSON body {"build": {"name", "cql"},
+                                    "probe": {"name", "cql"}, "predicate":
+                                    "contains"|"dwithin", "radius_m", "max"}
+                                    -> {"pairs": [[build_fid, probe_fid]...],
+                                    "count", "stats"}
     GET /stats/count?name=&cql=&exact=
     GET /stats/bounds?name=
     GET /metrics                 -- Prometheus text exposition (store
@@ -50,9 +56,18 @@ from typing import Optional
 # past this only bloats the response a client asked for by accident
 MAX_DEBUG_TRACES = 1000
 
+# POST /join body cap: a join request is a small JSON spec, not a bulk
+# upload — an unbounded rfile.read(Content-Length) would buffer whatever
+# a client declares into RAM outside any admission/deadline envelope
+MAX_JOIN_BODY = 1 << 20
+
 
 def make_handler(store):
     class GeoMesaHandler(BaseHTTPRequestHandler):
+        # socket-level read timeout: a client that declares a body it
+        # never sends must not wedge its handler thread forever
+        timeout = 60
+
         def log_message(self, *args):  # quiet
             pass
 
@@ -66,6 +81,109 @@ def make_handler(store):
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
+
+        def _send_error(self, e: Exception) -> None:
+            """The shared failure mapping: overload sheds and exhausted-
+            shard failures answer 503 + Retry-After, deadline deaths 504,
+            anything else 500 — queries and joins fail crisply, never
+            with truncated bodies."""
+            from geomesa_tpu.utils.audit import (
+                QueryTimeout,
+                ShardUnavailable,
+                ShedLoad,
+            )
+
+            if isinstance(e, (ShedLoad, ShardUnavailable)):
+                self._send(
+                    503, json.dumps({"error": str(e)}),
+                    headers={"Retry-After": "1"},
+                )
+            elif isinstance(e, QueryTimeout):
+                self._send(504, json.dumps({"error": str(e)}))
+            else:
+                self._send(500, json.dumps({"error": str(e)}))
+
+        def do_POST(self):
+            try:
+                parsed = urllib.parse.urlparse(self.path)
+                route = parsed.path.rstrip("/")
+                if route != "/join":
+                    self._send(404, json.dumps({"error": "not found"}))
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    if length < 0:
+                        # rfile.read(-1) would block until an EOF the
+                        # client may never send
+                        raise ValueError(length)
+                except ValueError:
+                    self._send(
+                        400, json.dumps({"error": "invalid Content-Length"})
+                    )
+                    return
+                if length > MAX_JOIN_BODY:
+                    self._send(
+                        413, json.dumps({"error": "request body too large"})
+                    )
+                    return
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    body = json.loads(raw or b"{}")
+                except ValueError:
+                    self._send(400, json.dumps({"error": "invalid JSON body"}))
+                    return
+                try:
+                    bspec = body["build"]
+                    pspec = body["probe"]
+                    build = (bspec["name"], bspec.get("cql", "INCLUDE"))
+                    probe = (pspec["name"], pspec.get("cql", "INCLUDE"))
+                except (KeyError, TypeError):
+                    self._send(
+                        400,
+                        json.dumps({"error": (
+                            "body needs build/probe objects with a name: "
+                            '{"build": {"name", "cql"}, "probe": {...}}'
+                        )}),
+                    )
+                    return
+                # validate the cap BEFORE paying for the join: a bad
+                # "max" is the caller's error (400), like /debug/traces
+                limit = body.get("max")
+                if limit is not None:
+                    try:
+                        limit = int(limit)
+                    except (TypeError, ValueError):
+                        self._send(
+                            400,
+                            json.dumps({"error": "max must be an integer"}),
+                        )
+                        return
+                    if limit < 0:
+                        self._send(
+                            400, json.dumps({"error": "max must be >= 0"})
+                        )
+                        return
+                from geomesa_tpu.ops.join import JoinError
+
+                try:
+                    res = store.query_join(
+                        build, probe,
+                        predicate=body.get("predicate", "contains"),
+                        radius_m=body.get("radius_m"),
+                    )
+                except (JoinError, KeyError) as e:
+                    self._send(400, json.dumps({"error": str(e)}))
+                    return
+                self._send(
+                    200,
+                    json.dumps({
+                        "pairs": res.pairs(limit),
+                        "count": len(res),
+                        "stats": res.stats,
+                    }, default=str),
+                )
+            except Exception as e:  # surface the error to the client
+                self._send_error(e)
 
         def do_GET(self):
             try:
@@ -368,26 +486,7 @@ def make_handler(store):
             except KeyError as e:
                 self._send(400, json.dumps({"error": f"missing param {e}"}))
             except Exception as e:  # surface the error to the client
-                from geomesa_tpu.utils.audit import (
-                    QueryTimeout,
-                    ShardUnavailable,
-                    ShedLoad,
-                )
-
-                if isinstance(e, (ShedLoad, ShardUnavailable)):
-                    # overload sheds AND exhausted-shard failures map to
-                    # the HTTP backpressure idiom: 503 + Retry-After —
-                    # cheap for the server, actionable for a well-behaved
-                    # client (a shard may recover within a breaker
-                    # cooldown)
-                    self._send(
-                        503, json.dumps({"error": str(e)}),
-                        headers={"Retry-After": "1"},
-                    )
-                elif isinstance(e, QueryTimeout):
-                    self._send(504, json.dumps({"error": str(e)}))
-                else:
-                    self._send(500, json.dumps({"error": str(e)}))
+                self._send_error(e)
 
     return GeoMesaHandler
 

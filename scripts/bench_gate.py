@@ -64,6 +64,50 @@ DEFAULT_TOLERANCE = {
 }
 
 
+def run_join_stream(store, reps: int) -> dict:
+    """The spatial-join bench leg: GDELT-style points (the store the
+    main stream just built) x a synthetic geofence set, through
+    store.query_join. The first join builds + uploads the bucketed
+    build side; the remaining reps must ride the HBM-resident cache —
+    build-reuse is part of what the gate pins (a lost cache shows up as
+    a per_join_ms regression AND a build_hits drop). Pair parity is a
+    correctness gate like hits_total."""
+    import numpy as np
+
+    from geomesa_tpu.geom.base import Polygon
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.utils import devstats
+
+    rng = np.random.default_rng(11)
+    store.create_schema(
+        parse_spec("fences", "zname:String,*geom:Polygon:srid=4326")
+    )
+    with store.writer("fences") as w:
+        for i in range(64):
+            cx = rng.uniform(-160, 150)
+            cy = rng.uniform(-70, 60)
+            wdeg, hdeg = rng.uniform(2, 12, 2)
+            w.write([f"z{i}", Polygon(
+                [[cx, cy], [cx + wdeg, cy], [cx + wdeg, cy + hdeg],
+                 [cx, cy + hdeg], [cx, cy]]
+            )], fid=f"g{i}")
+    hits0 = devstats.devstats_metrics().counter("join.build.hits")
+    t0 = time.perf_counter()
+    pairs = 0
+    for _ in range(reps):
+        res = store.query_join("fences", "gdelt", predicate="contains")
+        pairs = len(res)
+    total_s = time.perf_counter() - t0
+    build_hits = devstats.devstats_metrics().counter("join.build.hits") - hits0
+    return {
+        "reps": reps,
+        "per_join_ms": round(total_s / max(reps, 1) * 1000.0, 3),
+        "pairs": pairs,
+        "build_hits": build_hits,
+        "path": res.stats["path"],
+    }
+
+
 def run_stream(n: int, reps: int) -> dict:
     """Ingest n synthetic rows, warm (pack + compile), then run the
     jittered bench query stream traced; return the gate artifact."""
@@ -120,8 +164,10 @@ def run_stream(n: int, reps: int) -> dict:
         for name, (cnt, self_ms) in sorted(per_name.items())
     }
     hits = sum(len(r) for r in results)
+    join = run_join_stream(store, max(2, reps // 2))
     return {
         "schema": 1,
+        "join": join,
         "config": {
             "n": n,
             "reps": reps,
@@ -154,6 +200,10 @@ def inject_slowdown(artifact: dict, factor: float) -> dict:
     for row in out["spans"].values():
         row["self_ms"] = round(row["self_ms"] * factor, 3)
         row["ms_per_query"] = round(row["ms_per_query"] * factor, 3)
+    if "join" in out:
+        out["join"]["per_join_ms"] = round(
+            out["join"]["per_join_ms"] * factor, 3
+        )
     out["injected_slowdown"] = factor
     return out
 
@@ -214,6 +264,34 @@ def compare(baseline: dict, current: dict, tolerance: dict = None) -> list:
             f"hits_total drifted: {current.get('hits_total')} != "
             f"{baseline.get('hits_total')} (CORRECTNESS, not perf)"
         )
+
+    # the spatial-join leg gates like the main stream: wall inside the
+    # time band, pair count an exact correctness check, and the
+    # build-cache hit count pinned (a lost HBM build cache re-uploads
+    # the geofence set every query — exactly the regression the
+    # build-once design exists to prevent). Baselines recorded before
+    # the join leg skip it.
+    b_join = baseline.get("join")
+    c_join = current.get("join", {})
+    if b_join:
+        b_ms, c_ms = b_join["per_join_ms"], c_join.get("per_join_ms", 0.0)
+        limit = b_ms * tol["per_query_ms_factor"]
+        if c_ms > limit:
+            out.append(
+                f"join per_join_ms regressed: {c_ms:.1f} > {limit:.1f} "
+                f"(baseline {b_ms:.1f} x {tol['per_query_ms_factor']})"
+            )
+        if b_join.get("pairs") != c_join.get("pairs"):
+            out.append(
+                f"join pairs drifted: {c_join.get('pairs')} != "
+                f"{b_join.get('pairs')} (CORRECTNESS, not perf)"
+            )
+        if c_join.get("build_hits", 0) < b_join.get("build_hits", 0):
+            out.append(
+                f"join build_hits dropped: {c_join.get('build_hits')} < "
+                f"{b_join.get('build_hits')} — the HBM build cache "
+                "stopped reusing the geofence build side"
+            )
     return out
 
 

@@ -23,10 +23,16 @@
 #     fault-free single-process run or fails crisply with
 #     QueryTimeout/ShardUnavailable, never a truncated result, with the
 #     per-shard outcome table attributing which shard degraded and why
+#   - join parity under faults (tests/test_join.py): for every
+#     join.build/join.probe × error/drop/latency × seed schedule the
+#     spatial join answers IDENTICAL pairs to the fault-free run (device
+#     degrades to the host reference join), a crash schedule dies
+#     crisply mid-join, and device-vs-host parity holds on every seed
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
 cd "$(dirname "$0")/.."
 exec timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_chaos.py tests/test_crash.py tests/test_shards.py -q -m chaos \
+    tests/test_chaos.py tests/test_crash.py tests/test_shards.py \
+    tests/test_join.py -q -m chaos \
     -p no:cacheprovider "$@"

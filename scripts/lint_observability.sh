@@ -30,6 +30,8 @@ declare -A SPANS=(
     ["stream.poll"]="geomesa_tpu/stream/store.py"
     ["shard.rpc"]="geomesa_tpu/parallel/shards.py"
     ["shard.merge"]="geomesa_tpu/parallel/shards.py"
+    ["join.build"]="geomesa_tpu/ops/join.py"
+    ["join.probe"]="geomesa_tpu/ops/join.py"
 )
 for point in "${!SPANS[@]}"; do
     file="${SPANS[$point]}"

@@ -68,6 +68,19 @@ for point in shard.rpc shard.merge; do
     fi
 done
 
+# the spatial-join boundaries are pinned the same way: the build-side
+# upload and every probe chunk must stay injectable (ops/join.py), so
+# the join's device->host degradation parity can always be chaos-tested
+for point in join.build join.probe; do
+    if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/ops/join.py; then
+        echo "FAIL: geomesa_tpu/ops/join.py lost the '${point}' fault point"
+        echo "      (the join contract: build upload and probe chunks are"
+        echo "       injectable — faults.fault_point(\"${point}\") beside a"
+        echo "       deadline check; see utils/faults.py)"
+        fail=1
+    fi
+done
+
 # multi-file mutation sites in the store tier must declare a
 # write-ahead intent before touching files (crash-consistency contract)
 while IFS= read -r f; do

@@ -76,3 +76,53 @@ def test_dwithin_mask():
     y = np.array([0.0, 0.0, 0.0], dtype=np.float32)
     got = np.asarray(dwithin_mask_f32(x, y, 0.0, 0.0, 100_000.0))
     np.testing.assert_array_equal(got, [True, True, False])
+
+
+def test_dwithin_mask_honors_grid_snap_epsilon():
+    """Regression (PR 7): the device dwithin mask must widen by the curve
+    layer's GridSnap/normalization epsilon + f32 slack so radii mean the
+    same thing in planner pruning and kernel evaluation — a boundary
+    point the f64 host predicate keeps can NEVER be dropped by the f32
+    pre-filter. Before the fix, points within a few meters of the exact
+    radius flipped on f32 rounding."""
+    from geomesa_tpu.ops.geometry import snap_epsilon_deg, snap_epsilon_m
+    from geomesa_tpu.process.geodesy import haversine_m
+
+    # the epsilon is one z2 grid cell (31 bits) in planner units plus
+    # the f32 distance slack — nonzero, radius-scaled, and shared by
+    # planner pruning (degrees) and kernel evaluation (meters)
+    assert snap_epsilon_deg() == 360.0 / (1 << 31)
+    assert snap_epsilon_m(0.0) >= 16.0
+    assert snap_epsilon_m(1e7) > snap_epsilon_m(100.0)
+
+    # a dense ring of points straddling the exact radius: every point the
+    # f64 predicate accepts must survive the f32 mask (superset contract)
+    r = 250_000.0
+    cx, cy = 7.3, 44.1
+    rng = np.random.default_rng(5)
+    theta = rng.uniform(0, 2 * np.pi, 4000)
+    # place each point within ~+-1 m of its target distance (targets
+    # straddle the boundary inside the measured ~+-0.5 m f32 evaluation
+    # noise): start from the flat-earth guess, then Newton-correct the
+    # radial scale against the true f64 haversine
+    target = r + rng.uniform(-0.5, 0.5, 4000)
+    deg = target / 111_194.93
+    dx = deg * np.cos(theta) / np.cos(np.radians(cy))
+    dy = deg * np.sin(theta)
+    for _ in range(3):
+        d = haversine_m(cx + dx, cy + dy, cx, cy)
+        scale = target / d
+        dx *= scale
+        dy *= scale
+    x = (cx + dx).astype(np.float32)
+    y = (cy + dy).astype(np.float32)
+    exact = haversine_m(
+        np.asarray(x, np.float64), np.asarray(y, np.float64), cx, cy
+    ) <= r
+    masked = np.asarray(dwithin_mask_f32(x, y, cx, cy, r))
+    assert exact.any() and not exact.all()  # the draw straddles
+    assert not (exact & ~masked).any()  # no true hit is ever pre-filtered
+    # with the widening disabled, the raw mask provably flips boundary
+    # points (the bug this regression pins)
+    raw = np.asarray(dwithin_mask_f32(x, y, cx, cy, r, snap_m=0.0))
+    assert (exact & ~raw).any()

@@ -301,3 +301,46 @@ def test_web_debug_device_and_metrics_carry_devstats(monkeypatch):
     assert "geomesa_device_h2d_bytes" in metrics
     assert "geomesa_xla_compile_total" in metrics
     assert "geomesa_device_pad_ratio" in metrics
+
+
+def test_debug_device_join_block():
+    """GET /debug/device carries the spatial-join telemetry block:
+    build-cache entries/hits, the bucket skew histogram, and the split/
+    chunk counters (ops/join.join_debug)."""
+    from geomesa_tpu.geom.base import Polygon
+    from geomesa_tpu.parallel.executor import TpuScanExecutor
+    from geomesa_tpu.utils.config import properties
+    from geomesa_tpu.web import GeoMesaServer
+
+    store = _fill(TpuDataStore(executor=TpuScanExecutor()), n=500)
+    store.create_schema(
+        parse_spec("zones", "zname:String,*geom:Polygon:srid=4326")
+    )
+    rng = np.random.default_rng(2)
+    with store.writer("zones") as w:
+        # a skewed cluster so the split counter provably moves
+        for i in range(24):
+            cx, cy = rng.uniform(0, 15, 2)
+            w.write([f"z{i}", Polygon(
+                [[cx, cy], [cx + 1, cy], [cx + 1, cy + 1], [cx, cy + 1],
+                 [cx, cy]]
+            )], fid=f"g{i}")
+    splits0 = devstats.devstats_metrics().counter("join.bucket.splits")
+    with properties(geomesa_join_skew_threshold="4"):
+        store.query_join("zones", "gdelt", predicate="contains")
+        store.query_join("zones", "gdelt", predicate="contains")  # cache hit
+    with GeoMesaServer(store) as url:
+        dev = json.loads(
+            urllib.request.urlopen(url + "/debug/device").read()
+        )
+    j = dev["join"]
+    assert j["build_cache"]["entries"] >= 1
+    assert j["build_cache"]["hits"] >= 1
+    assert j["build_cache"]["misses"] >= 1
+    assert j["buckets"]["count"] >= 1
+    assert j["buckets"]["max_entries"] >= 1
+    assert j["buckets"]["splits_total"] > splits0
+    assert isinstance(j["buckets"]["histogram"], dict)
+    assert j["buckets"]["histogram"]  # occupancy buckets present
+    assert j["probe"]["chunks"] >= 1
+    assert j["probe"]["pairs"] >= 0

@@ -1,0 +1,1005 @@
+"""Device-side spatial joins (ops/join.py + TpuDataStore.query_join).
+
+Parity contract: the device kernel path (f32 dual-mask prefilter + exact
+f64 boundary verification) answers IDENTICAL pairs to the host reference
+join, which in turn matches a pure-NumPy / Shapely-free reference
+implemented here — across degenerate polygons (touching edges, vertex
+hits, empty build side, NaN-geometry "null" rows), skewed build sides
+(adaptive bucket splits), every chaos schedule over the join.build /
+join.probe fault points, the SQL JOIN pushdown, and the POST /join web
+surface.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point, Polygon
+from geomesa_tpu.ops.join import (
+    JoinBuild,
+    JoinError,
+    JoinSpec,
+    host_join,
+    join_debug,
+)
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.process.geodesy import haversine_m
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import faults
+from geomesa_tpu.utils.audit import QueryTimeout
+from geomesa_tpu.utils.config import properties
+
+T0 = 1483228800000
+
+ZONES = [
+    # two rectangles SHARING the edge x=5 (touching edges), plus a
+    # triangle with a vertex exactly at (20, 20)
+    Polygon([[0, 0], [5, 0], [5, 10], [0, 10], [0, 0]]),
+    Polygon([[5, 0], [10, 0], [10, 10], [5, 10], [5, 0]]),
+    Polygon([[20, 20], [30, 20], [25, 30], [20, 20]]),
+]
+
+
+def _point_in_poly_ref(x, y, poly) -> np.ndarray:
+    """The test's OWN reference: even-odd ray cast over shell+holes with
+    an explicit boundary test — pure NumPy, no geom.predicates."""
+    x = np.asarray(x, float)
+    y = np.asarray(y, float)
+    inside = np.zeros(len(x), dtype=bool)
+    on_edge = np.zeros(len(x), dtype=bool)
+    rings = [poly.shell] + list(poly.holes or [])
+    for ring in rings:
+        r = np.asarray(ring, float)
+        for i in range(len(r) - 1):
+            (x0, y0), (x1, y1) = r[i], r[i + 1]
+            straddles = (y0 > y) != (y1 > y)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                xint = x0 + (y - y0) * (x1 - x0) / ((y1 - y0) or 1.0)
+            inside ^= straddles & (xint > x)
+            # boundary: point on the closed segment
+            abx, aby = x1 - x0, y1 - y0
+            den = abx * abx + aby * aby
+            t = np.clip(
+                ((x - x0) * abx + (y - y0) * aby) / (den if den else 1.0),
+                0.0, 1.0,
+            )
+            d2 = (x - (x0 + t * abx)) ** 2 + (y - (y0 + t * aby)) ** 2
+            on_edge |= d2 == 0.0
+    return inside | on_edge
+
+
+def _reference_pairs_contains(polys, fids_b, px, py, fids_p):
+    out = set()
+    for gi, p in enumerate(polys):
+        if p is None:
+            continue
+        m = _point_in_poly_ref(px, py, p) & ~np.isnan(px) & ~np.isnan(py)
+        for i in np.flatnonzero(m):
+            out.add((str(fids_b[gi]), str(fids_p[i])))
+    return out
+
+
+def _reference_pairs_dwithin(bx, by, fids_b, px, py, fids_p, r):
+    out = set()
+    for gi in range(len(bx)):
+        if np.isnan(bx[gi]) or np.isnan(by[gi]):
+            continue
+        d = haversine_m(px, py, bx[gi], by[gi])
+        m = (d <= r) & ~np.isnan(px) & ~np.isnan(py)
+        for i in np.flatnonzero(m):
+            out.add((str(fids_b[gi]), str(fids_p[i])))
+    return out
+
+
+def _mkstore(device=True, n=300, seed=0, zones=ZONES, boundary_probes=True,
+             **store_kw):
+    ex = TpuScanExecutor(default_mesh()) if device else None
+    store = TpuDataStore(executor=ex, **store_kw)
+    store.create_schema(parse_spec("events", "kind:String,dtg:Date,*geom:Point:srid=4326"))
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-5, 35, n)
+    y = rng.uniform(-5, 35, n)
+    if boundary_probes and n >= 12:
+        # degenerate probes: the shared edge, a vertex hit, NaN rows
+        x[0], y[0] = 5.0, 5.0      # exactly ON the touching edge
+        x[1], y[1] = 20.0, 20.0    # exactly ON a polygon vertex
+        x[2], y[2] = 5.0, 0.0      # shared corner of both rectangles
+        x[3], y[3] = np.nan, np.nan  # null-geometry partition row
+    store._insert_columns(store.get_schema("events"), {
+        "__fid__": np.array([f"e{i}" for i in range(n)], dtype=object),
+        "kind": np.array([f"k{i % 3}" for i in range(n)], dtype=object),
+        "geom__x": x, "geom__y": y,
+        "dtg": np.full(n, T0, dtype=np.int64),
+    })
+    store.create_schema(parse_spec("zones", "zname:String,*geom:Polygon:srid=4326"))
+    with store.writer("zones") as w:
+        for i, p in enumerate(zones):
+            w.write([f"z{i}", p], fid=f"g{i}")
+    return store, x, y
+
+
+# -- parity: device == host == pure-NumPy reference ---------------------------
+
+
+def test_contains_parity_device_host_reference():
+    store, x, y = _mkstore(device=True)
+    dev = store.query_join("zones", "events", predicate="contains")
+    assert dev.stats["path"] == "device-join"
+
+    host_store, _, _ = _mkstore(device=False)
+    host = host_store.query_join("zones", "events", predicate="contains")
+    assert host.stats["path"] == "host-join"
+
+    fids_p = [f"e{i}" for i in range(len(x))]
+    ref = _reference_pairs_contains(
+        ZONES, [f"g{i}" for i in range(len(ZONES))], x, y, fids_p
+    )
+    assert set(dev.pairs()) == set(host.pairs()) == ref
+    assert dev.pairs() == host.pairs()  # canonical order, not just set
+    # the probe on the SHARED edge matched BOTH rectangles (boundary
+    # inclusive, like the host evaluator), the vertex probe matched the
+    # triangle, and the NaN row matched nothing
+    got = set(dev.pairs())
+    assert ("g0", "e0") in got and ("g1", "e0") in got
+    assert ("g2", "e1") in got
+    assert not any(p == "e3" for _b, p in got)
+
+
+def test_dwithin_parity_device_host_reference():
+    r = 300_000.0
+    store, x, y = _mkstore(device=True, n=200, seed=1)
+    dev = store.query_join(
+        ("events", "kind = 'k0'"), ("events", "kind <> 'k0'"),
+        predicate=f"dwithin({r})",
+    )
+    assert dev.stats["path"] == "device-join"
+    host_store, _, _ = _mkstore(device=False, n=200, seed=1)
+    host = host_store.query_join(
+        ("events", "kind = 'k0'"), ("events", "kind <> 'k0'"),
+        predicate="dwithin", radius_m=r,
+    )
+    assert dev.pairs() == host.pairs()
+    k = np.array([f"k{i % 3}" for i in range(200)])
+    bsel = np.flatnonzero(k == "k0")
+    psel = np.flatnonzero(k != "k0")
+    ref = _reference_pairs_dwithin(
+        x[bsel], y[bsel], [f"e{i}" for i in bsel],
+        x[psel], y[psel], [f"e{i}" for i in psel], r,
+    )
+    assert set(dev.pairs()) == ref
+
+
+def test_empty_build_side_and_empty_probe():
+    store, _x, _y = _mkstore(device=True)
+    res = store.query_join(("zones", "zname = 'nope'"), "events",
+                           predicate="contains")
+    assert len(res) == 0 and res.pairs() == []
+    res2 = store.query_join("zones", ("events", "kind = 'nope'"),
+                            predicate="contains")
+    assert len(res2) == 0
+
+
+def test_polygon_with_hole_parity():
+    donut = Polygon(
+        [[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]],
+        holes=[[[3, 3], [7, 3], [7, 7], [3, 7], [3, 3]]],
+    )
+    store, x, y = _mkstore(device=True, zones=[donut], boundary_probes=False)
+    dev = store.query_join("zones", "events", predicate="contains")
+    hstore, _, _ = _mkstore(device=False, zones=[donut], boundary_probes=False)
+    host = hstore.query_join("zones", "events", predicate="contains")
+    assert dev.pairs() == host.pairs()
+    ref = _reference_pairs_contains(
+        [donut], ["g0"], x, y, [f"e{i}" for i in range(len(x))]
+    )
+    assert set(dev.pairs()) == ref
+    # the hole actually excludes interior points
+    inside_hole = (x > 3.5) & (x < 6.5) & (y > 3.5) & (y < 6.5)
+    assert inside_hole.any()
+    got_probe = {p for _b, p in dev.pairs()}
+    assert not any(f"e{i}" in got_probe for i in np.flatnonzero(inside_hole))
+
+
+# -- adaptive skew splits -----------------------------------------------------
+
+
+def test_skewed_build_splits_and_completes_within_deadline():
+    """One bucket holding >50% of the geometries: the adaptive split
+    engages (split counters move, the pad cap stays bounded) and the
+    join completes inside the ordinary deadline envelope."""
+    rng = np.random.default_rng(7)
+    # 40 small geofences crammed into one base cell (base grid is 8x8 ->
+    # 45x22.5 degrees; all of these fit in [0,20)^2), 4 spread elsewhere
+    zones = []
+    for i in range(40):
+        cx, cy = rng.uniform(0, 18, 2)
+        zones.append(Polygon([[cx, cy], [cx + 1, cy], [cx + 1, cy + 1],
+                              [cx, cy + 1], [cx, cy]]))
+    for i in range(4):
+        cx = -170 + i * 40
+        zones.append(Polygon([[cx, -80], [cx + 2, -80], [cx + 2, -78],
+                              [cx, -78], [cx, -80]]))
+    with properties(geomesa_join_skew_threshold="8"):
+        store, x, y = _mkstore(device=True, n=400, seed=3, zones=zones,
+                               query_timeout_s=30.0)
+        res = store.query_join("zones", "events", predicate="contains")
+        assert res.stats["path"] == "device-join"
+        assert res.stats["splits"] > 0
+        assert res.stats["max_bucket"] <= 40
+        hstore, _, _ = _mkstore(device=False, n=400, seed=3, zones=zones)
+        host = hstore.query_join("zones", "events", predicate="contains")
+    assert res.pairs() == host.pairs()
+    ref = _reference_pairs_contains(
+        zones, [f"g{i}" for i in range(len(zones))], x, y,
+        [f"e{i}" for i in range(len(x))],
+    )
+    assert set(res.pairs()) == ref
+
+
+# -- build cache --------------------------------------------------------------
+
+
+def test_build_cache_hit_and_generation_invalidation():
+    store, _x, _y = _mkstore(device=True)
+    r1 = store.query_join("zones", "events", predicate="contains")
+    assert r1.stats["build"] == "rebuild"
+    r2 = store.query_join("zones", "events", predicate="contains")
+    assert r2.stats["build"] == "hit"
+    assert r1.pairs() == r2.pairs()
+    # a write moves the schema generation: the cache key changes and the
+    # build side rebuilds — a stale HBM build can never answer
+    with store.writer("zones") as w:
+        w.write(["z9", Polygon([[30, -5], [32, -5], [32, -3], [30, -3],
+                                [30, -5]])], fid="g9")
+    r3 = store.query_join("zones", "events", predicate="contains")
+    assert r3.stats["build"] == "rebuild"
+    assert r3.stats["geometries"] == len(ZONES) + 1
+    # different predicate/filter = different cache entries
+    r4 = store.query_join(("zones", "zname = 'z0'"), "events",
+                          predicate="contains")
+    assert r4.stats["build"] == "rebuild"
+
+
+def test_join_spec_parse_errors():
+    assert JoinSpec.parse("dwithin(500)").radius_m == 500.0
+    assert JoinSpec.parse("contains").kind == "contains"
+    assert JoinSpec.parse("dwithin", 10.0).radius_m == 10.0
+    with pytest.raises(JoinError):
+        JoinSpec.parse("dwithin")  # no radius
+    with pytest.raises(JoinError):
+        JoinSpec.parse("touches")
+    with pytest.raises(JoinError):
+        JoinSpec.parse("dwithin(-5)")
+    store, _x, _y = _mkstore(device=False)
+    with pytest.raises(JoinError):
+        # contains needs a polygonal build side
+        store.query_join("events", "events", predicate="contains")
+    with pytest.raises(JoinError):
+        # dwithin needs a point build side
+        store.query_join("zones", "events", predicate="dwithin(10)")
+    with pytest.raises(KeyError):
+        store.query_join("missing", "events")
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_join_stats_on_root_span_and_debug_block():
+    from geomesa_tpu.utils import trace
+
+    store, _x, _y = _mkstore(device=True)
+    ring = trace.InMemoryTraceExporter(capacity=8)
+    with trace.exporting(ring):
+        store.query_join("zones", "events", predicate="contains")
+    roots = [t for t in ring.traces if t.name == "query.join"]
+    assert len(roots) == 1
+    root = roots[0]
+    js = root.attributes["join"]
+    assert js["path"] == "device-join"
+    assert {"buckets", "splits", "max_bucket", "pairs", "probed",
+            "build", "histogram"} <= set(js)
+    assert "device" in root.attributes  # cost receipt rides the join root too
+    names = {s.name for s in root.walk()}
+    assert "join.build" in names and "join.probe" in names
+    # the debug block reflects the build
+    dbg = join_debug()
+    assert dbg["build_cache"]["entries"] >= 1
+    assert dbg["buckets"]["count"] >= 1
+    assert isinstance(dbg["buckets"]["histogram"], dict)
+
+
+def test_web_post_join_endpoint():
+    from geomesa_tpu.web import GeoMesaServer
+
+    store, x, y = _mkstore(device=True)
+    with GeoMesaServer(store) as url:
+        body = json.dumps({
+            "build": {"name": "zones"},
+            "probe": {"name": "events", "cql": "kind = 'k1'"},
+            "predicate": "contains",
+        }).encode()
+        req = urllib.request.Request(url + "/join", data=body,
+                                     headers={"Content-Type": "application/json"})
+        got = json.loads(urllib.request.urlopen(req).read())
+        assert got["count"] == len(got["pairs"])
+        assert got["stats"]["path"] == "device-join"
+        k = np.array([f"k{i % 3}" for i in range(len(x))])
+        sel = np.flatnonzero(k == "k1")
+        ref = _reference_pairs_contains(
+            ZONES, [f"g{i}" for i in range(len(ZONES))],
+            x[sel], y[sel], [f"e{i}" for i in sel],
+        )
+        assert {tuple(p) for p in got["pairs"]} == ref
+        # max truncates explicitly
+        body2 = json.dumps({
+            "build": {"name": "zones"}, "probe": {"name": "events"},
+            "predicate": "contains", "max": 2,
+        }).encode()
+        req2 = urllib.request.Request(url + "/join", data=body2)
+        got2 = json.loads(urllib.request.urlopen(req2).read())
+        assert len(got2["pairs"]) == 2 and got2["count"] >= 2
+        # bad requests answer 400, not 500
+        for bad in (b"{not json", b"{}",
+                    json.dumps({"build": {"name": "zones"},
+                                "probe": {"name": "events"},
+                                "predicate": "dwithin"}).encode()):
+            req3 = urllib.request.Request(url + "/join", data=bad)
+            try:
+                urllib.request.urlopen(req3)
+                raise AssertionError("expected HTTPError")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+
+
+# -- SQL pushdown -------------------------------------------------------------
+
+
+def test_sql_join_rides_device_join():
+    from geomesa_tpu.compute.sql import SQLContext
+
+    store, x, y = _mkstore(device=True)
+    host_store, _, _ = _mkstore(device=False)
+    q = ("SELECT a.kind, b.zname FROM events a JOIN zones b "
+         "ON st_contains(b.geom, a.geom) WHERE a.kind <> 'k2'")
+    dev = SQLContext(store).sql(q)
+    host = SQLContext(host_store).sql(q)
+    assert list(dev.columns) == list(host.columns)
+    for k in dev.columns:
+        assert np.array_equal(
+            np.asarray(dev.columns[k], object),
+            np.asarray(host.columns[k], object),
+        ), k
+    # the device store actually joined on device (cache now warm)
+    jr = store.query_join("zones", ("events", "kind <> 'k2'"),
+                          predicate="contains")
+    assert jr.stats["build"] == "hit"
+    assert jr.stats["path"] == "device-join"
+
+
+def test_sql_dwithin_join_rides_device_join():
+    from geomesa_tpu.compute.sql import SQLContext
+
+    store, x, y = _mkstore(device=True, n=120, seed=5)
+    host_store, _, _ = _mkstore(device=False, n=120, seed=5)
+    q = ("SELECT a.kind, b.kind AS bk FROM events a JOIN events b "
+         "ON st_dwithin(a.geom, b.geom, 250000) WHERE b.kind = 'k0'")
+    dev = SQLContext(store).sql(q)
+    host = SQLContext(host_store).sql(q)
+    assert len(dev.columns["kind"]) == len(host.columns["kind"]) > 0
+    for k in dev.columns:
+        assert np.array_equal(
+            np.asarray(dev.columns[k], object),
+            np.asarray(host.columns[k], object),
+        ), k
+
+
+# -- failure envelope ---------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("schedule", [
+    "join.build:error=1.0",
+    "join.probe:error=0.5",
+    "join.probe:drop=0.5",
+    "join.build:latency=1.0,join.probe:latency=0.5",
+    "device.dispatch:error=0.3,device.fetch:error=0.3,join.probe:error=0.2",
+])
+def test_join_parity_under_faults(schedule, seed):
+    """Any error/drop/latency schedule over the join fault points may
+    cost latency (device->host degradation), never correctness: the
+    pairs are identical to the fault-free run on every seed."""
+    base_store, _x, _y = _mkstore(device=True, seed=seed)
+    base = base_store.query_join("zones", "events", predicate="contains")
+    store, _x, _y = _mkstore(device=True, seed=seed)
+    with faults.inject(schedule, seed=seed):
+        got = store.query_join("zones", "events", predicate="contains")
+    assert got.pairs() == base.pairs()
+    assert got.stats["path"] in ("device-join", "host-join-degraded")
+    # dwithin flavor on one seed per schedule (keeps the soak bounded)
+    if seed == 0:
+        b2, _, _ = _mkstore(device=True, seed=11, n=120)
+        want = b2.query_join("events", "events", predicate="dwithin(200000)")
+        s2, _, _ = _mkstore(device=True, seed=11, n=120)
+        with faults.inject(schedule, seed=seed):
+            got2 = s2.query_join("events", "events",
+                                 predicate="dwithin(200000)")
+        assert got2.pairs() == want.pairs()
+
+
+@pytest.mark.chaos
+def test_join_crash_dies_crisply():
+    """A crash schedule at a join boundary unwinds like a process death:
+    no partial pair set escapes."""
+    store, _x, _y = _mkstore(device=True)
+    with faults.inject("join.probe:crash", seed=1):
+        with pytest.raises(faults.SimulatedCrash):
+            store.query_join("zones", "events", predicate="contains")
+    # the store still answers (and identically) afterwards
+    fresh, _x, _y = _mkstore(device=True)
+    assert (store.query_join("zones", "events", predicate="contains").pairs()
+            == fresh.query_join("zones", "events", predicate="contains").pairs())
+
+
+@pytest.mark.chaos
+def test_join_latency_bounded_by_deadline():
+    """A latency storm on the probe chunks costs at most the deadline:
+    the join either answers correct pairs or dies with QueryTimeout —
+    never a truncated pair set."""
+    base_store, _x, _y = _mkstore(device=True, n=400)
+    base = base_store.query_join("zones", "events", predicate="contains")
+    store, _x, _y = _mkstore(device=True, n=400, query_timeout_s=0.15)
+    rules = [faults.FaultRule("join.probe", "latency", latency_s=0.2),
+             faults.FaultRule("join.build", "latency", latency_s=0.2)]
+    import time
+
+    t0 = time.perf_counter()
+    try:
+        got = store.query_join("zones", "events", predicate="contains")
+        assert got.pairs() == base.pairs()
+    except QueryTimeout:
+        pass  # crisp, never truncated
+    finally:
+        elapsed = time.perf_counter() - t0
+    with faults.inject(rules=rules):
+        t0 = time.perf_counter()
+        try:
+            got = store.query_join("zones", "events", predicate="contains")
+            assert got.pairs() == base.pairs()
+        except QueryTimeout:
+            pass
+        elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0  # deadline + granularity, not unbounded
+
+
+def test_fs_store_join_with_lazy_replay(tmp_path):
+    """query_join on FsDataStore: the build query's lazy partition
+    replay lands inside the join, the build caches under the generation
+    it actually read (no spurious rebuild on the second join), and a
+    reopened store answers identically."""
+    from geomesa_tpu.store.fs import FsDataStore
+
+    def fill(store):
+        store.create_schema(
+            parse_spec("events", "kind:String,dtg:Date,*geom:Point:srid=4326")
+        )
+        rng = np.random.default_rng(4)
+        n = 150
+        store._insert_columns(store.get_schema("events"), {
+            "__fid__": np.array([f"e{i}" for i in range(n)], dtype=object),
+            "kind": np.array([f"k{i % 3}" for i in range(n)], dtype=object),
+            "geom__x": rng.uniform(-5, 35, n),
+            "geom__y": rng.uniform(-5, 35, n),
+            "dtg": np.full(n, T0, dtype=np.int64),
+        })
+        store.create_schema(
+            parse_spec("zones", "zname:String,*geom:Polygon:srid=4326")
+        )
+        with store.writer("zones") as w:
+            for i, p in enumerate(ZONES):
+                w.write([f"z{i}", p], fid=f"g{i}")
+
+    root = str(tmp_path / "store")
+    s1 = FsDataStore(root, executor=TpuScanExecutor(default_mesh()))
+    fill(s1)
+    first = s1.query_join("zones", "events", predicate="contains")
+    assert first.stats["build"] == "rebuild"
+    again = s1.query_join("zones", "events", predicate="contains")
+    assert again.stats["build"] == "hit"
+    assert again.pairs() == first.pairs()
+
+    # a REOPENED store (fresh process analog: lazy replay pending)
+    s2 = FsDataStore(root, executor=TpuScanExecutor(default_mesh()))
+    r1 = s2.query_join("zones", "events", predicate="contains")
+    assert r1.pairs() == first.pairs()
+    # the build filed under the post-replay generation: next join hits
+    r2 = s2.query_join("zones", "events", predicate="contains")
+    assert r2.stats["build"] == "hit"
+
+
+def test_dwithin_pairs_across_antimeridian():
+    """Review regression: a radius-expanded envelope crossing lon ±180
+    wraps to the far columns — pairs straddling the date line must not
+    vanish from either path."""
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    store.create_schema(parse_spec("pts", "side:String,*geom:Point:srid=4326"))
+    with store.writer("pts") as w:
+        w.write(["b", Point(179.9, 0.0)], fid="east")
+        w.write(["p", Point(-179.9, 0.0)], fid="west")   # ~22 km away
+        w.write(["p", Point(0.0, 0.0)], fid="far")
+    dev = store.query_join(("pts", "side = 'b'"), ("pts", "side = 'p'"),
+                           predicate="dwithin(50000)")
+    assert dev.pairs() == [("east", "west")]
+    hstore = TpuDataStore()
+    hstore.create_schema(parse_spec("pts", "side:String,*geom:Point:srid=4326"))
+    with hstore.writer("pts") as w:
+        w.write(["b", Point(179.9, 0.0)], fid="east")
+        w.write(["p", Point(-179.9, 0.0)], fid="west")
+        w.write(["p", Point(0.0, 0.0)], fid="far")
+    host = hstore.query_join(("pts", "side = 'b'"), ("pts", "side = 'p'"),
+                             predicate="dwithin(50000)")
+    assert host.pairs() == dev.pairs()
+
+
+def test_dwithin_pairs_over_the_pole():
+    """Review regression: when the radius cap reaches a pole, no
+    cos-scaled dlon bounds the bucket cover — two points at lat 89.9
+    and opposite-ish longitudes sit ~22 km apart OVER the pole, and the
+    old 0.01 cos floor routed them to disjoint buckets (both paths
+    agreed on the wrong, empty answer)."""
+    for device in (True, False):
+        ex = TpuScanExecutor(default_mesh()) if device else None
+        store = TpuDataStore(executor=ex)
+        store.create_schema(
+            parse_spec("pts", "side:String,*geom:Point:srid=4326")
+        )
+        with store.writer("pts") as w:
+            w.write(["b", Point(0.0, 89.9)], fid="build")
+            w.write(["p", Point(170.0, 89.9)], fid="near")  # ~22 km over
+            w.write(["p", Point(170.0, 80.0)], fid="far")
+        res = store.query_join(("pts", "side = 'b'"), ("pts", "side = 'p'"),
+                               predicate="dwithin(25000)")
+        assert res.pairs() == [("build", "near")], (device, res.pairs())
+
+
+def test_join_holds_one_admission_slot_end_to_end():
+    """Review regression: the join's expensive phase (build bucketing +
+    the kernel probe loop) must count against geomesa.query.max.inflight
+    like any scan. One slot covers the WHOLE join — the inner
+    build/probe queries ride it reentrantly, so max_inflight=1 cannot
+    deadlock a join against itself — and while a foreign request holds
+    the only slot the join sheds crisply."""
+    from geomesa_tpu.utils.audit import ShedLoad
+    from tests.test_overload import hold_slot
+
+    store, x, y = _mkstore(device=True, n=50, max_inflight=1, max_queue=0)
+    res = store.query_join("zones", "events", predicate="contains")
+    assert res.stats["path"] == "device-join" and len(res) > 0
+
+    release = hold_slot(store.admission)
+    try:
+        with pytest.raises(ShedLoad):
+            store.query_join("zones", "events", predicate="contains")
+    finally:
+        release()
+    # slot free again: the same join answers fine
+    again = store.query_join("zones", "events", predicate="contains")
+    assert sorted(again.pairs()) == sorted(res.pairs())
+
+
+def test_sharded_store_write_invalidates_build_cache():
+    """Review regression: ShardedDataStore keeps no coordinator rows, so
+    only the write-generation counter can move the cache key — a write
+    must rebuild, never serve the stale HBM build inside the TTL."""
+    from geomesa_tpu.parallel.shards import ShardedDataStore
+
+    store = ShardedDataStore(num_shards=2)
+    store.create_schema(
+        parse_spec("events", "kind:String,dtg:Date,*geom:Point:srid=4326")
+    )
+    rng = np.random.default_rng(9)
+    n = 100
+    store._insert_columns(store.get_schema("events"), {
+        "__fid__": np.array([f"e{i}" for i in range(n)], dtype=object),
+        "kind": np.array([f"k{i % 2}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-5, 15, n), "geom__y": rng.uniform(-5, 15, n),
+        "dtg": np.full(n, T0, dtype=np.int64),
+    })
+    store.create_schema(
+        parse_spec("zones", "zname:String,*geom:Polygon:srid=4326")
+    )
+    with store.writer("zones") as w:
+        w.write(["z0", ZONES[0]], fid="g0")
+    r1 = store.query_join("zones", "events", predicate="contains")
+    r2 = store.query_join("zones", "events", predicate="contains")
+    assert r2.stats["build"] == "hit"
+    with store.writer("zones") as w:
+        w.write(["z1", ZONES[1]], fid="g1")
+    r3 = store.query_join("zones", "events", predicate="contains")
+    assert r3.stats["build"] == "rebuild"
+    assert r3.stats["geometries"] == 2
+    assert set(r3.pairs()) > set(r1.pairs()) or ("g1" not in
+                                                 {b for b, _ in r3.pairs()})
+
+
+def test_delete_schema_invalidates_build_cache():
+    """Review regression: delete_schema must advance the write
+    generation too — on a ShardedDataStore coordinator (local table
+    versions never move) a delete + recreate cycle used to reproduce
+    the pre-delete schema_generation and serve the deleted incarnation's
+    pairs out of the build cache for a TTL."""
+    from geomesa_tpu.parallel.shards import ShardedDataStore
+
+    zspec = "zname:String,*geom:Polygon:srid=4326"
+    for store in (
+        TpuDataStore(executor=TpuScanExecutor(default_mesh())),
+        ShardedDataStore(num_shards=2),
+    ):
+        store.create_schema(
+            parse_spec("events", "kind:String,dtg:Date,*geom:Point:srid=4326")
+        )
+        store._insert_columns(store.get_schema("events"), {
+            "__fid__": np.array(["e0"], dtype=object),
+            "kind": np.array(["k"], dtype=object),
+            "geom__x": np.array([2.0]), "geom__y": np.array([2.0]),
+            "dtg": np.full(1, T0, dtype=np.int64),
+        })
+        store.create_schema(parse_spec("zones", zspec))
+        with store.writer("zones") as w:
+            w.write(["z0", ZONES[0]], fid="g0")
+        r1 = store.query_join("zones", "events", predicate="contains")
+        assert r1.pairs() == [("g0", "e0")]
+        gen_before = store.schema_generation("zones")
+        store.delete_schema("zones")
+        store.create_schema(parse_spec("zones", zspec))  # empty recreate
+        assert store.schema_generation("zones") != gen_before
+        r2 = store.query_join("zones", "events", predicate="contains")
+        assert r2.stats["build"] == "rebuild"
+        assert r2.pairs() == [], type(store).__name__
+
+
+def test_write_landing_mid_build_never_serves_stale_pairs():
+    """Review regression: the cache key is captured BEFORE the build
+    query. A write completing between the build scan and the cache put
+    used to re-key the pre-write build under the post-write generation
+    — every later join hit that stale entry for a TTL. Now the write
+    moves the generation past the captured key and the next join
+    rebuilds with the new rows."""
+    store, x, y = _mkstore(device=True)
+    orig_query = store.query
+    fired = []
+
+    def query_then_write(name, q=None, **kw):
+        res = orig_query(name, q, **kw)
+        if name == "zones" and not fired:
+            fired.append(True)
+            store.query = orig_query  # the writer's flush must not recurse
+            with store.writer("zones") as w:
+                w.write(["late", ZONES[2]], fid="glate")  # lands mid-build
+        return res
+
+    store.query = query_then_write
+    r1 = store.query_join("zones", "events", predicate="contains")
+    assert r1.stats["geometries"] == 3  # the build scan read pre-write rows
+    r2 = store.query_join("zones", "events", predicate="contains")
+    assert r2.stats["build"] == "rebuild"  # gen moved PAST the cached key
+    assert r2.stats["geometries"] == 4
+    # glate duplicates g2's triangle: they pair with the same probes
+    assert ({p for b, p in r2.pairs() if b == "g2"}
+            == {p for b, p in r2.pairs() if b == "glate"})
+
+
+def test_concurrent_first_joins_share_one_build_cache():
+    """Review regression: the lazy per-store JoinBuildCache creation is
+    a setdefault (atomic under the GIL) — two concurrent first joins
+    must agree on ONE cache, so neither build put() vanishes into an
+    orphaned cache and the next join is a hit, not a spurious rebuild."""
+    import threading
+
+    store, x, y = _mkstore(device=True, n=60)
+    assert getattr(store, "_join_cache", None) is None
+    results, errs = [], []
+
+    def first_join():
+        try:
+            results.append(
+                sorted(store.query_join(
+                    "zones", "events", predicate="contains").pairs())
+            )
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=first_join) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not errs and len(set(map(tuple, results))) == 1
+    cache = store._join_cache
+    again = store.query_join("zones", "events", predicate="contains")
+    assert store._join_cache is cache  # identity stable forever after
+    assert again.stats["build"] == "hit"
+
+
+def test_multimember_multipolygon_takes_host_path():
+    """Review regression: overlapping MultiPolygon members break the
+    concatenated even-odd parity, so multi-member builds decline the
+    device kernel and answer through the host union semantics."""
+    from geomesa_tpu.geom.base import MultiPolygon
+
+    overlap = MultiPolygon([
+        Polygon([[0, 0], [6, 0], [6, 6], [0, 6], [0, 0]]),
+        Polygon([[4, 4], [10, 4], [10, 10], [4, 10], [4, 4]]),
+    ])
+    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    store.create_schema(parse_spec("pts", "dtg:Date,*geom:Point:srid=4326"))
+    with store.writer("pts") as w:
+        w.write([T0, Point(5.0, 5.0)], fid="inside-overlap")
+        w.write([T0, Point(20.0, 20.0)], fid="outside")
+    store.create_schema(
+        parse_spec("mz", "zname:String,*geom:MultiPolygon:srid=4326")
+    )
+    with store.writer("mz") as w:
+        w.write(["m", overlap], fid="g0")
+    res = store.query_join("mz", "pts", predicate="contains")
+    # host path (kernel declined), and the overlap point IS a pair
+    assert res.stats["path"] == "host-join"
+    assert res.pairs() == [("g0", "inside-overlap")]
+
+
+def test_join_spec_radius_coercion():
+    """Review regression: a string radius (JSON client) coerces instead
+    of raising TypeError through to a 500."""
+    assert JoinSpec.parse("dwithin", "500").radius_m == 500.0
+    with pytest.raises(JoinError):
+        JoinSpec.parse("dwithin", "all")
+    # a typo'd predicate fails crisply instead of silently running with
+    # the separately-supplied radius
+    for typo in ("dwithin500", "dwithin(500]x", "dwithin(500)x"):
+        with pytest.raises(JoinError):
+            JoinSpec.parse(typo, 500)
+
+
+def test_web_post_join_max_validation():
+    from geomesa_tpu.web import GeoMesaServer
+
+    store, _x, _y = _mkstore(device=True)
+    with GeoMesaServer(store) as url:
+        for bad_max in ("all", -1):
+            body = json.dumps({
+                "build": {"name": "zones"}, "probe": {"name": "events"},
+                "predicate": "contains", "max": bad_max,
+            }).encode()
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(url + "/join", data=body)
+                )
+                raise AssertionError("expected HTTPError")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+
+
+def test_build_query_identity_keys_the_cache():
+    """Review regression: two build queries sharing a filter but
+    differing in limit/projection must not collide on one cached
+    build."""
+    from geomesa_tpu.index.planner import Query
+
+    store, _x, _y = _mkstore(device=True)
+    limited = store.query_join(("zones", Query(max_features=1)), "events",
+                               predicate="contains")
+    assert limited.stats["geometries"] == 1
+    full = store.query_join("zones", "events", predicate="contains")
+    # a colliding cache would have served the 1-geometry build here
+    assert full.stats["build"] == "rebuild"
+    assert full.stats["geometries"] == len(ZONES)
+    assert {b for b, _p in full.pairs()} > {b for b, _p in limited.pairs()}
+
+
+def test_sharded_age_off_invalidates_build_cache():
+    """Review regression: sharded age-off removes worker rows without
+    touching coordinator tables — it must advance the write generation
+    or a cached build keeps serving expired features."""
+    from geomesa_tpu.parallel.shards import ShardedDataStore
+
+    store = ShardedDataStore(num_shards=2)
+    store.create_schema(parse_spec("ev", "dtg:Date,*geom:Point:srid=4326"))
+    import time as _time
+
+    now = int(_time.time() * 1000)
+    old = now - 5 * 86400000
+    store._insert_columns(store.get_schema("ev"), {
+        "__fid__": np.array(["fresh", "stale"], dtype=object),
+        "geom__x": np.array([1.0, 2.0]),
+        "geom__y": np.array([1.0, 2.0]),
+        "dtg": np.array([now, old], dtype=np.int64),
+    })
+    # build side = the point type (dwithin): cache it with BOTH rows,
+    # then turn on retention and expire the old one
+    r1 = store.query_join("ev", "ev", predicate="dwithin(1000)")
+    assert {b for b, _ in r1.pairs()} == {"fresh", "stale"}
+    store.get_schema("ev").user_data["geomesa.feature.expiry"] = "1 days"
+    removed = store.age_off("ev")
+    assert removed >= 1
+    r2 = store.query_join("ev", "ev", predicate="dwithin(1000)")
+    assert r2.stats["build"] == "rebuild"
+    assert {b for b, _ in r2.pairs()} == {"fresh"}
+
+
+def test_explicit_zero_join_knobs_honored():
+    """Review regression: split.depth=0 disables adaptive splits (no
+    falsy-or default restoring 6)."""
+    zones = [
+        Polygon([[i * 0.5, 0], [i * 0.5 + 0.4, 0], [i * 0.5 + 0.4, 0.4],
+                 [i * 0.5, 0.4], [i * 0.5, 0]])
+        for i in range(12)
+    ]
+    with properties(geomesa_join_split_depth="0",
+                    geomesa_join_skew_threshold="2"):
+        store, _x, _y = _mkstore(device=True, n=60, zones=zones,
+                                 boundary_probes=False)
+        res = store.query_join("zones", "events", predicate="contains")
+        assert res.stats["splits"] == 0
+        assert res.stats["max_bucket"] >= 3  # over threshold, NOT split
+    hstore, _, _ = _mkstore(device=False, n=60, zones=zones,
+                            boundary_probes=False)
+    host = hstore.query_join("zones", "events", predicate="contains")
+    assert res.pairs() == host.pairs()
+
+
+def test_host_join_direct_unit():
+    """host_join over a hand-built JoinBuild: the exact reference is
+    callable without a store (the unit tests' entry point)."""
+    spec = JoinSpec.parse("contains")
+    ft = parse_spec("z", "zname:String,*geom:Polygon:srid=4326")
+    fids = np.array(["a", "b"], dtype=object)
+    cols = {"__fid__": fids,
+            "zname": np.array(["p", "q"], dtype=object)}
+    build = JoinBuild(spec, ft, cols, fids, list(ZONES[:2]), None, None)
+    px = np.array([2.0, 7.0, 5.0, np.nan])
+    py = np.array([2.0, 2.0, 5.0, 1.0])
+    bi, pi = host_join(build, px, py)
+    got = {(int(b), int(p)) for b, p in zip(bi, pi)}
+    # point 2 sits ON the shared edge: both polygons match it
+    assert got == {(0, 0), (1, 1), (0, 2), (1, 2)}
+
+
+def test_shed_or_timed_out_join_audits_outcome():
+    """Review regression: a join shed at its own admission gate never
+    ran its inner build/probe queries, so query_join itself must write
+    the QueryEvent — without it the PR 4 outcome accounting
+    (QueryEvent.outcome ok|timeout|shed) silently undercounts the join
+    query class."""
+    from geomesa_tpu.utils.audit import InMemoryAuditWriter, ShedLoad
+    from tests.test_overload import hold_slot
+
+    store, _, _ = _mkstore(device=True, n=40, max_inflight=1, max_queue=0,
+                           audit_writer=InMemoryAuditWriter())
+    release = hold_slot(store.admission)
+    try:
+        with pytest.raises(ShedLoad):
+            store.query_join("zones", "events", predicate="contains")
+    finally:
+        release()
+    ev = store.audit_writer.events[-1]
+    assert ev.outcome == "shed" and ev.hits == 0
+    assert ev.type_name == "zones+events"
+
+    from geomesa_tpu.utils.audit import MetricsRegistry
+
+    store2, _, _ = _mkstore(device=True, n=40, query_timeout_s=0.0,
+                            audit_writer=InMemoryAuditWriter(),
+                            metrics=MetricsRegistry())
+    with pytest.raises(QueryTimeout):
+        store2.query_join("zones", "events", predicate="contains")
+    ev2 = store2.audit_writer.events[-1]
+    assert ev2.outcome == "timeout" and ev2.hits == 0
+    assert ev2.type_name == "zones+events"
+    # no double count: the inner query that died audited ITSELF into
+    # queries.timeout; the join keeps its failure in join-scoped counters
+    assert store2.metrics.counter("queries.join.timeout") == 1
+    assert (store2.metrics.counter("queries.timeout")
+            == store2.metrics.counter("queries"))
+
+
+def test_web_post_join_bad_content_length_is_400():
+    """Review regression: a malformed Content-Length header is a client
+    error (400) like every other bad input on /join, not an unhandled
+    ValueError surfacing as a 500."""
+    import http.client
+
+    from geomesa_tpu.web import GeoMesaServer
+
+    store, _, _ = _mkstore(device=True, n=20)
+    with GeoMesaServer(store) as url:
+        # "-1" must 400 WITHOUT reading the body: rfile.read(-1) would
+        # block until an EOF the client may never send; a huge declared
+        # length answers 413 before buffering anything
+        for bad, code in (("abc", 400), ("-1", 400),
+                          (str(1 << 33), 413)):
+            conn = http.client.HTTPConnection(
+                url.split("//", 1)[1], timeout=10
+            )
+            try:
+                conn.putrequest("POST", "/join", skip_accept_encoding=True)
+                conn.putheader("Content-Length", bad)
+                conn.endheaders()
+                assert conn.getresponse().status == code, bad
+            finally:
+                conn.close()
+
+
+def test_build_cache_put_evicts_displaced_same_key_build():
+    """Review regression: two concurrent misses on one key both build
+    and put(); the displaced loser must release its device arrays like
+    every other removal path instead of pinning HBM until GC collects
+    it. Re-putting the SAME build (LRU refresh shape) never
+    self-evicts."""
+    from geomesa_tpu.ops.join import JoinBuildCache
+
+    class _Build:
+        evicted = False
+
+        def evict_device(self):
+            self.evicted = True
+
+    cache = JoinBuildCache()
+    winner, loser = _Build(), _Build()
+    cache.put(("k",), loser)
+    cache.put(("k",), winner)
+    assert loser.evicted and not winner.evicted
+    cache.put(("k",), winner)
+    assert not winner.evicted
+
+
+def test_build_cache_ttl_evicts_idle_not_hot():
+    """Review regression: the TTL sweep keys off last-USED, refreshed by
+    every hit — steady traffic against one geofence set must not pay a
+    full rebuild (plus HBM re-upload) every ttl; only IDLE builds
+    release their device arrays."""
+    import time as _time
+
+    from geomesa_tpu.ops.join import JoinBuildCache
+
+    class _Build:
+        def __init__(self):
+            self.built_at = self.last_used = _time.time()
+            self.evicted = False
+
+        def evict_device(self):
+            self.evicted = True
+
+    cache = JoinBuildCache()
+    hot, idle = _Build(), _Build()
+    cache.put(("hot",), hot)
+    cache.put(("idle",), idle)
+    hot.last_used = idle.last_used = _time.time() - 10.0
+    assert cache.get(("hot",), ttl_s=20.0) is hot  # hit refreshes last_used
+    assert cache.get(("hot",), ttl_s=5.0) is hot   # survives its own age
+    assert idle.evicted  # idle past ttl: swept, device arrays released
+    assert cache.get(("idle",), ttl_s=5.0) is None
+
+
+def test_near_antipodal_dwithin_declines_device():
+    """Review regression: near the antipodal distance the haversine's
+    asin amplifies f32 error past any fixed epsilon band, so huge radii
+    (> ops.join.DWITHIN_DEVICE_MAX_R_M) answer via the exact host path —
+    and the pairs still match the haversine brute force."""
+    r = 1.9e7  # ~95% of the antipodal distance
+    store, x, y = _mkstore(device=True, n=40, seed=3)
+    res = store.query_join(
+        ("events", "kind = 'k0'"), ("events", "kind <> 'k0'"),
+        predicate="dwithin", radius_m=r,
+    )
+    assert res.stats["path"] == "host-join"
+    k = np.array([f"k{i % 3}" for i in range(40)])
+    bsel = np.flatnonzero(k == "k0")
+    psel = np.flatnonzero(k != "k0")
+    ref = _reference_pairs_dwithin(
+        x[bsel], y[bsel], [f"e{i}" for i in bsel],
+        x[psel], y[psel], [f"e{i}" for i in psel], r,
+    )
+    assert set(res.pairs()) == ref and len(ref) > 0

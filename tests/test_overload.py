@@ -6,6 +6,7 @@ device breaker's host-path short-circuit. The chaos-schedule editions
 (latency soaks, concurrent overload) live in tests/test_chaos.py.
 """
 
+import contextvars
 import gc
 import json
 import threading
@@ -41,6 +42,16 @@ T0 = 1483228800000
 
 def counter(name):
     return robustness_metrics().report().get(name, 0)
+
+
+def hold_slot(ctl):
+    """Occupy one admission slot from a FOREIGN context — another
+    request, as far as the reentrant admit is concerned — so the test's
+    own context cannot ride it. Returns the release callable."""
+    ctx = contextvars.Context()  # fresh, NOT a copy: no inherited flags
+    admit = ctl.admit()
+    ctx.run(admit.__enter__)
+    return lambda: ctx.run(admit.__exit__, None, None, None)
 
 
 def _small_store(**kw):
@@ -203,16 +214,39 @@ def test_breaker_registry_reports_worst_state():
 def test_admission_fast_path_and_overflow_shed():
     ctl = AdmissionController(1, 0)
     before = counter("shed.overflow")
-    with ctl.admit():
-        assert ctl.inflight == 1
-        with pytest.raises(ShedLoad):
-            with ctl.admit():
-                pass
+    release = hold_slot(ctl)  # a FOREIGN request holds the only slot
+    assert ctl.inflight == 1
+    with pytest.raises(ShedLoad):
+        with ctl.admit():
+            pass
+    release()
     assert ctl.inflight == 0
     with ctl.admit():  # the slot really was released
         pass
     assert ctl.sheds == 1 and ctl.recently_shedding()
     assert counter("shed.overflow") == before + 1
+
+
+def test_admission_reentrant_within_one_context():
+    """A context that already holds a slot rides it on nested admits
+    (query_join admits once around the whole join, and its inner
+    build/probe queries must not queue for a second slot — at
+    max_inflight=1 that would deadlock the join against itself). A
+    foreign context still sheds while the slot is held."""
+    ctl = AdmissionController(1, 0)
+    with ctl.admit():
+        assert ctl.inflight == 1
+        with ctl.admit():  # rides the outer slot: no second acquire
+            assert ctl.inflight == 1
+        assert ctl.inflight == 1  # inner exit released NOTHING
+        with pytest.raises(ShedLoad):  # but other requests still shed
+            hold_slot(ctl)
+    assert ctl.inflight == 0  # outer exit released the one real slot
+    # distinct controllers never share the held flag
+    other = AdmissionController(1, 0)
+    with ctl.admit():
+        with other.admit():
+            assert ctl.inflight == 1 and other.inflight == 1
 
 
 def test_admission_queue_wait_charged_against_deadline():
@@ -280,9 +314,12 @@ def test_query_timeout_audits_outcome():
 def test_shed_load_audits_outcome():
     store = _small_store(max_inflight=1, max_queue=0,
                          audit_writer=InMemoryAuditWriter())
-    with store.admission.admit():  # someone else holds the only slot
+    release = hold_slot(store.admission)  # someone else holds the slot
+    try:
         with pytest.raises(ShedLoad):
             store.query("t", "INCLUDE")
+    finally:
+        release()
     ev = store.audit_writer.events[-1]
     assert ev.outcome == "shed" and ev.hits == 0
     # slot free again: the same query answers fine and audits "ok"
@@ -414,8 +451,11 @@ def test_healthz_degrades_while_breaker_open_or_shedding():
 
         del b
         gc.collect()
-        with store.admission.admit():
+        release = hold_slot(store.admission)
+        try:
             with pytest.raises(ShedLoad):
                 store.query("t", "INCLUDE")
+        finally:
+            release()
         health = _get(url + "/healthz")  # recent shed also degrades
         assert health["status"] == "degraded" and health["shedding"]
