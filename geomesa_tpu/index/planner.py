@@ -108,6 +108,60 @@ class QueryPlan:
         return self.full_filter
 
 
+def spatial_only_shape(plan: QueryPlan, ft: FeatureType):
+    """The query's geometry list when ``plan`` is answerable from the z2
+    aggregate pyramid (ops/pyramid.py), else None.
+
+    The pyramid's interior/boundary fusion is sound exactly when: the
+    plan is a single z2 arm (no cross-index union), the spatial
+    predicate IS the whole filter (no residual secondary, and the
+    filter reads only the default geometry — a dtg or attribute
+    predicate would make interior rows conditional on columns the
+    pyramid never aggregated), the geometry extraction is precise
+    (an over-approximated extraction could classify an interior cell
+    from a box wider than the true predicate), and every spatial leaf
+    is a CONTAINMENT-shaped predicate (BBOX / INTERSECTS / WITHIN,
+    whose per-row truth over a point row in a strictly-interior cell
+    is provably true). CONTAINS inverts the operands (the ROW must
+    contain the literal — false for every point row), DISJOINT negates,
+    and DWITHIN reaches outside the literal's own shape: their
+    extracted covers describe candidate ranges, NOT the predicate, so
+    the pyramid declines them."""
+    if plan.union is not None or plan.is_empty:
+        return None
+    if plan.index.name != "z2" or plan.secondary is not None:
+        return None
+    geom = ft.default_geometry
+    if geom is None:
+        return None
+    gv = plan.values.geometries
+    if gv is None or not gv.values or not gv.precise:
+        return None
+    if plan.full_filter is None:
+        return None
+    if set(ast.properties(plan.full_filter)) != {geom.name}:
+        return None
+    for node in ast.walk(plan.full_filter):
+        if isinstance(node, (ast.And, ast.Or)):
+            continue
+        if not isinstance(node, (ast.BBox, ast.Intersects, ast.Within)):
+            return None
+    return list(gv.values)
+
+
+def pyramid_worthwhile(interior_rows: int, boundary_rows: int) -> bool:
+    """The aggregation cost model: answer from the pyramid only when the
+    interior partial sums carry real weight. The boundary ring pays the
+    exact segment scan either way, so a query whose candidates are
+    mostly boundary (a region at or below one cell's size) gains nothing
+    over the ordinary push-down — decline and let it run uncached. The
+    absolute floor keeps small stores on the pyramid: a ring of a few
+    hundred rows is a trivial seek regardless of the ratio."""
+    if interior_rows <= 0:
+        return False
+    return boundary_rows <= 4 * interior_rows or boundary_rows <= 256
+
+
 class QueryPlanner:
     """Plans queries for one feature type over its enabled indices."""
 

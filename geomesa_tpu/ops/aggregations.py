@@ -370,5 +370,43 @@ def make_sharded_density_dual(
     return with_time, no_time
 
 
+# --- aggregate pyramid build reduction ---------------------------------------
+#
+# The GeoBlocks-style pyramid (ops/pyramid.py) pre-aggregates every row
+# into a coarse z2 cell grid so hot polygon/bbox aggregations answer from
+# interior partial sums. The build reduction runs straight off the
+# HBM-resident segment mirrors: the z2 segments already hold each row's
+# EXACT integer grid coordinates (seg.xi / seg.yi, decoded from the index
+# keys), so the device bins by integer shifts — bit-identical to the host
+# build that decodes the same keys, no f32 coordinate rounding anywhere.
+
+
+def make_pyramid_counts(mesh, bits: int, src_bits: int = 31):
+    """Jitted shard_map pyramid-count pass: (xi, yi, mask) -> [H, W] i32
+    per-cell row counts, psum'd over the data axis. ``mask`` excludes
+    tombstoned and null-geometry rows (their lenient-encoded keys would
+    otherwise count in cell 0). Counting uses the sort + boundary-search
+    idiom (integer-exact, scatter-free — the density_kernel_sort shape)."""
+    n = 1 << bits
+    shift = src_bits - bits
+
+    def step(xi, yi, mask):
+        cx = jax.lax.shift_right_logical(xi, shift)
+        cy = jax.lax.shift_right_logical(yi, shift)
+        flat = jnp.where(mask, cy * n + cx, jnp.int32(n * n))
+        s = jnp.sort(flat)
+        bounds = jnp.searchsorted(s, jnp.arange(n * n + 1, dtype=jnp.int32))
+        grid = jnp.diff(bounds).astype(jnp.int32).reshape(n, n)
+        return jax.lax.psum(grid, DATA_AXIS)
+
+    from geomesa_tpu.parallel.mesh import shard_map_fn
+
+    d = P(DATA_AXIS)
+    return instrumented_jit(
+        "agg.pyramid",
+        shard_map_fn(step, mesh, in_specs=(d, d, d), out_specs=P()),
+    )
+
+
 # the host reference implementation lives in geomesa_tpu.index.aggregators
 # (pure numpy, so the host-only datastore path has no jax dependency)

@@ -1,0 +1,739 @@
+"""HBM-resident aggregate pyramid cache: sub-millisecond hot aggregations.
+
+The GeoBlocks idea ("GeoBlocks: A Query-Cache Accelerated Data Structure
+for Spatial Aggregation over Polygons", PAPERS.md) applied to this repo's
+layout: repeated dashboard aggregations (count / stats / density over a
+polygon or bbox) stop re-sweeping every candidate segment and instead
+answer from hierarchical pre-aggregated blocks, rescanning only the
+query's boundary ring.
+
+Structure
+---------
+An ``AggPyramid`` per feature type is a small stack of z2-gridded levels.
+The finest level is a ``2^bits x 2^bits`` grid over the world
+(``geomesa.agg.cell.bits``); each level above halves the resolution
+(``geomesa.agg.levels``). Cells are COARSENED Z2 CELLS: a row's cell is
+its z2 index key's integer grid coordinate shifted down — exact integer
+arithmetic shared by the device build kernel (ops/aggregations.
+make_pyramid_counts over the HBM-resident segment mirrors), the host
+build (z2_decode of the same keys), and the per-query classification, so
+all three agree bit-for-bit. Per cell the pyramid holds the row count
+(always) and, lazily per consumed column, sum/min/max/non-null-count
+(``AggPyramid.ensure_columns``). The finest count grid doubles as the
+coarse density grid of the type (``/debug/device`` ``agg`` block).
+
+Exactness (the parity contract)
+-------------------------------
+``classify`` splits a query's geometry set into INTERIOR cells (every row
+binned there provably satisfies the exact f64 predicate), BOUNDARY cells,
+and outside cells (no row there can match). Two mechanisms, both
+conservative-only:
+
+* rectangles use monotonicity: ``normalize`` (curve/normalized.py) is
+  monotone in the coordinate, so cells strictly between the cells of the
+  query's own normalized bounds contain only rows strictly inside the
+  box — no epsilon, exact by construction;
+* polygons use a hierarchical descent with widened cell rectangles
+  (``_EPS_DEG`` dominates every f64 rounding in the bin arithmetic by
+  ~3 orders of magnitude): a cell whose widened rect no polygon edge
+  touches is wholly inside or outside by one center test; touched cells
+  recurse to the next finer level and bottom out as boundary.
+
+Interior cells answer from partial sums (exact sums, never estimates);
+boundary cells fall through to the exact segment scan — each boundary
+cell is ONE contiguous z2 key range, so the fallthrough seeks exactly
+the boundary ring and evaluates the plan's own post-filter on those
+rows. Fused, a hot polygon aggregation touches only its boundary ring.
+
+Caching and invalidation
+------------------------
+Pyramids (and the density-grid query memo) live in a per-store
+``AggCache`` — the PR 7 ``JoinBuildCache`` pattern: TTL'd LRU keyed by
+``(kind, type, schema generation, knobs)``, byte-bounded
+(``geomesa.agg.cache.bytes``), device arrays evicted with their entry so
+idle pyramids release HBM at TTL. Any write / compact / delete /
+delete_schema — including one routed through a ``ShardedDataStore``
+worker — bumps the per-type write generation (``_note_write``), which
+both re-keys the cache AND drops the type's entries eagerly.
+
+Failure envelope
+----------------
+``agg.build`` is a named fault point paired with a span and a deadline
+check; a build failure degrades the aggregation to the uncached exact
+scan path with identical answers (parity under faults covers
+aggregations-from-cache; ``scripts/chaos_smoke.sh`` soaks it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.curve.zorder import z2_decode, z2_encode
+from geomesa_tpu.geom.base import Geometry, MultiPolygon, Polygon
+from geomesa_tpu.utils import deadline, faults, trace
+from geomesa_tpu.utils.devstats import devstats_metrics
+
+# the z2 curve's per-dimension resolution (curve/sfc.Z2SFC default);
+# pyramid cells are these integer grid coordinates shifted down
+Z2_BITS = 31
+
+# conservative widening (degrees) for polygon cell-rectangle tests: the
+# f64 bin arithmetic (normalize + the cell-bound reconstruction here) is
+# exact to ~1e-12 deg at world scale; 1e-9 dominates it by 3 orders of
+# magnitude while adding ~0.1 mm of area per cell edge. Only ever moves
+# borderline cells from interior to boundary — never the unsafe way.
+_EPS_DEG = 1e-9
+
+# cell classification codes (uint8 grid)
+OUTSIDE, INTERIOR, BOUNDARY = 0, 1, 2
+
+# per-pyramid classification memo (the GeoBlocks "query cache"): a hot
+# repeated polygon re-uses its interior sums + boundary ring without
+# re-classifying; bounded LRU
+CLASSIFY_MEMO_CAP = 64
+
+# per-level-classification chunk so the [cells x edges] overlap test
+# stays memory-bounded on huge covers
+_CLASSIFY_CHUNK = 1024
+
+# live per-store caches, for /debug/device entry/byte sums (join.py's
+# _CACHES posture, including the lock-vs-iteration rule)
+_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+_CACHES_LOCK = threading.Lock()
+_LAST_BUILD: Dict[str, Any] = {}
+_LAST_BUILD_LOCK = threading.Lock()
+
+
+class AggError(ValueError):
+    """Bad aggregate request (unknown column, non-numeric column)."""
+
+
+def agg_enabled() -> bool:
+    """The cache's operational escape hatch (geomesa.agg.enabled): off
+    routes every aggregation through the ordinary uncached paths —
+    identical answers by the parity contract, just no pyramid."""
+    from geomesa_tpu.utils.config import AGG_ENABLED
+
+    got = AGG_ENABLED.to_bool()
+    return True if got is None else got
+
+
+def agg_knobs() -> Tuple[int, int, float, int]:
+    """(cell_bits, levels, ttl_s, cache_cap_bytes) — resolved fresh per
+    call (config values may change under tests). None-checked, not
+    falsy-or'd (the PR 6 shard-knob rule)."""
+    from geomesa_tpu.utils.config import (
+        AGG_CACHE_BYTES,
+        AGG_CACHE_TTL,
+        AGG_CELL_BITS,
+        AGG_LEVELS,
+    )
+
+    def val(prop, default):
+        got = prop.to_int()
+        return default if got is None else got
+
+    bits = min(12, max(2, val(AGG_CELL_BITS, 8)))
+    levels = min(bits - 1, max(1, val(AGG_LEVELS, 3)))
+    ttl = AGG_CACHE_TTL.to_duration_s(600.0)
+    cap = AGG_CACHE_BYTES.to_bytes()
+    if cap is None:
+        cap = 64 << 20
+    return bits, levels, ttl, cap
+
+
+def could_have_interior(geoms: List[Geometry], bits: int) -> bool:
+    """Cheap PRE-BUILD gate for the cost model: can any geometry's
+    envelope cover at least one interior cell at the finest level? A
+    geometry spanning fewer than 3 cells in either axis has rim-only
+    coverage — no interior cell is possible, every candidate row is
+    boundary, and ``pyramid_worthwhile`` would decline AFTER paying the
+    full O(table) build. Declining here skips the build entirely
+    (conservative the cheap way: under-declining only loses caching for
+    one query shape, never correctness)."""
+    n = 1 << bits
+    cw, ch = 360.0 / n, 180.0 / n
+    for g in geoms:
+        env = g.envelope
+        if (env.xmax - env.xmin) >= 3.0 * cw and (env.ymax - env.ymin) >= 3.0 * ch:
+            return True
+    return False
+
+
+# -- build --------------------------------------------------------------------
+
+
+def host_counts(table, ft, bits: int) -> np.ndarray:
+    """[H, W] int64 per-cell row counts from the host index table: the
+    exact reference the device kernel must match (same key decode, same
+    integer shifts, same null-geometry exclusion)."""
+    n = 1 << bits
+    shift = Z2_BITS - bits
+    geom = ft.default_geometry.name
+    grid = np.zeros(n * n, dtype=np.int64)
+    for b, rows in table.scan_all():
+        if not len(rows):
+            continue
+        xi, yi = z2_decode(b.key[rows])
+        # null geometries encode leniently (clipped keys): they can never
+        # match a spatial predicate, so they must never count in a cell
+        x = np.asarray(b.gather(geom + "__x", rows), dtype=np.float64)
+        y = np.asarray(b.gather(geom + "__y", rows), dtype=np.float64)
+        ok = np.isfinite(x) & np.isfinite(y)
+        flat = ((yi >> shift) * n + (xi >> shift))[ok]
+        grid += np.bincount(flat, minlength=n * n)
+    return grid.reshape(n, n)
+
+
+def build_pyramid(table, ft, executor=None) -> "AggPyramid":
+    """Materialize one type's pyramid — the ``agg.build`` boundary:
+    injectable, span-wrapped, deadline-paired. The device reduction runs
+    off the existing segment mirrors when the executor carries them
+    (``TpuScanExecutor.pyramid_counts``); the host build is the
+    bit-identical fallback. Raises on injected/device faults — the
+    caller's degradation path answers from the uncached exact scan."""
+    bits, levels, _ttl, _cap = agg_knobs()
+    reg = devstats_metrics()
+    t0 = time.perf_counter()
+    with trace.span("agg.build", type=ft.name, bits=bits, levels=levels):
+        deadline.check("agg.build")
+        faults.fault_point("agg.build")
+        counts0 = None
+        pyramid_counts = getattr(executor, "pyramid_counts", None)
+        if pyramid_counts is not None:
+            counts0 = pyramid_counts(table, bits)
+        if counts0 is None:
+            counts0 = host_counts(table, ft, bits)
+        counts = [np.asarray(counts0, dtype=np.int64)]
+        for _ in range(1, levels):
+            g = counts[-1]
+            if g.shape[0] < 2:
+                break
+            counts.append(
+                g.reshape(g.shape[0] // 2, 2, g.shape[1] // 2, 2).sum(axis=(1, 3))
+            )
+        pyr = AggPyramid(table.index.sfc(ft), ft, counts)
+        mesh = getattr(executor, "mesh", None)
+        if mesh is not None:
+            pyr.ensure_device(mesh)
+    reg.inc("agg.cache.builds")
+    reg.update_timer("agg.build", time.perf_counter() - t0)
+    with _LAST_BUILD_LOCK:
+        _LAST_BUILD.clear()
+        _LAST_BUILD.update(pyr.stats)
+    return pyr
+
+
+class AggPyramid:
+    """One type's aggregate pyramid: the stack of per-cell count grids
+    (``counts[0]`` finest -> coarsest), lazily-built per-column
+    sum/min/max/count grids, the per-query classification memo, and the
+    HBM-resident device copies."""
+
+    def __init__(self, sfc, ft, counts: List[np.ndarray]):
+        self.sfc = sfc
+        self.geom = ft.default_geometry.name
+        self.counts = counts
+        self.bits = int(counts[0].shape[0]).bit_length() - 1
+        self.levels = len(counts)
+        self.total_rows = int(counts[0].sum())
+        self.built_at = time.time()
+        self.last_used = self.built_at
+        # col -> {"sum","min","max","count"} finest-level grids
+        self.col_grids: Dict[str, Dict[str, np.ndarray]] = {}
+        self._queries: Dict[Any, tuple] = {}  # classification memo (LRU)
+        self._lock = threading.Lock()
+        self._dev: Optional[list] = None
+        self._dev_lock = threading.Lock()
+        self.stats = {
+            "type": ft.name,
+            "bits": self.bits,
+            "levels": self.levels,
+            "rows": self.total_rows,
+            "cells": int(counts[0].size),
+            "occupied": int((counts[0] > 0).sum()),
+        }
+        reg = devstats_metrics()
+        reg.set_gauge("agg.pyramid.cells", int(counts[0].size))
+        reg.set_gauge("agg.pyramid.rows", self.total_rows)
+
+    @property
+    def nbytes(self) -> int:
+        n = sum(g.nbytes for g in self.counts)
+        # snapshot under the lock: ensure_columns inserts concurrently
+        # (byte-accounting from another query's cache put must not hit a
+        # dict-changed-size-during-iteration)
+        with self._lock:
+            grids_list = list(self.col_grids.values())
+        for grids in grids_list:
+            n += sum(g.nbytes for g in grids.values())
+        return n
+
+    # -- device residency --------------------------------------------------
+
+    def ensure_device(self, mesh):
+        """Replicate the level stack into HBM (once); the device copies
+        are the cache's resident acceleration structure and are evicted
+        with the entry (TTL / capacity / invalidation). Today's query
+        answers reduce the HOST grids (interior sums are tiny numpy
+        reductions — a device round-trip would cost more than it saves);
+        the resident copies exist for the device-side consumers the
+        ROADMAP follow-ups name (density grids coarsened on device,
+        fused pyramid+scan kernels), and their footprint is bounded by
+        geomesa.agg.cache.bytes like everything else in the entry."""
+        with self._dev_lock:
+            if self._dev is None:
+                from geomesa_tpu.parallel import mesh as mesh_mod
+
+                self._dev = [
+                    mesh_mod.replicate(mesh, g.astype(np.int32))
+                    for g in self.counts
+                ]
+            return self._dev
+
+    def evict_device(self) -> None:
+        with self._dev_lock:
+            self._dev = None
+
+    # -- classification ----------------------------------------------------
+
+    def _norm_cell(self, v: float, axis: str, bits: int) -> int:
+        """The coarsened cell of one query-bound coordinate, through the
+        SAME normalize the index keys used — monotone, so strict
+        between-ness in cell space proves strict between-ness in
+        coordinate space (no epsilon)."""
+        dim = self.sfc.lon if axis == "x" else self.sfc.lat
+        n = int(dim.normalize(np.asarray([v], dtype=np.float64))[0])
+        n = min(max(n, 0), dim.max_index)
+        return n >> (Z2_BITS - bits)
+
+    def _cell_rects(self, bits: int, cells: np.ndarray) -> np.ndarray:
+        """[K, 4] widened degree-space rectangles of cells at ``bits``."""
+        s = Z2_BITS - bits
+        lon, lat = self.sfc.lon, self.sfc.lat
+        sx = (lon.max - lon.min) / lon.bins
+        sy = (lat.max - lat.min) / lat.bins
+        cx = cells[:, 0].astype(np.int64)
+        cy = cells[:, 1].astype(np.int64)
+        out = np.empty((len(cells), 4), dtype=np.float64)
+        out[:, 0] = lon.min + (cx << s) * sx - _EPS_DEG
+        out[:, 1] = lat.min + (cy << s) * sy - _EPS_DEG
+        out[:, 2] = lon.min + ((cx + 1) << s) * sx + _EPS_DEG
+        out[:, 3] = lat.min + ((cy + 1) << s) * sy + _EPS_DEG
+        return out
+
+    def classify(self, geoms: List[Geometry], memo_key=None) -> tuple:
+        """(interior_rows, boundary_rows, candidate_rows, boundary_cells,
+        interior_mask) for a query's geometry set. ``boundary_cells`` is
+        [K, 2] (cx, cy) at the finest level; ``interior_mask`` is the
+        finest-level bool grid the column aggregates reduce under.
+        Memoized per ``memo_key`` (normally the filter's CQL text) — the
+        hot-query path re-uses its ring."""
+        if memo_key is not None:
+            with self._lock:
+                got = self._queries.pop(memo_key, None)
+                if got is not None:
+                    self._queries[memo_key] = got  # LRU refresh
+                    return got
+        n0 = 1 << self.bits
+        cls = np.zeros((n0, n0), dtype=np.uint8)
+        for g in self._flatten(geoms):
+            if getattr(g, "is_rectangle", lambda: False)():
+                self._paint_rect(cls, g.envelope)
+            elif isinstance(g, Polygon):
+                self._paint_polygon(cls, g)
+            else:
+                # area-free geometries (lines, points): no cell can be
+                # interior; the envelope cover is all boundary
+                self._paint_cover_boundary(cls, g.envelope)
+        c0 = self.counts[0]
+        interior_mask = cls == INTERIOR
+        interior_rows = int(c0[interior_mask].sum())
+        boundary_rows = int(c0[cls == BOUNDARY].sum())
+        cand = interior_rows + boundary_rows
+        by, bx = np.nonzero(cls == BOUNDARY)
+        boundary_cells = np.stack([bx, by], axis=1).astype(np.int64)
+        # drop EMPTY boundary cells: zero rows means zero scan ranges
+        occ = c0[by, bx] > 0
+        boundary_cells = boundary_cells[occ]
+        got = (interior_rows, boundary_rows, cand, boundary_cells, interior_mask)
+        if memo_key is not None:
+            with self._lock:
+                self._queries[memo_key] = got
+                while len(self._queries) > CLASSIFY_MEMO_CAP:
+                    self._queries.pop(next(iter(self._queries)))
+        return got
+
+    @staticmethod
+    def _flatten(geoms: List[Geometry]) -> List[Geometry]:
+        out: List[Geometry] = []
+        for g in geoms:
+            if isinstance(g, MultiPolygon):
+                out.extend(g.geoms)
+            else:
+                out.append(g)
+        return out
+
+    def _paint_rect(self, cls: np.ndarray, env) -> None:
+        """Monotone-exact rectangle painting: rim cells of the box's own
+        normalized-bound cells are boundary, strictly-inside cells are
+        interior. Interior paint is unconditional (an interior cell of
+        ANY geometry needs no exact check); boundary never downgrades
+        another geometry's interior."""
+        c0 = self._norm_cell(env.xmin, "x", self.bits)
+        c1 = self._norm_cell(env.xmax, "x", self.bits)
+        r0 = self._norm_cell(env.ymin, "y", self.bits)
+        r1 = self._norm_cell(env.ymax, "y", self.bits)
+        sub = cls[r0 : r1 + 1, c0 : c1 + 1]
+        sub[sub == OUTSIDE] = BOUNDARY
+        if r1 - r0 >= 2 and c1 - c0 >= 2:
+            cls[r0 + 1 : r1, c0 + 1 : c1] = INTERIOR
+
+    def _paint_cover_boundary(self, cls: np.ndarray, env) -> None:
+        c0 = self._norm_cell(env.xmin, "x", self.bits)
+        c1 = self._norm_cell(env.xmax, "x", self.bits)
+        r0 = self._norm_cell(env.ymin, "y", self.bits)
+        r1 = self._norm_cell(env.ymax, "y", self.bits)
+        sub = cls[r0 : r1 + 1, c0 : c1 + 1]
+        sub[sub == OUTSIDE] = BOUNDARY
+
+    def _paint_polygon(self, cls: np.ndarray, poly: Polygon) -> None:
+        """Hierarchical descent (the pyramid's cost model in action):
+        classify the envelope cover at the coarsest level; cells no edge
+        touches resolve wholly by one center test; touched cells recurse
+        and bottom out as finest-level boundary cells."""
+        from geomesa_tpu.geom.predicates import points_in_polygon
+
+        rings = [np.asarray(poly.shell, dtype=np.float64)] + [
+            np.asarray(h, dtype=np.float64) for h in (poly.holes or [])
+        ]
+        edges = np.concatenate(
+            [
+                np.concatenate([r[:-1], r[1:]], axis=1)
+                for r in rings
+                if len(r) >= 2
+            ]
+        )  # [E, 4] (x0, y0, x1, y1)
+        env = poly.envelope
+        bits_c = self.bits - (self.levels - 1)
+        c0 = self._norm_cell(env.xmin, "x", bits_c)
+        c1 = self._norm_cell(env.xmax, "x", bits_c)
+        r0 = self._norm_cell(env.ymin, "y", bits_c)
+        r1 = self._norm_cell(env.ymax, "y", bits_c)
+        gx, gy = np.meshgrid(
+            np.arange(c0, c1 + 1, dtype=np.int64),
+            np.arange(r0, r1 + 1, dtype=np.int64),
+        )
+        cells = np.stack([gx.ravel(), gy.ravel()], axis=1)
+        bits_l = bits_c
+        while len(cells):
+            rects = self._cell_rects(bits_l, cells)
+            amb = np.zeros(len(cells), dtype=bool)
+            for s0 in range(0, len(cells), _CLASSIFY_CHUNK):
+                sl = slice(s0, s0 + _CLASSIFY_CHUNK)
+                amb[sl] = _edges_overlap_rects(edges, rects[sl])
+            clear = ~amb
+            if clear.any():
+                cx_mid = (rects[clear, 0] + rects[clear, 2]) * 0.5
+                cy_mid = (rects[clear, 1] + rects[clear, 3]) * 0.5
+                inside = points_in_polygon(cx_mid, cy_mid, poly)
+                shift = self.bits - bits_l
+                for (cx, cy) in cells[clear][inside]:
+                    cls[
+                        cy << shift : (cy + 1) << shift,
+                        cx << shift : (cx + 1) << shift,
+                    ] = INTERIOR
+            cells = cells[amb]
+            if bits_l == self.bits:
+                keep = cls[cells[:, 1], cells[:, 0]] != INTERIOR
+                cls[cells[keep, 1], cells[keep, 0]] = BOUNDARY
+                break
+            # recurse: 4 children per ambiguous cell at the next level
+            cx = cells[:, 0] * 2
+            cy = cells[:, 1] * 2
+            cells = np.stack(
+                [
+                    np.stack([cx + dx, cy + dy], axis=1)
+                    for dx in (0, 1)
+                    for dy in (0, 1)
+                ],
+                axis=0,
+            ).reshape(-1, 2)
+            bits_l += 1
+
+    # -- boundary ring -> scan ranges --------------------------------------
+
+    def cell_ranges(self, cells: np.ndarray):
+        """Boundary cells -> z2 key ranges (each pyramid cell is one
+        contiguous z2 span; z-adjacent cells merge). Returns a RangeSet
+        the ordinary IndexTable.scan seeks with."""
+        from geomesa_tpu.index.keyspace import RangeSet
+
+        if not len(cells):
+            return RangeSet(
+                np.empty(0, np.int64), np.empty(0, np.int64),
+                np.empty(0, np.int64), np.empty(0, bool),
+            )
+        s = Z2_BITS - self.bits
+        z = np.sort(
+            z2_encode(cells[:, 0] << s, cells[:, 1] << s).astype(np.int64)
+        )
+        span = np.int64(1) << np.int64(2 * s)
+        gaps = np.flatnonzero(np.diff(z) != span)
+        starts = np.concatenate([[0], gaps + 1])
+        ends = np.concatenate([gaps, [len(z) - 1]])
+        lower = z[starts]
+        upper = z[ends] + span - 1
+        return RangeSet(
+            np.zeros(len(lower), dtype=np.int64), lower, upper,
+            np.zeros(len(lower), dtype=bool),
+        )
+
+    # -- per-column aggregate grids ----------------------------------------
+
+    def ensure_columns(self, table, ft, cols: List[str]) -> None:
+        """Lazily build sum/min/max/count grids for ``cols`` (one table
+        pass for all missing columns). Integer-backed columns (ints,
+        dates) accumulate in int64 — exact; floats in f64. An O(table)
+        build like the count build, so it runs under the same
+        ``agg.build`` envelope: injectable, span-wrapped, and
+        deadline-checked per block (the caller degrades a failure to the
+        uncached exact scan; a QueryTimeout propagates crisply)."""
+        with self._lock:
+            missing = [c for c in cols if c not in self.col_grids]
+        if not missing:
+            return
+        with trace.span("agg.build", type=ft.name, columns=len(missing)):
+            deadline.check("agg.build")
+            faults.fault_point("agg.build")
+            self._build_columns(table, ft, missing)
+
+    def _build_columns(self, table, ft, missing: List[str]) -> None:
+        n = 1 << self.bits
+        shift = Z2_BITS - self.bits
+        geom = self.geom
+        dtypes = {c: _sum_dtype(ft, c) for c in missing}
+        acc = {
+            c: {
+                "sum": np.zeros(n * n, dtype=dtypes[c]),
+                "min": np.full(n * n, np.inf),
+                "max": np.full(n * n, -np.inf),
+                "count": np.zeros(n * n, dtype=np.int64),
+            }
+            for c in missing
+        }
+        for b, rows in table.scan_all():
+            deadline.check("agg.build")
+            if not len(rows):
+                continue
+            xi, yi = z2_decode(b.key[rows])
+            x = np.asarray(b.gather(geom + "__x", rows), dtype=np.float64)
+            y = np.asarray(b.gather(geom + "__y", rows), dtype=np.float64)
+            ok = np.isfinite(x) & np.isfinite(y)
+            flat = (yi >> shift) * n + (xi >> shift)
+            for c in missing:
+                v = b.gather(c, rows)
+                # a missing __null companion gathers as zeros (blocks.py)
+                nulls = b.gather(c + "__null", rows)
+                m = ok & ~np.asarray(nulls, dtype=bool)
+                if not m.any():
+                    continue
+                fl = flat[m]
+                vv = np.asarray(v)[m]
+                # sums accumulate in the column's NATIVE width (int64 for
+                # int-backed columns — exact); min/max compare in f64
+                np.add.at(acc[c]["sum"], fl, vv.astype(dtypes[c], copy=False))
+                vf = vv.astype(np.float64, copy=False)
+                np.minimum.at(acc[c]["min"], fl, vf)
+                np.maximum.at(acc[c]["max"], fl, vf)
+                acc[c]["count"] += np.bincount(fl, minlength=n * n)
+        with self._lock:
+            for c in missing:
+                self.col_grids[c] = {
+                    k: g.reshape(n, n) for k, g in acc[c].items()
+                }
+
+
+def _sum_dtype(ft, col: str):
+    for a in ft.attributes:
+        if a.name == col:
+            dt = a.type.numpy_dtype
+            if dt is not None and np.dtype(dt).kind in "iub":
+                return np.int64
+            return np.float64
+    return np.float64
+
+
+def _edges_overlap_rects(edges: np.ndarray, rects: np.ndarray) -> np.ndarray:
+    """[K] bool: does any edge segment possibly intersect each rect?
+    Conservative (false positives move a cell to the boundary ring —
+    cost, never correctness): bbox overlap AND NOT all four rect corners
+    strictly on one side of the edge's supporting line."""
+    ax, ay, bx, by = edges[:, 0], edges[:, 1], edges[:, 2], edges[:, 3]
+    rx0, ry0, rx1, ry1 = rects[:, 0], rects[:, 1], rects[:, 2], rects[:, 3]
+    exmin = np.minimum(ax, bx)[None, :]
+    exmax = np.maximum(ax, bx)[None, :]
+    eymin = np.minimum(ay, by)[None, :]
+    eymax = np.maximum(ay, by)[None, :]
+    bbox = (
+        (exmax >= rx0[:, None]) & (exmin <= rx1[:, None])
+        & (eymax >= ry0[:, None]) & (eymin <= ry1[:, None])
+    )
+    dx = (bx - ax)[None, :]
+    dy = (by - ay)[None, :]
+    pos = np.zeros_like(bbox)
+    neg = np.zeros_like(bbox)
+    first = True
+    for cx, cy in ((rx0, ry0), (rx0, ry1), (rx1, ry0), (rx1, ry1)):
+        cross = dx * (cy[:, None] - ay[None, :]) - dy * (cx[:, None] - ax[None, :])
+        if first:
+            pos = cross > 0
+            neg = cross < 0
+            first = False
+        else:
+            pos &= cross > 0
+            neg &= cross < 0
+    return (bbox & ~(pos | neg)).any(axis=1)
+
+
+# -- density-grid query memo --------------------------------------------------
+
+
+class DensityMemo:
+    """One cached density grid (host f64) — the direct query-result leg
+    of the GeoBlocks cache: a repeated dashboard tile answers with zero
+    dispatch and a bit-identical grid (it IS the stored grid, copied)."""
+
+    __slots__ = ("grid", "last_used", "built_at")
+
+    def __init__(self, grid: np.ndarray):
+        self.grid = np.array(grid, dtype=np.float64, copy=True)
+        self.built_at = time.time()
+        self.last_used = self.built_at
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.grid.nbytes)
+
+    def evict_device(self) -> None:  # host-only entry
+        pass
+
+
+# -- cache --------------------------------------------------------------------
+
+
+class AggCache:
+    """Per-store TTL'd LRU over pyramid + density-memo entries, bounded
+    by total bytes. A generation move re-keys (a stale entry can never
+    answer); ``invalidate`` additionally drops a type's entries eagerly
+    so a write releases device arrays now, not at TTL."""
+
+    def __init__(self):
+        self._entries: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+        with _CACHES_LOCK:
+            _CACHES.add(self)
+
+    def get(self, key: tuple, ttl_s: float):
+        reg = devstats_metrics()
+        with self._lock:
+            self._sweep(ttl_s)
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._entries[key] = e  # LRU refresh
+                e.last_used = time.time()
+                reg.inc("agg.cache.hits")
+                reg.inc(f"agg.cache.{key[0]}.hits")
+                return e
+        reg.inc("agg.cache.misses")
+        return None
+
+    def put(self, key: tuple, entry) -> None:
+        _bits, _levels, _ttl, cap = agg_knobs()
+        reg = devstats_metrics()
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None and old is not entry:
+                old.evict_device()
+            self._entries[key] = entry
+            while len(self._entries) > 1 and self._total_bytes() > cap:
+                _k, victim = next(iter(self._entries.items()))
+                self._entries.pop(_k).evict_device()
+                reg.inc("agg.cache.evicted")
+
+    def invalidate(self, type_name: str) -> int:
+        """Drop every entry of ``type_name`` (keys are (kind, type, ...));
+        called from the write path so stale levels release immediately."""
+        reg = devstats_metrics()
+        dropped = 0
+        with self._lock:
+            for k in [k for k in self._entries if k[1] == type_name]:
+                self._entries.pop(k).evict_device()
+                dropped += 1
+        if dropped:
+            reg.inc("agg.cache.invalidated", dropped)
+        return dropped
+
+    def _sweep(self, ttl_s: float) -> None:
+        """Drop EVERY expired entry (idle pyramids must release HBM at
+        TTL — the JoinBuildCache rule). Called under the lock."""
+        now = time.time()
+        expired = [
+            k for k, e in self._entries.items() if now - e.last_used > ttl_s
+        ]
+        for k in expired:
+            self._entries.pop(k).evict_device()
+        if expired:
+            devstats_metrics().inc("agg.cache.expired", len(expired))
+
+    def _total_bytes(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._total_bytes()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+def _cache_totals() -> Tuple[int, int]:
+    with _CACHES_LOCK:
+        caches = list(_CACHES)
+    return sum(len(c) for c in caches), sum(c.total_bytes() for c in caches)
+
+
+def agg_debug() -> Dict[str, Any]:
+    """The ``agg`` block of GET /debug/device: cache occupancy/bytes and
+    hit/miss/build/eviction counters, plus the latest pyramid build's
+    shape — the operator's "is the aggregate cache earning its HBM"
+    answer."""
+    reg = devstats_metrics()
+    counters, _g, _t, totals = reg.snapshot()
+    entries, nbytes = _cache_totals()
+    with _LAST_BUILD_LOCK:
+        last = dict(_LAST_BUILD)
+    build_count, build_sum_s = totals.get("agg.build", (0, 0.0))
+    return {
+        "cache": {
+            "entries": entries,
+            "bytes": nbytes,
+            "hits": counters.get("agg.cache.hits", 0),
+            "misses": counters.get("agg.cache.misses", 0),
+            "builds": counters.get("agg.cache.builds", 0),
+            "expired": counters.get("agg.cache.expired", 0),
+            "evicted": counters.get("agg.cache.evicted", 0),
+            "invalidated": counters.get("agg.cache.invalidated", 0),
+        },
+        "build": {
+            "count": build_count,
+            "wall_s": round(build_sum_s, 4),
+        },
+        "pyramid": last,
+    }

@@ -34,7 +34,7 @@ segment (a full re-upload — the compaction analog).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -2076,6 +2076,36 @@ class DeviceSegment:
             self.t_ms = self._pack(traw, np.int32, -1)
         return True
 
+    def agg_mask(self, table: IndexTable):
+        """Packed (valid & finite-geometry) row mask for the aggregate
+        pyramid build reduction (ops/pyramid.py): null geometries encode
+        leniently (clipped keys land in cell 0) and must never count in
+        a cell, exactly as the host build excludes them. Cached per
+        tombstone state (``self.valid`` is re-packed whenever tombstones
+        move, so identity of that array keys the cache)."""
+        got = getattr(self, "_agg_mask", None)
+        if got is not None and got[0] is self.valid:
+            return got[1]
+        geom = table.ft.default_geometry.name
+        finite = (
+            np.concatenate(
+                [
+                    np.isfinite(
+                        np.asarray(b.full_col(geom + "__x"), dtype=np.float64)
+                    )
+                    & np.isfinite(
+                        np.asarray(b.full_col(geom + "__y"), dtype=np.float64)
+                    )
+                    for b in self.blocks
+                ]
+            )
+            if self.blocks
+            else np.empty(0, dtype=bool)
+        )
+        mask = self._pack([self._valid_host & finite], bool, False)
+        self._agg_mask = (self.valid, mask)
+        return mask
+
     def _mask_args(self, boxes_dev, windows_dev) -> tuple:
         if self.kind == "z3":
             return (self.xi, self.yi, self.bins, self.ti, self.valid, boxes_dev, windows_dev)
@@ -3882,6 +3912,8 @@ class TpuScanExecutor:
         # evicted (frees the device-resident shards)
         self._cache: Dict[int, Tuple["weakref.ref", DeviceIndex]] = {}
         self._density_fns: Dict[Tuple[int, int], tuple] = {}
+        # aggregate-pyramid build reductions, one per cell-bits setting
+        self._pyramid_fns: Dict[int, Any] = {}
         # circuit breaker over device.dispatch/fetch: a PERSISTENTLY
         # failing link short-circuits queries straight to the host scan
         # (zero per-query dispatch/retry cost) until a half-open probe
@@ -5690,6 +5722,34 @@ class TpuScanExecutor:
             for seg in dev.segments
         ]
         return _count_dual_resolve(pendings, node, geom)
+
+    def pyramid_counts(self, table: IndexTable, bits: int) -> Optional[np.ndarray]:
+        """[H, W] int64 per-cell row counts for the aggregate pyramid
+        (ops/pyramid.py), reduced on device straight off the existing z2
+        segment mirrors — the rows' integer grid coordinates (seg.xi/yi)
+        are already HBM-resident, so a build moves one small mask up and
+        one [H, W] grid back per segment. Integer shifts + sort counting
+        make the grid bit-identical to the host build over the same
+        keys. None -> the host build (non-z2 table, no mirrors)."""
+        if table.index.name != "z2":
+            return None
+        dev = self.device_index(table)
+        if not dev.segments:
+            return None
+        fn = self._pyramid_fns.get(bits)
+        if fn is None:
+            from geomesa_tpu.ops.aggregations import make_pyramid_counts
+
+            fn = make_pyramid_counts(self.mesh, bits)
+            self._pyramid_fns[bits] = fn
+        n = 1 << bits
+        total = np.zeros((n, n), dtype=np.int64)
+        for seg in dev.segments:
+            if seg.n == 0:
+                continue
+            grid = fn(seg.xi, seg.yi, seg.agg_mask(table))
+            total += np.asarray(_np_local(grid), dtype=np.int64)
+        return total
 
     def density_scan(self, table: IndexTable, plan: QueryPlan, spec):
         """Fused filter + density grid on device (the server-side

@@ -322,6 +322,17 @@ class ShardWorker:
             st = self._stores.get(partition)
         return 0 if st is None else st.count(name)
 
+    def count_filtered(self, name: str, query: Query, partition: str) -> int:
+        """One partition's exact filtered count under this shard's
+        admission budget (the sub-store's own aggregate pyramid answers
+        it when hot — ops/pyramid.py). Same envelope as ``scan``: a
+        shed routes the coordinator to a replica, the ambient deadline
+        slice bounds the underlying blocks."""
+        with self.admission.admit():
+            with self._lock:
+                st = self._stores.get(partition)
+            return 0 if st is None else st.count(name, query)
+
     def has_visibility(self, name: str) -> bool:
         with self._lock:
             stores = list(self._stores.values())
@@ -515,7 +526,97 @@ class ShardedDataStore(TpuDataStore):
             est = self.stats.get_count(ft, q.filter)
             if est is not None:
                 return int(est)
+        if exact and q.max_features is None and not q.hints:
+            # merged per-worker pyramid count: each covering partition's
+            # primary sub-store answers exactly (through ITS pyramid
+            # when hot) instead of shipping every matching row up
+            plan = self._plan_cached(name, q)
+            if not plan.is_empty:
+                got = self._count_pyramid(name, ft, q, plan)
+                if got is not None:
+                    return got
         return len(self.query(name, q))
+
+    def _pyramid_for(self, name: str, ft):
+        """The coordinator keeps NO row data — a locally-built pyramid
+        would aggregate its (empty) local tables and answer zero for
+        everything. Aggregations answer through the per-worker pyramids
+        (``_count_pyramid`` below) or the ordinary scatter/gather."""
+        return None
+
+    def _count_pyramid(self, name, ft, query: Query, plan) -> Optional[int]:
+        """Merged coordinator answer over per-worker pyramids: the
+        filter's partition covering routes each partition's exact count
+        to its placement chain (partitions are disjoint row sets and
+        replicas mirror their primary, so one answer per partition sums
+        every matching row exactly once), and each sub-store's own
+        ``count`` rides ITS aggregate pyramid once hot. The PR 6 shard
+        envelope applies: each call runs under the worker's per-shard
+        admission budget (``count_filtered``), an open breaker or a
+        ``ShedLoad`` reroutes to the replica with zero dispatch cost and
+        no strike, other failures strike and fail over, and an
+        exhausted chain raises a crisp ``ShardUnavailable`` — never a
+        partial sum."""
+        from geomesa_tpu.index.planner import spatial_only_shape
+        from geomesa_tpu.ops.pyramid import agg_enabled
+
+        if not agg_enabled():
+            return None
+        if query.max_features is not None or query.hints.get("sampling"):
+            return None
+        if spatial_only_shape(plan, ft) is None:
+            return None
+        if self._age_off_cutoff(ft) is not None:
+            return None
+        if any(w.has_visibility(name) for w in self.workers):
+            return None
+        wq = Query(filter=query.filter)
+        total = 0
+        with trace.span("agg.shard.count", type=name) as sp:
+            parts = self.placement.covering(
+                ft, query.filter, self._partitions.get(name, set())
+            )
+            for p in parts:
+                deadline.check("agg.shard.count")
+                total += self._count_one_partition(name, wq, p)
+            sp.set_attr("partitions", len(parts))
+        return total
+
+    def _count_one_partition(self, name: str, wq: Query, p: str) -> int:
+        """One partition's count through its placement chain under the
+        per-shard breaker protocol (every ``allow()`` gets a verdict)."""
+        last: Optional[BaseException] = None
+        for sid in self.placement.targets(p):
+            br = self._breakers[sid]
+            if not br.allow():
+                continue  # open: straight to the replica, zero dispatch
+            try:
+                got = self.workers[sid].count_filtered(name, wq, p)
+            except ShedLoad as e:
+                # overloaded is not broken: no strike, try the replica
+                br.cancel_probe()
+                last = e
+                continue
+            except QueryTimeout:
+                # the QUERY's budget died, not the shard (the PR 4/6
+                # rule) — release any probe slot and propagate crisply
+                br.cancel_probe()
+                raise
+            except Exception as e:  # noqa: BLE001 - worker failure
+                br.record_failure()
+                trace.event(
+                    "shard.failure", shard=sid, partition=p,
+                    error=type(e).__name__,
+                )
+                last = e
+                continue
+            br.record_success()
+            return got
+        raise ShardUnavailable(
+            f"partition {p!r}: every placement "
+            f"{self.placement.targets(p)} refused or failed"
+            + (f" (last: {type(last).__name__}: {last})" if last else "")
+        )
 
     # -- execute: route -> scatter/gather -> merge ---------------------------
 
@@ -524,6 +625,14 @@ class ShardedDataStore(TpuDataStore):
     ) -> QueryResult:
         if plan.is_empty:
             return super()._execute(name, ft, query, plan, t_scan_start, pending)
+        # aggregate-cache shortcuts before the fan-out (ops/pyramid.py):
+        # a memoized density grid or a Count()-only stats spec answered
+        # from the per-worker pyramids skips the whole scatter/gather —
+        # today those queries ship EVERY matching row to the coordinator
+        untransformed = self._untransformed(query)
+        got = self._agg_shortcut(name, ft, query, plan, untransformed)
+        if got is not None:
+            return got
         groups = self._route_shards(name, ft, query)
         plan.scan_path = f"sharded[{len(groups)}]"
         if not groups:
@@ -542,7 +651,9 @@ class ShardedDataStore(TpuDataStore):
             # even a failing query's trace attributes which shard
             # degraded and why (hedges, failovers, refusals)
             trace.set_attr("shards", outcomes)
-        return self._merge_shards(ft, query, plan, scanouts)
+        result = self._merge_shards(ft, query, plan, scanouts)
+        self._agg_density_fill(name, query, untransformed, result)
+        return result
 
     def _route_shards(
         self, name: str, ft, query: Query

@@ -443,8 +443,14 @@ class TpuDataStore:
 
     def _note_write(self, name: str) -> None:
         """Advance the type's write generation (see _write_gen). Every
-        mutation path — base or override — must call this."""
+        mutation path — base or override — must call this. The aggregate
+        cache (ops/pyramid.py) invalidates here too: the generation in
+        its keys already re-keys stale entries, but dropping them NOW
+        releases their device arrays instead of waiting out the TTL."""
         self._write_gen[name] = self._write_gen.get(name, 0) + 1
+        cache = self.__dict__.get("_agg_cache")
+        if cache is not None:
+            cache.invalidate(name)
 
     def schema_generation(self, name: str) -> tuple:
         """An opaque value that changes whenever the type's stored rows
@@ -507,6 +513,16 @@ class TpuDataStore:
                 and not has_vis
                 and self._age_off_cutoff(self.get_schema(name)) is None
             ):
+                # aggregate pyramid first (ops/pyramid.py): a hot region
+                # answers from interior partial sums + the boundary ring
+                # without sweeping candidate segments — cheaper than even
+                # the device mask-sum, and available on host-only stores
+                if q.max_features is None and not q.hints:
+                    self._prepare_query(name, q)
+                    plan = self._plan_cached(name, q)
+                    got = self._count_pyramid(name, self.get_schema(name), q, plan)
+                    if got is not None:
+                        return got
                 got = self._count_device(name, q)
                 if got is not None:
                     return got
@@ -548,6 +564,340 @@ class TpuDataStore:
                 self.executor, "GEOMESA_COUNT_DEVICE", "count", e
             )
             return None
+
+    # -- aggregate pyramid cache (ops/pyramid.py) ----------------------------
+
+    def _agg_cache_obj(self):
+        """The per-store aggregate cache, created lazily. GIL-atomic
+        setdefault: two concurrent first aggregations agree on ONE cache
+        (the ops/join.py rule — an orphaned loser would pin its device
+        arrays until GC)."""
+        cache = getattr(self, "_agg_cache", None)
+        if cache is None:
+            from geomesa_tpu.ops.pyramid import AggCache
+
+            cache = self.__dict__.setdefault("_agg_cache", AggCache())
+        return cache
+
+    def _pyramid_for(self, name: str, ft) -> Optional[Any]:
+        """The type's cached aggregate pyramid, built lazily under the
+        ``agg.build`` fault envelope. None when ineligible (no z2 table)
+        or when the build degraded — the caller answers from the
+        uncached exact scan path with identical results (parity under
+        faults covers aggregations-from-cache)."""
+        from geomesa_tpu.ops.pyramid import agg_knobs, build_pyramid
+
+        table = self._tables[name].get("z2")
+        if table is None:
+            return None
+        bits, levels, ttl, _cap = agg_knobs()
+        cache = self._agg_cache_obj()
+        # the key carries the schema generation (local table versions +
+        # the write counter): any write/compact/delete — including one
+        # routed through a ShardedDataStore worker — moves it, so a
+        # stale pyramid can never answer
+        key = ("pyramid", name, self.schema_generation(name), bits, levels)
+        pyr = cache.get(key, ttl)
+        if pyr is not None:
+            return pyr
+        try:
+            pyr = build_pyramid(table, ft, self.executor)
+        except Exception as e:  # noqa: BLE001 - injected/device build failure
+            from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
+
+            if isinstance(e, QueryTimeout):
+                raise  # the query's budget died, not the build
+            robustness_metrics().inc("degrade.agg_to_scan")
+            trace.event(
+                "degrade.agg_to_scan", reason=f"{type(e).__name__}: {e}"
+            )
+            return None
+        cache.put(key, pyr)
+        return pyr
+
+    def _agg_eligible(self, name: str, ft) -> bool:
+        """Store-state gates shared by every pyramid consumer: per-row
+        visibilities need the auth-enforcing scan, and age-off masks
+        expired rows at scan time — the pyramid aggregated them all."""
+        from geomesa_tpu.ops.pyramid import agg_enabled
+
+        if not agg_enabled():
+            return False
+        tables = self._tables.get(name)
+        if not tables or "z2" not in tables:
+            return False
+        first = next(iter(tables.values()))
+        if any(b.has_col("__vis__") for b in first.blocks):
+            return False
+        return self._age_off_cutoff(ft) is None
+
+    def _pyramid_classify(self, name, ft, query: Query, plan):
+        """The shared gate→build→classify pipeline under every pyramid
+        consumer: eligibility, spatial-only shape, the pre-build and
+        post-classify cost-model declines, and the (possibly degraded)
+        build. Returns ``(pyr, interior_rows, boundary_cells,
+        interior_mask)`` or None (the caller answers uncached)."""
+        from geomesa_tpu.filter.parser import to_cql
+        from geomesa_tpu.index.planner import (
+            pyramid_worthwhile,
+            spatial_only_shape,
+        )
+        from geomesa_tpu.ops.pyramid import agg_knobs, could_have_interior
+
+        if not self._agg_eligible(name, ft):
+            return None
+        geoms = spatial_only_shape(plan, ft)
+        if geoms is None:
+            return None
+        bits, _levels, _ttl, _cap = agg_knobs()
+        if not could_have_interior(geoms, bits):
+            # sub-cell region: decline BEFORE paying the O(table) build
+            devstats.devstats_metrics().inc("agg.cache.declined")
+            return None
+        pyr = self._pyramid_for(name, ft)
+        if pyr is None:
+            return None
+        interior, boundary_rows, _cand, cells, imask = pyr.classify(
+            geoms, memo_key=to_cql(query.filter)
+        )
+        if not pyramid_worthwhile(interior, boundary_rows):
+            devstats.devstats_metrics().inc("agg.cache.declined")
+            return None
+        return pyr, interior, cells, imask
+
+    def _count_pyramid(self, name, ft, query: Query, plan) -> Optional[int]:
+        """Exact count from the pyramid: interior partial sums + the
+        exact boundary-ring scan. None -> the ordinary paths answer.
+        ShardedDataStore overrides this with the per-worker fan-out."""
+        got = self._pyramid_classify(name, ft, query, plan)
+        if got is None:
+            return None
+        pyr, interior, cells, _imask = got
+        n = interior
+        if len(cells):
+            parts = self._agg_boundary_parts(
+                name, ft, plan, pyr.cell_ranges(cells)
+            )
+            n += sum(len(r) for _b, r in parts)
+        return n
+
+    def _agg_boundary_parts(self, name, ft, plan, ranges) -> List[tuple]:
+        """The fallthrough half of the interior/boundary fusion: seek
+        ONLY the boundary cells' z2 key spans (each pyramid cell is one
+        contiguous z2 range) and evaluate the plan's own post-filter on
+        those rows — identical per-row semantics to the uncached scan,
+        so pyramid answers are exact by construction."""
+        table = self._tables[name]["z2"]
+        dl = deadline_mod.ambient()
+        pf = plan.post_filter
+        pf_props = set(ast.properties(pf)) if pf is not None else None
+        parts: List[tuple] = []
+        for block, rows in table.scan(ranges):
+            if dl is not None:
+                dl.check("agg.boundary")
+            if pf_props is not None and len(rows):
+                fcols = self._gather_filter_cols(block, rows, pf_props)
+                mask = self.executor.post_filter(ft, plan, fcols)
+                if not mask.all():
+                    rows = rows[mask]
+            if len(rows):
+                parts.append((block, rows))
+        return parts
+
+    def _density_key(self, name: str, query: Query) -> Optional[tuple]:
+        """Cache key of one density aggregation: everything that decides
+        the grid — filter, grid spec, weight column, projection, and the
+        schema generation (a write re-keys instead of serving stale)."""
+        from geomesa_tpu.filter.parser import to_cql
+
+        spec = query.hints.get("density") or {}
+        try:
+            env = tuple(float(v) for v in spec["envelope"])
+            w, h = int(spec["width"]), int(spec["height"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        return (
+            "density", name, self.schema_generation(name),
+            to_cql(query.filter), env, w, h, spec.get("weight"),
+            tuple(query.properties) if query.properties is not None else None,
+        )
+
+    @staticmethod
+    def _untransformed(query: Query) -> bool:
+        """Device aggregation push-downs (and the aggregate cache)
+        evaluate STORED columns — a query transform (computed property)
+        changes what the host path would aggregate, so any transform
+        keeps aggregation on the host. Same containment test
+        QueryTransforms.parse uses, without building and discarding the
+        transform ASTs per query."""
+        return not query.properties or not any(
+            "=" in p for p in query.properties
+        )
+
+    def _agg_shortcut(
+        self, name, ft, query: Query, plan, untransformed: bool
+    ) -> Optional[QueryResult]:
+        """Aggregate-cache lookups ahead of the push-down dispatch; the
+        caller audits the returned result like any other (satisfying the
+        cache-hit QueryEvent/receipt contract)."""
+        from geomesa_tpu.ops.pyramid import agg_enabled, agg_knobs
+
+        if not agg_enabled():
+            return None
+        # ANY non-aggregation hint declines: sampling/sample_by change
+        # the row set, loose_bbox changes the filter contract (loose and
+        # exact grids must never share a memo entry), and an unknown
+        # future hint is assumed semantics-altering until proven not
+        if set(query.hints) - set(AGGREGATION_HINTS) or not untransformed:
+            return None
+        hints = set(query.hints) & set(AGGREGATION_HINTS)
+        if hints == {"density"}:
+            key = self._density_key(name, query)
+            if key is None:
+                return None
+            _b, _l, ttl, _c = agg_knobs()
+            entry = self._agg_cache_obj().get(key, ttl)
+            if entry is None:
+                return None
+            plan.scan_path = "agg-cache-density"
+            trace.set_attr("agg.cache", "hit")
+            return QueryResult(
+                ft, _empty_columns(ft), plan, {"density": entry.grid.copy()}
+            )
+        if hints == {"stats"}:
+            stat = _count_only_stats(query.hints["stats"])
+            if stat is None:
+                return None
+            n = self._count_pyramid(name, ft, query, plan)
+            if n is None:
+                return None
+            for s in stat.stats if hasattr(stat, "stats") else [stat]:
+                s.count = n
+            plan.scan_path = "agg-pyramid-stats"
+            trace.set_attr("agg.cache", "hit")
+            return QueryResult(ft, _empty_columns(ft), plan, {"stats": stat})
+        return None
+
+    def _agg_density_fill(
+        self, name, query: Query, untransformed: bool, result: QueryResult
+    ) -> None:
+        """Memoize a just-computed density grid (device or host path) so
+        the next identical dashboard tile answers with zero dispatch."""
+        from geomesa_tpu.ops.pyramid import agg_enabled
+
+        if not agg_enabled():
+            return
+        # same hint whitelist as _agg_shortcut: a loose_bbox (or sampled)
+        # grid must never be memoized where an exact query could hit it
+        if set(query.hints) - set(AGGREGATION_HINTS) or not untransformed:
+            return
+        if set(query.hints) & set(AGGREGATION_HINTS) != {"density"}:
+            return
+        grid = (result.aggregate or {}).get("density")
+        if grid is None:
+            return
+        key = self._density_key(name, query)
+        if key is None:
+            return
+        from geomesa_tpu.ops.pyramid import DensityMemo
+
+        self._agg_cache_obj().put(key, DensityMemo(np.asarray(grid)))
+
+    def aggregate(
+        self,
+        name: str,
+        query: Union[str, Query] = "INCLUDE",
+        columns: Optional[Sequence[str]] = None,
+    ) -> Dict[str, Any]:
+        """Lightweight spatial aggregate: exact matching-row count plus
+        per-column sum/min/max/non-null-count for numeric ``columns``.
+
+        Spatial-only filters over the default geometry answer from the
+        aggregate pyramid (interior partial sums fused with the exact
+        boundary-ring scan — ops/pyramid.py); anything else falls back
+        to the ordinary exact query. Counts, integer sums, and min/max
+        are identical between the two paths by construction; float sums
+        may differ in the last ulp (summation order). Runs under the
+        standard query envelope (budget + one admission slot)."""
+        from geomesa_tpu.ops.pyramid import AggError
+
+        ft = self.get_schema(name)
+        q = self._as_query(query)
+        cols = list(columns or [])
+        for c in cols:
+            a = next((a for a in ft.attributes if a.name == c), None)
+            if a is None:
+                raise AggError(f"unknown column {c!r}")
+            dt = a.type.numpy_dtype
+            if dt is None or np.dtype(dt).kind not in "iufb":
+                raise AggError(f"column {c!r} is not numeric")
+        with trace.span(
+            "query.aggregate", force=self.slow_query_s is not None, type=name
+        ) as root:
+            with deadline_mod.budget(self.query_timeout_s):
+                with self.admission.admit():
+                    self._prepare_query(name, q)
+                    got = self._aggregate_pyramid(name, ft, q, cols)
+                    if got is not None:
+                        if root.recording:
+                            root.set_attr("agg.cache", "hit")
+                        return got
+                    # exact fallback: the ordinary scan (admission slot
+                    # and budget are reentrant — PR 7 / PR 6 semantics)
+                    res = self.query(name, q)
+                    return _aggregate_columns(ft, res.columns, cols)
+
+    def _aggregate_pyramid(
+        self, name, ft, q: Query, cols: List[str]
+    ) -> Optional[Dict[str, Any]]:
+        if q.max_features is not None or q.hints:
+            return None
+        plan = self._plan_cached(name, q)
+        got = self._pyramid_classify(name, ft, q, plan)
+        if got is None:
+            return None
+        pyr, interior, cells, imask = got
+        try:
+            pyr.ensure_columns(self._tables[name]["z2"], ft, cols)
+        except Exception as e:  # noqa: BLE001 - injected/device build failure
+            from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
+
+            if isinstance(e, QueryTimeout):
+                raise  # the query's budget died, not the build
+            robustness_metrics().inc("degrade.agg_to_scan")
+            trace.event(
+                "degrade.agg_to_scan", reason=f"{type(e).__name__}: {e}"
+            )
+            return None  # the caller answers from the uncached exact scan
+        parts = (
+            self._agg_boundary_parts(name, ft, plan, pyr.cell_ranges(cells))
+            if len(cells)
+            else []
+        )
+        out: Dict[str, Any] = {
+            "count": interior + sum(len(r) for _b, r in parts),
+            "columns": {},
+        }
+        for c in cols:
+            g = pyr.col_grids[c]
+            occupied = imask & (g["count"] > 0)
+            cnt = int(g["count"][imask].sum())
+            total = g["sum"][imask].sum()
+            mn = g["min"][occupied].min() if occupied.any() else np.inf
+            mx = g["max"][occupied].max() if occupied.any() else -np.inf
+            for block, rows in parts:
+                v = block.gather(c, rows)
+                nulls = np.asarray(
+                    block.gather(c + "__null", rows), dtype=bool
+                )
+                vv = np.asarray(v)[~nulls]
+                if len(vv):
+                    cnt += len(vv)
+                    total = total + vv.sum()
+                    mn = min(mn, float(vv.min()))
+                    mx = max(mx, float(vv.max()))
+            out["columns"][c] = _column_summary(ft, c, cnt, total, mn, mx)
+        return out
 
     # -- queries ------------------------------------------------------------
 
@@ -1022,6 +1372,20 @@ class TpuDataStore:
                 return QueryResult(ft, empty, plan, run_aggregation(ft, query.hints, empty))
             return QueryResult(ft, empty, plan)
 
+        untransformed = self._untransformed(query)
+
+        # aggregate-cache shortcuts (ops/pyramid.py): a memoized density
+        # grid answers with zero dispatch; a Count()-only stats spec over
+        # a spatial-only plan answers from the pyramid's interior partial
+        # sums plus the exact boundary ring. Either way the caller's
+        # ordinary _audit still runs on the returned result — the
+        # QueryEvent outcome row and the (zero-dispatch) cost receipt are
+        # written for cache-answered push-downs too, with agg.cache=hit
+        # stamped on the query root span.
+        got = self._agg_shortcut(name, ft, query, plan, untransformed)
+        if got is not None:
+            return got
+
         if plan.union is not None:
             # cross-index OR: scan each arm on its own index, union by fid
             # (FilterSplitter.scala:64-110; dedup replaces makeDisjoint :303)
@@ -1030,19 +1394,12 @@ class TpuDataStore:
                 parts.extend(
                     self._scan_parts(name, ft, query, arm, t_scan_start, pending)
                 )
-            return self._merge(ft, query, plan, parts, unique=False)
+            result = self._merge(ft, query, plan, parts, unique=False)
+            self._agg_density_fill(name, query, untransformed, result)
+            return result
 
         tables = self._tables[name]
         table = tables[plan.index.name]
-
-        # device aggregation push-downs evaluate STORED columns — a query
-        # transform (computed property) changes what the host path would
-        # aggregate, so any transform keeps aggregation on the host
-        # (same containment test QueryTransforms.parse uses, without
-        # building and discarding the transform ASTs per query)
-        untransformed = not query.properties or not any(
-            "=" in p for p in query.properties
-        )
 
         # fused device density push-down: grid comes back, features don't
         # (the KryoLazyDensityIterator analog)
@@ -1073,7 +1430,11 @@ class TpuDataStore:
                 grid = None
             if grid is not None:
                 plan.scan_path = "device-density"
-                return QueryResult(ft, _empty_columns(ft), plan, {"density": grid})
+                result = QueryResult(
+                    ft, _empty_columns(ft), plan, {"density": grid}
+                )
+                self._agg_density_fill(name, query, untransformed, result)
+                return result
 
         # device stats push-down: per-code count histograms come back,
         # features don't (the KryoLazyStatsIterator analog) — the host
@@ -1109,7 +1470,9 @@ class TpuDataStore:
         # this layout writes exactly ONE row per feature per index, and
         # expand_intervals dedupes overlapping range hits within a block —
         # so extent results stay lazy like point results
-        return self._merge(ft, query, plan, parts, unique=True)
+        result = self._merge(ft, query, plan, parts, unique=True)
+        self._agg_density_fill(name, query, untransformed, result)
+        return result
 
     def _route(self, query: Query, plan: QueryPlan) -> List[QueryPlan]:
         """ROUTE stage: decompose a plan into independently scannable
@@ -1615,6 +1978,63 @@ def _empty_columns(ft: FeatureType) -> Columns:
             dtype = a.type.numpy_dtype
             cols[a.name] = np.empty(0, dtype=dtype if dtype is not None else object)
     return cols
+
+
+def _count_only_stats(spec):
+    """Parsed stat when ``spec`` is composed solely of Count() stats (the
+    pyramid can answer those exactly from partial sums), else None.
+    Sketches with per-value state (MinMax's HLL registers, histograms)
+    cannot be reconstructed from per-cell scalar aggregates and keep the
+    ordinary device/host stats paths."""
+    from geomesa_tpu.stats.parser import parse_stat
+    from geomesa_tpu.stats.sketches import CountStat
+
+    try:
+        stat = parse_stat(spec)
+    except Exception:  # noqa: BLE001 - malformed spec: let run_stats raise
+        return None
+    stats = stat.stats if hasattr(stat, "stats") else [stat]
+    if not stats or not all(isinstance(s, CountStat) for s in stats):
+        return None
+    return stat
+
+
+def _column_summary(ft, col, cnt, total, mn, mx):
+    """Normalize one column's aggregate across the pyramid and fallback
+    paths: integer-backed columns report integer sums, floats report
+    floats; an all-null column reports None bounds."""
+    a = next((a for a in ft.attributes if a.name == col), None)
+    int_backed = (
+        a is not None
+        and a.type.numpy_dtype is not None
+        and np.dtype(a.type.numpy_dtype).kind in "iub"
+    )
+    return {
+        "count": int(cnt),
+        "sum": int(total) if int_backed else float(total),
+        "min": float(mn) if cnt else None,
+        "max": float(mx) if cnt else None,
+    }
+
+
+def _aggregate_columns(ft, columns, cols) -> Dict[str, Any]:
+    """Host-exact aggregate over already-filtered result columns — the
+    uncached reference the pyramid path must match."""
+    n = getattr(columns, "num_rows", None)
+    if n is None:
+        n = len(next(iter(columns.values()), []))
+    out: Dict[str, Any] = {"count": int(n), "columns": {}}
+    for c in cols:
+        v = np.asarray(columns[c])
+        nulls = columns.get(c + "__null")
+        if nulls is not None:
+            v = v[~np.asarray(nulls, dtype=bool)]
+        cnt = len(v)
+        total = v.sum() if cnt else 0
+        mn = float(v.min()) if cnt else np.inf
+        mx = float(v.max()) if cnt else -np.inf
+        out["columns"][c] = _column_summary(ft, c, cnt, total, mn, mx)
+    return out
 
 
 def _materialize(columns) -> Columns:

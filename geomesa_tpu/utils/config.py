@@ -178,6 +178,20 @@ JOIN_SKEW_THRESHOLD = SystemProperty("geomesa.join.skew.threshold", "128")
 JOIN_SPLIT_DEPTH = SystemProperty("geomesa.join.split.depth", "6")
 JOIN_CACHE_TTL = SystemProperty("geomesa.join.cache.ttl", "10 minutes")
 JOIN_PROBE_CHUNK = SystemProperty("geomesa.join.probe.chunk", "2048")
+# Aggregate pyramid cache (ops/pyramid.py): per-type z2-gridded partial
+# aggregates (count, per-column sum/min/max) answering hot count/stats
+# aggregations from interior partial sums with an exact boundary-ring
+# fallthrough, plus a density-grid query memo. `cell.bits` sets the
+# finest level's grid (2^bits x 2^bits cells over the world); `levels`
+# stacks that many coarser halvings above it (the hierarchical descent
+# the polygon classifier walks). Entries are TTL'd per LAST USE and the
+# cache is bounded by `cache.bytes` (LRU past it); device copies are
+# evicted with their entry so idle pyramids release HBM.
+AGG_ENABLED = SystemProperty("geomesa.agg.enabled", "true")
+AGG_LEVELS = SystemProperty("geomesa.agg.levels", "3")
+AGG_CELL_BITS = SystemProperty("geomesa.agg.cell.bits", "8")
+AGG_CACHE_TTL = SystemProperty("geomesa.agg.cache.ttl", "10 minutes")
+AGG_CACHE_BYTES = SystemProperty("geomesa.agg.cache.bytes", "64MB")
 # Socket-timeout knobs: NO I/O boundary is unbounded-by-default. The
 # netlog RPC client derives its per-attempt timeout from
 # min(geomesa.netlog.timeout, the query's remaining deadline); auxiliary

@@ -389,6 +389,13 @@ def device_debug() -> Dict[str, Any]:
     except Exception:  # noqa: BLE001 - debug page must render regardless
         join_block = {}
     try:
+        # lazy: same rule for the aggregate pyramid cache
+        from geomesa_tpu.ops.pyramid import agg_debug
+
+        agg_block = agg_debug()
+    except Exception:  # noqa: BLE001 - debug page must render regardless
+        agg_block = {}
+    try:
         backend = jax.default_backend()
         n_devices = len(jax.devices())
     except Exception as e:  # noqa: BLE001 - backend init failure is still a page
@@ -426,4 +433,7 @@ def device_debug() -> Dict[str, Any]:
         # spatial-join telemetry (ops/join.py): build-cache occupancy +
         # hit/miss counters, bucket skew histogram, split/pair counters
         "join": join_block,
+        # aggregate pyramid cache (ops/pyramid.py): entries/bytes,
+        # hit/miss/build/eviction counters, latest pyramid shape
+        "agg": agg_block,
     }

@@ -101,6 +101,7 @@ FAULT_POINTS = (
     "shard.merge",
     "join.build",
     "join.probe",
+    "agg.build",
 )
 
 KINDS = ("error", "drop", "latency", "torn", "crash")

@@ -13,6 +13,11 @@ SURVEY.md section 2.5). Endpoints over a datastore:
                                     -> {"pairs": [[build_fid, probe_fid]...],
                                     "count", "stats"}
     GET /stats/count?name=&cql=&exact=
+    GET /stats/aggregate?name=&cql=&columns=a,b
+                                 -- count + per-column sum/min/max over
+                                    the matching rows; hot spatial
+                                    regions answer from the aggregate
+                                    pyramid cache (ops/pyramid.py)
     GET /stats/bounds?name=
     GET /metrics                 -- Prometheus text exposition (store
                                     registry + robustness counters +
@@ -478,6 +483,24 @@ def make_handler(store):
                     exact = params.get("exact", "true").lower() != "false"
                     n = store.count(name, params.get("cql", "INCLUDE"), exact=exact)
                     self._send(200, json.dumps({"count": int(n)}))
+                elif route == "/stats/aggregate":
+                    # dashboard aggregate surface over the pyramid cache
+                    # (ops/pyramid.py): count + per-column sum/min/max,
+                    # hot regions answered from interior partial sums
+                    from geomesa_tpu.ops.pyramid import AggError
+
+                    cols = [
+                        c for c in params.get("columns", "").split(",") if c
+                    ]
+                    try:
+                        got = store.aggregate(
+                            params["name"], params.get("cql", "INCLUDE"),
+                            columns=cols,
+                        )
+                    except AggError as e:
+                        self._send(400, json.dumps({"error": str(e)}))
+                        return
+                    self._send(200, json.dumps(got, default=str))
                 elif route == "/stats/bounds":
                     b = store.stats.get_bounds(store.get_schema(params["name"]))
                     self._send(200, json.dumps({"bounds": b}))

@@ -108,6 +108,52 @@ def run_join_stream(store, reps: int) -> dict:
     }
 
 
+def run_agg_stream(store, reps: int) -> dict:
+    """The aggregate-pyramid bench leg (GeoBlocks): N repeated polygon
+    aggregations over the GDELT-style load the main stream built. The
+    FIRST touch pays the pyramid build plus the exact boundary-ring
+    scan (cold); every following rep must answer from the cached
+    interior partial sums + boundary ring (hot). The gate pins the hot
+    wall inside the time band, the count as an exact correctness check,
+    a minimum cache hit-count (a lost cache shows up as zero hits), and
+    the cold/hot speedup itself — the whole point of the cache is that
+    hot is AT LEAST 10x cheaper than first touch."""
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.utils import devstats
+
+    poly = (
+        "POLYGON((-60 -30, 60 -30, 80 20, 0 45, -80 20, -60 -30))"
+    )
+    cql = f"INTERSECTS(geom, {poly})"
+
+    def make_query():
+        q = Query.cql(cql)
+        q.hints["stats"] = "Count()"
+        return q
+
+    reg = devstats.devstats_metrics()
+    hits0 = reg.counter("agg.cache.hits")
+    t0 = time.perf_counter()
+    res = store.query("gdelt", make_query())
+    cold_s = time.perf_counter() - t0
+    count = int(res.aggregate["stats"].count)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = store.query("gdelt", make_query())
+    hot_s = (time.perf_counter() - t0) / max(reps, 1)
+    assert int(res.aggregate["stats"].count) == count
+    hits = reg.counter("agg.cache.hits") - hits0
+    return {
+        "reps": reps,
+        "cold_ms": round(cold_s * 1000.0, 3),
+        "hot_ms": round(hot_s * 1000.0, 3),
+        "speedup": round(cold_s / max(hot_s, 1e-9), 1),
+        "count": count,
+        "hits": hits,
+        "path": res.plan.scan_path,
+    }
+
+
 def run_stream(n: int, reps: int) -> dict:
     """Ingest n synthetic rows, warm (pack + compile), then run the
     jittered bench query stream traced; return the gate artifact."""
@@ -165,9 +211,19 @@ def run_stream(n: int, reps: int) -> dict:
     }
     hits = sum(len(r) for r in results)
     join = run_join_stream(store, max(2, reps // 2))
+    agg = run_agg_stream(store, max(4, reps))
+    try:
+        # 1-minute loadavg at measurement time: the gate is known
+        # load-sensitive, and a flaky band should at least SAY the box
+        # was busier than when the baseline was recorded
+        loadavg = round(os.getloadavg()[0], 2)
+    except (AttributeError, OSError):
+        loadavg = None
     return {
         "schema": 1,
         "join": join,
+        "agg": agg,
+        "loadavg_1m": loadavg,
         "config": {
             "n": n,
             "reps": reps,
@@ -204,6 +260,11 @@ def inject_slowdown(artifact: dict, factor: float) -> dict:
         out["join"]["per_join_ms"] = round(
             out["join"]["per_join_ms"] * factor, 3
         )
+    if "agg" in out:
+        # uniform scaling preserves the (self-relative) speedup ratio:
+        # the injection tests the band gates, not the cache's physics
+        out["agg"]["cold_ms"] = round(out["agg"]["cold_ms"] * factor, 3)
+        out["agg"]["hot_ms"] = round(out["agg"]["hot_ms"] * factor, 3)
     out["injected_slowdown"] = factor
     return out
 
@@ -291,6 +352,40 @@ def compare(baseline: dict, current: dict, tolerance: dict = None) -> list:
                 f"join build_hits dropped: {c_join.get('build_hits')} < "
                 f"{b_join.get('build_hits')} — the HBM build cache "
                 "stopped reusing the geofence build side"
+            )
+
+    # the aggregate-pyramid leg (GeoBlocks): hot wall inside the time
+    # band, count an exact correctness check, a minimum cache hit-count
+    # (like the join leg's build_hits), and the cold/hot speedup floor —
+    # a hot cache-served aggregation must be >= 10x cheaper than the
+    # cold first touch, self-relative so machine speed cancels out.
+    # Baselines recorded before the agg leg skip it.
+    b_agg = baseline.get("agg")
+    c_agg = current.get("agg", {})
+    if b_agg:
+        b_ms, c_ms = b_agg["hot_ms"], c_agg.get("hot_ms", 0.0)
+        limit = b_ms * tol["per_query_ms_factor"]
+        if c_ms > limit:
+            out.append(
+                f"agg hot_ms regressed: {c_ms:.2f} > {limit:.2f} "
+                f"(baseline {b_ms:.2f} x {tol['per_query_ms_factor']})"
+            )
+        if b_agg.get("count") != c_agg.get("count"):
+            out.append(
+                f"agg count drifted: {c_agg.get('count')} != "
+                f"{b_agg.get('count')} (CORRECTNESS, not perf)"
+            )
+        if c_agg.get("hits", 0) < b_agg.get("hits", 0):
+            out.append(
+                f"agg hits dropped: {c_agg.get('hits')} < "
+                f"{b_agg.get('hits')} — the aggregate pyramid cache "
+                "stopped serving hot aggregations"
+            )
+        if c_agg.get("speedup", 0.0) < 10.0:
+            out.append(
+                f"agg speedup below floor: {c_agg.get('speedup')}x < 10x "
+                "— hot cache-served aggregations are no longer "
+                "meaningfully cheaper than the cold first touch"
             )
     return out
 
@@ -404,6 +499,22 @@ def main(argv=None) -> int:
         f"recompiles={artifact['devstats']['recompiles']}, "
         f"d2h={artifact['devstats']['d2h_bytes']:,}B"
     )
+    # the gate is known load-sensitive: when this run's 1-minute loadavg
+    # exceeds the baseline's, say so — a failing band on a busy machine
+    # may be noise, and a silent flake gives the operator no hint why
+    # slack of 0.5: a baseline recorded on an idle box (loadavg ~0) must
+    # not make every future check "warn" on ordinary background noise —
+    # the warning is for genuinely busier-than-recording runs
+    b_load = baseline.get("loadavg_1m")
+    c_load = artifact.get("loadavg_1m")
+    if b_load is not None and c_load is not None and c_load > b_load + 0.5:
+        print(
+            f"load warning: 1m loadavg {c_load} exceeds the baseline's "
+            f"{b_load} — this gate is load-sensitive; a failing time "
+            "band under higher load than the recording may be noise "
+            "(re-run on a quiet machine before trusting it)",
+            file=sys.stderr,
+        )
     if regressions:
         print("REGRESSION:", file=sys.stderr)
         for line in regressions:

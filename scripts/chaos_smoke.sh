@@ -28,11 +28,16 @@
 #     spatial join answers IDENTICAL pairs to the fault-free run (device
 #     degrades to the host reference join), a crash schedule dies
 #     crisply mid-join, and device-vs-host parity holds on every seed
+#   - aggregate-cache parity under faults (tests/test_agg_cache.py): for
+#     every agg.build × error/drop/latency × seed schedule, count/stats/
+#     density aggregations answer IDENTICAL results to the fault-free
+#     run (a failed pyramid build degrades to the uncached exact scan),
+#     and a crash schedule dies crisply mid-build
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
 cd "$(dirname "$0")/.."
 exec timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_crash.py tests/test_shards.py \
-    tests/test_join.py -q -m chaos \
+    tests/test_join.py tests/test_agg_cache.py -q -m chaos \
     -p no:cacheprovider "$@"

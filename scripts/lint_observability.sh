@@ -32,6 +32,7 @@ declare -A SPANS=(
     ["shard.merge"]="geomesa_tpu/parallel/shards.py"
     ["join.build"]="geomesa_tpu/ops/join.py"
     ["join.probe"]="geomesa_tpu/ops/join.py"
+    ["agg.build"]="geomesa_tpu/ops/pyramid.py"
 )
 for point in "${!SPANS[@]}"; do
     file="${SPANS[$point]}"

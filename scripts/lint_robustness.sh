@@ -81,6 +81,19 @@ for point in join.build join.probe; do
     fi
 done
 
+# the aggregate-pyramid build boundary is pinned too: a build that
+# cannot be chaos-tested cannot prove its degrade-to-exact-scan parity
+for point in agg.build; do
+    if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/ops/pyramid.py; then
+        echo "FAIL: geomesa_tpu/ops/pyramid.py lost the '${point}' fault point"
+        echo "      (the aggregate-cache contract: a pyramid build failure"
+        echo "       degrades to the uncached exact scan with identical"
+        echo "       answers — faults.fault_point(\"${point}\") beside a"
+        echo "       deadline check; see utils/faults.py)"
+        fail=1
+    fi
+done
+
 # multi-file mutation sites in the store tier must declare a
 # write-ahead intent before touching files (crash-consistency contract)
 while IFS= read -r f; do

@@ -1,0 +1,597 @@
+"""Aggregate pyramid cache (ops/pyramid.py + the datastore integration).
+
+Parity contract under test: a pyramid-answered aggregation (count /
+Count()-stats / aggregate() column summaries / memoized density grid) is
+IDENTICAL to the uncached exact scan — interior cells are exact partial
+sums, boundary cells re-run the exact per-row predicate, so no epsilon
+ever reaches an answer. That parity must hold across every invalidation
+path (write / compact / delete / delete_schema, including a write routed
+through a ShardedDataStore worker), across every agg.build chaos
+schedule (a failed build degrades to the uncached scan), on device and
+host-only stores, and an expired-TTL entry must release its device
+arrays (the HBM gauge drops).
+"""
+
+import gc
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.ops.pyramid import AggError, host_counts
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.parallel.shards import ShardedDataStore
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import devstats, faults, trace
+from geomesa_tpu.utils.audit import InMemoryAuditWriter, QueryTimeout
+from geomesa_tpu.utils.config import properties
+
+SPEC = "val:Integer,w:Double,dtg:Date,*geom:Point:srid=4326"
+T0 = 1483228800000
+
+# a large concave polygon: thousands of interior cells at the default
+# 8-bit grid, so the pyramid path is worthwhile and actually engages
+POLY = "POLYGON((-60 -30, 60 -30, 80 20, 0 45, -80 20, -60 -30))"
+CQL = f"INTERSECTS(geom, {POLY})"
+BBOX = "BBOX(geom, -50.3, -25.7, 55.9, 35.2)"
+
+
+def _mkstore(device=True, n=4000, seed=0, **kw):
+    ex = TpuScanExecutor(default_mesh()) if device else None
+    store = TpuDataStore(executor=ex, **kw)
+    store.create_schema(parse_spec("events", SPEC))
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-90, 90, n)
+    y = rng.uniform(-50, 50, n)
+    if n > 8:
+        x[5], y[5] = np.nan, np.nan  # null-geometry row: must never count
+    store._insert_columns(store.get_schema("events"), {
+        "__fid__": np.array([f"e{i}" for i in range(n)], dtype=object),
+        "val": rng.integers(0, 100, n).astype(np.int32),
+        "w": rng.uniform(0.0, 1.0, n),
+        "geom__x": x, "geom__y": y,
+        "dtg": np.full(n, T0, dtype=np.int64),
+    })
+    return store
+
+
+def _ref_count(store, cql) -> int:
+    """The uncached exact reference: materialize the matching rows."""
+    return len(store.query("events", cql))
+
+
+# -- build parity -------------------------------------------------------------
+
+
+def test_device_build_matches_host_build_bit_for_bit():
+    """The device reduction (segment mirrors + integer shifts + sort
+    counting) and the host build (z2_decode of the same keys) produce
+    the SAME count grid — the foundation of the exactness contract."""
+    store = _mkstore(device=True)
+    table = store._tables["events"]["z2"]
+    ft = store.get_schema("events")
+    dev = store.executor.pyramid_counts(table, 8)
+    host = host_counts(table, ft, 8)
+    assert dev is not None
+    assert np.array_equal(dev, host)
+    # the NaN row is excluded on both sides: total == finite-geometry rows
+    assert int(host.sum()) == store.count("events") - 1
+
+
+# -- answer parity ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cql", [CQL, BBOX])
+def test_count_parity_and_cache_hits(cql):
+    reg = devstats.devstats_metrics()
+    store = _mkstore(device=True)
+    ref = _ref_count(store, cql)
+    h0 = reg.counter("agg.cache.hits")
+    assert store.count("events", cql) == ref      # cold: build + answer
+    assert store.count("events", cql) == ref      # hot: cache hit
+    assert reg.counter("agg.cache.hits") > h0
+
+
+def test_count_parity_host_only_store():
+    """The pyramid is not device-gated: a host-only store answers hot
+    counts from the same partial sums (host build)."""
+    store = _mkstore(device=False)
+    ref = _ref_count(store, CQL)
+    assert store.count("events", CQL) == ref
+    assert store.count("events", CQL) == ref
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_polygon_count_parity_across_shapes(seed):
+    """Triangles, slivers, and a polygon with a hole: interior/boundary
+    classification must stay conservative for every shape."""
+    store = _mkstore(device=True, seed=seed)
+    shapes = [
+        "POLYGON((-70 -40, 70 -40, 0 48, -70 -40))",
+        "POLYGON((-85 -10, 85 -12, 85 8, -85 10, -85 -10))",
+        "POLYGON((-60 -35, 60 -35, 60 40, -60 40, -60 -35),"
+        "(-30 -15, 30 -15, 30 20, -30 20, -30 -15))",  # hole
+    ]
+    for shp in shapes:
+        cql = f"INTERSECTS(geom, {shp})"
+        ref = _ref_count(store, cql)
+        assert store.count("events", cql) == ref, shp
+        assert store.count("events", cql) == ref, shp
+
+
+def test_agg_enabled_knob_is_an_escape_hatch():
+    """geomesa.agg.enabled=false routes everything through the ordinary
+    uncached paths — identical answers, zero cache activity."""
+    reg = devstats.devstats_metrics()
+    store = _mkstore(device=True, n=800)
+    ref = _ref_count(store, CQL)
+    with properties(geomesa_agg_enabled="false"):
+        b0 = reg.counter("agg.cache.builds")
+        m0 = reg.counter("agg.cache.misses")
+        assert store.count("events", CQL) == ref
+        assert store.count("events", CQL) == ref
+        assert reg.counter("agg.cache.builds") == b0
+        assert reg.counter("agg.cache.misses") == m0
+    assert store.count("events", CQL) == ref  # back on, still exact
+
+
+def test_tiny_region_declines_but_stays_exact():
+    """A sub-cell region has no interior cells: the cost model declines
+    the pyramid (nothing to gain over the ordinary push-down) and the
+    ordinary paths answer — still exactly."""
+    reg = devstats.devstats_metrics()
+    store = _mkstore(device=True)
+    cql = "BBOX(geom, 10.0, 10.0, 10.4, 10.3)"
+    d0 = reg.counter("agg.cache.declined")
+    assert store.count("events", cql) == _ref_count(store, cql)
+    assert reg.counter("agg.cache.declined") > d0
+
+
+def test_non_containment_predicates_decline_the_pyramid():
+    """CONTAINS inverts the operands (the ROW must contain the literal —
+    false for every point row) and DWITHIN reaches outside the literal's
+    shape: the pyramid must decline both, never serve the extraction
+    cover's interior as the answer."""
+    store = _mkstore(device=True)
+    contains = f"CONTAINS(geom, {POLY})"
+    ref = len(store.query("events", contains))
+    assert ref == 0  # a point can never contain a polygon
+    assert store.count("events", contains) == ref
+    assert store.count("events", contains) == ref
+    dwithin = "DWITHIN(geom, POINT(10 10), 2000000, meters)"
+    ref_d = len(store.query("events", dwithin))
+    assert store.count("events", dwithin) == ref_d
+    assert store.count("events", dwithin) == ref_d
+
+
+def test_loose_bbox_never_shares_the_density_memo():
+    """A loose_bbox density grid and the exact grid answer different
+    contracts: the loose query must not hit (or fill) the exact memo."""
+    reg = devstats.devstats_metrics()
+    store = _mkstore(device=True)
+
+    def dq(loose=False):
+        q = Query.cql(BBOX)
+        q.hints["density"] = {
+            "envelope": (-90.0, -50.0, 90.0, 50.0), "width": 32, "height": 32,
+        }
+        if loose:
+            q.hints["loose_bbox"] = True
+        return q
+
+    store.query("events", dq())        # computes + memoizes the exact grid
+    h0 = reg.counter("agg.cache.hits")
+    store.query("events", dq())        # exact repeat: memo hit
+    assert reg.counter("agg.cache.hits") == h0 + 1
+    h1 = reg.counter("agg.cache.hits")
+    store.query("events", dq(loose=True))  # loose: must bypass the memo
+    assert reg.counter("agg.cache.hits") == h1
+
+
+def test_aggregate_columns_parity():
+    """aggregate() == the reference computed from the full uncached
+    query: counts and integer sums exact, float sums to 1 ulp."""
+    store = _mkstore(device=True)
+    got = store.aggregate("events", CQL, columns=["val", "w"])
+    res = store.query("events", CQL)
+    v = np.asarray(res.columns["val"])
+    w = np.asarray(res.columns["w"])
+    assert got["count"] == len(res)
+    assert got["columns"]["val"]["count"] == len(v)
+    assert got["columns"]["val"]["sum"] == int(v.sum())
+    assert got["columns"]["val"]["min"] == float(v.min())
+    assert got["columns"]["val"]["max"] == float(v.max())
+    assert np.isclose(got["columns"]["w"]["sum"], w.sum(), rtol=1e-12)
+    assert got["columns"]["w"]["min"] == float(w.min())
+    assert got["columns"]["w"]["max"] == float(w.max())
+    # hot repeat: identical summary (ints bit-identical)
+    again = store.aggregate("events", CQL, columns=["val", "w"])
+    assert again["columns"]["val"] == got["columns"]["val"]
+    assert again["count"] == got["count"]
+
+
+def test_aggregate_fallback_parity_on_non_spatial_filter():
+    """A filter the pyramid cannot serve (attribute predicate) answers
+    through the exact fallback with the same output shape."""
+    store = _mkstore(device=True)
+    got = store.aggregate("events", f"{CQL} AND val > 50", columns=["val"])
+    res = store.query("events", f"{CQL} AND val > 50")
+    v = np.asarray(res.columns["val"])
+    assert got["count"] == len(res)
+    assert got["columns"]["val"]["sum"] == int(v.sum())
+
+
+def test_aggregate_validates_columns():
+    store = _mkstore(device=False, n=50)
+    with pytest.raises(AggError):
+        store.aggregate("events", CQL, columns=["nope"])
+    store.create_schema(parse_spec("tagged", "tag:String,*geom:Point:srid=4326"))
+    with store.writer("tagged") as w:
+        w.write(["a", Point(1.0, 2.0)], fid="t0")
+    with pytest.raises(AggError):
+        store.aggregate("tagged", "INCLUDE", columns=["tag"])
+
+
+def test_stats_count_shortcut_parity():
+    store = _mkstore(device=True)
+    ref = _ref_count(store, CQL)
+    for _ in range(2):  # cold then hot
+        q = Query.cql(CQL)
+        q.hints["stats"] = "Count()"
+        res = store.query("events", q)
+        assert int(res.aggregate["stats"].count) == ref
+
+
+def test_density_memo_is_bit_identical():
+    store = _mkstore(device=True)
+
+    def dq():
+        q = Query.cql(CQL)
+        q.hints["density"] = {
+            "envelope": (-90.0, -50.0, 90.0, 50.0), "width": 64, "height": 64,
+        }
+        return q
+
+    first = store.query("events", dq()).aggregate["density"]
+    again = store.query("events", dq()).aggregate["density"]
+    assert np.array_equal(np.asarray(first), np.asarray(again))
+    # a different grid spec is a different key — never the wrong grid
+    q2 = dq()
+    q2.hints["density"]["width"] = 32
+    other = store.query("events", q2).aggregate["density"]
+    assert np.asarray(other).shape != np.asarray(first).shape
+
+
+# -- satellite: cache-answered push-downs still audit + receipt ---------------
+
+
+def test_cache_hit_writes_query_event_and_zero_dispatch_receipt():
+    """A push-down answered from cache must still write its QueryEvent
+    outcome row and a cost receipt — zero-dispatch (no bytes moved, no
+    recompiles), with agg.cache=hit on the query root span."""
+    store = _mkstore(device=True, audit_writer=InMemoryAuditWriter())
+    ring = trace.install(trace.InMemoryTraceExporter())
+    try:
+        def run():
+            q = Query.cql(CQL)
+            q.hints["stats"] = "Count()"
+            return store.query("events", q)
+
+        cold = run()
+        n0 = len(store.audit_writer.events)
+        hot = run()
+        assert int(hot.aggregate["stats"].count) == int(
+            cold.aggregate["stats"].count
+        )
+        evs = store.audit_writer.events
+        assert len(evs) == n0 + 1  # the cache hit wrote its outcome row
+        ev = evs[-1]
+        assert ev.outcome == "ok"
+        assert ev.scan_path == "agg-pyramid-stats"
+        # zero-dispatch receipt: a cache hit moved nothing over the link
+        assert ev.recompiles == 0
+        assert ev.h2d_bytes == 0 and ev.d2h_bytes == 0
+        root = ring.traces[-1]
+        assert root.name == "query"
+        assert root.attributes.get("agg.cache") == "hit"
+    finally:
+        trace.uninstall(ring)
+
+
+# -- invalidation -------------------------------------------------------------
+
+
+def _hot(store, cql=CQL):
+    """Prime the pyramid and return the (verified-correct) hot count."""
+    n = store.count("events", cql)
+    assert store.count("events", cql) == n
+    return n
+
+
+def test_write_invalidates_pyramid():
+    reg = devstats.devstats_metrics()
+    store = _mkstore(device=True)
+    n = _hot(store)
+    i0 = reg.counter("agg.cache.invalidated")
+    with store.writer("events") as w:
+        w.write([1, 0.5, T0, Point(0.0, 0.0)], fid="inside")   # interior
+        w.write([2, 0.5, T0, Point(120.0, 80.0)], fid="out")   # outside
+    assert reg.counter("agg.cache.invalidated") > i0
+    assert store.count("events", CQL) == n + 1
+    assert store.count("events", CQL) == _ref_count(store, CQL)
+
+
+def test_delete_features_invalidates_pyramid():
+    store = _mkstore(device=True)
+    n = _hot(store)
+    # e0 may be inside or outside the polygon: compare against the ref
+    store.delete_features("events", ["e0", "e1", "e2"])
+    assert store.count("events", CQL) == _ref_count(store, CQL)
+    assert store.count("events", CQL) <= n
+
+
+def test_compact_invalidates_pyramid():
+    store = _mkstore(device=True)
+    store.delete_features("events", [f"e{i}" for i in range(100)])
+    n = _hot(store)
+    store.compact("events")
+    assert store.count("events", CQL) == n  # same rows, fresh generation
+    assert store.count("events", CQL) == _ref_count(store, CQL)
+
+
+def test_delete_schema_drops_pyramid_entries():
+    store = _mkstore(device=True)
+    _hot(store)
+    cache = store._agg_cache
+    assert len(cache) > 0
+    store.delete_schema("events")
+    assert len(cache) == 0  # no stale entry survives the type
+    # a recreated type with different rows answers ITS answer, never
+    # the deleted incarnation's
+    store.create_schema(parse_spec("events", SPEC))
+    with store.writer("events") as w:
+        w.write([1, 0.1, T0, Point(0.0, 0.0)], fid="only")
+    assert store.count("events", CQL) == 1
+    assert store.count("events", CQL) == 1
+
+
+def test_sharded_worker_write_invalidates():
+    """A write routed through a ShardedDataStore worker must invalidate
+    the per-worker pyramids: the merged coordinator count reflects it
+    immediately (the PR 7 write-generation rule covers aggregates)."""
+    data = [
+        (f"f{i:04d}", [int(i), 0.5, T0,
+                       Point(float(x), float(y))])
+        for i, (x, y) in enumerate(
+            zip(np.random.default_rng(3).uniform(-90, 90, 300),
+                np.random.default_rng(4).uniform(-50, 50, 300))
+        )
+    ]
+    sh = ShardedDataStore(num_shards=3, replicas=1)
+    sh.create_schema(parse_spec("events", SPEC))
+    with sh.writer("events") as w:
+        for fid, values in data:
+            w.write(values, fid=fid)
+    base = _mkstore(device=False, n=0)
+    with base.writer("events") as w:
+        for fid, values in data:
+            w.write(values, fid=fid)
+    n = sh.count("events", CQL)
+    assert n == base.count("events", CQL)
+    assert sh.count("events", CQL) == n  # hot
+    with sh.writer("events") as w:
+        w.write([999, 0.9, T0, Point(0.0, 0.0)], fid="new-inside")
+    assert sh.count("events", CQL) == n + 1
+    # sharded stats shortcut agrees with the merged count
+    q = Query.cql(CQL)
+    q.hints["stats"] = "Count()"
+    assert int(sh.query("events", q).aggregate["stats"].count) == n + 1
+
+
+def test_sharded_count_breaker_reroute_and_crisp_exhaustion():
+    """The merged pyramid count runs under the PR 6 shard envelope: an
+    open primary breaker reroutes that partition's count to the replica
+    with the same exact answer; every placement refused raises a crisp
+    ShardUnavailable — never a partial sum."""
+    from geomesa_tpu.utils.audit import ShardUnavailable
+    from geomesa_tpu.utils.breaker import CircuitBreaker
+
+    rng = np.random.default_rng(7)
+    sh = ShardedDataStore(num_shards=3, replicas=1)
+    sh.create_schema(parse_spec("events", SPEC))
+    with sh.writer("events") as w:
+        for i in range(300):
+            w.write(
+                [int(i), 0.5, T0,
+                 Point(float(rng.uniform(-90, 90)), float(rng.uniform(-50, 50)))],
+                fid=f"f{i}",
+            )
+    n = sh.count("events", CQL)
+    assert n == len(sh.query("events", CQL))
+    # open one partition's PRIMARY: the replica serves, answer unchanged
+    p = next(iter(sh._partitions["events"]))
+    primary = sh.placement.primary(p)
+    b = CircuitBreaker(f"shard.{primary}", failures=1, window_s=300.0,
+                       cooldown_s=300.0)
+    sh._breakers[primary] = b
+    b.record_failure()  # open
+    assert b.state == "open"
+    assert sh.count("events", CQL) == n
+    # every placement open -> crisp ShardUnavailable, never partial
+    for i in range(len(sh._breakers)):
+        bb = CircuitBreaker(f"shard.{i}", failures=1, window_s=300.0,
+                            cooldown_s=300.0)
+        sh._breakers[i] = bb
+        bb.record_failure()
+    with pytest.raises(ShardUnavailable):
+        sh.count("events", CQL)
+
+
+def test_ttl_expiry_releases_device_arrays():
+    """An expired-TTL entry releases its device arrays: the entry leaves
+    the cache, its pyramid's device stack is evicted, and the HBM
+    live-bytes gauge drops."""
+    reg = devstats.devstats_metrics()
+
+    def hbm_live():
+        # the HBM gauge is a sampled gauge_fn: snapshot() evaluates it
+        _c, gauges, _t, _tot = reg.snapshot()
+        return gauges["device.hbm.live_bytes"]
+
+    store = _mkstore(device=True)
+    with properties(geomesa_agg_cache_ttl="50 ms"):
+        _hot(store)
+        cache = store._agg_cache
+        assert len(cache) >= 1
+        pyr = next(
+            e for e in cache._entries.values() if hasattr(e, "counts")
+        )
+        assert pyr._dev is not None  # HBM-resident while live
+        before = hbm_live()
+        time.sleep(0.1)
+        x0 = reg.counter("agg.cache.expired")
+        assert cache.get(("probe",), 0.05) is None  # sweep runs on get
+        assert reg.counter("agg.cache.expired") > x0
+        assert len(cache) == 0
+        assert pyr._dev is None  # device stack evicted with the entry
+        del pyr
+        gc.collect()
+        assert hbm_live() < before
+
+
+def test_cache_bytes_cap_evicts_lru():
+    reg = devstats.devstats_metrics()
+    store = _mkstore(device=True, n=500)
+    store.create_schema(parse_spec("other", SPEC))
+    rng = np.random.default_rng(9)
+    store._insert_columns(store.get_schema("other"), {
+        "__fid__": np.array([f"o{i}" for i in range(500)], dtype=object),
+        "val": rng.integers(0, 9, 500).astype(np.int32),
+        "w": rng.uniform(0.0, 1.0, 500),
+        "geom__x": rng.uniform(-90, 90, 500),
+        "geom__y": rng.uniform(-50, 50, 500),
+        "dtg": np.full(500, T0, dtype=np.int64),
+    })
+    # each finest level alone is 8 * 2^(2*8) = 512KiB: a 600KB cap holds
+    # exactly one pyramid, so the second type's build evicts the first
+    with properties(geomesa_agg_cache_bytes="600KB"):
+        e0 = reg.counter("agg.cache.evicted")
+        assert store.count("events", CQL) == _ref_count(store, CQL)
+        n_other = len(store.query("other", CQL))
+        assert store.count("other", CQL) == n_other
+        assert reg.counter("agg.cache.evicted") > e0
+        assert len(store._agg_cache) == 1
+        # the evicted type still answers exactly (it just rebuilds)
+        assert store.count("events", CQL) == _ref_count(store, CQL)
+
+
+# -- failure envelope (chaos) -------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("schedule", [
+    "agg.build:error=1.0",
+    "agg.build:drop=0.5",
+    "agg.build:error=0.5,device.dispatch:error=0.3",
+])
+def test_agg_parity_under_faults(schedule, seed):
+    """Any error/drop schedule over agg.build (and the device boundary
+    under it) may cost latency — never correctness: count, aggregate(),
+    and the density grid are identical to the fault-free run."""
+    base = _mkstore(device=True, seed=seed, n=1500)
+    want_n = base.count("events", CQL)
+    want_agg = base.aggregate("events", CQL, columns=["val"])
+
+    def dq():
+        q = Query.cql(CQL)
+        q.hints["density"] = {
+            "envelope": (-90.0, -50.0, 90.0, 50.0), "width": 32, "height": 32,
+        }
+        return q
+
+    want_grid = np.asarray(base.query("events", dq()).aggregate["density"])
+    store = _mkstore(device=True, seed=seed, n=1500)
+    with faults.inject(schedule, seed=seed):
+        assert store.count("events", CQL) == want_n
+        assert store.count("events", CQL) == want_n
+        got = store.aggregate("events", CQL, columns=["val"])
+        assert got["count"] == want_agg["count"]
+        assert got["columns"]["val"] == want_agg["columns"]["val"]
+        grid = np.asarray(store.query("events", dq()).aggregate["density"])
+        assert np.array_equal(grid, want_grid)
+    # fault-free afterwards: the degraded store recovers to the cache
+    assert store.count("events", CQL) == want_n
+
+
+@pytest.mark.chaos
+def test_agg_build_crash_dies_crisply():
+    store = _mkstore(device=True, n=800)
+    with faults.inject("agg.build:crash", seed=1):
+        with pytest.raises(faults.SimulatedCrash):
+            store.count("events", CQL)
+    # the store still answers (and exactly) afterwards
+    assert store.count("events", CQL) == _ref_count(store, CQL)
+
+
+@pytest.mark.chaos
+def test_agg_build_latency_bounded_by_deadline():
+    """A latency storm on the build costs at most the query budget: the
+    count either answers exactly or dies with a crisp QueryTimeout."""
+    base = _mkstore(device=True, n=800)
+    want = base.count("events", CQL)
+    store = _mkstore(device=True, n=800, query_timeout_s=0.15)
+    rules = [faults.FaultRule("agg.build", "latency", latency_s=0.4)]
+    with faults.inject(rules=rules):
+        t0 = time.perf_counter()
+        try:
+            assert store.count("events", CQL) == want
+        except QueryTimeout:
+            pass  # crisp, never a wrong count
+        assert time.perf_counter() - t0 < 5.0
+
+
+# -- web surface --------------------------------------------------------------
+
+
+def test_web_stats_aggregate_endpoint():
+    from geomesa_tpu.web import GeoMesaServer
+
+    store = _mkstore(device=True, n=600)
+    ref = store.aggregate("events", CQL, columns=["val"])
+    with GeoMesaServer(store) as url:
+        qs = urllib.parse.urlencode(
+            {"name": "events", "cql": CQL, "columns": "val"}
+        )
+        got = json.loads(
+            urllib.request.urlopen(url + "/stats/aggregate?" + qs).read()
+        )
+        assert got["count"] == ref["count"]
+        assert got["columns"]["val"]["sum"] == ref["columns"]["val"]["sum"]
+        # unknown column answers 400, not 500
+        qs_bad = urllib.parse.urlencode(
+            {"name": "events", "cql": CQL, "columns": "nope"}
+        )
+        try:
+            urllib.request.urlopen(url + "/stats/aggregate?" + qs_bad)
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+def test_debug_device_agg_block():
+    from geomesa_tpu.ops.pyramid import agg_debug
+
+    store = _mkstore(device=True, n=600)
+    _hot(store)
+    dbg = agg_debug()
+    assert dbg["cache"]["entries"] >= 1
+    assert dbg["cache"]["bytes"] > 0
+    assert dbg["cache"]["hits"] >= 1
+    assert dbg["pyramid"].get("rows") is not None

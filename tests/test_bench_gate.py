@@ -86,6 +86,49 @@ def test_hit_drift_is_reported_as_correctness():
     assert regs and "CORRECTNESS" in regs[0]
 
 
+def _agg(hot_ms=1.5, cold_ms=300.0, count=7000, hits=6, speedup=None):
+    return {
+        "reps": 6, "cold_ms": cold_ms, "hot_ms": hot_ms,
+        "speedup": speedup if speedup is not None
+        else round(cold_ms / hot_ms, 1),
+        "count": count, "hits": hits, "path": "agg-pyramid-stats",
+    }
+
+
+def test_agg_leg_clean_and_bands():
+    base, cur = _artifact(), _artifact()
+    base["agg"], cur["agg"] = _agg(), _agg(hot_ms=1.8, cold_ms=310.0)
+    assert bench_gate.compare(base, cur) == []
+    # hot wall past the band
+    slow = _artifact()
+    slow["agg"] = _agg(hot_ms=4.0)
+    assert any("agg hot_ms" in r for r in bench_gate.compare(base, slow))
+    # count drift is correctness, not perf
+    drift = _artifact()
+    drift["agg"] = _agg(count=6999)
+    assert any("CORRECTNESS" in r for r in bench_gate.compare(base, drift))
+    # a lost cache shows as dropped hits
+    cold = _artifact()
+    cold["agg"] = _agg(hits=0)
+    assert any("agg hits dropped" in r for r in bench_gate.compare(base, cold))
+    # speedup floor: hot must stay >= 10x cheaper than first touch
+    flat = _artifact()
+    flat["agg"] = _agg(hot_ms=40.0 * 4, cold_ms=300.0, speedup=1.9)
+    assert any("speedup below floor" in r for r in bench_gate.compare(base, flat))
+    # baselines recorded before the leg skip it
+    old = _artifact()
+    assert bench_gate.compare(old, cur) == []
+
+
+def test_agg_leg_survives_injected_slowdown():
+    art = _artifact()
+    art["agg"] = _agg()
+    out = bench_gate.inject_slowdown(art, 2.0)
+    # uniform scaling: both sides move, the self-relative ratio holds
+    assert out["agg"]["hot_ms"] == pytest.approx(art["agg"]["hot_ms"] * 2)
+    assert out["agg"]["cold_ms"] == pytest.approx(art["agg"]["cold_ms"] * 2)
+
+
 def test_config_mismatch_refuses_to_compare():
     cur = _artifact()
     cur["config"]["n"] = 100

@@ -11,6 +11,7 @@ from geomesa_tpu.geom.base import Point
 from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
 from geomesa_tpu.schema.featuretype import parse_spec
 from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils.config import properties
 
 SPEC = "dtg:Date,kind:String,cnt:Int,*geom:Point:srid=4326"
 BASE = int(np.datetime64("2026-01-01T00:00:00", "ms").astype("int64"))
@@ -100,9 +101,13 @@ def test_count_respects_limit_and_failure_trip(monkeypatch):
 
     monkeypatch.setattr(ds.executor, "count_scan", boom)
     monkeypatch.delenv("GEOMESA_COUNT_DEVICE", raising=False)
-    want = len(ds.query("t", CQLS[0]))
-    for _ in range(3):
-        assert ds.count("t", CQLS[0]) == want
+    # the aggregate pyramid would answer this spatial-only count before
+    # count_scan ever runs (ops/pyramid.py) — this test is ABOUT the
+    # device count path's failure trip, so switch the cache off
+    with properties(geomesa_agg_enabled="false"):
+        want = len(ds.query("t", CQLS[0]))
+        for _ in range(3):
+            assert ds.count("t", CQLS[0]) == want
     assert calls["n"] == 1  # tripped after the first failure
 
 
@@ -211,8 +216,12 @@ def test_poly_count_device_parity():
             "intersects(geom, POLYGON ((-38 -38, 28 -33, 8 28, -33 18, "
             "-38 -38)))",
         ]
-        for cql in cqls:
-            assert ds.count("t", cql) == len(ds.query("t", cql)), cql
+        # the aggregate pyramid would answer the spatial-only counts
+        # before _count_poly_scan runs (ops/pyramid.py) — this test is
+        # ABOUT the device ray-cast path, so switch the cache off
+        with properties(geomesa_agg_enabled="false"):
+            for cql in cqls:
+                assert ds.count("t", cql) == len(ds.query("t", cql)), cql
     finally:
         exm.TpuScanExecutor._count_poly_scan = orig
     assert calls["n"] >= len(cqls) - 1
