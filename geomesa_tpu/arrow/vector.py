@@ -9,7 +9,7 @@ mapping + attribute readers/writers), geomesa-arrow-jts PointVector.java
 from __future__ import annotations
 
 import io
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
@@ -171,6 +171,35 @@ def write_features(
     finally:
         if own:
             out.close()
+
+
+def iter_ipc(batches) -> Iterator[bytes]:
+    """RecordBatch iterator -> Arrow IPC stream BYTE chunks, emitted
+    incrementally: the first chunk (schema header + first batch) is
+    yielded as soon as the first batch exists, while later batches are
+    still being produced — the wire half of ``TpuDataStore.query_stream``
+    (web.py frames each chunk as one HTTP chunked-transfer frame). The
+    final chunk carries the IPC end-of-stream marker, so
+    ``pa.ipc.open_stream`` over the concatenation reads a complete,
+    well-formed stream."""
+    import io as _io
+
+    buf = _io.BytesIO()
+    writer = None
+    for b in batches:
+        if writer is None:
+            writer = pa.ipc.new_stream(buf, b.schema)
+        writer.write_batch(b)
+        chunk = buf.getvalue()
+        buf.seek(0)
+        buf.truncate(0)
+        if chunk:
+            yield chunk
+    if writer is not None:
+        writer.close()
+        tail = buf.getvalue()
+        if tail:
+            yield tail
 
 
 def read_features(source) -> tuple:

@@ -454,6 +454,46 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh,
     return fn
 
 
+_EXACT_MASK_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+
+
+def _exact_mask_batch_fn(has_time: bool, q: int, mode: str, mesh):
+    """Q stacked exact predicates -> ONE full-table packed bitmap
+    u8[q, n/8] in a single segment sweep — the coalescer's kernel
+    (parallel/batch.py).
+
+    The per-query RLE/span-framing machinery the other batch layouts pay
+    (cumsum + bounded-nonzero per query) dominates their wall at serving
+    sizes: ~60-130 ms/query vs ~0.6 ms for the mask compare itself at
+    200k rows on the CPU gate box. Stacking the predicate descriptors
+    and emitting the raw [N, rows] mask packed to bits skips ALL of it:
+    one vmapped limb-compare pass over the resident columns, one
+    packbits, n/8 bytes per query over the link, and the host demuxes
+    each query's rows with the native ctz decoder (~1 ms per 1 MB).
+    ``q`` is the PADDED query count (pow2 buckets keep jit shapes
+    bounded); pad rows repeat the last descriptor and are never decoded."""
+    key = (has_time, q, mode, mesh)
+    fn = _EXACT_MASK_BATCH_FNS.get(key)
+    if fn is None:
+        body = _exact_mask_body(has_time, mode, mesh)
+        body = _gathered(body, mesh)
+        nrow, _nrep = _exact_arg_counts(has_time, False)
+
+        def run(*args):
+            rows, rep = args[:nrow], args[nrow:]
+            if has_time:
+                m = jax.vmap(lambda box, win: body(*rows, box, win))(
+                    rep[0], rep[1]
+                )
+            else:
+                m = jax.vmap(lambda box: body(*rows, box))(rep[0])
+            return jnp.packbits(m, axis=1)
+
+        fn = instrumented_jit("exact_mask_batch", run)
+        _EXACT_MASK_BATCH_FNS[key] = fn
+    return fn
+
+
 _EXACT_COUNT_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
@@ -792,15 +832,16 @@ class _ShardBitmapBatch:
 
     def _fetch(self):
         if self._np is None:
-            t1 = _trace_fetch_begin(self.trace, self.hdr, self.bits)
-            if not getattr(self.hdr, "is_fully_addressable", True):
-                self.local_shards = {
-                    int(s.index[0].start or 0) // self.q
-                    for s in self.hdr.addressable_shards
-                }
-            h = _np_local(self.hdr).reshape(self.n_shards, self.q, 4)
-            b = _np_local(self.bits).reshape(self.n_shards, self.q, -1)
-            _trace_fetch_end(self.trace, t1)
+            with _shared_fetch_span(self.q):
+                t1 = _trace_fetch_begin(self.trace, self.hdr, self.bits)
+                if not getattr(self.hdr, "is_fully_addressable", True):
+                    self.local_shards = {
+                        int(s.index[0].start or 0) // self.q
+                        for s in self.hdr.addressable_shards
+                    }
+                h = _np_local(self.hdr).reshape(self.n_shards, self.q, 4)
+                b = _np_local(self.bits).reshape(self.n_shards, self.q, -1)
+                _trace_fetch_end(self.trace, t1)
             self._np = (h, b)
             self.hdr = self.bits = None
             if self.seg is not None:
@@ -959,6 +1000,65 @@ def _decode_full_bitmap_rows(packed: np.ndarray, n: int) -> np.ndarray:
     return rows
 
 
+def _shared_fetch_span(q: int):
+    """Span around a BATCHED buffer fetch serving ``q`` queries. The
+    blocked wall of the whole shared sweep lands on whichever query
+    resolves first, so the span carries ``shared_q`` — the slow-query
+    batch log (store/datastore.py ``_log_slow_batch``) apportions the
+    wait across the members that rode the sweep instead of blaming the
+    first member's span tree for all of it."""
+    return trace.span("device.fetch.shared", shared_q=int(q))
+
+
+class _MaskBatch:
+    """One coalesced mask-batch buffer: u8[q, n/8] full-table packed
+    bitmaps (see _exact_mask_batch_fn), fetched once. ``prefetch``-able:
+    the coalescer resolves the shared D2H inside its OWN cost collector
+    so the sweep's bytes split across members instead of landing in the
+    first resolver's receipt."""
+
+    __slots__ = ("buf", "n_rows", "q_real", "_np", "trace")
+
+    def __init__(self, buf, n_rows: int, q_real: int, trace=None):
+        self.buf = buf
+        self.n_rows = n_rows  # real (unpadded) segment rows
+        self.q_real = q_real
+        self._np = None
+        self.trace = trace
+
+    def _fetch(self):
+        if self._np is None:
+            with _shared_fetch_span(self.q_real):
+                t1 = _trace_fetch_begin(self.trace, self.buf)
+                self._np = _np_local(self.buf)
+                _trace_fetch_end(self.trace, t1)
+            self.buf = None
+        return self._np
+
+
+class _PendingMaskHits:
+    """One query's row of a coalesced mask batch: decode the full-table
+    packed bitmap with the native ctz decoder. No span framing, no
+    capacity escalation — the bitmap covers every row by construction."""
+
+    __slots__ = ("batch", "i", "_rows")
+
+    def __init__(self, batch: "_MaskBatch", i: int):
+        self.batch = batch
+        self.i = i
+        self._rows: Optional[np.ndarray] = None
+
+    def prefetch(self) -> None:
+        self.batch._fetch()
+
+    def rows(self) -> np.ndarray:
+        if self._rows is None:
+            self._rows = _decode_full_bitmap_rows(
+                self.batch._fetch()[self.i], self.batch.n_rows
+            )
+        return self._rows
+
+
 class _BitmapBatch:
     """One bitmap batch (headers + span-framed bitmaps), fetched once.
     Remembers the stream's widest span on the segment (once per batch)."""
@@ -975,9 +1075,10 @@ class _BitmapBatch:
 
     def _fetch(self):
         if self._np is None:
-            t1 = _trace_fetch_begin(self.trace, self.hdr, self.bits)
-            self._np = (_np_local(self.hdr), _np_local(self.bits))
-            _trace_fetch_end(self.trace, t1)
+            with _shared_fetch_span(self.hdr.shape[0]):
+                t1 = _trace_fetch_begin(self.trace, self.hdr, self.bits)
+                self._np = (_np_local(self.hdr), _np_local(self.bits))
+                _trace_fetch_end(self.trace, t1)
             self.hdr = self.bits = None
             if self.seg is not None:
                 h = self._np[0]
@@ -1071,9 +1172,10 @@ class _PackedBatch:
 
     def _fetch(self):
         if self._np is None:
-            t1 = _trace_fetch_begin(self.trace, self.buf)
-            flat = _np_local(self.buf)
-            _trace_fetch_end(self.trace, t1)
+            with _shared_fetch_span(self.q_real):
+                t1 = _trace_fetch_begin(self.trace, self.buf)
+                flat = _np_local(self.buf)
+                _trace_fetch_end(self.trace, t1)
             self.trace = None  # escalation refetch must not re-append
             self.buf = None
             hlen = self.q * (3 + 3 * PACK_XCAP)
@@ -1188,9 +1290,10 @@ class _BatchRows:
 
     def row(self, i: int) -> np.ndarray:
         if self._np is None:
-            t1 = _trace_fetch_begin(self.trace, self.buf)
-            self._np = _np_local(self.buf)
-            _trace_fetch_end(self.trace, t1)
+            with _shared_fetch_span(self.buf.shape[0]):
+                t1 = _trace_fetch_begin(self.trace, self.buf)
+                self._np = _np_local(self.buf)
+                _trace_fetch_end(self.trace, t1)
             self.buf = None  # release the device allocation immediately
         return self._np[i]
 
@@ -2851,6 +2954,35 @@ class DeviceSegment:
                 )
         return out
 
+    def dispatch_exact_mask_batch(
+        self, descs: Sequence[tuple], has_time: bool
+    ) -> List["_PendingMaskHits"]:
+        """Q exact predicates, ONE full-table sweep, ONE packed
+        u8[q, n/8] bitmap back — no span framing, no RLE, no capacity
+        escalation (the coalescer's kernel; see _exact_mask_batch_fn).
+        ``descs`` = [(box_np u32[8], win_np u32[4]|None)], padded to the
+        pow2 query bucket by repeating the last descriptor."""
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        q = len(descs)
+        qpad = _pow2_at_least(q, 4)
+        boxes_np = np.stack([d[0] for d in descs] + [descs[-1][0]] * (qpad - q))
+        boxes_dev = replicate(self.mesh, boxes_np)
+        if has_time:
+            wins_np = np.stack(
+                [d[1] for d in descs] + [descs[-1][1]] * (qpad - q)
+            )
+            wins_dev = replicate(self.mesh, wins_np)
+        else:
+            wins_dev = None
+        args = self._exact_args(boxes_dev, wins_dev, has_time)
+        btrace = _batch_trace(self, args, qpad, "mask", 0)
+        buf = _exact_mask_batch_fn(has_time, qpad, mode, self.mesh)(*args)
+        if btrace is not None:
+            btrace["out_bytes"] = int(buf.nbytes)
+        _start_d2h(buf)
+        batch = _MaskBatch(buf, self.n, q, trace=btrace)
+        return [_PendingMaskHits(batch, i) for i in range(q)]
+
     def load_poly(self, table: IndexTable) -> bool:
         """Exact limbs + f32 coords for the banded polygon path (point
         z-indices only)."""
@@ -3414,6 +3546,16 @@ class _PendingScan:
     def __init__(self, pending, exact: bool = False):
         self.pending = pending
         self.exact = exact
+
+    def prefetch(self) -> None:
+        """Resolve any prefetchable shared device buffers NOW (coalescer
+        seam): the shared sweep's D2H lands in the CALLER's cost
+        collector instead of whichever member resolves first. Pendings
+        without a prefetch hook resolve lazily as before."""
+        for _seg, ph in self.pending:
+            fn = getattr(ph, "prefetch", None)
+            if fn is not None:
+                fn()
 
     def __iter__(self):
         for seg, ph in self.pending:
@@ -4499,6 +4641,98 @@ class TpuScanExecutor:
                 # half-open probe slot must not stay latched (non-timeout
                 # failures reach degrade() in the caller, which resolves
                 # the probe via record_failure)
+                self.breaker.cancel_probe()
+            raise
+
+    def dispatch_coalesced(self, items: Sequence[Tuple[IndexTable, QueryPlan]]):
+        """Dispatch a COALESCED query group; returns {id(plan): scan | None}.
+
+        The admission-point coalescer's seam (parallel/batch.py): plans
+        whose full filter reduces to one exact box(+window) predicate on
+        the same z-index table stack their compiled descriptors into ONE
+        [N, rows] packed-mask sweep per segment (dispatch_exact_mask_batch
+        — no per-query RLE/span framing, the whole point of coalescing),
+        and everything else takes exactly the dispatch_many path a
+        query_many batch would. Same breaker envelope as dispatch_many:
+        an open circuit answers the whole group from the host path."""
+        out: Dict[int, object] = {}
+        if not self.breaker.allow():
+            trace.event("breaker.short_circuit", breaker=self.breaker.name)
+            return out
+        try:
+            mask_groups: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
+            rest: List[Tuple[IndexTable, QueryPlan]] = []
+            seen: set = set()
+            # the stacked-mask kernel compiles for the single-device
+            # layout; multi-chip meshes keep the shard-extract batch
+            # paths of dispatch_many (the `rest` route below)
+            single_device = self.mesh.devices.size == 1
+            for table, plan in items:
+                if id(plan) in seen:
+                    continue
+                seen.add(id(plan))
+                deadline.check("device.dispatch")
+                if not single_device or not self._scan_eligible(table, plan):
+                    rest.append((table, plan))
+                    continue
+                seek = self._seek_scan(table, plan)
+                if seek is not None:
+                    # the cost chooser picked a selective host seek:
+                    # cheaper than ANY full sweep, coalesced or not
+                    out[id(plan)] = seek
+                    continue
+                # NOT gated on _exact_device_enabled (unlike the single/
+                # RLE-batch exact paths): that gate exists because on the
+                # CPU backend the wider limb columns cost more than the
+                # host post-filter saves — but the stacked mask also
+                # deletes the per-query RLE/span extraction, which IS the
+                # dominant sweep cost there, so coalesced stacking wins
+                # on every backend
+                shape = self._exact_predicate_shape(table, plan)
+                desc = None if shape is None else self._shape_limbs(shape)
+                if desc is None:
+                    rest.append((table, plan))
+                    continue
+                has_time = desc[1] is not None
+                key = (id(table), has_time)
+                if key not in mask_groups:
+                    mask_groups[key] = (table, has_time, [])
+                mask_groups[key][2].append((id(plan), plan, desc))
+            for table, has_time, lst in mask_groups.values():
+                dev = self.device_index(table)
+                if len(lst) < 2 or not dev.segments or not all(
+                    seg.load_exact(table) for seg in dev.segments
+                ):
+                    # a lone member (or an unloadable mirror) gains
+                    # nothing from the mask layout: the ordinary batch/
+                    # single dispatch answers
+                    rest.extend((table, plan) for _pid, plan, _d in lst)
+                    continue
+                for i in range(0, len(lst), self.BATCH_MAX):
+                    chunk = lst[i : i + self.BATCH_MAX]
+                    deadline.check("device.dispatch")
+                    descs = [d for _pid, _p, d in chunk]
+                    per_seg = [
+                        seg.dispatch_exact_mask_batch(descs, has_time)
+                        for seg in dev.segments
+                    ]
+                    for qi, (pid, _plan, _d) in enumerate(chunk):
+                        out[pid] = _PendingScan(
+                            [
+                                (seg, phs[qi])
+                                for seg, phs in zip(dev.segments, per_seg)
+                            ],
+                            exact=True,
+                        )
+            if rest:
+                self._dispatch_many_batches(rest, out)
+            return out
+        except Exception as e:
+            from geomesa_tpu.utils.audit import QueryTimeout
+
+            if isinstance(e, QueryTimeout):
+                # budget death is no verdict on the link (see
+                # dispatch_many): release a half-open probe slot
                 self.breaker.cancel_probe()
             raise
 

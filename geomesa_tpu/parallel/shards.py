@@ -395,6 +395,19 @@ class ShardedDataStore(TpuDataStore):
     bounded) -> gather -> merge. See the module docstring for the
     robustness contract."""
 
+    # no coordinator-level coalescing (parallel/batch.py): _execute here
+    # is a thread-pooled fan-out that already runs members' shard scans
+    # concurrently — serializing members behind one group leader would
+    # trade that parallelism for nothing. The WORKER stores, where the
+    # device sweeps actually execute, coalesce their own admitted scans.
+    COALESCE_QUERIES = False
+    # the coordinator's LOCAL tables are intentionally empty (rows live
+    # in the shard workers), so query_stream must not scan them
+    # incrementally — it streams via the overridden _execute fan-out
+    # (gather, then chunk: correct answers, no first-byte win; per-shard
+    # incremental merge is a named ROADMAP follow-up)
+    STREAMS_LOCAL_PARTS = False
+
     def __init__(
         self,
         num_shards: Optional[int] = None,

@@ -252,6 +252,22 @@ class FeatureWriter:
 class TpuDataStore:
     """The datastore facade: create_schema / writer / query / delete."""
 
+    # cross-query coalescing at the admission point (parallel/batch.py).
+    # Subclasses whose _execute is NOT a local device scan opt out: the
+    # sharded coordinator's fan-out is already concurrent across shards,
+    # and serializing members behind one leader would cost parallelism
+    # instead of sharing a sweep (its WORKER stores coalesce, where the
+    # device sweeps actually run).
+    COALESCE_QUERIES = True
+    # whether query_stream may scan this store's LOCAL tables
+    # incrementally. Subclasses whose rows live elsewhere (the sharded
+    # coordinator's local tables are intentionally empty — data is
+    # routed to shard workers) MUST opt out, or the streamable branch
+    # would stream zero rows from the empty local tables; with the
+    # opt-out they stream via the overridden _execute (materialize,
+    # then chunk) with correct answers and no first-byte win.
+    STREAMS_LOCAL_PARTS = True
+
     def __init__(
         self,
         metadata: Optional[Metadata] = None,
@@ -935,6 +951,34 @@ class TpuDataStore:
                     # than its deadline (± one fault-point granularity)
                     with deadline_mod.budget(self.query_timeout_s):
                         with self.admission.admit():
+                            # cross-query coalescing (parallel/batch.py):
+                            # STRICTLY after admit — shedding semantics
+                            # untouched — concurrently admitted queries
+                            # of this type may ride one stacked device
+                            # sweep. None = run the solo path below
+                            # (quiet store, disabled, or seam degraded:
+                            # identical answers either way).
+                            out = self._coalesced(name, ft, query)
+                            if out is not None:
+                                plan = out.plan
+                                if root.recording:
+                                    root.set_attr("hits", len(out.result))
+                                    root.set_attr(
+                                        "scan_path",
+                                        self._collect_scan_path(plan),
+                                    )
+                                    root.set_attr("device", out.receipt)
+                                    root.set_attr("coalesced", out.group_n)
+                                if (
+                                    self.audit_writer is not None
+                                    or self.metrics is not None
+                                ):
+                                    self._audit(
+                                        name, query, plan, out.result,
+                                        t_admit, t_admit + out.plan_s,
+                                        out.receipt,
+                                    )
+                                return out.result
                             # device cost receipt baseline: taken BEFORE
                             # preparation so a lazy store's replay uploads
                             # attribute to the query that paid for them
@@ -990,6 +1034,41 @@ class TpuDataStore:
         """Pre-execution hook inside the query's root span — subclasses
         that must materialize state first (FsDataStore's lazy partition
         replay) override this so that work lands ON the query's trace."""
+
+    # -- cross-query coalescing (parallel/batch.py) --------------------------
+
+    def _coalescer_obj(self):
+        """The per-store coalescer, created lazily (GIL-atomic
+        setdefault, the _agg_cache_obj rule: two concurrent firsts must
+        agree on ONE instance or their groups could never meet)."""
+        co = getattr(self, "_coalescer", None)
+        if co is None:
+            from geomesa_tpu.parallel.batch import QueryCoalescer
+
+            co = self.__dict__.setdefault("_coalescer", QueryCoalescer(self))
+        return co
+
+    def _coalesced(self, name: str, ft, query: Query):
+        """Hand one ADMITTED query to the coalescer when coalescing can
+        actually help. Returns a batch.MemberOutcome, or None for the
+        solo path. Gates, cheapest first: the class opt-out, an executor
+        without the stacked-sweep seam, the geomesa.batch.* knobs, and —
+        the latency guard — actual concurrency (another query in flight,
+        or a group already gathering): a quiet store's queries never pay
+        the window."""
+        if not self.COALESCE_QUERIES:
+            return None
+        if getattr(self.executor, "dispatch_coalesced", None) is None:
+            return None
+        from geomesa_tpu.parallel.batch import batch_knobs
+
+        enabled, _window_s, _max_q = batch_knobs()
+        if not enabled:
+            return None
+        co = self._coalescer_obj()
+        if self.admission.inflight < 2 and not co.gathering(name):
+            return None
+        return co.submit(name, ft, query)
 
     def query_join(
         self,
@@ -1158,7 +1237,16 @@ class TpuDataStore:
         overhead — shared preparation (a lazy store's partition replay)
         plus pipelined planning/dispatch, i.e. everything outside the
         per-query spans — over budget dumps the batch tree. Per-query
-        trees log themselves via _log_slow_query."""
+        trees log themselves via _log_slow_query.
+
+        Members that rode a coalesced sweep get PER-MEMBER attribution:
+        the shared batched-buffer fetch blocks inside whichever member
+        resolves first, so that member's raw span wall carries the whole
+        sweep. Each ``device.fetch.shared`` span records how many
+        queries its buffer served (``shared_q``); the log re-attributes
+        each member's wall as raw minus the (q-1)/q share of shared
+        fetches that belong to its sweep-mates, so "which member was
+        actually slow" stays answerable."""
         import logging as _logging
 
         if self.slow_query_s is None or not batch.recording:
@@ -1168,12 +1256,235 @@ class TpuDataStore:
         )
         if own_ms < self.slow_query_s * 1000.0:
             return
+        members = []
+        for i, c in enumerate(
+            c for c in batch.children if c.name == "query"
+        ):
+            shared_ms = sum(
+                s.duration_ms * (s.attributes.get("shared_q", 1) - 1)
+                / max(s.attributes.get("shared_q", 1), 1)
+                for s in c.find("device.fetch.shared")
+            )
+            attributed = c.duration_ms - shared_ms
+            members.append(
+                f"  member {i}: {attributed:.1f}ms attributed"
+                + (
+                    f" (raw {c.duration_ms:.1f}ms includes "
+                    f"{shared_ms:.1f}ms of sweep-mates' shared fetch)"
+                    if shared_ms > 0.0
+                    else f" (raw {c.duration_ms:.1f}ms)"
+                )
+            )
         _logging.getLogger("geomesa_tpu.slowquery").warning(
             "slow query batch type=%s trace=%s overhead %.1fms of %.1fms "
-            "total (budget %.0fms)\n%s",
+            "total (budget %.0fms)\n%s\n%s",
             name, batch.trace_id, own_ms, batch.duration_ms,
-            self.slow_query_s * 1000.0, batch.render(),
+            self.slow_query_s * 1000.0, "\n".join(members), batch.render(),
         )
+
+    # -- streaming result delivery (arrow/vector.py) -------------------------
+
+    def query_stream(
+        self,
+        name: str,
+        query: Union[str, Query] = "INCLUDE",
+        batch_rows: Optional[int] = None,
+    ):
+        """Streaming query: an iterator of Arrow ``RecordBatch``es, one
+        (or more, capped at ``geomesa.stream.batch.rows`` rows) per
+        scanned block — the first batch flushes while later blocks are
+        still scanning, so first-byte latency stops paying for full
+        materialization. Exposed over HTTP as chunked transfer encoding
+        (web.py: ``GET /query?stream=1``, ``POST /query/stream``).
+
+        Contract:
+
+        * always yields at least ONE batch (an empty one for zero rows),
+          so consumers can read the schema from the stream itself;
+        * concatenating the batches equals ``query()`` on the same
+          query — limit, projection, and union-arm dedupe included
+          (order within the stream is scan order; a plain ``query()``
+          streams in the same order);
+        * sort / sampling / derived-transform queries cannot stream
+          incrementally — they fall back to full materialization and
+          then chunk the finished result (identical answers, no
+          first-byte win); aggregation hints raise ``ValueError``
+          (a density grid is not a feature stream);
+        * runs under ONE admission slot and ONE query budget for the
+          LIFETIME of the iteration — a consumer that stalls past the
+          budget gets ``QueryTimeout`` at the next block, never a
+          silently truncated stream; closing the iterator early
+          releases the slot.
+        """
+        from geomesa_tpu.index.aggregators import has_aggregation as _has_agg
+        from geomesa_tpu.utils.config import STREAM_BATCH_ROWS
+
+        ft = self.get_schema(name)
+        q = self._as_query(query)
+        if _has_agg(q.hints):
+            raise ValueError(
+                "aggregation queries have no feature stream; use query()"
+            )
+        if batch_rows is None:
+            batch_rows = STREAM_BATCH_ROWS.to_int() or 8192
+        return self._stream_gen(name, ft, q, max(1, int(batch_rows)))
+
+    def _stream_gen(self, name, ft, q: Query, batch_rows: int):
+        """query_stream's generator body. Context managers must not span
+        a yield (a contextvar leaking into the consumer), so the budget
+        is an EXPLICIT Deadline attached around each step's work, and
+        admission uses the controller primitives directly (honoring the
+        reentrant-slot contract) instead of the context manager."""
+        import time as _time
+
+        from geomesa_tpu.arrow.vector import SimpleFeatureVector
+        from geomesa_tpu.index.transforms import QueryTransforms
+
+        t0 = _time.perf_counter()
+        dl = (
+            deadline_mod.Deadline(self.query_timeout_s)
+            if self.query_timeout_s is not None
+            else None
+        )
+        ctl = self.admission
+        rode_slot = ctl._ctx_held.get()
+        if not rode_slot:
+            with deadline_mod.attach(dl):
+                ctl._acquire()
+        hits = 0
+        plan = None
+        try:
+            dev0 = devstats.receipt_snapshot()
+            with deadline_mod.attach(dl):
+                with trace.span("query.stream", type=name):
+                    self._prepare_query(name, q)
+                    plan = self._plan_cached(name, q)
+            t_planned = _time.perf_counter()
+            streamable = (
+                self.STREAMS_LOCAL_PARTS
+                and not q.sort_by
+                and not q.hints.get("sampling")
+                and QueryTransforms.parse(ft, q.properties) is None
+            )
+            if streamable and not plan.is_empty:
+                out_ft = (
+                    _narrow_ft(ft, q.properties)
+                    if q.properties is not None
+                    else ft
+                )
+                vec = SimpleFeatureVector(out_ft)
+                remaining = q.max_features
+                # union arms may overlap: first-occurrence fid dedupe,
+                # incremental (same winners as _dedupe_by_fid's)
+                seen = set() if plan.union is not None else None
+                parts = self._iter_stream_parts(name, ft, q, plan, t0)
+                while remaining is None or remaining > 0:
+                    batches = []
+                    with deadline_mod.attach(dl):
+                        try:
+                            block, rows = next(parts)
+                        except StopIteration:
+                            break
+                        cols = _materialize(
+                            self._columns_from_parts(
+                                ft, q, [(block, rows)]
+                            )
+                        )
+                        if seen is not None:
+                            cols = _dedupe_against(cols, seen)
+                        n = len(cols.get("__fid__", ()))
+                        if remaining is not None and n > remaining:
+                            cols = {k: v[:remaining] for k, v in cols.items()}
+                            n = remaining
+                        for lo in range(0, n, batch_rows):
+                            sub = {
+                                k: v[lo : lo + batch_rows]
+                                for k, v in cols.items()
+                            }
+                            batches.append(vec.to_batch(sub))
+                        hits += n
+                        if remaining is not None:
+                            remaining -= n
+                    for b in batches:
+                        yield b
+                if hits == 0:
+                    yield vec.to_batch(_empty_columns(out_ft))
+            else:
+                # sort/sampling/transforms (or an empty plan): the
+                # finished result chunks into batches — same answers,
+                # no first-byte win
+                with deadline_mod.attach(dl):
+                    result = self._execute(name, ft, q, plan, t0)
+                    cols = _materialize(result.columns)
+                    vec = SimpleFeatureVector(result.ft)
+                    n = len(cols.get("__fid__", ()))
+                    hits = n
+                    batches = [
+                        vec.to_batch(
+                            {k: v[lo : lo + batch_rows] for k, v in cols.items()}
+                        )
+                        for lo in range(0, n, batch_rows)
+                    ] or [vec.to_batch(_empty_columns(result.ft))]
+                for b in batches:
+                    yield b
+            if self.metrics is not None or self.audit_writer is not None:
+                with deadline_mod.attach(dl):
+                    self._audit(
+                        name, q, plan, None, t0, t_planned,
+                        devstats.receipt_since(dev0), hits=hits,
+                    )
+                if self.metrics is not None:
+                    self.metrics.inc("queries.stream")
+        finally:
+            if not rode_slot:
+                ctl._release()
+
+    def _iter_stream_parts(self, name, ft, q: Query, plan, t0):
+        """Route+scan for the streaming path: yields (block, rows) per
+        resolved block across every routed unit. Device degradation
+        covers the window BEFORE a unit's first part is out (identical
+        results via the host scan); after first emission a device
+        failure fails the stream crisply — the consumer already holds
+        earlier bytes, and a silent re-scan could duplicate them."""
+        from geomesa_tpu.utils.audit import QueryTimeout
+
+        for arm in self._route(q, plan):
+            table = self._tables[name][arm.index.name]
+            scan = self.executor.scan_candidates(table, arm)
+            device_scan = scan is not None
+            arm.scan_path = _scan_label(scan)
+            emitted = False
+            gen = self._iter_consume(ft, q, arm, table, scan, device_scan, t0)
+            while True:
+                try:
+                    part = next(gen)
+                except StopIteration:
+                    break
+                except Exception as e:
+                    if not device_scan or emitted or isinstance(e, QueryTimeout):
+                        raise
+                    degrade = getattr(self.executor, "degrade", None)
+                    if degrade is not None:
+                        degrade(table, e)
+                    arm.scan_path = "host-table-degraded"
+                    # one degrade only: a failure of the HOST re-scan
+                    # must propagate, not loop back through another
+                    # degrade (device_scan False ends re-entry)
+                    device_scan = False
+                    gen = self._iter_consume(
+                        ft, q, arm, table, None, False, t0
+                    )
+                    continue
+                emitted = True
+                yield part
+            if device_scan and arm.scan_path.startswith("device"):
+                # the device scan resolved end-to-end: close a half-open
+                # breaker probe (the _scan_parts contract — without this
+                # a streamed probe query would leave the breaker latched
+                # half-open and short-circuit every later dispatch)
+                ok = getattr(self.executor, "record_device_success", None)
+                if ok is not None:
+                    ok()
 
     def _query_many_planned(self, name, ft, qs: List[Query]) -> List[QueryResult]:
         import time as _time
@@ -1264,7 +1575,7 @@ class TpuDataStore:
         return getattr(plan, "scan_path", "")
 
     def _audit(self, name, query, plan, result, t_start, t_planned,
-               receipt=None):
+               receipt=None, hits=None):
         import time as _time
 
         from geomesa_tpu.filter.parser import to_cql
@@ -1272,6 +1583,8 @@ class TpuDataStore:
 
         now = _time.perf_counter()
         receipt = receipt or {}
+        if hits is None:
+            hits = len(result)
         if self.metrics is not None:
             self.metrics.inc("queries")
             self.metrics.update_timer("query.plan", t_planned - t_start)
@@ -1287,7 +1600,7 @@ class TpuDataStore:
                     date_ms=int(_time.time() * 1000),
                     planning_ms=1000 * (t_planned - t_start),
                     scanning_ms=1000 * (now - t_planned),
-                    hits=len(result),
+                    hits=hits,
                     scan_path=self._collect_scan_path(plan),
                     # called inside the query's root span: the audit row
                     # and the exported trace tree join on this id
@@ -1627,10 +1940,23 @@ class TpuDataStore:
         """Resolve one (possibly device-pending) scan into parts; the
         filtering tail of _scan_parts, split out so a device failure can
         re-enter with the host scan."""
+        return list(
+            self._iter_consume(
+                ft, query, plan, table, scan, device_scan, t_scan_start
+            )
+        )
+
+    def _iter_consume(
+        self, ft, query: Query, plan: QueryPlan, table, scan, device_scan,
+        t_scan_start,
+    ) -> Iterator[tuple]:
+        """Generator body of _consume_scan: yields each (block,
+        final_rows) part as its block resolves — query_stream consumes
+        this lazily so the first Arrow batch flushes while later blocks
+        are still scanning; _consume_scan materializes the list."""
         import time as _time
 
         dl = deadline_mod.ambient()
-        parts: List[tuple] = []
         if scan is None:
             if plan.ranges:
                 scan = table.scan(plan.ranges)
@@ -1705,9 +2031,11 @@ class TpuDataStore:
                     if vmask is not None:
                         rows = rows[vmask]
                 bsp.set_attr("rows_out", len(rows))
-                if len(rows):
-                    parts.append((block, rows))
-        return parts
+            # the yield sits OUTSIDE the span: a streaming consumer may
+            # suspend here indefinitely, and a span (contextvar) must
+            # never stay open across a generator suspension
+            if len(rows):
+                yield block, rows
 
     def _age_off_keep(self, ft, block, rows, age_cutoff):
         """Bool keep-mask for the dtg age-off window, or None if all live
@@ -1951,8 +2279,14 @@ def _scan_label(scan) -> str:
         )
         pending = getattr(scan, "pending", None)
         if pending:
-            # every non-bitmap pending resolves RLE runs (packed or not)
-            fmt = "bitmap" if "Bitmap" in type(pending[0][1]).__name__ else "runs"
+            # wire format suffix: coalesced full-table masks, span-framed
+            # bitmaps, else RLE runs (packed or not)
+            pname = type(pending[0][1]).__name__
+            fmt = (
+                "mask" if "Mask" in pname
+                else "bitmap" if "Bitmap" in pname
+                else "runs"
+            )
             return f"{base}/{fmt}"
         return base
     return name.strip("_").lower()
@@ -2059,6 +2393,27 @@ def _narrow_ft(ft: FeatureType, props: Sequence[str]) -> FeatureType:
         [a for a in ft.attributes if a.name in keep],
         user_data,
     )
+
+
+def _dedupe_against(columns: Columns, seen: set) -> Columns:
+    """Incremental first-occurrence fid dedupe for the streaming union
+    path: drop rows whose fid was already emitted by an earlier part,
+    record the rest into ``seen`` — the same winners _dedupe_by_fid
+    picks over the concatenated parts. Vectorized like its batch
+    sibling: np.unique for in-part winners, np.isin vs the seen set."""
+    fids = columns.get("__fid__")
+    if fids is None or len(fids) == 0:
+        return columns
+    fids_s = np.asarray(fids).astype(str)
+    _, first_idx = np.unique(fids_s, return_index=True)
+    keep = np.zeros(len(fids_s), dtype=bool)
+    keep[first_idx] = True
+    if seen:
+        keep &= ~np.isin(fids_s, np.array(list(seen), dtype=fids_s.dtype))
+    seen.update(fids_s[keep].tolist())
+    if keep.all():
+        return columns
+    return {k: v[keep] for k, v in columns.items()}
 
 
 def _dedupe_by_fid(columns: Columns) -> Columns:

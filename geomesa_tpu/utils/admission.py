@@ -125,39 +125,52 @@ class AdmissionController:
         dl = deadline_mod.ambient()
         t0 = time.perf_counter()
         with trace.span("admit.wait") as sp:
-            with self._cond:
-                if self.queued >= self.max_queue:
-                    self._shed_locked()
-                self.queued += 1
-                try:
-                    while self.inflight >= self.max_inflight:
-                        if dl is not None and dl.is_cancelled:
-                            # a cancelled scan (hedge loser) must stop
-                            # holding a queue slot promptly, even though
-                            # its slice may have eons left
-                            dl.check("admit.wait")
-                        left = None if dl is None else dl.remaining()
-                        if left is not None and left <= 0.0:
-                            self._last_shed = time.monotonic()
-                            robustness_metrics().inc("shed.queue_timeout")
-                            trace.event(
-                                "deadline.exceeded", point="admit.wait",
-                            )
-                            raise QueryTimeout(
-                                "query budget exhausted after "
-                                f"{time.perf_counter() - t0:.3f}s in the "
-                                "admission queue (never executed)"
-                            )
-                        # deadline-bearing waiters poll (bounded tick) so
-                        # cancellation is observed without a notify
-                        self._cond.wait(
-                            timeout=left if dl is None else min(left, 0.1)
-                        )
-                    self.inflight += 1
-                    self.admitted += 1
-                    self._waits.append(time.perf_counter() - t0)
-                finally:
-                    self.queued -= 1
+            # cancellation wakes the wait via the deadline's on_cancel
+            # hook (a hedge loser must stop holding a queue slot the
+            # moment the winner answers — the former 100 ms poll tick
+            # was a per-queued-member p99 tax under coalescing)
+            unregister = (
+                dl.on_cancel(self._wake_waiters) if dl is not None else None
+            )
+            try:
+                with self._cond:
+                    if self.queued >= self.max_queue:
+                        self._shed_locked()
+                    self.queued += 1
+                    try:
+                        while self.inflight >= self.max_inflight:
+                            if dl is not None and dl.is_cancelled:
+                                dl.check("admit.wait")
+                            left = None if dl is None else dl.remaining()
+                            if left is not None and left <= 0.0:
+                                self._last_shed = time.monotonic()
+                                robustness_metrics().inc("shed.queue_timeout")
+                                trace.event(
+                                    "deadline.exceeded", point="admit.wait",
+                                )
+                                raise QueryTimeout(
+                                    "query budget exhausted after "
+                                    f"{time.perf_counter() - t0:.3f}s in the "
+                                    "admission queue (never executed)"
+                                )
+                            self._cond.wait(timeout=left)
+                        self.inflight += 1
+                        self.admitted += 1
+                        self._waits.append(time.perf_counter() - t0)
+                    except BaseException:
+                        # pass the baton: _release notifies ONE waiter,
+                        # and that notify may have been meant for us — a
+                        # waiter leaving on timeout/cancellation must
+                        # hand the freed slot to the next in line (the
+                        # old poll tick masked this lost-wakeup; the
+                        # wakeup-driven wait cannot)
+                        self._cond.notify()
+                        raise
+                    finally:
+                        self.queued -= 1
+            finally:
+                if unregister is not None:
+                    unregister()
             if sp.recording:
                 sp.set_attr(
                     "waited_ms", (time.perf_counter() - t0) * 1000.0
@@ -167,6 +180,13 @@ class AdmissionController:
         with self._cond:
             self.inflight -= 1
             self._cond.notify()
+
+    def _wake_waiters(self) -> None:
+        """Deadline-cancellation wakeup: notify EVERY waiter (the
+        cancelled one re-checks is_cancelled and leaves; the rest go
+        straight back to sleep with their remaining-budget timeouts)."""
+        with self._cond:
+            self._cond.notify_all()
 
     # -- observability -------------------------------------------------------
 

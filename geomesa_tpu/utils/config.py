@@ -192,6 +192,25 @@ AGG_LEVELS = SystemProperty("geomesa.agg.levels", "3")
 AGG_CELL_BITS = SystemProperty("geomesa.agg.cell.bits", "8")
 AGG_CACHE_TTL = SystemProperty("geomesa.agg.cache.ttl", "10 minutes")
 AGG_CACHE_BYTES = SystemProperty("geomesa.agg.cache.bytes", "64MB")
+# Cross-query coalescing (parallel/batch.py): concurrently admitted
+# queries of one feature type gather for up to `window.ms` (cap
+# `max.queries` members), stack their compiled predicate parameters
+# into ONE batched device sweep ([N, rows] mask), and demux per query —
+# per-query results, per-query audit rows, receipts split with the
+# shared sweep cost apportioned. Runs strictly AFTER admission (ShedLoad
+# semantics unchanged); every member keeps its own deadline (a budget
+# that dies mid-window ejects crisply with QueryTimeout). `enabled=0`
+# is the escape hatch: the solo path answers identically. The window
+# only opens when another query is in flight or a group is already
+# gathering, so an unsaturated store pays zero added latency.
+BATCH_ENABLED = SystemProperty("geomesa.batch.enabled", "true")
+BATCH_WINDOW_MS = SystemProperty("geomesa.batch.window.ms", "2")
+BATCH_MAX_QUERIES = SystemProperty("geomesa.batch.max.queries", "32")
+# Streaming result delivery (TpuDataStore.query_stream / web.py
+# GET /query?stream=1, POST /query/stream): per-block Arrow record
+# batches flush as scanning progresses; `batch.rows` caps the rows per
+# emitted RecordBatch (a huge block still streams in bounded frames).
+STREAM_BATCH_ROWS = SystemProperty("geomesa.stream.batch.rows", "8192")
 # Socket-timeout knobs: NO I/O boundary is unbounded-by-default. The
 # netlog RPC client derives its per-attempt timeout from
 # min(geomesa.netlog.timeout, the query's remaining deadline); auxiliary

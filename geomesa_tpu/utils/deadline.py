@@ -31,6 +31,7 @@ land on the suffering query's trace as a ``deadline.exceeded`` event.
 from __future__ import annotations
 
 import contextvars
+import threading
 import time
 from contextlib import contextmanager
 from typing import Optional
@@ -41,6 +42,11 @@ from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
 _CURRENT: contextvars.ContextVar[Optional["Deadline"]] = contextvars.ContextVar(
     "geomesa_tpu_deadline", default=None
 )
+
+# guards every Deadline's cancel-callback list: registration is rare
+# (one blocked wait at a time per deadline) and cancel() fires callbacks
+# outside the lock, so contention is effectively zero
+_CANCEL_LOCK = threading.Lock()
 
 
 class Deadline:
@@ -54,7 +60,7 @@ class Deadline:
     ``check()`` raises, aborting the scan at the following block/fault
     boundary without waiting out the slice."""
 
-    __slots__ = ("budget_s", "t_end", "cancelled", "_outer")
+    __slots__ = ("budget_s", "t_end", "cancelled", "_outer", "_on_cancel")
 
     def __init__(
         self,
@@ -72,6 +78,7 @@ class Deadline:
         # its own (knob-derived) budget inside an attached slice must
         # still abort when the coordinator cancels the slice handle
         self._outer = outer
+        self._on_cancel: Optional[list] = None
 
     def remaining(self) -> float:
         """Seconds of budget left (negative once expired)."""
@@ -86,8 +93,49 @@ class Deadline:
         winner already answered): every subsequent ``check()`` raises
         ``QueryTimeout`` immediately — including checks against
         deadlines NESTED inside this one (the cancel chain walks
-        outward). Idempotent, safe cross-thread (one bool store)."""
+        outward). Registered ``on_cancel`` wakeups fire so a BLOCKED
+        wait (admission queue, coalesce window) unblocks immediately
+        instead of discovering the cancellation on its next poll tick.
+        Idempotent, safe cross-thread (one bool store)."""
         self.cancelled = True
+        with _CANCEL_LOCK:
+            fns = list(self._on_cancel or ())
+        for fn in fns:
+            fn()
+
+    def on_cancel(self, fn) -> "callable":
+        """Register a wakeup to fire when this deadline — or any
+        ENCLOSING one (cancellation pierces nesting, see is_cancelled) —
+        is cancelled. The hook is a wakeup, not a work queue: keep ``fn``
+        tiny and non-blocking (a Condition notify, an Event set). Fires
+        immediately when already cancelled. Returns an unregister
+        callable; a blocked wait registers around its wait loop and
+        ALWAYS unregisters in a finally."""
+        chain = []
+        fire_now = False
+        with _CANCEL_LOCK:
+            d = self
+            while d is not None:
+                if d.cancelled:
+                    fire_now = True
+                    break
+                if d._on_cancel is None:
+                    d._on_cancel = []
+                d._on_cancel.append(fn)
+                chain.append(d)
+                d = d._outer
+        if fire_now:
+            fn()
+
+        def unregister() -> None:
+            with _CANCEL_LOCK:
+                for d in chain:
+                    try:
+                        d._on_cancel.remove(fn)
+                    except (AttributeError, ValueError):
+                        pass
+
+        return unregister
 
     @property
     def is_cancelled(self) -> bool:

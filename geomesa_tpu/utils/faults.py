@@ -26,6 +26,12 @@ disk, or device boundary:
     join.probe         per-chunk probe dispatch of a spatial join
                        (ops/join.py); device failures here degrade to
                        the host reference join with identical pairs
+    batch.coalesce     the cross-query coalescing seam (parallel/batch.py):
+                       the shared plan+dispatch phase a group leader runs
+                       for every coalesced member. A failure here degrades
+                       the WHOLE group to per-query solo execution with
+                       identical results — one member's fault never fails
+                       a sibling
 
 Kinds:
 
@@ -102,6 +108,7 @@ FAULT_POINTS = (
     "join.build",
     "join.probe",
     "agg.build",
+    "batch.coalesce",
 )
 
 KINDS = ("error", "drop", "latency", "torn", "crash")

@@ -6,6 +6,15 @@ SURVEY.md section 2.5). Endpoints over a datastore:
     GET /types
     GET /types/<name>            -- schema description
     GET /query?name=&cql=&format=geojson|csv&max=
+    GET /query?name=&cql=&stream=1&max=
+                                 -- streaming results: Arrow IPC record
+                                    batches as chunked transfer encoding
+                                    (TpuDataStore.query_stream) — the
+                                    first batch flushes while later
+                                    blocks are still scanning
+    POST /query/stream           -- the POST edition: JSON body {"name",
+                                    "cql"?, "max"?, "batch_rows"?} ->
+                                    the same chunked Arrow stream
     POST /join                   -- device-side spatial join (ops/join.py):
                                     JSON body {"build": {"name", "cql"},
                                     "probe": {"name", "cql"}, "predicate":
@@ -72,6 +81,10 @@ def make_handler(store):
         # socket-level read timeout: a client that declares a body it
         # never sends must not wedge its handler thread forever
         timeout = 60
+        # chunked transfer encoding (the streaming query endpoints)
+        # needs HTTP/1.1; every non-streamed response still carries an
+        # explicit Content-Length (_send), so keep-alive stays correct
+        protocol_version = "HTTP/1.1"
 
         def log_message(self, *args):  # quiet
             pass
@@ -98,6 +111,14 @@ def make_handler(store):
                 ShedLoad,
             )
 
+            if getattr(self, "_streaming", False):
+                # a streamed response already sent its 200 + headers: a
+                # second status line would corrupt the chunked body.
+                # Drop the connection WITHOUT the terminating 0-chunk —
+                # the client's chunked decoder reports a transport
+                # error, never a clean-parsing truncated stream
+                self.close_connection = True
+                return
             if isinstance(e, (ShedLoad, ShardUnavailable)):
                 self._send(
                     503, json.dumps({"error": str(e)}),
@@ -108,10 +129,79 @@ def make_handler(store):
             else:
                 self._send(500, json.dumps({"error": str(e)}))
 
+        def _stream_query(self, name: str, cql: str, max_features,
+                          batch_rows=None) -> None:
+            """Shared body of GET /query?stream=1 and POST /query/stream:
+            the store's Arrow record-batch stream as chunked transfer
+            encoding. The FIRST chunk is forced before the headers go
+            out, so planning errors, overload sheds, and pre-stream
+            timeouts still map to clean 4xx/5xx responses; a failure
+            after the first byte terminates the chunked stream WITHOUT
+            the final 0-length chunk — clients see a transport error,
+            never a silently truncated result that parses clean."""
+            from geomesa_tpu.arrow.vector import iter_ipc
+            from geomesa_tpu.index.planner import Query
+
+            q = Query.cql(cql)
+            if max_features is not None:
+                q.max_features = int(max_features)
+            chunks = iter_ipc(store.query_stream(name, q, batch_rows=batch_rows))
+            first = next(chunks)  # errors surface BEFORE any header
+            self._streaming = True
+            self.send_response(200)
+            self.send_header("Content-Type", "application/vnd.apache.arrow.stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            self._write_chunk(first)
+            for chunk in chunks:
+                self._write_chunk(chunk)
+            self._write_chunk(b"")  # terminating 0-chunk: stream complete
+            self._streaming = False
+
+        def _write_chunk(self, data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode())
+            if data:
+                self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+            self.wfile.flush()
+
         def do_POST(self):
             try:
                 parsed = urllib.parse.urlparse(self.path)
                 route = parsed.path.rstrip("/")
+                if route == "/query/stream":
+                    try:
+                        length = int(self.headers.get("Content-Length") or 0)
+                        if length < 0:
+                            raise ValueError(length)
+                    except ValueError:
+                        self._send(
+                            400, json.dumps({"error": "invalid Content-Length"})
+                        )
+                        return
+                    if length > MAX_JOIN_BODY:
+                        self._send(
+                            413, json.dumps({"error": "request body too large"})
+                        )
+                        return
+                    raw = self.rfile.read(length) if length else b"{}"
+                    try:
+                        body = json.loads(raw or b"{}")
+                        name = body["name"]
+                    except (ValueError, KeyError, TypeError):
+                        self._send(
+                            400,
+                            json.dumps({"error": (
+                                'body needs {"name", "cql"?, "max"?, '
+                                '"batch_rows"?}'
+                            )}),
+                        )
+                        return
+                    self._stream_query(
+                        name, body.get("cql", "INCLUDE"), body.get("max"),
+                        body.get("batch_rows"),
+                    )
+                    return
                 if route != "/join":
                     self._send(404, json.dumps({"error": "not found"}))
                     return
@@ -215,6 +305,14 @@ def make_handler(store):
                     from geomesa_tpu.tools.export import to_csv, to_geojson
 
                     name = params["name"]
+                    if params.get("stream", "") in ("1", "true"):
+                        # chunked Arrow record-batch stream: the first
+                        # batch flushes while later blocks still scan
+                        self._stream_query(
+                            name, params.get("cql", "INCLUDE"),
+                            params.get("max"),
+                        )
+                        return
                     q = Query.cql(params.get("cql", "INCLUDE"))
                     if "max" in params:
                         q.max_features = int(params["max"])

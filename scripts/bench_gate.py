@@ -154,6 +154,176 @@ def run_agg_stream(store, reps: int) -> dict:
     }
 
 
+def run_concurrent_stream(n: int, threads: int, per_thread: int) -> dict:
+    """The saturated-concurrency bench leg (PR 9): K client threads x M
+    queries over ONE store, with cross-query coalescing ON (the default)
+    and then OFF (the `geomesa.batch.enabled=0` escape hatch, i.e. the
+    pre-coalescing solo path). The gate pins the self-relative speedup —
+    coalesced saturated features/sec/host must be >= 2x solo — and exact
+    hit parity between the two modes (the escape-hatch contract). p99
+    per-query wall comes from the store's own query.scan timer summaries
+    (the PR 2/3 observability rails), not ad-hoc timers.
+
+    The leg builds its OWN store on a single-device mesh (one device
+    per serving host — the shape the coalescer's stacked-mask kernel
+    targets). That also sidesteps a pre-existing hazard unrelated to
+    coalescing: concurrent SOLO device queries on a multi-device mesh
+    (the 8-virtual-device test conftest) can deadlock in XLA's
+    collective rendezvous."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    import bench
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.parallel import TpuScanExecutor
+    from geomesa_tpu.parallel.mesh import default_mesh
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import TpuDataStore
+    from geomesa_tpu.utils.audit import MetricsRegistry, histogram_summary
+    from geomesa_tpu.utils.config import properties
+
+    x, y, t = bench.synthesize(n)
+    store = TpuDataStore(
+        executor=TpuScanExecutor(default_mesh(jax.devices()[:1]))
+    )
+    ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    store._insert_columns(
+        ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t}
+    )
+    store.query("gdelt", bench.QUERY)  # warm: mirror + kernels
+    _boxes, cqls = bench.make_queries(8)
+
+    def one_pass(enabled: bool):
+        reg = MetricsRegistry()
+        old_metrics = store.metrics
+        store.metrics = reg
+        hits = [0] * threads
+        errors = []
+        barrier = threading.Barrier(threads)
+
+        def worker(i):
+            try:
+                barrier.wait(timeout=30)
+                total = 0
+                for j in range(per_thread):
+                    q = Query.cql(cqls[(i + j) % len(cqls)], properties=[])
+                    total += len(store.query("gdelt", q))
+                hits[i] = total
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        try:
+            with properties(
+                geomesa_batch_enabled=("true" if enabled else "false"),
+            ):
+                ts = [
+                    threading.Thread(target=worker, args=(i,))
+                    for i in range(threads)
+                ]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                wall = time.perf_counter() - t0
+        finally:
+            store.metrics = old_metrics
+        if errors:
+            raise errors[0]
+        scans = reg.snapshot()[2].get("query.scan", [])
+        p99 = histogram_summary(scans)["p99_ms"] if scans else None
+        return wall, sum(hits), p99
+
+    # warm both modes' kernels outside the measured passes
+    one_pass(True)
+    one_pass(False)
+    wall_co, hits_co, p99_co = one_pass(True)
+    wall_solo, hits_solo, p99_solo = one_pass(False)
+    queries = threads * per_thread
+    fps_co = n * queries / max(wall_co, 1e-9)
+    fps_solo = n * queries / max(wall_solo, 1e-9)
+    return {
+        "threads": threads,
+        "per_thread": per_thread,
+        "hits": hits_co,
+        "hits_solo": hits_solo,
+        "features_per_s": round(fps_co, 1),
+        "features_per_s_solo": round(fps_solo, 1),
+        "speedup": round(fps_co / max(fps_solo, 1e-9), 2),
+        "p99_ms": None if p99_co is None else round(p99_co, 3),
+        "p99_ms_solo": None if p99_solo is None else round(p99_solo, 3),
+    }
+
+
+def run_stream_latency(reps: int) -> dict:
+    """The streaming first-byte bench leg (PR 9): a multi-block store
+    (the fs/host tier shape: many sealed blocks), one selective query.
+    `full_ms` is the full-materialization wall — query() PLUS converting
+    the whole result to one Arrow batch, which is what a non-streaming
+    client must wait for before its first byte. `first_batch_ms` is
+    query_stream()'s wall to the FIRST record batch. The gate pins
+    first/full < 0.5 (self-relative, machine speed cancels)."""
+    import numpy as np
+
+    from geomesa_tpu.arrow.vector import SimpleFeatureVector
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.schema.featuretype import parse_spec
+    from geomesa_tpu.store.datastore import TpuDataStore, _materialize
+
+    store = TpuDataStore()
+    ft = parse_spec("spoints", "v:Integer,dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    rng = np.random.default_rng(5)
+    blocks, rows = 16, 4000
+    t0ms = 1514764800000
+    k = 0
+    for _b in range(blocks):
+        cols = {
+            "__fid__": np.array([f"s{k+i}" for i in range(rows)], dtype=object),
+            "geom__x": rng.uniform(-170, 170, rows),
+            "geom__y": rng.uniform(-80, 80, rows),
+            "v": rng.integers(0, 1000, rows, dtype=np.int64).astype(np.int32),
+            "dtg": t0ms + np.arange(k, k + rows) * 1000,
+        }
+        store._insert_columns(ft, cols)
+        k += rows
+    cql = "bbox(geom, -120, -60, 120, 60)"
+    vec = SimpleFeatureVector(ft)
+    # warm both paths (pyarrow/jit residue must not land in the ratio)
+    _ = vec.to_batch(_materialize(store.query("spoints", cql).columns))
+    next(iter(store.query_stream("spoints", cql)))
+
+    full_s = []
+    first_s = []
+    hits = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = store.query("spoints", Query.cql(cql))
+        batch = vec.to_batch(_materialize(res.columns))
+        full_s.append(time.perf_counter() - t0)
+        hits = batch.num_rows
+        t0 = time.perf_counter()
+        gen = store.query_stream("spoints", Query.cql(cql))
+        first = next(gen)
+        first_s.append(time.perf_counter() - t0)
+        streamed = first.num_rows + sum(b.num_rows for b in gen)
+        assert streamed == hits, (streamed, hits)
+    full_ms = sorted(full_s)[len(full_s) // 2] * 1000.0
+    first_ms = sorted(first_s)[len(first_s) // 2] * 1000.0
+    return {
+        "reps": reps,
+        "blocks": blocks,
+        "hits": hits,
+        "full_ms": round(full_ms, 3),
+        "first_batch_ms": round(first_ms, 3),
+        "first_batch_ratio": round(first_ms / max(full_ms, 1e-9), 3),
+    }
+
+
 def run_stream(n: int, reps: int) -> dict:
     """Ingest n synthetic rows, warm (pack + compile), then run the
     jittered bench query stream traced; return the gate artifact."""
@@ -212,6 +382,8 @@ def run_stream(n: int, reps: int) -> dict:
     hits = sum(len(r) for r in results)
     join = run_join_stream(store, max(2, reps // 2))
     agg = run_agg_stream(store, max(4, reps))
+    concurrent = run_concurrent_stream(n, threads=8, per_thread=4)
+    stream = run_stream_latency(max(3, reps // 2))
     try:
         # 1-minute loadavg at measurement time: the gate is known
         # load-sensitive, and a flaky band should at least SAY the box
@@ -223,6 +395,8 @@ def run_stream(n: int, reps: int) -> dict:
         "schema": 1,
         "join": join,
         "agg": agg,
+        "concurrent": concurrent,
+        "stream": stream,
         "loadavg_1m": loadavg,
         "config": {
             "n": n,
@@ -265,6 +439,20 @@ def inject_slowdown(artifact: dict, factor: float) -> dict:
         # the injection tests the band gates, not the cache's physics
         out["agg"]["cold_ms"] = round(out["agg"]["cold_ms"] * factor, 3)
         out["agg"]["hot_ms"] = round(out["agg"]["hot_ms"] * factor, 3)
+    if "concurrent" in out:
+        # uniform scaling: both modes slow equally, speedup preserved
+        for key in ("features_per_s", "features_per_s_solo"):
+            out["concurrent"][key] = round(out["concurrent"][key] / factor, 1)
+        for key in ("p99_ms", "p99_ms_solo"):
+            if out["concurrent"].get(key) is not None:
+                out["concurrent"][key] = round(
+                    out["concurrent"][key] * factor, 3
+                )
+    if "stream" in out:
+        out["stream"]["full_ms"] = round(out["stream"]["full_ms"] * factor, 3)
+        out["stream"]["first_batch_ms"] = round(
+            out["stream"]["first_batch_ms"] * factor, 3
+        )
     out["injected_slowdown"] = factor
     return out
 
@@ -386,6 +574,66 @@ def compare(baseline: dict, current: dict, tolerance: dict = None) -> list:
                 f"agg speedup below floor: {c_agg.get('speedup')}x < 10x "
                 "— hot cache-served aggregations are no longer "
                 "meaningfully cheaper than the cold first touch"
+            )
+
+    # the saturated-concurrency leg (PR 9 cross-query coalescing): the
+    # coalesced saturated features/sec/host must stay >= 2x the solo
+    # escape hatch (self-relative, so machine speed cancels), the two
+    # modes must answer IDENTICALLY (the `geomesa.batch.enabled=0`
+    # contract), and the coalesced throughput sits in the ordinary time
+    # band vs the baseline. Baselines recorded before the leg skip it.
+    b_con = baseline.get("concurrent")
+    c_con = current.get("concurrent", {})
+    if b_con:
+        if c_con.get("hits") != c_con.get("hits_solo"):
+            out.append(
+                f"concurrent hit parity broke: coalesced {c_con.get('hits')} "
+                f"!= solo {c_con.get('hits_solo')} (CORRECTNESS, not perf — "
+                "the geomesa.batch.enabled=0 escape hatch must answer "
+                "identically)"
+            )
+        if c_con.get("hits") != b_con.get("hits"):
+            out.append(
+                f"concurrent hits drifted: {c_con.get('hits')} != "
+                f"{b_con.get('hits')} (CORRECTNESS, not perf)"
+            )
+        if c_con.get("speedup", 0.0) < 2.0:
+            out.append(
+                f"concurrent coalescing speedup below floor: "
+                f"{c_con.get('speedup')}x < 2x — coalesced saturated "
+                "features/sec/host no longer meaningfully beats the solo "
+                "path (a lost stacked sweep, a serialized window, or a "
+                "grouping gate that stopped firing)"
+            )
+        b_fps = b_con.get("features_per_s", 0.0)
+        c_fps = c_con.get("features_per_s", 0.0)
+        floor = b_fps / tol["per_query_ms_factor"]
+        if b_fps and c_fps < floor:
+            out.append(
+                f"concurrent features_per_s regressed: {c_fps:,.0f} < "
+                f"{floor:,.0f} (baseline {b_fps:,.0f} / "
+                f"{tol['per_query_ms_factor']})"
+            )
+
+    # the streaming first-byte leg (PR 9 chunked Arrow delivery): the
+    # first streamed batch must cost < 0.5x the full-materialization
+    # wall of the same query (self-relative). Baselines recorded before
+    # the leg skip it.
+    b_str = baseline.get("stream")
+    c_str = current.get("stream", {})
+    if b_str:
+        if c_str.get("hits") != b_str.get("hits"):
+            out.append(
+                f"stream hits drifted: {c_str.get('hits')} != "
+                f"{b_str.get('hits')} (CORRECTNESS, not perf)"
+            )
+        ratio = c_str.get("first_batch_ratio", 1.0)
+        if ratio >= 0.5:
+            out.append(
+                f"stream first-batch ratio above ceiling: {ratio} >= 0.5 "
+                "— the first Arrow batch no longer flushes meaningfully "
+                "before full materialization (streaming lost its "
+                "incremental scan)"
             )
     return out
 

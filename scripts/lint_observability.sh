@@ -33,6 +33,7 @@ declare -A SPANS=(
     ["join.build"]="geomesa_tpu/ops/join.py"
     ["join.probe"]="geomesa_tpu/ops/join.py"
     ["agg.build"]="geomesa_tpu/ops/pyramid.py"
+    ["batch.coalesce"]="geomesa_tpu/parallel/batch.py"
 )
 for point in "${!SPANS[@]}"; do
     file="${SPANS[$point]}"

@@ -94,6 +94,21 @@ for point in agg.build; do
     fi
 done
 
+# the cross-query coalescing seam is pinned the same way: the shared
+# plan+dispatch phase a group leader runs for every member must stay
+# injectable, so the degrade-to-solo parity (and member isolation — one
+# member's fault never fails a sibling) can always be chaos-tested
+for point in batch.coalesce; do
+    if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/parallel/batch.py; then
+        echo "FAIL: geomesa_tpu/parallel/batch.py lost the '${point}' fault point"
+        echo "      (the coalescer contract: a shared-phase failure degrades"
+        echo "       the WHOLE group to per-query solo execution with"
+        echo "       identical results — faults.fault_point(\"${point}\")"
+        echo "       beside a deadline check; see utils/faults.py)"
+        fail=1
+    fi
+done
+
 # multi-file mutation sites in the store tier must declare a
 # write-ahead intent before touching files (crash-consistency contract)
 while IFS= read -r f; do

@@ -129,6 +129,91 @@ def test_agg_leg_survives_injected_slowdown():
     assert out["agg"]["cold_ms"] == pytest.approx(art["agg"]["cold_ms"] * 2)
 
 
+def _concurrent(speedup=3.0, hits=357160, hits_solo=357160, fps=9.0e6):
+    return {
+        "threads": 8, "per_thread": 4,
+        "hits": hits, "hits_solo": hits_solo,
+        "features_per_s": fps, "features_per_s_solo": fps / speedup,
+        "speedup": speedup, "p99_ms": 300.0, "p99_ms_solo": 900.0,
+    }
+
+
+def _stream(ratio=0.12, hits=33916):
+    return {
+        "reps": 3, "blocks": 16, "hits": hits,
+        "full_ms": 16.0, "first_batch_ms": 16.0 * ratio,
+        "first_batch_ratio": ratio,
+    }
+
+
+def test_concurrent_leg_clean_and_bands():
+    base, cur = _artifact(), _artifact()
+    base["concurrent"] = _concurrent()
+    cur["concurrent"] = _concurrent(speedup=2.8)
+    assert bench_gate.compare(base, cur) == []
+    # coalescing speedup below the 2x floor
+    flat = _artifact()
+    flat["concurrent"] = _concurrent(speedup=1.4)
+    assert any(
+        "speedup below floor" in r for r in bench_gate.compare(base, flat)
+    )
+    # coalesced vs solo answers must be identical (escape-hatch contract)
+    bleed = _artifact()
+    bleed["concurrent"] = _concurrent(hits_solo=357159)
+    assert any(
+        "hit parity broke" in r for r in bench_gate.compare(base, bleed)
+    )
+    # hit drift vs the recorded baseline is correctness
+    drift = _artifact()
+    drift["concurrent"] = _concurrent(hits=1, hits_solo=1)
+    assert any("CORRECTNESS" in r for r in bench_gate.compare(base, drift))
+    # absolute throughput collapse trips the time band
+    slow = _artifact()
+    slow["concurrent"] = _concurrent(fps=9.0e6 / 4)
+    assert any(
+        "features_per_s regressed" in r for r in bench_gate.compare(base, slow)
+    )
+    # baselines recorded before the leg skip it
+    assert bench_gate.compare(_artifact(), cur) == []
+
+
+def test_stream_leg_clean_and_bands():
+    base, cur = _artifact(), _artifact()
+    base["stream"], cur["stream"] = _stream(), _stream(ratio=0.2)
+    assert bench_gate.compare(base, cur) == []
+    # first-batch no longer meaningfully early
+    late = _artifact()
+    late["stream"] = _stream(ratio=0.8)
+    assert any(
+        "first-batch ratio above ceiling" in r
+        for r in bench_gate.compare(base, late)
+    )
+    # hit drift is correctness
+    drift = _artifact()
+    drift["stream"] = _stream(hits=1)
+    assert any("CORRECTNESS" in r for r in bench_gate.compare(base, drift))
+    # pre-leg baselines skip
+    assert bench_gate.compare(_artifact(), cur) == []
+
+
+def test_new_legs_survive_injected_slowdown():
+    art = _artifact()
+    art["concurrent"] = _concurrent()
+    art["stream"] = _stream()
+    out = bench_gate.inject_slowdown(art, 2.0)
+    # self-relative gates hold under uniform scaling
+    assert out["concurrent"]["speedup"] == art["concurrent"]["speedup"]
+    assert out["stream"]["first_batch_ratio"] == (
+        art["stream"]["first_batch_ratio"]
+    )
+    assert out["concurrent"]["features_per_s"] == pytest.approx(
+        art["concurrent"]["features_per_s"] / 2
+    )
+    assert out["stream"]["first_batch_ms"] == pytest.approx(
+        art["stream"]["first_batch_ms"] * 2
+    )
+
+
 def test_config_mismatch_refuses_to_compare():
     cur = _artifact()
     cur["config"]["n"] = 100
