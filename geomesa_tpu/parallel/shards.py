@@ -333,6 +333,19 @@ class ShardWorker:
                 st = self._stores.get(partition)
             return 0 if st is None else st.count(name, query)
 
+    def telemetry(self) -> Dict[str, Any]:
+        """One shard's point-in-time telemetry for the flight-recorder
+        rollup (utils/timeline.py): the per-shard admission depth
+        (LOCK-FREE peek — the sampler must never contend with the scan
+        path) and partition residency. This is the worker-facing seam a
+        cross-process transport would serve over RPC, like ``scan``."""
+        with self._lock:
+            partitions = len(self._stores)
+        return {
+            "admission": self.admission.peek(),
+            "partitions": partitions,
+        }
+
     def has_visibility(self, name: str) -> bool:
         with self._lock:
             stores = list(self._stores.values())
@@ -996,6 +1009,22 @@ class ShardedDataStore(TpuDataStore):
             return self._finish(ft, query, plan, columns)
 
     # -- observability -------------------------------------------------------
+
+    def _timeline_extra(self) -> Dict[str, Any]:
+        """Per-shard rollup for the coordinator's timeline sampler
+        (utils/timeline.py): each worker's telemetry gathered through
+        the worker-facing seam (``ShardWorker.telemetry`` — the
+        ``_shard_call`` analog a cross-process transport would fan out
+        as RPCs), merged with the coordinator-side per-shard breaker
+        view. PASSIVE throughout: lock-free admission peeks and
+        non-transitioning breaker reads — a sampler tick can never
+        strike a breaker or hold a shard's admission queue."""
+        return {
+            "shards": {
+                str(i): {**w.telemetry(), "breaker": self._breakers[i].peek_state}
+                for i, w in enumerate(self.workers)
+            }
+        }
 
     def shards_snapshot(self) -> Dict[str, Any]:
         """The ``shards`` block for /debug/overload + /healthz: per-shard

@@ -847,21 +847,57 @@ class TpuDataStore:
             dt = a.type.numpy_dtype
             if dt is None or np.dtype(dt).kind not in "iufb":
                 raise AggError(f"column {c!r} is not numeric")
-        with trace.span(
-            "query.aggregate", force=self.slow_query_s is not None, type=name
-        ) as root:
-            with deadline_mod.budget(self.query_timeout_s):
-                with self.admission.admit():
-                    self._prepare_query(name, q)
-                    got = self._aggregate_pyramid(name, ft, q, cols)
-                    if got is not None:
-                        if root.recording:
-                            root.set_attr("agg.cache", "hit")
-                        return got
-                    # exact fallback: the ordinary scan (admission slot
-                    # and budget are reentrant — PR 7 / PR 6 semantics)
-                    res = self.query(name, q)
-                    return _aggregate_columns(ft, res.columns, cols)
+        import time as _time
+
+        from geomesa_tpu.utils.audit import QueryTimeout, ShedLoad
+
+        t0 = _time.perf_counter()
+        root = trace.NOOP
+        try:
+            with trace.span(
+                "query.aggregate", force=self.slow_query_s is not None,
+                type=name,
+            ) as root:
+                try:
+                    with deadline_mod.budget(self.query_timeout_s):
+                        with self.admission.admit():
+                            self._prepare_query(name, q)
+                            got = self._aggregate_pyramid(name, ft, q, cols)
+                            if got is None:
+                                # exact fallback: the ordinary scan
+                                # (admission slot and budget are
+                                # reentrant — PR 7 / PR 6 semantics)
+                                res = self.query(name, q)
+                                got = _aggregate_columns(ft, res.columns, cols)
+                            elif root.recording:
+                                root.set_attr("agg.cache", "hit")
+                            # aggregate-class accounting (the SLO engine's
+                            # `aggregate` class, utils/slo.py): one counter
+                            # + timer per surface call. The exact-fallback
+                            # inner query also audits as a `query` — the
+                            # classes are separate trails, like joins
+                            if self.metrics is not None:
+                                self.metrics.inc("queries.aggregate")
+                                self.metrics.update_timer(
+                                    "query.aggregate",
+                                    _time.perf_counter() - t0,
+                                )
+                            return got
+                except (QueryTimeout, ShedLoad) as e:
+                    outcome = (
+                        "timeout" if isinstance(e, QueryTimeout) else "shed"
+                    )
+                    if root.recording:
+                        root.set_attr("outcome", outcome)
+                    if self.metrics is not None:
+                        # aggregate-scoped only (the query_join rule): an
+                        # inner query's own timeout already audited into
+                        # queries/queries.<outcome>
+                        self.metrics.inc("queries.aggregate")
+                        self.metrics.inc(f"queries.aggregate.{outcome}")
+                    raise
+        finally:
+            self._log_slow_query(name, None, root)
 
     def _aggregate_pyramid(
         self, name, ft, q: Query, cols: List[str]
@@ -1249,6 +1285,8 @@ class TpuDataStore:
         actually slow" stays answerable."""
         import logging as _logging
 
+        from geomesa_tpu.utils.audit import slow_query_note
+
         if self.slow_query_s is None or not batch.recording:
             return
         own_ms = batch.duration_ms - sum(
@@ -1256,6 +1294,15 @@ class TpuDataStore:
         )
         if own_ms < self.slow_query_s * 1000.0:
             return
+        if not slow_query_note({
+            "kind": "batch",
+            "type": name,
+            "trace_id": batch.trace_id,
+            "duration_ms": round(batch.duration_ms, 1),
+            "overhead_ms": round(own_ms, 1),
+            "budget_ms": round(self.slow_query_s * 1000.0, 1),
+        }):
+            return  # storm guard: render shed, summary retained
         members = []
         for i, c in enumerate(
             c for c in batch.children if c.name == "query"
@@ -1327,7 +1374,34 @@ class TpuDataStore:
             )
         if batch_rows is None:
             batch_rows = STREAM_BATCH_ROWS.to_int() or 8192
-        return self._stream_gen(name, ft, q, max(1, int(batch_rows)))
+        gen = self._stream_gen(name, ft, q, max(1, int(batch_rows)))
+        if self.metrics is None:
+            return gen
+        return self._stream_first_timed(gen)
+
+    def _stream_first_timed(self, gen):
+        """Wrap a result stream to time its FIRST batch — the
+        ``query.stream.first`` timer behind the stream_first_batch SLO
+        class (utils/slo.py) and the `stream` bench leg's headline
+        number. The clock starts at the consumer's first ``next()``
+        (this wrapper is itself a generator), so producer-side setup the
+        consumer never waited on is not charged."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        first = True
+        try:
+            for b in gen:
+                if first:
+                    first = False
+                    self.metrics.update_timer(
+                        "query.stream.first", _time.perf_counter() - t0
+                    )
+                yield b
+        finally:
+            # a consumer closing THIS wrapper must close the underlying
+            # stream NOW (releasing its admission slot), not at GC
+            gen.close()
 
     def _stream_gen(self, name, ft, q: Query, batch_rows: int):
         """query_stream's generator body. Context managers must not span
@@ -1652,13 +1726,30 @@ class TpuDataStore:
         """Threshold slow-query log: any query over ``slow_query_s``
         dumps its full span tree + the plan explain (the per-query
         "why was this one slow" answer the aggregate timers can't give).
-        ``root`` is real whenever a budget is set (query() forces it)."""
+        ``root`` is real whenever a budget is set (query() forces it).
+
+        Storm-guarded (utils/audit.slow_query_note): every slow query
+        files a cheap summary into the bounded tail behind
+        ``/debug/report``, but the EXPENSIVE part — rendering the span
+        tree and explain — is rate-limited to
+        ``geomesa.query.slow.max.per.min`` so an overload event cannot
+        turn the observability layer into the bottleneck it measures."""
         import logging as _logging
+
+        from geomesa_tpu.utils.audit import slow_query_note
 
         if self.slow_query_s is None or not root.recording:
             return
         if root.duration_ms < self.slow_query_s * 1000.0:
             return
+        if not slow_query_note({
+            "kind": "query",
+            "type": name,
+            "trace_id": root.trace_id,
+            "duration_ms": round(root.duration_ms, 1),
+            "budget_ms": round(self.slow_query_s * 1000.0, 1),
+        }):
+            return  # render shed; the summary survives in the tail
         _logging.getLogger("geomesa_tpu.slowquery").warning(
             "slow query type=%s trace=%s took %.1fms (budget %.0fms)\n%s\n"
             "explain:\n%s",

@@ -190,6 +190,20 @@ class AdmissionController:
 
     # -- observability -------------------------------------------------------
 
+    def peek(self) -> Dict[str, int]:
+        """LOCK-FREE point read of the admission depth for the telemetry
+        timeline sampler (utils/timeline.py): plain attribute reads, so
+        the sampler can never contend with — let alone hold — the
+        admission queue's condition lock. The ints may tear across each
+        other under concurrency (a snapshot one query out of date), which
+        is fine for a per-second flight recorder."""
+        return {
+            "inflight": self.inflight,
+            "queued": self.queued,
+            "sheds": self.sheds,
+            "admitted": self.admitted,
+        }
+
     def recently_shedding(self, window_s: float = _RECENT_SHED_S) -> bool:
         last = self._last_shed
         return last is not None and time.monotonic() - last < window_s

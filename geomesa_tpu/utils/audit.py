@@ -11,10 +11,12 @@ dataclass, pluggable writers, and a counter/timer registry.
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 
 @dataclass
@@ -118,6 +120,48 @@ def histogram_summary(vals: List[float], total_count: Optional[int] = None) -> D
     }
 
 
+# -- timer exemplars ----------------------------------------------------------
+#
+# When the SLO engine is active (utils/slo.py), timer reservoirs also
+# keep EXEMPLARS: (seconds, trace_id, wall_ms) triples filed per
+# power-of-two latency bucket, plus a small recent ring — so /debug/slo
+# and the Prometheus exposition can link a p99 straight to a retained
+# trace in /debug/traces instead of leaving the operator to guess which
+# query the percentile describes. The hook is flag-gated at module
+# level: with the flag off (the default until a timeline sampler with
+# exemplars starts), update_timer's added cost is ONE global read — the
+# trace.span / fault_point free-when-off discipline, asserted by
+# tests/test_timeline.py.
+
+_EXEMPLARS = False
+_EXEMPLAR_RECENT = 32  # recent-exemplar ring per timer
+# bucket i covers [2^i, 2^(i+1)) milliseconds, clamped to this range
+_EXEMPLAR_BUCKET_MIN = -4  # 62.5 us
+_EXEMPLAR_BUCKET_MAX = 17  # ~131 s
+
+
+def set_exemplars(on: bool) -> None:
+    """Flip the process-wide exemplar hook (utils/timeline.py manages
+    this against the sampler refcount; tests flip it directly)."""
+    global _EXEMPLARS
+    _EXEMPLARS = bool(on)
+
+
+def exemplars_enabled() -> bool:
+    return _EXEMPLARS
+
+
+def exemplar_bucket(seconds: float) -> int:
+    """floor(log2(milliseconds)), clamped — the shared latency-bucket
+    rule for exemplars AND the timeline's per-tick timer histograms, so
+    an SLO threshold maps to the same bucket edge in both."""
+    ms = seconds * 1000.0
+    if ms <= 0.0:
+        return _EXEMPLAR_BUCKET_MIN
+    b = math.frexp(ms)[1] - 1  # 2**b <= ms < 2**(b+1)
+    return max(_EXEMPLAR_BUCKET_MIN, min(_EXEMPLAR_BUCKET_MAX, b))
+
+
 class MetricsRegistry:
     """Counters + gauges + timers with a snapshot report (Dropwizard
     registry role). Timers report percentile summaries
@@ -135,6 +179,10 @@ class MetricsRegistry:
         self._timer_totals: Dict[str, List[float]] = {}
         self._gauges: Dict[str, float] = {}
         self._gauge_fns: Dict[str, Any] = {}
+        # timer -> {"buckets": {bucket: (s, trace_id, wall_ms)},
+        #           "recent": deque} — populated ONLY while the exemplar
+        # flag is up (bounded: 22 buckets + a 32-deep ring per timer)
+        self._exemplars: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
 
     def inc(self, name: str, n: int = 1) -> None:
@@ -166,6 +214,18 @@ class MetricsRegistry:
             return float(self._gauges.get(name, default))
 
     def update_timer(self, name: str, seconds: float) -> None:
+        ex: Optional[Tuple[float, str, float]] = None
+        if _EXEMPLARS:
+            # the trace-id read happens OUTSIDE the lock and ONLY under
+            # the flag: disabled, this method's added cost is the one
+            # module-global read above (the free-when-off contract)
+            from geomesa_tpu.utils import trace as _trace
+
+            ex = (
+                float(seconds),
+                _trace.current_trace_id() or "",
+                time.time() * 1000.0,
+            )
         with self._lock:
             vals = self._timers.setdefault(name, [])
             vals.append(seconds)
@@ -174,6 +234,38 @@ class MetricsRegistry:
             tot = self._timer_totals.setdefault(name, [0, 0.0])
             tot[0] += 1
             tot[1] += seconds
+            if ex is not None:
+                slot = self._exemplars.get(name)
+                if slot is None:
+                    slot = self._exemplars[name] = {
+                        "buckets": {},
+                        "recent": deque(maxlen=_EXEMPLAR_RECENT),
+                    }
+                slot["buckets"][exemplar_bucket(seconds)] = ex
+                slot["recent"].append(ex)
+
+    def exemplars(self, name: Optional[str] = None) -> Dict[str, Any]:
+        """Copy of the exemplar state: ``{timer: {"buckets": {bucket:
+        (seconds, trace_id, wall_ms)}, "recent": [...]}}`` (one timer's
+        slot when ``name`` is given, ``{}`` when it has none). Buckets
+        keep the LAST exemplar per power-of-two latency bucket — the
+        highest occupied bucket is the worst recent sample, which is
+        what the p99 wants linked."""
+        with self._lock:
+            items = (
+                [(name, self._exemplars.get(name))]
+                if name is not None
+                else list(self._exemplars.items())
+            )
+            out = {
+                n: {
+                    "buckets": dict(slot["buckets"]),
+                    "recent": list(slot["recent"]),
+                }
+                for n, slot in items
+                if slot is not None
+            }
+        return out.get(name, {}) if name is not None else out
 
     def timer(self, name: str):
         registry = self
@@ -537,12 +629,14 @@ def prometheus_text(registries, prefix: str = "geomesa") -> str:
     gauges: Dict[str, float] = {}
     timers: Dict[str, List[float]] = {}
     totals: Dict[str, tuple] = {}
+    exemplars: Dict[str, Dict[str, Any]] = {}
     for reg in registries:
         c, g, t, tt = reg.snapshot()
         counters.update(c)
         gauges.update(g)
         timers.update({k: v for k, v in t.items() if v})
         totals.update(tt)
+        exemplars.update(reg.exemplars())
     lines: List[str] = []
     for name, v in sorted(counters.items()):
         p = _prom_name(name, prefix)
@@ -557,6 +651,21 @@ def prometheus_text(registries, prefix: str = "geomesa") -> str:
         h = histogram_summary(vals)
         cum_count, cum_sum = totals.get(name, (h["count"], sum(vals)))
         lines.append(f"# TYPE {p} summary")
+        # p99 exemplar as a COMMENT line: the text exposition (version
+        # 0.0.4) allows only an optional timestamp after a sample value,
+        # and OpenMetrics forbids exemplars on summary quantiles — an
+        # inline suffix would abort the whole scrape. A '# exemplar:'
+        # comment is ignored by every parser while still shipping the
+        # worst bucket's (value, trace_id) link to /debug/traces in the
+        # same scrape body (the full structure serves on /debug/slo).
+        slot = exemplars.get(name)
+        if slot and slot["buckets"]:
+            s, tid, ts = slot["buckets"][max(slot["buckets"])]
+            if tid:
+                lines.append(
+                    f'# exemplar: {p}{{quantile="0.99"}} '
+                    f'trace_id="{tid}" value={s:g} ts={ts / 1000.0:.3f}'
+                )
         for label, key in (("0.5", "p50_ms"), ("0.9", "p90_ms"),
                            ("0.95", "p95_ms"), ("0.99", "p99_ms")):
             lines.append(f'{p}{{quantile="{label}"}} {h[key] / 1000:g}')
@@ -679,6 +788,60 @@ def reporters_from_config(
             r.start()
         out.append(r)
     return out
+
+
+# -- slow-query log: bounded tail + storm guard -------------------------------
+#
+# The slow-query log (store/datastore._log_slow_query) renders a FULL
+# span tree + plan explain per emission — exactly the thing you cannot
+# afford once per query during the overload event you are trying to
+# debug. The guard rate-limits full emissions to
+# ``geomesa.query.slow.max.per.min`` (dropped renders counted under
+# ``slowlog.dropped``), while EVERY slow query still files a cheap
+# summary entry into a bounded in-memory tail — the "slow-query log
+# tail" section of the /debug/report incident bundle.
+
+_SLOWLOG_TAIL = 256
+_SLOWLOG: deque = deque(maxlen=_SLOWLOG_TAIL)
+_SLOWLOG_EMITS: deque = deque()  # monotonic stamps of full emissions
+_SLOWLOG_LOCK = threading.Lock()
+
+
+def slow_query_note(entry: Dict[str, Any]) -> bool:
+    """File one slow query into the tail; True when the caller may emit
+    the FULL log render (inside this minute's budget), False when the
+    storm guard dropped the render (summary retained, ``dropped``
+    flagged, ``slowlog.dropped`` counted)."""
+    from geomesa_tpu.utils.config import SLOW_QUERY_MAX_PER_MIN
+
+    limit = SLOW_QUERY_MAX_PER_MIN.to_int()
+    limit = 60 if limit is None else limit
+    now = time.monotonic()
+    entry = dict(entry)
+    entry.setdefault("date_ms", int(time.time() * 1000))
+    with _SLOWLOG_LOCK:
+        cutoff = now - 60.0
+        while _SLOWLOG_EMITS and _SLOWLOG_EMITS[0] < cutoff:
+            _SLOWLOG_EMITS.popleft()
+        allowed = len(_SLOWLOG_EMITS) < limit
+        if allowed:
+            _SLOWLOG_EMITS.append(now)
+        else:
+            entry["dropped"] = True
+        _SLOWLOG.append(entry)
+    if not allowed:
+        robustness_metrics().inc("slowlog.dropped")
+    return allowed
+
+
+def slow_query_tail(n: int = 50) -> List[Dict[str, Any]]:
+    """Last ``n`` slow-query summaries (oldest first) — the incident
+    report's slow-log section; entries the storm guard suppressed carry
+    ``dropped: True`` (the summary survives, only the render was shed)."""
+    if n <= 0:
+        return []
+    with _SLOWLOG_LOCK:
+        return list(_SLOWLOG)[-n:]
 
 
 class QueryTimeout(RuntimeError):

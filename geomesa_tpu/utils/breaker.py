@@ -107,11 +107,15 @@ class CircuitBreaker:
         with _REGISTRY_LOCK:
             _REGISTRY.add(self)
         # live state gauge (same-named breakers overwrite each other;
-        # breaker_states() merges them by worst state instead)
+        # breaker_states() merges them by worst state instead). Reads
+        # peek_state: a metrics snapshot — the Prometheus scrape, a
+        # Reporter tick, the timeline sampler — must OBSERVE the
+        # breaker, never run its open->half-open transition (the next
+        # real caller's allow() ticks it identically)
         ref = weakref.ref(self)
         robustness_metrics().gauge_fn(
             f"breaker.{name}.state",
-            lambda: _STATE_GAUGE[ref().state] if ref() is not None else 0.0,
+            lambda: _STATE_GAUGE[ref().peek_state] if ref() is not None else 0.0,
         )
 
     # -- state ---------------------------------------------------------------
@@ -130,6 +134,19 @@ class CircuitBreaker:
         with self._lock:
             self._tick_locked()
             return self._state
+
+    @property
+    def peek_state(self) -> str:
+        """PASSIVE state read for the telemetry sampler
+        (utils/timeline.py): computes the effective state (an elapsed
+        cooldown reads as half-open) WITHOUT taking the lock or running
+        the open->half-open transition — a sampler tick must never
+        mutate breaker state, contend with the query path, or release a
+        probe slot. May lag a concurrent transition by one tick."""
+        s = self._state
+        if s == OPEN and self._clock() - self._opened_at >= self.cooldown_s:
+            return HALF_OPEN
+        return s
 
     def allow(self) -> bool:
         """May a call proceed? Closed: always. Open: never (counted under
@@ -222,6 +239,21 @@ def breaker_states() -> Dict[str, str]:
         live = list(_REGISTRY)
     for b in live:
         s = b.state
+        if _SEVERITY[s] >= _SEVERITY.get(out.get(b.name, CLOSED), 0):
+            out[b.name] = s
+    return out
+
+
+def peek_states() -> Dict[str, str]:
+    """breaker_states() for the telemetry sampler: every live breaker's
+    ``peek_state`` (passive — no transitions run, no locks taken),
+    worst-per-name. The timeline must observe breakers, never drive
+    them."""
+    out: Dict[str, str] = {}
+    with _REGISTRY_LOCK:
+        live = list(_REGISTRY)
+    for b in live:
+        s = b.peek_state
         if _SEVERITY[s] >= _SEVERITY.get(out.get(b.name, CLOSED), 0):
             out[b.name] = s
     return out

@@ -20,6 +20,19 @@ from typing import Dict, Optional
 _overrides: Dict[str, str] = {}
 _lock = threading.Lock()
 
+# every SystemProperty ever constructed, by name (last construction
+# wins a name collision — module reloads in tests). The incident-report
+# bundle (web.py GET /debug/report) snapshots this: "what was every knob
+# resolved to WHEN the pager fired" is the config half of any incident.
+_KNOWN: Dict[str, "SystemProperty"] = {}
+
+
+def config_snapshot() -> Dict[str, Optional[str]]:
+    """Every known knob's CURRENTLY-RESOLVED value (override -> env ->
+    default), sorted by name. A point-in-time read — cheap enough for
+    the /debug/report bundle, never cached."""
+    return {name: _KNOWN[name].get() for name in sorted(_KNOWN)}
+
 
 def set_property(name: str, value: Optional[str]) -> None:
     """Set (or clear, with None) a programmatic override — the top tier."""
@@ -64,6 +77,7 @@ class SystemProperty:
     def __init__(self, name: str, default: Optional[str] = None):
         self.name = name
         self.default = default
+        _KNOWN[name] = self  # GIL-atomic; last construction wins
 
     def get(self) -> Optional[str]:
         with _lock:
@@ -222,6 +236,49 @@ SOCKET_TIMEOUT = SystemProperty("geomesa.socket.timeout", "10 seconds")
 # plus the plan explain (the audit-log "why was this one slow" answer;
 # duration string, e.g. '500 ms'). Unset = no slow-query log.
 SLOW_QUERY_THRESHOLD = SystemProperty("geomesa.query.slow.threshold", None)
+# Slow-log storm guard: at most this many FULL slow-query log emissions
+# (span tree + explain render) per minute; entries past the budget still
+# land in the bounded in-memory tail (utils/audit.slow_query_tail — the
+# /debug/report section) as a cheap summary, counted under
+# `slowlog.dropped`. An overload event must not turn the observability
+# layer into the bottleneck it is measuring.
+SLOW_QUERY_MAX_PER_MIN = SystemProperty("geomesa.query.slow.max.per.min", "60")
+# Flight-recorder telemetry timeline (utils/timeline.py): a daemon
+# thread samples every registry counter/gauge/timer, breaker states,
+# admission depth, and device stats once per `interval` into a
+# fixed-memory ring covering `window` — the "what changed in the last
+# 60 seconds" answer behind GET /debug/timeline. `enabled=0` starts no
+# sampler thread AND keeps the hot path at zero added work (the timer
+# exemplar hook below stays a single module-flag read).
+TIMELINE_ENABLED = SystemProperty("geomesa.timeline.enabled", "true")
+TIMELINE_INTERVAL = SystemProperty("geomesa.timeline.interval", "1 second")
+TIMELINE_WINDOW = SystemProperty("geomesa.timeline.window", "1 hour")
+# SLO engine (utils/slo.py): declarative latency/availability objectives
+# per query class (query, join, aggregate, stream first-batch) with
+# multi-window burn rates (fast / slow) computed over the timeline ring.
+# A class is VIOLATING — /healthz degrades, naming it — when both
+# windows burn faster than their thresholds AND the fast window saw at
+# least `min.events` (a single failed query on a quiet store must not
+# page anyone). `exemplars=1` (with the timeline enabled) makes timer
+# reservoirs keep (value, trace_id) exemplars per latency bucket so the
+# p99 links straight to a retained trace in /debug/traces.
+SLO_ENABLED = SystemProperty("geomesa.slo.enabled", "true")
+SLO_EXEMPLARS = SystemProperty("geomesa.slo.exemplars", "true")
+SLO_WINDOW_FAST = SystemProperty("geomesa.slo.window.fast", "5 minutes")
+SLO_WINDOW_SLOW = SystemProperty("geomesa.slo.window.slow", "1 hour")
+SLO_BURN_FAST = SystemProperty("geomesa.slo.burn.fast", "14.4")
+SLO_BURN_SLOW = SystemProperty("geomesa.slo.burn.slow", "1.0")
+SLO_MIN_EVENTS = SystemProperty("geomesa.slo.min.events", "100")
+SLO_AVAILABILITY = SystemProperty("geomesa.slo.availability", "0.999")
+SLO_LATENCY_OBJECTIVE = SystemProperty("geomesa.slo.latency.objective", "0.99")
+SLO_QUERY_LATENCY_MS = SystemProperty("geomesa.slo.query.latency.ms", "250")
+SLO_JOIN_LATENCY_MS = SystemProperty("geomesa.slo.join.latency.ms", "1000")
+SLO_AGGREGATE_LATENCY_MS = SystemProperty(
+    "geomesa.slo.aggregate.latency.ms", "250"
+)
+SLO_STREAM_FIRST_LATENCY_MS = SystemProperty(
+    "geomesa.slo.stream.first.latency.ms", "250"
+)
 # Crash recovery (store/journal.py): corrupt files quarantined by the
 # integrity layer are kept for operator inspection, then aged out by the
 # store-open scrub once older than this TTL (bounds disk leakage from

@@ -1,0 +1,311 @@
+"""SLO engine: declarative per-query-class objectives with multi-window
+burn rates over the telemetry timeline.
+
+With GeoBlocks-style caching (PR 8) and coalescing (PR 9) the latency
+distribution is strongly bimodal — pyramid hit vs. exact scan, coalesced
+vs. solo — so an aggregate p99 actively misleads: it averages two
+different machines. This module evaluates objectives PER QUERY CLASS,
+the classes derived from the existing ``QueryEvent.outcome`` counters
+and root-span timer names the audit layer already writes:
+
+    query               queries / queries.{timeout,shed} / query.scan
+    join                queries.join / queries.join.{timeout,shed} / query.join
+    aggregate           queries.aggregate / ... / query.aggregate
+    stream_first_batch  queries.stream / query.stream.first
+
+(``query_many`` members audit into the ``query`` class — each resolves
+under its own root span and budget, PR 4 semantics.)
+
+Two objective kinds per class:
+
+* **availability** — bad = timeout + shed outcomes over the window;
+* **latency** — bad = timer samples over the class's threshold
+  (``geomesa.slo.<class>.latency.ms``), counted from the timeline's
+  per-tick latency-bucket histograms (bucket resolution: a sample in
+  the threshold's own power-of-two bucket counts as GOOD — the engine
+  under-counts violations by at most one bucket, never cries wolf).
+
+Burn rate = (bad / events) / (1 - objective): 1.0 means the error
+budget spends exactly at sustainable pace. A class is VIOLATING when
+BOTH the fast window (default 5 m) and the slow window (default 1 h)
+burn past their thresholds (defaults 14.4 / 1.0 — the classic
+page-on-fast-burn pair) AND the fast window saw at least
+``geomesa.slo.min.events`` events. The AND gives fast alert RESET: the
+moment the fast window slides clean, /healthz clears, even while the
+slow window still remembers the incident.
+
+Exemplars close the loop: with ``geomesa.slo.exemplars`` on (raised by
+the first timeline sampler), every timer keeps (value, trace_id) pairs
+per latency bucket (utils/audit.py), so ``GET /debug/slo`` and the
+incident report link each class's worst samples straight to retained
+traces in ``/debug/traces``.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from geomesa_tpu.utils import audit
+
+# query class -> (events counter, bad-outcome counters, latency timer).
+# Derived from what store/datastore.py already writes per class — the
+# engine adds no hot-path instrumentation of its own.
+CLASSES: Dict[str, Dict[str, Any]] = {
+    "query": {
+        "counter": "queries",
+        "bad": ("queries.timeout", "queries.shed"),
+        "timer": "query.scan",
+    },
+    "join": {
+        "counter": "queries.join",
+        "bad": ("queries.join.timeout", "queries.join.shed"),
+        "timer": "query.join",
+    },
+    "aggregate": {
+        "counter": "queries.aggregate",
+        "bad": ("queries.aggregate.timeout", "queries.aggregate.shed"),
+        "timer": "query.aggregate",
+    },
+    "stream_first_batch": {
+        "counter": "queries.stream",
+        "bad": (),
+        "timer": "query.stream.first",
+    },
+}
+
+
+@dataclass
+class SloSpec:
+    """One objective: ``kind`` is ``availability`` (good = outcome ok)
+    or ``latency`` (good = under ``latency_ms``); ``objective`` is the
+    good-fraction target (0.999 = three nines)."""
+
+    name: str
+    cls: str
+    kind: str
+    objective: float
+    latency_ms: Optional[float] = None
+
+    def __post_init__(self):
+        if self.cls not in CLASSES:
+            raise ValueError(
+                f"unknown query class {self.cls!r} (classes: {sorted(CLASSES)})"
+            )
+        if self.kind not in ("availability", "latency"):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError("objective must be in (0, 1)")
+        if self.kind == "latency" and not self.latency_ms:
+            raise ValueError("latency SLOs need latency_ms")
+
+
+def default_slos() -> List[SloSpec]:
+    """The knob-driven default objective set: availability + latency per
+    class (availability skipped for the stream class — a stream that
+    fails pre-first-byte already audits as a ``query`` outcome)."""
+    from geomesa_tpu.utils import config as cfg
+
+    avail = cfg.SLO_AVAILABILITY.to_float() or 0.999
+    lat_obj = cfg.SLO_LATENCY_OBJECTIVE.to_float() or 0.99
+    lat_ms = {
+        "query": cfg.SLO_QUERY_LATENCY_MS.to_float(),
+        "join": cfg.SLO_JOIN_LATENCY_MS.to_float(),
+        "aggregate": cfg.SLO_AGGREGATE_LATENCY_MS.to_float(),
+        "stream_first_batch": cfg.SLO_STREAM_FIRST_LATENCY_MS.to_float(),
+    }
+    out: List[SloSpec] = []
+    for cls in CLASSES:
+        if CLASSES[cls]["bad"]:
+            out.append(SloSpec(f"{cls}-availability", cls, "availability", avail))
+        if lat_ms.get(cls):
+            out.append(
+                SloSpec(
+                    f"{cls}-latency", cls, "latency", lat_obj,
+                    latency_ms=float(lat_ms[cls]),
+                )
+            )
+    return out
+
+
+def slo_knobs() -> tuple:
+    """(enabled, fast_s, slow_s, fast_burn, slow_burn, min_events)."""
+    from geomesa_tpu.utils import config as cfg
+
+    enabled = bool(cfg.SLO_ENABLED.to_bool())
+    fast_s = cfg.SLO_WINDOW_FAST.to_duration_s(300.0)
+    slow_s = cfg.SLO_WINDOW_SLOW.to_duration_s(3600.0)
+    fast_burn = cfg.SLO_BURN_FAST.to_float() or 14.4
+    slow_burn = cfg.SLO_BURN_SLOW.to_float() or 1.0
+    me = cfg.SLO_MIN_EVENTS.to_int()
+    min_events = 100 if me is None else me
+    return enabled, fast_s, slow_s, fast_burn, slow_burn, min_events
+
+
+class SloEngine:
+    """Evaluates a spec set over a ``TimelineSampler``'s ring.
+
+    Pure reads: window sums over recorded snapshots plus exemplar
+    lookups — the engine adds nothing to the query path and is safe to
+    call from /healthz on every probe."""
+
+    def __init__(self, sampler, specs: Optional[List[SloSpec]] = None):
+        self.sampler = sampler
+        self.specs = list(specs) if specs is not None else default_slos()
+
+    # -- window folding ------------------------------------------------------
+
+    @staticmethod
+    def _fold(snaps: List[Dict[str, Any]], spec: SloSpec) -> Tuple[int, int]:
+        """(events, bad) for one spec over one window's snapshots."""
+        meta = CLASSES[spec.cls]
+        events = 0
+        bad = 0
+        if spec.kind == "availability":
+            for s in snaps:
+                deltas = s.get("counters", {})
+                events += deltas.get(meta["counter"], 0)
+                bad += sum(deltas.get(b, 0) for b in meta["bad"])
+            return events, bad
+        # latency: fold the per-tick bucket histograms. A sample in the
+        # threshold's own bucket reads as good (bucket-edge resolution);
+        # buckets strictly above the threshold's are violations.
+        thr_bucket = audit.exemplar_bucket(spec.latency_ms / 1000.0)
+        for s in snaps:
+            t = s.get("timers", {}).get(meta["timer"])
+            if not t:
+                continue
+            events += t.get("count", 0)
+            for b, n in t.get("hist", {}).items():
+                if int(b) > thr_bucket:
+                    bad += n
+        return events, bad
+
+    def _window_eval(
+        self, spec: SloSpec, window_s: float, snaps: List[Dict[str, Any]]
+    ) -> Dict[str, Any]:
+        events, bad = self._fold(snaps, spec)
+        budget = 1.0 - spec.objective
+        frac = (bad / events) if events else 0.0
+        return {
+            "window_s": window_s,
+            "coverage_s": round(len(snaps) * self.sampler.interval_s, 3),
+            "events": events,
+            "bad": bad,
+            "bad_fraction": round(frac, 6),
+            "burn_rate": round(frac / budget, 3) if budget > 0 else 0.0,
+        }
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, exemplars: bool = True) -> Dict[str, Any]:
+        """The GET /debug/slo body: every spec's fast/slow windows, burn
+        rates, violation verdicts, and (unless ``exemplars=False``)
+        worst exemplars, trace-linked. The ring is copied ONCE per
+        window, not per spec — /healthz probes this on every poll, and
+        the evaluation must never contend with the sampler's tick
+        beyond two bounded copies."""
+        enabled, fast_s, slow_s, fast_burn, slow_burn, min_events = slo_knobs()
+        slow_snaps = self.sampler.window(slow_s)
+        n_fast = max(1, int(round(fast_s / self.sampler.interval_s)))
+        fast_snaps = slow_snaps[-n_fast:] if slow_s >= fast_s else (
+            self.sampler.window(fast_s)
+        )
+        rows = []
+        violating = []
+        for spec in self.specs:
+            fast = self._window_eval(spec, fast_s, fast_snaps)
+            slow = self._window_eval(spec, slow_s, slow_snaps)
+            violated = (
+                enabled
+                and fast["events"] >= min_events
+                and fast["burn_rate"] >= fast_burn
+                and slow["burn_rate"] >= slow_burn
+            )
+            if violated:
+                violating.append(spec.name)
+            rows.append({
+                "name": spec.name,
+                "class": spec.cls,
+                "kind": spec.kind,
+                "objective": spec.objective,
+                "latency_ms": spec.latency_ms,
+                "fast": fast,
+                "slow": slow,
+                "violating": violated,
+                "exemplars": (
+                    self.worst_exemplars(spec.cls) if exemplars else []
+                ),
+            })
+        return {
+            "enabled": enabled,
+            "thresholds": {
+                "fast_burn": fast_burn,
+                "slow_burn": slow_burn,
+                "min_events": min_events,
+            },
+            "slos": rows,
+            "violating": violating,
+        }
+
+    def violating(self) -> List[str]:
+        """Just the violating SLO names — the /healthz degradation
+        input: one evaluation with exemplar gathering skipped (nobody
+        reads them on a health probe)."""
+        return self.evaluate(exemplars=False)["violating"]
+
+    # -- exemplars -----------------------------------------------------------
+
+    def worst_exemplars(self, cls: str, n: int = 3) -> List[Dict[str, Any]]:
+        """The class timer's worst retained exemplars (highest occupied
+        latency buckets first): ``[{ms, trace_id, date_ms}]`` with ids
+        resolvable in /debug/traces while the debug ring retains them."""
+        timer = CLASSES[cls]["timer"]
+        best: Dict[int, tuple] = {}
+        for reg in self.sampler.registries:
+            slot = reg.exemplars(timer)
+            if slot:
+                for b, ex in slot["buckets"].items():
+                    best[b] = ex
+        out = []
+        for b in sorted(best, reverse=True)[:n]:
+            s, tid, wall = best[b]
+            out.append({
+                "ms": round(s * 1000.0, 3),
+                "trace_id": tid,
+                "date_ms": int(wall),
+            })
+        return out
+
+
+# -- per-store engines --------------------------------------------------------
+
+_ENGINES: "weakref.WeakKeyDictionary[Any, SloEngine]" = (
+    weakref.WeakKeyDictionary()
+)
+_ENGINES_LOCK = threading.Lock()
+
+
+def engine_for(store, create: bool = True) -> Optional[SloEngine]:
+    """The store's SLO engine over its timeline sampler (None when the
+    engine or the timeline is disabled — /healthz then skips the slo
+    block entirely). ``create=False`` builds the (cheap) engine only
+    over an ALREADY-RUNNING sampler: a /healthz probe must never be the
+    thing that spawns a recorder thread."""
+    from geomesa_tpu.utils import timeline
+
+    enabled = slo_knobs()[0]
+    if not enabled:
+        return None
+    with _ENGINES_LOCK:
+        got = _ENGINES.get(store)
+    if got is not None:
+        return got
+    sampler = timeline.sampler_for(store, create=create)
+    if sampler is None:
+        return None
+    eng = SloEngine(sampler)
+    with _ENGINES_LOCK:
+        return _ENGINES.setdefault(store, eng)

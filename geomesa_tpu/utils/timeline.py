@@ -1,0 +1,366 @@
+"""Flight-recorder telemetry timeline: a fixed-memory ring of per-second
+deltas over every registry.
+
+PRs 2-3 made the system observable point-in-time (`/metrics`, span
+trees, cost receipts) — but the first question of any incident is *"what
+changed in the last 60 seconds?"*, and a Prometheus scrape interval is
+too coarse (and external) to answer it from inside the process. This
+module is the continuous layer: a ``TimelineSampler`` daemon thread
+snapshots, once per ``geomesa.timeline.interval``,
+
+* **counter deltas** of every registry the store's telemetry lands in
+  (the store's own ``MetricsRegistry``, ``robustness_metrics()``,
+  ``devstats_metrics()``) — only the counters that MOVED, so an idle
+  store's snapshots stay tiny;
+* **gauge values** (HBM residency, pad efficiency, cache sizes, ...);
+* **timer activity**: per-timer count/sum deltas plus a power-of-two
+  latency-bucket histogram of the interval's new samples (the shared
+  ``audit.exemplar_bucket`` rule — the SLO engine evaluates latency
+  objectives over any window by summing these buckets);
+* **breaker states** (``breaker.peek_states`` — PASSIVE reads: the
+  sampler never runs a transition, never releases a probe slot);
+* **admission depth** (``AdmissionController.peek`` — LOCK-FREE reads:
+  the sampler never contends with, let alone holds, the queue);
+* **cache hit/miss deltas** for the aggregate pyramid, join build, and
+  query-coalescing layers, with derived hit rates;
+* a per-shard rollup when the store is a ``ShardedDataStore``
+  (``_timeline_extra`` — each worker's telemetry gathered through the
+  worker-facing seam a cross-process transport would RPC).
+
+The ring covers ``geomesa.timeline.window`` (default 1 hour at 1 s
+ticks) and is served as ``GET /debug/timeline?s=60`` (web.py), embedded
+in bench artifacts (scripts/bench_gate.py), and bundled into the
+one-shot incident report (``GET /debug/report``).
+
+Free when off: ``geomesa.timeline.enabled=0`` starts no thread, and the
+only hot-path hook in the whole subsystem — the timer exemplar record in
+``audit.MetricsRegistry.update_timer`` — stays behind a single
+module-flag read (asserted by tests/test_timeline.py). The sampler
+itself only ever READS: it must never strike a breaker, hold the
+admission queue, or touch a fault point (chaos-soaked in
+tests/test_timeline.py via scripts/chaos_smoke.sh).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from geomesa_tpu.utils import audit
+from geomesa_tpu.utils.audit import MetricsRegistry
+
+_log = logging.getLogger("geomesa_tpu.timeline")
+
+# cache-layer counter pairs surfaced as per-tick hit rates: the bimodal
+# latency story (pyramid hit vs exact scan, coalesced vs solo) is
+# unreadable from aggregate percentiles alone
+_CACHE_RATES = (
+    ("agg", "agg.cache.hits", "agg.cache.misses"),
+    ("join_build", "join.build.hits", "join.build.misses"),
+)
+
+
+def timeline_knobs() -> tuple:
+    """(enabled, interval_s, window_s) from the geomesa.timeline.* tier."""
+    from geomesa_tpu.utils.config import (
+        TIMELINE_ENABLED,
+        TIMELINE_INTERVAL,
+        TIMELINE_WINDOW,
+    )
+
+    enabled = bool(TIMELINE_ENABLED.to_bool())
+    interval_s = TIMELINE_INTERVAL.to_duration_s(1.0)
+    window_s = TIMELINE_WINDOW.to_duration_s(3600.0)
+    return enabled, max(0.01, interval_s), max(interval_s, window_s)
+
+
+class TimelineSampler:
+    """One store's flight recorder: a daemon thread appending per-tick
+    delta snapshots to a bounded ring.
+
+    ``tick()`` is callable directly (tests drive it deterministically);
+    ``start()`` runs it on the interval. The sampler holds the store
+    WEAKLY — telemetry must never pin a store's tables and mirrors —
+    and the thread exits once the store is collected."""
+
+    def __init__(
+        self,
+        store: Any = None,
+        registries: Optional[List[MetricsRegistry]] = None,
+        interval_s: Optional[float] = None,
+        window_s: Optional[float] = None,
+    ):
+        _enabled, k_interval, k_window = timeline_knobs()
+        self.interval_s = k_interval if interval_s is None else float(interval_s)
+        self.window_s = k_window if window_s is None else float(window_s)
+        self._store = (lambda: None) if store is None else weakref.ref(store)
+        if registries is None:
+            from geomesa_tpu.utils.audit import robustness_metrics
+            from geomesa_tpu.utils.devstats import devstats_metrics
+
+            registries = [robustness_metrics(), devstats_metrics()]
+            m = getattr(store, "metrics", None)
+            if isinstance(m, MetricsRegistry):
+                # the store registry FIRST: its query.* names must win a
+                # (never expected) collision with the process registries
+                registries.insert(0, m)
+        self.registries = list(registries)
+        capacity = max(2, int(round(self.window_s / self.interval_s)))
+        self._ring: deque = deque(maxlen=capacity)
+        self._prev_counters: Dict[str, int] = {}
+        self._prev_totals: Dict[str, tuple] = {}
+        self._primed = False
+        self.ticks = 0  # cumulative, survives ring rotation
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sampling ------------------------------------------------------------
+
+    def _merged_snapshot(self):
+        counters: Dict[str, int] = {}
+        gauges: Dict[str, float] = {}
+        timers: Dict[str, List[float]] = {}
+        totals: Dict[str, tuple] = {}
+        # later registries must NOT overwrite the store's own names, so
+        # iterate in reverse priority (store registry listed first wins)
+        for reg in reversed(self.registries):
+            c, g, t, tt = reg.snapshot()
+            counters.update(c)
+            gauges.update(g)
+            timers.update(t)
+            totals.update(tt)
+        return counters, gauges, timers, totals
+
+    def tick(self) -> Optional[Dict[str, Any]]:
+        """Take one snapshot (append to the ring, return it). Never
+        raises — a telemetry failure must not kill the recorder loop —
+        and only ever READS the layers it observes."""
+        try:
+            return self._tick()
+        except Exception:  # noqa: BLE001 - recorder must outlive bad gauges
+            _log.exception("timeline tick failed; recording continues")
+            return None
+
+    def _tick(self) -> Dict[str, Any]:
+        from geomesa_tpu.utils.breaker import peek_states
+
+        counters, gauges, timers, totals = self._merged_snapshot()
+        with self._lock:
+            snap: Dict[str, Any] = {
+                "t": time.time(),
+                "dt_s": round(self.interval_s, 3),
+            }
+            if self._primed:
+                deltas = {
+                    k: v - self._prev_counters.get(k, 0)
+                    for k, v in counters.items()
+                    if v != self._prev_counters.get(k, 0)
+                }
+            else:
+                # first tick: establish the baseline, report no deltas
+                # (a process's whole history is not "the last second")
+                deltas = {}
+            snap["counters"] = deltas
+            snap["gauges"] = {k: v for k, v in gauges.items()}
+            tblock: Dict[str, Any] = {}
+            for name, (count, total_s) in totals.items():
+                pc, ps = self._prev_totals.get(name, (0, 0.0))
+                k = count - pc
+                if k <= 0 or not self._primed:
+                    continue
+                hist: Dict[int, int] = {}
+                # the interval's new samples are the reservoir tail —
+                # exact while fewer than RESERVOIR samples land per tick
+                # (4096/s; far past any load this process serves)
+                for s in timers.get(name, [])[-k:]:
+                    b = audit.exemplar_bucket(s)
+                    hist[b] = hist.get(b, 0) + 1
+                tblock[name] = {
+                    "count": k,
+                    "sum_ms": round((total_s - ps) * 1000.0, 3),
+                    "hist": hist,
+                }
+            snap["timers"] = tblock
+            snap["caches"] = self._cache_rates(deltas)
+            self._prev_counters = counters
+            self._prev_totals = totals
+            self._primed = True
+            # passive observations: peek_states runs no transitions,
+            # peek() takes no locks — the recorder watches, never drives
+            snap["breakers"] = peek_states()
+            store = self._store()
+            if store is not None:
+                adm = getattr(store, "admission", None)
+                if adm is not None:
+                    snap["admission"] = adm.peek()
+                extra = getattr(store, "_timeline_extra", None)
+                if extra is not None:
+                    snap.update(extra())
+            self._ring.append(snap)
+            self.ticks += 1
+            return snap
+
+    @staticmethod
+    def _cache_rates(deltas: Dict[str, int]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for label, hits_c, miss_c in _CACHE_RATES:
+            hits = deltas.get(hits_c, 0)
+            misses = deltas.get(miss_c, 0)
+            if hits or misses:
+                out[label] = {
+                    "hits": hits,
+                    "misses": misses,
+                    "rate": round(hits / (hits + misses), 3),
+                }
+        groups = deltas.get("batch.coalesce.groups", 0)
+        members = deltas.get("batch.coalesce.members", 0)
+        if groups:
+            out["coalesce"] = {
+                "groups": groups,
+                "members": members,
+                "mean_group": round(members / groups, 2),
+            }
+        return out
+
+    # -- ring access ---------------------------------------------------------
+
+    def window(self, s: Optional[float] = None) -> List[Dict[str, Any]]:
+        """The last ``s`` seconds of snapshots (oldest first; the whole
+        ring when ``s`` is None). Copies under the lock — a concurrent
+        tick can never mutate what a reader is serializing."""
+        with self._lock:
+            snaps = list(self._ring)
+        if s is None:
+            return snaps
+        n = max(1, int(round(float(s) / self.interval_s)))
+        return snaps[-n:]
+
+    def payload(self, s: Optional[float] = 60.0) -> Dict[str, Any]:
+        """The GET /debug/timeline body."""
+        snaps = self.window(s)
+        return {
+            "enabled": True,
+            "interval_s": self.interval_s,
+            "window_s": self.window_s,
+            "ticks": self.ticks,
+            "returned": len(snaps),
+            "snapshots": snaps,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "TimelineSampler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        ref = weakref.ref(self)
+
+        def loop():
+            while True:
+                me = ref()
+                if me is None:
+                    return
+                stop, interval = me._stop, me.interval_s
+                store_dead = (
+                    isinstance(me._store, weakref.ref)
+                    and me._store() is None
+                )
+                del me  # the loop must not pin the sampler between ticks
+                if store_dead:
+                    return  # telemetry dies with (never outlives) its store
+                if stop.wait(interval):
+                    return
+                me = ref()
+                if me is None:
+                    return
+                me.tick()
+                del me
+
+        t = threading.Thread(
+            target=loop, name="geomesa-timeline", daemon=True
+        )
+        self._thread = t
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+
+# -- per-store samplers -------------------------------------------------------
+#
+# One sampler per store, refcounted like trace.ensure_ring: each server
+# (web.GeoMesaServer) holds one reference, the last release stops the
+# thread and — when no sampler remains anywhere — drops the process-wide
+# exemplar flag back to the free-when-off state.
+
+_SAMPLERS: "weakref.WeakKeyDictionary[Any, TimelineSampler]" = (
+    weakref.WeakKeyDictionary()
+)
+_REFS: "weakref.WeakKeyDictionary[Any, int]" = weakref.WeakKeyDictionary()
+_SAMPLERS_LOCK = threading.Lock()
+
+
+def _exemplars_wanted() -> bool:
+    from geomesa_tpu.utils.config import SLO_EXEMPLARS
+
+    return bool(SLO_EXEMPLARS.to_bool())
+
+
+def sampler_for(store, create: bool = True) -> Optional[TimelineSampler]:
+    """The store's running sampler; started on first request when
+    ``geomesa.timeline.enabled`` (None otherwise, and None with
+    ``create=False`` when none exists yet). Starting the first sampler
+    also raises the timer-exemplar flag (``geomesa.slo.exemplars``) so
+    /debug/slo has traces to link; stopping the last drops it."""
+    with _SAMPLERS_LOCK:
+        got = _SAMPLERS.get(store)
+        if got is not None or not create:
+            return got
+        enabled, _i, _w = timeline_knobs()
+        if not enabled:
+            return None
+        sampler = TimelineSampler(store)
+        _SAMPLERS[store] = sampler
+        _REFS[store] = 0
+        if _exemplars_wanted():
+            audit.set_exemplars(True)
+    sampler.start()
+    return sampler
+
+
+def acquire(store) -> Optional[TimelineSampler]:
+    """sampler_for + one refcount (a server's hold on the recorder)."""
+    got = sampler_for(store)
+    if got is not None:
+        with _SAMPLERS_LOCK:
+            _REFS[store] = _REFS.get(store, 0) + 1
+    return got
+
+
+def release(store) -> None:
+    """Drop one server's hold; the last release stops the store's
+    sampler and, when no sampler remains for ANY store, restores the
+    exemplar hook to its free no-op path."""
+    stop_me = None
+    with _SAMPLERS_LOCK:
+        if store not in _SAMPLERS:
+            return
+        refs = _REFS.get(store, 0) - 1
+        if refs > 0:
+            _REFS[store] = refs
+            return
+        stop_me = _SAMPLERS.pop(store, None)
+        _REFS.pop(store, None)
+        if not _SAMPLERS:
+            audit.set_exemplars(False)
+    if stop_me is not None:
+        stop_me.stop()

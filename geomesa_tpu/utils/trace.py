@@ -413,7 +413,17 @@ def ensure_ring(capacity: int = 256) -> InMemoryTraceExporter:
     with _DEBUG_RING_LOCK:
         if _DEBUG_RING is None:
             _DEBUG_RING = install(
-                InMemoryTraceExporter(capacity, root_names=("query", "query.batch"))
+                InMemoryTraceExporter(
+                    capacity,
+                    root_names=(
+                        # every query-class root (utils/slo.py CLASSES):
+                        # exemplar trace ids from any class must resolve
+                        # here, and background roots (polls, ingest)
+                        # still can never evict them
+                        "query", "query.batch", "query.join",
+                        "query.aggregate", "query.stream",
+                    ),
+                )
             )
         _DEBUG_RING_REFS += 1
         return _DEBUG_RING
@@ -432,6 +442,19 @@ def release_ring() -> None:
             return
         ring, _DEBUG_RING, _DEBUG_RING_REFS = _DEBUG_RING, None, 0
     uninstall(ring)
+
+
+def find_trace(trace_id: str) -> Optional[Span]:
+    """Resolve one retained trace tree by id — how the incident report
+    (web.py GET /debug/report) turns an exemplar's trace_id into the
+    actual span tree. Searches the debug ring (or a test's in-memory
+    exporter); None once the ring has rotated past it."""
+    if not trace_id:
+        return None
+    for root in recent_traces(10**9):
+        if root.trace_id == trace_id:
+            return root
+    return None
 
 
 def recent_traces(n: int = 20) -> List[Span]:

@@ -45,6 +45,22 @@ SURVEY.md section 2.5). Endpoints over a datastore:
                                     quarantine aging), live pending-intent
                                     count, recovery./journal./quarantine.
                                     counters
+    GET /debug/timeline?s=60     -- flight-recorder timeline
+                                    (utils/timeline.py): the last s
+                                    seconds of per-tick delta snapshots —
+                                    counter deltas, gauges, timer latency
+                                    histograms, breaker states, admission
+                                    depth, cache hit rates, per-shard
+                                    rollup on sharded stores
+    GET /debug/slo               -- SLO engine (utils/slo.py): per-query-
+                                    class objectives, fast/slow-window
+                                    burn rates, violation verdicts, and
+                                    trace-linked worst exemplars
+    GET /debug/report?s=300      -- one-shot incident report: every
+                                    debug surface + slow-query log tail +
+                                    resolved exemplar traces + config
+                                    snapshot in ONE JSON bundle
+                                    (scripts/capture_report.py)
 
 Overload mapping: a ShedLoad from admission control and a
 ShardUnavailable from the sharded scatter/gather (parallel/shards.py)
@@ -74,6 +90,152 @@ MAX_DEBUG_TRACES = 1000
 # upload — an unbounded rfile.read(Content-Length) would buffer whatever
 # a client declares into RAM outside any admission/deadline envelope
 MAX_JOIN_BODY = 1 << 20
+
+# /debug/timeline default + cap on the requested window (seconds): the
+# ring is bounded anyway; the cap only stops an accidental ?s=1e12 from
+# serializing the whole ring into one response nobody asked for
+DEFAULT_TIMELINE_S = 60.0
+MAX_TIMELINE_S = 24 * 3600.0
+# the incident report's default timeline window
+DEFAULT_REPORT_S = 300.0
+
+
+# -- debug payloads -----------------------------------------------------------
+#
+# One function per /debug/* surface, shared by the route handlers AND
+# the /debug/report bundle assembly below — so a debug page and the
+# incident report can never drift apart. scripts/lint_observability.sh
+# enforces the closure: every /debug/<name> route registered in this
+# file must appear as a key in REPORT_SECTIONS (new debug surfaces are
+# incident-report-complete by construction).
+
+
+def debug_traces_payload(store, n: int = 20):
+    from geomesa_tpu.utils import trace as _trace
+
+    return [t.to_dict() for t in _trace.recent_traces(n)]
+
+
+def debug_device_payload(store):
+    from geomesa_tpu.utils.devstats import device_debug
+
+    return device_debug()
+
+
+def debug_overload_payload(store):
+    from geomesa_tpu.utils.audit import robustness_metrics
+    from geomesa_tpu.utils.breaker import breaker_states
+
+    counters, _g, _t, _tt = robustness_metrics().snapshot()
+    adm = getattr(store, "admission", None)
+    snap_fn = getattr(store, "shards_snapshot", None)
+    return {
+        "breakers": breaker_states(),
+        # admission snapshot includes the wait-time histogram summary
+        # (p50/p99): were queries queuing long before sheds, or did
+        # traffic spike straight past the queue?
+        "admission": None if adm is None else adm.snapshot(),
+        # per-shard breaker + admission states for sharded stores
+        # (parallel/shards.py)
+        "shards": None if snap_fn is None else snap_fn(),
+        "counters": {
+            k: v
+            for k, v in sorted(counters.items())
+            if k.startswith(("shed.", "breaker.", "deadline.", "shard."))
+        },
+    }
+
+
+def debug_recovery_payload(store):
+    from geomesa_tpu.utils.audit import robustness_metrics
+
+    counters, _g, _t, _tt = robustness_metrics().snapshot()
+    jr = getattr(store, "journal", None)
+    return {
+        "last_recovery": getattr(store, "last_recovery", None),
+        "journal_pending": None if jr is None else len(jr.pending()),
+        "counters": {
+            k: v
+            for k, v in sorted(counters.items())
+            if k.startswith(("recovery.", "journal.", "quarantine."))
+        },
+    }
+
+
+def debug_timeline_payload(store, s: float = DEFAULT_TIMELINE_S):
+    from geomesa_tpu.utils import timeline as _timeline
+
+    sampler = _timeline.sampler_for(store)
+    if sampler is None:
+        return {"enabled": False, "snapshots": []}
+    return sampler.payload(min(float(s), MAX_TIMELINE_S))
+
+
+def debug_slo_payload(store):
+    from geomesa_tpu.utils import slo as _slo
+
+    eng = _slo.engine_for(store)
+    if eng is None:
+        return {"enabled": False, "slos": [], "violating": []}
+    return eng.evaluate()
+
+
+# every /debug/* surface, by route name — the /debug/report bundle
+# assembles ALL of them (lint rule 4 pins the closure). Values take
+# (store, window_s); surfaces without a window ignore it.
+REPORT_SECTIONS = {
+    "traces": lambda store, s: debug_traces_payload(store, 20),
+    "device": lambda store, s: debug_device_payload(store),
+    "overload": lambda store, s: debug_overload_payload(store),
+    "recovery": lambda store, s: debug_recovery_payload(store),
+    "timeline": lambda store, s: debug_timeline_payload(store, s),
+    "slo": lambda store, s: debug_slo_payload(store),
+}
+
+
+def incident_report(store, window_s: float = DEFAULT_REPORT_S):
+    """The GET /debug/report bundle: ONE JSON artifact with everything a
+    pager needs attached — the timeline window, SLO/burn-rate state,
+    every debug surface, the slow-query log tail, the worst exemplar
+    traces RESOLVED to their full span trees (while the debug ring
+    retains them), and the complete resolved config. A section that
+    fails to assemble reports its error instead of failing the bundle —
+    a half-broken process is exactly when the report matters most."""
+    import time as _time
+
+    from geomesa_tpu.utils import slo as _slo
+    from geomesa_tpu.utils import trace as _trace
+    from geomesa_tpu.utils.audit import slow_query_tail
+    from geomesa_tpu.utils.config import config_snapshot
+
+    out = {
+        "generated_ms": int(_time.time() * 1000),
+        "window_s": window_s,
+        "store": type(store).__name__,
+        "sections": {},
+    }
+    for name, fn in REPORT_SECTIONS.items():
+        try:
+            out["sections"][name] = fn(store, window_s)
+        except Exception as e:  # noqa: BLE001 - report the failure, keep the rest
+            out["sections"][name] = {"error": f"{type(e).__name__}: {e}"}
+    out["slow_queries"] = slow_query_tail(50)
+    # resolve each violating class's worst exemplars into full trees:
+    # the report carries the trace, not just a pointer a rotated ring
+    # may no longer answer
+    exemplar_traces = {}
+    eng = _slo.engine_for(store, create=False)
+    if eng is not None:
+        for row in out["sections"].get("slo", {}).get("slos", ()):
+            for ex in row.get("exemplars", ()):
+                tid = ex.get("trace_id")
+                if tid and tid not in exemplar_traces:
+                    root = _trace.find_trace(tid)
+                    if root is not None:
+                        exemplar_traces[tid] = root.to_dict()
+    out["exemplar_traces"] = exemplar_traces
+    out["config"] = config_snapshot()
+    return out
 
 
 def make_handler(store):
@@ -157,6 +319,22 @@ def make_handler(store):
                 self._write_chunk(chunk)
             self._write_chunk(b"")  # terminating 0-chunk: stream complete
             self._streaming = False
+
+        def _window_param(self, params, default_s: float):
+            """Validate the ?s= window (seconds) for the timeline/report
+            routes: non-numeric or negative answers 400 and returns
+            None; absurdly large clamps (the ring is bounded anyway)."""
+            try:
+                s = float(params.get("s", default_s))
+            except ValueError:
+                self._send(
+                    400, json.dumps({"error": "s must be a number of seconds"})
+                )
+                return None
+            if not (s >= 0):  # rejects NaN too ('nan < 0' is False)
+                self._send(400, json.dumps({"error": "s must be >= 0"}))
+                return None
+            return min(s, MAX_TIMELINE_S)
 
         def _write_chunk(self, data: bytes) -> None:
             self.wfile.write(f"{len(data):x}\r\n".encode())
@@ -467,10 +645,24 @@ def make_handler(store):
                             "replicas": snap["replicas"],
                             "unavailable": down,
                         }
+                    # SLO burn-rate degradation (utils/slo.py): while any
+                    # query class burns its error budget past both window
+                    # thresholds, /healthz names the violating SLO so a
+                    # balancer (and the on-call) can steer BEFORE the
+                    # breaker/shed machinery has anything to show.
+                    # create=False: a health probe must never be what
+                    # spawns the recorder thread — the engine only
+                    # evaluates when a sampler is already running
+                    from geomesa_tpu.utils import slo as _slo
+
+                    eng = _slo.engine_for(store, create=False)
+                    if eng is not None:
+                        violating = eng.violating()
+                        body["slo"] = {"violating": violating}
+                        if violating:
+                            body["status"] = "degraded"
                     self._send(200, json.dumps(body))
                 elif route == "/debug/traces":
-                    from geomesa_tpu.utils import trace as _trace
-
                     # validate ?n= rather than bubbling a 500: non-numeric
                     # and negative are the CALLER's error (400); absurdly
                     # large just clamps — the ring is bounded anyway and a
@@ -490,51 +682,16 @@ def make_handler(store):
                     n = min(n, MAX_DEBUG_TRACES)
                     self._send(
                         200,
-                        json.dumps(
-                            [t.to_dict() for t in _trace.recent_traces(n)],
-                            default=str,
-                        ),
+                        json.dumps(debug_traces_payload(store, n), default=str),
                     )
                 elif route == "/debug/overload":
                     # overload-protection debug page: every breaker's
                     # live state, the store's admission snapshot, and the
                     # shed/deadline/breaker counters — the operator's
                     # one-stop "why are we 503ing" answer
-                    from geomesa_tpu.utils.audit import robustness_metrics
-                    from geomesa_tpu.utils.breaker import breaker_states
-
-                    counters, _g, _t, _tt = robustness_metrics().snapshot()
-                    adm = getattr(store, "admission", None)
-                    snap_fn = getattr(store, "shards_snapshot", None)
                     self._send(
                         200,
-                        json.dumps(
-                            {
-                                "breakers": breaker_states(),
-                                # admission snapshot includes the wait-
-                                # time histogram summary (p50/p99): were
-                                # queries queuing long before sheds, or
-                                # did traffic spike straight past the
-                                # queue?
-                                "admission": (
-                                    None if adm is None else adm.snapshot()
-                                ),
-                                # per-shard breaker + admission states
-                                # for sharded stores (parallel/shards.py)
-                                "shards": (
-                                    None if snap_fn is None else snap_fn()
-                                ),
-                                "counters": {
-                                    k: v
-                                    for k, v in sorted(counters.items())
-                                    if k.startswith(
-                                        ("shed.", "breaker.", "deadline.",
-                                         "shard.")
-                                    )
-                                },
-                            },
-                            default=str,
-                        ),
+                        json.dumps(debug_overload_payload(store), default=str),
                     )
                 elif route == "/debug/recovery":
                     # crash-consistency debug page: what startup recovery
@@ -543,39 +700,50 @@ def make_handler(store):
                     # deferred deletes awaiting the next open), and the
                     # process-wide recovery/journal/quarantine counters —
                     # the operator's "did that crash lose anything" answer
-                    from geomesa_tpu.utils.audit import robustness_metrics
-
-                    counters, _g, _t, _tt = robustness_metrics().snapshot()
-                    jr = getattr(store, "journal", None)
                     self._send(
                         200,
-                        json.dumps(
-                            {
-                                "last_recovery": getattr(
-                                    store, "last_recovery", None
-                                ),
-                                "journal_pending": (
-                                    None if jr is None else len(jr.pending())
-                                ),
-                                "counters": {
-                                    k: v
-                                    for k, v in sorted(counters.items())
-                                    if k.startswith(
-                                        ("recovery.", "journal.",
-                                         "quarantine.")
-                                    )
-                                },
-                            },
-                            default=str,
-                        ),
+                        json.dumps(debug_recovery_payload(store), default=str),
                     )
                 elif route == "/debug/device":
                     # device/compiler telemetry page: per-kernel compile +
                     # cache accounting, transfer byte totals, padding
                     # efficiency, best-effort HBM (utils/devstats.py)
-                    from geomesa_tpu.utils.devstats import device_debug
-
-                    self._send(200, json.dumps(device_debug(), default=str))
+                    self._send(
+                        200, json.dumps(debug_device_payload(store), default=str)
+                    )
+                elif route == "/debug/timeline":
+                    # the flight recorder (utils/timeline.py): the last
+                    # ?s= seconds of per-tick delta snapshots — counter
+                    # deltas, gauges, timer histograms, breaker states,
+                    # admission depth, cache hit rates, per-shard rollup
+                    s = self._window_param(params, DEFAULT_TIMELINE_S)
+                    if s is None:
+                        return
+                    self._send(
+                        200,
+                        json.dumps(
+                            debug_timeline_payload(store, s), default=str
+                        ),
+                    )
+                elif route == "/debug/slo":
+                    # the SLO engine (utils/slo.py): per-query-class
+                    # objectives, fast/slow-window burn rates, violation
+                    # verdicts, and trace-linked worst exemplars
+                    self._send(
+                        200, json.dumps(debug_slo_payload(store), default=str)
+                    )
+                elif route == "/debug/report":
+                    # the one-shot incident report: every debug surface +
+                    # slow-query tail + exemplar traces + config snapshot
+                    # in ONE bundle — the artifact you attach to a pager
+                    # (scripts/capture_report.py fetches and files it)
+                    s = self._window_param(params, DEFAULT_REPORT_S)
+                    if s is None:
+                        return
+                    self._send(
+                        200,
+                        json.dumps(incident_report(store, s), default=str),
+                    )
                 elif route == "/stats/count":
                     name = params["name"]
                     exact = params.get("exact", "true").lower() != "false"
@@ -616,10 +784,26 @@ class GeoMesaServer:
     """Embeddable server; ``with GeoMesaServer(store) as url: ...``"""
 
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0):
+        from geomesa_tpu.utils import timeline as _timeline
         from geomesa_tpu.utils import trace as _trace
 
         _trace.ensure_ring()  # /debug/traces has a sink from the start
-        self.httpd = ThreadingHTTPServer((host, port), make_handler(store))
+        self._store = store
+        self._sampler_held = False
+        try:
+            self.httpd = ThreadingHTTPServer((host, port), make_handler(store))
+        except BaseException:
+            # a failed bind must not leak the trace ring reference
+            _trace.release_ring()
+            raise
+        # the flight recorder starts with the server (None when
+        # geomesa.timeline.enabled=0): /debug/timeline, /debug/slo, and
+        # /debug/report have history from the first request, and the
+        # last server's exit stops the thread (free-when-off, like the
+        # trace ring). Acquired AFTER the socket bind — a port conflict
+        # raising out of __init__ has no __exit__ to release the sampler
+        # (or its process-wide exemplar flag)
+        self._sampler_held = _timeline.acquire(store) is not None
         self.thread: Optional[threading.Thread] = None
         self._ring_held = True
 
@@ -634,10 +818,14 @@ class GeoMesaServer:
         return self.url
 
     def __exit__(self, *exc):
+        from geomesa_tpu.utils import timeline as _timeline
         from geomesa_tpu.utils import trace as _trace
 
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self._sampler_held:
+            self._sampler_held = False
+            _timeline.release(self._store)
         if self._ring_held:
             # a short-lived embedded server must not leave the tracer
             # active for the rest of the process (free-when-off contract)
@@ -646,8 +834,10 @@ class GeoMesaServer:
 
 
 def serve(store, host: str = "127.0.0.1", port: int = 8765) -> None:
+    from geomesa_tpu.utils import timeline as _timeline
     from geomesa_tpu.utils import trace as _trace
 
     _trace.ensure_ring()
+    _timeline.acquire(store)  # the recorder runs for the server's lifetime
     httpd = ThreadingHTTPServer((host, port), make_handler(store))
     httpd.serve_forever()

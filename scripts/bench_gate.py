@@ -356,10 +356,23 @@ def run_stream(n: int, reps: int) -> dict:
     compile_s0 = devstats.devstats_metrics().snapshot()[3].get(
         "xla.compile", (0, 0.0)
     )[1]
-    with trace.exporting(ring):
-        t0 = time.perf_counter()
-        results = [store.query("gdelt", q) for q in queries]
-        total_s = time.perf_counter() - t0
+    # flight recorder riding the measured stream (utils/timeline.py):
+    # the artifact embeds the per-tick snapshots so a noisy run can be
+    # triaged post-hoc (did recompiles land mid-stream? did a breaker
+    # flap?) instead of just failing a band with no story
+    from geomesa_tpu.utils.timeline import TimelineSampler
+
+    sampler = TimelineSampler(store=store, interval_s=0.25, window_s=120.0)
+    sampler.start()
+    try:
+        with trace.exporting(ring):
+            t0 = time.perf_counter()
+            results = [store.query("gdelt", q) for q in queries]
+            total_s = time.perf_counter() - t0
+    finally:
+        sampler.tick()  # close the window: the tail of the stream lands
+        sampler.stop()
+    timeline_snaps = sampler.window(None)[-40:]
     receipt = devstats.receipt_since(dev0)
     compile_s1 = devstats.devstats_metrics().snapshot()[3].get(
         "xla.compile", (0, 0.0)
@@ -398,6 +411,12 @@ def run_stream(n: int, reps: int) -> dict:
         "concurrent": concurrent,
         "stream": stream,
         "loadavg_1m": loadavg,
+        # the headline stream's flight-recorder window (not gated:
+        # triage context for humans reading a failed band)
+        "timeline": {
+            "interval_s": sampler.interval_s,
+            "snapshots": timeline_snaps,
+        },
         "config": {
             "n": n,
             "reps": reps,
@@ -638,6 +657,26 @@ def compare(baseline: dict, current: dict, tolerance: dict = None) -> list:
     return out
 
 
+def load_warning(baseline: dict, current: dict) -> str:
+    """The load-sensitivity caveat, or "" when the box was no busier
+    than at recording. The gate is known load-sensitive; a failing time
+    band under higher load than the recording may be noise. Slack of
+    0.5: a baseline recorded on an idle box (loadavg ~0) must not make
+    every future check warn on ordinary background noise. Returned (not
+    just printed) so --check PERSISTS it into the artifact — a flaky
+    band in CI history should carry its own explanation."""
+    b_load = baseline.get("loadavg_1m")
+    c_load = current.get("loadavg_1m")
+    if b_load is None or c_load is None or c_load <= b_load + 0.5:
+        return ""
+    return (
+        f"1m loadavg {c_load} exceeds the baseline's {b_load} — this "
+        "gate is load-sensitive; a failing time band under higher load "
+        "than the recording may be noise (re-run on a quiet machine "
+        "before trusting it)"
+    )
+
+
 def span_deltas(baseline: dict, current: dict, top: int = 8) -> list:
     """Informational per-span ms/query deltas (largest growth first) —
     the "where did it go" context printed next to a failing gate."""
@@ -724,6 +763,11 @@ def main(argv=None) -> int:
     )
     artifact = attempts[len(attempts) // 2]  # median per_query_ms
     artifact = inject_slowdown(artifact, args.inject_slowdown)
+    warn = "" if baseline is None else load_warning(baseline, artifact)
+    if warn:
+        # persisted INTO the artifact/check result, not only printed:
+        # the CI artifact of a flaky band carries its own explanation
+        artifact["load_warning"] = warn
     text = json.dumps(artifact, indent=1, sort_keys=True)
     if args.out:
         with open(args.out, "w") as fh:
@@ -747,22 +791,8 @@ def main(argv=None) -> int:
         f"recompiles={artifact['devstats']['recompiles']}, "
         f"d2h={artifact['devstats']['d2h_bytes']:,}B"
     )
-    # the gate is known load-sensitive: when this run's 1-minute loadavg
-    # exceeds the baseline's, say so — a failing band on a busy machine
-    # may be noise, and a silent flake gives the operator no hint why
-    # slack of 0.5: a baseline recorded on an idle box (loadavg ~0) must
-    # not make every future check "warn" on ordinary background noise —
-    # the warning is for genuinely busier-than-recording runs
-    b_load = baseline.get("loadavg_1m")
-    c_load = artifact.get("loadavg_1m")
-    if b_load is not None and c_load is not None and c_load > b_load + 0.5:
-        print(
-            f"load warning: 1m loadavg {c_load} exceeds the baseline's "
-            f"{b_load} — this gate is load-sensitive; a failing time "
-            "band under higher load than the recording may be noise "
-            "(re-run on a quiet machine before trusting it)",
-            file=sys.stderr,
-        )
+    if warn:
+        print(f"load warning: {warn}", file=sys.stderr)
     if regressions:
         print("REGRESSION:", file=sys.stderr)
         for line in regressions:

@@ -1,0 +1,86 @@
+"""One-shot incident report capture: fetch GET /debug/report from a
+running geomesa-tpu server and file the JSON bundle to disk.
+
+The artifact you attach to a pager: the timeline window around the
+incident, SLO/burn-rate state, worst exemplar traces (resolved to full
+span trees), device/overload/recovery blocks, the slow-query log tail,
+and the complete resolved config — captured in ONE request so the
+snapshot is internally consistent.
+
+Usage:
+    python scripts/capture_report.py http://127.0.0.1:8765
+    python scripts/capture_report.py http://host:8765 -o incident.json -s 600
+
+Retries transient fetch failures (the server may be the thing that is
+hurting — a report capturer that gives up on the first 503 defeats its
+purpose) and prints a one-line triage summary: violating SLOs, timeline
+coverage, worst exemplar.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+ATTEMPTS = 3
+BACKOFF_S = 1.0
+
+
+def fetch_report(base_url: str, window_s: float, timeout_s: float) -> dict:
+    url = f"{base_url.rstrip('/')}/debug/report?s={window_s:g}"
+    last = None
+    for attempt in range(ATTEMPTS):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                return json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            last = e
+            if attempt + 1 < ATTEMPTS:
+                time.sleep(BACKOFF_S * (attempt + 1))
+    raise SystemExit(f"could not fetch {url}: {last}")
+
+
+def summarize(report: dict) -> str:
+    slo = report.get("sections", {}).get("slo", {})
+    tl = report.get("sections", {}).get("timeline", {})
+    violating = slo.get("violating", [])
+    worst = None
+    for row in slo.get("slos", ()):
+        for ex in row.get("exemplars", ()):
+            if worst is None or ex["ms"] > worst["ms"]:
+                worst = ex
+    parts = [
+        f"violating={','.join(violating) if violating else 'none'}",
+        f"timeline_snapshots={len(tl.get('snapshots', ()))}",
+        f"slow_queries={len(report.get('slow_queries', ()))}",
+    ]
+    if worst is not None:
+        parts.append(f"worst_exemplar={worst['ms']:g}ms trace={worst['trace_id']}")
+    return " ".join(parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", help="server base url, e.g. http://127.0.0.1:8765")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: incident-<epoch>.json)")
+    ap.add_argument("-s", "--window", type=float, default=300.0,
+                    help="timeline window seconds (default 300)")
+    ap.add_argument("--timeout", type=float, default=30.0,
+                    help="per-request socket timeout seconds")
+    args = ap.parse_args(argv)
+
+    report = fetch_report(args.url, args.window, args.timeout)
+    out = args.out or f"incident-{int(time.time())}.json"
+    with open(out, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"report written: {out}")
+    print(f"summary: {summarize(report)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,11 +33,17 @@
 #     density aggregations answer IDENTICAL results to the fault-free
 #     run (a failed pyramid build degrades to the uncached exact scan),
 #     and a crash schedule dies crisply mid-build
+#   - telemetry under faults (tests/test_timeline.py): the flight-
+#     recorder sampler keeps snapshots flowing while fault schedules
+#     fire, and the sampler thread is strictly PASSIVE — it never
+#     strikes a breaker, runs a breaker transition, or holds the
+#     admission queue (the observability layer must not perturb the
+#     failure behavior it records)
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
 cd "$(dirname "$0")/.."
 exec timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_crash.py tests/test_shards.py \
-    tests/test_join.py tests/test_agg_cache.py -q -m chaos \
-    -p no:cacheprovider "$@"
+    tests/test_join.py tests/test_agg_cache.py tests/test_timeline.py \
+    -q -m chaos -p no:cacheprovider "$@"

@@ -68,6 +68,30 @@ while IFS= read -r hit; do
     fail=1
 done < <(grep -rnE 'jax\.jit\(' --include='*.py' geomesa_tpu/ || true)
 
+# 4. Incident-report completeness — every /debug/* endpoint web.py
+#    serves must be assembled into the GET /debug/report bundle
+#    (REPORT_SECTIONS): a debug surface an operator can open by hand but
+#    the pager artifact silently omits is exactly the section missing at
+#    3am. New debug endpoints are report-complete by construction or
+#    this lint fails. (/debug/report itself is the bundle, exempt.)
+sections=$(sed -n '/^REPORT_SECTIONS = {/,/^}/p' geomesa_tpu/web.py)
+if [ -z "$sections" ]; then
+    echo "FAIL: geomesa_tpu/web.py lost its REPORT_SECTIONS = {...} block"
+    echo "      (the /debug/report bundle assembly the report lint pins)"
+    fail=1
+fi
+while IFS= read -r route; do
+    name="${route#\"/debug/}"
+    name="${name%\"}"
+    [ "$name" = "report" ] && continue
+    if ! printf '%s\n' "$sections" | grep -q "\"${name}\""; then
+        echo "FAIL: /debug/${name} is served by web.py but missing from the"
+        echo "      /debug/report bundle (add a \"${name}\" entry to"
+        echo "      REPORT_SECTIONS so incident reports stay complete)"
+        fail=1
+    fi
+done < <(grep -oE '"/debug/[a-z_]+"' geomesa_tpu/web.py | sort -u)
+
 if [ "$fail" -eq 0 ]; then
     echo "observability lint clean"
 fi

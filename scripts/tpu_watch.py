@@ -1,5 +1,19 @@
 """Watch for the axon TPU tunnel to come up; run the hardware batch.
 
+Second mode — live server watch: pass a server URL instead of a log
+path and the watcher tails a RUNNING geomesa-tpu server's telemetry
+timeline instead::
+
+    python scripts/tpu_watch.py http://127.0.0.1:8765
+
+One ``GET /debug/timeline?s=<refresh>`` request per refresh
+(TPU_WATCH_REFRESH seconds, default 2): the server-side flight recorder
+(utils/timeline.py) already holds per-second deltas, so the watcher
+renders them directly — no client-side /metrics scraping-and-diffing,
+no state between refreshes, and the numbers match what /debug/report
+would capture. Ctrl-C exits.
+
+
 Probes in a killable subprocess every PERIOD seconds (the in-process claim
 can hang indefinitely). On the first healthy probe it runs, sequentially
 (judge-critical numbers first so a short window still yields them):
@@ -252,6 +266,81 @@ def batch() -> None:
                 break
 
 
+def _fmt_rate(block: dict) -> str:
+    return f"{block['hits']}/{block['hits'] + block['misses']}"
+
+
+def watch_server(url: str) -> None:
+    """The live-watch loop: one /debug/timeline request per refresh,
+    rendering the window's aggregate deltas as a top-style line. The
+    server's ring supplies history and deltas — the client keeps NO
+    state and never diffs /metrics itself."""
+    import urllib.request
+
+    refresh = float(os.environ.get("TPU_WATCH_REFRESH", 2))
+    endpoint = f"{url.rstrip('/')}/debug/timeline?s={refresh:g}"
+    print(f"watching {endpoint} every {refresh:g}s (Ctrl-C to exit)", flush=True)
+    while True:
+        try:
+            with urllib.request.urlopen(endpoint, timeout=10) as resp:
+                body = json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            print(f"[{time.strftime('%H:%M:%S')}] fetch failed: {e}", flush=True)
+            time.sleep(refresh)
+            continue
+        if not body.get("enabled", False):
+            print("server timeline disabled (geomesa.timeline.enabled=0)")
+            return
+        snaps = body.get("snapshots", [])
+        # fold the refresh window's snapshots into one delta line
+        counters: dict = {}
+        caches: dict = {}
+        coalesce = {"groups": 0, "members": 0}
+        breakers: dict = {}
+        admission: dict = {}
+        for s in snaps:
+            for k, v in s.get("counters", {}).items():
+                counters[k] = counters.get(k, 0) + v
+            for label, block in s.get("caches", {}).items():
+                if label == "coalesce":
+                    # groups/members, not a hit/miss cache — rendering
+                    # it as a rate would read healthy coalescing as 0%
+                    coalesce["groups"] += block.get("groups", 0)
+                    coalesce["members"] += block.get("members", 0)
+                    continue
+                acc = caches.setdefault(label, {"hits": 0, "misses": 0})
+                acc["hits"] += block.get("hits", 0)
+                acc["misses"] += block.get("misses", 0)
+            breakers = s.get("breakers", breakers)
+            admission = s.get("admission", admission)
+        open_breakers = sorted(
+            n for n, st in breakers.items() if st != "closed"
+        )
+        parts = [
+            f"q={counters.get('queries', 0)}",
+            f"to={counters.get('queries.timeout', 0) + counters.get('deadline.exceeded', 0)}",
+            f"shed={counters.get('shed.overflow', 0)}",
+            f"h2d={counters.get('device.h2d.bytes', 0):,}B",
+            f"d2h={counters.get('device.d2h.bytes', 0):,}B",
+            f"compiles={counters.get('xla.compile.total', 0)}",
+        ]
+        if admission:
+            parts.append(
+                f"adm={admission.get('inflight', 0)}+{admission.get('queued', 0)}q"
+            )
+        for label, block in sorted(caches.items()):
+            if block["hits"] + block["misses"]:
+                parts.append(f"{label}={_fmt_rate(block)}")
+        if coalesce["groups"]:
+            parts.append(
+                f"coalesce={coalesce['members']}q/{coalesce['groups']}grp"
+            )
+        if open_breakers:
+            parts.append(f"breakers={','.join(open_breakers)}")
+        print(f"[{time.strftime('%H:%M:%S')}] " + " ".join(parts), flush=True)
+        time.sleep(refresh)
+
+
 def main():
     log(f"watching for TPU (period {PERIOD}s, once={ONCE})")
     lock = AxonLock()
@@ -285,4 +374,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1].startswith(("http://", "https://")):
+        try:
+            watch_server(sys.argv[1])
+        except KeyboardInterrupt:
+            pass
+    else:
+        main()

@@ -214,6 +214,34 @@ def test_new_legs_survive_injected_slowdown():
     )
 
 
+def test_load_warning_persisted_and_slacked():
+    """PR 10 satellite: the loadavg caveat is RETURNED (main() persists
+    it into the artifact) rather than only printed, and the 0.5 slack
+    keeps an idle-box baseline from warning on background noise."""
+    base, cur = _artifact(), _artifact()
+    base["loadavg_1m"], cur["loadavg_1m"] = 0.1, 0.4
+    assert bench_gate.load_warning(base, cur) == ""  # inside the slack
+    cur["loadavg_1m"] = 3.2
+    warn = bench_gate.load_warning(base, cur)
+    assert "3.2" in warn and "0.1" in warn and "load-sensitive" in warn
+    # either side missing (pre-PR-8 baseline, loadavg-less platform): quiet
+    assert bench_gate.load_warning(_artifact(), cur) == ""
+
+
+def test_timeline_embed_survives_injection_and_compare():
+    """The bench artifact's flight-recorder window is triage context,
+    not a gated band: compare() ignores it and inject_slowdown carries
+    it through untouched."""
+    base, cur = _artifact(), _artifact()
+    cur["timeline"] = {
+        "interval_s": 0.25,
+        "snapshots": [{"t": 1.0, "counters": {"queries": 6}}],
+    }
+    assert bench_gate.compare(base, cur) == []
+    out = bench_gate.inject_slowdown(cur, 2.0)
+    assert out["timeline"] == cur["timeline"]
+
+
 def test_config_mismatch_refuses_to_compare():
     cur = _artifact()
     cur["config"]["n"] = 100

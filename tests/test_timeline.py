@@ -1,0 +1,693 @@
+"""Continuous telemetry (PR 10): the flight-recorder timeline
+(utils/timeline.py), the SLO burn-rate engine (utils/slo.py), timer
+exemplars, the slow-log storm guard, and the one-shot incident report
+(GET /debug/report).
+
+Pins the PR 10 contract:
+
+* free when off — with ``geomesa.timeline.enabled=0`` no sampler thread
+  starts and the only hot-path hook (the timer exemplar record) stays a
+  single module-flag read that never touches the tracer;
+* the sampler is strictly PASSIVE — snapshots keep flowing under fault
+  schedules, and a tick never runs a breaker transition, strikes a
+  breaker, or holds the admission queue;
+* exemplar attribution is per-member — through PR 9's coalesced groups
+  and PR 6's hedged shard requests, a ``query.scan`` exemplar carries
+  the MEMBER's own trace id, never the group leader's or the hedge
+  loser's;
+* burn-rate degradation is end to end — a chaos-injected latency
+  schedule drives the fast window over threshold, /healthz degrades
+  naming the violating SLO, and recovery clears it;
+* /debug/report is one self-consistent bundle: timeline, SLO state,
+  resolvable exemplar traces, device/overload/recovery, the slow-query
+  tail, and the full config snapshot.
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import audit, faults, slo, timeline, trace
+from geomesa_tpu.utils.audit import (
+    InMemoryAuditWriter,
+    MetricsRegistry,
+    QueryTimeout,
+    robustness_metrics,
+)
+from geomesa_tpu.utils.breaker import CircuitBreaker
+from geomesa_tpu.utils.config import properties
+
+T0 = 1483228800000  # 2017-01-01T00:00:00Z
+DAY = 86400000
+SPEC = "actor:String,dtg:Date,*geom:Point:srid=4326"
+CQL = "bbox(geom, -50, -50, 50, 50)"
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    """Restore the process exporter list AND the exemplar flag around
+    every test (both are process-wide by design)."""
+    flag = audit.exemplars_enabled()
+    with trace._EXPORTERS_LOCK:
+        saved = list(trace._EXPORTERS)
+    yield
+    audit.set_exemplars(flag)
+    with trace._EXPORTERS_LOCK:
+        added = [e for e in trace._EXPORTERS if e not in saved]
+        trace._EXPORTERS[:] = saved
+    if trace._DEBUG_RING is not None and trace._DEBUG_RING in added:
+        trace._DEBUG_RING = None
+        trace._DEBUG_RING_REFS = 0
+
+
+def _fill(store, name="gdelt", n=2000, seed=3):
+    ft = parse_spec(name, SPEC)
+    store.create_schema(ft)
+    rng = np.random.default_rng(seed)
+    store._insert_columns(ft, {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-80, 80, n),
+        "geom__y": rng.uniform(-80, 80, n),
+        "dtg": T0 + rng.integers(0, 30 * DAY, n),
+        "actor": np.array([["USA", "FRA", "CHN"][i % 3] for i in range(n)],
+                          dtype=object),
+    })
+    return store
+
+
+def _get(url):
+    return json.loads(urllib.request.urlopen(url, timeout=10).read())
+
+
+# -- free when off ------------------------------------------------------------
+
+
+def test_exemplar_hook_free_when_off(monkeypatch):
+    """The lint-style overhead assertion: with the flag down,
+    update_timer must not even READ the tracer — a poisoned
+    current_trace_id proves the fast path touches nothing beyond the
+    one module-flag check."""
+    reg = MetricsRegistry()
+    audit.set_exemplars(False)
+
+    def boom():
+        raise AssertionError("hot path read the tracer with exemplars off")
+
+    monkeypatch.setattr(trace, "current_trace_id", boom)
+    for _ in range(100):
+        reg.update_timer("query.scan", 0.01)
+    assert reg.exemplars() == {}  # no exemplar state ever allocated
+    monkeypatch.undo()
+    audit.set_exemplars(True)
+    with trace.exporting(trace.InMemoryTraceExporter()):
+        with trace.span("query"):
+            reg.update_timer("query.scan", 0.2)
+    ex = reg.exemplars("query.scan")
+    assert ex and ex["recent"][0][1]  # recorded, with a trace id
+
+
+def test_exemplar_hook_overhead_bounded():
+    """Microbench direction check: the disabled path must not cost more
+    than the enabled path (it does strictly less work — one global read
+    vs. tracer read + bucket math). Generous 2x margin; medians over
+    repeats absorb scheduler noise."""
+    reg = MetricsRegistry()
+    n = 20_000
+
+    def measure():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            reg.update_timer("bench.timer", 0.001)
+        return time.perf_counter() - t0
+
+    audit.set_exemplars(False)
+    off = sorted(measure() for _ in range(3))[1]
+    audit.set_exemplars(True)
+    with trace.exporting(trace.InMemoryTraceExporter()):
+        with trace.span("query"):
+            on = sorted(measure() for _ in range(3))[1]
+    audit.set_exemplars(False)
+    assert off <= on * 2.0, (off, on)
+
+
+def test_disabled_timeline_starts_no_sampler():
+    from geomesa_tpu.web import debug_timeline_payload
+
+    store = _fill(TpuDataStore(metrics=MetricsRegistry()))
+    with properties(geomesa_timeline_enabled="false"):
+        assert timeline.sampler_for(store) is None
+        assert debug_timeline_payload(store) == {
+            "enabled": False, "snapshots": [],
+        }
+        # no sampler -> no engine for /healthz (create=False contract)
+        assert slo.engine_for(store, create=False) is None
+
+
+# -- the sampler --------------------------------------------------------------
+
+
+def test_tick_deltas_gauges_and_timer_histograms():
+    reg = MetricsRegistry()
+    reg.inc("queries", 5)
+    reg.set_gauge("plan_cache.size", 7)
+    s = timeline.TimelineSampler(registries=[reg], interval_s=0.1, window_s=10)
+    first = s.tick()
+    assert first["counters"] == {}  # priming tick: history is not a delta
+    reg.inc("queries", 3)
+    reg.inc("queries.timeout", 1)
+    reg.update_timer("query.scan", 0.010)  # bucket 3 (8-16ms)
+    reg.update_timer("query.scan", 0.500)  # bucket 8 (256-512ms)
+    snap = s.tick()
+    assert snap["counters"] == {"queries": 3, "queries.timeout": 1}
+    assert snap["gauges"]["plan_cache.size"] == 7
+    t = snap["timers"]["query.scan"]
+    assert t["count"] == 2 and t["hist"] == {3: 1, 8: 1}
+    assert abs(t["sum_ms"] - 510.0) < 1.0
+    # an idle tick reports nothing moved
+    idle = s.tick()
+    assert idle["counters"] == {} and idle["timers"] == {}
+
+
+def test_ring_is_fixed_memory_and_window_slices():
+    reg = MetricsRegistry()
+    s = timeline.TimelineSampler(registries=[reg], interval_s=1.0, window_s=5)
+    for _ in range(12):
+        s.tick()
+    assert s.ticks == 12
+    assert len(s.window(None)) == 5  # ring capacity = window / interval
+    assert len(s.window(2)) == 2
+    assert len(s.window(100)) == 5
+    p = s.payload(3)
+    assert p["enabled"] and p["returned"] == 3 and p["ticks"] == 12
+
+
+def test_sampler_observes_breakers_and_admission_passively():
+    """The chaos invariant, deterministically: a tick reports an OPEN
+    breaker (and, past cooldown, reads it as half-open) WITHOUT running
+    the transition, striking it, or touching its probe slot — and reads
+    admission depth without the condition lock."""
+    clk = {"t": 0.0}
+    br = CircuitBreaker("tl.passive", failures=1, window_s=30,
+                        cooldown_s=5.0, clock=lambda: clk["t"])
+    br.record_failure()  # trips open
+    store = _fill(TpuDataStore(metrics=MetricsRegistry()))
+    s = timeline.TimelineSampler(store=store, interval_s=0.1, window_s=10)
+    before, _g, _t, _tt = robustness_metrics().snapshot()
+    snap = s.tick()
+    assert snap["breakers"]["tl.passive"] == "open"
+    assert snap["admission"] == {
+        "inflight": 0, "queued": 0, "sheds": 0, "admitted": 0,
+    }
+    clk["t"] = 10.0  # past cooldown: peek READS half-open...
+    snap = s.tick()
+    assert snap["breakers"]["tl.passive"] == "half-open"
+    assert br._state == "open"  # ...but never RUNS the transition
+    after, _g, _t, _tt = robustness_metrics().snapshot()
+    for k in set(before) | set(after):
+        if k.startswith("breaker.tl.passive."):
+            assert after.get(k, 0) == before.get(k, 0), k
+    # a real caller still gets the probe (sampling consumed nothing)
+    assert br.allow()
+
+
+def test_cache_hit_rates_derived_per_tick():
+    reg = MetricsRegistry()
+    s = timeline.TimelineSampler(registries=[reg], interval_s=0.1, window_s=10)
+    s.tick()
+    reg.inc("agg.cache.hits", 9)
+    reg.inc("agg.cache.misses", 1)
+    reg.inc("batch.coalesce.groups", 2)
+    reg.inc("batch.coalesce.members", 6)
+    snap = s.tick()
+    assert snap["caches"]["agg"] == {"hits": 9, "misses": 1, "rate": 0.9}
+    assert snap["caches"]["coalesce"] == {
+        "groups": 2, "members": 6, "mean_group": 3.0,
+    }
+
+
+def test_sampler_thread_runs_and_stops():
+    reg = MetricsRegistry()
+    s = timeline.TimelineSampler(registries=[reg], interval_s=0.02, window_s=5)
+    s.start()
+    deadline_ts = time.time() + 5.0
+    while s.ticks < 3 and time.time() < deadline_ts:
+        time.sleep(0.01)
+    s.stop()
+    assert s.ticks >= 3
+    settled = s.ticks
+    time.sleep(0.1)
+    assert s.ticks == settled  # stopped means stopped
+
+
+def test_sharded_rollup_reports_per_worker_telemetry():
+    from geomesa_tpu.parallel.shards import ShardedDataStore
+
+    sh = _fill(ShardedDataStore(num_shards=3, replicas=1,
+                                metrics=MetricsRegistry()))
+    s = timeline.TimelineSampler(store=sh, interval_s=0.1, window_s=10)
+    snap = s.tick()
+    assert set(snap["shards"]) == {"0", "1", "2"}
+    for block in snap["shards"].values():
+        assert block["breaker"] == "closed"
+        assert "inflight" in block["admission"]
+        assert block["partitions"] >= 0
+    assert sum(b["partitions"] for b in snap["shards"].values()) > 0
+
+
+# -- per-class accounting feeding the SLO engine ------------------------------
+
+
+def test_stream_first_batch_timer_and_aggregate_counters():
+    reg = MetricsRegistry()
+    store = _fill(TpuDataStore(metrics=reg))
+    batches = list(store.query_stream("gdelt", CQL))
+    assert batches
+    _c, _g, timers, totals = reg.snapshot()
+    assert totals["query.stream.first"][0] == 1
+    assert len(timers["query.stream.first"]) == 1
+    got = store.aggregate("gdelt", CQL)
+    assert got["count"] > 0
+    assert reg.counter("queries.aggregate") == 1
+    assert reg.snapshot()[3]["query.aggregate"][0] == 1
+
+
+# -- the SLO engine -----------------------------------------------------------
+
+
+def _slo_props(**extra):
+    base = dict(
+        geomesa_slo_min_events="5",
+        geomesa_slo_window_fast="1 second",
+        geomesa_slo_window_slow="3 seconds",
+    )
+    base.update(extra)
+    return properties(**base)
+
+
+def test_latency_burn_counts_bucketed_violations():
+    reg = MetricsRegistry()
+    s = timeline.TimelineSampler(registries=[reg], interval_s=0.1, window_s=10)
+    s.tick()
+    for _ in range(5):
+        reg.update_timer("query.scan", 0.010)  # well under 250 ms
+    for _ in range(5):
+        reg.update_timer("query.scan", 0.600)  # well over
+    s.tick()
+    with _slo_props():
+        ev = slo.SloEngine(s).evaluate()
+    row = next(r for r in ev["slos"] if r["name"] == "query-latency")
+    assert row["fast"]["events"] == 10 and row["fast"]["bad"] == 5
+    # bad_fraction 0.5 over a 0.99 objective: burn 50x >> both thresholds
+    assert row["fast"]["burn_rate"] > 14.4
+    assert row["violating"]
+    assert "query-latency" in ev["violating"]
+
+
+def test_availability_burn_needs_min_events():
+    reg = MetricsRegistry()
+    s = timeline.TimelineSampler(registries=[reg], interval_s=0.1, window_s=10)
+    s.tick()
+    reg.inc("queries", 2)
+    reg.inc("queries.timeout", 2)
+    s.tick()
+    with _slo_props():  # min 5 events: 2 total failures must not page
+        assert "query-availability" not in slo.SloEngine(s).violating()
+    reg.inc("queries", 8)
+    reg.inc("queries.timeout", 8)
+    s.tick()
+    with _slo_props():
+        assert "query-availability" in slo.SloEngine(s).violating()
+
+
+def test_worst_exemplars_link_traces():
+    reg = MetricsRegistry()
+    audit.set_exemplars(True)
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        with trace.span("query") as sp:
+            reg.update_timer("query.scan", 0.4)
+        tid = sp.trace_id
+    s = timeline.TimelineSampler(registries=[reg], interval_s=0.1, window_s=10)
+    out = slo.SloEngine(s).worst_exemplars("query")
+    assert out and out[0]["trace_id"] == tid
+    assert out[0]["ms"] == pytest.approx(400.0)
+
+
+# -- slow-log storm guard -----------------------------------------------------
+
+
+def test_slow_log_storm_guard_rate_limits_renders(caplog):
+    with audit._SLOWLOG_LOCK:  # deterministic regardless of test order
+        audit._SLOWLOG.clear()
+        audit._SLOWLOG_EMITS.clear()
+    store = _fill(TpuDataStore(metrics=MetricsRegistry(), slow_query_s=0.0))
+    d0 = robustness_metrics().counter("slowlog.dropped")
+    with properties(geomesa_query_slow_max_per_min="2"):
+        with caplog.at_level(logging.WARNING, logger="geomesa_tpu.slowquery"):
+            for _ in range(5):
+                store.query("gdelt", CQL)
+    rendered = [r for r in caplog.records if "slow query" in r.getMessage()]
+    assert len(rendered) == 2  # the per-minute render budget
+    assert robustness_metrics().counter("slowlog.dropped") - d0 == 3
+    tail = audit.slow_query_tail(10)
+    assert len(tail) == 5  # EVERY slow query kept a summary
+    assert sum(1 for e in tail if e.get("dropped")) == 3
+    assert all(e["trace_id"] and e["duration_ms"] >= 0 for e in tail)
+
+
+# -- exemplar attribution through coalescing and hedging ----------------------
+
+
+def _make_device_store(n=6000):
+    """Single-device store on the device scan path (the serving shape
+    the coalescer targets; concurrent SOLO queries on the 8-virtual-
+    device conftest mesh can deadlock in XLA's collective rendezvous —
+    the pre-existing hazard test_batch_coalesce documents)."""
+    import bench
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+
+    import jax
+
+    x, y, t = bench.synthesize(n)
+    store = TpuDataStore(
+        executor=TpuScanExecutor(default_mesh(jax.devices()[:1])),
+        metrics=MetricsRegistry(),
+        audit_writer=InMemoryAuditWriter(),
+    )
+    ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
+    store.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    store._insert_columns(
+        ft, {"__fid__": fids, "geom__x": x, "geom__y": y, "dtg": t}
+    )
+    store.query("gdelt", bench.QUERY)  # warm: mirror + kernels
+    return store
+
+
+@pytest.fixture(scope="module")
+def device_store():
+    """Shared clean device store (tests that fault it build their own —
+    an opened breaker must not leak into siblings)."""
+    return _make_device_store()
+
+
+def test_coalesced_members_keep_their_own_exemplar_traces(device_store):
+    """PR 9 interaction: members of one coalesced group each record
+    their query.scan sample under their OWN trace id — never the group
+    leader's. The audit rows (whose trace_id joins the span tree) are
+    ground truth."""
+    import bench
+    from geomesa_tpu.utils import devstats
+
+    store = device_store
+    ring = trace.InMemoryTraceExporter(capacity=16)
+    audit.set_exemplars(True)
+    queries = [Query.cql(bench.QUERY) for _ in range(3)]
+    n0 = len(store.audit_writer.events)
+    g0 = devstats.devstats_metrics().counter("batch.coalesce.groups")
+    barrier = threading.Barrier(3)
+    errors = []
+
+    def worker(q):
+        try:
+            barrier.wait(timeout=10)
+            store.query("gdelt", q)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    old_reg = store.metrics
+    store.metrics = MetricsRegistry()  # exemplar set == exactly this run
+    try:
+        with trace.exporting(ring):
+            with properties(geomesa_batch_enabled="true",
+                            geomesa_batch_window_ms="150"):
+                ts = [
+                    threading.Thread(target=worker, args=(q,)) for q in queries
+                ]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=60)
+        assert not errors, errors
+        assert devstats.devstats_metrics().counter("batch.coalesce.groups") > g0
+        member_ids = {e.trace_id for e in store.audit_writer.events[n0:]}
+        assert len(member_ids) == 3  # three queries, three distinct traces
+        ex = store.metrics.exemplars("query.scan")
+        recent_ids = {tid for _s, tid, _t in ex["recent"]}
+        # every exemplar is a member's own trace — and all three members
+        # appear (a leader-capture bug would collapse them to one id)
+        assert recent_ids == member_ids
+    finally:
+        store.metrics = old_reg
+
+
+def test_hedged_queries_keep_their_own_exemplar_traces():
+    """PR 6 interaction: a query whose shard scan hedged (loser
+    cancelled) still records its query.scan exemplar under its OWN
+    trace id — and never under another query's."""
+    from geomesa_tpu.geom.base import Point
+    from geomesa_tpu.parallel.shards import ShardedDataStore
+
+    with properties(geomesa_shard_hedge_min_ms="20"):
+        sh = ShardedDataStore(
+            num_shards=3, replicas=1,
+            metrics=MetricsRegistry(), audit_writer=InMemoryAuditWriter(),
+        )
+        sh.create_schema(parse_spec("t", "name:String,dtg:Date,*geom:Point:srid=4326"))
+        rs = np.random.RandomState(0)
+        with sh.writer("t") as w:
+            for i in range(120):
+                w.write(
+                    [f"n{i % 5}", T0 + int(rs.randint(0, 30 * DAY)),
+                     Point(float(rs.uniform(-70, 70)), float(rs.uniform(-70, 70)))],
+                    fid=f"f{i:04d}",
+                )
+        # find a data-bearing shard and make it lag so a hedge fires
+        ring0 = trace.InMemoryTraceExporter(capacity=4)
+        with trace.exporting(ring0):
+            sh.query("t", "INCLUDE")
+        victim = int(next(iter(
+            [r for r in ring0.traces if r.name == "query"][-1]
+            .attributes["shards"]
+        )))
+        orig = sh.workers[victim].scan
+
+        def slow(name, q, parts):
+            time.sleep(0.3)
+            return orig(name, q, parts)
+
+        sh.workers[victim].scan = slow
+        m = robustness_metrics()
+        h0 = m.counter("shard.hedge.issued")
+        audit.set_exemplars(True)
+        n0 = len(sh.audit_writer.events)
+        ring = trace.InMemoryTraceExporter(capacity=8)
+        with trace.exporting(ring):
+            sh.query("t", "INCLUDE")
+            sh.query("t", "BBOX(geom, -20, -20, 20, 20)")
+        assert m.counter("shard.hedge.issued") > h0  # a hedge really fired
+        own_ids = {e.trace_id for e in sh.audit_writer.events[n0:]}
+        assert len(own_ids) == 2
+        ex = sh.metrics.exemplars("query.scan")
+        recent_ids = {tid for _s, tid, _t in ex["recent"]}
+        # each query's sample carries its own trace — the hedge loser's
+        # thread (same trace, cancelled scan) contributed nothing extra,
+        # and no sample crossed between the two queries
+        assert recent_ids == own_ids
+
+
+# -- burn-rate degradation end to end (acceptance) ----------------------------
+
+
+def test_burn_rate_degrades_healthz_and_recovers(device_store, monkeypatch):
+    """A chaos-injected latency schedule (device.fetch lags past the
+    query budget) starves queries into crisp timeouts; the fast-window
+    burn rate crosses threshold; /healthz degrades NAMING the violating
+    SLO; the schedule ends, the fast window slides clean, and /healthz
+    recovers. QueryTimeout is never a device failure (PR 4), so the
+    degradation here is PURELY the SLO engine's — no breaker opens."""
+    import bench
+    from geomesa_tpu.web import GeoMesaServer
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")  # keep the device scan path live
+    store = device_store
+    with properties(
+        geomesa_timeline_interval="50 ms",
+        geomesa_slo_min_events="5",
+        geomesa_slo_window_fast="2 seconds",
+        geomesa_slo_window_slow="6 seconds",
+    ):
+        with GeoMesaServer(store) as url:
+            store.query_timeout_s = 0.05
+            try:
+                rules = [
+                    faults.FaultRule(
+                        "device.fetch", "latency", latency_s=0.2, prob=1.0
+                    ),
+                    faults.FaultRule(
+                        "device.dispatch", "latency", latency_s=0.2, prob=1.0
+                    ),
+                ]
+                with faults.inject(rules=rules):
+                    for _ in range(10):
+                        with pytest.raises(QueryTimeout):
+                            store.query("gdelt", bench.QUERY)
+                deadline_ts = time.time() + 4.0
+                degraded = None
+                while time.time() < deadline_ts:
+                    h = _get(url + "/healthz")
+                    if (
+                        h["status"] == "degraded"
+                        and h.get("slo", {}).get("violating")
+                    ):
+                        degraded = h
+                        break
+                    time.sleep(0.05)
+                assert degraded is not None, "burn rate never degraded /healthz"
+                assert "query-availability" in degraded["slo"]["violating"]
+                # no breaker opened: the degradation is the SLO's alone
+                assert not degraded["breakers"]
+                # /debug/slo carries the detail: burn rates + windows
+                body = _get(url + "/debug/slo")
+                row = next(
+                    r for r in body["slos"]
+                    if r["name"] == "query-availability"
+                )
+                assert row["violating"] and row["fast"]["burn_rate"] > 14.4
+            finally:
+                store.query_timeout_s = None
+            # recovery: healthy traffic, the fast window slides clean
+            deadline_ts = time.time() + 10.0
+            cleared = False
+            while time.time() < deadline_ts:
+                store.query("gdelt", bench.QUERY)
+                h = _get(url + "/healthz")
+                if h["status"] == "ok" and not h["slo"]["violating"]:
+                    cleared = True
+                    break
+                time.sleep(0.1)
+            assert cleared, "violation never cleared after recovery"
+
+
+# -- the one-shot incident report (acceptance) --------------------------------
+
+
+def test_incident_report_bundle_end_to_end():
+    """Induce a slow query; GET /debug/report must return ONE bundle
+    with the timeline window, SLO state, >=1 exemplar trace id
+    resolvable in /debug/traces, device/overload/recovery blocks, the
+    slow-query tail containing the induced query, and the config
+    snapshot."""
+    from geomesa_tpu.web import GeoMesaServer
+
+    with properties(geomesa_timeline_interval="50 ms"):
+        store = _fill(TpuDataStore(metrics=MetricsRegistry(),
+                                   slow_query_s=0.0))
+        with GeoMesaServer(store) as url:
+            for _ in range(3):
+                store.query("gdelt", CQL)
+            deadline_ts = time.time() + 5.0
+            while time.time() < deadline_ts:
+                if _get(url + "/debug/timeline?s=60")["ticks"] >= 2:
+                    break
+                time.sleep(0.05)
+            rep = _get(url + "/debug/report?s=60")
+            assert set(rep["sections"]) >= {
+                "traces", "device", "overload", "recovery", "timeline", "slo",
+            }
+            assert rep["sections"]["timeline"]["snapshots"]
+            assert rep["sections"]["slo"]["enabled"]
+            assert rep["sections"]["device"]["backend"]
+            assert "breakers" in rep["sections"]["overload"]
+            assert "counters" in rep["sections"]["recovery"]
+            # the induced slow queries are in the tail, trace ids intact
+            slow_ids = {e["trace_id"] for e in rep["slow_queries"]}
+            assert slow_ids
+            # >=1 exemplar trace resolved AND resolvable via the live
+            # debug ring (the acceptance criterion)
+            assert rep["exemplar_traces"]
+            served = {
+                t["trace_id"]
+                for t in _get(url + "/debug/traces?n=1000")
+            }
+            assert set(rep["exemplar_traces"]) & served
+            # full resolved config rides along
+            assert rep["config"]["geomesa.timeline.enabled"] is not None
+            assert "geomesa.slo.window.fast" in rep["config"]
+            # and the capture script's summary renders it
+            import importlib.util
+            import os
+
+            spec = importlib.util.spec_from_file_location(
+                "capture_report",
+                os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "scripts", "capture_report.py",
+                ),
+            )
+            cap = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(cap)
+            line = cap.summarize(rep)
+            assert "timeline_snapshots=" in line and "violating=" in line
+
+
+def test_report_completeness_matches_registered_debug_routes():
+    """The lint's contract, asserted from Python too: every /debug/*
+    route web.py dispatches is a REPORT_SECTIONS key (report excepted),
+    so a new debug surface cannot silently skip the incident bundle."""
+    import inspect
+    import re
+
+    from geomesa_tpu import web
+
+    src = inspect.getsource(web)
+    routes = set(re.findall(r'"/debug/([a-z_]+)"', src)) - {"report"}
+    assert routes == set(web.REPORT_SECTIONS)
+
+
+# -- chaos: snapshots keep flowing, sampler stays passive ---------------------
+
+
+@pytest.mark.chaos
+def test_timeline_keeps_recording_under_fault_schedules(monkeypatch):
+    """The chaos_smoke invariant: while device faults fire through the
+    query path (PR 1 degradation absorbing them — answers stay
+    identical), the sampler thread keeps appending snapshots and the
+    recorder SEES the chaos (fault counters land in the deltas). Own
+    store: the schedule may open the device breaker, which must not
+    leak into sibling tests."""
+    import bench
+
+    monkeypatch.setenv("GEOMESA_SEEK", "0")  # force the device scan path
+    store = _make_device_store(n=4000)
+    want = sorted(store.query("gdelt", bench.QUERY).fids)
+    s = timeline.TimelineSampler(store=store, interval_s=0.02, window_s=30)
+    s.start()
+    try:
+        with faults.inject("device.fetch:error=0.4,device.dispatch:error=0.2",
+                           seed=11):
+            t_end = time.time() + 0.6
+            while time.time() < t_end:
+                got = sorted(store.query("gdelt", bench.QUERY).fids)
+                assert got == want  # parity under faults, recorder live
+        deadline_ts = time.time() + 5.0
+        while s.ticks < 10 and time.time() < deadline_ts:
+            time.sleep(0.02)
+    finally:
+        s.stop()
+    assert s.ticks >= 10, "sampler stalled during the fault schedule"
+    total = {}
+    for snap in s.window(None):
+        for k, v in snap["counters"].items():
+            total[k] = total.get(k, 0) + v
+    assert total.get("queries", 0) > 0  # traffic recorded through the chaos
+    fault_keys = [k for k in total if k.startswith("fault.device.")]
+    assert fault_keys, "the recorder never observed the fault schedule"
