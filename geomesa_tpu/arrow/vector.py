@@ -20,15 +20,61 @@ _FID = "__fid__"
 
 
 class SimpleFeatureVector:
-    """Maps a FeatureType + columnar batch to an Arrow RecordBatch."""
+    """Maps a FeatureType + columnar batch to an Arrow RecordBatch.
+
+    Dictionary-encoded columns share ONE unified, append-only dictionary
+    across every ``to_batch`` call on the same vector: per-batch codes
+    map into a vocabulary that only ever grows, so a multi-batch IPC
+    stream (``query_stream`` / ``write_features``) carries delta
+    dictionaries instead of per-batch replacements — the streamed concat
+    equals the materialized table, encoding included, and a consumer
+    holding early batches never sees their dictionary change."""
 
     def __init__(self, ft: FeatureType, dictionary_encode: Sequence[str] = ()):
         self.ft = ft
         self.dictionary_encode = set(dictionary_encode)
+        # per-column unified dictionary: (values list, value -> code)
+        self._dicts: Dict[str, tuple] = {}
         fields = [pa.field(_FID, pa.utf8())]
         for a in ft.attributes:
             fields.append(pa.field(a.name, self._arrow_type(a), nullable=True))
         self.schema = pa.schema(fields, metadata={b"geomesa.sft.spec": ft.spec().encode()})
+
+    def _unified_dict_array(self, name: str, values=None, codes=None,
+                            vocab=None) -> pa.DictionaryArray:
+        """One batch's slice of ``name`` as a DictionaryArray over the
+        column's unified dictionary. Input is either store-layout
+        ``codes`` + this block's ``vocab``, or plain ``values`` (None =
+        null), which encode batch-locally at C speed first — either way
+        only the SMALL per-batch vocabulary walks the Python-level
+        unified index; per-row work stays vectorized. Growth is strictly
+        append-only — the delta-dictionary invariant ``iter_ipc`` /
+        ``write_features`` rely on."""
+        if codes is None:
+            arr = (values if isinstance(values, pa.Array)
+                   else pa.array(values, type=pa.utf8()))
+            enc = arr.dictionary_encode()
+            vocab = enc.dictionary.to_pylist()
+            codes = enc.indices.fill_null(-1).to_numpy(zero_copy_only=False)
+        got = self._dicts.get(name)
+        if got is None:
+            got = self._dicts[name] = ([], {})
+        vals_list, index = got
+        codes = np.asarray(codes, dtype=np.int64)
+        remap = np.empty(max(len(vocab), 1), dtype=np.int32)
+        for i, v in enumerate(vocab):
+            sv = str(v)
+            code = index.get(sv)
+            if code is None:
+                code = index[sv] = len(vals_list)
+                vals_list.append(sv)
+            remap[i] = code
+        mask = codes < 0  # -1 = null sentinel (store layout / fill_null)
+        out_codes = remap[np.where(mask, 0, codes)].astype(np.int32)
+        idx = pa.array(out_codes, mask=mask if mask.any() else None)
+        return pa.DictionaryArray.from_arrays(
+            idx, pa.array(vals_list, type=pa.utf8())
+        )
 
     def _arrow_type(self, a) -> pa.DataType:
         if a.type == AttributeType.POINT:
@@ -81,16 +127,14 @@ class SimpleFeatureVector:
                 and a.name in self.dictionary_encode
             ):
                 # store-layout dictionary columns map STRAIGHT to Arrow
-                # dictionaries — codes + sorted vocab, no re-encode (the
-                # ArrowDictionary wire role fed from the at-rest codes)
-                codes = np.asarray(columns[a.name], dtype=np.int32)
-                mask = codes < 0  # -1 = null sentinel
-                idx = pa.array(np.where(mask, 0, codes), mask=mask)
-                arrays.append(
-                    pa.DictionaryArray.from_arrays(
-                        idx, pa.array(columns[a.name + "__vocab"], type=pa.utf8())
-                    )
-                )
+                # dictionaries — at-rest codes remap through the UNIFIED
+                # vocabulary (first block: verbatim, identity codes), so
+                # later batches extend rather than replace the dictionary
+                arrays.append(self._unified_dict_array(
+                    a.name,
+                    codes=columns[a.name],
+                    vocab=columns[a.name + "__vocab"],
+                ))
             elif a.type == AttributeType.STRING and a.name in columns:
                 col = columns[a.name]
                 vocab = columns.get(a.name + "__vocab")
@@ -105,13 +149,21 @@ class SimpleFeatureVector:
                     vals = pa.array(col, type=pa.utf8(),
                                     mask=np.asarray(nulls) if nulls is not None else None)
                 if a.name in self.dictionary_encode:
-                    vals = vals.dictionary_encode()
-                arrays.append(vals)
+                    # per-batch .dictionary_encode() would mint a NEW
+                    # dictionary per batch (IPC replacement dictionaries;
+                    # streamed concat != materialized) — unify instead
+                    arrays.append(self._unified_dict_array(a.name, vals))
+                else:
+                    arrays.append(vals)
             elif a.name in columns and columns[a.name].dtype == object:
-                vals = pa.array(list(columns[a.name]), type=pa.utf8())
                 if a.name in self.dictionary_encode:
-                    vals = vals.dictionary_encode()
-                arrays.append(vals)
+                    arrays.append(self._unified_dict_array(
+                        a.name, list(columns[a.name])
+                    ))
+                else:
+                    arrays.append(
+                        pa.array(list(columns[a.name]), type=pa.utf8())
+                    )
             else:
                 nulls = columns.get(a.name + "__null")
                 arrays.append(
@@ -165,12 +217,20 @@ def write_features(
     own = isinstance(sink, str)
     out = pa.OSFile(sink, "wb") if own else sink
     try:
-        with pa.ipc.new_stream(out, vec.schema) as writer:
+        with pa.ipc.new_stream(out, vec.schema, options=_IPC_OPTS) as writer:
             for cols in batches:
                 writer.write_batch(vec.to_batch(cols))
     finally:
         if own:
             out.close()
+
+
+# shared IPC write options: dictionary batches whose vocabulary GREW
+# since the last emission ship as DELTA dictionaries (new values only)
+# instead of full replacements — pairs with SimpleFeatureVector's
+# unified append-only dictionaries, so a streamed dictionary column is
+# one dictionary extended incrementally, never N disagreeing ones
+_IPC_OPTS = pa.ipc.IpcWriteOptions(emit_dictionary_deltas=True)
 
 
 def iter_ipc(batches) -> Iterator[bytes]:
@@ -188,7 +248,7 @@ def iter_ipc(batches) -> Iterator[bytes]:
     writer = None
     for b in batches:
         if writer is None:
-            writer = pa.ipc.new_stream(buf, b.schema)
+            writer = pa.ipc.new_stream(buf, b.schema, options=_IPC_OPTS)
         writer.write_batch(b)
         chunk = buf.getvalue()
         buf.seek(0)

@@ -65,7 +65,9 @@ from geomesa_tpu.ops.geometry import (
     snap_epsilon_deg,
     snap_epsilon_m,
 )
+from geomesa_tpu.utils import audit as audit_mod
 from geomesa_tpu.utils import deadline, faults, trace
+from geomesa_tpu.utils import plans as plans_mod
 from geomesa_tpu.utils.devstats import devstats_metrics, instrumented_jit
 
 # the point-in-polygon boundary band, degrees. Pairs whose probe point
@@ -923,18 +925,38 @@ class JoinPlanner:
 
         stats: Dict[str, Any] = {"build": "rebuild" if rebuilt else "hit"}
         stats.update(build.stats)
+        # cache-engagement tally on the join's plan fingerprint
+        # (utils/plans.py; one contextvar read when plan telemetry is off)
+        plans_mod.note("join.build", "rebuild" if rebuilt else "hit")
         mesh = getattr(store.executor, "mesh", None)
         env = os.environ.get("GEOMESA_JOIN_DEVICE", "auto")
-        use_device = (
-            mesh is not None
-            and build.device_eligible
-            and not (spec.kind == "dwithin"
-                     and spec.radius_m > DWITHIN_DEVICE_MAX_R_M)
-            and env != "0"
-            and not mesh_mod.device_tripped(
-                store.executor, "GEOMESA_JOIN_DEVICE"
+        # kernel eligibility, decomposed so every decline is reason-coded
+        # (utils/audit.decision): WHY a join ran host-side is part of its
+        # plan-quality record, not something to re-derive from the inputs
+        use_device = mesh is not None
+        if use_device and not build.device_eligible:
+            # e.g. a multi-member MultiPolygon build: concatenated
+            # even-odd parity != member union (see _geometry_edges)
+            audit_mod.decision(
+                "join.kernel", "build_ineligible", build=build_name
             )
-        )
+            use_device = False
+        if use_device and (
+            spec.kind == "dwithin" and spec.radius_m > DWITHIN_DEVICE_MAX_R_M
+        ):
+            audit_mod.decision(
+                "join.kernel", "antipodal_radius",
+                radius_m=float(spec.radius_m),
+            )
+            use_device = False
+        if use_device and env == "0":
+            audit_mod.decision("join.kernel", "env_disabled")
+            use_device = False
+        if use_device and mesh_mod.device_tripped(
+            store.executor, "GEOMESA_JOIN_DEVICE"
+        ):
+            audit_mod.decision("join.kernel", "device_tripped")
+            use_device = False
         bi = pi = None
         path = "host-join"
         if use_device:
@@ -962,6 +984,9 @@ class JoinPlanner:
                 trace.event(
                     "degrade.join_to_host",
                     reason=f"{type(e).__name__}: {e}",
+                )
+                audit_mod.decision(
+                    "degrade", "join_to_host", error=type(e).__name__
                 )
                 mesh_mod.trip_device(
                     store.executor, "GEOMESA_JOIN_DEVICE", "join", e

@@ -48,7 +48,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from geomesa_tpu.utils import deadline
+from geomesa_tpu.utils import audit, deadline
 from geomesa_tpu.utils import devstats, faults, trace
 from geomesa_tpu.utils.audit import QueryTimeout, robustness_metrics
 
@@ -254,6 +254,10 @@ class QueryCoalescer:
                 "degrade.coalesce_to_solo",
                 reason=f"{type(e).__name__}: {e}",
                 n=len(members),
+            )
+            audit.decision(
+                "coalesce", "seam_degraded",
+                error=type(e).__name__, n=len(members),
             )
             return  # _lead's finally hands every member to the solo path
         if not live:

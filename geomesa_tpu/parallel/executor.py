@@ -60,7 +60,7 @@ from geomesa_tpu.parallel.mesh import (
     shard_map_fn,
 )
 from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
-from geomesa_tpu.utils import deadline, faults, trace
+from geomesa_tpu.utils import audit, deadline, faults, trace
 from geomesa_tpu.utils.devstats import count_d2h, instrumented_jit, record_pad
 
 # initial hit-run capacity: 4096 runs * 8B = 32 KiB per segment transfer
@@ -4587,6 +4587,12 @@ class TpuScanExecutor:
             reason=f"{type(exc).__name__}: {exc}",
             mirrors_evicted=evicted,
         )
+        # reason-coded decision audit (utils/audit.decision): counter +
+        # span event + a tally on the degraded query's plan fingerprint
+        audit.decision(
+            "degrade", "device_to_host",
+            error=type(exc).__name__, mirrors_evicted=evicted,
+        )
         sys.stderr.write(
             f"[executor] device scan failed ({type(exc).__name__}: {exc}); "
             "host path answers; mirror marked for rebuild\n"
@@ -4667,6 +4673,12 @@ class TpuScanExecutor:
             # layout; multi-chip meshes keep the shard-extract batch
             # paths of dispatch_many (the `rest` route below)
             single_device = self.mesh.devices.size == 1
+            if not single_device and items:
+                # one reason-coded record per group, not per member
+                audit.decision(
+                    "coalesce", "multi_chip",
+                    devices=int(self.mesh.devices.size), n=len(items),
+                )
             for table, plan in items:
                 if id(plan) in seen:
                     continue

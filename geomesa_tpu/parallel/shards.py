@@ -97,6 +97,7 @@ from geomesa_tpu.utils.audit import (
     QueryTimeout,
     ShardUnavailable,
     ShedLoad,
+    decision,
     robustness_metrics,
 )
 from geomesa_tpu.utils.breaker import CircuitBreaker
@@ -258,6 +259,13 @@ class ShardWorker:
         self._stores: Dict[str, TpuDataStore] = {}
         self._schemas: Dict[str, FeatureType] = {}
         self._lock = threading.Lock()
+        # ONE plan-fingerprint registry per SHARD (utils/plans.py),
+        # shared by every partition sub-store — so the per-shard rollup
+        # (telemetry(), the /debug/plans shards block) is one read, the
+        # shape a cross-process transport would ship whole
+        from geomesa_tpu.utils.plans import PlanRegistry
+
+        self.plans = PlanRegistry()
 
     def create_schema(self, ft: FeatureType) -> None:
         with self._lock:
@@ -285,6 +293,9 @@ class ShardWorker:
                     else None
                 )
                 st = TpuDataStore(executor=ex, auths=self._auths)
+                # partition sub-stores share the shard's fingerprint
+                # registry (fixed memory per shard, not per partition)
+                st.__dict__["_plans"] = self.plans
                 for ft in self._schemas.values():
                     st.create_schema(ft)
                 self._stores[partition] = st
@@ -344,6 +355,9 @@ class ShardWorker:
         return {
             "admission": self.admission.peek(),
             "partitions": partitions,
+            # the shard's hottest plan fingerprints (utils/plans.py):
+            # the plan-level half of the rollup, same seam
+            "plans": self.plans.top(5),
         }
 
     def has_visibility(self, name: str) -> bool:
@@ -779,10 +793,15 @@ class ShardedDataStore(TpuDataStore):
                     if self._breakers[t].allow():
                         return t
                     if dispatched == 0:
-                        # breaker open/probing: zero dispatch cost
+                        # breaker open/probing: zero dispatch cost —
+                        # reason-coded: the query was REROUTED around a
+                        # tripped shard, which its fingerprint should show
                         refused = outcome(gid).setdefault("refused", [])
                         if t not in refused:
                             refused.append(t)
+                            decision(
+                                "breaker", "reroute", shard=t, group=gid
+                            )
             return None
 
         def dispatch(gid: int, hedge: bool) -> bool:
@@ -858,6 +877,7 @@ class ShardedDataStore(TpuDataStore):
                 )
                 if a.hedge:
                     metrics.inc("shard.hedge.won")
+                    decision("hedge", "won", shard=a.target, group=gid)
                 for sib in inflight[gid]:
                     # hedge race lost: cancel cooperatively; no breaker
                     # verdict, no receipt, no degrade counter
@@ -979,6 +999,15 @@ class ShardedDataStore(TpuDataStore):
                                 after_ms=round((now - a.t0) * 1000.0, 2),
                                 threshold_ms=round(thr * 1000.0, 2),
                             )
+                            decision(
+                                "hedge", "fired", group=gid,
+                                shard=a.target,
+                                after_ms=round((now - a.t0) * 1000.0, 2),
+                            )
+                        else:
+                            # no placement left to hedge to — final for
+                            # this group (one hedge decision per group)
+                            decision("hedge", "refused", group=gid)
         except BaseException:
             abort_all()
             raise
@@ -1025,6 +1054,26 @@ class ShardedDataStore(TpuDataStore):
                 for i, w in enumerate(self.workers)
             }
         }
+
+    def plans_rollup(self, n: int = 20) -> tuple:
+        """The /debug/plans sharded rollup: (per-shard top blocks, the
+        cross-shard merged fingerprint table). Worker rows come through
+        each shard's own registry — the read a cross-process transport
+        would RPC alongside ``telemetry()`` — and merge by fingerprint
+        id (sums exact; per-shard latency reservoirs stay per-shard)."""
+        from geomesa_tpu.utils import plans as plans_util
+
+        shards = {
+            str(i): w.plans.top(5) for i, w in enumerate(self.workers)
+        }
+        # merge from each shard's FULL registry (bounded at its cap),
+        # not its top-n: a shape hot fleet-wide but below one shard's
+        # cutoff must not vanish from (or undercount in) the merged
+        # table; the n-slice applies after the exact merge
+        merged = plans_util.merge_rows(
+            [w.plans.rows(n=w.plans.cap) for w in self.workers]
+        )[: max(0, int(n))]
+        return shards, merged
 
     def shards_snapshot(self) -> Dict[str, Any]:
         """The ``shards`` block for /debug/overload + /healthz: per-shard

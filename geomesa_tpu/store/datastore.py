@@ -16,6 +16,7 @@ reducers (density/stats/bin) when hinted).
 
 from __future__ import annotations
 
+import math
 import uuid
 from collections.abc import Mapping
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
@@ -40,8 +41,10 @@ from geomesa_tpu.store.blocks import (
     take_rows,
 )
 from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
+from geomesa_tpu.utils import audit as audit_mod
 from geomesa_tpu.utils import deadline as deadline_mod
 from geomesa_tpu.utils import devstats, trace
+from geomesa_tpu.utils import plans as plans_mod
 
 DEFAULT_FLUSH_SIZE = 100_000
 
@@ -579,6 +582,9 @@ class TpuDataStore:
             mesh_mod.trip_device(
                 self.executor, "GEOMESA_COUNT_DEVICE", "count", e
             )
+            audit_mod.decision(
+                "degrade", "count_to_host", error=type(e).__name__
+            )
             return None
 
     # -- aggregate pyramid cache (ops/pyramid.py) ----------------------------
@@ -627,6 +633,9 @@ class TpuDataStore:
             trace.event(
                 "degrade.agg_to_scan", reason=f"{type(e).__name__}: {e}"
             )
+            audit_mod.decision(
+                "pyramid", "build_degraded", error=type(e).__name__
+            )
             return None
         cache.put(key, pyr)
         return pyr
@@ -669,6 +678,7 @@ class TpuDataStore:
         if not could_have_interior(geoms, bits):
             # sub-cell region: decline BEFORE paying the O(table) build
             devstats.devstats_metrics().inc("agg.cache.declined")
+            audit_mod.decision("pyramid", "sub_cell_region", type=name)
             return None
         pyr = self._pyramid_for(name, ft)
         if pyr is None:
@@ -678,6 +688,10 @@ class TpuDataStore:
         )
         if not pyramid_worthwhile(interior, boundary_rows):
             devstats.devstats_metrics().inc("agg.cache.declined")
+            audit_mod.decision(
+                "pyramid", "boundary_dominates",
+                interior=int(interior), boundary_rows=int(boundary_rows),
+            )
             return None
         return pyr, interior, cells, imask
 
@@ -777,6 +791,7 @@ class TpuDataStore:
                 return None
             plan.scan_path = "agg-cache-density"
             trace.set_attr("agg.cache", "hit")
+            plans_mod.note("pyramid", "hit")
             return QueryResult(
                 ft, _empty_columns(ft), plan, {"density": entry.grid.copy()}
             )
@@ -791,6 +806,7 @@ class TpuDataStore:
                 s.count = n
             plan.scan_path = "agg-pyramid-stats"
             trace.set_attr("agg.cache", "hit")
+            plans_mod.note("pyramid", "hit")
             return QueryResult(ft, _empty_columns(ft), plan, {"stats": stat})
         return None
 
@@ -853,6 +869,7 @@ class TpuDataStore:
 
         t0 = _time.perf_counter()
         root = trace.NOOP
+        ptok = plans_mod.begin()
         try:
             with trace.span(
                 "query.aggregate", force=self.slow_query_s is not None,
@@ -869,8 +886,12 @@ class TpuDataStore:
                                 # reentrant — PR 7 / PR 6 semantics)
                                 res = self.query(name, q)
                                 got = _aggregate_columns(ft, res.columns, cols)
-                            elif root.recording:
-                                root.set_attr("agg.cache", "hit")
+                                agg_path = "agg-exact-fallback"
+                            else:
+                                agg_path = "agg-pyramid"
+                                plans_mod.note("pyramid", "hit")
+                                if root.recording:
+                                    root.set_attr("agg.cache", "hit")
                             # aggregate-class accounting (the SLO engine's
                             # `aggregate` class, utils/slo.py): one counter
                             # + timer per surface call. The exact-fallback
@@ -881,6 +902,17 @@ class TpuDataStore:
                                 self.metrics.update_timer(
                                     "query.aggregate",
                                     _time.perf_counter() - t0,
+                                )
+                            if plans_mod.enabled():
+                                # aggregate-class fingerprint; the exact
+                                # fallback's inner query fingerprinted
+                                # itself (and drained the pending scope)
+                                # as a `query` already
+                                self._plans_obj().observe(
+                                    "aggregate", name, query=q,
+                                    scan_path=agg_path, outcome="ok",
+                                    hits=int(got.get("count", 0)),
+                                    duration_s=_time.perf_counter() - t0,
                                 )
                             return got
                 except (QueryTimeout, ShedLoad) as e:
@@ -895,8 +927,14 @@ class TpuDataStore:
                         # queries/queries.<outcome>
                         self.metrics.inc("queries.aggregate")
                         self.metrics.inc(f"queries.aggregate.{outcome}")
+                    if plans_mod.enabled():
+                        self._plans_obj().observe(
+                            "aggregate", name, query=q, outcome=outcome,
+                            duration_s=_time.perf_counter() - t0,
+                        )
                     raise
         finally:
+            plans_mod.end(ptok)
             self._log_slow_query(name, None, root)
 
     def _aggregate_pyramid(
@@ -919,6 +957,9 @@ class TpuDataStore:
             robustness_metrics().inc("degrade.agg_to_scan")
             trace.event(
                 "degrade.agg_to_scan", reason=f"{type(e).__name__}: {e}"
+            )
+            audit_mod.decision(
+                "pyramid", "build_degraded", error=type(e).__name__
             )
             return None  # the caller answers from the uncached exact scan
         parts = (
@@ -961,6 +1002,92 @@ class TpuDataStore:
         plan = self.planner(name).plan(query)
         return plan.explain
 
+    def explain_analyze(
+        self, name: str, query: Union[str, Query] = "INCLUDE"
+    ) -> Dict[str, Any]:
+        """EXPLAIN ANALYZE: run the query for real under a FORCED trace
+        and return the plan tree annotated with what actually happened —
+        per-stage wall/self-times, rows in/out per scanned block, the
+        device cost receipt, every reason-coded decision taken
+        (``utils.audit.decision``), and the plan-time estimate vs the
+        consume-time actuals. Exposed as ``POST /explain`` (web.py).
+
+        The query executes through the ordinary envelope (budget,
+        admission, audit, fingerprinting) — an EXPLAIN ANALYZE is a real
+        query whose answer is its own telemetry, so overload semantics
+        (ShedLoad/QueryTimeout) apply unchanged."""
+        q = self._as_query(query)
+        # the forced wrapper span makes query()'s whole tree record even
+        # with no exporter installed; capturing the child directly (not
+        # through an exporter ring) keeps concurrent queries out
+        with trace.span("explain.analyze", force=True, type=name) as wrap:
+            result = self.query(name, q)
+        root = next(
+            (c for c in wrap.children if c.name == "query"), wrap
+        )
+        plan = result.plan
+        blocks = root.find("scan.block")
+        rows_in = sum(int(b.attributes.get("rows_in", 0)) for b in blocks)
+        rows_out = sum(int(b.attributes.get("rows_out", 0)) for b in blocks)
+        # self-time attribution: how much of the audited wall the NAMED
+        # stages explain (the PR 2 >=90% contract, now per execution).
+        # Concurrent stages (a sharded fan-out's parallel scans) can sum
+        # past the wall; the fraction clamps at 1.0 — "fully attributed"
+        attributed = sum(
+            s.self_time_ms for s in root.walk() if s is not root
+        )
+        decisions = [
+            {
+                "point": ev["name"][len("decision."):],
+                **{k: v for k, v in ev.items() if k not in ("name", "t_ms")},
+            }
+            for sp in root.walk()
+            for ev in sp.events
+            if ev["name"].startswith("decision.")
+        ]
+        est_cost = float(getattr(plan, "cost", 0.0) or 0.0)
+        scan_path = self._collect_scan_path(plan)
+        key = plans_mod.fingerprint_key(
+            "query", name, plan=plan, query=q, scan_path=scan_path
+        )
+        out: Dict[str, Any] = {
+            "type": name,
+            "trace_id": root.trace_id,
+            "fingerprint": plans_mod.fingerprint_id(key),
+            "plan": {
+                "index": getattr(plan.index, "name", ""),
+                "scan_path": scan_path,
+                "union_arms": len(plan.union) if plan.union else 0,
+                "explain": plan.explain,
+            },
+            "estimate": {
+                "cost": est_cost,
+                "ranges": len(plan.ranges),
+            },
+            "actual": {
+                "hits": len(result),
+                "rows_scanned": rows_in,
+                "rows_out": rows_out,
+                "blocks": len(blocks),
+                "duration_ms": round(root.duration_ms, 3),
+            },
+            # signed log2: +k = the cost model UNDER-estimated by ~2^k
+            "misestimate_log2": round(
+                math.log2((rows_in + 1.0) / (est_cost + 1.0)), 3
+            ),
+            "receipt": root.attributes.get("device", {}),
+            "attribution": {
+                "attributed_ms": round(attributed, 3),
+                "total_ms": round(root.duration_ms, 3),
+                "fraction": round(
+                    min(attributed / root.duration_ms, 1.0), 4
+                ) if root.duration_ms > 0 else 1.0,
+            },
+            "decisions": decisions,
+            "stages": _stage_tree(root),
+        }
+        return out
+
     def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:
         import time as _time
 
@@ -975,6 +1102,10 @@ class TpuDataStore:
         # RAISE (a timeout is exactly the query the slow log exists for).
         root = trace.NOOP
         plan = None
+        # plan-quality pending scope (utils/plans.py): decisions and
+        # per-block row actuals collect here until _audit folds them
+        # into the fingerprint. None (one flag read) when disabled.
+        ptok = plans_mod.begin()
         try:
             with trace.span(
                 "query", force=self.slow_query_s is not None, type=name
@@ -997,6 +1128,10 @@ class TpuDataStore:
                             out = self._coalesced(name, ft, query)
                             if out is not None:
                                 plan = out.plan
+                                plans_mod.note(
+                                    "coalesce",
+                                    "joined" if out.group_n > 1 else "solo",
+                                )
                                 if root.recording:
                                     root.set_attr("hits", len(out.result))
                                     root.set_attr(
@@ -1005,10 +1140,7 @@ class TpuDataStore:
                                     )
                                     root.set_attr("device", out.receipt)
                                     root.set_attr("coalesced", out.group_n)
-                                if (
-                                    self.audit_writer is not None
-                                    or self.metrics is not None
-                                ):
+                                if self._auditing():
                                     self._audit(
                                         name, query, plan, out.result,
                                         t_admit, t_admit + out.plan_s,
@@ -1041,10 +1173,7 @@ class TpuDataStore:
                                 # slow-query log renders it next to the
                                 # tree it explains
                                 root.set_attr("device", receipt)
-                            if (
-                                self.audit_writer is not None
-                                or self.metrics is not None
-                            ):
+                            if self._auditing():
                                 self._audit(
                                     name, query, plan, result, t_start,
                                     t_planned, receipt,
@@ -1060,10 +1189,11 @@ class TpuDataStore:
                     )
                     if root.recording:
                         root.set_attr("outcome", outcome)
-                    if self.audit_writer is not None or self.metrics is not None:
+                    if self._auditing():
                         self._audit_failure(name, query, plan, t_admit, outcome)
                     raise
         finally:
+            plans_mod.end(ptok)
             self._log_slow_query(name, plan, root)
 
     def _prepare_query(self, name: str, query: Query) -> None:
@@ -1144,6 +1274,7 @@ class TpuDataStore:
         probe_name, probe_q = self._join_side(probe)
         root = trace.NOOP
         t0 = _time.perf_counter()
+        ptok = plans_mod.begin()
         try:
             with trace.span(
                 "query.join", force=self.slow_query_s is not None,
@@ -1163,16 +1294,32 @@ class TpuDataStore:
                                 build_name, build_q, probe_name, probe_q,
                                 spec,
                             )
+                        receipt = devstats.receipt_since(dev0)
                         if root.recording:
                             root.set_attr("join", result.stats)
                             root.set_attr("hits", len(result))
-                            root.set_attr(
-                                "device", devstats.receipt_since(dev0)
-                            )
+                            root.set_attr("device", receipt)
                         if self.metrics is not None:
                             self.metrics.inc("queries.join")
                             self.metrics.update_timer(
                                 "query.join", _time.perf_counter() - t0
+                            )
+                        if plans_mod.enabled():
+                            # join-class fingerprint: predicate kind as
+                            # the shape, the answering path (device/host/
+                            # degraded) as the scan path — the inner
+                            # build/probe queries fingerprinted (and
+                            # drained) themselves as `query`s already
+                            self._plans_obj().observe(
+                                "join",
+                                f"{build_name}+{probe_name}",
+                                shape=f"join:{spec.kind}",
+                                scan_path=str(
+                                    result.stats.get("path", "")
+                                ),
+                                outcome="ok", hits=len(result),
+                                duration_s=_time.perf_counter() - t0,
+                                receipt=receipt,
                             )
                         return result
                 except (QueryTimeout, ShedLoad) as e:
@@ -1194,6 +1341,12 @@ class TpuDataStore:
                         # join there too would show 2 failures for 1 join
                         self.metrics.inc("queries.join")
                         self.metrics.inc(f"queries.join.{outcome}")
+                    if plans_mod.enabled():
+                        self._plans_obj().observe(
+                            "join", f"{build_name}+{probe_name}",
+                            shape=f"join:{spec.kind}", outcome=outcome,
+                            duration_s=_time.perf_counter() - t0,
+                        )
                     if self.audit_writer is not None:
                         self._audit_failure(
                             build_name + "+" + probe_name, probe_q, None,
@@ -1201,6 +1354,7 @@ class TpuDataStore:
                         )
                     raise
         finally:
+            plans_mod.end(ptok)
             self._log_slow_query(build_name + "+" + probe_name, None, root)
 
     def _join_side(self, side) -> tuple:
@@ -1336,6 +1490,7 @@ class TpuDataStore:
         name: str,
         query: Union[str, Query] = "INCLUDE",
         batch_rows: Optional[int] = None,
+        dictionary_encode: Sequence[str] = (),
     ):
         """Streaming query: an iterator of Arrow ``RecordBatch``es, one
         (or more, capped at ``geomesa.stream.batch.rows`` rows) per
@@ -1361,7 +1516,12 @@ class TpuDataStore:
           LIFETIME of the iteration — a consumer that stalls past the
           budget gets ``QueryTimeout`` at the next block, never a
           silently truncated stream; closing the iterator early
-          releases the slot.
+          releases the slot;
+        * ``dictionary_encode`` names string columns to ship as Arrow
+          dictionaries — ONE unified dictionary across every batch of
+          the stream (append-only growth, delta dictionaries on the
+          IPC wire), so the streamed concat equals the materialized
+          table encoding included.
         """
         from geomesa_tpu.index.aggregators import has_aggregation as _has_agg
         from geomesa_tpu.utils.config import STREAM_BATCH_ROWS
@@ -1374,7 +1534,9 @@ class TpuDataStore:
             )
         if batch_rows is None:
             batch_rows = STREAM_BATCH_ROWS.to_int() or 8192
-        gen = self._stream_gen(name, ft, q, max(1, int(batch_rows)))
+        gen = self._stream_gen(
+            name, ft, q, max(1, int(batch_rows)), tuple(dictionary_encode)
+        )
         if self.metrics is None:
             return gen
         return self._stream_first_timed(gen)
@@ -1403,7 +1565,8 @@ class TpuDataStore:
             # stream NOW (releasing its admission slot), not at GC
             gen.close()
 
-    def _stream_gen(self, name, ft, q: Query, batch_rows: int):
+    def _stream_gen(self, name, ft, q: Query, batch_rows: int,
+                    dictionary_encode: tuple = ()):
         """query_stream's generator body. Context managers must not span
         a yield (a contextvar leaking into the consumer), so the budget
         is an EXPLICIT Deadline attached around each step's work, and
@@ -1427,9 +1590,14 @@ class TpuDataStore:
                 ctl._acquire()
         hits = 0
         plan = None
+        # plans pending scope, generator edition: the collector object
+        # lives for the whole stream, but the contextvar is re-entered
+        # around each step (plans_mod.attach — a contextvar must never
+        # stay set across a yield, the deadline.attach posture)
+        pend = plans_mod.pending()
         try:
             dev0 = devstats.receipt_snapshot()
-            with deadline_mod.attach(dl):
+            with deadline_mod.attach(dl), plans_mod.attach(pend):
                 with trace.span("query.stream", type=name):
                     self._prepare_query(name, q)
                     plan = self._plan_cached(name, q)
@@ -1446,7 +1614,10 @@ class TpuDataStore:
                     if q.properties is not None
                     else ft
                 )
-                vec = SimpleFeatureVector(out_ft)
+                # ONE vector for the whole stream: its unified per-column
+                # dictionaries persist across batches (delta dictionaries
+                # on the wire, not per-batch replacements)
+                vec = SimpleFeatureVector(out_ft, dictionary_encode)
                 remaining = q.max_features
                 # union arms may overlap: first-occurrence fid dedupe,
                 # incremental (same winners as _dedupe_by_fid's)
@@ -1454,7 +1625,7 @@ class TpuDataStore:
                 parts = self._iter_stream_parts(name, ft, q, plan, t0)
                 while remaining is None or remaining > 0:
                     batches = []
-                    with deadline_mod.attach(dl):
+                    with deadline_mod.attach(dl), plans_mod.attach(pend):
                         try:
                             block, rows = next(parts)
                         except StopIteration:
@@ -1487,10 +1658,10 @@ class TpuDataStore:
                 # sort/sampling/transforms (or an empty plan): the
                 # finished result chunks into batches — same answers,
                 # no first-byte win
-                with deadline_mod.attach(dl):
+                with deadline_mod.attach(dl), plans_mod.attach(pend):
                     result = self._execute(name, ft, q, plan, t0)
                     cols = _materialize(result.columns)
-                    vec = SimpleFeatureVector(result.ft)
+                    vec = SimpleFeatureVector(result.ft, dictionary_encode)
                     n = len(cols.get("__fid__", ()))
                     hits = n
                     batches = [
@@ -1501,8 +1672,12 @@ class TpuDataStore:
                     ] or [vec.to_batch(_empty_columns(result.ft))]
                 for b in batches:
                     yield b
-            if self.metrics is not None or self.audit_writer is not None:
-                with deadline_mod.attach(dl):
+            if self._auditing():
+                # observe() drains the stream's pending collector (rows
+                # scanned per block, any decisions fired mid-stream) so
+                # a streamed query's fingerprint record matches the
+                # non-streamed edition of the same shape
+                with deadline_mod.attach(dl), plans_mod.attach(pend):
                     self._audit(
                         name, q, plan, None, t0, t_planned,
                         devstats.receipt_since(dev0), hits=hits,
@@ -1618,6 +1793,7 @@ class TpuDataStore:
             # time cover THIS query's resolve, not the whole batch's
             t_resolve = _time.perf_counter()
             root = trace.NOOP
+            ptok = plans_mod.begin()
             try:
                 with trace.span(
                     "query", force=self.slow_query_s is not None,
@@ -1631,13 +1807,25 @@ class TpuDataStore:
                             root.set_attr("hits", len(result))
                             root.set_attr("scan_path", self._collect_scan_path(plan))
                             root.set_attr("device", receipt)
-                        if self.audit_writer is not None or self.metrics is not None:
+                        if self._auditing():
                             self._audit(name, q, plan, result, t_resolve - dt,
                                         t_resolve, receipt)
             finally:
+                plans_mod.end(ptok)
                 self._log_slow_query(name, plan, root)
             results.append(result)
         return results
+
+    def _auditing(self) -> bool:
+        """Whether the per-query audit step must run at all: an audit
+        writer, a metrics registry, or the plan-fingerprint registry
+        (utils/plans.py) is listening. _audit/_audit_failure re-check
+        each sink individually — this is just the hot-path gate."""
+        return (
+            self.audit_writer is not None
+            or self.metrics is not None
+            or plans_mod.enabled()
+        )
 
     @staticmethod
     def _collect_scan_path(plan) -> str:
@@ -1685,6 +1873,32 @@ class TpuDataStore:
                     pad_ratio=float(receipt.get("pad_ratio", 0.0)),
                 )
             )
+        if plans_mod.enabled():
+            # fold the finished query into its plan fingerprint
+            # (utils/plans.py): plan-time estimates (QueryPlan.cost,
+            # range count) meet the consume-time actuals and the
+            # pending decision tallies here
+            self._plans_obj().observe(
+                "query", name, plan=plan, query=query,
+                scan_path=self._collect_scan_path(plan),
+                outcome="ok", hits=hits, duration_s=now - t_start,
+                receipt=receipt,
+                est_cost=plan.cost,
+                est_ranges=len(plan.ranges),
+            )
+
+    def _plans_obj(self):
+        """The per-store plan-fingerprint registry (utils/plans.py),
+        created lazily. GIL-atomic setdefault — the _agg_cache_obj rule:
+        two concurrent first queries must agree on ONE registry.
+        ShardWorker pre-assigns a shared registry to its partition
+        sub-stores so a shard rolls up as one read."""
+        reg = getattr(self, "_plans", None)
+        if reg is None:
+            from geomesa_tpu.utils.plans import PlanRegistry
+
+            reg = self.__dict__.setdefault("_plans", PlanRegistry())
+        return reg
 
     def _audit_failure(self, name, query, plan, t_admit, outcome: str,
                        count_metrics: bool = True):
@@ -1693,8 +1907,9 @@ class TpuDataStore:
         elapsed wall (admission wait included) lands in scanning_ms so
         latency dashboards see the cost overload actually charged.
         ``count_metrics=False`` writes the event only — query_join keeps
-        its failures in join-scoped counters so an inner query that
-        already audited its own timeout is not double-counted."""
+        its failures in join-scoped counters (and its own join-class
+        fingerprint) so an inner query that already audited its own
+        timeout is not double-counted."""
         import time as _time
 
         from geomesa_tpu.filter.parser import to_cql
@@ -1720,6 +1935,20 @@ class TpuDataStore:
                     trace_id=trace.current_trace_id() or "",
                     outcome=outcome,
                 )
+            )
+        if count_metrics and plans_mod.enabled():
+            # failed queries fingerprint too: a shape that times out is
+            # exactly the shape the misestimate/decision record explains
+            # (count_metrics=False = a join-level failure event that
+            # already wrote its own join-class fingerprint)
+            self._plans_obj().observe(
+                "query", name, plan=plan, query=query,
+                scan_path=(
+                    self._collect_scan_path(plan) if plan is not None else ""
+                ),
+                outcome=outcome, hits=0, duration_s=elapsed_ms / 1000.0,
+                est_cost=plan.cost if plan is not None else None,
+                est_ranges=len(plan.ranges) if plan is not None else None,
             )
 
     def _log_slow_query(self, name: str, plan, root) -> None:
@@ -1831,6 +2060,9 @@ class TpuDataStore:
                 mesh_mod.trip_device(
                     self.executor, "GEOMESA_DENSITY_DEVICE", "density", e
                 )
+                audit_mod.decision(
+                    "degrade", "density_to_host", error=type(e).__name__
+                )
                 grid = None
             if grid is not None:
                 plan.scan_path = "device-density"
@@ -1862,6 +2094,9 @@ class TpuDataStore:
                     raise  # the query's budget died, not the device
                 mesh_mod.trip_device(
                     self.executor, "GEOMESA_STATS_DEVICE", "stats", e
+                )
+                audit_mod.decision(
+                    "degrade", "stats_to_host", error=type(e).__name__
                 )
                 stat = None
             if stat is not None:
@@ -2018,6 +2253,9 @@ class TpuDataStore:
                         "degrade.device_to_host",
                         reason=f"{type(e).__name__}: {e}",
                     )
+                    audit_mod.decision(
+                        "degrade", "device_to_host", error=type(e).__name__
+                    )
                 plan.scan_path = "host-table-degraded"
                 sp.set_attr("scan_path", plan.scan_path)
                 return self._consume_scan(
@@ -2103,7 +2341,8 @@ class TpuDataStore:
                 raise QueryTimeout(
                     f"query exceeded {self.query_timeout_s}s (geomesa.query.timeout analog)"
                 )
-            with trace.span("scan.block", rows_in=len(rows)) as bsp:
+            rows_in = len(rows)
+            with trace.span("scan.block", rows_in=rows_in) as bsp:
                 if covered is not None and pf_props is not None:
                     rows = self._filter_block_covered(
                         ft, plan, block, rows, covered, age_cutoff, pf_props
@@ -2122,6 +2361,9 @@ class TpuDataStore:
                     if vmask is not None:
                         rows = rows[vmask]
                 bsp.set_attr("rows_out", len(rows))
+            # per-block actuals for the plan fingerprint's estimate-vs-
+            # actual record (one contextvar read when plans are off)
+            plans_mod.note_scan(rows_in, len(rows))
             # the yield sits OUTSIDE the span: a streaming consumer may
             # suspend here indefinitely, and a span (contextvar) must
             # never stay open across a generator suspension
@@ -2346,6 +2588,38 @@ class HostScanExecutor(ScanExecutor):
 _INTERNAL_SUFFIXES = (
     "__vocab", "__bxmin", "__bymin", "__bxmax", "__bymax", "__isrect"
 )
+
+
+# span attributes worth carrying into an EXPLAIN ANALYZE stage row —
+# plan/scan provenance and row counts, not free-form payloads
+_STAGE_ATTRS = (
+    "index", "scan_path", "type", "cost", "n_ranges", "union_arms",
+    "rows_in", "rows_out", "rows", "hits", "coalesced", "n", "shards",
+)
+
+
+def _stage_tree(sp) -> Dict[str, Any]:
+    """One span subtree as an EXPLAIN ANALYZE stage row: wall/self
+    times, the provenance attributes, decision/degrade events, nested
+    stages — the per-execution edition of the plan Explainer."""
+    out: Dict[str, Any] = {
+        "stage": sp.name,
+        "duration_ms": round(sp.duration_ms, 3),
+        "self_ms": round(sp.self_time_ms, 3),
+    }
+    attrs = {k: sp.attributes[k] for k in _STAGE_ATTRS if k in sp.attributes}
+    if attrs:
+        out["attrs"] = attrs
+    events = [
+        {k: v for k, v in ev.items() if k != "t_ms"}
+        for ev in sp.events
+        if ev["name"].startswith(("decision.", "degrade.", "fault."))
+    ]
+    if events:
+        out["events"] = events
+    if sp.children:
+        out["stages"] = [_stage_tree(c) for c in sp.children]
+    return out
 
 
 def _scan_label(scan) -> str:

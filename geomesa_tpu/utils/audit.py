@@ -267,6 +267,16 @@ class MetricsRegistry:
             }
         return out.get(name, {}) if name is not None else out
 
+    def drop_timer(self, name: str) -> None:
+        """Remove one timer's reservoir, totals, and exemplars — how the
+        plan-fingerprint registry (utils/plans.py) keeps its per-
+        fingerprint timers bounded by the same LRU that bounds the
+        fingerprints themselves."""
+        with self._lock:
+            self._timers.pop(name, None)
+            self._timer_totals.pop(name, None)
+            self._exemplars.pop(name, None)
+
     def timer(self, name: str):
         registry = self
 
@@ -353,6 +363,34 @@ def robustness_metrics() -> MetricsRegistry:
         if _ROBUSTNESS is None:
             _ROBUSTNESS = MetricsRegistry()
         return _ROBUSTNESS
+
+
+def decision(point: str, reason: str, **attrs: Any) -> None:
+    """Reason-coded adaptive-decision audit: the ONE helper every
+    decline/degrade/fallback/hedge/reroute branch routes through
+    (scripts/lint_observability.sh rule 5 pins the pairing), so "why did
+    the system take the slow/safe path" is answerable from three joined
+    surfaces at once:
+
+    * a ``decision.<point>`` span event (``reason`` + attrs) on the
+      query that suffered it — free outside a trace;
+    * a ``decision.<point>.<reason>`` counter in
+      ``robustness_metrics()`` — rates/deltas on /metrics and the
+      timeline;
+    * a tally on the current query's plan fingerprint
+      (utils/plans.py) — one contextvar read when plan telemetry is
+      off, so the hook is hot-path safe.
+
+    ``reason`` must be a STABLE code (``boundary_dominates``,
+    ``antipodal_radius``), never a formatted message — messages go in
+    ``attrs`` where they stay out of counter names."""
+    robustness_metrics().inc(f"decision.{point}.{reason}")
+    from geomesa_tpu.utils import trace as _trace
+
+    _trace.event(f"decision.{point}", reason=reason, **attrs)
+    from geomesa_tpu.utils import plans as _plans
+
+    _plans.note(point, reason)
 
 
 def _flatten(snapshot):

@@ -279,6 +279,15 @@ SLO_AGGREGATE_LATENCY_MS = SystemProperty(
 SLO_STREAM_FIRST_LATENCY_MS = SystemProperty(
     "geomesa.slo.stream.first.latency.ms", "250"
 )
+# Plan-quality telemetry (utils/plans.py): per-fingerprint aggregates —
+# normalized plan shape -> calls/outcomes/latency/rows/receipts/
+# estimate-vs-actual/decision tallies — behind GET /debug/plans,
+# POST /explain, and the timeline's per-tick top-fingerprint deltas.
+# `enabled=0` reduces every hot-path hook to a single cached flag read
+# (the exemplar-hook posture; poisoned-registry test pins it). `max`
+# bounds the top-K LRU of fingerprints per registry (fixed memory).
+PLANS_ENABLED = SystemProperty("geomesa.plans.enabled", "true")
+PLANS_MAX = SystemProperty("geomesa.plans.max", "256")
 # Crash recovery (store/journal.py): corrupt files quarantined by the
 # integrity layer are kept for operator inspection, then aged out by the
 # store-open scrub once older than this TTL (bounds disk leakage from

@@ -113,6 +113,7 @@ class TimelineSampler:
         self._ring: deque = deque(maxlen=capacity)
         self._prev_counters: Dict[str, int] = {}
         self._prev_totals: Dict[str, tuple] = {}
+        self._prev_plans: Dict[str, tuple] = {}
         self._primed = False
         self.ticks = 0  # cumulative, survives ring rotation
         self._lock = threading.Lock()
@@ -189,6 +190,7 @@ class TimelineSampler:
             snap["caches"] = self._cache_rates(deltas)
             self._prev_counters = counters
             self._prev_totals = totals
+            was_primed = self._primed
             self._primed = True
             # passive observations: peek_states runs no transitions,
             # peek() takes no locks — the recorder watches, never drives
@@ -198,6 +200,21 @@ class TimelineSampler:
                 adm = getattr(store, "admission", None)
                 if adm is not None:
                     snap["admission"] = adm.peek()
+                # per-tick top plan-fingerprint deltas (utils/plans.py):
+                # "which plan shapes were hot THIS second". Reads the
+                # registry only if the store already HAS one — a sampler
+                # tick must never be what creates telemetry state
+                preg = getattr(store, "_plans", None)
+                if preg is not None:
+                    from geomesa_tpu.utils import plans as _plans
+
+                    self._prev_plans, prows = _plans.timeline_deltas(
+                        preg, self._prev_plans
+                    )
+                    # first tick primes the baseline, reports nothing
+                    # (the counter-delta rule above)
+                    if prows and was_primed:
+                        snap["plans"] = prows
                 extra = getattr(store, "_timeline_extra", None)
                 if extra is not None:
                     snap.update(extra())

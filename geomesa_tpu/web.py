@@ -15,6 +15,14 @@ SURVEY.md section 2.5). Endpoints over a datastore:
     POST /query/stream           -- the POST edition: JSON body {"name",
                                     "cql"?, "max"?, "batch_rows"?} ->
                                     the same chunked Arrow stream
+    POST /explain                -- EXPLAIN ANALYZE (utils/plans.py):
+                                    JSON body {"name", "cql"?, "max"?} ->
+                                    the query executed under a forced
+                                    trace, returned as its plan tree
+                                    annotated with per-stage self-times,
+                                    rows in/out, the cost receipt,
+                                    reason-coded decisions, and
+                                    estimate-vs-actual misestimate
     POST /join                   -- device-side spatial join (ops/join.py):
                                     JSON body {"build": {"name", "cql"},
                                     "probe": {"name", "cql"}, "predicate":
@@ -56,6 +64,14 @@ SURVEY.md section 2.5). Endpoints over a datastore:
                                     class objectives, fast/slow-window
                                     burn rates, violation verdicts, and
                                     trace-linked worst exemplars
+    GET /debug/plans?n=&sort=    -- plan-quality telemetry
+                                    (utils/plans.py): top query
+                                    fingerprints — calls/outcomes,
+                                    latency, rows, receipts, estimate-
+                                    vs-actual misestimate, decision
+                                    tallies; sort=time|calls|hits|
+                                    misestimate; per-shard rollup +
+                                    merged table on sharded stores
     GET /debug/report?s=300      -- one-shot incident report: every
                                     debug surface + slow-query log tail +
                                     resolved exemplar traces + config
@@ -180,6 +196,28 @@ def debug_slo_payload(store):
     return eng.evaluate()
 
 
+# /debug/plans ?n= clamp (the MAX_DEBUG_TRACES posture); the ?sort=
+# whitelist comes from utils/plans.SORTS — one source, no drift
+MAX_DEBUG_PLANS = 1000
+
+
+def debug_plans_payload(store, n: int = 20, sort: str = "time"):
+    from geomesa_tpu.utils import plans as _plans
+
+    obj = getattr(store, "_plans_obj", None)
+    if obj is None:
+        return {"enabled": _plans.enabled(), "count": 0, "fingerprints": []}
+    out = obj().payload(sort=sort, n=n)
+    # sharded coordinator: per-shard top blocks (through the worker
+    # telemetry seam) + the cross-shard merged table
+    rollup = getattr(store, "plans_rollup", None)
+    if rollup is not None:
+        shards, merged = rollup(n=n)
+        out["shards"] = shards
+        out["merged"] = merged
+    return out
+
+
 # every /debug/* surface, by route name — the /debug/report bundle
 # assembles ALL of them (lint rule 4 pins the closure). Values take
 # (store, window_s); surfaces without a window ignore it.
@@ -190,6 +228,7 @@ REPORT_SECTIONS = {
     "recovery": lambda store, s: debug_recovery_payload(store),
     "timeline": lambda store, s: debug_timeline_payload(store, s),
     "slo": lambda store, s: debug_slo_payload(store),
+    "plans": lambda store, s: debug_plans_payload(store, 10),
 }
 
 
@@ -292,7 +331,7 @@ def make_handler(store):
                 self._send(500, json.dumps({"error": str(e)}))
 
         def _stream_query(self, name: str, cql: str, max_features,
-                          batch_rows=None) -> None:
+                          batch_rows=None, dictionary=None) -> None:
             """Shared body of GET /query?stream=1 and POST /query/stream:
             the store's Arrow record-batch stream as chunked transfer
             encoding. The FIRST chunk is forced before the headers go
@@ -300,14 +339,21 @@ def make_handler(store):
             timeouts still map to clean 4xx/5xx responses; a failure
             after the first byte terminates the chunked stream WITHOUT
             the final 0-length chunk — clients see a transport error,
-            never a silently truncated result that parses clean."""
+            never a silently truncated result that parses clean.
+            ``dictionary`` names string columns to dictionary-encode on
+            the wire — ONE unified dictionary across all batches (delta
+            dictionaries in the IPC stream), so the streamed concat
+            equals the materialized table, encoding included."""
             from geomesa_tpu.arrow.vector import iter_ipc
             from geomesa_tpu.index.planner import Query
 
             q = Query.cql(cql)
             if max_features is not None:
                 q.max_features = int(max_features)
-            chunks = iter_ipc(store.query_stream(name, q, batch_rows=batch_rows))
+            chunks = iter_ipc(store.query_stream(
+                name, q, batch_rows=batch_rows,
+                dictionary_encode=list(dictionary or ()),
+            ))
             first = next(chunks)  # errors surface BEFORE any header
             self._streaming = True
             self.send_response(200)
@@ -343,67 +389,144 @@ def make_handler(store):
             self.wfile.write(b"\r\n")
             self.wfile.flush()
 
+        def _read_json_body(self):
+            """Shared POST body intake: Content-Length validated (a
+            negative one would rfile.read(-1) until an EOF the client
+            may never send), size-capped (413), JSON-parsed. Returns the
+            dict, or None with the error response already sent."""
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                if length < 0:
+                    raise ValueError(length)
+            except ValueError:
+                self._send(
+                    400, json.dumps({"error": "invalid Content-Length"})
+                )
+                return None
+            if length > MAX_JOIN_BODY:
+                self._send(
+                    413, json.dumps({"error": "request body too large"})
+                )
+                return None
+            raw = self.rfile.read(length) if length else b"{}"
+            try:
+                body = json.loads(raw or b"{}")
+            except ValueError:
+                self._send(400, json.dumps({"error": "invalid JSON body"}))
+                return None
+            if not isinstance(body, dict):
+                self._send(
+                    400, json.dumps({"error": "body must be a JSON object"})
+                )
+                return None
+            return body
+
         def do_POST(self):
             try:
                 parsed = urllib.parse.urlparse(self.path)
                 route = parsed.path.rstrip("/")
                 if route == "/query/stream":
-                    try:
-                        length = int(self.headers.get("Content-Length") or 0)
-                        if length < 0:
-                            raise ValueError(length)
-                    except ValueError:
-                        self._send(
-                            400, json.dumps({"error": "invalid Content-Length"})
-                        )
+                    body = self._read_json_body()
+                    if body is None:
                         return
-                    if length > MAX_JOIN_BODY:
-                        self._send(
-                            413, json.dumps({"error": "request body too large"})
-                        )
-                        return
-                    raw = self.rfile.read(length) if length else b"{}"
                     try:
-                        body = json.loads(raw or b"{}")
                         name = body["name"]
-                    except (ValueError, KeyError, TypeError):
+                    except KeyError:
                         self._send(
                             400,
                             json.dumps({"error": (
                                 'body needs {"name", "cql"?, "max"?, '
-                                '"batch_rows"?}'
+                                '"batch_rows"?, "dictionary"?}'
                             )}),
                         )
                         return
+                    dictionary = body.get("dictionary")
+                    if dictionary is not None and (
+                        not isinstance(dictionary, list)
+                        or not all(isinstance(c, str) for c in dictionary)
+                    ):
+                        # a bare string would silently split into
+                        # characters; anything else would 500 — both are
+                        # the caller's error
+                        self._send(
+                            400,
+                            json.dumps({"error": (
+                                "dictionary must be a list of column names"
+                            )}),
+                        )
+                        return
+                    if dictionary:
+                        # a typo'd column would silently stream un-
+                        # encoded utf8 — name-check against the type's
+                        # string attributes (unknown TYPE falls through
+                        # to the ordinary stream error mapping)
+                        try:
+                            ft = store.get_schema(name)
+                        except Exception:  # noqa: BLE001
+                            ft = None
+                        if ft is not None:
+                            strings = {
+                                a.name for a in ft.attributes
+                                if getattr(a.type, "name", "") == "STRING"
+                            }
+                            bad = [c for c in dictionary
+                                   if c not in strings]
+                            if bad:
+                                self._send(
+                                    400,
+                                    json.dumps({"error": (
+                                        f"dictionary columns {bad} are "
+                                        "not string attributes of "
+                                        f"{name!r}"
+                                    )}),
+                                )
+                                return
                     self._stream_query(
                         name, body.get("cql", "INCLUDE"), body.get("max"),
                         body.get("batch_rows"),
+                        dictionary=dictionary,
                     )
+                    return
+                if route == "/explain":
+                    # EXPLAIN ANALYZE: run the query for real under a
+                    # forced trace; the response is the annotated plan
+                    # tree (stage self-times, rows in/out, receipt,
+                    # reason-coded decisions, estimate vs actual)
+                    body = self._read_json_body()
+                    if body is None:
+                        return
+                    try:
+                        name = body["name"]
+                    except KeyError:
+                        self._send(
+                            400,
+                            json.dumps({"error": (
+                                'body needs {"name", "cql"?, "max"?}'
+                            )}),
+                        )
+                        return
+                    from geomesa_tpu.index.planner import Query
+
+                    q = Query.cql(body.get("cql", "INCLUDE"))
+                    if body.get("max") is not None:
+                        try:
+                            q.max_features = int(body["max"])
+                        except (TypeError, ValueError):
+                            self._send(
+                                400,
+                                json.dumps(
+                                    {"error": "max must be an integer"}
+                                ),
+                            )
+                            return
+                    got = store.explain_analyze(name, q)
+                    self._send(200, json.dumps(got, default=str))
                     return
                 if route != "/join":
                     self._send(404, json.dumps({"error": "not found"}))
                     return
-                try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    if length < 0:
-                        # rfile.read(-1) would block until an EOF the
-                        # client may never send
-                        raise ValueError(length)
-                except ValueError:
-                    self._send(
-                        400, json.dumps({"error": "invalid Content-Length"})
-                    )
-                    return
-                if length > MAX_JOIN_BODY:
-                    self._send(
-                        413, json.dumps({"error": "request body too large"})
-                    )
-                    return
-                raw = self.rfile.read(length) if length else b"{}"
-                try:
-                    body = json.loads(raw or b"{}")
-                except ValueError:
-                    self._send(400, json.dumps({"error": "invalid JSON body"}))
+                body = self._read_json_body()
+                if body is None:
                     return
                 try:
                     bspec = body["build"]
@@ -731,6 +854,43 @@ def make_handler(store):
                     # verdicts, and trace-linked worst exemplars
                     self._send(
                         200, json.dumps(debug_slo_payload(store), default=str)
+                    )
+                elif route == "/debug/plans":
+                    # plan-quality telemetry (utils/plans.py): the top
+                    # query fingerprints — calls/outcomes/latency, rows,
+                    # receipts, estimate-vs-actual misestimate, decision
+                    # tallies — sortable; per-shard rollup when sharded.
+                    # Param contract mirrors /debug/traces?n=: caller
+                    # errors answer 400, absurd sizes clamp
+                    try:
+                        n = int(params.get("n", 20))
+                    except ValueError:
+                        self._send(
+                            400, json.dumps({"error": "n must be an integer"})
+                        )
+                        return
+                    if n < 0:
+                        self._send(
+                            400, json.dumps({"error": "n must be >= 0"})
+                        )
+                        return
+                    n = min(n, MAX_DEBUG_PLANS)
+                    from geomesa_tpu.utils.plans import SORTS
+
+                    sort = params.get("sort", "time")
+                    if sort not in SORTS:
+                        self._send(
+                            400,
+                            json.dumps({"error": (
+                                f"sort must be one of {list(SORTS)}"
+                            )}),
+                        )
+                        return
+                    self._send(
+                        200,
+                        json.dumps(
+                            debug_plans_payload(store, n, sort), default=str
+                        ),
                     )
                 elif route == "/debug/report":
                     # the one-shot incident report: every debug surface +

@@ -417,6 +417,11 @@ def run_stream(n: int, reps: int) -> dict:
             "interval_s": sampler.interval_s,
             "snapshots": timeline_snaps,
         },
+        # top plan fingerprints of the measured stream (utils/plans.py —
+        # not gated): a regressed band arrives WITH plan attribution
+        # (which shape got slow, how wrong its cost estimate was, which
+        # decisions fired) instead of a bare number
+        "plans": {"top": store._plans_obj().rows(sort="time", n=10)},
         "config": {
             "n": n,
             "reps": reps,

@@ -39,6 +39,11 @@
 #     strikes a breaker, runs a breaker transition, or holds the
 #     admission queue (the observability layer must not perturb the
 #     failure behavior it records)
+#   - plan-fingerprint exactness under faults (tests/test_plans.py):
+#     for device fault schedules, every query still counts EXACTLY once
+#     in its plan fingerprint — a degraded query lands on the degraded
+#     scan-path fingerprint with its reason-coded degrade decision
+#     recorded, never double-counted and never lost
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
@@ -46,4 +51,5 @@ cd "$(dirname "$0")/.."
 exec timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_crash.py tests/test_shards.py \
     tests/test_join.py tests/test_agg_cache.py tests/test_timeline.py \
+    tests/test_plans.py \
     -q -m chaos -p no:cacheprovider "$@"

@@ -92,6 +92,40 @@ while IFS= read -r route; do
     fi
 done < <(grep -oE '"/debug/[a-z_]+"' geomesa_tpu/web.py | sort -u)
 
+# 5. Reason-coded decision audit — any FILE bumping a degrade/declined/
+#    fallback counter in geomesa_tpu/ must also call the reason-coded
+#    utils/audit.decision(...) helper, so adaptive branches (cache
+#    decline, device->host degrade, coalesce fallback) land on /metrics
+#    AND the query's span AND its plan fingerprint (utils/plans.py) at
+#    once. FILE granularity: a new file with an unaudited fallback
+#    branch fails outright; within an already-audited file the pairing
+#    of each individual site is a review responsibility (the pins below
+#    keep the audited files from regressing to zero). (audit.py defines
+#    the helper; it bumps no fallback counters itself.)
+while IFS= read -r f; do
+    [ "$f" = "geomesa_tpu/utils/audit.py" ] && continue
+    if ! grep -qE '(audit(_mod)?\.)?decision\(' "$f"; then
+        echo "FAIL: ${f} bumps a degrade/declined/fallback counter but never"
+        echo "      calls utils/audit.decision(point, reason, ...) — adaptive"
+        echo "      branches must be reason-coded (counter + span event +"
+        echo "      plan-fingerprint tally), not just counted"
+        fail=1
+    fi
+done < <(grep -rlE 'inc\("(degrade\.[a-z_.]+|agg\.cache\.declined|[a-z._]*fallback[a-z._]*)"' \
+    --include='*.py' geomesa_tpu/ || true)
+
+# pin the known decision-audited files: if one of these loses its last
+# decision() call the rule above can no longer see the file at all
+for f in geomesa_tpu/parallel/executor.py geomesa_tpu/parallel/batch.py \
+         geomesa_tpu/parallel/shards.py geomesa_tpu/store/datastore.py \
+         geomesa_tpu/ops/join.py; do
+    if ! grep -qE '(audit(_mod)?\.)?decision\(' "$f"; then
+        echo "FAIL: ${f} lost its reason-coded decision(...) calls"
+        echo "      (pinned adaptive-decision site — see utils/audit.decision)"
+        fail=1
+    fi
+done
+
 if [ "$fail" -eq 0 ]; then
     echo "observability lint clean"
 fi

@@ -1,0 +1,584 @@
+"""Plan-quality telemetry (PR 11): query fingerprints (utils/plans.py),
+EXPLAIN ANALYZE, reason-coded decisions (utils/audit.decision), and the
+/debug/plans + POST /explain surfaces.
+
+Pins the PR 11 contract:
+
+* fingerprints normalize literals away — two bboxes over the same
+  column/index/path are ONE fingerprint with two calls;
+* the registry is fixed-memory — a top-K LRU whose eviction also drops
+  the per-fingerprint latency timer;
+* estimate-vs-actual is recorded per query — a deliberately mis-costed
+  plan shows up as a large log2 misestimate;
+* EXPLAIN ANALYZE attributes >=90% of a device-path query's wall time
+  to named plan stages (the PR 2 idiom, per execution);
+* adaptive branches are reason-coded: pyramid decline, join kernel
+  decline, and coalesce fallback each leave a decision.<point>.<reason>
+  counter AND a tally on the query's fingerprint;
+* the sharded rollup serves each worker's registry through the
+  telemetry seam, and the merged table sums exactly;
+* free when off — geomesa.plans.enabled=0 reduces the hot path to one
+  flag read (poisoned-registry idiom), and fingerprint stats stay EXACT
+  under fault schedules (a degraded query counts once, on the degraded
+  fingerprint, with its degrade decision recorded).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import audit, faults, plans, trace
+from geomesa_tpu.utils.audit import (
+    InMemoryAuditWriter,
+    MetricsRegistry,
+    robustness_metrics,
+)
+from geomesa_tpu.utils.config import properties
+
+T0 = 1483228800000
+DAY = 86400000
+SPEC = "actor:String,dtg:Date,*geom:Point:srid=4326"
+CQL = "bbox(geom, -50, -50, 50, 50)"
+
+
+@pytest.fixture(autouse=True)
+def _plans_flag():
+    """Re-resolve the cached plans flag from the knob around every test
+    (it is cached module-wide by design)."""
+    plans.set_enabled(None)
+    yield
+    plans.set_enabled(None)
+
+
+def _fill(store, name="gdelt", n=2000, seed=3):
+    ft = parse_spec(name, SPEC)
+    store.create_schema(ft)
+    rng = np.random.default_rng(seed)
+    store._insert_columns(ft, {
+        "__fid__": np.array([f"f{i}" for i in range(n)], dtype=object),
+        "geom__x": rng.uniform(-80, 80, n),
+        "geom__y": rng.uniform(-80, 80, n),
+        "dtg": T0 + rng.integers(0, 30 * DAY, n),
+        "actor": np.array([["USA", "FRA", "CHN"][i % 3] for i in range(n)],
+                          dtype=object),
+    })
+    return store
+
+
+def _device_store(n=5000):
+    """Single-device store on the device scan path (the PR 9/10 test
+    shape: one device per host; the 8-virtual-device conftest mesh can
+    deadlock concurrent SOLO queries in XLA's collective rendezvous)."""
+    import jax
+
+    from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+
+    return _fill(TpuDataStore(
+        executor=TpuScanExecutor(default_mesh(jax.devices()[:1])),
+        metrics=MetricsRegistry(),
+        audit_writer=InMemoryAuditWriter(),
+    ), n=n)
+
+
+def _rows(store, **kw):
+    return store._plans_obj().rows(**kw)
+
+
+# -- fingerprint normalization ------------------------------------------------
+
+
+class TestFingerprints:
+    def test_two_bboxes_one_fingerprint(self):
+        store = _fill(TpuDataStore())
+        store.query("gdelt", "bbox(geom, -50, -50, 50, 50)")
+        store.query("gdelt", "bbox(geom, -10, -10, 10, 10)")
+        rows = _rows(store)
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["calls"] == 2
+        assert r["shape"] == "BBOX(geom)"
+        assert r["index"] == "z2"
+        assert r["outcomes"] == {"ok": 2}
+        assert r["hits"] > 0 and r["rows_scanned"] >= r["rows_returned"] > 0
+
+    def test_shape_changes_split_fingerprints(self):
+        store = _fill(TpuDataStore())
+        store.query("gdelt", CQL)
+        store.query("gdelt", "actor = 'USA'")
+        store.query("gdelt", f"({CQL}) AND actor = 'FRA'")
+        shapes = {r["shape"] for r in _rows(store)}
+        assert len(shapes) == 3
+        # AND children sort, literals erase
+        assert "AND(BBOX(geom),actor=?)" in shapes
+
+    def test_filter_shape_order_independent(self):
+        from geomesa_tpu.filter.parser import parse_cql
+
+        a = plans.filter_shape(
+            parse_cql("bbox(geom, 0, 0, 1, 1) AND actor = 'x'")
+        )
+        b = plans.filter_shape(
+            parse_cql("actor = 'y' AND bbox(geom, 5, 5, 9, 9)")
+        )
+        assert a == b == "AND(BBOX(geom),actor=?)"
+
+    def test_latency_timer_and_summary_attached(self):
+        store = _fill(TpuDataStore())
+        for _ in range(3):
+            store.query("gdelt", CQL)
+        r = _rows(store)[0]
+        assert r["latency"]["count"] == 3
+        assert r["latency"]["p99_ms"] >= r["latency"]["p50_ms"] > 0
+        assert r["total_ms"] > 0
+
+    def test_exemplar_links_worst_sample_to_trace(self):
+        store = _fill(TpuDataStore())
+        audit.set_exemplars(True)
+        try:
+            with trace.exporting(trace.InMemoryTraceExporter()):
+                store.query("gdelt", CQL)
+        finally:
+            audit.set_exemplars(False)
+        r = _rows(store)[0]
+        assert r["worst_exemplar"]["trace_id"]
+        assert r["worst_exemplar"]["ms"] > 0
+
+
+# -- fixed memory -------------------------------------------------------------
+
+
+class TestBoundedRegistry:
+    def test_lru_bound_and_timer_cleanup(self):
+        reg = plans.PlanRegistry(cap=4)
+        for i in range(10):
+            reg.observe("query", f"type{i}", scan_path="host-table",
+                        duration_s=0.001 * (i + 1))
+        assert len(reg) == 4
+        assert reg.evicted == 6
+        # evicted fingerprints drop their timers too (fixed memory)
+        _c, _g, timers, totals = reg.metrics.snapshot()
+        assert len(timers) == 4 and len(totals) == 4
+        # survivors are the most recently used
+        kept = {r["type"] for r in reg.rows(n=10)}
+        assert kept == {"type6", "type7", "type8", "type9"}
+
+    def test_rows_sorting_and_validation(self):
+        reg = plans.PlanRegistry(cap=8)
+        reg.observe("query", "a", duration_s=0.5)
+        reg.observe("query", "b", duration_s=0.1)
+        reg.observe("query", "b", duration_s=0.1)
+        assert [r["type"] for r in reg.rows(sort="time")] == ["a", "b"]
+        assert [r["type"] for r in reg.rows(sort="calls")] == ["b", "a"]
+        with pytest.raises(ValueError):
+            reg.rows(sort="bogus")
+
+
+# -- estimate vs actual -------------------------------------------------------
+
+
+class TestMisestimate:
+    def test_miscosted_plan_shows_large_log_ratio(self):
+        store = _fill(TpuDataStore(), n=4000)
+        q = Query.cql(CQL)
+        store.query("gdelt", q)  # honest cost first
+        honest = _rows(store)[0]["misestimate"]["mean_log2"]
+        assert honest is not None and abs(honest) <= 3
+        # deliberately mis-cost the CACHED plan: the executor consumes
+        # rows the model claimed would not exist
+        plan = store._plan_cached("gdelt", q)
+        plan.cost = 1.0
+        store.query("gdelt", q)
+        r = _rows(store)[0]
+        assert r["calls"] == 2
+        hist = {int(b): c for b, c in r["misestimate"]["hist"].items()}
+        assert max(hist) >= 6, hist  # ~2^6+ under-estimate recorded
+        assert r["estimate"]["cost_mean"] < r["actual"]["rows_mean"]
+
+    def test_streamed_query_records_same_actuals_as_materialized(self):
+        """A streamed query must fold into the SAME fingerprint record
+        as its materialized twin — rows scanned per block included, so
+        stream traffic cannot corrupt the shared misestimate."""
+        store = _fill(TpuDataStore(metrics=MetricsRegistry()))
+        store.query("gdelt", CQL)
+        base = _rows(store)[0]
+        list(store.query_stream("gdelt", CQL, batch_rows=128))
+        r = _rows(store)[0]
+        assert r["fingerprint"] == base["fingerprint"]
+        assert r["calls"] == 2
+        # the streamed pass contributed real per-block actuals
+        assert r["rows_scanned"] == 2 * base["rows_scanned"]
+        assert r["rows_returned"] == 2 * base["rows_returned"]
+        # and an identical misestimate bucket (same plan, same actuals)
+        assert r["misestimate"]["hist"] == {
+            b: 2 * c for b, c in base["misestimate"]["hist"].items()
+        }
+
+    def test_no_misestimate_verdict_without_observed_blocks(self):
+        """A query whose scan ran in another context (a coalesced
+        follower: the leader's thread did the blocks) must not bucket
+        actual=0 against a real cost — no blocks observed, no verdict.
+        Without a pending scope at all, hits stand in (join/aggregate
+        class observes pass no est_cost, so this is the stream-less
+        direct-observe path)."""
+        reg = plans.PlanRegistry(cap=4)
+        q = Query.cql(CQL)
+        tok = plans.begin()  # pending scope exists, but zero blocks
+        try:
+            reg.observe("query", "t", query=q, est_cost=8192.0,
+                        est_ranges=4, duration_s=0.01, hits=100)
+        finally:
+            plans.end(tok)
+        assert reg.rows()[0]["misestimate"]["hist"] == {}
+        # no pending scope: the hits fallback still records a bucket
+        reg.observe("query", "t", query=q, est_cost=100.0,
+                    est_ranges=4, duration_s=0.01, hits=100)
+        assert reg.rows()[0]["misestimate"]["hist"] == {"0": 1}
+
+    def test_merge_rows_recomputes_weighted_means(self):
+        a = plans.PlanRegistry(cap=4)
+        b = plans.PlanRegistry(cap=4)
+        q = Query.cql(CQL)
+        a.observe("query", "t", query=q, est_cost=10.0, est_ranges=2,
+                  duration_s=0.01, hits=1)
+        for _ in range(9):
+            b.observe("query", "t", query=q, est_cost=10000.0,
+                      est_ranges=20, duration_s=0.01, hits=1)
+        merged = plans.merge_rows([a.rows(n=10), b.rows(n=10)])
+        assert len(merged) == 1
+        m = merged[0]
+        assert m["calls"] == 10
+        # exact weighted mean, not the first shard's verbatim mean
+        assert m["estimate"]["cost_mean"] == pytest.approx(
+            (10.0 + 9 * 10000.0) / 10
+        )
+        assert m["estimate"]["ranges_mean"] == pytest.approx(
+            (2 + 9 * 20) / 10
+        )
+
+    def test_timeline_carries_top_fingerprint_deltas(self):
+        from geomesa_tpu.utils.timeline import TimelineSampler
+
+        store = _fill(TpuDataStore(metrics=MetricsRegistry()))
+        s = TimelineSampler(store=store, interval_s=0.05, window_s=10)
+        s.tick()  # prime
+        store.query("gdelt", CQL)
+        snap = s.tick()
+        assert snap["plans"], "no per-tick fingerprint deltas recorded"
+        row = snap["plans"][0]
+        assert row["calls"] == 1 and row["type"] == "gdelt"
+        # idle tick: no plans block (delta-only, like counters)
+        snap2 = s.tick()
+        assert "plans" not in snap2
+
+
+# -- EXPLAIN ANALYZE ----------------------------------------------------------
+
+
+class TestExplainAnalyze:
+    def test_device_path_attribution_and_estimates(self, monkeypatch):
+        """The acceptance criterion: EXPLAIN ANALYZE on a device-path
+        query attributes >=90% of wall time to named plan stages, and
+        reports estimate vs actual for the execution."""
+        monkeypatch.setenv("GEOMESA_SEEK", "0")  # keep the device path live
+        store = _device_store()
+        store.query("gdelt", CQL)  # warm: compile + mirror upload
+        # best-covered of a few runs (the PR 2 idiom: coverage is a
+        # property of the instrumentation, not one run's GC luck)
+        best = None
+        for _ in range(5):
+            store._plan_cache.clear()
+            ea = store.explain_analyze("gdelt", CQL)
+            if best is None or ea["attribution"]["fraction"] > \
+                    best["attribution"]["fraction"]:
+                best = ea
+        assert best["attribution"]["fraction"] >= 0.9, json.dumps(
+            best["attribution"]
+        )
+        stage_names = set()
+
+        def walk(st):
+            stage_names.add(st["stage"])
+            for c in st.get("stages", ()):
+                walk(c)
+
+        walk(best["stages"])
+        assert {"query", "plan", "scan", "scan.block"} <= stage_names
+        assert best["actual"]["rows_scanned"] > 0
+        assert best["actual"]["hits"] > 0
+        assert best["estimate"]["cost"] > 0
+        assert isinstance(best["misestimate_log2"], float)
+        assert best["fingerprint"]
+        assert best["plan"]["explain"]  # the plan-time Explainer rides along
+
+    def test_explain_analyze_fingerprint_matches_registry(self):
+        store = _fill(TpuDataStore())
+        ea = store.explain_analyze("gdelt", CQL)
+        fids = {r["fingerprint"] for r in _rows(store)}
+        assert ea["fingerprint"] in fids
+
+
+# -- reason-coded decisions ---------------------------------------------------
+
+
+def _counter(name):
+    return robustness_metrics().counter(name)
+
+
+class TestDecisions:
+    def test_pyramid_decline_reason_on_fingerprint(self):
+        """A sub-cell aggregate region declines the pyramid BEFORE the
+        build, with the reason on both the counter and the aggregate's
+        fingerprint."""
+        store = _fill(TpuDataStore(metrics=MetricsRegistry()), n=3000)
+        c0 = _counter("decision.pyramid.sub_cell_region")
+        store.aggregate("gdelt", "bbox(geom, 0.0, 0.0, 0.5, 0.5)")
+        assert _counter("decision.pyramid.sub_cell_region") == c0 + 1
+        agg = [r for r in _rows(store) if r["kind"] == "aggregate"]
+        assert agg and agg[0]["decisions"].get(
+            "pyramid.sub_cell_region") == 1
+        assert agg[0]["scan_path"] == "agg-exact-fallback"
+
+    def test_pyramid_hit_engagement(self):
+        store = _fill(TpuDataStore(metrics=MetricsRegistry()), n=3000)
+        store.aggregate("gdelt", CQL)  # wide region: pyramid answers
+        agg = [r for r in _rows(store) if r["kind"] == "aggregate"
+               and r["scan_path"] == "agg-pyramid"]
+        assert agg and agg[0]["decisions"].get("pyramid.hit") == 1
+
+    def test_join_kernel_decline_antipodal_radius(self):
+        store = _device_store(n=40)
+        _fill(store, name="probe", n=20, seed=7)
+        c0 = _counter("decision.join.kernel.antipodal_radius")
+        # a near-antipodal radius expands every build envelope to the
+        # whole world — keep the bucket grid tiny or the build side
+        # quad-splits itself into thousands of world-covering buckets
+        with properties(geomesa_join_bucket_bits="1",
+                        geomesa_join_split_depth="0"):
+            res = store.query_join("gdelt", "probe", "dwithin",
+                                   radius_m=1.2e7)
+        assert _counter("decision.join.kernel.antipodal_radius") == c0 + 1
+        assert res.stats["path"] == "host-join"  # declined, not degraded
+        jr = [r for r in _rows(store) if r["kind"] == "join"]
+        assert jr and jr[0]["decisions"].get(
+            "join.kernel.antipodal_radius") == 1
+        assert jr[0]["shape"] == "join:dwithin"
+        # the build-cache engagement tally rides the same fingerprint
+        assert jr[0]["decisions"].get("join.build.rebuild") == 1
+
+    def test_coalesce_fallback_reason(self):
+        """A batch.coalesce seam fault degrades the group to solo AND
+        leaves the reason-coded decision on the counter + the leader's
+        fingerprint."""
+        store = _device_store(n=4000)
+        c0 = _counter("decision.coalesce.seam_degraded")
+        barrier = threading.Barrier(3)
+        errors = []
+
+        def worker(q):
+            try:
+                barrier.wait(timeout=10)
+                store.query("gdelt", q)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        with properties(geomesa_batch_enabled="true",
+                        geomesa_batch_window_ms="50"):
+            with faults.inject("batch.coalesce:error=1", seed=5):
+                ts = [threading.Thread(target=worker, args=(
+                    Query.cql(f"bbox(geom, -{20 + i}, -20, {20 + i}, 20)"),
+                )) for i in range(3)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=30)
+        assert not errors, errors
+        assert _counter("decision.coalesce.seam_degraded") > c0
+        tallied = [r for r in _rows(store)
+                   if r["decisions"].get("coalesce.seam_degraded")]
+        assert tallied, "no fingerprint carries the coalesce fallback"
+
+    def test_device_degrade_decision(self, monkeypatch):
+        monkeypatch.setenv("GEOMESA_SEEK", "0")  # keep the device path live
+        store = _device_store(n=3000)
+        store.query("gdelt", CQL)  # warm
+        c0 = _counter("decision.degrade.device_to_host")
+        with faults.inject("device.fetch:error=1", seed=3):
+            store.query("gdelt", CQL)
+        assert _counter("decision.degrade.device_to_host") > c0
+        deg = [r for r in _rows(store)
+               if r["decisions"].get("degrade.device_to_host")]
+        assert deg and deg[0]["scan_path"] == "host-table-degraded"
+
+
+# -- sharded rollup -----------------------------------------------------------
+
+
+class TestShardedRollup:
+    def test_worker_telemetry_and_merged_table_sum_exactly(self):
+        from geomesa_tpu.parallel.shards import ShardedDataStore
+
+        store = _fill(ShardedDataStore(num_shards=3, replicas=0), n=3000)
+        for _ in range(4):
+            store.query("gdelt", CQL)
+        # the worker seam: telemetry()'s plans block IS the worker
+        # registry's top — what a cross-process transport would ship
+        for w in store.workers:
+            assert w.telemetry()["plans"] == w.plans.top(5)
+        shards, merged = store.plans_rollup()
+        per_worker = sum(
+            r["calls"] for w in store.workers for r in w.plans.rows(n=100)
+        )
+        assert per_worker > 0
+        assert sum(r["calls"] for r in merged) == per_worker
+        # coordinator-level fingerprints audit the 4 queries exactly
+        coord = [r for r in _rows(store) if r["type"] == "gdelt"]
+        assert sum(r["calls"] for r in coord) == 4
+        # worker hits across shards reassemble the query answer
+        want = len(store.query("gdelt", CQL))
+        assert sum(
+            r["rows_returned"] for r in merged if r["shape"] == "BBOX(geom)"
+        ) >= want
+
+
+# -- free when off ------------------------------------------------------------
+
+
+class TestFreeWhenOff:
+    def test_poisoned_registry_off_flag(self, monkeypatch):
+        """With geomesa.plans.enabled=0 the query hot path does ZERO
+        fingerprint work: a poisoned registry object and a poisoned
+        observe prove nothing beyond the one flag read ever runs."""
+        store = _fill(TpuDataStore(metrics=MetricsRegistry()))
+
+        def boom(*a, **k):
+            raise AssertionError("hot path touched the plan registry "
+                                 "with plans disabled")
+
+        plans.set_enabled(False)
+        monkeypatch.setattr(TpuDataStore, "_plans_obj", boom)
+        monkeypatch.setattr(plans.PlanRegistry, "observe", boom)
+        monkeypatch.setattr(plans.PlanRegistry, "__init__", boom)
+        res = store.query("gdelt", CQL)
+        assert len(res) > 0
+        store.aggregate("gdelt", CQL)
+        list(store.query_stream("gdelt", CQL))
+        # note/note_scan outside a begin scope are inert one-read no-ops
+        plans.note("pyramid", "hit")
+        plans.note_scan(10, 5)
+
+    def test_flag_resolves_from_knob(self):
+        with properties(geomesa_plans_enabled="false"):
+            plans.set_enabled(None)
+            assert not plans.enabled()
+        plans.set_enabled(None)
+        assert plans.enabled()  # default true
+
+
+# -- web surfaces -------------------------------------------------------------
+
+
+def _get_code(url):
+    try:
+        return urllib.request.urlopen(url, timeout=10).status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+class TestWebSurfaces:
+    @pytest.fixture()
+    def served(self):
+        from geomesa_tpu import web
+
+        store = _fill(TpuDataStore(metrics=MetricsRegistry()))
+        store.query("gdelt", CQL)
+        with web.GeoMesaServer(store) as url:
+            yield store, url
+
+    def test_debug_plans_param_contract(self, served):
+        _store, url = served
+        # the /debug/traces?n= contract: caller errors 400, big clamps
+        assert _get_code(url + "/debug/plans?n=abc") == 400
+        assert _get_code(url + "/debug/plans?n=-1") == 400
+        assert _get_code(url + "/debug/plans?sort=bogus") == 400
+        assert _get_code(url + "/debug/plans?n=999999") == 200
+        for sort in ("time", "calls", "hits", "misestimate"):
+            assert _get_code(url + f"/debug/plans?sort={sort}") == 200
+
+    def test_debug_plans_payload(self, served):
+        _store, url = served
+        got = json.loads(urllib.request.urlopen(
+            url + "/debug/plans?n=5").read())
+        assert got["enabled"] is True
+        assert got["count"] >= 1
+        assert got["fingerprints"][0]["shape"] == "BBOX(geom)"
+
+    def test_post_explain(self, served):
+        _store, url = served
+
+        def post(body):
+            req = urllib.request.Request(
+                url + "/explain", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                resp = urllib.request.urlopen(req, timeout=30)
+                return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, got = post({"name": "gdelt", "cql": CQL})
+        assert code == 200
+        assert got["actual"]["hits"] > 0
+        assert got["attribution"]["fraction"] > 0
+        assert got["plan"]["index"] == "z2"
+        assert post({})[0] == 400          # missing name
+        assert post({"name": "gdelt", "max": "x"})[0] == 400
+
+    def test_report_bundle_has_plans_section(self, served):
+        _store, url = served
+        rep = json.loads(urllib.request.urlopen(
+            url + "/debug/report").read())
+        assert "plans" in rep["sections"]
+        assert rep["sections"]["plans"]["count"] >= 1
+
+
+# -- chaos: exact stats under fault schedules ---------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [3, 11])
+def test_fingerprint_stats_exact_under_fault_schedules(monkeypatch, seed):
+    """The chaos_smoke invariant: under a device fault schedule every
+    query counts EXACTLY once across the type's fingerprints — a
+    degraded query lands on the degraded-path fingerprint carrying its
+    reason-coded degrade decision, never double-counted, never lost —
+    and answers keep parity with the fault-free run."""
+    monkeypatch.setenv("GEOMESA_SEEK", "0")  # force the device scan path
+    store = _device_store(n=4000)
+    want = sorted(store.query("gdelt", CQL).fids)
+
+    def calls():
+        return sum(r["calls"] for r in _rows(store, n=100)
+                   if r["kind"] == "query")
+
+    before = calls()
+    n_queries = 10
+    with faults.inject(
+        "device.fetch:error=0.4,device.dispatch:error=0.2", seed=seed
+    ):
+        for _ in range(n_queries):
+            got = sorted(store.query("gdelt", CQL).fids)
+            assert got == want  # parity under faults
+    assert calls() - before == n_queries  # exactly once each
+    degraded = [r for r in _rows(store, n=100)
+                if r["scan_path"] == "host-table-degraded"]
+    if degraded:  # the schedule fired at least once at these rates
+        assert degraded[0]["decisions"].get("degrade.device_to_host", 0) >= 1
+        assert degraded[0]["outcomes"].get("ok", 0) == degraded[0]["calls"]

@@ -57,6 +57,118 @@ def _fids(tbl):
 CQL = "bbox(geom, -100, -50, 100, 50)"
 
 
+class TestStreamedDictionaries:
+    """PR 11 satellite (ROADMAP-named): dictionaries survive streaming.
+    Per-batch re-encoding minted a NEW dictionary per batch (IPC
+    replacement dictionaries; a consumer holding early batches saw the
+    mapping change). Now every batch of one stream shares a UNIFIED
+    append-only dictionary, shipped as delta dictionaries — streamed
+    concat equals the materialized table, encoding included."""
+
+    def _dict_store(self):
+        store = TpuDataStore()
+        ft = parse_spec("t", SPEC)
+        store.create_schema(ft)
+        # two blocks with DISJOINT name vocabularies: per-block store
+        # vocabs differ, so per-batch encoding would disagree
+        for b, names in enumerate((["alpha", "beta"], ["gamma", "beta"])):
+            store._insert_columns(ft, {
+                "__fid__": np.array(
+                    [f"f{b}_{i}" for i in range(100)], dtype=object),
+                "name": np.array([names[i % 2] for i in range(100)],
+                                 dtype=object),
+                "age": np.arange(100, dtype=np.int32),
+                "dtg": np.full(100, T0, dtype=np.int64),
+                "geom__x": np.linspace(-60, 60, 100),
+                "geom__y": np.linspace(-30, 30, 100),
+            })
+        return store
+
+    def test_unified_dictionary_round_trip(self):
+        import io
+
+        from geomesa_tpu.arrow.vector import iter_ipc
+
+        store = self._dict_store()
+        batches = list(store.query_stream(
+            "t", "INCLUDE", batch_rows=64, dictionary_encode=["name"]))
+        assert len(batches) >= 3
+        dicts = []
+        for b in batches:
+            col = b.column(1)
+            assert pa.types.is_dictionary(col.type)
+            dicts.append(col.dictionary.to_pylist())
+        # append-only: every batch's dictionary EXTENDS the previous
+        # (the delta-dictionary invariant; no replacements mid-stream)
+        for a, b2 in zip(dicts, dicts[1:]):
+            assert b2[: len(a)] == a, (a, b2)
+        assert dicts[-1] == ["alpha", "beta", "gamma"]
+        # full IPC wire round trip == materialized table, order included
+        chunks = b"".join(iter_ipc(store.query_stream(
+            "t", "INCLUDE", batch_rows=64, dictionary_encode=["name"])))
+        tbl = pa.ipc.open_stream(io.BytesIO(chunks)).read_all()
+        mat = store.query("t")
+        assert tbl.column("name").to_pylist() == [
+            str(v) for v in mat.columns["name"]
+        ]
+        assert _fids(tbl) == sorted(map(str, mat.fids))
+
+    def test_write_features_multi_vocab_blocks(self):
+        import io
+
+        from geomesa_tpu.arrow.vector import read_features, write_features
+
+        ft = parse_spec("t", SPEC)
+        cols1 = {
+            "__fid__": np.array(["a", "b"], object),
+            "name": np.array([0, 1], np.int32),
+            "name__vocab": np.array(["X", "Y"]),
+            "age": np.zeros(2, np.int32),
+            "dtg": np.zeros(2, np.int64),
+            "geom__x": np.zeros(2), "geom__y": np.zeros(2),
+        }
+        cols2 = dict(cols1)
+        cols2["__fid__"] = np.array(["c", "d"], object)
+        cols2["name"] = np.array([0, -1], np.int32)  # -1 = null
+        cols2["name__vocab"] = np.array(["Z"])
+        buf = io.BytesIO()
+        write_features(ft, [cols1, cols2], buf, dictionary_encode=["name"])
+        buf.seek(0)
+        _ft, got = read_features(buf)
+        assert list(got["name"]) == ["X", "Y", "Z", None]
+
+    def test_post_stream_dictionary_param(self):
+        store = self._dict_store()
+        with GeoMesaServer(store) as url:
+            req = urllib.request.Request(
+                url + "/query/stream",
+                data=json.dumps({
+                    "name": "t", "batch_rows": 64, "dictionary": ["name"],
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = urllib.request.urlopen(req, timeout=30)
+            tbl = pa.ipc.open_stream(resp.read()).read_all()
+        assert pa.types.is_dictionary(tbl.schema.field("name").type)
+        assert tbl.num_rows == 200
+
+    def test_post_stream_bad_dictionary_param_400(self):
+        store = self._dict_store()
+        with GeoMesaServer(store) as url:
+            # wrong types AND typo'd / non-string column names: a typo
+            # would otherwise stream un-encoded utf8 with a clean 200
+            for bad in ("name", 5, [1, 2], ["naem"], ["age"]):
+                req = urllib.request.Request(
+                    url + "/query/stream",
+                    data=json.dumps(
+                        {"name": "t", "dictionary": bad}
+                    ).encode(),
+                )
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=10)
+                assert ei.value.code == 400
+
+
 class TestQueryStream:
     def test_parity_plain(self):
         store = _store()
