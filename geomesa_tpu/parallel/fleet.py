@@ -1,0 +1,1989 @@
+"""Multi-host serving tier: cross-process shard workers with a
+supervised lifecycle, heartbeat membership, and journaled rebalancing.
+
+The PR 6 shard fabric (``parallel/shards.py``) carries every serving-
+tier behavior — placement with replicas, hedging, per-shard deadline
+slices/breakers/admission, the no-truncated-results invariant — but its
+workers are an in-process thread pool sharing one GIL: a ``crash`` fault
+at ``shard.rpc`` only *simulates* a dead peer. This module puts a real
+transport at the same ``_shard_call`` seam and makes the fleet survive
+genuine process death:
+
+* **Wire protocol** — length-prefixed JSON + Arrow frames reusing the
+  netlog envelope discipline (``stream/netlog.py``): every request is
+  one JSON header frame (op, trace id, the query's REMAINING budget —
+  never an absolute wall-clock instant, so coordinator/worker clock
+  skew cannot stretch or instantly expire a deadline slice) followed by
+  zero or more Arrow IPC column frames. The worker re-anchors the
+  budget against its own monotonic clock (``netlog.envelope_budget``)
+  and serves the scan under it. ``fleet.rpc`` is the client-side fault
+  point; socket timeouts are re-derived PER ATTEMPT from
+  ``min(geomesa.fleet.rpc.timeout, remaining budget)`` with a deadline
+  check BEFORE the dial (the RemoteLogBroker discipline).
+
+* **Worker processes** — each ``FleetDataStore`` shard is a SPAWNED
+  process (``python -m geomesa_tpu.parallel.fleet --worker``) owning
+  its partitions' ``FsDataStore`` roots under ``<root>/workers/w<i>``:
+  host-parallel scans for free (no shared GIL), and the PR 5 intent-
+  journal recovery runs on every worker (re)start — a ``kill -9`` mid-
+  write reopens to exactly the pre- or post-batch row set.
+
+* **Supervision** — a heartbeat loop (``fleet.heartbeat`` fault point)
+  drives a missed-beat → SUSPECT → DEAD state machine with hysteresis
+  (one slow GC pause never triggers a partition move); a dead worker's
+  primary partitions move to live replicas and the process restarts
+  under bounded exponential backoff (``utils/retry.RetryPolicy``). A
+  worker that keeps dying (``geomesa.fleet.flap.*``) is marked OUT via
+  its existing ``shard.<n>`` breaker instead of being restarted again.
+
+* **Rebalancing** — placement moves on shard join/leave/death are
+  journaled through ``store/journal.py`` intents (``fleet.rebalance``
+  fault point): the full placement table is one durably-replaced file,
+  so a coordinator crash at ANY position recovers to exactly the pre-
+  or post-move placement — never a partition owned by zero or two
+  primaries. While a move is copying, writes DUAL-TARGET the old and
+  new chains (``PlacementMap.pending_moves``) so no row written in the
+  window is dropped; duplicates are absorbed by the coordinator's fid
+  dedupe (the replica/hedge belt-and-suspenders, ``_merge_shards``).
+
+* **Graceful drain** — ``drain_worker`` moves the worker's primaries to
+  their successors (new admissions route there), then the worker sheds
+  new scans while in-flight queries complete (or fail crisply) against
+  their own deadlines, bounded by ``geomesa.fleet.drain.timeout``.
+
+* **Fleet telemetry** — worker ``telemetry()``/plan fingerprints ship
+  over the wire (the same seam ``ShardWorker.telemetry`` defined);
+  ``GET /debug/report`` gains a ``fleet`` section covering every
+  worker, and ``/healthz`` degrades while any member is not live.
+
+Known window (documented, bounded by the heartbeat): a write that fails
+against a REPLICA target is skipped with a ``fleet.replica.write.
+skipped`` counter rather than failing the batch; the partition is
+re-synced when the worker is restored, but a failover landing on that
+replica before the resync may serve the partition's pre-gap rows. The
+primary write still fails crisply.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import uuid
+from collections import OrderedDict
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.filter.parser import to_cql
+from geomesa_tpu.parallel.shards import ShardedDataStore, _concat_columns
+from geomesa_tpu.schema.featuretype import FeatureType, parse_spec
+from geomesa_tpu.store.integrity import (
+    CorruptFileError,
+    durable_write,
+    quarantine,
+    read_verified,
+)
+from geomesa_tpu.store.journal import IntentJournal
+from geomesa_tpu.stream.netlog import (
+    envelope_budget,
+    recv_frame,
+    request_envelope,
+    send_frame,
+)
+from geomesa_tpu.utils import deadline, devstats, faults, trace
+from geomesa_tpu.utils.admission import AdmissionController
+from geomesa_tpu.utils.audit import (
+    QueryTimeout,
+    ShardUnavailable,
+    ShedLoad,
+    decision,
+    robustness_metrics,
+)
+from geomesa_tpu.utils.retry import RetryPolicy
+
+# worker liveness states (the heartbeat membership machine)
+LIVE, SUSPECT, DEAD, OUT = "live", "suspect", "dead", "out"
+
+# budget for PASSIVE observation RPCs (telemetry, plan rollups): a
+# wedged worker must cost a health probe or sampler tick at most this,
+# never the full geomesa.fleet.rpc.timeout x retry ladder — the PR 10
+# passivity rule extended over the wire
+_PASSIVE_RPC_BUDGET_S = 1.0
+
+# server-reported error types the client re-raises as themselves, so the
+# coordinator's shard envelope (shed->replica, crisp timeout, failover)
+# treats a remote failure exactly like a local one
+_WIRE_ERRORS: Dict[str, type] = {
+    "QueryTimeout": QueryTimeout,
+    "ShedLoad": ShedLoad,
+    "ShardUnavailable": ShardUnavailable,
+    "KeyError": KeyError,
+    "ValueError": ValueError,
+}
+
+
+class WorkerUnavailable(ConnectionError):
+    """A fleet worker could not be reached (dead process, refused dial,
+    exhausted transport retries) — a ConnectionError, so the
+    coordinator's scatter/gather strikes the shard's breaker and fails
+    over exactly like an in-process ``ShardDied``."""
+
+
+# -- column codec -------------------------------------------------------------
+#
+# Scan results and ingest batches cross the wire as Arrow IPC streams:
+# one RecordBatch per partition column-dict, each field carrying its
+# original numpy dtype in metadata so the round trip is exact (object
+# fid arrays stay object, datetime64 stays datetime64, unicode widths
+# are restored).
+
+_DTYPE_META = b"np_dtype"
+_KIND_META = b"geomesa_kind"
+
+# stay comfortably under netlog's 64 MB recv_frame cap: a skewed
+# partition's full materialization must ship as MULTIPLE frames, not
+# one oversized frame every retry would rebuild and re-reject
+_FRAME_BUDGET = 32 * 1024 * 1024
+
+
+def iter_column_chunks(columns: Dict[str, Any], max_bytes: int = _FRAME_BUDGET):
+    """Yield row-slices of a column dict, each estimated under
+    ``max_bytes`` — the wire unit for scans and inserts. One chunk for
+    the common small case."""
+    cols = {k: np.asarray(v) for k, v in columns.items()}
+    fids = cols.get("__fid__")
+    n = len(fids) if fids is not None else max(
+        (len(v) for v in cols.values()), default=0
+    )
+    if n == 0:
+        yield columns
+        return
+    per_row = 0
+    for a in cols.values():
+        if a.dtype.kind == "O":
+            sample = a[: min(100, n)]
+            per_row += max(
+                16, int(sum(len(str(v)) for v in sample) / max(1, len(sample)))
+            )
+        else:
+            per_row += max(1, a.dtype.itemsize)
+    rows = max(1, int(max_bytes / max(1, per_row)))
+    if rows >= n:
+        yield columns
+        return
+    for lo in range(0, n, rows):
+        yield {k: v[lo : lo + rows] for k, v in cols.items()}
+
+
+def columns_to_ipc(columns: Dict[str, Any]) -> bytes:
+    """One column dict -> one Arrow IPC stream (single RecordBatch)."""
+    import pyarrow as pa
+
+    from geomesa_tpu.geom.base import Geometry
+    from geomesa_tpu.geom.wkt import to_wkt
+
+    names = sorted(columns)
+    arrays, fields = [], []
+    for k in names:
+        a = np.asarray(columns[k])
+        meta = {_DTYPE_META: str(a.dtype).encode()}
+        if a.dtype.kind == "M":  # datetime64 -> int64 view, restored on decode
+            arr = pa.array(a.view("i8"))
+        elif a.dtype.kind in "OU":
+            vals = a.tolist()
+            if any(isinstance(v, Geometry) for v in vals):
+                # geometry OBJECT columns (polygon/line schemas) ship as
+                # WKT and re-parse on the far side — a bare str(v) would
+                # strand strings where the store expects Geometry
+                meta[_KIND_META] = b"wkt"
+                arr = pa.array(
+                    [None if v is None else to_wkt(v) for v in vals],
+                    type=pa.string(),
+                )
+            else:
+                arr = pa.array(
+                    [None if v is None else str(v) for v in vals],
+                    type=pa.string(),
+                )
+        else:
+            arr = pa.array(a)
+        arrays.append(arr)
+        fields.append(pa.field(k, arr.type, metadata=meta))
+    schema = pa.schema(fields)
+    batch = pa.RecordBatch.from_arrays(arrays, schema=schema)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, schema) as writer:
+        writer.write_batch(batch)
+    return sink.getvalue().to_pybytes()
+
+
+def ipc_to_columns(buf: bytes) -> Dict[str, np.ndarray]:
+    """Inverse of ``columns_to_ipc`` — exact dtype round trip."""
+    import pyarrow as pa
+
+    from geomesa_tpu.geom.wkt import parse_wkt
+
+    with pa.ipc.open_stream(pa.BufferReader(buf)) as reader:
+        table = reader.read_all()
+    out: Dict[str, np.ndarray] = {}
+    for field in table.schema:
+        col = table.column(field.name).combine_chunks()
+        fmeta = field.metadata or {}
+        dt = np.dtype(fmeta.get(_DTYPE_META, b"O").decode())
+        if fmeta.get(_KIND_META) == b"wkt":
+            out[field.name] = np.array(
+                [None if v is None else parse_wkt(v) for v in col.to_pylist()],
+                dtype=object,
+            )
+        elif dt.kind == "M":
+            out[field.name] = col.to_numpy(zero_copy_only=False).astype(
+                np.int64
+            ).view(dt)
+        elif dt.kind == "O":
+            out[field.name] = np.array(col.to_pylist(), dtype=object)
+        elif dt.kind == "U":
+            out[field.name] = np.array(col.to_pylist(), dtype=dt)
+        else:
+            out[field.name] = col.to_numpy(zero_copy_only=False).astype(
+                dt, copy=False
+            )
+    return out
+
+
+def _query_to_wire(query: Query) -> Dict[str, Any]:
+    """The worker-query wire form: CQL + hints (sort/limit/projection/
+    aggregation were already stripped by ``_worker_query`` — they run
+    coordinator-side over the complete row set)."""
+    return {"cql": to_cql(query.filter), "hints": dict(query.hints)}
+
+
+def _query_from_wire(head: Dict[str, Any]) -> Query:
+    return Query.cql(head.get("cql", "INCLUDE"), hints=dict(head.get("hints") or {}))
+
+
+def _error_reply(e: BaseException) -> Dict[str, Any]:
+    return {"ok": 0, "etype": type(e).__name__, "error": str(e)}
+
+
+def _raise_wire_error(resp: Dict[str, Any]) -> None:
+    etype = resp.get("etype", "")
+    msg = resp.get("error", "unknown worker error")
+    cls = _WIRE_ERRORS.get(etype)
+    if cls is not None:
+        raise cls(msg)
+    raise RuntimeError(f"worker error: {etype}: {msg}")
+
+
+# -- worker process -----------------------------------------------------------
+
+
+class _WorkerState:
+    """The worker-process half of the fleet: partition-scoped
+    ``FsDataStore`` sub-stores (PR 5 journal recovery runs at every
+    open — including the reopen after a ``kill -9``) behind the
+    per-shard admission budget, served over the wire by
+    ``_FleetHandler``. The cross-process edition of
+    ``shards.ShardWorker``."""
+
+    def __init__(self, worker_id: int, root: str,
+                 auths: Optional[List[str]] = None):
+        from geomesa_tpu.utils.config import SHARD_MAX_INFLIGHT, SHARD_QUEUE_DEPTH
+        from geomesa_tpu.utils.plans import PlanRegistry
+
+        self.worker_id = int(worker_id)
+        self.root = root
+        self._auths = auths
+        os.makedirs(root, exist_ok=True)
+        self.admission = AdmissionController(
+            SHARD_MAX_INFLIGHT.to_int() or 32,
+            128 if SHARD_QUEUE_DEPTH.to_int() is None else SHARD_QUEUE_DEPTH.to_int(),
+            name=f"fleetworker{worker_id}",
+        )
+        self.plans = PlanRegistry()
+        self._stores: Dict[str, Any] = {}
+        self._schemas: Dict[str, FeatureType] = {}
+        self._lock = threading.Lock()
+        # applied insert batch ids (bounded): a retry of an insert whose
+        # ACK was lost must not re-append its rows — inserts are
+        # append-only with no fid upsert, and counts never fid-dedupe,
+        # so a double-apply would inflate counts permanently
+        self._applied: "OrderedDict[str, bool]" = OrderedDict()
+        self.draining = False
+        self.t_start = time.monotonic()
+        self.recovered: Dict[str, Any] = {}
+        # reopen every partition already on disk NOW: each FsDataStore
+        # open runs the PR 5 intent-journal recovery + scrub, so a
+        # restarted worker repairs whatever the kill left behind BEFORE
+        # it accepts a single scan
+        for d in sorted(os.listdir(root)):
+            if os.path.isdir(os.path.join(root, d)):
+                st = self._store(d)
+                self.recovered[d] = st.last_recovery["intents"]
+
+    def _store(self, partition: str, create: bool = True):
+        from geomesa_tpu.store.fs import FsDataStore
+
+        with self._lock:
+            st = self._stores.get(partition)
+            if st is not None:
+                return st
+            path = os.path.join(self.root, partition)
+            if not create and not os.path.isdir(path):
+                return None
+            st = FsDataStore(path, auths=self._auths)
+            # partition sub-stores share the worker's plan-fingerprint
+            # registry (the ShardWorker arrangement: fixed memory per
+            # worker, one rollup read for the telemetry seam)
+            st.__dict__["_plans"] = self.plans
+            for ft in self._schemas.values():
+                if ft.name not in st.type_names:
+                    st.create_schema(ft)
+            self._stores[partition] = st
+            return st
+
+    def _snapshot_stores(self) -> List[Any]:
+        with self._lock:
+            return list(self._stores.values())
+
+    # -- ops (dispatched by _FleetHandler under the envelope budget) ---------
+
+    def dispatch(
+        self, head: Dict[str, Any], payloads: List[bytes]
+    ) -> Tuple[Dict[str, Any], List[bytes]]:
+        op = head.get("op")
+        fn = getattr(self, f"op_{op}", None)
+        if fn is None:
+            return {"ok": 0, "etype": "ValueError", "error": f"unknown op {op!r}"}, []
+        return fn(head, payloads)
+
+    def op_ping(self, head, payloads):
+        return {
+            "ok": 1,
+            "pid": os.getpid(),
+            "worker": self.worker_id,
+            "draining": self.draining,
+            "partitions": len(self._stores),
+            "uptime_s": round(time.monotonic() - self.t_start, 3),
+        }, []
+
+    def op_create_schema(self, head, payloads):
+        ft = parse_spec(head["name"], head["spec"])
+        with self._lock:
+            self._schemas[ft.name] = ft
+            stores = list(self._stores.values())
+        for st in stores:
+            if ft.name not in st.type_names:
+                st.create_schema(ft)
+        return {"ok": 1}, []
+
+    def op_delete_schema(self, head, payloads):
+        name = head["name"]
+        with self._lock:
+            self._schemas.pop(name, None)
+            stores = list(self._stores.values())
+        for st in stores:
+            if name in st.type_names:
+                st.delete_schema(name)
+        return {"ok": 1}, []
+
+    def op_insert(self, head, payloads):
+        if self.draining:
+            raise ShedLoad(f"fleet worker {self.worker_id} draining")
+        batch = head.get("batch")
+        if batch is not None:
+            # check-AND-SET under the lock: the reservation lands
+            # before any row does, so a retry overlapping a
+            # still-running first apply (per-attempt socket timeout
+            # beat a slow fsync) cannot double-append — it bounces as
+            # retryable until the first apply settles
+            with self._lock:
+                state = self._applied.get(batch)
+                if state is True:
+                    # the ack was lost, not the apply: acknowledge
+                    # without re-appending (idempotent insert)
+                    return {"ok": 1, "deduped": True}, []
+                if state is False:
+                    raise ConnectionError(
+                        f"insert batch {batch} still applying"
+                    )
+                self._applied[batch] = False  # reserved, in flight
+        try:
+            name = head["name"]
+            columns = ipc_to_columns(payloads[0])
+            st = self._store(head["partition"])
+            ft = self._schemas.get(name)
+            if ft is not None and name not in st.type_names:
+                st.create_schema(ft)
+            # stats observe coordinator-side (the planner lives there)
+            st._insert_columns(
+                st.get_schema(name), columns, observe_stats=False
+            )
+        except BaseException:
+            if batch is not None:
+                with self._lock:
+                    self._applied.pop(batch, None)
+            raise
+        if batch is not None:
+            with self._lock:
+                self._applied[batch] = True
+                while len(self._applied) > 4096:
+                    self._applied.popitem(last=False)
+        return {"ok": 1}, []
+
+    def op_inventory(self, head, payloads):
+        """What this worker holds on disk: partition -> {type: spec}.
+        The coordinator-restart recovery seam — a fresh coordinator
+        over an existing root rebuilds its routing table (and schemas)
+        from the workers' journal-recovered stores."""
+        out: Dict[str, Dict[str, str]] = {}
+        with self._lock:
+            stores = dict(self._stores)
+        for p, st in sorted(stores.items()):
+            out[p] = {n: st.get_schema(n).spec() for n in st.type_names}
+        return {"ok": 1, "inventory": out}, []
+
+    def op_scan(self, head, payloads):
+        if self.draining:
+            raise ShedLoad(f"fleet worker {self.worker_id} draining")
+        query = _query_from_wire(head)
+        with self.admission.admit():
+            receipt: Dict[str, int] = {}
+            frames: List[bytes] = []
+            rows = 0
+            with devstats.collecting(receipt):
+                for p in head.get("partitions", ()):
+                    st = self._store(p, create=False)
+                    if st is None:
+                        continue
+                    res = st.query(head["name"], query)
+                    if len(res):
+                        from geomesa_tpu.store.datastore import _materialize
+
+                        # chunked under the frame cap: the coordinator's
+                        # merge concatenates frames, so a partition may
+                        # ship as several
+                        for chunk in iter_column_chunks(
+                            dict(_materialize(res.columns))
+                        ):
+                            frames.append(columns_to_ipc(chunk))
+                        rows += len(res)
+            return {"ok": 1, "rows": rows, "receipt": receipt}, frames
+
+    def op_count(self, head, payloads):
+        st = self._store(head["partition"], create=False)
+        n = 0 if st is None or head["name"] not in st.type_names else st.count(
+            head["name"]
+        )
+        return {"ok": 1, "count": int(n)}, []
+
+    def op_count_filtered(self, head, payloads):
+        if self.draining:
+            raise ShedLoad(f"fleet worker {self.worker_id} draining")
+        with self.admission.admit():
+            st = self._store(head["partition"], create=False)
+            n = (
+                0
+                if st is None or head["name"] not in st.type_names
+                else st.count(head["name"], _query_from_wire(head))
+            )
+            return {"ok": 1, "count": int(n)}, []
+
+    def op_has_visibility(self, head, payloads):
+        name = head["name"]
+        for st in self._snapshot_stores():
+            tables = st._tables.get(name)
+            if not tables:
+                continue
+            first = next(iter(tables.values()))
+            if any(b.has_col("__vis__") for b in first.blocks):
+                return {"ok": 1, "value": True}, []
+        return {"ok": 1, "value": False}, []
+
+    def op_delete(self, head, payloads):
+        for st in self._snapshot_stores():
+            if head["name"] in st.type_names:
+                st.delete_features(head["name"], list(head["fids"]))
+        return {"ok": 1}, []
+
+    def op_compact(self, head, payloads):
+        for st in self._snapshot_stores():
+            if head["name"] in st.type_names:
+                st.compact(head["name"])
+        return {"ok": 1}, []
+
+    def op_age_off(self, head, payloads):
+        removed = 0
+        for p in head.get("partitions", ()):
+            st = self._store(p, create=False)
+            if st is not None and head["name"] in st.type_names:
+                removed += st.age_off(head["name"])
+        return {"ok": 1, "removed": int(removed)}, []
+
+    def op_telemetry(self, head, payloads):
+        return {
+            "ok": 1,
+            "admission": self.admission.peek(),
+            "partitions": len(self._stores),
+            "plans": self.plans.top(5),
+            "pid": os.getpid(),
+            "draining": self.draining,
+            "uptime_s": round(time.monotonic() - self.t_start, 3),
+            "recovered": self.recovered,
+        }, []
+
+    def op_plans(self, head, payloads):
+        n = int(head.get("n", 20))
+        return {
+            "ok": 1,
+            "top": self.plans.top(min(n, 50)),
+            "rows": self.plans.rows(sort=head.get("sort", "time"), n=n),
+            "cap": self.plans.cap,
+        }, []
+
+    def op_drain(self, head, payloads):
+        """Stop admitting new scans; wait (bounded by the caller's
+        ``timeout_s``) for in-flight ones to finish against their own
+        deadlines. The client polls with small timeouts (ack-then-poll)
+        so the drain wait can never race the RPC socket budget."""
+        self.draining = True
+        t_end = time.monotonic() + float(head.get("timeout_s", 0.0))
+        while True:
+            inflight = int(self.admission.peek().get("inflight", 0))
+            if inflight == 0:
+                return {"ok": 1, "drained": True, "inflight": 0}, []
+            if time.monotonic() >= t_end:
+                return {"ok": 1, "drained": False, "inflight": inflight}, []
+            time.sleep(0.02)
+
+
+class _FleetHandler(socketserver.BaseRequestHandler):
+    """One persistent worker connection: JSON header frame (+ ``frames``
+    payload frames) in, JSON reply (+ payload frames) out. The envelope
+    budget is re-anchored and attached around every op, and server-side
+    spans key on the envelope's trace id (the netlog discipline) so the
+    worker's work joins the calling query's tree."""
+
+    def handle(self) -> None:
+        state: _WorkerState = self.server.owner  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                try:
+                    head = json.loads(recv_frame(sock).decode())
+                    payloads = [
+                        recv_frame(sock) for _ in range(int(head.get("frames", 0)))
+                    ]
+                except (ConnectionError, ValueError, OSError):
+                    return
+                try:
+                    with trace.span(
+                        f"fleet.server.{head.get('op', 'unknown')}",
+                        trace_id=head.get("trace"),
+                        worker=state.worker_id,
+                    ):
+                        with deadline.budget(envelope_budget(head)):
+                            reply, frames = state.dispatch(head, payloads)
+                except ConnectionError:
+                    return
+                except Exception as e:  # noqa: BLE001 - report to client
+                    reply, frames = _error_reply(e), []
+                reply["frames"] = len(frames)
+                try:
+                    send_frame(sock, json.dumps(reply).encode())
+                    for b in frames:
+                        send_frame(sock, b)
+                except OSError:
+                    return
+        finally:
+            sock.close()
+
+
+def worker_main(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point of a spawned fleet worker process."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(description="geomesa-tpu fleet shard worker")
+    ap.add_argument("--id", type=int, required=True)
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--portfile", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--auths", default=None)
+    args = ap.parse_args(argv)
+
+    auths = args.auths.split(",") if args.auths else None
+    state = _WorkerState(args.id, args.root, auths=auths)
+
+    class _Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = _Server((args.host, 0), _FleetHandler)
+    srv.owner = state  # type: ignore[attr-defined]
+    port = srv.server_address[1]
+
+    def _term(_sig, _frm):
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    # publish the bound port atomically: the supervisor polls for this
+    # file, so a half-written port must never be readable
+    tmp = args.portfile + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(f"{args.host}:{port}\n")
+    os.replace(tmp, args.portfile)
+    try:
+        srv.serve_forever()
+    finally:
+        srv.server_close()
+    return 0
+
+
+# -- coordinator-side client --------------------------------------------------
+
+
+class _PlansProxy:
+    """The ``ShardWorker.plans`` seam over the wire: ``top``/``rows``/
+    ``cap`` served by the worker's shared PlanRegistry. Unreachable
+    workers contribute empty tables (the rollup must not 500 while a
+    restart is in flight)."""
+
+    def __init__(self, client: "WorkerClient"):
+        self._client = client
+
+    def top(self, n: int = 5) -> List[Dict[str, Any]]:
+        try:
+            with deadline.budget(_PASSIVE_RPC_BUDGET_S):
+                resp, _ = self._client._rpc("plans", {"n": int(n)})
+        except (OSError, QueryTimeout):
+            return []
+        return resp.get("top", [])
+
+    def rows(self, sort: str = "time", n: int = 20) -> List[Dict[str, Any]]:
+        try:
+            with deadline.budget(_PASSIVE_RPC_BUDGET_S):
+                resp, _ = self._client._rpc(
+                    "plans", {"n": int(n), "sort": sort}
+                )
+        except (OSError, QueryTimeout):
+            return []
+        return resp.get("rows", [])
+
+    @property
+    def cap(self) -> int:
+        from geomesa_tpu.utils.config import PLANS_MAX
+
+        return PLANS_MAX.to_int() or 256
+
+
+class WorkerClient:
+    """The ``ShardWorker`` contract over the fleet wire protocol — the
+    coordinator's ``_shard_call`` seam talks to this exactly as it
+    talked to the in-process worker. A small connection pool keeps
+    concurrent scans (and the supervisor's heartbeat) from serializing
+    on one socket; every pooled socket dies with its first transport
+    error, and addresses re-resolve per dial so a restarted worker's
+    new port is picked up transparently."""
+
+    _POOL_MAX = 8
+
+    def __init__(
+        self,
+        shard_id: int,
+        address_fn: Callable[[], Optional[Tuple[str, int]]],
+        timeout_s: Optional[float] = None,
+        retry: Optional[RetryPolicy] = None,
+    ):
+        from geomesa_tpu.utils.config import FLEET_RPC_TIMEOUT
+
+        self.shard_id = int(shard_id)
+        self._address_fn = address_fn
+        self._timeout_s = (
+            FLEET_RPC_TIMEOUT.to_duration_s(10.0) if timeout_s is None else timeout_s
+        )
+        self._retry = retry if retry is not None else RetryPolicy(
+            name="fleet.rpc", max_attempts=3, base_s=0.02, cap_s=0.25
+        )
+        self._pool: List[socket.socket] = []
+        self._plock = threading.Lock()
+        self.plans = _PlansProxy(self)
+
+    # -- transport -----------------------------------------------------------
+
+    def _dial(self) -> socket.socket:
+        addr = self._address_fn()
+        if addr is None:
+            raise WorkerUnavailable(
+                f"fleet worker {self.shard_id} has no address (not spawned "
+                "or marked out)"
+            )
+        s = socket.create_connection(
+            addr, timeout=deadline.io_timeout(self._timeout_s, "fleet.dial")
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _checkout(self) -> socket.socket:
+        with self._plock:
+            if self._pool:
+                return self._pool.pop()
+        return self._dial()
+
+    def _checkin(self, sock: socket.socket) -> None:
+        with self._plock:
+            if len(self._pool) < self._POOL_MAX:
+                self._pool.append(sock)
+                return
+        sock.close()
+
+    def close(self) -> None:
+        with self._plock:
+            pool, self._pool = self._pool, []
+        for s in pool:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _attempt(self, op: str, fields: Dict[str, Any], payloads: List[bytes]):
+        """One full request/response exchange. The deadline is consulted
+        BEFORE the dial (an already-dead budget must not pay a connect),
+        and the socket timeout is re-derived PER ATTEMPT from
+        ``min(geomesa.fleet.rpc.timeout, remaining budget)`` — a stalled
+        worker costs at most the deadline, never the knob constant."""
+        with trace.span("fleet.rpc", op=op, shard=self.shard_id):
+            deadline.check("fleet.rpc")
+            try:
+                faults.fault_point("fleet.rpc")
+            except faults.SimulatedCrash as e:
+                # a crash at fleet.rpc models the WORKER process dying
+                # mid-exchange (utils/faults.py): the coordinator
+                # observes a dead peer — a ConnectionError every caller
+                # (scan failover, count chain, replica-write skip)
+                # already handles — exactly as a real kill surfaces
+                raise WorkerUnavailable(
+                    f"fleet worker {self.shard_id} died mid-exchange: {e}"
+                ) from e
+            sock = self._checkout()
+            try:
+                sock.settimeout(deadline.io_timeout(self._timeout_s, "fleet.rpc"))
+                head = request_envelope(op, frames=len(payloads), **fields)
+                send_frame(sock, json.dumps(head).encode())
+                for b in payloads:
+                    send_frame(sock, b)
+                resp = json.loads(recv_frame(sock).decode())
+                frames = [
+                    recv_frame(sock) for _ in range(int(resp.get("frames", 0)))
+                ]
+            except OSError:
+                sock.close()
+                # a recv that timed out BECAUSE the budget bounded the
+                # socket surfaces as a crisp QueryTimeout (the caller's
+                # slice expired — PR 6's lagging-shard verdict), not as
+                # a transport error the retry ladder would re-dial
+                deadline.check("fleet.rpc")
+                raise
+            except BaseException:
+                # a non-transport unwind (QueryTimeout mid-exchange, a
+                # SimulatedCrash) leaves the connection's framing state
+                # unknown — never return it to the pool
+                sock.close()
+                raise
+            if resp.get("ok") != 1:
+                self._checkin(sock)
+                _raise_wire_error(resp)
+            self._checkin(sock)
+            return resp, frames
+
+    def _rpc(self, op: str, fields: Optional[Dict[str, Any]] = None,
+             payloads: Optional[List[bytes]] = None):
+        """Every fleet op is retry-safe: reads are idempotent, schema
+        ops converge, and ``insert`` carries a stable batch id the
+        worker dedupes on (a lost ACK must not re-append rows — counts
+        never fid-dedupe) — so transient transport blips retry
+        uniformly through the RetryPolicy (which clamps its ladder to
+        the ambient deadline)."""
+        return self._retry.call(self._attempt, op, fields or {}, payloads or [])
+
+    # -- ShardWorker surface -------------------------------------------------
+
+    def create_schema(self, ft: FeatureType) -> None:
+        self._rpc("create_schema", {"name": ft.name, "spec": ft.spec()})
+
+    def delete_schema(self, name: str) -> None:
+        self._rpc("delete_schema", {"name": name})
+
+    def insert(self, partition: str, ft: FeatureType, columns) -> None:
+        # batch ids are generated ONCE per chunk, so every retry of a
+        # lost-ACK exchange re-sends the SAME id and the worker
+        # acknowledges without re-appending; oversized batches (a
+        # resync shipping a whole partition) split under the frame cap
+        for chunk in iter_column_chunks(columns):
+            self._rpc(
+                "insert",
+                {"partition": partition, "name": ft.name,
+                 "batch": uuid.uuid4().hex},
+                [columns_to_ipc(chunk)],
+            )
+
+    def scan(self, name: str, query: Query, partitions: Sequence[str]) -> Dict[str, Any]:
+        resp, frames = self._rpc(
+            "scan",
+            {"name": name, "partitions": list(partitions), **_query_to_wire(query)},
+        )
+        return {
+            "columns": [ipc_to_columns(b) for b in frames],
+            "rows": int(resp.get("rows", 0)),
+            "receipt": resp.get("receipt", {}),
+        }
+
+    def count(self, name: str, partition: str) -> int:
+        resp, _ = self._rpc("count", {"name": name, "partition": partition})
+        return int(resp["count"])
+
+    def count_filtered(self, name: str, query: Query, partition: str) -> int:
+        resp, _ = self._rpc(
+            "count_filtered",
+            {"name": name, "partition": partition, **_query_to_wire(query)},
+        )
+        return int(resp["count"])
+
+    def has_visibility(self, name: str) -> bool:
+        """Conservative under partition: an unreachable worker answers
+        True — "might hold visibility rows" — which only DISABLES the
+        stats-estimate and pyramid shortcuts, forcing the failover-
+        protected full query path. Never a wrong count, only a slower
+        one while a restart is in flight."""
+        try:
+            resp, _ = self._rpc("has_visibility", {"name": name})
+        except (OSError, QueryTimeout):
+            return True
+        return bool(resp.get("value"))
+
+    def delete(self, name: str, fids) -> None:
+        self._rpc("delete", {"name": name, "fids": [str(f) for f in fids]})
+
+    def compact(self, name: str) -> None:
+        self._rpc("compact", {"name": name})
+
+    def age_off(self, name: str, partitions: Sequence[str]) -> int:
+        resp, _ = self._rpc(
+            "age_off", {"name": name, "partitions": list(partitions)}
+        )
+        return int(resp.get("removed", 0))
+
+    def telemetry(self) -> Dict[str, Any]:
+        """The flight-recorder seam: unreachable workers report
+        themselves rather than breaking the sampler tick or the
+        /debug/report fleet section, and the read runs under its own
+        small budget — a WEDGED (not dead) worker must not stall every
+        /healthz probe and 1 s sampler tick for the full RPC timeout."""
+        try:
+            with deadline.budget(_PASSIVE_RPC_BUDGET_S):
+                resp, _ = self._rpc("telemetry")
+        except (OSError, QueryTimeout) as e:
+            return {"unreachable": True, "error": f"{type(e).__name__}: {e}"}
+        resp.pop("ok", None)
+        resp.pop("frames", None)
+        return resp
+
+    def inventory(self) -> Dict[str, Dict[str, str]]:
+        resp, _ = self._rpc("inventory")
+        return resp.get("inventory", {})
+
+    def ping(self) -> Dict[str, Any]:
+        resp, _ = self._attempt("ping", {}, [])  # no retry: one beat, one probe
+        return resp
+
+    def drain(self, timeout_s: float) -> Dict[str, Any]:
+        """Ack-then-poll: the first call flips the worker's draining
+        flag and answers immediately; subsequent short polls watch the
+        in-flight count fall to zero — the wait is bounded by
+        ``timeout_s`` without ever holding one RPC open past the socket
+        budget."""
+        t_end = time.monotonic() + float(timeout_s)
+        resp, _ = self._rpc("drain", {"timeout_s": 0.0})
+        while not resp.get("drained") and time.monotonic() < t_end:
+            time.sleep(0.05)
+            resp, _ = self._rpc("drain", {"timeout_s": 0.0})
+        return {k: resp.get(k) for k in ("drained", "inflight")}
+
+
+# -- supervisor ---------------------------------------------------------------
+
+
+def _repo_pythonpath() -> str:
+    import geomesa_tpu
+
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(geomesa_tpu.__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    return pkg_parent + (os.pathsep + existing if existing else "")
+
+
+class FleetSupervisor:
+    """Spawns, watches, restarts, and drains the worker processes.
+
+    Heartbeat membership: every ``geomesa.fleet.heartbeat.interval`` the
+    supervisor pings each worker through the ``fleet.heartbeat`` fault
+    point. Consecutive misses walk the state machine LIVE -> SUSPECT
+    (``heartbeat.suspect`` misses — observed, nothing moves: the
+    hysteresis that keeps one slow GC from triggering a rebalance) ->
+    DEAD (``heartbeat.dead`` misses, or the process reaped): the
+    worker's primary partitions move to live replicas (journaled) and
+    the process restarts under bounded exponential backoff
+    (``RetryPolicy``). A worker dying more than ``flap.restarts`` times
+    within ``flap.window`` is marked OUT via its ``shard.<n>`` breaker
+    and left down for the operator."""
+
+    def __init__(self, store: "FleetDataStore", num_workers: int,
+                 supervise: bool = True):
+        from geomesa_tpu.utils.config import (
+            FLEET_DRAIN_TIMEOUT,
+            FLEET_FLAP_RESTARTS,
+            FLEET_FLAP_WINDOW,
+            FLEET_HEARTBEAT_DEAD,
+            FLEET_HEARTBEAT_INTERVAL,
+            FLEET_HEARTBEAT_SUSPECT,
+            FLEET_RESTART_BASE,
+            FLEET_RESTART_CAP,
+            FLEET_RESTART_MAX,
+            FLEET_SPAWN_TIMEOUT,
+        )
+
+        self.store = store
+        self.num_workers = int(num_workers)
+        self.base_dir = os.path.join(store.root, "workers")
+        os.makedirs(self.base_dir, exist_ok=True)
+        self._supervise = bool(supervise)
+        self._interval_s = FLEET_HEARTBEAT_INTERVAL.to_duration_s(1.0)
+        self._suspect_after = FLEET_HEARTBEAT_SUSPECT.to_int() or 2
+        self._dead_after = FLEET_HEARTBEAT_DEAD.to_int() or 4
+        self._restart_base_s = FLEET_RESTART_BASE.to_duration_s(0.2)
+        self._restart_cap_s = FLEET_RESTART_CAP.to_duration_s(5.0)
+        self._restart_max = FLEET_RESTART_MAX.to_int() or 6
+        self._flap_restarts = FLEET_FLAP_RESTARTS.to_int() or 3
+        self._flap_window_s = FLEET_FLAP_WINDOW.to_duration_s(60.0)
+        self._spawn_timeout_s = FLEET_SPAWN_TIMEOUT.to_duration_s(30.0)
+        self.drain_timeout_s = FLEET_DRAIN_TIMEOUT.to_duration_s(10.0)
+        self._procs: List[Optional[subprocess.Popen]] = [None] * self.num_workers
+        self._addrs: List[Optional[Tuple[str, int]]] = [None] * self.num_workers
+        self._state: List[str] = [DEAD] * self.num_workers
+        self._misses: List[int] = [0] * self.num_workers
+        self._deaths: List[List[float]] = [[] for _ in range(self.num_workers)]
+        self.restarts: List[int] = [0] * self.num_workers
+        self._lock = threading.RLock()
+        # serializes REPAIRS (rebalance + respawn + restore) without
+        # blocking the beat loop: detection keeps running while one
+        # worker's repair is in flight, so a second simultaneous death
+        # is declared promptly instead of reading stale-LIVE
+        self._repair_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- process lifecycle ---------------------------------------------------
+
+    def worker_root(self, i: int) -> str:
+        return os.path.join(self.base_dir, f"w{i}")
+
+    def worker_address(self, i: int) -> Optional[Tuple[str, int]]:
+        with self._lock:
+            return self._addrs[i]
+
+    def worker_pid(self, i: int) -> Optional[int]:
+        with self._lock:
+            proc = self._procs[i]
+        return None if proc is None else proc.pid
+
+    def spawn(self, i: int) -> None:
+        """Spawn worker ``i`` and wait for it to publish its port. The
+        worker process re-opens its partition roots (journal recovery)
+        before it binds, so a published port means a recovered store."""
+        portfile = os.path.join(self.base_dir, f"w{i}.port")
+        try:
+            os.remove(portfile)
+        except FileNotFoundError:
+            pass
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _repo_pythonpath()
+        # workers are host-scan processes: they must not race the
+        # coordinator for an accelerator unless explicitly told to
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # a cpu-pinned worker must not claim a remote accelerator
+            # session at interpreter startup either (the
+            # force_cpu_platform recipe, parallel/mesh.py — the claim
+            # can block for minutes and serializes spawns)
+            env["PALLAS_AXON_POOL_IPS"] = ""
+        env["GEOMESA_FLEET_WORKER_ID"] = str(i)
+        cmd = [
+            sys.executable,
+            "-m",
+            "geomesa_tpu.parallel.fleet",
+            "--worker",
+            "--id",
+            str(i),
+            "--root",
+            self.worker_root(i),
+            "--portfile",
+            portfile,
+        ]
+        # list-shaped auths travel to the worker stores (visibility rows
+        # must filter identically on both sides of the wire); provider
+        # OBJECTS cannot cross a process boundary — workers then run
+        # auth-less and visibility-bearing scans under-serve (documented)
+        auths = getattr(self.store, "auths", None)
+        if isinstance(auths, str):
+            auths = [auths]
+        if isinstance(auths, (list, tuple)) and all(
+            isinstance(a, str) for a in auths
+        ) and auths:
+            cmd += ["--auths", ",".join(auths)]
+        log = open(os.path.join(self.base_dir, f"w{i}.log"), "ab")
+        try:
+            proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+        finally:
+            log.close()
+        t_end = time.monotonic() + self._spawn_timeout_s
+        addr: Optional[Tuple[str, int]] = None
+        while time.monotonic() < t_end:
+            if self._stop.is_set():
+                # stop() is waiting on this repair: abort the spawn
+                # promptly instead of making close()/atexit wait out
+                # the port-publish timeout
+                proc.kill()
+                raise RuntimeError("supervisor stopping")
+            if proc.poll() is not None:
+                raise WorkerUnavailable(
+                    f"fleet worker {i} exited rc={proc.returncode} during spawn"
+                )
+            try:
+                text = open(portfile).read().strip()
+            except FileNotFoundError:
+                time.sleep(0.02)
+                continue
+            if text:
+                host, _, port = text.partition(":")
+                addr = (host, int(port))
+                break
+            time.sleep(0.02)
+        if addr is None:
+            proc.kill()
+            raise TimeoutError(f"fleet worker {i} never published its port")
+        with self._lock:
+            self._procs[i] = proc
+            self._addrs[i] = addr
+            self._state[i] = LIVE
+            self._misses[i] = 0
+
+    def start(self) -> None:
+        import atexit
+
+        try:
+            for i in range(self.num_workers):
+                self.spawn(i)
+        except BaseException:
+            # a mid-loop spawn failure must not strand the workers that
+            # DID spawn (the atexit hook below is not registered yet)
+            self.stop()
+            raise
+        atexit.register(self.stop)
+        if self._supervise:
+            self._thread = threading.Thread(
+                target=self._beat_loop, daemon=True,
+                name="geomesa-fleet-heartbeat",
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        import atexit
+
+        # a stopped supervisor must not stay pinned (with its whole
+        # store graph) in the atexit table for the process lifetime
+        atexit.unregister(self.stop)
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=2 * self._interval_s + 1.0)
+        # drain any in-flight repair BEFORE tearing processes down: a
+        # repair past its stop-check could otherwise respawn a worker
+        # after this teardown and leak a live orphan process (repairs
+        # queued behind the lock see _stop set and return)
+        with self._repair_lock:
+            pass
+        with self._lock:
+            procs = list(self._procs)
+            self._procs = [None] * self.num_workers
+            self._addrs = [None] * self.num_workers
+        for proc in procs:
+            if proc is None or proc.poll() is not None:
+                continue
+            proc.terminate()
+            try:
+                proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=2.0)
+
+    def kill_worker(self, i: int) -> None:
+        """Hard-kill (SIGKILL) worker ``i`` — the chaos harness's lever;
+        the heartbeat machine is what must notice and repair."""
+        with self._lock:
+            proc = self._procs[i]
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=5.0)
+
+    # -- membership ----------------------------------------------------------
+
+    def states(self) -> List[str]:
+        with self._lock:
+            return list(self._state)
+
+    def all_live(self) -> bool:
+        return all(s == LIVE for s in self.states())
+
+    # every N beats, retry outstanding replica-gap repairs for live
+    # workers (transient restore failures must heal without another
+    # death/restore event)
+    _DIRTY_SWEEP_BEATS = 20
+
+    def _beat_loop(self) -> None:
+        beats = 0
+        while not self._stop.wait(self._interval_s):
+            beats += 1
+            if beats % self._DIRTY_SWEEP_BEATS == 0 and self.store._dirty:
+                with self._repair_lock:
+                    if self._stop.is_set():
+                        return
+                    try:
+                        self.store.repair_dirty()
+                    except Exception:  # noqa: BLE001 - sweep is best-effort
+                        robustness_metrics().inc("fleet.heartbeat.error")
+            for i in range(self.num_workers):
+                if self._stop.is_set():
+                    return
+                try:
+                    # the beat itself is budget-bounded; the REPAIR
+                    # (rebalance + restart + resync) runs on its OWN
+                    # thread, serialized by the repair lock — one
+                    # worker's multi-second repair must neither be
+                    # cancelled by the probe's one-interval allowance
+                    # nor block the detection of a second death
+                    if self._beat_once(i):
+                        threading.Thread(
+                            target=self._handle_dead, args=(i,),
+                            daemon=True,
+                            name=f"geomesa-fleet-repair-{i}",
+                        ).start()
+                except faults.SimulatedCrash:
+                    # this thread IS the top level for the heartbeat: a
+                    # crash rule at fleet.heartbeat models one probe
+                    # dying, and the supervisor loop must outlive it —
+                    # a silently-dead heartbeat would leave real deaths
+                    # undetected forever while /healthz reads healthy
+                    robustness_metrics().inc("fleet.heartbeat.crashed")
+                except Exception:  # noqa: BLE001 - the loop must survive
+                    robustness_metrics().inc("fleet.heartbeat.error")
+
+    def _beat_once(self, i: int) -> bool:
+        """One heartbeat probe; True when this beat just declared the
+        worker DEAD (the caller repairs, outside the beat budget)."""
+        with self._lock:
+            if self._state[i] == OUT:
+                return False
+            proc = self._procs[i]
+        reaped = proc is not None and proc.poll() is not None
+        # each beat runs under its own budget (one interval): the probe's
+        # socket timeout derives from it, so a wedged worker costs at
+        # most one interval per beat, never the RPC knob constant
+        with trace.span("fleet.heartbeat", worker=i):
+            with deadline.budget(self._interval_s):
+                try:
+                    deadline.check("fleet.heartbeat")
+                    faults.fault_point("fleet.heartbeat")
+                    if reaped:
+                        raise WorkerUnavailable(
+                            f"fleet worker {i} process exited "
+                            f"rc={proc.returncode}"
+                        )
+                    self.store.workers[i].ping()
+                except (OSError, QueryTimeout):
+                    return self._miss(i, reaped)
+                else:
+                    self._alive(i)
+                    return False
+
+    def _alive(self, i: int) -> None:
+        with self._lock:
+            was = self._state[i]
+            self._misses[i] = 0
+            self._state[i] = LIVE
+        if was == SUSPECT:
+            robustness_metrics().inc("fleet.worker.recovered")
+            trace.event("fleet.worker.recovered", worker=i)
+
+    def _miss(self, i: int, reaped: bool) -> bool:
+        """Record a missed beat; True when the worker just transitioned
+        to DEAD (repair is the caller's job, outside the beat budget)."""
+        m = robustness_metrics()
+        with self._lock:
+            self._misses[i] += 1
+            misses = self._misses[i]
+            state = self._state[i]
+        m.inc("fleet.heartbeat.missed")
+        # a reaped process is unambiguous death — no hysteresis needed;
+        # a missed beat walks LIVE -> SUSPECT -> DEAD so one slow pause
+        # (GC, a long fsync) is observed repeatedly before anything moves
+        if not reaped and misses < self._suspect_after:
+            return False
+        if not reaped and misses < self._dead_after:
+            if state != SUSPECT:
+                with self._lock:
+                    self._state[i] = SUSPECT
+                m.inc("fleet.worker.suspect")
+                trace.event("fleet.worker.suspect", worker=i, misses=misses)
+            return False
+        if state == DEAD:
+            return False
+        with self._lock:
+            self._state[i] = DEAD
+        m.inc("fleet.worker.dead")
+        decision("fleet", "worker_dead", worker=i, reaped=reaped)
+        return True
+
+    def _handle_dead(self, i: int) -> None:
+        """Repair: move the dead worker's primaries to live replicas
+        (journaled — a coordinator crash mid-move recovers to pre- or
+        post-move placement), then restart the process under bounded
+        backoff, then restore its placement. Repairs serialize on the
+        repair lock (placement moves must not interleave) but run off
+        the beat thread."""
+        with self._repair_lock:
+            if self._stop.is_set():
+                return
+            try:
+                self._repair_one(i)
+            except RuntimeError:
+                # the stop()-induced abort (see _respawn_once) is a
+                # clean exit for this thread, not an error
+                if not self._stop.is_set():
+                    raise
+
+    def _repair_one(self, i: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._deaths[i] = [
+                t for t in self._deaths[i] if now - t <= self._flap_window_s
+            ]
+            self._deaths[i].append(now)
+            flapping = len(self._deaths[i]) > self._flap_restarts
+        try:
+            self.store._rebalance_away(i)
+        except Exception:  # noqa: BLE001 - repair must reach the restart
+            robustness_metrics().inc("fleet.rebalance.failed")
+        if flapping:
+            self._mark_out(i)
+            return
+        try:
+            RetryPolicy(
+                name="fleet.restart",
+                max_attempts=self._restart_max,
+                base_s=self._restart_base_s,
+                cap_s=self._restart_cap_s,
+                retryable=(OSError, TimeoutError),
+            ).call(self._respawn_once, i)
+        except (OSError, TimeoutError):
+            decision("fleet", "restart_exhausted", worker=i)
+            self._mark_out(i)
+            return
+        with self._lock:
+            self.restarts[i] += 1
+        robustness_metrics().inc("fleet.worker.restarted")
+        decision("fleet", "worker_restarted", worker=i)
+        try:
+            self.store._restore_worker(i)
+        except Exception:  # noqa: BLE001 - placement restores on next death/join
+            robustness_metrics().inc("fleet.restore.failed")
+
+    def _respawn_once(self, i: int) -> None:
+        if self._stop.is_set():
+            # RuntimeError is NOT in the restart ladder's retryable set:
+            # the ladder aborts at the next attempt boundary instead of
+            # holding the repair lock (and stop()) for minutes
+            raise RuntimeError("supervisor stopping")
+        with self._lock:
+            proc = self._procs[i]
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=5.0)
+        self.store.workers[i].close()  # pooled sockets point at the corpse
+        self.spawn(i)
+
+    def _mark_out(self, i: int) -> None:
+        """Flapping (or unrestartable): stop restarting and trip the
+        shard's EXISTING breaker so the coordinator routes around it
+        with zero dispatch cost until an operator intervenes (the
+        breaker's own half-open probe keeps testing the route)."""
+        from geomesa_tpu.utils.config import BREAKER_FAILURES
+
+        with self._lock:
+            self._state[i] = OUT
+        br = self.store._breakers[i]
+        for _ in range(BREAKER_FAILURES.to_int() or 5):
+            br.record_failure()
+        robustness_metrics().inc("fleet.worker.out")
+        decision("fleet", "flap_out", worker=i)
+
+    def revive(self, i: int) -> None:
+        """Operator lever: clear an OUT verdict and restart the worker.
+        Takes the repair lock — a revive must not interleave with an
+        in-flight death repair."""
+        with self._lock:
+            self._deaths[i] = []
+            self._misses[i] = 0
+        with self._repair_lock:
+            self._respawn_once(i)
+            self.store._restore_worker(i)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                str(i): {
+                    "state": self._state[i],
+                    "pid": None if self._procs[i] is None else self._procs[i].pid,
+                    "address": self._addrs[i],
+                    "misses": self._misses[i],
+                    "restarts": self.restarts[i],
+                }
+                for i in range(self.num_workers)
+            }
+
+
+# -- coordinator --------------------------------------------------------------
+
+
+class FleetDataStore(ShardedDataStore):
+    """The multi-host coordinator: a ``ShardedDataStore`` whose workers
+    are spawned processes behind the fleet wire protocol, with a
+    supervised lifecycle and journaled placement rebalancing. See the
+    module docstring for the full contract.
+
+    ``transport="inproc"`` keeps the PR 6 in-process ``ShardWorker``
+    pool under the SAME journaled placement/rebalance machinery — the
+    crash-schedule soaks (``fleet.rebalance`` x crash position) run
+    there without paying process spawns."""
+
+    def __init__(
+        self,
+        root: str,
+        num_workers: Optional[int] = None,
+        replicas: Optional[int] = None,
+        partition_bits: Optional[int] = None,
+        transport: str = "process",
+        supervise: bool = True,
+        **kwargs,
+    ):
+        from geomesa_tpu.utils.config import FLEET_WORKERS
+
+        if transport not in ("process", "inproc"):
+            raise ValueError(f"unknown fleet transport {transport!r}")
+        if num_workers is None:
+            num_workers = FLEET_WORKERS.to_int()
+        super().__init__(
+            num_shards=num_workers,
+            replicas=replicas,
+            partition_bits=partition_bits,
+            **kwargs,
+        )
+        self.root = os.path.abspath(root)
+        fleet_dir = os.path.join(self.root, "_fleet")
+        os.makedirs(fleet_dir, exist_ok=True)
+        self._placement_path = os.path.join(fleet_dir, "placement.json")
+        self._fleet_journal = IntentJournal(fleet_dir)
+        # (partition, shard) pairs whose REPLICA copy missed a write
+        # while the shard was down/draining — repaired by _resync_into
+        # when the worker is restored. PERSISTED beside the placement
+        # table: a coordinator restart must not forget a repair
+        # obligation, or a later failover onto the gapped replica would
+        # silently under-serve the partition
+        self._dirty_path = os.path.join(fleet_dir, "dirty.json")
+        self._dirty_lock = threading.Lock()
+        self._dirty: set = set()
+        self._load_dirty()
+        # serializes PLACEMENT MOVES across every mover (death repair,
+        # drain, restore, manual move_partition): the journaled
+        # intent + table replace must never interleave
+        self._move_lock = threading.RLock()
+        # in-flight routed-write gate: a mover sets pending_moves, then
+        # WAITS for writes that computed their targets BEFORE the set
+        # to finish applying — closing the window where such a write
+        # lands on the old chain after the move's copy scan already
+        # ran (it would vanish from results at the flip). Writes
+        # starting after the set dual-target both chains.
+        self._write_gate = threading.Condition()
+        self._writes_inflight = 0
+        # recover the placement journal BEFORE the first placement read:
+        # a coordinator that crashed mid-move reopens to exactly the
+        # pre- or post-move table (the store-open discipline, PR 5)
+        self.recover_fleet()
+        self.transport = transport
+        self.supervisor: Optional[FleetSupervisor] = None
+        if transport == "process":
+            self.supervisor = FleetSupervisor(
+                self, len(self.workers), supervise=supervise
+            )
+            self.workers = [
+                WorkerClient(i, functools.partial(self.supervisor.worker_address, i))
+                for i in range(len(self._breakers))
+            ]
+            self.supervisor.start()
+            self._recover_routing()
+            # repair obligations recovered from disk: close replica
+            # gaps NOW rather than waiting for the gapped worker's next
+            # death/restore cycle
+            for p, s in sorted(set(self._dirty)):
+                if self._live(s):
+                    self._clear_dirty(p, s)
+                    try:
+                        self._resync_into(p, s)
+                    except Exception:  # noqa: BLE001 - keep the obligation
+                        self._mark_dirty(p, s)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        if self.transport == "process":
+            for w in self.workers:
+                w.close()
+        super().close()
+
+    # -- placement persistence + recovery ------------------------------------
+
+    def recover_fleet(self) -> Dict[str, int]:
+        """Coordinator-crash recovery for the placement state machine:
+        roll the fleet intent journal forward/back, reload the placement
+        table, and clear any in-memory move state. Idempotent."""
+        summary = self._fleet_journal.recover()
+        self._load_placement()
+        self.placement.pending_moves.clear()
+        return summary
+
+    def _load_dirty(self) -> None:
+        try:
+            rec = json.loads(read_verified(self._dirty_path).decode())
+            self._dirty = {(str(p), int(s)) for p, s in rec.get("dirty", ())}
+        except FileNotFoundError:
+            self._dirty = set()
+        except (CorruptFileError, ValueError, UnicodeDecodeError):
+            quarantine(self._dirty_path)
+            self._dirty = set()
+
+    def _mark_dirty(self, partition: str, sid: int) -> None:
+        with self._dirty_lock:
+            self._dirty.add((partition, sid))
+            self._save_dirty_locked()
+
+    def _clear_dirty(self, partition: str, sid: int) -> None:
+        with self._dirty_lock:
+            self._dirty.discard((partition, sid))
+            self._save_dirty_locked()
+
+    def _save_dirty_locked(self) -> None:
+        durable_write(
+            self._dirty_path,
+            json.dumps(
+                {"dirty": sorted([p, s] for p, s in self._dirty)}
+            ).encode(),
+            crc=True,
+        )
+
+    def _recover_routing(self) -> None:
+        """Coordinator-restart recovery for the ROUTING table: a fresh
+        coordinator over an existing root rebuilds its schemas and the
+        per-type partition sets from the workers' journal-recovered
+        on-disk inventories — without this, the durably-recovered
+        placement table would route for partitions the new coordinator
+        does not know exist, and every query would silently answer
+        empty while the rows sit intact under the worker roots."""
+        recovered_types = 0
+        recovered_parts = 0
+        for w in self.workers:
+            try:
+                inv = w.inventory()
+            except (OSError, QueryTimeout):
+                continue  # a down worker's partitions resurface via its
+                # replicas' inventories (and its own at restore)
+            for partition, types in inv.items():
+                for name, spec in types.items():
+                    if name not in self._schemas:
+                        self.create_schema(parse_spec(name, spec))
+                        recovered_types += 1
+                    known = self._partitions.setdefault(name, set())
+                    if partition not in known:
+                        known.add(partition)
+                        recovered_parts += 1
+        if recovered_parts or recovered_types:
+            robustness_metrics().inc("fleet.routing.recovered")
+            trace.event(
+                "fleet.routing.recovered",
+                types=recovered_types, partitions=recovered_parts,
+            )
+
+    def _load_placement(self) -> None:
+        try:
+            rec = json.loads(read_verified(self._placement_path).decode())
+            loaded = {
+                str(k): int(v) for k, v in (rec.get("overrides") or {}).items()
+            }
+            # a fleet reopened with FEWER workers may hold overrides
+            # pointing past the new shard count: dropping them falls
+            # back to the (modulo-correct) stable hash placement
+            # instead of modulo-wrapping chains onto shards that never
+            # held the rows (and IndexErroring fleet_health)
+            n = self.placement.num_shards
+            dropped = {p: s for p, s in loaded.items() if not 0 <= s < n}
+            if dropped:
+                robustness_metrics().inc("fleet.placement.dropped")
+                trace.event("fleet.placement.dropped", overrides=dropped)
+            self.placement.overrides = {
+                p: s for p, s in loaded.items() if 0 <= s < n
+            }
+        except FileNotFoundError:
+            self.placement.overrides = {}
+        except (CorruptFileError, ValueError, UnicodeDecodeError):
+            # a torn placement table quarantines like any corrupt file;
+            # the stable hash placement is always a valid fallback
+            quarantine(self._placement_path)
+            robustness_metrics().inc("fleet.placement.corrupt")
+            self.placement.overrides = {}
+
+    def _write_placement(self, overrides: Dict[str, int]) -> None:
+        data = json.dumps(
+            {"version": 1, "overrides": overrides}, sort_keys=True
+        ).encode()
+        durable_write(self._placement_path, data, crc=True)
+
+    # -- writes + counts across dead workers ---------------------------------
+
+    def _insert_columns(self, ft, columns, observe_stats: bool = True):
+        # PAUSE while a move is copying (bounded): a batch that starts
+        # after the copy window closes routes to the FINAL placement —
+        # no duplicate-vs-copy race at all. Together with the drain
+        # below, a write either fully precedes the copy scan (drained)
+        # or fully follows the flip; the dual-write targets only cover
+        # the bounded-timeout fallthrough (counted).
+        t_end = time.monotonic() + 30.0
+        while self.placement.pending_moves and time.monotonic() < t_end:
+            time.sleep(0.01)
+        if self.placement.pending_moves:
+            robustness_metrics().inc("fleet.write.during.move")
+        with self._write_gate:
+            self._writes_inflight += 1
+        try:
+            super()._insert_columns(ft, columns, observe_stats=observe_stats)
+        finally:
+            with self._write_gate:
+                self._writes_inflight -= 1
+                self._write_gate.notify_all()
+
+    def _await_write_drain(self, timeout_s: float = 30.0) -> None:
+        """Wait for every routed write already in flight to finish (see
+        ``_write_gate``). Bounded: a wedged writer must not deadlock a
+        repair — on timeout the move proceeds and the residual risk is
+        counted."""
+        t_end = time.monotonic() + timeout_s
+        with self._write_gate:
+            while self._writes_inflight:
+                left = t_end - time.monotonic()
+                if left <= 0:
+                    robustness_metrics().inc("fleet.rebalance.drain.timeout")
+                    return
+                self._write_gate.wait(timeout=min(left, 0.1))
+
+    def _insert_one(self, sid: int, partition: str, ft, columns,
+                    is_primary: bool) -> None:
+        """The documented replica-gap window: a write that cannot reach
+        a REPLICA target is skipped (counted + marked dirty for resync
+        at restore) instead of failing the batch — the primary write
+        still fails crisply, so an acked batch always has a serving
+        home."""
+        try:
+            self.workers[sid].insert(partition, ft, columns)
+        except (OSError, ShedLoad):
+            if is_primary:
+                raise
+            self._mark_dirty(partition, sid)
+            robustness_metrics().inc("fleet.replica.write.skipped")
+            decision(
+                "fleet", "replica_write_skipped", shard=sid,
+                partition=partition,
+            )
+
+    def count(self, name: str, query=None, exact: bool = True) -> int:
+        """Plain counts ride the placement chain too: the in-process
+        fabric summed each primary directly (workers there cannot
+        die); over real processes every per-partition count gets the
+        full breaker/failover verdict protocol, so a dead primary's
+        replica answers and an exhausted chain fails crisply."""
+        if query is None:
+            self.get_schema(name)
+            wq = Query()
+            return sum(
+                self._count_one_partition(name, wq, p)
+                for p in sorted(self._partitions.get(name, ()))
+            )
+        return super().count(name, query, exact)
+
+    # -- rebalancing ---------------------------------------------------------
+
+    def _live(self, sid: int) -> bool:
+        if self.supervisor is None:
+            return True
+        return self.supervisor.states()[sid] == LIVE
+
+    def _all_partitions(self) -> List[str]:
+        out: set = set()
+        for parts in self._partitions.values():
+            out |= set(parts)
+        return sorted(out)
+
+    def _apply_moves(
+        self, moves: Dict[str, int], resync: bool, reason: str
+    ) -> None:
+        """One journaled placement change: the full move set lands as a
+        single durable replace of the placement table, write-ahead
+        journaled so a coordinator crash at ANY ``fleet.rebalance``
+        position recovers (``recover_fleet``) to exactly the pre- or
+        post-move placement. While the move is copying, affected
+        partitions dual-target old + new chains (no dropped writes;
+        duplicates dedupe at merge)."""
+        if not moves:
+            return
+        with self._move_lock, \
+                trace.span("fleet.rebalance", moves=len(moves), reason=reason):
+            deadline.check("fleet.rebalance")
+            faults.fault_point("fleet.rebalance")  # pre-intent: pre-move
+            new_over = dict(self.placement.overrides)
+            for p, t in moves.items():
+                if self.placement.hash_primary(p) == t:
+                    new_over.pop(p, None)
+                else:
+                    new_over[p] = int(t)
+            with self._fleet_journal.intent(
+                "fleet.rebalance", replaces=[self._placement_path]
+            ):
+                faults.fault_point("fleet.rebalance")  # intent down: pre-move
+                self.placement.pending_moves.update(moves)
+                # writes that read their targets BEFORE the pending set
+                # must APPLY before the copy scans run, or the copy
+                # would miss them and the flip would drop them
+                self._await_write_drain()
+                try:
+                    if resync:
+                        for p in sorted(moves):
+                            self._resync_partition(p, moves[p])
+                    # copied, not flipped: a crash here still recovers
+                    # to PRE (extra replica copies are inert — routing
+                    # never consults them until the flip lands)
+                    faults.fault_point("fleet.rebalance")
+                    self._write_placement(new_over)
+                    self.placement.overrides = new_over
+                    faults.fault_point("fleet.rebalance")  # flipped: post-move
+                finally:
+                    for p in moves:
+                        self.placement.pending_moves.pop(p, None)
+            robustness_metrics().inc("fleet.rebalance.moves", len(moves))
+            decision("fleet.rebalance", reason, moves=len(moves))
+
+    def _copy_partition(self, p: str, src: int, targets: Sequence[int]) -> None:
+        """Copy partition ``p``'s rows from ``src`` into each target —
+        ONLY the fids the target does not already hold. Inserts are
+        append-only (no fid upsert in the store tier), so a blind full
+        copy would physically duplicate the partition on a target that
+        journal-recovered its rows: worker-side counts would double on
+        every kill/restore cycle and disk would grow unboundedly. The
+        missing-fid filter makes every repair idempotent."""
+        for name in sorted(self._partitions):
+            if p not in self._partitions[name]:
+                continue
+            ft = self.get_schema(name)
+            out = self.workers[src].scan(name, Query(), [p])
+            cols = _concat_columns(ft, [c for c in out["columns"] if c])
+            fids = cols.get("__fid__")
+            if fids is None or len(fids) == 0:
+                continue
+            for t in targets:
+                have = set()
+                for c in self.workers[t].scan(name, Query(), [p])["columns"]:
+                    have.update(c["__fid__"])
+                if have:
+                    mask = np.array([f not in have for f in fids], dtype=bool)
+                    if not mask.any():
+                        continue
+                    sub = {k: np.asarray(v)[mask] for k, v in cols.items()}
+                else:
+                    sub = cols
+                self.workers[t].insert(p, ft, sub)
+
+    def _resync_partition(self, p: str, new_primary: int) -> None:
+        """Fill the members of the DESTINATION chain that do not hold
+        partition ``p``'s full row set, from a live current holder.
+        Keeps the fabric invariant every failover/hedge relies on — a
+        partition's rows live on EVERY shard of its primary's chain."""
+        old = self.placement.primary(p)
+        old_chain = self.placement.chain(old)
+        fill = [t for t in self.placement.chain(new_primary) if t not in old_chain]
+        if not fill:
+            return
+        src = new_primary if new_primary in old_chain else old
+        if not self._live(src):
+            live = [t for t in old_chain if self._live(t)]
+            if not live:
+                raise ShardUnavailable(
+                    f"partition {p!r}: no live holder in {old_chain} to resync from"
+                )
+            src = live[0]
+        # a DEAD (or failing) fill target must not abort the whole
+        # journaled move set — two simultaneously-down workers would
+        # otherwise turn one worker's repair into a fleet-wide stall.
+        # The gapped replica is marked dirty and repaired at restore,
+        # the same obligation a skipped replica write carries.
+        for t in fill:
+            if not self._live(t):
+                self._mark_dirty(p, t)
+                continue
+            try:
+                self._copy_partition(p, src, [t])
+            except (OSError, ShedLoad, QueryTimeout):
+                self._mark_dirty(p, t)
+        robustness_metrics().inc("fleet.resync.partitions")
+
+    def _resync_into(self, p: str, target: int) -> None:
+        """Repair one dirty REPLICA copy: re-copy the rows ``target``
+        is missing from a live chain member."""
+        src = next(
+            (
+                t
+                for t in self.placement.targets(p)
+                if t != target and self._live(t)
+            ),
+            None,
+        )
+        if src is None:
+            raise ShardUnavailable(
+                f"partition {p!r}: no live holder to repair replica "
+                f"{target} from"
+            )
+        self._copy_partition(p, src, [target])
+        robustness_metrics().inc("fleet.resync.replicas")
+
+    def _rebalance_away(self, dead: int) -> None:
+        """Move every partition primarily owned by ``dead`` to its first
+        LIVE replica successor (which already holds the rows), then
+        re-replicate onto the successor's own chain."""
+        moves: Dict[str, int] = {}
+        for p in self._all_partitions():
+            if self.placement.primary(p) != dead:
+                continue
+            for t in self.placement.chain(dead)[1:]:
+                if t != dead and self._live(t):
+                    moves[p] = t
+                    break
+        self._apply_moves(moves, resync=True, reason="worker_dead")
+
+    def _restore_worker(self, i: int) -> None:
+        """A worker rejoined (restart or operator revive): re-push the
+        schemas it may have never seen, resync + move back the
+        partitions whose stable hash placement is ``i`` (they carry the
+        writes that landed while it was down), and let its breaker
+        observe the recovery naturally (probe success closes it)."""
+        for ft in list(self._schemas.values()):
+            self.workers[i].create_schema(ft)
+        # replica copies that missed writes while the worker was down
+        # repair FIRST, so the move-back below starts from a complete
+        # chain. BEST-EFFORT per pair: one transient copy failure
+        # re-marks its pair and moves on — it must not abort the
+        # move-back and breaker reset below, or a single QueryTimeout
+        # would leave the (now-LIVE) worker serving nothing with no
+        # later event to retry (the heartbeat's periodic repair_dirty
+        # sweep retries the re-marked pairs). The mark comes OUT before
+        # the copy so a write skipped mid-repair re-adds it instead of
+        # being erased by a post-copy discard.
+        dirty = sorted(p for (p, s) in set(self._dirty) if s == i)
+        for p in dirty:
+            self._clear_dirty(p, i)
+            try:
+                self._resync_into(p, i)
+            except Exception:  # noqa: BLE001 - re-mark, keep restoring
+                self._mark_dirty(p, i)
+                robustness_metrics().inc("fleet.resync.retry")
+        moves = {
+            p: i
+            for p in self._all_partitions()
+            if self.placement.hash_primary(p) == i and self.placement.primary(p) != i
+        }
+        self._apply_moves(moves, resync=True, reason="worker_restored")
+        # the supervisor just verified the worker out-of-band (spawned,
+        # pinged, pushed schemas, re-synced through it): close its
+        # breaker NOW so /healthz clears with the restore instead of
+        # waiting out a cooldown + an organic half-open probe
+        self._breakers[i].reset()
+
+    def repair_dirty(self) -> int:
+        """Best-effort sweep of outstanding replica-gap obligations
+        against LIVE workers (the heartbeat runs this periodically, so
+        a transiently-failed restore repair heals without waiting for
+        the worker's next death/restore cycle). Returns repairs made."""
+        done = 0
+        for p, s in sorted(set(self._dirty)):
+            if not self._live(s):
+                continue
+            self._clear_dirty(p, s)
+            try:
+                self._resync_into(p, s)
+                done += 1
+            except Exception:  # noqa: BLE001 - keep the obligation
+                self._mark_dirty(p, s)
+        return done
+
+    def move_partition(self, partition: str, to_shard: int,
+                       resync: bool = True) -> None:
+        """Operator/test lever: one journaled partition move."""
+        if not (0 <= int(to_shard) < len(self.workers)):
+            raise ValueError(f"no such shard {to_shard}")
+        self._apply_moves({str(partition): int(to_shard)}, resync=resync,
+                          reason="manual")
+
+    # -- drain ---------------------------------------------------------------
+
+    def drain_worker(self, i: int, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful drain: primaries move to their successors first (new
+        admissions route there), then the worker sheds new scans while
+        in-flight queries complete against their own deadlines."""
+        from geomesa_tpu.utils.config import FLEET_DRAIN_TIMEOUT
+
+        if timeout_s is None:
+            timeout_s = FLEET_DRAIN_TIMEOUT.to_duration_s(10.0)
+        moves: Dict[str, int] = {}
+        for p in self._all_partitions():
+            if self.placement.primary(p) != i:
+                continue
+            for t in self.placement.chain(i)[1:]:
+                if t != i and self._live(t):
+                    moves[p] = t
+                    break
+            else:
+                chain = self.placement.chain(i)
+                for t in range(len(self.workers)):
+                    if t != i and t not in chain and self._live(t):
+                        moves[p] = t
+                        break
+        self._apply_moves(moves, resync=True, reason="drain")
+        decision("fleet", "drain", worker=i, moves=len(moves))
+        if self.transport == "process":
+            return self.workers[i].drain(timeout_s)
+        return {"drained": True, "inflight": 0}
+
+    # -- observability -------------------------------------------------------
+
+    def shards_snapshot(self) -> Dict[str, Any]:
+        """LOCAL-ONLY (no wire RPCs): /healthz and /debug/overload call
+        this on every probe, and N serial telemetry RPCs — up to the
+        passive budget EACH against wedged workers — would stack into
+        multi-second health probes. Breaker state and the supervisor's
+        last-beat view answer everything the probes consume; the
+        RPC-rich per-worker telemetry lives on /debug/fleet
+        (``fleet_snapshot``), which is on-demand."""
+        states = (
+            self.supervisor.states()
+            if self.supervisor is not None
+            else [LIVE] * len(self.workers)
+        )
+        return {
+            "count": len(self.workers),
+            "replicas": self.placement.replicas,
+            "partitions": {
+                n: len(ps) for n, ps in sorted(self._partitions.items())
+            },
+            "moved": dict(sorted(self.placement.overrides.items())),
+            "shards": {
+                str(i): {
+                    "breaker": self._breakers[i].peek_state,
+                    "state": states[i],
+                }
+                for i in range(len(self.workers))
+            },
+        }
+
+    def fleet_health(self) -> Dict[str, Any]:
+        """The /healthz fleet block: membership states; ``down`` names
+        every worker not currently LIVE, and full placement means every
+        partition's primary chain starts at a live worker."""
+        states = (
+            self.supervisor.states()
+            if self.supervisor is not None
+            else [LIVE] * len(self.workers)
+        )
+        down = sorted(i for i, s in enumerate(states) if s != LIVE)
+        unowned = sorted(
+            p for p in self._all_partitions()
+            if states[self.placement.primary(p)] != LIVE
+        )
+        return {
+            "workers": len(self.workers),
+            "states": {str(i): s for i, s in enumerate(states)},
+            "down": down,
+            "unowned_partitions": unowned,
+            "placement_moved": len(self.placement.overrides),
+        }
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """The /debug/fleet + /debug/report section: supervisor view
+        (state machine, pids, restart counts) joined with each live
+        worker's over-the-wire telemetry."""
+        sup = (
+            self.supervisor.snapshot() if self.supervisor is not None else {}
+        )
+        out: Dict[str, Any] = {
+            "transport": self.transport,
+            "workers": {},
+            "placement": {
+                "moved": dict(sorted(self.placement.overrides.items())),
+                "pending_moves": dict(self.placement.pending_moves),
+                "partitions": {
+                    n: len(ps) for n, ps in sorted(self._partitions.items())
+                },
+            },
+            "health": self.fleet_health(),
+        }
+        for i, w in enumerate(self.workers):
+            row: Dict[str, Any] = dict(sup.get(str(i), {}))
+            row["breaker"] = self._breakers[i].peek_state
+            row["telemetry"] = w.telemetry()
+            out["workers"][str(i)] = row
+        return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--worker":
+        return worker_main(argv[1:])
+    sys.stderr.write(
+        "usage: python -m geomesa_tpu.parallel.fleet --worker --id I "
+        "--root DIR --portfile FILE\n"
+    )
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

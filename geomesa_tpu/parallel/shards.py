@@ -147,6 +147,18 @@ class PlacementMap:
         self.replicas = max(0, min(int(replicas), self.num_shards - 1))
         self._z2 = Z2Scheme(bits=bits)
         self._hash_parts = max(16, self.num_shards * 4)
+        # REBALANCING state (parallel/fleet.py journals both through the
+        # fleet intent journal): `overrides` reassigns a partition's
+        # primary away from its stable hash placement — the move target
+        # of a rebalance on shard join/leave/death. `pending_moves`
+        # marks partitions mid-move: writes DUAL-TARGET the old and new
+        # chains until the move commits, so no row written during the
+        # copy window can be dropped (duplicates are absorbed by the
+        # coordinator's fid dedupe). Routing/reads consult `overrides`
+        # only — a partition is answered by exactly ONE primary chain at
+        # any instant, never zero or two.
+        self.overrides: Dict[str, int] = {}
+        self.pending_moves: Dict[str, int] = {}
 
     # -- partitioning --------------------------------------------------------
 
@@ -193,8 +205,14 @@ class PlacementMap:
 
     # -- placement -----------------------------------------------------------
 
-    def primary(self, partition: str) -> int:
+    def hash_primary(self, partition: str) -> int:
+        """The partition's STABLE hash placement — where it lives when
+        no rebalance override has moved it."""
         return zlib.crc32(partition.encode()) % self.num_shards
+
+    def primary(self, partition: str) -> int:
+        got = self.overrides.get(partition)
+        return self.hash_primary(partition) if got is None else got
 
     def chain(self, primary: int) -> List[int]:
         """Placement chain for a per-shard scan: the primary shard then
@@ -203,6 +221,18 @@ class PlacementMap:
 
     def targets(self, partition: str) -> List[int]:
         return self.chain(self.primary(partition))
+
+    def write_targets(self, partition: str) -> List[int]:
+        """Where an ingest batch for ``partition`` must land: the
+        current placement chain, plus the DESTINATION chain while a
+        rebalance move is in flight (the dual-write window) — a row
+        written mid-move reaches both homes, so the move can commit in
+        either direction without dropping it."""
+        out = self.targets(partition)
+        pend = self.pending_moves.get(partition)
+        if pend is not None:
+            out = out + [t for t in self.chain(pend) if t not in out]
+        return out
 
 
 def mesh_executor_factory(mesh=None):
@@ -513,14 +543,24 @@ class ShardedDataStore(TpuDataStore):
             mask = inv == i
             sub = {k: np.asarray(v)[mask] for k, v in columns.items()}
             known.add(str(p))
-            for sid in self.placement.targets(str(p)):
-                self.workers[sid].insert(str(p), ft, sub)
+            targets = self.placement.write_targets(str(p))
+            for sid in targets:
+                self._insert_one(sid, str(p), ft, sub, is_primary=sid == targets[0])
         if observe_stats and self.stats is not None:
             self.stats.observe_columns(ft, columns)
         # coordinator tables never move on writes (rows live on shard
         # workers): the write-generation counter is the ONLY signal the
         # schema-generation cache keys (ops/join.py) have here
         self._note_write(ft.name)
+
+    def _insert_one(self, sid: int, partition: str, ft, columns,
+                    is_primary: bool) -> None:
+        """One routed per-target insert — the seam the cross-process
+        fleet (parallel/fleet.py) overrides to absorb a dead REPLICA
+        target (skip + mark dirty for resync) instead of failing the
+        whole batch; in-process workers cannot die, so the base form is
+        a direct call."""
+        self.workers[sid].insert(partition, ft, columns)
 
     def delete_features(self, name: str, fids) -> None:
         for w in self.workers:

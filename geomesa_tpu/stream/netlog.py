@@ -33,6 +33,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from geomesa_tpu.stream.filelog import FileLogBroker, FileOffsetManager
@@ -59,15 +60,60 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_msg(sock: socket.socket) -> bytes:
+def recv_frame(sock: socket.socket, max_bytes: int = _MAX_MSG) -> bytes:
+    """One ``[u32 len][bytes]`` frame off the socket (the shared wire
+    framing — netlog and the fleet transport, parallel/fleet.py, speak
+    the same envelope discipline)."""
     (n,) = _LEN.unpack(_recv_exact(sock, 4))
-    if n > _MAX_MSG:
+    if n > max_bytes:
         raise ConnectionError(f"oversized frame ({n} bytes)")
     return _recv_exact(sock, n) if n else b""
 
 
-def _send_msg(sock: socket.socket, payload: bytes) -> None:
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    """Length-prefix and send one frame (see ``recv_frame``)."""
     sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+# internal aliases (the original private names, kept for callers/tests)
+_recv_msg = recv_frame
+_send_msg = send_frame
+
+
+def request_envelope(op: str, **fields) -> dict:
+    """The shared RPC request envelope: ``op`` + caller fields, plus the
+    two cross-process disciplines every geomesa transport carries:
+
+    * ``trace`` — the ambient trace id, so server-side spans join the
+      calling query's tree (PR 2's netlog rule, now shared).
+    * ``budget_s`` — the query's REMAINING budget in seconds (never an
+      absolute wall-clock instant: coordinator/worker clock skew must
+      not be able to extend or instantly expire a deadline slice). The
+      receiving side re-anchors it against its own monotonic clock via
+      ``envelope_budget``. ``sent_unix`` rides along for telemetry only
+      and is never consulted for deadline math.
+    """
+    head = dict(fields)
+    head["op"] = op
+    tid = trace.current_trace_id()
+    if tid:
+        head.setdefault("trace", tid)
+    left = deadline.remaining()
+    if left is not None:
+        head["budget_s"] = max(0.0, left)
+    head["sent_unix"] = time.time()
+    return head
+
+
+def envelope_budget(head: dict) -> Optional[float]:
+    """The server-side half of the deadline discipline: the remaining
+    budget carried by ``request_envelope``, or None when the caller was
+    unbounded. Attach it with ``deadline.budget(envelope_budget(head))``
+    — re-anchored NOW on the local monotonic clock, so wire latency is
+    absorbed by the coordinator's slice reserve and clock skew between
+    hosts cannot stretch or kill the slice."""
+    b = head.get("budget_s")
+    return None if b is None else max(0.0, float(b))
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -331,12 +377,11 @@ class RemoteLogBroker:
                 raise
 
     def _rpc(self, head: dict, payload: Optional[bytes] = None):
-        # trace correlation across the wire: the client's trace id rides
-        # in the message envelope so broker-side spans join this query's
-        # tree (heads are built fresh per call — safe to annotate)
-        tid = trace.current_trace_id()
-        if tid:
-            head.setdefault("trace", tid)
+        # trace correlation across the wire: the client's trace id (and
+        # the remaining-budget field) ride in the shared request
+        # envelope so broker-side spans join this query's tree (heads
+        # are built fresh per call — safe to annotate)
+        head = request_envelope(head.pop("op"), **head)
         with self._lock:
             # open circuit: fail fast with CircuitOpen (a
             # ConnectionError) — no dial, no retry ladder. The cooldown's

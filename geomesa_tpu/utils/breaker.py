@@ -199,6 +199,23 @@ class CircuitBreaker:
             robustness_metrics().inc(f"breaker.{self.name}.closes")
             trace.event("breaker.close", breaker=self.name)
 
+    def reset(self) -> None:
+        """Administrative close: the OPERATOR (or a supervisor that
+        verified the dependency recovered out-of-band — the fleet
+        restarts a worker, pings it, and re-syncs through it before
+        calling this) declares the circuit healthy. Unlike
+        ``record_success``, this closes from ANY state without waiting
+        out the cooldown: positive external evidence outranks the
+        timer. No-op when already closed with an empty window."""
+        with self._lock:
+            if self._state == CLOSED and not self._window:
+                return
+            self._state = CLOSED
+            self._probing = False
+            self._window.clear()
+            robustness_metrics().inc(f"breaker.{self.name}.resets")
+            trace.event("breaker.reset", breaker=self.name)
+
     def record_failure(self) -> None:
         """A guarded call failed. Half-open: the probe failed — re-open
         for another cooldown. Closed: roll the window; trip open when it

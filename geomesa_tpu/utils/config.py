@@ -174,6 +174,38 @@ SHARD_HEDGE_MIN_MS = SystemProperty("geomesa.shard.hedge.min.ms", "25")
 SHARD_DEADLINE_FRACTION = SystemProperty("geomesa.shard.deadline.fraction", "0.5")
 SHARD_MAX_INFLIGHT = SystemProperty("geomesa.shard.max.inflight", "32")
 SHARD_QUEUE_DEPTH = SystemProperty("geomesa.shard.queue.depth", "128")
+# Multi-host serving tier (parallel/fleet.py): the FleetDataStore
+# coordinator runs each shard as a SPAWNED WORKER PROCESS owning its
+# partitions' FsDataStore roots, supervised by a heartbeat membership
+# loop. `workers` overrides geomesa.shard.count for the fleet; a worker
+# missing `heartbeat.suspect` consecutive beats (one per
+# `heartbeat.interval`) is SUSPECT (no action — hysteresis, so one slow
+# GC pause never triggers a partition move), `heartbeat.dead` misses is
+# DEAD: its primary partitions move to live replicas (journaled through
+# the fleet intent journal) and the supervisor restarts the process
+# with bounded exponential backoff (`restart.base`..`restart.cap`, at
+# most `restart.max` attempts per death). A worker dying more than
+# `flap.restarts` times inside `flap.window` is marked OUT via its
+# shard.<n> breaker instead of being restarted again. `drain.timeout`
+# bounds graceful drain (in-flight scans complete against their own
+# deadlines; new admissions shed to the successor). `rpc.timeout` is
+# the per-attempt socket budget of every fleet RPC, always re-clamped
+# to the calling query's remaining deadline; `spawn.timeout` bounds how
+# long a spawned worker may take to publish its port.
+FLEET_WORKERS = SystemProperty("geomesa.fleet.workers", None)
+FLEET_HEARTBEAT_INTERVAL = SystemProperty(
+    "geomesa.fleet.heartbeat.interval", "1 second"
+)
+FLEET_HEARTBEAT_SUSPECT = SystemProperty("geomesa.fleet.heartbeat.suspect", "2")
+FLEET_HEARTBEAT_DEAD = SystemProperty("geomesa.fleet.heartbeat.dead", "4")
+FLEET_RESTART_BASE = SystemProperty("geomesa.fleet.restart.base", "200 ms")
+FLEET_RESTART_CAP = SystemProperty("geomesa.fleet.restart.cap", "5 seconds")
+FLEET_RESTART_MAX = SystemProperty("geomesa.fleet.restart.max", "6")
+FLEET_FLAP_RESTARTS = SystemProperty("geomesa.fleet.flap.restarts", "3")
+FLEET_FLAP_WINDOW = SystemProperty("geomesa.fleet.flap.window", "60 seconds")
+FLEET_DRAIN_TIMEOUT = SystemProperty("geomesa.fleet.drain.timeout", "10 seconds")
+FLEET_RPC_TIMEOUT = SystemProperty("geomesa.fleet.rpc.timeout", "10 seconds")
+FLEET_SPAWN_TIMEOUT = SystemProperty("geomesa.fleet.spawn.timeout", "30 seconds")
 # Spatial placement granularity: partitions are low-resolution z2 cells
 # of the point geometry (store/partitions.Z2Scheme, `bits` even), so a
 # bbox query routes to the shards owning intersecting cells only;

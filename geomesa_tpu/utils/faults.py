@@ -32,6 +32,22 @@ disk, or device boundary:
                        the WHOLE group to per-query solo execution with
                        identical results — one member's fault never fails
                        a sibling
+    fleet.rpc          coordinator->worker-process RPC (parallel/fleet.py):
+                       the cross-process edition of shard.rpc — one
+                       request/response exchange with a spawned shard
+                       worker; a ``crash`` here models the WORKER process
+                       dying mid-exchange (the coordinator fails over,
+                       like shard.rpc), and error/drop model the transport
+    fleet.heartbeat    one supervisor heartbeat probe (parallel/fleet.py):
+                       faults here exercise the missed-beat -> suspect ->
+                       dead membership machine without touching a real
+                       process
+    fleet.rebalance    one placement move (parallel/fleet.py): partition
+                       primary reassignment on worker join/leave/death,
+                       journaled through the fleet intent journal — a
+                       ``crash`` at any position must recover to exactly
+                       the pre- or post-move placement, never a partition
+                       owned by zero or two primaries
 
 Kinds:
 
@@ -109,6 +125,9 @@ FAULT_POINTS = (
     "join.probe",
     "agg.build",
     "batch.coalesce",
+    "fleet.rpc",
+    "fleet.heartbeat",
+    "fleet.rebalance",
 )
 
 KINDS = ("error", "drop", "latency", "torn", "crash")

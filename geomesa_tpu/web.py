@@ -72,6 +72,11 @@ SURVEY.md section 2.5). Endpoints over a datastore:
                                     tallies; sort=time|calls|hits|
                                     misestimate; per-shard rollup +
                                     merged table on sharded stores
+    GET /debug/fleet             -- multi-host serving tier
+                                    (parallel/fleet.py): supervisor
+                                    membership states, per-worker pids/
+                                    restarts/breakers, placement moves,
+                                    per-worker telemetry over the wire
     GET /debug/report?s=300      -- one-shot incident report: every
                                     debug surface + slow-query log tail +
                                     resolved exemplar traces + config
@@ -201,6 +206,19 @@ def debug_slo_payload(store):
 MAX_DEBUG_PLANS = 1000
 
 
+def debug_fleet_payload(store):
+    """The multi-host serving tier (parallel/fleet.py): supervisor
+    membership states, per-worker pids/restart counts, placement moves,
+    and every worker's over-the-wire telemetry. Non-fleet stores report
+    ``{"fleet": False}`` so the report section is always present."""
+    fn = getattr(store, "fleet_snapshot", None)
+    if fn is None:
+        return {"fleet": False}
+    out = fn()
+    out["fleet"] = True
+    return out
+
+
 def debug_plans_payload(store, n: int = 20, sort: str = "time"):
     from geomesa_tpu.utils import plans as _plans
 
@@ -229,6 +247,7 @@ REPORT_SECTIONS = {
     "timeline": lambda store, s: debug_timeline_payload(store, s),
     "slo": lambda store, s: debug_slo_payload(store),
     "plans": lambda store, s: debug_plans_payload(store, 10),
+    "fleet": lambda store, s: debug_fleet_payload(store),
 }
 
 
@@ -768,6 +787,23 @@ def make_handler(store):
                             "replicas": snap["replicas"],
                             "unavailable": down,
                         }
+                    # multi-host fleet membership (parallel/fleet.py):
+                    # /healthz stays degraded while ANY worker process
+                    # is not LIVE or any partition's primary points at a
+                    # non-live worker, and clears once the supervisor
+                    # has restarted the process and restored placement —
+                    # the "fleet survived the kill" probe the chaos soak
+                    # (and a balancer) watches
+                    fleet_fn = getattr(store, "fleet_health", None)
+                    if fleet_fn is not None:
+                        fh = fleet_fn()
+                        body["fleet"] = {
+                            "workers": fh["workers"],
+                            "down": fh["down"],
+                            "unowned_partitions": fh["unowned_partitions"],
+                        }
+                        if fh["down"] or fh["unowned_partitions"]:
+                            body["status"] = "degraded"
                     # SLO burn-rate degradation (utils/slo.py): while any
                     # query class burns its error budget past both window
                     # thresholds, /healthz names the violating SLO so a
@@ -826,6 +862,16 @@ def make_handler(store):
                     self._send(
                         200,
                         json.dumps(debug_recovery_payload(store), default=str),
+                    )
+                elif route == "/debug/fleet":
+                    # multi-host serving tier (parallel/fleet.py): the
+                    # supervisor's membership machine, per-worker pid/
+                    # restart/breaker state, placement moves, and each
+                    # worker's over-the-wire telemetry — the operator's
+                    # "which process is hurting" answer
+                    self._send(
+                        200,
+                        json.dumps(debug_fleet_payload(store), default=str),
                     )
                 elif route == "/debug/device":
                     # device/compiler telemetry page: per-kernel compile +

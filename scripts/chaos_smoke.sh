@@ -44,12 +44,28 @@
 #     in its plan fingerprint — a degraded query lands on the degraded
 #     scan-path fingerprint with its reason-coded degrade decision
 #     recorded, never double-counted and never lost
+#   - fleet survives real process death (tests/test_fleet.py, its own
+#     120 s cap): a worker process is killed with a REAL SIGKILL mid-
+#     query-stream — every in-flight and subsequent query answers
+#     identically to the single-process run or fails crisply with
+#     QueryTimeout/ShardUnavailable, never truncated; the supervisor
+#     restores full placement (all partitions primary-owned) and
+#     /healthz clears; a coordinator SimulatedCrash at every
+#     fleet.rebalance position recovers to exactly the pre- or
+#     post-move placement
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
 cd "$(dirname "$0")/.."
-exec timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
+rc=0
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_crash.py tests/test_shards.py \
     tests/test_join.py tests/test_agg_cache.py tests/test_timeline.py \
     tests/test_plans.py \
-    -q -m chaos -p no:cacheprovider "$@"
+    -q -m chaos -p no:cacheprovider "$@" || rc=$?
+# the real-SIGKILL fleet soak spawns worker PROCESSES: bounded on its
+# own so a wedged spawn can never eat the in-process soaks' budget
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet.py \
+    -q -m chaos -p no:cacheprovider "$@" || rc=$?
+exit $rc

@@ -34,6 +34,9 @@ declare -A SPANS=(
     ["join.probe"]="geomesa_tpu/ops/join.py"
     ["agg.build"]="geomesa_tpu/ops/pyramid.py"
     ["batch.coalesce"]="geomesa_tpu/parallel/batch.py"
+    ["fleet.rpc"]="geomesa_tpu/parallel/fleet.py"
+    ["fleet.heartbeat"]="geomesa_tpu/parallel/fleet.py"
+    ["fleet.rebalance"]="geomesa_tpu/parallel/fleet.py"
 )
 for point in "${!SPANS[@]}"; do
     file="${SPANS[$point]}"
