@@ -18,7 +18,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from geomesa_tpu.ops.filters import spatial_mask, temporal_mask
-from geomesa_tpu.parallel.mesh import DATA_AXIS
+from geomesa_tpu.parallel.mesh import DATA_AXIS, gated
 from geomesa_tpu.utils.devstats import instrumented_jit
 
 
@@ -196,7 +196,10 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
 
     d = P(DATA_AXIS)
     r = P()
-    with_time = instrumented_jit("density.time", 
+    # the psum reduction is a REAL collective: gate both editions so
+    # concurrent multi-device executions can never interleave their
+    # rendezvous (parallel/mesh.gated — the PR 9 deadlock fence)
+    with_time = gated(instrumented_jit("density.time", 
         shard_map_fn(
             step,
             mesh,
@@ -204,8 +207,8 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
             out_specs=r,
             check=not use_pallas,
         )
-    )
-    no_time = instrumented_jit("density.notime", 
+    ), mesh)
+    no_time = gated(instrumented_jit("density.notime", 
         shard_map_fn(
             step_no_time,
             mesh,
@@ -213,7 +216,7 @@ def make_sharded_density(mesh, width: int, height: int, mode: str = "xla"):
             out_specs=r,
             check=not use_pallas,
         )
-    )
+    ), mesh)
     return with_time, no_time
 
 
@@ -349,7 +352,8 @@ def make_sharded_density_dual(
 
     d = P(DATA_AXIS)
     r = P()
-    with_time = instrumented_jit("density_dual.time", 
+    # psum-bearing like the plain editions: same rendezvous fence
+    with_time = gated(instrumented_jit("density_dual.time", 
         shard_map_fn(
             step,
             mesh,
@@ -357,8 +361,8 @@ def make_sharded_density_dual(
             out_specs=(r, d, d),
             check=not use_pallas,
         )
-    )
-    no_time = instrumented_jit("density_dual.notime", 
+    ), mesh)
+    no_time = gated(instrumented_jit("density_dual.notime", 
         shard_map_fn(
             step_no_time,
             mesh,
@@ -366,7 +370,7 @@ def make_sharded_density_dual(
             out_specs=(r, d, d),
             check=not use_pallas,
         )
-    )
+    ), mesh)
     return with_time, no_time
 
 
@@ -402,10 +406,10 @@ def make_pyramid_counts(mesh, bits: int, src_bits: int = 31):
     from geomesa_tpu.parallel.mesh import shard_map_fn
 
     d = P(DATA_AXIS)
-    return instrumented_jit(
+    return gated(instrumented_jit(
         "agg.pyramid",
         shard_map_fn(step, mesh, in_specs=(d, d, d), out_specs=P()),
-    )
+    ), mesh)
 
 
 # the host reference implementation lives in geomesa_tpu.index.aggregators

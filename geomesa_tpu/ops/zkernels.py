@@ -205,6 +205,18 @@ def limbs_in_range(
     return ge & le
 
 
+def pack_mask_rows(m: jnp.ndarray) -> jnp.ndarray:
+    """[..., rows] bool mask -> [..., rows/8] u8 packed bits along the
+    LAST axis — THE wire step of every stacked-mask batch kernel
+    (parallel/executor: _exact_mask_batch_fn and the per-shard SPMD
+    editions). One home so the single-device and shard_map editions can
+    never diverge on bit order, and so the row-count contract is stated
+    once: the last axis must be a multiple of 8, which DeviceSegment
+    guarantees by construction (n_padded divides by 8 * n_devices, so
+    both the full table and every per-shard slice pack evenly)."""
+    return jnp.packbits(m, axis=-1)
+
+
 def split_i64_to_limbs(z) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Host-side helper: int64 keys -> (hi, lo) uint32 arrays (numpy in/out)."""
     import numpy as np

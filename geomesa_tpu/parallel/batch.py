@@ -10,7 +10,12 @@ feature type for a tiny window (``geomesa.batch.window.ms``, cap
 parameters into ONE batched kernel call (``instrumented_jit``-accounted:
 one sweep evaluates N predicate rows, producing an [N, rows] packed
 mask — executor.dispatch_coalesced / _exact_mask_batch_fn), and demuxes
-per query.
+per query. Plain box(+window), attribute-plane, extent (xz), and banded
+polygon shapes all stack (the dual-plane editions resolve through the
+ring-certify contract); on an SPMD mesh the sweep compiles per chip
+inside shard_map with no collective anywhere (the stacked-mask SPMD
+kernel — multi-chip groups are rendezvous-safe by construction), so
+coalescing reaches every mesh size.
 
 Contract (the standing envelope):
 
@@ -231,6 +236,13 @@ class QueryCoalescer:
         reg = devstats.devstats_metrics()
         reg.inc("batch.coalesce.groups")
         reg.inc("batch.coalesce.members", len(members))
+        # pow2 group-size histogram for the /debug/device coalesce block
+        # (the timeline/SLO layer's "is the coalescer actually batching"
+        # signal — a histogram of all-1s means the window never fills)
+        bucket = 1
+        while bucket < len(members):
+            bucket *= 2
+        reg.inc(f"batch.coalesce.group.pow2.{bucket}")
         pad0 = reg.counter("device.pad.events")
         shared: Dict[str, int] = {}
         try:

@@ -51,6 +51,7 @@ from geomesa_tpu.ops.filters import (
     z2_query_mask,
     z3_query_mask,
 )
+from geomesa_tpu.ops.zkernels import pack_mask_rows
 from geomesa_tpu.parallel.mesh import (
     DATA_AXIS,
     default_mesh,
@@ -61,7 +62,12 @@ from geomesa_tpu.parallel.mesh import (
 )
 from geomesa_tpu.store.blocks import FeatureBlock, IndexTable
 from geomesa_tpu.utils import audit, deadline, faults, trace
-from geomesa_tpu.utils.devstats import count_d2h, instrumented_jit, record_pad
+from geomesa_tpu.utils.devstats import (
+    count_d2h,
+    devstats_metrics,
+    instrumented_jit,
+    record_pad,
+)
 
 # initial hit-run capacity: 4096 runs * 8B = 32 KiB per segment transfer
 HIT_CAPACITY0 = 4096
@@ -259,6 +265,23 @@ def _gathered(mask, mesh):
     return wrapped
 
 
+def _mesh_gated(fn, mesh):
+    """Serialize one multi-device execution at a time through the mesh's
+    dispatch gate (``mesh.gated`` / ``mesh.dispatch_gate``) — the fence
+    half of the rendezvous-safety contract: XLA's collective rendezvous
+    assumes programs launch in one global order per device set, and two
+    threads interleaving collective-bearing programs (the ``_gathered``
+    all-gather, a cross-shard ``jnp.sum``/``psum``) can deadlock it —
+    the hazard PR 9's tests surfaced with concurrent SOLO queries on a
+    multi-device mesh. Single-device meshes return ``fn`` unchanged,
+    and the collective-free shard_map editions (shard-extract bitmaps,
+    the stacked-mask SPMD kernel) never wrap at all — their layout IS
+    the other half of the contract."""
+    from geomesa_tpu.parallel.mesh import gated
+
+    return gated(fn, mesh)
+
+
 def _mask_runs(m, rcap: int):
     """Bool mask -> (count, n_runs, starts[rcap], ends[rcap]) — the shared
     RLE extraction both transfer layouts build on (their parity depends on
@@ -291,7 +314,7 @@ def _runs_fn(kind: str, rcap: int, mode: str, mesh):
         def run(*args):
             return _runs_from_mask(mask(*args), rcap)
 
-        fn = instrumented_jit(f"runs.{kind}", run)
+        fn = _mesh_gated(instrumented_jit(f"runs.{kind}", run), mesh)
         _RUNS_FNS[key] = fn
     return fn
 
@@ -449,15 +472,27 @@ def _exact_runs_fn(has_time: bool, rcap: int, mode: str, mesh,
         def run(*args):
             return _runs_from_mask(mask(*args), rcap)
 
-        fn = instrumented_jit("exact_runs", run)
+        fn = _mesh_gated(instrumented_jit("exact_runs", run), mesh)
         _EXACT_RUNS_FNS[key] = fn
     return fn
 
 
 _EXACT_MASK_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_EXACT_SHARD_MASK_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_DUAL_MASK_BATCH_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
+_DUAL_SHARD_MASK_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
-def _exact_mask_batch_fn(has_time: bool, q: int, mode: str, mesh):
+def _mask_batch_rows(mask, has_time: bool, args, attr=False):
+    """Vmapped [q, rows] bool mask over stacked point descriptors — the
+    stacked-mask sibling of _point_desc_split's lax.scan split, shared
+    by the replicated AND per-shard mask-batch editions (their parity
+    depends on this staying single-sourced)."""
+    mask_of, descs = _point_desc_split(mask, has_time, args, attr)
+    return jax.vmap(lambda *d: mask_of(d))(*descs)
+
+
+def _exact_mask_batch_fn(has_time: bool, q: int, mode: str, mesh, attr=False):
     """Q stacked exact predicates -> ONE full-table packed bitmap
     u8[q, n/8] in a single segment sweep — the coalescer's kernel
     (parallel/batch.py).
@@ -471,26 +506,129 @@ def _exact_mask_batch_fn(has_time: bool, q: int, mode: str, mesh):
     packbits, n/8 bytes per query over the link, and the host demuxes
     each query's rows with the native ctz decoder (~1 ms per 1 MB).
     ``q`` is the PADDED query count (pow2 buckets keep jit shapes
-    bounded); pad rows repeat the last descriptor and are never decoded."""
-    key = (has_time, q, mode, mesh)
+    bounded); pad rows repeat the last descriptor and are never decoded.
+    ``attr`` threads the rank-code attribute plane exactly like
+    _exact_runs_batch_fn's editions (the coalescer's attr fold). On a
+    multi-device mesh use _exact_shard_mask_batch_fn — the per-shard,
+    collective-free edition — instead; this replicated form stays for
+    single-device meshes (and the GEOMESA_SHARD_EXTRACT=0 A/B posture
+    of the other batch layouts)."""
+    key = (has_time, q, mode, mesh, attr)
     fn = _EXACT_MASK_BATCH_FNS.get(key)
     if fn is None:
-        body = _exact_mask_body(has_time, mode, mesh)
+        body = _exact_mask_body(has_time, mode, mesh, attr)
         body = _gathered(body, mesh)
-        nrow, _nrep = _exact_arg_counts(has_time, False)
 
         def run(*args):
-            rows, rep = args[:nrow], args[nrow:]
-            if has_time:
-                m = jax.vmap(lambda box, win: body(*rows, box, win))(
-                    rep[0], rep[1]
-                )
-            else:
-                m = jax.vmap(lambda box: body(*rows, box))(rep[0])
-            return jnp.packbits(m, axis=1)
+            m = _mask_batch_rows(body, has_time, args, attr)
+            return pack_mask_rows(m)
 
-        fn = instrumented_jit("exact_mask_batch", run)
+        fn = _mesh_gated(instrumented_jit("exact_mask_batch", run), mesh)
         _EXACT_MASK_BATCH_FNS[key] = fn
+    return fn
+
+
+def _exact_shard_mask_batch_fn(has_time: bool, q: int, mesh, attr=False):
+    """PER-SHARD edition of _exact_mask_batch_fn — the multi-chip
+    stacked-mask kernel: the local mask AND the bit-pack both run INSIDE
+    shard_map, so each chip sweeps only its RESIDENT rows and emits its
+    own u8[q, shard_n/8] packed plane; the leading axis concatenates
+    across shards -> [D*q, shard_n/8] with NO cross-chip collective at
+    all (the rendezvous-safety contract's collective-free half — a
+    coalesced group on an SPMD mesh compiles to one such sweep per
+    chip). The host stitches shard planes with row offsets (shard d's
+    rows start at d * shard_n), exactly the shard-extract bitmap
+    discipline minus the span framing the mask layout exists to skip."""
+    key = (has_time, q, mesh, attr)
+    fn = _EXACT_SHARD_MASK_FNS.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec as P
+
+        # the UNWRAPPED local mask body: shard_map provides the locality
+        local_mask = _exact_mask_body(has_time, "local", mesh, attr)
+        nrow, nrep = _exact_arg_counts(has_time, attr)
+
+        def shard_body(*args):
+            m = _mask_batch_rows(local_mask, has_time, args, attr)
+            return pack_mask_rows(m)  # per shard: [q, shard_n/8]
+
+        wrapped = shard_map_fn(
+            shard_body,
+            mesh,
+            in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * nrep),
+            out_specs=P(DATA_AXIS),
+            check=False,
+        )
+        # collective-free by construction: NOT mesh-gated (concurrent
+        # stacked sweeps cannot rendezvous, so they may overlap freely)
+        fn = instrumented_jit("exact_shard_mask_batch", wrapped)
+        _EXACT_SHARD_MASK_FNS[key] = fn
+    return fn
+
+
+def _dual_mask_batch_fn(kind: str, has_time: bool, q: int, mode: str, mesh,
+                        attr=False):
+    """Dual-plane (hit/decided) edition of _exact_mask_batch_fn for the
+    extent-envelope ('xz') and banded-polygon ('poly') coalesced folds:
+    Q stacked descriptors -> (hit u8[q, n/8], decided u8[q, n/8]) full-
+    table packed planes in one sweep — no span framing, no RLE. Decided
+    rows are final; hit & ~decided is the ring/band the host certifies
+    (_XZBatchScan's resolve contract, unchanged)."""
+    key = (kind, has_time, q, mode, mesh, attr)
+    fn = _DUAL_MASK_BATCH_FNS.get(key)
+    if fn is None:
+        if kind == "xz":
+            body = _xz_exact_mask_body(has_time, mode, mesh, attr)
+            split = _xz_desc_split
+        else:
+            body = _poly_mask_body(has_time, mode, mesh, attr)
+            split = _poly_desc_split
+        body = _gathered(body, mesh)
+
+        def run(*args):
+            mask_of, descs = split(body, attr, args)
+            hit, dec = jax.vmap(lambda *d: mask_of(d))(*descs)
+            return pack_mask_rows(hit), pack_mask_rows(dec)
+
+        fn = _mesh_gated(instrumented_jit(f"{kind}_mask_batch", run), mesh)
+        _DUAL_MASK_BATCH_FNS[key] = fn
+    return fn
+
+
+def _dual_shard_mask_batch_fn(kind: str, has_time: bool, q: int, mesh,
+                              attr=False):
+    """PER-SHARD edition of _dual_mask_batch_fn: each chip packs its
+    LOCAL hit/decided planes inside shard_map -> two [D*q, shard_n/8]
+    buffers, collective-free like _exact_shard_mask_batch_fn."""
+    key = (kind, has_time, q, mesh, attr)
+    fn = _DUAL_SHARD_MASK_FNS.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec as P
+
+        if kind == "xz":
+            local = _xz_exact_mask_body(has_time, "local", mesh, attr)
+            nrow, nrep = _xz_arg_counts(attr)
+            split = _xz_desc_split
+        else:
+            local = _poly_mask_body(has_time, "local", mesh, attr)
+            nrow, nrep = _poly_arg_counts(has_time, attr)
+            split = _poly_desc_split
+
+        def shard_body(*args):
+            mask_of, descs = split(local, attr, args)
+            hit, dec = jax.vmap(lambda *d: mask_of(d))(*descs)
+            return pack_mask_rows(hit), pack_mask_rows(dec)
+
+        wrapped = shard_map_fn(
+            shard_body,
+            mesh,
+            in_specs=tuple([P(DATA_AXIS)] * nrow + [P()] * nrep),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            check=False,
+        )
+        # collective-free by construction: NOT mesh-gated
+        fn = instrumented_jit(f"{kind}_shard_mask_batch", wrapped)
+        _DUAL_SHARD_MASK_FNS[key] = fn
     return fn
 
 
@@ -509,7 +647,7 @@ def _exact_count_fn(has_time: bool, mode: str, mesh, attr=False):
         def run(*args):
             return jnp.sum(mask(*args), dtype=jnp.int32)
 
-        fn = instrumented_jit("exact_count", run)
+        fn = _mesh_gated(instrumented_jit("exact_count", run), mesh)
         _EXACT_COUNT_FNS[key] = fn
     return fn
 
@@ -545,7 +683,7 @@ def _exact_stat_hist_fn(has_time: bool, mode: str, mesh, u_pad: int):
             hist = jnp.diff(bounds)
             return jnp.concatenate([cnt[None], hist])
 
-        fn = instrumented_jit("exact_stat_hist", run)
+        fn = _mesh_gated(instrumented_jit("exact_stat_hist", run), mesh)
         _EXACT_STAT_FNS[key] = fn
     return fn
 
@@ -619,7 +757,7 @@ def _exact_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
             _, out = jax.lax.scan(step, 0, descs)
             return out
 
-        fn = instrumented_jit("exact_runs_batch", run)
+        fn = _mesh_gated(instrumented_jit("exact_runs_batch", run), mesh)
         _EXACT_RUNS_BATCH_FNS[key] = fn
     return fn
 
@@ -691,7 +829,7 @@ def _exact_packed_batch_fn(has_time: bool, rcap: int, sum_cap: int, q: int,
             )
             return jnp.concatenate([headers.reshape(-1), shared])
 
-        fn = instrumented_jit("exact_packed_batch", run)
+        fn = _mesh_gated(instrumented_jit("exact_packed_batch", run), mesh)
         _EXACT_PACKED_BATCH_FNS[key] = fn
     return fn
 
@@ -736,7 +874,7 @@ def _exact_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
             _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
 
-        fn = instrumented_jit("exact_bitmap_batch", run)
+        fn = _mesh_gated(instrumented_jit("exact_bitmap_batch", run), mesh)
         _EXACT_BITMAP_BATCH_FNS[key] = fn
     return fn
 
@@ -1055,6 +1193,151 @@ class _PendingMaskHits:
         if self._rows is None:
             self._rows = _decode_full_bitmap_rows(
                 self.batch._fetch()[self.i], self.batch.n_rows
+            )
+        return self._rows
+
+
+class _ShardMaskBatch:
+    """One SPMD coalesced mask-batch buffer: u8[D*q, shard_n/8] per-shard
+    packed planes (see _exact_shard_mask_batch_fn), fetched once; shard d
+    / query i slices at [d, i] after the reshape. ``prefetch``-able like
+    _MaskBatch so the coalescer's shared D2H apportions across members.
+    On a multi-process (DCN) mesh _np_local zero-fills the shards this
+    process cannot read, and zero bits decode to no rows — each process
+    resolves exactly its own shards' hits, union across processes."""
+
+    __slots__ = ("buf", "n_rows", "n_shards", "q", "q_real", "shard_n",
+                 "_np", "trace")
+
+    def __init__(self, buf, n_rows: int, n_shards: int, q: int, q_real: int,
+                 shard_n: int, trace=None):
+        self.buf = buf
+        self.n_rows = n_rows  # real (unpadded) segment rows
+        self.n_shards = n_shards
+        self.q = q  # padded query count (the wire layout's stride)
+        self.q_real = q_real
+        self.shard_n = shard_n
+        self._np = None
+        self.trace = trace
+
+    def _fetch(self):
+        if self._np is None:
+            with _shared_fetch_span(self.q_real):
+                t1 = _trace_fetch_begin(self.trace, self.buf)
+                self._np = _np_local(self.buf).reshape(
+                    self.n_shards, self.q, -1
+                )
+                _trace_fetch_end(self.trace, t1)
+            self.buf = None
+        return self._np
+
+
+class _PendingShardMaskHits:
+    """One query's row of an SPMD coalesced mask batch: decode each
+    shard's full-plane bitmap with the native ctz decoder, offset by the
+    shard's row base, concatenate (rows stay sorted — shard bases
+    ascend). No span framing, no capacity escalation — each plane covers
+    every resident row of its shard by construction."""
+
+    __slots__ = ("batch", "i", "_rows")
+
+    def __init__(self, batch: "_ShardMaskBatch", i: int):
+        self.batch = batch
+        self.i = i
+        self._rows: Optional[np.ndarray] = None
+
+    def prefetch(self) -> None:
+        self.batch._fetch()
+
+    def rows(self) -> np.ndarray:
+        if self._rows is None:
+            b = self.batch._fetch()
+            sn = self.batch.shard_n
+            parts = []
+            for d in range(self.batch.n_shards):
+                base = d * sn
+                bound = min(sn, self.batch.n_rows - base)
+                if bound <= 0:
+                    break  # later shards hold only pad rows
+                got = _decode_full_bitmap_rows(b[d, self.i], bound)
+                if len(got):
+                    parts.append(base + got)
+            self._rows = (
+                np.concatenate(parts) if parts
+                else np.empty(0, dtype=np.int64)
+            )
+        return self._rows
+
+
+class _DualMaskBatch:
+    """One coalesced dual-plane (hit/decided) mask-batch buffer pair for
+    the extent/polygon folds: single-device [q, n/8] x2, or per-shard
+    [D*q, shard_n/8] x2 (n_shards=1 IS the single-device case — one
+    class, one fetch/decode path)."""
+
+    __slots__ = ("hit", "dec", "n_rows", "n_shards", "q", "q_real",
+                 "shard_n", "_np", "trace")
+
+    def __init__(self, hit, dec, n_rows: int, n_shards: int, q: int,
+                 q_real: int, shard_n: int, trace=None):
+        self.hit = hit
+        self.dec = dec
+        self.n_rows = n_rows
+        self.n_shards = n_shards
+        self.q = q
+        self.q_real = q_real
+        self.shard_n = shard_n
+        self._np = None
+        self.trace = trace
+
+    def _fetch(self):
+        if self._np is None:
+            with _shared_fetch_span(self.q_real):
+                t1 = _trace_fetch_begin(self.trace, self.hit, self.dec)
+                h = _np_local(self.hit).reshape(self.n_shards, self.q, -1)
+                d = _np_local(self.dec).reshape(self.n_shards, self.q, -1)
+                _trace_fetch_end(self.trace, t1)
+            self._np = (h, d)
+            self.hit = self.dec = None
+        return self._np
+
+
+class _PendingDualMaskHits:
+    """One extent/polygon query's slice of a coalesced dual mask batch:
+    rows() -> (hit_rows, decided_rows), both sorted, decided a subset of
+    hit — the _XZBatchScan resolve contract, full-table planes instead
+    of span windows (no overflow fallback to need)."""
+
+    __slots__ = ("batch", "i", "_rows")
+
+    def __init__(self, batch: "_DualMaskBatch", i: int):
+        self.batch = batch
+        self.i = i
+        self._rows = None
+
+    def prefetch(self) -> None:
+        self.batch._fetch()
+
+    def rows(self):
+        if self._rows is None:
+            h, dc = self.batch._fetch()
+            sn = self.batch.shard_n
+            hits, decs = [], []
+            for d in range(self.batch.n_shards):
+                base = d * sn
+                bound = min(sn, self.batch.n_rows - base)
+                if bound <= 0:
+                    break
+                got = _decode_full_bitmap_rows(h[d, self.i], bound)
+                if len(got):
+                    hits.append(base + got)
+                got = _decode_full_bitmap_rows(dc[d, self.i], bound)
+                if len(got):
+                    decs.append(base + got)
+            empty = np.empty(0, dtype=np.int64)
+            self._rows = (
+                np.concatenate(hits) if hits else empty,
+                np.concatenate(decs) if decs else empty,
             )
         return self._rows
 
@@ -1481,7 +1764,7 @@ def _xz_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
             _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
 
-        fn = instrumented_jit("xz_bitmap_batch", run)
+        fn = _mesh_gated(instrumented_jit("xz_bitmap_batch", run), mesh)
         _XZ_BITMAP_BATCH_FNS[key] = fn
     return fn
 
@@ -1777,7 +2060,7 @@ def _poly_runs_fn(has_time: bool, rcap: int, mode: str, mesh, attr=False):
             hit, decided = mask(*args)
             return _xz_dual_runs(hit, decided, rcap)
 
-        fn = instrumented_jit("poly_runs", run)
+        fn = _mesh_gated(instrumented_jit("poly_runs", run), mesh)
         _POLY_RUNS_FNS[key] = fn
     return fn
 
@@ -1801,7 +2084,7 @@ def _poly_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
             _, out = jax.lax.scan(step, 0, descs)
             return out
 
-        fn = instrumented_jit("poly_runs_batch", run)
+        fn = _mesh_gated(instrumented_jit("poly_runs_batch", run), mesh)
         _POLY_RUNS_BATCH_FNS[key] = fn
     return fn
 
@@ -1819,7 +2102,7 @@ def _poly_packed_fn(has_time: bool, mode: str, mesh, attr=False):
             hit, dec = mask(*args)
             return jnp.concatenate([jnp.packbits(hit), jnp.packbits(dec)])
 
-        fn = instrumented_jit("poly_packed", run)
+        fn = _mesh_gated(instrumented_jit("poly_packed", run), mesh)
         _POLY_PACKED_FNS[key] = fn
     return fn
 
@@ -1844,7 +2127,7 @@ def _poly_bitmap_batch_fn(has_time: bool, span_cap: int, q: int, mode: str,
             _, (headers, bitmaps) = jax.lax.scan(step, 0, descs)
             return headers, bitmaps
 
-        fn = instrumented_jit("poly_bitmap_batch", run)
+        fn = _mesh_gated(instrumented_jit("poly_bitmap_batch", run), mesh)
         _POLY_BITMAP_BATCH_FNS[key] = fn
     return fn
 
@@ -1860,7 +2143,7 @@ def _xz_runs_fn(has_time: bool, rcap: int, mode: str, mesh, attr=False):
             hit, decided = mask(*args)
             return _xz_dual_runs(hit, decided, rcap)
 
-        fn = instrumented_jit("xz_runs", run)
+        fn = _mesh_gated(instrumented_jit("xz_runs", run), mesh)
         _XZ_RUNS_FNS[key] = fn
     return fn
 
@@ -1885,7 +2168,7 @@ def _xz_runs_batch_fn(has_time: bool, rcap: int, q: int, mode: str, mesh,
             _, out = jax.lax.scan(step, 0, descs)
             return out
 
-        fn = instrumented_jit("xz_runs_batch", run)
+        fn = _mesh_gated(instrumented_jit("xz_runs_batch", run), mesh)
         _XZ_RUNS_BATCH_FNS[key] = fn
     return fn
 
@@ -1901,7 +2184,7 @@ def _xz_packed_fn(has_time: bool, mode: str, mesh, attr=False):
             hit, decided = mask(*args)
             return jnp.concatenate([jnp.packbits(hit), jnp.packbits(decided)])
 
-        fn = instrumented_jit("xz_packed", run)
+        fn = _mesh_gated(instrumented_jit("xz_packed", run), mesh)
         _XZ_PACKED_FNS[key] = fn
     return fn
 
@@ -1916,7 +2199,7 @@ def _exact_packed_fn(has_time: bool, mode: str, mesh, attr=False):
         def run(*args):
             return jnp.packbits(mask(*args))
 
-        fn = instrumented_jit("exact_packed", run)
+        fn = _mesh_gated(instrumented_jit("exact_packed", run), mesh)
         _EXACT_PACKED_FNS[key] = fn
     return fn
 
@@ -1930,7 +2213,9 @@ def _knn_fn(k: int, mode: str, mesh):
     pallas_spmd meshes rank per shard (k indices per chip, stacked) — the
     per-tablet partial-result + client-merge shape of the reference's
     distributed kNN, with lax.top_k as the per-chip ranker."""
-    key = (k, mode, mesh if mode == "pallas_spmd" else None)
+    # mesh is ALWAYS in the key: the non-spmd edition's dispatch gate
+    # (and the spmd edition's shard specs) are both per-mesh state
+    key = (k, mode, mesh)
     fn = _KNN_FNS.get(key)
     if fn is None:
 
@@ -1968,9 +2253,13 @@ def _knn_fn(k: int, mode: str, mesh):
                 out_specs=P(DATA_AXIS),
                 check=False,
             )
+            # per-shard top-k is collective-free (axis_index + local
+            # top_k, P(DATA_AXIS) out concatenates without comms)
             fn = instrumented_jit("knn", body)
         else:
-            fn = instrumented_jit("knn", local_topk)
+            # a replicated top_k over row-sharded columns lowers with
+            # cross-shard collectives on a multi-device mesh: gate it
+            fn = _mesh_gated(instrumented_jit("knn", local_topk), mesh)
         _KNN_FNS[key] = fn
     return fn
 
@@ -1985,7 +2274,7 @@ def _packed_fn(kind: str, mode: str, mesh):
         def run(*args):
             return jnp.packbits(mask(*args))
 
-        fn = instrumented_jit(f"packed.{kind}", run)
+        fn = _mesh_gated(instrumented_jit(f"packed.{kind}", run), mesh)
         _PACKED_FNS[key] = fn
     return fn
 
@@ -2955,13 +3244,23 @@ class DeviceSegment:
         return out
 
     def dispatch_exact_mask_batch(
-        self, descs: Sequence[tuple], has_time: bool
-    ) -> List["_PendingMaskHits"]:
+        self, descs: Sequence[tuple], has_time: bool,
+        attr: Optional[str] = None, attr_kind: str = "member",
+    ) -> list:
         """Q exact predicates, ONE full-table sweep, ONE packed
         u8[q, n/8] bitmap back — no span framing, no RLE, no capacity
         escalation (the coalescer's kernel; see _exact_mask_batch_fn).
-        ``descs`` = [(box_np u32[8], win_np u32[4]|None)], padded to the
-        pow2 query bucket by repeating the last descriptor."""
+        ``descs`` = [(box_np u32[8], win_np u32[4]|None)] — or, with
+        ``attr`` set, [(box, win, payload)]: the rank-code attribute
+        plane ANDs into the stacked mask exactly like the RLE batch
+        editions (the coalescer's attr fold). Padded to the pow2 query
+        bucket by repeating the last descriptor.
+
+        On a multi-device mesh the PER-SHARD edition dispatches instead
+        (_exact_shard_mask_batch_fn): each chip packs its local plane
+        inside shard_map with no collective anywhere — a coalesced
+        group on an SPMD mesh is rendezvous-safe by construction, not
+        by fencing."""
         mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
         q = len(descs)
         qpad = _pow2_at_least(q, 4)
@@ -2974,14 +3273,113 @@ class DeviceSegment:
             wins_dev = replicate(self.mesh, wins_np)
         else:
             wins_dev = None
-        args = self._exact_args(boxes_dev, wins_dev, has_time)
+        is_attr, codes_dev, qcodes_dev = self._attr_batch_vectors(
+            attr, attr_kind,
+            [d[2] for d in descs] if attr is not None else None, qpad,
+        )
+        args = self._exact_args(
+            boxes_dev, wins_dev, has_time, codes_dev, qcodes_dev
+        )
+        n_sh = self.mesh.devices.size
+        if n_sh > 1:
+            btrace = _batch_trace(self, args, qpad, "mask_shard", 0)
+            buf = _exact_shard_mask_batch_fn(
+                has_time, qpad, self.mesh, is_attr
+            )(*args)
+            if btrace is not None:
+                btrace["out_bytes"] = int(buf.nbytes)
+            _start_d2h(buf)
+            batch = _ShardMaskBatch(
+                buf, self.n, n_sh, qpad, q, self.shard_n(), trace=btrace
+            )
+            return [_PendingShardMaskHits(batch, i) for i in range(q)]
         btrace = _batch_trace(self, args, qpad, "mask", 0)
-        buf = _exact_mask_batch_fn(has_time, qpad, mode, self.mesh)(*args)
+        buf = _exact_mask_batch_fn(
+            has_time, qpad, mode, self.mesh, is_attr
+        )(*args)
         if btrace is not None:
             btrace["out_bytes"] = int(buf.nbytes)
         _start_d2h(buf)
         batch = _MaskBatch(buf, self.n, q, trace=btrace)
         return [_PendingMaskHits(batch, i) for i in range(q)]
+
+    def dispatch_dual_mask_batch(
+        self, kind: str, descs: Sequence[tuple], has_time: bool,
+        attr: Optional[str] = None, attr_kind: str = "member",
+    ) -> List["_PendingDualMaskHits"]:
+        """Dual-plane (hit/decided) edition of dispatch_exact_mask_batch
+        for the coalescer's extent ('xz') and banded-polygon ('poly')
+        folds: Q stacked descriptors, ONE sweep, two full-table packed
+        planes per query. ``descs`` = [(qbox u32[12], win u32[4]
+        [, payload])] for 'xz', [(edges f32[E,4], box u32[8],
+        win u32[4]|None [, payload])] for 'poly' (edge counts pad to the
+        batch's shared pow2 bucket with degenerate zero edges). Resolves
+        through _XZBatchScan — decided rows final, the ring/band host-
+        certified — identical to the span-framed batch paths minus the
+        framing. Multi-device meshes take the per-shard collective-free
+        kernel (_dual_shard_mask_batch_fn)."""
+        mode = "spmd" if _mask_mode(self.mesh) == "pallas_spmd" else "local"
+        q = len(descs)
+        qpad = _pow2_at_least(q, 4)
+        padded = list(descs) + [descs[-1]] * (qpad - q)
+        if kind == "poly":
+            ecap = _pow2_at_least(max(len(d[0]) for d in descs), 8)
+
+            def pad_edges(e):
+                out = np.zeros((ecap, 4), np.float32)
+                out[: len(e)] = e
+                return out
+
+            edges_np = np.stack([pad_edges(d[0]) for d in padded])
+            boxes_np = np.stack([d[1] for d in padded])
+            wins_np = np.stack(
+                [
+                    d[2] if d[2] is not None else np.zeros(4, np.uint32)
+                    for d in padded
+                ]
+            )
+            is_attr, codes_dev, qcodes_dev = self._attr_batch_vectors(
+                attr, attr_kind,
+                [d[3] for d in descs] if attr is not None else None, qpad,
+            )
+            args = self._poly_args(
+                replicate(self.mesh, edges_np),
+                replicate(self.mesh, boxes_np),
+                replicate(self.mesh, wins_np),
+                has_time, codes_dev, qcodes_dev,
+            )
+        else:
+            boxes_np = np.stack([d[0] for d in padded])
+            wins_np = np.stack([d[1] for d in padded])
+            is_attr, codes_dev, qcodes_dev = self._attr_batch_vectors(
+                attr, attr_kind,
+                [d[2] for d in descs] if attr is not None else None, qpad,
+            )
+            args = self._xz_args(
+                replicate(self.mesh, boxes_np),
+                replicate(self.mesh, wins_np),
+                has_time, codes_dev, qcodes_dev,
+            )
+        n_sh = self.mesh.devices.size
+        if n_sh > 1:
+            btrace = _batch_trace(self, args, qpad, f"mask_shard_{kind}", 0)
+            hit, dec = _dual_shard_mask_batch_fn(
+                kind, has_time, qpad, self.mesh, is_attr
+            )(*args)
+            shard_n = self.shard_n()
+        else:
+            btrace = _batch_trace(self, args, qpad, f"mask_{kind}", 0)
+            hit, dec = _dual_mask_batch_fn(
+                kind, has_time, qpad, mode, self.mesh, is_attr
+            )(*args)
+            shard_n = self.n_padded
+        if btrace is not None:
+            btrace["out_bytes"] = int(hit.nbytes) + int(dec.nbytes)
+        _start_d2h(hit, dec)
+        batch = _DualMaskBatch(
+            hit, dec, self.n, n_sh, qpad, q, shard_n, trace=btrace
+        )
+        return [_PendingDualMaskHits(batch, i) for i in range(q)]
 
     def load_poly(self, table: IndexTable) -> bool:
         """Exact limbs + f32 coords for the banded polygon path (point
@@ -3523,6 +3921,17 @@ class _XZBatchScan:
         self.exact = True
         self.seek = True
 
+    def prefetch(self) -> None:
+        """Resolve prefetchable shared buffers NOW (the _PendingScan
+        contract): a coalesced dual-mask group's shared D2H lands in the
+        leader's cost collector and apportions across members instead of
+        hitting the first resolver's receipt. Span-framed pendings have
+        no hook and resolve lazily as before."""
+        for _seg, ph in self.pending:
+            fn = getattr(ph, "prefetch", None)
+            if fn is not None:
+                fn()
+
     def __iter__(self):
         for seg, ph in self.pending:
             hit_rows, dec_rows = ph.rows()
@@ -3750,7 +4159,7 @@ class _HostSeekScan:
 _DEVSEEK_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
-def _devseek_fn(has_time: bool, n_iv: int, cand_cap: int):
+def _devseek_fn(has_time: bool, n_iv: int, cand_cap: int, mesh=None):
     """Candidate-interval exact test on device.
 
     The device-assisted seek protocol (the round-3 answer to the tserver
@@ -3762,7 +4171,7 @@ def _devseek_fn(has_time: bool, n_iv: int, cand_cap: int):
     query's own exact predicate, and returns a packed bitmap over the
     candidate space (cand_cap/8 bytes — the "~32KB back" transfer).
     Per-query device work is O(candidates), not O(N)."""
-    key = (has_time, n_iv, cand_cap)
+    key = (has_time, n_iv, cand_cap, mesh)
     fn = _DEVSEEK_FNS.get(key)
     if fn is not None:
         return fn
@@ -3791,7 +4200,10 @@ def _devseek_fn(has_time: bool, n_iv: int, cand_cap: int):
             m = exact_st_mask(gxh, gxl, gyh, gyl, gvalid, box)
         return jnp.packbits(m)
 
-    fn = instrumented_jit("devseek", run)
+    # the candidate gathers from row-sharded mirrors lower with
+    # cross-device collectives on a multi-device mesh: gated like every
+    # other collective-bearing kernel (the rendezvous fence)
+    fn = _mesh_gated(instrumented_jit("devseek", run), mesh)
     _DEVSEEK_FNS[key] = fn
     return fn
 
@@ -3853,7 +4265,8 @@ def _str_successor(s: str):
 _DEVSEEK_XZ_FNS: Dict[tuple, "jax.stages.Wrapped"] = {}
 
 
-def _devseek_xz_fn(n_iv: int, cand_cap: int, has_time: bool = False):
+def _devseek_xz_fn(n_iv: int, cand_cap: int, has_time: bool = False,
+                   mesh=None):
     """Extent (xz2/xz3) device-assisted seek: exact f64 envelope tests on
     the candidates via sort-key limb compares (the device edition of
     native/seekscan.cpp geomesa_env_seek_scan), plus — for xz3 — the
@@ -3863,7 +4276,7 @@ def _devseek_xz_fn(n_iv: int, cand_cap: int, has_time: bool = False):
     exact predicate: envelope inside a rectangle query, or an isrect
     feature overlapping one). Only hit & ~decided rows — the boundary-
     straddling ring — need the host's per-geometry test."""
-    key = (n_iv, cand_cap, has_time)
+    key = (n_iv, cand_cap, has_time, mesh)
     fn = _DEVSEEK_XZ_FNS.get(key)
     if fn is not None:
         return fn
@@ -3918,7 +4331,9 @@ def _devseek_xz_fn(n_iv: int, cand_cap: int, has_time: bool = False):
         decided = hit & rect & ~placeholder & (inside | ir)
         return jnp.concatenate([jnp.packbits(hit), jnp.packbits(decided)])
 
-    fn = instrumented_jit("devseek_xz", run)
+    # sharded-mirror candidate gathers: same rendezvous fence as the
+    # point edition above
+    fn = _mesh_gated(instrumented_jit("devseek_xz", run), mesh)
     _DEVSEEK_XZ_FNS[key] = fn
     return fn
 
@@ -4184,7 +4599,7 @@ class TpuScanExecutor:
         for seg, starts, lens, tot, n_iv, cand, starts_p, lens_p in (
             self._candidate_batches(dev, per_block)
         ):
-            fn = _devseek_xz_fn(n_iv, cand, has_time)
+            fn = _devseek_xz_fn(n_iv, cand, has_time, mesh=self.mesh)
             valid = seg.valid
             th = tl = win = qbox_dev  # unused placeholders when no time
             if has_time:
@@ -4239,7 +4654,7 @@ class TpuScanExecutor:
         for seg, starts, lens, tot, n_iv, cand, starts_p, lens_p in (
             self._candidate_batches(dev, per_block)
         ):
-            fn = _devseek_fn(has_time, n_iv, cand)
+            fn = _devseek_fn(has_time, n_iv, cand, mesh=self.mesh)
             valid = seg.tvalid if has_time else seg.valid
             th = seg.tk_hi if has_time else seg.xk_hi  # unused when no time
             tl = seg.tk_lo if has_time else seg.xk_lo
@@ -4650,82 +5065,203 @@ class TpuScanExecutor:
                 self.breaker.cancel_probe()
             raise
 
+    @staticmethod
+    def _spmd_coalesce_enabled() -> bool:
+        """geomesa.batch.spmd.enabled — the multi-chip stacked-mask kill
+        switch: off routes every coalesced plan on an SPMD mesh to the
+        dispatch_many batch paths (per-plan ``coalesce/spmd_disabled``
+        declines), identical answers. Single-device meshes ignore it."""
+        from geomesa_tpu.utils.config import BATCH_SPMD_ENABLED
+
+        return bool(BATCH_SPMD_ENABLED.to_bool())
+
+    @staticmethod
+    def _attr_codes_loaded(dev, extra) -> bool:
+        """Group-level attr-plane load check shared by the coalesced
+        mask folds: ``extra`` is None (no attr plane) or (attr, kind)."""
+        if extra is None:
+            return True
+        attr, akind = extra
+        return all(
+            seg.load_attr_codes(attr) for seg in dev.segments
+        ) and (
+            akind != "vocabmask"
+            or all(seg.attr_vocab_ok(attr) for seg in dev.segments)
+        )
+
     def dispatch_coalesced(self, items: Sequence[Tuple[IndexTable, QueryPlan]]):
         """Dispatch a COALESCED query group; returns {id(plan): scan | None}.
 
         The admission-point coalescer's seam (parallel/batch.py): plans
-        whose full filter reduces to one exact box(+window) predicate on
-        the same z-index table stack their compiled descriptors into ONE
-        [N, rows] packed-mask sweep per segment (dispatch_exact_mask_batch
-        — no per-query RLE/span framing, the whole point of coalescing),
-        and everything else takes exactly the dispatch_many path a
-        query_many batch would. Same breaker envelope as dispatch_many:
-        an open circuit answers the whole group from the host path."""
+        whose full filter the device can evaluate exactly stack their
+        compiled descriptors into ONE packed-mask sweep per segment — no
+        per-query RLE/span framing, the whole point of coalescing. Four
+        editions share the layout: plain box(+window) predicates, the
+        rank-code attribute plane, extent envelopes (xz), and banded
+        polygons — the latter two as dual hit/decided planes resolving
+        through _XZBatchScan. On a single chip that is one [N, rows]
+        sweep (dispatch_exact_mask_batch); on an SPMD mesh each chip
+        sweeps its RESIDENT rows inside shard_map with no collective
+        anywhere (_exact_shard_mask_batch_fn — rendezvous-safe by
+        construction) and the host stitches shard planes by row offset.
+
+        Plans that cannot ride a stacked sweep decline with a PER-PLAN
+        reason code (``decision("coalesce", <reason>)`` — /debug/plans
+        explains why a member missed the sweep):
+
+        * ``seek_cheaper``     the cost chooser picked a selective host
+                               seek — cheaper than ANY full sweep
+        * ``kernel_ineligible``no mask edition matches the plan's shape
+        * ``lone_member``      nothing shares its group (stacking gains
+                               nothing; the single dispatch answers)
+        * ``mirror_unloadable``a segment lacks the mirror/codes the
+                               edition needs
+        * ``spmd_disabled``    geomesa.batch.spmd.enabled=0 on a
+                               multi-chip mesh
+
+        Declined plans take exactly the dispatch_many path a query_many
+        batch would. Same breaker envelope as dispatch_many: an open
+        circuit answers the whole group from the host path."""
         out: Dict[int, object] = {}
         if not self.breaker.allow():
             trace.event("breaker.short_circuit", breaker=self.breaker.name)
             return out
         try:
-            mask_groups: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
+            # (id(table), has_time, extra) -> (table, has_time, extra,
+            # [(pid, plan, desc)]); extra = None | (attr, kind)
+            mask_groups: Dict[tuple, tuple] = {}
+            # ("xz"|"poly", id(table), has_time, extra) -> (kind, table,
+            # has_time, extra, [(pid, plan, desc, geom, node)])
+            dual_groups: Dict[tuple, tuple] = {}
             rest: List[Tuple[IndexTable, QueryPlan]] = []
             seen: set = set()
-            # the stacked-mask kernel compiles for the single-device
-            # layout; multi-chip meshes keep the shard-extract batch
-            # paths of dispatch_many (the `rest` route below)
-            single_device = self.mesh.devices.size == 1
-            if not single_device and items:
-                # one reason-coded record per group, not per member
-                audit.decision(
-                    "coalesce", "multi_chip",
-                    devices=int(self.mesh.devices.size), n=len(items),
-                )
+            # plans whose seek probe already ran (and declined) here:
+            # the rest route must not pay the O(blocks x ranges) cost
+            # probe a second time in _dispatch_many_batches
+            seek_probed: set = set()
+            reg = devstats_metrics()
+            reg.set_gauge(
+                "batch.coalesce.devices", int(self.mesh.devices.size)
+            )
+            spmd_ok = (
+                self.mesh.devices.size == 1 or self._spmd_coalesce_enabled()
+            )
             for table, plan in items:
                 if id(plan) in seen:
                     continue
                 seen.add(id(plan))
                 deadline.check("device.dispatch")
-                if not single_device or not self._scan_eligible(table, plan):
+                if not spmd_ok:
+                    audit.decision(
+                        "coalesce", "spmd_disabled",
+                        devices=int(self.mesh.devices.size),
+                    )
                     rest.append((table, plan))
                     continue
                 seek = self._seek_scan(table, plan)
+                seek_probed.add(id(plan))
                 if seek is not None:
                     # the cost chooser picked a selective host seek:
                     # cheaper than ANY full sweep, coalesced or not
+                    audit.decision(
+                        "coalesce", "seek_cheaper", index=table.index.name
+                    )
                     out[id(plan)] = seek
                     continue
-                # NOT gated on _exact_device_enabled (unlike the single/
-                # RLE-batch exact paths): that gate exists because on the
-                # CPU backend the wider limb columns cost more than the
-                # host post-filter saves — but the stacked mask also
-                # deletes the per-query RLE/span extraction, which IS the
-                # dominant sweep cost there, so coalesced stacking wins
-                # on every backend
-                shape = self._exact_predicate_shape(table, plan)
-                desc = None if shape is None else self._shape_limbs(shape)
-                if desc is None:
-                    rest.append((table, plan))
+                if self._scan_eligible(table, plan):
+                    # NOT gated on _exact_device_enabled (unlike the
+                    # single/RLE-batch exact paths): that gate exists
+                    # because on the CPU backend the wider limb columns
+                    # cost more than the host post-filter saves — but
+                    # the stacked mask also deletes the per-query RLE/
+                    # span extraction, which IS the dominant sweep cost
+                    # there, so coalesced stacking wins on every backend
+                    # (the attr/poly descs take gated=False for the same
+                    # reason)
+                    shape = self._exact_predicate_shape(table, plan)
+                    desc = None if shape is None else self._shape_limbs(shape)
+                    if desc is not None:
+                        has_time = desc[1] is not None
+                        key = (id(table), has_time, None)
+                        if key not in mask_groups:
+                            mask_groups[key] = (table, has_time, None, [])
+                        mask_groups[key][3].append((id(plan), plan, desc))
+                        continue
+                    adesc = self._attr_batch_desc(table, plan, gated=False)
+                    if adesc is not None:
+                        attr, akind, d = adesc
+                        has_time = d[1] is not None
+                        key = (id(table), has_time, (attr, akind))
+                        if key not in mask_groups:
+                            mask_groups[key] = (
+                                table, has_time, (attr, akind), [],
+                            )
+                        mask_groups[key][3].append((id(plan), plan, d))
+                        continue
+                    poly = self._poly_batch_desc(table, plan, gated=False)
+                    if poly is not None:
+                        edges, box_np, win_np, has_time, geom, node, ai = poly
+                        extra = None if ai is None else (ai[0], ai[1])
+                        desc = (
+                            (edges, box_np, win_np)
+                            if ai is None
+                            else (edges, box_np, win_np, ai[2])
+                        )
+                        key = ("poly", id(table), has_time, extra)
+                        if key not in dual_groups:
+                            dual_groups[key] = (
+                                "poly", table, has_time, extra, [],
+                            )
+                        dual_groups[key][4].append(
+                            (id(plan), plan, desc, geom, node)
+                        )
+                        continue
+                xz = self._xz_batch_desc(table, plan)
+                if xz is not None:
+                    qbox, win, has_time, geom, node, ai = xz
+                    extra = None if ai is None else (ai[0], ai[1])
+                    desc = (
+                        (qbox, win) if ai is None else (qbox, win, ai[2])
+                    )
+                    key = ("xz", id(table), has_time, extra)
+                    if key not in dual_groups:
+                        dual_groups[key] = ("xz", table, has_time, extra, [])
+                    dual_groups[key][4].append(
+                        (id(plan), plan, desc, geom, node)
+                    )
                     continue
-                has_time = desc[1] is not None
-                key = (id(table), has_time)
-                if key not in mask_groups:
-                    mask_groups[key] = (table, has_time, [])
-                mask_groups[key][2].append((id(plan), plan, desc))
-            for table, has_time, lst in mask_groups.values():
+                audit.decision(
+                    "coalesce", "kernel_ineligible", index=table.index.name
+                )
+                rest.append((table, plan))
+            stacked = 0
+
+            def decline_group(table, lst, reason: str):
+                audit.decision("coalesce", reason, n=len(lst))
+                rest.extend((table, item[1]) for item in lst)
+
+            for table, has_time, extra, lst in mask_groups.values():
                 dev = self.device_index(table)
-                if len(lst) < 2 or not dev.segments or not all(
-                    seg.load_exact(table) for seg in dev.segments
-                ):
-                    # a lone member (or an unloadable mirror) gains
-                    # nothing from the mask layout: the ordinary batch/
-                    # single dispatch answers
-                    rest.extend((table, plan) for _pid, plan, _d in lst)
+                if len(lst) < 2:
+                    # a lone member gains nothing from the mask layout:
+                    # the ordinary batch/single dispatch answers
+                    decline_group(table, lst, "lone_member")
                     continue
+                if not dev.segments or not all(
+                    seg.load_exact(table) for seg in dev.segments
+                ) or not self._attr_codes_loaded(dev, extra):
+                    decline_group(table, lst, "mirror_unloadable")
+                    continue
+                attr = None if extra is None else extra[0]
+                akind = "member" if extra is None else extra[1]
                 for i in range(0, len(lst), self.BATCH_MAX):
                     chunk = lst[i : i + self.BATCH_MAX]
                     deadline.check("device.dispatch")
                     descs = [d for _pid, _p, d in chunk]
                     per_seg = [
-                        seg.dispatch_exact_mask_batch(descs, has_time)
+                        seg.dispatch_exact_mask_batch(
+                            descs, has_time, attr=attr, attr_kind=akind
+                        )
                         for seg in dev.segments
                     ]
                     for qi, (pid, _plan, _d) in enumerate(chunk):
@@ -4736,8 +5272,59 @@ class TpuScanExecutor:
                             ],
                             exact=True,
                         )
+                    stacked += len(chunk)
+            for kind, table, has_time, extra, lst in dual_groups.values():
+                dev = self.device_index(table)
+                if len(lst) < 2:
+                    decline_group(table, lst, "lone_member")
+                    continue
+                if kind == "poly":
+                    loaded = bool(dev.segments) and all(
+                        seg.load_poly(table) for seg in dev.segments
+                    )
+                else:
+                    loaded = bool(dev.segments) and all(
+                        seg.load_exact_xz(table) for seg in dev.segments
+                    ) and not (
+                        has_time
+                        and any(seg.xz_tk is None for seg in dev.segments)
+                    )
+                if not loaded or not self._attr_codes_loaded(dev, extra):
+                    decline_group(table, lst, "mirror_unloadable")
+                    continue
+                attr = None if extra is None else extra[0]
+                akind = "member" if extra is None else extra[1]
+                for i in range(0, len(lst), self.BATCH_MAX):
+                    chunk = lst[i : i + self.BATCH_MAX]
+                    deadline.check("device.dispatch")
+                    descs = [item[2] for item in chunk]
+                    per_seg = [
+                        seg.dispatch_dual_mask_batch(
+                            kind, descs, has_time,
+                            attr=attr, attr_kind=akind,
+                        )
+                        for seg in dev.segments
+                    ]
+                    for qi, item in enumerate(chunk):
+                        pid, geom, node = item[0], item[3], item[4]
+                        out[pid] = _XZBatchScan(
+                            [
+                                (seg, phs[qi])
+                                for seg, phs in zip(dev.segments, per_seg)
+                            ],
+                            node,
+                            geom,
+                        )
+                    stacked += len(chunk)
+            # the stacked-vs-rest split feeds the /debug/device coalesce
+            # block (the timeline/SLO layer's "coalescer reach" signal)
+            if stacked:
+                reg.inc("batch.coalesce.plans.stacked", stacked)
             if rest:
-                self._dispatch_many_batches(rest, out)
+                reg.inc("batch.coalesce.plans.rest", len(rest))
+                self._dispatch_many_batches(
+                    rest, out, seek_declined=seek_probed
+                )
             return out
         except Exception as e:
             from geomesa_tpu.utils.audit import QueryTimeout
@@ -4749,10 +5336,14 @@ class TpuScanExecutor:
             raise
 
     def _dispatch_many_batches(
-        self, items: Sequence[Tuple[IndexTable, QueryPlan]], out: Dict[int, object]
+        self, items: Sequence[Tuple[IndexTable, QueryPlan]],
+        out: Dict[int, object], seek_declined=frozenset(),
     ):
         """dispatch_many's body, split out so the breaker wrapper above
-        can resolve the half-open probe slot on every exit path."""
+        can resolve the half-open probe slot on every exit path.
+        ``seek_declined`` carries plan ids whose seek cost probe already
+        ran (and declined) in dispatch_coalesced — the rest route skips
+        re-probing them."""
         seen: set = set()
         batchable: Dict[tuple, Tuple[IndexTable, bool, list]] = {}
         attr_batchable: Dict[tuple, Tuple[IndexTable, bool, str, list]] = {}
@@ -4763,7 +5354,10 @@ class TpuScanExecutor:
                 continue
             seen.add(id(plan))
             deadline.check("device.dispatch")
-            seek = self._seek_scan(table, plan)
+            seek = (
+                None if id(plan) in seek_declined
+                else self._seek_scan(table, plan)
+            )
             if seek is not None:
                 out[id(plan)] = seek
                 continue
@@ -5074,7 +5668,8 @@ class TpuScanExecutor:
                         geom,
                     )
 
-    def _poly_batch_desc(self, table: IndexTable, plan: QueryPlan):
+    def _poly_batch_desc(self, table: IndexTable, plan: QueryPlan,
+                         gated: bool = True):
         """(edges f32[E,4], box u32[8], win u32[4]|None, has_time, geom,
         node, attr_info) when this point z-index plan's FULL filter is
         one non-rect INTERSECTS(polygon) on the default geometry (+ z3
@@ -5083,8 +5678,10 @@ class TpuScanExecutor:
         rank-code test ANDs into the hit plane so the band ring only
         carries attr-passing rows) — the banded-raycast batch
         descriptor; None otherwise. Same GEOMESA_EXACT_DEVICE gate as
-        the box path (the kernel rides the exact limb columns)."""
-        if not self._exact_device_enabled():
+        the box path (the kernel rides the exact limb columns);
+        ``gated=False`` skips it — the coalescer's mask fold wins on
+        every backend (see _attr_batch_desc)."""
+        if gated and not self._exact_device_enabled():
             return None
         if table.index.name not in ("z2", "z3"):
             return None
@@ -5319,7 +5916,8 @@ class TpuScanExecutor:
             return None
         return self._shape_limbs(shape)
 
-    def _attr_batch_desc(self, table: IndexTable, plan: QueryPlan):
+    def _attr_batch_desc(self, table: IndexTable, plan: QueryPlan,
+                         gated: bool = True):
         """(attr_name, kind, (box_limbs, win_limbs|None, payload)) when
         the plan's FULL filter is one box(+window) AND attribute
         predicates on exactly ONE eligible attribute that the unified
@@ -5337,8 +5935,16 @@ class TpuScanExecutor:
         (code order == value order; null/NaN rank -1, which IS NULL's
         [-1, -1] interval selects). Eligible attribute types: String
         (non-json), Integer, Long, Float, Double, Date (the default dtg
-        stays with the window plane)."""
-        if not self._exact_device_enabled():
+        stays with the window plane).
+
+        ``gated=False`` skips the GEOMESA_EXACT_DEVICE backend gate —
+        the coalescer's posture: that gate exists because the wider limb
+        columns lose to the host post-filter on the CPU backend, but the
+        stacked MASK layout also deletes the per-query RLE/span
+        extraction (the dominant cost there), so coalesced stacking
+        wins on every backend (same rationale as the plain shape in
+        dispatch_coalesced)."""
+        if gated and not self._exact_device_enabled():
             return None
         if table.index.name not in ("z2", "z3"):
             return None

@@ -8,7 +8,8 @@ device set — the layout code here is identical single-chip and pod-scale.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import threading
+from typing import Dict, Optional, Sequence
 
 import jax
 import numpy as np
@@ -18,6 +19,76 @@ from geomesa_tpu.utils import deadline, faults, trace
 from geomesa_tpu.utils.devstats import count_h2d, instrumented_jit
 
 DATA_AXIS = "shards"
+
+# per-device-set dispatch gates (see dispatch_gate): one entry per
+# distinct multi-device set, shared across every Mesh built over it
+_DISPATCH_GATES: Dict[tuple, threading.RLock] = {}
+_DISPATCH_GATES_LOCK = threading.Lock()
+
+
+def dispatch_gate(mesh) -> Optional[threading.RLock]:
+    """The per-mesh dispatch gate: at most ONE collective-bearing XLA
+    program in flight per device set.
+
+    XLA's collective rendezvous assumes programs reach every
+    participating device in one global order; two host threads each
+    launching a program with collectives (the all-gather of
+    ``executor._gathered``, a cross-shard ``jnp.sum`` reduction) onto
+    the SAME multi-device mesh can interleave their launches and
+    deadlock the rendezvous — the hazard PR 9's concurrency tests
+    surfaced with concurrent SOLO queries. The fence: callers hold this
+    gate from launch until the program's outputs are READY, so no
+    collective of one program can still be pending when the next
+    launches. Keyed by the underlying device set (not the Mesh object),
+    so every Mesh built over the same chips shares one gate; re-entrant
+    so a gated kernel may compose gated helpers.
+
+    Returns None — no gating — for single-device meshes (nothing to
+    rendezvous) and under ``GEOMESA_SPMD_GATE=0`` (A/B escape hatch;
+    shipping code must treat the gate as always on). Collective-free
+    kernels (the shard_map shard-extract and stacked-mask editions,
+    whose bodies contain no cross-shard communication) never consult
+    the gate at all — that layout is the other half of the
+    rendezvous-safety contract."""
+    import os
+
+    if mesh is None or getattr(mesh, "devices", np.empty(0)).size <= 1:
+        return None
+    if os.environ.get("GEOMESA_SPMD_GATE", "1") == "0":
+        return None
+    key = tuple(
+        (getattr(d, "platform", "?"), getattr(d, "id", id(d)))
+        for d in mesh.devices.flat
+    )
+    with _DISPATCH_GATES_LOCK:
+        gate = _DISPATCH_GATES.get(key)
+        if gate is None:
+            gate = _DISPATCH_GATES[key] = threading.RLock()
+    return gate
+
+
+def gated(fn, mesh):
+    """Wrap a jitted multi-device execution in the mesh's dispatch gate
+    (see ``dispatch_gate``): the call holds the gate until its outputs
+    are READY, so no collective of this program can still be pending
+    when another thread launches the next one. Single-device meshes
+    (and ``GEOMESA_SPMD_GATE=0``) return ``fn`` unchanged — zero
+    overhead exactly where there is nothing to rendezvous."""
+    gate = dispatch_gate(mesh)
+    if gate is None:
+        return fn
+
+    def call(*args, **kwargs):
+        with gate:
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            return out
+
+    call.__name__ = f"mesh_gated[{getattr(fn, '__name__', 'fn')}]"
+    for shared in ("_jitted", "_devstats"):
+        if hasattr(fn, shared):
+            setattr(call, shared, getattr(fn, shared))
+    return call
 
 
 def force_cpu_platform(min_devices: int = 0):

@@ -459,10 +459,11 @@ class ShardedDataStore(TpuDataStore):
     # device sweeps actually execute, coalesce their own admitted scans.
     COALESCE_QUERIES = False
     # the coordinator's LOCAL tables are intentionally empty (rows live
-    # in the shard workers), so query_stream must not scan them
-    # incrementally — it streams via the overridden _execute fan-out
-    # (gather, then chunk: correct answers, no first-byte win; per-shard
-    # incremental merge is a named ROADMAP follow-up)
+    # in the shard workers), so query_stream must not scan them — it
+    # streams per-shard partial batches incrementally instead
+    # (_iter_stream_shard_cols over _scatter_gather_iter: each group's
+    # columns flush the moment its outcome is final; sort/sampling/
+    # transform queries still materialize-then-chunk)
     STREAMS_LOCAL_PARTS = False
 
     def __init__(
@@ -807,6 +808,33 @@ class ShardedDataStore(TpuDataStore):
         """SCATTER + GATHER with hedging, breaker-guarded failover, and
         the crisp partial-result policy. Returns one scan result per
         group (sorted by group id) or raises — never a subset."""
+        results: Dict[int, Dict[str, Any]] = {}
+        for gid, res in self._scatter_gather_iter(name, wq, groups, outcomes):
+            results[gid] = res
+        return [results[gid] for gid in sorted(results)]
+
+    def _scatter_gather_iter(
+        self,
+        name: str,
+        wq: Query,
+        groups: Dict[int, List[str]],
+        outcomes: Dict[str, Dict[str, Any]],
+    ):
+        """The generator edition of SCATTER + GATHER: yields
+        ``(gid, result)`` the moment a group's outcome is FINAL — its
+        scan succeeded, its hedge race (if any) was settled at win time,
+        and nothing can roll it back (failover only ever replaces a
+        FAILED attempt; a recorded success discards every late sibling).
+        This is the incremental release point the sharded
+        ``query_stream`` builds on: a yielded group's rows are safe to
+        hand to the consumer immediately, while slower shards keep
+        scanning. A failure of ANY later group raises crisply
+        (``QueryTimeout``/``ShardUnavailable``) BEFORE the generator is
+        exhausted, so a partial gather can never masquerade as a
+        complete stream — the no-truncated-results invariant, streamed.
+        All the robustness machinery (hedging, breaker-guarded
+        failover, per-shard deadline slices, cooperative cancellation on
+        abandonment) is the materialized path's, unchanged."""
         dl = deadline.ambient()
         live: Dict[Any, tuple] = {}  # future -> (gid, _Attempt)
         inflight: Dict[int, List[_Attempt]] = {gid: [] for gid in groups}
@@ -985,6 +1013,7 @@ class ShardedDataStore(TpuDataStore):
                 f"{self.placement.chain(gid)} (last: {type(exc).__name__}: {exc})"
             )
 
+        released: Set[int] = set()
         try:
             for gid in groups:
                 outcome(gid)
@@ -1011,6 +1040,14 @@ class ShardedDataStore(TpuDataStore):
                     fatal = resolve(fut)
                     if fatal is not None:
                         raise fatal
+                # release every group resolve() just finalized: its
+                # result can no longer be rolled back (a consumer
+                # closing the generator mid-stream unwinds through the
+                # abort_all below, poisoning the still-running scans)
+                for gid in list(results):
+                    if gid not in released:
+                        released.add(gid)
+                        yield gid, results[gid]
                 # hedge evaluation: a shard lagging past the quantile of
                 # its completed siblings re-issues to its replica chain.
                 # ONE hedge decision per group — a refused hedge (no
@@ -1053,7 +1090,6 @@ class ShardedDataStore(TpuDataStore):
             raise
         # stragglers (cancelled hedge losers) may still be running; they
         # were cancelled at win time and their results are discarded
-        return [results[gid] for gid in sorted(results)]
 
     # -- merge ---------------------------------------------------------------
 
@@ -1076,6 +1112,51 @@ class ShardedDataStore(TpuDataStore):
             columns = RetryPolicy("shard.merge", max_attempts=3).call(merge_once)
             columns = _dedupe_by_fid(columns)
             return self._finish(ft, query, plan, columns)
+
+    # -- incremental streaming -----------------------------------------------
+
+    def _iter_stream_shard_cols(self, name: str, ft, query: Query, plan, t0):
+        """The sharded ``query_stream`` seam (store/datastore.py
+        ``_stream_gen``): a generator of per-shard-group column dicts,
+        each yielded the moment its group's outcome is FINAL
+        (``_scatter_gather_iter`` — a success can no longer be rolled
+        back by failover or a hedge race), so the first Arrow batch
+        flushes while slower shards are still scanning instead of
+        gather-then-chunk. Crispness is inherited: any group that
+        exhausts its placement chain (or the query budget) raises
+        ``ShardUnavailable``/``QueryTimeout`` out of the generator
+        BEFORE it is exhausted — the consumer can never mistake a
+        partial gather for a complete stream. The per-shard outcome
+        table still lands on the query's root span. None (base stores
+        / ``geomesa.stream.shard.incremental=0``) keeps the
+        materialize-then-chunk fallback."""
+        from geomesa_tpu.utils.config import STREAM_SHARD_INCREMENTAL
+
+        if not STREAM_SHARD_INCREMENTAL.to_bool():
+            return None
+        groups = self._route_shards(name, ft, query)
+        plan.scan_path = f"sharded-stream[{len(groups)}]"
+        wq = self._worker_query(query)
+        outcomes: Dict[str, Dict[str, Any]] = {}
+
+        def gen():
+            try:
+                for gid, res in self._scatter_gather_iter(
+                    name, wq, groups, outcomes
+                ):
+                    # span-visible release point: the timing evidence
+                    # that batch N flushed before the last shard landed
+                    trace.event(
+                        "stream.shard.batch", group=int(gid),
+                        rows=int(res["rows"]),
+                    )
+                    for cols in res["columns"]:
+                        if cols:
+                            yield cols
+            finally:
+                trace.set_attr("shards", outcomes)
+
+        return gen()
 
     # -- observability -------------------------------------------------------
 

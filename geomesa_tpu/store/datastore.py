@@ -1602,12 +1602,23 @@ class TpuDataStore:
                     self._prepare_query(name, q)
                     plan = self._plan_cached(name, q)
             t_planned = _time.perf_counter()
-            streamable = (
-                self.STREAMS_LOCAL_PARTS
-                and not q.sort_by
+            # merge-free shapes stream incrementally; sort/sampling/
+            # derived-transform queries must see ALL rows first
+            mergeless = (
+                not q.sort_by
                 and not q.hints.get("sampling")
                 and QueryTransforms.parse(ft, q.properties) is None
             )
+            streamable = self.STREAMS_LOCAL_PARTS and mergeless
+            shard_parts = None
+            if not streamable and mergeless and not plan.is_empty:
+                # sharded coordinators stream per-shard partial batches
+                # through the incremental gather (parallel/shards.py
+                # _iter_stream_shard_cols); None = no such seam (or the
+                # geomesa.stream.shard.incremental escape hatch is off)
+                shard_parts = self._iter_stream_shard_cols(
+                    name, ft, q, plan, t0
+                )
             if streamable and not plan.is_empty:
                 out_ft = (
                     _narrow_ft(ft, q.properties)
@@ -1654,6 +1665,53 @@ class TpuDataStore:
                         yield b
                 if hits == 0:
                     yield vec.to_batch(_empty_columns(out_ft))
+            elif shard_parts is not None:
+                out_ft = (
+                    _narrow_ft(ft, q.properties)
+                    if q.properties is not None
+                    else ft
+                )
+                vec = SimpleFeatureVector(out_ft, dictionary_encode)
+                remaining = q.max_features
+                # cross-shard fid dedupe is ALWAYS on here (replica
+                # failover, hedges, and mid-rebalance dual-target writes
+                # can each surface a fid twice): incremental first-
+                # occurrence winners, the same rows _merge_shards'
+                # _dedupe_by_fid keeps over the full gather
+                seen: set = set()
+                try:
+                    while remaining is None or remaining > 0:
+                        batches = []
+                        with deadline_mod.attach(dl), plans_mod.attach(pend):
+                            try:
+                                cols = next(shard_parts)
+                            except StopIteration:
+                                break
+                            cols = _dedupe_against(_materialize(cols), seen)
+                            n = len(cols.get("__fid__", ()))
+                            if remaining is not None and n > remaining:
+                                cols = {
+                                    k: v[:remaining] for k, v in cols.items()
+                                }
+                                n = remaining
+                            for lo in range(0, n, batch_rows):
+                                sub = {
+                                    k: v[lo : lo + batch_rows]
+                                    for k, v in cols.items()
+                                }
+                                batches.append(vec.to_batch(sub))
+                            hits += n
+                            if remaining is not None:
+                                remaining -= n
+                        for b in batches:
+                            yield b
+                finally:
+                    # closing the stream mid-iteration must poison the
+                    # still-running shard scans NOW (the generator's
+                    # abort path), not at GC
+                    shard_parts.close()
+                if hits == 0:
+                    yield vec.to_batch(_empty_columns(out_ft))
             else:
                 # sort/sampling/transforms (or an empty plan): the
                 # finished result chunks into batches — same answers,
@@ -1687,6 +1745,16 @@ class TpuDataStore:
         finally:
             if not rode_slot:
                 ctl._release()
+
+    def _iter_stream_shard_cols(self, name, ft, q: Query, plan, t0):
+        """Sharded-streaming seam: coordinators whose rows live in shard
+        workers (parallel/shards.ShardedDataStore, and the fleet tier on
+        top of it) return a generator of per-shard-group column dicts,
+        each yielded the moment its group's outcome is FINAL — the
+        incremental edition of gather-then-chunk. None (this base class)
+        means no such seam exists and ``_stream_gen`` falls back to full
+        materialization for non-local stores."""
+        return None
 
     def _iter_stream_parts(self, name, ft, q: Query, plan, t0):
         """Route+scan for the streaming path: yields (block, rows) per

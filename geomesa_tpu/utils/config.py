@@ -252,11 +252,27 @@ AGG_CACHE_BYTES = SystemProperty("geomesa.agg.cache.bytes", "64MB")
 BATCH_ENABLED = SystemProperty("geomesa.batch.enabled", "true")
 BATCH_WINDOW_MS = SystemProperty("geomesa.batch.window.ms", "2")
 BATCH_MAX_QUERIES = SystemProperty("geomesa.batch.max.queries", "32")
+# Multi-chip coalescing: on an SPMD mesh a coalesced group compiles to
+# ONE collective-free stacked-mask sweep per chip (shard_map over the
+# segment mirrors). `spmd.enabled=0` declines every coalesced plan to
+# the dispatch_many batch paths instead (per-plan reason-coded
+# `coalesce/spmd_disabled`), identical answers — the A/B lever for the
+# SPMD kernel itself; single-device meshes ignore it.
+BATCH_SPMD_ENABLED = SystemProperty("geomesa.batch.spmd.enabled", "true")
 # Streaming result delivery (TpuDataStore.query_stream / web.py
 # GET /query?stream=1, POST /query/stream): per-block Arrow record
 # batches flush as scanning progresses; `batch.rows` caps the rows per
 # emitted RecordBatch (a huge block still streams in bounded frames).
 STREAM_BATCH_ROWS = SystemProperty("geomesa.stream.batch.rows", "8192")
+# Sharded streaming (ShardedDataStore.query_stream): per-shard partial
+# Arrow batches flush as each shard group's outcome becomes FINAL (a
+# success can no longer be rolled back by failover), instead of
+# gather-then-chunk; any late shard failure still ends the stream
+# crisply before the terminating chunk. `incremental=0` restores the
+# materialize-then-chunk posture (identical answers, no first-byte win).
+STREAM_SHARD_INCREMENTAL = SystemProperty(
+    "geomesa.stream.shard.incremental", "true"
+)
 # Socket-timeout knobs: NO I/O boundary is unbounded-by-default. The
 # netlog RPC client derives its per-attempt timeout from
 # min(geomesa.netlog.timeout, the query's remaining deadline); auxiliary

@@ -436,4 +436,31 @@ def device_debug() -> Dict[str, Any]:
         # aggregate pyramid cache (ops/pyramid.py): entries/bytes,
         # hit/miss/build/eviction counters, latest pyramid shape
         "agg": agg_block,
+        # cross-query coalescer reach (parallel/batch.py admission
+        # groups + parallel/executor.dispatch_coalesced routing): how
+        # many groups formed, the pow2 group-size histogram (all-1s
+        # means the window never fills), how many member plans rode a
+        # stacked-mask sweep vs fell to the dispatch_many batch paths,
+        # and the mesh size the sweeps compiled for — the timeline/SLO
+        # layer's "is the coalescer earning its window" signal
+        "coalesce": {
+            "groups": counters.get("batch.coalesce.groups", 0),
+            "members": counters.get("batch.coalesce.members", 0),
+            "stacked_plans": counters.get("batch.coalesce.plans.stacked", 0),
+            "rest_plans": counters.get("batch.coalesce.plans.rest", 0),
+            "devices": gauges.get("batch.coalesce.devices", 0),
+            # NUMERIC bucket order: lexical sort would interleave 16/32
+            # between 1 and 2, scrambling exactly the large-group tail
+            # the histogram exists to show
+            "group_pow2": {
+                k.rsplit(".", 1)[1]: counters[k]
+                for k in sorted(
+                    (
+                        k for k in counters
+                        if k.startswith("batch.coalesce.group.pow2.")
+                    ),
+                    key=lambda k: int(k.rsplit(".", 1)[1]),
+                )
+            },
+        },
     }

@@ -154,7 +154,8 @@ def run_agg_stream(store, reps: int) -> dict:
     }
 
 
-def run_concurrent_stream(n: int, threads: int, per_thread: int) -> dict:
+def run_concurrent_stream(n: int, threads: int, per_thread: int,
+                          devices: int = 1, receipts: bool = False) -> dict:
     """The saturated-concurrency bench leg (PR 9): K client threads x M
     queries over ONE store, with cross-query coalescing ON (the default)
     and then OFF (the `geomesa.batch.enabled=0` escape hatch, i.e. the
@@ -164,12 +165,17 @@ def run_concurrent_stream(n: int, threads: int, per_thread: int) -> dict:
     per-query wall comes from the store's own query.scan timer summaries
     (the PR 2/3 observability rails), not ad-hoc timers.
 
-    The leg builds its OWN store on a single-device mesh (one device
-    per serving host — the shape the coalescer's stacked-mask kernel
-    targets). That also sidesteps a pre-existing hazard unrelated to
-    coalescing: concurrent SOLO device queries on a multi-device mesh
-    (the 8-virtual-device test conftest) can deadlock in XLA's
-    collective rendezvous."""
+    ``devices`` sizes the leg's own mesh: 1 is the classic
+    one-device-per-host serving shape; the `concurrent_spmd` leg runs
+    the SAME saturated stream on a forced multi-device CPU mesh, where
+    a coalesced group compiles to ONE collective-free stacked-mask
+    sweep per chip (executor._exact_shard_mask_batch_fn) and the SOLO
+    escape hatch exercises the per-mesh dispatch gate (mesh.gated — the
+    rendezvous fence that makes concurrent solo queries on an SPMD mesh
+    safe; before it they could deadlock in XLA's collective
+    rendezvous). ``receipts`` additionally audits every query and gates
+    the receipt-splitting invariant: member receipts must SUM exactly
+    to the device bytes the whole pass moved."""
     import threading
 
     import jax
@@ -181,12 +187,18 @@ def run_concurrent_stream(n: int, threads: int, per_thread: int) -> dict:
     from geomesa_tpu.parallel.mesh import default_mesh
     from geomesa_tpu.schema.featuretype import parse_spec
     from geomesa_tpu.store.datastore import TpuDataStore
-    from geomesa_tpu.utils.audit import MetricsRegistry, histogram_summary
+    from geomesa_tpu.utils.audit import (
+        InMemoryAuditWriter,
+        MetricsRegistry,
+        histogram_summary,
+    )
     from geomesa_tpu.utils.config import properties
 
     x, y, t = bench.synthesize(n)
+    kwargs = {"audit_writer": InMemoryAuditWriter()} if receipts else {}
     store = TpuDataStore(
-        executor=TpuScanExecutor(default_mesh(jax.devices()[:1]))
+        executor=TpuScanExecutor(default_mesh(jax.devices()[:devices])),
+        **kwargs,
     )
     ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
     store.create_schema(ft)
@@ -243,12 +255,14 @@ def run_concurrent_stream(n: int, threads: int, per_thread: int) -> dict:
     one_pass(False)
     wall_co, hits_co, p99_co = one_pass(True)
     wall_solo, hits_solo, p99_solo = one_pass(False)
+    receipt_block = _receipt_probe(store, cqls[:4]) if receipts else None
     queries = threads * per_thread
     fps_co = n * queries / max(wall_co, 1e-9)
     fps_solo = n * queries / max(wall_solo, 1e-9)
-    return {
+    out = {
         "threads": threads,
         "per_thread": per_thread,
+        "devices": devices,
         "hits": hits_co,
         "hits_solo": hits_solo,
         "features_per_s": round(fps_co, 1),
@@ -257,6 +271,91 @@ def run_concurrent_stream(n: int, threads: int, per_thread: int) -> dict:
         "p99_ms": None if p99_co is None else round(p99_co, 3),
         "p99_ms_solo": None if p99_solo is None else round(p99_solo, 3),
     }
+    if receipt_block is not None:
+        out["receipts"] = receipt_block
+    return out
+
+
+def _receipt_probe(store, cqls, attempts: int = 6) -> dict:
+    """The receipt-sum gate of the `concurrent_spmd` leg: one barrier-
+    synchronized wave of concurrent queries per attempt, under a wide
+    coalescing window with one admission slot held (the saturated
+    steady state — even the first arrival passes the concurrency gate).
+    Once a wave lands in ONE full coalesced group (grouping is
+    scheduler-dependent, so split waves retry), the members' audited
+    receipts must SUM exactly to the device bytes the wave moved — the
+    receipt-splitting invariant on the SPMD mesh: every byte of the
+    stacked per-chip sweep lands in exactly one member receipt."""
+    import contextvars
+    import threading
+
+    from geomesa_tpu.index.planner import Query
+    from geomesa_tpu.utils import devstats
+    from geomesa_tpu.utils.config import properties
+
+    reg = devstats.devstats_metrics()
+    for _ in range(attempts):
+        qs = [Query.cql(c) for c in cqls]
+        store.audit_writer.events.clear()
+        g0 = reg.counter("batch.coalesce.groups")
+        m0 = reg.counter("batch.coalesce.members")
+        d2h0 = reg.counter("device.d2h.bytes")
+        h2d0 = reg.counter("device.h2d.bytes")
+        ctx = contextvars.Context()
+        admit = store.admission.admit()
+        ctx.run(admit.__enter__)
+        errors = []
+        barrier = threading.Barrier(len(qs))
+
+        def worker(q):
+            try:
+                barrier.wait(timeout=30)
+                store.query("gdelt", q)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        try:
+            with properties(
+                geomesa_batch_enabled="true",
+                geomesa_batch_window_ms="100",
+            ):
+                ths = [
+                    threading.Thread(target=worker, args=(q,)) for q in qs
+                ]
+                for th in ths:
+                    th.start()
+                for th in ths:
+                    th.join()
+        finally:
+            ctx.run(admit.__exit__, None, None, None)
+        if errors:
+            raise errors[0]
+        if (
+            reg.counter("batch.coalesce.groups") - g0 != 1
+            or reg.counter("batch.coalesce.members") - m0 != len(qs)
+        ):
+            continue  # scheduling split the arrivals; try again
+        d2h_total = reg.counter("device.d2h.bytes") - d2h0
+        h2d_total = reg.counter("device.h2d.bytes") - h2d0
+        events = [
+            e for e in store.audit_writer.events if e.type_name == "gdelt"
+        ]
+        d2h_sum = sum(e.d2h_bytes for e in events)
+        h2d_sum = sum(e.h2d_bytes for e in events)
+        return {
+            "queries": len(events),
+            "d2h_total": d2h_total,
+            "d2h_receipts": d2h_sum,
+            "h2d_total": h2d_total,
+            "h2d_receipts": h2d_sum,
+            "exact": (
+                len(events) == len(qs)
+                and d2h_sum == d2h_total
+                and h2d_sum == h2d_total
+                and d2h_total > 0
+            ),
+        }
+    return {"exact": False, "error": f"no full group in {attempts} attempts"}
 
 
 def run_stream_latency(reps: int) -> dict:
@@ -341,7 +440,12 @@ def run_stream(n: int, reps: int) -> dict:
     x, y, t = bench.synthesize(n)
     _boxes, cqls = bench.make_queries(reps)
 
-    store = TpuDataStore(executor=TpuScanExecutor(default_mesh()))
+    # the headline stream keeps the classic one-device-per-host serving
+    # shape even though the process now carries >= 2 virtual devices
+    # for the concurrent_spmd leg; multi-chip behavior is gated there
+    store = TpuDataStore(
+        executor=TpuScanExecutor(default_mesh(jax.devices()[:1]))
+    )
     ft = parse_spec("gdelt", "dtg:Date,*geom:Point:srid=4326")
     store.create_schema(ft)
     fids = np.array([f"f{i}" for i in range(n)], dtype=object)
@@ -396,6 +500,20 @@ def run_stream(n: int, reps: int) -> dict:
     join = run_join_stream(store, max(2, reps // 2))
     agg = run_agg_stream(store, max(4, reps))
     concurrent = run_concurrent_stream(n, threads=8, per_thread=4)
+    # the multi-chip edition: same saturated stream on a forced
+    # 2-device mesh (the __main__ pin forces
+    # xla_force_host_platform_device_count >= 2 on CPU) — coalesced
+    # groups ride the collective-free per-chip stacked-mask sweep, solo
+    # queries exercise the rendezvous dispatch gate, and the receipt
+    # probe pins the split invariant. Skipped (absent from the
+    # artifact) only when the backend truly has one device.
+    concurrent_spmd = (
+        run_concurrent_stream(
+            n, threads=8, per_thread=4, devices=2, receipts=True
+        )
+        if len(jax.devices()) >= 2
+        else None
+    )
     stream = run_stream_latency(max(3, reps // 2))
     try:
         # 1-minute loadavg at measurement time: the gate is known
@@ -409,6 +527,7 @@ def run_stream(n: int, reps: int) -> dict:
         "join": join,
         "agg": agg,
         "concurrent": concurrent,
+        "concurrent_spmd": concurrent_spmd,
         "stream": stream,
         "loadavg_1m": loadavg,
         # the headline stream's flight-recorder window (not gated:
@@ -463,15 +582,15 @@ def inject_slowdown(artifact: dict, factor: float) -> dict:
         # the injection tests the band gates, not the cache's physics
         out["agg"]["cold_ms"] = round(out["agg"]["cold_ms"] * factor, 3)
         out["agg"]["hot_ms"] = round(out["agg"]["hot_ms"] * factor, 3)
-    if "concurrent" in out:
+    for leg in ("concurrent", "concurrent_spmd"):
+        if not out.get(leg):
+            continue
         # uniform scaling: both modes slow equally, speedup preserved
         for key in ("features_per_s", "features_per_s_solo"):
-            out["concurrent"][key] = round(out["concurrent"][key] / factor, 1)
+            out[leg][key] = round(out[leg][key] / factor, 1)
         for key in ("p99_ms", "p99_ms_solo"):
-            if out["concurrent"].get(key) is not None:
-                out["concurrent"][key] = round(
-                    out["concurrent"][key] * factor, 3
-                )
+            if out[leg].get(key) is not None:
+                out[leg][key] = round(out[leg][key] * factor, 3)
     if "stream" in out:
         out["stream"]["full_ms"] = round(out["stream"]["full_ms"] * factor, 3)
         out["stream"]["first_batch_ms"] = round(
@@ -636,6 +755,63 @@ def compare(baseline: dict, current: dict, tolerance: dict = None) -> list:
             out.append(
                 f"concurrent features_per_s regressed: {c_fps:,.0f} < "
                 f"{floor:,.0f} (baseline {b_fps:,.0f} / "
+                f"{tol['per_query_ms_factor']})"
+            )
+
+    # the multi-chip saturated-concurrency leg (the SPMD stacked-mask
+    # kernel + the rendezvous dispatch gate): same parity/speedup/band
+    # posture as `concurrent`, ON A MULTI-DEVICE MESH — coalesced
+    # saturated throughput must stay >= 2x the solo escape hatch, the
+    # two modes must answer identically, hits must match the baseline,
+    # and the receipt probe must report EXACT member-receipt sums (the
+    # split invariant across per-chip sweeps). Baselines recorded
+    # before the leg (or single-device runs) skip it.
+    b_spmd = baseline.get("concurrent_spmd")
+    c_spmd = current.get("concurrent_spmd") or {}
+    if b_spmd and not c_spmd:
+        # same config (the early devices-mismatch check already refused
+        # cross-config comparisons) but the leg is GONE: one clear line
+        # instead of three misleading correctness failures
+        out.append(
+            "concurrent_spmd leg missing from this run but present in "
+            "the baseline — the SPMD bench leg stopped running on an "
+            "unchanged device configuration"
+        )
+    elif b_spmd:
+        if c_spmd.get("hits") != c_spmd.get("hits_solo"):
+            out.append(
+                f"concurrent_spmd hit parity broke: coalesced "
+                f"{c_spmd.get('hits')} != solo {c_spmd.get('hits_solo')} "
+                "(CORRECTNESS, not perf — the SPMD stacked sweep must "
+                "answer identically to the solo path)"
+            )
+        if c_spmd.get("hits") != b_spmd.get("hits"):
+            out.append(
+                f"concurrent_spmd hits drifted: {c_spmd.get('hits')} != "
+                f"{b_spmd.get('hits')} (CORRECTNESS, not perf)"
+            )
+        if c_spmd.get("speedup", 0.0) < 2.0:
+            out.append(
+                f"concurrent_spmd coalescing speedup below floor: "
+                f"{c_spmd.get('speedup')}x < 2x — coalesced saturated "
+                "throughput on the multi-device mesh no longer "
+                "meaningfully beats solo (a lost SPMD stacked sweep, or "
+                "the multi-chip decline path re-appeared)"
+            )
+        if not (c_spmd.get("receipts") or {}).get("exact"):
+            out.append(
+                "concurrent_spmd receipt sums not exact: "
+                f"{c_spmd.get('receipts')} — member receipts must sum "
+                "to the group sweep's device bytes on the SPMD mesh "
+                "(CORRECTNESS of the cost-accounting contract)"
+            )
+        b_fps = b_spmd.get("features_per_s", 0.0)
+        c_fps = c_spmd.get("features_per_s", 0.0)
+        floor = b_fps / tol["per_query_ms_factor"]
+        if b_fps and c_fps < floor:
+            out.append(
+                f"concurrent_spmd features_per_s regressed: {c_fps:,.0f} "
+                f"< {floor:,.0f} (baseline {b_fps:,.0f} / "
                 f"{tol['per_query_ms_factor']})"
             )
 
@@ -818,5 +994,8 @@ if __name__ == "__main__":
     if os.environ.get("GEOMESA_GATE_DEVICE", "") != "1":
         from geomesa_tpu.parallel.mesh import force_cpu_platform
 
-        force_cpu_platform()
+        # min_devices=2: the concurrent_spmd leg needs a multi-device
+        # CPU mesh (xla_force_host_platform_device_count) in the same
+        # process; the classic legs pin their own single-device meshes
+        force_cpu_platform(min_devices=2)
     sys.exit(main())

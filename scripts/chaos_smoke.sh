@@ -44,6 +44,19 @@
 #     in its plan fingerprint — a degraded query lands on the degraded
 #     scan-path fingerprint with its reason-coded degrade decision
 #     recorded, never double-counted and never lost
+#   - multi-chip coalescing under faults (tests/test_spmd_coalesce.py):
+#     for every batch.coalesce x error/drop/latency x seed schedule ON A
+#     FORCED MULTI-DEVICE MESH (the 8-virtual-device conftest), a
+#     coalesced group answers identically to the solo fault-free run (a
+#     seam failure degrades the WHOLE group to per-query execution,
+#     parity-or-crisp), and concurrent solo queries never deadlock in
+#     the collective rendezvous (the per-mesh dispatch gate)
+#   - incremental sharded streaming under faults (tests/test_shards.py
+#     streaming soaks): for shard.rpc schedules, query_stream over a
+#     ShardedDataStore either streams the complete result set (per-
+#     shard failover absorbed mid-stream) or dies crisply with
+#     QueryTimeout/ShardUnavailable BEFORE the terminating chunk —
+#     never a truncated stream
 #   - fleet survives real process death (tests/test_fleet.py, its own
 #     120 s cap): a worker process is killed with a REAL SIGKILL mid-
 #     query-stream — every in-flight and subsequent query answers
@@ -61,7 +74,7 @@ rc=0
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_crash.py tests/test_shards.py \
     tests/test_join.py tests/test_agg_cache.py tests/test_timeline.py \
-    tests/test_plans.py \
+    tests/test_plans.py tests/test_spmd_coalesce.py \
     -q -m chaos -p no:cacheprovider "$@" || rc=$?
 # the real-SIGKILL fleet soak spawns worker PROCESSES: bounded on its
 # own so a wedged spawn can never eat the in-process soaks' budget

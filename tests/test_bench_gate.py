@@ -138,6 +138,18 @@ def _concurrent(speedup=3.0, hits=357160, hits_solo=357160, fps=9.0e6):
     }
 
 
+def _spmd(speedup=2.9, hits=357160, hits_solo=357160, fps=7.0e6,
+          exact=True):
+    out = _concurrent(speedup=speedup, hits=hits, hits_solo=hits_solo,
+                      fps=fps)
+    out["devices"] = 2
+    out["receipts"] = {
+        "queries": 4, "d2h_total": 4096, "d2h_receipts": 4096,
+        "h2d_total": 1024, "h2d_receipts": 1024, "exact": exact,
+    }
+    return out
+
+
 def _stream(ratio=0.12, hits=33916):
     return {
         "reps": 3, "blocks": 16, "hits": hits,
@@ -175,6 +187,52 @@ def test_concurrent_leg_clean_and_bands():
     )
     # baselines recorded before the leg skip it
     assert bench_gate.compare(_artifact(), cur) == []
+
+
+def test_concurrent_spmd_leg_clean_and_bands():
+    """PR 14: the multi-chip saturated leg gates like `concurrent` —
+    parity/drift/speedup/time band — PLUS the receipt-sum invariant."""
+    base, cur = _artifact(), _artifact()
+    base["concurrent_spmd"] = _spmd()
+    cur["concurrent_spmd"] = _spmd(speedup=2.5)
+    assert bench_gate.compare(base, cur) == []
+    flat = _artifact()
+    flat["concurrent_spmd"] = _spmd(speedup=1.3)
+    assert any(
+        "concurrent_spmd coalescing speedup below floor" in r
+        for r in bench_gate.compare(base, flat)
+    )
+    bleed = _artifact()
+    bleed["concurrent_spmd"] = _spmd(hits_solo=1)
+    assert any(
+        "concurrent_spmd hit parity broke" in r
+        for r in bench_gate.compare(base, bleed)
+    )
+    drift = _artifact()
+    drift["concurrent_spmd"] = _spmd(hits=1, hits_solo=1)
+    assert any("CORRECTNESS" in r for r in bench_gate.compare(base, drift))
+    # a broken receipt split is correctness of the accounting contract
+    leak = _artifact()
+    leak["concurrent_spmd"] = _spmd(exact=False)
+    assert any(
+        "receipt sums not exact" in r for r in bench_gate.compare(base, leak)
+    )
+    slow = _artifact()
+    slow["concurrent_spmd"] = _spmd(fps=7.0e6 / 4)
+    assert any(
+        "concurrent_spmd features_per_s regressed" in r
+        for r in bench_gate.compare(base, slow)
+    )
+    # pre-leg baselines (and single-device runs) skip it
+    assert bench_gate.compare(_artifact(), cur) == []
+    # uniform slowdown injection preserves the self-relative gates
+    art = _artifact()
+    art["concurrent_spmd"] = _spmd()
+    out = bench_gate.inject_slowdown(art, 2.0)
+    assert out["concurrent_spmd"]["speedup"] == art["concurrent_spmd"]["speedup"]
+    assert out["concurrent_spmd"]["features_per_s"] == pytest.approx(
+        art["concurrent_spmd"]["features_per_s"] / 2
+    )
 
 
 def test_stream_leg_clean_and_bands():

@@ -659,3 +659,139 @@ def test_shard_merge_faults_are_absorbed(baseline, kind):
         for q in QUERIES:
             assert sorted(sh.query("t", q).fids) == baseline[q], (kind, q)
     assert rule.fired == 2
+
+
+# -- incremental sharded streaming (PR 14) ------------------------------------
+
+
+def _stream_fids(batches):
+    return sorted(
+        str(x)
+        for b in batches
+        if b.num_rows
+        for x in b.column("__fid__").to_numpy(zero_copy_only=False)
+    )
+
+
+class TestIncrementalShardStreaming:
+    def test_streamed_concat_equals_materialized_query(self, baseline):
+        sh = sharded()
+        for q in QUERIES:
+            got = _stream_fids(sh.query_stream("t", q))
+            assert got == baseline[q], q
+
+    def test_limit_and_projection_stream_incrementally(self):
+        base = ingest(TpuDataStore())
+        sh = sharded()
+        q = Query.cql("BBOX(geom, -70, -70, 70, 70)", max_features=10)
+        batches = list(sh.query_stream("t", q))
+        assert sum(b.num_rows for b in batches) == 10
+        qp = Query.cql("name = 'n1'", properties=["name"])
+        batches = list(sh.query_stream("t", qp))
+        assert _stream_fids(batches) == sorted(
+            base.query("t", qp).fids
+        )
+        for b in batches:
+            assert "name" in b.schema.names and "n" not in b.schema.names
+
+    def test_first_batch_flushes_before_last_shard_completes(self, baseline):
+        """The first-byte win, asserted via timings: with one shard
+        group slowed, the first Arrow batch arrives while that shard is
+        still scanning — and the stream still completes with parity
+        (gather-then-chunk would hold EVERY byte for the straggler)."""
+        sh = sharded()
+        sh._hedge_min_s = 60.0  # hedging off: the slow shard stays slow
+        q = Query.cql("BBOX(geom, -70, -70, 70, 70)")
+        sh.query("t", q)  # warm kernels/mirrors outside the timed pass
+        groups = sh._route_shards("t", sh.get_schema("t"), q)
+        assert len(groups) >= 2, "need a fan-out to prove incrementality"
+        slow = sorted(groups)[-1]
+        orig = sh.workers[slow].scan
+        slow_s = 0.6
+        done_at = {}
+
+        def slow_scan(name, wq, partitions):
+            time.sleep(slow_s)
+            out = orig(name, wq, partitions)
+            done_at["t"] = time.perf_counter()
+            return out
+
+        sh.workers[slow].scan = slow_scan
+        t0 = time.perf_counter()
+        gen = sh.query_stream("t", q)
+        first = next(gen)
+        t_first = time.perf_counter() - t0
+        rest = list(gen)
+        assert t_first < slow_s * 0.8, (
+            f"first batch waited for the straggler: {t_first:.3f}s"
+        )
+        assert done_at["t"] - t0 >= slow_s  # the straggler really lagged
+        assert _stream_fids([first] + rest) == sorted(
+            sh.query("t", q).fids
+        )
+
+    def test_mid_stream_shard_death_fails_over_with_parity(self, baseline):
+        """A shard dying mid-stream is absorbed by replica failover
+        BEFORE its batches are released (a group's rows only flush once
+        its outcome is final) — the stream completes with full parity."""
+        sh = sharded()
+        q = "BBOX(geom, -20, -20, 20, 20)"
+        victim = _primaries(sh)[0]
+
+        def dead(*a, **k):
+            raise ConnectionError("killed mid-stream")
+
+        sh.workers[victim].scan = dead
+        got = _stream_fids(sh.query_stream("t", q))
+        assert got == baseline[q]
+
+    def test_exhausted_chain_ends_stream_crisply_never_truncated(self):
+        """Every placement of one group dead: the stream raises a crisp
+        ShardUnavailable instead of terminating cleanly with missing
+        rows — the no-truncated-results invariant, streamed."""
+        sh = sharded(replicas=0)
+        victim = _primaries(sh)[0]
+
+        def dead(*a, **k):
+            raise ConnectionError("killed")
+
+        sh.workers[victim].scan = dead
+        gen = sh.query_stream("t", "BBOX(geom, -70, -70, 70, 70)")
+        with pytest.raises(ShardUnavailable):
+            for _ in gen:
+                pass
+
+    def test_escape_hatch_materializes_with_identical_answers(self, baseline):
+        sh = sharded()
+        with properties(geomesa_stream_shard_incremental="false"):
+            got = _stream_fids(
+                sh.query_stream("t", "BBOX(geom, -20, -20, 20, 20)")
+            )
+        assert got == baseline["BBOX(geom, -20, -20, 20, 20)"]
+
+    def test_early_close_releases_admission_slot(self):
+        sh = sharded()
+        gen = sh.query_stream("t", "INCLUDE")
+        next(gen)
+        gen.close()
+        snap = sh.admission.snapshot()
+        assert snap["inflight"] == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", ["error", "drop", "crash"])
+@pytest.mark.parametrize("seed", range(3))
+def test_stream_chaos_parity_or_crisp_under_shard_faults(baseline, kind, seed):
+    """Incremental sharded streaming under shard.rpc schedules: the
+    stream either delivers the COMPLETE result set (failover absorbed
+    mid-stream, batches only released once final) or dies crisply with
+    QueryTimeout/ShardUnavailable before the terminating chunk — never
+    a truncated stream."""
+    sh = sharded(num_shards=3)
+    with faults.inject(f"shard.rpc:{kind}=0.3", seed=seed):
+        for q in QUERIES:
+            try:
+                got = _stream_fids(sh.query_stream("t", q))
+            except (QueryTimeout, ShardUnavailable):
+                continue  # crisp, never truncated
+            assert got == baseline[q], (kind, seed, q)

@@ -1,0 +1,422 @@
+"""Multi-chip cross-query coalescing (the SPMD stacked-mask kernel) and
+the collective-rendezvous safety contract.
+
+Covers the PR 14 contract on the conftest's forced multi-device CPU
+mesh: a coalesced group on an SPMD mesh compiles to ONE collective-free
+stacked-mask sweep per chip (executor._exact_shard_mask_batch_fn — each
+chip packs its resident rows inside shard_map, the host stitches shard
+planes by row offset) and answers IDENTICALLY to the single-device
+stacked sweep, the solo path, and the host reference — including the
+attribute-plane, extent (xz), and banded-polygon folds and the
+receipt-split-sums-to-group invariant. Concurrent SOLO device queries on
+a multi-device mesh must complete without deadlocking in XLA's
+collective rendezvous (the per-mesh dispatch gate, mesh.dispatch_gate —
+the hazard PR 9's tests surfaced). Declines are per-plan reason-coded
+(``decision.coalesce.*``) so /debug/plans explains why a member missed
+the sweep.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import bench
+from geomesa_tpu.geom.base import Polygon
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.parallel import TpuScanExecutor, default_mesh
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.datastore import HostScanExecutor, TpuDataStore
+from geomesa_tpu.utils import devstats, faults
+from geomesa_tpu.utils.audit import InMemoryAuditWriter, robustness_metrics
+from geomesa_tpu.utils.config import properties
+
+N = 12_000
+
+
+@pytest.fixture(autouse=True)
+def _no_seek(monkeypatch):
+    # the cost chooser would answer these selective plans via host
+    # seeks (correct, but then nothing exercises the stacked sweep)
+    monkeypatch.setenv("GEOMESA_SEEK", "0")
+
+
+def _mesh(devices: int):
+    import jax
+
+    return default_mesh(jax.devices()[:devices])
+
+
+def _store(devices: int, audit: bool = False, n: int = N,
+           spec: str = "name:String,dtg:Date,*geom:Point:srid=4326"):
+    x, y, t = bench.synthesize(n)
+    kw = {"audit_writer": InMemoryAuditWriter()} if audit else {}
+    store = TpuDataStore(executor=TpuScanExecutor(_mesh(devices)), **kw)
+    ft = parse_spec("gdelt", spec)
+    store.create_schema(ft)
+    fids = np.array([f"f{i}" for i in range(n)], dtype=object)
+    names = np.array([f"n{i % 5}" for i in range(n)], dtype=object)
+    store._insert_columns(
+        ft,
+        {"__fid__": fids, "name": names, "geom__x": x, "geom__y": y,
+         "dtg": t},
+    )
+    store.query("gdelt", bench.QUERY)  # warm: mirror + kernels
+    return store
+
+
+def _concurrent(store, queries, enabled=True, window_ms="60"):
+    results = [None] * len(queries)
+    errors = []
+    barrier = threading.Barrier(len(queries))
+
+    def worker(i, q):
+        try:
+            barrier.wait(timeout=20)
+            results[i] = store.query("gdelt", q)
+        except Exception as e:  # noqa: BLE001 - surfaced via errors
+            errors.append((i, e))
+
+    with properties(
+        geomesa_batch_enabled=("true" if enabled else "false"),
+        geomesa_batch_window_ms=window_ms,
+    ):
+        threads = [
+            threading.Thread(target=worker, args=(i, q), daemon=True)
+            for i, q in enumerate(queries)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    assert not errors, errors
+    assert all(r is not None for r in results), "a worker never finished"
+    return results
+
+
+def _fids(res):
+    return sorted(map(str, res.fids))
+
+
+PLAIN_MIX = [
+    bench.QUERY,
+    bench.QUERY,
+    "bbox(geom, -20, -10, 40, 30) AND dtg DURING "
+    "2018-01-01T00:00:00Z/2018-03-01T00:00:00Z",
+    "bbox(geom, -60, -30, 10, 20)",
+]
+
+
+class TestSpmdStackedMaskParity:
+    def test_plain_group_parity_2dev_vs_1dev_vs_solo(self):
+        """The headline: a coalesced group on a 2-device mesh (per-chip
+        stacked-mask sweep) == the single-device stacked sweep == the
+        solo path, and the SPMD kernel actually ran (no silent fallback
+        to the rest route — the deleted multi_chip decline must not
+        reappear as a behavior)."""
+        reg = devstats.devstats_metrics()
+        s2 = _store(devices=2)
+        s1 = _store(devices=1)
+        qs = [Query.cql(c) for c in PLAIN_MIX]
+        stacked0 = reg.counter("batch.coalesce.plans.stacked")
+        r2 = _concurrent(s2, [Query.cql(c) for c in PLAIN_MIX])
+        r1 = _concurrent(s1, qs)
+        solo = [s1.query("gdelt", Query.cql(c)) for c in PLAIN_MIX]
+        for a, b, c in zip(r2, r1, solo):
+            assert _fids(a) == _fids(b) == _fids(c)
+        assert reg.counter("batch.coalesce.plans.stacked") > stacked0
+        assert reg.counter("xla.compile.exact_shard_mask_batch") >= 1
+
+    def test_mixed_attr_group_parity(self):
+        """The attr fold: bbox AND name='..' members stack into the
+        attr-plane mask edition of the same sweep on the SPMD mesh."""
+        s2 = _store(devices=2)
+        host = TpuDataStore(executor=HostScanExecutor())
+        ft = parse_spec("gdelt", "name:String,dtg:Date,*geom:Point:srid=4326")
+        host.create_schema(ft)
+        x, y, t = bench.synthesize(N)
+        host._insert_columns(
+            ft,
+            {
+                "__fid__": np.array([f"f{i}" for i in range(N)], dtype=object),
+                "name": np.array([f"n{i % 5}" for i in range(N)], dtype=object),
+                "geom__x": x, "geom__y": y, "dtg": t,
+            },
+        )
+        cqls = [
+            "bbox(geom, -120, -60, 120, 60) AND name = 'n1'",
+            "bbox(geom, -120, -60, 120, 60) AND name = 'n2'",
+            "bbox(geom, -60, -30, 10, 20) AND name IN ('n0', 'n3')",
+        ]
+        got = _concurrent(s2, [Query.cql(c) for c in cqls])
+        for c, r in zip(cqls, got):
+            assert _fids(r) == _fids(host.query("gdelt", c)), c
+
+    def test_poly_group_parity(self):
+        """The banded-polygon fold: non-rect INTERSECTS members ride the
+        dual hit/decided stacked planes on the SPMD mesh; the band ring
+        still takes the host's exact test (identical results)."""
+        s2 = _store(devices=2)
+        host = _store(devices=1)
+        cqls = [
+            "INTERSECTS(geom, POLYGON((-60 -30, 60 -30, 80 20, 0 45, "
+            "-80 20, -60 -30)))",
+            "INTERSECTS(geom, POLYGON((-120 -50, -20 -50, -70 40, "
+            "-120 -50)))",
+        ]
+        got = _concurrent(s2, [Query.cql(c) for c in cqls])
+        for c, r in zip(cqls, got):
+            with properties(geomesa_batch_enabled="false"):
+                want = host.query("gdelt", Query.cql(c))
+            assert _fids(r) == _fids(want), c
+
+    def test_xz_group_parity(self):
+        """The extent fold: polygon-geometry schema (xz index), rect and
+        polygon INTERSECTS members stack into the dual-plane sweep."""
+        host = TpuDataStore(executor=HostScanExecutor())
+        dev = TpuDataStore(executor=TpuScanExecutor(_mesh(2)))
+        rng = np.random.default_rng(17)
+        rows = []
+        for i in range(800):
+            x0 = float(rng.uniform(-150, 140))
+            y0 = float(rng.uniform(-70, 60))
+            k = i % 3
+            if k == 0:  # rect (isrect fast path)
+                g = Polygon([[x0, y0], [x0 + 2, y0], [x0 + 2, y0 + 2],
+                             [x0, y0 + 2], [x0, y0]])
+            elif k == 1:  # triangle (ring rows)
+                g = Polygon([[x0, y0], [x0 + 3, y0], [x0 + 1.5, y0 + 3],
+                             [x0, y0]])
+            else:
+                g = None
+            rows.append(g)
+        for s in (host, dev):
+            s.create_schema(
+                parse_spec("areas", "dtg:Date,*geom:Geometry:srid=4326")
+            )
+            with s.writer("areas") as w:
+                for i, g in enumerate(rows):
+                    w.write([None, g], fid=f"a{i}")
+        cqls = [
+            "bbox(geom, -60, -40, 40, 40)",
+            "bbox(geom, -120, -60, -20, 20)",
+        ]
+        results = [None, None]
+        errors = []
+        barrier = threading.Barrier(2)
+
+        def worker(i, c):
+            try:
+                barrier.wait(timeout=20)
+                results[i] = dev.query("areas", Query.cql(c))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        with properties(geomesa_batch_enabled="true",
+                        geomesa_batch_window_ms="60"):
+            ts = [threading.Thread(target=worker, args=(i, c), daemon=True)
+                  for i, c in enumerate(cqls)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+        assert not errors, errors
+        for c, r in zip(cqls, results):
+            assert _fids(r) == _fids(host.query("areas", c)), c
+
+
+class TestSpmdReceiptSplitting:
+    def test_member_receipts_sum_to_group_cost_on_spmd_mesh(self):
+        """The receipt-splitting invariant, SPMD edition: when every
+        concurrent query rode ONE coalesced group on the 2-device mesh,
+        member receipts sum EXACTLY to the device bytes of the whole
+        group execution (per-chip sweeps included)."""
+        store = _store(devices=2, audit=True)
+        cqls = PLAIN_MIX
+        reg = devstats.devstats_metrics()
+        for _attempt in range(6):
+            qs = [Query.cql(c) for c in cqls]
+            store.audit_writer.events.clear()
+            g0 = reg.counter("batch.coalesce.groups")
+            m0 = reg.counter("batch.coalesce.members")
+            d2h0 = reg.counter("device.d2h.bytes")
+            h2d0 = reg.counter("device.h2d.bytes")
+            release = _hold_slot(store.admission)
+            try:
+                _concurrent(store, qs, window_ms="100")
+            finally:
+                release()
+            if not (
+                reg.counter("batch.coalesce.groups") - g0 == 1
+                and reg.counter("batch.coalesce.members") - m0 == len(qs)
+            ):
+                continue  # scheduling split the arrivals; try again
+            d2h_total = reg.counter("device.d2h.bytes") - d2h0
+            h2d_total = reg.counter("device.h2d.bytes") - h2d0
+            events = [
+                e for e in store.audit_writer.events
+                if e.type_name == "gdelt"
+            ]
+            assert len(events) == len(qs)
+            assert sum(e.d2h_bytes for e in events) == d2h_total
+            assert sum(e.h2d_bytes for e in events) == h2d_total
+            assert d2h_total > 0
+            return
+        pytest.fail("threads never landed in one full coalesced group")
+
+
+class TestRendezvousSafety:
+    def test_concurrent_solo_queries_never_deadlock(self):
+        """The regression stress for the PR 9 hazard: N threads of SOLO
+        device queries (coalescing OFF) on the full multi-device
+        conftest mesh, under a watchdog — before the per-mesh dispatch
+        gate this could deadlock in XLA's collective rendezvous. The
+        watchdog turns a hang into a crisp failure: daemon threads that
+        never finish fail the assert instead of wedging the suite."""
+        import jax
+
+        store = _store(devices=len(jax.devices()))
+        cqls = PLAIN_MIX * 2
+        results = [None] * len(cqls)
+        errors = []
+        barrier = threading.Barrier(len(cqls))
+
+        def worker(i, c):
+            try:
+                barrier.wait(timeout=30)
+                for _ in range(2):
+                    results[i] = store.query("gdelt", Query.cql(c))
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        with properties(geomesa_batch_enabled="false"):
+            threads = [
+                threading.Thread(target=worker, args=(i, c), daemon=True)
+                for i, c in enumerate(cqls)
+            ]
+            for t in threads:
+                t.start()
+            deadline = 180.0
+            import time as _time
+
+            t0 = _time.monotonic()
+            for t in threads:
+                t.join(timeout=max(0.1, deadline - (_time.monotonic() - t0)))
+            hung = [t for t in threads if t.is_alive()]
+        assert not hung, (
+            f"{len(hung)} solo queries hung on the multi-device mesh — "
+            "the collective-rendezvous deadlock is back (mesh.dispatch_gate)"
+        )
+        assert not errors, errors
+        assert all(r is not None for r in results)
+
+    def test_gate_shared_per_device_set(self):
+        """Two Mesh objects over the same devices share ONE gate; a
+        single-device mesh has none (nothing to rendezvous)."""
+        import jax
+
+        from geomesa_tpu.parallel.mesh import dispatch_gate
+
+        a = dispatch_gate(default_mesh(jax.devices()[:2]))
+        b = dispatch_gate(default_mesh(jax.devices()[:2]))
+        assert a is not None and a is b
+        assert dispatch_gate(default_mesh(jax.devices()[:1])) is None
+
+
+class TestDeclineReasons:
+    def test_kernel_ineligible_is_per_plan_reason_coded(self):
+        """A member whose shape no mask edition matches declines with
+        decision.coalesce.kernel_ineligible — /debug/plans' answer to
+        'why did this member miss the stacked sweep'."""
+        store = _store(devices=2)
+        rm = robustness_metrics()
+        k0 = rm.counter("decision.coalesce.kernel_ineligible")
+        # a LineString INTERSECTS: spatially scannable (envelope cover)
+        # but no mask edition claims it — not a box, not a polygon
+        # ray-cast, not an extent plan. The held slot models the
+        # saturated steady state so every arrival (including the
+        # ineligible member) passes the coalescer's concurrency gate.
+        cqls = [
+            bench.QUERY,
+            bench.QUERY,
+            "INTERSECTS(geom, LINESTRING(-100 -40, 20 30))",
+        ]
+        for _attempt in range(4):
+            release = _hold_slot(store.admission)
+            try:
+                _concurrent(store, [Query.cql(c) for c in cqls],
+                            window_ms="100")
+            finally:
+                release()
+            if rm.counter("decision.coalesce.kernel_ineligible") > k0:
+                return
+        pytest.fail("the ineligible member never recorded its decline")
+
+    def test_seek_cheaper_is_reason_coded(self, monkeypatch):
+        """With the cost chooser free to seek (GEOMESA_SEEK=1), a
+        selective member takes the host seek and records
+        decision.coalesce.seek_cheaper instead of riding the sweep."""
+        monkeypatch.setenv("GEOMESA_SEEK", "1")
+        store = _store(devices=2)
+        rm = robustness_metrics()
+        s0 = rm.counter("decision.coalesce.seek_cheaper")
+        for _attempt in range(4):
+            release = _hold_slot(store.admission)
+            try:
+                _concurrent(store, [Query.cql(c) for c in PLAIN_MIX],
+                            window_ms="100")
+            finally:
+                release()
+            if rm.counter("decision.coalesce.seek_cheaper") > s0:
+                return
+        pytest.fail("no coalesced member ever recorded seek_cheaper")
+
+    def test_spmd_disabled_escape_hatch(self):
+        """geomesa.batch.spmd.enabled=0: every coalesced plan on the
+        SPMD mesh declines (reason-coded) to the dispatch_many batch
+        paths with identical answers."""
+        store = _store(devices=2)
+        rm = robustness_metrics()
+        want = [_fids(store.query("gdelt", Query.cql(c))) for c in PLAIN_MIX]
+        d0 = rm.counter("decision.coalesce.spmd_disabled")
+        with properties(geomesa_batch_spmd_enabled="false"):
+            got = _concurrent(store, [Query.cql(c) for c in PLAIN_MIX])
+        assert rm.counter("decision.coalesce.spmd_disabled") > d0
+        for w, g in zip(want, got):
+            assert w == _fids(g)
+
+
+# -- chaos soaks (scripts/chaos_smoke.sh) -------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", ["error", "drop", "latency"])
+@pytest.mark.parametrize("seed", [5, 23])
+def test_spmd_coalesce_seam_fault_parity(kind, seed):
+    """batch.coalesce fault schedules on the SPMD mesh: a seam failure
+    degrades the WHOLE group to per-query solo execution with identical
+    results — parity-or-crisp, never cross-member bleed, never
+    truncated (the single-device chaos contract, multi-chip edition)."""
+    store = _store(devices=2)
+    want = [
+        _fids(r)
+        for r in _concurrent(
+            store, [Query.cql(c) for c in PLAIN_MIX], enabled=False
+        )
+    ]
+    with faults.inject(f"batch.coalesce:{kind}=0.5", seed=seed):
+        got = _concurrent(store, [Query.cql(c) for c in PLAIN_MIX])
+    for w, g in zip(want, got):
+        assert w == _fids(g)
+
+
+def _hold_slot(ctl):
+    """Model the saturated steady state: hold one admission slot in a
+    detached context so even the first arrival passes the coalescer's
+    concurrency gate (the test_batch_coalesce idiom)."""
+    import contextvars
+
+    ctx = contextvars.Context()
+    admit = ctl.admit()
+    ctx.run(admit.__enter__)
+    return lambda: ctx.run(admit.__exit__, None, None, None)
