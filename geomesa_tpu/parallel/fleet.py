@@ -70,7 +70,7 @@ import functools
 import json
 import os
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 import socket
 import socketserver
 import subprocess
@@ -112,11 +112,35 @@ from geomesa_tpu.utils.retry import RetryPolicy
 # worker liveness states (the heartbeat membership machine)
 LIVE, SUSPECT, DEAD, OUT = "live", "suspect", "dead", "out"
 
-# budget for PASSIVE observation RPCs (telemetry, plan rollups): a
-# wedged worker must cost a health probe or sampler tick at most this,
-# never the full geomesa.fleet.rpc.timeout x retry ladder — the PR 10
-# passivity rule extended over the wire
+# budget for PASSIVE observation RPCs (telemetry, timeline, debug, plan
+# rollups): a wedged worker must cost a health probe or sampler tick at
+# most this, never the full geomesa.fleet.rpc.timeout x retry ladder —
+# the PR 10 passivity rule extended over the wire (default; the
+# geomesa.fleet.debug.budget knob overrides)
 _PASSIVE_RPC_BUDGET_S = 1.0
+
+
+def _passive_budget_s() -> float:
+    from geomesa_tpu.utils.config import FLEET_DEBUG_BUDGET
+
+    return FLEET_DEBUG_BUDGET.to_duration_s(_PASSIVE_RPC_BUDGET_S)
+
+
+def _stitch_max_bytes() -> int:
+    """The trace-stitching trailer budget in bytes: 0 when stitching is
+    off (``geomesa.fleet.trace.stitch``), else
+    ``geomesa.fleet.trace.max.bytes`` — an oversized worker subtree
+    degrades to the stub span with a reason-coded decision, never a
+    failed (or unbounded) reply."""
+    from geomesa_tpu.utils.config import (
+        FLEET_TRACE_MAX_BYTES,
+        FLEET_TRACE_STITCH,
+    )
+
+    if not FLEET_TRACE_STITCH.to_bool():
+        return 0
+    return max(0, FLEET_TRACE_MAX_BYTES.to_int() or 0)
+
 
 # server-reported error types the client re-raises as themselves, so the
 # coordinator's shard envelope (shed->replica, crisp timeout, failover)
@@ -295,6 +319,7 @@ class _WorkerState:
 
     def __init__(self, worker_id: int, root: str,
                  auths: Optional[List[str]] = None):
+        from geomesa_tpu.utils.audit import MetricsRegistry
         from geomesa_tpu.utils.config import SHARD_MAX_INFLIGHT, SHARD_QUEUE_DEPTH
         from geomesa_tpu.utils.plans import PlanRegistry
 
@@ -302,6 +327,12 @@ class _WorkerState:
         self.root = root
         self._auths = auths
         os.makedirs(root, exist_ok=True)
+        # ONE metrics registry shared by every partition sub-store (the
+        # plans-registry arrangement): worker-side query counters and
+        # class timers exist at all — without this the `timeline` op
+        # would diff empty registries and worker latency samples could
+        # never mint an exemplar
+        self.metrics = MetricsRegistry()
         self.admission = AdmissionController(
             SHARD_MAX_INFLIGHT.to_int() or 32,
             128 if SHARD_QUEUE_DEPTH.to_int() is None else SHARD_QUEUE_DEPTH.to_int(),
@@ -319,6 +350,20 @@ class _WorkerState:
         self.draining = False
         self.t_start = time.monotonic()
         self.recovered: Dict[str, Any] = {}
+        # the worker debug plane's trace section: the last N span trees
+        # captured for stitching trailers (the worker runs NO exporter —
+        # recording only happens when a coordinator asked for it, so
+        # this ring costs nothing on untraced traffic)
+        from geomesa_tpu.utils.config import FLEET_DEBUG_TRACES
+
+        self._recent_traces: deque = deque(
+            maxlen=max(1, FLEET_DEBUG_TRACES.to_int() or 16)
+        )
+        # on-demand flight-recorder tick state for the `timeline` op
+        # (the coordinator's sampler drives the cadence; the worker only
+        # diffs its registries between calls)
+        self._tl_sampler = None
+        self._tl_lock = threading.Lock()
         # reopen every partition already on disk NOW: each FsDataStore
         # open runs the PR 5 intent-journal recovery + scrub, so a
         # restarted worker repairs whatever the kill left behind BEFORE
@@ -338,7 +383,7 @@ class _WorkerState:
             path = os.path.join(self.root, partition)
             if not create and not os.path.isdir(path):
                 return None
-            st = FsDataStore(path, auths=self._auths)
+            st = FsDataStore(path, auths=self._auths, metrics=self.metrics)
             # partition sub-stores share the worker's plan-fingerprint
             # registry (the ShardWorker arrangement: fixed memory per
             # worker, one rollup read for the telemetry seam)
@@ -548,6 +593,142 @@ class _WorkerState:
             "cap": self.plans.cap,
         }, []
 
+    def note_trace(self, sp) -> None:
+        """Retain one stitching-captured span tree for the debug plane's
+        ``traces`` section (bounded ring; GIL-atomic append)."""
+        self._recent_traces.append(sp)
+
+    def _registries(self) -> List[Any]:
+        from geomesa_tpu.utils.audit import robustness_metrics
+        from geomesa_tpu.utils.devstats import devstats_metrics
+
+        # the shared store registry FIRST (its query.* names win — the
+        # TimelineSampler registry-priority rule)
+        return [self.metrics, robustness_metrics(), devstats_metrics()]
+
+    def op_timeline(self, head, payloads):
+        """One on-demand flight-recorder tick over this worker's
+        registries (store metrics per partition + the process-wide
+        robustness/devstats registries): counter/gauge/timer deltas
+        since the LAST timeline call, worker-side breaker states, and
+        the class timers' latency exemplars. The coordinator's sampler
+        calls this once per tick under the passive budget — the worker
+        keeps only the diff baseline, no thread and no ring of its own.
+        The first call primes the baseline and reports no deltas (the
+        TimelineSampler rule)."""
+        from geomesa_tpu.utils import audit, slo
+        from geomesa_tpu.utils.timeline import TimelineSampler
+
+        with self._tl_lock:
+            if self._tl_sampler is None:
+                self._tl_sampler = TimelineSampler(
+                    registries=self._registries(),
+                    interval_s=1.0, window_s=60.0,
+                )
+                # the coordinator's recorder is observing this worker:
+                # raise the exemplar hook here too (the sampler_for
+                # rule), so worker-minted latency samples carry the
+                # envelope trace id the stitched store can resolve
+                from geomesa_tpu.utils.config import SLO_EXEMPLARS
+
+                if SLO_EXEMPLARS.to_bool():
+                    audit.set_exemplars(True)
+            sampler = self._tl_sampler
+            regs = sampler.registries
+            snap = sampler.tick() or {}
+        exemplars: Dict[str, Dict[str, List[Any]]] = {}
+        class_timers = {meta["timer"] for meta in slo.CLASSES.values()}
+        for reg in regs:
+            for timer, slot in reg.exemplars().items():
+                if timer not in class_timers:
+                    continue
+                buckets = exemplars.setdefault(timer, {})
+                for b, (s, tid, wall) in slot["buckets"].items():
+                    buckets[str(b)] = [float(s), tid, float(wall)]
+        return {
+            "ok": 1,
+            "tick": snap,
+            "exemplars": exemplars,
+            "admission": self.admission.peek(),
+            "partitions": len(self._stores),
+            "plans": self.plans.top(5),
+            "draining": self.draining,
+            "pid": os.getpid(),
+        }, []
+
+    def op_debug(self, head, payloads):
+        """The worker half of the fleet debug plane: this worker's
+        traces/device/overload/recovery/plans sections, each assembled
+        under its own error isolation — one bad gauge must not blank
+        the whole worker entry in ``GET /debug/fleet`` or the incident
+        report (the REPORT_SECTIONS posture, per worker)."""
+
+        def _traces():
+            return [sp.to_dict() for sp in list(self._recent_traces)]
+
+        def _device():
+            from geomesa_tpu.utils.devstats import device_debug
+
+            return device_debug()
+
+        def _overload():
+            from geomesa_tpu.utils.audit import robustness_metrics
+            from geomesa_tpu.utils.breaker import breaker_states
+
+            counters, _g, _t, _tt = robustness_metrics().snapshot()
+            return {
+                "breakers": breaker_states(),
+                "admission": self.admission.snapshot(),
+                "counters": {
+                    k: v
+                    for k, v in sorted(counters.items())
+                    if k.startswith(("shed.", "breaker.", "deadline."))
+                },
+            }
+
+        def _recovery():
+            from geomesa_tpu.utils.audit import robustness_metrics
+
+            counters, _g, _t, _tt = robustness_metrics().snapshot()
+            parts = {}
+            with self._lock:
+                stores = dict(self._stores)
+            for p, st in sorted(stores.items()):
+                parts[p] = getattr(st, "last_recovery", None)
+            return {
+                "recovered_at_start": self.recovered,
+                "partitions": parts,
+                "counters": {
+                    k: v
+                    for k, v in sorted(counters.items())
+                    if k.startswith(
+                        ("recovery.", "journal.", "quarantine.")
+                    )
+                },
+            }
+
+        def _plans():
+            return self.plans.payload(n=int(head.get("n", 10)))
+
+        sections: Dict[str, Any] = {}
+        for name, fn in (
+            ("traces", _traces),
+            ("device", _device),
+            ("overload", _overload),
+            ("recovery", _recovery),
+            ("plans", _plans),
+        ):
+            try:
+                sections[name] = fn()
+            except Exception as e:  # noqa: BLE001 - isolate per section
+                sections[name] = {"error": f"{type(e).__name__}: {e}"}
+        return {
+            "ok": 1,
+            "worker": self.worker_id,
+            "pid": os.getpid(),
+            "sections": sections,
+        }, []
+
     def op_drain(self, head, payloads):
         """Stop admitting new scans; wait (bounded by the caller's
         ``timeout_s``) for in-flight ones to finish against their own
@@ -584,21 +765,60 @@ class _FleetHandler(socketserver.BaseRequestHandler):
                     ]
                 except (ConnectionError, ValueError, OSError):
                     return
+                # trace stitching (coordinator-driven): a request whose
+                # envelope carries ``stitch`` (the coordinator's trailer
+                # byte budget) FORCES the server span so the op's whole
+                # subtree records even though the worker runs no
+                # exporter; untraced traffic keeps the free no-op path
+                try:
+                    stitch_max = int(head.get("stitch") or 0)
+                except (TypeError, ValueError):
+                    stitch_max = 0
+                sp = None
                 try:
                     with trace.span(
                         f"fleet.server.{head.get('op', 'unknown')}",
                         trace_id=head.get("trace"),
+                        force=stitch_max > 0,
                         worker=state.worker_id,
-                    ):
+                    ) as sp:
                         with deadline.budget(envelope_budget(head)):
                             reply, frames = state.dispatch(head, payloads)
                 except ConnectionError:
                     return
                 except Exception as e:  # noqa: BLE001 - report to client
                     reply, frames = _error_reply(e), []
+                if stitch_max > 0 and sp is not None and sp.recording:
+                    # error replies stitch too — the subtree of a FAILED
+                    # op is exactly what the coordinator wants to see.
+                    # Oversized / unserializable trailers degrade to the
+                    # stub span client-side (reason-coded there); the
+                    # reply itself always succeeds.
+                    if head.get("op") != "ping":
+                        # a traced heartbeat would flood the debug
+                        # plane's small retained-trace ring with pings
+                        state.note_trace(sp)
+                    frames = list(frames)
+                    try:
+                        trailer = json.dumps(
+                            sp.to_dict(), default=str
+                        ).encode()
+                    except Exception:  # noqa: BLE001 - never fail the op
+                        reply["trace_error"] = 1
+                    else:
+                        if len(trailer) > stitch_max:
+                            reply["trace_over"] = len(trailer)
+                        else:
+                            frames.append(trailer)
+                            reply["trace_frame"] = 1
                 reply["frames"] = len(frames)
                 try:
-                    send_frame(sock, json.dumps(reply).encode())
+                    # default=str: the debug-plane replies (retained
+                    # span trees, device gauges) can carry numpy
+                    # scalars — a send-time TypeError would drop the
+                    # connection OUTSIDE op_debug's per-section
+                    # isolation and read as a dead worker
+                    send_frame(sock, json.dumps(reply, default=str).encode())
                     for b in frames:
                         send_frame(sock, b)
                 except OSError:
@@ -662,7 +882,7 @@ class _PlansProxy:
 
     def top(self, n: int = 5) -> List[Dict[str, Any]]:
         try:
-            with deadline.budget(_PASSIVE_RPC_BUDGET_S):
+            with deadline.budget(_passive_budget_s()):
                 resp, _ = self._client._rpc("plans", {"n": int(n)})
         except (OSError, QueryTimeout):
             return []
@@ -670,7 +890,7 @@ class _PlansProxy:
 
     def rows(self, sort: str = "time", n: int = 20) -> List[Dict[str, Any]]:
         try:
-            with deadline.budget(_PASSIVE_RPC_BUDGET_S):
+            with deadline.budget(_passive_budget_s()):
                 resp, _ = self._client._rpc(
                     "plans", {"n": int(n), "sort": sort}
                 )
@@ -760,8 +980,16 @@ class WorkerClient:
         and the socket timeout is re-derived PER ATTEMPT from
         ``min(geomesa.fleet.rpc.timeout, remaining budget)`` — a stalled
         worker costs at most the deadline, never the knob constant."""
-        with trace.span("fleet.rpc", op=op, shard=self.shard_id):
+        with trace.span("fleet.rpc", op=op, shard=self.shard_id) as sp:
             deadline.check("fleet.rpc")
+            # trace stitching rides the EXISTING request/reply — no new
+            # RPC on the hot path: only a recording coordinator span
+            # asks for the trailer, so untraced traffic's envelope (and
+            # the worker's no-op span path) is byte-identical to a
+            # stitching-disabled fleet
+            stitch_max = _stitch_max_bytes() if sp.recording else 0
+            if stitch_max > 0:
+                fields = dict(fields, stitch=stitch_max)
             try:
                 faults.fault_point("fleet.rpc")
             except faults.SimulatedCrash as e:
@@ -770,6 +998,12 @@ class WorkerClient:
                 # observes a dead peer — a ConnectionError every caller
                 # (scan failover, count chain, replica-write skip)
                 # already handles — exactly as a real kill surfaces
+                if stitch_max > 0:
+                    # the in-flight subtree died with the worker: the
+                    # stub fleet.rpc span stands, reason-coded
+                    decision(
+                        "fleet.trace", "worker_lost", shard=self.shard_id
+                    )
                 raise WorkerUnavailable(
                     f"fleet worker {self.shard_id} died mid-exchange: {e}"
                 ) from e
@@ -786,6 +1020,10 @@ class WorkerClient:
                 ]
             except OSError:
                 sock.close()
+                if stitch_max > 0:
+                    decision(
+                        "fleet.trace", "worker_lost", shard=self.shard_id
+                    )
                 # a recv that timed out BECAUSE the budget bounded the
                 # socket surfaces as a crisp QueryTimeout (the caller's
                 # slice expired — PR 6's lagging-shard verdict), not as
@@ -798,11 +1036,57 @@ class WorkerClient:
                 # unknown — never return it to the pool
                 sock.close()
                 raise
+            if stitch_max > 0:
+                self._absorb_trailer(sp, resp, frames)
             if resp.get("ok") != 1:
                 self._checkin(sock)
                 _raise_wire_error(resp)
             self._checkin(sock)
             return resp, frames
+
+    def _absorb_trailer(
+        self, sp, resp: Dict[str, Any], frames: List[bytes]
+    ) -> None:
+        """Graft the worker's span-subtree trailer under the fleet.rpc
+        span — or degrade to today's stub with a reason-coded
+        ``decision("fleet.trace", ...)``. Strictly best-effort: a bad
+        trailer must never fail a healthy reply.
+
+        Clock-skew re-anchor: the subtree is placed inside the RPC span
+        using only the COORDINATOR's clock observations — the rpc span's
+        own start and elapsed time plus the worker's (monotonic-derived)
+        subtree duration, centering the residual round-trip slack. The
+        worker's wall clock is never trusted (the remaining-budget
+        envelope posture, stream/netlog.py)."""
+        over = resp.pop("trace_over", None)
+        if over:
+            decision(
+                "fleet.trace", "over_budget",
+                shard=self.shard_id, bytes=int(over),
+            )
+            return
+        if resp.pop("trace_error", None):
+            decision("fleet.trace", "trailer_failed", shard=self.shard_id)
+            return
+        if not resp.pop("trace_frame", None):
+            return
+        buf = frames.pop() if frames else None
+        resp["frames"] = len(frames)
+        if buf is None or not sp.recording:
+            return
+        try:
+            sub = trace.Span.from_dict(json.loads(buf.decode()))
+            elapsed_ms = (time.perf_counter() - sp._t0) * 1000.0
+            anchor_ms = sp.start_ms + max(
+                0.0, elapsed_ms - sub.duration_ms
+            ) / 2.0
+            offset_ms = anchor_ms - sub.start_ms
+            trace.graft(sp, sub, offset_ms=offset_ms)
+            sub.set_attr("stitched", True)
+            sub.set_attr("shard", self.shard_id)
+            sub.set_attr("skew_ms", round(offset_ms, 3))
+        except Exception:  # noqa: BLE001 - stub span, reason-coded
+            decision("fleet.trace", "decode_failed", shard=self.shard_id)
 
     def _rpc(self, op: str, fields: Optional[Dict[str, Any]] = None,
              payloads: Optional[List[bytes]] = None):
@@ -888,9 +1172,42 @@ class WorkerClient:
         small budget — a WEDGED (not dead) worker must not stall every
         /healthz probe and 1 s sampler tick for the full RPC timeout."""
         try:
-            with deadline.budget(_PASSIVE_RPC_BUDGET_S):
+            with deadline.budget(_passive_budget_s()):
                 resp, _ = self._rpc("telemetry")
         except (OSError, QueryTimeout) as e:
+            return {"unreachable": True, "error": f"{type(e).__name__}: {e}"}
+        resp.pop("ok", None)
+        resp.pop("frames", None)
+        return resp
+
+    def timeline(self) -> Dict[str, Any]:
+        """One worker flight-recorder tick over the wire (op
+        ``timeline``): counter/gauge/timer deltas since the last call,
+        worker-side breaker states, admission depth, hot plan
+        fingerprints, and class-timer exemplars. Same passive contract
+        as ``telemetry`` — budget-bounded, unreachable workers report
+        themselves rather than stalling the coordinator's sampler — plus
+        whole-worker error isolation: ANY worker-side failure becomes
+        this worker's error entry, never a raised sampler tick."""
+        try:
+            with deadline.budget(_passive_budget_s()):
+                resp, _ = self._rpc("timeline")
+        except Exception as e:  # noqa: BLE001 - passive plane isolates
+            return {"unreachable": True, "error": f"{type(e).__name__}: {e}"}
+        resp.pop("ok", None)
+        resp.pop("frames", None)
+        return resp
+
+    def debug(self) -> Dict[str, Any]:
+        """The worker's debug plane (op ``debug``): traces/device/
+        overload/recovery/plans sections, each error-isolated worker-
+        side; a wedged worker yields an error entry under the passive
+        budget — and ANY failure yields this worker's error entry, never
+        a stalled (or 500ing) /debug/fleet or incident report."""
+        try:
+            with deadline.budget(_passive_budget_s()):
+                resp, _ = self._rpc("debug")
+        except Exception as e:  # noqa: BLE001 - passive plane isolates
             return {"unreachable": True, "error": f"{type(e).__name__}: {e}"}
         resp.pop("ok", None)
         resp.pop("frames", None)
@@ -1269,6 +1586,13 @@ class FleetSupervisor:
         with self._repair_lock:
             if self._stop.is_set():
                 return
+            with self._lock:
+                if self._state[i] != DEAD:
+                    # the worker was revived/respawned (operator revive,
+                    # an earlier repair) while this repair waited on the
+                    # lock: running anyway would SIGKILL the healthy new
+                    # process and restart it for nothing
+                    return
             try:
                 self._repair_one(i)
             except RuntimeError:
@@ -1896,6 +2220,66 @@ class FleetDataStore(ShardedDataStore):
 
     # -- observability -------------------------------------------------------
 
+    def _timeline_extra(self) -> Dict[str, Any]:
+        """The fleet edition of the per-shard timeline rollup: ONE
+        passive-budgeted ``timeline`` RPC per worker per tick serves
+        both the PR 11 ``shards`` block (admission/partitions/plans)
+        AND the per-worker flight-recorder deltas — worker-side
+        breakers, journal recovery, device stats, admission — merged
+        into a fleet rollup (``timeline.merge_worker_ticks``). A wedged
+        worker contributes an ``unreachable`` entry under the passive
+        budget, never a stalled sampler tick. The worker exemplars that
+        ride the reply are cached for ``slo.worst_exemplars`` and the
+        /metrics fleet exemplar lines."""
+        if self.transport != "process":
+            return super()._timeline_extra()
+        from geomesa_tpu.utils.timeline import merge_worker_ticks
+
+        shards: Dict[str, Any] = {}
+        workers: Dict[str, Any] = {}
+        exemplars: Dict[str, Dict[int, tuple]] = {}
+        for i, w in enumerate(self.workers):
+            row = w.timeline()
+            workers[str(i)] = row
+            shard: Dict[str, Any] = {
+                "breaker": self._breakers[i].peek_state,
+            }
+            if row.get("unreachable"):
+                shard["unreachable"] = True
+            else:
+                shard["admission"] = row.get("admission")
+                shard["partitions"] = row.get("partitions")
+                shard["plans"] = row.get("plans", [])
+                for timer, buckets in (row.get("exemplars") or {}).items():
+                    slot = exemplars.setdefault(timer, {})
+                    for b, ex in buckets.items():
+                        try:
+                            slot[int(b)] = (
+                                float(ex[0]), str(ex[1]), float(ex[2]), i,
+                            )
+                        except (TypeError, ValueError, IndexError):
+                            continue
+            shards[str(i)] = shard
+        # whole-dict swap (GIL-atomic): readers (slo engine, /metrics)
+        # never see a half-merged view
+        self._fleet_exemplar_cache = exemplars
+        return {
+            "shards": shards,
+            "fleet": {
+                "workers": workers,
+                "rollup": merge_worker_ticks(workers),
+            },
+        }
+
+    def _fleet_exemplars(self) -> Dict[str, Dict[int, tuple]]:
+        """Worker-minted class-timer exemplars, as gathered by the last
+        sampler tick: ``{timer: {bucket: (seconds, trace_id, wall_ms,
+        shard)}}``. Their trace ids are the envelope (= coordinator
+        query) ids, so with stitching on they resolve through the
+        coordinator's debug ring; with stitching off the shard
+        annotation still names where the sample ran."""
+        return getattr(self, "_fleet_exemplar_cache", {})
+
     def shards_snapshot(self) -> Dict[str, Any]:
         """LOCAL-ONLY (no wire RPCs): /healthz and /debug/overload call
         this on every probe, and N serial telemetry RPCs — up to the
@@ -1950,7 +2334,15 @@ class FleetDataStore(ShardedDataStore):
     def fleet_snapshot(self) -> Dict[str, Any]:
         """The /debug/fleet + /debug/report section: supervisor view
         (state machine, pids, restart counts) joined with each live
-        worker's over-the-wire telemetry."""
+        worker's over-the-wire telemetry and debug-plane sections.
+
+        Workers are gathered CONCURRENTLY: each worker's two passive
+        reads (telemetry + debug) are budget-bounded, but paying them
+        serially would stack into 2 x budget x N exactly when every
+        worker is wedged — the incident the report exists for. With the
+        fan-out the worst case is ~2 x the passive budget total."""
+        from concurrent.futures import ThreadPoolExecutor
+
         sup = (
             self.supervisor.snapshot() if self.supervisor is not None else {}
         )
@@ -1966,11 +2358,27 @@ class FleetDataStore(ShardedDataStore):
             },
             "health": self.fleet_health(),
         }
-        for i, w in enumerate(self.workers):
+
+        def gather(i: int, w: Any) -> Dict[str, Any]:
             row: Dict[str, Any] = dict(sup.get(str(i), {}))
             row["breaker"] = self._breakers[i].peek_state
             row["telemetry"] = w.telemetry()
-            out["workers"][str(i)] = row
+            # the fleet debug plane: each worker's traces/device/
+            # overload/recovery/plans sections (error-isolated worker-
+            # side; a wedged worker yields an unreachable entry under
+            # the passive budget — the incident report never stalls on
+            # one process)
+            dbg = getattr(w, "debug", None)
+            if callable(dbg):
+                row["debug"] = dbg()
+            return row
+
+        with ThreadPoolExecutor(
+            max_workers=max(1, min(8, len(self.workers)))
+        ) as pool:
+            rows = pool.map(gather, range(len(self.workers)), self.workers)
+            for i, row in enumerate(rows):
+                out["workers"][str(i)] = row
         return out
 
 

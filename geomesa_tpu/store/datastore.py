@@ -1086,6 +1086,24 @@ class TpuDataStore:
             "decisions": decisions,
             "stages": _stage_tree(root),
         }
+        # fleet queries: how much of the plan executed with worker-side
+        # attribution (parallel/fleet.py trace stitching) — each
+        # fleet.rpc either carries its grafted worker subtree (the scan/
+        # post-filter spans above came THROUGH the worker) or stands as
+        # a reason-coded stub (trailer over budget / worker lost /
+        # stitching off)
+        rpcs = root.find("fleet.rpc")
+        if rpcs:
+            stitched = sum(
+                1
+                for s in rpcs
+                if any(c.attributes.get("stitched") for c in s.children)
+            )
+            out["fleet"] = {
+                "rpcs": len(rpcs),
+                "stitched": stitched,
+                "stubs": len(rpcs) - stitched,
+            }
         return out
 
     def query(self, name: str, query: Union[str, Query] = "INCLUDE") -> QueryResult:

@@ -714,6 +714,33 @@ def prometheus_text(registries, prefix: str = "geomesa") -> str:
     return "\n".join(lines) + "\n"
 
 
+def fleet_exemplar_text(
+    exemplars: Dict[str, Dict[int, tuple]], prefix: str = "geomesa"
+) -> str:
+    """Comment-line exposition of WORKER-minted timer exemplars (the
+    fleet coordinator's ``_fleet_exemplars`` cache, parallel/fleet.py):
+    worker timers live in other processes, so they cannot render as
+    registry summaries here — but their worst exemplars must not
+    silently vanish from the coordinator's scrape. Same '# exemplar:'
+    comment discipline as ``prometheus_text`` (ignored by every parser,
+    still links trace ids in the scrape body), with a ``shard`` label
+    naming the worker that paid the latency."""
+    lines: List[str] = []
+    for timer in sorted(exemplars):
+        buckets = exemplars[timer]
+        if not buckets:
+            continue
+        s, tid, ts, shard = buckets[max(buckets)]
+        if not tid:
+            continue
+        p = _prom_name(timer, prefix)
+        lines.append(
+            f'# exemplar: {p}{{quantile="0.99",shard="{int(shard)}"}} '
+            f'trace_id="{tid}" value={s:g} ts={ts / 1000.0:.3f}'
+        )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 class PrometheusReporter(Reporter):
     """Prometheus edition of the scheduled reporters: writes the text
     exposition atomically to ``path`` on every interval (the

@@ -206,6 +206,17 @@ FLEET_FLAP_WINDOW = SystemProperty("geomesa.fleet.flap.window", "60 seconds")
 FLEET_DRAIN_TIMEOUT = SystemProperty("geomesa.fleet.drain.timeout", "10 seconds")
 FLEET_RPC_TIMEOUT = SystemProperty("geomesa.fleet.rpc.timeout", "10 seconds")
 FLEET_SPAWN_TIMEOUT = SystemProperty("geomesa.fleet.spawn.timeout", "30 seconds")
+# fleet observability: cross-process trace stitching (worker span
+# subtrees return in a bounded reply trailer and graft under the
+# coordinator's fleet.rpc span) and the fleet debug plane's passive
+# observation budget (telemetry/timeline/debug/plans RPCs — a wedged
+# worker costs a probe at most this, never the rpc.timeout x retries)
+FLEET_TRACE_STITCH = SystemProperty("geomesa.fleet.trace.stitch", "true")
+FLEET_TRACE_MAX_BYTES = SystemProperty(
+    "geomesa.fleet.trace.max.bytes", "262144"
+)
+FLEET_DEBUG_BUDGET = SystemProperty("geomesa.fleet.debug.budget", "1 second")
+FLEET_DEBUG_TRACES = SystemProperty("geomesa.fleet.debug.traces", "16")
 # Spatial placement granularity: partitions are low-resolution z2 cells
 # of the point geometry (store/partitions.Z2Scheme, `bits` even), so a
 # bbox query routes to the shards owning intersecting cells only;

@@ -261,22 +261,40 @@ class SloEngine:
     def worst_exemplars(self, cls: str, n: int = 3) -> List[Dict[str, Any]]:
         """The class timer's worst retained exemplars (highest occupied
         latency buckets first): ``[{ms, trace_id, date_ms}]`` with ids
-        resolvable in /debug/traces while the debug ring retains them."""
+        resolvable in /debug/traces while the debug ring retains them.
+
+        On a fleet coordinator, worker-minted exemplars (shipped by the
+        ``timeline`` RPC, parallel/fleet.py) merge in with a ``shard``
+        annotation: their trace ids are the envelope ids, so with trace
+        stitching on they resolve to the SAME stitched trees — and with
+        stitching off the shard number still says where the latency was
+        paid instead of the sample silently vanishing. A local exemplar
+        wins a bucket collision (it resolves without any wire help)."""
         timer = CLASSES[cls]["timer"]
         best: Dict[int, tuple] = {}
+        # worker-minted first, so local registries override per bucket
+        store = self.sampler._store()
+        fleet_fn = getattr(store, "_fleet_exemplars", None)
+        if callable(fleet_fn):
+            for b, ex in (fleet_fn().get(timer) or {}).items():
+                best[int(b)] = ex  # (s, tid, wall_ms, shard)
         for reg in self.sampler.registries:
             slot = reg.exemplars(timer)
             if slot:
                 for b, ex in slot["buckets"].items():
-                    best[b] = ex
+                    best[b] = ex  # (s, tid, wall_ms)
         out = []
         for b in sorted(best, reverse=True)[:n]:
-            s, tid, wall = best[b]
-            out.append({
+            ex = best[b]
+            s, tid, wall = ex[0], ex[1], ex[2]
+            row = {
                 "ms": round(s * 1000.0, 3),
                 "trace_id": tid,
                 "date_ms": int(wall),
-            })
+            }
+            if len(ex) > 3:
+                row["shard"] = int(ex[3])
+            out.append(row)
         return out
 
 

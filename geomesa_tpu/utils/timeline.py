@@ -312,6 +312,61 @@ class TimelineSampler:
             t.join(timeout=2.0)
 
 
+def merge_worker_ticks(workers: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-worker flight-recorder ticks (the fleet ``timeline`` RPC
+    replies, parallel/fleet.py) into one fleet-rollup block:
+
+    * **counters** — per-tick deltas SUM across workers (additive by
+      construction);
+    * **timers** — count/sum_ms sum, latency-bucket histograms merged
+      bucket-wise (so the SLO bucket rule applies to the rollup too);
+    * **breakers** — only each worker's NON-closed breakers, keyed by
+      worker (a silently degrading worker — device breaker open, host
+      scans — becomes visible from the coordinator);
+    * **unreachable** — workers whose tick did not answer under the
+      passive budget.
+
+    Gauges are deliberately NOT rolled up: summing HBM residency or pad
+    ratios across processes is a lie; the per-worker blocks keep them."""
+    rollup: Dict[str, Any] = {
+        "workers": 0,
+        "counters": {},
+        "timers": {},
+        "breakers": {},
+        "unreachable": [],
+    }
+    counters = rollup["counters"]
+    timers = rollup["timers"]
+    for wid in sorted(workers):
+        row = workers[wid]
+        if not isinstance(row, dict) or row.get("unreachable"):
+            rollup["unreachable"].append(wid)
+            continue
+        rollup["workers"] += 1
+        tick = row.get("tick") or {}
+        for k, v in (tick.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        for name, t in (tick.get("timers") or {}).items():
+            acc = timers.setdefault(
+                name, {"count": 0, "sum_ms": 0.0, "hist": {}}
+            )
+            acc["count"] += int(t.get("count", 0))
+            acc["sum_ms"] = round(
+                acc["sum_ms"] + float(t.get("sum_ms", 0.0)), 3
+            )
+            for b, n in (t.get("hist") or {}).items():
+                b = str(b)
+                acc["hist"][b] = acc["hist"].get(b, 0) + int(n)
+        open_b = sorted(
+            name
+            for name, state in (tick.get("breakers") or {}).items()
+            if state != "closed"
+        )
+        if open_b:
+            rollup["breakers"][wid] = open_b
+    return rollup
+
+
 # -- per-store samplers -------------------------------------------------------
 #
 # One sampler per store, refcounted like trace.ensure_ring: each server

@@ -131,6 +131,25 @@ class Span:
             out["children"] = [c.to_dict() for c in self.children]
         return out
 
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Span":
+        """Inverse of ``to_dict`` — rebuilds a span tree from its wire
+        form (the fleet trace-stitching trailer, parallel/fleet.py).
+        Ids and timings are kept verbatim; the caller re-anchors wall
+        times via ``graft`` (a remote clock is never trusted as-is)."""
+        sp = cls.__new__(cls)
+        sp.name = str(d.get("name", ""))
+        sp.trace_id = str(d.get("trace_id", ""))
+        sp.span_id = str(d.get("span_id") or _new_id())
+        sp.parent_id = d.get("parent_id")
+        sp.start_ms = float(d.get("start_ms", 0.0))
+        sp.duration_ms = float(d.get("duration_ms", 0.0))
+        sp.attributes = dict(d.get("attributes") or {})
+        sp.events = list(d.get("events") or [])
+        sp.children = [cls.from_dict(c) for c in d.get("children") or ()]
+        sp._t0 = 0.0  # deserialized spans are closed; never re-timed
+        return sp
+
     def render(self, indent: int = 0) -> str:
         """Human-readable indented tree (the Explainer's indentation
         idiom, index/planner.py Explainer)."""
@@ -279,6 +298,26 @@ def set_attr(key: str, value: Any) -> None:
     sp = _CURRENT.get()
     if sp is not None:
         sp.set_attr(key, value)
+
+
+def graft(parent: Span, sub: Span, offset_ms: float = 0.0) -> Span:
+    """Attach a deserialized remote subtree under ``parent`` — the
+    coordinator half of fleet trace stitching (parallel/fleet.py).
+
+    Every span in the subtree is re-keyed onto the parent's trace id
+    (the remote side opened its root with the envelope's id, but a
+    dropped/foreign id must not fracture the tree) and its wall-clock
+    ``start_ms`` is shifted by ``offset_ms`` — the caller computes the
+    offset from its OWN clock observations (RPC span start + elapsed)
+    plus the remote span's monotonic-derived durations, so a skewed
+    remote wall clock can never place the subtree outside the RPC that
+    carried it. Span-relative event times need no shift."""
+    sub.parent_id = parent.span_id
+    for s in sub.walk():
+        s.trace_id = parent.trace_id
+        s.start_ms += offset_ms
+    parent.children.append(sub)
+    return sub
 
 
 def active() -> bool:

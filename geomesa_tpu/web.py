@@ -741,8 +741,22 @@ def make_handler(store):
                         regs.append(store.metrics)
                     regs.append(robustness_metrics())
                     regs.append(devstats_metrics())
+                    text = prometheus_text(regs)
+                    # fleet coordinators append WORKER-minted exemplar
+                    # comment lines (parallel/fleet.py): worker timers
+                    # live in other processes, but their worst samples
+                    # must not silently vanish from the scrape — each
+                    # carries its shard label and the envelope trace id
+                    # the stitched /debug/traces store resolves
+                    fx = getattr(store, "_fleet_exemplars", None)
+                    if callable(fx):
+                        from geomesa_tpu.utils.audit import (
+                            fleet_exemplar_text,
+                        )
+
+                        text += fleet_exemplar_text(fx())
                     self._send(
-                        200, prometheus_text(regs),
+                        200, text,
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
                 elif route == "/healthz":

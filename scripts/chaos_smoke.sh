@@ -66,6 +66,14 @@
 #     /healthz clears; a coordinator SimulatedCrash at every
 #     fleet.rebalance position recovers to exactly the pre- or
 #     post-move placement
+#   - stitched traces under fleet faults (tests/test_fleet.py): under
+#     fleet.rpc error/drop/crash schedules every query is parity-or-
+#     crisp AND every retained trace's fleet.rpc spans are each either
+#     fully stitched (the worker's span subtree grafted under them) or
+#     a stub with a reason (error/fault event or a reason-coded
+#     fleet.trace decision); a real SIGKILL's in-flight subtree
+#     degrades to the stub path while the failover attempt against the
+#     replica still stitches
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail

@@ -129,6 +129,59 @@ for f in geomesa_tpu/parallel/executor.py geomesa_tpu/parallel/batch.py \
     fi
 done
 
+# 6. Fleet observation plane (PR 15) — the cross-process observability
+#    RPCs must stay span-wrapped (the fleet.rpc pin above covers the
+#    transport) AND passive-budget-paired: telemetry/timeline/debug/plan
+#    reads against a WEDGED worker may cost a /healthz probe, a sampler
+#    tick, or an incident report at most geomesa.fleet.debug.budget
+#    each, never the rpc.timeout x retry ladder. The trace-stitching
+#    trailer must keep its reason-coded degradation, and the worker
+#    debug plane must keep every per-worker section the incident report
+#    promises.
+FLEET=geomesa_tpu/parallel/fleet.py
+for op in op_telemetry op_timeline op_debug op_plans; do
+    if ! grep -qE "def ${op}\(" "$FLEET"; then
+        echo "FAIL: ${FLEET} lost its worker-side ${op}() handler"
+        echo "      (the fleet debug plane serves it — see _WorkerState)"
+        fail=1
+    fi
+done
+for fn in telemetry timeline debug; do
+    body=$(sed -n "/    def ${fn}(self)/,/    def /p" "$FLEET")
+    if ! printf '%s\n' "$body" | grep -q '_passive_budget_s()'; then
+        echo "FAIL: WorkerClient.${fn}() in ${FLEET} is not passive-budget-"
+        echo "      paired (deadline.budget(_passive_budget_s()) — a wedged"
+        echo "      worker must cost a probe at most the debug budget)"
+        fail=1
+    fi
+done
+if [ "$(grep -c 'deadline.budget(_passive_budget_s())' "$FLEET")" -lt 5 ]; then
+    echo "FAIL: ${FLEET} lost passive-budget pairing on its observation"
+    echo "      RPCs (telemetry/timeline/debug + the _PlansProxy reads)"
+    fail=1
+fi
+for reason in over_budget trailer_failed decode_failed worker_lost; do
+    if ! grep -q "\"${reason}\"" "$FLEET"; then
+        echo "FAIL: ${FLEET} lost the reason-coded fleet.trace decision"
+        echo "      '${reason}' — trailer degradation must stay attributable"
+        fail=1
+    fi
+done
+for sec in traces device overload recovery plans; do
+    if ! grep -q "(\"${sec}\", _${sec})" "$FLEET"; then
+        echo "FAIL: worker debug plane in ${FLEET} lost its '${sec}' section"
+        echo "      (op_debug must keep every per-worker section the"
+        echo "       incident report's fleet block promises)"
+        fail=1
+    fi
+done
+if ! grep -q 'row\["debug"\]' "$FLEET"; then
+    echo "FAIL: fleet_snapshot in ${FLEET} no longer attaches per-worker"
+    echo "      debug sections — /debug/fleet and the incident report must"
+    echo "      carry every worker's debug plane"
+    fail=1
+fi
+
 if [ "$fail" -eq 0 ]; then
     echo "observability lint clean"
 fi

@@ -338,6 +338,53 @@ def watch_server(url: str) -> None:
         if open_breakers:
             parts.append(f"breakers={','.join(open_breakers)}")
         print(f"[{time.strftime('%H:%M:%S')}] " + " ".join(parts), flush=True)
+        # fleet coordinators: the merged per-worker timeline rollup
+        # (parallel/fleet.py `timeline` RPC) — one sub-line per worker
+        # plus the fleet fold, so a silently degrading worker (breaker
+        # open, host scans) is visible from the same watch
+        fleet = snaps[-1].get("fleet") if snaps else None
+        if fleet:
+            roll = fleet.get("rollup", {})
+            rparts = [
+                f"workers={roll.get('workers', 0)}",
+                f"q={roll.get('counters', {}).get('queries', 0)}",
+            ]
+            scan = roll.get("timers", {}).get("query.scan", {})
+            if scan.get("count"):
+                rparts.append(
+                    f"scan={scan['count']}x/{scan.get('sum_ms', 0):.0f}ms"
+                )
+            if roll.get("unreachable"):
+                rparts.append(f"unreachable={','.join(roll['unreachable'])}")
+            # worker ids are numeric strings: sort as ints so w10 does
+            # not interleave between w1 and w2
+            by_wid = lambda k: int(k) if str(k).isdigit() else 0  # noqa: E731
+            for wid, names in sorted(
+                roll.get("breakers", {}).items(), key=lambda kv: by_wid(kv[0])
+            ):
+                rparts.append(f"w{wid}.breakers={','.join(names)}")
+            print("  fleet: " + " ".join(rparts), flush=True)
+            for wid in sorted(fleet.get("workers", {}), key=by_wid):
+                row = fleet["workers"][wid]
+                if row.get("unreachable"):
+                    print(f"    w{wid}: UNREACHABLE {row.get('error', '')}",
+                          flush=True)
+                    continue
+                tick = row.get("tick") or {}
+                wc = tick.get("counters") or {}
+                adm = row.get("admission") or {}
+                wl = [
+                    f"q={wc.get('queries', 0)}",
+                    f"adm={adm.get('inflight', 0)}+{adm.get('queued', 0)}q",
+                    f"parts={row.get('partitions', 0)}",
+                ]
+                wopen = sorted(
+                    n for n, st_ in (tick.get("breakers") or {}).items()
+                    if st_ != "closed"
+                )
+                if wopen:
+                    wl.append(f"breakers={','.join(wopen)}")
+                print(f"    w{wid}: " + " ".join(wl), flush=True)
         time.sleep(refresh)
 
 

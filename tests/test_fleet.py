@@ -738,3 +738,346 @@ def test_process_drain_worker(fleet):
     # undrain for any later test: revive restarts the process fresh
     fleet.supervisor.revive(2)
     assert _await(lambda: fleet.supervisor.all_live())
+
+
+# -- fleet observability: trace stitching, merged timeline, debug plane ------
+#
+# PR 15: the observability stack crosses the fleet wire. Worker span
+# subtrees return in a bounded reply trailer and graft under the
+# coordinator's fleet.rpc span (clock-skew re-anchored from the
+# coordinator's own observations); worker flight-recorder deltas and
+# class-timer exemplars ride a passive `timeline` RPC; the `debug` RPC
+# exposes each worker's traces/device/overload/recovery/plans sections
+# with per-section error isolation.
+
+from geomesa_tpu.utils import trace  # noqa: E402
+
+
+def _stitched_children(sp):
+    return [c for c in sp.children if c.attributes.get("stitched")]
+
+
+def _stub_reasons(sp):
+    return [
+        ev for ev in sp.events
+        if ev["name"].startswith(("decision.fleet.trace", "error", "fault."))
+    ]
+
+
+def _workers_reachable(fleet):
+    return all(
+        not fleet.workers[i].telemetry().get("unreachable")
+        for i in range(len(fleet.workers))
+    )
+
+
+def _settled_stitch_verdict(sp, timeout_s=5.0):
+    """stitched | stub verdict for one fleet.rpc span, waiting out the
+    abandoned-attempt race: a hedge loser / late failover attempt may
+    still be finishing its exchange (and grafting its trailer) after
+    the query root already exported — the span tree is append-only, so
+    poll briefly before judging the span a reasonless stub."""
+    t0 = time.monotonic()
+    while True:
+        if _stitched_children(sp):
+            return "stitched"
+        if _stub_reasons(sp):
+            return "stub"
+        if time.monotonic() - t0 > timeout_s:
+            return "unresolved"
+        time.sleep(0.05)
+
+
+def test_trace_stitching_end_to_end(fleet, baseline):
+    """A traced fleet query's tree contains the WORKER-side spans: each
+    fleet.rpc span carries a grafted fleet.server.scan subtree whose
+    descendants are the worker's own plan/scan/post-filter spans, all
+    re-keyed onto the coordinator's trace id and re-anchored inside the
+    rpc span's window."""
+    ring = trace.InMemoryTraceExporter(capacity=64, root_names=("query",))
+    q = "BBOX(geom, 0, 0, 60, 60)"
+    with trace.exporting(ring):
+        got = sorted(fleet.query("t", q).fids)
+    assert got == baseline[q]
+    tr = ring.traces[-1]
+    rpcs = tr.find("fleet.rpc")
+    assert rpcs, tr.render()
+    subs = [c for sp in rpcs for c in _stitched_children(sp)]
+    assert subs, tr.render()
+    for sub in subs:
+        assert sub.name == "fleet.server.scan"
+        assert isinstance(sub.attributes.get("shard"), int)
+        assert "skew_ms" in sub.attributes
+        names = {s.name for s in sub.walk()}
+        # the worker's own pipeline spans came through the wire
+        assert "query" in names and "scan.block" in names, sorted(names)
+        for s in sub.walk():
+            # one trace id end to end: find_trace/exemplar resolution
+            # works on the stitched tree
+            assert s.trace_id == tr.trace_id
+    # re-anchor places every subtree inside its rpc span's wall window
+    for sp in rpcs:
+        for sub in _stitched_children(sp):
+            assert sub.start_ms >= sp.start_ms - 1.0
+
+
+def test_stitching_off_leaves_stub_and_no_decisions(fleet, baseline):
+    """geomesa.fleet.trace.stitch=false: byte-identical behavior to the
+    pre-stitching fleet — stub fleet.rpc spans, no trailer fields, and
+    no fleet.trace decision counters."""
+    m = robustness_metrics()
+    before = {
+        k: v for k, v in m.snapshot()[0].items()
+        if k.startswith("decision.fleet.trace")
+    }
+    ring = trace.InMemoryTraceExporter(capacity=64, root_names=("query",))
+    with properties(geomesa_fleet_trace_stitch="false"):
+        with trace.exporting(ring):
+            got = sorted(fleet.query("t", "INCLUDE").fids)
+    assert got == baseline["INCLUDE"]
+    rpcs = [sp for tr in ring.traces for sp in tr.find("fleet.rpc")]
+    assert rpcs
+    assert not any(_stitched_children(sp) for sp in rpcs)
+    after = {
+        k: v for k, v in m.snapshot()[0].items()
+        if k.startswith("decision.fleet.trace")
+    }
+    assert after == before
+
+
+def test_trailer_over_budget_degrades_with_reason(fleet, baseline):
+    """An oversized worker subtree degrades to today's stub span with a
+    reason-coded decision("fleet.trace", "over_budget") — never a failed
+    query."""
+    m = robustness_metrics()
+    before = m.counter("decision.fleet.trace.over_budget")
+    ring = trace.InMemoryTraceExporter(capacity=64, root_names=("query",))
+    with properties(geomesa_fleet_trace_max_bytes="8"):
+        with trace.exporting(ring):
+            got = sorted(fleet.query("t", "INCLUDE").fids)
+    assert got == baseline["INCLUDE"]
+    assert m.counter("decision.fleet.trace.over_budget") > before
+    rpcs = [sp for tr in ring.traces for sp in tr.find("fleet.rpc")]
+    assert rpcs and not any(_stitched_children(sp) for sp in rpcs)
+    assert any(
+        ev["name"] == "decision.fleet.trace"
+        and ev.get("reason") == "over_budget"
+        for sp in rpcs
+        for ev in sp.events
+    )
+
+
+def test_explain_analyze_attributes_through_the_worker(fleet):
+    """POST /explain's engine over a fleet: the annotated plan tree
+    reaches THROUGH the worker (stitched fleet.server.scan stages with
+    the worker's scan.block children) and the >=90% self-time
+    attribution contract holds end to end."""
+    out = fleet.explain_analyze("t", "BBOX(geom, -60, -60, 60, 60)")
+    assert out["fleet"]["rpcs"] >= 1
+    assert out["fleet"]["stitched"] == out["fleet"]["rpcs"]
+    assert out["fleet"]["stubs"] == 0
+    assert out["attribution"]["fraction"] >= 0.9
+
+    def walk(stage):
+        yield stage
+        for c in stage.get("stages", ()):
+            yield from walk(c)
+
+    names = [s["stage"] for s in walk(out["stages"])]
+    assert "fleet.server.scan" in names
+    assert "scan.block" in names  # worker-side blocks in the stage tree
+    # worker blocks feed the actuals: a fleet EXPLAIN sees rows scanned
+    assert out["actual"]["rows_scanned"] > 0
+
+
+def test_fleet_timeline_rollup_and_worker_exemplars(fleet):
+    """The merged timeline: one passive `timeline` RPC per worker per
+    tick folds worker counter/timer deltas into per-worker series and a
+    fleet rollup, and worker-minted class-timer exemplars surface with
+    a shard annotation through the SLO engine and /metrics."""
+    from geomesa_tpu.utils.audit import fleet_exemplar_text
+    from geomesa_tpu.utils.slo import SloEngine
+    from geomesa_tpu.utils.timeline import TimelineSampler
+
+    assert _await(lambda: _fleet_settled(fleet))
+    assert _await(lambda: _workers_reachable(fleet), timeout_s=15.0)
+    sampler = TimelineSampler(fleet, interval_s=0.05, window_s=10.0)
+    sampler.tick()  # primes coordinator AND worker baselines
+    # traced queries: the envelope trace id is what worker-side timer
+    # exemplars must carry (untraced traffic mints blank ids)
+    ring = trace.InMemoryTraceExporter(capacity=16, root_names=("query",))
+    with trace.exporting(ring):
+        for _ in range(3):
+            fleet.query("t", "INCLUDE")
+    snap = sampler.tick()
+    fl = snap["fleet"]
+    assert set(fl["workers"]) == {"0", "1", "2"}
+    roll = fl["rollup"]
+    assert roll["workers"] == 3 and roll["unreachable"] == [], fl["workers"]
+    # worker-side query work is visible from the coordinator
+    assert roll["counters"].get("queries", 0) > 0
+    assert roll["timers"]["query.scan"]["count"] > 0
+    assert sum(roll["timers"]["query.scan"]["hist"].values()) > 0
+    # the per-shard block still carries admission/partitions/plans
+    for shard in snap["shards"].values():
+        assert "admission" in shard and "breaker" in shard
+    # worker-minted exemplars: shard-annotated, trace ids resolvable
+    # through the stitched store (the envelope id IS the query id)
+    ex = fleet._fleet_exemplars()
+    assert ex.get("query.scan"), ex
+    eng = SloEngine(sampler)
+    worst = eng.worst_exemplars("query")
+    assert any("shard" in row for row in worst), worst
+    text = fleet_exemplar_text(fleet._fleet_exemplars())
+    assert "# exemplar:" in text and 'shard="' in text
+    # a worker-minted exemplar id is a coordinator query id: it resolves
+    # against the stitched trace store (here, the test ring)
+    ring_ids = {t.trace_id for t in ring.traces}
+    assert any(
+        row.get("trace_id") in ring_ids for row in worst if "shard" in row
+    ), (worst, ring_ids)
+
+
+def test_debug_fleet_per_worker_sections(fleet):
+    """The fleet debug plane: every worker contributes its traces/
+    device/overload/recovery/plans sections to /debug/fleet (and so to
+    the incident report), each error-isolated."""
+    ring = trace.InMemoryTraceExporter(capacity=16, root_names=("query",))
+    with trace.exporting(ring):
+        fleet.query("t", "INCLUDE")  # stitching retains worker traces
+    snap = fleet.fleet_snapshot()
+    assert set(snap["workers"]) == {"0", "1", "2"}
+    got_traces = 0
+    for row in snap["workers"].values():
+        sections = row["debug"]["sections"]
+        assert set(sections) == {
+            "traces", "device", "overload", "recovery", "plans",
+        }
+        assert "breakers" in sections["overload"]
+        assert "admission" in sections["overload"]
+        assert "counters" in sections["recovery"]
+        assert "fingerprints" in sections["plans"]
+        got_traces += len(sections["traces"])
+    # at least one worker retained the stitching-captured span tree
+    assert got_traces > 0
+
+
+def test_incident_report_isolates_a_wedged_worker(tmp_path, baseline):
+    """Satellite: a worker that stops responding (SIGSTOP — wedged, not
+    dead) must cost the incident report at most the passive budget per
+    observation RPC and yield an unreachable/error entry for ITS
+    section — never a 500 or a full-rpc.timeout stall."""
+    from geomesa_tpu.web import incident_report
+
+    st = ingest(
+        FleetDataStore(
+            str(tmp_path / "fleet_wedge"), num_workers=2, replicas=1,
+            partition_bits=2, supervise=False,
+        )
+    )
+    try:
+        pid = st.supervisor.worker_pid(0)
+        os.kill(pid, signal.SIGSTOP)
+        try:
+            t0 = time.monotonic()
+            rep = incident_report(st, 30.0)
+            dt = time.monotonic() - t0
+            fl = rep["sections"]["fleet"]
+            assert fl["fleet"] is True
+            w0 = fl["workers"]["0"]
+            assert w0["telemetry"].get("unreachable") is True
+            assert w0["debug"].get("unreachable") is True
+            # the live worker's sections still assembled
+            assert "sections" in fl["workers"]["1"]["debug"]
+            # bounded: passive budgets, never the rpc.timeout ladder
+            assert dt < 20.0, dt
+        finally:
+            os.kill(pid, signal.SIGCONT)
+        # the fleet still answers once the worker resumes
+        assert sorted(st.query("t", "INCLUDE").fids) == baseline["INCLUDE"]
+    finally:
+        st.close()
+
+
+@pytest.mark.chaos
+def test_stitched_trace_chaos_parity_or_stub_with_reason(tmp_path, baseline):
+    """Satellite soak: under fleet.rpc error/drop/crash schedules every
+    query is parity-or-crisp AND every retained trace's fleet.rpc spans
+    are each either fully stitched or a stub with a reason (error/fault
+    event or a reason-coded fleet.trace decision)."""
+    st = ingest(
+        FleetDataStore(
+            str(tmp_path / "fleet_stitch_chaos"), num_workers=3,
+            replicas=1, partition_bits=2, supervise=False,
+        )
+    )
+    try:
+        ring = trace.InMemoryTraceExporter(
+            capacity=512, root_names=("query",)
+        )
+        with trace.exporting(ring):
+            for kind in ("error", "drop", "crash"):
+                for seed in (1, 2):
+                    with faults.inject(f"fleet.rpc:{kind}=0.3", seed=seed):
+                        for q in QUERIES:
+                            try:
+                                got = sorted(st.query("t", q).fids)
+                            except (QueryTimeout, ShardUnavailable):
+                                continue  # crisp, never truncated
+                            assert got == baseline[q], (kind, seed, q)
+        checked = stubs = 0
+        for tr in ring.traces:
+            for sp in tr.find("fleet.rpc"):
+                checked += 1
+                verdict = _settled_stitch_verdict(sp)
+                assert verdict != "unresolved", tr.render()
+                if verdict == "stub":
+                    stubs += 1
+        assert checked > 0
+        assert stubs > 0  # the schedules did produce degraded spans
+    finally:
+        st.close()
+
+
+@pytest.mark.chaos
+def test_sigkill_inflight_subtree_degrades_to_stub(fleet, baseline):
+    """Satellite: a real SIGKILL. RPCs against the corpse degrade to
+    the stub span with a reason; the failover attempt against the
+    replica still stitches; the supervisor heals the fleet."""
+    assert _await(lambda: _fleet_settled(fleet))
+    assert _await(lambda: _workers_reachable(fleet), timeout_s=15.0)
+    victim = fleet.placement.primary(fleet._all_partitions()[0])
+    pid = fleet.supervisor.worker_pid(victim)
+    ring = trace.InMemoryTraceExporter(capacity=64, root_names=("query",))
+    with trace.exporting(ring):
+        os.kill(pid, signal.SIGKILL)
+        for q in QUERIES:
+            try:
+                got = sorted(fleet.query("t", q).fids)
+            except (QueryTimeout, ShardUnavailable):
+                continue
+            assert got == baseline[q]
+    stubs = stitched = 0
+    for tr in ring.traces:
+        for sp in tr.find("fleet.rpc"):
+            verdict = _settled_stitch_verdict(sp)
+            assert verdict != "unresolved", tr.render()
+            if verdict == "stitched":
+                stitched += 1
+            else:
+                stubs += 1
+    assert stubs >= 1  # the in-flight/first attempts hit the corpse
+    assert stitched >= 1  # failover attempts still stitched
+    # heal: the suite may have killed this worker before (flap-out is
+    # legitimate supervisor behavior inside the window) — revive clears
+    # the verdict, then the fleet must fully settle
+    from geomesa_tpu.parallel.fleet import OUT
+
+    assert _await(
+        lambda: _fleet_settled(fleet)
+        or fleet.supervisor.states()[victim] == OUT,
+        timeout_s=30.0,
+    )
+    if fleet.supervisor.states()[victim] == OUT:
+        fleet.supervisor.revive(victim)
+    assert _await(lambda: _fleet_settled(fleet), timeout_s=30.0)

@@ -765,3 +765,82 @@ def test_fs_block_spans_on_lazy_replay(tmp_path, monkeypatch):
         "lazy replay did not nest block reads under the query trace:\n"
         + roots[-1].render()
     )
+
+
+# -- span wire form + grafting (PR 15: fleet trace stitching) -----------------
+
+
+def test_span_from_dict_roundtrip():
+    """Span.from_dict is the exact inverse of to_dict — the fleet trace
+    trailer (parallel/fleet.py) must rebuild the worker's subtree with
+    ids, timings, attributes, events, and nesting intact."""
+    from geomesa_tpu.utils import trace
+
+    ring = trace.InMemoryTraceExporter()
+    with trace.exporting(ring):
+        with trace.span("query", type="t", hits=3):
+            with trace.span("scan") as sc:
+                sc.add_event("fault.fs.block_read.error", path="x")
+                with trace.span("scan.block", rows_in=10):
+                    pass
+    root = ring.traces[-1]
+    back = trace.Span.from_dict(root.to_dict())
+    assert back.to_dict() == root.to_dict()
+    assert back.span_id == root.span_id
+    assert [s.name for s in back.walk()] == [s.name for s in root.walk()]
+    assert back.find("scan")[0].events[0]["name"] == "fault.fs.block_read.error"
+    # self_time still computes on the rebuilt tree
+    assert back.self_time_ms >= 0.0
+
+
+def test_graft_rekeys_trace_ids_and_shifts_wall_times():
+    """graft() re-keys every grafted span onto the PARENT's trace id and
+    shifts start_ms by the caller-computed offset — a skewed remote wall
+    clock can never place the subtree outside the RPC that carried it,
+    and find_trace-style id lookups see ONE tree."""
+    from geomesa_tpu.utils import trace
+
+    parent = trace.Span("fleet.rpc", "coordid0000000ab", None)
+    sub = trace.Span.from_dict({
+        "name": "fleet.server.scan",
+        "trace_id": "workerid00000000",
+        "span_id": "s1",
+        "start_ms": 5_000_000.0,  # absurd remote clock
+        "duration_ms": 2.0,
+        "children": [{
+            "name": "scan.block", "trace_id": "workerid00000000",
+            "span_id": "s2", "start_ms": 5_000_001.0, "duration_ms": 1.0,
+        }],
+    })
+    off = parent.start_ms - 5_000_000.0
+    got = trace.graft(parent, sub, offset_ms=off)
+    assert got is sub and parent.children == [sub]
+    assert sub.parent_id == parent.span_id
+    assert all(s.trace_id == "coordid0000000ab" for s in sub.walk())
+    assert abs(sub.start_ms - parent.start_ms) < 1e-6
+    assert abs(sub.children[0].start_ms - (parent.start_ms + 1.0)) < 1e-6
+    # the graft participates in self-time attribution
+    parent.duration_ms = 3.0
+    assert abs(parent.self_time_ms - 1.0) < 1e-9
+
+
+def test_fleet_exemplar_text_renders_shard_labeled_comments():
+    """Worker-minted exemplars render as '# exemplar:' comment lines
+    with a shard label (parser-ignored, link-complete) — and blank
+    trace ids render nothing rather than a dangling pointer."""
+    from geomesa_tpu.utils.audit import fleet_exemplar_text
+
+    text = fleet_exemplar_text({
+        "query.scan": {
+            2: (0.004, "aaaabbbbccccdddd", 1700000000000.0, 1),
+            5: (0.040, "ddddeeeeffff0000", 1700000001000.0, 0),
+        },
+        "query.join": {3: (0.008, "", 1700000002000.0, 2)},  # blank id
+        "query.aggregate": {},
+    })
+    lines = [ln for ln in text.splitlines() if ln]
+    assert len(lines) == 1  # worst bucket only, blank ids skipped
+    assert lines[0].startswith("# exemplar: geomesa_query_scan")
+    assert 'shard="0"' in lines[0]  # bucket 5 (the worst) is shard 0's
+    assert 'trace_id="ddddeeeeffff0000"' in lines[0]
+    assert fleet_exemplar_text({}) == ""

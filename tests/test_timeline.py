@@ -691,3 +691,62 @@ def test_timeline_keeps_recording_under_fault_schedules(monkeypatch):
     assert total.get("queries", 0) > 0  # traffic recorded through the chaos
     fault_keys = [k for k in total if k.startswith("fault.device.")]
     assert fault_keys, "the recorder never observed the fault schedule"
+
+
+# -- fleet rollup (PR 15: merged timeline over the fleet wire) ----------------
+
+
+def test_merge_worker_ticks_sums_counters_and_timer_histograms():
+    """The fleet-rollup fold (timeline.merge_worker_ticks): counter
+    deltas sum, timer count/sum/hist merge bucket-wise, non-closed
+    worker breakers surface per worker, unreachable workers are listed
+    — and gauges deliberately do NOT roll up (summing HBM across
+    processes would be a lie)."""
+    workers = {
+        "0": {
+            "tick": {
+                "counters": {"queries": 3, "degrade.device_to_host": 1},
+                "gauges": {"hbm.live.bytes": 100.0},
+                "timers": {
+                    "query.scan": {
+                        "count": 3, "sum_ms": 12.0, "hist": {"1": 2, "3": 1}
+                    }
+                },
+                "breakers": {"device": "open", "netlog": "closed"},
+            }
+        },
+        "1": {
+            "tick": {
+                "counters": {"queries": 2},
+                "timers": {
+                    "query.scan": {
+                        "count": 2, "sum_ms": 4.5, "hist": {1: 1, 4: 1}
+                    }
+                },
+                "breakers": {"device": "closed"},
+            }
+        },
+        "2": {"unreachable": True, "error": "QueryTimeout: wedged"},
+    }
+    roll = timeline.merge_worker_ticks(workers)
+    assert roll["workers"] == 2
+    assert roll["unreachable"] == ["2"]
+    assert roll["counters"] == {"queries": 5, "degrade.device_to_host": 1}
+    t = roll["timers"]["query.scan"]
+    assert t["count"] == 5
+    assert t["sum_ms"] == 16.5
+    # histograms merge by bucket regardless of int/str JSON key form
+    assert t["hist"] == {"1": 3, "3": 1, "4": 1}
+    assert roll["breakers"] == {"0": ["device"]}
+    assert "gauges" not in roll
+
+
+def test_merge_worker_ticks_empty_and_malformed_rows():
+    assert timeline.merge_worker_ticks({}) == {
+        "workers": 0, "counters": {}, "timers": {},
+        "breakers": {}, "unreachable": [],
+    }
+    # a malformed row (transport returned junk) counts as unreachable,
+    # never a KeyError in the sampler tick
+    roll = timeline.merge_worker_ticks({"0": None, "1": {"tick": {}}})
+    assert roll["unreachable"] == ["0"] and roll["workers"] == 1
