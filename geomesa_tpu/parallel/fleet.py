@@ -71,6 +71,7 @@ import json
 import os
 import uuid
 from collections import OrderedDict, deque
+import signal
 import socket
 import socketserver
 import subprocess
@@ -145,20 +146,51 @@ def _stitch_max_bytes() -> int:
 # server-reported error types the client re-raises as themselves, so the
 # coordinator's shard envelope (shed->replica, crisp timeout, failover)
 # treats a remote failure exactly like a local one
+class StaleEpoch(RuntimeError):
+    """A mutating RPC carried a fencing epoch older than one this worker
+    has already served. The sender is a fenced-out (zombie) coordinator
+    whose lease was seized — the write is rejected crisply and never
+    applied, so a coordinator pair can never split-brain the data. A
+    RuntimeError on purpose: the retry ladder must not hammer it (the
+    sender's epoch can only get MORE stale)."""
+
+
 _WIRE_ERRORS: Dict[str, type] = {
     "QueryTimeout": QueryTimeout,
     "ShedLoad": ShedLoad,
     "ShardUnavailable": ShardUnavailable,
+    "StaleEpoch": StaleEpoch,
     "KeyError": KeyError,
     "ValueError": ValueError,
 }
+
+# ops that change worker state: these carry the coordinator's fencing
+# epoch in the envelope and are rejected with StaleEpoch when a newer
+# coordinator has already written to the worker. Reads deliberately do
+# NOT fence — a fenced-out coordinator may keep serving stale-tolerant
+# queries but can never mutate.
+_MUTATING_OPS = frozenset(
+    {"create_schema", "delete_schema", "insert", "delete", "compact", "age_off"}
+)
 
 
 class WorkerUnavailable(ConnectionError):
     """A fleet worker could not be reached (dead process, refused dial,
     exhausted transport retries) — a ConnectionError, so the
-    coordinator's scatter/gather strikes the shard's breaker and fails
-    over exactly like an in-process ``ShardDied``."""
+    coordinator's shard envelope (shed->replica, crisp timeout,
+    failover) strikes the shard's breaker and fails over exactly like an
+    in-process ``ShardDied``. ``known_dead`` marks the failures where
+    the supervisor had ALREADY declared the worker DEAD/OUT before the
+    dial — the fleet.rpc retry ladder skips those (re-dialing a corpse
+    only delays failover)."""
+
+    known_dead = False
+
+
+def _retry_worth(e: BaseException) -> bool:
+    """fleet.rpc retry classification: transient I/O failures yes, a
+    peer the supervisor already marked DEAD/OUT no."""
+    return isinstance(e, OSError) and not getattr(e, "known_dead", False)
 
 
 # -- column codec -------------------------------------------------------------
@@ -205,6 +237,35 @@ def iter_column_chunks(columns: Dict[str, Any], max_bytes: int = _FRAME_BUDGET):
         return
     for lo in range(0, n, rows):
         yield {k: v[lo : lo + rows] for k, v in cols.items()}
+
+
+# high-water mark of a single streamed-scan frame observed coordinator-
+# side: the proof (asserted by tests, exported via fleet_health) that
+# peak per-reply frame memory is bounded by geomesa.fleet.scan.chunk.bytes
+# plus the row-estimator slack, never a worker's full materialization
+_SCAN_CHUNK_PEAK = {"bytes": 0}
+
+
+def scan_chunk_peak() -> int:
+    return int(_SCAN_CHUNK_PEAK["bytes"])
+
+
+def _note_scan_chunk(nbytes: int) -> None:
+    if nbytes > _SCAN_CHUNK_PEAK["bytes"]:
+        _SCAN_CHUNK_PEAK["bytes"] = int(nbytes)
+
+
+def _scan_chunk_bytes() -> int:
+    """Streamed-scan chunk budget (``geomesa.fleet.scan.chunk.bytes``).
+    Explicit ``0`` disables streaming (legacy materialize-then-reply);
+    the budget is clamped to the frame budget so a generous knob can
+    never produce a frame netlog would reject."""
+    from geomesa_tpu.utils.config import FLEET_SCAN_CHUNK_BYTES
+
+    b = FLEET_SCAN_CHUNK_BYTES.to_bytes()
+    if b is None:
+        b = 8 * 1024 * 1024
+    return max(0, min(int(b), _FRAME_BUDGET))
 
 
 def columns_to_ipc(columns: Dict[str, Any]) -> bytes:
@@ -347,6 +408,12 @@ class _WorkerState:
         # append-only with no fid upsert, and counts never fid-dedupe,
         # so a double-apply would inflate counts permanently
         self._applied: "OrderedDict[str, bool]" = OrderedDict()
+        # highest coordinator fencing epoch seen on a mutating RPC:
+        # anything lower is a fenced-out (zombie) coordinator and is
+        # rejected with StaleEpoch. In-memory on purpose — a restarted
+        # worker re-learns the live epoch on the first fenced write, and
+        # split-brain needs TWO coordinators alive, not a worker restart
+        self._epoch = 0
         self.draining = False
         self.t_start = time.monotonic()
         self.recovered: Dict[str, Any] = {}
@@ -407,6 +474,28 @@ class _WorkerState:
         fn = getattr(self, f"op_{op}", None)
         if fn is None:
             return {"ok": 0, "etype": "ValueError", "error": f"unknown op {op!r}"}, []
+        ep = head.get("epoch")
+        if ep is not None and op in _MUTATING_OPS:
+            ep = int(ep)
+            with self._lock:
+                known = self._epoch
+                if ep >= known:
+                    self._epoch = ep
+            if ep < known:
+                self.metrics.inc("fleet.epoch.rejected")
+                decision(
+                    "fleet.lease",
+                    "stale_epoch",
+                    worker=self.worker_id,
+                    op=op,
+                    got=ep,
+                    have=known,
+                )
+                raise StaleEpoch(
+                    f"fleet worker {self.worker_id}: mutating op {op!r} carries "
+                    f"fencing epoch {ep} < {known} — the sender's lease was "
+                    "seized by a newer coordinator"
+                )
         return fn(head, payloads)
 
     def op_ping(self, head, payloads):
@@ -499,6 +588,13 @@ class _WorkerState:
         if self.draining:
             raise ShedLoad(f"fleet worker {self.worker_id} draining")
         query = _query_from_wire(head)
+        chunk_bytes = _scan_chunk_bytes()
+        if chunk_bytes > 0:
+            # streamed reply: the handler pumps this generator frame by
+            # frame, so the first chunk leaves the worker before the
+            # last partition is scanned and neither side ever holds the
+            # full materialization
+            return {"ok": 1, "stream": 1}, self._scan_chunks(head, query, chunk_bytes)
         with self.admission.admit():
             receipt: Dict[str, int] = {}
             frames: List[bytes] = []
@@ -521,6 +617,37 @@ class _WorkerState:
                             frames.append(columns_to_ipc(chunk))
                         rows += len(res)
             return {"ok": 1, "rows": rows, "receipt": receipt}, frames
+
+    def _scan_chunks(self, head, query, chunk_bytes: int):
+        """Generator behind a streamed ``op_scan``: bounded Arrow IPC
+        byte chunks, then ONE final totals dict (rows/receipt/chunks).
+        Runs on the handler thread inside its span + envelope budget, so
+        the ambient deadline is checked per chunk — a stalled consumer
+        or an expired budget surfaces as a crisp mid-stream QueryTimeout
+        frame, never a truncated result. The admission slot is held for
+        the stream's whole life (the handler ``close()``s the generator
+        on abort, which releases it)."""
+        with self.admission.admit():
+            receipt: Dict[str, int] = {}
+            rows = 0
+            chunks = 0
+            with devstats.collecting(receipt):
+                for p in head.get("partitions", ()):
+                    st = self._store(p, create=False)
+                    if st is None:
+                        continue
+                    res = st.query(head["name"], query)
+                    if len(res):
+                        from geomesa_tpu.store.datastore import _materialize
+
+                        for chunk in iter_column_chunks(
+                            dict(_materialize(res.columns)), max_bytes=chunk_bytes
+                        ):
+                            deadline.check("fleet.scan.chunk")
+                            chunks += 1
+                            yield columns_to_ipc(chunk)
+                        rows += len(res)
+            yield {"rows": rows, "receipt": receipt, "chunks": chunks}
 
     def op_count(self, head, payloads):
         st = self._store(head["partition"], create=False)
@@ -745,12 +872,54 @@ class _WorkerState:
             time.sleep(0.02)
 
 
+class _ClientGone(Exception):
+    """The peer vanished mid-streamed-reply: nothing left to report to —
+    the handler drops the connection instead of building an error frame
+    nobody will read."""
+
+
 class _FleetHandler(socketserver.BaseRequestHandler):
     """One persistent worker connection: JSON header frame (+ ``frames``
     payload frames) in, JSON reply (+ payload frames) out. The envelope
     budget is re-anchored and attached around every op, and server-side
     spans key on the envelope's trace id (the netlog discipline) so the
-    worker's work joins the calling query's tree."""
+    worker's work joins the calling query's tree.
+
+    Streamed scans add a second reply shape: a head with ``stream: 1``
+    and ``frames: 0``, then per chunk a small control frame
+    (``{"chunk": 1, "bytes": n}``) followed by the Arrow frame, then one
+    FINAL control frame with the totals (or the crisp mid-stream error)
+    plus the usual trailer fields — the client loops on control frames
+    until one without ``chunk`` arrives."""
+
+    def _pump_chunks(self, sock, gen) -> Dict[str, Any]:
+        """Drive a streamed op generator: forward each bytes chunk as a
+        control+data frame pair, capture the final totals dict, and turn
+        a mid-stream op failure into the error-shaped final control
+        frame (parity-or-crisp: the client sees a typed error, never a
+        silently short stream)."""
+        tail: Dict[str, Any] = {"ok": 1, "rows": 0, "receipt": {}, "chunks": 0}
+        sent = 0
+        try:
+            for item in gen:
+                if isinstance(item, dict):
+                    tail.update(item)
+                    continue
+                try:
+                    send_frame(
+                        sock, json.dumps({"chunk": 1, "bytes": len(item)}).encode()
+                    )
+                    send_frame(sock, item)
+                except OSError as e:
+                    raise _ClientGone from e
+                sent += 1
+        except _ClientGone:
+            raise
+        except Exception as e:  # noqa: BLE001 - report as final frame
+            tail = _error_reply(e)
+            tail["chunks"] = sent
+        tail["done"] = 1
+        return tail
 
     def handle(self) -> None:
         state: _WorkerState = self.server.owner  # type: ignore[attr-defined]
@@ -784,6 +953,32 @@ class _FleetHandler(socketserver.BaseRequestHandler):
                     ) as sp:
                         with deadline.budget(envelope_budget(head)):
                             reply, frames = state.dispatch(head, payloads)
+                            if isinstance(reply, dict) and reply.pop("stream", None):
+                                # streamed scan: the ok+stream head goes
+                                # out FIRST, then chunk-control + Arrow
+                                # frame pairs while the op generator
+                                # produces them (still under this span's
+                                # envelope budget), and the FINAL control
+                                # frame — totals, or the crisp mid-stream
+                                # error — becomes ``reply`` so the
+                                # trailer path below rides it unchanged
+                                gen = frames
+                                head_out = dict(reply)
+                                head_out["stream"] = 1
+                                head_out["frames"] = 0
+                                try:
+                                    send_frame(
+                                        sock,
+                                        json.dumps(head_out, default=str).encode(),
+                                    )
+                                    reply = self._pump_chunks(sock, gen)
+                                finally:
+                                    close = getattr(gen, "close", None)
+                                    if callable(close):
+                                        close()
+                                frames = []
+                except _ClientGone:
+                    return
                 except ConnectionError:
                     return
                 except Exception as e:  # noqa: BLE001 - report to client
@@ -922,6 +1117,7 @@ class WorkerClient:
         address_fn: Callable[[], Optional[Tuple[str, int]]],
         timeout_s: Optional[float] = None,
         retry: Optional[RetryPolicy] = None,
+        state_fn: Optional[Callable[[], str]] = None,
     ):
         from geomesa_tpu.utils.config import FLEET_RPC_TIMEOUT
 
@@ -931,8 +1127,17 @@ class WorkerClient:
             FLEET_RPC_TIMEOUT.to_duration_s(10.0) if timeout_s is None else timeout_s
         )
         self._retry = retry if retry is not None else RetryPolicy(
-            name="fleet.rpc", max_attempts=3, base_s=0.02, cap_s=0.25
+            name="fleet.rpc", max_attempts=3, base_s=0.02, cap_s=0.25,
+            retryable=_retry_worth,
         )
+        # supervisor liveness view (optional): lets a failed dial on a
+        # worker ALREADY declared DEAD/OUT surface as a crisp
+        # known-dead WorkerUnavailable the retry ladder skips
+        self._state_fn = state_fn
+        # coordinator fencing-epoch provider (optional): mutating ops
+        # stamp the current lease epoch into their envelope so workers
+        # can reject a fenced-out coordinator's writes
+        self.epoch_fn: Optional[Callable[[], Optional[int]]] = None
         self._pool: List[socket.socket] = []
         self._plock = threading.Lock()
         self.plans = _PlansProxy(self)
@@ -942,13 +1147,30 @@ class WorkerClient:
     def _dial(self) -> socket.socket:
         addr = self._address_fn()
         if addr is None:
-            raise WorkerUnavailable(
+            e = WorkerUnavailable(
                 f"fleet worker {self.shard_id} has no address (not spawned "
                 "or marked out)"
             )
-        s = socket.create_connection(
-            addr, timeout=deadline.io_timeout(self._timeout_s, "fleet.dial")
-        )
+            e.known_dead = True
+            raise e
+        try:
+            s = socket.create_connection(
+                addr, timeout=deadline.io_timeout(self._timeout_s, "fleet.dial")
+            )
+        except OSError as exc:
+            state = self._state_fn() if self._state_fn is not None else None
+            if state in (DEAD, OUT):
+                # the supervisor had already declared this peer gone:
+                # surface the crisp known-dead verdict (skipped by the
+                # retry ladder) instead of a bare socket error —
+                # failover paths must not re-dial a corpse
+                e = WorkerUnavailable(
+                    f"fleet worker {self.shard_id} is {state} "
+                    f"(dial {addr[0]}:{addr[1]} failed: {exc})"
+                )
+                e.known_dead = True
+                raise e from exc
+            raise
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
@@ -990,6 +1212,12 @@ class WorkerClient:
             stitch_max = _stitch_max_bytes() if sp.recording else 0
             if stitch_max > 0:
                 fields = dict(fields, stitch=stitch_max)
+            if op in _MUTATING_OPS and self.epoch_fn is not None:
+                ep = self.epoch_fn()
+                if ep is not None:
+                    # fencing: the worker rejects this write with
+                    # StaleEpoch if a newer coordinator got there first
+                    fields = dict(fields, epoch=int(ep))
             try:
                 faults.fault_point("fleet.rpc")
             except faults.SimulatedCrash as e:
@@ -1015,9 +1243,12 @@ class WorkerClient:
                 for b in payloads:
                     send_frame(sock, b)
                 resp = json.loads(recv_frame(sock).decode())
-                frames = [
-                    recv_frame(sock) for _ in range(int(resp.get("frames", 0)))
-                ]
+                if resp.get("ok") == 1 and resp.get("stream"):
+                    resp, frames = self._recv_stream(sock)
+                else:
+                    frames = [
+                        recv_frame(sock) for _ in range(int(resp.get("frames", 0)))
+                    ]
             except OSError:
                 sock.close()
                 if stitch_max > 0:
@@ -1043,6 +1274,35 @@ class WorkerClient:
                 _raise_wire_error(resp)
             self._checkin(sock)
             return resp, frames
+
+    def _recv_stream(self, sock) -> Tuple[Dict[str, Any], List[bytes]]:
+        """Consume a chunk-streamed scan reply: alternating control +
+        Arrow frame pairs until the final control frame (totals or a
+        typed mid-stream error). Each bounded frame is decoded to
+        columns AS IT ARRIVES and the raw bytes dropped — the
+        coordinator's peak raw-frame memory for the reply is ONE chunk
+        (the geomesa.fleet.scan.chunk.bytes budget), regardless of how
+        much the worker ships in total. Returns the final control frame
+        as ``resp`` (decoded columns under ``_columns``) plus any
+        trailing frames (the stitch trailer), so the caller's trailer /
+        error handling rides unchanged."""
+        columns: List[Dict[str, Any]] = []
+        chunks = 0
+        while True:
+            ctrl = json.loads(recv_frame(sock).decode())
+            if not ctrl.get("chunk"):
+                break
+            buf = recv_frame(sock)
+            _note_scan_chunk(len(buf))
+            columns.append(ipc_to_columns(buf))
+            del buf
+            chunks += 1
+        frames = [recv_frame(sock) for _ in range(int(ctrl.get("frames", 0)))]
+        if chunks:
+            robustness_metrics().inc("fleet.scan.chunks", chunks)
+        ctrl["streamed"] = 1
+        ctrl["_columns"] = columns
+        return ctrl, frames
 
     def _absorb_trailer(
         self, sp, resp: Dict[str, Any], frames: List[bytes]
@@ -1124,8 +1384,12 @@ class WorkerClient:
             "scan",
             {"name": name, "partitions": list(partitions), **_query_to_wire(query)},
         )
+        if resp.get("streamed"):
+            columns = resp.get("_columns") or []
+        else:
+            columns = [ipc_to_columns(b) for b in frames]
         return {
-            "columns": [ipc_to_columns(b) for b in frames],
+            "columns": columns,
             "rows": int(resp.get("rows", 0)),
             "receipt": resp.get("receipt", {}),
         }
@@ -1235,7 +1499,172 @@ class WorkerClient:
         return {k: resp.get(k) for k in ("drained", "inflight")}
 
 
+# -- coordinator lease --------------------------------------------------------
+
+
+class FleetLease:
+    """The coordinator HA lease: a durably-written ``<root>/_fleet/lease``
+    record ``{holder, epoch, ttl_s, renewed_unix}`` (CRC-framed like every
+    other _fleet file). Exactly one coordinator renews it; a standby
+    watches it and takes over when ``renewed_unix`` goes ``ttl_s`` stale.
+
+    The correctness story is the FENCING EPOCH, not the file: every
+    acquisition bumps ``epoch``, mutating RPCs carry it, and workers
+    reject anything below the highest epoch they have served
+    (``StaleEpoch``). The lease file only arbitrates WHO SHOULD be
+    coordinating — a zombie that keeps running past its lease can still
+    read, but its first write after a takeover bounces at every worker
+    the new coordinator has touched. Wall-clock (``time.time``) on
+    purpose: freshness must compare across processes, where monotonic
+    clocks share no origin."""
+
+    def __init__(self, path: str, ttl_s: Optional[float] = None):
+        from geomesa_tpu.utils.config import FLEET_LEASE_TTL
+
+        self.path = path
+        self.ttl_s = (
+            FLEET_LEASE_TTL.to_duration_s(3.0) if ttl_s is None else float(ttl_s)
+        )
+        self.holder = f"{socket.gethostname()}:{os.getpid()}:{uuid.uuid4().hex[:8]}"
+        self.epoch = 0
+        self._lock = threading.Lock()
+
+    def read(self) -> Optional[Dict[str, Any]]:
+        try:
+            return json.loads(read_verified(self.path).decode())
+        except FileNotFoundError:
+            return None
+        except (CorruptFileError, ValueError, UnicodeDecodeError):
+            # a torn/corrupt lease quarantines and reads as ABSENT: the
+            # next acquirer bumps past whatever epoch it held (epoch is
+            # also fenced worker-side, so even a lost high-water mark
+            # cannot resurrect a zombie's writes)
+            quarantine(self.path)
+            robustness_metrics().inc("fleet.lease.corrupt")
+            return None
+
+    def status(self) -> Dict[str, Any]:
+        rec = self.read()
+        age = (
+            None
+            if rec is None
+            else max(0.0, time.time() - float(rec.get("renewed_unix", 0.0)))
+        )
+        ttl = self.ttl_s if rec is None else float(rec.get("ttl_s", self.ttl_s))
+        return {
+            "holder": None if rec is None else rec.get("holder"),
+            "epoch": 0 if rec is None else int(rec.get("epoch", 0)),
+            "age_s": None if age is None else round(age, 3),
+            "ttl_s": ttl,
+            "expired": age is None or age > ttl,
+            "held_by_me": rec is not None and rec.get("holder") == self.holder,
+        }
+
+    def _write(self) -> None:
+        durable_write(
+            self.path,
+            json.dumps(
+                {
+                    "version": 1,
+                    "holder": self.holder,
+                    "epoch": int(self.epoch),
+                    "ttl_s": self.ttl_s,
+                    "renewed_unix": time.time(),
+                },
+                sort_keys=True,
+            ).encode(),
+            crc=True,
+        )
+
+    def acquire(self, wait: bool = False, timeout_s: Optional[float] = None) -> int:
+        """Take the lease with a bumped fencing epoch.
+
+        ``wait=False`` (a deliberately-started coordinator) seizes
+        immediately — split-brain safety comes from the epoch fence at
+        the workers, not from acquisition politeness. ``wait=True`` (a
+        standby's takeover) defers until the current holder's record has
+        gone a full TTL without a renewal, bounded by ``timeout_s``."""
+        t_end = None if timeout_s is None else time.monotonic() + float(timeout_s)
+        with self._lock, trace.span("fleet.lease", op="acquire", wait=wait):
+            while True:
+                deadline.check("fleet.lease")
+                faults.fault_point("fleet.lease")
+                cur = self.read()
+                fresh = (
+                    cur is not None
+                    and cur.get("holder") != self.holder
+                    and time.time() - float(cur.get("renewed_unix", 0.0))
+                    <= float(cur.get("ttl_s", self.ttl_s))
+                )
+                if fresh and wait:
+                    if t_end is not None and time.monotonic() >= t_end:
+                        raise TimeoutError(
+                            f"fleet lease still held by {cur.get('holder')!r} "
+                            f"(epoch {cur.get('epoch')})"
+                        )
+                    time.sleep(min(0.05, self.ttl_s / 10.0))
+                    continue
+                reason = (
+                    "acquired"
+                    if cur is None
+                    else ("takeover" if cur.get("holder") != self.holder else "renewed")
+                )
+                self.epoch = int((cur or {}).get("epoch", 0)) + 1
+                self._write()
+                robustness_metrics().inc("fleet.lease.acquired")
+                decision(
+                    "fleet.lease", reason, epoch=self.epoch, holder=self.holder
+                )
+                return self.epoch
+
+    def renew(self) -> bool:
+        """Refresh the holder stamp. ``False`` (reason-coded) means the
+        lease was seized by a newer coordinator — the caller is FENCED:
+        it must stop mutating (its epoch already bounces at every worker
+        the new coordinator has written to) and stand down."""
+        with self._lock, trace.span("fleet.lease", op="renew"):
+            deadline.check("fleet.lease")
+            faults.fault_point("fleet.lease")
+            cur = self.read()
+            if (
+                cur is not None
+                and cur.get("holder") != self.holder
+                and int(cur.get("epoch", 0)) > self.epoch
+            ):
+                robustness_metrics().inc("fleet.lease.lost")
+                decision(
+                    "fleet.lease",
+                    "lost",
+                    holder=self.holder,
+                    to=cur.get("holder"),
+                    epoch=int(cur.get("epoch", 0)),
+                )
+                return False
+            self._write()
+            robustness_metrics().inc("fleet.lease.renewed")
+            return True
+
+    def release(self) -> None:
+        """Drop the lease iff still ours (a clean close hands the next
+        coordinator an expired record instead of a TTL wait)."""
+        with self._lock:
+            cur = self.read()
+            if cur is not None and cur.get("holder") == self.holder:
+                try:
+                    os.remove(self.path)
+                except OSError:
+                    pass
+
+
 # -- supervisor ---------------------------------------------------------------
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
 
 
 def _repo_pythonpath() -> str:
@@ -1292,6 +1721,10 @@ class FleetSupervisor:
         self._spawn_timeout_s = FLEET_SPAWN_TIMEOUT.to_duration_s(30.0)
         self.drain_timeout_s = FLEET_DRAIN_TIMEOUT.to_duration_s(10.0)
         self._procs: List[Optional[subprocess.Popen]] = [None] * self.num_workers
+        # pid of each worker REGARDLESS of parentage: spawn() records its
+        # child's pid here, adopt() the orphan's — liveness checks and
+        # kill paths use os.kill when there is no Popen to poll/reap
+        self._pids: List[Optional[int]] = [None] * self.num_workers
         self._addrs: List[Optional[Tuple[str, int]]] = [None] * self.num_workers
         self._state: List[str] = [DEAD] * self.num_workers
         self._misses: List[int] = [0] * self.num_workers
@@ -1318,7 +1751,11 @@ class FleetSupervisor:
     def worker_pid(self, i: int) -> Optional[int]:
         with self._lock:
             proc = self._procs[i]
-        return None if proc is None else proc.pid
+            return proc.pid if proc is not None else self._pids[i]
+
+    def worker_state(self, i: int) -> str:
+        with self._lock:
+            return self._state[i]
 
     def spawn(self, i: int) -> None:
         """Spawn worker ``i`` and wait for it to publish its port. The
@@ -1397,16 +1834,82 @@ class FleetSupervisor:
             raise TimeoutError(f"fleet worker {i} never published its port")
         with self._lock:
             self._procs[i] = proc
+            self._pids[i] = proc.pid
             self._addrs[i] = addr
             self._state[i] = LIVE
             self._misses[i] = 0
 
-    def start(self) -> None:
+    def adopt(self, i: int) -> bool:
+        """Attach to an already-running worker process — one a dead
+        coordinator left behind. Reads the worker's published portfile,
+        probes it with a raw ping, and records its address + pid WITHOUT
+        spawning: takeover must not double-spawn over a healthy worker's
+        partition roots (two processes over one FsDataStore root is the
+        one corruption the whole layout forbids). False when there is
+        nothing live to adopt (missing/stale portfile, dead port)."""
+        portfile = os.path.join(self.base_dir, f"w{i}.port")
+        try:
+            text = open(portfile).read().strip()
+        except OSError:
+            return False
+        if not text:
+            return False
+        host, _, port = text.partition(":")
+        try:
+            addr = (host, int(port))
+        except ValueError:
+            return False
+        pid = self._probe_pid(addr)
+        if pid is None:
+            return False
+        with self._lock:
+            self._procs[i] = None
+            self._pids[i] = pid
+            self._addrs[i] = addr
+            self._state[i] = LIVE
+            self._misses[i] = 0
+        robustness_metrics().inc("fleet.worker.adopted")
+        decision("fleet", "worker_adopted", worker=i, pid=pid)
+        return True
+
+    @staticmethod
+    def _probe_pid(addr: Tuple[str, int]) -> Optional[int]:
+        """Raw ping against a candidate adoptee: its pid on success,
+        None for anything dead/foreign (bounded at 1s — adoption probes
+        must not serialize a takeover on a wedged corpse)."""
+        try:
+            s = socket.create_connection(addr, timeout=1.0)
+        except OSError:
+            return None
+        try:
+            s.settimeout(1.0)
+            send_frame(s, json.dumps(request_envelope("ping", frames=0)).encode())
+            resp = json.loads(recv_frame(s).decode())
+            for _ in range(int(resp.get("frames", 0))):
+                recv_frame(s)
+            if resp.get("ok") != 1:
+                return None
+            return int(resp.get("pid") or 0) or None
+        except (OSError, ValueError):
+            return None
+        finally:
+            s.close()
+
+    def start(self, attach: bool = False) -> Tuple[int, int]:
+        """Bring every worker up; with ``attach=True`` (takeover /
+        coordinator restart) adopt-or-spawn: surviving orphans are
+        adopted in place, only the actually-dead slots spawn fresh.
+        Returns ``(adopted, spawned)``."""
         import atexit
 
+        adopted = spawned = 0
         try:
             for i in range(self.num_workers):
-                self.spawn(i)
+                if attach and self.adopt(i):
+                    adopted += 1
+                else:
+                    self.spawn(i)
+                    spawned += 1
         except BaseException:
             # a mid-loop spawn failure must not strand the workers that
             # DID spawn (the atexit hook below is not registered yet)
@@ -1419,6 +1922,7 @@ class FleetSupervisor:
                 name="geomesa-fleet-heartbeat",
             )
             self._thread.start()
+        return adopted, spawned
 
     def stop(self) -> None:
         import atexit
@@ -1437,7 +1941,9 @@ class FleetSupervisor:
             pass
         with self._lock:
             procs = list(self._procs)
+            pids = list(self._pids)
             self._procs = [None] * self.num_workers
+            self._pids = [None] * self.num_workers
             self._addrs = [None] * self.num_workers
         for proc in procs:
             if proc is None or proc.poll() is not None:
@@ -1448,15 +1954,41 @@ class FleetSupervisor:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=2.0)
+        for proc, pid in zip(procs, pids):
+            # adopted workers are not our children: no Popen to
+            # terminate/reap — signal the pid directly and poll it down
+            if proc is not None or pid is None:
+                continue
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                continue
+            t_end = time.monotonic() + 2.0
+            while time.monotonic() < t_end and _pid_alive(pid):
+                time.sleep(0.05)
+            if _pid_alive(pid):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
 
     def kill_worker(self, i: int) -> None:
         """Hard-kill (SIGKILL) worker ``i`` — the chaos harness's lever;
         the heartbeat machine is what must notice and repair."""
         with self._lock:
             proc = self._procs[i]
+            pid = self._pids[i]
         if proc is not None and proc.poll() is None:
             proc.kill()
             proc.wait(timeout=5.0)
+        elif proc is None and pid is not None and _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                return
+            t_end = time.monotonic() + 5.0
+            while time.monotonic() < t_end and _pid_alive(pid):
+                time.sleep(0.02)
 
     # -- membership ----------------------------------------------------------
 
@@ -1517,7 +2049,13 @@ class FleetSupervisor:
             if self._state[i] == OUT:
                 return False
             proc = self._procs[i]
-        reaped = proc is not None and proc.poll() is not None
+            pid = self._pids[i]
+        if proc is not None:
+            reaped = proc.poll() is not None
+        else:
+            # adopted worker: not our child, nothing to reap — a dead
+            # pid is the same unambiguous verdict
+            reaped = pid is not None and not _pid_alive(pid)
         # each beat runs under its own budget (one interval): the probe's
         # socket timeout derives from it, so a wedged worker costs at
         # most one interval per beat, never the RPC knob constant
@@ -1528,8 +2066,8 @@ class FleetSupervisor:
                     faults.fault_point("fleet.heartbeat")
                     if reaped:
                         raise WorkerUnavailable(
-                            f"fleet worker {i} process exited "
-                            f"rc={proc.returncode}"
+                            f"fleet worker {i} process exited rc="
+                            f"{proc.returncode if proc is not None else '?'}"
                         )
                     self.store.workers[i].ping()
                 except (OSError, QueryTimeout):
@@ -1645,9 +2183,18 @@ class FleetSupervisor:
             raise RuntimeError("supervisor stopping")
         with self._lock:
             proc = self._procs[i]
+            pid = self._pids[i]
         if proc is not None and proc.poll() is None:
             proc.kill()
             proc.wait(timeout=5.0)
+        elif proc is None and pid is not None and _pid_alive(pid):
+            # an adopted corpse (wedged but unreaped): SIGKILL by pid
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        with self._lock:
+            self._pids[i] = None
         self.store.workers[i].close()  # pooled sockets point at the corpse
         self.spawn(i)
 
@@ -1682,7 +2229,11 @@ class FleetSupervisor:
             return {
                 str(i): {
                     "state": self._state[i],
-                    "pid": None if self._procs[i] is None else self._procs[i].pid,
+                    "pid": (
+                        self._procs[i].pid
+                        if self._procs[i] is not None
+                        else self._pids[i]
+                    ),
                     "address": self._addrs[i],
                     "misses": self._misses[i],
                     "restarts": self.restarts[i],
@@ -1713,6 +2264,7 @@ class FleetDataStore(ShardedDataStore):
         partition_bits: Optional[int] = None,
         transport: str = "process",
         supervise: bool = True,
+        standby: bool = False,
         **kwargs,
     ):
         from geomesa_tpu.utils.config import FLEET_WORKERS
@@ -1754,21 +2306,60 @@ class FleetDataStore(ShardedDataStore):
         # starting after the set dual-target both chains.
         self._write_gate = threading.Condition()
         self._writes_inflight = 0
+        # coordinator HA: the durably-leased fencing-epoch record. A
+        # standby holds an UNACQUIRED lease object (epoch 0) and only
+        # bumps it at takeover(); the active coordinator seizes it now
+        # and renews it on the lease loop
+        self._lease = FleetLease(os.path.join(fleet_dir, "lease"))
+        self._standby = bool(standby)
+        self._supervise_flag = bool(supervise)
+        self._fenced = False
+        self._lease_stop: Optional[threading.Event] = None
+        self._lease_thread: Optional[threading.Thread] = None
+        self.transport = transport
+        self.supervisor: Optional[FleetSupervisor] = None
+        if standby:
+            # a standby must not touch SHARED state while the active
+            # coordinator lives: no journal roll-forward (it would
+            # commit the active's in-flight rebalance intents), no
+            # worker spawns, no lease write. It tails everything at
+            # takeover() instead.
+            if transport == "process":
+                self.supervisor = FleetSupervisor(
+                    self, len(self.workers), supervise=supervise
+                )
+                self.workers = [
+                    WorkerClient(
+                        i,
+                        functools.partial(self.supervisor.worker_address, i),
+                        state_fn=functools.partial(self.supervisor.worker_state, i),
+                    )
+                    for i in range(len(self._breakers))
+                ]
+            return
         # recover the placement journal BEFORE the first placement read:
         # a coordinator that crashed mid-move reopens to exactly the
         # pre- or post-move table (the store-open discipline, PR 5)
         self.recover_fleet()
-        self.transport = transport
-        self.supervisor: Optional[FleetSupervisor] = None
+        self._lease.acquire(wait=False)
         if transport == "process":
             self.supervisor = FleetSupervisor(
                 self, len(self.workers), supervise=supervise
             )
             self.workers = [
-                WorkerClient(i, functools.partial(self.supervisor.worker_address, i))
+                WorkerClient(
+                    i,
+                    functools.partial(self.supervisor.worker_address, i),
+                    state_fn=functools.partial(self.supervisor.worker_state, i),
+                )
                 for i in range(len(self._breakers))
             ]
-            self.supervisor.start()
+            for w in self.workers:
+                w.epoch_fn = self._lease_epoch
+            # adopt-or-spawn: a coordinator restarting over a root whose
+            # workers survived it attaches to them instead of double-
+            # spawning over their partition roots
+            self.supervisor.start(attach=True)
             self._recover_routing()
             # repair obligations recovered from disk: close replica
             # gaps NOW rather than waiting for the gapped worker's next
@@ -1780,16 +2371,274 @@ class FleetDataStore(ShardedDataStore):
                         self._resync_into(p, s)
                     except Exception:  # noqa: BLE001 - keep the obligation
                         self._mark_dirty(p, s)
+        # roll pending cross-worker fan-out intents FORWARD now that the
+        # workers are reachable (the dying coordinator's half-applied
+        # delete/compact/age_off finishes before we serve anything)
+        self._replay_fanouts()
+        self._start_lease_loop()
 
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
+        if self._lease_stop is not None:
+            self._lease_stop.set()
+        if self._lease_thread is not None and self._lease_thread.is_alive():
+            self._lease_thread.join(timeout=2.0)
         if self.supervisor is not None:
             self.supervisor.stop()
         if self.transport == "process":
             for w in self.workers:
                 w.close()
+        if not self._standby and not self._fenced:
+            self._lease.release()
         super().close()
+
+    # -- coordinator HA (lease, standby, takeover) ---------------------------
+
+    def _lease_epoch(self) -> Optional[int]:
+        ep = self._lease.epoch
+        return ep if ep > 0 else None
+
+    def _start_lease_loop(self) -> None:
+        """Renew the lease every ``geomesa.fleet.lease.renew.interval``.
+        Process transport under supervision only — an inproc (or
+        unsupervised test) fleet holds the lease from acquisition until
+        close, and a standby can still take over the moment the process
+        dies (no renewals outlive it)."""
+        from geomesa_tpu.utils.config import FLEET_LEASE_RENEW
+
+        if self.transport != "process" or not self._supervise_flag:
+            return
+        interval = FLEET_LEASE_RENEW.to_duration_s(1.0)
+        self._lease_stop = threading.Event()
+        stop = self._lease_stop
+
+        def loop() -> None:
+            while not stop.wait(interval):
+                try:
+                    if not self._lease.renew():
+                        # fenced: a newer coordinator seized the lease —
+                        # stop renewing; our epoch already bounces at
+                        # the workers, reads may continue (documented)
+                        self._fenced = True
+                        return
+                except faults.SimulatedCrash:
+                    # a crash rule at fleet.lease models the coordinator
+                    # dying mid-renewal: the loop (this thread) is the
+                    # top level — count it and let the renewal lapse
+                    robustness_metrics().inc("fleet.lease.crashed")
+                    return
+                except Exception:  # noqa: BLE001 - renewals must survive blips
+                    robustness_metrics().inc("fleet.lease.error")
+
+        self._lease_thread = threading.Thread(
+            target=loop, daemon=True, name="geomesa-fleet-lease"
+        )
+        self._lease_thread.start()
+
+    def standby_status(self) -> Dict[str, Any]:
+        """What a standby (or anyone) sees of the active coordinator:
+        the lease record's holder/epoch/freshness plus the count of
+        fan-out intents a takeover would have to replay."""
+        st = self._lease.status()
+        st["standby"] = self._standby
+        st["fenced"] = self._fenced
+        st["pending_fanouts"] = len(self._fleet_journal.pending_fanouts())
+        return st
+
+    def takeover(
+        self, wait: bool = True, timeout_s: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Standby -> active. Waits out the current holder's lease TTL
+        (``wait=False`` seizes immediately — the chaos harness's lever),
+        bumps the fencing epoch, rolls the placement journal
+        forward/back, adopts the surviving worker processes (spawning
+        replacements for dead slots), rebuilds routing from worker
+        inventories, replays pending fan-out intents, and resumes
+        supervision + renewal. After this returns the store serves
+        exactly as a fresh coordinator over the same root would — and
+        the dead coordinator's epoch is fenced at every worker this one
+        touches."""
+        if not self._standby:
+            raise RuntimeError("takeover() is a standby-only lever")
+        epoch = self._lease.acquire(wait=wait, timeout_s=timeout_s)
+        journal = self.recover_fleet()
+        adopted = spawned = 0
+        if self.transport == "process" and self.supervisor is not None:
+            for w in self.workers:
+                w.epoch_fn = self._lease_epoch
+            adopted, spawned = self.supervisor.start(attach=True)
+            self._recover_routing()
+            for p, s in sorted(set(self._dirty)):
+                if self._live(s):
+                    self._clear_dirty(p, s)
+                    try:
+                        self._resync_into(p, s)
+                    except Exception:  # noqa: BLE001 - keep the obligation
+                        self._mark_dirty(p, s)
+        replayed = self._replay_fanouts()
+        self._standby = False
+        self._start_lease_loop()
+        decision(
+            "fleet.lease",
+            "takeover_complete",
+            epoch=epoch,
+            adopted=adopted,
+            spawned=spawned,
+            fanouts_replayed=replayed,
+        )
+        return {
+            "epoch": epoch,
+            "adopted": adopted,
+            "spawned": spawned,
+            "fanouts_replayed": replayed,
+            "journal": journal,
+        }
+
+    # -- crash-atomic cross-worker mutations ---------------------------------
+
+    def _journaled_fanout(
+        self,
+        kind: str,
+        name: str,
+        calls: Dict[str, Any],
+        payload: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        """One crash-atomic cross-worker mutation: a roll-FORWARD intent
+        (participant list + payload) lands in the fleet journal before
+        the first worker is touched, each participant's completion is
+        durably done-marked, and only a fully-applied fan-out commits.
+        A coordinator crash at ANY position leaves an intent whose
+        un-done participants ``_replay_fanouts`` re-applies at
+        takeover/restart — half the workers mutated is a state that can
+        exist only while a recovery is already obligated to finish it.
+        A plain mid-fan-out failure keeps the same obligation: the
+        intent stays pending (counted + reason-coded) and the error
+        propagates crisply."""
+        results: Dict[str, Any] = {}
+        with trace.span(
+            "fleet.fanout", op=kind, table=name, participants=len(calls)
+        ):
+            deadline.check("fleet.fanout")
+            faults.fault_point("fleet.fanout")  # pre-intent: nothing applied
+            path = self._fleet_journal.fanout_begin(
+                kind, name, list(calls), payload
+            )
+            try:
+                for key in calls:
+                    # mid fan-out: a crash here leaves THIS participant
+                    # (and everything after it) to the replay
+                    faults.fault_point("fleet.fanout")
+                    results[key] = calls[key]()
+                    self._fleet_journal.fanout_done(path, key)
+            except Exception:
+                robustness_metrics().inc("fleet.fanout.deferred")
+                decision(
+                    "fleet.fanout",
+                    "deferred",
+                    op=kind,
+                    table=name,
+                    done=len(results),
+                    total=len(calls),
+                )
+                raise
+            faults.fault_point("fleet.fanout")  # applied, intent pending
+            self._fleet_journal.fanout_finish(path)
+            robustness_metrics().inc("fleet.fanout.applied")
+        return results
+
+    def _replay_fanouts(self) -> int:
+        """Roll every pending fan-out intent FORWARD: re-run the
+        participants without a done-mark (worker-side these ops are
+        idempotent — deletes of deleted fids, compaction of compacted
+        tables, age-off re-sweeps), finish the local half a dying
+        coordinator never reached (delete_schema's catalog drop), then
+        commit the intent. Runs at coordinator init and at standby
+        takeover, BEFORE anything is served."""
+        replayed = 0
+        for rec in self._fleet_journal.pending_fanouts():
+            kind = rec.get("kind")
+            name = rec.get("name")
+            payload = rec.get("payload") or {}
+            done = set(rec.get("done") or ())
+            with trace.span("fleet.fanout", op=kind, table=name, replay=True):
+                deadline.check("fleet.fanout")
+                try:
+                    calls = self._fanout_calls(
+                        kind, name, fids=payload.get("fids")
+                    )
+                except (KeyError, ValueError):
+                    # nothing routable anymore (schema/partitions gone):
+                    # the remaining participants have nothing to apply
+                    calls = {}
+                remaining = [
+                    k for k in rec.get("participants", ()) if k not in done
+                ]
+                for key in remaining:
+                    call = calls.get(key)
+                    if call is not None:
+                        faults.fault_point("fleet.fanout")
+                        try:
+                            call()
+                        except (KeyError, ValueError):
+                            pass  # already applied on that worker
+                    self._fleet_journal.fanout_done(rec["path"], key)
+                if kind == "delete_schema" and name in self._schemas:
+                    # the dying coordinator dropped the workers' copies
+                    # but never reached its own catalog
+                    try:
+                        super(ShardedDataStore, self).delete_schema(name)
+                    except KeyError:
+                        pass
+                    self._partitions.pop(name, None)
+                elif name in self._schemas:
+                    self._note_write(name)
+                self._fleet_journal.fanout_finish(rec["path"])
+                replayed += 1
+                robustness_metrics().inc("fleet.fanout.replayed")
+                decision(
+                    "fleet.fanout",
+                    "replayed",
+                    op=kind,
+                    table=name,
+                    remaining=len(remaining),
+                )
+        return replayed
+
+    def delete_features(self, name: str, fids) -> None:
+        fids = [str(f) for f in fids]
+        self._journaled_fanout(
+            "delete",
+            name,
+            self._fanout_calls("delete", name, fids=fids),
+            {"fids": fids},
+        )
+        self._note_write(name)
+
+    def compact(self, name: str) -> None:
+        self._journaled_fanout(
+            "compact", name, self._fanout_calls("compact", name), {}
+        )
+        self._note_write(name)
+
+    def age_off(self, name: str) -> int:
+        results = self._journaled_fanout(
+            "age_off", name, self._fanout_calls("age_off", name), {}
+        )
+        removed = sum(int(v or 0) for v in results.values())
+        if removed:
+            self._note_write(name)
+        return removed
+
+    def delete_schema(self, name: str) -> None:
+        self.get_schema(name)  # unknown type raises BEFORE the intent lands
+        self._journaled_fanout(
+            "delete_schema", name, self._fanout_calls("delete_schema", name), {}
+        )
+        # the local catalog half comes LAST: a crash before it leaves a
+        # pending intent whose replay finishes exactly this drop
+        super(ShardedDataStore, self).delete_schema(name)
+        self._partitions.pop(name, None)
 
     # -- placement persistence + recovery ------------------------------------
 
@@ -2323,12 +3172,18 @@ class FleetDataStore(ShardedDataStore):
             p for p in self._all_partitions()
             if states[self.placement.primary(p)] != LIVE
         )
+        lease = self._lease.status()
+        lease["standby"] = self._standby
+        lease["fenced"] = self._fenced
         return {
             "workers": len(self.workers),
             "states": {str(i): s for i, s in enumerate(states)},
             "down": down,
             "unowned_partitions": unowned,
             "placement_moved": len(self.placement.overrides),
+            "lease": lease,
+            "fanouts_pending": len(self._fleet_journal.pending_fanouts()),
+            "scan_chunk_peak_bytes": scan_chunk_peak(),
         }
 
     def fleet_snapshot(self) -> Dict[str, Any]:
@@ -2357,6 +3212,19 @@ class FleetDataStore(ShardedDataStore):
                 },
             },
             "health": self.fleet_health(),
+            "lease": self.standby_status(),
+            "fanouts": {
+                "pending": [
+                    {
+                        "op": r.get("kind"),
+                        "name": r.get("name"),
+                        "participants": len(r.get("participants", ())),
+                        "done": len(r.get("done", ())),
+                        "ts": r.get("ts"),
+                    }
+                    for r in self._fleet_journal.pending_fanouts()
+                ],
+            },
         }
 
         def gather(i: int, w: Any) -> Dict[str, Any]:

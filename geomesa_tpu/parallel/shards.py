@@ -525,8 +525,8 @@ class ShardedDataStore(TpuDataStore):
         # and bumps the write generation so build-cache keys can never
         # reproduce the deleted incarnation
         super().delete_schema(name)
-        for w in self.workers:
-            w.delete_schema(name)
+        for call in self._fanout_calls("delete_schema", name).values():
+            call()
         self._partitions.pop(name, None)
 
     def _insert_columns(self, ft, columns, observe_stats: bool = True):
@@ -563,26 +563,63 @@ class ShardedDataStore(TpuDataStore):
         a direct call."""
         self.workers[sid].insert(partition, ft, columns)
 
+    def _fanout_calls(self, kind: str, name: str, fids=None) -> Dict[str, Any]:
+        """Ordered ``{participant_key: thunk}`` for one cross-worker
+        mutation fan-out (``delete``/``compact``/``delete_schema``/
+        ``age_off``) — the seam the cross-process fleet journals
+        (parallel/fleet.py): the participant list lands in a durable
+        roll-forward intent BEFORE the first thunk runs, each completed
+        participant is done-marked, and a coordinator crash at any
+        position replays only the remainder. Every thunk is idempotent
+        (worker-side ops ignore absent types/fids), so replaying an
+        already-applied participant is safe. In-process fabrics just
+        run the thunks in order."""
+        calls: Dict[str, Any] = {}
+        if kind == "delete":
+            for i, w in enumerate(self.workers):
+                calls[str(i)] = functools.partial(w.delete, name, fids)
+        elif kind == "compact":
+            for i, w in enumerate(self.workers):
+                calls[str(i)] = functools.partial(w.compact, name)
+        elif kind == "delete_schema":
+            for i, w in enumerate(self.workers):
+                calls[str(i)] = functools.partial(w.delete_schema, name)
+        elif kind == "age_off":
+            by_primary: Dict[int, List[str]] = {}
+            for p in sorted(self._partitions.get(name, ())):
+                by_primary.setdefault(self.placement.primary(p), []).append(p)
+            for sid, ps in sorted(by_primary.items()):
+                calls[str(sid)] = functools.partial(
+                    self._age_off_chain, name, sid, ps
+                )
+        else:
+            raise ValueError(f"unknown fan-out kind {kind!r}")
+        return calls
+
+    def _age_off_chain(self, name: str, sid: int, partitions) -> int:
+        """Age off one primary's partitions across its whole placement
+        chain; counts the PRIMARY's removals only (replicas mirror)."""
+        removed = 0
+        for t in self.placement.chain(sid):
+            n = self.workers[t].age_off(name, partitions)
+            if t == sid:
+                removed = n
+        return removed
+
     def delete_features(self, name: str, fids) -> None:
-        for w in self.workers:
-            w.delete(name, fids)
+        for call in self._fanout_calls("delete", name, fids=fids).values():
+            call()
         self._note_write(name)
 
     def compact(self, name: str) -> None:
-        for w in self.workers:
-            w.compact(name)
+        for call in self._fanout_calls("compact", name).values():
+            call()
         self._note_write(name)
 
     def age_off(self, name: str) -> int:
-        by_primary: Dict[int, List[str]] = {}
-        for p in sorted(self._partitions.get(name, ())):
-            by_primary.setdefault(self.placement.primary(p), []).append(p)
-        removed = 0
-        for sid, ps in sorted(by_primary.items()):
-            for t in self.placement.chain(sid):
-                n = self.workers[t].age_off(name, ps)
-                if t == sid:
-                    removed += n  # count primaries only; replicas mirror
+        removed = sum(
+            call() for call in self._fanout_calls("age_off", name).values()
+        )
         if removed:
             # age-off mutates worker rows like any delete: the write
             # generation must move or schema-generation cache keys

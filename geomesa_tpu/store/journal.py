@@ -250,7 +250,8 @@ class IntentJournal:
         Idempotent: a crash DURING recovery re-runs to the same state at
         the next open. ``metadata`` (when given) lets ``drop_type``
         intents finish their schema-registry deletion."""
-        summary = {"forward": 0, "back": 0, "corrupt": 0, "kept": 0}
+        summary = {"forward": 0, "back": 0, "corrupt": 0, "kept": 0,
+                   "fanouts": 0}
         pend = self.pending()
         if not pend:
             return summary
@@ -268,6 +269,17 @@ class IntentJournal:
                     quarantine(path)
                     m.inc("recovery.intent.corrupt")
                     summary["corrupt"] += 1
+                    continue
+                if rec.get("fanout"):
+                    # a fan-out intent is a ROLL-FORWARD obligation whose
+                    # remaining participants live outside this store's
+                    # files: file-level recovery must neither commit nor
+                    # roll it back (committing would silently drop the
+                    # obligation — it has no publishes). The fleet
+                    # coordinator replays it (_replay_fanouts) once its
+                    # workers are reachable.
+                    m.inc("recovery.fanout.pending")
+                    summary["fanouts"] += 1
                     continue
                 missing = [
                     p for p in publishes if not os.path.exists(self._abs(p))
@@ -298,6 +310,73 @@ class IntentJournal:
                 else:
                     summary["kept"] += 1
         return summary
+
+    # -- cross-worker fan-out intents ----------------------------------------
+    #
+    # A fleet mutation fan-out (delete/compact/delete_schema/age_off,
+    # parallel/fleet.py) touches MANY worker processes with no shared
+    # filesystem transaction to lean on, so its crash boundary is a
+    # roll-forward record here: the full participant list lands durably
+    # before the first worker is touched, each completed participant is
+    # done-marked durably, and the record commits only after the last
+    # one. A coordinator crash at any position leaves the record (and
+    # its done-marks) for the takeover/restart coordinator to replay —
+    # every participant op is idempotent, so replaying an
+    # already-applied participant is safe.
+
+    def fanout_begin(
+        self,
+        kind: str,
+        name: str,
+        participants: Sequence[str],
+        payload: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Durably record a cross-worker fan-out intent; returns the
+        record path used for done-marks and the final commit."""
+        record: Dict[str, Any] = {
+            "op": f"fleet.fanout.{kind}",
+            "ts": time.time(),
+            "fanout": {
+                "kind": kind,
+                "name": name,
+                "participants": [str(p) for p in participants],
+                "done": [],
+                "payload": dict(payload or {}),
+            },
+        }
+        return self._write_record(record)
+
+    def fanout_done(self, path: str, participant: str) -> None:
+        """Durably done-mark one participant (idempotent): the replay
+        after a crash re-runs only the participants not marked here."""
+        rec = json.loads(read_verified(path).decode())
+        fan = rec.setdefault("fanout", {})
+        done = fan.setdefault("done", [])
+        if str(participant) not in done:
+            done.append(str(participant))
+            _INTENT_WRITE_RETRY.call(self._write_record_once, path, rec)
+
+    def fanout_finish(self, path: str) -> None:
+        """Commit a fully-applied fan-out intent (absorbs transient
+        failures exactly like ``_commit`` — the mutation already
+        applied, replay of a fully-done record is a no-op)."""
+        self._commit(path)
+
+    def pending_fanouts(self) -> List[Dict[str, Any]]:
+        """Uncommitted fan-out intents, oldest first: ``[{path, ts,
+        kind, name, participants, done, payload}]``. Corrupt records are
+        left for ``recover()`` to quarantine."""
+        out: List[Dict[str, Any]] = []
+        for path in self.pending():
+            try:
+                rec = json.loads(read_verified(path).decode())
+            except (CorruptFileError, ValueError, UnicodeDecodeError,
+                    AttributeError):
+                continue
+            fan = rec.get("fanout")
+            if fan:
+                out.append({"path": path, "ts": rec.get("ts"), **fan})
+        return out
 
 
 class _Intent:

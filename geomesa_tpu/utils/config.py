@@ -217,6 +217,24 @@ FLEET_TRACE_MAX_BYTES = SystemProperty(
 )
 FLEET_DEBUG_BUDGET = SystemProperty("geomesa.fleet.debug.budget", "1 second")
 FLEET_DEBUG_TRACES = SystemProperty("geomesa.fleet.debug.traces", "16")
+# Coordinator HA (parallel/fleet.py): the active coordinator holds the
+# durably-leased `_fleet/lease` file (fencing epoch bumped on every
+# acquire), renewing it every `lease.renew.interval`; a standby
+# coordinator watching the same root takes over once the lease has gone
+# `lease.ttl` without a renewal. Workers remember the highest epoch
+# they have served and reject mutating RPCs carrying an older one, so a
+# fenced-out zombie coordinator can never split-brain a write.
+# `scan.chunk.bytes` bounds each Arrow frame of a streamed worker scan
+# reply (op_scan chunks through `iter_column_chunks` with the deadline
+# checked per chunk); explicit 0 disables streaming and restores the
+# materialize-then-reply exchange.
+FLEET_LEASE_TTL = SystemProperty("geomesa.fleet.lease.ttl", "3 seconds")
+FLEET_LEASE_RENEW = SystemProperty(
+    "geomesa.fleet.lease.renew.interval", "1 second"
+)
+FLEET_SCAN_CHUNK_BYTES = SystemProperty(
+    "geomesa.fleet.scan.chunk.bytes", "8MB"
+)
 # Spatial placement granularity: partitions are low-resolution z2 cells
 # of the point geometry (store/partitions.Z2Scheme, `bits` even), so a
 # bbox query routes to the shards owning intersecting cells only;

@@ -48,6 +48,22 @@ disk, or device boundary:
                        ``crash`` at any position must recover to exactly
                        the pre- or post-move placement, never a partition
                        owned by zero or two primaries
+    fleet.lease        one coordinator lease acquire/renew (parallel/
+                       fleet.py): the durably-leased ``_fleet/lease``
+                       file with its fencing epoch — a ``crash`` here
+                       models the ACTIVE COORDINATOR dying between
+                       renewals; the standby must take over past the
+                       TTL with a higher epoch, and the zombie's
+                       stale-epoch mutating RPCs must bounce at the
+                       workers (split-brain fencing)
+    fleet.fanout       one cross-worker mutation fan-out position
+                       (parallel/fleet.py): delete/compact/
+                       delete_schema/age_off journal a roll-forward
+                       fan-out intent (participants + per-worker
+                       done-marks) before touching any worker — a
+                       ``crash`` at any position replays the remaining
+                       participants at takeover/restart, never leaving
+                       half the workers mutated
 
 Kinds:
 
@@ -128,6 +144,8 @@ FAULT_POINTS = (
     "fleet.rpc",
     "fleet.heartbeat",
     "fleet.rebalance",
+    "fleet.lease",
+    "fleet.fanout",
 )
 
 KINDS = ("error", "drop", "latency", "torn", "crash")

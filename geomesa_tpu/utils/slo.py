@@ -34,6 +34,14 @@ page-on-fast-burn pair) AND the fast window saw at least
 moment the fast window slides clean, /healthz clears, even while the
 slow window still remembers the incident.
 
+On a fleet coordinator the same gate ALSO runs per worker, over the
+unmerged ``per_worker`` series the timeline rollup keeps
+(``timeline.merge_worker_ticks``): one sick worker whose latency the
+fleet-merged histogram would dilute below threshold still violates its
+class objective, and the verdict is attributed
+(``<slo-name>@worker<id>``) so /healthz names both the SLO and the
+worker burning it.
+
 Exemplars close the loop: with ``geomesa.slo.exemplars`` on (raised by
 the first timeline sampler), every timer keeps (value, trace_id) pairs
 per latency bucket (utils/audit.py), so ``GET /debug/slo`` and the
@@ -183,6 +191,41 @@ class SloEngine:
                     bad += n
         return events, bad
 
+    @staticmethod
+    def _fold_workers(
+        snaps: List[Dict[str, Any]], spec: SloSpec
+    ) -> Dict[str, Tuple[int, int]]:
+        """Per-worker ``{wid: (events, bad)}`` over one window, folded
+        from the fleet rollup's UNMERGED ``per_worker`` series
+        (``timeline.merge_worker_ticks``). Empty on non-fleet stores —
+        the engine then behaves exactly as before."""
+        meta = CLASSES[spec.cls]
+        thr_bucket = (
+            audit.exemplar_bucket(spec.latency_ms / 1000.0)
+            if spec.kind == "latency"
+            else None
+        )
+        acc: Dict[str, List[int]] = {}
+        for s in snaps:
+            per = ((s.get("fleet") or {}).get("rollup") or {}).get(
+                "per_worker"
+            ) or {}
+            for wid, series in per.items():
+                row = acc.setdefault(str(wid), [0, 0])
+                if spec.kind == "availability":
+                    deltas = series.get("counters") or {}
+                    row[0] += int(deltas.get(meta["counter"], 0))
+                    row[1] += sum(int(deltas.get(b, 0)) for b in meta["bad"])
+                    continue
+                t = (series.get("timers") or {}).get(meta["timer"])
+                if not t:
+                    continue
+                row[0] += int(t.get("count", 0))
+                for b, n in (t.get("hist") or {}).items():
+                    if int(b) > thr_bucket:
+                        row[1] += int(n)
+        return {w: (e, b) for w, (e, b) in acc.items()}
+
     def _window_eval(
         self, spec: SloSpec, window_s: float, snaps: List[Dict[str, Any]]
     ) -> Dict[str, Any]:
@@ -224,8 +267,20 @@ class SloEngine:
                 and fast["burn_rate"] >= fast_burn
                 and slow["burn_rate"] >= slow_burn
             )
+            # per-worker burn (fleet stores only): a single sick worker
+            # violates its class objective even when the fleet-merged
+            # histogram dilutes it under threshold — skew a sum hides
+            workers = self._workers_eval(
+                spec,
+                fast_snaps,
+                slow_snaps,
+                (enabled, fast_burn, slow_burn, min_events),
+            )
+            sick = sorted(w for w, r in workers.items() if r["violating"])
             if violated:
                 violating.append(spec.name)
+            for w in sick:
+                violating.append(f"{spec.name}@worker{w}")
             rows.append({
                 "name": spec.name,
                 "class": spec.cls,
@@ -234,7 +289,9 @@ class SloEngine:
                 "latency_ms": spec.latency_ms,
                 "fast": fast,
                 "slow": slow,
-                "violating": violated,
+                "violating": violated or bool(sick),
+                "violating_workers": sick,
+                "workers": workers,
                 "exemplars": (
                     self.worst_exemplars(spec.cls) if exemplars else []
                 ),
@@ -249,6 +306,53 @@ class SloEngine:
             "slos": rows,
             "violating": violating,
         }
+
+    def _workers_eval(
+        self,
+        spec: SloSpec,
+        fast_snaps: List[Dict[str, Any]],
+        slow_snaps: List[Dict[str, Any]],
+        knobs: Tuple[bool, float, float, int],
+    ) -> Dict[str, Any]:
+        """Per-worker burn rows for one spec: ``{wid: {fast, slow,
+        violating}}``, workers with zero events omitted. The violation
+        rule is the SAME multi-window/min-events gate as the merged
+        series, applied to one worker's own events — so the verdict
+        names the sick worker instead of waiting for the fleet average
+        to cross."""
+        enabled, fast_burn, slow_burn, min_events = knobs
+        fast_w = self._fold_workers(fast_snaps, spec)
+        if not fast_w:
+            return {}
+        slow_w = self._fold_workers(slow_snaps, spec)
+        budget = 1.0 - spec.objective
+        out: Dict[str, Any] = {}
+        for wid in sorted(set(fast_w) | set(slow_w)):
+            fe, fb = fast_w.get(wid, (0, 0))
+            se, sb = slow_w.get(wid, (0, 0))
+            if not fe and not se:
+                continue
+            f_rate = (
+                round(((fb / fe) if fe else 0.0) / budget, 3)
+                if budget > 0
+                else 0.0
+            )
+            s_rate = (
+                round(((sb / se) if se else 0.0) / budget, 3)
+                if budget > 0
+                else 0.0
+            )
+            out[wid] = {
+                "fast": {"events": fe, "bad": fb, "burn_rate": f_rate},
+                "slow": {"events": se, "bad": sb, "burn_rate": s_rate},
+                "violating": (
+                    enabled
+                    and fe >= min_events
+                    and f_rate >= fast_burn
+                    and s_rate >= slow_burn
+                ),
+            }
+        return out
 
     def violating(self) -> List[str]:
         """Just the violating SLO names — the /healthz degradation

@@ -324,7 +324,12 @@ def merge_worker_ticks(workers: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
       worker (a silently degrading worker — device breaker open, host
       scans — becomes visible from the coordinator);
     * **unreachable** — workers whose tick did not answer under the
-      passive budget.
+      passive budget;
+    * **per_worker** — each reachable worker's UNMERGED counter/timer
+      series, keyed by worker. The SLO engine burns these individually:
+      a single sick worker must violate its class objective even when
+      the fleet-summed histogram dilutes it below threshold (the skew a
+      sum can never show).
 
     Gauges are deliberately NOT rolled up: summing HBM residency or pad
     ratios across processes is a lie; the per-worker blocks keep them."""
@@ -334,6 +339,7 @@ def merge_worker_ticks(workers: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         "timers": {},
         "breakers": {},
         "unreachable": [],
+        "per_worker": {},
     }
     counters = rollup["counters"]
     timers = rollup["timers"]
@@ -344,8 +350,11 @@ def merge_worker_ticks(workers: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
             continue
         rollup["workers"] += 1
         tick = row.get("tick") or {}
+        w_counters: Dict[str, int] = {}
+        w_timers: Dict[str, Any] = {}
         for k, v in (tick.get("counters") or {}).items():
             counters[k] = counters.get(k, 0) + int(v)
+            w_counters[k] = int(v)
         for name, t in (tick.get("timers") or {}).items():
             acc = timers.setdefault(
                 name, {"count": 0, "sum_ms": 0.0, "hist": {}}
@@ -354,9 +363,19 @@ def merge_worker_ticks(workers: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
             acc["sum_ms"] = round(
                 acc["sum_ms"] + float(t.get("sum_ms", 0.0)), 3
             )
-            for b, n in (t.get("hist") or {}).items():
-                b = str(b)
-                acc["hist"][b] = acc["hist"].get(b, 0) + int(n)
+            hist = {str(b): int(n) for b, n in (t.get("hist") or {}).items()}
+            for b, n in hist.items():
+                acc["hist"][b] = acc["hist"].get(b, 0) + n
+            w_timers[name] = {
+                "count": int(t.get("count", 0)),
+                "sum_ms": round(float(t.get("sum_ms", 0.0)), 3),
+                "hist": hist,
+            }
+        if w_counters or w_timers:
+            rollup["per_worker"][wid] = {
+                "counters": w_counters,
+                "timers": w_timers,
+            }
         open_b = sorted(
             name
             for name, state in (tick.get("breakers") or {}).items()

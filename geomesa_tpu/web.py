@@ -172,15 +172,33 @@ def debug_recovery_payload(store):
 
     counters, _g, _t, _tt = robustness_metrics().snapshot()
     jr = getattr(store, "journal", None)
-    return {
+    out = {
         "last_recovery": getattr(store, "last_recovery", None),
         "journal_pending": None if jr is None else len(jr.pending()),
         "counters": {
             k: v
             for k, v in sorted(counters.items())
-            if k.startswith(("recovery.", "journal.", "quarantine."))
+            if k.startswith(
+                ("recovery.", "journal.", "quarantine.", "fleet.fanout.")
+            )
         },
     }
+    # fleet coordinators: cross-worker fan-out intents still owing a
+    # roll-forward replay (delete/compact/age_off/delete_schema that
+    # crashed mid-fan-out) — the takeover/restart replay drains these
+    fj = getattr(store, "_fleet_journal", None)
+    if fj is not None and hasattr(fj, "pending_fanouts"):
+        out["fanouts"] = [
+            {
+                "op": rec.get("kind"),
+                "name": rec.get("name"),
+                "participants": len(rec.get("participants") or ()),
+                "done": len(rec.get("done") or ()),
+                "ts": rec.get("ts"),
+            }
+            for rec in fj.pending_fanouts()
+        ]
+    return out
 
 
 def debug_timeline_payload(store, s: float = DEFAULT_TIMELINE_S):
@@ -815,8 +833,21 @@ def make_handler(store):
                             "workers": fh["workers"],
                             "down": fh["down"],
                             "unowned_partitions": fh["unowned_partitions"],
+                            # coordinator HA state: who holds the fleet
+                            "lease": fh.get("lease"),
+                            # lease (+ fencing epoch), whether THIS
+                            # process is a standby or has been fenced
+                            # off, and how many cross-worker fan-outs
+                            # still owe a roll-forward replay
+                            "fanouts_pending": fh.get("fanouts_pending", 0),
                         }
-                        if fh["down"] or fh["unowned_partitions"]:
+                        lease = fh.get("lease") or {}
+                        if (
+                            fh["down"]
+                            or fh["unowned_partitions"]
+                            or lease.get("fenced")
+                            or fh.get("fanouts_pending")
+                        ):
                             body["status"] = "degraded"
                     # SLO burn-rate degradation (utils/slo.py): while any
                     # query class burns its error budget past both window

@@ -74,6 +74,16 @@
 #     fleet.trace decision); a real SIGKILL's in-flight subtree
 #     degrades to the stub path while the failover attempt against the
 #     replica still stitches
+#   - fleet survives the COORDINATOR (tests/test_fleet.py, its own
+#     90 s cap): a crash schedule at every fleet.fanout position of a
+#     cross-worker mutation leaves the fleet exactly pre-op or post-op
+#     (an intent on disk is rolled FORWARD at takeover, never half-
+#     applied); a standby seizes the lease when renewals stop and the
+#     fenced ex-coordinator's mutating RPCs bounce with StaleEpoch; a
+#     real SIGKILL of the coordinator process mid-fan-out lets the
+#     standby adopt the orphaned workers, replay the pending intent,
+#     and answer the post-op result set with every partition primary-
+#     owned and zero divergent workers
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
@@ -86,7 +96,18 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
     -q -m chaos -p no:cacheprovider "$@" || rc=$?
 # the real-SIGKILL fleet soak spawns worker PROCESSES: bounded on its
 # own so a wedged spawn can never eat the in-process soaks' budget
+# (the coordinator-kill soaks run in their own leg below)
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py \
-    -q -m chaos -p no:cacheprovider "$@" || rc=$?
+    -q -m chaos -p no:cacheprovider \
+    -k "not coordinator and not takeover and not fanout" "$@" || rc=$?
+# the coordinator-kill leg: crash-position sweeps over cross-worker
+# fan-outs, the standby-takeover fencing soak, and the real-SIGKILL
+# coordinator death mid-fan-out — bounded on its own so a wedged
+# takeover (lease wait, process spawn) can never eat the worker-death
+# leg's budget
+timeout -k 10 90 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet.py \
+    -q -m chaos -p no:cacheprovider \
+    -k "coordinator or takeover or fanout" "$@" || rc=$?
 exit $rc

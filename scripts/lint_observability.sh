@@ -37,6 +37,8 @@ declare -A SPANS=(
     ["fleet.rpc"]="geomesa_tpu/parallel/fleet.py"
     ["fleet.heartbeat"]="geomesa_tpu/parallel/fleet.py"
     ["fleet.rebalance"]="geomesa_tpu/parallel/fleet.py"
+    ["fleet.lease"]="geomesa_tpu/parallel/fleet.py"
+    ["fleet.fanout"]="geomesa_tpu/parallel/fleet.py"
 )
 for point in "${!SPANS[@]}"; do
     file="${SPANS[$point]}"

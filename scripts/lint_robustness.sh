@@ -69,16 +69,18 @@ for point in shard.rpc shard.merge; do
 done
 
 # the multi-host fleet boundaries are pinned the same way: the
-# cross-process RPC, the heartbeat probe, and the journaled placement
-# move (parallel/fleet.py) must stay injectable — rule 3 above already
+# cross-process RPC, the heartbeat probe, the journaled placement
+# move, the coordinator lease write, and the cross-worker fan-out
+# (parallel/fleet.py) must stay injectable — rule 3 above already
 # forces the file to consult the deadline beside them (the fleet RPC
 # derives its socket timeout from min(knob, remaining) per attempt and
 # checks the budget BEFORE the dial)
-for point in fleet.rpc fleet.heartbeat fleet.rebalance; do
+for point in fleet.rpc fleet.heartbeat fleet.rebalance fleet.lease fleet.fanout; do
     if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/parallel/fleet.py; then
         echo "FAIL: geomesa_tpu/parallel/fleet.py lost the '${point}' fault point"
-        echo "      (the fleet contract: process death, missed heartbeats, and"
-        echo "       crashed rebalances must stay chaos-testable —"
+        echo "      (the fleet contract: process death, missed heartbeats,"
+        echo "       crashed rebalances, lease renewals, and cross-worker"
+        echo "       fan-outs must stay chaos-testable —"
         echo "       faults.fault_point(\"${point}\") beside a deadline check;"
         echo "       see utils/faults.py)"
         fail=1

@@ -321,7 +321,7 @@ def test_debug_recovery_endpoint(tmp_path):
         )
     assert body["journal_pending"] == 0
     assert body["last_recovery"]["intents"] == {
-        "forward": 0, "back": 0, "corrupt": 0, "kept": 0
+        "forward": 0, "back": 0, "corrupt": 0, "kept": 0, "fanouts": 0
     }
     assert body["last_recovery"]["scrub"]["tmp_swept"] == 0
     assert "duration_ms" in body["last_recovery"]

@@ -35,10 +35,13 @@ import pytest
 from geomesa_tpu.geom.base import Point
 from geomesa_tpu.parallel.fleet import (
     FleetDataStore,
+    FleetLease,
+    StaleEpoch,
     WorkerClient,
     WorkerUnavailable,
     columns_to_ipc,
     ipc_to_columns,
+    scan_chunk_peak,
 )
 from geomesa_tpu.schema.featuretype import parse_spec
 from geomesa_tpu.store.datastore import TpuDataStore
@@ -297,7 +300,13 @@ def test_socket_timeout_rederived_from_remaining_budget():
 
 
 def test_fleet_fault_points_registered():
-    for point in ("fleet.rpc", "fleet.heartbeat", "fleet.rebalance"):
+    for point in (
+        "fleet.rpc",
+        "fleet.heartbeat",
+        "fleet.rebalance",
+        "fleet.lease",
+        "fleet.fanout",
+    ):
         assert point in faults.FAULT_POINTS
 
 
@@ -1081,3 +1090,466 @@ def test_sigkill_inflight_subtree_degrades_to_stub(fleet, baseline):
     if fleet.supervisor.states()[victim] == OUT:
         fleet.supervisor.revive(victim)
     assert _await(lambda: _fleet_settled(fleet), timeout_s=30.0)
+
+
+# -- coordinator HA: lease, fencing, fan-out atomicity ------------------------
+
+
+def test_lease_acquire_renew_takeover_fencing(tmp_path):
+    """The FleetLease state machine: first acquire mints epoch 1, a
+    takeover bumps it, and the fenced ex-holder's next renewal comes
+    back False (the stand-down signal) instead of resurrecting it."""
+    path = str(tmp_path / "lease")
+    a = FleetLease(path, ttl_s=5.0)
+    assert a.acquire() == 1
+    assert a.renew() is True
+    st = a.status()
+    assert st["held_by_me"] and st["epoch"] == 1 and not st["expired"]
+    b = FleetLease(path, ttl_s=5.0)
+    assert b.acquire() == 2  # forceful seize bumps past the holder
+    assert a.renew() is False  # fenced: A must stop mutating
+    assert b.renew() is True
+    st = b.status()
+    assert st["holder"] == b.holder and st["epoch"] == 2
+
+
+def test_lease_wait_respects_ttl_and_timeout(tmp_path):
+    """A polite (standby) acquire waits out the holder's TTL and is
+    bounded by timeout_s — it never seizes a fresh lease."""
+    path = str(tmp_path / "lease")
+    a = FleetLease(path, ttl_s=0.4)
+    a.acquire()
+    b = FleetLease(path, ttl_s=0.4)
+    with pytest.raises(TimeoutError):
+        b.acquire(wait=True, timeout_s=0.1)
+    t0 = time.monotonic()
+    assert b.acquire(wait=True, timeout_s=10.0) == 2
+    assert time.monotonic() - t0 >= 0.2  # waited for the record to stale
+
+
+def test_lease_corrupt_record_quarantines_and_reads_absent(tmp_path):
+    path = str(tmp_path / "lease")
+    a = FleetLease(path, ttl_s=5.0)
+    assert a.acquire() == 1
+    with open(path, "wb") as fh:
+        fh.write(b"torn garbage not a CRC frame")
+    before = robustness_metrics().counter("fleet.lease.corrupt")
+    b = FleetLease(path, ttl_s=5.0)
+    assert b.read() is None
+    assert robustness_metrics().counter("fleet.lease.corrupt") == before + 1
+    # the next acquire starts a fresh epoch line; worker-side fencing
+    # (not the file) is what keeps a zombie's writes out
+    assert b.acquire() == 1
+
+
+def test_known_dead_worker_skips_the_retry_ladder():
+    """Satellite: a dial against a worker the supervisor already marked
+    DEAD/OUT (or that was never spawned) surfaces a crisp known-dead
+    WorkerUnavailable immediately — no retry ladder against a corpse."""
+    m = robustness_metrics()
+    before = m.counter("retry.fleet.rpc.retries")
+    client = WorkerClient(3, lambda: None)
+    t0 = time.monotonic()
+    with pytest.raises(WorkerUnavailable) as ei:
+        client.ping()
+    assert ei.value.known_dead
+    assert time.monotonic() - t0 < 1.0
+    assert m.counter("retry.fleet.rpc.retries") == before
+    client2 = WorkerClient(
+        0, lambda: ("127.0.0.1", _dead_port()), state_fn=lambda: "dead"
+    )
+    with pytest.raises(WorkerUnavailable) as ei2:
+        client2.ping()
+    assert ei2.value.known_dead
+    assert m.counter("retry.fleet.rpc.retries") == before
+
+
+def test_scan_chunk_knob_explicit_zero_and_clamp():
+    """The explicit-zero knob rule for geomesa.fleet.scan.chunk.bytes:
+    unset means the 8MB default, "0" means the legacy materialized
+    reply, and absurd values clamp to the frame budget."""
+    from geomesa_tpu.parallel.fleet import _FRAME_BUDGET, _scan_chunk_bytes
+
+    assert _scan_chunk_bytes() == 8 * 1024 * 1024
+    with properties(geomesa_fleet_scan_chunk_bytes="0"):
+        assert _scan_chunk_bytes() == 0
+    with properties(geomesa_fleet_scan_chunk_bytes="64KB"):
+        assert _scan_chunk_bytes() == 64 * 1024
+    with properties(geomesa_fleet_scan_chunk_bytes="100GB"):
+        assert _scan_chunk_bytes() == _FRAME_BUDGET
+
+
+def test_lease_crash_on_acquire_then_fresh_coordinator_recovers(tmp_path):
+    """A coordinator that dies INSIDE the lease acquire (the fleet.lease
+    fault point) leaves a root any fresh coordinator can seize — the
+    forceful epoch bump never waits on a dead holder's record."""
+    root = tmp_path / "leasecrash"
+    rule = faults.FaultRule("fleet.lease", "crash", max_fires=1)
+    with faults.inject(rules=[rule]):
+        with pytest.raises(faults.SimulatedCrash):
+            FleetDataStore(
+                str(root), num_workers=4, replicas=1, partition_bits=2,
+                transport="inproc",
+            )
+    assert rule.fired == 1
+    st = inproc_fleet(root)
+    try:
+        assert st._lease.status()["held_by_me"]
+        assert sorted(st.query("t", "INCLUDE").fids) == sorted(
+            f for f, _ in rows()
+        )
+    finally:
+        st.close()
+
+
+@pytest.mark.chaos
+def test_fanout_crash_sweep_delete_features_pre_or_post(tmp_path):
+    """The crash-schedule sweep at the fan-out layer: a coordinator
+    SimulatedCrash at EVERY fleet.fanout position leaves delete_features
+    either fully un-applied (crash before the intent) or — once the
+    intent is journaled — rolled FORWARD by the next coordinator's
+    replay. No position may surface a half-deleted table."""
+    from geomesa_tpu.store.journal import IntentJournal
+
+    all_fids = sorted(f for f, _ in rows())
+    doomed = all_fids[::9]
+    want_pre = all_fids
+    want_post = sorted(set(all_fids) - set(doomed))
+    position = 0
+    while position < 12:
+        root = tmp_path / f"fan{position}"
+        st = inproc_fleet(root)
+        rule = faults.FaultRule(
+            "fleet.fanout", "crash", max_fires=1, skip=position
+        )
+        crashed = False
+        with faults.inject(rules=[rule]):
+            try:
+                st.delete_features("t", doomed)
+            except faults.SimulatedCrash:
+                crashed = True
+        if not crashed:
+            assert rule.fired == 0
+            assert sorted(st.query("t", "INCLUDE").fids) == want_post
+            st.close()
+            break
+        intent_pending = bool(
+            IntentJournal(str(root / "_fleet")).pending_fanouts()
+        )
+        # "coordinator recovery": the replay a restarted coordinator (or
+        # a standby's takeover) runs before serving anything — the
+        # recover_fleet() lever of the rebalance sweep, one layer up.
+        # (In-proc workers are memory-backed, so the recovery runs on
+        # the same object; the real cross-process restart is the SIGKILL
+        # soak below.)
+        st._replay_fanouts()
+        got = sorted(st.query("t", "INCLUDE").fids)
+        assert not st._fleet_journal.pending_fanouts()
+        if intent_pending:
+            # a journaled intent is an obligation: always roll-forward
+            assert got == want_post, position
+        else:
+            assert got == want_pre, position  # crash before the intent
+        st.close()
+        position += 1
+    assert position >= 3, "the sweep never reached the fan-out interior"
+
+
+@pytest.mark.chaos
+def test_fanout_crash_delete_schema_replays_local_half(tmp_path):
+    """delete_schema's fan-out dies after the intent (one worker already
+    dropped): the next coordinator replays the remaining workers AND the
+    local catalog half the dying coordinator never reached."""
+    root = tmp_path / "dropschema"
+    st = inproc_fleet(root)
+    rule = faults.FaultRule("fleet.fanout", "crash", max_fires=1, skip=2)
+    with faults.inject(rules=[rule]):
+        with pytest.raises(faults.SimulatedCrash):
+            st.delete_schema("t")
+    try:
+        # the schema is still half-alive: the local catalog keeps it
+        # until the replay finishes the fan-out AND the local drop
+        assert st._fleet_journal.pending_fanouts()
+        assert st._replay_fanouts() == 1
+        types = st.type_names
+        if callable(types):
+            types = types()
+        assert "t" not in list(types)
+        assert not st._fleet_journal.pending_fanouts()
+    finally:
+        st.close()
+
+
+def test_healthz_and_debug_surfaces_report_lease_and_fanouts(tmp_path):
+    """Satellite: /healthz carries the lease holder/epoch + pending
+    fan-out count (degrading while a replay is owed), /debug/fleet shows
+    the full lease record and intent list, and /debug/recovery joins the
+    fan-out replay summary."""
+    from geomesa_tpu.web import GeoMesaServer
+
+    st = inproc_fleet(tmp_path / "web")
+
+    def _get(url):
+        return json.loads(urllib.request.urlopen(url).read())
+
+    try:
+        with GeoMesaServer(st) as url:
+            h = _get(url + "/healthz")
+            assert h["status"] == "ok"
+            lease = h["fleet"]["lease"]
+            assert lease["held_by_me"] and lease["epoch"] >= 1
+            assert not lease["expired"]
+            assert h["fleet"]["fanouts_pending"] == 0
+            # an unfinished fan-out intent is a visible repair obligation
+            path = st._fleet_journal.fanout_begin(
+                "delete", "t", ["w0", "w1"], {"fids": ["f00001"]}
+            )
+            h2 = _get(url + "/healthz")
+            assert h2["status"] == "degraded"
+            assert h2["fleet"]["fanouts_pending"] == 1
+            dbg = _get(url + "/debug/fleet")
+            assert dbg["lease"]["holder"] == st._lease.holder
+            assert dbg["fanouts"]["pending"][0]["op"] == "delete"
+            assert dbg["fanouts"]["pending"][0]["participants"] == 2
+            rec = _get(url + "/debug/recovery")
+            assert rec["fanouts"][0]["op"] == "delete"
+            assert rec["fanouts"][0]["participants"] == 2
+            assert rec["fanouts"][0]["done"] == 0
+            st._fleet_journal.fanout_done(path, "w0")
+            st._fleet_journal.fanout_done(path, "w1")
+            st._fleet_journal.fanout_finish(path)
+            h3 = _get(url + "/healthz")
+            assert h3["status"] == "ok"
+            assert h3["fleet"]["fanouts_pending"] == 0
+    finally:
+        st.close()
+
+
+# -- chunked worker scan streams ----------------------------------------------
+
+
+def test_stream_first_batch_lands_before_the_slowest_worker(tmp_path):
+    """The incremental scatter-gather: one slow worker must not delay
+    the first streamed batch — groups release the moment THEIR outcome
+    is final, while the straggler keeps scanning."""
+    st = inproc_fleet(tmp_path / "stream")
+    originals = {}
+    try:
+        parts = st._all_partitions()
+        slow_worker = st.placement.primary(parts[-1])
+        assert any(st.placement.primary(p) != slow_worker for p in parts)
+        # slow the whole placement chain, or the hedge race would win
+        # from the replica and hide the straggler
+        for sid in st.placement.chain(slow_worker):
+            orig = st.workers[sid].scan
+
+            def slow_scan(*a, _orig=orig, **k):
+                time.sleep(0.8)
+                return _orig(*a, **k)
+
+            originals[sid] = orig
+            st.workers[sid].scan = slow_scan
+        t0 = time.monotonic()
+        gen = st.query_stream("t", "INCLUDE")
+        batches = [next(gen)]
+        dt_first = time.monotonic() - t0
+        batches.extend(gen)
+        dt_all = time.monotonic() - t0
+        assert dt_first < 0.6, dt_first  # first batch beat the straggler
+        assert dt_all >= 0.8, dt_all  # ... which really was slow
+        got = sorted(
+            str(x)
+            for b in batches
+            if b.num_rows
+            for x in b.column("__fid__").to_numpy(zero_copy_only=False)
+        )
+        assert got == sorted(f for f, _ in rows())
+    finally:
+        for sid, orig in originals.items():
+            st.workers[sid].scan = orig
+        st.close()
+
+
+@pytest.mark.chaos
+def test_streamed_scan_chunks_bound_memory_and_match(tmp_path, monkeypatch):
+    """Over the REAL wire: a small geomesa.fleet.scan.chunk.bytes makes
+    op_scan stream many bounded Arrow chunks; the answer matches the
+    single-process store and the coordinator's peak received frame stays
+    bounded by the knob (plus serialization slack) — never the full
+    materialization."""
+    from geomesa_tpu.parallel import fleet as fleet_mod
+
+    monkeypatch.setenv("GEOMESA_FLEET_SCAN_CHUNK_BYTES", "4096")
+    data = rows(400)
+    single = ingest(TpuDataStore(), data=data)
+    want = sorted(single.query("t", "INCLUDE").fids)
+    with properties(geomesa_fleet_heartbeat_interval="150 ms"):
+        st = ingest(
+            FleetDataStore(
+                str(tmp_path / "chunks"), num_workers=2, replicas=1,
+                partition_bits=2,
+            ),
+            data=data,
+        )
+        try:
+            fleet_mod._SCAN_CHUNK_PEAK["bytes"] = 0
+            before = robustness_metrics().counter("fleet.scan.chunks")
+            got = sorted(st.query("t", "INCLUDE").fids)
+            assert got == want
+            chunks = robustness_metrics().counter("fleet.scan.chunks") - before
+            assert chunks >= 4, chunks  # several bounded chunks, not one blob
+            peak = scan_chunk_peak()
+            assert 0 < peak <= 4096 * 4, peak
+        finally:
+            st.close()
+
+
+# -- standby takeover + split-brain fencing -----------------------------------
+
+
+@pytest.mark.chaos
+def test_standby_takeover_fences_the_old_coordinator(tmp_path):
+    """Split-brain: the active coordinator stops renewing (models a
+    wedged process that is still running), the standby waits out the
+    TTL, takes over by ADOPTING the live workers, and serves parity.
+    The old coordinator's next mutating RPC bounces with StaleEpoch at
+    every worker the new one has written to — its zombie writes cannot
+    land."""
+    root = str(tmp_path / "ha")
+    with properties(
+        geomesa_fleet_lease_ttl="600 ms",
+        geomesa_fleet_lease_renew_interval="100 ms",
+        geomesa_fleet_heartbeat_interval="150 ms",
+    ):
+        a = ingest(
+            FleetDataStore(root, num_workers=2, replicas=1, partition_bits=2)
+        )
+        b = None
+        try:
+            want = sorted(a.query("t", "INCLUDE").fids)
+            b = FleetDataStore(
+                root, num_workers=2, replicas=1, partition_bits=2,
+                standby=True,
+            )
+            sb = b.standby_status()
+            assert sb["standby"] and sb["epoch"] == 1
+            # the active "dies": renewals stop, the lease never releases
+            a._lease_stop.set()
+            a._lease_thread.join(timeout=2.0)
+            info = b.takeover(wait=True, timeout_s=20.0)
+            assert info["epoch"] == 2
+            assert info["adopted"] + info["spawned"] == 2
+            assert sorted(b.query("t", "INCLUDE").fids) == want
+            # teach every worker the new epoch with one mutating RPC
+            for w in b.workers:
+                w.compact("t")
+            # the fenced coordinator's mutation bounces crisply
+            with pytest.raises(StaleEpoch):
+                a.workers[0].delete("t", [want[0]])
+            with pytest.raises(StaleEpoch):
+                a.workers[1].delete("t", [want[0]])
+            assert sorted(b.query("t", "INCLUDE").fids) == want
+            assert b.fleet_health()["lease"]["holder"] == b._lease.holder
+        finally:
+            # b first: its supervisor owns the (adopted) workers now
+            if b is not None:
+                b.close()
+            a.close()
+
+
+_CHILD_COORDINATOR = """
+import sys
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.parallel.fleet import FleetDataStore
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.utils import faults
+
+root = sys.argv[1]
+st = FleetDataStore(root, num_workers=2, replicas=1, partition_bits=2)
+st.create_schema(
+    parse_spec("t", "name:String,n:Int,*geom:Point:srid=4326")
+)
+with st.writer("t") as w:
+    for i in range(40):
+        w.write(
+            [f"n{i % 7}", i, Point(float(i % 50), float(-(i % 50)))],
+            fid=f"f{i:05d}",
+        )
+print("READY", flush=True)
+# stall INSIDE the fan-out (after the intent + first participant), so a
+# kill -9 lands mid-mutation with the roll-forward obligation on disk
+rule = faults.FaultRule(
+    "fleet.fanout", "latency", latency_s=120.0, max_fires=1, skip=2
+)
+with faults.inject(rules=[rule]):
+    print("FANOUT", flush=True)
+    st.delete_features("t", [f"f{i:05d}" for i in range(0, 40, 4)])
+print("DONE", flush=True)
+"""
+
+
+@pytest.mark.chaos
+def test_sigkill_coordinator_mid_fanout_standby_rolls_forward(tmp_path):
+    """The acceptance soak: kill -9 the REAL coordinator process while a
+    cross-worker delete is half-applied. A standby seizes the lease,
+    adopts the orphaned worker processes, replays the pending fan-out
+    intent, and serves exactly the post-delete table — never the
+    half-deleted one — with every partition owned by exactly one live
+    primary."""
+    import subprocess
+
+    from geomesa_tpu.parallel.fleet import _repo_pythonpath
+    from geomesa_tpu.store.journal import IntentJournal
+
+    root = str(tmp_path / "killco")
+    script = tmp_path / "coordinator_child.py"
+    script.write_text(_CHILD_COORDINATOR)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _repo_pythonpath()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    import sys as _sys
+
+    proc = subprocess.Popen(
+        [_sys.executable, str(script), root],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+    try:
+        seen = []
+        for line in proc.stdout:
+            seen.append(line.strip())
+            if line.strip() == "FANOUT":
+                break
+        assert "READY" in seen and "FANOUT" in seen, seen
+        # wait for the intent (and the first done-mark) to be durable
+        assert _await(
+            lambda: bool(
+                IntentJournal(os.path.join(root, "_fleet")).pending_fanouts()
+            ),
+            timeout_s=20.0,
+        ), "the fan-out intent never reached the journal"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    all_fids = [f"f{i:05d}" for i in range(40)]
+    want_post = sorted(set(all_fids) - set(all_fids[::4]))
+    b = FleetDataStore(
+        root, num_workers=2, replicas=1, partition_bits=2, standby=True
+    )
+    try:
+        info = b.takeover(wait=False)
+        assert info["fanouts_replayed"] == 1
+        assert info["adopted"] + info["spawned"] == 2
+        assert not b._fleet_journal.pending_fanouts()
+        got = sorted(b.query("t", "INCLUDE").fids)
+        assert got == want_post  # rolled FORWARD, never half-deleted
+        fh = b.fleet_health()
+        assert fh["down"] == [] and fh["unowned_partitions"] == []
+        assert fh["lease"]["held_by_me"]
+    finally:
+        b.close()

@@ -327,6 +327,54 @@ def test_availability_burn_needs_min_events():
         assert "query-availability" in slo.SloEngine(s).violating()
 
 
+def test_per_worker_burn_names_the_sick_worker():
+    """Fleet rollups keep each worker's series UNMERGED so one sick
+    worker's burn cannot hide inside a healthy fleet average: the
+    engine appends ``<slo>@worker<id>`` to the violating list (the
+    /healthz degradation input) and carries the per-worker burn rows on
+    the spec's evaluation."""
+
+    class _Fleetish:
+        def _timeline_extra(self):
+            return {
+                "fleet": {
+                    "rollup": {
+                        "per_worker": {
+                            "0": {"counters": {"queries": 40}, "timers": {}},
+                            "2": {
+                                "counters": {
+                                    "queries": 10,
+                                    "queries.timeout": 9,
+                                },
+                                "timers": {},
+                            },
+                        }
+                    }
+                }
+            }
+
+    reg = MetricsRegistry()
+    store = _Fleetish()
+    s = timeline.TimelineSampler(
+        store=store, registries=[reg], interval_s=0.1, window_s=10
+    )
+    s.tick()
+    # merged fleet traffic: healthy on average (fast burn 9 < 14.4),
+    # while worker 2 is 90% timeouts — the average hides it
+    reg.inc("queries", 1000)
+    reg.inc("queries.timeout", 9)
+    s.tick()
+    with _slo_props():
+        ev = slo.SloEngine(s).evaluate()
+    row = next(r for r in ev["slos"] if r["name"] == "query-availability")
+    assert row["fast"]["burn_rate"] < 14.4  # the merged gate stays quiet
+    assert row["violating_workers"] == ["2"]
+    assert row["workers"]["2"]["violating"]
+    assert not row["workers"]["0"]["violating"]
+    assert row["violating"]  # a sick worker degrades the spec row
+    assert "query-availability@worker2" in ev["violating"]
+
+
 def test_worst_exemplars_link_traces():
     reg = MetricsRegistry()
     audit.set_exemplars(True)
@@ -744,7 +792,7 @@ def test_merge_worker_ticks_sums_counters_and_timer_histograms():
 def test_merge_worker_ticks_empty_and_malformed_rows():
     assert timeline.merge_worker_ticks({}) == {
         "workers": 0, "counters": {}, "timers": {},
-        "breakers": {}, "unreachable": [],
+        "breakers": {}, "unreachable": [], "per_worker": {},
     }
     # a malformed row (transport returned junk) counts as unreachable,
     # never a KeyError in the sampler tick
