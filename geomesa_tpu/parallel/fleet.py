@@ -431,6 +431,17 @@ class _WorkerState:
         # diffs its registries between calls)
         self._tl_sampler = None
         self._tl_lock = threading.Lock()
+        # durable telemetry spool (utils/history.py): this worker's
+        # ticks, breaker transitions, and decision tallies persist
+        # under <root>/_telemetry so a kill -9 leaves evidence the
+        # postmortem replays; None when geomesa.history.enabled=0.
+        # Opening the spool also detects an unclean previous shutdown
+        # (a dead pid's live marker) before the first scan is served
+        from geomesa_tpu.utils import history as _history
+
+        self._history = _history.open_spool(
+            root, owner=f"worker{worker_id}"
+        )
         # reopen every partition already on disk NOW: each FsDataStore
         # open runs the PR 5 intent-journal recovery + scrub, so a
         # restarted worker repairs whatever the kill left behind BEFORE
@@ -763,6 +774,11 @@ class _WorkerState:
             sampler = self._tl_sampler
             regs = sampler.registries
             snap = sampler.tick() or {}
+        # durable telemetry: the coordinator's per-tick pull IS this
+        # worker's tick cadence, so the spool rides it — outside the
+        # sampler lock, write-behind, budget-bounded in flush()
+        if self._history is not None and snap:
+            self._history.on_tick(snap)
         exemplars: Dict[str, Dict[str, List[Any]]] = {}
         class_timers = {meta["timer"] for meta in slo.CLASSES.values()}
         for reg in regs:
@@ -854,6 +870,33 @@ class _WorkerState:
             "worker": self.worker_id,
             "pid": os.getpid(),
             "sections": sections,
+        }, []
+
+    def op_history(self, head, payloads):
+        """The durable-spool seam (utils/history.py): this worker's
+        spooled records for a requested window — flushed first so the
+        reply covers up to the current tick, capped by ``max`` so one
+        RPC reply stays bounded no matter how much history is on disk
+        (the caller reads under the passive budget; a truncated reply
+        says so and the postmortem reads the disk directly instead)."""
+        from geomesa_tpu.utils import history as _history
+
+        if self._history is not None:
+            self._history.flush()
+        s = head.get("s")
+        until = head.get("until")
+        limit = int(head.get("max", 2000))
+        records, truncated = _history.read_records(
+            self.root,
+            s=None if s is None else float(s),
+            until=None if until is None else float(until),
+            limit=limit,
+        )
+        return {
+            "ok": 1,
+            "worker": self.worker_id,
+            "records": records,
+            "truncated": bool(truncated),
         }, []
 
     def op_drain(self, head, payloads):
@@ -1471,6 +1514,29 @@ class WorkerClient:
         try:
             with deadline.budget(_passive_budget_s()):
                 resp, _ = self._rpc("debug")
+        except Exception as e:  # noqa: BLE001 - passive plane isolates
+            return {"unreachable": True, "error": f"{type(e).__name__}: {e}"}
+        resp.pop("ok", None)
+        resp.pop("frames", None)
+        return resp
+
+    def history(self, s: Optional[float] = None,
+                until: Optional[float] = None,
+                max_records: int = 2000) -> Dict[str, Any]:
+        """The worker's durable telemetry spool (op ``history``): a
+        windowed slice of its on-disk records for /debug/history's
+        merged fleet view. Same passive contract as ``timeline`` —
+        budget-bounded, any failure becomes this worker's unreachable
+        entry (the postmortem script then reads the worker's spool from
+        disk, which needs no process at all)."""
+        head: Dict[str, Any] = {"max": int(max_records)}
+        if s is not None:
+            head["s"] = float(s)
+        if until is not None:
+            head["until"] = float(until)
+        try:
+            with deadline.budget(_passive_budget_s()):
+                resp, _ = self._rpc("history", head)
         except Exception as e:  # noqa: BLE001 - passive plane isolates
             return {"unreachable": True, "error": f"{type(e).__name__}: {e}"}
         resp.pop("ok", None)

@@ -91,6 +91,10 @@ class FsDataStore(TpuDataStore):
         if block_format not in ("npz", "parquet"):
             raise ValueError(f"unknown block format: {block_format!r}")
         self._root = root
+        # public: the durable-store contract every telemetry persistence
+        # layer keys on (utils/history.spool_for, the fleet tier) — a
+        # store with a `root` can host a `<root>/_telemetry` spool
+        self.root = os.path.abspath(root)
         self._lazy = lazy
         self._format = block_format
         if isinstance(partition_scheme, str):

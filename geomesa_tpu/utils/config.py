@@ -330,6 +330,26 @@ SLOW_QUERY_MAX_PER_MIN = SystemProperty("geomesa.query.slow.max.per.min", "60")
 TIMELINE_ENABLED = SystemProperty("geomesa.timeline.enabled", "true")
 TIMELINE_INTERVAL = SystemProperty("geomesa.timeline.interval", "1 second")
 TIMELINE_WINDOW = SystemProperty("geomesa.timeline.window", "1 hour")
+# Durable telemetry spool (utils/history.py): per-tick timeline
+# snapshots, SLO violations, breaker transitions, decision tallies, and
+# periodic per-fingerprint top-K land write-behind in append-only
+# segment files under `<root>/_telemetry/` — the flight recorder that
+# survives the process. Segments rotate at `history.bytes` (sealed with
+# the store/integrity.py CRC footer; explicit 0 disables size rotation)
+# and age out after `history.ttl` (explicit 0 disables the retention
+# sweep). `enabled=0` opens no spool, creates no directory, and adds
+# zero work anywhere — the sampler hook is one attribute read.
+HISTORY_ENABLED = SystemProperty("geomesa.history.enabled", "true")
+HISTORY_BYTES = SystemProperty("geomesa.history.bytes", "1MB")
+HISTORY_TTL = SystemProperty("geomesa.history.ttl", "24 hours")
+# Perf-regression sentry (utils/history.py): per-fingerprint EWMA
+# latency baselines over the spool's per-tick plan deltas; a sustained
+# log2 shift >= `sentry.threshold` covering at least `sentry.min.events`
+# query events raises a reason-coded decision("sentry", "regressed"),
+# degrades /healthz naming the fingerprint, and clears on recovery.
+# Explicit threshold 0 disables the sentry.
+SENTRY_THRESHOLD = SystemProperty("geomesa.sentry.threshold", "1.0")
+SENTRY_MIN_EVENTS = SystemProperty("geomesa.sentry.min.events", "32")
 # SLO engine (utils/slo.py): declarative latency/availability objectives
 # per query class (query, join, aggregate, stream first-batch) with
 # multi-window burn rates (fast / slow) computed over the timeline ring.

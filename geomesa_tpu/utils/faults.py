@@ -64,6 +64,13 @@ disk, or device boundary:
                        ``crash`` at any position replays the remaining
                        participants at takeover/restart, never leaving
                        half the workers mutated
+    history.append     one write-behind flush of the durable telemetry
+                       spool (utils/history.py): the sampler-tick
+                       thread appending queued records to the active
+                       ``_telemetry`` segment — an ``error``/``drop``
+                       here must re-queue (never lose silently, never
+                       block a query), overflow past the bounded queue
+                       counts ``history.dropped``
 
 Kinds:
 
@@ -146,6 +153,7 @@ FAULT_POINTS = (
     "fleet.rebalance",
     "fleet.lease",
     "fleet.fanout",
+    "history.append",
 )
 
 KINDS = ("error", "drop", "latency", "torn", "crash")

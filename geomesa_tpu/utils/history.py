@@ -1,0 +1,753 @@
+"""Durable telemetry: the crash-surviving flight recorder.
+
+Everything PRs 2-15 record — the timeline ring, SLO burn state, breaker
+transitions, reason-coded decision tallies, the plan-fingerprint
+registry — is in-memory and dies with the process, which is precisely
+the moment it matters. This module is the persistence layer under all
+of it: a per-process **spool** of append-only JSONL segment files in
+``<root>/_telemetry/``, fed write-behind from the TimelineSampler tick,
+plus the three consumers that spend the history:
+
+* **the spool** (``HistorySpool``) — per tick it records the timeline
+  snapshot, breaker *transitions* (diffed against the previous tick),
+  per-tick ``decision.*`` tallies, SLO violations with their exemplar
+  trace ids, and (periodically) the per-fingerprint top-K with
+  misestimate histograms. Records queue in a BOUNDED list and flush on
+  the sampler-tick thread — never a query thread — under a small
+  budget, span-wrapped and fault-injectable (``history.append``).
+  Overflow past the queue bound drops oldest-first and counts
+  ``history.dropped``: backpressure degrades the recording, never the
+  serving. Segments rotate at ``geomesa.history.bytes`` (sealed with
+  the store/integrity.py CRC footer) and age out after
+  ``geomesa.history.ttl``; a corrupt segment quarantines-and-skips via
+  the same discipline every store file uses — adjacent segments keep
+  their ticks. ``geomesa.history.enabled=0`` opens no spool, creates no
+  directory, and leaves the sampler hook a single attribute read.
+
+* **the crash black box** — opening a spool writes a ``live-<pid>``
+  marker; a clean close (atexit or explicit) dumps the trace ring, the
+  slow-query tail, and breaker/admission snapshots to
+  ``_telemetry/blackbox-<pid>.json``, seals the active segment, and
+  removes the marker. A marker whose pid is dead at the NEXT open is an
+  unclean shutdown: counted ``history.unclean_start``, recorded in the
+  spool, surfaced on ``GET /debug/recovery``. A ``kill -9`` leaves the
+  marker (the detection) and the unsealed segment (the evidence) — the
+  reader passes footer-less segments through unverified and skips torn
+  trailing lines, so the pre-kill window replays.
+
+* **fleet postmortems** — every fleet worker spools locally; the
+  budget-bounded ``op_history`` RPC (parallel/fleet.py, the PR 15
+  passive-observation posture) ships windowed records to
+  ``GET /debug/history?s=&until=``, and ``scripts/postmortem.py``
+  reconstructs the merged fleet timeline for ANY past window purely
+  from disk — including from a PR 16 standby after takeover.
+
+* **the perf-regression sentry** (``PerfSentry``) — per-fingerprint
+  EWMA latency baselines over the per-tick plan deltas; a sustained
+  log2 shift >= ``geomesa.sentry.threshold`` covering at least
+  ``geomesa.sentry.min.events`` query events raises a reason-coded
+  ``decision("sentry", "regressed")``, degrades /healthz naming the
+  fingerprint, lands in the incident report, and clears with
+  ``decision("sentry", "recovered")`` once latency returns under
+  threshold. The first consumer that spends telemetry on a decision
+  instead of a dashboard.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import math
+import os
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from geomesa_tpu.utils import deadline
+from geomesa_tpu.utils.audit import robustness_metrics
+
+_log = logging.getLogger("geomesa_tpu.history")
+
+TELEMETRY_DIR = "_telemetry"
+SEGMENT_PREFIX = "seg-"
+MARKER_PREFIX = "live-"
+BLACKBOX_PREFIX = "blackbox-"
+
+# write-behind queue bound: a wedged disk degrades the RECORDING
+# (drops, counted), never the sampler thread's memory or a query
+PENDING_CAP = 256
+# per-flush budget: the sampler tick pays at most this for durability
+# (an injected latency fault clamps to it via deadline.remaining)
+FLUSH_BUDGET_S = 0.5
+# per-fingerprint top-K cadence: the full rows (misestimate histograms,
+# receipts) are heavy relative to a tick, so they spool periodically
+PLANS_EVERY_TICKS = 30
+# EWMA smoothing for the sentry's per-fingerprint latency baseline
+SENTRY_ALPHA = 0.2
+
+
+def history_knobs() -> Tuple[bool, Optional[int], Optional[float]]:
+    """(enabled, segment_bytes, ttl_s) from the geomesa.history.* tier.
+
+    PR 6 knob rule: explicit zeros are honored — ``history.bytes=0``
+    disables size rotation (one growing active segment),
+    ``history.ttl=0`` disables the retention sweep. ``None`` (returned
+    as the value itself) never happens: unset falls to the defaults."""
+    from geomesa_tpu.utils.config import (
+        HISTORY_BYTES,
+        HISTORY_ENABLED,
+        HISTORY_TTL,
+    )
+
+    enabled = bool(HISTORY_ENABLED.to_bool())
+    b = HISTORY_BYTES.to_bytes()
+    seg_bytes = (1 << 20) if b is None else int(b)
+    t = HISTORY_TTL.to_duration_s()
+    ttl_s = 24 * 3600.0 if t is None else float(t)
+    return enabled, seg_bytes, ttl_s
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # alive, owned by someone else
+    except OSError:
+        return False
+    return True
+
+
+# -- the perf-regression sentry ----------------------------------------------
+
+
+class PerfSentry:
+    """Per-fingerprint EWMA latency baselines over the spool's per-tick
+    plan deltas (``utils/plans.timeline_deltas`` rows: one
+    ``{fingerprint, calls, ms}`` row per hot fingerprint per tick).
+
+    While a fingerprint is healthy its baseline tracks the EWMA of its
+    per-call latency; a tick whose per-call latency sits
+    ``log2(cur/baseline) >= geomesa.sentry.threshold`` accumulates its
+    CALLS toward ``geomesa.sentry.min.events`` (event-weighted: one
+    slow tick of 100 queries is worth 100 events, one slow stray query
+    is worth 1 — a quiet store must not page anyone). Crossing the
+    floor flips the fingerprint to REGRESSED: a reason-coded
+    ``decision("sentry", "regressed")`` (counter + span event + plan
+    tally at once) and an entry in ``regressed`` that /healthz and the
+    incident report name. The baseline deliberately FREEZES while over
+    threshold — an EWMA that keeps averaging would absorb the
+    regression it is supposed to flag. One healthy tick clears the
+    fingerprint with ``decision("sentry", "recovered")``."""
+
+    def __init__(self):
+        from geomesa_tpu.utils.config import (
+            SENTRY_MIN_EVENTS,
+            SENTRY_THRESHOLD,
+        )
+
+        th = SENTRY_THRESHOLD.to_float()
+        self.threshold = 1.0 if th is None else float(th)
+        me = SENTRY_MIN_EVENTS.to_int()
+        self.min_events = 32 if me is None else int(me)
+        self._baseline: Dict[str, float] = {}  # fid -> EWMA ms/call
+        self._hot: Dict[str, int] = {}  # fid -> events over threshold
+        self.regressed: Dict[str, Dict[str, Any]] = {}
+
+    def observe(
+        self, prows: List[Dict[str, Any]], t: float
+    ) -> List[Dict[str, Any]]:
+        """Feed one tick's plan-delta rows; returns sentry records to
+        spool (state changes only — a steady regression is one record
+        when it trips and one when it clears, not one per tick)."""
+        if self.threshold <= 0:  # explicit 0 disables (knob rule)
+            return []
+        from geomesa_tpu.utils import audit
+
+        events: List[Dict[str, Any]] = []
+        for row in prows or ():
+            fid = row.get("fingerprint")
+            calls = int(row.get("calls") or 0)
+            ms = float(row.get("ms") or 0.0)
+            if not fid or calls <= 0:
+                continue
+            cur = ms / calls
+            base = self._baseline.get(fid)
+            if base is None:
+                self._baseline[fid] = cur  # first sight primes, no verdict
+                continue
+            shift = math.log2(max(cur, 1e-6) / max(base, 1e-6))
+            if shift >= self.threshold:
+                hot = self._hot.get(fid, 0) + calls
+                self._hot[fid] = hot
+                if fid not in self.regressed and hot >= self.min_events:
+                    info = {
+                        "shift_log2": round(shift, 3),
+                        "baseline_ms": round(base, 3),
+                        "latency_ms": round(cur, 3),
+                        "events": hot,
+                        "since": t,
+                    }
+                    self.regressed[fid] = info
+                    audit.decision(
+                        "sentry",
+                        "regressed",
+                        fingerprint=fid,
+                        shift_log2=info["shift_log2"],
+                        baseline_ms=info["baseline_ms"],
+                        latency_ms=info["latency_ms"],
+                    )
+                    events.append(
+                        {"kind": "sentry", "t": t, "state": "regressed",
+                         "fingerprint": fid, **info}
+                    )
+            else:
+                self._baseline[fid] = (
+                    (1.0 - SENTRY_ALPHA) * base + SENTRY_ALPHA * cur
+                )
+                self._hot.pop(fid, None)
+                if self.regressed.pop(fid, None) is not None:
+                    audit.decision(
+                        "sentry", "recovered", fingerprint=fid,
+                        latency_ms=round(cur, 3),
+                    )
+                    events.append(
+                        {"kind": "sentry", "t": t, "state": "recovered",
+                         "fingerprint": fid, "latency_ms": round(cur, 3)}
+                    )
+        return events
+
+
+# -- the spool ----------------------------------------------------------------
+
+
+class HistorySpool:
+    """One process's durable telemetry spool under ``<root>/_telemetry``.
+
+    ``append()`` only queues (bounded, never blocks, never raises);
+    ``flush()`` — called from the sampler-tick thread, structurally
+    never a query thread — writes the queue to the active segment under
+    the ``history.append`` span/fault-point/deadline discipline. A
+    failed flush re-queues (bounded by the same cap), so a transient
+    disk fault loses nothing and a dead disk degrades to counted
+    drops."""
+
+    def __init__(self, root: str, owner: str = ""):
+        self.root = os.path.abspath(root)
+        self.dir = os.path.join(self.root, TELEMETRY_DIR)
+        self.owner = owner or f"pid{os.getpid()}"
+        _enabled, self.seg_bytes, self.ttl_s = history_knobs()
+        self._lock = threading.Lock()
+        self._pending: List[Dict[str, Any]] = []
+        self._active: Optional[str] = None
+        self._active_size = 0
+        self._prev_breakers: Dict[str, str] = {}
+        self._ticks = 0
+        self._closed = False
+        self._last_written: Optional[str] = None
+        self.sentry = PerfSentry()
+        self.unclean: List[Dict[str, Any]] = []
+        os.makedirs(self.dir, exist_ok=True)
+        self._scan_unclean()
+        self._marker = os.path.join(
+            self.dir, f"{MARKER_PREFIX}{os.getpid()}"
+        )
+        with open(self._marker, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps(
+                {"pid": os.getpid(), "owner": self.owner, "t": time.time()}
+            ))
+        atexit.register(self._atexit)
+
+    # -- unclean-start detection / black box ---------------------------------
+
+    def _scan_unclean(self) -> None:
+        """A ``live-<pid>`` marker whose pid is dead means that process
+        never closed its spool: an unclean shutdown (kill -9, OOM, power
+        loss). Counted, recorded, and the stale marker consumed so one
+        crash reports once — the unsealed segment it left behind stays,
+        that is the evidence the postmortem replays."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for name in sorted(names):
+            if not name.startswith(MARKER_PREFIX):
+                continue
+            try:
+                pid = int(name[len(MARKER_PREFIX):])
+            except ValueError:
+                continue
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            path = os.path.join(self.dir, name)
+            info: Dict[str, Any] = {"pid": pid}
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    info.update(json.loads(fh.read()))
+            except (OSError, ValueError):
+                pass
+            info["blackbox"] = os.path.exists(
+                os.path.join(self.dir, f"{BLACKBOX_PREFIX}{pid}.json")
+            )
+            robustness_metrics().inc("history.unclean_start")
+            self.unclean.append(info)
+            self.append({
+                "kind": "unclean_start", "t": time.time(),
+                "owner": self.owner, "dead": info,
+            })
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _blackbox_payload(self) -> Dict[str, Any]:
+        from geomesa_tpu.utils import trace as _trace
+        from geomesa_tpu.utils.audit import slow_query_tail
+        from geomesa_tpu.utils.breaker import peek_states
+
+        out: Dict[str, Any] = {
+            "t": time.time(),
+            "pid": os.getpid(),
+            "owner": self.owner,
+            "breakers": peek_states(),
+            "slow_queries": slow_query_tail(50),
+        }
+        try:
+            out["traces"] = [
+                sp.to_dict() for sp in _trace.blackbox_traces(20)
+            ]
+        except Exception as e:  # noqa: BLE001 - a bad span must not lose the box
+            out["traces"] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def close(self, blackbox: bool = True) -> None:
+        """Clean shutdown: drain the queue, dump the black box, seal the
+        active segment (CRC footer — replay verifies it), remove the
+        live marker. Idempotent; also the atexit path."""
+        from geomesa_tpu.store import integrity
+
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            batch, self._pending = self._pending, []
+            active = self._active
+            self._active = None
+        try:
+            if batch:
+                self._write(batch)
+                active = active or self._last_written
+        except OSError:
+            robustness_metrics().inc("history.append.errors")
+        if blackbox:
+            try:
+                integrity.durable_write(
+                    os.path.join(
+                        self.dir, f"{BLACKBOX_PREFIX}{os.getpid()}.json"
+                    ),
+                    json.dumps(
+                        self._blackbox_payload(), default=str
+                    ).encode("utf-8"),
+                )
+            except Exception:  # noqa: BLE001 - shutdown path must not raise
+                _log.exception("blackbox dump failed")
+        try:
+            if active and os.path.exists(active):
+                integrity.append_crc_footer(active)
+            integrity.fsync_dir(self.dir)
+        except OSError:
+            pass
+        try:
+            os.remove(self._marker)
+        except OSError:
+            pass
+
+    def _atexit(self) -> None:
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    # -- write-behind ---------------------------------------------------------
+
+    def append(self, record: Dict[str, Any]) -> None:
+        """Queue one record (bounded; DROPS past the cap, counted
+        ``history.dropped``). Safe from any thread; never blocks on
+        I/O, never raises — this is the only call a non-tick thread
+        ever makes into the spool."""
+        with self._lock:
+            if self._closed or len(self._pending) >= PENDING_CAP:
+                if not self._closed:
+                    robustness_metrics().inc("history.dropped")
+                return
+            self._pending.append(record)
+
+    def flush(self) -> int:
+        """Drain the queue to the active segment: span-wrapped,
+        fault-injectable, and budget-bounded — a wedged disk costs the
+        sampler tick at most ``FLUSH_BUDGET_S`` and the batch re-queues
+        (bounded) for the next tick. Returns records written."""
+        from geomesa_tpu.utils import faults, trace
+
+        with self._lock:
+            if self._closed or not self._pending:
+                return 0
+            batch, self._pending = self._pending, []
+        try:
+            with trace.span("history.append") as sp:
+                with deadline.budget(FLUSH_BUDGET_S):
+                    deadline.check("history.append")
+                    faults.fault_point("history.append")
+                    n = self._write(batch)
+                sp.set_attr("records", n)
+            return n
+        except Exception as e:  # noqa: BLE001 - recording degrades, never raises
+            robustness_metrics().inc("history.append.errors")
+            _log.debug("history flush failed, re-queueing: %s", e)
+            with self._lock:
+                merged = batch + self._pending
+                dropped = len(merged) - PENDING_CAP
+                if dropped > 0:
+                    # oldest-first drop: the tail is closest to "now",
+                    # which is what a postmortem wants most
+                    merged = merged[dropped:]
+                    robustness_metrics().inc("history.dropped", dropped)
+                self._pending = merged
+            return 0
+
+    def _write(self, batch: List[Dict[str, Any]]) -> int:
+        """Append the batch to the active segment; rotate + sweep when
+        the size bound trips. Single-writer by construction (only the
+        tick thread and close() call this, close() after _closed)."""
+        if self._active is None:
+            self._active = os.path.join(
+                self.dir,
+                f"{SEGMENT_PREFIX}{int(time.time() * 1000)}"
+                f"-{os.getpid()}.jsonl",
+            )
+            self._active_size = 0
+        data = b"".join(
+            json.dumps(rec, default=str).encode("utf-8") + b"\n"
+            for rec in batch
+        )
+        with open(self._active, "ab") as fh:
+            fh.write(data)
+        self._active_size += len(data)
+        self._last_written = self._active
+        if self.seg_bytes and self._active_size >= self.seg_bytes:
+            self._rotate()
+        return len(batch)
+
+    def _rotate(self) -> None:
+        """Seal the active segment (CRC footer: the reader VERIFIES
+        sealed segments; a torn or bit-flipped one quarantines) and
+        sweep expired ones. The next flush opens a fresh segment."""
+        from geomesa_tpu.store import integrity
+
+        sealed, self._active = self._active, None
+        self._active_size = 0
+        try:
+            integrity.append_crc_footer(sealed)
+            integrity.fsync_dir(self.dir)
+        except OSError:
+            robustness_metrics().inc("history.append.errors")
+        robustness_metrics().inc("history.segments.sealed")
+        self._sweep()
+
+    def _sweep(self) -> None:
+        """Age out sealed segments past ``history.ttl`` (explicit 0
+        disables). mtime-based: a segment's mtime is its LAST write, so
+        a segment only expires once everything in it is stale."""
+        if not self.ttl_s:
+            return
+        cutoff = time.time() - self.ttl_s
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith(SEGMENT_PREFIX)
+                    and name.endswith(".jsonl")):
+                continue
+            path = os.path.join(self.dir, name)
+            if path == self._active:
+                continue
+            try:
+                if os.stat(path).st_mtime < cutoff:
+                    os.remove(path)
+                    robustness_metrics().inc("history.segments.expired")
+            except OSError:
+                continue
+
+    # -- the per-tick feed ----------------------------------------------------
+
+    def on_tick(self, snap: Dict[str, Any], store: Any = None) -> None:
+        """The write-behind feed, called from the TimelineSampler tick
+        (coordinator) or the ``op_timeline`` on-demand tick (fleet
+        worker) AFTER the in-memory ring append — the ring is the
+        source of truth, the spool is its shadow. Builds this tick's
+        durable records, runs the sentry, flushes."""
+        if self._closed or not snap:
+            return
+        t = float(snap.get("t") or time.time())
+        self._ticks += 1
+        self.append({"kind": "tick", "t": t, "owner": self.owner,
+                     "tick": snap})
+        # breaker TRANSITIONS, not states: the tick record already has
+        # the full state map, this one answers "when did it flip"
+        cur = dict(snap.get("breakers") or {})
+        changed = {
+            name: [self._prev_breakers.get(name, "closed"), state]
+            for name, state in cur.items()
+            if self._prev_breakers.get(name, "closed") != state
+        }
+        if changed:
+            self.append({"kind": "breaker", "t": t, "changed": changed})
+        self._prev_breakers = cur
+        # reason-coded decision tallies: the tick counters are already
+        # per-tick deltas, so the decision.* slice IS this tick's tally
+        tallies = {
+            k: v for k, v in (snap.get("counters") or {}).items()
+            if k.startswith("decision.")
+        }
+        if tallies:
+            self.append({"kind": "decision", "t": t, "tallies": tallies})
+        if store is not None:
+            self._record_slo(t, store)
+            if self._ticks % PLANS_EVERY_TICKS == 1:
+                self._record_plans(t, store)
+        for ev in self.sentry.observe(snap.get("plans") or [], t):
+            self.append(ev)
+        self.flush()
+
+    def _record_slo(self, t: float, store: Any) -> None:
+        """SLO violations with exemplar trace ids — only while
+        violating (a healthy tick spools nothing), and only against an
+        engine that ALREADY exists (the sampler must never be what
+        creates telemetry state — the engine_for create=False rule)."""
+        try:
+            from geomesa_tpu.utils import slo as _slo
+
+            eng = _slo.engine_for(store, create=False)
+            if eng is None:
+                return
+            rec = _slo.violation_record(eng)
+            if rec:
+                self.append({"kind": "slo", "t": t, **rec})
+        except Exception:  # noqa: BLE001 - recording must not kill the tick
+            _log.debug("slo history record failed", exc_info=True)
+
+    def _record_plans(self, t: float, store: Any) -> None:
+        """Periodic per-fingerprint top-K with misestimate histograms —
+        the recorded statistics the adaptive-selection thesis needs to
+        outlive the process that recorded them."""
+        try:
+            preg = getattr(store, "_plans", None)
+            if preg is None:
+                return
+            from geomesa_tpu.utils import plans as _plans
+
+            rows = _plans.history_rows(preg, n=10)
+            if rows:
+                self.append({"kind": "plans", "t": t, "rows": rows})
+        except Exception:  # noqa: BLE001 - recording must not kill the tick
+            _log.debug("plans history record failed", exc_info=True)
+
+    # -- introspection --------------------------------------------------------
+
+    def segments(self) -> List[str]:
+        try:
+            return sorted(
+                n for n in os.listdir(self.dir)
+                if n.startswith(SEGMENT_PREFIX) and n.endswith(".jsonl")
+            )
+        except OSError:
+            return []
+
+    def info(self) -> Dict[str, Any]:
+        """The /debug/recovery ``history`` block."""
+        counters, _g, _t, _tt = robustness_metrics().snapshot()
+        return {
+            "dir": self.dir,
+            "owner": self.owner,
+            "segments": len(self.segments()),
+            "pending": len(self._pending),
+            "unclean_starts": list(self.unclean),
+            "dropped": counters.get("history.dropped", 0),
+            "regressed": dict(self.sentry.regressed),
+        }
+
+    def read(
+        self,
+        s: Optional[float] = None,
+        until: Optional[float] = None,
+        limit: Optional[int] = None,
+    ) -> Tuple[List[Dict[str, Any]], bool]:
+        self.flush()
+        return read_records(self.root, s=s, until=until, limit=limit)
+
+
+# -- the reader (works with no live spool: postmortems read dead roots) -------
+
+
+def read_records(
+    root: str,
+    s: Optional[float] = None,
+    until: Optional[float] = None,
+    limit: Optional[int] = None,
+) -> Tuple[List[Dict[str, Any]], bool]:
+    """Every spool record under ``<root>/_telemetry`` with
+    ``s <= t <= until`` (both optional), oldest first; returns
+    ``(records, truncated)``. Disk-only — a SIGKILLed or long-dead
+    process's spool reads the same as a live one.
+
+    The integrity discipline (store/integrity.py): sealed segments CRC-
+    verify — a corrupt one is quarantined and SKIPPED (counted
+    ``history.segments.corrupt``), adjacent segments keep their ticks.
+    Footer-less segments (the active one, or one a kill -9 orphaned)
+    pass through unverified; a torn trailing line skips per-line
+    (counted ``history.torn``) and every parseable line before it
+    survives."""
+    from geomesa_tpu.store import integrity
+
+    d = os.path.join(root, TELEMETRY_DIR)
+    out: List[Dict[str, Any]] = []
+    truncated = False
+    if not os.path.isdir(d):
+        return out, truncated
+    cap = None if limit is None else max(0, int(limit))
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith(SEGMENT_PREFIX) and name.endswith(".jsonl")):
+            continue
+        path = os.path.join(d, name)
+        try:
+            data = integrity.read_verified(path)
+        except integrity.CorruptFileError:
+            robustness_metrics().inc("history.segments.corrupt")
+            integrity.quarantine(path)
+            continue
+        except OSError:
+            continue
+        for line in data.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                robustness_metrics().inc("history.torn")
+                continue
+            if not isinstance(rec, dict):
+                robustness_metrics().inc("history.torn")
+                continue
+            t = rec.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            if s is not None and t < float(s):
+                continue
+            if until is not None and t > float(until):
+                continue
+            if cap is not None and len(out) >= cap:
+                truncated = True
+                break
+            out.append(rec)
+        if truncated:
+            break
+    out.sort(key=lambda r: r.get("t", 0.0))
+    return out, truncated
+
+
+def blackboxes(root: str) -> List[Dict[str, Any]]:
+    """Every ``blackbox-<pid>.json`` under the root's spool, parsed."""
+    d = os.path.join(root, TELEMETRY_DIR)
+    out: List[Dict[str, Any]] = []
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith(BLACKBOX_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(d, name), "r", encoding="utf-8") as fh:
+                box = json.loads(fh.read())
+        except (OSError, ValueError):
+            continue
+        if isinstance(box, dict):
+            box["file"] = name
+            out.append(box)
+    return out
+
+
+def stale_markers(root: str) -> List[int]:
+    """Pids of dead processes whose live markers were never consumed —
+    the disk-only unclean-shutdown signal a postmortem reads without a
+    process having restarted yet."""
+    d = os.path.join(root, TELEMETRY_DIR)
+    out: List[int] = []
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not name.startswith(MARKER_PREFIX):
+            continue
+        try:
+            pid = int(name[len(MARKER_PREFIX):])
+        except ValueError:
+            continue
+        if not _pid_alive(pid):
+            out.append(pid)
+    return out
+
+
+# -- per-store spools (the sampler_for arrangement) ---------------------------
+
+_SPOOLS: "weakref.WeakKeyDictionary[Any, HistorySpool]" = (
+    weakref.WeakKeyDictionary()
+)
+_SPOOLS_LOCK = threading.Lock()
+
+
+def open_spool(root: str, owner: str = "") -> Optional[HistorySpool]:
+    """A spool at an explicit root (fleet workers), or None when
+    ``geomesa.history.enabled=0`` / the directory cannot be created —
+    disabled history must cost nothing and break nothing."""
+    enabled, _b, _t = history_knobs()
+    if not enabled or not root:
+        return None
+    try:
+        return HistorySpool(root, owner=owner)
+    except OSError:
+        _log.warning("history spool unavailable at %s", root, exc_info=True)
+        return None
+
+
+def spool_for(store: Any, create: bool = True) -> Optional[HistorySpool]:
+    """The store's spool, keyed weakly like timeline.sampler_for; only
+    stores with a durable ``root`` (fleet coordinators, workers, fs
+    stores that grow one) can spool — everything else answers None and
+    the sampler hook stays a no-op attribute read."""
+    root = getattr(store, "root", None)
+    if not isinstance(root, str) or not root:
+        return None
+    with _SPOOLS_LOCK:
+        got = _SPOOLS.get(store)
+        if got is not None or not create:
+            return got
+        sp = open_spool(root, owner=type(store).__name__)
+        if sp is not None:
+            _SPOOLS[store] = sp
+        return sp
+
+
+def sentry_regressions(store: Any) -> Dict[str, Dict[str, Any]]:
+    """The /healthz hook: currently-regressed fingerprints, by
+    fingerprint. create=False — a health probe must never be what opens
+    the spool (the engine_for posture)."""
+    sp = spool_for(store, create=False)
+    return {} if sp is None else dict(sp.sentry.regressed)
+
+
+def recovery_info(store: Any) -> Optional[Dict[str, Any]]:
+    """The /debug/recovery ``history`` block, or None when no spool."""
+    sp = spool_for(store, create=False)
+    return None if sp is None else sp.info()

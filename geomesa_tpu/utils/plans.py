@@ -557,6 +557,33 @@ def timeline_deltas(
     return now, rows[: max(0, int(n))]
 
 
+def history_rows(
+    registry: Optional[PlanRegistry], n: int = 10
+) -> List[Dict[str, Any]]:
+    """The durable-spool edition of the top-K (utils/history.py
+    ``plans`` records): per-fingerprint cumulative calls/latency,
+    scan path, and the estimate-vs-actual misestimate histogram — the
+    recorded statistics the ROADMAP's auto-tuning arc needs to outlive
+    the process. A slice of ``rows()``, not the whole row: receipts and
+    exemplar pointers stay in memory, the spool keeps what a future
+    planner correction would actually consume."""
+    if registry is None:
+        return []
+    out = []
+    for r in registry.rows(sort="time", n=n):
+        out.append({
+            "fingerprint": r["fingerprint"],
+            "type": r.get("type"),
+            "scan_path": r.get("scan_path"),
+            "calls": r.get("calls"),
+            "total_ms": r.get("total_ms"),
+            "rows_scanned": r.get("rows_scanned"),
+            "estimate": r.get("estimate"),
+            "misestimate": r.get("misestimate"),
+        })
+    return out
+
+
 def merge_rows(row_lists: List[List[Dict[str, Any]]]) -> List[Dict[str, Any]]:
     """Merge fingerprint rows from several registries (the sharded
     rollup): numeric aggregates sum by fingerprint id and every mean

@@ -410,6 +410,32 @@ _ENGINES: "weakref.WeakKeyDictionary[Any, SloEngine]" = (
 _ENGINES_LOCK = threading.Lock()
 
 
+def violation_record(engine: SloEngine) -> Optional[Dict[str, Any]]:
+    """The durable-spool edition of one SLO evaluation (utils/
+    history.py): ``{"violating": [...], "exemplars": {slo: [trace
+    ids]}}`` while any class is violating, None while healthy — a
+    healthy tick must spool nothing. The exemplar TRACE IDS persist
+    (the trees themselves live in the bounded debug ring / black box):
+    a postmortem joins them back against whatever ring or blackbox dump
+    survived the crash."""
+    ev = engine.evaluate(exemplars=True)
+    violating = ev.get("violating") or []
+    if not violating:
+        return None
+    exemplars: Dict[str, List[str]] = {}
+    for row in ev.get("slos", ()):
+        if not row.get("violating"):
+            continue
+        ids = [
+            ex.get("trace_id")
+            for ex in row.get("exemplars", ())
+            if ex.get("trace_id")
+        ]
+        if ids:
+            exemplars[row["name"]] = ids
+    return {"violating": violating, "exemplars": exemplars}
+
+
 def engine_for(store, create: bool = True) -> Optional[SloEngine]:
     """The store's SLO engine over its timeline sampler (None when the
     engine or the timeline is disabled — /healthz then skips the slo

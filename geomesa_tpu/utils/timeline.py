@@ -119,6 +119,10 @@ class TimelineSampler:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # the durable telemetry spool (utils/history.py), attached by
+        # sampler_for when geomesa.history.enabled and the store has a
+        # durable root; None keeps the hook a single attribute read
+        self._history: Optional[Any] = None
 
     # -- sampling ------------------------------------------------------------
 
@@ -142,10 +146,21 @@ class TimelineSampler:
         raises — a telemetry failure must not kill the recorder loop —
         and only ever READS the layers it observes."""
         try:
-            return self._tick()
+            snap = self._tick()
         except Exception:  # noqa: BLE001 - recorder must outlive bad gauges
             _log.exception("timeline tick failed; recording continues")
             return None
+        # write-behind durability (utils/history.py): feed the spool
+        # AFTER the ring append and OUTSIDE the sampler lock — a wedged
+        # flush (bounded by its own budget) must never block window()
+        # readers, and the ring stays the source of truth
+        hist = self._history
+        if hist is not None:
+            try:
+                hist.on_tick(snap, self._store())
+            except Exception:  # noqa: BLE001 - spool failures never stop ticks
+                _log.exception("history spool tick failed; recording continues")
+        return snap
 
     def _tick(self) -> Dict[str, Any]:
         from geomesa_tpu.utils.breaker import peek_states
@@ -278,6 +293,13 @@ class TimelineSampler:
         ref = weakref.ref(self)
 
         def loop():
+            # tick-cost compensation: waiting the FULL interval after
+            # tick work makes every cycle last interval + tick_cost, so
+            # timestamps drift and an hour's ring covers less than an
+            # hour. Subtract the previous tick's cost from the wait
+            # (floored at 0: a tick slower than the interval ticks
+            # again immediately, it cannot wait a negative time).
+            elapsed = 0.0
             while True:
                 me = ref()
                 if me is None:
@@ -290,12 +312,14 @@ class TimelineSampler:
                 del me  # the loop must not pin the sampler between ticks
                 if store_dead:
                     return  # telemetry dies with (never outlives) its store
-                if stop.wait(interval):
+                if stop.wait(max(0.0, interval - elapsed)):
                     return
                 me = ref()
                 if me is None:
                     return
+                t0 = time.monotonic()
                 me.tick()
+                elapsed = time.monotonic() - t0
                 del me
 
         t = threading.Thread(
@@ -420,6 +444,13 @@ def sampler_for(store, create: bool = True) -> Optional[TimelineSampler]:
         if not enabled:
             return None
         sampler = TimelineSampler(store)
+        # durable telemetry (utils/history.py): stores with a durable
+        # root get their ticks spooled write-behind; spool_for answers
+        # None (and the tick hook stays one attribute read) when
+        # geomesa.history.enabled=0 or the store is memory-only
+        from geomesa_tpu.utils import history as _history
+
+        sampler._history = _history.spool_for(store)
         _SAMPLERS[store] = sampler
         _REFS[store] = 0
         if _exemplars_wanted():

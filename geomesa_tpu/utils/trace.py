@@ -496,6 +496,15 @@ def find_trace(trace_id: str) -> Optional[Span]:
     return None
 
 
+def blackbox_traces(n: int = 20) -> List[Span]:
+    """The crash black box's trace dump (utils/history.py): the last
+    ``n`` retained trace trees, [] when no ring is installed. Identical
+    to ``recent_traces`` today, but named for its shutdown-path caller —
+    the dump must stay a pure read that can run during interpreter
+    teardown (no ring installation, no lock beyond the snapshot)."""
+    return recent_traces(n)
+
+
 def recent_traces(n: int = 20) -> List[Span]:
     """Last ``n`` trace trees for /debug/traces: the debug ring when one
     is installed (query-filtered — an application's own unfiltered ring

@@ -77,6 +77,15 @@ SURVEY.md section 2.5). Endpoints over a datastore:
                                     membership states, per-worker pids/
                                     restarts/breakers, placement moves,
                                     per-worker telemetry over the wire
+    GET /debug/history?s=&until= -- durable telemetry spool
+                                    (utils/history.py): replay any past
+                                    window from the on-disk segments —
+                                    ticks, breaker transitions, SLO
+                                    violations, decision tallies, sentry
+                                    verdicts — merged across fleet
+                                    workers via the passive op_history
+                                    RPC; answers for windows before this
+                                    process existed
     GET /debug/report?s=300      -- one-shot incident report: every
                                     debug surface + slow-query log tail +
                                     resolved exemplar traces + config
@@ -179,7 +188,8 @@ def debug_recovery_payload(store):
             k: v
             for k, v in sorted(counters.items())
             if k.startswith(
-                ("recovery.", "journal.", "quarantine.", "fleet.fanout.")
+                ("recovery.", "journal.", "quarantine.", "fleet.fanout.",
+                 "history.")
             )
         },
     }
@@ -198,6 +208,14 @@ def debug_recovery_payload(store):
             }
             for rec in fj.pending_fanouts()
         ]
+    # durable telemetry spool (utils/history.py): segment/queue state,
+    # and — the crash-recovery headline — whether the LAST shutdown was
+    # unclean (a dead pid's live marker found at this open)
+    from geomesa_tpu.utils import history as _history
+
+    hist = _history.recovery_info(store)
+    if hist is not None:
+        out["history"] = hist
     return out
 
 
@@ -237,6 +255,57 @@ def debug_fleet_payload(store):
     return out
 
 
+def debug_history_payload(store, s: float = DEFAULT_TIMELINE_S,
+                          until=None):
+    """``GET /debug/history?s=&until=``: the durable telemetry spool
+    (utils/history.py) replayed for ANY past window — per-tick timeline
+    snapshots, breaker transitions, SLO violations with exemplar trace
+    ids, decision tallies, sentry verdicts — merged with every fleet
+    worker's spool over the budget-bounded ``op_history`` RPC. Unlike
+    /debug/timeline (the in-memory ring: this process, since it
+    started) the spool answers for windows BEFORE this process existed
+    — a standby that just took over serves the dead coordinator's last
+    minutes from the same root."""
+    import time as _time
+
+    from geomesa_tpu.utils import history as _history
+
+    root = getattr(store, "root", None)
+    enabled = _history.history_knobs()[0]
+    if not isinstance(root, str) or not root or not enabled:
+        return {"enabled": False, "records": []}
+    u = _time.time() if until is None else float(until)
+    lo = u - float(s)
+    sp = _history.spool_for(store, create=False)
+    if sp is not None:
+        sp.flush()  # the window must cover up to the current tick
+    records, truncated = _history.read_records(
+        root, s=lo, until=u, limit=5000
+    )
+    out = {
+        "enabled": True,
+        "s": float(s),
+        "until": u,
+        "records": records,
+        "truncated": truncated,
+        "sentry": _history.sentry_regressions(store),
+        "unclean": _history.stale_markers(root),
+    }
+    # fleet coordinators: each worker's spooled window over the passive
+    # op_history RPC — unreachable workers report themselves (their
+    # on-disk spool still answers to scripts/postmortem.py)
+    ws = getattr(store, "workers", None)
+    if isinstance(ws, (list, tuple)) and hasattr(store, "fleet_health"):
+        workers = {}
+        for i, w in enumerate(ws):
+            h = getattr(w, "history", None)
+            if callable(h):
+                workers[str(i)] = h(lo, u)
+        if workers:
+            out["workers"] = workers
+    return out
+
+
 def debug_plans_payload(store, n: int = 20, sort: str = "time"):
     from geomesa_tpu.utils import plans as _plans
 
@@ -266,6 +335,7 @@ REPORT_SECTIONS = {
     "slo": lambda store, s: debug_slo_payload(store),
     "plans": lambda store, s: debug_plans_payload(store, 10),
     "fleet": lambda store, s: debug_fleet_payload(store),
+    "history": lambda store, s: debug_history_payload(store, s),
 }
 
 
@@ -865,6 +935,19 @@ def make_handler(store):
                         body["slo"] = {"violating": violating}
                         if violating:
                             body["status"] = "degraded"
+                    # perf-regression sentry (utils/history.py): while
+                    # any plan fingerprint's latency sits a sustained
+                    # log2 shift past its EWMA baseline, /healthz
+                    # degrades NAMING the fingerprint — a balancer (and
+                    # the on-call) sees the regression before any SLO
+                    # window burns, and recovery clears it. create=False
+                    # posture: the probe reads an existing spool only
+                    from geomesa_tpu.utils import history as _history
+
+                    regressed = _history.sentry_regressions(store)
+                    if regressed:
+                        body["sentry"] = {"regressed": regressed}
+                        body["status"] = "degraded"
                     self._send(200, json.dumps(body))
                 elif route == "/debug/traces":
                     # validate ?n= rather than bubbling a 500: non-numeric
@@ -937,6 +1020,34 @@ def make_handler(store):
                         200,
                         json.dumps(
                             debug_timeline_payload(store, s), default=str
+                        ),
+                    )
+                elif route == "/debug/history":
+                    # the durable telemetry spool (utils/history.py):
+                    # replay ANY past window from disk, merged across
+                    # the fleet — ?s= window seconds ending at ?until=
+                    # (unix seconds, default now). Param contract
+                    # mirrors /debug/timeline: caller errors answer 400
+                    s = self._window_param(params, DEFAULT_TIMELINE_S)
+                    if s is None:
+                        return
+                    until = None
+                    if "until" in params:
+                        try:
+                            until = float(params["until"])
+                        except ValueError:
+                            self._send(
+                                400,
+                                json.dumps(
+                                    {"error": "until must be a number"}
+                                ),
+                            )
+                            return
+                    self._send(
+                        200,
+                        json.dumps(
+                            debug_history_payload(store, s, until),
+                            default=str,
                         ),
                     )
                 elif route == "/debug/slo":

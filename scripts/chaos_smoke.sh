@@ -84,6 +84,18 @@
 #     standby adopt the orphaned workers, replay the pending intent,
 #     and answer the post-op result set with every partition primary-
 #     owned and zero divergent workers
+#   - durable telemetry survives both kills (tests/test_fleet.py, both
+#     SIGKILL legs): after the REAL worker SIGKILL the victim's spool
+#     (<root>/workers/w<i>/_telemetry) is readable — pre-kill ticks
+#     replay from disk, the restarted worker records the unclean start
+#     (stale live-marker detection), and the budget-bounded op_history
+#     RPC serves the window through the coordinator; after the REAL
+#     coordinator SIGKILL, scripts/postmortem.py reconstructs the
+#     merged fleet timeline covering the kill instant from disk alone —
+#     pre-kill per-worker ticks, breaker states, AND the orphaned
+#     fan-out intent still owing its replay — and after takeover the
+#     standby's postmortem over the same root shows the intent replayed
+#     with the adopted workers still spooling
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail

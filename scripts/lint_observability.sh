@@ -39,6 +39,7 @@ declare -A SPANS=(
     ["fleet.rebalance"]="geomesa_tpu/parallel/fleet.py"
     ["fleet.lease"]="geomesa_tpu/parallel/fleet.py"
     ["fleet.fanout"]="geomesa_tpu/parallel/fleet.py"
+    ["history.append"]="geomesa_tpu/utils/history.py"
 )
 for point in "${!SPANS[@]}"; do
     file="${SPANS[$point]}"
@@ -141,7 +142,7 @@ done
 #    debug plane must keep every per-worker section the incident report
 #    promises.
 FLEET=geomesa_tpu/parallel/fleet.py
-for op in op_telemetry op_timeline op_debug op_plans; do
+for op in op_telemetry op_timeline op_debug op_plans op_history; do
     if ! grep -qE "def ${op}\(" "$FLEET"; then
         echo "FAIL: ${FLEET} lost its worker-side ${op}() handler"
         echo "      (the fleet debug plane serves it — see _WorkerState)"
@@ -157,9 +158,18 @@ for fn in telemetry timeline debug; do
         fail=1
     fi
 done
-if [ "$(grep -c 'deadline.budget(_passive_budget_s())' "$FLEET")" -lt 5 ]; then
+# history(self, s=..., until=...) takes args, so it needs its own sed
+# pattern (the loop above matches the literal zero-arg signatures)
+hist_body=$(sed -n "/    def history(self/,/    def /p" "$FLEET")
+if ! printf '%s\n' "$hist_body" | grep -q '_passive_budget_s()'; then
+    echo "FAIL: WorkerClient.history() in ${FLEET} is not passive-budget-"
+    echo "      paired — a postmortem spool pull against a wedged worker"
+    echo "      must cost at most the debug budget"
+    fail=1
+fi
+if [ "$(grep -c 'deadline.budget(_passive_budget_s())' "$FLEET")" -lt 6 ]; then
     echo "FAIL: ${FLEET} lost passive-budget pairing on its observation"
-    echo "      RPCs (telemetry/timeline/debug + the _PlansProxy reads)"
+    echo "      RPCs (telemetry/timeline/debug/history + the _PlansProxy reads)"
     fail=1
 fi
 for reason in over_budget trailer_failed decode_failed worker_lost; do

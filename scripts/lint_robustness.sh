@@ -128,6 +128,22 @@ for point in batch.coalesce; do
     fi
 done
 
+# the durable-telemetry flush seam is pinned too: the write-behind
+# spool append (utils/history.py) must stay injectable so chaos runs
+# can prove a full telemetry-disk failure NEVER blocks or fails a
+# query (the flush is span-wrapped, budget-bounded, and drops count
+# history.dropped instead of raising)
+for point in history.append; do
+    if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/utils/history.py; then
+        echo "FAIL: geomesa_tpu/utils/history.py lost the '${point}' fault point"
+        echo "      (the durable-telemetry contract: a spool flush failure is"
+        echo "       absorbed — counted as history.dropped — never surfaced"
+        echo "       to the query path; faults.fault_point(\"${point}\")"
+        echo "       beside a deadline check; see utils/faults.py)"
+        fail=1
+    fi
+done
+
 # multi-file mutation sites in the store tier must declare a
 # write-ahead intent before touching files (crash-consistency contract)
 while IFS= read -r f; do

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Reconstruct the merged fleet timeline for a past window — from disk.
+
+    python scripts/postmortem.py <root> [--window 300] [--until <unix>]
+    [--s <unix>] [--json]
+
+Everything here reads the durable telemetry spools (utils/history.py)
+and the fleet intent journal with NO live server and NO live worker: the
+coordinator's ``<root>/_telemetry``, every worker's
+``<root>/workers/w*/_telemetry``, the black-box dumps, the stale live
+markers a kill -9 left behind, and the ``_fleet`` journal's pending
+fan-out intents. That makes it the "what was the fleet doing when the
+old coordinator died" answer a PR 16 standby (same root, after
+takeover) or an operator on a corpse can always get:
+
+* per-worker counter totals over the window, rolled up fleet-wide with
+  the same ``timeline.merge_worker_ticks`` fold the live watch uses;
+* each process's LAST breaker states at (or before) the window's end;
+* the last SLO burn record (violating classes + exemplar trace ids);
+* sentry verdicts (perf regressions that tripped or cleared);
+* unclean-shutdown evidence: stale live markers, black boxes, and
+  ``unclean_start`` records;
+* cross-worker fan-out intents still owing a roll-forward replay.
+
+Exit code 0 with a human summary (or ``--json`` for the full artifact).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from geomesa_tpu.utils import history  # noqa: E402
+
+
+def _fold(records):
+    """Summarize one process's spool records over the window: counter/
+    timer totals across ticks, final breaker states, last SLO burn,
+    sentry + breaker-transition + unclean-start event lists."""
+    out = {
+        "ticks": 0,
+        "first_t": None,
+        "last_t": None,
+        "counters": {},
+        "timers": {},
+        "breakers": {},
+        "last_slo": None,
+        "sentry": [],
+        "transitions": [],
+        "unclean_starts": [],
+        "decisions": {},
+    }
+    counters = out["counters"]
+    timers = out["timers"]
+    for rec in records:
+        kind = rec.get("kind")
+        t = rec.get("t")
+        if kind == "tick":
+            tick = rec.get("tick") or {}
+            out["ticks"] += 1
+            out["first_t"] = t if out["first_t"] is None else out["first_t"]
+            out["last_t"] = t
+            for k, v in (tick.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + int(v)
+            for name, tb in (tick.get("timers") or {}).items():
+                acc = timers.setdefault(
+                    name, {"count": 0, "sum_ms": 0.0, "hist": {}}
+                )
+                acc["count"] += int(tb.get("count", 0))
+                acc["sum_ms"] = round(
+                    acc["sum_ms"] + float(tb.get("sum_ms", 0.0)), 3
+                )
+                for b, n in (tb.get("hist") or {}).items():
+                    acc["hist"][str(b)] = acc["hist"].get(str(b), 0) + int(n)
+            out["breakers"] = dict(tick.get("breakers") or out["breakers"])
+        elif kind == "slo":
+            out["last_slo"] = {
+                "t": t,
+                "violating": rec.get("violating"),
+                "exemplars": rec.get("exemplars"),
+            }
+        elif kind == "sentry":
+            out["sentry"].append(rec)
+        elif kind == "breaker":
+            out["transitions"].append(rec)
+        elif kind == "unclean_start":
+            out["unclean_starts"].append(rec)
+        elif kind == "decision":
+            for k, v in (rec.get("tallies") or {}).items():
+                out["decisions"][k] = out["decisions"].get(k, 0) + int(v)
+    return out
+
+
+def _worker_roots(root):
+    base = os.path.join(root, "workers")
+    out = {}
+    if not os.path.isdir(base):
+        return out
+    for name in sorted(os.listdir(base)):
+        p = os.path.join(base, name)
+        if name.startswith("w") and name[1:].isdigit() and os.path.isdir(p):
+            out[name[1:]] = p
+    return out
+
+
+def _pending_fanouts(root):
+    """Cross-worker fan-out intents still owing a roll-forward replay,
+    read straight off the ``_fleet`` journal (the takeover replays
+    these; a postmortem lists what the dead coordinator left owing)."""
+    fleet_dir = os.path.join(root, "_fleet")
+    if not os.path.isdir(fleet_dir):
+        return []
+    try:
+        from geomesa_tpu.store.journal import IntentJournal
+
+        return [
+            {
+                "op": r.get("kind"),
+                "name": r.get("name"),
+                "participants": len(r.get("participants") or ()),
+                "done": len(r.get("done") or ()),
+                "ts": r.get("ts"),
+            }
+            for r in IntentJournal(fleet_dir).pending_fanouts()
+        ]
+    except Exception as e:  # noqa: BLE001 - a broken journal is itself a finding
+        return [{"error": f"{type(e).__name__}: {e}"}]
+
+
+def reconstruct(root, s=None, until=None):
+    """The full postmortem artifact for ``[s, until]`` (unix seconds;
+    ``until`` defaults to now, ``s`` to 300 s before it). Callable from
+    tests and chaos soaks — pure disk reads, no server."""
+    from geomesa_tpu.utils.timeline import merge_worker_ticks
+
+    root = os.path.abspath(root)
+    u = time.time() if until is None else float(until)
+    lo = (u - 300.0) if s is None else float(s)
+    crecs, _ = history.read_records(root, s=lo, until=u)
+    out = {
+        "root": root,
+        "window": {"s": lo, "until": u},
+        "coordinator": _fold(crecs),
+        "workers": {},
+        "pending_fanouts": _pending_fanouts(root),
+        "blackboxes": [
+            {
+                "file": b.get("file"),
+                "pid": b.get("pid"),
+                "owner": b.get("owner"),
+                "t": b.get("t"),
+                "breakers": b.get("breakers"),
+                "slow_queries": len(b.get("slow_queries") or ()),
+                "traces": len(b.get("traces") or ()),
+            }
+            for b in history.blackboxes(root)
+        ],
+        "stale_markers": history.stale_markers(root),
+    }
+    per_worker_ticks = {}
+    for wid, wroot in _worker_roots(root).items():
+        wrecs, _ = history.read_records(wroot, s=lo, until=u)
+        fold = _fold(wrecs)
+        fold["blackboxes"] = [
+            b.get("file") for b in history.blackboxes(wroot)
+        ]
+        fold["stale_markers"] = history.stale_markers(wroot)
+        out["workers"][wid] = fold
+        # one synthetic "tick" per worker (the window's fold) feeds the
+        # SAME rollup the live coordinator computes per second — the
+        # merged fleet timeline, from disk
+        per_worker_ticks[wid] = {
+            "tick": {
+                "counters": fold["counters"],
+                "timers": fold["timers"],
+                "breakers": fold["breakers"],
+            }
+        }
+    if per_worker_ticks:
+        out["rollup"] = merge_worker_ticks(per_worker_ticks)
+    return out
+
+
+def _fmt_t(t):
+    if not isinstance(t, (int, float)):
+        return "-"
+    return time.strftime("%H:%M:%S", time.localtime(t)) + f".{int(t * 1000) % 1000:03d}"
+
+
+def _print_summary(pm):
+    w = pm["window"]
+    print(f"postmortem {pm['root']}")
+    print(f"  window {_fmt_t(w['s'])} .. {_fmt_t(w['until'])}")
+    for label, fold in [("coordinator", pm["coordinator"])] + [
+        (f"worker {wid}", f) for wid, f in sorted(pm["workers"].items())
+    ]:
+        print(
+            f"  {label}: {fold['ticks']} ticks"
+            f" [{_fmt_t(fold['first_t'])} .. {_fmt_t(fold['last_t'])}]"
+            f" q={fold['counters'].get('queries', 0)}"
+        )
+        open_b = sorted(
+            n for n, st in fold["breakers"].items() if st != "closed"
+        )
+        if open_b:
+            print(f"    breakers open: {', '.join(open_b)}")
+        for tr in fold["transitions"]:
+            for name, (old, new) in sorted(tr.get("changed", {}).items()):
+                print(f"    {_fmt_t(tr['t'])} breaker {name}: {old} -> {new}")
+        if fold["last_slo"]:
+            slo = fold["last_slo"]
+            print(
+                f"    last SLO burn {_fmt_t(slo['t'])}:"
+                f" {', '.join(slo.get('violating') or [])}"
+            )
+        for ev in fold["sentry"]:
+            print(
+                f"    {_fmt_t(ev['t'])} sentry {ev.get('state')}:"
+                f" {ev.get('fingerprint')}"
+                + (
+                    f" (shift {ev.get('shift_log2')} log2)"
+                    if ev.get("state") == "regressed" else ""
+                )
+            )
+        for un in fold["unclean_starts"]:
+            print(
+                f"    {_fmt_t(un['t'])} UNCLEAN START:"
+                f" dead pid {un.get('dead', {}).get('pid')}"
+            )
+        if fold.get("stale_markers"):
+            print(f"    stale live markers (dead, never restarted):"
+                  f" {fold['stale_markers']}")
+    if pm.get("stale_markers"):
+        print(f"  coordinator stale markers: {pm['stale_markers']}")
+    if pm["pending_fanouts"]:
+        print("  pending fan-outs (owed a roll-forward replay):")
+        for f in pm["pending_fanouts"]:
+            print(f"    {f}")
+    if pm["blackboxes"]:
+        print("  black boxes:")
+        for b in pm["blackboxes"]:
+            print(
+                f"    {b['file']}: pid {b['pid']} at {_fmt_t(b.get('t'))},"
+                f" {b['slow_queries']} slow queries, {b['traces']} traces"
+            )
+    roll = pm.get("rollup")
+    if roll:
+        print(
+            f"  fleet rollup: workers={roll.get('workers', 0)}"
+            f" q={roll.get('counters', {}).get('queries', 0)}"
+            + (
+                f" unreachable={roll['unreachable']}"
+                if roll.get("unreachable") else ""
+            )
+        )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merged fleet timeline for a past window, from disk"
+    )
+    ap.add_argument("root", help="fleet root (the coordinator's root dir)")
+    ap.add_argument("--window", type=float, default=300.0,
+                    help="window length in seconds (default 300)")
+    ap.add_argument("--until", type=float, default=None,
+                    help="window end, unix seconds (default: now)")
+    ap.add_argument("--s", type=float, default=None,
+                    help="window start, unix seconds (overrides --window)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full JSON artifact instead of a summary")
+    args = ap.parse_args(argv)
+    until = args.until if args.until is not None else time.time()
+    s = args.s if args.s is not None else until - args.window
+    pm = reconstruct(args.root, s=s, until=until)
+    if args.json:
+        json.dump(pm, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        _print_summary(pm)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

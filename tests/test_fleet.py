@@ -46,7 +46,7 @@ from geomesa_tpu.parallel.fleet import (
 from geomesa_tpu.schema.featuretype import parse_spec
 from geomesa_tpu.store.datastore import TpuDataStore
 from geomesa_tpu.stream.netlog import envelope_budget, request_envelope
-from geomesa_tpu.utils import deadline, faults
+from geomesa_tpu.utils import deadline, faults, history
 from geomesa_tpu.utils.audit import (
     QueryTimeout,
     ShardUnavailable,
@@ -556,6 +556,21 @@ def fleet(tmp_path_factory):
             st.close()
 
 
+def _postmortem():
+    """scripts/postmortem.py, loaded by path (scripts/ is not a
+    package) — the disk-only fleet-timeline reconstructor the kill
+    tests assert against."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(repo, "scripts", "postmortem.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _await(cond, timeout_s=30.0, tick=0.1):
     t0 = time.monotonic()
     while time.monotonic() - t0 < timeout_s:
@@ -675,9 +690,14 @@ def test_sigkill_mid_query_stream_parity_or_crisp_then_full_recovery(
     ]
     for t in threads:
         t.start()
+    t0 = time.time()
     try:
         time.sleep(0.3)  # queries in flight
         victim = fleet.placement.primary(fleet._all_partitions()[0])
+        # a couple of on-demand ticks spool the victim's PRE-KILL
+        # telemetry (the same feed the coordinator sampler drives)
+        for _ in range(2):
+            fleet.workers[victim].timeline()
         os.kill(fleet.supervisor.worker_pid(victim), signal.SIGKILL)
         time.sleep(2.0)  # keep streaming through death + restart
     finally:
@@ -711,6 +731,25 @@ def test_sigkill_mid_query_stream_parity_or_crisp_then_full_recovery(
         )
     for q, want in baseline.items():
         assert sorted(fleet.query("t", q).fids) == want
+    # durable telemetry: the kill -9 could not erase the victim's
+    # spool. Its pre-kill ticks replay straight from disk, the
+    # restarted worker recorded the unclean start (stale live marker),
+    # and the op_history RPC serves both through the coordinator.
+    wroot = os.path.join(fleet.root, "workers", f"w{victim}")
+    recs, _ = history.read_records(wroot, s=t0 - 1, until=time.time())
+    assert any(r["kind"] == "tick" for r in recs)
+    resp = fleet.workers[victim].history(s=t0 - 1)
+    assert not resp.get("unreachable"), resp
+    kinds = {r["kind"] for r in resp["records"]}
+    assert "tick" in kinds and "unclean_start" in kinds
+    # and scripts/postmortem.py reconstructs the merged fleet timeline
+    # covering the kill instant — per-worker counters, breaker states,
+    # the rollup — pure disk reads, no RPC
+    pm = _postmortem().reconstruct(fleet.root, s=t0 - 1, until=time.time())
+    fold = pm["workers"][str(victim)]
+    assert fold["ticks"] >= 2
+    assert fold["unclean_starts"], "restart must flag the kill"
+    assert "breakers" in fold and pm["rollup"]["workers"] >= 1
 
 
 def test_coordinator_restart_recovers_routing_from_worker_inventories(
@@ -1476,6 +1515,10 @@ with st.writer("t") as w:
             fid=f"f{i:05d}",
         )
 print("READY", flush=True)
+# spool pre-kill worker telemetry (the on-demand tick IS the durable
+# feed): the postmortem below must replay the window before the kill
+for w in st.workers:
+    w.timeline()
 # stall INSIDE the fan-out (after the intent + first participant), so a
 # kill -9 lands mid-mutation with the roll-forward obligation on disk
 rule = faults.FaultRule(
@@ -1536,6 +1579,14 @@ def test_sigkill_coordinator_mid_fanout_standby_rolls_forward(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+    # BEFORE anyone takes over: scripts/postmortem.py reconstructs the
+    # dead coordinator's last window purely from disk — the pre-kill
+    # per-worker ticks AND the fan-out intent still owing its replay
+    t_kill = time.time()
+    pm = _postmortem().reconstruct(root, s=t_kill - 120, until=t_kill + 1)
+    assert pm["pending_fanouts"], "postmortem lost the orphaned fan-out"
+    assert any(f["ticks"] > 0 for f in pm["workers"].values()), \
+        "postmortem lost the pre-kill worker ticks"
     all_fids = [f"f{i:05d}" for i in range(40)]
     want_post = sorted(set(all_fids) - set(all_fids[::4]))
     b = FleetDataStore(
@@ -1551,5 +1602,13 @@ def test_sigkill_coordinator_mid_fanout_standby_rolls_forward(tmp_path):
         fh = b.fleet_health()
         assert fh["down"] == [] and fh["unowned_partitions"] == []
         assert fh["lease"]["held_by_me"]
+        # the standby's postmortem over the SAME root: the replayed
+        # fan-out no longer pends, and the adopted workers keep
+        # spooling into the merged fleet rollup
+        for w in b.workers:
+            w.timeline()
+        pm2 = _postmortem().reconstruct(root, s=t_kill - 120)
+        assert pm2["pending_fanouts"] == []
+        assert pm2["rollup"]["workers"] == 2
     finally:
         b.close()

@@ -1,0 +1,418 @@
+"""Durable telemetry (PR 17): the crash-surviving flight recorder
+(utils/history.py), its segment rotation/retention/integrity
+discipline, the write-behind never-blocks contract, the kill -9 replay,
+and the perf-regression sentry.
+
+Pins the PR 17 contract:
+
+* knobs follow the PR 6 rule — explicit ``history.bytes=0`` disables
+  size rotation, explicit ``history.ttl=0`` disables the retention
+  sweep, and ``history.enabled=0`` opens no spool, creates no
+  directory, and costs the sampler a single attribute read;
+* the spool wears the store-tier integrity discipline — sealed segments
+  carry the CRC footer and VERIFY on read; a corrupt one quarantines
+  and is skipped WITHOUT losing adjacent segments' ticks; a torn
+  trailing line (the kill -9 signature) skips per-line;
+* a SIGKILLed process's spool replays its pre-kill window from disk
+  alone, its stale live marker names the dead pid, and the next open at
+  the same root counts/records the unclean start;
+* backpressure degrades the RECORDING (bounded queue, counted drops),
+  never the caller;
+* the sentry trips on a sustained per-fingerprint latency shift —
+  reason-coded decision, /healthz degrades NAMING the fingerprint —
+  and recovers when latency returns.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from geomesa_tpu.store import integrity
+from geomesa_tpu.store.fs import FsDataStore
+from geomesa_tpu.utils import history, timeline
+from geomesa_tpu.utils.audit import robustness_metrics
+from geomesa_tpu.utils.config import properties
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _postmortem():
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(REPO, "scripts", "postmortem.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tick(i, **counters):
+    return {"t": time.time(), "counters": dict(counters),
+            "breakers": {}, "n": i}
+
+
+def _segments(root):
+    d = os.path.join(root, history.TELEMETRY_DIR)
+    return sorted(
+        n for n in os.listdir(d)
+        if n.startswith(history.SEGMENT_PREFIX) and n.endswith(".jsonl")
+    )
+
+
+# -- rotation / retention knobs (PR 6 rule: explicit zeros honored) -----------
+
+
+def test_rotation_seals_segments_with_crc_and_replays_all(tmp_path):
+    m = robustness_metrics()
+    sealed0 = m.counter("history.segments.sealed")
+    with properties(geomesa_history_bytes="200"):
+        sp = history.HistorySpool(str(tmp_path), owner="t")
+        for i in range(12):
+            sp.append({"kind": "tick", "t": time.time(), "n": i})
+        sp.flush()
+        for i in range(12, 24):
+            sp.append({"kind": "tick", "t": time.time(), "n": i})
+        sp.flush()
+        segs = _segments(str(tmp_path))
+        assert len(segs) >= 2  # 200-byte bound really rotated
+        assert m.counter("history.segments.sealed") > sealed0
+        # sealed segments verify: read_verified strips a valid footer
+        sealed = [s for s in segs
+                  if os.path.join(sp.dir, s) != sp._active]
+        data = integrity.read_verified(os.path.join(sp.dir, sealed[0]))
+        assert data.endswith(b"\n")
+        # nothing lost across the rotation boundary
+        recs, truncated = history.read_records(str(tmp_path))
+        assert not truncated
+        assert [r["n"] for r in recs if r["kind"] == "tick"] == list(range(24))
+        sp.close(blackbox=False)
+
+
+def test_explicit_zero_bytes_disables_rotation(tmp_path):
+    with properties(geomesa_history_bytes="0"):
+        sp = history.HistorySpool(str(tmp_path), owner="t")
+        assert sp.seg_bytes == 0
+        for i in range(50):
+            sp.append({"kind": "tick", "t": time.time(), "n": i})
+            sp.flush()
+        assert len(_segments(str(tmp_path))) == 1  # one growing segment
+        sp.close(blackbox=False)
+
+
+def test_retention_sweeps_expired_segments(tmp_path):
+    m = robustness_metrics()
+    expired0 = m.counter("history.segments.expired")
+    with properties(geomesa_history_bytes="120", geomesa_history_ttl="1 hour"):
+        sp = history.HistorySpool(str(tmp_path), owner="t")
+        sp.append({"kind": "tick", "t": time.time(), "pad": "x" * 150})
+        sp.flush()  # > 120 B: seals segment 1
+        old = _segments(str(tmp_path))
+        assert len(old) == 1
+        stale = os.path.join(sp.dir, old[0])
+        past = time.time() - 2 * 3600
+        os.utime(stale, (past, past))
+        sp.append({"kind": "tick", "t": time.time(), "pad": "y" * 150})
+        sp.flush()  # rotation 2 runs the sweep
+        assert not os.path.exists(stale)
+        assert m.counter("history.segments.expired") > expired0
+        sp.close(blackbox=False)
+
+
+def test_explicit_zero_ttl_disables_sweep(tmp_path):
+    with properties(geomesa_history_bytes="120", geomesa_history_ttl="0"):
+        sp = history.HistorySpool(str(tmp_path), owner="t")
+        assert sp.ttl_s == 0
+        sp.append({"kind": "tick", "t": time.time(), "pad": "x" * 150})
+        sp.flush()
+        stale = os.path.join(sp.dir, _segments(str(tmp_path))[0])
+        past = time.time() - 10 * 24 * 3600
+        os.utime(stale, (past, past))
+        sp.append({"kind": "tick", "t": time.time(), "pad": "y" * 150})
+        sp.flush()
+        assert os.path.exists(stale)  # ttl=0: nothing ever ages out
+        sp.close(blackbox=False)
+
+
+def test_disabled_history_opens_no_spool_and_creates_nothing(tmp_path):
+    with properties(geomesa_history_enabled="false"):
+        assert history.open_spool(str(tmp_path), owner="t") is None
+        store = FsDataStore(str(tmp_path / "root"))
+        sampler = timeline.sampler_for(store)
+        assert sampler._history is None  # the hook stays one attr read
+        sampler.tick()
+        assert not os.path.isdir(
+            os.path.join(store.root, history.TELEMETRY_DIR)
+        )
+        from geomesa_tpu import web
+
+        body = web.debug_history_payload(store)
+        assert body == {"enabled": False, "records": []}
+
+
+# -- integrity: corrupt segments quarantine, torn lines skip ------------------
+
+
+def test_corrupt_sealed_segment_quarantines_and_keeps_neighbors(tmp_path):
+    m = robustness_metrics()
+    corrupt0 = m.counter("history.segments.corrupt")
+    with properties(geomesa_history_bytes="150"):
+        sp = history.HistorySpool(str(tmp_path), owner="t")
+        for i in range(4):
+            sp.append({"kind": "tick", "t": time.time(), "n": i})
+        sp.flush()  # ~200 B: seals segment 1
+        for i in range(4, 8):
+            sp.append({"kind": "tick", "t": time.time(), "n": i})
+        sp.flush()
+        segs = _segments(str(tmp_path))
+        assert len(segs) >= 2
+        victim = os.path.join(sp.dir, segs[0])
+        blob = bytearray(open(victim, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # bit-flip mid-file, footer intact
+        with open(victim, "wb") as fh:
+            fh.write(bytes(blob))
+        recs, _ = history.read_records(str(tmp_path))
+        got = [r["n"] for r in recs if r.get("kind") == "tick"]
+        # segment 1's ticks are gone WITH the corruption, segment 2's
+        # survive untouched — quarantine-and-skip, not fail-the-read
+        assert got == [4, 5, 6, 7]
+        assert m.counter("history.segments.corrupt") > corrupt0
+        assert not os.path.exists(victim)
+        assert any(
+            n.startswith(segs[0]) and n.endswith(".quarantine")
+            for n in os.listdir(sp.dir)
+        )
+        sp.close(blackbox=False)
+
+
+def test_torn_trailing_line_skips_without_losing_good_lines(tmp_path):
+    m = robustness_metrics()
+    torn0 = m.counter("history.torn")
+    with properties(geomesa_history_bytes="0"):
+        sp = history.HistorySpool(str(tmp_path), owner="t")
+        for i in range(3):
+            sp.append({"kind": "tick", "t": time.time(), "n": i})
+        sp.flush()
+        # the kill -9 signature: a partial JSON line at the tail of a
+        # footer-less (never-sealed) segment
+        with open(sp._active, "ab") as fh:
+            fh.write(b'{"kind": "tick", "t": 17')
+        recs, _ = history.read_records(str(tmp_path))
+        assert [r["n"] for r in recs if r.get("kind") == "tick"] == [0, 1, 2]
+        assert m.counter("history.torn") > torn0
+        sp.close(blackbox=False)
+
+
+# -- the write-behind contract ------------------------------------------------
+
+
+def test_backpressure_drops_oldest_and_counts(tmp_path):
+    m = robustness_metrics()
+    d0 = m.counter("history.dropped")
+    sp = history.HistorySpool(str(tmp_path), owner="t")
+    for i in range(history.PENDING_CAP + 7):
+        sp.append({"kind": "tick", "t": time.time(), "n": i})
+    assert m.counter("history.dropped") - d0 == 7
+    assert len(sp._pending) == history.PENDING_CAP
+    sp.close(blackbox=False)
+
+
+def test_flush_failure_requeues_and_degrades_to_drops(tmp_path):
+    from geomesa_tpu.utils import faults
+
+    m = robustness_metrics()
+    e0 = m.counter("history.append.errors")
+    sp = history.HistorySpool(str(tmp_path), owner="t")
+    sp.append({"kind": "tick", "t": time.time(), "n": 0})
+    with faults.inject(rules=[
+        faults.FaultRule("history.append", "error", prob=1.0)
+    ]):
+        assert sp.flush() == 0  # absorbed, never raised
+    assert m.counter("history.append.errors") > e0
+    assert len(sp._pending) == 1  # transient fault loses nothing
+    assert sp.flush() == 1  # next healthy tick drains it
+    recs, _ = history.read_records(str(tmp_path))
+    assert [r["n"] for r in recs] == [0]
+    sp.close(blackbox=False)
+
+
+# -- kill -9: the black box and the replay ------------------------------------
+
+_VICTIM = """
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+from geomesa_tpu.utils import history
+sp = history.HistorySpool(sys.argv[1], owner="victim")
+for i in range(5):
+    sp.on_tick({{"t": time.time(), "counters": {{"queries": 2}},
+                "breakers": {{"device": "open" if i >= 3 else "closed"}}}})
+print("SPOOLED", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+def test_sigkill_spool_replays_prekill_window_and_flags_unclean(tmp_path):
+    p = subprocess.run(
+        [sys.executable, "-c", _VICTIM.format(repo=REPO), str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "SPOOLED" in p.stdout
+    assert p.returncode == -signal.SIGKILL  # really died by SIGKILL
+    # the pre-kill window replays from disk alone: 5 ticks plus the
+    # breaker transition record the closed->open flip produced
+    recs, _ = history.read_records(str(tmp_path))
+    ticks = [r for r in recs if r["kind"] == "tick"]
+    assert len(ticks) == 5
+    assert sum(r["tick"]["counters"]["queries"] for r in ticks) == 10
+    flips = [r for r in recs if r["kind"] == "breaker"]
+    assert flips and flips[0]["changed"]["device"] == ["closed", "open"]
+    # no clean close: the live marker is stale (dead pid), no black box
+    assert history.stale_markers(str(tmp_path)) != []
+    assert history.blackboxes(str(tmp_path)) == []
+    # postmortem.reconstruct covers the kill instant, pure disk reads
+    pm = _postmortem().reconstruct(
+        str(tmp_path), s=ticks[0]["t"] - 1, until=ticks[-1]["t"] + 1
+    )
+    assert pm["coordinator"]["ticks"] == 5
+    assert pm["coordinator"]["counters"]["queries"] == 10
+    assert pm["coordinator"]["breakers"]["device"] == "open"
+    assert pm["stale_markers"] != []
+    # the NEXT open at this root detects the unclean start: counted,
+    # recorded in the spool, marker consumed so one crash reports once
+    m = robustness_metrics()
+    u0 = m.counter("history.unclean_start")
+    sp = history.HistorySpool(str(tmp_path), owner="successor")
+    assert m.counter("history.unclean_start") == u0 + 1
+    assert sp.unclean and sp.unclean[0]["owner"] == "victim"
+    sp.flush()
+    recs2, _ = history.read_records(str(tmp_path))
+    assert any(r["kind"] == "unclean_start" for r in recs2)
+    assert history.stale_markers(str(tmp_path)) == []
+    sp.close(blackbox=False)
+
+
+def test_clean_close_dumps_blackbox_and_seals(tmp_path):
+    sp = history.HistorySpool(str(tmp_path), owner="t")
+    sp.on_tick({"t": time.time(), "counters": {}, "breakers": {}})
+    sp.close()
+    boxes = history.blackboxes(str(tmp_path))
+    assert len(boxes) == 1
+    assert boxes[0]["pid"] == os.getpid()
+    assert "breakers" in boxes[0] and "slow_queries" in boxes[0]
+    assert history.stale_markers(str(tmp_path)) == []
+    # close sealed the active segment: the footer verifies
+    segs = _segments(str(tmp_path))
+    integrity.read_verified(
+        os.path.join(str(tmp_path), history.TELEMETRY_DIR, segs[0])
+    )
+
+
+# -- the perf-regression sentry -----------------------------------------------
+
+
+def test_sentry_trips_on_sustained_shift_and_recovers(tmp_path):
+    m = robustness_metrics()
+    r0 = m.counter("decision.sentry.regressed")
+    c0 = m.counter("decision.sentry.recovered")
+    with properties(geomesa_sentry_threshold="1.0",
+                    geomesa_sentry_min_events="10"):
+        s = history.PerfSentry()
+        t = time.time()
+        # prime the baseline: ~10 ms/call
+        assert s.observe([{"fingerprint": "fp1", "calls": 5, "ms": 50}], t) == []
+        # 4x latency (2.0 log2 shift) but only 6 events: under the floor
+        assert s.observe(
+            [{"fingerprint": "fp1", "calls": 6, "ms": 240}], t
+        ) == []
+        assert "fp1" not in s.regressed
+        # 6 more slow events cross min_events=10: REGRESSED
+        ev = s.observe([{"fingerprint": "fp1", "calls": 6, "ms": 240}], t)
+        assert [e["state"] for e in ev] == ["regressed"]
+        assert s.regressed["fp1"]["shift_log2"] == pytest.approx(2.0, abs=0.01)
+        assert m.counter("decision.sentry.regressed") == r0 + 1
+        # the baseline FROZE while regressed (no EWMA absorption)
+        assert s._baseline["fp1"] == pytest.approx(10.0)
+        # one healthy tick clears it
+        ev = s.observe([{"fingerprint": "fp1", "calls": 5, "ms": 50}], t + 1)
+        assert [e["state"] for e in ev] == ["recovered"]
+        assert s.regressed == {}
+        assert m.counter("decision.sentry.recovered") == c0 + 1
+
+
+def test_sentry_threshold_zero_disables(tmp_path):
+    with properties(geomesa_sentry_threshold="0"):
+        s = history.PerfSentry()
+        t = time.time()
+        s.observe([{"fingerprint": "fp1", "calls": 50, "ms": 500}], t)
+        assert s.observe(
+            [{"fingerprint": "fp1", "calls": 50, "ms": 50000}], t
+        ) == []
+        assert s.regressed == {}
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_sentry_degrades_healthz_naming_fingerprint(tmp_path):
+    """Acceptance: a tripped sentry degrades /healthz NAMING the
+    fingerprint, lands on /debug/history + /debug/recovery, and
+    /healthz recovers once the fingerprint clears."""
+    from geomesa_tpu.web import GeoMesaServer
+
+    with properties(geomesa_sentry_min_events="8"):
+        store = FsDataStore(str(tmp_path / "root"))
+        sp = history.spool_for(store)
+        assert sp is not None
+        t = time.time() - 5  # records must sit INSIDE the ?s= window
+        sp.on_tick({"t": t, "counters": {}, "breakers": {},
+                    "plans": [{"fingerprint": "fp9", "calls": 5, "ms": 50}]},
+                   store)
+        sp.on_tick({"t": t + 1, "counters": {}, "breakers": {},
+                    "plans": [{"fingerprint": "fp9", "calls": 9, "ms": 360}]},
+                   store)
+        assert "fp9" in sp.sentry.regressed
+        with GeoMesaServer(store) as url:
+            h = _get(url + "/healthz")
+            assert h["status"] == "degraded"
+            assert "fp9" in h["sentry"]["regressed"]
+            body = _get(url + "/debug/history?s=3600")
+            assert "fp9" in body["sentry"]
+            assert any(r["kind"] == "sentry" for r in body["records"])
+            rec = _get(url + "/debug/recovery")
+            assert rec["history"]["regressed"].get("fp9")
+            # recovery: latency returns, the fingerprint clears
+            sp.on_tick({"t": t + 2, "counters": {}, "breakers": {},
+                        "plans": [{"fingerprint": "fp9", "calls": 5,
+                                   "ms": 50}]}, store)
+            h = _get(url + "/healthz")
+            assert h["status"] == "ok" and "sentry" not in h
+        sp.close(blackbox=False)
+
+
+# -- the /debug/history surface -----------------------------------------------
+
+
+def test_debug_history_payload_windows_records(tmp_path):
+    from geomesa_tpu import web
+
+    store = FsDataStore(str(tmp_path / "root"))
+    sampler = timeline.sampler_for(store)
+    assert sampler._history is not None
+    robustness_metrics().inc("queries", 1)
+    sampler.tick()
+    sampler.tick()
+    body = web.debug_history_payload(store, s=3600)
+    assert body["enabled"] and not body["truncated"]
+    kinds = {r["kind"] for r in body["records"]}
+    assert "tick" in kinds
+    # an until= in the past excludes the fresh ticks
+    past = web.debug_history_payload(store, s=60, until=time.time() - 3600)
+    assert past["records"] == []
+    sampler._history.close(blackbox=False)

@@ -247,6 +247,37 @@ def test_sampler_thread_runs_and_stops():
     assert s.ticks == settled  # stopped means stopped
 
 
+def test_tick_loop_compensates_for_slow_ticks():
+    """Regression: the sampler loop used to wait the FULL interval
+    after each tick's work, so a tick costing c seconds drifted the
+    cadence to interval+c (a 50 ms snapshot gather on a busy fleet
+    coordinator turned a 1 s timeline into ~1.05 s and the ring's
+    per-second deltas silently stretched). The wait must subtract the
+    tick's own cost."""
+    reg = MetricsRegistry()
+    s = timeline.TimelineSampler(registries=[reg], interval_s=0.08, window_s=5)
+    times = []
+    orig = s._tick
+
+    def slow_tick():
+        times.append(time.monotonic())
+        time.sleep(0.05)  # tick work eats most of the interval
+        return orig()
+
+    s._tick = slow_tick
+    s.start()
+    deadline_ts = time.time() + 8.0
+    while len(times) < 8 and time.time() < deadline_ts:
+        time.sleep(0.01)
+    s.stop()
+    assert len(times) >= 8
+    gaps = sorted(b - a for a, b in zip(times, times[1:]))
+    median = gaps[len(gaps) // 2]
+    # drifting loop paces at ~interval+cost (0.13 s); compensated loop
+    # holds ~interval (0.08 s). Midpoint with slack for scheduler jitter.
+    assert median < 0.115, f"tick spacing drifted: {gaps}"
+
+
 def test_sharded_rollup_reports_per_worker_telemetry():
     from geomesa_tpu.parallel.shards import ShardedDataStore
 
