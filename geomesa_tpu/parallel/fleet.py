@@ -383,6 +383,7 @@ class _WorkerState:
         from geomesa_tpu.utils.audit import MetricsRegistry
         from geomesa_tpu.utils.config import SHARD_MAX_INFLIGHT, SHARD_QUEUE_DEPTH
         from geomesa_tpu.utils.plans import PlanRegistry
+        from geomesa_tpu.utils.tenants import TenantRegistry
 
         self.worker_id = int(worker_id)
         self.root = root
@@ -400,6 +401,10 @@ class _WorkerState:
             name=f"fleetworker{worker_id}",
         )
         self.plans = PlanRegistry()
+        # ONE tenant meter per worker (utils/tenants.py): the label
+        # crosses the wire inside the query's hints, so the worker's
+        # registry meters remote traffic exactly like local
+        self.tenants = TenantRegistry()
         self._stores: Dict[str, Any] = {}
         self._schemas: Dict[str, FeatureType] = {}
         self._lock = threading.Lock()
@@ -466,6 +471,7 @@ class _WorkerState:
             # registry (the ShardWorker arrangement: fixed memory per
             # worker, one rollup read for the telemetry seam)
             st.__dict__["_plans"] = self.plans
+            st.__dict__["_tenants"] = self.tenants
             for ft in self._schemas.values():
                 if ft.name not in st.type_names:
                     st.create_schema(ft)
@@ -716,6 +722,7 @@ class _WorkerState:
             "admission": self.admission.peek(),
             "partitions": len(self._stores),
             "plans": self.plans.top(5),
+            "tenants": self.tenants.top(5),
             "pid": os.getpid(),
             "draining": self.draining,
             "uptime_s": round(time.monotonic() - self.t_start, 3),
@@ -729,6 +736,15 @@ class _WorkerState:
             "top": self.plans.top(min(n, 50)),
             "rows": self.plans.rows(sort=head.get("sort", "time"), n=n),
             "cap": self.plans.cap,
+        }, []
+
+    def op_tenants(self, head, payloads):
+        n = int(head.get("n", 20))
+        return {
+            "ok": 1,
+            "top": self.tenants.top(min(n, 50)),
+            "rows": self.tenants.rows(sort=head.get("sort", "time"), n=n),
+            "cap": self.tenants.cap,
         }, []
 
     def note_trace(self, sp) -> None:
@@ -779,6 +795,17 @@ class _WorkerState:
         # sampler lock, write-behind, budget-bounded in flush()
         if self._history is not None and snap:
             self._history.on_tick(snap)
+        # workload capture rides the same cadence: drain each partition
+        # sub-store's EXISTING spool (create=False — a tick never opens
+        # one), so a SIGKILLed worker's capture survives on disk
+        if snap:
+            from geomesa_tpu.utils import workload as _workload
+
+            for st in self._snapshot_stores():
+                try:
+                    _workload.flush_for(st)
+                except Exception:  # noqa: BLE001 - never stall the tick
+                    pass
         exemplars: Dict[str, Dict[str, List[Any]]] = {}
         class_timers = {meta["timer"] for meta in slo.CLASSES.values()}
         for reg in regs:
@@ -795,16 +822,17 @@ class _WorkerState:
             "admission": self.admission.peek(),
             "partitions": len(self._stores),
             "plans": self.plans.top(5),
+            "tenants": self.tenants.top(5),
             "draining": self.draining,
             "pid": os.getpid(),
         }, []
 
     def op_debug(self, head, payloads):
         """The worker half of the fleet debug plane: this worker's
-        traces/device/overload/recovery/plans sections, each assembled
-        under its own error isolation — one bad gauge must not blank
-        the whole worker entry in ``GET /debug/fleet`` or the incident
-        report (the REPORT_SECTIONS posture, per worker)."""
+        traces/device/overload/recovery/plans/tenants sections, each
+        assembled under its own error isolation — one bad gauge must
+        not blank the whole worker entry in ``GET /debug/fleet`` or the
+        incident report (the REPORT_SECTIONS posture, per worker)."""
 
         def _traces():
             return [sp.to_dict() for sp in list(self._recent_traces)]
@@ -853,6 +881,9 @@ class _WorkerState:
         def _plans():
             return self.plans.payload(n=int(head.get("n", 10)))
 
+        def _tenants():
+            return self.tenants.payload(n=int(head.get("n", 10)))
+
         sections: Dict[str, Any] = {}
         for name, fn in (
             ("traces", _traces),
@@ -860,6 +891,7 @@ class _WorkerState:
             ("overload", _overload),
             ("recovery", _recovery),
             ("plans", _plans),
+            ("tenants", _tenants),
         ):
             try:
                 sections[name] = fn()
@@ -1143,6 +1175,39 @@ class _PlansProxy:
         return PLANS_MAX.to_int() or 256
 
 
+class _TenantsProxy:
+    """The ``ShardWorker.tenants`` seam over the wire — the
+    ``_PlansProxy`` shape for the worker's TenantRegistry: unreachable
+    workers contribute empty tables, every call passive-budget-bounded."""
+
+    def __init__(self, client: "WorkerClient"):
+        self._client = client
+
+    def top(self, n: int = 5) -> List[Dict[str, Any]]:
+        try:
+            with deadline.budget(_passive_budget_s()):
+                resp, _ = self._client._rpc("tenants", {"n": int(n)})
+        except (OSError, QueryTimeout):
+            return []
+        return resp.get("top", [])
+
+    def rows(self, sort: str = "time", n: int = 20) -> List[Dict[str, Any]]:
+        try:
+            with deadline.budget(_passive_budget_s()):
+                resp, _ = self._client._rpc(
+                    "tenants", {"n": int(n), "sort": sort}
+                )
+        except (OSError, QueryTimeout):
+            return []
+        return resp.get("rows", [])
+
+    @property
+    def cap(self) -> int:
+        from geomesa_tpu.utils.config import TENANTS_MAX
+
+        return TENANTS_MAX.to_int() or 64
+
+
 class WorkerClient:
     """The ``ShardWorker`` contract over the fleet wire protocol — the
     coordinator's ``_shard_call`` seam talks to this exactly as it
@@ -1184,6 +1249,7 @@ class WorkerClient:
         self._pool: List[socket.socket] = []
         self._plock = threading.Lock()
         self.plans = _PlansProxy(self)
+        self.tenants = _TenantsProxy(self)
 
     # -- transport -----------------------------------------------------------
 
@@ -3165,6 +3231,7 @@ class FleetDataStore(ShardedDataStore):
                 shard["admission"] = row.get("admission")
                 shard["partitions"] = row.get("partitions")
                 shard["plans"] = row.get("plans", [])
+                shard["tenants"] = row.get("tenants", [])
                 for timer, buckets in (row.get("exemplars") or {}).items():
                     slot = exemplars.setdefault(timer, {})
                     for b, ex in buckets.items():

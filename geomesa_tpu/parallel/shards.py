@@ -294,8 +294,12 @@ class ShardWorker:
         # (telemetry(), the /debug/plans shards block) is one read, the
         # shape a cross-process transport would ship whole
         from geomesa_tpu.utils.plans import PlanRegistry
+        from geomesa_tpu.utils.tenants import TenantRegistry
 
         self.plans = PlanRegistry()
+        # ONE tenant meter per shard too (utils/tenants.py) — the same
+        # shared-registry/rollup shape, keyed by tenant label
+        self.tenants = TenantRegistry()
 
     def create_schema(self, ft: FeatureType) -> None:
         with self._lock:
@@ -326,6 +330,7 @@ class ShardWorker:
                 # partition sub-stores share the shard's fingerprint
                 # registry (fixed memory per shard, not per partition)
                 st.__dict__["_plans"] = self.plans
+                st.__dict__["_tenants"] = self.tenants
                 for ft in self._schemas.values():
                     st.create_schema(ft)
                 self._stores[partition] = st
@@ -388,6 +393,8 @@ class ShardWorker:
             # the shard's hottest plan fingerprints (utils/plans.py):
             # the plan-level half of the rollup, same seam
             "plans": self.plans.top(5),
+            # and its hottest tenants (utils/tenants.py), same shape
+            "tenants": self.tenants.top(5),
         }
 
     def has_visibility(self, name: str) -> bool:
@@ -1230,6 +1237,21 @@ class ShardedDataStore(TpuDataStore):
         # table; the n-slice applies after the exact merge
         merged = plans_util.merge_rows(
             [w.plans.rows(n=w.plans.cap) for w in self.workers]
+        )[: max(0, int(n))]
+        return shards, merged
+
+    def tenants_rollup(self, n: int = 20) -> tuple:
+        """The /debug/tenants sharded rollup: (per-shard top blocks,
+        the cross-shard merged tenant table) — the ``plans_rollup``
+        discipline applied to tenant labels (merge each shard's FULL
+        capped registry, slice after the exact merge)."""
+        from geomesa_tpu.utils import tenants as tenants_util
+
+        shards = {
+            str(i): w.tenants.top(5) for i, w in enumerate(self.workers)
+        }
+        merged = tenants_util.merge_rows(
+            [w.tenants.rows(n=w.tenants.cap) for w in self.workers]
         )[: max(0, int(n))]
         return shards, merged
 

@@ -45,6 +45,8 @@ from geomesa_tpu.utils import audit as audit_mod
 from geomesa_tpu.utils import deadline as deadline_mod
 from geomesa_tpu.utils import devstats, trace
 from geomesa_tpu.utils import plans as plans_mod
+from geomesa_tpu.utils import tenants as tenants_mod
+from geomesa_tpu.utils import workload as workload_mod
 
 DEFAULT_FLUSH_SIZE = 100_000
 
@@ -870,6 +872,7 @@ class TpuDataStore:
         t0 = _time.perf_counter()
         root = trace.NOOP
         ptok = plans_mod.begin()
+        wtok = workload_mod.op_begin()
         try:
             with trace.span(
                 "query.aggregate", force=self.slow_query_s is not None,
@@ -903,17 +906,25 @@ class TpuDataStore:
                                     "query.aggregate",
                                     _time.perf_counter() - t0,
                                 )
+                            fid = ""
                             if plans_mod.enabled():
                                 # aggregate-class fingerprint; the exact
                                 # fallback's inner query fingerprinted
                                 # itself (and drained the pending scope)
                                 # as a `query` already
-                                self._plans_obj().observe(
+                                fid = self._plans_obj().observe(
                                     "aggregate", name, query=q,
                                     scan_path=agg_path, outcome="ok",
                                     hits=int(got.get("count", 0)),
                                     duration_s=_time.perf_counter() - t0,
                                 )
+                            self._observe_workload(
+                                "aggregate", name, query=q, outcome="ok",
+                                duration_s=_time.perf_counter() - t0,
+                                rows=int(got.get("count", 0)),
+                                fingerprint=fid,
+                                extra={"columns": cols} if cols else None,
+                            )
                             return got
                 except (QueryTimeout, ShedLoad) as e:
                     outcome = (
@@ -927,14 +938,22 @@ class TpuDataStore:
                         # queries/queries.<outcome>
                         self.metrics.inc("queries.aggregate")
                         self.metrics.inc(f"queries.aggregate.{outcome}")
+                    fid = ""
                     if plans_mod.enabled():
-                        self._plans_obj().observe(
+                        fid = self._plans_obj().observe(
                             "aggregate", name, query=q, outcome=outcome,
                             duration_s=_time.perf_counter() - t0,
                         )
+                    self._observe_workload(
+                        "aggregate", name, query=q, outcome=outcome,
+                        duration_s=_time.perf_counter() - t0,
+                        fingerprint=fid,
+                        extra={"columns": cols} if cols else None,
+                    )
                     raise
         finally:
             plans_mod.end(ptok)
+            workload_mod.op_end(wtok)
             self._log_slow_query(name, None, root)
 
     def _aggregate_pyramid(
@@ -1124,6 +1143,9 @@ class TpuDataStore:
         # per-block row actuals collect here until _audit folds them
         # into the fingerprint. None (one flag read) when disabled.
         ptok = plans_mod.begin()
+        # workload op-depth marker: a query invoked INSIDE a join or
+        # aggregate captures as nested (not directly re-driven by replay)
+        wtok = workload_mod.op_begin()
         try:
             with trace.span(
                 "query", force=self.slow_query_s is not None, type=name
@@ -1212,6 +1234,7 @@ class TpuDataStore:
                     raise
         finally:
             plans_mod.end(ptok)
+            workload_mod.op_end(wtok)
             self._log_slow_query(name, plan, root)
 
     def _prepare_query(self, name: str, query: Query) -> None:
@@ -1293,6 +1316,7 @@ class TpuDataStore:
         root = trace.NOOP
         t0 = _time.perf_counter()
         ptok = plans_mod.begin()
+        wtok = workload_mod.op_begin()
         try:
             with trace.span(
                 "query.join", force=self.slow_query_s is not None,
@@ -1322,13 +1346,14 @@ class TpuDataStore:
                             self.metrics.update_timer(
                                 "query.join", _time.perf_counter() - t0
                             )
+                        fid = ""
                         if plans_mod.enabled():
                             # join-class fingerprint: predicate kind as
                             # the shape, the answering path (device/host/
                             # degraded) as the scan path — the inner
                             # build/probe queries fingerprinted (and
                             # drained) themselves as `query`s already
-                            self._plans_obj().observe(
+                            fid = self._plans_obj().observe(
                                 "join",
                                 f"{build_name}+{probe_name}",
                                 shape=f"join:{spec.kind}",
@@ -1339,6 +1364,18 @@ class TpuDataStore:
                                 duration_s=_time.perf_counter() - t0,
                                 receipt=receipt,
                             )
+                        self._observe_workload(
+                            "join", f"{build_name}+{probe_name}",
+                            tenant=self._join_tenant(build_q, probe_q),
+                            outcome="ok",
+                            duration_s=_time.perf_counter() - t0,
+                            rows=len(result), receipt=receipt,
+                            fingerprint=fid,
+                            extra=self._join_extra(
+                                build_name, build_q, probe_name, probe_q,
+                                spec,
+                            ),
+                        )
                         return result
                 except (QueryTimeout, ShedLoad) as e:
                     # crisp failure: a timed-out join never returns a
@@ -1359,12 +1396,23 @@ class TpuDataStore:
                         # join there too would show 2 failures for 1 join
                         self.metrics.inc("queries.join")
                         self.metrics.inc(f"queries.join.{outcome}")
+                    fid = ""
                     if plans_mod.enabled():
-                        self._plans_obj().observe(
+                        fid = self._plans_obj().observe(
                             "join", f"{build_name}+{probe_name}",
                             shape=f"join:{spec.kind}", outcome=outcome,
                             duration_s=_time.perf_counter() - t0,
                         )
+                    self._observe_workload(
+                        "join", f"{build_name}+{probe_name}",
+                        tenant=self._join_tenant(build_q, probe_q),
+                        outcome=outcome,
+                        duration_s=_time.perf_counter() - t0,
+                        fingerprint=fid,
+                        extra=self._join_extra(
+                            build_name, build_q, probe_name, probe_q, spec,
+                        ),
+                    )
                     if self.audit_writer is not None:
                         self._audit_failure(
                             build_name + "+" + probe_name, probe_q, None,
@@ -1373,7 +1421,32 @@ class TpuDataStore:
                     raise
         finally:
             plans_mod.end(ptok)
+            workload_mod.op_end(wtok)
             self._log_slow_query(build_name + "+" + probe_name, None, root)
+
+    @staticmethod
+    def _join_tenant(build_q, probe_q) -> str:
+        """Tenant label for a join: probe hint wins, then build hint."""
+        label = tenants_mod.tenant_of(probe_q)
+        if label == tenants_mod.ANON:
+            label = tenants_mod.tenant_of(build_q)
+        return label
+
+    @staticmethod
+    def _join_extra(build_name, build_q, probe_name, probe_q, spec):
+        """Replay payload for a captured join (both sides as CQL)."""
+        if not workload_mod.enabled():
+            return None
+        from geomesa_tpu.filter.parser import to_cql
+
+        return {
+            "join": {
+                "build": [build_name, to_cql(build_q.filter)],
+                "probe": [probe_name, to_cql(probe_q.filter)],
+                "predicate": spec.kind,
+                "radius_m": spec.radius_m,
+            }
+        }
 
     def _join_side(self, side) -> tuple:
         """``"name"`` or ``(name, cql-or-Query)`` -> (name, Query)."""
@@ -1757,6 +1830,7 @@ class TpuDataStore:
                     self._audit(
                         name, q, plan, None, t0, t_planned,
                         devstats.receipt_since(dev0), hits=hits,
+                        wl_cls="stream",
                     )
                 if self.metrics is not None:
                     self.metrics.inc("queries.stream")
@@ -1904,13 +1978,17 @@ class TpuDataStore:
 
     def _auditing(self) -> bool:
         """Whether the per-query audit step must run at all: an audit
-        writer, a metrics registry, or the plan-fingerprint registry
-        (utils/plans.py) is listening. _audit/_audit_failure re-check
-        each sink individually — this is just the hot-path gate."""
+        writer, a metrics registry, the plan-fingerprint registry
+        (utils/plans.py), the tenant meter (utils/tenants.py), or the
+        workload recorder (utils/workload.py) is listening.
+        _audit/_audit_failure re-check each sink individually — this is
+        just the hot-path gate."""
         return (
             self.audit_writer is not None
             or self.metrics is not None
             or plans_mod.enabled()
+            or tenants_mod.enabled()
+            or workload_mod.enabled()
         )
 
     @staticmethod
@@ -1923,7 +2001,7 @@ class TpuDataStore:
         return getattr(plan, "scan_path", "")
 
     def _audit(self, name, query, plan, result, t_start, t_planned,
-               receipt=None, hits=None):
+               receipt=None, hits=None, wl_cls="query"):
         import time as _time
 
         from geomesa_tpu.filter.parser import to_cql
@@ -1959,12 +2037,13 @@ class TpuDataStore:
                     pad_ratio=float(receipt.get("pad_ratio", 0.0)),
                 )
             )
+        fid = ""
         if plans_mod.enabled():
             # fold the finished query into its plan fingerprint
             # (utils/plans.py): plan-time estimates (QueryPlan.cost,
             # range count) meet the consume-time actuals and the
             # pending decision tallies here
-            self._plans_obj().observe(
+            fid = self._plans_obj().observe(
                 "query", name, plan=plan, query=query,
                 scan_path=self._collect_scan_path(plan),
                 outcome="ok", hits=hits, duration_s=now - t_start,
@@ -1972,6 +2051,11 @@ class TpuDataStore:
                 est_cost=plan.cost,
                 est_ranges=len(plan.ranges),
             )
+        self._observe_workload(
+            wl_cls, name, query=query, outcome="ok",
+            duration_s=now - t_start, rows=hits, receipt=receipt,
+            fingerprint=fid,
+        )
 
     def _plans_obj(self):
         """The per-store plan-fingerprint registry (utils/plans.py),
@@ -1985,6 +2069,52 @@ class TpuDataStore:
 
             reg = self.__dict__.setdefault("_plans", PlanRegistry())
         return reg
+
+    def _tenants_obj(self):
+        """The per-store tenant-cost registry (utils/tenants.py),
+        created lazily — the _plans_obj arrangement exactly: GIL-atomic
+        setdefault so two concurrent first queries agree on ONE
+        registry, and ShardWorker / fleet workers pre-assign a shared
+        registry to their partition sub-stores so a worker rolls up as
+        one read."""
+        reg = getattr(self, "_tenants", None)
+        if reg is None:
+            from geomesa_tpu.utils.tenants import TenantRegistry
+
+            reg = self.__dict__.setdefault("_tenants", TenantRegistry())
+        return reg
+
+    def _observe_workload(self, cls, type_name, *, query=None, cql=None,
+                          outcome="ok", duration_s=0.0, rows=0,
+                          receipt=None, fingerprint="", tenant=None,
+                          extra=None):
+        """The workload-intelligence seam: per-tenant metering
+        (utils/tenants.py) + workload capture (utils/workload.py) for
+        one finished request. Both are pure observers — off costs one
+        cached flag read each, and the capture swallows its own
+        failures — so this sits AFTER the result is final and can never
+        change an answer. Runs inside the admission slot, so the
+        recorded in-flight depth includes the request itself."""
+        t_on = tenants_mod.enabled()
+        w_on = workload_mod.enabled()
+        if not (t_on or w_on):
+            return
+        if tenant is None:
+            tenant = tenants_mod.tenant_of(query)
+        if t_on:
+            self._tenants_obj().observe(
+                tenant, cls, outcome=outcome, duration_s=duration_s,
+                rows=rows, receipt=receipt,
+            )
+        if w_on:
+            adm = getattr(self, "admission", None)
+            inflight = adm.peek()["inflight"] if adm is not None else 0
+            workload_mod.record(
+                self, cls, type_name, query=query, cql=cql,
+                tenant=tenant, inflight=inflight, outcome=outcome,
+                fingerprint=fingerprint, receipt=receipt,
+                duration_s=duration_s, rows=rows, extra=extra,
+            )
 
     def _audit_failure(self, name, query, plan, t_admit, outcome: str,
                        count_metrics: bool = True):
@@ -2022,12 +2152,13 @@ class TpuDataStore:
                     outcome=outcome,
                 )
             )
+        fid = ""
         if count_metrics and plans_mod.enabled():
             # failed queries fingerprint too: a shape that times out is
             # exactly the shape the misestimate/decision record explains
             # (count_metrics=False = a join-level failure event that
             # already wrote its own join-class fingerprint)
-            self._plans_obj().observe(
+            fid = self._plans_obj().observe(
                 "query", name, plan=plan, query=query,
                 scan_path=(
                     self._collect_scan_path(plan) if plan is not None else ""
@@ -2035,6 +2166,15 @@ class TpuDataStore:
                 outcome=outcome, hits=0, duration_s=elapsed_ms / 1000.0,
                 est_cost=plan.cost if plan is not None else None,
                 est_ranges=len(plan.ranges) if plan is not None else None,
+            )
+        if count_metrics:
+            # failed queries meter and capture too (conservation: the
+            # per-tenant outcome sums must equal queries.<outcome>);
+            # count_metrics=False = a join-level event whose join path
+            # recorded its own tenant/workload observation already
+            self._observe_workload(
+                "query", name, query=query, outcome=outcome,
+                duration_s=elapsed_ms / 1000.0, fingerprint=fid,
             )
 
     def _log_slow_query(self, name: str, plan, root) -> None:

@@ -342,6 +342,32 @@ TIMELINE_WINDOW = SystemProperty("geomesa.timeline.window", "1 hour")
 HISTORY_ENABLED = SystemProperty("geomesa.history.enabled", "true")
 HISTORY_BYTES = SystemProperty("geomesa.history.bytes", "1MB")
 HISTORY_TTL = SystemProperty("geomesa.history.ttl", "24 hours")
+# Workload recorder (utils/workload.py): every admitted query/join/
+# aggregate/stream appends a REPLAYABLE descriptor — type name, CQL,
+# hints, query class, tenant, monotonic arrival offset, in-flight
+# concurrency, outcome, plan-fingerprint id, cost receipt — to its own
+# CRC-sealed segment kind (`wl-*`) under `<root>/_telemetry/`, so
+# scripts/replay_workload.py can re-drive yesterday's traffic against a
+# knob change. Default OFF: capture is an opt-in observer, and
+# `enabled=0` leaves ONE cached flag read on the hot path (the
+# history-spool posture; poisoned-spool test pins it). `literals=0`
+# replaces CQL literals with a salted hash before anything touches disk
+# (capture without retaining user-supplied values). `bytes`/`ttl`
+# mirror the history rotation/retention knobs for the workload segments.
+WORKLOAD_ENABLED = SystemProperty("geomesa.workload.enabled", "false")
+WORKLOAD_LITERALS = SystemProperty("geomesa.workload.literals", "true")
+WORKLOAD_BYTES = SystemProperty("geomesa.workload.bytes", "1MB")
+WORKLOAD_TTL = SystemProperty("geomesa.workload.ttl", "24 hours")
+# Per-tenant cost metering (utils/tenants.py): the `tenant` query hint
+# (web.py maps the X-Geomesa-Tenant header into it; absent = "anon")
+# accumulates into a fixed-memory top-K LRU — calls/outcomes/latency/
+# rows/receipt sums/per-class splits per tenant — behind
+# GET /debug/tenants, the timeline's per-tick tenant deltas, per-tenant
+# SLO burn (`<slo>@tenant:<label>` on /healthz), and the fleet rollup.
+# `enabled=0` reduces the hot-path hook to a single cached flag read
+# (the plans-registry posture). `max` bounds tenants per registry.
+TENANTS_ENABLED = SystemProperty("geomesa.tenants.enabled", "true")
+TENANTS_MAX = SystemProperty("geomesa.tenants.max", "64")
 # Perf-regression sentry (utils/history.py): per-fingerprint EWMA
 # latency baselines over the spool's per-tick plan deltas; a sustained
 # log2 shift >= `sentry.threshold` covering at least `sentry.min.events`

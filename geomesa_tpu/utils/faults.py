@@ -71,6 +71,13 @@ disk, or device boundary:
                        here must re-queue (never lose silently, never
                        block a query), overflow past the bounded queue
                        counts ``history.dropped``
+    workload.append    one write-behind flush of the workload-capture
+                       spool (utils/workload.py): the sampler-tick
+                       thread appending queued query descriptors to the
+                       active ``wl-*`` segment — an ``error``/``drop``
+                       here must re-queue (never lose silently, never
+                       perturb a query), overflow past the bounded
+                       queue counts ``workload.dropped``
 
 Kinds:
 
@@ -154,6 +161,7 @@ FAULT_POINTS = (
     "fleet.lease",
     "fleet.fanout",
     "history.append",
+    "workload.append",
 )
 
 KINDS = ("error", "drop", "latency", "torn", "crash")

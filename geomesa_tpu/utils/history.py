@@ -519,6 +519,7 @@ class HistorySpool:
             self._record_slo(t, store)
             if self._ticks % PLANS_EVERY_TICKS == 1:
                 self._record_plans(t, store)
+                self._record_tenants(t, store)
         for ev in self.sentry.observe(snap.get("plans") or [], t):
             self.append(ev)
         self.flush()
@@ -555,6 +556,22 @@ class HistorySpool:
                 self.append({"kind": "plans", "t": t, "rows": rows})
         except Exception:  # noqa: BLE001 - recording must not kill the tick
             _log.debug("plans history record failed", exc_info=True)
+
+    def _record_tenants(self, t: float, store: Any) -> None:
+        """Periodic per-tenant cost table (utils/tenants.py) — who was
+        burning the store, durable; postmortems fold it around a kill
+        instant the same way they fold the plans table."""
+        try:
+            treg = getattr(store, "_tenants", None)
+            if treg is None:
+                return
+            from geomesa_tpu.utils import tenants as _tenants
+
+            rows = _tenants.history_rows(treg, n=10)
+            if rows:
+                self.append({"kind": "tenants", "t": t, "rows": rows})
+        except Exception:  # noqa: BLE001 - recording must not kill the tick
+            _log.debug("tenants history record failed", exc_info=True)
 
     # -- introspection --------------------------------------------------------
 
@@ -598,6 +615,8 @@ def read_records(
     s: Optional[float] = None,
     until: Optional[float] = None,
     limit: Optional[int] = None,
+    prefix: str = SEGMENT_PREFIX,
+    counter_ns: str = "history",
 ) -> Tuple[List[Dict[str, Any]], bool]:
     """Every spool record under ``<root>/_telemetry`` with
     ``s <= t <= until`` (both optional), oldest first; returns
@@ -606,11 +625,13 @@ def read_records(
 
     The integrity discipline (store/integrity.py): sealed segments CRC-
     verify — a corrupt one is quarantined and SKIPPED (counted
-    ``history.segments.corrupt``), adjacent segments keep their ticks.
+    ``<ns>.segments.corrupt``), adjacent segments keep their ticks.
     Footer-less segments (the active one, or one a kill -9 orphaned)
     pass through unverified; a torn trailing line skips per-line
-    (counted ``history.torn``) and every parseable line before it
-    survives."""
+    (counted ``<ns>.torn``) and every parseable line before it
+    survives. ``prefix``/``counter_ns`` select the segment KIND — the
+    workload-capture spool (utils/workload.py, ``wl-`` segments) reads
+    through this same verified path under its own counters."""
     from geomesa_tpu.store import integrity
 
     d = os.path.join(root, TELEMETRY_DIR)
@@ -620,13 +641,13 @@ def read_records(
         return out, truncated
     cap = None if limit is None else max(0, int(limit))
     for name in sorted(os.listdir(d)):
-        if not (name.startswith(SEGMENT_PREFIX) and name.endswith(".jsonl")):
+        if not (name.startswith(prefix) and name.endswith(".jsonl")):
             continue
         path = os.path.join(d, name)
         try:
             data = integrity.read_verified(path)
         except integrity.CorruptFileError:
-            robustness_metrics().inc("history.segments.corrupt")
+            robustness_metrics().inc(f"{counter_ns}.segments.corrupt")
             integrity.quarantine(path)
             continue
         except OSError:
@@ -637,10 +658,10 @@ def read_records(
             try:
                 rec = json.loads(line.decode("utf-8"))
             except (ValueError, UnicodeDecodeError):
-                robustness_metrics().inc("history.torn")
+                robustness_metrics().inc(f"{counter_ns}.torn")
                 continue
             if not isinstance(rec, dict):
-                robustness_metrics().inc("history.torn")
+                robustness_metrics().inc(f"{counter_ns}.torn")
                 continue
             t = rec.get("t")
             if not isinstance(t, (int, float)):

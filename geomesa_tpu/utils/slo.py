@@ -226,6 +226,30 @@ class SloEngine:
                         row[1] += int(n)
         return {w: (e, b) for w, (e, b) in acc.items()}
 
+    @staticmethod
+    def _fold_tenants(
+        snaps: List[Dict[str, Any]], spec: SloSpec
+    ) -> Dict[str, Tuple[int, int]]:
+        """Per-tenant ``{label: (events, bad)}`` over one window, folded
+        from the sampler's per-tick tenant deltas (utils/tenants.py
+        ``timeline_deltas`` — per-class call/bad splits, so a spec folds
+        its OWN class). Availability specs only: the tenant rows carry
+        no latency histograms, so latency objectives stay store-wide.
+        Empty when the tenant meter is off — the engine then behaves
+        exactly as before."""
+        if spec.kind != "availability":
+            return {}
+        acc: Dict[str, List[int]] = {}
+        for s in snaps:
+            for r in s.get("tenants") or []:
+                c = (r.get("classes") or {}).get(spec.cls)
+                if not c:
+                    continue
+                row = acc.setdefault(str(r.get("tenant", "")), [0, 0])
+                row[0] += int(c.get("calls", 0))
+                row[1] += int(c.get("bad", 0))
+        return {t: (e, b) for t, (e, b) in acc.items()}
+
     def _window_eval(
         self, spec: SloSpec, window_s: float, snaps: List[Dict[str, Any]]
     ) -> Dict[str, Any]:
@@ -277,10 +301,23 @@ class SloEngine:
                 (enabled, fast_burn, slow_burn, min_events),
             )
             sick = sorted(w for w, r in workers.items() if r["violating"])
+            # per-tenant burn (tenant meter on): one tenant's failing
+            # traffic violates ITS objective even while the store-wide
+            # series — diluted by every other tenant's successes —
+            # stays green (the per-worker skew rule, per label)
+            tenants = self._tenants_eval(
+                spec,
+                fast_snaps,
+                slow_snaps,
+                (enabled, fast_burn, slow_burn, min_events),
+            )
+            sick_t = sorted(t for t, r in tenants.items() if r["violating"])
             if violated:
                 violating.append(spec.name)
             for w in sick:
                 violating.append(f"{spec.name}@worker{w}")
+            for t in sick_t:
+                violating.append(f"{spec.name}@tenant:{t}")
             rows.append({
                 "name": spec.name,
                 "class": spec.cls,
@@ -289,9 +326,11 @@ class SloEngine:
                 "latency_ms": spec.latency_ms,
                 "fast": fast,
                 "slow": slow,
-                "violating": violated or bool(sick),
+                "violating": violated or bool(sick) or bool(sick_t),
                 "violating_workers": sick,
                 "workers": workers,
+                "violating_tenants": sick_t,
+                "tenants": tenants,
                 "exemplars": (
                     self.worst_exemplars(spec.cls) if exemplars else []
                 ),
@@ -343,6 +382,51 @@ class SloEngine:
                 else 0.0
             )
             out[wid] = {
+                "fast": {"events": fe, "bad": fb, "burn_rate": f_rate},
+                "slow": {"events": se, "bad": sb, "burn_rate": s_rate},
+                "violating": (
+                    enabled
+                    and fe >= min_events
+                    and f_rate >= fast_burn
+                    and s_rate >= slow_burn
+                ),
+            }
+        return out
+
+    def _tenants_eval(
+        self,
+        spec: SloSpec,
+        fast_snaps: List[Dict[str, Any]],
+        slow_snaps: List[Dict[str, Any]],
+        knobs: Tuple[bool, float, float, int],
+    ) -> Dict[str, Any]:
+        """Per-tenant burn rows for one spec: ``{label: {fast, slow,
+        violating}}``, tenants with zero events omitted — the
+        ``_workers_eval`` gate (same multi-window/min-events rule)
+        applied to one tenant's own events."""
+        enabled, fast_burn, slow_burn, min_events = knobs
+        fast_t = self._fold_tenants(fast_snaps, spec)
+        if not fast_t:
+            return {}
+        slow_t = self._fold_tenants(slow_snaps, spec)
+        budget = 1.0 - spec.objective
+        out: Dict[str, Any] = {}
+        for label in sorted(set(fast_t) | set(slow_t)):
+            fe, fb = fast_t.get(label, (0, 0))
+            se, sb = slow_t.get(label, (0, 0))
+            if not fe and not se:
+                continue
+            f_rate = (
+                round(((fb / fe) if fe else 0.0) / budget, 3)
+                if budget > 0
+                else 0.0
+            )
+            s_rate = (
+                round(((sb / se) if se else 0.0) / budget, 3)
+                if budget > 0
+                else 0.0
+            )
+            out[label] = {
                 "fast": {"events": fe, "bad": fb, "burn_rate": f_rate},
                 "slow": {"events": se, "bad": sb, "burn_rate": s_rate},
                 "violating": (

@@ -1,0 +1,427 @@
+"""Per-tenant cost metering: who is burning the fleet, end to end.
+
+Receipts exist per query (PR 3) and per plan fingerprint (PR 11), but
+nothing attributes cost to a *client*. This module closes that gap: a
+tenant label travels with the query (query hint ``tenant``; web.py maps
+the ``X-Geomesa-Tenant`` header into it, the hint winning when both are
+present; absent = ``"anon"``) and every served query / join / aggregate
+/ stream folds into a fixed-memory top-K LRU of per-tenant aggregates —
+the ``utils/plans.py`` registry discipline applied to the *who* axis:
+
+* calls + outcome counts (ok / timeout / shed / error) and the ``bad``
+  total the per-tenant SLO availability burn folds;
+* a latency timer per tenant through ``audit.MetricsRegistry`` — the
+  PR 10 per-tick histograms and trace-linked exemplars come free;
+* rows returned and cost-receipt sums (recompiles, h2d/d2h bytes, pad);
+* per-class splits (query / join / aggregate / stream): which *kind* of
+  traffic each tenant is.
+
+Free when off: ``geomesa.tenants.enabled=0`` reduces the hot-path hook
+to a single cached module-flag read (the plans posture). ``max`` bounds
+tenants per registry; past it the coldest evicts (counted, its timer
+dropped) — an adversarial flood of labels costs fixed memory.
+
+Surfaces: ``GET /debug/tenants`` (the /debug/plans 400/clamp/sort
+contract), the ``tenants`` section of ``GET /debug/report``, per-tick
+tenant deltas in the timeline (which per-tenant SLO burn evaluates —
+a violation names ``<slo>@tenant:<label>`` on /healthz), periodic
+durable ``tenants`` records in the history spool, and the fleet rollup:
+the label crosses the wire in the query hints, every worker keeps its
+own registry, and ``tenants_rollup()`` merges full capped registries
+exactly like ``plans_rollup()`` (weighted-mean merge, never top-n of
+top-n).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from geomesa_tpu.utils.audit import MetricsRegistry, histogram_summary
+
+# the default label: queries that carry no tenant hint/header still
+# meter (conservation — per-tenant sums must equal store-level counts)
+ANON = "anon"
+# labels are operator-facing identifiers, not payloads: bound them so a
+# hostile header cannot bloat registries, metric names, or SLO verdicts
+MAX_LABEL = 64
+
+# -- the flag -----------------------------------------------------------------
+
+_ENABLED: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """The hot-path gate: one module-global read once resolved."""
+    e = _ENABLED
+    if e is None:
+        return _resolve()
+    return e
+
+
+def _resolve() -> bool:
+    global _ENABLED
+    from geomesa_tpu.utils.config import TENANTS_ENABLED
+
+    _ENABLED = bool(TENANTS_ENABLED.to_bool())
+    return _ENABLED
+
+
+def set_enabled(on: Optional[bool]) -> None:
+    """Flip the cached flag (``None`` re-resolves on the next read)."""
+    global _ENABLED
+    _ENABLED = None if on is None else bool(on)
+
+
+def tenants_knobs() -> Tuple[bool, int]:
+    """(enabled, max_tenants) from the geomesa.tenants.* tier."""
+    from geomesa_tpu.utils.config import TENANTS_MAX
+
+    cap = TENANTS_MAX.to_int()
+    return enabled(), 64 if cap is None or cap <= 0 else cap
+
+
+def tenant_of(query: Any) -> str:
+    """The query's tenant label: the ``tenant`` hint, cleaned and
+    bounded, else ``"anon"``. Accepts any duck-typed query (or None)."""
+    hints = getattr(query, "hints", None)
+    label = hints.get("tenant") if isinstance(hints, dict) else None
+    return clean_label(label)
+
+
+def clean_label(label: Any) -> str:
+    """Normalize one externally-supplied label: non-string / blank /
+    whitespace-only fall to ``"anon"``; the rest strip + truncate."""
+    if not isinstance(label, str):
+        return ANON
+    label = label.strip()
+    if not label:
+        return ANON
+    return label[:MAX_LABEL]
+
+
+# -- the registry -------------------------------------------------------------
+
+
+class TenantEntry:
+    """One tenant's aggregates (mutated under the registry lock)."""
+
+    __slots__ = (
+        "label", "calls", "outcomes", "bad", "rows", "total_s", "last_ms",
+        "recompiles", "h2d_bytes", "d2h_bytes", "pad_ratio_sum",
+        "pad_calls", "classes",
+    )
+
+    def __init__(self, label: str):
+        self.label = label
+        self.calls = 0
+        self.outcomes: Dict[str, int] = {}
+        self.bad = 0  # non-ok outcomes: the SLO availability numerator
+        self.rows = 0
+        self.total_s = 0.0
+        self.last_ms = 0.0
+        self.recompiles = 0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.pad_ratio_sum = 0.0
+        self.pad_calls = 0
+        self.classes: Dict[str, Dict[str, Any]] = {}
+
+    def row(self) -> Dict[str, Any]:
+        return {
+            "tenant": self.label,
+            "calls": self.calls,
+            "outcomes": dict(self.outcomes),
+            "bad": self.bad,
+            "rows": self.rows,
+            "total_ms": round(self.total_s * 1000.0, 3),
+            "last_ms": round(self.last_ms, 3),
+            "receipt": {
+                "recompiles": self.recompiles,
+                "h2d_bytes": self.h2d_bytes,
+                "d2h_bytes": self.d2h_bytes,
+                "pad_ratio_mean": round(
+                    self.pad_ratio_sum / max(self.pad_calls, 1), 4
+                ),
+                "pad_calls": self.pad_calls,
+            },
+            "classes": {
+                k: {"calls": v["calls"],
+                    "ms": round(v["s"] * 1000.0, 3),
+                    "bad": v["bad"]}
+                for k, v in sorted(self.classes.items())
+            },
+        }
+
+
+_SORTS = {
+    "time": lambda r: r["total_ms"],
+    "calls": lambda r: r["calls"],
+    "rows": lambda r: r["rows"],
+    "bad": lambda r: r["bad"],
+}
+# the public sort-key whitelist (web.py validates ?sort= against THIS —
+# the utils/plans.SORTS arrangement, no shadow copy to drift)
+SORTS = tuple(_SORTS)
+
+
+class TenantRegistry:
+    """Fixed-memory top-K LRU of per-tenant aggregates (one per store;
+    a ShardWorker / fleet worker shares ONE across its partition
+    sub-stores so the rollup is one read). Latency rides
+    ``self.metrics`` timers named ``tenant.<label>`` — the shared
+    MetricsRegistry reservoir/exemplar machinery, dropped with the
+    entry on LRU eviction so memory stays bounded by the cap alone."""
+
+    def __init__(self, cap: Optional[int] = None):
+        self.cap = tenants_knobs()[1] if cap is None else int(cap)
+        self.metrics = MetricsRegistry()
+        self._entries: "OrderedDict[str, TenantEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.evicted = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def observe(
+        self,
+        label: str,
+        cls: str,
+        *,
+        outcome: str = "ok",
+        duration_s: float = 0.0,
+        rows: int = 0,
+        receipt: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Fold one finished request into its tenant (LRU-bumped;
+        evicts the coldest entry past the cap)."""
+        label = clean_label(label)
+        dropped = None
+        with self._lock:
+            e = self._entries.get(label)
+            if e is None:
+                e = TenantEntry(label)
+                self._entries[label] = e
+                if len(self._entries) > self.cap:
+                    _k, dropped = self._entries.popitem(last=False)
+                    self.evicted += 1
+            else:
+                self._entries.move_to_end(label)
+            e.calls += 1
+            e.outcomes[outcome] = e.outcomes.get(outcome, 0) + 1
+            if outcome != "ok":
+                e.bad += 1
+            e.rows += int(rows)
+            e.total_s += float(duration_s)
+            e.last_ms = float(duration_s) * 1000.0
+            if receipt:
+                e.recompiles += int(receipt.get("recompiles", 0))
+                e.h2d_bytes += int(receipt.get("h2d_bytes", 0))
+                e.d2h_bytes += int(receipt.get("d2h_bytes", 0))
+                pr = float(receipt.get("pad_ratio", 0.0))
+                if pr > 0.0:
+                    e.pad_ratio_sum += pr
+                    e.pad_calls += 1
+            c = e.classes.get(cls)
+            if c is None:
+                c = e.classes[cls] = {"calls": 0, "s": 0.0, "bad": 0}
+            c["calls"] += 1
+            c["s"] += float(duration_s)
+            if outcome != "ok":
+                # per-class bad split: the per-tenant SLO burn folds a
+                # spec's OWN class, not the tenant's mixed traffic
+                c["bad"] += 1
+        if dropped is not None:
+            self.metrics.drop_timer(f"tenant.{dropped.label}")
+        # the timer update sits OUTSIDE the registry lock (the
+        # PlanRegistry ordering rule: registry lock, then metrics lock)
+        self.metrics.update_timer(f"tenant.{label}", float(duration_s))
+
+    # -- reads ---------------------------------------------------------------
+
+    def rows(self, sort: str = "time", n: int = 20) -> List[Dict[str, Any]]:
+        """Top ``n`` tenant rows by ``sort`` (time | calls | rows |
+        bad), latency summaries and trace-linked exemplars attached."""
+        if sort not in _SORTS:
+            raise ValueError(
+                f"unknown sort {sort!r} (one of {sorted(_SORTS)})"
+            )
+        with self._lock:
+            rows = [e.row() for e in self._entries.values()]
+        rows.sort(key=_SORTS[sort], reverse=True)
+        rows = rows[: max(0, int(n))]
+        _c, _g, timers, totals = self.metrics.snapshot()
+        for r in rows:
+            vals = timers.get(f"tenant.{r['tenant']}")
+            if vals:
+                r["latency"] = histogram_summary(
+                    vals,
+                    total_count=totals.get(
+                        f"tenant.{r['tenant']}", (None,)
+                    )[0],
+                )
+            ex = self.metrics.exemplars(f"tenant.{r['tenant']}")
+            if ex and ex.get("buckets"):
+                b = max(ex["buckets"])
+                s, tid, wall = ex["buckets"][b]
+                if tid:
+                    r["worst_exemplar"] = {
+                        "ms": round(s * 1000.0, 3),
+                        "trace_id": tid,
+                        "date_ms": int(wall),
+                    }
+        return rows
+
+    def top(self, n: int = 5) -> List[Dict[str, Any]]:
+        """Compact per-shard/timeline summary: the ``n`` hottest
+        tenants by total time."""
+        with self._lock:
+            es = sorted(
+                self._entries.values(), key=lambda e: e.total_s,
+                reverse=True,
+            )[: max(0, int(n))]
+            return [
+                {
+                    "tenant": e.label,
+                    "calls": e.calls,
+                    "bad": e.bad,
+                    "rows": e.rows,
+                    "total_ms": round(e.total_s * 1000.0, 3),
+                }
+                for e in es
+            ]
+
+    def totals(self) -> Dict[str, tuple]:
+        """{label: (calls, total_s, bad, {cls: (calls, bad)})} — the
+        timeline sampler diffs consecutive reads into per-tick tenant
+        deltas (which the per-tenant SLO burn folds, per class)."""
+        with self._lock:
+            return {
+                e.label: (
+                    e.calls, e.total_s, e.bad,
+                    {k: (v["calls"], v["bad"])
+                     for k, v in e.classes.items()},
+                )
+                for e in self._entries.values()
+            }
+
+    def payload(self, sort: str = "time", n: int = 20) -> Dict[str, Any]:
+        """The GET /debug/tenants body (single-store edition; the
+        sharded coordinator wraps this with its rollup)."""
+        return {
+            "enabled": enabled(),
+            "sort": sort,
+            "count": len(self),
+            "evicted": self.evicted,
+            "tenants": self.rows(sort=sort, n=n),
+        }
+
+
+def timeline_deltas(
+    registry: Optional[TenantRegistry],
+    prev: Dict[str, tuple],
+    n: int = 5,
+) -> Tuple[Dict[str, tuple], List[Dict[str, Any]]]:
+    """One timeline tick's tenant deltas: (new_prev, rows) — "who was
+    hot THIS second", with per-class call/bad splits so the per-tenant
+    SLO availability burn folds a spec's OWN class. Pure reads; an
+    absent registry returns no rows."""
+    if registry is None:
+        return prev, []
+    now = registry.totals()
+    rows = []
+    for label, (calls, total_s, bad, classes) in now.items():
+        pc, ps, pb, pcls = prev.get(label, (0, 0.0, 0, {}))
+        dc = calls - pc
+        if dc <= 0:
+            continue
+        dcls = {}
+        for k, (cc, cb) in classes.items():
+            oc, ob = pcls.get(k, (0, 0))
+            if cc - oc > 0:
+                dcls[k] = {"calls": cc - oc, "bad": cb - ob}
+        rows.append({
+            "tenant": label,
+            "calls": dc,
+            "ms": round((total_s - ps) * 1000.0, 3),
+            "bad": bad - pb,
+            "classes": dcls,
+        })
+    rows.sort(key=lambda r: r["ms"], reverse=True)
+    return now, rows[: max(0, int(n))]
+
+
+def history_rows(
+    registry: Optional[TenantRegistry], n: int = 10
+) -> List[Dict[str, Any]]:
+    """The durable-spool edition of the top-K (utils/history.py
+    ``tenants`` records): cumulative per-tenant calls / outcomes /
+    latency / rows / receipt — what a postmortem folds around a kill
+    instant. A slice of ``rows()``: exemplar pointers stay in memory."""
+    if registry is None:
+        return []
+    out = []
+    for r in registry.rows(sort="time", n=n):
+        out.append({
+            "tenant": r["tenant"],
+            "calls": r["calls"],
+            "outcomes": r["outcomes"],
+            "bad": r["bad"],
+            "rows": r["rows"],
+            "total_ms": r["total_ms"],
+            "receipt": r["receipt"],
+            "classes": r["classes"],
+        })
+    return out
+
+
+def merge_rows(row_lists: List[List[Dict[str, Any]]]) -> List[Dict[str, Any]]:
+    """Merge tenant rows from several registries (the fleet rollup):
+    numeric aggregates sum by label and the pad-ratio mean is
+    recomputed as an EXACT weighted mean from ``mean * count`` — the
+    utils/plans.merge_rows contract. Latency summaries and exemplars
+    are per-source and dropped (percentile reservoirs do not merge)."""
+    out: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    for rows in row_lists:
+        for r in rows:
+            label = r["tenant"]
+            m = out.get(label)
+            if m is None:
+                m = {k: v for k, v in r.items()
+                     if k not in ("latency", "worst_exemplar")}
+                m["outcomes"] = dict(r.get("outcomes", {}))
+                m["receipt"] = dict(r["receipt"])
+                m["classes"] = {
+                    k: dict(v) for k, v in r.get("classes", {}).items()
+                }
+                out[label] = m
+                continue
+            for k in ("calls", "bad", "rows"):
+                m[k] += r.get(k, 0)
+            m["total_ms"] = round(m["total_ms"] + r["total_ms"], 3)
+            for k, v in r.get("outcomes", {}).items():
+                m["outcomes"][k] = m["outcomes"].get(k, 0) + v
+            for k, v in r.get("classes", {}).items():
+                c = m["classes"].get(k)
+                if c is None:
+                    m["classes"][k] = dict(v)
+                else:
+                    c["calls"] += v.get("calls", 0)
+                    c["ms"] = round(c.get("ms", 0.0) + v.get("ms", 0.0), 3)
+                    c["bad"] = c.get("bad", 0) + v.get("bad", 0)
+            mr, rr = m["receipt"], r["receipt"]
+            pad_sum = (
+                mr["pad_ratio_mean"] * mr.get("pad_calls", 0)
+                + rr["pad_ratio_mean"] * rr.get("pad_calls", 0)
+            )
+            mr["pad_calls"] = mr.get("pad_calls", 0) + rr.get("pad_calls", 0)
+            mr["pad_ratio_mean"] = round(
+                pad_sum / max(mr["pad_calls"], 1), 4
+            )
+            for k in ("recompiles", "h2d_bytes", "d2h_bytes"):
+                mr[k] += rr.get(k, 0)
+    merged = list(out.values())
+    merged.sort(key=lambda r: r["total_ms"], reverse=True)
+    return merged

@@ -114,6 +114,7 @@ class TimelineSampler:
         self._prev_counters: Dict[str, int] = {}
         self._prev_totals: Dict[str, tuple] = {}
         self._prev_plans: Dict[str, tuple] = {}
+        self._prev_tenants: Dict[str, tuple] = {}
         self._primed = False
         self.ticks = 0  # cumulative, survives ring rotation
         self._lock = threading.Lock()
@@ -160,6 +161,15 @@ class TimelineSampler:
                 hist.on_tick(snap, self._store())
             except Exception:  # noqa: BLE001 - spool failures never stop ticks
                 _log.exception("history spool tick failed; recording continues")
+        # workload-capture drain (utils/workload.py): same write-behind
+        # posture, its OWN spool — history may be off while capture is
+        # on. create=False: a tick must never be what opens the spool.
+        try:
+            from geomesa_tpu.utils import workload as _workload
+
+            _workload.flush_for(self._store())
+        except Exception:  # noqa: BLE001 - spool failures never stop ticks
+            _log.exception("workload spool tick failed; recording continues")
         return snap
 
     def _tick(self) -> Dict[str, Any]:
@@ -230,6 +240,17 @@ class TimelineSampler:
                     # (the counter-delta rule above)
                     if prows and was_primed:
                         snap["plans"] = prows
+                # per-tick per-tenant deltas (utils/tenants.py): "whose
+                # traffic was THIS second" — same never-creates posture
+                treg = getattr(store, "_tenants", None)
+                if treg is not None:
+                    from geomesa_tpu.utils import tenants as _tenants
+
+                    self._prev_tenants, trows = _tenants.timeline_deltas(
+                        treg, self._prev_tenants
+                    )
+                    if trows and was_primed:
+                        snap["tenants"] = trows
                 extra = getattr(store, "_timeline_extra", None)
                 if extra is not None:
                     snap.update(extra())

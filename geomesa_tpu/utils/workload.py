@@ -1,0 +1,534 @@
+"""Workload capture: the replayable record of served traffic.
+
+Everything the observability tier records so far is *about* queries —
+receipts (PR 3), plan fingerprints (PR 11), timeline ticks (PR 10),
+durable history (PR 17). None of it records the queries THEMSELVES, so
+no knob change (batch window, hedge quantile, pyramid build threshold,
+shard deadline fraction) can ever be evaluated against the traffic that
+actually hit the store. This module is that missing instrument: with
+``geomesa.workload.enabled=1`` every admitted query / join / aggregate
+/ stream appends a **replayable descriptor** — type name, CQL, hints,
+query class, tenant label, monotonic arrival offset, in-flight
+concurrency at admission, outcome, plan-fingerprint id, cost receipt —
+to its own segment kind (``wl-*``) under ``<root>/_telemetry/``, and
+``scripts/replay_workload.py`` re-drives the captured stream against
+any store at recorded (or accelerated) pacing.
+
+The capture is a **pure observer**, enforced three ways:
+
+* **off is free** — the default. ``geomesa.workload.enabled=0`` leaves
+  ONE cached module-flag read on the hot path (the plans-registry
+  posture; the poisoned-spool test pins it).
+* **on never perturbs** — ``record()`` only builds a dict and queues it
+  in a bounded list: no I/O, no lock shared with execution, and any
+  internal failure is swallowed (counted ``workload.record.errors``).
+  Overflow past the queue bound drops the NEW record (counted
+  ``workload.dropped``) — the recorder may lose traffic, never delay
+  it.
+* **flush is off the query path** — the queue drains on the timeline
+  sampler's tick thread (or an explicit ``flush()``), span-wrapped
+  (``workload.append``), fault-injectable, and budget-bounded exactly
+  like the history spool; a dead telemetry disk re-queues bounded and
+  degrades to counted drops.
+
+Privacy: ``geomesa.workload.literals=0`` replaces every quoted CQL
+string literal with a salted hash (``'h:<12hex>'``) before anything is
+queued — capture keeps the workload *shape* without retaining
+user-supplied values. Hashed captures still replay structurally (the
+hashes parse as strings), but result-set comparison is meaningless for
+them; the replay harness marks such records and skips result hashing.
+
+Segments rotate at ``geomesa.workload.bytes`` (CRC-sealed via
+store/integrity.py) and age out after ``geomesa.workload.ttl``; the
+reader is ``utils/history.read_records`` pointed at the ``wl-`` prefix,
+so sealed-segment verification, corrupt-segment quarantine, and
+torn-line skipping are the one shared discipline.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextvars
+import hashlib
+import json
+import logging
+import os
+import re
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from geomesa_tpu.utils import deadline
+from geomesa_tpu.utils.audit import robustness_metrics
+
+_log = logging.getLogger("geomesa_tpu.workload")
+
+# the workload spool's own segment kind, beside history's "seg-" under
+# the same <root>/_telemetry/ directory (each reader filters by prefix,
+# so the two spools never see each other's segments)
+SEGMENT_PREFIX = "wl-"
+# write-behind queue bound: a wedged disk (or no sampler draining us)
+# degrades the RECORDING — drops, counted — never a query
+PENDING_CAP = 512
+# per-flush budget: the tick thread pays at most this for durability
+FLUSH_BUDGET_S = 0.5
+
+# -- the cached flag (the plans.enabled() posture) ----------------------------
+
+_ENABLED: Optional[bool] = None
+_LITERALS: Optional[bool] = None
+_FLAG_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """ONE cached read on the hot path — the entire cost of
+    ``geomesa.workload.enabled=0`` (default)."""
+    e = _ENABLED
+    if e is None:
+        e = _resolve()
+    return e
+
+
+def raw_literals() -> bool:
+    """Whether captured CQL keeps its raw literals (default) or hashes
+    them (``geomesa.workload.literals=0``). Cached beside the flag."""
+    if _ENABLED is None:
+        _resolve()
+    return bool(_LITERALS)
+
+
+def _resolve() -> bool:
+    global _ENABLED, _LITERALS
+    from geomesa_tpu.utils.config import (
+        WORKLOAD_ENABLED,
+        WORKLOAD_LITERALS,
+    )
+
+    with _FLAG_LOCK:
+        _LITERALS = bool(WORKLOAD_LITERALS.to_bool())
+        _ENABLED = bool(WORKLOAD_ENABLED.to_bool())
+        return _ENABLED
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Test hook (the plans.set_enabled contract): ``None`` re-resolves
+    from config on the next read, a bool forces."""
+    global _ENABLED, _LITERALS
+    with _FLAG_LOCK:
+        if value is None:
+            _ENABLED = None
+            _LITERALS = None
+        else:
+            # forcing the flag must still resolve the literals knob —
+            # a forced-on capture with _LITERALS left None would scrub
+            # every literal (None is falsy) against the raw default
+            from geomesa_tpu.utils.config import WORKLOAD_LITERALS
+
+            _ENABLED = bool(value)
+            _LITERALS = bool(WORKLOAD_LITERALS.to_bool())
+
+
+def workload_knobs() -> Tuple[bool, int, float]:
+    """(enabled, segment_bytes, ttl_s) from the geomesa.workload.* tier;
+    explicit zeros honored (the history_knobs contract)."""
+    from geomesa_tpu.utils.config import (
+        WORKLOAD_BYTES,
+        WORKLOAD_ENABLED,
+        WORKLOAD_TTL,
+    )
+
+    en = bool(WORKLOAD_ENABLED.to_bool())
+    b = WORKLOAD_BYTES.to_bytes()
+    seg_bytes = (1 << 20) if b is None else int(b)
+    t = WORKLOAD_TTL.to_duration_s()
+    ttl_s = 24 * 3600.0 if t is None else float(t)
+    return en, seg_bytes, ttl_s
+
+
+# -- op nesting ---------------------------------------------------------------
+
+# context-local operation depth (the admission reentrancy idiom): a
+# join's inner build/probe queries and an aggregate's exact-fallback
+# query audit themselves too, so their captures would double when the
+# replay harness re-drives the OUTER op. Depth > 1 at record time marks
+# the descriptor ``nested`` — metered and counted like everything else,
+# but never directly re-driven.
+_OP_DEPTH: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "workload_op_depth", default=0
+)
+
+
+def op_begin() -> "contextvars.Token[int]":
+    """Mark entry into a public store operation (query / aggregate /
+    join / stream). Pair with ``op_end(token)`` in a finally."""
+    return _OP_DEPTH.set(_OP_DEPTH.get() + 1)
+
+
+def op_end(token: "contextvars.Token[int]") -> None:
+    _OP_DEPTH.reset(token)
+
+
+def nested() -> bool:
+    """True when the current context is inside an OUTER store op."""
+    return _OP_DEPTH.get() > 1
+
+
+# -- literal scrubbing --------------------------------------------------------
+
+# quoted CQL string literals, '' being the escaped quote — the only
+# place user-supplied VALUES appear in the normalized to_cql form
+# (numbers in geometric/temporal predicates are shapes, kept: the
+# workload's spatial structure IS the signal the knob lab needs)
+_LITERAL_RE = re.compile(r"'(?:[^']|'')*'")
+# per-process salt: equal literals stay equal WITHIN a capture (the
+# workload shape survives), but the hash is not a dictionary lookup
+_SALT = os.urandom(8).hex()
+
+
+def scrub_cql(cql: str) -> str:
+    """Replace every quoted string literal with ``'h:<12hex>'`` of its
+    salted hash — capture without retaining user-supplied values."""
+
+    def _sub(m: "re.Match[str]") -> str:
+        h = hashlib.sha1(
+            (_SALT + m.group(0)).encode("utf-8")
+        ).hexdigest()[:12]
+        return f"'h:{h}'"
+
+    return _LITERAL_RE.sub(_sub, cql)
+
+
+# -- the spool ----------------------------------------------------------------
+
+
+class WorkloadSpool:
+    """One process's workload-capture spool under ``<root>/_telemetry``
+    (``wl-*`` segments). The HistorySpool write-behind discipline minus
+    the black box / live markers / sentry — capture is a log, not a
+    crash recorder. ``append()`` only queues (bounded, never blocks,
+    never raises); ``flush()`` runs on the sampler-tick thread under
+    the ``workload.append`` span/fault-point/deadline discipline."""
+
+    def __init__(self, root: str, owner: str = ""):
+        from geomesa_tpu.utils.history import TELEMETRY_DIR
+
+        self.root = os.path.abspath(root)
+        self.dir = os.path.join(self.root, TELEMETRY_DIR)
+        self.owner = owner or f"pid{os.getpid()}"
+        _en, self.seg_bytes, self.ttl_s = workload_knobs()
+        self._lock = threading.Lock()
+        self._pending: List[Dict[str, Any]] = []
+        self._active: Optional[str] = None
+        self._active_size = 0
+        self._seq = 0
+        self._closed = False
+        # the capture epoch: every record's `off` is monotonic seconds
+        # since this instant — recorded pacing, immune to wall clock
+        # jumps, exactly what open-loop replay re-sleeps
+        self.epoch = time.monotonic()
+        self.epoch_t = time.time()
+        os.makedirs(self.dir, exist_ok=True)
+        atexit.register(self._atexit)
+
+    def append(self, record: Dict[str, Any]) -> None:
+        """Queue one descriptor (bounded; DROPS past the cap, counted
+        ``workload.dropped``). Safe from any thread; never blocks on
+        I/O, never raises — the only call a query thread ever makes."""
+        with self._lock:
+            if self._closed or len(self._pending) >= PENDING_CAP:
+                if not self._closed:
+                    robustness_metrics().inc("workload.dropped")
+                return
+            self._pending.append(record)
+
+    def flush(self) -> int:
+        """Drain the queue to the active segment: span-wrapped,
+        fault-injectable, budget-bounded — a wedged disk costs the tick
+        at most ``FLUSH_BUDGET_S`` and the batch re-queues (bounded)
+        for the next tick. Returns records written."""
+        from geomesa_tpu.utils import faults, trace
+
+        with self._lock:
+            if self._closed or not self._pending:
+                return 0
+            batch, self._pending = self._pending, []
+        try:
+            with trace.span("workload.append") as sp:
+                with deadline.budget(FLUSH_BUDGET_S):
+                    deadline.check("workload.append")
+                    faults.fault_point("workload.append")
+                    n = self._write(batch)
+                sp.set_attr("records", n)
+            return n
+        except Exception as e:  # noqa: BLE001 - capture degrades, never raises
+            robustness_metrics().inc("workload.append.errors")
+            _log.debug("workload flush failed, re-queueing: %s", e)
+            with self._lock:
+                merged = batch + self._pending
+                dropped = len(merged) - PENDING_CAP
+                if dropped > 0:
+                    # oldest-first drop: the tail is closest to "now"
+                    merged = merged[dropped:]
+                    robustness_metrics().inc("workload.dropped", dropped)
+                self._pending = merged
+            return 0
+
+    def _write(self, batch: List[Dict[str, Any]]) -> int:
+        if self._active is None:
+            # the sequence suffix keeps two rotations inside the same
+            # millisecond from reusing a SEALED segment's name (an
+            # append past its CRC footer would corrupt it)
+            self._seq += 1
+            self._active = os.path.join(
+                self.dir,
+                f"{SEGMENT_PREFIX}{int(time.time() * 1000)}"
+                f"-{os.getpid()}-{self._seq}.jsonl",
+            )
+            self._active_size = 0
+        data = b"".join(
+            json.dumps(rec, default=str).encode("utf-8") + b"\n"
+            for rec in batch
+        )
+        with open(self._active, "ab") as fh:
+            fh.write(data)
+        self._active_size += len(data)
+        if self.seg_bytes and self._active_size >= self.seg_bytes:
+            self._rotate()
+        return len(batch)
+
+    def _rotate(self) -> None:
+        """Seal (CRC footer — the reader verifies) and sweep."""
+        from geomesa_tpu.store import integrity
+
+        sealed, self._active = self._active, None
+        self._active_size = 0
+        try:
+            integrity.append_crc_footer(sealed)
+            integrity.fsync_dir(self.dir)
+        except OSError:
+            robustness_metrics().inc("workload.append.errors")
+        robustness_metrics().inc("workload.segments.sealed")
+        self._sweep()
+
+    def _sweep(self) -> None:
+        if not self.ttl_s:
+            return
+        cutoff = time.time() - self.ttl_s
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith(SEGMENT_PREFIX)
+                    and name.endswith(".jsonl")):
+                continue
+            path = os.path.join(self.dir, name)
+            if path == self._active:
+                continue
+            try:
+                if os.stat(path).st_mtime < cutoff:
+                    os.remove(path)
+                    robustness_metrics().inc("workload.segments.expired")
+            except OSError:
+                continue
+
+    def close(self) -> None:
+        """Drain and seal; idempotent (also the atexit path)."""
+        from geomesa_tpu.store import integrity
+
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            batch, self._pending = self._pending, []
+            active = self._active
+            self._active = None
+        try:
+            if batch:
+                self._active = active  # resume (or open) for the drain
+                self._write(batch)
+                active, self._active = self._active, None
+        except OSError:
+            robustness_metrics().inc("workload.append.errors")
+        try:
+            if active and os.path.exists(active):
+                integrity.append_crc_footer(active)
+            integrity.fsync_dir(self.dir)
+        except OSError:
+            pass
+
+    def _atexit(self) -> None:
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    def segments(self) -> List[str]:
+        try:
+            return sorted(
+                n for n in os.listdir(self.dir)
+                if n.startswith(SEGMENT_PREFIX) and n.endswith(".jsonl")
+            )
+        except OSError:
+            return []
+
+    def info(self) -> Dict[str, Any]:
+        counters, _g, _t, _tt = robustness_metrics().snapshot()
+        return {
+            "dir": self.dir,
+            "owner": self.owner,
+            "segments": len(self.segments()),
+            "pending": len(self._pending),
+            "dropped": counters.get("workload.dropped", 0),
+        }
+
+
+# -- per-store spools (the history.spool_for arrangement) ---------------------
+
+_SPOOLS: "weakref.WeakKeyDictionary[Any, WorkloadSpool]" = (
+    weakref.WeakKeyDictionary()
+)
+_SPOOLS_LOCK = threading.Lock()
+
+
+def open_spool(root: str, owner: str = "") -> Optional[WorkloadSpool]:
+    """A spool at an explicit root, or None when capture is off / the
+    directory cannot be created — disabled capture must cost nothing
+    and break nothing."""
+    if not enabled() or not root:
+        return None
+    try:
+        return WorkloadSpool(root, owner=owner)
+    except OSError:
+        _log.warning("workload spool unavailable at %s", root,
+                     exc_info=True)
+        return None
+
+
+def spool_for(store: Any, create: bool = True) -> Optional[WorkloadSpool]:
+    """The store's capture spool, keyed weakly; only stores with a
+    durable ``root`` can capture — everything else answers None."""
+    root = getattr(store, "root", None)
+    if not isinstance(root, str) or not root:
+        return None
+    with _SPOOLS_LOCK:
+        got = _SPOOLS.get(store)
+        if got is not None or not create:
+            return got
+        sp = open_spool(root, owner=type(store).__name__)
+        if sp is not None:
+            _SPOOLS[store] = sp
+        return sp
+
+
+def flush_for(store: Any) -> None:
+    """The tick-thread drain hook (utils/timeline.py): flush an
+    EXISTING spool only — a sampler tick must never be what opens one
+    (the engine_for create=False posture)."""
+    sp = spool_for(store, create=False)
+    if sp is not None:
+        sp.flush()
+
+
+# -- the hot-path hook --------------------------------------------------------
+
+
+def record(
+    store: Any,
+    cls: str,
+    type_name: str,
+    *,
+    query: Any = None,
+    cql: Optional[str] = None,
+    tenant: str = "anon",
+    inflight: int = 0,
+    outcome: str = "ok",
+    fingerprint: str = "",
+    receipt: Optional[Dict[str, Any]] = None,
+    duration_s: float = 0.0,
+    rows: int = 0,
+    extra: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Capture one served request. Called from the datastore observe
+    seams INSIDE the admission slot (so ``inflight`` reflects the
+    concurrency the query actually ran under). Pure-observer contract:
+    when capture is off this is ONE cached flag read; when on, any
+    internal failure is swallowed (counted ``workload.record.errors``)
+    — the recorder may lose a record, never perturb a query."""
+    if not enabled():
+        return
+    try:
+        sp = spool_for(store)
+        if sp is None:
+            return
+        text = cql
+        hints: Dict[str, Any] = {}
+        max_features = None
+        if query is not None:
+            if text is None:
+                from geomesa_tpu.filter.parser import to_cql
+
+                text = to_cql(query.filter)
+            hints = {
+                k: v for k, v in (query.hints or {}).items()
+                if k != "tenant"  # travels in its own field
+            }
+            max_features = query.max_features
+        literals = "raw"
+        if text is not None and not raw_literals():
+            text = scrub_cql(text)
+            literals = "hashed"
+        rec: Dict[str, Any] = {
+            "kind": "workload",
+            "t": time.time(),
+            "off": round(time.monotonic() - sp.epoch, 6),
+            "cls": cls,
+            "type": type_name,
+            "cql": text,
+            "tenant": tenant,
+            "inflight": int(inflight),
+            "outcome": outcome,
+            "fingerprint": fingerprint,
+            "ms": round(float(duration_s) * 1000.0, 3),
+            "rows": int(rows),
+            "literals": literals,
+        }
+        if hints:
+            rec["hints"] = hints
+        if max_features is not None:
+            rec["max"] = int(max_features)
+        if receipt:
+            rec["receipt"] = dict(receipt)
+        if extra:
+            rec.update(extra)
+        if nested():
+            # an inner op of the outer record above it — replay drives
+            # the outer one; re-driving this too would double it
+            rec["nested"] = 1
+        sp.append(rec)
+    except Exception:  # noqa: BLE001 - a capture bug must never fail a query
+        robustness_metrics().inc("workload.record.errors")
+        _log.debug("workload record failed", exc_info=True)
+
+
+# -- the reader ---------------------------------------------------------------
+
+
+def read_workload(
+    root: str,
+    s: Optional[float] = None,
+    until: Optional[float] = None,
+    limit: Optional[int] = None,
+) -> Tuple[List[Dict[str, Any]], bool]:
+    """Captured descriptors under ``<root>/_telemetry`` (``wl-*``),
+    oldest first, via the shared verified reader — sealed-segment CRC
+    checks, corrupt-segment quarantine (``workload.segments.corrupt``),
+    torn-line skips (``workload.torn``). Disk-only: a SIGKILLed
+    process's capture reads the same as a live one."""
+    from geomesa_tpu.utils import history as _history
+
+    return _history.read_records(
+        root, s=s, until=until, limit=limit,
+        prefix=SEGMENT_PREFIX, counter_ns="workload",
+    )

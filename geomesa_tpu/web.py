@@ -72,6 +72,15 @@ SURVEY.md section 2.5). Endpoints over a datastore:
                                     tallies; sort=time|calls|hits|
                                     misestimate; per-shard rollup +
                                     merged table on sharded stores
+    GET /debug/tenants?n=&sort=  -- per-tenant cost metering
+                                    (utils/tenants.py): calls/outcomes,
+                                    latency, rows, device receipts, and
+                                    per-class splits by tenant label
+                                    (the ``tenant`` query hint or the
+                                    X-Geomesa-Tenant header; hint wins);
+                                    sort=time|calls|rows|bad; per-shard
+                                    rollup + merged table on sharded
+                                    stores
     GET /debug/fleet             -- multi-host serving tier
                                     (parallel/fleet.py): supervisor
                                     membership states, per-worker pids/
@@ -242,6 +251,49 @@ def debug_slo_payload(store):
 MAX_DEBUG_PLANS = 1000
 
 
+# -- shared query-param validation -------------------------------------------
+#
+# ONE contract for every debug surface (traces/timeline/history/plans/
+# tenants — previously hand-rolled per route, drift waiting to happen):
+# non-numeric and negative are the CALLER's error (400); absurdly large
+# clamps — the backing rings/registries are bounded anyway, the clamp
+# only stops an accidental ?n=1e12 from serializing a response nobody
+# asked for. Pure functions returning (value, None) or (None, error) so
+# they unit-test without a socket; the handler wrappers turn the error
+# into the 400 response.
+
+
+def parse_count_param(params, cap: int, default_n: int = 20):
+    """Validate ``?n=`` (row/tree count): (n, None) or (None, error)."""
+    try:
+        n = int(params.get("n", default_n))
+    except ValueError:
+        return None, "n must be an integer"
+    if n < 0:
+        return None, "n must be >= 0"
+    return min(n, cap), None
+
+
+def parse_window_param(params, default_s: float, cap_s: float = MAX_TIMELINE_S):
+    """Validate ``?s=`` (window seconds): (s, None) or (None, error)."""
+    try:
+        s = float(params.get("s", default_s))
+    except ValueError:
+        return None, "s must be a number of seconds"
+    if not (s >= 0):  # rejects NaN too ('nan < 0' is False)
+        return None, "s must be >= 0"
+    return min(s, cap_s), None
+
+
+def parse_sort_param(params, sorts, default: str = "time"):
+    """Validate ``?sort=`` against a whitelist tuple: (sort, None) or
+    (None, error)."""
+    sort = params.get("sort", default)
+    if sort not in sorts:
+        return None, f"sort must be one of {list(sorts)}"
+    return sort, None
+
+
 def debug_fleet_payload(store):
     """The multi-host serving tier (parallel/fleet.py): supervisor
     membership states, per-worker pids/restart counts, placement moves,
@@ -323,6 +375,25 @@ def debug_plans_payload(store, n: int = 20, sort: str = "time"):
     return out
 
 
+def debug_tenants_payload(store, n: int = 20, sort: str = "time"):
+    """``GET /debug/tenants?n=&sort=``: the per-tenant cost meter
+    (utils/tenants.py) — calls/outcomes/latency/rows/receipt sums and
+    per-class splits by tenant label, plus the sharded rollup on
+    coordinators (the /debug/plans contract, keyed by label)."""
+    from geomesa_tpu.utils import tenants as _tenants
+
+    obj = getattr(store, "_tenants_obj", None)
+    if obj is None:
+        return {"enabled": _tenants.enabled(), "count": 0, "tenants": []}
+    out = obj().payload(sort=sort, n=n)
+    rollup = getattr(store, "tenants_rollup", None)
+    if rollup is not None:
+        shards, merged = rollup(n=n)
+        out["shards"] = shards
+        out["merged"] = merged
+    return out
+
+
 # every /debug/* surface, by route name — the /debug/report bundle
 # assembles ALL of them (lint rule 4 pins the closure). Values take
 # (store, window_s); surfaces without a window ignore it.
@@ -334,6 +405,7 @@ REPORT_SECTIONS = {
     "timeline": lambda store, s: debug_timeline_payload(store, s),
     "slo": lambda store, s: debug_slo_payload(store),
     "plans": lambda store, s: debug_plans_payload(store, 10),
+    "tenants": lambda store, s: debug_tenants_payload(store, 10),
     "fleet": lambda store, s: debug_fleet_payload(store),
     "history": lambda store, s: debug_history_payload(store, s),
 }
@@ -454,7 +526,7 @@ def make_handler(store):
             from geomesa_tpu.arrow.vector import iter_ipc
             from geomesa_tpu.index.planner import Query
 
-            q = Query.cql(cql)
+            q = self._apply_tenant(Query.cql(cql))
             if max_features is not None:
                 q.max_features = int(max_features)
             chunks = iter_ipc(store.query_stream(
@@ -473,21 +545,44 @@ def make_handler(store):
             self._write_chunk(b"")  # terminating 0-chunk: stream complete
             self._streaming = False
 
+        def _apply_tenant(self, q):
+            """``X-Geomesa-Tenant`` header -> ``tenant`` query hint for
+            the per-tenant meter (utils/tenants.py). setdefault: a hint
+            the caller set explicitly WINS over the transport header;
+            neither present means the meter's ``anon`` default."""
+            hdr = self.headers.get("X-Geomesa-Tenant")
+            if hdr:
+                q.hints.setdefault("tenant", hdr)
+            return q
+
         def _window_param(self, params, default_s: float):
             """Validate the ?s= window (seconds) for the timeline/report
-            routes: non-numeric or negative answers 400 and returns
-            None; absurdly large clamps (the ring is bounded anyway)."""
-            try:
-                s = float(params.get("s", default_s))
-            except ValueError:
-                self._send(
-                    400, json.dumps({"error": "s must be a number of seconds"})
-                )
+            routes via the shared contract: sends the 400 and returns
+            None on a caller error."""
+            s, err = parse_window_param(params, default_s)
+            if err is not None:
+                self._send(400, json.dumps({"error": err}))
                 return None
-            if not (s >= 0):  # rejects NaN too ('nan < 0' is False)
-                self._send(400, json.dumps({"error": "s must be >= 0"}))
+            return s
+
+        def _count_param(self, params, cap: int, default_n: int = 20):
+            """Validate the ?n= count for the traces/plans/tenants
+            routes via the shared contract: sends the 400 and returns
+            None on a caller error."""
+            n, err = parse_count_param(params, cap, default_n)
+            if err is not None:
+                self._send(400, json.dumps({"error": err}))
                 return None
-            return min(s, MAX_TIMELINE_S)
+            return n
+
+        def _sort_param(self, params, sorts):
+            """Validate the ?sort= whitelist via the shared contract:
+            sends the 400 and returns None on a caller error."""
+            sort, err = parse_sort_param(params, sorts)
+            if err is not None:
+                self._send(400, json.dumps({"error": err}))
+                return None
+            return sort
 
         def _write_chunk(self, data: bytes) -> None:
             self.wfile.write(f"{len(data):x}\r\n".encode())
@@ -614,7 +709,7 @@ def make_handler(store):
                         return
                     from geomesa_tpu.index.planner import Query
 
-                    q = Query.cql(body.get("cql", "INCLUDE"))
+                    q = self._apply_tenant(Query.cql(body.get("cql", "INCLUDE")))
                     if body.get("max") is not None:
                         try:
                             q.max_features = int(body["max"])
@@ -635,11 +730,25 @@ def make_handler(store):
                 body = self._read_json_body()
                 if body is None:
                     return
+                from geomesa_tpu.index.planner import Query
+
                 try:
                     bspec = body["build"]
                     pspec = body["probe"]
-                    build = (bspec["name"], bspec.get("cql", "INCLUDE"))
-                    probe = (pspec["name"], pspec.get("cql", "INCLUDE"))
+                    # Query objects (not raw CQL) so the tenant header
+                    # can ride the hints into the join's meter record
+                    build = (
+                        bspec["name"],
+                        self._apply_tenant(
+                            Query.cql(bspec.get("cql", "INCLUDE"))
+                        ),
+                    )
+                    probe = (
+                        pspec["name"],
+                        self._apply_tenant(
+                            Query.cql(pspec.get("cql", "INCLUDE"))
+                        ),
+                    )
                 except (KeyError, TypeError):
                     self._send(
                         400,
@@ -721,7 +830,7 @@ def make_handler(store):
                             params.get("max"),
                         )
                         return
-                    q = Query.cql(params.get("cql", "INCLUDE"))
+                    q = self._apply_tenant(Query.cql(params.get("cql", "INCLUDE")))
                     if "max" in params:
                         q.max_features = int(params["max"])
                     res = store.query(name, q)
@@ -743,10 +852,10 @@ def make_handler(store):
                         f"bbox({geom}, {env[0]!r}, {env[1]!r}, {env[2]!r}, {env[3]!r})"
                     )
                     user_cql = params.get("cql", "INCLUDE")
-                    q = Query.cql(
+                    q = self._apply_tenant(Query.cql(
                         bbox_cql if user_cql == "INCLUDE"
                         else f"({bbox_cql}) AND ({user_cql})"
-                    )
+                    ))
                     q.hints["density"] = {
                         "envelope": tuple(env),
                         "width": int(params.get("width", 256)),
@@ -763,7 +872,7 @@ def make_handler(store):
                     from geomesa_tpu.index.planner import Query
 
                     name = params["name"]
-                    q = Query.cql(params.get("cql", "INCLUDE"))
+                    q = self._apply_tenant(Query.cql(params.get("cql", "INCLUDE")))
                     q.hints["bin"] = {
                         "track": params.get("track", "id"),
                         "sort": params.get("sort", "").lower() == "true",
@@ -950,23 +1059,11 @@ def make_handler(store):
                         body["status"] = "degraded"
                     self._send(200, json.dumps(body))
                 elif route == "/debug/traces":
-                    # validate ?n= rather than bubbling a 500: non-numeric
-                    # and negative are the CALLER's error (400); absurdly
-                    # large just clamps — the ring is bounded anyway and a
-                    # huge JSON dump would only hurt the server
-                    try:
-                        n = int(params.get("n", 20))
-                    except ValueError:
-                        self._send(
-                            400, json.dumps({"error": "n must be an integer"})
-                        )
+                    # ?n= validated by the shared contract (400 on the
+                    # caller's error, clamp on absurd sizes)
+                    n = self._count_param(params, MAX_DEBUG_TRACES)
+                    if n is None:
                         return
-                    if n < 0:
-                        self._send(
-                            400, json.dumps({"error": "n must be >= 0"})
-                        )
-                        return
-                    n = min(n, MAX_DEBUG_TRACES)
                     self._send(
                         200,
                         json.dumps(debug_traces_payload(store, n), default=str),
@@ -1062,36 +1159,39 @@ def make_handler(store):
                     # query fingerprints — calls/outcomes/latency, rows,
                     # receipts, estimate-vs-actual misestimate, decision
                     # tallies — sortable; per-shard rollup when sharded.
-                    # Param contract mirrors /debug/traces?n=: caller
-                    # errors answer 400, absurd sizes clamp
-                    try:
-                        n = int(params.get("n", 20))
-                    except ValueError:
-                        self._send(
-                            400, json.dumps({"error": "n must be an integer"})
-                        )
+                    # ?n=/?sort= validated by the shared contract
+                    n = self._count_param(params, MAX_DEBUG_PLANS)
+                    if n is None:
                         return
-                    if n < 0:
-                        self._send(
-                            400, json.dumps({"error": "n must be >= 0"})
-                        )
-                        return
-                    n = min(n, MAX_DEBUG_PLANS)
                     from geomesa_tpu.utils.plans import SORTS
 
-                    sort = params.get("sort", "time")
-                    if sort not in SORTS:
-                        self._send(
-                            400,
-                            json.dumps({"error": (
-                                f"sort must be one of {list(SORTS)}"
-                            )}),
-                        )
+                    sort = self._sort_param(params, SORTS)
+                    if sort is None:
                         return
                     self._send(
                         200,
                         json.dumps(
                             debug_plans_payload(store, n, sort), default=str
+                        ),
+                    )
+                elif route == "/debug/tenants":
+                    # per-tenant cost metering (utils/tenants.py): who
+                    # is spending the store's time/device budget —
+                    # calls/outcomes/latency/rows/receipts by tenant
+                    # label, per-class splits, sharded rollup. Same
+                    # ?n=/?sort= contract as /debug/plans
+                    n = self._count_param(params, MAX_DEBUG_PLANS)
+                    if n is None:
+                        return
+                    from geomesa_tpu.utils.tenants import SORTS
+
+                    sort = self._sort_param(params, SORTS)
+                    if sort is None:
+                        return
+                    self._send(
+                        200,
+                        json.dumps(
+                            debug_tenants_payload(store, n, sort), default=str
                         ),
                     )
                 elif route == "/debug/report":

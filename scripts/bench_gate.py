@@ -541,6 +541,12 @@ def run_stream(n: int, reps: int) -> dict:
         # (which shape got slow, how wrong its cost estimate was, which
         # decisions fired) instead of a bare number
         "plans": {"top": store._plans_obj().rows(sort="time", n=10)},
+        # top tenants of the measured stream (utils/tenants.py — not
+        # gated): a regressed band arrives knowing WHOSE traffic paid
+        # for the regression. The synthetic bench runs untagged, so
+        # this is normally one "anon" row — real value shows when the
+        # gate replays captured traffic (scripts/replay_workload.py)
+        "tenants": {"top": store._tenants_obj().top(5)},
         "config": {
             "n": n,
             "reps": reps,

@@ -84,6 +84,18 @@
 #     standby adopt the orphaned workers, replay the pending intent,
 #     and answer the post-op result set with every partition primary-
 #     owned and zero divergent workers
+#   - workload capture purity under faults (tests/test_workload.py):
+#     for workload.append x error/drop/latency x seed schedules, every
+#     query answers byte-identically to the capture-off run — the
+#     recorder may LOSE records (counted workload.dropped), never
+#     perturb an answer or surface an error to the query path; and a
+#     replay of a clean capture re-captures the EXACT per-fingerprint
+#     call counts (nested inner ops regenerate, never double-drive)
+#   - SIGKILLed capture replays (tests/test_workload.py): a real
+#     SIGKILL of a capturing process mid-run leaves CRC-sealed wl-*
+#     segments that load_records reads cleanly (torn tail skipped),
+#     and scripts/replay_workload.py drives the surviving records
+#     against a reopened store with the captured row counts
 #   - durable telemetry survives both kills (tests/test_fleet.py, both
 #     SIGKILL legs): after the REAL worker SIGKILL the victim's spool
 #     (<root>/workers/w<i>/_telemetry) is readable — pre-kill ticks
@@ -105,6 +117,7 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_crash.py tests/test_shards.py \
     tests/test_join.py tests/test_agg_cache.py tests/test_timeline.py \
     tests/test_plans.py tests/test_spmd_coalesce.py \
+    tests/test_workload.py \
     -q -m chaos -p no:cacheprovider "$@" || rc=$?
 # the real-SIGKILL fleet soak spawns worker PROCESSES: bounded on its
 # own so a wedged spawn can never eat the in-process soaks' budget

@@ -40,6 +40,7 @@ declare -A SPANS=(
     ["fleet.lease"]="geomesa_tpu/parallel/fleet.py"
     ["fleet.fanout"]="geomesa_tpu/parallel/fleet.py"
     ["history.append"]="geomesa_tpu/utils/history.py"
+    ["workload.append"]="geomesa_tpu/utils/workload.py"
 )
 for point in "${!SPANS[@]}"; do
     file="${SPANS[$point]}"
@@ -142,7 +143,7 @@ done
 #    debug plane must keep every per-worker section the incident report
 #    promises.
 FLEET=geomesa_tpu/parallel/fleet.py
-for op in op_telemetry op_timeline op_debug op_plans op_history; do
+for op in op_telemetry op_timeline op_debug op_plans op_history op_tenants; do
     if ! grep -qE "def ${op}\(" "$FLEET"; then
         echo "FAIL: ${FLEET} lost its worker-side ${op}() handler"
         echo "      (the fleet debug plane serves it — see _WorkerState)"
@@ -167,9 +168,10 @@ if ! printf '%s\n' "$hist_body" | grep -q '_passive_budget_s()'; then
     echo "      must cost at most the debug budget"
     fail=1
 fi
-if [ "$(grep -c 'deadline.budget(_passive_budget_s())' "$FLEET")" -lt 6 ]; then
+if [ "$(grep -c 'deadline.budget(_passive_budget_s())' "$FLEET")" -lt 8 ]; then
     echo "FAIL: ${FLEET} lost passive-budget pairing on its observation"
-    echo "      RPCs (telemetry/timeline/debug/history + the _PlansProxy reads)"
+    echo "      RPCs (telemetry/timeline/debug/history/tenants + the"
+    echo "      _PlansProxy reads)"
     fail=1
 fi
 for reason in over_budget trailer_failed decode_failed worker_lost; do
@@ -179,7 +181,7 @@ for reason in over_budget trailer_failed decode_failed worker_lost; do
         fail=1
     fi
 done
-for sec in traces device overload recovery plans; do
+for sec in traces device overload recovery plans tenants; do
     if ! grep -q "(\"${sec}\", _${sec})" "$FLEET"; then
         echo "FAIL: worker debug plane in ${FLEET} lost its '${sec}' section"
         echo "      (op_debug must keep every per-worker section the"

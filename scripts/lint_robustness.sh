@@ -144,6 +144,22 @@ for point in history.append; do
     fi
 done
 
+# the workload-capture flush seam is pinned for the same reason as
+# history.append: the recorder's segment append (utils/workload.py)
+# must stay injectable so chaos runs can prove a capture-disk failure
+# NEVER changes a query's answer or latency class (capture is
+# budget-bounded and drops count workload.dropped instead of raising)
+for point in workload.append; do
+    if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/utils/workload.py; then
+        echo "FAIL: geomesa_tpu/utils/workload.py lost the '${point}' fault point"
+        echo "      (the workload-capture contract: a recorder flush failure is"
+        echo "       absorbed — counted as workload.dropped — never surfaced"
+        echo "       to the query path; faults.fault_point(\"${point}\")"
+        echo "       beside a deadline check; see utils/faults.py)"
+        fail=1
+    fi
+done
+
 # multi-file mutation sites in the store tier must declare a
 # write-ahead intent before touching files (crash-consistency contract)
 while IFS= read -r f; do

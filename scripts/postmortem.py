@@ -20,7 +20,12 @@ takeover) or an operator on a corpse can always get:
 * sentry verdicts (perf regressions that tripped or cleared);
 * unclean-shutdown evidence: stale live markers, black boxes, and
   ``unclean_start`` records;
-* cross-worker fan-out intents still owing a roll-forward replay.
+* cross-worker fan-out intents still owing a roll-forward replay;
+* the window's captured workload (``wl-*`` segments, utils/workload.py)
+  — request mix by class/tenant plus the final pre-kill tail — and the
+  last per-tenant cost table, so "who was asking what when it died" is
+  answerable and the victim's traffic is replayable
+  (scripts/replay_workload.py).
 
 Exit code 0 with a human summary (or ``--json`` for the full artifact).
 """
@@ -53,6 +58,7 @@ def _fold(records):
         "transitions": [],
         "unclean_starts": [],
         "decisions": {},
+        "last_tenants": None,
     }
     counters = out["counters"]
     timers = out["timers"]
@@ -92,7 +98,46 @@ def _fold(records):
         elif kind == "decision":
             for k, v in (rec.get("tallies") or {}).items():
                 out["decisions"][k] = out["decisions"].get(k, 0) + int(v)
+        elif kind == "tenants":
+            # cumulative registry snapshots (history._record_tenants);
+            # the LAST one in the window is the state at death
+            out["last_tenants"] = {"t": t, "rows": rec.get("rows") or []}
     return out
+
+
+def _fold_workload(root, lo, u):
+    """The window's captured workload (utils/workload.py ``wl-*``
+    segments), summarized: what request mix was the process serving
+    when it died. ``None`` when capture was off (no segments)."""
+    from geomesa_tpu.utils import workload
+
+    recs, truncated = workload.read_workload(root, s=lo, until=u)
+    if not recs:
+        return None
+    by_class, by_tenant, errors = {}, {}, 0
+    for r in recs:
+        if r.get("nested"):
+            continue
+        by_class[r.get("cls", "?")] = by_class.get(r.get("cls", "?"), 0) + 1
+        lab = r.get("tenant", "anon")
+        by_tenant[lab] = by_tenant.get(lab, 0) + 1
+        if r.get("outcome", "ok") != "ok":
+            errors += 1
+    return {
+        "records": len(recs),
+        "truncated": truncated,
+        "by_class": by_class,
+        "by_tenant": by_tenant,
+        "errors": errors,
+        # the final requests before the window's end — the "what was
+        # in flight at the kill instant" tail, replayable as-is
+        "last": [
+            {k: r.get(k) for k in
+             ("t", "cls", "type", "cql", "tenant", "outcome", "ms",
+              "fingerprint")}
+            for r in recs[-5:]
+        ],
+    }
 
 
 def _worker_roots(root):
@@ -141,10 +186,12 @@ def reconstruct(root, s=None, until=None):
     u = time.time() if until is None else float(until)
     lo = (u - 300.0) if s is None else float(s)
     crecs, _ = history.read_records(root, s=lo, until=u)
+    cfold = _fold(crecs)
+    cfold["workload"] = _fold_workload(root, lo, u)
     out = {
         "root": root,
         "window": {"s": lo, "until": u},
-        "coordinator": _fold(crecs),
+        "coordinator": cfold,
         "workers": {},
         "pending_fanouts": _pending_fanouts(root),
         "blackboxes": [
@@ -165,6 +212,7 @@ def reconstruct(root, s=None, until=None):
     for wid, wroot in _worker_roots(root).items():
         wrecs, _ = history.read_records(wroot, s=lo, until=u)
         fold = _fold(wrecs)
+        fold["workload"] = _fold_workload(wroot, lo, u)
         fold["blackboxes"] = [
             b.get("file") for b in history.blackboxes(wroot)
         ]
@@ -226,6 +274,23 @@ def _print_summary(pm):
                     if ev.get("state") == "regressed" else ""
                 )
             )
+        wl = fold.get("workload")
+        if wl:
+            mix = ", ".join(
+                f"{k}={v}" for k, v in sorted(wl["by_class"].items())
+            )
+            print(
+                f"    workload capture: {wl['records']} records"
+                f" ({mix}), {wl['errors']} errors — replayable via"
+                " scripts/replay_workload.py"
+            )
+        lt = fold.get("last_tenants")
+        if lt and lt.get("rows"):
+            top = ", ".join(
+                f"{r.get('tenant')} ({r.get('calls', 0)} calls)"
+                for r in lt["rows"][:3]
+            )
+            print(f"    tenants at {_fmt_t(lt['t'])}: {top}")
         for un in fold["unclean_starts"]:
             print(
                 f"    {_fmt_t(un['t'])} UNCLEAN START:"

@@ -287,9 +287,17 @@ def _fold_snaps(snaps: list) -> dict:
     coalesce = {"groups": 0, "members": 0}
     breakers: dict = {}
     admission: dict = {}
+    tenants: dict = {}
     for s in snaps:
         for k, v in s.get("counters", {}).items():
             counters[k] = counters.get(k, 0) + v
+        for row in s.get("tenants", []) or []:
+            acc = tenants.setdefault(
+                row.get("tenant", "anon"), {"calls": 0, "ms": 0.0, "bad": 0}
+            )
+            acc["calls"] += row.get("calls", 0)
+            acc["ms"] = round(acc["ms"] + row.get("ms", 0.0), 3)
+            acc["bad"] += row.get("bad", 0)
         for label, block in s.get("caches", {}).items():
             if label == "coalesce":
                 # groups/members, not a hit/miss cache — rendering
@@ -303,7 +311,7 @@ def _fold_snaps(snaps: list) -> dict:
         breakers = s.get("breakers", breakers)
         admission = s.get("admission", admission)
     return {"counters": counters, "caches": caches, "coalesce": coalesce,
-            "breakers": breakers, "admission": admission}
+            "breakers": breakers, "admission": admission, "tenants": tenants}
 
 
 def _render_fold(fold: dict, stamp: str) -> None:
@@ -334,6 +342,21 @@ def _render_fold(fold: dict, stamp: str) -> None:
     if open_breakers:
         parts.append(f"breakers={','.join(open_breakers)}")
     print(f"[{stamp}] " + " ".join(parts), flush=True)
+    # the tenants pane: who spent the window's device time (utils/
+    # tenants.py deltas embedded in the same flight-recorder snapshots,
+    # so live watch and --history replay render identically)
+    if fold.get("tenants"):
+        top = sorted(
+            fold["tenants"].items(), key=lambda kv: -kv[1]["ms"]
+        )[:5]
+        print(
+            "  tenants: " + " ".join(
+                f"{label}={acc['calls']}q/{acc['ms']:.0f}ms"
+                + (f"/{acc['bad']}bad" if acc["bad"] else "")
+                for label, acc in top
+            ),
+            flush=True,
+        )
 
 
 # worker ids are numeric strings: sort as ints so w10 does not

@@ -359,6 +359,10 @@ def test_gate_end_to_end_record_check_and_injected_fail(tmp_path, monkeypatch):
     doc = json.load(open(baseline))
     assert doc["per_query_ms"] > 0 and doc["spans"]
     assert "devstats" in doc and doc["devstats"]["d2h_bytes"] >= 0
+    # the per-tenant attribution table rides every artifact (untagged
+    # bench traffic meters as the one "anon" tenant)
+    assert isinstance(doc["tenants"]["top"], list)
+    assert any(r.get("tenant") == "anon" for r in doc["tenants"]["top"])
     assert bench_gate.main(args + ["--check"]) == 0
     # 3x, not 2x: warm reruns of a tiny stream can be ~25% faster than
     # the cold-recorded baseline, and 2x of a faster run can land back

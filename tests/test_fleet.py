@@ -999,7 +999,7 @@ def test_debug_fleet_per_worker_sections(fleet):
     for row in snap["workers"].values():
         sections = row["debug"]["sections"]
         assert set(sections) == {
-            "traces", "device", "overload", "recovery", "plans",
+            "traces", "device", "overload", "recovery", "plans", "tenants",
         }
         assert "breakers" in sections["overload"]
         assert "admission" in sections["overload"]

@@ -655,6 +655,11 @@ def test_netlog_carries_trace_id_to_broker(tmp_path):
             with trace.span("client") as client_root:
                 broker.send("t", 0, b"payload")
                 broker.poll("t", {0: 0})
+                # the server exports a request's span just before reading
+                # the NEXT request off the socket, so this trailing poll's
+                # reply guarantees the send+poll spans above were exported
+                # while the exporting context is still open
+                broker.poll("t", {0: 0})
         broker.close()
     client = [t for t in ring.traces if t.name == "client"]
     rpc_ops = {s.attributes.get("op") for s in client[-1].find("netlog.rpc")}

@@ -487,24 +487,34 @@ def test_coalesced_members_keep_their_own_exemplar_traces(device_store):
     from geomesa_tpu.utils import devstats
 
     store = device_store
-    ring = trace.InMemoryTraceExporter(capacity=16)
     audit.set_exemplars(True)
-    queries = [Query.cql(bench.QUERY) for _ in range(3)]
-    n0 = len(store.audit_writer.events)
-    g0 = devstats.devstats_metrics().counter("batch.coalesce.groups")
-    barrier = threading.Barrier(3)
     errors = []
-
-    def worker(q):
-        try:
-            barrier.wait(timeout=10)
-            store.query("gdelt", q)
-        except Exception as e:  # noqa: BLE001 - surfaced below
-            errors.append(e)
-
     old_reg = store.metrics
-    store.metrics = MetricsRegistry()  # exemplar set == exactly this run
-    try:
+
+    # 6 members: the coalescer's latency guard (inflight >= 2, or a
+    # window already gathering) needs two queries genuinely overlapping
+    # once — warm sub-ms queries from 3 threads can serialize perfectly,
+    # 6 make that vanishingly rare (and solo stragglers still exemplar
+    # under their own ids, so the assertions hold for any mix)
+    n_members = 6
+
+    def round_():
+        """One coalesce attempt; False when thread scheduling ran every
+        member solo (no group formed, nothing to assert on)."""
+        ring = trace.InMemoryTraceExporter(capacity=16)
+        queries = [Query.cql(bench.QUERY) for _ in range(n_members)]
+        n0 = len(store.audit_writer.events)
+        g0 = devstats.devstats_metrics().counter("batch.coalesce.groups")
+        barrier = threading.Barrier(n_members)
+        store.metrics = MetricsRegistry()  # exemplar set == this round
+
+        def worker(q):
+            try:
+                barrier.wait(timeout=10)
+                store.query("gdelt", q)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
         with trace.exporting(ring):
             with properties(geomesa_batch_enabled="true",
                             geomesa_batch_window_ms="150"):
@@ -516,14 +526,22 @@ def test_coalesced_members_keep_their_own_exemplar_traces(device_store):
                 for t in ts:
                     t.join(timeout=60)
         assert not errors, errors
-        assert devstats.devstats_metrics().counter("batch.coalesce.groups") > g0
+        if devstats.devstats_metrics().counter("batch.coalesce.groups") == g0:
+            return False
         member_ids = {e.trace_id for e in store.audit_writer.events[n0:]}
-        assert len(member_ids) == 3  # three queries, three distinct traces
+        assert len(member_ids) == n_members  # one distinct trace each
         ex = store.metrics.exemplars("query.scan")
         recent_ids = {tid for _s, tid, _t in ex["recent"]}
         # every exemplar is a member's own trace — and all three members
         # appear (a leader-capture bug would collapse them to one id)
         assert recent_ids == member_ids
+        return True
+
+    try:
+        # scheduling on a loaded machine can miss the 150 ms window, so
+        # the coalesce itself gets a few attempts; the member-isolation
+        # assertions run on the round that actually grouped
+        assert any(round_() for _ in range(4)), "no round formed a group"
     finally:
         store.metrics = old_reg
 

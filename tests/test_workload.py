@@ -1,0 +1,420 @@
+"""Workload capture (utils/workload.py) + the replay loop
+(scripts/replay_workload.py).
+
+The contract under test:
+
+* free when off — ``geomesa.workload.enabled=0`` (the default) costs
+  ONE cached flag read; a poisoned spool layer proves nothing below the
+  flag is ever touched;
+* pure when on — capture never changes an answer: under a
+  ``workload.append`` error/drop/latency fault schedule the store
+  answers byte-identically to the capture-off run, across seeds;
+* the descriptors are replayable — CQL (raw or literal-hashed), hints,
+  tenant, arrival offset, in-flight depth, outcome, plan fingerprint;
+  a join's inner build/probe queries and an aggregate's exact fallback
+  are marked ``nested`` so replay drives only top-level ops;
+* the loop closes — replaying a capture WITH capture still on
+  reproduces the per-fingerprint call counts exactly; two replays of
+  the same capture produce identical result hashes and an empty
+  ``compare()``; an injected slowdown is flagged through the same gate;
+* a SIGKILLed process's spool replays — capture is durable the moment
+  a flush lands, no clean shutdown required.
+"""
+
+import collections
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geom.base import Polygon
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.store.fs import FsDataStore
+from geomesa_tpu.utils import faults, workload
+from geomesa_tpu.utils.audit import robustness_metrics
+from geomesa_tpu.utils.config import properties
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SPEC = importlib.util.spec_from_file_location(
+    "replay_workload", os.path.join(REPO, "scripts", "replay_workload.py"),
+)
+replay_workload = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(replay_workload)
+
+T0 = 1483228800000  # 2017-01-01T00:00:00Z
+
+
+@pytest.fixture(autouse=True)
+def _reset_flag():
+    workload.set_enabled(None)
+    yield
+    workload.set_enabled(None)
+
+
+def _fill(root, n=300, seed=0):
+    store = FsDataStore(str(root))
+    store.create_schema(parse_spec(
+        "events", "kind:String,val:Integer,dtg:Date,*geom:Point:srid=4326"
+    ))
+    rng = np.random.default_rng(seed)
+    store._insert_columns(store.get_schema("events"), {
+        "__fid__": np.array([f"e{i}" for i in range(n)], dtype=object),
+        "kind": np.array([f"k{i % 3}" for i in range(n)], dtype=object),
+        "val": np.arange(n, dtype=np.int64),
+        "geom__x": rng.uniform(-5, 35, n),
+        "geom__y": rng.uniform(-5, 35, n),
+        "dtg": np.full(n, T0, dtype=np.int64),
+    })
+    store.create_schema(parse_spec(
+        "zones", "zname:String,*geom:Polygon:srid=4326"
+    ))
+    with store.writer("zones") as w:
+        w.write(["z0", Polygon([[0, 0], [5, 0], [5, 10], [0, 10], [0, 0]])],
+                fid="g0")
+    return store
+
+
+def _traffic(store):
+    """The captured mix: repeated + distinct queries (two tenants), an
+    aggregate, a join, a stream."""
+    q = Query.cql("kind = 'k0'", hints={"tenant": "acme"})
+    store.query("events", q)
+    store.query("events", q)
+    store.query("events", Query.cql(
+        "BBOX(geom, 0, 0, 10, 10)", hints={"tenant": "beta"},
+        max_features=50,
+    ))
+    store.aggregate(
+        "events", Query.cql("INCLUDE", hints={"tenant": "acme"}),
+        columns=["val"],
+    )
+    store.query_join("zones", "events", predicate="contains")
+    for _ in store.query_stream(
+        "events", Query.cql("kind = 'k1'", hints={"tenant": "beta"})
+    ):
+        pass
+
+
+def _captured(store):
+    workload.flush_for(store)
+    recs, _ = workload.read_workload(store.root)
+    return recs
+
+
+# -- free when off ------------------------------------------------------------
+
+
+def test_default_off_and_poisoned_path(tmp_path, monkeypatch):
+    """Disabled capture is ONE cached flag read: with everything below
+    the flag poisoned, a full query mix still runs clean."""
+    assert not workload.enabled()  # the default
+
+    def _boom(*a, **k):
+        raise AssertionError("capture layer touched while disabled")
+
+    monkeypatch.setattr(workload, "spool_for", _boom)
+    monkeypatch.setattr(workload, "open_spool", _boom)
+    store = _fill(tmp_path / "root")
+    _traffic(store)  # must not raise
+    monkeypatch.undo()
+    workload.flush_for(store)
+    recs, _ = workload.read_workload(store.root)
+    assert recs == []  # nothing captured while off
+
+
+# -- the descriptors ----------------------------------------------------------
+
+
+def test_capture_descriptors_and_nested_marking(tmp_path):
+    workload.set_enabled(True)
+    store = _fill(tmp_path / "root")
+    _traffic(store)
+    recs = _captured(store)
+    top = [r for r in recs if not r.get("nested")]
+    nested = [r for r in recs if r.get("nested")]
+    # a join's build+probe inner queries and the non-pyramid aggregate's
+    # exact fallback are nested; every top-level op captured once
+    assert collections.Counter(r["cls"] for r in top) == {
+        "query": 3, "aggregate": 1, "join": 1, "stream": 1,
+    }
+    assert nested and all(r["cls"] == "query" for r in nested)
+    for r in top:
+        for field in ("t", "off", "cls", "type", "tenant", "inflight",
+                      "outcome", "fingerprint", "ms", "rows", "literals"):
+            assert field in r, f"missing {field}"
+    assert any(r.get("max") == 50 for r in top)
+    j = next(r for r in top if r["cls"] == "join")
+    assert j["join"]["predicate"] == "contains"
+    assert j["join"]["build"][0] == "zones"
+    # offsets are monotone non-decreasing: the recorded pacing replays
+    offs = [r["off"] for r in recs]
+    assert offs == sorted(offs)
+
+
+def test_literal_hashing_knob(tmp_path):
+    with properties(geomesa_workload_literals="0",
+                    geomesa_workload_enabled="true"):
+        workload.set_enabled(None)
+        store = _fill(tmp_path / "root")
+        store.query("events", Query.cql("kind = 'k0'"))
+        recs = _captured(store)
+    assert recs
+    assert all("k0" not in (r.get("cql") or "") for r in recs)
+    assert all(r["literals"] == "hashed" for r in recs)
+    # equal literals stay equal within the capture — the shape survives
+    assert "'h:" in recs[0]["cql"]
+
+
+def test_scrub_cql_hashes_values_not_shape():
+    a = workload.scrub_cql("actor = 'USA' AND kind = 'USA'")
+    assert "USA" not in a
+    h = a.split("'")[1]
+    assert a.count(h) == 2  # same literal, same hash
+    # escaped-quote literals scrub as ONE literal
+    b = workload.scrub_cql("name = 'O''Brien'")
+    assert "Brien" not in b and b.count("'h:") == 1
+    # numbers/geometry stay: the spatial shape IS the signal
+    c = workload.scrub_cql("BBOX(geom, 0, 0, 10, 10)")
+    assert c == "BBOX(geom, 0, 0, 10, 10)"
+
+
+# -- purity under faults ------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [3, 11])
+def test_capture_purity_under_append_faults(tmp_path, seed):
+    """Byte-identical answers with capture on+faulted vs capture off:
+    the recorder may lose records, never perturb a query."""
+    queries = ["INCLUDE", "kind = 'k1'", "BBOX(geom, 0, 0, 20, 20)"]
+    off_store = _fill(tmp_path / "off", seed=seed)
+    want = {q: sorted(off_store.query("events", q).fids) for q in queries}
+
+    workload.set_enabled(True)
+    on_store = _fill(tmp_path / "on", seed=seed)
+    schedule = ("workload.append:error=0.5,workload.append:drop=0.3,"
+                "workload.append:latency=0.05")
+    with faults.inject(schedule, seed=seed):
+        for _ in range(3):
+            got = {
+                q: sorted(on_store.query("events", q).fids) for q in queries
+            }
+            assert got == want
+            workload.flush_for(on_store)  # faulted flushes swallow
+    # capture degraded gracefully, and SOME flushes failed (the faults
+    # actually fired) without a single wrong answer
+    workload.flush_for(on_store)
+
+
+def test_flush_failure_requeues_bounded(tmp_path):
+    workload.set_enabled(True)
+    store = _fill(tmp_path / "root")
+    sp = workload.spool_for(store)
+    m = robustness_metrics()
+    base_err = m.counter("workload.append.errors")
+    sp.append({"kind": "workload", "t": 0, "off": 0.0})
+    with faults.inject("workload.append:error=1.0"):
+        assert sp.flush() == 0
+    assert m.counter("workload.append.errors") == base_err + 1
+    # the record survived the failed flush and lands on the next one
+    assert sp.flush() == 1
+
+
+def test_pending_ring_bounded_drops(tmp_path):
+    workload.set_enabled(True)
+    store = _fill(tmp_path / "root")
+    sp = workload.spool_for(store)
+    m = robustness_metrics()
+    base = m.counter("workload.dropped")
+    for i in range(workload.PENDING_CAP + 50):
+        sp.append({"kind": "workload", "i": i})
+    assert m.counter("workload.dropped") == base + 50
+    assert sp.flush() == workload.PENDING_CAP
+
+
+def test_segment_rotation_seals_and_reader_verifies(tmp_path):
+    with properties(geomesa_workload_bytes="512",
+                    geomesa_workload_enabled="true"):
+        workload.set_enabled(None)
+        store = _fill(tmp_path / "root")
+        sp = workload.spool_for(store)
+        for i in range(50):
+            sp.append({"kind": "workload", "t": i, "off": float(i),
+                       "cls": "query", "pad": "x" * 64})
+            sp.flush()
+        names = [n for n in os.listdir(sp.dir) if n.startswith("wl-")]
+        assert len(names) > 1  # rotated
+        recs, _ = workload.read_workload(store.root)
+        assert len(recs) == 50  # sealed + active both readable
+
+
+# -- the replay loop ----------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_replay_reproduces_fingerprint_counts_exactly(tmp_path):
+    """Capture, then replay WITH capture still on: the re-captured
+    stream's per-fingerprint top-level counts equal the original's —
+    the closed loop at the heart of the knob lab."""
+    workload.set_enabled(True)
+    store = _fill(tmp_path / "root")
+    _traffic(store)
+    first = _captured(store)
+    driven = replay_workload.load_records(store.root)
+    assert len(driven) == 6
+    results = replay_workload.replay_open_loop(store, driven, speed=0)
+    assert all(r["outcome"] == "ok" for r in results)
+    everything = _captured(store)
+    second = everything[len(first):]
+
+    def counts(recs):
+        return collections.Counter(
+            (r["cls"], r["fingerprint"])
+            for r in recs if not r.get("nested")
+        )
+
+    assert counts(second) == counts(first)
+    # nested inner ops regenerate too — same count, never doubled
+    assert (
+        sum(1 for r in second if r.get("nested"))
+        == sum(1 for r in first if r.get("nested"))
+    )
+    # raw-literal replays answer with the captured row counts
+    for r in results:
+        assert r["rows"] == r["captured_rows"]
+
+
+def test_replay_aa_compare_clean_and_slowdown_flagged(tmp_path):
+    workload.set_enabled(True)
+    store = _fill(tmp_path / "root")
+    _traffic(store)
+    workload.flush_for(store)
+    recs = replay_workload.load_records(store.root)
+    workload.set_enabled(False)  # replays must not append to the capture
+
+    def artifact():
+        import time as _time
+
+        t0 = _time.perf_counter()
+        results = replay_workload.replay_open_loop(store, recs, speed=0)
+        return replay_workload.build_artifact(
+            store, recs, results, _time.perf_counter() - t0, "open", 0,
+        )
+
+    a, b = artifact(), artifact()
+    # A/A: same capture, same store — identical request mix and answers.
+    # The wide timing band makes this leg assert CORRECTNESS-clean (call
+    # counts, result hash, errors): sub-ms queries under CI load jitter
+    # far past the default 1.75x band between two honest replays.
+    assert replay_workload.compare(a, b, {"per_query_ms_factor": 50.0}) == []
+    assert a["result_hash"] and a["result_hash"] == b["result_hash"]
+    assert a["config"]["driven"] == 6
+    # an injected slowdown trips the band through the same gate
+    slow = replay_workload.inject_slowdown(json.loads(json.dumps(b)), 10.0)
+    regs = replay_workload.compare(a, slow)
+    assert regs and any("per_query_ms regressed" in r for r in regs)
+    # a doctored call count is a CORRECTNESS failure, not a band miss
+    drift = json.loads(json.dumps(b))
+    k = next(iter(drift["fingerprints"]))
+    drift["fingerprints"][k]["calls"] += 1
+    assert any(
+        "CORRECTNESS" in r for r in replay_workload.compare(a, drift)
+    )
+    # tenant attribution rode the replay: the captured labels re-meter
+    labels = {r["tenant"] for r in a["tenants"]}
+    assert {"acme", "beta"} <= labels
+
+
+def test_replay_closed_loop_same_answers(tmp_path):
+    workload.set_enabled(True)
+    store = _fill(tmp_path / "root")
+    _traffic(store)
+    workload.flush_for(store)
+    recs = replay_workload.load_records(store.root)
+    workload.set_enabled(False)
+    results = replay_workload.replay_closed_loop(store, recs)
+    assert len(results) == len(recs) == 6
+    assert all(r["outcome"] == "ok" for r in results)
+    assert all(r["rows"] == r["captured_rows"] for r in results)
+
+
+def test_replay_cli_compare_paths(tmp_path):
+    """The --compare path end to end, files included, without driving
+    a store: exit 0 in band, 1 on regression."""
+    art = {
+        "schema": 1, "kind": "workload_replay",
+        "config": {"mode": "open", "records": 2, "literals": "raw"},
+        "per_query_ms": 10.0, "p95_ms": 12.0,
+        "fingerprints": {"abc": {"calls": 2, "ms_mean": 10.0}},
+        "slo": {"calls": 2, "bad": 0},
+        "result_hash": "d34d", "tolerance": {"per_query_ms_factor": 1.75},
+    }
+    before, after = tmp_path / "a.json", tmp_path / "b.json"
+    before.write_text(json.dumps(art))
+    after.write_text(json.dumps(art))
+    assert replay_workload.main(
+        ["--compare", str(before), str(after)]
+    ) == 0
+    slow = dict(art, per_query_ms=100.0)
+    after.write_text(json.dumps(slow))
+    assert replay_workload.main(
+        ["--compare", str(before), str(after)]
+    ) == 1
+
+
+# -- SIGKILL durability -------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_sigkilled_capture_replays(tmp_path):
+    """SIGKILL a capturing process mid-run: whatever flushed is sealed
+    enough to read (CRC-verified segments, torn-line skips) and the
+    victim's workload re-drives cleanly — the postmortem loop."""
+    root = str(tmp_path / "root")
+    child = textwrap.dedent(f"""
+        import os, signal, sys
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        from geomesa_tpu.utils import config, workload
+        from geomesa_tpu.store.fs import FsDataStore
+        from geomesa_tpu.schema.featuretype import parse_spec
+        from geomesa_tpu.index.planner import Query
+        config.set_property("geomesa.workload.enabled", "true")
+        store = FsDataStore({root!r})
+        store.create_schema(parse_spec(
+            "events", "kind:String,dtg:Date,*geom:Point:srid=4326"))
+        rng = np.random.default_rng(0)
+        n = 100
+        store._insert_columns(store.get_schema("events"), {{
+            "__fid__": np.array([f"e{{i}}" for i in range(n)], dtype=object),
+            "kind": np.array([f"k{{i % 3}}" for i in range(n)], dtype=object),
+            "geom__x": rng.uniform(-5, 35, n),
+            "geom__y": rng.uniform(-5, 35, n),
+            "dtg": np.full(n, {T0}, dtype=np.int64),
+        }})
+        store.query("events", Query.cql(
+            "kind = 'k0'", hints={{"tenant": "victim"}}))
+        store.query("events", "INCLUDE")
+        workload.flush_for(store)
+        os.kill(os.getpid(), signal.SIGKILL)  # no atexit, no seal
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", child], env=env, timeout=240,
+                       capture_output=True, text=True)
+    assert p.returncode == -signal.SIGKILL, p.stderr[-500:]
+
+    recs = replay_workload.load_records(root)
+    assert len(recs) == 2
+    assert {r["tenant"] for r in recs} == {"victim", "anon"}
+    survivor = FsDataStore(root)
+    results = replay_workload.replay_open_loop(survivor, recs, speed=0)
+    assert len(results) == 2
+    assert all(r["outcome"] == "ok" for r in results)
+    assert all(r["rows"] == r["captured_rows"] for r in results)
