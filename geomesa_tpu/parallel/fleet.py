@@ -70,11 +70,11 @@ import functools
 import json
 import os
 import uuid
+import zlib
 from collections import OrderedDict, deque
 import signal
 import socket
 import socketserver
-import subprocess
 import sys
 import threading
 import time
@@ -84,6 +84,16 @@ import numpy as np
 
 from geomesa_tpu.index.planner import Query
 from geomesa_tpu.filter.parser import to_cql
+# _pid_alive/_repo_pythonpath are re-exported for back-compat: they
+# moved to the launcher module with the process-lifecycle code
+from geomesa_tpu.parallel.launch import (  # noqa: F401
+    WorkerHandle,
+    WorkerLaunchFailed,
+    _pid_alive,
+    _repo_pythonpath,
+    make_launcher,
+    probe_endpoint,
+)
 from geomesa_tpu.parallel.shards import ShardedDataStore, _concat_columns
 from geomesa_tpu.schema.featuretype import FeatureType, parse_spec
 from geomesa_tpu.store.integrity import (
@@ -170,7 +180,17 @@ _WIRE_ERRORS: Dict[str, type] = {
 # NOT fence — a fenced-out coordinator may keep serving stale-tolerant
 # queries but can never mutate.
 _MUTATING_OPS = frozenset(
-    {"create_schema", "delete_schema", "insert", "delete", "compact", "age_off"}
+    {
+        "create_schema",
+        "delete_schema",
+        "insert",
+        "delete",
+        "compact",
+        "age_off",
+        # partition shipping writes replica rows on the target: a fenced
+        # coordinator must not keep "repairing" replicas it no longer owns
+        "ship_apply",
+    }
 )
 
 
@@ -265,6 +285,34 @@ def _scan_chunk_bytes() -> int:
     b = FLEET_SCAN_CHUNK_BYTES.to_bytes()
     if b is None:
         b = 8 * 1024 * 1024
+    return max(0, min(int(b), _FRAME_BUDGET))
+
+
+# high-water mark of a single partition-ship frame built coordinator-
+# side (re-encoded source chunk after the digest mask). The ship-path
+# analogue of _SCAN_CHUNK_PEAK: tests assert it stays within the ship
+# chunk budget plus estimator slack even for a skewed partition.
+_SHIP_FRAME_PEAK = {"bytes": 0}
+
+
+def ship_frame_peak() -> int:
+    return int(_SHIP_FRAME_PEAK["bytes"])
+
+
+def _note_ship_frame(nbytes: int) -> None:
+    if nbytes > _SHIP_FRAME_PEAK["bytes"]:
+        _SHIP_FRAME_PEAK["bytes"] = int(nbytes)
+
+
+def _ship_chunk_bytes() -> int:
+    """Partition-ship chunk budget (``geomesa.fleet.ship.chunk.bytes``).
+    Unset inherits the streamed-scan budget; explicit ``0`` disables the
+    ship protocol (legacy materialized copy, inproc fallback)."""
+    from geomesa_tpu.utils.config import FLEET_SHIP_CHUNK_BYTES
+
+    b = FLEET_SHIP_CHUNK_BYTES.to_bytes()
+    if b is None:
+        return _scan_chunk_bytes()
     return max(0, min(int(b), _FRAME_BUDGET))
 
 
@@ -413,12 +461,35 @@ class _WorkerState:
         # append-only with no fid upsert, and counts never fid-dedupe,
         # so a double-apply would inflate counts permanently
         self._applied: "OrderedDict[str, bool]" = OrderedDict()
+        # open partition-ship sessions (bounded LRU): ship id -> the
+        # target-side digest/done/inflight state op_ship_apply dedupes
+        # against. A ship abandoned by a dead coordinator just ages out;
+        # the NEXT repair pass re-begins with a fresh digest snapshot,
+        # which is why a half-applied ship is always completable
+        self._ships: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         # highest coordinator fencing epoch seen on a mutating RPC:
         # anything lower is a fenced-out (zombie) coordinator and is
         # rejected with StaleEpoch. In-memory on purpose — a restarted
         # worker re-learns the live epoch on the first fenced write, and
         # split-brain needs TWO coordinators alive, not a worker restart
         self._epoch = 0
+        # SELF-fencing (partition tolerance): the monotonic instant the
+        # observed epoch was last confirmed live — any envelope carrying
+        # the current (or newer) epoch refreshes it, pings included. A
+        # worker cut off from its coordinator (worker→coordinator path
+        # up, coordinator→worker pings lost, or a zombie coordinator
+        # whose lease already expired elsewhere) stops seeing fresh
+        # epochs; once staleness exceeds the fence TTL it rejects
+        # MUTATIONS with StaleEpoch while still serving reads — the same
+        # stale-reads/no-writes posture as an epoch conflict, reached
+        # without ever observing the newer epoch
+        self._epoch_fresh = time.monotonic()
+        from geomesa_tpu.utils.config import FLEET_FENCE_TTL, FLEET_LEASE_TTL
+
+        ttl = FLEET_FENCE_TTL.to_duration_s(None)
+        if ttl is None:
+            ttl = FLEET_LEASE_TTL.to_duration_s(3.0)
+        self._fence_ttl_s = float(ttl)
         self.draining = False
         self.t_start = time.monotonic()
         self.recovered: Dict[str, Any] = {}
@@ -492,13 +563,36 @@ class _WorkerState:
         if fn is None:
             return {"ok": 0, "etype": "ValueError", "error": f"unknown op {op!r}"}, []
         ep = head.get("epoch")
-        if ep is not None and op in _MUTATING_OPS:
+        if ep is not None:
             ep = int(ep)
+            now = time.monotonic()
+            self_fence = False
+            stale_s = 0.0
             with self._lock:
                 known = self._epoch
-                if ep >= known:
+                if ep > known:
+                    # a newer coordinator: adopt its epoch and restart
+                    # the freshness clock — a healed partition rejoins
+                    # the moment the live coordinator speaks
                     self._epoch = ep
-            if ep < known:
+                    self._epoch_fresh = now
+                elif ep == known:
+                    stale_s = now - self._epoch_fresh
+                    if (
+                        known > 0
+                        and op in _MUTATING_OPS
+                        and stale_s > self._fence_ttl_s
+                    ):
+                        # SELF-fence: the sender's epoch matches, but
+                        # this worker hasn't heard it confirmed within
+                        # the fence TTL — a partition may have seated a
+                        # newer coordinator this worker cannot see.
+                        # Reject the write WITHOUT refreshing freshness;
+                        # only a ping (or a newer epoch) heals.
+                        self_fence = True
+                    else:
+                        self._epoch_fresh = now
+            if op in _MUTATING_OPS and ep < known:
                 self.metrics.inc("fleet.epoch.rejected")
                 decision(
                     "fleet.lease",
@@ -512,6 +606,22 @@ class _WorkerState:
                     f"fleet worker {self.worker_id}: mutating op {op!r} carries "
                     f"fencing epoch {ep} < {known} — the sender's lease was "
                     "seized by a newer coordinator"
+                )
+            if self_fence:
+                self.metrics.inc("fleet.epoch.self_fenced")
+                decision(
+                    "fleet.lease",
+                    "self_fenced",
+                    worker=self.worker_id,
+                    op=op,
+                    epoch=ep,
+                    stale_s=round(stale_s, 3),
+                )
+                raise StaleEpoch(
+                    f"fleet worker {self.worker_id}: mutating op {op!r} carries "
+                    f"epoch {ep}, unconfirmed for {stale_s:.2f}s "
+                    f"(> fence ttl {self._fence_ttl_s:.2f}s) — self-fencing "
+                    "until a live coordinator pings or a newer epoch arrives"
                 )
         return fn(head, payloads)
 
@@ -715,6 +825,122 @@ class _WorkerState:
             if st is not None and head["name"] in st.type_names:
                 removed += st.age_off(head["name"])
         return {"ok": 1, "removed": int(removed)}, []
+
+    # -- partition shipping (target side) ------------------------------------
+
+    def op_ship_begin(self, head, payloads):
+        """Open a ship session as the TARGET: snapshot the fids this
+        worker already holds for ``(name, partition)`` and stream them
+        back as sorted-fid digest chunks (compact bytes, never rows).
+        The digest is BOTH the coordinator's skip-mask and this side's
+        idempotency set — rows landed by a previous crashed ship are in
+        it, so re-shipping after any crash position only fills gaps."""
+        if self.draining:
+            raise ShedLoad(f"fleet worker {self.worker_id} draining")
+        name = head["name"]
+        partition = head["partition"]
+        ship = str(head["ship"])
+        chunk_bytes = int(head.get("chunk_bytes") or _FRAME_BUDGET)
+        chunk_bytes = max(1, min(chunk_bytes, _FRAME_BUDGET))
+        st = self._store(partition)
+        ft = self._schemas.get(name)
+        if ft is not None and name not in st.type_names:
+            st.create_schema(ft)
+        have: set = set()
+        if name in st.type_names:
+            res = st.query(name, Query())
+            if len(res):
+                from geomesa_tpu.store.datastore import _materialize
+
+                cols = dict(_materialize(res.columns))
+                have = {str(f) for f in cols.get("__fid__", ())}
+        with self._lock:
+            self._ships[ship] = {
+                "name": name,
+                "partition": partition,
+                "have": have,
+                "done": set(),
+                "inflight": set(),
+            }
+            while len(self._ships) > 4:
+                self._ships.popitem(last=False)
+        digest = np.array(sorted(have), dtype=object)
+
+        def _digest_chunks():
+            sent = 0
+            for chunk in iter_column_chunks(
+                {"__fid__": digest}, max_bytes=chunk_bytes
+            ):
+                deadline.check("fleet.ship")
+                sent += 1
+                yield columns_to_ipc(chunk)
+            yield {"have": len(digest), "chunks": sent}
+
+        return {"ok": 1, "stream": 1}, _digest_chunks()
+
+    def op_ship_apply(self, head, payloads):
+        """Apply one CRC-framed ship chunk idempotently: chunk seqs
+        dedupe exactly like insert batch ids (a lost-ACK retry
+        acknowledges without re-appending), and rows whose fid is
+        already in the session digest are skipped — so replaying ANY
+        prefix or suffix of the chunk sequence converges on the same
+        byte-identical replica."""
+        ship = str(head["ship"])
+        seq = int(head["seq"])
+        buf = payloads[0]
+        if zlib.crc32(buf) & 0xFFFFFFFF != int(head["crc"]) & 0xFFFFFFFF:
+            # a torn frame is a TRANSPORT fault: retryable, never applied
+            raise ConnectionError(
+                f"ship {ship} chunk {seq}: crc mismatch (torn frame)"
+            )
+        with self._lock:
+            ss = self._ships.get(ship)
+            if ss is None:
+                raise ValueError(
+                    f"unknown ship {ship!r} on worker {self.worker_id} "
+                    "(session evicted or target restarted — re-begin)"
+                )
+            if seq in ss["done"]:
+                return {"ok": 1, "deduped": True}, []
+            if seq in ss["inflight"]:
+                raise ConnectionError(f"ship {ship} chunk {seq} still applying")
+            ss["inflight"].add(seq)
+        try:
+            columns = ipc_to_columns(buf)
+            fids = [str(f) for f in np.asarray(columns.get("__fid__", ()))]
+            with self._lock:
+                have = ss["have"]
+                mask = np.array([f not in have for f in fids], dtype=bool)
+            applied = 0
+            if len(fids) and mask.any():
+                sub = (
+                    columns
+                    if mask.all()
+                    else {k: np.asarray(v)[mask] for k, v in columns.items()}
+                )
+                st = self._store(ss["partition"])
+                name = ss["name"]
+                ft = self._schemas.get(name)
+                if ft is not None and name not in st.type_names:
+                    st.create_schema(ft)
+                st._insert_columns(st.get_schema(name), sub, observe_stats=False)
+                applied = int(mask.sum())
+        except BaseException:
+            with self._lock:
+                ss["inflight"].discard(seq)
+            raise
+        with self._lock:
+            ss["have"].update(fids)
+            ss["inflight"].discard(seq)
+            ss["done"].add(seq)
+        return {"ok": 1, "applied": applied, "skipped": len(fids) - applied}, []
+
+    def op_ship_end(self, head, payloads):
+        with self._lock:
+            ss = self._ships.pop(str(head["ship"]), None)
+        if ss is None:
+            return {"ok": 1, "known": 0}, []
+        return {"ok": 1, "known": 1, "chunks": len(ss["done"])}, []
 
     def op_telemetry(self, head, payloads):
         return {
@@ -1105,10 +1331,19 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(description="geomesa-tpu fleet shard worker")
     ap.add_argument("--id", type=int, required=True)
     ap.add_argument("--root", required=True)
-    ap.add_argument("--portfile", required=True)
+    ap.add_argument("--portfile", default=None)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--auths", default=None)
+    # --announce stdout is the REMOTE handshake: the worker prints one
+    # `ENDPOINT host:port pid` line and the launcher reads it off the
+    # launch command's stdout (SshLauncher) — no shared filesystem
+    # required. The portfile stays the local-launcher handshake.
+    ap.add_argument(
+        "--announce", choices=("portfile", "stdout"), default="portfile"
+    )
     args = ap.parse_args(argv)
+    if args.announce == "portfile" and not args.portfile:
+        ap.error("--portfile is required with --announce portfile")
 
     auths = args.auths.split(",") if args.auths else None
     state = _WorkerState(args.id, args.root, auths=auths)
@@ -1125,12 +1360,15 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
         threading.Thread(target=srv.shutdown, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _term)
-    # publish the bound port atomically: the supervisor polls for this
-    # file, so a half-written port must never be readable
-    tmp = args.portfile + ".tmp"
-    with open(tmp, "w") as fh:
-        fh.write(f"{args.host}:{port}\n")
-    os.replace(tmp, args.portfile)
+    if args.portfile:
+        # publish the bound port atomically: the supervisor polls for
+        # this file, so a half-written port must never be readable
+        tmp = args.portfile + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(f"{args.host}:{port}\n")
+        os.replace(tmp, args.portfile)
+    if args.announce == "stdout":
+        print(f"ENDPOINT {args.host}:{port} {os.getpid()}", flush=True)
     try:
         srv.serve_forever()
     finally:
@@ -1329,6 +1567,11 @@ class WorkerClient:
                     fields = dict(fields, epoch=int(ep))
             try:
                 faults.fault_point("fleet.rpc")
+                # DIRECTIONAL partition injection (utils/faults.py): a
+                # fleet.rpc.send rule drops the request before it leaves
+                # the coordinator — the asymmetric half where requests
+                # (and heartbeat pings) never reach the worker
+                faults.fault_point("fleet.rpc", direction="send")
             except faults.SimulatedCrash as e:
                 # a crash at fleet.rpc models the WORKER process dying
                 # mid-exchange (utils/faults.py): the coordinator
@@ -1351,6 +1594,11 @@ class WorkerClient:
                 send_frame(sock, json.dumps(head).encode())
                 for b in payloads:
                     send_frame(sock, b)
+                # the OTHER asymmetric half: the request was delivered
+                # (the worker may well APPLY it) but the reply never
+                # comes back — retries must ride the idempotent-apply /
+                # batch-dedupe machinery, never double-apply
+                faults.fault_point("fleet.rpc", direction="recv")
                 resp = json.loads(recv_frame(sock).decode())
                 if resp.get("ok") == 1 and resp.get("stream"):
                     resp, frames = self._recv_stream(sock)
@@ -1503,6 +1751,113 @@ class WorkerClient:
             "receipt": resp.get("receipt", {}),
         }
 
+    def scan_chunks(self, name: str, query: Query, partitions: Sequence[str]):
+        """Generator edition of ``scan`` for partition shipping: yields
+        ONE decoded column-chunk at a time and drops its raw frame
+        before pulling the next, so the consumer (the coordinator's
+        ship loop) holds at most one chunk of the source partition —
+        never the full materialization ``scan`` collects. SINGLE
+        attempt, no retry ladder: a mid-stream failure aborts the ship,
+        whose dirty-mark obligation re-ships idempotently later."""
+        with trace.span("fleet.rpc", op="scan", shard=self.shard_id):
+            deadline.check("fleet.rpc")
+            faults.fault_point("fleet.rpc")
+            faults.fault_point("fleet.rpc", direction="send")
+            sock = self._checkout()
+            try:
+                sock.settimeout(deadline.io_timeout(self._timeout_s, "fleet.rpc"))
+                head = request_envelope(
+                    "scan",
+                    frames=0,
+                    name=name,
+                    partitions=list(partitions),
+                    **_query_to_wire(query),
+                )
+                send_frame(sock, json.dumps(head).encode())
+                faults.fault_point("fleet.rpc", direction="recv")
+                resp = json.loads(recv_frame(sock).decode())
+                if resp.get("ok") == 1 and resp.get("stream"):
+                    while True:
+                        ctrl = json.loads(recv_frame(sock).decode())
+                        if not ctrl.get("chunk"):
+                            break
+                        buf = recv_frame(sock)
+                        _note_scan_chunk(len(buf))
+                        cols = ipc_to_columns(buf)
+                        del buf
+                        deadline.check("fleet.rpc")
+                        yield cols
+                    for _ in range(int(ctrl.get("frames", 0))):
+                        recv_frame(sock)
+                    if ctrl.get("ok") != 1:
+                        # typed mid-stream error frame (parity-or-crisp)
+                        _raise_wire_error(ctrl)
+                else:
+                    # legacy non-streamed reply (scan chunking disabled):
+                    # frames are already bounded by the frame budget —
+                    # decode and yield them one at a time
+                    n = int(resp.get("frames", 0))
+                    if resp.get("ok") != 1:
+                        for _ in range(n):
+                            recv_frame(sock)
+                        _raise_wire_error(resp)
+                    for _ in range(n):
+                        buf = recv_frame(sock)
+                        cols = ipc_to_columns(buf)
+                        del buf
+                        yield cols
+            except BaseException:
+                # framing state unknown on ANY unwind mid-stream
+                # (including the consumer closing this generator early)
+                sock.close()
+                raise
+            self._checkin(sock)
+
+    # -- partition shipping (coordinator-driven repair protocol) -------------
+
+    def ship_begin(
+        self, name: str, partition: str, ship: str, chunk_bytes: int
+    ) -> "np.ndarray":
+        """Open a ship on the TARGET: returns its fid digest for
+        ``(name, partition)`` as one sorted numpy array (streamed from
+        the worker in bounded sorted-fid chunks — compact bytes, never
+        the rows). Retry-safe: a re-begin re-snapshots the digest."""
+        resp, frames = self._rpc(
+            "ship_begin",
+            {
+                "name": name,
+                "partition": partition,
+                "ship": ship,
+                "chunk_bytes": int(chunk_bytes),
+            },
+        )
+        if resp.get("streamed"):
+            cols = resp.get("_columns") or []
+        else:
+            cols = [ipc_to_columns(b) for b in frames]
+        parts = [np.asarray(c["__fid__"]) for c in cols if len(c.get("__fid__", ()))]
+        if not parts:
+            return np.array([], dtype=object)
+        return np.concatenate(parts)
+
+    def ship_apply(self, ship: str, seq: int, buf: bytes) -> Dict[str, Any]:
+        """Apply one ship chunk on the target: CRC-framed, seq-deduped
+        (a retry of a lost-ACK apply acknowledges without re-appending —
+        the insert-batch idempotency contract, keyed by chunk seq)."""
+        resp, _ = self._rpc(
+            "ship_apply",
+            {"ship": ship, "seq": int(seq), "crc": zlib.crc32(buf) & 0xFFFFFFFF},
+            [buf],
+        )
+        return {
+            "applied": int(resp.get("applied", 0)),
+            "skipped": int(resp.get("skipped", 0)),
+            "deduped": bool(resp.get("deduped")),
+        }
+
+    def ship_end(self, ship: str) -> None:
+        self._rpc("ship_end", {"ship": ship})
+
     def count(self, name: str, partition: str) -> int:
         resp, _ = self._rpc("count", {"name": name, "partition": partition})
         return int(resp["count"])
@@ -1614,7 +1969,16 @@ class WorkerClient:
         return resp.get("inventory", {})
 
     def ping(self) -> Dict[str, Any]:
-        resp, _ = self._attempt("ping", {}, [])  # no retry: one beat, one probe
+        # the heartbeat ping carries the coordinator's lease epoch: it
+        # is the worker's self-fencing freshness signal — a worker that
+        # stops hearing its epoch confirmed fences its own mutations
+        # after the fence TTL (dispatch), and the next ping heals it
+        fields: Dict[str, Any] = {}
+        if self.epoch_fn is not None:
+            ep = self.epoch_fn()
+            if ep is not None:
+                fields["epoch"] = int(ep)
+        resp, _ = self._attempt("ping", fields, [])  # no retry: one beat, one probe
         return resp
 
     def drain(self, timeout_s: float) -> Dict[str, Any]:
@@ -1791,22 +2155,6 @@ class FleetLease:
 # -- supervisor ---------------------------------------------------------------
 
 
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-    except OSError:
-        return False
-    return True
-
-
-def _repo_pythonpath() -> str:
-    import geomesa_tpu
-
-    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(geomesa_tpu.__file__)))
-    existing = os.environ.get("PYTHONPATH", "")
-    return pkg_parent + (os.pathsep + existing if existing else "")
-
-
 class FleetSupervisor:
     """Spawns, watches, restarts, and drains the worker processes.
 
@@ -1852,11 +2200,18 @@ class FleetSupervisor:
         self._flap_window_s = FLEET_FLAP_WINDOW.to_duration_s(60.0)
         self._spawn_timeout_s = FLEET_SPAWN_TIMEOUT.to_duration_s(30.0)
         self.drain_timeout_s = FLEET_DRAIN_TIMEOUT.to_duration_s(10.0)
-        self._procs: List[Optional[subprocess.Popen]] = [None] * self.num_workers
-        # pid of each worker REGARDLESS of parentage: spawn() records its
-        # child's pid here, adopt() the orphan's — liveness checks and
-        # kill paths use os.kill when there is no Popen to poll/reap
-        self._pids: List[Optional[int]] = [None] * self.num_workers
+        # EVERY process-lifecycle action routes through the launcher SPI
+        # (parallel/launch.py, geomesa.fleet.launcher): first launch,
+        # the respawn ladder, takeover adoption, kills — a restart can
+        # never bypass the configured launcher back to a local Popen
+        self.launcher = make_launcher(
+            self.base_dir, self.worker_root,
+            auths=getattr(store, "auths", None),
+        )
+        self._handles: List[Optional[WorkerHandle]] = [None] * self.num_workers
+        # per-worker launch telemetry for /debug/fleet's launcher block
+        self._launch_attempts: List[int] = [0] * self.num_workers
+        self._handshake_ms: List[float] = [0.0] * self.num_workers
         self._addrs: List[Optional[Tuple[str, int]]] = [None] * self.num_workers
         self._state: List[str] = [DEAD] * self.num_workers
         self._misses: List[int] = [0] * self.num_workers
@@ -1882,150 +2237,77 @@ class FleetSupervisor:
 
     def worker_pid(self, i: int) -> Optional[int]:
         with self._lock:
-            proc = self._procs[i]
-            return proc.pid if proc is not None else self._pids[i]
+            handle = self._handles[i]
+            return handle.pid if handle is not None else None
 
     def worker_state(self, i: int) -> str:
         with self._lock:
             return self._state[i]
 
     def spawn(self, i: int) -> None:
-        """Spawn worker ``i`` and wait for it to publish its port. The
-        worker process re-opens its partition roots (journal recovery)
-        before it binds, so a published port means a recovered store."""
-        portfile = os.path.join(self.base_dir, f"w{i}.port")
-        try:
-            os.remove(portfile)
-        except FileNotFoundError:
-            pass
-        env = dict(os.environ)
-        env["PYTHONPATH"] = _repo_pythonpath()
-        # workers are host-scan processes: they must not race the
-        # coordinator for an accelerator unless explicitly told to
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        if env.get("JAX_PLATFORMS") == "cpu":
-            # a cpu-pinned worker must not claim a remote accelerator
-            # session at interpreter startup either (the
-            # force_cpu_platform recipe, parallel/mesh.py — the claim
-            # can block for minutes and serializes spawns)
-            env["PALLAS_AXON_POOL_IPS"] = ""
-        env["GEOMESA_FLEET_WORKER_ID"] = str(i)
-        cmd = [
-            sys.executable,
-            "-m",
-            "geomesa_tpu.parallel.fleet",
-            "--worker",
-            "--id",
-            str(i),
-            "--root",
-            self.worker_root(i),
-            "--portfile",
-            portfile,
-        ]
-        # list-shaped auths travel to the worker stores (visibility rows
-        # must filter identically on both sides of the wire); provider
-        # OBJECTS cannot cross a process boundary — workers then run
-        # auth-less and visibility-bearing scans under-serve (documented)
-        auths = getattr(self.store, "auths", None)
-        if isinstance(auths, str):
-            auths = [auths]
-        if isinstance(auths, (list, tuple)) and all(
-            isinstance(a, str) for a in auths
-        ) and auths:
-            cmd += ["--auths", ",".join(auths)]
-        log = open(os.path.join(self.base_dir, f"w{i}.log"), "ab")
-        try:
-            proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
-        finally:
-            log.close()
-        t_end = time.monotonic() + self._spawn_timeout_s
-        addr: Optional[Tuple[str, int]] = None
-        while time.monotonic() < t_end:
-            if self._stop.is_set():
-                # stop() is waiting on this repair: abort the spawn
-                # promptly instead of making close()/atexit wait out
-                # the port-publish timeout
-                proc.kill()
-                raise RuntimeError("supervisor stopping")
-            if proc.poll() is not None:
-                raise WorkerUnavailable(
-                    f"fleet worker {i} exited rc={proc.returncode} during spawn"
-                )
-            try:
-                text = open(portfile).read().strip()
-            except FileNotFoundError:
-                time.sleep(0.02)
-                continue
-            if text:
-                host, _, port = text.partition(":")
-                addr = (host, int(port))
-                break
-            time.sleep(0.02)
-        if addr is None:
-            proc.kill()
-            raise TimeoutError(f"fleet worker {i} never published its port")
+        """Launch worker ``i`` through the configured launcher and wait
+        for its endpoint handshake. The worker process re-opens its
+        partition roots (journal recovery) before it binds, so an
+        announced endpoint means a recovered store."""
         with self._lock:
-            self._procs[i] = proc
-            self._pids[i] = proc.pid
-            self._addrs[i] = addr
+            self._launch_attempts[i] += 1
+        handle = self.launcher.launch(
+            i, timeout_s=self._spawn_timeout_s, stop=self._stop.is_set
+        )
+        with self._lock:
+            self._handles[i] = handle
+            self._addrs[i] = handle.addr
             self._state[i] = LIVE
             self._misses[i] = 0
+            self._handshake_ms[i] = handle.handshake_ms
 
     def adopt(self, i: int) -> bool:
         """Attach to an already-running worker process — one a dead
-        coordinator left behind. Reads the worker's published portfile,
-        probes it with a raw ping, and records its address + pid WITHOUT
-        spawning: takeover must not double-spawn over a healthy worker's
-        partition roots (two processes over one FsDataStore root is the
-        one corruption the whole layout forbids). False when there is
-        nothing live to adopt (missing/stale portfile, dead port)."""
-        portfile = os.path.join(self.base_dir, f"w{i}.port")
-        try:
-            text = open(portfile).read().strip()
-        except OSError:
-            return False
-        if not text:
-            return False
-        host, _, port = text.partition(":")
-        try:
-            addr = (host, int(port))
-        except ValueError:
-            return False
-        pid = self._probe_pid(addr)
-        if pid is None:
+        coordinator left behind. The launcher reads the published
+        endpoint record, probes it with a raw ping, and hands back the
+        live worker WITHOUT spawning: takeover must not double-spawn
+        over a healthy worker's partition roots (two processes over one
+        FsDataStore root is the one corruption the whole layout
+        forbids). False when there is nothing live to adopt
+        (missing/stale endpoint record, dead port)."""
+        handle = self.launcher.adopt(i)
+        if handle is None:
             return False
         with self._lock:
-            self._procs[i] = None
-            self._pids[i] = pid
-            self._addrs[i] = addr
+            self._handles[i] = handle
+            self._addrs[i] = handle.addr
             self._state[i] = LIVE
             self._misses[i] = 0
         robustness_metrics().inc("fleet.worker.adopted")
-        decision("fleet", "worker_adopted", worker=i, pid=pid)
+        decision("fleet", "worker_adopted", worker=i, pid=handle.pid)
         return True
 
     @staticmethod
     def _probe_pid(addr: Tuple[str, int]) -> Optional[int]:
-        """Raw ping against a candidate adoptee: its pid on success,
-        None for anything dead/foreign (bounded at 1s — adoption probes
-        must not serialize a takeover on a wedged corpse)."""
-        try:
-            s = socket.create_connection(addr, timeout=1.0)
-        except OSError:
-            return None
-        try:
-            s.settimeout(1.0)
-            send_frame(s, json.dumps(request_envelope("ping", frames=0)).encode())
-            resp = json.loads(recv_frame(s).decode())
-            for _ in range(int(resp.get("frames", 0))):
-                recv_frame(s)
-            if resp.get("ok") != 1:
-                return None
-            return int(resp.get("pid") or 0) or None
-        except (OSError, ValueError):
-            return None
-        finally:
-            s.close()
+        """Back-compat alias of ``launch.probe_endpoint`` (the raw
+        adoption ping, bounded at 1s)."""
+        return probe_endpoint(addr)
+
+    def launcher_snapshot(self) -> Dict[str, Any]:
+        """The /debug/fleet ``launcher`` block: which launcher the
+        fleet routes lifecycle actions through, plus per-worker launch
+        attempts and last handshake latency."""
+        with self._lock:
+            return {
+                "kind": self.launcher.kind,
+                "workers": {
+                    str(i): {
+                        "launch_attempts": self._launch_attempts[i],
+                        "handshake_ms": round(self._handshake_ms[i], 1),
+                        "adopted": (
+                            self._handles[i].adopted
+                            if self._handles[i] is not None
+                            else False
+                        ),
+                    }
+                    for i in range(self.num_workers)
+                },
+            }
 
     def start(self, attach: bool = False) -> Tuple[int, int]:
         """Bring every worker up; with ``attach=True`` (takeover /
@@ -2072,55 +2354,23 @@ class FleetSupervisor:
         with self._repair_lock:
             pass
         with self._lock:
-            procs = list(self._procs)
-            pids = list(self._pids)
-            self._procs = [None] * self.num_workers
-            self._pids = [None] * self.num_workers
+            handles = list(self._handles)
+            self._handles = [None] * self.num_workers
             self._addrs = [None] * self.num_workers
-        for proc in procs:
-            if proc is None or proc.poll() is not None:
+        for handle in handles:
+            if handle is None:
                 continue
-            proc.terminate()
-            try:
-                proc.wait(timeout=2.0)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                proc.wait(timeout=2.0)
-        for proc, pid in zip(procs, pids):
-            # adopted workers are not our children: no Popen to
-            # terminate/reap — signal the pid directly and poll it down
-            if proc is not None or pid is None:
-                continue
-            try:
-                os.kill(pid, signal.SIGTERM)
-            except OSError:
-                continue
-            t_end = time.monotonic() + 2.0
-            while time.monotonic() < t_end and _pid_alive(pid):
-                time.sleep(0.05)
-            if _pid_alive(pid):
-                try:
-                    os.kill(pid, signal.SIGKILL)
-                except OSError:
-                    pass
+            # graceful-then-hard teardown through the launcher (adopted
+            # workers are not our children: the launcher signals by pid)
+            self.launcher.shutdown(handle, timeout_s=2.0)
 
     def kill_worker(self, i: int) -> None:
         """Hard-kill (SIGKILL) worker ``i`` — the chaos harness's lever;
         the heartbeat machine is what must notice and repair."""
         with self._lock:
-            proc = self._procs[i]
-            pid = self._pids[i]
-        if proc is not None and proc.poll() is None:
-            proc.kill()
-            proc.wait(timeout=5.0)
-        elif proc is None and pid is not None and _pid_alive(pid):
-            try:
-                os.kill(pid, signal.SIGKILL)
-            except OSError:
-                return
-            t_end = time.monotonic() + 5.0
-            while time.monotonic() < t_end and _pid_alive(pid):
-                time.sleep(0.02)
+            handle = self._handles[i]
+        if handle is not None:
+            self.launcher.kill(handle, wait_s=5.0)
 
     # -- membership ----------------------------------------------------------
 
@@ -2180,14 +2430,12 @@ class FleetSupervisor:
         with self._lock:
             if self._state[i] == OUT:
                 return False
-            proc = self._procs[i]
-            pid = self._pids[i]
-        if proc is not None:
-            reaped = proc.poll() is not None
-        else:
-            # adopted worker: not our child, nothing to reap — a dead
-            # pid is the same unambiguous verdict
-            reaped = pid is not None and not _pid_alive(pid)
+            handle = self._handles[i]
+        # the launcher answers "observably dead" from local evidence (a
+        # reaped child, a dead adopted pid); a remote worker with no
+        # local evidence stays un-reaped and the missed-ping hysteresis
+        # below carries the verdict
+        reaped = handle is not None and self.launcher.poll(handle)
         # each beat runs under its own budget (one interval): the probe's
         # socket timeout derives from it, so a wedged worker costs at
         # most one interval per beat, never the RPC knob constant
@@ -2197,9 +2445,13 @@ class FleetSupervisor:
                     deadline.check("fleet.heartbeat")
                     faults.fault_point("fleet.heartbeat")
                     if reaped:
+                        rc = (
+                            handle.proc.returncode
+                            if handle is not None and handle.proc is not None
+                            else "?"
+                        )
                         raise WorkerUnavailable(
-                            f"fleet worker {i} process exited rc="
-                            f"{proc.returncode if proc is not None else '?'}"
+                            f"fleet worker {i} process exited rc={rc}"
                         )
                     self.store.workers[i].ping()
                 except (OSError, QueryTimeout):
@@ -2314,19 +2566,15 @@ class FleetSupervisor:
             # holding the repair lock (and stop()) for minutes
             raise RuntimeError("supervisor stopping")
         with self._lock:
-            proc = self._procs[i]
-            pid = self._pids[i]
-        if proc is not None and proc.poll() is None:
-            proc.kill()
-            proc.wait(timeout=5.0)
-        elif proc is None and pid is not None and _pid_alive(pid):
-            # an adopted corpse (wedged but unreaped): SIGKILL by pid
-            try:
-                os.kill(pid, signal.SIGKILL)
-            except OSError:
-                pass
+            handle = self._handles[i]
+        if handle is not None:
+            # retire the predecessor (a wedged-but-unreaped corpse
+            # included) through the SAME launcher that started it — the
+            # respawn ladder must never bypass the configured SPI back
+            # to a local kill/spawn pair
+            self.launcher.kill(handle, wait_s=5.0)
         with self._lock:
-            self._pids[i] = None
+            self._handles[i] = None
         self.store.workers[i].close()  # pooled sockets point at the corpse
         self.spawn(i)
 
@@ -2362,9 +2610,9 @@ class FleetSupervisor:
                 str(i): {
                     "state": self._state[i],
                     "pid": (
-                        self._procs[i].pid
-                        if self._procs[i] is not None
-                        else self._pids[i]
+                        self._handles[i].pid
+                        if self._handles[i] is not None
+                        else None
                     ),
                     "address": self._addrs[i],
                     "misses": self._misses[i],
@@ -2438,6 +2686,18 @@ class FleetDataStore(ShardedDataStore):
         # starting after the set dual-target both chains.
         self._write_gate = threading.Condition()
         self._writes_inflight = 0
+        # partition-ship telemetry (the /debug/fleet ``ship`` block):
+        # own lock — ships serialize on the move lock, but the debug
+        # plane reads these counters concurrently
+        self._ship_lock = threading.Lock()
+        self._ship_stats: Dict[str, int] = {
+            "active": 0,
+            "ships": 0,
+            "chunks": 0,
+            "bytes": 0,
+            "resumes": 0,
+            "failed": 0,
+        }
         # coordinator HA: the durably-leased fencing-epoch record. A
         # standby holds an UNACQUIRED lease object (epoch 0) and only
         # bumps it at takeover(); the active coordinator seizes it now
@@ -2693,6 +2953,30 @@ class FleetDataStore(ShardedDataStore):
             name = rec.get("name")
             payload = rec.get("payload") or {}
             done = set(rec.get("done") or ())
+            if kind == "ship":
+                # a ship intent that survived a crash is NOT re-driven
+                # here — every chunk it applied is already durable and
+                # idempotent. It converts into the (partition, target)
+                # dirty mark, and the repair sweep re-ships exactly the
+                # gap (the fresh digest masks what landed).
+                p = payload.get("partition")
+                try:
+                    target = int(next(iter(rec.get("participants") or ()), None))
+                except (TypeError, ValueError):
+                    target = None
+                if p is not None and target is not None:
+                    self._mark_dirty(str(p), target)
+                self._fleet_journal.fanout_finish(rec["path"])
+                replayed += 1
+                robustness_metrics().inc("fleet.ship.restarted")
+                decision(
+                    "fleet.ship",
+                    "restarted",
+                    table=name,
+                    partition=p,
+                    target=target,
+                )
+                continue
             with trace.span("fleet.fanout", op=kind, table=name, replay=True):
                 deadline.check("fleet.fanout")
                 try:
@@ -2811,6 +3095,42 @@ class FleetDataStore(ShardedDataStore):
             ).encode(),
             crc=True,
         )
+
+    def _scan_chain(self, gid: int, partitions) -> List[int]:
+        """Dirty-replica reconciliation on the READ path: a replica
+        carrying an outstanding dirty mark for ANY of the group's
+        partitions is dropped from the failover chain — serving its
+        gapped copy would be a silently-truncated answer. This includes
+        a PRIMARY whose fill failed mid-move (the skipped_dirty
+        branches commit the flip and carry the obligation): an emptied
+        chain fails crisply (ShardUnavailable) until the repair sweep
+        clears the marks, which is the parity-or-crisp contract under
+        asymmetric partitions."""
+        chain = super()._scan_chain(gid, partitions)
+        with self._dirty_lock:
+            dirty = set(self._dirty)
+        if not dirty:
+            return chain
+        out = [
+            s for s in chain
+            if not any((p, s) in dirty for p in partitions)
+        ]
+        for s in chain:
+            if s not in out:
+                robustness_metrics().inc("fleet.dirty.rerouted")
+                decision(
+                    "fleet.ship", "dirty_replica_skipped",
+                    shard=s, group=gid,
+                )
+        return out
+
+    def _partition_targets(self, p: str) -> List[int]:
+        chain = super()._partition_targets(p)
+        with self._dirty_lock:
+            dirty = set(self._dirty)
+        if not dirty:
+            return chain
+        return [s for s in chain if (p, s) not in dirty]
 
     def _recover_routing(self) -> None:
         """Coordinator-restart recovery for the ROUTING table: a fresh
@@ -3017,7 +3337,28 @@ class FleetDataStore(ShardedDataStore):
         copy would physically duplicate the partition on a target that
         journal-recovered its rows: worker-side counts would double on
         every kill/restore cycle and disk would grow unboundedly. The
-        missing-fid filter makes every repair idempotent."""
+        missing-fid filter makes every repair idempotent.
+
+        Process fleets ship CHUNKED (``_ship_one``): the source streams
+        bounded Arrow chunks, the target answers with a compact fid
+        digest, and coordinator peak frame memory stays at one chunk —
+        never the skewed partition's full materialization both sides
+        of the legacy copy pay. The legacy materialized copy remains
+        for inproc workers (no wire) and an explicit
+        ``geomesa.fleet.ship.chunk.bytes=0``."""
+        chunk_bytes = _ship_chunk_bytes()
+        src_w = self.workers[src]
+        if (
+            chunk_bytes > 0
+            and hasattr(src_w, "scan_chunks")
+            and all(hasattr(self.workers[t], "ship_begin") for t in targets)
+        ):
+            for name in sorted(self._partitions):
+                if p not in self._partitions[name]:
+                    continue
+                for t in targets:
+                    self._ship_one(name, p, src, int(t), chunk_bytes)
+            return
         for name in sorted(self._partitions):
             if p not in self._partitions[name]:
                 continue
@@ -3039,6 +3380,103 @@ class FleetDataStore(ShardedDataStore):
                 else:
                     sub = cols
                 self.workers[t].insert(p, ft, sub)
+
+    def _ship_one(
+        self, name: str, p: str, src: int, t: int, chunk_bytes: int
+    ) -> None:
+        """One journaled, bounded-memory partition ship ``src -> t``.
+
+        Protocol: the target snapshots its fid digest (``ship_begin``,
+        sorted-fid chunks), the source streams bounded Arrow chunks
+        (``scan_chunks``), the coordinator masks already-held fids and
+        forwards each surviving chunk with a CRC (``ship_apply``, seq-
+        deduped and fid-idempotent target-side), then ``ship_end``.
+
+        Crash atomicity: the ship is a journaled ``ship`` intent. Every
+        applied chunk is already durable and idempotent, so recovery
+        never re-drives the ship itself — ``_replay_fanouts`` converts a
+        crash-surviving intent into the (partition, target) dirty mark,
+        and the next repair pass re-ships exactly the gap (the fresh
+        digest masks everything that landed). A plain mid-ship failure
+        commits the intent and re-raises: the CALLER's dirty-mark is
+        the standing obligation (the PR 12/16 recovery hook)."""
+        ship = uuid.uuid4().hex
+        with trace.span("fleet.ship", table=name, partition=p,
+                        src=src, target=t):
+            deadline.check("fleet.ship")
+            faults.fault_point("fleet.ship")  # pre-intent: nothing shipped
+            path = self._fleet_journal.fanout_begin(
+                "ship", name, [str(t)], {"partition": p, "src": int(src)}
+            )
+            with self._ship_lock:
+                self._ship_stats["active"] += 1
+            chunks = shipped_bytes = applied = skipped = 0
+            try:
+                digest = self.workers[t].ship_begin(name, p, ship, chunk_bytes)
+                faults.fault_point("fleet.ship")  # digest read, no rows moved
+                seq = 0
+                for cols in self.workers[src].scan_chunks(name, Query(), [p]):
+                    fids = np.asarray(cols.get("__fid__", ()))
+                    if len(fids) == 0:
+                        continue
+                    if len(digest):
+                        mask = ~np.isin(fids.astype(object), digest)
+                        if not mask.any():
+                            skipped += len(fids)
+                            continue
+                        if not mask.all():
+                            skipped += int(len(fids) - mask.sum())
+                            cols = {
+                                k: np.asarray(v)[mask] for k, v in cols.items()
+                            }
+                    buf = columns_to_ipc(cols)
+                    _note_ship_frame(len(buf))
+                    deadline.check("fleet.ship")
+                    faults.fault_point("fleet.ship")  # chunk boundary
+                    out = self.workers[t].ship_apply(ship, seq, buf)
+                    applied += out["applied"]
+                    chunks += 1
+                    shipped_bytes += len(buf)
+                    seq += 1
+                    del buf, cols
+                faults.fault_point("fleet.ship")  # applied, intent pending
+                self.workers[t].ship_end(ship)
+            except Exception:
+                # commit the intent — every applied chunk is durable and
+                # the caller's dirty-mark carries the re-ship obligation;
+                # only a CRASH (BaseException) leaves the record for
+                # _replay_fanouts to convert into that mark itself
+                self._fleet_journal.fanout_finish(path)
+                with self._ship_lock:
+                    self._ship_stats["active"] -= 1
+                    self._ship_stats["failed"] += 1
+                robustness_metrics().inc("fleet.ship.failed")
+                raise
+            self._fleet_journal.fanout_finish(path)
+            with self._ship_lock:
+                st = self._ship_stats
+                st["active"] -= 1
+                st["ships"] += 1
+                st["chunks"] += chunks
+                st["bytes"] += shipped_bytes
+                if skipped:
+                    st["resumes"] += 1
+            robustness_metrics().inc("fleet.ship.applied")
+            if chunks:
+                robustness_metrics().inc("fleet.ship.chunks", chunks)
+            if skipped:
+                # the target's digest already held part of the source
+                # set: this ship RESUMED a prior partial copy (a crashed
+                # ship, a journal-recovered target) instead of restarting
+                decision(
+                    "fleet.ship",
+                    "resumed",
+                    table=name,
+                    partition=p,
+                    target=t,
+                    skipped_rows=int(skipped),
+                    applied_rows=int(applied),
+                )
 
     def _resync_partition(self, p: str, new_primary: int) -> None:
         """Fill the members of the DESTINATION chain that do not hold
@@ -3066,11 +3504,19 @@ class FleetDataStore(ShardedDataStore):
         for t in fill:
             if not self._live(t):
                 self._mark_dirty(p, t)
+                decision(
+                    "fleet.ship", "skipped_dirty",
+                    partition=p, target=t, cause="target_dead",
+                )
                 continue
             try:
                 self._copy_partition(p, src, [t])
-            except (OSError, ShedLoad, QueryTimeout):
+            except (OSError, ShedLoad, QueryTimeout) as e:
                 self._mark_dirty(p, t)
+                decision(
+                    "fleet.ship", "skipped_dirty",
+                    partition=p, target=t, cause=type(e).__name__,
+                )
         robustness_metrics().inc("fleet.resync.partitions")
 
     def _resync_into(self, p: str, target: int) -> None:
@@ -3250,6 +3696,15 @@ class FleetDataStore(ShardedDataStore):
             "fleet": {
                 "workers": workers,
                 "rollup": merge_worker_ticks(workers),
+                # the tick carries the ship + launcher counters too, so
+                # the flight recorder shows repairs moving (or stalling)
+                # between beats without a /debug/fleet pull
+                "ship": self.ship_snapshot(),
+                "launcher": (
+                    self.supervisor.launcher_snapshot()
+                    if self.supervisor is not None
+                    else {"kind": "inproc"}
+                ),
             },
         }
 
@@ -3317,7 +3772,19 @@ class FleetDataStore(ShardedDataStore):
             "lease": lease,
             "fanouts_pending": len(self._fleet_journal.pending_fanouts()),
             "scan_chunk_peak_bytes": scan_chunk_peak(),
+            "ship_frame_peak_bytes": ship_frame_peak(),
         }
+
+    def ship_snapshot(self) -> Dict[str, Any]:
+        """The /debug/fleet ``ship`` block: in-flight ships, cumulative
+        chunk/byte counters, resume/restart tallies, and the peak frame
+        gauge that proves coordinator ship memory stays ≤ the chunk
+        budget."""
+        with self._ship_lock:
+            stats = dict(self._ship_stats)
+        stats["frame_peak_bytes"] = ship_frame_peak()
+        stats["chunk_budget_bytes"] = _ship_chunk_bytes()
+        return stats
 
     def fleet_snapshot(self) -> Dict[str, Any]:
         """The /debug/fleet + /debug/report section: supervisor view
@@ -3337,6 +3804,14 @@ class FleetDataStore(ShardedDataStore):
         out: Dict[str, Any] = {
             "transport": self.transport,
             "workers": {},
+            # launcher SPI view: kind plus per-worker launch attempts /
+            # handshake latency (inproc fleets have no launcher)
+            "launcher": (
+                self.supervisor.launcher_snapshot()
+                if self.supervisor is not None
+                else {"kind": "inproc"}
+            ),
+            "ship": self.ship_snapshot(),
             "placement": {
                 "moved": dict(sorted(self.placement.overrides.items())),
                 "pending_moves": dict(self.placement.pending_moves),
@@ -3389,7 +3864,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return worker_main(argv[1:])
     sys.stderr.write(
         "usage: python -m geomesa_tpu.parallel.fleet --worker --id I "
-        "--root DIR --portfile FILE\n"
+        "--root DIR [--portfile FILE | --announce stdout]\n"
     )
     return 2
 

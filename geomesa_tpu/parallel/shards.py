@@ -707,11 +707,25 @@ class ShardedDataStore(TpuDataStore):
             sp.set_attr("partitions", len(parts))
         return total
 
+    def _scan_chain(self, gid: int, partitions) -> List[int]:
+        """READ-path failover chain for one scatter group. The base
+        fabric serves the raw placement chain; subclasses drop members
+        known to hold incomplete copies of the group's partitions (the
+        fleet's dirty-replica marks) — a failover onto a gapped replica
+        would be a silently-truncated answer. Mutation fan-outs keep
+        using the raw chain: dirty replicas must still receive writes."""
+        return self.placement.chain(gid)
+
+    def _partition_targets(self, p: str) -> List[int]:
+        """READ-path failover targets for one partition (the count
+        chain's edition of ``_scan_chain``)."""
+        return self.placement.targets(p)
+
     def _count_one_partition(self, name: str, wq: Query, p: str) -> int:
         """One partition's count through its placement chain under the
         per-shard breaker protocol (every ``allow()`` gets a verdict)."""
         last: Optional[BaseException] = None
-        for sid in self.placement.targets(p):
+        for sid in self._partition_targets(p):
             br = self._breakers[sid]
             if not br.allow():
                 continue  # open: straight to the replica, zero dispatch
@@ -739,7 +753,7 @@ class ShardedDataStore(TpuDataStore):
             return got
         raise ShardUnavailable(
             f"partition {p!r}: every placement "
-            f"{self.placement.targets(p)} refused or failed"
+            f"{self._partition_targets(p)} refused or failed"
             + (f" (last: {type(last).__name__}: {last})" if last else "")
         )
 
@@ -887,6 +901,14 @@ class ShardedDataStore(TpuDataStore):
         lat_done: List[float] = []
         hedge_decided: Set[int] = set()  # groups whose one hedge chance is spent
         metrics = robustness_metrics()
+        # per-group failover chain, snapshotted once: subclasses drop
+        # members KNOWN to hold incomplete copies of the group's
+        # partitions (the fleet's dirty-replica marks) — serving one
+        # would be a silently-truncated answer, the one outcome the
+        # parity-or-crisp contract forbids
+        chains: Dict[int, List[int]] = {
+            gid: self._scan_chain(gid, groups[gid]) for gid in groups
+        }
 
         def outcome(gid: int) -> Dict[str, Any]:
             return outcomes.setdefault(str(gid), {"partitions": len(groups[gid])})
@@ -897,7 +919,7 @@ class ShardedDataStore(TpuDataStore):
             # placement so a transient fault on every placement is still
             # absorbed (the boundary's bounded-retry budget — the
             # deadline caps the ladder like everywhere else)
-            chain = self.placement.chain(gid)
+            chain = chains[gid]
             for dispatched in (0, 1):
                 for t in chain:
                     if tried[gid].count(t) != dispatched:
@@ -930,7 +952,7 @@ class ShardedDataStore(TpuDataStore):
             # (fraction of the budget REMAINING at execution start, so
             # pool queue wait charges the query, never the shard) — the
             # coordinator keeps the handle purely to cancel()
-            last = len(tried[gid]) + 1 >= 2 * len(self.placement.chain(gid))
+            last = len(tried[gid]) + 1 >= 2 * len(chains[gid])
             a = _Attempt(t, deadline.Deadline(_UNBOUNDED_S), hedge)
             tried[gid].append(t)
             inflight[gid].append(a)
@@ -1054,7 +1076,7 @@ class ShardedDataStore(TpuDataStore):
                 return None  # the loop-top deadline check raises crisply
             return ShardUnavailable(
                 f"shard group {gid} exhausted every placement "
-                f"{self.placement.chain(gid)} (last: {type(exc).__name__}: {exc})"
+                f"{chains[gid]} (last: {type(exc).__name__}: {exc})"
             )
 
         released: Set[int] = set()
@@ -1066,7 +1088,7 @@ class ShardedDataStore(TpuDataStore):
                     outcome(gid)["outcome"] = "unavailable"
                     raise ShardUnavailable(
                         f"shard group {gid}: every placement "
-                        f"{self.placement.chain(gid)} refused (breakers open)"
+                        f"{chains[gid]} refused (breakers open)"
                     )
             while len(results) < len(groups):
                 if dl is not None:

@@ -235,6 +235,29 @@ FLEET_LEASE_RENEW = SystemProperty(
 FLEET_SCAN_CHUNK_BYTES = SystemProperty(
     "geomesa.fleet.scan.chunk.bytes", "8MB"
 )
+# Remote-ready fleet (parallel/launch.py + the ship protocol in
+# parallel/fleet.py). `launcher` selects the WorkerLauncher the
+# supervisor routes EVERY process-lifecycle action through (first
+# launch, restart ladder, takeover adoption, kill): `local` is the
+# in-tree Popen + portfile handshake, `ssh` renders `ssh.command` — a
+# shell template with {python} {id} {root} {host} placeholders — and
+# reads the worker's `ENDPOINT host:port` announcement from the remote
+# stdout (the portfile is a LOCAL launcher detail, not the contract).
+# `ship.chunk.bytes` bounds each Arrow frame of a streamed partition
+# ship (source->target replica copy); unset inherits scan.chunk.bytes,
+# explicit 0 disables streaming and restores the materialized copy.
+# `fence.ttl` is the worker-side self-fencing window: a worker
+# whose observed lease epoch has not been refreshed (by a heartbeat
+# ping or a mutating RPC) for longer than this rejects same-epoch
+# mutating RPCs with StaleEpoch — reads keep serving — until a
+# heartbeat or a higher epoch proves the coordinator is live again;
+# unset inherits geomesa.fleet.lease.ttl.
+FLEET_LAUNCHER = SystemProperty("geomesa.fleet.launcher", "local")
+FLEET_SSH_COMMAND = SystemProperty("geomesa.fleet.ssh.command", None)
+FLEET_SHIP_CHUNK_BYTES = SystemProperty(
+    "geomesa.fleet.ship.chunk.bytes", None
+)
+FLEET_FENCE_TTL = SystemProperty("geomesa.fleet.fence.ttl", None)
 # Spatial placement granularity: partitions are low-resolution z2 cells
 # of the point geometry (store/partitions.Z2Scheme, `bits` even), so a
 # bbox query routes to the shards owning intersecting cells only;

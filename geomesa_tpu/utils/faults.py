@@ -38,6 +38,33 @@ disk, or device boundary:
                        worker; a ``crash`` here models the WORKER process
                        dying mid-exchange (the coordinator fails over,
                        like shard.rpc), and error/drop model the transport
+    fleet.rpc.send     the coordinator->worker DIRECTION of a fleet RPC:
+                       a rule here fires before the request leaves the
+                       coordinator, so a ``drop`` schedule models an
+                       asymmetric network partition where requests (and
+                       heartbeat pings) never reach the worker while its
+                       replies would still flow. ``fleet.rpc`` rules keep
+                       matching both directions; ``fleet.rpc.*`` wildcards
+                       match the directional points only
+    fleet.rpc.recv     the worker->coordinator DIRECTION: fires after the
+                       worker has processed the request, before the
+                       coordinator reads the reply — a ``drop`` models the
+                       asymmetric partition where a mutation APPLIED but
+                       its ack was lost (the idempotent-apply/dedupe
+                       machinery must absorb the retry)
+    fleet.launch       one worker launch through the WorkerLauncher SPI
+                       (parallel/launch.py): process start + endpoint
+                       handshake, bounded by geomesa.fleet.spawn.timeout —
+                       an ``error`` exercises the supervisor's restart
+                       ladder, a ``crash`` models the coordinator dying
+                       mid-launch
+    fleet.ship         one chunk position of a streamed partition ship
+                       (parallel/fleet.py): the chunked source->target
+                       replica copy behind rebalance/repair — a ``crash``
+                       at ANY chunk position must leave a state the next
+                       repair pass completes idempotently (dirty-mark
+                       obligation + journaled ship record), never a
+                       duplicated or half-visible row
     fleet.heartbeat    one supervisor heartbeat probe (parallel/fleet.py):
                        faults here exercise the missed-beat -> suspect ->
                        dead membership machine without touching a real
@@ -156,10 +183,14 @@ FAULT_POINTS = (
     "agg.build",
     "batch.coalesce",
     "fleet.rpc",
+    "fleet.rpc.send",
+    "fleet.rpc.recv",
     "fleet.heartbeat",
     "fleet.rebalance",
     "fleet.lease",
     "fleet.fanout",
+    "fleet.launch",
+    "fleet.ship",
     "history.append",
     "workload.append",
 )
@@ -335,10 +366,21 @@ def _active_sets() -> List[FaultSet]:
     return ([env] if env is not None else []) + stack
 
 
-def fault_point(point: str) -> None:
+def fault_point(point: str, direction: Optional[str] = None) -> None:
     """The harness hook: call at a named boundary. ``error``/``drop``/
     ``crash`` rules raise, ``latency`` sleeps; ``torn`` rules are
-    write-site only (see ``maybe_tear``) and never fire here."""
+    write-site only (see ``maybe_tear``) and never fire here.
+
+    ``direction`` narrows the draw to the directional sub-point
+    ``<point>.<direction>`` (e.g. ``fleet.rpc`` + ``send`` draws only
+    ``fleet.rpc.send`` rules), so a schedule can drop one direction of
+    a duplex boundary while the other keeps flowing — an asymmetric
+    network partition. A directional call deliberately does NOT re-draw
+    the bare point's rules: the bare call at the same boundary already
+    fired them once, and firing twice would double a probability
+    schedule."""
+    if direction is not None:
+        point = f"{point}.{direction}"
     for fs in _active_sets():
         rule = fs.draw(point, ("error", "drop", "latency", "crash"))
         if rule is None:

@@ -108,6 +108,29 @@
 #     fan-out intent still owing its replay — and after takeover the
 #     standby's postmortem over the same root shows the intent replayed
 #     with the adopted workers still spooling
+#   - crash-safe partition shipping (tests/test_fleet.py, its own leg):
+#     a coordinator SimulatedCrash at EVERY fleet.ship position — pre-
+#     intent, post-digest, every chunk boundary, post-apply — recovers
+#     to parity with a byte-identical deduplicated replica and an empty
+#     journal; a REAL SIGKILL of the TARGET worker mid-ship lands on the
+#     dirty-mark obligation and the repair sweep RESUMES (the fresh fid
+#     digest masks every chunk that already landed — zero duplicates);
+#     coordinator peak frame memory stays gauge-bounded by the chunk
+#     budget throughout
+#   - asymmetric network partitions (tests/test_fleet.py, same leg):
+#     dropping 30% of ONE direction of the fleet RPC at a time
+#     (coordinator->worker sends, then worker->coordinator replies)
+#     leaves every query parity-or-crisp (QueryTimeout /
+#     ShardUnavailable / StaleEpoch — never wrong or truncated), and
+#     the healed fleet settles back to fully primary-owned; a worker
+#     whose observed epoch goes unconfirmed past the fence TTL self-
+#     fences (rejects mutations, still serves reads) until a live
+#     coordinator ping or a newer epoch heals it
+#   - launcher SPI under process death (tests/test_fleet.py, same leg):
+#     the ssh (command-template, local-loopback) launcher serves full
+#     parity, and a REAL SIGKILL respawns the worker THROUGH the same
+#     launcher — launch attempts tick on /debug/fleet's launcher block,
+#     never a residual local-Popen path
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
@@ -121,11 +144,13 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m pytest \
     -q -m chaos -p no:cacheprovider "$@" || rc=$?
 # the real-SIGKILL fleet soak spawns worker PROCESSES: bounded on its
 # own so a wedged spawn can never eat the in-process soaks' budget
-# (the coordinator-kill soaks run in their own leg below)
+# (the coordinator-kill and ship/partition soaks run in their own legs
+# below)
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py \
     -q -m chaos -p no:cacheprovider \
-    -k "not coordinator and not takeover and not fanout" "$@" || rc=$?
+    -k "not coordinator and not takeover and not fanout and not ship and not asym and not ssh" \
+    "$@" || rc=$?
 # the coordinator-kill leg: crash-position sweeps over cross-worker
 # fan-outs, the standby-takeover fencing soak, and the real-SIGKILL
 # coordinator death mid-fan-out — bounded on its own so a wedged
@@ -135,4 +160,13 @@ timeout -k 10 90 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py \
     -q -m chaos -p no:cacheprovider \
     -k "coordinator or takeover or fanout" "$@" || rc=$?
+# the remote-ready leg: the fleet.ship crash-position sweep + the
+# mid-ship TARGET SIGKILL (each spawns its own 3-worker process fleet
+# per position), the asymmetric-partition drop soaks, and the ssh
+# loopback launcher respawn — bounded on their own so the per-position
+# fleet spawns can never eat the worker-death leg's budget
+timeout -k 10 150 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet.py \
+    -q -m chaos -p no:cacheprovider \
+    -k "ship or asym or ssh" "$@" || rc=$?
 exit $rc

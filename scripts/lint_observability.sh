@@ -39,6 +39,8 @@ declare -A SPANS=(
     ["fleet.rebalance"]="geomesa_tpu/parallel/fleet.py"
     ["fleet.lease"]="geomesa_tpu/parallel/fleet.py"
     ["fleet.fanout"]="geomesa_tpu/parallel/fleet.py"
+    ["fleet.ship"]="geomesa_tpu/parallel/fleet.py"
+    ["fleet.launch"]="geomesa_tpu/parallel/launch.py"
     ["history.append"]="geomesa_tpu/utils/history.py"
     ["workload.append"]="geomesa_tpu/utils/workload.py"
 )

@@ -75,12 +75,28 @@ done
 # forces the file to consult the deadline beside them (the fleet RPC
 # derives its socket timeout from min(knob, remaining) per attempt and
 # checks the budget BEFORE the dial)
-for point in fleet.rpc fleet.heartbeat fleet.rebalance fleet.lease fleet.fanout; do
+for point in fleet.rpc fleet.heartbeat fleet.rebalance fleet.lease fleet.fanout fleet.ship; do
     if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/parallel/fleet.py; then
         echo "FAIL: geomesa_tpu/parallel/fleet.py lost the '${point}' fault point"
         echo "      (the fleet contract: process death, missed heartbeats,"
-        echo "       crashed rebalances, lease renewals, and cross-worker"
-        echo "       fan-outs must stay chaos-testable —"
+        echo "       crashed rebalances, lease renewals, cross-worker"
+        echo "       fan-outs, and partition ships must stay chaos-testable —"
+        echo "       faults.fault_point(\"${point}\") beside a deadline check;"
+        echo "       see utils/faults.py)"
+        fail=1
+    fi
+done
+
+# the launcher SPI boundary is pinned in its own module: every worker
+# launch (local spawn, ssh, restart-ladder respawns, takeover adoption
+# probes) runs under fleet.launch with a bounded handshake deadline —
+# rule 3 above forces the deadline pairing once the point exists
+for point in fleet.launch; do
+    if ! grep -q "fault_point(\"${point}\")" geomesa_tpu/parallel/launch.py; then
+        echo "FAIL: geomesa_tpu/parallel/launch.py lost the '${point}' fault point"
+        echo "      (the launcher contract: worker launches — local or remote —"
+        echo "       must stay chaos-testable and deadline-bounded, failing"
+        echo "       crisply with WorkerLaunchFailed —"
         echo "       faults.fault_point(\"${point}\") beside a deadline check;"
         echo "       see utils/faults.py)"
         fail=1

@@ -302,10 +302,14 @@ def test_socket_timeout_rederived_from_remaining_budget():
 def test_fleet_fault_points_registered():
     for point in (
         "fleet.rpc",
+        "fleet.rpc.send",
+        "fleet.rpc.recv",
         "fleet.heartbeat",
         "fleet.rebalance",
         "fleet.lease",
         "fleet.fanout",
+        "fleet.launch",
+        "fleet.ship",
     ):
         assert point in faults.FAULT_POINTS
 
@@ -1612,3 +1616,476 @@ def test_sigkill_coordinator_mid_fanout_standby_rolls_forward(tmp_path):
         assert pm2["rollup"]["workers"] == 2
     finally:
         b.close()
+
+
+# -- remote-ready fleet: launcher SPI, streamed shipping, self-fencing --------
+
+
+def _partition_fid_list(st, worker, partition, name="t"):
+    """Raw (non-deduped) fid list from one worker's copy of a partition
+    — the dup detector the ship idempotency tests assert with."""
+    from geomesa_tpu.index.planner import Query as _Q
+
+    out = st.workers[worker].scan(name, _Q(), [partition])
+    fids: list = []
+    for c in out["columns"]:
+        fids.extend(str(f) for f in c["__fid__"])
+    return fids
+
+
+def test_launcher_failures_are_crisp(tmp_path):
+    """The SPI contract: a misconfigured launcher fails at construction
+    (ValueError), and a launch command that dies before announcing an
+    endpoint fails FAST with WorkerLaunchFailed — never a hang until
+    the spawn timeout."""
+    from geomesa_tpu.parallel.launch import WorkerLaunchFailed, make_launcher
+
+    roots = lambda i: str(tmp_path / f"w{i}")  # noqa: E731
+    with properties(geomesa_fleet_launcher="ssh"):
+        with pytest.raises(ValueError):  # ssh without a command template
+            make_launcher(str(tmp_path), roots)
+    with properties(geomesa_fleet_launcher="carrier-pigeon"):
+        with pytest.raises(ValueError):
+            make_launcher(str(tmp_path), roots)
+    with properties(
+        geomesa_fleet_ssh_command="{python} -c 'raise SystemExit(7)'"
+    ):
+        ln = make_launcher(str(tmp_path), roots, kind="ssh")
+        t0 = time.monotonic()
+        with pytest.raises(WorkerLaunchFailed):
+            ln.launch(0, timeout_s=30.0)
+        assert time.monotonic() - t0 < 10.0  # crisp, not the full timeout
+
+
+def test_worker_self_fences_on_stale_epoch_and_ping_heals(tmp_path):
+    """Partition tolerance, worker side: a worker whose observed epoch
+    goes unconfirmed past the fence TTL rejects MUTATIONS with
+    StaleEpoch (a partitioned minority must not accept writes a seated
+    majority-side coordinator no longer owns) while still serving
+    reads; a coordinator ping carrying the live epoch — or any newer
+    epoch — heals it."""
+    from geomesa_tpu.parallel.fleet import _WorkerState
+
+    with properties(geomesa_fleet_fence_ttl="100 ms"):
+        ws = _WorkerState(0, str(tmp_path / "w0"))
+    ws.dispatch({"op": "create_schema", "name": "t", "spec": SPEC,
+                 "epoch": 5}, [])
+    # fresh epoch: mutations at the same epoch are served
+    head, _ = ws.dispatch({"op": "compact", "name": "t", "epoch": 5}, [])
+    assert head["ok"] == 1
+    time.sleep(0.25)  # let epoch 5 go stale past the 100 ms fence TTL
+    with pytest.raises(StaleEpoch):
+        ws.dispatch({"op": "compact", "name": "t", "epoch": 5}, [])
+    assert ws.metrics.counter("fleet.epoch.self_fenced") == 1
+    # ...but reads still answer (stale-reads/no-writes posture)
+    head, _ = ws.dispatch({"op": "ping"}, [])
+    assert head["ok"] == 1
+    # a failed mutation must NOT have refreshed freshness: still fenced
+    with pytest.raises(StaleEpoch):
+        ws.dispatch({"op": "compact", "name": "t", "epoch": 5}, [])
+    # the heal signal: a coordinator ping CARRYING the live epoch
+    head, _ = ws.dispatch({"op": "ping", "epoch": 5}, [])
+    assert head["ok"] == 1
+    head, _ = ws.dispatch({"op": "compact", "name": "t", "epoch": 5}, [])
+    assert head["ok"] == 1
+    # and a NEWER epoch is always accepted, fence or no fence
+    time.sleep(0.25)
+    head, _ = ws.dispatch({"op": "compact", "name": "t", "epoch": 6}, [])
+    assert head["ok"] == 1
+
+
+@pytest.mark.chaos
+def test_partition_ship_streams_bounded_chunks_byte_identical(
+    tmp_path, monkeypatch
+):
+    """Tentpole acceptance, happy path: a partition move over the REAL
+    wire ships bounded Arrow chunks — coordinator peak frame memory
+    stays at the chunk budget (gauge-asserted), never the partition's
+    full materialization — and the target's copy is byte-identical
+    (same fids, zero duplicates) with parity on every query."""
+    from geomesa_tpu.parallel import fleet as fleet_mod
+
+    monkeypatch.setenv("GEOMESA_FLEET_SCAN_CHUNK_BYTES", "2048")
+    monkeypatch.setenv("GEOMESA_FLEET_SHIP_CHUNK_BYTES", "2048")
+    data = rows(500)
+    single = ingest(TpuDataStore(), data=data)
+    want = {q: sorted(single.query("t", q).fids) for q in QUERIES}
+    with properties(geomesa_fleet_heartbeat_interval="150 ms"):
+        st = ingest(
+            FleetDataStore(
+                str(tmp_path / "ship"), num_workers=3, replicas=1,
+                partition_bits=2,
+            ),
+            data=data,
+        )
+        try:
+            # pick the fattest partition and a target OUTSIDE the
+            # current chain (so the move must actually ship rows)
+            p = max(
+                st._all_partitions(),
+                key=lambda q: len(_partition_fid_list(
+                    st, st.placement.primary(q), q
+                )),
+            )
+            cur = st.placement.primary(p)
+            chain = st.placement.chain(cur)
+            t = next(i for i in range(3) if i not in chain)
+            src_fids = _partition_fid_list(st, cur, p)
+            assert len(src_fids) >= 50
+            fleet_mod._SHIP_FRAME_PEAK["bytes"] = 0
+            st.move_partition(p, t)
+            snap = st.ship_snapshot()
+            assert snap["ships"] >= 1
+            assert snap["chunks"] >= 2, snap  # streamed, not one blob
+            assert snap["bytes"] > 0 and snap["active"] == 0
+            assert 0 < snap["frame_peak_bytes"] <= 2048 * 4, snap
+            # byte-identical: same fid set, zero physical duplicates
+            got = _partition_fid_list(st, t, p)
+            assert len(got) == len(set(got))
+            assert sorted(got) == sorted(src_fids)
+            for q, w in want.items():
+                assert sorted(st.query("t", q).fids) == w
+            assert not st._fleet_journal.pending_fanouts()
+            # the debug surfaces carry the ship + launcher blocks
+            fs = st.fleet_snapshot()
+            assert fs["ship"]["ships"] >= 1
+            assert fs["launcher"]["kind"] == "local"
+            assert all(
+                w["launch_attempts"] >= 1
+                for w in fs["launcher"]["workers"].values()
+            )
+        finally:
+            st.close()
+
+
+@pytest.mark.chaos
+def test_ship_chunk_failure_marks_dirty_then_repair_resumes(
+    tmp_path, monkeypatch
+):
+    """A plain mid-ship failure (transport error at a chunk boundary)
+    commits the ship intent and lands on the dirty-mark obligation; the
+    repair sweep RESUMES — the fresh digest masks every chunk that
+    already landed, so the re-ship moves only the gap and the replica
+    ends byte-identical with zero duplicates."""
+    monkeypatch.setenv("GEOMESA_FLEET_SCAN_CHUNK_BYTES", "2048")
+    monkeypatch.setenv("GEOMESA_FLEET_SHIP_CHUNK_BYTES", "2048")
+    data = rows(500)
+    with properties(geomesa_fleet_heartbeat_interval="150 ms"):
+        st = ingest(
+            FleetDataStore(
+                str(tmp_path / "shiperr"), num_workers=3, replicas=1,
+                partition_bits=2,
+            ),
+            data=data,
+        )
+        try:
+            p = max(
+                st._all_partitions(),
+                key=lambda q: len(_partition_fid_list(
+                    st, st.placement.primary(q), q
+                )),
+            )
+            cur = st.placement.primary(p)
+            t = next(
+                i for i in range(3) if i not in st.placement.chain(cur)
+            )
+            src_fids = _partition_fid_list(st, cur, p)
+            m = robustness_metrics()
+            before_failed = m.counter("fleet.ship.failed")
+            # positions 0/1 are pre-intent/post-digest; 2 is the second
+            # chunk boundary — at least one chunk has already applied
+            rule = faults.FaultRule(
+                "fleet.ship", "error", max_fires=1, skip=3
+            )
+            with faults.inject(rules=[rule]):
+                st.move_partition(p, t)
+            assert rule.fired == 1
+            assert m.counter("fleet.ship.failed") == before_failed + 1
+            # the failure committed its intent and left the obligation
+            assert not st._fleet_journal.pending_fanouts()
+            assert (p, t) in st._dirty
+            assert st.repair_dirty() >= 1
+            assert (p, t) not in st._dirty
+            got = _partition_fid_list(st, t, p)
+            assert len(got) == len(set(got))  # resume never re-applies
+            assert sorted(got) == sorted(src_fids)
+            assert st.ship_snapshot()["resumes"] >= 1
+        finally:
+            st.close()
+
+
+@pytest.mark.chaos
+def test_ship_crash_sweep_recovers_byte_identical_empty_journal(tmp_path):
+    """Satellite acceptance: a coordinator SimulatedCrash at EVERY
+    fleet.ship position — pre-intent, post-digest, every chunk
+    boundary, post-apply — recovers (recover_fleet + fan-out replay +
+    repair sweep) to parity on every query, a byte-identical
+    deduplicated replica wherever a ship intent survived, and an empty
+    journal."""
+    os.environ["GEOMESA_FLEET_SCAN_CHUNK_BYTES"] = "2048"
+    os.environ["GEOMESA_FLEET_SHIP_CHUNK_BYTES"] = "2048"
+    try:
+        data = rows(300)
+        single = ingest(TpuDataStore(), data=data)
+        want = {q: sorted(single.query("t", q).fids) for q in QUERIES}
+        position = 0
+        while position < 10:
+            root = tmp_path / f"shipsweep{position}"
+            with properties(geomesa_fleet_heartbeat_interval="150 ms"):
+                st = ingest(
+                    FleetDataStore(
+                        str(root), num_workers=3, replicas=1,
+                        partition_bits=2,
+                    ),
+                    data=data,
+                )
+                try:
+                    p = max(
+                        st._all_partitions(),
+                        key=lambda q: len(_partition_fid_list(
+                            st, st.placement.primary(q), q
+                        )),
+                    )
+                    cur = st.placement.primary(p)
+                    t = next(
+                        i for i in range(3)
+                        if i not in st.placement.chain(cur)
+                    )
+                    src_fids = sorted(
+                        set(_partition_fid_list(st, cur, p))
+                    )
+                    rule = faults.FaultRule(
+                        "fleet.ship", "crash", max_fires=1, skip=position
+                    )
+                    crashed = False
+                    with faults.inject(rules=[rule]):
+                        try:
+                            st.move_partition(p, t)
+                        except faults.SimulatedCrash:
+                            crashed = True
+                    if not crashed:
+                        # the sweep walked past the last position: the
+                        # uninjected move simply succeeded
+                        assert rule.fired == 0
+                        assert st.placement.primary(p) == t
+                        break
+                    # "coordinator restart" over the same root: placement
+                    # journal first, then the ship intent -> dirty mark,
+                    # then the repair sweep that completes the obligation
+                    st.recover_fleet()
+                    had_intent = bool(st._fleet_journal.pending_fanouts())
+                    st._replay_fanouts()
+                    st.repair_dirty()
+                    assert not st._fleet_journal.pending_fanouts()
+                    assert not st._fleet_journal.pending()
+                    assert st.placement.primary(p) in (cur, t), position
+                    for q, w in want.items():
+                        assert sorted(st.query("t", q).fids) == w, (
+                            position, q
+                        )
+                    if had_intent:
+                        # the intent survived the crash: recovery owed —
+                        # and delivered — a complete, deduplicated copy
+                        got = _partition_fid_list(st, t, p)
+                        assert len(got) == len(set(got)), position
+                        assert sorted(set(got)) == src_fids, position
+                finally:
+                    st.close()
+            position += 1
+        assert position >= 3, "the sweep never reached the protocol's interior"
+    finally:
+        os.environ.pop("GEOMESA_FLEET_SCAN_CHUNK_BYTES", None)
+        os.environ.pop("GEOMESA_FLEET_SHIP_CHUNK_BYTES", None)
+
+
+@pytest.mark.chaos
+def test_ssh_loopback_launcher_parity_and_respawn_through_spi(tmp_path):
+    """The SshLauncher over a local loopback template (no ssh binary,
+    same template + stdout-handshake path): full query parity, the
+    launcher block on /debug/fleet names the configured kind, and a
+    kill -9 respawns THROUGH the SPI — launch attempts tick up on the
+    same launcher, never a residual local Popen path."""
+    with properties(
+        geomesa_fleet_launcher="ssh",
+        geomesa_fleet_ssh_command=(
+            "{python} -m geomesa_tpu.parallel.fleet --worker --id {id} "
+            "--root {root} --announce stdout"
+        ),
+        geomesa_fleet_heartbeat_interval="150 ms",
+        geomesa_fleet_heartbeat_suspect="2",
+        geomesa_fleet_heartbeat_dead="3",
+    ):
+        st = ingest(
+            FleetDataStore(
+                str(tmp_path / "sshfleet"), num_workers=2, replicas=1,
+                partition_bits=2,
+            )
+        )
+        try:
+            want = sorted(st.query("t", "INCLUDE").fids)
+            snap = st.supervisor.launcher_snapshot()
+            assert snap["kind"] == "ssh"
+            assert all(
+                w["launch_attempts"] == 1 and w["handshake_ms"] > 0
+                for w in snap["workers"].values()
+            )
+            # the stdout handshake announced the REAL worker pid
+            pid = st.supervisor.worker_pid(0)
+            assert pid is not None and pid != os.getpid()
+            os.kill(pid, signal.SIGKILL)
+            assert _await(lambda: st.supervisor.restarts[0] >= 1)
+            assert _await(lambda: _fleet_settled(st))
+            snap = st.supervisor.launcher_snapshot()
+            assert snap["kind"] == "ssh"  # the respawn used the SPI...
+            assert snap["workers"]["0"]["launch_attempts"] >= 2  # ...again
+            assert st.supervisor.worker_pid(0) != pid
+            assert sorted(st.query("t", "INCLUDE").fids) == want
+            live = [
+                st.supervisor.worker_pid(i) for i in range(2)
+            ]
+        finally:
+            st.close()
+    # teardown must reap the shell-launched workers' whole process
+    # GROUP: killing only the `sh -c` wrapper orphans the worker it
+    # spawned, and two leaked idle workers poison every test and bench
+    # that runs after a fleet teardown on a small box
+    def _gone():
+        for p in live:
+            if p is None:
+                continue
+            try:
+                os.kill(p, 0)
+            except OSError:
+                continue
+            return False
+        return True
+
+    assert _await(_gone, timeout_s=10.0), f"ssh-launched workers leaked: {live}"
+
+
+@pytest.mark.chaos
+def test_asym_partition_drops_parity_or_crisp_then_heal(tmp_path, baseline):
+    """Tentpole acceptance, partition tolerance: drop 30% of ONE
+    direction of the fleet RPC at a time — coordinator->worker sends,
+    then worker->coordinator replies — and every query under the
+    partition either answers with full parity or fails crisply
+    (QueryTimeout / ShardUnavailable / StaleEpoch), never wrong or
+    truncated. When the partition heals the fleet settles back to
+    fully primary-owned with parity."""
+    with properties(geomesa_fleet_heartbeat_interval="150 ms"):
+        st = ingest(
+            FleetDataStore(
+                str(tmp_path / "asym"), num_workers=3, replicas=1,
+                partition_bits=2,
+            )
+        )
+        try:
+            for direction in ("fleet.rpc.send", "fleet.rpc.recv"):
+                outcomes = {"ok": 0, "crisp": 0}
+                rule = faults.FaultRule(direction, "drop", prob=0.3)
+                with faults.inject(rules=[rule], seed=7):
+                    t_end = time.monotonic() + 2.0
+                    qi = 0
+                    while time.monotonic() < t_end:
+                        q = QUERIES[qi % len(QUERIES)]
+                        qi += 1
+                        try:
+                            got = sorted(st.query("t", q).fids)
+                        except (QueryTimeout, ShardUnavailable, StaleEpoch):
+                            outcomes["crisp"] += 1
+                            continue
+                        assert got == baseline[q], (direction, q)
+                        outcomes["ok"] += 1
+                assert outcomes["ok"] > 0, direction
+                assert rule.fired > 0, direction  # the drops really flew
+            # healed: obligations sweep out, placement converges
+            st.repair_dirty()
+            assert _await(lambda: _fleet_settled(st), timeout_s=30.0)
+            fh = st.fleet_health()
+            assert fh["down"] == [] and fh["unowned_partitions"] == []
+            for q, w in baseline.items():
+                assert sorted(st.query("t", q).fids) == w
+        finally:
+            st.close()
+
+
+@pytest.mark.chaos
+def test_sigkill_target_mid_ship_repairs_to_identical_replica(
+    tmp_path, monkeypatch
+):
+    """kill -9 the TARGET worker while chunks are in flight: the ship
+    fails as a plain transport error (intent committed, dirty-mark
+    obligation), the supervisor respawns the worker — its journal
+    recovery keeps every chunk that already landed — and the repair
+    sweep resumes the ship to a byte-identical, deduplicated replica."""
+    monkeypatch.setenv("GEOMESA_FLEET_SCAN_CHUNK_BYTES", "2048")
+    monkeypatch.setenv("GEOMESA_FLEET_SHIP_CHUNK_BYTES", "2048")
+    data = rows(500)
+    with properties(
+        geomesa_fleet_heartbeat_interval="150 ms",
+        geomesa_fleet_heartbeat_suspect="2",
+        geomesa_fleet_heartbeat_dead="3",
+    ):
+        st = ingest(
+            FleetDataStore(
+                str(tmp_path / "shipkill"), num_workers=3, replicas=1,
+                partition_bits=2,
+            ),
+            data=data,
+        )
+        try:
+            p = max(
+                st._all_partitions(),
+                key=lambda q: len(_partition_fid_list(
+                    st, st.placement.primary(q), q
+                )),
+            )
+            cur = st.placement.primary(p)
+            t = next(
+                i for i in range(3) if i not in st.placement.chain(cur)
+            )
+            src_fids = sorted(set(_partition_fid_list(st, cur, p)))
+            pid = st.supervisor.worker_pid(t)
+            assert pid is not None
+
+            # stall the SECOND chunk boundary (one chunk already landed)
+            # long enough for the SIGKILL to land mid-ship
+            rule = faults.FaultRule(
+                "fleet.ship", "latency", latency_s=3.0, max_fires=1, skip=3
+            )
+
+            def killer():
+                # fire only once the stall has BEGUN — a wall-clock sleep
+                # can beat the first chunk apply on a slow box, and a kill
+                # before anything landed leaves the resume nothing to mask
+                t_end = time.monotonic() + 15.0
+                while rule.fired < 1 and time.monotonic() < t_end:
+                    time.sleep(0.01)
+                os.kill(pid, signal.SIGKILL)
+
+            th = threading.Thread(target=killer, daemon=True)
+            th.start()
+            with faults.inject(rules=[rule]):
+                st.move_partition(p, t)  # dirty-marks, never raises
+            th.join(timeout=10)
+            # the ship intent never outlives the failure (the dirty
+            # mark carries the obligation), and the target heals
+            assert not st._fleet_journal.pending_fanouts()
+            assert _await(lambda: st.supervisor.restarts[t] >= 1)
+            # (no _fleet_settled here: the MANUAL move keeps its
+            # placement override by design — await liveness + journal)
+            assert _await(
+                lambda: st.supervisor.all_live()
+                and not st._fleet_journal.pending(),
+                timeout_s=30.0,
+            )
+            st.repair_dirty()
+            assert not any(pair == (p, t) for pair in st._dirty)
+            got = _partition_fid_list(st, t, p)
+            assert len(got) == len(set(got))  # resume never re-applies
+            assert sorted(set(got)) == src_fids
+            assert st.ship_snapshot()["resumes"] >= 1
+            want = sorted(f for f, _ in data)
+            assert sorted(st.query("t", "INCLUDE").fids) == want
+        finally:
+            st.close()

@@ -101,6 +101,32 @@ def test_fault_rule_wildcard_and_max_fires():
     assert rule.fired == 2
 
 
+def test_directional_fault_points_split_a_duplex_boundary():
+    # a directional rule fires only its own direction: the asymmetric-
+    # partition primitive (drop coordinator->worker sends while
+    # worker->coordinator replies keep flowing, or vice versa)
+    before = counter("fault.fleet.rpc.send.drop")
+    with faults.inject(rules=[faults.FaultRule("fleet.rpc.send", "drop")]):
+        with pytest.raises(ConnectionError):
+            faults.fault_point("fleet.rpc", direction="send")
+        faults.fault_point("fleet.rpc", direction="recv")  # other way flows
+        faults.fault_point("fleet.rpc")  # bare exchange point untouched
+    assert counter("fault.fleet.rpc.send.drop") == before + 1
+    # the fleet.rpc.* wildcard matches the directional sub-points only —
+    # never the bare exchange point (which already drew its own rules)
+    wild = faults.FaultRule("fleet.rpc.*", "error")
+    with faults.inject(rules=[wild]):
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("fleet.rpc", direction="send")
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("fleet.rpc", direction="recv")
+        faults.fault_point("fleet.rpc")
+    assert wild.fired == 2
+    # both directions are registered boundaries, so lint/sweep tooling
+    # can enumerate them like any other point
+    assert {"fleet.rpc.send", "fleet.rpc.recv"} <= set(faults.FAULT_POINTS)
+
+
 def test_env_activation(monkeypatch):
     monkeypatch.setenv("GEOMESA_FAULTS", "metadata.save:error")
     with pytest.raises(faults.InjectedFault):

@@ -769,6 +769,14 @@ def test_timeline_keeps_recording_under_fault_schedules(monkeypatch):
     s = timeline.TimelineSampler(store=store, interval_s=0.02, window_s=30)
     s.start()
     try:
+        # the first tick only PRIMES the delta baseline (reports no
+        # deltas): faults fired before it would vanish into the baseline
+        # — on a small box the whole burst can beat the sampler thread's
+        # first schedule, so recording provably begins before the chaos
+        t_prime = time.time() + 5.0
+        while s.ticks < 1 and time.time() < t_prime:
+            time.sleep(0.005)
+        assert s.ticks >= 1, "sampler never primed"
         with faults.inject("device.fetch:error=0.4,device.dispatch:error=0.2",
                            seed=11):
             t_end = time.time() + 0.6
