@@ -957,6 +957,20 @@ class JoinPlanner:
         ):
             audit_mod.decision("join.kernel", "device_tripped")
             use_device = False
+        if use_device:
+            # brownout speculation gate (utils/brownout.py): at the
+            # hedge-off ladder level, fresh device build/compile work is
+            # capacity the queue needs more — the host reference join
+            # answers with identical pairs
+            bo = getattr(store, "_brownout", None)
+            if bo is not None and not bo.speculation_allowed():
+                from geomesa_tpu.utils import brownout as brownout_mod
+
+                if brownout_mod.enabled():
+                    audit_mod.decision(
+                        "join.kernel", "brownout", level=bo.level
+                    )
+                    use_device = False
         bi = pi = None
         path = "host-join"
         if use_device:

@@ -110,7 +110,7 @@ from geomesa_tpu.stream.netlog import (
     send_frame,
 )
 from geomesa_tpu.utils import deadline, devstats, faults, trace
-from geomesa_tpu.utils.admission import AdmissionController
+from geomesa_tpu.utils.admission import AdmissionController, classify
 from geomesa_tpu.utils.audit import (
     QueryTimeout,
     ShardUnavailable,
@@ -655,9 +655,19 @@ class _WorkerState:
                 st.delete_schema(name)
         return {"ok": 1}, []
 
+    def _shed_draining(self) -> None:
+        """The ONE drain-refusal path: while the supervisor migrates
+        this worker's partitions away, ops bounce with ShedLoad (the
+        coordinator fails over to a replica, no breaker strike) — one
+        reason-coded decision per refusal so a drain window reads as
+        routing, not as errors."""
+        if not self.draining:
+            return
+        decision("fleet.drain", "shed", worker=self.worker_id)
+        raise ShedLoad(f"fleet worker {self.worker_id} draining")
+
     def op_insert(self, head, payloads):
-        if self.draining:
-            raise ShedLoad(f"fleet worker {self.worker_id} draining")
+        self._shed_draining()
         batch = head.get("batch")
         if batch is not None:
             # check-AND-SET under the lock: the reservation lands
@@ -712,8 +722,7 @@ class _WorkerState:
         return {"ok": 1, "inventory": out}, []
 
     def op_scan(self, head, payloads):
-        if self.draining:
-            raise ShedLoad(f"fleet worker {self.worker_id} draining")
+        self._shed_draining()
         query = _query_from_wire(head)
         chunk_bytes = _scan_chunk_bytes()
         if chunk_bytes > 0:
@@ -722,7 +731,7 @@ class _WorkerState:
             # last partition is scanned and neither side ever holds the
             # full materialization
             return {"ok": 1, "stream": 1}, self._scan_chunks(head, query, chunk_bytes)
-        with self.admission.admit():
+        with self.admission.admit(priority=classify(query.hints)):
             receipt: Dict[str, int] = {}
             frames: List[bytes] = []
             rows = 0
@@ -754,7 +763,7 @@ class _WorkerState:
         frame, never a truncated result. The admission slot is held for
         the stream's whole life (the handler ``close()``s the generator
         on abort, which releases it)."""
-        with self.admission.admit():
+        with self.admission.admit(priority=classify(query.hints)):
             receipt: Dict[str, int] = {}
             rows = 0
             chunks = 0
@@ -784,14 +793,14 @@ class _WorkerState:
         return {"ok": 1, "count": int(n)}, []
 
     def op_count_filtered(self, head, payloads):
-        if self.draining:
-            raise ShedLoad(f"fleet worker {self.worker_id} draining")
-        with self.admission.admit():
+        self._shed_draining()
+        query = _query_from_wire(head)
+        with self.admission.admit(priority=classify(query.hints)):
             st = self._store(head["partition"], create=False)
             n = (
                 0
                 if st is None or head["name"] not in st.type_names
-                else st.count(head["name"], _query_from_wire(head))
+                else st.count(head["name"], query)
             )
             return {"ok": 1, "count": int(n)}, []
 
@@ -835,8 +844,7 @@ class _WorkerState:
         The digest is BOTH the coordinator's skip-mask and this side's
         idempotency set — rows landed by a previous crashed ship are in
         it, so re-shipping after any crash position only fills gaps."""
-        if self.draining:
-            raise ShedLoad(f"fleet worker {self.worker_id} draining")
+        self._shed_draining()
         name = head["name"]
         partition = head["partition"]
         ship = str(head["ship"])
@@ -2709,6 +2717,11 @@ class FleetDataStore(ShardedDataStore):
         self._lease_stop: Optional[threading.Event] = None
         self._lease_thread: Optional[threading.Thread] = None
         self.transport = transport
+        # last-known worker admission peeks, refreshed by the sampler
+        # tick (`_timeline_extra`): the `_admission_peek` override
+        # answers pre-dispatch backpressure from this cache — the
+        # dispatch path must never pay a wire RPC to ask "busy?"
+        self._admission_peek_cache: Dict[int, Optional[Dict[str, Any]]] = {}
         self.supervisor: Optional[FleetSupervisor] = None
         if standby:
             # a standby must not touch SHARED state while the active
@@ -3673,8 +3686,14 @@ class FleetDataStore(ShardedDataStore):
             }
             if row.get("unreachable"):
                 shard["unreachable"] = True
+                self._admission_peek_cache.pop(i, None)
             else:
                 shard["admission"] = row.get("admission")
+                # pre-dispatch backpressure reads THIS cache (base
+                # `_admission_peek` would reach for an attribute the
+                # remote WorkerClient doesn't have): one tick of
+                # staleness is the price of a zero-RPC dispatch path
+                self._admission_peek_cache[i] = row.get("admission")
                 shard["partitions"] = row.get("partitions")
                 shard["plans"] = row.get("plans", [])
                 shard["tenants"] = row.get("tenants", [])
@@ -3707,6 +3726,16 @@ class FleetDataStore(ShardedDataStore):
                 ),
             },
         }
+
+    def _admission_peek(self, sid: int) -> Optional[Dict[str, Any]]:
+        """Backpressure peek, fleet edition: the process transport's
+        workers live behind RPC, so the dispatch path reads the sampler
+        tick's cached peek (one beat stale, zero wire cost); the inproc
+        transport keeps the base direct read. No cache entry (sampler
+        off, worker unreachable) means "unknown" — never saturated."""
+        if self.transport != "process":
+            return super()._admission_peek(sid)
+        return self._admission_peek_cache.get(sid)
 
     def _fleet_exemplars(self) -> Dict[str, Dict[int, tuple]]:
         """Worker-minted class-timer exemplars, as gathered by the last

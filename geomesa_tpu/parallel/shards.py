@@ -92,7 +92,7 @@ from geomesa_tpu.store.datastore import (
 from geomesa_tpu.store.partitions import Z2Scheme
 from geomesa_tpu.utils import deadline
 from geomesa_tpu.utils import devstats, faults, trace
-from geomesa_tpu.utils.admission import AdmissionController
+from geomesa_tpu.utils.admission import AdmissionController, classify
 from geomesa_tpu.utils.audit import (
     QueryTimeout,
     ShardUnavailable,
@@ -347,7 +347,7 @@ class ShardWorker:
         shard's admission budget; the caller's ambient deadline slice
         bounds every block. The receipt is an EXACT context-local
         collector — a hedge race cannot bleed bytes between scans."""
-        with self.admission.admit():
+        with self.admission.admit(priority=classify(query.hints)):
             receipt: Dict[str, int] = {}
             out_cols: List[dict] = []
             rows = 0
@@ -374,7 +374,7 @@ class ShardWorker:
         it when hot — ops/pyramid.py). Same envelope as ``scan``: a
         shed routes the coordinator to a replica, the ambient deadline
         slice bounds the underlying blocks."""
-        with self.admission.admit():
+        with self.admission.admit(priority=classify(query.hints)):
             with self._lock:
                 st = self._stores.get(partition)
             return 0 if st is None else st.count(name, query)
@@ -721,6 +721,32 @@ class ShardedDataStore(TpuDataStore):
         chain's edition of ``_scan_chain``)."""
         return self.placement.targets(p)
 
+    def _admission_peek(self, sid: int) -> Optional[Dict[str, Any]]:
+        """One worker's admission peek for pre-dispatch backpressure —
+        in-process workers read directly (lock-free attribute reads);
+        the fleet tier overrides with its last heartbeat/timeline cache
+        (a peek must NEVER cost an RPC on the dispatch path)."""
+        adm = getattr(self.workers[sid], "admission", None)
+        return adm.peek() if adm is not None else None
+
+    def _placement_saturated(self, sid: int) -> bool:
+        """True when the worker's last-known admission peek shows every
+        in-flight slot taken AND queries queuing behind them: a dispatch
+        would join the queue, not run. Stale-peek misjudgments are safe
+        either way — route to the replica (same rows) or queue briefly."""
+        try:
+            peek = self._admission_peek(sid)
+        except Exception:  # noqa: BLE001 - a peek must never fail a dispatch
+            return False
+        if not peek:
+            return False
+        mi = peek.get("max_inflight")
+        return (
+            mi is not None
+            and peek.get("inflight", 0) >= mi
+            and peek.get("queued", 0) > 0
+        )
+
     def _count_one_partition(self, name: str, wq: Query, p: str) -> int:
         """One partition's count through its placement chain under the
         per-shard breaker protocol (every ``allow()`` gets a verdict)."""
@@ -909,6 +935,12 @@ class ShardedDataStore(TpuDataStore):
         chains: Dict[int, List[int]] = {
             gid: self._scan_chain(gid, groups[gid]) for gid in groups
         }
+        # fleet backpressure rides the brownout switch: enabled=0 must
+        # reproduce today's dispatch order byte-for-byte
+        from geomesa_tpu.utils import brownout as brownout_mod
+
+        brownout = getattr(self, "_brownout", None)
+        backpressure_on = brownout is not None and brownout_mod.enabled()
 
         def outcome(gid: int) -> Dict[str, Any]:
             return outcomes.setdefault(str(gid), {"partitions": len(groups[gid])})
@@ -918,13 +950,37 @@ class ShardedDataStore(TpuDataStore):
             # replica, not back to itself); then ONE re-dispatch per
             # placement so a transient fault on every placement is still
             # absorbed (the boundary's bounded-retry budget — the
-            # deadline caps the ladder like everywhere else)
+            # deadline caps the ladder like everywhere else). On the
+            # untried pass, a placement whose last-known admission peek
+            # shows it SATURATED (slots full, queries queuing) is
+            # deferred in favor of an idle replica — backpressure
+            # steering, not a breaker verdict: the worker is healthy,
+            # just busy, so no strike and no probe slot is spent on the
+            # skip. Saturated placements remain the fallback when every
+            # alternative is refused (better a queued slot than none).
             chain = chains[gid]
             for dispatched in (0, 1):
+                deferred: List[int] = []
                 for t in chain:
                     if tried[gid].count(t) != dispatched:
                         continue
+                    if (
+                        dispatched == 0
+                        and backpressure_on
+                        and len(chain) > 1
+                        and self._placement_saturated(t)
+                    ):
+                        # checked BEFORE allow(): the defer must not
+                        # consume a half-open probe slot it won't use
+                        deferred.append(t)
+                        continue
                     if self._breakers[t].allow():
+                        for s in deferred:
+                            metrics.inc("shard.backpressure.reroute")
+                            decision(
+                                "backpressure", "reroute",
+                                shard=s, to=t, group=gid,
+                            )
                         return t
                     if dispatched == 0:
                         # breaker open/probing: zero dispatch cost —
@@ -936,6 +992,9 @@ class ShardedDataStore(TpuDataStore):
                             decision(
                                 "breaker", "reroute", shard=t, group=gid
                             )
+                for t in deferred:
+                    if self._breakers[t].allow():
+                        return t
             return None
 
         def dispatch(gid: int, hedge: bool) -> bool:
@@ -1080,6 +1139,36 @@ class ShardedDataStore(TpuDataStore):
             )
 
         released: Set[int] = set()
+        # pre-fan-out shed: with the brownout ladder active, a
+        # NON-critical query facing a group whose EVERY placement is
+        # saturated would only join queues a burning fleet can't drain —
+        # refuse it here with the burn-derived Retry-After, before a
+        # single dispatch. A stale all-saturated read costs one early
+        # 503 on a sheddable class, never a truncated answer; critical
+        # traffic always proceeds to the normal dispatch ladder.
+        if backpressure_on and brownout.level >= 1:
+            pri = classify(wq.hints)
+            if pri != "critical":
+                for gid, chain in chains.items():
+                    if not chain or not all(
+                        self._placement_saturated(t) for t in chain
+                    ):
+                        continue
+                    metrics.inc("shed.fanout")
+                    metrics.inc(f"shed.priority.{pri}")
+                    outcome(gid)["outcome"] = "shed_fanout"
+                    decision(
+                        "backpressure", "shed_fanout", group=gid,
+                        priority=pri, level=brownout.level,
+                    )
+                    err = ShedLoad(
+                        f"fan-out refused: every placement {chain} of "
+                        f"shard group {gid} is saturated and brownout "
+                        f"level {brownout.level} is active — retry "
+                        "after backoff"
+                    )
+                    err.retry_after_s = brownout.retry_after_s()
+                    raise err
         try:
             for gid in groups:
                 outcome(gid)
@@ -1123,6 +1212,14 @@ class ShardedDataStore(TpuDataStore):
                         _quantile(lat_done, self._hedge_q), self._hedge_min_s
                     )
                     now = time.perf_counter()
+                    # brownout hedge-off: at speculation-off levels a
+                    # hedge is a SECOND copy of work the fleet already
+                    # can't drain — suppressed fleet-wide, once per
+                    # group (the level is re-read each tick, so a
+                    # recovering fleet resumes hedging mid-gather)
+                    hedge_off = (
+                        backpressure_on and not brownout.hedging_allowed()
+                    )
                     for gid, alist in inflight.items():
                         if (
                             gid in results
@@ -1132,6 +1229,14 @@ class ShardedDataStore(TpuDataStore):
                             continue
                         a = alist[0]
                         if now - a.t0 <= thr:
+                            continue
+                        if hedge_off:
+                            hedge_decided.add(gid)
+                            metrics.inc("shard.hedge.suppressed")
+                            decision(
+                                "hedge", "brownout_suppressed", group=gid,
+                                level=brownout.level,
+                            )
                             continue
                         hedge_decided.add(gid)
                         if dispatch(gid, hedge=True):

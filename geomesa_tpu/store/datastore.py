@@ -41,6 +41,7 @@ from geomesa_tpu.store.blocks import (
     take_rows,
 )
 from geomesa_tpu.store.metadata import InMemoryMetadata, Metadata
+from geomesa_tpu.utils import admission as admission_mod
 from geomesa_tpu.utils import audit as audit_mod
 from geomesa_tpu.utils import deadline as deadline_mod
 from geomesa_tpu.utils import devstats, trace
@@ -329,6 +330,15 @@ class TpuDataStore:
             mq = QUERY_QUEUE_DEPTH.to_int()
             max_queue = 256 if mq is None else mq
         self.admission = AdmissionController(max_inflight, max_queue)
+        # closed-loop brownout (utils/brownout.py): the timeline tick
+        # drives the ladder off queue depth, SLO burn, and breaker
+        # state; the admission gate consults it per query. The
+        # controller exists unconditionally (one attribute read when
+        # idle); geomesa.brownout.enabled=0 bypasses every gate.
+        from geomesa_tpu.utils.brownout import BrownoutController
+
+        self._brownout = BrownoutController()
+        self.admission.brownout = self._brownout
         # write-time maintained sketches feeding the cost-based decider
         # (accumulo/data/stats/StatsCombiner.scala:26 analog)
         self.stats = stats if stats is not None else MetadataBackedStats(self.metadata)
@@ -624,6 +634,21 @@ class TpuDataStore:
         pyr = cache.get(key, ttl)
         if pyr is not None:
             return pyr
+        # brownout speculation gate: a COLD pyramid build is optional
+        # work (the exact scan answers identically) — at hedge-off
+        # levels the capacity it would burn belongs to queued queries.
+        # A warm pyramid above keeps serving; only the build defers
+        bo = getattr(self, "_brownout", None)
+        if bo is not None and not bo.speculation_allowed():
+            from geomesa_tpu.utils import brownout as brownout_mod
+            from geomesa_tpu.utils.audit import robustness_metrics
+
+            if brownout_mod.enabled():
+                robustness_metrics().inc("agg.cache.declined")
+                audit_mod.decision(
+                    "pyramid", "brownout_deferred", level=bo.level
+                )
+                return None
         try:
             pyr = build_pyramid(table, ft, self.executor)
         except Exception as e:  # noqa: BLE001 - injected/device build failure
@@ -880,7 +905,9 @@ class TpuDataStore:
             ) as root:
                 try:
                     with deadline_mod.budget(self.query_timeout_s):
-                        with self.admission.admit():
+                        with self.admission.admit(
+                            priority=admission_mod.classify(q.hints)
+                        ):
                             self._prepare_query(name, q)
                             got = self._aggregate_pyramid(name, ft, q, cols)
                             if got is None:
@@ -1157,7 +1184,9 @@ class TpuDataStore:
                     # spend the same budget — a query can never cost more
                     # than its deadline (± one fault-point granularity)
                     with deadline_mod.budget(self.query_timeout_s):
-                        with self.admission.admit():
+                        with self.admission.admit(
+                            priority=admission_mod.classify(query.hints)
+                        ):
                             # cross-query coalescing (parallel/batch.py):
                             # STRICTLY after admit — shedding semantics
                             # untouched — concurrently admitted queries
@@ -1330,7 +1359,9 @@ class TpuDataStore:
                         # the inner build/probe queries ride this slot
                         # (reentrant admit), so a join can never
                         # deadlock against itself
-                        with self.admission.admit():
+                        with self.admission.admit(
+                            priority=admission_mod.classify(probe_q.hints)
+                        ):
                             dev0 = devstats.receipt_snapshot()
                             result = JoinPlanner(self).join(
                                 build_name, build_q, probe_name, probe_q,
@@ -1491,7 +1522,15 @@ class TpuDataStore:
                 # batchmates waiting for slots. The queue wait itself is
                 # bounded by one query budget (the per-phase budgets
                 # below don't exist yet while we wait).
-                with self.admission.admit(self.query_timeout_s):
+                # the batch classifies as its MOST important member
+                # (lowest PRIORITIES index): a background flood must not
+                # shed the one critical query riding the same batch
+                batch_pri = min(
+                    (admission_mod.classify(q.hints) for q in qs),
+                    key=admission_mod.PRIORITIES.index,
+                    default=None,
+                )
+                with self.admission.admit(self.query_timeout_s, batch_pri):
                     # batch-level cost receipt: the pipelined phase-1 work
                     # (mirror uploads, compiles triggered by dispatch_many)
                     # happens OUTSIDE the per-query resolve windows, so the
@@ -1675,10 +1714,22 @@ class TpuDataStore:
             else None
         )
         ctl = self.admission
+        pri = admission_mod.classify(q.hints)
         rode_slot = ctl._ctx_held.get()
         if not rode_slot:
+            # the brownout gate runs here too (the _Admit context
+            # manager's posture): a shed-class stream refuses in O(1)
+            # before any slot bookkeeping
+            bo = ctl.brownout
+            if bo is not None and bo.level > 0 and bo.should_shed(pri):
+                from geomesa_tpu.utils import brownout as brownout_mod
+
+                if brownout_mod.enabled():
+                    ctl._brownout_shed(
+                        pri, bo.level, bo.retry_after_s(), fail_fast=False
+                    )
             with deadline_mod.attach(dl):
-                ctl._acquire()
+                ctl._acquire(pri)
         hits = 0
         plan = None
         # plans pending scope, generator edition: the collector object
@@ -1836,7 +1887,7 @@ class TpuDataStore:
                     self.metrics.inc("queries.stream")
         finally:
             if not rode_slot:
-                ctl._release()
+                ctl._release(pri)
 
     def _iter_stream_shard_cols(self, name, ft, q: Query, plan, t0):
         """Sharded-streaming seam: coordinators whose rows live in shard

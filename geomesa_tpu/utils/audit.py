@@ -920,9 +920,14 @@ class QueryTimeout(RuntimeError):
 class ShedLoad(RuntimeError):
     """Raised when admission control refuses a query outright: every
     in-flight slot is taken AND the bounded wait queue is full
-    (``utils.admission``). Deliberately fast and cheap — shedding exists
-    so overload degrades to quick, honest 503s instead of queueing into
-    collapse. web.py maps it to 503 + Retry-After."""
+    (``utils.admission``), or the brownout ladder sheds the query's
+    priority class (``utils.brownout``). Deliberately fast and cheap —
+    shedding exists so overload degrades to quick, honest 503s instead
+    of queueing into collapse. web.py maps it to 503 + Retry-After;
+    ``retry_after_s`` (when a brownout supplies its burn-derived
+    backoff) overrides the header's default of 1 second."""
+
+    retry_after_s: Optional[float] = None
 
 
 class ShardUnavailable(RuntimeError):

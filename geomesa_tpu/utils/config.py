@@ -447,3 +447,51 @@ FEATURE_EXPIRY = SystemProperty("geomesa.feature.expiry", None)
 # in the backing KV store, not in client memory). Off by default.
 SPILL_DIR = SystemProperty("geomesa.spill.dir", None)
 SPILL_MIN_BYTES = SystemProperty("geomesa.spill.min.bytes", "4MB")
+# Priority classes (utils/admission.py): the `geomesa.query.priority`
+# query hint (web.py maps the X-Geomesa-Priority header into it) and the
+# per-tenant default map classify every query/join/aggregate/stream as
+# critical / interactive / batch / background. `priority.default` names
+# the class for unhinted traffic; `admission.critical.reserve` holds
+# that many in-flight slots back from NON-critical classes, so a
+# background flood can never starve critical traffic even while healthy
+# (explicit 0 disables the floor). `tenants.priority` is a per-tenant
+# default map, "tenantA=critical,tenantB=background".
+PRIORITY_DEFAULT = SystemProperty("geomesa.priority.default", "interactive")
+ADMISSION_CRITICAL_RESERVE = SystemProperty(
+    "geomesa.admission.critical.reserve", "1"
+)
+TENANTS_PRIORITY = SystemProperty("geomesa.tenants.priority", None)
+# Brownout controller (utils/brownout.py): a deterministic overload
+# ladder driven each timeline tick by SLO burn, admission queue depth,
+# and breaker states — level 0 normal, 1 sheds background, 2 sheds
+# batch and disables hedging + cold speculative builds, 3 fail-fasts
+# everything below critical. `enabled=0` is byte-identical to a build
+# without the controller. Levels ENTER after `enter.ticks` consecutive
+# over-threshold ticks and EXIT after `exit.ticks` clear ones
+# (hysteresis — the ladder must never flap on one noisy second).
+# `queue.ratio.*` are the admission (queued / max_queue) thresholds for
+# levels 1-3; `retry.after.s` is the floor of the burn-derived
+# Retry-After that shed responses carry.
+BROWNOUT_ENABLED = SystemProperty("geomesa.brownout.enabled", "true")
+BROWNOUT_ENTER_TICKS = SystemProperty("geomesa.brownout.enter.ticks", "2")
+BROWNOUT_EXIT_TICKS = SystemProperty("geomesa.brownout.exit.ticks", "3")
+BROWNOUT_QUEUE_RATIO_1 = SystemProperty("geomesa.brownout.queue.ratio.1", "0.5")
+BROWNOUT_QUEUE_RATIO_2 = SystemProperty(
+    "geomesa.brownout.queue.ratio.2", "0.75"
+)
+BROWNOUT_QUEUE_RATIO_3 = SystemProperty(
+    "geomesa.brownout.queue.ratio.3", "0.95"
+)
+BROWNOUT_RETRY_AFTER_S = SystemProperty("geomesa.brownout.retry.after.s", "1")
+# Retry budgets (utils/retry.py): a per-boundary token bucket caps
+# retries at ~`ratio` of that boundary's traffic (the classic 10% rule)
+# so a retry storm can never amplify an overload — exhaustion gives up
+# crisply (the original error) and counts retry.<name>.budget_exhausted.
+# `min` is a per-SECOND refill floor (the Finagle RetryBudget shape) so
+# low-traffic boundaries — and fault-heavy chaos soaks, where injected
+# failure rates dwarf any traffic ratio — still recover their ability
+# to retry; `cap` bounds the burst a long-idle bucket can save up.
+RETRY_BUDGET_ENABLED = SystemProperty("geomesa.retry.budget.enabled", "true")
+RETRY_BUDGET_RATIO = SystemProperty("geomesa.retry.budget.ratio", "0.1")
+RETRY_BUDGET_MIN = SystemProperty("geomesa.retry.budget.min", "10")
+RETRY_BUDGET_CAP = SystemProperty("geomesa.retry.budget.cap", "100")

@@ -13,17 +13,128 @@ Backoff is exponential with decorrelated jitter (the AWS architecture
 blog's variant): ``sleep_i = min(cap, uniform(base, 3 * sleep_{i-1}))``.
 Decorrelation keeps a thundering herd of retriers from re-colliding on
 the same schedule; the cap bounds tail latency.
+
+Layered over the per-call ladder is a per-BOUNDARY retry budget (one
+token bucket per policy ``name``, shared by every policy instance with
+that name): each initial call deposits ``geomesa.retry.budget.ratio``
+tokens, the bucket refills at least ``geomesa.retry.budget.min`` tokens
+per second, and each retry spends one. The ratio deposit is the classic
+~10%-of-traffic rule — under a true outage, retries cannot amplify the
+boundary's traffic by more than ~ratio, so a retry storm can't finish
+off a struggling dependency. The time-based floor is the Finagle
+RetryBudget refinement: low-traffic boundaries (and fault-injection
+soaks, whose failure rates dwarf any traffic ratio) still recover the
+ability to retry. Exhaustion gives up crisply — the ORIGINAL exception,
+plus ``retry.<name>.budget_exhausted`` and a reason-coded decision — so
+the failure reads as "budget spent", never as a silent hang.
 """
 
 from __future__ import annotations
 
 import random
+import threading
 import time
-from typing import Callable, Optional, Tuple, Type, Union
+from typing import Callable, Dict, Optional, Tuple, Type, Union
 
-from geomesa_tpu.utils.audit import robustness_metrics
+from geomesa_tpu.utils.audit import decision, robustness_metrics
 
 Retryable = Union[Tuple[Type[BaseException], ...], Callable[[BaseException], bool]]
+
+# -- per-boundary retry budgets ----------------------------------------------
+
+# (enabled, deposit ratio, per-second refill floor, bucket cap) — cached
+# after first read, the usual free-when-off shape; reset_budgets() for
+# tests and config reloads
+_CFG: Optional[Tuple[bool, float, float, float]] = None
+_BUDGETS: Dict[str, "_TokenBudget"] = {}
+_BUDGETS_LOCK = threading.Lock()
+
+
+def _cfg() -> Tuple[bool, float, float, float]:
+    global _CFG
+    cfg = _CFG
+    if cfg is None:
+        from geomesa_tpu.utils.config import (
+            RETRY_BUDGET_CAP,
+            RETRY_BUDGET_ENABLED,
+            RETRY_BUDGET_MIN,
+            RETRY_BUDGET_RATIO,
+        )
+
+        enabled = RETRY_BUDGET_ENABLED.to_bool()
+        ratio = RETRY_BUDGET_RATIO.to_float()
+        floor = RETRY_BUDGET_MIN.to_float()
+        cap = RETRY_BUDGET_CAP.to_float()
+        cfg = (
+            True if enabled is None else bool(enabled),
+            0.1 if ratio is None else max(0.0, ratio),
+            10.0 if floor is None else max(0.0, floor),
+            100.0 if cap is None else max(1.0, cap),
+        )
+        _CFG = cfg
+    return cfg
+
+
+class _TokenBudget:
+    """One boundary's bucket. Starts full (a fresh process may retry its
+    first failures — cold starts are exactly when dependencies flap)."""
+
+    __slots__ = ("tokens", "cap", "_last", "_lock")
+
+    def __init__(self, cap: float):
+        self.cap = cap
+        self.tokens = cap
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill_locked(self, floor_per_s: float) -> None:
+        now = time.monotonic()
+        dt = now - self._last
+        self._last = now
+        if dt > 0 and floor_per_s > 0:
+            self.tokens = min(self.cap, self.tokens + dt * floor_per_s)
+
+    def deposit(self, ratio: float, floor_per_s: float) -> None:
+        with self._lock:
+            self._refill_locked(floor_per_s)
+            self.tokens = min(self.cap, self.tokens + ratio)
+
+    def try_spend(self, floor_per_s: float) -> bool:
+        with self._lock:
+            self._refill_locked(floor_per_s)
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                return True
+            return False
+
+
+def _budget_for(name: str) -> "_TokenBudget":
+    b = _BUDGETS.get(name)
+    if b is None:
+        with _BUDGETS_LOCK:
+            b = _BUDGETS.get(name)
+            if b is None:
+                b = _TokenBudget(_cfg()[3])
+                _BUDGETS[name] = b
+    return b
+
+
+def reset_budgets() -> None:
+    """Drop every bucket and the cached knobs (tests, config reloads)."""
+    global _CFG
+    with _BUDGETS_LOCK:
+        _CFG = None
+        _BUDGETS.clear()
+
+
+def budgets_snapshot() -> Dict[str, Dict[str, float]]:
+    """Point-in-time token levels per boundary (``/debug/overload``)."""
+    with _BUDGETS_LOCK:
+        items = list(_BUDGETS.items())
+    return {
+        name: {"tokens": round(b.tokens, 2), "cap": b.cap}
+        for name, b in items
+    }
 
 
 class RetryPolicy:
@@ -79,6 +190,13 @@ class RetryPolicy:
         immediately instead of burning the budget asleep."""
         from geomesa_tpu.utils import deadline as _deadline
 
+        enabled, ratio, floor, _cap = _cfg()
+        budget = _budget_for(self.name) if enabled else None
+        if budget is not None:
+            # the DEPOSIT happens per initial call, not per retry: the
+            # bucket tracks the boundary's real traffic, so sustained
+            # retries are bounded at ~ratio of it
+            budget.deposit(ratio, floor)
         t0 = time.monotonic()
         ambient = _deadline.ambient()
         prev = self.base_s
@@ -106,6 +224,18 @@ class RetryPolicy:
                     # budget — the final sleep is pointless; give up NOW
                     # with the budget intact for the caller's cleanup
                     robustness_metrics().inc(f"retry.{self.name}.giveup")
+                    raise
+                if budget is not None and not budget.try_spend(floor):
+                    # the boundary-wide budget is spent: more retries
+                    # here would amplify whatever is melting the
+                    # dependency. Fail crisply with the ORIGINAL error
+                    robustness_metrics().inc(
+                        f"retry.{self.name}.budget_exhausted"
+                    )
+                    decision(
+                        "retry", "budget_exhausted",
+                        policy=self.name, attempt=attempt,
+                    )
                     raise
                 robustness_metrics().inc(f"retry.{self.name}.retries")
                 self._sleep(prev)

@@ -101,6 +101,52 @@ def clean_label(label: Any) -> str:
     return label[:MAX_LABEL]
 
 
+# -- per-tenant default priority ----------------------------------------------
+#
+# The middle rung of the priority ladder (utils/admission.py classify):
+# an explicit `geomesa.query.priority` hint wins, then the query's
+# tenant looks up here, then `geomesa.priority.default`. The map knob is
+# "tenantA=critical,tenantB=background" — parsed once and cached (the
+# flag posture above), so the per-admit lookup is one dict get.
+
+_PRIORITY_MAP: Optional[Dict[str, str]] = None
+
+
+def default_priority(tenant: str) -> Optional[str]:
+    """The tenant's configured default priority class, or None when the
+    map has no entry (the caller falls through to the global default)."""
+    m = _PRIORITY_MAP
+    if m is None:
+        m = _resolve_priority_map()
+    return m.get(tenant)
+
+
+def _resolve_priority_map() -> Dict[str, str]:
+    global _PRIORITY_MAP
+    from geomesa_tpu.utils.config import TENANTS_PRIORITY
+
+    raw = TENANTS_PRIORITY.get()
+    out: Dict[str, str] = {}
+    if raw:
+        from geomesa_tpu.utils.admission import PRIORITIES
+
+        for pair in str(raw).split(","):
+            label, _, cls = pair.partition("=")
+            label = clean_label(label)
+            cls = cls.strip().lower()
+            if label != ANON and cls in PRIORITIES:
+                out[label] = cls
+    _PRIORITY_MAP = out
+    return out
+
+
+def reset_priority_map() -> None:
+    """Drop the cached map (re-parsed on the next lookup) — for tests
+    and config reloads that flip ``geomesa.tenants.priority``."""
+    global _PRIORITY_MAP
+    _PRIORITY_MAP = None
+
+
 # -- the registry -------------------------------------------------------------
 
 

@@ -176,6 +176,22 @@ class TimelineSampler:
         from geomesa_tpu.utils.breaker import peek_states
 
         counters, gauges, timers, totals = self._merged_snapshot()
+        # the brownout control loop RUNS on this tick (the one
+        # deliberate exception to the watches-never-drives rule: the
+        # ladder needs exactly one periodic evaluation point, and the
+        # sampler is it). OUTSIDE the ring lock — the controller reads
+        # the SLO engine, whose window() copy takes this same lock.
+        # Returns None for a quiet healthy store, keeping the tick
+        # byte-identical; geomesa.brownout.enabled=0 never evaluates
+        bblock = None
+        _store0 = self._store()
+        if _store0 is not None:
+            bo = getattr(_store0, "_brownout", None)
+            if bo is not None:
+                from geomesa_tpu.utils import brownout as _brownout
+
+                if _brownout.enabled():
+                    bblock = bo.on_tick(_store0)
         with self._lock:
             snap: Dict[str, Any] = {
                 "t": time.time(),
@@ -254,6 +270,8 @@ class TimelineSampler:
                 extra = getattr(store, "_timeline_extra", None)
                 if extra is not None:
                     snap.update(extra())
+                if bblock is not None:
+                    snap["brownout"] = bblock
             self._ring.append(snap)
             self.ticks += 1
             return snap

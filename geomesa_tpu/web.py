@@ -162,27 +162,46 @@ def debug_device_payload(store):
 
 
 def debug_overload_payload(store):
+    from geomesa_tpu.utils import retry as retry_mod
     from geomesa_tpu.utils.audit import robustness_metrics
     from geomesa_tpu.utils.breaker import breaker_states
 
     counters, _g, _t, _tt = robustness_metrics().snapshot()
     adm = getattr(store, "admission", None)
     snap_fn = getattr(store, "shards_snapshot", None)
+    bo = getattr(store, "_brownout", None)
     return {
         "breakers": breaker_states(),
         # admission snapshot includes the wait-time histogram summary
-        # (p50/p99): were queries queuing long before sheds, or did
-        # traffic spike straight past the queue?
+        # (p50/p99) — overall AND per priority class: were queries
+        # queuing long before sheds, and WHOSE queries (a background
+        # flood shows up as background p99 exploding while the critical
+        # reserve keeps critical p99 flat)?
         "admission": None if adm is None else adm.snapshot(),
+        # the brownout ladder's position + the signals that put it there
+        # (utils/brownout.py)
+        "brownout": None if bo is None else bo.snapshot(),
+        # per-boundary retry-budget token levels (utils/retry.py): a
+        # drained bucket beside budget_exhausted counters explains WHY
+        # a boundary stopped retrying
+        "retry_budgets": retry_mod.budgets_snapshot(),
         # per-shard breaker + admission states for sharded stores
         # (parallel/shards.py)
         "shards": None if snap_fn is None else snap_fn(),
         "counters": {
             k: v
             for k, v in sorted(counters.items())
-            if k.startswith(("shed.", "breaker.", "deadline.", "shard."))
+            if k.startswith(("shed.", "breaker.", "deadline.", "shard.",
+                             "brownout."))
         },
     }
+
+
+def debug_brownout_payload(store):
+    """The brownout block standalone (it also rides /debug/overload):
+    ladder level, driving signals, recent transitions, shed counters."""
+    bo = getattr(store, "_brownout", None)
+    return {"brownout": None if bo is None else bo.snapshot()}
 
 
 def debug_recovery_payload(store):
@@ -401,6 +420,7 @@ REPORT_SECTIONS = {
     "traces": lambda store, s: debug_traces_payload(store, 20),
     "device": lambda store, s: debug_device_payload(store),
     "overload": lambda store, s: debug_overload_payload(store),
+    "brownout": lambda store, s: debug_brownout_payload(store),
     "recovery": lambda store, s: debug_recovery_payload(store),
     "timeline": lambda store, s: debug_timeline_payload(store, s),
     "slo": lambda store, s: debug_slo_payload(store),
@@ -500,9 +520,17 @@ def make_handler(store):
                 self.close_connection = True
                 return
             if isinstance(e, (ShedLoad, ShardUnavailable)):
+                # a brownout shed carries its burn-derived backoff on
+                # the exception; plain admission sheds keep the 1s
+                # default (honest and cheap beats clever here)
+                ra = getattr(e, "retry_after_s", None)
                 self._send(
                     503, json.dumps({"error": str(e)}),
-                    headers={"Retry-After": "1"},
+                    headers={
+                        "Retry-After": (
+                            "1" if ra is None else str(int(max(1, ra)))
+                        )
+                    },
                 )
             elif isinstance(e, QueryTimeout):
                 self._send(504, json.dumps({"error": str(e)}))
@@ -547,12 +575,20 @@ def make_handler(store):
 
         def _apply_tenant(self, q):
             """``X-Geomesa-Tenant`` header -> ``tenant`` query hint for
-            the per-tenant meter (utils/tenants.py). setdefault: a hint
-            the caller set explicitly WINS over the transport header;
-            neither present means the meter's ``anon`` default."""
+            the per-tenant meter (utils/tenants.py), and
+            ``X-Geomesa-Priority`` -> the ``geomesa.query.priority``
+            hint for admission classing (utils/admission.classify).
+            setdefault both: a hint the caller set explicitly WINS over
+            the transport header; junk priority values fall through to
+            the tenant/default classification downstream."""
             hdr = self.headers.get("X-Geomesa-Tenant")
             if hdr:
                 q.hints.setdefault("tenant", hdr)
+            pri = self.headers.get("X-Geomesa-Priority")
+            if pri:
+                from geomesa_tpu.utils.admission import PRIORITY_HINT
+
+                q.hints.setdefault(PRIORITY_HINT, pri)
             return q
 
         def _window_param(self, params, default_s: float):
@@ -1057,6 +1093,21 @@ def make_handler(store):
                     if regressed:
                         body["sentry"] = {"regressed": regressed}
                         body["status"] = "degraded"
+                    # brownout ladder (utils/brownout.py): any active
+                    # level is a NAMED degradation — the balancer sees
+                    # "brownout-L2" and which classes are being shed,
+                    # not just a generic "degraded"
+                    bo = getattr(store, "_brownout", None)
+                    if bo is not None and bo.level > 0:
+                        from geomesa_tpu.utils import brownout as _bo_mod
+
+                        if _bo_mod.enabled():
+                            body["brownout"] = {
+                                "level": bo.level,
+                                "name": f"brownout-L{bo.level}",
+                                "shedding": bo.shedding_classes(),
+                            }
+                            body["status"] = "degraded"
                     self._send(200, json.dumps(body))
                 elif route == "/debug/traces":
                     # ?n= validated by the shared contract (400 on the
@@ -1076,6 +1127,16 @@ def make_handler(store):
                     self._send(
                         200,
                         json.dumps(debug_overload_payload(store), default=str),
+                    )
+                elif route == "/debug/brownout":
+                    # the brownout ladder (utils/brownout.py): live
+                    # level, the signals the last tick folded, recent
+                    # transitions, per-class shed counters — the
+                    # operator's "what is the overload defense doing
+                    # RIGHT NOW" answer
+                    self._send(
+                        200,
+                        json.dumps(debug_brownout_payload(store), default=str),
                     )
                 elif route == "/debug/recovery":
                     # crash-consistency debug page: what startup recovery

@@ -131,6 +131,14 @@
 #     parity, and a REAL SIGKILL respawns the worker THROUGH the same
 #     launcher — launch attempts tick on /debug/fleet's launcher block,
 #     never a residual local-Popen path
+#   - closed-loop overload defense (tests/test_brownout.py, its own
+#     leg): a 4x-oversubscribed mixed-priority flood against a burning
+#     SLO drives the brownout ladder up — critical-class queries answer
+#     with FULL parity (never truncated, never shed), lower classes
+#     shed as crisp ShedLoad with a burn-derived Retry-After, retry
+#     budgets cap the retry amplification at the token bucket, and the
+#     ladder steps back down once the flood stops and the fast window
+#     clears
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
@@ -169,4 +177,10 @@ timeout -k 10 150 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py \
     -q -m chaos -p no:cacheprovider \
     -k "ship or asym or ssh" "$@" || rc=$?
+# the overload-defense leg: the 4x-oversubscription brownout soak
+# (priority floods, ladder walk, retry-budget caps) — bounded on its
+# own so a wedged flood thread can never eat the parity soaks' budget
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_brownout.py \
+    -q -m chaos -p no:cacheprovider "$@" || rc=$?
 exit $rc

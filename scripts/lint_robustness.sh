@@ -191,6 +191,33 @@ while IFS= read -r f; do
     fi
 done < <(grep -rlE 'fsync_replace\(|os\.remove\(' --include='*.py' geomesa_tpu/store/ || true)
 
+# every load-shed must be accountable: a `raise ShedLoad` outside the
+# admission/brownout engines (which ARE the accounting) must carry a
+# reason-coded decision() within the few lines above it — an anonymous
+# 503 is exactly the overload signal a postmortem can't reconstruct
+while IFS= read -r f; do
+    case "$f" in
+        geomesa_tpu/utils/admission.py|geomesa_tpu/utils/brownout.py|geomesa_tpu/utils/audit.py) continue ;;
+    esac
+    bad=$(awk '
+        /decision\(/ { last_decision = NR }
+        /raise ShedLoad/ {
+            if (last_decision == 0 || NR - last_decision > 6)
+                print FILENAME ":" NR
+        }
+    ' "$f")
+    if [ -n "$bad" ]; then
+        echo "FAIL: unaccounted ShedLoad raise site(s):"
+        echo "$bad" | sed 's/^/      /'
+        echo "      (every shed outside utils/admission.py + utils/brownout.py"
+        echo "       must pair with a reason-coded decision(point, reason, ...)"
+        echo "       within the ~5 preceding lines — or route through the"
+        echo "       admission/brownout engines, which count and reason-code"
+        echo "       every refusal; see utils/audit.decision)"
+        fail=1
+    fi
+done < <(grep -rlE 'raise ShedLoad' --include='*.py' geomesa_tpu/ || true)
+
 if [ "$fail" -eq 0 ]; then
     echo "robustness lint clean"
 fi

@@ -287,6 +287,7 @@ def _fold_snaps(snaps: list) -> dict:
     coalesce = {"groups": 0, "members": 0}
     breakers: dict = {}
     admission: dict = {}
+    brownout: dict = {}
     tenants: dict = {}
     for s in snaps:
         for k, v in s.get("counters", {}).items():
@@ -310,8 +311,10 @@ def _fold_snaps(snaps: list) -> dict:
             acc["misses"] += block.get("misses", 0)
         breakers = s.get("breakers", breakers)
         admission = s.get("admission", admission)
+        brownout = s.get("brownout", brownout)
     return {"counters": counters, "caches": caches, "coalesce": coalesce,
-            "breakers": breakers, "admission": admission, "tenants": tenants}
+            "breakers": breakers, "admission": admission,
+            "brownout": brownout, "tenants": tenants}
 
 
 def _render_fold(fold: dict, stamp: str) -> None:
@@ -341,6 +344,19 @@ def _render_fold(fold: dict, stamp: str) -> None:
         )
     if open_breakers:
         parts.append(f"breakers={','.join(open_breakers)}")
+    # the overload-defense pane: active brownout level (LAST state in
+    # the window, the breaker convention) + the window's per-class shed
+    # deltas, so "who is being refused" reads off the same line
+    bo = fold.get("brownout") or {}
+    if bo.get("level"):
+        parts.append(f"bo=L{bo['level']}")
+    pri_sheds = [
+        f"{k[len('shed.priority.'):]}:{v}"
+        for k, v in sorted(counters.items())
+        if k.startswith("shed.priority.") and v
+    ]
+    if pri_sheds:
+        parts.append(f"shed.pri={','.join(pri_sheds)}")
     print(f"[{stamp}] " + " ".join(parts), flush=True)
     # the tenants pane: who spent the window's device time (utils/
     # tenants.py deltas embedded in the same flight-recorder snapshots,
@@ -476,6 +492,19 @@ def watch_history(root: str, at: float = None, window: float = 300.0) -> None:
             stamp = time.strftime("%H:%M:%S", time.localtime(rec.get("t", 0)))
             print(
                 f"[{stamp}] sentry {rec.get('state')}: {rec.get('fingerprint')}",
+                flush=True,
+            )
+        elif rec.get("kind") == "brownout":
+            # ladder transitions (utils/brownout.py): one line per rung
+            # move, with the signals that drove it — a postmortem reads
+            # WHEN the defense engaged and why off the same replay
+            stamp = time.strftime("%H:%M:%S", time.localtime(rec.get("t", 0)))
+            print(
+                f"[{stamp}] brownout L{rec.get('from')}->L{rec.get('level')}"
+                f" (target L{rec.get('target')},"
+                f" queue={rec.get('queue_ratio')},"
+                f" slo={','.join(rec.get('slo_violating') or []) or '-'},"
+                f" breakers={len(rec.get('open_breakers') or [])})",
                 flush=True,
             )
 

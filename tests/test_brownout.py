@@ -1,0 +1,526 @@
+"""Closed-loop overload defense: the brownout ladder (utils/brownout.py),
+the priority-aware shed path it drives through the admission gate, the
+per-boundary retry budgets (utils/retry.py), and the web surfaces that
+name the degradation. Unit coverage drives ``on_tick`` with synthetic
+signals (deterministic ladder walks, no timing); the chaos-marked soak at
+the bottom runs the real closed loop — a 4x-oversubscribed mixed-priority
+flood against a live timeline sampler — and asserts the standing
+invariant: overload may cost AVAILABILITY of low-priority classes, never
+correctness or critical-class availability.
+"""
+
+import contextvars
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from geomesa_tpu.geom.base import Point
+from geomesa_tpu.schema.featuretype import parse_spec
+from geomesa_tpu.index.planner import Query
+from geomesa_tpu.store.datastore import TpuDataStore
+from geomesa_tpu.utils import admission as admission_mod
+from geomesa_tpu.utils import brownout as brownout_mod
+from geomesa_tpu.utils import retry as retry_mod
+from geomesa_tpu.utils import tenants as tenants_mod
+from geomesa_tpu.utils.admission import PRIORITY_HINT
+from geomesa_tpu.utils.audit import ShedLoad, robustness_metrics
+from geomesa_tpu.utils.brownout import BrownoutController
+from geomesa_tpu.utils.config import properties
+from geomesa_tpu.utils.retry import RetryPolicy
+
+SPEC = "name:String,dtg:Date,*geom:Point:srid=4326"
+T0 = 1483228800000
+ROWS = 20
+
+
+@pytest.fixture(autouse=True)
+def _reset_overload_state():
+    """Every test leaves the cached flags, budgets, and priority maps as
+    it found them — the free-when-off caches are module globals."""
+    yield
+    brownout_mod.set_enabled(None)
+    retry_mod.reset_budgets()
+    admission_mod.reset_default_priority()
+    tenants_mod.reset_priority_map()
+
+
+def counter(name):
+    return robustness_metrics().report().get(name, 0)
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def _small_store(**kw):
+    s = TpuDataStore(**kw)
+    ft = parse_spec("t", SPEC)
+    s.create_schema(ft)
+    with s.writer("t") as w:
+        for i in range(ROWS):
+            w.write([f"n{i % 3}", T0 + i, Point(float(i % 10), float(i % 7))],
+                    fid=f"f{i}")
+    return s
+
+
+def _pq(priority):
+    """An INCLUDE query carrying a priority hint."""
+    return Query(hints={PRIORITY_HINT: priority})
+
+
+class _FakeAdmission:
+    def __init__(self, max_queue):
+        self.max_queue = max_queue
+        self.queued = 0
+
+    def peek(self):
+        return {"queued": self.queued}
+
+
+class _FakeStore:
+    """The minimum surface on_tick reads: .admission. No SLO engine, no
+    history spool (both looked up with create=False and absent here)."""
+
+    def __init__(self, max_queue=10):
+        self.admission = _FakeAdmission(max_queue)
+
+
+# -- the ladder (deterministic: synthetic signals, no clock) ------------------
+
+
+def test_ladder_walks_one_rung_with_enter_exit_hysteresis():
+    store = _FakeStore(max_queue=10)
+    bo = BrownoutController()
+    with properties(
+        geomesa_brownout_enter_ticks="2", geomesa_brownout_exit_ticks="2"
+    ):
+        store.admission.queued = 10  # ratio 1.0 -> target 3 immediately
+        levels = []
+        for _ in range(7):
+            block = bo.on_tick(store)
+            levels.append(bo.level)
+            assert block is not None and block["target"] == 3
+        # one rung per enter_ticks consecutive over-target ticks — a
+        # target of 3 never jumps the ladder
+        assert levels == [0, 1, 1, 2, 2, 3, 3]
+
+        store.admission.queued = 0  # clear -> target 0
+        levels = []
+        for _ in range(7):
+            bo.on_tick(store)
+            levels.append(bo.level)
+        assert levels == [3, 2, 2, 1, 1, 0, 0]
+
+    # every transition is a history record with the signals that drove it
+    snap = bo.snapshot()
+    assert len(snap["transitions"]) == 6
+    assert all(rec["kind"] == "brownout" for rec in snap["transitions"])
+    ups = [r for r in snap["transitions"] if r["level"] > r["from"]]
+    assert [r["level"] for r in ups] == [1, 2, 3]
+
+
+def test_one_noisy_tick_never_flaps_the_ladder():
+    store = _FakeStore(max_queue=10)
+    bo = BrownoutController()
+    with properties(
+        geomesa_brownout_enter_ticks="2", geomesa_brownout_exit_ticks="2"
+    ):
+        # alternating over/under target: the enter streak resets on every
+        # clear tick, so the ladder never leaves 0
+        for _ in range(6):
+            store.admission.queued = 10
+            bo.on_tick(store)
+            store.admission.queued = 0
+            bo.on_tick(store)
+        assert bo.level == 0 and not bo.snapshot()["transitions"]
+
+
+def test_quiet_store_tick_reports_nothing():
+    # level 0, target 0, no history: the tick block stays None so the
+    # timeline snapshot is byte-identical to a build without brownout
+    bo = BrownoutController()
+    assert bo.on_tick(_FakeStore()) is None
+    assert bo.on_tick(object()) is None  # no admission at all: still quiet
+
+
+def test_slo_burn_escalates_and_breakers_force_speculation_off(monkeypatch):
+    from geomesa_tpu.utils import breaker as breaker_mod
+    from geomesa_tpu.utils import slo as slo_mod
+
+    class _Eng:
+        def evaluate(self, exemplars=True):
+            return {
+                "violating": ["query-availability"],
+                "slos": [{"violating": True, "fast": {"burn_rate": 14.9}}],
+            }
+
+    store = _FakeStore(max_queue=10)
+    bo = BrownoutController()
+    with properties(
+        geomesa_brownout_enter_ticks="1", geomesa_brownout_exit_ticks="1"
+    ):
+        # a burning SLO with an EMPTY queue still targets level 1:
+        # latency is hurting even where the queue isn't deep yet
+        monkeypatch.setattr(slo_mod, "engine_for", lambda s, create=True: _Eng())
+        bo.on_tick(store)
+        assert bo.level == 1
+        assert bo._last_signals["target"] == 1
+        # Retry-After derives from the worst violating fast burn
+        assert bo.retry_after_s() == 15
+
+        # an open breaker under pressure forces at least the
+        # speculation-off rung: stop re-issuing work against a fabric
+        # that is already failing
+        monkeypatch.setattr(
+            breaker_mod, "peek_states", lambda: {"device": "open"}
+        )
+        bo.on_tick(store)
+        assert bo.level == 2 and bo._last_signals["target"] == 2
+
+    # but an open breaker with NO pressure never raises the ladder alone
+    bo2 = BrownoutController()
+    monkeypatch.setattr(slo_mod, "engine_for", lambda s, create=True: None)
+    for _ in range(4):
+        bo2.on_tick(store if store.admission.queued == 0 else store)
+    assert bo2.level == 0
+
+
+def test_level_semantics_matrix():
+    bo = BrownoutController()
+    for level, shed, queue_ok, spec in [
+        (0, [], ["critical", "interactive", "batch", "background"], True),
+        (1, ["background"], ["critical", "interactive", "batch"], True),
+        (2, ["batch", "background"], ["critical", "interactive"], False),
+        (3, ["batch", "background"], ["critical"], False),
+    ]:
+        bo.level = level
+        assert [p for p in admission_mod.PRIORITIES if bo.should_shed(p)] \
+            == sorted(shed, key=admission_mod.PRIORITIES.index)
+        assert bo.shedding_classes() == shed
+        assert [p for p in queue_ok if not bo.queue_allowed(p)] == []
+        assert bo.hedging_allowed() == spec
+        assert bo.speculation_allowed() == spec
+    # critical is untouchable at EVERY level — the standing invariant
+    for level in range(4):
+        bo.level = level
+        assert not bo.should_shed("critical")
+        assert bo.queue_allowed("critical")
+
+
+# -- the query-path gate ------------------------------------------------------
+
+
+def test_forced_level_sheds_low_classes_with_retry_after():
+    store = _small_store(max_inflight=4, max_queue=4)
+    bo = store._brownout
+    bo.level = 1
+    bo._retry_after_s = 7.0
+    try:
+        before = counter("shed.brownout")
+        with pytest.raises(ShedLoad) as ei:
+            store.query("t", _pq("background"))
+        assert ei.value.retry_after_s == 7.0
+        assert counter("shed.brownout") == before + 1
+        # level 1 touches ONLY background: every other class answers in full
+        for pri in ("critical", "interactive", "batch"):
+            assert len(store.query("t", _pq(pri))) == ROWS
+
+        bo.level = 2  # batch joins the shed set
+        for pri in ("batch", "background"):
+            with pytest.raises(ShedLoad):
+                store.query("t", _pq(pri))
+        assert len(store.query("t", _pq("interactive"))) == ROWS
+
+        bo.level = 3  # interactive fail-fast (uncontended: still answers)
+        assert len(store.query("t", _pq("interactive"))) == ROWS
+        assert len(store.query("t", _pq("critical"))) == ROWS
+    finally:
+        bo.level = 0
+
+
+def test_disabled_flag_is_byte_identical_even_at_forced_level():
+    store = _small_store(max_inflight=4, max_queue=4)
+    store._brownout.level = 3
+    store._brownout._retry_after_s = 9.0
+    brownout_mod.set_enabled(False)
+    try:
+        before = counter("shed.brownout")
+        # every class answers in full: the gate is one cached-flag read
+        for pri in admission_mod.PRIORITIES:
+            assert len(store.query("t", _pq(pri))) == ROWS
+        assert counter("shed.brownout") == before
+    finally:
+        store._brownout.level = 0
+    with properties(geomesa_brownout_enabled="false"):
+        brownout_mod.set_enabled(None)
+        assert not brownout_mod.enabled()
+    brownout_mod.set_enabled(None)
+
+
+# -- retry budgets ------------------------------------------------------------
+
+
+def test_retry_budget_exhaustion_fails_crisply_with_original_error():
+    with properties(
+        geomesa_retry_budget_cap="2",
+        geomesa_retry_budget_min="0",
+        geomesa_retry_budget_ratio="0",
+    ):
+        retry_mod.reset_budgets()
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise OSError("dependency down")
+
+        policy = RetryPolicy(
+            name="bt_exhaust", max_attempts=10, base_s=0.0, cap_s=0.0,
+            sleep=lambda s: None,
+        )
+        before = counter("retry.bt_exhaust.budget_exhausted")
+        with pytest.raises(OSError, match="dependency down"):
+            policy.call(boom)
+        # bucket cap 2, zero refill: 1 initial call + exactly 2 retries —
+        # the retry storm is capped at the bucket, never at max_attempts
+        assert len(calls) == 3
+        assert counter("retry.bt_exhaust.budget_exhausted") == before + 1
+        snap = retry_mod.budgets_snapshot()["bt_exhaust"]
+        assert snap["tokens"] == 0.0 and snap["cap"] == 2.0
+
+        # a second policy instance with the SAME name shares the bucket:
+        # its very first retry finds the budget already spent
+        calls2 = []
+
+        def boom2():
+            calls2.append(1)
+            raise OSError("still down")
+
+        with pytest.raises(OSError, match="still down"):
+            RetryPolicy(
+                name="bt_exhaust", max_attempts=10, base_s=0.0, cap_s=0.0,
+                sleep=lambda s: None,
+            ).call(boom2)
+        assert len(calls2) == 1
+
+
+def test_retry_budget_refill_floor_and_disabled_path():
+    with properties(
+        geomesa_retry_budget_cap="1",
+        geomesa_retry_budget_min="1000",
+        geomesa_retry_budget_ratio="0",
+    ):
+        retry_mod.reset_budgets()
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise OSError("flap")
+
+        # the Finagle floor: 1000 tokens/s refill means the bucket never
+        # stays empty across attempts — all max_attempts run
+        with pytest.raises(OSError):
+            RetryPolicy(
+                name="bt_floor", max_attempts=4, base_s=0.001, cap_s=0.002,
+            ).call(boom)
+        assert len(calls) == 4
+
+    with properties(geomesa_retry_budget_enabled="false"):
+        retry_mod.reset_budgets()
+        calls = []
+        with pytest.raises(OSError):
+            RetryPolicy(
+                name="bt_off", max_attempts=4, base_s=0.0, cap_s=0.0,
+                sleep=lambda s: None,
+            ).call(boom)
+        assert len(calls) == 4
+        assert "bt_off" not in retry_mod.budgets_snapshot()
+    retry_mod.reset_budgets()
+
+
+# -- web surfaces -------------------------------------------------------------
+
+
+def test_web_names_brownout_and_propagates_retry_after():
+    from geomesa_tpu.web import GeoMesaServer
+
+    store = _small_store(max_inflight=4, max_queue=4)
+    bo = store._brownout
+    with GeoMesaServer(store) as url:
+        bo.level = 2
+        bo._retry_after_s = 9.0
+        try:
+            # the transport header classifies; the shed carries the
+            # burn-derived Retry-After, not the generic "1"
+            req = urllib.request.Request(
+                url + "/query?name=t",
+                headers={"X-Geomesa-Priority": "background"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 503
+            assert ei.value.headers["Retry-After"] == "9"
+
+            # /healthz NAMES the degradation and what it sheds
+            health = _get(url + "/healthz")
+            assert health["status"] == "degraded"
+            assert health["brownout"]["name"] == "brownout-L2"
+            assert health["brownout"]["shedding"] == ["batch", "background"]
+
+            # /debug/brownout and /debug/overload carry the ladder state
+            dbg = _get(url + "/debug/brownout")["brownout"]
+            assert dbg["enabled"] and dbg["level"] == 2
+            over = _get(url + "/debug/overload")
+            assert over["brownout"]["level"] == 2
+            assert isinstance(over["retry_budgets"], dict)
+            # a critical query still answers in full THROUGH the server
+            body = _get(url + "/query?name=t")  # hintless: default class
+            assert len(body["features"]) == ROWS
+        finally:
+            bo.level = 0
+
+        # level cleared: /healthz carries no brownout block at all
+        health = _get(url + "/healthz")
+        assert "brownout" not in health
+
+
+def test_junk_priority_header_falls_back_and_still_answers():
+    from geomesa_tpu.web import GeoMesaServer
+
+    store = _small_store(max_inflight=4, max_queue=4)
+    bo = store._brownout
+    with GeoMesaServer(store) as url:
+        bo.level = 1  # sheds background only
+        try:
+            # a junk header value classifies as the default (interactive)
+            # — never a 500, never a shed at level 1
+            req = urllib.request.Request(
+                url + "/query?name=t",
+                headers={"X-Geomesa-Priority": "vip!!"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert len(json.loads(r.read())["features"]) == ROWS
+        finally:
+            bo.level = 0
+
+
+# -- the chaos soak: the real closed loop -------------------------------------
+
+
+@pytest.mark.chaos
+def test_brownout_soak_4x_oversubscription_critical_parity():
+    """The acceptance soak: a 4x-oversubscribed mixed-priority flood
+    against a live timeline sampler. The queue fills, overflow sheds
+    burn the availability SLO, the sampler's ticks walk the ladder up;
+    critical-class queries answer with FULL parity throughout (never
+    truncated, never shed), lower classes shed as crisp ShedLoad
+    carrying a Retry-After, /healthz names the brownout level — and
+    once the flood stops the ladder steps back down to 0."""
+    from geomesa_tpu.web import GeoMesaServer
+
+    with properties(
+        geomesa_timeline_interval="50 ms",
+        geomesa_slo_min_events="5",
+        geomesa_slo_window_fast="2 seconds",
+        geomesa_slo_window_slow="6 seconds",
+        geomesa_brownout_enter_ticks="1",
+        geomesa_brownout_exit_ticks="1",
+        geomesa_brownout_queue_ratio_1="0.25",
+        geomesa_brownout_queue_ratio_2="0.5",
+        geomesa_brownout_queue_ratio_3="0.75",
+    ):
+        store = _small_store(max_inflight=2, max_queue=4)
+        bo = store._brownout
+        with GeoMesaServer(store) as url:
+            stop = threading.Event()
+            errors = []            # invariant violations (must stay empty)
+            crit_answers = []      # every critical result's row count
+            shed_retry_afters = [] # Retry-After values brownout sheds carried
+            outcomes = {"ok": 0, "shed": 0}
+            lock = threading.Lock()
+
+            def critical_loop():
+                while not stop.is_set():
+                    try:
+                        n = len(store.query("t", _pq("critical")))
+                        with lock:
+                            crit_answers.append(n)
+                    except Exception as e:  # noqa: BLE001 - the assertion
+                        with lock:
+                            errors.append(f"critical: {type(e).__name__}: {e}")
+                        return
+
+            def flood_loop(priority):
+                while not stop.is_set():
+                    try:
+                        n = len(store.query("t", _pq(priority)))
+                        with lock:
+                            outcomes["ok"] += 1
+                        if n != ROWS:  # crisp-or-complete: never truncated
+                            with lock:
+                                errors.append(f"{priority}: truncated {n}")
+                            return
+                    except ShedLoad as e:
+                        with lock:
+                            outcomes["shed"] += 1
+                            if e.retry_after_s is not None:
+                                shed_retry_afters.append(e.retry_after_s)
+                    except Exception as e:  # noqa: BLE001 - the assertion
+                        with lock:
+                            errors.append(f"{priority}: {type(e).__name__}: {e}")
+                        return
+
+            # 2 in-flight slots, 4 queue slots vs 14 offered threads:
+            # >4x oversubscription, mixed classes
+            threads = [threading.Thread(target=critical_loop, daemon=True)
+                       for _ in range(2)]
+            threads += [
+                threading.Thread(target=flood_loop, args=(pri,), daemon=True)
+                for pri in (["background"] * 5 + ["batch"] * 4
+                            + ["interactive"] * 3)
+            ]
+            for t in threads:
+                t.start()
+
+            # the closed loop must raise the ladder on its own
+            deadline_ts = time.time() + 8.0
+            browned = None
+            while time.time() < deadline_ts and not errors:
+                if bo.level >= 1:
+                    h = _get(url + "/healthz")
+                    if h.get("brownout"):
+                        browned = h
+                        break
+                time.sleep(0.05)
+            stop.set()
+            for t in threads:
+                t.join(10.0)
+
+            assert not errors, errors
+            assert browned is not None, "flood never raised the ladder"
+            assert browned["status"] == "degraded"
+            assert browned["brownout"]["name"] == f"brownout-L{browned['brownout']['level']}"
+            # critical-class parity: every single answer complete
+            assert crit_answers and all(n == ROWS for n in crit_answers)
+            # low classes shed crisply, and the brownout sheds carried a
+            # usable Retry-After
+            assert outcomes["shed"] > 0
+            assert counter("shed.priority.background") > 0
+            assert shed_retry_afters and all(
+                ra >= 1.0 for ra in shed_retry_afters
+            )
+            # the snapshot attributes the sheds by class
+            snap = store.admission.snapshot()["priority"]
+            assert snap["critical"]["sheds"] == 0
+
+            # flood gone: the ladder steps back down to 0 on its own
+            deadline_ts = time.time() + 15.0
+            while time.time() < deadline_ts and bo.level > 0:
+                time.sleep(0.1)
+            assert bo.level == 0, f"ladder stuck at L{bo.level}"
+            health = _get(url + "/healthz")
+            assert "brownout" not in health

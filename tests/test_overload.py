@@ -44,12 +44,12 @@ def counter(name):
     return robustness_metrics().report().get(name, 0)
 
 
-def hold_slot(ctl):
+def hold_slot(ctl, priority=None):
     """Occupy one admission slot from a FOREIGN context — another
     request, as far as the reentrant admit is concerned — so the test's
     own context cannot ride it. Returns the release callable."""
     ctx = contextvars.Context()  # fresh, NOT a copy: no inherited flags
-    admit = ctl.admit()
+    admit = ctl.admit(priority=priority)
     ctx.run(admit.__enter__)
     return lambda: ctx.run(admit.__exit__, None, None, None)
 
@@ -459,3 +459,166 @@ def test_healthz_degrades_while_breaker_open_or_shedding():
             release()
         health = _get(url + "/healthz")  # recent shed also degrades
         assert health["status"] == "degraded" and health["shedding"]
+
+
+# ---------------------------------------------------------------------------
+# priority classes: the critical-reserve floor + starvation regression
+
+
+def test_priority_reserve_floor_holds_under_background_flood():
+    """A background flood cannot starve critical: the reserved slot keeps
+    the LAST in-flight slot for critical-class admits even with the rest
+    of the gate saturated by background traffic (the starvation
+    regression for the priority-aware admission gate)."""
+    before = counter("shed.priority.background")
+    ctl = AdmissionController(2, 0, name="pri-floor", critical_reserve=1)
+
+    release = hold_slot(ctl, priority="background")
+    try:
+        # a SECOND background admit may not take the reserved slot: its
+        # effective limit is max_inflight - reserve = 1, already full,
+        # and max_queue=0 makes the refusal a crisp shed
+        def bg():
+            with ctl.admit(priority="background"):
+                pass  # pragma: no cover - must not admit
+
+        with pytest.raises(ShedLoad):
+            contextvars.Context().run(bg)
+        assert counter("shed.priority.background") == before + 1
+
+        # ...but a critical admit walks straight into the reserved slot
+        admitted = []
+
+        def crit():
+            with ctl.admit(priority="critical"):
+                admitted.append(ctl.peek())
+
+        contextvars.Context().run(crit)
+        assert admitted and admitted[0]["priority"]["critical"] == 1
+        assert admitted[0]["priority"]["background"] == 1
+    finally:
+        release()
+
+    snap = ctl.snapshot()
+    assert snap["critical_reserve"] == 1
+    pri = snap["priority"]
+    assert pri["critical"]["admitted"] == 1 and pri["critical"]["sheds"] == 0
+    assert pri["background"]["sheds"] >= 1
+    # per-class queue-wait histograms ride the snapshot (satellite)
+    assert "wait_ms" in pri["critical"]
+
+
+def test_priority_release_wakes_queued_critical_not_just_background():
+    """The lost-wakeup regression: with a background waiter AND a
+    critical waiter parked on the same condition, a release must wake
+    the critical waiter even though the background waiter (over its
+    class limit) cannot proceed — _release broadcasts while a critical
+    admit is queued."""
+    ctl = AdmissionController(2, 8, name="pri-wake", critical_reserve=1)
+
+    rel_bg = hold_slot(ctl, priority="background")   # non-critical limit full
+    rel_c1 = hold_slot(ctl, priority="critical")     # gate now fully in-flight
+
+    got_critical = threading.Event()
+    bg_admitted = threading.Event()
+
+    def queued_critical():
+        def run():
+            with ctl.admit(budget_s=10.0, priority="critical"):
+                got_critical.set()
+        contextvars.Context().run(run)
+
+    def queued_background():
+        def run():
+            try:
+                with ctl.admit(budget_s=10.0, priority="background"):
+                    bg_admitted.set()
+            except (ShedLoad, QueryTimeout):
+                pass
+        contextvars.Context().run(run)
+
+    t_bg = threading.Thread(target=queued_background, daemon=True)
+    t_cr = threading.Thread(target=queued_critical, daemon=True)
+    t_bg.start()
+    # let the background waiter park first so a single targeted notify
+    # would hit IT (and stall forever) if release didn't broadcast
+    deadline_t = time.monotonic() + 5.0
+    while ctl.peek()["queued"] < 1 and time.monotonic() < deadline_t:
+        time.sleep(0.005)
+    t_cr.start()
+    while ctl.peek()["queued"] < 2 and time.monotonic() < deadline_t:
+        time.sleep(0.005)
+
+    rel_c1()  # frees one slot: only the CRITICAL waiter may take it
+    assert got_critical.wait(5.0), "queued critical admit starved"
+    assert not bg_admitted.is_set()  # background still over its limit
+
+    rel_bg()  # now the background waiter's class limit clears too
+    assert bg_admitted.wait(5.0)
+    t_bg.join(5.0)
+    t_cr.join(5.0)
+    assert ctl.peek()["inflight"] == 0 and ctl.peek()["queued"] == 0
+
+
+def test_classify_hint_beats_tenant_default_and_bad_values_fall_back():
+    from geomesa_tpu.utils import admission as admission_mod
+
+    assert admission_mod.classify({"geomesa.query.priority": "batch"}) == "batch"
+    assert admission_mod.classify({}) == admission_mod.default_priority()
+    # junk hint values fall back to the configured default, never raise
+    assert (admission_mod.classify({"geomesa.query.priority": "vip!!"})
+            == admission_mod.default_priority())
+
+
+def test_full_queue_of_low_class_waiters_cannot_crowd_out_critical():
+    """The queue-overflow mirror of the reserve floor: with the wait
+    queue full of lower-class waiters, a critical admit still QUEUES
+    (bounded by max_queue critical waiters) instead of shedding — a
+    background flood can never cost critical-class availability."""
+    ctl = AdmissionController(1, 1, name="pri-queue", critical_reserve=0)
+    rel = hold_slot(ctl)  # the one slot busy
+
+    waiter_done = threading.Event()
+
+    def interactive_waiter():
+        def run():
+            with ctl.admit(budget_s=10.0):
+                pass
+            waiter_done.set()
+        contextvars.Context().run(run)
+
+    t_wait = threading.Thread(target=interactive_waiter, daemon=True)
+    t_wait.start()
+    deadline_t = time.monotonic() + 5.0
+    while ctl.peek()["queued"] < 1 and time.monotonic() < deadline_t:
+        time.sleep(0.005)
+    assert ctl.peek()["queued"] == 1  # queue full (max_queue=1)
+
+    # a second non-critical admit overflows crisply...
+    def bg():
+        with ctl.admit(priority="background"):
+            pass  # pragma: no cover - must not admit
+
+    with pytest.raises(ShedLoad):
+        contextvars.Context().run(bg)
+
+    # ...but a critical admit joins the queue and eventually answers
+    got_critical = threading.Event()
+
+    def crit():
+        def run():
+            with ctl.admit(budget_s=10.0, priority="critical"):
+                got_critical.set()
+        contextvars.Context().run(run)
+
+    t_crit = threading.Thread(target=crit, daemon=True)
+    t_crit.start()
+    while ctl.peek()["queued"] < 2 and time.monotonic() < deadline_t:
+        time.sleep(0.005)
+    assert ctl.peek()["queued"] == 2  # over max_queue: the critical lane
+
+    rel()  # drain: both waiters must complete, neither sheds
+    assert waiter_done.wait(5.0) and got_critical.wait(5.0)
+    t_wait.join(5.0)
+    t_crit.join(5.0)
+    assert ctl.peek()["inflight"] == 0 and ctl.peek()["queued"] == 0

@@ -203,8 +203,12 @@ def test_sampler_observes_breakers_and_admission_passively():
     before, _g, _t, _tt = robustness_metrics().snapshot()
     snap = s.tick()
     assert snap["breakers"]["tl.passive"] == "open"
+    # the peek carries the capacity alongside the depths (the fleet's
+    # pre-dispatch backpressure judges saturation from one peek)
     assert snap["admission"] == {
         "inflight": 0, "queued": 0, "sheds": 0, "admitted": 0,
+        "max_inflight": store.admission.max_inflight,
+        "max_queue": store.admission.max_queue,
     }
     clk["t"] = 10.0  # past cooldown: peek READS half-open...
     snap = s.tick()
